@@ -1,0 +1,25 @@
+"""cnosdb_tpu — a TPU-native distributed time-series database.
+
+A ground-up rebuild of the capability surface of CnosDB (reference:
+/root/reference, Rust, v2.4.3) designed TPU-first:
+
+- Host side (Python + C++ codecs): columnar TSM storage (pages/chunks/
+  footer+bloom), WAL, memcache, flush, leveled compaction, series index,
+  meta/coordinator/sharding.
+- Device side (JAX/XLA): the scan data plane — predicate filters,
+  time-bucketed GROUP BY and the aggregate set (count/sum/mean/min/max/
+  first/last) run as jit/shard_map programs with segment reductions and
+  ICI psum partial-aggregate combining.
+
+Layer map mirrors reference SURVEY.md §1 (services → query → coordinator →
+meta → replication → storage) but is architected around XLA's compilation
+model: static padded block shapes, segment ids for (series × time-bucket)
+grouping, collectives over a jax.sharding.Mesh instead of NCCL/gRPC fanout
+on the hot path.
+
+This top-level import is intentionally light (models/storage only need
+numpy); jax loads — and x64 is enabled, timestamps are i64 ns — when the
+device-side `cnosdb_tpu.ops` / `cnosdb_tpu.parallel` modules import.
+"""
+
+__version__ = "0.1.0"

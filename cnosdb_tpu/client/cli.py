@@ -1,0 +1,34 @@
+"""`cnosdb-tpu-cli` — interactive SQL REPL over the HTTP API.
+
+Counterpart of the reference's `client/` crate (cnosdb-cli,
+client/src/main.rs:188, exec.rs). Grows with the HTTP service.
+"""
+from __future__ import annotations
+
+import argparse
+import sys
+
+
+def build_arg_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="cnosdb-tpu-cli", description=__doc__)
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=8902)
+    p.add_argument("-u", "--user", default="root")
+    p.add_argument("-p", "--password", default="")
+    p.add_argument("-d", "--database", default="public")
+    p.add_argument("--file", help="execute statements from file and exit")
+    p.add_argument("-c", "--command", help="execute one statement and exit")
+    p.add_argument("--format", default="table",
+                   choices=["table", "csv", "tsv", "json"])
+    return p
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = build_arg_parser().parse_args(argv)
+    from .repl import run_repl
+
+    return run_repl(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,129 @@
+"""Error taxonomy.
+
+Mirrors the reference's per-crate error enums (e.g. tskv/src/error.rs,
+meta/src/error.rs, query_server/spi/src/lib.rs QueryError) collapsed into a
+single hierarchy with stable error codes, matching the numbered error-code
+scheme the reference derives via derive_traits/error_code.
+"""
+from __future__ import annotations
+
+
+class CnosError(Exception):
+    """Base error. `code` is a stable string like the reference's 010001."""
+
+    code = "000000"
+
+    def __init__(self, message: str = "", **ctx):
+        self.message = message
+        self.ctx = ctx
+        super().__init__(message)
+
+    def __str__(self) -> str:  # pragma: no cover - repr sugar
+        if self.ctx:
+            kv = ", ".join(f"{k}={v!r}" for k, v in self.ctx.items())
+            return f"[{self.code}] {self.message} ({kv})"
+        return f"[{self.code}] {self.message}"
+
+
+class ConfigError(CnosError):
+    code = "010001"
+
+
+class MetaError(CnosError):
+    code = "020001"
+
+
+class TenantNotFound(MetaError):
+    code = "020002"
+
+
+class DatabaseNotFound(MetaError):
+    code = "020003"
+
+
+class DatabaseAlreadyExists(MetaError):
+    code = "020004"
+
+
+class TableNotFound(MetaError):
+    code = "020005"
+
+
+class TableAlreadyExists(MetaError):
+    code = "020006"
+
+
+class BucketNotFound(MetaError):
+    code = "020007"
+
+
+class StorageError(CnosError):
+    code = "030001"
+
+
+class WalError(StorageError):
+    code = "030002"
+
+
+class TsmError(StorageError):
+    code = "030003"
+
+
+class ChecksumMismatch(StorageError):
+    code = "030004"
+
+
+class CodecError(StorageError):
+    code = "030005"
+
+
+class IndexError_(StorageError):
+    code = "030006"
+
+
+class SchemaError(CnosError):
+    code = "040001"
+
+
+class FieldTypeMismatch(SchemaError):
+    code = "040002"
+
+
+class ColumnNotFound(SchemaError):
+    code = "040003"
+
+
+class QueryError(CnosError):
+    code = "050001"
+
+
+class ParserError(QueryError):
+    code = "050002"
+
+
+class PlanError(QueryError):
+    code = "050003"
+
+
+class ExecutionError(QueryError):
+    code = "050004"
+
+
+class FunctionError(QueryError):
+    code = "050005"
+
+
+class CoordinatorError(CnosError):
+    code = "060001"
+
+
+class ReplicationError(CnosError):
+    code = "070001"
+
+
+class AuthError(CnosError):
+    code = "080001"
+
+
+class LimiterError(CnosError):
+    code = "090001"

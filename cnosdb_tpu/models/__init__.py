@@ -1,0 +1,34 @@
+from .codec import Encoding, codecs_for
+from .schema import (
+    ValueType,
+    ColumnType,
+    TableColumn,
+    TskvTableSchema,
+    DatabaseSchema,
+    DatabaseOptions,
+    Precision,
+    TenantOptions,
+    Duration,
+)
+from .series import Tag, SeriesKey
+from .predicate import (
+    TimeRange,
+    TimeRanges,
+    Domain,
+    RangeDomain,
+    SetDomain,
+    AllDomain,
+    NoneDomain,
+    ColumnDomains,
+)
+from .meta_data import NodeInfo, VnodeInfo, ReplicationSet, BucketInfo, VnodeStatus
+
+__all__ = [
+    "Encoding", "codecs_for",
+    "ValueType", "ColumnType", "TableColumn", "TskvTableSchema",
+    "DatabaseSchema", "DatabaseOptions", "Precision", "TenantOptions", "Duration",
+    "Tag", "SeriesKey",
+    "TimeRange", "TimeRanges", "Domain", "RangeDomain", "SetDomain",
+    "AllDomain", "NoneDomain", "ColumnDomains",
+    "NodeInfo", "VnodeInfo", "ReplicationSet", "BucketInfo", "VnodeStatus",
+]

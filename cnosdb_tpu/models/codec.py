@@ -1,0 +1,67 @@
+"""Column encodings.
+
+Mirrors the reference's Encoding enum and per-type legal-codec tables
+(common/models/src/codec.rs:5-54). Numeric discriminants are kept identical
+so TSM files carry compatible ids.
+"""
+from __future__ import annotations
+
+import enum
+
+
+class Encoding(enum.IntEnum):
+    DEFAULT = 0
+    NULL = 1
+    DELTA = 2
+    QUANTILE = 3
+    GZIP = 4
+    BZIP = 5
+    GORILLA = 6
+    SNAPPY = 7
+    ZSTD = 8
+    ZLIB = 9
+    BITPACK = 10
+    DELTA_TS = 11
+    UNKNOWN = 15
+
+    @classmethod
+    def from_str(cls, s: str) -> "Encoding":
+        return cls[s.strip().upper()]
+
+
+# Legal codecs per value type (codec.rs:5-34). QUANTILE maps to our
+# zstd-of-deltas fallback (reference uses pco); SNAPPY maps to zlib level 1
+# (no python-snappy in env) — ids preserved, implementation differs.
+INTEGER_CODECS = (Encoding.DEFAULT, Encoding.NULL, Encoding.DELTA, Encoding.DELTA_TS, Encoding.QUANTILE)
+TIMESTAMP_CODECS = INTEGER_CODECS
+UNSIGNED_CODECS = INTEGER_CODECS
+DOUBLE_CODECS = (Encoding.DEFAULT, Encoding.NULL, Encoding.GORILLA, Encoding.QUANTILE)
+STRING_CODECS = (
+    Encoding.DEFAULT, Encoding.NULL, Encoding.GZIP, Encoding.BZIP,
+    Encoding.ZSTD, Encoding.SNAPPY, Encoding.ZLIB,
+)
+BOOLEAN_CODECS = (Encoding.DEFAULT, Encoding.NULL, Encoding.BITPACK)
+
+
+def codecs_for(value_type: str):
+    from .schema import ValueType
+
+    vt = value_type if isinstance(value_type, str) else value_type.name
+    table = {
+        "TIMESTAMP": TIMESTAMP_CODECS,
+        "TIME": TIMESTAMP_CODECS,
+        "BIGINT": INTEGER_CODECS,
+        "INTEGER": INTEGER_CODECS,
+        "BIGINT_UNSIGNED": UNSIGNED_CODECS,
+        "UNSIGNED": UNSIGNED_CODECS,
+        "DOUBLE": DOUBLE_CODECS,
+        "FLOAT": DOUBLE_CODECS,
+        "STRING": STRING_CODECS,
+        "GEOMETRY": STRING_CODECS,
+        "BOOLEAN": BOOLEAN_CODECS,
+        "TAG": STRING_CODECS,
+    }
+    key = vt.upper()
+    if key not in table:
+        return (Encoding.DEFAULT, Encoding.NULL)
+    return table[key]

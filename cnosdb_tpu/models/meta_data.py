@@ -1,0 +1,102 @@
+"""Cluster placement metadata.
+
+Mirrors common/models/src/meta_data.rs:73-157: a database's data is split
+into time Buckets; each bucket has `shard_num` ReplicationSets (one raft
+group each); each replica is a Vnode pinned to a node. Placement for a write
+is (bucket by timestamp) → (shard by series hash_id % shard_count).
+"""
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+
+
+class VnodeStatus(enum.IntEnum):
+    RUNNING = 0
+    COPYING = 1
+    BROKEN = 2
+
+
+@dataclass
+class NodeInfo:
+    id: int
+    grpc_addr: str = ""
+    http_addr: str = ""
+    attributes: dict = field(default_factory=dict)
+
+    def to_dict(self) -> dict:
+        return {"id": self.id, "grpc_addr": self.grpc_addr,
+                "http_addr": self.http_addr, "attributes": self.attributes}
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "NodeInfo":
+        return cls(d["id"], d.get("grpc_addr", ""), d.get("http_addr", ""),
+                   d.get("attributes", {}))
+
+
+@dataclass
+class VnodeInfo:
+    id: int
+    node_id: int
+    status: VnodeStatus = VnodeStatus.RUNNING
+
+    def to_dict(self) -> dict:
+        return {"id": self.id, "node_id": self.node_id, "status": int(self.status)}
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "VnodeInfo":
+        return cls(d["id"], d["node_id"], VnodeStatus(d.get("status", 0)))
+
+
+@dataclass
+class ReplicationSet:
+    id: int
+    leader_node_id: int = 0
+    leader_vnode_id: int = 0
+    vnodes: list[VnodeInfo] = field(default_factory=list)
+
+    def vnode(self, vnode_id: int) -> VnodeInfo | None:
+        for v in self.vnodes:
+            if v.id == vnode_id:
+                return v
+        return None
+
+    def by_node(self, node_id: int) -> VnodeInfo | None:
+        for v in self.vnodes:
+            if v.node_id == node_id:
+                return v
+        return None
+
+    def to_dict(self) -> dict:
+        return {"id": self.id, "leader_node_id": self.leader_node_id,
+                "leader_vnode_id": self.leader_vnode_id,
+                "vnodes": [v.to_dict() for v in self.vnodes]}
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "ReplicationSet":
+        return cls(d["id"], d.get("leader_node_id", 0), d.get("leader_vnode_id", 0),
+                   [VnodeInfo.from_dict(v) for v in d.get("vnodes", [])])
+
+
+@dataclass
+class BucketInfo:
+    id: int
+    start_time: int  # ns, inclusive
+    end_time: int    # ns, exclusive
+    shard_group: list[ReplicationSet] = field(default_factory=list)
+
+    def vnode_for(self, series_hash: int) -> ReplicationSet:
+        """shard = hash % shard_count (reference meta_data.rs:81-85)."""
+        return self.shard_group[series_hash % len(self.shard_group)]
+
+    def contains(self, ts: int) -> bool:
+        return self.start_time <= ts < self.end_time
+
+    def to_dict(self) -> dict:
+        return {"id": self.id, "start_time": self.start_time, "end_time": self.end_time,
+                "shard_group": [r.to_dict() for r in self.shard_group]}
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "BucketInfo":
+        return cls(d["id"], d["start_time"], d["end_time"],
+                   [ReplicationSet.from_dict(r) for r in d.get("shard_group", [])])

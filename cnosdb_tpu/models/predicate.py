@@ -1,0 +1,376 @@
+"""Predicate pushdown domain algebra.
+
+Mirrors the reference's `common/models/src/predicate/domain.rs`: a
+`ColumnDomains` maps column name → Domain, where a Domain is All / None /
+a set of ranges / a value set. The query planner extracts tag and time
+constraints from WHERE into this algebra; the index evaluates tag domains
+into series-id bitmaps (`index/ts_index.rs:397 get_series_ids_by_domains`)
+and `TimeRanges` prunes buckets, files, chunks and pages
+(`reader/iterator.rs:155-199`).
+
+TPU-first: Domains also compile to vectorized numpy masks (host pruning)
+and to jit-able predicate closures (device filtering in ops/filter.py).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Iterable
+
+I64_MIN = -(2 ** 63)
+I64_MAX = 2 ** 63 - 1
+
+
+# ---------------------------------------------------------------------------
+# Time ranges
+# ---------------------------------------------------------------------------
+@dataclass(frozen=True, order=True)
+class TimeRange:
+    """Closed interval [min_ts, max_ts] in ns (reference TimeRange semantics)."""
+
+    min_ts: int = I64_MIN
+    max_ts: int = I64_MAX
+
+    @classmethod
+    def all(cls) -> "TimeRange":
+        return cls(I64_MIN, I64_MAX)
+
+    @property
+    def is_empty(self) -> bool:
+        return self.min_ts > self.max_ts
+
+    def overlaps(self, other: "TimeRange") -> bool:
+        return self.min_ts <= other.max_ts and other.min_ts <= self.max_ts
+
+    def contains(self, ts: int) -> bool:
+        return self.min_ts <= ts <= self.max_ts
+
+    def includes(self, other: "TimeRange") -> bool:
+        return self.min_ts <= other.min_ts and other.max_ts <= self.max_ts
+
+    def intersect(self, other: "TimeRange") -> "TimeRange":
+        return TimeRange(max(self.min_ts, other.min_ts), min(self.max_ts, other.max_ts))
+
+    def merge(self, other: "TimeRange") -> "TimeRange":
+        return TimeRange(min(self.min_ts, other.min_ts), max(self.max_ts, other.max_ts))
+
+
+class TimeRanges:
+    """Sorted, disjoint union of TimeRange (reference TimeRanges)."""
+
+    def __init__(self, ranges: Iterable[TimeRange] = ()):  # normalizes
+        rs = sorted(r for r in ranges if not r.is_empty)
+        merged: list[TimeRange] = []
+        for r in rs:
+            if merged and r.min_ts <= merged[-1].max_ts + 1:
+                merged[-1] = merged[-1].merge(r)
+            else:
+                merged.append(r)
+        self.ranges: list[TimeRange] = merged
+
+    @classmethod
+    def all(cls) -> "TimeRanges":
+        return cls([TimeRange.all()])
+
+    @classmethod
+    def empty(cls) -> "TimeRanges":
+        return cls([])
+
+    @property
+    def is_empty(self) -> bool:
+        return not self.ranges
+
+    @property
+    def is_all(self) -> bool:
+        return len(self.ranges) == 1 and self.ranges[0] == TimeRange.all()
+
+    @property
+    def min_ts(self) -> int:
+        return self.ranges[0].min_ts if self.ranges else I64_MAX
+
+    @property
+    def max_ts(self) -> int:
+        return self.ranges[-1].max_ts if self.ranges else I64_MIN
+
+    def overlaps(self, tr: TimeRange) -> bool:
+        return any(r.overlaps(tr) for r in self.ranges)
+
+    def contains(self, ts: int) -> bool:
+        return any(r.contains(ts) for r in self.ranges)
+
+    def includes(self, tr: TimeRange) -> bool:
+        return any(r.includes(tr) for r in self.ranges)
+
+    def intersect(self, other: "TimeRanges") -> "TimeRanges":
+        out = []
+        for a in self.ranges:
+            for b in other.ranges:
+                c = a.intersect(b)
+                if not c.is_empty:
+                    out.append(c)
+        return TimeRanges(out)
+
+    def union(self, other: "TimeRanges") -> "TimeRanges":
+        return TimeRanges([*self.ranges, *other.ranges])
+
+    def __iter__(self):
+        return iter(self.ranges)
+
+    def __repr__(self) -> str:
+        return f"TimeRanges({self.ranges!r})"
+
+
+# ---------------------------------------------------------------------------
+# Value domains
+# ---------------------------------------------------------------------------
+class Domain:
+    """Base class; subclasses: AllDomain, NoneDomain, RangeDomain, SetDomain."""
+
+    def intersect(self, other: "Domain") -> "Domain":
+        raise NotImplementedError
+
+    def union(self, other: "Domain") -> "Domain":
+        raise NotImplementedError
+
+    def contains_value(self, v) -> bool:
+        raise NotImplementedError
+
+
+class AllDomain(Domain):
+    def intersect(self, other: Domain) -> Domain:
+        return other
+
+    def union(self, other: Domain) -> Domain:
+        return self
+
+    def contains_value(self, v) -> bool:
+        return True
+
+    def __eq__(self, o):
+        return isinstance(o, AllDomain)
+
+    def __repr__(self):
+        return "All"
+
+
+class NoneDomain(Domain):
+    def intersect(self, other: Domain) -> Domain:
+        return self
+
+    def union(self, other: Domain) -> Domain:
+        return other
+
+    def contains_value(self, v) -> bool:
+        return False
+
+    def __eq__(self, o):
+        return isinstance(o, NoneDomain)
+
+    def __repr__(self):
+        return "None_"
+
+
+@dataclass(frozen=True)
+class ValueRange:
+    """One range with open/closed bounds over an orderable python value."""
+
+    low: object = None        # None = unbounded
+    low_inclusive: bool = True
+    high: object = None
+    high_inclusive: bool = True
+
+    @property
+    def is_empty(self) -> bool:
+        if self.low is None or self.high is None:
+            return False
+        if self.low > self.high:
+            return True
+        if self.low == self.high and not (self.low_inclusive and self.high_inclusive):
+            return True
+        return False
+
+    def contains(self, v) -> bool:
+        if self.low is not None:
+            if v < self.low or (v == self.low and not self.low_inclusive):
+                return False
+        if self.high is not None:
+            if v > self.high or (v == self.high and not self.high_inclusive):
+                return False
+        return True
+
+    def intersect(self, o: "ValueRange") -> "ValueRange":
+        low, li = self.low, self.low_inclusive
+        if o.low is not None and (low is None or o.low > low or (o.low == low and not o.low_inclusive)):
+            low, li = o.low, o.low_inclusive
+        high, hi = self.high, self.high_inclusive
+        if o.high is not None and (high is None or o.high < high or (o.high == high and not o.high_inclusive)):
+            high, hi = o.high, o.high_inclusive
+        return ValueRange(low, li, high, hi)
+
+    def overlaps(self, o: "ValueRange") -> bool:
+        return not self.intersect(o).is_empty
+
+
+class RangeDomain(Domain):
+    """Union of ValueRanges."""
+
+    def __init__(self, ranges: Iterable[ValueRange]):
+        self.ranges = [r for r in ranges if not r.is_empty]
+
+    @classmethod
+    def of(cls, low=None, low_inc=True, high=None, high_inc=True) -> "RangeDomain":
+        return cls([ValueRange(low, low_inc, high, high_inc)])
+
+    @classmethod
+    def eq(cls, v) -> "RangeDomain":
+        return cls([ValueRange(v, True, v, True)])
+
+    @classmethod
+    def gt(cls, v) -> "RangeDomain":
+        return cls([ValueRange(v, False, None, True)])
+
+    @classmethod
+    def ge(cls, v) -> "RangeDomain":
+        return cls([ValueRange(v, True, None, True)])
+
+    @classmethod
+    def lt(cls, v) -> "RangeDomain":
+        return cls([ValueRange(None, True, v, False)])
+
+    @classmethod
+    def le(cls, v) -> "RangeDomain":
+        return cls([ValueRange(None, True, v, True)])
+
+    def intersect(self, other: Domain) -> Domain:
+        if isinstance(other, AllDomain):
+            return self
+        if isinstance(other, NoneDomain):
+            return other
+        if isinstance(other, SetDomain):
+            vals = {v for v in other.values if self.contains_value(v)}
+            return SetDomain(vals) if vals else NoneDomain()
+        assert isinstance(other, RangeDomain)
+        out = []
+        for a in self.ranges:
+            for b in other.ranges:
+                c = a.intersect(b)
+                if not c.is_empty:
+                    out.append(c)
+        return RangeDomain(out) if out else NoneDomain()
+
+    def union(self, other: Domain) -> Domain:
+        if isinstance(other, (AllDomain, NoneDomain)):
+            return other.union(self)
+        if isinstance(other, SetDomain):
+            # keep as range union (approximate upward: used for pruning, so
+            # over-approximation is safe)
+            return RangeDomain(self.ranges + [ValueRange(v, True, v, True) for v in other.values])
+        assert isinstance(other, RangeDomain)
+        return RangeDomain(self.ranges + other.ranges)
+
+    def contains_value(self, v) -> bool:
+        return any(r.contains(v) for r in self.ranges)
+
+    def __eq__(self, o):
+        return isinstance(o, RangeDomain) and self.ranges == o.ranges
+
+    def __repr__(self):
+        return f"Ranges({self.ranges!r})"
+
+
+class SetDomain(Domain):
+    """Explicit value set, e.g. tag IN ('a','b') (reference ValueEntry sets)."""
+
+    def __init__(self, values: Iterable):
+        self.values = frozenset(values)
+
+    def intersect(self, other: Domain) -> Domain:
+        if isinstance(other, (AllDomain, NoneDomain)):
+            return other.intersect(self)
+        if isinstance(other, SetDomain):
+            vals = self.values & other.values
+            return SetDomain(vals) if vals else NoneDomain()
+        return other.intersect(self)
+
+    def union(self, other: Domain) -> Domain:
+        if isinstance(other, (AllDomain, NoneDomain)):
+            return other.union(self)
+        if isinstance(other, SetDomain):
+            return SetDomain(self.values | other.values)
+        return other.union(self)
+
+    def contains_value(self, v) -> bool:
+        return v in self.values
+
+    def __eq__(self, o):
+        return isinstance(o, SetDomain) and self.values == o.values
+
+    def __repr__(self):
+        return f"Set({sorted(self.values)!r})"
+
+
+class ColumnDomains:
+    """column name → Domain; conjunction across columns.
+
+    `is_all` ⇒ no constraint; `is_none` ⇒ provably empty result.
+    """
+
+    def __init__(self, domains: dict[str, Domain] | None = None, none: bool = False):
+        self._none = none
+        self.domains: dict[str, Domain] = dict(domains or {})
+
+    @classmethod
+    def all(cls) -> "ColumnDomains":
+        return cls()
+
+    @classmethod
+    def none(cls) -> "ColumnDomains":
+        return cls(none=True)
+
+    @classmethod
+    def of(cls, column: str, domain: Domain) -> "ColumnDomains":
+        return cls({column: domain})
+
+    @property
+    def is_all(self) -> bool:
+        return not self._none and not self.domains
+
+    @property
+    def is_none(self) -> bool:
+        return self._none
+
+    def get(self, column: str) -> Domain:
+        if self._none:
+            return NoneDomain()
+        return self.domains.get(column, AllDomain())
+
+    def insert_or_intersect(self, column: str, domain: Domain) -> None:
+        cur = self.domains.get(column)
+        d = domain if cur is None else cur.intersect(domain)
+        if isinstance(d, NoneDomain):
+            self._none = True
+        self.domains[column] = d
+
+    def intersect(self, other: "ColumnDomains") -> "ColumnDomains":
+        if self.is_none or other.is_none:
+            return ColumnDomains.none()
+        out = ColumnDomains(dict(self.domains))
+        for col, d in other.domains.items():
+            out.insert_or_intersect(col, d)
+        return out
+
+    def union(self, other: "ColumnDomains") -> "ColumnDomains":
+        """Column-wise union; only columns constrained on BOTH sides stay
+        constrained (sound over-approximation for OR)."""
+        if self.is_none:
+            return other
+        if other.is_none:
+            return self
+        out = ColumnDomains()
+        for col in set(self.domains) & set(other.domains):
+            out.domains[col] = self.domains[col].union(other.domains[col])
+        return out
+
+    def __repr__(self):
+        if self.is_none:
+            return "ColumnDomains(NONE)"
+        return f"ColumnDomains({self.domains!r})"

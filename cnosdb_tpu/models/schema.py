@@ -1,0 +1,433 @@
+"""Schemas: columns, tables, databases, tenants.
+
+Mirrors the reference's schema model
+(common/models/src/schema/{tskv_table_schema,database_schema,tenant}.rs):
+- a table = one TIME column + tag columns + typed field columns, each with
+  a column id and a codec;
+- a database = owner(tenant) + options (ttl, shard, vnode_duration, replica,
+  precision);
+- tenants carry options/limiters.
+
+TPU-first notes: every field type maps to a fixed-width device dtype
+(STRING fields are dictionary-encoded to i32 codes before device transfer),
+and the schema knows each column's numpy/jax dtype so scan batches can be
+assembled without per-row branching.
+"""
+from __future__ import annotations
+
+import enum
+import json
+import re
+from dataclasses import dataclass, field as dc_field
+
+import numpy as np
+
+from ..errors import SchemaError, ColumnNotFound
+from .codec import Encoding
+
+TIME_FIELD_NAME = "time"
+
+
+class Precision(enum.IntEnum):
+    """Timestamp precision of a database (reference common/utils/src/precision.rs)."""
+
+    MS = 0
+    US = 1
+    NS = 2
+
+    def to_ns_factor(self) -> int:
+        return {Precision.MS: 1_000_000, Precision.US: 1_000, Precision.NS: 1}[self]
+
+    @classmethod
+    def parse(cls, s: str) -> "Precision":
+        return cls[s.strip().upper()]
+
+
+class ValueType(enum.IntEnum):
+    """Field value types (reference ValueType in tskv_table_schema.rs)."""
+
+    UNKNOWN = 0
+    FLOAT = 1      # f64
+    INTEGER = 2    # i64
+    UNSIGNED = 3   # u64
+    BOOLEAN = 4
+    STRING = 5
+    GEOMETRY = 6
+
+    def numpy_dtype(self):
+        return {
+            ValueType.FLOAT: np.float64,
+            ValueType.INTEGER: np.int64,
+            ValueType.UNSIGNED: np.uint64,
+            ValueType.BOOLEAN: np.bool_,
+            ValueType.STRING: object,
+            ValueType.GEOMETRY: object,
+        }[self]
+
+    def device_dtype(self):
+        """dtype as staged onto TPU; strings ride as dictionary codes."""
+        return {
+            ValueType.FLOAT: np.float64,
+            ValueType.INTEGER: np.int64,
+            ValueType.UNSIGNED: np.uint64,
+            ValueType.BOOLEAN: np.bool_,
+            ValueType.STRING: np.int32,
+            ValueType.GEOMETRY: np.int32,
+        }[self]
+
+    @classmethod
+    def parse(cls, s: str) -> "ValueType":
+        m = {
+            "DOUBLE": cls.FLOAT, "FLOAT": cls.FLOAT,
+            "BIGINT": cls.INTEGER, "INTEGER": cls.INTEGER, "INT": cls.INTEGER,
+            "BIGINT UNSIGNED": cls.UNSIGNED, "UNSIGNED": cls.UNSIGNED,
+            "BOOLEAN": cls.BOOLEAN, "BOOL": cls.BOOLEAN,
+            "STRING": cls.STRING, "TEXT": cls.STRING, "VARCHAR": cls.STRING,
+            "GEOMETRY": cls.GEOMETRY,
+        }
+        key = s.strip().upper()
+        if key not in m:
+            raise SchemaError(f"unknown value type {s!r}")
+        return m[key]
+
+    def sql_name(self) -> str:
+        return {
+            ValueType.FLOAT: "DOUBLE",
+            ValueType.INTEGER: "BIGINT",
+            ValueType.UNSIGNED: "BIGINT UNSIGNED",
+            ValueType.BOOLEAN: "BOOLEAN",
+            ValueType.STRING: "STRING",
+            ValueType.GEOMETRY: "GEOMETRY",
+            ValueType.UNKNOWN: "UNKNOWN",
+        }[self]
+
+
+class ColumnKind(enum.IntEnum):
+    TIME = 0
+    TAG = 1
+    FIELD = 2
+
+
+@dataclass(frozen=True)
+class ColumnType:
+    kind: ColumnKind
+    value_type: ValueType = ValueType.UNKNOWN
+    precision: Precision = Precision.NS
+
+    @classmethod
+    def time(cls, precision: Precision = Precision.NS) -> "ColumnType":
+        return cls(ColumnKind.TIME, ValueType.INTEGER, precision)
+
+    @classmethod
+    def tag(cls) -> "ColumnType":
+        return cls(ColumnKind.TAG, ValueType.STRING)
+
+    @classmethod
+    def field(cls, vt: ValueType) -> "ColumnType":
+        return cls(ColumnKind.FIELD, vt)
+
+    @property
+    def is_time(self) -> bool:
+        return self.kind == ColumnKind.TIME
+
+    @property
+    def is_tag(self) -> bool:
+        return self.kind == ColumnKind.TAG
+
+    @property
+    def is_field(self) -> bool:
+        return self.kind == ColumnKind.FIELD
+
+
+_IDENT_RE = re.compile(r"^[A-Za-z_][A-Za-z0-9_]*$")
+
+
+@dataclass
+class TableColumn:
+    id: int
+    name: str
+    column_type: ColumnType
+    encoding: Encoding = Encoding.DEFAULT
+
+    def default_encoding(self) -> Encoding:
+        ct = self.column_type
+        if ct.is_time:
+            return Encoding.DELTA_TS
+        if ct.is_tag:
+            return Encoding.ZSTD
+        return {
+            ValueType.FLOAT: Encoding.GORILLA,
+            ValueType.INTEGER: Encoding.DELTA,
+            ValueType.UNSIGNED: Encoding.DELTA,
+            ValueType.BOOLEAN: Encoding.BITPACK,
+            ValueType.STRING: Encoding.ZSTD,
+            ValueType.GEOMETRY: Encoding.ZSTD,
+        }.get(ct.value_type, Encoding.DEFAULT)
+
+    def to_dict(self) -> dict:
+        return {
+            "id": self.id,
+            "name": self.name,
+            "kind": int(self.column_type.kind),
+            "value_type": int(self.column_type.value_type),
+            "precision": int(self.column_type.precision),
+            "encoding": int(self.encoding),
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "TableColumn":
+        return cls(
+            id=d["id"],
+            name=d["name"],
+            column_type=ColumnType(
+                ColumnKind(d["kind"]), ValueType(d["value_type"]), Precision(d["precision"])
+            ),
+            encoding=Encoding(d["encoding"]),
+        )
+
+
+class TskvTableSchema:
+    """Table schema: time + tags + fields, each with stable column ids.
+
+    Mirrors reference TskvTableSchema (tskv_table_schema.rs): schema_version
+    bumps on ALTER, column ids never reused, field ids are the per-series
+    column identity inside TSM chunks.
+    """
+
+    def __init__(self, tenant: str, db: str, name: str, columns: list[TableColumn],
+                 schema_version: int = 0, next_column_id: int | None = None):
+        self.tenant = tenant
+        self.db = db
+        self.name = name
+        self.schema_version = schema_version
+        self.columns: list[TableColumn] = []
+        self._by_name: dict[str, TableColumn] = {}
+        self._next_id = 0
+        for c in columns:
+            self._add(c)
+        # Column ids are never reused, even across drop + serde round-trips,
+        # so TSM chunks written under a dropped id can't be misread as a new
+        # column. Persisted in to_dict/from_dict.
+        if next_column_id is not None:
+            self._next_id = max(self._next_id, next_column_id)
+
+    # -- construction ----------------------------------------------------
+    def _add(self, c: TableColumn) -> None:
+        if c.name in self._by_name:
+            raise SchemaError(f"duplicate column {c.name!r} in {self.name}")
+        if not _IDENT_RE.match(c.name):
+            raise SchemaError(f"invalid column name {c.name!r}")
+        self.columns.append(c)
+        self._by_name[c.name] = c
+        self._next_id = max(self._next_id, c.id + 1)
+
+    def add_column(self, name: str, column_type: ColumnType,
+                   encoding: Encoding | None = None) -> TableColumn:
+        col = TableColumn(self._next_id, name, column_type,
+                          encoding if encoding is not None else Encoding.DEFAULT)
+        if encoding is None:
+            col.encoding = col.default_encoding()
+        self._add(col)
+        self.schema_version += 1
+        return col
+
+    def drop_column(self, name: str) -> TableColumn:
+        col = self._by_name.pop(name, None)
+        if col is None:
+            raise ColumnNotFound(f"{self.name}.{name}")
+        if col.column_type.is_time:
+            raise SchemaError("cannot drop time column")
+        self.columns.remove(col)
+        self.schema_version += 1
+        return col
+
+    # -- lookups ---------------------------------------------------------
+    def column(self, name: str) -> TableColumn:
+        c = self._by_name.get(name)
+        if c is None:
+            raise ColumnNotFound(f"{self.name}.{name}")
+        return c
+
+    def contains_column(self, name: str) -> bool:
+        return name in self._by_name
+
+    def column_by_id(self, cid: int) -> TableColumn | None:
+        for c in self.columns:
+            if c.id == cid:
+                return c
+        return None
+
+    @property
+    def time_column(self) -> TableColumn:
+        for c in self.columns:
+            if c.column_type.is_time:
+                return c
+        raise SchemaError(f"table {self.name} has no time column")
+
+    @property
+    def tag_columns(self) -> list[TableColumn]:
+        return [c for c in self.columns if c.column_type.is_tag]
+
+    @property
+    def field_columns(self) -> list[TableColumn]:
+        return [c for c in self.columns if c.column_type.is_field]
+
+    def tag_names(self) -> list[str]:
+        return [c.name for c in self.tag_columns]
+
+    def field_names(self) -> list[str]:
+        return [c.name for c in self.field_columns]
+
+    # -- serde -----------------------------------------------------------
+    def to_dict(self) -> dict:
+        return {
+            "tenant": self.tenant,
+            "db": self.db,
+            "name": self.name,
+            "schema_version": self.schema_version,
+            "next_column_id": self._next_id,
+            "columns": [c.to_dict() for c in self.columns],
+        }
+
+    def to_json(self) -> str:
+        return json.dumps(self.to_dict())
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "TskvTableSchema":
+        return cls(d["tenant"], d["db"], d["name"],
+                   [TableColumn.from_dict(c) for c in d["columns"]],
+                   d.get("schema_version", 0),
+                   next_column_id=d.get("next_column_id"))
+
+    @classmethod
+    def from_json(cls, s: str) -> "TskvTableSchema":
+        return cls.from_dict(json.loads(s))
+
+    @classmethod
+    def new_measurement(cls, tenant: str, db: str, name: str,
+                        tags: list[str],
+                        fields: list[tuple[str, ValueType]],
+                        precision: Precision = Precision.NS) -> "TskvTableSchema":
+        """Build a schema the way line-protocol auto-creation does
+        (reference database.rs build_write_group schema inference)."""
+        cols = [TableColumn(0, TIME_FIELD_NAME, ColumnType.time(precision), Encoding.DELTA_TS)]
+        nid = 1
+        for t in sorted(tags):
+            cols.append(TableColumn(nid, t, ColumnType.tag(), Encoding.ZSTD))
+            nid += 1
+        for fname, vt in fields:
+            c = TableColumn(nid, fname, ColumnType.field(vt))
+            c.encoding = c.default_encoding()
+            cols.append(c)
+            nid += 1
+        return cls(tenant, db, name, cols)
+
+
+@dataclass
+class Duration:
+    """A time duration usable as TTL / vnode_duration (reference
+    database_schema.rs DatabaseOptions durations, e.g. '1d', '365d', 'inf')."""
+
+    ns: int  # 0 == INF
+
+    INF_NS = 0
+
+    @classmethod
+    def parse(cls, s: str) -> "Duration":
+        s = s.strip().lower()
+        if s in ("inf", "none", ""):
+            return cls(0)
+        m = re.match(r"^(\d+)\s*(ns|us|ms|s|m|h|d|w|y)?$", s)
+        if not m:
+            raise SchemaError(f"bad duration {s!r}")
+        n = int(m.group(1))
+        if n == 0:
+            # ns=0 is the INF sentinel; a literal zero duration would silently
+            # mean "retain forever", so reject it.
+            raise SchemaError(f"zero duration {s!r} is invalid (use 'INF' for unlimited)")
+        unit = m.group(2) or "s"
+        factor = {
+            "ns": 1, "us": 1_000, "ms": 1_000_000, "s": 1_000_000_000,
+            "m": 60_000_000_000, "h": 3_600_000_000_000,
+            "d": 86_400_000_000_000, "w": 7 * 86_400_000_000_000,
+            "y": 365 * 86_400_000_000_000,
+        }[unit]
+        return cls(n * factor)
+
+    @property
+    def is_inf(self) -> bool:
+        return self.ns == 0
+
+    def __str__(self) -> str:
+        if self.is_inf:
+            return "INF"
+        d = 86_400_000_000_000
+        if self.ns % d == 0:
+            return f"{self.ns // d}d"
+        return f"{self.ns}ns"
+
+
+@dataclass
+class DatabaseOptions:
+    """Reference DatabaseOptions (database_schema.rs:109-176)."""
+
+    ttl: Duration = dc_field(default_factory=lambda: Duration(0))
+    shard_num: int = 1
+    vnode_duration: Duration = dc_field(default_factory=lambda: Duration.parse("365d"))
+    replica: int = 1
+    precision: Precision = Precision.NS
+
+    def to_dict(self) -> dict:
+        return {
+            "ttl": self.ttl.ns, "shard_num": self.shard_num,
+            "vnode_duration": self.vnode_duration.ns,
+            "replica": self.replica, "precision": int(self.precision),
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "DatabaseOptions":
+        return cls(Duration(d["ttl"]), d["shard_num"], Duration(d["vnode_duration"]),
+                   d["replica"], Precision(d["precision"]))
+
+
+@dataclass
+class DatabaseSchema:
+    tenant: str
+    name: str
+    options: DatabaseOptions = dc_field(default_factory=DatabaseOptions)
+
+    @property
+    def owner(self) -> str:
+        return make_owner(self.tenant, self.name)
+
+    def to_dict(self) -> dict:
+        return {"tenant": self.tenant, "name": self.name, "options": self.options.to_dict()}
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "DatabaseSchema":
+        return cls(d["tenant"], d["name"], DatabaseOptions.from_dict(d["options"]))
+
+
+def make_owner(tenant: str, db: str) -> str:
+    """owner id = 'tenant.db' (reference models::schema utils make_owner)."""
+    return f"{tenant}.{db}"
+
+
+@dataclass
+class TenantOptions:
+    comment: str = ""
+    limiter: dict | None = None
+    drop_after: Duration | None = None
+
+    def to_dict(self) -> dict:
+        return {
+            "comment": self.comment,
+            "limiter": self.limiter,
+            "drop_after": self.drop_after.ns if self.drop_after else None,
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "TenantOptions":
+        da = d.get("drop_after")
+        return cls(d.get("comment", ""), d.get("limiter"),
+                   Duration(da) if da is not None else None)

@@ -1,0 +1,89 @@
+"""Series keys.
+
+A series = measurement(table) + sorted tag set. Mirrors the reference's
+SeriesKey (common/models/src/series_info.rs): stable binary encoding used as
+the index key, and a BKDR hash for shard placement
+(coordinator/src/service.rs:604-610 hashes table+tags to pick the shard).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from ..utils.hash import bkdr_hash
+
+
+@dataclass(frozen=True, order=True)
+class Tag:
+    key: str
+    value: str
+
+
+class SeriesKey:
+    __slots__ = ("table", "tags", "_encoded", "_hash")
+
+    def __init__(self, table: str, tags: list[Tag] | list[tuple[str, str]] | dict):
+        if isinstance(tags, dict):
+            tags = [Tag(k, v) for k, v in tags.items()]
+        else:
+            tags = [t if isinstance(t, Tag) else Tag(t[0], t[1]) for t in tags]
+        tags = sorted(tags)
+        self.table = table
+        self.tags = tuple(tags)
+        self._encoded: bytes | None = None
+        self._hash: int | None = None
+
+    # -- encoding --------------------------------------------------------
+    def encode(self) -> bytes:
+        """Stable binary encoding: len-prefixed table then k/v pairs."""
+        if self._encoded is None:
+            tb = self.table.encode()
+            parts = [len(tb).to_bytes(2, "little"), tb]
+            parts.append(len(self.tags).to_bytes(2, "little"))
+            for t in self.tags:
+                kb, vb = t.key.encode(), t.value.encode()
+                parts += [len(kb).to_bytes(2, "little"), kb,
+                          len(vb).to_bytes(4, "little"), vb]
+            self._encoded = b"".join(parts)
+        return self._encoded
+
+    @classmethod
+    def decode(cls, data: bytes) -> "SeriesKey":
+        off = 0
+        tl = int.from_bytes(data[off:off + 2], "little"); off += 2
+        table = data[off:off + tl].decode(); off += tl
+        n = int.from_bytes(data[off:off + 2], "little"); off += 2
+        tags = []
+        for _ in range(n):
+            kl = int.from_bytes(data[off:off + 2], "little"); off += 2
+            k = data[off:off + kl].decode(); off += kl
+            vl = int.from_bytes(data[off:off + 4], "little"); off += 4
+            v = data[off:off + vl].decode(); off += vl
+            tags.append(Tag(k, v))
+        return cls(table, tags)
+
+    # -- identity --------------------------------------------------------
+    def hash_id(self) -> int:
+        """BKDR u64 used for shard placement (BucketInfo.vnode_for)."""
+        if self._hash is None:
+            self._hash = bkdr_hash(self.encode())
+        return self._hash
+
+    def tag_value(self, key: str) -> str | None:
+        for t in self.tags:
+            if t.key == key:
+                return t.value
+        return None
+
+    def tag_dict(self) -> dict[str, str]:
+        return {t.key: t.value for t in self.tags}
+
+    def __eq__(self, other) -> bool:
+        return (isinstance(other, SeriesKey)
+                and self.table == other.table and self.tags == other.tags)
+
+    def __hash__(self) -> int:
+        return hash((self.table, self.tags))
+
+    def __repr__(self) -> str:
+        ts = ",".join(f"{t.key}={t.value}" for t in self.tags)
+        return f"{self.table},{ts}"

@@ -1,0 +1,10 @@
+"""Device-side data plane (JAX/XLA).
+
+Importing this package configures JAX for the engine: x64 on, because
+timestamps are int64 nanoseconds end-to-end (f32/i32 cannot represent them)
+and integer fields are i64. Host-only layers (models/storage) do not import
+this, keeping pure-metadata use of cnosdb_tpu jax-free.
+"""
+import jax
+
+jax.config.update("jax_enable_x64", True)

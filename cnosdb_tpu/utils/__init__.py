@@ -1,0 +1,4 @@
+from .hash import bkdr_hash, bkdr_hash_u64, fnv1a_64, split_id
+from .bloom import BloomFilter
+
+__all__ = ["bkdr_hash", "bkdr_hash_u64", "fnv1a_64", "split_id", "BloomFilter"]

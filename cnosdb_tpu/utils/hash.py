@@ -1,0 +1,49 @@
+"""Hashes used for series placement and bloom filters.
+
+BKDR (seed 1313) matches the reference's series hashing
+(common/utils/src/bkdr_hash.rs:3-58, used for shard placement at
+coordinator/src/service.rs:671). FNV-1a is the second, independent hash for
+bloom double-hashing.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+_BKDR_SEED = 1313
+_MASK64 = (1 << 64) - 1
+
+_FNV_OFFSET = 0xCBF29CE484222325
+_FNV_PRIME = 0x100000001B3
+
+
+def bkdr_hash(data: bytes, init: int = 0) -> int:
+    """BKDR hash of bytes → u64 (wrapping mul-add, seed 1313)."""
+    h = init
+    for b in data:
+        h = (h * _BKDR_SEED + b) & _MASK64
+    return h
+
+
+def bkdr_hash_u64(data: bytes) -> int:
+    return bkdr_hash(data)
+
+
+def fnv1a_64(data: bytes) -> int:
+    h = _FNV_OFFSET
+    for b in data:
+        h ^= b
+        h = (h * _FNV_PRIME) & _MASK64
+    return h
+
+
+def split_id(id128: int) -> tuple[int, int]:
+    """Split a (prefix<<64)|hash id into (prefix, hash)."""
+    return id128 >> 64, id128 & _MASK64
+
+
+def bkdr_hash_batch(items: list[bytes]) -> np.ndarray:
+    """Vectorized-ish batch BKDR hash (python loop per item; items are short)."""
+    out = np.empty(len(items), dtype=np.uint64)
+    for i, it in enumerate(items):
+        out[i] = bkdr_hash(it)
+    return out

@@ -1,0 +1,26 @@
+"""Test harness: run all tests on a virtual 8-device CPU mesh.
+
+Env must be set before jax (or anything importing jax) loads, so this sits
+at the very top of conftest.
+"""
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(42)
+
+
+@pytest.fixture
+def tmp_engine_dir(tmp_path):
+    d = tmp_path / "engine"
+    d.mkdir()
+    return str(d)

@@ -1,0 +1,208 @@
+import numpy as np
+import pytest
+
+from cnosdb_tpu.models import (
+    AllDomain,
+    BucketInfo,
+    ColumnDomains,
+    ColumnType,
+    DatabaseOptions,
+    DatabaseSchema,
+    Duration,
+    Encoding,
+    NoneDomain,
+    Precision,
+    ReplicationSet,
+    SeriesKey,
+    Tag,
+    TableColumn,
+    TimeRange,
+    TimeRanges,
+    TskvTableSchema,
+    ValueType,
+    VnodeInfo,
+)
+from cnosdb_tpu.models.predicate import RangeDomain, SetDomain, ValueRange
+from cnosdb_tpu.errors import SchemaError, ColumnNotFound
+from cnosdb_tpu.utils import BloomFilter, bkdr_hash
+
+
+# ---------------------------------------------------------------- hash/bloom
+def test_bkdr_hash_matches_definition():
+    # h = h*1313 + byte, wrapping u64
+    assert bkdr_hash(b"") == 0
+    assert bkdr_hash(b"a") == ord("a")
+    assert bkdr_hash(b"ab") == (ord("a") * 1313 + ord("b"))
+
+
+def test_bloom_filter_roundtrip():
+    bf = BloomFilter(1 << 12)
+    ids = [1, 42, 999999, 2**63]
+    for i in ids:
+        bf.insert_u64(i)
+    for i in ids:
+        assert bf.maybe_contains_u64(i)
+    # serialization round-trip
+    bf2 = BloomFilter.from_bytes(bf.to_bytes())
+    for i in ids:
+        assert bf2.maybe_contains_u64(i)
+    misses = sum(bf.maybe_contains_u64(i) for i in range(10_000, 11_000))
+    assert misses < 20  # false-positive rate sanity
+
+
+def test_bloom_batch_matches_scalar():
+    bf = BloomFilter(1 << 12)
+    ids = np.array([3, 17, 2**40, 2**63 + 5], dtype=np.uint64)
+    bf.insert_u64_batch(ids)
+    for i in ids:
+        assert bf.maybe_contains_u64(int(i))
+    batch = bf.maybe_contains_u64_batch(np.array([3, 17, 4444, 2**63 + 5], dtype=np.uint64))
+    assert batch[0] and batch[1] and batch[3]
+    # scalar insert visible to batch query
+    bf2 = BloomFilter(1 << 12)
+    bf2.insert_u64(12345)
+    assert bf2.maybe_contains_u64_batch(np.array([12345], dtype=np.uint64))[0]
+
+
+def test_non_ascii_series_key():
+    k = SeriesKey("温度", {"主机": "h1", "区": "华东"})
+    assert SeriesKey.decode(k.encode()) == k
+
+
+def test_column_id_not_reused_after_drop_and_serde():
+    s = _schema()
+    s.add_column("f2", ColumnType.field(ValueType.FLOAT))
+    dropped_id = s.column("f2").id
+    s.drop_column("f2")
+    s2 = TskvTableSchema.from_json(s.to_json())
+    c = s2.add_column("f3", ColumnType.field(ValueType.FLOAT))
+    assert c.id > dropped_id
+
+
+def test_zero_duration_rejected():
+    with pytest.raises(SchemaError):
+        Duration.parse("0d")
+
+
+# ---------------------------------------------------------------- series key
+def test_series_key_sorted_tags_and_roundtrip():
+    k1 = SeriesKey("cpu", [("host", "h1"), ("az", "us")])
+    k2 = SeriesKey("cpu", [("az", "us"), ("host", "h1")])
+    assert k1 == k2
+    assert k1.hash_id() == k2.hash_id()
+    k3 = SeriesKey.decode(k1.encode())
+    assert k3 == k1
+    assert k3.tag_value("host") == "h1"
+    assert k3.tag_value("nope") is None
+
+
+def test_series_key_distinct():
+    a = SeriesKey("cpu", {"host": "h1"})
+    b = SeriesKey("cpu", {"host": "h2"})
+    c = SeriesKey("mem", {"host": "h1"})
+    assert len({a, b, c}) == 3
+    assert a.hash_id() != b.hash_id()
+
+
+# ---------------------------------------------------------------- schema
+def _schema():
+    return TskvTableSchema.new_measurement(
+        "cnosdb", "db1", "cpu",
+        tags=["host", "region"],
+        fields=[("usage_user", ValueType.FLOAT), ("n", ValueType.INTEGER)],
+    )
+
+
+def test_schema_structure():
+    s = _schema()
+    assert s.time_column.name == "time"
+    assert s.tag_names() == ["host", "region"]
+    assert s.field_names() == ["usage_user", "n"]
+    assert s.column("usage_user").column_type.value_type == ValueType.FLOAT
+    assert s.column("usage_user").encoding == Encoding.GORILLA
+    assert s.column("time").encoding == Encoding.DELTA_TS
+    with pytest.raises(ColumnNotFound):
+        s.column("missing")
+
+
+def test_schema_evolution_and_serde():
+    s = _schema()
+    v0 = s.schema_version
+    s.add_column("usage_system", ColumnType.field(ValueType.FLOAT))
+    assert s.schema_version == v0 + 1
+    ids = [c.id for c in s.columns]
+    assert len(ids) == len(set(ids))
+    s2 = TskvTableSchema.from_json(s.to_json())
+    assert s2.field_names() == s.field_names()
+    assert s2.column("usage_system").encoding == s.column("usage_system").encoding
+    with pytest.raises(SchemaError):
+        s.drop_column("time")
+    s.drop_column("n")
+    assert "n" not in s.field_names()
+
+
+def test_duration_parse():
+    assert Duration.parse("1d").ns == 86_400_000_000_000
+    assert Duration.parse("inf").is_inf
+    assert Duration.parse("10m").ns == 600_000_000_000
+    assert str(Duration.parse("365d")) == "365d"
+
+
+def test_database_schema_serde():
+    d = DatabaseSchema("cnosdb", "db1", DatabaseOptions(
+        ttl=Duration.parse("30d"), shard_num=4,
+        vnode_duration=Duration.parse("1d"), replica=2, precision=Precision.MS))
+    d2 = DatabaseSchema.from_dict(d.to_dict())
+    assert d2.options.shard_num == 4
+    assert d2.options.precision == Precision.MS
+    assert d2.owner == "cnosdb.db1"
+
+
+# ---------------------------------------------------------------- time ranges
+def test_time_ranges_normalize_and_ops():
+    trs = TimeRanges([TimeRange(10, 20), TimeRange(15, 30), TimeRange(50, 60)])
+    assert trs.ranges == [TimeRange(10, 30), TimeRange(50, 60)]
+    assert trs.overlaps(TimeRange(25, 55))
+    assert not trs.overlaps(TimeRange(31, 49))
+    assert trs.contains(55)
+    assert not trs.contains(40)
+    inter = trs.intersect(TimeRanges([TimeRange(0, 12), TimeRange(55, 100)]))
+    assert inter.ranges == [TimeRange(10, 12), TimeRange(55, 60)]
+    assert TimeRanges.empty().is_empty
+    assert TimeRanges.all().is_all
+
+
+# ---------------------------------------------------------------- domains
+def test_range_domain_algebra():
+    d = RangeDomain.ge(10).intersect(RangeDomain.lt(20))
+    assert d.contains_value(10)
+    assert d.contains_value(19)
+    assert not d.contains_value(20)
+    none = RangeDomain.gt(5).intersect(RangeDomain.lt(5))
+    assert isinstance(none, NoneDomain)
+    s = SetDomain(["a", "b"]).intersect(SetDomain(["b", "c"]))
+    assert s == SetDomain(["b"])
+    s2 = RangeDomain.of(low="a", high="b").intersect(SetDomain(["b", "z"]))
+    assert s2 == SetDomain(["b"])
+
+
+def test_column_domains():
+    cd = ColumnDomains.of("host", SetDomain(["h1", "h2"]))
+    cd2 = ColumnDomains.of("host", SetDomain(["h2", "h3"]))
+    inter = cd.intersect(cd2)
+    assert inter.get("host") == SetDomain(["h2"])
+    assert isinstance(inter.get("other"), AllDomain)
+    empty = cd.intersect(ColumnDomains.of("host", SetDomain(["zzz"])))
+    assert empty.is_none
+    u = cd.union(ColumnDomains.all())
+    assert u.is_all or isinstance(u.get("host"), AllDomain)
+
+
+# ---------------------------------------------------------------- placement
+def test_bucket_vnode_for():
+    rs = [ReplicationSet(i, vnodes=[VnodeInfo(i * 10, 1)]) for i in range(4)]
+    b = BucketInfo(1, 0, 1000, rs)
+    assert b.contains(0) and b.contains(999) and not b.contains(1000)
+    k = SeriesKey("cpu", {"host": "h7"})
+    chosen = b.vnode_for(k.hash_id())
+    assert chosen is rs[k.hash_id() % 4]
