@@ -1,0 +1,87 @@
+"""Write batches — the wire/WAL representation of point writes.
+
+Role-parity with the reference's flatbuffers Points (common/protos/
+proto/models.fbs, built by protocol_parser lines_convert.rs:20,197): rows
+grouped per table and per series, columnar within a series. Grouping by
+series at the parse edge keeps the vnode apply path allocation-free and
+lets memcache append whole arrays.
+
+Serialized with msgpack (C-speed) for WAL + RPC. Field values ride as
+(value_type, values list) with None for missing-at-that-row.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import msgpack
+import numpy as np
+
+from .schema import ValueType
+from .series import SeriesKey, Tag
+
+
+@dataclass
+class SeriesRows:
+    """Rows of one series: parallel arrays, may be unsorted in time."""
+
+    key: SeriesKey
+    timestamps: list[int]
+    fields: dict[str, tuple[int, list]]  # name → (ValueType, values; None=missing)
+
+    def n_rows(self) -> int:
+        return len(self.timestamps)
+
+
+@dataclass
+class WriteBatch:
+    """table → list[SeriesRows]."""
+
+    tables: dict[str, list[SeriesRows]] = field(default_factory=dict)
+
+    def add_series(self, table: str, sr: SeriesRows):
+        self.tables.setdefault(table, []).append(sr)
+
+    def n_rows(self) -> int:
+        return sum(sr.n_rows() for srs in self.tables.values() for sr in srs)
+
+    # -- serde -----------------------------------------------------------
+    def encode(self) -> bytes:
+        obj = {}
+        for table, srs in self.tables.items():
+            obj[table] = [
+                [sr.key.encode(), sr.timestamps,
+                 {k: [vt, vals] for k, (vt, vals) in sr.fields.items()}]
+                for sr in srs
+            ]
+        return msgpack.packb(obj, use_bin_type=True)
+
+    @classmethod
+    def decode(cls, data: bytes) -> "WriteBatch":
+        obj = msgpack.unpackb(data, raw=False, strict_map_key=False)
+        wb = cls()
+        for table, srs in obj.items():
+            for key_b, ts, fields in srs:
+                wb.add_series(table, SeriesRows(
+                    SeriesKey.decode(key_b), list(ts),
+                    {k: (int(v[0]), list(v[1])) for k, v in fields.items()}))
+        return wb
+
+    # -- convenience builder (tests, SQL INSERT path) --------------------
+    @classmethod
+    def from_rows(cls, table: str, rows: list[dict], tag_names: list[str],
+                  field_types: dict[str, ValueType]) -> "WriteBatch":
+        """rows: [{'time': i64, <tag>: str, <field>: value}]"""
+        groups: dict[SeriesKey, list[dict]] = {}
+        for r in rows:
+            key = SeriesKey(table, [Tag(t, str(r[t])) for t in tag_names if r.get(t) is not None])
+            groups.setdefault(key, []).append(r)
+        wb = cls()
+        for key, rs in groups.items():
+            ts = [int(r["time"]) for r in rs]
+            fields = {}
+            for fname, vt in field_types.items():
+                vals = [r.get(fname) for r in rs]
+                if any(v is not None for v in vals):
+                    fields[fname] = (int(vt), vals)
+            wb.add_series(table, SeriesRows(key, ts, fields))
+        return wb

@@ -1,0 +1,309 @@
+"""Column block codecs.
+
+Role mirrors the reference's tsm codec suite (tskv/src/tsm/codec/:
+timestamp.rs delta+simple8b, integer.rs zigzag+simple8b, float.rs Gorilla
+XOR, string.rs snappy/zstd/gzip/bzip/zlib, boolean.rs bitpack, dispatch
+instance.rs:358-420) with the same Encoding ids, but the bit layouts are a
+new design optimized for a TPU host: every transform is numpy-vectorized
+(no per-value Python or bit-granular loops) so pages decode at memory
+bandwidth into arrays ready for PCIe staging.
+
+- DELTA / DELTA_TS (i64/u64/ts): zigzag(delta) → narrowest uint cast →
+  zstd-1. DELTA_TS adds a constant-stride fast path (regular time series
+  encode to 18 bytes). Decode = zstd → widen → unzigzag → cumsum.
+- GORILLA (f64): XOR with previous (u64 view) → byte-plane transpose →
+  zstd-1 (XOR zero-bytes compress like Gorilla's leading/trailing zero
+  windows). Decode = zstd → untranspose → log-step prefix-XOR scan.
+- QUANTILE: raw-LE → byte-plane transpose → zstd-3 (stands in for the
+  reference's pco; keeps the enum id).
+- BITPACK (bool): np.packbits.
+- Strings: length-prefixed concat → container codec (zstd/gzip/zlib/bzip;
+  SNAPPY rides zlib-1 — no snappy lib in env, id preserved).
+
+Each encoded block: [1B encoding id][payload]; `encode`/`decode` dispatch
+on column value type + id, matching the reference's one-byte code header
+(tsm/codec block layout).
+"""
+from __future__ import annotations
+
+import bz2
+import gzip
+import zlib
+
+import numpy as np
+import zstandard
+
+from ..errors import CodecError
+from ..models.codec import Encoding
+from ..models.schema import ValueType
+
+_ZSTD_C = zstandard.ZstdCompressor(level=1)
+_ZSTD_C3 = zstandard.ZstdCompressor(level=3)
+_ZSTD_D = zstandard.ZstdDecompressor()
+
+
+# ---------------------------------------------------------------------------
+# primitives
+# ---------------------------------------------------------------------------
+def zigzag(v: np.ndarray) -> np.ndarray:
+    v = v.astype(np.int64, copy=False)
+    return ((v << 1) ^ (v >> 63)).view(np.uint64)
+
+
+def unzigzag(u: np.ndarray) -> np.ndarray:
+    u = u.astype(np.uint64, copy=False)
+    half = (u >> np.uint64(1)).view(np.int64)
+    sign = (u & np.uint64(1)).view(np.int64)
+    np.negative(sign, out=sign)
+    half ^= sign
+    return half
+
+
+def _narrow_cast(u: np.ndarray) -> tuple[int, bytes]:
+    """Cast u64 array to the narrowest of u8/u16/u32/u64; returns (width, bytes)."""
+    if len(u) == 0:
+        return 1, b""
+    mx = int(u.max())
+    if mx < 1 << 8:
+        return 1, u.astype(np.uint8).tobytes()
+    if mx < 1 << 16:
+        return 2, u.astype(np.uint16).tobytes()
+    if mx < 1 << 32:
+        return 4, u.astype(np.uint32).tobytes()
+    return 8, u.tobytes()
+
+
+_WIDTH_DTYPE = {1: np.uint8, 2: np.uint16, 4: np.uint32, 8: np.uint64}
+
+
+def _widen(width: int, raw: bytes) -> np.ndarray:
+    return np.frombuffer(raw, dtype=_WIDTH_DTYPE[width]).astype(np.uint64)
+
+
+def _byte_transpose(raw: np.ndarray, itemsize: int) -> bytes:
+    return raw.view(np.uint8).reshape(-1, itemsize).T.tobytes()
+
+
+def _byte_untranspose(raw: bytes, itemsize: int, dtype) -> np.ndarray:
+    a = np.frombuffer(raw, dtype=np.uint8).reshape(itemsize, -1).T
+    return np.ascontiguousarray(a).view(dtype).ravel()
+
+
+def prefix_xor_scan(x: np.ndarray) -> np.ndarray:
+    """Inclusive XOR scan (vectorized Gorilla 'undo'): single C pass."""
+    return np.bitwise_xor.accumulate(x)
+
+
+# ---------------------------------------------------------------------------
+# integer / timestamp
+# ---------------------------------------------------------------------------
+def _encode_delta(values: np.ndarray, is_ts: bool) -> bytes:
+    v = values.view(np.int64) if values.dtype == np.uint64 else values.astype(np.int64, copy=False)
+    n = len(v)
+    if n == 0:
+        return b"\x00"
+    deltas = np.diff(v)
+    if is_ts and n > 1 and bool(np.all(deltas == deltas[0])):
+        # constant stride: [1][n u32][first i64][stride i64]
+        return (b"\x01" + np.uint32(n).tobytes() + np.int64(v[0]).tobytes()
+                + np.int64(deltas[0]).tobytes())
+    zz = zigzag(deltas) if n > 1 else np.empty(0, dtype=np.uint64)
+    width, raw = _narrow_cast(zz)
+    comp = _ZSTD_C.compress(raw)
+    return (b"\x02" + np.uint32(n).tobytes() + np.int64(v[0]).tobytes()
+            + bytes([width]) + comp)
+
+
+def _decode_delta(data: bytes, unsigned: bool) -> np.ndarray:
+    tag = data[0]
+    dtype = np.uint64 if unsigned else np.int64
+    if tag == 0:
+        return np.empty(0, dtype=dtype)
+    n = int(np.frombuffer(data[1:5], dtype=np.uint32)[0])
+    first = int(np.frombuffer(data[5:13], dtype=np.int64)[0])
+    if tag == 1:
+        stride = int(np.frombuffer(data[13:21], dtype=np.int64)[0])
+        out = first + stride * np.arange(n, dtype=np.int64)
+        return out.view(dtype)
+    width = data[13]
+    zz = _widen(width, _ZSTD_D.decompress(data[14:]))
+    deltas = unzigzag(zz)
+    out = np.empty(n, dtype=np.int64)
+    out[0] = first
+    if n > 1:
+        np.cumsum(deltas, out=out[1:])
+        out[1:] += first
+    return out.view(dtype)
+
+
+# ---------------------------------------------------------------------------
+# float (Gorilla family)
+# ---------------------------------------------------------------------------
+def _encode_gorilla(values: np.ndarray) -> bytes:
+    v = np.ascontiguousarray(values, dtype=np.float64).view(np.uint64)
+    n = len(v)
+    if n == 0:
+        return b"\x00"
+    x = v.copy()
+    x[1:] ^= v[:-1]
+    comp = _ZSTD_C.compress(_byte_transpose(x, 8))
+    return b"\x02" + np.uint32(n).tobytes() + comp
+
+
+def _decode_gorilla(data: bytes) -> np.ndarray:
+    if data[0] == 0:
+        return np.empty(0, dtype=np.float64)
+    n = int(np.frombuffer(data[1:5], dtype=np.uint32)[0])
+    x = _byte_untranspose(_ZSTD_D.decompress(data[5:]), 8, np.uint64)
+    assert len(x) == n, (len(x), n)
+    return prefix_xor_scan(x).view(np.float64)
+
+
+# ---------------------------------------------------------------------------
+# raw / quantile-style
+# ---------------------------------------------------------------------------
+def _encode_raw_transposed(values: np.ndarray, level3: bool = False) -> bytes:
+    a = np.ascontiguousarray(values)
+    comp = (_ZSTD_C3 if level3 else _ZSTD_C).compress(_byte_transpose(a, a.itemsize))
+    return np.uint32(len(a)).tobytes() + comp
+
+
+def _decode_raw_transposed(data: bytes, dtype) -> np.ndarray:
+    n = int(np.frombuffer(data[:4], dtype=np.uint32)[0])
+    if n == 0:
+        return np.empty(0, dtype=dtype)
+    out = _byte_untranspose(_ZSTD_D.decompress(data[4:]), np.dtype(dtype).itemsize, dtype)
+    assert len(out) == n
+    return out
+
+
+# ---------------------------------------------------------------------------
+# boolean
+# ---------------------------------------------------------------------------
+def _encode_bool(values: np.ndarray) -> bytes:
+    b = np.ascontiguousarray(values, dtype=np.bool_)
+    return np.uint32(len(b)).tobytes() + np.packbits(b).tobytes()
+
+
+def _decode_bool(data: bytes) -> np.ndarray:
+    n = int(np.frombuffer(data[:4], dtype=np.uint32)[0])
+    bits = np.unpackbits(np.frombuffer(data[4:], dtype=np.uint8), count=n)
+    return bits.astype(np.bool_)
+
+
+# ---------------------------------------------------------------------------
+# strings
+# ---------------------------------------------------------------------------
+def _pack_strings(values) -> bytes:
+    # [n u32][lens u32 xN][utf8 concat]
+    bs = [v.encode() if isinstance(v, str) else bytes(v) for v in values]
+    lens = np.array([len(b) for b in bs], dtype=np.uint32)
+    return np.uint32(len(bs)).tobytes() + lens.tobytes() + b"".join(bs)
+
+
+def _unpack_strings(raw: bytes) -> np.ndarray:
+    n = int(np.frombuffer(raw[:4], dtype=np.uint32)[0])
+    lens = np.frombuffer(raw[4:4 + 4 * n], dtype=np.uint32)
+    out = np.empty(n, dtype=object)
+    off = 4 + 4 * n
+    ends = np.cumsum(lens)
+    starts = ends - lens
+    for i in range(n):
+        out[i] = raw[off + starts[i]: off + ends[i]].decode()
+    return out
+
+
+_STR_CONTAINERS = {
+    Encoding.ZSTD: (lambda b: _ZSTD_C3.compress(b), lambda b: _ZSTD_D.decompress(b)),
+    Encoding.GZIP: (lambda b: gzip.compress(b, 6), gzip.decompress),
+    Encoding.ZLIB: (lambda b: zlib.compress(b, 6), zlib.decompress),
+    Encoding.BZIP: (lambda b: bz2.compress(b, 9), bz2.decompress),
+    Encoding.SNAPPY: (lambda b: zlib.compress(b, 1), zlib.decompress),
+    Encoding.DEFAULT: (lambda b: _ZSTD_C3.compress(b), lambda b: _ZSTD_D.decompress(b)),
+    Encoding.NULL: (lambda b: b, lambda b: b),
+}
+
+
+# ---------------------------------------------------------------------------
+# dispatch
+# ---------------------------------------------------------------------------
+def _resolve_default(vt: ValueType, is_time: bool) -> Encoding:
+    if is_time:
+        return Encoding.DELTA_TS
+    return {
+        ValueType.FLOAT: Encoding.GORILLA,
+        ValueType.INTEGER: Encoding.DELTA,
+        ValueType.UNSIGNED: Encoding.DELTA,
+        ValueType.BOOLEAN: Encoding.BITPACK,
+        ValueType.STRING: Encoding.ZSTD,
+        ValueType.GEOMETRY: Encoding.ZSTD,
+    }[vt]
+
+
+def encode(values: np.ndarray, vt: ValueType, encoding: Encoding = Encoding.DEFAULT,
+           is_time: bool = False) -> bytes:
+    """Encode a column block → [1B encoding id][payload]."""
+    if encoding == Encoding.DEFAULT:
+        encoding = _resolve_default(vt, is_time)
+    eid = bytes([int(encoding)])
+    try:
+        if vt in (ValueType.INTEGER, ValueType.UNSIGNED):
+            if encoding in (Encoding.DELTA, Encoding.DELTA_TS):
+                return eid + _encode_delta(np.asarray(values), is_ts=(encoding == Encoding.DELTA_TS or is_time))
+            if encoding in (Encoding.QUANTILE, Encoding.NULL):
+                return eid + _encode_raw_transposed(np.asarray(values), level3=True)
+        elif vt == ValueType.FLOAT:
+            if encoding == Encoding.GORILLA:
+                return eid + _encode_gorilla(np.asarray(values))
+            if encoding in (Encoding.QUANTILE, Encoding.NULL):
+                return eid + _encode_raw_transposed(np.asarray(values, dtype=np.float64), level3=True)
+        elif vt == ValueType.BOOLEAN:
+            if encoding in (Encoding.BITPACK, Encoding.NULL):
+                return eid + _encode_bool(np.asarray(values))
+        elif vt in (ValueType.STRING, ValueType.GEOMETRY):
+            comp, _ = _STR_CONTAINERS.get(encoding, _STR_CONTAINERS[Encoding.DEFAULT])
+            return eid + comp(_pack_strings(values))
+    except CodecError:
+        raise
+    except Exception as e:  # pragma: no cover - defensive
+        raise CodecError(f"encode failed: {e}", vt=vt.name, encoding=encoding.name)
+    raise CodecError("illegal encoding for type", vt=vt.name, encoding=encoding.name)
+
+
+def decode(data: bytes, vt: ValueType) -> np.ndarray:
+    """Decode a column block produced by `encode`."""
+    if len(data) == 0:
+        raise CodecError("empty block")
+    encoding = Encoding(data[0])
+    payload = data[1:]
+    try:
+        if vt in (ValueType.INTEGER, ValueType.UNSIGNED):
+            unsigned = vt == ValueType.UNSIGNED
+            if encoding in (Encoding.DELTA, Encoding.DELTA_TS):
+                return _decode_delta(payload, unsigned)
+            if encoding in (Encoding.QUANTILE, Encoding.NULL):
+                return _decode_raw_transposed(payload, np.uint64 if unsigned else np.int64)
+        elif vt == ValueType.FLOAT:
+            if encoding == Encoding.GORILLA:
+                return _decode_gorilla(payload)
+            if encoding in (Encoding.QUANTILE, Encoding.NULL):
+                return _decode_raw_transposed(payload, np.float64)
+        elif vt == ValueType.BOOLEAN:
+            if encoding in (Encoding.BITPACK, Encoding.NULL):
+                return _decode_bool(payload)
+        elif vt in (ValueType.STRING, ValueType.GEOMETRY):
+            _, decomp = _STR_CONTAINERS.get(encoding, _STR_CONTAINERS[Encoding.DEFAULT])
+            return _unpack_strings(decomp(payload))
+    except CodecError:
+        raise
+    except Exception as e:
+        raise CodecError(f"decode failed: {e}", vt=vt.name, encoding=encoding.name)
+    raise CodecError("illegal encoding for type", vt=vt.name, encoding=encoding.name)
+
+
+def encode_timestamps(ts: np.ndarray, encoding: Encoding = Encoding.DEFAULT) -> bytes:
+    return encode(ts, ValueType.INTEGER, encoding, is_time=True)
+
+
+def decode_timestamps(data: bytes) -> np.ndarray:
+    return decode(data, ValueType.INTEGER)

@@ -1,0 +1,194 @@
+"""Leveled compaction: picker + merge executor.
+
+Role-parity with the reference's compaction subsystem
+(tskv/src/compaction/: picker.rs LevelCompactionPicker/DeltaCompactionPicker,
+compact.rs merge, job.rs): L0 holds overlapping delta files from flushes;
+when enough accumulate they merge (plus overlapping L1 files) into L1;
+levels 1..4 are size-bounded and spill upward. Merging is per-series with
+per-field latest-file-wins on duplicate timestamps (same rule as memcache),
+vectorized with numpy — no row-at-a-time k-way heap.
+"""
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+
+import numpy as np
+
+from ..models.schema import ValueType
+from ..models.codec import Encoding
+from .memcache import _group_starts, _typed_array
+from .summary import FileMeta, Version, VersionEdit, MAX_LEVEL
+from .tombstone import tombstone_path
+from .tsm import TsmWriter
+
+
+@dataclass
+class CompactReq:
+    """One unit of compaction work."""
+
+    files: list[FileMeta]
+    target_level: int
+
+
+class Picker:
+    """Decides what to compact (reference picker.rs:17-300)."""
+
+    def __init__(self, l0_trigger: int = 4,
+                 level_base_size: int = 256 * 1024 * 1024,
+                 level_size_multiplier: int = 4,
+                 max_compact_files: int = 8):
+        self.l0_trigger = l0_trigger
+        self.level_base_size = level_base_size
+        self.level_size_multiplier = level_size_multiplier
+        self.max_compact_files = max_compact_files
+
+    def level_max_size(self, level: int) -> int:
+        return self.level_base_size * (self.level_size_multiplier ** max(0, level - 1))
+
+    def pick(self, version: Version) -> CompactReq | None:
+        # delta compaction first: L0 count trigger
+        l0 = sorted(version.levels[0].values(), key=lambda f: f.file_id)
+        if len(l0) >= self.l0_trigger:
+            picked = l0[:self.max_compact_files]
+            lo = min(f.min_ts for f in picked)
+            hi = max(f.max_ts for f in picked)
+            overlapped = [f for f in version.levels[1].values() if f.overlaps(lo, hi)]
+            return CompactReq(picked + overlapped[: self.max_compact_files], 1)
+        # level compaction: size overflow spills oldest files upward
+        for level in range(1, MAX_LEVEL):
+            if version.level_size(level) > self.level_max_size(level):
+                files = sorted(version.levels[level].values(), key=lambda f: f.file_id)
+                picked = files[: self.max_compact_files]
+                lo = min(f.min_ts for f in picked)
+                hi = max(f.max_ts for f in picked)
+                overlapped = [f for f in version.levels[level + 1].values()
+                              if f.overlaps(lo, hi)][: self.max_compact_files]
+                return CompactReq(picked + overlapped, level + 1)
+        return None
+
+
+# ---------------------------------------------------------------------------
+# merge executor
+# ---------------------------------------------------------------------------
+def run_compaction(version: Version, req: CompactReq, out_file_id: int) -> VersionEdit | None:
+    """Merge req.files → one file at req.target_level; returns the edit
+    (caller applies it via Summary). Tombstoned rows are dropped for good."""
+    # priority must match scan._series_parts: higher level = older data =
+    # lower priority (L4..L1 then L0), ascending file_id within a level.
+    # Readers/tombstones come from the Version caches; Version._apply evicts
+    # and closes them when the edit lands.
+    readers = [(fm, version.reader(fm), version.tombstone(fm))
+               for fm in req.files]
+    readers.sort(key=lambda t: (-t[0].level, t[0].file_id))
+
+    out_path_dir = "tsm" if req.target_level > 0 else "delta"
+    out_path = os.path.join(version.dir, out_path_dir, f"_{out_file_id:06d}.tsm")
+    os.makedirs(os.path.dirname(out_path), exist_ok=True)
+    w = TsmWriter(out_path)
+    wrote = False
+
+    tables: list[str] = sorted({t for _, r, _ in readers for t in r.tables()})
+    for table in tables:
+        sids = sorted({int(s) for _, r, _ in readers for s in r.series_ids(table)})
+        for sid in sids:
+            merged = _merge_series(table, sid, readers)
+            if merged is None:
+                continue
+            ts, cols = merged
+            if len(ts) == 0:
+                continue
+            w.write_series(table, sid, ts, cols)
+            wrote = True
+
+    edit_del = [fm.file_id for fm, _, _ in readers]
+    if not wrote:
+        w.abort()
+        edit = VersionEdit(del_files=edit_del)
+    else:
+        footer = w.finish()
+        fm_out = FileMeta(out_file_id, req.target_level, footer.min_ts,
+                          footer.max_ts, os.path.getsize(out_path),
+                          footer.series_count)
+        edit = VersionEdit(add_files=[fm_out], del_files=edit_del)
+    # old tombstones die with their files (caller deletes files after apply)
+    return edit
+
+
+def _merge_series(table: str, sid: int, readers) -> tuple[np.ndarray, dict] | None:
+    """Vectorized k-file merge of one series.
+
+    Concatenate rows from all files (priority = position in `readers`,
+    ascending file_id), stable-sort by ts, then per field pick the last
+    valid value within each timestamp group — identical semantics to
+    memcache.materialize.
+    """
+    ts_parts: list[np.ndarray] = []
+    col_parts: dict[str, list[tuple[int, np.ndarray, np.ndarray]]] = {}
+    col_types: dict[str, tuple[ValueType, Encoding, int]] = {}
+    offsets: list[int] = []
+    total = 0
+    for fm, r, tb in readers:
+        cm = r.chunk(table, sid)
+        if cm is None:
+            continue
+        ts = r.read_series_timestamps(table, sid)
+        keep = tb.mask_for(table, sid, ts)
+        for col in cm.columns:
+            pm0 = col.pages[0]
+            vt = ValueType(pm0.value_type)
+            vals, valid = r.read_series_column(table, sid, col.name)
+            if keep is not None:
+                vals, valid = vals[keep], valid[keep]
+            col_parts.setdefault(col.name, []).append((total, vals, valid))
+            if col.name not in col_types:
+                col_types[col.name] = (vt, Encoding(pm0.encoding), col.column_id)
+        if keep is not None:
+            ts = ts[keep]
+        ts_parts.append(ts)
+        offsets.append(total)
+        total += len(ts)
+    if total == 0:
+        return None
+    ts_all = np.concatenate(ts_parts)
+    order = np.argsort(ts_all, kind="stable")
+    ts_sorted = ts_all[order]
+    group_starts = _group_starts(ts_sorted)
+    uts = ts_sorted[group_starts]
+    idx = np.arange(total, dtype=np.int64)
+    out_cols = {}
+    for name, parts in col_parts.items():
+        vt, enc, cid = col_types[name]
+        np_dtype = vt.numpy_dtype()
+        vals_all = np.empty(total, dtype=np_dtype if np_dtype is not object else object)
+        valid_all = np.zeros(total, dtype=bool)
+        for off, vals, valid in parts:
+            vals_all[off:off + len(vals)] = vals
+            valid_all[off:off + len(valid)] = valid
+        vals_s = vals_all[order]
+        valid_s = valid_all[order]
+        score = np.where(valid_s, idx, -1)
+        last_valid = np.maximum.reduceat(score, group_starts)
+        valid_out = last_valid >= 0
+        vals_out = vals_s[np.clip(last_valid, 0, None)]
+        null_mask = None if valid_out.all() else ~valid_out
+        out_cols[name] = (cid, vt, enc, vals_out, null_mask)
+    return uts, out_cols
+
+
+def gc_compacted_files(version: Version, edit: VersionEdit):
+    """Delete merged-away files + their tombstones (after Summary.apply)."""
+    for fid in edit.del_files:
+        for sub in ("delta", "tsm"):
+            p = os.path.join(version.dir, sub, f"_{fid:06d}.tsm")
+            if os.path.exists(p):
+                try:
+                    os.unlink(p)
+                except OSError:
+                    pass
+            tp = tombstone_path(p)
+            if os.path.exists(tp):
+                try:
+                    os.unlink(tp)
+                except OSError:
+                    pass

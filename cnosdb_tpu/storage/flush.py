@@ -1,0 +1,53 @@
+"""Flush: immutable memcache → delta (L0) TSM file.
+
+Role-parity with reference FlushTask (tskv/src/compaction/flush.rs:21-215):
+per-series pages are encoded from the materialized memcache and written as
+one L0 file; the resulting VersionEdit carries the flushed WAL seq so the
+WAL can be purged behind it.
+"""
+from __future__ import annotations
+
+import os
+
+from ..models.schema import TskvTableSchema, ValueType
+from ..models.codec import Encoding
+from .memcache import MemCache
+from .summary import FileMeta, VersionEdit
+from .tsm import TsmWriter
+
+
+def flush_memcache(cache: MemCache, file_id: int, path: str,
+                   schemas: dict[str, TskvTableSchema] | None = None) -> VersionEdit | None:
+    """Write `cache` to a delta TSM file at `path`; → VersionEdit (None if
+    the cache was empty)."""
+    if cache.is_empty:
+        return None
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    w = TsmWriter(path)
+    n_series = 0
+    for table, sid, ts, fields in cache.series_batches():
+        if len(ts) == 0:
+            continue
+        schema = schemas.get(table) if schemas else None
+        cols = {}
+        for name, (vt, vals, valid) in fields.items():
+            cid, enc = _column_meta(schema, name, vt)
+            null_mask = None if valid.all() else ~valid
+            cols[name] = (cid, vt, enc, vals, null_mask)
+        w.write_series(table, sid, ts, cols)
+        n_series += 1
+    if n_series == 0:
+        w.abort()
+        return None
+    footer = w.finish()
+    fm = FileMeta(file_id, 0, footer.min_ts, footer.max_ts,
+                  os.path.getsize(path), footer.series_count)
+    return VersionEdit(add_files=[fm], flushed_seq=cache.max_seq)
+
+
+def _column_meta(schema: TskvTableSchema | None, name: str, vt: ValueType):
+    if schema is not None and schema.contains_column(name):
+        col = schema.column(name)
+        enc = col.encoding if col.encoding != Encoding.DEFAULT else col.default_encoding()
+        return col.id, enc
+    return 0, Encoding.DEFAULT

@@ -1,0 +1,190 @@
+"""In-memory write cache (one active + N immutable per vnode).
+
+Role-parity with the reference's MemCache (tskv/src/mem_cache/
+memcache.rs:30-295, series_data.rs): per-series row storage that absorbs
+writes and converts to columnar pages at flush. Kept deliberately simple —
+per-series Python lists of appended row chunks; sorting, last-write-wins
+dedup and null-mask construction happen once, vectorized, at
+`series_batches()` (flush or read) time, not per write.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..models.points import SeriesRows
+from ..models.schema import ValueType
+
+_APPROX_ROW_BYTES = 48
+
+
+class SeriesData:
+    """Accumulated rows of one series inside a memcache."""
+
+    __slots__ = ("sid", "table", "ts_chunks", "field_chunks", "n_rows")
+
+    def __init__(self, sid: int, table: str):
+        self.sid = sid
+        self.table = table
+        self.ts_chunks: list[list[int]] = []
+        # field → list[(row_offset, value_type, values)]; offset aligns the
+        # chunk with its rows in the concatenated timestamp stream
+        self.field_chunks: dict[str, list[tuple[int, int, list]]] = {}
+        self.n_rows = 0
+
+    def append(self, sr: SeriesRows):
+        off = self.n_rows
+        self.ts_chunks.append(sr.timestamps)
+        self.n_rows += len(sr.timestamps)
+        for name, (vt, vals) in sr.fields.items():
+            self.field_chunks.setdefault(name, []).append((off, vt, vals))
+
+    def materialize(self) -> tuple[np.ndarray, dict[str, tuple[ValueType, np.ndarray, np.ndarray]], np.ndarray]:
+        """→ (sorted unique ts, {field: (vt, values, valid_mask)}, order)
+
+        Sorts by time. Duplicate timestamps merge PER FIELD: each field
+        takes its latest non-missing value across the duplicate rows
+        (reference memcache RowData::extend — a later partial row overrides
+        only the fields it carries).
+        """
+        ts = np.array([t for c in self.ts_chunks for t in c], dtype=np.int64)
+        n = len(ts)
+        order = np.argsort(ts, kind="stable")  # stable: append order within ties
+        ts_sorted = ts[order]
+        group_starts = _group_starts(ts_sorted)
+        uts = ts_sorted[group_starts]
+        out_fields: dict[str, tuple[ValueType, np.ndarray, np.ndarray]] = {}
+        idx = np.arange(n, dtype=np.int64)
+        for name, chunks in self.field_chunks.items():
+            vt = ValueType(chunks[0][1])
+            vals_full = np.empty(n, dtype=object)
+            valid_full = np.zeros(n, dtype=bool)
+            for off, _vt, vals in chunks:
+                for i, v in enumerate(vals):
+                    if v is not None:
+                        vals_full[off + i] = v
+                        valid_full[off + i] = True
+            vals_s = vals_full[order]
+            valid_s = valid_full[order]
+            # per-group index of last valid row (-1 if none), vectorized
+            score = np.where(valid_s, idx, -1)
+            last_valid = np.maximum.reduceat(score, group_starts) if n else score
+            valid_out = last_valid >= 0
+            gather = np.clip(last_valid, 0, None)
+            vals_out = vals_s[gather]
+            out_fields[name] = (vt, _typed_array(vals_out, valid_out, vt), valid_out)
+        return uts, out_fields, order
+
+    def time_range(self) -> tuple[int, int]:
+        lo, hi = 2**63 - 1, -(2**63)
+        for c in self.ts_chunks:
+            for t in c:
+                if t < lo:
+                    lo = t
+                if t > hi:
+                    hi = t
+        return lo, hi
+
+
+def _group_starts(sorted_arr: np.ndarray) -> np.ndarray:
+    """Indices where a new run of equal values begins in a sorted array."""
+    n = len(sorted_arr)
+    if n == 0:
+        return np.empty(0, dtype=np.int64)
+    starts = np.empty(n, dtype=bool)
+    starts[0] = True
+    starts[1:] = sorted_arr[1:] != sorted_arr[:-1]
+    return np.nonzero(starts)[0]
+
+
+def _typed_array(obj_vals: np.ndarray, valid: np.ndarray, vt: ValueType) -> np.ndarray:
+    np_dtype = vt.numpy_dtype()
+    if np_dtype is object:
+        out = np.empty(len(obj_vals), dtype=object)
+        out[:] = [v if m else "" for v, m in zip(obj_vals, valid)]
+        return out
+    out = np.zeros(len(obj_vals), dtype=np_dtype)
+    if valid.any():
+        idx = np.nonzero(valid)[0]
+        out[idx] = np.array([obj_vals[i] for i in idx], dtype=np_dtype)
+    return out
+
+
+class MemCache:
+    """Active or immutable write cache for one vnode."""
+
+    def __init__(self, vnode_id: int, max_bytes: int = 128 * 1024 * 1024):
+        self.vnode_id = vnode_id
+        self.max_bytes = max_bytes
+        self.series: dict[tuple[str, int], SeriesData] = {}
+        self.approx_bytes = 0
+        self.min_seq: int | None = None
+        self.max_seq: int = 0
+        self.min_ts = 2**63 - 1
+        self.max_ts = -(2**63)
+        self.immutable = False
+
+    def write_series(self, table: str, sid: int, sr: SeriesRows, seq: int):
+        assert not self.immutable, "write to immutable memcache"
+        key = (table, sid)
+        sd = self.series.get(key)
+        if sd is None:
+            sd = self.series[key] = SeriesData(sid, table)
+        sd.append(sr)
+        nb = len(sr.timestamps)
+        self.approx_bytes += nb * _APPROX_ROW_BYTES * (1 + len(sr.fields))
+        if self.min_seq is None:
+            self.min_seq = seq
+        self.max_seq = max(self.max_seq, seq)
+        if sr.timestamps:
+            lo, hi = min(sr.timestamps), max(sr.timestamps)
+            self.min_ts = min(self.min_ts, lo)
+            self.max_ts = max(self.max_ts, hi)
+
+    @property
+    def is_empty(self) -> bool:
+        return not self.series
+
+    def should_flush(self) -> bool:
+        return self.approx_bytes >= self.max_bytes
+
+    def mark_immutable(self):
+        self.immutable = True
+
+    def series_batches(self):
+        """Yield (table, sid, ts, fields) in sorted (table, sid) order —
+        flush consumes this to write a delta TSM file."""
+        for (table, sid) in sorted(self.series.keys()):
+            sd = self.series[(table, sid)]
+            ts, fields, _ = sd.materialize()
+            yield table, sid, ts, fields
+
+    def delete_series(self, table: str, sid: int):
+        self.series.pop((table, sid), None)
+
+    def delete_table(self, table: str):
+        for key in [k for k in self.series if k[0] == table]:
+            del self.series[key]
+
+    def delete_time_range(self, table: str, sids, min_ts: int, max_ts: int):
+        """Row-level delete inside cache (reference memcache delete):
+        rebuild affected series without rows in [min_ts, max_ts]."""
+        sidset = set(int(s) for s in sids) if sids is not None else None
+        for (tbl, sid), sd in list(self.series.items()):
+            if tbl != table or (sidset is not None and sid not in sidset):
+                continue
+            ts, fields, _ = sd.materialize()
+            keep = (ts < min_ts) | (ts > max_ts)
+            if keep.all():
+                continue
+            nd = SeriesData(sid, tbl)
+            if keep.any():
+                kts = ts[keep].tolist()
+                nf = {}
+                for name, (vt, vals, valid) in fields.items():
+                    v = [vals[i] if valid[i] else None for i in np.nonzero(keep)[0]]
+                    nf[name] = (int(vt), v)
+                from ..models.series import SeriesKey
+                nd.append(SeriesRows(SeriesKey(tbl, []), kts, nf))
+                self.series[(tbl, sid)] = nd
+            else:
+                del self.series[(tbl, sid)]

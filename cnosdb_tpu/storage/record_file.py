@@ -1,0 +1,79 @@
+"""Generic CRC'd append-only record file.
+
+Role-parity with reference tskv/src/record_file/ (format doc mod.rs:1-34):
+the common container under the WAL and the Summary manifest. A file is
+[8B magic header] then records of [len u32 | crc32 u32 | payload]. Reads
+stop cleanly at truncation or corruption (torn tail after crash), which is
+exactly the recovery contract the WAL needs.
+"""
+from __future__ import annotations
+
+import os
+import struct
+import zlib
+from typing import Iterator
+
+from ..errors import StorageError
+
+FILE_MAGIC = b"CNOSREC1"
+_HDR = struct.Struct("<II")
+
+
+class RecordWriter:
+    def __init__(self, path: str):
+        self.path = path
+        exists = os.path.exists(path) and os.path.getsize(path) >= len(FILE_MAGIC)
+        self._f = open(path, "ab")
+        if not exists:
+            self._f.write(FILE_MAGIC)
+            self._f.flush()
+
+    def append(self, payload: bytes) -> int:
+        """Append one record, return its file offset."""
+        off = self._f.tell()
+        self._f.write(_HDR.pack(len(payload), zlib.crc32(payload)))
+        self._f.write(payload)
+        return off
+
+    def sync(self):
+        self._f.flush()
+        os.fsync(self._f.fileno())
+
+    @property
+    def size(self) -> int:
+        self._f.flush()
+        return self._f.tell()
+
+    def close(self):
+        try:
+            self.sync()
+        finally:
+            self._f.close()
+
+
+class RecordReader:
+    def __init__(self, path: str):
+        self.path = path
+        with open(path, "rb") as f:
+            self._buf = f.read()
+        if self._buf[:len(FILE_MAGIC)] != FILE_MAGIC:
+            raise StorageError("bad record file magic", path=path)
+
+    def __iter__(self) -> Iterator[bytes]:
+        off = len(FILE_MAGIC)
+        buf = self._buf
+        n = len(buf)
+        while off + _HDR.size <= n:
+            ln, crc = _HDR.unpack_from(buf, off)
+            start = off + _HDR.size
+            end = start + ln
+            if end > n:
+                break  # torn tail
+            payload = buf[start:end]
+            if zlib.crc32(payload) != crc:
+                break  # corruption: stop replay here
+            yield payload
+            off = end
+
+    def records(self) -> list[bytes]:
+        return list(self)

@@ -1,0 +1,241 @@
+"""Scan assembly: merge memcaches + TSM files into device-ready batches.
+
+Role-parity with the reference's read pipeline (tskv/src/reader/
+iterator.rs:94-121 reader tree: SeriesReader → DataMerger → DataFilter →
+Chunk/MemcacheReader), re-shaped for TPU: instead of a per-series stream
+tree pulling one RecordBatch at a time, the scan materializes ONE large
+columnar batch per vnode — timestamps, a series-ordinal segment array and
+field columns with validity masks, already concatenated across series —
+which is exactly the padded/masked layout `ops.tpu_exec` stages over PCIe.
+
+Dedup priority on duplicate timestamps (low→high): L4..L1 files, L0 delta
+files by ascending file id, immutable memcaches (oldest first), active
+memcache. Within a priority, later rows win per FIELD (same rule as
+memcache.materialize / compaction merge).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from ..models.predicate import TimeRange, TimeRanges
+from ..models.schema import TskvTableSchema, ValueType
+from .memcache import _group_starts
+from .vnode import VnodeStorage
+
+
+@dataclass
+class ScanBatch:
+    """One vnode's scan result, columnar, concatenated across series."""
+
+    table: str
+    series_ids: np.ndarray          # u64 [S]
+    series_keys: list               # SeriesKey per ordinal (tags for GROUP BY)
+    ts: np.ndarray                  # i64 [N]
+    sid_ordinal: np.ndarray         # i32 [N] — segment id per row
+    fields: dict[str, tuple[ValueType, np.ndarray, np.ndarray]] = field(default_factory=dict)
+    # name → (vt, values [N], valid [N])
+
+    @property
+    def n_rows(self) -> int:
+        return len(self.ts)
+
+    @property
+    def n_series(self) -> int:
+        return len(self.series_ids)
+
+
+def _time_mask(ts: np.ndarray, trs: TimeRanges) -> np.ndarray | None:
+    if trs.is_all:
+        return None
+    m = np.zeros(len(ts), dtype=bool)
+    for r in trs.ranges:
+        m |= (ts >= r.min_ts) & (ts <= r.max_ts)
+    return m
+
+
+def _series_parts(vnode: VnodeStorage, table: str, sid: int,
+                  field_names: list[str], trs: TimeRanges):
+    """Collect (ts, {field: (vt, vals, valid)}) parts in priority order."""
+    parts = []
+    version = vnode.summary.version
+    # files: L4..L1 then L0, ascending file_id within level ⇒ ascending priority
+    for level in (4, 3, 2, 1, 0):
+        fms = sorted(version.levels[level].values(), key=lambda f: f.file_id)
+        for fm in fms:
+            if not trs.is_all and not trs.overlaps(TimeRange(fm.min_ts, fm.max_ts)):
+                continue
+            r = version.reader(fm)
+            cm = r.chunk(table, sid)
+            if cm is None:
+                continue
+            ts = r.read_series_timestamps(table, sid)
+            keep = version.tombstone(fm).mask_for(table, sid, ts)
+            tmask = _time_mask(ts, trs)
+            if keep is None and tmask is None:
+                sel = None
+            else:
+                sel = np.ones(len(ts), dtype=bool)
+                if keep is not None:
+                    sel &= keep
+                if tmask is not None:
+                    sel &= tmask
+                if not sel.any():
+                    continue
+            fields = {}
+            for name in field_names:
+                col = cm.column(name)
+                if col is None:
+                    continue
+                vt = ValueType(col.pages[0].value_type)
+                vals, valid = r.read_series_column(table, sid, name)
+                if sel is not None:
+                    vals, valid = vals[sel], valid[sel]
+                fields[name] = (vt, vals, valid)
+            parts.append(((ts[sel] if sel is not None else ts), fields))
+    # memcaches: immutables old→new, then active
+    for cache in [*vnode.immutables, vnode.active]:
+        sd = cache.series.get((table, sid))
+        if sd is None:
+            continue
+        ts, mfields, _ = sd.materialize()
+        tmask = _time_mask(ts, trs)
+        if tmask is not None:
+            if not tmask.any():
+                continue
+            ts = ts[tmask]
+        fields = {}
+        for name in field_names:
+            if name not in mfields:
+                continue
+            vt, vals, valid = mfields[name]
+            if tmask is not None:
+                vals, valid = vals[tmask], valid[tmask]
+            fields[name] = (vt, vals, valid)
+        parts.append((ts, fields))
+    return parts
+
+
+def merge_parts(parts, field_names: list[str]):
+    """Merge priority-ordered parts → (ts, {field: (vt, vals, valid)})."""
+    if not parts:
+        return np.empty(0, dtype=np.int64), {}
+    if len(parts) == 1:
+        ts, fields = parts[0]
+        return ts, fields
+    ts_all = np.concatenate([p[0] for p in parts])
+    total = len(ts_all)
+    order = np.argsort(ts_all, kind="stable")
+    ts_sorted = ts_all[order]
+    group_starts = _group_starts(ts_sorted)
+    uts = ts_sorted[group_starts]
+    idx = np.arange(total, dtype=np.int64)
+    out = {}
+    for name in field_names:
+        vt = None
+        for _, fields in parts:
+            if name in fields:
+                vt = fields[name][0]
+                break
+        if vt is None:
+            continue
+        np_dtype = vt.numpy_dtype()
+        vals_all = np.zeros(total, dtype=np_dtype if np_dtype is not object else object)
+        valid_all = np.zeros(total, dtype=bool)
+        off = 0
+        for ts_p, fields in parts:
+            n = len(ts_p)
+            if name in fields:
+                _, vals, valid = fields[name]
+                vals_all[off:off + n] = vals
+                valid_all[off:off + n] = valid
+            off += n
+        vals_s = vals_all[order]
+        valid_s = valid_all[order]
+        score = np.where(valid_s, idx, -1)
+        last_valid = np.maximum.reduceat(score, group_starts)
+        valid_out = last_valid >= 0
+        vals_out = vals_s[np.clip(last_valid, 0, None)]
+        out[name] = (vt, vals_out, valid_out)
+    return uts, out
+
+
+def scan_vnode(vnode: VnodeStorage, table: str,
+               series_ids: np.ndarray | None = None,
+               time_ranges: TimeRanges | None = None,
+               field_names: list[str] | None = None) -> ScanBatch:
+    """Materialize a vnode scan into one ScanBatch."""
+    trs = time_ranges if time_ranges is not None else TimeRanges.all()
+    if series_ids is None:
+        file_sids = set()
+        for fm in vnode.summary.version.all_files():
+            r = vnode.summary.version.reader(fm)
+            file_sids.update(int(s) for s in r.series_ids(table))
+        mem_sids = {sid for (t, sid) in vnode.active.series if t == table}
+        for c in vnode.immutables:
+            mem_sids |= {sid for (t, sid) in c.series if t == table}
+        series_ids = np.array(sorted(file_sids | mem_sids), dtype=np.uint64)
+    if field_names is None:
+        field_names = _discover_fields(vnode, table)
+
+    ts_parts, ord_parts = [], []
+    fparts: dict[str, list[tuple[int, np.ndarray, np.ndarray]]] = {n: [] for n in field_names}
+    ftypes: dict[str, ValueType] = {}
+    keys = []
+    kept_sids = []
+    total = 0
+    for ordinal, sid in enumerate(series_ids):
+        sid = int(sid)
+        parts = _series_parts(vnode, table, sid, field_names, trs)
+        ts, fields = merge_parts(parts, field_names)
+        if len(ts) == 0:
+            continue
+        ts_parts.append(ts)
+        ord_parts.append(np.full(len(ts), len(kept_sids), dtype=np.int32))
+        for name in field_names:
+            if name in fields:
+                vt, vals, valid = fields[name]
+                ftypes.setdefault(name, vt)
+                fparts[name].append((total, vals, valid))
+        kept_sids.append(sid)
+        keys.append(vnode.index.get_series_key(sid))
+        total += len(ts)
+
+    if total == 0:
+        return ScanBatch(table, np.empty(0, dtype=np.uint64), [],
+                         np.empty(0, dtype=np.int64), np.empty(0, dtype=np.int32), {})
+    ts_all = np.concatenate(ts_parts)
+    ord_all = np.concatenate(ord_parts)
+    out_fields = {}
+    for name, parts in fparts.items():
+        if not parts:
+            continue
+        vt = ftypes[name]
+        np_dtype = vt.numpy_dtype()
+        vals_all = np.zeros(total, dtype=np_dtype if np_dtype is not object else object)
+        valid_all = np.zeros(total, dtype=bool)
+        for off, vals, valid in parts:
+            vals_all[off:off + len(vals)] = vals
+            valid_all[off:off + len(valid)] = valid
+        out_fields[name] = (vt, vals_all, valid_all)
+    return ScanBatch(table, np.array(kept_sids, dtype=np.uint64), keys,
+                     ts_all, ord_all, out_fields)
+
+
+def _discover_fields(vnode: VnodeStorage, table: str) -> list[str]:
+    names: set[str] = set()
+    schema = vnode.schemas.get(table)
+    if schema is not None:
+        return schema.field_names()
+    for fm in vnode.summary.version.all_files():
+        r = vnode.summary.version.reader(fm)
+        g = r.groups.get(table)
+        if g:
+            for cm in g.chunks.values():
+                names.update(c.name for c in cm.columns)
+    for cache in [vnode.active, *vnode.immutables]:
+        for (t, sid), sd in cache.series.items():
+            if t == table:
+                names.update(sd.field_chunks.keys())
+    return sorted(names)

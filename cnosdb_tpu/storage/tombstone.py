@@ -1,0 +1,68 @@
+"""Tombstones: row-range deletes against immutable TSM files.
+
+Role-parity with reference tskv/src/tsm/tombstone.rs (`.tombstone` file per
+TSM file): DELETE FROM / DROP SERIES record (table, series-set, time-range)
+exclusions; readers subtract them, compaction drops the rows for good.
+"""
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+
+import msgpack
+import numpy as np
+
+from .record_file import RecordReader, RecordWriter
+
+
+@dataclass(frozen=True)
+class TombstoneEntry:
+    table: str | None       # None = any table
+    series_id: int | None   # None = all series
+    min_ts: int
+    max_ts: int
+
+    def matches_series(self, table: str, sid: int) -> bool:
+        return ((self.table is None or self.table == table)
+                and (self.series_id is None or self.series_id == sid))
+
+
+def tombstone_path(tsm_path: str) -> str:
+    return tsm_path + ".tombstone"
+
+
+class TsmTombstone:
+    def __init__(self, tsm_path: str):
+        self.path = tombstone_path(tsm_path)
+        self.entries: list[TombstoneEntry] = []
+        if os.path.exists(self.path):
+            for payload in RecordReader(self.path):
+                t, s, lo, hi = msgpack.unpackb(payload, raw=False)
+                self.entries.append(TombstoneEntry(t, s, lo, hi))
+
+    def add(self, entries: list[TombstoneEntry]):
+        w = RecordWriter(self.path)
+        for e in entries:
+            w.append(msgpack.packb([e.table, e.series_id, e.min_ts, e.max_ts]))
+        w.close()
+        self.entries.extend(entries)
+
+    @property
+    def is_empty(self) -> bool:
+        return not self.entries
+
+    def mask_for(self, table: str, sid: int, ts: np.ndarray) -> np.ndarray | None:
+        """→ boolean keep-mask over ts, or None if untouched."""
+        hit = [e for e in self.entries if e.matches_series(table, sid)]
+        if not hit:
+            return None
+        keep = np.ones(len(ts), dtype=bool)
+        for e in hit:
+            keep &= (ts < e.min_ts) | (ts > e.max_ts)
+        return keep
+
+    def remove_file(self):
+        try:
+            os.unlink(self.path)
+        except FileNotFoundError:
+            pass

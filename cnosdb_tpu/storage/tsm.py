@@ -1,0 +1,426 @@
+"""TSM file format: the immutable columnar store.
+
+Role-parity with the reference's TSM v2 (tskv/src/tsm/writer.rs:40-540,
+reader.rs, page.rs, chunk.rs, chunk_group.rs, footer.rs): a file holds, per
+table (chunk group), per series (chunk), per column, encoded pages; the
+footer carries a series-id bloom filter and the meta tree offset; pages
+carry null bitsets and min/max/sum/count statistics used for pruning and
+for metadata-only aggregates (reference pushdown_agg_reader.rs answers
+COUNT from page meta without decoding).
+
+The byte layout is a fresh design (not the reference's): meta sections are
+msgpack (fast C codec), pages are [null bitset][codec block] with crc32,
+and chunks keep whole-series column runs contiguous so a scan materializes
+large numpy arrays per column — the shape the TPU staging path wants.
+
+Layout:
+    [magic u32 | version u8]
+    page data ...                         (sequential, crc'd)
+    meta: msgpack chunk tree              (zstd)
+    bloom: series-id bloom bits
+    footer (fixed 64B): meta_off u64 | meta_len u64 | bloom_off u64 |
+        bloom_len u64 | min_ts i64 | max_ts i64 | series_count u32 |
+        crc u32 | magic u32 | version u8 | pad
+"""
+from __future__ import annotations
+
+import os
+import struct
+import zlib
+from dataclasses import dataclass, field
+
+import msgpack
+import numpy as np
+import zstandard
+
+from ..errors import TsmError, ChecksumMismatch
+from ..models.codec import Encoding
+from ..models.schema import ValueType
+from ..utils.bloom import BloomFilter
+from . import codecs
+
+MAGIC = 0x7C05DB01
+VERSION = 1
+FOOTER_SIZE = 64
+
+_ZC = zstandard.ZstdCompressor(level=1)
+_ZD = zstandard.ZstdDecompressor()
+
+
+# ---------------------------------------------------------------------------
+# metadata model
+# ---------------------------------------------------------------------------
+@dataclass
+class PageMeta:
+    offset: int
+    size: int
+    n_rows: int           # logical rows in the page (incl. nulls)
+    n_values: int         # non-null values
+    value_type: int       # ValueType
+    encoding: int         # Encoding id actually used
+    min_ts: int
+    max_ts: int
+    stat_min: float | int | None = None
+    stat_max: float | int | None = None
+    stat_sum: float | int | None = None
+
+    def to_list(self):
+        return [self.offset, self.size, self.n_rows, self.n_values,
+                self.value_type, self.encoding, self.min_ts, self.max_ts,
+                self.stat_min, self.stat_max, self.stat_sum]
+
+    @classmethod
+    def from_list(cls, l):
+        return cls(*l)
+
+
+@dataclass
+class ColumnMeta:
+    column_id: int
+    name: str
+    pages: list[PageMeta] = field(default_factory=list)
+
+    def to_list(self):
+        return [self.column_id, self.name, [p.to_list() for p in self.pages]]
+
+    @classmethod
+    def from_list(cls, l):
+        return cls(l[0], l[1], [PageMeta.from_list(p) for p in l[2]])
+
+
+@dataclass
+class ChunkMeta:
+    """All pages of one series (reference chunk.rs)."""
+
+    series_id: int
+    n_rows: int
+    min_ts: int
+    max_ts: int
+    time_pages: list[PageMeta] = field(default_factory=list)
+    columns: list[ColumnMeta] = field(default_factory=list)
+
+    def column(self, name: str) -> ColumnMeta | None:
+        for c in self.columns:
+            if c.name == name:
+                return c
+        return None
+
+    def to_list(self):
+        return [self.series_id, self.n_rows, self.min_ts, self.max_ts,
+                [p.to_list() for p in self.time_pages],
+                [c.to_list() for c in self.columns]]
+
+    @classmethod
+    def from_list(cls, l):
+        return cls(l[0], l[1], l[2], l[3],
+                   [PageMeta.from_list(p) for p in l[4]],
+                   [ColumnMeta.from_list(c) for c in l[5]])
+
+
+@dataclass
+class ChunkGroupMeta:
+    """All chunks of one table (reference chunk_group.rs)."""
+
+    table: str
+    chunks: dict[int, ChunkMeta] = field(default_factory=dict)
+
+    def to_list(self):
+        return [self.table, [c.to_list() for c in self.chunks.values()]]
+
+    @classmethod
+    def from_list(cls, l):
+        cm = {c[0]: ChunkMeta.from_list(c) for c in l[1]}
+        return cls(l[0], cm)
+
+
+# ---------------------------------------------------------------------------
+# stats
+# ---------------------------------------------------------------------------
+def _compute_stats(values: np.ndarray, vt: ValueType):
+    if len(values) == 0:
+        return None, None, None
+    if vt == ValueType.FLOAT:
+        finite = values[np.isfinite(values)]
+        if len(finite) == 0:
+            return None, None, None
+        return float(finite.min()), float(finite.max()), float(finite.sum())
+    if vt in (ValueType.INTEGER, ValueType.UNSIGNED):
+        return int(values.min()), int(values.max()), int(values.sum())
+    if vt == ValueType.BOOLEAN:
+        return bool(values.min()), bool(values.max()), int(values.sum())
+    return None, None, None  # strings: no numeric stats
+
+
+# ---------------------------------------------------------------------------
+# writer
+# ---------------------------------------------------------------------------
+class TsmWriter:
+    """Streams series chunks into a TSM file; finish() seals meta+footer.
+
+    Mirrors reference TsmWriter::write_record_batch/finish
+    (tsm/writer.rs:249,503).
+    """
+
+    def __init__(self, path: str, max_page_rows: int = 256 * 1024):
+        self.path = path
+        self.max_page_rows = max_page_rows
+        self._f = open(path + ".tmp", "wb")
+        self._f.write(struct.pack("<IB", MAGIC, VERSION))
+        self._off = self._f.tell()
+        self._groups: dict[str, ChunkGroupMeta] = {}
+        self._bloom = BloomFilter()
+        self._min_ts = 2**63 - 1
+        self._max_ts = -(2**63)
+        self._finished = False
+
+    # -- core append -----------------------------------------------------
+    def _write_page(self, payload: bytes) -> tuple[int, int]:
+        crc = zlib.crc32(payload)
+        data = struct.pack("<II", len(payload), crc) + payload
+        off = self._off
+        self._f.write(data)
+        self._off += len(data)
+        return off, len(data)
+
+    def write_series(self, table: str, series_id: int,
+                     timestamps: np.ndarray,
+                     columns: dict[str, tuple[int, ValueType, Encoding, np.ndarray, np.ndarray | None]]):
+        """Write one series chunk.
+
+        columns: name → (column_id, value_type, encoding, values, null_mask)
+        `values` has one entry per row; rows where null_mask is True are
+        nulls (their value slot is ignored; dense packing happens here).
+        Timestamps must be sorted ascending and deduplicated.
+        """
+        if self._finished:
+            raise TsmError("writer already finished")
+        n = len(timestamps)
+        if n == 0:
+            return
+        ts = np.ascontiguousarray(timestamps, dtype=np.int64)
+        if n > 1 and bool(np.any(np.diff(ts) < 0)):
+            raise TsmError("timestamps not sorted", series=series_id)
+        group = self._groups.setdefault(table, ChunkGroupMeta(table))
+        if series_id in group.chunks:
+            raise TsmError("duplicate series chunk", series=series_id)
+        chunk = ChunkMeta(series_id, n, int(ts[0]), int(ts[-1]))
+        self._min_ts = min(self._min_ts, int(ts[0]))
+        self._max_ts = max(self._max_ts, int(ts[-1]))
+        self._bloom.insert_u64(series_id)
+
+        # time pages
+        for s in range(0, n, self.max_page_rows):
+            seg = ts[s:s + self.max_page_rows]
+            blk = codecs.encode_timestamps(seg)
+            off, size = self._write_page(blk)
+            chunk.time_pages.append(PageMeta(
+                off, size, len(seg), len(seg), int(ValueType.INTEGER),
+                int(Encoding.DELTA_TS), int(seg[0]), int(seg[-1]),
+                int(seg[0]), int(seg[-1]), None))
+
+        # field pages
+        for name, (cid, vt, enc, values, null_mask) in columns.items():
+            cm = ColumnMeta(cid, name)
+            for s in range(0, n, self.max_page_rows):
+                e = min(s + self.max_page_rows, n)
+                seg_ts = ts[s:e]
+                vals = values[s:e]
+                if null_mask is not None:
+                    nm = np.ascontiguousarray(null_mask[s:e], dtype=bool)
+                    dense = vals[~nm] if isinstance(vals, np.ndarray) else \
+                        [v for v, m in zip(vals, nm) if not m]
+                    bitset = np.packbits(nm).tobytes()
+                    has_nulls = bool(nm.any())
+                else:
+                    nm = None
+                    dense = vals
+                    bitset = b""
+                    has_nulls = False
+                if vt in (ValueType.STRING, ValueType.GEOMETRY):
+                    smin = smax = ssum = None
+                else:
+                    dense = np.ascontiguousarray(dense)
+                    smin, smax, ssum = _compute_stats(dense, vt)
+                blk = codecs.encode(dense, vt, enc)
+                payload = (struct.pack("<BI", 1 if has_nulls else 0, len(bitset))
+                           + (bitset if has_nulls else b"") + blk)
+                off, size = self._write_page(payload)
+                nvals = len(dense)
+                cm.pages.append(PageMeta(
+                    off, size, e - s, nvals, int(vt), blk[0],
+                    int(seg_ts[0]), int(seg_ts[-1]), smin, smax, ssum))
+            chunk.columns.append(cm)
+        group.chunks[series_id] = chunk
+
+    # -- finish ----------------------------------------------------------
+    def finish(self) -> "TsmFooter":
+        if self._finished:
+            raise TsmError("writer already finished")
+        meta_raw = msgpack.packb([g.to_list() for g in self._groups.values()])
+        meta = _ZC.compress(meta_raw)
+        meta_off = self._off
+        self._f.write(meta)
+        bloom = self._bloom.to_bytes()
+        bloom_off = meta_off + len(meta)
+        self._f.write(bloom)
+        series_count = sum(len(g.chunks) for g in self._groups.values())
+        body = struct.pack("<QQQQqqI", meta_off, len(meta), bloom_off,
+                           len(bloom), self._min_ts, self._max_ts, series_count)
+        crc = zlib.crc32(body)
+        footer = body + struct.pack("<II B", crc, MAGIC, VERSION)
+        footer += b"\x00" * (FOOTER_SIZE - len(footer))
+        self._f.write(footer)
+        self._f.flush()
+        os.fsync(self._f.fileno())
+        self._f.close()
+        os.replace(self.path + ".tmp", self.path)
+        self._finished = True
+        return TsmFooter(meta_off, len(meta), bloom_off, len(bloom),
+                         self._min_ts, self._max_ts, series_count)
+
+    def abort(self):
+        if not self._finished:
+            self._f.close()
+            try:
+                os.unlink(self.path + ".tmp")
+            except FileNotFoundError:
+                pass
+
+
+@dataclass
+class TsmFooter:
+    meta_off: int
+    meta_len: int
+    bloom_off: int
+    bloom_len: int
+    min_ts: int
+    max_ts: int
+    series_count: int
+
+
+# ---------------------------------------------------------------------------
+# reader
+# ---------------------------------------------------------------------------
+class TsmReader:
+    """Random-access TSM reader (reference tsm/reader.rs:825).
+
+    Loads footer + meta eagerly (small), pages lazily via one mmap'd file.
+    """
+
+    def __init__(self, path: str):
+        self.path = path
+        self._f = open(path, "rb")
+        import mmap as _mmap
+
+        self._buf = _mmap.mmap(self._f.fileno(), 0, access=_mmap.ACCESS_READ)
+        if len(self._buf) < FOOTER_SIZE + 5:
+            raise TsmError("file too small", path=path)
+        magic, version = struct.unpack_from("<IB", self._buf, 0)
+        if magic != MAGIC:
+            raise TsmError("bad magic", path=path)
+        footer_raw = self._buf[-FOOTER_SIZE:]
+        body = footer_raw[:52]
+        crc, fmagic, fver = struct.unpack_from("<IIB", footer_raw, 52)
+        if fmagic != MAGIC:
+            raise TsmError("bad footer magic", path=path)
+        if zlib.crc32(body) != crc:
+            raise ChecksumMismatch("footer crc", path=path)
+        (meta_off, meta_len, bloom_off, bloom_len,
+         self.min_ts, self.max_ts, self.series_count) = struct.unpack("<QQQQqqI", body)
+        self.footer = TsmFooter(meta_off, meta_len, bloom_off, bloom_len,
+                                self.min_ts, self.max_ts, self.series_count)
+        meta_raw = _ZD.decompress(self._buf[meta_off:meta_off + meta_len])
+        self.groups: dict[str, ChunkGroupMeta] = {}
+        for g in msgpack.unpackb(meta_raw, strict_map_key=False):
+            cg = ChunkGroupMeta.from_list(g)
+            self.groups[cg.table] = cg
+        self.bloom = BloomFilter.from_bytes(self._buf[bloom_off:bloom_off + bloom_len])
+
+    def close(self):
+        if not isinstance(self._buf, bytes):
+            self._buf.close()
+        self._f.close()
+        self._buf = b""
+
+    # -- meta queries ----------------------------------------------------
+    def tables(self) -> list[str]:
+        return list(self.groups)
+
+    def chunk(self, table: str, series_id: int) -> ChunkMeta | None:
+        g = self.groups.get(table)
+        return g.chunks.get(series_id) if g else None
+
+    def series_ids(self, table: str) -> np.ndarray:
+        g = self.groups.get(table)
+        if not g:
+            return np.empty(0, dtype=np.uint64)
+        return np.fromiter(g.chunks.keys(), dtype=np.uint64, count=len(g.chunks))
+
+    def maybe_contains_series(self, series_id: int) -> bool:
+        return self.bloom.maybe_contains_u64(series_id)
+
+    # -- page reads ------------------------------------------------------
+    def _read_page(self, pm: PageMeta) -> bytes:
+        raw = self._buf[pm.offset:pm.offset + pm.size]
+        plen, crc = struct.unpack_from("<II", raw, 0)
+        payload = raw[8:8 + plen]
+        if zlib.crc32(payload) != crc:
+            raise ChecksumMismatch("page crc", path=self.path, offset=pm.offset)
+        return payload
+
+    def read_time_page(self, pm: PageMeta) -> np.ndarray:
+        return codecs.decode_timestamps(self._read_page(pm))
+
+    def read_field_page(self, pm: PageMeta) -> tuple[np.ndarray, np.ndarray | None]:
+        """→ (dense_values, null_mask|None). null_mask[i] True → row i null."""
+        payload = self._read_page(pm)
+        has_nulls, blen = struct.unpack_from("<BI", payload, 0)
+        off = 5
+        nm = None
+        if has_nulls:
+            bits = np.frombuffer(payload[off:off + blen], dtype=np.uint8)
+            nm = np.unpackbits(bits, count=pm.n_rows).astype(bool)
+            off += blen
+        vals = codecs.decode(payload[off:], ValueType(pm.value_type))
+        return vals, nm
+
+    def read_series_timestamps(self, table: str, series_id: int) -> np.ndarray:
+        cm = self.chunk(table, series_id)
+        if cm is None:
+            return np.empty(0, dtype=np.int64)
+        return np.concatenate([self.read_time_page(p) for p in cm.time_pages]) \
+            if len(cm.time_pages) != 1 else self.read_time_page(cm.time_pages[0])
+
+    def read_series_column(self, table: str, series_id: int, name: str,
+                           fill=None) -> tuple[np.ndarray, np.ndarray]:
+        """→ (values_full, valid_mask) aligned to the series' timestamps.
+
+        Nulls are expanded in place (fill value, default type-zero), with
+        valid_mask False at null rows — the padded/masked shape the device
+        kernels consume.
+        """
+        cm = self.chunk(table, series_id)
+        if cm is None:
+            return np.empty(0), np.empty(0, dtype=bool)
+        col = cm.column(name)
+        if col is None:
+            # column absent in this chunk (schema evolution): all-null
+            n = cm.n_rows
+            return np.zeros(n), np.zeros(n, dtype=bool)
+        outs, masks = [], []
+        for pm in col.pages:
+            dense, nm = self.read_field_page(pm)
+            vt = ValueType(pm.value_type)
+            if nm is None:
+                outs.append(dense)
+                masks.append(np.ones(pm.n_rows, dtype=bool))
+            else:
+                full = np.zeros(pm.n_rows, dtype=dense.dtype if isinstance(dense, np.ndarray) else object)
+                if fill is not None:
+                    full[:] = fill
+                full[~nm] = dense
+                outs.append(full)
+                masks.append(~nm)
+        if len(outs) == 1:
+            return outs[0], masks[0]
+        return np.concatenate(outs), np.concatenate(masks)

@@ -1,0 +1,228 @@
+"""Per-vnode storage state machine.
+
+Role-parity with the reference's VnodeStorage (tskv/src/vnode_store.rs:
+29-620): the unit that a replica set replicates. apply() consumes logged
+commands (Write / DeleteTable / DeleteSeries / DeleteTimeRange / UpdateTags),
+write() stages rows into the memcache after series-id assignment, flush()
+rotates the active cache into an L0 TSM file recorded in the Summary, and
+recovery replays WAL entries above the flushed watermark
+(wal_store.rs:429 recover).
+
+Directory layout: <vnode_dir>/{wal/, index/, delta/, tsm/, summary}
+"""
+from __future__ import annotations
+
+import os
+import threading
+
+import msgpack
+import numpy as np
+
+from ..errors import StorageError
+from ..models.points import SeriesRows, WriteBatch
+from ..models.schema import TskvTableSchema
+from ..models.series import SeriesKey, Tag
+from .compaction import Picker, gc_compacted_files, run_compaction
+from .flush import flush_memcache
+from .index import TSIndex
+from .memcache import MemCache
+from .summary import Summary, VersionEdit
+from .tombstone import TombstoneEntry, TsmTombstone
+from .wal import Wal, WalEntryType
+
+
+class VnodeStorage:
+    def __init__(self, vnode_id: int, dir_path: str,
+                 schemas: dict[str, TskvTableSchema] | None = None,
+                 memcache_bytes: int = 128 * 1024 * 1024,
+                 wal_sync: bool = False,
+                 picker: Picker | None = None):
+        self.vnode_id = vnode_id
+        self.dir = dir_path
+        os.makedirs(dir_path, exist_ok=True)
+        self.schemas = schemas if schemas is not None else {}
+        self.memcache_bytes = memcache_bytes
+        self.lock = threading.RLock()
+        self.summary = Summary(dir_path)
+        self.index = TSIndex(os.path.join(dir_path, "index"))
+        self.wal = Wal(os.path.join(dir_path, "wal"), sync_on_append=wal_sync)
+        self.active = MemCache(vnode_id, memcache_bytes)
+        self.immutables: list[MemCache] = []
+        self.picker = picker or Picker()
+        self._replay_wal()
+
+    # ------------------------------------------------------------------ boot
+    def _replay_wal(self):
+        flushed = self.summary.version.flushed_seq
+        for entry in self.wal.replay(from_seq=flushed + 1):
+            self._apply_entry(entry.entry_type, entry.data, entry.seq, logged=True)
+
+    # ------------------------------------------------------------------ write
+    def write(self, batch: WriteBatch, sync: bool = False) -> int:
+        """Log + apply one write batch; → assigned WAL seq."""
+        with self.lock:
+            data = batch.encode()
+            seq = self.wal.append(WalEntryType.WRITE, data)
+            if sync:
+                self.wal.sync()
+            self._apply_write(batch, seq)
+            return seq
+
+    def apply_entry(self, entry_type: int, data: bytes, seq: int):
+        """Apply a replicated log entry (replication layer path): the entry
+        is already durable in this vnode's WAL at `seq`."""
+        with self.lock:
+            self._apply_entry(entry_type, data, seq, logged=True)
+
+    def _apply_entry(self, entry_type: int, data: bytes, seq: int, logged: bool):
+        if entry_type == WalEntryType.WRITE:
+            self._apply_write(WriteBatch.decode(data), seq)
+        elif entry_type == WalEntryType.DELETE_TABLE:
+            obj = msgpack.unpackb(data, raw=False)
+            self._apply_drop_table(obj["table"])
+        elif entry_type == WalEntryType.DELETE_SERIES:
+            obj = msgpack.unpackb(data, raw=False)
+            self._apply_delete_series(obj["table"], obj["sids"])
+        elif entry_type == WalEntryType.UPDATE_TAGS:
+            obj = msgpack.unpackb(data, raw=False)
+            self._apply_update_tags(obj["table"], obj["old_keys"], obj["new_keys"])
+        elif entry_type == WalEntryType.DELETE_TIME_RANGE:
+            obj = msgpack.unpackb(data, raw=False)
+            self._apply_delete_time_range(obj["table"], obj["sids"],
+                                          obj["min_ts"], obj["max_ts"])
+        # RAFT_BLANK/MEMBERSHIP: no storage effect
+
+    def _apply_write(self, batch: WriteBatch, seq: int):
+        for table, series_list in batch.tables.items():
+            for sr in series_list:
+                sid = self.index.add_series_if_not_exists(sr.key)
+                self.active.write_series(table, sid, sr, seq)
+        if self.active.should_flush():
+            self.flush()
+
+    # ------------------------------------------------------------------ flush
+    def switch_to_immutable(self):
+        with self.lock:
+            if self.active.is_empty:
+                return
+            self.active.mark_immutable()
+            self.immutables.append(self.active)
+            self.active = MemCache(self.vnode_id, self.memcache_bytes)
+
+    def flush(self, sync: bool = True):
+        """Rotate active cache and persist ALL immutables to L0 files."""
+        with self.lock:
+            self.switch_to_immutable()
+            for cache in self.immutables:
+                fid = self.summary.next_file_id()
+                path = os.path.join(self.dir, "delta", f"_{fid:06d}.tsm")
+                edit = flush_memcache(cache, fid, path, self.schemas)
+                if edit is not None:
+                    self.summary.apply(edit, sync=sync)
+            self.immutables.clear()
+            self.index.sync()
+            self.wal.sync()
+            self.wal.purge_to(self.summary.version.flushed_seq + 1)
+
+    # ------------------------------------------------------------------ compact
+    def compact(self, force_level: int | None = None) -> bool:
+        """Run at most one compaction round; → True if work was done."""
+        with self.lock:
+            req = self.picker.pick(self.summary.version)
+            if req is None:
+                return False
+            fid = self.summary.next_file_id()
+            edit = run_compaction(self.summary.version, req, fid)
+            if edit is None:
+                return False
+            self.summary.apply(edit)
+            gc_compacted_files(self.summary.version, edit)
+            return True
+
+    def compact_full(self, max_rounds: int = 32):
+        for _ in range(max_rounds):
+            if not self.compact():
+                break
+
+    # ------------------------------------------------------------------ deletes
+    def drop_table(self, table: str):
+        with self.lock:
+            data = msgpack.packb({"table": table})
+            self.wal.append(WalEntryType.DELETE_TABLE, data)
+            self._apply_drop_table(table)
+
+    def _apply_drop_table(self, table: str):
+        self.active.delete_table(table)
+        for c in self.immutables:
+            c.delete_table(table)
+        for sid in self.index.table_series_ids(table):
+            self.index.del_series(int(sid))
+        for fm in self.summary.version.all_files():
+            self.summary.version.tombstone(fm).add(
+                [TombstoneEntry(table, None, -(2**63), 2**63 - 1)])
+
+    def delete_series(self, table: str, sids: list[int]):
+        with self.lock:
+            data = msgpack.packb({"table": table, "sids": [int(s) for s in sids]})
+            self.wal.append(WalEntryType.DELETE_SERIES, data)
+            self._apply_delete_series(table, sids)
+
+    def _apply_delete_series(self, table: str, sids):
+        for c in [self.active, *self.immutables]:
+            for sid in sids:
+                c.delete_series(table, int(sid))
+        for fm in self.summary.version.all_files():
+            self.summary.version.tombstone(fm).add(
+                [TombstoneEntry(table, int(s), -(2**63), 2**63 - 1) for s in sids])
+
+    def delete_time_range(self, table: str, sids, min_ts: int, max_ts: int):
+        """DELETE FROM t WHERE ... (reference vnode_store.rs:503)."""
+        with self.lock:
+            data = msgpack.packb({
+                "table": table,
+                "sids": [int(s) for s in sids] if sids is not None else None,
+                "min_ts": int(min_ts), "max_ts": int(max_ts)})
+            self.wal.append(WalEntryType.DELETE_TIME_RANGE, data)
+            self._apply_delete_time_range(table, sids, min_ts, max_ts)
+
+    def _apply_delete_time_range(self, table: str, sids, min_ts: int, max_ts: int):
+        for c in [self.active, *self.immutables]:
+            c.delete_time_range(table, sids, min_ts, max_ts)
+        ents = ([TombstoneEntry(table, int(s), min_ts, max_ts) for s in sids]
+                if sids is not None else [TombstoneEntry(table, None, min_ts, max_ts)])
+        for fm in self.summary.version.all_files():
+            if fm.overlaps(min_ts, max_ts):
+                self.summary.version.tombstone(fm).add(ents)
+
+    def _apply_update_tags(self, table: str, old_keys: list[bytes], new_keys: list[bytes]):
+        """UPDATE tag values: re-key series (reference update_tags_value)."""
+        for ob, nb in zip(old_keys, new_keys):
+            old_key = SeriesKey.decode(ob)
+            sid = self.index.get_series_id(old_key)
+            if sid is None:
+                continue
+            self.index.rename_series(sid, SeriesKey.decode(nb))
+
+    def update_tags(self, table: str, old_keys: list[SeriesKey], new_keys: list[SeriesKey]):
+        with self.lock:
+            data = msgpack.packb({
+                "table": table,
+                "old_keys": [k.encode() for k in old_keys],
+                "new_keys": [k.encode() for k in new_keys]})
+            self.wal.append(WalEntryType.UPDATE_TAGS, data)
+            self._apply_update_tags(table, [k.encode() for k in old_keys],
+                                    [k.encode() for k in new_keys])
+
+    # ------------------------------------------------------------------ stats
+    def series_count(self) -> int:
+        return self.index.series_count()
+
+    def disk_size(self) -> int:
+        return sum(f.size for f in self.summary.version.all_files())
+
+    def close(self):
+        with self.lock:
+            self.flush()
+            self.wal.close()
+            self.index.close()
+            self.summary.close()

@@ -1,0 +1,180 @@
+"""Write-ahead log — also the replication log.
+
+Mirrors the reference's WAL-is-the-raft-log design (tskv/src/wal/
+wal_store.rs:22-150 RaftEntryStorage over wal files; recover :429): one WAL
+per vnode, made of numbered segment files of CRC records. Entries carry a
+monotonically increasing sequence; recovery replays entries with
+seq > flushed watermark. The replication layer stores its raft entries
+through this same API, so there is exactly one durable log per vnode.
+
+Entry record layout (inside a record-file payload):
+    seq u64 | entry_type u8 | data...
+"""
+from __future__ import annotations
+
+import os
+import re
+import struct
+from dataclasses import dataclass
+
+from ..errors import WalError
+from .record_file import RecordReader, RecordWriter
+
+SEGMENT_PATTERN = re.compile(r"^wal_(\d{10})\.log$")
+_ENTRY_HDR = struct.Struct("<QB")
+
+
+class WalEntryType:
+    WRITE = 1          # point write batch
+    DELETE_TABLE = 2
+    DELETE_SERIES = 3
+    UPDATE_TAGS = 4
+    RAFT_BLANK = 5     # raft no-op/membership entries
+    RAFT_MEMBERSHIP = 6
+    DELETE_TIME_RANGE = 7
+
+
+@dataclass
+class WalEntry:
+    seq: int
+    entry_type: int
+    data: bytes
+
+    def encode(self) -> bytes:
+        return _ENTRY_HDR.pack(self.seq, self.entry_type) + self.data
+
+    @classmethod
+    def decode(cls, payload: bytes) -> "WalEntry":
+        seq, et = _ENTRY_HDR.unpack_from(payload, 0)
+        return cls(seq, et, payload[_ENTRY_HDR.size:])
+
+
+class Wal:
+    """Segmented WAL for one vnode."""
+
+    def __init__(self, dir_path: str, max_segment_size: int = 64 * 1024 * 1024,
+                 sync_on_append: bool = False):
+        self.dir = dir_path
+        self.max_segment_size = max_segment_size
+        self.sync_on_append = sync_on_append
+        os.makedirs(dir_path, exist_ok=True)
+        self._segments: list[int] = self._list_segments()
+        self._next_seq = 1
+        self._min_seq = 1
+        self._writer: RecordWriter | None = None
+        if self._segments:
+            entries = list(self.replay())
+            if entries:
+                self._min_seq = entries[0].seq
+                self._next_seq = entries[-1].seq + 1
+        self._open_writer()
+
+    # -- segments --------------------------------------------------------
+    def _list_segments(self) -> list[int]:
+        out = []
+        for name in os.listdir(self.dir):
+            m = SEGMENT_PATTERN.match(name)
+            if m:
+                out.append(int(m.group(1)))
+        return sorted(out)
+
+    def _seg_path(self, seg_id: int) -> str:
+        return os.path.join(self.dir, f"wal_{seg_id:010d}.log")
+
+    def _open_writer(self):
+        if not self._segments:
+            self._segments.append(0)
+        self._writer = RecordWriter(self._seg_path(self._segments[-1]))
+
+    def _roll(self):
+        self._writer.close()
+        self._segments.append(self._segments[-1] + 1)
+        self._writer = RecordWriter(self._seg_path(self._segments[-1]))
+
+    # -- append/replay ---------------------------------------------------
+    @property
+    def next_seq(self) -> int:
+        return self._next_seq
+
+    @property
+    def min_seq(self) -> int:
+        return self._min_seq
+
+    def append(self, entry_type: int, data: bytes, seq: int | None = None) -> int:
+        """Append one entry; returns its seq. Explicit `seq` is used by the
+        replication layer (raft log index); it must be >= current tail."""
+        if seq is None:
+            seq = self._next_seq
+        elif seq < self._next_seq:
+            # raft log truncation-on-conflict: drop tail entries >= seq first
+            self.truncate_from(seq)
+        e = WalEntry(seq, entry_type, data)
+        self._writer.append(e.encode())
+        if self.sync_on_append:
+            self._writer.sync()
+        self._next_seq = seq + 1
+        if self._writer.size >= self.max_segment_size:
+            self._roll()
+        return seq
+
+    def sync(self):
+        if self._writer:
+            self._writer.sync()
+
+    def replay(self, from_seq: int = 0):
+        """Yield entries with seq >= from_seq in log order.
+
+        Later duplicates of a seq win (post-truncation re-appends)."""
+        entries: dict[int, WalEntry] = {}
+        tail_seq = 0
+        for seg in self._list_segments():
+            try:
+                rr = RecordReader(self._seg_path(seg))
+            except Exception:
+                continue
+            for payload in rr:
+                e = WalEntry.decode(payload)
+                if e.seq <= tail_seq:
+                    # append at seq s after truncation invalidates all > s
+                    # (rare path: only on post-conflict rewrites)
+                    entries = {k: v for k, v in entries.items() if k < e.seq}
+                entries[e.seq] = e
+                tail_seq = e.seq
+        for seq in sorted(entries):
+            if seq >= from_seq:
+                yield entries[seq]
+
+    def truncate_from(self, seq: int):
+        """Logical truncation of entries >= seq (raft conflict). Physical
+        bytes stay; replay() honors the rewrite rule above."""
+        if seq < self._min_seq:
+            self._min_seq = seq
+        self._next_seq = seq
+
+    # -- GC --------------------------------------------------------------
+    def purge_to(self, seq: int):
+        """Drop whole segments whose entries are all < seq (post-flush GC,
+        reference SnapshotPolicy purge multi_raft.rs:107-138)."""
+        self._min_seq = max(self._min_seq, seq)
+        segs = self._list_segments()
+        # Delete only segments provably below the watermark; unreadable
+        # segments and everything after them are kept (log order matters),
+        # as is the active segment.
+        for seg in segs[:-1]:
+            try:
+                max_seq = 0
+                for payload in RecordReader(self._seg_path(seg)):
+                    max_seq = max(max_seq, WalEntry.decode(payload).seq)
+            except Exception:
+                break
+            if max_seq >= seq:
+                break
+            os.unlink(self._seg_path(seg))
+
+    def total_size(self) -> int:
+        return sum(os.path.getsize(self._seg_path(s)) for s in self._list_segments())
+
+    def close(self):
+        if self._writer:
+            self._writer.close()
+            self._writer = None

@@ -1,0 +1,122 @@
+import numpy as np
+import pytest
+
+from cnosdb_tpu.errors import CodecError
+from cnosdb_tpu.models.codec import Encoding
+from cnosdb_tpu.models.schema import ValueType
+from cnosdb_tpu.storage import codecs
+
+
+def _roundtrip(values, vt, enc=Encoding.DEFAULT, is_time=False):
+    blk = codecs.encode(values, vt, enc, is_time=is_time)
+    out = codecs.decode(blk, vt)
+    return blk, out
+
+
+# ------------------------------------------------------------- timestamps
+def test_regular_timestamps_constant_stride_fast_path():
+    ts = np.arange(0, 10_000_000_000, 1_000_000, dtype=np.int64)  # 10k pts @1ms
+    blk = codecs.encode_timestamps(ts)
+    assert len(blk) < 32  # constant stride encodes to ~22 bytes
+    out = codecs.decode_timestamps(blk)
+    np.testing.assert_array_equal(out, ts)
+
+
+def test_irregular_timestamps(rng):
+    base = np.int64(1_600_000_000_000_000_000)
+    ts = base + np.cumsum(rng.integers(1, 1_000_000, size=5000)).astype(np.int64)
+    blk = codecs.encode_timestamps(ts)
+    np.testing.assert_array_equal(codecs.decode_timestamps(blk), ts)
+    assert len(blk) < ts.nbytes  # compresses
+
+
+# ------------------------------------------------------------- integers
+@pytest.mark.parametrize("enc", [Encoding.DELTA, Encoding.QUANTILE])
+def test_integer_roundtrip(rng, enc):
+    for vals in [
+        rng.integers(-(2**62), 2**62, size=1000),
+        np.array([0], dtype=np.int64),
+        np.array([-(2**63), 2**63 - 1, 0, -1, 1], dtype=np.int64),
+        np.zeros(100, dtype=np.int64),
+    ]:
+        _, out = _roundtrip(vals.astype(np.int64), ValueType.INTEGER, enc)
+        np.testing.assert_array_equal(out, vals)
+        assert out.dtype == np.int64
+
+
+def test_unsigned_roundtrip(rng):
+    vals = rng.integers(0, 2**63, size=1000, dtype=np.uint64) * 2
+    _, out = _roundtrip(vals, ValueType.UNSIGNED, Encoding.DELTA)
+    np.testing.assert_array_equal(out, vals)
+    assert out.dtype == np.uint64
+
+
+def test_empty_blocks():
+    for vt, enc in [(ValueType.INTEGER, Encoding.DELTA),
+                    (ValueType.FLOAT, Encoding.GORILLA),
+                    (ValueType.BOOLEAN, Encoding.BITPACK),
+                    (ValueType.STRING, Encoding.ZSTD)]:
+        _, out = _roundtrip(np.array([], dtype=np.float64) if vt == ValueType.FLOAT
+                            else [] if vt == ValueType.STRING
+                            else np.array([], dtype=np.int64), vt, enc)
+        assert len(out) == 0
+
+
+# ------------------------------------------------------------- floats
+def test_float_gorilla_roundtrip(rng):
+    for vals in [
+        rng.normal(50.0, 10.0, size=10_000),
+        np.array([np.nan, np.inf, -np.inf, 0.0, -0.0, 1e-300, 1e300]),
+        np.full(1000, 3.14159),
+        np.array([1.5]),
+    ]:
+        _, out = _roundtrip(vals, ValueType.FLOAT, Encoding.GORILLA)
+        np.testing.assert_array_equal(out.view(np.uint64), np.asarray(vals, dtype=np.float64).view(np.uint64))
+
+
+def test_float_compression_on_slowly_varying():
+    t = np.arange(100_000)
+    vals = 50.0 + np.sin(t / 1000.0)  # smooth signal
+    blk, out = _roundtrip(vals, ValueType.FLOAT, Encoding.GORILLA)
+    np.testing.assert_array_equal(out, vals)
+    assert len(blk) < vals.nbytes * 0.8
+
+
+# ------------------------------------------------------------- bool/string
+def test_bool_roundtrip(rng):
+    vals = rng.integers(0, 2, size=1237).astype(bool)
+    _, out = _roundtrip(vals, ValueType.BOOLEAN, Encoding.BITPACK)
+    np.testing.assert_array_equal(out, vals)
+
+
+@pytest.mark.parametrize("enc", [Encoding.ZSTD, Encoding.GZIP, Encoding.ZLIB,
+                                 Encoding.BZIP, Encoding.SNAPPY])
+def test_string_roundtrip(enc):
+    vals = ["hello", "", "世界", "x" * 1000, "tag_value_1"] * 20
+    _, out = _roundtrip(vals, ValueType.STRING, enc)
+    assert list(out) == vals
+
+
+# ------------------------------------------------------------- errors
+def test_illegal_encoding_rejected():
+    with pytest.raises(CodecError):
+        codecs.encode(np.array([1.0]), ValueType.FLOAT, Encoding.BITPACK)
+    with pytest.raises(CodecError):
+        codecs.decode(b"", ValueType.FLOAT)
+
+
+# ------------------------------------------------------------- perf sanity
+def test_decode_speed_smoke():
+    """Decode must be way faster than Python-loop speed (vectorized check)."""
+    import time
+    n = 1_000_000
+    ts = np.arange(n, dtype=np.int64) * 1_000_000
+    vals = 50.0 + np.sin(np.arange(n) / 1000.0)
+    tblk = codecs.encode_timestamps(ts)
+    fblk = codecs.encode(vals, ValueType.FLOAT)
+    t0 = time.perf_counter()
+    codecs.decode_timestamps(tblk)
+    codecs.decode(fblk, ValueType.FLOAT)
+    dt = time.perf_counter() - t0
+    # 1M ts + 1M floats; vectorized path should run well under a second
+    assert dt < 1.0, f"decode too slow: {dt:.3f}s"

@@ -1,0 +1,135 @@
+import numpy as np
+import pytest
+
+from cnosdb_tpu.errors import TsmError
+from cnosdb_tpu.models.codec import Encoding
+from cnosdb_tpu.models.schema import ValueType
+from cnosdb_tpu.storage.tsm import TsmReader, TsmWriter
+
+
+def _write_basic(path, n=1000, series=(1, 2, 3)):
+    w = TsmWriter(path)
+    for sid in series:
+        ts = np.arange(n, dtype=np.int64) * 1_000_000 + sid
+        vals = np.linspace(0, 100, n) + sid
+        nulls = np.zeros(n, dtype=bool)
+        nulls[::97] = True
+        ints = np.arange(n, dtype=np.int64) * sid
+        w.write_series("cpu", sid, ts, {
+            "usage": (1, ValueType.FLOAT, Encoding.GORILLA, vals, nulls),
+            "n": (2, ValueType.INTEGER, Encoding.DELTA, ints, None),
+        })
+    return w.finish()
+
+
+def test_write_read_roundtrip(tmp_path):
+    p = str(tmp_path / "f1.tsm")
+    footer = _write_basic(p)
+    assert footer.series_count == 3
+    r = TsmReader(p)
+    assert r.tables() == ["cpu"]
+    assert sorted(r.series_ids("cpu")) == [1, 2, 3]
+    ts = r.read_series_timestamps("cpu", 2)
+    assert len(ts) == 1000 and ts[0] == 2
+    vals, valid = r.read_series_column("cpu", 2, "usage")
+    assert len(vals) == 1000
+    assert not valid[0] and valid[1]  # row 0 null (::97 mask)
+    expect = np.linspace(0, 100, 1000) + 2
+    np.testing.assert_allclose(vals[valid], expect[~(np.arange(1000) % 97 == 0)])
+    ints, ivalid = r.read_series_column("cpu", 2, "n")
+    assert ivalid.all()
+    np.testing.assert_array_equal(ints, np.arange(1000, dtype=np.int64) * 2)
+    r.close()
+
+
+def test_bloom_and_stats(tmp_path):
+    p = str(tmp_path / "f2.tsm")
+    _write_basic(p)
+    r = TsmReader(p)
+    assert r.maybe_contains_series(1)
+    misses = sum(r.maybe_contains_series(i) for i in range(1000, 1500))
+    assert misses < 10
+    cm = r.chunk("cpu", 1)
+    assert cm.n_rows == 1000
+    pm = cm.column("n").pages[0]
+    assert pm.stat_min == 0 and pm.stat_max == 999
+    assert pm.stat_sum == sum(range(1000))
+    assert pm.n_values == 1000
+    upm = cm.column("usage").pages[0]
+    assert upm.n_values == 1000 - len(range(0, 1000, 97))
+    r.close()
+
+
+def test_multi_page_chunks(tmp_path):
+    p = str(tmp_path / "f3.tsm")
+    n = 10_000
+    w = TsmWriter(p, max_page_rows=1024)
+    ts = np.arange(n, dtype=np.int64)
+    vals = np.random.default_rng(1).normal(size=n)
+    w.write_series("m", 7, ts, {"v": (1, ValueType.FLOAT, Encoding.GORILLA, vals, None)})
+    w.finish()
+    r = TsmReader(p)
+    cm = r.chunk("m", 7)
+    assert len(cm.time_pages) == (n + 1023) // 1024
+    out, valid = r.read_series_column("m", 7, "v")
+    np.testing.assert_array_equal(out, vals)
+    np.testing.assert_array_equal(r.read_series_timestamps("m", 7), ts)
+    r.close()
+
+
+def test_string_and_bool_columns(tmp_path):
+    p = str(tmp_path / "f4.tsm")
+    w = TsmWriter(p)
+    ts = np.arange(10, dtype=np.int64)
+    strs = np.array([f"s{i}" for i in range(10)], dtype=object)
+    bools = np.array([i % 2 == 0 for i in range(10)])
+    w.write_series("t", 5, ts, {
+        "s": (1, ValueType.STRING, Encoding.ZSTD, strs, None),
+        "b": (2, ValueType.BOOLEAN, Encoding.BITPACK, bools, None),
+    })
+    w.finish()
+    r = TsmReader(p)
+    sv, _ = r.read_series_column("t", 5, "s")
+    assert list(sv) == [f"s{i}" for i in range(10)]
+    bv, _ = r.read_series_column("t", 5, "b")
+    np.testing.assert_array_equal(bv, bools)
+    r.close()
+
+
+def test_missing_column_is_all_null(tmp_path):
+    p = str(tmp_path / "f5.tsm")
+    _write_basic(p, n=50)
+    r = TsmReader(p)
+    vals, valid = r.read_series_column("cpu", 1, "added_later")
+    assert len(vals) == 50 and not valid.any()
+    r.close()
+
+
+def test_unsorted_timestamps_rejected(tmp_path):
+    w = TsmWriter(str(tmp_path / "f6.tsm"))
+    with pytest.raises(TsmError):
+        w.write_series("t", 1, np.array([5, 3, 1], dtype=np.int64), {})
+    w.abort()
+
+
+def test_corrupt_page_detected(tmp_path):
+    p = str(tmp_path / "f7.tsm")
+    _write_basic(p, n=100)
+    raw = bytearray(open(p, "rb").read())
+    raw[10] ^= 0xFF  # flip a byte inside first page
+    open(p, "wb").write(bytes(raw))
+    r = TsmReader(p)
+    from cnosdb_tpu.errors import ChecksumMismatch
+    with pytest.raises(ChecksumMismatch):
+        r.read_series_timestamps("cpu", 1)
+    r.close()
+
+
+def test_atomic_write_no_partial_file(tmp_path):
+    p = str(tmp_path / "f8.tsm")
+    w = TsmWriter(p)
+    w.write_series("t", 1, np.arange(5, dtype=np.int64), {})
+    w.abort()
+    import os
+    assert not os.path.exists(p)
+    assert not os.path.exists(p + ".tmp")
