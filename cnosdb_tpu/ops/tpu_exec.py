@@ -1,0 +1,304 @@
+"""TpuExec: the device scan-aggregate physical operator.
+
+This is the rebuild's `TpuTableProvider`/`TpuExec` (north star in
+BASELINE.json): the counterpart of the reference's TskvExec +
+AggregateFilterTskvExec + DataFusion partial AggregateExec
+(query_server/query/src/extension/physical/plan_node/tskv_exec.rs:36,
+aggregate_filter_scan.rs:27), collapsed into one fused device program per
+scanned column:
+
+    host: ScanBatch (from storage.scan) → bucket i32 / group i32 / rank i32
+    device: filter mask → segment ids → masked segment reductions
+    host: segment labels (tag values, bucket starts) + presence masking
+
+Group-by cardinality maps to segments = group × time-bucket; dense bucket
+ranges index directly, sparse ones remap through np.unique.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from ..models.schema import ValueType
+from ..storage.scan import ScanBatch
+from ..sql.expr import Expr
+from . import kernels
+
+_DENSE_BUCKET_LIMIT = 1 << 21
+
+
+@dataclass
+class AggSpec:
+    func: str               # count/count_star/sum/mean/min/max/first/last
+    column: str | None      # None for count(*)
+    alias: str
+
+    _NEEDS = {
+        "count": {"want_count": True},
+        "sum": {"want_sum": True},
+        "mean": {"want_sum": True, "want_count": True},
+        "avg": {"want_sum": True, "want_count": True},
+        "min": {"want_min": True},
+        "max": {"want_max": True},
+        "first": {"want_first": True},
+        "last": {"want_last": True},
+    }
+
+
+@dataclass
+class TpuQuery:
+    filter: Expr | None = None
+    group_tags: list[str] = field(default_factory=list)
+    time_bucket: tuple[int, int] | None = None   # (origin_ns, interval_ns)
+    aggs: list[AggSpec] = field(default_factory=list)
+
+
+@dataclass
+class AggResult:
+    """Columnar result: group label columns then one column per agg."""
+
+    columns: dict[str, np.ndarray]
+    n_rows: int
+    # per-column validity (NULL where a group had no values for that agg)
+    valid: dict[str, np.ndarray] = field(default_factory=dict)
+
+
+def execute_scan_aggregate(batch: ScanBatch, query: TpuQuery) -> AggResult:
+    n = batch.n_rows
+    if n == 0:
+        names = query.group_tags + (["time"] if query.time_bucket else []) \
+            + [a.alias for a in query.aggs]
+        return AggResult({nm: np.empty(0) for nm in names}, 0)
+
+    # ------------------------------------------------ grouping: series → group
+    if query.group_tags:
+        label_of_series = []
+        group_map: dict[tuple, int] = {}
+        for key in batch.series_keys:
+            tags = key.tag_dict() if key is not None else {}
+            label = tuple(tags.get(t) for t in query.group_tags)
+            gid = group_map.setdefault(label, len(group_map))
+            label_of_series.append(gid)
+        group_of_series = np.array(label_of_series, dtype=np.int32)
+        group_labels = [None] * len(group_map)
+        for label, gid in group_map.items():
+            group_labels[gid] = label
+        n_groups = len(group_map)
+    else:
+        group_of_series = np.zeros(batch.n_series, dtype=np.int32)
+        group_labels = [()]
+        n_groups = 1
+    group_of_row = group_of_series[batch.sid_ordinal]
+
+    # ------------------------------------------------ time buckets (host i64)
+    if query.time_bucket is not None:
+        origin, interval = query.time_bucket
+        b = (batch.ts - origin) // interval
+        bmin, bmax = int(b.min()), int(b.max())
+        span = bmax - bmin + 1
+        if span <= _DENSE_BUCKET_LIMIT:
+            bucket_ids = (b - bmin).astype(np.int32)
+            bucket_starts = origin + (bmin + np.arange(span, dtype=np.int64)) * interval
+            n_buckets = span
+        else:
+            uniq, inv = np.unique(b, return_inverse=True)
+            bucket_ids = inv.astype(np.int32)
+            bucket_starts = origin + uniq * interval
+            n_buckets = len(uniq)
+    else:
+        bucket_ids = np.zeros(n, dtype=np.int32)
+        bucket_starts = None
+        n_buckets = 1
+
+    num_segments = n_groups * n_buckets
+    seg_ids = (group_of_row.astype(np.int64) * n_buckets
+               + bucket_ids.astype(np.int64)).astype(np.int32)
+
+    # ------------------------------------------------ filter
+    row_mask = np.ones(n, dtype=bool)
+    if query.filter is not None:
+        env = _filter_env(batch)
+        has_is_null = _contains_is_null(query.filter)
+        missing = [c for c in query.filter.columns() if c not in env]
+        if missing and not has_is_null:
+            # a schema column with no data in this vnode is all-NULL here:
+            # any comparison on it matches nothing
+            row_mask = np.zeros(n, dtype=bool)
+        else:
+            for c in missing:  # IS NULL paths need the env entries
+                env[c] = np.zeros(n)
+                env[f"__valid__:{c}"] = np.zeros(n, dtype=bool)
+            row_mask = np.asarray(query.filter.eval(env, np), dtype=bool)
+            if row_mask.shape == ():  # constant predicate
+                row_mask = np.full(n, bool(row_mask))
+            # SQL three-valued logic approximation: a NULL operand makes a
+            # comparison non-matching, so rows where a referenced field is
+            # null are excluded — except under an explicit IS NULL test.
+            if not has_is_null:
+                for cname in query.filter.columns():
+                    if cname in batch.fields:
+                        row_mask &= batch.fields[cname][2]
+    seg_ids = np.where(row_mask, seg_ids, 0).astype(np.int32)
+
+    # ------------------------------------------------ rank for first/last
+    needs_rank = any(a.func in ("first", "last") for a in query.aggs)
+    if needs_rank:
+        order = np.argsort(batch.ts, kind="stable")
+        rank = np.empty(n, dtype=np.int32)
+        rank[order] = np.arange(n, dtype=np.int32)
+    else:
+        rank = np.zeros(n, dtype=np.int32)
+
+    # ------------------------------------------------ per-column kernels
+    presence = kernels.aggregate_column_host(
+        np.zeros(n, dtype=np.int64), row_mask, seg_ids, rank, num_segments,
+        {"want_count": True, "want_sum": False, "want_min": False,
+         "want_max": False})["count"]
+    present = presence > 0
+
+    col_wants: dict[str, dict] = {}
+    for a in query.aggs:
+        if a.column is None:
+            continue
+        w = col_wants.setdefault(a.column, {
+            "want_count": False, "want_sum": False, "want_min": False,
+            "want_max": False, "want_first": False, "want_last": False})
+        for k, v in AggSpec._NEEDS[a.func].items():
+            w[k] = w[k] or v
+
+    col_results: dict[str, dict] = {}
+    for cname, wants in col_wants.items():
+        if cname not in batch.fields:
+            col_results[cname] = None
+            continue
+        vt, vals, valid = batch.fields[cname]
+        if vt in (ValueType.STRING, ValueType.GEOMETRY):
+            col_results[cname] = _host_string_agg(
+                vals, valid & row_mask, seg_ids, rank, num_segments, wants)
+            continue
+        dev_vals = vals if vt != ValueType.BOOLEAN else vals.astype(np.int64)
+        col_results[cname] = kernels.aggregate_column_host(
+            dev_vals, valid & row_mask, seg_ids, rank, num_segments, wants)
+
+    # ------------------------------------------------ assemble result table
+    out_cols: dict[str, np.ndarray] = {}
+    out_valid: dict[str, np.ndarray] = {}
+    sel = np.nonzero(present)[0]
+    grp_idx = (sel // n_buckets).astype(np.int64)
+    bkt_idx = (sel % n_buckets).astype(np.int64)
+    for i, t in enumerate(query.group_tags):
+        out_cols[t] = np.array([group_labels[g][i] for g in grp_idx], dtype=object)
+    if bucket_starts is not None:
+        out_cols["time"] = bucket_starts[bkt_idx]
+
+    for a in query.aggs:
+        if a.column is None:
+            out_cols[a.alias] = presence[sel]
+            continue
+        r = col_results.get(a.column)
+        if r is None:
+            if a.func == "count":  # COUNT of an absent column is 0, never NULL
+                out_cols[a.alias] = np.zeros(len(sel), dtype=np.int64)
+            else:
+                out_cols[a.alias] = np.zeros(len(sel))
+                out_valid[a.alias] = np.zeros(len(sel), dtype=bool)
+            continue
+        cnt = r.get("count")
+        if a.func == "count":
+            out_cols[a.alias] = cnt[sel]
+        elif a.func in ("mean", "avg"):
+            c = cnt[sel]
+            s = r["sum"][sel].astype(np.float64)
+            with np.errstate(invalid="ignore", divide="ignore"):
+                out_cols[a.alias] = np.where(c > 0, s / np.maximum(c, 1), np.nan)
+            out_valid[a.alias] = c > 0
+        elif a.func == "sum":
+            have = _have_values(r, sel, batch, a.column, seg_ids, row_mask, num_segments)
+            out_cols[a.alias] = r["sum"][sel]
+            out_valid[a.alias] = have
+        elif a.func in ("min", "max", "first", "last"):
+            have = _have_values(r, sel, batch, a.column, seg_ids, row_mask, num_segments)
+            out_cols[a.alias] = r[a.func][sel]
+            out_valid[a.alias] = have
+    return AggResult(out_cols, len(sel), out_valid)
+
+
+def _have_values(r, sel, batch, column, seg_ids, row_mask, num_segments):
+    cnt = r.get("count")
+    if cnt is None:
+        vt, vals, valid = batch.fields[column]
+        cnt = kernels.aggregate_column_host(
+            np.zeros(len(seg_ids), dtype=np.int64), valid & row_mask, seg_ids,
+            np.zeros(len(seg_ids), dtype=np.int32), num_segments,
+            {"want_count": True, "want_sum": False, "want_min": False,
+             "want_max": False})["count"]
+        r["count"] = cnt
+    return cnt[sel] > 0
+
+
+def _contains_is_null(e) -> bool:
+    from ..sql.expr import IsNull
+
+    if isinstance(e, IsNull):
+        return True
+    for attr in ("left", "right", "operand", "expr", "low", "high"):
+        sub = getattr(e, attr, None)
+        if isinstance(sub, Expr) and _contains_is_null(sub):
+            return True
+    args = getattr(e, "args", None)
+    if args:
+        return any(_contains_is_null(a) for a in args)
+    return False
+
+
+def _filter_env(batch: ScanBatch) -> dict:
+    env: dict = {"time": batch.ts}
+    for name, (vt, vals, valid) in batch.fields.items():
+        env[name] = vals
+        env[f"__valid__:{name}"] = valid
+    # tag columns expand per-row from series keys
+    tag_names = set()
+    for k in batch.series_keys:
+        if k is not None:
+            tag_names.update(t.key for t in k.tags)
+    for t in tag_names:
+        per_series = np.array(
+            [(k.tag_value(t) if k is not None else None) for k in batch.series_keys],
+            dtype=object)
+        env[t] = per_series[batch.sid_ordinal]
+    return env
+
+
+def _host_string_agg(vals, valid, seg_ids, rank, num_segments, wants):
+    """String columns aggregate host-side (count/first/last/min/max)."""
+    out = {}
+    count = np.zeros(num_segments, dtype=np.int64)
+    np.add.at(count, seg_ids[valid], 1)
+    out["count"] = count
+    if wants.get("want_min") or wants.get("want_max"):
+        mins = np.empty(num_segments, dtype=object)
+        maxs = np.empty(num_segments, dtype=object)
+        for i in np.nonzero(valid)[0]:
+            s = seg_ids[i]
+            v = vals[i]
+            if mins[s] is None or v < mins[s]:
+                mins[s] = v
+            if maxs[s] is None or v > maxs[s]:
+                maxs[s] = v
+        out["min"], out["max"] = mins, maxs
+    if wants.get("want_first") or wants.get("want_last"):
+        fr = np.full(num_segments, 2**31 - 1, dtype=np.int64)
+        lr = np.full(num_segments, -(2**31), dtype=np.int64)
+        fv = np.empty(num_segments, dtype=object)
+        lv = np.empty(num_segments, dtype=object)
+        for i in np.nonzero(valid)[0]:
+            s = seg_ids[i]
+            if rank[i] < fr[s]:
+                fr[s] = rank[i]; fv[s] = vals[i]
+            if rank[i] > lr[s]:
+                lr[s] = rank[i]; lv[s] = vals[i]
+        out["first"], out["last"] = fv, lv
+    if wants.get("want_sum"):
+        out["sum"] = np.zeros(num_segments)
+    return out

@@ -1,0 +1,213 @@
+"""Coordinator: routes writes to placed vnodes, fans scans out over them.
+
+Role-parity with the reference's Coordinator trait / CoordService
+(coordinator/src/lib.rs:56-140, service.rs:548-834): write_points splits a
+WriteBatch per (bucket by timestamp → shard by series hash) placement from
+meta, and table_vnodes enumerates the vnodes a predicate's time ranges
+touch. In this single-process round every placed vnode is local; the
+seams where gRPC fan-out goes later are `_write_vnode` / `scan_table`.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+from ..models.points import SeriesRows, WriteBatch
+from ..models.predicate import ColumnDomains, TimeRanges
+from ..models.schema import TskvTableSchema, ValueType
+from ..storage.engine import TsKv
+from ..storage.scan import ScanBatch, scan_vnode
+from .meta import MetaStore
+
+
+@dataclass
+class PlacedSplit:
+    """One scan unit: a vnode plus the predicate pushed to it
+    (reference data_source/split/mod.rs PlacedSplit)."""
+
+    owner: str
+    vnode_id: int
+    table: str
+    time_ranges: TimeRanges
+    tag_domains: ColumnDomains
+
+
+class Coordinator:
+    def __init__(self, meta: MetaStore, engine: TsKv):
+        self.meta = meta
+        self.engine = engine
+        # schema auto-creation callbacks land on meta; keep engine's view hot
+        meta.watch(self._on_meta_event)
+
+    def _on_meta_event(self, event: str, payload: dict):
+        if event in ("create_table", "update_table"):
+            owner = payload["owner"]
+            tenant, db = owner.split(".", 1)
+            schema = self.meta.table_opt(tenant, db, payload["table"])
+            if schema is not None:
+                self.engine.set_table_schema(owner, schema)
+        elif event == "drop_table":
+            self.engine.drop_table(payload["owner"], payload["table"])
+        elif event == "drop_db":
+            self.engine.drop_database(payload["owner"])
+
+    # ---------------------------------------------------------------- write
+    def write_points(self, tenant: str, db: str, batch: WriteBatch,
+                     sync: bool = False):
+        """Split per placement and write each vnode group
+        (reference service.rs:565 write_lines)."""
+        owner = f"{tenant}.{db}"
+        self.meta.database(tenant, db)  # raises if missing
+        per_vnode: dict[int, WriteBatch] = {}
+        for table, series_list in batch.tables.items():
+            self._ensure_schema(tenant, db, table, series_list)
+            for sr in series_list:
+                groups = self._split_series_by_bucket(tenant, db, sr)
+                for vnode_id, sub in groups:
+                    per_vnode.setdefault(vnode_id, WriteBatch()).add_series(table, sub)
+        for vnode_id, sub_batch in per_vnode.items():
+            self._write_vnode(owner, vnode_id, sub_batch, sync)
+
+    def _split_series_by_bucket(self, tenant: str, db: str, sr: SeriesRows):
+        """A series' rows can straddle buckets; split rows by bucket then
+        route to `shard = hash % shard_num` within each."""
+        h = sr.key.hash_id()
+        if not sr.timestamps:
+            return []
+        # fast path: whole series fits one bucket (the common case)
+        lo, hi = min(sr.timestamps), max(sr.timestamps)
+        b_lo = self.meta.locate_bucket_for_write(tenant, db, lo)
+        if b_lo.contains(hi):
+            return [(b_lo.vnode_for(h).leader_vnode_id, sr)]
+        vnode_rows: dict[int, list[int]] = {}
+        for i, ts in enumerate(sr.timestamps):
+            bucket = self.meta.locate_bucket_for_write(tenant, db, ts)
+            rs = bucket.vnode_for(h)
+            vnode_rows.setdefault(rs.leader_vnode_id, []).append(i)
+        out = []
+        for vnode_id, idxs in vnode_rows.items():
+            if len(idxs) == len(sr.timestamps):
+                out.append((vnode_id, sr))
+            else:
+                sub = SeriesRows(
+                    sr.key, [sr.timestamps[i] for i in idxs],
+                    {k: (vt, [vals[i] for i in idxs])
+                     for k, (vt, vals) in sr.fields.items()})
+                out.append((vnode_id, sub))
+        return out
+
+    def _write_vnode(self, owner: str, vnode_id: int, batch: WriteBatch,
+                     sync: bool):
+        self.engine.write(owner, vnode_id, batch, sync=sync)
+
+    def _ensure_schema(self, tenant: str, db: str, table: str,
+                       series_list: list[SeriesRows]):
+        """Auto-create/evolve the table schema from incoming points
+        (reference database.rs build_write_group schema inference)."""
+        schema = self.meta.table_opt(tenant, db, table)
+        if schema is None:
+            tags = sorted({t.key for sr in series_list for t in sr.key.tags})
+            fields = {}
+            for sr in series_list:
+                for name, (vt, _vals) in sr.fields.items():
+                    fields.setdefault(name, ValueType(vt))
+            schema = TskvTableSchema.new_measurement(
+                tenant, db, table, tags, sorted(fields.items()),
+                precision=self.meta.database(tenant, db).options.precision)
+            self.meta.create_table(schema, if_not_exists=True)
+            return
+        from ..models.schema import ColumnType
+
+        changed = False
+        for sr in series_list:
+            for t in sr.key.tags:
+                if not schema.contains_column(t.key):
+                    schema.add_column(t.key, ColumnType.tag())
+                    changed = True
+            for name, (vt, _vals) in sr.fields.items():
+                if not schema.contains_column(name):
+                    schema.add_column(name, ColumnType.field(ValueType(vt)))
+                    changed = True
+        if changed:
+            self.meta.update_table(schema)
+
+    # ---------------------------------------------------------------- read
+    def table_vnodes(self, tenant: str, db: str, table: str,
+                     time_ranges: TimeRanges,
+                     tag_domains: ColumnDomains) -> list[PlacedSplit]:
+        """Predicate → splits (reference SplitManager::splits +
+        coord.table_vnodes)."""
+        owner = f"{tenant}.{db}"
+        lo = None if time_ranges.is_all else time_ranges.min_ts
+        hi = None if time_ranges.is_all else time_ranges.max_ts
+        splits = []
+        seen = set()
+        for bucket in self.meta.buckets_for(tenant, db, lo, hi):
+            for rs in bucket.shard_group:
+                if rs.leader_vnode_id in seen:
+                    continue
+                seen.add(rs.leader_vnode_id)
+                splits.append(PlacedSplit(owner, rs.leader_vnode_id, table,
+                                          time_ranges, tag_domains))
+        return splits
+
+    def scan_table(self, tenant: str, db: str, table: str,
+                   time_ranges: TimeRanges | None = None,
+                   tag_domains: ColumnDomains | None = None,
+                   field_names: list[str] | None = None) -> list[ScanBatch]:
+        """Fan a scan out over placed vnodes → one ScanBatch per vnode."""
+        trs = time_ranges or TimeRanges.all()
+        doms = tag_domains or ColumnDomains.all()
+        batches = []
+        for split in self.table_vnodes(tenant, db, table, trs, doms):
+            v = self.engine.vnode(split.owner, split.vnode_id)
+            if v is None:
+                continue
+            sids = None
+            if not doms.is_all:
+                sids = v.index.get_series_ids_by_domains(table, doms)
+                if len(sids) == 0:
+                    continue
+            b = scan_vnode(v, table, series_ids=sids, time_ranges=trs,
+                           field_names=field_names)
+            if b.n_rows:
+                batches.append(b)
+        return batches
+
+    # ---------------------------------------------------------------- admin
+    def drop_table(self, tenant: str, db: str, table: str):
+        self.meta.drop_table(tenant, db, table)
+
+    def drop_database(self, tenant: str, db: str):
+        self.meta.drop_database(tenant, db)
+
+    def delete_from_table(self, tenant: str, db: str, table: str,
+                          tag_domains: ColumnDomains, min_ts: int, max_ts: int):
+        owner = f"{tenant}.{db}"
+        for v in self.engine.local_vnodes(owner):
+            sids = None
+            if not tag_domains.is_all:
+                sids = v.index.get_series_ids_by_domains(table, tag_domains)
+                if len(sids) == 0:
+                    continue
+            v.delete_time_range(table, sids, min_ts, max_ts)
+
+    def tag_values(self, tenant: str, db: str, table: str, tag_key: str) -> list[str]:
+        owner = f"{tenant}.{db}"
+        out = set()
+        for v in self.engine.local_vnodes(owner):
+            out.update(v.index.tag_values(table, tag_key))
+        return sorted(out)
+
+    def series_keys(self, tenant: str, db: str, table: str,
+                    tag_domains: ColumnDomains | None = None) -> list:
+        owner = f"{tenant}.{db}"
+        doms = tag_domains or ColumnDomains.all()
+        keys = {}
+        for v in self.engine.local_vnodes(owner):
+            for sid in v.index.get_series_ids_by_domains(table, doms):
+                k = v.index.get_series_key(int(sid))
+                if k is not None:
+                    keys[(k.table, k.tags)] = k
+        return [keys[k] for k in sorted(keys)]

@@ -1,0 +1,86 @@
+"""Distributed scan aggregation: shard_map partials + ICI collectives.
+
+The multi-chip form of ops.kernels.segment_aggregate (SURVEY §2.4
+"Partial-agg distribution"): rows are sharded over the mesh axis, every
+device reduces its shard into [num_segments] partials in one fused
+program, then count/sum combine with `psum`, min/max with `pmin`/`pmax`,
+and first/last resolve by all-gathering the per-device (rank, value)
+candidates and selecting the global arg-min/max — all inside the same jit,
+so XLA schedules compute and ICI traffic together. Output is replicated
+(P()) on every device.
+"""
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+from .. import ops as _ops  # noqa: F401 - x64 config side effect
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax import shard_map
+
+from ..ops.kernels import local_segment_partials, pad_rows, pad_segments, _pad
+from .mesh import SHARD_AXIS, mesh_size
+
+
+@functools.partial(
+    jax.jit, static_argnames=("mesh", "num_segments", "want_first", "want_last"))
+def _dist_kernel(values, valid, seg_ids, rank, *, mesh: Mesh,
+                 num_segments: int, want_first: bool, want_last: bool):
+    def body(v, m, s, r):
+        local = local_segment_partials(
+            v, m, s, r, num_segments=num_segments,
+            want_first=want_first, want_last=want_last)
+        out = {
+            "count": jax.lax.psum(local["count"], SHARD_AXIS),
+            "sum": jax.lax.psum(local["sum"], SHARD_AXIS),
+            "min": jax.lax.pmin(local["min"], SHARD_AXIS),
+            "max": jax.lax.pmax(local["max"], SHARD_AXIS),
+        }
+        if want_first:
+            ranks = jax.lax.all_gather(local["first_rank"], SHARD_AXIS)  # [D,S]
+            vals = jax.lax.all_gather(local["first"], SHARD_AXIS)
+            dev = jnp.argmin(ranks, axis=0)
+            out["first"] = jnp.take_along_axis(vals, dev[None, :], axis=0)[0]
+            out["first_rank"] = jnp.min(ranks, axis=0)
+        if want_last:
+            ranks = jax.lax.all_gather(local["last_rank"], SHARD_AXIS)
+            vals = jax.lax.all_gather(local["last"], SHARD_AXIS)
+            dev = jnp.argmax(ranks, axis=0)
+            out["last"] = jnp.take_along_axis(vals, dev[None, :], axis=0)[0]
+            out["last_rank"] = jnp.max(ranks, axis=0)
+        return out
+
+    fn = shard_map(
+        body, mesh=mesh,
+        in_specs=(P(SHARD_AXIS), P(SHARD_AXIS), P(SHARD_AXIS), P(SHARD_AXIS)),
+        out_specs=P(), check_vma=False)
+    return fn(values, valid, seg_ids, rank)
+
+
+def distributed_aggregate_host(values: np.ndarray, valid: np.ndarray,
+                               seg_ids: np.ndarray, rank: np.ndarray,
+                               num_segments: int, mesh: Mesh,
+                               want_first: bool = False,
+                               want_last: bool = False) -> dict:
+    """Host wrapper: pad rows to devices × size class, shard, run, fetch."""
+    n = len(values)
+    d = mesh_size(mesh)
+    np_pad = pad_rows(max(n, 1))
+    if np_pad % d:
+        np_pad = ((np_pad + d - 1) // d) * d
+    ns_pad = pad_segments(max(num_segments, 1))
+    values = _pad(values, np_pad)
+    valid = _pad(valid, np_pad, fill=False)
+    seg_ids = _pad(seg_ids, np_pad, fill=0)
+    rank = _pad(rank, np_pad, fill=0)
+    sharding = NamedSharding(mesh, P(SHARD_AXIS))
+    dv = jax.device_put(values, sharding)
+    dm = jax.device_put(valid, sharding)
+    ds = jax.device_put(seg_ids, sharding)
+    dr = jax.device_put(rank, sharding)
+    out = _dist_kernel(dv, dm, ds, dr, mesh=mesh, num_segments=ns_pad,
+                       want_first=want_first, want_last=want_last)
+    return {k: np.asarray(v)[:num_segments] for k, v in out.items()}

@@ -1,0 +1,29 @@
+"""Device mesh management.
+
+The rebuild's answer to the reference's scan fan-out + NCCL-style backend
+(SURVEY §2.4): rows shard across a 1-D `jax.sharding.Mesh` axis ("shard"),
+partial aggregates combine over ICI collectives. Multi-host extends the
+same mesh across processes (jax distributed init), with DCN handled by XLA.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .. import ops as _ops  # noqa: F401 - x64 config side effect
+import jax
+from jax.sharding import Mesh
+
+SHARD_AXIS = "shard"
+
+
+def make_mesh(n_devices: int | None = None) -> Mesh:
+    devs = jax.devices()
+    if n_devices is not None:
+        if n_devices > len(devs):
+            raise ValueError(f"requested {n_devices} devices, have {len(devs)}")
+        devs = devs[:n_devices]
+    return Mesh(np.array(devs), (SHARD_AXIS,))
+
+
+def mesh_size(mesh: Mesh) -> int:
+    return mesh.shape[SHARD_AXIS]

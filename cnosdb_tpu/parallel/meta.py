@@ -1,0 +1,314 @@
+"""Meta store: cluster catalog + placement.
+
+Role-parity with the reference's meta crate (meta/src/model/meta_admin.rs
+AdminMeta + meta_tenant.rs TenantMeta + store/storage.rs state machine):
+tenants, databases, table schemas, buckets/replica-sets/vnode placement,
+users/roles. The reference runs this as its own single-group raft cluster
+over HTTP watch; here it is a process-local store with a durable JSON
+snapshot (atomic rewrite per mutation — meta mutations are rare), designed
+so the same API can later front a replicated backend without callers
+changing.
+
+Placement (reference meta_tenant.rs:562 create_bucket, :716
+locate_replication_set_for_write): a write at ts t lands in the bucket
+covering t (auto-created, duration = db.vnode_duration), within it in shard
+`series_hash % shard_num`.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+
+from ..errors import (
+    DatabaseAlreadyExists, DatabaseNotFound, MetaError, TableAlreadyExists,
+    TableNotFound, TenantNotFound,
+)
+from ..models.meta_data import BucketInfo, NodeInfo, ReplicationSet, VnodeInfo
+from ..models.schema import (
+    DatabaseOptions, DatabaseSchema, TenantOptions, TskvTableSchema,
+)
+
+DEFAULT_TENANT = "cnosdb"
+DEFAULT_DATABASE = "public"
+USAGE_SCHEMA = "usage_schema"
+
+
+class MetaStore:
+    def __init__(self, path: str | None = None, node_id: int = 1):
+        self.path = path
+        self.node_id = node_id
+        self.lock = threading.RLock()
+        self.tenants: dict[str, TenantOptions] = {}
+        self.users: dict[str, dict] = {}
+        self.databases: dict[str, DatabaseSchema] = {}          # owner → schema
+        self.tables: dict[str, dict[str, TskvTableSchema]] = {}  # owner → {table}
+        self.buckets: dict[str, list[BucketInfo]] = {}           # owner → buckets
+        self.nodes: dict[int, NodeInfo] = {node_id: NodeInfo(node_id)}
+        self._next_bucket_id = 1
+        self._next_replica_id = 1
+        self._next_vnode_id = 1
+        self._watchers: list = []
+        if path and os.path.exists(path):
+            self._load()
+        else:
+            self._bootstrap()
+            self._persist()
+
+    # ------------------------------------------------------------ durability
+    def _bootstrap(self):
+        self.tenants[DEFAULT_TENANT] = TenantOptions(comment="system tenant")
+        self.users["root"] = {"password": "", "admin": True, "comment": "system admin"}
+        for db in (DEFAULT_DATABASE, USAGE_SCHEMA):
+            schema = DatabaseSchema(DEFAULT_TENANT, db, DatabaseOptions())
+            self.databases[schema.owner] = schema
+            self.tables.setdefault(schema.owner, {})
+            self.buckets.setdefault(schema.owner, [])
+
+    def _to_dict(self) -> dict:
+        return {
+            "tenants": {k: v.to_dict() for k, v in self.tenants.items()},
+            "users": self.users,
+            "databases": {k: v.to_dict() for k, v in self.databases.items()},
+            "tables": {o: {t: s.to_dict() for t, s in ts.items()}
+                       for o, ts in self.tables.items()},
+            "buckets": {o: [b.to_dict() for b in bs] for o, bs in self.buckets.items()},
+            "nodes": {str(k): v.to_dict() for k, v in self.nodes.items()},
+            "next_ids": [self._next_bucket_id, self._next_replica_id, self._next_vnode_id],
+        }
+
+    def _persist(self):
+        if not self.path:
+            return
+        tmp = self.path + ".tmp"
+        os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
+        with open(tmp, "w") as f:
+            json.dump(self._to_dict(), f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self.path)
+
+    def _load(self):
+        with open(self.path) as f:
+            d = json.load(f)
+        self.tenants = {k: TenantOptions.from_dict(v) for k, v in d["tenants"].items()}
+        self.users = d["users"]
+        self.databases = {k: DatabaseSchema.from_dict(v) for k, v in d["databases"].items()}
+        self.tables = {o: {t: TskvTableSchema.from_dict(s) for t, s in ts.items()}
+                       for o, ts in d["tables"].items()}
+        self.buckets = {o: [BucketInfo.from_dict(b) for b in bs]
+                        for o, bs in d["buckets"].items()}
+        self.nodes = {int(k): NodeInfo.from_dict(v) for k, v in d["nodes"].items()}
+        self._next_bucket_id, self._next_replica_id, self._next_vnode_id = d["next_ids"]
+
+    def _notify(self, event: str, **kw):
+        for w in list(self._watchers):
+            try:
+                w(event, kw)
+            except Exception:
+                pass
+
+    def watch(self, callback):
+        """callback(event:str, payload:dict) on every meta mutation
+        (reference watch long-poll, meta/src/service/http.rs /watch)."""
+        self._watchers.append(callback)
+
+    # ------------------------------------------------------------ tenants/users
+    def create_tenant(self, name: str, options: TenantOptions | None = None):
+        with self.lock:
+            if name in self.tenants:
+                raise MetaError(f"tenant {name!r} exists")
+            self.tenants[name] = options or TenantOptions()
+            self._persist()
+            self._notify("create_tenant", tenant=name)
+
+    def drop_tenant(self, name: str):
+        with self.lock:
+            if name == DEFAULT_TENANT:
+                raise MetaError("cannot drop system tenant")
+            self.tenants.pop(name, None)
+            dropped = [o for o in self.databases if o.startswith(name + ".")]
+            for owner in dropped:
+                self.databases.pop(owner, None)
+                self.tables.pop(owner, None)
+                self.buckets.pop(owner, None)
+            self._persist()
+            # per-db events so the engine reclaims vnodes + disk for each
+            for owner in dropped:
+                self._notify("drop_db", owner=owner)
+            self._notify("drop_tenant", tenant=name)
+
+    def create_user(self, name: str, password: str = "", admin: bool = False,
+                    comment: str = ""):
+        with self.lock:
+            if name in self.users:
+                raise MetaError(f"user {name!r} exists")
+            self.users[name] = {"password": password, "admin": admin, "comment": comment}
+            self._persist()
+
+    def drop_user(self, name: str):
+        with self.lock:
+            if name == "root":
+                raise MetaError("cannot drop root")
+            self.users.pop(name, None)
+            self._persist()
+
+    def alter_user(self, name: str, password: str | None = None):
+        with self.lock:
+            if name not in self.users:
+                raise MetaError(f"user {name!r} missing")
+            if password is not None:
+                self.users[name]["password"] = password
+            self._persist()
+
+    # ------------------------------------------------------------ databases
+    def create_database(self, schema: DatabaseSchema, if_not_exists: bool = False):
+        with self.lock:
+            if schema.tenant not in self.tenants:
+                raise TenantNotFound(schema.tenant)
+            if schema.owner in self.databases:
+                if if_not_exists:
+                    return
+                raise DatabaseAlreadyExists(schema.name)
+            self.databases[schema.owner] = schema
+            self.tables.setdefault(schema.owner, {})
+            self.buckets.setdefault(schema.owner, [])
+            self._persist()
+            self._notify("create_db", owner=schema.owner)
+
+    def alter_database(self, tenant: str, db: str, **opts):
+        with self.lock:
+            schema = self.database(tenant, db)
+            for k, v in opts.items():
+                if v is not None:
+                    setattr(schema.options, k, v)
+            self._persist()
+            self._notify("alter_db", owner=schema.owner)
+
+    def drop_database(self, tenant: str, db: str, if_exists: bool = True):
+        with self.lock:
+            owner = f"{tenant}.{db}"
+            if owner not in self.databases:
+                if if_exists:
+                    return
+                raise DatabaseNotFound(db)
+            del self.databases[owner]
+            self.tables.pop(owner, None)
+            self.buckets.pop(owner, None)
+            self._persist()
+            self._notify("drop_db", owner=owner)
+
+    def database(self, tenant: str, db: str) -> DatabaseSchema:
+        owner = f"{tenant}.{db}"
+        schema = self.databases.get(owner)
+        if schema is None:
+            raise DatabaseNotFound(db)
+        return schema
+
+    def list_databases(self, tenant: str) -> list[str]:
+        pre = tenant + "."
+        return sorted(o[len(pre):] for o in self.databases if o.startswith(pre))
+
+    # ------------------------------------------------------------ tables
+    def create_table(self, schema: TskvTableSchema, if_not_exists: bool = False):
+        with self.lock:
+            owner = f"{schema.tenant}.{schema.db}"
+            if owner not in self.databases:
+                raise DatabaseNotFound(schema.db)
+            tbls = self.tables.setdefault(owner, {})
+            if schema.name in tbls:
+                if if_not_exists:
+                    return
+                raise TableAlreadyExists(schema.name)
+            tbls[schema.name] = schema
+            self._persist()
+            self._notify("create_table", owner=owner, table=schema.name)
+
+    def update_table(self, schema: TskvTableSchema):
+        with self.lock:
+            owner = f"{schema.tenant}.{schema.db}"
+            self.tables.setdefault(owner, {})[schema.name] = schema
+            self._persist()
+            self._notify("update_table", owner=owner, table=schema.name)
+
+    def drop_table(self, tenant: str, db: str, table: str, if_exists: bool = True):
+        with self.lock:
+            owner = f"{tenant}.{db}"
+            tbls = self.tables.get(owner, {})
+            if table not in tbls:
+                if if_exists:
+                    return
+                raise TableNotFound(table)
+            del tbls[table]
+            self._persist()
+            self._notify("drop_table", owner=owner, table=table)
+
+    def table(self, tenant: str, db: str, table: str) -> TskvTableSchema:
+        owner = f"{tenant}.{db}"
+        s = self.tables.get(owner, {}).get(table)
+        if s is None:
+            raise TableNotFound(table)
+        return s
+
+    def table_opt(self, tenant: str, db: str, table: str) -> TskvTableSchema | None:
+        return self.tables.get(f"{tenant}.{db}", {}).get(table)
+
+    def list_tables(self, tenant: str, db: str) -> list[str]:
+        return sorted(self.tables.get(f"{tenant}.{db}", {}).keys())
+
+    # ------------------------------------------------------------ placement
+    def locate_bucket_for_write(self, tenant: str, db: str, ts: int) -> BucketInfo:
+        """Find-or-create the bucket covering ts (reference
+        meta_tenant.rs:716)."""
+        with self.lock:
+            owner = f"{tenant}.{db}"
+            schema = self.database(tenant, db)
+            for b in self.buckets.get(owner, []):
+                if b.contains(ts):
+                    return b
+            dur = schema.options.vnode_duration.ns or 365 * 86_400_000_000_000
+            start = (ts // dur) * dur if ts >= 0 else -((-ts + dur - 1) // dur) * dur
+            bucket = BucketInfo(self._next_bucket_id, start, start + dur, [])
+            self._next_bucket_id += 1
+            for _ in range(max(1, schema.options.shard_num)):
+                vnodes = [VnodeInfo(self._next_vnode_id + i, self.node_id)
+                          for i in range(max(1, schema.options.replica))]
+                self._next_vnode_id += len(vnodes)
+                rs = ReplicationSet(self._next_replica_id, self.node_id,
+                                    vnodes[0].id, vnodes)
+                self._next_replica_id += 1
+                bucket.shard_group.append(rs)
+            self.buckets.setdefault(owner, []).append(bucket)
+            self.buckets[owner].sort(key=lambda b: b.start_time)
+            self._persist()
+            self._notify("create_bucket", owner=owner, bucket_id=bucket.id)
+            return bucket
+
+    def buckets_for(self, tenant: str, db: str,
+                    min_ts: int | None = None, max_ts: int | None = None) -> list[BucketInfo]:
+        owner = f"{tenant}.{db}"
+        out = []
+        for b in self.buckets.get(owner, []):
+            if min_ts is not None and b.end_time <= min_ts:
+                continue
+            if max_ts is not None and b.start_time > max_ts:
+                continue
+            out.append(b)
+        return out
+
+    def expire_buckets(self, tenant: str, db: str, now_ns: int) -> list[BucketInfo]:
+        """TTL expiry (reference meta_admin.rs:848 expired_bucket)."""
+        with self.lock:
+            schema = self.database(tenant, db)
+            if schema.options.ttl.is_inf:
+                return []
+            cutoff = now_ns - schema.options.ttl.ns
+            owner = f"{tenant}.{db}"
+            expired = [b for b in self.buckets.get(owner, []) if b.end_time <= cutoff]
+            if expired:
+                self.buckets[owner] = [b for b in self.buckets[owner]
+                                       if b.end_time > cutoff]
+                self._persist()
+                self._notify("expire_buckets", owner=owner,
+                             bucket_ids=[b.id for b in expired])
+            return expired

@@ -1,0 +1,317 @@
+"""Expression IR.
+
+The SQL layer plans WHERE/SELECT expressions into this tree; it evaluates
+under EITHER numpy (host pre-filtering, string columns) or jax.numpy
+(device filtering inside the fused scan kernel) via the `xp` module
+parameter — one IR, two execution targets, no translation layer. Mirrors
+the role of DataFusion's PhysicalExpr in the reference's scan filter
+(tskv/src/reader/filter.rs) and domain extraction
+(common/models/src/predicate/domain.rs push_down_filter).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any
+
+import numpy as np
+
+from ..errors import PlanError
+from ..models.predicate import (
+    AllDomain, ColumnDomains, NoneDomain, RangeDomain, SetDomain,
+)
+
+
+class Expr:
+    def eval(self, env: dict, xp) -> Any:
+        raise NotImplementedError
+
+    def columns(self) -> set[str]:
+        return set()
+
+    def __repr__(self):
+        return self.to_sql()
+
+    def to_sql(self) -> str:
+        raise NotImplementedError
+
+
+@dataclass(repr=False)
+class Column(Expr):
+    name: str
+
+    def eval(self, env, xp):
+        if self.name not in env:
+            raise PlanError(f"unknown column {self.name!r}")
+        return env[self.name]
+
+    def columns(self):
+        return {self.name}
+
+    def to_sql(self):
+        return self.name
+
+
+@dataclass(repr=False)
+class Literal(Expr):
+    value: Any
+
+    def eval(self, env, xp):
+        return self.value
+
+    def to_sql(self):
+        if isinstance(self.value, str):
+            return "'" + self.value.replace("'", "''") + "'"
+        if self.value is None:
+            return "NULL"
+        if isinstance(self.value, bool):
+            return "TRUE" if self.value else "FALSE"
+        return str(self.value)
+
+
+_BIN_OPS = {
+    "+": lambda xp, a, b: a + b,
+    "-": lambda xp, a, b: a - b,
+    "*": lambda xp, a, b: a * b,
+    "/": lambda xp, a, b: _div(xp, a, b),
+    "%": lambda xp, a, b: xp.mod(a, b),
+    "=": lambda xp, a, b: _eq(xp, a, b),
+    "!=": lambda xp, a, b: ~_eq(xp, a, b),
+    "<": lambda xp, a, b: a < b,
+    "<=": lambda xp, a, b: a <= b,
+    ">": lambda xp, a, b: a > b,
+    ">=": lambda xp, a, b: a >= b,
+    "and": lambda xp, a, b: a & b,
+    "or": lambda xp, a, b: a | b,
+}
+
+
+def _div(xp, a, b):
+    # SQL division: integer/integer stays integral in CnosDB? DataFusion
+    # yields float for `/` on floats, trunc-div on ints. Follow DataFusion.
+    a_int = _is_int(a) and _is_int(b)
+    if a_int:
+        return xp.where(b != 0, a // xp.where(b == 0, 1, b), 0)
+    return a / b
+
+
+def _is_int(v):
+    if isinstance(v, (int, np.integer)) and not isinstance(v, bool):
+        return True
+    dt = getattr(v, "dtype", None)
+    return dt is not None and np.issubdtype(dt, np.integer)
+
+
+def _eq(xp, a, b):
+    return a == b
+
+
+@dataclass(repr=False)
+class BinOp(Expr):
+    op: str
+    left: Expr
+    right: Expr
+
+    def eval(self, env, xp):
+        f = _BIN_OPS.get(self.op)
+        if f is None:
+            raise PlanError(f"unknown operator {self.op!r}")
+        return f(xp, self.left.eval(env, xp), self.right.eval(env, xp))
+
+    def columns(self):
+        return self.left.columns() | self.right.columns()
+
+    def to_sql(self):
+        op = self.op.upper() if self.op in ("and", "or") else self.op
+        return f"({self.left.to_sql()} {op} {self.right.to_sql()})"
+
+
+@dataclass(repr=False)
+class UnaryOp(Expr):
+    op: str  # 'not' | '-'
+    operand: Expr
+
+    def eval(self, env, xp):
+        v = self.operand.eval(env, xp)
+        if self.op == "not":
+            return ~v
+        if self.op == "-":
+            return -v
+        raise PlanError(f"unknown unary {self.op!r}")
+
+    def columns(self):
+        return self.operand.columns()
+
+    def to_sql(self):
+        return f"({'NOT ' if self.op == 'not' else '-'}{self.operand.to_sql()})"
+
+
+@dataclass(repr=False)
+class InList(Expr):
+    expr: Expr
+    values: list
+    negated: bool = False
+
+    def eval(self, env, xp):
+        v = self.expr.eval(env, xp)
+        m = None
+        for lit in self.values:
+            c = _eq(xp, v, lit)
+            m = c if m is None else (m | c)
+        if m is None:
+            m = xp.zeros(getattr(v, "shape", (1,)), dtype=bool)
+        return ~m if self.negated else m
+
+    def columns(self):
+        return self.expr.columns()
+
+    def to_sql(self):
+        vals = ", ".join(Literal(v).to_sql() for v in self.values)
+        neg = " NOT" if self.negated else ""
+        return f"({self.expr.to_sql()}{neg} IN ({vals}))"
+
+
+@dataclass(repr=False)
+class Between(Expr):
+    expr: Expr
+    low: Expr
+    high: Expr
+    negated: bool = False
+
+    def eval(self, env, xp):
+        v = self.expr.eval(env, xp)
+        m = (v >= self.low.eval(env, xp)) & (v <= self.high.eval(env, xp))
+        return ~m if self.negated else m
+
+    def columns(self):
+        return self.expr.columns() | self.low.columns() | self.high.columns()
+
+    def to_sql(self):
+        neg = " NOT" if self.negated else ""
+        return f"({self.expr.to_sql()}{neg} BETWEEN {self.low.to_sql()} AND {self.high.to_sql()})"
+
+
+@dataclass(repr=False)
+class IsNull(Expr):
+    expr: Expr
+    negated: bool = False
+
+    def eval(self, env, xp):
+        # validity masks ride in env under '__valid__:<col>'
+        cols = self.expr.columns()
+        if len(cols) == 1:
+            key = f"__valid__:{next(iter(cols))}"
+            if key in env:
+                valid = env[key]
+                return valid if self.negated else ~valid
+        v = self.expr.eval(env, xp)
+        if getattr(v, "dtype", None) is not None and v.dtype.kind == "f":
+            m = xp.isnan(v)
+        else:
+            m = xp.zeros(getattr(v, "shape", (1,)), dtype=bool)
+        return ~m if self.negated else m
+
+    def columns(self):
+        return self.expr.columns()
+
+    def to_sql(self):
+        neg = " NOT" if self.negated else ""
+        return f"({self.expr.to_sql()} IS{neg} NULL)"
+
+
+@dataclass(repr=False)
+class Func(Expr):
+    """Scalar function call evaluated row-wise (abs, floor, ceil, sqrt...)."""
+
+    name: str
+    args: list
+
+    _FUNCS = {
+        "abs": lambda xp, a: xp.abs(a),
+        "floor": lambda xp, a: xp.floor(a),
+        "ceil": lambda xp, a: xp.ceil(a),
+        "round": lambda xp, a: xp.round(a),
+        "sqrt": lambda xp, a: xp.sqrt(a),
+        "exp": lambda xp, a: xp.exp(a),
+        "ln": lambda xp, a: xp.log(a),
+        "log10": lambda xp, a: xp.log10(a),
+        "log2": lambda xp, a: xp.log2(a),
+        "sin": lambda xp, a: xp.sin(a),
+        "cos": lambda xp, a: xp.cos(a),
+        "tan": lambda xp, a: xp.tan(a),
+        "asin": lambda xp, a: xp.arcsin(a),
+        "acos": lambda xp, a: xp.arccos(a),
+        "atan": lambda xp, a: xp.arctan(a),
+        "atan2": lambda xp, a, b: xp.arctan2(a, b),
+        "pow": lambda xp, a, b: xp.power(a, b),
+        "power": lambda xp, a, b: xp.power(a, b),
+        "signum": lambda xp, a: xp.sign(a),
+    }
+
+    def eval(self, env, xp):
+        f = self._FUNCS.get(self.name.lower())
+        if f is None:
+            raise PlanError(f"unknown function {self.name!r}")
+        return f(xp, *[a.eval(env, xp) for a in self.args])
+
+    def columns(self):
+        out = set()
+        for a in self.args:
+            out |= a.columns()
+        return out
+
+    def to_sql(self):
+        return f"{self.name}({', '.join(a.to_sql() for a in self.args)})"
+
+
+# ---------------------------------------------------------------------------
+# domain extraction (predicate pushdown)
+# ---------------------------------------------------------------------------
+def extract_domains(expr: Expr | None, columns: set[str]) -> ColumnDomains:
+    """Sound over-approximation of `expr` restricted to `columns` — used to
+    push tag/time constraints into the index and file pruning (reference
+    predicate::domain push_down_filter). Rows outside the returned domains
+    can never satisfy expr; the full expr is still re-checked at execution.
+    """
+    if expr is None:
+        return ColumnDomains.all()
+    return _extract(expr, columns)
+
+
+def _extract(e: Expr, cols: set[str]) -> ColumnDomains:
+    if isinstance(e, BinOp):
+        if e.op == "and":
+            return _extract(e.left, cols).intersect(_extract(e.right, cols))
+        if e.op == "or":
+            return _extract(e.left, cols).union(_extract(e.right, cols))
+        if e.op in ("=", "<", "<=", ">", ">="):
+            col, lit, op = _col_lit(e)
+            if col is not None and col in cols:
+                dom = {
+                    "=": lambda v: SetDomain([v]),
+                    "<": RangeDomain.lt, "<=": RangeDomain.le,
+                    ">": RangeDomain.gt, ">=": RangeDomain.ge,
+                }[op](lit)
+                return ColumnDomains.of(col, dom)
+        return ColumnDomains.all()
+    if isinstance(e, InList) and not e.negated and isinstance(e.expr, Column):
+        if e.expr.name in cols:
+            return ColumnDomains.of(e.expr.name, SetDomain(e.values))
+        return ColumnDomains.all()
+    if isinstance(e, Between) and not e.negated and isinstance(e.expr, Column):
+        if (e.expr.name in cols and isinstance(e.low, Literal)
+                and isinstance(e.high, Literal)):
+            return ColumnDomains.of(
+                e.expr.name,
+                RangeDomain.of(low=e.low.value, high=e.high.value))
+        return ColumnDomains.all()
+    return ColumnDomains.all()
+
+
+def _col_lit(e: BinOp):
+    """Normalize col-op-literal / literal-op-col → (col, lit, op)."""
+    flip = {"<": ">", "<=": ">=", ">": "<", ">=": "<=", "=": "="}
+    if isinstance(e.left, Column) and isinstance(e.right, Literal):
+        return e.left.name, e.right.value, e.op
+    if isinstance(e.left, Literal) and isinstance(e.right, Column):
+        return e.right.name, e.left.value, flip[e.op]
+    return None, None, None

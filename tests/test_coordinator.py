@@ -1,0 +1,148 @@
+import numpy as np
+import pytest
+
+from cnosdb_tpu.models.points import SeriesRows, WriteBatch
+from cnosdb_tpu.models.predicate import ColumnDomains, SetDomain, TimeRange, TimeRanges
+from cnosdb_tpu.models.schema import (
+    DatabaseOptions, DatabaseSchema, Duration, ValueType,
+)
+from cnosdb_tpu.models.series import SeriesKey
+from cnosdb_tpu.parallel.coordinator import Coordinator
+from cnosdb_tpu.parallel.meta import MetaStore, DEFAULT_TENANT
+from cnosdb_tpu.storage.engine import TsKv
+from cnosdb_tpu.errors import DatabaseNotFound
+
+DAY = 86_400_000_000_000
+
+
+@pytest.fixture
+def cluster(tmp_path):
+    meta = MetaStore(str(tmp_path / "meta.json"))
+    engine = TsKv(str(tmp_path / "data"))
+    coord = Coordinator(meta, engine)
+    yield meta, engine, coord
+    engine.close()
+
+
+def _write(coord, host, ts_list, vals, table="cpu", db="public"):
+    wb = WriteBatch()
+    wb.add_series(table, SeriesRows(
+        SeriesKey(table, {"host": host}), list(ts_list),
+        {"usage": (int(ValueType.FLOAT), list(vals))}))
+    coord.write_points(DEFAULT_TENANT, db, wb)
+
+
+def test_write_creates_schema_and_bucket(cluster):
+    meta, engine, coord = cluster
+    _write(coord, "h1", [10, 20], [1.0, 2.0])
+    schema = meta.table(DEFAULT_TENANT, "public", "cpu")
+    assert schema.tag_names() == ["host"]
+    assert schema.field_names() == ["usage"]
+    assert len(meta.buckets_for(DEFAULT_TENANT, "public")) == 1
+    batches = coord.scan_table(DEFAULT_TENANT, "public", "cpu")
+    assert sum(b.n_rows for b in batches) == 2
+
+
+def test_schema_evolution_on_write(cluster):
+    meta, engine, coord = cluster
+    _write(coord, "h1", [10], [1.0])
+    wb = WriteBatch()
+    wb.add_series("cpu", SeriesRows(
+        SeriesKey("cpu", {"host": "h1", "rack": "r1"}), [20],
+        {"usage": (int(ValueType.FLOAT), [2.0]),
+         "temp": (int(ValueType.FLOAT), [55.0])}))
+    coord.write_points(DEFAULT_TENANT, "public", wb)
+    schema = meta.table(DEFAULT_TENANT, "public", "cpu")
+    assert "rack" in schema.tag_names()
+    assert "temp" in schema.field_names()
+
+
+def test_multi_bucket_split(cluster):
+    meta, engine, coord = cluster
+    meta.create_database(DatabaseSchema(
+        DEFAULT_TENANT, "db2",
+        DatabaseOptions(vnode_duration=Duration.parse("1d"))))
+    # rows across 3 days → 3 buckets
+    _write(coord, "h1", [0, DAY + 5, 2 * DAY + 5], [1.0, 2.0, 3.0], db="db2")
+    assert len(meta.buckets_for(DEFAULT_TENANT, "db2")) == 3
+    batches = coord.scan_table(DEFAULT_TENANT, "db2", "cpu")
+    assert sum(b.n_rows for b in batches) == 3
+    # time-pruned scan only touches one bucket's vnode
+    batches = coord.scan_table(
+        DEFAULT_TENANT, "db2", "cpu",
+        time_ranges=TimeRanges([TimeRange(DAY, 2 * DAY - 1)]))
+    assert sum(b.n_rows for b in batches) == 1
+
+
+def test_shard_split(cluster):
+    meta, engine, coord = cluster
+    meta.create_database(DatabaseSchema(
+        DEFAULT_TENANT, "sharded", DatabaseOptions(shard_num=4)))
+    wb = WriteBatch()
+    for i in range(40):
+        wb.add_series("cpu", SeriesRows(
+            SeriesKey("cpu", {"host": f"h{i}"}), [1],
+            {"usage": (int(ValueType.FLOAT), [float(i)])}))
+    coord.write_points(DEFAULT_TENANT, "sharded", wb)
+    buckets = meta.buckets_for(DEFAULT_TENANT, "sharded")
+    assert len(buckets) == 1 and len(buckets[0].shard_group) == 4
+    owner = f"{DEFAULT_TENANT}.sharded"
+    used = engine.local_vnodes(owner)
+    assert len(used) > 1  # series spread over shards
+    assert sum(v.series_count() for v in used) == 40
+    batches = coord.scan_table(DEFAULT_TENANT, "sharded", "cpu")
+    assert sum(b.n_rows for b in batches) == 40
+
+
+def test_tag_domain_pushdown(cluster):
+    meta, engine, coord = cluster
+    for h in ("h1", "h2", "h3"):
+        _write(coord, h, [1, 2], [1.0, 2.0])
+    batches = coord.scan_table(
+        DEFAULT_TENANT, "public", "cpu",
+        tag_domains=ColumnDomains.of("host", SetDomain(["h2"])))
+    assert sum(b.n_rows for b in batches) == 2
+    assert all(b.n_series == 1 for b in batches)
+
+
+def test_unknown_database_rejected(cluster):
+    meta, engine, coord = cluster
+    with pytest.raises(DatabaseNotFound):
+        _write(coord, "h1", [1], [1.0], db="nope")
+
+
+def test_meta_persistence(tmp_path):
+    meta = MetaStore(str(tmp_path / "meta.json"))
+    meta.create_database(DatabaseSchema(DEFAULT_TENANT, "mydb",
+                                        DatabaseOptions(shard_num=2)))
+    engine = TsKv(str(tmp_path / "data"))
+    coord = Coordinator(meta, engine)
+    _write(coord, "h1", [5], [1.0], db="mydb")
+    engine.close()
+    meta2 = MetaStore(str(tmp_path / "meta.json"))
+    assert meta2.database(DEFAULT_TENANT, "mydb").options.shard_num == 2
+    assert meta2.table(DEFAULT_TENANT, "mydb", "cpu").field_names() == ["usage"]
+    assert len(meta2.buckets_for(DEFAULT_TENANT, "mydb")) == 1
+    engine2 = TsKv(str(tmp_path / "data"))
+    engine2.open_existing()
+    coord2 = Coordinator(meta2, engine2)
+    batches = coord2.scan_table(DEFAULT_TENANT, "mydb", "cpu")
+    assert sum(b.n_rows for b in batches) == 1
+    engine2.close()
+
+
+def test_drop_table_and_database(cluster):
+    meta, engine, coord = cluster
+    _write(coord, "h1", [1], [1.0])
+    coord.drop_table(DEFAULT_TENANT, "public", "cpu")
+    assert coord.scan_table(DEFAULT_TENANT, "public", "cpu") == []
+    assert "cpu" not in meta.list_tables(DEFAULT_TENANT, "public")
+
+
+def test_tag_values_and_series_keys(cluster):
+    meta, engine, coord = cluster
+    for h in ("b", "a", "c"):
+        _write(coord, h, [1], [1.0])
+    assert coord.tag_values(DEFAULT_TENANT, "public", "cpu", "host") == ["a", "b", "c"]
+    keys = coord.series_keys(DEFAULT_TENANT, "public", "cpu")
+    assert [k.tag_value("host") for k in keys] == ["a", "b", "c"]

@@ -1,0 +1,159 @@
+"""Device scan-aggregate operator tests (run on CPU backend via conftest)."""
+import numpy as np
+import pytest
+
+from cnosdb_tpu.models.points import SeriesRows, WriteBatch
+from cnosdb_tpu.models.schema import TskvTableSchema, ValueType
+from cnosdb_tpu.models.series import SeriesKey
+from cnosdb_tpu.ops.tpu_exec import AggSpec, TpuQuery, execute_scan_aggregate
+from cnosdb_tpu.sql.expr import BinOp, Column, InList, Literal
+from cnosdb_tpu.storage.scan import scan_vnode
+from cnosdb_tpu.storage.vnode import VnodeStorage
+
+
+@pytest.fixture
+def vnode(tmp_path):
+    schemas = {"cpu": TskvTableSchema.new_measurement(
+        "t", "db", "cpu", tags=["host", "region"],
+        fields=[("usage", ValueType.FLOAT), ("n", ValueType.INTEGER)])}
+    v = VnodeStorage(1, str(tmp_path / "v"), schemas=schemas)
+    wb = WriteBatch()
+    # h0/h1 in us, h2 in eu; 100 rows each at 1s cadence
+    for i, (host, region) in enumerate([("h0", "us"), ("h1", "us"), ("h2", "eu")]):
+        ts = list(range(0, 100_000_000_000, 1_000_000_000))
+        vals = [float(i * 100 + k) for k in range(100)]
+        ns = [i * 100 + k for k in range(100)]
+        wb.add_series("cpu", SeriesRows(
+            SeriesKey("cpu", {"host": host, "region": region}), ts,
+            {"usage": (int(ValueType.FLOAT), vals),
+             "n": (int(ValueType.INTEGER), ns)}))
+    v.write(wb)
+    v.flush()
+    yield v
+    v.close()
+
+
+def _batch(v):
+    return scan_vnode(v, "cpu")
+
+
+def test_global_aggregates(vnode):
+    b = _batch(vnode)
+    q = TpuQuery(aggs=[
+        AggSpec("count", None, "cnt"),
+        AggSpec("sum", "usage", "s"),
+        AggSpec("mean", "usage", "m"),
+        AggSpec("min", "usage", "lo"),
+        AggSpec("max", "usage", "hi"),
+    ])
+    r = execute_scan_aggregate(b, q)
+    assert r.n_rows == 1
+    assert r.columns["cnt"][0] == 300
+    expect = np.concatenate([np.arange(100.0) + i * 100 for i in range(3)])
+    assert r.columns["s"][0] == pytest.approx(expect.sum())
+    assert r.columns["m"][0] == pytest.approx(expect.mean())
+    assert r.columns["lo"][0] == 0.0 and r.columns["hi"][0] == 299.0
+
+
+def test_group_by_tag(vnode):
+    b = _batch(vnode)
+    q = TpuQuery(group_tags=["region"],
+                 aggs=[AggSpec("count", None, "cnt"), AggSpec("max", "usage", "hi")])
+    r = execute_scan_aggregate(b, q)
+    rows = {r.columns["region"][i]: (r.columns["cnt"][i], r.columns["hi"][i])
+            for i in range(r.n_rows)}
+    assert rows["us"] == (200, 199.0)
+    assert rows["eu"] == (100, 299.0)
+
+
+def test_group_by_time_bucket(vnode):
+    b = _batch(vnode)
+    # 10s buckets over 100s of data → 10 buckets
+    q = TpuQuery(time_bucket=(0, 10_000_000_000),
+                 aggs=[AggSpec("count", None, "cnt"), AggSpec("mean", "usage", "m")])
+    r = execute_scan_aggregate(b, q)
+    assert r.n_rows == 10
+    order = np.argsort(r.columns["time"])
+    assert (r.columns["cnt"][order] == 30).all()
+    # bucket k holds rows k*10..k*10+9 for each of 3 series
+    m0 = np.mean([k + i * 100 for i in range(3) for k in range(10)])
+    assert r.columns["m"][order][0] == pytest.approx(m0)
+
+
+def test_double_groupby(vnode):
+    """TSBS double-groupby shape: GROUP BY time bucket AND host."""
+    b = _batch(vnode)
+    q = TpuQuery(group_tags=["host"], time_bucket=(0, 50_000_000_000),
+                 aggs=[AggSpec("mean", "usage", "m")])
+    r = execute_scan_aggregate(b, q)
+    assert r.n_rows == 6  # 3 hosts × 2 buckets
+    for i in range(r.n_rows):
+        host = r.columns["host"][i]
+        t = r.columns["time"][i]
+        base = int(host[1]) * 100
+        lo = 0 if t == 0 else 50
+        assert r.columns["m"][i] == pytest.approx(base + lo + 24.5)
+
+
+def test_filter_pushdown(vnode):
+    b = _batch(vnode)
+    q = TpuQuery(filter=BinOp(">", Column("usage"), Literal(250.0)),
+                 aggs=[AggSpec("count", None, "cnt"), AggSpec("min", "usage", "lo")])
+    r = execute_scan_aggregate(b, q)
+    assert r.columns["cnt"][0] == 49  # 251..299
+    assert r.columns["lo"][0] == 251.0
+
+
+def test_filter_on_tag(vnode):
+    b = _batch(vnode)
+    q = TpuQuery(filter=InList(Column("host"), ["h0", "h2"]),
+                 aggs=[AggSpec("count", None, "cnt")])
+    r = execute_scan_aggregate(b, q)
+    assert r.columns["cnt"][0] == 200
+
+
+def test_first_last(vnode):
+    b = _batch(vnode)
+    q = TpuQuery(group_tags=["host"],
+                 aggs=[AggSpec("first", "usage", "f"), AggSpec("last", "usage", "l")])
+    r = execute_scan_aggregate(b, q)
+    rows = {r.columns["host"][i]: (r.columns["f"][i], r.columns["l"][i])
+            for i in range(r.n_rows)}
+    assert rows["h0"] == (0.0, 99.0)
+    assert rows["h2"] == (200.0, 299.0)
+
+
+def test_integer_aggregation_is_exact(vnode):
+    b = _batch(vnode)
+    q = TpuQuery(aggs=[AggSpec("sum", "n", "s"), AggSpec("max", "n", "mx")])
+    r = execute_scan_aggregate(b, q)
+    assert r.columns["s"][0] == sum(range(300))
+    assert r.columns["mx"][0] == 299
+    assert r.columns["s"].dtype == np.int64
+
+
+def test_null_handling(tmp_path):
+    schemas = {"m": TskvTableSchema.new_measurement(
+        "t", "db", "m", tags=["h"], fields=[("v", ValueType.FLOAT)])}
+    v = VnodeStorage(1, str(tmp_path / "v2"), schemas=schemas)
+    wb = WriteBatch()
+    wb.add_series("m", SeriesRows(SeriesKey("m", {"h": "a"}), [1, 2, 3, 4],
+                                  {"v": (int(ValueType.FLOAT), [1.0, None, 3.0, None])}))
+    v.write(wb)
+    b = scan_vnode(v, "m")
+    r = execute_scan_aggregate(b, TpuQuery(aggs=[
+        AggSpec("count", "v", "c"), AggSpec("count", None, "star"),
+        AggSpec("sum", "v", "s")]))
+    assert r.columns["c"][0] == 2       # nulls not counted
+    assert r.columns["star"][0] == 4    # count(*) counts rows
+    assert r.columns["s"][0] == 4.0
+    v.close()
+
+
+def test_empty_group_not_emitted(vnode):
+    b = _batch(vnode)
+    q = TpuQuery(filter=BinOp("=", Column("host"), Literal("h0")),
+                 group_tags=["host"], aggs=[AggSpec("count", None, "c")])
+    r = execute_scan_aggregate(b, q)
+    assert r.n_rows == 1
+    assert r.columns["host"][0] == "h0"
