@@ -1,0 +1,128 @@
+"""Benchmark: TSBS double-groupby-style scan/aggregate through the full engine.
+
+Ingests a TSBS-cpu-like dataset (100 hosts × 20k points, 2M rows), flushes
+to TSM, then measures the end-to-end SQL query path — scan (decode + merge)
+→ device filter/bucket/segment-aggregate → result — for the headline query
+shape `SELECT date_bin(1h, time), host, mean(usage_user) GROUP BY ...`
+(TSBS double-groupby-1; BASELINE.json config 2).
+
+Prints ONE JSON line:
+    {"metric": ..., "value": rows/sec, "unit": "rows/s", "vs_baseline": x}
+vs_baseline compares against a pandas/numpy CPU implementation of the same
+aggregation over the same in-memory arrays (the reference publishes no
+absolute numbers — BASELINE.md — so the baseline is measured in-process).
+"""
+from __future__ import annotations
+
+import json
+import shutil
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+N_HOSTS = 100
+N_PER_HOST = 20_000
+INTERVAL_NS = 10 * 10**9          # 10s cadence
+BUCKET_NS = 3600 * 10**9          # 1h buckets
+QUERY = ("SELECT date_bin(INTERVAL '1 hour', time) AS t, hostname, "
+         "avg(usage_user) AS mean_usage FROM cpu GROUP BY t, hostname")
+
+
+def build_dataset(coord, tenant, db):
+    from cnosdb_tpu.models.points import SeriesRows, WriteBatch
+    from cnosdb_tpu.models.schema import ValueType
+    from cnosdb_tpu.models.series import SeriesKey
+
+    rng = np.random.default_rng(123)
+    base = 1_640_995_200_000_000_000  # 2022-01-01
+    ts = (base + np.arange(N_PER_HOST, dtype=np.int64) * INTERVAL_NS)
+    ts_list = ts.tolist()
+    t0 = time.perf_counter()
+    for h in range(N_HOSTS):
+        usage = np.clip(50 + 20 * np.sin(np.arange(N_PER_HOST) / 500 + h)
+                        + rng.normal(0, 5, N_PER_HOST), 0, 100)
+        wb = WriteBatch()
+        wb.add_series("cpu", SeriesRows(
+            SeriesKey("cpu", {"hostname": f"host_{h}"}), ts_list,
+            {"usage_user": (int(ValueType.FLOAT), usage.tolist())}))
+        coord.write_points(tenant, db, wb)
+    coord.engine.flush_all()
+    coord.engine.compact_all()
+    return time.perf_counter() - t0
+
+
+def numpy_baseline(ts, hosts_idx, usage, n_hosts):
+    """The CPU-side oracle: same grouping in vectorized numpy."""
+    bucket = (ts - ts.min()) // BUCKET_NS
+    nb = int(bucket.max()) + 1
+    seg = hosts_idx.astype(np.int64) * nb + bucket
+    nseg = n_hosts * nb
+    sums = np.bincount(seg, weights=usage, minlength=nseg)
+    counts = np.bincount(seg, minlength=nseg)
+    with np.errstate(invalid="ignore"):
+        return sums / np.maximum(counts, 1), counts
+
+
+def main():
+    data_dir = tempfile.mkdtemp(prefix="cnosdb_bench_")
+    try:
+        from cnosdb_tpu.parallel.coordinator import Coordinator
+        from cnosdb_tpu.parallel.meta import MetaStore, DEFAULT_TENANT
+        from cnosdb_tpu.sql.executor import QueryExecutor, Session
+        from cnosdb_tpu.storage.engine import TsKv
+
+        meta = MetaStore(data_dir + "/meta.json")
+        engine = TsKv(data_dir + "/data")
+        coord = Coordinator(meta, engine)
+        executor = QueryExecutor(meta, coord)
+        session = Session(database="public")
+
+        n_rows = N_HOSTS * N_PER_HOST
+        ingest_s = build_dataset(coord, DEFAULT_TENANT, "public")
+        print(f"# ingested {n_rows} rows in {ingest_s:.1f}s "
+              f"({n_rows/ingest_s/1e6:.2f}M rows/s)", file=sys.stderr)
+
+        # --- engine path (scan → TPU kernels → merge) -------------------
+        rs = executor.execute_one(QUERY, session)   # warm-up (compile+cache)
+        expect_groups = rs.n_rows
+        iters = 3
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            rs = executor.execute_one(QUERY, session)
+        engine_dt = (time.perf_counter() - t0) / iters
+        assert rs.n_rows == expect_groups
+        engine_rate = n_rows / engine_dt
+
+        # --- CPU baseline over identical in-memory arrays ----------------
+        batches = coord.scan_table(DEFAULT_TENANT, "public", "cpu")
+        ts = np.concatenate([b.ts for b in batches])
+        usage = np.concatenate([b.fields["usage_user"][1] for b in batches])
+        hosts_idx = np.concatenate(
+            [b.sid_ordinal + sum(bb.n_series for bb in batches[:i])
+             for i, b in enumerate(batches)]).astype(np.int64)
+        numpy_baseline(ts, hosts_idx, usage, N_HOSTS)  # warm-up
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            numpy_baseline(ts, hosts_idx, usage, N_HOSTS)
+        base_dt = (time.perf_counter() - t0) / iters
+        base_rate = n_rows / base_dt
+        print(f"# engine query {engine_dt*1e3:.0f}ms "
+              f"({engine_rate/1e6:.1f}M rows/s) | numpy-groupby baseline "
+              f"{base_dt*1e3:.0f}ms ({base_rate/1e6:.1f}M rows/s)",
+              file=sys.stderr)
+
+        print(json.dumps({
+            "metric": "tsbs_double_groupby_1h_scan_agg",
+            "value": round(engine_rate, 1),
+            "unit": "rows/s",
+            "vs_baseline": round(engine_rate / base_rate, 3),
+        }))
+        engine.close()
+    finally:
+        shutil.rmtree(data_dir, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    main()

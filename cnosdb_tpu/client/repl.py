@@ -1,0 +1,108 @@
+"""Interactive SQL REPL over the HTTP API.
+
+Role-parity with the reference cnosdb-cli (client/src/exec.rs:21-270):
+line editing, `\\c db`, `\\w file` line-protocol import, output formats,
+file/one-shot execution.
+"""
+from __future__ import annotations
+
+import base64
+import sys
+import urllib.error
+import urllib.request
+
+
+class Client:
+    def __init__(self, host: str, port: int, user: str, password: str,
+                 database: str, fmt: str = "table"):
+        self.base = f"http://{host}:{port}"
+        self.user = user
+        self.password = password
+        self.database = database
+        self.fmt = fmt
+
+    def _headers(self) -> dict:
+        token = base64.b64encode(f"{self.user}:{self.password}".encode()).decode()
+        accept = {"table": "text/table", "csv": "application/csv",
+                  "tsv": "application/csv", "json": "application/json"}[self.fmt]
+        return {"Authorization": f"Basic {token}", "Accept": accept}
+
+    def sql(self, query: str) -> tuple[int, str]:
+        req = urllib.request.Request(
+            f"{self.base}/api/v1/sql?db={self.database}",
+            data=query.encode(), headers=self._headers(), method="POST")
+        try:
+            with urllib.request.urlopen(req, timeout=300) as r:
+                return r.status, r.read().decode()
+        except urllib.error.HTTPError as e:
+            return e.code, e.read().decode()
+        except urllib.error.URLError as e:
+            return 0, f"connection error: {e}"
+
+    def write_lines(self, lines: str) -> tuple[int, str]:
+        req = urllib.request.Request(
+            f"{self.base}/api/v1/write?db={self.database}",
+            data=lines.encode(), headers=self._headers(), method="POST")
+        try:
+            with urllib.request.urlopen(req, timeout=300) as r:
+                return r.status, r.read().decode()
+        except urllib.error.HTTPError as e:
+            return e.code, e.read().decode()
+
+
+def run_repl(args) -> int:
+    client = Client(args.host, args.port, args.user, args.password,
+                    args.database, args.format)
+    if args.command:
+        status, out = client.sql(args.command)
+        print(out)
+        return 0 if status == 200 else 1
+    if args.file:
+        with open(args.file) as f:
+            for stmt in f.read().split(";"):
+                if stmt.strip():
+                    status, out = client.sql(stmt)
+                    print(out)
+                    if status != 200:
+                        return 1
+        return 0
+    print(f"cnosdb-tpu-cli connected to {client.base} (db {client.database})")
+    print("Type SQL, \\c <db> to switch database, \\w <file> to import line "
+          "protocol, \\q to quit.")
+    try:
+        import readline  # noqa: F401 - enables history/editing
+    except ImportError:
+        pass
+    buf = []
+    while True:
+        prompt = f"{client.database} ❯ " if not buf else "... "
+        try:
+            line = input(prompt)
+        except (EOFError, KeyboardInterrupt):
+            print()
+            return 0
+        s = line.strip()
+        if not buf and s.startswith("\\"):
+            parts = s.split()
+            if parts[0] in ("\\q", "\\quit", "\\exit"):
+                return 0
+            if parts[0] == "\\c" and len(parts) > 1:
+                client.database = parts[1]
+                continue
+            if parts[0] == "\\w" and len(parts) > 1:
+                with open(parts[1]) as f:
+                    status, out = client.write_lines(f.read())
+                print("ok" if status == 200 else out)
+                continue
+            if parts[0] == "\\format" and len(parts) > 1:
+                client.fmt = parts[1]
+                continue
+            print(f"unknown command {parts[0]}")
+            continue
+        buf.append(line)
+        if s.endswith(";") or (s and not buf[:-1] and not s.endswith("\\")):
+            query = "\n".join(buf).rstrip(";")
+            buf = []
+            if query.strip():
+                _status, out = client.sql(query)
+                print(out)

@@ -1,0 +1,167 @@
+"""Layered configuration.
+
+Role-parity with the reference's config crate (config/src/tskv/mod.rs:37-120
+Figment TOML + CNOSDB_ env overrides; `cnosdb config` prints defaults,
+`cnosdb check` validates): TOML file → env (`CNOSDB_SECTION_KEY`) → CLI
+flags, with typed sections global/deployment/query/storage/wal/cache/
+log/service/cluster.
+"""
+from __future__ import annotations
+
+import os
+import tomllib
+from dataclasses import dataclass, field, fields as dc_fields
+
+from .errors import ConfigError
+
+
+@dataclass
+class GlobalConfig:
+    node_id: int = 1
+    host: str = "localhost"
+    cluster_name: str = "cluster_xxx"
+    store_metrics: bool = True
+
+
+@dataclass
+class DeploymentConfig:
+    mode: str = "singleton"       # singleton | query_tskv | tskv | query
+    cpu: int = 0                  # 0 = auto
+    memory: int = 0
+
+
+@dataclass
+class QueryConfig:
+    max_server_connections: int = 10240
+    query_sql_limit: int = 16 * 1024 * 1024
+    write_sql_limit: int = 160 * 1024 * 1024
+    auth_enabled: bool = False
+    read_timeout_ms: int = 3_000_000
+    write_timeout_ms: int = 3_000_000
+
+
+@dataclass
+class StorageConfig:
+    path: str = "./cnosdb-data"
+    max_summary_size: int = 128 * 1024 * 1024
+    base_file_size: int = 16 * 1024 * 1024
+    max_level: int = 4
+    compact_trigger_file_num: int = 4
+    max_compact_size: int = 2 * 1024 * 1024 * 1024
+    strict_write: bool = False
+    reserve_space: int = 0
+
+
+@dataclass
+class WalConfig:
+    enabled: bool = True
+    max_file_size: int = 64 * 1024 * 1024
+    sync: bool = False
+
+
+@dataclass
+class CacheConfig:
+    max_buffer_size: int = 128 * 1024 * 1024
+    partition: int = 0
+
+
+@dataclass
+class LogConfig:
+    level: str = "info"
+    path: str = "./cnosdb-logs"
+
+
+@dataclass
+class ServiceConfig:
+    http_listen_port: int = 8902
+    grpc_listen_port: int = 8903
+    flight_rpc_listen_port: int = 8904
+    tcp_listen_port: int = 8905
+    enable_report: bool = False
+
+
+@dataclass
+class ClusterConfig:
+    raft_logs_to_keep: int = 5000
+    snapshot_holding_time_s: int = 3600
+    heartbeat_interval_ms: int = 300
+    election_timeout_ms: int = 1000
+
+
+@dataclass
+class Config:
+    global_: GlobalConfig = field(default_factory=GlobalConfig)
+    deployment: DeploymentConfig = field(default_factory=DeploymentConfig)
+    query: QueryConfig = field(default_factory=QueryConfig)
+    storage: StorageConfig = field(default_factory=StorageConfig)
+    wal: WalConfig = field(default_factory=WalConfig)
+    cache: CacheConfig = field(default_factory=CacheConfig)
+    log: LogConfig = field(default_factory=LogConfig)
+    service: ServiceConfig = field(default_factory=ServiceConfig)
+    cluster: ClusterConfig = field(default_factory=ClusterConfig)
+
+    _SECTIONS = {
+        "global": "global_", "deployment": "deployment", "query": "query",
+        "storage": "storage", "wal": "wal", "cache": "cache", "log": "log",
+        "service": "service", "cluster": "cluster",
+    }
+
+    @classmethod
+    def load(cls, path: str | None = None, env: dict | None = None) -> "Config":
+        cfg = cls()
+        if path:
+            try:
+                with open(path, "rb") as f:
+                    data = tomllib.load(f)
+            except FileNotFoundError:
+                raise ConfigError(f"config file not found: {path}")
+            except tomllib.TOMLDecodeError as e:
+                raise ConfigError(f"bad TOML in {path}: {e}")
+            for section, attr in cls._SECTIONS.items():
+                if section in data:
+                    obj = getattr(cfg, attr)
+                    for k, v in data[section].items():
+                        if hasattr(obj, k):
+                            setattr(obj, k, v)
+                        # unknown keys warn, not fail (reference check.rs warns)
+        env = env if env is not None else os.environ
+        for section, attr in cls._SECTIONS.items():
+            obj = getattr(cfg, attr)
+            for f in dc_fields(obj):
+                key = f"CNOSDB_{section.upper()}_{f.name.upper()}"
+                if key in env:
+                    raw = env[key]
+                    t = type(getattr(obj, f.name))
+                    if t is bool:
+                        setattr(obj, f.name, raw.lower() in ("1", "true", "yes"))
+                    elif t is int:
+                        setattr(obj, f.name, int(raw))
+                    else:
+                        setattr(obj, f.name, raw)
+        return cfg
+
+    def to_toml(self) -> str:
+        out = []
+        for section, attr in self._SECTIONS.items():
+            out.append(f"[{section}]")
+            obj = getattr(self, attr)
+            for f in dc_fields(obj):
+                v = getattr(obj, f.name)
+                if isinstance(v, bool):
+                    out.append(f"{f.name} = {'true' if v else 'false'}")
+                elif isinstance(v, (int, float)):
+                    out.append(f"{f.name} = {v}")
+                else:
+                    out.append(f'{f.name} = "{v}"')
+            out.append("")
+        return "\n".join(out)
+
+    def check(self) -> list[str]:
+        warnings = []
+        if self.storage.compact_trigger_file_num < 2:
+            warnings.append("storage.compact_trigger_file_num < 2")
+        if self.cache.max_buffer_size < 1024 * 1024:
+            warnings.append("cache.max_buffer_size very small")
+        if self.deployment.mode not in ("singleton", "query_tskv", "tskv", "query"):
+            raise ConfigError(f"bad deployment.mode {self.deployment.mode!r}")
+        return warnings

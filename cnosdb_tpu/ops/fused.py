@@ -1,0 +1,291 @@
+"""Fused device-side query kernels over cached DeviceBatches.
+
+One jitted program per (filter expression, aggregate set, segment shape)
+runs the ENTIRE per-vnode query — predicate filter, time-bucket
+computation, group mapping, masked segment reductions — against
+device-resident columns. Per query, only the group-of-series vector and
+scalar bucket parameters cross to the device and only [num_segments]
+partials come back; the row data never moves again. This is what makes
+repeated analytics queries fast under a thin host↔device pipe.
+
+Bucket math is pure int32 (64-bit integer ops are software-emulated on
+TPU, measured ~1000× slower). For interval = I_s whole seconds, with batch
+epoch E and query origin O:
+
+    bucket(ts) = floor((ts - O)/interval)
+    let A = E - O = qA*interval + rA,  rA = rA_s*1e9 + rA_ns  (host, exact)
+    ts = E + sec*1e9 + rem             (device i32 pair)
+    carry = (rem + rA_ns) >= 1e9
+    bucket = qA + floor((sec + rA_s + carry) / I_s)            (all i32)
+
+The final index subtracts bmin host-side (folded into `offset`), so no
+per-query recompilation: I_s, rA_s, rA_ns, offset are traced scalars.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..sql.expr import Expr
+from .device_cache import DeviceBatch
+from .kernels import local_segment_partials, pad_segments
+
+_kernel_cache: dict = {}
+
+NS_PER_SEC = 1_000_000_000
+
+
+def bucket_arith_params(epoch_ns: int, origin: int, interval: int,
+                        bmin: int, max_span_ns: int = 0,
+                        ) -> tuple[int, int, int, int] | None:
+    """Host-side derivation of the i32 bucket constants; None if the
+    interval is not a whole number of seconds or any i32 step could
+    overflow (host path handles those)."""
+    if interval % NS_PER_SEC != 0:
+        return None
+    i_s = interval // NS_PER_SEC
+    if i_s >= 2**31:
+        return None
+    a = epoch_ns - origin
+    qa = a // interval
+    ra = a - qa * interval
+    ra_s = ra // NS_PER_SEC
+    ra_ns = ra % NS_PER_SEC
+    # sec_adj = ts_sec + ra_s + carry must stay inside i32
+    if max_span_ns // NS_PER_SEC + ra_s + 2 >= 2**31:
+        return None
+    offset = qa - bmin
+    if not (-(2**31) < offset < 2**31):
+        return None
+    return int(i_s), int(ra_s), int(ra_ns), int(offset)
+
+
+class PendingFused:
+    """A launched (asynchronous) fused kernel; fetch() pulls the single
+    packed output matrix in ONE device→host transfer and unpacks it."""
+
+    __slots__ = ("dev_out", "manifest", "num_segments", "int_cols")
+
+    def __init__(self, dev_out, manifest, num_segments, int_cols):
+        self.dev_out = dev_out
+        self.manifest = manifest
+        self.num_segments = num_segments
+        self.int_cols = int_cols
+
+    def fetch(self) -> dict[str, dict]:
+        mat = np.asarray(self.dev_out)  # [n_slots, ns_pad], one transfer
+        out: dict[str, dict] = {}
+        for i, (col, agg) in enumerate(self.manifest):
+            row = mat[i, :self.num_segments]
+            if agg == "count" or agg.endswith("_rank") or col in self.int_cols:
+                # exact below 2^53; integer sums beyond that would lose
+                # precision in the packed f64 transfer (documented limit)
+                row = row.astype(np.int64)
+            out.setdefault(col, {})[agg] = row
+        return out
+
+
+def launch_fused(dbatch: DeviceBatch, filter_expr: Expr | None,
+                 group_of_series: np.ndarray, n_groups: int, n_buckets: int,
+                 arith: tuple[int, int, int, int] | None,
+                 col_wants: dict[str, dict]) -> PendingFused:
+    num_segments = n_groups * n_buckets
+    ns_pad = pad_segments(max(num_segments, 1))
+
+    filter_key = filter_expr.to_sql() if filter_expr is not None else ""
+    cols_key = tuple(sorted((c, tuple(sorted(w.items())))
+                            for c, w in col_wants.items()))
+    # ship every column the kernel touches: aggregated ones AND columns the
+    # filter references but no aggregate does
+    filt_cols = filter_expr.columns() if filter_expr is not None else set()
+    present = [n for n in sorted(set(col_wants) | filt_cols)
+               if n in dbatch.fields]
+    dtypes_key = tuple((name, str(dbatch.fields[name][1].dtype))
+                       for name in present)
+    i_s, ra_s, ra_ns, offset = arith if arith is not None else (1, 0, 0, 0)
+    use_bucket = arith is not None
+    need_rank = any(w.get("want_first") or w.get("want_last")
+                    for w in col_wants.values())
+    valid_flags = tuple(dbatch.fields[n][2] is not None for n in present)
+    has_ts_ns = use_bucket and not dbatch.ns_all_zero
+    regular = dbatch.series_params is not None
+    # the divisor i_s MUST be a compile-time constant: division by a traced
+    # i32 is software-emulated on TPU (~1000× slower); XLA strength-reduces
+    # constant divisors to multiplies. Intervals are few (1m/5m/1h/...), so
+    # keying the kernel cache on i_s costs a handful of compiles. The
+    # add/compare params (ra_s/ra_ns/offset) stay traced — they change per
+    # batch/origin without recompilation. Optional inputs (ts_ns, rank,
+    # per-column validity) are kernel variants: every buffer passed is
+    # re-streamed per launch under the relay, so absent means bytes saved.
+    key = (filter_key, cols_key, dtypes_key, ns_pad, n_buckets,
+           use_bucket, i_s, dbatch.n_pad, need_rank, valid_flags, has_ts_ns,
+           regular)
+    entry = _kernel_cache.get(key)
+    if entry is None:
+        entry = _build_kernel(filter_expr, col_wants, tuple(present), ns_pad,
+                              n_buckets, use_bucket, i_s, need_rank,
+                              valid_flags, has_ts_ns, regular, dbatch.n_pad)
+        _kernel_cache[key] = entry
+    fn, manifest = entry
+
+    ns = max(dbatch.n_series, 1)
+    gos = np.zeros(ns, dtype=np.int32)
+    gos[:len(group_of_series)] = group_of_series
+
+    args = []
+    if not regular:
+        if use_bucket:
+            args.append(dbatch.ts_sec)
+            if has_ts_ns:
+                args.append(dbatch.ts_ns)
+        args.append(dbatch.sid_ordinal)
+    if need_rank:
+        args.append(dbatch.rank_dev())
+    # every host→device transfer costs ~45-90ms fixed under the relay: all
+    # per-query scalars + the group vector + (regular mode) the per-series
+    # run params ride in ONE i32 buffer
+    sp = dbatch.series_params if regular else None
+    sp_len = sp.size if sp is not None else 0
+    params = np.empty(4 + ns + sp_len, dtype=np.int32)
+    params[0] = ra_s
+    params[1] = ra_ns
+    params[2] = offset
+    params[3] = dbatch.n_rows
+    params[4:4 + ns] = gos
+    if sp is not None:
+        params[4 + ns:] = sp.ravel()
+    from .placement import scan_device
+
+    args.append(jax.device_put(params, scan_device()))
+    for name, has_valid in zip(present, valid_flags):
+        _vt, vals, valid = dbatch.fields[name]
+        args.append(vals)
+        if has_valid:
+            args.append(valid)
+    dev_out = fn(*args)
+    int_cols = {name for name in present
+                if jnp.issubdtype(dbatch.fields[name][1].dtype, jnp.integer)}
+    return PendingFused(dev_out, manifest, num_segments, int_cols)
+
+
+def run_fused(dbatch: DeviceBatch, filter_expr: Expr | None,
+              group_of_series: np.ndarray, n_groups: int, n_buckets: int,
+              arith: tuple[int, int, int, int] | None,
+              col_wants: dict[str, dict]) -> dict[str, dict]:
+    return launch_fused(dbatch, filter_expr, group_of_series, n_groups,
+                        n_buckets, arith, col_wants).fetch()
+
+
+def _build_kernel(filter_expr: Expr | None, col_wants: dict,
+                  present: tuple, ns_pad: int, n_buckets: int,
+                  use_bucket: bool, i_s: int, need_rank: bool,
+                  valid_flags: tuple, has_ts_ns: bool, regular: bool,
+                  n_pad: int = 0):
+    """→ (jitted fn, manifest). The kernel packs every partial into ONE
+    [n_slots, ns_pad] float64 matrix so the host fetches a single transfer
+    (small device→host pulls have ~15-90ms fixed latency through the host
+    relay; one packed pull amortizes it). f64 holds counts and i32 ranks
+    exactly (< 2^53). Optional inputs are compile-time variants — see
+    launch_fused."""
+    manifest: list[tuple[str, str]] = [("__presence__", "count")]
+    agg_cols = [n for n in present if n in col_wants]
+    for name in agg_cols:
+        w = col_wants[name]
+        manifest.append((name, "count"))
+        for agg, flag in (("sum", "want_sum"), ("min", "want_min"),
+                          ("max", "want_max"), ("first", "want_first"),
+                          ("last", "want_last")):
+            if w.get(flag):
+                manifest.append((name, agg))
+                if agg in ("first", "last"):
+                    manifest.append((name, agg + "_rank"))
+
+    def kernel(*args):
+        i = 0
+        ts_sec = ts_ns = None
+        sid_ord = None
+        if not regular:
+            if use_bucket:
+                ts_sec = args[i]; i += 1
+                if has_ts_ns:
+                    ts_ns = args[i]; i += 1
+            sid_ord = args[i]; i += 1
+        if need_rank:
+            rank = args[i]; i += 1
+        else:
+            rank = None
+        params = args[i]; i += 1
+        ra_s, ra_ns, offset, n_rows = params[0], params[1], params[2], params[3]
+        fields = {}
+        for name, has_valid in zip(present, valid_flags):
+            vals = args[i]; i += 1
+            valid = None
+            if has_valid:
+                valid = args[i]; i += 1
+            fields[name] = (vals, valid)
+
+        row = jax.lax.iota(jnp.int32, n_pad)
+        if regular:
+            # reconstruct sid + ts_sec from [n_series,3] run params
+            n_series = (params.shape[0] - 4) // 4
+            group_of_series = params[4:4 + n_series]
+            sp = params[4 + n_series:].reshape(n_series, 3)
+            row_start, sec0, stride = sp[:, 0], sp[:, 1], sp[:, 2]
+            sid_ord = (jnp.searchsorted(row_start, row, side="right") - 1
+                       ).astype(jnp.int32)
+            sid_ord = jnp.clip(sid_ord, 0, n_series - 1)
+            if use_bucket:
+                k = row - row_start[sid_ord]
+                ts_sec = sec0[sid_ord] + k * stride[sid_ord]
+        else:
+            n_series = params.shape[0] - 4
+            group_of_series = params[4:]
+        mask = row < n_rows
+        if filter_expr is not None:
+            env = {}
+            for name, (vals, valid) in fields.items():
+                env[name] = vals
+                env[f"__valid__:{name}"] = (
+                    valid if valid is not None
+                    else jnp.ones(vals.shape, dtype=bool))
+            fmask = filter_expr.eval(env, jnp)
+            mask = mask & fmask
+            # null operands exclude rows (host path does the same)
+            for c in filter_expr.columns():
+                if c in fields and fields[c][1] is not None:
+                    mask = mask & fields[c][1]
+        if use_bucket:
+            if ts_ns is not None:
+                carry = ((ts_ns + ra_ns) >= NS_PER_SEC).astype(jnp.int32)
+            else:
+                carry = (ra_ns >= NS_PER_SEC).astype(jnp.int32)
+            sec_adj = ts_sec + ra_s + carry
+            bucket = offset + sec_adj // jnp.int32(i_s)
+            bucket = jnp.clip(bucket, 0, n_buckets - 1)
+        else:
+            bucket = jnp.zeros_like(sid_ord)
+        seg = (group_of_series[sid_ord] * n_buckets + bucket).astype(jnp.int32)
+        seg = jnp.where(mask, seg, 0)
+        results = {("__presence__", "count"): jax.ops.segment_sum(
+            mask.astype(jnp.int32), seg, ns_pad)}
+        for name in agg_cols:
+            vals, valid = fields[name]
+            w = col_wants[name]
+            part = local_segment_partials(
+                vals, (valid & mask) if valid is not None else mask, seg,
+                rank if rank is not None else seg,  # rank unused w/o first/last
+                num_segments=ns_pad,
+                want_count=True,  # always: NULL-presence masking needs it
+                want_sum=w.get("want_sum", False),
+                want_min=w.get("want_min", False),
+                want_max=w.get("want_max", False),
+                want_first=w.get("want_first", False),
+                want_last=w.get("want_last", False))
+            for agg, arr in part.items():
+                results[(name, agg)] = arr
+        rows = [results[slot].astype(jnp.float64) for slot in manifest]
+        return jnp.stack(rows)
+
+    return jax.jit(kernel), manifest

@@ -73,8 +73,10 @@ def local_segment_partials(values, valid, seg_ids, rank, *, num_segments: int,
     vmax, vmin = type_extrema(values.dtype)
     zero = jnp.zeros((), values.dtype)
     if want_count:
+        # i32 on device (64-bit int ops are emulated on TPU); a batch is
+        # bounded well below 2^31 rows, host wrappers upcast to i64
         out["count"] = jax.ops.segment_sum(
-            valid.astype(jnp.int64), seg_ids, num_segments)
+            valid.astype(jnp.int32), seg_ids, num_segments)
     if want_sum:
         out["sum"] = jax.ops.segment_sum(
             jnp.where(valid, values, zero), seg_ids, num_segments)
@@ -122,7 +124,10 @@ def aggregate_column_host(values: np.ndarray, valid: np.ndarray,
         rank = _pad(rank, np_pad, fill=0)
     out = segment_aggregate(values, valid, seg_ids, rank,
                             num_segments=ns_pad, **wants)
-    return {k: np.asarray(v)[:num_segments] for k, v in out.items()}
+    host = {k: np.asarray(v)[:num_segments] for k, v in out.items()}
+    if "count" in host:
+        host["count"] = host["count"].astype(np.int64)
+    return host
 
 
 def _pad(a: np.ndarray, n: int, fill=0):

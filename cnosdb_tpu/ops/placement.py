@@ -1,0 +1,69 @@
+"""Operator placement: choose where the fused scan kernel runs.
+
+A database picks physical operators by cost; on a TPU host the choice is
+between the accelerator and host XLA (same jit program, different
+backend). The accelerator wins when data stays HBM-resident and the
+PCIe/ICI pipe is real; it loses when every launch must re-stream inputs
+through a thin transport (some dev environments reach the chip via a
+network relay at ~100-250MB/s with tens-of-ms fixed costs per transfer —
+measured in this repo's bench notes). We probe the pipe once per process
+and place accordingly.
+
+Override with CNOSDB_TPU_PLACEMENT = device | cpu | auto (default auto).
+"""
+from __future__ import annotations
+
+import os
+import time
+
+import numpy as np
+
+import jax
+
+_placement_device = None
+
+# below this, per-query input re-streaming dominates any kernel win
+MIN_PIPE_MBS = 500.0
+
+
+def _probe_pipe_mbs(dev) -> float:
+    """Round-trip 4MB to `dev` twice; → effective MB/s (worst of puts/pulls)."""
+    a = np.zeros(524_288, dtype=np.float64)  # 4MB
+    worst = float("inf")
+    for _ in range(2):
+        t0 = time.perf_counter()
+        x = jax.device_put(a, dev)
+        jax.block_until_ready(x)
+        put_dt = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        np.asarray(x)
+        pull_dt = time.perf_counter() - t0
+        worst = min(worst, a.nbytes / 1e6 / max(put_dt, pull_dt))
+    return worst
+
+
+def scan_device():
+    """The device the fused scan kernels (and DeviceBatches) live on."""
+    global _placement_device
+    if _placement_device is not None:
+        return _placement_device
+    mode = os.environ.get("CNOSDB_TPU_PLACEMENT", "auto").lower()
+    default = jax.devices()[0]
+    if mode == "device":
+        _placement_device = default
+        return _placement_device
+    cpu = None
+    try:
+        cpu = jax.devices("cpu")[0]
+    except Exception:
+        pass
+    if mode == "cpu":
+        _placement_device = cpu or default
+        return _placement_device
+    # auto: accelerator unless the pipe is degraded
+    if default.platform == "cpu" or cpu is None:
+        _placement_device = default
+        return _placement_device
+    mbs = _probe_pipe_mbs(default)
+    _placement_device = default if mbs >= MIN_PIPE_MBS else cpu
+    return _placement_device

@@ -65,6 +65,21 @@ class AggResult:
 
 
 def execute_scan_aggregate(batch: ScanBatch, query: TpuQuery) -> AggResult:
+    return finish_scan_aggregate(launch_scan_aggregate(batch, query))
+
+
+def finish_scan_aggregate(job) -> AggResult:
+    """Complete a launched job: fetch device partials (one transfer) and
+    assemble the result table."""
+    if isinstance(job, AggResult):
+        return job
+    return job()
+
+
+def launch_scan_aggregate(batch: ScanBatch, query: TpuQuery):
+    """Start a scan-aggregate; device kernels are dispatched asynchronously
+    so a coordinator can launch every vnode's kernel before fetching any
+    result (device→host pulls carry fixed relay latency)."""
     n = batch.n_rows
     if n == 0:
         names = query.group_tags + (["time"] if query.time_bucket else []) \
@@ -89,74 +104,9 @@ def execute_scan_aggregate(batch: ScanBatch, query: TpuQuery) -> AggResult:
         group_of_series = np.zeros(batch.n_series, dtype=np.int32)
         group_labels = [()]
         n_groups = 1
-    group_of_row = group_of_series[batch.sid_ordinal]
+    group_of_row = None  # host path computes lazily
 
-    # ------------------------------------------------ time buckets (host i64)
-    if query.time_bucket is not None:
-        origin, interval = query.time_bucket
-        b = (batch.ts - origin) // interval
-        bmin, bmax = int(b.min()), int(b.max())
-        span = bmax - bmin + 1
-        if span <= _DENSE_BUCKET_LIMIT:
-            bucket_ids = (b - bmin).astype(np.int32)
-            bucket_starts = origin + (bmin + np.arange(span, dtype=np.int64)) * interval
-            n_buckets = span
-        else:
-            uniq, inv = np.unique(b, return_inverse=True)
-            bucket_ids = inv.astype(np.int32)
-            bucket_starts = origin + uniq * interval
-            n_buckets = len(uniq)
-    else:
-        bucket_ids = np.zeros(n, dtype=np.int32)
-        bucket_starts = None
-        n_buckets = 1
-
-    num_segments = n_groups * n_buckets
-    seg_ids = (group_of_row.astype(np.int64) * n_buckets
-               + bucket_ids.astype(np.int64)).astype(np.int32)
-
-    # ------------------------------------------------ filter
-    row_mask = np.ones(n, dtype=bool)
-    if query.filter is not None:
-        env = _filter_env(batch)
-        has_is_null = _contains_is_null(query.filter)
-        missing = [c for c in query.filter.columns() if c not in env]
-        if missing and not has_is_null:
-            # a schema column with no data in this vnode is all-NULL here:
-            # any comparison on it matches nothing
-            row_mask = np.zeros(n, dtype=bool)
-        else:
-            for c in missing:  # IS NULL paths need the env entries
-                env[c] = np.zeros(n)
-                env[f"__valid__:{c}"] = np.zeros(n, dtype=bool)
-            row_mask = np.asarray(query.filter.eval(env, np), dtype=bool)
-            if row_mask.shape == ():  # constant predicate
-                row_mask = np.full(n, bool(row_mask))
-            # SQL three-valued logic approximation: a NULL operand makes a
-            # comparison non-matching, so rows where a referenced field is
-            # null are excluded — except under an explicit IS NULL test.
-            if not has_is_null:
-                for cname in query.filter.columns():
-                    if cname in batch.fields:
-                        row_mask &= batch.fields[cname][2]
-    seg_ids = np.where(row_mask, seg_ids, 0).astype(np.int32)
-
-    # ------------------------------------------------ rank for first/last
-    needs_rank = any(a.func in ("first", "last") for a in query.aggs)
-    if needs_rank:
-        order = np.argsort(batch.ts, kind="stable")
-        rank = np.empty(n, dtype=np.int32)
-        rank[order] = np.arange(n, dtype=np.int32)
-    else:
-        rank = np.zeros(n, dtype=np.int32)
-
-    # ------------------------------------------------ per-column kernels
-    presence = kernels.aggregate_column_host(
-        np.zeros(n, dtype=np.int64), row_mask, seg_ids, rank, num_segments,
-        {"want_count": True, "want_sum": False, "want_min": False,
-         "want_max": False})["count"]
-    present = presence > 0
-
+    # ------------------------------------------------ aggregate wants
     col_wants: dict[str, dict] = {}
     for a in query.aggs:
         if a.column is None:
@@ -166,22 +116,142 @@ def execute_scan_aggregate(batch: ScanBatch, query: TpuQuery) -> AggResult:
             "want_max": False, "want_first": False, "want_last": False})
         for k, v in AggSpec._NEEDS[a.func].items():
             w[k] = w[k] or v
+    needs_rank = any(a.func in ("first", "last") for a in query.aggs)
 
-    col_results: dict[str, dict] = {}
-    for cname, wants in col_wants.items():
-        if cname not in batch.fields:
-            col_results[cname] = None
-            continue
-        vt, vals, valid = batch.fields[cname]
-        if vt in (ValueType.STRING, ValueType.GEOMETRY):
-            col_results[cname] = _host_string_agg(
-                vals, valid & row_mask, seg_ids, rank, num_segments, wants)
-            continue
-        dev_vals = vals if vt != ValueType.BOOLEAN else vals.astype(np.int64)
-        col_results[cname] = kernels.aggregate_column_host(
-            dev_vals, valid & row_mask, seg_ids, rank, num_segments, wants)
+    # ------------------------------------------------ bucket geometry (meta only)
+    ts_lo = int(batch.ts.min())
+    ts_hi = int(batch.ts.max())
+    if query.time_bucket is not None:
+        origin, interval = query.time_bucket
+        bmin = (ts_lo - origin) // interval
+        bmax = (ts_hi - origin) // interval
+        dense_span = int(bmax - bmin + 1)
+    else:
+        origin = interval = bmin = 0
+        dense_span = 1
 
-    # ------------------------------------------------ assemble result table
+    arith = None
+    if query.time_bucket is not None:
+        from .fused import bucket_arith_params
+
+        arith = bucket_arith_params(ts_lo, origin, interval, int(bmin),
+                                    max_span_ns=ts_hi - ts_lo)
+    i32_ok = (ts_hi - ts_lo) < (2**31 - 2) * 1_000_000_000
+    use_device = (_device_eligible(batch, query, col_wants, dense_span)
+                  and i32_ok
+                  and (query.time_bucket is None or arith is not None))
+
+    if use_device:
+        from .device_cache import device_batch
+        from .fused import launch_fused
+
+        n_buckets = dense_span if query.time_bucket is not None else 1
+        if query.time_bucket is not None:
+            bucket_starts = origin + (bmin + np.arange(n_buckets, dtype=np.int64)) * interval
+        else:
+            bucket_starts = None
+        num_segments = n_groups * n_buckets
+        dbatch = device_batch(batch)
+        pending = launch_fused(dbatch, query.filter, group_of_series,
+                               n_groups, n_buckets, arith, col_wants)
+
+        def complete():
+            res = pending.fetch()
+            presence = res.pop("__presence__")["count"]
+            present = presence > 0
+            col_results = {c: res.get(c) for c in col_wants}
+            return _assemble(batch, query, presence, present, col_results,
+                             group_labels, bucket_starts, n_buckets,
+                             needs_rank, order=None)
+
+        return complete
+    else:
+        # ---------------------------------------- host-prep path
+        group_of_row = group_of_series[batch.sid_ordinal]
+        if query.time_bucket is not None:
+            b = (batch.ts - origin) // interval
+            if dense_span <= _DENSE_BUCKET_LIMIT:
+                bucket_ids = (b - bmin).astype(np.int32)
+                bucket_starts = origin + (bmin + np.arange(dense_span, dtype=np.int64)) * interval
+                n_buckets = dense_span
+            else:
+                uniq, inv = np.unique(b, return_inverse=True)
+                bucket_ids = inv.astype(np.int32)
+                bucket_starts = origin + uniq * interval
+                n_buckets = len(uniq)
+        else:
+            bucket_ids = np.zeros(n, dtype=np.int32)
+            bucket_starts = None
+            n_buckets = 1
+
+        num_segments = n_groups * n_buckets
+        seg_ids = (group_of_row.astype(np.int64) * n_buckets
+                   + bucket_ids.astype(np.int64)).astype(np.int32)
+
+        # -------------------------------------------- filter
+        row_mask = np.ones(n, dtype=bool)
+        if query.filter is not None:
+            env = _filter_env(batch)
+            has_is_null = _contains_is_null(query.filter)
+            missing = [c for c in query.filter.columns() if c not in env]
+            if missing and not has_is_null:
+                # a schema column with no data in this vnode is all-NULL
+                # here: any comparison on it matches nothing
+                row_mask = np.zeros(n, dtype=bool)
+            else:
+                for c in missing:  # IS NULL paths need the env entries
+                    env[c] = np.zeros(n)
+                    env[f"__valid__:{c}"] = np.zeros(n, dtype=bool)
+                row_mask = np.asarray(query.filter.eval(env, np), dtype=bool)
+                if row_mask.shape == ():  # constant predicate
+                    row_mask = np.full(n, bool(row_mask))
+                # SQL three-valued logic approximation: a NULL operand makes
+                # a comparison non-matching, so rows where a referenced field
+                # is null are excluded — except under an explicit IS NULL.
+                if not has_is_null:
+                    for cname in query.filter.columns():
+                        if cname in batch.fields:
+                            row_mask &= batch.fields[cname][2]
+        seg_ids = np.where(row_mask, seg_ids, 0).astype(np.int32)
+
+        # -------------------------------------------- rank for first/last
+        if needs_rank:
+            order = np.argsort(batch.ts, kind="stable")
+            rank = np.empty(n, dtype=np.int32)
+            rank[order] = np.arange(n, dtype=np.int32)
+        else:
+            order = None
+            rank = np.zeros(n, dtype=np.int32)
+
+        # -------------------------------------------- per-column kernels
+        presence = kernels.aggregate_column_host(
+            np.zeros(n, dtype=np.int64), row_mask, seg_ids, rank, num_segments,
+            {"want_count": True, "want_sum": False, "want_min": False,
+             "want_max": False})["count"]
+        present = presence > 0
+
+        col_results = {}
+        for cname, wants in col_wants.items():
+            if cname not in batch.fields:
+                col_results[cname] = None
+                continue
+            vt, vals, valid = batch.fields[cname]
+            if vt in (ValueType.STRING, ValueType.GEOMETRY):
+                col_results[cname] = _host_string_agg(
+                    vals, valid & row_mask, seg_ids, rank, num_segments, wants)
+                continue
+            dev_vals = vals if vt != ValueType.BOOLEAN else vals.astype(np.int64)
+            col_results[cname] = kernels.aggregate_column_host(
+                dev_vals, valid & row_mask, seg_ids, rank, num_segments,
+                {**wants, "want_count": True})
+
+        return _assemble(batch, query, presence, present, col_results,
+                         group_labels, bucket_starts, n_buckets, needs_rank,
+                         order)
+
+
+def _assemble(batch, query, presence, present, col_results, group_labels,
+              bucket_starts, n_buckets, needs_rank, order) -> AggResult:
     out_cols: dict[str, np.ndarray] = {}
     out_valid: dict[str, np.ndarray] = {}
     sel = np.nonzero(present)[0]
@@ -214,27 +284,58 @@ def execute_scan_aggregate(batch: ScanBatch, query: TpuQuery) -> AggResult:
                 out_cols[a.alias] = np.where(c > 0, s / np.maximum(c, 1), np.nan)
             out_valid[a.alias] = c > 0
         elif a.func == "sum":
-            have = _have_values(r, sel, batch, a.column, seg_ids, row_mask, num_segments)
+            have = cnt[sel] > 0
             out_cols[a.alias] = r["sum"][sel]
             out_valid[a.alias] = have
-        elif a.func in ("min", "max", "first", "last"):
-            have = _have_values(r, sel, batch, a.column, seg_ids, row_mask, num_segments)
+        elif a.func in ("min", "max"):
+            have = cnt[sel] > 0
             out_cols[a.alias] = r[a.func][sel]
             out_valid[a.alias] = have
+        elif a.func in ("first", "last"):
+            have = cnt[sel] > 0
+            out_cols[a.alias] = r[a.func][sel]
+            out_valid[a.alias] = have
+            # hidden timestamp of the selected row: lets a coordinator merge
+            # first/last partials across vnodes by actual time order
+            rk = r.get(f"{a.func}_rank")
+            if rk is not None and needs_rank:
+                sorted_ts = _sorted_ts(batch, order)
+                ranks = np.clip(rk[sel], 0, len(sorted_ts) - 1)
+                out_cols[a.alias + "__ts"] = sorted_ts[ranks]
     return AggResult(out_cols, len(sel), out_valid)
 
 
-def _have_values(r, sel, batch, column, seg_ids, row_mask, num_segments):
-    cnt = r.get("count")
-    if cnt is None:
-        vt, vals, valid = batch.fields[column]
-        cnt = kernels.aggregate_column_host(
-            np.zeros(len(seg_ids), dtype=np.int64), valid & row_mask, seg_ids,
-            np.zeros(len(seg_ids), dtype=np.int32), num_segments,
-            {"want_count": True, "want_sum": False, "want_min": False,
-             "want_max": False})["count"]
-        r["count"] = cnt
-    return cnt[sel] > 0
+def _sorted_ts(batch: ScanBatch, order) -> np.ndarray:
+    cached = getattr(batch, "_sorted_ts", None)
+    if cached is None:
+        cached = batch.ts[order] if order is not None else np.sort(batch.ts, kind="stable")
+        batch._sorted_ts = cached
+    return cached
+
+
+def _device_eligible(batch: ScanBatch, query: TpuQuery,
+                     col_wants: dict, dense_span: int) -> bool:
+    """Fused device path applies when the whole query is expressible over
+    device-resident numeric columns (no strings/tags in filter or aggs, no
+    IS NULL, dense bucket range)."""
+    if dense_span > _DENSE_BUCKET_LIMIT:
+        return False
+    for cname in col_wants:
+        f = batch.fields.get(cname)
+        if f is not None and f[0] in (ValueType.STRING, ValueType.GEOMETRY):
+            return False
+    if query.filter is not None:
+        if _contains_is_null(query.filter):
+            return False
+        for c in query.filter.columns():
+            f = batch.fields.get(c)
+            if c == "time":
+                return False  # i64 time never rides to device; host path
+            if f is None:
+                return False  # tag / absent column → host semantics
+            if f[0] in (ValueType.STRING, ValueType.GEOMETRY):
+                return False
+    return True
 
 
 def _contains_is_null(e) -> bool:
@@ -299,6 +400,7 @@ def _host_string_agg(vals, valid, seg_ids, rank, num_segments, wants):
             if rank[i] > lr[s]:
                 lr[s] = rank[i]; lv[s] = vals[i]
         out["first"], out["last"] = fv, lv
+        out["first_rank"], out["last_rank"] = fr, lr
     if wants.get("want_sum"):
         out["sum"] = np.zeros(num_segments)
     return out

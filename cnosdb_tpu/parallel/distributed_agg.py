@@ -83,4 +83,7 @@ def distributed_aggregate_host(values: np.ndarray, valid: np.ndarray,
     dr = jax.device_put(rank, sharding)
     out = _dist_kernel(dv, dm, ds, dr, mesh=mesh, num_segments=ns_pad,
                        want_first=want_first, want_last=want_last)
-    return {k: np.asarray(v)[:num_segments] for k, v in out.items()}
+    host = {k: np.asarray(v)[:num_segments] for k, v in out.items()}
+    if "count" in host:
+        host["count"] = host["count"].astype(np.int64)
+    return host

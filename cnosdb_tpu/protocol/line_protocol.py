@@ -1,0 +1,158 @@
+"""InfluxDB line protocol parser.
+
+Role-parity with the reference's protocol parser
+(common/protocol_parser/src/line_protocol/parser.rs:40-49 +
+lines_convert.rs): text lines → WriteBatch grouped per (table, series),
+which is the shape the coordinator and vnode apply path consume.
+
+Format: measurement[,tag=v...] field=value[,field=value...] [timestamp]
+Escapes: '\\,' '\\ ' '\\=' in names/tags; fields: 1.5 (float), 3i (int),
+7u (unsigned), "text" (string), t/f/true/false (bool).
+"""
+from __future__ import annotations
+
+import time as _time
+
+from ..errors import ParserError
+from ..models.points import SeriesRows, WriteBatch
+from ..models.schema import Precision, ValueType
+from ..models.series import SeriesKey, Tag
+
+
+def parse_lines(text: str, precision: Precision = Precision.NS,
+                default_time_ns: int | None = None) -> WriteBatch:
+    factor = precision.to_ns_factor()
+    now = default_time_ns if default_time_ns is not None else int(_time.time() * 1e9)
+    groups: dict[tuple[str, tuple], dict] = {}
+    for lineno, raw in enumerate(text.splitlines(), 1):
+        line = raw.strip()
+        if not line or line.startswith("#"):
+            continue
+        try:
+            measurement, tags, fields, ts = _parse_line(line)
+        except ParserError:
+            raise
+        except Exception as e:
+            raise ParserError(f"line {lineno}: {e}", line=raw[:120])
+        ts_ns = ts * factor if ts is not None else now
+        key = (measurement, tuple(sorted(tags)))
+        g = groups.get(key)
+        if g is None:
+            g = groups[key] = {"tags": tags, "ts": [], "fields": {}}
+        idx = len(g["ts"])
+        g["ts"].append(ts_ns)
+        for fname, (vt, val) in fields.items():
+            col = g["fields"].setdefault(fname, (vt, []))
+            if col[0] != vt:
+                raise ParserError(
+                    f"line {lineno}: field {fname!r} type conflict in batch")
+            vals = col[1]
+            while len(vals) < idx:
+                vals.append(None)
+            vals.append(val)
+    wb = WriteBatch()
+    for (measurement, tag_items), g in groups.items():
+        n = len(g["ts"])
+        fields = {}
+        for fname, (vt, vals) in g["fields"].items():
+            while len(vals) < n:
+                vals.append(None)
+            fields[fname] = (int(vt), vals)
+        sk = SeriesKey(measurement, [Tag(k, v) for k, v in g["tags"].items()])
+        wb.add_series(measurement, SeriesRows(sk, g["ts"], fields))
+    return wb
+
+
+def _split_escaped(s: str, sep: str, unescape: bool = False) -> list[str]:
+    """Split on unescaped `sep`. Escape sequences are PRESERVED unless
+    `unescape` (so nested splits see them); unescape only at the last
+    splitting level."""
+    out = []
+    cur = []
+    i = 0
+    n = len(s)
+    in_quotes = False
+    while i < n:
+        c = s[i]
+        if c == "\\" and i + 1 < n and not in_quotes:
+            if unescape:
+                cur.append(s[i + 1])
+            else:
+                cur.append(c)
+                cur.append(s[i + 1])
+            i += 2
+            continue
+        if c == '"':
+            in_quotes = not in_quotes
+            cur.append(c)
+            i += 1
+            continue
+        if c == sep and not in_quotes:
+            out.append("".join(cur))
+            cur = []
+            i += 1
+            continue
+        cur.append(c)
+        i += 1
+    out.append("".join(cur))
+    return out
+
+
+def _unescape(s: str) -> str:
+    out = []
+    i = 0
+    while i < len(s):
+        if s[i] == "\\" and i + 1 < len(s):
+            out.append(s[i + 1])
+            i += 2
+        else:
+            out.append(s[i])
+            i += 1
+    return "".join(out)
+
+
+def _parse_line(line: str):
+    # split into up to 3 sections on unescaped spaces
+    sections = _split_escaped(line, " ")
+    sections = [s for s in sections if s != ""]
+    if len(sections) < 2:
+        raise ParserError("missing fields section")
+    head = sections[0]
+    field_str = sections[1]
+    ts = None
+    if len(sections) >= 3:
+        ts = int(sections[2])
+    head_parts = _split_escaped(head, ",")
+    measurement = _unescape(head_parts[0])
+    if not measurement:
+        raise ParserError("empty measurement")
+    tags = {}
+    for t in head_parts[1:]:
+        kv = _split_escaped(t, "=")
+        if len(kv) != 2:
+            raise ParserError(f"bad tag {t!r}")
+        tags[_unescape(kv[0])] = _unescape(kv[1])
+    fields = {}
+    for f in _split_escaped(field_str, ","):
+        kv = _split_escaped(f, "=")
+        if len(kv) != 2:
+            raise ParserError(f"bad field {f!r}")
+        fields[_unescape(kv[0])] = _parse_field_value(kv[1])
+    if not fields:
+        raise ParserError("no fields")
+    return measurement, tags, fields, ts
+
+
+def _parse_field_value(v: str):
+    if len(v) >= 2 and v[0] == '"' and v[-1] == '"':
+        return (ValueType.STRING, v[1:-1].replace('\\"', '"'))
+    lv = v.lower()
+    if lv in ("t", "true"):
+        return (ValueType.BOOLEAN, True)
+    if lv in ("f", "false"):
+        return (ValueType.BOOLEAN, False)
+    if v.endswith("i"):
+        return (ValueType.INTEGER, int(v[:-1]))
+    if v.endswith("u"):
+        return (ValueType.UNSIGNED, int(v[:-1]))
+    return (ValueType.FLOAT, float(v))

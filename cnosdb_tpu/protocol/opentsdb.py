@@ -1,0 +1,58 @@
+"""OpenTSDB telnet `put` protocol parser.
+
+Role-parity with common/protocol_parser/src/open_tsdb/: lines of
+`put <metric> <ts> <value> tag=v ...` → WriteBatch (field name "value",
+timestamps auto-scaled: seconds or milliseconds accepted like the
+reference).
+"""
+from __future__ import annotations
+
+from ..errors import ParserError
+from ..models.points import SeriesRows, WriteBatch
+from ..models.schema import ValueType
+from ..models.series import SeriesKey, Tag
+
+
+def parse_opentsdb(text: str) -> WriteBatch:
+    groups: dict[tuple[str, tuple], dict] = {}
+    for lineno, raw in enumerate(text.splitlines(), 1):
+        line = raw.strip()
+        if not line:
+            continue
+        parts = line.split()
+        if parts[0] == "put":
+            parts = parts[1:]
+        if len(parts) < 3:
+            raise ParserError(f"opentsdb line {lineno}: too few fields")
+        metric, ts_s, val_s = parts[0], parts[1], parts[2]
+        tags = {}
+        for kv in parts[3:]:
+            k, _, v = kv.partition("=")
+            if not _:
+                raise ParserError(f"opentsdb line {lineno}: bad tag {kv!r}")
+            tags[k] = v
+        try:
+            ts = int(ts_s)
+        except ValueError:
+            raise ParserError(f"opentsdb line {lineno}: bad timestamp {ts_s!r}")
+        # auto-scale: s (10 digits) or ms (13) → ns
+        if ts < 10**11:
+            ts *= 10**9
+        elif ts < 10**14:
+            ts *= 10**6
+        elif ts < 10**17:
+            ts *= 10**3
+        try:
+            val = float(val_s)
+        except ValueError:
+            raise ParserError(f"opentsdb line {lineno}: bad value {val_s!r}")
+        key = (metric, tuple(sorted(tags.items())))
+        g = groups.setdefault(key, {"tags": tags, "ts": [], "vals": []})
+        g["ts"].append(ts)
+        g["vals"].append(val)
+    wb = WriteBatch()
+    for (metric, _), g in groups.items():
+        sk = SeriesKey(metric, [Tag(k, v) for k, v in g["tags"].items()])
+        wb.add_series(metric, SeriesRows(
+            sk, g["ts"], {"value": (int(ValueType.FLOAT), g["vals"])}))
+    return wb

@@ -1,0 +1,266 @@
+"""HTTP service: the primary user-facing API.
+
+Role-parity with the reference's HttpService (main/src/http/
+http_service.rs): /api/v1/write (line protocol), /api/v1/sql, /api/v1/ping,
+/api/v1/opentsdb/write, /metrics (Prometheus text), with basic auth and
+per-request db / precision / pretty parameters, csv|json result encoding
+via the Accept header (main/src/http/response.rs, result_format.rs).
+"""
+from __future__ import annotations
+
+import asyncio
+import base64
+import json
+
+import numpy as np
+from aiohttp import web
+
+from .. import __version__
+from ..errors import CnosError, ParserError, QueryError
+from ..models.schema import Precision
+from ..parallel.coordinator import Coordinator
+from ..parallel.meta import MetaStore, DEFAULT_TENANT
+from ..protocol.line_protocol import parse_lines
+from ..sql.executor import QueryExecutor, ResultSet, Session
+from ..storage.engine import TsKv
+from .metrics import MetricsRegistry
+
+
+class HttpServer:
+    def __init__(self, meta: MetaStore, coord: Coordinator,
+                 executor: QueryExecutor, auth_enabled: bool = False):
+        self.meta = meta
+        self.coord = coord
+        self.executor = executor
+        self.auth_enabled = auth_enabled
+        self.metrics = MetricsRegistry()
+        self.app = web.Application(client_max_size=512 * 1024 * 1024)
+        self.app.add_routes([
+            web.post("/api/v1/write", self.handle_write),
+            web.post("/api/v1/sql", self.handle_sql),
+            web.get("/api/v1/ping", self.handle_ping),
+            web.post("/api/v1/opentsdb/write", self.handle_opentsdb_write),
+            web.post("/api/v1/prom/write", self.handle_prom_write),
+            web.get("/metrics", self.handle_metrics),
+            web.get("/debug/health", self.handle_ping),
+        ])
+
+    # ------------------------------------------------------------- helpers
+    def _auth(self, request) -> tuple[str, str]:
+        """→ (user, tenant); raises 401 on failure."""
+        hdr = request.headers.get("Authorization", "")
+        user, password = "root", ""
+        if hdr.startswith("Basic "):
+            try:
+                dec = base64.b64decode(hdr[6:]).decode()
+                user, _, password = dec.partition(":")
+            except Exception:
+                raise web.HTTPUnauthorized(text="bad authorization header")
+        elif self.auth_enabled:
+            raise web.HTTPUnauthorized(text="authorization required")
+        if self.auth_enabled:
+            u = self.meta.users.get(user)
+            if u is None or u.get("password", "") != password:
+                raise web.HTTPUnauthorized(text="invalid user or password")
+        tenant = request.query.get("tenant", DEFAULT_TENANT)
+        return user, tenant
+
+    def _session(self, request) -> Session:
+        user, tenant = self._auth(request)
+        db = request.query.get("db", "public")
+        return Session(tenant=tenant, database=db, user=user)
+
+    # ------------------------------------------------------------- handlers
+    async def handle_ping(self, request):
+        return web.json_response({"version": __version__, "status": "healthy"})
+
+    async def handle_write(self, request):
+        session = self._session(request)
+        precision = request.query.get("precision", "ns")
+        try:
+            prec = Precision.parse(precision)
+        except Exception:
+            return _err_response(400, ParserError(f"bad precision {precision!r}"))
+        body = await request.text()
+        try:
+            batch = parse_lines(body, prec)
+            loop = asyncio.get_running_loop()
+            await loop.run_in_executor(
+                None, lambda: self.coord.write_points(
+                    session.tenant, session.database, batch))
+        except CnosError as e:
+            self.metrics.incr("http_write_errors")
+            return _err_response(_status_for(e), e)
+        self.metrics.incr("http_writes")
+        self.metrics.incr("http_points_written", batch.n_rows())
+        return web.Response(status=200)
+
+    async def handle_sql(self, request):
+        session = self._session(request)
+        sql = (await request.text()).strip()
+        if not sql:
+            return _err_response(400, QueryError("empty sql"))
+        accept = request.headers.get("Accept", "application/csv")
+        try:
+            loop = asyncio.get_running_loop()
+            results = await loop.run_in_executor(
+                None, lambda: self.executor.execute_sql(sql, session))
+        except CnosError as e:
+            self.metrics.incr("http_sql_errors")
+            return _err_response(_status_for(e), e)
+        self.metrics.incr("http_queries")
+        rs = results[-1] if results else ResultSet.empty()
+        if "json" in accept:
+            return web.Response(text=format_json(rs),
+                                content_type="application/json")
+        if "table" in accept:
+            return web.Response(text=format_table(rs), content_type="text/plain")
+        return web.Response(text=format_csv(rs), content_type="text/csv")
+
+    async def handle_opentsdb_write(self, request):
+        """OpenTSDB telnet-style put lines over HTTP (reference
+        tcp_service + opentsdb parser)."""
+        session = self._session(request)
+        body = await request.text()
+        from ..protocol.opentsdb import parse_opentsdb
+
+        try:
+            batch = parse_opentsdb(body)
+            self.coord.write_points(session.tenant, session.database, batch)
+        except CnosError as e:
+            return _err_response(_status_for(e), e)
+        return web.Response(status=200)
+
+    async def handle_prom_write(self, request):
+        return _err_response(501, QueryError(
+            "prometheus remote write requires snappy; not yet enabled"))
+
+    async def handle_metrics(self, request):
+        return web.Response(text=self.metrics.prometheus_text(),
+                            content_type="text/plain")
+
+    # ------------------------------------------------------------- lifecycle
+    async def start(self, host: str = "0.0.0.0", port: int = 8902):
+        runner = web.AppRunner(self.app)
+        await runner.setup()
+        site = web.TCPSite(runner, host, port)
+        await site.start()
+        return runner
+
+
+# ---------------------------------------------------------------------------
+# result formatting (reference main/src/http/result_format.rs)
+# ---------------------------------------------------------------------------
+def _cell(v):
+    if v is None:
+        return ""
+    if isinstance(v, float) and np.isnan(v):
+        return ""
+    if isinstance(v, np.floating):
+        return repr(float(v))
+    if isinstance(v, (np.integer,)):
+        return str(int(v))
+    if isinstance(v, np.bool_):
+        return "true" if v else "false"
+    if isinstance(v, bool):
+        return "true" if v else "false"
+    return str(v)
+
+
+def format_csv(rs: ResultSet) -> str:
+    lines = [",".join(rs.names)]
+    for row in rs.rows():
+        lines.append(",".join(_csv_escape(_cell(v)) for v in row))
+    return "\n".join(lines) + "\n"
+
+
+def _csv_escape(s: str) -> str:
+    if "," in s or '"' in s or "\n" in s:
+        return '"' + s.replace('"', '""') + '"'
+    return s
+
+
+def _json_value(v):
+    if v is None:
+        return None
+    if isinstance(v, (np.floating, float)):
+        f = float(v)
+        return None if np.isnan(f) else f
+    if isinstance(v, np.integer):
+        return int(v)
+    if isinstance(v, (np.bool_, bool)):
+        return bool(v)
+    return str(v) if not isinstance(v, (int, str)) else v
+
+
+def format_json(rs: ResultSet) -> str:
+    out = [
+        {n: _json_value(v) for n, v in zip(rs.names, row)}
+        for row in rs.rows()
+    ]
+    return json.dumps(out)
+
+
+def format_table(rs: ResultSet) -> str:
+    rows = [[_cell(v) for v in row] for row in rs.rows()]
+    widths = [max(len(n), *(len(r[i]) for r in rows)) if rows else len(n)
+              for i, n in enumerate(rs.names)]
+    sep = "+" + "+".join("-" * (w + 2) for w in widths) + "+"
+    def fmt_row(cells):
+        return "| " + " | ".join(c.ljust(w) for c, w in zip(cells, widths)) + " |"
+    lines = [sep, fmt_row(rs.names), sep]
+    for r in rows:
+        lines.append(fmt_row(r))
+    lines.append(sep)
+    return "\n".join(lines) + "\n"
+
+
+def _status_for(e: CnosError) -> int:
+    from ..errors import (
+        AuthError, DatabaseNotFound, ParserError, PlanError, TableNotFound,
+    )
+
+    if isinstance(e, AuthError):
+        return 401
+    if isinstance(e, (ParserError, PlanError, DatabaseNotFound, TableNotFound)):
+        return 422
+    return 500
+
+
+def _err_response(status: int, e: CnosError):
+    return web.json_response(
+        {"error_code": getattr(e, "code", "000000"), "error_message": str(e)},
+        status=status)
+
+
+def build_server(data_dir: str, auth_enabled: bool = False,
+                 wal_sync: bool = False):
+    """Wire meta + engine + coordinator + executor (reference
+    server.rs ServiceBuilder::build_query_storage)."""
+    import os
+
+    meta = MetaStore(os.path.join(data_dir, "meta", "meta.json"))
+    engine = TsKv(os.path.join(data_dir, "db"), wal_sync=wal_sync)
+    engine.open_existing()
+    coord = Coordinator(meta, engine)
+    executor = QueryExecutor(meta, coord)
+    return HttpServer(meta, coord, executor, auth_enabled=auth_enabled)
+
+
+def run_server(args) -> int:
+    import asyncio
+
+    server = build_server(args.data_dir)
+
+    async def main():
+        await server.start(port=args.http_port)
+        print(f"cnosdb-tpu listening on :{args.http_port} "
+              f"(data dir {args.data_dir}, mode {getattr(args, 'mode', 'singleton')})")
+        while True:
+            await asyncio.sleep(3600)
+
+    try:
+        asyncio.run(main())
+    except KeyboardInterrupt:
+        server.coord.engine.close()
+    return 0

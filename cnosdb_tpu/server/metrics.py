@@ -1,0 +1,62 @@
+"""Metrics registry with Prometheus text exposition.
+
+Role-parity with common/metrics (metric_register.rs, prom_reporter.rs):
+typed counters/gauges/histograms exported at GET /metrics.
+"""
+from __future__ import annotations
+
+import threading
+from collections import defaultdict
+
+
+class MetricsRegistry:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counters: dict[tuple[str, tuple], float] = defaultdict(float)
+        self._gauges: dict[tuple[str, tuple], float] = {}
+        self._histograms: dict[tuple[str, tuple], list] = defaultdict(list)
+        self._hist_bounds = [0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1, 5, 10, 60]
+
+    def incr(self, name: str, value: float = 1, **labels):
+        with self._lock:
+            self._counters[(name, _lk(labels))] += value
+
+    def set_gauge(self, name: str, value: float, **labels):
+        with self._lock:
+            self._gauges[(name, _lk(labels))] = value
+
+    def observe(self, name: str, value: float, **labels):
+        with self._lock:
+            self._histograms[(name, _lk(labels))].append(value)
+
+    def prometheus_text(self) -> str:
+        out = []
+        with self._lock:
+            for (name, labels), v in sorted(self._counters.items()):
+                out.append(f"# TYPE {name} counter")
+                out.append(f"{name}{_fmt(labels)} {v}")
+            for (name, labels), v in sorted(self._gauges.items()):
+                out.append(f"# TYPE {name} gauge")
+                out.append(f"{name}{_fmt(labels)} {v}")
+            for (name, labels), vals in sorted(self._histograms.items()):
+                out.append(f"# TYPE {name} histogram")
+                cum = 0
+                for b in self._hist_bounds:
+                    cum = sum(1 for x in vals if x <= b)
+                    out.append(f'{name}_bucket{_fmt(labels, le=b)} {cum}')
+                out.append(f'{name}_bucket{_fmt(labels, le="+Inf")} {len(vals)}')
+                out.append(f"{name}_sum{_fmt(labels)} {sum(vals)}")
+                out.append(f"{name}_count{_fmt(labels)} {len(vals)}")
+        return "\n".join(out) + "\n"
+
+
+def _lk(labels: dict) -> tuple:
+    return tuple(sorted(labels.items()))
+
+
+def _fmt(labels: tuple, **extra) -> str:
+    items = list(labels) + sorted(extra.items())
+    if not items:
+        return ""
+    inner = ",".join(f'{k}="{v}"' for k, v in items)
+    return "{" + inner + "}"

@@ -1,0 +1,185 @@
+"""SQL statement AST.
+
+Role-parity with the reference's ExtStatement
+(query_server/spi/src/query/ast.rs:16-73): standard SELECT/INSERT/DELETE
+plus CnosDB DDL (databases with TTL/SHARD/REPLICA/VNODE_DURATION/PRECISION,
+tables with CODEC and TAGS(...)), SHOW/DESCRIBE, tenants/users, and admin
+statements. Expressions reuse sql.expr's dual-target IR.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+from .expr import Expr
+
+
+@dataclass
+class SelectItem:
+    expr: Any               # Expr | "*"
+    alias: str | None = None
+
+
+@dataclass
+class SelectStmt:
+    items: list[SelectItem]
+    table: str | None
+    where: Optional[Expr] = None
+    group_by: list = field(default_factory=list)    # Expr | int (1-based) | str
+    having: Optional[Expr] = None
+    order_by: list = field(default_factory=list)    # (Expr|str, asc: bool)
+    limit: int | None = None
+    offset: int | None = None
+    distinct: bool = False
+    database: str | None = None   # explicit db qualifier (FROM db.table)
+
+
+@dataclass
+class CreateDatabase:
+    name: str
+    if_not_exists: bool = False
+    options: dict = field(default_factory=dict)  # ttl/shard/vnode_duration/replica/precision
+
+
+@dataclass
+class AlterDatabase:
+    name: str
+    options: dict = field(default_factory=dict)
+
+
+@dataclass
+class DropDatabase:
+    name: str
+    if_exists: bool = False
+
+
+@dataclass
+class ColumnDef:
+    name: str
+    type_name: str
+    codec: str | None = None
+
+
+@dataclass
+class CreateTable:
+    name: str
+    fields: list[ColumnDef]
+    tags: list[str]
+    if_not_exists: bool = False
+
+
+@dataclass
+class DropTable:
+    name: str
+    if_exists: bool = False
+
+
+@dataclass
+class AlterTable:
+    name: str
+    action: str                      # add_field/add_tag/drop/alter_codec
+    column: ColumnDef | None = None
+    drop_name: str | None = None
+
+
+@dataclass
+class ShowStmt:
+    kind: str                        # databases/tables/series/tag_values/queries
+    table: str | None = None
+    tag_key: str | None = None
+    where: Optional[Expr] = None
+    on_database: str | None = None
+    limit: int | None = None
+    offset: int | None = None
+
+
+@dataclass
+class DescribeStmt:
+    kind: str                        # table/database
+    name: str = ""
+
+
+@dataclass
+class InsertStmt:
+    table: str
+    columns: list[str]
+    rows: list[list]                 # literal values per row
+    select: SelectStmt | None = None
+
+
+@dataclass
+class DeleteStmt:
+    table: str
+    where: Optional[Expr] = None
+
+
+@dataclass
+class UpdateStmt:
+    table: str
+    assignments: dict[str, Expr]
+    where: Optional[Expr] = None
+
+
+@dataclass
+class ExplainStmt:
+    inner: Any
+    analyze: bool = False
+    verbose: bool = False
+
+
+@dataclass
+class CreateTenant:
+    name: str
+    if_not_exists: bool = False
+    comment: str = ""
+
+
+@dataclass
+class DropTenant:
+    name: str
+    if_exists: bool = False
+
+
+@dataclass
+class CreateUser:
+    name: str
+    password: str = ""
+    if_not_exists: bool = False
+    comment: str = ""
+
+
+@dataclass
+class DropUser:
+    name: str
+    if_exists: bool = False
+
+
+@dataclass
+class AlterUser:
+    name: str
+    password: str | None = None
+
+
+@dataclass
+class CompactStmt:
+    database: str | None = None
+
+
+@dataclass
+class FlushStmt:
+    database: str | None = None
+
+
+@dataclass
+class KillQuery:
+    query_id: int
+
+
+@dataclass
+class IntervalValue:
+    """INTERVAL literal resolved to nanoseconds."""
+
+    ns: int
+
+    def __repr__(self):
+        return f"Interval({self.ns}ns)"

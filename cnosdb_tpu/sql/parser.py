@@ -1,0 +1,800 @@
+"""SQL lexer + recursive-descent parser.
+
+Role-parity with the reference's parser (query_server/query/src/sql/
+parser.rs, 3 255 LoC wrapping sqlparser-rs): standard SELECT plus the
+CnosDB statement set. Built from scratch (no sqlparser dependency exists
+in this environment): a regex lexer and precedence-climbing expression
+parser producing sql.ast nodes over the sql.expr IR.
+"""
+from __future__ import annotations
+
+import re
+from datetime import datetime, timezone
+
+from ..errors import ParserError
+from . import ast
+from .expr import (
+    Between, BinOp, Column, Expr, Func, InList, IsNull, Literal, UnaryOp,
+)
+
+# ---------------------------------------------------------------------------
+# lexer
+# ---------------------------------------------------------------------------
+_TOKEN_RE = re.compile(
+    r"""
+    (?P<ws>\s+|--[^\n]*|/\*.*?\*/)
+  | (?P<number>\d+\.\d*(?:[eE][+-]?\d+)?|\.\d+(?:[eE][+-]?\d+)?|\d+(?:[eE][+-]?\d+)?)
+  | (?P<string>'(?:[^']|'')*')
+  | (?P<qident>"(?:[^"]|"")*")
+  | (?P<ident>[A-Za-z_][A-Za-z0-9_]*)
+  | (?P<op><>|!=|<=|>=|\|\||<|>|=|\+|-|\*|/|%|\(|\)|,|\.|;)
+    """,
+    re.VERBOSE | re.DOTALL,
+)
+
+
+class Token:
+    __slots__ = ("kind", "value", "pos")
+
+    def __init__(self, kind, value, pos):
+        self.kind = kind
+        self.value = value
+        self.pos = pos
+
+    def __repr__(self):
+        return f"{self.kind}:{self.value}"
+
+
+def tokenize(sql: str) -> list[Token]:
+    out = []
+    pos = 0
+    n = len(sql)
+    while pos < n:
+        m = _TOKEN_RE.match(sql, pos)
+        if not m:
+            raise ParserError(f"unexpected character {sql[pos]!r}", at=pos)
+        pos = m.end()
+        kind = m.lastgroup
+        if kind == "ws":
+            continue
+        text = m.group()
+        if kind == "string":
+            out.append(Token("string", text[1:-1].replace("''", "'"), m.start()))
+        elif kind == "qident":
+            out.append(Token("ident", text[1:-1].replace('""', '"'), m.start()))
+        elif kind == "ident":
+            out.append(Token("ident", text, m.start()))
+        elif kind == "number":
+            out.append(Token("number", text, m.start()))
+        else:
+            out.append(Token("op", text, m.start()))
+    out.append(Token("eof", "", n))
+    return out
+
+
+_INTERVAL_UNITS = {
+    "nanosecond": 1, "nanoseconds": 1,
+    "microsecond": 1_000, "microseconds": 1_000,
+    "millisecond": 1_000_000, "milliseconds": 1_000_000,
+    "second": 10**9, "seconds": 10**9,
+    "minute": 60 * 10**9, "minutes": 60 * 10**9,
+    "hour": 3600 * 10**9, "hours": 3600 * 10**9,
+    "day": 86400 * 10**9, "days": 86400 * 10**9,
+    "week": 7 * 86400 * 10**9, "weeks": 7 * 86400 * 10**9,
+    "month": 30 * 86400 * 10**9, "months": 30 * 86400 * 10**9,
+    "year": 365 * 86400 * 10**9, "years": 365 * 86400 * 10**9,
+}
+
+_SHORT_UNITS = {
+    "ns": 1, "us": 1_000, "ms": 1_000_000, "s": 10**9,
+    "m": 60 * 10**9, "h": 3600 * 10**9, "d": 86400 * 10**9,
+    "w": 7 * 86400 * 10**9, "y": 365 * 86400 * 10**9,
+}
+
+
+def parse_interval_string(s: str) -> int:
+    """'1 minute', '10m', '1 hour 30 minutes' → ns."""
+    s = s.strip().lower()
+    total = 0
+    m_all = re.findall(r"(\d+(?:\.\d+)?)\s*([a-z]+)", s)
+    if not m_all:
+        raise ParserError(f"bad interval {s!r}")
+    for num, unit in m_all:
+        factor = _INTERVAL_UNITS.get(unit) or _SHORT_UNITS.get(unit)
+        if factor is None:
+            raise ParserError(f"bad interval unit {unit!r}")
+        total += int(float(num) * factor)
+    return total
+
+
+def parse_timestamp_string(s: str) -> int:
+    """RFC3339-ish → ns since epoch (UTC assumed when naive)."""
+    t = s.strip()
+    try:
+        if t.endswith("Z"):
+            t = t[:-1] + "+00:00"
+        frac_ns = 0
+        m = re.search(r"\.(\d+)", t)
+        if m and len(m.group(1)) > 6:
+            digits = m.group(1)
+            frac_ns = int(digits[6:].ljust(3, "0")[:3])
+            t = t.replace("." + digits, "." + digits[:6])
+        dt = datetime.fromisoformat(t)
+        if dt.tzinfo is None:
+            dt = dt.replace(tzinfo=timezone.utc)
+        return int(dt.timestamp() * 1_000_000_000) + frac_ns
+    except ParserError:
+        raise
+    except Exception:
+        raise ParserError(f"bad timestamp {s!r}")
+
+
+# ---------------------------------------------------------------------------
+# parser
+# ---------------------------------------------------------------------------
+class Parser:
+    def __init__(self, sql: str):
+        self.tokens = tokenize(sql)
+        self.i = 0
+
+    # -- token helpers ---------------------------------------------------
+    def peek(self) -> Token:
+        return self.tokens[self.i]
+
+    def next(self) -> Token:
+        t = self.tokens[self.i]
+        self.i += 1
+        return t
+
+    def kw(self) -> str | None:
+        t = self.peek()
+        return t.value.upper() if t.kind == "ident" else None
+
+    def accept_kw(self, *kws: str) -> bool:
+        if self.kw() in kws:
+            self.next()
+            return True
+        return False
+
+    def expect_kw(self, *kws: str) -> str:
+        k = self.kw()
+        if k not in kws:
+            raise ParserError(f"expected {'/'.join(kws)}, got {self.peek().value!r}")
+        self.next()
+        return k
+
+    def accept_op(self, op: str) -> bool:
+        t = self.peek()
+        if t.kind == "op" and t.value == op:
+            self.next()
+            return True
+        return False
+
+    def expect_op(self, op: str):
+        if not self.accept_op(op):
+            raise ParserError(f"expected {op!r}, got {self.peek().value!r}")
+
+    def expect_ident(self) -> str:
+        t = self.peek()
+        if t.kind != "ident":
+            raise ParserError(f"expected identifier, got {t.value!r}")
+        return self.next().value
+
+    def expect_string(self) -> str:
+        t = self.peek()
+        if t.kind != "string":
+            raise ParserError(f"expected string literal, got {t.value!r}")
+        return self.next().value
+
+    def expect_number(self) -> float | int:
+        t = self.peek()
+        if t.kind != "number":
+            raise ParserError(f"expected number, got {t.value!r}")
+        self.next()
+        return _num(t.value)
+
+    # -- entry -----------------------------------------------------------
+    def parse_statements(self) -> list:
+        stmts = []
+        while self.peek().kind != "eof":
+            if self.accept_op(";"):
+                continue
+            stmts.append(self.parse_statement())
+            if self.peek().kind != "eof":
+                self.expect_op(";")
+        return stmts
+
+    def parse_statement(self):
+        k = self.kw()
+        if k == "SELECT":
+            return self.parse_select()
+        if k == "EXPLAIN":
+            self.next()
+            analyze = self.accept_kw("ANALYZE")
+            verbose = self.accept_kw("VERBOSE")
+            return ast.ExplainStmt(self.parse_statement(), analyze, verbose)
+        if k == "CREATE":
+            return self.parse_create()
+        if k == "DROP":
+            return self.parse_drop()
+        if k == "ALTER":
+            return self.parse_alter()
+        if k == "SHOW":
+            return self.parse_show()
+        if k in ("DESCRIBE", "DESC"):
+            return self.parse_describe()
+        if k == "INSERT":
+            return self.parse_insert()
+        if k == "DELETE":
+            return self.parse_delete()
+        if k == "UPDATE":
+            return self.parse_update()
+        if k == "COMPACT":
+            self.next()
+            self.expect_kw("DATABASE")
+            return ast.CompactStmt(self.expect_ident())
+        if k == "FLUSH":
+            self.next()
+            db = None
+            if self.accept_kw("DATABASE"):
+                db = self.expect_ident()
+            return ast.FlushStmt(db)
+        if k == "KILL":
+            self.next()
+            self.accept_kw("QUERY")
+            return ast.KillQuery(int(self.expect_number()))
+        raise ParserError(f"unsupported statement start {self.peek().value!r}")
+
+    # -- SELECT ----------------------------------------------------------
+    def parse_select(self) -> ast.SelectStmt:
+        self.expect_kw("SELECT")
+        distinct = self.accept_kw("DISTINCT")
+        self.accept_kw("ALL")
+        items = [self.parse_select_item()]
+        while self.accept_op(","):
+            items.append(self.parse_select_item())
+        table = None
+        database = None
+        if self.accept_kw("FROM"):
+            table = self.expect_ident()
+            if self.accept_op("."):   # db.table — db qualifier recorded
+                database = table
+                table = self.expect_ident()
+        where = self.parse_expr() if self.accept_kw("WHERE") else None
+        group_by = []
+        if self.accept_kw("GROUP"):
+            self.expect_kw("BY")
+            group_by.append(self.parse_group_item())
+            while self.accept_op(","):
+                group_by.append(self.parse_group_item())
+        having = self.parse_expr() if self.accept_kw("HAVING") else None
+        order_by = []
+        if self.accept_kw("ORDER"):
+            self.expect_kw("BY")
+            order_by.append(self.parse_order_item())
+            while self.accept_op(","):
+                order_by.append(self.parse_order_item())
+        limit = offset = None
+        if self.accept_kw("LIMIT"):
+            limit = int(self.expect_number())
+        if self.accept_kw("OFFSET"):
+            offset = int(self.expect_number())
+        return ast.SelectStmt(items, table, where, group_by, having,
+                              order_by, limit, offset, distinct, database)
+
+    def parse_select_item(self) -> ast.SelectItem:
+        if self.accept_op("*"):
+            return ast.SelectItem("*")
+        e = self.parse_expr()
+        alias = None
+        if self.accept_kw("AS"):
+            alias = self.expect_ident()
+        elif (self.peek().kind == "ident"
+              and self.kw() not in ("FROM", "WHERE", "GROUP", "HAVING",
+                                    "ORDER", "LIMIT", "OFFSET")):
+            alias = self.next().value
+        return ast.SelectItem(e, alias)
+
+    def parse_group_item(self):
+        t = self.peek()
+        if t.kind == "number":
+            return int(self.expect_number())
+        return self.parse_expr()
+
+    def parse_order_item(self):
+        e = self.parse_expr()
+        asc = True
+        if self.accept_kw("DESC"):
+            asc = False
+        else:
+            self.accept_kw("ASC")
+        return (e, asc)
+
+    # -- DDL -------------------------------------------------------------
+    def parse_create(self):
+        self.expect_kw("CREATE")
+        k = self.kw()
+        if k == "DATABASE":
+            self.next()
+            ine = self._if_not_exists()
+            name = self.expect_ident()
+            opts = {}
+            if self.accept_kw("WITH"):
+                while True:
+                    o = self.kw()
+                    if o == "TTL":
+                        self.next()
+                        opts["ttl"] = self.expect_string()
+                    elif o == "SHARD":
+                        self.next()
+                        opts["shard_num"] = int(self.expect_number())
+                    elif o == "VNODE_DURATION":
+                        self.next()
+                        opts["vnode_duration"] = self.expect_string()
+                    elif o == "REPLICA":
+                        self.next()
+                        opts["replica"] = int(self.expect_number())
+                    elif o == "PRECISION":
+                        self.next()
+                        opts["precision"] = self.expect_string()
+                    else:
+                        break
+            return ast.CreateDatabase(name, ine, opts)
+        if k == "TABLE":
+            self.next()
+            ine = self._if_not_exists()
+            name = self.expect_ident()
+            fields, tags = [], []
+            self.expect_op("(")
+            while True:
+                if self.accept_kw("TAGS"):
+                    self.expect_op("(")
+                    tags.append(self.expect_ident())
+                    while self.accept_op(","):
+                        tags.append(self.expect_ident())
+                    self.expect_op(")")
+                else:
+                    cname = self.expect_ident()
+                    tname = self.expect_ident()
+                    if tname.upper() == "BIGINT" and self.kw() == "UNSIGNED":
+                        self.next()
+                        tname = "BIGINT UNSIGNED"
+                    codec = None
+                    if self.accept_kw("CODEC"):
+                        self.expect_op("(")
+                        codec = self.expect_ident()
+                        self.expect_op(")")
+                    fields.append(ast.ColumnDef(cname, tname, codec))
+                if not self.accept_op(","):
+                    break
+            self.expect_op(")")
+            return ast.CreateTable(name, fields, tags, ine)
+        if k == "TENANT":
+            self.next()
+            ine = self._if_not_exists()
+            name = self.expect_ident()
+            comment = ""
+            if self.accept_kw("WITH"):
+                if self.accept_kw("COMMENT"):
+                    self.accept_op("=")
+                    comment = self.expect_string()
+            return ast.CreateTenant(name, ine, comment)
+        if k == "USER":
+            self.next()
+            ine = self._if_not_exists()
+            name = self.expect_ident()
+            password = ""
+            comment = ""
+            if self.accept_kw("WITH"):
+                while True:
+                    if self.accept_kw("PASSWORD"):
+                        self.accept_op("=")
+                        password = self.expect_string()
+                    elif self.accept_kw("COMMENT"):
+                        self.accept_op("=")
+                        comment = self.expect_string()
+                    else:
+                        break
+                    self.accept_op(",")
+            return ast.CreateUser(name, password, ine, comment)
+        raise ParserError(f"unsupported CREATE {k}")
+
+    def _if_not_exists(self) -> bool:
+        if self.kw() == "IF":
+            self.next()
+            self.expect_kw("NOT")
+            self.expect_kw("EXISTS")
+            return True
+        return False
+
+    def _if_exists(self) -> bool:
+        if self.kw() == "IF":
+            self.next()
+            self.expect_kw("EXISTS")
+            return True
+        return False
+
+    def parse_drop(self):
+        self.expect_kw("DROP")
+        k = self.kw()
+        if k == "DATABASE":
+            self.next()
+            ie = self._if_exists()
+            return ast.DropDatabase(self.expect_ident(), ie)
+        if k == "TABLE":
+            self.next()
+            ie = self._if_exists()
+            return ast.DropTable(self.expect_ident(), ie)
+        if k == "TENANT":
+            self.next()
+            ie = self._if_exists()
+            return ast.DropTenant(self.expect_ident(), ie)
+        if k == "USER":
+            self.next()
+            ie = self._if_exists()
+            return ast.DropUser(self.expect_ident(), ie)
+        raise ParserError(f"unsupported DROP {k}")
+
+    def parse_alter(self):
+        self.expect_kw("ALTER")
+        k = self.kw()
+        if k == "DATABASE":
+            self.next()
+            name = self.expect_ident()
+            self.expect_kw("SET")
+            opts = {}
+            while True:
+                o = self.kw()
+                if o == "TTL":
+                    self.next()
+                    opts["ttl"] = self.expect_string()
+                elif o == "SHARD":
+                    self.next()
+                    opts["shard_num"] = int(self.expect_number())
+                elif o == "VNODE_DURATION":
+                    self.next()
+                    opts["vnode_duration"] = self.expect_string()
+                elif o == "REPLICA":
+                    self.next()
+                    opts["replica"] = int(self.expect_number())
+                else:
+                    break
+            return ast.AlterDatabase(name, opts)
+        if k == "TABLE":
+            self.next()
+            name = self.expect_ident()
+            if self.accept_kw("ADD"):
+                if self.accept_kw("TAG"):
+                    return ast.AlterTable(name, "add_tag",
+                                          ast.ColumnDef(self.expect_ident(), "STRING"))
+                self.accept_kw("FIELD")
+                cname = self.expect_ident()
+                tname = self.expect_ident()
+                codec = None
+                if self.accept_kw("CODEC"):
+                    self.expect_op("(")
+                    codec = self.expect_ident()
+                    self.expect_op(")")
+                return ast.AlterTable(name, "add_field",
+                                      ast.ColumnDef(cname, tname, codec))
+            if self.accept_kw("DROP"):
+                self.accept_kw("COLUMN")
+                return ast.AlterTable(name, "drop", drop_name=self.expect_ident())
+            raise ParserError("unsupported ALTER TABLE action")
+        if k == "USER":
+            self.next()
+            name = self.expect_ident()
+            self.expect_kw("SET")
+            self.expect_kw("PASSWORD")
+            self.accept_op("=")
+            return ast.AlterUser(name, self.expect_string())
+        raise ParserError(f"unsupported ALTER {k}")
+
+    def parse_show(self):
+        self.expect_kw("SHOW")
+        k = self.kw()
+        if k == "DATABASES":
+            self.next()
+            return ast.ShowStmt("databases")
+        if k == "TABLES":
+            self.next()
+            db = None
+            if self.accept_kw("ON"):
+                db = self.expect_ident()
+            return ast.ShowStmt("tables", on_database=db)
+        if k == "SERIES":
+            self.next()
+            stmt = ast.ShowStmt("series")
+            if self.accept_kw("FROM"):
+                stmt.table = self.expect_ident()
+            if self.accept_kw("WHERE"):
+                stmt.where = self.parse_expr()
+            if self.accept_kw("LIMIT"):
+                stmt.limit = int(self.expect_number())
+            if self.accept_kw("OFFSET"):
+                stmt.offset = int(self.expect_number())
+            return stmt
+        if k == "TAG":
+            self.next()
+            if self.accept_kw("VALUES"):
+                stmt = ast.ShowStmt("tag_values")
+                if self.accept_kw("FROM"):
+                    stmt.table = self.expect_ident()
+                self.expect_kw("WITH")
+                self.expect_kw("KEY")
+                self.accept_op("=")
+                stmt.tag_key = self.expect_ident()
+                if self.accept_kw("LIMIT"):
+                    stmt.limit = int(self.expect_number())
+                return stmt
+            self.expect_kw("KEYS")
+            stmt = ast.ShowStmt("tag_keys")
+            if self.accept_kw("FROM"):
+                stmt.table = self.expect_ident()
+            return stmt
+        if k == "QUERIES":
+            self.next()
+            return ast.ShowStmt("queries")
+        raise ParserError(f"unsupported SHOW {k}")
+
+    def parse_describe(self):
+        self.next()
+        k = self.kw()
+        if k == "TABLE":
+            self.next()
+            return ast.DescribeStmt("table", self.expect_ident())
+        if k == "DATABASE":
+            self.next()
+            return ast.DescribeStmt("database", self.expect_ident())
+        return ast.DescribeStmt("table", self.expect_ident())
+
+    def parse_insert(self):
+        self.expect_kw("INSERT")
+        self.expect_kw("INTO")
+        table = self.expect_ident()
+        columns = []
+        if self.accept_op("("):
+            columns.append(self.expect_ident())
+            while self.accept_op(","):
+                columns.append(self.expect_ident())
+            self.expect_op(")")
+        if self.kw() == "SELECT":
+            return ast.InsertStmt(table, columns, [], self.parse_select())
+        self.expect_kw("VALUES")
+        rows = []
+        while True:
+            self.expect_op("(")
+            row = [self.parse_literal_value()]
+            while self.accept_op(","):
+                row.append(self.parse_literal_value())
+            self.expect_op(")")
+            rows.append(row)
+            if not self.accept_op(","):
+                break
+        return ast.InsertStmt(table, columns, rows)
+
+    def parse_literal_value(self):
+        e = self.parse_expr()
+        return _const_eval(e)
+
+    def parse_delete(self):
+        self.expect_kw("DELETE")
+        self.expect_kw("FROM")
+        table = self.expect_ident()
+        where = self.parse_expr() if self.accept_kw("WHERE") else None
+        return ast.DeleteStmt(table, where)
+
+    def parse_update(self):
+        self.expect_kw("UPDATE")
+        table = self.expect_ident()
+        self.expect_kw("SET")
+        assigns = {}
+        while True:
+            col = self.expect_ident()
+            self.expect_op("=")
+            assigns[col] = self.parse_expr()
+            if not self.accept_op(","):
+                break
+        where = self.parse_expr() if self.accept_kw("WHERE") else None
+        return ast.UpdateStmt(table, assigns, where)
+
+    # -- expressions (precedence climbing) -------------------------------
+    def parse_expr(self) -> Expr:
+        return self.parse_or()
+
+    def parse_or(self) -> Expr:
+        e = self.parse_and()
+        while self.accept_kw("OR"):
+            e = BinOp("or", e, self.parse_and())
+        return e
+
+    def parse_and(self) -> Expr:
+        e = self.parse_not()
+        while self.accept_kw("AND"):
+            e = BinOp("and", e, self.parse_not())
+        return e
+
+    def parse_not(self) -> Expr:
+        if self.accept_kw("NOT"):
+            return UnaryOp("not", self.parse_not())
+        return self.parse_comparison()
+
+    def parse_comparison(self) -> Expr:
+        e = self.parse_additive()
+        while True:
+            t = self.peek()
+            if t.kind == "op" and t.value in ("=", "!=", "<>", "<", "<=", ">", ">="):
+                self.next()
+                op = "!=" if t.value == "<>" else t.value
+                e = BinOp(op, e, self.parse_additive())
+            elif self.kw() == "IS":
+                self.next()
+                negated = self.accept_kw("NOT")
+                self.expect_kw("NULL")
+                e = IsNull(e, negated)
+            elif self.kw() in ("IN", "NOT"):
+                negated = False
+                if self.kw() == "NOT":
+                    save = self.i
+                    self.next()
+                    if self.kw() == "IN":
+                        negated = True
+                    elif self.kw() == "BETWEEN":
+                        self.next()
+                        lo = self.parse_additive()
+                        self.expect_kw("AND")
+                        hi = self.parse_additive()
+                        e = Between(e, lo, hi, negated=True)
+                        continue
+                    else:
+                        self.i = save
+                        break
+                if self.kw() == "IN":
+                    self.next()
+                    self.expect_op("(")
+                    vals = [_const_eval(self.parse_expr())]
+                    while self.accept_op(","):
+                        vals.append(_const_eval(self.parse_expr()))
+                    self.expect_op(")")
+                    e = InList(e, vals, negated)
+                else:
+                    break
+            elif self.kw() == "BETWEEN":
+                self.next()
+                lo = self.parse_additive()
+                self.expect_kw("AND")
+                hi = self.parse_additive()
+                e = Between(e, lo, hi)
+            else:
+                break
+        return e
+
+    def parse_additive(self) -> Expr:
+        e = self.parse_multiplicative()
+        while True:
+            t = self.peek()
+            if t.kind == "op" and t.value in ("+", "-"):
+                self.next()
+                e = BinOp(t.value, e, self.parse_multiplicative())
+            else:
+                break
+        return e
+
+    def parse_multiplicative(self) -> Expr:
+        e = self.parse_unary()
+        while True:
+            t = self.peek()
+            if t.kind == "op" and t.value in ("*", "/", "%"):
+                self.next()
+                e = BinOp(t.value, e, self.parse_unary())
+            else:
+                break
+        return e
+
+    def parse_unary(self) -> Expr:
+        if self.accept_op("-"):
+            operand = self.parse_unary()
+            if isinstance(operand, Literal) and isinstance(operand.value, (int, float)):
+                return Literal(-operand.value)
+            return UnaryOp("-", operand)
+        if self.accept_op("+"):
+            return self.parse_unary()
+        return self.parse_primary()
+
+    def parse_primary(self) -> Expr:
+        t = self.peek()
+        if t.kind == "number":
+            self.next()
+            return Literal(_num(t.value))
+        if t.kind == "string":
+            self.next()
+            return Literal(t.value)
+        if self.accept_op("("):
+            e = self.parse_expr()
+            self.expect_op(")")
+            return e
+        if t.kind == "ident":
+            k = t.value.upper()
+            if k == "TRUE":
+                self.next()
+                return Literal(True)
+            if k == "FALSE":
+                self.next()
+                return Literal(False)
+            if k == "NULL":
+                self.next()
+                return Literal(None)
+            if k == "INTERVAL":
+                self.next()
+                s = self.expect_string()
+                if self.peek().kind == "ident" and self.kw() in (
+                        u.upper() for u in _INTERVAL_UNITS):
+                    unit = self.next().value.lower()
+                    return Literal(ast.IntervalValue(
+                        parse_interval_string(s + " " + unit)))
+                return Literal(ast.IntervalValue(parse_interval_string(s)))
+            if k == "TIMESTAMP":
+                self.next()
+                return Literal(parse_timestamp_string(self.expect_string()))
+            if k == "NOW" :
+                self.next()
+                self.expect_op("(")
+                self.expect_op(")")
+                import time as _time
+
+                return Literal(int(_time.time() * 1e9))
+            if k in _RESERVED:
+                raise ParserError(f"unexpected keyword {t.value!r} in expression")
+            name = self.next().value
+            if self.accept_op("("):
+                if self.accept_op("*"):
+                    self.expect_op(")")
+                    return Func(name, [Literal("*")])
+                args = []
+                if not self.accept_op(")"):
+                    if self.accept_kw("DISTINCT"):
+                        args.append(Literal("__distinct__"))
+                    args.append(self.parse_expr())
+                    while self.accept_op(","):
+                        args.append(self.parse_expr())
+                    self.expect_op(")")
+                return Func(name, args)
+            return Column(name)
+        raise ParserError(f"unexpected token {t.value!r} in expression")
+
+
+_RESERVED = {
+    "SELECT", "FROM", "WHERE", "GROUP", "BY", "HAVING", "ORDER", "LIMIT",
+    "OFFSET", "AND", "OR", "NOT", "AS", "ASC", "DESC", "IN", "BETWEEN",
+    "IS", "CASE", "WHEN", "THEN", "ELSE", "END", "UNION", "JOIN", "ON",
+    "INNER", "LEFT", "RIGHT", "FULL", "CROSS", "INSERT", "INTO", "VALUES",
+    "DELETE", "UPDATE", "SET",
+}
+
+
+def _num(text: str):
+    if re.fullmatch(r"\d+", text):
+        return int(text)
+    return float(text)
+
+
+def _const_eval(e: Expr):
+    """Fold a literal-only expression to a python value (INSERT VALUES)."""
+    if isinstance(e, Literal):
+        return e.value
+    if isinstance(e, UnaryOp) and e.op == "-":
+        v = _const_eval(e.operand)
+        return -v
+    if isinstance(e, Func):
+        import numpy as np
+
+        return e.eval({}, np)
+    if isinstance(e, BinOp):
+        import numpy as np
+
+        return e.eval({}, np)
+    raise ParserError(f"expected literal value, got {e!r}")
+
+
+def parse_sql(sql: str) -> list:
+    return Parser(sql).parse_statements()

@@ -1,0 +1,373 @@
+"""Logical planning: AST → executable plans.
+
+Role-parity with the reference's planner + analyzer + optimizer stack
+(query_server/query/src/sql/planner.rs, extension/analyse/
+transform_time_window.rs, extension/logical/optimizer_rule/
+push_down_aggregation.rs, rewrite_tag_scan.rs): a SELECT becomes either an
+AggregatePlan — aggregates pushed into the TpuExec scan with time ranges /
+tag domains split out of WHERE for bucket+index pruning — or a RawScanPlan.
+The WHERE split mirrors Predicate::push_down_filter
+(common/models/src/predicate/domain.rs): exact time ranges from pure-time
+conjuncts, a sound tag-domain over-approximation for the index, and the
+residual expression re-checked at execution.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from ..errors import PlanError
+from ..models.predicate import ColumnDomains, TimeRange, TimeRanges, I64_MIN, I64_MAX
+from ..models.schema import TskvTableSchema
+from ..ops.tpu_exec import AggSpec
+from . import ast
+from .expr import (
+    Between, BinOp, Column, Expr, Func, InList, IsNull, Literal, UnaryOp,
+    extract_domains,
+)
+from .parser import parse_timestamp_string
+
+AGG_FUNCS = {"count", "sum", "avg", "mean", "min", "max", "first", "last",
+             "median", "stddev", "mode", "increase", "count_distinct"}
+
+TIME_COL = "time"
+
+
+@dataclass
+class AggregatePlan:
+    table: str
+    schema: TskvTableSchema
+    time_ranges: TimeRanges
+    tag_domains: ColumnDomains
+    filter: Expr | None                  # residual, re-checked on device/host
+    group_tags: list[str]
+    bucket: tuple[int, int] | None       # (origin, interval)
+    bucket_alias: str | None
+    aggs: list[AggSpec]                  # internal partial aggregates
+    output: list[tuple[str, Expr]]       # output name → expr over agg aliases/groups
+    having: Expr | None
+    order_by: list
+    limit: int | None
+    offset: int | None
+
+
+@dataclass
+class RawScanPlan:
+    table: str
+    schema: TskvTableSchema
+    time_ranges: TimeRanges
+    tag_domains: ColumnDomains
+    filter: Expr | None
+    output: list[tuple[str, Expr]]       # projections over row columns
+    order_by: list
+    limit: int | None
+    offset: int | None
+    distinct: bool = False
+
+
+# ---------------------------------------------------------------------------
+# WHERE splitting
+# ---------------------------------------------------------------------------
+def split_where(where: Expr | None, schema: TskvTableSchema):
+    """→ (time_ranges, tag_domains, residual_expr)."""
+    if where is None:
+        return TimeRanges.all(), ColumnDomains.all(), None
+    where = _normalize_time_literals(where)
+    conjuncts = _split_and(where)
+    time_trs = TimeRanges.all()
+    residual = []
+    for c in conjuncts:
+        tr = _pure_time_ranges(c)
+        if tr is not None:
+            time_trs = time_trs.intersect(tr)
+        else:
+            residual.append(c)
+    tag_cols = set(schema.tag_names())
+    res_expr = _join_and(residual)
+    tag_domains = extract_domains(res_expr, tag_cols)
+    return time_trs, tag_domains, res_expr
+
+
+def _split_and(e: Expr) -> list[Expr]:
+    if isinstance(e, BinOp) and e.op == "and":
+        return _split_and(e.left) + _split_and(e.right)
+    return [e]
+
+
+def _join_and(es: list[Expr]) -> Expr | None:
+    out = None
+    for e in es:
+        out = e if out is None else BinOp("and", out, e)
+    return out
+
+
+def _normalize_time_literals(e: Expr) -> Expr:
+    """Rewrite string literals compared against `time` into ns ints."""
+    if isinstance(e, BinOp):
+        l, r = _normalize_time_literals(e.left), _normalize_time_literals(e.right)
+        if e.op in ("=", "!=", "<", "<=", ">", ">="):
+            if _is_time_col(l) and isinstance(r, Literal) and isinstance(r.value, str):
+                r = Literal(parse_timestamp_string(r.value))
+            if _is_time_col(r) and isinstance(l, Literal) and isinstance(l.value, str):
+                l = Literal(parse_timestamp_string(l.value))
+        return BinOp(e.op, l, r)
+    if isinstance(e, Between) and _is_time_col(e.expr):
+        lo, hi = e.low, e.high
+        if isinstance(lo, Literal) and isinstance(lo.value, str):
+            lo = Literal(parse_timestamp_string(lo.value))
+        if isinstance(hi, Literal) and isinstance(hi.value, str):
+            hi = Literal(parse_timestamp_string(hi.value))
+        return Between(e.expr, lo, hi, e.negated)
+    if isinstance(e, UnaryOp):
+        return UnaryOp(e.op, _normalize_time_literals(e.operand))
+    return e
+
+
+def _is_time_col(e: Expr) -> bool:
+    return isinstance(e, Column) and e.name == TIME_COL
+
+
+def _pure_time_ranges(e: Expr) -> TimeRanges | None:
+    """If `e` constrains ONLY time, return its exact TimeRanges."""
+    if isinstance(e, BinOp) and e.op in ("=", "<", "<=", ">", ">="):
+        col, lit, op = _norm_cmp(e)
+        if col == TIME_COL and isinstance(lit, (int, float)):
+            v = int(lit)
+            return {
+                "=": TimeRanges([TimeRange(v, v)]),
+                "<": TimeRanges([TimeRange(I64_MIN, v - 1)]),
+                "<=": TimeRanges([TimeRange(I64_MIN, v)]),
+                ">": TimeRanges([TimeRange(v + 1, I64_MAX)]),
+                ">=": TimeRanges([TimeRange(v, I64_MAX)]),
+            }[op]
+    if isinstance(e, Between) and not e.negated and _is_time_col(e.expr):
+        if isinstance(e.low, Literal) and isinstance(e.high, Literal):
+            return TimeRanges([TimeRange(int(e.low.value), int(e.high.value))])
+    if isinstance(e, BinOp) and e.op == "or":
+        l = _pure_time_ranges(e.left)
+        r = _pure_time_ranges(e.right)
+        if l is not None and r is not None:
+            return l.union(r)
+    return None
+
+
+def _norm_cmp(e: BinOp):
+    flip = {"<": ">", "<=": ">=", ">": "<", ">=": "<=", "=": "="}
+    if isinstance(e.left, Column) and isinstance(e.right, Literal):
+        return e.left.name, e.right.value, e.op
+    if isinstance(e.left, Literal) and isinstance(e.right, Column):
+        return e.right.name, e.left.value, flip[e.op]
+    return None, None, None
+
+
+# ---------------------------------------------------------------------------
+# SELECT planning
+# ---------------------------------------------------------------------------
+def plan_select(stmt: ast.SelectStmt, schema: TskvTableSchema):
+    time_trs, tag_domains, residual = split_where(stmt.where, schema)
+
+    has_agg = any(_contains_agg(i.expr) for i in stmt.items
+                  if isinstance(i.expr, Expr))
+    if not has_agg and not stmt.group_by:
+        return _plan_raw(stmt, schema, time_trs, tag_domains, residual)
+    if not has_agg:
+        raise PlanError("GROUP BY requires aggregate functions in SELECT")
+    return _plan_aggregate(stmt, schema, time_trs, tag_domains, residual)
+
+
+def _contains_agg(e) -> bool:
+    if isinstance(e, Func) and e.name.lower() in AGG_FUNCS:
+        return True
+    for attr in ("left", "right", "operand", "expr", "low", "high"):
+        sub = getattr(e, attr, None)
+        if isinstance(sub, Expr) and _contains_agg(sub):
+            return True
+    for a in getattr(e, "args", None) or []:
+        if isinstance(a, Expr) and _contains_agg(a):
+            return True
+    return False
+
+
+def _is_bucket_func(e) -> bool:
+    return isinstance(e, Func) and e.name.lower() in ("date_bin", "time_window", "time_bucket")
+
+
+def _bucket_params(e: Func) -> tuple[int, int]:
+    """date_bin(INTERVAL, time[, origin]) / time_window(time, INTERVAL)."""
+    name = e.name.lower()
+    args = e.args
+    if name == "date_bin":
+        if not args or not isinstance(args[0], Literal) \
+                or not isinstance(args[0].value, ast.IntervalValue):
+            raise PlanError("date_bin needs INTERVAL first argument")
+        interval = args[0].value.ns
+        origin = 0
+        if len(args) >= 3 and isinstance(args[2], Literal):
+            v = args[2].value
+            origin = parse_timestamp_string(v) if isinstance(v, str) else int(v)
+        return origin, interval
+    # time_window(time, interval) / time_bucket(interval, time)
+    for a in args:
+        if isinstance(a, Literal) and isinstance(a.value, ast.IntervalValue):
+            return 0, a.value.ns
+        if isinstance(a, Literal) and isinstance(a.value, str):
+            from .parser import parse_interval_string
+
+            return 0, parse_interval_string(a.value)
+    raise PlanError(f"cannot extract interval from {e.to_sql()}")
+
+
+class _AggCollector:
+    def __init__(self, schema: TskvTableSchema):
+        self.schema = schema
+        self.aggs: list[AggSpec] = []
+        self._by_key: dict[tuple, str] = {}
+
+    def rewrite(self, e: Expr) -> Expr:
+        """Replace aggregate calls with Column(alias) over partial results."""
+        if isinstance(e, Func) and e.name.lower() in AGG_FUNCS:
+            return Column(self._register(e))
+        if isinstance(e, BinOp):
+            return BinOp(e.op, self.rewrite(e.left), self.rewrite(e.right))
+        if isinstance(e, UnaryOp):
+            return UnaryOp(e.op, self.rewrite(e.operand))
+        if isinstance(e, Func):
+            return Func(e.name, [self.rewrite(a) for a in e.args])
+        return e
+
+    def _register(self, f: Func) -> str:
+        name = f.name.lower()
+        if name == "avg":
+            name = "mean"
+        distinct = bool(f.args and isinstance(f.args[0], Literal)
+                        and f.args[0].value == "__distinct__")
+        args = [a for a in f.args
+                if not (isinstance(a, Literal) and a.value == "__distinct__")]
+        if name == "count" and args and isinstance(args[0], Literal) \
+                and args[0].value == "*":
+            col = None
+        else:
+            if not args or not isinstance(args[0], Column):
+                raise PlanError(f"aggregate argument must be a column: {f.to_sql()}")
+            col = args[0].name
+            if col != TIME_COL and not self.schema.contains_column(col):
+                raise PlanError(f"unknown column {col!r} in {f.to_sql()}")
+        if distinct:
+            if name != "count":
+                raise PlanError("DISTINCT only supported in count()")
+            name = "count_distinct"
+        key = (name, col)
+        if key in self._by_key:
+            return self._by_key[key]
+        alias = f"__agg{len(self.aggs)}"
+        self.aggs.append(AggSpec(name if name != "count_star" else "count",
+                                 col, alias))
+        self._by_key[key] = alias
+        return alias
+
+
+def _plan_aggregate(stmt, schema, time_trs, tag_domains, residual):
+    coll = _AggCollector(schema)
+    tag_names = set(schema.tag_names())
+
+    # aliases from select items (group by may reference them)
+    alias_map: dict[str, Expr] = {}
+    for it in stmt.items:
+        if isinstance(it.expr, Expr) and it.alias:
+            alias_map[it.alias] = it.expr
+
+    group_tags: list[str] = []
+    bucket = None
+    bucket_alias = None
+    group_exprs: list[Expr] = []
+
+    def classify_group(g):
+        nonlocal bucket, bucket_alias
+        if isinstance(g, int):
+            if g < 1 or g > len(stmt.items):
+                raise PlanError(f"GROUP BY position {g} out of range")
+            g = stmt.items[g - 1].expr
+        if isinstance(g, Column) and g.name in alias_map:
+            alias = g.name
+            g = alias_map[g.name]
+            if _is_bucket_func(g):
+                bucket = _bucket_params(g)
+                bucket_alias = alias
+                return
+        if _is_bucket_func(g):
+            bucket = _bucket_params(g)
+            return
+        if isinstance(g, Column):
+            if g.name in tag_names:
+                group_tags.append(g.name)
+                return
+            if g.name == TIME_COL:
+                raise PlanError("GROUP BY time requires date_bin/time_window")
+            raise PlanError(f"can only GROUP BY tags or time buckets, got {g.name!r}")
+        raise PlanError(f"unsupported GROUP BY expression {g!r}")
+
+    for g in stmt.group_by:
+        classify_group(g)
+
+    # outputs
+    output: list[tuple[str, Expr]] = []
+    for idx, it in enumerate(stmt.items):
+        e = it.expr
+        if e == "*":
+            raise PlanError("SELECT * cannot be combined with aggregates")
+        if _is_bucket_func(e):
+            name = it.alias or "time"
+            if bucket is None:
+                bucket = _bucket_params(e)
+                bucket_alias = it.alias
+            output.append((name, Column("time")))
+            continue
+        if isinstance(e, Column) and e.name in tag_names:
+            if e.name not in group_tags:
+                raise PlanError(f"column {e.name!r} must appear in GROUP BY")
+            output.append((it.alias or e.name, e))
+            continue
+        rewritten = coll.rewrite(e)
+        name = it.alias or (e.to_sql() if not isinstance(e, Func)
+                            else _default_agg_name(e))
+        output.append((name, rewritten))
+
+    having = coll.rewrite(stmt.having) if stmt.having is not None else None
+
+    order_by = []
+    for oe, asc in stmt.order_by:
+        if isinstance(oe, Column):
+            order_by.append((oe, asc))
+        else:
+            order_by.append((coll.rewrite(oe), asc))
+
+    return AggregatePlan(
+        table=stmt.table, schema=schema, time_ranges=time_trs,
+        tag_domains=tag_domains, filter=residual, group_tags=group_tags,
+        bucket=bucket, bucket_alias=bucket_alias, aggs=coll.aggs,
+        output=output, having=having, order_by=order_by,
+        limit=stmt.limit, offset=stmt.offset)
+
+
+def _default_agg_name(e: Func) -> str:
+    args = ", ".join(a.to_sql() for a in e.args)
+    return f"{e.name}({args})"
+
+
+def _plan_raw(stmt, schema, time_trs, tag_domains, residual):
+    output: list[tuple[str, Expr]] = []
+    for it in stmt.items:
+        if it.expr == "*":
+            output.append((TIME_COL, Column(TIME_COL)))
+            for c in schema.tag_columns:
+                output.append((c.name, Column(c.name)))
+            for c in schema.field_columns:
+                output.append((c.name, Column(c.name)))
+        else:
+            name = it.alias or (it.expr.name if isinstance(it.expr, Column)
+                                else it.expr.to_sql())
+            output.append((name, it.expr))
+    return RawScanPlan(
+        table=stmt.table, schema=schema, time_ranges=time_trs,
+        tag_domains=tag_domains, filter=residual, output=output,
+        order_by=stmt.order_by, limit=stmt.limit, offset=stmt.offset,
+        distinct=stmt.distinct)

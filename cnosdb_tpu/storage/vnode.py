@@ -49,6 +49,9 @@ class VnodeStorage:
         self.active = MemCache(vnode_id, memcache_bytes)
         self.immutables: list[MemCache] = []
         self.picker = picker or Picker()
+        # monotonically increasing snapshot id: bumps on any mutation so
+        # scan caches (host ScanBatch + device twin) invalidate naturally
+        self.data_version = 0
         self._replay_wal()
 
     # ------------------------------------------------------------------ boot
@@ -93,6 +96,7 @@ class VnodeStorage:
         # RAFT_BLANK/MEMBERSHIP: no storage effect
 
     def _apply_write(self, batch: WriteBatch, seq: int):
+        self.data_version += 1
         for table, series_list in batch.tables.items():
             for sr in series_list:
                 sid = self.index.add_series_if_not_exists(sr.key)
@@ -113,6 +117,8 @@ class VnodeStorage:
         """Rotate active cache and persist ALL immutables to L0 files."""
         with self.lock:
             self.switch_to_immutable()
+            if self.immutables:
+                self.data_version += 1
             for cache in self.immutables:
                 fid = self.summary.next_file_id()
                 path = os.path.join(self.dir, "delta", f"_{fid:06d}.tsm")
@@ -135,6 +141,9 @@ class VnodeStorage:
             edit = run_compaction(self.summary.version, req, fid)
             if edit is None:
                 return False
+            # bump only when the file set actually changes so no-op rounds
+            # don't invalidate scan caches
+            self.data_version += 1
             self.summary.apply(edit)
             gc_compacted_files(self.summary.version, edit)
             return True
@@ -152,6 +161,7 @@ class VnodeStorage:
             self._apply_drop_table(table)
 
     def _apply_drop_table(self, table: str):
+        self.data_version += 1
         self.active.delete_table(table)
         for c in self.immutables:
             c.delete_table(table)
@@ -168,6 +178,7 @@ class VnodeStorage:
             self._apply_delete_series(table, sids)
 
     def _apply_delete_series(self, table: str, sids):
+        self.data_version += 1
         for c in [self.active, *self.immutables]:
             for sid in sids:
                 c.delete_series(table, int(sid))
@@ -186,6 +197,7 @@ class VnodeStorage:
             self._apply_delete_time_range(table, sids, min_ts, max_ts)
 
     def _apply_delete_time_range(self, table: str, sids, min_ts: int, max_ts: int):
+        self.data_version += 1
         for c in [self.active, *self.immutables]:
             c.delete_time_range(table, sids, min_ts, max_ts)
         ents = ([TombstoneEntry(table, int(s), min_ts, max_ts) for s in sids]
@@ -196,6 +208,7 @@ class VnodeStorage:
 
     def _apply_update_tags(self, table: str, old_keys: list[bytes], new_keys: list[bytes]):
         """UPDATE tag values: re-key series (reference update_tags_value)."""
+        self.data_version += 1
         for ob, nb in zip(old_keys, new_keys):
             old_key = SeriesKey.decode(ob)
             sid = self.index.get_series_id(old_key)

@@ -1,0 +1,184 @@
+import json
+
+import numpy as np
+import pytest
+
+from cnosdb_tpu.config import Config
+from cnosdb_tpu.errors import ParserError
+from cnosdb_tpu.models.schema import Precision, ValueType
+from cnosdb_tpu.protocol.line_protocol import parse_lines
+from cnosdb_tpu.protocol.opentsdb import parse_opentsdb
+
+
+# ---------------------------------------------------------------- line proto
+def test_line_protocol_basic():
+    wb = parse_lines(
+        "cpu,host=h1,region=us usage_user=1.5,usage_system=2.0 1000\n"
+        "cpu,host=h1,region=us usage_user=2.5 2000\n"
+        "mem,host=h1 used=100i,total=200u,ok=t,name=\"srv 1\" 1000\n")
+    assert set(wb.tables) == {"cpu", "mem"}
+    cpu = wb.tables["cpu"][0]
+    assert cpu.key.tag_value("host") == "h1"
+    assert cpu.timestamps == [1000, 2000]
+    assert cpu.fields["usage_user"] == (int(ValueType.FLOAT), [1.5, 2.5])
+    assert cpu.fields["usage_system"] == (int(ValueType.FLOAT), [2.0, None])
+    mem = wb.tables["mem"][0]
+    assert mem.fields["used"][0] == int(ValueType.INTEGER)
+    assert mem.fields["total"][0] == int(ValueType.UNSIGNED)
+    assert mem.fields["ok"] == (int(ValueType.BOOLEAN), [True])
+    assert mem.fields["name"] == (int(ValueType.STRING), ["srv 1"])
+
+
+def test_line_protocol_escapes_and_precision():
+    wb = parse_lines("my\\ table,tag\\,1=a\\ b value=1 5", precision=Precision.MS)
+    sr = wb.tables["my table"][0]
+    assert sr.key.tag_value("tag,1") == "a b"
+    assert sr.timestamps == [5_000_000]
+
+
+def test_line_protocol_default_time_and_errors():
+    wb = parse_lines("cpu v=1", default_time_ns=42)
+    assert wb.tables["cpu"][0].timestamps == [42]
+    with pytest.raises(ParserError):
+        parse_lines("cpu")  # no fields
+    with pytest.raises(ParserError):
+        parse_lines("cpu v=")  # bad value
+
+
+def test_opentsdb():
+    wb = parse_opentsdb("put sys.cpu 1672531200 42.5 host=a dc=x\n"
+                        "sys.cpu 1672531201000 43.5 host=a dc=x\n")
+    sr = wb.tables["sys.cpu"][0]
+    assert sr.timestamps == [1672531200 * 10**9, 1672531201 * 10**9]
+    assert sr.fields["value"][1] == [42.5, 43.5]
+
+
+# ---------------------------------------------------------------- config
+def test_config_defaults_and_toml(tmp_path):
+    c = Config()
+    text = c.to_toml()
+    assert "[storage]" in text
+    p = tmp_path / "c.toml"
+    p.write_text("[service]\nhttp_listen_port = 9999\n[wal]\nsync = true\n")
+    c2 = Config.load(str(p))
+    assert c2.service.http_listen_port == 9999
+    assert c2.wal.sync is True
+    c3 = Config.load(str(p), env={"CNOSDB_SERVICE_HTTP_LISTEN_PORT": "7777"})
+    assert c3.service.http_listen_port == 7777
+    assert c2.check() == []
+
+
+# ---------------------------------------------------------------- HTTP
+class _HttpHarness:
+    """Runs the real aiohttp server in a background thread; plain urllib
+    client — no pytest plugins needed."""
+
+    def __init__(self, data_dir: str):
+        import asyncio
+        import socket
+        import threading
+
+        from cnosdb_tpu.server.http import build_server
+
+        self.server = build_server(data_dir)
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            self.port = s.getsockname()[1]
+        self._loop = asyncio.new_event_loop()
+        self._started = threading.Event()
+
+        def run():
+            asyncio.set_event_loop(self._loop)
+
+            async def boot():
+                self._runner = await self.server.start("127.0.0.1", self.port)
+                self._started.set()
+
+            self._loop.create_task(boot())
+            self._loop.run_forever()
+
+        self._thread = threading.Thread(target=run, daemon=True)
+        self._thread.start()
+        assert self._started.wait(10)
+
+    def request(self, method: str, path: str, data: str | None = None,
+                headers: dict | None = None):
+        import urllib.error
+        import urllib.request
+
+        url = f"http://127.0.0.1:{self.port}{path}"
+        req = urllib.request.Request(
+            url, data=data.encode() if data is not None else None,
+            headers=headers or {}, method=method)
+        try:
+            with urllib.request.urlopen(req, timeout=30) as resp:
+                return resp.status, resp.read().decode()
+        except urllib.error.HTTPError as e:
+            return e.code, e.read().decode()
+
+    def close(self):
+        self._loop.call_soon_threadsafe(self._loop.stop)
+        self._thread.join(timeout=5)
+        self.server.coord.engine.close()
+
+
+@pytest.fixture
+def http(tmp_path):
+    h = _HttpHarness(str(tmp_path / "srv"))
+    yield h
+    h.close()
+
+
+def test_http_ping(http):
+    status, body = http.request("GET", "/api/v1/ping")
+    assert status == 200
+    assert json.loads(body)["status"] == "healthy"
+
+
+def test_http_write_and_sql(http):
+    lines = "\n".join(
+        f"cpu,host=h{i % 2} usage={i}.5 {1672531200000000000 + i * 10**9}"
+        for i in range(10))
+    status, body = http.request("POST", "/api/v1/write?db=public", lines)
+    assert status == 200, body
+    status, text = http.request(
+        "POST", "/api/v1/sql?db=public",
+        "SELECT count(*) AS c, max(usage) AS m FROM cpu")
+    assert status == 200
+    assert text.splitlines()[0] == "c,m"
+    assert text.splitlines()[1] == "10,9.5"
+
+
+def test_http_sql_json_format(http):
+    http.request("POST", "/api/v1/write?db=public", "m,h=a v=1 100")
+    status, text = http.request("POST", "/api/v1/sql?db=public",
+                                "SELECT * FROM m",
+                                headers={"Accept": "application/json"})
+    assert json.loads(text) == [{"time": 100, "h": "a", "v": 1.0}]
+
+
+def test_http_sql_error(http):
+    status, body = http.request("POST", "/api/v1/sql?db=public",
+                                "SELECT * FROM missing")
+    assert status == 422
+    assert json.loads(body)["error_code"].startswith("02")
+
+
+def test_http_bad_write(http):
+    status, _ = http.request("POST", "/api/v1/write?db=public", "not-a-line")
+    assert status == 422
+
+
+def test_http_opentsdb_write(http):
+    status, _ = http.request("POST", "/api/v1/opentsdb/write?db=public",
+                             "put sys.load 1672531200 1.5 host=x")
+    assert status == 200
+    status, text = http.request("POST", "/api/v1/sql?db=public",
+                                'SELECT count(*) AS c FROM "sys.load"')
+    assert text.splitlines()[1] == "1"
+
+
+def test_http_metrics(http):
+    http.request("POST", "/api/v1/write?db=public", "m v=1 1")
+    status, text = http.request("GET", "/metrics")
+    assert "http_points_written" in text

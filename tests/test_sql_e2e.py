@@ -1,0 +1,199 @@
+"""End-to-end SQL tests: parse → plan → execute over a live engine."""
+import numpy as np
+import pytest
+
+from cnosdb_tpu.errors import CnosError, QueryError, TableNotFound
+from cnosdb_tpu.parallel.coordinator import Coordinator
+from cnosdb_tpu.parallel.meta import MetaStore
+from cnosdb_tpu.sql.executor import QueryExecutor, Session
+from cnosdb_tpu.storage.engine import TsKv
+
+
+@pytest.fixture
+def db(tmp_path):
+    meta = MetaStore(str(tmp_path / "meta.json"))
+    engine = TsKv(str(tmp_path / "data"))
+    coord = Coordinator(meta, engine)
+    ex = QueryExecutor(meta, coord)
+    yield ex
+    engine.close()
+
+
+@pytest.fixture
+def air(db):
+    """The reference's demo table (oceanic_station)."""
+    db.execute_one("CREATE TABLE air (visibility DOUBLE, temperature DOUBLE, "
+                   "pressure DOUBLE, TAGS(station))")
+    rows = []
+    for i in range(10):
+        t = 1672531200000000000 + i * 60_000_000_000  # 2023-01-01 + i min
+        st = "XiaoMaiDao" if i % 2 == 0 else "LianYunGang"
+        rows.append(f"({t}, '{st}', {50 + i}, {20 + i * 0.5}, {1000 + i})")
+    db.execute_one("INSERT INTO air (time, station, visibility, temperature, pressure) "
+                   "VALUES " + ", ".join(rows))
+    return db
+
+
+def test_create_show_describe(db):
+    db.execute_one("CREATE DATABASE mydb WITH TTL '30d' SHARD 2")
+    rs = db.execute_one("SHOW DATABASES")
+    assert "mydb" in rs.columns[0].tolist()
+    db.execute_one("CREATE TABLE air (visibility DOUBLE, TAGS(station))")
+    rs = db.execute_one("SHOW TABLES")
+    assert rs.columns[0].tolist() == ["air"]
+    rs = db.execute_one("DESCRIBE TABLE air")
+    d = dict(zip(rs.columns[0].tolist(), rs.columns[2].tolist()))
+    assert d["time"] == "TIME" and d["station"] == "TAG" and d["visibility"] == "FIELD"
+
+
+def test_insert_select_star(air):
+    rs = air.execute_one("SELECT * FROM air ORDER BY time")
+    assert rs.n_rows == 10
+    assert rs.names == ["time", "station", "visibility", "temperature", "pressure"]
+    assert rs.columns[2][0] == 50.0
+    assert rs.columns[1][1] == "LianYunGang"
+
+
+def test_select_where_projection(air):
+    rs = air.execute_one(
+        "SELECT temperature, visibility FROM air "
+        "WHERE station = 'XiaoMaiDao' AND visibility > 53 ORDER BY time")
+    assert rs.n_rows == 3  # i in {4,6,8}
+    np.testing.assert_allclose(rs.columns[0], [22.0, 23.0, 24.0])
+
+
+def test_global_aggregate(air):
+    rs = air.execute_one(
+        "SELECT count(*), avg(visibility), min(pressure), max(pressure) FROM air")
+    assert rs.rows()[0] == (10, pytest.approx(54.5), 1000.0, 1009.0)
+
+
+def test_group_by_tag(air):
+    rs = air.execute_one(
+        "SELECT station, count(*) AS c, max(temperature) AS mx FROM air "
+        "GROUP BY station ORDER BY station")
+    assert rs.rows() == [("LianYunGang", 5, pytest.approx(24.5)),
+                         ("XiaoMaiDao", 5, pytest.approx(24.0))]
+
+
+def test_group_by_time_bucket(air):
+    rs = air.execute_one(
+        "SELECT date_bin(INTERVAL '5 minutes', time) AS t, count(*) AS c "
+        "FROM air GROUP BY t ORDER BY t")
+    assert rs.n_rows == 2
+    assert rs.columns[1].tolist() == [5, 5]
+
+
+def test_double_groupby(air):
+    rs = air.execute_one(
+        "SELECT station, date_bin(INTERVAL '5 minutes', time) AS t, "
+        "avg(visibility) AS v FROM air GROUP BY station, t ORDER BY station, t")
+    assert rs.n_rows == 4
+    # LianYunGang odd minutes: {1,3} then {5,7,9}; XiaoMaiDao {0,2,4} then {6,8}
+    assert rs.columns[2].tolist() == pytest.approx([52.0, 57.0, 52.0, 57.0])
+
+
+def test_first_last(air):
+    rs = air.execute_one(
+        "SELECT station, first(visibility) AS f, last(visibility) AS l "
+        "FROM air GROUP BY station ORDER BY station")
+    assert rs.rows() == [("LianYunGang", 51.0, 59.0), ("XiaoMaiDao", 50.0, 58.0)]
+
+
+def test_having_and_arith(air):
+    rs = air.execute_one(
+        "SELECT station, max(visibility) - min(visibility) AS spread FROM air "
+        "GROUP BY station HAVING count(*) >= 5 ORDER BY station")
+    assert rs.columns[1].tolist() == [8.0, 8.0]
+
+
+def test_time_range_filter(air):
+    rs = air.execute_one(
+        "SELECT count(*) FROM air WHERE time >= '2023-01-01T00:03:00Z' "
+        "AND time < '2023-01-01T00:07:00Z'")
+    assert rs.columns[0][0] == 4
+
+
+def test_count_distinct(air):
+    rs = air.execute_one("SELECT count(DISTINCT station) FROM air")
+    assert rs.columns[0][0] == 2
+
+
+def test_limit_offset(air):
+    rs = air.execute_one("SELECT time FROM air ORDER BY time LIMIT 3 OFFSET 2")
+    assert rs.n_rows == 3
+    assert rs.columns[0][0] == 1672531200000000000 + 2 * 60_000_000_000
+
+
+def test_order_desc(air):
+    rs = air.execute_one("SELECT visibility FROM air ORDER BY visibility DESC LIMIT 2")
+    assert rs.columns[0].tolist() == [59.0, 58.0]
+
+
+def test_delete(air):
+    air.execute_one("DELETE FROM air WHERE time < '2023-01-01T00:05:00Z'")
+    rs = air.execute_one("SELECT count(*) FROM air")
+    assert rs.columns[0][0] == 5
+    air.execute_one("DELETE FROM air WHERE station = 'XiaoMaiDao'")
+    rs = air.execute_one("SELECT count(*) FROM air")
+    assert rs.columns[0][0] == 3
+
+
+def test_update_tag(air):
+    air.execute_one("UPDATE air SET station = 'Renamed' WHERE station = 'XiaoMaiDao'")
+    rs = air.execute_one("SHOW TAG VALUES FROM air WITH KEY = station")
+    assert rs.columns[0].tolist() == ["LianYunGang", "Renamed"]
+
+
+def test_show_series_tag_values(air):
+    rs = air.execute_one("SHOW SERIES FROM air")
+    assert rs.n_rows == 2
+    rs = air.execute_one("SHOW TAG VALUES FROM air WITH KEY = station")
+    assert set(rs.columns[0]) == {"XiaoMaiDao", "LianYunGang"}
+
+
+def test_explain(air):
+    rs = air.execute_one("EXPLAIN SELECT station, count(*) FROM air "
+                         "WHERE time > 100 GROUP BY station")
+    text = "\n".join(rs.columns[0])
+    assert "TpuAggregateExec" in text
+
+
+def test_alter_table_add_field(air):
+    air.execute_one("ALTER TABLE air ADD FIELD humidity DOUBLE")
+    rs = air.execute_one("DESCRIBE TABLE air")
+    assert "humidity" in rs.columns[0].tolist()
+    rs = air.execute_one("SELECT humidity FROM air LIMIT 1")
+    assert rs.columns[0][0] is None or np.isnan(rs.columns[0][0])
+
+
+def test_flush_then_query(air):
+    air.execute_one("FLUSH")
+    rs = air.execute_one("SELECT count(*) FROM air")
+    assert rs.columns[0][0] == 10
+
+
+def test_constant_select(db):
+    rs = db.execute_one("SELECT 1 + 2 AS x")
+    assert rs.columns[0][0] == 3
+
+
+def test_unknown_table_error(db):
+    with pytest.raises(TableNotFound):
+        db.execute_one("SELECT * FROM nope")
+
+
+def test_null_field_aggregation(db):
+    db.execute_one("CREATE TABLE m (a DOUBLE, b DOUBLE, TAGS(h))")
+    db.execute_one("INSERT INTO m (time, h, a) VALUES (1, 'x', 1.0)")
+    db.execute_one("INSERT INTO m (time, h, b) VALUES (2, 'x', 5.0)")
+    rs = db.execute_one("SELECT count(a), count(b), count(*), sum(a) FROM m")
+    assert rs.rows()[0] == (1, 1, 2, 1.0)
+
+
+def test_tenant_user_ddl(db):
+    db.execute_one("CREATE TENANT t2")
+    db.execute_one("CREATE USER u1 WITH PASSWORD = 'pw'")
+    db.execute_one("ALTER USER u1 SET PASSWORD = 'pw2'")
+    db.execute_one("DROP USER u1")
+    db.execute_one("DROP TENANT t2")

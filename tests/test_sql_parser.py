@@ -1,0 +1,166 @@
+import pytest
+
+from cnosdb_tpu.errors import ParserError
+from cnosdb_tpu.sql import ast
+from cnosdb_tpu.sql.expr import BinOp, Between, Column, Func, InList, IsNull, Literal
+from cnosdb_tpu.sql.parser import parse_sql, parse_interval_string, parse_timestamp_string
+
+
+def one(sql):
+    stmts = parse_sql(sql)
+    assert len(stmts) == 1
+    return stmts[0]
+
+
+def test_basic_select():
+    s = one("SELECT usage_user, usage_system FROM cpu")
+    assert isinstance(s, ast.SelectStmt)
+    assert s.table == "cpu"
+    assert [i.expr.name for i in s.items] == ["usage_user", "usage_system"]
+
+
+def test_select_star_where_order_limit():
+    s = one("SELECT * FROM cpu WHERE host = 'h1' AND usage_user > 50.5 "
+            "ORDER BY time DESC LIMIT 10 OFFSET 5")
+    assert s.items[0].expr == "*"
+    assert isinstance(s.where, BinOp) and s.where.op == "and"
+    assert s.order_by[0][1] is False
+    assert s.limit == 10 and s.offset == 5
+
+
+def test_aggregate_group_by():
+    s = one("SELECT date_bin(INTERVAL '1 minute', time) AS t, avg(usage_user) "
+            "FROM cpu GROUP BY t, hostname HAVING avg(usage_user) > 10")
+    f = s.items[0].expr
+    assert isinstance(f, Func) and f.name == "date_bin"
+    assert f.args[0].value.ns == 60 * 10**9
+    assert s.items[0].alias == "t"
+    assert len(s.group_by) == 2
+    assert s.having is not None
+
+
+def test_count_star():
+    s = one("SELECT count(*) FROM cpu")
+    f = s.items[0].expr
+    assert f.name == "count" and f.args[0].value == "*"
+
+
+def test_in_between_isnull():
+    s = one("SELECT * FROM t WHERE a IN (1, 2, 3) AND b NOT IN ('x') "
+            "AND c BETWEEN 1 AND 5 AND d NOT BETWEEN 2 AND 3 AND e IS NOT NULL")
+    # walk the and-chain
+    preds = []
+    def walk(e):
+        if isinstance(e, BinOp) and e.op == "and":
+            walk(e.left); walk(e.right)
+        else:
+            preds.append(e)
+    walk(s.where)
+    assert isinstance(preds[0], InList) and not preds[0].negated
+    assert isinstance(preds[1], InList) and preds[1].negated
+    assert isinstance(preds[2], Between) and not preds[2].negated
+    assert isinstance(preds[3], Between) and preds[3].negated
+    assert isinstance(preds[4], IsNull) and preds[4].negated
+
+
+def test_operator_precedence():
+    s = one("SELECT * FROM t WHERE a = 1 OR b = 2 AND c = 3")
+    assert s.where.op == "or"
+    assert s.where.right.op == "and"
+    e = one("SELECT 1 + 2 * 3 FROM t").items[0].expr
+    assert e.op == "+" and e.right.op == "*"
+
+
+def test_create_database_options():
+    s = one("CREATE DATABASE IF NOT EXISTS oceanic_station WITH TTL '30d' "
+            "SHARD 4 VNODE_DURATION '1d' REPLICA 2 PRECISION 'ms'")
+    assert s.if_not_exists
+    assert s.options == {"ttl": "30d", "shard_num": 4, "vnode_duration": "1d",
+                         "replica": 2, "precision": "ms"}
+
+
+def test_create_table():
+    s = one("CREATE TABLE air (visibility DOUBLE, temperature DOUBLE CODEC(GORILLA), "
+            "presssure BIGINT, ok BOOLEAN, TAGS(station, region))")
+    assert [f.name for f in s.fields] == ["visibility", "temperature", "presssure", "ok"]
+    assert s.fields[1].codec == "GORILLA"
+    assert s.tags == ["station", "region"]
+
+
+def test_insert():
+    s = one("INSERT INTO air (time, station, visibility) VALUES "
+            "(1673591597000000000, 'XiaoMaiDao', 56), (1673591598000000000, 'DaMaiDao', 57.5)")
+    assert s.table == "air"
+    assert s.columns == ["time", "station", "visibility"]
+    assert len(s.rows) == 2
+    assert s.rows[1] == [1673591598000000000, "DaMaiDao", 57.5]
+
+
+def test_delete_update():
+    d = one("DELETE FROM cpu WHERE time < 100 AND host = 'h1'")
+    assert d.table == "cpu"
+    u = one("UPDATE cpu SET host = 'h2' WHERE host = 'h1'")
+    assert "host" in u.assignments
+
+
+def test_show_describe():
+    assert one("SHOW DATABASES").kind == "databases"
+    assert one("SHOW TABLES").kind == "tables"
+    s = one("SHOW TAG VALUES FROM cpu WITH KEY = host LIMIT 5")
+    assert s.kind == "tag_values" and s.table == "cpu" and s.tag_key == "host"
+    d = one("DESCRIBE TABLE cpu")
+    assert d.kind == "table" and d.name == "cpu"
+
+
+def test_alter():
+    s = one("ALTER TABLE cpu ADD FIELD temp DOUBLE CODEC(GORILLA)")
+    assert s.action == "add_field" and s.column.codec == "GORILLA"
+    s2 = one("ALTER TABLE cpu DROP temp")
+    assert s2.action == "drop" and s2.drop_name == "temp"
+    s3 = one("ALTER DATABASE db SET TTL '7d'")
+    assert s3.options == {"ttl": "7d"}
+
+
+def test_tenant_user():
+    assert one("CREATE TENANT test").name == "test"
+    u = one("CREATE USER u1 WITH PASSWORD = 'secret'")
+    assert u.password == "secret"
+    assert one("ALTER USER u1 SET PASSWORD = 'n'").password == "n"
+    assert one("DROP TENANT IF EXISTS test").if_exists
+
+
+def test_explain():
+    s = one("EXPLAIN SELECT * FROM cpu")
+    assert isinstance(s, ast.ExplainStmt)
+    assert isinstance(s.inner, ast.SelectStmt)
+
+
+def test_multi_statements_and_comments():
+    stmts = parse_sql("SELECT 1; -- comment\nSELECT 2; /* block */ SELECT 3")
+    assert len(stmts) == 3
+
+
+def test_quoted_identifiers_and_strings():
+    s = one('SELECT "weird col" FROM "my table" WHERE note = \'it\'\'s\'')
+    assert s.items[0].expr.name == "weird col"
+    assert s.table == "my table"
+    assert s.where.right.value == "it's"
+
+
+def test_intervals_and_timestamps():
+    assert parse_interval_string("1 minute") == 60 * 10**9
+    assert parse_interval_string("10m") == 600 * 10**9
+    assert parse_interval_string("1 hour 30 minutes") == 5400 * 10**9
+    assert parse_timestamp_string("1970-01-01T00:00:00Z") == 0
+    assert parse_timestamp_string("1970-01-01 00:00:01") == 10**9
+    ns = parse_timestamp_string("2022-01-01T00:00:00.000000123Z")
+    assert ns % 1000 == 123
+
+
+def test_errors():
+    with pytest.raises(ParserError):
+        parse_sql("SELEC * FROM t")
+    with pytest.raises(ParserError):
+        parse_sql("SELECT FROM t")
+    with pytest.raises(ParserError):
+        parse_sql("SELECT * FROM t WHERE a >")

@@ -150,6 +150,16 @@ def test_null_handling(tmp_path):
     v.close()
 
 
+def test_filter_on_non_aggregated_column(vnode):
+    """Device path must ship filter-only columns to the kernel."""
+    b = _batch(vnode)
+    q = TpuQuery(filter=BinOp(">", Column("n"), Literal(250)),
+                 aggs=[AggSpec("sum", "usage", "s"), AggSpec("count", None, "c")])
+    r = execute_scan_aggregate(b, q)
+    assert r.columns["c"][0] == 49
+    assert r.columns["s"][0] == pytest.approx(sum(251.0 + k for k in range(49)))
+
+
 def test_empty_group_not_emitted(vnode):
     b = _batch(vnode)
     q = TpuQuery(filter=BinOp("=", Column("host"), Literal("h0")),
