@@ -39,6 +39,7 @@ class Coordinator:
     def __init__(self, meta: MetaStore, engine: TsKv):
         self.meta = meta
         self.engine = engine
+        self._replica_mgr = None  # built on first multi-replica write
         # ScanBatch snapshots keyed by vnode data_version: repeated queries
         # reuse both the host batch and its device-resident twin (the
         # reference's TsmReader LRU cache, promoted to whole-scan snapshots
@@ -66,15 +67,18 @@ class Coordinator:
         (reference service.rs:565 write_lines)."""
         owner = f"{tenant}.{db}"
         self.meta.database(tenant, db)  # raises if missing
-        per_vnode: dict[int, WriteBatch] = {}
+        per_rs: dict[int, tuple[object, WriteBatch]] = {}
         for table, series_list in batch.tables.items():
             self._ensure_schema(tenant, db, table, series_list)
             for sr in series_list:
                 groups = self._split_series_by_bucket(tenant, db, sr)
-                for vnode_id, sub in groups:
-                    per_vnode.setdefault(vnode_id, WriteBatch()).add_series(table, sub)
-        for vnode_id, sub_batch in per_vnode.items():
-            self._write_vnode(owner, vnode_id, sub_batch, sync)
+                for rs, sub in groups:
+                    entry = per_rs.get(rs.id)
+                    if entry is None:
+                        entry = per_rs[rs.id] = (rs, WriteBatch())
+                    entry[1].add_series(table, sub)
+        for rs, sub_batch in per_rs.values():
+            self._write_replica_set(owner, rs, sub_batch, sync)
 
     def _split_series_by_bucket(self, tenant: str, db: str, sr: SeriesRows):
         """A series' rows can straddle buckets; split rows by bucket then
@@ -86,27 +90,50 @@ class Coordinator:
         lo, hi = min(sr.timestamps), max(sr.timestamps)
         b_lo = self.meta.locate_bucket_for_write(tenant, db, lo)
         if b_lo.contains(hi):
-            return [(b_lo.vnode_for(h).leader_vnode_id, sr)]
-        vnode_rows: dict[int, list[int]] = {}
+            return [(b_lo.vnode_for(h), sr)]
+        rs_rows: dict[int, tuple[object, list[int]]] = {}
         for i, ts in enumerate(sr.timestamps):
             bucket = self.meta.locate_bucket_for_write(tenant, db, ts)
             rs = bucket.vnode_for(h)
-            vnode_rows.setdefault(rs.leader_vnode_id, []).append(i)
+            rs_rows.setdefault(rs.id, (rs, []))[1].append(i)
         out = []
-        for vnode_id, idxs in vnode_rows.items():
+        for rs, idxs in rs_rows.values():
             if len(idxs) == len(sr.timestamps):
-                out.append((vnode_id, sr))
+                out.append((rs, sr))
             else:
                 sub = SeriesRows(
                     sr.key, [sr.timestamps[i] for i in idxs],
                     {k: (vt, [vals[i] for i in idxs])
                      for k, (vt, vals) in sr.fields.items()})
-                out.append((vnode_id, sub))
+                out.append((rs, sub))
         return out
 
-    def _write_vnode(self, owner: str, vnode_id: int, batch: WriteBatch,
-                     sync: bool):
-        self.engine.write(owner, vnode_id, batch, sync=sync)
+    def _write_replica_set(self, owner: str, rs, batch: WriteBatch,
+                           sync: bool):
+        """Single-replica sets write the engine directly; replicated sets go
+        through raft consensus (reference service.rs write_replica_by_raft)."""
+        if len(rs.vnodes) <= 1:
+            self.engine.write(owner, rs.leader_vnode_id, batch, sync=sync)
+            return
+        from ..storage.wal import WalEntryType
+
+        self.replica_manager().write(owner, rs, WalEntryType.WRITE,
+                                     batch.encode(), sync=sync)
+
+    def replica_manager(self):
+        if self._replica_mgr is None:
+            from .replica import ReplicaGroupManager
+
+            self._replica_mgr = ReplicaGroupManager(self.engine)
+        return self._replica_mgr
+
+    def close(self):
+        """Stop raft tickers BEFORE closing the engine — heartbeats append
+        to the WAL, which must outlive them."""
+        if self._replica_mgr is not None:
+            self._replica_mgr.stop()
+            self._replica_mgr = None
+        self.engine.close()
 
     def _ensure_schema(self, tenant: str, db: str, table: str,
                        series_list: list[SeriesRows]):
@@ -152,10 +179,16 @@ class Coordinator:
         seen = set()
         for bucket in self.meta.buckets_for(tenant, db, lo, hi):
             for rs in bucket.shard_group:
-                if rs.leader_vnode_id in seen:
+                vnode_id = rs.leader_vnode_id
+                if len(rs.vnodes) > 1 and self._replica_mgr is not None:
+                    # follow the live raft leader for read-your-writes
+                    live = self._replica_mgr.current_leader_vnode(owner, rs)
+                    if live is not None:
+                        vnode_id = live
+                if vnode_id in seen:
                     continue
-                seen.add(rs.leader_vnode_id)
-                splits.append(PlacedSplit(owner, rs.leader_vnode_id, table,
+                seen.add(vnode_id)
+                splits.append(PlacedSplit(owner, vnode_id, table,
                                           time_ranges, tag_domains))
         return splits
 

@@ -1,0 +1,607 @@
+"""Raft consensus: replication for vnode replica sets and the meta group.
+
+Role-parity with the reference's replication crate (replication/src/:
+openraft 0.9 TypeConfig with D=R=Vec<u8> lib.rs:56-66, ApplyStorage trait
+:103-112, EntryStorage :114-139, RaftNode raft_node.rs:24, MultiRaft
+multi_raft.rs:27) rebuilt from scratch: leader election with randomized
+timeouts, log replication with consistency check + conflict truncation,
+commit on majority, snapshot install for lagging followers, and a
+pluggable transport (in-process for single-host replica sets and tests;
+an HTTP transport rides the same messages between nodes).
+
+The log store IS the vnode WAL (storage/wal.py) — same single durable log
+per vnode as the reference (wal_store.rs RaftEntryStorage).
+
+Simplifications vs openraft, stated plainly:
+- pre-vote and leader-lease reads are not implemented (reads go through
+  the leader's state machine which is safe for our apply model);
+- membership changes are single-step (add/remove one voter at a time).
+"""
+from __future__ import annotations
+
+import random
+import threading
+import time
+from dataclasses import dataclass, field
+
+import msgpack
+
+from ..errors import ReplicationError
+
+
+class Role:
+    FOLLOWER = "follower"
+    CANDIDATE = "candidate"
+    LEADER = "leader"
+
+
+@dataclass
+class LogEntry:
+    term: int
+    index: int
+    entry_type: int     # WalEntryType value (RAFT_BLANK for no-ops)
+    data: bytes
+
+
+class StateMachine:
+    """ApplyStorage counterpart (replication/src/lib.rs:103-112)."""
+
+    def apply(self, entry: LogEntry) -> None:
+        raise NotImplementedError
+
+    def snapshot(self) -> bytes:
+        raise NotImplementedError
+
+    def install_snapshot(self, data: bytes, last_index: int, last_term: int) -> None:
+        raise NotImplementedError
+
+
+class LogStore:
+    """EntryStorage counterpart (replication/src/lib.rs:114-139)."""
+
+    def append(self, entry: LogEntry) -> None:
+        raise NotImplementedError
+
+    def entries_from(self, index: int, limit: int = 512) -> list[LogEntry]:
+        raise NotImplementedError
+
+    def entry_at(self, index: int) -> LogEntry | None:
+        raise NotImplementedError
+
+    def last_index(self) -> int:
+        raise NotImplementedError
+
+    def term_at(self, index: int) -> int:
+        raise NotImplementedError
+
+    def truncate_from(self, index: int) -> None:
+        raise NotImplementedError
+
+    def save_hard_state(self, term: int, voted_for: int | None) -> None:
+        raise NotImplementedError
+
+    def load_hard_state(self) -> tuple[int, int | None]:
+        raise NotImplementedError
+
+
+class MemoryLogStore(LogStore):
+    """Volatile store for tests and the meta group's cache."""
+
+    def __init__(self):
+        self.entries: dict[int, LogEntry] = {}
+        self._last = 0
+        self._term = 0
+        self._voted: int | None = None
+
+    def append(self, entry: LogEntry):
+        self.entries[entry.index] = entry
+        self._last = max(self._last, entry.index)
+
+    def entries_from(self, index, limit=512):
+        out = []
+        i = index
+        while i <= self._last and len(out) < limit:
+            e = self.entries.get(i)
+            if e is None:
+                break
+            out.append(e)
+            i += 1
+        return out
+
+    def entry_at(self, index):
+        return self.entries.get(index)
+
+    def last_index(self):
+        return self._last
+
+    def term_at(self, index):
+        e = self.entries.get(index)
+        return e.term if e else 0
+
+    def truncate_from(self, index):
+        for i in list(self.entries):
+            if i >= index:
+                del self.entries[i]
+        self._last = min(self._last, index - 1)
+
+    def save_hard_state(self, term, voted_for):
+        self._term, self._voted = term, voted_for
+
+    def load_hard_state(self):
+        return self._term, self._voted
+
+
+class WalLogStore(LogStore):
+    """Raft log over the vnode WAL (reference wal_store.rs RaftEntryStorage).
+
+    Entry encoding inside the WAL record: [term u64][payload]; the WAL's
+    own seq is the raft index. Hard state rides in a sidecar record file.
+    """
+
+    def __init__(self, wal, hard_state_path: str):
+        import os
+
+        self.wal = wal
+        self._hs_path = hard_state_path
+        self._entries: dict[int, LogEntry] = {}
+        for we in wal.replay():
+            self._entries[we.seq] = LogEntry(we.term, we.seq, we.entry_type,
+                                             we.data)
+        self._last = max(self._entries) if self._entries else 0
+        # stay in sync with WAL GC (vnode flush purges behind the flushed
+        # watermark): drop mirrored entries so memory stays bounded and
+        # entries_from honestly reports the purge (snapshot path engages)
+        wal.purge_listeners.append(self._on_purge)
+        self._term = 0
+        self._voted = None
+        if os.path.exists(self._hs_path):
+            with open(self._hs_path, "rb") as f:
+                raw = f.read()
+            if len(raw) >= 16:
+                self._term = int.from_bytes(raw[:8], "little")
+                v = int.from_bytes(raw[8:16], "little")
+                self._voted = None if v == 2**64 - 1 else v
+
+    def append(self, entry: LogEntry):
+        self.wal.append(entry.entry_type, entry.data, seq=entry.index,
+                        term=entry.term)
+        self._entries[entry.index] = entry
+        self._last = max(self._last, entry.index)
+
+    def entries_from(self, index, limit=512):
+        out = []
+        i = index
+        while i <= self._last and len(out) < limit:
+            e = self._entries.get(i)
+            if e is None:
+                break
+            out.append(e)
+            i += 1
+        return out
+
+    def entry_at(self, index):
+        return self._entries.get(index)
+
+    def last_index(self):
+        return self._last
+
+    def term_at(self, index):
+        e = self._entries.get(index)
+        return e.term if e else 0
+
+    def truncate_from(self, index):
+        self.wal.truncate_from(index)
+        for i in list(self._entries):
+            if i >= index:
+                del self._entries[i]
+        self._last = min(self._last, index - 1)
+
+    def purge_to(self, index):
+        self.wal.purge_to(index)  # listener prunes _entries
+
+    def _on_purge(self, seq: int):
+        for i in list(self._entries):
+            if i < seq:
+                del self._entries[i]
+
+    def save_hard_state(self, term, voted_for):
+        import os
+
+        self._term, self._voted = term, voted_for
+        tmp = self._hs_path + ".tmp"
+        v = 2**64 - 1 if voted_for is None else voted_for
+        with open(tmp, "wb") as f:
+            f.write(term.to_bytes(8, "little") + v.to_bytes(8, "little"))
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self._hs_path)
+
+    def load_hard_state(self):
+        return self._term, self._voted
+
+
+class Transport:
+    """Message passing between raft peers; send(to, msg) → reply dict|None."""
+
+    def send(self, group_id: str, to: int, msg: dict) -> dict | None:
+        raise NotImplementedError
+
+
+class InProcessTransport(Transport):
+    """Single-process cluster wiring (tests + single-host replica sets)."""
+
+    def __init__(self):
+        self.nodes: dict[tuple[str, int], "RaftNode"] = {}
+        self.partitions: set[frozenset] = set()
+        self.lock = threading.Lock()
+
+    def register(self, node: "RaftNode"):
+        self.nodes[(node.group_id, node.node_id)] = node
+
+    def partition(self, a: int, b: int):
+        with self.lock:
+            self.partitions.add(frozenset((a, b)))
+
+    def heal(self):
+        with self.lock:
+            self.partitions.clear()
+
+    def send(self, group_id, to, msg):
+        with self.lock:
+            if frozenset((msg["from"], to)) in self.partitions:
+                return None
+        node = self.nodes.get((group_id, to))
+        if node is None or not node.alive:
+            return None
+        return node.handle_message(msg)
+
+
+RAFT_BLANK = 5  # WalEntryType.RAFT_BLANK
+
+
+class RaftNode:
+    """One consensus participant for one group (≈ reference RaftNode)."""
+
+    def __init__(self, group_id: str, node_id: int, peers: list[int],
+                 log: LogStore, sm: StateMachine, transport: Transport,
+                 election_timeout: tuple[float, float] = (0.15, 0.3),
+                 heartbeat_interval: float = 0.05,
+                 tick: bool = True):
+        self.group_id = group_id
+        self.node_id = node_id
+        self.peers = [p for p in peers if p != node_id]
+        self.log = log
+        self.sm = sm
+        self.transport = transport
+        self.election_timeout = election_timeout
+        self.heartbeat_interval = heartbeat_interval
+
+        self.term, self.voted_for = log.load_hard_state()
+        self.role = Role.FOLLOWER
+        self.leader_id: int | None = None
+        self.commit_index = 0
+        self.last_applied = 0
+        self.next_index: dict[int, int] = {}
+        self.match_index: dict[int, int] = {}
+        self.alive = True
+        self.lock = threading.RLock()
+        self._last_heartbeat = time.monotonic()
+        self._election_deadline = self._new_deadline()
+        self._stop = threading.Event()
+        self._apply_cv = threading.Condition(self.lock)
+        if isinstance(transport, InProcessTransport):
+            transport.register(self)
+        self._ticker = None
+        if tick:
+            self._ticker = threading.Thread(target=self._tick_loop, daemon=True)
+            self._ticker.start()
+
+    # ------------------------------------------------------------ lifecycle
+    def _new_deadline(self):
+        lo, hi = self.election_timeout
+        return time.monotonic() + random.uniform(lo, hi)
+
+    def stop(self):
+        self._stop.set()
+        self.alive = False
+        if self._ticker:
+            self._ticker.join(timeout=1)
+
+    def crash(self):
+        """Simulate failure: stop responding (state retained for restart)."""
+        self.alive = False
+
+    def restart(self):
+        with self.lock:
+            self.alive = True
+            self.role = Role.FOLLOWER
+            self._election_deadline = self._new_deadline()
+
+    def _tick_loop(self):
+        while not self._stop.is_set():
+            time.sleep(0.01)
+            if not self.alive:
+                continue
+            try:
+                with self.lock:
+                    role = self.role
+                now = time.monotonic()
+                if role == Role.LEADER:
+                    if now - self._last_heartbeat >= self.heartbeat_interval:
+                        self._broadcast_append()
+                elif now >= self._election_deadline:
+                    self._start_election()
+            except Exception:
+                # a transient failure (e.g. races at shutdown) must not kill
+                # the ticker thread and silently dead-lock the group
+                if self._stop.is_set():
+                    return
+                time.sleep(0.05)
+
+    # ------------------------------------------------------------ elections
+    def _start_election(self):
+        with self.lock:
+            self.term += 1
+            self.role = Role.CANDIDATE
+            self.voted_for = self.node_id
+            self.log.save_hard_state(self.term, self.voted_for)
+            term = self.term
+            last_idx = self.log.last_index()
+            last_term = self.log.term_at(last_idx)
+            self._election_deadline = self._new_deadline()
+        votes = 1
+        for p in self.peers:
+            reply = self.transport.send(self.group_id, p, {
+                "type": "request_vote", "from": self.node_id, "term": term,
+                "last_log_index": last_idx, "last_log_term": last_term})
+            if reply is None:
+                continue
+            if reply.get("term", 0) > term:
+                self._step_down(reply["term"])
+                return
+            if reply.get("granted"):
+                votes += 1
+        with self.lock:
+            if self.role != Role.CANDIDATE or self.term != term:
+                return
+            if votes * 2 > len(self.peers) + 1:
+                self.role = Role.LEADER
+                self.leader_id = self.node_id
+                last = self.log.last_index()
+                self.next_index = {p: last + 1 for p in self.peers}
+                self.match_index = {p: 0 for p in self.peers}
+                # commit a blank entry to settle the new term (raft §8)
+                self._append_local(RAFT_BLANK, b"")
+        if self.role == Role.LEADER:
+            self._broadcast_append()
+
+    def _step_down(self, term: int):
+        with self.lock:
+            if term > self.term:
+                self.term = term
+                self.voted_for = None
+                self.log.save_hard_state(self.term, None)
+            self.role = Role.FOLLOWER
+            self._election_deadline = self._new_deadline()
+
+    # ------------------------------------------------------------ client API
+    def propose(self, entry_type: int, data: bytes,
+                timeout: float = 5.0) -> int:
+        """Append via the leader; blocks until applied. → log index."""
+        with self.lock:
+            if self.role != Role.LEADER:
+                raise NotLeader(self.leader_id)
+            idx = self._append_local(entry_type, data)
+        self._broadcast_append()
+        deadline = time.monotonic() + timeout
+        with self._apply_cv:
+            while self.last_applied < idx:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise ReplicationError("propose timeout", index=idx)
+                self._apply_cv.wait(remaining)
+        return idx
+
+    def _append_local(self, entry_type: int, data: bytes) -> int:
+        idx = self.log.last_index() + 1
+        self.log.append(LogEntry(self.term, idx, entry_type, data))
+        self.match_index[self.node_id] = idx
+        return idx
+
+    # ------------------------------------------------------------ replication
+    def _broadcast_append(self):
+        self._last_heartbeat = time.monotonic()
+        for p in self.peers:
+            self._send_append(p)
+        self._advance_commit()
+
+    def _send_append(self, peer: int):
+        need_snapshot = False
+        with self.lock:
+            if self.role != Role.LEADER:
+                return
+            ni = self.next_index.get(peer, self.log.last_index() + 1)
+            prev_idx = ni - 1
+            prev_term = self.log.term_at(prev_idx)
+            entries = self.log.entries_from(ni)
+            if prev_idx > 0 and prev_term == 0 and self.log.entry_at(prev_idx) is None:
+                need_snapshot = True  # log purged below ni
+            msg = None if need_snapshot else {
+                "type": "append_entries", "from": self.node_id,
+                "term": self.term, "prev_log_index": prev_idx,
+                "prev_log_term": prev_term,
+                "entries": [[e.term, e.index, e.entry_type, e.data]
+                            for e in entries],
+                "leader_commit": self.commit_index,
+            }
+        if need_snapshot:
+            # snapshot serialization scans the state machine: NEVER under
+            # the raft lock, or heartbeats/votes stall and elections fire
+            self._send_snapshot(peer)
+            return
+        reply = self.transport.send(self.group_id, peer, msg)
+        if reply is None:
+            return
+        with self.lock:
+            if reply.get("term", 0) > self.term:
+                pass
+            elif reply.get("success"):
+                if entries:
+                    self.match_index[peer] = entries[-1].index
+                    self.next_index[peer] = entries[-1].index + 1
+                return
+            else:
+                self.next_index[peer] = max(1, min(
+                    ni - 1, reply.get("conflict_index", ni - 1)))
+                return
+        self._step_down(reply["term"])
+
+    def _send_snapshot(self, peer: int):
+        data = self.sm.snapshot()
+        last_idx = self.log.last_index()
+        last_term = self.log.term_at(last_idx)
+        msg = {"type": "install_snapshot", "from": self.node_id,
+               "term": self.term, "data": data,
+               "last_index": self.commit_index,
+               "last_term": self.log.term_at(self.commit_index)}
+        reply = self.transport.send(self.group_id, peer, msg)
+        if reply and reply.get("success"):
+            with self.lock:
+                self.next_index[peer] = self.commit_index + 1
+                self.match_index[peer] = self.commit_index
+
+    def _advance_commit(self):
+        with self.lock:
+            if self.role != Role.LEADER:
+                return
+            matches = sorted([self.log.last_index()]
+                             + [self.match_index.get(p, 0) for p in self.peers])
+            majority_idx = matches[len(matches) // 2] if len(matches) % 2 \
+                else matches[len(matches) // 2 - 1]
+            # a leader only commits entries from its own term (raft §5.4.2)
+            if majority_idx > self.commit_index and \
+                    self.log.term_at(majority_idx) == self.term:
+                self.commit_index = majority_idx
+            self._apply_committed()
+
+    def _apply_committed(self):
+        while self.last_applied < self.commit_index:
+            e = self.log.entry_at(self.last_applied + 1)
+            if e is None:
+                break
+            if e.entry_type != RAFT_BLANK:
+                self.sm.apply(e)
+            self.last_applied += 1
+        with self._apply_cv:
+            self._apply_cv.notify_all()
+
+    # ------------------------------------------------------------ RPC handling
+    def handle_message(self, msg: dict) -> dict:
+        t = msg["type"]
+        if t == "request_vote":
+            return self._on_request_vote(msg)
+        if t == "append_entries":
+            return self._on_append_entries(msg)
+        if t == "install_snapshot":
+            return self._on_install_snapshot(msg)
+        raise ReplicationError(f"unknown message {t}")
+
+    def _on_request_vote(self, msg):
+        with self.lock:
+            if msg["term"] > self.term:
+                self._step_down(msg["term"])
+            granted = False
+            if msg["term"] == self.term and self.voted_for in (None, msg["from"]):
+                my_last = self.log.last_index()
+                my_term = self.log.term_at(my_last)
+                up_to_date = (msg["last_log_term"], msg["last_log_index"]) >= \
+                    (my_term, my_last)
+                if up_to_date:
+                    granted = True
+                    self.voted_for = msg["from"]
+                    self.log.save_hard_state(self.term, self.voted_for)
+                    self._election_deadline = self._new_deadline()
+            return {"term": self.term, "granted": granted}
+
+    def _on_append_entries(self, msg):
+        with self.lock:
+            if msg["term"] < self.term:
+                return {"term": self.term, "success": False}
+            if msg["term"] > self.term:
+                self._step_down(msg["term"])
+            self.role = Role.FOLLOWER
+            self.leader_id = msg["from"]
+            self._election_deadline = self._new_deadline()
+            prev_idx, prev_term = msg["prev_log_index"], msg["prev_log_term"]
+            if prev_idx > 0:
+                local_term = self.log.term_at(prev_idx)
+                if local_term != prev_term:
+                    conflict = min(prev_idx, self.log.last_index() + 1)
+                    return {"term": self.term, "success": False,
+                            "conflict_index": max(1, conflict)}
+            for raw in msg["entries"]:
+                e = LogEntry(raw[0], raw[1], raw[2], raw[3])
+                existing = self.log.entry_at(e.index)
+                if existing is not None and existing.term != e.term:
+                    self.log.truncate_from(e.index)
+                    existing = None
+                if existing is None:
+                    self.log.append(e)
+            if msg["leader_commit"] > self.commit_index:
+                self.commit_index = min(msg["leader_commit"],
+                                        self.log.last_index())
+            self._apply_committed()
+            return {"term": self.term, "success": True}
+
+    def _on_install_snapshot(self, msg):
+        with self.lock:
+            if msg["term"] < self.term:
+                return {"term": self.term, "success": False}
+            if msg["term"] > self.term:
+                self._step_down(msg["term"])
+            self.leader_id = msg["from"]
+            self._election_deadline = self._new_deadline()
+            self.sm.install_snapshot(msg["data"], msg["last_index"],
+                                     msg["last_term"])
+            self.log.truncate_from(1)
+            self.log.append(LogEntry(msg["last_term"], msg["last_index"],
+                                     RAFT_BLANK, b""))
+            self.commit_index = msg["last_index"]
+            self.last_applied = msg["last_index"]
+            return {"term": self.term, "success": True}
+
+    # ------------------------------------------------------------ info
+    def is_leader(self) -> bool:
+        return self.role == Role.LEADER and self.alive
+
+    def metrics(self) -> dict:
+        return {"term": self.term, "role": self.role,
+                "leader": self.leader_id, "commit": self.commit_index,
+                "applied": self.last_applied,
+                "last_log": self.log.last_index()}
+
+
+class NotLeader(ReplicationError):
+    def __init__(self, leader_id):
+        super().__init__("not leader", leader=leader_id)
+        self.leader_id = leader_id
+
+
+class MultiRaft:
+    """Registry of raft groups in one process (reference multi_raft.rs)."""
+
+    def __init__(self):
+        self.groups: dict[str, RaftNode] = {}
+        self.lock = threading.Lock()
+
+    def add(self, node: RaftNode):
+        with self.lock:
+            self.groups[node.group_id] = node
+
+    def get(self, group_id: str) -> RaftNode | None:
+        return self.groups.get(group_id)
+
+    def stop_all(self):
+        with self.lock:
+            for n in self.groups.values():
+                n.stop()

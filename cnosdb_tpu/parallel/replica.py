@@ -1,0 +1,170 @@
+"""Replica groups: raft-replicated vnodes.
+
+Role-parity with the reference's RaftNodesManager + TskvRaftWriter
+(coordinator/src/raft/manager.rs:33-754, raft/writer.rs:19): every
+ReplicationSet with more than one vnode runs a raft group whose state
+machine is the VnodeStorage apply path and whose log store is that vnode's
+WAL (one durable log per vnode, reference wal_store.rs). Writes go to the
+group leader (retry-on-leader-change like tskv_executor.rs
+TskvLeaderExecutor); single-vnode sets bypass consensus entirely.
+"""
+from __future__ import annotations
+
+import threading
+
+import msgpack
+
+from ..errors import ReplicationError
+from ..models.meta_data import ReplicationSet
+from ..storage.engine import TsKv
+from ..storage.vnode import VnodeStorage
+from .raft import (
+    InProcessTransport, LogEntry, MultiRaft, NotLeader, RaftNode,
+    StateMachine, WalLogStore,
+)
+
+
+class VnodeStateMachine(StateMachine):
+    """ApplyStorage over VnodeStorage (reference tskv TskvEngineStorage)."""
+
+    def __init__(self, vnode: VnodeStorage):
+        self.vnode = vnode
+
+    def apply(self, entry: LogEntry):
+        self.vnode.apply_entry(entry.entry_type, entry.data, entry.index)
+
+    def snapshot(self) -> bytes:
+        """Ship the memcache + flushed state as a write-batch replay bundle
+        (round-1 scope: logical snapshot; file-level snapshots later)."""
+        from ..storage.scan import scan_vnode
+
+        tables = {}
+        for (table, _sid) in list(self.vnode.active.series.keys()) + \
+                [(t, s) for c in self.vnode.immutables for (t, s) in c.series]:
+            tables[table] = True
+        for fm in self.vnode.summary.version.all_files():
+            r = self.vnode.summary.version.reader(fm)
+            for t in r.tables():
+                tables[t] = True
+        out = {}
+        for table in tables:
+            b = scan_vnode(self.vnode, table)
+            rows = []
+            for i in range(b.n_rows):
+                sid = int(b.series_ids[b.sid_ordinal[i]])
+                key = self.vnode.index.get_series_key(sid)
+                fields = {}
+                for name, (vt, vals, valid) in b.fields.items():
+                    if valid[i]:
+                        v = vals[i]
+                        fields[name] = [int(vt), v.item() if hasattr(v, "item") else v]
+                rows.append([key.encode() if key else b"", int(b.ts[i]), fields])
+            out[table] = rows
+        return msgpack.packb(out, use_bin_type=True)
+
+    def install_snapshot(self, data: bytes, last_index: int, last_term: int):
+        from ..models.points import SeriesRows, WriteBatch
+        from ..models.series import SeriesKey
+
+        obj = msgpack.unpackb(data, raw=False, strict_map_key=False)
+        # replace local state: drop all tables, then re-apply rows
+        wb = WriteBatch()
+        for table, rows in obj.items():
+            self.vnode._apply_drop_table(table)
+            per_key: dict[bytes, list] = {}
+            for key_b, ts, fields in rows:
+                per_key.setdefault(key_b, []).append((ts, fields))
+            for key_b, items in per_key.items():
+                key = SeriesKey.decode(key_b)
+                ts_list = [t for t, _ in items]
+                fnames = {n for _, f in items for n in f}
+                fs = {}
+                for n in fnames:
+                    vt = next(f[n][0] for _, f in items if n in f)
+                    fs[n] = (vt, [f.get(n, [None, None])[1] if n in f else None
+                                  for _, f in items])
+                wb.add_series(table, SeriesRows(key, ts_list, fs))
+        if wb.tables:
+            self.vnode._apply_write(wb, last_index)
+
+
+class ReplicaGroupManager:
+    """Builds/holds raft groups for replica sets (all local this round)."""
+
+    def __init__(self, engine: TsKv,
+                 election_timeout=(0.15, 0.3), heartbeat_interval=0.05):
+        self.engine = engine
+        self.transport = InProcessTransport()
+        self.multi = MultiRaft()
+        self.election_timeout = election_timeout
+        self.heartbeat_interval = heartbeat_interval
+        self.lock = threading.Lock()
+
+    def group_id(self, owner: str, rs: ReplicationSet) -> str:
+        return f"{owner}/{rs.id}"
+
+    def get_or_build(self, owner: str, rs: ReplicationSet) -> dict[int, RaftNode]:
+        """→ vnode_id → RaftNode for the set (builds all local members)."""
+        gid = self.group_id(owner, rs)
+        with self.lock:
+            nodes = {}
+            peers = [v.id for v in rs.vnodes]
+            for v in rs.vnodes:
+                key = (gid, v.id)
+                existing = self.transport.nodes.get(key)
+                if existing is not None:
+                    nodes[v.id] = existing
+                    continue
+                vnode = self.engine.open_vnode(owner, v.id)
+                import os
+
+                log = WalLogStore(vnode.wal,
+                                  os.path.join(vnode.dir, "hardstate"))
+                node = RaftNode(gid, v.id, peers, log,
+                                VnodeStateMachine(vnode), self.transport,
+                                election_timeout=self.election_timeout,
+                                heartbeat_interval=self.heartbeat_interval)
+                self.multi.add(node)
+                nodes[v.id] = node
+            return nodes
+
+    def current_leader_vnode(self, owner: str, rs: ReplicationSet) -> int | None:
+        """The raft leader's vnode id (may differ from meta's static
+        leader_vnode_id after elections) — readers follow it for
+        read-your-writes."""
+        gid = self.group_id(owner, rs)
+        for v in rs.vnodes:
+            node = self.transport.nodes.get((gid, v.id))
+            if node is not None and node.is_leader():
+                return v.id
+        return None
+
+    def write(self, owner: str, rs: ReplicationSet, entry_type: int,
+              data: bytes, retries: int = 20, sync: bool = False) -> int:
+        """Propose on the current leader, retrying across leader changes
+        (reference TskvLeaderExecutor)."""
+        import time
+
+        nodes = self.get_or_build(owner, rs)
+        last_err: Exception | None = None
+        for _ in range(retries):
+            leader = next((n for n in nodes.values() if n.is_leader()), None)
+            if leader is None:
+                time.sleep(0.05)
+                continue
+            try:
+                idx = leader.propose(entry_type, data)
+                if sync:
+                    self.engine.open_vnode(owner, leader.node_id).wal.sync()
+                return idx
+            except NotLeader as e:
+                last_err = e
+                time.sleep(0.05)
+            except ReplicationError as e:
+                last_err = e
+                time.sleep(0.05)
+        raise ReplicationError(
+            f"no leader for {self.group_id(owner, rs)}") from last_err
+
+    def stop(self):
+        self.multi.stop_all()

@@ -35,7 +35,7 @@ def parse_lines(text: str, precision: Precision = Precision.NS,
         except Exception as e:
             raise ParserError(f"line {lineno}: {e}", line=raw[:120])
         ts_ns = ts * factor if ts is not None else now
-        key = (measurement, tuple(sorted(tags)))
+        key = (measurement, tuple(sorted(tags.items())))
         g = groups.get(key)
         if g is None:
             g = groups[key] = {"tags": tags, "ts": [], "fields": {}}

@@ -262,5 +262,5 @@ def run_server(args) -> int:
     try:
         asyncio.run(main())
     except KeyboardInterrupt:
-        server.coord.engine.close()
+        server.coord.close()
     return 0

@@ -377,11 +377,44 @@ class QueryExecutor:
             return ResultSet(names, cols)
         table = stmt.table
         db = stmt.database or session.database
+        from .system_tables import is_system_db, system_table
+
+        if is_system_db(db):
+            names, cols = system_table(self, db, table, session)
+            return self._select_over_env(stmt, names, cols)
         schema = self.meta.table(session.tenant, db, table)
         plan = plan_select(stmt, schema)
         if isinstance(plan, AggregatePlan):
             return self._exec_aggregate(plan, session.tenant, db)
         return self._exec_raw(plan, session.tenant, db)
+
+    def _select_over_env(self, stmt: ast.SelectStmt, names: list[str], cols):
+        """Generic SELECT over an in-memory table (system schemas)."""
+        env = {n: c for n, c in zip(names, cols)}
+        n = len(cols[0]) if cols else 0
+        mask = np.ones(n, dtype=bool)
+        if stmt.where is not None:
+            m = stmt.where.eval(env, np)
+            mask = np.full(n, bool(m)) if np.isscalar(m) or m.shape == () else m
+        env = {k: v[mask] for k, v in env.items()}
+        n = int(mask.sum())
+        out_names, out_cols = [], []
+        for it in stmt.items:
+            if it.expr == "*":
+                out_names.extend(names)
+                out_cols.extend(env[x] for x in names)
+                continue
+            v = it.expr.eval(env, np)
+            if np.isscalar(v) or getattr(v, "shape", None) == ():
+                v = np.full(n, v)
+            out_names.append(it.alias or (it.expr.name if isinstance(it.expr, Column)
+                                          else it.expr.to_sql()))
+            out_cols.append(np.asarray(v))
+        rs = ResultSet(out_names, out_cols)
+        env_all = dict(env)
+        for nm, c in zip(out_names, out_cols):
+            env_all[nm] = c
+        return _order_limit(rs, stmt.order_by, stmt.limit, stmt.offset, env_all)
 
     # ---------------------------------------------------------- aggregates
     def _exec_aggregate(self, plan: AggregatePlan, tenant: str, db: str):
@@ -393,10 +426,11 @@ class QueryExecutor:
             tenant, db, plan.table, time_ranges=plan.time_ranges,
             tag_domains=plan.tag_domains, field_names=needed_fields)
 
+        host_funcs = ("count_distinct", "collect")
         q = TpuQuery(filter=plan.filter, group_tags=plan.group_tags,
                      time_bucket=plan.bucket,
-                     aggs=[a for a in phys_aggs if a.func != "count_distinct"])
-        distinct_specs = [a for a in phys_aggs if a.func == "count_distinct"]
+                     aggs=[a for a in phys_aggs if a.func not in host_funcs])
+        distinct_specs = [a for a in phys_aggs if a.func in host_funcs]
 
         # launch every vnode's device kernel before fetching any result:
         # fetches carry fixed device→host latency, launches are async
@@ -454,8 +488,10 @@ class QueryExecutor:
             names.append(name)
             cols.append(np.asarray(v))
         rs = ResultSet(names, cols)
+        if plan.gapfill and rs.n_rows:
+            rs = _apply_gapfill(plan, rs)
         env_out = dict(env)
-        for nm, c in zip(names, cols):
+        for nm, c in zip(rs.names, rs.columns):
             env_out[nm] = c
         return _order_limit(rs, plan.order_by, plan.limit, plan.offset, env_out)
 
@@ -496,9 +532,11 @@ class QueryExecutor:
             names.append(name)
             cols.append(np.asarray(v))
         rs = ResultSet(names, cols)
+        if plan.gapfill and rs.n_rows:
+            rs = _apply_gapfill(plan, rs)
         # ORDER BY may reference output aliases (e.g. the bucket alias)
         env_out = dict(env)
-        for nm, c in zip(names, cols):
+        for nm, c in zip(rs.names, rs.columns):
             env_out[nm] = c
         rs = _order_limit(rs, plan.order_by, plan.limit, plan.offset, env_out)
         return rs
@@ -647,6 +685,14 @@ def _decompose_aggs(aggs: list[AggSpec]):
             finalize[a.alias] = ("pass", want(a.func, a.column))
         elif a.func == "count_distinct":
             finalize[a.alias] = ("distinct", want("count_distinct", a.column))
+        elif a.func == "increase":
+            # last - first over the window (counter-reset handling is a
+            # noted gap vs the reference's increase UDAF)
+            f = want("first", a.column)
+            l = want("last", a.column)
+            finalize[a.alias] = ("increase", f, l)
+        elif a.func in ("median", "stddev", "mode"):
+            finalize[a.alias] = (a.func, want("collect", a.column))
         else:
             raise PlanError(f"aggregate {a.func!r} not supported yet")
     return phys, finalize
@@ -667,6 +713,22 @@ def _apply_finalizer(spec, parts: dict):
     if kind == "distinct":
         vals = parts.get(spec[1])
         return len(vals) if vals is not None else 0
+    if kind == "increase":
+        f, l = parts.get(spec[1]), parts.get(spec[2])
+        if f is None or l is None:
+            return None
+        return l - f
+    if kind in ("median", "stddev", "mode"):
+        chunks = parts.get(spec[1])
+        if not chunks:
+            return None
+        vals = np.concatenate(chunks)
+        if kind == "median":
+            return float(np.median(vals))
+        if kind == "stddev":
+            return float(np.std(vals, ddof=1)) if len(vals) > 1 else None
+        uniq, counts = np.unique(vals, return_counts=True)
+        return uniq[np.argmax(counts)]
     raise ExecutionError(f"bad finalizer {spec!r}")
 
 
@@ -697,6 +759,10 @@ def _vector_finalize(spec, parts_env: dict, n: int):
     if kind == "distinct":
         c, v = col(spec[1], 0)
         return c, v
+    if kind == "increase":
+        f, fv = col(spec[1])
+        l, lv = col(spec[2])
+        return l - f, fv & lv
     raise ExecutionError(f"bad finalizer {spec!r}")
 
 
@@ -778,13 +844,128 @@ def _merge_distinct(acc: dict, batch, plan: AggregatePlan, spec: AggSpec):
     if plan.bucket is not None:
         origin, interval = plan.bucket
         buckets = origin + ((batch.ts - origin) // interval) * interval
-    for i in np.nonzero(mask)[0]:
+    collect = spec.func == "collect"
+    idxs = np.nonzero(mask)[0]
+    if collect:
+        # group indices first, slice values in bulk per group
+        group_rows: dict[tuple, list[int]] = {}
+        for i in idxs:
+            key = tagmaps[batch.sid_ordinal[i]]
+            if plan.bucket is not None:
+                key = key + (int(buckets[i]),)
+            group_rows.setdefault(key, []).append(i)
+        arr = np.asarray(vals)
+        for key, rows in group_rows.items():
+            parts = acc.setdefault(key, {})
+            parts.setdefault(spec.alias, []).append(arr[rows])
+        return
+    for i in idxs:
         key = tagmaps[batch.sid_ordinal[i]]
         if plan.bucket is not None:
             key = key + (int(buckets[i]),)
         parts = acc.setdefault(key, {})
         s = parts.setdefault(spec.alias, set())
         s.add(vals[i])
+
+
+def _apply_gapfill(plan: AggregatePlan, rs: ResultSet) -> ResultSet:
+    """Expand to a dense (group × bucket) grid; fill per locf/interpolate
+    (reference extension/expr scalar_function gapfill/locf/interpolate)."""
+    origin, interval = plan.bucket
+    cols = {n: c for n, c in zip(rs.names, rs.columns)}
+    # outputs may alias the bucket ("t") and tags: resolve via plan.output
+    time_name = None
+    tag_name_of: dict[str, str] = {}
+    for name, expr in plan.output:
+        if isinstance(expr, Column):
+            if expr.name == "time":
+                time_name = name
+            elif expr.name in plan.group_tags:
+                tag_name_of[expr.name] = name
+    if time_name is None or time_name not in cols:
+        return rs
+    times = cols[time_name].astype(np.int64)
+    # grid bounds: the query's time range when bounded, else observed range
+    lo = times.min()
+    hi = times.max()
+    if not plan.time_ranges.is_all:
+        qlo, qhi = plan.time_ranges.min_ts, plan.time_ranges.max_ts
+        if qlo > -(2**62):
+            lo = origin + ((qlo - origin) // interval) * interval
+        if qhi < 2**62:
+            hi = origin + ((qhi - origin) // interval) * interval
+    grid = np.arange(lo, hi + 1, interval, dtype=np.int64)
+    gt = [tag_name_of.get(t, t) for t in plan.group_tags if
+          tag_name_of.get(t, t) in cols]
+    group_keys = list(zip(*[cols[t] for t in gt])) if gt else [()] * rs.n_rows
+    groups: dict[tuple, dict[int, int]] = {}
+    for i, k in enumerate(group_keys):
+        groups.setdefault(tuple(k), {})[int(times[i])] = i
+
+    agg_names = [n for n in rs.names if n not in gt and n != time_name]
+    out: dict[str, list] = {n: [] for n in rs.names}
+    for key in sorted(groups, key=lambda k: tuple(str(x) for x in k)):
+        row_of = groups[key]
+        present_t = np.array(sorted(row_of), dtype=np.int64)
+        for name in agg_names:
+            src = cols[name]
+            if src.dtype == object:
+                # string-valued aggregates: grid holes stay None; only locf
+                # makes sense for them
+                vals = np.full(len(grid), None, dtype=object)
+                for t, i in row_of.items():
+                    gi = (t - lo) // interval
+                    if 0 <= gi < len(grid):
+                        vals[gi] = src[i]
+                if plan.fill_methods.get(name) == "locf":
+                    last = None
+                    for j in range(len(vals)):
+                        if vals[j] is None:
+                            vals[j] = last
+                        else:
+                            last = vals[j]
+                out[name].extend(vals.tolist())
+                continue
+            vals = np.full(len(grid), np.nan)
+            for t, i in row_of.items():
+                gi = (t - lo) // interval
+                if 0 <= gi < len(grid):
+                    v = src[i]
+                    vals[gi] = v if v is not None else np.nan
+            method = plan.fill_methods.get(name)
+            if method == "locf":
+                last = np.nan
+                for j in range(len(vals)):
+                    if np.isnan(vals[j]):
+                        vals[j] = last
+                    else:
+                        last = vals[j]
+            elif method == "interpolate":
+                known = ~np.isnan(vals)
+                if known.sum() >= 2:
+                    xs = grid[known].astype(np.float64)
+                    ys = vals[known]
+                    missing = ~known
+                    interp = np.interp(grid[missing].astype(np.float64), xs, ys)
+                    # strict interpolation: no extrapolation beyond endpoints
+                    mlo, mhi = grid[known][0], grid[known][-1]
+                    inside = (grid[missing] >= mlo) & (grid[missing] <= mhi)
+                    fill = np.full(missing.sum(), np.nan)
+                    fill[inside] = interp[inside]
+                    vals[missing] = fill
+            out[name].extend(vals.tolist())
+        for i, t in enumerate(gt):
+            out[t].extend([key[i]] * len(grid))
+        out[time_name].extend(grid.tolist())
+    new_cols = []
+    for n in rs.names:
+        if n == time_name:
+            new_cols.append(np.array(out[n], dtype=np.int64))
+        elif n in gt or (n in cols and cols[n].dtype == object):
+            new_cols.append(np.array(out[n], dtype=object))
+        else:
+            new_cols.append(np.array(out[n]))
+    return ResultSet(rs.names, new_cols)
 
 
 def _order_limit(rs: ResultSet, order_by, limit, offset, env) -> ResultSet:

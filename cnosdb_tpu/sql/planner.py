@@ -48,6 +48,8 @@ class AggregatePlan:
     order_by: list
     limit: int | None
     offset: int | None
+    gapfill: bool = False                # dense bucket grid requested
+    fill_methods: dict = field(default_factory=dict)  # output → locf|interpolate
 
 
 @dataclass
@@ -188,7 +190,8 @@ def _contains_agg(e) -> bool:
 
 
 def _is_bucket_func(e) -> bool:
-    return isinstance(e, Func) and e.name.lower() in ("date_bin", "time_window", "time_bucket")
+    return isinstance(e, Func) and e.name.lower() in (
+        "date_bin", "time_window", "time_bucket", "time_window_gapfill")
 
 
 def _bucket_params(e: Func) -> tuple[int, int]:
@@ -309,6 +312,8 @@ def _plan_aggregate(stmt, schema, time_trs, tag_domains, residual):
         classify_group(g)
 
     # outputs
+    gapfill = False
+    fill_methods: dict[str, str] = {}
     output: list[tuple[str, Expr]] = []
     for idx, it in enumerate(stmt.items):
         e = it.expr
@@ -319,7 +324,16 @@ def _plan_aggregate(stmt, schema, time_trs, tag_domains, residual):
             if bucket is None:
                 bucket = _bucket_params(e)
                 bucket_alias = it.alias
+            if e.name.lower() == "time_window_gapfill":
+                gapfill = True
             output.append((name, Column("time")))
+            continue
+        # locf(...)/interpolate(...) wrap an aggregate output with a fill rule
+        if isinstance(e, Func) and e.name.lower() in ("locf", "interpolate") \
+                and len(e.args) == 1:
+            name = it.alias or _default_agg_name(e)
+            fill_methods[name] = e.name.lower()
+            output.append((name, coll.rewrite(e.args[0])))
             continue
         if isinstance(e, Column) and e.name in tag_names:
             if e.name not in group_tags:
@@ -340,12 +354,15 @@ def _plan_aggregate(stmt, schema, time_trs, tag_domains, residual):
         else:
             order_by.append((coll.rewrite(oe), asc))
 
+    if (gapfill or fill_methods) and bucket is None:
+        raise PlanError("gapfill/locf/interpolate require a time bucket")
     return AggregatePlan(
         table=stmt.table, schema=schema, time_ranges=time_trs,
         tag_domains=tag_domains, filter=residual, group_tags=group_tags,
         bucket=bucket, bucket_alias=bucket_alias, aggs=coll.aggs,
         output=output, having=having, order_by=order_by,
-        limit=stmt.limit, offset=stmt.offset)
+        limit=stmt.limit, offset=stmt.offset,
+        gapfill=gapfill or bool(fill_methods), fill_methods=fill_methods)
 
 
 def _default_agg_name(e: Func) -> str:

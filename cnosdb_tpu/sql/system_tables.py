@@ -1,0 +1,108 @@
+"""System schemas: information_schema / cluster_schema / usage_schema.
+
+Role-parity with the reference's metadata providers
+(query_server/query/src/metadata/: information_schema_provider,
+cluster_schema_provider, usage_schema_provider): virtual tables backed by
+the meta store and engine stats, addressable as
+`SELECT ... FROM information_schema.<table>`.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..errors import TableNotFound
+
+
+def is_system_db(db: str) -> bool:
+    return db in ("information_schema", "cluster_schema", "usage_schema")
+
+
+def system_table(executor, db: str, table: str, session) -> tuple[list[str], list]:
+    meta = executor.meta
+    engine = executor.coord.engine
+    t = table.lower()
+    if db == "information_schema":
+        if t == "databases":
+            rows = []
+            for name in meta.list_databases(session.tenant):
+                o = meta.database(session.tenant, name).options
+                rows.append((session.tenant, name, str(o.ttl), o.shard_num,
+                             str(o.vnode_duration), o.replica, o.precision.name))
+            return _cols(["tenant_name", "database_name", "ttl", "shard",
+                          "vnode_duration", "replica", "precision"], rows)
+        if t == "tables":
+            rows = []
+            for dbn in meta.list_databases(session.tenant):
+                for tn in meta.list_tables(session.tenant, dbn):
+                    rows.append((session.tenant, dbn, tn, "BASE TABLE"))
+            return _cols(["table_tenant", "table_database", "table_name",
+                          "table_type"], rows)
+        if t == "columns":
+            rows = []
+            for dbn in meta.list_databases(session.tenant):
+                for tn in meta.list_tables(session.tenant, dbn):
+                    schema = meta.table(session.tenant, dbn, tn)
+                    for c in schema.columns:
+                        ct = c.column_type
+                        kind = ("TIME" if ct.is_time else
+                                "TAG" if ct.is_tag else "FIELD")
+                        dtype = ("TIMESTAMP" if ct.is_time else "STRING"
+                                 if ct.is_tag else ct.value_type.sql_name())
+                        rows.append((session.tenant, dbn, tn, c.name, kind,
+                                     dtype, c.encoding.name))
+            return _cols(["table_tenant", "table_database", "table_name",
+                          "column_name", "column_type", "data_type",
+                          "compression_codec"], rows)
+        if t == "tenants":
+            rows = [(name, opts.comment) for name, opts in meta.tenants.items()]
+            return _cols(["tenant_name", "tenant_options"], rows)
+        if t == "users":
+            rows = [(name, bool(u.get("admin")), u.get("comment", ""))
+                    for name, u in meta.users.items()]
+            return _cols(["user_name", "is_admin", "comment"], rows)
+        if t == "queries":
+            return _cols(["query_id", "query_text", "user_name", "tenant_name",
+                          "state", "duration"], [])
+    if db == "cluster_schema":
+        if t == "nodes":
+            rows = [(n.id, n.http_addr, n.grpc_addr, "running")
+                    for n in meta.nodes.values()]
+            return _cols(["node_id", "http_addr", "grpc_addr", "status"], rows)
+        if t == "vnodes":
+            rows = []
+            for owner, buckets in meta.buckets.items():
+                for b in buckets:
+                    for rs in b.shard_group:
+                        for v in rs.vnodes:
+                            rows.append((v.id, owner, b.id, rs.id, v.node_id,
+                                         v.status.name))
+            return _cols(["vnode_id", "owner", "bucket_id", "replica_set_id",
+                          "node_id", "status"], rows)
+    if db == "usage_schema":
+        if t == "disk_usage":
+            rows = []
+            for (owner, vid), v in engine.vnodes.items():
+                rows.append((owner, vid, v.disk_size(), v.series_count()))
+            return _cols(["owner", "vnode_id", "disk_bytes", "series_count"], rows)
+        if t == "wal_usage":
+            rows = []
+            for (owner, vid), v in engine.vnodes.items():
+                rows.append((owner, vid, v.wal.total_size()))
+            return _cols(["owner", "vnode_id", "wal_bytes"], rows)
+    raise TableNotFound(f"{db}.{table}")
+
+
+def _cols(names: list[str], rows: list[tuple]):
+    if not rows:
+        return names, [np.empty(0, dtype=object) for _ in names]
+    cols = []
+    for i in range(len(names)):
+        vals = [r[i] for r in rows]
+        if all(isinstance(v, bool) for v in vals):
+            cols.append(np.array(vals))
+        elif all(isinstance(v, (int, np.integer)) and not isinstance(v, bool)
+                 for v in vals):
+            cols.append(np.array(vals, dtype=np.int64))
+        else:
+            cols.append(np.array(vals, dtype=object))
+    return names, cols

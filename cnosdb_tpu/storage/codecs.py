@@ -126,6 +126,11 @@ def _decode_delta(data: bytes, unsigned: bool) -> np.ndarray:
         out = first + stride * np.arange(n, dtype=np.int64)
         return out.view(dtype)
     width = data[13]
+    from . import native
+
+    nat = native.decode_delta_i64(data[14:], width, first, n)
+    if nat is not None:
+        return nat.view(dtype)
     zz = _widen(width, _ZSTD_D.decompress(data[14:]))
     deltas = unzigzag(zz)
     out = np.empty(n, dtype=np.int64)
@@ -154,6 +159,11 @@ def _decode_gorilla(data: bytes) -> np.ndarray:
     if data[0] == 0:
         return np.empty(0, dtype=np.float64)
     n = int(np.frombuffer(data[1:5], dtype=np.uint32)[0])
+    from . import native
+
+    nat = native.decode_xor_f64(data[5:], n)
+    if nat is not None:
+        return nat
     x = _byte_untranspose(_ZSTD_D.decompress(data[5:]), 8, np.uint64)
     assert len(x) == n, (len(x), n)
     return prefix_xor_scan(x).view(np.float64)

@@ -1,0 +1,118 @@
+"""ctypes bindings for the native codec library (native/codecs.cpp).
+
+Loads cnosdb_tpu/_native/libcnosdb_codecs.so when present (built via
+`make -C native`; auto-built on first import when a compiler is around) and
+exposes fused decode kernels; storage.codecs falls back to the vectorized
+numpy pipeline when unavailable, so the package works without a toolchain.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+
+import numpy as np
+
+import threading
+
+_LIB = None
+_TRIED = False
+_LOAD_LOCK = threading.Lock()
+_tls = threading.local()
+
+
+def _lib_path() -> str:
+    return os.path.join(os.path.dirname(os.path.dirname(__file__)),
+                        "_native", "libcnosdb_codecs.so")
+
+
+def _try_build() -> bool:
+    native_dir = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.dirname(__file__))), "native")
+    if not os.path.isdir(native_dir):
+        return False
+    try:
+        subprocess.run(["make", "-C", native_dir], check=True,
+                       capture_output=True, timeout=120)
+        return os.path.exists(_lib_path())
+    except Exception:
+        return False
+
+
+def get_lib():
+    global _LIB, _TRIED
+    if _LIB is not None or _TRIED:
+        return _LIB
+    with _LOAD_LOCK:
+        return _get_lib_locked()
+
+
+def _get_lib_locked():
+    global _LIB, _TRIED
+    if _LIB is not None or _TRIED:
+        return _LIB
+    _TRIED = True
+    if os.environ.get("CNOSDB_NO_NATIVE"):
+        return None
+    path = _lib_path()
+    if not os.path.exists(path):
+        if not _try_build():
+            return None
+    try:
+        lib = ctypes.CDLL(path)
+        lib.decode_delta_i64.restype = ctypes.c_int
+        lib.decode_delta_i64.argtypes = [
+            ctypes.c_char_p, ctypes.c_size_t, ctypes.c_int, ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_int64), ctypes.c_size_t,
+            ctypes.POINTER(ctypes.c_uint8), ctypes.c_size_t]
+        lib.decode_xor_f64.restype = ctypes.c_int
+        lib.decode_xor_f64.argtypes = [
+            ctypes.c_char_p, ctypes.c_size_t,
+            ctypes.POINTER(ctypes.c_uint64), ctypes.c_size_t,
+            ctypes.POINTER(ctypes.c_uint8), ctypes.c_size_t]
+        lib.version.restype = ctypes.c_int
+        if lib.version() != 1:
+            return None
+        _LIB = lib
+    except OSError:
+        _LIB = None
+    return _LIB
+
+
+def available() -> bool:
+    return get_lib() is not None
+
+
+def _get_scratch(size: int) -> np.ndarray:
+    """Per-thread scratch: decodes run concurrently (query pool + the
+    background compaction worker), a shared buffer would corrupt both."""
+    buf = getattr(_tls, "scratch", None)
+    if buf is None or len(buf) < size:
+        buf = _tls.scratch = np.empty(max(size, 1 << 20), dtype=np.uint8)
+    return buf
+
+
+def decode_delta_i64(comp: bytes, width: int, first: int, n: int) -> np.ndarray | None:
+    lib = get_lib()
+    if lib is None:
+        return None
+    out = np.empty(n, dtype=np.int64)
+    scratch = _get_scratch((n - 1) * width if n > 1 else 1)
+    rc = lib.decode_delta_i64(
+        comp, len(comp), width, first,
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)), n,
+        scratch.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)), len(scratch))
+    return out if rc == 0 else None
+
+
+def decode_xor_f64(comp: bytes, n: int) -> np.ndarray | None:
+    lib = get_lib()
+    if lib is None:
+        return None
+    out = np.empty(n, dtype=np.uint64)
+    scratch = _get_scratch(n * 8)
+    rc = lib.decode_xor_f64(
+        comp, len(comp),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)), n,
+        scratch.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)), len(scratch))
+    return out.view(np.float64) if rc == 0 else None

@@ -8,7 +8,10 @@ seq > flushed watermark. The replication layer stores its raft entries
 through this same API, so there is exactly one durable log per vnode.
 
 Entry record layout (inside a record-file payload):
-    seq u64 | entry_type u8 | data...
+    seq u64 | entry_type u8 | term u64 | data...
+
+`term` is 0 for unreplicated vnodes; the raft layer stores its term here so
+one durable log serves both recovery paths.
 """
 from __future__ import annotations
 
@@ -21,7 +24,7 @@ from ..errors import WalError
 from .record_file import RecordReader, RecordWriter
 
 SEGMENT_PATTERN = re.compile(r"^wal_(\d{10})\.log$")
-_ENTRY_HDR = struct.Struct("<QB")
+_ENTRY_HDR = struct.Struct("<QBQ")
 
 
 class WalEntryType:
@@ -39,14 +42,15 @@ class WalEntry:
     seq: int
     entry_type: int
     data: bytes
+    term: int = 0
 
     def encode(self) -> bytes:
-        return _ENTRY_HDR.pack(self.seq, self.entry_type) + self.data
+        return _ENTRY_HDR.pack(self.seq, self.entry_type, self.term) + self.data
 
     @classmethod
     def decode(cls, payload: bytes) -> "WalEntry":
-        seq, et = _ENTRY_HDR.unpack_from(payload, 0)
-        return cls(seq, et, payload[_ENTRY_HDR.size:])
+        seq, et, term = _ENTRY_HDR.unpack_from(payload, 0)
+        return cls(seq, et, payload[_ENTRY_HDR.size:], term)
 
 
 class Wal:
@@ -62,6 +66,7 @@ class Wal:
         self._next_seq = 1
         self._min_seq = 1
         self._writer: RecordWriter | None = None
+        self.purge_listeners: list = []  # called with (seq) after purge_to
         if self._segments:
             entries = list(self.replay())
             if entries:
@@ -100,7 +105,8 @@ class Wal:
     def min_seq(self) -> int:
         return self._min_seq
 
-    def append(self, entry_type: int, data: bytes, seq: int | None = None) -> int:
+    def append(self, entry_type: int, data: bytes, seq: int | None = None,
+               term: int = 0) -> int:
         """Append one entry; returns its seq. Explicit `seq` is used by the
         replication layer (raft log index); it must be >= current tail."""
         if seq is None:
@@ -108,7 +114,7 @@ class Wal:
         elif seq < self._next_seq:
             # raft log truncation-on-conflict: drop tail entries >= seq first
             self.truncate_from(seq)
-        e = WalEntry(seq, entry_type, data)
+        e = WalEntry(seq, entry_type, data, term)
         self._writer.append(e.encode())
         if self.sync_on_append:
             self._writer.sync()
@@ -170,6 +176,11 @@ class Wal:
             if max_seq >= seq:
                 break
             os.unlink(self._seg_path(seg))
+        for cb in self.purge_listeners:
+            try:
+                cb(seq)
+            except Exception:
+                pass
 
     def total_size(self) -> int:
         return sum(os.path.getsize(self._seg_path(s)) for s in self._list_segments())

@@ -105,6 +105,28 @@ def test_illegal_encoding_rejected():
         codecs.decode(b"", ValueType.FLOAT)
 
 
+# ------------------------------------------------------------- native parity
+def test_native_decode_matches_numpy(rng):
+    """When the C++ library is present, its fused decode must be
+    bit-identical to the numpy pipeline."""
+    from cnosdb_tpu.storage import native
+
+    if not native.available():
+        pytest.skip("native codec library not built")
+    n = 50_000
+    ts = np.int64(1.6e18) + np.cumsum(rng.integers(1, 10**6, n)).astype(np.int64)
+    vals = np.cumsum(rng.normal(size=n))
+    vals[::97] = np.nan
+    tblk = codecs.encode_timestamps(ts)
+    fblk = codecs.encode(vals, ValueType.FLOAT)
+    width = tblk[1 + 13]
+    first = int(np.frombuffer(tblk[1 + 5:1 + 13], dtype=np.int64)[0])
+    nat_ts = native.decode_delta_i64(tblk[1 + 14:], width, first, n)
+    np.testing.assert_array_equal(nat_ts, ts)
+    nat_f = native.decode_xor_f64(fblk[1 + 5:], n)
+    np.testing.assert_array_equal(nat_f.view(np.uint64), vals.view(np.uint64))
+
+
 # ------------------------------------------------------------- perf sanity
 def test_decode_speed_smoke():
     """Decode must be way faster than Python-loop speed (vectorized check)."""

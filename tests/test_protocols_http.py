@@ -36,6 +36,16 @@ def test_line_protocol_escapes_and_precision():
     assert sr.timestamps == [5_000_000]
 
 
+def test_line_protocol_distinct_tag_values_make_distinct_series():
+    """Same tag KEYS but different values must NOT merge into one series."""
+    wb = parse_lines("cpu,host=a v=1 1\ncpu,host=b v=2 1\ncpu,host=a v=3 2\n")
+    series = wb.tables["cpu"]
+    assert len(series) == 2
+    by_host = {sr.key.tag_value("host"): sr for sr in series}
+    assert by_host["a"].timestamps == [1, 2]
+    assert by_host["b"].timestamps == [1]
+
+
 def test_line_protocol_default_time_and_errors():
     wb = parse_lines("cpu v=1", default_time_ns=42)
     assert wb.tables["cpu"][0].timestamps == [42]
@@ -119,7 +129,7 @@ class _HttpHarness:
     def close(self):
         self._loop.call_soon_threadsafe(self._loop.stop)
         self._thread.join(timeout=5)
-        self.server.coord.engine.close()
+        self.server.coord.close()
 
 
 @pytest.fixture

@@ -1,0 +1,95 @@
+"""System tables, heavy aggregates, gapfill/locf/interpolate."""
+import numpy as np
+import pytest
+
+from cnosdb_tpu.parallel.coordinator import Coordinator
+from cnosdb_tpu.parallel.meta import MetaStore
+from cnosdb_tpu.sql.executor import QueryExecutor, Session
+from cnosdb_tpu.storage.engine import TsKv
+
+
+@pytest.fixture
+def db(tmp_path):
+    meta = MetaStore(str(tmp_path / "meta.json"))
+    engine = TsKv(str(tmp_path / "data"))
+    coord = Coordinator(meta, engine)
+    ex = QueryExecutor(meta, coord)
+    yield ex
+    engine.close()
+
+
+@pytest.fixture
+def m(db):
+    db.execute_one("CREATE TABLE m (v DOUBLE, TAGS(h))")
+    rows = []
+    # h=a: values 1..9 at minutes 0..8; h=b: 10,30 at minutes 0 and 4
+    for i in range(9):
+        rows.append(f"({i * 60_000_000_000}, 'a', {i + 1})")
+    rows.append("(0, 'b', 10)")
+    rows.append(f"({4 * 60_000_000_000}, 'b', 30)")
+    db.execute_one("INSERT INTO m (time, h, v) VALUES " + ", ".join(rows))
+    return db
+
+
+def test_information_schema(m):
+    rs = m.execute_one("SELECT database_name FROM information_schema.databases "
+                       "ORDER BY database_name")
+    assert "public" in rs.columns[0].tolist()
+    rs = m.execute_one("SELECT table_name FROM information_schema.tables "
+                       "WHERE table_database = 'public'")
+    assert rs.columns[0].tolist() == ["m"]
+    rs = m.execute_one(
+        "SELECT column_name, column_type FROM information_schema.columns "
+        "WHERE table_name = 'm' ORDER BY column_name")
+    d = dict(zip(rs.columns[0], rs.columns[1]))
+    assert d == {"time": "TIME", "h": "TAG", "v": "FIELD"}
+    rs = m.execute_one("SELECT user_name FROM information_schema.users")
+    assert "root" in rs.columns[0].tolist()
+
+
+def test_cluster_and_usage_schema(m):
+    rs = m.execute_one("SELECT vnode_id, status FROM cluster_schema.vnodes")
+    assert rs.n_rows >= 1
+    rs = m.execute_one("SELECT owner, series_count FROM usage_schema.disk_usage")
+    assert rs.n_rows >= 1
+
+
+def test_median_stddev_mode(m):
+    rs = m.execute_one(
+        "SELECT median(v) AS md, stddev(v) AS sd FROM m WHERE h = 'a'")
+    vals = np.arange(1.0, 10.0)
+    assert rs.columns[0][0] == pytest.approx(np.median(vals))
+    assert rs.columns[1][0] == pytest.approx(np.std(vals, ddof=1))
+    m.execute_one("INSERT INTO m (time, h, v) VALUES (999, 'c', 5), (1000, 'c', 5), (1001, 'c', 7)")
+    rs = m.execute_one("SELECT mode(v) AS mo FROM m WHERE h = 'c'")
+    assert rs.columns[0][0] == 5.0
+
+
+def test_increase(m):
+    rs = m.execute_one("SELECT h, increase(v) AS inc FROM m GROUP BY h ORDER BY h")
+    assert rs.rows() == [("a", 8.0), ("b", 20.0)]
+
+
+def test_gapfill_locf(m):
+    rs = m.execute_one(
+        "SELECT h, time_window_gapfill(time, INTERVAL '1 minute') AS t, "
+        "locf(max(v)) AS v FROM m WHERE h = 'b' GROUP BY h, t ORDER BY t")
+    # b has data at minute 0 and 4 → grid fills minutes 1-3 with locf
+    assert rs.n_rows == 5
+    assert rs.columns[2].tolist() == [10.0, 10.0, 10.0, 10.0, 30.0]
+
+
+def test_gapfill_interpolate(m):
+    rs = m.execute_one(
+        "SELECT h, time_window_gapfill(time, INTERVAL '1 minute') AS t, "
+        "interpolate(max(v)) AS v FROM m WHERE h = 'b' GROUP BY h, t ORDER BY t")
+    assert rs.columns[2].tolist() == pytest.approx([10.0, 15.0, 20.0, 25.0, 30.0])
+
+
+def test_gapfill_grid_bounded_by_where(m):
+    rs = m.execute_one(
+        "SELECT time_window_gapfill(time, INTERVAL '1 minute') AS t, "
+        "locf(max(v)) AS v FROM m WHERE h = 'b' AND time >= 0 "
+        "AND time <= 360000000000 GROUP BY t ORDER BY t")
+    assert rs.n_rows == 7  # minutes 0..6 despite data ending at minute 4
+    assert rs.columns[1].tolist()[-1] == 30.0
