@@ -132,8 +132,30 @@ class HttpServer:
         return web.Response(status=200)
 
     async def handle_prom_write(self, request):
-        return _err_response(501, QueryError(
-            "prometheus remote write requires snappy; not yet enabled"))
+        """Prometheus remote write: snappy + prompb (reference
+        prom/remote_server.rs remote_write)."""
+        session = self._session(request)
+        from ..protocol.prometheus import parse_remote_write, snappy_available
+
+        if not snappy_available():
+            return _err_response(501, QueryError("snappy library unavailable"))
+        body = await request.read()
+        try:
+            batch = parse_remote_write(body)
+        except CnosError as e:
+            return _err_response(_status_for(e), e)
+        except Exception as e:
+            # malformed prompb must be 4xx: prometheus retries 5xx forever
+            return _err_response(400, ParserError(f"bad remote-write body: {e}"))
+        try:
+            loop = asyncio.get_running_loop()
+            await loop.run_in_executor(
+                None, lambda: self.coord.write_points(
+                    session.tenant, session.database, batch))
+        except CnosError as e:
+            return _err_response(_status_for(e), e)
+        self.metrics.incr("prom_write_points", batch.n_rows())
+        return web.Response(status=204)
 
     async def handle_metrics(self, request):
         return web.Response(text=self.metrics.prometheus_text(),
@@ -249,11 +271,45 @@ def build_server(data_dir: str, auth_enabled: bool = False,
 
 def run_server(args) -> int:
     import asyncio
+    import time as _time
 
-    server = build_server(args.data_dir)
+    from ..config import Config
+
+    # Config.load with no path still applies CNOSDB_* env overrides
+    cfg = Config.load(getattr(args, "config", None))
+    server = build_server(args.data_dir,
+                          auth_enabled=cfg.query.auth_enabled,
+                          wal_sync=cfg.wal.sync)
+    flight_port = cfg.service.flight_rpc_listen_port
+
+    async def ttl_job():
+        """Bucket TTL expiry (reference meta_admin.rs:848 + ResourceManager):
+        drop vnodes of expired buckets."""
+        while True:
+            await asyncio.sleep(60)
+            now = int(_time.time() * 1e9)
+            for owner in list(server.meta.databases):
+                tenant, db = owner.split(".", 1)
+                try:
+                    for bucket in server.meta.expire_buckets(tenant, db, now):
+                        for rs in bucket.shard_group:
+                            for v in rs.vnodes:
+                                server.coord.engine.drop_vnode(owner, v.id)
+                except Exception:
+                    pass
 
     async def main():
         await server.start(port=args.http_port)
+        try:
+            from .flight import start_flight_server
+
+            start_flight_server(server.executor, flight_port,
+                                auth_enabled=cfg.query.auth_enabled)
+            print(f"flight sql on :{flight_port}")
+        except Exception as e:
+            print(f"flight sql disabled: {e}")
+        # hold a strong reference: the loop keeps only weak refs to tasks
+        main._ttl_task = asyncio.get_running_loop().create_task(ttl_job())
         print(f"cnosdb-tpu listening on :{args.http_port} "
               f"(data dir {args.data_dir}, mode {getattr(args, 'mode', 'singleton')})")
         while True:

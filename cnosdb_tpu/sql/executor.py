@@ -64,15 +64,80 @@ class ResultSet:
         return cls(["result"], [np.array([text], dtype=object)])
 
 
+class QueryTracker:
+    """Running-query registry with cooperative kill (reference
+    dispatcher/query_tracker.rs:32)."""
+
+    def __init__(self):
+        import threading
+
+        self._lock = threading.Lock()
+        self._next = 1
+        self.running: dict[int, dict] = {}
+
+    def register(self, sql: str, session: "Session") -> int:
+        import time as _t
+
+        with self._lock:
+            qid = self._next
+            self._next += 1
+            self.running[qid] = {"sql": sql, "user": session.user,
+                                 "tenant": session.tenant,
+                                 "start": _t.time(), "cancelled": False}
+            return qid
+
+    def finish(self, qid: int):
+        with self._lock:
+            self.running.pop(qid, None)
+
+    def kill(self, qid: int) -> bool:
+        with self._lock:
+            q = self.running.get(qid)
+            if q is None:
+                return False
+            q["cancelled"] = True
+            return True
+
+    def check_cancelled(self, qid: int):
+        q = self.running.get(qid)
+        if q is not None and q["cancelled"]:
+            raise QueryError(f"query {qid} cancelled")
+
+    def snapshot(self) -> list[tuple[int, dict]]:
+        with self._lock:
+            return [(qid, dict(q)) for qid, q in self.running.items()]
+
+
 class QueryExecutor:
     def __init__(self, meta: MetaStore, coord: Coordinator):
         self.meta = meta
         self.coord = coord
+        self.tracker = QueryTracker()
 
     # ------------------------------------------------------------------ api
     def execute_sql(self, sql: str, session: Session | None = None) -> list[ResultSet]:
         session = session or Session()
-        return [self.execute_statement(s, session) for s in parse_sql(sql)]
+        qid = self.tracker.register(sql, session)
+        import threading as _th
+
+        if not hasattr(self, "_tls"):
+            self._tls = _th.local()
+        prev_qid = getattr(self._tls, "qid", None)
+        self._tls.qid = qid
+        try:
+            out = []
+            for s in parse_sql(sql):
+                self.tracker.check_cancelled(qid)
+                out.append(self.execute_statement(s, session))
+            return out
+        finally:
+            self._tls.qid = prev_qid
+            self.tracker.finish(qid)
+
+    def _poll_cancel(self):
+        qid = getattr(getattr(self, "_tls", None), "qid", None)
+        if qid is not None:
+            self.tracker.check_cancelled(qid)
 
     def execute_one(self, sql: str, session: Session | None = None) -> ResultSet:
         rs = self.execute_sql(sql, session)
@@ -131,6 +196,9 @@ class QueryExecutor:
         if isinstance(stmt, ast.AlterUser):
             self.meta.alter_user(stmt.name, stmt.password)
             return ResultSet.message("ok")
+        if isinstance(stmt, ast.KillQuery):
+            ok = self.tracker.kill(stmt.query_id)
+            return ResultSet.message("ok" if ok else "no such query")
         if isinstance(stmt, ast.CompactStmt):
             self.coord.engine.compact_all()
             return ResultSet.message("ok")
@@ -231,7 +299,20 @@ class QueryExecutor:
                 reprs = reprs[:stmt.limit]
             return ResultSet(["key"], [np.array(reprs, dtype=object)])
         if stmt.kind == "queries":
-            return ResultSet.empty(["query_id", "query_text", "user_name"])
+            import time as _t
+
+            ids, texts, users, durs = [], [], [], []
+            for qid, q in self.tracker.snapshot():
+                ids.append(qid)
+                texts.append(q["sql"][:200])
+                users.append(q["user"])
+                durs.append(round(_t.time() - q["start"], 3))
+            return ResultSet(
+                ["query_id", "query_text", "user_name", "duration"],
+                [np.array(ids, dtype=np.int64),
+                 np.array(texts, dtype=object),
+                 np.array(users, dtype=object),
+                 np.array(durs)])
         raise ExecutionError(f"unsupported SHOW {stmt.kind}")
 
     def _describe(self, stmt: ast.DescribeStmt, session: Session):
@@ -444,15 +525,21 @@ class QueryExecutor:
             return self._finalize_single(plan, r, phys_aggs, finalize)
         acc: dict[tuple, dict] = {}
         for batch, job in zip(batches, jobs):
+            self._poll_cancel()  # KILL QUERY lands between vnode fetches
             r = finish_scan_aggregate(job)
             _merge_partial(acc, r, plan, phys_aggs)
             for spec in distinct_specs:
                 _merge_distinct(acc, batch, plan, spec)
+        if not acc and not plan.group_tags and plan.bucket is None:
+            acc[()] = {}  # SQL: a global aggregate always yields one row
 
         return self._finalize_aggregate(plan, acc, finalize)
 
     def _finalize_single(self, plan: AggregatePlan, r, phys_aggs, finalize):
         n = r.n_rows
+        if n == 0 and not plan.group_tags and plan.bucket is None:
+            # SQL: a global aggregate always yields one row
+            return self._finalize_aggregate(plan, {(): {}}, finalize)
         env: dict[str, np.ndarray] = {}
         for t in plan.group_tags:
             env[t] = r.columns[t]

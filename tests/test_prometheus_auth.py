@@ -1,0 +1,157 @@
+"""Prometheus remote write, auth enforcement, query tracker, TTL expiry."""
+import struct
+import time
+
+import numpy as np
+import pytest
+
+from cnosdb_tpu.models.schema import DatabaseOptions, DatabaseSchema, Duration
+from cnosdb_tpu.parallel.coordinator import Coordinator
+from cnosdb_tpu.parallel.meta import MetaStore, DEFAULT_TENANT
+from cnosdb_tpu.protocol import prometheus as prom
+from cnosdb_tpu.sql.executor import QueryExecutor, Session
+from cnosdb_tpu.storage.engine import TsKv
+
+
+def _varint(v: int) -> bytes:
+    out = b""
+    while True:
+        b = v & 0x7F
+        v >>= 7
+        if v:
+            out += bytes([b | 0x80])
+        else:
+            return out + bytes([b])
+
+
+def _ld(field_no: int, payload: bytes) -> bytes:
+    return _varint((field_no << 3) | 2) + _varint(len(payload)) + payload
+
+
+def _label(name: str, value: str) -> bytes:
+    return _ld(1, name.encode()) + _ld(2, value.encode())
+
+
+def _sample(value: float, ts_ms: int) -> bytes:
+    return (_varint((1 << 3) | 1) + struct.pack("<d", value)
+            + _varint(2 << 3) + _varint(ts_ms & (2**64 - 1)))
+
+
+def _write_request() -> bytes:
+    ts1 = (_ld(1, _label("__name__", "node_cpu")) + _ld(1, _label("host", "a"))
+           + _ld(2, _sample(0.5, 1000)) + _ld(2, _sample(0.7, 2000)))
+    ts2 = (_ld(1, _label("__name__", "node_mem")) + _ld(1, _label("host", "a"))
+           + _ld(2, _sample(100.0, 1000)))
+    return _ld(1, ts1) + _ld(1, ts2)
+
+
+def test_prom_parse_remote_write():
+    if not prom.snappy_available():
+        pytest.skip("libsnappy not present")
+    body = prom.snappy_compress(_write_request())
+    wb = prom.parse_remote_write(body)
+    assert set(wb.tables) == {"node_cpu", "node_mem"}
+    sr = wb.tables["node_cpu"][0]
+    assert sr.key.tag_value("host") == "a"
+    assert sr.timestamps == [1000 * 10**6, 2000 * 10**6]
+    assert sr.fields["value"][1] == [0.5, 0.7]
+
+
+@pytest.fixture
+def db(tmp_path):
+    meta = MetaStore(str(tmp_path / "meta.json"))
+    engine = TsKv(str(tmp_path / "data"))
+    coord = Coordinator(meta, engine)
+    ex = QueryExecutor(meta, coord)
+    yield meta, coord, ex
+    coord.close()
+
+
+def test_prom_end_to_end(db):
+    meta, coord, ex = db
+    if not prom.snappy_available():
+        pytest.skip("libsnappy not present")
+    wb = prom.parse_remote_write(prom.snappy_compress(_write_request()))
+    coord.write_points(DEFAULT_TENANT, "public", wb)
+    rs = ex.execute_one("SELECT count(*) AS c, max(value) AS m FROM node_cpu")
+    assert rs.rows()[0] == (2, 0.7)
+
+
+def test_show_queries_and_kill(db):
+    meta, coord, ex = db
+    # a registered query shows up while running: simulate by registering
+    qid = ex.tracker.register("SELECT 1", Session())
+    rs = ex.execute_one("SHOW QUERIES")
+    assert qid in rs.columns[0].tolist()
+    ok = ex.execute_one(f"KILL QUERY {qid}")
+    assert ok.columns[0][0] == "ok"
+    with pytest.raises(Exception):
+        ex.tracker.check_cancelled(qid)
+    ex.tracker.finish(qid)
+
+
+def test_ttl_bucket_expiry(db):
+    meta, coord, ex = db
+    meta.create_database(DatabaseSchema(
+        DEFAULT_TENANT, "short", DatabaseOptions(
+            ttl=Duration.parse("1d"), vnode_duration=Duration.parse("1h"))))
+    s = Session(database="short")
+    ex.execute_one("CREATE TABLE m (v DOUBLE, TAGS(h))", s)
+    now = int(time.time() * 1e9)
+    old = now - 3 * 86_400_000_000_000
+    ex.execute_one(f"INSERT INTO m (time, h, v) VALUES ({old}, 'a', 1), ({now}, 'a', 2)", s)
+    assert len(meta.buckets_for(DEFAULT_TENANT, "short")) == 2
+    expired = meta.expire_buckets(DEFAULT_TENANT, "short", now)
+    assert len(expired) == 1
+    owner = f"{DEFAULT_TENANT}.short"
+    for rs_ in expired[0].shard_group:
+        for v in rs_.vnodes:
+            coord.engine.drop_vnode(owner, v.id)
+    rs = ex.execute_one("SELECT count(*) AS c FROM m", s)
+    assert rs.columns[0][0] == 1  # old bucket gone, recent row remains
+
+
+def test_http_auth_enforced(tmp_path):
+    import sys
+    sys.path.insert(0, "tests")
+    from test_protocols_http import _HttpHarness
+
+    h = _HttpHarness.__new__(_HttpHarness)
+    import asyncio, socket, threading
+    from cnosdb_tpu.server.http import build_server
+
+    h.server = build_server(str(tmp_path / "srv"), auth_enabled=True)
+    h.server.meta.create_user("alice", "pw123")
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        h.port = s.getsockname()[1]
+    h._loop = asyncio.new_event_loop()
+    h._started = threading.Event()
+
+    def run():
+        asyncio.set_event_loop(h._loop)
+
+        async def boot():
+            h._runner = await h.server.start("127.0.0.1", h.port)
+            h._started.set()
+        h._loop.create_task(boot())
+        h._loop.run_forever()
+
+    h._thread = threading.Thread(target=run, daemon=True)
+    h._thread.start()
+    assert h._started.wait(10)
+    try:
+        import base64
+
+        status, _ = h.request("POST", "/api/v1/sql?db=public", "SELECT 1")
+        assert status == 401
+        tok = base64.b64encode(b"alice:wrong").decode()
+        status, _ = h.request("POST", "/api/v1/sql?db=public", "SELECT 1",
+                              headers={"Authorization": f"Basic {tok}"})
+        assert status == 401
+        tok = base64.b64encode(b"alice:pw123").decode()
+        status, _ = h.request("POST", "/api/v1/sql?db=public", "SELECT 1",
+                              headers={"Authorization": f"Basic {tok}"})
+        assert status == 200
+    finally:
+        h.close()
