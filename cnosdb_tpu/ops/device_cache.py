@@ -37,7 +37,7 @@ class DeviceBatch:
     __slots__ = ("n_rows", "n_pad", "n_series", "epoch_ns", "ts_sec", "ts_ns",
                  "sid_ordinal", "rank", "in_rows", "fields", "ts_min", "ts_max",
                  "i32_ok", "ns_all_zero", "field_all_valid", "_rank_np",
-                 "series_params", "_ts_sec_np", "_sid_np")
+                 "series_params")
 
     def __init__(self, batch):
         n = batch.n_rows
@@ -65,12 +65,14 @@ class DeviceBatch:
         # wire or occupy HBM. This is TSM run-length structure carried onto
         # the device.
         self.series_params = None
-        self._ts_sec_np = sec
-        self._sid_np = batch.sid_ordinal
         import os as _os
 
+        # opt-in: reconstructing sid/ts_sec on device trades ~16MB of
+        # transfer for extra gathers — measured a net loss on both the
+        # relay-attached TPU and host XLA; wins only where HBM bandwidth is
+        # real and the pipe is the bottleneck
         if n and self.ns_all_zero and _os.environ.get(
-                "CNOSDB_TPU_REGULAR", "1") != "0":
+                "CNOSDB_TPU_REGULAR", "0") == "1":
             self.series_params = _regular_series_params(
                 batch.sid_ordinal, sec, batch.n_series, self.n_pad)
         if self.series_params is not None:

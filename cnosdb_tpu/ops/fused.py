@@ -66,13 +66,14 @@ class PendingFused:
     """A launched (asynchronous) fused kernel; fetch() pulls the single
     packed output matrix in ONE device→host transfer and unpacks it."""
 
-    __slots__ = ("dev_out", "manifest", "num_segments", "int_cols")
+    __slots__ = ("dev_out", "manifest", "num_segments", "int_cols", "agg_cols")
 
-    def __init__(self, dev_out, manifest, num_segments, int_cols):
+    def __init__(self, dev_out, manifest, num_segments, int_cols, agg_cols):
         self.dev_out = dev_out
         self.manifest = manifest
         self.num_segments = num_segments
         self.int_cols = int_cols
+        self.agg_cols = agg_cols
 
     def fetch(self) -> dict[str, dict]:
         mat = np.asarray(self.dev_out)  # [n_slots, ns_pad], one transfer
@@ -84,6 +85,12 @@ class PendingFused:
                 # precision in the packed f64 transfer (documented limit)
                 row = row.astype(np.int64)
             out.setdefault(col, {})[agg] = row
+        presence = out.get("__presence__", {}).get("count")
+        if presence is not None:
+            # all-valid columns elide their count slot (it IS presence); a
+            # column whose ONLY slot was count must still appear in out
+            for col in self.agg_cols:
+                out.setdefault(col, {}).setdefault("count", presence)
         return out
 
 
@@ -167,7 +174,8 @@ def launch_fused(dbatch: DeviceBatch, filter_expr: Expr | None,
     dev_out = fn(*args)
     int_cols = {name for name in present
                 if jnp.issubdtype(dbatch.fields[name][1].dtype, jnp.integer)}
-    return PendingFused(dev_out, manifest, num_segments, int_cols)
+    agg_cols = tuple(n for n in present if n in col_wants)
+    return PendingFused(dev_out, manifest, num_segments, int_cols, agg_cols)
 
 
 def run_fused(dbatch: DeviceBatch, filter_expr: Expr | None,
@@ -191,9 +199,12 @@ def _build_kernel(filter_expr: Expr | None, col_wants: dict,
     launch_fused."""
     manifest: list[tuple[str, str]] = [("__presence__", "count")]
     agg_cols = [n for n in present if n in col_wants]
+    valid_of = dict(zip(present, valid_flags))
     for name in agg_cols:
         w = col_wants[name]
-        manifest.append((name, "count"))
+        if valid_of.get(name):
+            # nullable column: its count differs from presence → own slot
+            manifest.append((name, "count"))
         for agg, flag in (("sum", "want_sum"), ("min", "want_min"),
                           ("max", "want_max"), ("first", "want_first"),
                           ("last", "want_last")):
@@ -268,8 +279,8 @@ def _build_kernel(filter_expr: Expr | None, col_wants: dict,
             bucket = jnp.zeros_like(sid_ord)
         seg = (group_of_series[sid_ord] * n_buckets + bucket).astype(jnp.int32)
         seg = jnp.where(mask, seg, 0)
-        results = {("__presence__", "count"): jax.ops.segment_sum(
-            mask.astype(jnp.int32), seg, ns_pad)}
+        presence = jax.ops.segment_sum(mask.astype(jnp.int32), seg, ns_pad)
+        results = {("__presence__", "count"): presence}
         for name in agg_cols:
             vals, valid = fields[name]
             w = col_wants[name]
@@ -277,12 +288,16 @@ def _build_kernel(filter_expr: Expr | None, col_wants: dict,
                 vals, (valid & mask) if valid is not None else mask, seg,
                 rank if rank is not None else seg,  # rank unused w/o first/last
                 num_segments=ns_pad,
-                want_count=True,  # always: NULL-presence masking needs it
+                # an all-valid column's count IS the presence count: skip
+                # the extra scatter
+                want_count=valid is not None,
                 want_sum=w.get("want_sum", False),
                 want_min=w.get("want_min", False),
                 want_max=w.get("want_max", False),
                 want_first=w.get("want_first", False),
                 want_last=w.get("want_last", False))
+            if "count" not in part:
+                part["count"] = presence
             for agg, arr in part.items():
                 results[(name, agg)] = arr
         rows = [results[slot].astype(jnp.float64) for slot in manifest]

@@ -11,8 +11,16 @@ from cnosdb_tpu.storage.scan import scan_vnode
 from cnosdb_tpu.storage.vnode import VnodeStorage
 
 
+@pytest.fixture(params=["0", "1"], ids=["rowwise", "regular"])
+def _regular_mode(request, monkeypatch):
+    """Exercise BOTH device layouts: explicit per-row sid/ts and the
+    run-length-reconstruction variant."""
+    monkeypatch.setenv("CNOSDB_TPU_REGULAR", request.param)
+    return request.param
+
+
 @pytest.fixture
-def vnode(tmp_path):
+def vnode(tmp_path, _regular_mode):
     schemas = {"cpu": TskvTableSchema.new_measurement(
         "t", "db", "cpu", tags=["host", "region"],
         fields=[("usage", ValueType.FLOAT), ("n", ValueType.INTEGER)])}
