@@ -18,9 +18,22 @@ SHARD_AXIS = "shard"
 
 def make_mesh(n_devices: int | None = None) -> Mesh:
     devs = jax.devices()
+    if n_devices is not None and n_devices > len(devs):
+        # default backend short on devices (e.g. one real TPU): fall back to
+        # the host platform, which xla_force_host_platform_device_count can
+        # expand into a virtual mesh
+        try:
+            cpu = jax.devices("cpu")
+        except Exception:
+            cpu = []
+        if len(cpu) >= n_devices:
+            devs = cpu
+        else:
+            raise ValueError(
+                f"requested {n_devices} devices, have {len(devs)} "
+                f"(+{len(cpu)} cpu); set XLA_FLAGS="
+                f"--xla_force_host_platform_device_count={n_devices}")
     if n_devices is not None:
-        if n_devices > len(devs):
-            raise ValueError(f"requested {n_devices} devices, have {len(devs)}")
         devs = devs[:n_devices]
     return Mesh(np.array(devs), (SHARD_AXIS,))
 
