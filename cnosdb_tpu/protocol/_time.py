@@ -1,0 +1,20 @@
+"""Shared timestamp normalization for ingest protocols."""
+from __future__ import annotations
+
+
+def normalize_ts_ns(v: int) -> int:
+    """Infer the unit of an integer timestamp by magnitude → ns.
+
+    < 1e11  → seconds      (covers dates well past 5000 AD)
+    < 1e14  → milliseconds
+    < 1e17  → microseconds
+    else    → nanoseconds
+    """
+    v = int(v)
+    if v < 10**11:
+        return v * 10**9
+    if v < 10**14:
+        return v * 10**6
+    if v < 10**17:
+        return v * 10**3
+    return v

@@ -1,0 +1,91 @@
+"""Elasticsearch-style `_bulk` JSON ingestion (log API).
+
+Role-parity with the reference's ES bulk endpoint (common/protocol_parser/
+src/json_protocol/ feeding the `_bulk` log API in http_service.rs): NDJSON
+pairs of action metadata + document. Documents map to rows:
+  - time: `time` / `@timestamp` / `timestamp` field (ISO string, ms, or ns)
+  - keys named in `tag_keys` → tags; other strings → STRING fields;
+    numbers → DOUBLE/BIGINT; bools → BOOLEAN.
+"""
+from __future__ import annotations
+
+import json
+import time as _time
+
+from ..errors import ParserError
+from ..models.points import SeriesRows, WriteBatch
+from ..models.schema import ValueType
+from ..models.series import SeriesKey, Tag
+from ..sql.parser import parse_timestamp_string
+
+
+def _doc_time(doc: dict) -> int:
+    from ._time import normalize_ts_ns
+
+    for k in ("time", "@timestamp", "timestamp"):
+        if k in doc:
+            v = doc.pop(k)
+            if isinstance(v, str):
+                return parse_timestamp_string(v)
+            return normalize_ts_ns(v)
+    return int(_time.time() * 1e9)
+
+
+def parse_es_bulk(body: str, table: str = "logs",
+                  tag_keys: tuple[str, ...] = ()) -> WriteBatch:
+    lines = [l for l in body.splitlines() if l.strip()]
+    groups: dict[tuple, dict] = {}
+    i = 0
+    while i < len(lines):
+        try:
+            meta = json.loads(lines[i])
+        except json.JSONDecodeError as e:
+            raise ParserError(f"bad bulk meta line {i + 1}: {e}")
+        i += 1
+        action = next(iter(meta), "index")
+        if action in ("delete",):
+            continue
+        if i >= len(lines):
+            raise ParserError("bulk action without document")
+        try:
+            doc = json.loads(lines[i])
+        except json.JSONDecodeError as e:
+            raise ParserError(f"bad bulk doc line {i + 1}: {e}")
+        i += 1
+        ts = _doc_time(doc)
+        tags = {}
+        fields = {}
+        for k, v in doc.items():
+            if k in tag_keys:
+                tags[k] = str(v)
+            elif isinstance(v, bool):
+                fields[k] = (ValueType.BOOLEAN, v)
+            elif isinstance(v, int):
+                fields[k] = (ValueType.INTEGER, v)
+            elif isinstance(v, float):
+                fields[k] = (ValueType.FLOAT, v)
+            elif isinstance(v, str):
+                fields[k] = (ValueType.STRING, v)
+            else:
+                fields[k] = (ValueType.STRING, json.dumps(v))
+        key = tuple(sorted(tags.items()))
+        g = groups.setdefault(key, {"tags": tags, "rows": []})
+        g["rows"].append((ts, fields))
+    wb = WriteBatch()
+    for key, g in groups.items():
+        ts_list = [r[0] for r in g["rows"]]
+        fnames: dict[str, ValueType] = {}
+        for _, fs in g["rows"]:
+            for n, (vt, _v) in fs.items():
+                prev = fnames.setdefault(n, vt)
+                if prev != vt:
+                    raise ParserError(
+                        f"field {n!r} type conflict in bulk batch: "
+                        f"{prev.name} vs {vt.name}")
+        fields = {}
+        for n, vt in fnames.items():
+            fields[n] = (int(vt),
+                         [r[1].get(n, (None, None))[1] for r in g["rows"]])
+        sk = SeriesKey(table, [Tag(k, v) for k, v in g["tags"].items()])
+        wb.add_series(table, SeriesRows(sk, ts_list, fields))
+    return wb

@@ -35,13 +35,9 @@ def parse_opentsdb(text: str) -> WriteBatch:
             ts = int(ts_s)
         except ValueError:
             raise ParserError(f"opentsdb line {lineno}: bad timestamp {ts_s!r}")
-        # auto-scale: s (10 digits) or ms (13) → ns
-        if ts < 10**11:
-            ts *= 10**9
-        elif ts < 10**14:
-            ts *= 10**6
-        elif ts < 10**17:
-            ts *= 10**3
+        from ._time import normalize_ts_ns
+
+        ts = normalize_ts_ns(ts)
         try:
             val = float(val_s)
         except ValueError:

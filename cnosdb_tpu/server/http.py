@@ -41,6 +41,7 @@ class HttpServer:
             web.get("/api/v1/ping", self.handle_ping),
             web.post("/api/v1/opentsdb/write", self.handle_opentsdb_write),
             web.post("/api/v1/prom/write", self.handle_prom_write),
+            web.post("/api/v1/es/_bulk", self.handle_es_bulk),
             web.get("/metrics", self.handle_metrics),
             web.get("/debug/health", self.handle_ping),
         ])
@@ -156,6 +157,30 @@ class HttpServer:
             return _err_response(_status_for(e), e)
         self.metrics.incr("prom_write_points", batch.n_rows())
         return web.Response(status=204)
+
+    async def handle_es_bulk(self, request):
+        """ES-style log ingest (reference `_bulk` json_protocol API)."""
+        session = self._session(request)
+        table = request.query.get("table", "logs")
+        tag_keys = tuple(t for t in request.query.get("tags", "").split(",") if t)
+        from ..protocol.es_bulk import parse_es_bulk
+
+        body = await request.text()
+        try:
+            batch = parse_es_bulk(body, table, tag_keys)
+        except CnosError as e:
+            return _err_response(_status_for(e), e)
+        except Exception as e:
+            # valid-JSON-but-wrong-shape lines must be 4xx, not 500
+            return _err_response(400, ParserError(f"bad bulk body: {e}"))
+        try:
+            loop = asyncio.get_running_loop()
+            await loop.run_in_executor(
+                None, lambda: self.coord.write_points(
+                    session.tenant, session.database, batch))
+        except CnosError as e:
+            return _err_response(_status_for(e), e)
+        return web.json_response({"errors": False, "items": batch.n_rows()})
 
     async def handle_metrics(self, request):
         return web.Response(text=self.metrics.prometheus_text(),

@@ -431,6 +431,13 @@ class QueryExecutor:
         schema = self.meta.table(session.tenant, session.database, sel.table)
         plan = plan_select(sel, schema)
         lines = []
+        if stmt.analyze:
+            import time as _t
+
+            t0 = _t.perf_counter()
+            rs = self._select(sel, session)
+            elapsed = (_t.perf_counter() - t0) * 1e3
+            lines.append(f"Execution: {rs.n_rows} rows in {elapsed:.2f}ms")
         if isinstance(plan, AggregatePlan):
             lines.append("TpuAggregateExec")
             lines.append(f"  table={plan.table}")

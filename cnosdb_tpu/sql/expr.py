@@ -219,6 +219,52 @@ class IsNull(Expr):
 
 
 @dataclass(repr=False)
+class Like(Expr):
+    """SQL LIKE with % and _ wildcards (host-evaluated; string columns
+    never ride to the device anyway)."""
+
+    expr: Expr
+    pattern: str
+    negated: bool = False
+
+    def _regex(self):
+        rx = getattr(self, "_rx", None)
+        if rx is None:
+            import re as _re
+
+            out = []
+            for ch in self.pattern:
+                if ch == "%":
+                    out.append(".*")
+                elif ch == "_":
+                    out.append(".")
+                else:
+                    out.append(_re.escape(ch))
+            rx = _re.compile("^" + "".join(out) + "$", _re.DOTALL)
+            object.__setattr__(self, "_rx", rx)
+        return rx
+
+    def eval(self, env, xp):
+        v = self.expr.eval(env, xp)
+        rx = self._regex()
+        arr = np.asarray(v, dtype=object) if not np.isscalar(v) else None
+        if arr is None:
+            m = bool(rx.match(str(v)))
+            return (not m) if self.negated else m
+        out = np.fromiter(
+            (bool(rx.match(x)) if isinstance(x, str) else False for x in arr),
+            dtype=bool, count=len(arr))
+        return ~out if self.negated else out
+
+    def columns(self):
+        return self.expr.columns()
+
+    def to_sql(self):
+        neg = " NOT" if self.negated else ""
+        return f"({self.expr.to_sql()}{neg} LIKE {Literal(self.pattern).to_sql()})"
+
+
+@dataclass(repr=False)
 class Func(Expr):
     """Scalar function call evaluated row-wise (abs, floor, ceil, sqrt...)."""
 

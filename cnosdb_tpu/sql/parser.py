@@ -14,7 +14,7 @@ from datetime import datetime, timezone
 from ..errors import ParserError
 from . import ast
 from .expr import (
-    Between, BinOp, Column, Expr, Func, InList, IsNull, Literal, UnaryOp,
+    Between, BinOp, Column, Expr, Func, InList, IsNull, Like, Literal, UnaryOp,
 )
 
 # ---------------------------------------------------------------------------
@@ -632,6 +632,9 @@ class Parser:
                 negated = self.accept_kw("NOT")
                 self.expect_kw("NULL")
                 e = IsNull(e, negated)
+            elif self.kw() == "LIKE":
+                self.next()
+                e = Like(e, self.expect_string())
             elif self.kw() in ("IN", "NOT"):
                 negated = False
                 if self.kw() == "NOT":
@@ -645,6 +648,10 @@ class Parser:
                         self.expect_kw("AND")
                         hi = self.parse_additive()
                         e = Between(e, lo, hi, negated=True)
+                        continue
+                    elif self.kw() == "LIKE":
+                        self.next()
+                        e = Like(e, self.expect_string(), negated=True)
                         continue
                     else:
                         self.i = save

@@ -1,0 +1,95 @@
+"""LIKE, EXPLAIN ANALYZE, ES bulk, TSBS cpu-max-all-8 shape."""
+import json
+
+import numpy as np
+import pytest
+
+from cnosdb_tpu.parallel.coordinator import Coordinator
+from cnosdb_tpu.parallel.meta import MetaStore, DEFAULT_TENANT
+from cnosdb_tpu.protocol.es_bulk import parse_es_bulk
+from cnosdb_tpu.sql.executor import QueryExecutor, Session
+from cnosdb_tpu.storage.engine import TsKv
+
+
+@pytest.fixture
+def db(tmp_path):
+    meta = MetaStore(str(tmp_path / "meta.json"))
+    engine = TsKv(str(tmp_path / "data"))
+    coord = Coordinator(meta, engine)
+    ex = QueryExecutor(meta, coord)
+    yield ex
+    coord.close()
+
+
+def test_like(db):
+    db.execute_one("CREATE TABLE m (v DOUBLE, TAGS(host))")
+    db.execute_one("INSERT INTO m (time, host, v) VALUES "
+                   "(1, 'web-01', 1), (2, 'web-02', 2), (3, 'db-01', 3)")
+    rs = db.execute_one("SELECT host FROM m WHERE host LIKE 'web-%' ORDER BY host")
+    assert rs.columns[0].tolist() == ["web-01", "web-02"]
+    rs = db.execute_one("SELECT count(*) FROM m WHERE host NOT LIKE 'web%'")
+    assert rs.columns[0][0] == 1
+    rs = db.execute_one("SELECT host FROM m WHERE host LIKE '__-01' ORDER BY host")
+    assert rs.columns[0].tolist() == ["db-01"]  # exactly two leading chars
+    rs = db.execute_one("SELECT host FROM m WHERE host LIKE '%-01' ORDER BY host")
+    assert rs.columns[0].tolist() == ["db-01", "web-01"]
+
+
+def test_explain_analyze(db):
+    db.execute_one("CREATE TABLE m (v DOUBLE, TAGS(h))")
+    db.execute_one("INSERT INTO m (time, h, v) VALUES (1, 'a', 1), (2, 'a', 2)")
+    rs = db.execute_one("EXPLAIN ANALYZE SELECT count(*) FROM m")
+    text = "\n".join(rs.columns[0])
+    assert "Execution: 1 rows" in text
+    assert "TpuAggregateExec" in text
+
+
+def test_es_bulk_parse_and_ingest(db):
+    body = "\n".join([
+        json.dumps({"index": {}}),
+        json.dumps({"@timestamp": "2023-01-01T00:00:00Z", "service": "api",
+                    "level": "error", "latency": 12.5, "code": 500}),
+        json.dumps({"index": {}}),
+        json.dumps({"@timestamp": "2023-01-01T00:00:01Z", "service": "api",
+                    "level": "info", "latency": 3.25, "code": 200}),
+    ])
+    wb = parse_es_bulk(body, "logs", tag_keys=("service",))
+    db.coord.write_points(DEFAULT_TENANT, "public", wb)
+    rs = db.execute_one("SELECT count(*) AS c, max(latency) AS l FROM logs")
+    assert rs.rows()[0] == (2, 12.5)
+    rs = db.execute_one("SELECT level FROM logs WHERE code = 500")
+    assert rs.columns[0].tolist() == ["error"]
+
+
+def test_tsbs_cpu_max_all_8_shape(db):
+    """The cpu-max-all-8 headline: max of 8 fields by hour for 8 hosts."""
+    fields = [f"usage_{k}" for k in
+              ("user", "system", "idle", "nice", "iowait", "irq",
+               "softirq", "steal")]
+    db.execute_one("CREATE TABLE cpu (" + ", ".join(f"{f} DOUBLE" for f in fields)
+                   + ", TAGS(hostname))")
+    rows = []
+    rng = np.random.default_rng(7)
+    for h in range(8):
+        for i in range(120):  # 2 hours at 1m cadence
+            t = i * 60_000_000_000
+            vals = rng.integers(0, 100, 8)
+            rows.append(f"({t}, 'host_{h}', " + ", ".join(map(str, vals)) + ")")
+    db.execute_one(
+        "INSERT INTO cpu (time, hostname, " + ", ".join(fields) + ") VALUES "
+        + ", ".join(rows))
+    sql = ("SELECT date_bin(INTERVAL '1 hour', time) AS t, hostname, "
+           + ", ".join(f"max({f}) AS mx_{f}" for f in fields)
+           + " FROM cpu WHERE hostname IN ('host_0','host_1','host_2','host_3',"
+           "'host_4','host_5','host_6','host_7') GROUP BY t, hostname "
+           "ORDER BY hostname, t")
+    rs = db.execute_one(sql)
+    assert rs.n_rows == 16  # 8 hosts × 2 hours
+    assert len(rs.names) == 10
+    # oracle check for one cell
+    chk = db.execute_one(
+        "SELECT max(usage_user) FROM cpu WHERE hostname = 'host_3' "
+        "AND time < 3600000000000")
+    row3 = [i for i in range(16) if rs.columns[1][i] == "host_3"
+            and rs.columns[0][i] == 0][0]
+    assert rs.columns[2][row3] == chk.columns[0][0]
