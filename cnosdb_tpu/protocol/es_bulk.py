@@ -60,10 +60,11 @@ def parse_es_bulk(body: str, table: str = "logs",
                 tags[k] = str(v)
             elif isinstance(v, bool):
                 fields[k] = (ValueType.BOOLEAN, v)
-            elif isinstance(v, int):
-                fields[k] = (ValueType.INTEGER, v)
-            elif isinstance(v, float):
-                fields[k] = (ValueType.FLOAT, v)
+            elif isinstance(v, (int, float)):
+                # JSON has one number type; ES and the reference's
+                # json_protocol treat it as double — so do we (mixing 12
+                # and 12.5 in one stream must not conflict)
+                fields[k] = (ValueType.FLOAT, float(v))
             elif isinstance(v, str):
                 fields[k] = (ValueType.STRING, v)
             else:
@@ -71,10 +72,10 @@ def parse_es_bulk(body: str, table: str = "logs",
         key = tuple(sorted(tags.items()))
         g = groups.setdefault(key, {"tags": tags, "rows": []})
         g["rows"].append((ts, fields))
-    wb = WriteBatch()
-    for key, g in groups.items():
-        ts_list = [r[0] for r in g["rows"]]
-        fnames: dict[str, ValueType] = {}
+    # type-conflict check spans the WHOLE batch (not per series group): a
+    # column's type is global to the table
+    fnames: dict[str, ValueType] = {}
+    for g in groups.values():
         for _, fs in g["rows"]:
             for n, (vt, _v) in fs.items():
                 prev = fnames.setdefault(n, vt)
@@ -82,10 +83,14 @@ def parse_es_bulk(body: str, table: str = "logs",
                     raise ParserError(
                         f"field {n!r} type conflict in bulk batch: "
                         f"{prev.name} vs {vt.name}")
+    wb = WriteBatch()
+    for key, g in groups.items():
+        ts_list = [r[0] for r in g["rows"]]
         fields = {}
         for n, vt in fnames.items():
-            fields[n] = (int(vt),
-                         [r[1].get(n, (None, None))[1] for r in g["rows"]])
+            vals = [r[1].get(n, (None, None))[1] for r in g["rows"]]
+            if any(v is not None for v in vals):
+                fields[n] = (int(vt), vals)
         sk = SeriesKey(table, [Tag(k, v) for k, v in g["tags"].items()])
         wb.add_series(table, SeriesRows(sk, ts_list, fields))
     return wb

@@ -8,6 +8,7 @@ reference).
 from __future__ import annotations
 
 from ..errors import ParserError
+from ._time import normalize_ts_ns
 from ..models.points import SeriesRows, WriteBatch
 from ..models.schema import ValueType
 from ..models.series import SeriesKey, Tag
@@ -35,8 +36,6 @@ def parse_opentsdb(text: str) -> WriteBatch:
             ts = int(ts_s)
         except ValueError:
             raise ParserError(f"opentsdb line {lineno}: bad timestamp {ts_s!r}")
-        from ._time import normalize_ts_ns
-
         ts = normalize_ts_ns(ts)
         try:
             val = float(val_s)

@@ -179,7 +179,10 @@ class HttpServer:
                 None, lambda: self.coord.write_points(
                     session.tenant, session.database, batch))
         except CnosError as e:
+            self.metrics.incr("es_bulk_errors")
             return _err_response(_status_for(e), e)
+        self.metrics.incr("es_bulk_writes")
+        self.metrics.incr("es_bulk_points_written", batch.n_rows())
         return web.json_response({"errors": False, "items": batch.n_rows()})
 
     async def handle_metrics(self, request):

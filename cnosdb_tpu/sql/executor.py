@@ -428,14 +428,20 @@ class QueryExecutor:
         sel = stmt.inner
         if sel.table is None:
             return ResultSet.message("Projection (no table)")
-        schema = self.meta.table(session.tenant, session.database, sel.table)
+        schema = self.meta.table(session.tenant,
+                                 sel.database or session.database, sel.table)
         plan = plan_select(sel, schema)
         lines = []
         if stmt.analyze:
             import time as _t
 
+            db = sel.database or session.database
             t0 = _t.perf_counter()
-            rs = self._select(sel, session)
+            # execute the SAME plan object that gets printed below
+            if isinstance(plan, AggregatePlan):
+                rs = self._exec_aggregate(plan, session.tenant, db)
+            else:
+                rs = self._exec_raw(plan, session.tenant, db)
             elapsed = (_t.perf_counter() - t0) * 1e3
             lines.append(f"Execution: {rs.n_rows} rows in {elapsed:.2f}ms")
         if isinstance(plan, AggregatePlan):

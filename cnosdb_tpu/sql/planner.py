@@ -165,6 +165,7 @@ def _norm_cmp(e: BinOp):
 # SELECT planning
 # ---------------------------------------------------------------------------
 def plan_select(stmt: ast.SelectStmt, schema: TskvTableSchema):
+    _validate_columns(stmt, schema)
     time_trs, tag_domains, residual = split_where(stmt.where, schema)
 
     has_agg = any(_contains_agg(i.expr) for i in stmt.items
@@ -174,6 +175,42 @@ def plan_select(stmt: ast.SelectStmt, schema: TskvTableSchema):
     if not has_agg:
         raise PlanError("GROUP BY requires aggregate functions in SELECT")
     return _plan_aggregate(stmt, schema, time_trs, tag_domains, residual)
+
+
+def _validate_columns(stmt: ast.SelectStmt, schema: TskvTableSchema):
+    """Unknown columns error at plan time (a column absent from one vnode's
+    data is NULL, but a column absent from the schema is a user mistake)."""
+    known = {c.name for c in schema.columns} | {TIME_COL}
+    aliases = {it.alias for it in stmt.items if it.alias}
+
+    def check(e, allow_alias=False):
+        if isinstance(e, Column):
+            if e.name in known:
+                return
+            if allow_alias and e.name in aliases:
+                return
+            raise PlanError(f"unknown column {e.name!r} in table {schema.name!r}")
+        for attr in ("left", "right", "operand", "expr", "low", "high"):
+            sub = getattr(e, attr, None)
+            if isinstance(sub, Expr):
+                check(sub, allow_alias)
+        for a in getattr(e, "args", None) or []:
+            if isinstance(a, Expr):
+                check(a, allow_alias)
+
+    for it in stmt.items:
+        if isinstance(it.expr, Expr):
+            check(it.expr)
+    if stmt.where is not None:
+        check(stmt.where)
+    if stmt.having is not None:
+        check(stmt.having, allow_alias=True)
+    for g in stmt.group_by:
+        if isinstance(g, Expr):
+            check(g, allow_alias=True)
+    for oe, _asc in stmt.order_by:
+        if isinstance(oe, Expr):
+            check(oe, allow_alias=True)
 
 
 def _contains_agg(e) -> bool:

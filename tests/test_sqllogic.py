@@ -1,0 +1,62 @@
+"""Golden-file SQL logic test runner (reference sqllogicaltests analog)."""
+import os
+
+import pytest
+
+from cnosdb_tpu.parallel.coordinator import Coordinator
+from cnosdb_tpu.parallel.meta import MetaStore
+from cnosdb_tpu.sql.executor import QueryExecutor, Session
+from cnosdb_tpu.storage.engine import TsKv
+from cnosdb_tpu.server.http import format_csv
+
+CASES_DIR = os.path.join(os.path.dirname(__file__), "sqllogic")
+
+
+def _parse_slt(path):
+    blocks = []
+    with open(path) as f:
+        lines = f.read().splitlines()
+    i = 0
+    while i < len(lines):
+        line = lines[i].strip()
+        i += 1
+        if not line or line.startswith("#"):
+            continue
+        if line.startswith("statement ok "):
+            blocks.append(("ok", line[len("statement ok "):], None, i))
+        elif line.startswith("statement error "):
+            blocks.append(("error", line[len("statement error "):], None, i))
+        elif line.startswith("query "):
+            sql = line[len("query "):]
+            expected = []
+            while i < len(lines) and lines[i].strip() != "":
+                expected.append(lines[i].rstrip())
+                i += 1
+            blocks.append(("query", sql, expected, i))
+    return blocks
+
+
+@pytest.mark.parametrize(
+    "case", sorted(f for f in os.listdir(CASES_DIR) if f.endswith(".slt")))
+def test_sqllogic(case, tmp_path):
+    meta = MetaStore(str(tmp_path / "meta.json"))
+    engine = TsKv(str(tmp_path / "data"))
+    coord = Coordinator(meta, engine)
+    ex = QueryExecutor(meta, coord)
+    session = Session()
+    try:
+        for kind, sql, expected, lineno in _parse_slt(
+                os.path.join(CASES_DIR, case)):
+            if kind == "ok":
+                ex.execute_one(sql, session)
+            elif kind == "error":
+                with pytest.raises(Exception):
+                    ex.execute_one(sql, session)
+            else:
+                rs = ex.execute_one(sql, session)
+                got = format_csv(rs).strip().splitlines()
+                assert got == expected, (
+                    f"{case}:{lineno} for {sql!r}\n"
+                    f"expected: {expected}\n     got: {got}")
+    finally:
+        coord.close()
