@@ -45,6 +45,7 @@ class MetaStore:
         self.tables: dict[str, dict[str, TskvTableSchema]] = {}  # owner → {table}
         self.buckets: dict[str, list[BucketInfo]] = {}           # owner → buckets
         self.nodes: dict[int, NodeInfo] = {node_id: NodeInfo(node_id)}
+        self.streams: dict[str, dict] = {}  # stream name → definition
         self._next_bucket_id = 1
         self._next_replica_id = 1
         self._next_vnode_id = 1
@@ -74,6 +75,7 @@ class MetaStore:
                        for o, ts in self.tables.items()},
             "buckets": {o: [b.to_dict() for b in bs] for o, bs in self.buckets.items()},
             "nodes": {str(k): v.to_dict() for k, v in self.nodes.items()},
+            "streams": self.streams,
             "next_ids": [self._next_bucket_id, self._next_replica_id, self._next_vnode_id],
         }
 
@@ -99,6 +101,7 @@ class MetaStore:
         self.buckets = {o: [BucketInfo.from_dict(b) for b in bs]
                         for o, bs in d["buckets"].items()}
         self.nodes = {int(k): NodeInfo.from_dict(v) for k, v in d["nodes"].items()}
+        self.streams = d.get("streams", {})
         self._next_bucket_id, self._next_replica_id, self._next_vnode_id = d["next_ids"]
 
     def _notify(self, event: str, **kw):
@@ -255,6 +258,19 @@ class MetaStore:
 
     def list_tables(self, tenant: str, db: str) -> list[str]:
         return sorted(self.tables.get(f"{tenant}.{db}", {}).keys())
+
+    # ------------------------------------------------------------ streams
+    def create_stream(self, name: str, definition: dict):
+        with self.lock:
+            if name in self.streams:
+                raise MetaError(f"stream {name!r} exists")
+            self.streams[name] = definition
+            self._persist()
+
+    def drop_stream(self, name: str):
+        with self.lock:
+            if self.streams.pop(name, None) is not None:
+                self._persist()
 
     # ------------------------------------------------------------ placement
     def locate_bucket_for_write(self, tenant: str, db: str, ts: int) -> BucketInfo:

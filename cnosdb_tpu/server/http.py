@@ -294,6 +294,7 @@ def build_server(data_dir: str, auth_enabled: bool = False,
     engine.open_existing()
     coord = Coordinator(meta, engine)
     executor = QueryExecutor(meta, coord)
+    executor.restore_streams()  # persisted streams resume at their watermark
     return HttpServer(meta, coord, executor, auth_enabled=auth_enabled)
 
 

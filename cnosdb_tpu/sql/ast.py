@@ -161,6 +161,23 @@ class AlterUser:
 
 
 @dataclass
+class CreateStream:
+    name: str
+    target: str
+    select: "SelectStmt"
+    select_sql: str                 # raw text (persisted definition)
+    interval_s: float = 10.0
+    delay_ns: int = 0
+    if_not_exists: bool = False
+
+
+@dataclass
+class DropStream:
+    name: str
+    if_exists: bool = False
+
+
+@dataclass
 class CompactStmt:
     database: str | None = None
 

@@ -110,9 +110,13 @@ class QueryTracker:
 
 class QueryExecutor:
     def __init__(self, meta: MetaStore, coord: Coordinator):
+        import threading as _th
+
         self.meta = meta
         self.coord = coord
         self.tracker = QueryTracker()
+        self._stream_engine = None
+        self._stream_lock = _th.Lock()
 
     # ------------------------------------------------------------------ api
     def execute_sql(self, sql: str, session: Session | None = None) -> list[ResultSet]:
@@ -196,6 +200,15 @@ class QueryExecutor:
         if isinstance(stmt, ast.AlterUser):
             self.meta.alter_user(stmt.name, stmt.password)
             return ResultSet.message("ok")
+        if isinstance(stmt, ast.CreateStream):
+            return self._create_stream(stmt, session)
+        if isinstance(stmt, ast.DropStream):
+            se = self.stream_engine()
+            if stmt.name not in se.streams and not stmt.if_exists:
+                raise ExecutionError(f"unknown stream {stmt.name!r}")
+            se.drop(stmt.name)
+            self.meta.drop_stream(stmt.name)
+            return ResultSet.message("ok")
         if isinstance(stmt, ast.KillQuery):
             ok = self.tracker.kill(stmt.query_id)
             return ResultSet.message("ok" if ok else "no such query")
@@ -206,6 +219,64 @@ class QueryExecutor:
             self.coord.engine.flush_all()
             return ResultSet.message("ok")
         raise ExecutionError(f"unsupported statement {type(stmt).__name__}")
+
+    # ------------------------------------------------------------------ streams
+    def stream_engine(self):
+        if self._stream_engine is None:
+            with self._stream_lock:
+                if self._stream_engine is None:
+                    import os
+
+                    from .stream import StreamEngine
+
+                    self._stream_engine = StreamEngine(
+                        self, os.path.join(self.coord.engine.data_dir, "streams"))
+        return self._stream_engine
+
+    def _create_stream(self, stmt: ast.CreateStream, session: Session,
+                       persist: bool = True):
+        from .stream import StreamQuery
+
+        se = self.stream_engine()
+        if stmt.name in se.streams:
+            if stmt.if_not_exists:
+                return ResultSet.message("ok")
+            raise ExecutionError(f"stream {stmt.name!r} exists")
+        # validate the template NOW: missing tables/columns must fail the
+        # CREATE, not silently kill every future trigger
+        db = stmt.select.database or session.database
+        schema = self.meta.table(session.tenant, db, stmt.select.table)
+        plan_select(stmt.select, schema)
+        if persist:
+            self.meta.create_stream(stmt.name, {
+                "target": stmt.target, "select_sql": stmt.select_sql,
+                "interval_s": stmt.interval_s, "delay_ns": stmt.delay_ns,
+                "tenant": session.tenant, "database": session.database,
+                "user": session.user})
+        se.register(StreamQuery(
+            name=stmt.name, sql=stmt.select_sql, stmt=stmt.select,
+            interval_s=stmt.interval_s, delay_ns=stmt.delay_ns,
+            session=Session(session.tenant, session.database, session.user),
+            sink=("table", stmt.target)), start_ns=0)
+        return ResultSet.message("ok")
+
+    def restore_streams(self):
+        """Re-register persisted streams on boot (watermarks resume)."""
+        for name, d in list(self.meta.streams.items()):
+            try:
+                sel = parse_sql(d["select_sql"])[0]
+                stmt = ast.CreateStream(
+                    name, d["target"], sel, d["select_sql"],
+                    d.get("interval_s", 10.0), d.get("delay_ns", 0))
+                self._create_stream(
+                    stmt, Session(d.get("tenant", "cnosdb"),
+                                  d.get("database", "public"),
+                                  d.get("user", "root")), persist=False)
+            except Exception:
+                import logging
+
+                logging.getLogger("cnosdb.stream").exception(
+                    "failed to restore stream %s", name)
 
     # ------------------------------------------------------------------ DDL
     def _create_database(self, stmt: ast.CreateDatabase, session: Session):
@@ -313,6 +384,16 @@ class QueryExecutor:
                  np.array(texts, dtype=object),
                  np.array(users, dtype=object),
                  np.array(durs)])
+        if stmt.kind == "streams":
+            se = self.stream_engine()
+            names = sorted(se.streams)
+            return ResultSet(
+                ["stream_name", "target", "interval_s", "query"],
+                [np.array(names, dtype=object),
+                 np.array([se.streams[n].sink[1] if isinstance(se.streams[n].sink, tuple)
+                           else "<callback>" for n in names], dtype=object),
+                 np.array([se.streams[n].interval_s for n in names]),
+                 np.array([se.streams[n].sql[:120] for n in names], dtype=object)])
         raise ExecutionError(f"unsupported SHOW {stmt.kind}")
 
     def _describe(self, stmt: ast.DescribeStmt, session: Session):

@@ -134,6 +134,7 @@ def parse_timestamp_string(s: str) -> int:
 # ---------------------------------------------------------------------------
 class Parser:
     def __init__(self, sql: str):
+        self.sql = sql
         self.tokens = tokenize(sql)
         self.i = 0
 
@@ -369,6 +370,27 @@ class Parser:
                     break
             self.expect_op(")")
             return ast.CreateTable(name, fields, tags, ine)
+        if k == "STREAM":
+            self.next()
+            ine = self._if_not_exists()
+            name = self.expect_ident()
+            interval_s = 10.0
+            delay_ns = 0
+            if self.accept_kw("TRIGGER"):
+                self.expect_kw("INTERVAL")
+                interval_s = parse_interval_string(self.expect_string()) / 1e9
+            if self.accept_kw("WATERMARK"):
+                self.expect_kw("DELAY")
+                delay_ns = parse_interval_string(self.expect_string())
+            self.expect_kw("INTO")
+            target = self.expect_ident()
+            self.expect_kw("AS")
+            start_pos = self.peek().pos
+            select = self.parse_select()
+            end_pos = self.peek().pos
+            return ast.CreateStream(name, target, select,
+                                    self.sql[start_pos:end_pos].strip(),
+                                    interval_s, delay_ns, ine)
         if k == "TENANT":
             self.next()
             ine = self._if_not_exists()
@@ -425,6 +447,10 @@ class Parser:
             self.next()
             ie = self._if_exists()
             return ast.DropTable(self.expect_ident(), ie)
+        if k == "STREAM":
+            self.next()
+            ie = self._if_exists()
+            return ast.DropStream(self.expect_ident(), ie)
         if k == "TENANT":
             self.next()
             ie = self._if_exists()
@@ -535,6 +561,9 @@ class Parser:
         if k == "QUERIES":
             self.next()
             return ast.ShowStmt("queries")
+        if k == "STREAMS":
+            self.next()
+            return ast.ShowStmt("streams")
         raise ParserError(f"unsupported SHOW {k}")
 
     def parse_describe(self):

@@ -184,19 +184,11 @@ def _validate_columns(stmt: ast.SelectStmt, schema: TskvTableSchema):
     aliases = {it.alias for it in stmt.items if it.alias}
 
     def check(e, allow_alias=False):
-        if isinstance(e, Column):
-            if e.name in known:
-                return
-            if allow_alias and e.name in aliases:
-                return
-            raise PlanError(f"unknown column {e.name!r} in table {schema.name!r}")
-        for attr in ("left", "right", "operand", "expr", "low", "high"):
-            sub = getattr(e, attr, None)
-            if isinstance(sub, Expr):
-                check(sub, allow_alias)
-        for a in getattr(e, "args", None) or []:
-            if isinstance(a, Expr):
-                check(a, allow_alias)
+        allowed = known | aliases if allow_alias else known
+        unknown = e.columns() - allowed
+        if unknown:
+            raise PlanError(
+                f"unknown column {sorted(unknown)[0]!r} in table {schema.name!r}")
 
     for it in stmt.items:
         if isinstance(it.expr, Expr):

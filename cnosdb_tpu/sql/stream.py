@@ -24,11 +24,12 @@ from .executor import QueryExecutor, ResultSet, Session
 @dataclass
 class StreamQuery:
     name: str
-    sql: str                      # must contain $START/$END time placeholders
-    interval_s: float             # trigger cadence
+    sql: str = ""                 # text form with $START/$END placeholders
+    interval_s: float = 10.0      # trigger cadence
     delay_ns: int = 0             # watermark delay (late data allowance)
     session: Session = field(default_factory=Session)
     sink: object = None           # callable(ResultSet) | ("table", name)
+    stmt: object = None           # parsed SelectStmt template (SQL DDL path)
 
 
 class WatermarkTracker:
@@ -49,11 +50,31 @@ class WatermarkTracker:
 
     def set(self, name: str, value: int):
         self.watermarks[name] = value
+        self._persist()
+
+    def remove(self, name: str):
+        if self.watermarks.pop(name, None) is not None:
+            self._persist()
+
+    def _persist(self):
         tmp = self.path + ".tmp"
         os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
         with open(tmp, "w") as f:
             json.dump(self.watermarks, f)
         os.replace(tmp, self.path)
+
+
+def _window_stmt(stmt, start: int, end: int):
+    """Template SelectStmt → copy with WHERE ∧ start ≤ time < end."""
+    import dataclasses
+
+    from .expr import BinOp, Column, Literal
+
+    window = BinOp("and",
+                   BinOp(">=", Column("time"), Literal(int(start))),
+                   BinOp("<", Column("time"), Literal(int(end))))
+    where = window if stmt.where is None else BinOp("and", stmt.where, window)
+    return dataclasses.replace(stmt, where=where)
 
 
 class StreamEngine:
@@ -65,7 +86,7 @@ class StreamEngine:
         self._stop = threading.Event()
 
     def register(self, sq: StreamQuery, start_ns: int | None = None):
-        if "$START" not in sq.sql or "$END" not in sq.sql:
+        if sq.stmt is None and ("$START" not in sq.sql or "$END" not in sq.sql):
             raise QueryError("stream SQL must contain $START and $END placeholders")
         if sq.name in self.streams:
             # replace: stop the old trigger thread first, or two loops would
@@ -80,7 +101,7 @@ class StreamEngine:
         self._threads[sq.name] = (t, stop_evt)
         t.start()
 
-    def drop(self, name: str):
+    def drop(self, name: str, keep_watermark: bool = False):
         self.streams.pop(name, None)
         entry = self._threads.pop(name, None)
         if entry is not None:
@@ -88,6 +109,10 @@ class StreamEngine:
             stop_evt.set()
             if t is not threading.current_thread():
                 t.join(timeout=2)
+        if not keep_watermark:
+            # a re-created stream with the same name must start fresh, not
+            # resume from the dropped stream's watermark
+            self.tracker.remove(name)
 
     def stop(self):
         self._stop.set()
@@ -107,8 +132,12 @@ class StreamEngine:
         end = now - sq.delay_ns
         if end <= start:
             return None
-        sql = sq.sql.replace("$START", str(start)).replace("$END", str(end))
-        rs = self.executor.execute_one(sql, sq.session)
+        if sq.stmt is not None:
+            rs = self.executor.execute_statement(
+                _window_stmt(sq.stmt, start, end), sq.session)
+        else:
+            sql = sq.sql.replace("$START", str(start)).replace("$END", str(end))
+            rs = self.executor.execute_one(sql, sq.session)
         self._emit(sq, rs)
         self.tracker.set(name, end)
         return rs
@@ -168,11 +197,17 @@ class StreamEngine:
         self.executor.coord.write_points(session.tenant, session.database, wb)
 
     def _run_stream(self, sq: StreamQuery, stop_evt: threading.Event):
-        while not (self._stop.is_set() or stop_evt.is_set()):
+        import logging
+
+        # cadence-aligned: first trigger one interval after registration
+        # (also keeps manual triggering in tests deterministic)
+        while not stop_evt.wait(sq.interval_s) and not self._stop.is_set():
             if self.streams.get(sq.name) is not sq:
                 return
             try:
                 self.trigger_once(sq.name)
             except Exception:
-                pass  # transient errors must not kill the trigger loop
-            stop_evt.wait(sq.interval_s)
+                # transient errors must not kill the trigger loop, but they
+                # must be visible
+                logging.getLogger("cnosdb.stream").exception(
+                    "stream %s trigger failed", sq.name)
