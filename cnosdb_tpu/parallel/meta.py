@@ -16,8 +16,11 @@ covering t (auto-created, duration = db.vnode_duration), within it in shard
 """
 from __future__ import annotations
 
+import hashlib
+import hmac
 import json
 import os
+import secrets
 import threading
 
 from ..errors import (
@@ -34,6 +37,28 @@ DEFAULT_DATABASE = "public"
 USAGE_SCHEMA = "usage_schema"
 
 
+def hash_password(pw: str) -> str:
+    """Salted PBKDF2 — passwords are never persisted in the clear
+    (reference stores a hash too: common/models/src/auth/user.rs)."""
+    salt = secrets.token_hex(8)
+    h = hashlib.pbkdf2_hmac("sha256", pw.encode(), bytes.fromhex(salt), 50_000)
+    return f"pbkdf2${salt}${h.hex()}"
+
+
+def verify_password(stored: str, candidate: str) -> bool:
+    """Constant-time verification against the stored hash (or a legacy
+    plaintext value from a pre-hashing meta.json)."""
+    parts = stored.split("$")
+    if len(parts) == 3 and parts[0] == "pbkdf2":
+        cand = hashlib.pbkdf2_hmac(
+            "sha256", candidate.encode(), bytes.fromhex(parts[1]), 50_000).hex()
+        return hmac.compare_digest(cand, parts[2])
+    return hmac.compare_digest(stored, candidate)
+
+
+_DUMMY_HASH = hash_password("!nonexistent!")
+
+
 class MetaStore:
     def __init__(self, path: str | None = None, node_id: int = 1):
         self.path = path
@@ -46,6 +71,11 @@ class MetaStore:
         self.buckets: dict[str, list[BucketInfo]] = {}           # owner → buckets
         self.nodes: dict[int, NodeInfo] = {node_id: NodeInfo(node_id)}
         self.streams: dict[str, dict] = {}  # stream name → definition
+        self.members: dict[str, dict[str, str]] = {}  # tenant → {user → role}
+        self.roles: dict[str, dict[str, dict]] = {}   # tenant → {role → spec}
+        # verified-credential cache; keys bind (user, stored-hash, password)
+        # so password changes and drops invalidate naturally
+        self._auth_cache: set = set()
         self._next_bucket_id = 1
         self._next_replica_id = 1
         self._next_vnode_id = 1
@@ -59,7 +89,8 @@ class MetaStore:
     # ------------------------------------------------------------ durability
     def _bootstrap(self):
         self.tenants[DEFAULT_TENANT] = TenantOptions(comment="system tenant")
-        self.users["root"] = {"password": "", "admin": True, "comment": "system admin"}
+        self.users["root"] = {"password": hash_password(""), "admin": True,
+                              "comment": "system admin"}
         for db in (DEFAULT_DATABASE, USAGE_SCHEMA):
             schema = DatabaseSchema(DEFAULT_TENANT, db, DatabaseOptions())
             self.databases[schema.owner] = schema
@@ -76,6 +107,8 @@ class MetaStore:
             "buckets": {o: [b.to_dict() for b in bs] for o, bs in self.buckets.items()},
             "nodes": {str(k): v.to_dict() for k, v in self.nodes.items()},
             "streams": self.streams,
+            "members": self.members,
+            "roles": self.roles,
             "next_ids": [self._next_bucket_id, self._next_replica_id, self._next_vnode_id],
         }
 
@@ -102,6 +135,8 @@ class MetaStore:
                         for o, bs in d["buckets"].items()}
         self.nodes = {int(k): NodeInfo.from_dict(v) for k, v in d["nodes"].items()}
         self.streams = d.get("streams", {})
+        self.members = d.get("members", {})
+        self.roles = d.get("roles", {})
         self._next_bucket_id, self._next_replica_id, self._next_vnode_id = d["next_ids"]
 
     def _notify(self, event: str, **kw):
@@ -130,6 +165,8 @@ class MetaStore:
             if name == DEFAULT_TENANT:
                 raise MetaError("cannot drop system tenant")
             self.tenants.pop(name, None)
+            self.members.pop(name, None)
+            self.roles.pop(name, None)
             dropped = [o for o in self.databases if o.startswith(name + ".")]
             for owner in dropped:
                 self.databases.pop(owner, None)
@@ -146,7 +183,8 @@ class MetaStore:
         with self.lock:
             if name in self.users:
                 raise MetaError(f"user {name!r} exists")
-            self.users[name] = {"password": password, "admin": admin, "comment": comment}
+            self.users[name] = {"password": hash_password(password),
+                                "admin": admin, "comment": comment}
             self._persist()
 
     def drop_user(self, name: str):
@@ -154,6 +192,8 @@ class MetaStore:
             if name == "root":
                 raise MetaError("cannot drop root")
             self.users.pop(name, None)
+            for members in self.members.values():
+                members.pop(name, None)
             self._persist()
 
     def alter_user(self, name: str, password: str | None = None):
@@ -161,8 +201,63 @@ class MetaStore:
             if name not in self.users:
                 raise MetaError(f"user {name!r} missing")
             if password is not None:
-                self.users[name]["password"] = password
+                self.users[name]["password"] = hash_password(password)
             self._persist()
+
+    def check_user(self, name: str, password: str) -> dict | None:
+        """Authenticate; returns the user record or None. Unknown users pay
+        exactly one PBKDF2 (precomputed dummy hash), like wrong passwords,
+        so response timing does not enumerate usernames. Verified
+        credentials are cached (invalidated on alter/drop) so steady-state
+        auth costs one SHA-256 digest compare, not 50k PBKDF2 rounds."""
+        with self.lock:
+            u = self.users.get(name)
+            stored = u["password"] if u else _DUMMY_HASH
+        cache_key = (name, hashlib.sha256((stored + "\x00" + password).encode()).hexdigest())
+        with self.lock:
+            if cache_key in self._auth_cache:
+                return u
+        ok = verify_password(stored, password)
+        if u is not None and ok:
+            with self.lock:
+                if len(self._auth_cache) > 1024:
+                    self._auth_cache.clear()
+                self._auth_cache.add(cache_key)
+            return u
+        return None
+
+    # ------------------------------------------------------------ membership
+    def add_member(self, tenant: str, user: str, role: str = "member"):
+        with self.lock:
+            if tenant not in self.tenants:
+                raise TenantNotFound(tenant)
+            if user not in self.users:
+                raise MetaError(f"user {user!r} missing")
+            self.members.setdefault(tenant, {})[user] = role
+            self._persist()
+
+    def remove_member(self, tenant: str, user: str):
+        with self.lock:
+            self.members.get(tenant, {}).pop(user, None)
+            self._persist()
+
+    def member_role(self, tenant: str, user: str) -> str | None:
+        with self.lock:
+            return self.members.get(tenant, {}).get(user)
+
+    def user_can_access(self, user: str, tenant: str) -> bool:
+        """Tenant authorization: admins everywhere; everyone may use the
+        system tenant; otherwise must be a member (reference
+        meta_tenant member model, common/models/src/auth/role.rs)."""
+        with self.lock:
+            u = self.users.get(user)
+            if u is None:
+                return False
+            if u.get("admin"):
+                return True
+            if tenant == DEFAULT_TENANT:
+                return True
+            return user in self.members.get(tenant, {})
 
     # ------------------------------------------------------------ databases
     def create_database(self, schema: DatabaseSchema, if_not_exists: bool = False):

@@ -285,6 +285,10 @@ class RaftNode:
         self.match_index: dict[int, int] = {}
         self.alive = True
         self.lock = threading.RLock()
+        # serializes sm.apply vs sm.snapshot so a shipped snapshot is
+        # consistent with the applied index it claims (ordering: self.lock
+        # may be held when taking _sm_lock, never the reverse)
+        self._sm_lock = threading.Lock()
         self._last_heartbeat = time.monotonic()
         self._election_deadline = self._new_deadline()
         self._stop = threading.Event()
@@ -457,18 +461,37 @@ class RaftNode:
         self._step_down(reply["term"])
 
     def _send_snapshot(self, peer: int):
-        data = self.sm.snapshot()
-        last_idx = self.log.last_index()
-        last_term = self.log.term_at(last_idx)
+        # Capture (snapshot, applied index) consistently WITHOUT holding
+        # _sm_lock across serialization: appliers hold self.lock while
+        # waiting on _sm_lock, so a long-held _sm_lock would transitively
+        # stall heartbeats and trigger elections. Optimistic scheme: the
+        # brief _sm_lock acquisitions mean no apply is mid-flight at either
+        # index read; equal indices bracket an untorn serialization.
+        for attempt in range(10):
+            with self._sm_lock:
+                a0 = self.last_applied
+            try:
+                data = self.sm.snapshot()
+            except RuntimeError:  # state mutated during iteration
+                continue
+            with self._sm_lock:
+                applied_idx = self.last_applied
+            if applied_idx == a0:
+                break
+        else:
+            # heavy churn: take the lock as a last resort for a bounded time
+            with self._sm_lock:
+                data = self.sm.snapshot()
+                applied_idx = self.last_applied
         msg = {"type": "install_snapshot", "from": self.node_id,
                "term": self.term, "data": data,
-               "last_index": self.commit_index,
-               "last_term": self.log.term_at(self.commit_index)}
+               "last_index": applied_idx,
+               "last_term": self.log.term_at(applied_idx)}
         reply = self.transport.send(self.group_id, peer, msg)
         if reply and reply.get("success"):
             with self.lock:
-                self.next_index[peer] = self.commit_index + 1
-                self.match_index[peer] = self.commit_index
+                self.next_index[peer] = applied_idx + 1
+                self.match_index[peer] = applied_idx
 
     def _advance_commit(self):
         with self.lock:
@@ -489,9 +512,10 @@ class RaftNode:
             e = self.log.entry_at(self.last_applied + 1)
             if e is None:
                 break
-            if e.entry_type != RAFT_BLANK:
-                self.sm.apply(e)
-            self.last_applied += 1
+            with self._sm_lock:
+                if e.entry_type != RAFT_BLANK:
+                    self.sm.apply(e)
+                self.last_applied += 1
         with self._apply_cv:
             self._apply_cv.notify_all()
 
@@ -561,13 +585,14 @@ class RaftNode:
                 self._step_down(msg["term"])
             self.leader_id = msg["from"]
             self._election_deadline = self._new_deadline()
-            self.sm.install_snapshot(msg["data"], msg["last_index"],
-                                     msg["last_term"])
-            self.log.truncate_from(1)
-            self.log.append(LogEntry(msg["last_term"], msg["last_index"],
-                                     RAFT_BLANK, b""))
-            self.commit_index = msg["last_index"]
-            self.last_applied = msg["last_index"]
+            with self._sm_lock:
+                self.sm.install_snapshot(msg["data"], msg["last_index"],
+                                         msg["last_term"])
+                self.log.truncate_from(1)
+                self.log.append(LogEntry(msg["last_term"], msg["last_index"],
+                                         RAFT_BLANK, b""))
+                self.commit_index = msg["last_index"]
+                self.last_applied = msg["last_index"]
             return {"term": self.term, "success": True}
 
     # ------------------------------------------------------------ info

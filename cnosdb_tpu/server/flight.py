@@ -57,8 +57,7 @@ if FLIGHT_AVAILABLE:
                 user, _, pw = base64.b64decode(auth[6:]).decode().partition(":")
             except Exception:
                 raise fl.FlightUnauthenticatedError("bad authorization")
-            u = self.server.meta.users.get(user)
-            if u is None or u.get("password", "") != pw:
+            if self.server.meta.check_user(user, pw) is None:
                 raise fl.FlightUnauthenticatedError("invalid credentials")
             return None
 

@@ -60,10 +60,12 @@ class HttpServer:
         elif self.auth_enabled:
             raise web.HTTPUnauthorized(text="authorization required")
         if self.auth_enabled:
-            u = self.meta.users.get(user)
-            if u is None or u.get("password", "") != password:
+            if self.meta.check_user(user, password) is None:
                 raise web.HTTPUnauthorized(text="invalid user or password")
         tenant = request.query.get("tenant", DEFAULT_TENANT)
+        if self.auth_enabled and not self.meta.user_can_access(user, tenant):
+            raise web.HTTPForbidden(
+                text=f"user {user!r} is not a member of tenant {tenant!r}")
         return user, tenant
 
     def _session(self, request) -> Session:
@@ -127,7 +129,10 @@ class HttpServer:
 
         try:
             batch = parse_opentsdb(body)
-            self.coord.write_points(session.tenant, session.database, batch)
+            loop = asyncio.get_running_loop()
+            await loop.run_in_executor(
+                None, lambda: self.coord.write_points(
+                    session.tenant, session.database, batch))
         except CnosError as e:
             return _err_response(_status_for(e), e)
         return web.Response(status=200)

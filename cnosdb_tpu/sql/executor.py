@@ -1173,13 +1173,17 @@ def _order_limit(rs: ResultSet, order_by, limit, offset, env) -> ResultSet:
 
 
 def _mixed_order(order_by, env, n):
-    """Mixed asc/desc: stable sort from last key to first."""
-    idx = np.arange(n)
+    """Mixed asc/desc via one lexsort over rank-inverted keys.
+
+    Reversing a stable ascending argsort would reverse ties and break
+    lower-priority keys; instead descending keys become negated dense
+    ranks (np.unique inverse), which lexsort ascends over correctly."""
+    keys = []
     for oe, asc in reversed(order_by):
         v = oe.eval(env, np) if isinstance(oe, Expr) else env[oe]
-        v = np.asarray(v)[idx]
-        order = np.argsort(v, kind="stable")
+        v = np.asarray(v)
         if not asc:
-            order = order[::-1]
-        idx = idx[order]
-    return idx
+            _, inv = np.unique(v, return_inverse=True)
+            v = -inv.astype(np.int64)
+        keys.append(v)
+    return np.lexsort(keys)

@@ -72,7 +72,36 @@ class Wal:
             if entries:
                 self._min_seq = entries[0].seq
                 self._next_seq = entries[-1].seq + 1
+        # Sequences must never restart below a previously handed-out seq even
+        # when every segment holding them has been purged (roll + purge_to can
+        # leave only an empty active segment). A durable tail marker records
+        # the high-water next_seq; on open we take the max of replayed tail
+        # and marker so post-restart appends stay above the flushed watermark.
+        marker = self._read_tail_marker()
+        if marker > self._next_seq:
+            self._next_seq = marker
+            self._min_seq = max(self._min_seq, marker)
         self._open_writer()
+
+    # -- tail marker ------------------------------------------------------
+    @property
+    def _tail_path(self) -> str:
+        return os.path.join(self.dir, "wal.tail")
+
+    def _read_tail_marker(self) -> int:
+        try:
+            with open(self._tail_path, "rb") as f:
+                return struct.unpack("<Q", f.read(8))[0]
+        except Exception:
+            return 1
+
+    def _persist_tail_marker(self):
+        tmp = self._tail_path + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(struct.pack("<Q", self._next_seq))
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self._tail_path)
 
     # -- segments --------------------------------------------------------
     def _list_segments(self) -> list[int]:
@@ -93,6 +122,7 @@ class Wal:
 
     def _roll(self):
         self._writer.close()
+        self._persist_tail_marker()
         self._segments.append(self._segments[-1] + 1)
         self._writer = RecordWriter(self._seg_path(self._segments[-1]))
 
@@ -156,12 +186,15 @@ class Wal:
         if seq < self._min_seq:
             self._min_seq = seq
         self._next_seq = seq
+        if self._read_tail_marker() > seq:
+            self._persist_tail_marker()
 
     # -- GC --------------------------------------------------------------
     def purge_to(self, seq: int):
         """Drop whole segments whose entries are all < seq (post-flush GC,
         reference SnapshotPolicy purge multi_raft.rs:107-138)."""
         self._min_seq = max(self._min_seq, seq)
+        self._persist_tail_marker()
         segs = self._list_segments()
         # Delete only segments provably below the watermark; unreadable
         # segments and everything after them are kept (log order matters),
@@ -189,3 +222,4 @@ class Wal:
         if self._writer:
             self._writer.close()
             self._writer = None
+            self._persist_tail_marker()

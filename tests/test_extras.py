@@ -93,3 +93,19 @@ def test_tsbs_cpu_max_all_8_shape(db):
     row3 = [i for i in range(16) if rs.columns[1][i] == "host_3"
             and rs.columns[0][i] == 0][0]
     assert rs.columns[2][row3] == chk.columns[0][0]
+
+
+def test_order_by_mixed_desc_asc_ties(db):
+    """Regression: ORDER BY a DESC, b ASC must keep b ascending within
+    equal a groups (reversing a stable argsort broke this)."""
+    db.execute_one("CREATE TABLE mo (a BIGINT, b BIGINT, TAGS(t))")
+    rows = [(i + 1, a, b) for i, (a, b) in enumerate(
+        [(1, 3), (2, 1), (1, 1), (2, 3), (1, 2), (2, 2)])]
+    vals = ", ".join(f"({t}, 'x', {a}, {b})" for t, a, b in rows)
+    db.execute_one(f"INSERT INTO mo (time, t, a, b) VALUES {vals}")
+    rs = db.execute_one("SELECT a, b FROM mo ORDER BY a DESC, b ASC")
+    got = list(zip(rs.columns[0].tolist(), rs.columns[1].tolist()))
+    assert got == [(2, 1), (2, 2), (2, 3), (1, 1), (1, 2), (1, 3)]
+    rs = db.execute_one("SELECT a, b FROM mo ORDER BY a ASC, b DESC")
+    got = list(zip(rs.columns[0].tolist(), rs.columns[1].tolist()))
+    assert got == [(1, 3), (1, 2), (1, 1), (2, 3), (2, 2), (2, 1)]

@@ -206,3 +206,35 @@ def test_bucket_vnode_for():
     k = SeriesKey("cpu", {"host": "h7"})
     chosen = b.vnode_for(k.hash_id())
     assert chosen is rs[k.hash_id() % 4]
+
+
+def test_password_hash_roundtrip():
+    from cnosdb_tpu.parallel.meta import hash_password, verify_password
+    h = hash_password("s3cret")
+    assert "s3cret" not in h
+    assert verify_password(h, "s3cret")
+    assert not verify_password(h, "wrong")
+    # legacy plaintext values still verify (constant-time)
+    assert verify_password("plain", "plain")
+    assert not verify_password("plain", "nope")
+
+
+def test_meta_tenant_membership(tmp_path):
+    from cnosdb_tpu.parallel.meta import MetaStore
+    m = MetaStore(str(tmp_path / "meta.json"))
+    m.create_user("alice", "pw")
+    m.create_tenant("acme")
+    assert m.check_user("alice", "pw") is not None
+    assert m.check_user("alice", "bad") is None
+    assert m.check_user("ghost", "pw") is None
+    # non-member cannot reach a private tenant; default tenant is open
+    assert not m.user_can_access("alice", "acme")
+    assert m.user_can_access("alice", "cnosdb")
+    m.add_member("acme", "alice", "member")
+    assert m.user_can_access("alice", "acme")
+    # persisted across reopen
+    m2 = MetaStore(str(tmp_path / "meta.json"))
+    assert m2.user_can_access("alice", "acme")
+    assert m2.check_user("alice", "pw") is not None
+    m.remove_member("acme", "alice")
+    assert not m.user_can_access("alice", "acme")

@@ -114,3 +114,24 @@ def test_wal_raft_truncate_conflict(tmp_path):
     assert [e.seq for e in entries] == [1, 2, 3, 4, 5, 6]
     assert entries[4].data == b"new5"
     w2.close()
+
+
+def test_wal_seq_survives_purge_all_and_restart(tmp_path):
+    """Regression: roll + purge leaving only an empty active segment must
+    not reset seqs below a previously handed-out watermark after restart
+    (would silently drop post-restart writes in crash recovery)."""
+    d = str(tmp_path / "wal")
+    w = Wal(d, max_segment_size=256)
+    for i in range(100):
+        w.append(WalEntryType.WRITE, b"x" * 32)
+    # force roll so active segment is empty, then purge everything flushed
+    w._roll()
+    w.purge_to(101)
+    w.close()
+    w2 = Wal(d, max_segment_size=256)
+    assert w2.next_seq >= 101
+    s = w2.append(WalEntryType.WRITE, b"after-restart")
+    assert s >= 101
+    # replay-from-flushed must see the new write
+    assert [e.data for e in w2.replay(from_seq=101)] == [b"after-restart"]
+    w2.close()
