@@ -118,6 +118,14 @@ class TimeRanges:
     def __repr__(self) -> str:
         return f"TimeRanges({self.ranges!r})"
 
+    def to_wire(self) -> list:
+        """msgpack-safe form for the cross-process scan plane."""
+        return [[r.min_ts, r.max_ts] for r in self.ranges]
+
+    @classmethod
+    def from_wire(cls, w: list) -> "TimeRanges":
+        return cls([TimeRange(a, b) for a, b in w])
+
 
 # ---------------------------------------------------------------------------
 # Value domains
@@ -370,7 +378,42 @@ class ColumnDomains:
             out.domains[col] = self.domains[col].union(other.domains[col])
         return out
 
+    def to_wire(self) -> dict:
+        return {"none": self._none,
+                "cols": {c: domain_to_wire(d) for c, d in self.domains.items()}}
+
+    @classmethod
+    def from_wire(cls, w: dict) -> "ColumnDomains":
+        return cls({c: domain_from_wire(d) for c, d in w["cols"].items()},
+                   none=w["none"])
+
     def __repr__(self):
         if self.is_none:
             return "ColumnDomains(NONE)"
         return f"ColumnDomains({self.domains!r})"
+
+
+def domain_to_wire(d: Domain) -> list:
+    """msgpack-safe tagged form mirroring the reference's domain protobufs."""
+    if isinstance(d, AllDomain):
+        return ["all"]
+    if isinstance(d, NoneDomain):
+        return ["none"]
+    if isinstance(d, RangeDomain):
+        return ["range", [[r.low, r.low_inclusive, r.high, r.high_inclusive]
+                          for r in d.ranges]]
+    if isinstance(d, SetDomain):
+        return ["set", sorted(d.values)]
+    raise TypeError(f"unknown domain {type(d).__name__}")
+
+
+def domain_from_wire(w: list) -> Domain:
+    tag = w[0]
+    if tag == "all":
+        return AllDomain()
+    if tag == "none":
+        return NoneDomain()
+    if tag == "range":
+        return RangeDomain([ValueRange(lo, li, hi, hic)
+                            for lo, li, hi, hic in w[1]])
+    return SetDomain(w[1])

@@ -4,15 +4,21 @@ Role-parity with the reference's Coordinator trait / CoordService
 (coordinator/src/lib.rs:56-140, service.rs:548-834): write_points splits a
 WriteBatch per (bucket by timestamp → shard by series hash) placement from
 meta, and table_vnodes enumerates the vnodes a predicate's time ranges
-touch. In this single-process round every placed vnode is local; the
-seams where gRPC fan-out goes later are `_write_vnode` / `scan_table`.
+touch. Vnodes placed on other nodes are reached over the msgpack-HTTP RPC
+plane: writes forward to the replica leader's node with retry-on-leader-
+change (reference tskv_executor.rs TskvLeaderExecutor + rpc/tskv.rs
+RaftWrite), scans stream back as Arrow IPC (reference QueryRecordBatch),
+and a scan that fails on the leader's node fails over to follower replicas
+(reference reader/mod.rs:36 CheckedCoordinatorRecordBatchStream).
 """
 from __future__ import annotations
 
-from dataclasses import dataclass
+import time
+from dataclasses import dataclass, field
 
 import numpy as np
 
+from ..errors import CoordinatorError
 from ..models.points import SeriesRows, WriteBatch
 from ..models.predicate import ColumnDomains, TimeRanges
 from ..models.schema import TskvTableSchema, ValueType
@@ -31,14 +37,21 @@ class PlacedSplit:
     table: str
     time_ranges: TimeRanges
     tag_domains: ColumnDomains
+    node_id: int = 0
+    # failover candidates: other replicas as (vnode_id, node_id)
+    alternates: list = field(default_factory=list)
 
 
 class Coordinator:
     SCAN_CACHE_SIZE = 32
 
-    def __init__(self, meta: MetaStore, engine: TsKv):
+    def __init__(self, meta, engine: TsKv, node_id: int | None = None):
         self.meta = meta
         self.engine = engine
+        # distributed iff the catalog is a remote MetaClient: placement may
+        # then name vnodes on other nodes, reached over RPC
+        self.distributed = not isinstance(meta, MetaStore)
+        self.node_id = node_id if node_id is not None else meta.node_id
         self._replica_mgr = None  # built on first multi-replica write
         # ScanBatch snapshots keyed by vnode data_version: repeated queries
         # reuse both the host batch and its device-resident twin (the
@@ -47,6 +60,14 @@ class Coordinator:
         self._scan_cache: dict = {}
         # schema auto-creation callbacks land on meta; keep engine's view hot
         meta.watch(self._on_meta_event)
+
+    def _rpc(self, node_id: int, method: str, payload: dict):
+        from .net import RpcUnavailable, rpc_call
+
+        addr = self.meta.node_addr(node_id)
+        if not addr:
+            raise RpcUnavailable(f"node {node_id} has no address")
+        return rpc_call(addr, method, payload)
 
     def _on_meta_event(self, event: str, payload: dict):
         if event in ("create_table", "update_table"):
@@ -110,21 +131,80 @@ class Coordinator:
 
     def _write_replica_set(self, owner: str, rs, batch: WriteBatch,
                            sync: bool):
-        """Single-replica sets write the engine directly; replicated sets go
-        through raft consensus (reference service.rs write_replica_by_raft)."""
-        if len(rs.vnodes) <= 1:
-            self.engine.write(owner, rs.leader_vnode_id, batch, sync=sync)
-            return
+        """Single-replica sets write the engine directly (locally or on the
+        owning node); replicated sets go through raft consensus on the
+        leader (reference service.rs write_replica_by_raft)."""
         from ..storage.wal import WalEntryType
 
-        self.replica_manager().write(owner, rs, WalEntryType.WRITE,
-                                     batch.encode(), sync=sync)
+        if len(rs.vnodes) <= 1:
+            target = rs.vnodes[0].node_id if rs.vnodes else self.node_id
+            if not self.distributed or target == self.node_id:
+                self.engine.write(owner, rs.leader_vnode_id, batch, sync=sync)
+            else:
+                self._rpc(target, "write_vnode",
+                          {"owner": owner, "vnode_id": rs.leader_vnode_id,
+                           "data": batch.encode(), "sync": sync})
+            return
+        data = batch.encode()
+        if not self.distributed:
+            self.replica_manager().write(owner, rs, WalEntryType.WRITE,
+                                         data, sync=sync)
+            return
+        self._write_replicated(owner, rs, WalEntryType.WRITE, data, sync)
+
+    def _write_replicated(self, owner: str, rs, entry_type: int, data: bytes,
+                          sync: bool, timeout: float = 15.0):
+        """Find the raft leader across nodes, retrying on leader change /
+        node loss (reference TskvLeaderExecutor::do_request retry loop)."""
+        from .net import RpcError, RpcUnavailable
+        from .raft import NotLeader
+
+        deadline = time.monotonic() + timeout
+        hint_vnode: int | None = None
+        last_err = None
+        has_local = any(v.node_id == self.node_id for v in rs.vnodes)
+        while time.monotonic() < deadline:
+            # 1. a local member may be (or become) the leader
+            if has_local:
+                try:
+                    return self.replica_manager().propose_local(
+                        owner, rs, entry_type, data, sync=sync)
+                except NotLeader as e:
+                    hint_vnode = e.args[0] if e.args else None
+                    last_err = e
+            # 2. forward to the hinted leader's node, then every other node
+            order = []
+            if hint_vnode is not None:
+                v = rs.vnode(hint_vnode)
+                if v is not None and v.node_id != self.node_id:
+                    order.append(v.node_id)
+            order += [v.node_id for v in rs.vnodes
+                      if v.node_id != self.node_id and v.node_id not in order]
+            for nid in order:
+                try:
+                    r = self._rpc(nid, "write_replica",
+                                  {"owner": owner, "rs": rs.to_dict(),
+                                   "entry_type": entry_type, "data": data,
+                                   "sync": sync})
+                except (RpcUnavailable, RpcError) as e:
+                    last_err = e
+                    continue
+                if r.get("ok"):
+                    return r.get("index")
+                hint_vnode = r.get("hint")
+            time.sleep(0.1)
+        raise CoordinatorError(
+            f"no reachable leader for replica set {rs.id} of {owner}"
+        ) from last_err
 
     def replica_manager(self):
         if self._replica_mgr is None:
             from .replica import ReplicaGroupManager
 
-            self._replica_mgr = ReplicaGroupManager(self.engine)
+            self._replica_mgr = ReplicaGroupManager(
+                self.engine,
+                node_id=self.node_id if self.distributed else None,
+                meta=self.meta if self.distributed else None)
         return self._replica_mgr
 
     def close(self):
@@ -185,11 +265,18 @@ class Coordinator:
                     live = self._replica_mgr.current_leader_vnode(owner, rs)
                     if live is not None:
                         vnode_id = live
+                # route to the chosen vnode's placement node
+                v = rs.vnode(vnode_id)
+                node_id = v.node_id if v is not None \
+                    else (rs.leader_node_id or self.node_id)
                 if vnode_id in seen:
                     continue
                 seen.add(vnode_id)
+                alts = [(a.id, a.node_id) for a in rs.vnodes
+                        if a.id != vnode_id]
                 splits.append(PlacedSplit(owner, vnode_id, table,
-                                          time_ranges, tag_domains))
+                                          time_ranges, tag_domains,
+                                          node_id=node_id, alternates=alts))
         return splits
 
     def scan_table(self, tenant: str, db: str, table: str,
@@ -201,36 +288,77 @@ class Coordinator:
         doms = tag_domains or ColumnDomains.all()
         batches = []
         for split in self.table_vnodes(tenant, db, table, trs, doms):
-            v = self.engine.vnode(split.owner, split.vnode_id)
-            if v is None:
-                continue
-            sids = None
-            if not doms.is_all:
-                sids = v.index.get_series_ids_by_domains(table, doms)
-                if len(sids) == 0:
-                    continue
-            import hashlib
-
-            sids_key = (hashlib.md5(np.ascontiguousarray(sids).tobytes())
-                        .hexdigest() if sids is not None else None)
-            key = (split.owner, split.vnode_id, table,
-                   tuple(field_names) if field_names is not None else None,
-                   tuple((r.min_ts, r.max_ts) for r in trs.ranges),
-                   sids_key)
-            hit = self._scan_cache.get(key)
-            if hit is not None and hit[0] == v.data_version:
-                b = hit[1]
-                self._scan_cache[key] = self._scan_cache.pop(key)  # LRU touch
+            if self.distributed and split.node_id != self.node_id:
+                b = self._scan_remote(split, field_names)
             else:
-                b = scan_vnode(v, table, series_ids=sids, time_ranges=trs,
-                               field_names=field_names)
-                self._scan_cache.pop(key, None)  # supersede stale version
-                while len(self._scan_cache) >= self.SCAN_CACHE_SIZE:
-                    self._scan_cache.pop(next(iter(self._scan_cache)))
-                self._scan_cache[key] = (v.data_version, b)
-            if b.n_rows:
+                b = self._scan_local(split, field_names)
+            if b is not None and b.n_rows:
                 batches.append(b)
         return batches
+
+    def _scan_local(self, split: PlacedSplit, field_names) -> ScanBatch | None:
+        table, trs, doms = split.table, split.time_ranges, split.tag_domains
+        v = self.engine.vnode(split.owner, split.vnode_id)
+        if v is None:
+            return None
+        sids = None
+        if not doms.is_all:
+            sids = v.index.get_series_ids_by_domains(table, doms)
+            if len(sids) == 0:
+                return None
+        import hashlib
+
+        sids_key = (hashlib.md5(np.ascontiguousarray(sids).tobytes())
+                    .hexdigest() if sids is not None else None)
+        key = (split.owner, split.vnode_id, table,
+               tuple(field_names) if field_names is not None else None,
+               tuple((r.min_ts, r.max_ts) for r in trs.ranges),
+               sids_key)
+        hit = self._scan_cache.get(key)
+        if hit is not None and hit[0] == v.data_version:
+            b = hit[1]
+            self._scan_cache[key] = self._scan_cache.pop(key)  # LRU touch
+        else:
+            b = scan_vnode(v, table, series_ids=sids, time_ranges=trs,
+                           field_names=field_names)
+            self._scan_cache.pop(key, None)  # supersede stale version
+            while len(self._scan_cache) >= self.SCAN_CACHE_SIZE:
+                self._scan_cache.pop(next(iter(self._scan_cache)))
+            self._scan_cache[key] = (v.data_version, b)
+        return b
+
+    def _scan_remote(self, split: PlacedSplit, field_names) -> ScanBatch | None:
+        """Scan one split on its owning node, failing over to replica
+        alternates (reference opener.rs:84-120 remote open +
+        reader/mod.rs:36 broken-replica failover)."""
+        from .ipc import decode_scan_batch
+        from .net import RpcError, RpcUnavailable
+
+        targets = [(split.vnode_id, split.node_id)] + list(split.alternates)
+        last_err = None
+        for vnode_id, node_id in targets:
+            if node_id == self.node_id:
+                alt = PlacedSplit(split.owner, vnode_id, split.table,
+                                  split.time_ranges, split.tag_domains)
+                return self._scan_local(alt, field_names)
+            try:
+                r = self._rpc(node_id, "scan_vnode", {
+                    "owner": split.owner, "vnode_id": vnode_id,
+                    "table": split.table,
+                    "trs": split.time_ranges.to_wire(),
+                    "doms": split.tag_domains.to_wire(),
+                    "field_names": field_names,
+                })
+            except (RpcUnavailable, RpcError) as e:
+                last_err = e
+                continue
+            raw = r.get("ipc")
+            if raw is None:
+                return None
+            return decode_scan_batch(raw)
+        raise CoordinatorError(
+            f"all replicas unreachable for vnode {split.vnode_id} "
+            f"of {split.owner}") from last_err
 
     # ---------------------------------------------------------------- admin
     def drop_table(self, tenant: str, db: str, table: str):
@@ -239,9 +367,77 @@ class Coordinator:
     def drop_database(self, tenant: str, db: str):
         self.meta.drop_database(tenant, db)
 
+    def _peer_nodes(self, tenant: str, db: str) -> list[int]:
+        """Other nodes hosting vnodes of this database."""
+        if not self.distributed:
+            return []
+        nodes = set()
+        for bucket in self.meta.buckets_for(tenant, db):
+            for rs in bucket.shard_group:
+                for v in rs.vnodes:
+                    if v.node_id != self.node_id:
+                        nodes.add(v.node_id)
+        return sorted(nodes)
+
     def delete_from_table(self, tenant: str, db: str, table: str,
                           tag_domains: ColumnDomains, min_ts: int, max_ts: int):
+        """Replicated sets delete through the raft log (the entry carries
+        the tag predicate, resolved at apply time on every replica, so a
+        down follower replays it on rejoin); single-replica vnodes delete
+        directly, and an unreachable owner fails the statement — a silent
+        skip would resurrect rows later."""
         owner = f"{tenant}.{db}"
+        if not self.distributed:
+            self.delete_local(owner, table, tag_domains, min_ts, max_ts)
+            return
+        import msgpack
+
+        from ..storage.wal import WalEntryType
+        from .net import RpcError, RpcUnavailable
+
+        payload = msgpack.packb(
+            {"table": table, "doms": tag_domains.to_wire(),
+             "min_ts": min_ts, "max_ts": max_ts}, use_bin_type=True)
+        failed = []
+        for bucket in self.meta.buckets_for(tenant, db):
+            for rs in bucket.shard_group:
+                if len(rs.vnodes) > 1:
+                    self._write_replicated(
+                        owner, rs, WalEntryType.DELETE_TIME_RANGE, payload,
+                        sync=False)
+                    continue
+                for v in rs.vnodes:
+                    if v.node_id == self.node_id:
+                        self.delete_vnode_local(owner, v.id, table,
+                                                tag_domains, min_ts, max_ts)
+                    else:
+                        try:
+                            self._rpc(v.node_id, "delete_vnode_range", {
+                                "owner": owner, "vnode_id": v.id,
+                                "table": table,
+                                "doms": tag_domains.to_wire(),
+                                "min_ts": min_ts, "max_ts": max_ts})
+                        except (RpcUnavailable, RpcError) as e:
+                            failed.append((v.node_id, e))
+        if failed:
+            raise CoordinatorError(
+                f"delete failed on nodes {[n for n, _ in failed]}: "
+                f"{failed[0][1]}")
+
+    def delete_vnode_local(self, owner: str, vnode_id: int, table: str,
+                           doms: ColumnDomains, min_ts: int, max_ts: int):
+        v = self.engine.vnode(owner, vnode_id)
+        if v is None:
+            return
+        sids = None
+        if not doms.is_all:
+            sids = v.index.get_series_ids_by_domains(table, doms)
+            if len(sids) == 0:
+                return
+        v.delete_time_range(table, sids, min_ts, max_ts)
+
+    def delete_local(self, owner: str, table: str,
+                     tag_domains: ColumnDomains, min_ts: int, max_ts: int):
         for v in self.engine.local_vnodes(owner):
             sids = None
             if not tag_domains.is_all:
@@ -251,7 +447,20 @@ class Coordinator:
             v.delete_time_range(table, sids, min_ts, max_ts)
 
     def tag_values(self, tenant: str, db: str, table: str, tag_key: str) -> list[str]:
-        owner = f"{tenant}.{db}"
+        out = set(self.tag_values_local(f"{tenant}.{db}", table, tag_key))
+        from .net import RpcError, RpcUnavailable
+
+        for nid in self._peer_nodes(tenant, db):
+            try:
+                r = self._rpc(nid, "tag_values", {
+                    "owner": f"{tenant}.{db}", "table": table,
+                    "tag_key": tag_key})
+                out.update(r.get("values", []))
+            except (RpcUnavailable, RpcError):
+                pass
+        return sorted(out)
+
+    def tag_values_local(self, owner: str, table: str, tag_key: str) -> list[str]:
         out = set()
         for v in self.engine.local_vnodes(owner):
             out.update(v.index.tag_values(table, tag_key))
@@ -259,8 +468,27 @@ class Coordinator:
 
     def series_keys(self, tenant: str, db: str, table: str,
                     tag_domains: ColumnDomains | None = None) -> list:
-        owner = f"{tenant}.{db}"
         doms = tag_domains or ColumnDomains.all()
+        keys = {}
+        for k in self.series_keys_local(f"{tenant}.{db}", table, doms):
+            keys[(k.table, k.tags)] = k
+        from ..models.series import SeriesKey
+        from .net import RpcError, RpcUnavailable
+
+        for nid in self._peer_nodes(tenant, db):
+            try:
+                r = self._rpc(nid, "series_keys", {
+                    "owner": f"{tenant}.{db}", "table": table,
+                    "doms": doms.to_wire()})
+                for raw in r.get("keys", []):
+                    k = SeriesKey.decode(raw)
+                    keys[(k.table, k.tags)] = k
+            except (RpcUnavailable, RpcError):
+                pass
+        return [keys[k] for k in sorted(keys)]
+
+    def series_keys_local(self, owner: str, table: str,
+                          doms: ColumnDomains) -> list:
         keys = {}
         for v in self.engine.local_vnodes(owner):
             for sid in v.index.get_series_ids_by_domains(table, doms):

@@ -1,0 +1,103 @@
+"""Arrow IPC encoding of scan results for the cross-process data plane.
+
+Counterpart of the reference's scan-stream wire format (tskv/src/reader/
+serialize.rs:30 TonicRecordBatchEncoder → Arrow IPC bytes inside
+kv_service.proto BatchBytesResponse, decoded in coordinator/src/reader/
+deserialize.rs): a ScanBatch crosses processes as one Arrow IPC stream
+whose schema metadata carries the non-columnar sidecar (table name, series
+ids, encoded series keys, field value-types).
+
+Columns: ts i64 | sid_ordinal i32 | one column per field with Arrow-native
+nulls for the validity mask. The receiving coordinator rebuilds the exact
+ScanBatch layout the device staging path (ops/tpu_exec) expects.
+"""
+from __future__ import annotations
+
+import json
+
+import numpy as np
+import pyarrow as pa
+
+from ..models.schema import ValueType
+from ..models.series import SeriesKey
+from ..storage.scan import ScanBatch
+
+_ARROW_TYPES = {
+    ValueType.FLOAT: pa.float64(),
+    ValueType.INTEGER: pa.int64(),
+    ValueType.UNSIGNED: pa.uint64(),
+    ValueType.BOOLEAN: pa.bool_(),
+    ValueType.STRING: pa.large_utf8(),
+    ValueType.GEOMETRY: pa.large_utf8(),
+}
+
+
+def encode_scan_batch(b: ScanBatch) -> bytes:
+    arrays = [pa.array(b.ts, type=pa.int64()),
+              pa.array(b.sid_ordinal, type=pa.int32())]
+    fields = [pa.field("time", pa.int64()), pa.field("__sid_ord", pa.int32())]
+    vts = {}
+    for name, (vt, vals, valid) in b.fields.items():
+        vt = ValueType(vt)
+        vts[name] = int(vt)
+        mask = ~np.asarray(valid, dtype=bool)
+        if vt in (ValueType.STRING, ValueType.GEOMETRY):
+            # object arrays: go through python list; arrow masks via None
+            pylist = [None if m else str(v)
+                      for v, m in zip(vals.tolist(), mask.tolist())]
+            arr = pa.array(pylist, type=_ARROW_TYPES[vt])
+        else:
+            arr = pa.array(np.asarray(vals), type=_ARROW_TYPES[vt], mask=mask)
+        arrays.append(arr)
+        fields.append(pa.field(name, _ARROW_TYPES[vt]))
+    meta = {
+        "table": b.table,
+        "series_ids": [int(s) for s in b.series_ids],
+        "series_keys": [k.encode().hex() if k is not None else ""
+                        for k in b.series_keys],
+        "value_types": vts,
+    }
+    schema = pa.schema(fields, metadata={b"cnos": json.dumps(meta).encode()})
+    batch = pa.record_batch(arrays, schema=schema)
+    sink = pa.BufferOutputStream()
+    with pa.ipc.new_stream(sink, schema) as w:
+        w.write_batch(batch)
+    return sink.getvalue().to_pybytes()
+
+
+def decode_scan_batch(raw: bytes) -> ScanBatch:
+    with pa.ipc.open_stream(pa.BufferReader(raw)) as r:
+        table = r.read_all()
+    meta = json.loads(table.schema.metadata[b"cnos"].decode())
+    ts = table.column("time").to_numpy(zero_copy_only=False).astype(np.int64)
+    sid_ord = (table.column("__sid_ord").to_numpy(zero_copy_only=False)
+               .astype(np.int32))
+    fields = {}
+    for name, vt_i in meta["value_types"].items():
+        vt = ValueType(vt_i)
+        col = table.column(name)
+        valid = ~np.asarray(col.is_null().to_numpy(zero_copy_only=False),
+                            dtype=bool)
+        if vt in (ValueType.STRING, ValueType.GEOMETRY):
+            vals = np.array([v if v is not None else "" for v in col.to_pylist()],
+                            dtype=object)
+        else:
+            np_dtype = {ValueType.FLOAT: np.float64,
+                        ValueType.INTEGER: np.int64,
+                        ValueType.UNSIGNED: np.uint64,
+                        ValueType.BOOLEAN: np.bool_}[vt]
+            filled = pa.compute.fill_null(col, pa.scalar(0, type=col.type)
+                                          if vt != ValueType.BOOLEAN
+                                          else pa.scalar(False))
+            vals = (filled.to_numpy(zero_copy_only=False).astype(np_dtype))
+        fields[name] = (vt, vals, valid)
+    keys = [SeriesKey.decode(bytes.fromhex(h)) if h else None
+            for h in meta["series_keys"]]
+    return ScanBatch(
+        table=meta["table"],
+        series_ids=np.asarray(meta["series_ids"], dtype=np.uint64),
+        series_keys=keys,
+        ts=ts,
+        sid_ordinal=sid_ord,
+        fields=fields,
+    )

@@ -22,6 +22,7 @@ import json
 import os
 import secrets
 import threading
+import time
 
 from ..errors import (
     DatabaseAlreadyExists, DatabaseNotFound, MetaError, TableAlreadyExists,
@@ -60,7 +61,10 @@ _DUMMY_HASH = hash_password("!nonexistent!")
 
 
 class MetaStore:
-    def __init__(self, path: str | None = None, node_id: int = 1):
+    def __init__(self, path: str | None = None, node_id: int = 1,
+                 register_self: bool = True):
+        """`register_self=False` for a standalone meta server: it is not a
+        data node, so placement must not target its node_id."""
         self.path = path
         self.node_id = node_id
         self.lock = threading.RLock()
@@ -69,13 +73,21 @@ class MetaStore:
         self.databases: dict[str, DatabaseSchema] = {}          # owner → schema
         self.tables: dict[str, dict[str, TskvTableSchema]] = {}  # owner → {table}
         self.buckets: dict[str, list[BucketInfo]] = {}           # owner → buckets
-        self.nodes: dict[int, NodeInfo] = {node_id: NodeInfo(node_id)}
+        self.nodes: dict[int, NodeInfo] = \
+            {node_id: NodeInfo(node_id)} if register_self else {}
         self.streams: dict[str, dict] = {}  # stream name → definition
         self.members: dict[str, dict[str, str]] = {}  # tenant → {user → role}
         self.roles: dict[str, dict[str, dict]] = {}   # tenant → {role → spec}
         # verified-credential cache; keys bind (user, stored-hash, password)
         # so password changes and drops invalidate naturally
         self._auth_cache: set = set()
+        # monotone state version + bounded event log: the /watch long-poll
+        # plane (reference meta/src/service/http.rs /watch + watch logs in
+        # store/storage.rs) — every mutation bumps version and records its
+        # event so remote caches can catch up incrementally
+        self.version = 0
+        self.events: list[tuple[int, str, dict]] = []
+        self._version_cv = threading.Condition(self.lock)
         self._next_bucket_id = 1
         self._next_replica_id = 1
         self._next_vnode_id = 1
@@ -126,6 +138,11 @@ class MetaStore:
     def _load(self):
         with open(self.path) as f:
             d = json.load(f)
+        self._from_dict(d)
+
+    def _from_dict(self, d: dict):
+        """Replace full state from a snapshot dict (used by durable load and
+        by remote-cache hydration in MetaClient)."""
         self.tenants = {k: TenantOptions.from_dict(v) for k, v in d["tenants"].items()}
         self.users = d["users"]
         self.databases = {k: DatabaseSchema.from_dict(v) for k, v in d["databases"].items()}
@@ -140,11 +157,32 @@ class MetaStore:
         self._next_bucket_id, self._next_replica_id, self._next_vnode_id = d["next_ids"]
 
     def _notify(self, event: str, **kw):
+        with self.lock:
+            self.version += 1
+            self.events.append((self.version, event, kw))
+            if len(self.events) > 4096:
+                del self.events[:2048]
+            self._version_cv.notify_all()
         for w in list(self._watchers):
             try:
                 w(event, kw)
             except Exception:
                 pass
+
+    def wait_version(self, after: int, timeout: float = 30.0) -> int:
+        """Block until version > after (long-poll /watch); → current version."""
+        deadline = time.monotonic() + timeout
+        with self._version_cv:
+            while self.version <= after:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    break
+                self._version_cv.wait(remaining)
+            return self.version
+
+    def events_since(self, after: int) -> list[tuple[int, str, dict]]:
+        with self.lock:
+            return [e for e in self.events if e[0] > after]
 
     def watch(self, callback):
         """callback(event:str, payload:dict) on every meta mutation
@@ -354,6 +392,42 @@ class MetaStore:
     def list_tables(self, tenant: str, db: str) -> list[str]:
         return sorted(self.tables.get(f"{tenant}.{db}", {}).keys())
 
+    # ------------------------------------------------------------ nodes
+    def register_node(self, node_id: int, grpc_addr: str = "",
+                      http_addr: str = ""):
+        """Data node joins the cluster (reference meta_admin.rs:479
+        add_data_node); placement spreads over registered, alive nodes."""
+        with self.lock:
+            self.nodes[node_id] = NodeInfo(node_id, grpc_addr, http_addr,
+                                           {"last_seen": time.time()})
+            self._persist()
+            self._notify("register_node", node_id=node_id)
+
+    def report_heartbeat(self, node_id: int):
+        """Liveness beat (reference regular_report_node_metrics
+        server.rs:121-131); not persisted — liveness is runtime state."""
+        with self.lock:
+            n = self.nodes.get(node_id)
+            if n is not None:
+                n.attributes["last_seen"] = time.time()
+
+    def node_addr(self, node_id: int) -> str | None:
+        with self.lock:
+            n = self.nodes.get(node_id)
+            return n.grpc_addr if n else None
+
+    def alive_nodes(self, max_age: float = 15.0) -> list[NodeInfo]:
+        """Nodes seen within max_age seconds. Nodes that never heartbeat
+        (single-process/test stores) count as alive."""
+        now = time.time()
+        with self.lock:
+            out = []
+            for n in self.nodes.values():
+                seen = n.attributes.get("last_seen")
+                if seen is None or now - seen <= max_age:
+                    out.append(n)
+            return out
+
     # ------------------------------------------------------------ streams
     def create_stream(self, name: str, definition: dict):
         with self.lock:
@@ -381,11 +455,26 @@ class MetaStore:
             start = (ts // dur) * dur if ts >= 0 else -((-ts + dur - 1) // dur) * dur
             bucket = BucketInfo(self._next_bucket_id, start, start + dur, [])
             self._next_bucket_id += 1
+            # spread replicas round-robin over alive nodes (reference
+            # meta_tenant.rs:562 create_bucket node selection); fall back to
+            # all REGISTERED nodes rather than placing on a phantom id when
+            # heartbeats are transiently stale — a bucket is persisted, so a
+            # bad placement would poison its time range permanently
+            cand = sorted(n.id for n in self.alive_nodes())
+            if not cand:
+                cand = sorted(self.nodes)
+            if not cand:
+                raise MetaError("no data nodes registered; cannot place bucket")
+            rr = bucket.id  # deterministic stagger across buckets
             for _ in range(max(1, schema.options.shard_num)):
-                vnodes = [VnodeInfo(self._next_vnode_id + i, self.node_id)
-                          for i in range(max(1, schema.options.replica))]
+                replica = max(1, schema.options.replica)
+                vnodes = []
+                for i in range(replica):
+                    node = cand[(rr + i) % len(cand)]
+                    vnodes.append(VnodeInfo(self._next_vnode_id + i, node))
+                rr += replica
                 self._next_vnode_id += len(vnodes)
-                rs = ReplicationSet(self._next_replica_id, self.node_id,
+                rs = ReplicationSet(self._next_replica_id, vnodes[0].node_id,
                                     vnodes[0].id, vnodes)
                 self._next_replica_id += 1
                 bucket.shard_group.append(rs)

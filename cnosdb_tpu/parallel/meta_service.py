@@ -1,0 +1,311 @@
+"""Meta cluster service: the catalog's cross-process plane.
+
+Role-parity with the reference's meta HTTP API + client (meta/src/service/
+http.rs:58-236 /read /write /watch /dump /restore endpoints; meta/src/
+client.rs:83-140 MetaHttpClient; meta/src/model/meta_admin.rs AdminMeta
+watch loops): one MetaService process owns the authoritative MetaStore;
+every data/query node runs a MetaClient holding a full local cache that
+serves reads, forwards mutations, and follows a long-poll watch stream.
+
+Wire model (over parallel.net msgpack-HTTP):
+  meta_read   {}                      → {version, snapshot}
+  meta_write  {method, kwargs}        → {version, snapshot, events, result}
+  meta_watch  {after, timeout}        → {version, snapshot, events}   (long-poll)
+  meta_dump   {}                      → {snapshot}
+  meta_restore{snapshot}              → {version}
+
+Mutations are dispatched by method name onto the authoritative store with
+schema-typed arguments rehydrated from their dict forms; the full snapshot
+rides back on every write (meta mutations are rare and the state is small —
+same trade the reference makes shipping watch logs + periodic full syncs).
+"""
+from __future__ import annotations
+
+import threading
+import time
+
+from .. import errors as _errors
+from ..errors import CnosError, MetaError
+from ..models.meta_data import BucketInfo
+from ..models.schema import DatabaseSchema, TenantOptions, TskvTableSchema
+from .meta import MetaStore
+from .net import RpcError, RpcServer, rpc_call
+
+# mutation → {arg name → rehydrator} applied server-side
+_ARG_HYDRATORS = {
+    "create_tenant": {"options": lambda d: TenantOptions.from_dict(d) if d else None},
+    "create_database": {"schema": DatabaseSchema.from_dict},
+    "create_table": {"schema": TskvTableSchema.from_dict},
+    "update_table": {"schema": TskvTableSchema.from_dict},
+}
+
+MUTATIONS = frozenset([
+    "create_tenant", "drop_tenant", "create_user", "drop_user", "alter_user",
+    "add_member", "remove_member", "create_database", "alter_database",
+    "drop_database", "create_table", "update_table", "drop_table",
+    "create_stream", "drop_stream", "locate_bucket_for_write",
+    "expire_buckets", "register_node", "report_heartbeat",
+])
+
+
+def _dehydrate(result):
+    if isinstance(result, BucketInfo):
+        return {"_type": "bucket", "v": result.to_dict()}
+    if isinstance(result, list) and result and isinstance(result[0], BucketInfo):
+        return {"_type": "buckets", "v": [b.to_dict() for b in result]}
+    return {"_type": "raw", "v": result}
+
+
+def _rehydrate(wrapped):
+    t, v = wrapped["_type"], wrapped["v"]
+    if t == "bucket":
+        return BucketInfo.from_dict(v)
+    if t == "buckets":
+        return [BucketInfo.from_dict(b) for b in v]
+    return v
+
+
+class MetaService:
+    """Hosts the authoritative MetaStore over RPC."""
+
+    def __init__(self, store: MetaStore, host: str = "127.0.0.1",
+                 port: int = 0):
+        self.store = store
+        self.server = RpcServer(host, port, {
+            "ping": lambda p: {"ok": True, "version": store.version},
+            "meta_read": self._read,
+            "meta_write": self._write,
+            "meta_watch": self._watch,
+            "meta_beat": self._beat,
+            "meta_dump": lambda p: {"snapshot": self.store._to_dict()},
+            "meta_restore": self._restore,
+        })
+        self.addr = self.server.addr
+
+    def start(self):
+        self.server.start()
+        return self
+
+    def stop(self):
+        self.server.stop()
+
+    def _read(self, p):
+        with self.store.lock:
+            return {"version": self.store.version,
+                    "snapshot": self.store._to_dict()}
+
+    def _write(self, p):
+        method = p["method"]
+        if method not in MUTATIONS:
+            raise MetaError(f"not a meta mutation: {method}")
+        kwargs = dict(p.get("kwargs") or {})
+        for name, fix in _ARG_HYDRATORS.get(method, {}).items():
+            if name in kwargs:
+                kwargs[name] = fix(kwargs[name])
+        before = self.store.version
+        result = getattr(self.store, method)(**kwargs)
+        with self.store.lock:
+            out = {"version": self.store.version,
+                   "events": [[v, e, kw] for v, e, kw in
+                              self.store.events_since(before)],
+                   "result": _dehydrate(result)}
+            # the snapshot is O(catalog); omit it when nothing changed
+            if self.store.version != before:
+                out["snapshot"] = self.store._to_dict()
+            return out
+
+    def _beat(self, p):
+        """Liveness beat — deliberately NOT a meta_write: no version bump,
+        no snapshot serialization on the hot 3s path."""
+        self.store.report_heartbeat(int(p["node_id"]))
+        return {"ok": True}
+
+    def _watch(self, p):
+        after = int(p.get("after", 0))
+        timeout = min(float(p.get("timeout", 25.0)), 55.0)
+        version = self.store.wait_version(after, timeout)
+        with self.store.lock:
+            return {"version": version,
+                    "snapshot": self.store._to_dict(),
+                    "events": [[v, e, kw] for v, e, kw in
+                               self.store.events_since(after)]}
+
+    def _restore(self, p):
+        with self.store.lock:
+            self.store._from_dict(p["snapshot"])
+            self.store._persist()
+        self.store._notify("restore")
+        with self.store.lock:
+            return {"version": self.store.version}
+
+
+def _raise_remote(e: RpcError):
+    """Map a remote error name back to its local exception class.
+
+    RpcError text is "<method>@<addr>: <ErrClass>: <message>"."""
+    parts = str(e).split(": ", 2)
+    if len(parts) == 3:
+        cls = getattr(_errors, parts[1], None)
+        if isinstance(cls, type) and issubclass(cls, CnosError):
+            raise cls(parts[2])
+    raise e
+
+
+class MetaClient:
+    """Full-cache meta client (reference AdminMeta + MetaHttpClient).
+
+    Reads serve from the local MetaStore replica; mutations forward to the
+    MetaService and synchronously apply the returned snapshot so callers get
+    read-your-writes; a daemon watch thread keeps the cache fresh and fires
+    the same watcher callbacks MetaStore would locally."""
+
+    def __init__(self, addr: str, node_id: int = 0, watch: bool = True):
+        self.addr = addr
+        self.node_id = node_id
+        self.cache = MetaStore(path=None, node_id=node_id, register_self=False)
+        self._watchers: list = []
+        self._seen_version = 0
+        self._sync_lock = threading.Lock()
+        self._stop = threading.Event()
+        self.refresh()
+        self._watch_thread = None
+        if watch:
+            self._watch_thread = threading.Thread(target=self._watch_loop,
+                                                  daemon=True)
+            self._watch_thread.start()
+        self._hb_thread = None
+
+    # ---------------------------------------------------------------- sync
+    def refresh(self):
+        r = rpc_call(self.addr, "meta_read")
+        self._apply(r["version"], r["snapshot"], [])
+
+    def _apply(self, version: int, snapshot: dict | None, events: list):
+        fire = []
+        with self._sync_lock:
+            with self.cache.lock:
+                if snapshot is not None and version > self.cache.version:
+                    self.cache._from_dict(snapshot)
+                    self.cache.version = version
+            for v, event, kw in events:
+                if v > self._seen_version:
+                    self._seen_version = v
+                    fire.append((event, kw))
+        for event, kw in fire:
+            for w in list(self._watchers):
+                try:
+                    w(event, kw)
+                except Exception:
+                    pass
+
+    def _watch_loop(self):
+        while not self._stop.is_set():
+            try:
+                r = rpc_call(self.addr, "meta_watch",
+                             {"after": self._seen_version, "timeout": 25.0},
+                             timeout=30.0)
+                self._apply(r["version"], r["snapshot"], r["events"])
+            except Exception:
+                if self._stop.wait(1.0):
+                    return
+
+    def start_heartbeat(self, interval: float = 3.0):
+        def beat():
+            while not self._stop.wait(interval):
+                try:
+                    rpc_call(self.addr, "meta_beat",
+                             {"node_id": self.node_id}, timeout=5.0)
+                except Exception:
+                    pass
+        self._hb_thread = threading.Thread(target=beat, daemon=True)
+        self._hb_thread.start()
+
+    def close(self):
+        self._stop.set()
+
+    # ------------------------------------------------------------ mutations
+    def _forward(self, method: str, **kwargs):
+        try:
+            r = rpc_call(self.addr, "meta_write",
+                         {"method": method, "kwargs": kwargs})
+        except RpcError as e:
+            _raise_remote(e)
+        self._apply(r["version"], r.get("snapshot"), r["events"])
+        return _rehydrate(r["result"])
+
+    def create_tenant(self, name, options=None):
+        return self._forward("create_tenant", name=name,
+                             options=options.to_dict() if options else None)
+
+    def drop_tenant(self, name):
+        return self._forward("drop_tenant", name=name)
+
+    def create_user(self, name, password="", admin=False, comment=""):
+        return self._forward("create_user", name=name, password=password,
+                             admin=admin, comment=comment)
+
+    def drop_user(self, name):
+        return self._forward("drop_user", name=name)
+
+    def alter_user(self, name, password=None):
+        return self._forward("alter_user", name=name, password=password)
+
+    def add_member(self, tenant, user, role="member"):
+        return self._forward("add_member", tenant=tenant, user=user, role=role)
+
+    def remove_member(self, tenant, user):
+        return self._forward("remove_member", tenant=tenant, user=user)
+
+    def create_database(self, schema, if_not_exists=False):
+        return self._forward("create_database", schema=schema.to_dict(),
+                             if_not_exists=if_not_exists)
+
+    def alter_database(self, tenant, db, **opts):
+        return self._forward("alter_database", tenant=tenant, db=db, **opts)
+
+    def drop_database(self, tenant, db, if_exists=True):
+        return self._forward("drop_database", tenant=tenant, db=db,
+                             if_exists=if_exists)
+
+    def create_table(self, schema, if_not_exists=False):
+        return self._forward("create_table", schema=schema.to_dict(),
+                             if_not_exists=if_not_exists)
+
+    def update_table(self, schema):
+        return self._forward("update_table", schema=schema.to_dict())
+
+    def drop_table(self, tenant, db, table):
+        return self._forward("drop_table", tenant=tenant, db=db, table=table)
+
+    def create_stream(self, name, definition):
+        return self._forward("create_stream", name=name, definition=definition)
+
+    def drop_stream(self, name):
+        return self._forward("drop_stream", name=name)
+
+    def register_node(self, node_id, grpc_addr="", http_addr=""):
+        return self._forward("register_node", node_id=node_id,
+                             grpc_addr=grpc_addr, http_addr=http_addr)
+
+    def expire_buckets(self, tenant, db, now_ns):
+        return self._forward("expire_buckets", tenant=tenant, db=db,
+                             now_ns=now_ns)
+
+    def locate_bucket_for_write(self, tenant, db, ts):
+        """Cache-first: only the bucket-creating miss pays an RPC."""
+        owner = f"{tenant}.{db}"
+        with self.cache.lock:
+            for b in self.cache.buckets.get(owner, []):
+                if b.contains(ts):
+                    return b
+        return self._forward("locate_bucket_for_write",
+                             tenant=tenant, db=db, ts=ts)
+
+    # ------------------------------------------------------------ watchers
+    def watch(self, callback):
+        self._watchers.append(callback)
+
+    # ------------------------------------------------------------ reads
+    def __getattr__(self, name):
+        # read-only methods + attributes delegate to the cache replica
+        return getattr(self.cache, name)

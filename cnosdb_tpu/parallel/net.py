@@ -1,0 +1,147 @@
+"""Cross-process RPC plane: msgpack over HTTP.
+
+This is the rebuild's counterpart of the reference's tonic/gRPC node-to-node
+plane (common/protos/proto/kv_service.proto TSKVService + raft_service.proto,
+replication/src/network_grpc.rs, meta/src/service/http.rs): a thread-per-
+request HTTP server carrying msgpack request/reply bodies, and a client with
+per-thread persistent connections. HTTP instead of gRPC because the callers
+are synchronous engine/raft threads (thread-per-request matches the raft
+tick/propose model the way tonic's tasks match tokio), and msgpack because
+the payloads are already msgpack throughout the storage layer; Arrow IPC
+rides inside scan replies as opaque bytes (reference serialize.rs:30
+TonicRecordBatchEncoder ↔ BatchBytesResponse).
+
+Wire form: POST /rpc/<method> with a msgpack body → 200 + msgpack reply,
+or 500 + msgpack {"_err": class, "_msg": str} re-raised client-side.
+"""
+from __future__ import annotations
+
+import http.client
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import msgpack
+
+from ..errors import CnosError
+
+
+class RpcError(CnosError):
+    pass
+
+
+class RpcUnavailable(RpcError):
+    """Peer unreachable (connection refused / reset / timeout)."""
+
+
+def pack(obj) -> bytes:
+    return msgpack.packb(obj, use_bin_type=True)
+
+
+def unpack(raw: bytes):
+    return msgpack.unpackb(raw, raw=False, strict_map_key=False)
+
+
+class RpcServer:
+    """Serves `handlers[method](payload) -> reply` at POST /rpc/<method>."""
+
+    def __init__(self, host: str, port: int, handlers: dict):
+        self.handlers = dict(handlers)
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *a):  # quiet
+                pass
+
+            def do_POST(self):
+                n = int(self.headers.get("Content-Length", 0))
+                body = self.rfile.read(n) if n else b""
+                method = self.path.rsplit("/", 1)[-1]
+                fn = outer.handlers.get(method)
+                if fn is None:
+                    self._reply(404, pack({"_err": "NoSuchMethod", "_msg": method}))
+                    return
+                try:
+                    reply = fn(unpack(body) if body else {})
+                    self._reply(200, pack(reply))
+                except Exception as e:  # propagate to caller, keep serving
+                    self._reply(500, pack({"_err": type(e).__name__,
+                                           "_msg": str(e)}))
+
+            def _reply(self, status: int, raw: bytes):
+                try:
+                    self.send_response(status)
+                    self.send_header("Content-Type", "application/msgpack")
+                    self.send_header("Content-Length", str(len(raw)))
+                    self.end_headers()
+                    self.wfile.write(raw)
+                except (BrokenPipeError, ConnectionResetError):
+                    pass
+
+        self.httpd = ThreadingHTTPServer((host, port), Handler)
+        self.httpd.daemon_threads = True
+        self.port = self.httpd.server_address[1]
+        self.addr = f"{host}:{self.port}"
+        self._thread = threading.Thread(target=self.httpd.serve_forever,
+                                        daemon=True)
+
+    def start(self):
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+
+class _ConnCache(threading.local):
+    def __init__(self):
+        self.conns: dict[str, http.client.HTTPConnection] = {}
+
+
+_conns = _ConnCache()
+
+
+def rpc_call(addr: str, method: str, payload: dict | None = None,
+             timeout: float = 10.0):
+    """One RPC; reuses this thread's connection to `addr` ("host:port")."""
+    body = pack(payload or {})
+    last_exc: Exception | None = None
+    for attempt in (0, 1):  # one retry on a stale kept-alive connection
+        conn = _conns.conns.get(addr)
+        if conn is None:
+            host, _, port = addr.rpartition(":")
+            conn = http.client.HTTPConnection(host, int(port), timeout=timeout)
+            _conns.conns[addr] = conn
+        try:
+            conn.request("POST", f"/rpc/{method}", body,
+                         {"Content-Type": "application/msgpack"})
+            resp = conn.getresponse()
+            raw = resp.read()
+            reply = unpack(raw) if raw else {}
+            if resp.status != 200:
+                raise RpcError(f"{method}@{addr}: "
+                               f"{reply.get('_err')}: {reply.get('_msg')}")
+            return reply
+        except (ConnectionError, http.client.HTTPException, OSError,
+                TimeoutError) as e:
+            conn.close()
+            _conns.conns.pop(addr, None)
+            last_exc = e
+            if attempt == 0:
+                continue
+    raise RpcUnavailable(f"{method}@{addr}: {last_exc}") from last_exc
+
+
+def wait_rpc_ready(addr: str, method: str = "ping", timeout: float = 10.0):
+    """Poll until a peer answers (process start-up races in harnesses)."""
+    deadline = time.monotonic() + timeout
+    while True:
+        try:
+            return rpc_call(addr, method, {}, timeout=2.0)
+        except RpcError:
+            if time.monotonic() > deadline:
+                raise
+            time.sleep(0.05)

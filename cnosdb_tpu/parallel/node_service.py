@@ -1,0 +1,110 @@
+"""Data-node RPC service: the node-to-node data plane.
+
+Role-parity with the reference's gRPC TSKVService + RaftService servers
+(main/src/rpc/tskv.rs:214-397 RaftWrite/QueryRecordBatch/TagScan/Admin,
+replication/src/network_grpc.rs RaftCBServer): every data node hosts one
+RpcServer (parallel.net) answering
+
+  raft_msg        raft consensus messages for replica groups on this node
+  write_vnode     single-replica point writes for a local vnode
+  write_replica   propose on a replica-set whose raft leader lives here
+  scan_vnode      scan one local vnode → Arrow IPC bytes
+  tag_values / series_keys / delete_from_table   index/admin fan-out
+  status          node liveness + vnode inventory
+
+The service owns nothing itself: it is a thin dispatch onto the node's
+Coordinator / ReplicaGroupManager / engine, so local and remote execution
+share one code path.
+"""
+from __future__ import annotations
+
+from ..models.points import WriteBatch
+from ..models.predicate import ColumnDomains, TimeRanges
+from .coordinator import Coordinator, PlacedSplit
+from .ipc import encode_scan_batch
+from .net import RpcServer
+from .raft import NotLeader
+
+
+class DataNodeService:
+    def __init__(self, coord: Coordinator, host: str = "127.0.0.1",
+                 port: int = 0):
+        self.coord = coord
+        self.server = RpcServer(host, port, {
+            "ping": self._ping,
+            "status": self._status,
+            "raft_msg": self._raft_msg,
+            "write_vnode": self._write_vnode,
+            "write_replica": self._write_replica,
+            "scan_vnode": self._scan_vnode,
+            "tag_values": self._tag_values,
+            "series_keys": self._series_keys,
+            "delete_vnode_range": self._delete_vnode_range,
+        })
+        self.addr = self.server.addr
+
+    def start(self):
+        self.server.start()
+        return self
+
+    def stop(self):
+        self.server.stop()
+
+    # ------------------------------------------------------------ handlers
+    def _ping(self, p):
+        return {"ok": True, "node_id": self.coord.node_id}
+
+    def _status(self, p):
+        inv: dict[str, list[int]] = {}
+        for (owner, vid) in list(self.coord.engine.vnodes):
+            inv.setdefault(owner, []).append(vid)
+        return {"node_id": self.coord.node_id,
+                "vnodes": {o: sorted(vs) for o, vs in inv.items()}}
+
+    def _raft_msg(self, p):
+        reply = self.coord.replica_manager().handle_raft_msg(
+            p["group"], p["to"], p["msg"])
+        return {"reply": reply}
+
+    def _write_vnode(self, p):
+        batch = WriteBatch.decode(p["data"])
+        self.coord.engine.write(p["owner"], p["vnode_id"], batch,
+                                sync=p.get("sync", False))
+        return {"ok": True}
+
+    def _write_replica(self, p):
+        from ..models.meta_data import ReplicationSet
+
+        rs = ReplicationSet.from_dict(p["rs"])
+        try:
+            idx = self.coord.replica_manager().propose_local(
+                p["owner"], rs, p["entry_type"], p["data"],
+                sync=p.get("sync", False))
+        except NotLeader as e:
+            return {"ok": False, "hint": e.args[0] if e.args else None}
+        return {"ok": True, "index": idx}
+
+    def _scan_vnode(self, p):
+        split = PlacedSplit(
+            p["owner"], p["vnode_id"], p["table"],
+            TimeRanges.from_wire(p["trs"]),
+            ColumnDomains.from_wire(p["doms"]))
+        b = self.coord._scan_local(split, p.get("field_names"))
+        if b is None:
+            return {"ipc": None}
+        return {"ipc": encode_scan_batch(b)}
+
+    def _tag_values(self, p):
+        return {"values": self.coord.tag_values_local(
+            p["owner"], p["table"], p["tag_key"])}
+
+    def _series_keys(self, p):
+        keys = self.coord.series_keys_local(
+            p["owner"], p["table"], ColumnDomains.from_wire(p["doms"]))
+        return {"keys": [k.encode() for k in keys]}
+
+    def _delete_vnode_range(self, p):
+        self.coord.delete_vnode_local(
+            p["owner"], p["vnode_id"], p["table"],
+            ColumnDomains.from_wire(p["doms"]), p["min_ts"], p["max_ts"])
+        return {"ok": True}

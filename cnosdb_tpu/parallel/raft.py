@@ -256,6 +256,44 @@ class InProcessTransport(Transport):
         return node.handle_message(msg)
 
 
+class HttpTransport(Transport):
+    """Cross-process raft transport (reference replication/src/
+    network_grpc.rs RaftCBServer + network_client.rs client pool): messages
+    for peers on this host short-circuit through an embedded
+    InProcessTransport; remote peers get msgpack-HTTP `raft_msg` RPCs.
+
+    `resolver(group_id, peer_id) -> "host:port" | None` — None means the
+    peer is (or should be) local. Unreachable peers yield None replies,
+    which the raft layer already treats as dropped messages."""
+
+    def __init__(self, resolver):
+        self.resolver = resolver
+        self.local = InProcessTransport()
+        self.nodes = self.local.nodes  # registry view for managers
+
+    def register(self, node: "RaftNode"):
+        self.local.register(node)
+
+    def send(self, group_id, to, msg):
+        if (group_id, to) in self.local.nodes:
+            return self.local.send(group_id, to, msg)
+        addr = self.resolver(group_id, to)
+        if addr is None:
+            return None
+        from .net import RpcError, rpc_call
+
+        try:
+            # short timeout: raft treats a missing reply as a dropped
+            # message and retries next tick; a long block here would stall
+            # the concurrent broadcast threads' join window
+            r = rpc_call(addr, "raft_msg",
+                         {"group": group_id, "to": to, "msg": msg},
+                         timeout=2.0)
+        except RpcError:
+            return None
+        return r.get("reply")
+
+
 RAFT_BLANK = 5  # WalEntryType.RAFT_BLANK
 
 
@@ -293,7 +331,7 @@ class RaftNode:
         self._election_deadline = self._new_deadline()
         self._stop = threading.Event()
         self._apply_cv = threading.Condition(self.lock)
-        if isinstance(transport, InProcessTransport):
+        if hasattr(transport, "register"):
             transport.register(self)
         self._ticker = None
         if tick:
@@ -353,22 +391,42 @@ class RaftNode:
             last_idx = self.log.last_index()
             last_term = self.log.term_at(last_idx)
             self._election_deadline = self._new_deadline()
-        votes = 1
-        for p in self.peers:
-            reply = self.transport.send(self.group_id, p, {
-                "type": "request_vote", "from": self.node_id, "term": term,
-                "last_log_index": last_idx, "last_log_term": last_term})
+        # ask all peers concurrently; proceed on majority without waiting
+        # for slow/unreachable peers (same rationale as _broadcast_append)
+        votes = [1]
+        total = len(self.peers) + 1
+        vote_lock = threading.Lock()
+        settled = threading.Event()
+
+        def ask(p):
+            try:
+                reply = self.transport.send(self.group_id, p, {
+                    "type": "request_vote", "from": self.node_id,
+                    "term": term, "last_log_index": last_idx,
+                    "last_log_term": last_term})
+            except Exception:
+                return
             if reply is None:
-                continue
+                return
             if reply.get("term", 0) > term:
                 self._step_down(reply["term"])
+                settled.set()
                 return
             if reply.get("granted"):
-                votes += 1
+                with vote_lock:
+                    votes[0] += 1
+                    if votes[0] * 2 > total:
+                        settled.set()
+
+        threads = [threading.Thread(target=ask, args=(p,), daemon=True)
+                   for p in self.peers]
+        for t in threads:
+            t.start()
+        settled.wait(timeout=1.0)
         with self.lock:
             if self.role != Role.CANDIDATE or self.term != term:
                 return
-            if votes * 2 > len(self.peers) + 1:
+            if votes[0] * 2 > len(self.peers) + 1:
                 self.role = Role.LEADER
                 self.leader_id = self.node_id
                 last = self.log.last_index()
@@ -414,10 +472,31 @@ class RaftNode:
 
     # ------------------------------------------------------------ replication
     def _broadcast_append(self):
+        """Send to all peers CONCURRENTLY: one slow/unreachable peer (packet
+        loss blocks an HTTP send for the full timeout) must not delay
+        heartbeats or commit progress toward the healthy majority."""
         self._last_heartbeat = time.monotonic()
-        for p in self.peers:
-            self._send_append(p)
+        if len(self.peers) <= 1:
+            for p in self.peers:
+                self._safe_send_append(p)
+        else:
+            threads = [threading.Thread(target=self._safe_send_append,
+                                        args=(p,), daemon=True)
+                       for p in self.peers]
+            for t in threads:
+                t.start()
+            # brief join so the fast majority's replies land before commit
+            for t in threads:
+                t.join(timeout=0.5)
         self._advance_commit()
+
+    def _safe_send_append(self, peer: int):
+        """A failed send is a dropped message — never let it unwind a
+        broadcast thread (e.g. stores closing during shutdown)."""
+        try:
+            self._send_append(peer)
+        except Exception:
+            pass
 
     def _send_append(self, peer: int):
         need_snapshot = False
@@ -446,6 +525,7 @@ class RaftNode:
         reply = self.transport.send(self.group_id, peer, msg)
         if reply is None:
             return
+        advanced = False
         with self.lock:
             if reply.get("term", 0) > self.term:
                 pass
@@ -453,12 +533,18 @@ class RaftNode:
                 if entries:
                     self.match_index[peer] = entries[-1].index
                     self.next_index[peer] = entries[-1].index + 1
-                return
+                    advanced = True
             else:
                 self.next_index[peer] = max(1, min(
                     ni - 1, reply.get("conflict_index", ni - 1)))
                 return
-        self._step_down(reply["term"])
+        if reply.get("term", 0) > self.term:
+            self._step_down(reply["term"])
+        elif advanced:
+            # commit as soon as this reply completes a majority — replies
+            # from concurrent broadcast threads must not wait for the next
+            # heartbeat tick
+            self._advance_commit()
 
     def _send_snapshot(self, peer: int):
         # Capture (snapshot, applied index) consistently WITHOUT holding

@@ -7,9 +7,17 @@ machine is the VnodeStorage apply path and whose log store is that vnode's
 WAL (one durable log per vnode, reference wal_store.rs). Writes go to the
 group leader (retry-on-leader-change like tskv_executor.rs
 TskvLeaderExecutor); single-vnode sets bypass consensus entirely.
+
+Two deployments share this code:
+- single-process (tests, singleton mode): every replica is local, messages
+  ride the InProcessTransport;
+- multi-node: each node builds ONLY the raft members whose vnodes are
+  placed on it; peer messages ride HttpTransport to the owning node's RPC
+  service (reference network_grpc.rs), resolved through meta placement.
 """
 from __future__ import annotations
 
+import os
 import threading
 
 import msgpack
@@ -19,8 +27,8 @@ from ..models.meta_data import ReplicationSet
 from ..storage.engine import TsKv
 from ..storage.vnode import VnodeStorage
 from .raft import (
-    InProcessTransport, LogEntry, MultiRaft, NotLeader, RaftNode,
-    StateMachine, WalLogStore,
+    HttpTransport, InProcessTransport, LogEntry, MultiRaft, NotLeader,
+    RaftNode, StateMachine, WalLogStore,
 )
 
 
@@ -89,35 +97,80 @@ class VnodeStateMachine(StateMachine):
 
 
 class ReplicaGroupManager:
-    """Builds/holds raft groups for replica sets (all local this round)."""
+    """Builds/holds the raft groups for this node's replica-set members.
 
-    def __init__(self, engine: TsKv,
+    With `meta=None` (single-process), all members of every set are built
+    locally over InProcessTransport — the round-1 behavior. With a meta
+    view, only vnodes placed on `node_id` are built and remote peers are
+    resolved to their owning node's RPC address."""
+
+    def __init__(self, engine: TsKv, node_id: int | None = None,
+                 meta=None,
                  election_timeout=(0.15, 0.3), heartbeat_interval=0.05):
         self.engine = engine
-        self.transport = InProcessTransport()
+        self.node_id = node_id
+        self.meta = meta
+        if meta is None:
+            self.transport = InProcessTransport()
+        else:
+            self.transport = HttpTransport(self._resolve_peer)
         self.multi = MultiRaft()
         self.election_timeout = election_timeout
         self.heartbeat_interval = heartbeat_interval
         self.lock = threading.Lock()
+        # group_id → ReplicationSet placement (for peer resolution)
+        self._placements: dict[str, ReplicationSet] = {}
 
     def group_id(self, owner: str, rs: ReplicationSet) -> str:
         return f"{owner}/{rs.id}"
 
+    # ------------------------------------------------------------ placement
+    def _resolve_peer(self, group_id: str, peer_vnode: int) -> str | None:
+        rs = self._placements.get(group_id)
+        if rs is None:
+            rs = self._find_placement(group_id)
+        if rs is None:
+            return None
+        v = rs.vnode(peer_vnode)
+        if v is None or v.node_id == self.node_id:
+            return None
+        return self.meta.node_addr(v.node_id)
+
+    def _find_placement(self, group_id: str) -> ReplicationSet | None:
+        """owner/rs_id → ReplicationSet via the meta bucket map."""
+        owner, _, rs_id_s = group_id.rpartition("/")
+        tenant, _, db = owner.partition(".")
+        try:
+            rs_id = int(rs_id_s)
+        except ValueError:
+            return None
+        for bucket in self.meta.buckets_for(tenant, db):
+            for rs in bucket.shard_group:
+                if rs.id == rs_id:
+                    self._placements[group_id] = rs
+                    return rs
+        return None
+
+    def _is_local(self, v) -> bool:
+        return self.meta is None or v.node_id == self.node_id
+
+    # ------------------------------------------------------------ groups
     def get_or_build(self, owner: str, rs: ReplicationSet) -> dict[int, RaftNode]:
-        """→ vnode_id → RaftNode for the set (builds all local members)."""
+        """→ vnode_id → RaftNode for this node's members of the set."""
         gid = self.group_id(owner, rs)
         with self.lock:
+            self._placements[gid] = rs
             nodes = {}
             peers = [v.id for v in rs.vnodes]
             for v in rs.vnodes:
+                if not self._is_local(v):
+                    continue
                 key = (gid, v.id)
                 existing = self.transport.nodes.get(key)
                 if existing is not None:
                     nodes[v.id] = existing
                     continue
                 vnode = self.engine.open_vnode(owner, v.id)
-                import os
-
                 log = WalLogStore(vnode.wal,
                                   os.path.join(vnode.dir, "hardstate"))
                 node = RaftNode(gid, v.id, peers, log,
@@ -127,6 +180,26 @@ class ReplicaGroupManager:
                 self.multi.add(node)
                 nodes[v.id] = node
             return nodes
+
+    def ensure_group(self, group_id: str) -> bool:
+        """Build this node's members for a group named by id (first contact
+        from a remote raft peer, reference manager.rs open-on-demand)."""
+        rs = self._placements.get(group_id) or self._find_placement(group_id)
+        if rs is None:
+            return False
+        owner = group_id.rpartition("/")[0]
+        self.get_or_build(owner, rs)
+        return True
+
+    def handle_raft_msg(self, group_id: str, to: int, msg: dict) -> dict | None:
+        node = self.transport.nodes.get((group_id, to))
+        if node is None:
+            if not self.ensure_group(group_id):
+                return None
+            node = self.transport.nodes.get((group_id, to))
+            if node is None:
+                return None
+        return node.handle_message(msg)
 
     def current_leader_vnode(self, owner: str, rs: ReplicationSet) -> int | None:
         """The raft leader's vnode id (may differ from meta's static
@@ -139,6 +212,16 @@ class ReplicaGroupManager:
                 return v.id
         return None
 
+    def leader_hint(self, owner: str, rs: ReplicationSet) -> int | None:
+        """A local member's view of the current leader vnode id."""
+        gid = self.group_id(owner, rs)
+        for v in rs.vnodes:
+            node = self.transport.nodes.get((gid, v.id))
+            if node is not None and node.leader_id is not None:
+                return node.leader_id
+        return None
+
+    # ------------------------------------------------------------ writes
     def write(self, owner: str, rs: ReplicationSet, entry_type: int,
               data: bytes, retries: int = 20, sync: bool = False) -> int:
         """Propose on the current leader, retrying across leader changes
@@ -165,6 +248,19 @@ class ReplicaGroupManager:
                 time.sleep(0.05)
         raise ReplicationError(
             f"no leader for {self.group_id(owner, rs)}") from last_err
+
+    def propose_local(self, owner: str, rs: ReplicationSet, entry_type: int,
+                      data: bytes, sync: bool = False) -> int:
+        """Propose iff a member on THIS node is the raft leader; raises
+        NotLeader(hint) otherwise so the coordinator can forward."""
+        nodes = self.get_or_build(owner, rs)
+        leader = next((n for n in nodes.values() if n.is_leader()), None)
+        if leader is None:
+            raise NotLeader(self.leader_hint(owner, rs))
+        idx = leader.propose(entry_type, data)
+        if sync:
+            self.engine.open_vnode(owner, leader.node_id).wal.sync()
+        return idx
 
     def stop(self):
         self.multi.stop_all()

@@ -303,6 +303,33 @@ def build_server(data_dir: str, auth_enabled: bool = False,
     return HttpServer(meta, coord, executor, auth_enabled=auth_enabled)
 
 
+def build_cluster_node(data_dir: str, meta_addr: str, node_id: int,
+                       rpc_host: str = "127.0.0.1", rpc_port: int = 0,
+                       auth_enabled: bool = False, wal_sync: bool = False):
+    """Wire a cluster data/query node: MetaClient cache + node RPC service
+    + local engine + distributed coordinator (reference server.rs
+    build_query_storage in cluster deployment: AdminMeta::new +
+    add_data_node + grpc TSKVService)."""
+    import os
+
+    from ..parallel.meta_service import MetaClient
+    from ..parallel.net import wait_rpc_ready
+    from ..parallel.node_service import DataNodeService
+
+    wait_rpc_ready(meta_addr, timeout=30.0)
+    meta = MetaClient(meta_addr, node_id=node_id)
+    engine = TsKv(os.path.join(data_dir, "db"), wal_sync=wal_sync)
+    engine.open_existing()
+    coord = Coordinator(meta, engine, node_id=node_id)
+    node_svc = DataNodeService(coord, host=rpc_host, port=rpc_port).start()
+    meta.register_node(node_id, grpc_addr=node_svc.addr)
+    meta.start_heartbeat()
+    executor = QueryExecutor(meta, coord)
+    server = HttpServer(meta, coord, executor, auth_enabled=auth_enabled)
+    server.node_service = node_svc
+    return server
+
+
 def run_server(args) -> int:
     import asyncio
     import time as _time
@@ -311,9 +338,19 @@ def run_server(args) -> int:
 
     # Config.load with no path still applies CNOSDB_* env overrides
     cfg = Config.load(getattr(args, "config", None))
-    server = build_server(args.data_dir,
-                          auth_enabled=cfg.query.auth_enabled,
-                          wal_sync=cfg.wal.sync)
+    mode = getattr(args, "mode", "singleton")
+    if mode == "meta":
+        return run_meta_server(args)
+    if getattr(args, "meta", None):
+        server = build_cluster_node(
+            args.data_dir, args.meta, getattr(args, "node_id", 1) or 1,
+            rpc_port=getattr(args, "rpc_port", 0) or 0,
+            auth_enabled=cfg.query.auth_enabled, wal_sync=cfg.wal.sync)
+        print(f"node rpc on {server.node_service.addr}")
+    else:
+        server = build_server(args.data_dir,
+                              auth_enabled=cfg.query.auth_enabled,
+                              wal_sync=cfg.wal.sync)
     flight_port = cfg.service.flight_rpc_listen_port
 
     async def ttl_job():
@@ -353,4 +390,26 @@ def run_server(args) -> int:
         asyncio.run(main())
     except KeyboardInterrupt:
         server.coord.close()
+    return 0
+
+
+def run_meta_server(args) -> int:
+    """Standalone meta service process (reference cnosdb-meta binary,
+    meta/src/bin/main.rs + service/http.rs)."""
+    import os
+    import time as _time
+
+    from ..parallel.meta_service import MetaService
+
+    store = MetaStore(os.path.join(args.data_dir, "meta", "meta.json"),
+                      register_self=False)
+    svc = MetaService(store, port=getattr(args, "meta_port", 8901) or 8901)
+    svc.start()
+    print(f"cnosdb-tpu meta listening on {svc.addr} "
+          f"(data dir {args.data_dir})")
+    try:
+        while True:
+            _time.sleep(3600)
+    except KeyboardInterrupt:
+        svc.stop()
     return 0

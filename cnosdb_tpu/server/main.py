@@ -12,12 +12,20 @@ import sys
 def build_arg_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(prog="cnosdb-tpu", description=__doc__)
     sub = p.add_subparsers(dest="cmd")
-    run = sub.add_parser("run", help="run a data/query node")
+    run = sub.add_parser("run", help="run a data/query/meta node")
     run.add_argument("--config", default=None, help="TOML config path")
     run.add_argument("--data-dir", default="./cnosdb-data")
     run.add_argument("--http-port", type=int, default=8902)
     run.add_argument("-M", "--mode", default="singleton",
-                     choices=["singleton", "query_tskv", "tskv", "query"])
+                     choices=["singleton", "query_tskv", "tskv", "query",
+                              "meta"])
+    run.add_argument("--meta", default=None,
+                     help="meta service address host:port (cluster modes)")
+    run.add_argument("--node-id", type=int, default=1)
+    run.add_argument("--rpc-port", type=int, default=0,
+                     help="node-to-node RPC port (0 = ephemeral)")
+    run.add_argument("--meta-port", type=int, default=8901,
+                     help="meta service port (mode=meta)")
     cfg = sub.add_parser("config", help="print default config")
     check = sub.add_parser("check", help="validate a config file")
     check.add_argument("path")

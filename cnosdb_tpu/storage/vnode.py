@@ -91,7 +91,20 @@ class VnodeStorage:
             self._apply_update_tags(obj["table"], obj["old_keys"], obj["new_keys"])
         elif entry_type == WalEntryType.DELETE_TIME_RANGE:
             obj = msgpack.unpackb(data, raw=False)
-            self._apply_delete_time_range(obj["table"], obj["sids"],
+            sids = obj.get("sids")
+            if obj.get("doms") is not None:
+                # replicated deletes carry the tag predicate and resolve
+                # series ids at APPLY time on each replica — identical by
+                # determinism, and robust to replica index skew
+                from ..models.predicate import ColumnDomains
+
+                doms = ColumnDomains.from_wire(obj["doms"])
+                if not doms.is_all:
+                    sids = self.index.get_series_ids_by_domains(
+                        obj["table"], doms)
+                    if len(sids) == 0:
+                        return
+            self._apply_delete_time_range(obj["table"], sids,
                                           obj["min_ts"], obj["max_ts"])
         # RAFT_BLANK/MEMBERSHIP: no storage effect
 
