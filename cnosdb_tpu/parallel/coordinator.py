@@ -13,6 +13,7 @@ and a scan that fails on the leader's node fails over to follower replicas
 """
 from __future__ import annotations
 
+import threading
 import time
 from dataclasses import dataclass, field
 
@@ -56,8 +57,10 @@ class Coordinator:
         # ScanBatch snapshots keyed by vnode data_version: repeated queries
         # reuse both the host batch and its device-resident twin (the
         # reference's TsmReader LRU cache, promoted to whole-scan snapshots
-        # because host→device transfer dominates on this hardware)
+        # because host→device transfer dominates on this hardware);
+        # lock-guarded: node-service handler threads scan concurrently
         self._scan_cache: dict = {}
+        self._scan_cache_lock = threading.Lock()
         # schema auto-creation callbacks land on meta; keep engine's view hot
         meta.watch(self._on_meta_event)
 
@@ -314,13 +317,14 @@ class Coordinator:
                tuple(field_names) if field_names is not None else None,
                tuple((r.min_ts, r.max_ts) for r in trs.ranges),
                sids_key)
-        hit = self._scan_cache.get(key)
-        if hit is not None and hit[0] == v.data_version:
-            b = hit[1]
-            self._scan_cache[key] = self._scan_cache.pop(key)  # LRU touch
-        else:
-            b = scan_vnode(v, table, series_ids=sids, time_ranges=trs,
-                           field_names=field_names)
+        with self._scan_cache_lock:
+            hit = self._scan_cache.get(key)
+            if hit is not None and hit[0] == v.data_version:
+                self._scan_cache[key] = self._scan_cache.pop(key)  # LRU touch
+                return hit[1]
+        b = scan_vnode(v, table, series_ids=sids, time_ranges=trs,
+                       field_names=field_names)
+        with self._scan_cache_lock:
             self._scan_cache.pop(key, None)  # supersede stale version
             while len(self._scan_cache) >= self.SCAN_CACHE_SIZE:
                 self._scan_cache.pop(next(iter(self._scan_cache)))
@@ -447,6 +451,8 @@ class Coordinator:
             v.delete_time_range(table, sids, min_ts, max_ts)
 
     def tag_values(self, tenant: str, db: str, table: str, tag_key: str) -> list[str]:
+        """Index fan-out; an unreachable owner fails the query — a silent
+        skip would return partial values as if complete."""
         out = set(self.tag_values_local(f"{tenant}.{db}", table, tag_key))
         from .net import RpcError, RpcUnavailable
 
@@ -456,8 +462,9 @@ class Coordinator:
                     "owner": f"{tenant}.{db}", "table": table,
                     "tag_key": tag_key})
                 out.update(r.get("values", []))
-            except (RpcUnavailable, RpcError):
-                pass
+            except (RpcUnavailable, RpcError) as e:
+                raise CoordinatorError(
+                    f"tag scan failed on node {nid}: {e}") from e
         return sorted(out)
 
     def tag_values_local(self, owner: str, table: str, tag_key: str) -> list[str]:
@@ -483,8 +490,9 @@ class Coordinator:
                 for raw in r.get("keys", []):
                     k = SeriesKey.decode(raw)
                     keys[(k.table, k.tags)] = k
-            except (RpcUnavailable, RpcError):
-                pass
+            except (RpcUnavailable, RpcError) as e:
+                raise CoordinatorError(
+                    f"series scan failed on node {nid}: {e}") from e
         return [keys[k] for k in sorted(keys)]
 
     def series_keys_local(self, owner: str, table: str,

@@ -179,6 +179,11 @@ class MetaClient:
     def refresh(self):
         r = rpc_call(self.addr, "meta_read")
         self._apply(r["version"], r["snapshot"], [])
+        # the snapshot already reflects every event up to its version; a
+        # watch must never replay history from before it (a replayed
+        # drop_table event would destroy live re-created data)
+        with self._sync_lock:
+            self._seen_version = max(self._seen_version, r["version"])
 
     def _apply(self, version: int, snapshot: dict | None, events: list):
         fire = []

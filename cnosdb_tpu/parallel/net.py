@@ -20,6 +20,7 @@ import http.client
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from socket import timeout as socket_timeout
 
 import msgpack
 
@@ -96,43 +97,74 @@ class RpcServer:
         self.httpd.server_close()
 
 
-class _ConnCache(threading.local):
+class _ConnPool:
+    """Shared keep-alive connection pool keyed by peer address.
+
+    Shared (not thread-local) because raft broadcast/election paths spawn
+    short-lived sender threads — a per-thread cache would open a brand-new
+    TCP connection for every raft message."""
+
+    MAX_IDLE_PER_ADDR = 8
+
     def __init__(self):
-        self.conns: dict[str, http.client.HTTPConnection] = {}
+        self.lock = threading.Lock()
+        self.idle: dict[str, list[http.client.HTTPConnection]] = {}
+
+    def get(self, addr: str, timeout: float):
+        """→ (conn, reused) — reused connections may be stale keep-alives."""
+        with self.lock:
+            conns = self.idle.get(addr)
+            if conns:
+                return conns.pop(), True
+        host, _, port = addr.rpartition(":")
+        return http.client.HTTPConnection(host, int(port),
+                                          timeout=timeout), False
+
+    def put(self, addr: str, conn):
+        with self.lock:
+            conns = self.idle.setdefault(addr, [])
+            if len(conns) < self.MAX_IDLE_PER_ADDR:
+                conns.append(conn)
+                return
+        conn.close()
 
 
-_conns = _ConnCache()
+_pool = _ConnPool()
 
 
 def rpc_call(addr: str, method: str, payload: dict | None = None,
              timeout: float = 10.0):
-    """One RPC; reuses this thread's connection to `addr` ("host:port")."""
+    """One RPC to `addr` ("host:port") over a pooled keep-alive connection.
+
+    Retry policy: ONLY a non-timeout failure on a REUSED connection is
+    retried (the classic stale keep-alive race, where the request cannot
+    have been processed). A timeout or a fresh-connection failure is NOT
+    retried — the server may have fully applied a non-idempotent mutation
+    whose reply was lost, and re-executing it would double-apply."""
     body = pack(payload or {})
-    last_exc: Exception | None = None
-    for attempt in (0, 1):  # one retry on a stale kept-alive connection
-        conn = _conns.conns.get(addr)
-        if conn is None:
-            host, _, port = addr.rpartition(":")
-            conn = http.client.HTTPConnection(host, int(port), timeout=timeout)
-            _conns.conns[addr] = conn
+    for attempt in (0, 1):
+        conn, reused = _pool.get(addr, timeout)
+        conn.timeout = timeout
+        if conn.sock is not None:
+            conn.sock.settimeout(timeout)
         try:
             conn.request("POST", f"/rpc/{method}", body,
                          {"Content-Type": "application/msgpack"})
             resp = conn.getresponse()
             raw = resp.read()
             reply = unpack(raw) if raw else {}
-            if resp.status != 200:
-                raise RpcError(f"{method}@{addr}: "
-                               f"{reply.get('_err')}: {reply.get('_msg')}")
-            return reply
         except (ConnectionError, http.client.HTTPException, OSError,
                 TimeoutError) as e:
             conn.close()
-            _conns.conns.pop(addr, None)
-            last_exc = e
-            if attempt == 0:
+            if reused and attempt == 0 and not isinstance(
+                    e, (TimeoutError, socket_timeout)):
                 continue
-    raise RpcUnavailable(f"{method}@{addr}: {last_exc}") from last_exc
+            raise RpcUnavailable(f"{method}@{addr}: {e}") from e
+        _pool.put(addr, conn)
+        if resp.status != 200:
+            raise RpcError(f"{method}@{addr}: "
+                           f"{reply.get('_err')}: {reply.get('_msg')}")
+        return reply
 
 
 def wait_rpc_ready(addr: str, method: str = "ping", timeout: float = 10.0):

@@ -32,6 +32,48 @@ class SelectStmt:
     offset: int | None = None
     distinct: bool = False
     database: str | None = None   # explicit db qualifier (FROM db.table)
+    # non-trivial FROM (joins / subquery relations); when set, `table` is
+    # only populated for the single-plain-table fast path
+    from_item: Any = None
+
+
+@dataclass
+class TableRef:
+    """One named relation in FROM (reference ast.rs TableFactor::Table)."""
+
+    name: str
+    alias: str | None = None
+    database: str | None = None
+
+
+@dataclass
+class SubqueryRef:
+    """FROM (SELECT ...) alias — a derived relation."""
+
+    select: Any                    # SelectStmt | UnionStmt
+    alias: str
+
+
+@dataclass
+class Join:
+    """left <kind> JOIN right ON on (reference reads these via DataFusion;
+    here joins execute host-side over columnar results)."""
+
+    left: Any                      # TableRef | SubqueryRef | Join
+    right: Any
+    kind: str                      # inner|left|right|full|cross
+    on: Optional[Expr] = None
+
+
+@dataclass
+class UnionStmt:
+    """UNION [ALL] chain; ORDER BY/LIMIT apply to the combined result."""
+
+    selects: list                  # SelectStmt
+    alls: list = field(default_factory=list)   # per-operator ALL flags
+    order_by: list = field(default_factory=list)
+    limit: int | None = None
+    offset: int | None = None
 
 
 @dataclass

@@ -27,9 +27,12 @@ from ..ops.tpu_exec import AggSpec, TpuQuery, execute_scan_aggregate
 from ..parallel.coordinator import Coordinator
 from ..parallel.meta import MetaStore
 from . import ast
-from .expr import Column, Expr, Literal
+from . import relational as rel
+from .expr import (
+    Column, Expr, Func, InList, InSubquery, Literal, Subquery, WindowFunc,
+)
 from .parser import parse_sql
-from .planner import AggregatePlan, RawScanPlan, plan_select
+from .planner import AGG_FUNCS, AggregatePlan, RawScanPlan, plan_select
 
 
 @dataclass
@@ -150,6 +153,8 @@ class QueryExecutor:
     def execute_statement(self, stmt, session: Session) -> ResultSet:
         if isinstance(stmt, ast.SelectStmt):
             return self._select(stmt, session)
+        if isinstance(stmt, ast.UnionStmt):
+            return self._union(stmt, session)
         if isinstance(stmt, ast.ExplainStmt):
             return self._explain(stmt, session)
         if isinstance(stmt, ast.CreateDatabase):
@@ -542,6 +547,9 @@ class QueryExecutor:
         return ResultSet(["plan"], [np.array(lines, dtype=object)])
 
     def _select(self, stmt: ast.SelectStmt, session: Session):
+        stmt = self._resolve_subqueries(stmt, session)
+        if stmt.from_item is not None or self._needs_relational(stmt):
+            return self._select_relational(stmt, session)
         if stmt.table is None:
             # constant SELECT (SELECT 1)
             names, cols = [], []
@@ -562,6 +570,287 @@ class QueryExecutor:
         if isinstance(plan, AggregatePlan):
             return self._exec_aggregate(plan, session.tenant, db)
         return self._exec_raw(plan, session.tenant, db)
+
+    # ------------------------------------------------------- relational path
+    def _needs_relational(self, stmt: ast.SelectStmt) -> bool:
+        """Window functions route through the relational pipeline; plain
+        single-table queries keep the fused-kernel path."""
+        exprs = [it.expr for it in stmt.items if isinstance(it.expr, Expr)]
+        exprs += [e for e in (stmt.where, stmt.having) if e is not None]
+        exprs += [e for e, _ in stmt.order_by if isinstance(e, Expr)]
+        return any(rel.contains_window(e) for e in exprs)
+
+    def _resolve_subqueries(self, stmt: ast.SelectStmt, session: Session):
+        """Execute uncorrelated scalar / IN subqueries and splice their
+        results in as literals (reference: DataFusion subquery rules)."""
+        found = []
+
+        def spot(e):
+            if isinstance(e, (Subquery, InSubquery)):
+                found.append(e)
+
+        exprs = [it.expr for it in stmt.items if isinstance(it.expr, Expr)]
+        exprs += [e for e in (stmt.where, stmt.having) if e is not None]
+        for e in exprs:
+            rel.walk_exprs(e, spot)
+        if not found:
+            return stmt
+
+        def replace(e):
+            q = e.select
+            rs = self._union(q, session) if isinstance(q, ast.UnionStmt) \
+                else self._select(q, session)
+            if isinstance(e, Subquery):
+                if len(rs.columns) != 1 or rs.n_rows > 1:
+                    raise QueryError(
+                        "scalar subquery must return a single value")
+                if rs.n_rows == 0:
+                    return Literal(None)
+                v = rs.columns[0][0]
+                return Literal(v.item() if hasattr(v, "item") else v)
+            if len(rs.columns) != 1:
+                raise QueryError("IN subquery must return a single column")
+            vals = [v.item() if hasattr(v, "item") else v
+                    for v in rs.columns[0]]
+            non_null = [v for v in vals if v is not None]
+            return InList(e.expr, non_null, e.negated,
+                          null_present=len(non_null) != len(vals))
+
+        import copy as _copy
+
+        out = _copy.copy(stmt)
+        pred = lambda e: isinstance(e, (Subquery, InSubquery))  # noqa: E731
+        out.items = [ast.SelectItem(rel.rewrite_exprs(it.expr, pred, replace)
+                                    if isinstance(it.expr, Expr) else it.expr,
+                                    it.alias) for it in stmt.items]
+        if stmt.where is not None:
+            out.where = rel.rewrite_exprs(stmt.where, pred, replace)
+        if stmt.having is not None:
+            out.having = rel.rewrite_exprs(stmt.having, pred, replace)
+        return out
+
+    def _strip_alias(self, e: Expr, alias: str | None) -> Expr:
+        """alias.col → col for pushdown into the aliased base relation."""
+        if alias is None or e is None:
+            return e
+        prefix = alias + "."
+        return rel.rewrite_exprs(
+            e, lambda x: isinstance(x, Column) and x.name.startswith(prefix),
+            lambda x: Column(x.name[len(prefix):]))
+
+    def _materialize_from(self, item, session: Session,
+                          pushed_where: Expr | None = None) -> rel.Scope:
+        """FROM item → Scope. Base tables materialize through the normal
+        single-table path (predicate pushdown, fused kernels, system
+        tables); joins compose host-side (reference: TskvExec leaves under
+        DataFusion join operators)."""
+        if isinstance(item, ast.TableRef):
+            sub = ast.SelectStmt(
+                items=[ast.SelectItem("*")], table=item.name,
+                where=self._strip_alias(pushed_where, item.alias),
+                database=item.database)
+            rs = self._select(sub, session)
+            return rel.Scope.from_relation(rs.names, rs.columns, item.alias)
+        if isinstance(item, ast.SubqueryRef):
+            q = item.select
+            rs = self._union(q, session) if isinstance(q, ast.UnionStmt) \
+                else self._select(q, session)
+            # pushed_where (if any) applies post-materialization
+            scope = rel.Scope.from_relation(rs.names, rs.columns, item.alias)
+            if pushed_where is not None:
+                w = self._strip_alias(pushed_where, item.alias)
+                m = np.asarray(w.eval(scope.env, np))
+                if not m.shape:
+                    m = np.full(scope.n, bool(m))
+                scope = scope.filter(m)
+            return scope
+        if isinstance(item, ast.Join):
+            left = self._materialize_from(item.left, session)
+            right = self._materialize_from(item.right, session)
+            scope = rel.hash_join(left, right, item.kind, item.on)
+            if pushed_where is not None:
+                m = np.asarray(pushed_where.eval(scope.env, np))
+                if not m.shape:
+                    m = np.full(scope.n, bool(m))
+                scope = scope.filter(m)
+            return scope
+        raise PlanError(f"unsupported FROM item {type(item).__name__}")
+
+    def _select_relational(self, stmt: ast.SelectStmt, session: Session):
+        item = stmt.from_item or ast.TableRef(stmt.table, None, stmt.database)
+        where = stmt.where
+        pushed = None
+        if isinstance(item, ast.TableRef) and where is not None \
+                and not rel.contains_window(where):
+            pushed, where = where, None   # full pushdown into the base scan
+        scope = self._materialize_from(item, session, pushed)
+        if where is not None:
+            if rel.contains_window(where):
+                raise PlanError("window functions are not allowed in WHERE")
+            m = np.asarray(where.eval(scope.env, np))
+            if not m.shape:
+                m = np.full(scope.n, bool(m))
+            scope = scope.filter(m)
+
+        has_agg = any(
+            rel.collect_aggs(it.expr, AGG_FUNCS)
+            for it in stmt.items if isinstance(it.expr, Expr))
+        if stmt.group_by or has_agg:
+            if self._needs_relational(stmt):
+                raise PlanError(
+                    "window functions cannot mix with GROUP BY in one "
+                    "SELECT — wrap the aggregate in a subquery")
+            rs, env, order_by = self._host_group_aggregate(stmt, scope)
+            rs = _order_limit(rs, order_by, stmt.limit, stmt.offset, env)
+            return self._distinct(rs) if stmt.distinct else rs
+
+        # window evaluation over the filtered scope, then projection
+        win_map: dict[int, str] = {}
+        wfs: list[WindowFunc] = []
+        for it in stmt.items:
+            if isinstance(it.expr, Expr):
+                rel.walk_exprs(it.expr, lambda e: wfs.append(e)
+                               if isinstance(e, WindowFunc) else None)
+        for e, _ in stmt.order_by:
+            if isinstance(e, Expr):
+                rel.walk_exprs(e, lambda x: wfs.append(x)
+                               if isinstance(x, WindowFunc) else None)
+        env = dict(scope.env)
+        for i, wf in enumerate(wfs):
+            alias = f"__win{i}"
+            env[alias] = rel.eval_window(wf, scope.env, scope.n)
+            win_map[id(wf)] = alias
+
+        def unwin(e):
+            if not isinstance(e, Expr):
+                return e
+            return rel.rewrite_exprs(
+                e, lambda x: isinstance(x, WindowFunc),
+                lambda x: Column(win_map[id(x)]))
+
+        out_names, out_cols = [], []
+        for it in stmt.items:
+            if it.expr == "*":
+                out_names.extend(scope.names)
+                out_cols.extend(scope.cols)
+                continue
+            v = unwin(it.expr).eval(env, np)
+            if np.isscalar(v) or getattr(v, "shape", None) == ():
+                v = np.full(scope.n, v)
+            out_names.append(it.alias or
+                             (it.expr.name if isinstance(it.expr, Column)
+                              else it.expr.to_sql()))
+            out_cols.append(np.asarray(v))
+        rs = ResultSet(out_names, out_cols)
+        env_all = dict(env)
+        for nm, c in zip(out_names, out_cols):
+            env_all.setdefault(nm, c)
+        order_by = [(unwin(e), asc) for e, asc in stmt.order_by]
+        rs = _order_limit(rs, order_by, stmt.limit, stmt.offset, env_all)
+        return self._distinct(rs) if stmt.distinct else rs
+
+    def _host_group_aggregate(self, stmt: ast.SelectStmt, scope: rel.Scope):
+        """GROUP BY + aggregates over a joined/derived relation — the
+        host-side final-aggregate (single tables use the fused kernel)."""
+        key_exprs: list[Expr] = []
+        for g in stmt.group_by:
+            if isinstance(g, int):
+                e = stmt.items[g - 1].expr
+                if not isinstance(e, Expr):
+                    raise PlanError("GROUP BY ordinal refers to *")
+                key_exprs.append(e)
+            elif isinstance(g, Expr):
+                key_exprs.append(g)
+            else:
+                key_exprs.append(Column(str(g)))
+        key_cols = [np.asarray(e.eval(scope.env, np)) for e in key_exprs]
+        gid, first_idx = rel.group_indices(key_cols, scope.n)
+        n_groups = len(first_idx)
+
+        agg_cache: dict[str, np.ndarray] = {}
+        genv = {k: v[first_idx] for k, v in scope.env.items()}
+
+        def agg_col(f: Func) -> str:
+            distinct = bool(f.args) and isinstance(f.args[0], Literal) \
+                and f.args[0].value == "__distinct__"
+            args = f.args[1:] if distinct else f.args
+            star = (len(args) == 1 and isinstance(args[0], Literal)
+                    and args[0].value == "*")
+            key = f.to_sql() + ("D" if distinct else "")
+            if key not in agg_cache:
+                col = None if (star or not args) else \
+                    np.asarray(args[0].eval(scope.env, np))
+                agg_cache[key] = rel.host_aggregate(
+                    f.name, col, gid, n_groups, distinct)
+            return key
+
+        def rewrite(e):
+            return rel.rewrite_exprs(
+                e, lambda x: isinstance(x, Func)
+                and not isinstance(x, WindowFunc)
+                and x.name.lower() in AGG_FUNCS,
+                lambda x: Column(agg_col(x)))
+
+        rewritten = [(it, rewrite(it.expr) if isinstance(it.expr, Expr)
+                      else it.expr) for it in stmt.items]
+        having = rewrite(stmt.having) if stmt.having is not None else None
+        genv.update(agg_cache)
+
+        if having is not None:
+            hm = np.asarray(having.eval(genv, np))
+            if not hm.shape:
+                hm = np.full(n_groups, bool(hm))
+            genv = {k: v[hm] for k, v in genv.items()}
+            n_groups = int(hm.sum())
+
+        out_names, out_cols = [], []
+        for it, e in rewritten:
+            if e == "*":
+                raise PlanError("SELECT * is invalid with GROUP BY")
+            v = e.eval(genv, np)
+            if np.isscalar(v) or getattr(v, "shape", None) == ():
+                v = np.full(n_groups, v)
+            out_names.append(it.alias or
+                             (it.expr.name if isinstance(it.expr, Column)
+                              else it.expr.to_sql()))
+            out_cols.append(np.asarray(v))
+        rs = ResultSet(out_names, out_cols)
+        env_all = dict(genv)
+        for nm, c in zip(out_names, out_cols):
+            env_all.setdefault(nm, c)
+        # ORDER BY count(*) etc. must see the same aggregate rewrites
+        order_by = [(rewrite(e) if isinstance(e, Expr) else e, asc)
+                    for e, asc in stmt.order_by]
+        return rs, env_all, order_by
+
+    def _distinct(self, rs: ResultSet) -> ResultSet:
+        seen = set()
+        keep = []
+        for i in range(rs.n_rows):
+            key = tuple(c[i] if c.dtype == object else c[i].item()
+                        for c in rs.columns)
+            if key not in seen:
+                seen.add(key)
+                keep.append(i)
+        idx = np.asarray(keep, dtype=np.int64)
+        return ResultSet(rs.names, [c[idx] for c in rs.columns])
+
+    def _union(self, stmt: ast.UnionStmt, session: Session) -> ResultSet:
+        results = [self._select(s, session) for s in stmt.selects]
+        width = len(results[0].names)
+        for r in results[1:]:
+            if len(r.names) != width:
+                raise QueryError("UNION branches must have equal arity")
+        names = results[0].names
+        acc = [results[0].columns[i] for i in range(width)]
+        for r, all_ in zip(results[1:], stmt.alls):
+            acc = [_concat_cols(acc[i], r.columns[i]) for i in range(width)]
+            if not all_:
+                rs_tmp = self._distinct(ResultSet(names, acc))
+                acc = list(rs_tmp.columns)
+        rs = ResultSet(names, acc)
+        env = {n: c for n, c in zip(names, acc)}
+        return _order_limit(rs, stmt.order_by, stmt.limit, stmt.offset, env)
 
     def _select_over_env(self, stmt: ast.SelectStmt, names: list[str], cols):
         """Generic SELECT over an in-memory table (system schemas)."""
@@ -1149,14 +1438,35 @@ def _apply_gapfill(plan: AggregatePlan, rs: ResultSet) -> ResultSet:
     return ResultSet(rs.names, new_cols)
 
 
+def _null_safe_key(v: np.ndarray):
+    """→ (sortable values, null flags | None). Object columns with Nones
+    (outer-join padding) are not directly orderable; nulls ride a separate
+    flag key (NULLS LAST ascending, FIRST descending — DataFusion's
+    defaults, which the reference inherits)."""
+    v = np.asarray(v)
+    if v.dtype != object:
+        return v, None
+    nulls = np.array([x is None for x in v], dtype=np.int8)
+    vals = v
+    if nulls.any():
+        vals = np.array([("" if x is None else x) for x in v], dtype=object)
+    try:
+        vals = vals.astype("U")
+    except (TypeError, ValueError):
+        pass
+    return vals, (nulls if nulls.any() else None)
+
+
 def _order_limit(rs: ResultSet, order_by, limit, offset, env) -> ResultSet:
     n = rs.n_rows
     if n and order_by:
         keys = []
         for oe, asc in reversed(order_by):
             v = oe.eval(env, np) if isinstance(oe, Expr) else env[oe]
-            v = np.asarray(v)
-            keys.append(v)
+            vals, nulls = _null_safe_key(np.asarray(v))
+            keys.append(vals)
+            if nulls is not None:
+                keys.append(nulls)  # later key = higher priority in lexsort
         idx = np.lexsort(keys)
         # lexsort is ascending on all; apply desc by flipping per-key is
         # complex — handle single-key desc and uniform direction fast paths
@@ -1172,6 +1482,19 @@ def _order_limit(rs: ResultSet, order_by, limit, offset, env) -> ResultSet:
     return rs
 
 
+def _concat_cols(a: np.ndarray, b: np.ndarray) -> np.ndarray:
+    """Concatenate union branches; mixed dtypes fall back to object."""
+    if a.dtype == b.dtype:
+        return np.concatenate([a, b])
+    if a.dtype != object and b.dtype != object:
+        try:
+            return np.concatenate([a.astype(np.float64),
+                                   b.astype(np.float64)])
+        except (TypeError, ValueError):
+            pass
+    return np.concatenate([a.astype(object), b.astype(object)])
+
+
 def _mixed_order(order_by, env, n):
     """Mixed asc/desc via one lexsort over rank-inverted keys.
 
@@ -1181,9 +1504,11 @@ def _mixed_order(order_by, env, n):
     keys = []
     for oe, asc in reversed(order_by):
         v = oe.eval(env, np) if isinstance(oe, Expr) else env[oe]
-        v = np.asarray(v)
+        vals, nulls = _null_safe_key(np.asarray(v))
         if not asc:
-            _, inv = np.unique(v, return_inverse=True)
-            v = -inv.astype(np.int64)
-        keys.append(v)
+            _, inv = np.unique(vals, return_inverse=True)
+            vals = -inv.astype(np.int64)
+        keys.append(vals)
+        if nulls is not None:
+            keys.append(nulls if asc else -nulls)
     return np.lexsort(keys)

@@ -150,9 +150,15 @@ class InList(Expr):
     expr: Expr
     values: list
     negated: bool = False
+    # a NULL among the comparison values (e.g. from an IN-subquery): per
+    # SQL three-valued logic it can never satisfy IN, and it makes NOT IN
+    # unknown (hence false as a filter) for EVERY row
+    null_present: bool = False
 
     def eval(self, env, xp):
         v = self.expr.eval(env, xp)
+        if self.negated and self.null_present:
+            return xp.zeros(getattr(v, "shape", (1,)), dtype=bool)
         m = None
         for lit in self.values:
             c = _eq(xp, v, lit)
@@ -307,6 +313,81 @@ class Func(Expr):
 
     def to_sql(self):
         return f"{self.name}({', '.join(a.to_sql() for a in self.args)})"
+
+
+@dataclass(repr=False)
+class Subquery(Expr):
+    """Uncorrelated scalar subquery — the executor resolves it to a Literal
+    before evaluation (reference gets these via DataFusion's subquery
+    decorrelation; we support the uncorrelated forms)."""
+
+    select: object   # ast.SelectStmt | ast.UnionStmt
+
+    def eval(self, env, xp):
+        raise PlanError("unresolved scalar subquery (executor must resolve)")
+
+    def columns(self):
+        return set()
+
+    def to_sql(self):
+        return "(<subquery>)"
+
+
+@dataclass(repr=False)
+class InSubquery(Expr):
+    """expr [NOT] IN (SELECT ...) — resolved to an InList by the executor."""
+
+    expr: Expr
+    select: object
+    negated: bool = False
+
+    def eval(self, env, xp):
+        raise PlanError("unresolved IN subquery (executor must resolve)")
+
+    def columns(self):
+        return self.expr.columns()
+
+    def to_sql(self):
+        neg = " NOT" if self.negated else ""
+        return f"({self.expr.to_sql()}{neg} IN (<subquery>))"
+
+
+@dataclass(repr=False)
+class WindowFunc(Expr):
+    """fn(args) OVER (PARTITION BY ... ORDER BY ...) — evaluated by the
+    relational executor over the post-filter row set; generic eval is
+    invalid because window semantics need whole-partition context."""
+
+    name: str
+    args: list
+    partition_by: list = None    # list[Expr]
+    order_by: list = None        # list[(Expr, asc)]
+
+    def eval(self, env, xp):
+        raise PlanError(
+            f"window function {self.name} outside relational context")
+
+    def columns(self):
+        out = set()
+        for a in self.args:
+            out |= a.columns()
+        for e in (self.partition_by or []):
+            out |= e.columns()
+        for e, _ in (self.order_by or []):
+            out |= e.columns()
+        return out
+
+    def to_sql(self):
+        parts = []
+        if self.partition_by:
+            parts.append("PARTITION BY "
+                         + ", ".join(e.to_sql() for e in self.partition_by))
+        if self.order_by:
+            parts.append("ORDER BY " + ", ".join(
+                e.to_sql() + ("" if asc else " DESC")
+                for e, asc in self.order_by))
+        return (f"{self.name}({', '.join(a.to_sql() for a in self.args)}) "
+                f"OVER ({' '.join(parts)})")
 
 
 # ---------------------------------------------------------------------------

@@ -14,7 +14,8 @@ from datetime import datetime, timezone
 from ..errors import ParserError
 from . import ast
 from .expr import (
-    Between, BinOp, Column, Expr, Func, InList, IsNull, Like, Literal, UnaryOp,
+    Between, BinOp, Column, Expr, Func, InList, InSubquery, IsNull, Like,
+    Literal, Subquery, UnaryOp, WindowFunc,
 )
 
 # ---------------------------------------------------------------------------
@@ -208,7 +209,7 @@ class Parser:
     def parse_statement(self):
         k = self.kw()
         if k == "SELECT":
-            return self.parse_select()
+            return self.parse_query()
         if k == "EXPLAIN":
             self.next()
             analyze = self.accept_kw("ANALYZE")
@@ -247,6 +248,26 @@ class Parser:
         raise ParserError(f"unsupported statement start {self.peek().value!r}")
 
     # -- SELECT ----------------------------------------------------------
+    def parse_query(self):
+        """SELECT [UNION [ALL] SELECT]... — a trailing ORDER BY/LIMIT
+        belongs to the whole union (standard SQL set-op scoping)."""
+        first = self.parse_select()
+        if self.kw() != "UNION":
+            return first
+        selects, alls = [first], []
+        while self.accept_kw("UNION"):
+            alls.append(self.accept_kw("ALL"))
+            selects.append(self.parse_select())
+        for s in selects[:-1]:
+            if s.order_by or s.limit is not None:
+                raise ParserError(
+                    "ORDER BY/LIMIT must follow the last UNION branch")
+        last = selects[-1]
+        u = ast.UnionStmt(selects, alls, last.order_by, last.limit,
+                          last.offset)
+        last.order_by, last.limit, last.offset = [], None, None
+        return u
+
     def parse_select(self) -> ast.SelectStmt:
         self.expect_kw("SELECT")
         distinct = self.accept_kw("DISTINCT")
@@ -256,11 +277,14 @@ class Parser:
             items.append(self.parse_select_item())
         table = None
         database = None
+        from_item = None
         if self.accept_kw("FROM"):
-            table = self.expect_ident()
-            if self.accept_op("."):   # db.table — db qualifier recorded
-                database = table
-                table = self.expect_ident()
+            from_item = self.parse_from_item()
+            if isinstance(from_item, ast.TableRef) and from_item.alias is None:
+                # plain single table: keep the fast-path fields populated
+                table = from_item.name
+                database = from_item.database
+                from_item = None
         where = self.parse_expr() if self.accept_kw("WHERE") else None
         group_by = []
         if self.accept_kw("GROUP"):
@@ -281,7 +305,51 @@ class Parser:
         if self.accept_kw("OFFSET"):
             offset = int(self.expect_number())
         return ast.SelectStmt(items, table, where, group_by, having,
-                              order_by, limit, offset, distinct, database)
+                              order_by, limit, offset, distinct, database,
+                              from_item)
+
+    def parse_from_item(self):
+        base = self.parse_table_factor()
+        while True:
+            k = self.kw()
+            if k == "CROSS":
+                self.next()
+                self.expect_kw("JOIN")
+                base = ast.Join(base, self.parse_table_factor(), "cross")
+            elif k in ("JOIN", "INNER", "LEFT", "RIGHT", "FULL"):
+                kind = "inner"
+                if k == "INNER":
+                    self.next()
+                elif k in ("LEFT", "RIGHT", "FULL"):
+                    kind = k.lower()
+                    self.next()
+                    self.accept_kw("OUTER")
+                self.expect_kw("JOIN")
+                right = self.parse_table_factor()
+                self.expect_kw("ON")
+                base = ast.Join(base, right, kind, self.parse_expr())
+            else:
+                return base
+
+    def parse_table_factor(self):
+        if self.accept_op("("):
+            sub = self.parse_query()
+            self.expect_op(")")
+            self.accept_kw("AS")
+            return ast.SubqueryRef(sub, self.expect_ident())
+        name = self.expect_ident()
+        database = None
+        if self.accept_op("."):
+            database, name = name, self.expect_ident()
+        alias = None
+        if self.accept_kw("AS"):
+            alias = self.expect_ident()
+        elif (self.peek().kind == "ident"
+              and self.kw() not in _RESERVED
+              and self.kw() not in ("GROUP", "HAVING", "ORDER", "LIMIT",
+                                    "OFFSET", "UNION")):
+            alias = self.next().value
+        return ast.TableRef(name, alias, database)
 
     def parse_select_item(self) -> ast.SelectItem:
         if self.accept_op("*"):
@@ -688,6 +756,11 @@ class Parser:
                 if self.kw() == "IN":
                     self.next()
                     self.expect_op("(")
+                    if self.kw() == "SELECT":
+                        sub = self.parse_query()
+                        self.expect_op(")")
+                        e = InSubquery(e, sub, negated)
+                        continue
                     vals = [_const_eval(self.parse_expr())]
                     while self.accept_op(","):
                         vals.append(_const_eval(self.parse_expr()))
@@ -746,6 +819,10 @@ class Parser:
             self.next()
             return Literal(t.value)
         if self.accept_op("("):
+            if self.kw() == "SELECT":
+                sub = self.parse_query()
+                self.expect_op(")")
+                return Subquery(sub)
             e = self.parse_expr()
             self.expect_op(")")
             return e
@@ -785,7 +862,7 @@ class Parser:
             if self.accept_op("("):
                 if self.accept_op("*"):
                     self.expect_op(")")
-                    return Func(name, [Literal("*")])
+                    return self._maybe_over(Func(name, [Literal("*")]))
                 args = []
                 if not self.accept_op(")"):
                     if self.accept_kw("DISTINCT"):
@@ -794,9 +871,33 @@ class Parser:
                     while self.accept_op(","):
                         args.append(self.parse_expr())
                     self.expect_op(")")
-                return Func(name, args)
+                return self._maybe_over(Func(name, args))
+            if self.accept_op("."):
+                # qualified column: alias.col (relational FROM scopes)
+                return Column(f"{name}.{self.expect_ident()}")
             return Column(name)
         raise ParserError(f"unexpected token {t.value!r} in expression")
+
+    def _maybe_over(self, f: Func) -> Expr:
+        """fn(...) [OVER (PARTITION BY ... ORDER BY ...)]"""
+        if self.kw() != "OVER":
+            return f
+        self.next()
+        self.expect_op("(")
+        partition_by: list = []
+        order_by: list = []
+        if self.accept_kw("PARTITION"):
+            self.expect_kw("BY")
+            partition_by.append(self.parse_expr())
+            while self.accept_op(","):
+                partition_by.append(self.parse_expr())
+        if self.accept_kw("ORDER"):
+            self.expect_kw("BY")
+            order_by.append(self.parse_order_item())
+            while self.accept_op(","):
+                order_by.append(self.parse_order_item())
+        self.expect_op(")")
+        return WindowFunc(f.name, f.args, partition_by, order_by)
 
 
 _RESERVED = {

@@ -1,0 +1,439 @@
+"""Relational operators over columnar results: join / union / window /
+host-side group-by.
+
+The reference gets JOIN/UNION/subqueries/window functions from DataFusion
+(query_server/query/src/sql/planner.rs lowers to DataFusion plans); here
+they run host-side over the numpy columns the scan layer produces. The
+single-table aggregate path stays on the fused device kernel (ops/fused);
+these operators compose ABOVE materialized relations, which is where the
+reference also runs them (DataFusion operators above TskvExec — SURVEY
+§3.3 "the part to push to TPU"; TSDB joins are small dimension joins, so
+host execution is the right default placement).
+
+A `Scope` is the working shape: display-ordered output columns plus an env
+that also exposes alias-qualified names ("a.col") for expression eval.
+"""
+from __future__ import annotations
+
+import copy
+
+import numpy as np
+
+from ..errors import PlanError
+from .expr import BinOp, Column, Expr, Func, WindowFunc
+
+
+class Scope:
+    """Columns of one relational stage.
+
+    names/cols: display order (SELECT * order); env: every addressable
+    name including alias-qualified forms."""
+
+    def __init__(self, names: list[str], cols: list, env: dict | None = None):
+        self.names = list(names)
+        self.cols = [np.asarray(c) for c in cols]
+        self.env = dict(env) if env is not None else \
+            {n: c for n, c in zip(self.names, self.cols)}
+
+    @classmethod
+    def from_relation(cls, names, cols, alias: str | None) -> "Scope":
+        s = cls(names, cols)
+        if alias:
+            for n, c in zip(s.names, s.cols):
+                s.env[f"{alias}.{n}"] = c
+        return s
+
+    @property
+    def n(self) -> int:
+        return len(self.cols[0]) if self.cols else 0
+
+    def filter(self, mask: np.ndarray) -> "Scope":
+        return Scope(self.names, [c[mask] for c in self.cols],
+                     {k: v[mask] for k, v in self.env.items()})
+
+    def take(self, idx: np.ndarray) -> "Scope":
+        return Scope(self.names, [c[idx] for c in self.cols],
+                     {k: v[idx] for k, v in self.env.items()})
+
+
+def _null_take(col: np.ndarray, idx: np.ndarray):
+    """col[idx] with idx == -1 yielding NULL (object None / float NaN);
+    int/bool columns promote to float so NaN can carry the null."""
+    missing = idx < 0
+    if not missing.any():
+        return col[idx]
+    safe = np.where(missing, 0, idx)
+    if len(col) == 0:
+        return (np.full(len(idx), None, dtype=object) if col.dtype == object
+                else np.full(len(idx), np.nan))
+    out = col[safe]
+    if col.dtype == object:
+        out = out.copy()
+        out[missing] = None
+        return out
+    if not np.issubdtype(out.dtype, np.floating):
+        out = out.astype(np.float64)
+    else:
+        out = out.copy()
+    out[missing] = np.nan
+    return out
+
+
+def _split_conjuncts(e: Expr | None) -> list[Expr]:
+    if e is None:
+        return []
+    if isinstance(e, BinOp) and e.op == "and":
+        return _split_conjuncts(e.left) + _split_conjuncts(e.right)
+    return [e]
+
+
+def _equi_keys(on: Expr | None, lscope: set[str], rscope: set[str]):
+    """Split ON into equi-join key pairs + residual conjuncts."""
+    keys, residual = [], []
+    for c in _split_conjuncts(on):
+        if isinstance(c, BinOp) and c.op == "=":
+            lc, rc = c.left.columns(), c.right.columns()
+            if lc and rc:
+                if lc <= lscope and rc <= rscope:
+                    keys.append((c.left, c.right))
+                    continue
+                if lc <= rscope and rc <= lscope:
+                    keys.append((c.right, c.left))
+                    continue
+        residual.append(c)
+    return keys, residual
+
+
+def _key_tuple(arrays: list, i: int) -> tuple:
+    return tuple(a[i].item() if hasattr(a[i], "item") else a[i]
+                 for a in arrays)
+
+
+def hash_join(left: Scope, right: Scope, kind: str,
+              on: Expr | None) -> Scope:
+    """Hash equi-join with residual filter; inner/left/right/full/cross
+    (reference defers to DataFusion's HashJoinExec)."""
+    if kind != "cross" and on is None:
+        raise PlanError("JOIN requires an ON condition (use CROSS JOIN)")
+    keys, residual = ([], []) if kind == "cross" else \
+        _equi_keys(on, set(left.env), set(right.env))
+    ln, rn = left.n, right.n
+    if keys:
+        lkeys = [np.asarray(le.eval(left.env, np)) for le, _ in keys]
+        rkeys = [np.asarray(re.eval(right.env, np)) for _, re in keys]
+        table: dict = {}
+        for j in range(rn):
+            table.setdefault(_key_tuple(rkeys, j), []).append(j)
+        li_l, ri_l = [], []
+        for i in range(ln):
+            for j in table.get(_key_tuple(lkeys, i), ()):
+                li_l.append(i)
+                ri_l.append(j)
+        li = np.asarray(li_l, dtype=np.int64)
+        ri = np.asarray(ri_l, dtype=np.int64)
+    else:
+        li = np.repeat(np.arange(ln, dtype=np.int64), rn)
+        ri = np.tile(np.arange(rn, dtype=np.int64), ln)
+
+    if residual and len(li):
+        env = {}
+        for k, v in right.env.items():
+            env[k] = v[ri]
+        for k, v in left.env.items():
+            env[k] = v[li]   # left wins bare-name collisions
+        mask = np.ones(len(li), dtype=bool)
+        for c in residual:
+            m = np.asarray(c.eval(env, np))
+            mask &= m if m.shape else np.full(len(li), bool(m))
+        li, ri = li[mask], ri[mask]
+
+    if kind in ("left", "full"):
+        matched = np.zeros(ln, dtype=bool)
+        matched[li[li >= 0]] = True
+        extra = np.nonzero(~matched)[0]
+        li = np.concatenate([li, extra])
+        ri = np.concatenate([ri, np.full(len(extra), -1, dtype=np.int64)])
+    if kind in ("right", "full"):
+        matched = np.zeros(rn, dtype=bool)
+        matched[ri[ri >= 0]] = True
+        extra = np.nonzero(~matched)[0]
+        li = np.concatenate([li, np.full(len(extra), -1, dtype=np.int64)])
+        ri = np.concatenate([ri, extra])
+
+    names, cols, env = [], [], {}
+    taken_l = {k: _null_take(v, li) for k, v in left.env.items()}
+    taken_r = {k: _null_take(v, ri) for k, v in right.env.items()}
+    for n_ in left.names:
+        names.append(n_)
+        cols.append(taken_l[n_])
+    for n_ in right.names:
+        names.append(n_)
+        cols.append(taken_r[n_])
+    env.update(taken_r)
+    env.update(taken_l)   # left wins bare-name collisions
+    return Scope(names, cols, env)
+
+
+# ---------------------------------------------------------------------------
+# host group-by (relational path; the single-table path uses fused kernels)
+# ---------------------------------------------------------------------------
+def group_indices(key_cols: list, n: int):
+    """→ (group id per row [n], representative row per group)."""
+    if n == 0:
+        return np.zeros(0, dtype=np.int64), np.zeros(0, dtype=np.int64)
+    if not key_cols:
+        return np.zeros(n, dtype=np.int64), np.zeros(1, dtype=np.int64)
+    ids = None
+    for kc in key_cols:
+        kc = np.asarray(kc)
+        _, inv = np.unique(kc.astype("U") if kc.dtype == object else kc,
+                           return_inverse=True)
+        card = int(inv.max()) + 1
+        ids = inv.astype(np.int64) if ids is None else ids * card + inv
+    _, first_idx, gid = np.unique(ids, return_index=True, return_inverse=True)
+    return gid.astype(np.int64), first_idx.astype(np.int64)
+
+
+def host_aggregate(func: str, col, gid: np.ndarray, n_groups: int,
+                   distinct: bool = False):
+    """One aggregate over grouped rows (relational/host path)."""
+    func = func.lower()
+    if func == "count" and col is None:
+        return np.bincount(gid, minlength=n_groups).astype(np.int64)
+    if col is None:
+        raise PlanError(f"aggregate {func} needs an argument")
+    col = np.asarray(col)
+    if col.dtype == object:
+        valid = np.array([v is not None for v in col], dtype=bool)
+    elif np.issubdtype(col.dtype, np.floating):
+        valid = ~np.isnan(col)
+    else:
+        valid = np.ones(len(col), dtype=bool)
+    g, v = gid[valid], col[valid]
+    if func == "count":
+        if distinct:
+            out = np.zeros(n_groups, dtype=np.int64)
+            seen: dict[int, set] = {}
+            for i in range(len(g)):
+                seen.setdefault(int(g[i]), set()).add(
+                    v[i] if col.dtype == object else v[i].item())
+            for k, s in seen.items():
+                out[k] = len(s)
+            return out
+        return np.bincount(g, minlength=n_groups).astype(np.int64)
+    if func in ("sum", "avg", "mean"):
+        s = np.bincount(g, weights=v.astype(np.float64), minlength=n_groups)
+        if func == "sum":
+            return s
+        c = np.bincount(g, minlength=n_groups)
+        with np.errstate(invalid="ignore", divide="ignore"):
+            out = s / np.maximum(c, 1)
+        out[c == 0] = np.nan
+        return out
+    if func in ("min", "max"):
+        if col.dtype == object:
+            out = np.full(n_groups, None, dtype=object)
+            for i in range(len(g)):
+                cur = out[g[i]]
+                if cur is None or (func == "min" and v[i] < cur) \
+                        or (func == "max" and v[i] > cur):
+                    out[g[i]] = v[i]
+            return out
+        out = np.full(n_groups, np.nan)
+        red = np.fmin if func == "min" else np.fmax
+        for i in range(len(g)):
+            out[g[i]] = v[i] if np.isnan(out[g[i]]) else \
+                red(out[g[i]], v[i])
+        if np.issubdtype(col.dtype, np.integer) and not np.isnan(out).any():
+            return out.astype(col.dtype)
+        return out
+    raise PlanError(f"unsupported aggregate {func!r} over joined relations")
+
+
+# ---------------------------------------------------------------------------
+# expression tree utilities (agg / window discovery + rewrite)
+# ---------------------------------------------------------------------------
+_CHILD_ATTRS = ("left", "right", "operand", "expr", "low", "high")
+
+
+def walk_exprs(e, fn):
+    """Depth-first visit of every Expr node."""
+    if not isinstance(e, Expr):
+        return
+    fn(e)
+    for attr in _CHILD_ATTRS:
+        child = getattr(e, attr, None)
+        if isinstance(child, Expr):
+            walk_exprs(child, fn)
+    for a in getattr(e, "args", None) or []:
+        walk_exprs(a, fn)
+
+
+def rewrite_exprs(e, pred, replace):
+    """Copy-on-write rewrite: nodes matching pred become replace(node)."""
+    if not isinstance(e, Expr):
+        return e
+    if pred(e):
+        return replace(e)
+    out = copy.copy(e)
+    for attr in _CHILD_ATTRS:
+        child = getattr(e, attr, None)
+        if isinstance(child, Expr):
+            setattr(out, attr, rewrite_exprs(child, pred, replace))
+    if getattr(e, "args", None):
+        out.args = [rewrite_exprs(a, pred, replace) for a in e.args]
+    return out
+
+
+def contains_window(e) -> bool:
+    found = []
+    walk_exprs(e, lambda x: found.append(x) if isinstance(x, WindowFunc)
+               else None)
+    return bool(found)
+
+
+def collect_aggs(e, agg_names: set) -> list:
+    """Top-level aggregate calls (not recursing INTO them — their args are
+    row-level expressions)."""
+    out = []
+
+    def visit(x):
+        if isinstance(x, Func) and not isinstance(x, WindowFunc) \
+                and x.name.lower() in agg_names:
+            out.append(x)
+            return
+        for attr in _CHILD_ATTRS:
+            child = getattr(x, attr, None)
+            if isinstance(child, Expr):
+                visit(child)
+        for a in getattr(x, "args", None) or []:
+            if isinstance(a, Expr):
+                visit(a)
+
+    if isinstance(e, Expr):
+        visit(e)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# window functions
+# ---------------------------------------------------------------------------
+_RANKERS = {"row_number", "rank", "dense_rank"}
+_OFFSETS = {"lag", "lead"}
+_VALUES = {"first_value", "last_value"}
+_WINAGGS = {"sum", "avg", "mean", "min", "max", "count"}
+
+WINDOW_FUNCS = _RANKERS | _OFFSETS | _VALUES | _WINAGGS
+
+
+def eval_window(wf: WindowFunc, env: dict, n: int) -> np.ndarray:
+    """Evaluate one window function over an n-row scope.
+
+    SQL default frame semantics: ranking functions require ORDER BY;
+    aggregates are running when ORDER BY is present (UNBOUNDED PRECEDING
+    .. CURRENT ROW) and whole-partition otherwise."""
+    name = wf.name.lower()
+    if n == 0:
+        return np.zeros(0, dtype=np.int64 if name in _RANKERS else np.float64)
+    part_cols = [np.asarray(e.eval(env, np)) for e in (wf.partition_by or [])]
+    gid, _ = group_indices(part_cols, n)
+    order_keys = []
+    for e, asc in reversed(wf.order_by or []):
+        v = np.asarray(e.eval(env, np))
+        if not asc:
+            _, inv = np.unique(v, return_inverse=True)
+            v = -inv.astype(np.int64)
+        order_keys.append(v)
+    order_keys.append(gid)
+    perm = np.lexsort(order_keys)  # partition-major, order-keyed inside
+    sorted_gid = gid[perm]
+    starts = np.nonzero(np.r_[True, sorted_gid[1:] != sorted_gid[:-1]])[0]
+    ends = np.r_[starts[1:], n]
+    out = np.empty(n, dtype=np.float64)
+
+    def ordered_vals(e: Expr):
+        return np.asarray(e.eval(env, np))[perm]
+
+    if name in _RANKERS:
+        if not wf.order_by:
+            raise PlanError(f"{name}() requires ORDER BY in OVER()")
+        keys = [ordered_vals(e) for e, _ in wf.order_by]
+        res = np.empty(n, dtype=np.int64)
+        for s, e_ in zip(starts, ends):
+            if name == "row_number":
+                res[perm[s:e_]] = np.arange(1, e_ - s + 1)
+                continue
+            r = d = 1
+            for i in range(s, e_):
+                if i > s and not all(
+                        np.array_equal(k[i], k[i - 1]) for k in keys):
+                    r = (i - s) + 1
+                    d += 1
+                res[perm[i]] = r if name == "rank" else d
+        return res
+
+    if name in _OFFSETS:
+        src = ordered_vals(wf.args[0])
+        offset = int(wf.args[1].eval({}, np)) if len(wf.args) > 1 else 1
+        default = wf.args[2].eval({}, np) if len(wf.args) > 2 else None
+        shift = offset if name == "lag" else -offset
+        res = np.empty(n, dtype=object)
+        for s, e_ in zip(starts, ends):
+            seg = src[s:e_]
+            for i in range(len(seg)):
+                j = i - shift
+                res[perm[s + i]] = seg[j] if 0 <= j < len(seg) else default
+        if src.dtype != object and default is None:
+            resf = np.array([np.nan if x is None else x for x in res],
+                            dtype=np.float64)
+            return resf
+        return res
+
+    if name in _VALUES:
+        src = ordered_vals(wf.args[0])
+        res = np.empty(n, dtype=object if src.dtype == object else src.dtype)
+        for s, e_ in zip(starts, ends):
+            res[perm[s:e_]] = src[s] if name == "first_value" else src[e_ - 1]
+        return res
+
+    if name in _WINAGGS:
+        star = (len(wf.args) == 1
+                and getattr(wf.args[0], "value", None) == "*")
+        src = None if (name == "count" and star) else ordered_vals(wf.args[0])
+        cumulative = bool(wf.order_by)
+        for s, e_ in zip(starts, ends):
+            seg = None if src is None else src[s:e_]
+            if name == "count":
+                if seg is None:
+                    vals = (np.arange(1, e_ - s + 1) if cumulative
+                            else np.full(e_ - s, e_ - s))
+                else:
+                    ok = (np.array([x is not None for x in seg])
+                          if seg.dtype == object
+                          else ~np.isnan(seg.astype(np.float64)))
+                    vals = (np.cumsum(ok) if cumulative
+                            else np.full(e_ - s, int(ok.sum())))
+                out[perm[s:e_]] = vals
+                continue
+            segf = seg.astype(np.float64)
+            if cumulative:
+                if name in ("sum", "avg", "mean"):
+                    cs = np.nancumsum(segf)
+                    if name == "sum":
+                        vals = cs
+                    else:
+                        cnt = np.cumsum(~np.isnan(segf))
+                        vals = cs / np.maximum(cnt, 1)
+                elif name == "min":
+                    vals = np.fmin.accumulate(segf)
+                else:
+                    vals = np.fmax.accumulate(segf)
+            else:
+                agg = {"sum": np.nansum, "avg": np.nanmean,
+                       "mean": np.nanmean, "min": np.nanmin,
+                       "max": np.nanmax}[name](segf) if len(segf) else np.nan
+                vals = np.full(e_ - s, agg)
+            out[perm[s:e_]] = vals
+        return out
+
+    raise PlanError(f"unsupported window function {wf.name!r}")

@@ -1,0 +1,169 @@
+"""JOIN / UNION / subqueries / window functions (reference gets these from
+DataFusion — query_server/query/src/sql/planner.rs; here they run host-side
+over columnar scan results, sql/relational.py)."""
+import numpy as np
+import pytest
+
+from cnosdb_tpu.parallel.coordinator import Coordinator
+from cnosdb_tpu.parallel.meta import MetaStore
+from cnosdb_tpu.sql.executor import QueryExecutor
+from cnosdb_tpu.storage.engine import TsKv
+
+
+@pytest.fixture
+def db(tmp_path):
+    meta = MetaStore(str(tmp_path / "meta.json"))
+    engine = TsKv(str(tmp_path / "data"))
+    coord = Coordinator(meta, engine)
+    ex = QueryExecutor(meta, coord)
+    ex.execute_one("CREATE TABLE cpu (v DOUBLE, TAGS(host, region))")
+    ex.execute_one(
+        "INSERT INTO cpu (time, host, region, v) VALUES "
+        "(1, 'a', 'eu', 1.0), (2, 'b', 'eu', 2.0), "
+        "(3, 'c', 'us', 3.0), (4, 'a', 'us', 4.0)")
+    ex.execute_one("CREATE TABLE hostinfo (owner STRING, TAGS(host))")
+    ex.execute_one("INSERT INTO hostinfo (time, host, owner) VALUES "
+                   "(1, 'a', 'alice'), (1, 'b', 'bob')")
+    yield ex
+    coord.close()
+
+
+def rows(rs, *cols):
+    return list(zip(*[rs.columns[c].tolist() for c in cols]))
+
+
+def test_inner_join(db):
+    rs = db.execute_one("SELECT c.host, c.v, h.owner FROM cpu c "
+                        "JOIN hostinfo h ON c.host = h.host ORDER BY c.v")
+    assert rs.columns[0].tolist() == ["a", "b", "a"]
+    assert rs.columns[2].tolist() == ["alice", "bob", "alice"]
+
+
+def test_left_join_null_fill_and_null_ordering(db):
+    rs = db.execute_one(
+        "SELECT c.host, h.owner FROM cpu c LEFT JOIN hostinfo h "
+        "ON c.host = h.host ORDER BY c.host, h.owner")
+    got = rows(rs, 0, 1)
+    assert got == [("a", "alice"), ("a", "alice"), ("b", "bob"), ("c", None)]
+
+
+def test_full_and_cross_join(db):
+    db.execute_one("INSERT INTO hostinfo (time, host, owner) VALUES "
+                   "(1, 'z', 'zed')")
+    rs = db.execute_one("SELECT c.host, h.owner FROM cpu c "
+                        "FULL JOIN hostinfo h ON c.host = h.host")
+    pairs = set(rows(rs, 0, 1))
+    assert (None, "zed") in pairs and ("c", None) in pairs
+    rs = db.execute_one("SELECT count(*) FROM cpu c CROSS JOIN hostinfo h")
+    assert rs.columns[0][0] == 12
+
+
+def test_group_by_over_join(db):
+    rs = db.execute_one(
+        "SELECT h.owner, count(*), sum(c.v) FROM cpu c "
+        "JOIN hostinfo h ON c.host = h.host GROUP BY h.owner ORDER BY h.owner")
+    assert rows(rs, 0, 1, 2) == [("alice", 2, 5.0), ("bob", 1, 2.0)]
+
+
+def test_group_by_order_by_aggregate(db):
+    rs = db.execute_one(
+        "SELECT c.region, count(*) FROM cpu c JOIN hostinfo h "
+        "ON c.host = h.host GROUP BY c.region ORDER BY count(*) DESC")
+    assert rows(rs, 0, 1) == [("eu", 2), ("us", 1)]
+
+
+def test_having_over_join(db):
+    rs = db.execute_one(
+        "SELECT h.owner, sum(c.v) s FROM cpu c JOIN hostinfo h "
+        "ON c.host = h.host GROUP BY h.owner HAVING sum(c.v) > 3")
+    assert rows(rs, 0, 1) == [("alice", 5.0)]
+
+
+def test_union_and_union_all(db):
+    rs = db.execute_one("SELECT host FROM cpu WHERE region = 'eu' "
+                        "UNION SELECT host FROM cpu WHERE host = 'a' "
+                        "ORDER BY host")
+    assert rs.columns[0].tolist() == ["a", "b"]
+    rs = db.execute_one("SELECT host FROM cpu WHERE region = 'eu' "
+                        "UNION ALL SELECT host FROM cpu WHERE host = 'a' "
+                        "ORDER BY host")
+    assert rs.columns[0].tolist() == ["a", "a", "a", "b"]
+
+
+def test_scalar_subquery(db):
+    rs = db.execute_one("SELECT host, v FROM cpu "
+                        "WHERE v > (SELECT avg(v) FROM cpu) ORDER BY v")
+    assert rs.columns[0].tolist() == ["c", "a"]
+
+
+def test_in_subquery(db):
+    rs = db.execute_one("SELECT count(*) FROM cpu "
+                        "WHERE host IN (SELECT host FROM hostinfo)")
+    assert rs.columns[0][0] == 3
+    rs = db.execute_one("SELECT count(*) FROM cpu "
+                        "WHERE host NOT IN (SELECT host FROM hostinfo)")
+    assert rs.columns[0][0] == 1
+
+
+def test_from_subquery(db):
+    rs = db.execute_one(
+        "SELECT t.host FROM (SELECT host, v FROM cpu WHERE v >= 2) t "
+        "WHERE t.v < 4 ORDER BY t.host")
+    assert rs.columns[0].tolist() == ["b", "c"]
+
+
+def test_row_number_partitioned(db):
+    rs = db.execute_one(
+        "SELECT host, v, row_number() OVER "
+        "(PARTITION BY region ORDER BY v DESC) rn FROM cpu ORDER BY host, v")
+    got = set(rows(rs, 0, 1, 2))
+    assert {("a", 1.0, 2), ("b", 2.0, 1), ("c", 3.0, 2),
+            ("a", 4.0, 1)} <= got
+
+
+def test_cumulative_sum_window(db):
+    rs = db.execute_one(
+        "SELECT v, sum(v) OVER (ORDER BY time) s FROM cpu ORDER BY time")
+    assert rs.columns[1].tolist() == [1.0, 3.0, 6.0, 10.0]
+
+
+def test_whole_partition_aggregate_window(db):
+    rs = db.execute_one(
+        "SELECT region, v, avg(v) OVER (PARTITION BY region) a "
+        "FROM cpu ORDER BY time")
+    assert rs.columns[2].tolist() == [1.5, 1.5, 3.5, 3.5]
+
+
+def test_lag_lead(db):
+    rs = db.execute_one(
+        "SELECT v, lag(v) OVER (ORDER BY time) p, "
+        "lead(v) OVER (ORDER BY time) n FROM cpu ORDER BY time")
+    p, n = rs.columns[1].tolist(), rs.columns[2].tolist()
+    assert np.isnan(p[0]) and p[1:] == [1.0, 2.0, 3.0]
+    assert n[:3] == [2.0, 3.0, 4.0] and np.isnan(n[3])
+
+
+def test_rank_dense_rank_ties(db):
+    db.execute_one("INSERT INTO cpu (time, host, region, v) VALUES "
+                   "(5, 'd', 'us', 3.0)")
+    rs = db.execute_one(
+        "SELECT host, rank() OVER (ORDER BY v) r, "
+        "dense_rank() OVER (ORDER BY v) d FROM cpu ORDER BY v, host")
+    assert rows(rs, 0, 1, 2) == [("a", 1, 1), ("b", 2, 2), ("c", 3, 3),
+                                 ("d", 3, 3), ("a", 5, 4)]
+
+
+def test_first_value(db):
+    rs = db.execute_one(
+        "SELECT host, first_value(v) OVER (PARTITION BY region "
+        "ORDER BY time) f FROM cpu WHERE region = 'eu' ORDER BY time")
+    assert rs.columns[1].tolist() == [1.0, 1.0]
+
+
+def test_window_over_aggregate_subquery(db):
+    """Windows over grouped results compose via FROM subquery."""
+    rs = db.execute_one(
+        "SELECT t.region, rank() OVER (ORDER BY t.s DESC) r FROM "
+        "(SELECT region, sum(v) s FROM cpu GROUP BY region) t "
+        "ORDER BY r")
+    assert rows(rs, 0, 1) == [("us", 1), ("eu", 2)]
