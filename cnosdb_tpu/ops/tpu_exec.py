@@ -33,6 +33,7 @@ class AggSpec:
     func: str               # count/count_star/sum/mean/min/max/first/last
     column: str | None      # None for count(*)
     alias: str
+    param: object = None    # extra constant arg (e.g. sample size k)
 
     _NEEDS = {
         "count": {"want_count": True},

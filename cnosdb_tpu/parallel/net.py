@@ -142,7 +142,7 @@ def rpc_call(addr: str, method: str, payload: dict | None = None,
     retried — the server may have fully applied a non-idempotent mutation
     whose reply was lost, and re-executing it would double-apply."""
     body = pack(payload or {})
-    for attempt in (0, 1):
+    while True:
         conn, reused = _pool.get(addr, timeout)
         conn.timeout = timeout
         if conn.sock is not None:
@@ -156,8 +156,10 @@ def rpc_call(addr: str, method: str, payload: dict | None = None,
         except (ConnectionError, http.client.HTTPException, OSError,
                 TimeoutError) as e:
             conn.close()
-            if reused and attempt == 0 and not isinstance(
-                    e, (TimeoutError, socket_timeout)):
+            if reused and not isinstance(e, (TimeoutError, socket_timeout)):
+                # stale keep-alive: safe to retry; loop is bounded because
+                # each iteration drains one pooled conn and a fresh conn's
+                # failure raises
                 continue
             raise RpcUnavailable(f"{method}@{addr}: {e}") from e
         _pool.put(addr, conn)

@@ -565,11 +565,68 @@ class QueryExecutor:
         if is_system_db(db):
             names, cols = system_table(self, db, table, session)
             return self._select_over_env(stmt, names, cols)
+        if (len(stmt.items) == 1 and isinstance(stmt.items[0].expr, Func)
+                and stmt.items[0].expr.name.lower() in _REPAIR_FUNCS):
+            return self._ts_gen_func(stmt, session)
         schema = self.meta.table(session.tenant, db, table)
         plan = plan_select(stmt, schema)
         if isinstance(plan, AggregatePlan):
             return self._exec_aggregate(plan, session.tenant, db)
         return self._exec_raw(plan, session.tenant, db)
+
+    def _ts_gen_func(self, stmt: ast.SelectStmt, session: Session):
+        """Row-set-valued data repair (reference ts_gen_func/data_repair/:
+        timestamp_repair/value_fill/value_repair run as a dedicated exec
+        node over the scanned series; here a raw time-ordered scan feeds
+        the numpy implementations in sql.tsfuncs).
+
+        Form: SELECT <fn>(time, value[, 'k=v,k=v']) FROM t [WHERE ...]"""
+        from . import tsfuncs
+
+        f = stmt.items[0].expr
+        name = f.name.lower()
+        if stmt.group_by or stmt.having is not None or stmt.distinct:
+            raise PlanError(
+                f"{name} does not support GROUP BY/HAVING/DISTINCT — "
+                "restrict the series with WHERE instead")
+        args = list(f.args)
+        opts: dict[str, str] = {}
+        if args and isinstance(args[-1], Literal) \
+                and isinstance(args[-1].value, str):
+            for kv in args.pop().value.split(","):
+                if "=" in kv:
+                    k, _, v = kv.partition("=")
+                    opts[k.strip()] = v.strip()
+        if len(args) != 2 or not isinstance(args[1], Column):
+            raise PlanError(f"{name}(time, value[, 'options']) expected")
+        value_col = args[1].name
+        base = ast.SelectStmt(
+            items=[ast.SelectItem(Column("time")),
+                   ast.SelectItem(Column(value_col))],
+            table=stmt.table, where=stmt.where, database=stmt.database,
+            order_by=[(Column("time"), True)])
+        rs = self._select(base, session)
+        ts = rs.columns[0].astype(np.int64)
+        vals = rs.columns[1].astype(np.float64)
+        if name == "timestamp_repair":
+            interval = int(opts["interval"]) if "interval" in opts else None
+            new_ts, new_vals = tsfuncs.timestamp_repair(
+                ts, vals, method=opts.get("method", "median"),
+                interval=interval)
+        elif name == "value_fill":
+            new_ts = ts
+            new_vals = tsfuncs.value_fill(ts, vals,
+                                          method=opts.get("method", "linear"))
+        else:
+            new_ts = ts
+            new_vals = tsfuncs.value_repair(
+                ts, vals,
+                min_speed=float(opts["min_speed"]) if "min_speed" in opts else None,
+                max_speed=float(opts["max_speed"]) if "max_speed" in opts else None)
+        alias = stmt.items[0].alias or value_col
+        out = ResultSet(["time", alias], [new_ts, new_vals])
+        env = {"time": new_ts, alias: new_vals, value_col: new_vals}
+        return _order_limit(out, stmt.order_by, stmt.limit, stmt.offset, env)
 
     # ------------------------------------------------------- relational path
     def _needs_relational(self, stmt: ast.SelectStmt) -> bool:
@@ -737,9 +794,7 @@ class QueryExecutor:
             v = unwin(it.expr).eval(env, np)
             if np.isscalar(v) or getattr(v, "shape", None) == ():
                 v = np.full(scope.n, v)
-            out_names.append(it.alias or
-                             (it.expr.name if isinstance(it.expr, Column)
-                              else it.expr.to_sql()))
+            out_names.append(_out_name(it))
             out_cols.append(np.asarray(v))
         rs = ResultSet(out_names, out_cols)
         env_all = dict(env)
@@ -810,9 +865,7 @@ class QueryExecutor:
             v = e.eval(genv, np)
             if np.isscalar(v) or getattr(v, "shape", None) == ():
                 v = np.full(n_groups, v)
-            out_names.append(it.alias or
-                             (it.expr.name if isinstance(it.expr, Column)
-                              else it.expr.to_sql()))
+            out_names.append(_out_name(it))
             out_cols.append(np.asarray(v))
         rs = ResultSet(out_names, out_cols)
         env_all = dict(genv)
@@ -826,9 +879,16 @@ class QueryExecutor:
     def _distinct(self, rs: ResultSet) -> ResultSet:
         seen = set()
         keep = []
+        nan_token = object()  # NaN keys must compare equal (SQL: NULLs are
+        # not distinct from each other; outer-join padding is NaN)
         for i in range(rs.n_rows):
-            key = tuple(c[i] if c.dtype == object else c[i].item()
-                        for c in rs.columns)
+            key = []
+            for c in rs.columns:
+                v = c[i] if c.dtype == object else c[i].item()
+                if isinstance(v, float) and v != v:
+                    v = nan_token
+                key.append(v)
+            key = tuple(key)
             if key not in seen:
                 seen.add(key)
                 keep.append(i)
@@ -890,7 +950,7 @@ class QueryExecutor:
             tenant, db, plan.table, time_ranges=plan.time_ranges,
             tag_domains=plan.tag_domains, field_names=needed_fields)
 
-        host_funcs = ("count_distinct", "collect")
+        host_funcs = ("count_distinct", "collect", "collect_ts")
         q = TpuQuery(filter=plan.filter, group_tags=plan.group_tags,
                      time_bucket=plan.bucket,
                      aggs=[a for a in phys_aggs if a.func not in host_funcs])
@@ -980,7 +1040,12 @@ class QueryExecutor:
                 v = _apply_finalizer(spec, acc[k])
                 vals.append(v)
                 valids.append(v is not None)
-            arr = np.array([v if v is not None else np.nan for v in vals])
+            if any(isinstance(v, (dict, list, str)) for v in vals):
+                # composite results (gauge/state data, samples): object col
+                arr = np.empty(len(vals), dtype=object)
+                arr[:] = vals
+            else:
+                arr = np.array([v if v is not None else np.nan for v in vals])
             env[alias] = arr
             env[f"__valid__:{alias}"] = np.array(valids, dtype=bool)
 
@@ -1155,17 +1220,54 @@ def _decompose_aggs(aggs: list[AggSpec]):
             finalize[a.alias] = ("pass", want(a.func, a.column))
         elif a.func == "count_distinct":
             finalize[a.alias] = ("distinct", want("count_distinct", a.column))
-        elif a.func == "increase":
-            # last - first over the window (counter-reset handling is a
-            # noted gap vs the reference's increase UDAF)
-            f = want("first", a.column)
-            l = want("last", a.column)
-            finalize[a.alias] = ("increase", f, l)
         elif a.func in ("median", "stddev", "mode"):
             finalize[a.alias] = (a.func, want("collect", a.column))
+        elif a.func in _SERIES_AGGS:
+            # whole-series aggregates: need the group's full time-ordered
+            # (ts, value) sequence (reference runs these as DataFusion
+            # accumulators, not decomposable partials)
+            finalize[a.alias] = ("series", a.func,
+                                 want("collect_ts", a.column), a.param)
         else:
             raise PlanError(f"aggregate {a.func!r} not supported yet")
     return phys, finalize
+
+
+# aggregates finalized from the full (ts, value) sequence via sql.tsfuncs
+_SERIES_AGGS = {"increase", "sample", "gauge_agg", "state_agg",
+                "compact_state_agg", "completeness", "consistency",
+                "timeliness", "validity"}
+
+# row-set-valued repair transforms (reference ts_gen_func)
+_REPAIR_FUNCS = {"timestamp_repair", "value_fill", "value_repair"}
+
+
+def _out_name(it: ast.SelectItem) -> str:
+    """Display name for a select item: SQL strips the relation qualifier
+    from a plain column reference (SELECT c.host → column \"host\")."""
+    if it.alias:
+        return it.alias
+    if isinstance(it.expr, Column):
+        return it.expr.name.rsplit(".", 1)[-1]
+    return it.expr.to_sql()
+
+
+def _series_finalize(func: str, ts: np.ndarray, vals: np.ndarray, param):
+    from . import tsfuncs
+
+    order = np.argsort(ts, kind="stable")
+    ts, vals = ts[order], np.asarray(vals)[order]
+    if func == "increase":
+        return tsfuncs.increase(ts, vals)
+    if func == "sample":
+        return tsfuncs.sample(vals, int(param or 1))
+    if func == "gauge_agg":
+        return tsfuncs.gauge_data(ts, vals)
+    if func == "state_agg":
+        return tsfuncs.state_data(ts, vals, compact=False)
+    if func == "compact_state_agg":
+        return tsfuncs.state_data(ts, vals, compact=True)
+    return tsfuncs.data_quality(func, ts, vals)
 
 
 def _apply_finalizer(spec, parts: dict):
@@ -1199,6 +1301,13 @@ def _apply_finalizer(spec, parts: dict):
             return float(np.std(vals, ddof=1)) if len(vals) > 1 else None
         uniq, counts = np.unique(vals, return_counts=True)
         return uniq[np.argmax(counts)]
+    if kind == "series":
+        chunks = parts.get(spec[2])
+        if not chunks:
+            return None
+        ts = np.concatenate([c[0] for c in chunks])
+        vals = np.concatenate([np.asarray(c[1]) for c in chunks])
+        return _series_finalize(spec[1], ts, vals, spec[3])
     raise ExecutionError(f"bad finalizer {spec!r}")
 
 
@@ -1314,7 +1423,7 @@ def _merge_distinct(acc: dict, batch, plan: AggregatePlan, spec: AggSpec):
     if plan.bucket is not None:
         origin, interval = plan.bucket
         buckets = origin + ((batch.ts - origin) // interval) * interval
-    collect = spec.func == "collect"
+    collect = spec.func in ("collect", "collect_ts")
     idxs = np.nonzero(mask)[0]
     if collect:
         # group indices first, slice values in bulk per group
@@ -1325,9 +1434,11 @@ def _merge_distinct(acc: dict, batch, plan: AggregatePlan, spec: AggSpec):
                 key = key + (int(buckets[i]),)
             group_rows.setdefault(key, []).append(i)
         arr = np.asarray(vals)
+        with_ts = spec.func == "collect_ts"
         for key, rows in group_rows.items():
             parts = acc.setdefault(key, {})
-            parts.setdefault(spec.alias, []).append(arr[rows])
+            chunk = (batch.ts[rows], arr[rows]) if with_ts else arr[rows]
+            parts.setdefault(spec.alias, []).append(chunk)
         return
     for i in idxs:
         key = tagmaps[batch.sid_ordinal[i]]
@@ -1438,23 +1549,9 @@ def _apply_gapfill(plan: AggregatePlan, rs: ResultSet) -> ResultSet:
     return ResultSet(rs.names, new_cols)
 
 
-def _null_safe_key(v: np.ndarray):
-    """→ (sortable values, null flags | None). Object columns with Nones
-    (outer-join padding) are not directly orderable; nulls ride a separate
-    flag key (NULLS LAST ascending, FIRST descending — DataFusion's
-    defaults, which the reference inherits)."""
-    v = np.asarray(v)
-    if v.dtype != object:
-        return v, None
-    nulls = np.array([x is None for x in v], dtype=np.int8)
-    vals = v
-    if nulls.any():
-        vals = np.array([("" if x is None else x) for x in v], dtype=object)
-    try:
-        vals = vals.astype("U")
-    except (TypeError, ValueError):
-        pass
-    return vals, (nulls if nulls.any() else None)
+# NULLS LAST ascending, FIRST descending — DataFusion's defaults, which
+# the reference inherits; shared with the window-function order keys
+_null_safe_key = rel.null_safe_key
 
 
 def _order_limit(rs: ResultSet, order_by, limit, offset, env) -> ResultSet:

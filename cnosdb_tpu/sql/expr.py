@@ -115,7 +115,20 @@ class BinOp(Expr):
         f = _BIN_OPS.get(self.op)
         if f is None:
             raise PlanError(f"unknown operator {self.op!r}")
-        return f(xp, self.left.eval(env, xp), self.right.eval(env, xp))
+        a = self.left.eval(env, xp)
+        b = self.right.eval(env, xp)
+        if a is None or b is None:
+            # SQL three-valued logic: NULL compares unknown (false as a
+            # filter, e.g. an empty scalar subquery); NULL arithmetic is
+            # NULL
+            if self.op in ("=", "!=", "<", "<=", ">", ">="):
+                other = b if a is None else a
+                shape = getattr(other, "shape", None)
+                if shape:
+                    return xp.zeros(shape, dtype=bool)
+                return False
+            return None
+        return f(xp, a, b)
 
     def columns(self):
         return self.left.columns() | self.right.columns()
@@ -315,6 +328,72 @@ class Func(Expr):
         return f"{self.name}({', '.join(a.to_sql() for a in self.args)})"
 
 
+def _obj_func(fn, *, numeric: bool = True):
+    """Lift a python function over object columns (gauge/state composites
+    from sql.tsfuncs). Extra args arrive as evaluated scalars."""
+    def run(xp, arr, *rest):
+        import numpy as _np
+
+        rest = [r.item() if hasattr(r, "item") else r for r in rest]
+        if isinstance(arr, _np.ndarray):
+            vals = [None if x is None else fn(x, *rest) for x in arr]
+            if numeric:
+                if all(v is None or isinstance(v, (int, float)) for v in vals):
+                    if any(v is None for v in vals):
+                        return _np.array([_np.nan if v is None else float(v)
+                                          for v in vals])
+                    if all(isinstance(v, int) for v in vals):
+                        return _np.array(vals, dtype=_np.int64)
+                    return _np.array(vals, dtype=_np.float64)
+            out = _np.empty(len(vals), dtype=object)
+            out[:] = vals
+            return out
+        return None if arr is None else fn(arr, *rest)
+    return run
+
+
+def _binary_obj_func(fn):
+    """Pairwise lift for two-geometry scalars (st_distance)."""
+    def run(xp, a, b, *rest):
+        import numpy as _np
+
+        if isinstance(a, _np.ndarray) or isinstance(b, _np.ndarray):
+            n = len(a) if isinstance(a, _np.ndarray) else len(b)
+            aa = a if isinstance(a, _np.ndarray) else [a] * n
+            bb = b if isinstance(b, _np.ndarray) else [b] * n
+            return _np.array([
+                _np.nan if (x is None or y is None) else fn(x, y, *rest)
+                for x, y in zip(aa, bb)])
+        if a is None or b is None:
+            return None
+        return fn(a, b, *rest)
+    return run
+
+
+def _register_tsfuncs():
+    """Gauge/state accessors + GIS scalars (reference scalar_function/
+    gauge/*.rs, duration_in.rs, state_at.rs, gis/*.rs). Registered lazily
+    at module bottom to avoid an import cycle with sql.tsfuncs."""
+    from . import tsfuncs as tf
+
+    Func._FUNCS.update({
+        "delta": _obj_func(tf.gauge_delta),
+        "time_delta": _obj_func(tf.gauge_time_delta),
+        "rate": _obj_func(tf.gauge_rate),
+        "first_val": _obj_func(lambda g: g["first"][1]),
+        "last_val": _obj_func(lambda g: g["last"][1]),
+        "first_time": _obj_func(lambda g: g["first"][0]),
+        "last_time": _obj_func(lambda g: g["last"][0]),
+        "idelta_left": _obj_func(tf.gauge_idelta_left),
+        "idelta_right": _obj_func(tf.gauge_idelta_right),
+        "num_elements": _obj_func(lambda g: g["num_elements"]),
+        "duration_in": _obj_func(tf.duration_in),
+        "state_at": _obj_func(tf.state_at, numeric=False),
+        "st_distance": _binary_obj_func(tf.st_distance),
+        "st_area": _obj_func(tf.st_area),
+    })
+
+
 @dataclass(repr=False)
 class Subquery(Expr):
     """Uncorrelated scalar subquery — the executor resolves it to a Literal
@@ -442,3 +521,6 @@ def _col_lit(e: BinOp):
     if isinstance(e.left, Literal) and isinstance(e.right, Column):
         return e.right.name, e.left.value, flip[e.op]
     return None, None, None
+
+
+_register_tsfuncs()

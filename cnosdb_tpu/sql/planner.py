@@ -27,7 +27,15 @@ from .expr import (
 from .parser import parse_timestamp_string
 
 AGG_FUNCS = {"count", "sum", "avg", "mean", "min", "max", "first", "last",
-             "median", "stddev", "mode", "increase", "count_distinct"}
+             "median", "stddev", "mode", "increase", "count_distinct",
+             "sample", "gauge_agg", "state_agg", "compact_state_agg",
+             "completeness", "consistency", "timeliness", "validity"}
+
+# aggregates taking the reference's (time, value) signature whose leading
+# time argument is implicit here (the collect_ts partial always carries
+# timestamps): increase.rs:42-45, gauge/mod.rs, state_agg, data_quality
+TS_PAIR_AGGS = {"increase", "gauge_agg", "state_agg", "compact_state_agg",
+                "completeness", "consistency", "timeliness", "validity"}
 
 TIME_COL = "time"
 
@@ -274,6 +282,16 @@ class _AggCollector:
                         and f.args[0].value == "__distinct__")
         args = [a for a in f.args
                 if not (isinstance(a, Literal) and a.value == "__distinct__")]
+        param = None
+        if name in TS_PAIR_AGGS and len(args) == 2 \
+                and isinstance(args[0], Column) and args[0].name == TIME_COL:
+            args = args[1:]   # reference signature f(time, value)
+        if name == "sample":
+            if len(args) != 2 or not isinstance(args[1], Literal):
+                raise PlanError("sample(column, k) takes a column and a "
+                                "constant size")
+            param = int(args[1].value)
+            args = args[:1]
         if name == "count" and args and isinstance(args[0], Literal) \
                 and args[0].value == "*":
             col = None
@@ -287,12 +305,12 @@ class _AggCollector:
             if name != "count":
                 raise PlanError("DISTINCT only supported in count()")
             name = "count_distinct"
-        key = (name, col)
+        key = (name, col, param)
         if key in self._by_key:
             return self._by_key[key]
         alias = f"__agg{len(self.aggs)}"
         self.aggs.append(AggSpec(name if name != "count_star" else "count",
-                                 col, alias))
+                                 col, alias, param))
         self._by_key[key] = alias
         return alias
 

@@ -79,6 +79,23 @@ def _null_take(col: np.ndarray, idx: np.ndarray):
     return out
 
 
+def null_safe_key(v: np.ndarray):
+    """→ (sortable values, null flags | None) — object columns with Nones
+    are not directly orderable (shared with executor._order_limit)."""
+    v = np.asarray(v)
+    if v.dtype != object:
+        return v, None
+    nulls = np.array([x is None for x in v], dtype=np.int8)
+    vals = v
+    if nulls.any():
+        vals = np.array([("" if x is None else x) for x in v], dtype=object)
+    try:
+        vals = vals.astype("U")
+    except (TypeError, ValueError):
+        pass
+    return vals, (nulls if nulls.any() else None)
+
+
 def _split_conjuncts(e: Expr | None) -> list[Expr]:
     if e is None:
         return []
@@ -104,9 +121,16 @@ def _equi_keys(on: Expr | None, lscope: set[str], rscope: set[str]):
     return keys, residual
 
 
-def _key_tuple(arrays: list, i: int) -> tuple:
-    return tuple(a[i].item() if hasattr(a[i], "item") else a[i]
-                 for a in arrays)
+def _key_tuple(arrays: list, i: int) -> tuple | None:
+    """Row i's join key; None when any component is NULL — SQL equi-joins
+    never match on NULL (NULL = NULL is unknown)."""
+    out = []
+    for a in arrays:
+        v = a[i].item() if hasattr(a[i], "item") else a[i]
+        if v is None or (isinstance(v, float) and v != v):
+            return None
+        out.append(v)
+    return tuple(out)
 
 
 def hash_join(left: Scope, right: Scope, kind: str,
@@ -123,10 +147,13 @@ def hash_join(left: Scope, right: Scope, kind: str,
         rkeys = [np.asarray(re.eval(right.env, np)) for _, re in keys]
         table: dict = {}
         for j in range(rn):
-            table.setdefault(_key_tuple(rkeys, j), []).append(j)
+            k = _key_tuple(rkeys, j)
+            if k is not None:
+                table.setdefault(k, []).append(j)
         li_l, ri_l = [], []
         for i in range(ln):
-            for j in table.get(_key_tuple(lkeys, i), ()):
+            k = _key_tuple(lkeys, i)
+            for j in (table.get(k, ()) if k is not None else ()):
                 li_l.append(i)
                 ri_l.append(j)
         li = np.asarray(li_l, dtype=np.int64)
@@ -339,11 +366,13 @@ def eval_window(wf: WindowFunc, env: dict, n: int) -> np.ndarray:
     gid, _ = group_indices(part_cols, n)
     order_keys = []
     for e, asc in reversed(wf.order_by or []):
-        v = np.asarray(e.eval(env, np))
+        vals, nulls = null_safe_key(np.asarray(e.eval(env, np)))
         if not asc:
-            _, inv = np.unique(v, return_inverse=True)
-            v = -inv.astype(np.int64)
-        order_keys.append(v)
+            _, inv = np.unique(vals, return_inverse=True)
+            vals = -inv.astype(np.int64)
+        order_keys.append(vals)
+        if nulls is not None:
+            order_keys.append(nulls if asc else -nulls)
     order_keys.append(gid)
     perm = np.lexsort(order_keys)  # partition-major, order-keyed inside
     sorted_gid = gid[perm]

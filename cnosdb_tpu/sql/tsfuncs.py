@@ -1,0 +1,473 @@
+"""Time-series function families: counter increase, sampling, gauge/state
+aggregation, data-quality metrics, data repair, and GIS scalars.
+
+Behavior-parity with the reference's extension functions
+(query_server/query/src/extension/expr/):
+- increase: aggregate_function/increase.rs:82-107 — counter resets add the
+  post-reset value instead of a negative delta;
+- sample: aggregate_function/sample.rs — k-reservoir;
+- gauge_agg + accessors: aggregate_function/gauge/mod.rs:44-118;
+- state_agg / compact_state_agg, duration_in, state_at:
+  aggregate_function/state_agg/state_agg_data.rs:89-152;
+- completeness/consistency/timeliness/validity:
+  aggregate_function/data_quality/common.rs (NaN interpolation, windowed
+  timestamp anomaly detection, MAD outlier counting);
+- timestamp_repair / value_fill / value_repair:
+  ts_gen_func/data_repair/*.rs (median/mode interval reconstruction,
+  mean/previous/linear fill, SCREEN speed clamping);
+- st_* GIS: scalar_function/gis/ (WKT geometries).
+
+All functions are pure numpy over (time, value) arrays — they run host-side
+at aggregate finalize (whole-group context), which is also where the
+reference runs them (DataFusion accumulators, not the scan kernel).
+"""
+from __future__ import annotations
+
+import math
+import re
+
+import numpy as np
+
+from ..errors import FunctionError
+
+NS = 1_000_000_000
+
+
+# ---------------------------------------------------------------------------
+# counter increase (exact reset handling)
+# ---------------------------------------------------------------------------
+def increase(ts: np.ndarray, vals: np.ndarray) -> float | None:
+    """Counter increase with reset handling (increase.rs:98-103): a drop
+    means the counter restarted, so the post-reset value is the delta."""
+    if len(vals) == 0:
+        return None
+    v = np.asarray(vals, dtype=np.float64)
+    if len(v) == 1:
+        return 0.0
+    d = np.diff(v)
+    return float(np.where(d > 0, d, np.where(d < 0, v[1:], 0.0)).sum())
+
+
+# ---------------------------------------------------------------------------
+# sample (k-reservoir)
+# ---------------------------------------------------------------------------
+def sample(vals: np.ndarray, k: int) -> list:
+    """k-reservoir sample (sample.rs). Deterministic seed per call keeps
+    query results reproducible across replicas."""
+    n = len(vals)
+    if k <= 0:
+        raise FunctionError("sample size must be positive")
+
+    def plain(x):
+        return x.item() if hasattr(x, "item") else x
+
+    if n <= k:
+        return [plain(v) for v in vals]
+    rng = np.random.default_rng(abs(hash((n, k))) % (2**32))
+    idx = np.sort(rng.choice(n, size=k, replace=False))
+    return [plain(v) for v in np.asarray(vals)[idx]]
+
+
+# ---------------------------------------------------------------------------
+# gauge_agg
+# ---------------------------------------------------------------------------
+def gauge_data(ts: np.ndarray, vals: np.ndarray) -> dict | None:
+    """GaugeData (gauge/mod.rs): first/second/penultimate/last TSPoints."""
+    n = len(ts)
+    if n == 0:
+        return None
+    t = np.asarray(ts, dtype=np.int64)
+    v = np.asarray(vals, dtype=np.float64)
+    return {
+        "kind": "gauge",
+        "first": [int(t[0]), float(v[0])],
+        "second": [int(t[min(1, n - 1)]), float(v[min(1, n - 1)])],
+        "penultimate": [int(t[max(0, n - 2)]), float(v[max(0, n - 2)])],
+        "last": [int(t[-1]), float(v[-1])],
+        "num_elements": int(n),
+    }
+
+
+def gauge_delta(g: dict) -> float:
+    return g["last"][1] - g["first"][1]
+
+
+def gauge_time_delta(g: dict) -> int:
+    return g["last"][0] - g["first"][0]
+
+
+def gauge_rate(g: dict) -> float | None:
+    td = gauge_time_delta(g)
+    if td == 0:
+        return None
+    return gauge_delta(g) / float(td)
+
+
+def gauge_idelta_left(g: dict) -> float:
+    return g["second"][1] - g["first"][1]
+
+
+def gauge_idelta_right(g: dict) -> float:
+    return g["last"][1] - g["penultimate"][1]
+
+
+# ---------------------------------------------------------------------------
+# state_agg / compact_state_agg
+# ---------------------------------------------------------------------------
+def state_data(ts: np.ndarray, states: np.ndarray,
+               compact: bool = False) -> dict | None:
+    """StateAggData (state_agg_data.rs): per-state total duration and, for
+    the non-compact form, the [start, end) periods. A state's period runs
+    until the NEXT reading's timestamp; the final reading contributes no
+    duration (no successor), matching the reference accumulator."""
+    n = len(ts)
+    if n == 0:
+        return None
+    t = np.asarray(ts, dtype=np.int64)
+    order = np.argsort(t, kind="stable")
+    t = t[order]
+    s = np.asarray(states)[order]
+    durations: dict = {}
+    periods: dict = {}
+    cur_state = s[0]
+    cur_start = int(t[0])
+    for i in range(1, n):
+        if s[i] != cur_state:
+            end = int(t[i])
+            durations[cur_state] = durations.get(cur_state, 0) + (end - cur_start)
+            if not compact:
+                periods.setdefault(cur_state, []).append([cur_start, end])
+            cur_state = s[i]
+            cur_start = end
+    end = int(t[-1])
+    if end > cur_start:
+        durations[cur_state] = durations.get(cur_state, 0) + (end - cur_start)
+        if not compact:
+            periods.setdefault(cur_state, []).append([cur_start, end])
+    return {"kind": "state", "compact": compact,
+            "durations": {str(k): int(v) for k, v in durations.items()},
+            "periods": {str(k): v for k, v in periods.items()}}
+
+
+def duration_in(sa: dict, state, start: int | None = None,
+                interval: int | None = None) -> int:
+    """Total time in `state` (state_agg_data.rs:89-136), optionally
+    restricted to [start, start+interval)."""
+    key = str(state)
+    if start is None:
+        return int(sa["durations"].get(key, 0))
+    if sa.get("compact"):
+        raise FunctionError("duration_in with a time range needs state_agg "
+                            "(not compact_state_agg)")
+    periods = sa["periods"].get(key, [])
+    total = 0
+    end = start + interval if interval is not None else None
+    for p_start, p_end in periods:
+        if p_end <= start:
+            continue
+        if end is not None and p_start >= end:
+            continue
+        lo = max(p_start, start)
+        hi = p_end if end is None else min(p_end, end)
+        if hi > lo:
+            total += hi - lo
+    return int(total)
+
+
+def state_at(sa: dict, ts: int):
+    """State whose period covers ts (state_agg_data.rs:138-152)."""
+    if sa.get("compact"):
+        raise FunctionError("state_at needs state_agg (not compact form)")
+    for state, periods in sa["periods"].items():
+        for p_start, p_end in periods:
+            if p_start <= ts < p_end:
+                return state
+    return None
+
+
+# ---------------------------------------------------------------------------
+# data-quality metrics (data_quality/common.rs)
+# ---------------------------------------------------------------------------
+def _dq_median(x: np.ndarray) -> float:
+    return float(np.median(x)) if len(x) else 0.0
+
+
+def _dq_mad(x: np.ndarray) -> float:
+    mid = _dq_median(x)
+    return 1.4826 * _dq_median(np.abs(x - mid))
+
+
+def _dq_outliers(x: np.ndarray, k: float = 3.0) -> int:
+    if len(x) == 0:
+        return 0
+    mid = _dq_median(x)
+    sigma = _dq_mad(x)
+    return int((np.abs(x - mid) > k * sigma).sum())
+
+
+class _DataQuality:
+    """Port of DataSeriesQuality: NaN interpolation then timestamp-window
+    and value-outlier counting (common.rs:40-215)."""
+
+    WINDOW = 10
+
+    def __init__(self, ts: np.ndarray, vals: np.ndarray):
+        t = np.asarray(ts, dtype=np.float64)
+        v = np.asarray(vals, dtype=np.float64).copy()
+        self.cnt = len(t)
+        bad = ~np.isfinite(v)
+        self.specialcnt = int(bad.sum())
+        v[bad] = np.nan
+        good = np.nonzero(~np.isnan(v))[0]
+        if len(good) < 2:
+            raise FunctionError("at least two finite values are needed")
+        # linear interpolation through NaNs, extrapolating the edges from
+        # the first/last pair of good points (common.rs nan_process)
+        v = np.interp(t, t[good], v[good])
+        i1, i2 = good[0], good[1]
+        slope = (v[i2] - v[i1]) / (t[i2] - t[i1]) if t[i2] != t[i1] else 0.0
+        head = np.arange(len(t)) < i1
+        v[head] = v[i1] + slope * (t[head] - t[i1])
+        j1, j2 = good[-2], good[-1]
+        slope = (v[j2] - v[j1]) / (t[j2] - t[j1]) if t[j2] != t[j1] else 0.0
+        tail = np.arange(len(t)) > j2
+        v[tail] = v[j1] + slope * (t[tail] - t[j1])
+        self.t, self.v = t, v
+        self.misscnt = self.latecnt = self.redundancycnt = 0
+        self._time_detect()
+        self._value_detect()
+
+    def _time_detect(self):
+        t = self.t
+        if len(t) < 2:
+            return
+        base = _dq_median(np.diff(t))
+        if base == 0:
+            return
+        window = list(t[:self.WINDOW])
+        i = len(window)
+        while len(window) > 1:
+            times = (window[1] - window[0]) / base
+            if times <= 0.5:
+                window.pop(1)
+                self.redundancycnt += 1
+            elif 2.0 <= times <= 9.0:
+                temp = 0
+                j = 2
+                while j < len(window):
+                    times2 = (window[j] - window[j - 1]) / base
+                    if times2 >= 2.0:
+                        break
+                    if times2 <= 0.5:
+                        temp += 1
+                        window.pop(j)
+                        j -= 1
+                        if temp == round(times - 1.0):
+                            break
+                    j += 1
+                self.latecnt += temp
+                self.misscnt += round(times - 1.0) - temp
+            window.pop(0)
+            while len(window) < self.WINDOW and i < self.cnt:
+                window.append(t[i])
+                i += 1
+
+    def _value_detect(self):
+        v, t = self.v, self.t
+        self.valuecnt = _dq_outliers(v)
+        self.variationcnt = _dq_outliers(np.diff(v))
+        with np.errstate(invalid="ignore", divide="ignore"):
+            speed = np.diff(v) / np.diff(t)
+        self.speedcnt = _dq_outliers(speed)
+        self.speedchangecnt = _dq_outliers(np.diff(speed))
+
+    def completeness(self) -> float:
+        return 1.0 - (self.misscnt + self.specialcnt) / (self.cnt + self.misscnt)
+
+    def consistency(self) -> float:
+        return 1.0 - self.redundancycnt / self.cnt
+
+    def timeliness(self) -> float:
+        return 1.0 - self.latecnt / self.cnt
+
+    def validity(self) -> float:
+        return 1.0 - 0.25 * (self.valuecnt + self.variationcnt
+                             + self.speedcnt + self.speedchangecnt) / self.cnt
+
+
+def data_quality(metric: str, ts: np.ndarray, vals: np.ndarray) -> float:
+    dq = _DataQuality(ts, vals)
+    return getattr(dq, metric)()
+
+
+# ---------------------------------------------------------------------------
+# data repair (ts_gen_func/data_repair/)
+# ---------------------------------------------------------------------------
+def _interval_estimate(ts: np.ndarray, method: str = "median",
+                       interval: int | None = None) -> int:
+    if interval is not None:
+        return int(interval)
+    d = np.diff(ts)
+    if len(d) == 0:
+        return 1
+    if method == "mode":
+        u, c = np.unique(d, return_counts=True)
+        return int(u[np.argmax(c)])
+    return int(np.median(d))
+
+
+def timestamp_repair(ts: np.ndarray, vals: np.ndarray,
+                     method: str = "median",
+                     interval: int | None = None) -> tuple[np.ndarray, np.ndarray]:
+    """Rebuild an even timestamp grid (timestamp_repair.rs): estimate the
+    sampling interval (median/mode of diffs or explicit), regenerate
+    start..end on that grid, and map each original reading to its nearest
+    slot (first writer wins); empty slots fill by linear interpolation."""
+    t = np.asarray(ts, dtype=np.int64)
+    v = np.asarray(vals, dtype=np.float64)
+    if len(t) == 0:
+        return t, v
+    order = np.argsort(t, kind="stable")
+    t, v = t[order], v[order]
+    step = max(1, _interval_estimate(t, method, interval))
+    start, end = int(t[0]), int(t[-1])
+    n_slots = (end - start) // step + 1
+    grid = start + step * np.arange(n_slots, dtype=np.int64)
+    slot = np.clip(np.round((t - start) / step).astype(np.int64), 0,
+                   n_slots - 1)
+    filled = np.full(n_slots, np.nan)
+    for i in range(len(t) - 1, -1, -1):   # first writer wins
+        filled[slot[i]] = v[i]
+    missing = np.isnan(filled)
+    if missing.any() and (~missing).any():
+        good = np.nonzero(~missing)[0]
+        filled = np.interp(np.arange(n_slots), good, filled[good])
+    return grid, filled
+
+
+def value_fill(ts: np.ndarray, vals: np.ndarray,
+               method: str = "linear") -> np.ndarray:
+    """Fill NaN values (value_fill.rs): mean / previous / linear."""
+    t = np.asarray(ts, dtype=np.float64)
+    v = np.asarray(vals, dtype=np.float64).copy()
+    bad = np.isnan(v)
+    if not bad.any():
+        return v
+    good = np.nonzero(~bad)[0]
+    if len(good) == 0:
+        return v
+    method = method.lower()
+    if method == "mean":
+        v[bad] = v[good].mean()
+    elif method == "previous":
+        idx = np.maximum.accumulate(
+            np.where(~bad, np.arange(len(v)), -1))
+        has_prev = idx >= 0
+        v[bad & has_prev] = v[idx[bad & has_prev]]
+    elif method == "linear":
+        v[bad] = np.interp(t[bad], t[good], v[good])
+    else:
+        raise FunctionError(f"unsupported fill method {method!r} "
+                            "(mean|previous|linear)")
+    return v
+
+
+def value_repair(ts: np.ndarray, vals: np.ndarray,
+                 min_speed: float | None = None,
+                 max_speed: float | None = None) -> np.ndarray:
+    """SCREEN repair (value_repair.rs screen method): clamp each step's
+    rate of change into [smin, smax]; default bounds = median speed ±
+    3·MAD (the reference's auto-threshold)."""
+    t = np.asarray(ts, dtype=np.float64)
+    v = np.asarray(vals, dtype=np.float64).copy()
+    if len(v) < 2:
+        return v
+    with np.errstate(invalid="ignore", divide="ignore"):
+        speed = np.diff(v) / np.diff(t)
+    if min_speed is None or max_speed is None:
+        mid = _dq_median(speed)
+        sigma = _dq_mad(speed)
+        if min_speed is None:
+            min_speed = mid - 3 * sigma
+        if max_speed is None:
+            max_speed = mid + 3 * sigma
+    for i in range(1, len(v)):
+        dt = t[i] - t[i - 1]
+        lo = v[i - 1] + min_speed * dt
+        hi = v[i - 1] + max_speed * dt
+        if v[i] < lo:
+            v[i] = lo
+        elif v[i] > hi:
+            v[i] = hi
+    return v
+
+
+# ---------------------------------------------------------------------------
+# GIS (scalar_function/gis/ — WKT geometries)
+# ---------------------------------------------------------------------------
+_WKT_NUM = r"[-+]?\d*\.?\d+(?:[eE][-+]?\d+)?"
+
+
+def _parse_wkt(wkt: str):
+    """→ (type, list of (x, y)). Supports POINT/LINESTRING/POLYGON."""
+    if wkt is None:
+        return None
+    m = re.match(r"\s*(POINT|LINESTRING|POLYGON)\s*\((.*)\)\s*$",
+                 str(wkt).strip(), re.IGNORECASE)
+    if not m:
+        raise FunctionError(f"bad WKT geometry: {wkt!r}")
+    gtype = m.group(1).upper()
+    body = m.group(2)
+    if gtype == "POLYGON":
+        ring = re.match(r"\s*\((.*?)\)", body)
+        if not ring:
+            raise FunctionError(f"bad WKT polygon: {wkt!r}")
+        body = ring.group(1)
+    pts = []
+    for pair in body.split(","):
+        nums = re.findall(_WKT_NUM, pair)
+        if len(nums) < 2:
+            raise FunctionError(f"bad WKT coordinates: {pair!r}")
+        pts.append((float(nums[0]), float(nums[1])))
+    return gtype, pts
+
+
+def _seg_point_dist(px, py, ax, ay, bx, by) -> float:
+    dx, dy = bx - ax, by - ay
+    if dx == dy == 0:
+        return math.hypot(px - ax, py - ay)
+    u = ((px - ax) * dx + (py - ay) * dy) / (dx * dx + dy * dy)
+    u = max(0.0, min(1.0, u))
+    return math.hypot(px - (ax + u * dx), py - (ay + u * dy))
+
+
+def st_distance(wkt1: str, wkt2: str) -> float:
+    """Planar euclidean distance (gis/st_distance.rs, geo crate
+    EuclideanDistance): exact for point↔point / point↔linestring;
+    min vertex-to-segment distance otherwise."""
+    g1, g2 = _parse_wkt(wkt1), _parse_wkt(wkt2)
+    if g1 is None or g2 is None:
+        return None
+    (t1, p1), (t2, p2) = g1, g2
+    if t1 == t2 == "POINT":
+        return math.hypot(p1[0][0] - p2[0][0], p1[0][1] - p2[0][1])
+    best = math.inf
+    for (a, pa), (b, pb) in ((g1, g2), (g2, g1)):
+        segs = list(zip(pb, pb[1:])) or [(pb[0], pb[0])]
+        for (px, py) in pa:
+            for (s1, s2) in segs:
+                best = min(best, _seg_point_dist(px, py, *s1, *s2))
+    return best
+
+
+def st_area(wkt: str) -> float:
+    """Polygon shoelace area (gis/st_area.rs); 0 for points/lines."""
+    g = _parse_wkt(wkt)
+    if g is None:
+        return None
+    gtype, pts = g
+    if gtype != "POLYGON" or len(pts) < 3:
+        return 0.0
+    x = np.array([p[0] for p in pts])
+    y = np.array([p[1] for p in pts])
+    return float(abs(np.dot(x, np.roll(y, -1)) - np.dot(y, np.roll(x, -1))) / 2)

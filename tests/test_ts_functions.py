@@ -1,0 +1,148 @@
+"""Function-family parity tests vs hand-computed oracles (reference
+extension/expr: increase.rs, sample.rs, gauge/, state_agg/, data_quality/,
+ts_gen_func/data_repair/, gis/)."""
+import numpy as np
+import pytest
+
+from cnosdb_tpu.parallel.coordinator import Coordinator
+from cnosdb_tpu.parallel.meta import MetaStore
+from cnosdb_tpu.sql.executor import QueryExecutor
+from cnosdb_tpu.sql import tsfuncs
+from cnosdb_tpu.storage.engine import TsKv
+
+
+@pytest.fixture
+def db(tmp_path):
+    meta = MetaStore(str(tmp_path / "meta.json"))
+    engine = TsKv(str(tmp_path / "data"))
+    coord = Coordinator(meta, engine)
+    ex = QueryExecutor(meta, coord)
+    yield ex
+    coord.close()
+
+
+def test_increase_counter_reset(db):
+    db.execute_one("CREATE TABLE c (v DOUBLE, TAGS(h))")
+    # 1→5→8 rises 7; reset to 2 adds 2 (increase.rs:98-103); 2→4 adds 2
+    db.execute_one("INSERT INTO c (time, h, v) VALUES "
+                   "(1,'a',1),(2,'a',5),(3,'a',8),(4,'a',2),(5,'a',4)")
+    rs = db.execute_one("SELECT increase(time, v) FROM c")
+    assert rs.columns[0][0] == 11.0
+    # short form without the explicit time arg
+    rs = db.execute_one("SELECT increase(v) FROM c")
+    assert rs.columns[0][0] == 11.0
+    # per-group
+    db.execute_one("INSERT INTO c (time, h, v) VALUES (1,'b',10),(2,'b',3)")
+    rs = db.execute_one("SELECT h, increase(time, v) FROM c GROUP BY h "
+                        "ORDER BY h")
+    assert rs.columns[1].tolist() == [11.0, 3.0]
+
+
+def test_gauge_agg_accessors(db):
+    db.execute_one("CREATE TABLE g (v DOUBLE, TAGS(h))")
+    db.execute_one("INSERT INTO g (time, h, v) VALUES "
+                   "(1,'a',1),(2,'a',5),(3,'a',8),(4,'a',2),(5,'a',4)")
+    rs = db.execute_one(
+        "SELECT delta(gauge_agg(time, v)), rate(gauge_agg(time, v)), "
+        "time_delta(gauge_agg(time, v)), first_val(gauge_agg(time, v)), "
+        "last_val(gauge_agg(time, v)), idelta_left(gauge_agg(time, v)), "
+        "idelta_right(gauge_agg(time, v)), num_elements(gauge_agg(time, v)) "
+        "FROM g")
+    row = [c[0] for c in rs.columns]
+    assert row[0] == 3.0                 # last - first (gauge/mod.rs:44)
+    assert abs(row[1] - 0.75) < 1e-12    # delta / time_delta
+    assert row[2] == 4
+    assert row[3] == 1.0 and row[4] == 4.0
+    assert row[5] == 4.0                 # second - first
+    assert row[6] == 2.0                 # last - penultimate
+    assert row[7] == 5
+
+
+def test_state_agg_duration_in_state_at(db):
+    db.execute_one("CREATE TABLE st (s STRING, TAGS(h))")
+    db.execute_one("INSERT INTO st (time, h, s) VALUES "
+                   "(0,'a','up'),(10,'a','down'),(30,'a','up'),"
+                   "(40,'a','up'),(60,'a','down')")
+    one = lambda q: db.execute_one(q).columns[0][0]  # noqa: E731
+    assert one("SELECT duration_in(state_agg(time, s), 'up') FROM st") == 40
+    assert one("SELECT duration_in(state_agg(time, s), 'down') FROM st") == 20
+    assert one("SELECT state_at(state_agg(time, s), 35) FROM st") == "up"
+    assert one("SELECT state_at(state_agg(time, s), 15) FROM st") == "down"
+    # windowed duration_in [5, 5+30): up in [5,10) + [30,35)
+    assert one("SELECT duration_in(state_agg(time, s), 'up', 5, 30) "
+               "FROM st") == 10
+    # compact form answers totals only
+    assert one("SELECT duration_in(compact_state_agg(time, s), 'up') "
+               "FROM st") == 40
+
+
+def test_sample(db):
+    db.execute_one("CREATE TABLE smp (v BIGINT, TAGS(h))")
+    vals = ", ".join(f"({i},'a',{i})" for i in range(1, 101))
+    db.execute_one(f"INSERT INTO smp (time, h, v) VALUES {vals}")
+    s = db.execute_one("SELECT sample(v, 10) FROM smp").columns[0][0]
+    assert isinstance(s, list) and len(s) == 10
+    assert all(1 <= x <= 100 for x in s) and len(set(s)) == 10
+    # n <= k returns everything
+    s = db.execute_one("SELECT sample(v, 500) FROM smp").columns[0][0]
+    assert len(s) == 100
+
+
+def test_data_quality_clean_series(db):
+    db.execute_one("CREATE TABLE dq (v DOUBLE, TAGS(h))")
+    vals = ", ".join(f"({i * 10},'a',{float(i)})" for i in range(1, 21))
+    db.execute_one(f"INSERT INTO dq (time, h, v) VALUES {vals}")
+    for fn in ("completeness", "consistency", "timeliness"):
+        rs = db.execute_one(f"SELECT {fn}(time, v) FROM dq")
+        assert rs.columns[0][0] == 1.0, fn
+    assert db.execute_one("SELECT validity(time, v) FROM dq").columns[0][0] >= 0.9
+
+
+def test_data_quality_detects_missing_points():
+    # direct oracle: evenly spaced except one 3-interval gap → 2 missing
+    ts = np.array([0, 10, 20, 50, 60, 70, 80, 90, 100, 110, 120], dtype=np.int64)
+    vals = np.arange(len(ts), dtype=np.float64)
+    c = tsfuncs.data_quality("completeness", ts, vals)
+    n, miss = len(ts), 2
+    assert abs(c - (1.0 - miss / (n + miss))) < 1e-12
+
+
+def test_timestamp_repair(db):
+    db.execute_one("CREATE TABLE tr (v DOUBLE, TAGS(h))")
+    # 10ns cadence with one missing slot (40) and one jittered point (71)
+    db.execute_one("INSERT INTO tr (time, h, v) VALUES "
+                   "(10,'a',1),(20,'a',2),(30,'a',3),(50,'a',5),"
+                   "(60,'a',6),(71,'a',7)")
+    rs = db.execute_one("SELECT timestamp_repair(time, v) FROM tr")
+    assert rs.columns[0].tolist() == [10, 20, 30, 40, 50, 60, 70]
+    assert rs.columns[1].tolist() == [1, 2, 3, 4, 5, 6, 7]
+
+
+def test_value_fill(db):
+    db.execute_one("CREATE TABLE vf (v DOUBLE, TAGS(h))")
+    db.execute_one("INSERT INTO vf (time, h, v) VALUES "
+                   "(10,'a',1),(20,'a',2),(40,'a',4)")
+    # linear interpolation over a NaN injected via direct API
+    ts = np.array([10, 20, 30, 40], dtype=np.int64)
+    v = np.array([1.0, 2.0, np.nan, 4.0])
+    assert tsfuncs.value_fill(ts, v, "linear").tolist() == [1, 2, 3, 4]
+    assert tsfuncs.value_fill(ts, v, "previous").tolist() == [1, 2, 2, 4]
+    filled = tsfuncs.value_fill(ts, v, "mean")
+    assert abs(filled[2] - np.mean([1, 2, 4])) < 1e-12
+
+
+def test_value_repair_screen():
+    ts = np.arange(0, 100, 10, dtype=np.int64)
+    v = np.array([1.0, 2, 3, 4, 500, 6, 7, 8, 9, 10])  # spike at i=4
+    out = tsfuncs.value_repair(ts, v)
+    assert out[4] < 50  # spike clamped toward the speed envelope
+    assert out[0] == 1.0 and out[-1] <= 10.0
+
+
+def test_gis_scalars(db):
+    one = lambda q: db.execute_one(q).columns[0][0]  # noqa: E731
+    assert one("SELECT st_distance('POINT(0 0)', 'POINT(3 4)')") == 5.0
+    assert one("SELECT st_area('POLYGON((0 0, 4 0, 4 3, 0 3, 0 0))')") == 12.0
+    # point to segment distance
+    d = one("SELECT st_distance('POINT(2 2)', 'LINESTRING(0 0, 4 0)')")
+    assert d == 2.0
