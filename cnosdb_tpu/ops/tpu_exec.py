@@ -241,7 +241,16 @@ def launch_scan_aggregate(batch: ScanBatch, query: TpuQuery):
                 col_results[cname] = _host_string_agg(
                     vals, valid & row_mask, seg_ids, rank, num_segments, wants)
                 continue
-            dev_vals = vals if vt != ValueType.BOOLEAN else vals.astype(np.int64)
+            if vt == ValueType.BOOLEAN:
+                dev_vals = vals.astype(np.int64)
+            elif vt == ValueType.UNSIGNED:
+                # order-preserving bias: u64 ^ 2^63 viewed as i64 keeps the
+                # kernel's comparisons/min/max exact for values ≥ 2^63;
+                # sums stay exact mod 2^64 and _assemble un-biases
+                dev_vals = (np.asarray(vals, dtype=np.uint64)
+                            ^ np.uint64(1 << 63)).view(np.int64)
+            else:
+                dev_vals = vals
             col_results[cname] = kernels.aggregate_column_host(
                 dev_vals, valid & row_mask, seg_ids, rank, num_segments,
                 {**wants, "want_count": True})
@@ -276,25 +285,41 @@ def _assemble(batch, query, presence, present, col_results, group_labels,
                 out_valid[a.alias] = np.zeros(len(sel), dtype=bool)
             continue
         cnt = r.get("count")
+        unsigned = (a.column in batch.fields
+                    and batch.fields[a.column][0] == ValueType.UNSIGNED)
+
+        def unbias(x):
+            return (np.ascontiguousarray(x).view(np.uint64)
+                    ^ np.uint64(1 << 63))
+
+        def unbias_sum(s, c):
+            # sum of biased vals = true_sum - count·2^63 (mod 2^64)
+            return (np.ascontiguousarray(s).view(np.uint64)
+                    + c.astype(np.uint64) * np.uint64(1 << 63))
+
         if a.func == "count":
             out_cols[a.alias] = cnt[sel]
         elif a.func in ("mean", "avg"):
             c = cnt[sel]
-            s = r["sum"][sel].astype(np.float64)
+            s = (unbias_sum(r["sum"][sel], c).astype(np.float64) if unsigned
+                 else r["sum"][sel].astype(np.float64))
             with np.errstate(invalid="ignore", divide="ignore"):
                 out_cols[a.alias] = np.where(c > 0, s / np.maximum(c, 1), np.nan)
             out_valid[a.alias] = c > 0
         elif a.func == "sum":
             have = cnt[sel] > 0
-            out_cols[a.alias] = r["sum"][sel]
+            s = r["sum"][sel]
+            out_cols[a.alias] = unbias_sum(s, cnt[sel]) if unsigned else s
             out_valid[a.alias] = have
         elif a.func in ("min", "max"):
             have = cnt[sel] > 0
-            out_cols[a.alias] = r[a.func][sel]
+            v = r[a.func][sel]
+            out_cols[a.alias] = unbias(v) if unsigned else v
             out_valid[a.alias] = have
         elif a.func in ("first", "last"):
             have = cnt[sel] > 0
-            out_cols[a.alias] = r[a.func][sel]
+            v = r[a.func][sel]
+            out_cols[a.alias] = unbias(v) if unsigned else v
             out_valid[a.alias] = have
             # hidden timestamp of the selected row: lets a coordinator merge
             # first/last partials across vnodes by actual time order
@@ -324,6 +349,10 @@ def _device_eligible(batch: ScanBatch, query: TpuQuery,
     for cname in col_wants:
         f = batch.fields.get(cname)
         if f is not None and f[0] in (ValueType.STRING, ValueType.GEOMETRY):
+            return False
+        if f is not None and f[0] == ValueType.UNSIGNED:
+            # the packed single-transfer output is f64; u64 values above
+            # 2^53 would round — the host kernel path is exact (biased i64)
             return False
     if query.filter is not None:
         if _contains_is_null(query.filter):

@@ -142,7 +142,7 @@ def rpc_call(addr: str, method: str, payload: dict | None = None,
     retried — the server may have fully applied a non-idempotent mutation
     whose reply was lost, and re-executing it would double-apply."""
     body = pack(payload or {})
-    while True:
+    for attempt in range(_ConnPool.MAX_IDLE_PER_ADDR + 1):
         conn, reused = _pool.get(addr, timeout)
         conn.timeout = timeout
         if conn.sock is not None:
@@ -150,23 +150,31 @@ def rpc_call(addr: str, method: str, payload: dict | None = None,
         try:
             conn.request("POST", f"/rpc/{method}", body,
                          {"Content-Type": "application/msgpack"})
+        except (ConnectionError, http.client.HTTPException, OSError,
+                TimeoutError) as e:
+            # send-phase failure: retry ONLY the stale-keep-alive case
+            # (reused conn, non-timeout) — bounded by the pool size so a
+            # flapping peer refilling the pool cannot loop us forever
+            conn.close()
+            if reused and not isinstance(e, (TimeoutError, socket_timeout)):
+                continue
+            raise RpcUnavailable(f"{method}@{addr}: {e}") from e
+        try:
             resp = conn.getresponse()
             raw = resp.read()
             reply = unpack(raw) if raw else {}
         except (ConnectionError, http.client.HTTPException, OSError,
                 TimeoutError) as e:
+            # response-phase failure: the server may have fully processed a
+            # non-idempotent mutation whose reply was lost — NEVER retry
             conn.close()
-            if reused and not isinstance(e, (TimeoutError, socket_timeout)):
-                # stale keep-alive: safe to retry; loop is bounded because
-                # each iteration drains one pooled conn and a fresh conn's
-                # failure raises
-                continue
             raise RpcUnavailable(f"{method}@{addr}: {e}") from e
         _pool.put(addr, conn)
         if resp.status != 200:
             raise RpcError(f"{method}@{addr}: "
                            f"{reply.get('_err')}: {reply.get('_msg')}")
         return reply
+    raise RpcUnavailable(f"{method}@{addr}: pooled connections exhausted")
 
 
 def wait_rpc_ready(addr: str, method: str = "ping", timeout: float = 10.0):

@@ -15,7 +15,9 @@ from dataclasses import dataclass, field
 
 import numpy as np
 
-from ..errors import ExecutionError, PlanError, QueryError, TableNotFound
+from ..errors import (
+    ExecutionError, FunctionError, PlanError, QueryError, TableNotFound,
+)
 from ..models.points import WriteBatch
 from ..models.predicate import TimeRanges
 from ..models.schema import (
@@ -437,6 +439,13 @@ class QueryExecutor:
         cols = stmt.columns or [c.name for c in schema.columns]
         if "time" not in cols:
             raise ExecutionError("INSERT must include the time column")
+        # SQL INSERT is schema-strict (the schemaless path is line
+        # protocol); unknown columns are an error, not an auto-evolution
+        unknown = [c for c in cols
+                   if c != "time" and not schema.contains_column(c)]
+        if unknown:
+            raise ExecutionError(
+                f"unknown column(s) {unknown} in INSERT INTO {stmt.table}")
         tag_names = [c for c in cols if schema.contains_column(c)
                      and schema.column(c).column_type.is_tag]
         field_types = {c: schema.column(c).column_type.value_type
@@ -1260,14 +1269,19 @@ def _series_finalize(func: str, ts: np.ndarray, vals: np.ndarray, param):
     if func == "increase":
         return tsfuncs.increase(ts, vals)
     if func == "sample":
-        return tsfuncs.sample(vals, int(param or 1))
+        return tsfuncs.sample(vals, int(param) if param is not None else 1)
     if func == "gauge_agg":
         return tsfuncs.gauge_data(ts, vals)
     if func == "state_agg":
         return tsfuncs.state_data(ts, vals, compact=False)
     if func == "compact_state_agg":
         return tsfuncs.state_data(ts, vals, compact=True)
-    return tsfuncs.data_quality(func, ts, vals)
+    try:
+        return tsfuncs.data_quality(func, ts, vals)
+    except FunctionError:
+        # a degenerate group (<2 finite values) yields NULL for that group
+        # instead of failing the whole query
+        return None
 
 
 def _apply_finalizer(spec, parts: dict):
@@ -1285,11 +1299,6 @@ def _apply_finalizer(spec, parts: dict):
     if kind == "distinct":
         vals = parts.get(spec[1])
         return len(vals) if vals is not None else 0
-    if kind == "increase":
-        f, l = parts.get(spec[1]), parts.get(spec[2])
-        if f is None or l is None:
-            return None
-        return l - f
     if kind in ("median", "stddev", "mode"):
         chunks = parts.get(spec[1])
         if not chunks:
@@ -1338,10 +1347,6 @@ def _vector_finalize(spec, parts_env: dict, n: int):
     if kind == "distinct":
         c, v = col(spec[1], 0)
         return c, v
-    if kind == "increase":
-        f, fv = col(spec[1])
-        l, lv = col(spec[2])
-        return l - f, fv & lv
     raise ExecutionError(f"bad finalizer {spec!r}")
 
 

@@ -328,6 +328,70 @@ class Func(Expr):
         return f"{self.name}({', '.join(a.to_sql() for a in self.args)})"
 
 
+def _str_func(fn, *, out=object):
+    """Lift a python string function elementwise over object columns
+    (DataFusion-inherited string scalars in the reference)."""
+    def run(xp, arr, *rest):
+        import numpy as _np
+
+        rest = [r.item() if hasattr(r, "item") else r for r in rest]
+        if isinstance(arr, _np.ndarray):
+            vals = [None if x is None else fn(str(x), *rest) for x in arr]
+            if out is object:
+                o = _np.empty(len(vals), dtype=object)
+                o[:] = vals
+                return o
+            return _np.array([out() if v is None else v for v in vals],
+                             dtype=out)
+        return None if arr is None else fn(str(arr), *rest)
+    return run
+
+
+def _fn_substr(s, start, length=None):
+    """SQL substr: 1-based; a start < 1 consumes the length window before
+    position 1 (PostgreSQL/DataFusion semantics)."""
+    start = int(start)
+    if length is None:
+        return s[max(0, start - 1):]
+    end = start + int(length)     # exclusive 1-based end
+    lo = max(1, start)
+    if end <= lo:
+        return ""
+    return s[lo - 1:end - 1]
+
+
+def _fn_lpad(s, n, p=" "):
+    n = int(n)
+    if n <= len(s):
+        return s[:n]              # SQL lpad truncates to the target length
+    if not p:
+        return s
+    return (p * n)[:n - len(s)] + s
+
+
+def _fn_rpad(s, n, p=" "):
+    n = int(n)
+    if n <= len(s):
+        return s[:n]
+    if not p:
+        return s
+    return s + (p * n)[:n - len(s)]
+
+
+def _fn_concat(xp, *parts):
+    import numpy as _np
+
+    arrays = [p for p in parts if isinstance(p, _np.ndarray)]
+    if not arrays:
+        return "".join("" if p is None else str(p) for p in parts)
+    n = len(arrays[0])
+    cols = [p if isinstance(p, _np.ndarray) else [p] * n for p in parts]
+    o = _np.empty(n, dtype=object)
+    o[:] = ["".join("" if v is None else str(v) for v in row)
+            for row in zip(*cols)]
+    return o
+
+
 def _obj_func(fn, *, numeric: bool = True):
     """Lift a python function over object columns (gauge/state composites
     from sql.tsfuncs). Extra args arrive as evaluated scalars."""
@@ -391,6 +455,25 @@ def _register_tsfuncs():
         "state_at": _obj_func(tf.state_at, numeric=False),
         "st_distance": _binary_obj_func(tf.st_distance),
         "st_area": _obj_func(tf.st_area),
+        # string scalars (DataFusion-inherited set in the reference)
+        "upper": _str_func(str.upper),
+        "lower": _str_func(str.lower),
+        "length": _str_func(len, out=np.int64),
+        "char_length": _str_func(len, out=np.int64),
+        "trim": _str_func(str.strip),
+        "ltrim": _str_func(str.lstrip),
+        "rtrim": _str_func(str.rstrip),
+        "reverse": _str_func(lambda s: s[::-1]),
+        "substr": _str_func(_fn_substr),
+        "substring": _str_func(_fn_substr),
+        "replace": _str_func(lambda s, a, b: s.replace(a, b)),
+        "starts_with": _str_func(lambda s, p: s.startswith(p), out=np.bool_),
+        "ends_with": _str_func(lambda s, p: s.endswith(p), out=np.bool_),
+        "concat": _fn_concat,
+        "strpos": _str_func(lambda s, sub: s.find(sub) + 1, out=np.int64),
+        "repeat": _str_func(lambda s, n: s * int(n)),
+        "lpad": _str_func(_fn_lpad),
+        "rpad": _str_func(_fn_rpad),
     })
 
 

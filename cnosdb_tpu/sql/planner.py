@@ -283,8 +283,11 @@ class _AggCollector:
         args = [a for a in f.args
                 if not (isinstance(a, Literal) and a.value == "__distinct__")]
         param = None
-        if name in TS_PAIR_AGGS and len(args) == 2 \
-                and isinstance(args[0], Column) and args[0].name == TIME_COL:
+        if name in TS_PAIR_AGGS and len(args) == 2:
+            if not (isinstance(args[0], Column) and args[0].name == TIME_COL):
+                raise PlanError(
+                    f"{name}(time, value): first argument must be the time "
+                    f"column, got {f.to_sql()}")
             args = args[1:]   # reference signature f(time, value)
         if name == "sample":
             if len(args) != 2 or not isinstance(args[1], Literal):

@@ -54,16 +54,20 @@ def system_table(executor, db: str, table: str, session) -> tuple[list[str], lis
                           "column_name", "column_type", "data_type",
                           "compression_codec"], rows)
         if t == "tenants":
-            rows = [(name, opts.comment) for name, opts in meta.tenants.items()]
-            return _cols(["tenant_name", "tenant_options"], rows)
+            return _tenants_table(meta)
         if t == "users":
-            rows = [(name, bool(u.get("admin")), u.get("comment", ""))
-                    for name, u in meta.users.items()]
-            return _cols(["user_name", "is_admin", "comment"], rows)
+            return _users_table(meta)
         if t == "queries":
             return _cols(["query_id", "query_text", "user_name", "tenant_name",
                           "state", "duration"], [])
     if db == "cluster_schema":
+        # the reference serves users/tenants from CLUSTER_SCHEMA
+        # (metadata/cluster_schema_provider); keep them reachable from the
+        # information_schema spelling too
+        if t == "users":
+            return _users_table(meta)
+        if t == "tenants":
+            return _tenants_table(meta)
         if t == "nodes":
             rows = [(n.id, n.http_addr, n.grpc_addr, "running")
                     for n in meta.nodes.values()]
@@ -90,6 +94,17 @@ def system_table(executor, db: str, table: str, session) -> tuple[list[str], lis
                 rows.append((owner, vid, v.wal.total_size()))
             return _cols(["owner", "vnode_id", "wal_bytes"], rows)
     raise TableNotFound(f"{db}.{table}")
+
+
+def _users_table(meta):
+    rows = [(name, bool(u.get("admin")), u.get("comment", ""))
+            for name, u in meta.users.items()]
+    return _cols(["user_name", "is_admin", "comment"], rows)
+
+
+def _tenants_table(meta):
+    rows = [(name, opts.comment) for name, opts in meta.tenants.items()]
+    return _cols(["tenant_name", "tenant_options"], rows)
 
 
 def _cols(names: list[str], rows: list[tuple]):
