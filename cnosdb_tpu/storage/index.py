@@ -2,20 +2,31 @@
 
 Role-parity with the reference's TSIndex (tskv/src/index/ts_index.rs:84-660):
 - forward map: series_id → SeriesKey
-- inverted map: (table, tag_key, tag_value) → set of series ids
+- inverted map: (table, tag_key, tag_value) → series-id postings
 - `get_series_ids_by_domains` evaluates tag ColumnDomains to a series-id
   array (ts_index.rs:397), the entry point of every tag-filtered scan.
 
-The reference persists through heed/LMDB with roaring bitmaps; here the
-index is an in-memory dict-of-sets (vnode series cardinality is bounded by
-sharding) persisted via a CRC'd binlog (storage/record_file.py) replayed on
-open — same recovery contract, no external KV dependency. Bitmap math uses
-sorted numpy arrays at query time, which is the shape the scan layer wants
-anyway.
+Storage design (the reference uses heed/LMDB + roaring bitmaps,
+index/engine2.rs): a periodic CHECKPOINT file holds the whole index as
+columnar sections — sorted series-id array, concatenated encoded keys with
+offsets, sorted key-hash array for O(log n) id lookup, and per-(table,tag)
+sorted value dictionaries pointing into one big u64 postings region. The
+file is mmapped; postings and value dictionaries are np.frombuffer slices
+materialized lazily, so opening a vnode with 1M series reads only the
+small header. Mutations append to a CRC'd binlog (storage/record_file.py)
+and live in small overlay dicts; open = load checkpoint + replay the
+binlog TAIL (rotated at each checkpoint), not the full history — the
+incremental-checkpoint contract of the reference's LMDB write-back cache.
+
+Postings math uses sorted numpy arrays end to end, which is the shape the
+scan layer wants anyway (roaring-style compression is unnecessary: 64-bit
+sorted arrays beat python sets by ~20× memory and vectorize).
 """
 from __future__ import annotations
 
+import mmap
 import os
+import struct
 
 import msgpack
 import numpy as np
@@ -30,31 +41,205 @@ from .record_file import RecordReader, RecordWriter
 _OP_ADD = 1
 _OP_DEL = 2
 
+_CKPT_MAGIC = 0x1D45C0DE
+_CKPT_VERSION = 1
+CKPT_NAME = "index.ckpt"
+
+# binlog tail entries that trigger a background-ish checkpoint on the
+# write path (amortized: rewriting N series costs O(N) once per threshold)
+CKPT_THRESHOLD = 200_000
+
+
+class _Checkpoint:
+    """Read view over one checkpoint file (mmap + lazy numpy slices)."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._f = open(path, "rb")
+        self.mm = mmap.mmap(self._f.fileno(), 0, access=mmap.ACCESS_READ)
+        magic, version, hlen = struct.unpack_from("<III", self.mm, 0)
+        if magic != _CKPT_MAGIC:
+            raise IndexError_(f"bad index checkpoint magic in {path}")
+        if version != _CKPT_VERSION:
+            raise IndexError_(f"unsupported index checkpoint v{version}")
+        self.h = msgpack.unpackb(self.mm[12:12 + hlen], raw=False)
+        self.n = self.h["n"]
+        self.next_sid = self.h["next_sid"]
+        base = 12 + hlen
+        sec = self.h["sections"]
+
+        def arr(name, dtype):
+            off, ln = sec[name]
+            return np.frombuffer(self.mm, dtype=dtype, count=ln,
+                                 offset=base + off)
+
+        self.sids = arr("sids", np.uint64)            # sorted
+        self.key_offs = arr("key_offs", np.uint64)    # [n+1]
+        kb_off, kb_len = sec["key_blob"]
+        self._kb_base = base + kb_off
+        self.hashes = arr("hashes", np.uint64)        # sorted
+        self.hash_perm = arr("hash_perm", np.uint32)  # hash idx → row idx
+        self._post_base = base + sec["postings"][0]
+        self.tables = self.h["tables"]
+        # lazy caches
+        self._tag_dict_cache: dict = {}
+
+    def close(self):
+        try:
+            self.mm.close()
+        except BufferError:
+            # numpy views over the mmap are still alive (postings handed to
+            # a scan); the map is reclaimed when the last view dies
+            pass
+        self._f.close()
+
+    # -- forward ----------------------------------------------------------
+    def key_bytes_at(self, row: int) -> bytes:
+        lo, hi = int(self.key_offs[row]), int(self.key_offs[row + 1])
+        return self.mm[self._kb_base + lo:self._kb_base + hi]
+
+    def row_of_sid(self, sid: int) -> int | None:
+        i = int(np.searchsorted(self.sids, np.uint64(sid)))
+        if i < self.n and self.sids[i] == sid:
+            return i
+        return None
+
+    def lookup(self, key: SeriesKey) -> int | None:
+        kb = key.encode()
+        h = np.uint64(key.hash_id())
+        i = int(np.searchsorted(self.hashes, h))
+        while i < self.n and self.hashes[i] == h:
+            row = int(self.hash_perm[i])
+            if self.key_bytes_at(row) == kb:
+                return int(self.sids[row])
+            i += 1
+        return None
+
+    # -- postings ---------------------------------------------------------
+    def postings(self, off: int, cnt: int) -> np.ndarray:
+        return np.frombuffer(self.mm, dtype=np.uint64, count=cnt,
+                             offset=self._post_base + off * 8)
+
+    def table_sids(self, table: str) -> np.ndarray:
+        t = self.tables.get(table)
+        if t is None:
+            return np.empty(0, dtype=np.uint64)
+        off, cnt = t["all"]
+        return self.postings(off, cnt)
+
+    def _tag(self, table: str, tag_key: str):
+        """→ (value_offsets u64[V+1], values_blob memoryview,
+        posting_offsets u64[V+1], base_posting_off) or None."""
+        ck = (table, tag_key)
+        hit = self._tag_dict_cache.get(ck)
+        if hit is not None:
+            return hit
+        t = self.tables.get(table)
+        if t is None or tag_key not in t["tags"]:
+            return None
+        m = t["tags"][tag_key]
+        base = 12 + struct.unpack_from("<I", self.mm, 8)[0]
+        voff = np.frombuffer(self.mm, dtype=np.uint64, count=m["nv"] + 1,
+                             offset=base + m["voffs"])
+        poff = np.frombuffer(self.mm, dtype=np.uint64, count=m["nv"] + 1,
+                             offset=base + m["poffs"])
+        entry = (voff, base + m["vblob"], poff)
+        self._tag_dict_cache[ck] = entry
+        return entry
+
+    def _value_at(self, voff, vblob_base, i: int) -> str:
+        lo, hi = int(voff[i]), int(voff[i + 1])
+        return self.mm[vblob_base + lo:vblob_base + hi].decode()
+
+    def tag_value_sids(self, table: str, tag_key: str, value: str) -> np.ndarray:
+        tag = self._tag(table, tag_key)
+        if tag is None:
+            return np.empty(0, dtype=np.uint64)
+        voff, vb, poff = tag
+        nv = len(voff) - 1
+        lo, hi = 0, nv
+        while lo < hi:  # binary search over the sorted value dictionary
+            mid = (lo + hi) // 2
+            if self._value_at(voff, vb, mid) < value:
+                lo = mid + 1
+            else:
+                hi = mid
+        if lo < nv and self._value_at(voff, vb, lo) == value:
+            return self.postings(int(poff[lo]), int(poff[lo + 1] - poff[lo]))
+        return np.empty(0, dtype=np.uint64)
+
+    def tag_all_sids(self, table: str, tag_key: str) -> np.ndarray:
+        """Union of every value's postings = one contiguous slice."""
+        tag = self._tag(table, tag_key)
+        if tag is None:
+            return np.empty(0, dtype=np.uint64)
+        voff, _vb, poff = tag
+        out = self.postings(int(poff[0]), int(poff[-1] - poff[0]))
+        return np.unique(out)
+
+    def tag_values(self, table: str, tag_key: str) -> list[str]:
+        tag = self._tag(table, tag_key)
+        if tag is None:
+            return []
+        voff, vb, _poff = tag
+        return [self._value_at(voff, vb, i) for i in range(len(voff) - 1)]
+
+    def tag_keys(self, table: str) -> list[str]:
+        t = self.tables.get(table)
+        return sorted(t["tags"].keys()) if t else []
+
+    def has_tag(self, table: str, tag_key: str) -> bool:
+        t = self.tables.get(table)
+        return t is not None and tag_key in t["tags"]
+
+    def tag_items(self, table: str, tag_key: str):
+        """Iterate (value, postings) pairs — range-domain evaluation."""
+        tag = self._tag(table, tag_key)
+        if tag is None:
+            return
+        voff, vb, poff = tag
+        for i in range(len(voff) - 1):
+            yield (self._value_at(voff, vb, i),
+                   self.postings(int(poff[i]), int(poff[i + 1] - poff[i])))
+
 
 class TSIndex:
     def __init__(self, dir_path: str):
         self.dir = dir_path
         os.makedirs(dir_path, exist_ok=True)
         self._binlog_path = os.path.join(dir_path, "index.binlog")
+        self._ckpt_path = os.path.join(dir_path, CKPT_NAME)
+        self._ckpt: _Checkpoint | None = None
+        # overlay: mutations since the checkpoint
         self._forward: dict[int, SeriesKey] = {}
         self._by_key: dict[SeriesKey, int] = {}
         self._inverted: dict[str, dict[str, dict[str, set[int]]]] = {}
         self._by_table: dict[str, set[int]] = {}
+        self._deleted: set[int] = set()          # deleted checkpoint sids
+        self._key_cache: dict[int, SeriesKey] = {}  # decoded ckpt keys
         self._next_sid = 1
+        self._tail_count = 0
+        if os.path.exists(self._ckpt_path):
+            self._ckpt = _Checkpoint(self._ckpt_path)
+            self._next_sid = self._ckpt.next_sid
         if os.path.exists(self._binlog_path):
             self._replay()
         self._binlog = RecordWriter(self._binlog_path)
 
     # -- recovery --------------------------------------------------------
     def _replay(self):
+        if os.path.getsize(self._binlog_path) == 0:
+            return  # crash-window artifact of a binlog rotation: harmless
         for payload in RecordReader(self._binlog_path):
             op, sid, key_b = msgpack.unpackb(payload, raw=False)
             if op == _OP_ADD:
                 self._insert_mem(sid, SeriesKey.decode(key_b))
             else:
                 self._remove_mem(sid)
+            self._tail_count += 1
 
     def _insert_mem(self, sid: int, key: SeriesKey):
+        self._deleted.discard(sid)
         self._forward[sid] = key
         self._by_key[key] = sid
         self._by_table.setdefault(key.table, set()).add(sid)
@@ -66,6 +251,11 @@ class TSIndex:
     def _remove_mem(self, sid: int):
         key = self._forward.pop(sid, None)
         if key is None:
+            # may live in the checkpoint
+            key = self._ckpt_key(sid)
+            if key is not None:
+                self._deleted.add(sid)
+                self._key_cache.pop(sid, None)
             return
         self._by_key.pop(key, None)
         self._by_table.get(key.table, set()).discard(sid)
@@ -77,17 +267,184 @@ class TSIndex:
                 s.discard(sid)
                 if not s:
                     del vals[t.value]
+        # a sid can live in BOTH overlay and checkpoint (re-keyed after a
+        # checkpoint); removing the overlay copy must not let the stale
+        # checkpoint row resurrect it
+        if self._ckpt is not None and self._ckpt.row_of_sid(sid) is not None:
+            self._deleted.add(sid)
+            self._key_cache.pop(sid, None)
+
+    def _ckpt_key(self, sid: int) -> SeriesKey | None:
+        if self._ckpt is None or sid in self._deleted:
+            return None
+        hit = self._key_cache.get(sid)
+        if hit is not None:
+            return hit
+        row = self._ckpt.row_of_sid(sid)
+        if row is None:
+            return None
+        key = SeriesKey.decode(self._ckpt.key_bytes_at(row))
+        self._key_cache[sid] = key
+        return key
+
+    # -- checkpoint ------------------------------------------------------
+    def checkpoint(self):
+        """Rewrite the full index into a fresh checkpoint + empty binlog
+        (incremental-recovery contract: open cost is the tail, not the
+        history)."""
+        # materialize every live series: checkpoint rows + overlay
+        entries: list[tuple[int, bytes]] = []
+        if self._ckpt is not None:
+            for row in range(self._ckpt.n):
+                sid = int(self._ckpt.sids[row])
+                if sid in self._deleted or sid in self._forward:
+                    continue
+                entries.append((sid, bytes(self._ckpt.key_bytes_at(row))))
+        for sid, key in self._forward.items():
+            entries.append((sid, key.encode()))
+        entries.sort()
+        n = len(entries)
+
+        sids = np.array([e[0] for e in entries], dtype=np.uint64)
+        key_lens = np.array([len(e[1]) for e in entries], dtype=np.uint64)
+        key_offs = np.zeros(n + 1, dtype=np.uint64)
+        np.cumsum(key_lens, out=key_offs[1:])
+        key_blob = b"".join(e[1] for e in entries)
+
+        keys = [SeriesKey.decode(e[1]) for e in entries]
+        hashes = np.array([k.hash_id() for k in keys], dtype=np.uint64)
+        hash_perm = np.argsort(hashes, kind="stable").astype(np.uint32)
+        hashes_sorted = hashes[hash_perm]
+
+        # postings: (table, tag_key, tag_value) → sorted sid arrays, plus
+        # per-table all-series postings
+        inv: dict[str, dict[str, dict[str, list[int]]]] = {}
+        by_table: dict[str, list[int]] = {}
+        for (sid, _), k in zip(entries, keys):
+            by_table.setdefault(k.table, []).append(sid)
+            tbl = inv.setdefault(k.table, {})
+            for t in k.tags:
+                tbl.setdefault(t.key, {}).setdefault(t.value, []).append(sid)
+
+        postings_parts: list[np.ndarray] = []
+        post_off = 0
+        tables_meta: dict = {}
+        aux = bytearray()   # value dictionaries region (after header)
+
+        def push_postings(sid_list) -> tuple[int, int]:
+            nonlocal post_off
+            a = np.array(sorted(sid_list), dtype=np.uint64)
+            postings_parts.append(a)
+            off = post_off
+            post_off += len(a)
+            return off, len(a)
+
+        for table in sorted(inv):
+            t_meta = {"tags": {}}
+            t_meta["all"] = list(push_postings(by_table[table]))
+            for tag_key in sorted(inv[table]):
+                vals = inv[table][tag_key]
+                sorted_vals = sorted(vals)
+                voffs = np.zeros(len(sorted_vals) + 1, dtype=np.uint64)
+                vblob = bytearray()
+                poffs = np.zeros(len(sorted_vals) + 1, dtype=np.uint64)
+                for i, v in enumerate(sorted_vals):
+                    vb = v.encode()
+                    vblob += vb
+                    voffs[i + 1] = voffs[i] + len(vb)
+                    off, cnt = push_postings(vals[v])
+                    poffs[i] = off
+                    poffs[i + 1] = off + cnt
+                tag_meta = {"nv": len(sorted_vals), "voffs": len(aux)}
+                aux += voffs.tobytes()
+                tag_meta["vblob"] = len(aux)
+                aux += bytes(vblob)
+                tag_meta["poffs"] = len(aux)
+                aux += poffs.tobytes()
+                t_meta["tags"][tag_key] = tag_meta
+            tables_meta[table] = t_meta
+
+        postings = (np.concatenate(postings_parts) if postings_parts
+                    else np.empty(0, dtype=np.uint64))
+
+        # assemble sections after the aux region
+        sections = {}
+        body = bytearray(aux)
+
+        def add_section(name, raw: bytes, count: int):
+            sections[name] = [len(body), count]
+            body.extend(raw)
+
+        add_section("sids", sids.tobytes(), n)
+        add_section("key_offs", key_offs.tobytes(), n + 1)
+        add_section("key_blob", key_blob, len(key_blob))
+        add_section("hashes", hashes_sorted.tobytes(), n)
+        add_section("hash_perm", hash_perm.tobytes(), n)
+        add_section("postings", postings.tobytes(), len(postings))
+
+        header = msgpack.packb({
+            "n": n, "next_sid": self._next_sid,
+            "tables": tables_meta, "sections": sections,
+        }, use_bin_type=True)
+
+        tmp = self._ckpt_path + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(struct.pack("<III", _CKPT_MAGIC, _CKPT_VERSION,
+                                len(header)))
+            f.write(header)
+            f.write(bytes(body))
+            f.flush()
+            os.fsync(f.fileno())
+        old = self._ckpt
+        os.replace(tmp, self._ckpt_path)
+        # rotate the binlog: everything up to here is in the checkpoint.
+        # The replacement file gets its FILE_MAGIC header and an fsync
+        # BEFORE the rename (and the directory after), so a crash in this
+        # window can never leave an unopenable header-less binlog
+        self._binlog.close()
+        blt = self._binlog_path + ".tmp"
+        w = RecordWriter(blt)
+        w.sync()
+        w.close()
+        os.replace(blt, self._binlog_path)
+        dirfd = os.open(self.dir, os.O_RDONLY)
+        try:
+            os.fsync(dirfd)
+        finally:
+            os.close(dirfd)
+        self._binlog = RecordWriter(self._binlog_path)
+        self._tail_count = 0
+        if old is not None:
+            old.close()
+        self._ckpt = _Checkpoint(self._ckpt_path)
+        # overlay is now fully contained in the checkpoint
+        self._forward.clear()
+        self._by_key.clear()
+        self._inverted.clear()
+        self._by_table.clear()
+        self._deleted.clear()
+        self._key_cache.clear()
+
+    def _maybe_checkpoint(self):
+        # adaptive: rewrite cost is O(total), so demand the tail be a
+        # constant fraction of it — amortized O(log n) rewrites per series
+        # instead of O(n/threshold)
+        total = self._ckpt.n if self._ckpt is not None else 0
+        if self._tail_count >= max(CKPT_THRESHOLD, total // 2):
+            self.checkpoint()
 
     # -- write path ------------------------------------------------------
     def add_series_if_not_exists(self, key: SeriesKey) -> int:
         """→ series id (existing or newly assigned).
         Reference ts_index.rs:148."""
-        sid = self._by_key.get(key)
+        sid = self.get_series_id(key)
         if sid is not None:
             return sid
         sid = self._next_sid
         self._binlog.append(msgpack.packb([_OP_ADD, sid, key.encode()]))
         self._insert_mem(sid, key)
+        self._tail_count += 1
+        self._maybe_checkpoint()
         return sid
 
     def add_batch(self, keys: list[SeriesKey]) -> np.ndarray:
@@ -95,43 +452,110 @@ class TSIndex:
                         dtype=np.uint64)
 
     def del_series(self, sid: int):
-        if sid in self._forward:
+        if sid in self._forward or (self._ckpt is not None
+                                    and self._ckpt_key(sid) is not None):
             self._binlog.append(msgpack.packb([_OP_DEL, sid, b""]))
             self._remove_mem(sid)
+            self._tail_count += 1
+            self._maybe_checkpoint()
 
     def rename_series(self, sid: int, new_key: SeriesKey):
         """Re-key an existing series id (UPDATE <tag> path)."""
-        if sid not in self._forward:
+        if self.get_series_key(sid) is None:
             raise IndexError_(f"unknown series id {sid}")
         self._binlog.append(msgpack.packb([_OP_DEL, sid, b""]))
         self._remove_mem(sid)
         self._binlog.append(msgpack.packb([_OP_ADD, sid, new_key.encode()]))
         self._insert_mem(sid, new_key)
+        self._tail_count += 2
+        self._maybe_checkpoint()
 
     def sync(self):
         self._binlog.sync()
 
     def close(self):
         self._binlog.close()
+        if self._ckpt is not None:
+            self._ckpt.close()
+            self._ckpt = None
 
     # -- read path -------------------------------------------------------
     def get_series_key(self, sid: int) -> SeriesKey | None:
-        return self._forward.get(sid)
+        key = self._forward.get(sid)
+        if key is not None:
+            return key
+        return self._ckpt_key(sid)
 
     def get_series_id(self, key: SeriesKey) -> int | None:
-        return self._by_key.get(key)
+        sid = self._by_key.get(key)
+        if sid is not None:
+            return sid
+        if self._ckpt is not None:
+            sid = self._ckpt.lookup(key)
+            if sid is not None and sid not in self._deleted \
+                    and sid not in self._forward:
+                return sid
+        return None
 
     def series_count(self) -> int:
-        return len(self._forward)
+        n = len(self._forward)
+        if self._ckpt is not None:
+            # overlay may re-key checkpoint sids; count distinct live ids
+            ck = self._ckpt.n - len(self._deleted)
+            overlap = sum(1 for s in self._forward
+                          if self._ckpt.row_of_sid(s) is not None
+                          and s not in self._deleted)
+            n += ck - overlap
+        return n
+
+    def _combine(self, ckpt_arr: np.ndarray, overlay: set[int]) -> np.ndarray:
+        """checkpoint postings − deleted/re-keyed + overlay → sorted u64."""
+        parts = []
+        if len(ckpt_arr):
+            # checkpoint sids that were deleted OR re-keyed since (their
+            # postings live in the overlay now) must not surface
+            drop = self._deleted
+            if self._forward:
+                drop = drop | self._forward.keys()
+            if drop:
+                drop_a = np.fromiter(drop, dtype=np.uint64, count=len(drop))
+                ckpt_arr = ckpt_arr[~np.isin(ckpt_arr, drop_a)]
+            parts.append(np.asarray(ckpt_arr))
+        if overlay:
+            parts.append(np.fromiter(overlay, dtype=np.uint64,
+                                     count=len(overlay)))
+        if not parts:
+            return np.empty(0, dtype=np.uint64)
+        return np.unique(np.concatenate(parts))
 
     def table_series_ids(self, table: str) -> np.ndarray:
-        return _to_sorted_array(self._by_table.get(table, set()))
+        ck = (self._ckpt.table_sids(table) if self._ckpt is not None
+              else np.empty(0, dtype=np.uint64))
+        return self._combine(ck, self._by_table.get(table, set()))
 
     def tag_values(self, table: str, tag_key: str) -> list[str]:
-        return sorted(self._inverted.get(table, {}).get(tag_key, {}).keys())
+        vals = set(self._inverted.get(table, {}).get(tag_key, {}).keys())
+        if self._ckpt is not None:
+            ck_vals = self._ckpt.tag_values(table, tag_key)
+            if not self._deleted and not self._forward:
+                vals.update(ck_vals)   # nothing can have emptied a value
+            else:
+                for v in ck_vals:
+                    if len(self._value_sids(table, tag_key, v)):
+                        vals.add(v)
+        return sorted(vals)
 
     def tag_keys(self, table: str) -> list[str]:
-        return sorted(self._inverted.get(table, {}).keys())
+        keys = set(self._inverted.get(table, {}).keys())
+        if self._ckpt is not None:
+            keys.update(self._ckpt.tag_keys(table))
+        return sorted(keys)
+
+    def _value_sids(self, table: str, tag_key: str, value: str) -> np.ndarray:
+        ck = (self._ckpt.tag_value_sids(table, tag_key, value)
+              if self._ckpt is not None else np.empty(0, dtype=np.uint64))
+        ov = self._inverted.get(table, {}).get(tag_key, {}).get(value, set())
+        return self._combine(ck, ov)
 
     def get_series_ids_by_domains(self, table: str,
                                   domains: ColumnDomains) -> np.ndarray:
@@ -139,13 +563,14 @@ class TSIndex:
         (reference ts_index.rs:397)."""
         if domains.is_none:
             return np.empty(0, dtype=np.uint64)
-        all_sids = self._by_table.get(table, set())
         if domains.is_all:
-            return _to_sorted_array(all_sids)
-        result: set[int] | None = None
-        tbl_inv = self._inverted.get(table, {})
+            return self.table_series_ids(table)
+        result: np.ndarray | None = None
         for tag_key, dom in domains.domains.items():
-            if tag_key not in tbl_inv:
+            known = (tag_key in self._inverted.get(table, {})
+                     or (self._ckpt is not None
+                         and self._ckpt.has_tag(table, tag_key)))
+            if not known:
                 # unknown tag constrained: rows have no such tag → for an
                 # equality/set constraint nothing matches unless the domain
                 # admits absent (we treat absent as no-match, like reference
@@ -153,38 +578,40 @@ class TSIndex:
                 if isinstance(dom, AllDomain):
                     continue
                 return np.empty(0, dtype=np.uint64)
-            matched = _eval_tag_domain(tbl_inv[tag_key], dom)
-            result = matched if result is None else (result & matched)
-            if not result:
+            matched = self._eval_tag_domain(table, tag_key, dom)
+            result = matched if result is None else \
+                np.intersect1d(result, matched, assume_unique=True)
+            if not len(result):
                 return np.empty(0, dtype=np.uint64)
         if result is None:
-            result = all_sids
-        return _to_sorted_array(result)
+            return self.table_series_ids(table)
+        return result
 
-
-def _eval_tag_domain(value_map: dict[str, set[int]], dom: Domain) -> set[int]:
-    if isinstance(dom, AllDomain):
-        out: set[int] = set()
-        for s in value_map.values():
-            out |= s
-        return out
-    if isinstance(dom, NoneDomain):
-        return set()
-    if isinstance(dom, SetDomain):
-        out = set()
-        for v in dom.values:
-            out |= value_map.get(v, set())
-        return out
-    if isinstance(dom, RangeDomain):
-        out = set()
-        for v, sids in value_map.items():
-            if dom.contains_value(v):
-                out |= sids
-        return out
-    raise IndexError_(f"unsupported domain {type(dom).__name__}")
-
-
-def _to_sorted_array(s: set[int]) -> np.ndarray:
-    a = np.fromiter(s, dtype=np.uint64, count=len(s))
-    a.sort()
-    return a
+    def _eval_tag_domain(self, table: str, tag_key: str,
+                         dom: Domain) -> np.ndarray:
+        if isinstance(dom, NoneDomain):
+            return np.empty(0, dtype=np.uint64)
+        if isinstance(dom, SetDomain):
+            parts = [self._value_sids(table, tag_key, v) for v in dom.values]
+            parts = [p for p in parts if len(p)]
+            if not parts:
+                return np.empty(0, dtype=np.uint64)
+            return np.unique(np.concatenate(parts))
+        if isinstance(dom, AllDomain):
+            ck = (self._ckpt.tag_all_sids(table, tag_key)
+                  if self._ckpt is not None else np.empty(0, dtype=np.uint64))
+            ov: set[int] = set()
+            for s in self._inverted.get(table, {}).get(tag_key, {}).values():
+                ov |= s
+            return self._combine(ck, ov)
+        if isinstance(dom, RangeDomain):
+            vals = set(self._inverted.get(table, {}).get(tag_key, {}).keys())
+            if self._ckpt is not None:
+                vals.update(self._ckpt.tag_values(table, tag_key))
+            parts = [self._value_sids(table, tag_key, v)
+                     for v in vals if dom.contains_value(v)]
+            parts = [p for p in parts if len(p)]
+            if not parts:
+                return np.empty(0, dtype=np.uint64)
+            return np.unique(np.concatenate(parts))
+        raise IndexError_(f"unsupported domain {type(dom).__name__}")

@@ -29,6 +29,10 @@ def free_port() -> int:
 def _env():
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"  # keep subprocesses off the TPU tunnel
+    # the axon PJRT plugin dials the relay AT IMPORT when this is set —
+    # even under JAX_PLATFORMS=cpu — and a degraded relay then stalls
+    # every node process for up to minutes; tests must never depend on it
+    env.pop("PALLAS_AXON_POOL_IPS", None)
     env.setdefault("PYTHONUNBUFFERED", "1")
     return env
 

@@ -6,6 +6,10 @@ at the very top of conftest.
 import os
 
 os.environ["JAX_PLATFORMS"] = "cpu"  # force: env presets JAX_PLATFORMS=axon
+# the axon plugin's sitecustomize registration dials the TPU relay at
+# interpreter start when this is set; a degraded relay would stall the
+# whole suite, and tests run on the virtual CPU mesh regardless
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
