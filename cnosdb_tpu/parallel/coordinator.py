@@ -46,9 +46,13 @@ class PlacedSplit:
 class Coordinator:
     SCAN_CACHE_SIZE = 32
 
-    def __init__(self, meta, engine: TsKv, node_id: int | None = None):
+    def __init__(self, meta, engine: TsKv, node_id: int | None = None,
+                 memory_pool=None):
+        from ..utils.memory_pool import DEFAULT_POOL
+
         self.meta = meta
         self.engine = engine
+        self.memory_pool = memory_pool or DEFAULT_POOL
         # distributed iff the catalog is a remote MetaClient: placement may
         # then name vnodes on other nodes, reached over RPC
         self.distributed = not isinstance(meta, MetaStore)
@@ -91,6 +95,13 @@ class Coordinator:
         (reference service.rs:565 write_lines)."""
         owner = f"{tenant}.{db}"
         self.meta.database(tenant, db)  # raises if missing
+        # gate large ingests on the memory budget (reference raft/writer.rs
+        # :58-84 gates writes on GreedyMemoryPool)
+        est = batch.n_rows() * 128
+        with self.memory_pool.reservation(est, f"write to {owner}"):
+            self._write_points_inner(tenant, db, owner, batch, sync)
+
+    def _write_points_inner(self, tenant, db, owner, batch, sync):
         per_rs: dict[int, tuple[object, WriteBatch]] = {}
         for table, series_list in batch.tables.items():
             self._ensure_schema(tenant, db, table, series_list)

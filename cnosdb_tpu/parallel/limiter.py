@@ -1,0 +1,81 @@
+"""Tenant rate limiting: token buckets.
+
+Role-parity with the reference's limiter stack (common/limiter_bucket
+CountBucket + meta/src/limiter/local_request_limiter.rs:44): each tenant's
+TenantOptions may carry a `limiter` dict
+
+    {"max_writes_per_sec": N, "max_queries_per_sec": N,
+     "max_points_per_sec": N}
+
+and the HTTP layer checks the matching bucket per request (reference
+http_limiter_check_write in http_service.rs). Buckets refill continuously
+(rate per second, burst = one second's allowance) and are purely local per
+process — the reference's remote-bucket escalation to meta is a later
+round."""
+from __future__ import annotations
+
+import threading
+import time
+
+from ..errors import LimiterError
+
+
+class TokenBucket:
+    """Continuous-refill token bucket (reference CountBucket)."""
+
+    def __init__(self, rate_per_sec: float, burst: float | None = None):
+        self.rate = float(rate_per_sec)
+        self.capacity = float(burst if burst is not None else rate_per_sec)
+        self.tokens = self.capacity
+        self.t_last = time.monotonic()
+        self.lock = threading.Lock()
+
+    def try_acquire(self, n: float = 1.0) -> bool:
+        with self.lock:
+            now = time.monotonic()
+            self.tokens = min(self.capacity,
+                              self.tokens + (now - self.t_last) * self.rate)
+            self.t_last = now
+            if self.tokens >= n:
+                self.tokens -= n
+                return True
+            return False
+
+
+class TenantLimiters:
+    """Per-tenant bucket registry fed from TenantOptions.limiter."""
+
+    def __init__(self, meta):
+        self.meta = meta
+        self._buckets: dict[tuple[str, str], TokenBucket] = {}
+        self._lock = threading.Lock()
+
+    def _bucket(self, tenant: str, kind: str) -> TokenBucket | None:
+        opts = self.meta.tenants.get(tenant)
+        cfg = getattr(opts, "limiter", None) if opts is not None else None
+        if not cfg:
+            return None
+        rate = cfg.get(f"max_{kind}_per_sec")
+        if not rate:
+            return None
+        key = (tenant, kind)
+        with self._lock:
+            b = self._buckets.get(key)
+            if b is None or b.rate != float(rate):
+                b = self._buckets[key] = TokenBucket(rate)
+            return b
+
+    def check_write(self, tenant: str, n_points: int = 0):
+        b = self._bucket(tenant, "writes")
+        if b is not None and not b.try_acquire(1):
+            raise LimiterError(f"tenant {tenant!r} write rate limit exceeded")
+        if n_points:
+            pb = self._bucket(tenant, "points")
+            if pb is not None and not pb.try_acquire(n_points):
+                raise LimiterError(
+                    f"tenant {tenant!r} points rate limit exceeded")
+
+    def check_query(self, tenant: str):
+        b = self._bucket(tenant, "queries")
+        if b is not None and not b.try_acquire(1):
+            raise LimiterError(f"tenant {tenant!r} query rate limit exceeded")

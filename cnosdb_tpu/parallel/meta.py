@@ -271,6 +271,10 @@ class MetaStore:
                 raise TenantNotFound(tenant)
             if user not in self.users:
                 raise MetaError(f"user {user!r} missing")
+            if role not in ("member", "owner") \
+                    and role not in self.roles.get(tenant, {}):
+                raise MetaError(
+                    f"unknown role {role!r} in tenant {tenant!r}")
             self.members.setdefault(tenant, {})[user] = role
             self._persist()
 
@@ -296,6 +300,88 @@ class MetaStore:
             if tenant == DEFAULT_TENANT:
                 return True
             return user in self.members.get(tenant, {})
+
+    # ------------------------------------------------------------ roles/RBAC
+    # role spec: {"inherit": "member"|"owner", "privileges": {db: level}}
+    # levels order read < write < all (reference common/models/src/auth/
+    # privilege.rs DatabasePrivilege)
+    _PRIV_ORDER = {"read": 0, "write": 1, "all": 2}
+
+    def create_role(self, tenant: str, name: str, inherit: str = "member"):
+        with self.lock:
+            if tenant not in self.tenants:
+                raise TenantNotFound(tenant)
+            roles = self.roles.setdefault(tenant, {})
+            if name in roles or name in ("owner", "member"):
+                raise MetaError(f"role {name!r} exists in tenant {tenant!r}")
+            if inherit not in ("member", "owner"):
+                raise MetaError(f"role can only inherit member|owner")
+            roles[name] = {"inherit": inherit, "privileges": {}}
+            self._persist()
+
+    def drop_role(self, tenant: str, name: str):
+        with self.lock:
+            self.roles.get(tenant, {}).pop(name, None)
+            members = self.members.get(tenant, {})
+            for user, role in list(members.items()):
+                if role == name:
+                    members[user] = "member"
+            self._persist()
+
+    def list_roles(self, tenant: str) -> dict:
+        with self.lock:
+            out = {"owner": {"inherit": "owner", "privileges": {}},
+                   "member": {"inherit": "member", "privileges": {}}}
+            out.update(self.roles.get(tenant, {}))
+            return out
+
+    def grant_db_privilege(self, tenant: str, role: str, db: str, level: str):
+        if level not in self._PRIV_ORDER:
+            raise MetaError(f"bad privilege level {level!r}")
+        with self.lock:
+            spec = self.roles.get(tenant, {}).get(role)
+            if spec is None:
+                raise MetaError(f"unknown role {role!r} (system roles "
+                                "cannot be granted to)")
+            spec["privileges"][db] = level
+            self._persist()
+
+    def revoke_db_privilege(self, tenant: str, role: str, db: str):
+        with self.lock:
+            spec = self.roles.get(tenant, {}).get(role)
+            if spec is not None:
+                spec["privileges"].pop(db, None)
+                self._persist()
+
+    def check_db_privilege(self, user: str, tenant: str, db: str,
+                           need: str) -> bool:
+        """Does `user` hold `need` (read|write|all) on tenant.db?
+        (reference auth/auth_control.rs AccessControlImpl)."""
+        with self.lock:
+            u = self.users.get(user)
+            if u is None:
+                return False
+            if u.get("admin"):
+                return True
+            role = self.members.get(tenant, {}).get(user)
+            if role is None:
+                # non-members of the default tenant get member rights there
+                if tenant == DEFAULT_TENANT:
+                    role = "member"
+                else:
+                    return False
+            need_rank = self._PRIV_ORDER[need]
+            if role == "owner":
+                return True
+            if role == "member":
+                return need_rank <= self._PRIV_ORDER["read"]
+            spec = self.roles.get(tenant, {}).get(role)
+            if spec is None:
+                return False
+            if spec.get("inherit") == "owner":
+                return True
+            granted = spec["privileges"].get(db, "read")
+            return need_rank <= self._PRIV_ORDER[granted]
 
     # ------------------------------------------------------------ databases
     def create_database(self, schema: DatabaseSchema, if_not_exists: bool = False):

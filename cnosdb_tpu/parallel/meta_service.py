@@ -45,6 +45,7 @@ MUTATIONS = frozenset([
     "drop_database", "create_table", "update_table", "drop_table",
     "create_stream", "drop_stream", "locate_bucket_for_write",
     "expire_buckets", "register_node", "report_heartbeat",
+    "create_role", "drop_role", "grant_db_privilege", "revoke_db_privilege",
 ])
 
 
@@ -291,6 +292,21 @@ class MetaClient:
     def register_node(self, node_id, grpc_addr="", http_addr=""):
         return self._forward("register_node", node_id=node_id,
                              grpc_addr=grpc_addr, http_addr=http_addr)
+
+    def create_role(self, tenant, name, inherit="member"):
+        return self._forward("create_role", tenant=tenant, name=name,
+                             inherit=inherit)
+
+    def drop_role(self, tenant, name):
+        return self._forward("drop_role", tenant=tenant, name=name)
+
+    def grant_db_privilege(self, tenant, role, db, level):
+        return self._forward("grant_db_privilege", tenant=tenant, role=role,
+                             db=db, level=level)
+
+    def revoke_db_privilege(self, tenant, role, db):
+        return self._forward("revoke_db_privilege", tenant=tenant, role=role,
+                             db=db)
 
     def expire_buckets(self, tenant, db, now_ns):
         return self._forward("expire_buckets", tenant=tenant, db=db,

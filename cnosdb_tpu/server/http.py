@@ -34,6 +34,9 @@ class HttpServer:
         self.executor = executor
         self.auth_enabled = auth_enabled
         self.metrics = MetricsRegistry()
+        from ..parallel.limiter import TenantLimiters
+
+        self.limiters = TenantLimiters(meta)
         self.app = web.Application(client_max_size=512 * 1024 * 1024)
         self.app.add_routes([
             web.post("/api/v1/write", self.handle_write),
@@ -73,12 +76,25 @@ class HttpServer:
         db = request.query.get("db", "public")
         return Session(tenant=tenant, database=db, user=user)
 
+    def _authorize_write(self, session: Session):
+        """RBAC write gate for the ingest endpoints — line-protocol /
+        OpenTSDB / prom / ES writes must clear the same bar as SQL INSERT
+        (reference http_service.rs privilege checks per route)."""
+        if not self.auth_enabled:
+            return
+        if not self.meta.check_db_privilege(session.user, session.tenant,
+                                            session.database, "write"):
+            raise web.HTTPForbidden(
+                text=f"user {session.user!r} lacks write privilege on "
+                     f"{session.tenant}.{session.database}")
+
     # ------------------------------------------------------------- handlers
     async def handle_ping(self, request):
         return web.json_response({"version": __version__, "status": "healthy"})
 
     async def handle_write(self, request):
         session = self._session(request)
+        self._authorize_write(session)
         precision = request.query.get("precision", "ns")
         try:
             prec = Precision.parse(precision)
@@ -87,6 +103,7 @@ class HttpServer:
         body = await request.text()
         try:
             batch = parse_lines(body, prec)
+            self.limiters.check_write(session.tenant, batch.n_rows())
             loop = asyncio.get_running_loop()
             await loop.run_in_executor(
                 None, lambda: self.coord.write_points(
@@ -105,6 +122,7 @@ class HttpServer:
             return _err_response(400, QueryError("empty sql"))
         accept = request.headers.get("Accept", "application/csv")
         try:
+            self.limiters.check_query(session.tenant)
             loop = asyncio.get_running_loop()
             results = await loop.run_in_executor(
                 None, lambda: self.executor.execute_sql(sql, session))
@@ -124,6 +142,7 @@ class HttpServer:
         """OpenTSDB telnet-style put lines over HTTP (reference
         tcp_service + opentsdb parser)."""
         session = self._session(request)
+        self._authorize_write(session)
         body = await request.text()
         from ..protocol.opentsdb import parse_opentsdb
 
@@ -141,6 +160,7 @@ class HttpServer:
         """Prometheus remote write: snappy + prompb (reference
         prom/remote_server.rs remote_write)."""
         session = self._session(request)
+        self._authorize_write(session)
         from ..protocol.prometheus import parse_remote_write, snappy_available
 
         if not snappy_available():
@@ -166,6 +186,7 @@ class HttpServer:
     async def handle_es_bulk(self, request):
         """ES-style log ingest (reference `_bulk` json_protocol API)."""
         session = self._session(request)
+        self._authorize_write(session)
         table = request.query.get("table", "logs")
         tag_keys = tuple(t for t in request.query.get("tags", "").split(",") if t)
         from ..protocol.es_bulk import parse_es_bulk
@@ -272,11 +293,14 @@ def format_table(rs: ResultSet) -> str:
 
 def _status_for(e: CnosError) -> int:
     from ..errors import (
-        AuthError, DatabaseNotFound, ParserError, PlanError, TableNotFound,
+        AuthError, DatabaseNotFound, LimiterError, ParserError, PlanError,
+        TableNotFound,
     )
 
     if isinstance(e, AuthError):
-        return 401
+        return 403
+    if isinstance(e, LimiterError):
+        return 429
     if isinstance(e, (ParserError, PlanError, DatabaseNotFound, TableNotFound)):
         return 422
     return 500

@@ -203,6 +203,42 @@ class AlterUser:
 
 
 @dataclass
+class CreateRole:
+    """CREATE ROLE r [INHERIT member|owner] (reference ast.rs CreateRole)."""
+
+    name: str
+    inherit: str = "member"
+    if_not_exists: bool = False
+
+
+@dataclass
+class DropRole:
+    name: str
+    if_exists: bool = False
+
+
+@dataclass
+class GrantRevoke:
+    """GRANT/REVOKE READ|WRITE|ALL ON DATABASE db TO|FROM ROLE r
+    (reference ast.rs GrantRevoke)."""
+
+    grant: bool
+    level: str          # read|write|all
+    database: str
+    role: str
+
+
+@dataclass
+class AlterTenantMember:
+    """ALTER TENANT t ADD USER u AS r | REMOVE USER u."""
+
+    tenant: str
+    user: str
+    role: str | None = None     # None = REMOVE
+    add: bool = True
+
+
+@dataclass
 class CreateStream:
     name: str
     target: str

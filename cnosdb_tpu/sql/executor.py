@@ -114,12 +114,16 @@ class QueryTracker:
 
 
 class QueryExecutor:
-    def __init__(self, meta: MetaStore, coord: Coordinator):
+    def __init__(self, meta: MetaStore, coord: Coordinator,
+                 memory_pool=None):
         import threading as _th
+
+        from ..utils.memory_pool import DEFAULT_POOL
 
         self.meta = meta
         self.coord = coord
         self.tracker = QueryTracker()
+        self.memory_pool = memory_pool or DEFAULT_POOL
         self._stream_engine = None
         self._stream_lock = _th.Lock()
 
@@ -153,6 +157,7 @@ class QueryExecutor:
         return rs[-1] if rs else ResultSet.empty()
 
     def execute_statement(self, stmt, session: Session) -> ResultSet:
+        self._check_privilege(stmt, session)
         if isinstance(stmt, ast.SelectStmt):
             return self._select(stmt, session)
         if isinstance(stmt, ast.UnionStmt):
@@ -207,6 +212,40 @@ class QueryExecutor:
         if isinstance(stmt, ast.AlterUser):
             self.meta.alter_user(stmt.name, stmt.password)
             return ResultSet.message("ok")
+        if isinstance(stmt, ast.CreateRole):
+            from ..errors import MetaError
+
+            try:
+                self.meta.create_role(session.tenant, stmt.name, stmt.inherit)
+            except MetaError as e:
+                # IF NOT EXISTS only forgives the already-exists case —
+                # bad INHERIT or a missing tenant must still surface
+                if not (stmt.if_not_exists and "exists" in str(e)):
+                    raise
+            return ResultSet.message("ok")
+        if isinstance(stmt, ast.DropRole):
+            from ..errors import MetaError
+
+            if stmt.name not in self.meta.list_roles(session.tenant):
+                if stmt.if_exists:
+                    return ResultSet.message("ok")
+                raise MetaError(f"unknown role {stmt.name!r}")
+            self.meta.drop_role(session.tenant, stmt.name)
+            return ResultSet.message("ok")
+        if isinstance(stmt, ast.GrantRevoke):
+            if stmt.grant:
+                self.meta.grant_db_privilege(session.tenant, stmt.role,
+                                             stmt.database, stmt.level)
+            else:
+                self.meta.revoke_db_privilege(session.tenant, stmt.role,
+                                              stmt.database)
+            return ResultSet.message("ok")
+        if isinstance(stmt, ast.AlterTenantMember):
+            if stmt.add:
+                self.meta.add_member(stmt.tenant, stmt.user, stmt.role)
+            else:
+                self.meta.remove_member(stmt.tenant, stmt.user)
+            return ResultSet.message("ok")
         if isinstance(stmt, ast.CreateStream):
             return self._create_stream(stmt, session)
         if isinstance(stmt, ast.DropStream):
@@ -226,6 +265,54 @@ class QueryExecutor:
             self.coord.engine.flush_all()
             return ResultSet.message("ok")
         raise ExecutionError(f"unsupported statement {type(stmt).__name__}")
+
+    # privilege needed per statement class
+    _READ_STMTS = (ast.SelectStmt, ast.UnionStmt, ast.ShowStmt,
+                   ast.DescribeStmt, ast.ExplainStmt)
+    _WRITE_STMTS = (ast.InsertStmt, ast.DeleteStmt, ast.UpdateStmt)
+    # instance-level administration: NEVER grantable through tenant roles
+    # (a tenant owner resetting the system admin's password would be a
+    # full privilege escalation)
+    _ADMIN_STMTS = (ast.CreateUser, ast.DropUser, ast.AlterUser,
+                    ast.CreateTenant, ast.DropTenant)
+
+    def _check_privilege(self, stmt, session: Session):
+        """RBAC gate (reference auth/auth_control.rs AccessControlImpl →
+        privilege checks on the logical plan): reads need read, DML needs
+        write, tenant-scoped DDL needs tenant-owner, instance admin needs
+        an admin user. Admin users and unauthenticated embedded sessions
+        (user 'root') pass through."""
+        from ..errors import AuthError
+
+        user = session.user
+        u = self.meta.users.get(user)
+        if u is None or u.get("admin"):
+            return  # unknown → authentication already failed upstream
+        if isinstance(stmt, self._ADMIN_STMTS):
+            raise AuthError(
+                f"user {user!r} is not an admin (instance administration)")
+        if isinstance(stmt, ast.AlterTenantMember):
+            # scope the check to the TARGET tenant, not the session's
+            if not self.meta.check_db_privilege(user, stmt.tenant, "", "all"):
+                raise AuthError(
+                    f"user {user!r} is not an owner of tenant "
+                    f"{stmt.tenant!r}")
+            return
+        if isinstance(stmt, self._READ_STMTS):
+            need = "read"
+        elif isinstance(stmt, self._WRITE_STMTS):
+            need = "write"
+        else:
+            need = "all"
+        db = getattr(stmt, "database", None) or session.database
+        from .system_tables import is_system_db
+
+        if is_system_db(db) and need == "read":
+            return
+        if not self.meta.check_db_privilege(user, session.tenant, db, need):
+            raise AuthError(
+                f"user {user!r} lacks {need} privilege on "
+                f"{session.tenant}.{db}")
 
     # ------------------------------------------------------------------ streams
     def stream_engine(self):
@@ -401,6 +488,25 @@ class QueryExecutor:
                            else "<callback>" for n in names], dtype=object),
                  np.array([se.streams[n].interval_s for n in names]),
                  np.array([se.streams[n].sql[:120] for n in names], dtype=object)])
+        if stmt.kind == "roles":
+            roles = self.meta.list_roles(session.tenant)
+            names = sorted(roles)
+            return ResultSet(
+                ["role_name", "inherit", "privileges"],
+                [np.array(names, dtype=object),
+                 np.array([roles[n].get("inherit", "") for n in names],
+                          dtype=object),
+                 np.array([", ".join(f"{db}:{lv}" for db, lv in
+                                     sorted(roles[n].get("privileges", {})
+                                            .items()))
+                           for n in names], dtype=object)])
+        if stmt.kind == "users":
+            users = sorted(self.meta.users)
+            return ResultSet(
+                ["user_name", "is_admin"],
+                [np.array(users, dtype=object),
+                 np.array([bool(self.meta.users[u].get("admin"))
+                           for u in users])])
         raise ExecutionError(f"unsupported SHOW {stmt.kind}")
 
     def _describe(self, stmt: ast.DescribeStmt, session: Session):
@@ -958,7 +1064,12 @@ class QueryExecutor:
         batches = self.coord.scan_table(
             tenant, db, plan.table, time_ranges=plan.time_ranges,
             tag_domains=plan.tag_domains, field_names=needed_fields)
+        with self.memory_pool.reservation(_batches_bytes(batches),
+                                          f"scan of {plan.table}"):
+            return self._exec_aggregate_batches(plan, batches, phys_aggs,
+                                                finalize)
 
+    def _exec_aggregate_batches(self, plan, batches, phys_aggs, finalize):
         host_funcs = ("count_distinct", "collect", "collect_ts")
         q = TpuQuery(filter=plan.filter, group_tags=plan.group_tags,
                      time_bucket=plan.bucket,
@@ -1098,7 +1209,11 @@ class QueryExecutor:
         batches = self.coord.scan_table(
             tenant, db, plan.table, time_ranges=plan.time_ranges,
             tag_domains=plan.tag_domains, field_names=field_names)
+        with self.memory_pool.reservation(_batches_bytes(batches),
+                                          f"scan of {plan.table}"):
+            return self._exec_raw_batches(plan, batches)
 
+    def _exec_raw_batches(self, plan: RawScanPlan, batches):
         frames = []
         for b in batches:
             env = {"time": b.ts}
@@ -1249,6 +1364,16 @@ _SERIES_AGGS = {"increase", "sample", "gauge_agg", "state_agg",
 
 # row-set-valued repair transforms (reference ts_gen_func)
 _REPAIR_FUNCS = {"timestamp_repair", "value_fill", "value_repair"}
+
+
+def _batches_bytes(batches) -> int:
+    """Rough working-set estimate of scan batches for memory-pool gating."""
+    total = 0
+    for b in batches:
+        total += b.ts.nbytes + b.sid_ordinal.nbytes
+        for _vt, vals, valid in b.fields.values():
+            total += getattr(vals, "nbytes", 0) + getattr(valid, "nbytes", 0)
+    return total
 
 
 def _out_name(it: ast.SelectItem) -> str:

@@ -245,6 +245,16 @@ class Parser:
             self.next()
             self.accept_kw("QUERY")
             return ast.KillQuery(int(self.expect_number()))
+        if k in ("GRANT", "REVOKE"):
+            grant = k == "GRANT"
+            self.next()
+            level = self.expect_kw("READ", "WRITE", "ALL").lower()
+            self.expect_kw("ON")
+            self.expect_kw("DATABASE")
+            db = self.expect_ident()
+            self.expect_kw("TO" if grant else "FROM")
+            self.expect_kw("ROLE")
+            return ast.GrantRevoke(grant, level, db, self.expect_ident())
         raise ParserError(f"unsupported statement start {self.peek().value!r}")
 
     # -- SELECT ----------------------------------------------------------
@@ -487,6 +497,14 @@ class Parser:
                         break
                     self.accept_op(",")
             return ast.CreateUser(name, password, ine, comment)
+        if k == "ROLE":
+            self.next()
+            ine = self._if_not_exists()
+            name = self.expect_ident()
+            inherit = "member"
+            if self.accept_kw("INHERIT"):
+                inherit = self.expect_ident().lower()
+            return ast.CreateRole(name, inherit, ine)
         raise ParserError(f"unsupported CREATE {k}")
 
     def _if_not_exists(self) -> bool:
@@ -527,6 +545,10 @@ class Parser:
             self.next()
             ie = self._if_exists()
             return ast.DropUser(self.expect_ident(), ie)
+        if k == "ROLE":
+            self.next()
+            ie = self._if_exists()
+            return ast.DropRole(self.expect_ident(), ie)
         raise ParserError(f"unsupported DROP {k}")
 
     def parse_alter(self):
@@ -582,6 +604,21 @@ class Parser:
             self.expect_kw("PASSWORD")
             self.accept_op("=")
             return ast.AlterUser(name, self.expect_string())
+        if k == "TENANT":
+            self.next()
+            tenant = self.expect_ident()
+            if self.accept_kw("ADD"):
+                self.expect_kw("USER")
+                user = self.expect_ident()
+                role = "member"
+                if self.accept_kw("AS"):
+                    role = self.expect_ident()
+                return ast.AlterTenantMember(tenant, user, role, add=True)
+            if self.accept_kw("REMOVE"):
+                self.expect_kw("USER")
+                return ast.AlterTenantMember(tenant, self.expect_ident(),
+                                             add=False)
+            raise ParserError("ALTER TENANT expects ADD USER or REMOVE USER")
         raise ParserError(f"unsupported ALTER {k}")
 
     def parse_show(self):
@@ -632,6 +669,12 @@ class Parser:
         if k == "STREAMS":
             self.next()
             return ast.ShowStmt("streams")
+        if k == "ROLES":
+            self.next()
+            return ast.ShowStmt("roles")
+        if k == "USERS":
+            self.next()
+            return ast.ShowStmt("users")
         raise ParserError(f"unsupported SHOW {k}")
 
     def parse_describe(self):

@@ -1,0 +1,60 @@
+"""Greedy memory pool: a fixed byte budget gating queries and writes.
+
+Role-parity with the reference's GreedyMemoryPool
+(common/memory_pool/src/lib.rs:18-60, wired into writes at
+coordinator/src/raft/writer.rs:58-84 and into DataFusion queries): callers
+acquire an estimate before materializing large buffers and release when
+done; an acquisition that would exceed the budget fails the operation
+instead of OOM-killing the process."""
+from __future__ import annotations
+
+import threading
+
+from ..errors import CnosError
+
+
+class MemoryExhausted(CnosError):
+    pass
+
+
+class MemoryPool:
+    def __init__(self, capacity_bytes: int):
+        self.capacity = int(capacity_bytes)
+        self.used = 0
+        self._lock = threading.Lock()
+
+    def acquire(self, n: int, what: str = "buffer"):
+        with self._lock:
+            if self.used + n > self.capacity:
+                raise MemoryExhausted(
+                    f"memory pool exhausted acquiring {n} bytes for {what} "
+                    f"({self.used}/{self.capacity} in use)")
+            self.used += n
+
+    def release(self, n: int):
+        with self._lock:
+            self.used = max(0, self.used - n)
+
+    def reservation(self, n: int, what: str = "buffer"):
+        return _Reservation(self, n, what)
+
+
+class _Reservation:
+    """Context manager: acquire on enter, release on exit."""
+
+    def __init__(self, pool: MemoryPool, n: int, what: str):
+        self.pool = pool
+        self.n = int(n)
+        self.what = what
+
+    def __enter__(self):
+        self.pool.acquire(self.n, self.what)
+        return self
+
+    def __exit__(self, *exc):
+        self.pool.release(self.n)
+        return False
+
+
+# a generous default for embedded/test use; servers size it from config
+DEFAULT_POOL = MemoryPool(4 << 30)

@@ -1,0 +1,145 @@
+"""RBAC roles/privileges, tenant rate limiters, memory pool (reference
+common/models/src/auth/, meta/src/limiter/local_request_limiter.rs:44,
+common/memory_pool/src/lib.rs:18-60)."""
+import time
+
+import pytest
+
+from cnosdb_tpu.errors import AuthError, LimiterError
+from cnosdb_tpu.models.schema import TenantOptions
+from cnosdb_tpu.parallel.coordinator import Coordinator
+from cnosdb_tpu.parallel.limiter import TenantLimiters, TokenBucket
+from cnosdb_tpu.parallel.meta import MetaStore
+from cnosdb_tpu.sql.executor import QueryExecutor, Session
+from cnosdb_tpu.storage.engine import TsKv
+from cnosdb_tpu.utils.memory_pool import MemoryExhausted, MemoryPool
+
+
+@pytest.fixture
+def db(tmp_path):
+    meta = MetaStore(str(tmp_path / "meta.json"))
+    engine = TsKv(str(tmp_path / "data"))
+    coord = Coordinator(meta, engine)
+    ex = QueryExecutor(meta, coord)
+    yield ex
+    coord.close()
+
+
+def test_rbac_grant_revoke_flow(db):
+    root = Session()
+    db.execute_one("CREATE USER reader WITH PASSWORD = 'r'", root)
+    db.execute_one("CREATE USER writer WITH PASSWORD = 'w'", root)
+    db.execute_one("CREATE ROLE rw INHERIT member", root)
+    db.execute_one("GRANT WRITE ON DATABASE public TO ROLE rw", root)
+    db.execute_one("ALTER TENANT cnosdb ADD USER reader AS member", root)
+    db.execute_one("ALTER TENANT cnosdb ADD USER writer AS rw", root)
+    db.execute_one("CREATE TABLE t (v DOUBLE, TAGS(h))", root)
+    db.execute_one("INSERT INTO t (time, h, v) VALUES (1, 'a', 1)", root)
+
+    reader = Session(user="reader")
+    writer = Session(user="writer")
+    # member: read ok, write denied
+    assert db.execute_one("SELECT count(*) FROM t", reader).columns[0][0] == 1
+    with pytest.raises(AuthError):
+        db.execute_one("INSERT INTO t (time, h, v) VALUES (2, 'a', 2)", reader)
+    # custom role with WRITE: read + write ok, DDL denied
+    db.execute_one("INSERT INTO t (time, h, v) VALUES (2, 'a', 2)", writer)
+    assert db.execute_one("SELECT count(*) FROM t", writer).columns[0][0] == 2
+    with pytest.raises(AuthError):
+        db.execute_one("DROP TABLE t", writer)
+    # revoke takes write away again
+    db.execute_one("REVOKE WRITE ON DATABASE public FROM ROLE rw", root)
+    with pytest.raises(AuthError):
+        db.execute_one("INSERT INTO t (time, h, v) VALUES (3, 'a', 3)", writer)
+    # SHOW ROLES lists system + custom roles
+    rs = db.execute_one("SHOW ROLES", root)
+    assert set(rs.columns[0].tolist()) >= {"owner", "member", "rw"}
+
+
+def test_rbac_owner_and_nonmember(db):
+    root = Session()
+    db.execute_one("CREATE USER boss WITH PASSWORD = 'b'", root)
+    db.execute_one("CREATE USER stranger WITH PASSWORD = 's'", root)
+    db.execute_one("CREATE TENANT acme", root)
+    db.execute_one("ALTER TENANT acme ADD USER boss AS owner", root)
+    owner = Session(tenant="acme", user="boss")
+    # owners may run DDL in their tenant
+    db.execute_one("CREATE DATABASE d WITH SHARD 1", owner)
+    # non-member denied entirely
+    with pytest.raises(AuthError):
+        db.execute_one("SELECT 1", Session(tenant="acme", database="d",
+                                           user="stranger"))
+
+
+def test_token_bucket():
+    b = TokenBucket(10)  # 10/s, burst 10
+    assert all(b.try_acquire() for _ in range(10))
+    assert not b.try_acquire()
+    time.sleep(0.25)
+    assert b.try_acquire()  # ~2.5 tokens refilled
+    assert not b.try_acquire(5)
+
+
+def test_tenant_limiters(db):
+    meta = db.meta
+    meta.create_tenant("lim", TenantOptions(
+        limiter={"max_writes_per_sec": 2, "max_queries_per_sec": 1000}))
+    lims = TenantLimiters(meta)
+    lims.check_write("lim")
+    lims.check_write("lim")
+    with pytest.raises(LimiterError):
+        lims.check_write("lim")
+    # unlimited tenant never throttles
+    for _ in range(100):
+        lims.check_write("cnosdb")
+    lims.check_query("lim")
+
+
+def test_memory_pool_gates_queries(tmp_path):
+    meta = MetaStore(str(tmp_path / "meta.json"))
+    engine = TsKv(str(tmp_path / "data"))
+    coord = Coordinator(meta, engine)
+    ex = QueryExecutor(meta, coord, memory_pool=MemoryPool(512))
+    ex.execute_one("CREATE TABLE m (v DOUBLE, TAGS(h))")
+    vals = ", ".join(f"({i}, 'a', {i})" for i in range(200))
+    ex.execute_one(f"INSERT INTO m (time, h, v) VALUES {vals}")
+    with pytest.raises(MemoryExhausted):
+        ex.execute_one("SELECT sum(v) FROM m")   # scan exceeds 512 bytes
+    ex.memory_pool = MemoryPool(1 << 20)
+    assert ex.execute_one("SELECT count(*) FROM m").columns[0][0] == 200
+    assert ex.memory_pool.used == 0   # released after the query
+    coord.close()
+
+
+def test_rbac_no_privilege_escalation(db):
+    """Tenant owners must NOT reach instance administration or foreign
+    tenants (review findings: ALTER USER root, cross-tenant ALTER TENANT)."""
+    root = Session()
+    db.execute_one("CREATE USER boss WITH PASSWORD = 'b'", root)
+    db.execute_one("CREATE TENANT corp", root)
+    db.execute_one("ALTER TENANT corp ADD USER boss AS owner", root)
+    owner = Session(tenant="corp", user="boss")
+    with pytest.raises(AuthError):
+        db.execute_one("ALTER USER root SET PASSWORD = 'hacked'", owner)
+    with pytest.raises(AuthError):
+        db.execute_one("CREATE USER mallory WITH PASSWORD = 'm'", owner)
+    with pytest.raises(AuthError):
+        db.execute_one("DROP TENANT cnosdb", owner)
+    with pytest.raises(AuthError):
+        # owner of corp must not grant himself membership elsewhere
+        db.execute_one("ALTER TENANT cnosdb ADD USER boss AS owner", owner)
+    # but CAN manage membership of his own tenant
+    db.execute_one("CREATE USER worker WITH PASSWORD = 'w'", root)
+    db.execute_one("ALTER TENANT corp ADD USER worker AS member", owner)
+
+
+def test_role_validation(db):
+    root = Session()
+    with pytest.raises(Exception):
+        db.execute_one("CREATE ROLE IF NOT EXISTS bad INHERIT bogus", root)
+    with pytest.raises(Exception):
+        db.execute_one("DROP ROLE nosuch", root)
+    db.execute_one("DROP ROLE IF EXISTS nosuch", root)
+    db.execute_one("CREATE USER u9 WITH PASSWORD = 'x'", root)
+    with pytest.raises(Exception):
+        db.execute_one("ALTER TENANT cnosdb ADD USER u9 AS missing_role", root)
