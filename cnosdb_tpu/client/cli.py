@@ -20,13 +20,46 @@ def build_arg_parser() -> argparse.ArgumentParser:
     p.add_argument("-c", "--command", help="execute one statement and exit")
     p.add_argument("--format", default="table",
                    choices=["table", "csv", "tsv", "json"])
+    p.add_argument("--dump-ddl", action="store_true",
+                   help="print CREATE statements for every database/table "
+                        "and exit (reference cnosdb-cli --dump-ddl)")
     return p
+
+
+def dump_ddl(client) -> int:
+    """Emit re-runnable DDL for all databases and tables (reference
+    client/src/exec.rs --dump-ddl restore path)."""
+    dbs = [r[0] for r in client.sql_rows("SHOW DATABASES")]
+    for db in dbs:
+        if db in ("usage_schema",):
+            continue
+        opts = client.sql_rows(f"DESCRIBE DATABASE {db}")
+        if opts:
+            ttl, shard, vnode_dur, replica, precision = opts[0][:5]
+            print(f"CREATE DATABASE IF NOT EXISTS {db} WITH TTL '{ttl}' "
+                  f"SHARD {shard} VNODE_DURATION '{vnode_dur}' "
+                  f"REPLICA {replica} PRECISION '{precision}';")
+        for (tbl,) in client.sql_rows(f"SHOW TABLES ON {db}"):
+            cols = client.sql_rows(f"DESCRIBE TABLE {db}.{tbl}")
+            tags = [c[0] for c in cols if c[2] == "TAG"]
+            fields = [f"{c[0]} {c[1]} CODEC({c[3]})" for c in cols
+                      if c[2] == "FIELD"]
+            body = ", ".join(fields + [f"TAGS({', '.join(tags)})"])
+            print(f"CREATE TABLE IF NOT EXISTS {db}.{tbl} ({body});")
+    return 0
 
 
 def main(argv: list[str] | None = None) -> int:
     args = build_arg_parser().parse_args(argv)
-    from .repl import run_repl
+    from .repl import Client, run_repl
 
+    if args.dump_ddl:
+        try:
+            return dump_ddl(Client(args.host, args.port, args.user,
+                                   args.password, args.database, "csv"))
+        except RuntimeError as e:
+            print(f"dump-ddl failed: {e}", file=sys.stderr)
+            return 1
     return run_repl(args)
 
 

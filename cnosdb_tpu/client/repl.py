@@ -39,6 +39,23 @@ class Client:
         except urllib.error.URLError as e:
             return 0, f"connection error: {e}"
 
+    def sql_rows(self, query: str) -> list[list[str]]:
+        """CSV-parsed result rows (header stripped) — used by --dump-ddl.
+        Failures RAISE: a silent empty result would let a backup script
+        store an empty dump with exit code 0."""
+        saved, self.fmt = self.fmt, "csv"
+        try:
+            status, out = self.sql(query)
+        finally:
+            self.fmt = saved
+        if status != 200:
+            raise RuntimeError(f"query failed ({status}): {out.strip()}")
+        import csv as _csv
+        import io as _io
+
+        rows = list(_csv.reader(_io.StringIO(out)))
+        return rows[1:] if rows else []
+
     def write_lines(self, lines: str) -> tuple[int, str]:
         req = urllib.request.Request(
             f"{self.base}/api/v1/write?db={self.database}",

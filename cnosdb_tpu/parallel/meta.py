@@ -78,6 +78,8 @@ class MetaStore:
         self.streams: dict[str, dict] = {}  # stream name → definition
         self.members: dict[str, dict[str, str]] = {}  # tenant → {user → role}
         self.roles: dict[str, dict[str, dict]] = {}   # tenant → {role → spec}
+        # external (file-backed) tables: owner → {name → {path, fmt, header}}
+        self.externals: dict[str, dict[str, dict]] = {}
         # verified-credential cache; keys bind (user, stored-hash, password)
         # so password changes and drops invalidate naturally
         self._auth_cache: set = set()
@@ -121,6 +123,7 @@ class MetaStore:
             "streams": self.streams,
             "members": self.members,
             "roles": self.roles,
+            "externals": self.externals,
             "next_ids": [self._next_bucket_id, self._next_replica_id, self._next_vnode_id],
         }
 
@@ -154,6 +157,7 @@ class MetaStore:
         self.streams = d.get("streams", {})
         self.members = d.get("members", {})
         self.roles = d.get("roles", {})
+        self.externals = d.get("externals", {})
         self._next_bucket_id, self._next_replica_id, self._next_vnode_id = d["next_ids"]
 
     def _notify(self, event: str, **kw):
@@ -438,7 +442,8 @@ class MetaStore:
             if owner not in self.databases:
                 raise DatabaseNotFound(schema.db)
             tbls = self.tables.setdefault(owner, {})
-            if schema.name in tbls:
+            if schema.name in tbls \
+                    or schema.name in self.externals.get(owner, {}):
                 if if_not_exists:
                     return
                 raise TableAlreadyExists(schema.name)
@@ -513,6 +518,35 @@ class MetaStore:
                 if seen is None or now - seen <= max_age:
                     out.append(n)
             return out
+
+    # ------------------------------------------------------------ externals
+    def create_external_table(self, tenant: str, db: str, name: str,
+                              path: str, fmt: str = "csv",
+                              header: bool = True,
+                              if_not_exists: bool = False):
+        """File-backed table (reference create_external_table.rs:189)."""
+        with self.lock:
+            owner = f"{tenant}.{db}"
+            if owner not in self.databases:
+                raise DatabaseNotFound(db)
+            tbls = self.externals.setdefault(owner, {})
+            if name in tbls or name in self.tables.get(owner, {}):
+                if if_not_exists:
+                    return
+                raise TableAlreadyExists(name)
+            tbls[name] = {"path": path, "fmt": fmt, "header": header}
+            self._persist()
+
+    def drop_external_table(self, tenant: str, db: str, name: str) -> bool:
+        with self.lock:
+            out = self.externals.get(f"{tenant}.{db}", {}).pop(name, None)
+            if out is not None:
+                self._persist()
+            return out is not None
+
+    def external_opt(self, tenant: str, db: str, name: str) -> dict | None:
+        with self.lock:
+            return self.externals.get(f"{tenant}.{db}", {}).get(name)
 
     # ------------------------------------------------------------ streams
     def create_stream(self, name: str, definition: dict):

@@ -46,6 +46,7 @@ MUTATIONS = frozenset([
     "create_stream", "drop_stream", "locate_bucket_for_write",
     "expire_buckets", "register_node", "report_heartbeat",
     "create_role", "drop_role", "grant_db_privilege", "revoke_db_privilege",
+    "create_external_table", "drop_external_table",
 ])
 
 
@@ -307,6 +308,16 @@ class MetaClient:
     def revoke_db_privilege(self, tenant, role, db):
         return self._forward("revoke_db_privilege", tenant=tenant, role=role,
                              db=db)
+
+    def create_external_table(self, tenant, db, name, path, fmt="csv",
+                              header=True, if_not_exists=False):
+        return self._forward("create_external_table", tenant=tenant, db=db,
+                             name=name, path=path, fmt=fmt, header=header,
+                             if_not_exists=if_not_exists)
+
+    def drop_external_table(self, tenant, db, name):
+        return self._forward("drop_external_table", tenant=tenant, db=db,
+                             name=name)
 
     def expire_buckets(self, tenant, db, now_ns):
         return self._forward("expire_buckets", tenant=tenant, db=db,

@@ -176,3 +176,87 @@ def _zig_int64(v: int) -> int:
     if v >= 1 << 63:
         v -= 1 << 64
     return v
+
+
+# ---------------------------------------------------------------------------
+# remote READ (reference prom/remote_server.rs:478 remote_read): hand-rolled
+# prompb ReadRequest decode + ReadResponse encode, mirroring the write path
+# ---------------------------------------------------------------------------
+MATCH_EQ, MATCH_NEQ, MATCH_RE, MATCH_NRE = 0, 1, 2, 3
+
+
+def parse_read_request(body: bytes, compressed: bool = True) -> list[dict]:
+    """→ [{"start_ms", "end_ms", "matchers": [(type, name, value)]}]"""
+    raw = snappy_uncompress(body) if compressed else body
+    queries = []
+    for fno, q_raw in _fields(raw):
+        if fno != 1:
+            continue
+        q = {"start_ms": 0, "end_ms": 0, "matchers": []}
+        for f2, v in _fields(q_raw):
+            if f2 == 1:
+                q["start_ms"] = _zig_int64(v)
+            elif f2 == 2:
+                q["end_ms"] = _zig_int64(v)
+            elif f2 == 3:
+                mtype, name, value = MATCH_EQ, "", ""
+                for f3, mv in _fields(v):
+                    if f3 == 1:
+                        mtype = mv
+                    elif f3 == 2:
+                        name = mv.decode()
+                    elif f3 == 3:
+                        value = mv.decode()
+                q["matchers"].append((mtype, name, value))
+        queries.append(q)
+    return queries
+
+
+def _w_varint(out: bytearray, v: int):
+    if v < 0:
+        v += 1 << 64
+    while True:
+        b = v & 0x7F
+        v >>= 7
+        if v:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return
+
+
+def _w_tag(out: bytearray, field_no: int, wire: int):
+    _w_varint(out, (field_no << 3) | wire)
+
+
+def _w_bytes(out: bytearray, field_no: int, raw: bytes):
+    _w_tag(out, field_no, 2)
+    _w_varint(out, len(raw))
+    out += raw
+
+
+def encode_read_response(per_query: list[list[tuple[dict, list]]],
+                         compress: bool = True) -> bytes:
+    """per_query: for each query, a list of (labels dict, [(ts_ms, value)])
+    series → snappy'd prompb ReadResponse."""
+    out = bytearray()
+    for series_list in per_query:
+        qr = bytearray()
+        for labels, samples in series_list:
+            ts_msg = bytearray()
+            for name in sorted(labels):
+                lbl = bytearray()
+                _w_bytes(lbl, 1, name.encode())
+                _w_bytes(lbl, 2, str(labels[name]).encode())
+                _w_bytes(ts_msg, 1, bytes(lbl))
+            for ts_ms, val in samples:
+                smp = bytearray()
+                _w_tag(smp, 1, 1)
+                smp += struct.pack("<d", float(val))
+                _w_tag(smp, 2, 0)
+                _w_varint(smp, int(ts_ms))
+                _w_bytes(ts_msg, 2, bytes(smp))
+            _w_bytes(qr, 1, bytes(ts_msg))
+        _w_bytes(out, 1, bytes(qr))
+    raw = bytes(out)
+    return snappy_compress(raw) if compress else raw

@@ -44,6 +44,7 @@ class HttpServer:
             web.get("/api/v1/ping", self.handle_ping),
             web.post("/api/v1/opentsdb/write", self.handle_opentsdb_write),
             web.post("/api/v1/prom/write", self.handle_prom_write),
+            web.post("/api/v1/prom/read", self.handle_prom_read),
             web.post("/api/v1/es/_bulk", self.handle_es_bulk),
             web.get("/metrics", self.handle_metrics),
             web.get("/debug/health", self.handle_ping),
@@ -75,6 +76,15 @@ class HttpServer:
         user, tenant = self._auth(request)
         db = request.query.get("db", "public")
         return Session(tenant=tenant, database=db, user=user)
+
+    def _authorize_read(self, session: Session):
+        if not self.auth_enabled:
+            return
+        if not self.meta.check_db_privilege(session.user, session.tenant,
+                                            session.database, "read"):
+            raise web.HTTPForbidden(
+                text=f"user {session.user!r} lacks read privilege on "
+                     f"{session.tenant}.{session.database}")
 
     def _authorize_write(self, session: Session):
         """RBAC write gate for the ingest endpoints — line-protocol /
@@ -182,6 +192,119 @@ class HttpServer:
             return _err_response(_status_for(e), e)
         self.metrics.incr("prom_write_points", batch.n_rows())
         return web.Response(status=204)
+
+    async def handle_prom_read(self, request):
+        """Prometheus remote read (reference prom/remote_server.rs:478
+        remote_read → SQL over the same storage): decode prompb
+        ReadRequest, scan per query, stream back a ReadResponse."""
+        session = self._session(request)
+        self._authorize_read(session)  # same bar as SQL SELECT
+        from ..protocol.prometheus import (
+            parse_read_request, encode_read_response, snappy_available,
+        )
+
+        if not snappy_available():
+            return _err_response(501, QueryError("snappy library unavailable"))
+        body = await request.read()
+        try:
+            queries = parse_read_request(body)
+        except CnosError as e:
+            return _err_response(_status_for(e), e)
+        except Exception as e:
+            return _err_response(400, ParserError(f"bad remote-read body: {e}"))
+        import re as _re
+
+        loop = asyncio.get_running_loop()
+        try:
+            per_query = await loop.run_in_executor(
+                None, lambda: [self._prom_read_query(session, q)
+                               for q in queries])
+        except _re.error as e:
+            # malformed matcher regex must be 4xx — prometheus retries 5xx
+            return _err_response(400, ParserError(f"bad matcher regex: {e}"))
+        except CnosError as e:
+            return _err_response(_status_for(e), e)
+        raw = encode_read_response(per_query)
+        return web.Response(body=raw,
+                            content_type="application/x-protobuf",
+                            headers={"Content-Encoding": "snappy"})
+
+    def _prom_read_query(self, session: Session, q: dict) -> list:
+        """One prompb Query → [(labels, [(ts_ms, value)])]."""
+        import re as _re
+
+        from ..models.predicate import (
+            ColumnDomains, SetDomain, TimeRange, TimeRanges,
+        )
+        from ..protocol.prometheus import (
+            MATCH_EQ, MATCH_NEQ, MATCH_NRE, MATCH_RE,
+        )
+
+        metric = None
+        eq_tags: dict[str, str] = {}
+        # post predicates see the ABSENT label as "" (prometheus semantics:
+        # a missing label equals the empty string)
+        post = []
+        for mtype, name, value in q["matchers"]:
+            if name == "__name__":
+                if mtype == MATCH_EQ:
+                    metric = value
+                elif mtype == MATCH_RE:
+                    metric = None  # regex metric: unsupported → no result
+                continue
+            if mtype == MATCH_EQ:
+                if value == "":
+                    post.append((name, lambda v: (v or "") == ""))
+                else:
+                    eq_tags[name] = value
+            elif mtype == MATCH_NEQ:
+                post.append((name, lambda v, x=value: (v or "") != x))
+            elif mtype == MATCH_RE:
+                rx = _re.compile(value)
+                post.append((name, lambda v, r=rx:
+                             r.fullmatch(v or "") is not None))
+            elif mtype == MATCH_NRE:
+                rx = _re.compile(value)
+                post.append((name, lambda v, r=rx:
+                             r.fullmatch(v or "") is None))
+        if metric is None:
+            return []
+        doms = ColumnDomains({k: SetDomain([v]) for k, v in eq_tags.items()}) \
+            if eq_tags else ColumnDomains.all()
+        trs = TimeRanges([TimeRange(q["start_ms"] * 1_000_000,
+                                    q["end_ms"] * 1_000_000)])
+        from ..errors import TableNotFound
+
+        try:
+            batches = self.coord.scan_table(
+                session.tenant, session.database, metric,
+                time_ranges=trs, tag_domains=doms, field_names=["value"])
+        except TableNotFound:
+            return []   # unknown metric = no data; real errors propagate
+        series: dict[tuple, list] = {}
+        labels_of: dict[tuple, dict] = {}
+        for b in batches:
+            if "value" not in b.fields:
+                continue
+            _vt, vals, valid = b.fields["value"]
+            for i in range(b.n_rows):
+                if not valid[i]:
+                    continue
+                key = b.series_keys[b.sid_ordinal[i]]
+                if key is None:
+                    continue
+                tags = key.tag_dict()
+                if any(not pred(tags.get(name)) for name, pred in post):
+                    continue
+                sk = tuple(sorted(tags.items()))
+                series.setdefault(sk, []).append(
+                    (int(b.ts[i]) // 1_000_000, float(vals[i])))
+                labels_of.setdefault(sk, {"__name__": metric, **tags})
+        out = []
+        for sk in sorted(series):
+            samples = sorted(series[sk])
+            out.append((labels_of[sk], samples))
+        return out
 
     async def handle_es_bulk(self, request):
         """ES-style log ingest (reference `_bulk` json_protocol API)."""

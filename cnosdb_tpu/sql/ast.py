@@ -108,6 +108,7 @@ class CreateTable:
     fields: list[ColumnDef]
     tags: list[str]
     if_not_exists: bool = False
+    database: str | None = None      # qualified CREATE TABLE db.tbl
 
 
 @dataclass
@@ -139,6 +140,7 @@ class ShowStmt:
 class DescribeStmt:
     kind: str                        # table/database
     name: str = ""
+    database: str | None = None      # qualified DESCRIBE TABLE db.tbl
 
 
 @dataclass
@@ -226,6 +228,29 @@ class GrantRevoke:
     level: str          # read|write|all
     database: str
     role: str
+
+
+@dataclass
+class CopyStmt:
+    """COPY INTO 'path' FROM table (export) | COPY INTO table FROM 'path'
+    (import) (reference execution/ddl/copy.rs + COPY INTO in ast.rs)."""
+
+    target: str
+    source: str
+    target_is_path: bool
+    fmt: str = "csv"            # csv|parquet
+
+
+@dataclass
+class CreateExternalTable:
+    """CREATE EXTERNAL TABLE name STORED AS CSV|PARQUET [WITH HEADER ROW]
+    LOCATION 'path' (reference create_external_table.rs:189)."""
+
+    name: str
+    path: str
+    fmt: str = "csv"
+    header: bool = True
+    if_not_exists: bool = False
 
 
 @dataclass

@@ -174,6 +174,11 @@ class QueryExecutor:
         if isinstance(stmt, ast.CreateTable):
             return self._create_table(stmt, session)
         if isinstance(stmt, ast.DropTable):
+            # an external table and a tskv table cannot share a name, so
+            # whichever exists is the drop target
+            if self.meta.drop_external_table(session.tenant,
+                                             session.database, stmt.name):
+                return ResultSet.message("ok")
             self.meta.drop_table(session.tenant, session.database, stmt.name,
                                  if_exists=stmt.if_exists)
             return ResultSet.message("ok")
@@ -246,6 +251,13 @@ class QueryExecutor:
             else:
                 self.meta.remove_member(stmt.tenant, stmt.user)
             return ResultSet.message("ok")
+        if isinstance(stmt, ast.CreateExternalTable):
+            self.meta.create_external_table(
+                session.tenant, session.database, stmt.name, stmt.path,
+                stmt.fmt, stmt.header, stmt.if_not_exists)
+            return ResultSet.message("ok")
+        if isinstance(stmt, ast.CopyStmt):
+            return self._copy(stmt, session)
         if isinstance(stmt, ast.CreateStream):
             return self._create_stream(stmt, session)
         if isinstance(stmt, ast.DropStream):
@@ -272,9 +284,12 @@ class QueryExecutor:
     _WRITE_STMTS = (ast.InsertStmt, ast.DeleteStmt, ast.UpdateStmt)
     # instance-level administration: NEVER grantable through tenant roles
     # (a tenant owner resetting the system admin's password would be a
-    # full privilege escalation)
+    # full privilege escalation). CopyStmt/CreateExternalTable touch the
+    # server's LOCAL FILESYSTEM — that is instance scope too, or any
+    # tenant owner could read /etc/passwd through an external table.
     _ADMIN_STMTS = (ast.CreateUser, ast.DropUser, ast.AlterUser,
-                    ast.CreateTenant, ast.DropTenant)
+                    ast.CreateTenant, ast.DropTenant,
+                    ast.CopyStmt, ast.CreateExternalTable)
 
     def _check_privilege(self, stmt, session: Session):
         """RBAC gate (reference auth/auth_control.rs AccessControlImpl →
@@ -405,14 +420,15 @@ class QueryExecutor:
         return ResultSet.message("ok")
 
     def _create_table(self, stmt: ast.CreateTable, session: Session):
+        db = stmt.database or session.database
         fields = []
         for f in stmt.fields:
             vt = ValueType.parse(f.type_name)
             fields.append((f.name, vt, f.codec))
         schema = TskvTableSchema.new_measurement(
-            session.tenant, session.database, stmt.name, stmt.tags,
+            session.tenant, db, stmt.name, stmt.tags,
             [(n, vt) for n, vt, _ in fields],
-            precision=self.meta.database(session.tenant, session.database)
+            precision=self.meta.database(session.tenant, db)
             .options.precision)
         for n, _vt, codec in fields:
             if codec:
@@ -520,7 +536,8 @@ class QueryExecutor:
                  np.array([str(o.vnode_duration)], dtype=object),
                  np.array([o.replica]),
                  np.array([o.precision.name], dtype=object)])
-        schema = self.meta.table(session.tenant, session.database, stmt.name)
+        schema = self.meta.table(session.tenant,
+                                 stmt.database or session.database, stmt.name)
         names, types, kinds, codecs = [], [], [], []
         for c in schema.columns:
             names.append(c.name)
@@ -680,6 +697,10 @@ class QueryExecutor:
         if is_system_db(db):
             names, cols = system_table(self, db, table, session)
             return self._select_over_env(stmt, names, cols)
+        if self.meta.external_opt(session.tenant, db, table) is not None:
+            # relational pipeline: aggregates/joins/windows all work over
+            # the materialized file (handled in _materialize_from)
+            return self._select_relational(stmt, session)
         if (len(stmt.items) == 1 and isinstance(stmt.items[0].expr, Func)
                 and stmt.items[0].expr.name.lower() in _REPAIR_FUNCS):
             return self._ts_gen_func(stmt, session)
@@ -742,6 +763,58 @@ class QueryExecutor:
         out = ResultSet(["time", alias], [new_ts, new_vals])
         env = {"time": new_ts, alias: new_vals, value_col: new_vals}
         return _order_limit(out, stmt.order_by, stmt.limit, stmt.offset, env)
+
+    def _copy(self, stmt: ast.CopyStmt, session: Session):
+        """COPY INTO (reference execution/ddl/copy + object-store sinks):
+        export a table to CSV/parquet, or import a file into a table."""
+        import pyarrow as pa
+
+        if stmt.target_is_path:
+            rs = self._select(ast.SelectStmt(
+                items=[ast.SelectItem("*")], table=stmt.source), session)
+            arrays, fields = [], []
+            for n, c in zip(rs.names, rs.columns):
+                if c.dtype == object:
+                    arrays.append(pa.array(
+                        [None if v is None else str(v) for v in c]))
+                else:
+                    arrays.append(pa.array(c))
+                fields.append(n)
+            table = pa.table(dict(zip(fields, arrays)))
+            if stmt.fmt == "parquet":
+                import pyarrow.parquet as pq
+
+                pq.write_table(table, stmt.target)
+            else:
+                import pyarrow.csv as pc
+
+                pc.write_csv(table, stmt.target)
+            return ResultSet(["rows_exported"],
+                             [np.array([rs.n_rows], dtype=np.int64)])
+        # import: file → table (schema must exist; columns map by name)
+        if stmt.fmt == "parquet":
+            import pyarrow.parquet as pq
+
+            table = pq.read_table(stmt.source)
+        else:
+            import pyarrow.csv as pc
+
+            table = pc.read_csv(stmt.source)
+        schema = self.meta.table(session.tenant, session.database,
+                                 stmt.target)
+        cols = {n: table.column(n).to_pylist() for n in table.column_names}
+        if "time" not in cols:
+            raise ExecutionError("COPY INTO table requires a time column")
+        n = len(cols["time"])
+        tag_names = [c for c in cols if schema.contains_column(c)
+                     and schema.column(c).column_type.is_tag]
+        field_types = {c: schema.column(c).column_type.value_type
+                       for c in cols if schema.contains_column(c)
+                       and schema.column(c).column_type.is_field}
+        rows = [{c: cols[c][i] for c in cols} for i in range(n)]
+        wb = WriteBatch.from_rows(stmt.target, rows, tag_names, field_types)
+        self.coord.write_points(session.tenant, session.database, wb)
+        return ResultSet(["rows_imported"], [np.array([n], dtype=np.int64)])
 
     # ------------------------------------------------------- relational path
     def _needs_relational(self, stmt: ast.SelectStmt) -> bool:
@@ -817,6 +890,18 @@ class QueryExecutor:
         tables); joins compose host-side (reference: TskvExec leaves under
         DataFusion join operators)."""
         if isinstance(item, ast.TableRef):
+            ext = self.meta.external_opt(
+                session.tenant, item.database or session.database, item.name)
+            if ext is not None:
+                names, cols = _load_external(ext)
+                scope = rel.Scope.from_relation(names, cols, item.alias)
+                if pushed_where is not None:
+                    w = self._strip_alias(pushed_where, item.alias)
+                    m = np.asarray(w.eval(scope.env, np))
+                    if not m.shape:
+                        m = np.full(scope.n, bool(m))
+                    scope = scope.filter(m)
+                return scope
             sub = ast.SelectStmt(
                 items=[ast.SelectItem("*")], table=item.name,
                 where=self._strip_alias(pushed_where, item.alias),
@@ -1364,6 +1449,32 @@ _SERIES_AGGS = {"increase", "sample", "gauge_agg", "state_agg",
 
 # row-set-valued repair transforms (reference ts_gen_func)
 _REPAIR_FUNCS = {"timestamp_repair", "value_fill", "value_repair"}
+
+
+def _load_external(ext: dict) -> tuple[list[str], list[np.ndarray]]:
+    """Materialize a file-backed external table (reference
+    create_external_table.rs reads through object_store + DataFusion
+    listing providers; local files only here)."""
+    if ext["fmt"] == "parquet":
+        import pyarrow.parquet as pq
+
+        table = pq.read_table(ext["path"])
+    else:
+        import pyarrow.csv as pc
+
+        ropts = pc.ReadOptions(autogenerate_column_names=not ext.get(
+            "header", True))
+        table = pc.read_csv(ext["path"], read_options=ropts)
+    names, cols = [], []
+    for name in table.column_names:
+        col = table.column(name)
+        arr = col.to_numpy(zero_copy_only=False)
+        if arr.dtype == object or arr.dtype.kind in ("U", "S"):
+            arr = np.array([None if v is None else str(v)
+                            for v in col.to_pylist()], dtype=object)
+        names.append(name)
+        cols.append(arr)
+    return names, cols
 
 
 def _batches_bytes(batches) -> int:

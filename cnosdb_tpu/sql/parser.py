@@ -245,6 +245,28 @@ class Parser:
             self.next()
             self.accept_kw("QUERY")
             return ast.KillQuery(int(self.expect_number()))
+        if k == "COPY":
+            self.next()
+            self.expect_kw("INTO")
+            t = self.peek()
+            if t.kind == "string":
+                target, target_is_path = self.expect_string(), True
+            else:
+                target, target_is_path = self.expect_ident(), False
+            self.expect_kw("FROM")
+            t = self.peek()
+            source = self.expect_string() if t.kind == "string" \
+                else self.expect_ident()
+            path = target if target_is_path else source
+            fmt = "parquet" if path.endswith(".parquet") else "csv"
+            if self.accept_kw("FILE_FORMAT"):
+                self.expect_op("=")
+                self.expect_op("(")
+                self.expect_kw("TYPE")
+                self.expect_op("=")
+                fmt = self.expect_string().lower()
+                self.expect_op(")")
+            return ast.CopyStmt(target, source, target_is_path, fmt)
         if k in ("GRANT", "REVOKE"):
             grant = k == "GRANT"
             self.next()
@@ -393,6 +415,28 @@ class Parser:
     def parse_create(self):
         self.expect_kw("CREATE")
         k = self.kw()
+        if k == "EXTERNAL":
+            self.next()
+            self.expect_kw("TABLE")
+            ine = self._if_not_exists()
+            name = self.expect_ident()
+            fmt, header = "csv", False
+            path = None
+            while True:
+                if self.accept_kw("STORED"):
+                    self.expect_kw("AS")
+                    fmt = self.expect_ident().lower()
+                elif self.accept_kw("WITH"):
+                    self.expect_kw("HEADER")
+                    self.accept_kw("ROW")
+                    header = True
+                elif self.accept_kw("LOCATION"):
+                    path = self.expect_string()
+                else:
+                    break
+            if path is None:
+                raise ParserError("CREATE EXTERNAL TABLE needs LOCATION")
+            return ast.CreateExternalTable(name, path, fmt, header, ine)
         if k == "DATABASE":
             self.next()
             ine = self._if_not_exists()
@@ -423,6 +467,9 @@ class Parser:
             self.next()
             ine = self._if_not_exists()
             name = self.expect_ident()
+            database = None
+            if self.accept_op("."):
+                database, name = name, self.expect_ident()
             fields, tags = [], []
             self.expect_op("(")
             while True:
@@ -447,7 +494,7 @@ class Parser:
                 if not self.accept_op(","):
                     break
             self.expect_op(")")
-            return ast.CreateTable(name, fields, tags, ine)
+            return ast.CreateTable(name, fields, tags, ine, database)
         if k == "STREAM":
             self.next()
             ine = self._if_not_exists()
@@ -680,13 +727,17 @@ class Parser:
     def parse_describe(self):
         self.next()
         k = self.kw()
-        if k == "TABLE":
+        kind = "table"
+        if k in ("TABLE", "DATABASE"):
             self.next()
-            return ast.DescribeStmt("table", self.expect_ident())
-        if k == "DATABASE":
-            self.next()
-            return ast.DescribeStmt("database", self.expect_ident())
-        return ast.DescribeStmt("table", self.expect_ident())
+            kind = k.lower()
+        name = self.expect_ident()
+        database = None
+        if kind == "table" and self.accept_op("."):
+            database, name = name, self.expect_ident()
+        stmt = ast.DescribeStmt(kind, name)
+        stmt.database = database
+        return stmt
 
     def parse_insert(self):
         self.expect_kw("INSERT")
