@@ -77,6 +77,15 @@ class Coordinator:
         return rpc_call(addr, method, payload)
 
     def _on_meta_event(self, event: str, payload: dict):
+        if event == "update_vnode":
+            # placement changed: raft peer resolution + scan snapshots must
+            # re-derive from the new replica-set layout
+            if self._replica_mgr is not None:
+                self._replica_mgr._placements.pop(
+                    f"{payload['owner']}/{payload['rs_id']}", None)
+            with self._scan_cache_lock:
+                self._scan_cache.clear()
+            return
         if event in ("create_table", "update_table"):
             owner = payload["owner"]
             tenant, db = owner.split(".", 1)
@@ -279,8 +288,17 @@ class Coordinator:
                     live = self._replica_mgr.current_leader_vnode(owner, rs)
                     if live is not None:
                         vnode_id = live
-                # route to the chosen vnode's placement node
+                # prefer a RUNNING replica over a broken-marked leader
+                from ..models.meta_data import VnodeStatus
+
                 v = rs.vnode(vnode_id)
+                if v is not None and v.status == VnodeStatus.BROKEN:
+                    healthy = [x for x in rs.vnodes
+                               if x.status == VnodeStatus.RUNNING]
+                    if healthy:
+                        v = healthy[0]
+                        vnode_id = v.id
+                # route to the chosen vnode's placement node
                 node_id = v.node_id if v is not None \
                     else (rs.leader_node_id or self.node_id)
                 if vnode_id in seen:
@@ -366,6 +384,7 @@ class Coordinator:
                 })
             except (RpcUnavailable, RpcError) as e:
                 last_err = e
+                self._mark_vnode_broken(vnode_id)
                 continue
             raw = r.get("ipc")
             if raw is None:
@@ -381,6 +400,132 @@ class Coordinator:
 
     def drop_database(self, tenant: str, db: str):
         self.meta.drop_database(tenant, db)
+
+    def _mark_vnode_broken(self, vnode_id: int):
+        """Failed-replica marking (reference reader/mod.rs:36
+        CheckedCoordinatorRecordBatchStream → Broken status); readers then
+        prefer RUNNING replicas until an admin repairs/moves it."""
+        from ..models.meta_data import VnodeStatus
+
+        try:
+            self.meta.update_vnode(vnode_id, status=int(VnodeStatus.BROKEN))
+        except Exception:
+            pass  # advisory only; the scan already failed over
+
+    # ---------------------------------------------------------------- admin
+    def move_vnode(self, vnode_id: int, to_node: int):
+        """MOVE VNODE <id> TO NODE <n> (reference raft/manager.rs:323-566 +
+        DownloadFile snapshot shipping): copy the data, flip placement,
+        drop the source copy. Placement flips LAST so a failure at any
+        earlier step leaves the original intact (the ResourceManager
+        retry contract collapses to at-most-once placement mutation)."""
+        hit = self.meta.find_vnode(vnode_id)
+        if hit is None:
+            raise CoordinatorError(f"unknown vnode {vnode_id}")
+        owner, _b, rs, v = hit
+        if len(rs.vnodes) > 1:
+            raise CoordinatorError(
+                "MOVE VNODE of a raft-replicated member needs membership "
+                "change (unsupported); REPLICA REMOVE + REPLICA ADD instead")
+        src_node = v.node_id
+        if src_node == to_node:
+            return
+        if self.meta.node_addr(to_node) is None and self.distributed:
+            raise CoordinatorError(f"unknown target node {to_node}")
+        data = self._fetch_vnode_snapshot(owner, vnode_id, src_node)
+        if data is not None:
+            self._install_vnode_snapshot(owner, vnode_id, to_node, data)
+        self.meta.update_vnode(vnode_id, node_id=to_node, status=0)
+        try:
+            if src_node == self.node_id:
+                self.engine.drop_vnode(owner, vnode_id)
+            elif self.distributed:
+                self._rpc(src_node, "vnode_drop",
+                          {"owner": owner, "vnode_id": vnode_id})
+        except Exception:
+            pass  # orphaned source data is garbage, not corruption
+
+    def copy_vnode(self, vnode_id: int, to_node: int) -> int:
+        """COPY VNODE <id> TO NODE <n>: add a replica seeded from a
+        snapshot (reference REPLICA ADD + add_follower). Restricted to
+        non-raft (single-replica) sets — raft membership change is the
+        round-3 path."""
+        hit = self.meta.find_vnode(vnode_id)
+        if hit is None:
+            raise CoordinatorError(f"unknown vnode {vnode_id}")
+        owner, _b, rs, v = hit
+        if len(rs.vnodes) > 1:
+            raise CoordinatorError(
+                "COPY VNODE of a raft-replicated set needs membership "
+                "change (unsupported); use MOVE VNODE")
+        data = self._fetch_vnode_snapshot(owner, vnode_id, v.node_id)
+        new_id = self.meta.add_replica_vnode(rs.id, to_node)
+        if data is not None:
+            self._install_vnode_snapshot(owner, new_id, to_node, data)
+        return new_id
+
+    def drop_replica(self, vnode_id: int):
+        """REPLICA REMOVE: update placement, then drop the data on the
+        OWNING node (node-aware — the vnode may not be local)."""
+        hit = self.meta.find_vnode(vnode_id)
+        if hit is None:
+            raise CoordinatorError(f"unknown vnode {vnode_id}")
+        owner, _b, _rs, v = hit
+        node = v.node_id
+        self.meta.remove_replica_vnode(vnode_id)
+        if node == self.node_id or not self.distributed:
+            self.engine.drop_vnode(owner, vnode_id)
+        else:
+            try:
+                self._rpc(node, "vnode_drop",
+                          {"owner": owner, "vnode_id": vnode_id})
+            except Exception:
+                pass  # orphaned data is garbage, placement is authoritative
+
+    def compact_vnode(self, vnode_id: int):
+        """COMPACT VNODE on whichever node owns it."""
+        hit = self.meta.find_vnode(vnode_id)
+        if hit is None:
+            raise CoordinatorError(f"unknown vnode {vnode_id}")
+        owner, _b, _rs, v = hit
+        if v.node_id == self.node_id or not self.distributed:
+            vn = self.engine.vnode(owner, vnode_id)
+            if vn is not None:
+                vn.compact()
+        else:
+            self._rpc(v.node_id, "vnode_compact",
+                      {"owner": owner, "vnode_id": vnode_id})
+
+    def copy_vnode_to_set(self, rs_id: int, to_node: int) -> int:
+        """REPLICA ADD ON <rs> NODE <n>: seed a new replica from the set's
+        current leader vnode."""
+        for owner, buckets in self.meta.buckets.items():
+            for b in buckets:
+                for rs in b.shard_group:
+                    if rs.id == rs_id:
+                        return self.copy_vnode(rs.leader_vnode_id, to_node)
+        raise CoordinatorError(f"unknown replica set {rs_id}")
+
+    def _fetch_vnode_snapshot(self, owner: str, vnode_id: int,
+                              node: int) -> bytes | None:
+        from .replica import VnodeStateMachine
+
+        if node == self.node_id or not self.distributed:
+            v = self.engine.vnode(owner, vnode_id)
+            return VnodeStateMachine(v).snapshot() if v is not None else None
+        return self._rpc(node, "vnode_snapshot",
+                         {"owner": owner, "vnode_id": vnode_id}).get("data")
+
+    def _install_vnode_snapshot(self, owner: str, vnode_id: int, node: int,
+                                data: bytes):
+        from .replica import VnodeStateMachine
+
+        if node == self.node_id or not self.distributed:
+            v = self.engine.open_vnode(owner, vnode_id)
+            VnodeStateMachine(v).install_snapshot(data, 0, 0)
+        else:
+            self._rpc(node, "vnode_install",
+                      {"owner": owner, "vnode_id": vnode_id, "data": data})
 
     def _peer_nodes(self, tenant: str, db: str) -> list[int]:
         """Other nodes hosting vnodes of this database."""

@@ -519,6 +519,89 @@ class MetaStore:
                     out.append(n)
             return out
 
+    # ------------------------------------------------------------ vnode admin
+    def find_vnode(self, vnode_id: int):
+        """→ (owner, bucket, rs, vnode) or None."""
+        with self.lock:
+            for owner, buckets in self.buckets.items():
+                for b in buckets:
+                    for rs in b.shard_group:
+                        v = rs.vnode(vnode_id)
+                        if v is not None:
+                            return owner, b, rs, v
+            return None
+
+    def update_vnode(self, vnode_id: int, node_id: int | None = None,
+                     status: int | None = None):
+        """Re-place or re-mark one vnode (reference MOVE VNODE admin +
+        broken-marking, coordinator/src/reader/mod.rs:36)."""
+        from ..models.meta_data import VnodeStatus
+
+        with self.lock:
+            hit = self.find_vnode(vnode_id)
+            if hit is None:
+                raise MetaError(f"unknown vnode {vnode_id}")
+            owner, _b, rs, v = hit
+            if node_id is not None:
+                v.node_id = node_id
+                if rs.leader_vnode_id == vnode_id:
+                    rs.leader_node_id = node_id
+            if status is not None:
+                v.status = VnodeStatus(status)
+            self._persist()
+            self._notify("update_vnode", owner=owner, vnode_id=vnode_id,
+                         rs_id=rs.id, node_id=v.node_id, status=int(v.status))
+
+    def add_replica_vnode(self, rs_id: int, node_id: int) -> int:
+        """COPY VNODE target: add a replica to a replica set (reference
+        REPLICA ADD, raft/manager.rs add_follower)."""
+        from ..models.meta_data import VnodeInfo
+
+        with self.lock:
+            for owner, buckets in self.buckets.items():
+                for b in buckets:
+                    for rs in b.shard_group:
+                        if rs.id == rs_id:
+                            vid = self._next_vnode_id
+                            self._next_vnode_id += 1
+                            rs.vnodes.append(VnodeInfo(vid, node_id))
+                            self._persist()
+                            self._notify("update_vnode", owner=owner,
+                                         vnode_id=vid, rs_id=rs.id,
+                                         node_id=node_id, status=0)
+                            return vid
+            raise MetaError(f"unknown replica set {rs_id}")
+
+    def remove_replica_vnode(self, vnode_id: int):
+        """REPLICA REMOVE: drop one replica entry from its set."""
+        with self.lock:
+            hit = self.find_vnode(vnode_id)
+            if hit is None:
+                raise MetaError(f"unknown vnode {vnode_id}")
+            owner, _b, rs, v = hit
+            if len(rs.vnodes) <= 1:
+                raise MetaError("cannot remove the last replica")
+            rs.vnodes = [x for x in rs.vnodes if x.id != vnode_id]
+            if rs.leader_vnode_id == vnode_id:
+                rs.leader_vnode_id = rs.vnodes[0].id
+                rs.leader_node_id = rs.vnodes[0].node_id
+            self._persist()
+            self._notify("update_vnode", owner=owner, vnode_id=vnode_id,
+                         rs_id=rs.id, node_id=-1, status=-1)
+
+    def promote_replica(self, vnode_id: int):
+        """REPLICA PROMOTE: make this replica the placement leader."""
+        with self.lock:
+            hit = self.find_vnode(vnode_id)
+            if hit is None:
+                raise MetaError(f"unknown vnode {vnode_id}")
+            owner, _b, rs, v = hit
+            rs.leader_vnode_id = v.id
+            rs.leader_node_id = v.node_id
+            self._persist()
+            self._notify("update_vnode", owner=owner, vnode_id=vnode_id,
+                         rs_id=rs.id, node_id=v.node_id, status=int(v.status))
+
     # ------------------------------------------------------------ externals
     def create_external_table(self, tenant: str, db: str, name: str,
                               path: str, fmt: str = "csv",
@@ -536,13 +619,17 @@ class MetaStore:
                 raise TableAlreadyExists(name)
             tbls[name] = {"path": path, "fmt": fmt, "header": header}
             self._persist()
+        self._notify("create_external", owner=owner, table=name)
 
     def drop_external_table(self, tenant: str, db: str, name: str) -> bool:
         with self.lock:
-            out = self.externals.get(f"{tenant}.{db}", {}).pop(name, None)
+            owner = f"{tenant}.{db}"
+            out = self.externals.get(owner, {}).pop(name, None)
             if out is not None:
                 self._persist()
-            return out is not None
+        if out is not None:
+            self._notify("drop_external", owner=owner, table=name)
+        return out is not None
 
     def external_opt(self, tenant: str, db: str, name: str) -> dict | None:
         with self.lock:

@@ -47,6 +47,8 @@ MUTATIONS = frozenset([
     "expire_buckets", "register_node", "report_heartbeat",
     "create_role", "drop_role", "grant_db_privilege", "revoke_db_privilege",
     "create_external_table", "drop_external_table",
+    "update_vnode", "add_replica_vnode", "remove_replica_vnode",
+    "promote_replica",
 ])
 
 
@@ -318,6 +320,20 @@ class MetaClient:
     def drop_external_table(self, tenant, db, name):
         return self._forward("drop_external_table", tenant=tenant, db=db,
                              name=name)
+
+    def update_vnode(self, vnode_id, node_id=None, status=None):
+        return self._forward("update_vnode", vnode_id=vnode_id,
+                             node_id=node_id, status=status)
+
+    def add_replica_vnode(self, rs_id, node_id):
+        return self._forward("add_replica_vnode", rs_id=rs_id,
+                             node_id=node_id)
+
+    def remove_replica_vnode(self, vnode_id):
+        return self._forward("remove_replica_vnode", vnode_id=vnode_id)
+
+    def promote_replica(self, vnode_id):
+        return self._forward("promote_replica", vnode_id=vnode_id)
 
     def expire_buckets(self, tenant, db, now_ns):
         return self._forward("expire_buckets", tenant=tenant, db=db,

@@ -40,6 +40,10 @@ class DataNodeService:
             "tag_values": self._tag_values,
             "series_keys": self._series_keys,
             "delete_vnode_range": self._delete_vnode_range,
+            "vnode_snapshot": self._vnode_snapshot,
+            "vnode_install": self._vnode_install,
+            "vnode_drop": self._vnode_drop,
+            "vnode_compact": self._vnode_compact,
         })
         self.addr = self.server.addr
 
@@ -107,4 +111,31 @@ class DataNodeService:
         self.coord.delete_vnode_local(
             p["owner"], p["vnode_id"], p["table"],
             ColumnDomains.from_wire(p["doms"]), p["min_ts"], p["max_ts"])
+        return {"ok": True}
+
+    # vnode snapshot shipping (reference rpc/tskv.rs DownloadFile — the
+    # MOVE/COPY VNODE data plane; logical snapshots here)
+    def _vnode_snapshot(self, p):
+        from .replica import VnodeStateMachine
+
+        v = self.coord.engine.vnode(p["owner"], p["vnode_id"])
+        if v is None:
+            return {"data": None}
+        return {"data": VnodeStateMachine(v).snapshot()}
+
+    def _vnode_install(self, p):
+        from .replica import VnodeStateMachine
+
+        v = self.coord.engine.open_vnode(p["owner"], p["vnode_id"])
+        VnodeStateMachine(v).install_snapshot(p["data"], 0, 0)
+        return {"ok": True}
+
+    def _vnode_drop(self, p):
+        self.coord.engine.drop_vnode(p["owner"], p["vnode_id"])
+        return {"ok": True}
+
+    def _vnode_compact(self, p):
+        v = self.coord.engine.vnode(p["owner"], p["vnode_id"])
+        if v is not None:
+            v.compact()
         return {"ok": True}

@@ -254,6 +254,18 @@ class CreateExternalTable:
 
 
 @dataclass
+class VnodeAdmin:
+    """MOVE|COPY|DROP|COMPACT VNODE <id> [TO NODE <n>] and REPLICA
+    ADD|REMOVE|PROMOTE (reference spi ast.rs:56-73 vnode/replica admin)."""
+
+    op: str                     # move|copy|drop|compact|replica_add|
+    # replica_remove|replica_promote
+    vnode_id: int = 0
+    node_id: int = 0
+    replica_set_id: int = 0
+
+
+@dataclass
 class AlterTenantMember:
     """ALTER TENANT t ADD USER u AS r | REMOVE USER u."""
 

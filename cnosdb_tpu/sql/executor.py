@@ -258,6 +258,8 @@ class QueryExecutor:
             return ResultSet.message("ok")
         if isinstance(stmt, ast.CopyStmt):
             return self._copy(stmt, session)
+        if isinstance(stmt, ast.VnodeAdmin):
+            return self._vnode_admin(stmt)
         if isinstance(stmt, ast.CreateStream):
             return self._create_stream(stmt, session)
         if isinstance(stmt, ast.DropStream):
@@ -289,7 +291,10 @@ class QueryExecutor:
     # tenant owner could read /etc/passwd through an external table.
     _ADMIN_STMTS = (ast.CreateUser, ast.DropUser, ast.AlterUser,
                     ast.CreateTenant, ast.DropTenant,
-                    ast.CopyStmt, ast.CreateExternalTable)
+                    ast.CopyStmt, ast.CreateExternalTable,
+                    # cluster-topology mutation reaches every tenant's
+                    # vnodes via the global placement map: instance scope
+                    ast.VnodeAdmin, ast.CompactStmt, ast.FlushStmt)
 
     def _check_privilege(self, stmt, session: Session):
         """RBAC gate (reference auth/auth_control.rs AccessControlImpl →
@@ -763,6 +768,32 @@ class QueryExecutor:
         out = ResultSet(["time", alias], [new_ts, new_vals])
         env = {"time": new_ts, alias: new_vals, value_col: new_vals}
         return _order_limit(out, stmt.order_by, stmt.limit, stmt.offset, env)
+
+    def _vnode_admin(self, stmt: ast.VnodeAdmin) -> ResultSet:
+        """Vnode/replica elasticity ops (reference ast.rs:56-73 +
+        raft/manager.rs:323-566)."""
+        if stmt.op == "move":
+            self.coord.move_vnode(stmt.vnode_id, stmt.node_id)
+            return ResultSet.message("ok")
+        if stmt.op == "copy":
+            new_id = self.coord.copy_vnode(stmt.vnode_id, stmt.node_id)
+            return ResultSet(["new_vnode_id"],
+                             [np.array([new_id], dtype=np.int64)])
+        if stmt.op == "compact":
+            self.coord.compact_vnode(stmt.vnode_id)
+            return ResultSet.message("ok")
+        if stmt.op == "replica_add":
+            new_id = self.coord.copy_vnode_to_set(stmt.replica_set_id,
+                                                  stmt.node_id)
+            return ResultSet(["new_vnode_id"],
+                             [np.array([new_id], dtype=np.int64)])
+        if stmt.op == "replica_remove":
+            self.coord.drop_replica(stmt.vnode_id)
+            return ResultSet.message("ok")
+        if stmt.op == "replica_promote":
+            self.meta.promote_replica(stmt.vnode_id)
+            return ResultSet.message("ok")
+        raise ExecutionError(f"unsupported vnode admin {stmt.op}")
 
     def _copy(self, stmt: ast.CopyStmt, session: Session):
         """COPY INTO (reference execution/ddl/copy + object-store sinks):

@@ -152,6 +152,12 @@ class Parser:
         t = self.peek()
         return t.value.upper() if t.kind == "ident" else None
 
+    def _peek_kw_at(self, offset: int) -> str | None:
+        j = self.i + offset
+        if j < len(self.tokens) and self.tokens[j].kind == "ident":
+            return self.tokens[j].value.upper()
+        return None
+
     def accept_kw(self, *kws: str) -> bool:
         if self.kw() in kws:
             self.next()
@@ -233,6 +239,9 @@ class Parser:
             return self.parse_update()
         if k == "COMPACT":
             self.next()
+            if self.accept_kw("VNODE"):
+                return ast.VnodeAdmin("compact",
+                                      vnode_id=int(self.expect_number()))
             self.expect_kw("DATABASE")
             return ast.CompactStmt(self.expect_ident())
         if k == "FLUSH":
@@ -245,6 +254,29 @@ class Parser:
             self.next()
             self.accept_kw("QUERY")
             return ast.KillQuery(int(self.expect_number()))
+        if k in ("MOVE", "COPY") and self._peek_kw_at(1) == "VNODE":
+            op = k.lower()
+            self.next()
+            self.expect_kw("VNODE")
+            vid = int(self.expect_number())
+            self.expect_kw("TO")
+            self.expect_kw("NODE")
+            return ast.VnodeAdmin(op, vnode_id=vid,
+                                  node_id=int(self.expect_number()))
+        if k == "REPLICA":
+            # REPLICA ADD ON <rs_id> NODE <node> | REMOVE VNODE <id> |
+            # PROMOTE VNODE <id> (reference ast.rs:56-73 replica admin)
+            self.next()
+            sub = self.expect_kw("ADD", "REMOVE", "PROMOTE")
+            if sub == "ADD":
+                self.expect_kw("ON")
+                rs_id = int(self.expect_number())
+                self.expect_kw("NODE")
+                return ast.VnodeAdmin("replica_add", replica_set_id=rs_id,
+                                      node_id=int(self.expect_number()))
+            self.accept_kw("VNODE")
+            return ast.VnodeAdmin(f"replica_{sub.lower()}",
+                                  vnode_id=int(self.expect_number()))
         if k == "COPY":
             self.next()
             self.expect_kw("INTO")

@@ -117,3 +117,38 @@ def test_chaos_restart_while_writing(cluster):
     assert _wait_count(n1, "evt", "d3", total, timeout=30.0) == total
     n3.wait_ready()
     assert _wait_count(n3, "evt", "d3", total, timeout=40.0) == total
+
+
+def test_move_vnode_then_kill_source(cluster):
+    """Elasticity (reference MOVE VNODE + DownloadFile): re-place a vnode
+    onto another node, kill the original owner — scans still answer from
+    the new placement."""
+    n1, n2, n3 = cluster.nodes
+    for n in (n1, n2, n3):
+        if n.proc is None:
+            n.start()
+    for n in (n1, n2, n3):
+        n.wait_ready()
+    n1.sql("CREATE DATABASE dmv WITH SHARD 1 REPLICA 1", db="public")
+    lines = "\n".join(
+        f"mv,host=h{i % 3} v={i} {1_700_000_000_000_000_000 + i * 1_000}"
+        for i in range(20))
+    n1.write_lp(lines, db="dmv")
+    assert _wait_count(n1, "mv", "dmv", 20) == 20
+    # find the vnode and its owning node
+    out = n1.sql("SELECT vnode_id, node_id FROM cluster_schema.vnodes "
+                 "WHERE owner = 'cnosdb.dmv'", db="public")
+    rows = _csv_rows(out)
+    assert rows, out
+    vid, owner_node = int(rows[0][0]), int(rows[0][1])
+    target = next(n.node_id for n in (n1, n2, n3)
+                  if n.node_id != owner_node)
+    n1.sql(f"MOVE VNODE {vid} TO NODE {target}", db="public")
+    # data fully served from the new node
+    survivor = next(n for n in (n1, n2, n3) if n.node_id != owner_node)
+    assert _wait_count(survivor, "mv", "dmv", 20) == 20
+    # kill the ORIGINAL owner: the moved vnode must keep answering
+    victim = next(n for n in (n1, n2, n3) if n.node_id == owner_node)
+    victim.kill()
+    assert _wait_count(survivor, "mv", "dmv", 20, timeout=30.0) == 20
+    victim.start().wait_ready()
