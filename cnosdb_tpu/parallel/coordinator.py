@@ -41,6 +41,8 @@ class PlacedSplit:
     node_id: int = 0
     # failover candidates: other replicas as (vnode_id, node_id)
     alternates: list = field(default_factory=list)
+    # replicas currently marked BROKEN (self-heal on a successful scan)
+    broken_ids: set = field(default_factory=set)
 
 
 class Coordinator:
@@ -81,8 +83,8 @@ class Coordinator:
             # placement changed: raft peer resolution + scan snapshots must
             # re-derive from the new replica-set layout
             if self._replica_mgr is not None:
-                self._replica_mgr._placements.pop(
-                    f"{payload['owner']}/{payload['rs_id']}", None)
+                self._replica_mgr.invalidate(payload["owner"],
+                                             payload["rs_id"])
             with self._scan_cache_lock:
                 self._scan_cache.clear()
             return
@@ -304,11 +306,22 @@ class Coordinator:
                 if vnode_id in seen:
                     continue
                 seen.add(vnode_id)
-                alts = [(a.id, a.node_id) for a in rs.vnodes
-                        if a.id != vnode_id]
-                splits.append(PlacedSplit(owner, vnode_id, table,
-                                          time_ranges, tag_domains,
-                                          node_id=node_id, alternates=alts))
+                # alternates: RUNNING replicas first; BROKEN ones stay as a
+                # last resort (and self-heal when a scan succeeds); COPYING
+                # replicas have no data yet and are never read
+                running = [(a.id, a.node_id) for a in rs.vnodes
+                           if a.id != vnode_id
+                           and a.status == VnodeStatus.RUNNING]
+                broken = [(a.id, a.node_id) for a in rs.vnodes
+                          if a.id != vnode_id
+                          and a.status == VnodeStatus.BROKEN]
+                split = PlacedSplit(owner, vnode_id, table,
+                                    time_ranges, tag_domains,
+                                    node_id=node_id,
+                                    alternates=running + broken)
+                split.broken_ids = {a.id for a in rs.vnodes
+                                    if a.status == VnodeStatus.BROKEN}
+                splits.append(split)
         return splits
 
     def scan_table(self, tenant: str, db: str, table: str,
@@ -382,10 +395,17 @@ class Coordinator:
                     "doms": split.tag_domains.to_wire(),
                     "field_names": field_names,
                 })
-            except (RpcUnavailable, RpcError) as e:
+            except RpcUnavailable as e:
+                # connection-level failure only: an app-level RpcError
+                # (e.g. a memory-pool rejection) is not a broken replica
                 last_err = e
                 self._mark_vnode_broken(vnode_id)
                 continue
+            except RpcError as e:
+                last_err = e
+                continue
+            if vnode_id in split.broken_ids:
+                self._clear_vnode_broken(vnode_id)  # it answered: self-heal
             raw = r.get("ipc")
             if raw is None:
                 return None
@@ -404,13 +424,26 @@ class Coordinator:
     def _mark_vnode_broken(self, vnode_id: int):
         """Failed-replica marking (reference reader/mod.rs:36
         CheckedCoordinatorRecordBatchStream → Broken status); readers then
-        prefer RUNNING replicas until an admin repairs/moves it."""
+        prefer RUNNING replicas. Self-heals when a later scan succeeds.
+        Skips the meta write when already marked — a down node must not
+        turn every scan retry into an O(catalog) meta broadcast."""
         from ..models.meta_data import VnodeStatus
 
         try:
+            hit = self.meta.find_vnode(vnode_id)
+            if hit is not None and hit[3].status == VnodeStatus.BROKEN:
+                return
             self.meta.update_vnode(vnode_id, status=int(VnodeStatus.BROKEN))
         except Exception:
             pass  # advisory only; the scan already failed over
+
+    def _clear_vnode_broken(self, vnode_id: int):
+        from ..models.meta_data import VnodeStatus
+
+        try:
+            self.meta.update_vnode(vnode_id, status=int(VnodeStatus.RUNNING))
+        except Exception:
+            pass
 
     # ---------------------------------------------------------------- admin
     def move_vnode(self, vnode_id: int, to_node: int):
@@ -458,27 +491,42 @@ class Coordinator:
             raise CoordinatorError(
                 "COPY VNODE of a raft-replicated set needs membership "
                 "change (unsupported); use MOVE VNODE")
+        from ..models.meta_data import VnodeStatus
+
         data = self._fetch_vnode_snapshot(owner, vnode_id, v.node_id)
-        new_id = self.meta.add_replica_vnode(rs.id, to_node)
-        if data is not None:
-            self._install_vnode_snapshot(owner, new_id, to_node, data)
+        # register as COPYING so readers skip it, install, THEN go RUNNING;
+        # a failed install rolls the placeholder back out
+        new_id = self.meta.add_replica_vnode(rs.id, to_node,
+                                             status=int(VnodeStatus.COPYING))
+        try:
+            if data is not None:
+                self._install_vnode_snapshot(owner, new_id, to_node, data)
+        except Exception:
+            self.meta.remove_replica_vnode(new_id)
+            raise
+        self.meta.update_vnode(new_id, status=int(VnodeStatus.RUNNING))
         return new_id
 
     def drop_replica(self, vnode_id: int):
-        """REPLICA REMOVE: update placement, then drop the data on the
-        OWNING node (node-aware — the vnode may not be local)."""
+        """REPLICA REMOVE: update placement, tear down the raft member,
+        then drop the data on the OWNING node (node-aware — the vnode may
+        not be local). A live raft ticker would recreate the WAL the drop
+        removes, so the member stops first."""
         hit = self.meta.find_vnode(vnode_id)
         if hit is None:
             raise CoordinatorError(f"unknown vnode {vnode_id}")
-        owner, _b, _rs, v = hit
+        owner, _b, rs, v = hit
         node = v.node_id
         self.meta.remove_replica_vnode(vnode_id)
+        if self._replica_mgr is not None:
+            self._replica_mgr.stop_member(owner, rs.id, vnode_id)
         if node == self.node_id or not self.distributed:
             self.engine.drop_vnode(owner, vnode_id)
         else:
             try:
                 self._rpc(node, "vnode_drop",
-                          {"owner": owner, "vnode_id": vnode_id})
+                          {"owner": owner, "vnode_id": vnode_id,
+                           "rs_id": rs.id})
             except Exception:
                 pass  # orphaned data is garbage, placement is authoritative
 
@@ -499,12 +547,11 @@ class Coordinator:
     def copy_vnode_to_set(self, rs_id: int, to_node: int) -> int:
         """REPLICA ADD ON <rs> NODE <n>: seed a new replica from the set's
         current leader vnode."""
-        for owner, buckets in self.meta.buckets.items():
-            for b in buckets:
-                for rs in b.shard_group:
-                    if rs.id == rs_id:
-                        return self.copy_vnode(rs.leader_vnode_id, to_node)
-        raise CoordinatorError(f"unknown replica set {rs_id}")
+        hit = self.meta.find_replica_set(rs_id)
+        if hit is None:
+            raise CoordinatorError(f"unknown replica set {rs_id}")
+        _owner, rs = hit
+        return self.copy_vnode(rs.leader_vnode_id, to_node)
 
     def _fetch_vnode_snapshot(self, owner: str, vnode_id: int,
                               node: int) -> bytes | None:

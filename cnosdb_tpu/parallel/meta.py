@@ -552,25 +552,36 @@ class MetaStore:
             self._notify("update_vnode", owner=owner, vnode_id=vnode_id,
                          rs_id=rs.id, node_id=v.node_id, status=int(v.status))
 
-    def add_replica_vnode(self, rs_id: int, node_id: int) -> int:
-        """COPY VNODE target: add a replica to a replica set (reference
-        REPLICA ADD, raft/manager.rs add_follower)."""
-        from ..models.meta_data import VnodeInfo
-
+    def find_replica_set(self, rs_id: int):
+        """→ (owner, rs) or None — the single authority for rs lookups."""
         with self.lock:
             for owner, buckets in self.buckets.items():
                 for b in buckets:
                     for rs in b.shard_group:
                         if rs.id == rs_id:
-                            vid = self._next_vnode_id
-                            self._next_vnode_id += 1
-                            rs.vnodes.append(VnodeInfo(vid, node_id))
-                            self._persist()
-                            self._notify("update_vnode", owner=owner,
-                                         vnode_id=vid, rs_id=rs.id,
-                                         node_id=node_id, status=0)
-                            return vid
-            raise MetaError(f"unknown replica set {rs_id}")
+                            return owner, rs
+            return None
+
+    def add_replica_vnode(self, rs_id: int, node_id: int,
+                          status: int = 0) -> int:
+        """COPY VNODE target: add a replica to a replica set (reference
+        REPLICA ADD, raft/manager.rs add_follower). Callers seeding data
+        pass status=COPYING and flip to RUNNING only after the snapshot
+        installs, so readers never trust a data-less replica."""
+        from ..models.meta_data import VnodeInfo, VnodeStatus
+
+        with self.lock:
+            hit = self.find_replica_set(rs_id)
+            if hit is None:
+                raise MetaError(f"unknown replica set {rs_id}")
+            owner, rs = hit
+            vid = self._next_vnode_id
+            self._next_vnode_id += 1
+            rs.vnodes.append(VnodeInfo(vid, node_id, VnodeStatus(status)))
+            self._persist()
+            self._notify("update_vnode", owner=owner, vnode_id=vid,
+                         rs_id=rs.id, node_id=node_id, status=status)
+            return vid
 
     def remove_replica_vnode(self, vnode_id: int):
         """REPLICA REMOVE: drop one replica entry from its set."""

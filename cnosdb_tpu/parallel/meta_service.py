@@ -325,9 +325,9 @@ class MetaClient:
         return self._forward("update_vnode", vnode_id=vnode_id,
                              node_id=node_id, status=status)
 
-    def add_replica_vnode(self, rs_id, node_id):
+    def add_replica_vnode(self, rs_id, node_id, status=0):
         return self._forward("add_replica_vnode", rs_id=rs_id,
-                             node_id=node_id)
+                             node_id=node_id, status=status)
 
     def remove_replica_vnode(self, vnode_id):
         return self._forward("remove_replica_vnode", vnode_id=vnode_id)

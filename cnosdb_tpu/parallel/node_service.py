@@ -131,6 +131,11 @@ class DataNodeService:
         return {"ok": True}
 
     def _vnode_drop(self, p):
+        # stop any live raft member first: its ticker would recreate the
+        # WAL the drop removes
+        if p.get("rs_id") is not None and self.coord._replica_mgr is not None:
+            self.coord._replica_mgr.stop_member(
+                p["owner"], p["rs_id"], p["vnode_id"])
         self.coord.engine.drop_vnode(p["owner"], p["vnode_id"])
         return {"ok": True}
 

@@ -712,6 +712,11 @@ class MultiRaft:
     def get(self, group_id: str) -> RaftNode | None:
         return self.groups.get(group_id)
 
+    def remove(self, node: RaftNode):
+        with self.lock:
+            if self.groups.get(node.group_id) is node:
+                del self.groups[node.group_id]
+
     def stop_all(self):
         with self.lock:
             for n in self.groups.values():

@@ -201,6 +201,21 @@ class ReplicaGroupManager:
                 return None
         return node.handle_message(msg)
 
+    def invalidate(self, owner: str, rs_id: int):
+        """Placement changed: drop the cached ReplicationSet for peer
+        resolution (single authority for the cache-key format)."""
+        self._placements.pop(f"{owner}/{rs_id}", None)
+
+    def stop_member(self, owner: str, rs_id: int, vnode_id: int):
+        """Tear down this node's raft member for a removed replica — its
+        WAL/dir is about to be dropped and a live ticker would recreate
+        them (REPLICA REMOVE)."""
+        gid = f"{owner}/{rs_id}"
+        node = self.transport.nodes.pop((gid, vnode_id), None)
+        if node is not None:
+            node.stop()
+            self.multi.remove(node)
+
     def current_leader_vnode(self, owner: str, rs: ReplicationSet) -> int | None:
         """The raft leader's vnode id (may differ from meta's static
         leader_vnode_id after elections) — readers follow it for
