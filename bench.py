@@ -1,20 +1,31 @@
-"""Benchmark: TSBS double-groupby-style scan/aggregate through the full engine.
+"""Benchmark: TSBS + hits query shapes over a 100M-row dataset, end to end.
 
-Ingests a TSBS-cpu-like dataset (100 hosts × 20k points, 2M rows), flushes
-to TSM, then measures the end-to-end SQL query path — scan (decode + merge)
-→ device filter/bucket/segment-aggregate → result — for the headline query
-shape `SELECT date_bin(1h, time), host, mean(usage_user) GROUP BY ...`
-(TSBS double-groupby-1; BASELINE.json config 2).
+Ingests a TSBS-cpu-like dataset (100 hosts × 1M points @10s cadence,
+100M rows × 2 fields) through the full write path (WAL → memcache → TSM),
+then measures the SQL query path — scan (decode+merge) → fused
+filter/bucket/segment-aggregate kernels → result — for the BASELINE.json
+shapes:
 
-Prints ONE JSON line:
-    {"metric": ..., "value": rows/sec, "unit": "rows/s", "vs_baseline": x}
-vs_baseline compares against a pandas/numpy CPU implementation of the same
-aggregation over the same in-memory arrays (the reference publishes no
-absolute numbers — BASELINE.md — so the baseline is measured in-process).
+  double_groupby_1    avg(usage_user) by host×hour, full scan  (headline)
+  double_groupby_all  avg of every field by host×hour, full scan
+  cpu_max_all_8       8 aggregates, 8 hosts, 12h window
+  last_loc            last(usage_user) per host (iot last-loc analog)
+  avg_load            avg(usage_system) per host (iot avg-load analog)
+  hits_filtered_agg   count+max under a selective value filter
+  hits_top10          top-10 hosts by sum (ORDER BY agg DESC LIMIT)
+
+Each shape is baselined against a vectorized numpy implementation of the
+same aggregation over the same in-memory arrays (the reference publishes
+no absolute numbers — BASELINE.md — so the baseline is measured
+in-process on this machine).
+
+Prints ONE JSON line: the headline metric plus a per-shape breakdown.
+Dataset size scales down via CNOSDB_BENCH_ROWS (default 100_000_000).
 """
 from __future__ import annotations
 
 import json
+import os
 import shutil
 import sys
 import tempfile
@@ -22,12 +33,13 @@ import time
 
 import numpy as np
 
+TARGET_ROWS = int(os.environ.get("CNOSDB_BENCH_ROWS", 100_000_000))
 N_HOSTS = 100
-N_PER_HOST = 20_000
+N_PER_HOST = max(1, TARGET_ROWS // N_HOSTS)
 INTERVAL_NS = 10 * 10**9          # 10s cadence
 BUCKET_NS = 3600 * 10**9          # 1h buckets
-QUERY = ("SELECT date_bin(INTERVAL '1 hour', time) AS t, hostname, "
-         "avg(usage_user) AS mean_usage FROM cpu GROUP BY t, hostname")
+BASE_TS = 1_640_995_200_000_000_000  # 2022-01-01
+CHUNK = 250_000
 
 
 def build_dataset(coord, tenant, db):
@@ -36,33 +48,177 @@ def build_dataset(coord, tenant, db):
     from cnosdb_tpu.models.series import SeriesKey
 
     rng = np.random.default_rng(123)
-    base = 1_640_995_200_000_000_000  # 2022-01-01
-    ts = (base + np.arange(N_PER_HOST, dtype=np.int64) * INTERVAL_NS)
-    ts_list = ts.tolist()
     t0 = time.perf_counter()
     for h in range(N_HOSTS):
-        usage = np.clip(50 + 20 * np.sin(np.arange(N_PER_HOST) / 500 + h)
-                        + rng.normal(0, 5, N_PER_HOST), 0, 100)
-        wb = WriteBatch()
-        wb.add_series("cpu", SeriesRows(
-            SeriesKey("cpu", {"hostname": f"host_{h}"}), ts_list,
-            {"usage_user": (int(ValueType.FLOAT), usage.tolist())}))
-        coord.write_points(tenant, db, wb)
+        key = SeriesKey("cpu", {"hostname": f"host_{h:03d}"})
+        for off in range(0, N_PER_HOST, CHUNK):
+            n = min(CHUNK, N_PER_HOST - off)
+            ts = BASE_TS + (np.arange(n, dtype=np.int64) + off) * INTERVAL_NS
+            user = np.clip(50 + 20 * np.sin((np.arange(n) + off) / 500 + h)
+                           + rng.normal(0, 5, n), 0, 100)
+            syst = np.clip(user * 0.4 + rng.normal(0, 2, n), 0, 100)
+            wb = WriteBatch()
+            wb.add_series("cpu", SeriesRows(
+                key, ts.tolist(),
+                {"usage_user": (int(ValueType.FLOAT), user.tolist()),
+                 "usage_system": (int(ValueType.FLOAT), syst.tolist())}))
+            coord.write_points(tenant, db, wb)
     coord.engine.flush_all()
     coord.engine.compact_all()
     return time.perf_counter() - t0
 
 
-def numpy_baseline(ts, hosts_idx, usage, n_hosts):
-    """The CPU-side oracle: same grouping in vectorized numpy."""
-    bucket = (ts - ts.min()) // BUCKET_NS
-    nb = int(bucket.max()) + 1
-    seg = hosts_idx.astype(np.int64) * nb + bucket
-    nseg = n_hosts * nb
-    sums = np.bincount(seg, weights=usage, minlength=nseg)
+def _seg_mean(seg, weights, nseg):
+    sums = np.bincount(seg, weights=weights, minlength=nseg)
     counts = np.bincount(seg, minlength=nseg)
     with np.errstate(invalid="ignore"):
-        return sums / np.maximum(counts, 1), counts
+        return sums / np.maximum(counts, 1)
+
+
+class Arrays:
+    """The in-memory columns every numpy baseline runs over."""
+
+    def __init__(self, coord, tenant, db):
+        batches = coord.scan_table(tenant, db, "cpu")
+        self.ts = np.concatenate([b.ts for b in batches])
+        self.user = np.concatenate(
+            [b.fields["usage_user"][1] for b in batches])
+        self.syst = np.concatenate(
+            [b.fields["usage_system"][1] for b in batches])
+        host_names = []
+        parts = []
+        off = 0
+        for b in batches:
+            for k in b.series_keys:
+                host_names.append(k.tag_dict()["hostname"])
+            parts.append(b.sid_ordinal.astype(np.int64) + off)
+            off += b.n_series
+        self.host_of_series = np.array(
+            [int(h.split("_")[1]) for h in host_names])
+        self.host = self.host_of_series[np.concatenate(parts)]
+        self.bucket = (self.ts - BASE_TS) // BUCKET_NS
+        self.nb = int(self.bucket.max()) + 1
+
+
+def shapes(arrays: Arrays):
+    """→ [(name, sql, rows_touched, numpy_fn)]. Each numpy fn computes the
+    same answer the SQL must produce (spot-verified below)."""
+    a = arrays
+    n = len(a.ts)
+    win_lo = BASE_TS + (a.nb // 2) * BUCKET_NS
+    win_hi = win_lo + 12 * BUCKET_NS - 1
+    eight = [f"host_{h:03d}" for h in range(0, 64, 8)]
+    eight_idx = set(range(0, 64, 8))
+    wmask = ((a.ts >= win_lo) & (a.ts <= win_hi)
+             & np.isin(a.host, list(eight_idx)))
+
+    def np_dg1():
+        seg = a.host * a.nb + a.bucket
+        return _seg_mean(seg, a.user, N_HOSTS * a.nb)
+
+    def np_dgall():
+        seg = a.host * a.nb + a.bucket
+        nseg = N_HOSTS * a.nb
+        return _seg_mean(seg, a.user, nseg), _seg_mean(seg, a.syst, nseg)
+
+    def np_max8():
+        sel = wmask
+        seg = (a.bucket[sel] - (win_lo - BASE_TS) // BUCKET_NS).astype(np.int64)
+        out = []
+        for col in (a.user[sel], a.syst[sel]):
+            for red in ("max", "min", "sum", "mean"):
+                if red == "max":
+                    r = np.full(12, -np.inf)
+                    np.maximum.at(r, seg, col)
+                elif red == "min":
+                    r = np.full(12, np.inf)
+                    np.minimum.at(r, seg, col)
+                elif red == "sum":
+                    r = np.bincount(seg, weights=col, minlength=12)
+                else:
+                    r = _seg_mean(seg, col, 12)
+                out.append(r)
+        return out
+
+    def np_lastloc():
+        # last per host: rows are time-ordered per series; track max-ts row
+        last_ts = np.zeros(N_HOSTS, dtype=np.int64)
+        last_val = np.zeros(N_HOSTS)
+        np.maximum.at(last_ts, a.host, a.ts)
+        pick = a.ts == last_ts[a.host]
+        last_val[a.host[pick]] = a.user[pick]
+        return last_val
+
+    def np_avgload():
+        return _seg_mean(a.host, a.syst, N_HOSTS)
+
+    def np_filtered():
+        m = a.user > 90
+        return int(m.sum()), (a.syst[m].max() if m.any() else None)
+
+    def np_top10():
+        sums = np.bincount(a.host, weights=a.user, minlength=N_HOSTS)
+        order = np.argsort(-sums)[:10]
+        return sums[order]
+
+    in_list = ", ".join(f"'{h}'" for h in eight)
+    return [
+        ("double_groupby_1",
+         "SELECT date_bin(INTERVAL '1 hour', time) AS t, hostname, "
+         "avg(usage_user) AS m FROM cpu GROUP BY t, hostname",
+         n, np_dg1),
+        ("double_groupby_all",
+         "SELECT date_bin(INTERVAL '1 hour', time) AS t, hostname, "
+         "avg(usage_user) AS mu, avg(usage_system) AS ms "
+         "FROM cpu GROUP BY t, hostname",
+         n, np_dgall),
+        ("cpu_max_all_8",
+         "SELECT date_bin(INTERVAL '1 hour', time) AS t, "
+         "max(usage_user) AS a1, min(usage_user) AS a2, "
+         "sum(usage_user) AS a3, avg(usage_user) AS a4, "
+         "max(usage_system) AS a5, min(usage_system) AS a6, "
+         "sum(usage_system) AS a7, avg(usage_system) AS a8 "
+         f"FROM cpu WHERE hostname IN ({in_list}) "
+         f"AND time >= {win_lo} AND time <= {win_hi} GROUP BY t",
+         int(wmask.sum()), np_max8),
+        ("last_loc",
+         "SELECT hostname, last(usage_user) AS l FROM cpu GROUP BY hostname",
+         n, np_lastloc),
+        ("avg_load",
+         "SELECT hostname, avg(usage_system) AS a FROM cpu GROUP BY hostname",
+         n, np_avgload),
+        ("hits_filtered_agg",
+         "SELECT count(*) AS c, max(usage_system) AS m FROM cpu "
+         "WHERE usage_user > 90",
+         n, np_filtered),
+        ("hits_top10",
+         "SELECT hostname, sum(usage_user) AS s FROM cpu "
+         "GROUP BY hostname ORDER BY s DESC LIMIT 10",
+         n, np_top10),
+    ]
+
+
+def spot_check(name, rs, arrays):
+    """The engine's answers must MATCH the oracle (not just be fast)."""
+    a = arrays
+    cols = {n: c for n, c in zip(rs.names, rs.columns)}
+    if name == "double_groupby_1":
+        want = a.user[(a.host == 3) & (a.bucket == 5)].mean()
+        got = cols["m"][(cols["hostname"] == "host_003")
+                        & (cols["t"] == BASE_TS + 5 * BUCKET_NS)]
+        np.testing.assert_allclose(got, [want], rtol=1e-9)
+    elif name == "last_loc":
+        i = np.argmax(cols["hostname"] == "host_007")
+        last_idx = np.flatnonzero(a.host == 7)
+        want = a.user[last_idx[np.argmax(a.ts[last_idx])]]
+        np.testing.assert_allclose(cols["l"][i], want, rtol=1e-12)
+    elif name == "hits_filtered_agg":
+        m = a.user > 90
+        assert int(cols["c"][0]) == int(m.sum())
+    elif name == "hits_top10":
+        sums = np.bincount(a.host, weights=a.user, minlength=N_HOSTS)
+        want = np.sort(sums)[::-1][:10]
+        np.testing.assert_allclose(np.sort(cols["s"])[::-1], want, rtol=1e-9)
 
 
 def main():
@@ -72,11 +228,13 @@ def main():
         from cnosdb_tpu.parallel.meta import MetaStore, DEFAULT_TENANT
         from cnosdb_tpu.sql.executor import QueryExecutor, Session
         from cnosdb_tpu.storage.engine import TsKv
+        from cnosdb_tpu.utils.memory_pool import MemoryPool
 
         meta = MetaStore(data_dir + "/meta.json")
         engine = TsKv(data_dir + "/data")
-        coord = Coordinator(meta, engine)
-        executor = QueryExecutor(meta, coord)
+        pool = MemoryPool(64 << 30)   # 100M-row scans are tens of GB
+        coord = Coordinator(meta, engine, memory_pool=pool)
+        executor = QueryExecutor(meta, coord, memory_pool=pool)
         session = Session(database="public")
 
         n_rows = N_HOSTS * N_PER_HOST
@@ -84,42 +242,43 @@ def main():
         print(f"# ingested {n_rows} rows in {ingest_s:.1f}s "
               f"({n_rows/ingest_s/1e6:.2f}M rows/s)", file=sys.stderr)
 
-        # --- engine path (scan → TPU kernels → merge) -------------------
-        rs = executor.execute_one(QUERY, session)   # warm-up (compile+cache)
-        expect_groups = rs.n_rows
-        iters = 3
-        t0 = time.perf_counter()
-        for _ in range(iters):
-            rs = executor.execute_one(QUERY, session)
-        engine_dt = (time.perf_counter() - t0) / iters
-        assert rs.n_rows == expect_groups
-        engine_rate = n_rows / engine_dt
-
-        # --- CPU baseline over identical in-memory arrays ----------------
-        batches = coord.scan_table(DEFAULT_TENANT, "public", "cpu")
-        ts = np.concatenate([b.ts for b in batches])
-        usage = np.concatenate([b.fields["usage_user"][1] for b in batches])
-        hosts_idx = np.concatenate(
-            [b.sid_ordinal + sum(bb.n_series for bb in batches[:i])
-             for i, b in enumerate(batches)]).astype(np.int64)
-        numpy_baseline(ts, hosts_idx, usage, N_HOSTS)  # warm-up
-        t0 = time.perf_counter()
-        for _ in range(iters):
-            numpy_baseline(ts, hosts_idx, usage, N_HOSTS)
-        base_dt = (time.perf_counter() - t0) / iters
-        base_rate = n_rows / base_dt
-        print(f"# engine query {engine_dt*1e3:.0f}ms "
-              f"({engine_rate/1e6:.1f}M rows/s) | numpy-groupby baseline "
-              f"{base_dt*1e3:.0f}ms ({base_rate/1e6:.1f}M rows/s)",
-              file=sys.stderr)
+        arrays = Arrays(coord, DEFAULT_TENANT, "public")
+        results = {}
+        headline = None
+        for name, sql, rows_touched, np_fn in shapes(arrays):
+            rs = executor.execute_one(sql, session)   # warm (compile+cache)
+            spot_check(name, rs, arrays)
+            iters = 2
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                rs = executor.execute_one(sql, session)
+            engine_dt = (time.perf_counter() - t0) / iters
+            np_fn()   # warm
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                np_fn()
+            base_dt = (time.perf_counter() - t0) / iters
+            rate = rows_touched / engine_dt
+            vs = (rows_touched / engine_dt) / (rows_touched / base_dt)
+            results[name] = {"rows_per_s": round(rate, 1),
+                             "ms": round(engine_dt * 1e3, 1),
+                             "baseline_ms": round(base_dt * 1e3, 1),
+                             "vs_baseline": round(vs, 3)}
+            print(f"# {name}: engine {engine_dt*1e3:.0f}ms "
+                  f"({rate/1e6:.1f}M rows/s) vs numpy {base_dt*1e3:.0f}ms "
+                  f"→ {vs:.2f}x", file=sys.stderr)
+            if name == "double_groupby_1":
+                headline = (rate, vs)
 
         print(json.dumps({
-            "metric": "tsbs_double_groupby_1h_scan_agg",
-            "value": round(engine_rate, 1),
+            "metric": "tsbs_double_groupby_1h_scan_agg_100m",
+            "value": round(headline[0], 1),
             "unit": "rows/s",
-            "vs_baseline": round(engine_rate / base_rate, 3),
+            "vs_baseline": round(headline[1], 3),
+            "n_rows": n_rows,
+            "shapes": results,
         }))
-        engine.close()
+        coord.close()
     finally:
         shutil.rmtree(data_dir, ignore_errors=True)
 

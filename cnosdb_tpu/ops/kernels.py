@@ -109,6 +109,64 @@ segment_aggregate = jax.jit(
                      "want_max", "want_first", "want_last"))
 
 
+def numpy_segment_partials(values: np.ndarray, valid: np.ndarray,
+                           seg_ids: np.ndarray, rank: np.ndarray,
+                           num_segments: int, wants: dict,
+                           assume_all_valid: bool = False) -> dict:
+    """Pure-numpy segment reductions — the CPU-placement twin of the XLA
+    kernel. On one core, bincount/ufunc.at beat XLA's scatter lowering by
+    ~2×, and no padding copies are needed; the device path remains the
+    jitted kernel (placement decides, ops/placement.py)."""
+    if not assume_all_valid and not valid.all():
+        rows = np.nonzero(valid)[0]
+        values = values[rows]
+        seg_ids = seg_ids[rows]
+        rank = rank[rows]
+    out: dict[str, np.ndarray] = {}
+    ns = num_segments
+    if wants.get("want_count"):
+        out["count"] = np.bincount(seg_ids, minlength=ns).astype(np.int64)
+    integral = values.dtype.kind in "iu"
+    if wants.get("want_sum"):
+        if integral:
+            # bincount sums in f64 and would round past 2^53; add.at is
+            # slower but exact in the column's own integer arithmetic
+            acc = np.zeros(ns, dtype=values.dtype)
+            np.add.at(acc, seg_ids, values)
+            out["sum"] = acc
+        else:
+            out["sum"] = np.bincount(seg_ids, weights=values, minlength=ns)
+    if wants.get("want_min"):
+        init = (np.iinfo(values.dtype).max if integral
+                else np.asarray(np.inf, values.dtype))
+        acc = np.full(ns, init, dtype=values.dtype)
+        np.minimum.at(acc, seg_ids, values)
+        out["min"] = acc
+    if wants.get("want_max"):
+        init = (np.iinfo(values.dtype).min if integral
+                else np.asarray(-np.inf, values.dtype))
+        acc = np.full(ns, init, dtype=values.dtype)
+        np.maximum.at(acc, seg_ids, values)
+        out["max"] = acc
+    if wants.get("want_first") or wants.get("want_last"):
+        sel_rank = {}
+        if wants.get("want_first"):
+            acc = np.full(ns, I32_MAX, dtype=rank.dtype)
+            np.minimum.at(acc, seg_ids, rank)
+            sel_rank["first"] = acc
+        if wants.get("want_last"):
+            acc = np.full(ns, I32_MIN, dtype=rank.dtype)
+            np.maximum.at(acc, seg_ids, rank)
+            sel_rank["last"] = acc
+        for name, acc in sel_rank.items():
+            pick = rank == acc[seg_ids]
+            vals_out = np.zeros(ns, dtype=values.dtype)
+            vals_out[seg_ids[pick]] = values[pick]
+            out[name] = vals_out
+            out[f"{name}_rank"] = acc
+    return out
+
+
 def aggregate_column_host(values: np.ndarray, valid: np.ndarray,
                           seg_ids: np.ndarray, rank: np.ndarray,
                           num_segments: int, wants: dict) -> dict:

@@ -138,7 +138,14 @@ def launch_scan_aggregate(batch: ScanBatch, query: TpuQuery):
         arith = bucket_arith_params(ts_lo, origin, interval, int(bmin),
                                     max_span_ns=ts_hi - ts_lo)
     i32_ok = (ts_hi - ts_lo) < (2**31 - 2) * 1_000_000_000
-    use_device = (_device_eligible(batch, query, col_wants, dense_span)
+    # placement: when the scan device resolved to CPU (no accelerator, or a
+    # degraded host↔device pipe), the pure-numpy host kernels beat XLA's
+    # CPU scatter lowering — the fused path is for real devices
+    from .placement import scan_device
+
+    cpu_mode = scan_device().platform == "cpu"
+    use_device = (not cpu_mode
+                  and _device_eligible(batch, query, col_wants, dense_span)
                   and i32_ok
                   and (query.time_bucket is None or arith is not None))
 
@@ -168,31 +175,79 @@ def launch_scan_aggregate(batch: ScanBatch, query: TpuQuery):
         return complete
     else:
         # ---------------------------------------- host-prep path
-        group_of_row = group_of_series[batch.sid_ordinal]
-        if query.time_bucket is not None:
-            b = (batch.ts - origin) // interval
-            if dense_span <= _DENSE_BUCKET_LIMIT:
-                bucket_ids = (b - bmin).astype(np.int32)
-                bucket_starts = origin + (bmin + np.arange(dense_span, dtype=np.int64)) * interval
-                n_buckets = dense_span
-            else:
-                uniq, inv = np.unique(b, return_inverse=True)
-                bucket_ids = inv.astype(np.int32)
-                bucket_starts = origin + uniq * interval
-                n_buckets = len(uniq)
+        # segment-id derivation is identical across repeated queries of the
+        # same (group tags, bucket) shape over one scan snapshot — cache it
+        # on the batch (same rationale as the reference's TsmReader cache:
+        # re-derivation, not decode, dominates repeat queries)
+        seg_key = (tuple(query.group_tags), origin, interval, bmin,
+                   dense_span)
+        seg_cache = getattr(batch, "_seg_cache", None)
+        if seg_cache is None:
+            seg_cache = batch._seg_cache = {}
+        cached = seg_cache.get(seg_key)
+        if cached is not None:
+            seg_ids, bucket_starts, n_buckets = cached[:3]
         else:
-            bucket_ids = np.zeros(n, dtype=np.int32)
-            bucket_starts = None
-            n_buckets = 1
-
+            group_of_row = group_of_series[batch.sid_ordinal]
+            if query.time_bucket is not None:
+                b = (batch.ts - origin) // interval
+                if dense_span <= _DENSE_BUCKET_LIMIT:
+                    bucket_ids = (b - bmin).astype(np.int32)
+                    bucket_starts = origin + (bmin + np.arange(dense_span, dtype=np.int64)) * interval
+                    n_buckets = dense_span
+                else:
+                    uniq, inv = np.unique(b, return_inverse=True)
+                    bucket_ids = inv.astype(np.int32)
+                    bucket_starts = origin + uniq * interval
+                    n_buckets = len(uniq)
+            else:
+                bucket_ids = np.zeros(n, dtype=np.int32)
+                bucket_starts = None
+                n_buckets = 1
+            # i64 on the numpy path: bincount would otherwise re-cast an
+            # i32 key array to intp on EVERY call (a 40ms copy at 10M rows)
+            seg_dtype = np.int64 if cpu_mode else np.int32
+            seg_ids = (group_of_row.astype(np.int64) * n_buckets
+                       + bucket_ids.astype(np.int64)).astype(seg_dtype)
+            # small LRU with eviction (4 shapes ≈ 4×8B/row pinned):
+            # NOTE this derived-cache memory rides the batch outside the
+            # MemoryPool's admission accounting — bounded here instead
+            while len(seg_cache) >= 4:
+                seg_cache.pop(next(iter(seg_cache)))
+            seg_cache[seg_key] = [seg_ids, bucket_starts, n_buckets, None]
         num_segments = n_groups * n_buckets
-        seg_ids = (group_of_row.astype(np.int64) * n_buckets
-                   + bucket_ids.astype(np.int64)).astype(np.int32)
+
+        def cached_counts() -> np.ndarray:
+            """Group sizes (bincount of seg_ids over ALL rows) — derived
+            purely from the cached segment layout, so repeated queries pay
+            it once (count/presence of all-valid unfiltered columns)."""
+            entry = seg_cache.get(seg_key)
+            if entry is not None:
+                if entry[3] is None or len(entry[3]) < num_segments:
+                    c = np.bincount(seg_ids, minlength=num_segments) \
+                        .astype(np.int64)
+                    entry[3] = c
+                return entry[3][:num_segments]
+            return np.bincount(seg_ids, minlength=num_segments) \
+                .astype(np.int64)
+
+        # per-column validity is immutable for one scan snapshot: memoize
+        # the .all() reductions (a 10M-bool reduce costs ~4ms per query)
+        av_cache = getattr(batch, "_allvalid_cache", None)
+        if av_cache is None:
+            av_cache = batch._allvalid_cache = {}
+
+        def col_all_valid(cname, valid):
+            hit = av_cache.get(cname)
+            if hit is None:
+                hit = av_cache[cname] = bool(valid.all())
+            return hit
 
         # -------------------------------------------- filter
-        row_mask = np.ones(n, dtype=bool)
+        row_mask = None   # None = no filter, every row participates
         if query.filter is not None:
-            env = _filter_env(batch)
+            row_mask = np.ones(n, dtype=bool)
+            env = _filter_env(batch, needed=query.filter.columns())
             has_is_null = _contains_is_null(query.filter)
             missing = [c for c in query.filter.columns() if c not in env]
             if missing and not has_is_null:
@@ -211,24 +266,52 @@ def launch_scan_aggregate(batch: ScanBatch, query: TpuQuery):
                 # is null are excluded — except under an explicit IS NULL.
                 if not has_is_null:
                     for cname in query.filter.columns():
-                        if cname in batch.fields:
+                        if cname in batch.fields and not col_all_valid(
+                                cname, batch.fields[cname][2]):
                             row_mask &= batch.fields[cname][2]
-        seg_ids = np.where(row_mask, seg_ids, 0).astype(np.int32)
+        all_rows = row_mask is None or bool(row_mask.all())
+        if row_mask is None:
+            row_mask = np.ones(n, dtype=bool) if not cpu_mode \
+                else None   # the numpy path never touches it when all_rows
+        sel_idx = None
+        if not all_rows:
+            if cpu_mode:
+                # compress ONCE under a selective filter: every kernel then
+                # touches O(selected) rows instead of O(n) masked arrays
+                sel_idx = np.nonzero(row_mask)[0]
+            else:
+                seg_ids = np.where(row_mask, seg_ids, 0).astype(np.int32)
 
         # -------------------------------------------- rank for first/last
         if needs_rank:
-            order = np.argsort(batch.ts, kind="stable")
-            rank = np.empty(n, dtype=np.int32)
-            rank[order] = np.arange(n, dtype=np.int32)
+            rank = getattr(batch, "_rank_cache", None)
+            if rank is None:
+                order = np.argsort(batch.ts, kind="stable")
+                rank = np.empty(n, dtype=np.int32)
+                rank[order] = np.arange(n, dtype=np.int32)
+                batch._rank_cache = rank
+                batch._order_cache = order
+            order = batch._order_cache
         else:
             order = None
-            rank = np.zeros(n, dtype=np.int32)
+            rank = getattr(batch, "_zero_rank", None)
+            if rank is None or len(rank) != n:
+                rank = batch._zero_rank = np.zeros(n, dtype=np.int32)
 
         # -------------------------------------------- per-column kernels
-        presence = kernels.aggregate_column_host(
-            np.zeros(n, dtype=np.int64), row_mask, seg_ids, rank, num_segments,
-            {"want_count": True, "want_sum": False, "want_min": False,
-             "want_max": False})["count"]
+        seg_kernel = (kernels.numpy_segment_partials if cpu_mode
+                      else kernels.aggregate_column_host)
+        if all_rows:
+            presence = cached_counts()
+        elif sel_idx is not None:
+            presence = np.bincount(seg_ids[sel_idx],
+                                   minlength=num_segments).astype(np.int64)
+        else:
+            presence = seg_kernel(
+                np.zeros(n, dtype=np.int64), row_mask, seg_ids, rank,
+                num_segments,
+                {"want_count": True, "want_sum": False, "want_min": False,
+                 "want_max": False})["count"]
         present = presence > 0
 
         col_results = {}
@@ -238,30 +321,52 @@ def launch_scan_aggregate(batch: ScanBatch, query: TpuQuery):
                 continue
             vt, vals, valid = batch.fields[cname]
             if vt in (ValueType.STRING, ValueType.GEOMETRY):
+                sv = valid if row_mask is None else (valid & row_mask)
                 col_results[cname] = _host_string_agg(
-                    vals, valid & row_mask, seg_ids, rank, num_segments, wants)
+                    vals, sv, seg_ids, rank, num_segments, wants)
                 continue
             if vt == ValueType.BOOLEAN:
                 dev_vals = vals.astype(np.int64)
-            elif vt == ValueType.UNSIGNED:
+            elif vt == ValueType.UNSIGNED and not cpu_mode:
                 # order-preserving bias: u64 ^ 2^63 viewed as i64 keeps the
                 # kernel's comparisons/min/max exact for values ≥ 2^63;
-                # sums stay exact mod 2^64 and _assemble un-biases
+                # sums stay exact mod 2^64 and _assemble un-biases. The
+                # numpy path compares/accumulates uint64 natively: no bias.
                 dev_vals = (np.asarray(vals, dtype=np.uint64)
                             ^ np.uint64(1 << 63)).view(np.int64)
             else:
                 dev_vals = vals
-            col_results[cname] = kernels.aggregate_column_host(
-                dev_vals, valid & row_mask, seg_ids, rank, num_segments,
+            all_valid = col_all_valid(cname, valid)
+            if sel_idx is not None:
+                # compressed path: gather selected rows once per column
+                v_sel = dev_vals[sel_idx]
+                valid_sel = (np.ones(len(sel_idx), dtype=bool) if all_valid
+                             else valid[sel_idx])
+                col_results[cname] = seg_kernel(
+                    v_sel, valid_sel, seg_ids[sel_idx], rank[sel_idx],
+                    num_segments, {**wants, "want_count": True})
+                continue
+            if all_rows and all_valid and cpu_mode:
+                # count == cached group sizes; skip the redundant bincount
+                r = kernels.numpy_segment_partials(
+                    dev_vals, valid, seg_ids, rank, num_segments,
+                    {**wants, "want_count": False}, assume_all_valid=True)
+                r["count"] = presence
+                col_results[cname] = r
+                continue
+            col_valid = valid if all_rows else (valid & row_mask)
+            col_results[cname] = seg_kernel(
+                dev_vals, col_valid, seg_ids, rank, num_segments,
                 {**wants, "want_count": True})
 
         return _assemble(batch, query, presence, present, col_results,
                          group_labels, bucket_starts, n_buckets, needs_rank,
-                         order)
+                         order, unsigned_biased=not cpu_mode)
 
 
 def _assemble(batch, query, presence, present, col_results, group_labels,
-              bucket_starts, n_buckets, needs_rank, order) -> AggResult:
+              bucket_starts, n_buckets, needs_rank, order,
+              unsigned_biased: bool = True) -> AggResult:
     out_cols: dict[str, np.ndarray] = {}
     out_valid: dict[str, np.ndarray] = {}
     sel = np.nonzero(present)[0]
@@ -285,7 +390,7 @@ def _assemble(batch, query, presence, present, col_results, group_labels,
                 out_valid[a.alias] = np.zeros(len(sel), dtype=bool)
             continue
         cnt = r.get("count")
-        unsigned = (a.column in batch.fields
+        unsigned = (unsigned_biased and a.column in batch.fields
                     and batch.fields[a.column][0] == ValueType.UNSIGNED)
 
         def unbias(x):
@@ -383,16 +488,20 @@ def _contains_is_null(e) -> bool:
     return False
 
 
-def _filter_env(batch: ScanBatch) -> dict:
+def _filter_env(batch: ScanBatch, needed: set | None = None) -> dict:
+    """Filter-evaluation env. `needed` restricts which columns materialize:
+    per-row tag expansion builds 10M-element OBJECT arrays, so only tags
+    the filter actually references are worth paying for."""
     env: dict = {"time": batch.ts}
     for name, (vt, vals, valid) in batch.fields.items():
         env[name] = vals
         env[f"__valid__:{name}"] = valid
-    # tag columns expand per-row from series keys
     tag_names = set()
     for k in batch.series_keys:
         if k is not None:
             tag_names.update(t.key for t in k.tags)
+    if needed is not None:
+        tag_names &= needed
     for t in tag_names:
         per_series = np.array(
             [(k.tag_value(t) if k is not None else None) for k in batch.series_keys],

@@ -384,9 +384,16 @@ class Coordinator:
         last_err = None
         for vnode_id, node_id in targets:
             if node_id == self.node_id:
+                if self.engine.vnode(split.owner, vnode_id) is None:
+                    # placement says local but the data is absent (dropped /
+                    # never installed): other replicas may still have it
+                    continue
                 alt = PlacedSplit(split.owner, vnode_id, split.table,
                                   split.time_ranges, split.tag_domains)
-                return self._scan_local(alt, field_names)
+                b = self._scan_local(alt, field_names)
+                if vnode_id in split.broken_ids:
+                    self._clear_vnode_broken(vnode_id)
+                return b
             try:
                 r = self._rpc(node_id, "scan_vnode", {
                     "owner": split.owner, "vnode_id": vnode_id,
@@ -501,10 +508,16 @@ class Coordinator:
         try:
             if data is not None:
                 self._install_vnode_snapshot(owner, new_id, to_node, data)
+            # the RUNNING flip is part of the same all-or-nothing publish:
+            # a replica stranded in COPYING would hold storage but never
+            # serve reads
+            self.meta.update_vnode(new_id, status=int(VnodeStatus.RUNNING))
         except Exception:
-            self.meta.remove_replica_vnode(new_id)
+            try:
+                self.meta.remove_replica_vnode(new_id)
+            except Exception:
+                pass  # meta unreachable: placeholder stays; retryable
             raise
-        self.meta.update_vnode(new_id, status=int(VnodeStatus.RUNNING))
         return new_id
 
     def drop_replica(self, vnode_id: int):

@@ -1683,7 +1683,7 @@ def _merge_distinct(acc: dict, batch, plan: AggregatePlan, spec: AggSpec):
         tagmaps.append(tuple(tags.get(t) for t in plan.group_tags))
     mask = np.ones(batch.n_rows, dtype=bool)
     if plan.filter is not None:
-        env = _filter_env(batch)
+        env = _filter_env(batch, needed=plan.filter.columns())
         missing = [c for c in plan.filter.columns() if c not in env]
         for c in missing:
             env[c] = np.zeros(batch.n_rows)
