@@ -81,6 +81,18 @@ class ServiceConfig:
 
 
 @dataclass
+class SecurityConfig:
+    """TLS for the user HTTP API (reference config [security] tls_config)."""
+
+    tls_cert_path: str = ""
+    tls_key_path: str = ""
+
+    @property
+    def enabled(self) -> bool:
+        return bool(self.tls_cert_path and self.tls_key_path)
+
+
+@dataclass
 class ClusterConfig:
     raft_logs_to_keep: int = 5000
     snapshot_holding_time_s: int = 3600
@@ -98,12 +110,13 @@ class Config:
     cache: CacheConfig = field(default_factory=CacheConfig)
     log: LogConfig = field(default_factory=LogConfig)
     service: ServiceConfig = field(default_factory=ServiceConfig)
+    security: SecurityConfig = field(default_factory=SecurityConfig)
     cluster: ClusterConfig = field(default_factory=ClusterConfig)
 
     _SECTIONS = {
         "global": "global_", "deployment": "deployment", "query": "query",
         "storage": "storage", "wal": "wal", "cache": "cache", "log": "log",
-        "service": "service", "cluster": "cluster",
+        "service": "service", "security": "security", "cluster": "cluster",
     }
 
     @classmethod

@@ -209,10 +209,12 @@ def launch_scan_aggregate(batch: ScanBatch, query: TpuQuery):
             seg_dtype = np.int64 if cpu_mode else np.int32
             seg_ids = (group_of_row.astype(np.int64) * n_buckets
                        + bucket_ids.astype(np.int64)).astype(seg_dtype)
-            # small LRU with eviction (4 shapes ≈ 4×8B/row pinned):
-            # NOTE this derived-cache memory rides the batch outside the
-            # MemoryPool's admission accounting — bounded here instead
-            while len(seg_cache) >= 4:
+            # small LRU with eviction. NOTE this derived-cache memory rides
+            # the batch outside the MemoryPool's admission accounting, so
+            # the bound is deliberately tight: ≤2 shapes ≈ 2×8B/row plus
+            # rank/order (first/last) ≈ 8B/row — ~24B/row worst case on a
+            # scan-cache-resident batch
+            while len(seg_cache) >= 2:
                 seg_cache.pop(next(iter(seg_cache)))
             seg_cache[seg_key] = [seg_ids, bucket_starts, n_buckets, None]
         num_segments = n_groups * n_buckets

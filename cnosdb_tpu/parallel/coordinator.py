@@ -557,6 +557,29 @@ class Coordinator:
             self._rpc(v.node_id, "vnode_compact",
                       {"owner": owner, "vnode_id": vnode_id})
 
+    def checksum_group(self, rs_id: int) -> list[tuple[int, int, str]]:
+        """Per-replica content checksums for one replica set (reference
+        compaction/check.rs ChecksumGroup): replicas must agree regardless
+        of their physical flush/compaction state."""
+        hit = self.meta.find_replica_set(rs_id)
+        if hit is None:
+            raise CoordinatorError(f"unknown replica set {rs_id}")
+        owner, rs = hit
+        out = []
+        for v in rs.vnodes:
+            if v.node_id == self.node_id or not self.distributed:
+                vn = self.engine.vnode(owner, v.id)
+                cs = vn.checksum() if vn is not None else ""
+            else:
+                try:
+                    cs = self._rpc(v.node_id, "vnode_checksum",
+                                   {"owner": owner, "vnode_id": v.id}) \
+                        .get("checksum", "")
+                except Exception:
+                    cs = "<unreachable>"
+            out.append((v.id, v.node_id, cs))
+        return out
+
     def copy_vnode_to_set(self, rs_id: int, to_node: int) -> int:
         """REPLICA ADD ON <rs> NODE <n>: seed a new replica from the set's
         current leader vnode."""

@@ -64,8 +64,12 @@ class RpcServer:
                 if fn is None:
                     self._reply(404, pack({"_err": "NoSuchMethod", "_msg": method}))
                     return
+                from ..server.trace import GLOBAL_COLLECTOR
+
                 try:
-                    reply = fn(unpack(body) if body else {})
+                    with GLOBAL_COLLECTOR.from_headers(
+                            self.headers, f"rpc:{method}"):
+                        reply = fn(unpack(body) if body else {})
                     self._reply(200, pack(reply))
                 except Exception as e:  # propagate to caller, keep serving
                     self._reply(500, pack({"_err": type(e).__name__,
@@ -142,14 +146,19 @@ def rpc_call(addr: str, method: str, payload: dict | None = None,
     retried — the server may have fully applied a non-idempotent mutation
     whose reply was lost, and re-executing it would double-apply."""
     body = pack(payload or {})
+    from ..server.trace import TRACE_HEADER, current_trace_header
+
+    hdrs = {"Content-Type": "application/msgpack"}
+    tid = current_trace_header()
+    if tid:
+        hdrs[TRACE_HEADER] = tid
     for attempt in range(_ConnPool.MAX_IDLE_PER_ADDR + 1):
         conn, reused = _pool.get(addr, timeout)
         conn.timeout = timeout
         if conn.sock is not None:
             conn.sock.settimeout(timeout)
         try:
-            conn.request("POST", f"/rpc/{method}", body,
-                         {"Content-Type": "application/msgpack"})
+            conn.request("POST", f"/rpc/{method}", body, hdrs)
         except (ConnectionError, http.client.HTTPException, OSError,
                 TimeoutError) as e:
             # send-phase failure: retry ONLY the stale-keep-alive case

@@ -44,6 +44,7 @@ class DataNodeService:
             "vnode_install": self._vnode_install,
             "vnode_drop": self._vnode_drop,
             "vnode_compact": self._vnode_compact,
+            "vnode_checksum": self._vnode_checksum,
         })
         self.addr = self.server.addr
 
@@ -144,3 +145,7 @@ class DataNodeService:
         if v is not None:
             v.compact()
         return {"ok": True}
+
+    def _vnode_checksum(self, p):
+        v = self.coord.engine.vnode(p["owner"], p["vnode_id"])
+        return {"checksum": v.checksum() if v is not None else ""}

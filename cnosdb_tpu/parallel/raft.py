@@ -228,12 +228,22 @@ class Transport:
 
 
 class InProcessTransport(Transport):
-    """Single-process cluster wiring (tests + single-host replica sets)."""
+    """Single-process cluster wiring (tests + single-host replica sets).
+
+    Fault injection beyond partitions (the faults real gRPC links show and
+    the reference exercises only by killing processes): `loss_rate` drops
+    messages, `max_delay_s` adds random latency, `reorder_rate` delays a
+    message past its successors (message-level reordering). Raft must stay
+    safe under all of them — tests drive the knobs."""
 
     def __init__(self):
         self.nodes: dict[tuple[str, int], "RaftNode"] = {}
         self.partitions: set[frozenset] = set()
         self.lock = threading.Lock()
+        self.loss_rate = 0.0
+        self.max_delay_s = 0.0
+        self.reorder_rate = 0.0
+        self._rng = random.Random(1234)
 
     def register(self, node: "RaftNode"):
         self.nodes[(node.group_id, node.node_id)] = node
@@ -246,10 +256,29 @@ class InProcessTransport(Transport):
         with self.lock:
             self.partitions.clear()
 
+    def chaos(self, loss: float = 0.0, delay_s: float = 0.0,
+              reorder: float = 0.0):
+        with self.lock:
+            self.loss_rate = loss
+            self.max_delay_s = delay_s
+            self.reorder_rate = reorder
+
     def send(self, group_id, to, msg):
         with self.lock:
             if frozenset((msg["from"], to)) in self.partitions:
                 return None
+            loss, delay, reorder = (self.loss_rate, self.max_delay_s,
+                                    self.reorder_rate)
+            if loss and self._rng.random() < loss:
+                return None
+            sleep_s = 0.0
+            if delay:
+                sleep_s = self._rng.random() * delay
+            if reorder and self._rng.random() < reorder:
+                # hold this message past later ones (same-link reordering)
+                sleep_s += delay if delay else 0.01
+        if sleep_s:
+            time.sleep(sleep_s)
         node = self.nodes.get((group_id, to))
         if node is None or not node.alive:
             return None

@@ -238,14 +238,17 @@ class ReplicaGroupManager:
 
     # ------------------------------------------------------------ writes
     def write(self, owner: str, rs: ReplicationSet, entry_type: int,
-              data: bytes, retries: int = 20, sync: bool = False) -> int:
+              data: bytes, timeout: float = 10.0, sync: bool = False) -> int:
         """Propose on the current leader, retrying across leader changes
-        (reference TskvLeaderExecutor)."""
+        (reference TskvLeaderExecutor). Deadline-based: a cold-start
+        election on a loaded host can take seconds; giving up early turns
+        a transient into a write failure."""
         import time
 
         nodes = self.get_or_build(owner, rs)
         last_err: Exception | None = None
-        for _ in range(retries):
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
             leader = next((n for n in nodes.values() if n.is_leader()), None)
             if leader is None:
                 time.sleep(0.05)

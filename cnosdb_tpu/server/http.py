@@ -11,6 +11,7 @@ from __future__ import annotations
 import asyncio
 import base64
 import json
+import sys
 
 import numpy as np
 from aiohttp import web
@@ -48,6 +49,9 @@ class HttpServer:
             web.post("/api/v1/es/_bulk", self.handle_es_bulk),
             web.get("/metrics", self.handle_metrics),
             web.get("/debug/health", self.handle_ping),
+            web.get("/debug/traces", self.handle_traces),
+            web.get("/debug/backtrace", self.handle_backtrace),
+            web.get("/debug/pprof", self.handle_pprof),
         ])
 
     # ------------------------------------------------------------- helpers
@@ -131,22 +135,112 @@ class HttpServer:
         if not sql:
             return _err_response(400, QueryError("empty sql"))
         accept = request.headers.get("Accept", "application/csv")
+        from .trace import GLOBAL_COLLECTOR
+
+        span = GLOBAL_COLLECTOR.from_headers(request.headers, "http:sql")
+        span.set_tag("sql", sql[:200]).set_tag("tenant", session.tenant)
+
+        def run():
+            with span:
+                return self.executor.execute_sql(sql, session)
+
         try:
             self.limiters.check_query(session.tenant)
             loop = asyncio.get_running_loop()
-            results = await loop.run_in_executor(
-                None, lambda: self.executor.execute_sql(sql, session))
+            results = await loop.run_in_executor(None, run)
         except CnosError as e:
             self.metrics.incr("http_sql_errors")
             return _err_response(_status_for(e), e)
         self.metrics.incr("http_queries")
         rs = results[-1] if results else ResultSet.empty()
         if "json" in accept:
-            return web.Response(text=format_json(rs),
+            resp = web.Response(text=format_json(rs),
                                 content_type="application/json")
-        if "table" in accept:
-            return web.Response(text=format_table(rs), content_type="text/plain")
-        return web.Response(text=format_csv(rs), content_type="text/csv")
+        elif "table" in accept:
+            resp = web.Response(text=format_table(rs),
+                                content_type="text/plain")
+        else:
+            resp = web.Response(text=format_csv(rs), content_type="text/csv")
+        # gzip negotiation (reference http_service gzip layer)
+        if "gzip" in request.headers.get("Accept-Encoding", ""):
+            resp.enable_compression()
+        return resp
+
+    def _require_admin(self, request):
+        """Debug surfaces expose cross-tenant internals (query text, stack
+        frames): admin-only when auth is on."""
+        if not self.auth_enabled:
+            return
+        user, _tenant = self._auth(request)
+        u = self.meta.users.get(user)
+        if u is None or not u.get("admin"):
+            raise web.HTTPForbidden(text="debug endpoints are admin-only")
+
+    @staticmethod
+    def _query_number(request, name, default, lo, hi):
+        try:
+            v = float(request.query.get(name, default))
+        except ValueError:
+            raise web.HTTPBadRequest(text=f"bad {name!r} parameter")
+        return min(max(v, lo), hi)
+
+    async def handle_traces(self, request):
+        """Collected spans (reference stores traces queryably via its
+        jaeger-query API; embedded form returns them directly)."""
+        self._require_admin(request)
+        from .trace import GLOBAL_COLLECTOR
+
+        tid = request.query.get("trace_id")
+        limit = int(self._query_number(request, "limit", 500, 1, 10_000))
+        return web.json_response(GLOBAL_COLLECTOR.spans(tid, limit))
+
+    async def handle_backtrace(self, request):
+        """Live thread stacks (reference /debug/backtrace,
+        http_service.rs:332)."""
+        self._require_admin(request)
+        import traceback
+
+        frames = sys._current_frames()
+        out = []
+        import threading as _th
+
+        names = {t.ident: t.name for t in _th.enumerate()}
+        for tid, frame in frames.items():
+            out.append(f"--- thread {tid} ({names.get(tid, '?')}):\n"
+                       + "".join(traceback.format_stack(frame)))
+        return web.Response(text="\n".join(out), content_type="text/plain")
+
+    _pprof_lock = asyncio.Lock()
+
+    async def handle_pprof(self, request):
+        """Whole-process sampling CPU profile for ?seconds=N (reference
+        /debug/pprof flamegraph, http_service.rs:1045). A sampler over
+        sys._current_frames() sees EVERY thread — executor query threads
+        and RPC handlers included — unlike cProfile, which instruments
+        only the calling thread."""
+        self._require_admin(request)
+        import traceback
+
+        seconds = self._query_number(request, "seconds", 2, 0.1, 30.0)
+        if self._pprof_lock.locked():
+            raise web.HTTPConflict(text="a profile is already running")
+        async with self._pprof_lock:
+            counts: dict[str, int] = {}
+            deadline = asyncio.get_running_loop().time() + seconds
+            n_samples = 0
+            while asyncio.get_running_loop().time() < deadline:
+                for tid, frame in sys._current_frames().items():
+                    stack = traceback.extract_stack(frame, limit=12)
+                    key = ";".join(f"{f.name}@{f.filename.rsplit('/', 1)[-1]}"
+                                   f":{f.lineno}" for f in stack[-6:])
+                    counts[key] = counts.get(key, 0) + 1
+                n_samples += 1
+                await asyncio.sleep(0.01)
+        lines = [f"# {n_samples} samples over {seconds}s "
+                 f"(collapsed stacks, hottest first)"]
+        for key, c in sorted(counts.items(), key=lambda kv: -kv[1])[:80]:
+            lines.append(f"{c:6d}  {key}")
+        return web.Response(text="\n".join(lines), content_type="text/plain")
 
     async def handle_opentsdb_write(self, request):
         """OpenTSDB telnet-style put lines over HTTP (reference
@@ -339,12 +433,47 @@ class HttpServer:
                             content_type="text/plain")
 
     # ------------------------------------------------------------- lifecycle
-    async def start(self, host: str = "0.0.0.0", port: int = 8902):
+    async def start(self, host: str = "0.0.0.0", port: int = 8902,
+                    ssl_context=None):
         runner = web.AppRunner(self.app)
         await runner.setup()
-        site = web.TCPSite(runner, host, port)
+        site = web.TCPSite(runner, host, port, ssl_context=ssl_context)
         await site.start()
         return runner
+
+    async def start_tcp_opentsdb(self, host: str = "0.0.0.0",
+                                 port: int = 8905):
+        """OpenTSDB telnet `put` listener (reference main/src/tcp/
+        tcp_service.rs:36-106): newline-delimited put lines per
+        connection, written through the normal coordinator path."""
+        from ..protocol.opentsdb import parse_opentsdb
+
+        async def on_conn(reader, writer):
+            loop = asyncio.get_running_loop()
+            try:
+                while True:
+                    line = await reader.readline()
+                    if not line:
+                        break
+                    text = line.decode(errors="replace").strip()
+                    if not text:
+                        continue
+                    if text.lower() == "quit":
+                        break
+                    try:
+                        batch = parse_opentsdb(text)
+                        await loop.run_in_executor(
+                            None, lambda b=batch: self.coord.write_points(
+                                DEFAULT_TENANT, "public", b))
+                        self.metrics.incr("tcp_opentsdb_points",
+                                          batch.n_rows())
+                    except CnosError as e:
+                        writer.write(f"error: {e}\n".encode())
+                        await writer.drain()
+            finally:
+                writer.close()
+
+        return await asyncio.start_server(on_conn, host, port)
 
 
 # ---------------------------------------------------------------------------
@@ -516,8 +645,27 @@ def run_server(args) -> int:
                 except Exception:
                     pass
 
+    ssl_context = None
+    if cfg.security.enabled:
+        import ssl as _ssl
+
+        ssl_context = _ssl.SSLContext(_ssl.PROTOCOL_TLS_SERVER)
+        ssl_context.load_cert_chain(cfg.security.tls_cert_path,
+                                    cfg.security.tls_key_path)
+
     async def main():
-        await server.start(port=args.http_port)
+        await server.start(port=args.http_port, ssl_context=ssl_context)
+        if cfg.query.auth_enabled:
+            # the telnet put protocol carries no credentials; exposing it
+            # on an authenticated server would bypass RBAC entirely
+            print("opentsdb tcp disabled: auth_enabled (telnet has no auth)")
+        else:
+            try:
+                main._tcp = await server.start_tcp_opentsdb(
+                    port=cfg.service.tcp_listen_port)
+                print(f"opentsdb tcp on :{cfg.service.tcp_listen_port}")
+            except Exception as e:
+                print(f"opentsdb tcp disabled: {e}")
         try:
             from .flight import start_flight_server
 

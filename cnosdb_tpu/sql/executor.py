@@ -793,6 +793,13 @@ class QueryExecutor:
         if stmt.op == "replica_promote":
             self.meta.promote_replica(stmt.vnode_id)
             return ResultSet.message("ok")
+        if stmt.op == "checksum":
+            rows = self.coord.checksum_group(stmt.replica_set_id)
+            return ResultSet(
+                ["vnode_id", "node_id", "checksum"],
+                [np.array([r[0] for r in rows], dtype=np.int64),
+                 np.array([r[1] for r in rows], dtype=np.int64),
+                 np.array([r[2] for r in rows], dtype=object)])
         raise ExecutionError(f"unsupported vnode admin {stmt.op}")
 
     def _copy(self, stmt: ast.CopyStmt, session: Session):

@@ -244,6 +244,12 @@ class Parser:
                                       vnode_id=int(self.expect_number()))
             self.expect_kw("DATABASE")
             return ast.CompactStmt(self.expect_ident())
+        if k == "CHECKSUM":
+            # CHECKSUM GROUP <rs_id> (reference check.rs ChecksumGroup)
+            self.next()
+            self.expect_kw("GROUP")
+            return ast.VnodeAdmin("checksum",
+                                  replica_set_id=int(self.expect_number()))
         if k == "FLUSH":
             self.next()
             db = None

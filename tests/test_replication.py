@@ -106,3 +106,42 @@ def test_replicated_vnode_recovers_from_wal(tmp_path):
     batches = coord2.scan_table(DEFAULT_TENANT, "rdb", "cpu")
     assert sum(b.n_rows for b in batches) == 2
     engine2.close()
+
+
+def test_replica_checksums_agree(tmp_path):
+    """All replicas of a raft group converge to one content checksum even
+    with different flush states (reference ChecksumGroup check.rs:99)."""
+    import time
+
+    import numpy as np
+
+    from cnosdb_tpu.parallel.coordinator import Coordinator
+    from cnosdb_tpu.parallel.meta import MetaStore
+    from cnosdb_tpu.sql.executor import QueryExecutor, Session
+    from cnosdb_tpu.storage.engine import TsKv
+
+    meta = MetaStore(str(tmp_path / "meta.json"))
+    engine = TsKv(str(tmp_path / "data"))
+    coord = Coordinator(meta, engine)
+    ex = QueryExecutor(meta, coord)
+    s = Session()
+    ex.execute_one("CREATE DATABASE rdb WITH SHARD 1 REPLICA 3", s)
+    s2 = Session(database="rdb")
+    ex.execute_one("CREATE TABLE m (v DOUBLE, TAGS(h))", s2)
+    vals = ", ".join(f"({i}, 'h{i % 4}', {i}.5)" for i in range(200))
+    ex.execute_one(f"INSERT INTO m (time, h, v) VALUES {vals}", s2)
+    # flush ONE replica only: physical divergence, logical equality
+    rs_id = meta.buckets["cnosdb.rdb"][0].shard_group[0].id
+    first_vnode = meta.buckets["cnosdb.rdb"][0].shard_group[0].vnodes[0]
+    engine.vnode("cnosdb.rdb", first_vnode.id).flush()
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        rows = coord.checksum_group(rs_id)
+        sums = {r[2] for r in rows}
+        if len(sums) == 1 and "" not in sums:
+            break
+        time.sleep(0.1)
+    assert len(sums) == 1 and "" not in sums, rows
+    rs = ex.execute_one(f"CHECKSUM GROUP {rs_id}", s)
+    assert len(set(rs.columns[2].tolist())) == 1
+    coord.close()
