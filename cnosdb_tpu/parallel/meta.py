@@ -519,6 +519,15 @@ class MetaStore:
                     out.append(n)
             return out
 
+    def placement_candidates(self) -> list[int]:
+        """Node ids eligible for new vnode placement: alive ones, falling
+        back to all REGISTERED nodes when heartbeats are transiently stale
+        (a persisted bucket must never land on a phantom id). The single
+        authority — both the in-process path and the replicated-meta
+        leader's proposal pinning use it."""
+        cand = sorted(n.id for n in self.alive_nodes())
+        return cand or sorted(self.nodes)
+
     # ------------------------------------------------------------ vnode admin
     def find_vnode(self, vnode_id: int):
         """→ (owner, bucket, rs, vnode) or None."""
@@ -660,9 +669,13 @@ class MetaStore:
                 self._persist()
 
     # ------------------------------------------------------------ placement
-    def locate_bucket_for_write(self, tenant: str, db: str, ts: int) -> BucketInfo:
+    def locate_bucket_for_write(self, tenant: str, db: str, ts: int,
+                                nodes: list[int] | None = None) -> BucketInfo:
         """Find-or-create the bucket covering ts (reference
-        meta_tenant.rs:716)."""
+        meta_tenant.rs:716). `nodes` pins the placement candidates — the
+        replicated meta leader computes them BEFORE proposing so apply is
+        deterministic on every member (liveness is runtime state and may
+        differ across replicas)."""
         with self.lock:
             owner = f"{tenant}.{db}"
             schema = self.database(tenant, db)
@@ -678,9 +691,7 @@ class MetaStore:
             # all REGISTERED nodes rather than placing on a phantom id when
             # heartbeats are transiently stale — a bucket is persisted, so a
             # bad placement would poison its time range permanently
-            cand = sorted(n.id for n in self.alive_nodes())
-            if not cand:
-                cand = sorted(self.nodes)
+            cand = sorted(nodes) if nodes else self.placement_candidates()
             if not cand:
                 raise MetaError("no data nodes registered; cannot place bucket")
             rr = bucket.id  # deterministic stagger across buckets

@@ -69,12 +69,82 @@ def _rehydrate(wrapped):
     return v
 
 
+class MetaStateMachine:
+    """ApplyStorage over a MetaStore — the replicated-meta analog of the
+    reference's heed state machine (meta/src/store/storage.rs:63
+    ApplyStorage::apply → process_write_command). Commands are
+    (method, kwargs) msgpack; apply returns live through a per-index
+    result slot so the proposing leader can answer the client."""
+
+    def __init__(self, store: MetaStore):
+        self.store = store
+        self._results: dict[str, object] = {}   # req_id → outcome
+        self._seen: dict[str, None] = {}        # bounded FIFO of req ids
+
+    def apply(self, entry):
+        import msgpack as _mp
+
+        method, kwargs, req_id = _mp.unpackb(entry.data, raw=False)
+        if req_id in self._seen:
+            # retried proposal whose first copy DID commit (propose timeout
+            # or leadership change): applying twice would double-mutate
+            return
+        self._seen[req_id] = None
+        if len(self._seen) > 1024:
+            for k in list(self._seen)[:512]:
+                del self._seen[k]
+        for name, fix in _ARG_HYDRATORS.get(method, {}).items():
+            if name in kwargs:
+                kwargs[name] = fix(kwargs[name])
+        try:
+            result = getattr(self.store, method)(**kwargs)
+            self._results[req_id] = ("ok", result)
+        except Exception as e:  # deterministic failures replicate as no-ops
+            self._results[req_id] = ("err", e)
+        if len(self._results) > 256:
+            for k in list(self._results)[:128]:
+                del self._results[k]
+
+    def take_result(self, req_id: str):
+        return self._results.pop(req_id, ("ok", None))
+
+    def snapshot(self) -> bytes:
+        import msgpack as _mp
+
+        with self.store.lock:
+            return _mp.packb({"state": self.store._to_dict(),
+                              "version": self.store.version},
+                             use_bin_type=True)
+
+    def install_snapshot(self, data: bytes, last_index: int, last_term: int):
+        import msgpack as _mp
+
+        obj = _mp.unpackb(data, raw=False, strict_map_key=False)
+        with self.store.lock:
+            self.store._from_dict(obj["state"])
+            self.store.version = max(self.store.version, obj["version"])
+            self.store._persist()
+        self.store._notify("restore")
+
+
 class MetaService:
-    """Hosts the authoritative MetaStore over RPC."""
+    """Hosts the authoritative MetaStore over RPC — standalone, or as one
+    member of a replicated meta raft group (reference: the meta crate runs
+    a single-group openraft cluster; `cnosdb-meta` binary).
+
+    With `peers` = {node_id: "host:port"} and `node_id` set, mutations go
+    through raft: the leader proposes (method, kwargs) entries, every
+    member applies them to its own MetaStore, and non-leader members proxy
+    client writes to the current leader."""
 
     def __init__(self, store: MetaStore, host: str = "127.0.0.1",
-                 port: int = 0):
+                 port: int = 0, node_id: int | None = None,
+                 peers: dict[int, str] | None = None,
+                 raft_dir: str | None = None):
         self.store = store
+        self.node_id = node_id
+        self.peers = dict(peers or {})
+        self.raft: object | None = None
         self.server = RpcServer(host, port, {
             "ping": lambda p: {"ok": True, "version": store.version},
             "meta_read": self._read,
@@ -83,15 +153,55 @@ class MetaService:
             "meta_beat": self._beat,
             "meta_dump": lambda p: {"snapshot": self.store._to_dict()},
             "meta_restore": self._restore,
+            "raft_msg": self._raft_msg,   # HttpTransport peer messages
+            "meta_status": self._status,
         })
         self.addr = self.server.addr
+        if node_id is not None and len(self.peers) > 1:
+            self._build_raft(raft_dir)
+
+    def _build_raft(self, raft_dir: str | None):
+        import os as _os
+
+        from ..storage.wal import Wal
+        from .raft import HttpTransport, MemoryLogStore, RaftNode, WalLogStore
+
+        def resolver(_gid, peer_id):
+            return self.peers.get(peer_id)
+
+        if raft_dir:
+            _os.makedirs(raft_dir, exist_ok=True)
+            log = WalLogStore(Wal(_os.path.join(raft_dir, "wal")),
+                              _os.path.join(raft_dir, "hardstate"))
+        else:
+            log = MemoryLogStore()
+        self.sm = MetaStateMachine(self.store)
+        self.raft = RaftNode("meta", self.node_id, sorted(self.peers),
+                             log, self.sm, HttpTransport(resolver),
+                             election_timeout=(0.3, 0.6),
+                             heartbeat_interval=0.1)
 
     def start(self):
         self.server.start()
         return self
 
     def stop(self):
+        if self.raft is not None:
+            self.raft.stop()
         self.server.stop()
+
+    def _raft_msg(self, p):
+        if self.raft is None:
+            return {"reply": None}
+        return {"reply": self.raft.handle_message(p["msg"])}
+
+    def _status(self, p):
+        out = {"node_id": self.node_id, "version": self.store.version,
+               "raft": self.raft is not None}
+        if self.raft is not None:
+            out.update(self.raft.metrics())
+        return out
+
 
     def _read(self, p):
         with self.store.lock:
@@ -102,12 +212,17 @@ class MetaService:
         method = p["method"]
         if method not in MUTATIONS:
             raise MetaError(f"not a meta mutation: {method}")
+        if self.raft is not None:
+            return self._write_raft(p, method)
+        before = self.store.version
         kwargs = dict(p.get("kwargs") or {})
         for name, fix in _ARG_HYDRATORS.get(method, {}).items():
             if name in kwargs:
                 kwargs[name] = fix(kwargs[name])
-        before = self.store.version
         result = getattr(self.store, method)(**kwargs)
+        return self._write_reply(before, result)
+
+    def _write_reply(self, before: int, result):
         with self.store.lock:
             out = {"version": self.store.version,
                    "events": [[v, e, kw] for v, e, kw in
@@ -118,10 +233,78 @@ class MetaService:
                 out["snapshot"] = self.store._to_dict()
             return out
 
+    def _write_raft(self, p, method: str):
+        """Propose the mutation through the meta raft group; non-leaders
+        proxy the whole request ONCE to the current leader (reference
+        MetaHttpClient retries on the leader, meta/src/client.rs).
+        Retried proposals carry one request id so the state machine
+        dedups copies whose earlier append did commit."""
+        import secrets as _secrets
+
+        import msgpack as _mp
+
+        from ..errors import ReplicationError
+        from .raft import NotLeader
+
+        req_id = p.get("_req_id") or _secrets.token_hex(8)
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline:
+            if self.raft.is_leader():
+                before = self.store.version
+                kwargs = dict(p.get("kwargs") or {})
+                if method == "locate_bucket_for_write" \
+                        and not kwargs.get("nodes"):
+                    # pin placement candidates at PROPOSAL time: apply must
+                    # be deterministic across members, liveness is not
+                    kwargs["nodes"] = self.store.placement_candidates()
+                try:
+                    self.raft.propose(
+                        1, _mp.packb([method, kwargs, req_id],
+                                     use_bin_type=True))
+                except (NotLeader, ReplicationError):
+                    time.sleep(0.1)
+                    continue
+                status, result = self.sm.take_result(req_id)
+                if status == "err":
+                    raise result
+                return self._write_reply(before, result)
+            # proxy by member id, never by address-string comparison (a
+            # stepped-down leader's stale leader_id may still be itself,
+            # and configured peer strings need not match the bound addr)
+            lid = self.raft.leader_id
+            if lid is not None and lid != self.node_id \
+                    and not p.get("_proxied"):
+                addr = self.peers.get(lid)
+                if addr:
+                    try:
+                        return rpc_call(addr, "meta_write",
+                                        {**p, "_proxied": True,
+                                         "_req_id": req_id}, timeout=10.0)
+                    except Exception:
+                        pass  # leader moved again: re-evaluate
+            time.sleep(0.1)
+        raise MetaError("meta raft group has no leader")
+
     def _beat(self, p):
         """Liveness beat — deliberately NOT a meta_write: no version bump,
-        no snapshot serialization on the hot 3s path."""
+        no snapshot serialization on the hot 3s path. In a replicated meta
+        group, beats forward to the LEADER (it makes placement decisions);
+        liveness stays runtime-local, never raft state."""
+        # ALWAYS record locally first: if this member is later elected it
+        # must not start with an empty liveness view (bucket placement
+        # would fall back to all registered nodes, dead ones included)
         self.store.report_heartbeat(int(p["node_id"]))
+        if self.raft is not None and not self.raft.is_leader() \
+                and not p.get("_fwd"):
+            lid = self.raft.leader_id
+            addr = self.peers.get(lid) if lid not in (None, self.node_id) \
+                else None
+            if addr:
+                try:
+                    rpc_call(addr, "meta_beat", {**p, "_fwd": True},
+                             timeout=5.0)
+                except Exception:
+                    pass  # beat is best-effort
         return {"ok": True}
 
     def _watch(self, p):

@@ -478,11 +478,17 @@ class RaftNode:
     # ------------------------------------------------------------ client API
     def propose(self, entry_type: int, data: bytes,
                 timeout: float = 5.0) -> int:
-        """Append via the leader; blocks until applied. → log index."""
+        """Append via the leader; blocks until applied. → log index.
+
+        Verifies the applied entry at idx still carries OUR term: after a
+        leadership change the slot can hold a different leader's entry
+        (ours truncated away) — reporting that as success would tell the
+        caller a lost write committed."""
         with self.lock:
             if self.role != Role.LEADER:
                 raise NotLeader(self.leader_id)
             idx = self._append_local(entry_type, data)
+            term = self.term
         self._broadcast_append()
         deadline = time.monotonic() + timeout
         with self._apply_cv:
@@ -491,6 +497,10 @@ class RaftNode:
                 if remaining <= 0:
                     raise ReplicationError("propose timeout", index=idx)
                 self._apply_cv.wait(remaining)
+        e = self.log.entry_at(idx)
+        if e is None or e.term != term:
+            raise ReplicationError(
+                "entry superseded after leadership change", index=idx)
         return idx
 
     def _append_local(self, entry_type: int, data: bytes) -> int:

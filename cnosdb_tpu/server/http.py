@@ -690,7 +690,8 @@ def run_server(args) -> int:
 
 def run_meta_server(args) -> int:
     """Standalone meta service process (reference cnosdb-meta binary,
-    meta/src/bin/main.rs + service/http.rs)."""
+    meta/src/bin/main.rs + service/http.rs). With --meta-peers it joins a
+    replicated meta raft group."""
     import os
     import time as _time
 
@@ -698,10 +699,21 @@ def run_meta_server(args) -> int:
 
     store = MetaStore(os.path.join(args.data_dir, "meta", "meta.json"),
                       register_self=False)
-    svc = MetaService(store, port=getattr(args, "meta_port", 8901) or 8901)
+    peers = {}
+    for spec in (getattr(args, "meta_peers", None) or "").split(","):
+        if "@" in spec:
+            nid, _, addr = spec.partition("@")
+            peers[int(nid)] = addr
+    svc = MetaService(store, host="0.0.0.0",
+                      port=getattr(args, "meta_port", 8901) or 8901,
+                      node_id=getattr(args, "node_id", None) if peers else None,
+                      peers=peers or None,
+                      raft_dir=os.path.join(args.data_dir, "meta", "raft"))
     svc.start()
     print(f"cnosdb-tpu meta listening on {svc.addr} "
-          f"(data dir {args.data_dir})")
+          f"(data dir {args.data_dir}"
+          + (f", raft member {args.node_id} of {sorted(peers)}" if peers
+             else "") + ")")
     try:
         while True:
             _time.sleep(3600)

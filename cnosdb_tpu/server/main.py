@@ -26,6 +26,9 @@ def build_arg_parser() -> argparse.ArgumentParser:
                      help="node-to-node RPC port (0 = ephemeral)")
     run.add_argument("--meta-port", type=int, default=8901,
                      help="meta service port (mode=meta)")
+    run.add_argument("--meta-peers", default=None,
+                     help="replicated meta group members as "
+                          "'1@host:port,2@host:port,...' (mode=meta)")
     cfg = sub.add_parser("config", help="print default config")
     check = sub.add_parser("check", help="validate a config file")
     check.add_argument("path")

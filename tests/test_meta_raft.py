@@ -1,0 +1,93 @@
+"""Replicated meta: a 3-member meta raft group (reference: the meta crate
+runs its own single-group openraft cluster — meta/src/service/server.rs,
+store/storage.rs ApplyStorage)."""
+import time
+
+import pytest
+
+from cnosdb_tpu.models.schema import DatabaseOptions, DatabaseSchema
+from cnosdb_tpu.parallel.meta import MetaStore
+from cnosdb_tpu.parallel.meta_service import MetaClient, MetaService
+from cnosdb_tpu.parallel.net import rpc_call
+
+
+@pytest.fixture
+def meta_group(tmp_path):
+    import socket
+
+    def free():
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        p = s.getsockname()[1]
+        s.close()
+        return p
+
+    ports = {i: free() for i in (1, 2, 3)}
+    peers = {i: f"127.0.0.1:{p}" for i, p in ports.items()}
+    services = []
+    for i in (1, 2, 3):
+        store = MetaStore(str(tmp_path / f"m{i}.json"), register_self=False)
+        svc = MetaService(store, port=ports[i], node_id=i, peers=peers,
+                          raft_dir=str(tmp_path / f"raft{i}"))
+        services.append(svc.start())
+    # wait for a leader
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        if any(s.raft.is_leader() for s in services):
+            break
+        time.sleep(0.05)
+    assert any(s.raft.is_leader() for s in services), "no meta leader"
+    yield services
+    for s in services:
+        s.stop()
+
+
+def test_meta_raft_write_replicates(meta_group):
+    services = meta_group
+    follower = next(s for s in services if not s.raft.is_leader())
+    # write THROUGH A FOLLOWER: proxied to the leader, applied everywhere
+    c = MetaClient(follower.addr, node_id=50, watch=False)
+    c.register_node(50, grpc_addr="127.0.0.1:5")
+    c.create_user("ru", "pw")
+    c.create_database(DatabaseSchema("cnosdb", "rdb",
+                                     DatabaseOptions(shard_num=2)))
+    b = c.locate_bucket_for_write("cnosdb", "rdb", 10**18)
+    assert len(b.shard_group) == 2
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        if all("cnosdb.rdb" in s.store.databases
+               and s.store.buckets.get("cnosdb.rdb") for s in services):
+            break
+        time.sleep(0.05)
+    for s in services:
+        assert "cnosdb.rdb" in s.store.databases
+        bl = s.store.buckets["cnosdb.rdb"]
+        assert [x.id for rs in bl[0].shard_group for x in rs.vnodes] == \
+            [x.id for rs in b.shard_group for x in rs.vnodes]
+        assert s.store.check_user("ru", "pw") is not None
+
+
+def test_meta_raft_leader_failover(meta_group):
+    services = meta_group
+    leader = next(s for s in services if s.raft.is_leader())
+    survivors = [s for s in services if s is not leader]
+    c = MetaClient(survivors[0].addr, node_id=51, watch=False)
+    c.register_node(51, grpc_addr="127.0.0.1:6")
+    c.create_tenant("t1")
+    # kill the leader's raft member AND rpc server
+    leader.stop()
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        if any(s.raft.is_leader() for s in survivors):
+            break
+        time.sleep(0.05)
+    assert any(s.raft.is_leader() for s in survivors), "no re-election"
+    # writes keep working through the remaining members
+    c.create_tenant("t2")
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        if all("t2" in s.store.tenants for s in survivors):
+            break
+        time.sleep(0.05)
+    for s in survivors:
+        assert "t1" in s.store.tenants and "t2" in s.store.tenants
