@@ -90,6 +90,10 @@ class MetaStore:
         self.version = 0
         self.events: list[tuple[int, str, dict]] = []
         self._version_cv = threading.Condition(self.lock)
+        # raft apply watermark: persisted INSIDE meta.json (same atomic
+        # write as the mutation itself) so a restarted replicated-meta
+        # member never re-applies logged mutations its store already holds
+        self.applied_index = 0
         self._next_bucket_id = 1
         self._next_replica_id = 1
         self._next_vnode_id = 1
@@ -124,6 +128,7 @@ class MetaStore:
             "members": self.members,
             "roles": self.roles,
             "externals": self.externals,
+            "applied_index": self.applied_index,
             "next_ids": [self._next_bucket_id, self._next_replica_id, self._next_vnode_id],
         }
 
@@ -158,6 +163,7 @@ class MetaStore:
         self.members = d.get("members", {})
         self.roles = d.get("roles", {})
         self.externals = d.get("externals", {})
+        self.applied_index = d.get("applied_index", 0)
         self._next_bucket_id, self._next_replica_id, self._next_vnode_id = d["next_ids"]
 
     def _notify(self, event: str, **kw):

@@ -84,6 +84,12 @@ class MetaStateMachine:
     def apply(self, entry):
         import msgpack as _mp
 
+        if entry.index <= self.store.applied_index:
+            # restart replay: the store already persisted this mutation
+            # (applied_index rides inside the same atomic meta.json write)
+            return
+        with self.store.lock:
+            self.store.applied_index = entry.index
         method, kwargs, req_id = _mp.unpackb(entry.data, raw=False)
         if req_id in self._seen:
             # retried proposal whose first copy DID commit (propose timeout
@@ -106,7 +112,15 @@ class MetaStateMachine:
                 del self._results[k]
 
     def take_result(self, req_id: str):
-        return self._results.pop(req_id, ("ok", None))
+        """Missing slot = the result is unknowable (deduplicated retry or
+        eviction) — that must surface as an uncertain-outcome error, never
+        as a fabricated success."""
+        hit = self._results.pop(req_id, None)
+        if hit is None:
+            return ("err", MetaError(
+                "outcome unknown: the proposal was deduplicated or its "
+                "result slot expired — re-check state before retrying"))
+        return hit
 
     def snapshot(self) -> bytes:
         import msgpack as _mp
@@ -179,7 +193,8 @@ class MetaService:
         self.raft = RaftNode("meta", self.node_id, sorted(self.peers),
                              log, self.sm, HttpTransport(resolver),
                              election_timeout=(0.3, 0.6),
-                             heartbeat_interval=0.1)
+                             heartbeat_interval=0.1,
+                             initial_applied=self.store.applied_index)
 
     def start(self):
         self.server.start()
@@ -276,12 +291,19 @@ class MetaService:
                     and not p.get("_proxied"):
                 addr = self.peers.get(lid)
                 if addr:
+                    from .net import RpcUnavailable
+
                     try:
                         return rpc_call(addr, "meta_write",
                                         {**p, "_proxied": True,
                                          "_req_id": req_id}, timeout=10.0)
-                    except Exception:
-                        pass  # leader moved again: re-evaluate
+                    except RpcUnavailable:
+                        pass  # leader moved/unreachable: re-evaluate
+                    except RpcError as e:
+                        # leader-side APPLICATION error: unwrap to the
+                        # original class — swallowing it would turn a
+                        # failed DDL into a silent success
+                        _raise_remote(e)
             time.sleep(0.1)
         raise MetaError("meta raft group has no leader")
 

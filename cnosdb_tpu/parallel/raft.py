@@ -200,9 +200,14 @@ class WalLogStore(LogStore):
         self.wal.purge_to(index)  # listener prunes _entries
 
     def _on_purge(self, seq: int):
+        self._purge_floor = max(getattr(self, "_purge_floor", 0), seq)
         for i in list(self._entries):
             if i < seq:
                 del self._entries[i]
+
+    def purged_below(self, idx: int) -> bool:
+        """True if entries < _purge_floor were GC'd (applied+flushed)."""
+        return idx < getattr(self, "_purge_floor", 0)
 
     def save_hard_state(self, term, voted_for):
         import os
@@ -333,7 +338,7 @@ class RaftNode:
                  log: LogStore, sm: StateMachine, transport: Transport,
                  election_timeout: tuple[float, float] = (0.15, 0.3),
                  heartbeat_interval: float = 0.05,
-                 tick: bool = True):
+                 tick: bool = True, initial_applied: int = 0):
         self.group_id = group_id
         self.node_id = node_id
         self.peers = [p for p in peers if p != node_id]
@@ -346,8 +351,11 @@ class RaftNode:
         self.term, self.voted_for = log.load_hard_state()
         self.role = Role.FOLLOWER
         self.leader_id: int | None = None
-        self.commit_index = 0
-        self.last_applied = 0
+        # a state machine that persisted its apply watermark resumes there
+        # (replicated meta); 0 = replay the whole log (vnode SMs rebuild
+        # from their own WAL semantics)
+        self.commit_index = initial_applied
+        self.last_applied = initial_applied
         self.next_index: dict[int, int] = {}
         self.match_index: dict[int, int] = {}
         self.alive = True
@@ -497,8 +505,17 @@ class RaftNode:
                 if remaining <= 0:
                     raise ReplicationError("propose timeout", index=idx)
                 self._apply_cv.wait(remaining)
-        e = self.log.entry_at(idx)
-        if e is None or e.term != term:
+        with self.lock:
+            e = self.log.entry_at(idx)
+        if e is None:
+            # ambiguous absence: a post-apply WAL purge (flush GC'd the
+            # applied entry — success) vs truncation after leadership loss.
+            # purged_below() disambiguates where the store tracks purges.
+            if getattr(self.log, "purged_below", lambda i: False)(idx):
+                return idx
+            raise ReplicationError(
+                "entry superseded after leadership change", index=idx)
+        if e.term != term:
             raise ReplicationError(
                 "entry superseded after leadership change", index=idx)
         return idx

@@ -704,7 +704,10 @@ def run_meta_server(args) -> int:
         if "@" in spec:
             nid, _, addr = spec.partition("@")
             peers[int(nid)] = addr
-    svc = MetaService(store, host="0.0.0.0",
+    # loopback by default: the msgpack RPC surface carries no auth, so
+    # exposing it beyond the host is an explicit operator decision
+    svc = MetaService(store, host=getattr(args, "meta_host", None)
+                      or "127.0.0.1",
                       port=getattr(args, "meta_port", 8901) or 8901,
                       node_id=getattr(args, "node_id", None) if peers else None,
                       peers=peers or None,

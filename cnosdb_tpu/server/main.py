@@ -29,6 +29,9 @@ def build_arg_parser() -> argparse.ArgumentParser:
     run.add_argument("--meta-peers", default=None,
                      help="replicated meta group members as "
                           "'1@host:port,2@host:port,...' (mode=meta)")
+    run.add_argument("--meta-host", default="127.0.0.1",
+                     help="meta RPC bind host; set 0.0.0.0 for multi-host "
+                          "groups (the RPC plane is unauthenticated)")
     cfg = sub.add_parser("config", help="print default config")
     check = sub.add_parser("check", help="validate a config file")
     check.add_argument("path")

@@ -22,6 +22,9 @@ _tls = threading.local()
 
 
 def _lib_path() -> str:
+    override = os.environ.get("CNOSDB_NATIVE_LIB")
+    if override:
+        return override   # e.g. the ASAN build in tests
     return os.path.join(os.path.dirname(os.path.dirname(__file__)),
                         "_native", "libcnosdb_codecs.so")
 

@@ -91,3 +91,71 @@ def test_meta_raft_leader_failover(meta_group):
         time.sleep(0.05)
     for s in survivors:
         assert "t1" in s.store.tenants and "t2" in s.store.tenants
+
+
+def test_meta_member_restart_no_double_apply(tmp_path):
+    """Regression: a restarted member replays the raft log onto a store
+    that already persisted those mutations — the applied-index watermark
+    (inside meta.json's atomic write) must prevent double-application of
+    non-idempotent commands like add_replica_vnode."""
+    import socket
+
+    def free():
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        p = s.getsockname()[1]
+        s.close()
+        return p
+
+    ports = {i: free() for i in (1, 2)}
+    peers = {i: f"127.0.0.1:{p}" for i, p in ports.items()}
+
+    def boot(i):
+        store = MetaStore(str(tmp_path / f"m{i}.json"), register_self=False)
+        return MetaService(store, port=ports[i], node_id=i, peers=peers,
+                           raft_dir=str(tmp_path / f"raft{i}")).start()
+
+    services = {i: boot(i) for i in (1, 2)}
+    try:
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline and not any(
+                s.raft.is_leader() for s in services.values()):
+            time.sleep(0.05)
+        c = MetaClient(services[1].addr, node_id=60, watch=False)
+        c.register_node(60, grpc_addr="127.0.0.1:9")
+        c.create_database(DatabaseSchema("cnosdb", "rr",
+                                         DatabaseOptions(shard_num=1)))
+        b = c.locate_bucket_for_write("cnosdb", "rr", 1)
+        rs_id = b.shard_group[0].id
+        new_vid = c.add_replica_vnode(rs_id, 60)
+        def replica_counts():
+            out = {}
+            for i, s in services.items():
+                bl = s.store.buckets.get("cnosdb.rr")
+                out[i] = len(bl[0].shard_group[0].vnodes) if bl else 0
+            return out
+
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline and \
+                set(replica_counts().values()) != {2}:
+            time.sleep(0.05)
+        assert set(replica_counts().values()) == {2}, replica_counts()
+        # restart member 2: its store must NOT grow extra replicas
+        services[2].stop()
+        time.sleep(0.2)
+        services[2] = boot(2)
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            vs = services[2].store.buckets.get("cnosdb.rr")
+            if vs:
+                time.sleep(0.5)   # allow any (wrong) replay to land
+                break
+            time.sleep(0.05)
+        vnodes = services[2].store.buckets["cnosdb.rr"][0].shard_group[0].vnodes
+        assert len(vnodes) == 2, [v.id for v in vnodes]
+    finally:
+        for s in services.values():
+            try:
+                s.stop()
+            except Exception:
+                pass

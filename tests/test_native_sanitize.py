@@ -1,0 +1,69 @@
+"""ASAN+UBSAN harness for the native codec kernels (SURVEY §5: the
+reference relies on Rust's ownership guarantees; the rebuild's C++ surface
+gets sanitizers). Builds `libcnosdb_codecs_asan.so` and drives codec
+round-trips through it in a SUBPROCESS with the sanitizer runtime
+preloaded — any heap overflow / UB aborts the child and fails the test."""
+import os
+import subprocess
+import sys
+
+import pytest
+
+NATIVE_DIR = os.path.join(os.path.dirname(__file__), "..", "native")
+ASAN_LIB = os.path.join(os.path.dirname(__file__), "..", "cnosdb_tpu",
+                        "_native", "libcnosdb_codecs_asan.so")
+
+CHILD = r"""
+import os, sys
+import numpy as np
+
+# route the bindings at the sanitized build
+os.environ["CNOSDB_NATIVE_LIB"] = sys.argv[1]
+from cnosdb_tpu.storage import codecs, native
+from cnosdb_tpu.models.schema import ValueType
+
+assert native.available(), "sanitized native lib failed to load"
+
+rng = np.random.default_rng(7)
+# exercise every codec family through encode→decode round-trips at odd
+# sizes (boundary conditions are where memory bugs live)
+for n in (0, 1, 7, 63, 64, 65, 1000, 4097):
+    ts = np.cumsum(rng.integers(1, 1000, max(n, 1)).astype(np.int64))[:n]
+    out = codecs.decode_timestamps(codecs.encode_timestamps(ts))
+    assert np.array_equal(out, ts), f"ts roundtrip n={n}"
+
+    f = rng.normal(0, 1e6, n)
+    out = codecs.decode(codecs.encode(f, ValueType.FLOAT), ValueType.FLOAT)
+    assert np.array_equal(out, f), f"f64 roundtrip n={n}"
+
+    i = rng.integers(-2**40, 2**40, max(n, 1)).astype(np.int64)[:n]
+    out = codecs.decode(codecs.encode(i, ValueType.INTEGER),
+                        ValueType.INTEGER)
+    assert np.array_equal(out, i), f"i64 roundtrip n={n}"
+print("SANITIZED ROUNDTRIPS OK")
+"""
+
+
+@pytest.mark.skipif(not os.path.exists(os.path.join(NATIVE_DIR, "codecs.cpp")),
+                    reason="native source absent")
+def test_codecs_under_asan(tmp_path):
+    build = subprocess.run(["make", "-C", NATIVE_DIR, "asan"],
+                           capture_output=True, text=True)
+    if build.returncode != 0:
+        pytest.skip(f"asan build unavailable: {build.stderr[-300:]}")
+    # find the asan runtime to preload (python itself isn't instrumented)
+    probe = subprocess.run(
+        ["g++", "-print-file-name=libasan.so"], capture_output=True,
+        text=True)
+    asan_rt = probe.stdout.strip()
+    env = dict(os.environ)
+    env["LD_PRELOAD"] = asan_rt
+    env["ASAN_OPTIONS"] = "detect_leaks=0,abort_on_error=1"
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    child = subprocess.run(
+        [sys.executable, "-c", CHILD, os.path.abspath(ASAN_LIB)],
+        capture_output=True, text=True, env=env, timeout=300)
+    assert child.returncode == 0, \
+        f"sanitizer run failed:\n{child.stdout}\n{child.stderr[-2000:]}"
+    assert "SANITIZED ROUNDTRIPS OK" in child.stdout
