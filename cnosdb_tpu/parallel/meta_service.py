@@ -93,7 +93,11 @@ class MetaStateMachine:
         method, kwargs, req_id = _mp.unpackb(entry.data, raw=False)
         if req_id in self._seen:
             # retried proposal whose first copy DID commit (propose timeout
-            # or leadership change): applying twice would double-mutate
+            # or leadership change): applying twice would double-mutate.
+            # Persist the watermark NOW — _seen is memory-only, so a
+            # restart replaying this duplicate would re-execute it
+            with self.store.lock:
+                self.store._persist()
             return
         self._seen[req_id] = None
         if len(self._seen) > 1024:

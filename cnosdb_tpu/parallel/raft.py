@@ -201,13 +201,23 @@ class WalLogStore(LogStore):
 
     def _on_purge(self, seq: int):
         self._purge_floor = max(getattr(self, "_purge_floor", 0), seq)
+        terms = getattr(self, "_purged_terms", None)
+        if terms is None:
+            terms = self._purged_terms = {}
         for i in list(self._entries):
             if i < seq:
+                # remember the purged entry's TERM: propose() must still
+                # distinguish "my applied entry was GC'd" (success) from
+                # "a different leader's replacement was GC'd" (lost write)
+                terms[i] = self._entries[i].term
                 del self._entries[i]
+        if len(terms) > 8192:
+            for k in sorted(terms)[:4096]:
+                del terms[k]
 
-    def purged_below(self, idx: int) -> bool:
-        """True if entries < _purge_floor were GC'd (applied+flushed)."""
-        return idx < getattr(self, "_purge_floor", 0)
+    def purged_term(self, idx: int) -> int | None:
+        """Term of a purged (applied + GC'd) entry, if remembered."""
+        return getattr(self, "_purged_terms", {}).get(idx)
 
     def save_hard_state(self, term, voted_for):
         import os
@@ -508,10 +518,10 @@ class RaftNode:
         with self.lock:
             e = self.log.entry_at(idx)
         if e is None:
-            # ambiguous absence: a post-apply WAL purge (flush GC'd the
-            # applied entry — success) vs truncation after leadership loss.
-            # purged_below() disambiguates where the store tracks purges.
-            if getattr(self.log, "purged_below", lambda i: False)(idx):
+            # absence = post-apply WAL purge or truncation after
+            # leadership loss; the recorded purge-time term disambiguates
+            pt = getattr(self.log, "purged_term", lambda i: None)(idx)
+            if pt == term:
                 return idx
             raise ReplicationError(
                 "entry superseded after leadership change", index=idx)

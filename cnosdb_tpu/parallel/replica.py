@@ -42,58 +42,14 @@ class VnodeStateMachine(StateMachine):
         self.vnode.apply_entry(entry.entry_type, entry.data, entry.index)
 
     def snapshot(self) -> bytes:
-        """Ship the memcache + flushed state as a write-batch replay bundle
-        (round-1 scope: logical snapshot; file-level snapshots later)."""
-        from ..storage.scan import scan_vnode
-
-        tables = {}
-        for (table, _sid) in list(self.vnode.active.series.keys()) + \
-                [(t, s) for c in self.vnode.immutables for (t, s) in c.series]:
-            tables[table] = True
-        for fm in self.vnode.summary.version.all_files():
-            r = self.vnode.summary.version.reader(fm)
-            for t in r.tables():
-                tables[t] = True
-        out = {}
-        for table in tables:
-            b = scan_vnode(self.vnode, table)
-            rows = []
-            for i in range(b.n_rows):
-                sid = int(b.series_ids[b.sid_ordinal[i]])
-                key = self.vnode.index.get_series_key(sid)
-                fields = {}
-                for name, (vt, vals, valid) in b.fields.items():
-                    if valid[i]:
-                        v = vals[i]
-                        fields[name] = [int(vt), v.item() if hasattr(v, "item") else v]
-                rows.append([key.encode() if key else b"", int(b.ts[i]), fields])
-            out[table] = rows
-        return msgpack.packb(out, use_bin_type=True)
+        """FILE-level snapshot (reference vnode_store.rs VnodeSnapshot +
+        DownloadFile shipping): flush, then capture the vnode's physical
+        files — no per-row re-encoding, and install is byte-identical."""
+        return msgpack.packb(self.vnode.file_snapshot(), use_bin_type=True)
 
     def install_snapshot(self, data: bytes, last_index: int, last_term: int):
-        from ..models.points import SeriesRows, WriteBatch
-        from ..models.series import SeriesKey
-
-        obj = msgpack.unpackb(data, raw=False, strict_map_key=False)
-        # replace local state: drop all tables, then re-apply rows
-        wb = WriteBatch()
-        for table, rows in obj.items():
-            self.vnode._apply_drop_table(table)
-            per_key: dict[bytes, list] = {}
-            for key_b, ts, fields in rows:
-                per_key.setdefault(key_b, []).append((ts, fields))
-            for key_b, items in per_key.items():
-                key = SeriesKey.decode(key_b)
-                ts_list = [t for t, _ in items]
-                fnames = {n for _, f in items for n in f}
-                fs = {}
-                for n in fnames:
-                    vt = next(f[n][0] for _, f in items if n in f)
-                    fs[n] = (vt, [f.get(n, [None, None])[1] if n in f else None
-                                  for _, f in items])
-                wb.add_series(table, SeriesRows(key, ts_list, fs))
-        if wb.tables:
-            self.vnode._apply_write(wb, last_index)
+        snap = msgpack.unpackb(data, raw=False, strict_map_key=False)
+        self.vnode.install_file_snapshot(snap)
 
 
 class ReplicaGroupManager:

@@ -161,6 +161,100 @@ class VnodeStorage:
             gc_compacted_files(self.summary.version, edit)
             return True
 
+    def file_snapshot(self) -> dict:
+        """FILE-level snapshot (reference vnode_store.rs:129-213
+        VnodeSnapshot = VersionEdit + file set shipped via DownloadFile):
+        flush everything, then capture the physical files — TSM levels,
+        summary manifest, index checkpoint/binlog — as relative-path blobs.
+        The WAL is excluded: it IS the raft log being snapshotted around.
+
+        Lock discipline: only the MANIFEST (file list + small mutable
+        metadata) is captured under the vnode lock; TSM data files are
+        immutable once written, so their bytes are read after release —
+        a concurrent compaction that deletes one shows up as a missing
+        file and triggers a retry, instead of stalling writes for the
+        whole multi-GB read."""
+        skip_top = {"wal", "hardstate"}
+        for _attempt in range(5):
+            with self.lock:
+                self.flush(sync=True)
+                files: dict[str, bytes] = {}
+                big: list[str] = []
+                for root, _dirs, names in os.walk(self.dir):
+                    rel_root = os.path.relpath(root, self.dir)
+                    if rel_root.split(os.sep)[0] in skip_top:
+                        continue
+                    for name in names:
+                        if rel_root == "." and name == "hardstate":
+                            continue
+                        rel = os.path.normpath(os.path.join(rel_root, name))
+                        if name.endswith(".tsm"):
+                            big.append(rel)   # immutable: read outside
+                        else:
+                            with open(os.path.join(root, name), "rb") as f:
+                                files[rel] = f.read()
+            try:
+                for rel in big:
+                    with open(os.path.join(self.dir, rel), "rb") as f:
+                        files[rel] = f.read()
+                return {"files": files}
+            except FileNotFoundError:
+                continue   # compaction replaced the file set: re-capture
+        # final attempt entirely under the lock (consistency over latency)
+        with self.lock:
+            self.flush(sync=True)
+            files = {}
+            for root, _dirs, names in os.walk(self.dir):
+                rel_root = os.path.relpath(root, self.dir)
+                if rel_root.split(os.sep)[0] in skip_top:
+                    continue
+                for name in names:
+                    if rel_root == "." and name == "hardstate":
+                        continue
+                    rel = os.path.normpath(os.path.join(rel_root, name))
+                    with open(os.path.join(root, name), "rb") as f:
+                        files[rel] = f.read()
+            return {"files": files}
+
+    def install_file_snapshot(self, snap: dict):
+        """Replace this vnode's physical state with a snapshot, in place
+        (the raft member and engine registry keep their object). Old
+        readers stay valid on unlinked inodes; data_version invalidates
+        every cache. Paths are CONFINED to the vnode dir — the snapshot
+        arrives over the network and must never become a file-write
+        primitive outside it."""
+        import shutil
+
+        base = os.path.realpath(self.dir)
+        for rel in snap["files"]:
+            if os.path.isabs(rel):
+                raise StorageError(f"absolute path in snapshot: {rel!r}")
+            dest = os.path.realpath(os.path.join(base, rel))
+            if not (dest == base or dest.startswith(base + os.sep)):
+                raise StorageError(f"path escapes vnode dir: {rel!r}")
+        with self.lock:
+            self.summary.version.close()
+            self.summary.close()
+            self.index.close()
+            for name in os.listdir(self.dir):
+                if name in ("wal", "hardstate"):
+                    continue
+                path = os.path.join(self.dir, name)
+                if os.path.isdir(path):
+                    shutil.rmtree(path, ignore_errors=True)
+                else:
+                    os.unlink(path)
+            for rel, raw in snap["files"].items():
+                path = os.path.join(self.dir, rel)
+                os.makedirs(os.path.dirname(path), exist_ok=True)
+                with open(path, "wb") as f:
+                    f.write(raw)
+            self.summary = Summary(self.dir)
+            self.index = TSIndex(os.path.join(self.dir, "index"))
+            self.active = MemCache(self.vnode_id, self.memcache_bytes)
+            self.immutables = []
+            self.data_version += 1
+
     def checksum(self) -> str:
         """Content checksum of every live row, independent of physical
         layout (reference compaction/check.rs:99 ChecksumGroup): replicas

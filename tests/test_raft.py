@@ -122,8 +122,13 @@ def test_leader_failover_and_rejoin():
         while time.monotonic() < deadline and sm.data.get("b") != 2:
             time.sleep(0.02)
         assert sm.data == {"a": 1, "b": 2}
-        leaders = [n for n in nodes.values() if n.is_leader()]
-        assert len(leaders) <= 1
+        # raft safety: at most one leader PER TERM (a deposed leader may
+        # transiently still claim leadership in an older term)
+        by_term: dict = {}
+        for n in nodes.values():
+            if n.is_leader():
+                by_term.setdefault(n.term, []).append(n)
+        assert all(len(v) <= 1 for v in by_term.values()), by_term
     finally:
         for n in nodes.values():
             n.stop()

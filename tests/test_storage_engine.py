@@ -346,3 +346,19 @@ def test_compaction_concurrent_with_writes(tmp_engine_dir):
     assert b.n_rows == total
     assert sorted(b.ts.tolist()) == list(range(total))
     eng.close()
+
+
+def test_install_file_snapshot_rejects_traversal(tmp_engine_dir):
+    """Regression (security): snapshot paths arrive over the network and
+    must never write outside the vnode dir."""
+    import pytest as _pytest
+
+    from cnosdb_tpu.errors import StorageError
+    from cnosdb_tpu.storage.engine import TsKv
+
+    eng = TsKv(tmp_engine_dir)
+    v = eng.open_vnode("t.db", 1)
+    for bad in ("../evil", "a/../../evil", "/etc/evil"):
+        with _pytest.raises(StorageError):
+            v.install_file_snapshot({"files": {bad: b"x"}})
+    eng.close()
