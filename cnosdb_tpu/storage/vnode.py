@@ -197,7 +197,7 @@ class VnodeStorage:
                 for rel in big:
                     with open(os.path.join(self.dir, rel), "rb") as f:
                         files[rel] = f.read()
-                return {"files": files}
+                return {"files": files, "digests": _digests(files)}
             except FileNotFoundError:
                 continue   # compaction replaced the file set: re-capture
         # final attempt entirely under the lock (consistency over latency)
@@ -214,7 +214,7 @@ class VnodeStorage:
                     rel = os.path.normpath(os.path.join(rel_root, name))
                     with open(os.path.join(root, name), "rb") as f:
                         files[rel] = f.read()
-            return {"files": files}
+            return {"files": files, "digests": _digests(files)}
 
     def install_file_snapshot(self, snap: dict):
         """Replace this vnode's physical state with a snapshot, in place
@@ -226,12 +226,17 @@ class VnodeStorage:
         import shutil
 
         base = os.path.realpath(self.dir)
+        digests = snap.get("digests") or {}
         for rel in snap["files"]:
             if os.path.isabs(rel):
                 raise StorageError(f"absolute path in snapshot: {rel!r}")
             dest = os.path.realpath(os.path.join(base, rel))
             if not (dest == base or dest.startswith(base + os.sep)):
                 raise StorageError(f"path escapes vnode dir: {rel!r}")
+            want = digests.get(rel)
+            if want is not None and _sha256(snap["files"][rel]) != want:
+                raise StorageError(
+                    f"snapshot file {rel!r} corrupted in transit")
         with self.lock:
             self.summary.version.close()
             self.summary.close()
@@ -400,3 +405,16 @@ class VnodeStorage:
             self.wal.close()
             self.index.close()
             self.summary.close()
+
+
+def _sha256(raw: bytes) -> str:
+    import hashlib
+
+    return hashlib.sha256(raw).hexdigest()
+
+
+def _digests(files: dict[str, bytes]) -> dict[str, str]:
+    """Per-file integrity digests shipped with a snapshot: install
+    verifies them so transit corruption fails loudly instead of landing
+    silently in the store."""
+    return {rel: _sha256(raw) for rel, raw in files.items()}
