@@ -149,6 +149,7 @@ class InsertStmt:
     columns: list[str]
     rows: list[list]                 # literal values per row
     select: SelectStmt | None = None
+    database: str | None = None      # qualified INSERT INTO db.tbl
 
 
 @dataclass

@@ -563,7 +563,8 @@ class QueryExecutor:
 
     # ------------------------------------------------------------------ DML
     def _insert(self, stmt: ast.InsertStmt, session: Session):
-        schema = self.meta.table(session.tenant, session.database, stmt.table)
+        db = stmt.database or session.database
+        schema = self.meta.table(session.tenant, db, stmt.table)
         cols = stmt.columns or [c.name for c in schema.columns]
         if "time" not in cols:
             raise ExecutionError("INSERT must include the time column")
@@ -591,7 +592,7 @@ class QueryExecutor:
                 row["time"] = parse_timestamp_string(t)
             rows.append(row)
         wb = WriteBatch.from_rows(stmt.table, rows, tag_names, field_types)
-        self.coord.write_points(session.tenant, session.database, wb)
+        self.coord.write_points(session.tenant, db, wb)
         return ResultSet(["rows"], [np.array([len(rows)])])
 
     def _delete(self, stmt: ast.DeleteStmt, session: Session):

@@ -781,6 +781,9 @@ class Parser:
         self.expect_kw("INSERT")
         self.expect_kw("INTO")
         table = self.expect_ident()
+        database = None
+        if self.accept_op("."):
+            database, table = table, self.expect_ident()
         columns = []
         if self.accept_op("("):
             columns.append(self.expect_ident())
@@ -788,7 +791,8 @@ class Parser:
                 columns.append(self.expect_ident())
             self.expect_op(")")
         if self.kw() == "SELECT":
-            return ast.InsertStmt(table, columns, [], self.parse_select())
+            return ast.InsertStmt(table, columns, [], self.parse_select(),
+                                  database)
         self.expect_kw("VALUES")
         rows = []
         while True:
@@ -800,7 +804,7 @@ class Parser:
             rows.append(row)
             if not self.accept_op(","):
                 break
-        return ast.InsertStmt(table, columns, rows)
+        return ast.InsertStmt(table, columns, rows, None, database)
 
     def parse_literal_value(self):
         e = self.parse_expr()

@@ -85,7 +85,7 @@ def test_replicated_write_failover_and_rejoin(cluster):
     assert _wait_count(n2, "mem", "d2", 64) == 64
     # restart node 3: raft replays/snapshots it back to parity
     n3.start().wait_ready()
-    assert _wait_count(n3, "mem", "d2", 64, timeout=40.0) == 64
+    assert _wait_count(n3, "mem", "d2", 64, timeout=90.0) == 64
 
 
 def test_killed_leaderless_shard_still_reads(cluster):
@@ -116,7 +116,7 @@ def test_chaos_restart_while_writing(cluster):
         total += 25
     assert _wait_count(n1, "evt", "d3", total, timeout=30.0) == total
     n3.wait_ready()
-    assert _wait_count(n3, "evt", "d3", total, timeout=40.0) == total
+    assert _wait_count(n3, "evt", "d3", total, timeout=90.0) == total
 
 
 def test_move_vnode_then_kill_source(cluster):
