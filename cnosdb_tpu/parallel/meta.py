@@ -94,6 +94,11 @@ class MetaStore:
         # write as the mutation itself) so a restarted replicated-meta
         # member never re-applies logged mutations its store already holds
         self.applied_index = 0
+        # recently-applied raft request ids, persisted in the SAME atomic
+        # meta.json write as the mutations they guard: a restarted member
+        # replaying a retried duplicate proposal (or a retry reaching a
+        # restarted leader) must still dedup originals applied pre-crash
+        self.recent_req_ids: list[str] = []
         self._next_bucket_id = 1
         self._next_replica_id = 1
         self._next_vnode_id = 1
@@ -129,6 +134,7 @@ class MetaStore:
             "roles": self.roles,
             "externals": self.externals,
             "applied_index": self.applied_index,
+            "recent_req_ids": self.recent_req_ids,
             "next_ids": [self._next_bucket_id, self._next_replica_id, self._next_vnode_id],
         }
 
@@ -164,6 +170,7 @@ class MetaStore:
         self.roles = d.get("roles", {})
         self.externals = d.get("externals", {})
         self.applied_index = d.get("applied_index", 0)
+        self.recent_req_ids = list(d.get("recent_req_ids", []))
         self._next_bucket_id, self._next_replica_id, self._next_vnode_id = d["next_ids"]
 
     def _notify(self, event: str, **kw):

@@ -79,14 +79,35 @@ class MetaStateMachine:
     def __init__(self, store: MetaStore):
         self.store = store
         self._results: dict[str, object] = {}   # req_id → outcome
-        self._seen: dict[str, None] = {}        # bounded FIFO of req ids
+        # bounded FIFO of req ids, seeded from the store so dedup survives
+        # restarts (the list is persisted atomically with applied_index)
+        self._seen: dict[str, None] = dict.fromkeys(store.recent_req_ids)
+
+    def _arm(self, req_id: str) -> None:
+        """Record req_id in the dedup set AND the store's persisted list
+        (written out by the mutation's own _persist, same atomic file)."""
+        if req_id in self._seen:
+            return
+        self._seen[req_id] = None
+        ids = self.store.recent_req_ids
+        ids.append(req_id)
+        if len(ids) > 2048:
+            for k in ids[:1024]:
+                self._seen.pop(k, None)
+            del ids[:1024]
 
     def apply(self, entry):
         import msgpack as _mp
 
         if entry.index <= self.store.applied_index:
             # restart replay: the store already persisted this mutation
-            # (applied_index rides inside the same atomic meta.json write)
+            # (applied_index rides inside the same atomic meta.json write).
+            # Still ARM the dedup set: a retried duplicate of this entry
+            # may sit later in the log, and _seen must reject it even when
+            # the original's req_id predates this process
+            req_id = _mp.unpackb(entry.data, raw=False)[2]
+            with self.store.lock:
+                self._arm(req_id)
             return
         with self.store.lock:
             self.store.applied_index = entry.index
@@ -94,26 +115,75 @@ class MetaStateMachine:
         if req_id in self._seen:
             # retried proposal whose first copy DID commit (propose timeout
             # or leadership change): applying twice would double-mutate.
-            # Persist the watermark NOW — _seen is memory-only, so a
-            # restart replaying this duplicate would re-execute it
+            # Persist the watermark NOW so a restart replaying this
+            # duplicate still skips it
             with self.store.lock:
                 self.store._persist()
             return
-        self._seen[req_id] = None
-        if len(self._seen) > 1024:
-            for k in list(self._seen)[:512]:
-                del self._seen[k]
+        with self.store.lock:
+            self._arm(req_id)
         for name, fix in _ARG_HYDRATORS.get(method, {}).items():
             if name in kwargs:
                 kwargs[name] = fix(kwargs[name])
+        # path-less stores have no durable copy to rollback-reload from, so
+        # capture the pre-mutation state up front (cheap: meta state is
+        # small and mutations are rare)
+        pre_state = None
+        if not self.store.path:
+            with self.store.lock:
+                pre_state = self.store._to_dict()
         try:
             result = getattr(self.store, method)(**kwargs)
             self._results[req_id] = ("ok", result)
-        except Exception as e:  # deterministic failures replicate as no-ops
+        except CnosError as e:
+            # deterministic validation failures replicate as no-ops —
+            # every member reaches the same outcome from the same state
             self._results[req_id] = ("err", e)
+        except Exception:
+            # environmental failure (e.g. disk-full inside _persist):
+            # applying "as a no-op" would silently diverge this member
+            # from the group. Re-raise — the raft apply loop stalls at
+            # this index and retries, keeping last_applied honest.
+            self._rollback(entry, req_id, pre_state)
+            raise
         if len(self._results) > 256:
             for k in list(self._results)[:128]:
                 del self._results[k]
+
+    def _rollback(self, entry, req_id: str, pre_state: dict | None) -> None:
+        """Undo a half-applied mutation after an environmental failure.
+
+        Store mutations mutate memory FIRST and persist second, so a
+        failed _persist leaves the in-memory state ahead of disk; the
+        raft stall-and-retry would then re-execute the mutation on top
+        of its own partial effect (e.g. a second phantom replica vnode).
+        Reload the last durable state — or the captured pre-apply state
+        for path-less stores — so the retry starts clean."""
+        with self.store.lock:
+            restored = False
+            try:
+                import os as _os
+
+                if pre_state is not None:
+                    self.store._from_dict(pre_state)
+                    restored = True
+                elif self.store.path and _os.path.exists(self.store.path):
+                    self.store._load()
+                    restored = True
+                if restored:
+                    self._seen = dict.fromkeys(self.store.recent_req_ids)
+            except Exception:
+                pass
+            if not restored:
+                # disk unreadable too: at least rewind the watermark and
+                # dedup arming so the retry is not mistaken for a dup
+                # (memory may keep a partial effect — but with the disk
+                # gone this member is about to crash out anyway)
+                self.store.applied_index = entry.index - 1
+                self._seen.pop(req_id, None)
+                if self.store.recent_req_ids \
+                        and self.store.recent_req_ids[-1] == req_id:
+                    self.store.recent_req_ids.pop()
 
     def take_result(self, req_id: str):
         """Missing slot = the result is unknowable (deduplicated retry or
@@ -141,6 +211,10 @@ class MetaStateMachine:
         with self.store.lock:
             self.store._from_dict(obj["state"])
             self.store.version = max(self.store.version, obj["version"])
+            # the snapshot replaced recent_req_ids: reseed the dedup set
+            # or retried duplicates sitting in the log AFTER the snapshot
+            # point would re-execute on this member only
+            self._seen = dict.fromkeys(self.store.recent_req_ids)
             self.store._persist()
         self.store._notify("restore")
 

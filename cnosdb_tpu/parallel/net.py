@@ -16,7 +16,9 @@ or 500 + msgpack {"_err": class, "_msg": str} re-raised client-side.
 """
 from __future__ import annotations
 
+import hmac
 import http.client
+import os
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -26,9 +28,24 @@ import msgpack
 
 from ..errors import CnosError
 
+# Intra-cluster shared secret (CNOSDB_CLUSTER_SECRET): when set, every RPC
+# must carry it — the plane exposes destructive admin and file-installing
+# methods (vnode_install, meta_restore, raft_msg), so any deployment that
+# binds beyond loopback MUST either set this or isolate the network. Read
+# at call time so harness-spawned processes inherit it from their env.
+SECRET_HEADER = "x-cnosdb-cluster-secret"
+
+
+def cluster_secret() -> str | None:
+    return os.environ.get("CNOSDB_CLUSTER_SECRET") or None
+
 
 class RpcError(CnosError):
     pass
+
+
+class RpcUnauthorized(RpcError):
+    """Missing/wrong cluster secret."""
 
 
 class RpcUnavailable(RpcError):
@@ -60,6 +77,12 @@ class RpcServer:
                 n = int(self.headers.get("Content-Length", 0))
                 body = self.rfile.read(n) if n else b""
                 method = self.path.rsplit("/", 1)[-1]
+                secret = cluster_secret()
+                if secret is not None and not hmac.compare_digest(
+                        self.headers.get(SECRET_HEADER, ""), secret):
+                    self._reply(403, pack({"_err": "RpcUnauthorized",
+                                           "_msg": "cluster secret required"}))
+                    return
                 fn = outer.handlers.get(method)
                 if fn is None:
                     self._reply(404, pack({"_err": "NoSuchMethod", "_msg": method}))
@@ -149,6 +172,9 @@ def rpc_call(addr: str, method: str, payload: dict | None = None,
     from ..server.trace import TRACE_HEADER, current_trace_header
 
     hdrs = {"Content-Type": "application/msgpack"}
+    secret = cluster_secret()
+    if secret is not None:
+        hdrs[SECRET_HEADER] = secret
     tid = current_trace_header()
     if tid:
         hdrs[TRACE_HEADER] = tid
@@ -179,6 +205,10 @@ def rpc_call(addr: str, method: str, payload: dict | None = None,
             conn.close()
             raise RpcUnavailable(f"{method}@{addr}: {e}") from e
         _pool.put(addr, conn)
+        if resp.status == 403:
+            # typed: auth misconfiguration is permanent — retry loops that
+            # catch RpcError/RpcUnavailable must be able to fail fast
+            raise RpcUnauthorized(f"{method}@{addr}: {reply.get('_msg')}")
         if resp.status != 200:
             raise RpcError(f"{method}@{addr}: "
                            f"{reply.get('_err')}: {reply.get('_msg')}")

@@ -68,6 +68,11 @@ class LogStore:
     def entry_at(self, index: int) -> LogEntry | None:
         raise NotImplementedError
 
+    def purge_record_floor(self) -> int:
+        """Highest index whose purged-entry term record was evicted (0 =
+        none): outcomes at or below it are unknowable, not superseded."""
+        return 0
+
     def last_index(self) -> int:
         raise NotImplementedError
 
@@ -143,6 +148,7 @@ class WalLogStore(LogStore):
 
         self.wal = wal
         self._hs_path = hard_state_path
+        self._purged_terms_evicted_to = 0
         self._entries: dict[int, LogEntry] = {}
         for we in wal.replay():
             self._entries[we.seq] = LogEntry(we.term, we.seq, we.entry_type,
@@ -212,12 +218,21 @@ class WalLogStore(LogStore):
                 terms[i] = self._entries[i].term
                 del self._entries[i]
         if len(terms) > 8192:
-            for k in sorted(terms)[:4096]:
+            evicted = sorted(terms)[:4096]
+            # remember HOW FAR records were dropped: a propose() landing in
+            # the evicted range must report "outcome unknown", not the
+            # definite "superseded" (its entry may well have committed)
+            self._purged_terms_evicted_to = max(
+                self._purged_terms_evicted_to, evicted[-1])
+            for k in evicted:
                 del terms[k]
 
     def purged_term(self, idx: int) -> int | None:
         """Term of a purged (applied + GC'd) entry, if remembered."""
         return getattr(self, "_purged_terms", {}).get(idx)
+
+    def purge_record_floor(self) -> int:
+        return self._purged_terms_evicted_to
 
     def save_hard_state(self, term, voted_for):
         import os
@@ -324,7 +339,7 @@ class HttpTransport(Transport):
         addr = self.resolver(group_id, to)
         if addr is None:
             return None
-        from .net import RpcError, rpc_call
+        from .net import RpcError, RpcUnauthorized, rpc_call
 
         try:
             # short timeout: raft treats a missing reply as a dropped
@@ -333,6 +348,21 @@ class HttpTransport(Transport):
             r = rpc_call(addr, "raft_msg",
                          {"group": group_id, "to": to, "msg": msg},
                          timeout=2.0)
+        except RpcUnauthorized as e:
+            # permanent misconfiguration (peers disagree on the cluster
+            # secret) — swallowing it would look exactly like a network
+            # partition forever. Surface it loudly, once per peer.
+            flagged = getattr(self, "_auth_flagged", None)
+            if flagged is None:
+                flagged = self._auth_flagged = set()
+            if (group_id, to) not in flagged:
+                flagged.add((group_id, to))
+                import sys as _sys
+
+                print(f"raft[{group_id}] peer {to}@{addr} rejects the "
+                      f"cluster secret: {e} — check CNOSDB_CLUSTER_SECRET "
+                      f"on every member", file=_sys.stderr)
+            return None
         except RpcError:
             return None
         return r.get("reply")
@@ -523,6 +553,13 @@ class RaftNode:
             pt = getattr(self.log, "purged_term", lambda i: None)(idx)
             if pt == term:
                 return idx
+            if pt is None and idx <= self.log.purge_record_floor():
+                # purge record evicted: the entry may have committed with
+                # our term — a definite "superseded" here would report a
+                # real write as lost. Surface the uncertainty instead.
+                raise ReplicationError(
+                    "outcome unknown: purged-entry term record evicted — "
+                    "re-check state before retrying", index=idx)
             raise ReplicationError(
                 "entry superseded after leadership change", index=idx)
         if e.term != term:
@@ -666,7 +703,23 @@ class RaftNode:
                 break
             with self._sm_lock:
                 if e.entry_type != RAFT_BLANK:
-                    self.sm.apply(e)
+                    try:
+                        self.sm.apply(e)
+                    except Exception as exc:
+                        # environmental apply failure (state machines raise
+                        # through for non-deterministic errors): do NOT
+                        # advance last_applied — stall at this index and
+                        # retry on the next tick rather than diverge, and
+                        # keep the tick/message threads alive. Log once
+                        # per stalled index, not once per tick.
+                        if getattr(self, "_stall_logged", None) != e.index:
+                            self._stall_logged = e.index
+                            import sys as _sys
+
+                            print(f"raft[{self.group_id}] apply stalled at "
+                                  f"index {e.index}: {exc!r}",
+                                  file=_sys.stderr)
+                        break
                 self.last_applied += 1
         with self._apply_cv:
             self._apply_cv.notify_all()

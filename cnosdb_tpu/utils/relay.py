@@ -1,0 +1,43 @@
+"""Degraded-TPU-relay guard, shared by bench.py and __graft_entry__.py.
+
+In tunneled-TPU environments the accelerator plugin dials the relay at
+`import jax` whenever PALLAS_AXON_POOL_IPS is set (even under
+JAX_PLATFORMS=cpu), and a degraded relay hangs the import for minutes.
+Clearing the var in-process is too late — sitecustomize registers the
+dialing plugin at interpreter start — so the only safe probe is a child
+process with a timeout, and the only safe fallback is re-running in a
+child (or execve'd image) whose environment never had the var.
+"""
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+
+def probe_jax_importable(timeout: float = 120.0) -> str | None:
+    """None when `import jax` can complete in this environment, else a
+    short reason string (probe runs in a throwaway subprocess)."""
+    if not os.environ.get("PALLAS_AXON_POOL_IPS"):
+        return None
+    try:
+        probe = subprocess.run(
+            [sys.executable, "-c", "import jax; jax.devices()"],
+            timeout=timeout, capture_output=True, text=True)
+        if probe.returncode == 0:
+            return None
+        return (f"device probe failed (rc={probe.returncode}): "
+                f"{(probe.stderr or '').strip()[-200:]}")
+    except subprocess.TimeoutExpired:
+        return "TPU relay unresponsive (probe timeout)"
+
+
+def cleaned_cpu_env(extra: dict | None = None) -> dict:
+    """A copy of the environment with the relay var stripped and jax
+    pinned to CPU — what a clean-env fallback child should run under."""
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    if extra:
+        env.update(extra)
+    return env

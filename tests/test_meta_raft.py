@@ -159,3 +159,75 @@ def test_meta_member_restart_no_double_apply(tmp_path):
                 s.stop()
             except Exception:
                 pass
+
+
+def test_meta_dedup_survives_restart(tmp_path):
+    """A retried duplicate proposal can land in the log AFTER the original
+    was applied and the member crashed: the dedup set must be rebuilt from
+    the persisted store (recent_req_ids rides the same atomic meta.json
+    write as the mutation), or replay re-executes a committed
+    non-idempotent mutation."""
+    import msgpack
+
+    from cnosdb_tpu.models.schema import DatabaseOptions, DatabaseSchema
+    from cnosdb_tpu.parallel.meta_service import MetaStateMachine
+    from cnosdb_tpu.parallel.raft import LogEntry
+
+    path = str(tmp_path / "meta.json")
+    store = MetaStore(path, register_self=False)
+    store.register_node(1, grpc_addr="a")
+    store.create_database(DatabaseSchema("cnosdb", "d",
+                                         DatabaseOptions(shard_num=1)))
+    b = store.locate_bucket_for_write("cnosdb", "d", 1, nodes=[1])
+    rs_id = b.shard_group[0].id
+
+    sm = MetaStateMachine(store)
+    cmd = msgpack.packb(["add_replica_vnode",
+                         {"rs_id": rs_id, "node_id": 1}, "req-dup-1"],
+                        use_bin_type=True)
+    sm.apply(LogEntry(1, 1, 1, cmd))
+    n_after_first = len(store.buckets["cnosdb.d"][0].shard_group[0].vnodes)
+    assert n_after_first == 2
+
+    # crash + restart: fresh store from disk, fresh state machine
+    store2 = MetaStore(path, register_self=False)
+    sm2 = MetaStateMachine(store2)
+    # replay of the original arms dedup even though it is skipped
+    sm2.apply(LogEntry(1, 1, 1, cmd))
+    # the retried DUPLICATE (same req id, later index) must be a no-op
+    sm2.apply(LogEntry(1, 2, 1, cmd))
+    vnodes = store2.buckets["cnosdb.d"][0].shard_group[0].vnodes
+    assert len(vnodes) == 2, [v.id for v in vnodes]
+
+
+def test_rpc_cluster_secret(tmp_path, monkeypatch):
+    """With CNOSDB_CLUSTER_SECRET set, the msgpack-HTTP plane rejects
+    callers that do not present it (ADVICE r2: the RPC plane exposes
+    destructive admin methods and must not be open on non-loopback)."""
+    import http.client
+
+    from cnosdb_tpu.parallel.net import RpcError, RpcServer, pack
+
+    monkeypatch.setenv("CNOSDB_CLUSTER_SECRET", "s3cret")
+    srv = RpcServer("127.0.0.1", 0, {"echo": lambda p: {"ok": p["x"]}})
+    srv.start()
+    try:
+        # authorized: rpc_call reads the secret from the env
+        assert rpc_call(srv.addr, "echo", {"x": 5})["ok"] == 5
+        # unauthorized: raw request without the header → 403
+        host, _, port = srv.addr.rpartition(":")
+        conn = http.client.HTTPConnection(host, int(port), timeout=5)
+        conn.request("POST", "/rpc/echo", pack({"x": 5}),
+                     {"Content-Type": "application/msgpack"})
+        assert conn.getresponse().status == 403
+        conn.close()
+        # wrong secret (server and client share this process's env, so
+        # exercise the mismatch with a raw header) → 403
+        conn = http.client.HTTPConnection(host, int(port), timeout=5)
+        conn.request("POST", "/rpc/echo", pack({"x": 5}),
+                     {"Content-Type": "application/msgpack",
+                      "x-cnosdb-cluster-secret": "wrong"})
+        assert conn.getresponse().status == 403
+        conn.close()
+    finally:
+        srv.stop()
