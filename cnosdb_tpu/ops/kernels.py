@@ -167,6 +167,108 @@ def numpy_segment_partials(values: np.ndarray, valid: np.ndarray,
     return out
 
 
+def run_boundaries(seg_ids: np.ndarray,
+                   sid_ordinal: np.ndarray | None = None) -> np.ndarray:
+    """Start indices of equal-segment runs (splitting additionally at
+    series boundaries when sid_ordinal is given — first/last need time
+    order WITHIN every run, which only holds per series).
+
+    Correct for arbitrary seg arrays — a segment recurring in many runs
+    just contributes several partials; the caller combines them. Fast
+    when segments are contiguous, which the storage layout guarantees:
+    scan batches are series-contiguous and time-ordered per series, so
+    group×bucket segment ids form runs."""
+    n = len(seg_ids)
+    if n == 0:
+        return np.zeros(0, dtype=np.int64)
+    ch = np.diff(seg_ids) != 0
+    if sid_ordinal is not None:
+        ch = ch | (np.diff(sid_ordinal) != 0)
+    return np.concatenate(([0], np.flatnonzero(ch) + 1)).astype(np.int64)
+
+
+_I64_MAX = np.int64(np.iinfo(np.int64).max)
+_I64_MIN = np.int64(np.iinfo(np.int64).min)
+
+
+def run_segment_partials(values: np.ndarray, seg_ids: np.ndarray,
+                         starts: np.ndarray, num_segments: int, wants: dict,
+                         ts: np.ndarray | None = None,
+                         run_counts: np.ndarray | None = None) -> dict:
+    """Segment reductions over contiguous equal-segment runs.
+
+    The storage-layout-aware twin of numpy_segment_partials: sequential
+    ufunc.reduceat over runs replaces scatter bincount/ufunc.at (5-8×
+    faster on one core at bench scale), then tiny per-run combines fold
+    runs into segments. ALL rows are assumed valid — callers compress
+    invalid rows out first (compression preserves run structure).
+
+    first/last require `ts` (row timestamps, time-ordered within each
+    run) and return companion 'first_ts'/'last_ts' arrays — actual
+    timestamps, which coordinators can merge across vnodes directly.
+    Tie-breaking matches the rank kernels: earliest row position wins
+    `first`, latest wins `last`."""
+    out: dict[str, np.ndarray] = {}
+    ns = num_segments
+    n = len(values)
+    if n == 0:
+        starts = starts[:0]
+    run_seg = seg_ids[starts] if n else np.zeros(0, dtype=np.int64)
+    if run_counts is None:
+        run_counts = np.diff(np.append(starts, n))
+    if wants.get("want_count"):
+        out["count"] = np.bincount(
+            run_seg, weights=run_counts, minlength=ns).astype(np.int64)
+    integral = values.dtype.kind in "iu"
+    if wants.get("want_sum"):
+        part = np.add.reduceat(values, starts) if n else values[:0]
+        if integral:
+            # bincount sums in f64 and would round past 2^53; add.at over
+            # the (few) runs is exact in the column's own arithmetic
+            acc = np.zeros(ns, dtype=values.dtype)
+            np.add.at(acc, run_seg, part)
+            out["sum"] = acc
+        else:
+            out["sum"] = np.bincount(run_seg, weights=part, minlength=ns)
+    if wants.get("want_min"):
+        init = (np.iinfo(values.dtype).max if integral
+                else np.asarray(np.inf, values.dtype))
+        part = np.minimum.reduceat(values, starts) if n else values[:0]
+        acc = np.full(ns, init, dtype=values.dtype)
+        np.minimum.at(acc, run_seg, part)
+        out["min"] = acc
+    if wants.get("want_max"):
+        init = (np.iinfo(values.dtype).min if integral
+                else np.asarray(-np.inf, values.dtype))
+        part = np.maximum.reduceat(values, starts) if n else values[:0]
+        acc = np.full(ns, init, dtype=values.dtype)
+        np.maximum.at(acc, run_seg, part)
+        out["max"] = acc
+    if wants.get("want_first"):
+        ft = ts[starts] if n else np.zeros(0, dtype=np.int64)
+        acc_t = np.full(ns, _I64_MAX, dtype=np.int64)
+        np.minimum.at(acc_t, run_seg, ft)
+        pick = np.flatnonzero(ft == acc_t[run_seg])
+        fvals = np.zeros(ns, dtype=values.dtype)
+        # reversed assignment: among ties the EARLIEST run wins (stable
+        # time-sort semantics of the rank kernel)
+        fvals[run_seg[pick][::-1]] = values[starts][pick][::-1]
+        out["first"] = fvals
+        out["first_ts"] = acc_t
+    if wants.get("want_last"):
+        ends = (np.append(starts[1:], n) - 1) if n \
+            else np.zeros(0, dtype=np.int64)
+        lt = ts[ends] if n else np.zeros(0, dtype=np.int64)
+        acc_t = np.full(ns, _I64_MIN, dtype=np.int64)
+        np.maximum.at(acc_t, run_seg, lt)
+        pick = np.flatnonzero(lt == acc_t[run_seg])
+        lvals = np.zeros(ns, dtype=values.dtype)
+        lvals[run_seg[pick]] = values[ends][pick]   # latest tied run wins
+        out["last"] = lvals
+        out["last_ts"] = acc_t
+    return out
+
+
 def aggregate_column_host(values: np.ndarray, valid: np.ndarray,
                           seg_ids: np.ndarray, rank: np.ndarray,
                           num_segments: int, wants: dict) -> dict:

@@ -120,8 +120,12 @@ def launch_scan_aggregate(batch: ScanBatch, query: TpuQuery):
     needs_rank = any(a.func in ("first", "last") for a in query.aggs)
 
     # ------------------------------------------------ bucket geometry (meta only)
-    ts_lo = int(batch.ts.min())
-    ts_hi = int(batch.ts.max())
+    # min/max are immutable per scan snapshot: cache them (a 100M-row i64
+    # min+max costs ~150ms — pure waste on every repeated query)
+    mm = getattr(batch, "_ts_minmax", None)
+    if mm is None:
+        mm = batch._ts_minmax = (int(batch.ts.min()), int(batch.ts.max()))
+    ts_lo, ts_hi = mm
     if query.time_bucket is not None:
         origin, interval = query.time_bucket
         bmin = (ts_lo - origin) // interval
@@ -212,23 +216,37 @@ def launch_scan_aggregate(batch: ScanBatch, query: TpuQuery):
             # small LRU with eviction. NOTE this derived-cache memory rides
             # the batch outside the MemoryPool's admission accounting, so
             # the bound is deliberately tight: ≤2 shapes ≈ 2×8B/row plus
-            # rank/order (first/last) ≈ 8B/row — ~24B/row worst case on a
+            # run layout + rank/order ≈ 8B/row — ~24B/row worst case on a
             # scan-cache-resident batch
             while len(seg_cache) >= 2:
                 seg_cache.pop(next(iter(seg_cache)))
-            seg_cache[seg_key] = [seg_ids, bucket_starts, n_buckets, None]
+            # slots: seg_ids, bucket_starts, n_buckets, counts,
+            #        run_starts, run_counts (runs built lazily)
+            seg_cache[seg_key] = [seg_ids, bucket_starts, n_buckets,
+                                  None, None, None]
         num_segments = n_groups * n_buckets
 
+        def cached_runs():
+            """Run layout of the cached segment ids (storage batches are
+            series-contiguous + time-ordered per series, so segments form
+            runs; kernels.run_boundaries). → (starts, run_counts)."""
+            entry = seg_cache[seg_key]
+            if entry[4] is None:
+                entry[4] = kernels.run_boundaries(seg_ids, batch.sid_ordinal)
+                entry[5] = np.diff(np.append(entry[4], n))
+            return entry[4], entry[5]
+
         def cached_counts() -> np.ndarray:
-            """Group sizes (bincount of seg_ids over ALL rows) — derived
-            purely from the cached segment layout, so repeated queries pay
-            it once (count/presence of all-valid unfiltered columns)."""
+            """Group sizes over ALL rows — derived from the cached run
+            layout (O(runs), not O(n)), so repeated queries pay nothing
+            (count/presence of all-valid unfiltered columns)."""
             entry = seg_cache.get(seg_key)
             if entry is not None:
                 if entry[3] is None or len(entry[3]) < num_segments:
-                    c = np.bincount(seg_ids, minlength=num_segments) \
-                        .astype(np.int64)
-                    entry[3] = c
+                    starts, rcounts = cached_runs()
+                    entry[3] = np.bincount(
+                        seg_ids[starts], weights=rcounts,
+                        minlength=num_segments).astype(np.int64)
                 return entry[3][:num_segments]
             return np.bincount(seg_ids, minlength=num_segments) \
                 .astype(np.int64)
@@ -247,7 +265,21 @@ def launch_scan_aggregate(batch: ScanBatch, query: TpuQuery):
 
         # -------------------------------------------- filter
         row_mask = None   # None = no filter, every row participates
-        if query.filter is not None:
+        sel_idx = None
+        zone_pruned = False
+        if query.filter is not None and cpu_mode \
+                and not _contains_is_null(query.filter):
+            # data skipping: block min/max zone maps (the reference's page
+            # statistics pruning, reader/column_group/statistics.rs) — a
+            # selective filter touches only candidate blocks
+            from . import zonemap
+
+            pb = zonemap.possible_blocks(query.filter, batch)
+            if pb is not None and len(pb) and pb.mean() <= 0.25:
+                idx = zonemap.candidate_rows(pb, n)
+                sel_idx = _eval_filter_on_rows(batch, query.filter, idx)
+                zone_pruned = True
+        if query.filter is not None and not zone_pruned:
             row_mask = np.ones(n, dtype=bool)
             env = _filter_env(batch, needed=query.filter.columns())
             has_is_null = _contains_is_null(query.filter)
@@ -271,21 +303,36 @@ def launch_scan_aggregate(batch: ScanBatch, query: TpuQuery):
                         if cname in batch.fields and not col_all_valid(
                                 cname, batch.fields[cname][2]):
                             row_mask &= batch.fields[cname][2]
-        all_rows = row_mask is None or bool(row_mask.all())
-        if row_mask is None:
-            row_mask = np.ones(n, dtype=bool) if not cpu_mode \
-                else None   # the numpy path never touches it when all_rows
-        sel_idx = None
-        if not all_rows:
-            if cpu_mode:
-                # compress ONCE under a selective filter: every kernel then
-                # touches O(selected) rows instead of O(n) masked arrays
-                sel_idx = np.nonzero(row_mask)[0]
-            else:
-                seg_ids = np.where(row_mask, seg_ids, 0).astype(np.int32)
+        if zone_pruned:
+            all_rows = len(sel_idx) == n
+            if all_rows:
+                sel_idx = None
+        else:
+            all_rows = row_mask is None or bool(row_mask.all())
+            if row_mask is None:
+                row_mask = np.ones(n, dtype=bool) if not cpu_mode \
+                    else None  # the numpy path never touches it when all_rows
+            if not all_rows:
+                if cpu_mode:
+                    # compress ONCE under a selective filter: every kernel
+                    # then touches O(selected) rows, not O(n) masked arrays
+                    sel_idx = np.nonzero(row_mask)[0]
+                else:
+                    seg_ids = np.where(row_mask, seg_ids, 0).astype(np.int32)
 
         # -------------------------------------------- rank for first/last
-        if needs_rank:
+        # run kernels resolve first/last from per-run endpoint timestamps
+        # (no O(n log n) argsort); the rank machinery remains for the XLA
+        # host wrapper, unordered synthetic batches, and string columns
+        ordered = _ordered_within_series(batch)
+        fl_string = any(
+            a.func in ("first", "last") and a.column in batch.fields
+            and batch.fields[a.column][0] in (ValueType.STRING,
+                                              ValueType.GEOMETRY)
+            for a in query.aggs)
+        rank_based_fl = needs_rank and (not cpu_mode or not ordered
+                                        or fl_string)
+        if rank_based_fl:
             rank = getattr(batch, "_rank_cache", None)
             if rank is None:
                 order = np.argsort(batch.ts, kind="stable")
@@ -303,8 +350,24 @@ def launch_scan_aggregate(batch: ScanBatch, query: TpuQuery):
         # -------------------------------------------- per-column kernels
         seg_kernel = (kernels.numpy_segment_partials if cpu_mode
                       else kernels.aggregate_column_host)
+        sel_runs = None
+        ts_sel = None
+        if cpu_mode and sel_idx is not None:
+            seg_sel = seg_ids[sel_idx]
+            starts_sel = kernels.run_boundaries(
+                seg_sel, batch.sid_ordinal[sel_idx])
+            rcounts_sel = np.diff(np.append(starts_sel, len(seg_sel)))
+            sel_runs = (seg_sel, starts_sel, rcounts_sel)
+            if needs_rank and not rank_based_fl:
+                ts_sel = batch.ts[sel_idx]
         if all_rows:
             presence = cached_counts()
+        elif sel_runs is not None:
+            seg_sel, starts_sel, rcounts_sel = sel_runs
+            presence = np.bincount(
+                seg_sel[starts_sel] if len(seg_sel) else seg_sel[:0],
+                weights=rcounts_sel,
+                minlength=num_segments).astype(np.int64)
         elif sel_idx is not None:
             presence = np.bincount(seg_ids[sel_idx],
                                    minlength=num_segments).astype(np.int64)
@@ -323,7 +386,14 @@ def launch_scan_aggregate(batch: ScanBatch, query: TpuQuery):
                 continue
             vt, vals, valid = batch.fields[cname]
             if vt in (ValueType.STRING, ValueType.GEOMETRY):
-                sv = valid if row_mask is None else (valid & row_mask)
+                if sel_idx is not None:
+                    sv = np.zeros(n, dtype=bool)
+                    sv[sel_idx] = True
+                    sv &= valid
+                elif row_mask is not None:
+                    sv = valid & row_mask
+                else:
+                    sv = valid
                 col_results[cname] = _host_string_agg(
                     vals, sv, seg_ids, rank, num_segments, wants)
                 continue
@@ -339,6 +409,45 @@ def launch_scan_aggregate(batch: ScanBatch, query: TpuQuery):
             else:
                 dev_vals = vals
             all_valid = col_all_valid(cname, valid)
+            col_fl = wants.get("want_first") or wants.get("want_last")
+            if cpu_mode and not (col_fl and rank_based_fl):
+                # ------------------------------- run-aware host kernels
+                need_ts = bool(col_fl)
+                if all_rows and all_valid:
+                    starts, rcounts = cached_runs()
+                    r = kernels.run_segment_partials(
+                        dev_vals, seg_ids, starts, num_segments,
+                        {**wants, "want_count": False},
+                        ts=batch.ts if need_ts else None,
+                        run_counts=rcounts)
+                    r["count"] = presence
+                elif all_valid and sel_runs is not None:
+                    seg_sel, starts_sel, rcounts_sel = sel_runs
+                    r = kernels.run_segment_partials(
+                        dev_vals[sel_idx], seg_sel, starts_sel,
+                        num_segments, {**wants, "want_count": False},
+                        ts=(ts_sel if ts_sel is not None
+                            else (batch.ts[sel_idx] if need_ts else None)),
+                        run_counts=rcounts_sel)
+                    r["count"] = presence
+                else:
+                    # nulls present: compress valid rows — compression
+                    # preserves the run structure
+                    if sel_idx is not None:
+                        vsub = valid[sel_idx]
+                        idx2 = sel_idx if vsub.all() else sel_idx[vsub]
+                    else:
+                        idx2 = np.flatnonzero(valid)
+                    seg2 = seg_ids[idx2]
+                    starts2 = kernels.run_boundaries(
+                        seg2, batch.sid_ordinal[idx2])
+                    r = kernels.run_segment_partials(
+                        dev_vals[idx2], seg2, starts2, num_segments,
+                        {**wants, "want_count": True},
+                        ts=batch.ts[idx2] if need_ts else None)
+                col_results[cname] = r
+                continue
+            # --------------------------- rank/scatter fallback kernels
             if sel_idx is not None:
                 # compressed path: gather selected rows once per column
                 v_sel = dev_vals[sel_idx]
@@ -429,12 +538,18 @@ def _assemble(batch, query, presence, present, col_results, group_labels,
             out_cols[a.alias] = unbias(v) if unsigned else v
             out_valid[a.alias] = have
             # hidden timestamp of the selected row: lets a coordinator merge
-            # first/last partials across vnodes by actual time order
-            rk = r.get(f"{a.func}_rank")
-            if rk is not None and needs_rank:
-                sorted_ts = _sorted_ts(batch, order)
-                ranks = np.clip(rk[sel], 0, len(sorted_ts) - 1)
-                out_cols[a.alias + "__ts"] = sorted_ts[ranks]
+            # first/last partials across vnodes by actual time order. Run
+            # kernels return the timestamps directly; rank kernels return
+            # positions into the time-sorted order.
+            tsv = r.get(f"{a.func}_ts")
+            if tsv is not None:
+                out_cols[a.alias + "__ts"] = tsv[sel]
+            else:
+                rk = r.get(f"{a.func}_rank")
+                if rk is not None and needs_rank:
+                    sorted_ts = _sorted_ts(batch, order)
+                    ranks = np.clip(rk[sel], 0, len(sorted_ts) - 1)
+                    out_cols[a.alias + "__ts"] = sorted_ts[ranks]
     return AggResult(out_cols, len(sel), out_valid)
 
 
@@ -490,25 +605,79 @@ def _contains_is_null(e) -> bool:
     return False
 
 
-def _filter_env(batch: ScanBatch, needed: set | None = None) -> dict:
+def _ordered_within_series(batch: ScanBatch) -> bool:
+    """True when (a) timestamps are non-decreasing within every series run
+    AND (b) each series occupies exactly one contiguous run — the storage
+    layout guarantees both for scan batches; synthetic batches are checked
+    once and the result cached. Run-kernel first/last depend on both:
+    without (b), filter/null compression can join two chunks of a
+    recurring series into one run whose timestamps jump backwards at the
+    seam, and run endpoints stop being the time extremes (sum/count/
+    min/max never depend on either)."""
+    cached = getattr(batch, "_ordered_ws", None)
+    if cached is None:
+        if batch.n_rows <= 1:
+            cached = True
+        else:
+            changes = np.diff(batch.sid_ordinal) != 0
+            ok = (np.diff(batch.ts) >= 0) | changes
+            cached = bool(ok.all()) and \
+                int(changes.sum()) + 1 == len(np.unique(batch.sid_ordinal))
+        batch._ordered_ws = cached
+    return cached
+
+
+def _eval_filter_on_rows(batch: ScanBatch, flt: Expr,
+                         idx: np.ndarray) -> np.ndarray:
+    """Evaluate `flt` over the candidate rows only (zone-map pruning) —
+    same semantics as the full-scan path sans IS NULL (callers exclude
+    it): missing columns match nothing, a NULL field operand excludes the
+    row. → selected row indices (subset of idx, ascending). Shares
+    _filter_env so both paths build identical environments."""
+    cols = flt.columns()
+    env = _filter_env(batch, needed=cols, rows=idx)
+    if any(c not in env for c in cols):
+        return idx[:0]   # all-NULL column: comparisons match nothing
+    mask = np.asarray(flt.eval(env, np), dtype=bool)
+    if mask.shape == ():
+        return idx if bool(mask) else idx[:0]
+    for c in cols:
+        v = env.get(f"__valid__:{c}")
+        if v is not None and not v.all():
+            mask &= v
+    return idx[np.flatnonzero(mask)]
+
+
+def _filter_env(batch: ScanBatch, needed: set | None = None,
+                rows: np.ndarray | None = None) -> dict:
     """Filter-evaluation env. `needed` restricts which columns materialize:
     per-row tag expansion builds 10M-element OBJECT arrays, so only tags
-    the filter actually references are worth paying for."""
-    env: dict = {"time": batch.ts}
+    the filter actually references are worth paying for. With `rows`, all
+    entries are gathered to that index subset (zone-map candidate rows) —
+    one construction path for both the full-scan and pruned evaluations."""
+    def sub(a):
+        return a if rows is None else a[rows]
+
+    env: dict = {"time": sub(batch.ts)}
     for name, (vt, vals, valid) in batch.fields.items():
-        env[name] = vals
-        env[f"__valid__:{name}"] = valid
+        if rows is not None and needed is not None and name not in needed:
+            continue   # gathers cost O(rows); skip unreferenced fields
+        env[name] = sub(vals)
+        env[f"__valid__:{name}"] = sub(valid)
     tag_names = set()
     for k in batch.series_keys:
         if k is not None:
             tag_names.update(t.key for t in k.tags)
     if needed is not None:
         tag_names &= needed
+    sid = None
     for t in tag_names:
         per_series = np.array(
             [(k.tag_value(t) if k is not None else None) for k in batch.series_keys],
             dtype=object)
-        env[t] = per_series[batch.sid_ordinal]
+        if sid is None:
+            sid = sub(batch.sid_ordinal)
+        env[t] = per_series[sid]
     return env
 
 

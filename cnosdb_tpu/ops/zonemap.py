@@ -1,0 +1,153 @@
+"""Block min/max zone maps: data skipping for selective filters.
+
+The host-side twin of the reference's page-statistics pruning
+(tskv/src/reader/column_group/statistics.rs prunes ChunkReader pages by
+PageMeta min/max): the scan batch is split into fixed blocks, each
+column's per-block [min, max] is computed once and cached on the batch,
+and a filter's conservative tri-state evaluation over those intervals
+prunes blocks no row of which can match. The predicate is then evaluated
+only over candidate-block rows — a selective filter touches O(matching
+blocks) instead of O(n).
+
+Conservativeness: invalid rows' slot values can only WIDEN a block's
+interval (never narrow it), and NaNs are excluded via fmin/fmax, so a
+pruned block provably contains no matching valid row.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..models.schema import ValueType
+from ..sql.expr import Between, BinOp, Column, InList, Literal
+
+BLOCK = 8192
+
+
+def _numeric(v) -> bool:
+    return isinstance(v, (int, float, np.integer, np.floating)) \
+        and not isinstance(v, bool)
+
+
+def zone_stats(batch, cname: str):
+    """Per-block (min, max) for a numeric field column or 'time', cached
+    on the batch (one sequential pass, amortized across queries)."""
+    cache = getattr(batch, "_zone_cache", None)
+    if cache is None:
+        cache = batch._zone_cache = {}
+    hit = cache.get(cname)
+    if hit is None:
+        if cname == "time":
+            vals = batch.ts
+        else:
+            vt, vals, _valid = batch.fields[cname]
+            if vt in (ValueType.STRING, ValueType.GEOMETRY):
+                return None
+        starts = np.arange(0, len(vals), BLOCK)
+        if vals.dtype.kind == "f":
+            # fmin/fmax skip NaNs: a NaN row can never satisfy a
+            # comparison, and letting it poison the interval would prune
+            # blocks whose OTHER rows match
+            bmin = np.fmin.reduceat(vals, starts)
+            bmax = np.fmax.reduceat(vals, starts)
+        else:
+            bmin = np.minimum.reduceat(vals, starts)
+            bmax = np.maximum.reduceat(vals, starts)
+        hit = cache[cname] = (bmin, bmax)
+    return hit
+
+
+def _col_name(e, batch) -> str | None:
+    """Column usable for zone evaluation: a numeric field or time."""
+    if not isinstance(e, Column):
+        return None
+    if e.name == "time":
+        return e.name
+    f = batch.fields.get(e.name)
+    if f is None or f[0] in (ValueType.STRING, ValueType.GEOMETRY):
+        return None
+    return e.name
+
+
+def possible_blocks(e, batch) -> np.ndarray | None:
+    """Conservative per-block match possibility for the filter tree, or
+    None when any reachable leaf is outside the supported forms (the
+    caller then evaluates the filter over every row as before)."""
+    if isinstance(e, BinOp):
+        if e.op in ("and", "or"):
+            a = possible_blocks(e.left, batch)
+            b = possible_blocks(e.right, batch)
+            if e.op == "and":
+                # one evaluable side suffices: AND can only shrink
+                if a is None:
+                    return b
+                if b is None:
+                    return a
+                return a & b
+            if a is None or b is None:
+                return None
+            return a | b
+        if e.op in ("=", "!=", "<", "<=", ">", ">="):
+            col, lit = None, None
+            if isinstance(e.right, Literal):
+                col, lit, op = _col_name(e.left, batch), e.right.value, e.op
+            elif isinstance(e.left, Literal):
+                flip = {"<": ">", "<=": ">=", ">": "<", ">=": "<=",
+                        "=": "=", "!=": "!="}
+                col, lit, op = _col_name(e.right, batch), e.left.value, \
+                    flip[e.op]
+            if col is None or not _numeric(lit):
+                return None
+            st = zone_stats(batch, col)
+            if st is None:
+                return None
+            bmin, bmax = st
+            if op == ">":
+                return bmax > lit
+            if op == ">=":
+                return bmax >= lit
+            if op == "<":
+                return bmin < lit
+            if op == "<=":
+                return bmin <= lit
+            if op == "=":
+                return (bmin <= lit) & (bmax >= lit)
+            # '!=': only a constant block equal to lit can be pruned
+            return ~((bmin == lit) & (bmax == lit))
+        return None
+    if isinstance(e, Between) and not e.negated:
+        col = _col_name(e.expr, batch)
+        if col is None or not isinstance(e.low, Literal) \
+                or not isinstance(e.high, Literal) \
+                or not _numeric(e.low.value) or not _numeric(e.high.value):
+            return None
+        st = zone_stats(batch, col)
+        if st is None:
+            return None
+        bmin, bmax = st
+        return (bmax >= e.low.value) & (bmin <= e.high.value)
+    if isinstance(e, InList) and not e.negated:
+        col = _col_name(e.expr, batch)
+        if col is None or not e.values \
+                or not all(_numeric(v) for v in e.values):
+            return None
+        st = zone_stats(batch, col)
+        if st is None:
+            return None
+        bmin, bmax = st
+        m = np.zeros(len(bmin), dtype=bool)
+        for v in e.values:
+            m |= (bmin <= v) & (bmax >= v)
+        return m
+    return None
+
+
+def candidate_rows(blocks: np.ndarray, n: int) -> np.ndarray:
+    """Row indices (ascending) of the possible blocks."""
+    cand = np.flatnonzero(blocks)
+    if len(cand) == 0:
+        return np.zeros(0, dtype=np.int64)
+    idx = (cand[:, None] * BLOCK
+           + np.arange(BLOCK, dtype=np.int64)).ravel()
+    if idx[-1] >= n:
+        idx = idx[idx < n]
+    return idx

@@ -175,3 +175,28 @@ def test_empty_group_not_emitted(vnode):
     r = execute_scan_aggregate(b, q)
     assert r.n_rows == 1
     assert r.columns["host"][0] == "h0"
+
+
+def test_first_last_recurring_series_falls_back_to_rank():
+    """A series that recurs non-contiguously (synthetic batches only; the
+    storage scan always emits one contiguous run per series) must NOT use
+    run-endpoint first/last: filter compression would join the two chunks
+    into one run whose timestamps jump backwards at the seam."""
+    from cnosdb_tpu.storage.scan import ScanBatch
+
+    sid = np.array([0, 0, 1, 1, 0, 0], dtype=np.int32)
+    ts = np.array([100, 110, 5, 6, 50, 60], dtype=np.int64)
+    vals = np.array([1.0, 2.0, 9.0, 9.5, 3.0, 4.0])
+    batch = ScanBatch(
+        "m", np.array([10, 11], dtype=np.uint64),
+        [SeriesKey("m", {"host": "a"}), SeriesKey("m", {"host": "b"})],
+        ts, sid,
+        {"v": (ValueType.FLOAT, vals, np.ones(6, dtype=bool))})
+    # filter drops the series-1 rows → series-0 chunks become adjacent
+    q = TpuQuery(filter=BinOp("<", Column("v"), Literal(5.0)),
+                 aggs=[AggSpec("first", "v", "f"),
+                       AggSpec("last", "v", "l")])
+    res = execute_scan_aggregate(batch, q)
+    # first = value at min ts (ts=50 → 3.0), last = at max ts (110 → 2.0)
+    assert res.columns["f"][0] == 3.0, res.columns
+    assert res.columns["l"][0] == 2.0, res.columns
