@@ -22,14 +22,43 @@ from .series import SeriesKey, Tag
 
 @dataclass
 class SeriesRows:
-    """Rows of one series: parallel arrays, may be unsorted in time."""
+    """Rows of one series: parallel arrays, may be unsorted in time.
+
+    `timestamps` is a list[int] OR an np.int64 array; each field's values
+    are a list (None = missing at that row) OR a typed numpy array, which
+    asserts every row is present. Array form is the fast ingest path —
+    it stays zero-copy through WAL encode (raw bytes) and memcache."""
 
     key: SeriesKey
-    timestamps: list[int]
-    fields: dict[str, tuple[int, list]]  # name → (ValueType, values; None=missing)
+    timestamps: list[int] | np.ndarray
+    fields: dict[str, tuple[int, list | np.ndarray]]  # name → (ValueType, values)
 
     def n_rows(self) -> int:
         return len(self.timestamps)
+
+
+def ts_bounds(col) -> tuple[int, int]:
+    """(min, max) of a timestamp column in either accepted representation
+    (list[int] or np.int64 array); callers must ensure it is non-empty."""
+    if isinstance(col, np.ndarray):
+        return int(col.min()), int(col.max())
+    return min(col), max(col)
+
+
+def _enc_col(vals):
+    """msgpack form of a column: numeric ndarray → tagged raw bytes
+    (C-speed both ways), anything else → list."""
+    if isinstance(vals, np.ndarray) and vals.dtype != object:
+        return {"__nd__": vals.dtype.str, "b": vals.tobytes()}
+    if isinstance(vals, np.ndarray):
+        return vals.tolist()
+    return vals
+
+
+def _dec_col(v):
+    if isinstance(v, dict):
+        return np.frombuffer(v["b"], dtype=np.dtype(v["__nd__"]))
+    return list(v)
 
 
 @dataclass
@@ -49,8 +78,8 @@ class WriteBatch:
         obj = {}
         for table, srs in self.tables.items():
             obj[table] = [
-                [sr.key.encode(), sr.timestamps,
-                 {k: [vt, vals] for k, (vt, vals) in sr.fields.items()}]
+                [sr.key.encode(), _enc_col(sr.timestamps),
+                 {k: [vt, _enc_col(vals)] for k, (vt, vals) in sr.fields.items()}]
                 for sr in srs
             ]
         return msgpack.packb(obj, use_bin_type=True)
@@ -62,8 +91,8 @@ class WriteBatch:
         for table, srs in obj.items():
             for key_b, ts, fields in srs:
                 wb.add_series(table, SeriesRows(
-                    SeriesKey.decode(key_b), list(ts),
-                    {k: (int(v[0]), list(v[1])) for k, v in fields.items()}))
+                    SeriesKey.decode(key_b), _dec_col(ts),
+                    {k: (int(v[0]), _dec_col(v[1])) for k, v in fields.items()}))
         return wb
 
     # -- convenience builder (tests, SQL INSERT path) --------------------

@@ -129,27 +129,35 @@ class Coordinator:
     def _split_series_by_bucket(self, tenant: str, db: str, sr: SeriesRows):
         """A series' rows can straddle buckets; split rows by bucket then
         route to `shard = hash % shard_num` within each."""
+        from ..models.points import ts_bounds
+
         h = sr.key.hash_id()
-        if not sr.timestamps:
+        if not len(sr.timestamps):
             return []
         # fast path: whole series fits one bucket (the common case)
-        lo, hi = min(sr.timestamps), max(sr.timestamps)
+        lo, hi = ts_bounds(sr.timestamps)
         b_lo = self.meta.locate_bucket_for_write(tenant, db, lo)
         if b_lo.contains(hi):
             return [(b_lo.vnode_for(h), sr)]
         rs_rows: dict[int, tuple[object, list[int]]] = {}
         for i, ts in enumerate(sr.timestamps):
-            bucket = self.meta.locate_bucket_for_write(tenant, db, ts)
+            bucket = self.meta.locate_bucket_for_write(tenant, db, int(ts))
             rs = bucket.vnode_for(h)
             rs_rows.setdefault(rs.id, (rs, []))[1].append(i)
+
+        def take(col, idxs):
+            if isinstance(col, np.ndarray):
+                return col[np.asarray(idxs, dtype=np.int64)]
+            return [col[i] for i in idxs]
+
         out = []
         for rs, idxs in rs_rows.values():
             if len(idxs) == len(sr.timestamps):
                 out.append((rs, sr))
             else:
                 sub = SeriesRows(
-                    sr.key, [sr.timestamps[i] for i in idxs],
-                    {k: (vt, [vals[i] for i in idxs])
+                    sr.key, take(sr.timestamps, idxs),
+                    {k: (vt, take(vals, idxs))
                      for k, (vt, vals) in sr.fields.items()})
                 out.append((rs, sub))
         return out

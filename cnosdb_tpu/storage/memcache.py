@@ -44,9 +44,16 @@ class SeriesData:
         Sorts by time. Duplicate timestamps merge PER FIELD: each field
         takes its latest non-missing value across the duplicate rows
         (reference memcache RowData::extend — a later partial row overrides
-        only the fields it carries).
+        only the fields it carries). Typed-array and None-free list chunks
+        materialize fully vectorized; only chunks actually carrying Nones
+        pay a per-element pass.
         """
-        ts = np.array([t for c in self.ts_chunks for t in c], dtype=np.int64)
+        if len(self.ts_chunks) == 1:
+            ts = np.asarray(self.ts_chunks[0], dtype=np.int64)
+        else:
+            ts = np.concatenate(
+                [np.asarray(c, dtype=np.int64) for c in self.ts_chunks]) \
+                if self.ts_chunks else np.empty(0, dtype=np.int64)
         n = len(ts)
         order = np.argsort(ts, kind="stable")  # stable: append order within ties
         ts_sorted = ts[order]
@@ -56,13 +63,24 @@ class SeriesData:
         idx = np.arange(n, dtype=np.int64)
         for name, chunks in self.field_chunks.items():
             vt = ValueType(chunks[0][1])
-            vals_full = np.empty(n, dtype=object)
+            np_dtype = vt.numpy_dtype()
+            typed = np_dtype is not object
+            vals_full = (np.zeros(n, dtype=np_dtype) if typed
+                         else np.empty(n, dtype=object))
             valid_full = np.zeros(n, dtype=bool)
             for off, _vt, vals in chunks:
-                for i, v in enumerate(vals):
-                    if v is not None:
-                        vals_full[off + i] = v
-                        valid_full[off + i] = True
+                m = len(vals)
+                if typed and isinstance(vals, np.ndarray):
+                    vals_full[off:off + m] = vals
+                    valid_full[off:off + m] = True
+                elif typed and None not in vals:
+                    vals_full[off:off + m] = np.asarray(vals, dtype=np_dtype)
+                    valid_full[off:off + m] = True
+                else:
+                    for i, v in enumerate(vals):
+                        if v is not None:
+                            vals_full[off + i] = v
+                            valid_full[off + i] = True
             vals_s = vals_full[order]
             valid_s = valid_full[order]
             # per-group index of last valid row (-1 if none), vectorized
@@ -71,17 +89,18 @@ class SeriesData:
             valid_out = last_valid >= 0
             gather = np.clip(last_valid, 0, None)
             vals_out = vals_s[gather]
-            out_fields[name] = (vt, _typed_array(vals_out, valid_out, vt), valid_out)
+            if not typed:
+                vals_out = _typed_array(vals_out, valid_out, vt)
+            out_fields[name] = (vt, vals_out, valid_out)
         return uts, out_fields, order
 
     def time_range(self) -> tuple[int, int]:
         lo, hi = 2**63 - 1, -(2**63)
         for c in self.ts_chunks:
-            for t in c:
-                if t < lo:
-                    lo = t
-                if t > hi:
-                    hi = t
+            a = np.asarray(c, dtype=np.int64)
+            if len(a):
+                lo = min(lo, int(a.min()))
+                hi = max(hi, int(a.max()))
         return lo, hi
 
 
@@ -135,8 +154,10 @@ class MemCache:
         if self.min_seq is None:
             self.min_seq = seq
         self.max_seq = max(self.max_seq, seq)
-        if sr.timestamps:
-            lo, hi = min(sr.timestamps), max(sr.timestamps)
+        if len(sr.timestamps):
+            from ..models.points import ts_bounds
+
+            lo, hi = ts_bounds(sr.timestamps)
             self.min_ts = min(self.min_ts, lo)
             self.max_ts = max(self.max_ts, hi)
 

@@ -146,3 +146,32 @@ def test_tag_values_and_series_keys(cluster):
     assert coord.tag_values(DEFAULT_TENANT, "public", "cpu", "host") == ["a", "b", "c"]
     keys = coord.series_keys(DEFAULT_TENANT, "public", "cpu")
     assert [k.tag_value("host") for k in keys] == ["a", "b", "c"]
+
+
+def test_multi_bucket_split_array_native(cluster):
+    """Array-form SeriesRows straddling a bucket boundary: the fancy-index
+    take() path must route rows identically to the list path."""
+    meta, engine, coord = cluster
+    meta.create_database(DatabaseSchema(
+        DEFAULT_TENANT, "db3",
+        DatabaseOptions(vnode_duration=Duration.parse("1d"))))
+    ts = np.array([5, DAY + 7, 2 * DAY + 9, 2 * DAY + 11], dtype=np.int64)
+    vals = np.array([1.0, 2.0, 3.0, 4.0])
+    wb = WriteBatch()
+    wb.add_series("cpu", SeriesRows(
+        SeriesKey("cpu", {"host": "ha"}), ts,
+        {"usage": (int(ValueType.FLOAT), vals)}))
+    coord.write_points(DEFAULT_TENANT, "db3", wb)
+    assert len(meta.buckets_for(DEFAULT_TENANT, "db3")) == 3
+    batches = coord.scan_table(DEFAULT_TENANT, "db3", "cpu")
+    assert sum(b.n_rows for b in batches) == 4
+    got = sorted((int(t), float(v))
+                 for b in batches
+                 for t, v in zip(b.ts, b.fields["usage"][1]))
+    assert got == [(5, 1.0), (DAY + 7, 2.0),
+                   (2 * DAY + 9, 3.0), (2 * DAY + 11, 4.0)]
+    # day-2 bucket alone holds the two straddled-off rows
+    batches = coord.scan_table(
+        DEFAULT_TENANT, "db3", "cpu",
+        time_ranges=TimeRanges([TimeRange(2 * DAY, 3 * DAY - 1)]))
+    assert sum(b.n_rows for b in batches) == 2

@@ -238,3 +238,36 @@ def test_meta_tenant_membership(tmp_path):
     assert m2.check_user("alice", "pw") is not None
     m.remove_member("acme", "alice")
     assert not m.user_can_access("alice", "acme")
+
+
+def test_writebatch_array_native_roundtrip():
+    """Array-native SeriesRows (the fast ingest path) must round-trip the
+    WAL/RPC encoding bit-exactly and interoperate with list-form rows."""
+    import numpy as np
+
+    from cnosdb_tpu.models.points import SeriesRows, WriteBatch
+    from cnosdb_tpu.models.schema import ValueType
+    from cnosdb_tpu.models.series import SeriesKey
+
+    ts = np.arange(5, dtype=np.int64) * 1_000_000_000
+    vals = np.array([1.5, 2.5, -3.0, np.nan, 0.0])
+    ints = np.array([1, -2, 3, 4, 5], dtype=np.int64)
+    wb = WriteBatch()
+    wb.add_series("m", SeriesRows(
+        SeriesKey("m", {"h": "a"}), ts,
+        {"f": (int(ValueType.FLOAT), vals),
+         "i": (int(ValueType.INTEGER), ints)}))
+    # list-form with a None rides alongside unchanged
+    wb.add_series("m", SeriesRows(
+        SeriesKey("m", {"h": "b"}), [10, 20],
+        {"f": (int(ValueType.FLOAT), [7.0, None])}))
+    out = WriteBatch.decode(wb.encode())
+    srs = out.tables["m"]
+    a, b = srs[0], srs[1]
+    np.testing.assert_array_equal(np.asarray(a.timestamps), ts)
+    got_f = np.asarray(a.fields["f"][1])
+    assert got_f.dtype == np.float64
+    np.testing.assert_array_equal(got_f, vals)  # NaN-exact
+    np.testing.assert_array_equal(np.asarray(a.fields["i"][1]), ints)
+    assert list(b.timestamps) == [10, 20]
+    assert b.fields["f"][1] == [7.0, None]
