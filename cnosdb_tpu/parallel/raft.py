@@ -368,7 +368,8 @@ class HttpTransport(Transport):
         return r.get("reply")
 
 
-RAFT_BLANK = 5  # WalEntryType.RAFT_BLANK
+RAFT_BLANK = 5       # WalEntryType.RAFT_BLANK
+RAFT_MEMBERSHIP = 6  # WalEntryType.RAFT_MEMBERSHIP — config-change entries
 
 
 class RaftNode:
@@ -573,6 +574,85 @@ class RaftNode:
         self.match_index[self.node_id] = idx
         return idx
 
+    # ------------------------------------------------------------ membership
+    def change_membership(self, member_ids: list[int],
+                          timeout: float = 10.0) -> int:
+        """Single-step voter add/remove (reference raft/manager.rs
+        add_follower/remove via openraft change_membership). Leader-only.
+
+        The new configuration takes effect at APPEND time on every node
+        that stores the entry (raft §6: for one-server deltas the old and
+        new majorities always overlap, so append-time adoption is safe).
+        Blocks until the entry commits under the NEW configuration.
+
+        Removing the current leader itself is rejected — the commit
+        counter includes self; callers stepdown() first and re-issue on
+        the new leader."""
+        import msgpack as _mp
+
+        with self.lock:
+            if self.role != Role.LEADER:
+                raise NotLeader(self.leader_id)
+            new = sorted({int(p) for p in member_ids})
+            cur = sorted({*self.peers, self.node_id})
+            delta = set(new) ^ set(cur)
+            if not delta:
+                return self.commit_index
+            if len(delta) > 1:
+                raise ReplicationError(
+                    f"membership changes one server at a time "
+                    f"(current {cur}, requested {new})")
+            if self.node_id not in new:
+                raise ReplicationError(
+                    "cannot remove the current leader: transfer leadership "
+                    "first (stepdown), then remove via the new leader")
+            data = _mp.packb({"members": new}, use_bin_type=True)
+            idx = self._append_local(RAFT_MEMBERSHIP, data)
+            term = self.term
+            self._adopt_membership(new)
+        self._broadcast_append()
+        deadline = time.monotonic() + timeout
+        with self._apply_cv:
+            while self.last_applied < idx:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise ReplicationError("membership change timeout",
+                                           index=idx)
+                self._apply_cv.wait(remaining)
+        with self.lock:
+            e = self.log.entry_at(idx)
+        if e is not None and e.term != term:
+            raise ReplicationError(
+                "membership change superseded after leadership change",
+                index=idx)
+        return idx
+
+    def _adopt_membership(self, member_ids: list[int]) -> None:
+        """Install a configuration (list of member ids incl. self if still
+        a member). Caller holds self.lock."""
+        self.peers = [p for p in member_ids if p != self.node_id]
+        last = self.log.last_index()
+        for p in self.peers:
+            self.next_index.setdefault(p, last + 1)
+            self.match_index.setdefault(p, 0)
+        for p in list(self.next_index):
+            if p not in self.peers:
+                del self.next_index[p]
+        for p in list(self.match_index):
+            if p != self.node_id and p not in self.peers:
+                del self.match_index[p]
+
+    def stepdown(self) -> None:
+        """Voluntarily yield leadership: revert to follower and push this
+        node's own election deadline far out so a peer campaigns first
+        (used before removing the leader member, and by MOVE VNODE)."""
+        with self.lock:
+            if self.role == Role.LEADER:
+                self.role = Role.FOLLOWER
+                self.leader_id = None
+                lo, hi = self.election_timeout
+                self._election_deadline = time.monotonic() + 4 * hi
+
     # ------------------------------------------------------------ replication
     def _broadcast_append(self):
         """Send to all peers CONCURRENTLY: one slow/unreachable peer (packet
@@ -702,7 +782,7 @@ class RaftNode:
             if e is None:
                 break
             with self._sm_lock:
-                if e.entry_type != RAFT_BLANK:
+                if e.entry_type not in (RAFT_BLANK, RAFT_MEMBERSHIP):
                     try:
                         self.sm.apply(e)
                     except Exception as exc:
@@ -776,6 +856,12 @@ class RaftNode:
                     existing = None
                 if existing is None:
                     self.log.append(e)
+                    if e.entry_type == RAFT_MEMBERSHIP:
+                        # configuration applies as soon as it is stored
+                        import msgpack as _mp
+
+                        self._adopt_membership(
+                            _mp.unpackb(e.data, raw=False)["members"])
             if msg["leader_commit"] > self.commit_index:
                 self.commit_index = min(msg["leader_commit"],
                                         self.log.last_index())

@@ -236,5 +236,44 @@ class ReplicaGroupManager:
             self.engine.open_vnode(owner, leader.node_id).wal.sync()
         return idx
 
+    # ------------------------------------------------------------ membership
+    def change_membership_local(self, owner: str, rs: ReplicationSet,
+                                member_ids: list[int],
+                                timeout: float = 10.0) -> int:
+        """Single-step config change via a LOCAL leader member; raises
+        NotLeader(hint) when no member on this node leads the group (the
+        coordinator then forwards to the leader's node). `rs` is the
+        CURRENT placement (pre- or post-change both work: peer resolution
+        uses meta placement, the raft config rides the log entry)."""
+        nodes = self.get_or_build(owner, rs)
+        leader = next((n for n in nodes.values() if n.is_leader()), None)
+        if leader is None:
+            raise NotLeader(self.leader_hint(owner, rs))
+        return leader.change_membership(member_ids, timeout=timeout)
+
+    def stepdown_local(self, owner: str, rs: ReplicationSet,
+                       vnode_id: int) -> bool:
+        """Ask a local member to yield leadership (pre-removal of the
+        leader member). → True if it was leader and stepped down."""
+        gid = self.group_id(owner, rs)
+        node = self.transport.nodes.get((gid, vnode_id))
+        if node is None or not node.is_leader():
+            return False
+        node.stepdown()
+        return True
+
+    def member_progress(self, owner: str, rs: ReplicationSet,
+                        vnode_id: int) -> tuple[int, int] | None:
+        """(match_index, commit_index) of `vnode_id` as seen by a LOCAL
+        leader — the catch-up gauge for REPLICA ADD. None when this node
+        does not lead the group."""
+        nodes = self.get_or_build(owner, rs)
+        leader = next((n for n in nodes.values() if n.is_leader()), None)
+        if leader is None:
+            return None
+        if vnode_id == leader.node_id:
+            return leader.log.last_index(), leader.commit_index
+        return leader.match_index.get(vnode_id, 0), leader.commit_index
+
     def stop(self):
         self.multi.stop_all()

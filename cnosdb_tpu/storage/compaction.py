@@ -37,11 +37,21 @@ class Picker:
     def __init__(self, l0_trigger: int = 4,
                  level_base_size: int = 256 * 1024 * 1024,
                  level_size_multiplier: int = 4,
-                 max_compact_files: int = 8):
+                 max_compact_files: int = 8,
+                 max_output_file_size: int = 128 * 1024 * 1024):
         self.l0_trigger = l0_trigger
         self.level_base_size = level_base_size
         self.level_size_multiplier = level_size_multiplier
         self.max_compact_files = max_compact_files
+        # bound per-output-file size (reference kv_option.rs:56-59
+        # level_max_file_size): merges split into time-partitioned files so
+        # later L0→L1 rounds rewrite only the overlapping window, not one
+        # ever-growing level file (O(n²) write amplification otherwise)
+        self.max_output_file_size = max_output_file_size
+        # L0 files at least this big skip the merge rewrite entirely and
+        # promote to L1 by metadata (a healthy flush is one of these; only
+        # dribble-sized tails are worth physically combining)
+        self.promote_file_size = max(1 << 20, max_output_file_size // 32)
 
     def level_max_size(self, level: int) -> int:
         return self.level_base_size * (self.level_size_multiplier ** max(0, level - 1))
@@ -51,29 +61,106 @@ class Picker:
         l0 = sorted(version.levels[0].values(), key=lambda f: f.file_id)
         if len(l0) >= self.l0_trigger:
             picked = l0[:self.max_compact_files]
-            lo = min(f.min_ts for f in picked)
-            hi = max(f.max_ts for f in picked)
-            overlapped = [f for f in version.levels[1].values() if f.overlaps(lo, hi)]
-            return CompactReq(picked + overlapped[: self.max_compact_files], 1)
+            return CompactReq(
+                picked + self._include_overlap(version, 1, picked), 1)
         # level compaction: size overflow spills oldest files upward
         for level in range(1, MAX_LEVEL):
             if version.level_size(level) > self.level_max_size(level):
                 files = sorted(version.levels[level].values(), key=lambda f: f.file_id)
                 picked = files[: self.max_compact_files]
-                lo = min(f.min_ts for f in picked)
-                hi = max(f.max_ts for f in picked)
-                overlapped = [f for f in version.levels[level + 1].values()
-                              if f.overlaps(lo, hi)][: self.max_compact_files]
-                return CompactReq(picked + overlapped, level + 1)
+                return CompactReq(
+                    picked + self._include_overlap(version, level + 1, picked),
+                    level + 1)
         return None
+
+    def pick_promotions(self, version: Version) \
+            -> list[tuple[FileMeta, int]]:
+        """Files that can move one level up by METADATA ONLY (zero bytes
+        re-encoded): flush-sized L0 files, and oldest files of an
+        over-budget level.
+
+        Order-preservation rules (dedup priority is level-then-file_id):
+        - oldest-first PREFIX of the source level only — everything left
+          behind must be newer than everything promoted;
+        - promoted id must exceed every id at the TARGET level, so the
+          moved rows keep outranking the data they outranked before (a
+          rewrite-merge output at the target could otherwise carry a
+          newer id than data that is logically older).
+        Rewrites during steady bulk load thus reduce to flush + one final
+        major pass; the mid-load level cascade is pointer moves."""
+        # L0 → L1: flush-sized files skip the merge entirely
+        max1 = max(version.levels[1], default=0)
+        out = []
+        for f in sorted(version.levels[0].values(), key=lambda x: x.file_id):
+            if f.size >= self.promote_file_size and f.file_id > max1:
+                out.append((f, 1))
+            else:
+                break
+        if out:
+            return out
+        # over-budget level: move the oldest files up until under budget
+        for level in range(1, MAX_LEVEL):
+            excess = version.level_size(level) - self.level_max_size(level)
+            if excess <= 0:
+                continue
+            max_t = max(version.levels[level + 1], default=0)
+            for f in sorted(version.levels[level].values(),
+                            key=lambda x: x.file_id):
+                if f.file_id <= max_t:
+                    break
+                out.append((f, level + 1))
+                max_t = f.file_id
+                excess -= f.size
+                if excess <= 0:
+                    break
+            if out:
+                return out
+        return out
+
+    def _include_overlap(self, version: Version, target: int,
+                         picked: list[FileMeta]) -> list[FileMeta]:
+        """Target-level files to rewrite alongside `picked` — ALL of the
+        overlapping ones, or NONE.
+
+        All-or-none is a correctness rule: dedup priority within a level
+        is ascending file_id, so merging only SOME overlapping files would
+        launder old rows into a new (highest) file_id and flip
+        last-write-wins against the excluded files. None (tiering: the
+        output lands as overlapping time-split files, ordered by id) is
+        chosen when the overlap is big relative to the picked set —
+        series-major ingest otherwise rewrites the whole level on every
+        round, O(n²) write amplification (the reference bounds this the
+        same way via level_max_file_size + picker cost heuristics)."""
+        lo = min(f.min_ts for f in picked)
+        hi = max(f.max_ts for f in picked)
+        overlapped = [f for f in version.levels[target].values()
+                      if f.overlaps(lo, hi)]
+        if not overlapped:
+            return []
+        picked_sz = sum(f.size for f in picked)
+        if sum(f.size for f in overlapped) > 2 * max(picked_sz, 1) \
+                or len(overlapped) > self.max_compact_files:
+            return []
+        return overlapped
 
 
 # ---------------------------------------------------------------------------
 # merge executor
 # ---------------------------------------------------------------------------
-def run_compaction(version: Version, req: CompactReq, out_file_id: int) -> VersionEdit | None:
-    """Merge req.files → one file at req.target_level; returns the edit
-    (caller applies it via Summary). Tombstoned rows are dropped for good."""
+def run_compaction(version: Version, req: CompactReq, out_file_id: int,
+                   alloc_id=None,
+                   max_out_bytes: int = 0) -> VersionEdit | None:
+    """Merge req.files → time-partitioned file(s) at req.target_level;
+    returns the edit (caller applies it via Summary). Tombstoned rows are
+    dropped for good.
+
+    With `alloc_id` (extra-file-id allocator) and `max_out_bytes` > 0 the
+    output splits into ceil(input_bytes / max_out_bytes) contiguous TIME
+    windows — files at the target level then cover disjoint ranges, so a
+    later merge over a narrow time window rewrites only the overlapping
+    files (the reference bounds per-level file size the same way,
+    kv_option.rs level_max_file_size; without the bound every L0 round
+    rewrites the whole level: O(n²) ingest amplification)."""
     # priority must match scan._series_parts: higher level = older data =
     # lower priority (L4..L1 then L0), ascending file_id within a level.
     # Readers/tombstones come from the Version caches; Version._apply evicts
@@ -82,11 +169,28 @@ def run_compaction(version: Version, req: CompactReq, out_file_id: int) -> Versi
                for fm in req.files]
     readers.sort(key=lambda t: (-t[0].level, t[0].file_id))
 
-    out_path_dir = "tsm" if req.target_level > 0 else "delta"
-    out_path = os.path.join(version.dir, out_path_dir, f"_{out_file_id:06d}.tsm")
-    os.makedirs(os.path.dirname(out_path), exist_ok=True)
-    w = TsmWriter(out_path)
-    wrote = False
+    lo = min(fm.min_ts for fm in req.files)
+    hi = max(fm.max_ts for fm in req.files)
+    n_out = 1
+    if alloc_id is not None and max_out_bytes > 0 and hi > lo:
+        total_bytes = sum(fm.size for fm in req.files)
+        n_out = int(max(1, min(64, -(-total_bytes // max_out_bytes))))
+    # window k covers [bounds[k], bounds[k+1])
+    bounds = [lo + (hi - lo + 1) * k // n_out for k in range(n_out + 1)]
+
+    out_dir = "tsm" if req.target_level > 0 else "delta"
+    writers: list[TsmWriter | None] = [None] * n_out
+    # pre-assign ids in WINDOW order (unused windows waste an id, which is
+    # harmless): output ids must ascend with time or pick_promotions'
+    # id-ordering rules would refuse to ever promote these files
+    fids: list[int] = [out_file_id] + [alloc_id() for _ in range(n_out - 1)]
+
+    def writer(k: int) -> TsmWriter:
+        if writers[k] is None:
+            path = os.path.join(version.dir, out_dir, f"_{fids[k]:06d}.tsm")
+            os.makedirs(os.path.dirname(path), exist_ok=True)
+            writers[k] = TsmWriter(path)
+        return writers[k]
 
     tables: list[str] = sorted({t for _, r, _ in readers for t in r.tables()})
     for table in tables:
@@ -98,21 +202,32 @@ def run_compaction(version: Version, req: CompactReq, out_file_id: int) -> Versi
             ts, cols = merged
             if len(ts) == 0:
                 continue
-            w.write_series(table, sid, ts, cols)
-            wrote = True
+            if n_out == 1:
+                writer(0).write_series(table, sid, ts, cols)
+                continue
+            cuts = np.searchsorted(ts, bounds[1:-1]).tolist()
+            prev = 0
+            for k, cut in enumerate(cuts + [len(ts)]):
+                if cut > prev:
+                    sliced = {
+                        name: (cid, vt, enc, vals[prev:cut],
+                               None if nm is None else nm[prev:cut])
+                        for name, (cid, vt, enc, vals, nm) in cols.items()}
+                    writer(k).write_series(table, sid, ts[prev:cut], sliced)
+                prev = cut
 
     edit_del = [fm.file_id for fm, _, _ in readers]
-    if not wrote:
-        w.abort()
-        edit = VersionEdit(del_files=edit_del)
-    else:
+    add_files = []
+    for k, w in enumerate(writers):
+        if w is None:
+            continue
         footer = w.finish()
-        fm_out = FileMeta(out_file_id, req.target_level, footer.min_ts,
-                          footer.max_ts, os.path.getsize(out_path),
-                          footer.series_count)
-        edit = VersionEdit(add_files=[fm_out], del_files=edit_del)
+        path = os.path.join(version.dir, out_dir, f"_{fids[k]:06d}.tsm")
+        add_files.append(FileMeta(fids[k], req.target_level, footer.min_ts,
+                                  footer.max_ts, os.path.getsize(path),
+                                  footer.series_count))
     # old tombstones die with their files (caller deletes files after apply)
-    return edit
+    return VersionEdit(add_files=add_files, del_files=edit_del)
 
 
 def _merge_series(table: str, sid: int, readers) -> tuple[np.ndarray, dict] | None:
@@ -151,11 +266,18 @@ def _merge_series(table: str, sid: int, readers) -> tuple[np.ndarray, dict] | No
     if total == 0:
         return None
     ts_all = np.concatenate(ts_parts)
-    order = np.argsort(ts_all, kind="stable")
-    ts_sorted = ts_all[order]
-    group_starts = _group_starts(ts_sorted)
-    uts = ts_sorted[group_starts]
-    idx = np.arange(total, dtype=np.int64)
+    # fast path: time-disjoint inputs in ascending order (the promotion
+    # chain's steady state — each flush covers a later window) need no
+    # sort and can hold no cross-part duplicates
+    presorted = len(ts_parts) == 1 or bool((ts_all[1:] > ts_all[:-1]).all())
+    if not presorted:
+        order = np.argsort(ts_all, kind="stable")
+        ts_sorted = ts_all[order]
+        group_starts = _group_starts(ts_sorted)
+        uts = ts_sorted[group_starts]
+        idx = np.arange(total, dtype=np.int64)
+    else:
+        uts = ts_all
     out_cols = {}
     for name, parts in col_parts.items():
         vt, enc, cid = col_types[name]
@@ -165,12 +287,15 @@ def _merge_series(table: str, sid: int, readers) -> tuple[np.ndarray, dict] | No
         for off, vals, valid in parts:
             vals_all[off:off + len(vals)] = vals
             valid_all[off:off + len(valid)] = valid
-        vals_s = vals_all[order]
-        valid_s = valid_all[order]
-        score = np.where(valid_s, idx, -1)
-        last_valid = np.maximum.reduceat(score, group_starts)
-        valid_out = last_valid >= 0
-        vals_out = vals_s[np.clip(last_valid, 0, None)]
+        if presorted:
+            vals_out, valid_out = vals_all, valid_all
+        else:
+            vals_s = vals_all[order]
+            valid_s = valid_all[order]
+            score = np.where(valid_s, idx, -1)
+            last_valid = np.maximum.reduceat(score, group_starts)
+            valid_out = last_valid >= 0
+            vals_out = vals_s[np.clip(last_valid, 0, None)]
         null_mask = None if valid_out.all() else ~valid_out
         out_cols[name] = (cid, vt, enc, vals_out, null_mask)
     return uts, out_cols

@@ -31,11 +31,15 @@ class TsKv:
         self.lock = threading.RLock()
         self.vnodes: dict[tuple[str, int], VnodeStorage] = {}
         self.schemas: dict[str, dict[str, TskvTableSchema]] = {}  # owner → tables
-        # one background worker drives compactions (reference CompactJob,
-        # compaction/job.rs) so merges never sit in the write path
+        # background workers drive compactions (reference CompactJob pool,
+        # compaction/job.rs max_concurrent_compaction) so merges never sit
+        # in the write path; per-vnode dedup + the vnode lock keep one
+        # merge per vnode, workers parallelize ACROSS vnodes
         from concurrent.futures import ThreadPoolExecutor
 
-        self._compactor = ThreadPoolExecutor(1, thread_name_prefix="compact")
+        workers = max(1, min(4, (os.cpu_count() or 1) - 1) or 1)
+        self._compactor = ThreadPoolExecutor(workers,
+                                             thread_name_prefix="compact")
         self._compact_pending: set[tuple[str, int]] = set()
 
     # ---------------------------------------------------------------- vnodes
@@ -105,8 +109,21 @@ class TsKv:
 
     def _maybe_schedule_compact(self, owner: str, vnode_id: int,
                                 v: VnodeStorage):
-        # cheap L0-count check inline; the merge itself runs on the worker
-        if len(v.summary.version.levels[0]) < v.picker.l0_trigger:
+        # cheap L0 check inline; the merge itself runs on the worker.
+        # Either enough small files piled up, or a flush-sized file is
+        # ready for the rewrite-free L1 promotion
+        version = v.summary.version
+        l0 = version.levels[0]
+        promo_ready = False
+        if l0:
+            # mirror pick_promotions' oldest-first prefix + id rule — a
+            # promote-sized file stuck behind a small older one must not
+            # resubmit a guaranteed-no-op job on every write
+            oldest = min(l0.values(), key=lambda f: f.file_id)
+            promo_ready = (oldest.size >= v.picker.promote_file_size
+                           and oldest.file_id
+                           > max(version.levels[1], default=0))
+        if len(l0) < v.picker.l0_trigger and not promo_ready:
             return
         key = (owner, vnode_id)
         with self.lock:
@@ -129,9 +146,10 @@ class TsKv:
                 v.flush(sync=sync)
 
     def compact_all(self):
+        """User-triggered COMPACT: full (major) compaction per vnode."""
         with self.lock:
             for v in self.vnodes.values():
-                v.compact_full()
+                v.compact_major()
 
     def drop_table(self, owner: str, table: str):
         for v in self.local_vnodes(owner):

@@ -147,15 +147,95 @@ class VnodeStorage:
     def compact(self, force_level: int | None = None) -> bool:
         """Run at most one compaction round; → True if work was done."""
         with self.lock:
+            if self._promote_l0():
+                return True
             req = self.picker.pick(self.summary.version)
             if req is None:
                 return False
             fid = self.summary.next_file_id()
-            edit = run_compaction(self.summary.version, req, fid)
+            edit = run_compaction(
+                self.summary.version, req, fid,
+                alloc_id=self.summary.next_file_id,
+                max_out_bytes=self.picker.max_output_file_size)
             if edit is None:
                 return False
             # bump only when the file set actually changes so no-op rounds
             # don't invalidate scan caches
+            self.data_version += 1
+            self.summary.apply(edit)
+            gc_compacted_files(self.summary.version, edit)
+            return True
+
+    def _promote_l0(self) -> bool:
+        """Rewrite-free level promotion (picker.pick_promotions): for
+        L0→L1, link the physical file into tsm/ and drop the delta link
+        (levels ≥1 share the tsm/ dir — a pure metadata flip). Crash-safe
+        in every window: before the edit lands the meta still says the
+        old level (its link intact, the new one is garbage for gc);
+        after, the new level's link is the live one."""
+        import dataclasses
+
+        from .tombstone import tombstone_path as _tb
+
+        version = self.summary.version
+        promos = self.picker.pick_promotions(version)
+        if not promos:
+            return False
+        adds = []
+        for fm, target in promos:
+            src = version.file_path(fm)
+            new = dataclasses.replace(fm, level=target)
+            dst = version.file_path(new)
+            if dst != src:
+                os.makedirs(os.path.dirname(dst), exist_ok=True)
+                if not os.path.exists(dst):
+                    os.link(src, dst)
+                if os.path.exists(_tb(src)) and not os.path.exists(_tb(dst)):
+                    os.link(_tb(src), _tb(dst))
+            adds.append(new)
+        self.data_version += 1
+        self.summary.apply(VersionEdit(
+            add_files=adds, del_files=[fm.file_id for fm, _ in promos]))
+        for fm, target in promos:
+            src = version.file_path(fm)   # path at the OLD level
+            new = dataclasses.replace(fm, level=target)
+            if version.file_path(new) == src:
+                continue
+            for p in (src, _tb(src)):
+                if os.path.exists(p):
+                    try:
+                        os.unlink(p)
+                    except OSError:
+                        pass
+        return True
+
+    def compact_major(self) -> bool:
+        """One-shot FULL compaction: merge every file of every level into
+        time-partitioned, size-bounded files at one level (reference user
+        COMPACT = full compaction). One pass over the data — unlike
+        looping normal rounds, which against heavily-overlapping tiered
+        levels would rewrite the tail repeatedly."""
+        from .compaction import CompactReq
+
+        with self.lock:
+            version = self.summary.version
+            files = [f for lvl in range(0, 5)
+                     for f in version.levels[lvl].values()]
+            if len(files) <= 1:
+                return False
+            total = sum(f.size for f in files)
+            # land everything at the smallest level whose budget holds it
+            target = 1
+            while target < 4 and total > self.picker.level_max_size(target):
+                target += 1
+            req = CompactReq(files, target)
+            fid = self.summary.next_file_id()
+            edit = run_compaction(
+                self.summary.version, req, fid,
+                alloc_id=self.summary.next_file_id,
+                max_out_bytes=self.picker.max_output_file_size)
+            if edit is None:
+                return False
             self.data_version += 1
             self.summary.apply(edit)
             gc_compacted_files(self.summary.version, edit)

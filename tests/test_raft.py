@@ -252,3 +252,92 @@ def test_chaos_loss_delay_reorder():
     finally:
         for n in nodes.values():
             n.stop()
+
+
+def test_membership_add_voter():
+    """Single-step add: a 4th member joins a live 3-node group via a
+    MEMBERSHIP entry and receives all data (log or snapshot catch-up)."""
+    tx, nodes, sms = make_cluster(3)
+    try:
+        leader = wait_leader(nodes)
+        put(leader, "a", 1)
+        put(leader, "b", 2)
+        # build the new member (empty log, knows the full config)
+        sm4 = KvSM()
+        nodes[4] = RaftNode("g1", 4, [1, 2, 3, 4], MemoryLogStore(), sm4,
+                            tx, election_timeout=(0.05, 0.15),
+                            heartbeat_interval=0.02)
+        sms[4] = sm4
+        leader.change_membership([1, 2, 3, 4])
+        assert sorted(leader.peers + [leader.node_id]) == [1, 2, 3, 4]
+        put(leader, "c", 3)
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline:
+            if sm4.data == {"a": 1, "b": 2, "c": 3}:
+                break
+            time.sleep(0.02)
+        assert sm4.data == {"a": 1, "b": 2, "c": 3}, sm4.data
+    finally:
+        for n in nodes.values():
+            n.stop()
+
+
+def test_membership_remove_follower_then_commit_with_new_majority():
+    """Removing a follower shrinks the quorum: a 3→2 group must commit
+    with both remaining members and never count the removed one."""
+    tx, nodes, sms = make_cluster(3)
+    try:
+        leader = wait_leader(nodes)
+        put(leader, "a", 1)
+        victim = next(i for i in nodes if i != leader.node_id)
+        leader.change_membership(
+            [i for i in (1, 2, 3) if i != victim])
+        nodes[victim].stop()
+        put(leader, "b", 2)   # must commit on the 2-member config
+        rest = [i for i in (1, 2, 3) if i != victim]
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline:
+            if all(sms[i].data.get("b") == 2 for i in rest):
+                break
+            time.sleep(0.02)
+        for i in rest:
+            assert sms[i].data.get("b") == 2
+    finally:
+        for n in nodes.values():
+            n.stop()
+
+
+def test_membership_rejects_multi_step_and_leader_self_removal():
+    tx, nodes, sms = make_cluster(3)
+    try:
+        leader = wait_leader(nodes)
+        with pytest.raises(ReplicationError):
+            leader.change_membership([leader.node_id])  # removes two
+        others = [i for i in (1, 2, 3) if i != leader.node_id]
+        with pytest.raises(ReplicationError):
+            leader.change_membership(others)  # removes the leader itself
+    finally:
+        for n in nodes.values():
+            n.stop()
+
+
+def test_stepdown_yields_leadership():
+    tx, nodes, sms = make_cluster(3)
+    try:
+        leader = wait_leader(nodes)
+        old = leader.node_id
+        leader.stepdown()
+        deadline = time.monotonic() + 5
+        new = None
+        while time.monotonic() < deadline:
+            leaders = [n for n in nodes.values()
+                       if n.is_leader() and n.node_id != old]
+            if leaders:
+                new = leaders[0]
+                break
+            time.sleep(0.02)
+        assert new is not None, "no new leader after stepdown"
+        put(new, "x", 9)
+    finally:
+        for n in nodes.values():
+            n.stop()

@@ -196,3 +196,67 @@ def test_file_level_snapshot_catchup(tmp_path):
 
     assert scan_vnode(lag_vnode, "m").n_rows == 200
     coord.close()
+
+
+def test_replica_add_on_live_group_then_member_loss(cluster):
+    """Raft membership change (VERDICT r2 #4): REPLICA ADD on a live
+    replicated group seeds a 4th member through the raft config change +
+    log/snapshot catch-up; killing an original member afterwards leaves
+    writes and reads correct on the grown quorum."""
+    from cnosdb_tpu.models.meta_data import VnodeStatus
+
+    meta, engine, coord = cluster
+    _write(coord, "h1", [1, 2, 3], [1.0, 2.0, 3.0])
+    rs = meta.buckets_for(DEFAULT_TENANT, "rdb")[0].shard_group[0]
+    owner = f"{DEFAULT_TENANT}.rdb"
+    orig_ids = [v.id for v in rs.vnodes]
+
+    new_id = coord.copy_vnode_to_set(rs.id, meta.node_id)
+    rs2 = meta.find_replica_set(rs.id)[1]
+    assert sorted(v.id for v in rs2.vnodes) == sorted(orig_ids + [new_id])
+    assert meta.find_vnode(new_id)[3].status == VnodeStatus.RUNNING
+
+    def new_member_has_data():
+        vn = engine.vnode(owner, new_id)
+        return vn is not None and scan_vnode(vn, "cpu").n_rows == 3
+
+    assert _wait(new_member_has_data), "new member did not catch up"
+
+    # kill an ORIGINAL member: 3 of 4 remain, quorum still holds
+    mgr = coord.replica_manager()
+    nodes = mgr.get_or_build(owner, rs2)
+    nodes[orig_ids[0]].crash()
+    _write(coord, "h1", [4], [4.0])
+
+    def read_all():
+        batches = coord.scan_table(DEFAULT_TENANT, "rdb", "cpu")
+        return sum(b.n_rows for b in batches) == 4
+
+    assert _wait(read_all, timeout=10.0), "reads wrong after member loss"
+
+
+def test_replica_remove_shrinks_live_group(cluster):
+    """REPLICA REMOVE on a replicated set commits a config shrink through
+    the leader (stepdown first when removing the leader member itself);
+    the smaller group keeps accepting writes."""
+    meta, engine, coord = cluster
+    _write(coord, "h2", [1, 2], [1.0, 2.0])
+    rs = meta.buckets_for(DEFAULT_TENANT, "rdb")[0].shard_group[0]
+    owner = f"{DEFAULT_TENANT}.rdb"
+    mgr = coord.replica_manager()
+    nodes = mgr.get_or_build(owner, rs)
+    # remove the CURRENT raft leader: exercises stepdown + retry-on-new-leader
+    leader_vid = next(vid for vid, n in nodes.items() if n.is_leader())
+    coord.drop_replica(leader_vid)
+    rs2 = meta.find_replica_set(rs.id)[1]
+    assert len(rs2.vnodes) == 2 and leader_vid not in {v.id for v in rs2.vnodes}
+    _write(coord, "h2", [3], [3.0])
+
+    def two_members_have_all():
+        for v in rs2.vnodes:
+            vn = engine.vnode(owner, v.id)
+            if vn is None or scan_vnode(vn, "cpu").n_rows != 3:
+                return False
+        return True
+
+    assert _wait(two_members_have_all, timeout=10.0)
