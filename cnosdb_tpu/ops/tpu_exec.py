@@ -381,10 +381,16 @@ def launch_scan_aggregate(batch: ScanBatch, query: TpuQuery):
 
         col_results = {}
         for cname, wants in col_wants.items():
-            if cname not in batch.fields:
+            if cname == "time":
+                # min/max/first/last/count over the time column itself:
+                # timestamps are always valid i64
+                vt, vals, valid = ValueType.INTEGER, batch.ts, \
+                    np.ones(n, dtype=bool)
+            elif cname not in batch.fields:
                 col_results[cname] = None
                 continue
-            vt, vals, valid = batch.fields[cname]
+            else:
+                vt, vals, valid = batch.fields[cname]
             if vt in (ValueType.STRING, ValueType.GEOMETRY):
                 if sel_idx is not None:
                     sv = np.zeros(n, dtype=bool)
@@ -569,6 +575,8 @@ def _device_eligible(batch: ScanBatch, query: TpuQuery,
     if dense_span > _DENSE_BUCKET_LIMIT:
         return False
     for cname in col_wants:
+        if cname == "time":
+            return False   # i64 timestamps never ride to device; host path
         f = batch.fields.get(cname)
         if f is not None and f[0] in (ValueType.STRING, ValueType.GEOMETRY):
             return False

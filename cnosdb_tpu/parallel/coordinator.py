@@ -589,17 +589,8 @@ class Coordinator:
                                    status=int(VnodeStatus.COPYING))
             hit2 = self.meta.find_replica_set(rs.id)
             rs2 = hit2[1] if hit2 is not None else rs
-            deadline = time.monotonic() + 60.0
-            while True:
-                pr = self._replica_progress(owner, rs2, vnode_id)
-                if pr is not None and pr[1] > 0 and pr[0] >= pr[1]:
-                    break
-                if time.monotonic() > deadline:
-                    raise CoordinatorError(
-                        f"moved replica {vnode_id} still catching up on "
-                        f"node {to_node}; it stays COPYING (unread) until "
-                        f"caught up — retry MOVE VNODE to re-check")
-                time.sleep(0.1)
+            self._wait_member_caught_up(owner, rs2, vnode_id,
+                                        what=f"moved replica {vnode_id}")
             self.meta.update_vnode(vnode_id, status=int(VnodeStatus.RUNNING))
             return
         data = self._fetch_vnode_snapshot(owner, vnode_id, src_node)
@@ -664,15 +655,8 @@ class Coordinator:
         members = sorted({v.id for v in rs.vnodes} | {new_id})
         try:
             self._replica_change_membership(owner, rs_new, members)
-            deadline = time.monotonic() + 30.0
-            while True:
-                pr = self._replica_progress(owner, rs_new, new_id)
-                if pr is not None and pr[1] > 0 and pr[0] >= pr[1]:
-                    break
-                if time.monotonic() > deadline:
-                    raise CoordinatorError(
-                        f"new replica {new_id} failed to catch up")
-                time.sleep(0.1)
+            self._wait_member_caught_up(owner, rs_new, new_id,
+                                        what=f"new replica {new_id}")
             self.meta.update_vnode(new_id, status=int(VnodeStatus.RUNNING))
             return new_id
         except Exception:
@@ -689,6 +673,31 @@ class Coordinator:
             except Exception:
                 pass
             raise
+
+    def _wait_member_caught_up(self, owner: str, rs, vnode_id: int,
+                               what: str, timeout: float = 45.0) -> None:
+        """Block until the member has ACKED a freshly-proposed no-op.
+
+        The leader's match_index can hold a STALE pre-rebuild value (it is
+        assigned, not monotonically validated, and nothing resets it when
+        a member is gutted and rebuilt) — so catching up is proven by the
+        member acknowledging an entry proposed AFTER the change: raft's
+        consistency check means it can only ack an index whose whole log
+        prefix (or snapshot) it actually holds."""
+        from ..storage.wal import WalEntryType
+
+        target = self._write_replicated(owner, rs, WalEntryType.RAFT_BLANK,
+                                        b"", sync=False)
+        deadline = time.monotonic() + timeout
+        while True:
+            pr = self._replica_progress(owner, rs, vnode_id)
+            if pr is not None and pr[0] >= target:
+                return
+            if time.monotonic() > deadline:
+                raise CoordinatorError(
+                    f"{what} has not caught up (stays COPYING, unread; "
+                    f"retry the admin op to re-check)")
+            time.sleep(0.1)
 
     def drop_replica(self, vnode_id: int):
         """REPLICA REMOVE: shrink the raft config via the leader (the

@@ -390,6 +390,10 @@ class RaftNode:
         self.heartbeat_interval = heartbeat_interval
 
         self.term, self.voted_for = log.load_hard_state()
+        # adopted-config history: (log index, members) per MEMBERSHIP entry
+        # stored — log truncation must revert to the prior configuration
+        self._boot_members = sorted({*self.peers, node_id})
+        self._config_log: list[tuple[int, list[int]]] = []
         self.role = Role.FOLLOWER
         self.leader_id: int | None = None
         # a state machine that persisted its apply watermark resumes there
@@ -609,7 +613,7 @@ class RaftNode:
             data = _mp.packb({"members": new}, use_bin_type=True)
             idx = self._append_local(RAFT_MEMBERSHIP, data)
             term = self.term
-            self._adopt_membership(new)
+            self._adopt_membership(new, index=idx)
         self._broadcast_append()
         deadline = time.monotonic() + timeout
         with self._apply_cv:
@@ -627,10 +631,14 @@ class RaftNode:
                 index=idx)
         return idx
 
-    def _adopt_membership(self, member_ids: list[int]) -> None:
+    def _adopt_membership(self, member_ids: list[int],
+                          index: int | None = None) -> None:
         """Install a configuration (list of member ids incl. self if still
-        a member). Caller holds self.lock."""
+        a member). Caller holds self.lock. `index` records which log entry
+        carried it, so truncation can revert."""
         self.peers = [p for p in member_ids if p != self.node_id]
+        if index is not None:
+            self._config_log.append((index, sorted(member_ids)))
         last = self.log.last_index()
         for p in self.peers:
             self.next_index.setdefault(p, last + 1)
@@ -641,6 +649,19 @@ class RaftNode:
         for p in list(self.match_index):
             if p != self.node_id and p not in self.peers:
                 del self.match_index[p]
+
+    def _revert_config_from(self, idx: int) -> None:
+        """Log truncation erased entries ≥ idx: any configuration adopted
+        from an erased MEMBERSHIP entry must roll back to the latest
+        surviving one (or the boot config) — an append-time-adopted but
+        never-committed config would otherwise make this node count the
+        wrong quorum forever. Caller holds self.lock."""
+        if not self._config_log or self._config_log[-1][0] < idx:
+            return
+        self._config_log = [(i, m) for i, m in self._config_log if i < idx]
+        members = (self._config_log[-1][1] if self._config_log
+                   else self._boot_members)
+        self._adopt_membership(members)
 
     def stepdown(self) -> None:
         """Voluntarily yield leadership: revert to follower and push this
@@ -853,6 +874,7 @@ class RaftNode:
                 existing = self.log.entry_at(e.index)
                 if existing is not None and existing.term != e.term:
                     self.log.truncate_from(e.index)
+                    self._revert_config_from(e.index)
                     existing = None
                 if existing is None:
                     self.log.append(e)
@@ -861,7 +883,8 @@ class RaftNode:
                         import msgpack as _mp
 
                         self._adopt_membership(
-                            _mp.unpackb(e.data, raw=False)["members"])
+                            _mp.unpackb(e.data, raw=False)["members"],
+                            index=e.index)
             if msg["leader_commit"] > self.commit_index:
                 self.commit_index = min(msg["leader_commit"],
                                         self.log.last_index())

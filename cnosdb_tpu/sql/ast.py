@@ -156,6 +156,7 @@ class InsertStmt:
 class DeleteStmt:
     table: str
     where: Optional[Expr] = None
+    database: Optional[str] = None
 
 
 @dataclass
@@ -163,6 +164,7 @@ class UpdateStmt:
     table: str
     assignments: dict[str, Expr]
     where: Optional[Expr] = None
+    database: Optional[str] = None
 
 
 @dataclass

@@ -596,7 +596,9 @@ class QueryExecutor:
         return ResultSet(["rows"], [np.array([len(rows)])])
 
     def _delete(self, stmt: ast.DeleteStmt, session: Session):
-        schema = self.meta.table(session.tenant, session.database, stmt.table)
+        schema = self.meta.table(session.tenant,
+                                 stmt.database or session.database,
+                                 stmt.table)
         from .planner import split_where
 
         trs, tag_domains, residual = split_where(stmt.where, schema)
@@ -608,12 +610,14 @@ class QueryExecutor:
                     f"DELETE supports time/tag predicates only, got {sorted(extra)}")
         lo = trs.min_ts if not trs.is_all else -(2**63)
         hi = trs.max_ts if not trs.is_all else 2**63 - 1
-        self.coord.delete_from_table(session.tenant, session.database,
+        self.coord.delete_from_table(session.tenant,
+                                     stmt.database or session.database,
                                      stmt.table, tag_domains, lo, hi)
         return ResultSet.message("ok")
 
     def _update(self, stmt: ast.UpdateStmt, session: Session):
-        schema = self.meta.table(session.tenant, session.database, stmt.table)
+        db = stmt.database or session.database
+        schema = self.meta.table(session.tenant, db, stmt.table)
         tag_names = set(schema.tag_names())
         if not set(stmt.assignments) <= tag_names:
             raise ExecutionError("UPDATE supports tag columns only")
@@ -625,7 +629,7 @@ class QueryExecutor:
             if not isinstance(e, Literal):
                 raise ExecutionError("UPDATE tag values must be literals")
             new_vals[k] = str(e.value)
-        owner = f"{session.tenant}.{session.database}"
+        owner = f"{session.tenant}.{db}"
         from ..models.series import SeriesKey, Tag
 
         count = 0
@@ -711,7 +715,14 @@ class QueryExecutor:
                 and stmt.items[0].expr.name.lower() in _REPAIR_FUNCS):
             return self._ts_gen_func(stmt, session)
         schema = self.meta.table(session.tenant, db, table)
-        plan = plan_select(stmt, schema)
+        try:
+            plan = plan_select(stmt, schema)
+        except PlanError as e:
+            if getattr(e, "fallback_relational", False):
+                # e.g. GROUP BY on a field column: the relational pipeline
+                # groups by arbitrary expressions
+                return self._select_relational(stmt, session)
+            raise
         if isinstance(plan, AggregatePlan):
             return self._exec_aggregate(plan, session.tenant, db)
         return self._exec_raw(plan, session.tenant, db)
@@ -857,12 +868,24 @@ class QueryExecutor:
 
     # ------------------------------------------------------- relational path
     def _needs_relational(self, stmt: ast.SelectStmt) -> bool:
-        """Window functions route through the relational pipeline; plain
-        single-table queries keep the fused-kernel path."""
+        """Window functions and aggregates over computed expressions
+        (sum(a*b)) route through the relational pipeline — it evaluates
+        aggregate arguments as expressions; plain single-table queries
+        keep the fused-kernel path."""
         exprs = [it.expr for it in stmt.items if isinstance(it.expr, Expr)]
         exprs += [e for e in (stmt.where, stmt.having) if e is not None]
         exprs += [e for e, _ in stmt.order_by if isinstance(e, Expr)]
-        return any(rel.contains_window(e) for e in exprs)
+        if any(rel.contains_window(e) for e in exprs):
+            return True
+        for e in exprs:
+            for f in rel.collect_aggs(e, AGG_FUNCS):
+                args = f.args
+                if args and isinstance(args[0], Literal) \
+                        and args[0].value == "__distinct__":
+                    args = args[1:]
+                if args and not isinstance(args[0], (Column, Literal)):
+                    return True
+        return False
 
     def _resolve_subqueries(self, stmt: ast.SelectStmt, session: Session):
         """Execute uncorrelated scalar / IN subqueries and splice their
@@ -992,7 +1015,10 @@ class QueryExecutor:
             rel.collect_aggs(it.expr, AGG_FUNCS)
             for it in stmt.items if isinstance(it.expr, Expr))
         if stmt.group_by or has_agg:
-            if self._needs_relational(stmt):
+            win_exprs = [it.expr for it in stmt.items
+                         if isinstance(it.expr, Expr)]
+            win_exprs += [e for e, _ in stmt.order_by if isinstance(e, Expr)]
+            if any(rel.contains_window(e) for e in win_exprs):
                 raise PlanError(
                     "window functions cannot mix with GROUP BY in one "
                     "SELECT — wrap the aggregate in a subquery")
@@ -1359,11 +1385,23 @@ class QueryExecutor:
                 mask = np.asarray(plan.filter.eval(env, np), dtype=bool)
                 if mask.shape == ():
                     mask = np.full(b.n_rows, bool(mask))
-                for c in plan.filter.columns():
-                    vk = f"__valid__:{c}"
-                    if c in b.fields:
-                        mask &= env[vk]
-            frames.append((env, mask))
+                # 3VL: a NULL field operand excludes the row — EXCEPT under
+                # an explicit IS NULL, which matches exactly those rows
+                from ..ops.tpu_exec import _contains_is_null
+
+                if not _contains_is_null(plan.filter):
+                    for c in plan.filter.columns():
+                        vk = f"__valid__:{c}"
+                        if c in b.fields:
+                            mask &= env[vk]
+            # filter BEFORE projection (DataFusion order): expressions must
+            # only see surviving rows — CAST over a filtered-out Inf row
+            # must not abort, and selective scans shrink the eval cost
+            if not bool(mask.all()):
+                env = {k: (v[mask] if isinstance(v, np.ndarray)
+                           and len(v) == b.n_rows else v)
+                       for k, v in env.items()}
+            frames.append((env, int(mask.sum())))
 
         # ORDER BY keys may reference non-projected columns: evaluate them
         # per frame as hidden columns
@@ -1373,32 +1411,51 @@ class QueryExecutor:
         out_cols: list[list[np.ndarray]] = [[] for _ in names]
         valid_cols: list[list[np.ndarray]] = [[] for _ in names]
         ord_cols: list[list[np.ndarray]] = [[] for _ in ord_items]
-        for env, mask in frames:
+        for env, n_rows in frames:
             for j, (_hn, oe, _asc) in enumerate(ord_items):
                 missing = [c for c in oe.columns() if c not in env]
                 for c in missing:
-                    env[c] = np.zeros(len(mask))
-                    env[f"__valid__:{c}"] = np.zeros(len(mask), dtype=bool)
+                    env[c] = np.zeros(n_rows)
+                    env[f"__valid__:{c}"] = np.zeros(n_rows, dtype=bool)
                 ov = oe.eval(env, np)
-                if np.isscalar(ov) or getattr(ov, "shape", None) == ():
-                    ov = np.full(len(mask), ov)
-                ord_cols[j].append(np.asarray(ov)[mask])
+                if ov is None:
+                    ov = np.full(n_rows, None, dtype=object)
+                elif np.isscalar(ov) or getattr(ov, "shape", None) == ():
+                    ov = np.full(n_rows, ov)
+                ov = np.asarray(ov)
+                # NULL slots in typed columns carry garbage values — sort
+                # keys must see the NULLs (rendered as None/nan) or NULLs
+                # order by their slot garbage
+                ovv = np.ones(n_rows, dtype=bool)
+                for c in oe.columns():
+                    vk = f"__valid__:{c}"
+                    if vk in env:
+                        ovv &= env[vk]
+                if not ovv.all():
+                    if np.issubdtype(ov.dtype, np.floating):
+                        ov = ov.copy()
+                        ov[~ovv] = np.nan
+                    else:
+                        ov = ov.astype(object)
+                        ov[~ovv] = None
+                ord_cols[j].append(ov)
             for i, (name, expr) in enumerate(plan.output):
                 missing = [c for c in expr.columns() if c not in env]
-                n_rows = len(mask)
                 for c in missing:
                     env[c] = np.zeros(n_rows)
                     env[f"__valid__:{c}"] = np.zeros(n_rows, dtype=bool)
                 v = expr.eval(env, np)
-                if np.isscalar(v) or getattr(v, "shape", None) == ():
+                if v is None:   # e.g. TRY_CAST failure: an all-NULL column
+                    v = np.full(n_rows, None, dtype=object)
+                elif np.isscalar(v) or getattr(v, "shape", None) == ():
                     v = np.full(n_rows, v)
-                out_cols[i].append(np.asarray(v)[mask])
+                out_cols[i].append(np.asarray(v))
                 vv = np.ones(n_rows, dtype=bool)
                 for c in expr.columns():
                     vk = f"__valid__:{c}"
                     if vk in env:
                         vv &= env[vk]
-                valid_cols[i].append(vv[mask])
+                valid_cols[i].append(vv)
 
         cols = [np.concatenate(c) if c else np.empty(0) for c in out_cols]
         valids = [np.concatenate(c) if c else np.empty(0, dtype=bool)

@@ -73,7 +73,9 @@ _BIN_OPS = {
     "-": lambda xp, a, b: a - b,
     "*": lambda xp, a, b: a * b,
     "/": lambda xp, a, b: _div(xp, a, b),
-    "%": lambda xp, a, b: xp.mod(a, b),
+    # SQL % is the REMAINDER (sign of the dividend, like DataFusion/C),
+    # not python/numpy floor-mod: -7 % 3 = -1
+    "%": lambda xp, a, b: xp.fmod(a, b),
     "=": lambda xp, a, b: _eq(xp, a, b),
     "!=": lambda xp, a, b: ~_eq(xp, a, b),
     "<": lambda xp, a, b: a < b,
@@ -87,10 +89,22 @@ _BIN_OPS = {
 
 def _div(xp, a, b):
     # SQL division: integer/integer stays integral in CnosDB? DataFusion
-    # yields float for `/` on floats, trunc-div on ints. Follow DataFusion.
+    # yields float for `/` on floats, TRUNC-div on ints (toward zero —
+    # numpy's // floors, so -7/2 would wrongly give -4). Follow DataFusion.
     a_int = _is_int(a) and _is_int(b)
     if a_int:
-        return xp.where(b != 0, a // xp.where(b == 0, 1, b), 0)
+        safe_b = xp.where(b == 0, 1, b)
+        qf = a // safe_b
+        rem = a - qf * safe_b
+        q = qf + ((rem != 0) & ((a < 0) != (b < 0)))
+        return xp.where(b != 0, q, 0)
+    if xp is np:
+        # IEEE semantics for scalar constants too (1.0/0 → inf, 0.0/0 →
+        # nan — same as the column path), and no warning spam in logs
+        with np.errstate(divide="ignore", invalid="ignore"):
+            if np.isscalar(a) and np.isscalar(b):
+                return float(np.float64(a) / np.float64(b))
+            return a / b
     return a / b
 
 
@@ -105,6 +119,46 @@ def _eq(xp, a, b):
     return a == b
 
 
+def _is_obj_arr(v) -> bool:
+    return isinstance(v, np.ndarray) and v.dtype == object
+
+
+def _obj_binop(op: str, f, xp, a, b):
+    """NULL-propagating elementwise op when an operand is an OBJECT array
+    (NULL-bearing int columns ride as objects to keep integer identity):
+    arithmetic yields NULL where any operand is NULL; comparisons yield
+    FALSE there (3VL as a filter)."""
+    n = len(a) if _is_obj_arr(a) else len(b)
+
+    def clean(v):
+        if not _is_obj_arr(v):
+            return v, np.zeros(n, dtype=bool)
+        nulls = np.array([x is None for x in v], dtype=bool)
+        vals = [0 if x is None else x for x in v]
+        try:
+            arr = np.array(vals, dtype=np.int64)
+        except (TypeError, ValueError, OverflowError):
+            try:
+                arr = np.array(vals, dtype=np.float64)
+            except (TypeError, ValueError):
+                return v, nulls   # strings etc: operate on objects
+        return arr, nulls
+
+    aa, an = clean(a)
+    bb, bn = clean(b)
+    nulls = an | bn
+    out = f(xp, aa, bb)
+    if op in ("=", "!=", "<", "<=", ">", ">=", "and", "or"):
+        out = np.asarray(out, dtype=bool)
+        if nulls.any():
+            out = out & ~nulls
+        return out
+    if nulls.any():
+        out = np.asarray(out).astype(object)
+        out[nulls] = None
+    return out
+
+
 @dataclass(repr=False)
 class BinOp(Expr):
     op: str
@@ -117,6 +171,8 @@ class BinOp(Expr):
             raise PlanError(f"unknown operator {self.op!r}")
         a = self.left.eval(env, xp)
         b = self.right.eval(env, xp)
+        if xp is np and (_is_obj_arr(a) or _is_obj_arr(b)):
+            return _obj_binop(self.op, f, xp, a, b)
         if a is None or b is None:
             # SQL three-valued logic: NULL compares unknown (false as a
             # filter, e.g. an empty scalar subquery); NULL arithmetic is
@@ -475,6 +531,134 @@ def _register_tsfuncs():
         "lpad": _str_func(_fn_lpad),
         "rpad": _str_func(_fn_rpad),
     })
+
+
+def _parse_bool_str(s: str) -> bool:
+    low = str(s).strip().lower()
+    if low in ("t", "true", "1", "yes"):
+        return True
+    if low in ("f", "false", "0", "no"):
+        return False
+    raise ValueError(f"invalid boolean string {s!r}")
+
+
+def _cast_scalar(x, kind: str):
+    """One value → cast target kind (i/u/f/s/b/t). Raises ValueError/
+    OverflowError on impossible casts (DataFusion-style strict CAST)."""
+    if kind in ("i", "t", "u"):
+        if isinstance(x, str):
+            out = int(x.strip())
+        elif isinstance(x, (float, np.floating)):
+            if np.isnan(x) or np.isinf(x):
+                raise ValueError(f"cannot cast {x} to integer")
+            out = int(x)          # truncation toward zero
+        else:
+            out = int(x)
+        if kind == "u" and out < 0:
+            raise ValueError(f"cannot cast negative {out} to unsigned")
+        return out
+    if kind == "f":
+        return float(x.strip()) if isinstance(x, str) else float(x)
+    if kind == "s":
+        if isinstance(x, (bool, np.bool_)):
+            return "true" if x else "false"
+        if isinstance(x, (float, np.floating)):
+            return repr(float(x))
+        if isinstance(x, (int, np.integer)):
+            return str(int(x))
+        return str(x)
+    if kind == "b":
+        if isinstance(x, str):
+            return _parse_bool_str(x)
+        return bool(x != 0) if not isinstance(x, (bool, np.bool_)) else bool(x)
+    raise ValueError(f"unknown cast kind {kind}")
+
+
+_CAST_KINDS = {"BIGINT": "i", "INT": "i", "INTEGER": "i",
+               "BIGINT UNSIGNED": "u", "UNSIGNED": "u",
+               "DOUBLE": "f", "FLOAT": "f",
+               "STRING": "s", "VARCHAR": "s", "TEXT": "s",
+               "BOOLEAN": "b", "BOOL": "b", "TIMESTAMP": "t"}
+
+
+@dataclass(repr=False)
+class Cast(Expr):
+    """CAST(expr AS type) / TRY_CAST (NULL instead of error) — reference
+    inherits DataFusion's cast kernels; semantics here follow them:
+    float→int truncates toward zero, NaN/Inf→int errors, bool→'true'."""
+
+    expr: Expr
+    target: str
+    safe: bool = False
+
+    def eval(self, env, xp):
+        kind = _CAST_KINDS.get(self.target.upper())
+        if kind is None:
+            raise PlanError(f"unknown CAST target {self.target!r}")
+        v = self.expr.eval(env, xp)
+        if v is None:
+            return None
+        if isinstance(v, np.ndarray) and v.dtype != object:
+            # NULL slots of a typed column carry garbage values — they
+            # must neither abort a strict CAST nor poison TRY_CAST
+            vm = None
+            if isinstance(self.expr, Column):
+                vm = env.get(f"__valid__:{self.expr.name}")
+            if kind in ("i", "t", "u"):
+                bad = (~np.isfinite(v) if v.dtype.kind == "f"
+                       else np.zeros(len(v), dtype=bool))
+                if kind == "u":
+                    bad = bad | (np.asarray(v, dtype=np.float64) < 0)
+                relevant = bad if vm is None else (bad & vm)
+                if relevant.any() and not self.safe:
+                    raise PlanError(
+                        "CAST failed: NaN/Inf/negative to integer")
+                vsafe = np.where(bad, 0, v)
+                tgt = np.uint64 if kind == "u" else np.int64
+                out_i = (np.trunc(vsafe) if v.dtype.kind == "f"
+                         else vsafe).astype(tgt)
+                if relevant.any():
+                    # TRY_CAST is per-element: only failed slots go NULL
+                    out = out_i.astype(object)
+                    out[relevant] = None
+                    return out
+                return out_i
+            if kind == "f":
+                return v.astype(np.float64)
+            if kind == "b":
+                return v != 0
+            out = np.empty(len(v), dtype=object)
+            out[:] = [_cast_scalar(x, "s") for x in v.tolist()]
+            return out
+        if isinstance(v, np.ndarray):   # object (string) column
+            out = np.empty(len(v), dtype=object)
+            vals = []
+            for x in v:
+                if x is None:
+                    vals.append(None)
+                    continue
+                try:
+                    vals.append(_cast_scalar(x, kind))
+                except (ValueError, OverflowError) as e:
+                    if self.safe:
+                        vals.append(None)
+                    else:
+                        raise PlanError(f"CAST failed: {e}")
+            out[:] = vals
+            return out
+        try:
+            return _cast_scalar(v, kind)
+        except (ValueError, OverflowError) as e:
+            if self.safe:
+                return None
+            raise PlanError(f"CAST failed: {e}")
+
+    def columns(self):
+        return self.expr.columns()
+
+    def to_sql(self):
+        fn = "TRY_CAST" if self.safe else "CAST"
+        return f"{fn}({self.expr.to_sql()} AS {self.target})"
 
 
 @dataclass(repr=False)

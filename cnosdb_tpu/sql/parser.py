@@ -398,6 +398,9 @@ class Parser:
                 right = self.parse_table_factor()
                 self.expect_kw("ON")
                 base = ast.Join(base, right, kind, self.parse_expr())
+            elif self.accept_op(","):
+                # comma join = CROSS JOIN (filters in WHERE)
+                base = ast.Join(base, self.parse_table_factor(), "cross")
             else:
                 return base
 
@@ -813,13 +816,17 @@ class Parser:
     def parse_delete(self):
         self.expect_kw("DELETE")
         self.expect_kw("FROM")
-        table = self.expect_ident()
+        database, table = None, self.expect_ident()
+        if self.accept_op("."):
+            database, table = table, self.expect_ident()
         where = self.parse_expr() if self.accept_kw("WHERE") else None
-        return ast.DeleteStmt(table, where)
+        return ast.DeleteStmt(table, where, database)
 
     def parse_update(self):
         self.expect_kw("UPDATE")
-        table = self.expect_ident()
+        database, table = None, self.expect_ident()
+        if self.accept_op("."):
+            database, table = table, self.expect_ident()
         self.expect_kw("SET")
         assigns = {}
         while True:
@@ -829,7 +836,7 @@ class Parser:
             if not self.accept_op(","):
                 break
         where = self.parse_expr() if self.accept_kw("WHERE") else None
-        return ast.UpdateStmt(table, assigns, where)
+        return ast.UpdateStmt(table, assigns, where, database)
 
     # -- expressions (precedence climbing) -------------------------------
     def parse_expr(self) -> Expr:
@@ -901,7 +908,12 @@ class Parser:
                     while self.accept_op(","):
                         vals.append(_const_eval(self.parse_expr()))
                     self.expect_op(")")
-                    e = InList(e, vals, negated)
+                    # a literal NULL among the values: three-valued logic —
+                    # it can never satisfy IN and makes NOT IN unknown
+                    # (false as a filter) for every row
+                    null_present = any(v is None for v in vals)
+                    e = InList(e, [v for v in vals if v is not None],
+                               negated, null_present)
                 else:
                     break
             elif self.kw() == "BETWEEN":
@@ -992,6 +1004,21 @@ class Parser:
                 import time as _time
 
                 return Literal(int(_time.time() * 1e9))
+            if k in ("CAST", "TRY_CAST"):
+                self.next()
+                self.expect_op("(")
+                e = self.parse_expr()
+                if self.kw() != "AS":
+                    raise ParserError(f"expected AS in {k}")
+                self.next()
+                tname = self.expect_ident().upper()
+                if tname == "BIGINT" and self.kw() == "UNSIGNED":
+                    self.next()
+                    tname = "BIGINT UNSIGNED"
+                self.expect_op(")")
+                from .expr import Cast
+
+                return Cast(e, tname, safe=(k == "TRY_CAST"))
             if k in _RESERVED:
                 raise ParserError(f"unexpected keyword {t.value!r} in expression")
             name = self.next().value

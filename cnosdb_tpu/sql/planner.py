@@ -355,8 +355,16 @@ def _plan_aggregate(stmt, schema, time_trs, tag_domains, residual):
                 return
             if g.name == TIME_COL:
                 raise PlanError("GROUP BY time requires date_bin/time_window")
-            raise PlanError(f"can only GROUP BY tags or time buckets, got {g.name!r}")
-        raise PlanError(f"unsupported GROUP BY expression {g!r}")
+            # grouping by a FIELD column: the fused scan kernel groups by
+            # series tags / time buckets only — the relational pipeline
+            # evaluates arbitrary group keys over materialized rows
+            e = PlanError(
+                f"can only GROUP BY tags or time buckets, got {g.name!r}")
+            e.fallback_relational = True
+            raise e
+        e = PlanError(f"unsupported GROUP BY expression {g!r}")
+        e.fallback_relational = True
+        raise e
 
     for g in stmt.group_by:
         classify_group(g)

@@ -58,24 +58,22 @@ class Scope:
 
 def _null_take(col: np.ndarray, idx: np.ndarray):
     """col[idx] with idx == -1 yielding NULL (object None / float NaN);
-    int/bool columns promote to float so NaN can carry the null."""
+    int/bool columns go to OBJECT arrays with None so values keep their
+    integer identity (a float-promoted 100 would render as 100.0 and lose
+    exactness past 2^53 — DataFusion likewise keeps Int64+null)."""
     missing = idx < 0
     if not missing.any():
         return col[idx]
     safe = np.where(missing, 0, idx)
     if len(col) == 0:
-        return (np.full(len(idx), None, dtype=object) if col.dtype == object
-                else np.full(len(idx), np.nan))
+        return np.full(len(idx), None, dtype=object)
     out = col[safe]
-    if col.dtype == object:
+    if np.issubdtype(out.dtype, np.floating):
         out = out.copy()
-        out[missing] = None
+        out[missing] = np.nan
         return out
-    if not np.issubdtype(out.dtype, np.floating):
-        out = out.astype(np.float64)
-    else:
-        out = out.copy()
-    out[missing] = np.nan
+    out = out.astype(object)
+    out[missing] = None
     return out
 
 
@@ -86,6 +84,15 @@ def null_safe_key(v: np.ndarray):
     if v.dtype != object:
         return v, None
     nulls = np.array([x is None for x in v], dtype=np.int8)
+    non_null = [x for x in v if x is not None]
+    if non_null and all(
+            isinstance(x, (int, float, np.integer, np.floating))
+            and not isinstance(x, (bool, np.bool_)) for x in non_null):
+        # numeric object column (NULL-bearing ints render as objects):
+        # order NUMERICALLY — stringifying would sort '12' before '5'
+        vals = np.array([0.0 if x is None else float(x) for x in v],
+                        dtype=np.float64)
+        return vals, (nulls if nulls.any() else None)
     vals = v
     if nulls.any():
         vals = np.array([("" if x is None else x) for x in v], dtype=object)
@@ -412,10 +419,12 @@ def eval_window(wf: WindowFunc, env: dict, n: int) -> np.ndarray:
             for i in range(len(seg)):
                 j = i - shift
                 res[perm[s + i]] = seg[j] if 0 <= j < len(seg) else default
-        if src.dtype != object and default is None:
-            resf = np.array([np.nan if x is None else x for x in res],
+        if src.dtype.kind == "f" and default is None:
+            # float input: NaN carries the out-of-frame NULL
+            return np.array([np.nan if x is None else x for x in res],
                             dtype=np.float64)
-            return resf
+        # integral/object inputs keep their value types (object array with
+        # None at the frame edges) — lead(Int64) must not render 5 as 5.0
         return res
 
     if name in _VALUES:
@@ -430,6 +439,26 @@ def eval_window(wf: WindowFunc, env: dict, n: int) -> np.ndarray:
                 and getattr(wf.args[0], "value", None) == "*")
         src = None if (name == "count" and star) else ordered_vals(wf.args[0])
         cumulative = bool(wf.order_by)
+        # sum/min/max of an integral NULL-free column stay INTEGERS
+        # (DataFusion: sum(Int64) → Int64); only NULL-bearing or float
+        # inputs go through the NaN-carrying float path
+        if src is not None and src.dtype.kind in "iu" \
+                and name in ("sum", "min", "max", "count"):
+            out = np.empty(n, dtype=np.int64)
+            for s, e_ in zip(starts, ends):
+                seg = src[s:e_]
+                if name == "count":
+                    vals = (np.arange(1, e_ - s + 1) if cumulative
+                            else np.full(e_ - s, e_ - s))
+                elif cumulative:
+                    vals = {"sum": np.cumsum,
+                            "min": np.minimum.accumulate,
+                            "max": np.maximum.accumulate}[name](seg)
+                else:
+                    vals = np.full(e_ - s, {"sum": np.sum, "min": np.min,
+                                            "max": np.max}[name](seg))
+                out[perm[s:e_]] = vals
+            return out
         for s, e_ in zip(starts, ends):
             seg = None if src is None else src[s:e_]
             if name == "count":

@@ -164,3 +164,36 @@ def test_errors():
         parse_sql("SELECT FROM t")
     with pytest.raises(ParserError):
         parse_sql("SELECT * FROM t WHERE a >")
+
+
+def test_try_cast_is_per_element_and_cast_skips_null_slots(tmp_path):
+    """TRY_CAST nulls only the failing elements; strict CAST must not
+    abort on NULL slots whose garbage values look uncastable."""
+    import numpy as np
+
+    from cnosdb_tpu.parallel.coordinator import Coordinator
+    from cnosdb_tpu.parallel.meta import MetaStore
+    from cnosdb_tpu.sql.executor import QueryExecutor, Session
+    from cnosdb_tpu.storage.engine import TsKv
+
+    meta = MetaStore(str(tmp_path / "meta.json"))
+    coord = Coordinator(meta, TsKv(str(tmp_path / "data")))
+    ex = QueryExecutor(meta, coord)
+    s = Session()
+    ex.execute_one(
+        "CREATE TABLE public.ct (f DOUBLE, TAGS(h))", s)
+    ex.execute_one(
+        "INSERT INTO public.ct (time, h, f) VALUES "
+        "(1,'x',1.9), (2,'x',1.0/0), (3,'x',NULL), (4,'x',-2.5)", s)
+    rs = ex.execute_one(
+        "SELECT TRY_CAST(f AS BIGINT) AS x FROM public.ct ORDER BY time", s)
+    got = [None if v is None or (isinstance(v, float) and np.isnan(v))
+           else int(v) for v in rs.columns[0].tolist()]
+    assert got == [1, None, None, -2]
+    # strict CAST over rows that exclude the Inf: NULL slot must not abort
+    rs = ex.execute_one(
+        "SELECT CAST(f AS BIGINT) AS x FROM public.ct "
+        "WHERE time != 2 ORDER BY time", s)
+    vals = rs.columns[0].tolist()
+    assert int(vals[0]) == 1 and int(vals[2]) == -2
+    coord.close()
