@@ -295,14 +295,15 @@ def launch_scan_aggregate(batch: ScanBatch, query: TpuQuery):
                 row_mask = np.asarray(query.filter.eval(env, np), dtype=bool)
                 if row_mask.shape == ():  # constant predicate
                     row_mask = np.full(n, bool(row_mask))
-                # SQL three-valued logic approximation: a NULL operand makes
-                # a comparison non-matching, so rows where a referenced field
-                # is null are excluded — except under an explicit IS NULL.
-                if not has_is_null:
-                    for cname in query.filter.columns():
-                        if cname in batch.fields and not col_all_valid(
-                                cname, batch.fields[cname][2]):
-                            row_mask &= batch.fields[cname][2]
+                # SQL three-valued logic: a NULL operand makes a comparison
+                # non-matching, so rows where a referenced field is null are
+                # excluded — except for the columns under an explicit
+                # IS NULL (per-column, not filter-wide)
+                skip = is_null_columns(query.filter) if has_is_null else set()
+                for cname in query.filter.columns() - skip:
+                    if cname in batch.fields and not col_all_valid(
+                            cname, batch.fields[cname][2]):
+                        row_mask &= batch.fields[cname][2]
         if zone_pruned:
             all_rows = len(sel_idx) == n
             if all_rows:
@@ -611,6 +612,27 @@ def _contains_is_null(e) -> bool:
     if args:
         return any(_contains_is_null(a) for a in args)
     return False
+
+
+def is_null_columns(e) -> set:
+    """Columns referenced INSIDE IS NULL nodes: validity masking must skip
+    exactly these — masking them defeats IS NULL, while skipping masking
+    for every other column lets its garbage NULL-slot values match."""
+    from ..sql.expr import IsNull
+
+    out: set = set()
+    if isinstance(e, IsNull):
+        return set(e.columns())
+    for attr in ("left", "right", "operand", "expr", "low", "high"):
+        sub = getattr(e, attr, None)
+        if isinstance(sub, Expr):
+            out |= is_null_columns(sub)
+    args = getattr(e, "args", None)
+    if args:
+        for a in args:
+            if isinstance(a, Expr):
+                out |= is_null_columns(a)
+    return out
 
 
 def _ordered_within_series(batch: ScanBatch) -> bool:

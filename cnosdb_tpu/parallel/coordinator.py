@@ -88,7 +88,7 @@ class Coordinator:
             with self._scan_cache_lock:
                 self._scan_cache.clear()
             return
-        if event in ("create_table", "update_table"):
+        if event in ("create_table", "update_table", "recover_table"):
             owner = payload["owner"]
             tenant, db = owner.split(".", 1)
             schema = self.meta.table_opt(tenant, db, payload["table"])
@@ -96,8 +96,22 @@ class Coordinator:
                 self.engine.set_table_schema(owner, schema)
         elif event == "drop_table":
             self.engine.drop_table(payload["owner"], payload["table"])
+        elif event == "trash_table":
+            # soft delete: schema gone, row data stays until purge
+            self.engine.remove_table_schema(payload["owner"],
+                                            payload["table"])
         elif event == "drop_db":
             self.engine.drop_database(payload["owner"])
+        elif event == "trash_db":
+            # soft delete: close vnodes, keep every file for RECOVER
+            self.engine.close_database(payload["owner"])
+            with self._scan_cache_lock:
+                self._scan_cache.clear()
+        elif event == "recover_db":
+            owner = payload["owner"]
+            tenant, db = owner.split(".", 1)
+            for t in self.meta.tables.get(owner, {}).values():
+                self.engine.set_table_schema(owner, t)
 
     # ---------------------------------------------------------------- write
     def write_points(self, tenant: str, db: str, batch: WriteBatch,
@@ -425,6 +439,11 @@ class Coordinator:
                    tag_domains: ColumnDomains | None = None,
                    field_names: list[str] | None = None) -> list[ScanBatch]:
         """Fan a scan out over placed vnodes → one ScanBatch per vnode."""
+        # a soft-dropped (trashed) table's rows stay on disk for RECOVER
+        # but must not be readable until then
+        if self.meta.table_opt(tenant, db, table) is None \
+                and self.meta.external_opt(tenant, db, table) is None:
+            return []
         trs = time_ranges or TimeRanges.all()
         doms = tag_domains or ColumnDomains.all()
         batches = []
@@ -751,6 +770,28 @@ class Coordinator:
                            "rs_id": rs.id})
             except Exception:
                 pass  # orphaned data is garbage, placement is authoritative
+
+    def destroy_replica_set(self, rs_id: int):
+        """REPLICA DESTORY: tear down a (damaged) replica set wholesale —
+        stop every member, remove the set from placement, drop the data
+        (reference parser.rs:2046; manager.rs destory_replica_group)."""
+        hit = self.meta.find_replica_set(rs_id)
+        if hit is None:
+            raise CoordinatorError(f"unknown replica set {rs_id}")
+        owner, rs = hit
+        removed = self.meta.remove_replica_set(rs_id)
+        for v in removed:
+            if v.node_id == self.node_id or not self.distributed:
+                if self._replica_mgr is not None:
+                    self._replica_mgr.stop_member(owner, rs_id, v.id)
+                self.engine.drop_vnode(owner, v.id)
+            else:
+                try:
+                    self._rpc(v.node_id, "vnode_drop",
+                              {"owner": owner, "vnode_id": v.id,
+                               "rs_id": rs_id})
+                except Exception:
+                    pass  # unreachable node: placement is authoritative
 
     def compact_vnode(self, vnode_id: int):
         """COMPACT VNODE on whichever node owns it."""

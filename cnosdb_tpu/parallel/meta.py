@@ -94,6 +94,11 @@ class MetaStore:
         # write as the mutation itself) so a restarted replicated-meta
         # member never re-applies logged mutations its store already holds
         self.applied_index = 0
+        # soft-deleted objects awaiting RECOVER or purge (reference DROP
+        # moves to a recycle window; RECOVER TENANT/DATABASE/TABLE undoes
+        # it, spi ast.rs:65-77). Payloads keep full schema state; data
+        # files stay on disk until purge_trash.
+        self.trash: dict[str, dict] = {"tenant": {}, "db": {}, "table": {}}
         # recently-applied raft request ids, persisted in the SAME atomic
         # meta.json write as the mutations they guard: a restarted member
         # replaying a retried duplicate proposal (or a retry reaching a
@@ -135,6 +140,7 @@ class MetaStore:
             "externals": self.externals,
             "applied_index": self.applied_index,
             "recent_req_ids": self.recent_req_ids,
+            "trash": self.trash,
             "next_ids": [self._next_bucket_id, self._next_replica_id, self._next_vnode_id],
         }
 
@@ -171,6 +177,7 @@ class MetaStore:
         self.externals = d.get("externals", {})
         self.applied_index = d.get("applied_index", 0)
         self.recent_req_ids = list(d.get("recent_req_ids", []))
+        self.trash = d.get("trash", {"tenant": {}, "db": {}, "table": {}})
         self._next_bucket_id, self._next_replica_id, self._next_vnode_id = d["next_ids"]
 
     def _notify(self, event: str, **kw):
@@ -215,23 +222,77 @@ class MetaStore:
             self._persist()
             self._notify("create_tenant", tenant=name)
 
-    def drop_tenant(self, name: str):
+    def drop_tenant(self, name: str, at: float | None = None):
+        """Soft delete: the tenant and all its databases move to the
+        recycle bin; RECOVER TENANT restores everything."""
+        import time as _time
+
         with self.lock:
             if name == DEFAULT_TENANT:
                 raise MetaError("cannot drop system tenant")
-            self.tenants.pop(name, None)
-            self.members.pop(name, None)
-            self.roles.pop(name, None)
+            if name not in self.tenants:
+                return
             dropped = [o for o in self.databases if o.startswith(name + ".")]
-            for owner in dropped:
-                self.databases.pop(owner, None)
-                self.tables.pop(owner, None)
-                self.buckets.pop(owner, None)
+            self.trash["tenant"][name] = {
+                "options": self.tenants.pop(name).to_dict(),
+                "members": self.members.pop(name, {}),
+                "roles": self.roles.pop(name, {}),
+                "dbs": {o: self._db_to_trash(o, at) for o in dropped},
+                "at": _time.time() if at is None else at,
+            }
             self._persist()
-            # per-db events so the engine reclaims vnodes + disk for each
             for owner in dropped:
-                self._notify("drop_db", owner=owner)
+                self._notify("trash_db", owner=owner)
             self._notify("drop_tenant", tenant=name)
+
+    def recover_tenant(self, name: str):
+        with self.lock:
+            payload = self.trash["tenant"].get(name)
+            if payload is None:
+                raise MetaError(f"tenant {name!r} is not in the recycle bin")
+            if name in self.tenants:
+                raise MetaError(
+                    f"cannot recover {name!r}: the name is in use again")
+            del self.trash["tenant"][name]
+            self.tenants[name] = TenantOptions.from_dict(payload["options"])
+            self.members[name] = payload["members"]
+            self.roles[name] = payload["roles"]
+            for owner, db_payload in payload["dbs"].items():
+                self._db_from_trash(owner, db_payload)
+            self._persist()
+            for owner in payload["dbs"]:
+                self._notify("recover_db", owner=owner)
+            self._notify("create_tenant", tenant=name)
+
+    def purge_trash(self, older_than_s: float = 0.0,
+                    now: float | None = None):
+        """Permanently reclaim recycled objects (fires the hard-delete
+        events so engines drop vnode data and disk). In replicated meta
+        groups the PROPOSER pins `now` so every member purges the same
+        set."""
+        import time as _time
+
+        cutoff = (_time.time() if now is None else now) - older_than_s
+        with self.lock:
+            fire = []
+            for owner in [o for o, p in self.trash["db"].items()
+                          if p["at"] <= cutoff]:
+                del self.trash["db"][owner]
+                fire.append(("drop_db", {"owner": owner}))
+            for key in [k for k, p in self.trash["table"].items()
+                        if p["at"] <= cutoff]:
+                p = self.trash["table"].pop(key)
+                owner, _, table = key.rpartition(".")
+                fire.append(("drop_table", {"owner": owner, "table": table}))
+            for name in [n for n, p in self.trash["tenant"].items()
+                         if p["at"] <= cutoff]:
+                p = self.trash["tenant"].pop(name)
+                for owner in p["dbs"]:
+                    fire.append(("drop_db", {"owner": owner}))
+            self._persist()
+            for event, kw in fire:
+                self._notify(event, **kw)
+            return len(fire)
 
     def create_user(self, name: str, password: str = "", admin: bool = False,
                     comment: str = ""):
@@ -424,18 +485,58 @@ class MetaStore:
             self._persist()
             self._notify("alter_db", owner=schema.owner)
 
-    def drop_database(self, tenant: str, db: str, if_exists: bool = True):
+    def _db_to_trash(self, owner: str, at: float | None = None) -> dict:
+        """Capture a database's full meta state for the recycle bin.
+        `at` is pinned by the PROPOSER in replicated-meta groups so every
+        member records the identical timestamp."""
+        import time as _time
+
+        return {
+            "schema": self.databases.pop(owner).to_dict(),
+            "tables": {t: s.to_dict()
+                       for t, s in self.tables.pop(owner, {}).items()},
+            "buckets": [b.to_dict() for b in self.buckets.pop(owner, [])],
+            "at": _time.time() if at is None else at,
+        }
+
+    def _db_from_trash(self, owner: str, payload: dict) -> None:
+        self.databases[owner] = DatabaseSchema.from_dict(payload["schema"])
+        self.tables[owner] = {t: TskvTableSchema.from_dict(s)
+                              for t, s in payload["tables"].items()}
+        self.buckets[owner] = [BucketInfo.from_dict(b)
+                               for b in payload["buckets"]]
+
+    def drop_database(self, tenant: str, db: str, if_exists: bool = True,
+                      at: float | None = None):
+        """Soft delete: the database moves to the recycle bin (data files
+        untouched); RECOVER DATABASE restores it, purge_trash reclaims."""
         with self.lock:
             owner = f"{tenant}.{db}"
             if owner not in self.databases:
                 if if_exists:
                     return
                 raise DatabaseNotFound(db)
-            del self.databases[owner]
-            self.tables.pop(owner, None)
-            self.buckets.pop(owner, None)
+            self.trash["db"][owner] = self._db_to_trash(owner, at)
             self._persist()
-            self._notify("drop_db", owner=owner)
+            self._notify("trash_db", owner=owner)
+
+    def recover_database(self, tenant: str, db: str):
+        with self.lock:
+            owner = f"{tenant}.{db}"
+            payload = self.trash["db"].get(owner)
+            if payload is None:
+                raise MetaError(f"database {db!r} is not in the recycle bin")
+            if owner in self.databases:
+                raise MetaError(
+                    f"cannot recover {db!r}: the name is in use again")
+            if tenant not in self.tenants:
+                raise MetaError(
+                    f"cannot recover {db!r}: tenant {tenant!r} is gone "
+                    f"(RECOVER TENANT first)")
+            del self.trash["db"][owner]
+            self._db_from_trash(owner, payload)
+            self._persist()
+            self._notify("recover_db", owner=owner)
 
     def database(self, tenant: str, db: str) -> DatabaseSchema:
         owner = f"{tenant}.{db}"
@@ -471,7 +572,12 @@ class MetaStore:
             self._persist()
             self._notify("update_table", owner=owner, table=schema.name)
 
-    def drop_table(self, tenant: str, db: str, table: str, if_exists: bool = True):
+    def drop_table(self, tenant: str, db: str, table: str,
+                   if_exists: bool = True, at: float | None = None):
+        """Soft delete (see drop_database): schema to the recycle bin,
+        row data stays in the vnodes until purge."""
+        import time as _time
+
         with self.lock:
             owner = f"{tenant}.{db}"
             tbls = self.tables.get(owner, {})
@@ -479,9 +585,30 @@ class MetaStore:
                 if if_exists:
                     return
                 raise TableNotFound(table)
-            del tbls[table]
+            self.trash["table"][f"{owner}.{table}"] = {
+                "schema": tbls.pop(table).to_dict(),
+                "at": _time.time() if at is None else at}
             self._persist()
-            self._notify("drop_table", owner=owner, table=table)
+            self._notify("trash_table", owner=owner, table=table)
+
+    def recover_table(self, tenant: str, db: str, table: str):
+        with self.lock:
+            owner = f"{tenant}.{db}"
+            key = f"{owner}.{table}"
+            payload = self.trash["table"].get(key)
+            if payload is None:
+                raise MetaError(f"table {table!r} is not in the recycle bin")
+            if owner not in self.databases:
+                raise MetaError(
+                    f"cannot recover {table!r}: database {db!r} is gone")
+            if table in self.tables.get(owner, {}):
+                raise MetaError(
+                    f"cannot recover {table!r}: the name is in use again")
+            del self.trash["table"][key]
+            self.tables.setdefault(owner, {})[table] = \
+                TskvTableSchema.from_dict(payload["schema"])
+            self._persist()
+            self._notify("recover_table", owner=owner, table=table)
 
     def table(self, tenant: str, db: str, table: str) -> TskvTableSchema:
         owner = f"{tenant}.{db}"
@@ -621,6 +748,28 @@ class MetaStore:
             self._persist()
             self._notify("update_vnode", owner=owner, vnode_id=vnode_id,
                          rs_id=rs.id, node_id=-1, status=-1)
+
+    def remove_replica_set(self, rs_id: int) -> list:
+        """REPLICA DESTORY: remove a (damaged) replica set wholesale from
+        its bucket (reference parser.rs:2046 / manager.rs destory) —
+        callers drop the member data. → the removed VnodeInfo list."""
+        with self.lock:
+            hit = self.find_replica_set(rs_id)
+            if hit is None:
+                raise MetaError(f"unknown replica set {rs_id}")
+            owner, rs = hit
+            removed = list(rs.vnodes)
+            for buckets in self.buckets.values():
+                for b in buckets:
+                    if rs in b.shard_group:
+                        b.shard_group.remove(rs)
+            # a bucket with no shards left can serve nothing: drop it
+            self.buckets[owner] = [b for b in self.buckets[owner]
+                                   if b.shard_group]
+            self._persist()
+            self._notify("update_vnode", owner=owner, vnode_id=-1,
+                         rs_id=rs_id, node_id=-1, status=-1)
+            return removed
 
     def promote_replica(self, vnode_id: int):
         """REPLICA PROMOTE: make this replica the placement leader."""

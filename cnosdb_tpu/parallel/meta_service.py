@@ -48,15 +48,21 @@ MUTATIONS = frozenset([
     "create_role", "drop_role", "grant_db_privilege", "revoke_db_privilege",
     "create_external_table", "drop_external_table",
     "update_vnode", "add_replica_vnode", "remove_replica_vnode",
-    "promote_replica",
+    "promote_replica", "remove_replica_set",
+    "recover_tenant", "recover_database", "recover_table", "purge_trash",
 ])
 
 
 def _dehydrate(result):
+    from ..models.meta_data import VnodeInfo
+
     if isinstance(result, BucketInfo):
         return {"_type": "bucket", "v": result.to_dict()}
     if isinstance(result, list) and result and isinstance(result[0], BucketInfo):
         return {"_type": "buckets", "v": [b.to_dict() for b in result]}
+    if isinstance(result, list) and result \
+            and isinstance(result[0], VnodeInfo):
+        return {"_type": "vnodes", "v": [x.to_dict() for x in result]}
     return {"_type": "raw", "v": result}
 
 
@@ -66,6 +72,10 @@ def _rehydrate(wrapped):
         return BucketInfo.from_dict(v)
     if t == "buckets":
         return [BucketInfo.from_dict(b) for b in v]
+    if t == "vnodes":
+        from ..models.meta_data import VnodeInfo
+
+        return [VnodeInfo.from_dict(x) for x in v]
     return v
 
 
@@ -350,6 +360,13 @@ class MetaService:
                     # pin placement candidates at PROPOSAL time: apply must
                     # be deterministic across members, liveness is not
                     kwargs["nodes"] = self.store.placement_candidates()
+                # wall-clock reads are likewise pinned at proposal: every
+                # member must stamp/purge trash identically
+                if method in ("drop_database", "drop_table",
+                              "drop_tenant") and kwargs.get("at") is None:
+                    kwargs["at"] = time.time()
+                if method == "purge_trash" and kwargs.get("now") is None:
+                    kwargs["now"] = time.time()
                 try:
                     self.raft.propose(
                         1, _mp.packb([method, kwargs, req_id],
@@ -617,6 +634,22 @@ class MetaClient:
 
     def promote_replica(self, vnode_id):
         return self._forward("promote_replica", vnode_id=vnode_id)
+
+    def remove_replica_set(self, rs_id):
+        return self._forward("remove_replica_set", rs_id=rs_id)
+
+    def recover_tenant(self, name):
+        return self._forward("recover_tenant", name=name)
+
+    def recover_database(self, tenant, db):
+        return self._forward("recover_database", tenant=tenant, db=db)
+
+    def recover_table(self, tenant, db, table):
+        return self._forward("recover_table", tenant=tenant, db=db,
+                             table=table)
+
+    def purge_trash(self, older_than_s=0.0):
+        return self._forward("purge_trash", older_than_s=older_than_s)
 
     def expire_buckets(self, tenant, db, now_ns):
         return self._forward("expire_buckets", tenant=tenant, db=db,

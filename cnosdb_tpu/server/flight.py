@@ -1,15 +1,27 @@
 """Arrow Flight SQL service.
 
 Role-parity with the reference's Flight SQL server (main/src/flight_sql/
-flight_sql_server.rs): clients authenticate with basic auth, submit SQL via
-GetFlightInfo/DoGet (the simplified Flight pattern pyarrow clients use:
-`flight.connect(...).do_get(Ticket(sql))`), and receive Arrow record
-batches. Results convert from the engine's numpy columns zero-copy where
-possible.
+flight_sql_server.rs, 1,255 LoC): implements the actual FlightSQL command
+set over gRPC — FlightDescriptor.cmd carries a protobuf `Any` wrapping
+arrow.flight.protocol.sql messages:
+
+  CommandStatementQuery  → GetFlightInfo executes the statement, returns
+                           the REAL result schema + a TicketStatementQuery
+                           endpoint; DoGet streams the cached result
+  CommandGetCatalogs / CommandGetDbSchemas / CommandGetTables
+                         → catalog browsing per the FlightSQL spec
+
+The three messages involved are tiny, so their protobuf wire format is
+encoded/decoded directly (varint + length-delimited fields) — no protoc
+dependency. A legacy raw ticket (b"<db>\\x00<sql>" or plain SQL bytes)
+remains accepted for simple `do_get(Ticket(sql))` clients.
+
+Clients authenticate with basic auth middleware, as in the reference.
 """
 from __future__ import annotations
 
 import base64
+import secrets
 import threading
 
 import numpy as np
@@ -24,7 +36,113 @@ except Exception:  # pragma: no cover - pyarrow always present in this env
 
 from ..sql.executor import QueryExecutor, ResultSet, Session
 
+# ---------------------------------------------------------------- protobuf
+_SQL_NS = "type.googleapis.com/arrow.flight.protocol.sql."
 
+
+def _pb_varint(n: int) -> bytes:
+    out = bytearray()
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        if n:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def _pb_bytes_field(field_no: int, payload: bytes) -> bytes:
+    return _pb_varint((field_no << 3) | 2) + _pb_varint(len(payload)) + payload
+
+
+def _pb_parse(data: bytes) -> dict[int, list]:
+    """Minimal protobuf reader: varint (0) and length-delimited (2)."""
+    out: dict[int, list] = {}
+    i, n = 0, len(data)
+    while i < n:
+        key = 0
+        shift = 0
+        while True:
+            b = data[i]
+            i += 1
+            key |= (b & 0x7F) << shift
+            shift += 7
+            if not b & 0x80:
+                break
+        field, wt = key >> 3, key & 7
+        if wt == 0:
+            val = 0
+            shift = 0
+            while True:
+                b = data[i]
+                i += 1
+                val |= (b & 0x7F) << shift
+                shift += 7
+                if not b & 0x80:
+                    break
+        elif wt == 2:
+            ln = 0
+            shift = 0
+            while True:
+                b = data[i]
+                i += 1
+                ln |= (b & 0x7F) << shift
+                shift += 7
+                if not b & 0x80:
+                    break
+            val = data[i:i + ln]
+            i += ln
+        else:  # pragma: no cover - the sql messages only use wt 0/2
+            raise ValueError(f"unsupported protobuf wire type {wt}")
+        out.setdefault(field, []).append(val)
+    return out
+
+
+def _any_pack(type_name: str, payload: bytes) -> bytes:
+    return (_pb_bytes_field(1, (_SQL_NS + type_name).encode())
+            + _pb_bytes_field(2, payload))
+
+
+def _any_unpack(raw: bytes) -> tuple[str, bytes] | None:
+    """→ (short type name, value bytes) for arrow.flight.protocol.sql
+    messages, else None (legacy raw-SQL descriptors)."""
+    try:
+        fields = _pb_parse(raw)
+        url = fields.get(1, [b""])[0]
+        if not isinstance(url, bytes) or b"arrow.flight.protocol.sql." \
+                not in url:
+            return None
+        val = fields.get(2, [b""])[0]
+        return url.rsplit(b".", 1)[-1].decode(), \
+            val if isinstance(val, bytes) else b""
+    except Exception:
+        return None
+
+
+def command_statement_query(sql: str) -> bytes:
+    """Client-side helper: a standard FlightSQL statement descriptor
+    (what adbc/JDBC drivers send)."""
+    return _any_pack("CommandStatementQuery",
+                     _pb_bytes_field(1, sql.encode()))
+
+
+def command_get_tables(include_schema: bool = False) -> bytes:
+    payload = b""
+    if include_schema:
+        payload += _pb_varint((5 << 3) | 0) + _pb_varint(1)
+    return _any_pack("CommandGetTables", payload)
+
+
+def command_get_catalogs() -> bytes:
+    return _any_pack("CommandGetCatalogs", b"")
+
+
+def command_get_db_schemas() -> bytes:
+    return _any_pack("CommandGetDbSchemas", b"")
+
+
+# ---------------------------------------------------------------- arrow
 def result_to_arrow(rs: ResultSet) -> "pa.Table":
     arrays, names = [], []
     for name, col in zip(rs.names, rs.columns):
@@ -39,6 +157,23 @@ def result_to_arrow(rs: ResultSet) -> "pa.Table":
 
 
 if FLIGHT_AVAILABLE:
+
+    class _DbHeaderMiddleware(fl.ServerMiddleware):
+        def __init__(self, db: str):
+            self.db = db
+
+    class _DbHeaderFactory(fl.ServerMiddlewareFactory):
+        """FlightSQL has no database field in CommandStatementQuery;
+        drivers select one via a connection header (adbc:
+        `adbc.flight.sql.rpc.call_header.database`, surfaced as a
+        `database` gRPC header here)."""
+
+        def start_call(self, info, headers):
+            db = None
+            for k, v in headers.items():
+                if k.lower() in ("database", "db", "x-cnosdb-database"):
+                    db = v[0] if isinstance(v, (list, tuple)) else v
+            return _DbHeaderMiddleware(db or "public")
 
     class _BasicAuthMiddlewareFactory(fl.ServerMiddlewareFactory):
         def __init__(self, server):
@@ -69,27 +204,129 @@ if FLIGHT_AVAILABLE:
             self.auth_enabled = auth_enabled
             super().__init__(
                 location,
-                middleware={"auth": _BasicAuthMiddlewareFactory(self)})
+                middleware={"auth": _BasicAuthMiddlewareFactory(self),
+                            "db": _DbHeaderFactory()})
             self.location = location
+            # statement_handle → executed Table (one do_get consumes it)
+            self._results: dict[bytes, "pa.Table"] = {}
+            self._results_lock = threading.Lock()
 
-        # ticket payload: b"<db>\x00<sql>" (db optional)
-        def do_get(self, context, ticket):
-            raw = ticket.ticket
+        # ------------------------------------------------------ execution
+        def _execute(self, db: str, sql: str) -> "pa.Table":
+            session = Session(database=db or "public")
+            rs = self.executor.execute_one(sql, session)
+            return result_to_arrow(rs)
+
+        def _catalog_table(self, kind: str, include_schema: bool):
+            dbs = sorted({o.split(".", 1)[1]
+                          for o in self.meta.databases})
+            if kind == "CommandGetCatalogs":
+                return pa.table({"catalog_name": ["cnosdb"]})
+            if kind == "CommandGetDbSchemas":
+                return pa.table({
+                    "catalog_name": ["cnosdb"] * len(dbs),
+                    "db_schema_name": dbs})
+            rows = {"catalog_name": [], "db_schema_name": [],
+                    "table_name": [], "table_type": []}
+            schemas = []
+            for owner, tables in sorted(self.meta.tables.items()):
+                db = owner.split(".", 1)[1]
+                for tname, ts in sorted(tables.items()):
+                    rows["catalog_name"].append("cnosdb")
+                    rows["db_schema_name"].append(db)
+                    rows["table_name"].append(tname)
+                    rows["table_type"].append("TABLE")
+                    if include_schema:
+                        cols = {c: pa.array([], pa.float64())
+                                for c in ts.field_names()}
+                        schemas.append(
+                            pa.table(cols).schema.serialize().to_pybytes()
+                            if cols else b"")
+            if include_schema:
+                rows["table_schema"] = schemas
+            return pa.table(rows)
+
+        def _info_for(self, descriptor, table: "pa.Table",
+                      handle: bytes) -> "fl.FlightInfo":
+            with self._results_lock:
+                if len(self._results) > 64:
+                    self._results.clear()   # dropped handles re-execute
+                self._results[handle] = table
+            ticket = fl.Ticket(_any_pack(
+                "TicketStatementQuery", _pb_bytes_field(1, handle)))
+            endpoint = fl.FlightEndpoint(ticket, [self.location])
+            return fl.FlightInfo(table.schema, descriptor, [endpoint],
+                                 table.num_rows, table.nbytes)
+
+        # ------------------------------------------------------ protocol
+        def get_flight_info(self, context, descriptor):
+            raw = descriptor.command or b""
+            parsed = _any_unpack(raw)
+            if parsed is not None:
+                kind, val = parsed
+                if kind == "CommandStatementQuery":
+                    fields = _pb_parse(val)
+                    sql = fields.get(1, [b""])[0].decode()
+                    db = "public"
+                    try:
+                        db = context.get_middleware("db").db
+                    except Exception:
+                        pass
+                    # statement handle doubles as a re-execution recipe;
+                    # the uniqueness suffix is hex so it can never contain
+                    # the \x00 separators
+                    handle = db.encode() + b"\x00" + sql.encode() \
+                        + b"\x00" + secrets.token_hex(8).encode()
+                    return self._info_for(
+                        descriptor, self._execute(db, sql), handle)
+                if kind in ("CommandGetCatalogs", "CommandGetDbSchemas",
+                            "CommandGetTables"):
+                    include_schema = False
+                    if kind == "CommandGetTables":
+                        include_schema = bool(
+                            _pb_parse(val).get(5, [0])[0])
+                    table = self._catalog_table(kind, include_schema)
+                    return self._info_for(
+                        descriptor, table,
+                        b"\x00" + kind.encode() + b"\x00"
+                        + secrets.token_hex(8).encode())
+                raise fl.FlightServerError(
+                    f"unsupported FlightSQL command {kind}")
+            # legacy: descriptor.command is raw (db\x00)sql — execute and
+            # advertise the true schema the same way
             db, sep, sql = raw.partition(b"\x00")
             if not sep:
                 db, sql = b"public", raw
-            session = Session(database=db.decode() or "public")
-            rs = self.executor.execute_one(sql.decode(), session)
-            table = result_to_arrow(rs)
-            return fl.RecordBatchStream(table)
+            handle = db + b"\x00" + sql + b"\x00" \
+                + secrets.token_hex(8).encode()
+            return self._info_for(
+                descriptor, self._execute(db.decode(), sql.decode()), handle)
 
-        def get_flight_info(self, context, descriptor):
-            sql = descriptor.command or b""
-            ticket = fl.Ticket(sql)
-            endpoint = fl.FlightEndpoint(ticket, [self.location])
-            # execute lazily at do_get; advertise unknown schema cheaply
-            schema = pa.schema([])
-            return fl.FlightInfo(schema, descriptor, [endpoint], -1, -1)
+        def do_get(self, context, ticket):
+            raw = ticket.ticket
+            parsed = _any_unpack(raw)
+            if parsed is not None and parsed[0] == "TicketStatementQuery":
+                handle = _pb_parse(parsed[1]).get(1, [b""])[0]
+                with self._results_lock:
+                    table = self._results.pop(handle, None)
+                if table is None:
+                    # evicted / different process: re-derive from the
+                    # recipe embedded in the handle
+                    db, _, rest = handle.partition(b"\x00")
+                    sql = rest.rsplit(b"\x00", 1)[0]
+                    if not sql:
+                        raise fl.FlightServerError("stale statement handle")
+                    if db == b"":   # catalog command handle
+                        table = self._catalog_table(sql.decode(), False)
+                    else:
+                        table = self._execute(db.decode(), sql.decode())
+                return fl.RecordBatchStream(table)
+            # legacy ticket payload: b"<db>\x00<sql>" (db optional)
+            db, sep, sql = raw.partition(b"\x00")
+            if not sep:
+                db, sql = b"public", raw
+            return fl.RecordBatchStream(
+                self._execute(db.decode(), sql.decode()))
 
     def start_flight_server(executor: QueryExecutor, port: int,
                             auth_enabled: bool = False) -> "FlightSqlServer":

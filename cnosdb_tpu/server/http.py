@@ -47,6 +47,15 @@ class HttpServer:
             web.post("/api/v1/prom/write", self.handle_prom_write),
             web.post("/api/v1/prom/read", self.handle_prom_read),
             web.post("/api/v1/es/_bulk", self.handle_es_bulk),
+            # OTLP trace ingest + jaeger query API (reference
+            # http_service.rs:1673-2407, otlp_to_jaeger.rs)
+            web.post("/api/v1/traces", self.handle_otlp_traces),
+            web.post("/v1/traces", self.handle_otlp_traces),
+            web.get("/api/services", self.handle_jaeger_services),
+            web.get("/api/services/{service}/operations",
+                    self.handle_jaeger_operations),
+            web.get("/api/traces", self.handle_jaeger_traces),
+            web.get("/api/traces/{trace_id}", self.handle_jaeger_trace),
             web.get("/metrics", self.handle_metrics),
             web.get("/debug/health", self.handle_ping),
             web.get("/debug/traces", self.handle_traces),
@@ -427,6 +436,163 @@ class HttpServer:
         self.metrics.incr("es_bulk_writes")
         self.metrics.incr("es_bulk_points_written", batch.n_rows())
         return web.json_response({"errors": False, "items": batch.n_rows()})
+
+    # --------------------------------------------------- traces (OTLP in)
+    async def handle_otlp_traces(self, request):
+        """OTLP/HTTP trace export → the `trace_spans` measurement: spans
+        become rows queryable by SQL AND by the jaeger API below."""
+        from ..models.points import WriteBatch
+        from ..models.schema import ValueType
+        from .otlp import TRACE_TABLE, parse_otlp_json
+
+        session = self._session(request)
+        self._authorize_write(session)
+        ctype = request.headers.get("Content-Type", "")
+        if "protobuf" in ctype:
+            return web.Response(
+                status=415,
+                text="OTLP/HTTP protobuf encoding not supported; send the "
+                     "OTLP JSON encoding (otlphttp exporter: encoding=json)")
+        body = await request.read()
+        try:
+            rows = parse_otlp_json(body)
+        except Exception as e:
+            return web.Response(status=400, text=f"bad OTLP JSON: {e}")
+        if rows:
+            wb = WriteBatch.from_rows(
+                TRACE_TABLE, rows,
+                tag_names=["service_name", "span_id"],
+                field_types={
+                    "trace_id": ValueType.STRING,
+                    "parent_span_id": ValueType.STRING,
+                    "operation_name": ValueType.STRING,
+                    "span_kind": ValueType.STRING,
+                    "duration_ns": ValueType.INTEGER,
+                    "status_code": ValueType.INTEGER,
+                    "attributes": ValueType.STRING,
+                })
+            loop = asyncio.get_running_loop()
+            await loop.run_in_executor(
+                None, lambda: self.coord.write_points(
+                    session.tenant, session.database, wb))
+        return web.json_response({"partialSuccess": {}})
+
+    # --------------------------------------------------- jaeger query API
+    def _trace_rows(self, session, where: str, limit: int | None = None):
+        from .otlp import TRACE_TABLE
+
+        sql = (f"SELECT time, service_name, span_id, trace_id, "
+               f"parent_span_id, operation_name, span_kind, duration_ns, "
+               f"status_code, attributes FROM {TRACE_TABLE}")
+        if where:
+            sql += f" WHERE {where}"
+        sql += " ORDER BY time DESC"
+        if limit:
+            sql += f" LIMIT {int(limit)}"
+        rs = self.executor.execute_one(sql, session)
+        return [dict(zip(rs.names, row)) for row in rs.rows()]
+
+    async def handle_jaeger_services(self, request):
+        from .otlp import TRACE_TABLE
+
+        session = self._session(request)
+        self._authorize_read(session)
+
+        def run():
+            try:
+                rs = self.executor.execute_one(
+                    f"SELECT DISTINCT service_name FROM {TRACE_TABLE} "
+                    f"ORDER BY service_name", session)
+                return [str(v) for v in rs.columns[0]]
+            except CnosError:
+                return []   # no traces ingested yet
+        loop = asyncio.get_running_loop()
+        data = await loop.run_in_executor(None, run)
+        return web.json_response({"data": data, "total": len(data)})
+
+    async def handle_jaeger_operations(self, request):
+        from .otlp import TRACE_TABLE
+
+        session = self._session(request)
+        self._authorize_read(session)
+        svc = request.match_info["service"].replace("'", "''")
+
+        def run():
+            try:
+                rs = self.executor.execute_one(
+                    f"SELECT DISTINCT operation_name FROM {TRACE_TABLE} "
+                    f"WHERE service_name = '{svc}' ORDER BY operation_name",
+                    session)
+                return [str(v) for v in rs.columns[0]]
+            except CnosError:
+                return []
+        loop = asyncio.get_running_loop()
+        data = await loop.run_in_executor(None, run)
+        return web.json_response({"data": data, "total": len(data)})
+
+    async def handle_jaeger_traces(self, request):
+        from .otlp import spans_to_jaeger_traces
+
+        session = self._session(request)
+        self._authorize_read(session)
+        svc = request.query.get("service", "").replace("'", "''")
+        op = request.query.get("operation", "").replace("'", "''")
+        limit = int(request.query.get("limit", 20))
+
+        def run():
+            try:
+                where = []
+                if svc:
+                    where.append(f"service_name = '{svc}'")
+                if op:
+                    where.append(f"operation_name = '{op}'")
+                if "start" in request.query:   # µs, jaeger convention
+                    where.append(
+                        f"time >= {int(request.query['start']) * 1000}")
+                if "end" in request.query:
+                    where.append(
+                        f"time <= {int(request.query['end']) * 1000}")
+                probe = self._trace_rows(session, " AND ".join(where),
+                                         limit=limit * 50)
+                ids: list[str] = []
+                for r in probe:
+                    if r["trace_id"] not in ids:
+                        ids.append(r["trace_id"])
+                    if len(ids) >= limit:
+                        break
+                if not ids:
+                    return []
+                idlist = ", ".join(
+                    "'" + i.replace("'", "''") + "'" for i in ids)
+                rows = self._trace_rows(session, f"trace_id IN ({idlist})")
+                return spans_to_jaeger_traces(rows)
+            except CnosError:
+                return []
+        loop = asyncio.get_running_loop()
+        data = await loop.run_in_executor(None, run)
+        return web.json_response({"data": data, "total": len(data)})
+
+    async def handle_jaeger_trace(self, request):
+        from .otlp import spans_to_jaeger_traces
+
+        session = self._session(request)
+        self._authorize_read(session)
+        tid = request.match_info["trace_id"].replace("'", "''")
+
+        def run():
+            try:
+                rows = self._trace_rows(session, f"trace_id = '{tid}'")
+                return spans_to_jaeger_traces(rows)
+            except CnosError:
+                return []
+        loop = asyncio.get_running_loop()
+        data = await loop.run_in_executor(None, run)
+        if not data:
+            return web.json_response(
+                {"data": [], "errors": [{"code": 404,
+                                         "msg": "trace not found"}]},
+                status=404)
+        return web.json_response({"data": data, "total": len(data)})
 
     async def handle_metrics(self, request):
         return web.Response(text=self.metrics.prometheus_text(),

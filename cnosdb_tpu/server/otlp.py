@@ -1,0 +1,129 @@
+"""Third-party trace ingest + Jaeger query API.
+
+Role-parity with the reference's OTLP→Jaeger pipeline
+(main/src/opentelemetry/otlp_to_jaeger.rs, 920 LoC;
+main/src/http/http_service.rs:1673-2407 jaeger HTTP endpoints): OTLP/HTTP
+trace export lands in the database's own storage (a `trace_spans`
+measurement — service name as the tag, span identity/timing/attributes
+as fields), and Jaeger's HTTP query API is answered by SQL over that
+table, so stored traces are ALSO queryable like any other data.
+
+The ingest accepts the OTLP/HTTP JSON encoding (the `otlphttp` exporter's
+json mode); protobuf-encoded OTLP is rejected with 415 — the JSON
+encoding is part of the OTLP spec and needs no generated bindings.
+"""
+from __future__ import annotations
+
+import json
+
+TRACE_TABLE = "trace_spans"
+
+
+def _attr_value(v: dict):
+    for k in ("stringValue", "intValue", "doubleValue", "boolValue"):
+        if k in v:
+            val = v[k]
+            return int(val) if k == "intValue" else val
+    if "arrayValue" in v:
+        return [_attr_value(x) for x in v["arrayValue"].get("values", [])]
+    return None
+
+
+def _attrs(lst) -> dict:
+    return {a["key"]: _attr_value(a.get("value", {})) for a in (lst or [])}
+
+
+_KINDS = {0: "unspecified", 1: "internal", 2: "server", 3: "client",
+          4: "producer", 5: "consumer"}
+
+
+def parse_otlp_json(body: bytes) -> list[dict]:
+    """OTLP/HTTP JSON ExportTraceServiceRequest → span row dicts."""
+    req = json.loads(body)
+    rows: list[dict] = []
+    for rs in req.get("resourceSpans", []):
+        rattrs = _attrs(rs.get("resource", {}).get("attributes"))
+        service = str(rattrs.get("service.name", "unknown"))
+        for ss in rs.get("scopeSpans", []) + rs.get("instrumentationLibrarySpans", []):
+            for sp in ss.get("spans", []):
+                start = int(sp.get("startTimeUnixNano", 0))
+                end = int(sp.get("endTimeUnixNano", start))
+                kind = sp.get("kind", 0)
+                if isinstance(kind, str):   # "SPAN_KIND_SERVER" form
+                    kind = {f"SPAN_KIND_{v.upper()}": k
+                            for k, v in _KINDS.items()}.get(kind, 0)
+                status = sp.get("status", {}).get("code", 0)
+                if isinstance(status, str):
+                    status = {"STATUS_CODE_UNSET": 0, "STATUS_CODE_OK": 1,
+                              "STATUS_CODE_ERROR": 2}.get(status, 0)
+                rows.append({
+                    "time": start,
+                    "service_name": service,
+                    "trace_id": str(sp.get("traceId", "")),
+                    "span_id": str(sp.get("spanId", "")),
+                    "parent_span_id": str(sp.get("parentSpanId", "") or ""),
+                    "operation_name": str(sp.get("name", "")),
+                    "span_kind": _KINDS.get(int(kind), "unspecified"),
+                    "duration_ns": max(0, end - start),
+                    "status_code": int(status),
+                    "attributes": json.dumps(
+                        {**rattrs, **_attrs(sp.get("attributes"))},
+                        sort_keys=True),
+                })
+    return rows
+
+
+# ------------------------------------------------------------- jaeger out
+def jaeger_tags(attr_json: str) -> list[dict]:
+    try:
+        attrs = json.loads(attr_json) if attr_json else {}
+    except Exception:
+        attrs = {}
+    out = []
+    for k, v in sorted(attrs.items()):
+        t = ("bool" if isinstance(v, bool)
+             else "int64" if isinstance(v, int)
+             else "float64" if isinstance(v, float) else "string")
+        out.append({"key": k, "type": t,
+                    "value": v if t != "string" else str(v)})
+    return out
+
+
+def spans_to_jaeger_traces(rows: list[dict]) -> list[dict]:
+    """Engine rows (dicts with the trace_spans columns) → jaeger /api
+    trace objects, spans grouped by trace id."""
+    by_trace: dict[str, list[dict]] = {}
+    for r in rows:
+        by_trace.setdefault(r["trace_id"], []).append(r)
+    out = []
+    for trace_id, spans in by_trace.items():
+        procs: dict[str, str] = {}
+        jspans = []
+        for r in spans:
+            svc = r["service_name"]
+            pid = procs.setdefault(svc, f"p{len(procs) + 1}")
+            refs = []
+            if r.get("parent_span_id"):
+                refs.append({"refType": "CHILD_OF", "traceID": trace_id,
+                             "spanID": r["parent_span_id"]})
+            jspans.append({
+                "traceID": trace_id,
+                "spanID": r["span_id"],
+                "operationName": r["operation_name"],
+                "references": refs,
+                "startTime": int(r["time"]) // 1000,        # µs
+                "duration": int(r["duration_ns"]) // 1000,  # µs
+                "tags": jaeger_tags(r.get("attributes", ""))
+                + [{"key": "span.kind", "type": "string",
+                    "value": r.get("span_kind", "unspecified")},
+                   {"key": "otel.status_code", "type": "int64",
+                    "value": int(r.get("status_code", 0))}],
+                "processID": pid,
+            })
+        out.append({
+            "traceID": trace_id,
+            "spans": jspans,
+            "processes": {pid: {"serviceName": svc, "tags": []}
+                          for svc, pid in procs.items()},
+        })
+    return out

@@ -115,6 +115,7 @@ class CreateTable:
 class DropTable:
     name: str
     if_exists: bool = False
+    database: Optional[str] = None
 
 
 @dataclass
@@ -259,13 +260,24 @@ class CreateExternalTable:
 @dataclass
 class VnodeAdmin:
     """MOVE|COPY|DROP|COMPACT VNODE <id> [TO NODE <n>] and REPLICA
-    ADD|REMOVE|PROMOTE (reference spi ast.rs:56-73 vnode/replica admin)."""
+    ADD|REMOVE|PROMOTE|DESTORY (reference spi ast.rs:56-73 vnode/replica
+    admin)."""
 
     op: str                     # move|copy|drop|compact|replica_add|
-    # replica_remove|replica_promote
+    # replica_remove|replica_promote|replica_destory
     vnode_id: int = 0
     node_id: int = 0
     replica_set_id: int = 0
+
+
+@dataclass
+class RecoverStmt:
+    """RECOVER TENANT|DATABASE|TABLE — undo a soft DROP (reference spi
+    ast.rs:65-77 RecoverTenant/RecoverDatabase/RecoverTable)."""
+
+    kind: str                   # tenant|database|table
+    name: str
+    database: Optional[str] = None
 
 
 @dataclass

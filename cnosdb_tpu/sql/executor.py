@@ -174,12 +174,12 @@ class QueryExecutor:
         if isinstance(stmt, ast.CreateTable):
             return self._create_table(stmt, session)
         if isinstance(stmt, ast.DropTable):
+            db = stmt.database or session.database
             # an external table and a tskv table cannot share a name, so
             # whichever exists is the drop target
-            if self.meta.drop_external_table(session.tenant,
-                                             session.database, stmt.name):
+            if self.meta.drop_external_table(session.tenant, db, stmt.name):
                 return ResultSet.message("ok")
-            self.meta.drop_table(session.tenant, session.database, stmt.name,
+            self.meta.drop_table(session.tenant, db, stmt.name,
                                  if_exists=stmt.if_exists)
             return ResultSet.message("ok")
         if isinstance(stmt, ast.AlterTable):
@@ -260,6 +260,16 @@ class QueryExecutor:
             return self._copy(stmt, session)
         if isinstance(stmt, ast.VnodeAdmin):
             return self._vnode_admin(stmt)
+        if isinstance(stmt, ast.RecoverStmt):
+            if stmt.kind == "tenant":
+                self.meta.recover_tenant(stmt.name)
+            elif stmt.kind == "database":
+                self.meta.recover_database(session.tenant, stmt.name)
+            else:
+                self.meta.recover_table(
+                    session.tenant, stmt.database or session.database,
+                    stmt.name)
+            return ResultSet.message("ok")
         if isinstance(stmt, ast.CreateStream):
             return self._create_stream(stmt, session)
         if isinstance(stmt, ast.DropStream):
@@ -294,7 +304,8 @@ class QueryExecutor:
                     ast.CopyStmt, ast.CreateExternalTable,
                     # cluster-topology mutation reaches every tenant's
                     # vnodes via the global placement map: instance scope
-                    ast.VnodeAdmin, ast.CompactStmt, ast.FlushStmt)
+                    ast.VnodeAdmin, ast.CompactStmt, ast.FlushStmt,
+                    ast.RecoverStmt)
 
     def _check_privilege(self, stmt, session: Session):
         """RBAC gate (reference auth/auth_control.rs AccessControlImpl →
@@ -804,6 +815,9 @@ class QueryExecutor:
             return ResultSet.message("ok")
         if stmt.op == "replica_promote":
             self.meta.promote_replica(stmt.vnode_id)
+            return ResultSet.message("ok")
+        if stmt.op == "replica_destory":
+            self.coord.destroy_replica_set(stmt.replica_set_id)
             return ResultSet.message("ok")
         if stmt.op == "checksum":
             rows = self.coord.checksum_group(stmt.replica_set_id)
@@ -1385,15 +1399,17 @@ class QueryExecutor:
                 mask = np.asarray(plan.filter.eval(env, np), dtype=bool)
                 if mask.shape == ():
                     mask = np.full(b.n_rows, bool(mask))
-                # 3VL: a NULL field operand excludes the row — EXCEPT under
-                # an explicit IS NULL, which matches exactly those rows
-                from ..ops.tpu_exec import _contains_is_null
+                # 3VL: a NULL field operand excludes the row — EXCEPT the
+                # columns under an explicit IS NULL, which matches exactly
+                # those rows (per-column: `a IS NULL AND b = 0` must still
+                # reject NULL-b rows whose slot garbage is 0)
+                from ..ops.tpu_exec import is_null_columns
 
-                if not _contains_is_null(plan.filter):
-                    for c in plan.filter.columns():
-                        vk = f"__valid__:{c}"
-                        if c in b.fields:
-                            mask &= env[vk]
+                skip = is_null_columns(plan.filter)
+                for c in plan.filter.columns() - skip:
+                    vk = f"__valid__:{c}"
+                    if c in b.fields:
+                        mask &= env[vk]
             # filter BEFORE projection (DataFusion order): expressions must
             # only see surviving rows — CAST over a filtered-out Inf row
             # must not abort, and selective scans shrink the eval cost

@@ -237,6 +237,16 @@ class Parser:
             return self.parse_delete()
         if k == "UPDATE":
             return self.parse_update()
+        if k == "RECOVER":
+            # RECOVER TENANT <n> | DATABASE <n> | TABLE [db.]<n>
+            # (reference spi ast.rs:65-77, parser.rs:1859)
+            self.next()
+            kind = self.expect_kw("TENANT", "DATABASE", "TABLE")
+            if kind == "TABLE":
+                database, name = self.parse_qualified_ident()
+            else:
+                database, name = None, self.expect_ident()
+            return ast.RecoverStmt(kind.lower(), name, database)
         if k == "COMPACT":
             self.next()
             if self.accept_kw("VNODE"):
@@ -273,13 +283,20 @@ class Parser:
             # REPLICA ADD ON <rs_id> NODE <node> | REMOVE VNODE <id> |
             # PROMOTE VNODE <id> (reference ast.rs:56-73 replica admin)
             self.next()
-            sub = self.expect_kw("ADD", "REMOVE", "PROMOTE")
+            sub = self.expect_kw("ADD", "REMOVE", "PROMOTE", "DESTORY",
+                                 "DESTROY")
             if sub == "ADD":
                 self.expect_kw("ON")
                 rs_id = int(self.expect_number())
                 self.expect_kw("NODE")
                 return ast.VnodeAdmin("replica_add", replica_set_id=rs_id,
                                       node_id=int(self.expect_number()))
+            if sub in ("DESTORY", "DESTROY"):
+                # the reference spells it DESTORY (parser.rs:2046); accept
+                # the correct spelling too
+                return ast.VnodeAdmin(
+                    "replica_destory",
+                    replica_set_id=int(self.expect_number()))
             self.accept_kw("VNODE")
             return ast.VnodeAdmin(f"replica_{sub.lower()}",
                                   vnode_id=int(self.expect_number()))
@@ -620,7 +637,8 @@ class Parser:
         if k == "TABLE":
             self.next()
             ie = self._if_exists()
-            return ast.DropTable(self.expect_ident(), ie)
+            database, name = self.parse_qualified_ident()
+            return ast.DropTable(name, ie, database)
         if k == "STREAM":
             self.next()
             ie = self._if_exists()
@@ -813,20 +831,23 @@ class Parser:
         e = self.parse_expr()
         return _const_eval(e)
 
+    def parse_qualified_ident(self) -> tuple:
+        """[db .] name → (database | None, name)."""
+        database, name = None, self.expect_ident()
+        if self.accept_op("."):
+            database, name = name, self.expect_ident()
+        return database, name
+
     def parse_delete(self):
         self.expect_kw("DELETE")
         self.expect_kw("FROM")
-        database, table = None, self.expect_ident()
-        if self.accept_op("."):
-            database, table = table, self.expect_ident()
+        database, table = self.parse_qualified_ident()
         where = self.parse_expr() if self.accept_kw("WHERE") else None
         return ast.DeleteStmt(table, where, database)
 
     def parse_update(self):
         self.expect_kw("UPDATE")
-        database, table = None, self.expect_ident()
-        if self.accept_op("."):
-            database, table = table, self.expect_ident()
+        database, table = self.parse_qualified_ident()
         self.expect_kw("SET")
         assigns = {}
         while True:

@@ -86,9 +86,20 @@ def null_safe_key(v: np.ndarray):
     nulls = np.array([x is None for x in v], dtype=np.int8)
     non_null = [x for x in v if x is not None]
     if non_null and all(
+            isinstance(x, (int, np.integer))
+            and not isinstance(x, (bool, np.bool_)) for x in non_null):
+        # all-integer object column: int64 keys keep exactness past 2^53
+        # (the whole reason NULL-bearing int columns ride as objects)
+        try:
+            vals = np.array([0 if x is None else int(x) for x in v],
+                            dtype=np.int64)
+            return vals, (nulls if nulls.any() else None)
+        except OverflowError:
+            pass   # u64-range values: fall through to float keys
+    if non_null and all(
             isinstance(x, (int, float, np.integer, np.floating))
             and not isinstance(x, (bool, np.bool_)) for x in non_null):
-        # numeric object column (NULL-bearing ints render as objects):
+        # mixed numeric object column (NULL-bearing floats as objects):
         # order NUMERICALLY — stringifying would sort '12' before '5'
         vals = np.array([0.0 if x is None else float(x) for x in v],
                         dtype=np.float64)

@@ -168,6 +168,15 @@ class TsKv:
             if os.path.isdir(d):
                 shutil.rmtree(d, ignore_errors=True)
 
+    def close_database(self, owner: str):
+        """Release a database's vnodes WITHOUT touching disk (soft DROP:
+        files stay for RECOVER; purge later hard-deletes)."""
+        with self.lock:
+            for key in [k for k in self.vnodes if k[0] == owner]:
+                self.vnodes[key].close()
+                del self.vnodes[key]
+            self.schemas.pop(owner, None)
+
     def drop_vnode(self, owner: str, vnode_id: int):
         import shutil
 

@@ -141,3 +141,57 @@ def test_stream_watermark_delay(db):
     assert collected == [0] or rs.columns[0][0] == 0
     rs = se.trigger_once("s2", now_ns=100_000_000_000)  # slice [30s, 70s)
     assert rs.columns[0][0] == 1
+
+
+def test_flight_sql_standard_descriptor_flow(db):
+    """The REAL FlightSQL protocol (reference flight_sql_server.rs):
+    FlightDescriptor.cmd = Any(CommandStatementQuery) → GetFlightInfo
+    advertises the TRUE result schema + a TicketStatementQuery endpoint;
+    DoGet on that ticket streams the rows. Catalog commands too."""
+    ex, _ = db
+    pytest.importorskip("pyarrow.flight")
+    import pyarrow as pa
+    import pyarrow.flight as fl
+
+    from cnosdb_tpu.server.flight import (
+        command_get_catalogs, command_get_tables, command_statement_query,
+        start_flight_server,
+    )
+
+    ex.execute_one("CREATE TABLE fsq (v DOUBLE, n BIGINT, TAGS(host))")
+    ex.execute_one("INSERT INTO fsq (time, host, v, n) VALUES "
+                   "(1, 'a', 1.5, 10), (2, 'b', 2.5, 20)")
+    port = _free_port()
+    server = start_flight_server(ex, port)
+    try:
+        client = fl.connect(f"grpc://127.0.0.1:{port}")
+        desc = fl.FlightDescriptor.for_command(
+            command_statement_query(
+                "SELECT host, v, n FROM fsq ORDER BY time"))
+        info = client.get_flight_info(desc)
+        # the schema is REAL, known before fetching any data
+        assert info.schema.names == ["host", "v", "n"]
+        assert info.schema.field("v").type == pa.float64()
+        assert info.schema.field("n").type == pa.int64()
+        assert info.total_records == 2
+        table = client.do_get(info.endpoints[0].ticket).read_all()
+        assert table.schema.names == ["host", "v", "n"]
+        assert table.column("host").to_pylist() == ["a", "b"]
+        assert table.column("n").to_pylist() == [10, 20]
+        # a second DoGet on the same ticket re-executes from the handle
+        table2 = client.do_get(info.endpoints[0].ticket).read_all()
+        assert table2.column("v").to_pylist() == [1.5, 2.5]
+
+        # catalog browsing commands
+        info = client.get_flight_info(fl.FlightDescriptor.for_command(
+            command_get_catalogs()))
+        cats = client.do_get(info.endpoints[0].ticket).read_all()
+        assert cats.column("catalog_name").to_pylist() == ["cnosdb"]
+        info = client.get_flight_info(fl.FlightDescriptor.for_command(
+            command_get_tables()))
+        tbl = client.do_get(info.endpoints[0].ticket).read_all()
+        assert "fsq" in tbl.column("table_name").to_pylist()
+        assert set(tbl.schema.names) >= {"catalog_name", "db_schema_name",
+                                         "table_name", "table_type"}
+    finally:
+        server.shutdown()

@@ -147,3 +147,68 @@ def test_tls_config_loading(tmp_path):
     cfg = Config.load(str(cfg_path))
     assert cfg.security.enabled
     assert Config().security.enabled is False
+
+
+def test_otlp_ingest_and_jaeger_query_api(http_server):
+    """OTLP/HTTP JSON export → own-table storage → SQL AND jaeger API
+    (reference otlp_to_jaeger.rs + http_service.rs jaeger endpoints)."""
+    import json as _json
+    import urllib.request
+
+    srv, port, _tcp = http_server
+    payload = {
+        "resourceSpans": [{
+            "resource": {"attributes": [
+                {"key": "service.name",
+                 "value": {"stringValue": "checkout"}}]},
+            "scopeSpans": [{"spans": [
+                {"traceId": "abc123", "spanId": "s1", "name": "GET /cart",
+                 "kind": 2, "startTimeUnixNano": "1700000000000000000",
+                 "endTimeUnixNano": "1700000000005000000",
+                 "attributes": [{"key": "http.status_code",
+                                 "value": {"intValue": "200"}}],
+                 "status": {"code": 1}},
+                {"traceId": "abc123", "spanId": "s2",
+                 "parentSpanId": "s1", "name": "SELECT",
+                 "kind": 3, "startTimeUnixNano": "1700000000001000000",
+                 "endTimeUnixNano": "1700000000002000000"},
+            ]}],
+        }],
+    }
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/api/v1/traces?db=public",
+        data=_json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req) as r:
+        assert r.status == 200
+
+    # stored spans are plain SQL rows
+    sreq = urllib.request.Request(
+        f"http://127.0.0.1:{port}/api/v1/sql?db=public",
+        data=b"SELECT count(*) AS c FROM trace_spans",
+        headers={"Accept": "application/json"})
+    with urllib.request.urlopen(sreq) as r:
+        body = r.read().decode()
+    assert '"c": 2' in body or '"c":2' in body, body
+
+    st, body = _get(port, "/api/services")
+    assert st == 200 and _json.loads(body)["data"] == ["checkout"]
+    st, body = _get(port, "/api/services/checkout/operations")
+    assert st == 200
+    assert sorted(_json.loads(body)["data"]) == ["GET /cart", "SELECT"]
+
+    st, body = _get(port, "/api/traces?service=checkout")
+    traces = _json.loads(body)["data"]
+    assert st == 200 and len(traces) == 1
+    tr = traces[0]
+    assert tr["traceID"] == "abc123" and len(tr["spans"]) == 2
+    child = next(s for s in tr["spans"] if s["spanID"] == "s2")
+    assert child["references"] == [{"refType": "CHILD_OF",
+                                    "traceID": "abc123", "spanID": "s1"}]
+    assert child["startTime"] == 1700000000001000  # µs
+    assert child["duration"] == 1000               # µs
+    procs = tr["processes"]
+    assert [p["serviceName"] for p in procs.values()] == ["checkout"]
+
+    st, body = _get(port, "/api/traces/abc123")
+    assert st == 200 and _json.loads(body)["data"][0]["traceID"] == "abc123"
