@@ -296,14 +296,18 @@ def launch_scan_aggregate(batch: ScanBatch, query: TpuQuery):
                 if row_mask.shape == ():  # constant predicate
                     row_mask = np.full(n, bool(row_mask))
                 # SQL three-valued logic: a NULL operand makes a comparison
-                # non-matching, so rows where a referenced field is null are
-                # excluded — except for the columns under an explicit
-                # IS NULL (per-column, not filter-wide)
-                skip = is_null_columns(query.filter) if has_is_null else set()
-                for cname in query.filter.columns() - skip:
-                    if cname in batch.fields and not col_all_valid(
-                            cname, batch.fields[cname][2]):
-                        row_mask &= batch.fields[cname][2]
+                # non-matching. Comparison LEAVES are already masked in
+                # sql.expr; the post-hoc pass below additionally covers
+                # bare-column and NOT-wrapped predicates, and is only
+                # sound for conjunctive (OR-free) filters — per-column,
+                # skipping columns under an explicit IS NULL
+                if is_conjunctive(query.filter):
+                    skip = is_null_columns(query.filter) if has_is_null \
+                        else set()
+                    for cname in query.filter.columns() - skip:
+                        if cname in batch.fields and not col_all_valid(
+                                cname, batch.fields[cname][2]):
+                            row_mask &= batch.fields[cname][2]
         if zone_pruned:
             all_rows = len(sel_idx) == n
             if all_rows:
@@ -614,6 +618,27 @@ def _contains_is_null(e) -> bool:
     return False
 
 
+def is_conjunctive(e) -> bool:
+    """True when the filter tree contains no OR: post-hoc validity
+    masking (AND-ing a column's valid mask into the row mask) is only
+    sound then — under a disjunction a row may match through a branch
+    that never touches the NULL column. Non-conjunctive filters rely on
+    the comparison-leaf masking in sql.expr instead."""
+    from ..sql.expr import BinOp
+
+    if isinstance(e, BinOp) and e.op == "or":
+        return False
+    for attr in ("left", "right", "operand", "expr", "low", "high"):
+        sub = getattr(e, attr, None)
+        if isinstance(sub, Expr) and not is_conjunctive(sub):
+            return False
+    args = getattr(e, "args", None)
+    if args:
+        return all(is_conjunctive(a) for a in args
+                   if isinstance(a, Expr))
+    return True
+
+
 def is_null_columns(e) -> set:
     """Columns referenced INSIDE IS NULL nodes: validity masking must skip
     exactly these — masking them defeats IS NULL, while skipping masking
@@ -671,10 +696,11 @@ def _eval_filter_on_rows(batch: ScanBatch, flt: Expr,
     mask = np.asarray(flt.eval(env, np), dtype=bool)
     if mask.shape == ():
         return idx if bool(mask) else idx[:0]
-    for c in cols:
-        v = env.get(f"__valid__:{c}")
-        if v is not None and not v.all():
-            mask &= v
+    if is_conjunctive(flt):   # see the 3VL notes in the classic path
+        for c in cols:
+            v = env.get(f"__valid__:{c}")
+            if v is not None and not v.all():
+                mask &= v
     return idx[np.flatnonzero(mask)]
 
 

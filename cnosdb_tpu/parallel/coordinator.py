@@ -102,6 +102,9 @@ class Coordinator:
                                             payload["table"])
         elif event == "drop_db":
             self.engine.drop_database(payload["owner"])
+        elif event == "purge_vnode":
+            # targeted reclamation of one trashed incarnation's vnode
+            self.engine.drop_vnode(payload["owner"], payload["vnode_id"])
         elif event == "trash_db":
             # soft delete: close vnodes, keep every file for RECOVER
             self.engine.close_database(payload["owner"])

@@ -233,6 +233,11 @@ class MetaStore:
             if name not in self.tenants:
                 return
             dropped = [o for o in self.databases if o.startswith(name + ".")]
+            fire = []
+            old = self.trash["tenant"].pop(name, None)
+            if old is not None:   # see drop_database: reclaim, don't leak
+                for owner, p in old.get("dbs", {}).items():
+                    fire += self._payload_vnode_events(owner, p)
             self.trash["tenant"][name] = {
                 "options": self.tenants.pop(name).to_dict(),
                 "members": self.members.pop(name, {}),
@@ -241,6 +246,8 @@ class MetaStore:
                 "at": _time.time() if at is None else at,
             }
             self._persist()
+            for event, kw in fire:
+                self._notify(event, **kw)
             for owner in dropped:
                 self._notify("trash_db", owner=owner)
             self._notify("drop_tenant", tenant=name)
@@ -275,20 +282,32 @@ class MetaStore:
         cutoff = (_time.time() if now is None else now) - older_than_s
         with self.lock:
             fire = []
+
+            def reclaim_db(owner, payload):
+                # whole-dir removal only when no LIVE database reuses the
+                # owner path; otherwise purge that incarnation's vnodes
+                if owner in self.databases:
+                    fire.extend(self._payload_vnode_events(owner, payload))
+                else:
+                    fire.append(("drop_db", {"owner": owner}))
+
             for owner in [o for o, p in self.trash["db"].items()
                           if p["at"] <= cutoff]:
-                del self.trash["db"][owner]
-                fire.append(("drop_db", {"owner": owner}))
+                reclaim_db(owner, self.trash["db"].pop(owner))
             for key in [k for k, p in self.trash["table"].items()
                         if p["at"] <= cutoff]:
-                p = self.trash["table"].pop(key)
+                self.trash["table"].pop(key)
                 owner, _, table = key.rpartition(".")
-                fire.append(("drop_table", {"owner": owner, "table": table}))
+                # table rows share the owner's vnode files; delete them
+                # only when no live table re-took the name
+                if table not in self.tables.get(owner, {}):
+                    fire.append(("drop_table",
+                                 {"owner": owner, "table": table}))
             for name in [n for n, p in self.trash["tenant"].items()
                          if p["at"] <= cutoff]:
                 p = self.trash["tenant"].pop(name)
-                for owner in p["dbs"]:
-                    fire.append(("drop_db", {"owner": owner}))
+                for owner, dbp in p["dbs"].items():
+                    reclaim_db(owner, dbp)
             self._persist()
             for event, kw in fire:
                 self._notify(event, **kw)
@@ -499,6 +518,20 @@ class MetaStore:
             "at": _time.time() if at is None else at,
         }
 
+    def _payload_vnode_events(self, owner: str, payload: dict) -> list:
+        """Targeted reclamation for ONE trashed incarnation: per-vnode
+        purge events. Never a whole-owner drop_db — a recreated live
+        database shares the owner directory, and its files must survive
+        the old incarnation's purge."""
+        out = []
+        for b in payload.get("buckets", []):
+            bi = BucketInfo.from_dict(b)
+            for rs in bi.shard_group:
+                for v in rs.vnodes:
+                    out.append(("purge_vnode",
+                                {"owner": owner, "vnode_id": v.id}))
+        return out
+
     def _db_from_trash(self, owner: str, payload: dict) -> None:
         self.databases[owner] = DatabaseSchema.from_dict(payload["schema"])
         self.tables[owner] = {t: TskvTableSchema.from_dict(s)
@@ -516,8 +549,17 @@ class MetaStore:
                 if if_exists:
                     return
                 raise DatabaseNotFound(db)
+            # a previous incarnation already in the bin can no longer be
+            # recovered once this drop takes its slot: reclaim its vnode
+            # files NOW instead of leaking them forever
+            fire = []
+            old = self.trash["db"].pop(owner, None)
+            if old is not None:
+                fire = self._payload_vnode_events(owner, old)
             self.trash["db"][owner] = self._db_to_trash(owner, at)
             self._persist()
+            for event, kw in fire:
+                self._notify(event, **kw)
             self._notify("trash_db", owner=owner)
 
     def recover_database(self, tenant: str, db: str):

@@ -379,7 +379,8 @@ class RaftNode:
                  log: LogStore, sm: StateMachine, transport: Transport,
                  election_timeout: tuple[float, float] = (0.15, 0.3),
                  heartbeat_interval: float = 0.05,
-                 tick: bool = True, initial_applied: int = 0):
+                 tick: bool = True, initial_applied: int = 0,
+                 on_state=None):
         self.group_id = group_id
         self.node_id = node_id
         self.peers = [p for p in peers if p != node_id]
@@ -388,6 +389,9 @@ class RaftNode:
         self.transport = transport
         self.election_timeout = election_timeout
         self.heartbeat_interval = heartbeat_interval
+        # leadership-change callback (event-driven writers instead of
+        # sleep-poll loops; loaded hosts starve pollers into deadlines)
+        self.on_state = on_state
 
         self.term, self.voted_for = log.load_hard_state()
         # adopted-config history: (log index, members) per MEMBERSHIP entry
@@ -424,6 +428,14 @@ class RaftNode:
     def _new_deadline(self):
         lo, hi = self.election_timeout
         return time.monotonic() + random.uniform(lo, hi)
+
+    def _notify_state(self):
+        cb = self.on_state
+        if cb is not None:
+            try:
+                cb(self)
+            except Exception:
+                pass
 
     def stop(self):
         self._stop.set()
@@ -464,6 +476,8 @@ class RaftNode:
 
     # ------------------------------------------------------------ elections
     def _start_election(self):
+        if not self._prevote():
+            return
         with self.lock:
             self.term += 1
             self.role = Role.CANDIDATE
@@ -517,7 +531,66 @@ class RaftNode:
                 # commit a blank entry to settle the new term (raft §8)
                 self._append_local(RAFT_BLANK, b"")
         if self.role == Role.LEADER:
+            self._notify_state()
             self._broadcast_append()
+
+    def _prevote(self) -> bool:
+        """PreVote phase (raft §4.2.3): probe a majority WITHOUT touching
+        term or voted_for. A partitioned node otherwise inflates its term
+        on every timeout and, on heal, disrupts the healthy group with a
+        storm of stale-log elections — the classic post-partition
+        convergence flake."""
+        with self.lock:
+            if not self.peers:
+                return True
+            term = self.term + 1
+            last_idx = self.log.last_index()
+            last_term = self.log.term_at(last_idx)
+            self._election_deadline = self._new_deadline()
+        votes = [1]
+        total = len(self.peers) + 1
+        vote_lock = threading.Lock()
+        settled = threading.Event()
+
+        def ask(p):
+            try:
+                reply = self.transport.send(self.group_id, p, {
+                    "type": "request_prevote", "from": self.node_id,
+                    "term": term, "last_log_index": last_idx,
+                    "last_log_term": last_term})
+            except Exception:
+                return
+            if reply is None:
+                return
+            if reply.get("granted"):
+                with vote_lock:
+                    votes[0] += 1
+                    if votes[0] * 2 > total:
+                        settled.set()
+
+        threads = [threading.Thread(target=ask, args=(p,), daemon=True)
+                   for p in self.peers]
+        for t in threads:
+            t.start()
+        settled.wait(timeout=1.0)
+        return votes[0] * 2 > total
+
+    def _on_request_prevote(self, msg):
+        with self.lock:
+            # leader stickiness: a node that heard from a live leader
+            # recently refuses prevotes — heals don't topple a working
+            # leader. No term/voted_for mutation here, by design.
+            lo, _hi = self.election_timeout
+            heard_recently = (time.monotonic()
+                              - getattr(self, "_last_append_seen", 0.0)) < lo
+            my_last = self.log.last_index()
+            my_term = self.log.term_at(my_last)
+            up_to_date = (msg["last_log_term"], msg["last_log_index"]) >= \
+                (my_term, my_last)
+            granted = (msg["term"] >= self.term and up_to_date
+                       and not (heard_recently
+                                and self.role == Role.FOLLOWER))
+            return {"term": self.term, "granted": granted}
 
     def _step_down(self, term: int):
         with self.lock:
@@ -527,6 +600,7 @@ class RaftNode:
                 self.log.save_hard_state(self.term, None)
             self.role = Role.FOLLOWER
             self._election_deadline = self._new_deadline()
+        self._notify_state()
 
     # ------------------------------------------------------------ client API
     def propose(self, entry_type: int, data: bytes,
@@ -673,6 +747,7 @@ class RaftNode:
                 self.leader_id = None
                 lo, hi = self.election_timeout
                 self._election_deadline = time.monotonic() + 4 * hi
+        self._notify_state()
 
     # ------------------------------------------------------------ replication
     def _broadcast_append(self):
@@ -828,6 +903,8 @@ class RaftNode:
     # ------------------------------------------------------------ RPC handling
     def handle_message(self, msg: dict) -> dict:
         t = msg["type"]
+        if t == "request_prevote":
+            return self._on_request_prevote(msg)
         if t == "request_vote":
             return self._on_request_vote(msg)
         if t == "append_entries":
@@ -860,7 +937,11 @@ class RaftNode:
             if msg["term"] > self.term:
                 self._step_down(msg["term"])
             self.role = Role.FOLLOWER
+            changed = self.leader_id != msg["from"]
             self.leader_id = msg["from"]
+            if changed:
+                self._notify_state()
+            self._last_append_seen = time.monotonic()
             self._election_deadline = self._new_deadline()
             prev_idx, prev_term = msg["prev_log_index"], msg["prev_log_term"]
             if prev_idx > 0:

@@ -76,6 +76,13 @@ class ReplicaGroupManager:
         self.lock = threading.Lock()
         # group_id → ReplicationSet placement (for peer resolution)
         self._placements: dict[str, ReplicationSet] = {}
+        # leadership transitions wake blocked writers (event-driven, not
+        # sleep-polling: pollers starve under load and hit deadlines)
+        self._state_cv = threading.Condition()
+
+    def _on_member_state(self, _node) -> None:
+        with self._state_cv:
+            self._state_cv.notify_all()
 
     def group_id(self, owner: str, rs: ReplicationSet) -> str:
         return f"{owner}/{rs.id}"
@@ -132,7 +139,8 @@ class ReplicaGroupManager:
                 node = RaftNode(gid, v.id, peers, log,
                                 VnodeStateMachine(vnode), self.transport,
                                 election_timeout=self.election_timeout,
-                                heartbeat_interval=self.heartbeat_interval)
+                                heartbeat_interval=self.heartbeat_interval,
+                                on_state=self._on_member_state)
                 self.multi.add(node)
                 nodes[v.id] = node
             return nodes
@@ -204,10 +212,19 @@ class ReplicaGroupManager:
         nodes = self.get_or_build(owner, rs)
         last_err: Exception | None = None
         deadline = time.monotonic() + timeout
+
+        def wait_state(span: float):
+            # woken early by any leadership transition; the timeout is a
+            # fallback for remote-leader groups whose local members see
+            # no transition
+            with self._state_cv:
+                self._state_cv.wait(min(span, max(
+                    0.0, deadline - time.monotonic())))
+
         while time.monotonic() < deadline:
             leader = next((n for n in nodes.values() if n.is_leader()), None)
             if leader is None:
-                time.sleep(0.05)
+                wait_state(0.25)
                 continue
             try:
                 idx = leader.propose(entry_type, data)
@@ -216,10 +233,10 @@ class ReplicaGroupManager:
                 return idx
             except NotLeader as e:
                 last_err = e
-                time.sleep(0.05)
+                wait_state(0.1)
             except ReplicationError as e:
                 last_err = e
-                time.sleep(0.05)
+                wait_state(0.1)
         raise ReplicationError(
             f"no leader for {self.group_id(owner, rs)}") from last_err
 

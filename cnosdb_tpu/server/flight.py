@@ -286,9 +286,13 @@ if FLIGHT_AVAILABLE:
                         include_schema = bool(
                             _pb_parse(val).get(5, [0])[0])
                     table = self._catalog_table(kind, include_schema)
+                    # the handle recipe keeps the include_schema flag so a
+                    # cache-evicted re-derivation matches the advertised
+                    # schema exactly
+                    recipe = f"{kind}|{int(include_schema)}"
                     return self._info_for(
                         descriptor, table,
-                        b"\x00" + kind.encode() + b"\x00"
+                        b"\x00" + recipe.encode() + b"\x00"
                         + secrets.token_hex(8).encode())
                 raise fl.FlightServerError(
                     f"unsupported FlightSQL command {kind}")
@@ -316,8 +320,9 @@ if FLIGHT_AVAILABLE:
                     sql = rest.rsplit(b"\x00", 1)[0]
                     if not sql:
                         raise fl.FlightServerError("stale statement handle")
-                    if db == b"":   # catalog command handle
-                        table = self._catalog_table(sql.decode(), False)
+                    if db == b"":   # catalog command handle: kind|flag
+                        kind, _, flag = sql.decode().partition("|")
+                        table = self._catalog_table(kind, flag == "1")
                     else:
                         table = self._execute(db.decode(), sql.decode())
                 return fl.RecordBatchStream(table)

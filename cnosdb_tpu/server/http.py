@@ -537,7 +537,15 @@ class HttpServer:
         self._authorize_read(session)
         svc = request.query.get("service", "").replace("'", "''")
         op = request.query.get("operation", "").replace("'", "''")
-        limit = int(request.query.get("limit", 20))
+        try:
+            limit = int(request.query.get("limit", 20))
+            start_us = int(request.query["start"]) \
+                if "start" in request.query else None
+            end_us = int(request.query["end"]) \
+                if "end" in request.query else None
+        except ValueError as e:
+            return web.Response(status=400,
+                                text=f"bad numeric query parameter: {e}")
 
         def run():
             try:
@@ -546,12 +554,10 @@ class HttpServer:
                     where.append(f"service_name = '{svc}'")
                 if op:
                     where.append(f"operation_name = '{op}'")
-                if "start" in request.query:   # µs, jaeger convention
-                    where.append(
-                        f"time >= {int(request.query['start']) * 1000}")
-                if "end" in request.query:
-                    where.append(
-                        f"time <= {int(request.query['end']) * 1000}")
+                if start_us is not None:   # µs, jaeger convention
+                    where.append(f"time >= {start_us * 1000}")
+                if end_us is not None:
+                    where.append(f"time <= {end_us * 1000}")
                 probe = self._trace_rows(session, " AND ".join(where),
                                          limit=limit * 50)
                 ids: list[str] = []
@@ -797,7 +803,9 @@ def run_server(args) -> int:
 
     async def ttl_job():
         """Bucket TTL expiry (reference meta_admin.rs:848 + ResourceManager):
-        drop vnodes of expired buckets."""
+        drop vnodes of expired buckets. Also reclaims the DROP recycle
+        bin once entries outlive the recovery window."""
+        trash_retention_s = 24 * 3600.0
         while True:
             await asyncio.sleep(60)
             now = int(_time.time() * 1e9)
@@ -810,6 +818,10 @@ def run_server(args) -> int:
                                 server.coord.engine.drop_vnode(owner, v.id)
                 except Exception:
                     pass
+            try:
+                server.meta.purge_trash(older_than_s=trash_retention_s)
+            except Exception:
+                pass
 
     ssl_context = None
     if cfg.security.enabled:

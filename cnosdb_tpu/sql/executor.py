@@ -304,8 +304,7 @@ class QueryExecutor:
                     ast.CopyStmt, ast.CreateExternalTable,
                     # cluster-topology mutation reaches every tenant's
                     # vnodes via the global placement map: instance scope
-                    ast.VnodeAdmin, ast.CompactStmt, ast.FlushStmt,
-                    ast.RecoverStmt)
+                    ast.VnodeAdmin, ast.CompactStmt, ast.FlushStmt)
 
     def _check_privilege(self, stmt, session: Session):
         """RBAC gate (reference auth/auth_control.rs AccessControlImpl →
@@ -320,6 +319,11 @@ class QueryExecutor:
         if u is None or u.get("admin"):
             return  # unknown → authentication already failed upstream
         if isinstance(stmt, self._ADMIN_STMTS):
+            raise AuthError(
+                f"user {user!r} is not an admin (instance administration)")
+        if isinstance(stmt, ast.RecoverStmt) and stmt.kind == "tenant":
+            # RECOVER TABLE/DATABASE undo tenant-scoped DDL (checked below
+            # like any DDL); only RECOVER TENANT is instance scope
             raise AuthError(
                 f"user {user!r} is not an admin (instance administration)")
         if isinstance(stmt, ast.AlterTenantMember):
@@ -1399,17 +1403,18 @@ class QueryExecutor:
                 mask = np.asarray(plan.filter.eval(env, np), dtype=bool)
                 if mask.shape == ():
                     mask = np.full(b.n_rows, bool(mask))
-                # 3VL: a NULL field operand excludes the row — EXCEPT the
-                # columns under an explicit IS NULL, which matches exactly
-                # those rows (per-column: `a IS NULL AND b = 0` must still
-                # reject NULL-b rows whose slot garbage is 0)
-                from ..ops.tpu_exec import is_null_columns
+                # 3VL: comparison leaves are masked in sql.expr; this
+                # post-hoc pass covers bare/NOT-wrapped predicates and is
+                # only sound for conjunctive (OR-free) filters —
+                # per-column, skipping columns under an explicit IS NULL
+                from ..ops.tpu_exec import is_conjunctive, is_null_columns
 
-                skip = is_null_columns(plan.filter)
-                for c in plan.filter.columns() - skip:
-                    vk = f"__valid__:{c}"
-                    if c in b.fields:
-                        mask &= env[vk]
+                if is_conjunctive(plan.filter):
+                    skip = is_null_columns(plan.filter)
+                    for c in plan.filter.columns() - skip:
+                        vk = f"__valid__:{c}"
+                        if c in b.fields:
+                            mask &= env[vk]
             # filter BEFORE projection (DataFusion order): expressions must
             # only see surviving rows — CAST over a filtered-out Inf row
             # must not abort, and selective scans shrink the eval cost

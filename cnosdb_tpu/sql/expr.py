@@ -123,6 +123,25 @@ def _is_obj_arr(v) -> bool:
     return isinstance(v, np.ndarray) and v.dtype == object
 
 
+def _mask_operand_validity(out, env, *exprs):
+    """3VL at the comparison LEAF: a predicate over a NULL operand is
+    UNKNOWN → False as a filter. Masking here (instead of post-hoc over
+    the whole filter) keeps disjunctions correct: in
+    `a IS NULL OR b = 0`, a NULL-b row can still match through the left
+    branch. Typed columns carry NULLs out-of-band as __valid__ masks."""
+    if not isinstance(out, np.ndarray) or out.dtype != bool:
+        return out
+    masked = out
+    for e in exprs:
+        for c in e.columns():
+            vm = env.get(f"__valid__:{c}")
+            if vm is not None and len(vm) == len(out) and not vm.all():
+                if masked is out:
+                    masked = out.copy()
+                masked &= vm
+    return masked
+
+
 def _obj_binop(op: str, f, xp, a, b):
     """NULL-propagating elementwise op when an operand is an OBJECT array
     (NULL-bearing int columns ride as objects to keep integer identity):
@@ -184,7 +203,10 @@ class BinOp(Expr):
                     return xp.zeros(shape, dtype=bool)
                 return False
             return None
-        return f(xp, a, b)
+        out = f(xp, a, b)
+        if xp is np and self.op in ("=", "!=", "<", "<=", ">", ">="):
+            out = _mask_operand_validity(out, env, self.left, self.right)
+        return out
 
     def columns(self):
         return self.left.columns() | self.right.columns()
@@ -194,15 +216,72 @@ class BinOp(Expr):
         return f"({self.left.to_sql()} {op} {self.right.to_sql()})"
 
 
+def _eval_false_mask(e, env, xp):
+    """Definite-FALSE mask under 3VL, or None when not derivable.
+
+    Filter evaluation produces definite-TRUE masks (comparison leaves are
+    validity-masked). NOT needs the definite-FALSE mask of its operand —
+    `NOT (i = 5 OR i < 0)` must exclude NULL-i rows (inner UNKNOWN →
+    NOT UNKNOWN = UNKNOWN), which ~true_mask would wrongly include."""
+    if isinstance(e, BinOp):
+        if e.op == "and":
+            fa = _eval_false_mask(e.left, env, xp)
+            fb = _eval_false_mask(e.right, env, xp)
+            return None if fa is None or fb is None else (fa | fb)
+        if e.op == "or":
+            fa = _eval_false_mask(e.left, env, xp)
+            fb = _eval_false_mask(e.right, env, xp)
+            return None if fa is None or fb is None else (fa & fb)
+        neg = {"=": "!=", "!=": "=", "<": ">=", "<=": ">",
+               ">": "<=", ">=": "<"}.get(e.op)
+        if neg is not None:
+            # the negated comparison, leaf-masked: exactly definite-false
+            return np.asarray(BinOp(neg, e.left, e.right).eval(env, xp),
+                              dtype=bool)
+        return None
+    if isinstance(e, UnaryOp) and e.op == "not":
+        v = e.operand.eval(env, xp)   # definite-true of the operand
+        return np.asarray(v, dtype=bool) if isinstance(v, np.ndarray) \
+            else None
+    if isinstance(e, IsNull):
+        return np.asarray(IsNull(e.expr, not e.negated).eval(env, xp),
+                          dtype=bool)
+    if isinstance(e, Between):
+        return np.asarray(
+            Between(e.expr, e.low, e.high, not e.negated).eval(env, xp),
+            dtype=bool)
+    if isinstance(e, InList):
+        return np.asarray(
+            InList(e.expr, e.values, not e.negated,
+                   e.null_present).eval(env, xp), dtype=bool)
+    if isinstance(e, Like):
+        return np.asarray(
+            Like(e.expr, e.pattern, not e.negated).eval(env, xp),
+            dtype=bool)
+    if isinstance(e, Column):
+        v = e.eval(env, xp)
+        if not isinstance(v, np.ndarray):
+            return None
+        out = ~np.asarray(v, dtype=bool)
+        return _mask_operand_validity(out, env, e)
+    if isinstance(e, Literal):
+        return None if e.value is None else (not bool(e.value))
+    return None
+
+
 @dataclass(repr=False)
 class UnaryOp(Expr):
     op: str  # 'not' | '-'
     operand: Expr
 
     def eval(self, env, xp):
-        v = self.operand.eval(env, xp)
         if self.op == "not":
-            return ~v
+            if xp is np:
+                fm = _eval_false_mask(self.operand, env, xp)
+                if isinstance(fm, np.ndarray):
+                    return fm
+            return ~self.operand.eval(env, xp)
+        v = self.operand.eval(env, xp)
         if self.op == "-":
             return -v
         raise PlanError(f"unknown unary {self.op!r}")
@@ -234,7 +313,10 @@ class InList(Expr):
             m = c if m is None else (m | c)
         if m is None:
             m = xp.zeros(getattr(v, "shape", (1,)), dtype=bool)
-        return ~m if self.negated else m
+        out = ~m if self.negated else m
+        if xp is np:
+            out = _mask_operand_validity(out, env, self.expr)
+        return out
 
     def columns(self):
         return self.expr.columns()
@@ -255,7 +337,11 @@ class Between(Expr):
     def eval(self, env, xp):
         v = self.expr.eval(env, xp)
         m = (v >= self.low.eval(env, xp)) & (v <= self.high.eval(env, xp))
-        return ~m if self.negated else m
+        out = ~m if self.negated else m
+        if xp is np:
+            out = _mask_operand_validity(out, env, self.expr,
+                                         self.low, self.high)
+        return out
 
     def columns(self):
         return self.expr.columns() | self.low.columns() | self.high.columns()
@@ -329,7 +415,10 @@ class Like(Expr):
         out = np.fromiter(
             (bool(rx.match(x)) if isinstance(x, str) else False for x in arr),
             dtype=bool, count=len(arr))
-        return ~out if self.negated else out
+        out = ~out if self.negated else out
+        if xp is np:
+            out = _mask_operand_validity(out, env, self.expr)
+        return out
 
     def columns(self):
         return self.expr.columns()
