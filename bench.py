@@ -13,6 +13,7 @@ shapes:
   avg_load            avg(usage_system) per host (iot avg-load analog)
   hits_filtered_agg   count+max under a selective value filter
   hits_top10          top-10 hosts by sum (ORDER BY agg DESC LIMIT)
+  hits_string_group   GROUP BY a STRING field (dictionary codes), 10% rows
 
 Each shape is baselined against a vectorized numpy implementation of the
 same aggregation over the same in-memory arrays (the reference publishes
@@ -34,6 +35,8 @@ import time
 import numpy as np
 
 TARGET_ROWS = int(os.environ.get("CNOSDB_BENCH_ROWS", 100_000_000))
+STR_ROWS = max(10_000, TARGET_ROWS // 10)   # hits-style string table
+N_URLS = 1000
 N_HOSTS = 100
 N_PER_HOST = max(1, TARGET_ROWS // N_HOSTS)
 INTERVAL_NS = 10 * 10**9          # 10s cadence
@@ -75,6 +78,31 @@ def build_dataset(coord, tenant, db):
     return ingest_s, time.perf_counter() - t1
 
 
+def build_string_dataset(coord, tenant, db):
+    """ClickBench-hits-style table: a STRING field (url, 1000 uniques) per
+    row — exercises dictionary pages + code-keyed group-by."""
+    from cnosdb_tpu.models.points import SeriesRows, WriteBatch
+    from cnosdb_tpu.models.schema import ValueType
+    from cnosdb_tpu.models.series import SeriesKey
+
+    rng = np.random.default_rng(7)
+    urls = [f"/page/{i:04d}" for i in range(N_URLS)]
+    key = SeriesKey("hits_str", {"site": "s0"})
+    for off in range(0, STR_ROWS, CHUNK):
+        n = min(CHUNK, STR_ROWS - off)
+        ts = BASE_TS + (np.arange(n, dtype=np.int64) + off) * 1_000_000_000
+        codes = rng.integers(0, N_URLS, n)
+        lat = rng.exponential(30, n)
+        wb = WriteBatch()
+        wb.add_series("hits_str", SeriesRows(
+            key, ts,
+            {"url": (int(ValueType.STRING), [urls[c] for c in codes]),
+             "latency": (int(ValueType.FLOAT), lat)}))
+        coord.write_points(tenant, db, wb)
+    coord.engine.flush_all()
+    coord.engine.compact_all()
+
+
 def _seg_mean(seg, weights, nseg):
     sums = np.bincount(seg, weights=weights, minlength=nseg)
     counts = np.bincount(seg, minlength=nseg)
@@ -105,6 +133,14 @@ class Arrays:
         self.host = self.host_of_series[np.concatenate(parts)]
         self.bucket = (self.ts - BASE_TS) // BUCKET_NS
         self.nb = int(self.bucket.max()) + 1
+        # string table columns (url arrives dictionary-encoded from scan)
+        from cnosdb_tpu.models.strcol import DictArray
+
+        sb = coord.scan_table(tenant, db, "hits_str")
+        url = DictArray.concat([b.fields["url"][1] for b in sb])
+        self.url_codes = url.codes.astype(np.int64)
+        self.url_values = url.values
+        self.latency = np.concatenate([b.fields["latency"][1] for b in sb])
 
 
 def shapes(arrays: Arrays):
@@ -168,6 +204,12 @@ def shapes(arrays: Arrays):
         order = np.argsort(-sums)[:10]
         return sums[order]
 
+    def np_string_group():
+        nseg = len(a.url_values)
+        c = np.bincount(a.url_codes, minlength=nseg)
+        s = np.bincount(a.url_codes, weights=a.latency, minlength=nseg)
+        return c, s
+
     in_list = ", ".join(f"'{h}'" for h in eight)
     return [
         ("double_groupby_1",
@@ -202,6 +244,10 @@ def shapes(arrays: Arrays):
          "SELECT hostname, sum(usage_user) AS s FROM cpu "
          "GROUP BY hostname ORDER BY s DESC LIMIT 10",
          n, np_top10),
+        ("hits_string_group",
+         "SELECT url, count(latency) AS c, sum(latency) AS s "
+         "FROM hits_str GROUP BY url",
+         len(a.url_codes), np_string_group),
     ]
 
 
@@ -226,6 +272,12 @@ def spot_check(name, rs, arrays):
         sums = np.bincount(a.host, weights=a.user, minlength=N_HOSTS)
         want = np.sort(sums)[::-1][:10]
         np.testing.assert_allclose(np.sort(cols["s"])[::-1], want, rtol=1e-9)
+    elif name == "hits_string_group":
+        want_c = np.bincount(a.url_codes, minlength=len(a.url_values))
+        got = dict(zip(cols["url"], cols["c"]))
+        u0 = a.url_values[0]
+        assert int(got[u0]) == int(want_c[0]), (got[u0], want_c[0])
+        assert len(got) == int((want_c > 0).sum())
 
 
 def _guard_degraded_relay():
@@ -317,6 +369,9 @@ def main():
         print(f"# ingested {n_rows} rows in {ingest_s:.1f}s "
               f"({n_rows/ingest_s/1e6:.2f}M rows/s); "
               f"full compaction {compact_s:.1f}s", file=sys.stderr)
+        build_string_dataset(coord, DEFAULT_TENANT, "public")
+        print(f"# ingested {STR_ROWS} string rows (hits_str)",
+              file=sys.stderr)
 
         arrays = Arrays(coord, DEFAULT_TENANT, "public")
         results = {}

@@ -1,0 +1,219 @@
+"""Dictionary-encoded string columns.
+
+The TPU-first answer to the reference's string columns (tskv/src/tsm/
+codec/string.rs stores raw compressed blocks; DataFusion aggregates on
+materialized Utf8 arrays): strings never travel the hot path as Python
+objects. A column is a pair (codes int32 [N], values object [U]) where
+`values` is the lexicographically-sorted unique dictionary — so every
+comparison, min/max, group-by and filter on the column is an integer
+kernel over `codes`, and code order IS string order. Python-object work is
+O(U) (decode the dictionary) instead of O(N) (decode every row).
+
+Invariants:
+- `values` is sorted ascending, unique, non-empty whenever `codes` is
+  non-empty (an all-null column carries a single "" entry so code 0 is
+  always addressable; validity lives in the caller's mask, not here).
+- `codes[i]` is an index into `values`; rows the caller marks invalid may
+  carry any code (conventionally 0).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+try:  # pyarrow rides the Arrow IPC plane already; use its C++ hash table
+    import pyarrow as pa
+    import pyarrow.compute as pc
+except Exception:  # pragma: no cover - arrow is a hard dep elsewhere
+    pa = None
+
+
+class DictArray:
+    __slots__ = ("codes", "values")
+
+    def __init__(self, codes: np.ndarray, values: np.ndarray):
+        self.codes = codes
+        self.values = values
+
+    # -- ndarray-ish surface used by the scan/merge paths ------------------
+    def __len__(self) -> int:
+        return len(self.codes)
+
+    def __getitem__(self, idx) -> "DictArray":
+        return DictArray(self.codes[idx], self.values)
+
+    @property
+    def dtype(self):
+        return np.dtype(object)
+
+    @property
+    def shape(self):
+        return self.codes.shape
+
+    def map_values(self, fn, out_dtype=object) -> np.ndarray:
+        """Apply a python fn once per UNIQUE, gather to rows. The workhorse
+        for string scalars (upper/substr/LIKE/CAST…): O(U) Python instead
+        of O(N)."""
+        per_u = [fn(x) for x in self.values]
+        if out_dtype is object:
+            arr = np.empty(len(per_u), dtype=object)
+            arr[:] = per_u
+        else:
+            arr = np.array(per_u, dtype=out_dtype)
+        return arr[self.codes]
+
+    def materialize(self) -> np.ndarray:
+        """→ object ndarray (vectorized pointer gather, no per-row Python)."""
+        if len(self.codes) == 0:
+            return np.empty(0, dtype=object)
+        return self.values[self.codes]
+
+    def tolist(self) -> list:
+        return self.materialize().tolist()
+
+    # dict-aware comparisons: predicate evaluated once per UNIQUE, then a
+    # C gather broadcasts it to rows — `col = 'x'` on 10M rows costs O(U)
+    # Python compares + one int gather instead of 10M object compares.
+    def _cmp(self, op, other) -> np.ndarray:
+        per_unique = op(self.values, other)
+        return per_unique[self.codes]
+
+    def __eq__(self, other):  # type: ignore[override]
+        if isinstance(other, DictArray):
+            other = other.materialize()
+        if isinstance(other, np.ndarray):
+            return self.materialize() == other
+        return self._cmp(np.equal, other)
+
+    def __ne__(self, other):  # type: ignore[override]
+        if isinstance(other, DictArray):
+            other = other.materialize()
+        if isinstance(other, np.ndarray):
+            return self.materialize() != other
+        return self._cmp(np.not_equal, other)
+
+    def __lt__(self, other):
+        return self._cmp(np.less, other)
+
+    def __le__(self, other):
+        return self._cmp(np.less_equal, other)
+
+    def __gt__(self, other):
+        return self._cmp(np.greater, other)
+
+    def __ge__(self, other):
+        return self._cmp(np.greater_equal, other)
+
+    def __hash__(self):  # __eq__ override kills the default
+        return id(self)
+
+    def isin(self, choices) -> np.ndarray:
+        per_unique = np.isin(self.values, list(choices))
+        return per_unique[self.codes]
+
+    # ---------------------------------------------------------------------
+    @classmethod
+    def empty(cls) -> "DictArray":
+        return cls(np.empty(0, dtype=np.int32), np.empty(0, dtype=object))
+
+    @classmethod
+    def from_objects(cls, arr) -> "DictArray":
+        """Factorize an object/str sequence. pyarrow's C++ hash when the
+        values are clean utf-8 str; a Python dict otherwise. None → code 0
+        (callers track validity separately)."""
+        if isinstance(arr, DictArray):
+            return arr
+        n = len(arr)
+        if n == 0:
+            return cls.empty()
+        if pa is not None:
+            try:
+                a = pa.array(arr, type=pa.large_utf8(), from_pandas=True)
+                d = a.dictionary_encode()
+                idx = d.indices
+                if idx.null_count:
+                    idx = idx.fill_null(0)
+                codes = np.asarray(idx.to_numpy(zero_copy_only=False),
+                                   dtype=np.int64)
+                values = np.array(d.dictionary.to_pylist(), dtype=object)
+                return cls._normalize(codes, values)
+            except (pa.ArrowInvalid, pa.ArrowTypeError):
+                pass
+        return cls._from_objects_py(arr)
+
+    @classmethod
+    def _from_objects_py(cls, arr) -> "DictArray":
+        table: dict = {}
+        codes = np.empty(len(arr), dtype=np.int64)
+        for i, v in enumerate(arr):
+            if v is None:
+                v = ""
+            elif isinstance(v, (bytes, bytearray)):
+                v = bytes(v).decode("utf-8", "replace")
+            c = table.get(v)
+            if c is None:
+                c = table[v] = len(table)
+            codes[i] = c
+        values = np.array(list(table.keys()), dtype=object)
+        return cls._normalize(codes, values)
+
+    @classmethod
+    def _normalize(cls, codes: np.ndarray, values: np.ndarray) -> "DictArray":
+        """Sort the dictionary (code order == string order) and remap."""
+        if len(values) == 0:
+            values = np.array([""], dtype=object)
+            codes = np.zeros(len(codes), dtype=np.int64)
+        order = np.argsort(values)  # O(U log U) Python compares — U small
+        rank = np.empty(len(values), dtype=np.int64)
+        rank[order] = np.arange(len(values))
+        return cls(rank[codes].astype(np.int32), values[order])
+
+    @classmethod
+    def concat(cls, parts) -> "DictArray":
+        """Concatenate parts (DictArray or object arrays) under one union
+        dictionary. Codes remap through searchsorted — vectorized."""
+        das = [p if isinstance(p, DictArray) else cls.from_objects(p)
+               for p in parts]
+        das = [d for d in das if len(d)]
+        if not das:
+            return cls.empty()
+        if len(das) == 1:
+            return das[0]
+        union = unify_dictionaries(das)
+        return cls(np.concatenate([d.remap_to(union) for d in das]), union)
+
+    def remap_to(self, union_values: np.ndarray) -> np.ndarray:
+        """codes re-expressed against a superset dictionary (sorted)."""
+        if self.values is union_values:
+            return self.codes
+        mapping = np.searchsorted(union_values, self.values)
+        return mapping[self.codes].astype(np.int32)
+
+
+def unify_dictionaries(das: list) -> np.ndarray:
+    """→ the sorted union dictionary over all parts. Non-mutating (decoded
+    DictArrays can be shared through reader caches across concurrent
+    scans); callers re-express codes via `d.remap_to(union)`."""
+    vals = [d.values for d in das if len(d.values)]
+    if not vals:
+        return np.array([""], dtype=object)
+    if len(vals) == 1 or all(v is vals[0] for v in vals[1:]):
+        return vals[0]
+    return np.unique(np.concatenate(vals))
+
+
+def as_object_array(vals) -> np.ndarray:
+    """Materialize DictArray → object ndarray; pass plain arrays through."""
+    if isinstance(vals, DictArray):
+        return vals.materialize()
+    return vals
+
+
+def as_dict_part(vals) -> DictArray:
+    """Coerce one merge part to a DictArray. Non-object numeric arrays are
+    schema-evolution all-null placeholders (their valid mask is all False)."""
+    if isinstance(vals, DictArray):
+        return vals
+    if isinstance(vals, np.ndarray) and vals.dtype != object:
+        return DictArray(np.zeros(len(vals), dtype=np.int32),
+                         np.array([""], dtype=object))
+    return DictArray.from_objects(vals)

@@ -21,6 +21,7 @@ from dataclasses import dataclass, field
 import numpy as np
 
 from ..models.schema import ValueType
+from ..models.strcol import DictArray
 from ..storage.scan import ScanBatch
 from ..sql.expr import Expr
 from . import kernels
@@ -51,6 +52,11 @@ class AggSpec:
 class TpuQuery:
     filter: Expr | None = None
     group_tags: list[str] = field(default_factory=list)
+    # GROUP BY on STRING field columns: their dictionary codes extend the
+    # segment id directly (group = tags × field-codes × bucket) — the
+    # hits-style string group-by runs the same integer kernels as tags,
+    # never the row-materializing relational fallback
+    group_fields: list[str] = field(default_factory=list)
     time_bucket: tuple[int, int] | None = None   # (origin_ns, interval_ns)
     aggs: list[AggSpec] = field(default_factory=list)
 
@@ -83,7 +89,8 @@ def launch_scan_aggregate(batch: ScanBatch, query: TpuQuery):
     result (device→host pulls carry fixed relay latency)."""
     n = batch.n_rows
     if n == 0:
-        names = query.group_tags + (["time"] if query.time_bucket else []) \
+        names = query.group_tags + query.group_fields \
+            + (["time"] if query.time_bucket else []) \
             + [a.alias for a in query.aggs]
         return AggResult({nm: np.empty(0) for nm in names}, 0)
 
@@ -106,6 +113,31 @@ def launch_scan_aggregate(batch: ScanBatch, query: TpuQuery):
         group_labels = [()]
         n_groups = 1
     group_of_row = None  # host path computes lazily
+
+    # ---------------------------------------- string-field group dimensions
+    # each GROUP BY field contributes its dictionary-code axis (+1 slot for
+    # the NULL group key); combined gid = ((tag_gid·d1 + c1)·d2 + c2)…
+    gf_dims: list[int] = []
+    gf_dicts: list[np.ndarray] = []
+    gf_codes: list[np.ndarray] = []
+    for fcol in query.group_fields:
+        f = batch.fields.get(fcol)
+        if f is None:  # column absent in this vnode: every row groups NULL
+            gf_dims.append(1)
+            gf_dicts.append(np.empty(0, dtype=object))
+            gf_codes.append(np.zeros(n, dtype=np.int64))
+            continue
+        _vt, vals, valid = f
+        da = vals if isinstance(vals, DictArray) else DictArray.from_objects(vals)
+        u = len(da.values)
+        codes = da.codes.astype(np.int64)
+        if not bool(valid.all()):
+            codes = np.where(valid, codes, u)
+        gf_dims.append(u + 1)
+        gf_dicts.append(da.values)
+        gf_codes.append(codes)
+    for d in gf_dims:
+        n_groups *= d
 
     # ------------------------------------------------ aggregate wants
     col_wants: dict[str, dict] = {}
@@ -148,7 +180,21 @@ def launch_scan_aggregate(batch: ScanBatch, query: TpuQuery):
     from .placement import scan_device
 
     cpu_mode = scan_device().platform == "cpu"
+    eff_buckets = dense_span if dense_span <= _DENSE_BUCKET_LIMIT \
+        else min(n, dense_span)   # sparse remap keeps occupied buckets only
+    if gf_dims and n_groups * eff_buckets > (1 << 24):
+        # only the new string-field axes can blow this up — tag-only
+        # queries keep the pre-existing dense/sparse bucket behavior
+        from ..errors import PlanError
+
+        e = PlanError(
+            f"group-by cardinality {n_groups} groups × {dense_span} buckets "
+            "exceeds the segment-kernel budget")
+        e.fallback_relational = True
+        raise e
+
     use_device = (not cpu_mode
+                  and not query.group_fields
                   and _device_eligible(batch, query, col_wants, dense_span)
                   and i32_ok
                   and (query.time_bucket is None or arith is not None))
@@ -183,8 +229,8 @@ def launch_scan_aggregate(batch: ScanBatch, query: TpuQuery):
         # same (group tags, bucket) shape over one scan snapshot — cache it
         # on the batch (same rationale as the reference's TsmReader cache:
         # re-derivation, not decode, dominates repeat queries)
-        seg_key = (tuple(query.group_tags), origin, interval, bmin,
-                   dense_span)
+        seg_key = (tuple(query.group_tags), tuple(query.group_fields),
+                   origin, interval, bmin, dense_span)
         seg_cache = getattr(batch, "_seg_cache", None)
         if seg_cache is None:
             seg_cache = batch._seg_cache = {}
@@ -193,6 +239,10 @@ def launch_scan_aggregate(batch: ScanBatch, query: TpuQuery):
             seg_ids, bucket_starts, n_buckets = cached[:3]
         else:
             group_of_row = group_of_series[batch.sid_ordinal]
+            if gf_dims:
+                group_of_row = group_of_row.astype(np.int64)
+                for dim, codes in zip(gf_dims, gf_codes):
+                    group_of_row = group_of_row * dim + codes
             if query.time_bucket is not None:
                 b = (batch.ts - origin) // interval
                 if dense_span <= _DENSE_BUCKET_LIMIT:
@@ -236,6 +286,10 @@ def launch_scan_aggregate(batch: ScanBatch, query: TpuQuery):
                 entry[5] = np.diff(np.append(entry[4], n))
             return entry[4], entry[5]
 
+        # string-field group keys shred the per-series run structure (a
+        # run per value change): skip run-layout construction entirely
+        prefer_flat = bool(gf_dims)
+
         def cached_counts() -> np.ndarray:
             """Group sizes over ALL rows — derived from the cached run
             layout (O(runs), not O(n)), so repeated queries pay nothing
@@ -243,10 +297,14 @@ def launch_scan_aggregate(batch: ScanBatch, query: TpuQuery):
             entry = seg_cache.get(seg_key)
             if entry is not None:
                 if entry[3] is None or len(entry[3]) < num_segments:
-                    starts, rcounts = cached_runs()
-                    entry[3] = np.bincount(
-                        seg_ids[starts], weights=rcounts,
-                        minlength=num_segments).astype(np.int64)
+                    if prefer_flat:
+                        entry[3] = np.bincount(
+                            seg_ids, minlength=num_segments).astype(np.int64)
+                    else:
+                        starts, rcounts = cached_runs()
+                        entry[3] = np.bincount(
+                            seg_ids[starts], weights=rcounts,
+                            minlength=num_segments).astype(np.int64)
                 return entry[3][:num_segments]
             return np.bincount(seg_ids, minlength=num_segments) \
                 .astype(np.int64)
@@ -357,7 +415,7 @@ def launch_scan_aggregate(batch: ScanBatch, query: TpuQuery):
                       else kernels.aggregate_column_host)
         sel_runs = None
         ts_sel = None
-        if cpu_mode and sel_idx is not None:
+        if cpu_mode and sel_idx is not None and not prefer_flat:
             seg_sel = seg_ids[sel_idx]
             starts_sel = kernels.run_boundaries(
                 seg_sel, batch.sid_ordinal[sel_idx])
@@ -421,25 +479,46 @@ def launch_scan_aggregate(batch: ScanBatch, query: TpuQuery):
                 dev_vals = vals
             all_valid = col_all_valid(cname, valid)
             col_fl = wants.get("want_first") or wants.get("want_last")
-            if cpu_mode and not (col_fl and rank_based_fl):
+            if cpu_mode and not (col_fl and rank_based_fl) \
+                    and not (prefer_flat and not col_fl):
+                # (string-field group keys without first/last skip the
+                # run-aware block entirely — the scatter kernels below do
+                # flat bincounts over sel_idx/valid subsets)
                 # ------------------------------- run-aware host kernels
                 need_ts = bool(col_fl)
                 if all_rows and all_valid:
                     starts, rcounts = cached_runs()
-                    r = kernels.run_segment_partials(
-                        dev_vals, seg_ids, starts, num_segments,
-                        {**wants, "want_count": False},
-                        ts=batch.ts if need_ts else None,
-                        run_counts=rcounts)
+                    if not col_fl and len(starts) > (n >> 2):
+                        # fine-grained runs (string-field group keys shred
+                        # the per-series run structure): a flat bincount
+                        # scatter beats reduceat over ~n tiny runs
+                        r = kernels.numpy_segment_partials(
+                            dev_vals, valid, seg_ids, rank, num_segments,
+                            {**wants, "want_count": False},
+                            assume_all_valid=True)
+                    else:
+                        r = kernels.run_segment_partials(
+                            dev_vals, seg_ids, starts, num_segments,
+                            {**wants, "want_count": False},
+                            ts=batch.ts if need_ts else None,
+                            run_counts=rcounts)
                     r["count"] = presence
                 elif all_valid and sel_runs is not None:
                     seg_sel, starts_sel, rcounts_sel = sel_runs
-                    r = kernels.run_segment_partials(
-                        dev_vals[sel_idx], seg_sel, starts_sel,
-                        num_segments, {**wants, "want_count": False},
-                        ts=(ts_sel if ts_sel is not None
-                            else (batch.ts[sel_idx] if need_ts else None)),
-                        run_counts=rcounts_sel)
+                    if not col_fl and len(starts_sel) > (len(seg_sel) >> 2):
+                        r = kernels.numpy_segment_partials(
+                            dev_vals[sel_idx],
+                            np.ones(len(seg_sel), dtype=bool), seg_sel,
+                            rank[sel_idx], num_segments,
+                            {**wants, "want_count": False},
+                            assume_all_valid=True)
+                    else:
+                        r = kernels.run_segment_partials(
+                            dev_vals[sel_idx], seg_sel, starts_sel,
+                            num_segments, {**wants, "want_count": False},
+                            ts=(ts_sel if ts_sel is not None
+                                else (batch.ts[sel_idx] if need_ts else None)),
+                            run_counts=rcounts_sel)
                     r["count"] = presence
                 else:
                     # nulls present: compress valid rows — compression
@@ -483,17 +562,33 @@ def launch_scan_aggregate(batch: ScanBatch, query: TpuQuery):
 
         return _assemble(batch, query, presence, present, col_results,
                          group_labels, bucket_starts, n_buckets, needs_rank,
-                         order, unsigned_biased=not cpu_mode)
+                         order, unsigned_biased=not cpu_mode,
+                         gf=(gf_dims, gf_dicts) if gf_dims else None)
 
 
 def _assemble(batch, query, presence, present, col_results, group_labels,
               bucket_starts, n_buckets, needs_rank, order,
-              unsigned_biased: bool = True) -> AggResult:
+              unsigned_biased: bool = True, gf=None) -> AggResult:
     out_cols: dict[str, np.ndarray] = {}
     out_valid: dict[str, np.ndarray] = {}
     sel = np.nonzero(present)[0]
     grp_idx = (sel // n_buckets).astype(np.int64)
     bkt_idx = (sel % n_buckets).astype(np.int64)
+    if gf is not None:
+        # peel the field-code axes off the combined gid (innermost first);
+        # code == U is the NULL group key
+        gf_dims, gf_dicts = gf
+        gid = grp_idx
+        for fcol, dim, dic in zip(reversed(query.group_fields),
+                                  reversed(gf_dims), reversed(gf_dicts)):
+            code = gid % dim
+            gid = gid // dim
+            lab = np.empty(len(code), dtype=object)
+            non_null = code < (dim - 1)
+            if non_null.any():
+                lab[non_null] = dic[code[non_null]]
+            out_cols[fcol] = lab
+        grp_idx = gid
     for i, t in enumerate(query.group_tags):
         out_cols[t] = np.array([group_labels[g][i] for g in grp_idx], dtype=object)
     if bucket_starts is not None:
@@ -619,14 +714,18 @@ def _contains_is_null(e) -> bool:
 
 
 def is_conjunctive(e) -> bool:
-    """True when the filter tree contains no OR: post-hoc validity
-    masking (AND-ing a column's valid mask into the row mask) is only
-    sound then — under a disjunction a row may match through a branch
-    that never touches the NULL column. Non-conjunctive filters rely on
-    the comparison-leaf masking in sql.expr instead."""
-    from ..sql.expr import BinOp
+    """True when the filter tree contains no OR and no NOT: post-hoc
+    validity masking (AND-ing a column's valid mask into the row mask) is
+    only sound then — under a disjunction a row may match through a
+    branch that never touches the NULL column, and NOT over AND is a
+    disjunction by De Morgan (NOT (i = 5 AND f > 2) must match an
+    i=NULL, f=0 row through the right branch). Non-conjunctive filters
+    rely on the comparison-leaf masking in sql.expr instead."""
+    from ..sql.expr import BinOp, UnaryOp
 
     if isinstance(e, BinOp) and e.op == "or":
+        return False
+    if isinstance(e, UnaryOp) and e.op == "not" and _contains_and(e.operand):
         return False
     for attr in ("left", "right", "operand", "expr", "low", "high"):
         sub = getattr(e, attr, None)
@@ -637,6 +736,21 @@ def is_conjunctive(e) -> bool:
         return all(is_conjunctive(a) for a in args
                    if isinstance(a, Expr))
     return True
+
+
+def _contains_and(e) -> bool:
+    from ..sql.expr import BinOp
+
+    if isinstance(e, BinOp) and e.op == "and":
+        return True
+    for attr in ("left", "right", "operand", "expr", "low", "high"):
+        sub = getattr(e, attr, None)
+        if isinstance(sub, Expr) and _contains_and(sub):
+            return True
+    args = getattr(e, "args", None)
+    if args:
+        return any(_contains_and(a) for a in args if isinstance(a, Expr))
+    return False
 
 
 def is_null_columns(e) -> set:
@@ -738,33 +852,45 @@ def _filter_env(batch: ScanBatch, needed: set | None = None,
 
 
 def _host_string_agg(vals, valid, seg_ids, rank, num_segments, wants):
-    """String columns aggregate host-side (count/first/last/min/max)."""
+    """String column aggregation on dictionary CODES (count/first/last/
+    min/max): the sorted-dictionary invariant makes code order string
+    order, so everything is integer ufunc.at — no per-row Python."""
+    from ..models.strcol import DictArray
+
+    if not isinstance(vals, DictArray):
+        vals = DictArray.from_objects(vals)
     out = {}
-    count = np.zeros(num_segments, dtype=np.int64)
-    np.add.at(count, seg_ids[valid], 1)
+    segv = seg_ids[valid]
+    cv = vals.codes[valid].astype(np.int64)
+    uniq = vals.values
+    u = max(len(uniq), 1)
+    count = np.bincount(segv, minlength=num_segments).astype(np.int64)
     out["count"] = count
+    have = count > 0
     if wants.get("want_min") or wants.get("want_max"):
+        mins_c = np.full(num_segments, np.iinfo(np.int64).max, dtype=np.int64)
+        np.minimum.at(mins_c, segv, cv)
+        maxs_c = np.full(num_segments, -1, dtype=np.int64)
+        np.maximum.at(maxs_c, segv, cv)
         mins = np.empty(num_segments, dtype=object)
         maxs = np.empty(num_segments, dtype=object)
-        for i in np.nonzero(valid)[0]:
-            s = seg_ids[i]
-            v = vals[i]
-            if mins[s] is None or v < mins[s]:
-                mins[s] = v
-            if maxs[s] is None or v > maxs[s]:
-                maxs[s] = v
+        mins[have] = uniq[mins_c[have]]
+        maxs[have] = uniq[maxs_c[have]]
         out["min"], out["max"] = mins, maxs
     if wants.get("want_first") or wants.get("want_last"):
-        fr = np.full(num_segments, 2**31 - 1, dtype=np.int64)
-        lr = np.full(num_segments, -(2**31), dtype=np.int64)
+        # pack (rank, code) into one i64 so a single min/max scatter picks
+        # both the extreme rank and the value it carries
+        packed = rank[valid].astype(np.int64) * u + cv
+        fpk = np.full(num_segments, np.iinfo(np.int64).max, dtype=np.int64)
+        np.minimum.at(fpk, segv, packed)
+        lpk = np.full(num_segments, -1, dtype=np.int64)
+        np.maximum.at(lpk, segv, packed)
         fv = np.empty(num_segments, dtype=object)
         lv = np.empty(num_segments, dtype=object)
-        for i in np.nonzero(valid)[0]:
-            s = seg_ids[i]
-            if rank[i] < fr[s]:
-                fr[s] = rank[i]; fv[s] = vals[i]
-            if rank[i] > lr[s]:
-                lr[s] = rank[i]; lv[s] = vals[i]
+        fv[have] = uniq[fpk[have] % u]
+        lv[have] = uniq[lpk[have] % u]
+        fr = np.where(have, fpk // u, 2**31 - 1)
+        lr = np.where(have, lpk // u, -(2**31))
         out["first"], out["last"] = fv, lv
         out["first_rank"], out["last_rank"] = fr, lr
     if wants.get("want_sum"):

@@ -20,6 +20,7 @@ import pyarrow as pa
 
 from ..models.schema import ValueType
 from ..models.series import SeriesKey
+from ..models.strcol import DictArray
 from ..storage.scan import ScanBatch
 
 _ARROW_TYPES = {
@@ -42,14 +43,18 @@ def encode_scan_batch(b: ScanBatch) -> bytes:
         vts[name] = int(vt)
         mask = ~np.asarray(valid, dtype=bool)
         if vt in (ValueType.STRING, ValueType.GEOMETRY):
-            # object arrays: go through python list; arrow masks via None
-            pylist = [None if m else str(v)
-                      for v, m in zip(vals.tolist(), mask.tolist())]
-            arr = pa.array(pylist, type=_ARROW_TYPES[vt])
+            # dictionary columns ride as Arrow DictionaryArray: codes move
+            # as int32 buffers, the dictionary once — no per-row Python
+            da = vals if isinstance(vals, DictArray) \
+                else DictArray.from_objects(vals)
+            idx = pa.array(da.codes, type=pa.int32(), mask=mask)
+            arr = pa.DictionaryArray.from_arrays(
+                idx, pa.array([str(v) for v in da.values],
+                              type=pa.large_utf8()))
         else:
             arr = pa.array(np.asarray(vals), type=_ARROW_TYPES[vt], mask=mask)
         arrays.append(arr)
-        fields.append(pa.field(name, _ARROW_TYPES[vt]))
+        fields.append(pa.field(name, arr.type))
     meta = {
         "table": b.table,
         "series_ids": [int(s) for s in b.series_ids],
@@ -79,8 +84,20 @@ def decode_scan_batch(raw: bytes) -> ScanBatch:
         valid = ~np.asarray(col.is_null().to_numpy(zero_copy_only=False),
                             dtype=bool)
         if vt in (ValueType.STRING, ValueType.GEOMETRY):
-            vals = np.array([v if v is not None else "" for v in col.to_pylist()],
-                            dtype=object)
+            chunk = (col.combine_chunks() if isinstance(col, pa.ChunkedArray)
+                     else col)
+            if pa.types.is_dictionary(chunk.type):
+                idx = chunk.indices
+                if idx.null_count:
+                    idx = idx.fill_null(0)
+                codes = np.asarray(idx.to_numpy(zero_copy_only=False),
+                                   dtype=np.int64)
+                values = np.array(chunk.dictionary.to_pylist(), dtype=object)
+                vals = DictArray._normalize(codes, values)
+            else:  # older peers ship plain utf8
+                vals = DictArray.from_objects(
+                    np.array([v if v is not None else ""
+                              for v in chunk.to_pylist()], dtype=object))
         else:
             np_dtype = {ValueType.FLOAT: np.float64,
                         ValueType.INTEGER: np.int64,

@@ -587,7 +587,11 @@ class RaftNode:
             my_term = self.log.term_at(my_last)
             up_to_date = (msg["last_log_term"], msg["last_log_index"]) >= \
                 (my_term, my_last)
+            # a LEADER always refuses: if it can receive this prevote it is
+            # alive, and granting would let a healed node assemble a
+            # majority to depose it (the disruption PreVote exists to stop)
             granted = (msg["term"] >= self.term and up_to_date
+                       and self.role != Role.LEADER
                        and not (heard_recently
                                 and self.role == Role.FOLLOWER))
             return {"term": self.term, "granted": granted}

@@ -25,6 +25,7 @@ from ..models.schema import (
     TenantOptions, TskvTableSchema, ValueType,
 )
 from ..models.codec import Encoding
+from ..models.strcol import DictArray, as_object_array
 from ..ops.tpu_exec import AggSpec, TpuQuery, execute_scan_aggregate
 from ..parallel.coordinator import Coordinator
 from ..parallel.meta import MetaStore
@@ -693,7 +694,10 @@ class QueryExecutor:
             lines.append(f"  time_ranges={plan.time_ranges!r}")
             lines.append(f"  tag_domains={plan.tag_domains!r}")
             lines.append(f"  filter={plan.filter.to_sql() if plan.filter else None}")
-            lines.append(f"  group_tags={plan.group_tags} bucket={plan.bucket}")
+            lines.append(f"  group_tags={plan.group_tags}"
+                         + (f" group_fields={plan.group_fields}"
+                            if plan.group_fields else "")
+                         + f" bucket={plan.bucket}")
             lines.append(f"  partial_aggs={[(a.func, a.column) for a in plan.aggs]}")
         else:
             lines.append("TpuScanExec")
@@ -732,15 +736,16 @@ class QueryExecutor:
         schema = self.meta.table(session.tenant, db, table)
         try:
             plan = plan_select(stmt, schema)
+            if isinstance(plan, AggregatePlan):
+                return self._exec_aggregate(plan, session.tenant, db)
+            return self._exec_raw(plan, session.tenant, db)
         except PlanError as e:
             if getattr(e, "fallback_relational", False):
-                # e.g. GROUP BY on a field column: the relational pipeline
-                # groups by arbitrary expressions
+                # e.g. GROUP BY on a field column the segment kernels
+                # can't key (non-string field, cardinality blow-up): the
+                # relational pipeline groups by arbitrary expressions
                 return self._select_relational(stmt, session)
             raise
-        if isinstance(plan, AggregatePlan):
-            return self._exec_aggregate(plan, session.tenant, db)
-        return self._exec_raw(plan, session.tenant, db)
 
     def _ts_gen_func(self, stmt: ast.SelectStmt, session: Session):
         """Row-set-valued data repair (reference ts_gen_func/data_repair/:
@@ -1227,6 +1232,7 @@ class QueryExecutor:
     def _exec_aggregate(self, plan: AggregatePlan, tenant: str, db: str):
         phys_aggs, finalize = _decompose_aggs(plan.aggs)
         needed_fields = sorted({a.column for a in phys_aggs if a.column}
+                               | set(plan.group_fields)
                                | (plan.filter.columns() & set(plan.schema.field_names())
                                   if plan.filter else set()))
         batches = self.coord.scan_table(
@@ -1240,6 +1246,7 @@ class QueryExecutor:
     def _exec_aggregate_batches(self, plan, batches, phys_aggs, finalize):
         host_funcs = ("count_distinct", "collect", "collect_ts")
         q = TpuQuery(filter=plan.filter, group_tags=plan.group_tags,
+                     group_fields=plan.group_fields,
                      time_bucket=plan.bucket,
                      aggs=[a for a in phys_aggs if a.func not in host_funcs])
         distinct_specs = [a for a in phys_aggs if a.func in host_funcs]
@@ -1272,7 +1279,7 @@ class QueryExecutor:
             # SQL: a global aggregate always yields one row
             return self._finalize_aggregate(plan, {(): {}}, finalize)
         env: dict[str, np.ndarray] = {}
-        for t in plan.group_tags:
+        for t in plan.group_tags + plan.group_fields:
             env[t] = r.columns[t]
         if plan.bucket is not None:
             env["time"] = r.columns["time"]
@@ -1317,7 +1324,7 @@ class QueryExecutor:
         keys = list(acc.keys())
         n = len(keys)
         env: dict[str, np.ndarray] = {}
-        for i, t in enumerate(plan.group_tags):
+        for i, t in enumerate(plan.group_tags + plan.group_fields):
             env[t] = np.array([k[i] for k in keys], dtype=object)
         if plan.bucket is not None:
             env["time"] = np.array([k[-1] for k in keys], dtype=np.int64) \
@@ -1419,7 +1426,7 @@ class QueryExecutor:
             # only see surviving rows — CAST over a filtered-out Inf row
             # must not abort, and selective scans shrink the eval cost
             if not bool(mask.all()):
-                env = {k: (v[mask] if isinstance(v, np.ndarray)
+                env = {k: (v[mask] if isinstance(v, (np.ndarray, DictArray))
                            and len(v) == b.n_rows else v)
                        for k, v in env.items()}
             frames.append((env, int(mask.sum())))
@@ -1439,6 +1446,8 @@ class QueryExecutor:
                     env[c] = np.zeros(n_rows)
                     env[f"__valid__:{c}"] = np.zeros(n_rows, dtype=bool)
                 ov = oe.eval(env, np)
+                if isinstance(ov, DictArray):
+                    ov = ov.materialize()
                 if ov is None:
                     ov = np.full(n_rows, None, dtype=object)
                 elif np.isscalar(ov) or getattr(ov, "shape", None) == ():
@@ -1466,6 +1475,8 @@ class QueryExecutor:
                     env[c] = np.zeros(n_rows)
                     env[f"__valid__:{c}"] = np.zeros(n_rows, dtype=bool)
                 v = expr.eval(env, np)
+                if isinstance(v, DictArray):
+                    v = v.materialize()
                 if v is None:   # e.g. TRY_CAST failure: an all-NULL column
                     v = np.full(n_rows, None, dtype=object)
                 elif np.isscalar(v) or getattr(v, "shape", None) == ():
@@ -1709,7 +1720,7 @@ def _merge_partial(acc: dict, result, plan: AggregatePlan,
     if n == 0:
         return
     cols = result.columns
-    gt = plan.group_tags
+    gt = plan.group_tags + plan.group_fields
     for i in range(n):
         key = tuple(cols[t][i] for t in gt)
         if plan.bucket is not None:
@@ -1749,6 +1760,7 @@ def _merge_distinct(acc: dict, batch, plan: AggregatePlan, spec: AggSpec):
     """Host-side COUNT(DISTINCT col): collect value sets per group."""
     if spec.column in batch.fields:
         vt, vals, valid = batch.fields[spec.column]
+        vals = as_object_array(vals)
     elif spec.column in plan.schema.tag_names():
         per_series = np.array(
             [(k.tag_value(spec.column) if k is not None else None)

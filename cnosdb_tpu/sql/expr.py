@@ -19,6 +19,7 @@ from ..errors import PlanError
 from ..models.predicate import (
     AllDomain, ColumnDomains, NoneDomain, RangeDomain, SetDomain,
 )
+from ..models.strcol import DictArray
 
 
 class Expr:
@@ -150,6 +151,8 @@ def _obj_binop(op: str, f, xp, a, b):
     n = len(a) if _is_obj_arr(a) else len(b)
 
     def clean(v):
+        if isinstance(v, DictArray):
+            return v.materialize(), np.zeros(n, dtype=bool)
         if not _is_obj_arr(v):
             return v, np.zeros(n, dtype=bool)
         nulls = np.array([x is None for x in v], dtype=bool)
@@ -408,6 +411,15 @@ class Like(Expr):
     def eval(self, env, xp):
         v = self.expr.eval(env, xp)
         rx = self._regex()
+        if isinstance(v, DictArray):
+            # regex once per unique, gather to rows
+            out = v.map_values(
+                lambda x: bool(rx.match(x)) if isinstance(x, str) else False,
+                out_dtype=bool)
+            out = ~out if self.negated else out
+            if xp is np:
+                out = _mask_operand_validity(out, env, self.expr)
+            return out
         arr = np.asarray(v, dtype=object) if not np.isscalar(v) else None
         if arr is None:
             m = bool(rx.match(str(v)))
@@ -480,6 +492,10 @@ def _str_func(fn, *, out=object):
         import numpy as _np
 
         rest = [r.item() if hasattr(r, "item") else r for r in rest]
+        if isinstance(arr, DictArray):
+            return arr.map_values(lambda x: fn(str(x), *rest),
+                                  out_dtype=out if out is not object
+                                  else object)
         if isinstance(arr, _np.ndarray):
             vals = [None if x is None else fn(str(x), *rest) for x in arr]
             if out is object:
@@ -526,6 +542,8 @@ def _fn_rpad(s, n, p=" "):
 def _fn_concat(xp, *parts):
     import numpy as _np
 
+    parts = [p.materialize() if isinstance(p, DictArray) else p
+             for p in parts]
     arrays = [p for p in parts if isinstance(p, _np.ndarray)]
     if not arrays:
         return "".join("" if p is None else str(p) for p in parts)
@@ -687,6 +705,15 @@ class Cast(Expr):
         v = self.expr.eval(env, xp)
         if v is None:
             return None
+        if isinstance(v, DictArray):
+            def cast_u(x):
+                try:
+                    return _cast_scalar(x, kind)
+                except (ValueError, OverflowError) as e:
+                    if self.safe:
+                        return None
+                    raise PlanError(f"CAST failed: {e}")
+            return v.map_values(cast_u)
         if isinstance(v, np.ndarray) and v.dtype != object:
             # NULL slots of a typed column carry garbage values — they
             # must neither abort a strict CAST nor poison TRY_CAST

@@ -17,7 +17,7 @@ from dataclasses import dataclass, field
 
 from ..errors import PlanError
 from ..models.predicate import ColumnDomains, TimeRange, TimeRanges, I64_MIN, I64_MAX
-from ..models.schema import TskvTableSchema
+from ..models.schema import TskvTableSchema, ValueType
 from ..ops.tpu_exec import AggSpec
 from . import ast
 from .expr import (
@@ -48,6 +48,7 @@ class AggregatePlan:
     tag_domains: ColumnDomains
     filter: Expr | None                  # residual, re-checked on device/host
     group_tags: list[str]
+    group_fields: list[str]              # STRING field group keys (dict codes)
     bucket: tuple[int, int] | None       # (origin, interval)
     bucket_alias: str | None
     aggs: list[AggSpec]                  # internal partial aggregates
@@ -329,6 +330,10 @@ def _plan_aggregate(stmt, schema, time_trs, tag_domains, residual):
             alias_map[it.alias] = it.expr
 
     group_tags: list[str] = []
+    group_fields: list[str] = []
+    string_fields = {c.name for c in schema.field_columns
+                     if c.column_type.value_type in (ValueType.STRING,
+                                                     ValueType.GEOMETRY)}
     bucket = None
     bucket_alias = None
     group_exprs: list[Expr] = []
@@ -355,9 +360,13 @@ def _plan_aggregate(stmt, schema, time_trs, tag_domains, residual):
                 return
             if g.name == TIME_COL:
                 raise PlanError("GROUP BY time requires date_bin/time_window")
-            # grouping by a FIELD column: the fused scan kernel groups by
-            # series tags / time buckets only — the relational pipeline
-            # evaluates arbitrary group keys over materialized rows
+            if g.name in string_fields:
+                # STRING field keys group on dictionary codes inside the
+                # segment kernels — same integer path as tags
+                group_fields.append(g.name)
+                return
+            # grouping by a non-string FIELD column: the relational
+            # pipeline evaluates arbitrary group keys over materialized rows
             e = PlanError(
                 f"can only GROUP BY tags or time buckets, got {g.name!r}")
             e.fallback_relational = True
@@ -398,6 +407,9 @@ def _plan_aggregate(stmt, schema, time_trs, tag_domains, residual):
                 raise PlanError(f"column {e.name!r} must appear in GROUP BY")
             output.append((it.alias or e.name, e))
             continue
+        if isinstance(e, Column) and e.name in group_fields:
+            output.append((it.alias or e.name, e))
+            continue
         rewritten = coll.rewrite(e)
         name = it.alias or (e.to_sql() if not isinstance(e, Func)
                             else _default_agg_name(e))
@@ -414,9 +426,19 @@ def _plan_aggregate(stmt, schema, time_trs, tag_domains, residual):
 
     if (gapfill or fill_methods) and bucket is None:
         raise PlanError("gapfill/locf/interpolate require a time bucket")
+    if group_fields and (gapfill or fill_methods
+                         or any(a.func in ("count_distinct", "collect",
+                                           "collect_ts")
+                                for a in coll.aggs)):
+        # host-side distinct/collect merging and gapfill key on tags only —
+        # string-field group keys take the relational pipeline there
+        e = PlanError("string-field GROUP BY with distinct/collect/gapfill")
+        e.fallback_relational = True
+        raise e
     return AggregatePlan(
         table=stmt.table, schema=schema, time_ranges=time_trs,
         tag_domains=tag_domains, filter=residual, group_tags=group_tags,
+        group_fields=group_fields,
         bucket=bucket, bucket_alias=bucket_alias, aggs=coll.aggs,
         output=output, having=having, order_by=order_by,
         limit=stmt.limit, offset=stmt.offset,

@@ -36,6 +36,7 @@ import zstandard
 from ..errors import CodecError
 from ..models.codec import Encoding
 from ..models.schema import ValueType
+from ..models.strcol import DictArray
 
 _ZSTD_C = zstandard.ZstdCompressor(level=1)
 _ZSTD_C3 = zstandard.ZstdCompressor(level=3)
@@ -202,16 +203,47 @@ def _decode_bool(data: bytes) -> np.ndarray:
 
 
 # ---------------------------------------------------------------------------
-# strings
+# strings — dictionary-encoded (codes + sorted unique dictionary)
 # ---------------------------------------------------------------------------
+# Page layout v2: [0xFFFFFFFF u32][n u32][u u32][dict lens u32 xU]
+#                 [dict utf8 concat][codes width u8][codes narrow raw]
+# Python-object decode cost is O(U) (the dictionary); the N row codes are
+# one frombuffer. v1 ([n][lens][concat], per-row decode) remains readable.
+_DICT_MARKER = 0xFFFFFFFF
+
+
 def _pack_strings(values) -> bytes:
-    # [n u32][lens u32 xN][utf8 concat]
-    bs = [v.encode() if isinstance(v, str) else bytes(v) for v in values]
+    da = values if isinstance(values, DictArray) else DictArray.from_objects(values)
+    bs = [v.encode() if isinstance(v, str) else bytes(v) for v in da.values]
     lens = np.array([len(b) for b in bs], dtype=np.uint32)
-    return np.uint32(len(bs)).tobytes() + lens.tobytes() + b"".join(bs)
+    width, codes_raw = _narrow_cast(da.codes.astype(np.uint64))
+    return (np.uint32(_DICT_MARKER).tobytes() + np.uint32(len(da.codes)).tobytes()
+            + np.uint32(len(bs)).tobytes() + lens.tobytes() + b"".join(bs)
+            + bytes([width]) + codes_raw)
 
 
-def _unpack_strings(raw: bytes) -> np.ndarray:
+def _unpack_strings(raw: bytes) -> DictArray:
+    head = int(np.frombuffer(raw[:4], dtype=np.uint32)[0])
+    if head != _DICT_MARKER:  # v1 page
+        return DictArray.from_objects(_unpack_strings_v1(raw))
+    n = int(np.frombuffer(raw[4:8], dtype=np.uint32)[0])
+    u = int(np.frombuffer(raw[8:12], dtype=np.uint32)[0])
+    lens = np.frombuffer(raw[12:12 + 4 * u], dtype=np.uint32)
+    off = 12 + 4 * u
+    ends = np.cumsum(lens)
+    starts = ends - lens
+    values = np.empty(u, dtype=object)
+    for i in range(u):  # O(unique), not O(rows)
+        values[i] = raw[off + starts[i]: off + ends[i]].decode()
+    off += int(ends[-1]) if u else 0
+    width = raw[off]
+    codes = _widen(width, raw[off + 1:])[:n].astype(np.int32)
+    if u == 0:
+        values = np.array([""], dtype=object)
+    return DictArray(codes, values)
+
+
+def _unpack_strings_v1(raw: bytes) -> np.ndarray:
     n = int(np.frombuffer(raw[:4], dtype=np.uint32)[0])
     lens = np.frombuffer(raw[4:4 + 4 * n], dtype=np.uint32)
     out = np.empty(n, dtype=object)

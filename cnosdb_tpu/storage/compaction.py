@@ -17,6 +17,8 @@ import numpy as np
 
 from ..models.schema import ValueType
 from ..models.codec import Encoding
+from ..models.strcol import DictArray, as_dict_part as _as_dict_part, \
+    unify_dictionaries
 from .memcache import _group_starts, _typed_array
 from .summary import FileMeta, Version, VersionEdit, MAX_LEVEL
 from .tombstone import tombstone_path
@@ -282,10 +284,19 @@ def _merge_series(table: str, sid: int, readers) -> tuple[np.ndarray, dict] | No
     for name, parts in col_parts.items():
         vt, enc, cid = col_types[name]
         np_dtype = vt.numpy_dtype()
-        vals_all = np.empty(total, dtype=np_dtype if np_dtype is not object else object)
+        is_str = np_dtype is object
+        if is_str:
+            # dictionary columns merge on int32 codes under a union dict;
+            # re-encode writes the union straight back out
+            das = [_as_dict_part(vals) for _, vals, _ in parts]
+            union = unify_dictionaries(das)
+            vals_all = np.zeros(total, dtype=np.int32)
+        else:
+            vals_all = np.empty(total, dtype=np_dtype)
         valid_all = np.zeros(total, dtype=bool)
-        for off, vals, valid in parts:
-            vals_all[off:off + len(vals)] = vals
+        for i, (off, vals, valid) in enumerate(parts):
+            vals_all[off:off + len(vals)] = (das[i].remap_to(union)
+                                             if is_str else vals)
             valid_all[off:off + len(valid)] = valid
         if presorted:
             vals_out, valid_out = vals_all, valid_all
@@ -296,6 +307,8 @@ def _merge_series(table: str, sid: int, readers) -> tuple[np.ndarray, dict] | No
             last_valid = np.maximum.reduceat(score, group_starts)
             valid_out = last_valid >= 0
             vals_out = vals_s[np.clip(last_valid, 0, None)]
+        if is_str:
+            vals_out = DictArray(vals_out, union)
         null_mask = None if valid_out.all() else ~valid_out
         out_cols[name] = (cid, vt, enc, vals_out, null_mask)
     return uts, out_cols

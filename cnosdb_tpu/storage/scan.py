@@ -21,6 +21,8 @@ import numpy as np
 
 from ..models.predicate import TimeRange, TimeRanges
 from ..models.schema import TskvTableSchema, ValueType
+from ..models.strcol import DictArray, as_dict_part as _as_dict_part, \
+    unify_dictionaries
 from .memcache import _group_starts
 from .vnode import VnodeStorage
 
@@ -141,14 +143,25 @@ def merge_parts(parts, field_names: list[str]):
         if vt is None:
             continue
         np_dtype = vt.numpy_dtype()
-        vals_all = np.zeros(total, dtype=np_dtype if np_dtype is not object else object)
+        is_str = np_dtype is object
+        union = None
+        if is_str:
+            # strings merge as int32 codes under one union dictionary —
+            # the dedup pick below is pure integer indexing either way
+            das = {id(f): _as_dict_part(f[name][1])
+                   for _, f in parts if name in f}
+            union = unify_dictionaries(list(das.values()))
+            vals_all = np.zeros(total, dtype=np.int32)
+        else:
+            vals_all = np.zeros(total, dtype=np_dtype)
         valid_all = np.zeros(total, dtype=bool)
         off = 0
         for ts_p, fields in parts:
             n = len(ts_p)
             if name in fields:
                 _, vals, valid = fields[name]
-                vals_all[off:off + n] = vals
+                vals_all[off:off + n] = (das[id(fields)].remap_to(union)
+                                         if is_str else vals)
                 valid_all[off:off + n] = valid
             off += n
         vals_s = vals_all[order]
@@ -157,6 +170,8 @@ def merge_parts(parts, field_names: list[str]):
         last_valid = np.maximum.reduceat(score, group_starts)
         valid_out = last_valid >= 0
         vals_out = vals_s[np.clip(last_valid, 0, None)]
+        if is_str:
+            vals_out = DictArray(vals_out, union)
         out[name] = (vt, vals_out, valid_out)
     return uts, out
 
@@ -213,7 +228,17 @@ def scan_vnode(vnode: VnodeStorage, table: str,
             continue
         vt = ftypes[name]
         np_dtype = vt.numpy_dtype()
-        vals_all = np.zeros(total, dtype=np_dtype if np_dtype is not object else object)
+        if np_dtype is object:
+            das = [_as_dict_part(vals) for _, vals, _ in parts]
+            union = unify_dictionaries(das)
+            vals_all = np.zeros(total, dtype=np.int32)
+            valid_all = np.zeros(total, dtype=bool)
+            for (off, vals, valid), d in zip(parts, das):
+                vals_all[off:off + len(d)] = d.remap_to(union)
+                valid_all[off:off + len(valid)] = valid
+            out_fields[name] = (vt, DictArray(vals_all, union), valid_all)
+            continue
+        vals_all = np.zeros(total, dtype=np_dtype)
         valid_all = np.zeros(total, dtype=bool)
         for off, vals, valid in parts:
             vals_all[off:off + len(vals)] = vals

@@ -36,6 +36,7 @@ import zstandard
 from ..errors import TsmError, ChecksumMismatch
 from ..models.codec import Encoding
 from ..models.schema import ValueType
+from ..models.strcol import DictArray
 from ..utils.bloom import BloomFilter
 from . import codecs
 
@@ -227,8 +228,8 @@ class TsmWriter:
                 vals = values[s:e]
                 if null_mask is not None:
                     nm = np.ascontiguousarray(null_mask[s:e], dtype=bool)
-                    dense = vals[~nm] if isinstance(vals, np.ndarray) else \
-                        [v for v, m in zip(vals, nm) if not m]
+                    dense = vals[~nm] if isinstance(vals, (np.ndarray, DictArray)) \
+                        else [v for v, m in zip(vals, nm) if not m]
                     bitset = np.packbits(nm).tobytes()
                     has_nulls = bool(nm.any())
                 else:
@@ -414,6 +415,12 @@ class TsmReader:
             if nm is None:
                 outs.append(dense)
                 masks.append(np.ones(pm.n_rows, dtype=bool))
+            elif isinstance(dense, DictArray):
+                # null expansion on codes: invalid rows carry code 0
+                full_codes = np.zeros(pm.n_rows, dtype=np.int32)
+                full_codes[~nm] = dense.codes
+                outs.append(DictArray(full_codes, dense.values))
+                masks.append(~nm)
             else:
                 full = np.zeros(pm.n_rows, dtype=dense.dtype if isinstance(dense, np.ndarray) else object)
                 if fill is not None:
@@ -423,4 +430,6 @@ class TsmReader:
                 masks.append(~nm)
         if len(outs) == 1:
             return outs[0], masks[0]
+        if any(isinstance(o, DictArray) for o in outs):
+            return DictArray.concat(outs), np.concatenate(masks)
         return np.concatenate(outs), np.concatenate(masks)

@@ -352,20 +352,26 @@ class VnodeStorage:
 
         import numpy as np
 
+        from ..models.strcol import as_object_array
         from .scan import scan_vnode
 
         h = hashlib.sha256()
-        tables = set()
-        for (table, _sid) in list(self.active.series.keys()):
-            tables.add(table)
-        for c in self.immutables:
-            for (table, _sid) in c.series:
+        with self.lock:
+            # under the vnode lock: a concurrent snapshot install swaps
+            # summary/index mid-scan otherwise (truncated-footer reads
+            # while a lagging replica is being seeded)
+            tables = set()
+            for (table, _sid) in list(self.active.series.keys()):
                 tables.add(table)
-        for fm in self.summary.version.all_files():
-            r = self.summary.version.reader(fm)
-            tables.update(r.tables())
+            for c in self.immutables:
+                for (table, _sid) in c.series:
+                    tables.add(table)
+            for fm in self.summary.version.all_files():
+                r = self.summary.version.reader(fm)
+                tables.update(r.tables())
+            batches = {t: scan_vnode(self, t) for t in sorted(tables)}
         for table in sorted(tables):
-            b = scan_vnode(self, table)
+            b = batches[table]
             if b.n_rows == 0:
                 continue
             keys = [k.encode() if k is not None else b""
@@ -385,7 +391,7 @@ class VnodeStorage:
                 _vt, vals, valid = b.fields[name]
                 h.update(name.encode())
                 h.update(valid[order].astype(np.uint8).tobytes())
-                v_ord = vals[order]
+                v_ord = as_object_array(vals[order])
                 if v_ord.dtype == object:
                     masked = np.where(valid[order], v_ord, "")
                     h.update("\x00".join(str(x) for x in masked).encode())

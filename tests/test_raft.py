@@ -341,3 +341,22 @@ def test_stepdown_yields_leadership():
     finally:
         for n in nodes.values():
             n.stop()
+
+
+def test_leader_refuses_prevote():
+    """A live leader must refuse prevotes: a healed node that can reach
+    the leader must not assemble a prevote majority to depose it."""
+    tx, nodes, sms = make_cluster()
+    try:
+        leader = wait_leader(nodes)
+        last = leader.log.last_index()
+        reply = leader._on_request_prevote({
+            "term": leader.term + 1,
+            "candidate": 99,
+            "last_log_index": last,
+            "last_log_term": leader.log.term_at(last),
+        })
+        assert reply["granted"] is False
+    finally:
+        for n in nodes.values():
+            n.stop()
