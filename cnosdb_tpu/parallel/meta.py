@@ -830,8 +830,11 @@ class MetaStore:
     def create_external_table(self, tenant: str, db: str, name: str,
                               path: str, fmt: str = "csv",
                               header: bool = True,
-                              if_not_exists: bool = False):
-        """File-backed table (reference create_external_table.rs:189)."""
+                              if_not_exists: bool = False,
+                              options: dict | None = None):
+        """File- or object-store-backed table (reference
+        create_external_table.rs:189; s3/gcs/azblob connection options per
+        spi/src/query/datasource/)."""
         with self.lock:
             owner = f"{tenant}.{db}"
             if owner not in self.databases:
@@ -841,7 +844,8 @@ class MetaStore:
                 if if_not_exists:
                     return
                 raise TableAlreadyExists(name)
-            tbls[name] = {"path": path, "fmt": fmt, "header": header}
+            tbls[name] = {"path": path, "fmt": fmt, "header": header,
+                          "options": dict(options or {})}
             self._persist()
         self._notify("create_external", owner=owner, table=name)
 

@@ -612,10 +612,12 @@ class MetaClient:
                              db=db)
 
     def create_external_table(self, tenant, db, name, path, fmt="csv",
-                              header=True, if_not_exists=False):
+                              header=True, if_not_exists=False,
+                              options=None):
         return self._forward("create_external_table", tenant=tenant, db=db,
                              name=name, path=path, fmt=fmt, header=header,
-                             if_not_exists=if_not_exists)
+                             if_not_exists=if_not_exists,
+                             options=dict(options or {}))
 
     def drop_external_table(self, tenant, db, name):
         return self._forward("drop_external_table", tenant=tenant, db=db,

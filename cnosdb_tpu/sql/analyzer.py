@@ -1,0 +1,186 @@
+"""First-class analyzer rules: AST→AST rewrites applied before planning.
+
+Counterpart of the reference's AnalyzerRule pipeline
+(query_server/query/src/extension/analyse/: transform_topk_func_to_topk_node.rs,
+transform_bottom_func_to_topk_node.rs, transform_exact_count_to_count.rs).
+Each rule is a pure function SelectStmt → SelectStmt; `analyze()` runs them
+in order. The executor calls analyze() once per statement, so BOTH the
+scan-aggregate fast path and the relational fallback see the rewritten
+tree — same layering as the reference, where analysis precedes logical
+optimization.
+"""
+from __future__ import annotations
+
+from ..errors import PlanError
+from . import ast
+from .expr import Between, BinOp, Cast, Column, Expr, Func, InList, IsNull, \
+    Like, Literal, UnaryOp
+
+_SELECTOR_FUNCS = ("topk", "bottom")
+
+
+def analyze(stmt):
+    """Run every analyzer rule. Non-SELECT statements pass through."""
+    if not isinstance(stmt, ast.SelectStmt):
+        return stmt
+    stmt = rewrite_exact_count(stmt)
+    stmt = rewrite_selector_functions(stmt)
+    return stmt
+
+
+# ---------------------------------------------------------------------------
+# exact_count(<expr>) → count(<expr>)
+# ---------------------------------------------------------------------------
+def rewrite_exact_count(stmt):
+    """exact_count(x) → count(x) (reference
+    transform_exact_count_to_count.rs:41-53). The reference's pushed-down
+    count can serve from page statistics; exact_count forces a real count.
+    Here the scan kernels count actual surviving rows already, so the
+    rewrite is a pure rename with identical semantics."""
+    def rw(e):
+        if isinstance(e, Func) and e.name.lower() == "exact_count":
+            return Func("count", [rw(a) for a in e.args])
+        return _map_children(e, rw)
+
+    return _map_stmt_exprs(stmt, rw)
+
+
+# ---------------------------------------------------------------------------
+# topk/bottom(field, k) → ORDER BY field DESC/ASC LIMIT k
+# ---------------------------------------------------------------------------
+def rewrite_selector_functions(stmt):
+    """topk(field, k) / bottom(field, k) become a sort-with-fetch over the
+    input and the function expression is replaced by the bare field
+    (reference transform_topk_func_to_topk_node.rs:43-72 builds
+    Sort{fetch=k} + projection + Limit(k)). Validation mirrors
+    valid_exprs(): one selector function, not nested, k ∈ [1, 255]."""
+    found = []
+    for it in stmt.items:
+        if isinstance(it.expr, Expr):
+            _find_selectors(it.expr, found, nested=False)
+    if not found:
+        return stmt
+    tops = [f for f, nested in found if not nested]
+    if any(nested for _, nested in found) or len(found) > 1:
+        raise PlanError(
+            "invalid selector function use: no nested selection functions, "
+            "no multiple selection functions")
+    sel = tops[0]
+    field_expr, k = _selector_args(sel)
+    if stmt.group_by or stmt.having is not None:
+        raise PlanError(f"{sel.name} cannot be combined with GROUP BY/HAVING")
+    if stmt.order_by:
+        raise PlanError(f"{sel.name} cannot be combined with ORDER BY "
+                        "(it defines the ordering)")
+
+    def replace(e):
+        if e is sel:
+            return field_expr
+        return _map_children(e, replace)
+
+    items = [ast.SelectItem(replace(it.expr)
+                            if isinstance(it.expr, Expr) else it.expr,
+                            it.alias or (sel.name if it.expr is sel else None))
+             for it in stmt.items]
+    import dataclasses
+
+    # NULL field values never rank (reference sorts nulls_first=false with
+    # fetch=k; the engine's ORDER BY places NULLs first on DESC, so the
+    # rewrite filters them out instead — same selected rows whenever ≥k
+    # non-null values exist)
+    not_null = IsNull(field_expr, negated=True)
+    where = not_null if stmt.where is None \
+        else BinOp("and", stmt.where, not_null)
+    # LIMIT/OFFSET paginate WITHIN the k selected rows; the executor
+    # applies offset before limit, so the limit must shrink by the offset
+    # or rows outside the top-k leak through the window
+    avail = max(0, k - (stmt.offset or 0))
+    return dataclasses.replace(
+        stmt, items=items, where=where,
+        order_by=[(field_expr, sel.name.lower() == "bottom")],
+        limit=avail if stmt.limit is None else min(avail, stmt.limit))
+
+
+def _find_selectors(e, out, nested):
+    hit = isinstance(e, Func) and e.name.lower() in _SELECTOR_FUNCS
+    if hit:
+        out.append((e, nested))
+    for c in _children(e):
+        _find_selectors(c, out, nested or hit)
+
+
+def _selector_args(f: Func):
+    if len(f.args) != 2 or not isinstance(f.args[0], Column) \
+            or not isinstance(f.args[1], Literal) \
+            or not isinstance(f.args[1].value, int) \
+            or isinstance(f.args[1].value, bool):
+        raise PlanError(
+            f"routine not match: {f.name}(field_name, k) — k is an integer "
+            "literal in [1, 255]")
+    k = f.args[1].value
+    if not 1 <= k <= 255:
+        raise PlanError(f"{f.name} k must be in [1, 255], got {k}")
+    return f.args[0], k
+
+
+# ---------------------------------------------------------------------------
+# expression-tree plumbing
+# ---------------------------------------------------------------------------
+def _children(e) -> list:
+    out = []
+    for attr in ("left", "right", "operand", "expr", "low", "high"):
+        sub = getattr(e, attr, None)
+        if isinstance(sub, Expr):
+            out.append(sub)
+    args = getattr(e, "args", None)
+    if args:
+        out.extend(a for a in args if isinstance(a, Expr))
+    return out
+
+
+def _map_children(e, fn):
+    """Rebuild `e` with fn applied to each child expression (identity when
+    nothing changes, so untouched statements share structure)."""
+    if isinstance(e, BinOp):
+        l, r = fn(e.left), fn(e.right)
+        return e if l is e.left and r is e.right else BinOp(e.op, l, r)
+    if isinstance(e, UnaryOp):
+        o = fn(e.operand)
+        return e if o is e.operand else UnaryOp(e.op, o)
+    if isinstance(e, Func):
+        args = [fn(a) if isinstance(a, Expr) else a for a in e.args]
+        if all(a is b for a, b in zip(args, e.args)):
+            return e
+        return Func(e.name, args)
+    if isinstance(e, InList):
+        x = fn(e.expr)
+        return e if x is e.expr else InList(x, e.values, e.negated,
+                                            e.null_present)
+    if isinstance(e, Between):
+        x, lo, hi = fn(e.expr), fn(e.low), fn(e.high)
+        if x is e.expr and lo is e.low and hi is e.high:
+            return e
+        return Between(x, lo, hi, e.negated)
+    if isinstance(e, IsNull):
+        x = fn(e.expr)
+        return e if x is e.expr else IsNull(x, e.negated)
+    if isinstance(e, Like):
+        x = fn(e.expr)
+        return e if x is e.expr else Like(x, e.pattern, e.negated)
+    if isinstance(e, Cast):
+        x = fn(e.expr)
+        return e if x is e.expr else Cast(x, e.target, e.safe)
+    return e
+
+
+def _map_stmt_exprs(stmt, fn):
+    import dataclasses
+
+    items = [ast.SelectItem(fn(it.expr) if isinstance(it.expr, Expr)
+                            else it.expr, it.alias) for it in stmt.items]
+    having = fn(stmt.having) if isinstance(stmt.having, Expr) else stmt.having
+    order_by = [(fn(oe) if isinstance(oe, Expr) else oe, asc)
+                for oe, asc in stmt.order_by]
+    group_by = [fn(g) if isinstance(g, Expr) else g for g in stmt.group_by]
+    return dataclasses.replace(stmt, items=items, having=having,
+                               order_by=order_by, group_by=group_by)

@@ -243,6 +243,9 @@ class CopyStmt:
     source: str
     target_is_path: bool
     fmt: str = "csv"            # csv|parquet
+    # CONNECTION = (...) credentials/endpoint for s3://, gcs://, azblob://
+    # paths (reference parser.rs:1716, logical_planner.rs:835)
+    options: dict = field(default_factory=dict)
 
 
 @dataclass
@@ -255,6 +258,7 @@ class CreateExternalTable:
     fmt: str = "csv"
     header: bool = True
     if_not_exists: bool = False
+    options: dict = field(default_factory=dict)   # object-store connection
 
 
 @dataclass

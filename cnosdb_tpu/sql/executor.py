@@ -255,7 +255,7 @@ class QueryExecutor:
         if isinstance(stmt, ast.CreateExternalTable):
             self.meta.create_external_table(
                 session.tenant, session.database, stmt.name, stmt.path,
-                stmt.fmt, stmt.header, stmt.if_not_exists)
+                stmt.fmt, stmt.header, stmt.if_not_exists, stmt.options)
             return ResultSet.message("ok")
         if isinstance(stmt, ast.CopyStmt):
             return self._copy(stmt, session)
@@ -708,7 +708,9 @@ class QueryExecutor:
         return ResultSet(["plan"], [np.array(lines, dtype=object)])
 
     def _select(self, stmt: ast.SelectStmt, session: Session):
-        stmt = self._resolve_subqueries(stmt, session)
+        from .analyzer import analyze
+
+        stmt = analyze(self._resolve_subqueries(stmt, session))
         if stmt.from_item is not None or self._needs_relational(stmt):
             return self._select_relational(stmt, session)
         if stmt.table is None:
@@ -839,7 +841,11 @@ class QueryExecutor:
 
     def _copy(self, stmt: ast.CopyStmt, session: Session):
         """COPY INTO (reference execution/ddl/copy + object-store sinks):
-        export a table to CSV/parquet, or import a file into a table."""
+        export a table to CSV/parquet, or import a file into a table.
+        s3:// gcs:// azblob:// paths ride utils.objstore with the
+        statement's CONNECTION options."""
+        import io
+
         import pyarrow as pa
 
         if stmt.target_is_path:
@@ -854,25 +860,35 @@ class QueryExecutor:
                     arrays.append(pa.array(c))
                 fields.append(n)
             table = pa.table(dict(zip(fields, arrays)))
+            from ..utils import objstore
+
+            remote = objstore.is_remote(stmt.target)
+            sink = io.BytesIO() if remote else stmt.target
             if stmt.fmt == "parquet":
                 import pyarrow.parquet as pq
 
-                pq.write_table(table, stmt.target)
+                pq.write_table(table, sink)
             else:
                 import pyarrow.csv as pc
 
-                pc.write_csv(table, stmt.target)
+                pc.write_csv(table, sink)
+            if remote:
+                objstore.write_uri(stmt.target, sink.getvalue(),
+                                   stmt.options)
             return ResultSet(["rows_exported"],
                              [np.array([rs.n_rows], dtype=np.int64)])
-        # import: file → table (schema must exist; columns map by name)
+        # import: file/object → table (schema must exist; map by name)
+        from ..utils import objstore
+
+        src = objstore.open_source(stmt.source, stmt.options)
         if stmt.fmt == "parquet":
             import pyarrow.parquet as pq
 
-            table = pq.read_table(stmt.source)
+            table = pq.read_table(src)
         else:
             import pyarrow.csv as pc
 
-            table = pc.read_csv(stmt.source)
+            table = pc.read_csv(src)
         schema = self.meta.table(session.tenant, session.database,
                                  stmt.target)
         cols = {n: table.column(n).to_pylist() for n in table.column_names}
@@ -1580,19 +1596,23 @@ _REPAIR_FUNCS = {"timestamp_repair", "value_fill", "value_repair"}
 
 
 def _load_external(ext: dict) -> tuple[list[str], list[np.ndarray]]:
-    """Materialize a file-backed external table (reference
-    create_external_table.rs reads through object_store + DataFusion
-    listing providers; local files only here)."""
+    """Materialize an external table (reference create_external_table.rs
+    reads through object_store + DataFusion listing providers; here a
+    local path reads directly and s3://, gcs://, azblob:// locations go
+    through utils.objstore with the table's stored connection options)."""
+    from ..utils import objstore
+
+    src = objstore.open_source(ext["path"], ext.get("options"))
     if ext["fmt"] == "parquet":
         import pyarrow.parquet as pq
 
-        table = pq.read_table(ext["path"])
+        table = pq.read_table(src)
     else:
         import pyarrow.csv as pc
 
         ropts = pc.ReadOptions(autogenerate_column_names=not ext.get(
             "header", True))
-        table = pc.read_csv(ext["path"], read_options=ropts)
+        table = pc.read_csv(src, read_options=ropts)
     names, cols = [], []
     for name in table.column_names:
         col = table.column(name)

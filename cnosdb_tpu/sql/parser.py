@@ -188,6 +188,32 @@ class Parser:
             raise ParserError(f"expected identifier, got {t.value!r}")
         return self.next().value
 
+    def _parse_kv_parens(self) -> dict:
+        """(key = 'value', flag = true, n = 3) → dict — the option-list
+        form of CONNECTION/OPTIONS clauses (reference parser.rs:1716-1790
+        parse_connection_options / sql option lists)."""
+        out: dict = {}
+        self.expect_op("(")
+        if not self.accept_op(")"):
+            while True:
+                key = self.expect_ident().lower()
+                self.expect_op("=")
+                t = self.peek()
+                if t.kind == "string":
+                    out[key] = self.expect_string()
+                elif t.kind == "number":
+                    out[key] = self.expect_number()
+                elif self.accept_kw("TRUE"):
+                    out[key] = True
+                elif self.accept_kw("FALSE"):
+                    out[key] = False
+                else:
+                    out[key] = self.expect_ident()
+                if not self.accept_op(","):
+                    break
+            self.expect_op(")")
+        return out
+
     def expect_string(self) -> str:
         t = self.peek()
         if t.kind != "string":
@@ -314,14 +340,25 @@ class Parser:
                 else self.expect_ident()
             path = target if target_is_path else source
             fmt = "parquet" if path.endswith(".parquet") else "csv"
-            if self.accept_kw("FILE_FORMAT"):
-                self.expect_op("=")
-                self.expect_op("(")
-                self.expect_kw("TYPE")
-                self.expect_op("=")
-                fmt = self.expect_string().lower()
-                self.expect_op(")")
-            return ast.CopyStmt(target, source, target_is_path, fmt)
+            options: dict = {}
+            while True:
+                if self.accept_kw("CONNECTION"):
+                    self.expect_op("=")
+                    options.update(self._parse_kv_parens())
+                elif self.accept_kw("FILE_FORMAT"):
+                    self.expect_op("=")
+                    self.expect_op("(")
+                    self.expect_kw("TYPE")
+                    self.expect_op("=")
+                    fmt = self.expect_string().lower()
+                    self.expect_op(")")
+                elif self.accept_kw("COPY_OPTIONS"):
+                    self.expect_op("=")
+                    self._parse_kv_parens()   # accepted for compatibility
+                else:
+                    break
+            return ast.CopyStmt(target, source, target_is_path, fmt,
+                                options)
         if k in ("GRANT", "REVOKE"):
             grant = k == "GRANT"
             self.next()
@@ -480,6 +517,7 @@ class Parser:
             name = self.expect_ident()
             fmt, header = "csv", False
             path = None
+            options: dict = {}
             while True:
                 if self.accept_kw("STORED"):
                     self.expect_kw("AS")
@@ -490,11 +528,15 @@ class Parser:
                     header = True
                 elif self.accept_kw("LOCATION"):
                     path = self.expect_string()
+                elif self.accept_kw("OPTIONS"):
+                    self.accept_op("=")
+                    options.update(self._parse_kv_parens())
                 else:
                     break
             if path is None:
                 raise ParserError("CREATE EXTERNAL TABLE needs LOCATION")
-            return ast.CreateExternalTable(name, path, fmt, header, ine)
+            return ast.CreateExternalTable(name, path, fmt, header, ine,
+                                           options)
         if k == "DATABASE":
             self.next()
             ine = self._if_not_exists()

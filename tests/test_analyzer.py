@@ -1,0 +1,82 @@
+"""Analyzer rules: topk/bottom selector rewrite + exact_count→count
+(reference extension/analyse/transform_{topk,bottom}_func_to_topk_node.rs,
+transform_exact_count_to_count.rs)."""
+import numpy as np
+import pytest
+
+from cnosdb_tpu.errors import PlanError, QueryError
+from cnosdb_tpu.parallel.coordinator import Coordinator
+from cnosdb_tpu.parallel.meta import MetaStore
+from cnosdb_tpu.sql.executor import QueryExecutor
+from cnosdb_tpu.storage.engine import TsKv
+
+
+@pytest.fixture
+def db(tmp_path):
+    meta = MetaStore(str(tmp_path / "meta.json"))
+    engine = TsKv(str(tmp_path / "data"))
+    ex = QueryExecutor(meta, Coordinator(meta, engine))
+    ex.execute_one("CREATE TABLE m (v DOUBLE, w DOUBLE, TAGS(h))")
+    rows = []
+    vals = [5.0, 1.0, 9.0, 3.0, 7.0, 2.0, 8.0, 4.0, 6.0, None]
+    for i, v in enumerate(vals):
+        rows.append(f"({i + 1}, 'h{i % 2}', "
+                    + ("NULL" if v is None else str(v)) + f", {i * 1.0})")
+    ex.execute_one("INSERT INTO m (time, h, v, w) VALUES " + ", ".join(rows))
+    yield ex
+    engine.close()
+
+
+def test_topk_rewrites_to_sort_limit(db):
+    rs = db.execute_one("SELECT topk(v, 3) FROM m")
+    assert rs.n_rows == 3
+    assert [float(x) for x in rs.columns[0]] == [9.0, 8.0, 7.0]
+
+
+def test_bottom_rewrites_ascending(db):
+    rs = db.execute_one("SELECT bottom(v, 2) AS b FROM m")
+    assert rs.names == ["b"]
+    assert [float(x) for x in rs.columns[0]] == [1.0, 2.0]
+
+
+def test_topk_k_bounds_and_shape(db):
+    for bad in ("topk(v, 0)", "topk(v, 256)", "topk(v)", "topk(v, 1.5)"):
+        with pytest.raises((PlanError, QueryError)):
+            db.execute_one(f"SELECT {bad} FROM m")
+
+
+def test_topk_rejects_multiple_and_nested(db):
+    with pytest.raises((PlanError, QueryError)):
+        db.execute_one("SELECT topk(v, 3), bottom(w, 2) FROM m")
+    with pytest.raises((PlanError, QueryError)):
+        db.execute_one("SELECT topk(v, 3) FROM m ORDER BY w")
+
+
+def test_topk_with_companion_columns(db):
+    # other projected columns ride along with the selected rows
+    rs = db.execute_one("SELECT time, topk(v, 2) AS t FROM m")
+    cols = dict(zip(rs.names, rs.columns))
+    assert [float(x) for x in cols["t"]] == [9.0, 8.0]
+    assert [int(x) for x in cols["time"]] == [3, 7]
+
+
+def test_topk_limit_caps_k(db):
+    rs = db.execute_one("SELECT topk(v, 5) FROM m LIMIT 2")
+    assert rs.n_rows == 2
+
+
+def test_topk_offset_stays_within_k(db):
+    # pagination happens WITHIN the top-k set: top-3 of v is {9,8,7},
+    # so OFFSET 2 leaves exactly [7] — never rows outside the top-3
+    rs = db.execute_one("SELECT topk(v, 3) AS t FROM m OFFSET 2")
+    assert [float(x) for x in rs.columns[0]] == [7.0]
+    rs = db.execute_one("SELECT topk(v, 3) AS t FROM m LIMIT 5 OFFSET 1")
+    assert [float(x) for x in rs.columns[0]] == [8.0, 7.0]
+
+
+def test_exact_count_rewrites_to_count(db):
+    rs = db.execute_one("SELECT exact_count(v) AS c FROM m")
+    assert int(rs.columns[0][0]) == 9   # NULL row excluded
+    rs = db.execute_one(
+        "SELECT h, exact_count(v) AS c FROM m GROUP BY h ORDER BY h")
+    assert [int(x) for x in rs.columns[1]] == [5, 4]

@@ -1,0 +1,230 @@
+"""Object-store external tables + COPY (reference
+spi/src/query/datasource/{s3,gcs,azure}.rs, logical_planner.rs:835-980):
+the stores are driven against an in-process fake server — the same
+endpoint-override path a minio/fake-gcs/azurite deployment uses."""
+import base64
+import datetime
+import hashlib
+import hmac
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+import pytest
+
+from cnosdb_tpu.parallel.coordinator import Coordinator
+from cnosdb_tpu.parallel.meta import MetaStore
+from cnosdb_tpu.sql.executor import QueryExecutor
+from cnosdb_tpu.storage.engine import TsKv
+from cnosdb_tpu.utils import objstore
+
+
+class _FakeStore(BaseHTTPRequestHandler):
+    """One handler serving all three dialects: objects live in
+    server.blobs; every request's auth material is recorded for
+    assertions."""
+
+    def log_message(self, *a):
+        pass
+
+    def _key(self):
+        import urllib.parse
+
+        return urllib.parse.unquote(self.path.split("?")[0])
+
+    def do_GET(self):
+        self.server.requests.append(
+            ("GET", self.path, {k.lower(): v for k, v in self.headers.items()}))
+        blob = self.server.blobs.get(self._key())
+        if blob is None:
+            self.send_response(404)
+            self.end_headers()
+            return
+        self.send_response(200)
+        self.send_header("Content-Length", str(len(blob)))
+        self.end_headers()
+        self.wfile.write(blob)
+
+    def do_PUT(self):
+        self.server.requests.append(
+            ("PUT", self.path, {k.lower(): v for k, v in self.headers.items()}))
+        n = int(self.headers.get("Content-Length", 0))
+        self.server.blobs[self._key()] = self.rfile.read(n)
+        self.send_response(200)
+        self.end_headers()
+
+    def do_POST(self):  # GCS media upload
+        self.server.requests.append(("POST", self.path, dict(self.headers)))
+        n = int(self.headers.get("Content-Length", 0))
+        body = self.rfile.read(n)
+        if self.path.startswith("/upload/storage/v1/b/"):
+            import urllib.parse
+
+            qs = urllib.parse.parse_qs(self.path.split("?", 1)[1])
+            name = qs["name"][0]
+            bucket = self.path.split("/b/")[1].split("/o")[0]
+            self.server.blobs[f"/storage/v1/b/{bucket}/o/{name}"] = body
+        self.send_response(200)
+        self.send_header("Content-Length", "2")
+        self.end_headers()
+        self.wfile.write(b"{}")
+
+
+@pytest.fixture
+def fake(request):
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), _FakeStore)
+    srv.blobs = {}
+    srv.requests = []
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    yield srv
+    srv.shutdown()
+
+
+def _endpoint(srv):
+    return f"http://127.0.0.1:{srv.server_address[1]}"
+
+
+# ---------------------------------------------------------------------------
+# store primitives
+# ---------------------------------------------------------------------------
+def test_s3_roundtrip_and_sigv4_shape(fake):
+    st = objstore.S3Store("bkt", region="eu-west-1",
+                          endpoint_url=_endpoint(fake),
+                          access_key_id="AKID", secret_key="SECRET")
+    st.put("dir/a.txt", b"hello")
+    assert st.get("dir/a.txt") == b"hello"
+    method, path, hdrs = fake.requests[0]
+    assert method == "PUT" and path == "/bkt/dir/a.txt"
+    auth = hdrs["authorization"]
+    assert auth.startswith("AWS4-HMAC-SHA256 Credential=AKID/")
+    assert "/eu-west-1/s3/aws4_request" in auth
+    assert "SignedHeaders=host;x-amz-content-sha256;x-amz-date" in auth
+    assert hdrs["x-amz-content-sha256"] == hashlib.sha256(b"hello").hexdigest()
+
+
+def test_s3_signature_is_deterministic():
+    st = objstore.S3Store("b", region="us-east-1",
+                          endpoint_url="http://x", access_key_id="AK",
+                          secret_key="SK")
+    now = datetime.datetime(2026, 1, 2, 3, 4, 5,
+                            tzinfo=datetime.timezone.utc)
+    h1 = st._signed_headers("GET", "/b/k", b"", now=now)
+    h2 = st._signed_headers("GET", "/b/k", b"", now=now)
+    assert h1 == h2
+    assert h1["Authorization"] != \
+        st._signed_headers("GET", "/b/other", b"", now=now)["Authorization"]
+
+
+def test_s3_anonymous_when_no_credentials(fake):
+    st = objstore.S3Store("bkt", endpoint_url=_endpoint(fake))
+    st.put("k", b"v")
+    _, _, hdrs = fake.requests[0]
+    assert "authorization" not in hdrs
+
+
+def test_gcs_roundtrip_emulator_mode(fake):
+    st = objstore.GcsStore("bkt", gcs_base_url=_endpoint(fake),
+                           disable_oauth=True)
+    st.put("data/x.bin", b"\x00\x01")
+    assert st.get("data/x.bin") == b"\x00\x01"
+
+
+def test_azblob_sharedkey_roundtrip(fake):
+    key = base64.b64encode(b"storage-account-key").decode()
+    st = objstore.AzblobStore("ctr", account="acct", access_key=key,
+                              endpoint_url=_endpoint(fake))
+    st.put("b.txt", b"azure!")
+    assert st.get("b.txt") == b"azure!"
+    _, path, hdrs = fake.requests[0]
+    assert path == "/acct/ctr/b.txt"
+    auth = hdrs["authorization"]
+    assert auth.startswith("SharedKey acct:")
+    # recompute the expected MAC with the documented canonical form, from
+    # the headers as RECEIVED on the wire (catches signed-vs-sent drift,
+    # e.g. urllib injecting its own Content-Type)
+    to_sign = ("PUT\n\n\n6\n\n"
+               + hdrs["content-type"] + "\n\n\n\n\n\n\n"
+               + f"x-ms-blob-type:{hdrs['x-ms-blob-type']}\n"
+               + f"x-ms-date:{hdrs['x-ms-date']}\n"
+               + f"x-ms-version:{hdrs['x-ms-version']}\n"
+               + "/acct/acct/ctr/b.txt")
+    want = base64.b64encode(hmac.new(
+        b"storage-account-key", to_sign.encode(),
+        hashlib.sha256).digest()).decode()
+    assert auth == f"SharedKey acct:{want}"
+
+
+def test_uri_parsing_errors():
+    assert objstore.parse_uri("s3://b/k/x.csv") == ("s3", "b", "k/x.csv")
+    assert objstore.parse_uri("/tmp/x.csv")[0] == "local"
+    with pytest.raises(objstore.ObjectStoreError):
+        objstore.parse_uri("ftp://b/k")
+    with pytest.raises(objstore.ObjectStoreError):
+        objstore.parse_uri("s3:///nobucket")
+
+
+# ---------------------------------------------------------------------------
+# SQL surface: external tables + COPY against the fake s3
+# ---------------------------------------------------------------------------
+@pytest.fixture
+def db(tmp_path):
+    meta = MetaStore(str(tmp_path / "meta.json"))
+    engine = TsKv(str(tmp_path / "data"))
+    ex = QueryExecutor(meta, Coordinator(meta, engine))
+    yield ex
+    engine.close()
+
+
+def test_external_table_over_s3(db, fake):
+    fake.blobs["/bkt/t.csv"] = b"a,b\n1,x\n2,y\n"
+    db.execute_one(
+        "CREATE EXTERNAL TABLE ext STORED AS csv WITH HEADER ROW "
+        "LOCATION 's3://bkt/t.csv' "
+        f"OPTIONS (endpoint_url = '{_endpoint(fake)}', "
+        "access_key_id = 'AK', secret_key = 'SK')")
+    rs = db.execute_one("SELECT a, b FROM ext ORDER BY a")
+    assert [int(x) for x in rs.columns[0]] == [1, 2]
+    assert list(rs.columns[1]) == ["x", "y"]
+    # the request was signed with the stored credentials
+    get = [r for r in fake.requests if r[0] == "GET"][0]
+    assert get[2]["authorization"].startswith(
+        "AWS4-HMAC-SHA256 Credential=AK/")
+
+
+def test_copy_export_import_s3(db, fake):
+    db.execute_one("CREATE TABLE m (v DOUBLE, TAGS(h))")
+    db.execute_one("INSERT INTO m (time, h, v) VALUES (1,'a',1.5),(2,'b',2.5)")
+    db.execute_one(
+        "COPY INTO 's3://bkt/out.csv' FROM m "
+        f"CONNECTION = (endpoint_url = '{_endpoint(fake)}') "
+        "FILE_FORMAT = (TYPE = 'csv')")
+    assert b"1.5" in fake.blobs["/bkt/out.csv"]
+    db.execute_one("CREATE TABLE m2 (v DOUBLE, TAGS(h))")
+    rs = db.execute_one(
+        "COPY INTO m2 FROM 's3://bkt/out.csv' "
+        f"CONNECTION = (endpoint_url = '{_endpoint(fake)}') "
+        "FILE_FORMAT = (TYPE = 'csv')")
+    assert int(rs.columns[0][0]) == 2
+    rs = db.execute_one("SELECT v FROM m2 ORDER BY time")
+    assert [float(x) for x in rs.columns[0]] == [1.5, 2.5]
+
+
+def test_external_table_via_meta_client(tmp_path):
+    """Cluster mode: CREATE EXTERNAL TABLE forwards options through the
+    MetaClient RPC plane (was dropped before — regression pin)."""
+    from cnosdb_tpu.parallel.meta import MetaStore
+    from cnosdb_tpu.parallel.meta_service import MetaClient, MetaService
+
+    store = MetaStore(str(tmp_path / "m.json"), register_self=False)
+    svc = MetaService(store, port=0).start()
+    try:
+        c = MetaClient(svc.addr, node_id=7, watch=False)
+        c.create_external_table(
+            "cnosdb", "public", "ext", "s3://bkt/t.csv", "csv", True,
+            False, {"endpoint_url": "http://e", "access_key_id": "AK"})
+        ext = c.external_opt("cnosdb", "public", "ext")
+        assert ext["path"] == "s3://bkt/t.csv"
+        assert ext["options"]["endpoint_url"] == "http://e"
+    finally:
+        svc.stop()
