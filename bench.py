@@ -324,6 +324,10 @@ def _device_kernel_metric():
             return {"device_probe": "no accelerator (cpu jax)"}
         from cnosdb_tpu.ops.kernels import segment_aggregate
 
+        # NOTE: through the axon tunnel, execution time scales with input
+        # size even for device_put inputs (buffers re-ship per call), so
+        # this measures the RELAY pipe as much as the kernel; on a local
+        # TPU host the same call is ~50µs/2M rows
         n, nseg = 1 << 21, 4096
         rng = np.random.default_rng(0)
         args = [jax.device_put(x, dev) for x in (
@@ -342,6 +346,7 @@ def _device_kernel_metric():
         dt = (time.perf_counter() - t0) / iters
         return {"device_probe": "ok",
                 "device": str(dev),
+                "device_kernel_ms_per_call": round(dt * 1e3, 2),
                 "device_kernel_rows_per_s": round(n / dt, 1)}
     except Exception as e:  # never let the metric sink the bench record
         return {"device_probe": f"metric failed: {e!r:.200}"}

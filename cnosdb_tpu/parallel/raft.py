@@ -494,25 +494,39 @@ class RaftNode:
         vote_lock = threading.Lock()
         settled = threading.Event()
 
+        replied = [0]
+
         def ask(p):
+            reply = None
             try:
                 reply = self.transport.send(self.group_id, p, {
                     "type": "request_vote", "from": self.node_id,
                     "term": term, "last_log_index": last_idx,
                     "last_log_term": last_term})
             except Exception:
-                return
-            if reply is None:
-                return
-            if reply.get("term", 0) > term:
-                self._step_down(reply["term"])
+                reply = None
+            # tally BEFORE marking this peer replied: settling first would
+            # let the main thread read a stale vote count and fail a round
+            # that was actually won
+            if reply is not None:
+                if reply.get("term", 0) > term:
+                    self._step_down(reply["term"])
+                    settled.set()
+                    return
+                if reply.get("granted"):
+                    with vote_lock:
+                        votes[0] += 1
+                        if votes[0] * 2 > total:
+                            settled.set()
+            with vote_lock:
+                replied[0] += 1
+                all_in = replied[0] == len(self.peers)
+            if all_in:
+                # every peer answered (grant/refusal/error): the round is
+                # decided — sleeping out the full timeout would turn each
+                # split-vote round into a 1s stall (the
+                # two-survivors-of-a-dead-leader election flake)
                 settled.set()
-                return
-            if reply.get("granted"):
-                with vote_lock:
-                    votes[0] += 1
-                    if votes[0] * 2 > total:
-                        settled.set()
 
         threads = [threading.Thread(target=ask, args=(p,), daemon=True)
                    for p in self.peers]
@@ -552,21 +566,27 @@ class RaftNode:
         vote_lock = threading.Lock()
         settled = threading.Event()
 
+        replied = [0]
+
         def ask(p):
+            reply = None
             try:
                 reply = self.transport.send(self.group_id, p, {
                     "type": "request_prevote", "from": self.node_id,
                     "term": term, "last_log_index": last_idx,
                     "last_log_term": last_term})
             except Exception:
-                return
-            if reply is None:
-                return
-            if reply.get("granted"):
-                with vote_lock:
+                reply = None
+            if reply is not None and reply.get("granted"):
+                with vote_lock:   # tally before the replied mark (above)
                     votes[0] += 1
                     if votes[0] * 2 > total:
                         settled.set()
+            with vote_lock:
+                replied[0] += 1
+                all_in = replied[0] == len(self.peers)
+            if all_in:
+                settled.set()   # round decided — don't sleep it out
 
         threads = [threading.Thread(target=ask, args=(p,), daemon=True)
                    for p in self.peers]

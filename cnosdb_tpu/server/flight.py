@@ -142,6 +142,27 @@ def command_get_db_schemas() -> bytes:
     return _any_pack("CommandGetDbSchemas", b"")
 
 
+def action_create_prepared_statement(sql: str) -> bytes:
+    """Client-side body for the CreatePreparedStatement action."""
+    return _any_pack("ActionCreatePreparedStatementRequest",
+                     _pb_bytes_field(1, sql.encode()))
+
+
+def action_close_prepared_statement(handle: bytes) -> bytes:
+    return _any_pack("ActionClosePreparedStatementRequest",
+                     _pb_bytes_field(1, handle))
+
+
+def command_prepared_statement_query(handle: bytes) -> bytes:
+    return _any_pack("CommandPreparedStatementQuery",
+                     _pb_bytes_field(1, handle))
+
+
+def command_statement_update(sql: str) -> bytes:
+    return _any_pack("CommandStatementUpdate",
+                     _pb_bytes_field(1, sql.encode()))
+
+
 # ---------------------------------------------------------------- arrow
 def result_to_arrow(rs: ResultSet) -> "pa.Table":
     arrays, names = [], []
@@ -279,6 +300,16 @@ if FLIGHT_AVAILABLE:
                         + b"\x00" + secrets.token_hex(8).encode()
                     return self._info_for(
                         descriptor, self._execute(db, sql), handle)
+                if kind == "CommandPreparedStatementQuery":
+                    handle = _pb_parse(val).get(1, [b""])[0]
+                    db, _, rest = handle.partition(b"\x00")
+                    sql = rest.rsplit(b"\x00", 1)[0]
+                    if not sql:
+                        raise fl.FlightServerError(
+                            "unknown prepared statement handle")
+                    return self._info_for(
+                        descriptor, self._execute(db.decode(), sql.decode()),
+                        handle)
                 if kind in ("CommandGetCatalogs", "CommandGetDbSchemas",
                             "CommandGetTables"):
                     include_schema = False
@@ -305,6 +336,106 @@ if FLIGHT_AVAILABLE:
                 + secrets.token_hex(8).encode()
             return self._info_for(
                 descriptor, self._execute(db.decode(), sql.decode()), handle)
+
+        def do_action(self, context, action):
+            """FlightSQL actions (reference flight_sql_server.rs:933
+            do_action_create_prepared_statement /
+            do_action_close_prepared_statement). As in the reference,
+            parameter binding is not supported — the prepared handle is a
+            replayable (db, sql) recipe; preparing a READ statement runs
+            it once to advertise the TRUE dataset schema (JDBC drivers
+            prepare even ad-hoc statements); preparing DML/DDL is
+            side-effect free."""
+            body = action.body.to_pybytes() if action.body else b""
+            parsed = _any_unpack(body)
+            val = parsed[1] if parsed else body
+            if action.type == "CreatePreparedStatement":
+                sql = _pb_parse(val).get(1, [b""])[0].decode()
+                db = "public"
+                try:
+                    db = context.get_middleware("db").db
+                except Exception:
+                    pass
+                handle = db.encode() + b"\x00" + sql.encode() + b"\x00" \
+                    + secrets.token_hex(8).encode()
+                # only READ statements run at prepare, and only for their
+                # SCHEMA: a LIMIT-0 wrapper avoids paying the full query
+                # twice (get_flight_info re-executes); preparing DML/DDL
+                # must not apply side effects — JDBC prepares an INSERT
+                # before running it
+                first_kw = (sql.lstrip().split(None, 1) or [""])[0].lower()
+                if first_kw in ("select", "show", "describe", "explain",
+                                "union"):
+                    try:
+                        probe = (f"SELECT * FROM ({sql}) __prep LIMIT 0"
+                                 if first_kw in ("select", "union") else sql)
+                        table = self._execute(db, probe)
+                    except Exception:
+                        table = self._execute(db, sql)   # unwrappable form
+                    schema_ipc = table.schema.serialize().to_pybytes()
+                else:
+                    schema_ipc = pa.schema([]).serialize().to_pybytes()
+                result = (_pb_bytes_field(1, handle)
+                          + _pb_bytes_field(2, schema_ipc)
+                          + _pb_bytes_field(3, b""))
+                yield fl.Result(_any_pack(
+                    "ActionCreatePreparedStatementResult", result))
+                return
+            if action.type == "ClosePreparedStatement":
+                handle = _pb_parse(val).get(1, [b""])[0]
+                with self._results_lock:
+                    self._results.pop(handle, None)
+                return
+            raise fl.FlightServerError(
+                f"unsupported action {action.type!r}")
+
+        def list_actions(self, context):
+            return [("CreatePreparedStatement",
+                     "plan a SQL statement, return handle + schema"),
+                    ("ClosePreparedStatement",
+                     "release a prepared statement handle")]
+
+        def do_put(self, context, descriptor, reader, writer):
+            """CommandStatementUpdate / CommandPreparedStatementUpdate →
+            execute, reply DoPutUpdateResult{record_count} in the metadata
+            stream (reference do_put_prepared_statement_update — how JDBC
+            runs DDL/DML)."""
+            parsed = _any_unpack(descriptor.command or b"")
+            if parsed is None:
+                raise fl.FlightServerError("unsupported DoPut descriptor")
+            kind, val = parsed
+            fields = _pb_parse(val)
+            if kind == "CommandStatementUpdate":
+                sql = fields.get(1, [b""])[0].decode()
+                db = "public"
+                try:
+                    db = context.get_middleware("db").db
+                except Exception:
+                    pass
+            elif kind == "CommandPreparedStatementUpdate":
+                handle = fields.get(1, [b""])[0]
+                dbb, _, rest = handle.partition(b"\x00")
+                db, sql = dbb.decode(), rest.rsplit(b"\x00", 1)[0].decode()
+            else:
+                raise fl.FlightServerError(
+                    f"unsupported DoPut command {kind}")
+            try:
+                while True:
+                    reader.read_chunk()   # drain bound-parameter stream
+            except StopIteration:
+                pass
+            rs = self.executor.execute_one(sql, Session(database=db))
+            # DML returns a 1-row count cell (the real affected count);
+            # DDL returns a message row → 0 affected
+            affected = 0
+            if rs.names and rs.n_rows == 1:
+                v = rs.columns[0][0]
+                if isinstance(v, (int, np.integer)):
+                    affected = int(v)
+            elif rs.names:
+                affected = rs.n_rows
+            update_result = _pb_varint((1 << 3) | 0) + _pb_varint(affected)
+            writer.write(pa.py_buffer(update_result))
 
         def do_get(self, context, ticket):
             raw = ticket.ticket

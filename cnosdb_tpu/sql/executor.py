@@ -991,13 +991,17 @@ class QueryExecutor:
         tables); joins compose host-side (reference: TskvExec leaves under
         DataFusion join operators)."""
         if isinstance(item, ast.TableRef):
+            # an unaliased table is addressable by its own name
+            # (`FROM o JOIN c ON o.cust = c.cust` — standard SQL); an
+            # explicit alias REPLACES the table name as the qualifier
+            qual = item.alias or item.name
             ext = self.meta.external_opt(
                 session.tenant, item.database or session.database, item.name)
             if ext is not None:
                 names, cols = _load_external(ext)
-                scope = rel.Scope.from_relation(names, cols, item.alias)
+                scope = rel.Scope.from_relation(names, cols, qual)
                 if pushed_where is not None:
-                    w = self._strip_alias(pushed_where, item.alias)
+                    w = self._strip_alias(pushed_where, qual)
                     m = np.asarray(w.eval(scope.env, np))
                     if not m.shape:
                         m = np.full(scope.n, bool(m))
@@ -1005,10 +1009,10 @@ class QueryExecutor:
                 return scope
             sub = ast.SelectStmt(
                 items=[ast.SelectItem("*")], table=item.name,
-                where=self._strip_alias(pushed_where, item.alias),
+                where=self._strip_alias(pushed_where, qual),
                 database=item.database)
             rs = self._select(sub, session)
-            return rel.Scope.from_relation(rs.names, rs.columns, item.alias)
+            return rel.Scope.from_relation(rs.names, rs.columns, qual)
         if isinstance(item, ast.SubqueryRef):
             q = item.select
             rs = self._union(q, session) if isinstance(q, ast.UnionStmt) \

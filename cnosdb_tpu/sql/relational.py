@@ -20,6 +20,7 @@ import copy
 import numpy as np
 
 from ..errors import PlanError
+from ..models.strcol import DictArray
 from .expr import BinOp, Column, Expr, Func, WindowFunc
 
 
@@ -151,6 +152,81 @@ def _key_tuple(arrays: list, i: int) -> tuple | None:
     return tuple(out)
 
 
+def _factorize_key_pair(lk: np.ndarray, rk: np.ndarray):
+    """→ (lcodes, rcodes, lvalid, rvalid) with equal values sharing a code
+    across both sides, or None when the dtypes defeat vectorization.
+    NULL (None) and NaN keys never match — they get valid=False."""
+    def prep(a):
+        if a.dtype == object:
+            valid = np.array([x is not None for x in a], dtype=bool)
+            if not all(isinstance(x, str) for x, v in zip(a, valid) if v):
+                return None   # mixed object types: python-equality fallback
+            filled = a.copy()
+            filled[~valid] = ""
+            return filled.astype("U"), valid, "str"
+        if np.issubdtype(a.dtype, np.floating):
+            valid = ~np.isnan(a)
+            return a.astype(np.float64), valid, "float"
+        if np.issubdtype(a.dtype, np.integer) or a.dtype == bool:
+            # keep ints exact: float64 would alias keys above 2^53
+            return a.astype(np.int64), np.ones(len(a), dtype=bool), "int"
+        if a.dtype.kind in ("U", "S"):
+            return a.astype("U"), np.ones(len(a), dtype=bool), "str"
+        return None
+
+    pl, pr = prep(lk), prep(rk)
+    if pl is None or pr is None:
+        return None
+    (lv, lvalid, lkind), (rv, rvalid, rkind) = pl, pr
+    if {lkind, rkind} == {"int", "float"}:
+        # mixed int/float equality (5 == 5.0): widen the int side only here
+        lv, rv = lv.astype(np.float64), rv.astype(np.float64)
+    elif lkind != rkind:
+        return None   # string-vs-number keys: fallback decides equality
+    both = np.concatenate([lv, rv])
+    _, inv = np.unique(both, return_inverse=True)
+    return (inv[:len(lv)].astype(np.int64), inv[len(lv):].astype(np.int64),
+            lvalid, rvalid)
+
+
+def _vector_join_indices(lkeys, rkeys, ln: int, rn: int):
+    """Vectorized equi-join matching: factorize each key pair, combine to
+    one id per row, sort the right side once, then searchsorted expansion
+    builds (li, ri) without a per-row python probe loop (the HashJoinExec
+    role, done the columnar way)."""
+    lid = np.zeros(ln, dtype=np.int64)
+    rid = np.zeros(rn, dtype=np.int64)
+    lvalid = np.ones(ln, dtype=bool)
+    rvalid = np.ones(rn, dtype=bool)
+    for lk, rk in zip(lkeys, rkeys):
+        f = _factorize_key_pair(lk, rk)
+        if f is None:
+            return None
+        lc, rc, lv, rv = f
+        card = int(max(lc.max(initial=0), rc.max(initial=0))) + 1
+        lid = lid * card + lc
+        rid = rid * card + rc
+        lvalid &= lv
+        rvalid &= rv
+    order = np.flatnonzero(rvalid)[
+        np.argsort(rid[rvalid], kind="stable")]
+    rs = rid[order]
+    lsel = np.flatnonzero(lvalid)
+    lo = np.searchsorted(rs, lid[lsel], "left")
+    hi = np.searchsorted(rs, lid[lsel], "right")
+    counts = hi - lo
+    total = int(counts.sum())
+    li = np.repeat(lsel, counts)
+    # right side: concatenated order[lo_i : hi_i] ranges, vectorized
+    if total:
+        starts = np.repeat(lo, counts)
+        prior = np.repeat(np.cumsum(counts) - counts, counts)
+        ri = order[starts + (np.arange(total) - prior)]
+    else:
+        ri = np.empty(0, dtype=np.int64)
+    return li.astype(np.int64), ri.astype(np.int64)
+
+
 def hash_join(left: Scope, right: Scope, kind: str,
               on: Expr | None) -> Scope:
     """Hash equi-join with residual filter; inner/left/right/full/cross
@@ -161,21 +237,33 @@ def hash_join(left: Scope, right: Scope, kind: str,
         _equi_keys(on, set(left.env), set(right.env))
     ln, rn = left.n, right.n
     if keys:
-        lkeys = [np.asarray(le.eval(left.env, np)) for le, _ in keys]
-        rkeys = [np.asarray(re.eval(right.env, np)) for _, re in keys]
-        table: dict = {}
-        for j in range(rn):
-            k = _key_tuple(rkeys, j)
-            if k is not None:
-                table.setdefault(k, []).append(j)
-        li_l, ri_l = [], []
-        for i in range(ln):
-            k = _key_tuple(lkeys, i)
-            for j in (table.get(k, ()) if k is not None else ()):
-                li_l.append(i)
-                ri_l.append(j)
-        li = np.asarray(li_l, dtype=np.int64)
-        ri = np.asarray(ri_l, dtype=np.int64)
+        def key_arr(e, env):
+            v = e.eval(env, np)
+            # materialize dictionary columns HERE: np.asarray would wrap
+            # a DictArray as one opaque object, breaking key comparison
+            return v.materialize() if isinstance(v, DictArray) \
+                else np.asarray(v)
+
+        lkeys = [key_arr(le, left.env) for le, _ in keys]
+        rkeys = [key_arr(re, right.env) for _, re in keys]
+        vec = _vector_join_indices(lkeys, rkeys, ln, rn)
+        if vec is not None:
+            li, ri = vec
+        else:
+            # fallback for key types numpy can't factorize (mixed objects)
+            table: dict = {}
+            for j in range(rn):
+                k = _key_tuple(rkeys, j)
+                if k is not None:
+                    table.setdefault(k, []).append(j)
+            li_l, ri_l = [], []
+            for i in range(ln):
+                k = _key_tuple(lkeys, i)
+                for j in (table.get(k, ()) if k is not None else ()):
+                    li_l.append(i)
+                    ri_l.append(j)
+            li = np.asarray(li_l, dtype=np.int64)
+            ri = np.asarray(ri_l, dtype=np.int64)
     else:
         li = np.repeat(np.arange(ln, dtype=np.int64), rn)
         ri = np.tile(np.arange(rn, dtype=np.int64), ln)

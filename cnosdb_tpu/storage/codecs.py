@@ -17,8 +17,11 @@ bandwidth into arrays ready for PCIe staging.
 - QUANTILE: raw-LE → byte-plane transpose → zstd-3 (stands in for the
   reference's pco; keeps the enum id).
 - BITPACK (bool): np.packbits.
-- Strings: length-prefixed concat → container codec (zstd/gzip/zlib/bzip;
-  SNAPPY rides zlib-1 — no snappy lib in env, id preserved).
+- Strings: DICTIONARY pages — sorted unique values + narrow-cast int32
+  codes → container codec (zstd/gzip/zlib/bzip; SNAPPY rides zlib-1 — no
+  snappy lib in env, id preserved). Decode materializes the dictionary
+  (O(unique) Python) and the codes in one frombuffer; v1 length-prefixed
+  pages remain readable. Code order == string order (models.strcol).
 
 Each encoded block: [1B encoding id][payload]; `encode`/`decode` dispatch
 on column value type + id, matching the reference's one-byte code header

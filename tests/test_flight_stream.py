@@ -195,3 +195,106 @@ def test_flight_sql_standard_descriptor_flow(db):
                                          "table_name", "table_type"}
     finally:
         server.shutdown()
+
+
+def test_flight_sql_prepared_statements(db):
+    """Prepared-statement flow (reference flight_sql_server.rs:933
+    do_action_create_prepared_statement + get_flight_info_prepared_statement
+    + do_put_prepared_statement_update): create → schema + handle, query
+    via CommandPreparedStatementQuery, update via DoPut, close."""
+    ex, _ = db
+    pytest.importorskip("pyarrow.flight")
+    import pyarrow as pa
+    import pyarrow.flight as fl
+
+    from cnosdb_tpu.server.flight import (
+        _any_unpack, _pb_parse, action_create_prepared_statement,
+        action_close_prepared_statement, command_prepared_statement_query,
+        command_statement_update, start_flight_server,
+    )
+
+    ex.execute_one("CREATE TABLE prep (v DOUBLE, TAGS(host))")
+    ex.execute_one("INSERT INTO prep (time, host, v) VALUES "
+                   "(1, 'a', 1.5), (2, 'b', 2.5)")
+    port = _free_port()
+    server = start_flight_server(ex, port)
+    try:
+        client = fl.connect(f"grpc://127.0.0.1:{port}")
+        results = list(client.do_action(fl.Action(
+            "CreatePreparedStatement",
+            action_create_prepared_statement(
+                "SELECT host, v FROM prep ORDER BY time"))))
+        kind, val = _any_unpack(results[0].body.to_pybytes())
+        assert kind == "ActionCreatePreparedStatementResult"
+        fields = _pb_parse(val)
+        handle = fields[1][0]
+        schema = pa.ipc.read_schema(pa.py_buffer(fields[2][0]))
+        assert schema.names == ["host", "v"]
+
+        # execute twice through the handle — prepared statements replay
+        for _ in range(2):
+            info = client.get_flight_info(fl.FlightDescriptor.for_command(
+                command_prepared_statement_query(handle)))
+            assert info.schema.names == ["host", "v"]
+            t = client.do_get(info.endpoints[0].ticket).read_all()
+            assert t.column("v").to_pylist() == [1.5, 2.5]
+
+        # DoPut statement update (how JDBC runs DML/DDL)
+        desc = fl.FlightDescriptor.for_command(command_statement_update(
+            "INSERT INTO prep (time, host, v) VALUES (3, 'c', 3.5)"))
+        writer, reader = client.do_put(desc, pa.schema([]))
+        writer.done_writing()
+        buf = reader.read()
+        writer.close()
+        assert buf is not None
+        info = client.get_flight_info(fl.FlightDescriptor.for_command(
+            command_prepared_statement_query(handle)))
+        t = client.do_get(info.endpoints[0].ticket).read_all()
+        assert t.num_rows == 3
+
+        client.do_action(fl.Action(
+            "ClosePreparedStatement",
+            action_close_prepared_statement(handle)))
+    finally:
+        server.shutdown()
+
+
+def test_flight_prepared_dml_no_side_effects_and_affected_count(db):
+    """Preparing an INSERT must not apply it; executing it via DoPut
+    reports the REAL affected-row count (JDBC executeUpdate)."""
+    ex, _ = db
+    pytest.importorskip("pyarrow.flight")
+    import pyarrow as pa
+    import pyarrow.flight as fl
+
+    from cnosdb_tpu.server.flight import (
+        _any_unpack, _pb_parse, action_create_prepared_statement,
+        command_statement_update, start_flight_server,
+    )
+
+    ex.execute_one("CREATE TABLE pdml (v DOUBLE, TAGS(host))")
+    port = _free_port()
+    server = start_flight_server(ex, port)
+    try:
+        client = fl.connect(f"grpc://127.0.0.1:{port}")
+        ins = ("INSERT INTO pdml (time, host, v) VALUES "
+               "(1,'a',1.0), (2,'b',2.0), (3,'c',3.0)")
+        results = list(client.do_action(fl.Action(
+            "CreatePreparedStatement",
+            action_create_prepared_statement(ins))))
+        assert results  # handle returned
+        rs = ex.execute_one("SELECT count(v) AS c FROM pdml")
+        assert int(rs.columns[0][0]) == 0  # prepare applied NOTHING
+
+        desc = fl.FlightDescriptor.for_command(command_statement_update(ins))
+        writer, reader = client.do_put(desc, pa.schema([]))
+        writer.done_writing()
+        buf = reader.read()
+        writer.close()
+        fields = _pb_parse(buf.to_pybytes() if hasattr(buf, "to_pybytes")
+                           else bytes(buf))
+        assert fields[1][0] == 3  # DoPutUpdateResult.record_count
+        rs = ex.execute_one("SELECT count(v) AS c FROM pdml")
+        assert int(rs.columns[0][0]) == 3
+    finally:
+        server.shutdown()

@@ -167,3 +167,72 @@ def test_window_over_aggregate_subquery(db):
         "(SELECT region, sum(v) s FROM cpu GROUP BY region) t "
         "ORDER BY r")
     assert rows(rs, 0, 1) == [("us", 1), ("eu", 2)]
+
+
+def test_join_null_keys_never_match(db):
+    """Vectorized equi-join semantics: NULL join keys match nothing
+    (SQL), including NULL-vs-NULL; NaN float keys match nothing."""
+    db.execute_one("CREATE TABLE lk (k BIGINT, x DOUBLE, TAGS(t))")
+    db.execute_one("CREATE TABLE rk (k2 BIGINT, y DOUBLE, TAGS(t))")
+    db.execute_one("INSERT INTO lk (time, t, k, x) VALUES "
+                   "(1,'l',1,10.0),(2,'l',NULL,20.0),(3,'l',3,30.0)")
+    db.execute_one("INSERT INTO rk (time, t, k2, y) VALUES "
+                   "(1,'r',1,1.5),(2,'r',NULL,2.5),(3,'r',9,3.5)")
+    rs = db.execute_one(
+        "SELECT l.x, r.y FROM lk l JOIN rk r ON l.k = r.k2 ORDER BY l.x")
+    assert rows(rs, 0, 1) == [(10.0, 1.5)]
+    # left join: NULL-key left rows survive with NULL right columns
+    # (float NULL renders as NaN in the columnar result)
+    rs = db.execute_one(
+        "SELECT l.x, r.y FROM lk l LEFT JOIN rk r ON l.k = r.k2 "
+        "ORDER BY l.x")
+    got = rows(rs, 0, 1)
+    assert [x for x, _ in got] == [10.0, 20.0, 30.0]
+    assert got[0][1] == 1.5
+    assert all(y != y or y is None for _, y in got[1:])  # NaN/None = NULL
+
+
+def test_join_string_keys_and_duplicates(db):
+    db.execute_one("CREATE TABLE ls (k STRING, x DOUBLE, TAGS(t))")
+    db.execute_one("CREATE TABLE rs_ (k2 STRING, y DOUBLE, TAGS(t))")
+    db.execute_one("INSERT INTO ls (time, t, k, x) VALUES "
+                   "(1,'l','a',1.0),(2,'l','b',2.0),(3,'l','a',3.0)")
+    db.execute_one("INSERT INTO rs_ (time, t, k2, y) VALUES "
+                   "(1,'r','a',10.0),(2,'r','a',20.0),(3,'r','c',30.0)")
+    rs = db.execute_one(
+        "SELECT l.x, r.y FROM ls l JOIN rs_ r ON l.k = r.k2 "
+        "ORDER BY l.x, r.y")
+    # 'a' x 'a' duplicates expand: (1,10),(1,20),(3,10),(3,20)
+    assert rows(rs, 0, 1) == [(1.0, 10.0), (1.0, 20.0),
+                              (3.0, 10.0), (3.0, 20.0)]
+
+
+def test_join_int_float_key_equality(db):
+    db.execute_one("CREATE TABLE li (k BIGINT, x DOUBLE, TAGS(t))")
+    db.execute_one("CREATE TABLE rf (k2 DOUBLE, y DOUBLE, TAGS(t))")
+    db.execute_one("INSERT INTO li (time, t, k, x) VALUES (1,'l',5,1.0)")
+    db.execute_one("INSERT INTO rf (time, t, k2, y) VALUES (1,'r',5.0,9.0)")
+    rs = db.execute_one(
+        "SELECT l.x, r.y FROM li l JOIN rf r ON l.k = r.k2")
+    assert rows(rs, 0, 1) == [(1.0, 9.0)]
+
+
+def test_join_bigint_keys_above_2_53_stay_exact(db):
+    big = 2**53
+    db.execute_one("CREATE TABLE lb (k BIGINT, x DOUBLE, TAGS(t))")
+    db.execute_one("CREATE TABLE rb (k2 BIGINT, y DOUBLE, TAGS(t))")
+    db.execute_one(f"INSERT INTO lb (time, t, k, x) VALUES (1,'l',{big},1.0)")
+    db.execute_one(
+        f"INSERT INTO rb (time, t, k2, y) VALUES (1,'r',{big + 1},9.0)")
+    rs = db.execute_one("SELECT l.x FROM lb l JOIN rb r ON l.k = r.k2")
+    assert rs.n_rows == 0  # 2^53 and 2^53+1 must NOT alias through float64
+
+
+def test_join_qualified_by_table_name_without_alias(db):
+    """FROM o JOIN c ON o.cust = c.cust — unaliased tables are
+    addressable by their own names (standard SQL)."""
+    rs = db.execute_one(
+        "SELECT hostinfo.owner, sum(cpu.v) AS s FROM cpu "
+        "JOIN hostinfo ON cpu.host = hostinfo.host "
+        "GROUP BY hostinfo.owner ORDER BY hostinfo.owner")
+    assert rows(rs, 0, 1) == [("alice", 5.0), ("bob", 2.0)]
