@@ -120,21 +120,53 @@ def launch_scan_aggregate(batch: ScanBatch, query: TpuQuery):
     gf_dims: list[int] = []
     gf_dicts: list[np.ndarray] = []
     gf_codes: list[np.ndarray] = []
+    # factorizations are immutable per scan snapshot: cache on the batch
+    # (numeric np.unique at 10M rows costs ~100s of ms per query)
+    gf_cache = getattr(batch, "_gf_cache", None)
+    if gf_cache is None and query.group_fields:
+        gf_cache = batch._gf_cache = {}
     for fcol in query.group_fields:
+        hit = gf_cache.get(fcol)
+        if hit is not None:
+            dim, dic, codes = hit
+            gf_dims.append(dim)
+            gf_dicts.append(dic)
+            gf_codes.append(codes)
+            continue
         f = batch.fields.get(fcol)
         if f is None:  # column absent in this vnode: every row groups NULL
+            gf_cache[fcol] = (1, np.empty(0, dtype=object),
+                             np.zeros(n, dtype=np.int64))
             gf_dims.append(1)
             gf_dicts.append(np.empty(0, dtype=object))
             gf_codes.append(np.zeros(n, dtype=np.int64))
             continue
         _vt, vals, valid = f
-        da = vals if isinstance(vals, DictArray) else DictArray.from_objects(vals)
-        u = len(da.values)
-        codes = da.codes.astype(np.int64)
+        if _vt in (ValueType.STRING, ValueType.GEOMETRY):
+            da = vals if isinstance(vals, DictArray) \
+                else DictArray.from_objects(vals)
+            u = len(da.values)
+            codes = da.codes.astype(np.int64)
+            dic = da.values
+        else:
+            # numeric group keys factorize per batch (np.unique collapses
+            # NaNs to one group, matching DataFusion's grouping)
+            arr = np.asarray(vals)
+            if _vt == ValueType.BOOLEAN:
+                arr = arr.astype(np.int64)
+            uniq, inv = np.unique(arr, return_inverse=True)
+            u = len(uniq)
+            codes = inv.astype(np.int64)
+            dic = uniq.astype(object)
+            if _vt == ValueType.BOOLEAN:
+                dic = np.array([bool(x) for x in uniq], dtype=object)
         if not bool(valid.all()):
             codes = np.where(valid, codes, u)
+        while len(gf_cache) >= 2:   # same tight bound as the seg cache
+            gf_cache.pop(next(iter(gf_cache)))
+        gf_cache[fcol] = (u + 1, dic, codes)
         gf_dims.append(u + 1)
-        gf_dicts.append(da.values)
+        gf_dicts.append(dic)
         gf_codes.append(codes)
     for d in gf_dims:
         n_groups *= d

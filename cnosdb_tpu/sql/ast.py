@@ -131,6 +131,10 @@ class ShowStmt:
     kind: str                        # databases/tables/series/tag_values/queries
     table: str | None = None
     tag_key: str | None = None
+    # SHOW TAG VALUES ... WITH KEY <op> — ("eq"|"ne"|"in"|"notin", [names])
+    # (reference ast.rs:433 With::{Equal,UnEqual,In,NotIn}; Match/UnMatch
+    # are NotImplemented upstream too)
+    tag_with: tuple | None = None
     where: Optional[Expr] = None
     on_database: str | None = None
     limit: int | None = None

@@ -483,17 +483,58 @@ class QueryExecutor:
             names = self.meta.list_tables(session.tenant, db)
             return ResultSet(["table_name"], [np.array(names, dtype=object)])
         if stmt.kind == "tag_values":
-            vals = self.coord.tag_values(session.tenant, session.database,
-                                         stmt.table, stmt.tag_key)
+            # (key, value) rows per the reference
+            # (planner.rs:2819 show_tag_value_projections)
+            schema = self.meta.table(session.tenant, session.database,
+                                     stmt.table)
+            tags = schema.tag_names()
+            op, names = stmt.tag_with or ("eq", [stmt.tag_key])
+            keys = {"eq": [t for t in tags if t in names],
+                    "ne": [t for t in tags if t not in names],
+                    "in": [t for t in tags if t in names],
+                    "notin": [t for t in tags if t not in names]}[op]
+            out_k: list[str] = []
+            out_v: list[str] = []
+            for key in keys:
+                for v in self.coord.tag_values(
+                        session.tenant, session.database, stmt.table, key):
+                    out_k.append(key)
+                    out_v.append(v)
             if stmt.limit is not None:
-                vals = vals[:stmt.limit]
-            return ResultSet(["value"], [np.array(vals, dtype=object)])
+                out_k, out_v = out_k[:stmt.limit], out_v[:stmt.limit]
+            return ResultSet(["key", "value"],
+                             [np.array(out_k, dtype=object),
+                              np.array(out_v, dtype=object)])
         if stmt.kind == "tag_keys":
             schema = self.meta.table(session.tenant, session.database, stmt.table)
             return ResultSet(["tag_key"],
                              [np.array(schema.tag_names(), dtype=object)])
         if stmt.kind == "series":
             keys = self.coord.series_keys(session.tenant, session.database, stmt.table)
+            if stmt.where is not None:
+                # tag predicates filter the series set (reference
+                # ShowTagBody.selection); time/field predicates would
+                # need a data scan — reject rather than silently ignore
+                schema = self.meta.table(session.tenant, session.database,
+                                         stmt.table)
+                tag_names = set(schema.tag_names())
+                bad = stmt.where.columns() - tag_names
+                if bad:
+                    raise PlanError(
+                        f"SHOW SERIES WHERE supports tag predicates only, "
+                        f"got {sorted(bad)}")
+                n = len(keys)
+                env: dict = {}
+                for c in stmt.where.columns():
+                    env[c] = np.array([k.tag_value(c) for k in keys],
+                                      dtype=object)
+                    env[f"__valid__:{c}"] = np.array(
+                        [k.tag_value(c) is not None for k in keys],
+                        dtype=bool)
+                mask = np.asarray(stmt.where.eval(env, np), dtype=bool)
+                if mask.shape == ():
+                    mask = np.full(n, bool(mask))
+                keys = [k for k, m in zip(keys, mask) if m]
             reprs = [repr(k) for k in keys]
             if stmt.offset:
                 reprs = reprs[stmt.offset:]
@@ -1738,6 +1779,19 @@ def _vector_finalize(spec, parts_env: dict, n: int):
     raise ExecutionError(f"bad finalizer {spec!r}")
 
 
+# one shared NaN so cross-vnode NaN group keys collapse to a single dict
+# entry (NaN != NaN defeats tuple keys; dict identity matches this object)
+_NAN_KEY = float("nan")
+
+
+def _canon_group_key(v):
+    if isinstance(v, float) and v != v:
+        return _NAN_KEY
+    if isinstance(v, np.floating) and v != v:
+        return _NAN_KEY
+    return v
+
+
 def _merge_partial(acc: dict, result, plan: AggregatePlan,
                    phys_aggs: list[AggSpec]):
     n = result.n_rows
@@ -1746,7 +1800,7 @@ def _merge_partial(acc: dict, result, plan: AggregatePlan,
     cols = result.columns
     gt = plan.group_tags + plan.group_fields
     for i in range(n):
-        key = tuple(cols[t][i] for t in gt)
+        key = tuple(_canon_group_key(cols[t][i]) for t in gt)
         if plan.bucket is not None:
             key = key + (int(cols["time"][i]),)
         parts = acc.setdefault(key, {})

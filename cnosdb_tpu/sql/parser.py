@@ -188,6 +188,14 @@ class Parser:
             raise ParserError(f"expected identifier, got {t.value!r}")
         return self.next().value
 
+    def _ident_parens(self) -> list[str]:
+        self.expect_op("(")
+        out = [self.expect_ident()]
+        while self.accept_op(","):
+            out.append(self.expect_ident())
+        self.expect_op(")")
+        return out
+
     def _parse_kv_parens(self) -> dict:
         """(key = 'value', flag = true, n = 3) → dict — the option-list
         form of CONNECTION/OPTIONS clauses (reference parser.rs:1716-1790
@@ -801,8 +809,19 @@ class Parser:
                     stmt.table = self.expect_ident()
                 self.expect_kw("WITH")
                 self.expect_kw("KEY")
-                self.accept_op("=")
-                stmt.tag_key = self.expect_ident()
+                # = k | != k | IN (a, b) | NOT IN (a, b)
+                if self.accept_op("="):
+                    stmt.tag_with = ("eq", [self.expect_ident()])
+                elif self.accept_op("!="):
+                    stmt.tag_with = ("ne", [self.expect_ident()])
+                elif self.accept_kw("NOT"):
+                    self.expect_kw("IN")
+                    stmt.tag_with = ("notin", self._ident_parens())
+                elif self.accept_kw("IN"):
+                    stmt.tag_with = ("in", self._ident_parens())
+                else:
+                    stmt.tag_with = ("eq", [self.expect_ident()])
+                stmt.tag_key = stmt.tag_with[1][0]
                 if self.accept_kw("LIMIT"):
                     stmt.limit = int(self.expect_number())
                 return stmt

@@ -331,9 +331,7 @@ def _plan_aggregate(stmt, schema, time_trs, tag_domains, residual):
 
     group_tags: list[str] = []
     group_fields: list[str] = []
-    string_fields = {c.name for c in schema.field_columns
-                     if c.column_type.value_type in (ValueType.STRING,
-                                                     ValueType.GEOMETRY)}
+    all_fields = {c.name for c in schema.field_columns}
     bucket = None
     bucket_alias = None
     group_exprs: list[Expr] = []
@@ -360,15 +358,17 @@ def _plan_aggregate(stmt, schema, time_trs, tag_domains, residual):
                 return
             if g.name == TIME_COL:
                 raise PlanError("GROUP BY time requires date_bin/time_window")
-            if g.name in string_fields:
-                # STRING field keys group on dictionary codes inside the
-                # segment kernels — same integer path as tags
+            if g.name in all_fields:
+                # FIELD keys group on codes inside the segment kernels —
+                # dictionary codes for strings, per-batch factorization
+                # for numerics; same integer path as tags. Cardinality
+                # blow-ups fall back to the relational pipeline at
+                # execution (segment-budget guard).
                 group_fields.append(g.name)
                 return
-            # grouping by a non-string FIELD column: the relational
-            # pipeline evaluates arbitrary group keys over materialized rows
             e = PlanError(
-                f"can only GROUP BY tags or time buckets, got {g.name!r}")
+                f"can only GROUP BY tags, fields or time buckets, "
+                f"got {g.name!r}")
             e.fallback_relational = True
             raise e
         e = PlanError(f"unsupported GROUP BY expression {g!r}")

@@ -35,6 +35,7 @@ class Scope:
         self.cols = [np.asarray(c) for c in cols]
         self.env = dict(env) if env is not None else \
             {n: c for n, c in zip(self.names, self.cols)}
+        self.quals: set[str] = set()   # relation qualifiers in scope
 
     @classmethod
     def from_relation(cls, names, cols, alias: str | None) -> "Scope":
@@ -42,6 +43,7 @@ class Scope:
         if alias:
             for n, c in zip(s.names, s.cols):
                 s.env[f"{alias}.{n}"] = c
+            s.quals = {alias}
         return s
 
     @property
@@ -49,8 +51,10 @@ class Scope:
         return len(self.cols[0]) if self.cols else 0
 
     def filter(self, mask: np.ndarray) -> "Scope":
-        return Scope(self.names, [c[mask] for c in self.cols],
-                     {k: v[mask] for k, v in self.env.items()})
+        out = Scope(self.names, [c[mask] for c in self.cols],
+                    {k: v[mask] for k, v in self.env.items()})
+        out.quals = set(self.quals)
+        return out
 
     def take(self, idx: np.ndarray) -> "Scope":
         return Scope(self.names, [c[idx] for c in self.cols],
@@ -233,6 +237,11 @@ def hash_join(left: Scope, right: Scope, kind: str,
     (reference defers to DataFusion's HashJoinExec)."""
     if kind != "cross" and on is None:
         raise PlanError("JOIN requires an ON condition (use CROSS JOIN)")
+    dup = left.quals & right.quals
+    if dup:
+        raise PlanError(
+            f"table name {sorted(dup)[0]!r} specified more than once — "
+            "alias one side")
     keys, residual = ([], []) if kind == "cross" else \
         _equi_keys(on, set(left.env), set(right.env))
     ln, rn = left.n, right.n
@@ -304,7 +313,9 @@ def hash_join(left: Scope, right: Scope, kind: str,
         cols.append(taken_r[n_])
     env.update(taken_r)
     env.update(taken_l)   # left wins bare-name collisions
-    return Scope(names, cols, env)
+    out = Scope(names, cols, env)
+    out.quals = left.quals | right.quals
+    return out
 
 
 # ---------------------------------------------------------------------------

@@ -236,3 +236,14 @@ def test_join_qualified_by_table_name_without_alias(db):
         "JOIN hostinfo ON cpu.host = hostinfo.host "
         "GROUP BY hostinfo.owner ORDER BY hostinfo.owner")
     assert rows(rs, 0, 1) == [("alice", 5.0), ("bob", 2.0)]
+
+
+def test_duplicate_unaliased_table_rejected(db):
+    from cnosdb_tpu.errors import CnosError
+    with pytest.raises(CnosError, match="more than once"):
+        db.execute_one("SELECT cpu.v FROM cpu JOIN cpu ON cpu.host = cpu.host")
+    # aliasing both sides is fine (self-join)
+    rs = db.execute_one(
+        "SELECT a.host FROM cpu a JOIN cpu b ON a.host = b.host "
+        "WHERE a.time < b.time")
+    assert rs.columns[0].tolist() == ["a"]

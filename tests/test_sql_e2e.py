@@ -142,14 +142,22 @@ def test_delete(air):
 def test_update_tag(air):
     air.execute_one("UPDATE air SET station = 'Renamed' WHERE station = 'XiaoMaiDao'")
     rs = air.execute_one("SHOW TAG VALUES FROM air WITH KEY = station")
-    assert rs.columns[0].tolist() == ["LianYunGang", "Renamed"]
+    assert rs.columns[1].tolist() == ["LianYunGang", "Renamed"]
 
 
 def test_show_series_tag_values(air):
     rs = air.execute_one("SHOW SERIES FROM air")
     assert rs.n_rows == 2
     rs = air.execute_one("SHOW TAG VALUES FROM air WITH KEY = station")
-    assert set(rs.columns[0]) == {"XiaoMaiDao", "LianYunGang"}
+    assert rs.names == ["key", "value"]
+    assert set(rs.columns[1]) == {"XiaoMaiDao", "LianYunGang"}
+    rs = air.execute_one(
+        "SHOW TAG VALUES FROM air WITH KEY != station")
+    assert rs.n_rows == 0
+    rs = air.execute_one(
+        "SHOW TAG VALUES FROM air WITH KEY IN (station)")
+    assert set(zip(rs.columns[0], rs.columns[1])) == {
+        ("station", "XiaoMaiDao"), ("station", "LianYunGang")}
 
 
 def test_explain(air):
