@@ -133,10 +133,15 @@ def launch_scan_aggregate(batch: ScanBatch, query: TpuQuery):
             gf_dicts.append(dic)
             gf_codes.append(codes)
             continue
+        # bound sized to the query: evicting below the current key-set
+        # would thrash every repeat of a multi-field GROUP BY
+        gf_bound = max(2, len(query.group_fields))
         f = batch.fields.get(fcol)
         if f is None:  # column absent in this vnode: every row groups NULL
+            while len(gf_cache) >= gf_bound:
+                gf_cache.pop(next(iter(gf_cache)))
             gf_cache[fcol] = (1, np.empty(0, dtype=object),
-                             np.zeros(n, dtype=np.int64))
+                              np.zeros(n, dtype=np.int64))
             gf_dims.append(1)
             gf_dicts.append(np.empty(0, dtype=object))
             gf_codes.append(np.zeros(n, dtype=np.int64))
@@ -162,7 +167,7 @@ def launch_scan_aggregate(batch: ScanBatch, query: TpuQuery):
                 dic = np.array([bool(x) for x in uniq], dtype=object)
         if not bool(valid.all()):
             codes = np.where(valid, codes, u)
-        while len(gf_cache) >= 2:   # same tight bound as the seg cache
+        while len(gf_cache) >= gf_bound:
             gf_cache.pop(next(iter(gf_cache)))
         gf_cache[fcol] = (u + 1, dic, codes)
         gf_dims.append(u + 1)

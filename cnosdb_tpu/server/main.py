@@ -41,6 +41,7 @@ def build_arg_parser() -> argparse.ArgumentParser:
 def main(argv: list[str] | None = None) -> int:
     args = build_arg_parser().parse_args(argv)
     if args.cmd in (None, "run"):
+        _guard_degraded_relay()
         from .http import run_server
 
         return run_server(args)
@@ -56,6 +57,28 @@ def main(argv: list[str] | None = None) -> int:
         print("config ok")
         return 0
     return 1
+
+
+def _guard_degraded_relay() -> None:
+    """A degraded TPU relay hangs `import jax` itself (the axon plugin
+    dials it at import when PALLAS_AXON_POOL_IPS is set) — which would
+    freeze the SERVER at its first query's placement probe. Probe in a
+    subprocess before any jax import; on a hang, re-exec the server on
+    CPU jax with the relay var stripped (same guard as bench.py and
+    __graft_entry__; cnosdb_tpu/utils/relay.py)."""
+    import os
+
+    if os.environ.get("CNOSDB_SERVER_REEXEC"):
+        return
+    from ..utils.relay import cleaned_cpu_env, probe_jax_importable
+
+    verdict = probe_jax_importable(timeout=30.0)
+    if verdict is None:
+        return
+    print(f"# {verdict}\n# re-exec server on CPU jax", file=sys.stderr)
+    os.execve(sys.executable, [sys.executable, "-m", "cnosdb_tpu.server.main",
+                               *(sys.argv[1:])],
+              cleaned_cpu_env({"CNOSDB_SERVER_REEXEC": "1"}))
 
 
 if __name__ == "__main__":

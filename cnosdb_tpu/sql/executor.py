@@ -1362,18 +1362,7 @@ class QueryExecutor:
                    for k, v in env.items()}
             n = int(mask.sum())
 
-        names, cols = [], []
-        for name, expr in plan.output:
-            if n == 0:
-                names.append(name)
-                cols.append(np.empty(0))
-                continue
-            v = expr.eval(env, np)
-            if np.isscalar(v) or getattr(v, "shape", None) == ():
-                v = np.full(n, v)
-            names.append(name)
-            cols.append(np.asarray(v))
-        rs = ResultSet(names, cols)
+        rs = ResultSet(*_render_output(plan, env, n))
         if plan.gapfill and rs.n_rows:
             rs = _apply_gapfill(plan, rs)
         env_out = dict(env)
@@ -1411,18 +1400,7 @@ class QueryExecutor:
                    for k, v in env.items()}
             n = int(mask.sum())
 
-        names, cols = [], []
-        for name, expr in plan.output:
-            if n == 0:
-                names.append(name)
-                cols.append(np.empty(0))
-                continue
-            v = expr.eval(env, np)
-            if np.isscalar(v) or getattr(v, "shape", None) == ():
-                v = np.full(n, v)
-            names.append(name)
-            cols.append(np.asarray(v))
-        rs = ResultSet(names, cols)
+        rs = ResultSet(*_render_output(plan, env, n))
         if plan.gapfill and rs.n_rows:
             rs = _apply_gapfill(plan, rs)
         # ORDER BY may reference output aliases (e.g. the bucket alias)
@@ -1747,6 +1725,39 @@ def _apply_finalizer(spec, parts: dict):
         vals = np.concatenate([np.asarray(c[1]) for c in chunks])
         return _series_finalize(spec[1], ts, vals, spec[3])
     raise ExecutionError(f"bad finalizer {spec!r}")
+
+
+def _render_output(plan, env: dict, n: int):
+    """Evaluate output expressions and RENDER NULLs: a slot whose source
+    aggregate is invalid (e.g. sum over an all-NULL group) must surface
+    as NULL/NaN, not its 0 accumulator."""
+    names, cols = [], []
+    for name, expr in plan.output:
+        if n == 0:
+            names.append(name)
+            cols.append(np.empty(0))
+            continue
+        v = expr.eval(env, np)
+        if isinstance(v, DictArray):
+            v = v.materialize()
+        if np.isscalar(v) or getattr(v, "shape", None) == ():
+            v = np.full(n, v)
+        arr = np.asarray(v)
+        vv = np.ones(n, dtype=bool)
+        for c in expr.columns():
+            vk = f"__valid__:{c}"
+            if vk in env and len(env[vk]) == n:
+                vv &= env[vk]
+        if not vv.all():
+            if np.issubdtype(arr.dtype, np.floating):
+                arr = arr.copy()
+                arr[~vv] = np.nan
+            else:
+                arr = arr.astype(object)
+                arr[~vv] = None
+        names.append(name)
+        cols.append(arr)
+    return names, cols
 
 
 def _vector_finalize(spec, parts_env: dict, n: int):

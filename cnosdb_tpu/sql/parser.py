@@ -812,7 +812,7 @@ class Parser:
                 # = k | != k | IN (a, b) | NOT IN (a, b)
                 if self.accept_op("="):
                     stmt.tag_with = ("eq", [self.expect_ident()])
-                elif self.accept_op("!="):
+                elif self.accept_op("!=") or self.accept_op("<>"):
                     stmt.tag_with = ("ne", [self.expect_ident()])
                 elif self.accept_kw("NOT"):
                     self.expect_kw("IN")

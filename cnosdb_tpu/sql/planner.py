@@ -426,13 +426,17 @@ def _plan_aggregate(stmt, schema, time_trs, tag_domains, residual):
 
     if (gapfill or fill_methods) and bucket is None:
         raise PlanError("gapfill/locf/interpolate require a time bucket")
+    # aggregates the segment kernels evaluate directly; everything else
+    # (median/stddev/mode/increase/sample/gauge/state/data-quality/
+    # count_distinct) merges host-side KEYED ON TAGS ONLY, so field group
+    # keys must take the relational pipeline with those
+    _KERNEL_AGGS = {"count", "count_star", "sum", "mean", "avg",
+                    "min", "max", "first", "last"}
     if group_fields and (gapfill or fill_methods
-                         or any(a.func in ("count_distinct", "collect",
-                                           "collect_ts")
+                         or any(a.func not in _KERNEL_AGGS
                                 for a in coll.aggs)):
-        # host-side distinct/collect merging and gapfill key on tags only —
-        # string-field group keys take the relational pipeline there
-        e = PlanError("string-field GROUP BY with distinct/collect/gapfill")
+        e = PlanError(
+            "field GROUP BY combines only with kernel aggregates")
         e.fallback_relational = True
         raise e
     return AggregatePlan(

@@ -391,6 +391,29 @@ def host_aggregate(func: str, col, gid: np.ndarray, n_groups: int,
         if np.issubdtype(col.dtype, np.integer) and not np.isnan(out).any():
             return out.astype(col.dtype)
         return out
+    if func in ("median", "stddev", "mode"):
+        # order-statistic / modal aggregates: one numpy pass per group
+        # after a single stable group sort (reference: DataFusion's
+        # accumulator set; time-ordered first/last stay kernel-only — row
+        # order after a join is arbitrary and would be silently wrong)
+        order = np.argsort(g, kind="stable")
+        gs, vs = g[order], v[order]
+        starts = np.flatnonzero(np.diff(gs, prepend=-1))
+        out = np.full(n_groups, np.nan) if col.dtype != object \
+            else np.full(n_groups, None, dtype=object)
+        for k, s0 in enumerate(starts):
+            s1 = starts[k + 1] if k + 1 < len(starts) else len(gs)
+            grp = vs[s0:s1]
+            gi = int(gs[s0])
+            if func == "median":
+                out[gi] = float(np.median(grp.astype(np.float64)))
+            elif func == "stddev":
+                out[gi] = (float(np.std(grp.astype(np.float64), ddof=1))
+                           if len(grp) > 1 else np.nan)
+            else:
+                uniq, cnt = np.unique(grp, return_counts=True)
+                out[gi] = uniq[int(np.argmax(cnt))]
+        return out
     raise PlanError(f"unsupported aggregate {func!r} over joined relations")
 
 

@@ -234,8 +234,6 @@ def test_nan_group_merges_across_vnodes(tmp_path):
     """GROUP BY a float field whose value is NaN: ONE NaN group, even
     when partials merge across shards (NaN != NaN defeats naive tuple
     keys)."""
-    from cnosdb_tpu.utils.memory_pool import MemoryPool
-
     meta = MetaStore(str(tmp_path / "meta.json"))
     engine = TsKv(str(tmp_path / "data"))
     ex = QueryExecutor(meta, Coordinator(meta, engine))
@@ -243,8 +241,6 @@ def test_nan_group_merges_across_vnodes(tmp_path):
     from cnosdb_tpu.sql.executor import Session
     s = Session(database="sh")
     ex.execute_one("CREATE TABLE m (v DOUBLE, f DOUBLE, TAGS(h))", s)
-    rows = ", ".join(f"({i}, 'h{i}', {i}.0, 0.0/0)" for i in range(8))
-    # 0.0/0 isn't INSERT-able literal syntax; insert NaN via float('nan')
     rows = ", ".join(f"({i}, 'h{i}', {i}.0, NaN)" for i in range(8))
     try:
         ex.execute_one(f"INSERT INTO m (time, h, v, f) VALUES {rows}", s)
@@ -265,3 +261,18 @@ def test_nan_group_merges_across_vnodes(tmp_path):
     assert rs.n_rows == 1, rs.columns
     assert int(rs.columns[1][0]) == 8
     engine.close()
+
+
+def test_field_group_with_host_merged_aggregates_falls_back(db):
+    """median/stddev etc. merge host-side keyed on tags only — a field
+    group key must route to the relational pipeline, not crash."""
+    db.execute_one("CREATE TABLE fm (v DOUBLE, b BIGINT, TAGS(h))")
+    db.execute_one("INSERT INTO fm (time, h, v, b) VALUES "
+                   "(1,'a',1.0,2),(2,'a',3.0,2),(3,'b',5.0,4)")
+    rs = db.execute_one(
+        "SELECT b, median(v) AS m FROM fm GROUP BY b ORDER BY b")
+    got = {int(k): float(m) for k, m in zip(rs.columns[0], rs.columns[1])}
+    assert got == {2: 2.0, 4: 5.0}
+    rs = db.execute_one(
+        "SELECT b, count(DISTINCT h) AS c FROM fm GROUP BY b ORDER BY b")
+    assert [int(x) for x in rs.columns[1]] == [1, 1]
