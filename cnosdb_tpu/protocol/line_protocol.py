@@ -23,6 +23,20 @@ def parse_lines(text: str, precision: Precision = Precision.NS,
                 default_time_ns: int | None = None) -> WriteBatch:
     factor = precision.to_ns_factor()
     now = default_time_ns if default_time_ns is not None else int(_time.time() * 1e9)
+    if len(text) >= 512:
+        # Native fast path (native/lineproto.cpp): same grouping/typing
+        # semantics, columnar output. None = unavailable or input outside
+        # its proven set — including anything malformed, so the Python path
+        # below raises the canonical error.
+        from . import native_lp
+
+        wb = native_lp.try_parse(text, now, factor)
+        if wb is not None:
+            return wb
+    return _parse_lines_py(text, factor, now)
+
+
+def _parse_lines_py(text: str, factor: int, now: int) -> WriteBatch:
     groups: dict[tuple[str, tuple], dict] = {}
     for lineno, raw in enumerate(text.splitlines(), 1):
         line = raw.strip()

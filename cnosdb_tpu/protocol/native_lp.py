@@ -1,0 +1,136 @@
+"""ctypes bridge to the native line-protocol parser (native/lineproto.cpp).
+
+The C++ parser mirrors the Python implementation exactly and rejects any
+input it cannot prove it handles identically (exotic unicode whitespace,
+overflowing literals, type conflicts) — `try_parse` then returns None and
+the caller runs the Python path, which either parses or raises the
+canonical ParserError. Success returns a WriteBatch whose timestamp and
+fully-present numeric columns are typed numpy arrays — the zero-copy fast
+ingest shape (models.points.SeriesRows array form).
+"""
+from __future__ import annotations
+
+import ctypes
+import struct
+
+import numpy as np
+
+from ..models.points import SeriesRows, WriteBatch
+from ..models.schema import ValueType
+from ..models.series import SeriesKey
+from ..storage import native as _native
+
+_CONFIGURED = False
+_LP_OK = False
+
+
+def _configure(lib) -> bool:
+    global _CONFIGURED, _LP_OK
+    if _CONFIGURED:
+        return _LP_OK
+    _CONFIGURED = True
+    if lib is None or not all(
+            hasattr(lib, s) for s in ("lp_parse", "lp_buf", "lp_size", "lp_free")):
+        return False
+    lib.lp_parse.restype = ctypes.c_void_p
+    lib.lp_parse.argtypes = [ctypes.c_char_p, ctypes.c_size_t,
+                             ctypes.c_longlong, ctypes.c_longlong,
+                             ctypes.c_char_p, ctypes.c_size_t]
+    lib.lp_buf.restype = ctypes.c_void_p
+    lib.lp_buf.argtypes = [ctypes.c_void_p]
+    lib.lp_size.restype = ctypes.c_size_t
+    lib.lp_size.argtypes = [ctypes.c_void_p]
+    lib.lp_free.restype = None
+    lib.lp_free.argtypes = [ctypes.c_void_p]
+    _LP_OK = True
+    return True
+
+
+def available() -> bool:
+    return _configure(_native.get_lib())
+
+
+def try_parse(text: str, default_ts: int, factor: int) -> WriteBatch | None:
+    """Parse via the native library; None = caller must use the Python path
+    (library unavailable, or input outside the native parser's proven set)."""
+    lib = _native.get_lib()
+    if not _configure(lib):
+        return None
+    raw = text.encode()
+    err = ctypes.create_string_buffer(160)
+    h = lib.lp_parse(raw, len(raw), default_ts, factor, err, len(err))
+    if not h:
+        return None
+    try:
+        buf = ctypes.string_at(lib.lp_buf(h), lib.lp_size(h))
+    finally:
+        lib.lp_free(h)
+    try:
+        return _decode(buf)
+    except Exception:
+        return None  # malformed meta walk: the Python path is canonical
+
+
+def _decode(buf: bytes) -> WriteBatch:
+    total, data_base = struct.unpack_from("<QQ", buf, 0)
+    off = 16
+    (n_groups,) = struct.unpack_from("<I", buf, off)
+    off += 4
+    wb = WriteBatch()
+    for _ in range(n_groups):
+        measurement, off = _str16(buf, off)
+        (n_tags,) = struct.unpack_from("<H", buf, off)
+        off += 2
+        tags = []
+        for _ in range(n_tags):
+            k, off = _str16(buf, off)
+            v, off = _str16(buf, off)
+            tags.append((k, v))
+        n_rows, ts_rel = struct.unpack_from("<IQ", buf, off)
+        off += 12
+        ts = np.frombuffer(buf, np.int64, n_rows, offset=data_base + ts_rel).copy()
+        (n_fields,) = struct.unpack_from("<H", buf, off)
+        off += 2
+        fields = {}
+        for _ in range(n_fields):
+            name, off = _str16(buf, off)
+            vt, missing, data_rel, present_rel = struct.unpack_from("<BBQQ", buf, off)
+            off += 18
+            base = data_base + data_rel
+            if vt == ValueType.STRING:
+                offs = np.frombuffer(buf, np.uint32, n_rows + 1, offset=base)
+                blob_base = base + 4 * (n_rows + 1)
+                mv = memoryview(buf)
+                vals = [str(mv[blob_base + offs[r]: blob_base + offs[r + 1]], "utf-8")
+                        for r in range(n_rows)]
+                if missing:
+                    present = np.frombuffer(buf, np.uint8, n_rows,
+                                            offset=data_base + present_rel)
+                    vals = [v if p else None for v, p in zip(vals, present)]
+            else:
+                if vt == ValueType.FLOAT:
+                    arr = np.frombuffer(buf, np.float64, n_rows, offset=base)
+                elif vt == ValueType.UNSIGNED:
+                    arr = np.frombuffer(buf, np.int64, n_rows, offset=base).view(np.uint64)
+                else:  # INTEGER / BOOLEAN ride as i64
+                    arr = np.frombuffer(buf, np.int64, n_rows, offset=base)
+                if vt == ValueType.BOOLEAN:
+                    arr = arr != 0
+                if missing:
+                    present = np.frombuffer(buf, np.uint8, n_rows,
+                                            offset=data_base + present_rel)
+                    obj = arr.astype(object)
+                    obj[present == 0] = None
+                    vals = obj.tolist()
+                else:
+                    vals = arr.copy()
+            fields[name] = (int(vt), vals)
+        sk = SeriesKey(measurement, tags)
+        wb.add_series(measurement, SeriesRows(sk, ts, fields))
+    return wb
+
+
+def _str16(buf: bytes, off: int) -> tuple[str, int]:
+    (n,) = struct.unpack_from("<H", buf, off)
+    off += 2
+    return buf[off:off + n].decode(), off + n

@@ -111,6 +111,14 @@ def _encode_delta(values: np.ndarray, is_ts: bool) -> bytes:
         # constant stride: [1][n u32][first i64][stride i64]
         return (b"\x01" + np.uint32(n).tobytes() + np.int64(v[0]).tobytes()
                 + np.int64(deltas[0]).tobytes())
+    from . import native
+
+    nat = native.encode_delta_i64(v) if n > 1 else None
+    if nat is not None:
+        width, raw_arr = nat
+        comp = _ZSTD_C.compress(raw_arr.tobytes())
+        return (b"\x02" + np.uint32(n).tobytes() + np.int64(v[0]).tobytes()
+                + bytes([width]) + comp)
     zz = zigzag(deltas) if n > 1 else np.empty(0, dtype=np.uint64)
     width, raw = _narrow_cast(zz)
     comp = _ZSTD_C.compress(raw)
@@ -153,6 +161,12 @@ def _encode_gorilla(values: np.ndarray) -> bytes:
     n = len(v)
     if n == 0:
         return b"\x00"
+    from . import native
+
+    nat = native.encode_xor_transpose_f64(v)
+    if nat is not None:
+        comp = _ZSTD_C.compress(nat.tobytes())
+        return b"\x02" + np.uint32(n).tobytes() + comp
     x = v.copy()
     x[1:] ^= v[:-1]
     comp = _ZSTD_C.compress(_byte_transpose(x, 8))

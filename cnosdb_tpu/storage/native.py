@@ -76,6 +76,16 @@ def _get_lib_locked():
         lib.version.restype = ctypes.c_int
         if lib.version() != 1:
             return None
+        if hasattr(lib, "encode_delta_i64"):
+            lib.encode_delta_i64.restype = ctypes.c_int
+            lib.encode_delta_i64.argtypes = [
+                ctypes.POINTER(ctypes.c_int64), ctypes.c_size_t,
+                ctypes.POINTER(ctypes.c_uint8), ctypes.c_size_t]
+        if hasattr(lib, "encode_xor_transpose_f64"):
+            lib.encode_xor_transpose_f64.restype = None
+            lib.encode_xor_transpose_f64.argtypes = [
+                ctypes.POINTER(ctypes.c_uint64), ctypes.c_size_t,
+                ctypes.POINTER(ctypes.c_uint8)]
         _LIB = lib
     except OSError:
         _LIB = None
@@ -106,6 +116,37 @@ def decode_delta_i64(comp: bytes, width: int, first: int, n: int) -> np.ndarray 
         out.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)), n,
         scratch.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)), len(scratch))
     return out if rc == 0 else None
+
+
+def encode_delta_i64(values: np.ndarray) -> tuple[int, np.ndarray] | None:
+    """Fused width-scan + zigzag-delta encode; returns (width, raw bytes of
+    (n-1)*width) or None (unavailable / n<2 handled by caller)."""
+    lib = get_lib()
+    if lib is None or not hasattr(lib, "encode_delta_i64"):
+        return None
+    n = len(values)
+    v = np.ascontiguousarray(values, dtype=np.int64)
+    out = np.empty(max((n - 1) * 8, 1), dtype=np.uint8)
+    width = lib.encode_delta_i64(
+        v.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)), n,
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)), len(out))
+    if width <= 0:
+        return None
+    return width, out[: (n - 1) * width]
+
+
+def encode_xor_transpose_f64(values: np.ndarray) -> np.ndarray | None:
+    """XOR-with-previous + byte-plane transpose in one native pass; returns
+    the n*8 transposed bytes ready for zstd, or None when unavailable."""
+    lib = get_lib()
+    if lib is None or not hasattr(lib, "encode_xor_transpose_f64"):
+        return None
+    v = np.ascontiguousarray(values).view(np.uint64)
+    out = np.empty(len(v) * 8, dtype=np.uint8)
+    lib.encode_xor_transpose_f64(
+        v.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)), len(v),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)))
+    return out
 
 
 def decode_xor_f64(comp: bytes, n: int) -> np.ndarray | None:

@@ -31,14 +31,14 @@ int decode_delta_i64(const uint8_t* comp, size_t comp_len, int width,
     if (raw_len > scratch_len) return -2;
     size_t got = ZSTD_decompress(scratch, raw_len, comp, comp_len);
     if (ZSTD_isError(got) || got != raw_len) return -3;
-    int64_t acc = first;
+    uint64_t acc = (uint64_t)first;
     switch (width) {
         case 1: {
             const uint8_t* d = scratch;
             for (size_t i = 1; i < n; i++) {
                 uint64_t z = d[i - 1];
-                acc += (int64_t)(z >> 1) ^ -(int64_t)(z & 1);
-                out[i] = acc;
+                acc += (uint64_t)((int64_t)(z >> 1) ^ -(int64_t)(z & 1));
+                out[i] = (int64_t)acc;
             }
             break;
         }
@@ -46,8 +46,8 @@ int decode_delta_i64(const uint8_t* comp, size_t comp_len, int width,
             const uint16_t* d = (const uint16_t*)scratch;
             for (size_t i = 1; i < n; i++) {
                 uint64_t z = d[i - 1];
-                acc += (int64_t)(z >> 1) ^ -(int64_t)(z & 1);
-                out[i] = acc;
+                acc += (uint64_t)((int64_t)(z >> 1) ^ -(int64_t)(z & 1));
+                out[i] = (int64_t)acc;
             }
             break;
         }
@@ -55,8 +55,8 @@ int decode_delta_i64(const uint8_t* comp, size_t comp_len, int width,
             const uint32_t* d = (const uint32_t*)scratch;
             for (size_t i = 1; i < n; i++) {
                 uint64_t z = d[i - 1];
-                acc += (int64_t)(z >> 1) ^ -(int64_t)(z & 1);
-                out[i] = acc;
+                acc += (uint64_t)((int64_t)(z >> 1) ^ -(int64_t)(z & 1));
+                out[i] = (int64_t)acc;
             }
             break;
         }
@@ -64,8 +64,8 @@ int decode_delta_i64(const uint8_t* comp, size_t comp_len, int width,
             const uint64_t* d = (const uint64_t*)scratch;
             for (size_t i = 1; i < n; i++) {
                 uint64_t z = d[i - 1];
-                acc += (int64_t)(z >> 1) ^ -(int64_t)(z & 1);
-                out[i] = acc;
+                acc += (uint64_t)((int64_t)(z >> 1) ^ -(int64_t)(z & 1));
+                out[i] = (int64_t)acc;
             }
             break;
         }
@@ -123,7 +123,9 @@ void encode_xor_transpose_f64(const uint64_t* in, size_t n, uint8_t* out) {
 void encode_zigzag_delta(const int64_t* in, size_t n, int width, uint8_t* out) {
     int64_t prev = in[0];
     for (size_t i = 1; i < n; i++) {
-        int64_t d = in[i] - prev;
+        // wrap-defined subtraction (numpy fallback wraps too; i64 overflow
+        // on extreme spreads must not be UB)
+        int64_t d = (int64_t)((uint64_t)in[i] - (uint64_t)prev);
         prev = in[i];
         uint64_t z = ((uint64_t)d << 1) ^ (uint64_t)(d >> 63);
         switch (width) {
@@ -133,6 +135,27 @@ void encode_zigzag_delta(const int64_t* in, size_t n, int width, uint8_t* out) {
             default: ((uint64_t*)out)[i - 1] = z; break;
         }
     }
+}
+
+// Fused encode: scan for the narrowest width, then write zigzag deltas at
+// that width into out (capacity must be >= (n-1)*8). Returns the width
+// (1/2/4/8), 0 for n < 2, or -1 when the capacity is short. The Python
+// layer zstd-compresses the result (zstd releases the GIL there).
+int encode_delta_i64(const int64_t* in, size_t n, uint8_t* out, size_t out_cap) {
+    if (n < 2) return 0;
+    uint64_t mx = 0;
+    int64_t prev = in[0];
+    for (size_t i = 1; i < n; i++) {
+        int64_t d = (int64_t)((uint64_t)in[i] - (uint64_t)prev);
+        prev = in[i];
+        uint64_t z = ((uint64_t)d << 1) ^ (uint64_t)(d >> 63);
+        if (z > mx) mx = z;
+    }
+    int width = mx < (1ull << 8) ? 1 : mx < (1ull << 16) ? 2
+              : mx < (1ull << 32) ? 4 : 8;
+    if ((n - 1) * (size_t)width > out_cap) return -1;
+    encode_zigzag_delta(in, n, width, out);
+    return width;
 }
 
 int version() { return 1; }

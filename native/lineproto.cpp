@@ -1,0 +1,512 @@
+// Native InfluxDB line-protocol parser — the ingest hot path.
+//
+// Role-parity with the reference's native protocol parser crate
+// (common/protocol_parser/src/line_protocol/parser.rs:40-49 +
+// lines_convert.rs:20,197): text → rows grouped per (measurement, sorted
+// tagset), columnar within a series — exactly the WriteBatch shape the
+// coordinator and vnode apply path consume. The algorithm mirrors the
+// Python parser in cnosdb_tpu/protocol/line_protocol.py token for token
+// (escape-preserving splits, quote toggling, suffix-typed field values);
+// any input this parser cannot prove it handles identically is rejected
+// so the caller falls back to the Python implementation — the fast path
+// never changes semantics.
+//
+// Output is a single contiguous buffer: a meta section Python walks with
+// struct.unpack_from, then 8-aligned data arrays numpy views directly.
+// Layout (little-endian):
+//   u64 total_len | u64 data_base | u32 n_groups
+//   per group:
+//     u16 mlen, measurement | u16 n_tags { u16 klen,k | u16 vlen,v } (sorted)
+//     u32 n_rows | u64 ts_rel | u16 n_fields
+//     per field: u16 nlen,name | u8 vt | u8 has_missing
+//                u64 data_rel | u64 present_rel (~0 when fully present)
+//   data section (each array 8-aligned, offsets relative to data_base):
+//     ts: i64[n];  FLOAT f64[n]; INTEGER/BOOLEAN i64[n]; UNSIGNED u64[n];
+//     STRING u32 offs[n+1] then utf8 blob; present u8[n].
+//
+// Build: make -C native   ABI: plain C over raw pointers, loaded via ctypes.
+
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <cstdlib>
+#include <cerrno>
+#include <string>
+#include <vector>
+#include <unordered_map>
+#include <map>
+#include <algorithm>
+
+namespace {
+
+// ValueType ids — must match cnosdb_tpu/models/schema.py (reference
+// tskv_table_schema.rs enum ids).
+enum VT : uint8_t { VT_FLOAT = 1, VT_INT = 2, VT_UINT = 3, VT_BOOL = 4, VT_STR = 5 };
+
+struct ParseErr {
+    std::string msg;
+};
+
+struct Col {
+    uint8_t vt = 0;
+    bool has_missing = false;
+    std::vector<uint8_t> present;
+    std::vector<double> f;
+    std::vector<int64_t> i;   // also bool storage (0/1) to keep it simple
+    std::vector<uint64_t> u;
+    std::vector<std::string> s;
+    size_t n() const {
+        switch (vt) {
+            case VT_FLOAT: return f.size();
+            case VT_INT: case VT_BOOL: return i.size();
+            case VT_UINT: return u.size();
+            case VT_STR: return s.size();
+        }
+        return 0;
+    }
+    void pad_to(size_t k) {
+        while (n() < k) {
+            switch (vt) {
+                case VT_FLOAT: f.push_back(0.0); break;
+                case VT_INT: case VT_BOOL: i.push_back(0); break;
+                case VT_UINT: u.push_back(0); break;
+                case VT_STR: s.emplace_back(); break;
+            }
+            present.push_back(0);
+            has_missing = true;
+        }
+    }
+};
+
+struct Group {
+    std::string measurement;
+    std::vector<std::pair<std::string, std::string>> tags;  // sorted
+    std::vector<int64_t> ts;
+    std::vector<Col> cols;
+    std::vector<std::string> col_names;                     // insertion order
+    std::unordered_map<std::string, int> col_index;
+};
+
+struct Result {
+    std::vector<uint8_t> buf;
+};
+
+// --- split/unescape mirroring the Python implementation -------------------
+// Split on unescaped `sep`; '\x' pairs are preserved (so nested splits see
+// them) unless `unescape`; '"' toggles quoting and inside quotes nothing is
+// an escape or separator.
+void split_escaped(const std::string& s, char sep, bool unescape,
+                   std::vector<std::string>& out) {
+    out.clear();
+    std::string cur;
+    bool in_quotes = false;
+    size_t n = s.size();
+    for (size_t i = 0; i < n;) {
+        char c = s[i];
+        if (c == '\\' && i + 1 < n && !in_quotes) {
+            if (unescape) {
+                cur.push_back(s[i + 1]);
+            } else {
+                cur.push_back(c);
+                cur.push_back(s[i + 1]);
+            }
+            i += 2;
+            continue;
+        }
+        if (c == '"') {
+            in_quotes = !in_quotes;
+            cur.push_back(c);
+            i++;
+            continue;
+        }
+        if (c == sep && !in_quotes) {
+            out.push_back(std::move(cur));
+            cur.clear();
+            i++;
+            continue;
+        }
+        cur.push_back(c);
+        i++;
+    }
+    out.push_back(std::move(cur));
+}
+
+std::string unescape(const std::string& s) {
+    std::string out;
+    out.reserve(s.size());
+    for (size_t i = 0; i < s.size();) {
+        if (s[i] == '\\' && i + 1 < s.size()) {
+            out.push_back(s[i + 1]);
+            i += 2;
+        } else {
+            out.push_back(s[i]);
+            i++;
+        }
+    }
+    return out;
+}
+
+bool parse_i64_strict(const std::string& s, int64_t* out) {
+    if (s.empty()) return false;
+    errno = 0;
+    char* end = nullptr;
+    long long v = strtoll(s.c_str(), &end, 10);
+    if (errno == ERANGE || end != s.c_str() + s.size()) return false;
+    *out = (int64_t)v;
+    return true;
+}
+
+bool parse_u64_strict(const std::string& s, uint64_t* out) {
+    if (s.empty() || s[0] == '-') return false;  // Python int() would accept
+                                                 // "-1" then store negative;
+                                                 // reject → fallback decides
+    errno = 0;
+    char* end = nullptr;
+    unsigned long long v = strtoull(s.c_str(), &end, 10);
+    if (errno == ERANGE || end != s.c_str() + s.size()) return false;
+    *out = (uint64_t)v;
+    return true;
+}
+
+// Strict float: only the plain [+-]digits[.digits][eE[+-]digits] shape that
+// C and Python agree on. nan/inf/underscores/hex floats → reject (fallback).
+bool parse_f64_strict(const std::string& s, double* out) {
+    if (s.empty()) return false;
+    for (char c : s) {
+        if (!((c >= '0' && c <= '9') || c == '+' || c == '-' || c == '.' ||
+              c == 'e' || c == 'E'))
+            return false;
+    }
+    errno = 0;
+    char* end = nullptr;
+    double v = strtod(s.c_str(), &end);
+    if (end != s.c_str() + s.size()) return false;
+    *out = v;
+    return true;
+}
+
+struct FieldVal {
+    uint8_t vt;
+    double f;
+    int64_t i;
+    uint64_t u;
+    std::string s;
+};
+
+bool lower_eq(const std::string& v, const char* a, const char* b) {
+    std::string lv;
+    lv.reserve(v.size());
+    for (char c : v) lv.push_back((char)tolower((unsigned char)c));
+    return lv == a || lv == b;
+}
+
+bool parse_field_value(const std::string& v, FieldVal* out) {
+    if (v.size() >= 2 && v.front() == '"' && v.back() == '"') {
+        out->vt = VT_STR;
+        std::string body = v.substr(1, v.size() - 2);
+        // replicate Python .replace('\\"', '"')
+        std::string r;
+        r.reserve(body.size());
+        for (size_t i = 0; i < body.size();) {
+            if (body[i] == '\\' && i + 1 < body.size() && body[i + 1] == '"') {
+                r.push_back('"');
+                i += 2;
+            } else {
+                r.push_back(body[i]);
+                i++;
+            }
+        }
+        out->s = std::move(r);
+        return true;
+    }
+    if (lower_eq(v, "t", "true")) {
+        out->vt = VT_BOOL;
+        out->i = 1;
+        return true;
+    }
+    if (lower_eq(v, "f", "false")) {
+        out->vt = VT_BOOL;
+        out->i = 0;
+        return true;
+    }
+    if (!v.empty() && v.back() == 'i') {
+        out->vt = VT_INT;
+        return parse_i64_strict(v.substr(0, v.size() - 1), &out->i);
+    }
+    if (!v.empty() && v.back() == 'u') {
+        out->vt = VT_UINT;
+        return parse_u64_strict(v.substr(0, v.size() - 1), &out->u);
+    }
+    out->vt = VT_FLOAT;
+    return parse_f64_strict(v, &out->f);
+}
+
+// Unicode whitespace / line separators Python's splitlines()/strip() honor
+// but this byte-level parser does not. Presence → reject whole input so the
+// Python parser decides (correctness over speed on exotic text).
+bool has_exotic_space(const uint8_t* p, size_t n) {
+    for (size_t i = 0; i + 1 < n; i++) {
+        if (p[i] == 0xC2 && (p[i + 1] == 0x85 || p[i + 1] == 0xA0)) return true;
+        if (p[i] == 0xE1 && i + 2 < n && p[i + 1] == 0x9A && p[i + 2] == 0x80) return true;
+        if (p[i] == 0xE2 && i + 2 < n) {
+            uint8_t b1 = p[i + 1], b2 = p[i + 2];
+            if (b1 == 0x80 && ((b2 >= 0x80 && b2 <= 0x8A) || b2 == 0xA8 ||
+                               b2 == 0xA9 || b2 == 0xAF))
+                return true;
+            if (b1 == 0x81 && b2 == 0x9F) return true;
+        }
+        if (p[i] == 0xE3 && i + 2 < n && p[i + 1] == 0x80 && p[i + 2] == 0x80) return true;
+    }
+    return false;
+}
+
+inline bool ascii_space(char c) {
+    // Python str.strip() whitespace set, ASCII subset (incl. FS/GS/RS/US)
+    return c == ' ' || c == '\t' || c == '\r' || c == '\n' || c == '\v' ||
+           c == '\f' || c == '\x1c' || c == '\x1d' || c == '\x1e';
+}
+
+inline bool line_term(uint8_t c) {
+    // Python splitlines() terminator set, ASCII subset
+    return c == '\n' || c == '\r' || c == '\v' || c == '\f' || c == '\x1c' ||
+           c == '\x1d' || c == '\x1e';
+}
+
+void align8(std::vector<uint8_t>& v) {
+    while (v.size() % 8) v.push_back(0);
+}
+
+template <typename T>
+uint64_t emit_array(std::vector<uint8_t>& data, const T* p, size_t n) {
+    align8(data);
+    uint64_t off = data.size();
+    const uint8_t* b = (const uint8_t*)p;
+    data.insert(data.end(), b, b + n * sizeof(T));
+    return off;
+}
+
+template <typename T>
+void put(std::string& meta, T v) {
+    meta.append((const char*)&v, sizeof(T));
+}
+
+void put_str16(std::string& meta, const std::string& s) {
+    if (s.size() > 0xFFFF) throw ParseErr{"name too long"};
+    put<uint16_t>(meta, (uint16_t)s.size());
+    meta.append(s);
+}
+
+}  // namespace
+
+extern "C" {
+
+// Returns a heap handle or NULL (err filled). factor multiplies explicit
+// timestamps (precision → ns); default_ts is used when a line has none.
+void* lp_parse(const uint8_t* text, size_t len, long long default_ts,
+               long long factor, char* err, size_t errcap) {
+    auto fail = [&](const std::string& m) -> void* {
+        if (err && errcap) snprintf(err, errcap, "%s", m.c_str());
+        return nullptr;
+    };
+    if (has_exotic_space(text, len)) return fail("exotic whitespace: fallback");
+    try {
+        std::vector<Group> groups;
+        std::unordered_map<std::string, int> group_index;
+        std::vector<std::string> sections, head_parts, kv, field_parts;
+        std::string line;
+        size_t pos = 0;
+        int lineno = 0;
+        while (pos <= len) {
+            // split on ASCII line terminators
+            size_t eol = pos;
+            while (eol < len && !line_term(text[eol])) eol++;
+            if (pos == len && eol == len && lineno > 0) break;
+            line.assign((const char*)text + pos, eol - pos);
+            // \r\n counts as one break (Python splitlines)
+            if (eol + 1 < len && text[eol] == '\r' && text[eol + 1] == '\n') eol++;
+            pos = eol + 1;
+            lineno++;
+            // strip
+            size_t a = 0, b = line.size();
+            while (a < b && ascii_space(line[a])) a++;
+            while (b > a && ascii_space(line[b - 1])) b--;
+            if (a > 0 || b < line.size()) line = line.substr(a, b - a);
+            if (line.empty() || line[0] == '#') {
+                if (pos > len) break;
+                continue;
+            }
+
+            split_escaped(line, ' ', false, sections);
+            sections.erase(std::remove(sections.begin(), sections.end(), std::string()),
+                           sections.end());
+            if (sections.size() < 2) throw ParseErr{"missing fields section"};
+            int64_t ts;
+            bool has_ts = sections.size() >= 3;
+            if (has_ts) {
+                if (!parse_i64_strict(sections[2], &ts)) throw ParseErr{"bad timestamp"};
+                __int128 wide = (__int128)ts * factor;
+                if (wide > INT64_MAX || wide < INT64_MIN) throw ParseErr{"timestamp overflow"};
+                ts = (int64_t)wide;
+            } else {
+                ts = default_ts;
+            }
+
+            split_escaped(sections[0], ',', false, head_parts);
+            std::string measurement = unescape(head_parts[0]);
+            if (measurement.empty()) throw ParseErr{"empty measurement"};
+            // later duplicate tag keys win (Python dict assignment), key order
+            // for grouping is sorted
+            std::map<std::string, std::string> tags;
+            for (size_t t = 1; t < head_parts.size(); t++) {
+                split_escaped(head_parts[t], '=', false, kv);
+                if (kv.size() != 2) throw ParseErr{"bad tag"};
+                tags[unescape(kv[0])] = unescape(kv[1]);
+            }
+
+            split_escaped(sections[1], ',', false, field_parts);
+            // later duplicate field names win within a line
+            std::vector<std::pair<std::string, FieldVal>> lfields;
+            std::unordered_map<std::string, int> lidx;
+            for (auto& f : field_parts) {
+                split_escaped(f, '=', false, kv);
+                if (kv.size() != 2) throw ParseErr{"bad field"};
+                FieldVal fv;
+                if (!parse_field_value(kv[1], &fv)) throw ParseErr{"bad field value"};
+                std::string name = unescape(kv[0]);
+                auto it = lidx.find(name);
+                if (it != lidx.end()) {
+                    lfields[it->second].second = std::move(fv);
+                } else {
+                    lidx.emplace(name, (int)lfields.size());
+                    lfields.emplace_back(std::move(name), std::move(fv));
+                }
+            }
+            if (lfields.empty()) throw ParseErr{"no fields"};
+
+            // length-prefixed key components: a NUL or any other byte in a
+            // tag key/value can never alias a component boundary
+            std::string gkey;
+            auto key_part = [&gkey](const std::string& s) {
+                uint32_t l = (uint32_t)s.size();
+                gkey.append((const char*)&l, 4);
+                gkey += s;
+            };
+            key_part(measurement);
+            for (auto& t : tags) {
+                key_part(t.first);
+                key_part(t.second);
+            }
+            auto git = group_index.find(gkey);
+            Group* g;
+            if (git == group_index.end()) {
+                group_index.emplace(std::move(gkey), (int)groups.size());
+                groups.emplace_back();
+                g = &groups.back();
+                g->measurement = std::move(measurement);
+                g->tags.assign(tags.begin(), tags.end());
+            } else {
+                g = &groups[git->second];
+            }
+            size_t idx = g->ts.size();
+            g->ts.push_back(ts);
+            for (auto& [name, fv] : lfields) {
+                auto cit = g->col_index.find(name);
+                Col* col;
+                if (cit == g->col_index.end()) {
+                    g->col_index.emplace(name, (int)g->cols.size());
+                    g->col_names.push_back(name);
+                    g->cols.emplace_back();
+                    col = &g->cols.back();
+                    col->vt = fv.vt;
+                } else {
+                    col = &g->cols[cit->second];
+                    if (col->vt != fv.vt) throw ParseErr{"field type conflict in batch"};
+                }
+                col->pad_to(idx);
+                switch (fv.vt) {
+                    case VT_FLOAT: col->f.push_back(fv.f); break;
+                    case VT_INT: case VT_BOOL: col->i.push_back(fv.i); break;
+                    case VT_UINT: col->u.push_back(fv.u); break;
+                    case VT_STR: col->s.push_back(std::move(fv.s)); break;
+                }
+                col->present.push_back(1);
+            }
+            if (pos > len) break;
+        }
+
+        // ---- serialize ---------------------------------------------------
+        std::string meta;
+        std::vector<uint8_t> data;
+        put<uint32_t>(meta, (uint32_t)groups.size());
+        for (auto& g : groups) {
+            size_t n = g.ts.size();
+            for (auto& c : g.cols) c.pad_to(n);
+            if (g.tags.size() > 0xFFFF || g.cols.size() > 0xFFFF ||
+                n > 0xFFFFFFFFull)
+                throw ParseErr{"too many tags/fields/rows"};
+            put_str16(meta, g.measurement);
+            put<uint16_t>(meta, (uint16_t)g.tags.size());
+            for (auto& t : g.tags) {
+                put_str16(meta, t.first);
+                put_str16(meta, t.second);
+            }
+            put<uint32_t>(meta, (uint32_t)n);
+            put<uint64_t>(meta, emit_array(data, g.ts.data(), n));
+            put<uint16_t>(meta, (uint16_t)g.cols.size());
+            for (size_t ci = 0; ci < g.cols.size(); ci++) {
+                Col& c = g.cols[ci];
+                put_str16(meta, g.col_names[ci]);
+                put<uint8_t>(meta, c.vt);
+                put<uint8_t>(meta, c.has_missing ? 1 : 0);
+                uint64_t data_rel;
+                switch (c.vt) {
+                    case VT_FLOAT: data_rel = emit_array(data, c.f.data(), n); break;
+                    case VT_INT: case VT_BOOL: data_rel = emit_array(data, c.i.data(), n); break;
+                    case VT_UINT: data_rel = emit_array(data, c.u.data(), n); break;
+                    default: {  // strings: u32 offs[n+1], then blob
+                        std::vector<uint32_t> offs(n + 1, 0);
+                        size_t total = 0;
+                        for (size_t r = 0; r < n; r++) {
+                            total += c.s[r].size();
+                            if (total > UINT32_MAX) throw ParseErr{"string column too large"};
+                            offs[r + 1] = (uint32_t)total;
+                        }
+                        data_rel = emit_array(data, offs.data(), n + 1);
+                        for (size_t r = 0; r < n; r++)
+                            data.insert(data.end(), c.s[r].begin(), c.s[r].end());
+                        break;
+                    }
+                }
+                put<uint64_t>(meta, data_rel);
+                if (c.has_missing) {
+                    put<uint64_t>(meta, emit_array(data, c.present.data(), n));
+                } else {
+                    put<uint64_t>(meta, ~(uint64_t)0);
+                }
+            }
+        }
+
+        auto* res = new Result();
+        uint64_t header = 8 + 8;
+        uint64_t data_base = header + meta.size();
+        data_base = (data_base + 7) & ~(uint64_t)7;
+        uint64_t total = data_base + data.size();
+        res->buf.resize(total);
+        memcpy(res->buf.data(), &total, 8);
+        memcpy(res->buf.data() + 8, &data_base, 8);
+        memcpy(res->buf.data() + header, meta.data(), meta.size());
+        if (!data.empty())
+            memcpy(res->buf.data() + data_base, data.data(), data.size());
+        return res;
+    } catch (ParseErr& e) {
+        return fail(e.msg);
+    } catch (std::exception& e) {
+        return fail(std::string("internal: ") + e.what());
+    }
+}
+
+const uint8_t* lp_buf(void* h) { return ((Result*)h)->buf.data(); }
+size_t lp_size(void* h) { return ((Result*)h)->buf.size(); }
+void lp_free(void* h) { delete (Result*)h; }
+
+}  // extern "C"

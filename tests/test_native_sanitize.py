@@ -40,6 +40,29 @@ for n in (0, 1, 7, 63, 64, 65, 1000, 4097):
     out = codecs.decode(codecs.encode(i, ValueType.INTEGER),
                         ValueType.INTEGER)
     assert np.array_equal(out, i), f"i64 roundtrip n={n}"
+
+# line-protocol parser under sanitizers: valid, malformed, and
+# adversarial inputs (truncated escapes, unbalanced quotes, huge tokens)
+from cnosdb_tpu.protocol import native_lp
+assert native_lp.available()
+cases = [
+    "cpu,host=a usage=1.5,b=t,s=\"x\",c=3i,u=7u 1000\n" * 50,
+    "m v=1",                       # no trailing newline
+    "m \\",                        # trailing escape
+    'm s="unterminated 5\n',
+    "m,t=1 v=1 99999999999999999999999\n",   # ts overflow
+    "m," + "k=v," * 500 + "z=1 v=1 5\n",
+    "m v=" + "9" * 400 + "i 5\n",
+    "\x00\xff bin=1 5\n",
+    "#only comments\n\n\n",
+    "",
+]
+for c in cases:
+    native_lp.try_parse(c, 0, 1)   # must not crash; result may be None
+rnd = np.random.default_rng(11)
+for _ in range(200):               # random byte soup
+    blob = rnd.integers(32, 127, rnd.integers(1, 300)).astype(np.uint8)
+    native_lp.try_parse(blob.tobytes().decode("ascii"), 0, 1)
 print("SANITIZED ROUNDTRIPS OK")
 """
 
@@ -56,8 +79,13 @@ def test_codecs_under_asan(tmp_path):
         ["g++", "-print-file-name=libasan.so"], capture_output=True,
         text=True)
     asan_rt = probe.stdout.strip()
+    cxx = subprocess.run(
+        ["g++", "-print-file-name=libstdc++.so"], capture_output=True,
+        text=True).stdout.strip()
     env = dict(os.environ)
-    env["LD_PRELOAD"] = asan_rt
+    # libstdc++ after libasan: the __cxa_throw interceptor must find the
+    # real symbol at init or sanitized C++ exceptions abort
+    env["LD_PRELOAD"] = f"{asan_rt} {cxx}"
     env["ASAN_OPTIONS"] = "detect_leaks=0,abort_on_error=1"
     env["JAX_PLATFORMS"] = "cpu"
     env.pop("PALLAS_AXON_POOL_IPS", None)
