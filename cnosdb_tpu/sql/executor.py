@@ -1068,9 +1068,11 @@ class QueryExecutor:
                 scope = scope.filter(m)
             return scope
         if isinstance(item, ast.Join):
-            left = self._materialize_from(item.left, session)
-            right = self._materialize_from(item.right, session)
-            scope = rel.hash_join(left, right, item.kind, item.on)
+            scope = self._join_optimized(item, session)
+            if scope is None:
+                left = self._materialize_from(item.left, session)
+                right = self._materialize_from(item.right, session)
+                scope = rel.hash_join(left, right, item.kind, item.on)
             if pushed_where is not None:
                 m = np.asarray(pushed_where.eval(scope.env, np))
                 if not m.shape:
@@ -1078,6 +1080,33 @@ class QueryExecutor:
                 scope = scope.filter(m)
             return scope
         raise PlanError(f"unsupported FROM item {type(item).__name__}")
+
+    def _join_optimized(self, item: ast.Join, session: Session):
+        """Cost-based ordering for maximal inner-join trees (exact
+        cardinalities — relations are materialized; sql/join_order.py).
+        None → structure not proven safe, caller runs written order."""
+        from . import join_order
+
+        flat = join_order.flatten_inner(item)
+        if flat is None:
+            return None
+        leaf_items, conjuncts = flat
+        if len(leaf_items) < 3:   # nothing to reorder; don't materialize twice
+            return None
+        leaves = [self._materialize_from(li, session) for li in leaf_items]
+        if not join_order.reorderable(leaves, conjuncts):
+            # structural decline AFTER materialization: replay the written
+            # tree over the already-materialized leaves (no double scan)
+            it = iter(leaves)
+            return self._join_written(item, it)
+        return join_order.order_and_join(leaves, conjuncts)
+
+    def _join_written(self, item, leaf_iter) -> rel.Scope:
+        if isinstance(item, ast.Join):
+            left = self._join_written(item.left, leaf_iter)
+            right = self._join_written(item.right, leaf_iter)
+            return rel.hash_join(left, right, item.kind, item.on)
+        return next(leaf_iter)
 
     def _select_relational(self, stmt: ast.SelectStmt, session: Session):
         item = stmt.from_item or ast.TableRef(stmt.table, None, stmt.database)

@@ -1,0 +1,203 @@
+"""Cost-based inner-join ordering.
+
+The reference inherits DataFusion's join planning; this engine materializes
+relations eagerly, which allows something better than estimates: EXACT
+cardinalities. A maximal tree of INNER joins is flattened to (leaves,
+conjuncts); leaves materialize first, single-leaf conjuncts filter early,
+then a greedy order joins the smallest estimated intermediate next
+(|L|·|R| / max(ndv(keys)) with exact distinct counts on the key columns).
+
+Row and column order stay EXACTLY as the written-order plan would produce
+them: each leaf carries a hidden row-index column through the joins, and
+the final result is lexsorted by the written-order index tuple (a left-deep
+chain of the hash joins in sql/relational.py emits rows lexicographically
+ordered by leaf row indices, and filters only remove rows — so the sort
+reconstructs the written order bit for bit). The optimizer is therefore
+invisible except in time: any structural case it does not prove safe
+(outer joins, leaves without a unique qualifier) falls back to written
+order.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..models.strcol import DictArray
+from . import ast
+from .relational import Scope, _split_conjuncts, hash_join
+from .expr import BinOp, Expr
+
+_HIDDEN = "__jridx"
+
+
+def flatten_inner(item) -> tuple[list, list] | None:
+    """ast.Join tree of ONLY inner joins → (leaves, conjuncts); None when
+    any join in the tree is not inner (outer joins pin their order)."""
+    if isinstance(item, ast.Join):
+        if item.kind != "inner":
+            return None
+        l = flatten_inner(item.left)
+        r = flatten_inner(item.right)
+        if l is None or r is None:
+            return None
+        return l[0] + r[0], l[1] + r[1] + _split_conjuncts(item.on)
+    return [item], []
+
+
+def _ndv(arr) -> int:
+    """Exact distinct count of a key column (NDV); 1 on anything exotic —
+    a conservative default that only makes the optimizer less eager."""
+    try:
+        if isinstance(arr, DictArray):
+            return max(len(np.unique(arr.codes)), 1)
+        a = np.asarray(arr)
+        if a.dtype == object:
+            return max(len({x for x in a.tolist()}), 1)
+        return max(len(np.unique(a)), 1)
+    except Exception:
+        return 1
+
+
+def _conjunct_sides(c: Expr):
+    """Equi conjunct → (left_expr, right_expr, left_cols, right_cols)."""
+    if isinstance(c, BinOp) and c.op == "=":
+        lc, rc = c.left.columns(), c.right.columns()
+        if lc and rc:
+            return c.left, c.right, lc, rc
+    return None
+
+
+def _conjoin(cs: list[Expr]) -> Expr | None:
+    out = None
+    for c in cs:
+        out = c if out is None else BinOp("and", out, c)
+    return out
+
+
+def order_and_join(leaves: list[Scope], conjuncts: list[Expr]) -> Scope:
+    """Join materialized leaf scopes in a greedy cost order; returns a scope
+    whose rows/columns match the written-order left-deep join exactly.
+    Callers guarantee every leaf has exactly one qualifier."""
+    k = len(leaves)
+    # hidden written-order row index per leaf, riding the env through joins
+    for i, s in enumerate(leaves):
+        s.env[f"{_HIDDEN}{i}"] = np.arange(s.n, dtype=np.int64)
+
+    # single-leaf conjuncts filter at the source (same rows the written
+    # plan would drop post-join; relative row order is unchanged)
+    leaf_cols = [set(s.env) for s in leaves]
+    remaining: list[Expr] = []
+    for c in conjuncts:
+        cols = c.columns()
+        hit = [i for i in range(k) if cols <= leaf_cols[i]]
+        if hit:
+            i = hit[0]
+            m = np.asarray(c.eval(leaves[i].env, np))
+            if not m.shape:
+                m = np.full(leaves[i].n, bool(m))
+            leaves[i] = leaves[i].filter(m.astype(bool))
+        else:
+            remaining.append(c)
+
+    unused = set(range(k))
+    start = min(unused, key=lambda i: leaves[i].n)
+    cur = leaves[start]
+    unused.discard(start)
+    pending = list(remaining)
+    leaf_ndv: dict[tuple[int, str], int] = {}   # loop-invariant, cached
+
+    while unused:
+        best, best_cost, best_connected = None, None, False
+        cur_cols = set(cur.env)
+        cur_ndv: dict[str, int] = {}            # valid for this round only
+        for j in unused:
+            cost = float(cur.n) * float(leaves[j].n)
+            connected = False
+            combined = cur_cols | leaf_cols[j]
+            for c in pending:
+                sides = _conjunct_sides(c)
+                if sides is None or not (c.columns() <= combined):
+                    continue
+                le, re_, lc, rc = sides
+                for a, b, ae, be in ((lc, rc, le, re_), (rc, lc, re_, le)):
+                    if a <= cur_cols and b <= leaf_cols[j]:
+                        connected = True
+                        ck = str(ae)
+                        if ck not in cur_ndv:
+                            cur_ndv[ck] = _ndv(ae.eval(cur.env, np))
+                        lk = (j, str(be))
+                        if lk not in leaf_ndv:
+                            leaf_ndv[lk] = _ndv(be.eval(leaves[j].env, np))
+                        nd = max(cur_ndv[ck], leaf_ndv[lk])
+                        cost = min(cost,
+                                   float(cur.n) * float(leaves[j].n) / nd)
+                        break
+            # cross products only when nothing is connected
+            if best is None or (connected, ) > (best_connected, ) or (
+                    connected == best_connected and cost < best_cost):
+                best, best_cost, best_connected = j, cost, connected
+        j = best
+        unused.discard(j)
+        combined = set(cur.env) | leaf_cols[j]
+        applicable = [c for c in pending if c.columns() <= combined]
+        pending = [c for c in pending if c not in applicable]
+        kind = "inner" if applicable else "cross"
+        cur = hash_join(cur, leaves[j], kind, _conjoin(applicable))
+
+    if pending:   # conjuncts referencing columns no leaf provides
+        m = np.ones(cur.n, dtype=bool)
+        for c in pending:
+            mm = np.asarray(c.eval(cur.env, np))
+            m &= mm.astype(bool) if mm.shape else bool(mm)
+        cur = cur.filter(m)
+
+    # restore written-order rows: lexsort by (ridx_0, ..., ridx_{k-1});
+    # np.lexsort sorts by the LAST key primarily
+    ridx = [np.asarray(cur.env[f"{_HIDDEN}{i}"], dtype=np.int64)
+            for i in range(k)]
+    order = np.lexsort(ridx[::-1])
+    cur = cur.take(order)
+
+    # restore written-order columns and bare-name resolution
+    names, cols, env = [], [], {}
+    for i, leaf in enumerate(leaves):
+        (qual,) = leaf.quals
+        for n_ in leaf.names:
+            col = cur.env[f"{qual}.{n_}"]
+            names.append(n_)
+            cols.append(col)
+            env[f"{qual}.{n_}"] = col
+    for i in range(k - 1, -1, -1):   # earliest-written leaf wins bare names
+        (qual,) = leaves[i].quals
+        for n_ in leaves[i].names:
+            env[n_] = cur.env[f"{qual}.{n_}"]
+    out = Scope(names, cols, env)
+    out.quals = set().union(*(s.quals for s in leaves))
+    return out
+
+
+def reorderable(leaves: list[Scope], conjuncts: list[Expr]) -> bool:
+    """Safe to reorder: ≥3 leaves, each with exactly one qualifier, no
+    qualifier collisions, every display column reachable qualified, and no
+    conjunct referencing a name visible in more than one leaf (written-order
+    bare-name resolution depends on join position; rather than emulate it
+    mid-reorder, bail out)."""
+    if len(leaves) < 3:
+        return False
+    seen: set[str] = set()
+    for s in leaves:
+        if len(s.quals) != 1:
+            return False
+        (q,) = s.quals
+        if q in seen:
+            return False
+        seen.add(q)
+        if len(set(s.names)) != len(s.names):
+            return False   # duplicate display names inside one leaf
+        for n_ in s.names:
+            if f"{q}.{n_}" not in s.env:
+                return False
+    for c in conjuncts:
+        for col in c.columns():
+            if sum(1 for s in leaves if col in s.env) > 1:
+                return False
+    return True

@@ -305,12 +305,18 @@ def hash_join(left: Scope, right: Scope, kind: str,
     names, cols, env = [], [], {}
     taken_l = {k: _null_take(v, li) for k, v in left.env.items()}
     taken_r = {k: _null_take(v, ri) for k, v in right.env.items()}
-    for n_ in left.names:
+    # display columns POSITIONALLY: duplicate bare names (several `time`
+    # columns under SELECT *) must each keep their own values, which a
+    # name-keyed lookup would collapse to the leftmost; reuse the env take
+    # when the display column IS the env column (the common, unique case)
+    for n_, c in zip(left.names, left.cols):
         names.append(n_)
-        cols.append(taken_l[n_])
-    for n_ in right.names:
+        cols.append(taken_l[n_] if left.env.get(n_) is c
+                    else _null_take(c, li))
+    for n_, c in zip(right.names, right.cols):
         names.append(n_)
-        cols.append(taken_r[n_])
+        cols.append(taken_r[n_] if right.env.get(n_) is c
+                    else _null_take(c, ri))
     env.update(taken_r)
     env.update(taken_l)   # left wins bare-name collisions
     out = Scope(names, cols, env)
