@@ -163,6 +163,93 @@ def command_statement_update(sql: str) -> bytes:
                      _pb_bytes_field(1, sql.encode()))
 
 
+def command_prepared_statement_update(handle: bytes) -> bytes:
+    return _any_pack("CommandPreparedStatementUpdate",
+                     _pb_bytes_field(1, handle))
+
+
+# -------------------------------------------------------------- binding
+def _sql_literal(v) -> str:
+    """Render one bound parameter as a SQL literal. Strings quote with ''
+    doubling; a bound value can never escape its literal position."""
+    if v is None:
+        return "NULL"
+    if isinstance(v, (bool, np.bool_)):
+        return "true" if v else "false"
+    if isinstance(v, (int, np.integer)):
+        return str(int(v))
+    if isinstance(v, (float, np.floating)):
+        f = float(v)
+        if f != f or f in (float("inf"), float("-inf")):
+            raise ValueError("non-finite float parameters are unsupported")
+        return repr(f)
+    if isinstance(v, str):
+        return "'" + v.replace("'", "''") + "'"
+    raise ValueError(f"unsupported parameter type {type(v).__name__}")
+
+
+def count_placeholders(sql: str) -> int:
+    """Number of bindable `?` positions (same quote scan as bind_sql)."""
+    count = 0
+    i, n = 0, len(sql)
+    while i < n:
+        c = sql[i]
+        if c in ("'", '"'):
+            q = c
+            j = i + 1
+            while j < n:
+                if sql[j] == q:
+                    if j + 1 < n and sql[j + 1] == q:
+                        j += 2
+                        continue
+                    break
+                j += 1
+            i = j + 1
+            continue
+        if c == "?":
+            count += 1
+        i += 1
+    return count
+
+
+def bind_sql(sql: str, params: list) -> str:
+    """Substitute `?` placeholders with literals, skipping quoted strings
+    and quoted identifiers (the reference returns unimplemented here; this
+    engine binds). Raises on placeholder/parameter count mismatch."""
+    out = []
+    i, n = 0, len(sql)
+    it = iter(params)
+    used = 0
+    while i < n:
+        c = sql[i]
+        if c in ("'", '"'):
+            q = c
+            j = i + 1
+            while j < n:
+                if sql[j] == q:
+                    if j + 1 < n and sql[j + 1] == q:   # doubled quote
+                        j += 2
+                        continue
+                    break
+                j += 1
+            out.append(sql[i:min(j + 1, n)])
+            i = j + 1
+            continue
+        if c == "?":
+            try:
+                out.append(_sql_literal(next(it)))
+            except StopIteration:
+                raise ValueError("more placeholders than parameters")
+            used += 1
+            i += 1
+            continue
+        out.append(c)
+        i += 1
+    if used != len(params):
+        raise ValueError(f"{len(params)} parameters for {used} placeholders")
+    return "".join(out)
+
+
 # ---------------------------------------------------------------- arrow
 def result_to_arrow(rs: ResultSet) -> "pa.Table":
     arrays, names = [], []
@@ -231,6 +318,10 @@ if FLIGHT_AVAILABLE:
             # statement_handle → executed Table (one do_get consumes it)
             self._results: dict[bytes, "pa.Table"] = {}
             self._results_lock = threading.Lock()
+            # prepared handle → last bound parameter row (DoPut with a
+            # CommandPreparedStatementQuery descriptor binds; the next
+            # get_flight_info on that handle consumes the binding)
+            self._bound_params: dict[bytes, list] = {}
 
         # ------------------------------------------------------ execution
         def _execute(self, db: str, sql: str) -> "pa.Table":
@@ -307,8 +398,20 @@ if FLIGHT_AVAILABLE:
                     if not sql:
                         raise fl.FlightServerError(
                             "unknown prepared statement handle")
+                    run_sql = sql.decode()
+                    with self._results_lock:
+                        params = self._bound_params.get(handle)
+                    if params is not None:
+                        try:
+                            run_sql = bind_sql(run_sql, params)
+                        except ValueError as e:
+                            raise fl.FlightServerError(str(e))
+                        # the ticket handle embeds the BOUND sql so a
+                        # cache-evicted do_get re-derives the same rows
+                        handle = (db + b"\x00" + run_sql.encode() + b"\x00"
+                                  + secrets.token_hex(8).encode())
                     return self._info_for(
-                        descriptor, self._execute(db.decode(), sql.decode()),
+                        descriptor, self._execute(db.decode(), run_sql),
                         handle)
                 if kind in ("CommandGetCatalogs", "CommandGetDbSchemas",
                             "CommandGetTables"):
@@ -340,12 +443,12 @@ if FLIGHT_AVAILABLE:
         def do_action(self, context, action):
             """FlightSQL actions (reference flight_sql_server.rs:933
             do_action_create_prepared_statement /
-            do_action_close_prepared_statement). As in the reference,
-            parameter binding is not supported — the prepared handle is a
-            replayable (db, sql) recipe; preparing a READ statement runs
-            it once to advertise the TRUE dataset schema (JDBC drivers
-            prepare even ad-hoc statements); preparing DML/DDL is
-            side-effect free."""
+            do_action_close_prepared_statement). The prepared handle is a
+            replayable (db, sql) recipe; `?` placeholders bind via DoPut
+            (bind_sql — the reference returns unimplemented there);
+            preparing a READ statement runs it once to advertise the TRUE
+            dataset schema (JDBC drivers prepare even ad-hoc statements);
+            preparing DML/DDL is side-effect free."""
             body = action.body.to_pybytes() if action.body else b""
             parsed = _any_unpack(body)
             val = parsed[1] if parsed else body
@@ -366,13 +469,26 @@ if FLIGHT_AVAILABLE:
                 first_kw = (sql.lstrip().split(None, 1) or [""])[0].lower()
                 if first_kw in ("select", "show", "describe", "explain",
                                 "union"):
+                    # parameterized statements probe with NULL bindings —
+                    # same shape, no rows needed; an unprobeable form
+                    # (e.g. LIMIT ?) advertises schema at execute time
+                    n_params = count_placeholders(sql)
+                    probe_sql = bind_sql(sql, [None] * n_params) \
+                        if n_params else sql
                     try:
-                        probe = (f"SELECT * FROM ({sql}) __prep LIMIT 0"
-                                 if first_kw in ("select", "union") else sql)
-                        table = self._execute(db, probe)
+                        try:
+                            probe = (f"SELECT * FROM ({probe_sql}) __prep "
+                                     "LIMIT 0"
+                                     if first_kw in ("select", "union")
+                                     else probe_sql)
+                            table = self._execute(db, probe)
+                        except Exception:
+                            table = self._execute(db, probe_sql)
+                        schema_ipc = table.schema.serialize().to_pybytes()
                     except Exception:
-                        table = self._execute(db, sql)   # unwrappable form
-                    schema_ipc = table.schema.serialize().to_pybytes()
+                        if not n_params:
+                            raise
+                        schema_ipc = pa.schema([]).serialize().to_pybytes()
                 else:
                     schema_ipc = pa.schema([]).serialize().to_pybytes()
                 result = (_pb_bytes_field(1, handle)
@@ -385,6 +501,7 @@ if FLIGHT_AVAILABLE:
                 handle = _pb_parse(val).get(1, [b""])[0]
                 with self._results_lock:
                     self._results.pop(handle, None)
+                    self._bound_params.pop(handle, None)
                 return
             raise fl.FlightServerError(
                 f"unsupported action {action.type!r}")
@@ -395,16 +512,59 @@ if FLIGHT_AVAILABLE:
                     ("ClosePreparedStatement",
                      "release a prepared statement handle")]
 
+        def _read_param_rows(self, reader) -> list[list]:
+            """Drain the DoPut stream → list of parameter rows (positional
+            python values); empty stream → []."""
+            rows: list[list] = []
+            try:
+                while True:
+                    chunk = reader.read_chunk()
+                    batch = chunk.data
+                    if batch is None or batch.num_rows == 0:
+                        continue
+                    cols = [batch.column(i).to_pylist()
+                            for i in range(batch.num_columns)]
+                    for r in range(batch.num_rows):
+                        rows.append([c[r] for c in cols])
+            except StopIteration:
+                pass
+            return rows
+
+        def _affected(self, rs) -> int:
+            # DML returns a 1-row count cell (the real affected count);
+            # DDL returns a message row → 0 affected
+            if rs.names and rs.n_rows == 1:
+                v = rs.columns[0][0]
+                if isinstance(v, (int, np.integer)):
+                    return int(v)
+            elif rs.names:
+                return rs.n_rows
+            return 0
+
         def do_put(self, context, descriptor, reader, writer):
             """CommandStatementUpdate / CommandPreparedStatementUpdate →
-            execute, reply DoPutUpdateResult{record_count} in the metadata
-            stream (reference do_put_prepared_statement_update — how JDBC
-            runs DDL/DML)."""
+            execute (once per bound parameter row — JDBC executeBatch),
+            reply DoPutUpdateResult{record_count} in the metadata stream;
+            CommandPreparedStatementQuery → bind parameters for the next
+            get_flight_info on that handle (the reference returns
+            unimplemented for this one; here it binds)."""
             parsed = _any_unpack(descriptor.command or b"")
             if parsed is None:
                 raise fl.FlightServerError("unsupported DoPut descriptor")
             kind, val = parsed
             fields = _pb_parse(val)
+            if kind == "CommandPreparedStatementQuery":
+                handle = fields.get(1, [b""])[0]
+                rows = self._read_param_rows(reader)
+                if len(rows) > 1:
+                    raise fl.FlightServerError(
+                        "one parameter row expected for a query binding")
+                with self._results_lock:
+                    self._bound_params[handle] = rows[0] if rows else []
+                result = _any_pack("DoPutPreparedStatementResult",
+                                   _pb_bytes_field(1, handle))
+                writer.write(pa.py_buffer(result))
+                return
             if kind == "CommandStatementUpdate":
                 sql = fields.get(1, [b""])[0].decode()
                 db = "public"
@@ -412,28 +572,27 @@ if FLIGHT_AVAILABLE:
                     db = context.get_middleware("db").db
                 except Exception:
                     pass
+                param_rows = self._read_param_rows(reader)
             elif kind == "CommandPreparedStatementUpdate":
                 handle = fields.get(1, [b""])[0]
                 dbb, _, rest = handle.partition(b"\x00")
                 db, sql = dbb.decode(), rest.rsplit(b"\x00", 1)[0].decode()
+                param_rows = self._read_param_rows(reader)
             else:
                 raise fl.FlightServerError(
                     f"unsupported DoPut command {kind}")
-            try:
-                while True:
-                    reader.read_chunk()   # drain bound-parameter stream
-            except StopIteration:
-                pass
-            rs = self.executor.execute_one(sql, Session(database=db))
-            # DML returns a 1-row count cell (the real affected count);
-            # DDL returns a message row → 0 affected
             affected = 0
-            if rs.names and rs.n_rows == 1:
-                v = rs.columns[0][0]
-                if isinstance(v, (int, np.integer)):
-                    affected = int(v)
-            elif rs.names:
-                affected = rs.n_rows
+            try:
+                if param_rows:
+                    for row in param_rows:
+                        rs = self.executor.execute_one(
+                            bind_sql(sql, row), Session(database=db))
+                        affected += self._affected(rs)
+                else:
+                    rs = self.executor.execute_one(sql, Session(database=db))
+                    affected = self._affected(rs)
+            except ValueError as e:
+                raise fl.FlightServerError(str(e))
             update_result = _pb_varint((1 << 3) | 0) + _pb_varint(affected)
             writer.write(pa.py_buffer(update_result))
 

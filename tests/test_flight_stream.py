@@ -298,3 +298,97 @@ def test_flight_prepared_dml_no_side_effects_and_affected_count(db):
         assert int(rs.columns[0][0]) == 3
     finally:
         server.shutdown()
+
+
+def test_bind_sql_unit():
+    """Quote-aware `?` substitution (flight.bind_sql)."""
+    from cnosdb_tpu.server.flight import bind_sql
+    assert bind_sql("SELECT * FROM t WHERE v = ? AND h = ?", [1.5, "a'b"]) \
+        == "SELECT * FROM t WHERE v = 1.5 AND h = 'a''b'"
+    # ? inside string literals / quoted identifiers is not a placeholder
+    assert bind_sql("SELECT '?' , \"a?b\" FROM t WHERE x = ?", [7]) \
+        == "SELECT '?' , \"a?b\" FROM t WHERE x = 7"
+    assert bind_sql("SELECT 'it''s ?' FROM t WHERE b = ?", [True]) \
+        == "SELECT 'it''s ?' FROM t WHERE b = true"
+    assert bind_sql("x = ?", [None]) == "x = NULL"
+    import pytest as _pt
+    with _pt.raises(ValueError):
+        bind_sql("x = ?", [])
+    with _pt.raises(ValueError):
+        bind_sql("x = ?", [1, 2])
+
+
+def test_flight_prepared_statement_bound_parameters(db):
+    """DoPut(CommandPreparedStatementQuery) binds `?` parameters for the
+    next get_flight_info; DoPut(CommandPreparedStatementUpdate) with a
+    parameter batch executes once per row (JDBC executeBatch). The
+    reference returns unimplemented for query binding
+    (flight_sql_server.rs do_put_prepared_statement_query)."""
+    ex, _ = db
+    pytest.importorskip("pyarrow.flight")
+    import pyarrow as pa
+    import pyarrow.flight as fl
+
+    from cnosdb_tpu.server.flight import (
+        _any_unpack, _pb_parse, action_create_prepared_statement,
+        command_prepared_statement_query, command_prepared_statement_update,
+        start_flight_server,
+    )
+
+    ex.execute_one("CREATE TABLE bindp (v DOUBLE, TAGS(host))")
+    ex.execute_one("INSERT INTO bindp (time, host, v) VALUES "
+                   "(1, 'a', 1.5), (2, 'b', 2.5), (3, 'c', 3.5)")
+    port = _free_port()
+    server = start_flight_server(ex, port)
+    try:
+        client = fl.connect(f"grpc://127.0.0.1:{port}")
+        results = list(client.do_action(fl.Action(
+            "CreatePreparedStatement",
+            action_create_prepared_statement(
+                "SELECT host, v FROM bindp WHERE v > ? ORDER BY time"))))
+        handle = _pb_parse(_any_unpack(results[0].body.to_pybytes())[1])[1][0]
+
+        # bind v > 2.0 then execute through the handle
+        desc = fl.FlightDescriptor.for_command(
+            command_prepared_statement_query(handle))
+        params = pa.table({"p1": [2.0]})
+        writer, reader = client.do_put(desc, params.schema)
+        writer.write_table(params)
+        writer.done_writing()
+        assert reader.read() is not None    # DoPutPreparedStatementResult
+        writer.close()
+        info = client.get_flight_info(desc)
+        t = client.do_get(info.endpoints[0].ticket).read_all()
+        assert t.column("v").to_pylist() == [2.5, 3.5]
+
+        # rebind with a different value — the handle replays with new params
+        writer, reader = client.do_put(desc, params.schema)
+        writer.write_table(pa.table({"p1": [3.0]}))
+        writer.done_writing()
+        reader.read()
+        writer.close()
+        info = client.get_flight_info(desc)
+        t = client.do_get(info.endpoints[0].ticket).read_all()
+        assert t.column("v").to_pylist() == [3.5]
+
+        # batched prepared INSERT: one execution per parameter row
+        results = list(client.do_action(fl.Action(
+            "CreatePreparedStatement",
+            action_create_prepared_statement(
+                "INSERT INTO bindp (time, host, v) VALUES (?, ?, ?)"))))
+        ihandle = _pb_parse(_any_unpack(results[0].body.to_pybytes())[1])[1][0]
+        idesc = fl.FlightDescriptor.for_command(
+            command_prepared_statement_update(ihandle))
+        batch = pa.table({"t": [10, 11], "h": ["x", "y"], "v": [10.5, 11.5]})
+        writer, reader = client.do_put(idesc, batch.schema)
+        writer.write_table(batch)
+        writer.done_writing()
+        buf = reader.read()
+        writer.close()
+        assert buf is not None
+        rs = ex.execute_one("SELECT count(v) FROM bindp")
+        assert rs.columns[0].tolist() == [5]
+        rs = ex.execute_one("SELECT host FROM bindp WHERE time = 11")
+        assert rs.columns[0].tolist() == ["y"]
+    finally:
+        server.shutdown()
