@@ -764,50 +764,31 @@ def is_conjunctive(e) -> bool:
         return False
     if isinstance(e, UnaryOp) and e.op == "not" and _contains_and(e.operand):
         return False
-    for attr in ("left", "right", "operand", "expr", "low", "high"):
-        sub = getattr(e, attr, None)
-        if isinstance(sub, Expr) and not is_conjunctive(sub):
-            return False
-    args = getattr(e, "args", None)
-    if args:
-        return all(is_conjunctive(a) for a in args
-                   if isinstance(a, Expr))
-    return True
+    from ..sql.expr import iter_child_exprs
+
+    return all(is_conjunctive(c) for c in iter_child_exprs(e))
 
 
 def _contains_and(e) -> bool:
-    from ..sql.expr import BinOp
+    from ..sql.expr import BinOp, iter_child_exprs
 
     if isinstance(e, BinOp) and e.op == "and":
         return True
-    for attr in ("left", "right", "operand", "expr", "low", "high"):
-        sub = getattr(e, attr, None)
-        if isinstance(sub, Expr) and _contains_and(sub):
-            return True
-    args = getattr(e, "args", None)
-    if args:
-        return any(_contains_and(a) for a in args if isinstance(a, Expr))
-    return False
+    return any(_contains_and(c) for c in iter_child_exprs(e))
 
 
 def is_null_columns(e) -> set:
-    """Columns referenced INSIDE IS NULL nodes: validity masking must skip
-    exactly these — masking them defeats IS NULL, while skipping masking
-    for every other column lets its garbage NULL-slot values match."""
-    from ..sql.expr import IsNull
+    """Columns referenced INSIDE NULL-aware nodes (IS NULL, CASE):
+    validity masking must skip exactly these — masking them defeats the
+    node's own NULL handling, while skipping masking for every other
+    column lets its garbage NULL-slot values match."""
+    from ..sql.expr import Case, IsNull, iter_child_exprs
 
-    out: set = set()
-    if isinstance(e, IsNull):
+    if isinstance(e, (IsNull, Case)):
         return set(e.columns())
-    for attr in ("left", "right", "operand", "expr", "low", "high"):
-        sub = getattr(e, attr, None)
-        if isinstance(sub, Expr):
-            out |= is_null_columns(sub)
-    args = getattr(e, "args", None)
-    if args:
-        for a in args:
-            if isinstance(a, Expr):
-                out |= is_null_columns(a)
+    out: set = set()
+    for c in iter_child_exprs(e):
+        out |= is_null_columns(c)
     return out
 
 

@@ -13,8 +13,8 @@ from __future__ import annotations
 
 from ..errors import PlanError
 from . import ast
-from .expr import Between, BinOp, Cast, Column, Expr, Func, InList, IsNull, \
-    Like, Literal, UnaryOp
+from .expr import Between, BinOp, Case, Cast, Column, Expr, Func, InList, \
+    IsNull, Like, Literal, UnaryOp
 
 _SELECTOR_FUNCS = ("topk", "bottom")
 
@@ -127,15 +127,9 @@ def _selector_args(f: Func):
 # expression-tree plumbing
 # ---------------------------------------------------------------------------
 def _children(e) -> list:
-    out = []
-    for attr in ("left", "right", "operand", "expr", "low", "high"):
-        sub = getattr(e, attr, None)
-        if isinstance(sub, Expr):
-            out.append(sub)
-    args = getattr(e, "args", None)
-    if args:
-        out.extend(a for a in args if isinstance(a, Expr))
-    return out
+    from .expr import iter_child_exprs
+
+    return list(iter_child_exprs(e))
 
 
 def _map_children(e, fn):
@@ -170,6 +164,15 @@ def _map_children(e, fn):
     if isinstance(e, Cast):
         x = fn(e.expr)
         return e if x is e.expr else Cast(x, e.target, e.safe)
+    if isinstance(e, Case):
+        op = fn(e.operand) if isinstance(e.operand, Expr) else e.operand
+        whens = [(fn(c), fn(r)) for c, r in e.whens]
+        els = fn(e.else_) if isinstance(e.else_, Expr) else e.else_
+        if op is e.operand and els is e.else_ and all(
+                a is c and b is r
+                for (a, b), (c, r) in zip(whens, e.whens)):
+            return e
+        return Case(op, whens, els)
     return e
 
 

@@ -30,6 +30,7 @@ from ..ops.tpu_exec import AggSpec, TpuQuery, execute_scan_aggregate
 from ..parallel.coordinator import Coordinator
 from ..parallel.meta import MetaStore
 from . import ast
+from . import expr as expr_mod
 from . import relational as rel
 from .expr import (
     Column, Expr, Func, InList, InSubquery, Literal, Subquery, WindowFunc,
@@ -1525,7 +1526,7 @@ class QueryExecutor:
                 # keys must see the NULLs (rendered as None/nan) or NULLs
                 # order by their slot garbage
                 ovv = np.ones(n_rows, dtype=bool)
-                for c in oe.columns():
+                for c in expr_mod.propagating_columns(oe):
                     vk = f"__valid__:{c}"
                     if vk in env:
                         ovv &= env[vk]
@@ -1551,7 +1552,7 @@ class QueryExecutor:
                     v = np.full(n_rows, v)
                 out_cols[i].append(np.asarray(v))
                 vv = np.ones(n_rows, dtype=bool)
-                for c in expr.columns():
+                for c in expr_mod.propagating_columns(expr):
                     vk = f"__valid__:{c}"
                     if vk in env:
                         vv &= env[vk]
@@ -1773,7 +1774,7 @@ def _render_output(plan, env: dict, n: int):
             v = np.full(n, v)
         arr = np.asarray(v)
         vv = np.ones(n, dtype=bool)
-        for c in expr.columns():
+        for c in expr_mod.propagating_columns(expr):
             vk = f"__valid__:{c}"
             if vk in env and len(env[vk]) == n:
                 vv &= env[vk]

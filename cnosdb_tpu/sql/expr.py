@@ -368,8 +368,13 @@ class IsNull(Expr):
                 valid = env[key]
                 return valid if self.negated else ~valid
         v = self.expr.eval(env, xp)
-        if getattr(v, "dtype", None) is not None and v.dtype.kind == "f":
+        dt = getattr(v, "dtype", None)
+        if dt is not None and dt.kind == "f":
             m = xp.isnan(v)
+        elif dt is not None and dt == object:
+            # join-filled columns ride as object arrays with None holes
+            m = np.array([x is None or (isinstance(x, float) and x != x)
+                          for x in v], dtype=bool)
         else:
             m = xp.zeros(getattr(v, "shape", (1,)), dtype=bool)
         return ~m if self.negated else m
@@ -686,6 +691,182 @@ _CAST_KINDS = {"BIGINT": "i", "INT": "i", "INTEGER": "i",
                "DOUBLE": "f", "FLOAT": "f",
                "STRING": "s", "VARCHAR": "s", "TEXT": "s",
                "BOOLEAN": "b", "BOOL": "b", "TIMESTAMP": "t"}
+
+
+def iter_child_exprs(e):
+    """Every direct child Expr of a node — the ONE traversal helper all
+    tree walks share (attr children, Func args, CASE arms)."""
+    for attr in ("left", "right", "operand", "expr", "low", "high",
+                 "else_"):
+        c = getattr(e, attr, None)
+        if isinstance(c, Expr):
+            yield c
+    for a in getattr(e, "args", None) or []:
+        if isinstance(a, Expr):
+            yield a
+    for c, r in getattr(e, "whens", None) or []:
+        if isinstance(c, Expr):
+            yield c
+        if isinstance(r, Expr):
+            yield r
+
+
+def propagating_columns(e) -> set:
+    """Columns whose NULLs propagate to this expression's result — i.e.
+    every referenced column EXCEPT those only seen inside NULL-aware nodes
+    (IS NULL, CASE), which define their own NULL behavior. The executor's
+    blanket NULL-out mask uses this instead of columns() so
+    `CASE WHEN i IS NULL THEN -1 ...` can map NULL to a value."""
+    if isinstance(e, (IsNull, Case)):
+        return set()
+    if not isinstance(e, Expr):
+        return set()
+    out = set()
+    if isinstance(e, Column):
+        out.add(e.name)
+    for attr in ("left", "right", "operand", "expr", "low", "high"):
+        child = getattr(e, attr, None)
+        if isinstance(child, Expr):
+            out |= propagating_columns(child)
+    for a in getattr(e, "args", None) or []:
+        out |= propagating_columns(a)
+    return out
+
+
+@dataclass(repr=False)
+class Case(Expr):
+    """CASE [operand] WHEN cond/value THEN result ... [ELSE d] END
+    (reference: DataFusion Expr::Case). First matching arm wins; no match
+    and no ELSE → NULL. NULL conditions/operands never match (3VL)."""
+
+    operand: Expr | None
+    whens: list            # [(cond_or_value, result_expr)]
+    else_: Expr | None = None
+
+    @staticmethod
+    def _env_invalid(e, env, n):
+        """Rows where any NULL-propagating column of `e` is invalid."""
+        invalid = None
+        for c in propagating_columns(e):
+            key = f"__valid__:{c}"
+            if key in env and len(env[key]) == n:
+                bad = ~np.asarray(env[key], dtype=bool)
+                invalid = bad if invalid is None else (invalid | bad)
+        return invalid
+
+    def _conds(self, env, xp, n):
+        base = self.operand.eval(env, xp) if self.operand is not None \
+            else None
+        base_bad = (self._env_invalid(self.operand, env, n)
+                    if self.operand is not None else None)
+        for cond, _ in self.whens:
+            if self.operand is not None:
+                m = _eq(xp, base, cond.eval(env, xp))
+                cond_bad = self._env_invalid(cond, env, n)
+            else:
+                m = cond.eval(env, xp)
+                cond_bad = self._env_invalid(cond, env, n)
+            m = np.asarray(m)
+            if m.dtype == object:
+                m = np.array([bool(x) if x is not None else False
+                              for x in m], dtype=bool)
+            elif m.dtype.kind == "f":
+                m = ~np.isnan(m) & (m != 0)
+            else:
+                m = m.astype(bool)
+            if not m.shape:
+                m = np.full(n, bool(m))
+            # 3VL: a NULL operand or NULL in the condition's propagating
+            # columns never matches (typed NULL slots carry garbage)
+            for bad in (base_bad, cond_bad):
+                if bad is not None:
+                    m = m & ~bad
+            yield m
+
+    def _arm_values(self, e, env, xp, n, pick):
+        """Values of one arm for the picked rows. Full-vector eval when it
+        succeeds; an arm that errors on rows its WHEN excludes (CAST over
+        a guarded Inf row) re-evaluates on the picked subset only."""
+        def vec(e_, env_, n_):
+            if e_ is None:
+                return np.full(n_, None, dtype=object)
+            v = e_.eval(env_, xp)
+            v = np.asarray(v.materialize() if hasattr(v, "materialize")
+                           else v)
+            if not v.shape:
+                v = np.full(n_, v[()])
+            if v.dtype != object:
+                v = v.astype(object)
+            vf = v.copy()
+            nanm = [isinstance(x, float) and x != x for x in vf]
+            if any(nanm):
+                vf[nanm] = None
+            bad = self._env_invalid(e_, env_, n_)
+            if bad is not None and bad.any():
+                vf[bad] = None
+            return vf
+
+        try:
+            return vec(e, env, n)[pick]
+        except Exception:
+            if pick.all():
+                raise
+            k = int(pick.sum())
+            sub = {key: (v[pick] if hasattr(v, "__len__")
+                         and not isinstance(v, (str, bytes))
+                         and len(v) == n else v)
+                   for key, v in env.items()}
+            return vec(e, sub, k)
+
+    def eval(self, env, xp):
+        # row count from any column in scope (scalar-only CASE gets n=1)
+        n = 1
+        for vv in env.values():
+            if hasattr(vv, "__len__") and not isinstance(vv, (str, bytes)):
+                n = len(vv)
+                break
+        result = np.full(n, None, dtype=object)
+        taken = np.zeros(n, dtype=bool)
+        for m, (_, res) in zip(self._conds(env, xp, n), self.whens):
+            pick = m & ~taken
+            taken |= m
+            if pick.any():
+                result[pick] = self._arm_values(res, env, xp, n, pick)
+        rest = ~taken
+        if self.else_ is not None and rest.any():
+            result[rest] = self._arm_values(self.else_, env, xp, n, rest)
+        # downcast homogeneous results so renders stay native (5 not 5.0)
+        vals = [x for x in result if x is not None]
+        if vals and len(vals) == n:
+            if all(isinstance(x, (bool, np.bool_)) for x in vals):
+                return np.array([bool(x) for x in result])
+            if all(isinstance(x, (int, np.integer))
+                   and not isinstance(x, (bool, np.bool_)) for x in vals):
+                return np.array([int(x) for x in result], dtype=np.int64)
+            if all(isinstance(x, (float, np.floating)) for x in vals):
+                return np.array([float(x) for x in result])
+        return result
+
+    def columns(self):
+        out = set()
+        if self.operand is not None:
+            out |= self.operand.columns()
+        for c, r in self.whens:
+            out |= c.columns() | r.columns()
+        if self.else_ is not None:
+            out |= self.else_.columns()
+        return out
+
+    def to_sql(self):
+        parts = ["CASE"]
+        if self.operand is not None:
+            parts.append(self.operand.to_sql())
+        for c, r in self.whens:
+            parts.append(f"WHEN {c.to_sql()} THEN {r.to_sql()}")
+        if self.else_ is not None:
+            parts.append(f"ELSE {self.else_.to_sql()}")
+        parts.append("END")
+        return " ".join(parts)
 
 
 @dataclass(repr=False)

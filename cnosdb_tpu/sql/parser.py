@@ -1101,6 +1101,29 @@ class Parser:
                 from .expr import Cast
 
                 return Cast(e, tname, safe=(k == "TRY_CAST"))
+            if k == "CASE":
+                # CASE [operand] WHEN v THEN r ... [ELSE d] END — searched
+                # and simple forms (reference: DataFusion Expr::Case)
+                self.next()
+                operand = None
+                if self.kw() != "WHEN":
+                    operand = self.parse_expr()
+                whens = []
+                while self.kw() == "WHEN":
+                    self.next()
+                    cond = self.parse_expr()
+                    self.expect_kw("THEN")
+                    whens.append((cond, self.parse_expr()))
+                if not whens:
+                    raise ParserError("CASE requires at least one WHEN")
+                else_ = None
+                if self.kw() == "ELSE":
+                    self.next()
+                    else_ = self.parse_expr()
+                self.expect_kw("END")
+                from .expr import Case
+
+                return Case(operand, whens, else_)
             if k in _RESERVED:
                 raise ParserError(f"unexpected keyword {t.value!r} in expression")
             name = self.next().value
@@ -1167,14 +1190,21 @@ def _const_eval(e: Expr):
     if isinstance(e, UnaryOp) and e.op == "-":
         v = _const_eval(e.operand)
         return -v
-    if isinstance(e, Func):
+    if isinstance(e, (Func, BinOp)):
         import numpy as np
 
-        return e.eval({}, np)
-    if isinstance(e, BinOp):
-        import numpy as np
-
-        return e.eval({}, np)
+        v = e.eval({}, np)
+        # numpy scalars/0-d arrays must become python values: they ride
+        # into WriteBatches (msgpack) and schema type checks
+        if isinstance(v, np.ndarray):
+            v = v[()] if v.shape == () else v.tolist()
+        if isinstance(v, np.floating):
+            return float(v)
+        if isinstance(v, np.integer):
+            return int(v)
+        if isinstance(v, np.bool_):
+            return bool(v)
+        return v
     raise ParserError(f"expected literal value, got {e!r}")
 
 

@@ -21,8 +21,8 @@ from ..models.schema import TskvTableSchema, ValueType
 from ..ops.tpu_exec import AggSpec
 from . import ast
 from .expr import (
-    Between, BinOp, Column, Expr, Func, InList, IsNull, Literal, UnaryOp,
-    extract_domains,
+    Between, BinOp, Case, Cast, Column, Expr, Func, InList, IsNull, Literal,
+    UnaryOp, extract_domains,
 )
 from .parser import parse_timestamp_string
 
@@ -215,16 +215,11 @@ def _validate_columns(stmt: ast.SelectStmt, schema: TskvTableSchema):
 
 
 def _contains_agg(e) -> bool:
+    from .expr import iter_child_exprs
+
     if isinstance(e, Func) and e.name.lower() in AGG_FUNCS:
         return True
-    for attr in ("left", "right", "operand", "expr", "low", "high"):
-        sub = getattr(e, attr, None)
-        if isinstance(sub, Expr) and _contains_agg(sub):
-            return True
-    for a in getattr(e, "args", None) or []:
-        if isinstance(a, Expr) and _contains_agg(a):
-            return True
-    return False
+    return any(_contains_agg(c) for c in iter_child_exprs(e))
 
 
 def _is_bucket_func(e) -> bool:
@@ -273,6 +268,23 @@ class _AggCollector:
             return UnaryOp(e.op, self.rewrite(e.operand))
         if isinstance(e, Func):
             return Func(e.name, [self.rewrite(a) for a in e.args])
+        if isinstance(e, Case):
+            return Case(
+                self.rewrite(e.operand) if isinstance(e.operand, Expr)
+                else e.operand,
+                [(self.rewrite(c), self.rewrite(r)) for c, r in e.whens],
+                self.rewrite(e.else_) if isinstance(e.else_, Expr)
+                else e.else_)
+        if isinstance(e, IsNull):
+            return IsNull(self.rewrite(e.expr), e.negated)
+        if isinstance(e, Between):
+            return Between(self.rewrite(e.expr), self.rewrite(e.low),
+                           self.rewrite(e.high), e.negated)
+        if isinstance(e, InList):
+            return InList(self.rewrite(e.expr), e.values, e.negated,
+                          e.null_present)
+        if isinstance(e, Cast):
+            return Cast(self.rewrite(e.expr), e.target, e.safe)
         return e
 
     def _register(self, f: Func) -> str:

@@ -372,10 +372,50 @@ def host_aggregate(func: str, col, gid: np.ndarray, n_groups: int,
             return out
         return np.bincount(g, minlength=n_groups).astype(np.int64)
     if func in ("sum", "avg", "mean"):
-        s = np.bincount(g, weights=v.astype(np.float64), minlength=n_groups)
-        if func == "sum":
-            return s
         c = np.bincount(g, minlength=n_groups)
+        if func == "sum":
+            # integer columns sum in their own arithmetic (exact past
+            # 2^53, and 12 must not render as 12.0); object columns of
+            # NULL-bearing ints get the same treatment — this matches the
+            # fused kernel path and DataFusion's Sum(Int64) → Int64
+            acc_dtype = None
+            if np.issubdtype(col.dtype, np.integer):
+                acc_dtype = np.uint64 if col.dtype.kind == "u" else np.int64
+            elif col.dtype == object and len(v) and all(
+                    isinstance(x, (int, np.integer))
+                    and not isinstance(x, (bool, np.bool_)) for x in v):
+                acc_dtype = np.int64
+            if acc_dtype is not None:
+                try:
+                    vi = v.astype(acc_dtype)
+                    # wrap guard: if |max| * largest-group-count could
+                    # exceed the accumulator, sum exactly in python ints
+                    # (DataFusion errors here; exact beats both)
+                    lim = 2**64 - 1 if acc_dtype == np.uint64 else 2**63 - 1
+                    mx = max(abs(int(vi.min())), abs(int(vi.max()))) \
+                        if len(vi) else 0
+                    if mx and mx > lim // max(int(c.max()), 1):
+                        out = np.full(n_groups, None, dtype=object)
+                        accs: dict[int, int] = {}
+                        for gi, val in zip(g.tolist(), vi.tolist()):
+                            accs[gi] = accs.get(gi, 0) + int(val)
+                        for gi, s_ in accs.items():
+                            out[gi] = s_
+                        return out
+                    acc = np.zeros(n_groups, dtype=acc_dtype)
+                    np.add.at(acc, g, vi)
+                    if (c == 0).any():   # SUM over no rows is NULL
+                        out = acc.astype(object)
+                        out[c == 0] = None
+                        return out
+                    return acc
+                except (OverflowError, ValueError):
+                    pass   # out-of-range values: fall through to float
+            s = np.bincount(g, weights=v.astype(np.float64),
+                            minlength=n_groups)
+            s[c == 0] = np.nan   # renders as NULL
+            return s
+        s = np.bincount(g, weights=v.astype(np.float64), minlength=n_groups)
         with np.errstate(invalid="ignore", divide="ignore"):
             out = s / np.maximum(c, 1)
         out[c == 0] = np.nan
@@ -426,7 +466,7 @@ def host_aggregate(func: str, col, gid: np.ndarray, n_groups: int,
 # ---------------------------------------------------------------------------
 # expression tree utilities (agg / window discovery + rewrite)
 # ---------------------------------------------------------------------------
-_CHILD_ATTRS = ("left", "right", "operand", "expr", "low", "high")
+_CHILD_ATTRS = ("left", "right", "operand", "expr", "low", "high", "else_")
 
 
 def walk_exprs(e, fn):
@@ -440,6 +480,9 @@ def walk_exprs(e, fn):
             walk_exprs(child, fn)
     for a in getattr(e, "args", None) or []:
         walk_exprs(a, fn)
+    for c, r in getattr(e, "whens", None) or []:   # CASE arms
+        walk_exprs(c, fn)
+        walk_exprs(r, fn)
 
 
 def rewrite_exprs(e, pred, replace):
@@ -455,6 +498,10 @@ def rewrite_exprs(e, pred, replace):
             setattr(out, attr, rewrite_exprs(child, pred, replace))
     if getattr(e, "args", None):
         out.args = [rewrite_exprs(a, pred, replace) for a in e.args]
+    if getattr(e, "whens", None):
+        out.whens = [(rewrite_exprs(c, pred, replace),
+                      rewrite_exprs(r, pred, replace))
+                     for c, r in e.whens]
     return out
 
 

@@ -341,3 +341,77 @@ def test_join_reorder_outer_falls_back(db3):
     b = _written_order(db3, sql)
     for ca, cb in zip(a.columns, b.columns):
         assert [str(x) for x in ca.tolist()] == [str(x) for x in cb.tolist()]
+
+
+# ---------------------------------------------------------------------------
+# CASE integration across traversals (review findings, round 3)
+# ---------------------------------------------------------------------------
+def test_case_null_aware_in_where(db):
+    """CASE WHEN i IS NULL THEN true END as a FILTER must keep NULL rows
+    (post-hoc validity masking must skip CASE-referenced columns)."""
+    db.execute_one("CREATE TABLE cw (i BIGINT, TAGS(h))")
+    db.execute_one("INSERT INTO cw (time, h, i) VALUES "
+                   "(1,'a',5),(2,'a',NULL),(3,'b',NULL)")
+    rs = db.execute_one(
+        "SELECT time FROM cw WHERE CASE WHEN i IS NULL THEN true "
+        "ELSE false END ORDER BY time")
+    assert rs.columns[0].tolist() == [2, 3]
+
+
+def test_case_agg_inside(db):
+    """An aggregate whose only appearance is inside CASE still makes the
+    query an aggregate query."""
+    db.execute_one("CREATE TABLE ca (i BIGINT, TAGS(h))")
+    db.execute_one("INSERT INTO ca (time, h, i) VALUES "
+                   "(1,'a',5),(2,'a',3)")
+    rs = db.execute_one(
+        "SELECT CASE WHEN sum(i) > 5 THEN 'big' ELSE 'small' END AS s "
+        "FROM ca")
+    assert rs.columns[0].tolist() == ["big"]
+
+
+def test_case_simple_null_operand_never_matches(db):
+    """CASE i WHEN 0 THEN ... with NULL i must take ELSE (garbage in the
+    typed NULL slot must not match)."""
+    db.execute_one("CREATE TABLE cn (i BIGINT, TAGS(h))")
+    db.execute_one("INSERT INTO cn (time, h, i) VALUES "
+                   "(1,'a',0),(2,'a',NULL)")
+    rs = db.execute_one(
+        "SELECT time, CASE i WHEN 0 THEN 'zero' ELSE 'other' END AS s "
+        "FROM cn ORDER BY time")
+    assert rs.columns[1].tolist() == ["zero", "other"]
+
+
+def test_case_guarded_arm_error(db):
+    """An arm that errors on rows its WHEN excludes must not abort."""
+    db.execute_one("CREATE TABLE cg (f DOUBLE, TAGS(h))")
+    db.execute_one("INSERT INTO cg (time, h, f) VALUES "
+                   "(1,'a',2.5),(2,'a',1.0/0)")
+    rs = db.execute_one(
+        "SELECT time, CASE WHEN f < 1000000 THEN CAST(f AS BIGINT) "
+        "ELSE -1 END AS v FROM cg ORDER BY time")
+    assert rs.columns[1].tolist() == [2, -1]
+
+
+def test_int_sum_overflow_exact(db):
+    """Integer SUM past int64 must be exact (python-int accumulation),
+    not a silent wrap."""
+    db.execute_one("CREATE TABLE ov (i BIGINT, TAGS(h))")
+    big = 2**62
+    db.execute_one(f"INSERT INTO ov (time, h, i) VALUES "
+                   f"(1,'a',{big}),(2,'a',{big}),(3,'a',{big})")
+    # relational path (join) to hit host_aggregate
+    db.execute_one("CREATE TABLE ovd (TAGS(h))")
+    db.execute_one("INSERT INTO ovd (time, h) VALUES (1,'a')")
+    rs = db.execute_one(
+        "SELECT sum(ov.i) FROM ov JOIN ovd ON ov.h = ovd.h")
+    assert rs.columns[0].tolist() == [3 * big]
+
+
+def test_case_in_analyzer_rewrites(db):
+    """exact_count inside a CASE arm still rewrites to count."""
+    db.execute_one("CREATE TABLE cr (i BIGINT, TAGS(h))")
+    db.execute_one("INSERT INTO cr (time, h, i) VALUES (1,'a',5),(2,'a',7)")
+    rs = db.execute_one(
+        "SELECT CASE WHEN exact_count(i) = 2 THEN 'two' END AS s FROM cr")
+    assert rs.columns[0].tolist() == ["two"]
