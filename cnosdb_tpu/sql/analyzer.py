@@ -24,8 +24,37 @@ def analyze(stmt):
     if not isinstance(stmt, ast.SelectStmt):
         return stmt
     stmt = rewrite_exact_count(stmt)
+    stmt = rewrite_null_functions(stmt)
     stmt = rewrite_selector_functions(stmt)
     return stmt
+
+
+# ---------------------------------------------------------------------------
+# coalesce/ifnull/nvl/nullif → CASE (NULL-aware by construction)
+# ---------------------------------------------------------------------------
+def rewrite_null_functions(stmt):
+    """Desugar the NULL-choosing scalar set into CASE, whose evaluation
+    consults validity masks (reference: DataFusion built-ins coalesce /
+    nullif; ifnull/nvl are the common aliases). coalesce(a, b, c) →
+    CASE WHEN a IS NOT NULL THEN a WHEN b IS NOT NULL THEN b ELSE c END;
+    nullif(a, b) → CASE WHEN a = b THEN NULL ELSE a END."""
+    def rw(e):
+        if isinstance(e, Func) and e.name.lower() in (
+                "coalesce", "ifnull", "nvl", "nullif"):
+            name = e.name.lower()
+            args = [rw(a) if isinstance(a, Expr) else a for a in e.args]
+            if name == "nullif":
+                if len(args) != 2:
+                    raise PlanError("nullif takes exactly two arguments")
+                return Case(None, [(BinOp("=", args[0], args[1]),
+                                    Literal(None))], args[0])
+            if len(args) < 2:
+                raise PlanError(f"{name} takes at least two arguments")
+            whens = [(IsNull(a, negated=True), a) for a in args[:-1]]
+            return Case(None, whens, args[-1])
+        return _map_children(e, rw)
+
+    return _map_stmt_exprs(stmt, rw)
 
 
 # ---------------------------------------------------------------------------
@@ -182,8 +211,10 @@ def _map_stmt_exprs(stmt, fn):
     items = [ast.SelectItem(fn(it.expr) if isinstance(it.expr, Expr)
                             else it.expr, it.alias) for it in stmt.items]
     having = fn(stmt.having) if isinstance(stmt.having, Expr) else stmt.having
+    where = fn(stmt.where) if isinstance(stmt.where, Expr) else stmt.where
     order_by = [(fn(oe) if isinstance(oe, Expr) else oe, asc)
                 for oe, asc in stmt.order_by]
     group_by = [fn(g) if isinstance(g, Expr) else g for g in stmt.group_by]
     return dataclasses.replace(stmt, items=items, having=having,
-                               order_by=order_by, group_by=group_by)
+                               where=where, order_by=order_by,
+                               group_by=group_by)
