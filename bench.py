@@ -43,16 +43,23 @@ INTERVAL_NS = 10 * 10**9          # 10s cadence
 BUCKET_NS = 3600 * 10**9          # 1h buckets
 BASE_TS = 1_640_995_200_000_000_000  # 2022-01-01
 CHUNK = 250_000
+LOAD_WORKERS = 8
+SHARDS = 8
 
 
 def build_dataset(coord, tenant, db):
+    from concurrent.futures import ThreadPoolExecutor
+
     from cnosdb_tpu.models.points import SeriesRows, WriteBatch
     from cnosdb_tpu.models.schema import ValueType
     from cnosdb_tpu.models.series import SeriesKey
 
-    rng = np.random.default_rng(123)
     t0 = time.perf_counter()
-    for h in range(N_HOSTS):
+
+    def load_host(h):
+        # per-worker rng: the oracles read the STORED data back, so only
+        # determinism per host matters, not the global sequence
+        rng = np.random.default_rng(123 + h)
         key = SeriesKey("cpu", {"hostname": f"host_{h:03d}"})
         for off in range(0, N_PER_HOST, CHUNK):
             n = min(CHUNK, N_PER_HOST - off)
@@ -68,6 +75,12 @@ def build_dataset(coord, tenant, db):
                 {"usage_user": (int(ValueType.FLOAT), user),
                  "usage_system": (int(ValueType.FLOAT), syst)}))
             coord.write_points(tenant, db, wb)
+
+    # parallel load, like the reference's 24-worker TSBS loader
+    # (benchmark/shell_env.sh:18-27); series-hash sharding spreads hosts
+    # over vnodes so writers rarely contend on one vnode lock
+    with ThreadPoolExecutor(max_workers=LOAD_WORKERS) as pool:
+        list(pool.map(load_host, range(N_HOSTS)))
     coord.engine.flush_all()
     # load throughput = durable + queryable (reference TSBS load measures
     # the same: background compaction continues async). The full compact
@@ -411,6 +424,8 @@ def main():
         session = Session(database="public")
 
         n_rows = N_HOSTS * N_PER_HOST
+        executor.execute_one(f"ALTER DATABASE public SET SHARD {SHARDS}",
+                             session)
         ingest_s, compact_s = build_dataset(coord, DEFAULT_TENANT, "public")
         print(f"# ingested {n_rows} rows in {ingest_s:.1f}s "
               f"({n_rows/ingest_s/1e6:.2f}M rows/s); "

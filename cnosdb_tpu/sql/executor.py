@@ -974,7 +974,7 @@ class QueryExecutor:
         found = []
 
         def spot(e):
-            if isinstance(e, (Subquery, InSubquery)):
+            if isinstance(e, (Subquery, InSubquery, expr_mod.Exists)):
                 found.append(e)
 
         exprs = [it.expr for it in stmt.items if isinstance(it.expr, Expr)]
@@ -988,6 +988,9 @@ class QueryExecutor:
             q = e.select
             rs = self._union(q, session) if isinstance(q, ast.UnionStmt) \
                 else self._select(q, session)
+            if isinstance(e, expr_mod.Exists):
+                hit = rs.n_rows > 0
+                return Literal((not hit) if e.negated else hit)
             if isinstance(e, Subquery):
                 if len(rs.columns) != 1 or rs.n_rows > 1:
                     raise QueryError(
@@ -1007,7 +1010,8 @@ class QueryExecutor:
         import copy as _copy
 
         out = _copy.copy(stmt)
-        pred = lambda e: isinstance(e, (Subquery, InSubquery))  # noqa: E731
+        pred = lambda e: isinstance(  # noqa: E731
+            e, (Subquery, InSubquery, expr_mod.Exists))
         out.items = [ast.SelectItem(rel.rewrite_exprs(it.expr, pred, replace)
                                     if isinstance(it.expr, Expr) else it.expr,
                                     it.alias) for it in stmt.items]

@@ -283,7 +283,10 @@ class UnaryOp(Expr):
                 fm = _eval_false_mask(self.operand, env, xp)
                 if isinstance(fm, np.ndarray):
                     return fm
-            return ~self.operand.eval(env, xp)
+            v = self.operand.eval(env, xp)
+            if isinstance(v, (bool, np.bool_)):
+                return not v   # ~True is -2 (bitwise), not False
+            return ~v
         v = self.operand.eval(env, xp)
         if self.op == "-":
             return -v
@@ -993,6 +996,26 @@ class InSubquery(Expr):
     def to_sql(self):
         neg = " NOT" if self.negated else ""
         return f"({self.expr.to_sql()}{neg} IN (<subquery>))"
+
+
+@dataclass(repr=False)
+class Exists(Expr):
+    """[NOT] EXISTS (SELECT ...) — resolved to a boolean literal by the
+    executor (uncorrelated, like InSubquery; reference: DataFusion's
+    scalar-subquery decorrelation handles the same class)."""
+
+    select: object
+    negated: bool = False
+
+    def eval(self, env, xp):
+        raise PlanError("unresolved EXISTS subquery (executor must resolve)")
+
+    def columns(self):
+        return set()
+
+    def to_sql(self):
+        neg = "NOT " if self.negated else ""
+        return f"({neg}EXISTS (<subquery>))"
 
 
 @dataclass(repr=False)

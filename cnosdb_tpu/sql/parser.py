@@ -1101,6 +1101,14 @@ class Parser:
                 from .expr import Cast
 
                 return Cast(e, tname, safe=(k == "TRY_CAST"))
+            if k == "EXISTS":
+                self.next()
+                self.expect_op("(")
+                sub = self.parse_query()
+                self.expect_op(")")
+                from .expr import Exists
+
+                return Exists(sub)
             if k == "CASE":
                 # CASE [operand] WHEN v THEN r ... [ELSE d] END — searched
                 # and simple forms (reference: DataFusion Expr::Case)

@@ -31,6 +31,7 @@ from __future__ import annotations
 
 import bz2
 import gzip
+import threading
 import zlib
 
 import numpy as np
@@ -41,9 +42,35 @@ from ..models.codec import Encoding
 from ..models.schema import ValueType
 from ..models.strcol import DictArray
 
-_ZSTD_C = zstandard.ZstdCompressor(level=1)
-_ZSTD_C3 = zstandard.ZstdCompressor(level=3)
-_ZSTD_D = zstandard.ZstdDecompressor()
+# zstd (de)compression CONTEXTS are not thread-safe for concurrent use;
+# encodes run from parallel ingest writers + the compaction pool and
+# decodes from the query pool concurrently, so each thread gets its own.
+_tls = threading.local()
+
+
+class _TlsZstd:
+    def __init__(self, level: int | None):
+        self._level = level
+        self._attr = f"zstd_{level}"
+
+    def _ctx(self):
+        c = getattr(_tls, self._attr, None)
+        if c is None:
+            c = (zstandard.ZstdDecompressor() if self._level is None
+                 else zstandard.ZstdCompressor(level=self._level))
+            setattr(_tls, self._attr, c)
+        return c
+
+    def compress(self, data):
+        return self._ctx().compress(data)
+
+    def decompress(self, data):
+        return self._ctx().decompress(data)
+
+
+_ZSTD_C = _TlsZstd(1)
+_ZSTD_C3 = _TlsZstd(3)
+_ZSTD_D = _TlsZstd(None)
 
 
 # ---------------------------------------------------------------------------

@@ -44,8 +44,10 @@ MAGIC = 0x7C05DB01
 VERSION = 1
 FOOTER_SIZE = 64
 
-_ZC = zstandard.ZstdCompressor(level=1)
-_ZD = zstandard.ZstdDecompressor()
+# thread-local contexts (parallel flush/compaction writers + query-pool
+# readers; zstd contexts are not safe for concurrent use)
+_ZC = codecs._TlsZstd(1)
+_ZD = codecs._TlsZstd(None)
 
 
 # ---------------------------------------------------------------------------

@@ -142,3 +142,40 @@ def test_decode_speed_smoke():
     dt = time.perf_counter() - t0
     # 1M ts + 1M floats; vectorized path should run well under a second
     assert dt < 1.0, f"decode too slow: {dt:.3f}s"
+
+
+def test_codec_thread_safety():
+    """Concurrent encode/decode from many threads (parallel ingest writers
+    + compaction pool + query pool share the codec layer; zstd contexts
+    must be thread-local — a shared context segfaults)."""
+    import threading
+
+    import numpy as np
+
+    from cnosdb_tpu.models.schema import ValueType
+    from cnosdb_tpu.storage import codecs
+
+    rng = np.random.default_rng(5)
+    ts = np.cumsum(rng.integers(1, 50, 200_000).astype(np.int64))
+    f = rng.normal(0, 1e5, 200_000)
+    errors = []
+
+    def worker(seed):
+        try:
+            r = np.random.default_rng(seed)
+            for _ in range(10):
+                n = int(r.integers(1_000, 200_000))
+                b = codecs.encode_timestamps(ts[:n])
+                assert np.array_equal(codecs.decode_timestamps(b), ts[:n])
+                b = codecs.encode(f[:n], ValueType.FLOAT)
+                assert np.array_equal(
+                    codecs.decode(b, ValueType.FLOAT), f[:n])
+        except Exception as e:  # pragma: no cover
+            errors.append(e)
+
+    threads = [threading.Thread(target=worker, args=(i,)) for i in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors, errors
