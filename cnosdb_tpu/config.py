@@ -93,6 +93,19 @@ class SecurityConfig:
 
 
 @dataclass
+class TraceConfig:
+    """Distributed-tracing sinks (reference config [trace]: minitrace →
+    OTLP collector, global_tracing.rs:14-60). When `otlp_endpoint` is set
+    (e.g. http://collector:4318), finished spans export as OTLP/HTTP JSON
+    to {endpoint}/v1/traces in the background."""
+
+    otlp_endpoint: str = ""
+    auto_generate_span: bool = False
+    batch_size: int = 256
+    flush_interval_s: float = 2.0
+
+
+@dataclass
 class ClusterConfig:
     raft_logs_to_keep: int = 5000
     snapshot_holding_time_s: int = 3600
@@ -112,11 +125,13 @@ class Config:
     service: ServiceConfig = field(default_factory=ServiceConfig)
     security: SecurityConfig = field(default_factory=SecurityConfig)
     cluster: ClusterConfig = field(default_factory=ClusterConfig)
+    trace: TraceConfig = field(default_factory=TraceConfig)
 
     _SECTIONS = {
         "global": "global_", "deployment": "deployment", "query": "query",
         "storage": "storage", "wal": "wal", "cache": "cache", "log": "log",
         "service": "service", "security": "security", "cluster": "cluster",
+        "trace": "trace",
     }
 
     @classmethod

@@ -801,6 +801,14 @@ def run_server(args) -> int:
                               wal_sync=cfg.wal.sync)
     flight_port = cfg.service.flight_rpc_listen_port
 
+    if cfg.trace.otlp_endpoint:
+        from .trace import GLOBAL_COLLECTOR, OtlpExporter
+
+        OtlpExporter(cfg.trace.otlp_endpoint, GLOBAL_COLLECTOR,
+                     batch_size=cfg.trace.batch_size,
+                     flush_interval_s=cfg.trace.flush_interval_s)
+        print(f"otlp export → {cfg.trace.otlp_endpoint}/v1/traces")
+
     async def ttl_job():
         """Bucket TTL expiry (reference meta_admin.rs:848 + ResourceManager):
         drop vnodes of expired buckets. Also reclaims the DROP recycle

@@ -212,3 +212,63 @@ def test_otlp_ingest_and_jaeger_query_api(http_server):
 
     st, body = _get(port, "/api/traces/abc123")
     assert st == 200 and _json.loads(body)["data"][0]["traceID"] == "abc123"
+
+
+def test_otlp_span_export():
+    """Own spans export as OTLP/HTTP JSON batches (reference
+    global_tracing.rs minitrace → opentelemetry-otlp). A stock OTLP
+    collector accepts the JSON encoding on /v1/traces."""
+    import json
+    import threading
+    from http.server import BaseHTTPRequestHandler, HTTPServer
+
+    from cnosdb_tpu.server.trace import OtlpExporter, TraceCollector
+
+    received = []
+
+    class Recv(BaseHTTPRequestHandler):
+        def do_POST(self):
+            body = self.rfile.read(int(self.headers["Content-Length"]))
+            received.append((self.path, json.loads(body)))
+            self.send_response(200)
+            self.end_headers()
+
+        def log_message(self, *a):
+            pass
+
+    srv = HTTPServer(("127.0.0.1", 0), Recv)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    try:
+        coll = TraceCollector()
+        exp = OtlpExporter(f"http://127.0.0.1:{srv.server_port}", coll,
+                           flush_interval_s=0.2)
+        with coll.span("parent") as p:
+            p.set_tag("db", "public")
+            with coll.span("child"):
+                pass
+        exp.close()
+        assert received, "no OTLP batch arrived"
+        path, payload = received[0]
+        assert path == "/v1/traces"
+        rs = payload["resourceSpans"][0]
+        svc = rs["resource"]["attributes"][0]
+        assert svc["key"] == "service.name"
+        spans = rs["scopeSpans"][0]["spans"]
+        names = {s["name"] for s in spans}
+        assert {"parent", "child"} <= names
+        by_name = {s["name"]: s for s in spans}
+        # ids are OTLP fixed-width hex; the child links to its parent
+        assert len(by_name["parent"]["traceId"]) == 32
+        assert len(by_name["parent"]["spanId"]) == 16
+        assert by_name["child"]["parentSpanId"] == \
+            by_name["parent"]["spanId"]
+        assert by_name["child"]["traceId"] == by_name["parent"]["traceId"]
+        pa = {a["key"]: a["value"]["stringValue"]
+              for a in by_name["parent"]["attributes"]}
+        assert pa.get("db") == "public"
+        assert int(by_name["parent"]["endTimeUnixNano"]) >= \
+            int(by_name["parent"]["startTimeUnixNano"])
+        assert exp.exported == len(spans)
+    finally:
+        srv.shutdown()
