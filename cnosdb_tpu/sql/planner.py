@@ -177,12 +177,16 @@ def plan_select(stmt: ast.SelectStmt, schema: TskvTableSchema):
     _validate_columns(stmt, schema)
     time_trs, tag_domains, residual = split_where(stmt.where, schema)
 
+    # aggregates may appear only in HAVING or ORDER BY (standard SQL:
+    # `SELECT h FROM t GROUP BY h HAVING count(i) > 3`); a GROUP BY with
+    # no aggregates anywhere is DISTINCT-on-keys — both are agg plans
     has_agg = any(_contains_agg(i.expr) for i in stmt.items
-                  if isinstance(i.expr, Expr))
+                  if isinstance(i.expr, Expr)) \
+        or (stmt.having is not None and _contains_agg(stmt.having)) \
+        or any(isinstance(oe, Expr) and _contains_agg(oe)
+               for oe, _ in stmt.order_by)
     if not has_agg and not stmt.group_by:
         return _plan_raw(stmt, schema, time_trs, tag_domains, residual)
-    if not has_agg:
-        raise PlanError("GROUP BY requires aggregate functions in SELECT")
     return _plan_aggregate(stmt, schema, time_trs, tag_domains, residual)
 
 
