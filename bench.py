@@ -41,6 +41,7 @@ N_HOSTS = 100
 N_PER_HOST = max(1, TARGET_ROWS // N_HOSTS)
 INTERVAL_NS = 10 * 10**9          # 10s cadence
 BUCKET_NS = 3600 * 10**9          # 1h buckets
+DAY_NS = 24 * BUCKET_NS
 BASE_TS = 1_640_995_200_000_000_000  # 2022-01-01
 CHUNK = 250_000
 LOAD_WORKERS = 8
@@ -223,6 +224,25 @@ def shapes(arrays: Arrays):
         s = np.bincount(a.url_codes, weights=a.latency, minlength=nseg)
         return c, s
 
+    def np_high_load():
+        m = a.user > 95
+        r = np.full(N_HOSTS, -np.inf)
+        np.maximum.at(r, a.host[m], a.user[m])
+        return r
+
+    def np_stationary():
+        sel = (a.ts >= win_lo) & (a.ts <= win_hi)
+        s = np.bincount(a.host[sel], weights=a.user[sel],
+                        minlength=N_HOSTS)
+        c = np.bincount(a.host[sel], minlength=N_HOSTS)
+        with np.errstate(invalid="ignore"):
+            m = s / np.maximum(c, 1)
+        return m[(c > 0) & (m < 48.0)]
+
+    def np_daily():
+        day = ((a.ts - BASE_TS) // DAY_NS).astype(np.int64)
+        return np.bincount(day)
+
     in_list = ", ".join(f"'{h}'" for h in eight)
     return [
         ("double_groupby_1",
@@ -261,6 +281,19 @@ def shapes(arrays: Arrays):
          "SELECT url, count(latency) AS c, sum(latency) AS s "
          "FROM hits_str GROUP BY url",
          len(a.url_codes), np_string_group),
+        ("high_load_max",
+         "SELECT hostname, max(usage_user) AS m FROM cpu "
+         "WHERE usage_user > 95 GROUP BY hostname",
+         n, np_high_load),
+        ("stationary",
+         "SELECT hostname, avg(usage_user) AS m FROM cpu "
+         f"WHERE time >= {win_lo} AND time <= {win_hi} GROUP BY hostname "
+         "HAVING avg(usage_user) < 48",
+         n, np_stationary),
+        ("daily_activity",
+         "SELECT date_bin(INTERVAL '24 hours', time) AS d, "
+         "count(usage_user) AS c FROM cpu GROUP BY d",
+         n, np_daily),
     ]
 
 
@@ -291,6 +324,18 @@ def spot_check(name, rs, arrays):
         u0 = a.url_values[0]
         assert int(got[u0]) == int(want_c[0]), (got[u0], want_c[0])
         assert len(got) == int((want_c > 0).sum())
+    elif name == "high_load_max":
+        m = (a.user > 95) & (a.host == 3)
+        if m.any():
+            i = np.argmax(cols["hostname"] == "host_003")
+            np.testing.assert_allclose(cols["m"][i], a.user[m].max(),
+                                       rtol=1e-12)
+    elif name == "daily_activity":
+        day = ((a.ts - BASE_TS) // DAY_NS).astype(np.int64)
+        want = np.bincount(day)
+        got = dict(zip(cols["d"], cols["c"]))
+        assert int(got[BASE_TS]) == int(want[0])
+        assert len(got) == len(want)
 
 
 def _guard_degraded_relay():

@@ -968,9 +968,131 @@ class QueryExecutor:
                     return True
         return False
 
+    def _decorrelate_exists(self, e, session: Session):
+        """Correlated EXISTS with ONE equality correlation conjunct
+        (`EXISTS (SELECT .. FROM u WHERE u.k = t.k AND <local preds>)`)
+        → semi-join as an IN over the inner key set; NOT EXISTS → NOT(IN)
+        (anti-join: outer NULL keys stay, unlike NOT IN's 3VL). This is
+        the standard decorrelation DataFusion's subquery rules perform.
+        Returns the replacement Expr, or None when not this pattern."""
+        q = e.select
+        if not isinstance(q, ast.SelectStmt) or q.where is None:
+            return None
+        if q.group_by or q.having is not None or q.order_by or \
+                q.limit is not None:
+            return None   # EXISTS bodies with those don't need them anyway
+        local_quals = self._from_qualifiers(q)
+        if not local_quals:
+            return None
+
+        def is_outer(expr: Expr) -> bool:
+            cols = expr.columns()
+            return bool(cols) and all(
+                "." in c and c.split(".", 1)[0] not in local_quals
+                for c in cols)
+
+        def is_local(expr: Expr) -> bool:
+            cols = expr.columns()
+            return all(("." not in c) or c.split(".", 1)[0] in local_quals
+                       for c in cols)
+
+        corr = None           # (outer_expr, inner_expr)
+        residual = []
+        from .relational import _split_conjuncts
+
+        for c in _split_conjuncts(q.where):
+            took = False
+            if (corr is None and isinstance(c, expr_mod.BinOp)
+                    and c.op == "="):
+                for outer, inner in ((c.left, c.right), (c.right, c.left)):
+                    if is_outer(outer) and is_local(inner) \
+                            and inner.columns():
+                        corr = (outer, inner)
+                        took = True
+                        break
+            if not took:
+                residual.append(c)
+        if corr is None:
+            return None
+        # every residual conjunct must be fully local
+        if not all(is_local(c) and not is_outer(c) for c in residual):
+            return None
+        outer_expr, inner_expr = corr
+        import copy as _copy
+        import dataclasses
+
+        inner_q = dataclasses.replace(
+            _copy.copy(q),
+            items=[ast.SelectItem(inner_expr, "__corr_key")],
+            where=self._conjoin(residual))
+        rs = self._select(inner_q, session)
+        vals = [v.item() if hasattr(v, "item") else v
+                for v in rs.columns[0]]
+        non_null = [v for v in vals if v is not None
+                    and not (isinstance(v, float) and v != v)]
+        keys = sorted(set(non_null), key=repr)
+        if e.negated:
+            # anti-join: a NULL outer key has no match → row KEPT (3VL
+            # NOT IN would drop it, so spell the NULL case explicitly)
+            return expr_mod.BinOp(
+                "or", expr_mod.IsNull(outer_expr),
+                InList(outer_expr, keys, negated=True))
+        return InList(outer_expr, keys, False)
+
+    @staticmethod
+    def _conjoin(cs):
+        out = None
+        for c in cs:
+            out = c if out is None else expr_mod.BinOp("and", out, c)
+        return out
+
+    @staticmethod
+    def _from_qualifiers(q: ast.SelectStmt) -> set:
+        """Relation qualifiers visible inside a subquery's own FROM."""
+        quals: set = set()
+
+        def visit(item):
+            if item is None:
+                return
+            if isinstance(item, ast.TableRef):
+                quals.add(item.alias or item.name)
+            elif isinstance(item, ast.SubqueryRef):
+                quals.add(item.alias)
+            elif isinstance(item, ast.Join):
+                visit(item.left)
+                visit(item.right)
+
+        visit(q.from_item)
+        if q.table:
+            quals.add(q.table)
+        return quals
+
     def _resolve_subqueries(self, stmt: ast.SelectStmt, session: Session):
         """Execute uncorrelated scalar / IN subqueries and splice their
-        results in as literals (reference: DataFusion subquery rules)."""
+        results in as literals; correlated EXISTS decorrelates to
+        semi/anti-joins (reference: DataFusion subquery rules)."""
+        # fold NOT over EXISTS into the node FIRST: anti-join NULL
+        # semantics differ from 3VL NOT over the semi-join replacement
+        def fold_pred(e):
+            return isinstance(e, expr_mod.UnaryOp) and e.op == "not" \
+                and isinstance(e.operand, expr_mod.Exists)
+
+        def fold(e):
+            return expr_mod.Exists(e.operand.select,
+                                   not e.operand.negated)
+
+        import dataclasses as _dc
+
+        stmt = _dc.replace(
+            stmt,
+            items=[ast.SelectItem(
+                rel.rewrite_exprs(it.expr, fold_pred, fold)
+                if isinstance(it.expr, Expr) else it.expr, it.alias)
+                for it in stmt.items],
+            where=(rel.rewrite_exprs(stmt.where, fold_pred, fold)
+                   if stmt.where is not None else None),
+            having=(rel.rewrite_exprs(stmt.having, fold_pred, fold)
+                    if stmt.having is not None else None))
         found = []
 
         def spot(e):
@@ -986,6 +1108,10 @@ class QueryExecutor:
 
         def replace(e):
             q = e.select
+            if isinstance(e, expr_mod.Exists):
+                corr = self._decorrelate_exists(e, session)
+                if corr is not None:
+                    return corr
             rs = self._union(q, session) if isinstance(q, ast.UnionStmt) \
                 else self._select(q, session)
             if isinstance(e, expr_mod.Exists):

@@ -313,16 +313,48 @@ class InList(Expr):
         v = self.expr.eval(env, xp)
         if self.negated and self.null_present:
             return xp.zeros(getattr(v, "shape", (1,)), dtype=bool)
-        m = None
-        for lit in self.values:
-            c = _eq(xp, v, lit)
-            m = c if m is None else (m | c)
+        m = self._isin_fast(v, xp)
+        if m is None:
+            for lit in self.values:
+                c = _eq(xp, v, lit)
+                m = c if m is None else (m | c)
         if m is None:
             m = xp.zeros(getattr(v, "shape", (1,)), dtype=bool)
         out = ~m if self.negated else m
         if xp is np:
             out = _mask_operand_validity(out, env, self.expr)
         return out
+
+    def _isin_fast(self, v, xp):
+        """np.isin for long homogeneous lists (decorrelated EXISTS can
+        carry thousands of keys; one vectorized pass per VALUE would be
+        O(list) column scans). None → per-literal fallback."""
+        if xp is not np or len(self.values) < 9:
+            return None
+        if not isinstance(v, np.ndarray) or v.dtype == object:
+            return None
+        vals = self.values
+
+        def plain_num(x):
+            return isinstance(x, (int, float, np.integer, np.floating)) \
+                and not isinstance(x, (bool, np.bool_))
+
+        if np.issubdtype(v.dtype, np.integer):
+            # int column vs float keys would compare through float64 and
+            # alias above 2^53 — keep the exact per-literal path there
+            if all(isinstance(x, (int, np.integer))
+                   and not isinstance(x, (bool, np.bool_)) for x in vals):
+                try:
+                    return np.isin(v, np.asarray(vals, dtype=np.int64))
+                except OverflowError:
+                    return None
+            return None
+        if np.issubdtype(v.dtype, np.floating) and all(
+                plain_num(x) for x in vals):
+            return np.isin(v, np.asarray([float(x) for x in vals]))
+        if v.dtype.kind == "U" and all(isinstance(x, str) for x in vals):
+            return np.isin(v, np.asarray(vals))
+        return None
 
     def columns(self):
         return self.expr.columns()

@@ -415,3 +415,61 @@ def test_case_in_analyzer_rewrites(db):
     rs = db.execute_one(
         "SELECT CASE WHEN exact_count(i) = 2 THEN 'two' END AS s FROM cr")
     assert rs.columns[0].tolist() == ["two"]
+
+
+# ---------------------------------------------------------------------------
+# correlated EXISTS (decorrelated to semi/anti-join)
+# ---------------------------------------------------------------------------
+def test_correlated_exists_semi_join(db):
+    """EXISTS with an equality correlation behaves as a semi-join."""
+    rs = db.execute_one(
+        "SELECT c.host, c.v FROM cpu c WHERE EXISTS "
+        "(SELECT 1 FROM hostinfo h WHERE h.host = c.host) ORDER BY c.v")
+    assert rows(rs, 0, 1) == [("a", 1.0), ("b", 2.0), ("a", 4.0)]
+
+
+def test_correlated_not_exists_anti_join(db):
+    """NOT EXISTS keeps outer rows with no match (anti-join)."""
+    rs = db.execute_one(
+        "SELECT c.host FROM cpu c WHERE NOT EXISTS "
+        "(SELECT 1 FROM hostinfo h WHERE h.host = c.host) ORDER BY c.host")
+    assert rs.columns[0].tolist() == ["c"]
+
+
+def test_correlated_exists_with_local_predicate(db):
+    """Local (non-correlated) conjuncts stay inside the subquery."""
+    rs = db.execute_one(
+        "SELECT c.host, c.v FROM cpu c WHERE EXISTS "
+        "(SELECT 1 FROM hostinfo h WHERE h.host = c.host "
+        "AND h.owner = 'alice') ORDER BY c.v")
+    assert rows(rs, 0, 1) == [("a", 1.0), ("a", 4.0)]
+
+
+def test_correlated_not_exists_null_outer_key(db):
+    """Anti-join semantics: an outer row whose key is NULL has no match
+    and must be KEPT by NOT EXISTS (NOT IN would drop it)."""
+    db.execute_one("CREATE TABLE ev (k BIGINT, TAGS(t))")
+    db.execute_one("INSERT INTO ev (time, t, k) VALUES "
+                   "(1,'x',1),(2,'x',NULL),(3,'x',9)")
+    db.execute_one("CREATE TABLE kv (k2 BIGINT, TAGS(t))")
+    db.execute_one("INSERT INTO kv (time, t, k2) VALUES (1,'y',1)")
+    rs = db.execute_one(
+        "SELECT e.time FROM ev e WHERE NOT EXISTS "
+        "(SELECT 1 FROM kv x WHERE x.k2 = e.k) ORDER BY e.time")
+    assert rs.columns[0].tolist() == [2, 3]
+    rs = db.execute_one(
+        "SELECT e.time FROM ev e WHERE EXISTS "
+        "(SELECT 1 FROM kv x WHERE x.k2 = e.k) ORDER BY e.time")
+    assert rs.columns[0].tolist() == [1]
+
+
+def test_in_list_isin_fast_path_exact(db):
+    """Long integer IN lists use np.isin without losing exactness."""
+    big = 2**53 + 1
+    db.execute_one("CREATE TABLE bigt (v BIGINT, TAGS(t))")
+    db.execute_one(f"INSERT INTO bigt (time, t, v) VALUES "
+                   f"(1,'x',{big}),(2,'x',{big + 1}),(3,'x',5)")
+    in_list = ", ".join(str(big + k) for k in range(0, 20, 2))
+    rs = db.execute_one(
+        f"SELECT time FROM bigt WHERE v IN ({in_list}) ORDER BY time")
+    assert rs.columns[0].tolist() == [1]   # big+1 is NOT in (evens only)
