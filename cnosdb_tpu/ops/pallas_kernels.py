@@ -1,0 +1,138 @@
+"""Pallas TPU kernel for the segment-aggregate hot op.
+
+The framework's hottest program is the masked segment reduction behind
+scan-fused GROUP BY (ops/kernels.local_segment_partials). XLA lowers
+`segment_sum` through sort/scatter; this kernel exploits the STORAGE
+LAYOUT instead: scan batches are series-contiguous and time-ordered, so
+the `group × n_buckets + bucket` segment ids each row tile touches span a
+narrow contiguous window. Every grid step reduces its row tile into a
+LOCAL window of `W` segments relative to a per-tile base (one VPU-masked
+pass over an [R, W] broadcast — VMEM-resident, no scatter), writing an
+independent [W] output block per tile; a final O(tiles·W) XLA
+segment-sum/min/max folds the windows into the global segment array
+(tiles·W ≪ rows, so the combine is noise).
+
+Preconditions checked by the host wrapper (`applicable`): every R-row
+tile's segment span fits in W. Storage scans guarantee this by
+construction except at series boundaries, which the window absorbs; the
+wrapper falls back to the XLA kernel otherwise — same contract as
+ops/placement choosing between device and host.
+
+Run `CNOSDB_TPU_PALLAS=1` to enable on the device path; tests drive the
+kernel in interpreter mode on CPU (guide: pallas_call(interpret=True)).
+"""
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+try:  # pallas import is deferred-fail: CPU-only deployments keep working
+    from jax.experimental import pallas as pl
+    PALLAS_AVAILABLE = True
+except Exception:  # pragma: no cover
+    PALLAS_AVAILABLE = False
+
+R_TILE = 256     # rows per grid step
+W_WIN = 2048     # local segment window (8 × 128-lane groups)
+
+
+def _kernel(base_ref, values_ref, valid_ref, seg_ref,
+            cnt_ref, sum_ref, min_ref, max_ref):
+    """One row tile → [W] partials relative to this tile's window base."""
+    base = base_ref[0, 0]
+    vals = values_ref[:]                        # [R] f64
+    ok = valid_ref[:]                           # [R] int8 validity
+    seg = seg_ref[:] - base                     # [R] i32, in [0, W)
+    # [R, W] membership mask: row r contributes to window slot seg[r]
+    lanes = jax.lax.broadcasted_iota(jnp.int32, (R_TILE, W_WIN), 1)
+    m = (seg[:, None] == lanes) & (ok[:, None] != 0)
+    vcol = vals[:, None]
+    zero = jnp.zeros((), vals.dtype)
+    cnt_ref[0, :] = jnp.sum(m.astype(jnp.int32), axis=0)
+    sum_ref[0, :] = jnp.sum(jnp.where(m, vcol, zero), axis=0)
+    pinf = jnp.array(jnp.inf, vals.dtype)
+    min_ref[0, :] = jnp.min(jnp.where(m, vcol, pinf), axis=0)
+    max_ref[0, :] = jnp.max(jnp.where(m, vcol, -pinf), axis=0)
+
+
+@functools.partial(jax.jit, static_argnames=("num_segments", "interpret"))
+def _windowed_partials(bases, values, valid, seg_ids, *, num_segments: int,
+                       interpret: bool = False):
+    """values/valid/seg_ids padded to a tile multiple; bases[t] = window
+    base of tile t (padded rows carry valid=False, seg=base)."""
+    n = values.shape[0]
+    tiles = n // R_TILE
+    out_shape = [
+        jax.ShapeDtypeStruct((tiles, W_WIN), jnp.int32),    # count
+        jax.ShapeDtypeStruct((tiles, W_WIN), values.dtype),  # sum
+        jax.ShapeDtypeStruct((tiles, W_WIN), values.dtype),  # min
+        jax.ShapeDtypeStruct((tiles, W_WIN), values.dtype),  # max
+    ]
+    row_spec = pl.BlockSpec((R_TILE,), lambda t: (t,))
+    win_spec = pl.BlockSpec((1, W_WIN), lambda t: (t, 0))
+    base_spec = pl.BlockSpec((1, 1), lambda t: (t, 0))
+    cnt, s, mn, mx = pl.pallas_call(
+        _kernel,
+        grid=(tiles,),
+        in_specs=[base_spec, row_spec, row_spec, row_spec],
+        out_specs=[win_spec, win_spec, win_spec, win_spec],
+        out_shape=out_shape,
+        interpret=interpret,
+    )(bases.reshape(-1, 1), values, valid.astype(jnp.int8), seg_ids)
+
+    # fold tile windows into global segments: tiny combine, plain XLA
+    gids = (bases[:, None] + jnp.arange(W_WIN, dtype=jnp.int32)[None, :])
+    gids = jnp.clip(gids.reshape(-1), 0, num_segments - 1)
+    out = {
+        "count": jax.ops.segment_sum(cnt.reshape(-1), gids, num_segments),
+        "sum": jax.ops.segment_sum(s.reshape(-1), gids, num_segments),
+        "min": jax.ops.segment_min(mn.reshape(-1), gids, num_segments),
+        "max": jax.ops.segment_max(mx.reshape(-1), gids, num_segments),
+    }
+    return out
+
+
+def applicable(seg_ids: np.ndarray) -> np.ndarray | None:
+    """Per-tile window bases when every tile's segment span fits W_WIN;
+    None → caller uses the XLA kernel. Vectorized host check."""
+    n = len(seg_ids)
+    if n == 0:
+        return None
+    pad = (-n) % R_TILE
+    s = np.pad(seg_ids, (0, pad), mode="edge").reshape(-1, R_TILE)
+    lo = s.min(axis=1)
+    hi = s.max(axis=1)
+    if int((hi - lo).max()) >= W_WIN:
+        return None
+    return lo.astype(np.int32)
+
+
+def segment_partials_pallas(values: np.ndarray, valid: np.ndarray,
+                            seg_ids: np.ndarray, num_segments: int,
+                            interpret: bool = False) -> dict | None:
+    """Host wrapper: pad to tile multiple, run the kernel, slice invalid
+    window slots out via the combine. None when the layout disqualifies."""
+    if not PALLAS_AVAILABLE:
+        return None
+    bases = applicable(np.asarray(seg_ids))
+    if bases is None:
+        return None
+    n = len(values)
+    pad = (-n) % R_TILE
+    if pad:
+        values = np.concatenate([values, np.zeros(pad, values.dtype)])
+        valid = np.concatenate([valid, np.zeros(pad, bool)])
+        seg_ids = np.concatenate(
+            [seg_ids, np.full(pad, seg_ids[-1], seg_ids.dtype)])
+    out = _windowed_partials(
+        jnp.asarray(bases), jnp.asarray(values), jnp.asarray(valid),
+        jnp.asarray(seg_ids, dtype=jnp.int32),
+        num_segments=num_segments, interpret=interpret)
+    host = {k: np.asarray(v) for k, v in out.items()}
+    # empty segments: min/max carry ±inf from the identity — mirror the
+    # XLA kernel's convention (callers mask by count)
+    return host
