@@ -13,8 +13,11 @@ The log store IS the vnode WAL (storage/wal.py) — same single durable log
 per vnode as the reference (wal_store.rs RaftEntryStorage).
 
 Simplifications vs openraft, stated plainly:
-- pre-vote and leader-lease reads are not implemented (reads go through
-  the leader's state machine which is safe for our apply model);
+- PreVote IS implemented (`_prevote()` below) — a candidate first polls a
+  majority without bumping terms, so partitioned nodes cannot depose a
+  healthy leader on rejoin; leader-lease reads are not implemented (reads
+  go through the leader's state machine which is safe for our apply
+  model);
 - membership changes are single-step (add/remove one voter at a time).
 """
 from __future__ import annotations

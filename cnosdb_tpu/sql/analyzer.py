@@ -20,13 +20,32 @@ _SELECTOR_FUNCS = ("topk", "bottom")
 
 
 def analyze(stmt):
-    """Run every analyzer rule. Non-SELECT statements pass through."""
+    """Run every analyzer rule. Non-SELECT statements pass through.
+    For UNION chains only the union-level ORDER BY needs rewriting here —
+    each branch is a SelectStmt that re-enters analyze() when executed."""
+    if isinstance(stmt, ast.UnionStmt):
+        return _analyze_union_order_by(stmt)
     if not isinstance(stmt, ast.SelectStmt):
         return stmt
     stmt = rewrite_exact_count(stmt)
     stmt = rewrite_null_functions(stmt)
     stmt = rewrite_selector_functions(stmt)
     return stmt
+
+
+def _analyze_union_order_by(stmt):
+    import dataclasses
+
+    def rw(e):
+        for r in _EXPR_REWRITERS:
+            e = r(e)
+        return e
+
+    order_by = [(rw(oe) if isinstance(oe, Expr) else oe, asc)
+                for oe, asc in stmt.order_by]
+    if all(a is b for (a, _), (b, _) in zip(order_by, stmt.order_by)):
+        return stmt
+    return dataclasses.replace(stmt, order_by=order_by)
 
 
 # ---------------------------------------------------------------------------
@@ -38,23 +57,25 @@ def rewrite_null_functions(stmt):
     nullif; ifnull/nvl are the common aliases). coalesce(a, b, c) →
     CASE WHEN a IS NOT NULL THEN a WHEN b IS NOT NULL THEN b ELSE c END;
     nullif(a, b) → CASE WHEN a = b THEN NULL ELSE a END."""
-    def rw(e):
-        if isinstance(e, Func) and e.name.lower() in (
-                "coalesce", "ifnull", "nvl", "nullif"):
-            name = e.name.lower()
-            args = [rw(a) if isinstance(a, Expr) else a for a in e.args]
-            if name == "nullif":
-                if len(args) != 2:
-                    raise PlanError("nullif takes exactly two arguments")
-                return Case(None, [(BinOp("=", args[0], args[1]),
-                                    Literal(None))], args[0])
-            if len(args) < 2:
-                raise PlanError(f"{name} takes at least two arguments")
-            whens = [(IsNull(a, negated=True), a) for a in args[:-1]]
-            return Case(None, whens, args[-1])
-        return _map_children(e, rw)
+    return _map_stmt_exprs(stmt, _rw_null_funcs)
 
-    return _map_stmt_exprs(stmt, rw)
+
+def _rw_null_funcs(e):
+    if isinstance(e, Func) and e.name.lower() in (
+            "coalesce", "ifnull", "nvl", "nullif"):
+        name = e.name.lower()
+        args = [_rw_null_funcs(a) if isinstance(a, Expr) else a
+                for a in e.args]
+        if name == "nullif":
+            if len(args) != 2:
+                raise PlanError("nullif takes exactly two arguments")
+            return Case(None, [(BinOp("=", args[0], args[1]),
+                                Literal(None))], args[0])
+        if len(args) < 2:
+            raise PlanError(f"{name} takes at least two arguments")
+        whens = [(IsNull(a, negated=True), a) for a in args[:-1]]
+        return Case(None, whens, args[-1])
+    return _map_children(e, _rw_null_funcs)
 
 
 # ---------------------------------------------------------------------------
@@ -66,12 +87,21 @@ def rewrite_exact_count(stmt):
     count can serve from page statistics; exact_count forces a real count.
     Here the scan kernels count actual surviving rows already, so the
     rewrite is a pure rename with identical semantics."""
-    def rw(e):
-        if isinstance(e, Func) and e.name.lower() == "exact_count":
-            return Func("count", [rw(a) for a in e.args])
-        return _map_children(e, rw)
+    return _map_stmt_exprs(stmt, _rw_exact_count)
 
-    return _map_stmt_exprs(stmt, rw)
+
+def _rw_exact_count(e):
+    if isinstance(e, Func) and e.name.lower() == "exact_count":
+        return Func("count", [_rw_exact_count(a) if isinstance(a, Expr)
+                              else a for a in e.args])
+    return _map_children(e, _rw_exact_count)
+
+
+# The expression-level desugar rules, in application order. Statement-level
+# analyze() applies each via its rewrite_* wrapper; _analyze_union_order_by
+# consumes this list directly — add new scalar desugars HERE so both paths
+# stay in sync.
+_EXPR_REWRITERS = (_rw_exact_count, _rw_null_funcs)
 
 
 # ---------------------------------------------------------------------------
@@ -215,6 +245,23 @@ def _map_stmt_exprs(stmt, fn):
     order_by = [(fn(oe) if isinstance(oe, Expr) else oe, asc)
                 for oe, asc in stmt.order_by]
     group_by = [fn(g) if isinstance(g, Expr) else g for g in stmt.group_by]
+    from_item = _map_from_item(stmt.from_item, fn)
     return dataclasses.replace(stmt, items=items, having=having,
                                where=where, order_by=order_by,
-                               group_by=group_by)
+                               group_by=group_by, from_item=from_item)
+
+
+def _map_from_item(fi, fn):
+    """Apply fn to JOIN ON conditions in a FROM tree. Without this,
+    coalesce() in `JOIN ... ON coalesce(a.x,0) = b.y` would reach
+    evaluation undesugared (round-3 advisor finding). Derived-relation
+    (SubqueryRef) bodies are NOT rewritten here — they re-enter analyze()
+    when the executor materializes them."""
+    if isinstance(fi, ast.Join):
+        left = _map_from_item(fi.left, fn)
+        right = _map_from_item(fi.right, fn)
+        on = fn(fi.on) if isinstance(fi.on, Expr) else fi.on
+        if left is fi.left and right is fi.right and on is fi.on:
+            return fi
+        return ast.Join(left, right, fi.kind, on)
+    return fi

@@ -978,9 +978,17 @@ class QueryExecutor:
         q = e.select
         if not isinstance(q, ast.SelectStmt) or q.where is None:
             return None
+        # Normalize first (exact_count→count, topk→ORDER BY+LIMIT, …) so
+        # the guards below see the executable shape — an un-analyzed
+        # exact_count would slip past the aggregate check.
+        from .analyzer import analyze
+
+        q = analyze(q)
         if q.group_by or q.having is not None or q.order_by or \
-                q.limit is not None:
+                q.limit is not None or q.offset:
             return None   # EXISTS bodies with those don't need them anyway
+        contains_agg = any(rel.collect_aggs(it.expr, AGG_FUNCS)
+                           for it in q.items if isinstance(it.expr, Expr))
         local_quals = self._from_qualifiers(q)
         if not local_quals:
             return None
@@ -1021,6 +1029,16 @@ class QueryExecutor:
         import copy as _copy
         import dataclasses
 
+        if contains_agg:
+            # An ungrouped aggregate subquery yields exactly one row no
+            # matter what the WHERE matches, so EXISTS is unconditionally
+            # true (and NOT EXISTS false) — never a semi-join. Execute the
+            # body with the correlation conjunct dropped first so invalid
+            # names (bad table/column) still raise instead of being
+            # silently short-circuited away.
+            probe = dataclasses.replace(q, where=self._conjoin(residual))
+            self._select(probe, session)
+            return Literal(not e.negated)
         inner_q = dataclasses.replace(
             _copy.copy(q),
             items=[ast.SelectItem(inner_expr, "__corr_key")],
@@ -1405,6 +1423,9 @@ class QueryExecutor:
         return ResultSet(rs.names, [c[idx] for c in rs.columns])
 
     def _union(self, stmt: ast.UnionStmt, session: Session) -> ResultSet:
+        from .analyzer import analyze
+
+        stmt = analyze(stmt)   # union-level ORDER BY desugaring
         results = [self._select(s, session) for s in stmt.selects]
         width = len(results[0].names)
         for r in results[1:]:

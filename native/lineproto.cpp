@@ -263,7 +263,8 @@ bool has_exotic_space(const uint8_t* p, size_t n) {
 inline bool ascii_space(char c) {
     // Python str.strip() whitespace set, ASCII subset (incl. FS/GS/RS/US)
     return c == ' ' || c == '\t' || c == '\r' || c == '\n' || c == '\v' ||
-           c == '\f' || c == '\x1c' || c == '\x1d' || c == '\x1e';
+           c == '\f' || c == '\x1c' || c == '\x1d' || c == '\x1e' ||
+           c == '\x1f';
 }
 
 inline bool line_term(uint8_t c) {

@@ -140,6 +140,11 @@ def test_ascii_control_separators():
     _assert_same("m\x1cx,t=a v=1 5\n" * 30)
     _assert_same("m v=1 5\x1dm v=2 6\n" * 30)
     _assert_same("\x1em v=3 7\n" * 30)
+    # \x1f (unit separator) is strip() whitespace but NOT a splitlines()
+    # terminator — a \x1f-prefixed line must strip to the same measurement
+    # on both paths (round-3 advisor finding)
+    _assert_same("\x1fm2,t=a f=1i 100\n" * 30)
+    _assert_same("m2,t=a f=1i 100\x1f\n" * 30)
 
 
 def test_nul_in_tags_keeps_series_distinct():

@@ -463,6 +463,86 @@ def test_correlated_not_exists_null_outer_key(db):
     assert rs.columns[0].tolist() == [1]
 
 
+def test_exists_aggregate_subquery_always_true(db):
+    """EXISTS over an ungrouped aggregate subquery is unconditionally TRUE
+    (the subquery yields exactly one row — count()=0 included), so every
+    outer row survives; NOT EXISTS keeps none. Round-3 advisor finding:
+    semi-join decorrelation must decline this shape."""
+    rs = db.execute_one(
+        "SELECT c.host FROM cpu c WHERE EXISTS "
+        "(SELECT count(*) FROM hostinfo h WHERE h.host = c.host) "
+        "ORDER BY c.v")
+    assert rs.columns[0].tolist() == ["a", "b", "c", "a"]
+    rs = db.execute_one(
+        "SELECT c.host FROM cpu c WHERE NOT EXISTS "
+        "(SELECT count(*) FROM hostinfo h WHERE h.host = c.host)")
+    assert rs.n_rows == 0
+
+
+def test_exists_aggregate_subquery_invalid_names_raise(db):
+    """The aggregate short-circuit must not mask name-resolution errors:
+    a bad table or column in the EXISTS body still raises."""
+    from cnosdb_tpu.errors import CnosError
+    for sql in (
+        "SELECT c.host FROM cpu c WHERE EXISTS "
+        "(SELECT count(*) FROM nosuch n WHERE n.x = c.host)",
+        "SELECT c.host FROM cpu c WHERE EXISTS "
+        "(SELECT count(h.bogus) FROM hostinfo h WHERE h.host = c.host)",
+    ):
+        with pytest.raises(CnosError):
+            db.execute_one(sql)
+
+
+def test_exists_exact_count_subquery_always_true(db):
+    """exact_count desugars to count BEFORE the decorrelation guards run,
+    so the aggregate short-circuit fires for it too."""
+    rs = db.execute_one(
+        "SELECT c.host FROM cpu c WHERE EXISTS "
+        "(SELECT exact_count(*) FROM hostinfo h WHERE h.host = c.host) "
+        "ORDER BY c.v")
+    assert rs.columns[0].tolist() == ["a", "b", "c", "a"]
+
+
+def test_exists_offset_not_decorrelated(db):
+    """OFFSET skips the aggregate's single row (EXISTS → false) and makes
+    semi-join decorrelation unsound; uncorrelated bodies evaluate exactly,
+    correlated ones must decline (error) rather than answer wrongly."""
+    rs = db.execute_one("SELECT host FROM cpu WHERE EXISTS "
+                        "(SELECT count(*) FROM hostinfo OFFSET 1)")
+    assert rs.n_rows == 0
+    from cnosdb_tpu.errors import CnosError
+    for sql in (
+        "SELECT c.host FROM cpu c WHERE EXISTS (SELECT count(*) "
+        "FROM hostinfo h WHERE h.host = c.host OFFSET 1)",
+        "SELECT c.host FROM cpu c WHERE EXISTS (SELECT 1 "
+        "FROM hostinfo h WHERE h.host = c.host OFFSET 1)",
+    ):
+        with pytest.raises(CnosError):
+            db.execute_one(sql)
+
+
+def test_coalesce_in_union_order_by(db):
+    """Union-level ORDER BY is desugared by the analyzer (it is evaluated
+    directly by _union, never re-entering per-branch analysis)."""
+    rs = db.execute_one(
+        "SELECT host FROM cpu UNION SELECT host FROM hostinfo "
+        "ORDER BY coalesce(host, 'zz')")
+    assert rs.columns[0].tolist() == ["a", "b", "c"]
+    rs = db.execute_one(
+        "SELECT * FROM (SELECT host FROM cpu UNION "
+        "SELECT host FROM hostinfo ORDER BY coalesce(host, 'zz')) d")
+    assert rs.columns[0].tolist() == ["a", "b", "c"]
+
+
+def test_coalesce_in_join_on(db):
+    """NULL-function desugaring must reach JOIN ON expressions
+    (round-3 advisor finding: coalesce in ON failed with PlanError)."""
+    rs = db.execute_one(
+        "SELECT c.host, h.owner FROM cpu c JOIN hostinfo h "
+        "ON coalesce(c.host, 'zz') = h.host ORDER BY c.v")
+    assert rows(rs, 0, 1) == [("a", "alice"), ("b", "bob"), ("a", "alice")]
+
+
 def test_in_list_isin_fast_path_exact(db):
     """Long integer IN lists use np.isin without losing exactness."""
     big = 2**53 + 1
