@@ -507,6 +507,8 @@ def main():
             if name == "double_groupby_1":
                 headline = (rate, vs)
 
+        from cnosdb_tpu.ops import pallas_kernels
+
         print(json.dumps({
             "metric": "tsbs_double_groupby_1h_scan_agg_100m",
             "value": round(headline[0], 1),
@@ -516,6 +518,8 @@ def main():
             "ingest_rows_per_s": round(n_rows / ingest_s, 1),
             "compact_s": round(compact_s, 1),
             "shapes": results,
+            "pallas_enabled": pallas_kernels.enabled(),
+            "pallas_engagements": pallas_kernels.engagements(),
             **_device_kernel_metric(),
         }))
         coord.close()

@@ -20,6 +20,13 @@ epoch E and query origin O:
 
 The final index subtracts bmin host-side (folded into `offset`), so no
 per-query recompilation: I_s, rA_s, rA_ns, offset are traced scalars.
+
+Segment reductions here stay on XLA's segment_sum/min/max: this kernel
+derives seg ids ON DEVICE (group_of_series[sid] × n_buckets + bucket), so
+the pallas windowed kernel's host-side applicability check
+(pallas_kernels.applicable — per-tile span < W_WIN over a host seg array)
+cannot run. The pallas route lives in kernels.aggregate_column_host,
+where the host-prep device path has the seg array in host memory.
 """
 from __future__ import annotations
 
@@ -33,6 +40,10 @@ from .device_cache import DeviceBatch
 from .kernels import local_segment_partials, pad_segments
 
 _kernel_cache: dict = {}
+
+# observability: how many fused device programs launched this process
+# (tests assert the device path actually engaged; bench records it)
+launch_count = 0
 
 NS_PER_SEC = 1_000_000_000
 
@@ -98,6 +109,8 @@ def launch_fused(dbatch: DeviceBatch, filter_expr: Expr | None,
                  group_of_series: np.ndarray, n_groups: int, n_buckets: int,
                  arith: tuple[int, int, int, int] | None,
                  col_wants: dict[str, dict]) -> PendingFused:
+    global launch_count
+    launch_count += 1
     num_segments = n_groups * n_buckets
     ns_pad = pad_segments(max(num_segments, 1))
 

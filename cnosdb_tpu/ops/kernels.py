@@ -273,10 +273,37 @@ def aggregate_column_host(values: np.ndarray, valid: np.ndarray,
                           seg_ids: np.ndarray, rank: np.ndarray,
                           num_segments: int, wants: dict) -> dict:
     """Host wrapper: pads rows to a size class, runs the jit kernel, pulls
-    results back as numpy (sliced to num_segments by the caller)."""
+    results back as numpy (sliced to num_segments by the caller).
+
+    When the pallas segment kernel is enabled (ops/pallas_kernels.enabled:
+    CNOSDB_TPU_PALLAS=1 or a real TPU scan device) and the batch's segment
+    layout qualifies, the storage-layout-aware windowed kernel replaces
+    XLA's sort/scatter segment lowering; first/last (rank selection) and
+    disqualified layouts fall back to the XLA kernel below."""
     n = len(values)
     np_pad = pad_rows(max(n, 1))
     ns_pad = pad_segments(max(num_segments, 1))
+    from . import pallas_kernels as pk
+
+    if pk.enabled() and not (wants.get("want_first")
+                             or wants.get("want_last")) and n \
+            and pk.applicable(seg_ids) is not None:
+        # cheap O(n/R_TILE) layout check BEFORE any padding copies —
+        # disqualified layouts fall straight through to the XLA path.
+        # Pad seg with the edge value (not 0) so trailing tiles keep
+        # their narrow window; padded rows are valid=False either way
+        v2 = _pad(values, np_pad)
+        ok2 = _pad(valid, np_pad, fill=False)
+        sg2 = _pad(seg_ids, np_pad, fill=seg_ids[n - 1])
+        out = pk.segment_partials_pallas(
+            v2, ok2, sg2.astype(np.int32, copy=False), ns_pad, wants=wants,
+            interpret=jax.default_backend() != "tpu")
+        if out is not None:
+            pk.note_engaged()
+            host = {k: v[:num_segments] for k, v in out.items()}
+            if "count" in host:
+                host["count"] = host["count"].astype(np.int64)
+            return host
     if np_pad != n:
         values = _pad(values, np_pad)
         valid = _pad(valid, np_pad, fill=False)

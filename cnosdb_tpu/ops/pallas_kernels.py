@@ -18,12 +18,19 @@ construction except at series boundaries, which the window absorbs; the
 wrapper falls back to the XLA kernel otherwise — same contract as
 ops/placement choosing between device and host.
 
-Run `CNOSDB_TPU_PALLAS=1` to enable on the device path; tests drive the
-kernel in interpreter mode on CPU (guide: pallas_call(interpret=True)).
+Integration (kernels.aggregate_column_host routes here): `enabled()`
+reads CNOSDB_TPU_PALLAS — "1" forces the kernel on, "0" off, unset/auto
+enables it only when the scan device is a real TPU. Tests drive
+segment_partials_pallas directly with interpret=True on the CPU backend
+against the numpy_segment_partials oracle (tests/test_pallas_kernels.py).
+
+Replaces the per-series reduction loop of the reference's reader tree
+(tskv/src/reader/iterator.rs:94-121) on the device placement.
 """
 from __future__ import annotations
 
 import functools
+import os
 
 import numpy as np
 
@@ -37,14 +44,37 @@ except Exception:  # pragma: no cover
     PALLAS_AVAILABLE = False
 
 R_TILE = 256     # rows per grid step
-W_WIN = 2048     # local segment window (8 × 128-lane groups)
+W_WIN = 2048     # local segment window (16 × 128-lane groups)
+
+
+def enabled() -> bool:
+    """Should aggregate_column_host route through this kernel?
+    CNOSDB_TPU_PALLAS=1 forces on (interpret-mode on CPU backends), =0
+    off; default: only on a real TPU scan device."""
+    mode = os.environ.get("CNOSDB_TPU_PALLAS", "auto").lower()
+    if mode in ("1", "on", "true"):
+        return PALLAS_AVAILABLE
+    if mode in ("0", "off", "false"):
+        return False
+    if not PALLAS_AVAILABLE:
+        return False
+    from .placement import scan_device
+
+    return scan_device().platform == "tpu"
+
+
+def _extrema(dtype):
+    if jnp.issubdtype(dtype, jnp.floating):
+        return jnp.array(jnp.inf, dtype), jnp.array(-jnp.inf, dtype)
+    info = jnp.iinfo(dtype)
+    return jnp.array(info.max, dtype), jnp.array(info.min, dtype)
 
 
 def _kernel(base_ref, values_ref, valid_ref, seg_ref,
             cnt_ref, sum_ref, min_ref, max_ref):
     """One row tile → [W] partials relative to this tile's window base."""
     base = base_ref[0, 0]
-    vals = values_ref[:]                        # [R] f64
+    vals = values_ref[:]                        # [R]
     ok = valid_ref[:]                           # [R] int8 validity
     seg = seg_ref[:] - base                     # [R] i32, in [0, W)
     # [R, W] membership mask: row r contributes to window slot seg[r]
@@ -52,18 +82,19 @@ def _kernel(base_ref, values_ref, valid_ref, seg_ref,
     m = (seg[:, None] == lanes) & (ok[:, None] != 0)
     vcol = vals[:, None]
     zero = jnp.zeros((), vals.dtype)
+    hi, lo = _extrema(vals.dtype)
     cnt_ref[0, :] = jnp.sum(m.astype(jnp.int32), axis=0)
     sum_ref[0, :] = jnp.sum(jnp.where(m, vcol, zero), axis=0)
-    pinf = jnp.array(jnp.inf, vals.dtype)
-    min_ref[0, :] = jnp.min(jnp.where(m, vcol, pinf), axis=0)
-    max_ref[0, :] = jnp.max(jnp.where(m, vcol, -pinf), axis=0)
+    min_ref[0, :] = jnp.min(jnp.where(m, vcol, hi), axis=0)
+    max_ref[0, :] = jnp.max(jnp.where(m, vcol, lo), axis=0)
 
 
 @functools.partial(jax.jit, static_argnames=("num_segments", "interpret"))
 def _windowed_partials(bases, values, valid, seg_ids, *, num_segments: int,
                        interpret: bool = False):
     """values/valid/seg_ids padded to a tile multiple; bases[t] = window
-    base of tile t (padded rows carry valid=False, seg=base)."""
+    base of tile t (padded rows carry valid=False, seg inside the tile's
+    window)."""
     n = values.shape[0]
     tiles = n // R_TILE
     out_shape = [
@@ -84,7 +115,9 @@ def _windowed_partials(bases, values, valid, seg_ids, *, num_segments: int,
         interpret=interpret,
     )(bases.reshape(-1, 1), values, valid.astype(jnp.int8), seg_ids)
 
-    # fold tile windows into global segments: tiny combine, plain XLA
+    # fold tile windows into global segments: tiny combine, plain XLA.
+    # Window slots past num_segments-1 clip onto the last segment carrying
+    # only identity values (count/sum 0, min/max extrema) — harmless.
     gids = (bases[:, None] + jnp.arange(W_WIN, dtype=jnp.int32)[None, :])
     gids = jnp.clip(gids.reshape(-1), 0, num_segments - 1)
     out = {
@@ -111,14 +144,40 @@ def applicable(seg_ids: np.ndarray) -> np.ndarray | None:
     return lo.astype(np.int32)
 
 
+_WANT_OF = {"count": "want_count", "sum": "want_sum",
+            "min": "want_min", "max": "want_max"}
+
+_engagements = 0
+
+
+def note_engaged() -> None:
+    global _engagements
+    _engagements += 1
+
+
+def engagements() -> int:
+    """How many aggregations ran through the pallas kernel this process
+    (bench.py records this so BENCH_r*.json shows whether it engaged)."""
+    return _engagements
+
+
 def segment_partials_pallas(values: np.ndarray, valid: np.ndarray,
                             seg_ids: np.ndarray, num_segments: int,
+                            wants: dict | None = None,
                             interpret: bool = False) -> dict | None:
-    """Host wrapper: pad to tile multiple, run the kernel, slice invalid
-    window slots out via the combine. None when the layout disqualifies."""
+    """Host wrapper: pad to a tile multiple, run the kernel, fold windows
+    into global segments. Returns None when the layout disqualifies
+    (`applicable`), when pallas is unavailable, or when `wants` asks for
+    first/last (rank selection stays on the XLA kernel). Output follows
+    the XLA kernel's conventions: empty segments carry count 0, sum 0 and
+    dtype-extrema min/max sentinels; `wants` (same keys as
+    local_segment_partials) subsets the returned aggregates."""
     if not PALLAS_AVAILABLE:
         return None
-    bases = applicable(np.asarray(seg_ids))
+    if wants and (wants.get("want_first") or wants.get("want_last")):
+        return None
+    seg_ids = np.asarray(seg_ids)
+    bases = applicable(seg_ids)
     if bases is None:
         return None
     n = len(values)
@@ -133,6 +192,6 @@ def segment_partials_pallas(values: np.ndarray, valid: np.ndarray,
         jnp.asarray(seg_ids, dtype=jnp.int32),
         num_segments=num_segments, interpret=interpret)
     host = {k: np.asarray(v) for k, v in out.items()}
-    # empty segments: min/max carry ±inf from the identity — mirror the
-    # XLA kernel's convention (callers mask by count)
+    if wants is not None:
+        host = {k: v for k, v in host.items() if wants.get(_WANT_OF[k])}
     return host

@@ -29,6 +29,12 @@ from . import kernels
 _DENSE_BUCKET_LIMIT = 1 << 21
 
 
+def _FORCE_DEVICE() -> bool:
+    import os
+
+    return os.environ.get("CNOSDB_TPU_FORCE_DEVICE_PATH", "0") == "1"
+
+
 @dataclass
 class AggSpec:
     func: str               # count/count_star/sum/mean/min/max/first/last
@@ -216,7 +222,12 @@ def launch_scan_aggregate(batch: ScanBatch, query: TpuQuery):
     # CPU scatter lowering — the fused path is for real devices
     from .placement import scan_device
 
-    cpu_mode = scan_device().platform == "cpu"
+    # CNOSDB_TPU_FORCE_DEVICE_PATH=1 is a TEST override: it runs the fused
+    # DeviceBatch/launch_fused program (and the aggregate_column_host XLA
+    # wrapper) on whatever backend jax has — CI exercises the device
+    # placement on the CPU backend, where it would otherwise never engage
+    # (round-3 verdict: the device path shipped with zero test coverage)
+    cpu_mode = scan_device().platform == "cpu" and not _FORCE_DEVICE()
     eff_buckets = dense_span if dense_span <= _DENSE_BUCKET_LIMIT \
         else min(n, dense_span)   # sparse remap keeps occupied buckets only
     if gf_dims and n_groups * eff_buckets > (1 << 24):

@@ -1035,8 +1035,17 @@ class QueryExecutor:
             # true (and NOT EXISTS false) — never a semi-join. Execute the
             # body with the correlation conjunct dropped first so invalid
             # names (bad table/column) still raise instead of being
-            # silently short-circuited away.
-            probe = dataclasses.replace(q, where=self._conjoin(residual))
+            # silently short-circuited away. Name resolution happens at
+            # plan time, so a constant-false time bound prunes the probe's
+            # scan to nothing (single-table bodies only: in a join body an
+            # unqualified `time` would be ambiguous).
+            probe_where = self._conjoin(residual)
+            if q.from_item is None:
+                never = expr_mod.BinOp("<", Column("time"),
+                                       Literal(-(2 ** 62)))
+                probe_where = never if probe_where is None \
+                    else expr_mod.BinOp("and", probe_where, never)
+            probe = dataclasses.replace(q, where=probe_where)
             self._select(probe, session)
             return Literal(not e.negated)
         inner_q = dataclasses.replace(
