@@ -67,13 +67,18 @@ class Join:
 
 @dataclass
 class UnionStmt:
-    """UNION [ALL] chain; ORDER BY/LIMIT apply to the combined result."""
+    """Set-operation chain (UNION/INTERSECT/EXCEPT [ALL]); ORDER BY/LIMIT
+    apply to the combined result. `ops[i]` is the operator joining
+    selects[i] and selects[i+1] (empty = all "union", the pre-set-op
+    wire shape); INTERSECT binds tighter than UNION/EXCEPT, so an
+    intersect chain nests as a UnionStmt inside `selects`."""
 
-    selects: list                  # SelectStmt
+    selects: list                  # SelectStmt | UnionStmt (nested chain)
     alls: list = field(default_factory=list)   # per-operator ALL flags
     order_by: list = field(default_factory=list)
     limit: int | None = None
     offset: int | None = None
+    ops: list = field(default_factory=list)    # union|intersect|except
 
 
 @dataclass

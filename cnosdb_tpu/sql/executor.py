@@ -1415,16 +1415,7 @@ class QueryExecutor:
     def _distinct(self, rs: ResultSet) -> ResultSet:
         seen = set()
         keep = []
-        nan_token = object()  # NaN keys must compare equal (SQL: NULLs are
-        # not distinct from each other; outer-join padding is NaN)
-        for i in range(rs.n_rows):
-            key = []
-            for c in rs.columns:
-                v = c[i] if c.dtype == object else c[i].item()
-                if isinstance(v, float) and v != v:
-                    v = nan_token
-                key.append(v)
-            key = tuple(key)
+        for i, key in enumerate(_row_keys(rs.columns)):
             if key not in seen:
                 seen.add(key)
                 keep.append(i)
@@ -1432,21 +1423,35 @@ class QueryExecutor:
         return ResultSet(rs.names, [c[idx] for c in rs.columns])
 
     def _union(self, stmt: ast.UnionStmt, session: Session) -> ResultSet:
+        """Set-operation chain. INTERSECT-precedence nesting is resolved at
+        parse time (a nested chain arrives as a UnionStmt branch); operators
+        at one level apply left to right. NULLs are not distinct from each
+        other in set-op row matching (SQL; reference via DataFusion)."""
         from .analyzer import analyze
 
         stmt = analyze(stmt)   # union-level ORDER BY desugaring
-        results = [self._select(s, session) for s in stmt.selects]
+
+        def run(s):
+            return self._union(s, session) if isinstance(s, ast.UnionStmt) \
+                else self._select(s, session)
+
+        results = [run(s) for s in stmt.selects]
         width = len(results[0].names)
         for r in results[1:]:
             if len(r.names) != width:
-                raise QueryError("UNION branches must have equal arity")
+                raise QueryError(
+                    "set-operation branches must have equal arity")
         names = results[0].names
         acc = [results[0].columns[i] for i in range(width)]
-        for r, all_ in zip(results[1:], stmt.alls):
-            acc = [_concat_cols(acc[i], r.columns[i]) for i in range(width)]
-            if not all_:
-                rs_tmp = self._distinct(ResultSet(names, acc))
-                acc = list(rs_tmp.columns)
+        ops = stmt.ops or ["union"] * len(stmt.alls)
+        for r, all_, op in zip(results[1:], stmt.alls, ops):
+            if op == "union":
+                acc = [_concat_cols(acc[i], r.columns[i])
+                       for i in range(width)]
+                if not all_:
+                    acc = list(self._distinct(ResultSet(names, acc)).columns)
+            else:
+                acc = _set_op_cols(acc, list(r.columns), op, all_)
         rs = ResultSet(names, acc)
         env = {n: c for n, c in zip(names, acc)}
         return _order_limit(rs, stmt.order_by, stmt.limit, stmt.offset, env)
@@ -2203,12 +2208,30 @@ def _apply_gapfill(plan: AggregatePlan, rs: ResultSet) -> ResultSet:
 _null_safe_key = rel.null_safe_key
 
 
+def _positional_order(order_by, rs: ResultSet):
+    """ORDER BY n (a bare integer literal) is positional over the output
+    columns in every SQL dialect; resolve it to the column array itself so
+    each _order_limit caller (set-op chain, relational join path, scan
+    path) gets it without needing the name in its env."""
+    out = []
+    for oe, asc in order_by:
+        pos = oe.value if isinstance(oe, Literal) else oe
+        if isinstance(pos, int) and not isinstance(pos, bool):
+            if not 1 <= pos <= len(rs.names):
+                raise QueryError(f"ORDER BY position {pos} is out of range")
+            oe = np.asarray(rs.columns[pos - 1])
+        out.append((oe, asc))
+    return out
+
+
 def _order_limit(rs: ResultSet, order_by, limit, offset, env) -> ResultSet:
     n = rs.n_rows
     if n and order_by:
+        order_by = _positional_order(order_by, rs)
         keys = []
         for oe, asc in reversed(order_by):
-            v = oe.eval(env, np) if isinstance(oe, Expr) else env[oe]
+            v = oe if isinstance(oe, np.ndarray) \
+                else oe.eval(env, np) if isinstance(oe, Expr) else env[oe]
             vals, nulls = _null_safe_key(np.asarray(v))
             keys.append(vals)
             if nulls is not None:
@@ -2241,6 +2264,58 @@ def _concat_cols(a: np.ndarray, b: np.ndarray) -> np.ndarray:
     return np.concatenate([a.astype(object), b.astype(object)])
 
 
+_NAN_KEY = object()  # NULL/NaN rows compare equal in DISTINCT and set ops
+
+
+def _row_keys(columns) -> list:
+    """Hashable per-row keys over a column set. Float NaN (the NULL /
+    outer-join padding value) maps to a shared token so NULLs are not
+    distinct from each other — SQL DISTINCT / set-operation semantics."""
+    if not columns:
+        return []
+    keys = []
+    for i in range(len(columns[0])):
+        key = []
+        for c in columns:
+            v = c[i] if c.dtype == object else c[i].item()
+            if v is None or (isinstance(v, float) and v != v):
+                v = _NAN_KEY  # None (object col) and NaN (float col) are
+                # both NULL; they must match across branch dtypes
+            key.append(v)
+        keys.append(tuple(key))
+    return keys
+
+
+def _set_op_cols(left: list, right: list, op: str, all_: bool) -> list:
+    """INTERSECT/EXCEPT over column sets, preserving left-operand row
+    order. Bag semantics for ALL (INTERSECT ALL keeps min(l,r) copies of
+    a row, EXCEPT ALL keeps l−r); the distinct forms dedupe the output.
+    The reference lowers these to DataFusion semi/anti joins + distinct
+    (query_server inherits them from its forked sqlparser/DataFusion)."""
+    from collections import Counter
+
+    budget = Counter(_row_keys(right))
+    keep: list[int] = []
+    if all_:
+        for i, k in enumerate(_row_keys(left)):
+            if budget[k] > 0:
+                budget[k] -= 1
+                if op == "intersect":
+                    keep.append(i)
+            elif op == "except":
+                keep.append(i)
+    else:
+        seen = set()
+        for i, k in enumerate(_row_keys(left)):
+            if k in seen:
+                continue
+            seen.add(k)
+            if (budget[k] > 0) == (op == "intersect"):
+                keep.append(i)
+    idx = np.array(keep, dtype=np.int64)
+    return [c[idx] for c in left]
+
+
 def _mixed_order(order_by, env, n):
     """Mixed asc/desc via one lexsort over rank-inverted keys.
 
@@ -2249,7 +2324,8 @@ def _mixed_order(order_by, env, n):
     ranks (np.unique inverse), which lexsort ascends over correctly."""
     keys = []
     for oe, asc in reversed(order_by):
-        v = oe.eval(env, np) if isinstance(oe, Expr) else env[oe]
+        v = oe if isinstance(oe, np.ndarray) \
+            else oe.eval(env, np) if isinstance(oe, Expr) else env[oe]
         vals, nulls = _null_safe_key(np.asarray(v))
         if not asc:
             _, inv = np.unique(vals, return_inverse=True)

@@ -248,7 +248,7 @@ class Parser:
 
     def parse_statement(self):
         k = self.kw()
-        if k == "SELECT":
+        if k in ("SELECT", "WITH"):
             return self.parse_query()
         if k == "EXPLAIN":
             self.next()
@@ -381,22 +381,73 @@ class Parser:
 
     # -- SELECT ----------------------------------------------------------
     def parse_query(self):
-        """SELECT [UNION [ALL] SELECT]... — a trailing ORDER BY/LIMIT
-        belongs to the whole union (standard SQL set-op scoping)."""
-        first = self.parse_select()
-        if self.kw() != "UNION":
+        """[WITH ctes] set-expression. Set-op grammar with standard
+        precedence (INTERSECT binds tighter than UNION/EXCEPT, both
+        left-associative); a trailing ORDER BY/LIMIT belongs to the whole
+        chain. CTEs are expanded inline at parse time — each reference
+        becomes a derived relation (SubqueryRef), the same planning shape
+        the reference gets from DataFusion's CTE inlining."""
+        if self.accept_kw("WITH"):
+            ctes: dict[str, object] = {}
+            while True:
+                name = self.expect_ident()
+                cols = None
+                if self.accept_op("("):
+                    cols = [self.expect_ident()]
+                    while self.accept_op(","):
+                        cols.append(self.expect_ident())
+                    self.expect_op(")")
+                self.expect_kw("AS")
+                self.expect_op("(")
+                body = self.parse_query()
+                self.expect_op(")")
+                # earlier CTEs are visible in later bodies (standard
+                # non-recursive WITH scoping); self-reference is not
+                body = _expand_ctes(body, ctes)
+                if cols is not None:
+                    body = _apply_cte_columns(body, cols, name)
+                if name in ctes:
+                    raise ParserError(f"duplicate CTE name {name!r}")
+                ctes[name] = body
+                if not self.accept_op(","):
+                    break
+            return _expand_ctes(self.parse_set_query(), ctes)
+        return self.parse_set_query()
+
+    def parse_set_query(self):
+        """intersect-chain ((UNION|EXCEPT) [ALL] intersect-chain)*"""
+        first = self.parse_intersect_chain()
+        if self.kw() not in ("UNION", "EXCEPT"):
             return first
-        selects, alls = [first], []
-        while self.accept_kw("UNION"):
+        selects, alls, ops = [first], [], []
+        while self.kw() in ("UNION", "EXCEPT"):
+            ops.append(self.next().value.lower())
+            alls.append(self.accept_kw("ALL"))
+            selects.append(self.parse_intersect_chain())
+        return self._make_setop(selects, alls, ops)
+
+    def parse_intersect_chain(self):
+        first = self.parse_select()
+        if self.kw() != "INTERSECT":
+            return first
+        selects, alls, ops = [first], [], []
+        while self.accept_kw("INTERSECT"):
+            ops.append("intersect")
             alls.append(self.accept_kw("ALL"))
             selects.append(self.parse_select())
+        return self._make_setop(selects, alls, ops)
+
+    @staticmethod
+    def _make_setop(selects, alls, ops):
+        """Hoist the LAST branch's ORDER BY/LIMIT to the whole chain
+        (standard SQL set-op scoping); earlier branches may not have one."""
         for s in selects[:-1]:
             if s.order_by or s.limit is not None:
-                raise ParserError(
-                    "ORDER BY/LIMIT must follow the last UNION branch")
+                raise ParserError("ORDER BY/LIMIT must follow the last "
+                                  "set-operation branch")
         last = selects[-1]
         u = ast.UnionStmt(selects, alls, last.order_by, last.limit,
-                          last.offset)
+                          last.offset, ops)
         last.order_by, last.limit, last.offset = [], None, None
         return u
 
@@ -482,7 +533,8 @@ class Parser:
         elif (self.peek().kind == "ident"
               and self.kw() not in _RESERVED
               and self.kw() not in ("GROUP", "HAVING", "ORDER", "LIMIT",
-                                    "OFFSET", "UNION")):
+                                    "OFFSET", "UNION", "INTERSECT",
+                                    "EXCEPT")):
             alias = self.next().value
         return ast.TableRef(name, alias, database)
 
@@ -495,7 +547,8 @@ class Parser:
             alias = self.expect_ident()
         elif (self.peek().kind == "ident"
               and self.kw() not in ("FROM", "WHERE", "GROUP", "HAVING",
-                                    "ORDER", "LIMIT", "OFFSET")):
+                                    "ORDER", "LIMIT", "OFFSET", "UNION",
+                                    "INTERSECT", "EXCEPT")):
             alias = self.next().value
         return ast.SelectItem(e, alias)
 
@@ -1176,12 +1229,94 @@ class Parser:
         return WindowFunc(f.name, f.args, partition_by, order_by)
 
 
+def _expand_ctes(stmt, ctes: dict):
+    """Inline every CTE reference as a derived relation. Each reference
+    gets its OWN deep copy of the body (a CTE used twice materializes
+    twice — correctness first; the planner sees plain SubqueryRefs).
+    Walks FROM trees, set-op branches, and subquery expressions; a real
+    table shadowed by a CTE name resolves to the CTE (standard scoping).
+    """
+    if not ctes:
+        return stmt
+    import copy as _copy
+
+    from .expr import Expr, iter_child_exprs
+
+    def walk_from(fi):
+        if isinstance(fi, ast.TableRef):
+            if fi.database is None and fi.name in ctes:
+                return ast.SubqueryRef(_copy.deepcopy(ctes[fi.name]),
+                                       fi.alias or fi.name)
+            return fi
+        if isinstance(fi, ast.Join):
+            fi.left = walk_from(fi.left)
+            fi.right = walk_from(fi.right)
+            walk_expr(fi.on)
+            return fi
+        if isinstance(fi, ast.SubqueryRef):
+            fi.select = _expand_ctes(fi.select, ctes)
+            return fi
+        return fi
+
+    def walk_expr(e):
+        if not isinstance(e, Expr):
+            return
+        sel = getattr(e, "select", None)
+        if isinstance(sel, (ast.SelectStmt, ast.UnionStmt)):
+            e.select = _expand_ctes(sel, ctes)
+        for c in iter_child_exprs(e):
+            walk_expr(c)
+
+    if isinstance(stmt, ast.UnionStmt):
+        stmt.selects = [_expand_ctes(s, ctes) for s in stmt.selects]
+        for oe, _ in stmt.order_by:
+            walk_expr(oe)
+        return stmt
+    if not isinstance(stmt, ast.SelectStmt):
+        return stmt
+    if stmt.table is not None and stmt.database is None \
+            and stmt.table in ctes:
+        stmt.from_item = ast.SubqueryRef(_copy.deepcopy(ctes[stmt.table]),
+                                         stmt.table)
+        stmt.table = None
+    elif stmt.from_item is not None:
+        stmt.from_item = walk_from(stmt.from_item)
+    for it in stmt.items:
+        walk_expr(it.expr)
+    walk_expr(stmt.where)
+    walk_expr(stmt.having)
+    for oe, _ in stmt.order_by:
+        walk_expr(oe)
+    for g in stmt.group_by:
+        walk_expr(g)
+    return stmt
+
+
+def _apply_cte_columns(body, cols: list, name: str):
+    """WITH name(c1, c2) AS (...) — rename the body's output columns.
+    Output names come from the first branch of a set-op chain."""
+    target = body
+    while isinstance(target, ast.UnionStmt):
+        target = target.selects[0]
+    if any(it.expr == "*" for it in target.items):
+        raise ParserError(
+            f"CTE {name!r} with a column list requires explicit select "
+            "items (no *)")
+    if len(target.items) != len(cols):
+        raise ParserError(
+            f"CTE {name!r} column list has {len(cols)} names for "
+            f"{len(target.items)} select items")
+    target.items = [ast.SelectItem(it.expr, c)
+                    for it, c in zip(target.items, cols)]
+    return body
+
+
 _RESERVED = {
     "SELECT", "FROM", "WHERE", "GROUP", "BY", "HAVING", "ORDER", "LIMIT",
     "OFFSET", "AND", "OR", "NOT", "AS", "ASC", "DESC", "IN", "BETWEEN",
     "IS", "CASE", "WHEN", "THEN", "ELSE", "END", "UNION", "JOIN", "ON",
     "INNER", "LEFT", "RIGHT", "FULL", "CROSS", "INSERT", "INTO", "VALUES",
-    "DELETE", "UPDATE", "SET",
+    "DELETE", "UPDATE", "SET", "INTERSECT", "EXCEPT", "WITH",
 }
 
 

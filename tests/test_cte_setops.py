@@ -1,0 +1,180 @@
+"""CTEs (WITH … AS) and set operations (INTERSECT/EXCEPT [ALL]).
+
+The reference inherits these from its forked DataFusion/sqlparser
+(query_server/query/Cargo.toml:63-64); here the parser expands CTEs
+inline into derived relations and the executor runs set-op chains with
+SQL bag semantics (sql/parser.py parse_query / executor._set_op_cols).
+"""
+import numpy as np
+import pytest
+
+from cnosdb_tpu.errors import ParserError, QueryError
+from cnosdb_tpu.parallel.coordinator import Coordinator
+from cnosdb_tpu.parallel.meta import MetaStore
+from cnosdb_tpu.sql.executor import QueryExecutor
+from cnosdb_tpu.storage.engine import TsKv
+
+
+@pytest.fixture
+def db(tmp_path):
+    meta = MetaStore(str(tmp_path / "meta.json"))
+    engine = TsKv(str(tmp_path / "data"))
+    coord = Coordinator(meta, engine)
+    ex = QueryExecutor(meta, coord)
+    ex.execute_one("CREATE TABLE cpu (v DOUBLE, TAGS(host, region))")
+    ex.execute_one(
+        "INSERT INTO cpu (time, host, region, v) VALUES "
+        "(1, 'a', 'eu', 1.0), (2, 'b', 'eu', 2.0), "
+        "(3, 'c', 'us', 3.0), (4, 'a', 'us', 4.0)")
+    yield ex
+    coord.close()
+
+
+def q(ex, sql):
+    rs = ex.execute_one(sql)
+    return [tuple(c[i] if c.dtype == object else c[i].item()
+                  for c in rs.columns) for i in range(rs.n_rows)]
+
+
+# -- set operations ---------------------------------------------------------
+
+def test_intersect_distinct(db):
+    out = q(db, "SELECT host FROM cpu INTERSECT "
+                "SELECT host FROM cpu WHERE v > 2.5 ORDER BY host")
+    assert out == [("a",), ("c",)]
+
+
+def test_except_distinct(db):
+    out = q(db, "SELECT host FROM cpu EXCEPT "
+                "SELECT host FROM cpu WHERE v > 2.5")
+    assert out == [("b",)]
+
+
+def test_except_all_bag_semantics(db):
+    # left bag has 'a' twice; right (v>3.5) has it once → one 'a' survives
+    out = q(db, "SELECT host FROM cpu EXCEPT ALL "
+                "SELECT host FROM cpu WHERE v > 3.5 ORDER BY host")
+    assert out == [("a",), ("b",), ("c",)]
+
+
+def test_intersect_all_keeps_duplicates(db):
+    out = q(db, "SELECT host FROM cpu INTERSECT ALL SELECT host FROM cpu "
+                "ORDER BY host")
+    assert out == [("a",), ("a",), ("b",), ("c",)]
+
+
+def test_intersect_all_min_multiplicity(db):
+    # left has 'a' twice, right once → INTERSECT ALL keeps min(2,1)=1
+    out = q(db, "SELECT host FROM cpu INTERSECT ALL "
+                "SELECT host FROM cpu WHERE v < 1.5")
+    assert out == [("a",)]
+
+
+def test_intersect_binds_tighter_than_union(db):
+    # UNION (x INTERSECT y): the INTERSECT evaluates first.
+    # hosts(v>1)={a,b,c}, hosts(v<3)={a,b} → intersect {a,b}, ∪ {'zz'}
+    out = q(db, "SELECT 'zz' UNION SELECT host FROM cpu WHERE v > 1 "
+                "INTERSECT SELECT host FROM cpu WHERE v < 3 ORDER BY 1")
+    assert out == [("a",), ("b",), ("zz",)]
+
+
+def test_setop_chain_left_associative(db):
+    # ({a,b,c} EXCEPT {a}) EXCEPT {b} = {c}
+    out = q(db, "SELECT host FROM cpu EXCEPT "
+                "SELECT host FROM cpu WHERE v = 1.0 EXCEPT "
+                "SELECT host FROM cpu WHERE v = 2.0")
+    assert out == [("c",)]
+
+
+def test_setop_nulls_not_distinct(db):
+    # NULL matches NULL in set-op row comparison (SQL semantics)
+    out = q(db, "SELECT CASE WHEN v > 10 THEN v END FROM cpu "
+                "INTERSECT SELECT CASE WHEN v > 20 THEN v END FROM cpu")
+    assert len(out) == 1  # single NULL row: NULL matches NULL
+    v = out[0][0]
+    assert v is None or v != v  # None (object col) or NaN (float col)
+
+
+def test_setop_arity_mismatch_rejected(db):
+    with pytest.raises(QueryError):
+        q(db, "SELECT host, v FROM cpu INTERSECT SELECT host FROM cpu")
+
+
+def test_setop_order_by_applies_to_whole_chain(db):
+    out = q(db, "SELECT host FROM cpu WHERE v < 2 UNION ALL "
+                "SELECT host FROM cpu WHERE v > 2.5 ORDER BY host DESC")
+    assert out == [("c",), ("a",), ("a",)]
+
+
+def test_order_by_only_on_last_branch(db):
+    with pytest.raises(ParserError):
+        q(db, "SELECT host FROM cpu ORDER BY host INTERSECT "
+              "SELECT host FROM cpu")
+
+
+# -- CTEs -------------------------------------------------------------------
+
+def test_basic_cte(db):
+    out = q(db, "WITH t AS (SELECT host, v FROM cpu WHERE v >= 2.0) "
+                "SELECT host FROM t ORDER BY host")
+    assert out == [("a",), ("b",), ("c",)]
+
+
+def test_cte_column_list(db):
+    out = q(db, "WITH t(h, val) AS (SELECT host, v FROM cpu) "
+                "SELECT h, val FROM t WHERE val > 2 ORDER BY h")
+    assert out == [("a", 4.0), ("c", 3.0)]
+
+
+def test_cte_chained_references(db):
+    out = q(db, "WITH a AS (SELECT host FROM cpu WHERE v < 2), "
+                "b AS (SELECT host FROM a) SELECT host FROM b")
+    assert out == [("a",)]
+
+
+def test_cte_referenced_twice_in_join(db):
+    out = q(db, "WITH t AS (SELECT host, v FROM cpu) "
+                "SELECT t1.host FROM t t1 JOIN t t2 ON t1.host = t2.host "
+                "WHERE t2.v > 3 ORDER BY t1.host")
+    assert out == [("a",), ("a",)]
+
+
+def test_cte_with_aggregate_body(db):
+    out = q(db, "WITH s AS (SELECT host, sum(v) AS total FROM cpu "
+                "GROUP BY host) SELECT host, total FROM s "
+                "WHERE total > 1.5 ORDER BY host")
+    assert out == [("a", 5.0), ("b", 2.0), ("c", 3.0)]
+
+
+def test_cte_over_setop_body(db):
+    out = q(db, "WITH t AS (SELECT host FROM cpu EXCEPT "
+                "SELECT host FROM cpu WHERE v > 2.5) SELECT host FROM t")
+    assert out == [("b",)]
+
+
+def test_cte_in_subquery_expression(db):
+    out = q(db, "WITH hi AS (SELECT max(v) AS m FROM cpu) "
+                "SELECT host FROM cpu WHERE v = (SELECT m FROM hi)")
+    assert out == [("a",)]
+
+
+def test_cte_shadows_real_table(db):
+    out = q(db, "WITH cpu AS (SELECT 'x' AS host) SELECT host FROM cpu")
+    assert out == [("x",)]
+
+
+def test_duplicate_cte_name_rejected(db):
+    with pytest.raises(ParserError):
+        q(db, "WITH t AS (SELECT 1), t AS (SELECT 2) SELECT * FROM t")
+
+
+def test_cte_column_list_arity_rejected(db):
+    with pytest.raises(ParserError):
+        q(db, "WITH t(a, b) AS (SELECT host FROM cpu) SELECT a FROM t")
+
+
+def test_cte_union_all_in_body(db):
+    out = q(db, "WITH t AS (SELECT host FROM cpu WHERE v = 1.0 UNION ALL "
+                "SELECT host FROM cpu WHERE v = 4.0) "
+                "SELECT count(host) AS n FROM t")
+    assert out == [(2,)]
