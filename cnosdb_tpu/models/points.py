@@ -37,6 +37,66 @@ class SeriesRows:
         return len(self.timestamps)
 
 
+def _check_field_value(vt: ValueType, v, fname: str):
+    """Reject values a field type cannot hold at WRITE time (the
+    reference fails the cast during planning: 'Can't cast value -3 to
+    type UInt64'); deferring to flush would corrupt the memcache."""
+    import numbers
+
+    from .schema import SchemaError
+
+    if v is None:
+        return
+    if vt == ValueType.UNSIGNED:
+        if isinstance(v, bool) or not isinstance(
+                v, (int, np.integer)) or int(v) < 0:
+            raise SchemaError(
+                f"can't cast value {v!r} to BIGINT UNSIGNED for {fname!r}")
+    elif vt == ValueType.INTEGER:
+        if isinstance(v, bool) or not isinstance(v, numbers.Real):
+            raise SchemaError(
+                f"can't cast value {v!r} to BIGINT for {fname!r}")
+
+    elif vt == ValueType.FLOAT:
+        if isinstance(v, bool) or not isinstance(v, numbers.Real):
+            raise SchemaError(
+                f"can't cast value {v!r} to DOUBLE for {fname!r}")
+    elif vt == ValueType.BOOLEAN:
+        # integers cast by truthiness and 'true'/'false' strings parse
+        # (reference: update_field.slt sets f2_boolean = 3 and 'False')
+        if isinstance(v, (bool, np.bool_, int, np.integer)):
+            return
+        if isinstance(v, str) and v.strip().lower() in (
+                "true", "false", "t", "f", "yes", "no"):
+            return
+        raise SchemaError(
+            f"can't cast value {v!r} to BOOLEAN for {fname!r}")
+    elif vt in (ValueType.STRING, ValueType.GEOMETRY):
+        if not isinstance(v, str):
+            raise SchemaError(
+                f"can't cast value {v!r} to STRING for {fname!r}")
+
+
+def _time_ns(v) -> int:
+    """Coerce a time cell to i64 ns: ints pass through; arrow/pandas
+    Timestamp, datetime and datetime64 (COPY FROM csv/parquet type
+    inference) convert exactly."""
+    import datetime as _dt
+
+    if isinstance(v, np.datetime64):
+        return int(v.astype("datetime64[ns]").astype(np.int64))
+    value = getattr(v, "value", None)   # pandas Timestamp: ns since epoch
+    if value is not None and type(v).__name__ == "Timestamp":
+        return int(value)
+    if isinstance(v, _dt.datetime):
+        if v.tzinfo is None:
+            v = v.replace(tzinfo=_dt.timezone.utc)
+        delta = v - _dt.datetime(1970, 1, 1, tzinfo=_dt.timezone.utc)
+        secs = delta.days * 86400 + delta.seconds
+        return secs * 1_000_000_000 + delta.microseconds * 1_000
+    return int(v)
+
+
 def ts_bounds(col) -> tuple[int, int]:
     """(min, max) of a timestamp column in either accepted representation
     (list[int] or np.int64 array); callers must ensure it is non-empty."""
@@ -104,13 +164,38 @@ class WriteBatch:
         for r in rows:
             key = SeriesKey(table, [Tag(t, str(r[t])) for t in tag_names if r.get(t) is not None])
             groups.setdefault(key, []).append(r)
+        from .schema import SchemaError
+
         wb = cls()
         for key, rs in groups.items():
-            ts = [int(r["time"]) for r in rs]
+            ts = [_time_ns(r["time"]) for r in rs]
             fields = {}
             for fname, vt in field_types.items():
                 vals = [r.get(fname) for r in rs]
                 if any(v is not None for v in vals):
+                    for v in vals:
+                        _check_field_value(vt, v, fname)
+                    # boolean columns cast ints (truthiness) and
+                    # 'true'/'false' strings
+                    if vt == ValueType.BOOLEAN:
+                        vals = [None if v is None
+                                else (v.strip().lower() in
+                                      ("true", "t", "yes")
+                                      if isinstance(v, str) else bool(v))
+                                for v in vals]
+                    if vt == ValueType.INTEGER:
+                        # float literals cast by truncation toward zero
+                        # (reference: INSERT 23.456 into BIGINT → 23);
+                        # NaN/Inf cannot truncate
+                        for v in vals:
+                            if isinstance(v, float) and (
+                                    v != v or v in (float("inf"),
+                                                    float("-inf"))):
+                                raise SchemaError(
+                                    f"can't cast value {v!r} to BIGINT "
+                                    f"for {fname!r}")
+                        vals = [None if v is None else int(v)
+                                for v in vals]
                     fields[fname] = (int(vt), vals)
             wb.add_series(table, SeriesRows(key, ts, fields))
         return wb

@@ -86,6 +86,8 @@ class ValueType(enum.IntEnum):
             "GEOMETRY": cls.GEOMETRY,
         }
         key = s.strip().upper()
+        if key.startswith("GEOMETRY("):
+            return cls.GEOMETRY   # GEOMETRY(subtype, srid) — WKT strings
         if key not in m:
             raise SchemaError(f"unknown value type {s!r}")
         return m[key]
@@ -148,6 +150,9 @@ class TableColumn:
     name: str
     column_type: ColumnType
     encoding: Encoding = Encoding.DEFAULT
+    # DDL gave an explicit CODEC(); DESCRIBE renders DEFAULT otherwise
+    # (reference keeps Encoding::Default distinct from the resolved codec)
+    explicit_codec: bool = False
 
     def default_encoding(self) -> Encoding:
         ct = self.column_type
@@ -172,6 +177,7 @@ class TableColumn:
             "value_type": int(self.column_type.value_type),
             "precision": int(self.column_type.precision),
             "encoding": int(self.encoding),
+            "explicit_codec": self.explicit_codec,
         }
 
     @classmethod
@@ -183,6 +189,7 @@ class TableColumn:
                 ColumnKind(d["kind"]), ValueType(d["value_type"]), Precision(d["precision"])
             ),
             encoding=Encoding(d["encoding"]),
+            explicit_codec=bool(d.get("explicit_codec", False)),
         )
 
 
@@ -307,12 +314,16 @@ class TskvTableSchema:
     def new_measurement(cls, tenant: str, db: str, name: str,
                         tags: list[str],
                         fields: list[tuple[str, ValueType]],
-                        precision: Precision = Precision.NS) -> "TskvTableSchema":
+                        precision: Precision = Precision.NS,
+                        sort_tags: bool = True) -> "TskvTableSchema":
         """Build a schema the way line-protocol auto-creation does
-        (reference database.rs build_write_group schema inference)."""
+        (reference database.rs build_write_group schema inference).
+        CREATE TABLE passes sort_tags=False: declared column order is the
+        SELECT * order (reference preserves it; only line-protocol
+        inference canonicalizes by sorting)."""
         cols = [TableColumn(0, TIME_FIELD_NAME, ColumnType.time(precision), Encoding.DELTA_TS)]
         nid = 1
-        for t in sorted(tags):
+        for t in (sorted(tags) if sort_tags else tags):
             cols.append(TableColumn(nid, t, ColumnType.tag(), Encoding.ZSTD))
             nid += 1
         for fname, vt in fields:

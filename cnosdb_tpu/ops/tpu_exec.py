@@ -498,8 +498,22 @@ def launch_scan_aggregate(batch: ScanBatch, query: TpuQuery):
                 vt, vals, valid = ValueType.INTEGER, batch.ts, \
                     np.ones(n, dtype=bool)
             elif cname not in batch.fields:
-                col_results[cname] = None
-                continue
+                if batch.n_series:
+                    # aggregate over a TAG column (count(station) etc.):
+                    # synthesize per-row values from the series keys; the
+                    # planner already validated the name, so a non-field
+                    # here is a tag (reference: tags are Utf8 dictionary
+                    # columns and aggregate like strings)
+                    per = np.array(
+                        [None if k is None else k.tag_value(cname)
+                         for k in batch.series_keys], dtype=object)
+                    vals = per[batch.sid_ordinal]
+                    valid = np.array([x is not None for x in vals],
+                                     dtype=bool)
+                    vt = ValueType.STRING
+                else:
+                    col_results[cname] = None
+                    continue
             else:
                 vt, vals, valid = batch.fields[cname]
             if vt in (ValueType.STRING, ValueType.GEOMETRY):

@@ -76,6 +76,7 @@ class MetaStore:
         self.nodes: dict[int, NodeInfo] = \
             {node_id: NodeInfo(node_id)} if register_self else {}
         self.streams: dict[str, dict] = {}  # stream name → definition
+        self.stream_tables: dict[str, dict] = {}  # stream table → binding
         self.members: dict[str, dict[str, str]] = {}  # tenant → {user → role}
         self.roles: dict[str, dict[str, dict]] = {}   # tenant → {role → spec}
         # external (file-backed) tables: owner → {name → {path, fmt, header}}
@@ -135,6 +136,7 @@ class MetaStore:
             "buckets": {o: [b.to_dict() for b in bs] for o, bs in self.buckets.items()},
             "nodes": {str(k): v.to_dict() for k, v in self.nodes.items()},
             "streams": self.streams,
+            "stream_tables": self.stream_tables,
             "members": self.members,
             "roles": self.roles,
             "externals": self.externals,
@@ -172,6 +174,7 @@ class MetaStore:
                         for o, bs in d["buckets"].items()}
         self.nodes = {int(k): NodeInfo.from_dict(v) for k, v in d["nodes"].items()}
         self.streams = d.get("streams", {})
+        self.stream_tables = d.get("stream_tables", {})
         self.members = d.get("members", {})
         self.roles = d.get("roles", {})
         self.externals = d.get("externals", {})
@@ -875,6 +878,33 @@ class MetaStore:
         with self.lock:
             if self.streams.pop(name, None) is not None:
                 self._persist()
+
+    # ------------------------------------------------- stream tables
+    # keyed by tenant.db.name: stream tables are catalog objects scoped
+    # like any table, not a global namespace
+    def create_stream_table(self, tenant: str, db: str, name: str,
+                            definition: dict,
+                            if_not_exists: bool = False):
+        key = f"{tenant}.{db}.{name}"
+        with self.lock:
+            if key in self.stream_tables:
+                if if_not_exists:
+                    return
+                raise MetaError(f"stream table {name!r} exists")
+            self.stream_tables[key] = definition
+            self._persist()
+
+    def drop_stream_table(self, tenant: str, db: str, name: str) -> bool:
+        key = f"{tenant}.{db}.{name}"
+        with self.lock:
+            if self.stream_tables.pop(key, None) is not None:
+                self._persist()
+                return True
+            return False
+
+    def stream_table(self, tenant: str, db: str, name: str) -> dict | None:
+        with self.lock:
+            return self.stream_tables.get(f"{tenant}.{db}.{name}")
 
     # ------------------------------------------------------------ placement
     def locate_bucket_for_write(self, tenant: str, db: str, ts: int,

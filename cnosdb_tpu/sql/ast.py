@@ -55,6 +55,17 @@ class SubqueryRef:
 
 
 @dataclass
+class ValuesRef:
+    """FROM (VALUES (...), (...)) [AS alias (col, ...)] — an inline
+    constant relation (reference via DataFusion's values plan; default
+    column names column1..columnN)."""
+
+    rows: list                     # rows of python constants
+    alias: str
+    columns: list | None = None
+
+
+@dataclass
 class Join:
     """left <kind> JOIN right ON on (reference reads these via DataFusion;
     here joins execute host-side over columnar results)."""
@@ -144,6 +155,7 @@ class ShowStmt:
     on_database: str | None = None
     limit: int | None = None
     offset: int | None = None
+    order_by: list = field(default_factory=list)   # (output col, asc)
 
 
 @dataclass
@@ -255,6 +267,8 @@ class CopyStmt:
     # CONNECTION = (...) credentials/endpoint for s3://, gcs://, azblob://
     # paths (reference parser.rs:1716, logical_planner.rs:835)
     options: dict = field(default_factory=dict)
+    # COPY INTO t(col, ...): positional mapping of source columns
+    columns: list | None = None
 
 
 @dataclass
@@ -311,6 +325,20 @@ class CreateStream:
     select_sql: str                 # raw text (persisted definition)
     interval_s: float = 10.0
     delay_ns: int = 0
+    if_not_exists: bool = False
+
+
+@dataclass
+class CreateStreamTable:
+    """CREATE STREAM TABLE name (cols) WITH (db=, table=,
+    event_time_column=) engine = tskv — a readable stream source bound
+    to an underlying tskv table (reference stream table providers,
+    query_server/query/src/stream/)."""
+
+    name: str
+    columns: list                  # (name, sql_type)
+    options: dict                  # db / table / event_time_column
+    engine: str = "tskv"
     if_not_exists: bool = False
 
 

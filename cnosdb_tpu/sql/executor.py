@@ -11,12 +11,14 @@ one mesh.
 """
 from __future__ import annotations
 
+import os
 from dataclasses import dataclass, field
 
 import numpy as np
 
 from ..errors import (
-    ExecutionError, FunctionError, PlanError, QueryError, TableNotFound,
+    CnosError, ExecutionError, FunctionError, PlanError, QueryError,
+    TableNotFound,
 )
 from ..models.points import WriteBatch
 from ..models.predicate import TimeRanges
@@ -175,12 +177,37 @@ class QueryExecutor:
             return ResultSet.message("ok")
         if isinstance(stmt, ast.CreateTable):
             return self._create_table(stmt, session)
+        if isinstance(stmt, ast.CreateStreamTable):
+            opts = {k.lower(): v for k, v in stmt.options.items()}
+            missing = {"db", "table", "event_time_column"} - set(opts)
+            if missing:
+                raise ExecutionError(
+                    f"CREATE STREAM TABLE requires WITH options "
+                    f"{sorted(missing)}")
+            if stmt.engine != "tskv":
+                raise ExecutionError(
+                    f"unsupported stream table engine {stmt.engine!r}")
+            self.meta.create_stream_table(
+                session.tenant, session.database, stmt.name,
+                {"db": opts["db"], "table": opts["table"],
+                 "event_time_column": opts["event_time_column"],
+                 "columns": list(stmt.columns), "engine": stmt.engine},
+                if_not_exists=stmt.if_not_exists)
+            return ResultSet.message("ok")
         if isinstance(stmt, ast.DropTable):
             db = stmt.database or session.database
             # an external table and a tskv table cannot share a name, so
             # whichever exists is the drop target
             if self.meta.drop_external_table(session.tenant, db, stmt.name):
                 return ResultSet.message("ok")
+            # a stream table only answers DROP when no tskv table claims
+            # the name (the real table always wins)
+            try:
+                self.meta.table(session.tenant, db, stmt.name)
+            except Exception:
+                if self.meta.drop_stream_table(session.tenant, db,
+                                               stmt.name):
+                    return ResultSet.message("ok")
             self.meta.drop_table(session.tenant, db, stmt.name,
                                  if_exists=stmt.if_exists)
             return ResultSet.message("ok")
@@ -451,10 +478,11 @@ class QueryExecutor:
             session.tenant, db, stmt.name, stmt.tags,
             [(n, vt) for n, vt, _ in fields],
             precision=self.meta.database(session.tenant, db)
-            .options.precision)
+            .options.precision, sort_tags=False)
         for n, _vt, codec in fields:
             if codec:
                 schema.column(n).encoding = Encoding.from_str(codec)
+                schema.column(n).explicit_codec = True
         self.meta.create_table(schema, stmt.if_not_exists)
         return ResultSet.message("ok")
 
@@ -463,8 +491,9 @@ class QueryExecutor:
         if stmt.action == "add_field":
             col = schema.add_column(stmt.column.name,
                                     ColumnType.field(ValueType.parse(stmt.column.type_name)))
-            if stmt.column.codec:
+            if stmt.column.codec and stmt.column.codec != "DEFAULT":
                 col.encoding = Encoding.from_str(stmt.column.codec)
+                col.explicit_codec = True
             else:
                 col.encoding = col.default_encoding()
         elif stmt.action == "add_tag":
@@ -486,57 +515,70 @@ class QueryExecutor:
         if stmt.kind == "tag_values":
             # (key, value) rows per the reference
             # (planner.rs:2819 show_tag_value_projections)
-            schema = self.meta.table(session.tenant, session.database,
-                                     stmt.table)
+            db = stmt.on_database or session.database
+            schema = self.meta.table(session.tenant, db, stmt.table)
+            if stmt.where is not None:
+                bad = stmt.where.columns() - set(schema.tag_names()) \
+                    - {"time"}
+                if bad:
+                    raise PlanError(
+                        f"SHOW TAG VALUES WHERE supports tag/time "
+                        f"predicates only, got {sorted(bad)}")
+            for name, _asc in stmt.order_by:
+                if name not in ("key", "value"):
+                    raise PlanError(
+                        f"SHOW TAG VALUES can only ORDER BY key/value, "
+                        f"got {name!r}")
             tags = schema.tag_names()
             op, names = stmt.tag_with or ("eq", [stmt.tag_key])
             keys = {"eq": [t for t in tags if t in names],
                     "ne": [t for t in tags if t not in names],
                     "in": [t for t in tags if t in names],
                     "notin": [t for t in tags if t not in names]}[op]
-            out_k: list[str] = []
-            out_v: list[str] = []
-            for key in keys:
-                for v in self.coord.tag_values(
-                        session.tenant, session.database, stmt.table, key):
-                    out_k.append(key)
-                    out_v.append(v)
+            pairs: set[tuple] = set()
+            if stmt.where is not None:
+                # derive values from the WHERE-surviving series only
+                skeys = self._filtered_series(session.tenant, db,
+                                              stmt.table, stmt.where)
+                for k in skeys:
+                    for key in keys:
+                        v = k.tag_value(key)
+                        if v is not None:
+                            pairs.add((key, v))
+            else:
+                for key in keys:
+                    for v in self.coord.tag_values(
+                            session.tenant, db, stmt.table, key):
+                        pairs.add((key, v))
+            rows = sorted(pairs)
+            for name, asc in reversed(stmt.order_by):
+                idx = 0 if name == "key" else 1
+                rows.sort(key=lambda r: r[idx], reverse=not asc)
+            if stmt.offset:
+                rows = rows[stmt.offset:]
             if stmt.limit is not None:
-                out_k, out_v = out_k[:stmt.limit], out_v[:stmt.limit]
+                rows = rows[:stmt.limit]
             return ResultSet(["key", "value"],
-                             [np.array(out_k, dtype=object),
-                              np.array(out_v, dtype=object)])
+                             [np.array([r[0] for r in rows], dtype=object),
+                              np.array([r[1] for r in rows], dtype=object)])
         if stmt.kind == "tag_keys":
             schema = self.meta.table(session.tenant, session.database, stmt.table)
             return ResultSet(["tag_key"],
                              [np.array(schema.tag_names(), dtype=object)])
         if stmt.kind == "series":
-            keys = self.coord.series_keys(session.tenant, session.database, stmt.table)
+            db = stmt.on_database or session.database
             if stmt.where is not None:
-                # tag predicates filter the series set (reference
-                # ShowTagBody.selection); time/field predicates would
-                # need a data scan — reject rather than silently ignore
-                schema = self.meta.table(session.tenant, session.database,
-                                         stmt.table)
-                tag_names = set(schema.tag_names())
-                bad = stmt.where.columns() - tag_names
-                if bad:
-                    raise PlanError(
-                        f"SHOW SERIES WHERE supports tag predicates only, "
-                        f"got {sorted(bad)}")
-                n = len(keys)
-                env: dict = {}
-                for c in stmt.where.columns():
-                    env[c] = np.array([k.tag_value(c) for k in keys],
-                                      dtype=object)
-                    env[f"__valid__:{c}"] = np.array(
-                        [k.tag_value(c) is not None for k in keys],
-                        dtype=bool)
-                mask = np.asarray(stmt.where.eval(env, np), dtype=bool)
-                if mask.shape == ():
-                    mask = np.full(n, bool(mask))
-                keys = [k for k, m in zip(keys, mask) if m]
+                keys = self._filtered_series(session.tenant, db,
+                                             stmt.table, stmt.where)
+            else:
+                keys = self.coord.series_keys(session.tenant, db,
+                                              stmt.table)
             reprs = [repr(k) for k in keys]
+            for name, asc in reversed(stmt.order_by):
+                if name != "key":
+                    raise PlanError(
+                        f"SHOW SERIES can only ORDER BY key, got {name!r}")
+                reprs.sort(reverse=not asc)
             if stmt.offset:
                 reprs = reprs[stmt.offset:]
             if stmt.limit is not None:
@@ -588,6 +630,53 @@ class QueryExecutor:
                            for u in users])])
         raise ExecutionError(f"unsupported SHOW {stmt.kind}")
 
+    def _filtered_series(self, tenant: str, db: str, table: str, where):
+        """Series keys surviving a SHOW SERIES / SHOW TAG VALUES WHERE:
+        tag predicates evaluate against the series keys, a `time`
+        conjunct against each series' data extent (reference
+        ShowTagBody.selection); field predicates are rejected."""
+        keys = self.coord.series_keys(tenant, db, table)
+        schema = self.meta.table(tenant, db, table)
+        tag_names = set(schema.tag_names())
+        bad = where.columns() - tag_names - {"time"}
+        if bad:
+            raise PlanError(
+                f"SHOW ... WHERE supports tag/time predicates only, "
+                f"got {sorted(bad)}")
+        n = len(keys)
+        env: dict = {}
+        for c in where.columns() - {"time"}:
+            env[c] = np.array([k.tag_value(c) for k in keys], dtype=object)
+            env[f"__valid__:{c}"] = np.array(
+                [k.tag_value(c) is not None for k in keys], dtype=bool)
+        if "time" in where.columns():
+            from .planner import split_where
+
+            trs, _doms, _res = split_where(where, schema)
+            mask = self._series_in_time(tenant, db, table, keys, trs)
+            tag_only = _strip_time_conjuncts(where)
+            if tag_only is not None:
+                m2 = np.asarray(tag_only.eval(env, np), dtype=bool)
+                if m2.shape == ():
+                    m2 = np.full(n, bool(m2))
+                mask = mask & m2
+        else:
+            mask = np.asarray(where.eval(env, np), dtype=bool)
+            if mask.shape == ():
+                mask = np.full(n, bool(mask))
+        return [k for k, m in zip(keys, mask) if m]
+
+    def _series_in_time(self, tenant: str, db: str, table: str, keys,
+                        trs) -> np.ndarray:
+        """Mask of series with ≥1 point inside the time ranges (reference
+        SHOW SERIES scans; `WHERE time < now()` keeps live series)."""
+        present = set()
+        for b in self.coord.scan_table(tenant, db, table, time_ranges=trs):
+            for k in b.series_keys:
+                if k is not None and b.n_rows:
+                    present.add(repr(k))
+        return np.array([repr(k) in present for k in keys], dtype=bool)
+
     def _describe(self, stmt: ast.DescribeStmt, session: Session):
         if stmt.kind == "database":
             d = self.meta.database(session.tenant, stmt.name)
@@ -606,7 +695,10 @@ class QueryExecutor:
             names.append(c.name)
             ct = c.column_type
             if ct.is_time:
-                types.append(f"TIMESTAMP({ct.precision.name})")
+                types.append("TIMESTAMP("
+                             + {"NS": "NANOSECOND", "US": "MICROSECOND",
+                                "MS": "MILLISECOND"}[ct.precision.name]
+                             + ")")
                 kinds.append("TIME")
             elif ct.is_tag:
                 types.append("STRING")
@@ -614,7 +706,8 @@ class QueryExecutor:
             else:
                 types.append(ct.value_type.sql_name())
                 kinds.append("FIELD")
-            codecs.append(c.encoding.name)
+            codecs.append(c.encoding.name if c.explicit_codec
+                          else "DEFAULT")
         return ResultSet(
             ["column_name", "data_type", "column_type", "compression_codec"],
             [np.array(x, dtype=object) for x in (names, types, kinds, codecs)])
@@ -624,6 +717,12 @@ class QueryExecutor:
         db = stmt.database or session.database
         schema = self.meta.table(session.tenant, db, stmt.table)
         cols = stmt.columns or [c.name for c in schema.columns]
+        # unquoted SQL identifiers are case-insensitive: fold each column
+        # to its schema-cased name (`TIME` → `time`; reference cases
+        # write INSERT tbl(TIME, ...))
+        by_lower = {c.name.lower(): c.name for c in schema.columns}
+        cols = [by_lower.get(c.lower(), c) if not schema.contains_column(c)
+                else c for c in cols]
         if "time" not in cols:
             raise ExecutionError("INSERT must include the time column")
         # SQL INSERT is schema-strict (the schemaless path is line
@@ -638,8 +737,23 @@ class QueryExecutor:
         field_types = {c: schema.column(c).column_type.value_type
                        for c in cols if schema.contains_column(c)
                        and schema.column(c).column_type.is_field}
+        src_rows = stmt.rows
+        if stmt.select is not None:
+            # INSERT ... SELECT: run the query, map columns positionally
+            # (reference: insert_select.slt — SELECT from VALUES etc.)
+            rsel = self.execute_statement(stmt.select, session)
+            if len(rsel.names) != len(cols):
+                raise ExecutionError(
+                    f"INSERT SELECT arity mismatch: {len(cols)} target "
+                    f"column(s), query yields {len(rsel.names)}")
+            src_rows = [
+                [None if (isinstance(v, float) and v != v) else
+                 (v.item() if isinstance(v, np.generic) else v)
+                 for v in row]
+                for row in zip(*[c.tolist() if hasattr(c, "tolist") else c
+                                 for c in rsel.columns])]
         rows = []
-        for raw in stmt.rows:
+        for raw in src_rows:
             if len(raw) != len(cols):
                 raise ExecutionError("INSERT row arity mismatch")
             row = dict(zip(cols, raw))
@@ -648,6 +762,13 @@ class QueryExecutor:
                 from .parser import parse_timestamp_string
 
                 row["time"] = parse_timestamp_string(t)
+            if row["time"] is None:
+                raise ExecutionError("INSERT time must not be NULL")
+            # a point with no field value is unrepresentable (same rule as
+            # line protocol; reference rejects all-NULL-field INSERT rows)
+            if not any(row.get(c) is not None for c in field_types):
+                raise ExecutionError(
+                    "INSERT row has no non-NULL field value")
             rows.append(row)
         wb = WriteBatch.from_rows(stmt.table, rows, tag_names, field_types)
         self.coord.write_points(session.tenant, db, wb)
@@ -677,8 +798,27 @@ class QueryExecutor:
         db = stmt.database or session.database
         schema = self.meta.table(session.tenant, db, stmt.table)
         tag_names = set(schema.tag_names())
-        if not set(stmt.assignments) <= tag_names:
-            raise ExecutionError("UPDATE supports tag columns only")
+        assigned = set(stmt.assignments)
+        if "time" in assigned:
+            raise ExecutionError("UPDATE cannot assign the time column")
+        if stmt.where is None:
+            raise ExecutionError(
+                "updating the entire table is disabled; add `where true` "
+                "to continue")
+        if assigned <= set(schema.field_names()):
+            return self._update_fields(stmt, schema, session, db)
+        if not assigned <= tag_names:
+            raise ExecutionError(
+                "UPDATE assigns either tag columns or field columns, "
+                "not a mix")
+        bad = stmt.where.columns() - tag_names
+        if bad:
+            # tag UPDATE rewrites whole series; a time/field condition
+            # would need per-row splits (reference: "Where clause cannot
+            # contain field/time column")
+            raise ExecutionError(
+                f"tag UPDATE WHERE cannot reference field/time columns, "
+                f"found: {sorted(bad)}")
         from .planner import split_where
 
         _, tag_domains, _ = split_where(stmt.where, schema)
@@ -686,7 +826,13 @@ class QueryExecutor:
         for k, e in stmt.assignments.items():
             if not isinstance(e, Literal):
                 raise ExecutionError("UPDATE tag values must be literals")
-            new_vals[k] = str(e.value)
+            # NULL removes the tag from the series key; the reference
+            # allows it as long as ≥1 tag remains (update_tag.slt: both
+            # tags → error, one of two → ok)
+            v = e.value
+            if isinstance(v, bool):
+                v = "true" if v else "false"   # SQL bool rendering
+            new_vals[k] = None if v is None else str(v)
         owner = f"{session.tenant}.{db}"
         from ..models.series import SeriesKey, Tag
 
@@ -700,6 +846,10 @@ class QueryExecutor:
                     continue
                 tags = k.tag_dict()
                 tags.update(new_vals)
+                tags = {tk: tv for tk, tv in tags.items() if tv is not None}
+                if not tags:
+                    raise ExecutionError(
+                        "UPDATE would leave a series with no tags")
                 old_keys.append(k)
                 new_keys.append(SeriesKey(stmt.table, tags))
             if old_keys:
@@ -707,16 +857,97 @@ class QueryExecutor:
                 count += len(old_keys)
         return ResultSet(["series_updated"], [np.array([count])])
 
+    def _update_fields(self, stmt: ast.UpdateStmt, schema, session, db):
+        """UPDATE of FIELD columns: scan the matching rows, evaluate the
+        assignment expressions over them, write the assigned fields back
+        at the same (series, time) — the LSM read path is last-write-wins
+        per field, so unassigned fields keep their old values (reference
+        dml update_field.slt semantics)."""
+        tag_names = schema.tag_names()
+        needed: set[str] = set()
+        for e in stmt.assignments.values():
+            if isinstance(e, Expr):
+                needed |= e.columns()
+        unknown = needed - set(schema.field_names()) - set(tag_names) \
+            - {"time"}
+        if unknown:
+            raise ExecutionError(
+                f"UPDATE expression references unknown column(s) "
+                f"{sorted(unknown)}")
+        items = [ast.SelectItem(Column("time"), None)]
+        for t in tag_names:
+            items.append(ast.SelectItem(Column(t), None))
+        for c in sorted(needed - {"time"} - set(tag_names)):
+            items.append(ast.SelectItem(Column(c), None))
+        sel = ast.SelectStmt(items=items, table=stmt.table,
+                             where=stmt.where, database=db)
+        rs = self._select(sel, session)
+        n = rs.n_rows
+        if n == 0:
+            return ResultSet(["count"], [np.array([0], dtype=np.int64)])
+        env = {nm: col for nm, col in zip(rs.names, rs.columns)}
+        rows: list[dict] = []
+        for i in range(n):
+            row: dict = {"time": int(env["time"][i])}
+            for t in tag_names:
+                v = env[t][i]
+                if v is not None:
+                    row[t] = v
+            rows.append(row)
+        field_types = {}
+        for fname, e in stmt.assignments.items():
+            field_types[fname] = schema.column(fname).column_type.value_type
+            vals = e.eval(env, np) if isinstance(e, Expr) else e
+            if np.isscalar(vals) or vals is None \
+                    or getattr(vals, "shape", None) == ():
+                vals = [vals] * n
+            for i, row in enumerate(rows):
+                v = vals[i]
+                if isinstance(v, np.generic):
+                    v = v.item()
+                if isinstance(v, float) and v != v:
+                    v = None
+                row[fname] = v
+        wb = WriteBatch.from_rows(stmt.table, rows,
+                                  [t for t in tag_names], field_types)
+        self.coord.write_points(session.tenant, db, wb)
+        return ResultSet(["count"], [np.array([n], dtype=np.int64)])
+
     # ------------------------------------------------------------------ SELECT
     def _explain(self, stmt: ast.ExplainStmt, session: Session):
+        if isinstance(stmt.inner, ast.CopyStmt):
+            src_txt = stmt.inner.source if isinstance(stmt.inner.source,
+                                                      str) else "<query>"
+            return ResultSet.message(
+                f"CopyExec target={stmt.inner.target} source={src_txt} "
+                f"format={stmt.inner.fmt}")
+        if isinstance(stmt.inner, ast.InsertStmt) \
+                and stmt.inner.select is not None:
+            return ResultSet.message(
+                f"InsertExec table={stmt.inner.table} source=<query>")
         if not isinstance(stmt.inner, ast.SelectStmt):
             raise ExecutionError("EXPLAIN supports SELECT only")
         sel = stmt.inner
         if sel.table is None:
             return ResultSet.message("Projection (no table)")
-        schema = self.meta.table(session.tenant,
-                                 sel.database or session.database, sel.table)
-        plan = plan_select(sel, schema)
+        tbl, db = sel.table, sel.database or session.database
+        st = None
+        if sel.database is None and self.meta.table_opt(
+                session.tenant, db, tbl) is None:
+            st = self.meta.stream_table(session.tenant, db, tbl)
+        if st is not None:
+            tbl, db = st["table"], st["db"]
+        schema = self.meta.table(session.tenant, db, tbl)
+        try:
+            plan = plan_select(sel, schema)
+        except CnosError as e:
+            # EXPLAIN (no execution) tolerates only DEFERRED-to-runtime
+            # value errors, the way the reference does (DataFusion defers
+            # `time >= 'xxx'` casts to execution); schema/semantic errors
+            # still raise
+            if stmt.analyze or "bad timestamp" not in str(e):
+                raise
+            return ResultSet.message(f"PlanningError (deferred): {e}")
         lines = []
         if stmt.analyze:
             import time as _t
@@ -755,6 +986,8 @@ class QueryExecutor:
         stmt = analyze(self._resolve_subqueries(stmt, session))
         if stmt.from_item is not None or self._needs_relational(stmt):
             return self._select_relational(stmt, session)
+        if stmt.table is not None:
+            stmt = self._strip_table_qualifiers(stmt)
         if stmt.table is None:
             # constant SELECT (SELECT 1)
             names, cols = [], []
@@ -765,6 +998,18 @@ class QueryExecutor:
             return ResultSet(names, cols)
         table = stmt.table
         db = stmt.database or session.database
+        st = None
+        if stmt.database is None and self.meta.table_opt(
+                session.tenant, db, table) is None:
+            st = self.meta.stream_table(session.tenant, db, table)
+        if st is not None:
+            # a stream table reads through to its bound tskv table
+            # (reference stream table provider over the base scan); the
+            # plan must carry the bound name — the scan reads plan.table
+            import dataclasses
+
+            table, db = st["table"], st["db"]
+            stmt = dataclasses.replace(stmt, table=table, database=db)
         from .system_tables import is_system_db, system_table
 
         if is_system_db(db):
@@ -891,8 +1136,12 @@ class QueryExecutor:
         import pyarrow as pa
 
         if stmt.target_is_path:
-            rs = self._select(ast.SelectStmt(
-                items=[ast.SelectItem("*")], table=stmt.source), session)
+            if isinstance(stmt.source, (ast.SelectStmt, ast.UnionStmt)):
+                rs = self.execute_statement(stmt.source, session)
+            else:
+                rs = self._select(ast.SelectStmt(
+                    items=[ast.SelectItem("*")], table=stmt.source),
+                    session)
             arrays, fields = [], []
             for n, c in zip(rs.names, rs.columns):
                 if c.dtype == object:
@@ -904,8 +1153,27 @@ class QueryExecutor:
             table = pa.table(dict(zip(fields, arrays)))
             from ..utils import objstore
 
-            remote = objstore.is_remote(stmt.target)
-            sink = io.BytesIO() if remote else stmt.target
+            target = stmt.target
+            if target.startswith("file://"):
+                target = target[len("file://"):]
+            remote = objstore.is_remote(target)
+            if not remote:
+                # a '/'-terminated target is a directory sink (reference
+                # writes part files under the prefix)
+                if target.endswith("/") or os.path.isdir(target):
+                    os.makedirs(target, exist_ok=True)
+                    # append the next part file (re-exports into the same
+                    # prefix accumulate, as the reference's sink does)
+                    part = 0
+                    while os.path.exists(os.path.join(
+                            target, f"part-{part}.{stmt.fmt}")):
+                        part += 1
+                    target = os.path.join(target,
+                                          f"part-{part}.{stmt.fmt}")
+                else:
+                    os.makedirs(os.path.dirname(target) or ".",
+                                exist_ok=True)
+            sink = io.BytesIO() if remote else target
             if stmt.fmt == "parquet":
                 import pyarrow.parquet as pq
 
@@ -922,18 +1190,74 @@ class QueryExecutor:
         # import: file/object → table (schema must exist; map by name)
         from ..utils import objstore
 
-        src = objstore.open_source(stmt.source, stmt.options)
+        source = stmt.source
+        if isinstance(source, str) and source.startswith("file://"):
+            source = source[len("file://"):]
+        if isinstance(source, str) and os.path.isdir(source):
+            # directory import: concatenate every part file (reference
+            # lists the prefix); parquet readers take the dir directly
+            if stmt.fmt != "parquet":
+                parts = sorted(
+                    os.path.join(source, f) for f in os.listdir(source)
+                    if not f.startswith("."))
+                import pyarrow.csv as pc
+
+                tables = [pc.read_csv(p) for p in parts]
+                table = pa.concat_tables(tables)
+                return self._copy_import(stmt, session, table)
+        src = objstore.open_source(source, stmt.options)
         if stmt.fmt == "parquet":
             import pyarrow.parquet as pq
 
             table = pq.read_table(src)
+        elif stmt.fmt == "json":
+            import pyarrow.json as pj
+
+            table = pj.read_json(src)
         else:
             import pyarrow.csv as pc
 
             table = pc.read_csv(src)
+        return self._copy_import(stmt, session, table)
+
+    def _copy_import(self, stmt: ast.CopyStmt, session: Session, table):
         schema = self.meta.table(session.tenant, session.database,
                                  stmt.target)
-        cols = {n: table.column(n).to_pylist() for n in table.column_names}
+        auto_infer = bool((stmt.options.get("__copy_options__") or {})
+                          .get("auto_infer_schema"))
+        if stmt.columns:
+            # COPY INTO t(col, ...): positional mapping of file columns
+            if len(stmt.columns) != len(table.column_names):
+                raise ExecutionError(
+                    f"COPY INTO column list has {len(stmt.columns)} "
+                    f"name(s), file has {len(table.column_names)}")
+            cols = {stmt.columns[i]: table.column(i).to_pylist()
+                    for i in range(len(stmt.columns))}
+        elif stmt.fmt == "csv" or auto_infer:
+            # csv (and auto_infer_schema mode): positional mapping to the
+            # table's declared column order (reference parses the file
+            # against the target schema — copy_into_table.slt expects a
+            # parse error when the layout doesn't line up, and
+            # auto_infer_schema errors on a column-count mismatch)
+            order = [c.name for c in schema.columns]
+            if len(table.column_names) != len(order):
+                raise ExecutionError(
+                    f"COPY INTO {stmt.target}: insert columns and source "
+                    f"columns not match ({len(table.column_names)} vs "
+                    f"{len(order)})")
+            cols = {order[i]: table.column(i).to_pylist()
+                    for i in range(len(order))}
+        else:
+            # named formats (parquet/json): map by column NAME; columns
+            # absent from the file stay NULL (reference json import)
+            cols = {n: table.column(n).to_pylist()
+                    for n in table.column_names}
+            unknown = [c for c in cols
+                       if c != "time" and not schema.contains_column(c)]
+            if unknown:
+                raise ExecutionError(
+                    f"COPY INTO {stmt.target}: file column(s) "
+                    f"{sorted(unknown)} not in target schema")
         if "time" not in cols:
             raise ExecutionError("COPY INTO table requires a time column")
         n = len(cols["time"])
@@ -1174,6 +1498,37 @@ class QueryExecutor:
             out.having = rel.rewrite_exprs(stmt.having, pred, replace)
         return out
 
+    def _strip_table_qualifiers(self, stmt: ast.SelectStmt):
+        """`SELECT m2.f0 FROM m2 WHERE m2.f1 > 0` — a single-table query
+        may qualify columns with the table (or db.table) name; resolve to
+        bare names before planning (joins handle qualifiers in the
+        relational scope instead)."""
+        import dataclasses
+
+        quals = [stmt.table + "."]
+        if stmt.database:
+            quals.append(f"{stmt.database}.{stmt.table}.")
+
+        def strip(e):
+            if not isinstance(e, Expr):
+                return e
+            out = e
+            for q in quals:
+                out = rel.rewrite_exprs(
+                    out, lambda x: isinstance(x, Column)
+                    and x.name.startswith(q),
+                    lambda x: Column(x.name[len(q):]))
+            return out
+
+        changed = dataclasses.replace(
+            stmt,
+            items=[ast.SelectItem(strip(it.expr), it.alias)
+                   for it in stmt.items],
+            where=strip(stmt.where), having=strip(stmt.having),
+            order_by=[(strip(oe), asc) for oe, asc in stmt.order_by],
+            group_by=[strip(g) for g in stmt.group_by])
+        return changed
+
     def _strip_alias(self, e: Expr, alias: str | None) -> Expr:
         """alias.col → col for pushdown into the aliased base relation."""
         if alias is None or e is None:
@@ -1212,6 +1567,33 @@ class QueryExecutor:
                 database=item.database)
             rs = self._select(sub, session)
             return rel.Scope.from_relation(rs.names, rs.columns, qual)
+        if isinstance(item, ast.ValuesRef):
+            width = len(item.rows[0]) if item.rows else 0
+            names = item.columns or [f"column{i + 1}"
+                                     for i in range(width)]
+            cols = []
+            for i in range(width):
+                vals = [r[i] for r in item.rows]
+                if all(isinstance(v, bool) for v in vals):
+                    cols.append(np.array(vals, dtype=bool))
+                elif all(isinstance(v, int) and not isinstance(v, bool)
+                         for v in vals):
+                    cols.append(np.array(vals, dtype=np.int64))
+                elif all(isinstance(v, (int, float))
+                         and not isinstance(v, bool) for v in vals):
+                    cols.append(np.array(vals, dtype=np.float64))
+                else:
+                    c = np.empty(len(vals), dtype=object)
+                    c[:] = vals
+                    cols.append(c)
+            scope = rel.Scope.from_relation(names, cols, item.alias)
+            if pushed_where is not None:
+                w = self._strip_alias(pushed_where, item.alias)
+                m = np.asarray(w.eval(scope.env, np))
+                if not m.shape:
+                    m = np.full(scope.n, bool(m))
+                scope = scope.filter(m)
+            return scope
         if isinstance(item, ast.SubqueryRef):
             q = item.select
             rs = self._union(q, session) if isinstance(q, ast.UnionStmt) \
@@ -1639,7 +2021,7 @@ class QueryExecutor:
             if plan.filter is not None:
                 missing = [c for c in plan.filter.columns() if c not in env]
                 for c in missing:
-                    env[c] = np.zeros(b.n_rows)
+                    env[c] = _schema_padding(plan.schema, c, b.n_rows)
                     env[f"__valid__:{c}"] = np.zeros(b.n_rows, dtype=bool)
                 mask = np.asarray(plan.filter.eval(env, np), dtype=bool)
                 if mask.shape == ():
@@ -1677,7 +2059,7 @@ class QueryExecutor:
             for j, (_hn, oe, _asc) in enumerate(ord_items):
                 missing = [c for c in oe.columns() if c not in env]
                 for c in missing:
-                    env[c] = np.zeros(n_rows)
+                    env[c] = _schema_padding(plan.schema, c, n_rows)
                     env[f"__valid__:{c}"] = np.zeros(n_rows, dtype=bool)
                 ov = oe.eval(env, np)
                 if isinstance(ov, DictArray):
@@ -1706,7 +2088,7 @@ class QueryExecutor:
             for i, (name, expr) in enumerate(plan.output):
                 missing = [c for c in expr.columns() if c not in env]
                 for c in missing:
-                    env[c] = np.zeros(n_rows)
+                    env[c] = _schema_padding(plan.schema, c, n_rows)
                     env[f"__valid__:{c}"] = np.zeros(n_rows, dtype=bool)
                 v = expr.eval(env, np)
                 if isinstance(v, DictArray):
@@ -1824,23 +2206,73 @@ def _load_external(ext: dict) -> tuple[list[str], list[np.ndarray]]:
     if ext["fmt"] == "parquet":
         import pyarrow.parquet as pq
 
-        table = pq.read_table(src)
+        table = pq.read_table(src)   # accepts files and directories
     else:
+        import pyarrow as pa
         import pyarrow.csv as pc
 
         ropts = pc.ReadOptions(autogenerate_column_names=not ext.get(
             "header", True))
-        table = pc.read_csv(src, read_options=ropts)
+        if isinstance(src, str) and os.path.isdir(src):
+            parts = sorted(os.path.join(src, f) for f in os.listdir(src)
+                           if not f.startswith("."))
+            table = pa.concat_tables(
+                [pc.read_csv(p, read_options=ropts) for p in parts])
+        else:
+            table = pc.read_csv(src, read_options=ropts)
     names, cols = [], []
     for name in table.column_names:
         col = table.column(name)
         arr = col.to_numpy(zero_copy_only=False)
-        if arr.dtype == object or arr.dtype.kind in ("U", "S"):
+        if arr.dtype.kind == "M":
+            # arrow timestamp columns (CSV type inference) → this
+            # engine's i64 ns representation
+            arr = arr.astype("datetime64[ns]").astype(np.int64)
+        elif arr.dtype == object and len(arr) \
+                and type(arr[0]).__name__ == "Timestamp":
+            arr = np.array([int(v.value) for v in arr], dtype=np.int64)
+        elif arr.dtype == object or arr.dtype.kind in ("U", "S"):
             arr = np.array([None if v is None else str(v)
                             for v in col.to_pylist()], dtype=object)
         names.append(name)
         cols.append(arr)
     return names, cols
+
+
+def _strip_time_conjuncts(e):
+    """Drop top-level AND conjuncts that reference only `time`, returning
+    the tag-only remainder (None when nothing remains). SHOW SERIES
+    evaluates time separately against each series' data extent."""
+    from .expr import BinOp
+
+    if "time" not in e.columns():
+        return e
+    if isinstance(e, BinOp) and e.op == "and":
+        left = _strip_time_conjuncts(e.left)
+        right = _strip_time_conjuncts(e.right)
+        if left is None:
+            return right
+        if right is None:
+            return left
+        return BinOp("and", left, right)
+    if e.columns() <= {"time"}:
+        return None
+    raise PlanError(
+        "SHOW SERIES: time predicates must be top-level AND conjuncts")
+
+
+def _schema_padding(schema, col: str, n: int) -> np.ndarray:
+    """All-invalid padding for a field absent from a vnode's batch, typed
+    from the schema so cross-batch concatenation keeps the declared dtype
+    (a BIGINT column must not decay to float64 because one vnode never
+    saw it; reference returns typed arrow arrays with null validity)."""
+    try:
+        dt = schema.column(col).column_type.value_type.numpy_dtype()
+    except Exception:
+        dt = np.float64
+    if dt is object:
+        return np.full(n, None, dtype=object)
+    return np.zeros(n, dtype=dt)
 
 
 def _batches_bytes(batches) -> int:

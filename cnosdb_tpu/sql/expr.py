@@ -397,7 +397,7 @@ class IsNull(Expr):
     def eval(self, env, xp):
         # validity masks ride in env under '__valid__:<col>'
         cols = self.expr.columns()
-        if len(cols) == 1:
+        if len(cols) == 1 and isinstance(self.expr, Column):
             key = f"__valid__:{next(iter(cols))}"
             if key in env:
                 valid = env[key]
@@ -412,6 +412,16 @@ class IsNull(Expr):
                           for x in v], dtype=bool)
         else:
             m = xp.zeros(getattr(v, "shape", (1,)), dtype=bool)
+        if not isinstance(self.expr, (Column, Literal)):
+            # composite expression: a NULL in any null-propagating input
+            # makes the result NULL (SQL 3VL — `(NOT (x = t0)) IS NULL`
+            # is TRUE on NULL-t0 rows even though the bool eval says
+            # False); NULL-defining nodes (CASE/IS NULL) are excluded by
+            # propagating_columns
+            for c in propagating_columns(self.expr):
+                nm = _column_null_mask(c, env, xp)
+                if nm is not None:
+                    m = m | nm
         return ~m if self.negated else m
 
     def columns(self):
@@ -491,8 +501,9 @@ class Func(Expr):
         "abs": lambda xp, a: xp.abs(a),
         "floor": lambda xp, a: xp.floor(a),
         "ceil": lambda xp, a: xp.ceil(a),
-        "round": lambda xp, a: xp.round(a),
+        "round": lambda xp, a, *nd: xp.round(a, *[int(d) for d in nd]),
         "sqrt": lambda xp, a: xp.sqrt(a),
+        "cbrt": lambda xp, a: xp.cbrt(a),
         "exp": lambda xp, a: xp.exp(a),
         "ln": lambda xp, a: xp.log(a),
         "log10": lambda xp, a: xp.log10(a),
@@ -500,13 +511,30 @@ class Func(Expr):
         "sin": lambda xp, a: xp.sin(a),
         "cos": lambda xp, a: xp.cos(a),
         "tan": lambda xp, a: xp.tan(a),
+        "sinh": lambda xp, a: xp.sinh(a),
+        "cosh": lambda xp, a: xp.cosh(a),
+        "tanh": lambda xp, a: xp.tanh(a),
         "asin": lambda xp, a: xp.arcsin(a),
         "acos": lambda xp, a: xp.arccos(a),
         "atan": lambda xp, a: xp.arctan(a),
+        "asinh": lambda xp, a: xp.arcsinh(a),
+        "acosh": lambda xp, a: xp.arccosh(a),
+        "atanh": lambda xp, a: xp.arctanh(a),
         "atan2": lambda xp, a, b: xp.arctan2(a, b),
         "pow": lambda xp, a, b: xp.power(a, b),
         "power": lambda xp, a, b: xp.power(a, b),
         "signum": lambda xp, a: xp.sign(a),
+        "trunc": lambda xp, a: xp.trunc(a),
+        "radians": lambda xp, a: xp.radians(a),
+        "degrees": lambda xp, a: xp.degrees(a),
+        "gcd": lambda xp, a, b: xp.gcd(_as_i64(xp, a), _as_i64(xp, b)),
+        "lcm": lambda xp, a, b: xp.lcm(_as_i64(xp, a), _as_i64(xp, b)),
+        "pi": lambda xp: xp.pi,
+        # log(x) = log10 in the reference (DataFusion math_expressions);
+        # log(base, x) is explicit-base
+        "log": lambda xp, a, *b: (xp.log(b[0]) / xp.log(a)) if b
+        else xp.log10(a),
+        "random": lambda xp: float(np.random.random()),
     }
 
     def eval(self, env, xp):
@@ -531,6 +559,29 @@ def _str_func(fn, *, out=object):
     def run(xp, arr, *rest):
         import numpy as _np
 
+        arr_rest = [r for r in rest
+                    if isinstance(r, _np.ndarray) and r.shape != ()]
+        if arr_rest:
+            # column-valued extra args (strpos(t0, t1)): elementwise zip
+            if isinstance(arr, DictArray):
+                arr = arr.materialize()
+            n = len(arr) if isinstance(arr, _np.ndarray) \
+                else len(arr_rest[0])
+            cols = [arr if isinstance(arr, _np.ndarray) else [arr] * n]
+            for r in rest:
+                cols.append(r if isinstance(r, _np.ndarray)
+                            and r.shape != ()
+                            else [r.item() if hasattr(r, "item") else r]
+                            * n)
+            vals = [None if row[0] is None
+                    or any(x is None for x in row[1:])
+                    else fn(str(row[0]), *row[1:]) for row in zip(*cols)]
+            if out is object:
+                o = _np.empty(len(vals), dtype=object)
+                o[:] = vals
+                return o
+            return _np.array([out() if v is None else v for v in vals],
+                             dtype=out)
         rest = [r.item() if hasattr(r, "item") else r for r in rest]
         if isinstance(arr, DictArray):
             return arr.map_values(lambda x: fn(str(x), *rest),
@@ -593,6 +644,250 @@ def _fn_concat(xp, *parts):
     o[:] = ["".join("" if v is None else str(v) for v in row)
             for row in zip(*cols)]
     return o
+
+
+def _as_i64(xp, a):
+    """gcd/lcm demand integer operands (DataFusion casts, erroring on
+    fractional input); numpy would silently truncate floats."""
+    arr = xp.asarray(a)
+    if arr.dtype.kind == "f":
+        if not bool(xp.all(arr == xp.floor(arr))):
+            raise PlanError("gcd/lcm require integer arguments")
+    return arr.astype(xp.int64) if hasattr(arr, "astype") else arr
+
+
+def _fn_ascii(s):
+    return ord(s[0]) if s else 0
+
+
+def _fn_initcap(s):
+    """Uppercase the first alphanumeric of each word, lowercase the rest
+    (word = alphanumeric run, PostgreSQL/DataFusion initcap)."""
+    out = []
+    new_word = True
+    for ch in s:
+        if ch.isalnum():
+            out.append(ch.upper() if new_word else ch.lower())
+            new_word = False
+        else:
+            out.append(ch)
+            new_word = True
+    return "".join(out)
+
+
+def _fn_left(s, n):
+    n = int(n)
+    if n >= 0:
+        return s[:n]
+    return s[:max(0, len(s) + n)]
+
+
+def _fn_right(s, n):
+    n = int(n)
+    if n >= 0:
+        return s[max(0, len(s) - n):] if n else ""
+    return s[-n:]
+
+
+def _fn_split_part(s, delim, n):
+    parts = s.split(delim)
+    n = int(n)
+    if n > 0:
+        return parts[n - 1] if n <= len(parts) else ""
+    if n < 0:
+        return parts[n] if -n <= len(parts) else ""
+    raise PlanError("split_part field position must not be zero")
+
+
+def _fn_translate(s, src, dst):
+    table = {ord(c): (dst[i] if i < len(dst) else None)
+             for i, c in enumerate(src)}
+    return s.translate(table)
+
+
+def _fn_md5(s):
+    import hashlib
+
+    return hashlib.md5(s.encode()).hexdigest()
+
+
+def _fn_to_hex(x):
+    v = int(x)
+    # DataFusion to_hex renders the two's-complement i64 bit pattern
+    return format(v & 0xFFFFFFFFFFFFFFFF, "x") if v < 0 else format(v, "x")
+
+
+def _fn_concat_ws(xp, sep, *parts):
+    import numpy as _np
+
+    sep_v = sep.item() if hasattr(sep, "item") else sep
+    if isinstance(sep_v, _np.ndarray):
+        raise PlanError("concat_ws separator must be a scalar")
+    if sep_v is None:
+        # NULL separator → NULL result (PostgreSQL/DataFusion)
+        arrs = [p for p in parts if isinstance(p, _np.ndarray)]
+        if not arrs:
+            return None
+        o = _np.empty(len(arrs[0]), dtype=object)
+        o[:] = None
+        return o
+    parts = [p.materialize() if isinstance(p, DictArray) else p
+             for p in parts]
+    arrays = [p for p in parts if isinstance(p, _np.ndarray)]
+    if not arrays:
+        vals = [str(p) for p in parts if p is not None]
+        return str(sep_v).join(vals)
+    n = len(arrays[0])
+    cols = [p if isinstance(p, _np.ndarray) else [p] * n for p in parts]
+    o = _np.empty(n, dtype=object)
+    o[:] = [str(sep_v).join(str(v) for v in row if v is not None)
+            for row in zip(*cols)]
+    return o
+
+
+# -- time scalars (int64 ns timestamps; reference renders these as arrow
+#    timestamps — query_server scalar set inherited from DataFusion) ------
+
+_NS = 1_000_000_000
+
+
+def _ns_to_dt(ns: int):
+    from datetime import datetime, timezone
+
+    return datetime.fromtimestamp(int(ns) / 1e9, tz=timezone.utc)
+
+
+def _fn_date_part(field, ns):
+    from datetime import timezone
+
+    dt = _ns_to_dt(ns)
+    f = str(field).lower()
+    if f in ("year", "years"):
+        v = dt.year
+    elif f in ("quarter",):
+        v = (dt.month - 1) // 3 + 1
+    elif f in ("month", "months"):
+        v = dt.month
+    elif f in ("week", "weeks"):
+        v = dt.isocalendar()[1]
+    elif f in ("day", "days"):
+        v = dt.day
+    elif f in ("doy",):
+        v = dt.timetuple().tm_yday
+    elif f in ("dow",):
+        v = (dt.weekday() + 1) % 7   # Sunday = 0 (PostgreSQL dow)
+    elif f in ("hour", "hours"):
+        v = dt.hour
+    elif f in ("minute", "minutes"):
+        v = dt.minute
+    elif f in ("second", "seconds"):
+        v = dt.second + dt.microsecond / 1e6
+    elif f in ("millisecond", "milliseconds"):
+        v = (dt.second + dt.microsecond / 1e6) * 1e3
+    elif f in ("microsecond", "microseconds"):
+        v = (dt.second + dt.microsecond / 1e6) * 1e6
+    elif f in ("nanosecond", "nanoseconds"):
+        v = dt.second * 1e9 + (int(ns) % _NS)
+    elif f in ("epoch",):
+        v = int(ns) / 1e9
+    else:
+        raise PlanError(f"date_part: unknown field {field!r}")
+    return float(v)
+
+
+def _fn_date_trunc(granularity, ns):
+    from datetime import datetime, timezone
+
+    dt = _ns_to_dt(ns)
+    g = str(granularity).lower()
+    if g == "year":
+        dt2 = datetime(dt.year, 1, 1, tzinfo=timezone.utc)
+    elif g == "quarter":
+        dt2 = datetime(dt.year, ((dt.month - 1) // 3) * 3 + 1, 1,
+                       tzinfo=timezone.utc)
+    elif g == "month":
+        dt2 = datetime(dt.year, dt.month, 1, tzinfo=timezone.utc)
+    elif g == "week":
+        from datetime import timedelta
+
+        d0 = datetime(dt.year, dt.month, dt.day, tzinfo=timezone.utc)
+        dt2 = d0 - timedelta(days=dt.weekday())
+    elif g == "day":
+        dt2 = datetime(dt.year, dt.month, dt.day, tzinfo=timezone.utc)
+    elif g == "hour":
+        return (int(ns) // (3600 * _NS)) * 3600 * _NS
+    elif g == "minute":
+        return (int(ns) // (60 * _NS)) * 60 * _NS
+    elif g == "second":
+        return (int(ns) // _NS) * _NS
+    elif g == "millisecond":
+        return (int(ns) // 1_000_000) * 1_000_000
+    elif g == "microsecond":
+        return (int(ns) // 1_000) * 1_000
+    else:
+        raise PlanError(f"date_trunc: unknown granularity {granularity!r}")
+    return int(dt2.timestamp()) * _NS
+
+
+def _fn_to_timestamp(x, scale_ns: int = 1):
+    """String → ns (ISO-8601), or integer scaled by the unit variant
+    (to_timestamp=ns, _seconds/_millis/_micros — DataFusion semantics)."""
+    if isinstance(x, str):
+        from .parser import parse_timestamp_string
+
+        return parse_timestamp_string(x)
+    return int(x) * scale_ns
+
+
+def _register_time_scalars():
+    import time as _time
+    from datetime import datetime, timezone
+
+    Func._FUNCS.update({
+        "now": lambda xp: int(_time.time() * 1e9),
+        "current_timestamp": lambda xp: int(_time.time() * 1e9),
+        "current_date": lambda xp: datetime.now(timezone.utc)
+        .strftime("%Y-%m-%d"),
+        "current_time": lambda xp: datetime.now(timezone.utc)
+        .strftime("%H:%M:%S.%f"),
+        "date_part": _scalar_first_obj(_fn_date_part),
+        "datepart": _scalar_first_obj(_fn_date_part),
+        "date_trunc": _scalar_first_obj(_fn_date_trunc),
+        "datetrunc": _scalar_first_obj(_fn_date_trunc),
+        "from_unixtime": _obj_func(lambda x: int(x) * _NS),
+        "to_timestamp": _obj_func(_fn_to_timestamp),
+        "to_timestamp_seconds": _obj_func(
+            lambda x: _fn_to_timestamp(x, _NS) if not isinstance(x, str)
+            else (_fn_to_timestamp(x) // _NS) * _NS),
+        "to_timestamp_millis": _obj_func(
+            lambda x: _fn_to_timestamp(x, 1_000_000) if not isinstance(x, str)
+            else (_fn_to_timestamp(x) // 1_000_000) * 1_000_000),
+        "to_timestamp_micros": _obj_func(
+            lambda x: _fn_to_timestamp(x, 1_000) if not isinstance(x, str)
+            else (_fn_to_timestamp(x) // 1_000) * 1_000),
+    })
+
+
+def _scalar_first_obj(fn):
+    """Lift fn(scalar_opt, value) where the FIRST argument is a scalar
+    option (field name / granularity) and the second is the column."""
+    def run(xp, opt, arr):
+        import numpy as _np
+
+        opt = opt.item() if hasattr(opt, "item") else opt
+        if isinstance(arr, _np.ndarray):
+            vals = [None if x is None else fn(opt, x) for x in arr]
+            if vals and all(isinstance(v, int) for v in vals):
+                return _np.array(vals, dtype=_np.int64)
+            if vals and all(v is None or isinstance(v, (int, float))
+                            for v in vals):
+                return _np.array([_np.nan if v is None else float(v)
+                                  for v in vals])
+            o = _np.empty(len(vals), dtype=object)
+            o[:] = vals
+            return o
+        return None if arr is None else fn(opt, arr)
+    return run
 
 
 def _obj_func(fn, *, numeric: bool = True):
@@ -677,7 +972,23 @@ def _register_tsfuncs():
         "repeat": _str_func(lambda s, n: s * int(n)),
         "lpad": _str_func(_fn_lpad),
         "rpad": _str_func(_fn_rpad),
+        "ascii": _str_func(_fn_ascii, out=np.int64),
+        "chr": _obj_func(lambda x: chr(int(x)), numeric=False),
+        "bit_length": _str_func(lambda s: len(s.encode()) * 8,
+                                out=np.int64),
+        "octet_length": _str_func(lambda s: len(s.encode()), out=np.int64),
+        "character_length": _str_func(len, out=np.int64),
+        "btrim": _str_func(lambda s, *c: s.strip(*c)),
+        "initcap": _str_func(_fn_initcap),
+        "left": _str_func(_fn_left),
+        "right": _str_func(_fn_right),
+        "split_part": _str_func(_fn_split_part),
+        "translate": _str_func(_fn_translate),
+        "md5": _str_func(_fn_md5),
+        "to_hex": _obj_func(_fn_to_hex, numeric=False),
+        "concat_ws": _fn_concat_ws,
     })
+    _register_time_scalars()
 
 
 def _parse_bool_str(s: str) -> bool:
@@ -708,7 +1019,9 @@ def _cast_scalar(x, kind: str):
         return float(x.strip()) if isinstance(x, str) else float(x)
     if kind == "s":
         if isinstance(x, (bool, np.bool_)):
-            return "true" if x else "false"
+            # the reference renders CAST(bool AS STRING) as '0'/'1'
+            # (data_type/type_conversion/between.slt pins it)
+            return "1" if x else "0"
         if isinstance(x, (float, np.floating)):
             return repr(float(x))
         if isinstance(x, (int, np.integer)):
@@ -744,6 +1057,29 @@ def iter_child_exprs(e):
             yield c
         if isinstance(r, Expr):
             yield r
+
+
+def _column_null_mask(col: str, env: dict, xp):
+    """Per-row NULL mask for a column in env, from its validity mask when
+    present, else from the value representation (None in object arrays,
+    NaN in float columns). None when the column can't be resolved."""
+    key = f"__valid__:{col}"
+    if key in env:
+        return ~np.asarray(env[key], dtype=bool)
+    v = env.get(col)
+    if v is None:
+        return None
+    if isinstance(v, DictArray):
+        return None   # dictionary columns have no NULL holes
+    dt = getattr(v, "dtype", None)
+    if dt is None:
+        return None
+    if dt == object:
+        return np.array([x is None or (isinstance(x, float) and x != x)
+                         for x in v], dtype=bool)
+    if dt.kind == "f":
+        return xp.isnan(v)
+    return None
 
 
 def propagating_columns(e) -> set:

@@ -64,7 +64,10 @@ def tokenize(sql: str) -> list[Token]:
         elif kind == "qident":
             out.append(Token("ident", text[1:-1].replace('""', '"'), m.start()))
         elif kind == "ident":
-            out.append(Token("ident", text, m.start()))
+            # unquoted identifiers fold to lowercase (DataFusion/standard
+            # SQL: `Order by Time` resolves the `time` column; quoted
+            # identifiers above preserve case)
+            out.append(Token("ident", text.lower(), m.start()))
         elif kind == "number":
             out.append(Token("number", text, m.start()))
         else:
@@ -123,7 +126,11 @@ def parse_timestamp_string(s: str) -> int:
         dt = datetime.fromisoformat(t)
         if dt.tzinfo is None:
             dt = dt.replace(tzinfo=timezone.utc)
-        return int(dt.timestamp() * 1_000_000_000) + frac_ns
+        # exact integer arithmetic: float seconds lose ns precision at
+        # ~1e18 (dt.timestamp()*1e9 rounds .005 s to 4999936 ns)
+        delta = dt - datetime(1970, 1, 1, tzinfo=timezone.utc)
+        secs = delta.days * 86400 + delta.seconds
+        return secs * 1_000_000_000 + delta.microseconds * 1_000 + frac_ns
     except ParserError:
         raise
     except Exception:
@@ -151,6 +158,12 @@ class Parser:
     def kw(self) -> str | None:
         t = self.peek()
         return t.value.upper() if t.kind == "ident" else None
+
+    def _peek_op_at(self, offset: int) -> str | None:
+        j = self.i + offset
+        if j < len(self.tokens) and self.tokens[j].kind == "op":
+            return self.tokens[j].value
+        return None
 
     def _peek_kw_at(self, offset: int) -> str | None:
         j = self.i + offset
@@ -338,16 +351,30 @@ class Parser:
             self.next()
             self.expect_kw("INTO")
             t = self.peek()
+            copy_cols = None
             if t.kind == "string":
                 target, target_is_path = self.expect_string(), True
             else:
                 target, target_is_path = self.expect_ident(), False
+                if self.accept_op("("):
+                    copy_cols = [self.expect_ident()]
+                    while self.accept_op(","):
+                        copy_cols.append(self.expect_ident())
+                    self.expect_op(")")
             self.expect_kw("FROM")
             t = self.peek()
-            source = self.expect_string() if t.kind == "string" \
-                else self.expect_ident()
+            if t.kind == "op" and t.value == "(":
+                # COPY INTO '<path>' FROM (SELECT ...) — query export
+                self.next()
+                source = self.parse_query()
+                self.expect_op(")")
+            elif t.kind == "string":
+                source = self.expect_string()
+            else:
+                source = self.expect_ident()
             path = target if target_is_path else source
-            fmt = "parquet" if path.endswith(".parquet") else "csv"
+            fmt = "parquet" if isinstance(path, str) \
+                and path.endswith(".parquet") else "csv"
             options: dict = {}
             while True:
                 if self.accept_kw("CONNECTION"):
@@ -362,11 +389,11 @@ class Parser:
                     self.expect_op(")")
                 elif self.accept_kw("COPY_OPTIONS"):
                     self.expect_op("=")
-                    self._parse_kv_parens()   # accepted for compatibility
+                    options["__copy_options__"] = self._parse_kv_parens()
                 else:
                     break
             return ast.CopyStmt(target, source, target_is_path, fmt,
-                                options)
+                                options, copy_cols)
         if k in ("GRANT", "REVOKE"):
             grant = k == "GRANT"
             self.next()
@@ -519,10 +546,19 @@ class Parser:
 
     def parse_table_factor(self):
         if self.accept_op("("):
+            if self.kw() == "VALUES":
+                return self._parse_values_rel()
             sub = self.parse_query()
             self.expect_op(")")
-            self.accept_kw("AS")
-            return ast.SubqueryRef(sub, self.expect_ident())
+            had_as = self.accept_kw("AS")
+            # alias is optional (reference allows a bare derived table);
+            # synthesize a scope name when absent
+            if had_as or (self.peek().kind == "ident"
+                          and self.kw() not in _RESERVED
+                          and self.kw() not in ("GROUP", "HAVING", "ORDER",
+                                                "LIMIT", "OFFSET")):
+                return ast.SubqueryRef(sub, self.expect_ident())
+            return ast.SubqueryRef(sub, f"__subquery_{self.i}")
         name = self.expect_ident()
         database = None
         if self.accept_op("."):
@@ -636,9 +672,9 @@ class Parser:
             while True:
                 if self.accept_kw("TAGS"):
                     self.expect_op("(")
-                    tags.append(self.expect_ident())
+                    tags.append(self._tag_name())
                     while self.accept_op(","):
-                        tags.append(self.expect_ident())
+                        tags.append(self._tag_name())
                     self.expect_op(")")
                 else:
                     cname = self.expect_ident()
@@ -646,16 +682,49 @@ class Parser:
                     if tname.upper() == "BIGINT" and self.kw() == "UNSIGNED":
                         self.next()
                         tname = "BIGINT UNSIGNED"
+                    elif tname.upper() == "GEOMETRY" and self.accept_op("("):
+                        # GEOMETRY(subtype, srid) — stored as WKT strings
+                        # (reference models/src/schema/tskv_table_schema.rs
+                        # GeometryType); subtype recorded for DESCRIBE
+                        sub = self.expect_ident().upper()
+                        self.expect_op(",")
+                        srid = int(self.expect_number())
+                        self.expect_op(")")
+                        tname = f"GEOMETRY({sub}, {srid})"
                     codec = None
                     if self.accept_kw("CODEC"):
                         self.expect_op("(")
-                        codec = self.expect_ident()
+                        codec = self.expect_ident().upper()
                         self.expect_op(")")
                     fields.append(ast.ColumnDef(cname, tname, codec))
                 if not self.accept_op(","):
                     break
             self.expect_op(")")
             return ast.CreateTable(name, fields, tags, ine, database)
+        if k == "STREAM" and self._peek_kw_at(1) == "TABLE":
+            # CREATE STREAM TABLE [IF NOT EXISTS] name (cols) WITH (db=,
+            # table=, event_time_column=) engine = tskv — the reference's
+            # stream-source DDL (query_server stream providers)
+            self.next()
+            self.expect_kw("TABLE")
+            ine = self._if_not_exists()
+            name = self.expect_ident()
+            columns = []
+            if self.accept_op("("):
+                while True:
+                    cname = self.expect_ident()
+                    tname = self.expect_ident().upper()
+                    columns.append((cname, tname))
+                    if not self.accept_op(","):
+                        break
+                self.expect_op(")")
+            self.expect_kw("WITH")
+            opts = self._parse_kv_parens()
+            engine = "tskv"
+            if self.accept_kw("ENGINE"):
+                self.accept_op("=")
+                engine = self.expect_ident().lower()
+            return ast.CreateStreamTable(name, columns, opts, engine, ine)
         if k == "STREAM":
             self.next()
             ine = self._if_not_exists()
@@ -798,7 +867,7 @@ class Parser:
                 codec = None
                 if self.accept_kw("CODEC"):
                     self.expect_op("(")
-                    codec = self.expect_ident()
+                    codec = self.expect_ident().upper()
                     self.expect_op(")")
                 return ast.AlterTable(name, "add_field",
                                       ast.ColumnDef(cname, tname, codec))
@@ -830,6 +899,65 @@ class Parser:
             raise ParserError("ALTER TENANT expects ADD USER or REMOVE USER")
         raise ParserError(f"unsupported ALTER {k}")
 
+    def _parse_values_rel(self):
+        """After '(' with VALUES next: inline constant relation."""
+        self.expect_kw("VALUES")
+        rows = []
+        while True:
+            self.expect_op("(")
+            row = [self.parse_literal_value()]
+            while self.accept_op(","):
+                row.append(self.parse_literal_value())
+            self.expect_op(")")
+            rows.append(row)
+            if not self.accept_op(","):
+                break
+        self.expect_op(")")
+        alias = f"__values_{self.i}"
+        cols = None
+        if self.accept_kw("AS") or (self.peek().kind == "ident"
+                                    and self.kw() not in _RESERVED):
+            alias = self.expect_ident()
+            if self.accept_op("("):
+                cols = [self.expect_ident()]
+                while self.accept_op(","):
+                    cols.append(self.expect_ident())
+                self.expect_op(")")
+        width = len(rows[0])
+        for r in rows:
+            if len(r) != width:
+                raise ParserError("VALUES rows must have equal arity")
+        if cols is not None and len(cols) != width:
+            raise ParserError("VALUES column list arity mismatch")
+        return ast.ValuesRef(rows, alias, cols)
+
+    def _tag_name(self) -> str:
+        """Tag names in TAGS(...) may be bare identifiers or string
+        literals (reference: `TAGS('foo')` in copy_into_wide_table)."""
+        if self.peek().kind == "string":
+            return self.expect_string()
+        return self.expect_ident()
+
+    def _parse_show_order_by(self) -> list:
+        """ORDER BY over a SHOW statement's OUTPUT columns only (the
+        reference accepts `SHOW SERIES ... ORDER BY key` but rejects
+        data columns — validated in the executor against the output)."""
+        if not self.accept_kw("ORDER"):
+            return []
+        self.expect_kw("BY")
+        items = []
+        while True:
+            name = self.expect_ident()
+            asc = True
+            if self.accept_kw("DESC"):
+                asc = False
+            else:
+                self.accept_kw("ASC")
+            items.append((name, asc))
+            if not self.accept_op(","):
+                break
+        return items
+
     def parse_show(self):
         self.expect_kw("SHOW")
         k = self.kw()
@@ -845,10 +973,15 @@ class Parser:
         if k == "SERIES":
             self.next()
             stmt = ast.ShowStmt("series")
-            if self.accept_kw("FROM"):
-                stmt.table = self.expect_ident()
+            if self.accept_kw("ON"):
+                stmt.on_database = self.expect_ident()
+            # FROM is mandatory (reference ast.rs ShowSeries: a bare
+            # `SHOW SERIES` is a parse error)
+            self.expect_kw("FROM")
+            stmt.table = self.expect_ident()
             if self.accept_kw("WHERE"):
                 stmt.where = self.parse_expr()
+            stmt.order_by = self._parse_show_order_by()
             if self.accept_kw("LIMIT"):
                 stmt.limit = int(self.expect_number())
             if self.accept_kw("OFFSET"):
@@ -858,8 +991,10 @@ class Parser:
             self.next()
             if self.accept_kw("VALUES"):
                 stmt = ast.ShowStmt("tag_values")
-                if self.accept_kw("FROM"):
-                    stmt.table = self.expect_ident()
+                if self.accept_kw("ON"):
+                    stmt.on_database = self.expect_ident()
+                self.expect_kw("FROM")
+                stmt.table = self.expect_ident()
                 self.expect_kw("WITH")
                 self.expect_kw("KEY")
                 # = k | != k | IN (a, b) | NOT IN (a, b)
@@ -875,8 +1010,13 @@ class Parser:
                 else:
                     stmt.tag_with = ("eq", [self.expect_ident()])
                 stmt.tag_key = stmt.tag_with[1][0]
+                if self.accept_kw("WHERE"):
+                    stmt.where = self.parse_expr()
+                stmt.order_by = self._parse_show_order_by()
                 if self.accept_kw("LIMIT"):
                     stmt.limit = int(self.expect_number())
+                if self.accept_kw("OFFSET"):
+                    stmt.offset = int(self.expect_number())
                 return stmt
             self.expect_kw("KEYS")
             stmt = ast.ShowStmt("tag_keys")
@@ -914,7 +1054,9 @@ class Parser:
 
     def parse_insert(self):
         self.expect_kw("INSERT")
-        self.expect_kw("INTO")
+        self.accept_kw("INTO")   # INTO is optional (reference dialect:
+        # `INSERT tbl(...) VALUES ...` — sqllogicaltests cases use both)
+        self.accept_kw("TABLE")  # `INSERT INTO TABLE t` variant
         table = self.expect_ident()
         database = None
         if self.accept_op("."):
@@ -925,8 +1067,8 @@ class Parser:
             while self.accept_op(","):
                 columns.append(self.expect_ident())
             self.expect_op(")")
-        if self.kw() == "SELECT":
-            return ast.InsertStmt(table, columns, [], self.parse_select(),
+        if self.kw() in ("SELECT", "WITH"):
+            return ast.InsertStmt(table, columns, [], self.parse_query(),
                                   database)
         self.expect_kw("VALUES")
         rows = []
@@ -956,6 +1098,10 @@ class Parser:
         self.expect_kw("DELETE")
         self.expect_kw("FROM")
         database, table = self.parse_qualified_ident()
+        # optional table alias (reference sqlparser accepts
+        # `DELETE FROM t a WHERE ...`); WHERE refers to bare columns
+        if self.peek().kind == "ident" and self.kw() not in _RESERVED:
+            self.next()
         where = self.parse_expr() if self.accept_kw("WHERE") else None
         return ast.DeleteStmt(table, where, database)
 
@@ -1162,6 +1308,15 @@ class Parser:
                 from .expr import Exists
 
                 return Exists(sub)
+            if k == "EXTRACT" and self._peek_op_at(1) == "(":
+                # EXTRACT(field FROM expr) → date_part('field', expr)
+                self.next()
+                self.expect_op("(")
+                field = self.expect_ident()
+                self.expect_kw("FROM")
+                e = self.parse_expr()
+                self.expect_op(")")
+                return Func("date_part", [Literal(field.lower()), e])
             if k == "CASE":
                 # CASE [operand] WHEN v THEN r ... [ELSE d] END — searched
                 # and simple forms (reference: DataFusion Expr::Case)
@@ -1186,7 +1341,17 @@ class Parser:
 
                 return Case(operand, whens, else_)
             if k in _RESERVED:
-                raise ParserError(f"unexpected keyword {t.value!r} in expression")
+                # LEFT/RIGHT/EXTRACT are function names when a '(' follows
+                # (DataFusion accepts the same); elsewhere they stay
+                # reserved (JOIN kinds)
+                nxt = self.tokens[self.i + 1] if self.i + 1 < len(
+                    self.tokens) else None
+                callable_kw = (k in ("LEFT", "RIGHT")
+                               and nxt is not None and nxt.kind == "op"
+                               and nxt.value == "(")
+                if not callable_kw:
+                    raise ParserError(
+                        f"unexpected keyword {t.value!r} in expression")
             name = self.next().value
             if self.accept_op("("):
                 if self.accept_op("*"):
@@ -1333,7 +1498,7 @@ def _const_eval(e: Expr):
     if isinstance(e, UnaryOp) and e.op == "-":
         v = _const_eval(e.operand)
         return -v
-    if isinstance(e, (Func, BinOp)):
+    if type(e).__name__ in ("Func", "BinOp", "Cast", "Case"):
         import numpy as np
 
         v = e.eval({}, np)

@@ -474,15 +474,22 @@ def _plan_raw(stmt, schema, time_trs, tag_domains, residual):
     output: list[tuple[str, Expr]] = []
     for it in stmt.items:
         if it.expr == "*":
-            output.append((TIME_COL, Column(TIME_COL)))
-            for c in schema.tag_columns:
-                output.append((c.name, Column(c.name)))
-            for c in schema.field_columns:
+            # declared column order (time first, then tags/fields exactly
+            # as CREATE TABLE/ALTER laid them out — the reference keeps
+            # schema order in SELECT *, it does not group tags)
+            for c in schema.columns:
                 output.append((c.name, Column(c.name)))
         else:
             name = it.alias or (it.expr.name if isinstance(it.expr, Column)
                                 else it.expr.to_sql())
             output.append((name, it.expr))
+    seen: set[str] = set()
+    for name, _e in output:
+        if name in seen:
+            raise PlanError(
+                f"Projections require unique expression names: {name!r} "
+                f"appears more than once — alias one of them")
+        seen.add(name)
     return RawScanPlan(
         table=stmt.table, schema=schema, time_ranges=time_trs,
         tag_domains=tag_domains, filter=residual, output=output,

@@ -353,6 +353,9 @@ def host_aggregate(func: str, col, gid: np.ndarray, n_groups: int,
     if col is None:
         raise PlanError(f"aggregate {func} needs an argument")
     col = np.asarray(col)
+    if col.shape == ():
+        # constant argument (count(1), sum(2)): broadcast over the rows
+        col = np.full(len(gid), col[()])
     if col.dtype == object:
         valid = np.array([v is not None for v in col], dtype=bool)
     elif np.issubdtype(col.dtype, np.floating):

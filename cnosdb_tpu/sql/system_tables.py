@@ -31,12 +31,28 @@ def system_table(executor, db: str, table: str, session) -> tuple[list[str], lis
             return _cols(["tenant_name", "database_name", "ttl", "shard",
                           "vnode_duration", "replica", "precision"], rows)
         if t == "tables":
+            # column set and values follow the reference
+            # (information_schema_provider/builder/tables.rs: table_type
+            # TABLE, engine TSKV/EXTERNAL/STREAM, options 'TODO')
             rows = []
             for dbn in meta.list_databases(session.tenant):
                 for tn in meta.list_tables(session.tenant, dbn):
-                    rows.append((session.tenant, dbn, tn, "BASE TABLE"))
+                    rows.append((session.tenant, dbn, tn, "TABLE", "TSKV",
+                                 "TODO"))
+                owner = f"{session.tenant}.{dbn}"
+                for tn in sorted(getattr(meta, "externals", {})
+                                 .get(owner, {})):
+                    rows.append((session.tenant, dbn, tn, "TABLE",
+                                 "EXTERNAL", "TODO"))
+            for key, st in sorted(getattr(meta, "stream_tables",
+                                          {}).items()):
+                tenant, dbn, name = key.split(".", 2)
+                if tenant != session.tenant:
+                    continue
+                rows.append((tenant, dbn, name, "TABLE", "STREAM", "TODO"))
             return _cols(["table_tenant", "table_database", "table_name",
-                          "table_type"], rows)
+                          "table_type", "table_engine", "table_options"],
+                         rows)
         if t == "columns":
             rows = []
             for dbn in meta.list_databases(session.tenant):

@@ -349,9 +349,9 @@ def test_join_reorder_outer_falls_back(db3):
 def test_case_null_aware_in_where(db):
     """CASE WHEN i IS NULL THEN true END as a FILTER must keep NULL rows
     (post-hoc validity masking must skip CASE-referenced columns)."""
-    db.execute_one("CREATE TABLE cw (i BIGINT, TAGS(h))")
-    db.execute_one("INSERT INTO cw (time, h, i) VALUES "
-                   "(1,'a',5),(2,'a',NULL),(3,'b',NULL)")
+    db.execute_one("CREATE TABLE cw (i BIGINT, pad BIGINT, TAGS(h))")
+    db.execute_one("INSERT INTO cw (time, h, i, pad) VALUES "
+                   "(1,'a',5,0),(2,'a',NULL,0),(3,'b',NULL,0)")
     rs = db.execute_one(
         "SELECT time FROM cw WHERE CASE WHEN i IS NULL THEN true "
         "ELSE false END ORDER BY time")
@@ -373,9 +373,9 @@ def test_case_agg_inside(db):
 def test_case_simple_null_operand_never_matches(db):
     """CASE i WHEN 0 THEN ... with NULL i must take ELSE (garbage in the
     typed NULL slot must not match)."""
-    db.execute_one("CREATE TABLE cn (i BIGINT, TAGS(h))")
-    db.execute_one("INSERT INTO cn (time, h, i) VALUES "
-                   "(1,'a',0),(2,'a',NULL)")
+    db.execute_one("CREATE TABLE cn (i BIGINT, pad BIGINT, TAGS(h))")
+    db.execute_one("INSERT INTO cn (time, h, i, pad) VALUES "
+                   "(1,'a',0,0),(2,'a',NULL,0)")
     rs = db.execute_one(
         "SELECT time, CASE i WHEN 0 THEN 'zero' ELSE 'other' END AS s "
         "FROM cn ORDER BY time")
@@ -401,8 +401,8 @@ def test_int_sum_overflow_exact(db):
     db.execute_one(f"INSERT INTO ov (time, h, i) VALUES "
                    f"(1,'a',{big}),(2,'a',{big}),(3,'a',{big})")
     # relational path (join) to hit host_aggregate
-    db.execute_one("CREATE TABLE ovd (TAGS(h))")
-    db.execute_one("INSERT INTO ovd (time, h) VALUES (1,'a')")
+    db.execute_one("CREATE TABLE ovd (pad BIGINT, TAGS(h))")
+    db.execute_one("INSERT INTO ovd (time, h, pad) VALUES (1,'a',0)")
     rs = db.execute_one(
         "SELECT sum(ov.i) FROM ov JOIN ovd ON ov.h = ovd.h")
     assert rs.columns[0].tolist() == [3 * big]
@@ -448,9 +448,9 @@ def test_correlated_exists_with_local_predicate(db):
 def test_correlated_not_exists_null_outer_key(db):
     """Anti-join semantics: an outer row whose key is NULL has no match
     and must be KEPT by NOT EXISTS (NOT IN would drop it)."""
-    db.execute_one("CREATE TABLE ev (k BIGINT, TAGS(t))")
-    db.execute_one("INSERT INTO ev (time, t, k) VALUES "
-                   "(1,'x',1),(2,'x',NULL),(3,'x',9)")
+    db.execute_one("CREATE TABLE ev (k BIGINT, pad BIGINT, TAGS(t))")
+    db.execute_one("INSERT INTO ev (time, t, k, pad) VALUES "
+                   "(1,'x',1,0),(2,'x',NULL,0),(3,'x',9,0)")
     db.execute_one("CREATE TABLE kv (k2 BIGINT, TAGS(t))")
     db.execute_one("INSERT INTO kv (time, t, k2) VALUES (1,'y',1)")
     rs = db.execute_one(

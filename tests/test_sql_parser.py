@@ -181,10 +181,10 @@ def test_try_cast_is_per_element_and_cast_skips_null_slots(tmp_path):
     ex = QueryExecutor(meta, coord)
     s = Session()
     ex.execute_one(
-        "CREATE TABLE public.ct (f DOUBLE, TAGS(h))", s)
+        "CREATE TABLE public.ct (f DOUBLE, pad BIGINT, TAGS(h))", s)
     ex.execute_one(
-        "INSERT INTO public.ct (time, h, f) VALUES "
-        "(1,'x',1.9), (2,'x',1.0/0), (3,'x',NULL), (4,'x',-2.5)", s)
+        "INSERT INTO public.ct (time, h, f, pad) VALUES "
+        "(1,'x',1.9,0), (2,'x',1.0/0,0), (3,'x',NULL,0), (4,'x',-2.5,0)", s)
     rs = ex.execute_one(
         "SELECT TRY_CAST(f AS BIGINT) AS x FROM public.ct ORDER BY time", s)
     got = [None if v is None or (isinstance(v, float) and np.isnan(v))
