@@ -671,6 +671,8 @@ def _assemble(batch, query, presence, present, col_results, group_labels,
         cnt = r.get("count")
         unsigned = (unsigned_biased and a.column in batch.fields
                     and batch.fields[a.column][0] == ValueType.UNSIGNED)
+        boolean = (a.column in batch.fields
+                   and batch.fields[a.column][0] == ValueType.BOOLEAN)
 
         def unbias(x):
             return (np.ascontiguousarray(x).view(np.uint64)
@@ -698,12 +700,19 @@ def _assemble(batch, query, presence, present, col_results, group_labels,
         elif a.func in ("min", "max"):
             have = cnt[sel] > 0
             v = r[a.func][sel]
-            out_cols[a.alias] = unbias(v) if unsigned else v
+            v = unbias(v) if unsigned else v
+            if boolean:
+                v = v.astype(bool)   # kernels run bools as i64; the
+                # value identity is BOOLEAN (min(f2) renders 'false')
+            out_cols[a.alias] = v
             out_valid[a.alias] = have
         elif a.func in ("first", "last"):
             have = cnt[sel] > 0
             v = r[a.func][sel]
-            out_cols[a.alias] = unbias(v) if unsigned else v
+            v = unbias(v) if unsigned else v
+            if boolean:
+                v = v.astype(bool)
+            out_cols[a.alias] = v
             out_valid[a.alias] = have
             # hidden timestamp of the selected row: lets a coordinator merge
             # first/last partials across vnodes by actual time order. Run

@@ -654,8 +654,10 @@ class HttpServer:
 def _cell(v):
     if v is None:
         return ""
-    if isinstance(v, float) and np.isnan(v):
-        return ""
+    if isinstance(v, (float, np.floating)) and np.isnan(v):
+        return "NaN"   # NaN is a VALUE; NULL is the empty cell
+    if isinstance(v, (float, np.floating)) and v == 0.0:
+        return repr(0.0)   # normalize -0.0 (arrow renders 0.0)
     if isinstance(v, np.floating):
         return repr(float(v))
     if isinstance(v, (np.integer,)):

@@ -1753,8 +1753,20 @@ class QueryExecutor:
             if key not in agg_cache:
                 col = None if (star or not args) else \
                     np.asarray(args[0].eval(scope.env, np))
+                col2 = param = None
+                name = f.name.lower()
+                if name in ("corr", "covar", "covar_pop", "covar_samp") \
+                        and len(args) == 2:
+                    col2 = np.asarray(args[1].eval(scope.env, np))
+                elif name == "approx_percentile_cont" and len(args) == 2:
+                    param = args[1].eval(scope.env, np)
+                elif name == "approx_percentile_cont_with_weight" \
+                        and len(args) == 3:
+                    col2 = np.asarray(args[1].eval(scope.env, np))
+                    param = args[2].eval(scope.env, np)
                 agg_cache[key] = rel.host_aggregate(
-                    f.name, col, gid, n_groups, distinct)
+                    f.name, col, gid, n_groups, distinct,
+                    col2=col2, param=param)
             return key
 
         def rewrite(e):
@@ -1869,9 +1881,18 @@ class QueryExecutor:
     # ---------------------------------------------------------- aggregates
     def _exec_aggregate(self, plan: AggregatePlan, tenant: str, db: str):
         phys_aggs, finalize = _decompose_aggs(plan.aggs)
+        second_cols = set()
+        for a in phys_aggs:
+            # collect2 / count_multi carry companion columns in param
+            if a.func == "collect2" and isinstance(a.param, str):
+                second_cols.add(a.param)
+            elif a.func == "count_multi":
+                second_cols.update(a.param or ())
         needed_fields = sorted({a.column for a in phys_aggs if a.column}
+                               | second_cols
                                | set(plan.group_fields)
-                               | (plan.filter.columns() & set(plan.schema.field_names())
+                               | (plan.filter.columns()
+                                  & set(plan.schema.field_names())
                                   if plan.filter else set()))
         batches = self.coord.scan_table(
             tenant, db, plan.table, time_ranges=plan.time_ranges,
@@ -1882,7 +1903,8 @@ class QueryExecutor:
                                                 finalize)
 
     def _exec_aggregate_batches(self, plan, batches, phys_aggs, finalize):
-        host_funcs = ("count_distinct", "collect", "collect_ts")
+        host_funcs = ("count_distinct", "collect", "collect_ts",
+                      "collect2", "count_multi")
         q = TpuQuery(filter=plan.filter, group_tags=plan.group_tags,
                      group_fields=plan.group_fields,
                      time_bucket=plan.bucket,
@@ -2117,11 +2139,10 @@ class QueryExecutor:
                 c2 = col.copy()
                 c2[~valid] = None
                 rendered.append(c2)
-            elif np.issubdtype(col.dtype, np.floating):
-                c2 = col.copy()
-                c2[~valid] = np.nan
-                rendered.append(c2)
             else:
+                # NULL slots become None; valid NaN values STAY NaN —
+                # the reference distinguishes them (acos(2) renders NaN,
+                # a NULL renders empty)
                 c2 = col.astype(object)
                 c2[~valid] = None
                 rendered.append(c2)
@@ -2151,11 +2172,11 @@ def _decompose_aggs(aggs: list[AggSpec]):
     finalize: dict = {}
     seen: dict[tuple, str] = {}
 
-    def want(func, col):
-        key = (func, col)
+    def want(func, col, param=None):
+        key = (func, col, repr(param))
         if key not in seen:
             alias = f"__p{len(phys)}"
-            phys.append(AggSpec(func, col, alias))
+            phys.append(AggSpec(func, col, alias, param))
             seen[key] = alias
         return seen[key]
 
@@ -2167,14 +2188,42 @@ def _decompose_aggs(aggs: list[AggSpec]):
         elif a.func == "count":
             c = want("count", a.column)
             finalize[a.alias] = ("int", c)
+        elif a.func == "count_null_const":
+            # count(NULL): zero per group, but groups still materialize
+            c = want("count", a.column)
+            finalize[a.alias] = ("zero", c)
+        elif a.func == "count_multi":
+            # count(a, b, ...): rows where every column is non-NULL
+            finalize[a.alias] = ("int", want("count_multi", a.column,
+                                             a.param))
+        elif a.func.startswith("const_agg:"):
+            # aggregate over a constant literal (avg(3) → 3.0)
+            c = want("count", None)
+            finalize[a.alias] = ("const_agg", a.func.split(":", 1)[1],
+                                 c, a.param)
         elif a.func == "sum":
             finalize[a.alias] = ("pass", want("sum", a.column))
         elif a.func in ("min", "max", "first", "last"):
             finalize[a.alias] = ("pass", want(a.func, a.column))
-        elif a.func == "count_distinct":
+        elif a.func in ("count_distinct", "approx_distinct"):
             finalize[a.alias] = ("distinct", want("count_distinct", a.column))
-        elif a.func in ("median", "stddev", "mode"):
-            finalize[a.alias] = (a.func, want("collect", a.column))
+        elif a.func in ("median", "approx_median", "stddev",
+                        "stddev_samp", "stddev_pop", "var", "var_samp",
+                        "var_pop", "mode", "array_agg"):
+            kind = {"approx_median": "median", "stddev_samp": "stddev",
+                    "var": "var_samp"}.get(a.func, a.func)
+            finalize[a.alias] = (kind, want("collect", a.column))
+        elif a.func == "approx_percentile_cont":
+            finalize[a.alias] = ("percentile", want("collect", a.column),
+                                 a.param)
+        elif a.func == "approx_percentile_cont_with_weight":
+            wcol, q = a.param
+            finalize[a.alias] = ("percentile_w",
+                                 want("collect2", a.column, wcol), q)
+        elif a.func in ("corr", "covar", "covar_pop", "covar_samp"):
+            kind = "covar_samp" if a.func == "covar" else a.func
+            finalize[a.alias] = (kind,
+                                 want("collect2", a.column, a.param))
         elif a.func in _SERIES_AGGS:
             # whole-series aggregates: need the group's full time-ordered
             # (ts, value) sequence (reference runs these as DataFusion
@@ -2318,6 +2367,17 @@ def _series_finalize(func: str, ts: np.ndarray, vals: np.ndarray, param):
         return None
 
 
+def _cell_repr(v) -> str:
+    """array_agg element rendering (bare values, arrow list style)."""
+    if isinstance(v, (float, np.floating)):
+        return repr(float(v))
+    if isinstance(v, (bool, np.bool_)):
+        return "true" if v else "false"
+    if isinstance(v, np.integer):
+        return str(int(v))
+    return str(v)
+
+
 def _apply_finalizer(spec, parts: dict):
     """Scalar (per-group-dict) interpretation of a finalizer spec."""
     kind = spec[0]
@@ -2328,22 +2388,86 @@ def _apply_finalizer(spec, parts: dict):
         return parts.get(spec[1], 0.0) / cnt
     if kind == "int":
         return int(parts.get(spec[1], 0))
+    if kind == "zero":
+        return 0
     if kind == "pass":
         return parts.get(spec[1])
     if kind == "distinct":
         vals = parts.get(spec[1])
         return len(vals) if vals is not None else 0
-    if kind in ("median", "stddev", "mode"):
+    if kind in ("median", "stddev", "stddev_pop", "var_samp", "var_pop",
+                "mode", "array_agg"):
         chunks = parts.get(spec[1])
         if not chunks:
             return None
         vals = np.concatenate(chunks)
         if kind == "median":
-            return float(np.median(vals))
+            return float(np.median(vals.astype(np.float64)))
         if kind == "stddev":
-            return float(np.std(vals, ddof=1)) if len(vals) > 1 else None
+            return float(np.std(vals.astype(np.float64), ddof=1)) \
+                if len(vals) > 1 else None
+        if kind == "stddev_pop":
+            return float(np.std(vals.astype(np.float64), ddof=0))
+        if kind == "var_samp":
+            return float(np.var(vals.astype(np.float64), ddof=1)) \
+                if len(vals) > 1 else None
+        if kind == "var_pop":
+            return float(np.var(vals.astype(np.float64), ddof=0))
+        if kind == "array_agg":
+            # rendered like arrow's list repr (reference array_agg.slt)
+            return "[" + ", ".join(_cell_repr(v) for v in vals) + "]"
         uniq, counts = np.unique(vals, return_counts=True)
         return uniq[np.argmax(counts)]
+    if kind == "percentile":
+        chunks = parts.get(spec[1])
+        if not chunks:
+            return None
+        vals = np.concatenate(chunks).astype(np.float64)
+        return float(np.quantile(vals, spec[2]))
+    if kind == "percentile_w":
+        chunks = parts.get(spec[1])
+        if not chunks:
+            return None
+        vals = np.concatenate([c[0] for c in chunks]).astype(np.float64)
+        w = np.concatenate([c[1] for c in chunks]).astype(np.float64)
+        order = np.argsort(vals)
+        vals, w = vals[order], w[order]
+        cum = np.cumsum(w)
+        if cum[-1] <= 0:
+            return None
+        target = spec[2] * cum[-1]
+        return float(vals[np.searchsorted(cum, target, side="left")
+                          .clip(0, len(vals) - 1)])
+    if kind in ("corr", "covar_samp", "covar_pop"):
+        chunks = parts.get(spec[1])
+        if not chunks:
+            return None
+        x = np.concatenate([c[0] for c in chunks]).astype(np.float64)
+        y = np.concatenate([c[1] for c in chunks]).astype(np.float64)
+        if kind == "corr":
+            if len(x) < 2 or np.std(x) == 0 or np.std(y) == 0:
+                return None
+            return float(np.corrcoef(x, y)[0, 1])
+        ddof = 1 if kind == "covar_samp" else 0
+        if len(x) <= ddof:
+            return None
+        return float(np.cov(x, y, ddof=ddof)[0, 1])
+    if kind == "const_agg":
+        rows = int(parts.get(spec[2], 0))
+        func, value = spec[1], spec[3]
+        if func == "sum":
+            return value * rows if rows else None
+        if rows == 0:
+            return None
+        if func in ("avg", "mean", "median"):
+            return float(value)
+        if func in ("min", "max"):
+            return value
+        if func in ("stddev", "stddev_samp", "var", "var_samp"):
+            return 0.0 if rows > 1 else None
+        if func in ("stddev_pop", "var_pop"):
+            return 0.0
+        return None
     if kind == "series":
         chunks = parts.get(spec[2])
         if not chunks:
@@ -2376,12 +2500,8 @@ def _render_output(plan, env: dict, n: int):
             if vk in env and len(env[vk]) == n:
                 vv &= env[vk]
         if not vv.all():
-            if np.issubdtype(arr.dtype, np.floating):
-                arr = arr.copy()
-                arr[~vv] = np.nan
-            else:
-                arr = arr.astype(object)
-                arr[~vv] = None
+            arr = arr.astype(object)
+            arr[~vv] = None
         names.append(name)
         cols.append(arr)
     return names, cols
@@ -2409,6 +2529,24 @@ def _vector_finalize(spec, parts_env: dict, n: int):
     if kind == "int":
         c, _ = col(spec[1], 0)
         return c.astype(np.int64), np.ones(n, dtype=bool)
+    if kind == "zero":
+        return np.zeros(n, dtype=np.int64), np.ones(n, dtype=bool)
+    if kind == "const_agg":
+        rows, _ = col(spec[2], 0)
+        rows = rows.astype(np.int64)
+        func, value = spec[1], spec[3]
+        ok = rows > 0
+        if func == "sum":
+            return np.where(ok, value * rows, 0), ok
+        if func in ("avg", "mean", "median"):
+            return np.where(ok, float(value), np.nan), ok
+        if func in ("min", "max"):
+            return np.where(ok, value, 0), ok
+        if func in ("stddev", "stddev_samp", "var", "var_samp"):
+            return np.zeros(n), rows > 1
+        if func in ("stddev_pop", "var_pop"):
+            return np.zeros(n), ok
+        raise ExecutionError(f"bad const_agg {func!r}")
     if kind == "pass":
         return col(spec[1])
     if kind == "distinct":
@@ -2472,22 +2610,40 @@ def _merge_partial(acc: dict, result, plan: AggregatePlan,
                     parts[a.alias + "__ts"] = ts
 
 
-def _merge_distinct(acc: dict, batch, plan: AggregatePlan, spec: AggSpec):
-    """Host-side COUNT(DISTINCT col): collect value sets per group."""
-    if spec.column in batch.fields:
-        vt, vals, valid = batch.fields[spec.column]
-        vals = as_object_array(vals)
-    elif spec.column in plan.schema.tag_names():
+def _batch_column(batch, plan, col):
+    """(values, valid) for a field / tag / time column of a scan batch,
+    or (None, None) when absent from this vnode."""
+    if col in batch.fields:
+        vt, vals, valid = batch.fields[col]
+        return as_object_array(vals), valid
+    if col in plan.schema.tag_names():
         per_series = np.array(
-            [(k.tag_value(spec.column) if k is not None else None)
+            [(k.tag_value(col) if k is not None else None)
              for k in batch.series_keys], dtype=object)
         vals = per_series[batch.sid_ordinal]
-        valid = np.array([v is not None for v in vals], dtype=bool)
-    elif spec.column == "time":
-        vals = batch.ts
-        valid = np.ones(batch.n_rows, dtype=bool)
-    else:
+        return vals, np.array([v is not None for v in vals], dtype=bool)
+    if col == "time":
+        return batch.ts, np.ones(batch.n_rows, dtype=bool)
+    return None, None
+
+
+def _merge_distinct(acc: dict, batch, plan: AggregatePlan, spec: AggSpec):
+    """Host-side COUNT(DISTINCT col) + collect partials per group."""
+    vals, valid = _batch_column(batch, plan, spec.column)
+    if vals is None:
         return
+    vals2 = None
+    if spec.func == "collect2":
+        vals2, valid2 = _batch_column(batch, plan, spec.param)
+        if vals2 is None:
+            return
+        valid = valid & valid2
+    if spec.func == "count_multi":
+        for extra in spec.param or []:
+            _ev, evalid = _batch_column(batch, plan, extra)
+            if _ev is None:
+                return
+            valid = valid & evalid
     # reuse the group/bucket mapping by building keys per row
     from ..ops.tpu_exec import _filter_env
 
@@ -2509,8 +2665,20 @@ def _merge_distinct(acc: dict, batch, plan: AggregatePlan, spec: AggSpec):
     if plan.bucket is not None:
         origin, interval = plan.bucket
         buckets = origin + ((batch.ts - origin) // interval) * interval
-    collect = spec.func in ("collect", "collect_ts")
+    collect = spec.func in ("collect", "collect_ts", "collect2")
     idxs = np.nonzero(mask)[0]
+    if spec.func == "count_multi":
+        if plan.bucket is not None or plan.group_tags:
+            for i in idxs:
+                key = tagmaps[batch.sid_ordinal[i]]
+                if plan.bucket is not None:
+                    key = key + (int(buckets[i]),)
+                parts = acc.setdefault(key, {})
+                parts[spec.alias] = parts.get(spec.alias, 0) + 1
+        else:
+            parts = acc.setdefault((), {})
+            parts[spec.alias] = parts.get(spec.alias, 0) + len(idxs)
+        return
     if collect:
         # group indices first, slice values in bulk per group
         group_rows: dict[tuple, list[int]] = {}
@@ -2521,9 +2689,15 @@ def _merge_distinct(acc: dict, batch, plan: AggregatePlan, spec: AggSpec):
             group_rows.setdefault(key, []).append(i)
         arr = np.asarray(vals)
         with_ts = spec.func == "collect_ts"
+        arr2 = np.asarray(vals2) if vals2 is not None else None
         for key, rows in group_rows.items():
             parts = acc.setdefault(key, {})
-            chunk = (batch.ts[rows], arr[rows]) if with_ts else arr[rows]
+            if spec.func == "collect2":
+                chunk = (arr[rows], arr2[rows])
+            elif with_ts:
+                chunk = (batch.ts[rows], arr[rows])
+            else:
+                chunk = arr[rows]
             parts.setdefault(spec.alias, []).append(chunk)
         return
     for i in idxs:

@@ -88,6 +88,23 @@ _BIN_OPS = {
 }
 
 
+def _math_float(xp, v):
+    """Numeric math-function results promote to Float64 (DataFusion
+    semantics the reference inherits); NULL-bearing object arrays map
+    elementwise, NULLs preserved."""
+    if isinstance(v, np.ndarray):
+        if v.dtype == object:
+            o = np.empty(len(v), dtype=object)
+            o[:] = [None if x is None else float(x) for x in v]
+            return o
+        if v.dtype.kind in "iub":
+            return v.astype(np.float64)
+        return v
+    if isinstance(v, (int, np.integer)) and not isinstance(v, bool):
+        return float(v)
+    return v
+
+
 def _div(xp, a, b):
     # SQL division: integer/integer stays integral in CnosDB? DataFusion
     # yields float for `/` on floats, TRUNC-div on ints (toward zero —
@@ -157,13 +174,18 @@ def _obj_binop(op: str, f, xp, a, b):
             return v, np.zeros(n, dtype=bool)
         nulls = np.array([x is None for x in v], dtype=bool)
         vals = [0 if x is None else x for x in v]
-        try:
-            arr = np.array(vals, dtype=np.int64)
-        except (TypeError, ValueError, OverflowError):
+        # int64 only when every value IS an integer — np.array(...,
+        # dtype=int64) silently truncates floats (1.5 → 1)
+        if all(isinstance(x, (int, np.integer))
+               and not isinstance(x, (bool, np.bool_)) for x in vals):
             try:
-                arr = np.array(vals, dtype=np.float64)
-            except (TypeError, ValueError):
-                return v, nulls   # strings etc: operate on objects
+                return np.array(vals, dtype=np.int64), nulls
+            except (TypeError, ValueError, OverflowError):
+                pass
+        try:
+            arr = np.array(vals, dtype=np.float64)
+        except (TypeError, ValueError):
+            return v, nulls   # strings etc: operate on objects
         return arr, nulls
 
     aa, an = clean(a)
@@ -497,11 +519,15 @@ class Func(Expr):
     name: str
     args: list
 
+    # math scalars return Float64 regardless of input type (reference via
+    # DataFusion's math_expressions: abs(BIGINT) renders 1.0 — pinned by
+    # function/common/math_function/abs.slt)
     _FUNCS = {
-        "abs": lambda xp, a: xp.abs(a),
+        "abs": lambda xp, a: _math_float(xp, xp.abs(a)),
         "floor": lambda xp, a: xp.floor(a),
         "ceil": lambda xp, a: xp.ceil(a),
-        "round": lambda xp, a, *nd: xp.round(a, *[int(d) for d in nd]),
+        "round": lambda xp, a, *nd: _math_float(
+            xp, xp.round(a, *[int(d) for d in nd])),
         "sqrt": lambda xp, a: xp.sqrt(a),
         "cbrt": lambda xp, a: xp.cbrt(a),
         "exp": lambda xp, a: xp.exp(a),
@@ -523,7 +549,7 @@ class Func(Expr):
         "atan2": lambda xp, a, b: xp.arctan2(a, b),
         "pow": lambda xp, a, b: xp.power(a, b),
         "power": lambda xp, a, b: xp.power(a, b),
-        "signum": lambda xp, a: xp.sign(a),
+        "signum": lambda xp, a: _math_float(xp, xp.sign(a)),
         "trunc": lambda xp, a: xp.trunc(a),
         "radians": lambda xp, a: xp.radians(a),
         "degrees": lambda xp, a: xp.degrees(a),
@@ -553,12 +579,18 @@ class Func(Expr):
         return f"{self.name}({', '.join(a.to_sql() for a in self.args)})"
 
 
-def _str_func(fn, *, out=object):
+def _str_func(fn, *, out=object, strict=True):
     """Lift a python string function elementwise over object columns
-    (DataFusion-inherited string scalars in the reference)."""
+    (DataFusion-inherited string scalars in the reference). strict
+    functions reject non-string inputs ('The function can only accept
+    strings' — string_func/*.slt); ascii and the concat family coerce."""
     def run(xp, arr, *rest):
         import numpy as _np
 
+        if strict:
+            _require_string_input(arr)
+        rest = tuple(r.materialize() if isinstance(r, DictArray) else r
+                     for r in rest)
         arr_rest = [r for r in rest
                     if isinstance(r, _np.ndarray) and r.shape != ()]
         if arr_rest:
@@ -633,6 +665,9 @@ def _fn_rpad(s, n, p=" "):
 def _fn_concat(xp, *parts):
     import numpy as _np
 
+    if not parts:
+        raise PlanError("concat takes at least one argument")
+
     parts = [p.materialize() if isinstance(p, DictArray) else p
              for p in parts]
     arrays = [p for p in parts if isinstance(p, _np.ndarray)]
@@ -654,6 +689,25 @@ def _as_i64(xp, a):
         if not bool(xp.all(arr == xp.floor(arr))):
             raise PlanError("gcd/lcm require integer arguments")
     return arr.astype(xp.int64) if hasattr(arr, "astype") else arr
+
+
+def _require_string_input(arr):
+    import numpy as _np
+
+    bad = False
+    if isinstance(arr, DictArray):
+        return
+    if isinstance(arr, _np.ndarray):
+        if arr.dtype.kind in "iufb":
+            bad = True
+        elif arr.dtype == object:
+            bad = any(isinstance(x, (int, float, _np.number, bool))
+                      and not isinstance(x, str)
+                      for x in arr if x is not None)
+    elif isinstance(arr, (bool, int, float, _np.number)):
+        bad = True
+    if bad:
+        raise PlanError("the function can only accept strings")
 
 
 def _fn_ascii(s):
@@ -719,6 +773,10 @@ def _fn_to_hex(x):
 
 def _fn_concat_ws(xp, sep, *parts):
     import numpy as _np
+
+    if not parts:
+        raise PlanError("concat_ws takes a separator and at least one "
+                        "argument")
 
     sep_v = sep.item() if hasattr(sep, "item") else sep
     if isinstance(sep_v, _np.ndarray):
@@ -896,6 +954,8 @@ def _obj_func(fn, *, numeric: bool = True):
     def run(xp, arr, *rest):
         import numpy as _np
 
+        if isinstance(arr, DictArray):
+            arr = arr.materialize()
         rest = [r.item() if hasattr(r, "item") else r for r in rest]
         if isinstance(arr, _np.ndarray):
             vals = [None if x is None else fn(x, *rest) for x in arr]
@@ -972,7 +1032,7 @@ def _register_tsfuncs():
         "repeat": _str_func(lambda s, n: s * int(n)),
         "lpad": _str_func(_fn_lpad),
         "rpad": _str_func(_fn_rpad),
-        "ascii": _str_func(_fn_ascii, out=np.int64),
+        "ascii": _str_func(_fn_ascii, out=np.int64, strict=False),
         "chr": _obj_func(lambda x: chr(int(x)), numeric=False),
         "bit_length": _str_func(lambda s: len(s.encode()) * 8,
                                 out=np.int64),

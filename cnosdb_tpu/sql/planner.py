@@ -26,8 +26,26 @@ from .expr import (
 )
 from .parser import parse_timestamp_string
 
+# aggregates that only take numeric inputs (reference/DataFusion type
+# signatures: Avg/Sum/Stddev/Median reject Timestamp, Utf8 and Boolean)
+_NUMERIC_ONLY_AGGS = {"sum", "avg", "mean", "median", "stddev",
+                      "stddev_samp", "stddev_pop", "var", "var_samp",
+                      "var_pop", "corr", "covar", "covar_pop",
+                      "covar_samp", "approx_median",
+                      "approx_percentile_cont",
+                      "approx_percentile_cont_with_weight",
+                      "increase", "sample"}
+
+# two-column statistical aggregates (reference statistical_agg/*.rs)
+_TWO_COL_AGGS = {"corr", "covar", "covar_pop", "covar_samp"}
+
 AGG_FUNCS = {"count", "sum", "avg", "mean", "min", "max", "first", "last",
-             "median", "stddev", "mode", "increase", "count_distinct",
+             "median", "stddev", "stddev_samp", "stddev_pop",
+             "var", "var_samp", "var_pop",
+             "corr", "covar", "covar_pop", "covar_samp",
+             "approx_distinct", "approx_median", "approx_percentile_cont",
+             "approx_percentile_cont_with_weight", "array_agg",
+             "mode", "increase", "count_distinct",
              "sample", "gauge_agg", "state_agg", "compact_state_agg",
              "completeness", "consistency", "timeliness", "validity"}
 
@@ -300,21 +318,84 @@ class _AggCollector:
         args = [a for a in f.args
                 if not (isinstance(a, Literal) and a.value == "__distinct__")]
         param = None
-        if name in TS_PAIR_AGGS and len(args) == 2:
+        if (name in TS_PAIR_AGGS or name in ("first", "last")) \
+                and len(args) == 2:
             if not (isinstance(args[0], Column) and args[0].name == TIME_COL):
                 raise PlanError(
                     f"{name}(time, value): first argument must be the time "
                     f"column, got {f.to_sql()}")
             args = args[1:]   # reference signature f(time, value)
+        if name in ("first", "last") and len(args) == 1 \
+                and isinstance(args[0], Column) \
+                and args[0].name == TIME_COL:
+            # reference first/last take (time, value); a lone time column
+            # is rejected there ("does not accept 1 function arguments")
+            raise PlanError(
+                f"the function {name} takes (time, value); min/max(time) "
+                f"orders timestamps")
         if name == "sample":
             if len(args) != 2 or not isinstance(args[1], Literal):
                 raise PlanError("sample(column, k) takes a column and a "
                                 "constant size")
             param = int(args[1].value)
             args = args[:1]
+        if name in _TWO_COL_AGGS:
+            if len(args) != 2 or not all(isinstance(a, Column)
+                                         for a in args):
+                raise PlanError(
+                    f"{name}(x, y) takes exactly two columns")
+            param = args[1].name
+            args = args[:1]
+        if name == "approx_percentile_cont":
+            if len(args) != 2 or not isinstance(args[1], Literal):
+                raise PlanError(
+                    "approx_percentile_cont(col, q) takes a column and "
+                    "a constant quantile")
+            param = float(args[1].value)
+            args = args[:1]
+        if name == "approx_percentile_cont_with_weight":
+            if len(args) != 3 or not isinstance(args[1], Column) \
+                    or not isinstance(args[2], Literal):
+                raise PlanError(
+                    "approx_percentile_cont_with_weight(col, w, q) takes "
+                    "two columns and a constant quantile")
+            param = (args[1].name, float(args[2].value))
+            args = args[:1]
+        if name not in TS_PAIR_AGGS and name not in ("sample", "count") \
+                and name not in _TWO_COL_AGGS \
+                and not name.startswith("approx_percentile") \
+                and len(args) > 1:
+            raise PlanError(
+                f"the function {name} takes exactly one argument, got "
+                f"{len(args)}: {f.to_sql()}")
+        if name == "count" and len(args) > 1:
+            # count(a, b): rows where EVERY argument is non-NULL
+            # (reference count.slt: count(t0, t1) over 8 rows → 8)
+            if not all(isinstance(a, Column) for a in args):
+                raise PlanError("multi-argument count takes columns")
+            param = tuple(a.name for a in args[1:])
+            args = args[:1]
+            name = "count_multi" 
         if name == "count" and args and isinstance(args[0], Literal) \
                 and args[0].value == "*":
             col = None
+        elif name == "count" and args and isinstance(args[0], Literal):
+            # count(<constant>): NULL counts nothing, any other constant
+            # counts every row (reference/DataFusion count(0) == count(*))
+            if args[0].value is None:
+                name, col = "count_null_const", None
+            else:
+                col = None
+        elif name in ("sum", "avg", "mean", "min", "max", "median",
+                      "stddev", "stddev_samp", "stddev_pop", "var",
+                      "var_samp", "var_pop") and args \
+                and isinstance(args[0], Literal):
+            # aggregate over a CONSTANT (reference: avg(3) → 3.0): ride
+            # the row count, finalize from the constant
+            if args[0].value is None:
+                raise PlanError(f"{name}(NULL) is not supported")
+            param = args[0].value
+            name, col = "const_agg:" + name, None
         else:
             if not args or not isinstance(args[0], Column):
                 raise PlanError(f"aggregate argument must be a column: {f.to_sql()}")
@@ -325,6 +406,31 @@ class _AggCollector:
             if name != "count":
                 raise PlanError("DISTINCT only supported in count()")
             name = "count_distinct"
+        # input-type validation (reference: "The function Avg does not
+        # support inputs of type Timestamp(Nanosecond)/Utf8")
+        if name in _NUMERIC_ONLY_AGGS:
+            check_cols = [col] if col is not None else []
+            if name in _TWO_COL_AGGS and isinstance(param, str):
+                check_cols.append(param)
+            if isinstance(param, tuple):   # percentile weight column
+                check_cols.append(param[0])
+            for cc in check_cols:
+                if cc == TIME_COL:
+                    raise PlanError(
+                        f"the function {name} does not support inputs "
+                        f"of type TIMESTAMP")
+                if not self.schema.contains_column(cc):
+                    raise PlanError(f"unknown column {cc!r} in {name}")
+                c = self.schema.column(cc)
+                if c.column_type.is_tag or c.column_type.value_type in (
+                        ValueType.STRING, ValueType.GEOMETRY):
+                    raise PlanError(
+                        f"the function {name} does not support inputs "
+                        f"of type STRING")
+                if c.column_type.value_type == ValueType.BOOLEAN:
+                    raise PlanError(
+                        f"the function {name} does not support inputs "
+                        f"of type BOOLEAN")
         key = (name, col, param)
         if key in self._by_key:
             return self._by_key[key]
@@ -490,8 +596,19 @@ def _plan_raw(stmt, schema, time_trs, tag_domains, residual):
                 f"Projections require unique expression names: {name!r} "
                 f"appears more than once — alias one of them")
         seen.add(name)
+    # ORDER BY <output alias> sorts by the aliased expression (standard
+    # SQL; a real schema column of the same name wins to stay stable)
+    alias_exprs = {it.alias: it.expr for it in stmt.items
+                   if it.alias and isinstance(it.expr, Expr)}
+    order_by = []
+    for oe, asc in stmt.order_by:
+        if isinstance(oe, Column) and oe.name in alias_exprs \
+                and oe.name != TIME_COL \
+                and not schema.contains_column(oe.name):
+            oe = alias_exprs[oe.name]
+        order_by.append((oe, asc))
     return RawScanPlan(
         table=stmt.table, schema=schema, time_ranges=time_trs,
         tag_domains=tag_domains, filter=residual, output=output,
-        order_by=stmt.order_by, limit=stmt.limit, offset=stmt.offset,
+        order_by=order_by, limit=stmt.limit, offset=stmt.offset,
         distinct=stmt.distinct)

@@ -72,13 +72,9 @@ def _null_take(col: np.ndarray, idx: np.ndarray):
     safe = np.where(missing, 0, idx)
     if len(col) == 0:
         return np.full(len(idx), None, dtype=object)
-    out = col[safe]
-    if np.issubdtype(out.dtype, np.floating):
-        out = out.copy()
-        out[missing] = np.nan
-        return out
-    out = out.astype(object)
-    out[missing] = None
+    out = col[safe].astype(object)
+    out[missing] = None   # join padding is NULL, never NaN (NaN is a
+    # value the reference renders as 'NaN')
     return out
 
 
@@ -344,10 +340,25 @@ def group_indices(key_cols: list, n: int):
     return gid.astype(np.int64), first_idx.astype(np.int64)
 
 
+def _col_valid(col) -> np.ndarray:
+    if col.dtype == object:
+        return np.array([v is not None and not (isinstance(v, float)
+                                                and v != v) for v in col],
+                        dtype=bool)
+    if np.issubdtype(col.dtype, np.floating):
+        return ~np.isnan(col)
+    return np.ones(len(col), dtype=bool)
+
+
 def host_aggregate(func: str, col, gid: np.ndarray, n_groups: int,
-                   distinct: bool = False):
+                   distinct: bool = False, col2=None, param=None):
     """One aggregate over grouped rows (relational/host path)."""
     func = func.lower()
+    func = {"approx_median": "median", "stddev_samp": "stddev",
+            "var": "var_samp", "approx_distinct": "count_distinct_",
+            "covar": "covar_samp", "mean": "avg"}.get(func, func)
+    if func == "count_distinct_":
+        return host_aggregate("count", col, gid, n_groups, distinct=True)
     if func == "count" and col is None:
         return np.bincount(gid, minlength=n_groups).astype(np.int64)
     if col is None:
@@ -416,13 +427,11 @@ def host_aggregate(func: str, col, gid: np.ndarray, n_groups: int,
                     pass   # out-of-range values: fall through to float
             s = np.bincount(g, weights=v.astype(np.float64),
                             minlength=n_groups)
-            s[c == 0] = np.nan   # renders as NULL
-            return s
+            return _null_where(s, c == 0)
         s = np.bincount(g, weights=v.astype(np.float64), minlength=n_groups)
         with np.errstate(invalid="ignore", divide="ignore"):
             out = s / np.maximum(c, 1)
-        out[c == 0] = np.nan
-        return out
+        return _null_where(out, c == 0)
     if func in ("min", "max"):
         if col.dtype == object:
             out = np.full(n_groups, None, dtype=object)
@@ -433,14 +442,70 @@ def host_aggregate(func: str, col, gid: np.ndarray, n_groups: int,
                     out[g[i]] = v[i]
             return out
         out = np.full(n_groups, np.nan)
+        filled = np.zeros(n_groups, dtype=bool)
         red = np.fmin if func == "min" else np.fmax
         for i in range(len(g)):
-            out[g[i]] = v[i] if np.isnan(out[g[i]]) else \
-                red(out[g[i]], v[i])
-        if np.issubdtype(col.dtype, np.integer) and not np.isnan(out).any():
+            gi = g[i]
+            out[gi] = v[i] if not filled[gi] else red(out[gi], v[i])
+            filled[gi] = True
+        if np.issubdtype(col.dtype, np.integer) and filled.all():
             return out.astype(col.dtype)
+        if col.dtype == bool and filled.all():
+            return out.astype(bool)
+        return _null_where(out, ~filled)
+    if func in ("corr", "covar_samp", "covar_pop"):
+        if col2 is None:
+            raise PlanError(f"{func} takes two columns")
+        col2 = np.asarray(col2)
+        pair_ok = valid & _col_valid(col2)
+        g2, x, y = gid[pair_ok], \
+            col[pair_ok].astype(np.float64), col2[pair_ok].astype(np.float64)
+        out = np.full(n_groups, None, dtype=object)
+        for k in np.unique(g2):
+            xs, ys = x[g2 == k], y[g2 == k]
+            if func == "corr":
+                if len(xs) >= 2 and np.std(xs) > 0 and np.std(ys) > 0:
+                    out[k] = float(np.corrcoef(xs, ys)[0, 1])
+            else:
+                ddof = 1 if func == "covar_samp" else 0
+                if len(xs) > ddof:
+                    out[k] = float(np.cov(xs, ys, ddof=ddof)[0, 1])
         return out
-    if func in ("median", "stddev", "mode"):
+    if func == "approx_percentile_cont":
+        out = np.full(n_groups, None, dtype=object)
+        for k in np.unique(g):
+            grp = v[g == k].astype(np.float64)
+            if len(grp):
+                out[k] = float(np.quantile(grp, float(param)))
+        return out
+    if func == "approx_percentile_cont_with_weight":
+        if col2 is None:
+            raise PlanError(
+                "approx_percentile_cont_with_weight takes a weight column")
+        col2 = np.asarray(col2)
+        pair_ok = valid & _col_valid(col2)
+        g2 = gid[pair_ok]
+        x = col[pair_ok].astype(np.float64)
+        w = col2[pair_ok].astype(np.float64)
+        out = np.full(n_groups, None, dtype=object)
+        for k in np.unique(g2):
+            xs, ws = x[g2 == k], w[g2 == k]
+            order = np.argsort(xs)
+            xs, ws = xs[order], ws[order]
+            cum = np.cumsum(ws)
+            if len(xs) and cum[-1] > 0:
+                idx = int(np.searchsorted(cum, float(param) * cum[-1],
+                                          side="left"))
+                out[k] = float(xs[min(idx, len(xs) - 1)])
+        return out
+    if func == "array_agg":
+        out = np.full(n_groups, None, dtype=object)
+        for k in np.unique(g):
+            grp = v[g == k]
+            out[k] = "[" + ", ".join(_arr_cell(x) for x in grp) + "]"
+        return out
+    if func in ("median", "stddev", "stddev_pop", "var_samp", "var_pop",
+                "mode"):
         # order-statistic / modal aggregates: one numpy pass per group
         # after a single stable group sort (reference: DataFusion's
         # accumulator set; time-ordered first/last stay kernel-only — row
@@ -448,8 +513,7 @@ def host_aggregate(func: str, col, gid: np.ndarray, n_groups: int,
         order = np.argsort(g, kind="stable")
         gs, vs = g[order], v[order]
         starts = np.flatnonzero(np.diff(gs, prepend=-1))
-        out = np.full(n_groups, np.nan) if col.dtype != object \
-            else np.full(n_groups, None, dtype=object)
+        out = np.full(n_groups, None, dtype=object)
         for k, s0 in enumerate(starts):
             s1 = starts[k + 1] if k + 1 < len(starts) else len(gs)
             grp = vs[s0:s1]
@@ -458,12 +522,38 @@ def host_aggregate(func: str, col, gid: np.ndarray, n_groups: int,
                 out[gi] = float(np.median(grp.astype(np.float64)))
             elif func == "stddev":
                 out[gi] = (float(np.std(grp.astype(np.float64), ddof=1))
-                           if len(grp) > 1 else np.nan)
+                           if len(grp) > 1 else None)
+            elif func == "stddev_pop":
+                out[gi] = float(np.std(grp.astype(np.float64), ddof=0))
+            elif func == "var_samp":
+                out[gi] = (float(np.var(grp.astype(np.float64), ddof=1))
+                           if len(grp) > 1 else None)
+            elif func == "var_pop":
+                out[gi] = float(np.var(grp.astype(np.float64), ddof=0))
             else:
                 uniq, cnt = np.unique(grp, return_counts=True)
                 out[gi] = uniq[int(np.argmax(cnt))]
         return out
     raise PlanError(f"unsupported aggregate {func!r} over joined relations")
+
+
+def _null_where(arr: np.ndarray, mask: np.ndarray):
+    """NULL out slots (object/None) — NaN stays a value."""
+    if not mask.any():
+        return arr
+    out = arr.astype(object)
+    out[mask] = None
+    return out
+
+
+def _arr_cell(v) -> str:
+    if isinstance(v, (float, np.floating)):
+        return repr(float(v))
+    if isinstance(v, (bool, np.bool_)):
+        return "true" if v else "false"
+    if isinstance(v, np.integer):
+        return str(int(v))
+    return str(v)
 
 
 # ---------------------------------------------------------------------------
@@ -608,12 +698,8 @@ def eval_window(wf: WindowFunc, env: dict, n: int) -> np.ndarray:
             for i in range(len(seg)):
                 j = i - shift
                 res[perm[s + i]] = seg[j] if 0 <= j < len(seg) else default
-        if src.dtype.kind == "f" and default is None:
-            # float input: NaN carries the out-of-frame NULL
-            return np.array([np.nan if x is None else x for x in res],
-                            dtype=np.float64)
-        # integral/object inputs keep their value types (object array with
-        # None at the frame edges) — lead(Int64) must not render 5 as 5.0
+        # every input keeps value identity in an object array with None
+        # at the frame edges (NULL ≠ NaN: NaN renders 'NaN')
         return res
 
     if name in _VALUES:

@@ -32,6 +32,10 @@ OUT_DIR = os.path.join(os.path.dirname(__file__), "sqllogic_ref")
 
 _TS_RE = re.compile(
     r"^\d{4}-\d{2}-\d{2}T\d{2}:\d{2}:\d{2}(\.\d+)?$")
+# non-integer numerics (decimal point or exponent): normalize arrow's
+# rendering (1.0e-6) to this engine's repr() rendering (1e-06)
+_FLOAT_RE = re.compile(
+    r"^-?(?:\d+\.\d*|\.\d+)(?:[eE][+-]?\d+)?$|^-?\d+[eE][+-]?\d+$")
 _TOKEN_RE = re.compile(r'"((?:[^"\\]|\\.)*)"|(\S+)')
 
 
@@ -50,6 +54,8 @@ def _convert_value(tok: str, quoted: bool) -> str:
             return ""
         if _TS_RE.match(tok):
             return _ts_to_ns(tok)
+        if _FLOAT_RE.match(tok):
+            return repr(float(tok))
         return tok
     s = tok.replace('\\"', '"')
     if s == "NULL":

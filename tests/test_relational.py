@@ -139,8 +139,9 @@ def test_lag_lead(db):
         "SELECT v, lag(v) OVER (ORDER BY time) p, "
         "lead(v) OVER (ORDER BY time) n FROM cpu ORDER BY time")
     p, n = rs.columns[1].tolist(), rs.columns[2].tolist()
-    assert np.isnan(p[0]) and p[1:] == [1.0, 2.0, 3.0]
-    assert n[:3] == [2.0, 3.0, 4.0] and np.isnan(n[3])
+    # out-of-frame slots are NULL (None), not NaN
+    assert p[0] is None and p[1:] == [1.0, 2.0, 3.0]
+    assert n[:3] == [2.0, 3.0, 4.0] and n[3] is None
 
 
 def test_rank_dense_rank_ties(db):
