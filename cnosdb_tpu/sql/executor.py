@@ -2207,6 +2207,10 @@ def _decompose_aggs(aggs: list[AggSpec]):
             finalize[a.alias] = ("pass", want(a.func, a.column))
         elif a.func in ("count_distinct", "approx_distinct"):
             finalize[a.alias] = ("distinct", want("count_distinct", a.column))
+        elif a.func == "array_agg" and isinstance(a.param, tuple) \
+                and a.param and a.param[0] == "order_time":
+            finalize[a.alias] = ("array_ts", want("collect_ts", a.column),
+                                 a.param[1], a.column == "time")
         elif a.func in ("median", "approx_median", "stddev",
                         "stddev_samp", "stddev_pop", "var", "var_samp",
                         "var_pop", "mode", "array_agg"):
@@ -2273,6 +2277,15 @@ def _load_external(ext: dict) -> tuple[list[str], list[np.ndarray]]:
     for name in table.column_names:
         col = table.column(name)
         arr = col.to_numpy(zero_copy_only=False)
+        if col.null_count and arr.dtype.kind == "f":
+            # arrow NULLs land as NaN in to_numpy; NULL ≠ NaN — carry
+            # them as object None so they render as empty cells
+            nulls = np.asarray(col.is_null())
+            arr = arr.astype(object)
+            arr[nulls] = None
+            names.append(name)
+            cols.append(arr)
+            continue
         if arr.dtype.kind == "M":
             # arrow timestamp columns (CSV type inference) → this
             # engine's i64 ns representation
@@ -2367,6 +2380,19 @@ def _series_finalize(func: str, ts: np.ndarray, vals: np.ndarray, param):
         return None
 
 
+def _iso_ns(ns: int) -> str:
+    """arrow timestamp rendering: ISO, fraction trimmed of trailing
+    zeros, omitted when zero."""
+    from datetime import datetime, timezone
+
+    secs, frac = divmod(int(ns), 1_000_000_000)
+    dt = datetime.fromtimestamp(secs, tz=timezone.utc)
+    base = dt.strftime("%Y-%m-%dT%H:%M:%S")
+    if frac:
+        base += ("." + f"{frac:09d}").rstrip("0")
+    return base
+
+
 def _cell_repr(v) -> str:
     """array_agg element rendering (bare values, arrow list style)."""
     if isinstance(v, (float, np.floating)):
@@ -2418,6 +2444,21 @@ def _apply_finalizer(spec, parts: dict):
             return "[" + ", ".join(_cell_repr(v) for v in vals) + "]"
         uniq, counts = np.unique(vals, return_counts=True)
         return uniq[np.argmax(counts)]
+    if kind == "array_ts":
+        chunks = parts.get(spec[1])
+        if not chunks:
+            return None
+        ts = np.concatenate([c[0] for c in chunks])
+        vals = np.concatenate([np.asarray(c[1], dtype=object)
+                               for c in chunks])
+        order = np.argsort(ts, kind="stable")
+        if not spec[2]:
+            order = order[::-1]
+        vals = vals[order]
+        if spec[3]:   # array_agg(time ...): elements render as arrow ts
+            return "[" + ", ".join(_iso_ns(int(t)) for t in ts[order]) \
+                + "]"
+        return "[" + ", ".join(_cell_repr(v) for v in vals) + "]"
     if kind == "percentile":
         chunks = parts.get(spec[1])
         if not chunks:

@@ -425,6 +425,8 @@ class IsNull(Expr):
                 valid = env[key]
                 return valid if self.negated else ~valid
         v = self.expr.eval(env, xp)
+        if v is None:   # NULL literal / NULL-valued scalar expression
+            return np.array([not self.negated])
         dt = getattr(v, "dtype", None)
         if dt is not None and dt.kind == "f":
             m = xp.isnan(v)
@@ -518,6 +520,8 @@ class Func(Expr):
 
     name: str
     args: list
+    # aggregate-call ordering: array_agg(x ORDER BY time DESC) — (col, asc)
+    agg_order: tuple | None = None
 
     # math scalars return Float64 regardless of input type (reference via
     # DataFusion's math_expressions: abs(BIGINT) renders 1.0 — pinned by
@@ -1456,6 +1460,10 @@ class WindowFunc(Expr):
     args: list
     partition_by: list = None    # list[Expr]
     order_by: list = None        # list[(Expr, asc)]
+    # frame: None (default: cumulative when ordered, whole partition
+    # otherwise) | 'full' | 'cum' | 'rev' (CURRENT ROW → UNBOUNDED
+    # FOLLOWING) — the ROWS BETWEEN shapes the reference corpus uses
+    frame: str | None = None
 
     def eval(self, env, xp):
         raise PlanError(

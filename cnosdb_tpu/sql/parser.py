@@ -1364,7 +1364,22 @@ class Parser:
                     args.append(self.parse_expr())
                     while self.accept_op(","):
                         args.append(self.parse_expr())
+                    agg_order = None
+                    if self.accept_kw("ORDER"):
+                        # array_agg(x ORDER BY col [DESC]) — aggregate
+                        # input ordering (reference via DataFusion)
+                        self.expect_kw("BY")
+                        oe = self.parse_expr()
+                        asc = True
+                        if self.accept_kw("DESC"):
+                            asc = False
+                        else:
+                            self.accept_kw("ASC")
+                        agg_order = (oe, asc)
                     self.expect_op(")")
+                    return self._maybe_over(
+                        Func(name, args, agg_order))
+                # empty argument list: accept_op(")") above consumed it
                 return self._maybe_over(Func(name, args))
             if self.accept_op("."):
                 # qualified column: alias.col (relational FROM scopes)
@@ -1390,8 +1405,37 @@ class Parser:
             order_by.append(self.parse_order_item())
             while self.accept_op(","):
                 order_by.append(self.parse_order_item())
+        frame = None
+        if self.accept_kw("ROWS"):
+            # ROWS BETWEEN <bound> AND <bound> — the reference corpus
+            # uses the unbounded/current-row shapes
+            self.expect_kw("BETWEEN")
+
+            def bound():
+                if self.accept_kw("UNBOUNDED"):
+                    return self.expect_kw("PRECEDING", "FOLLOWING").lower()
+                if self.accept_kw("CURRENT"):
+                    self.expect_kw("ROW")
+                    return "current"
+                n = self.expect_number()
+                kind = self.expect_kw("PRECEDING", "FOLLOWING").lower()
+                return (int(n), kind)
+
+            lo = bound()
+            self.expect_kw("AND")
+            hi = bound()
+            if lo == "preceding" and hi == "current":
+                frame = "cum"
+            elif lo == "preceding" and hi == "following":
+                frame = "full"
+            elif lo == "current" and hi == "following":
+                frame = "rev"
+            else:
+                raise ParserError(
+                    "unsupported window frame (supported: UNBOUNDED "
+                    "PRECEDING/CURRENT ROW/UNBOUNDED FOLLOWING bounds)")
         self.expect_op(")")
-        return WindowFunc(f.name, f.args, partition_by, order_by)
+        return WindowFunc(f.name, f.args, partition_by, order_by, frame)
 
 
 def _expand_ctes(stmt, ctes: dict):

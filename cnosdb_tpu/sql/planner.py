@@ -406,6 +406,17 @@ class _AggCollector:
             if name != "count":
                 raise PlanError("DISTINCT only supported in count()")
             name = "count_distinct"
+        if name == "array_agg" and getattr(f, "agg_order", None) \
+                is not None:
+            oe, asc = f.agg_order
+            if not (isinstance(oe, Column) and oe.name == TIME_COL):
+                raise PlanError(
+                    "array_agg ORDER BY supports the time column")
+            param = ("order_time", asc)
+        if name == "approx_distinct" and col == TIME_COL:
+            raise PlanError(
+                "the function approx_distinct does not support inputs "
+                "of type TIMESTAMP")
         # input-type validation (reference: "The function Avg does not
         # support inputs of type Timestamp(Nanosecond)/Utf8")
         if name in _NUMERIC_ONLY_AGGS:

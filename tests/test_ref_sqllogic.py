@@ -56,10 +56,28 @@ def _parse(path):
     return blocks
 
 
+def _known_gaps() -> set:
+    """Files still being brought to parity (tracked work list; each line
+    is a ported file with residual value/feature mismatches). A gap file
+    that STARTS passing must be removed from the list — xfail is strict."""
+    p = os.path.join(CASES_DIR, "KNOWN_GAPS.txt")
+    if not os.path.exists(p):
+        return set()
+    with open(p) as f:
+        return {ln.strip() for ln in f if ln.strip()
+                and not ln.startswith("#")}
+
+
 def _case_files():
     if not os.path.isdir(CASES_DIR):
         return []
-    return sorted(f for f in os.listdir(CASES_DIR) if f.endswith(".slt"))
+    gaps = _known_gaps()
+    return [
+        pytest.param(f, marks=pytest.mark.xfail(
+            reason="known parity gap (tests/sqllogic_ref/KNOWN_GAPS.txt)",
+            strict=True)) if f in gaps else f
+        for f in sorted(os.listdir(CASES_DIR)) if f.endswith(".slt")
+    ]
 
 
 @pytest.mark.parametrize("case", _case_files())
