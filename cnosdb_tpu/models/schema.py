@@ -153,6 +153,9 @@ class TableColumn:
     # DDL gave an explicit CODEC(); DESCRIBE renders DEFAULT otherwise
     # (reference keeps Encoding::Default distinct from the resolved codec)
     explicit_codec: bool = False
+    # GEOMETRY(subtype, srid): writes must match the declared subtype
+    # (reference GeometryType in tskv_table_schema.rs)
+    geom_subtype: str | None = None
 
     def default_encoding(self) -> Encoding:
         ct = self.column_type
@@ -178,6 +181,7 @@ class TableColumn:
             "precision": int(self.column_type.precision),
             "encoding": int(self.encoding),
             "explicit_codec": self.explicit_codec,
+            "geom_subtype": self.geom_subtype,
         }
 
     @classmethod
@@ -190,6 +194,7 @@ class TableColumn:
             ),
             encoding=Encoding(d["encoding"]),
             explicit_codec=bool(d.get("explicit_codec", False)),
+            geom_subtype=d.get("geom_subtype"),
         )
 
 

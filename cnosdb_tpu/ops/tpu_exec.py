@@ -75,6 +75,11 @@ class AggResult:
     n_rows: int
     # per-column validity (NULL where a group had no values for that agg)
     valid: dict[str, np.ndarray] = field(default_factory=dict)
+    # tag-group identity for the VECTORIZED cross-vnode merge: per-row
+    # local group index + the label table it indexes (None when string
+    # field group axes are present — those merge via the generic path)
+    gid: np.ndarray | None = None
+    labels: list | None = None
 
 
 def execute_scan_aggregate(batch: ScanBatch, query: TpuQuery) -> AggResult:
@@ -272,6 +277,26 @@ def launch_scan_aggregate(batch: ScanBatch, query: TpuQuery):
 
         return complete
     else:
+        # ------------------------------ fused native single-pass path
+        # the C++ twin of the device kernel (native/segagg.cpp): segment
+        # derivation + masked reductions in ONE GIL-free multithreaded
+        # sweep — this is what makes the COLD scan competitive (the
+        # numpy pipeline below costs several full-array passes)
+        seg_cache_probe = getattr(batch, "_seg_cache", None)
+        probe_key = (tuple(query.group_tags), tuple(query.group_fields),
+                     origin, interval, bmin, dense_span)
+        if seg_cache_probe is None or probe_key not in seg_cache_probe:
+            # cold only: a warm repeat reuses the cached numpy segment
+            # layout below, which beats re-sweeping the batch; the fused
+            # pass SEEDS that cache with the per-row segment ids it
+            # derives anyway
+            fused = _try_native_fused(batch, query, col_wants,
+                                      group_of_series, n_groups, origin,
+                                      interval, bmin, dense_span,
+                                      group_labels, needs_rank,
+                                      seg_cache_key=probe_key)
+            if fused is not None:
+                return fused
         # ---------------------------------------- host-prep path
         # segment-id derivation is identical across repeated queries of the
         # same (group tags, bucket) shape over one scan snapshot — cache it
@@ -628,6 +653,121 @@ def launch_scan_aggregate(batch: ScanBatch, query: TpuQuery):
                          gf=(gf_dims, gf_dicts) if gf_dims else None)
 
 
+def _try_native_fused(batch, query, col_wants, group_of_series, n_groups,
+                      origin, interval, bmin, dense_span, group_labels,
+                      needs_rank, seg_cache_key=None):
+    """Route qualifying scan-aggregates through native fused_seg_agg_f64:
+    unfiltered dense-bucket queries whose aggregates are count/sum/mean/
+    min/max over FLOAT columns (+ count(*)). Returns a complete() closure
+    or None to fall back."""
+    from ..storage import native
+
+    if not native.available():
+        return None
+    if query.group_fields:
+        return None
+    if query.filter is not None and _contains_is_null(query.filter):
+        return None   # IS NULL filters keep the classic 3VL machinery
+    if query.time_bucket is not None and dense_span > _DENSE_BUCKET_LIMIT:
+        return None
+    for a in query.aggs:
+        if a.func not in ("count", "sum", "mean", "avg", "min", "max",
+                          "first", "last"):
+            return None
+        if a.column is not None and a.column != "time":
+            f = batch.fields.get(a.column)
+            if f is None or f[0] != ValueType.FLOAT:
+                return None
+        if a.column == "time":
+            return None
+    n_buckets = dense_span if query.time_bucket is not None else 1
+    num_segments = n_groups * n_buckets
+    if num_segments > (1 << 26):
+        return None
+    lut = group_of_series.astype(np.int64)
+    sid = np.ascontiguousarray(batch.sid_ordinal, dtype=np.int32)
+    ts = np.ascontiguousarray(batch.ts, dtype=np.int64)
+    row_mask = None
+    if query.filter is not None:
+        # full-array eval (no index gathers): same semantics as
+        # _eval_filter_on_rows with rows=None
+        n = batch.n_rows
+        cols = query.filter.columns()
+        env = _filter_env(batch, needed=cols)
+        if any(c not in env for c in cols):
+            row_mask = np.zeros(n, dtype=np.uint8)
+        else:
+            m = np.asarray(query.filter.eval(env, np))
+            if m.shape == ():
+                m = np.full(n, bool(m))
+            m = m.astype(bool)
+            if is_conjunctive(query.filter):
+                for c in cols:
+                    v = env.get(f"__valid__:{c}")
+                    if v is not None and not v.all():
+                        m &= v
+            row_mask = m.astype(np.uint8)
+    col_results: dict = {}
+    presence = None
+    want_seg = seg_cache_key is not None
+    seg_out = None
+    for cname, wants in col_wants.items():
+        f = batch.fields[cname]
+        vals = np.ascontiguousarray(f[1], dtype=np.float64)
+        valid = f[2]
+        valid_u8 = None if bool(valid.all()) else \
+            np.ascontiguousarray(valid, dtype=np.uint8)
+        # count always rides along: _assemble derives validity (has any
+        # value) from it for every aggregate
+        r = native.fused_seg_agg_f64(
+            ts, sid, lut, origin, interval, int(bmin),
+            n_buckets if query.time_bucket is not None else 0,
+            vals, valid_u8, row_mask, num_segments,
+            {**wants, "want_count": True}, out_seg=want_seg)
+        if r is None:
+            return None
+        presence = r.pop("presence")
+        seg_out = r.pop("seg", seg_out)
+        want_seg = False   # one seg pass is enough
+        col_results[cname] = r
+    if presence is None:
+        # count(*)-only query: presence pass without a value column
+        r = native.fused_seg_agg_f64(
+            ts, sid, lut, origin, interval, int(bmin),
+            n_buckets if query.time_bucket is not None else 0,
+            None, None, row_mask, num_segments, {})
+        if r is None:
+            return None
+        presence = r["presence"]
+    present = presence > 0
+    if query.time_bucket is not None:
+        bucket_starts = origin + (int(bmin) + np.arange(
+            n_buckets, dtype=np.int64)) * interval
+    else:
+        bucket_starts = None
+    if seg_out is not None:
+        # seed the warm-path segment cache (slots: seg_ids,
+        # bucket_starts, n_buckets, counts, run_starts, run_counts) —
+        # seg ids are filter-independent; counts only cacheable when no
+        # filter shaped this presence
+        seg_cache = getattr(batch, "_seg_cache", None)
+        if seg_cache is None:
+            seg_cache = batch._seg_cache = {}
+        while len(seg_cache) >= 2:
+            seg_cache.pop(next(iter(seg_cache)))
+        seg_cache[seg_cache_key] = [
+            seg_out, bucket_starts, n_buckets,
+            presence if row_mask is None else None, None, None]
+
+    def complete():
+        return _assemble(batch, query, presence, present, col_results,
+                         group_labels, bucket_starts, n_buckets,
+                         needs_rank=False, order=None,
+                         unsigned_biased=False)
+
+    return complete
+
+
 def _assemble(batch, query, presence, present, col_results, group_labels,
               bucket_starts, n_buckets, needs_rank, order,
               unsigned_biased: bool = True, gf=None) -> AggResult:
@@ -652,7 +792,9 @@ def _assemble(batch, query, presence, present, col_results, group_labels,
             out_cols[fcol] = lab
         grp_idx = gid
     for i, t in enumerate(query.group_tags):
-        out_cols[t] = np.array([group_labels[g][i] for g in grp_idx], dtype=object)
+        lab_col = np.empty(len(group_labels), dtype=object)
+        lab_col[:] = [lab[i] for lab in group_labels]
+        out_cols[t] = lab_col[grp_idx]
     if bucket_starts is not None:
         out_cols["time"] = bucket_starts[bkt_idx]
 
@@ -727,7 +869,9 @@ def _assemble(batch, query, presence, present, col_results, group_labels,
                     sorted_ts = _sorted_ts(batch, order)
                     ranks = np.clip(rk[sel], 0, len(sorted_ts) - 1)
                     out_cols[a.alias + "__ts"] = sorted_ts[ranks]
-    return AggResult(out_cols, len(sel), out_valid)
+    return AggResult(out_cols, len(sel), out_valid,
+                     gid=(grp_idx if gf is None else None),
+                     labels=(group_labels if gf is None else None))
 
 
 def _sorted_ts(batch: ScanBatch, order) -> np.ndarray:

@@ -449,15 +449,25 @@ class Coordinator:
             return []
         trs = time_ranges or TimeRanges.all()
         doms = tag_domains or ColumnDomains.all()
-        batches = []
-        for split in self.table_vnodes(tenant, db, table, trs, doms):
+        splits = self.table_vnodes(tenant, db, table, trs, doms)
+
+        def one(split):
             if self.distributed and split.node_id != self.node_id:
-                b = self._scan_remote(split, field_names)
-            else:
-                b = self._scan_local(split, field_names)
-            if b is not None and b.n_rows:
-                batches.append(b)
-        return batches
+                return self._scan_remote(split, field_names)
+            return self._scan_local(split, field_names)
+
+        if len(splits) > 1:
+            # vnode scans are independent: decode in parallel (the C++
+            # codec calls and big numpy ops release the GIL, so the cold
+            # TSM→columns path scales with cores — the reference's scan
+            # fans out across DataFusion partitions the same way)
+            from concurrent.futures import ThreadPoolExecutor
+
+            with ThreadPoolExecutor(max_workers=min(8, len(splits))) as tp:
+                results = list(tp.map(one, splits))
+        else:
+            results = [one(s) for s in splits]
+        return [b for b in results if b is not None and b.n_rows]
 
     def _scan_local(self, split: PlacedSplit, field_names) -> ScanBatch | None:
         table, trs, doms = split.table, split.time_ranges, split.tag_domains
@@ -477,13 +487,18 @@ class Coordinator:
                tuple(field_names) if field_names is not None else None,
                tuple((r.min_ts, r.max_ts) for r in trs.ranges),
                sids_key)
+        from ..utils import stages
+
         with self._scan_cache_lock:
             hit = self._scan_cache.get(key)
             if hit is not None and hit[0] == v.data_version:
                 self._scan_cache[key] = self._scan_cache.pop(key)  # LRU touch
+                stages.count("scan_hit")
                 return hit[1]
-        b = scan_vnode(v, table, series_ids=sids, time_ranges=trs,
-                       field_names=field_names)
+        stages.count("scan_miss")
+        with stages.stage("decode_ms"):
+            b = scan_vnode(v, table, series_ids=sids, time_ranges=trs,
+                           field_names=field_names)
         with self._scan_cache_lock:
             self._scan_cache.pop(key, None)  # supersede stale version
             while len(self._scan_cache) >= self.SCAN_CACHE_SIZE:

@@ -654,6 +654,8 @@ class HttpServer:
 def _cell(v):
     if v is None:
         return ""
+    if isinstance(v, (bytes, bytearray)):
+        return v.hex()   # WKB and other binary render as lowercase hex
     if isinstance(v, (float, np.floating)) and np.isnan(v):
         return "NaN"   # NaN is a VALUE; NULL is the empty cell
     if isinstance(v, (float, np.floating)) and v == 0.0:

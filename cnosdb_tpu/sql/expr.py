@@ -983,6 +983,11 @@ def _binary_obj_func(fn):
     def run(xp, a, b, *rest):
         import numpy as _np
 
+        if isinstance(a, DictArray):
+            a = a.materialize()
+        if isinstance(b, DictArray):
+            b = b.materialize()
+
         if isinstance(a, _np.ndarray) or isinstance(b, _np.ndarray):
             n = len(a) if isinstance(a, _np.ndarray) else len(b)
             aa = a if isinstance(a, _np.ndarray) else [a] * n
@@ -996,10 +1001,36 @@ def _binary_obj_func(fn):
     return run
 
 
+def _binary_pred(fn):
+    """Pairwise lift for boolean geometry predicates: NULL in → NULL
+    out (object arrays keep None; _binary_obj_func's NaN would render
+    'NaN')."""
+    def run(xp, a, b, *rest):
+        import numpy as _np
+
+        if isinstance(a, DictArray):
+            a = a.materialize()
+        if isinstance(b, DictArray):
+            b = b.materialize()
+        if isinstance(a, _np.ndarray) or isinstance(b, _np.ndarray):
+            n = len(a) if isinstance(a, _np.ndarray) else len(b)
+            aa = a if isinstance(a, _np.ndarray) else [a] * n
+            bb = b if isinstance(b, _np.ndarray) else [b] * n
+            out = _np.empty(n, dtype=object)
+            out[:] = [None if (x is None or y is None) else fn(x, y)
+                      for x, y in zip(aa, bb)]
+            return out
+        if a is None or b is None:
+            return None
+        return fn(a, b)
+    return run
+
+
 def _register_tsfuncs():
     """Gauge/state accessors + GIS scalars (reference scalar_function/
     gauge/*.rs, duration_in.rs, state_at.rs, gis/*.rs). Registered lazily
     at module bottom to avoid an import cycle with sql.tsfuncs."""
+    from . import gis as _gis
     from . import tsfuncs as tf
 
     Func._FUNCS.update({
@@ -1017,6 +1048,13 @@ def _register_tsfuncs():
         "state_at": _obj_func(tf.state_at, numeric=False),
         "st_distance": _binary_obj_func(tf.st_distance),
         "st_area": _obj_func(tf.st_area),
+        "st_asbinary": _obj_func(_gis.st_asbinary, numeric=False),
+        "st_geomfromwkb": _obj_func(_gis.st_geomfromwkb, numeric=False),
+        "st_intersects": _binary_pred(_gis.st_intersects),
+        "st_disjoint": _binary_pred(_gis.st_disjoint),
+        "st_contains": _binary_pred(_gis.st_contains),
+        "st_within": _binary_pred(_gis.st_within),
+        "st_equals": _binary_pred(_gis.st_equals),
         # string scalars (DataFusion-inherited set in the reference)
         "upper": _str_func(str.upper),
         "lower": _str_func(str.lower),

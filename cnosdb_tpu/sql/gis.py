@@ -1,0 +1,601 @@
+"""GIS scalar functions: WKT/WKB codecs + planar predicates.
+
+Role-parity with the reference's gis scalar set
+(query_server/query/src/extension/expr/scalar_function/gis/:
+st_asbinary.rs, st_geomfromwkb.rs, st_binary_op.rs wrapping the geo
+crate). Geometries are WKT strings in the engine (GEOMETRY columns store
+WKT); st_AsBinary produces standard little-endian WKB bytes, rendered as
+lowercase hex; ST_GeomFromWKB parses WKB back to CANONICAL WKT (no space
+after the tag, comma-separated coordinates — the geo-types Display the
+reference shows in st_geomfromwkb.slt).
+
+Predicates (contains/within/intersects/disjoint/equals) are exact planar
+computational geometry over point/linestring/polygon and the multi
+variants: point-in-polygon by ray casting (concave rings supported),
+segment-pair intersection tests, containment = all-points-inside with no
+boundary crossings.
+"""
+from __future__ import annotations
+
+import re
+import struct
+
+from ..errors import PlanError
+
+_TYPES = ("POINT", "LINESTRING", "POLYGON", "MULTIPOINT",
+          "MULTILINESTRING", "MULTIPOLYGON", "GEOMETRYCOLLECTION")
+_WKB_CODE = {t: i + 1 for i, t in enumerate(_TYPES)}
+_WKB_TYPE = {v: k for k, v in _WKB_CODE.items()}
+
+
+# ---------------------------------------------------------------- WKT
+class Geom:
+    """(kind, data): POINT → (x, y) | None for EMPTY;
+    LINESTRING → [pts]; POLYGON → [rings][pts];
+    MULTIPOINT → [pts]; MULTILINESTRING → [[pts]];
+    MULTIPOLYGON → [[[pts]]]; GEOMETRYCOLLECTION → [Geom]."""
+
+    __slots__ = ("kind", "data")
+
+    def __init__(self, kind, data):
+        self.kind = kind
+        self.data = data
+
+
+def parse_wkt(s: str) -> Geom:
+    if not isinstance(s, str):
+        raise PlanError("GIS functions take WKT strings")
+    text = s.strip()
+    g, rest = _parse_geom(text)
+    if rest.strip():
+        raise PlanError(f"trailing WKT content: {rest[:20]!r}")
+    return g
+
+
+def _parse_geom(text: str):
+    m = re.match(r"\s*([A-Za-z]+)\s*", text)
+    if not m or m.group(1).upper() not in _TYPES:
+        raise PlanError(f"bad WKT: {text[:30]!r}")
+    kind = m.group(1).upper()
+    rest = text[m.end():]
+    if rest.upper().startswith("EMPTY"):
+        empty = {"POINT": None, "LINESTRING": [], "POLYGON": [],
+                 "MULTIPOINT": [], "MULTILINESTRING": [],
+                 "MULTIPOLYGON": [], "GEOMETRYCOLLECTION": []}[kind]
+        return Geom(kind, empty), rest[5:]
+    body, rest = _take_parens(rest)
+    if kind == "POINT":
+        return Geom(kind, _coord(body)), rest
+    if kind == "LINESTRING":
+        return Geom(kind, _coords(body)), rest
+    if kind == "POLYGON":
+        return Geom(kind, [_coords(r) for r in _split_groups(body)]), rest
+    if kind == "MULTIPOINT":
+        # both MULTIPOINT((1 2),(3 4)) and MULTIPOINT(1 2, 3 4)
+        groups = _split_top(body)
+        pts = []
+        for gtxt in groups:
+            gtxt = gtxt.strip()
+            if gtxt.startswith("("):
+                inner, _ = _take_parens(gtxt)
+                pts.append(_coord(inner))
+            else:
+                pts.append(_coord(gtxt))
+        return Geom(kind, pts), rest
+    if kind == "MULTILINESTRING":
+        return Geom(kind, [_coords(g) for g in _split_groups(body)]), rest
+    if kind == "MULTIPOLYGON":
+        polys = []
+        for gtxt in _split_top(body):
+            inner, _ = _take_parens(gtxt.strip())
+            polys.append([_coords(r) for r in _split_groups(inner)])
+        return Geom(kind, polys), rest
+    # GEOMETRYCOLLECTION
+    out = []
+    txt = body
+    while txt.strip():
+        g, txt = _parse_geom(txt)
+        out.append(g)
+        txt = txt.lstrip()
+        if txt.startswith(","):
+            txt = txt[1:]
+    return Geom(kind, out), rest
+
+
+def _take_parens(text: str):
+    text = text.lstrip()
+    if not text.startswith("("):
+        raise PlanError(f"bad WKT near {text[:20]!r}")
+    depth = 0
+    for i, ch in enumerate(text):
+        if ch == "(":
+            depth += 1
+        elif ch == ")":
+            depth -= 1
+            if depth == 0:
+                return text[1:i], text[i + 1:]
+    raise PlanError("unbalanced WKT parentheses")
+
+
+def _split_top(body: str) -> list[str]:
+    out, depth, cur = [], 0, []
+    for ch in body:
+        if ch == "(":
+            depth += 1
+        elif ch == ")":
+            depth -= 1
+        if ch == "," and depth == 0:
+            out.append("".join(cur))
+            cur = []
+        else:
+            cur.append(ch)
+    if cur:
+        out.append("".join(cur))
+    return out
+
+
+def _split_groups(body: str) -> list[str]:
+    return [_take_parens(g.strip())[0] for g in _split_top(body)]
+
+
+def _coord(txt: str):
+    parts = txt.split()
+    if len(parts) < 2:
+        raise PlanError(f"bad WKT coordinate {txt!r}")
+    return (float(parts[0]), float(parts[1]))
+
+
+def _coords(txt: str):
+    return [_coord(c) for c in _split_top(txt)]
+
+
+def _num(v: float) -> str:
+    return str(int(v)) if float(v).is_integer() else repr(float(v))
+
+
+def to_wkt(g: Geom) -> str:
+    """Canonical rendering (geo-types Display: no space after the tag)."""
+    k, d = g.kind, g.data
+    if k == "POINT":
+        if d is None:
+            return "POINT EMPTY"
+        return f"POINT({_num(d[0])} {_num(d[1])})"
+    if d in ([], None):
+        return f"{k} EMPTY"
+    if k == "LINESTRING":
+        return "LINESTRING(" + _pts(d) + ")"
+    if k == "POLYGON":
+        return "POLYGON(" + ",".join(f"({_pts(r)})" for r in d) + ")"
+    if k == "MULTIPOINT":
+        return "MULTIPOINT(" + _pts(d) + ")"
+    if k == "MULTILINESTRING":
+        return "MULTILINESTRING(" + ",".join(
+            f"({_pts(ln)})" for ln in d) + ")"
+    if k == "MULTIPOLYGON":
+        return "MULTIPOLYGON(" + ",".join(
+            "(" + ",".join(f"({_pts(r)})" for r in poly) + ")"
+            for poly in d) + ")"
+    return "GEOMETRYCOLLECTION(" + ",".join(to_wkt(x) for x in d) + ")"
+
+
+def _pts(pts) -> str:
+    return ",".join(f"{_num(x)} {_num(y)}" for x, y in pts)
+
+
+# ---------------------------------------------------------------- WKB
+def _wkb_geom(g: Geom) -> bytes:
+    code = _WKB_CODE[g.kind]
+    head = struct.pack("<BI", 1, code)
+    k, d = g.kind, g.data
+    if k == "POINT":
+        if d is None:
+            return head + struct.pack("<dd", float("nan"), float("nan"))
+        return head + struct.pack("<dd", *d)
+    if k == "LINESTRING":
+        return head + _wkb_ring(d)
+    if k == "POLYGON":
+        return head + struct.pack("<I", len(d)) + b"".join(
+            _wkb_ring(r) for r in d)
+    if k == "MULTIPOINT":
+        return head + struct.pack("<I", len(d)) + b"".join(
+            _wkb_geom(Geom("POINT", p)) for p in d)
+    if k == "MULTILINESTRING":
+        return head + struct.pack("<I", len(d)) + b"".join(
+            _wkb_geom(Geom("LINESTRING", ln)) for ln in d)
+    if k == "MULTIPOLYGON":
+        return head + struct.pack("<I", len(d)) + b"".join(
+            _wkb_geom(Geom("POLYGON", poly)) for poly in d)
+    return head + struct.pack("<I", len(d)) + b"".join(
+        _wkb_geom(x) for x in d)
+
+
+def _wkb_ring(pts) -> bytes:
+    return struct.pack("<I", len(pts)) + b"".join(
+        struct.pack("<dd", x, y) for x, y in pts)
+
+
+def _read_geom(buf: bytes, off: int):
+    if off + 5 > len(buf):
+        raise PlanError("truncated WKB")
+    order = buf[off]
+    fmt = "<" if order == 1 else ">"
+    code, = struct.unpack_from(fmt + "I", buf, off + 1)
+    kind = _WKB_TYPE.get(code)
+    if kind is None:
+        raise PlanError(f"unknown WKB geometry code {code}")
+    off += 5
+
+    def read_pt(o):
+        x, y = struct.unpack_from(fmt + "dd", buf, o)
+        return (x, y), o + 16
+
+    def read_count(o):
+        n, = struct.unpack_from(fmt + "I", buf, o)
+        return n, o + 4
+
+    if kind == "POINT":
+        p, off = read_pt(off)
+        if p[0] != p[0]:
+            return Geom(kind, None), off
+        return Geom(kind, p), off
+    if kind == "LINESTRING":
+        n, off = read_count(off)
+        pts = []
+        for _ in range(n):
+            p, off = read_pt(off)
+            pts.append(p)
+        return Geom(kind, pts), off
+    if kind == "POLYGON":
+        n, off = read_count(off)
+        rings = []
+        for _ in range(n):
+            m, off = read_count(off)
+            pts = []
+            for _ in range(m):
+                p, off = read_pt(off)
+                pts.append(p)
+            rings.append(pts)
+        return Geom(kind, rings), off
+    n, off = read_count(off)
+    subs = []
+    for _ in range(n):
+        sub, off = _read_geom(buf, off)
+        subs.append(sub)
+    if kind == "MULTIPOINT":
+        return Geom(kind, [s.data for s in subs]), off
+    if kind == "MULTILINESTRING":
+        return Geom(kind, [s.data for s in subs]), off
+    if kind == "MULTIPOLYGON":
+        return Geom(kind, [s.data for s in subs]), off
+    return Geom(kind, subs), off
+
+
+def st_asbinary(wkt) -> bytes | None:
+    """Unparseable input yields NULL, not an error (reference
+    st_asbinary.slt: st_AsBinary('POINT(0, 0)') → NULL)."""
+    if wkt is None:
+        return None
+    try:
+        return _wkb_geom(parse_wkt(str(wkt)))
+    except Exception:
+        return None
+
+
+def st_geomfromwkb(data) -> str | None:
+    if data is None:
+        return None
+    if not isinstance(data, (bytes, bytearray)):
+        raise PlanError(
+            "st_GeomFromWKB expects Binary input (st_AsBinary output)")
+    g, off = _read_geom(bytes(data), 0)
+    if off != len(data):
+        raise PlanError("trailing WKB bytes")
+    return to_wkt(g)
+
+
+def _ring_area(pts) -> float:
+    if len(pts) < 3:
+        return 0.0
+    s = 0.0
+    for i in range(len(pts)):
+        x1, y1 = pts[i]
+        x2, y2 = pts[(i + 1) % len(pts)]
+        s += x1 * y2 - x2 * y1
+    return abs(s) / 2.0
+
+
+def st_area_geom(g: Geom) -> float:
+    """Unsigned planar area (geo crate unsigned_area): outer rings minus
+    holes, multipolygons summed; 0 for points/lines. An EMPTY POINT is an
+    error (geo: 'The input was an empty Point, but the output doesn't
+    support empty Points')."""
+    if g.kind == "POINT" and g.data is None:
+        raise PlanError("the input was an empty Point")
+    total = 0.0
+    for rings in _polys(g):
+        if rings:
+            total += _ring_area(rings[0])
+            for hole in rings[1:]:
+                total -= _ring_area(hole)
+    return total
+
+
+# ------------------------------------------------------ predicates
+def _seg_intersect(p1, p2, p3, p4) -> bool:
+    """Closed-segment intersection (touching counts)."""
+    def orient(a, b, c):
+        v = (b[0] - a[0]) * (c[1] - a[1]) - (b[1] - a[1]) * (c[0] - a[0])
+        return 0 if v == 0 else (1 if v > 0 else -1)
+
+    def on_seg(a, b, c):
+        return (min(a[0], b[0]) <= c[0] <= max(a[0], b[0])
+                and min(a[1], b[1]) <= c[1] <= max(a[1], b[1]))
+
+    o1, o2 = orient(p1, p2, p3), orient(p1, p2, p4)
+    o3, o4 = orient(p3, p4, p1), orient(p3, p4, p2)
+    if o1 != o2 and o3 != o4:
+        return True
+    if o1 == 0 and on_seg(p1, p2, p3):
+        return True
+    if o2 == 0 and on_seg(p1, p2, p4):
+        return True
+    if o3 == 0 and on_seg(p3, p4, p1):
+        return True
+    return o4 == 0 and on_seg(p3, p4, p2)
+
+
+def _pt_on_seg(p, a, b) -> bool:
+    cross = (b[0] - a[0]) * (p[1] - a[1]) - (b[1] - a[1]) * (p[0] - a[0])
+    if cross != 0:
+        return False
+    return (min(a[0], b[0]) <= p[0] <= max(a[0], b[0])
+            and min(a[1], b[1]) <= p[1] <= max(a[1], b[1]))
+
+
+def _pt_in_ring(p, ring) -> int:
+    """2 = interior, 1 = boundary, 0 = outside (ray cast; concave ok)."""
+    n = len(ring)
+    if n == 0:
+        return 0
+    inside = False
+    for i in range(n):
+        a, b = ring[i], ring[(i + 1) % n]
+        if _pt_on_seg(p, a, b):
+            return 1
+        if (a[1] > p[1]) != (b[1] > p[1]):
+            xin = a[0] + (p[1] - a[1]) * (b[0] - a[0]) / (b[1] - a[1])
+            if xin > p[0]:
+                inside = not inside
+    return 2 if inside else 0
+
+
+def _pt_in_poly(p, rings) -> int:
+    """2/1/0 against a polygon with holes."""
+    if not rings:
+        return 0
+    r0 = _pt_in_ring(p, rings[0])
+    if r0 != 2:
+        return r0
+    for hole in rings[1:]:
+        h = _pt_in_ring(p, hole)
+        if h == 2:
+            return 0
+        if h == 1:
+            return 1
+    return 2
+
+
+def _segments(g: Geom):
+    k, d = g.kind, g.data
+    if k == "LINESTRING":
+        yield from zip(d, d[1:])
+    elif k == "POLYGON":
+        for r in d:
+            yield from zip(r, r[1:] + r[:1])
+    elif k == "MULTILINESTRING":
+        for ln in d:
+            yield from zip(ln, ln[1:])
+    elif k == "MULTIPOLYGON":
+        for poly in d:
+            for r in poly:
+                yield from zip(r, r[1:] + r[:1])
+    elif k == "GEOMETRYCOLLECTION":
+        for sub in d:
+            yield from _segments(sub)
+
+
+def _points(g: Geom):
+    k, d = g.kind, g.data
+    if k == "POINT":
+        if d is not None:
+            yield d
+    elif k in ("LINESTRING", "MULTIPOINT"):
+        yield from d
+    elif k in ("POLYGON", "MULTILINESTRING"):
+        for part in d:
+            yield from part
+    elif k == "MULTIPOLYGON":
+        for poly in d:
+            for r in poly:
+                yield from r
+    else:
+        for sub in d:
+            yield from _points(sub)
+
+
+def _polys(g: Geom):
+    if g.kind == "POLYGON":
+        yield g.data
+    elif g.kind == "MULTIPOLYGON":
+        yield from g.data
+    elif g.kind == "GEOMETRYCOLLECTION":
+        for sub in g.data:
+            yield from _polys(sub)
+
+
+def _pt_in_geom(p, g: Geom) -> int:
+    """2 interior / 1 boundary / 0 outside for area geometries; for
+    line/point geometries 1 = on, 0 = off."""
+    best = 0
+    for poly in _polys(g):
+        best = max(best, _pt_in_poly(p, poly))
+        if best == 2:
+            return 2
+    if g.kind in ("LINESTRING", "MULTILINESTRING",
+                  "GEOMETRYCOLLECTION"):
+        for a, b in _segments(g):
+            if _pt_on_seg(p, a, b):
+                return max(best, 1)
+    if g.kind in ("POINT", "MULTIPOINT"):
+        for q in _points(g):
+            if q == p:
+                return max(best, 1)
+    return best
+
+
+def st_intersects(w1, w2):
+    if w1 is None or w2 is None:
+        return None
+    g1, g2 = parse_wkt(w1), parse_wkt(w2)
+    if _is_empty(g1) or _is_empty(g2):
+        return False
+    for s1 in _segments(g1):
+        for s2 in _segments(g2):
+            if _seg_intersect(*s1, *s2):
+                return True
+    # containment without edge crossings (one inside the other), and
+    # point-vs-geometry cases
+    for p in _points(g1):
+        if _pt_in_geom(p, g2):
+            return True
+    for p in _points(g2):
+        if _pt_in_geom(p, g1):
+            return True
+    return False
+
+
+def st_disjoint(w1, w2):
+    r = st_intersects(w1, w2)
+    return None if r is None else (not r)
+
+
+def _is_empty(g: Geom) -> bool:
+    if g.kind == "POINT":
+        return g.data is None
+    if g.kind == "GEOMETRYCOLLECTION":
+        return all(_is_empty(x) for x in g.data) if g.data else True
+    return not g.data
+
+
+def _contains(outer: Geom, inner: Geom) -> bool:
+    """Every point of `inner` inside `outer` (boundary allowed), and no
+    inner edge crossing outer's boundary into the exterior (geo crate
+    Contains: an EMPTY geometry is contained in nothing)."""
+    if _is_empty(outer) or _is_empty(inner):
+        return False
+    pts = list(_points(inner))
+    if not pts:
+        return False
+    interior_seen = False
+    for p in pts:
+        loc = _pt_in_geom(p, outer)
+        if loc == 0:
+            return False
+        if loc == 2:
+            interior_seen = True
+    # midpoints guard concave boundaries: a segment between two inside
+    # vertices can leave the polygon
+    for a, b in _segments(inner):
+        mid = ((a[0] + b[0]) / 2, (a[1] + b[1]) / 2)
+        if _pt_in_geom(mid, outer) == 0:
+            return False
+        if _pt_in_geom(mid, outer) == 2:
+            interior_seen = True
+    outer_has_area = next(iter(_polys(outer)), None) is not None
+    if not outer_has_area:
+        # line outer: its BOUNDARY is the endpoint set (geo Contains
+        # excludes it — a line does not contain its own endpoints)
+        ends = _line_endpoints(outer)
+        if inner.kind in ("POINT", "MULTIPOINT"):
+            return all(p not in ends for p in pts)
+        mids = [((a[0] + b[0]) / 2, (a[1] + b[1]) / 2)
+                for a, b in _segments(inner)]
+        return any(p not in ends for p in pts + mids)
+    if inner.kind in ("POINT", "MULTIPOINT"):
+        return interior_seen or all(
+            _pt_in_geom(p, outer) >= 1 for p in pts)
+    if not interior_seen:
+        # boundary-coincident shapes (a polygon vs itself): test a
+        # representative INTERIOR point of each inner polygon
+        for poly in _polys(inner):
+            rp = _rep_point(poly)
+            if rp is not None:
+                loc = _pt_in_geom(rp, outer)
+                if loc == 0:
+                    return False
+                if loc == 2:
+                    interior_seen = True
+    return interior_seen
+
+
+def _line_endpoints(g: Geom) -> set:
+    """Boundary points of a line geometry: endpoints of each open
+    linestring (closed rings have none)."""
+    out = set()
+
+    def add(pts):
+        if len(pts) >= 2 and pts[0] != pts[-1]:
+            out.add(pts[0])
+            out.add(pts[-1])
+
+    if g.kind == "LINESTRING":
+        add(g.data)
+    elif g.kind == "MULTILINESTRING":
+        for ln in g.data:
+            add(ln)
+    elif g.kind == "GEOMETRYCOLLECTION":
+        for sub in g.data:
+            out |= _line_endpoints(sub)
+    return out
+
+
+def _rep_point(rings):
+    """A point strictly inside a polygon (concave/holes tolerated by
+    retrying candidate midpoints)."""
+    ring = rings[0] if rings else []
+    n = len(ring)
+    if n == 0:
+        return None
+    cx = sum(p[0] for p in ring) / n
+    cy = sum(p[1] for p in ring) / n
+    if _pt_in_poly((cx, cy), rings) == 2:
+        return (cx, cy)
+    for i in range(n):
+        for j in range(i + 2, n):
+            mid = ((ring[i][0] + ring[j][0]) / 2,
+                   (ring[i][1] + ring[j][1]) / 2)
+            if _pt_in_poly(mid, rings) == 2:
+                return mid
+    return None
+
+
+def st_contains(w1, w2):
+    if w1 is None or w2 is None:
+        return None
+    return _contains(parse_wkt(w1), parse_wkt(w2))
+
+
+def st_within(w1, w2):
+    if w1 is None or w2 is None:
+        return None
+    return _contains(parse_wkt(w2), parse_wkt(w1))
+
+
+def st_equals(w1, w2):
+    """Topological equality approximated as mutual containment."""
+    if w1 is None or w2 is None:
+        return None
+    g1, g2 = parse_wkt(w1), parse_wkt(w2)
+    if _is_empty(g1) and _is_empty(g2):
+        return True
+    return _contains(g1, g2) and _contains(g2, g1)

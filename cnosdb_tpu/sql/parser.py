@@ -687,8 +687,18 @@ class Parser:
                         # (reference models/src/schema/tskv_table_schema.rs
                         # GeometryType); subtype recorded for DESCRIBE
                         sub = self.expect_ident().upper()
+                        if sub not in ("POINT", "LINESTRING", "POLYGON",
+                                       "MULTIPOINT", "MULTILINESTRING",
+                                       "MULTIPOLYGON",
+                                       "GEOMETRYCOLLECTION"):
+                            raise ParserError(
+                                f"unknown geometry subtype {sub!r}")
                         self.expect_op(",")
                         srid = int(self.expect_number())
+                        if srid != 0:
+                            raise ParserError(
+                                f"unsupported geometry SRID {srid} "
+                                f"(only 0)")
                         self.expect_op(")")
                         tname = f"GEOMETRY({sub}, {srid})"
                     codec = None

@@ -409,7 +409,20 @@ _WKT_NUM = r"[-+]?\d*\.?\d+(?:[eE][-+]?\d+)?"
 
 
 def _parse_wkt(wkt: str):
-    """→ (type, list of (x, y)). Supports POINT/LINESTRING/POLYGON."""
+    """→ (type, list of (x, y)) — flattened points of any WKT geometry
+    (full grammar incl. EMPTY and multi types via sql.gis)."""
+    if wkt is None:
+        return None
+    from . import gis
+
+    try:
+        g = gis.parse_wkt(str(wkt))
+    except Exception:
+        return _parse_wkt_legacy(wkt)
+    return (g.kind, list(gis._points(g)))
+
+
+def _parse_wkt_legacy(wkt: str):
     if wkt is None:
         return None
     m = re.match(r"\s*(POINT|LINESTRING|POLYGON)\s*\((.*)\)\s*$",
@@ -445,15 +458,38 @@ def st_distance(wkt1: str, wkt2: str) -> float:
     """Planar euclidean distance (gis/st_distance.rs, geo crate
     EuclideanDistance): exact for point↔point / point↔linestring;
     min vertex-to-segment distance otherwise."""
-    g1, g2 = _parse_wkt(wkt1), _parse_wkt(wkt2)
-    if g1 is None or g2 is None:
+    k1 = str(wkt1).strip().upper() if wkt1 is not None else ""
+    k2 = str(wkt2).strip().upper() if wkt2 is not None else ""
+    coll = any(k.startswith("GEOMETRYCOLLECTION") for k in (k1, k2))
+    multi_pair = (any(k.startswith("MULTI") for k in (k1, k2))
+                  and not (k1.startswith("POINT")
+                           or k2.startswith("POINT")))
+    if coll or multi_pair:
+        from ..errors import FunctionError
+
+        # the reference's geo crate EuclideanDistance covers
+        # POINT×anything and POINT/LINESTRING/POLYGON pairs; other
+        # MULTI*/collection combinations error (st_distance.slt)
+        raise FunctionError(
+            "st_distance does not support this geometry combination")
+    if wkt1 is None or wkt2 is None:
         return None
-    (t1, p1), (t2, p2) = g1, g2
-    if t1 == t2 == "POINT":
-        return math.hypot(p1[0][0] - p2[0][0], p1[0][1] - p2[0][1])
+    from . import gis
+
+    ga, gb = gis.parse_wkt(str(wkt1)), gis.parse_wkt(str(wkt2))
+    # touching/crossing/contained geometries are at distance 0 (geo
+    # EuclideanDistance; a linestring crossing a polygon interior → 0.0)
+    try:
+        if gis.st_intersects(str(wkt1), str(wkt2)):
+            return 0.0
+    except Exception:
+        pass
     best = math.inf
-    for (a, pa), (b, pb) in ((g1, g2), (g2, g1)):
-        segs = list(zip(pb, pb[1:])) or [(pb[0], pb[0])]
+    for (pa, gb_) in ((list(gis._points(ga)), gb),
+                      (list(gis._points(gb)), ga)):
+        segs = list(gis._segments(gb_))
+        if not segs:
+            segs = [(p, p) for p in gis._points(gb_)]
         for (px, py) in pa:
             for (s1, s2) in segs:
                 best = min(best, _seg_point_dist(px, py, *s1, *s2))
@@ -461,13 +497,10 @@ def st_distance(wkt1: str, wkt2: str) -> float:
 
 
 def st_area(wkt: str) -> float:
-    """Polygon shoelace area (gis/st_area.rs); 0 for points/lines."""
-    g = _parse_wkt(wkt)
-    if g is None:
+    """Planar area (gis/st_area.rs, geo unsigned_area): outer rings
+    minus holes, multipolygons summed; 0 for points/lines."""
+    if wkt is None:
         return None
-    gtype, pts = g
-    if gtype != "POLYGON" or len(pts) < 3:
-        return 0.0
-    x = np.array([p[0] for p in pts])
-    y = np.array([p[1] for p in pts])
-    return float(abs(np.dot(x, np.roll(y, -1)) - np.dot(y, np.roll(x, -1))) / 2)
+    from . import gis
+
+    return gis.st_area_geom(gis.parse_wkt(str(wkt)))

@@ -86,6 +86,25 @@ def _get_lib_locked():
             lib.encode_xor_transpose_f64.argtypes = [
                 ctypes.POINTER(ctypes.c_uint64), ctypes.c_size_t,
                 ctypes.POINTER(ctypes.c_uint8)]
+        if hasattr(lib, "fused_seg_agg_f64"):
+            lib.fused_seg_agg_f64.restype = ctypes.c_int
+            lib.fused_seg_agg_f64.argtypes = [
+                ctypes.POINTER(ctypes.c_int64),    # ts
+                ctypes.POINTER(ctypes.c_int32),    # sid_ord
+                ctypes.POINTER(ctypes.c_int64),    # group_lut
+                ctypes.c_int64,                    # n_rows
+                ctypes.c_int64, ctypes.c_int64,    # origin, interval
+                ctypes.c_int64, ctypes.c_int64,    # bmin, n_buckets
+                ctypes.c_void_p,                   # vals (f64 or null)
+                ctypes.c_void_p,                   # valid (u8 or null)
+                ctypes.c_void_p,                   # row_mask
+                ctypes.c_int64,                    # num_segments
+                ctypes.c_void_p, ctypes.c_void_p,  # presence, count
+                ctypes.c_void_p, ctypes.c_void_p,  # sum, min
+                ctypes.c_void_p, ctypes.c_void_p,  # max, out_seg
+                ctypes.c_void_p, ctypes.c_void_p,  # first, first_ts
+                ctypes.c_void_p, ctypes.c_void_p,  # last, last_ts
+                ctypes.c_int]                      # n_threads
         _LIB = lib
     except OSError:
         _LIB = None
@@ -146,6 +165,72 @@ def encode_xor_transpose_f64(values: np.ndarray) -> np.ndarray | None:
     lib.encode_xor_transpose_f64(
         v.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)), len(v),
         out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)))
+    return out
+
+
+def fused_seg_agg_f64(ts, sid_ord, group_lut, origin, interval, bmin,
+                      n_buckets, vals, valid, row_mask, num_segments,
+                      wants: dict, out_seg: bool = False,
+                      n_threads: int = 8):
+    """One-pass segment partials (native/segagg.cpp) — presence always;
+    count/sum/min/max of `vals` per `wants`. → dict of arrays (plus
+    'seg' when out_seg) or None when the library / shape is unavailable
+    or a segment falls out of range."""
+    lib = get_lib()
+    if lib is None or not hasattr(lib, "fused_seg_agg_f64"):
+        return None
+    n = len(ts)
+
+    def p64(a):
+        return a.ctypes.data_as(ctypes.POINTER(ctypes.c_int64))
+
+    def voidp(a):
+        return a.ctypes.data if a is not None else None
+
+    presence = np.zeros(num_segments, dtype=np.int64)
+    count = np.zeros(num_segments, dtype=np.int64) \
+        if (wants.get("want_count") or wants.get("want_sum")) else None
+    sum_ = np.zeros(num_segments, dtype=np.float64) \
+        if wants.get("want_sum") else None
+    mn = np.zeros(num_segments, dtype=np.float64) \
+        if wants.get("want_min") else None
+    mx = np.zeros(num_segments, dtype=np.float64) \
+        if wants.get("want_max") else None
+    first = np.zeros(num_segments, dtype=np.float64) \
+        if wants.get("want_first") else None
+    first_ts = np.zeros(num_segments, dtype=np.int64) \
+        if first is not None else None
+    last = np.zeros(num_segments, dtype=np.float64) \
+        if wants.get("want_last") else None
+    last_ts = np.zeros(num_segments, dtype=np.int64) \
+        if last is not None else None
+    seg = np.empty(n, dtype=np.int64) if out_seg else None
+    rc = lib.fused_seg_agg_f64(
+        p64(ts), sid_ord.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        p64(group_lut), n, origin, interval, bmin, n_buckets,
+        voidp(vals), voidp(valid), voidp(row_mask), num_segments,
+        voidp(presence), voidp(count), voidp(sum_), voidp(mn), voidp(mx),
+        voidp(seg), voidp(first), voidp(first_ts), voidp(last),
+        voidp(last_ts), n_threads)
+    if rc != 0:
+        return None
+    out = {"presence": presence}
+    if count is not None:
+        out["count"] = count
+    if sum_ is not None:
+        out["sum"] = sum_
+    if mn is not None:
+        out["min"] = mn
+    if mx is not None:
+        out["max"] = mx
+    if first is not None:
+        out["first"] = first
+        out["first_ts"] = first_ts
+    if last is not None:
+        out["last"] = last
+        out["last_ts"] = last_ts
+    if seg is not None:
+        out["seg"] = seg
     return out
 
 

@@ -126,6 +126,44 @@ def merge_parts(parts, field_names: list[str]):
     if len(parts) == 1:
         ts, fields = parts[0]
         return ts, fields
+    # fast path: compacted output chunks are time-partitioned — when the
+    # parts are individually strictly increasing and pairwise DISJOINT
+    # after ordering by first timestamp, the merge is a concatenation
+    # (no argsort, no dedup — the dominant cold-scan shape)
+    nonempty = [p for p in parts if len(p[0])]
+    if len(nonempty) > 1:
+        ordered = sorted(nonempty, key=lambda p: int(p[0][0]))
+        ok = all(bool((p[0][1:] > p[0][:-1]).all()) for p in ordered)
+        if ok:
+            for a, b in zip(ordered, ordered[1:]):
+                if int(a[0][-1]) >= int(b[0][0]):
+                    ok = False
+                    break
+        if ok:
+            ts = np.concatenate([p[0] for p in ordered])
+            out = {}
+            for name in field_names:
+                vt = next((f[name][0] for _, f in ordered if name in f),
+                          None)
+                if vt is None:
+                    continue
+                np_dtype = vt.numpy_dtype()
+                if np_dtype is object:
+                    break   # dictionary columns: generic path unifies
+                vals_parts, valid_parts = [], []
+                for ts_p, f in ordered:
+                    if name in f:
+                        vals_parts.append(f[name][1])
+                        valid_parts.append(f[name][2])
+                    else:
+                        vals_parts.append(
+                            np.zeros(len(ts_p), dtype=np_dtype))
+                        valid_parts.append(
+                            np.zeros(len(ts_p), dtype=bool))
+                out[name] = (vt, np.concatenate(vals_parts),
+                             np.concatenate(valid_parts))
+            else:
+                return ts, out
     ts_all = np.concatenate([p[0] for p in parts])
     total = len(ts_all)
     order = np.argsort(ts_all, kind="stable")

@@ -1,0 +1,63 @@
+"""Per-stage query timing — the bench instrumentation plane.
+
+`bench.py` enables this around each measured query to report where the
+time goes (scan cache hit/miss, TSM decode, kernel, merge, finalize);
+disabled it costs one dict lookup per stage() call. Counters accumulate
+across threads (the scan fans out on a pool).
+
+Stages recorded by the engine:
+  scan_hit / scan_miss  — coordinator scan-snapshot cache counters
+  decode_ms             — TSM read+decode (cache-miss scans only)
+  kernel_ms             — fused segment-aggregate kernels
+  merge_ms              — cross-vnode partial merge
+  finalize_ms           — vectorized finalizers + output rendering
+"""
+from __future__ import annotations
+
+import threading
+import time
+from contextlib import contextmanager
+
+_lock = threading.Lock()
+_enabled = False
+_ms: dict[str, float] = {}
+_counts: dict[str, int] = {}
+
+
+def enable(flag: bool = True) -> None:
+    global _enabled
+    _enabled = flag
+
+
+def reset() -> None:
+    with _lock:
+        _ms.clear()
+        _counts.clear()
+
+
+def snapshot() -> dict:
+    with _lock:
+        out = {k: round(v, 2) for k, v in sorted(_ms.items())}
+        out.update(sorted(_counts.items()))
+        return out
+
+
+def count(name: str, n: int = 1) -> None:
+    if not _enabled:
+        return
+    with _lock:
+        _counts[name] = _counts.get(name, 0) + n
+
+
+@contextmanager
+def stage(name: str):
+    if not _enabled:
+        yield
+        return
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        dt = (time.perf_counter() - t0) * 1e3
+        with _lock:
+            _ms[name] = _ms.get(name, 0.0) + dt
