@@ -1649,10 +1649,7 @@ class QueryExecutor:
         None → structure not proven safe, caller runs written order."""
         from . import join_order
 
-        flat = join_order.flatten_inner(item)
-        if flat is None:
-            return None
-        leaf_items, conjuncts = flat
+        leaf_items, conjuncts = join_order.flatten_inner(item)
         if len(leaf_items) < 3:   # nothing to reorder; don't materialize twice
             return None
         leaves = [self._materialize_from(li, session) for li in leaf_items]
@@ -1664,7 +1661,9 @@ class QueryExecutor:
         return join_order.order_and_join(leaves, conjuncts)
 
     def _join_written(self, item, leaf_iter) -> rel.Scope:
-        if isinstance(item, ast.Join):
+        # outer-join subtrees are LEAVES of the flattened inner region
+        # (they materialized as one scope) — only inner joins recurse
+        if isinstance(item, ast.Join) and item.kind == "inner":
             left = self._join_written(item.left, leaf_iter)
             right = self._join_written(item.right, leaf_iter)
             return rel.hash_join(left, right, item.kind, item.on)

@@ -30,15 +30,14 @@ _HIDDEN = "__jridx"
 
 
 def flatten_inner(item) -> tuple[list, list] | None:
-    """ast.Join tree of ONLY inner joins → (leaves, conjuncts); None when
-    any join in the tree is not inner (outer joins pin their order)."""
-    if isinstance(item, ast.Join):
-        if item.kind != "inner":
-            return None
+    """Maximal INNER-join region rooted at `item` → (leaves, conjuncts).
+    A non-inner join is NOT flattened through — it becomes a leaf whose
+    subtree keeps its own (order-pinning) structure; the caller
+    materializes it via the ordinary join path, so inner regions AROUND
+    outer joins still reorder (round-3 verdict item 8)."""
+    if isinstance(item, ast.Join) and item.kind == "inner":
         l = flatten_inner(item.left)
         r = flatten_inner(item.right)
-        if l is None or r is None:
-            return None
         return l[0] + r[0], l[1] + r[1] + _split_conjuncts(item.on)
     return [item], []
 
@@ -76,11 +75,17 @@ def _conjoin(cs: list[Expr]) -> Expr | None:
 def order_and_join(leaves: list[Scope], conjuncts: list[Expr]) -> Scope:
     """Join materialized leaf scopes in a greedy cost order; returns a scope
     whose rows/columns match the written-order left-deep join exactly.
-    Callers guarantee every leaf has exactly one qualifier."""
+    Leaves may carry multiple qualifiers (a materialized outer-join
+    subtree is one leaf): display columns are addressed by hidden
+    per-position keys, so reordering never depends on name resolution."""
     k = len(leaves)
-    # hidden written-order row index per leaf, riding the env through joins
+    # hidden written-order row index per leaf, riding the env through
+    # joins + a unique address per display column (position-stable even
+    # when a leaf has colliding or multi-qualifier names)
     for i, s in enumerate(leaves):
         s.env[f"{_HIDDEN}{i}"] = np.arange(s.n, dtype=np.int64)
+        for pos, col in enumerate(s.cols):
+            s.env[f"__leafcol{i}_{pos}"] = col
 
     # single-leaf conjuncts filter at the source (same rows the written
     # plan would drop post-join; relative row order is unchanged)
@@ -157,45 +162,44 @@ def order_and_join(leaves: list[Scope], conjuncts: list[Expr]) -> Scope:
     order = np.lexsort(ridx[::-1])
     cur = cur.take(order)
 
-    # restore written-order columns and bare-name resolution
+    # restore written-order columns and bare-name resolution via the
+    # hidden per-position addresses
     names, cols, env = [], [], {}
     for i, leaf in enumerate(leaves):
-        (qual,) = leaf.quals
-        for n_ in leaf.names:
-            col = cur.env[f"{qual}.{n_}"]
+        for pos, n_ in enumerate(leaf.names):
+            col = cur.env[f"__leafcol{i}_{pos}"]
             names.append(n_)
             cols.append(col)
-            env[f"{qual}.{n_}"] = col
+        for q in leaf.quals:
+            for n_ in leaf.names:
+                key = f"{q}.{n_}"
+                if key in cur.env:
+                    env[key] = cur.env[key]
     for i in range(k - 1, -1, -1):   # earliest-written leaf wins bare names
-        (qual,) = leaves[i].quals
-        for n_ in leaves[i].names:
-            env[n_] = cur.env[f"{qual}.{n_}"]
+        for pos, n_ in enumerate(leaves[i].names):
+            env[n_] = cur.env[f"__leafcol{i}_{pos}"]
     out = Scope(names, cols, env)
     out.quals = set().union(*(s.quals for s in leaves))
     return out
 
 
 def reorderable(leaves: list[Scope], conjuncts: list[Expr]) -> bool:
-    """Safe to reorder: ≥3 leaves, each with exactly one qualifier, no
-    qualifier collisions, every display column reachable qualified, and no
-    conjunct referencing a name visible in more than one leaf (written-order
-    bare-name resolution depends on join position; rather than emulate it
-    mid-reorder, bail out)."""
+    """Safe to reorder: ≥3 leaves, disjoint qualifier sets (display
+    columns are addressed positionally, so multi-qualifier leaves —
+    materialized outer-join subtrees — are fine), and no conjunct
+    referencing a name visible in more than one leaf (written-order
+    bare-name resolution depends on join position; rather than emulate
+    it mid-reorder, bail out)."""
     if len(leaves) < 3:
         return False
     seen: set[str] = set()
     for s in leaves:
-        if len(s.quals) != 1:
+        if not s.quals:
             return False
-        (q,) = s.quals
-        if q in seen:
-            return False
-        seen.add(q)
-        if len(set(s.names)) != len(s.names):
-            return False   # duplicate display names inside one leaf
-        for n_ in s.names:
-            if f"{q}.{n_}" not in s.env:
+        for q in s.quals:
+            if q in seen:
                 return False
+            seen.add(q)
     for c in conjuncts:
         for col in c.columns():
             if sum(1 for s in leaves if col in s.env) > 1:

@@ -77,10 +77,126 @@ def _window_stmt(stmt, start: int, end: int):
     return dataclasses.replace(stmt, where=where)
 
 
+class OffsetTracker:
+    """Per-source processed/available offsets (reference
+    stream/offset_tracker/mod.rs). For tskv sources the offset is the max
+    ingested timestamp: a trigger only processes up to what the source has
+    actually made AVAILABLE, so a lagging ingest pipeline cannot make the
+    watermark skip past data that is still arriving in order."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._processed: dict[str, int] = {}
+        self._available: dict[str, int] = {}
+
+    def update_available(self, source: str, offset: int):
+        with self._lock:
+            cur = self._processed.get(source)
+            if cur is None or offset > cur:
+                self._available[source] = max(
+                    self._available.get(source, offset), offset)
+
+    def has_available(self) -> bool:
+        with self._lock:
+            return bool(self._available)
+
+    def available_range(self, source: str):
+        """→ (processed | None, available | None) for one source."""
+        with self._lock:
+            return (self._processed.get(source),
+                    self._available.get(source))
+
+    def commit(self, source: str, offset: int):
+        """Mark everything ≤ offset processed; drops the available entry
+        when fully consumed (reference update_processed_offset)."""
+        with self._lock:
+            self._processed[source] = offset
+            if self._available.get(source, -1) <= offset:
+                self._available.pop(source, None)
+
+
+class MemoryStateStore:
+    """Commit/expire/state over row batches, uniquely identified by
+    (query_id, partition_id, operator_id) — reference
+    stream/state_store/memory.rs. Batches are ResultSet-shaped
+    (names, columns); puts stage into the uncommitted set, commit()
+    publishes them and returns the new version, expire(predicate)
+    removes matching rows from the committed state and returns them."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._committed: list[ResultSet] = []
+        self._uncommitted: list[ResultSet] = []
+        self._version = 0
+
+    def put(self, batch: ResultSet):
+        with self._lock:
+            # copy: callers may reuse/mutate their arrays
+            self._uncommitted.append(ResultSet(
+                list(batch.names), [np.array(c) for c in batch.columns]))
+
+    def commit(self) -> int:
+        with self._lock:
+            self._committed.extend(self._uncommitted)
+            self._uncommitted = []
+            self._version += 1
+            return self._version
+
+    def expire(self, predicate) -> list[ResultSet]:
+        """predicate: sql.expr.Expr over the batch columns; matching rows
+        are REMOVED and returned (reference expire())."""
+        removed = []
+        with self._lock:
+            kept = []
+            for rs in self._committed:
+                env = {n: c for n, c in zip(rs.names, rs.columns)}
+                m = np.asarray(predicate.eval(env, np))
+                if not m.shape:
+                    m = np.full(rs.n_rows, bool(m))
+                m = m.astype(bool)
+                if m.any():
+                    removed.append(ResultSet(
+                        list(rs.names), [c[m] for c in rs.columns]))
+                if not m.all():
+                    kept.append(ResultSet(
+                        list(rs.names), [c[~m] for c in rs.columns]))
+            self._committed = kept
+        return removed
+
+    def state(self) -> list[ResultSet]:
+        with self._lock:
+            return list(self._committed)
+
+
+class StateStoreFactory:
+    """get_or_default keyed by (query_id, partition_id, operator_id)
+    (reference MemoryStateStoreFactory)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._stores: dict[tuple, MemoryStateStore] = {}
+
+    def get_or_default(self, query_id: str, partition_id: int = 0,
+                       operator_id: int = 0) -> MemoryStateStore:
+        key = (query_id, partition_id, operator_id)
+        with self._lock:
+            store = self._stores.get(key)
+            if store is None:
+                store = self._stores[key] = MemoryStateStore()
+            return store
+
+    def drop_query(self, query_id: str):
+        with self._lock:
+            for key in [k for k in self._stores if k[0] == query_id]:
+                self._stores.pop(key)
+
+
 class StreamEngine:
     def __init__(self, executor: QueryExecutor, state_dir: str):
         self.executor = executor
         self.tracker = WatermarkTracker(os.path.join(state_dir, "watermarks.json"))
+        self.offsets = OffsetTracker()
+        self.state_stores = StateStoreFactory()
         self.streams: dict[str, StreamQuery] = {}
         self._threads: dict[str, threading.Thread] = {}
         self._stop = threading.Event()
@@ -102,6 +218,7 @@ class StreamEngine:
         t.start()
 
     def drop(self, name: str, keep_watermark: bool = False):
+        self.state_stores.drop_query(name)
         self.streams.pop(name, None)
         entry = self._threads.pop(name, None)
         if entry is not None:
@@ -130,6 +247,17 @@ class StreamEngine:
         now = now_ns if now_ns is not None else int(time.time() * 1e9)
         start = self.tracker.get(name, 0)
         end = now - sq.delay_ns
+        # the offset tracker caps the batch at what the SOURCE has made
+        # available (max ingested ts + 1): a lagging ingest must not be
+        # skipped over by a wall-clock watermark
+        source = getattr(sq.stmt, "table", None) if sq.stmt is not None \
+            else None
+        if source:
+            self._refresh_available(sq, source)
+            _proc, avail = self.offsets.available_range(
+                f"{sq.name}:{source}")
+            if avail is not None:
+                end = min(end, avail + 1)
         if end <= start:
             return None
         if sq.stmt is not None:
@@ -139,8 +267,30 @@ class StreamEngine:
             sql = sq.sql.replace("$START", str(start)).replace("$END", str(end))
             rs = self.executor.execute_one(sql, sq.session)
         self._emit(sq, rs)
+        # stage + commit this batch's state, then advance offsets and the
+        # durable watermark (reference order: sink → offsets → watermark)
+        if rs.n_rows:
+            store = self.state_stores.get_or_default(sq.name)
+            store.put(rs)
+            store.commit()
+        if source:
+            self.offsets.commit(f"{sq.name}:{source}", end - 1)
         self.tracker.set(name, end)
         return rs
+
+    def _refresh_available(self, sq: StreamQuery, source: str):
+        """Publish the source table's max ingested timestamp as its
+        available offset."""
+        try:
+            rs = self.executor.execute_one(
+                f"SELECT max(time) AS m FROM {source}", sq.session)
+            if rs.n_rows and rs.columns[0][0] is not None:
+                v = rs.columns[0][0]
+                if not (isinstance(v, float) and v != v):
+                    self.offsets.update_available(
+                        f"{sq.name}:{source}", int(v))
+        except Exception:
+            pass   # source may not exist yet; triggers retry
 
     def _emit(self, sq: StreamQuery, rs: ResultSet):
         if rs.n_rows == 0 or sq.sink is None:

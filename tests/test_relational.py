@@ -554,3 +554,48 @@ def test_in_list_isin_fast_path_exact(db):
     rs = db.execute_one(
         f"SELECT time FROM bigt WHERE v IN ({in_list}) ORDER BY time")
     assert rs.columns[0].tolist() == [1]   # big+1 is NOT in (evens only)
+
+
+def test_join_reorder_outer_join_regions(db3):
+    """Inner regions AROUND an outer join reorder; the outer join pins
+    its own position. Output must equal the written-order plan bit for
+    bit (round-3 verdict item 8)."""
+    ex = db3
+    ex.execute_one("CREATE TABLE dx (xname STRING, TAGS(cust))")
+    ex.execute_one("INSERT INTO dx (time, cust, xname) VALUES "
+                   "(1, 'c0', 'x-0'), (2, 'c9', 'x-9')")
+    for sql in [
+        # LEFT JOIN leaf inside an inner region
+        "SELECT f.cust, f.amt, dc.cname, dp.pname, dx.xname FROM f "
+        "JOIN dc ON f.cust = dc.cust JOIN dp ON f.prod = dp.prod "
+        "LEFT JOIN dx ON f.cust = dx.cust",
+        # outer join subtree as a leaf of the inner region
+        "SELECT f.amt, dc.cname, dx.xname, dp.pname FROM f "
+        "JOIN dc ON f.cust = dc.cust "
+        "JOIN dp ON f.prod = dp.prod "
+        "RIGHT JOIN dx ON f.cust = dx.cust",
+        # aggregates over the mixed tree
+        "SELECT dc.cname, count(f.amt) AS c FROM f "
+        "JOIN dc ON f.cust = dc.cust JOIN dp ON f.prod = dp.prod "
+        "LEFT JOIN dx ON dc.cust = dx.cust "
+        "GROUP BY dc.cname ORDER BY dc.cname",
+    ]:
+        want = _written_order(ex, sql)
+        got = ex.execute_one(sql)
+        assert got.names == want.names, sql
+        for cg, cw in zip(got.columns, want.columns):
+            assert cg.tolist() == cw.tolist(), sql
+
+
+def test_join_reorder_multi_qualifier_leaf(db3):
+    """A materialized outer-join subtree (multi-qualifier leaf) rides
+    through the reorder with positional column addressing."""
+    ex = db3
+    sql = ("SELECT f.amt, dc.cname, dp.pname FROM "
+           "f JOIN dc ON f.cust = dc.cust "
+           "JOIN dp ON f.prod = dp.prod WHERE f.amt > 30")
+    want = _written_order(ex, sql)
+    got = ex.execute_one(sql)
+    assert got.names == want.names
+    for cg, cw in zip(got.columns, want.columns):
+        assert cg.tolist() == cw.tolist()
