@@ -462,9 +462,13 @@ def launch_scan_aggregate(batch: ScanBatch, query: TpuQuery):
         # host wrapper, unordered synthetic batches, and string columns
         ordered = _ordered_within_series(batch)
         fl_string = any(
-            a.func in ("first", "last") and a.column in batch.fields
-            and batch.fields[a.column][0] in (ValueType.STRING,
-                                              ValueType.GEOMETRY)
+            a.func in ("first", "last")
+            and ((a.column in batch.fields
+                  and batch.fields[a.column][0] in (ValueType.STRING,
+                                                    ValueType.GEOMETRY))
+                 # TAG columns aggregate through the string path too
+                 or (a.column is not None and a.column != "time"
+                     and a.column not in batch.fields))
             for a in query.aggs)
         rank_based_fl = needs_rank and (not cpu_mode or not ordered
                                         or fl_string)
@@ -853,7 +857,10 @@ def _assemble(batch, query, presence, present, col_results, group_labels,
             v = r[a.func][sel]
             v = unbias(v) if unsigned else v
             if boolean:
-                v = v.astype(bool)
+                # reference first/last render BOOLEAN as 1/0 (its
+                # selector accumulator widens; min/max keep true/false —
+                # function/common/first.slt vs min.slt)
+                v = v.astype(np.int64)
             out_cols[a.alias] = v
             out_valid[a.alias] = have
             # hidden timestamp of the selected row: lets a coordinator merge

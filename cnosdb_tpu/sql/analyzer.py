@@ -30,6 +30,8 @@ def analyze(stmt):
     stmt = rewrite_exact_count(stmt)
     stmt = rewrite_null_functions(stmt)
     stmt = rewrite_selector_functions(stmt)
+    stmt = _normalize_time_comparisons(stmt)
+    _reject_time_in_numeric_funcs(stmt)
     return stmt
 
 
@@ -46,6 +48,54 @@ def _analyze_union_order_by(stmt):
     if all(a is b for (a, _), (b, _) in zip(order_by, stmt.order_by)):
         return stmt
     return dataclasses.replace(stmt, order_by=order_by)
+
+
+def _normalize_time_comparisons(stmt):
+    """`now() >= '2024-01-01'`-style comparisons: a string literal
+    against a timestamp-valued expression parses as a timestamp
+    EVERYWHERE (the planner applies the same rule inside WHERE splits;
+    this covers constant selects and projections)."""
+    from .planner import _normalize_time_literals
+
+    return _map_stmt_exprs(stmt, _normalize_time_literals)
+
+
+_NUMERIC_FUNCS = {
+    "abs", "floor", "ceil", "round", "sqrt", "cbrt", "exp", "ln", "log",
+    "log10", "log2", "sin", "cos", "tan", "sinh", "cosh", "tanh", "asin",
+    "acos", "atan", "asinh", "acosh", "atanh", "atan2", "pow", "power",
+    "signum", "trunc", "radians", "degrees", "gcd", "lcm",
+}
+
+
+def _reject_time_in_numeric_funcs(stmt):
+    """Math scalars reject Timestamp inputs (reference: 'No function
+    matches ... exp(Timestamp(Nanosecond, None))'); the only int64 whose
+    NAME identifies it as a timestamp is the time column."""
+    def walk(e):
+        if not isinstance(e, Expr):
+            return
+        if isinstance(e, Func) and e.name.lower() in _NUMERIC_FUNCS:
+            for a in e.args:
+                for c in (a.columns() if isinstance(a, Expr) else ()):
+                    if c == "time" or c.endswith(".time"):
+                        raise PlanError(
+                            f"the function {e.name} does not support "
+                            f"inputs of type TIMESTAMP")
+        from .expr import iter_child_exprs
+
+        for c in iter_child_exprs(e):
+            walk(c)
+
+    for it in stmt.items:
+        if isinstance(it.expr, Expr):
+            walk(it.expr)
+    for e in (stmt.where, stmt.having):
+        if e is not None:
+            walk(e)
+    for oe, _ in stmt.order_by:
+        if isinstance(oe, Expr):
+            walk(oe)
 
 
 # ---------------------------------------------------------------------------

@@ -1000,6 +1000,7 @@ class QueryExecutor:
     def _select(self, stmt: ast.SelectStmt, session: Session):
         from .analyzer import analyze
 
+        stmt = self._fold_session_scalars(stmt, session)
         stmt = analyze(self._resolve_subqueries(stmt, session))
         if stmt.from_item is not None or self._needs_relational(stmt):
             return self._select_relational(stmt, session)
@@ -1520,6 +1521,38 @@ class QueryExecutor:
             out.having = rel.rewrite_exprs(stmt.having, pred, replace)
         return out
 
+    def _fold_session_scalars(self, stmt: ast.SelectStmt, session):
+        """current_user()/current_tenant()/current_database()/
+        current_role() fold to the SESSION's values (reference
+        session.rs scalars are session-bound; current_role is NULL in
+        the single-role default)."""
+        role = self.meta.members.get(session.tenant, {}).get(session.user)
+        vals = {"current_user": session.user,
+                "current_tenant": session.tenant,
+                "current_database": session.database,
+                "current_role": role}
+
+        def hit(x):
+            return isinstance(x, Func) and not x.args \
+                and x.name.lower() in vals
+
+        def sub(x):
+            return Literal(vals[x.name.lower()])
+
+        import dataclasses
+
+        changed = dataclasses.replace(
+            stmt,
+            items=[ast.SelectItem(
+                rel.rewrite_exprs(it.expr, hit, sub)
+                if isinstance(it.expr, Expr) else it.expr, it.alias)
+                for it in stmt.items],
+            where=rel.rewrite_exprs(stmt.where, hit, sub)
+            if stmt.where is not None else None,
+            having=rel.rewrite_exprs(stmt.having, hit, sub)
+            if stmt.having is not None else None)
+        return changed
+
     def _strip_table_qualifiers(self, stmt: ast.SelectStmt):
         """`SELECT m2.f0 FROM m2 WHERE m2.f1 > 0` — a single-table query
         may qualify columns with the table (or db.table) name; resolve to
@@ -1785,6 +1818,13 @@ class QueryExecutor:
                         and len(args) == 3:
                     col2 = np.asarray(args[1].eval(scope.env, np))
                     param = args[2].eval(scope.env, np)
+                elif name == "sample":
+                    if len(args) != 2 or not isinstance(args[1], Literal):
+                        raise PlanError(
+                            "sample(column, k) takes a column and a "
+                            "constant size")
+                    param = args[1].eval(scope.env, np)
+                    col = np.asarray(args[0].eval(scope.env, np))
                 agg_cache[key] = rel.host_aggregate(
                     f.name, col, gid, n_groups, distinct,
                     col2=col2, param=param)
@@ -2553,13 +2593,15 @@ def _apply_finalizer(spec, parts: dict):
     if kind == "const_agg":
         rows = int(parts.get(spec[2], 0))
         func, value = spec[1], spec[3]
+        if value is None:
+            return None
         if func == "sum":
             return value * rows if rows else None
         if rows == 0:
             return None
         if func in ("avg", "mean", "median"):
             return float(value)
-        if func in ("min", "max"):
+        if func in ("min", "max", "first", "last"):
             return value
         if func in ("stddev", "stddev_samp", "var", "var_samp"):
             return 0.0 if rows > 1 else None
@@ -2634,11 +2676,13 @@ def _vector_finalize(spec, parts_env: dict, n: int):
         rows = rows.astype(np.int64)
         func, value = spec[1], spec[3]
         ok = rows > 0
+        if value is None:
+            return np.full(n, None, dtype=object), np.zeros(n, dtype=bool)
         if func == "sum":
             return np.where(ok, value * rows, 0), ok
         if func in ("avg", "mean", "median"):
             return np.where(ok, float(value), np.nan), ok
-        if func in ("min", "max"):
+        if func in ("min", "max", "first", "last"):
             return np.where(ok, value, 0), ok
         if func in ("stddev", "stddev_samp", "var", "var_samp"):
             return np.zeros(n), rows > 1

@@ -714,6 +714,14 @@ def _require_string_input(arr):
         raise PlanError("the function can only accept strings")
 
 
+def _exact1(fn):
+    def run(s, *rest):
+        if rest:
+            raise PlanError("function takes exactly one argument")
+        return fn(s)
+    return run
+
+
 def _fn_ascii(s):
     return ord(s[0]) if s else 0
 
@@ -891,6 +899,14 @@ def _fn_date_trunc(granularity, ns):
     return int(dt2.timestamp()) * _NS
 
 
+def _fn_from_unixtime(x):
+    if isinstance(x, (float, np.floating)) or isinstance(x, str):
+        # reference signature: from_unixtime(Int64) only
+        raise PlanError(
+            "from_unixtime does not support this input type (Int64 only)")
+    return int(x) * _NS
+
+
 def _fn_to_timestamp(x, scale_ns: int = 1):
     """String → ns (ISO-8601), or integer scaled by the unit variant
     (to_timestamp=ns, _seconds/_millis/_micros — DataFusion semantics)."""
@@ -907,6 +923,7 @@ def _register_time_scalars():
 
     Func._FUNCS.update({
         "now": lambda xp: int(_time.time() * 1e9),
+
         "current_timestamp": lambda xp: int(_time.time() * 1e9),
         "current_date": lambda xp: datetime.now(timezone.utc)
         .strftime("%Y-%m-%d"),
@@ -916,7 +933,7 @@ def _register_time_scalars():
         "datepart": _scalar_first_obj(_fn_date_part),
         "date_trunc": _scalar_first_obj(_fn_date_trunc),
         "datetrunc": _scalar_first_obj(_fn_date_trunc),
-        "from_unixtime": _obj_func(lambda x: int(x) * _NS),
+        "from_unixtime": _obj_func(_fn_from_unixtime),
         "to_timestamp": _obj_func(_fn_to_timestamp),
         "to_timestamp_seconds": _obj_func(
             lambda x: _fn_to_timestamp(x, _NS) if not isinstance(x, str)
@@ -1060,9 +1077,11 @@ def _register_tsfuncs():
         "lower": _str_func(str.lower),
         "length": _str_func(len, out=np.int64),
         "char_length": _str_func(len, out=np.int64),
-        "trim": _str_func(str.strip),
-        "ltrim": _str_func(str.lstrip),
-        "rtrim": _str_func(str.rstrip),
+        # trim family takes exactly ONE argument (reference: the charset
+        # form is btrim; trim('a','b') errors)
+        "trim": _str_func(_exact1(str.strip)),
+        "ltrim": _str_func(_exact1(str.lstrip)),
+        "rtrim": _str_func(_exact1(str.rstrip)),
         "reverse": _str_func(lambda s: s[::-1]),
         "substr": _str_func(_fn_substr),
         "substring": _str_func(_fn_substr),
@@ -1081,6 +1100,8 @@ def _register_tsfuncs():
         "octet_length": _str_func(lambda s: len(s.encode()), out=np.int64),
         "character_length": _str_func(len, out=np.int64),
         "btrim": _str_func(lambda s, *c: s.strip(*c)),
+        "ltrim_chars": _str_func(lambda s, c: s.lstrip(c)),
+        "rtrim_chars": _str_func(lambda s, c: s.rstrip(c)),
         "initcap": _str_func(_fn_initcap),
         "left": _str_func(_fn_left),
         "right": _str_func(_fn_right),

@@ -28,7 +28,7 @@ _TOKEN_RE = re.compile(
   | (?P<string>'(?:[^']|'')*')
   | (?P<qident>"(?:[^"]|"")*")
   | (?P<ident>[A-Za-z_][A-Za-z0-9_]*)
-  | (?P<op><>|!=|<=|>=|\|\||<|>|=|\+|-|\*|/|%|\(|\)|,|\.|;)
+  | (?P<op><>|!=|<=|>=|\|\||<|>|=|\+|-|\*|/|%|\(|\)|\[|\]|,|\.|;)
     """,
     re.VERBOSE | re.DOTALL,
 )
@@ -1288,13 +1288,15 @@ class Parser:
             if k == "TIMESTAMP":
                 self.next()
                 return Literal(parse_timestamp_string(self.expect_string()))
-            if k == "NOW" :
+            if k == "DATE" and self.tokens[self.i + 1].kind == "string":
+                # DATE '2024-08-08' keeps its date STRING identity (the
+                # reference renders Date32 as ISO); comparisons against
+                # time normalize the string to ns in the planner
                 self.next()
-                self.expect_op("(")
-                self.expect_op(")")
-                import time as _time
+                s = self.expect_string()
+                parse_timestamp_string(s)   # validate eagerly
+                return Literal(s)
 
-                return Literal(int(_time.time() * 1e9))
             if k in ("CAST", "TRY_CAST"):
                 self.next()
                 self.expect_op("(")
@@ -1310,6 +1312,24 @@ class Parser:
                 from .expr import Cast
 
                 return Cast(e, tname, safe=(k == "TRY_CAST"))
+            if k == "ARRAY" and self._peek_op_at(1) == "[":
+                # ARRAY[1, 2, 3] → rendered list literal (reference via
+                # DataFusion list arrays; displays as [1, 2, 3])
+                self.next()
+                self.expect_op("[")
+                vals = []
+                if not self.accept_op("]"):
+                    vals.append(self.parse_literal_value())
+                    while self.accept_op(","):
+                        vals.append(self.parse_literal_value())
+                    self.expect_op("]")
+                def _el(v):
+                    if isinstance(v, bool):
+                        return "true" if v else "false"
+                    if isinstance(v, float):
+                        return repr(v)
+                    return str(v)
+                return Literal("[" + ", ".join(_el(v) for v in vals) + "]")
             if k == "EXISTS":
                 self.next()
                 self.expect_op("(")
@@ -1318,6 +1338,21 @@ class Parser:
                 from .expr import Exists
 
                 return Exists(sub)
+            if k == "TRIM" and self._peek_op_at(1) == "(" \
+                    and self._peek_kw_at(2) in ("BOTH", "LEADING",
+                                                "TRAILING"):
+                # TRIM([BOTH|LEADING|TRAILING] chars FROM s) — standard
+                # form (reference via sqlparser)
+                self.next()
+                self.expect_op("(")
+                side = self.expect_kw("BOTH", "LEADING", "TRAILING")
+                chars = self.parse_expr()
+                self.expect_kw("FROM")
+                s = self.parse_expr()
+                self.expect_op(")")
+                fname = {"BOTH": "btrim", "LEADING": "ltrim_chars",
+                         "TRAILING": "rtrim_chars"}[side]
+                return Func(fname, [s, chars])
             if k == "EXTRACT" and self._peek_op_at(1) == "(":
                 # EXTRACT(field FROM expr) → date_part('field', expr)
                 self.next()

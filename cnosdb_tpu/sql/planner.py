@@ -34,7 +34,7 @@ _NUMERIC_ONLY_AGGS = {"sum", "avg", "mean", "median", "stddev",
                       "covar_samp", "approx_median",
                       "approx_percentile_cont",
                       "approx_percentile_cont_with_weight",
-                      "increase", "sample"}
+                      "increase", "gauge_agg"}
 
 # two-column statistical aggregates (reference statistical_agg/*.rs)
 _TWO_COL_AGGS = {"corr", "covar", "covar_pop", "covar_samp"}
@@ -129,23 +129,50 @@ def _join_and(es: list[Expr]) -> Expr | None:
     return out
 
 
+def _is_time_valued(e: Expr) -> bool:
+    """Expressions statically known to be timestamps: the time column
+    and the timestamp-returning scalars (now()/to_timestamp family)."""
+    if _is_time_col(e):
+        return True
+    return isinstance(e, Func) and e.name.lower() in (
+        "now", "current_timestamp", "to_timestamp",
+        "to_timestamp_seconds", "to_timestamp_millis",
+        "to_timestamp_micros", "from_unixtime", "date_trunc")
+
+
+def _fold_now(e: Expr) -> Expr:
+    """now()/current_timestamp fold to a constant at plan time (so time
+    ranges still prune; the reference folds via DataFusion's
+    simplify_expressions)."""
+    if isinstance(e, Func) and not e.args and e.name.lower() in (
+            "now", "current_timestamp"):
+        import time as _time
+
+        return Literal(int(_time.time() * 1e9))
+    return e
+
+
 def _normalize_time_literals(e: Expr) -> Expr:
-    """Rewrite string literals compared against `time` into ns ints."""
+    """Rewrite string literals compared against `time` (or a timestamp-
+    valued expression, e.g. now()) into ns ints."""
+    e = _fold_now(e)
     if isinstance(e, BinOp):
         l, r = _normalize_time_literals(e.left), _normalize_time_literals(e.right)
         if e.op in ("=", "!=", "<", "<=", ">", ">="):
-            if _is_time_col(l) and isinstance(r, Literal) and isinstance(r.value, str):
+            if _is_time_valued(l) and isinstance(r, Literal) \
+                    and isinstance(r.value, str):
                 r = Literal(parse_timestamp_string(r.value))
-            if _is_time_col(r) and isinstance(l, Literal) and isinstance(l.value, str):
+            if _is_time_valued(r) and isinstance(l, Literal) \
+                    and isinstance(l.value, str):
                 l = Literal(parse_timestamp_string(l.value))
         return BinOp(e.op, l, r)
-    if isinstance(e, Between) and _is_time_col(e.expr):
-        lo, hi = e.low, e.high
+    if isinstance(e, Between) and _is_time_valued(e.expr):
+        lo, hi = _fold_now(e.low), _fold_now(e.high)
         if isinstance(lo, Literal) and isinstance(lo.value, str):
             lo = Literal(parse_timestamp_string(lo.value))
         if isinstance(hi, Literal) and isinstance(hi.value, str):
             hi = Literal(parse_timestamp_string(hi.value))
-        return Between(e.expr, lo, hi, e.negated)
+        return Between(_fold_now(e.expr), lo, hi, e.negated)
     if isinstance(e, UnaryOp):
         return UnaryOp(e.op, _normalize_time_literals(e.operand))
     return e
@@ -318,8 +345,10 @@ class _AggCollector:
         args = [a for a in f.args
                 if not (isinstance(a, Literal) and a.value == "__distinct__")]
         param = None
+        ts_stripped = False
         if (name in TS_PAIR_AGGS or name in ("first", "last")) \
                 and len(args) == 2:
+            ts_stripped = True
             if not (isinstance(args[0], Column) and args[0].name == TIME_COL):
                 raise PlanError(
                     f"{name}(time, value): first argument must be the time "
@@ -388,11 +417,16 @@ class _AggCollector:
                 col = None
         elif name in ("sum", "avg", "mean", "min", "max", "median",
                       "stddev", "stddev_samp", "stddev_pop", "var",
-                      "var_samp", "var_pop") and args \
-                and isinstance(args[0], Literal):
+                      "var_samp", "var_pop", "first", "last") and args \
+                and isinstance(args[0], Literal) \
+                and args[0].value != "*":
             # aggregate over a CONSTANT (reference: avg(3) → 3.0): ride
-            # the row count, finalize from the constant
-            if args[0].value is None:
+            # the row count, finalize from the constant. A NULL constant
+            # is rejected EXCEPT for first/last(time, NULL), which yield
+            # NULL (reference last.slt).
+            if args[0].value is None and not (
+                    name in ("first", "last") and ts_stripped):
+                # NULL constants reject except first/last(time, NULL)
                 raise PlanError(f"{name}(NULL) is not supported")
             param = args[0].value
             name, col = "const_agg:" + name, None

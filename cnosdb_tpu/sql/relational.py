@@ -498,6 +498,15 @@ def host_aggregate(func: str, col, gid: np.ndarray, n_groups: int,
                                           side="left"))
                 out[k] = float(xs[min(idx, len(xs) - 1)])
         return out
+    if func == "sample":
+        from . import tsfuncs
+
+        out = np.full(n_groups, None, dtype=object)
+        for k_ in np.unique(g):
+            out[k_] = tsfuncs.sample(v[g == k_],
+                                     int(param) if param is not None
+                                     else 1)
+        return out
     if func == "array_agg":
         out = np.full(n_groups, None, dtype=object)
         for k in np.unique(g):
@@ -631,9 +640,10 @@ def collect_aggs(e, agg_names: set) -> list:
 # ---------------------------------------------------------------------------
 # window functions
 # ---------------------------------------------------------------------------
-_RANKERS = {"row_number", "rank", "dense_rank"}
+_RANKERS = {"row_number", "rank", "dense_rank", "percent_rank",
+            "cume_dist"}
 _OFFSETS = {"lag", "lead"}
-_VALUES = {"first_value", "last_value"}
+_VALUES = {"first_value", "last_value", "nth_value"}
 _WINAGGS = {"sum", "avg", "mean", "min", "max", "count"}
 
 WINDOW_FUNCS = _RANKERS | _OFFSETS | _VALUES | _WINAGGS
@@ -667,30 +677,66 @@ def eval_window(wf: WindowFunc, env: dict, n: int) -> np.ndarray:
     out = np.empty(n, dtype=np.float64)
 
     def ordered_vals(e: Expr):
-        return np.asarray(e.eval(env, np))[perm]
+        v = np.asarray(e.eval(env, np))
+        if v.shape == ():
+            v = np.full(n, v[()])
+        return v[perm]
 
     if name in _RANKERS:
-        if not wf.order_by:
-            raise PlanError(f"{name}() requires ORDER BY in OVER()")
-        keys = [ordered_vals(e) for e, _ in wf.order_by]
-        res = np.empty(n, dtype=np.int64)
+        if wf.args and not (len(wf.args) == 1 and getattr(
+                wf.args[0], "value", None) == "*"):
+            raise PlanError(f"{name}() takes no arguments")
+        # without ORDER BY the input order ranks (reference accepts
+        # row_number() OVER (); every row is then its own peer group)
+        keys = [ordered_vals(e) for e, _ in wf.order_by] \
+            if wf.order_by else []
+        res = np.empty(n, dtype=np.float64) \
+            if name in ("percent_rank", "cume_dist") \
+            else np.empty(n, dtype=np.int64)
         for s, e_ in zip(starts, ends):
+            cnt = e_ - s
             if name == "row_number":
-                res[perm[s:e_]] = np.arange(1, e_ - s + 1)
+                res[perm[s:e_]] = np.arange(1, cnt + 1)
+                continue
+            if name == "cume_dist":
+                # rows ≤ current (peers count together)
+                i = s
+                while i < e_:
+                    j = i
+                    while j + 1 < e_ and all(
+                            np.array_equal(k[j + 1], k[i]) for k in keys):
+                        j += 1
+                    for t in range(i, j + 1):
+                        res[perm[t]] = (j + 1 - s) / cnt
+                    i = j + 1
                 continue
             r = d = 1
             for i in range(s, e_):
-                if i > s and not all(
+                if i > s and keys and not all(
                         np.array_equal(k[i], k[i - 1]) for k in keys):
                     r = (i - s) + 1
                     d += 1
-                res[perm[i]] = r if name == "rank" else d
+                if name == "percent_rank":
+                    res[perm[i]] = 0.0 if cnt <= 1 else (r - 1) / (cnt - 1)
+                else:
+                    res[perm[i]] = r if name == "rank" else d
         return res
 
     if name in _OFFSETS:
         src = ordered_vals(wf.args[0])
-        offset = int(wf.args[1].eval({}, np)) if len(wf.args) > 1 else 1
-        default = wf.args[2].eval({}, np) if len(wf.args) > 2 else None
+        try:
+            offset = int(wf.args[1].eval({}, np)) if len(wf.args) > 1 \
+                else 1
+        except (TypeError, ValueError):
+            # a non-numeric offset degrades to the default of 1 (the
+            # reference's cast produces the default: lag.slt pins
+            # LAG(v, 'invalid_offset', 0) ≡ LAG(v, 1, 0))
+            offset = 1
+        default = None
+        if len(wf.args) > 2:
+            default = wf.args[2].eval({}, np)
+            if hasattr(default, "item"):
+                default = default.item()
         shift = offset if name == "lag" else -offset
         res = np.empty(n, dtype=object)
         for s, e_ in zip(starts, ends):
@@ -704,9 +750,29 @@ def eval_window(wf: WindowFunc, env: dict, n: int) -> np.ndarray:
 
     if name in _VALUES:
         src = ordered_vals(wf.args[0])
-        res = np.empty(n, dtype=object if src.dtype == object else src.dtype)
+        # frame semantics (reference/standard SQL): with ORDER BY the
+        # default frame is UNBOUNDED PRECEDING..CURRENT ROW ('cum'),
+        # without it the whole partition; ROWS BETWEEN overrides
+        frame = wf.frame or ("cum" if wf.order_by else "full")
+        nth = None
+        if name == "nth_value":
+            if len(wf.args) < 2:
+                raise PlanError("nth_value takes (expr, n)")
+            nth = int(np.asarray(wf.args[1].eval(env, np)).reshape(-1)[0])
+            if nth <= 0:
+                raise PlanError("nth_value position must be positive")
+        res = np.empty(n, dtype=object)
         for s, e_ in zip(starts, ends):
-            res[perm[s:e_]] = src[s] if name == "first_value" else src[e_ - 1]
+            for i in range(s, e_):
+                lo = s if frame in ("cum", "full") else i
+                hi = (i + 1) if frame == "cum" else e_
+                if name == "first_value":
+                    v = src[lo]
+                elif name == "last_value":
+                    v = src[hi - 1]
+                else:   # nth_value
+                    v = src[lo + nth - 1] if (hi - lo) >= nth else None
+                res[perm[i]] = v
         return res
 
     if name in _WINAGGS:

@@ -38,14 +38,20 @@ NS = 1_000_000_000
 # ---------------------------------------------------------------------------
 def increase(ts: np.ndarray, vals: np.ndarray) -> float | None:
     """Counter increase with reset handling (increase.rs:98-103): a drop
-    means the counter restarted, so the post-reset value is the delta."""
+    means the counter restarted, so the post-reset value is the delta.
+    Integer inputs stay integer (reference: increase(Int64) renders 7,
+    not 7.0)."""
     if len(vals) == 0:
         return None
+    integral = all(isinstance(x, (int, np.integer))
+                   and not isinstance(x, (bool, np.bool_))
+                   for x in np.asarray(vals).tolist())
     v = np.asarray(vals, dtype=np.float64)
     if len(v) == 1:
-        return 0.0
+        return 0 if integral else 0.0
     d = np.diff(v)
-    return float(np.where(d > 0, d, np.where(d < 0, v[1:], 0.0)).sum())
+    out = float(np.where(d > 0, d, np.where(d < 0, v[1:], 0.0)).sum())
+    return int(out) if integral else out
 
 
 # ---------------------------------------------------------------------------
@@ -55,8 +61,9 @@ def sample(vals: np.ndarray, k: int) -> list:
     """k-reservoir sample (sample.rs). Deterministic seed per call keeps
     query results reproducible across replicas."""
     n = len(vals)
-    if k <= 0:
-        raise FunctionError("sample size must be positive")
+    if k <= 0 or k > 2000:
+        # reference bound: sample size in (0, 2000] (sample.slt)
+        raise FunctionError("sample size must be in (0, 2000]")
 
     def plain(x):
         return x.item() if hasattr(x, "item") else x

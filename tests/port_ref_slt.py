@@ -113,8 +113,14 @@ def parse_ref_slt(path: str) -> list:
             continue
         if line.startswith("sleep") or line == "halt":
             continue
-        if line.startswith("--#DATABASE="):
+        if line.startswith("--#DATABASE"):
             out.append(("use", line.split("=", 1)[1].strip()))
+            continue
+        if line.startswith("--#TENANT"):
+            out.append(("usetenant", line.split("=", 1)[1].strip()))
+            continue
+        if line.startswith("--#USER_NAME"):
+            out.append(("useuser", line.split("=", 1)[1].strip()))
             continue
         if line.startswith("--#"):
             continue
@@ -123,8 +129,12 @@ def parse_ref_slt(path: str) -> list:
             sql_lines = []
             while i < n and lines[i].strip() != "":
                 s = lines[i].strip()
-                if s.startswith("--#DATABASE="):
+                if s.startswith("--#DATABASE"):
                     out.append(("use", s.split("=", 1)[1].strip()))
+                elif s.startswith("--#TENANT"):
+                    out.append(("usetenant", s.split("=", 1)[1].strip()))
+                elif s.startswith("--#USER_NAME"):
+                    out.append(("useuser", s.split("=", 1)[1].strip()))
                 elif s == "--#LP_BEGIN":
                     i += 1
                     while i < n and lines[i].strip() != "--#LP_END":
@@ -185,6 +195,10 @@ def convert_file(path: str, seen=None) -> list[str]:
             out_lines.extend(convert_file(inc, seen))
         elif kind == "use":
             out_lines.append(f"usedb {payload}")
+        elif kind == "usetenant":
+            out_lines.append(f"usetenant {payload}")
+        elif kind == "useuser":
+            out_lines.append(f"useuser {payload}")
         elif kind == "lineproto":
             out_lines.append(f"lineproto {payload}")
         elif kind == "ok":

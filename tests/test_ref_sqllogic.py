@@ -39,6 +39,8 @@ def _parse(path):
                              ("error", "statement error "),
                              ("lineproto", "lineproto "),
                              ("cleandir", "cleandir "),
+                             ("usetenant", "usetenant "),
+                             ("useuser", "useuser "),
                              ("use", "usedb ")):
             if line.startswith(prefix):
                 blocks.append((kind, line[len(prefix):], None, i))
@@ -101,6 +103,10 @@ def test_ref_sqllogic(case, tmp_path):
 
                 batch = parse_lines(sql, Precision.parse("ns"))
                 coord.write_points(session.tenant, session.database, batch)
+            elif kind == "usetenant":
+                session.tenant = sql
+            elif kind == "useuser":
+                session.user = sql
             elif kind == "use":
                 try:
                     ex.execute_one(f"CREATE DATABASE IF NOT EXISTS {sql}",
