@@ -1311,7 +1311,10 @@ class QueryExecutor:
                 if args and isinstance(args[0], Literal) \
                         and args[0].value == "__distinct__":
                     args = args[1:]
-                if args and not isinstance(args[0], (Column, Literal)):
+                if any(not isinstance(a, (Column, Literal))
+                       for a in args):
+                    # computed argument ANYWHERE (corr(f1, -f1)): the
+                    # relational path evaluates expressions
                     return True
         return False
 
@@ -2607,7 +2610,9 @@ def _apply_finalizer(spec, parts: dict):
             return 0.0 if rows > 1 else None
         if func in ("stddev_pop", "var_pop"):
             return 0.0
-        return None
+        if func == "zero":
+            return 0.0
+        return None   # const_agg:null and unknown constants → NULL
     if kind == "series":
         chunks = parts.get(spec[2])
         if not chunks:
@@ -2688,6 +2693,10 @@ def _vector_finalize(spec, parts_env: dict, n: int):
             return np.zeros(n), rows > 1
         if func in ("stddev_pop", "var_pop"):
             return np.zeros(n), ok
+        if func == "zero":
+            return np.zeros(n), ok
+        if func == "null":
+            return np.full(n, None, dtype=object), np.zeros(n, dtype=bool)
         raise ExecutionError(f"bad const_agg {func!r}")
     if kind == "pass":
         return col(spec[1])

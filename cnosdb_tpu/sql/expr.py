@@ -624,15 +624,27 @@ def _str_func(fn, *, out=object, strict=True):
                                   out_dtype=out if out is not object
                                   else object)
         if isinstance(arr, _np.ndarray):
-            vals = [None if x is None else fn(str(x), *rest) for x in arr]
+            vals = [None if x is None else fn(_str_coerce(x), *rest)
+                    for x in arr]
             if out is object:
                 o = _np.empty(len(vals), dtype=object)
                 o[:] = vals
                 return o
             return _np.array([out() if v is None else v for v in vals],
                              dtype=out)
-        return None if arr is None else fn(str(arr), *rest)
+        return None if arr is None else fn(_str_coerce(arr), *rest)
     return run
+
+
+def _str_coerce(x) -> str:
+    """Implicit cast-to-string for the LENIENT string functions: bools
+    render '1'/'0' (matching CAST(bool AS STRING) — ascii(f2) over a
+    BOOLEAN column yields 49/48 in the reference)."""
+    if isinstance(x, (bool, np.bool_)):
+        return "1" if x else "0"
+    if isinstance(x, (float, np.floating)):
+        return repr(float(x))
+    return str(x)
 
 
 def _fn_substr(s, start, length=None):

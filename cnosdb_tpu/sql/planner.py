@@ -369,12 +369,21 @@ class _AggCollector:
             param = int(args[1].value)
             args = args[:1]
         if name in _TWO_COL_AGGS:
-            if len(args) != 2 or not all(isinstance(a, Column)
-                                         for a in args):
+            if len(args) == 2 and all(isinstance(a, Literal)
+                                      and a.value is not None
+                                      for a in args):
+                # constants have zero variance: corr/covar → 0.0 when
+                # rows exist (reference corr.slt: corr(1, 2) → 0.0)
+                param = 0.0
+                name, col = "const_agg:zero", None
+                args = []
+            elif len(args) != 2 or not all(isinstance(a, Column)
+                                           for a in args):
                 raise PlanError(
                     f"{name}(x, y) takes exactly two columns")
-            param = args[1].name
-            args = args[:1]
+            else:
+                param = args[1].name
+                args = args[:1]
         if name == "approx_percentile_cont":
             if len(args) != 2 or not isinstance(args[1], Literal):
                 raise PlanError(
@@ -430,6 +439,8 @@ class _AggCollector:
                 raise PlanError(f"{name}(NULL) is not supported")
             param = args[0].value
             name, col = "const_agg:" + name, None
+        elif name.startswith("const_agg:"):
+            pass   # already resolved to a constant aggregate above
         else:
             if not args or not isinstance(args[0], Column):
                 raise PlanError(f"aggregate argument must be a column: {f.to_sql()}")
@@ -457,7 +468,8 @@ class _AggCollector:
             check_cols = [col] if col is not None else []
             if name in _TWO_COL_AGGS and isinstance(param, str):
                 check_cols.append(param)
-            if isinstance(param, tuple):   # percentile weight column
+            if isinstance(param, tuple) and name.startswith(
+                    "approx_percentile"):   # percentile weight column
                 check_cols.append(param[0])
             for cc in check_cols:
                 if cc == TIME_COL:
@@ -467,6 +479,15 @@ class _AggCollector:
                 if not self.schema.contains_column(cc):
                     raise PlanError(f"unknown column {cc!r} in {name}")
                 c = self.schema.column(cc)
+                if not c.column_type.is_tag \
+                        and c.column_type.value_type in (
+                            ValueType.STRING, ValueType.GEOMETRY) \
+                        and name in _TWO_COL_AGGS:
+                    # corr/covar over a string FIELD yield NULL
+                    # (reference corr.slt/covar.slt); tags still error
+                    name, col = "const_agg:null", None
+                    param = None
+                    break
                 if c.column_type.is_tag or c.column_type.value_type in (
                         ValueType.STRING, ValueType.GEOMETRY):
                     raise PlanError(

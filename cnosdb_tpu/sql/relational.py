@@ -723,20 +723,48 @@ def eval_window(wf: WindowFunc, env: dict, n: int) -> np.ndarray:
         return res
 
     if name in _OFFSETS:
+        if len(wf.args) > 3:
+            raise PlanError(
+                f"{name} takes at most 3 arguments (value, offset, "
+                f"default)")
         src = ordered_vals(wf.args[0])
-        try:
-            offset = int(wf.args[1].eval({}, np)) if len(wf.args) > 1 \
-                else 1
-        except (TypeError, ValueError):
-            # a non-numeric offset degrades to the default of 1 (the
-            # reference's cast produces the default: lag.slt pins
-            # LAG(v, 'invalid_offset', 0) ≡ LAG(v, 1, 0))
-            offset = 1
+        offset = 1
+        if len(wf.args) > 1:
+            try:
+                ov = wf.args[1].eval({}, np)
+                if not isinstance(ov, (bool, np.bool_)) \
+                        and float(ov) == int(ov):
+                    # 2.5 / booleans degrade like a bad string would
+                    offset = int(ov)
+            except (TypeError, ValueError):
+                pass
+            # non-integral / non-numeric offsets degrade to the default
+            # of 1 (reference lag.slt: 'invalid_offset' and 2.5 both
+            # behave as LAG(v, 1, ...))
         default = None
         if len(wf.args) > 2:
             default = wf.args[2].eval({}, np)
             if hasattr(default, "item"):
                 default = default.item()
+            # the default must match the value column's type family
+            # (reference lag.slt/lead.slt: bool/str vs numeric and float
+            # vs Int64 all error)
+            src_probe = np.asarray(wf.args[0].eval(env, np))
+            num_kind = src_probe.dtype.kind in "iuf" or (
+                src_probe.dtype == object and any(
+                    isinstance(x, (int, float))
+                    and not isinstance(x, bool)
+                    for x in src_probe if x is not None))
+            int_kind = src_probe.dtype.kind in "iu" or (
+                src_probe.dtype == object and all(
+                    isinstance(x, (int, np.integer))
+                    and not isinstance(x, bool)
+                    for x in src_probe if x is not None))
+            if isinstance(default, bool) \
+                    or (isinstance(default, str) and num_kind) \
+                    or (isinstance(default, float) and int_kind):
+                raise PlanError(
+                    "lag/lead default must match the value type")
         shift = offset if name == "lag" else -offset
         res = np.empty(n, dtype=object)
         for s, e_ in zip(starts, ends):
@@ -749,6 +777,10 @@ def eval_window(wf: WindowFunc, env: dict, n: int) -> np.ndarray:
         return res
 
     if name in _VALUES:
+        if name in ("first_value", "last_value") and len(wf.args) != 1:
+            raise PlanError(f"{name} takes exactly one argument")
+        if name == "nth_value" and len(wf.args) != 2:
+            raise PlanError("nth_value takes (expr, n)")
         src = ordered_vals(wf.args[0])
         # frame semantics (reference/standard SQL): with ORDER BY the
         # default frame is UNBOUNDED PRECEDING..CURRENT ROW ('cum'),
@@ -758,9 +790,15 @@ def eval_window(wf: WindowFunc, env: dict, n: int) -> np.ndarray:
         if name == "nth_value":
             if len(wf.args) < 2:
                 raise PlanError("nth_value takes (expr, n)")
-            nth = int(np.asarray(wf.args[1].eval(env, np)).reshape(-1)[0])
-            if nth <= 0:
-                raise PlanError("nth_value position must be positive")
+            n_raw = np.asarray(wf.args[1].eval(env, np)).reshape(-1)[0]
+            if isinstance(n_raw, (float, np.floating)) \
+                    and float(n_raw) != int(n_raw):
+                raise PlanError("nth_value expects an integer n")
+            nth = int(n_raw)
+            if nth == 0:
+                # n = 0 errors; NEGATIVE n yields NULL rows (reference
+                # nth_value.slt pins both behaviors)
+                raise PlanError("nth_value expects n > 0")
         res = np.empty(n, dtype=object)
         for s, e_ in zip(starts, ends):
             for i in range(s, e_):
@@ -771,7 +809,8 @@ def eval_window(wf: WindowFunc, env: dict, n: int) -> np.ndarray:
                 elif name == "last_value":
                     v = src[hi - 1]
                 else:   # nth_value
-                    v = src[lo + nth - 1] if (hi - lo) >= nth else None
+                    v = src[lo + nth - 1] \
+                        if nth > 0 and (hi - lo) >= nth else None
                 res[perm[i]] = v
         return res
 

@@ -99,12 +99,36 @@ def gauge_delta(g: dict) -> float:
     return g["last"][1] - g["first"][1]
 
 
-def gauge_time_delta(g: dict) -> int:
+def format_interval_ns(ns: int) -> str:
+    """Arrow IntervalMonthDayNano rendering: '0 years 0 mons 0 days
+    0 hours 0 mins 0.005 secs' (reference renders time_delta this
+    way)."""
+    neg = ns < 0
+    ns = abs(int(ns))
+    days, rem = divmod(ns, 86_400_000_000_000)
+    hours, rem = divmod(rem, 3_600_000_000_000)
+    mins, rem = divmod(rem, 60_000_000_000)
+    secs = rem / 1e9
+    sign = "-" if neg else ""
+    sec_txt = f"{secs:.9f}".rstrip("0").rstrip(".")
+    if "." not in sec_txt and not sec_txt:
+        sec_txt = "0"
+    return (f"{sign}0 years 0 mons {days} days {hours} hours "
+            f"{mins} mins {sec_txt} secs")
+
+
+def gauge_time_delta(g: dict) -> str:
+    """Interval between first and last sample, rendered in arrow's
+    interval format (gauge/time_delta.rs returns an Interval)."""
+    return format_interval_ns(g["last"][0] - g["first"][0])
+
+
+def _gauge_time_delta_ns(g: dict) -> int:
     return g["last"][0] - g["first"][0]
 
 
 def gauge_rate(g: dict) -> float | None:
-    td = gauge_time_delta(g)
+    td = _gauge_time_delta_ns(g)
     if td == 0:
         return None
     return gauge_delta(g) / float(td)

@@ -51,7 +51,9 @@ def test_gauge_agg_accessors(db):
     row = [c[0] for c in rs.columns]
     assert row[0] == 3.0                 # last - first (gauge/mod.rs:44)
     assert abs(row[1] - 0.75) < 1e-12    # delta / time_delta
-    assert row[2] == 4
+    # interval rendering (arrow IntervalMonthDayNano, 4ns span)
+    assert row[2] == ("0 years 0 mons 0 days 0 hours 0 mins "
+                      "0.000000004 secs")
     assert row[3] == 1.0 and row[4] == 4.0
     assert row[5] == 4.0                 # second - first
     assert row[6] == 2.0                 # last - penultimate
