@@ -31,6 +31,7 @@ def analyze(stmt):
     stmt = rewrite_null_functions(stmt)
     stmt = rewrite_selector_functions(stmt)
     stmt = _normalize_time_comparisons(stmt)
+    stmt = _wrap_time_string_args(stmt)
     _reject_time_in_numeric_funcs(stmt)
     return stmt
 
@@ -66,6 +67,33 @@ _NUMERIC_FUNCS = {
     "acos", "atan", "asinh", "acosh", "atanh", "atan2", "pow", "power",
     "signum", "trunc", "radians", "degrees", "gcd", "lcm",
 }
+
+
+# positions whose time-column args see the ISO string form (reference
+# implicit Timestamp→Utf8 casts; None = every position)
+_TIME_AS_STRING_FUNCS = {"ascii": None, "concat": None, "concat_ws": None,
+                         "replace": {1, 2}, "strpos": {1},
+                         "translate": {1, 2}, "lpad": {2}, "rpad": {2},
+                         "split_part": {1}}
+
+
+def _wrap_time_string_args(stmt):
+    """Lenient string functions over the time column see the ISO form
+    (reference casts Timestamp→Utf8: ascii(TIME) is 49 — '1'…)."""
+    def rw(e):
+        if isinstance(e, Func) and e.name.lower() in _TIME_AS_STRING_FUNCS:
+            allowed = _TIME_AS_STRING_FUNCS[e.name.lower()]
+            new_args = []
+            for i, a in enumerate(e.args):
+                if isinstance(a, Column) and (
+                        a.name == "time" or a.name.endswith(".time")) \
+                        and (allowed is None or i in allowed):
+                    a = Func("__iso__", [a])
+                new_args.append(rw(a) if isinstance(a, Expr) else a)
+            return Func(e.name, new_args, e.agg_order)
+        return _map_children(e, rw)
+
+    return _map_stmt_exprs(stmt, rw)
 
 
 def _reject_time_in_numeric_funcs(stmt):

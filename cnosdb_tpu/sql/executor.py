@@ -799,6 +799,20 @@ class QueryExecutor:
 
         trs, tag_domains, residual = split_where(stmt.where, schema)
         if residual is not None:
+            # reference: non-constant expressions in a DELETE predicate
+            # are unimplemented ("operator || in delete statement" —
+            # cases/dml/delete.slt); only direct tag/time comparisons
+            from .expr import Func as _Func
+            from .expr import iter_child_exprs
+
+            def _no_funcs(e):
+                if isinstance(e, _Func):
+                    raise ExecutionError(
+                        f"function {e.name}() in a DELETE predicate is "
+                        "not supported")
+                for c in iter_child_exprs(e):
+                    _no_funcs(c)
+            _no_funcs(residual)
             dom_cols = set(tag_domains.domains) if not tag_domains.is_all else set()
             extra = residual.columns() - dom_cols - set(schema.tag_names())
             if extra:
@@ -1008,8 +1022,11 @@ class QueryExecutor:
             stmt = self._strip_table_qualifiers(stmt)
         if stmt.table is None:
             # constant SELECT (SELECT 1)
+            from .planner import validate_scalar_sigs_env
+
             names, cols = [], []
             for i, it in enumerate(stmt.items):
+                validate_scalar_sigs_env(it.expr, {})
                 v = it.expr.eval({}, np)
                 names.append(it.alias or it.expr.to_sql())
                 if isinstance(v, (bytes, bytearray)) or v is None:
@@ -1713,6 +1730,16 @@ class QueryExecutor:
                 and not rel.contains_window(where):
             pushed, where = where, None   # full pushdown into the base scan
         scope = self._materialize_from(item, session, pushed)
+        # schema-aware scalar signature checks over the materialized
+        # scope (the single-table path validates in plan_select)
+        from .planner import validate_scalar_sigs_env
+
+        for it in stmt.items:
+            if isinstance(it.expr, Expr):
+                validate_scalar_sigs_env(it.expr, scope.env)
+        for _e in (stmt.where, stmt.having):
+            if _e is not None:
+                validate_scalar_sigs_env(_e, scope.env)
         if where is not None:
             if rel.contains_window(where):
                 raise PlanError("window functions are not allowed in WHERE")

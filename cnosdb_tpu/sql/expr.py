@@ -553,7 +553,13 @@ class Func(Expr):
         "atan2": lambda xp, a, b: xp.arctan2(a, b),
         "pow": lambda xp, a, b: xp.power(a, b),
         "power": lambda xp, a, b: xp.power(a, b),
-        "signum": lambda xp, a: _math_float(xp, xp.sign(a)),
+        # reference signum(0) = 1.0 (math_function/signum.slt) — sign
+        # of the IEEE positive zero, not the three-valued sign
+        "signum": lambda xp, a: _math_float(
+            xp, xp.where(xp.isnan(a), a,
+                         xp.where(xp.asarray(a) >= 0, 1.0, -1.0))
+            if hasattr(a, "__len__") or hasattr(a, "shape")
+            else (float("nan") if a != a else (1.0 if a >= 0 else -1.0))),
         "trunc": lambda xp, a: xp.trunc(a),
         "radians": lambda xp, a: xp.radians(a),
         "degrees": lambda xp, a: xp.degrees(a),
@@ -609,9 +615,15 @@ def _str_func(fn, *, out=object, strict=True):
                             and r.shape != ()
                             else [r.item() if hasattr(r, "item") else r]
                             * n)
-            vals = [None if row[0] is None
-                    or any(x is None for x in row[1:])
-                    else fn(str(row[0]), *row[1:]) for row in zip(*cols)]
+            try:
+                vals = [None if row[0] is None
+                        or any(x is None for x in row[1:])
+                        else fn(str(row[0]), *row[1:])
+                        for row in zip(*cols)]
+            except TypeError as exc:
+                raise PlanError(
+                    f"no function matches the given argument types: "
+                    f"{exc}")
             if out is object:
                 o = _np.empty(len(vals), dtype=object)
                 o[:] = vals
@@ -619,20 +631,36 @@ def _str_func(fn, *, out=object, strict=True):
             return _np.array([out() if v is None else v for v in vals],
                              dtype=out)
         rest = [r.item() if hasattr(r, "item") else r for r in rest]
+        if any(r is None for r in rest):
+            # a NULL argument makes every row NULL (strict scalar
+            # semantics: replace(s, x, NULL) → NULL)
+            if isinstance(arr, (DictArray, _np.ndarray)):
+                n_ = len(arr)
+                o = _np.empty(n_, dtype=object)
+                o[:] = None
+                return o
+            return None
         if isinstance(arr, DictArray):
             return arr.map_values(lambda x: fn(str(x), *rest),
                                   out_dtype=out if out is not object
                                   else object)
-        if isinstance(arr, _np.ndarray):
-            vals = [None if x is None else fn(_str_coerce(x), *rest)
-                    for x in arr]
-            if out is object:
-                o = _np.empty(len(vals), dtype=object)
-                o[:] = vals
-                return o
-            return _np.array([out() if v is None else v for v in vals],
-                             dtype=out)
-        return None if arr is None else fn(_str_coerce(arr), *rest)
+        try:
+            if isinstance(arr, _np.ndarray):
+                vals = [None if x is None else fn(_str_coerce(x), *rest)
+                        for x in arr]
+                if out is object:
+                    o = _np.empty(len(vals), dtype=object)
+                    o[:] = vals
+                    return o
+                return _np.array([out() if v is None else v
+                                  for v in vals], dtype=out)
+            return None if arr is None else fn(_str_coerce(arr), *rest)
+        except TypeError as exc:
+            # mismatched argument types surface as plan errors, like the
+            # reference's "No function matches the given name and
+            # argument types"
+            raise PlanError(
+                f"no function matches the given argument types: {exc}")
     return run
 
 
@@ -650,10 +678,13 @@ def _str_coerce(x) -> str:
 def _fn_substr(s, start, length=None):
     """SQL substr: 1-based; a start < 1 consumes the length window before
     position 1 (PostgreSQL/DataFusion semantics)."""
-    start = int(start)
+    start = _int_n(start, "substr")
     if length is None:
         return s[max(0, start - 1):]
-    end = start + int(length)     # exclusive 1-based end
+    length = _int_n(length, "substr")
+    if length < 0:
+        raise PlanError("substr length must not be negative")
+    end = start + length                     # exclusive 1-based end
     lo = max(1, start)
     if end <= lo:
         return ""
@@ -661,8 +692,9 @@ def _fn_substr(s, start, length=None):
 
 
 def _fn_lpad(s, n, p=" "):
-    n = int(n)
-    if n <= len(s):
+    n = _int_n(n, "lpad")
+    p = _str_coerce(p)            # numeric pad coerces (reference:
+    if n <= len(s):               # rpad.slt pads with a bigint column)
         return s[:n]              # SQL lpad truncates to the target length
     if not p:
         return s
@@ -670,7 +702,8 @@ def _fn_lpad(s, n, p=" "):
 
 
 def _fn_rpad(s, n, p=" "):
-    n = int(n)
+    n = _int_n(n, "rpad")
+    p = _str_coerce(p)
     if n <= len(s):
         return s[:n]
     if not p:
@@ -688,11 +721,13 @@ def _fn_concat(xp, *parts):
              for p in parts]
     arrays = [p for p in parts if isinstance(p, _np.ndarray)]
     if not arrays:
-        return "".join("" if p is None else str(p) for p in parts)
+        return _cap_result("".join("" if p is None else _str_coerce(p)
+                                   for p in parts))
     n = len(arrays[0])
     cols = [p if isinstance(p, _np.ndarray) else [p] * n for p in parts]
     o = _np.empty(n, dtype=object)
-    o[:] = ["".join("" if v is None else str(v) for v in row)
+    o[:] = [_cap_result("".join("" if v is None else _str_coerce(v)
+                                for v in row))
             for row in zip(*cols)]
     return o
 
@@ -754,30 +789,36 @@ def _fn_initcap(s):
 
 
 def _fn_left(s, n):
-    n = int(n)
+    n = _int_n(n, "left")
     if n >= 0:
-        return s[:n]
-    return s[:max(0, len(s) + n)]
+        return _cap_result(s[:n])
+    return _cap_result(s[:max(0, len(s) + n)])
 
 
 def _fn_right(s, n):
-    n = int(n)
+    n = _int_n(n, "right")
     if n >= 0:
-        return s[max(0, len(s) - n):] if n else ""
-    return s[-n:]
+        return _cap_result(s[max(0, len(s) - n):] if n else "")
+    return _cap_result(s[-n:])
 
 
 def _fn_split_part(s, delim, n):
+    n = _int_n(n, "split_part")
+    if n <= 0:
+        # reference: field position must be greater than zero
+        # (query_server/sqllogicaltests/cases/function/string_func/
+        #  split_part.slt)
+        raise PlanError("split_part field position must be greater "
+                        "than zero")
+    delim = _str_coerce(delim)      # int delimiter coerces ('123')
+    if delim == "":
+        return ""           # reference renders empty, not an error
     parts = s.split(delim)
-    n = int(n)
-    if n > 0:
-        return parts[n - 1] if n <= len(parts) else ""
-    if n < 0:
-        return parts[n] if -n <= len(parts) else ""
-    raise PlanError("split_part field position must not be zero")
+    return parts[n - 1] if n <= len(parts) else ""
 
 
 def _fn_translate(s, src, dst):
+    src, dst = _str_coerce(src), _str_coerce(dst)
     table = {ord(c): (dst[i] if i < len(dst) else None)
              for i, c in enumerate(src)}
     return s.translate(table)
@@ -789,10 +830,76 @@ def _fn_md5(s):
     return hashlib.md5(s.encode()).hexdigest()
 
 
+def _fn_iso(x):
+    from datetime import datetime, timezone
+
+    ns = int(x)
+    secs, frac = divmod(ns, 1_000_000_000)
+    dt = datetime.fromtimestamp(secs, tz=timezone.utc)
+    base = dt.strftime("%Y-%m-%dT%H:%M:%S")
+    if frac:
+        digits = f"{frac:09d}"
+        # trim in 3-digit groups (ns -> us -> ms), matching arrow's
+        # timestamp rendering ('.010', not '.01')
+        while digits.endswith("000"):
+            digits = digits[:-3]
+        base += "." + digits
+    return base
+
+
+def _fn_chr(x):
+    n = int(x)
+    if n <= 0 or n > 0x10FFFF:
+        raise PlanError(f"chr() argument out of range: {n}")
+    return chr(n)
+
+
+def _int_n(v, what: str) -> int:
+    """Length/position arguments must be INTEGERS (reference errors on
+    LEFT('Hello', 2.7)); bools reject too."""
+    if isinstance(v, bool) or (isinstance(v, float) and v != int(v)):
+        raise PlanError(f"{what} requires an integer argument, got {v!r}")
+    return int(v)
+
+
+def _fn_repeat(s, n):
+    n = _int_n(n, "repeat")
+    if n < 0:
+        n = 0
+    if len(s) * n > (1 << 28):
+        raise PlanError("repeat result exceeds the 256MiB string limit")
+    return s * n
+
+
+def _cap_result(s: str) -> str:
+    """left/right/concat outputs are bounded at 2^22 bytes (the
+    reference errors on LEFT(huge, 10_000_000) and on a 4,294,305-char
+    CONCAT but passes REPEAT alone)."""
+    if len(s) > (1 << 22):
+        raise PlanError("string result exceeds the 4MiB limit")
+    return s
+
+
+class DateLit(Literal):
+    """DATE '2024-08-08': behaves as its ISO string everywhere except
+    scalar signature checks (reference: substr(DATE …) is a type error —
+    Date32 is not Utf8)."""
+
+
 def _fn_to_hex(x):
     v = int(x)
     # DataFusion to_hex renders the two's-complement i64 bit pattern
     return format(v & 0xFFFFFFFFFFFFFFFF, "x") if v < 0 else format(v, "x")
+
+
+def _to_hex_lift(xp, arr, *rest):
+    """to_hex(Int64): a bare NULL literal is untypable upstream and
+    errors; NULL column slots yield NULL."""
+    if rest:
+        raise PlanError("to_hex takes exactly one argument")
+    if arr is None:
+        raise PlanError("to_hex does not support a NULL literal")
+    return _obj_func(_fn_to_hex, numeric=False)(xp, arr)
 
 
 def _fn_concat_ws(xp, sep, *parts):
@@ -802,9 +909,23 @@ def _fn_concat_ws(xp, sep, *parts):
         raise PlanError("concat_ws takes a separator and at least one "
                         "argument")
 
+    if isinstance(sep, DictArray):
+        sep = sep.materialize()
+    if isinstance(sep, _np.ndarray) and sep.shape != ():
+        # column-valued separator: per-row join (reference:
+        # concat_ws(f0, f0) joins each row with its own value)
+        parts = [p.materialize() if isinstance(p, DictArray) else p
+                 for p in parts]
+        n = len(sep)
+        cols = [p if isinstance(p, _np.ndarray) else [p] * n
+                for p in parts]
+        o = _np.empty(n, dtype=object)
+        o[:] = [None if s is None else
+                _cap_result(_str_coerce(s).join(
+                    _str_coerce(v) for v in row if v is not None))
+                for s, *row in zip(sep, *cols)]
+        return o
     sep_v = sep.item() if hasattr(sep, "item") else sep
-    if isinstance(sep_v, _np.ndarray):
-        raise PlanError("concat_ws separator must be a scalar")
     if sep_v is None:
         # NULL separator → NULL result (PostgreSQL/DataFusion)
         arrs = [p for p in parts if isinstance(p, _np.ndarray)]
@@ -817,12 +938,13 @@ def _fn_concat_ws(xp, sep, *parts):
              for p in parts]
     arrays = [p for p in parts if isinstance(p, _np.ndarray)]
     if not arrays:
-        vals = [str(p) for p in parts if p is not None]
-        return str(sep_v).join(vals)
+        vals = [_str_coerce(p) for p in parts if p is not None]
+        return _cap_result(str(sep_v).join(vals))
     n = len(arrays[0])
     cols = [p if isinstance(p, _np.ndarray) else [p] * n for p in parts]
     o = _np.empty(n, dtype=object)
-    o[:] = [str(sep_v).join(str(v) for v in row if v is not None)
+    o[:] = [_cap_result(str(sep_v).join(_str_coerce(v) for v in row
+                                        if v is not None))
             for row in zip(*cols)]
     return o
 
@@ -920,12 +1042,16 @@ def _fn_from_unixtime(x):
 
 
 def _fn_to_timestamp(x, scale_ns: int = 1):
-    """String → ns (ISO-8601), or integer scaled by the unit variant
-    (to_timestamp=ns, _seconds/_millis/_micros — DataFusion semantics)."""
+    """String → ns (ISO-8601), or INTEGER scaled by the unit variant
+    (to_timestamp=ns, _seconds/_millis/_micros — DataFusion signatures
+    reject Float64)."""
     if isinstance(x, str):
         from .parser import parse_timestamp_string
 
         return parse_timestamp_string(x)
+    if isinstance(x, (float, np.floating)):
+        raise PlanError(
+            "to_timestamp does not support Float64 input")
     return int(x) * scale_ns
 
 
@@ -1089,24 +1215,37 @@ def _register_tsfuncs():
         "lower": _str_func(str.lower),
         "length": _str_func(len, out=np.int64),
         "char_length": _str_func(len, out=np.int64),
-        # trim family takes exactly ONE argument (reference: the charset
-        # form is btrim; trim('a','b') errors)
+        # TRIM takes exactly one argument (the charset form is btrim /
+        # TRIM(BOTH..FROM)); ltrim/rtrim accept an optional charset
+        # (reference ltrim.slt: ltrim('   sdf', ' s') works)
         "trim": _str_func(_exact1(str.strip)),
-        "ltrim": _str_func(_exact1(str.lstrip)),
-        "rtrim": _str_func(_exact1(str.rstrip)),
+        "ltrim": _str_func(lambda s, *c: s.lstrip(*[str(x) for x in c])),
+        "rtrim": _str_func(lambda s, *c: s.rstrip(*[str(x) for x in c])),
         "reverse": _str_func(lambda s: s[::-1]),
         "substr": _str_func(_fn_substr),
         "substring": _str_func(_fn_substr),
-        "replace": _str_func(lambda s, a, b: s.replace(a, b)),
-        "starts_with": _str_func(lambda s, p: s.startswith(p), out=np.bool_),
-        "ends_with": _str_func(lambda s, p: s.endswith(p), out=np.bool_),
+        "replace": _str_func(
+            lambda s, a, b: s.replace(_str_coerce(a), _str_coerce(b))),
+        # starts/ends_with coerce non-strings (reference:
+        # starts_with(123, 'hello') → false)
+        "starts_with": _str_func(
+            lambda s, p: s.startswith(_str_coerce(p)), out=np.bool_,
+            strict=False),
+        "ends_with": _str_func(
+            lambda s, p: s.endswith(_str_coerce(p)), out=np.bool_,
+            strict=False),
         "concat": _fn_concat,
-        "strpos": _str_func(lambda s, sub: s.find(sub) + 1, out=np.int64),
-        "repeat": _str_func(lambda s, n: s * int(n)),
+        "strpos": _str_func(lambda s, sub: s.find(_str_coerce(sub)) + 1,
+                            out=np.int64),
+        "repeat": _str_func(_fn_repeat),
         "lpad": _str_func(_fn_lpad),
         "rpad": _str_func(_fn_rpad),
         "ascii": _str_func(_fn_ascii, out=np.int64, strict=False),
-        "chr": _obj_func(lambda x: chr(int(x)), numeric=False),
+        # internal: timestamp → ISO string (analyzer wraps time args of
+        # lenient string functions so ascii(time) sees '1999-…' like the
+        # reference's implicit timestamp→utf8 cast)
+        "__iso__": _obj_func(_fn_iso, numeric=False),
+        "chr": _obj_func(_fn_chr, numeric=False),
         "bit_length": _str_func(lambda s: len(s.encode()) * 8,
                                 out=np.int64),
         "octet_length": _str_func(lambda s: len(s.encode()), out=np.int64),
@@ -1120,7 +1259,7 @@ def _register_tsfuncs():
         "split_part": _str_func(_fn_split_part),
         "translate": _str_func(_fn_translate),
         "md5": _str_func(_fn_md5),
-        "to_hex": _obj_func(_fn_to_hex, numeric=False),
+        "to_hex": _to_hex_lift,
         "concat_ws": _fn_concat_ws,
     })
     _register_time_scalars()
@@ -1173,7 +1312,8 @@ _CAST_KINDS = {"BIGINT": "i", "INT": "i", "INTEGER": "i",
                "BIGINT UNSIGNED": "u", "UNSIGNED": "u",
                "DOUBLE": "f", "FLOAT": "f",
                "STRING": "s", "VARCHAR": "s", "TEXT": "s",
-               "BOOLEAN": "b", "BOOL": "b", "TIMESTAMP": "t"}
+               "BOOLEAN": "b", "BOOL": "b", "TIMESTAMP": "t",
+               "CHAR": "s"}
 
 
 def iter_child_exprs(e):

@@ -1224,6 +1224,10 @@ class Parser:
             if t.kind == "op" and t.value in ("+", "-"):
                 self.next()
                 e = BinOp(t.value, e, self.parse_multiplicative())
+            elif t.kind == "op" and t.value == "||":
+                # string concatenation operator → concat()
+                self.next()
+                e = Func("concat", [e, self.parse_multiplicative()])
             else:
                 break
         return e
@@ -1295,7 +1299,9 @@ class Parser:
                 self.next()
                 s = self.expect_string()
                 parse_timestamp_string(s)   # validate eagerly
-                return Literal(s)
+                from .expr import DateLit
+
+                return DateLit(s)
 
             if k in ("CAST", "TRY_CAST"):
                 self.next()
@@ -1308,6 +1314,12 @@ class Parser:
                 if tname == "BIGINT" and self.kw() == "UNSIGNED":
                     self.next()
                     tname = "BIGINT UNSIGNED"
+                elif self.accept_op("("):
+                    # parameterized types: CHAR(6), VARCHAR(n), ...
+                    self.expect_number()
+                    while self.accept_op(","):
+                        self.expect_number()
+                    self.expect_op(")")
                 self.expect_op(")")
                 from .expr import Cast
 

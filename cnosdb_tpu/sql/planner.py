@@ -218,8 +218,179 @@ def _norm_cmp(e: BinOp):
 # ---------------------------------------------------------------------------
 # SELECT planning
 # ---------------------------------------------------------------------------
+# scalar signature table for schema-aware argument TYPE validation
+# (reference: DataFusion signatures reject e.g. left(Utf8, UInt64),
+# to_hex(UInt64), replace(Timestamp, ...)). 's' = string-typed arg,
+# 'i' = Int64 (UNSIGNED and TIMESTAMP reject), '?' = unchecked.
+_SCALAR_SIGS = {
+    "left": "si", "right": "si", "lpad": "siS", "rpad": "siS",
+    "repeat": "si", "strpos": "sS", "split_part": "sSi",
+    "translate": "sSS", "replace": "s??", "to_hex": "i", "chr": "i",
+    "initcap": "s", "reverse": "s", "md5": "s", "btrim": "s?",
+    "lower": "s", "upper": "s", "trim": "s", "ltrim": "s?",
+    "rtrim": "s?", "bit_length": "s", "octet_length": "s",
+    "length": "s", "char_length": "s", "character_length": "s",
+    "substr": "si?",
+    "substring": "si?",
+}
+
+
+def _arg_type(a, schema):
+    """'s'/'i'/'u'/'f'/'b'/'t'/None(unknown) for a scalar argument."""
+    if isinstance(a, Column):
+        name = a.name.split(".")[-1]
+        if name == TIME_COL:
+            return "t"
+        if not schema.contains_column(name):
+            return None
+        ct = schema.column(name).column_type
+        if ct.is_tag:
+            return "s"
+        return {ValueType.STRING: "s", ValueType.GEOMETRY: "s",
+                ValueType.INTEGER: "i", ValueType.UNSIGNED: "u",
+                ValueType.FLOAT: "f", ValueType.BOOLEAN: "b"}.get(
+                    ct.value_type)
+    if isinstance(a, Literal):
+        from .expr import DateLit
+
+        if isinstance(a, DateLit):
+            return "d"
+        v = a.value
+        if isinstance(v, bool):
+            return "b"
+        if isinstance(v, str):
+            return "s"
+        if isinstance(v, int):
+            return "i"
+        if isinstance(v, float):
+            return "f"
+    return None
+
+
+def _validate_scalar_sigs(e, schema):
+    if not isinstance(e, Expr):
+        return
+    if isinstance(e, Func):
+        sig = _SCALAR_SIGS.get(e.name.lower())
+        if sig is not None:
+            for a, want in zip(e.args, sig):
+                got = _arg_type(a, schema)
+                if got is None or want == "?":
+                    continue
+                if want == "i":
+                    # Int64 strictly; a float LITERAL defers to the
+                    # value check (2.0 casts, 2.7 errors there)
+                    ok = got == "i" or (got == "f"
+                                        and isinstance(a, Literal))
+                elif want == "s":
+                    ok = got == "s"
+                elif want == "S":
+                    # string with implicit numeric coercion (reference
+                    # pads with bigint columns, searches int literals,
+                    # casts time/date to ISO text)
+                    ok = got in ("s", "i", "u", "f", "b", "t", "d")
+                else:
+                    ok = got == want
+                if not ok:
+                    raise PlanError(
+                        f"no function matches {e.name}() for argument "
+                        f"type {got!r} (expects {want!r})")
+    from .expr import iter_child_exprs
+
+    for c in iter_child_exprs(e):
+        _validate_scalar_sigs(c, schema)
+
+
+def _env_arg_type(a, env):
+    """Argument type from a MATERIALIZED relational scope (joins): the
+    time column by name, then dtype classification."""
+    import numpy as np
+
+    from ..models.strcol import DictArray
+
+    if isinstance(a, Literal):
+        from .expr import DateLit
+
+        if isinstance(a, DateLit):
+            return "d"
+        return (
+            "b" if isinstance(a.value, bool) else
+            "s" if isinstance(a.value, str) else
+            "i" if isinstance(a.value, int) else
+            "f" if isinstance(a.value, float) else None)
+    if not isinstance(a, Column):
+        return None
+    name = a.name
+    if name == "time" or name.endswith(".time"):
+        return "t"
+    v = env.get(name)
+    if v is None:
+        return None
+    if isinstance(v, DictArray):
+        return "s"
+    dt = getattr(v, "dtype", None)
+    if dt is None:
+        return None
+    if dt == object:
+        probe = next((x for x in v if x is not None), None)
+        if isinstance(probe, str):
+            return "s"
+        if isinstance(probe, bool):
+            return "b"
+        if isinstance(probe, int):
+            return "i"
+        if isinstance(probe, float):
+            return "f"
+        return None
+    return {"u": "u", "i": "i", "f": "f", "b": "b"}.get(dt.kind)
+
+
+def validate_scalar_sigs_env(e, env):
+    """Relational-path twin of _validate_scalar_sigs: argument types
+    resolved from the materialized scope env."""
+    if not isinstance(e, Expr):
+        return
+    if isinstance(e, Func):
+        sig = _SCALAR_SIGS.get(e.name.lower())
+        if sig is not None:
+            for a, want in zip(e.args, sig):
+                got = _env_arg_type(a, env)
+                if got is None or want == "?":
+                    continue
+                if want == "i":
+                    ok = got == "i" or (got == "f"
+                                        and isinstance(a, Literal))
+                elif want == "s":
+                    ok = got == "s"
+                elif want == "S":
+                    # string with implicit numeric coercion (reference
+                    # pads with bigint columns, searches int literals,
+                    # casts time/date to ISO text)
+                    ok = got in ("s", "i", "u", "f", "b", "t", "d")
+                else:
+                    ok = got == want
+                if not ok:
+                    raise PlanError(
+                        f"no function matches {e.name}() for argument "
+                        f"type {got!r} (expects {want!r})")
+    from .expr import iter_child_exprs
+
+    for c in iter_child_exprs(e):
+        validate_scalar_sigs_env(c, env)
+
+
+def _validate_stmt_scalar_sigs(stmt, schema):
+    for it in stmt.items:
+        if isinstance(it.expr, Expr):
+            _validate_scalar_sigs(it.expr, schema)
+    for e in (stmt.where, stmt.having):
+        if e is not None:
+            _validate_scalar_sigs(e, schema)
+
+
 def plan_select(stmt: ast.SelectStmt, schema: TskvTableSchema):
     _validate_columns(stmt, schema)
+    _validate_stmt_scalar_sigs(stmt, schema)
     time_trs, tag_domains, residual = split_where(stmt.where, schema)
 
     # aggregates may appear only in HAVING or ORDER BY (standard SQL:

@@ -78,7 +78,7 @@ def process(path: str) -> list[str]:
                 rs = ex.execute_one(sql, session)
                 if kind in ("query", "querysort"):
                     got = format_csv(rs)[:-1].split("\n")[1:]
-                    if got == [""]:
+                    if got == [""] and rs.n_rows == 0:
                         got = []
                     got = [ln.rstrip() for ln in got]
                     want = [ln.replace("\\N", "").rstrip()
