@@ -660,6 +660,10 @@ def _cell(v):
         return "NaN"   # NaN is a VALUE; NULL is the empty cell
     if isinstance(v, (float, np.floating)) and v == 0.0:
         return repr(0.0)   # normalize -0.0 (arrow renders 0.0)
+    if isinstance(v, np.float32):
+        return str(v)     # shortest f32 repr ('1.5707964', '6e-06') —
+        # the reference's Float32 results (log/atan2 over ints) render
+        # at f32 precision
     if isinstance(v, np.floating):
         return repr(float(v))
     if isinstance(v, (np.integer,)):

@@ -59,7 +59,13 @@ class ResultSet:
         return len(self.columns[0]) if self.columns else 0
 
     def rows(self) -> list[tuple]:
-        return list(zip(*[c.tolist() for c in self.columns])) if self.columns else []
+        if not self.columns:
+            return []
+        # float32 stays a numpy scalar so renderers can keep f32
+        # precision (tolist() would widen to python float = f64)
+        cols = [list(c) if getattr(c, "dtype", None) == np.float32
+                else c.tolist() for c in self.columns]
+        return list(zip(*cols))
 
     def to_dict(self) -> dict:
         return {n: c for n, c in zip(self.names, self.columns)}
@@ -728,8 +734,13 @@ class QueryExecutor:
         by_lower = {c.name.lower(): c.name for c in schema.columns}
         cols = [by_lower.get(c.lower(), c) if not schema.contains_column(c)
                 else c for c in cols]
-        if "time" not in cols:
-            raise ExecutionError("INSERT must include the time column")
+        implicit_time = "time" not in cols
+        if implicit_time:
+            # reference fills now() when the time column is omitted
+            # (math_function/random.slt inserts VALUES (random()), …);
+            # one timestamp per statement — rows collide on identical
+            # series keys exactly as upstream
+            cols = list(cols) + ["time"]
         # SQL INSERT is schema-strict (the schemaless path is line
         # protocol); unknown columns are an error, not an auto-evolution
         unknown = [c for c in cols
@@ -757,6 +768,11 @@ class QueryExecutor:
                  for v in row]
                 for row in zip(*[c.tolist() if hasattr(c, "tolist") else c
                                  for c in rsel.columns])]
+        if implicit_time:
+            import time as _time
+
+            now_ns = int(_time.time() * 1e9)
+            src_rows = [list(r) + [now_ns] for r in src_rows]
         rows = []
         for raw in src_rows:
             if len(raw) != len(cols):
@@ -1027,7 +1043,8 @@ class QueryExecutor:
             names, cols = [], []
             for i, it in enumerate(stmt.items):
                 validate_scalar_sigs_env(it.expr, {})
-                v = it.expr.eval({}, np)
+                v = self._const_aggregate(it.expr) \
+                    if self._is_const_agg(it.expr) else it.expr.eval({}, np)
                 names.append(it.alias or it.expr.to_sql())
                 if isinstance(v, (bytes, bytearray)) or v is None:
                     c = np.empty(1, dtype=object)   # numpy 'S' dtype
@@ -1541,36 +1558,98 @@ class QueryExecutor:
             out.having = rel.rewrite_exprs(stmt.having, pred, replace)
         return out
 
+    def _is_const_agg(self, e) -> bool:
+        from .planner import AGG_FUNCS
+
+        return (isinstance(e, Func) and e.name.lower() in AGG_FUNCS
+                and all(isinstance(a, Literal) for a in e.args))
+
+    def _const_aggregate(self, e: Func):
+        """Aggregate over a literal with no FROM: one conceptual row
+        (reference: `select mode(null)` is NULL, `select count(null)`
+        is 0 — function/common/mode.slt, count.slt)."""
+        name = e.name.lower()
+        if not e.args:
+            raise PlanError(f"{e.name}() requires an argument")
+        v = e.args[0].value
+        if name in ("count", "count_distinct", "approx_distinct"):
+            return 0 if v is None else 1
+        if v is None:
+            return None
+        if name in ("avg", "mean", "median", "sum", "stddev_pop",
+                    "var_pop", "approx_median"):
+            return float(v) if name != "sum" else v
+        return v
+
     def _fold_session_scalars(self, stmt: ast.SelectStmt, session):
         """current_user()/current_tenant()/current_database()/
         current_role() fold to the SESSION's values (reference
         session.rs scalars are session-bound; current_role is NULL in
         the single-role default)."""
+        from datetime import datetime, timezone
+
+        from .expr import DateLit, TimeOfDayLit
+
         role = self.meta.members.get(session.tenant, {}).get(session.user)
+        now = datetime.now(timezone.utc)
         vals = {"current_user": session.user,
                 "current_tenant": session.tenant,
                 "current_database": session.database,
                 "current_role": role}
+        # date/time scalars fold ONCE per statement (reference:
+        # current_time() = current_time() is true within a query —
+        # time_functions/current_time.slt)
+        typed = {"current_date": DateLit(now.strftime("%Y-%m-%d")),
+                 "current_time": TimeOfDayLit(
+                     now.strftime("%H:%M:%S.%f"))}
 
         def hit(x):
             return isinstance(x, Func) and not x.args \
-                and x.name.lower() in vals
+                and x.name.lower() in (*vals, *typed, "arrow_typeof")
 
         def sub(x):
-            return Literal(vals[x.name.lower()])
+            n = x.name.lower()
+            if n in typed:
+                return typed[n]
+            return Literal(vals[n])
+
+        def hit_typeof(x):
+            return isinstance(x, Func) and x.name.lower() == \
+                "arrow_typeof" and len(x.args) == 1
+
+        def sub_typeof(x):
+            a = x.args[0]
+            if isinstance(a, DateLit):
+                t = "Date32"
+            elif isinstance(a, TimeOfDayLit):
+                t = "Time64(Nanosecond)"
+            elif isinstance(a, Literal):
+                v = a.value
+                t = ("Boolean" if isinstance(v, bool) else
+                     "Int64" if isinstance(v, int) else
+                     "Float64" if isinstance(v, float) else
+                     "Utf8" if isinstance(v, str) else "Null")
+            elif isinstance(a, Column) and a.name.endswith("time"):
+                t = 'Timestamp(Nanosecond, None)'
+            else:
+                raise ExecutionError("arrow_typeof over expressions is "
+                                     "not supported")
+            return Literal(t)
 
         import dataclasses
 
+        def fold(e):
+            if not isinstance(e, Expr):
+                return e
+            e = rel.rewrite_exprs(e, hit, sub)
+            return rel.rewrite_exprs(e, hit_typeof, sub_typeof)
+
         changed = dataclasses.replace(
             stmt,
-            items=[ast.SelectItem(
-                rel.rewrite_exprs(it.expr, hit, sub)
-                if isinstance(it.expr, Expr) else it.expr, it.alias)
-                for it in stmt.items],
-            where=rel.rewrite_exprs(stmt.where, hit, sub)
-            if stmt.where is not None else None,
-            having=rel.rewrite_exprs(stmt.having, hit, sub)
-            if stmt.having is not None else None)
+            items=[ast.SelectItem(fold(it.expr), it.alias)
+                   for it in stmt.items],
+            where=fold(stmt.where) if stmt.where is not None else None,
+            having=fold(stmt.having) if stmt.having is not None else None)
         return changed
 
     def _strip_table_qualifiers(self, stmt: ast.SelectStmt):
@@ -2516,7 +2595,10 @@ def _iso_ns(ns: int) -> str:
     dt = datetime.fromtimestamp(secs, tz=timezone.utc)
     base = dt.strftime("%Y-%m-%dT%H:%M:%S")
     if frac:
-        base += ("." + f"{frac:09d}").rstrip("0")
+        digits = f"{frac:09d}"
+        while digits.endswith("000"):   # trim ns→us→ms like arrow
+            digits = digits[:-3]
+        base += "." + digits
     return base
 
 

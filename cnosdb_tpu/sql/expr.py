@@ -105,6 +105,72 @@ def _math_float(xp, v):
     return v
 
 
+_LIBM_FNS = None
+
+
+def _libm_f32(name):
+    """glibc float math via ctypes: the reference's Float32 math path
+    (DataFusion coerces Int64→Float32 for log/atan2, computed with
+    Rust/libm log10f/atan2f whose results differ from numpy's by an
+    ulp — math_function/log.slt pins 0.30102998, glibc's log10f(2))."""
+    global _LIBM_FNS
+    if _LIBM_FNS is None:
+        import ctypes
+
+        lib = ctypes.CDLL("libm.so.6")
+        _LIBM_FNS = {}
+        for n, arity in (("log10f", 1), ("atan2f", 2), ("logf", 1)):
+            fn = getattr(lib, n)
+            fn.restype = ctypes.c_float
+            fn.argtypes = [ctypes.c_float] * arity
+            _LIBM_FNS[n] = fn
+    return _LIBM_FNS[name]
+
+
+def _all_int(*vs):
+    for v in vs:
+        if isinstance(v, np.ndarray):
+            if v.dtype.kind not in "iub":
+                return False
+        elif isinstance(v, bool) or not isinstance(v, (int, np.integer)):
+            return False
+    return True
+
+
+def _f32_lift(cname, *vs):
+    """Elementwise glibc f32 evaluation; returns float32 array/scalar."""
+    fn = _libm_f32(cname)
+    if any(isinstance(v, np.ndarray) for v in vs):
+        n = next(len(v) for v in vs if isinstance(v, np.ndarray))
+        cols = [v if isinstance(v, np.ndarray) else [v] * n for v in vs]
+        return np.array([fn(*(float(x) for x in row))
+                         for row in zip(*cols)], dtype=np.float32)
+    return np.float32(fn(*(float(v) for v in vs)))
+
+
+def _f32_log10(xp, a):
+    """DataFusion's Float32 log10: ln(x)/ln(10) evaluated in f32 —
+    one ulp below glibc's log10f at 2.0 (log.slt pins 0.30102998)."""
+    a32 = (a.astype(np.float32) if isinstance(a, np.ndarray)
+           else np.float32(a))
+    with np.errstate(divide="ignore", invalid="ignore"):
+        return (xp.log(a32) / xp.log(np.float32(10.0))).astype(np.float32)
+
+
+def _rust_atanh(xp, a):
+    """Rust std's atanh: 0.5 * ln_1p(2x/(1-x)) — bit-different from
+    numpy's arctanh (math_function/atanh.slt pins the last ulp)."""
+    a = _math_float(xp, a)
+    if isinstance(a, np.ndarray) and a.dtype == object:
+        o = np.empty(len(a), dtype=object)
+        o[:] = [None if x is None else _rust_atanh(xp, x) for x in a]
+        return o
+    if not isinstance(a, np.ndarray):
+        a = np.float64(a)
+    with np.errstate(divide="ignore", invalid="ignore"):
+        return 0.5 * xp.log1p(2.0 * a / (1.0 - a))
+
+
 def _div(xp, a, b):
     # SQL division: integer/integer stays integral in CnosDB? DataFusion
     # yields float for `/` on floats, TRUNC-div on ints (toward zero —
@@ -548,9 +614,11 @@ class Func(Expr):
         "acos": lambda xp, a: xp.arccos(a),
         "atan": lambda xp, a: xp.arctan(a),
         "asinh": lambda xp, a: xp.arcsinh(a),
-        "acosh": lambda xp, a: xp.arccosh(a),
-        "atanh": lambda xp, a: xp.arctanh(a),
-        "atan2": lambda xp, a, b: xp.arctan2(a, b),
+        "acosh": lambda xp, a: xp.arccosh(_math_float(xp, a)),
+        "atanh": _rust_atanh,
+        "atan2": lambda xp, a, b: (_f32_lift("atan2f", a, b)
+                                   if _all_int(a, b)
+                                   else xp.arctan2(a, b)),
         "pow": lambda xp, a, b: xp.power(a, b),
         "power": lambda xp, a, b: xp.power(a, b),
         # reference signum(0) = 1.0 (math_function/signum.slt) — sign
@@ -569,7 +637,7 @@ class Func(Expr):
         # log(x) = log10 in the reference (DataFusion math_expressions);
         # log(base, x) is explicit-base
         "log": lambda xp, a, *b: (xp.log(b[0]) / xp.log(a)) if b
-        else xp.log10(a),
+        else (_f32_log10(xp, a) if _all_int(a) else xp.log10(a)),
         "random": lambda xp: float(np.random.random()),
     }
 
@@ -577,7 +645,13 @@ class Func(Expr):
         f = self._FUNCS.get(self.name.lower())
         if f is None:
             raise PlanError(f"unknown function {self.name!r}")
-        return f(xp, *[a.eval(env, xp) for a in self.args])
+        try:
+            return f(xp, *[a.eval(env, xp) for a in self.args])
+        except TypeError as e:
+            # wrong arity / argument kinds surface as plan errors
+            # (current_date(1), current_time(current_time()), …)
+            raise PlanError(
+                f"no function matches the given argument types: {e}")
 
     def columns(self):
         out = set()
@@ -878,6 +952,12 @@ def _cap_result(s: str) -> str:
     if len(s) > (1 << 22):
         raise PlanError("string result exceeds the 4MiB limit")
     return s
+
+
+class TimeOfDayLit(Literal):
+    """current_time(): a Time64 value carried as its 'HH:MM:SS.ffffff'
+    rendering — lexical comparisons work, but string functions reject it
+    (reference: length(current_time()) is a type error)."""
 
 
 class DateLit(Literal):

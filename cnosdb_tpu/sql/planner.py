@@ -175,6 +175,22 @@ def _normalize_time_literals(e: Expr) -> Expr:
         return Between(_fold_now(e.expr), lo, hi, e.negated)
     if isinstance(e, UnaryOp):
         return UnaryOp(e.op, _normalize_time_literals(e.operand))
+    if isinstance(e, InList) and _is_time_valued(e.expr):
+        # time IN ('1999-12-31T00:00:00.045', …) — mode.slt; values are
+        # plain python values, not wrapped Literals
+        items = [parse_timestamp_string(v) if isinstance(v, str) else v
+                 for v in e.values]
+        return InList(e.expr, items, e.negated, e.null_present)
+    if isinstance(e, Case):
+        # comparisons live inside WHEN branches too:
+        # CASE WHEN time = current_date() THEN … (current_date.slt)
+        return Case(
+            _normalize_time_literals(e.operand)
+            if e.operand is not None else None,
+            [(_normalize_time_literals(w), _normalize_time_literals(t))
+             for w, t in e.whens],
+            _normalize_time_literals(e.else_)
+            if e.else_ is not None else None)
     return e
 
 
@@ -251,9 +267,9 @@ def _arg_type(a, schema):
                 ValueType.FLOAT: "f", ValueType.BOOLEAN: "b"}.get(
                     ct.value_type)
     if isinstance(a, Literal):
-        from .expr import DateLit
+        from .expr import DateLit, TimeOfDayLit
 
-        if isinstance(a, DateLit):
+        if isinstance(a, (DateLit, TimeOfDayLit)):
             return "d"
         v = a.value
         if isinstance(v, bool):
@@ -309,9 +325,9 @@ def _env_arg_type(a, env):
     from ..models.strcol import DictArray
 
     if isinstance(a, Literal):
-        from .expr import DateLit
+        from .expr import DateLit, TimeOfDayLit
 
-        if isinstance(a, DateLit):
+        if isinstance(a, (DateLit, TimeOfDayLit)):
             return "d"
         return (
             "b" if isinstance(a.value, bool) else
