@@ -440,8 +440,15 @@ class Coordinator:
     def scan_table(self, tenant: str, db: str, table: str,
                    time_ranges: TimeRanges | None = None,
                    tag_domains: ColumnDomains | None = None,
-                   field_names: list[str] | None = None) -> list[ScanBatch]:
-        """Fan a scan out over placed vnodes → one ScanBatch per vnode."""
+                   field_names: list[str] | None = None,
+                   page_filter=None) -> list[ScanBatch]:
+        """Fan a scan out over placed vnodes → one ScanBatch per vnode.
+
+        `page_filter` (optional sql.expr tree) lets the storage scan prune
+        pages its statistics prove can't match — the returned batches then
+        only cover filter-relevant rows, so callers MUST apply that same
+        filter. Cache entries are keyed by the filter's rendering.
+        """
         # a soft-dropped (trashed) table's rows stay on disk for RECOVER
         # but must not be readable until then
         if self.meta.table_opt(tenant, db, table) is None \
@@ -451,10 +458,38 @@ class Coordinator:
         doms = tag_domains or ColumnDomains.all()
         splits = self.table_vnodes(tenant, db, table, trs, doms)
 
+        import os
+
+        workers = min(8, len(splits))
+        # divide the host's cores across concurrent vnode scans: the
+        # native page decoder threads inside each scan multiply with the
+        # pool width, and oversubscription thrashes the cold path
+        ncpu = os.cpu_count() or 1
+        n_threads = max(1, ncpu // max(1, workers))
+
+        # extract pruning constraints + cache-key rendering ONCE per query
+        # (the filter tree walk is per-query, not per-vnode); a filter
+        # with no usable conjuncts degrades to a plain shared scan. The
+        # key renders the CONSTRAINTS (not the whole filter) so two
+        # filters that prune identically share one cache entry.
+        page_constraints = filter_key = None
+        if page_filter is not None:
+            from ..storage.scan import _page_constraints
+
+            page_constraints = _page_constraints(page_filter,
+                                                 field_names or [])
+            if page_constraints:
+                filter_key = repr(sorted(
+                    (c, [(op, repr(v)) for op, v in cons])
+                    for c, cons in page_constraints.items()))
+            else:
+                page_constraints = None
+
         def one(split):
             if self.distributed and split.node_id != self.node_id:
                 return self._scan_remote(split, field_names)
-            return self._scan_local(split, field_names)
+            return self._scan_local(split, field_names, page_constraints,
+                                    filter_key, n_threads)
 
         if len(splits) > 1:
             # vnode scans are independent: decode in parallel (the C++
@@ -463,13 +498,16 @@ class Coordinator:
             # fans out across DataFusion partitions the same way)
             from concurrent.futures import ThreadPoolExecutor
 
-            with ThreadPoolExecutor(max_workers=min(8, len(splits))) as tp:
+            with ThreadPoolExecutor(max_workers=workers) as tp:
                 results = list(tp.map(one, splits))
         else:
             results = [one(s) for s in splits]
         return [b for b in results if b is not None and b.n_rows]
 
-    def _scan_local(self, split: PlacedSplit, field_names) -> ScanBatch | None:
+    def _scan_local(self, split: PlacedSplit, field_names,
+                    page_constraints: dict | None = None,
+                    filter_key: str | None = None,
+                    n_threads: int = 1) -> ScanBatch | None:
         table, trs, doms = split.table, split.time_ranges, split.tag_domains
         v = self.engine.vnode(split.owner, split.vnode_id)
         if v is None:
@@ -483,22 +521,35 @@ class Coordinator:
 
         sids_key = (hashlib.md5(np.ascontiguousarray(sids).tobytes())
                     .hexdigest() if sids is not None else None)
-        key = (split.owner, split.vnode_id, table,
-               tuple(field_names) if field_names is not None else None,
-               tuple((r.min_ts, r.max_ts) for r in trs.ranges),
-               sids_key)
+        # a predicate-pruned batch holds only pages that can satisfy THAT
+        # constraint set: it is cached under the constraints' rendering
+        # and never serves a different query. The UNFILTERED entry remains
+        # valid for any filtered query (superset + row filter), so probe
+        # it as a fallback; and a scan the constraints didn't actually
+        # prune is stored under the shared unfiltered key.
+        base_key = (split.owner, split.vnode_id, table,
+                    tuple(field_names) if field_names is not None else None,
+                    tuple((r.min_ts, r.max_ts) for r in trs.ranges),
+                    sids_key)
+        key = base_key + (filter_key,)
+        key0 = base_key + (None,)
         from ..utils import stages
 
         with self._scan_cache_lock:
-            hit = self._scan_cache.get(key)
-            if hit is not None and hit[0] == v.data_version:
-                self._scan_cache[key] = self._scan_cache.pop(key)  # LRU touch
-                stages.count("scan_hit")
-                return hit[1]
+            for k in ((key, key0) if filter_key else (key0,)):
+                hit = self._scan_cache.get(k)
+                if hit is not None and hit[0] == v.data_version:
+                    self._scan_cache[k] = self._scan_cache.pop(k)  # LRU
+                    stages.count("scan_hit")
+                    return hit[1]
         stages.count("scan_miss")
         with stages.stage("decode_ms"):
             b = scan_vnode(v, table, series_ids=sids, time_ranges=trs,
-                           field_names=field_names)
+                           field_names=field_names,
+                           page_constraints=page_constraints,
+                           n_threads=n_threads)
+        if not getattr(b, "_pages_pruned", False):
+            key = key0   # nothing pruned: the batch is the full scan
         with self._scan_cache_lock:
             self._scan_cache.pop(key, None)  # supersede stale version
             while len(self._scan_cache) >= self.SCAN_CACHE_SIZE:

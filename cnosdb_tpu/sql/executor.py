@@ -2066,7 +2066,8 @@ class QueryExecutor:
                                   if plan.filter else set()))
         batches = self.coord.scan_table(
             tenant, db, plan.table, time_ranges=plan.time_ranges,
-            tag_domains=plan.tag_domains, field_names=needed_fields)
+            tag_domains=plan.tag_domains, field_names=needed_fields,
+            page_filter=plan.filter)
         with self.memory_pool.reservation(_batches_bytes(batches),
                                           f"scan of {plan.table}"):
             return self._exec_aggregate_batches(plan, batches, phys_aggs,

@@ -86,6 +86,16 @@ def _get_lib_locked():
             lib.encode_xor_transpose_f64.argtypes = [
                 ctypes.POINTER(ctypes.c_uint64), ctypes.c_size_t,
                 ctypes.POINTER(ctypes.c_uint8)]
+        if hasattr(lib, "decode_pages"):
+            lib.decode_pages.restype = ctypes.c_int
+            lib.decode_pages.argtypes = [
+                ctypes.c_void_p, ctypes.c_size_t,   # base, base_len
+                ctypes.POINTER(ctypes.c_int64),     # desc (n_pages × 6)
+                ctypes.c_int64,                     # n_pages
+                ctypes.c_void_p, ctypes.c_void_p,   # out_vals, out_valid
+                ctypes.c_int64,                     # out_rows capacity
+                ctypes.c_int, ctypes.c_int,         # check_crc, n_threads
+                ctypes.POINTER(ctypes.c_int32)]     # out_status
         if hasattr(lib, "fused_seg_agg_f64"):
             lib.fused_seg_agg_f64.restype = ctypes.c_int
             lib.fused_seg_agg_f64.argtypes = [
@@ -232,6 +242,39 @@ def fused_seg_agg_f64(ts, sid_ord, group_lut, origin, interval, bmin,
     if seg is not None:
         out["seg"] = seg
     return out
+
+
+def pagedec_available() -> bool:
+    lib = get_lib()
+    return lib is not None and hasattr(lib, "decode_pages")
+
+
+def decode_pages(base: np.ndarray, desc: np.ndarray,
+                 out_vals: np.ndarray, out_valid: np.ndarray | None,
+                 check_crc: bool = True,
+                 n_threads: int = 1) -> np.ndarray | None:
+    """Batch-decode TSM pages from one mmap'd file (native/pagedec.cpp).
+
+    base: u8 view over the whole file; desc: (n_pages, 6) i64 page
+    descriptors [src_off, src_size, out_off, n_rows, kind, n_values];
+    out_vals/out_valid: preallocated columns the pages decode into.
+    → per-page status array (0 = decoded; nonzero = caller must decode
+    that page via the Python path), or None when unavailable.
+    """
+    lib = get_lib()
+    if lib is None or not hasattr(lib, "decode_pages"):
+        return None
+    desc = np.ascontiguousarray(desc, dtype=np.int64)
+    n_pages = len(desc)
+    status = np.empty(n_pages, dtype=np.int32)
+    lib.decode_pages(
+        base.ctypes.data, len(base),
+        desc.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)), n_pages,
+        out_vals.ctypes.data,
+        out_valid.ctypes.data if out_valid is not None else None,
+        len(out_vals), 1 if check_crc else 0, n_threads,
+        status.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)))
+    return status
 
 
 def decode_xor_f64(comp: bytes, n: int) -> np.ndarray | None:

@@ -143,10 +143,14 @@ def _compute_stats(values: np.ndarray, vt: ValueType):
     if len(values) == 0:
         return None, None, None
     if vt == ValueType.FLOAT:
-        finite = values[np.isfinite(values)]
-        if len(finite) == 0:
+        # NaNs are excluded (they satisfy no comparison, and would poison
+        # the interval) but ±inf MUST be included: predicate page-pruning
+        # (scan._admit_pages) drops pages whose [min, max] cannot match,
+        # and an inf row outside a finite-only interval does match
+        nonnan = values[~np.isnan(values)]
+        if len(nonnan) == 0:
             return None, None, None
-        return float(finite.min()), float(finite.max()), float(finite.sum())
+        return float(nonnan.min()), float(nonnan.max()), float(nonnan.sum())
     if vt in (ValueType.INTEGER, ValueType.UNSIGNED):
         return int(values.min()), int(values.max()), int(values.sum())
     if vt == ValueType.BOOLEAN:
@@ -340,10 +344,26 @@ class TsmReader:
         self.bloom = BloomFilter.from_bytes(self._buf[bloom_off:bloom_off + bloom_len])
 
     def close(self):
+        self._buf_arr = None
         if not isinstance(self._buf, bytes):
-            self._buf.close()
+            try:
+                self._buf.close()
+            except BufferError:
+                # a lock-free concurrent scan still holds a buffer_array()
+                # view; the mmap stays alive until that array drops and GC
+                # reclaims it — never crash the closer (compaction's
+                # VersionEdit apply closes readers of deleted files)
+                pass
         self._f.close()
         self._buf = b""
+
+    def buffer_array(self) -> np.ndarray:
+        """Whole-file u8 view over the mmap (zero-copy) — the base pointer
+        the native batch page decoder reads from."""
+        arr = getattr(self, "_buf_arr", None)
+        if arr is None:
+            arr = self._buf_arr = np.frombuffer(self._buf, dtype=np.uint8)
+        return arr
 
     # -- meta queries ----------------------------------------------------
     def tables(self) -> list[str]:

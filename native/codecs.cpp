@@ -14,6 +14,8 @@
 #include <cstring>
 #include <zstd.h>
 
+#include "bytetrans.h"
+
 extern "C" {
 
 // ---------------------------------------------------------------------------
@@ -86,22 +88,7 @@ int decode_xor_f64(const uint8_t* comp, size_t comp_len,
     if (raw_len > scratch_len) return -2;
     size_t got = ZSTD_decompress(scratch, raw_len, comp, comp_len);
     if (ZSTD_isError(got) || got != raw_len) return -3;
-    // untranspose: plane p holds byte p of every value
-    const uint8_t* planes[8];
-    for (int p = 0; p < 8; p++) planes[p] = scratch + (size_t)p * n;
-    uint64_t acc = 0;
-    for (size_t i = 0; i < n; i++) {
-        uint64_t v = (uint64_t)planes[0][i]
-                   | ((uint64_t)planes[1][i] << 8)
-                   | ((uint64_t)planes[2][i] << 16)
-                   | ((uint64_t)planes[3][i] << 24)
-                   | ((uint64_t)planes[4][i] << 32)
-                   | ((uint64_t)planes[5][i] << 40)
-                   | ((uint64_t)planes[6][i] << 48)
-                   | ((uint64_t)planes[7][i] << 56);
-        acc ^= v;
-        out[i] = acc;
-    }
+    cnosdb_native::untranspose_xor_scan(scratch, n, out);
     return 0;
 }
 

@@ -1,0 +1,292 @@
+"""Native batch-scan fast path (storage.scan._scan_vnode_native +
+native/pagedec.cpp): equivalence against the legacy per-series Python
+decode across the tricky shapes — nulls, multiple disjoint flushes,
+overlapping chunks (fallback), tombstones (fallback), memcache overlay
+(fallback), time-range trims, string/bool/int columns — plus predicate
+page pruning soundness."""
+import os
+
+import numpy as np
+import pytest
+
+from cnosdb_tpu.models.points import SeriesRows, WriteBatch
+from cnosdb_tpu.models.predicate import TimeRange, TimeRanges
+from cnosdb_tpu.models.schema import TskvTableSchema, ValueType
+from cnosdb_tpu.models.series import SeriesKey
+from cnosdb_tpu.models.strcol import DictArray
+from cnosdb_tpu.storage import native
+from cnosdb_tpu.storage.scan import scan_vnode
+from cnosdb_tpu.storage.vnode import VnodeStorage
+
+pytestmark = pytest.mark.skipif(
+    not native.pagedec_available(), reason="native pagedec unavailable")
+
+
+def _schema():
+    return {"m": TskvTableSchema.new_measurement(
+        "t", "db", "m", tags=["host"],
+        fields=[("f", ValueType.FLOAT), ("i", ValueType.INTEGER),
+                ("b", ValueType.BOOLEAN), ("s", ValueType.STRING)])}
+
+
+def _write(v, host, ts, f=None, i=None, b=None, s=None):
+    def py(xs):
+        return [None if x is None else
+                (x.item() if isinstance(x, np.generic) else x) for x in xs]
+
+    fields = {}
+    if f is not None:
+        fields["f"] = (int(ValueType.FLOAT), py(f))
+    if i is not None:
+        fields["i"] = (int(ValueType.INTEGER), py(i))
+    if b is not None:
+        fields["b"] = (int(ValueType.BOOLEAN), py(b))
+    if s is not None:
+        fields["s"] = (int(ValueType.STRING), py(s))
+    wb = WriteBatch()
+    wb.add_series("m", SeriesRows(SeriesKey("m", {"host": host}),
+                                  list(ts), fields))
+    v.write(wb)
+
+
+def _assert_batches_equal(a, b):
+    assert a.n_rows == b.n_rows
+    assert a.n_series == b.n_series
+    np.testing.assert_array_equal(a.series_ids, b.series_ids)
+    np.testing.assert_array_equal(a.ts, b.ts)
+    np.testing.assert_array_equal(a.sid_ordinal, b.sid_ordinal)
+    assert set(a.fields) == set(b.fields)
+    for name in a.fields:
+        vt_a, vals_a, valid_a = a.fields[name]
+        vt_b, vals_b, valid_b = b.fields[name]
+        assert vt_a == vt_b
+        np.testing.assert_array_equal(valid_a, valid_b)
+        if isinstance(vals_a, DictArray) or isinstance(vals_b, DictArray):
+            obj_a = np.asarray(vals_a.materialize()
+                               if isinstance(vals_a, DictArray) else vals_a)
+            obj_b = np.asarray(vals_b.materialize()
+                               if isinstance(vals_b, DictArray) else vals_b)
+            np.testing.assert_array_equal(obj_a[valid_a], obj_b[valid_b])
+        else:
+            np.testing.assert_array_equal(vals_a[valid_a], vals_b[valid_b])
+
+
+def _both_scans(v, **kw):
+    got = scan_vnode(v, "m", **kw)
+    os.environ["CNOSDB_NO_NATIVE_SCAN"] = "1"
+    try:
+        want = scan_vnode(v, "m", **kw)
+    finally:
+        del os.environ["CNOSDB_NO_NATIVE_SCAN"]
+    return got, want
+
+
+def test_flushed_basic(tmp_engine_dir):
+    v = VnodeStorage(1, tmp_engine_dir, schemas=_schema())
+    rng = np.random.default_rng(1)
+    _write(v, "h1", range(0, 1000), f=rng.normal(size=1000),
+           i=rng.integers(-50, 50, 1000), b=rng.integers(0, 2, 1000) > 0,
+           s=[f"v{x}" for x in rng.integers(0, 5, 1000)])
+    _write(v, "h2", range(500, 900), f=rng.normal(size=400))
+    v.flush()
+    got, want = _both_scans(v)
+    _assert_batches_equal(got, want)
+    v.close()
+
+
+def test_multiple_disjoint_flushes(tmp_engine_dir):
+    v = VnodeStorage(1, tmp_engine_dir, schemas=_schema())
+    for base in (0, 1000, 2000):
+        _write(v, "h1", range(base, base + 500),
+               f=np.arange(base, base + 500) * 0.5)
+        v.flush()
+    got, want = _both_scans(v)
+    _assert_batches_equal(got, want)
+    assert (np.diff(got.ts) > 0).all()
+    v.close()
+
+
+def test_overlapping_chunks_fall_back(tmp_engine_dir):
+    v = VnodeStorage(1, tmp_engine_dir, schemas=_schema())
+    _write(v, "h1", range(0, 100), f=np.ones(100))
+    v.flush()
+    _write(v, "h1", range(50, 150), f=np.full(100, 2.0))  # overlap: dedup
+    v.flush()
+    got, want = _both_scans(v)
+    _assert_batches_equal(got, want)
+    assert got.n_rows == 150
+    # overlap region takes the later write
+    vt, vals, valid = got.fields["f"]
+    assert vals[got.ts == 75][0] == 2.0
+    v.close()
+
+
+def test_memcache_overlay_falls_back(tmp_engine_dir):
+    v = VnodeStorage(1, tmp_engine_dir, schemas=_schema())
+    _write(v, "h1", range(0, 100), f=np.ones(100))
+    v.flush()
+    _write(v, "h1", range(90, 120), f=np.full(30, 9.0))  # unflushed
+    got, want = _both_scans(v)
+    _assert_batches_equal(got, want)
+    assert got.fields["f"][1][got.ts == 95][0] == 9.0
+    v.close()
+
+
+def test_tombstone_falls_back(tmp_engine_dir):
+    v = VnodeStorage(1, tmp_engine_dir, schemas=_schema())
+    _write(v, "h1", range(0, 100), f=np.arange(100.0))
+    _write(v, "h2", range(0, 100), f=np.arange(100.0))
+    v.flush()
+    v.delete_time_range("m", None, 10, 20)
+    got, want = _both_scans(v)
+    _assert_batches_equal(got, want)
+    assert got.n_rows == 2 * (100 - 11)
+    v.close()
+
+
+def test_nulls_across_pages(tmp_engine_dir):
+    v = VnodeStorage(1, tmp_engine_dir, schemas=_schema())
+    # one field written on even rows only → other field null there
+    n = 500
+    ts = list(range(n))
+    f = [float(x) if x % 2 == 0 else None for x in range(n)]
+    i = [int(x) if x % 3 == 0 else None for x in range(n)]
+    wb = WriteBatch()
+    wb.add_series("m", SeriesRows(
+        SeriesKey("m", {"host": "h1"}), ts,
+        {"f": (int(ValueType.FLOAT), f),
+         "i": (int(ValueType.INTEGER), i)}))
+    v.write(wb)
+    v.flush()
+    got, want = _both_scans(v)
+    _assert_batches_equal(got, want)
+    vt, vals, valid = got.fields["f"]
+    assert valid.sum() == sum(1 for x in f if x is not None)
+    v.close()
+
+
+def test_time_range_trim(tmp_engine_dir):
+    v = VnodeStorage(1, tmp_engine_dir, schemas=_schema())
+    _write(v, "h1", range(0, 1000), f=np.arange(1000.0))
+    _write(v, "h2", range(2000, 3000), f=np.arange(1000.0))
+    v.flush()
+    trs = TimeRanges([TimeRange(250, 2200)])
+    got, want = _both_scans(v, time_ranges=trs)
+    _assert_batches_equal(got, want)
+    assert got.ts.min() >= 250 and got.ts.max() <= 2200
+    # h2 trimmed to 201 rows, h1 to 750
+    assert got.n_rows == 750 + 201
+    v.close()
+
+
+def test_time_range_drops_series_entirely(tmp_engine_dir):
+    v = VnodeStorage(1, tmp_engine_dir, schemas=_schema())
+    _write(v, "h1", range(0, 100), f=np.arange(100.0))
+    _write(v, "h2", range(5000, 5100), f=np.arange(100.0))
+    v.flush()
+    trs = TimeRanges([TimeRange(0, 200)])
+    got, want = _both_scans(v, time_ranges=trs)
+    _assert_batches_equal(got, want)
+    assert got.n_series == 1
+    v.close()
+
+
+def test_field_projection(tmp_engine_dir):
+    v = VnodeStorage(1, tmp_engine_dir, schemas=_schema())
+    rng = np.random.default_rng(3)
+    _write(v, "h1", range(0, 300), f=rng.normal(size=300),
+           i=rng.integers(0, 9, 300))
+    v.flush()
+    got, want = _both_scans(v, field_names=["i"])
+    _assert_batches_equal(got, want)
+    assert set(got.fields) == {"i"}
+    v.close()
+
+
+def test_predicate_page_pruning_sound(tmp_engine_dir):
+    """Pruned scan must keep every page that can hold a matching row;
+    the aggregate over (pruned batch + row filter) must equal the
+    aggregate over the full batch + row filter."""
+    from cnosdb_tpu.sql.expr import BinOp, Column, Literal
+
+    v = VnodeStorage(1, tmp_engine_dir, schemas=_schema())
+    rng = np.random.default_rng(4)
+    n = 600_000   # > 2 pages (256k rows each) with distinct stat ranges
+    vals = np.concatenate([rng.uniform(0, 10, n // 2),
+                           rng.uniform(50, 60, n // 2)])
+    _write(v, "h1", range(n), f=vals)
+    v.flush()
+    flt = BinOp(">", Column("f"), Literal(55.0))
+    pruned = scan_vnode(v, "m", page_filter=flt)
+    full = scan_vnode(v, "m")
+    assert pruned.n_rows < full.n_rows   # something actually pruned
+    pm = pruned.fields["f"][1] > 55.0
+    fm = full.fields["f"][1] > 55.0
+    assert pm.sum() == fm.sum()
+    assert pruned.fields["f"][1][pm].sum() == \
+        pytest.approx(full.fields["f"][1][fm].sum())
+    v.close()
+
+
+def test_pruning_keeps_inf(tmp_engine_dir):
+    """±inf participates in page stats (NaN doesn't): an inf row must
+    survive pruning for a > comparison."""
+    from cnosdb_tpu.sql.expr import BinOp, Column, Literal
+
+    v = VnodeStorage(1, tmp_engine_dir, schemas=_schema())
+    vals = np.zeros(1000)
+    vals[500] = np.inf
+    _write(v, "h1", range(1000), f=vals)
+    v.flush()
+    flt = BinOp(">", Column("f"), Literal(1e300))
+    pruned = scan_vnode(v, "m", page_filter=flt)
+    m = pruned.fields["f"][1] > 1e300
+    assert m.sum() == 1
+    v.close()
+
+
+def test_no_prune_on_ne_with_nan(tmp_engine_dir):
+    """`!=` must not prune: page stats exclude NaN but NaN satisfies !=
+    (sql 3VL evaluates it as ~(a == b)) — a constant page may hide a
+    matching NaN row."""
+    from cnosdb_tpu.sql.expr import BinOp, Column, Literal
+
+    v = VnodeStorage(1, tmp_engine_dir, schemas=_schema())
+    vals = np.full(1000, 5.0)
+    vals[123] = np.nan
+    _write(v, "h1", range(1000), f=vals)
+    v.flush()
+    flt = BinOp("!=", Column("f"), Literal(5.0))
+    pruned = scan_vnode(v, "m", page_filter=flt)
+    assert pruned.n_rows == 1000   # page kept despite lo == hi == 5
+    fv = pruned.fields["f"][1]
+    with np.errstate(invalid="ignore"):
+        m = ~(fv == 5.0)
+    assert m.sum() == 1
+    v.close()
+
+
+def test_unsigned_and_bool_roundtrip(tmp_engine_dir):
+    schemas = {"m": TskvTableSchema.new_measurement(
+        "t", "db", "m", tags=["host"],
+        fields=[("u", ValueType.UNSIGNED), ("b", ValueType.BOOLEAN)])}
+    v = VnodeStorage(1, tmp_engine_dir, schemas=schemas)
+    rng = np.random.default_rng(5)
+    u = rng.integers(0, 2**63, 400, dtype=np.uint64) * 2  # exercises u64
+    b = rng.integers(0, 2, 400) > 0
+    wb = WriteBatch()
+    wb.add_series("m", SeriesRows(
+        SeriesKey("m", {"host": "h1"}), list(range(400)),
+        {"u": (int(ValueType.UNSIGNED), u.tolist()),
+         "b": (int(ValueType.BOOLEAN), b.tolist())}))
+    v.write(wb)
+    v.flush()
+    got = scan_vnode(v, "m")
+    os.environ["CNOSDB_NO_NATIVE_SCAN"] = "1"
+    try:
+        want = scan_vnode(v, "m")
+    finally:
+        del os.environ["CNOSDB_NO_NATIVE_SCAN"]
+    np.testing.assert_array_equal(got.fields["u"][1], want.fields["u"][1])
+    np.testing.assert_array_equal(got.fields["b"][1], want.fields["b"][1])
+    v.close()
