@@ -57,6 +57,11 @@ class AggSpec:
 @dataclass
 class TpuQuery:
     filter: Expr | None = None
+    # native-kernel thread budget per batch (0 = all cores); the executor
+    # divides cores across concurrently-launched vnode batches so 8 pool
+    # workers don't each spawn a full-width native pool (oversubscription
+    # was the round-4 cold kernel bottleneck)
+    kernel_threads: int = 0
     group_tags: list[str] = field(default_factory=list)
     # GROUP BY on STRING field columns: their dictionary codes extend the
     # segment id directly (group = tags × field-codes × bucket) — the
@@ -657,6 +662,14 @@ def launch_scan_aggregate(batch: ScanBatch, query: TpuQuery):
                          gf=(gf_dims, gf_dicts) if gf_dims else None)
 
 
+def _kernel_threads(query: TpuQuery) -> int:
+    if query.kernel_threads > 0:
+        return query.kernel_threads
+    import os
+
+    return min(8, os.cpu_count() or 1)
+
+
 def _try_native_fused(batch, query, col_wants, group_of_series, n_groups,
                       origin, interval, bmin, dense_span, group_labels,
                       needs_rank, seg_cache_key=None):
@@ -727,7 +740,8 @@ def _try_native_fused(batch, query, col_wants, group_of_series, n_groups,
             ts, sid, lut, origin, interval, int(bmin),
             n_buckets if query.time_bucket is not None else 0,
             vals, valid_u8, row_mask, num_segments,
-            {**wants, "want_count": True}, out_seg=want_seg)
+            {**wants, "want_count": True}, out_seg=want_seg,
+            n_threads=_kernel_threads(query))
         if r is None:
             return None
         presence = r.pop("presence")
@@ -739,7 +753,8 @@ def _try_native_fused(batch, query, col_wants, group_of_series, n_groups,
         r = native.fused_seg_agg_f64(
             ts, sid, lut, origin, interval, int(bmin),
             n_buckets if query.time_bucket is not None else 0,
-            None, None, row_mask, num_segments, {})
+            None, None, row_mask, num_segments, {},
+            n_threads=_kernel_threads(query))
         if r is None:
             return None
         presence = r["presence"]

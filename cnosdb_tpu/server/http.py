@@ -654,6 +654,13 @@ class HttpServer:
 def _cell(v):
     if v is None:
         return ""
+    from ..sql.tsfuncs import IntervalNs, format_interval_ns, \
+        render_composite
+
+    if isinstance(v, IntervalNs):
+        return format_interval_ns(int(v))
+    if isinstance(v, dict):
+        return render_composite(v)   # gauge/window struct Display
     if isinstance(v, (bytes, bytearray)):
         return v.hex()   # WKB and other binary render as lowercase hex
     if isinstance(v, (float, np.floating)) and np.isnan(v):

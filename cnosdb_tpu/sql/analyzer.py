@@ -32,8 +32,45 @@ def analyze(stmt):
     stmt = rewrite_selector_functions(stmt)
     stmt = _normalize_time_comparisons(stmt)
     stmt = _wrap_time_string_args(stmt)
+    stmt = _interval_for_time_subtraction(stmt)
     _reject_time_in_numeric_funcs(stmt)
     return stmt
+
+
+def _time_typed(e) -> bool:
+    """Conservatively: does this expression yield a TIMESTAMP? (bare
+    time column, qualified .time, or selector/extremum aggregates and
+    date_trunc/date_bin over one)."""
+    from .expr import Column as _Col
+    from .expr import Func as _Func
+
+    if isinstance(e, _Col):
+        return e.name == "time" or e.name.endswith(".time")
+    if isinstance(e, _Func):
+        n = e.name.lower()
+        if n in ("min", "max", "first", "last", "first_value",
+                 "last_value") and e.args:
+            return _time_typed(e.args[0])
+        if n in ("date_trunc", "date_bin") and e.args:
+            return any(_time_typed(a) for a in e.args)
+    return False
+
+
+def _interval_for_time_subtraction(stmt):
+    """timestamp - timestamp = INTERVAL (arrow semantics the reference
+    inherits; gauge/time_delta.slt pins `max(time) - min(time)` rendered
+    as '0 years 0 mons ... secs'): wrap qualifying subtractions in the
+    __to_interval marker so the i64-ns result renders as an interval."""
+    from .expr import BinOp as _BinOp
+    from .expr import Func as _Func
+
+    def rw(e):
+        if isinstance(e, _BinOp) and e.op == "-" \
+                and _time_typed(e.left) and _time_typed(e.right):
+            return _Func("__to_interval", [e])
+        return _map_children(e, rw)
+
+    return _map_stmt_exprs(stmt, rw)
 
 
 def _analyze_union_order_by(stmt):

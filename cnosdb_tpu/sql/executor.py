@@ -12,6 +12,7 @@ one mesh.
 from __future__ import annotations
 
 import os
+import re
 from dataclasses import dataclass, field
 
 import numpy as np
@@ -1112,10 +1113,31 @@ class QueryExecutor:
         opts: dict[str, str] = {}
         if args and isinstance(args[-1], Literal) \
                 and isinstance(args[-1].value, str):
-            for kv in args.pop().value.split(","):
-                if "=" in kv:
-                    k, _, v = kv.partition("=")
-                    opts[k.strip()] = v.strip()
+            # urlencoded-style 'k=v&k=v' (the reference deserializes the
+            # option string with deny_unknown_fields: unknown or repeated
+            # fields are execution errors); ',' is accepted as a
+            # separator alias
+            allowed = {"timestamp_repair": {"method", "interval",
+                                            "start_mode"},
+                       "value_fill": {"method"},
+                       "value_repair": {"method", "min_speed", "max_speed",
+                                        "center", "sigma"}}[name]
+            raw = args.pop().value
+            for kv in re.split(r"[&,]", raw):
+                kv = kv.strip()
+                if not kv:
+                    continue
+                k, eq, v = kv.partition("=")
+                k = k.strip()
+                if not eq or k not in allowed:
+                    raise PlanError(
+                        f"Fail to parse argument: unknown field `{k}`, "
+                        f"expected one of "
+                        f"{', '.join(sorted(allowed))}")
+                if k in opts:
+                    raise PlanError(
+                        f"Fail to parse argument: duplicate field `{k}`")
+                opts[k] = v.strip()
         if len(args) != 2 or not isinstance(args[1], Column):
             raise PlanError(f"{name}(time, value[, 'options']) expected")
         value_col = args[1].name
@@ -1127,21 +1149,48 @@ class QueryExecutor:
         rs = self._select(base, session)
         ts = rs.columns[0].astype(np.int64)
         vals = rs.columns[1].astype(np.float64)
+
+        def _method(valid: set, default: str | None) -> str | None:
+            m = opts.get("method", default)
+            if m is not None and m.lower() not in valid:
+                raise PlanError(f"Invalid method: {m}")
+            return m.lower() if m is not None else None
+
         if name == "timestamp_repair":
-            interval = int(opts["interval"]) if "interval" in opts else None
+            start_mode = opts.get("start_mode")
+            if start_mode is not None \
+                    and start_mode.lower() not in ("linear", "mode"):
+                raise PlanError(f"Invalid start_mode: {start_mode}")
+            try:
+                interval = int(opts["interval"]) if "interval" in opts \
+                    else None
+            except ValueError as e:
+                raise PlanError(f"Fail to parse argument: {e}")
             new_ts, new_vals = tsfuncs.timestamp_repair(
-                ts, vals, method=opts.get("method", "median"),
-                interval=interval)
+                ts, vals,
+                method=_method({"median", "mode", "cluster"}, None),
+                interval=interval,
+                start_mode=start_mode.lower() if start_mode else None)
         elif name == "value_fill":
             new_ts = ts
-            new_vals = tsfuncs.value_fill(ts, vals,
-                                          method=opts.get("method", "linear"))
+            new_vals = tsfuncs.value_fill(
+                ts, vals,
+                method=_method({"mean", "previous", "linear", "ar", "ma"},
+                               "linear"))
         else:
             new_ts = ts
+
+            def fopt(k):
+                try:
+                    return float(opts[k]) if k in opts else None
+                except ValueError as e:
+                    raise PlanError(f"Fail to parse argument: {e}")
+
             new_vals = tsfuncs.value_repair(
                 ts, vals,
-                min_speed=float(opts["min_speed"]) if "min_speed" in opts else None,
-                max_speed=float(opts["max_speed"]) if "max_speed" in opts else None)
+                method=_method({"screen", "lsgreedy"}, "screen"),
+                min_speed=fopt("min_speed"), max_speed=fopt("max_speed"),
+                center=fopt("center"), sigma=fopt("sigma"))
         alias = stmt.items[0].alias or value_col
         out = ResultSet(["time", alias], [new_ts, new_vals])
         env = {"time": new_ts, alias: new_vals, value_col: new_vals}
@@ -1352,27 +1401,22 @@ class QueryExecutor:
                     return True
         return False
 
-    def _decorrelate_exists(self, e, session: Session):
-        """Correlated EXISTS with ONE equality correlation conjunct
-        (`EXISTS (SELECT .. FROM u WHERE u.k = t.k AND <local preds>)`)
-        → semi-join as an IN over the inner key set; NOT EXISTS → NOT(IN)
-        (anti-join: outer NULL keys stay, unlike NOT IN's 3VL). This is
-        the standard decorrelation DataFusion's subquery rules perform.
-        Returns the replacement Expr, or None when not this pattern."""
-        q = e.select
+    def _split_correlation(self, q, session: Session):
+        """Shared decorrelation front end: analyze the subquery body and
+        split its WHERE into correlated equality pairs and a local
+        residual (reference: DataFusion's subquery optimizer rules,
+        query_server/query/src/sql/logical/optimizer.rs:66-108).
+        → (analyzed_q, [(outer_expr, inner_expr)], residual) or None when
+        the body has no extractable correlation (uncorrelated, or
+        correlation in an unsupported position)."""
         if not isinstance(q, ast.SelectStmt) or q.where is None:
             return None
         # Normalize first (exact_count→count, topk→ORDER BY+LIMIT, …) so
-        # the guards below see the executable shape — an un-analyzed
-        # exact_count would slip past the aggregate check.
+        # the guards see the executable shape — an un-analyzed
+        # exact_count would slip past the aggregate checks.
         from .analyzer import analyze
 
         q = analyze(q)
-        if q.group_by or q.having is not None or q.order_by or \
-                q.limit is not None or q.offset:
-            return None   # EXISTS bodies with those don't need them anyway
-        contains_agg = any(rel.collect_aggs(it.expr, AGG_FUNCS)
-                           for it in q.items if isinstance(it.expr, Expr))
         local_quals = self._from_qualifiers(q)
         if not local_quals:
             return None
@@ -1388,28 +1432,60 @@ class QueryExecutor:
             return all(("." not in c) or c.split(".", 1)[0] in local_quals
                        for c in cols)
 
-        corr = None           # (outer_expr, inner_expr)
+        pairs = []            # [(outer_expr, inner_expr)]
         residual = []
         from .relational import _split_conjuncts
 
         for c in _split_conjuncts(q.where):
             took = False
-            if (corr is None and isinstance(c, expr_mod.BinOp)
-                    and c.op == "="):
+            if isinstance(c, expr_mod.BinOp) and c.op == "=":
                 for outer, inner in ((c.left, c.right), (c.right, c.left)):
                     if is_outer(outer) and is_local(inner) \
                             and inner.columns():
-                        corr = (outer, inner)
+                        pairs.append((outer, inner))
                         took = True
                         break
             if not took:
                 residual.append(c)
-        if corr is None:
+        if not pairs:
             return None
         # every residual conjunct must be fully local
         if not all(is_local(c) and not is_outer(c) for c in residual):
             return None
-        outer_expr, inner_expr = corr
+        return q, pairs, residual
+
+    @staticmethod
+    def _py_rows(rs):
+        """ResultSet columns → per-row python tuples with np scalars
+        unwrapped and NaN normalized to None (hash/eq-stable keys)."""
+        cols = []
+        for c in rs.columns:
+            vals = []
+            src = c.materialize() if hasattr(c, "materialize") else c
+            for v in src:
+                if hasattr(v, "item"):
+                    v = v.item()
+                if isinstance(v, float) and v != v:
+                    v = None
+                vals.append(v)
+            cols.append(vals)
+        return list(zip(*cols)) if cols else []
+
+    def _decorrelate_exists(self, e, session: Session):
+        """Correlated EXISTS (`EXISTS (SELECT .. FROM u WHERE u.k = t.k
+        AND <local preds>)`) → semi-join: one equality conjunct becomes
+        an IN over the inner key set, several become a KeyInSet over key
+        tuples; NOT EXISTS → the anti-join form (outer NULL keys stay,
+        unlike NOT IN's 3VL). Returns the replacement Expr or None."""
+        split = self._split_correlation(e.select, session)
+        if split is None:
+            return None
+        q, pairs, residual = split
+        if q.group_by or q.having is not None or q.order_by or \
+                q.limit is not None or q.offset:
+            return None   # EXISTS bodies with those don't need them anyway
+        contains_agg = any(rel.collect_aggs(it.expr, AGG_FUNCS)
+                           for it in q.items if isinstance(it.expr, Expr))
         import copy as _copy
         import dataclasses
 
@@ -1434,21 +1510,131 @@ class QueryExecutor:
             return Literal(not e.negated)
         inner_q = dataclasses.replace(
             _copy.copy(q),
-            items=[ast.SelectItem(inner_expr, "__corr_key")],
+            items=[ast.SelectItem(inner, f"__ck{i}")
+                   for i, (_o, inner) in enumerate(pairs)],
             where=self._conjoin(residual))
         rs = self._select(inner_q, session)
-        vals = [v.item() if hasattr(v, "item") else v
-                for v in rs.columns[0]]
-        non_null = [v for v in vals if v is not None
-                    and not (isinstance(v, float) and v != v)]
-        keys = sorted(set(non_null), key=repr)
-        if e.negated:
-            # anti-join: a NULL outer key has no match → row KEPT (3VL
-            # NOT IN would drop it, so spell the NULL case explicitly)
-            return expr_mod.BinOp(
-                "or", expr_mod.IsNull(outer_expr),
-                InList(outer_expr, keys, negated=True))
-        return InList(outer_expr, keys, False)
+        if len(pairs) == 1:
+            outer_expr = pairs[0][0]
+            vals = [v.item() if hasattr(v, "item") else v
+                    for v in rs.columns[0]]
+            non_null = [v for v in vals if v is not None
+                        and not (isinstance(v, float) and v != v)]
+            keys = sorted(set(non_null), key=repr)
+            if e.negated:
+                # anti-join: a NULL outer key has no match → row KEPT (3VL
+                # NOT IN would drop it, so spell the NULL case explicitly)
+                return expr_mod.BinOp(
+                    "or", expr_mod.IsNull(outer_expr),
+                    InList(outer_expr, keys, negated=True))
+            return InList(outer_expr, keys, False)
+        # composite correlation key: tuple-membership semi/anti-join
+        keys = {row for row in self._py_rows(rs)
+                if not any(k is None for k in row)}
+        return expr_mod.KeyInSet([o for o, _i in pairs], keys, e.negated)
+
+    def _decorrelate_scalar(self, e, session: Session):
+        """Correlated scalar subquery → grouped-aggregate lookup
+        (scalar-subquery-to-join): run the body once GROUPED BY its
+        correlation columns, then map each outer row's key through the
+        result. COUNT-shaped bodies default to 0 on missing keys, others
+        to NULL; non-aggregate bodies enforce at-most-one-row per probed
+        key. Returns a CorrLookup or None when not this pattern."""
+        split = self._split_correlation(e.select, session)
+        if split is None:
+            return None
+        q, pairs, residual = split
+        if q.group_by or q.having is not None or q.order_by or \
+                q.limit is not None or q.offset or len(q.items) != 1:
+            return None
+        item = q.items[0].expr
+        if not isinstance(item, Expr):
+            return None
+        import copy as _copy
+        import dataclasses
+
+        key_items = [ast.SelectItem(inner, f"__ck{i}")
+                     for i, (_o, inner) in enumerate(pairs)]
+        outer_exprs = [o for o, _i in pairs]
+        aggs = rel.collect_aggs(item, AGG_FUNCS)
+        if aggs:
+            if isinstance(item, Func) \
+                    and item.name.lower() in ("count", "exact_count",
+                                              "approx_distinct"):
+                default = 0
+            elif any(a.name.lower() in ("count", "exact_count",
+                                        "approx_distinct") for a in aggs):
+                # an expression AROUND count (count(*)+1) needs the
+                # empty-group value of the whole expression — punt
+                return None
+            else:
+                default = None
+            inner_q = dataclasses.replace(
+                _copy.copy(q),
+                items=key_items + [ast.SelectItem(item, "__v")],
+                where=self._conjoin(residual),
+                group_by=[inner for _o, inner in pairs])
+            rs = self._select(inner_q, session)
+            mapping = {row[:-1]: row[-1] for row in self._py_rows(rs)
+                       if not any(k is None for k in row[:-1])}
+            return expr_mod.CorrLookup(outer_exprs, mapping, default)
+        # non-aggregate body: at most one inner row may match any probed
+        # key — group and keep a duplicate sentinel that raises only if
+        # an outer row actually probes it
+        inner_q = dataclasses.replace(
+            _copy.copy(q),
+            items=key_items + [ast.SelectItem(item, "__v")],
+            where=self._conjoin(residual))
+        rs = self._select(inner_q, session)
+        mapping: dict = {}
+        for row in self._py_rows(rs):
+            key = row[:-1]
+            if any(k is None for k in key):
+                continue
+            if key in mapping:
+                mapping[key] = expr_mod._SCALAR_DUP
+            else:
+                mapping[key] = row[-1]
+        return expr_mod.CorrLookup(outer_exprs, mapping, None)
+
+    def _decorrelate_in(self, e, session: Session):
+        """Correlated IN subquery (`a [NOT] IN (SELECT v FROM u WHERE
+        u.k = t.k ..)`) → per-key membership with full three-valued
+        logic (CorrIn). Returns the replacement Expr or None."""
+        split = self._split_correlation(e.select, session)
+        if split is None:
+            return None
+        q, pairs, residual = split
+        if q.group_by or q.having is not None or q.order_by or \
+                q.limit is not None or q.offset or len(q.items) != 1:
+            return None
+        item = q.items[0].expr
+        if not isinstance(item, Expr) or rel.collect_aggs(item, AGG_FUNCS):
+            return None
+        import copy as _copy
+        import dataclasses
+
+        inner_q = dataclasses.replace(
+            _copy.copy(q),
+            items=[ast.SelectItem(inner, f"__ck{i}")
+                   for i, (_o, inner) in enumerate(pairs)]
+            + [ast.SelectItem(item, "__v")],
+            where=self._conjoin(residual))
+        rs = self._select(inner_q, session)
+        pairs_set: set = set()
+        keyed: set = set()
+        null_keys: set = set()
+        for row in self._py_rows(rs):
+            key, v = row[:-1], row[-1]
+            if any(k is None for k in key):
+                continue
+            keyed.add(key)
+            if v is None:
+                null_keys.add(key)
+            else:
+                pairs_set.add(key + (v,))
+        return expr_mod.CorrIn([e.expr] + [o for o, _i in pairs],
+                               pairs_set, keyed, null_keys, e.negated)
 
     @staticmethod
     def _conjoin(cs):
@@ -1521,6 +1707,14 @@ class QueryExecutor:
             q = e.select
             if isinstance(e, expr_mod.Exists):
                 corr = self._decorrelate_exists(e, session)
+                if corr is not None:
+                    return corr
+            elif isinstance(e, Subquery):
+                corr = self._decorrelate_scalar(e, session)
+                if corr is not None:
+                    return corr
+            elif isinstance(e, InSubquery):
+                corr = self._decorrelate_in(e, session)
                 if corr is not None:
                     return corr
             rs = self._union(q, session) if isinstance(q, ast.UnionStmt) \
@@ -1934,6 +2128,11 @@ class QueryExecutor:
                             "constant size")
                     param = args[1].eval(scope.env, np)
                     col = np.asarray(args[0].eval(scope.env, np))
+                elif name in ("gauge_agg", "state_agg",
+                              "compact_state_agg") and len(args) == 2:
+                    # (time, value): the timestamp column rides in col2
+                    col = np.asarray(args[1].eval(scope.env, np))
+                    col2 = np.asarray(args[0].eval(scope.env, np))
                 agg_cache[key] = rel.host_aggregate(
                     f.name, col, gid, n_groups, distinct,
                     col2=col2, param=param)
@@ -2076,9 +2275,16 @@ class QueryExecutor:
     def _exec_aggregate_batches(self, plan, batches, phys_aggs, finalize):
         host_funcs = ("count_distinct", "collect", "collect_ts",
                       "collect2", "count_multi")
+        import os
+
+        ncpu = os.cpu_count() or 1
         q = TpuQuery(filter=plan.filter, group_tags=plan.group_tags,
                      group_fields=plan.group_fields,
                      time_bucket=plan.bucket,
+                     # batches run kernels concurrently on a pool below:
+                     # give each native call its fair share of cores
+                     kernel_threads=max(1, ncpu // max(1, min(8,
+                                                              len(batches)))),
                      aggs=[a for a in phys_aggs if a.func not in host_funcs])
         distinct_specs = [a for a in phys_aggs if a.func in host_funcs]
 
@@ -2569,6 +2775,10 @@ def _series_finalize(func: str, ts: np.ndarray, vals: np.ndarray, param):
 
     order = np.argsort(ts, kind="stable")
     ts, vals = ts[order], np.asarray(vals)[order]
+    if isinstance(param, tuple) and len(param) == 2 \
+            and param[0] == "const_state":
+        vals = np.full(len(ts), param[1], dtype=object)
+        param = None
     if func == "increase":
         return tsfuncs.increase(ts, vals)
     if func == "sample":
@@ -2579,12 +2789,10 @@ def _series_finalize(func: str, ts: np.ndarray, vals: np.ndarray, param):
         return tsfuncs.state_data(ts, vals, compact=False)
     if func == "compact_state_agg":
         return tsfuncs.state_data(ts, vals, compact=True)
-    try:
-        return tsfuncs.data_quality(func, ts, vals)
-    except FunctionError:
-        # a degenerate group (<2 finite values) yields NULL for that group
-        # instead of failing the whole query
-        return None
+    # a degenerate group (<2 finite values) FAILS the query, matching the
+    # reference's "At least two non-NaN values are needed" execution error
+    # (function/data_quality.slt pins statement error for 1-row input)
+    return tsfuncs.data_quality(func, ts, vals)
 
 
 def _iso_ns(ns: int) -> str:

@@ -42,6 +42,25 @@ class Column(Expr):
 
     def eval(self, env, xp):
         if self.name not in env:
+            # struct field access: `col.field` over a composite-valued
+            # column (gauge/state/window dicts — reference struct columns
+            # support dotted access, e.g. state.state_duration,
+            # window.start)
+            if "." in self.name:
+                base, _, fld = self.name.rpartition(".")
+                if base in env:
+                    vals = env[base]
+                    rows = vals if isinstance(vals, np.ndarray) \
+                        and vals.dtype == object else None
+                    if rows is not None and any(
+                            isinstance(r, dict) for r in rows):
+                        out = np.empty(len(rows), dtype=object)
+                        for i, r in enumerate(rows):
+                            out[i] = r.get(fld) if isinstance(r, dict) \
+                                else None
+                        return out
+                    if isinstance(vals, dict):
+                        return vals.get(fld)
             raise PlanError(f"unknown column {self.name!r}")
         return env[self.name]
 
@@ -639,6 +658,9 @@ class Func(Expr):
         "log": lambda xp, a, *b: (xp.log(b[0]) / xp.log(a)) if b
         else (_f32_log10(xp, a) if _all_int(a) else xp.log10(a)),
         "random": lambda xp: float(np.random.random()),
+        # analyzer-injected marker: timestamp - timestamp yields an
+        # INTERVAL (arrow-rendered); wraps the subtraction's ns result
+        "__to_interval": lambda xp, a: _to_interval(a),
     }
 
     def eval(self, env, xp):
@@ -660,7 +682,22 @@ class Func(Expr):
         return out
 
     def to_sql(self):
+        if self.name == "__to_interval" and self.args:
+            # analyzer-injected rendering marker: invisible in output
+            # column names and EXPLAIN
+            return self.args[0].to_sql()
         return f"{self.name}({', '.join(a.to_sql() for a in self.args)})"
+
+
+def _to_interval(a):
+    from .tsfuncs import IntervalNs
+
+    if isinstance(a, np.ndarray):
+        out = np.empty(len(a), dtype=object)
+        for i, v in enumerate(a):
+            out[i] = None if v is None else IntervalNs(int(v))
+        return out
+    return None if a is None else IntervalNs(int(a))
 
 
 def _str_func(fn, *, out=object, strict=True):
@@ -1199,7 +1236,9 @@ def _obj_func(fn, *, numeric: bool = True):
         if isinstance(arr, _np.ndarray):
             vals = [None if x is None else fn(x, *rest) for x in arr]
             if numeric:
-                if all(v is None or isinstance(v, (int, float)) for v in vals):
+                # exact type check: int SUBCLASSES (IntervalNs) must stay
+                # objects so their interval rendering survives
+                if all(v is None or type(v) in (int, float) for v in vals):
                     if any(v is None for v in vals):
                         return _np.array([_np.nan if v is None else float(v)
                                           for v in vals])
@@ -1739,6 +1778,161 @@ class Exists(Expr):
     def to_sql(self):
         neg = "NOT " if self.negated else ""
         return f"({neg}EXISTS (<subquery>))"
+
+
+def _rows_of(v, n):
+    """Per-row python values for an eval() result: scalars broadcast,
+    np scalars unwrap (so tuple hashing matches the python values the
+    inner query produced), NaN normalizes to None (NULL semantics)."""
+    if isinstance(v, DictArray):
+        v = v.materialize()
+    if isinstance(v, np.ndarray):
+        out = []
+        for x in v.tolist() if v.dtype != object else v:
+            if isinstance(x, float) and x != x:
+                out.append(None)
+            elif isinstance(x, np.generic):
+                out.append(x.item())
+            else:
+                out.append(x)
+        return out
+    if isinstance(v, np.generic):
+        v = v.item()
+    if isinstance(v, float) and v != v:
+        v = None
+    return [v] * n
+
+
+def _env_rows(env: dict) -> int:
+    for val in env.values():
+        if isinstance(val, (np.ndarray, DictArray)):
+            return len(val)
+    return 1
+
+
+_SCALAR_DUP = object()   # sentinel: correlation key had >1 inner row
+
+
+@dataclass(repr=False)
+class CorrLookup(Expr):
+    """Decorrelated correlated SCALAR subquery: per row, the correlation
+    key exprs (`args`) evaluate and the tuple maps through `mapping`
+    (built by grouping the inner query by its correlation columns);
+    missing keys — including NULL key components, which can never equal
+    anything — yield `default` (0 for COUNT bodies, else NULL).
+    Reference surface: DataFusion's scalar_subquery_to_join
+    (query_server/query/src/sql/logical/optimizer.rs:66-108)."""
+
+    args: list
+    mapping: dict
+    default: object = None
+
+    def eval(self, env, xp):
+        n = _env_rows(env)
+        cols = [_rows_of(a.eval(env, xp), n) for a in self.args]
+        out = np.empty(n, dtype=object)
+        for i in range(n):
+            key = tuple(c[i] for c in cols)
+            if any(k is None for k in key):
+                out[i] = self.default
+                continue
+            v = self.mapping.get(key, self.default)
+            if v is _SCALAR_DUP:
+                raise PlanError(
+                    "scalar subquery must return a single value")
+            out[i] = v
+        return out
+
+    def columns(self):
+        s = set()
+        for a in self.args:
+            s |= a.columns()
+        return s
+
+    def to_sql(self):
+        return "(<correlated scalar subquery>)"
+
+
+@dataclass(repr=False)
+class CorrIn(Expr):
+    """Decorrelated correlated IN subquery: `probe [NOT] IN (SELECT v
+    FROM .. WHERE inner_k = outer_k ..)`. args = [probe, *outer_keys];
+    `pairs` holds (key.., v) tuples from the inner query, `keyed` the
+    correlation keys with any row, `null_keys` those whose value set
+    contained NULL. Three-valued logic folds to a filter mask: UNKNOWN
+    rows (NULL probe against a non-empty set, or a miss against a set
+    containing NULL) never match, for IN and NOT IN alike."""
+
+    args: list
+    pairs: set
+    keyed: set
+    null_keys: set
+    negated: bool = False
+
+    def eval(self, env, xp):
+        n = _env_rows(env)
+        cols = [_rows_of(a.eval(env, xp), n) for a in self.args]
+        out = np.zeros(n, dtype=bool)
+        for i in range(n):
+            probe = cols[0][i]
+            key = tuple(c[i] for c in cols[1:])
+            if any(k is None for k in key) or key not in self.keyed:
+                res = False          # empty set: IN false, NOT IN true
+            elif probe is None:
+                res = None
+            elif key + (probe,) in self.pairs:
+                res = True
+            elif key in self.null_keys:
+                res = None
+            else:
+                res = False
+            if res is None:
+                out[i] = False       # UNKNOWN excludes under both forms
+            else:
+                out[i] = (not res) if self.negated else res
+        return out
+
+    def columns(self):
+        s = set()
+        for a in self.args:
+            s |= a.columns()
+        return s
+
+    def to_sql(self):
+        neg = " NOT" if self.negated else ""
+        return f"({self.args[0].to_sql()}{neg} IN (<correlated subquery>))"
+
+
+@dataclass(repr=False)
+class KeyInSet(Expr):
+    """Decorrelated multi-conjunct EXISTS: membership of the outer
+    correlation key tuple in the inner key set. A NULL key component
+    matches nothing (EXISTS false → NOT EXISTS keeps the row, the
+    anti-join rule)."""
+
+    args: list
+    keys: set
+    negated: bool = False
+
+    def eval(self, env, xp):
+        n = _env_rows(env)
+        cols = [_rows_of(a.eval(env, xp), n) for a in self.args]
+        out = np.zeros(n, dtype=bool)
+        for i in range(n):
+            key = tuple(c[i] for c in cols)
+            m = (not any(k is None for k in key)) and key in self.keys
+            out[i] = (not m) if self.negated else m
+        return out
+
+    def columns(self):
+        s = set()
+        for a in self.args:
+            s |= a.columns()
+        return s
+
+    def to_sql(self):
+        neg = "NOT " if self.negated else ""
+        return f"({neg}EXISTS (<correlated subquery>))"
 
 
 @dataclass(repr=False)

@@ -533,6 +533,13 @@ class _AggCollector:
                 if not (isinstance(a, Literal) and a.value == "__distinct__")]
         param = None
         ts_stripped = False
+        if name in ("gauge_agg", "state_agg", "compact_state_agg") \
+                and len(args) != 2:
+            # strict reference signature (state_agg.slt pins errors for
+            # 0/1/3-argument forms)
+            raise PlanError(
+                f"the function {name} takes (time, value), got "
+                f"{len(args)} arguments: {f.to_sql()}")
         if (name in TS_PAIR_AGGS or name in ("first", "last")) \
                 and len(args) == 2:
             ts_stripped = True
@@ -628,6 +635,12 @@ class _AggCollector:
             name, col = "const_agg:" + name, None
         elif name.startswith("const_agg:"):
             pass   # already resolved to a constant aggregate above
+        elif name in ("gauge_agg", "state_agg", "compact_state_agg") \
+                and args and isinstance(args[0], Literal):
+            # constant value column (compact_state_agg(time, 1)): collect
+            # timestamps, substitute the constant at finalize
+            param = ("const_state", args[0].value)
+            col = TIME_COL
         else:
             if not args or not isinstance(args[0], Column):
                 raise PlanError(f"aggregate argument must be a column: {f.to_sql()}")

@@ -513,6 +513,26 @@ def host_aggregate(func: str, col, gid: np.ndarray, n_groups: int,
             grp = v[g == k]
             out[k] = "[" + ", ".join(_arr_cell(x) for x in grp) + "]"
         return out
+    if func in ("gauge_agg", "state_agg", "compact_state_agg"):
+        # (time, value) pair aggregates: col carries the values, col2 the
+        # timestamps (executor binds them); one tsfuncs call per group
+        from . import tsfuncs
+
+        if col2 is None:
+            raise PlanError(f"{func} takes (time, value)")
+        ts = np.asarray(col2)
+        out = np.full(n_groups, None, dtype=object)
+        for k in np.unique(g):
+            sel = g == k
+            tsv = ts[valid][sel].astype(np.int64)
+            if func == "gauge_agg":
+                vals = v[sel].astype(np.float64)
+                order = np.argsort(tsv, kind="stable")
+                out[k] = tsfuncs.gauge_data(tsv[order], vals[order])
+            else:
+                out[k] = tsfuncs.state_data(
+                    tsv, v[sel], compact=(func == "compact_state_agg"))
+        return out
     if func in ("median", "stddev", "stddev_pop", "var_samp", "var_pop",
                 "mode"):
         # order-statistic / modal aggregates: one numpy pass per group

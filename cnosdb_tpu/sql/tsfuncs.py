@@ -99,10 +99,20 @@ def gauge_delta(g: dict) -> float:
     return g["last"][1] - g["first"][1]
 
 
+class IntervalNs(int):
+    """A nanosecond span that RENDERS as an arrow interval (the value
+    stays an int for arithmetic/comparisons; server._cell formats it)."""
+
+    def __repr__(self):
+        return format_interval_ns(int(self))
+
+
 def format_interval_ns(ns: int) -> str:
     """Arrow IntervalMonthDayNano rendering: '0 years 0 mons 0 days
-    0 hours 0 mins 0.005 secs' (reference renders time_delta this
-    way)."""
+    0 hours 0 mins 0.005 secs'. The seconds field uses float repr
+    (shortest round-trip) — the reference renders 9 fixed digits, which
+    the slt port normalizes through repr(float(...)): 0.035000000 →
+    0.035, 0.000000000 → 0.0, 0.000000007 → 7e-09."""
     neg = ns < 0
     ns = abs(int(ns))
     days, rem = divmod(ns, 86_400_000_000_000)
@@ -110,17 +120,50 @@ def format_interval_ns(ns: int) -> str:
     mins, rem = divmod(rem, 60_000_000_000)
     secs = rem / 1e9
     sign = "-" if neg else ""
-    sec_txt = f"{secs:.9f}".rstrip("0").rstrip(".")
-    if "." not in sec_txt and not sec_txt:
-        sec_txt = "0"
     return (f"{sign}0 years 0 mons {days} days {hours} hours "
-            f"{mins} mins {sec_txt} secs")
+            f"{mins} mins {secs!r} secs")
 
 
-def gauge_time_delta(g: dict) -> str:
-    """Interval between first and last sample, rendered in arrow's
-    interval format (gauge/time_delta.rs returns an Interval)."""
-    return format_interval_ns(g["last"][0] - g["first"][0])
+def chrono_iso(ns: int) -> str:
+    """chrono NaiveDateTime rendering: ISO seconds plus a fractional
+    part of exactly 0, 3, 6 or 9 digits (the least that is exact) —
+    how the reference renders timestamps inside gauge/window structs."""
+    from datetime import datetime, timezone
+
+    secs, frac = divmod(int(ns), 1_000_000_000)
+    dt = datetime.fromtimestamp(secs, tz=timezone.utc)
+    base = dt.strftime("%Y-%m-%dT%H:%M:%S")
+    if frac == 0:
+        return base
+    if frac % 1_000_000 == 0:
+        return f"{base}.{frac // 1_000_000:03d}"
+    if frac % 1_000 == 0:
+        return f"{base}.{frac // 1_000:06d}"
+    return f"{base}.{frac:09d}"
+
+
+def render_composite(v: dict) -> str:
+    """Reference Display text for composite aggregate values (gauge
+    structs, time_window structs); other dicts fall back to str()."""
+    kind = v.get("kind")
+    if kind == "gauge":
+        def tsp(p):
+            return f"{{ts: {chrono_iso(p[0])}, val: {float(p[1])!r}}}"
+
+        return (f"{{first: {tsp(v['first'])}, second: {tsp(v['second'])}, "
+                f"penultimate: {tsp(v['penultimate'])}, "
+                f"last: {tsp(v['last'])}, "
+                f"num_elements: {v['num_elements']}}}")
+    if kind == "window":
+        return (f"{{start: {chrono_iso(v['start'])}, "
+                f"end: {chrono_iso(v['end'])}}}")
+    return str(v)
+
+
+def gauge_time_delta(g: dict) -> "IntervalNs":
+    """Interval between first and last sample (gauge/time_delta.rs
+    returns an Interval; IntervalNs renders it in arrow's format)."""
+    return IntervalNs(g["last"][0] - g["first"][0])
 
 
 def _gauge_time_delta_ns(g: dict) -> int:
@@ -175,18 +218,24 @@ def state_data(ts: np.ndarray, states: np.ndarray,
         durations[cur_state] = durations.get(cur_state, 0) + (end - cur_start)
         if not compact:
             periods.setdefault(cur_state, []).append([cur_start, end])
+    d = {str(k): int(v) for k, v in durations.items()}
+    p = {str(k): v for k, v in periods.items()}
     return {"kind": "state", "compact": compact,
-            "durations": {str(k): int(v) for k, v in durations.items()},
-            "periods": {str(k): v for k, v in periods.items()}}
+            "durations": d, "periods": p,
+            # reference StateAggData struct field names (dotted access:
+            # state.state_duration / state.state_periods)
+            "state_duration": d, "state_periods": p}
 
 
 def duration_in(sa: dict, state, start: int | None = None,
                 interval: int | None = None) -> int:
     """Total time in `state` (state_agg_data.rs:89-136), optionally
     restricted to [start, start+interval)."""
+    if interval is not None and hasattr(interval, "ns"):
+        interval = interval.ns   # ast.IntervalValue literal
     key = str(state)
     if start is None:
-        return int(sa["durations"].get(key, 0))
+        return IntervalNs(sa["durations"].get(key, 0))
     if sa.get("compact"):
         raise FunctionError("duration_in with a time range needs state_agg "
                             "(not compact_state_agg)")
@@ -202,7 +251,7 @@ def duration_in(sa: dict, state, start: int | None = None,
         hi = p_end if end is None else min(p_end, end)
         if hi > lo:
             total += hi - lo
-    return int(total)
+    return IntervalNs(total)
 
 
 def state_at(sa: dict, ts: int):
@@ -334,103 +383,408 @@ def data_quality(metric: str, ts: np.ndarray, vals: np.ndarray) -> float:
 # ---------------------------------------------------------------------------
 # data repair (ts_gen_func/data_repair/)
 # ---------------------------------------------------------------------------
-def _interval_estimate(ts: np.ndarray, method: str = "median",
-                       interval: int | None = None) -> int:
-    if interval is not None:
-        return int(interval)
-    d = np.diff(ts)
-    if len(d) == 0:
+def _median_quirk(x) -> float:
+    """The reference's interval/f64 median: sorts the DIFF array but
+    indexes it with the SERIES length n (timestamps count), i.e.
+    interval[n/2] over n-1 intervals (value_repair.rs interval_median /
+    timestamp_repair.rs get_interval_median) — kept bit-for-bit, except
+    the out-of-range read a 2-point series triggers upstream (a Rust
+    panic) clamps to the last interval here."""
+    x = sorted(x)
+    n = len(x) + 1
+    hi = len(x) - 1
+    if n % 2 == 0:
+        return (x[min(n // 2 - 1, hi)] + x[min(n // 2, hi)]) / 2
+    return x[min(n // 2, hi)]
+
+
+def _fdiv(a, b) -> float:
+    """Rust f64 division semantics: x/0 → ±inf, 0/0 → NaN (Python would
+    raise; duplicate timestamps across merged series hit this)."""
+    a, b = float(a), float(b)
+    if b == 0.0:
+        if a == 0.0 or a != a:
+            return float("nan")
+        return float("inf") if a > 0 else float("-inf")
+    return a / b
+
+
+def _f64_median(x) -> float:
+    x = sorted(x)
+    n = len(x)
+    if n % 2 == 0:
+        return (x[n // 2 - 1] + x[n // 2]) / 2.0
+    return x[n // 2]
+
+
+def _mad_ref(x) -> float:
+    mid = _f64_median(x)
+    return 1.4826 * _f64_median([abs(v - mid) for v in x])
+
+
+def _kmeans_1d(data: list[int], k: int = 3) -> int:
+    """k-means over interval samples; returns the mean of the largest
+    cluster (timestamp_repair.rs k_means_clustering, integer math)."""
+    if not data:
+        return 0
+    lo, hi = min(data), max(data)
+    means = [lo + (i + 1) * (hi - lo) // (k + 1) for i in range(k)]
+    results = [0] * len(data)
+    changed = True
+    clusters: dict[int, list[int]] = {}
+    while changed:
+        changed = False
+        for i, d in enumerate(data):
+            best = min(range(k), key=lambda j: abs(d - means[j]))
+            if best != results[i]:
+                changed = True
+                results[i] = best
+        clusters = {}
+        for i, r in enumerate(results):
+            clusters.setdefault(r, []).append(data[i])
+        for j in range(k):
+            s = clusters.get(j, [])
+            if s:
+                means[j] = sum(s) // len(s)
+    cnts = [len(clusters.get(j, [])) for j in range(k)]
+    biggest = max(range(k), key=lambda j: cnts[j])
+    s = clusters.get(biggest, [])
+    return sum(s) // len(s) if s else 0
+
+
+def _interval_estimate(t: np.ndarray, method: str) -> int:
+    d = [int(x) for x in np.diff(t)]
+    if not d:
         return 1
     if method == "mode":
-        u, c = np.unique(d, return_counts=True)
-        return int(u[np.argmax(c)])
-    return int(np.median(d))
+        best_key, best = 0, 0
+        counts: dict[int, int] = {}
+        for x in d:
+            counts[x] = counts.get(x, 0) + 1
+        for key, times in counts.items():
+            if times > best:
+                best, best_key = times, key
+        return best_key
+    if method == "cluster":
+        return _kmeans_1d(d, 3)
+    return int(_median_quirk(d))
+
+
+def _start_estimate(t: np.ndarray, delta: int, start_mode: str) -> int:
+    if start_mode == "linear":
+        total = 0
+        for i, v in enumerate(t):
+            total += int(v) - i * delta
+        return total // len(t)
+    # mode: most common residue class; latest sample in it, walked back
+    # to at/below the first timestamp
+    counts: dict[int, int] = {}
+    mods = []
+    for v in t:
+        m = int(v) % delta
+        mods.append(m)
+        counts[m] = counts.get(m, 0) + 1
+    best_key, best = 0, 0
+    for key, times in counts.items():
+        if times > best:
+            best, best_key = times, key
+    result = 0
+    for i, m in enumerate(mods):
+        if m == best_key:
+            result = int(t[i])
+    first = int(t[0])
+    while result > first:
+        result -= delta
+    return result
+
+
+_REPAIR_DP_CELL_CAP = 25_000_000
 
 
 def timestamp_repair(ts: np.ndarray, vals: np.ndarray,
-                     method: str = "median",
-                     interval: int | None = None) -> tuple[np.ndarray, np.ndarray]:
-    """Rebuild an even timestamp grid (timestamp_repair.rs): estimate the
-    sampling interval (median/mode of diffs or explicit), regenerate
-    start..end on that grid, and map each original reading to its nearest
-    slot (first writer wins); empty slots fill by linear interpolation."""
+                     method: str | None = None,
+                     interval: int | None = None,
+                     start_mode: str | None = None
+                     ) -> tuple[np.ndarray, np.ndarray]:
+    """Edit-distance timestamp repair (timestamp_repair.rs dp_repair):
+    estimate interval (median/mode/cluster or explicit, ms→ns) and grid
+    start (mode/linear), then DP-align the samples onto the grid with
+    insert/remove/shift costs. Inserted slots carry NaN — the reference
+    never interpolates here."""
     t = np.asarray(ts, dtype=np.int64)
-    v = np.asarray(vals, dtype=np.float64)
-    if len(t) == 0:
+    v = np.asarray(vals, dtype=np.float64).copy()
+    v[~np.isfinite(v)] = np.nan
+    if len(t) <= 2:
         return t, v
     order = np.argsort(t, kind="stable")
     t, v = t[order], v[order]
-    step = max(1, _interval_estimate(t, method, interval))
-    start, end = int(t[0]), int(t[-1])
-    n_slots = (end - start) // step + 1
-    grid = start + step * np.arange(n_slots, dtype=np.int64)
-    slot = np.clip(np.round((t - start) / step).astype(np.int64), 0,
-                   n_slots - 1)
-    filled = np.full(n_slots, np.nan)
-    for i in range(len(t) - 1, -1, -1):   # first writer wins
-        filled[slot[i]] = v[i]
-    missing = np.isnan(filled)
-    if missing.any() and (~missing).any():
-        good = np.nonzero(~missing)[0]
-        filled = np.interp(np.arange(n_slots), good, filled[good])
-    return grid, filled
+    if interval is not None:
+        if interval <= 0:
+            raise FunctionError("interval must be positive")
+        step = int(interval) * 1_000_000   # ms → ns
+    else:
+        step = max(1, _interval_estimate(t, method or "median"))
+    start = _start_estimate(t, step, start_mode or "mode")
+    m = len(t)
+    import math
+
+    n = math.ceil((int(t[-1]) - start) / step + 1.0)
+    if n <= 0 or n * m > _REPAIR_DP_CELL_CAP:
+        raise FunctionError(
+            f"timestamp_repair DP over {n}x{m} cells exceeds the cap")
+    ADD = 100_000_000_000
+    # f[i][j]: cost of producing i grid slots from the first j samples
+    f = np.empty((n + 1, m + 1), dtype=np.int64)
+    steps = np.zeros((n + 1, m + 1), dtype=np.int8)   # 0=nothing 1=ins 2=rm
+    f[:, 0] = ADD * np.arange(n + 1, dtype=np.int64)
+    steps[:, 0] = 1
+    f[0, :] = ADD * np.arange(m + 1, dtype=np.int64)
+    steps[0, :] = 2
+    tj = t.astype(np.int64)
+    for i in range(1, n + 1):
+        slot_ts = start + step * (i - 1)
+        for j in range(1, m + 1):
+            if tj[j - 1] == slot_ts:
+                f[i, j] = f[i - 1, j - 1]
+                steps[i, j] = 0
+            else:
+                if f[i - 1, j] < f[i, j - 1]:
+                    f[i, j] = f[i - 1, j] + ADD
+                    steps[i, j] = 1
+                else:
+                    f[i, j] = f[i, j - 1] + ADD
+                    steps[i, j] = 2
+                modify = f[i - 1, j - 1] + abs(int(tj[j - 1]) - slot_ts)
+                if modify < f[i, j]:
+                    f[i, j] = modify
+                    steps[i, j] = 0
+    out_ts = np.zeros(n, dtype=np.int64)
+    out_v = np.zeros(n, dtype=np.float64)
+    i, j = n, m
+    while i >= 1 and j >= 1:
+        ps = start + step * (i - 1)
+        s = steps[i, j]
+        if s == 0:
+            out_ts[i - 1] = ps
+            out_v[i - 1] = v[j - 1]
+            i -= 1
+            j -= 1
+        elif s == 1:
+            out_ts[i - 1] = ps
+            out_v[i - 1] = np.nan
+            i -= 1
+        else:
+            j -= 1
+    return out_ts, out_v
 
 
 def value_fill(ts: np.ndarray, vals: np.ndarray,
                method: str = "linear") -> np.ndarray:
-    """Fill NaN values (value_fill.rs): mean / previous / linear."""
-    t = np.asarray(ts, dtype=np.float64)
+    """Fill NaN values (value_fill.rs): mean / previous / linear (by
+    INDEX distance, edges carried from the nearest sample) / AR(1) /
+    5-wide moving average."""
     v = np.asarray(vals, dtype=np.float64).copy()
-    bad = np.isnan(v)
-    if not bad.any():
-        return v
-    good = np.nonzero(~bad)[0]
+    v[~np.isfinite(v)] = np.nan
+    good = np.nonzero(~np.isnan(v))[0]
     if len(good) == 0:
-        return v
+        raise FunctionError("All values are Invalid")
     method = method.lower()
+    n = len(v)
     if method == "mean":
-        v[bad] = v[good].mean()
-    elif method == "previous":
+        out = v.copy()
+        out[np.isnan(v)] = v[good].mean()
+        return out
+    if method == "previous":
         idx = np.maximum.accumulate(
-            np.where(~bad, np.arange(len(v)), -1))
-        has_prev = idx >= 0
-        v[bad & has_prev] = v[idx[bad & has_prev]]
-    elif method == "linear":
-        v[bad] = np.interp(t[bad], t[good], v[good])
-    else:
-        raise FunctionError(f"unsupported fill method {method!r} "
-                            "(mean|previous|linear)")
-    return v
+            np.where(~np.isnan(v), np.arange(n), -1))
+        out = np.where(idx >= 0, v[np.maximum(idx, 0)], np.nan)
+        return out
+    if method == "linear":
+        # index-based interpolation (the reference interpolates by sample
+        # POSITION, not timestamp); leading gap takes the first sample,
+        # trailing gap the last
+        out = v.copy()
+        out[np.isnan(v)] = np.interp(np.nonzero(np.isnan(v))[0], good,
+                                     v[good])
+        return out
+    if method == "ar":
+        mean = v[good].mean()
+        left = np.nan_to_num(v[:-1], nan=0.0)
+        right = np.nan_to_num(v[1:], nan=0.0)
+        factor = float((left * left).sum())
+        if factor == 0.0:
+            raise FunctionError(
+                "Cannot fit AR(1) model. Please try another method.")
+        theta = float((left * right).sum()) / factor
+        both = ~np.isnan(v[:-1]) & ~np.isnan(v[1:])
+        if not both.any():
+            raise FunctionError(
+                "Cannot fit AR(1) model. Please try another method.")
+        eps = float((v[1:][both] - theta * v[:-1][both]).mean())
+        out = np.empty(n)
+        for i in range(n):
+            if np.isnan(v[i]):
+                out[i] = theta * out[i - 1] + eps if i else mean
+            else:
+                out[i] = v[i]
+        return out
+    if method == "ma":
+        # sliding 5-window mean over known values, advanced exactly as
+        # the reference does (window trails for the first/last two rows)
+        w = 5
+        r = w - 1
+        win_sum = float(np.nansum(v[:min(r, n)]))
+        win_cnt = int((~np.isnan(v[:min(r, n)])).sum())
+        out = np.empty(n)
+        for i in range(n):
+            out[i] = v[i] if not np.isnan(v[i]) \
+                else _fdiv(win_sum, win_cnt)
+            if i <= (w - 1) // 2 or i >= n - (w - 1) // 2 - 1:
+                continue
+            if r < n and not np.isnan(v[r]):
+                win_sum += v[r]
+                win_cnt += 1
+            r += 1
+        return out
+    raise FunctionError(f"Invalid fill method: {method}")
+
+
+def _process_nan_inplace(t: np.ndarray, v: np.ndarray):
+    """value_repair.rs process_nan: linear-fill every NaN through the
+    surrounding finite samples BY TIMESTAMP, extrapolating the edges from
+    the first/last finite pair. Needs ≥ 2 finite values."""
+    good = np.nonzero(np.isfinite(v))[0]
+    if len(good) < 2:
+        raise FunctionError("At least two non-NaN values are needed")
+    i1, i2 = int(good[0]), int(good[1])
+    for i in range(i2):
+        v[i] = v[i1] + (v[i2] - v[i1]) * _fdiv(
+            int(t[i]) - int(t[i1]), int(t[i2]) - int(t[i1]))
+    for i in range(i2 + 1, len(v)):
+        if np.isfinite(v[i]):
+            i1, i2 = i2, i
+            for j in range(i1 + 1, i2):
+                v[j] = v[i1] + (v[i2] - v[i1]) * _fdiv(
+                    int(t[j]) - int(t[i1]), int(t[i2]) - int(t[i1]))
+    for i in range(i2 + 1, len(v)):
+        v[i] = v[i1] + (v[i2] - v[i1]) * _fdiv(
+            int(t[i]) - int(t[i1]), int(t[i2]) - int(t[i1]))
+
+
+def _screen_repair(t: np.ndarray, v: np.ndarray,
+                   smin: float | None, smax: float | None) -> np.ndarray:
+    """SCREEN (value_repair.rs screen): windowed-median speed repair.
+    Window = 5× median interval; bounds default to median speed ± 3·MAD."""
+    n = len(v)
+    w = 5 * int(_median_quirk([int(x) for x in np.diff(t)]))
+    speeds = [_fdiv(v[i + 1] - v[i], int(t[i + 1]) - int(t[i]))
+              for i in range(n - 1)]
+    sigma = _mad_ref(speeds)
+    mid = _f64_median(speeds)
+    if smin is None:
+        smin = mid - 3.0 * sigma
+    if smax is None:
+        smax = mid + 3.0 * sigma
+    ans = [[int(t[i]), float(v[i])] for i in range(n)]
+
+    def get_median(start):
+        m = 0
+        while start + m + 1 < len(ans) and \
+                ans[start + m + 1][0] <= ans[start][0] + w:
+            m += 1
+        x = [0.0] * (2 * m + 1)
+        x[0] = ans[start][1]
+        for i in range(1, m + 1):
+            x[i] = ans[start + i][1] + smin * (ans[start][0]
+                                               - ans[start + i][0])
+            x[i + m] = ans[start + i][1] + smax * (ans[start][0]
+                                                   - ans[start + i][0])
+        x.sort()
+        return x[m]
+
+    def local(start):
+        mid_v = get_median(start)
+        if start == 0:
+            ans[start][1] = mid_v
+        else:
+            xmin = ans[start - 1][1] + smin * (ans[start][0]
+                                               - ans[start - 1][0])
+            xmax = ans[start - 1][1] + smax * (ans[start][0]
+                                               - ans[start - 1][0])
+            ans[start][1] = max(xmin, min(xmax, mid_v))
+
+    start_index = 0
+    for i in range(1, n):
+        while ans[start_index][0] + w < ans[i][0]:
+            local(start_index)
+            start_index += 1
+    while start_index < n:
+        local(start_index)
+        start_index += 1
+    return np.array([a[1] for a in ans])
+
+
+def _lsgreedy_repair(t: np.ndarray, v: np.ndarray,
+                     center: float | None, sigma: float | None) -> np.ndarray:
+    """LsGreedy (value_repair.rs lsgreedy): greedily flatten the largest
+    speed-change outlier until all |u - center| fall within 3σ."""
+    n = len(v)
+    out = v.astype(np.float64).copy()
+    if n < 3:
+        return out
+    speeds = [_fdiv(out[i + 1] - out[i], int(t[i + 1]) - int(t[i]))
+              for i in range(n - 1)]
+    changes = [speeds[i + 1] - speeds[i] for i in range(len(speeds) - 1)]
+    center = 0.0 if center is None else center
+    if sigma is None:
+        sigma = _mad_ref(changes) if changes else 0.0
+    eps = 1e-12
+
+    def u_of(i):
+        v1 = _fdiv(out[i + 1] - out[i], int(t[i + 1]) - int(t[i]))
+        v2 = _fdiv(out[i] - out[i - 1], int(t[i]) - int(t[i - 1]))
+        return v1 - v2
+
+    for _ in range(10 * n + 100):   # greedy loop; provably shrinks u
+        cand = [(abs(u_of(i) - center), i) for i in range(1, n - 1)]
+        cand = [c for c in cand if c[0] > 3.0 * sigma]
+        if not cand:
+            break
+        top_u, idx = max(cand)
+        if top_u < max(eps, 3.0 * sigma):
+            break
+        u = u_of(idx)
+        if sigma < eps:
+            temp = abs(u - center)
+        else:
+            temp = max(sigma, abs((u - center) / 3.0))
+        temp *= _fdiv((int(t[idx + 1]) - int(t[idx]))
+                      * (int(t[idx]) - int(t[idx - 1])),
+                      int(t[idx + 1]) - int(t[idx - 1]))
+        if u > center:
+            out[idx] += temp
+        else:
+            out[idx] -= temp
+    return out
 
 
 def value_repair(ts: np.ndarray, vals: np.ndarray,
+                 method: str = "screen",
                  min_speed: float | None = None,
-                 max_speed: float | None = None) -> np.ndarray:
-    """SCREEN repair (value_repair.rs screen method): clamp each step's
-    rate of change into [smin, smax]; default bounds = median speed ±
-    3·MAD (the reference's auto-threshold)."""
-    t = np.asarray(ts, dtype=np.float64)
+                 max_speed: float | None = None,
+                 center: float | None = None,
+                 sigma: float | None = None) -> np.ndarray:
+    """Value repair (value_repair.rs): NaNs linear-filled first, then
+    SCREEN (windowed-median speed clamp) or LsGreedy."""
+    t = np.asarray(ts, dtype=np.int64)
     v = np.asarray(vals, dtype=np.float64).copy()
+    v[~np.isfinite(v)] = np.nan
     if len(v) < 2:
         return v
-    with np.errstate(invalid="ignore", divide="ignore"):
-        speed = np.diff(v) / np.diff(t)
-    if min_speed is None or max_speed is None:
-        mid = _dq_median(speed)
-        sigma = _dq_mad(speed)
-        if min_speed is None:
-            min_speed = mid - 3 * sigma
-        if max_speed is None:
-            max_speed = mid + 3 * sigma
-    for i in range(1, len(v)):
-        dt = t[i] - t[i - 1]
-        lo = v[i - 1] + min_speed * dt
-        hi = v[i - 1] + max_speed * dt
-        if v[i] < lo:
-            v[i] = lo
-        elif v[i] > hi:
-            v[i] = hi
-    return v
+    _process_nan_inplace(t, v)
+    if method == "lsgreedy":
+        return _lsgreedy_repair(t, v, center, sigma)
+    return _screen_repair(t, v, min_speed, max_speed)
 
 
 # ---------------------------------------------------------------------------

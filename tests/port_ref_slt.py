@@ -65,13 +65,61 @@ def _convert_value(tok: str, quoted: bool) -> str:
     return s
 
 
+_INT_RE = re.compile(r"^-?\d+$")
+_NUM_ANY_RE = re.compile(
+    r"^-?(?:\d+\.?\d*|\.\d+)(?:[eE][+-]?\d+)?$")
+
+
+def _regroup_tokens(toks: list[tuple[str, bool]]) -> list[tuple[str, bool]]:
+    """Rejoin upstream values the whitespace tokenizer shredded:
+    brace-balanced structs ('{first: {ts: ..., val: 4.0}, ...}') and
+    arrow interval renderings ('0 years 0 mons ... 0.035000000 secs')
+    become ONE cell each; interval seconds normalize through repr(float)
+    like every other numeric."""
+    out: list[tuple[str, bool]] = []
+    i = 0
+    n = len(toks)
+    while i < n:
+        tok, quoted = toks[i]
+        if not quoted and tok.startswith("{"):
+            depth = 0
+            parts = []
+            while i < n:
+                t = toks[i][0]
+                parts.append(t)
+                depth += t.count("{") - t.count("}")
+                i += 1
+                if depth <= 0:
+                    break
+            out.append((" ".join(parts), True))
+            continue
+        if (not quoted and _INT_RE.match(tok) and i + 11 < n
+                and [t[0] for t in toks[i + 1:i + 12:2]]
+                == ["years", "mons", "days", "hours", "mins", "secs"]
+                and all(_NUM_ANY_RE.match(toks[i + k][0])
+                        for k in (2, 4, 6, 8))
+                and _NUM_ANY_RE.match(toks[i + 10][0])):
+            vals = [toks[i + k][0] for k in range(0, 12, 2)]
+            secs = repr(float(vals[5]))
+            cell = (f"{vals[0]} years {vals[1]} mons {vals[2]} days "
+                    f"{vals[3]} hours {vals[4]} mins {secs} secs")
+            out.append((cell, True))
+            i += 12
+            continue
+        out.append((tok, quoted))
+        i += 1
+    return out
+
+
 def _convert_row(line: str) -> str:
-    cells = []
+    toks = []
     for m in _TOKEN_RE.finditer(line):
         if m.group(1) is not None:
-            cells.append(_convert_value(m.group(1), True))
+            toks.append((m.group(1), True))
         else:
-            cells.append(_convert_value(m.group(2), False))
+            toks.append((m.group(2), False))
+    cells = [_convert_value(tok, quoted)
+             for tok, quoted in _regroup_tokens(toks)]
     return ",".join(cells)
 
 

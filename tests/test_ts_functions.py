@@ -51,9 +51,11 @@ def test_gauge_agg_accessors(db):
     row = [c[0] for c in rs.columns]
     assert row[0] == 3.0                 # last - first (gauge/mod.rs:44)
     assert abs(row[1] - 0.75) < 1e-12    # delta / time_delta
-    # interval rendering (arrow IntervalMonthDayNano, 4ns span)
-    assert row[2] == ("0 years 0 mons 0 days 0 hours 0 mins "
-                      "0.000000004 secs")
+    # interval rendering (arrow IntervalMonthDayNano, 4ns span; seconds
+    # carry float repr — the slt port normalizes the reference's fixed
+    # 9 digits the same way)
+    assert str(row[2]) == ("0 years 0 mons 0 days 0 hours 0 mins "
+                           "4e-09 secs")
     assert row[3] == 1.0 and row[4] == 4.0
     assert row[5] == 4.0                 # second - first
     assert row[6] == 2.0                 # last - penultimate
@@ -116,8 +118,13 @@ def test_timestamp_repair(db):
                    "(10,'a',1),(20,'a',2),(30,'a',3),(50,'a',5),"
                    "(60,'a',6),(71,'a',7)")
     rs = db.execute_one("SELECT timestamp_repair(time, v) FROM tr")
-    assert rs.columns[0].tolist() == [10, 20, 30, 40, 50, 60, 70]
-    assert rs.columns[1].tolist() == [1, 2, 3, 4, 5, 6, 7]
+    # reference DP semantics (timestamp_repair.rs dp_repair): the grid
+    # extends to cover the last sample (ceil((71-10)/10)+1 slots → ..80),
+    # inserted slots are NaN (never interpolated), 71 aligns to 70
+    assert rs.columns[0].tolist() == [10, 20, 30, 40, 50, 60, 70, 80]
+    got = rs.columns[1].tolist()
+    assert got[:3] == [1, 2, 3] and got[4:7] == [5, 6, 7]
+    assert np.isnan(got[3]) and np.isnan(got[7])
 
 
 def test_value_fill(db):
