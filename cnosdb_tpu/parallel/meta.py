@@ -908,20 +908,44 @@ class MetaStore:
 
     # ------------------------------------------------------------ placement
     def locate_bucket_for_write(self, tenant: str, db: str, ts: int,
-                                nodes: list[int] | None = None) -> BucketInfo:
+                                nodes: list[int] | None = None,
+                                now_ns: int | None = None) -> BucketInfo:
         """Find-or-create the bucket covering ts (reference
-        meta_tenant.rs:716). `nodes` pins the placement candidates — the
-        replicated meta leader computes them BEFORE proposing so apply is
-        deterministic on every member (liveness is runtime state and may
-        differ across replicas)."""
+        meta_tenant.rs:716). `nodes` pins the placement candidates and
+        `now_ns` the TTL-expiry clock — the replicated meta leader
+        computes both BEFORE proposing so apply is deterministic on every
+        member and on log replay (liveness and wall time are runtime
+        state)."""
         with self.lock:
             owner = f"{tenant}.{db}"
             schema = self.database(tenant, db)
             for b in self.buckets.get(owner, []):
                 if b.contains(ts):
                     return b
+            # bucket-creation guards (reference meta_tenant.rs:562 /
+            # database_schema.rs:70-84): a write below now - ttl refuses
+            # with "create expired bucket" — and the INF TTL sentinel
+            # still subtracts i64::MAX, so timestamps hugging the i64-ns
+            # floor reject even without a TTL (time_window.slt pins it)
+            import time as _time
+
+            i64max = 2**63 - 1
+            # a TTL larger than the i64-ns domain saturates (upstream
+            # CnosDuration::to_nanoseconds caps at i64::MAX, so even
+            # '1000000d' leaves the extreme-past timestamps unwritable)
+            ttl_ns = min(schema.options.ttl.ns or i64max, i64max)
+            if now_ns is None:
+                now_ns = _time.time_ns()
+            if ts < now_ns - ttl_ns:
+                raise MetaError(
+                    f"create expired bucket db:{db} ts:{ts}")
             dur = schema.options.vnode_duration.ns or 365 * 86_400_000_000_000
             start = (ts // dur) * dur if ts >= 0 else -((-ts + dur - 1) // dur) * dur
+            if start + dur > i64max:
+                # bucket end would overflow the i64-ns domain (reference:
+                # "create bucket unknown error" at the max timestamp)
+                raise MetaError(
+                    f"create bucket unknown error db:{db} ts:{ts}")
             bucket = BucketInfo(self._next_bucket_id, start, start + dur, [])
             self._next_bucket_id += 1
             # spread replicas round-robin over alive nodes (reference

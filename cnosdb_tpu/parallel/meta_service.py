@@ -355,11 +355,17 @@ class MetaService:
             if self.raft.is_leader():
                 before = self.store.version
                 kwargs = dict(p.get("kwargs") or {})
-                if method == "locate_bucket_for_write" \
-                        and not kwargs.get("nodes"):
-                    # pin placement candidates at PROPOSAL time: apply must
-                    # be deterministic across members, liveness is not
-                    kwargs["nodes"] = self.store.placement_candidates()
+                if method == "locate_bucket_for_write":
+                    if not kwargs.get("nodes"):
+                        # pin placement candidates at PROPOSAL time: apply
+                        # must be deterministic across members, liveness
+                        # is not
+                        kwargs["nodes"] = self.store.placement_candidates()
+                    if kwargs.get("now_ns") is None:
+                        # the TTL expired-bucket check reads the clock —
+                        # pinned here so every member (and log replay)
+                        # accepts/rejects identically
+                        kwargs["now_ns"] = time.time_ns()
                 # wall-clock reads are likewise pinned at proposal: every
                 # member must stamp/purge trash identically
                 if method in ("drop_database", "drop_table",

@@ -1166,10 +1166,13 @@ class QueryExecutor:
                     else None
             except ValueError as e:
                 raise PlanError(f"Fail to parse argument: {e}")
+            # an explicit interval takes precedence and method is then
+            # never even validated (timestamp_repair.rs:70-85 checks
+            # arg.interval first)
+            method = None if interval is not None \
+                else _method({"median", "mode", "cluster"}, None)
             new_ts, new_vals = tsfuncs.timestamp_repair(
-                ts, vals,
-                method=_method({"median", "mode", "cluster"}, None),
-                interval=interval,
+                ts, vals, method=method, interval=interval,
                 start_mode=start_mode.lower() if start_mode else None)
         elif name == "value_fill":
             new_ts = ts
@@ -1386,8 +1389,20 @@ class QueryExecutor:
         exprs = [it.expr for it in stmt.items if isinstance(it.expr, Expr)]
         exprs += [e for e in (stmt.where, stmt.having) if e is not None]
         exprs += [e for e, _ in stmt.order_by if isinstance(e, Expr)]
+        exprs += [g for g in stmt.group_by if isinstance(g, Expr)]
         if any(rel.contains_window(e) for e in exprs):
             return True
+        if stmt.table is not None or stmt.from_item is not None:
+            tw = []
+            for e in exprs:
+                rel.walk_exprs(e, lambda x: tw.append(x)
+                               if isinstance(x, Func)
+                               and x.name.lower() == "time_window" else None)
+            if tw:
+                # TIME_WINDOW row expansion lives in the relational
+                # pipeline (_expand_time_window); the no-FROM constant
+                # form evaluates via the scalar Func registration
+                return True
         for e in exprs:
             for f in rel.collect_aggs(e, AGG_FUNCS):
                 args = f.args
@@ -1456,20 +1471,16 @@ class QueryExecutor:
 
     @staticmethod
     def _py_rows(rs):
-        """ResultSet columns → per-row python tuples with np scalars
-        unwrapped and NaN normalized to None (hash/eq-stable keys)."""
-        cols = []
-        for c in rs.columns:
-            vals = []
-            src = c.materialize() if hasattr(c, "materialize") else c
-            for v in src:
-                if hasattr(v, "item"):
-                    v = v.item()
-                if isinstance(v, float) and v != v:
-                    v = None
-                vals.append(v)
-            cols.append(vals)
-        return list(zip(*cols)) if cols else []
+        """ResultSet columns → per-row python tuples, normalized through
+        the SAME helper the probe side uses (expr._rows_of: np-scalar
+        unwrap, NaN→None) so build/probe key equality can't drift."""
+        from .expr import _rows_of
+
+        if not rs.columns:
+            return []
+        n = rs.n_rows
+        cols = [_rows_of(c, n) for c in rs.columns]
+        return list(zip(*cols))
 
     def _decorrelate_exists(self, e, session: Session):
         """Correlated EXISTS (`EXISTS (SELECT .. FROM u WHERE u.k = t.k
@@ -1765,6 +1776,11 @@ class QueryExecutor:
         name = e.name.lower()
         if not e.args:
             raise PlanError(f"{e.name}() requires an argument")
+        if name in ("approx_percentile_cont",
+                    "approx_percentile_cont_with_weight") \
+                and len(e.args) < 2:
+            raise PlanError(
+                f"{e.name} requires a column and a constant quantile")
         v = e.args[0].value
         if name in ("count", "count_distinct", "approx_distinct"):
             return 0 if v is None else 1
@@ -2021,6 +2037,8 @@ class QueryExecutor:
                 m = np.full(scope.n, bool(m))
             scope = scope.filter(m)
 
+        scope, stmt = self._expand_time_window(stmt, scope)
+
         has_agg = any(
             rel.collect_aggs(it.expr, AGG_FUNCS)
             for it in stmt.items if isinstance(it.expr, Expr))
@@ -2079,9 +2097,135 @@ class QueryExecutor:
         rs = _order_limit(rs, order_by, stmt.limit, stmt.offset, env_all)
         return self._distinct(rs) if stmt.distinct else rs
 
+    def _expand_time_window(self, stmt: ast.SelectStmt, scope: rel.Scope):
+        """Row-expanding TIME_WINDOW (reference transform_time_window.rs:
+        TIME_WINDOW → Expand): every row joins each sliding window that
+        contains its timestamp; the call sites are rewritten to a struct
+        column ({start, end} dicts) and all scope columns re-index by the
+        expansion. One distinct call per SELECT (upstream restriction)."""
+        calls: list[Func] = []
+
+        def spot(e):
+            if isinstance(e, Func) and not isinstance(e, WindowFunc) \
+                    and e.name.lower() == "time_window":
+                calls.append(e)
+
+        exprs = [it.expr for it in stmt.items if isinstance(it.expr, Expr)]
+        exprs += [g for g in stmt.group_by if isinstance(g, Expr)]
+        exprs += [e for e, _ in stmt.order_by if isinstance(e, Expr)]
+        if stmt.having is not None:
+            exprs.append(stmt.having)
+        for e in exprs:
+            rel.walk_exprs(e, spot)
+        if not calls:
+            return scope, stmt
+        sigs = {c.to_sql() for c in calls}
+        if len(sigs) > 1:
+            raise PlanError(
+                "only one TIME_WINDOW expression per SELECT is supported")
+        f = calls[0]
+        if not 2 <= len(f.args) <= 4:
+            raise PlanError(
+                "time_window(time, window[, slide[, start_time]])")
+        t = np.asarray(f.args[0].eval(scope.env, np))
+        if t.dtype.kind not in "iu":
+            raise PlanError(
+                "time_window's first argument must be a timestamp")
+        t = t.astype(np.int64)
+        window = self._tw_interval(f.args[1])
+        slide = self._tw_interval(f.args[2]) if len(f.args) > 2 else window
+        origin = 0
+        if len(f.args) > 3:
+            a = f.args[3]
+            v = a.eval({}, np) if isinstance(a, (Literal, expr_mod.Cast)) \
+                else None
+            if isinstance(v, str):
+                from .parser import parse_timestamp_string
+
+                v = parse_timestamp_string(v)
+            if not isinstance(v, (int, np.integer)):
+                raise PlanError("time_window start_time must be a "
+                                "timestamp constant")
+            origin = int(v)
+        if window <= 0 or slide <= 0:
+            raise PlanError("time_window durations must be positive")
+
+        # reference formula (transform_time_window.rs:248-393):
+        #   st_mod = start_time MOD window          (window, not slide!)
+        #   last_start = t - ((t - st_mod + slide) MOD slide)
+        #   window_start_i = last_start - i·slide, i ∈ [0, ⌈window/slide⌉)
+        # MOD is Rust's truncating remainder. EVERY i is emitted per row
+        # (a row can land in a window not covering its timestamp — the
+        # pinned 10ms/6ms rows show it); but when window % slide != 0
+        # the reference filters out SOURCE ROWS with t outside their own
+        # i=0 window (t ≥ last_start + window, possible when slide >
+        # window) — all copies of such a row drop together.
+        n_win = -(window // -slide)   # ceil
+        if n_win > 100:
+            raise PlanError(f"Too many overlapping windows: {n_win}")
+        st_mod = expr_mod.trunc_mod(origin, window)
+        last_start = t - np.fmod(t - st_mod + slide, slide)
+        if window % slide != 0:
+            rkeep = t < last_start + window
+            if not rkeep.all():
+                t = t[rkeep]
+                last_start = last_start[rkeep]
+                scope = scope.filter(rkeep)
+        n0 = len(t)
+        idx = np.repeat(np.arange(n0, dtype=np.int64), n_win)
+        ks = np.tile(np.arange(n_win, dtype=np.int64), n0)
+        starts_all = last_start[idx] - ks * slide
+        win_col = np.empty(len(idx), dtype=object)
+        for i, s in enumerate(starts_all):
+            win_col[i] = {"kind": "window", "start": int(s),
+                          "end": int(s) + window}
+        new_scope = rel.Scope(
+            scope.names, [c[idx] for c in scope.cols],
+            {k2: v[idx] for k2, v in scope.env.items()})
+        new_scope.quals = set(scope.quals)
+        new_scope.env["__time_window__"] = win_col
+
+        def rw(e):
+            if not isinstance(e, Expr):
+                return e
+            return rel.rewrite_exprs(
+                e, lambda x: isinstance(x, Func)
+                and not isinstance(x, WindowFunc)
+                and x.name.lower() == "time_window",
+                lambda x: Column("__time_window__"))
+
+        import dataclasses
+
+        stmt = dataclasses.replace(
+            stmt,
+            items=[ast.SelectItem(rw(it.expr), it.alias)
+                   for it in stmt.items],
+            group_by=[rw(g) for g in stmt.group_by],
+            order_by=[(rw(e), asc) for e, asc in stmt.order_by],
+            having=rw(stmt.having) if stmt.having is not None else None)
+        return new_scope, stmt
+
+    @staticmethod
+    def _tw_interval(arg) -> int:
+        """Interval constant for time_window durations: INTERVAL literal
+        or CAST(str AS INTERVAL)."""
+        if isinstance(arg, Literal) and hasattr(arg.value, "ns"):
+            return int(arg.value.ns)
+        if isinstance(arg, expr_mod.Cast) \
+                and arg.target.upper() == "INTERVAL" \
+                and isinstance(arg.expr, Literal) \
+                and isinstance(arg.expr.value, str):
+            from .parser import parse_interval_string
+
+            return int(parse_interval_string(arg.expr.value))
+        raise PlanError(
+            "time_window durations must be INTERVAL constants")
+
     def _host_group_aggregate(self, stmt: ast.SelectStmt, scope: rel.Scope):
         """GROUP BY + aggregates over a joined/derived relation — the
         host-side final-aggregate (single tables use the fused kernel)."""
+        alias_map = {it.alias: it.expr for it in stmt.items
+                     if it.alias and isinstance(it.expr, Expr)}
         key_exprs: list[Expr] = []
         for g in stmt.group_by:
             if isinstance(g, int):
@@ -2090,9 +2234,16 @@ class QueryExecutor:
                     raise PlanError("GROUP BY ordinal refers to *")
                 key_exprs.append(e)
             elif isinstance(g, Expr):
+                if isinstance(g, Column) and g.name not in scope.env \
+                        and g.name in alias_map:
+                    g = alias_map[g.name]   # GROUP BY a SELECT alias
                 key_exprs.append(g)
             else:
-                key_exprs.append(Column(str(g)))
+                name = str(g)
+                if name not in scope.env and name in alias_map:
+                    key_exprs.append(alias_map[name])
+                else:
+                    key_exprs.append(Column(name))
         key_cols = [np.asarray(e.eval(scope.env, np)) for e in key_exprs]
         gid, first_idx = rel.group_indices(key_cols, scope.n)
         n_groups = len(first_idx)
@@ -2621,6 +2772,10 @@ def _decompose_aggs(aggs: list[AggSpec]):
         elif a.func in ("count_distinct", "approx_distinct"):
             finalize[a.alias] = ("distinct", want("count_distinct", a.column))
         elif a.func == "array_agg" and isinstance(a.param, tuple) \
+                and a.param and a.param[0] == "const_array":
+            finalize[a.alias] = ("array_const", want("collect_ts", a.column),
+                                 a.param[1])
+        elif a.func == "array_agg" and isinstance(a.param, tuple) \
                 and a.param and a.param[0] == "order_time":
             finalize[a.alias] = ("array_ts", want("collect_ts", a.column),
                                  a.param[1], a.column == "time")
@@ -2635,8 +2790,13 @@ def _decompose_aggs(aggs: list[AggSpec]):
                                  a.param)
         elif a.func == "approx_percentile_cont_with_weight":
             wcol, q = a.param
-            finalize[a.alias] = ("percentile_w",
-                                 want("collect2", a.column, wcol), q)
+            if isinstance(wcol, tuple) and wcol[0] == "__const_w__":
+                finalize[a.alias] = ("percentile_w_const",
+                                     want("collect", a.column),
+                                     wcol[1], q)
+            else:
+                finalize[a.alias] = ("percentile_w",
+                                     want("collect2", a.column, wcol), q)
         elif a.func in ("corr", "covar", "covar_pop", "covar_samp"):
             kind = "covar_samp" if a.func == "covar" else a.func
             finalize[a.alias] = (kind,
@@ -2811,8 +2971,32 @@ def _iso_ns(ns: int) -> str:
     return base
 
 
+def _median_value(vals: np.ndarray):
+    """Median with DataFusion's type semantics: integer inputs compute
+    the even-count middle as (a + b) / 2 in INTEGER arithmetic
+    (truncating division — approx_median.slt pins median([1,4,5,6]) = 4),
+    floats interpolate."""
+    def all_int(a):
+        if np.issubdtype(a.dtype, np.integer):
+            return True
+        return a.dtype == object and len(a) and all(
+            isinstance(x, (int, np.integer))
+            and not isinstance(x, (bool, np.bool_)) for x in a)
+
+    if all_int(vals):
+        s = sorted(int(x) for x in vals)
+        m = len(s)
+        if m % 2:
+            return s[m // 2]
+        t = s[m // 2 - 1] + s[m // 2]
+        return t // 2 if t >= 0 else -((-t) // 2)   # truncate toward 0
+    return float(np.median(vals.astype(np.float64)))
+
+
 def _cell_repr(v) -> str:
     """array_agg element rendering (bare values, arrow list style)."""
+    if v is None:
+        return "NULL"
     if isinstance(v, (float, np.floating)):
         return repr(float(v))
     if isinstance(v, (bool, np.bool_)):
@@ -2846,7 +3030,7 @@ def _apply_finalizer(spec, parts: dict):
             return None
         vals = np.concatenate(chunks)
         if kind == "median":
-            return float(np.median(vals.astype(np.float64)))
+            return _median_value(vals)
         if kind == "stddev":
             return float(np.std(vals.astype(np.float64), ddof=1)) \
                 if len(vals) > 1 else None
@@ -2877,12 +3061,32 @@ def _apply_finalizer(spec, parts: dict):
             return "[" + ", ".join(_iso_ns(int(t)) for t in ts[order]) \
                 + "]"
         return "[" + ", ".join(_cell_repr(v) for v in vals) + "]"
+    if kind == "array_const":
+        chunks = parts.get(spec[1])
+        if not chunks:
+            return None
+        n_rows = sum(len(c[0]) for c in chunks)
+        return "[" + ", ".join([_cell_repr(spec[2])] * n_rows) + "]"
     if kind == "percentile":
         chunks = parts.get(spec[1])
         if not chunks:
             return None
         vals = np.concatenate(chunks).astype(np.float64)
         return float(np.quantile(vals, spec[2]))
+    if kind == "percentile_w_const":
+        chunks = parts.get(spec[1])
+        if not chunks:
+            return None
+        vals = np.concatenate(chunks).astype(np.float64)
+        w = np.full(len(vals), float(spec[2]))
+        order = np.argsort(vals)
+        vals, w = vals[order], w[order]
+        cum = np.cumsum(w)
+        if cum[-1] <= 0:
+            return None
+        target = spec[3] * cum[-1]
+        return float(vals[np.searchsorted(cum, target, side="left")
+                          .clip(0, len(vals) - 1)])
     if kind == "percentile_w":
         chunks = parts.get(spec[1])
         if not chunks:

@@ -661,6 +661,11 @@ class Func(Expr):
         # analyzer-injected marker: timestamp - timestamp yields an
         # INTERVAL (arrow-rendered); wraps the subtraction's ns result
         "__to_interval": lambda xp, a: _to_interval(a),
+        # scalar/constant form (SELECT time_window(cast(1 as timestamp),
+        # interval '3 day')): the row-expanding form is rewritten by the
+        # executor before evaluation (executor._expand_time_window)
+        "time_window": lambda xp, t, window, *rest: _time_window_scalar(
+            t, window, *rest),
     }
 
     def eval(self, env, xp):
@@ -687,6 +692,49 @@ class Func(Expr):
             # column names and EXPLAIN
             return self.args[0].to_sql()
         return f"{self.name}({', '.join(a.to_sql() for a in self.args)})"
+
+
+def trunc_mod(a: int, b: int) -> int:
+    """Rust/C truncating remainder (sign of the dividend) in exact int
+    arithmetic — np.fmod on python scalars would round through float64."""
+    r = a % b
+    if r and (a < 0) != (b < 0):
+        r -= b
+    return r
+
+
+def _interval_arg_ns(v) -> int:
+    """Interval-typed argument value → ns (IntervalValue literal or int)."""
+    if hasattr(v, "ns"):
+        return int(v.ns)
+    if isinstance(v, (int, np.integer)) and not isinstance(v, bool):
+        return int(v)
+    raise PlanError("time_window durations must be INTERVAL values")
+
+
+def _time_window_scalar(t, window, *rest):
+    """Tumbling window containing t (reference TIME_WINDOW with the slide
+    defaulted to the window width; origin = epoch or the 4th argument)."""
+    if t is None:
+        return None
+    if hasattr(t, "item"):
+        t = t.item()
+    w = _interval_arg_ns(window)
+    slide = _interval_arg_ns(rest[0]) if rest else w
+    origin = 0
+    if len(rest) > 1:
+        origin = rest[1]
+        if isinstance(origin, str):
+            from .parser import parse_timestamp_string
+
+            origin = parse_timestamp_string(origin)
+    if w <= 0 or slide <= 0:
+        raise PlanError("time_window durations must be positive")
+    t = int(t)
+    # st_mod uses the WINDOW duration (transform_time_window.rs:270-274)
+    st_mod = trunc_mod(int(origin), w)
+    start = t - trunc_mod(t - st_mod + slide, slide)
+    return {"kind": "window", "start": start, "end": start + w}
 
 
 def _to_interval(a):
@@ -1394,8 +1442,15 @@ def _parse_bool_str(s: str) -> bool:
 
 
 def _cast_scalar(x, kind: str):
-    """One value → cast target kind (i/u/f/s/b/t). Raises ValueError/
+    """One value → cast target kind (i/u/f/s/b/t/v). Raises ValueError/
     OverflowError on impossible casts (DataFusion-style strict CAST)."""
+    if kind == "v":   # INTERVAL: '3 day' → ns span (arrow-rendered)
+        from .parser import parse_interval_string
+        from .tsfuncs import IntervalNs
+
+        if isinstance(x, str):
+            return IntervalNs(parse_interval_string(x))
+        raise ValueError(f"cannot cast {x!r} to INTERVAL")
     if kind in ("i", "t", "u"):
         if isinstance(x, str):
             out = int(x.strip())
@@ -1432,7 +1487,7 @@ _CAST_KINDS = {"BIGINT": "i", "INT": "i", "INTEGER": "i",
                "DOUBLE": "f", "FLOAT": "f",
                "STRING": "s", "VARCHAR": "s", "TEXT": "s",
                "BOOLEAN": "b", "BOOL": "b", "TIMESTAMP": "t",
-               "CHAR": "s"}
+               "CHAR": "s", "INTERVAL": "v"}
 
 
 def iter_child_exprs(e):

@@ -579,19 +579,40 @@ class _AggCollector:
                 param = args[1].name
                 args = args[:1]
         if name == "approx_percentile_cont":
+            # optional third arg = t-digest centroid count (validated,
+            # then ignored: the exact computation needs no sketch size)
+            if len(args) == 3 and isinstance(args[2], Literal) \
+                    and isinstance(args[2].value, (int, float)) \
+                    and not isinstance(args[2].value, bool):
+                args = args[:2]
             if len(args) != 2 or not isinstance(args[1], Literal):
                 raise PlanError(
                     "approx_percentile_cont(col, q) takes a column and "
                     "a constant quantile")
             param = float(args[1].value)
+            if not 0.0 <= param <= 1.0:
+                raise PlanError(
+                    "Percentile value must be between 0.0 and 1.0 "
+                    f"inclusive, {param} is invalid")
             args = args[:1]
         if name == "approx_percentile_cont_with_weight":
-            if len(args) != 3 or not isinstance(args[1], Column) \
+            if len(args) != 3 \
+                    or not isinstance(args[1], (Column, Literal)) \
                     or not isinstance(args[2], Literal):
                 raise PlanError(
                     "approx_percentile_cont_with_weight(col, w, q) takes "
                     "two columns and a constant quantile")
-            param = (args[1].name, float(args[2].value))
+            q = float(args[2].value)
+            if not 0.0 <= q <= 1.0:
+                raise PlanError(
+                    "Percentile value must be between 0.0 and 1.0 "
+                    f"inclusive, {q} is invalid")
+            if isinstance(args[1], Literal):
+                # constant weight column (incl. negative constants, which
+                # the weighted-cumsum computation handles the same way)
+                param = (("__const_w__", float(args[1].value)), q)
+            else:
+                param = (args[1].name, q)
             args = args[:1]
         if name not in TS_PAIR_AGGS and name not in ("sample", "count") \
                 and name not in _TWO_COL_AGGS \
@@ -635,6 +656,15 @@ class _AggCollector:
             name, col = "const_agg:" + name, None
         elif name.startswith("const_agg:"):
             pass   # already resolved to a constant aggregate above
+        elif name == "array_agg" and args \
+                and isinstance(args[0], Literal) \
+                and args[0].value != "*":
+            # constant element (array_agg(3), array_agg(NULL)): ride the
+            # time column for the row count, substitute at finalize
+            param = ("const_array", args[0].value,
+                     param[1] if isinstance(param, tuple)
+                     and param and param[0] == "order_time" else True)
+            col = TIME_COL
         elif name in ("gauge_agg", "state_agg", "compact_state_agg") \
                 and args and isinstance(args[0], Literal):
             # constant value column (compact_state_agg(time, 1)): collect
@@ -652,12 +682,24 @@ class _AggCollector:
                 raise PlanError("DISTINCT only supported in count()")
             name = "count_distinct"
         if name == "array_agg" and getattr(f, "agg_order", None) \
-                is not None:
+                is not None and not (isinstance(param, tuple) and param
+                                     and param[0] == "const_array"):
             oe, asc = f.agg_order
             if not (isinstance(oe, Column) and oe.name == TIME_COL):
                 raise PlanError(
                     "array_agg ORDER BY supports the time column")
             param = ("order_time", asc)
+        if name == "approx_distinct" and col is not None \
+                and col != TIME_COL and self.schema.contains_column(col):
+            c = self.schema.column(col)
+            vt = getattr(getattr(c, "column_type", None), "value_type",
+                         None)
+            if vt is not None and vt.name in ("FLOAT", "BOOLEAN"):
+                # DataFusion's HLL has no Float64/Boolean accumulators
+                # (approx_distinct.slt pins both as errors)
+                raise PlanError(
+                    f"Support for 'approx_distinct' for data type "
+                    f"{vt.name} is not implemented")
         if name == "approx_distinct" and col == TIME_COL:
             raise PlanError(
                 "the function approx_distinct does not support inputs "
@@ -669,7 +711,8 @@ class _AggCollector:
             if name in _TWO_COL_AGGS and isinstance(param, str):
                 check_cols.append(param)
             if isinstance(param, tuple) and name.startswith(
-                    "approx_percentile"):   # percentile weight column
+                    "approx_percentile") \
+                    and isinstance(param[0], str):   # weight column name
                 check_cols.append(param[0])
             for cc in check_cols:
                 if cc == TIME_COL:

@@ -86,6 +86,21 @@ def null_safe_key(v: np.ndarray):
         return v, None
     nulls = np.array([x is None for x in v], dtype=np.int8)
     non_null = [x for x in v if x is not None]
+    if non_null and all(isinstance(x, dict) for x in non_null):
+        # composite struct column (time_window / gauge dicts): sort by
+        # the natural ordering of its fields — windows by (start, end)
+        def skey(x):
+            if x is None:
+                return ""
+            if x.get("kind") == "window":
+                # bias to unsigned so lexicographic == chronological
+                # for pre-epoch starts too
+                return (f"{x['start'] + (1 << 63):020d}:"
+                        f"{x['end'] + (1 << 63):020d}")
+            return str(x)
+
+        return np.array([skey(x) for x in v], dtype=object), \
+            (nulls if nulls.any() else None)
     if non_null and all(
             isinstance(x, (int, np.integer))
             and not isinstance(x, (bool, np.bool_)) for x in non_null):
@@ -548,7 +563,9 @@ def host_aggregate(func: str, col, gid: np.ndarray, n_groups: int,
             grp = vs[s0:s1]
             gi = int(gs[s0])
             if func == "median":
-                out[gi] = float(np.median(grp.astype(np.float64)))
+                from .executor import _median_value
+
+                out[gi] = _median_value(grp)
             elif func == "stddev":
                 out[gi] = (float(np.std(grp.astype(np.float64), ddof=1))
                            if len(grp) > 1 else None)

@@ -98,10 +98,14 @@ def test_ttl_bucket_expiry(db):
     s = Session(database="short")
     ex.execute_one("CREATE TABLE m (v DOUBLE, TAGS(h))", s)
     now = int(time.time() * 1e9)
-    old = now - 3 * 86_400_000_000_000
+    # writes below now - ttl are REJECTED at bucket creation (reference
+    # "create expired bucket"), so build two buckets inside the TTL and
+    # age one out by advancing the expiry clock instead
+    old = now - 12 * 3_600_000_000_000   # 12h ago, within the 1d TTL
     ex.execute_one(f"INSERT INTO m (time, h, v) VALUES ({old}, 'a', 1), ({now}, 'a', 2)", s)
     assert len(meta.buckets_for(DEFAULT_TENANT, "short")) == 2
-    expired = meta.expire_buckets(DEFAULT_TENANT, "short", now)
+    expired = meta.expire_buckets(DEFAULT_TENANT, "short",
+                                  now + 86_400_000_000_000)
     assert len(expired) == 1
     owner = f"{DEFAULT_TENANT}.short"
     for rs_ in expired[0].shard_group:
