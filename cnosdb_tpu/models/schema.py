@@ -234,12 +234,39 @@ class TskvTableSchema:
         self._next_id = max(self._next_id, c.id + 1)
 
     def add_column(self, name: str, column_type: ColumnType,
-                   encoding: Encoding | None = None) -> TableColumn:
+                   encoding: Encoding | None = None,
+                   sorted_insert: bool = False) -> TableColumn:
+        """`sorted_insert` keeps same-kind columns name-ordered — the
+        line-protocol schema-inference path uses it (the reference's
+        inferred schemas are BTreeMap-backed, so SELECT * over an
+        lp-evolved table lists fields alphabetically); explicit ALTER ADD
+        appends."""
         col = TableColumn(self._next_id, name, column_type,
                           encoding if encoding is not None else Encoding.DEFAULT)
         if encoding is None:
             col.encoding = col.default_encoding()
-        self._add(col)
+        if sorted_insert:
+            if col.name in self._by_name:
+                raise SchemaError(
+                    f"duplicate column {col.name!r} in {self.name}")
+            if not _IDENT_RE.match(col.name):
+                raise SchemaError(f"invalid column name {col.name!r}")
+            pos = len(self.columns)
+            for i, c in enumerate(self.columns):
+                if c.column_type.is_time:
+                    continue
+                same_kind = c.column_type.is_tag == column_type.is_tag
+                if same_kind and c.name > name:
+                    pos = i
+                    break
+                if column_type.is_tag and not c.column_type.is_tag:
+                    pos = i   # tags precede fields in the layout
+                    break
+            self.columns.insert(pos, col)
+            self._by_name[col.name] = col
+            self._next_id = max(self._next_id, col.id + 1)
+        else:
+            self._add(col)
         self.schema_version += 1
         return col
 

@@ -375,11 +375,13 @@ class Coordinator:
         for sr in series_list:
             for t in sr.key.tags:
                 if not schema.contains_column(t.key):
-                    schema.add_column(t.key, ColumnType.tag())
+                    schema.add_column(t.key, ColumnType.tag(),
+                                      sorted_insert=True)
                     changed = True
             for name, (vt, _vals) in sr.fields.items():
                 if not schema.contains_column(name):
-                    schema.add_column(name, ColumnType.field(ValueType(vt)))
+                    schema.add_column(name, ColumnType.field(ValueType(vt)),
+                                      sorted_insert=True)
                     changed = True
         if changed:
             self.meta.update_table(schema)

@@ -834,7 +834,8 @@ class MetaStore:
                               path: str, fmt: str = "csv",
                               header: bool = True,
                               if_not_exists: bool = False,
-                              options: dict | None = None):
+                              options: dict | None = None,
+                              columns: list | None = None):
         """File- or object-store-backed table (reference
         create_external_table.rs:189; s3/gcs/azblob connection options per
         spi/src/query/datasource/)."""
@@ -848,7 +849,8 @@ class MetaStore:
                     return
                 raise TableAlreadyExists(name)
             tbls[name] = {"path": path, "fmt": fmt, "header": header,
-                          "options": dict(options or {})}
+                          "options": dict(options or {}),
+                          "columns": [list(c) for c in (columns or [])]}
             self._persist()
         self._notify("create_external", owner=owner, table=name)
 

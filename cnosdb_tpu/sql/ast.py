@@ -48,10 +48,11 @@ class TableRef:
 
 @dataclass
 class SubqueryRef:
-    """FROM (SELECT ...) alias — a derived relation."""
+    """FROM (SELECT ...) alias [(col, ...)] — a derived relation."""
 
     select: Any                    # SelectStmt | UnionStmt
     alias: str
+    col_aliases: list = field(default_factory=list)   # positional renames
 
 
 @dataclass
@@ -282,6 +283,9 @@ class CreateExternalTable:
     header: bool = True
     if_not_exists: bool = False
     options: dict = field(default_factory=dict)   # object-store connection
+    # declared column list [(name, sql_type)] — overrides inferred names
+    # and coerces types (tpch.slt declares NUMERIC over CSV)
+    columns: list = field(default_factory=list)
 
 
 @dataclass
@@ -365,9 +369,15 @@ class KillQuery:
 
 @dataclass
 class IntervalValue:
-    """INTERVAL literal resolved to nanoseconds."""
+    """INTERVAL literal. `ns` is the legacy fixed total (months 30d,
+    years 365d — what bucketing consumes); `months`/`sub_ns` carry the
+    calendar-true decomposition for date arithmetic."""
 
     ns: int
+    months: int = 0
+    sub_ns: int | None = None
 
     def __repr__(self):
+        if self.months:
+            return f"Interval({self.months}mo+{self.sub_ns or 0}ns)"
         return f"Interval({self.ns}ns)"

@@ -290,7 +290,8 @@ class QueryExecutor:
         if isinstance(stmt, ast.CreateExternalTable):
             self.meta.create_external_table(
                 session.tenant, session.database, stmt.name, stmt.path,
-                stmt.fmt, stmt.header, stmt.if_not_exists, stmt.options)
+                stmt.fmt, stmt.header, stmt.if_not_exists, stmt.options,
+                stmt.columns)
             return ResultSet.message("ok")
         if isinstance(stmt, ast.CopyStmt):
             return self._copy(stmt, session)
@@ -774,6 +775,27 @@ class QueryExecutor:
 
             now_ns = int(_time.time() * 1e9)
             src_rows = [list(r) + [now_ns] for r in src_rows]
+        if stmt.select is None and len(src_rows) > 1:
+            # DataFusion types the VALUES list itself: mixing literal
+            # classes in one column position is an error before any
+            # schema coercion ("Inconsistent data type across values
+            # list" — sqlancer/function.slt)
+            for j in range(len(cols)):
+                seen_cls = None
+                for i, r in enumerate(src_rows):
+                    v = r[j] if j < len(r) else None
+                    if v is None:
+                        continue
+                    cls = (bool if isinstance(v, bool) else
+                           int if isinstance(v, int) else
+                           float if isinstance(v, float) else
+                           str if isinstance(v, str) else type(v))
+                    if seen_cls is None:
+                        seen_cls = cls
+                    elif cls is not seen_cls:
+                        raise ExecutionError(
+                            f"Inconsistent data type across values list "
+                            f"at row {i} column {j}")
         rows = []
         for raw in src_rows:
             if len(raw) != len(cols):
@@ -791,6 +813,10 @@ class QueryExecutor:
             if not any(row.get(c) is not None for c in field_types):
                 raise ExecutionError(
                     "INSERT row has no non-NULL field value")
+            for c, vt in field_types.items():
+                v = row.get(c)
+                if v is not None:
+                    row[c] = _insert_coerce(vt, v, c)
             for c in field_types:
                 sub = schema.column(c).geom_subtype \
                     if schema.contains_column(c) else None
@@ -1416,7 +1442,40 @@ class QueryExecutor:
                     return True
         return False
 
-    def _split_correlation(self, q, session: Session):
+    def _catalog_columns(self, from_item, table: str | None,
+                         session: Session) -> set | None:
+        """Column-name set of a FROM clause, resolved from catalog
+        metadata only (no execution) — None when any relation's columns
+        can't be known statically. Lets decorrelation classify
+        UNQUALIFIED outer references (tpch q2/q17/q20 correlate on bare
+        column names)."""
+        def of_item(item):
+            if item is None:
+                return set()
+            if isinstance(item, ast.TableRef):
+                db = item.database or session.database
+                sch = self.meta.table_opt(session.tenant, db, item.name)
+                if sch is not None:
+                    return set(sch.field_names()) | set(sch.tag_names()) \
+                        | {"time"}
+                ext = self.meta.external_opt(session.tenant, db, item.name)
+                if ext is not None and ext.get("columns"):
+                    return {c[0] for c in ext["columns"]}
+                return None
+            if isinstance(item, ast.Join):
+                a = of_item(item.left)
+                b = of_item(item.right)
+                return None if a is None or b is None else a | b
+            return None   # derived tables / VALUES: undeterminable here
+
+        if from_item is not None:
+            return of_item(from_item)
+        if table is not None:
+            return of_item(ast.TableRef(table, None, None))
+        return set()
+
+    def _split_correlation(self, q, session: Session,
+                           outer_cols: set | None = None):
         """Shared decorrelation front end: analyze the subquery body and
         split its WHERE into correlated equality pairs and a local
         residual (reference: DataFusion's subquery optimizer rules,
@@ -1435,20 +1494,29 @@ class QueryExecutor:
         local_quals = self._from_qualifiers(q)
         if not local_quals:
             return None
+        # column-level resolution for UNQUALIFIED names: a bare column
+        # that is NOT in the subquery's own relations but IS in the outer
+        # query's is a correlated reference (catalog-only check; when the
+        # inner columns can't be known statically, bare names stay local,
+        # the pre-existing conservative behavior)
+        local_cols = self._catalog_columns(q.from_item, q.table, session)
+
+        def col_outer(c: str) -> bool:
+            if "." in c:
+                return c.split(".", 1)[0] not in local_quals
+            return (local_cols is not None and outer_cols
+                    and c not in local_cols and c in outer_cols)
 
         def is_outer(expr: Expr) -> bool:
             cols = expr.columns()
-            return bool(cols) and all(
-                "." in c and c.split(".", 1)[0] not in local_quals
-                for c in cols)
+            return bool(cols) and all(col_outer(c) for c in cols)
 
         def is_local(expr: Expr) -> bool:
-            cols = expr.columns()
-            return all(("." not in c) or c.split(".", 1)[0] in local_quals
-                       for c in cols)
+            return not any(col_outer(c) for c in expr.columns())
 
         pairs = []            # [(outer_expr, inner_expr)]
-        residual = []
+        residual = []         # fully-local conjuncts
+        cross = []            # conjuncts mixing inner and outer columns
         from .relational import _split_conjuncts
 
         for c in _split_conjuncts(q.where):
@@ -1461,13 +1529,13 @@ class QueryExecutor:
                         took = True
                         break
             if not took:
-                residual.append(c)
+                if is_local(c) and not is_outer(c):
+                    residual.append(c)
+                else:
+                    cross.append(c)
         if not pairs:
             return None
-        # every residual conjunct must be fully local
-        if not all(is_local(c) and not is_outer(c) for c in residual):
-            return None
-        return q, pairs, residual
+        return q, pairs, residual, cross, col_outer
 
     @staticmethod
     def _py_rows(rs):
@@ -1482,16 +1550,17 @@ class QueryExecutor:
         cols = [_rows_of(c, n) for c in rs.columns]
         return list(zip(*cols))
 
-    def _decorrelate_exists(self, e, session: Session):
+    def _decorrelate_exists(self, e, session: Session,
+                            outer_cols: set | None = None):
         """Correlated EXISTS (`EXISTS (SELECT .. FROM u WHERE u.k = t.k
         AND <local preds>)`) → semi-join: one equality conjunct becomes
         an IN over the inner key set, several become a KeyInSet over key
         tuples; NOT EXISTS → the anti-join form (outer NULL keys stay,
         unlike NOT IN's 3VL). Returns the replacement Expr or None."""
-        split = self._split_correlation(e.select, session)
+        split = self._split_correlation(e.select, session, outer_cols)
         if split is None:
             return None
-        q, pairs, residual = split
+        q, pairs, residual, cross, col_outer = split
         if q.group_by or q.having is not None or q.order_by or \
                 q.limit is not None or q.offset:
             return None   # EXISTS bodies with those don't need them anyway
@@ -1519,6 +1588,12 @@ class QueryExecutor:
             probe = dataclasses.replace(q, where=probe_where)
             self._select(probe, session)
             return Literal(not e.negated)
+        if cross:
+            # cross-correlation conjuncts (inner col vs outer col, tpch
+            # q21): semi-join on the equality keys, then evaluate the
+            # remaining conjuncts per (outer row, inner candidate)
+            return self._decorrelate_exists_cross(
+                e, q, pairs, residual, cross, col_outer, session)
         inner_q = dataclasses.replace(
             _copy.copy(q),
             items=[ast.SelectItem(inner, f"__ck{i}")
@@ -1544,17 +1619,71 @@ class QueryExecutor:
                 if not any(k is None for k in row)}
         return expr_mod.KeyInSet([o for o, _i in pairs], keys, e.negated)
 
-    def _decorrelate_scalar(self, e, session: Session):
+    def _decorrelate_exists_cross(self, e, q, pairs, residual, cross,
+                                  col_outer, session: Session):
+        """EXISTS with mixed inner/outer conjuncts → CorrExists: inner
+        rows bucket by the equality keys carrying the columns the cross
+        conjuncts need; those conjuncts re-evaluate per candidate."""
+        import copy as _copy
+        import dataclasses
+
+        inner_cols: list[str] = []
+        outer_cols_used: list[str] = []
+        for c in cross:
+            for col in sorted(c.columns()):
+                if col_outer(col):
+                    if col not in outer_cols_used:
+                        outer_cols_used.append(col)
+                elif col not in inner_cols:
+                    inner_cols.append(col)
+        inner_map = {c: f"__cc{i}" for i, c in enumerate(inner_cols)}
+        outer_map = {c: f"__oc{i}" for i, c in enumerate(outer_cols_used)}
+
+        def rw(conj):
+            return rel.rewrite_exprs(
+                conj,
+                lambda x: isinstance(x, Column)
+                and (x.name in inner_map or x.name in outer_map),
+                lambda x: Column(inner_map.get(x.name)
+                                 or outer_map[x.name]))
+
+        cross_rw = [rw(c) for c in cross]
+        items = [ast.SelectItem(inner, f"__ck{i}")
+                 for i, (_o, inner) in enumerate(pairs)]
+        items += [ast.SelectItem(Column(c), inner_map[c])
+                  for c in inner_cols]
+        inner_q = dataclasses.replace(
+            _copy.copy(q), items=items, where=self._conjoin(residual))
+        rs = self._select(inner_q, session)
+        n_eq = len(pairs)
+        inner_rows: dict = {}
+        for row in self._py_rows(rs):
+            key = row[:n_eq]
+            if any(k is None for k in key):
+                continue
+            inner_rows.setdefault(key, []).append(
+                {inner_map[c]: v
+                 for c, v in zip(inner_cols, row[n_eq:])})
+        args = [o for o, _i in pairs] + [Column(c)
+                                         for c in outer_cols_used]
+        return expr_mod.CorrExists(
+            args, n_eq, [outer_map[c] for c in outer_cols_used],
+            inner_rows, cross_rw, e.negated)
+
+    def _decorrelate_scalar(self, e, session: Session,
+                            outer_cols: set | None = None):
         """Correlated scalar subquery → grouped-aggregate lookup
         (scalar-subquery-to-join): run the body once GROUPED BY its
         correlation columns, then map each outer row's key through the
         result. COUNT-shaped bodies default to 0 on missing keys, others
         to NULL; non-aggregate bodies enforce at-most-one-row per probed
         key. Returns a CorrLookup or None when not this pattern."""
-        split = self._split_correlation(e.select, session)
+        split = self._split_correlation(e.select, session, outer_cols)
         if split is None:
             return None
-        q, pairs, residual = split
+        q, pairs, residual, cross, _co = split
+        if cross:
+            return None   # mixed inner/outer conjuncts: EXISTS-only form
         if q.group_by or q.having is not None or q.order_by or \
                 q.limit is not None or q.offset or len(q.items) != 1:
             return None
@@ -1608,14 +1737,17 @@ class QueryExecutor:
                 mapping[key] = row[-1]
         return expr_mod.CorrLookup(outer_exprs, mapping, None)
 
-    def _decorrelate_in(self, e, session: Session):
+    def _decorrelate_in(self, e, session: Session,
+                        outer_cols: set | None = None):
         """Correlated IN subquery (`a [NOT] IN (SELECT v FROM u WHERE
         u.k = t.k ..)`) → per-key membership with full three-valued
         logic (CorrIn). Returns the replacement Expr or None."""
-        split = self._split_correlation(e.select, session)
+        split = self._split_correlation(e.select, session, outer_cols)
         if split is None:
             return None
-        q, pairs, residual = split
+        q, pairs, residual, cross, _co = split
+        if cross:
+            return None   # mixed inner/outer conjuncts: EXISTS-only form
         if q.group_by or q.having is not None or q.order_by or \
                 q.limit is not None or q.offset or len(q.items) != 1:
             return None
@@ -1714,18 +1846,21 @@ class QueryExecutor:
         if not found:
             return stmt
 
+        outer_cols = self._catalog_columns(stmt.from_item, stmt.table,
+                                           session)
+
         def replace(e):
             q = e.select
             if isinstance(e, expr_mod.Exists):
-                corr = self._decorrelate_exists(e, session)
+                corr = self._decorrelate_exists(e, session, outer_cols)
                 if corr is not None:
                     return corr
             elif isinstance(e, Subquery):
-                corr = self._decorrelate_scalar(e, session)
+                corr = self._decorrelate_scalar(e, session, outer_cols)
                 if corr is not None:
                     return corr
             elif isinstance(e, InSubquery):
-                corr = self._decorrelate_in(e, session)
+                corr = self._decorrelate_in(e, session, outer_cols)
                 if corr is not None:
                     return corr
             rs = self._union(q, session) if isinstance(q, ast.UnionStmt) \
@@ -1962,8 +2097,19 @@ class QueryExecutor:
             q = item.select
             rs = self._union(q, session) if isinstance(q, ast.UnionStmt) \
                 else self._select(q, session)
+            names = rs.names
+            aliases = getattr(item, "col_aliases", None)
+            if aliases:
+                # derived-table column list renames positionally
+                # (tpch.slt q13: FROM (...) AS c_orders (c_custkey, c_count))
+                if len(aliases) > len(names):
+                    raise PlanError(
+                        f"derived table {item.alias} declares "
+                        f"{len(aliases)} columns, query returns "
+                        f"{len(names)}")
+                names = list(aliases) + names[len(aliases):]
             # pushed_where (if any) applies post-materialization
-            scope = rel.Scope.from_relation(rs.names, rs.columns, item.alias)
+            scope = rel.Scope.from_relation(names, rs.columns, item.alias)
             if pushed_where is not None:
                 w = self._strip_alias(pushed_where, item.alias)
                 m = np.asarray(w.eval(scope.env, np))
@@ -2128,6 +2274,17 @@ class QueryExecutor:
             raise PlanError(
                 "time_window(time, window[, slide[, start_time]])")
         t = np.asarray(f.args[0].eval(scope.env, np))
+        if t.dtype == object:
+            # struct-field access (tsbench windows over window.start of
+            # an inner time_window) yields object ints; NULL rows drop
+            keep0 = np.array([isinstance(x, (int, np.integer))
+                              and not isinstance(x, (bool, np.bool_))
+                              for x in t], dtype=bool)
+            if not keep0.all():
+                scope = scope.filter(keep0)
+                t = t[keep0]
+            t = t.astype(np.int64) if len(t) else \
+                np.zeros(0, dtype=np.int64)
         if t.dtype.kind not in "iu":
             raise PlanError(
                 "time_window's first argument must be a timestamp")
@@ -2247,9 +2404,18 @@ class QueryExecutor:
         key_cols = [np.asarray(e.eval(scope.env, np)) for e in key_exprs]
         gid, first_idx = rel.group_indices(key_cols, scope.n)
         n_groups = len(first_idx)
+        if n_groups == 0 and not key_exprs:
+            # a GLOBAL aggregate over zero rows still yields one row
+            # (count 0 / NULL sums — tpch q6 over an empty filter)
+            n_groups = 1
 
         agg_cache: dict[str, np.ndarray] = {}
-        genv = {k: v[first_idx] for k, v in scope.env.items()}
+        genv = {}
+        for k, v in scope.env.items():
+            gv = v[first_idx]
+            if n_groups and len(gv) < n_groups:   # synthesized empty group
+                gv = np.full(n_groups, None, dtype=object)
+            genv[k] = gv
 
         def agg_col(f: Func) -> str:
             distinct = bool(f.args) and isinstance(f.args[0], Literal) \
@@ -2324,6 +2490,19 @@ class QueryExecutor:
         # ORDER BY count(*) etc. must see the same aggregate rewrites
         order_by = [(rewrite(e) if isinstance(e, Expr) else e, asc)
                     for e, asc in stmt.order_by]
+        if not key_exprs:
+            # a GLOBAL aggregate exposes only its aggregate outputs:
+            # ORDER BY a raw column is a schema error (sqlancer pins
+            # "No field named m0.t0" for ORDER BY under SUM(...))
+            allowed = set(out_names) | set(agg_cache)
+            for oe, _asc in order_by:
+                cols_ref = oe.columns() if isinstance(oe, Expr) else \
+                    ({oe} if isinstance(oe, str) else set())
+                bad = [c for c in cols_ref if c not in allowed]
+                if bad:
+                    raise PlanError(
+                        f"No field named {bad[0]} in the aggregate "
+                        f"output")
         return rs, env_all, order_by
 
     def _distinct(self, rs: ResultSet) -> ResultSet:
@@ -2781,7 +2960,8 @@ def _decompose_aggs(aggs: list[AggSpec]):
                                  a.param[1], a.column == "time")
         elif a.func in ("median", "approx_median", "stddev",
                         "stddev_samp", "stddev_pop", "var", "var_samp",
-                        "var_pop", "mode", "array_agg"):
+                        "var_pop", "mode", "array_agg",
+                        "bit_and", "bit_or", "bit_xor"):
             kind = {"approx_median": "median", "stddev_samp": "stddev",
                     "var": "var_samp"}.get(a.func, a.func)
             finalize[a.alias] = (kind, want("collect", a.column))
@@ -2871,6 +3051,26 @@ def _load_external(ext: dict) -> tuple[list[str], list[np.ndarray]]:
                             for v in col.to_pylist()], dtype=object)
         names.append(name)
         cols.append(arr)
+    declared = ext.get("columns") or []
+    if declared:
+        # declared column list (tpch.slt): positional rename + coercion
+        names = [c[0] for c in declared[:len(cols)]] + names[len(declared):]
+        for i, (_cn, sql_type) in enumerate(declared[:len(cols)]):
+            t = sql_type.upper()
+            a = cols[i]
+            try:
+                if t in ("NUMERIC", "DOUBLE", "FLOAT", "DECIMAL", "REAL"):
+                    if a.dtype != object:
+                        cols[i] = a.astype(np.float64)
+                elif t in ("INTEGER", "INT", "BIGINT"):
+                    if a.dtype != object and a.dtype.kind != "f":
+                        cols[i] = a.astype(np.int64)
+                elif t in ("VARCHAR", "STRING", "TEXT", "CHAR"):
+                    if a.dtype != object:
+                        cols[i] = np.array([str(v) for v in a],
+                                           dtype=object)
+            except (TypeError, ValueError):
+                pass   # keep the inferred dtype on impossible coercions
     return names, cols
 
 
@@ -2971,6 +3171,50 @@ def _iso_ns(ns: int) -> str:
     return base
 
 
+def _insert_coerce(vt, v, col: str):
+    """INSERT value → column type, with DataFusion's CAST semantics
+    (type_conversion/between.slt pins 23.456 into BIGINT as 23;
+    boolean.slt pins 1/0 into BOOLEAN as true/false)."""
+    from ..models.schema import ValueType as VT
+
+    is_bool = isinstance(v, (bool, np.bool_))
+    try:
+        if vt == VT.FLOAT:
+            if is_bool:
+                raise ValueError("BOOLEAN into DOUBLE")
+            return float(v)
+        if vt in (VT.INTEGER, VT.UNSIGNED):
+            if is_bool:
+                raise ValueError("BOOLEAN into BIGINT")
+            if isinstance(v, float):
+                if v != v or v in (float("inf"), float("-inf")):
+                    raise ValueError("NaN/Inf into BIGINT")
+                v = int(v)   # truncation toward zero (CAST semantics)
+            elif isinstance(v, str):
+                v = int(v.strip())
+            v = int(v)
+            if vt == VT.UNSIGNED and v < 0:
+                raise ValueError("negative into UNSIGNED")
+            return v
+        if vt == VT.BOOLEAN:
+            if is_bool:
+                return bool(v)
+            if isinstance(v, (int, float)):
+                return v != 0
+            if isinstance(v, str):
+                from .expr import _parse_bool_str
+
+                return _parse_bool_str(v)
+            raise ValueError(f"{type(v).__name__} into BOOLEAN")
+        if vt in (VT.STRING, VT.GEOMETRY):
+            return v if isinstance(v, str) else str(v)
+    except (ValueError, OverflowError) as e:
+        raise ExecutionError(
+            f"INSERT value {v!r} cannot be cast to the {vt.name} "
+            f"column {col!r}: {e}")
+    return v
+
+
 def _median_value(vals: np.ndarray):
     """Median with DataFusion's type semantics: integer inputs compute
     the even-count middle as (a + b) / 2 in INTEGER arithmetic
@@ -3024,11 +3268,13 @@ def _apply_finalizer(spec, parts: dict):
         vals = parts.get(spec[1])
         return len(vals) if vals is not None else 0
     if kind in ("median", "stddev", "stddev_pop", "var_samp", "var_pop",
-                "mode", "array_agg"):
+                "mode", "array_agg", "bit_and", "bit_or", "bit_xor"):
         chunks = parts.get(spec[1])
         if not chunks:
             return None
         vals = np.concatenate(chunks)
+        if kind in ("bit_and", "bit_or", "bit_xor"):
+            return rel.bit_reduce(kind, vals)
         if kind == "median":
             return _median_value(vals)
         if kind == "stddev":
@@ -3126,7 +3372,8 @@ def _apply_finalizer(spec, parts: dict):
             return None
         if func in ("avg", "mean", "median"):
             return float(value)
-        if func in ("min", "max", "first", "last"):
+        if func in ("min", "max", "first", "last",
+                    "bit_and", "bit_or", "bit_xor"):
             return value
         if func in ("stddev", "stddev_samp", "var", "var_samp"):
             return 0.0 if rows > 1 else None
@@ -3209,7 +3456,8 @@ def _vector_finalize(spec, parts_env: dict, n: int):
             return np.where(ok, value * rows, 0), ok
         if func in ("avg", "mean", "median"):
             return np.where(ok, float(value), np.nan), ok
-        if func in ("min", "max", "first", "last"):
+        if func in ("min", "max", "first", "last",
+                    "bit_and", "bit_or", "bit_xor"):
             return np.where(ok, value, 0), ok
         if func in ("stddev", "stddev_samp", "var", "var_samp"):
             return np.zeros(n), rows > 1

@@ -28,7 +28,7 @@ _TOKEN_RE = re.compile(
   | (?P<string>'(?:[^']|'')*')
   | (?P<qident>"(?:[^"]|"")*")
   | (?P<ident>[A-Za-z_][A-Za-z0-9_]*)
-  | (?P<op><>|!=|<=|>=|\|\||<|>|=|\+|-|\*|/|%|\(|\)|\[|\]|,|\.|;)
+  | (?P<op><>|!=|<=|>=|\|\||<|>|=|\+|-|\*|/|%|\^|\(|\)|\[|\]|,|\.|;)
     """,
     re.VERBOSE | re.DOTALL,
 )
@@ -97,9 +97,26 @@ _SHORT_UNITS = {
 
 
 def parse_interval_string(s: str) -> int:
-    """'1 minute', '10m', '1 hour 30 minutes' → ns."""
+    """'1 minute', '10m', '1 hour 30 minutes' → ns (months/years at
+    their fixed 30d/365d equivalents — unchanged legacy behavior for
+    bucketing; date arithmetic uses parse_interval_parts for
+    calendar-true months)."""
+    return parse_interval_parts(s)[0]
+
+
+_MONTH_UNITS = {"month": 1, "months": 1, "mon": 1, "mons": 1,
+                "year": 12, "years": 12, "y": 12}
+
+
+def parse_interval_parts(s: str) -> tuple[int, int, int]:
+    """'1 year 2 months 3 days' → (legacy total ns with months/years at
+    30d/365d, symbolic months, sub-month ns). The symbolic months let
+    date + INTERVAL apply calendar arithmetic (arrow IntervalMonthDayNano
+    — tpch date '1993-07-01' + 3 months is 1993-10-01, not +90 days)."""
     s = s.strip().lower()
-    total = 0
+    legacy = 0
+    sub_ns = 0
+    months = 0
     m_all = re.findall(r"(\d+(?:\.\d+)?)\s*([a-z]+)", s)
     if not m_all:
         raise ParserError(f"bad interval {s!r}")
@@ -107,8 +124,12 @@ def parse_interval_string(s: str) -> int:
         factor = _INTERVAL_UNITS.get(unit) or _SHORT_UNITS.get(unit)
         if factor is None:
             raise ParserError(f"bad interval unit {unit!r}")
-        total += int(float(num) * factor)
-    return total
+        legacy += int(float(num) * factor)
+        if unit in _MONTH_UNITS and float(num) == int(float(num)):
+            months += int(float(num)) * _MONTH_UNITS[unit]
+        else:
+            sub_ns += int(float(num) * factor)
+    return legacy, months, sub_ns
 
 
 def parse_timestamp_string(s: str) -> int:
@@ -557,7 +578,15 @@ class Parser:
                           and self.kw() not in _RESERVED
                           and self.kw() not in ("GROUP", "HAVING", "ORDER",
                                                 "LIMIT", "OFFSET")):
-                return ast.SubqueryRef(sub, self.expect_ident())
+                alias = self.expect_ident()
+                col_aliases: list = []
+                if self.accept_op("("):   # AS name (c1, c2, ...)
+                    while True:
+                        col_aliases.append(self.expect_ident())
+                        if not self.accept_op(","):
+                            break
+                    self.expect_op(")")
+                return ast.SubqueryRef(sub, alias, col_aliases)
             return ast.SubqueryRef(sub, f"__subquery_{self.i}")
         name = self.expect_ident()
         database = None
@@ -612,6 +641,19 @@ class Parser:
             self.expect_kw("TABLE")
             ine = self._if_not_exists()
             name = self.expect_ident()
+            columns: list = []
+            if self.accept_op("("):
+                while True:
+                    cname = self.expect_ident()
+                    parts = [self.expect_ident()]
+                    # multi-word types (BIGINT UNSIGNED); stop at , or )
+                    while not (self.peek().kind == "op"
+                               and self.peek().value in (",", ")")):
+                        parts.append(self.expect_ident())
+                    columns.append((cname, " ".join(parts).upper()))
+                    if not self.accept_op(","):
+                        break
+                self.expect_op(")")
             fmt, header = "csv", False
             path = None
             options: dict = {}
@@ -633,7 +675,7 @@ class Parser:
             if path is None:
                 raise ParserError("CREATE EXTERNAL TABLE needs LOCATION")
             return ast.CreateExternalTable(name, path, fmt, header, ine,
-                                           options)
+                                           options, columns)
         if k == "DATABASE":
             self.next()
             ine = self._if_not_exists()
@@ -1161,11 +1203,27 @@ class Parser:
             elif self.kw() == "IS":
                 self.next()
                 negated = self.accept_kw("NOT")
-                self.expect_kw("NULL")
-                e = IsNull(e, negated)
+                # IS [NOT] UNKNOWN ≡ IS [NOT] NULL over booleans;
+                # IS [NOT] TRUE/FALSE is the boolean value test;
+                # IS [NOT] DISTINCT FROM is NULL-safe inequality
+                k = self.expect_kw("NULL", "UNKNOWN", "TRUE", "FALSE",
+                                   "DISTINCT")
+                if k == "DISTINCT":
+                    self.expect_kw("FROM")
+                    from .expr import IsDistinct
+
+                    e = IsDistinct(e, self.parse_additive(), negated)
+                elif k in ("TRUE", "FALSE"):
+                    from .expr import IsBool
+
+                    e = IsBool(e, k == "TRUE", negated)
+                else:
+                    e = IsNull(e, negated)
             elif self.kw() == "LIKE":
                 self.next()
-                e = Like(e, self.expect_string())
+                pat = self.parse_additive()
+                e = Like(e, pat.value if isinstance(pat, Literal)
+                         and isinstance(pat.value, str) else pat)
             elif self.kw() in ("IN", "NOT"):
                 negated = False
                 if self.kw() == "NOT":
@@ -1182,7 +1240,11 @@ class Parser:
                         continue
                     elif self.kw() == "LIKE":
                         self.next()
-                        e = Like(e, self.expect_string(), negated=True)
+                        pat = self.parse_additive()
+                        e = Like(e, pat.value
+                                 if isinstance(pat, Literal)
+                                 and isinstance(pat.value, str) else pat,
+                                 negated=True)
                         continue
                     else:
                         self.i = save
@@ -1218,6 +1280,18 @@ class Parser:
         return e
 
     def parse_additive(self) -> Expr:
+        # caret (bitwise XOR) binds LOOSER than +/- (sqlparser-rs gives
+        # it precedence below additive): 1 ^ 2 + 3 is 1 ^ (2 + 3)
+        e = self._parse_additive_nocaret()
+        while True:
+            t = self.peek()
+            if t.kind == "op" and t.value == "^":
+                self.next()
+                e = BinOp("^", e, self._parse_additive_nocaret())
+            else:
+                return e
+
+    def _parse_additive_nocaret(self) -> Expr:
         e = self.parse_multiplicative()
         while True:
             t = self.peek()
@@ -1225,9 +1299,10 @@ class Parser:
                 self.next()
                 e = BinOp(t.value, e, self.parse_multiplicative())
             elif t.kind == "op" and t.value == "||":
-                # string concatenation operator → concat()
+                # string concatenation OPERATOR: NULL-propagating
+                # (concat() the function skips NULLs)
                 self.next()
-                e = Func("concat", [e, self.parse_multiplicative()])
+                e = Func("__concat_op", [e, self.parse_multiplicative()])
             else:
                 break
         return e
@@ -1286,9 +1361,8 @@ class Parser:
                 if self.peek().kind == "ident" and self.kw() in (
                         u.upper() for u in _INTERVAL_UNITS):
                     unit = self.next().value.lower()
-                    return Literal(ast.IntervalValue(
-                        parse_interval_string(s + " " + unit)))
-                return Literal(ast.IntervalValue(parse_interval_string(s)))
+                    s = s + " " + unit
+                return Literal(ast.IntervalValue(*parse_interval_parts(s)))
             if k == "TIMESTAMP":
                 self.next()
                 return Literal(parse_timestamp_string(self.expect_string()))
@@ -1365,6 +1439,23 @@ class Parser:
                 fname = {"BOTH": "btrim", "LEADING": "ltrim_chars",
                          "TRAILING": "rtrim_chars"}[side]
                 return Func(fname, [s, chars])
+            if k == "SUBSTRING" and self._peek_op_at(1) == "(":
+                # SUBSTRING(s FROM start [FOR len]) — standard form
+                # (tpch.slt q22; the comma form parses as a plain call)
+                save = self.i
+                self.next()
+                self.expect_op("(")
+                s = self.parse_expr()
+                if self.kw() == "FROM":
+                    self.next()
+                    start = self.parse_expr()
+                    args = [s, start]
+                    if self.kw() == "FOR":
+                        self.next()
+                        args.append(self.parse_expr())
+                    self.expect_op(")")
+                    return Func("substring", args)
+                self.i = save   # comma form: reparse as a normal call
             if k == "EXTRACT" and self._peek_op_at(1) == "(":
                 # EXTRACT(field FROM expr) → date_part('field', expr)
                 self.next()
@@ -1601,14 +1692,25 @@ def _const_eval(e: Expr):
     if isinstance(e, UnaryOp) and e.op == "-":
         v = _const_eval(e.operand)
         return -v
-    if type(e).__name__ in ("Func", "BinOp", "Cast", "Case"):
+    if isinstance(e, Expr):
+        # any column-free expression folds (sqlancer writes arbitrary
+        # constant expressions into INSERT VALUES: casts, concat, IN, ...)
+        if e.columns():
+            raise ParserError(
+                f"INSERT value references a column: {e!r}")
         import numpy as np
 
-        v = e.eval({}, np)
+        try:
+            v = e.eval({}, np)
+        except ParserError:
+            raise
+        except Exception as ex:
+            raise ParserError(f"bad INSERT value {e!r}: {ex}")
         # numpy scalars/0-d arrays must become python values: they ride
         # into WriteBatches (msgpack) and schema type checks
         if isinstance(v, np.ndarray):
-            v = v[()] if v.shape == () else v.tolist()
+            v = v[()] if v.shape == () else \
+                (v.tolist() if v.size > 1 else v.ravel()[0])
         if isinstance(v, np.floating):
             return float(v)
         if isinstance(v, np.integer):

@@ -40,6 +40,7 @@ _NUMERIC_ONLY_AGGS = {"sum", "avg", "mean", "median", "stddev",
 _TWO_COL_AGGS = {"corr", "covar", "covar_pop", "covar_samp"}
 
 AGG_FUNCS = {"count", "sum", "avg", "mean", "min", "max", "first", "last",
+             "bool_or", "bool_and", "bit_and", "bit_or", "bit_xor",
              "median", "stddev", "stddev_samp", "stddev_pop",
              "var", "var_samp", "var_pop",
              "corr", "covar", "covar_pop", "covar_samp",
@@ -527,6 +528,9 @@ class _AggCollector:
         name = f.name.lower()
         if name == "avg":
             name = "mean"
+        # bool_or/bool_and over BOOLEAN == max/min (true > false), same
+        # NULL-group semantics and true/false rendering
+        name = {"bool_or": "max", "bool_and": "min"}.get(name, name)
         distinct = bool(f.args and isinstance(f.args[0], Literal)
                         and f.args[0].value == "__distinct__")
         args = [a for a in f.args
@@ -623,12 +627,26 @@ class _AggCollector:
                 f"{len(args)}: {f.to_sql()}")
         if name == "count" and len(args) > 1:
             # count(a, b): rows where EVERY argument is non-NULL
-            # (reference count.slt: count(t0, t1) over 8 rows → 8)
-            if not all(isinstance(a, Column) for a in args):
-                raise PlanError("multi-argument count takes columns")
-            param = tuple(a.name for a in args[1:])
-            args = args[:1]
-            name = "count_multi" 
+            # (reference count.slt: count(t0, t1) over 8 rows → 8);
+            # non-NULL constants never reduce the count, a NULL constant
+            # zeroes it (sqlancer: count(1,2,3) == count(*))
+            if any(isinstance(a, Literal) and a.value is None
+                   for a in args):
+                name, col = "count_null_const", None
+                args = []
+            else:
+                cols_only = [a for a in args if isinstance(a, Column)]
+                if not all(isinstance(a, (Column, Literal))
+                           for a in args):
+                    raise PlanError("multi-argument count takes columns")
+                if not cols_only:
+                    args = [Literal("*")]   # all constants: count(*)
+                elif len(cols_only) == 1:
+                    args = cols_only
+                else:
+                    param = tuple(a.name for a in cols_only[1:])
+                    args = cols_only[:1]
+                    name = "count_multi" 
         if name == "count" and args and isinstance(args[0], Literal) \
                 and args[0].value == "*":
             col = None
@@ -641,7 +659,8 @@ class _AggCollector:
                 col = None
         elif name in ("sum", "avg", "mean", "min", "max", "median",
                       "stddev", "stddev_samp", "stddev_pop", "var",
-                      "var_samp", "var_pop", "first", "last") and args \
+                      "var_samp", "var_pop", "first", "last",
+                      "bit_and", "bit_or", "bit_xor") and args \
                 and isinstance(args[0], Literal) \
                 and args[0].value != "*":
             # aggregate over a CONSTANT (reference: avg(3) → 3.0): ride

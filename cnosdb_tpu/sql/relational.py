@@ -130,6 +130,22 @@ def null_safe_key(v: np.ndarray):
     return vals, (nulls if nulls.any() else None)
 
 
+def bit_reduce(kind: str, vals):
+    """BIT_AND/BIT_OR/BIT_XOR over a value sequence (NULL/NaN skipped;
+    NULL when nothing remains) — the one shared implementation for the
+    grouped and finalize paths."""
+    import functools
+    import operator as _op
+
+    ints = [int(x) for x in vals
+            if x is not None and not (isinstance(x, float) and x != x)]
+    if not ints:
+        return None
+    red = {"bit_and": _op.and_, "bit_or": _op.or_,
+           "bit_xor": _op.xor}[kind]
+    return functools.reduce(red, ints)
+
+
 def _split_conjuncts(e: Expr | None) -> list[Expr]:
     if e is None:
         return []
@@ -371,7 +387,8 @@ def host_aggregate(func: str, col, gid: np.ndarray, n_groups: int,
     func = func.lower()
     func = {"approx_median": "median", "stddev_samp": "stddev",
             "var": "var_samp", "approx_distinct": "count_distinct_",
-            "covar": "covar_samp", "mean": "avg"}.get(func, func)
+            "covar": "covar_samp", "mean": "avg",
+            "bool_or": "max", "bool_and": "min"}.get(func, func)
     if func == "count_distinct_":
         return host_aggregate("count", col, gid, n_groups, distinct=True)
     if func == "count" and col is None:
@@ -548,6 +565,11 @@ def host_aggregate(func: str, col, gid: np.ndarray, n_groups: int,
                 out[k] = tsfuncs.state_data(
                     tsv, v[sel], compact=(func == "compact_state_agg"))
         return out
+    if func in ("bit_and", "bit_or", "bit_xor"):
+        out = np.full(n_groups, None, dtype=object)
+        for k in np.unique(g):
+            out[k] = bit_reduce(func, v[g == k])
+        return out
     if func in ("median", "stddev", "stddev_pop", "var_samp", "var_pop",
                 "mode"):
         # order-statistic / modal aggregates: one numpy pass per group
@@ -605,7 +627,8 @@ def _arr_cell(v) -> str:
 # ---------------------------------------------------------------------------
 # expression tree utilities (agg / window discovery + rewrite)
 # ---------------------------------------------------------------------------
-_CHILD_ATTRS = ("left", "right", "operand", "expr", "low", "high", "else_")
+_CHILD_ATTRS = ("left", "right", "operand", "expr", "low", "high",
+                "else_", "pattern")
 
 
 def walk_exprs(e, fn):
