@@ -156,6 +156,10 @@ class TableColumn:
     # GEOMETRY(subtype, srid): writes must match the declared subtype
     # (reference GeometryType in tskv_table_schema.rs)
     geom_subtype: str | None = None
+    # previous names after ALTER ... RENAME COLUMN: storage chunks wrote
+    # under these (the reference tracks columns by id; here names carry
+    # the lineage so scans keep reading old files)
+    prior_names: list = dc_field(default_factory=list)
 
     def default_encoding(self) -> Encoding:
         ct = self.column_type
@@ -182,6 +186,7 @@ class TableColumn:
             "encoding": int(self.encoding),
             "explicit_codec": self.explicit_codec,
             "geom_subtype": self.geom_subtype,
+            "prior_names": self.prior_names,
         }
 
     @classmethod
@@ -195,6 +200,7 @@ class TableColumn:
             encoding=Encoding(d["encoding"]),
             explicit_codec=bool(d.get("explicit_codec", False)),
             geom_subtype=d.get("geom_subtype"),
+            prior_names=list(d.get("prior_names") or []),
         )
 
 
@@ -245,6 +251,11 @@ class TskvTableSchema:
                           encoding if encoding is not None else Encoding.DEFAULT)
         if encoding is None:
             col.encoding = col.default_encoding()
+        # reusing a renamed-away name cuts the old column's lineage to it
+        # (scans must never conflate the new column with historic chunks)
+        for c in self.columns:
+            if name in getattr(c, "prior_names", ()):
+                c.prior_names = [x for x in c.prior_names if x != name]
         if sorted_insert:
             if col.name in self._by_name:
                 raise SchemaError(
@@ -271,11 +282,15 @@ class TskvTableSchema:
         return col
 
     def drop_column(self, name: str) -> TableColumn:
-        col = self._by_name.pop(name, None)
+        col = self._by_name.get(name)
         if col is None:
             raise ColumnNotFound(f"{self.name}.{name}")
         if col.column_type.is_time:
+            # validate BEFORE mutating: a failed drop must not remove the
+            # name from the index (ALTER ... ADD FIELD time would then
+            # slip past the duplicate check — alter_table.slt)
             raise SchemaError("cannot drop time column")
+        self._by_name.pop(name)
         self.columns.remove(col)
         self.schema_version += 1
         return col
@@ -375,27 +390,80 @@ class Duration:
 
     INF_NS = 0
 
+    # humantime's unit values (the reference parses CnosDuration through
+    # the humantime crate: y=365.25d, M=30.44d, m=minutes — case matters)
+    _HUMANTIME_NS = {
+        "ns": 1, "us": 1_000, "ms": 1_000_000,
+        "s": 1_000_000_000, "sec": 1_000_000_000,
+        "m": 60_000_000_000, "min": 60_000_000_000,
+        "h": 3_600_000_000_000, "hr": 3_600_000_000_000,
+        "d": 86_400_000_000_000, "day": 86_400_000_000_000,
+        "days": 86_400_000_000_000,
+        "w": 7 * 86_400_000_000_000, "week": 7 * 86_400_000_000_000,
+        "M": 2_630_016_000_000_000, "month": 2_630_016_000_000_000,
+        "months": 2_630_016_000_000_000,
+        "y": 31_557_600_000_000_000, "year": 31_557_600_000_000_000,
+        "years": 31_557_600_000_000_000,
+        "minute": 60_000_000_000, "minutes": 60_000_000_000,
+        "hour": 3_600_000_000_000, "hours": 3_600_000_000_000,
+        "second": 1_000_000_000, "seconds": 1_000_000_000,
+    }
+
     @classmethod
     def parse(cls, s: str) -> "Duration":
-        s = s.strip().lower()
-        if s in ("inf", "none", ""):
+        raw = s.strip()
+        if raw.lower() in ("inf", "none", ""):
             return cls(0)
-        m = re.match(r"^(\d+)\s*(ns|us|ms|s|m|h|d|w|y)?$", s)
-        if not m:
-            raise SchemaError(f"bad duration {s!r}")
-        n = int(m.group(1))
-        if n == 0:
-            # ns=0 is the INF sentinel; a literal zero duration would silently
-            # mean "retain forever", so reject it.
-            raise SchemaError(f"zero duration {s!r} is invalid (use 'INF' for unlimited)")
-        unit = m.group(2) or "s"
-        factor = {
-            "ns": 1, "us": 1_000, "ms": 1_000_000, "s": 1_000_000_000,
-            "m": 60_000_000_000, "h": 3_600_000_000_000,
-            "d": 86_400_000_000_000, "w": 7 * 86_400_000_000_000,
-            "y": 365 * 86_400_000_000_000,
-        }[unit]
-        return cls(n * factor)
+        total = 0
+        matched = False
+        pos = 0
+        for m in re.finditer(r"\s*(\d+)\s*([A-Za-z]+)\s*", raw):
+            if m.start() != pos:
+                raise SchemaError(f"bad duration {s!r}")
+            pos = m.end()
+            num, unit = m.group(1), m.group(2)
+            factor = cls._HUMANTIME_NS.get(unit) \
+                or cls._HUMANTIME_NS.get(unit.lower())
+            if factor is None:
+                raise SchemaError(f"bad duration {s!r}")
+            total += int(num) * factor
+            matched = True
+        if matched and pos != len(raw):
+            raise SchemaError(f"bad duration {s!r}")   # trailing junk
+        if not matched:
+            m = re.match(r"^(\d+)$", raw)
+            if not m:
+                raise SchemaError(f"bad duration {s!r}")
+            total = int(m.group(1)) * 1_000_000_000   # bare number: secs
+        if total == 0:
+            # ns=0 is the INF sentinel; a literal zero duration would
+            # silently mean "retain forever", so reject it.
+            raise SchemaError(
+                f"zero duration {s!r} is invalid (use 'INF' for unlimited)")
+        return cls(total)
+
+    def humantime(self) -> str:
+        """humantime::format_duration text — what the reference's
+        DESCRIBE DATABASE and info-schema surfaces render."""
+        if self.is_inf:
+            return "INF"
+        units = [("year", 31_557_600_000_000_000),
+                 ("month", 2_630_016_000_000_000),
+                 ("day", 86_400_000_000_000),
+                 ("h", 3_600_000_000_000),
+                 ("m", 60_000_000_000),
+                 ("s", 1_000_000_000),
+                 ("ms", 1_000_000), ("us", 1_000), ("ns", 1)]
+        rem = self.ns
+        parts = []
+        for name, f in units:
+            q, rem = divmod(rem, f)
+            if q:
+                if name in ("year", "month", "day"):
+                    parts.append(f"{q}{name}" + ("s" if q > 1 else ""))
+                else:
+                    parts.append(f"{q}{name}")
+        return " ".join(parts) if parts else "0s"
 
     @property
     def is_inf(self) -> bool:
@@ -416,21 +484,26 @@ class DatabaseOptions:
 
     ttl: Duration = dc_field(default_factory=lambda: Duration(0))
     shard_num: int = 1
-    vnode_duration: Duration = dc_field(default_factory=lambda: Duration.parse("365d"))
+    vnode_duration: Duration = dc_field(default_factory=lambda: Duration.parse("1y"))
     replica: int = 1
     precision: Precision = Precision.NS
+    # storage-config surface DESCRIBE DATABASE exposes (create-time only)
+    config: dict = dc_field(default_factory=dict)
 
     def to_dict(self) -> dict:
         return {
             "ttl": self.ttl.ns, "shard_num": self.shard_num,
             "vnode_duration": self.vnode_duration.ns,
             "replica": self.replica, "precision": int(self.precision),
+            "config": self.config,
         }
 
     @classmethod
     def from_dict(cls, d: dict) -> "DatabaseOptions":
-        return cls(Duration(d["ttl"]), d["shard_num"], Duration(d["vnode_duration"]),
-                   d["replica"], Precision(d["precision"]))
+        out = cls(Duration(d["ttl"]), d["shard_num"], Duration(d["vnode_duration"]),
+                  d["replica"], Precision(d["precision"]))
+        out.config = dict(d.get("config") or {})
+        return out
 
 
 @dataclass

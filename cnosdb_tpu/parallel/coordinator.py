@@ -131,6 +131,15 @@ class Coordinator:
 
     def _write_points_inner(self, tenant, db, owner, batch, sync):
         per_rs: dict[int, tuple[object, WriteBatch]] = {}
+        prec = self.meta.database(tenant, db).options.precision
+        factor = prec.to_ns_factor()
+        if factor != 1:
+            # ns inputs TRUNCATE to the database's precision
+            # (db_precision.slt: us-db stores ...010001 as ...010000)
+            for table, series_list in batch.tables.items():
+                for sr in series_list:
+                    ts = np.asarray(sr.timestamps, dtype=np.int64)
+                    sr.timestamps = ts - (ts % factor)
         for table, series_list in batch.tables.items():
             self._ensure_schema(tenant, db, table, series_list)
             for sr in series_list:
@@ -611,8 +620,9 @@ class Coordinator:
     def drop_table(self, tenant: str, db: str, table: str):
         self.meta.drop_table(tenant, db, table)
 
-    def drop_database(self, tenant: str, db: str):
-        self.meta.drop_database(tenant, db)
+    def drop_database(self, tenant: str, db: str,
+                      if_exists: bool = True):
+        self.meta.drop_database(tenant, db, if_exists=if_exists)
 
     def _mark_vnode_broken(self, vnode_id: int):
         """Failed-replica marking (reference reader/mod.rs:36

@@ -119,7 +119,8 @@ class MetaStore:
     def _bootstrap(self):
         self.tenants[DEFAULT_TENANT] = TenantOptions(comment="system tenant")
         self.users["root"] = {"password": hash_password(""), "admin": True,
-                              "comment": "system admin"}
+                              "comment": "system admin",
+                              "must_change_password": True}
         for db in (DEFAULT_DATABASE, USAGE_SCHEMA):
             schema = DatabaseSchema(DEFAULT_TENANT, db, DatabaseOptions())
             self.databases[schema.owner] = schema
@@ -225,7 +226,25 @@ class MetaStore:
             self._persist()
             self._notify("create_tenant", tenant=name)
 
-    def drop_tenant(self, name: str, at: float | None = None):
+    def alter_tenant_options(self, name: str, changes: dict):
+        """SET/UNSET comment/drop_after (None value = unset) —
+        reference ALTER TENANT (ast.rs AlterTenantOperation)."""
+        from ..models.schema import Duration
+
+        with self.lock:
+            if name not in self.tenants:
+                raise TenantNotFound(name)
+            opts = self.tenants[name]
+            if "comment" in changes:
+                opts.comment = changes["comment"] or ""
+            if "drop_after" in changes:
+                v = changes["drop_after"]
+                opts.drop_after = Duration.parse(v) if v else None
+            self._persist()
+            self._notify("alter_tenant", tenant=name)
+
+    def drop_tenant(self, name: str, at: float | None = None,
+                    if_exists: bool = False):
         """Soft delete: the tenant and all its databases move to the
         recycle bin; RECOVER TENANT restores everything."""
         import time as _time
@@ -234,7 +253,9 @@ class MetaStore:
             if name == DEFAULT_TENANT:
                 raise MetaError("cannot drop system tenant")
             if name not in self.tenants:
-                return
+                if if_exists:
+                    return
+                raise TenantNotFound(name)
             dropped = [o for o in self.databases if o.startswith(name + ".")]
             fire = []
             old = self.trash["tenant"].pop(name, None)
@@ -317,29 +338,60 @@ class MetaStore:
             return len(fire)
 
     def create_user(self, name: str, password: str = "", admin: bool = False,
-                    comment: str = ""):
+                    comment: str = "",
+                    must_change_password: bool | None = None):
         with self.lock:
+            if not name or not name.strip():
+                raise MetaError("invalid user name")
             if name in self.users:
                 raise MetaError(f"user {name!r} exists")
-            self.users[name] = {"password": hash_password(password),
-                                "admin": admin, "comment": comment}
+            rec = {"password": hash_password(password),
+                   "admin": admin, "comment": comment}
+            if must_change_password is not None:
+                # presence == explicitly set (user_options JSON surfaces
+                # only set options — dcl/alter_user.slt)
+                rec["must_change_password"] = must_change_password
+            self.users[name] = rec
             self._persist()
 
-    def drop_user(self, name: str):
+    def drop_user(self, name: str, if_exists: bool = False):
         with self.lock:
             if name == "root":
                 raise MetaError("cannot drop root")
+            if name not in self.users:
+                if if_exists:
+                    return
+                raise MetaError(f"user {name!r} not found")
             self.users.pop(name, None)
             for members in self.members.values():
                 members.pop(name, None)
+            self._auth_cache.clear()
             self._persist()
 
-    def alter_user(self, name: str, password: str | None = None):
+    def alter_user(self, name: str, password: str | None = None,
+                   changes: dict | None = None):
         with self.lock:
             if name not in self.users:
                 raise MetaError(f"user {name!r} missing")
+            changes = dict(changes or {})
             if password is not None:
-                self.users[name]["password"] = hash_password(password)
+                changes.setdefault("password", password)
+            if "granted_admin" in changes and name == "root":
+                # the system admin's adminship is not grantable state
+                # (dcl/alter_user.slt pins both true and false as errors)
+                raise MetaError("cannot change root's granted_admin")
+            if "password" in changes:
+                self.users[name]["password"] = \
+                    hash_password(changes.pop("password"))
+                self._auth_cache.clear()
+            if "granted_admin" in changes:
+                self.users[name]["admin"] = bool(
+                    changes.pop("granted_admin"))
+            if "comment" in changes:
+                self.users[name]["comment"] = changes.pop("comment")
+            if "must_change_password" in changes:
+                self.users[name]["must_change_password"] = bool(
+                    changes.pop("must_change_password"))
             self._persist()
 
     def check_user(self, name: str, password: str) -> dict | None:
@@ -421,6 +473,10 @@ class MetaStore:
 
     def drop_role(self, tenant: str, name: str):
         with self.lock:
+            if name in ("owner", "member"):
+                # system roles (drop_role.slt pins DROP ROLE owner as an
+                # error)
+                raise MetaError(f"cannot drop system role {name!r}")
             self.roles.get(tenant, {}).pop(name, None)
             members = self.members.get(tenant, {})
             for user, role in list(members.items()):
@@ -484,10 +540,22 @@ class MetaStore:
             return need_rank <= self._PRIV_ORDER[granted]
 
     # ------------------------------------------------------------ databases
+    # db names allow word chars and interior spaces ('dd c' is legal);
+    # empty, whitespace-only, '/' or '.' are not (create_database.slt)
+    _DB_NAME_RE = __import__("re").compile(r"^(?=.*\S)[^/.\x00-\x1f]+$")
+
     def create_database(self, schema: DatabaseSchema, if_not_exists: bool = False):
         with self.lock:
             if schema.tenant not in self.tenants:
                 raise TenantNotFound(schema.tenant)
+            if not self._DB_NAME_RE.match(schema.name or ""):
+                # reference rejects names outside the identifier charset
+                # (create_database.slt: "db/1", '', ' ')
+                raise MetaError(f"invalid database name {schema.name!r}")
+            if schema.name in ("cluster_schema", "information_schema",
+                               "usage_schema"):
+                raise MetaError(
+                    f"cannot create system schema {schema.name!r}")
             if schema.owner in self.databases:
                 if if_not_exists:
                     return
@@ -547,6 +615,11 @@ class MetaStore:
         """Soft delete: the database moves to the recycle bin (data files
         untouched); RECOVER DATABASE restores it, purge_trash reclaims."""
         with self.lock:
+            if tenant == DEFAULT_TENANT and db in (DEFAULT_DATABASE,
+                                                   USAGE_SCHEMA):
+                # system databases are not droppable (drop_database.slt
+                # pins DROP DATABASE public as an error)
+                raise MetaError(f"cannot drop system database {db!r}")
             owner = f"{tenant}.{db}"
             if owner not in self.databases:
                 if if_exists:

@@ -138,9 +138,10 @@ class DropTable:
 @dataclass
 class AlterTable:
     name: str
-    action: str                      # add_field/add_tag/drop/alter_codec
+    action: str              # add_field/add_tag/drop/alter_codec/rename
     column: ColumnDef | None = None
     drop_name: str | None = None
+    rename_to: str | None = None
 
 
 @dataclass
@@ -202,6 +203,7 @@ class CreateTenant:
     name: str
     if_not_exists: bool = False
     comment: str = ""
+    drop_after: str | None = None
 
 
 @dataclass
@@ -216,6 +218,8 @@ class CreateUser:
     password: str = ""
     if_not_exists: bool = False
     comment: str = ""
+    granted_admin: bool = False
+    must_change_password: bool | None = None   # None = not specified
 
 
 @dataclass
@@ -227,7 +231,16 @@ class DropUser:
 @dataclass
 class AlterUser:
     name: str
-    password: str | None = None
+    # option changes: password/comment/granted_admin/must_change_password
+    changes: dict = field(default_factory=dict)
+
+
+@dataclass
+class AlterTenantOpts:
+    """ALTER TENANT t SET/UNSET comment/drop_after (None = unset)."""
+
+    tenant: str
+    changes: dict = field(default_factory=dict)
 
 
 @dataclass

@@ -99,6 +99,7 @@ class QueryTracker:
             self._next += 1
             self.running[qid] = {"sql": sql, "user": session.user,
                                  "tenant": session.tenant,
+                                 "db": session.database,
                                  "start": _t.time(), "cancelled": False}
             return qid
 
@@ -180,7 +181,8 @@ class QueryExecutor:
         if isinstance(stmt, ast.AlterDatabase):
             return self._alter_database(stmt, session)
         if isinstance(stmt, ast.DropDatabase):
-            self.coord.drop_database(session.tenant, stmt.name)
+            self.coord.drop_database(session.tenant, stmt.name,
+                                     if_exists=stmt.if_exists)
             return ResultSet.message("ok")
         if isinstance(stmt, ast.CreateTable):
             return self._create_table(stmt, session)
@@ -231,27 +233,38 @@ class QueryExecutor:
         if isinstance(stmt, ast.UpdateStmt):
             return self._update(stmt, session)
         if isinstance(stmt, ast.CreateTenant):
+            from ..models.schema import Duration
+
             try:
-                self.meta.create_tenant(stmt.name, TenantOptions(comment=stmt.comment))
+                self.meta.create_tenant(stmt.name, TenantOptions(
+                    comment=stmt.comment,
+                    drop_after=(Duration.parse(stmt.drop_after)
+                                if stmt.drop_after else None)))
             except Exception:
                 if not stmt.if_not_exists:
                     raise
             return ResultSet.message("ok")
         if isinstance(stmt, ast.DropTenant):
-            self.meta.drop_tenant(stmt.name)
+            self.meta.drop_tenant(stmt.name, if_exists=stmt.if_exists)
+            return ResultSet.message("ok")
+        if isinstance(stmt, ast.AlterTenantOpts):
+            self.meta.alter_tenant_options(stmt.tenant, stmt.changes)
             return ResultSet.message("ok")
         if isinstance(stmt, ast.CreateUser):
             try:
-                self.meta.create_user(stmt.name, stmt.password, comment=stmt.comment)
+                self.meta.create_user(
+                    stmt.name, stmt.password, admin=stmt.granted_admin,
+                    comment=stmt.comment,
+                    must_change_password=stmt.must_change_password)
             except Exception:
                 if not stmt.if_not_exists:
                     raise
             return ResultSet.message("ok")
         if isinstance(stmt, ast.DropUser):
-            self.meta.drop_user(stmt.name)
+            self.meta.drop_user(stmt.name, if_exists=stmt.if_exists)
             return ResultSet.message("ok")
         if isinstance(stmt, ast.AlterUser):
-            self.meta.alter_user(stmt.name, stmt.password)
+            self.meta.alter_user(stmt.name, changes=stmt.changes)
             return ResultSet.message("ok")
         if isinstance(stmt, ast.CreateRole):
             from ..errors import MetaError
@@ -337,7 +350,7 @@ class QueryExecutor:
     # server's LOCAL FILESYSTEM — that is instance scope too, or any
     # tenant owner could read /etc/passwd through an external table.
     _ADMIN_STMTS = (ast.CreateUser, ast.DropUser, ast.AlterUser,
-                    ast.CreateTenant, ast.DropTenant,
+                    ast.CreateTenant, ast.DropTenant, ast.AlterTenantOpts,
                     ast.CopyStmt, ast.CreateExternalTable,
                     # cluster-topology mutation reaches every tenant's
                     # vnodes via the global placement map: instance scope
@@ -458,6 +471,8 @@ class QueryExecutor:
             opts.replica = o["replica"]
         if "precision" in o:
             opts.precision = Precision.parse(o["precision"])
+        if "config" in o:
+            opts.config = dict(o["config"])
         self.meta.create_database(
             DatabaseSchema(session.tenant, stmt.name, opts), stmt.if_not_exists)
         return ResultSet.message("ok")
@@ -500,7 +515,11 @@ class QueryExecutor:
         return ResultSet.message("ok")
 
     def _alter_table(self, stmt: ast.AlterTable, session: Session):
-        schema = self.meta.table(session.tenant, session.database, stmt.name)
+        db = session.database
+        name = stmt.name
+        if "." in name:   # ALTER TABLE db.tbl
+            db, name = name.split(".", 1)
+        schema = self.meta.table(session.tenant, db, name)
         if stmt.action == "add_field":
             col = schema.add_column(stmt.column.name,
                                     ColumnType.field(ValueType.parse(stmt.column.type_name)))
@@ -511,7 +530,38 @@ class QueryExecutor:
                 col.encoding = col.default_encoding()
         elif stmt.action == "add_tag":
             schema.add_column(stmt.column.name, ColumnType.tag())
+        elif stmt.action == "rename":
+            # RENAME COLUMN old TO new (reference rename_field/tag.slt:
+            # time never renames; target must be free)
+            old = stmt.drop_name
+            if old == "time":
+                raise ExecutionError("cannot rename the time column")
+            if schema.contains_column(stmt.rename_to):
+                raise ExecutionError(
+                    f"column {stmt.rename_to!r} exists")
+            col = schema.column(old)
+            if col is None:
+                raise ExecutionError(f"column {old!r} not found")
+            del schema._by_name[old]
+            col.prior_names = [old] + list(col.prior_names)
+            col.name = stmt.rename_to
+            schema._by_name[stmt.rename_to] = col
+            schema.schema_version += 1
         elif stmt.action == "drop":
+            tgt = schema.column(stmt.drop_name)
+            if tgt is not None and tgt.column_type.is_field:
+                n_fields = sum(1 for c in schema.columns
+                               if c.column_type.is_field)
+                if n_fields <= 1:
+                    # a table must keep at least one field
+                    # (alter_table.slt pins DROP of the only field)
+                    raise ExecutionError(
+                        "cannot drop the only field column")
+            if tgt is not None and tgt.column_type.is_tag:
+                # the reference's ALTER TABLE DROP never removes TAG
+                # columns (create_table.slt pins DROP column7 on a
+                # two-tag table as an error)
+                raise ExecutionError("cannot drop a tag column")
             schema.drop_column(stmt.drop_name)
         self.meta.update_table(schema)
         return ResultSet.message("ok")
@@ -694,13 +744,27 @@ class QueryExecutor:
         if stmt.kind == "database":
             d = self.meta.database(session.tenant, stmt.name)
             o = d.options
+            # reference row (describe_database.slt):
+            # ttl, shard, vnode_duration, replica, precision, then the
+            # storage-config constants the reference surfaces per-db
             return ResultSet(
-                ["ttl", "shard", "vnode_duration", "replica", "precision"],
-                [np.array([str(o.ttl)], dtype=object),
+                ["ttl", "shard", "vnode_duration", "replica", "precision",
+                 "max_memcache_size", "memcache_partitions",
+                 "wal_max_file_size", "wal_sync", "strict_write",
+                 "max_cache_readers"],
+                [np.array([o.ttl.humantime()], dtype=object),
                  np.array([o.shard_num]),
-                 np.array([str(o.vnode_duration)], dtype=object),
+                 np.array([o.vnode_duration.humantime()], dtype=object),
                  np.array([o.replica]),
-                 np.array([o.precision.name], dtype=object)])
+                 np.array([o.precision.name], dtype=object),
+                 np.array([_size_display(o.config.get(
+                     "max_memcache_size", "128 MiB"))], dtype=object),
+                 np.array([o.config.get("memcache_partitions", 16)]),
+                 np.array([_size_display(o.config.get(
+                     "wal_max_file_size", "128 MiB"))], dtype=object),
+                 np.array([bool(o.config.get("wal_sync", False))]),
+                 np.array([bool(o.config.get("strict_write", False))]),
+                 np.array([o.config.get("max_cache_readers", 32)])])
         schema = self.meta.table(session.tenant,
                                  stmt.database or session.database, stmt.name)
         names, types, kinds, codecs = [], [], [], []
@@ -719,8 +783,9 @@ class QueryExecutor:
             else:
                 types.append(ct.value_type.sql_name())
                 kinds.append("FIELD")
-            codecs.append(c.encoding.name if c.explicit_codec
-                          else "DEFAULT")
+            codecs.append(None if c.encoding.name == "NULL"
+                          else (c.encoding.name if c.explicit_codec
+                                else "DEFAULT"))
         return ResultSet(
             ["column_name", "data_type", "column_type", "compression_codec"],
             [np.array(x, dtype=object) for x in (names, types, kinds, codecs)])
@@ -755,6 +820,10 @@ class QueryExecutor:
         field_types = {c: schema.column(c).column_type.value_type
                        for c in cols if schema.contains_column(c)
                        and schema.column(c).column_type.is_field}
+        prec_factor = self.meta.database(
+            session.tenant, db).options.precision.to_ns_factor()
+        scale_time = (prec_factor != 1 and stmt.select is None
+                      and not implicit_time)
         src_rows = stmt.rows
         if stmt.select is not None:
             # INSERT ... SELECT: run the query, map columns positionally
@@ -806,8 +875,23 @@ class QueryExecutor:
                 from .parser import parse_timestamp_string
 
                 row["time"] = parse_timestamp_string(t)
+            elif isinstance(t, float):
+                # a fractional time literal is a type error
+                # (create_table.slt pins VALUES (0.1, ...))
+                raise ExecutionError(
+                    f"INSERT time must be an integer timestamp, got {t!r}")
             if row["time"] is None:
                 raise ExecutionError("INSERT time must not be NULL")
+            if scale_time and not isinstance(t, str):
+                # EXPLICIT integer time literals are interpreted in the
+                # DATABASE's precision (db_precision.slt); implicit-now
+                # and INSERT..SELECT times are already ns and never scale
+                scaled = int(row["time"]) * prec_factor
+                if abs(scaled) > 2**63 - 1:
+                    raise ExecutionError(
+                        "timestamp overflows the ns domain at this "
+                        "database's precision")
+                row["time"] = scaled
             # a point with no field value is unrepresentable (same rule as
             # line protocol; reference rejects all-NULL-field INSERT rows)
             if not any(row.get(c) is not None for c in field_types):
@@ -1098,6 +1182,24 @@ class QueryExecutor:
 
         if is_system_db(db):
             names, cols = system_table(self, db, table, session)
+            has_agg = stmt.group_by or any(
+                rel.collect_aggs(it.expr, AGG_FUNCS)
+                for it in stmt.items if isinstance(it.expr, Expr))
+            if has_agg:
+                scope = rel.Scope(names, cols)
+                if stmt.where is not None:
+                    m = np.asarray(stmt.where.eval(scope.env, np))
+                    if not m.shape:
+                        m = np.full(scope.n, bool(m))
+                    scope = scope.filter(m)
+                import dataclasses as _dc
+
+                inner = _dc.replace(stmt, where=None)
+                rs, env, order_by = self._host_group_aggregate(inner,
+                                                               scope)
+                rs = _order_limit(rs, order_by, stmt.limit, stmt.offset,
+                                  env)
+                return self._distinct(rs) if stmt.distinct else rs
             return self._select_over_env(stmt, names, cols)
         if self.meta.external_opt(session.tenant, db, table) is not None:
             # relational pipeline: aggregates/joins/windows all work over
@@ -3213,6 +3315,17 @@ def _insert_coerce(vt, v, col: str):
             f"INSERT value {v!r} cannot be cast to the {vt.name} "
             f"column {col!r}: {e}")
     return v
+
+
+def _size_display(v) -> str:
+    """'128MiB'/'300M' → the reference's byte-size rendering
+    ('128 MiB', '300 MiB')."""
+    s = str(v).strip()
+    m = re.match(r"^(\d+(?:\.\d+)?)\s*([KMGT]?)(i?B?)$", s, re.I)
+    if not m:
+        return s
+    num, unit = m.group(1), m.group(2).upper()
+    return f"{num} {unit}iB" if unit else f"{num} B"
 
 
 def _median_value(vals: np.ndarray):

@@ -28,7 +28,8 @@ _TOKEN_RE = re.compile(
   | (?P<string>'(?:[^']|'')*')
   | (?P<qident>"(?:[^"]|"")*")
   | (?P<ident>[A-Za-z_][A-Za-z0-9_]*)
-  | (?P<op><>|!=|<=|>=|\|\||<|>|=|\+|-|\*|/|%|\^|\(|\)|\[|\]|,|\.|;)
+  | (?P<sysvar>@@[A-Za-z_][A-Za-z0-9_]*)
+  | (?P<op><>|!=|<=|>=|\|\||@@?|<|>|=|\+|-|\*|/|%|\^|\(|\)|\[|\]|,|\.|;)
     """,
     re.VERBOSE | re.DOTALL,
 )
@@ -68,6 +69,8 @@ def tokenize(sql: str) -> list[Token]:
             # SQL: `Order by Time` resolves the `time` column; quoted
             # identifiers above preserve case)
             out.append(Token("ident", text.lower(), m.start()))
+        elif kind == "sysvar":
+            out.append(Token("sysvar", text[2:].lower(), m.start()))
         elif kind == "number":
             out.append(Token("number", text, m.start()))
         else:
@@ -156,6 +159,15 @@ def parse_timestamp_string(s: str) -> int:
         raise
     except Exception:
         raise ParserError(f"bad timestamp {s!r}")
+
+
+# system variables (reference extension/variable/: @@cluster_name etc.)
+_SYSTEM_VARS = {
+    "cluster_name": "cluster_xxx",
+    "server_version": "2.4.3",
+    "deployment_mode": "singleton",
+    "node_id": "1001",
+}
 
 
 # ---------------------------------------------------------------------------
@@ -405,7 +417,7 @@ class Parser:
                     self.expect_op("=")
                     self.expect_op("(")
                     self.expect_kw("TYPE")
-                    self.expect_op("=")
+                    self.accept_op("=")   # `(type 'csv')` form is legal
                     fmt = self.expect_string().lower()
                     self.expect_op(")")
                 elif self.accept_kw("COPY_OPTIONS"):
@@ -423,8 +435,9 @@ class Parser:
             self.expect_kw("DATABASE")
             db = self.expect_ident()
             self.expect_kw("TO" if grant else "FROM")
-            self.expect_kw("ROLE")
-            return ast.GrantRevoke(grant, level, db, self.expect_ident())
+            self.accept_kw("ROLE")   # keyword optional upstream
+            return ast.GrantRevoke(grant, level, db,
+                                   self._ident_or_string())
         raise ParserError(f"unsupported statement start {self.peek().value!r}")
 
     # -- SELECT ----------------------------------------------------------
@@ -646,11 +659,23 @@ class Parser:
                 while True:
                     cname = self.expect_ident()
                     parts = [self.expect_ident()]
-                    # multi-word types (BIGINT UNSIGNED); stop at , or )
+                    if self.accept_op("("):   # DECIMAL(10,6) etc.
+                        args = [self.expect_number()]
+                        while self.accept_op(","):
+                            args.append(self.expect_number())
+                        self.expect_op(")")
+                        parts[-1] += "(" + ",".join(str(a) for a in args) \
+                            + ")"
+                    # multi-word types (BIGINT UNSIGNED); NOT NULL noise
                     while not (self.peek().kind == "op"
                                and self.peek().value in (",", ")")):
-                        parts.append(self.expect_ident())
-                    columns.append((cname, " ".join(parts).upper()))
+                        w = self.expect_ident().upper()
+                        if w == "NOT":
+                            self.expect_kw("NULL")
+                            continue
+                        parts.append(w)
+                    columns.append((cname, " ".join(
+                        x.upper() for x in parts)))
                     if not self.accept_op(","):
                         break
                 self.expect_op(")")
@@ -679,26 +704,47 @@ class Parser:
         if k == "DATABASE":
             self.next()
             ine = self._if_not_exists()
-            name = self.expect_ident()
+            name = self._ident_or_string()
             opts = {}
             if self.accept_kw("WITH"):
                 while True:
                     o = self.kw()
                     if o == "TTL":
                         self.next()
+                        self.accept_op("=")
                         opts["ttl"] = self.expect_string()
+                    elif o == "PRECISION":
+                        self.next()
+                        self.accept_op("=")
+                        opts["precision"] = self.expect_string()
                     elif o == "SHARD":
                         self.next()
+                        self.accept_op("=")
                         opts["shard_num"] = int(self.expect_number())
                     elif o == "VNODE_DURATION":
                         self.next()
+                        self.accept_op("=")
                         opts["vnode_duration"] = self.expect_string()
                     elif o == "REPLICA":
                         self.next()
+                        self.accept_op("=")
                         opts["replica"] = int(self.expect_number())
-                    elif o == "PRECISION":
+                    elif o in ("MAX_MEMCACHE_SIZE", "WAL_MAX_FILE_SIZE"):
                         self.next()
-                        opts["precision"] = self.expect_string()
+                        self.accept_op("=")
+                        opts.setdefault("config", {})[o.lower()] = \
+                            self.expect_string()
+                    elif o in ("MEMCACHE_PARTITIONS",
+                               "MAX_CACHE_READERS"):
+                        self.next()
+                        self.accept_op("=")
+                        opts.setdefault("config", {})[o.lower()] = \
+                            int(self.expect_number())
+                    elif o in ("WAL_SYNC", "STRICT_WRITE"):
+                        self.next()
+                        self.accept_op("=")
+                        opts.setdefault("config", {})[o.lower()] = \
+                            self.expect_string().lower() == "true"
                     else:
                         break
             return ast.CreateDatabase(name, ine, opts)
@@ -801,19 +847,29 @@ class Parser:
         if k == "TENANT":
             self.next()
             ine = self._if_not_exists()
-            name = self.expect_ident()
+            name = self._ident_or_string()
             comment = ""
+            drop_after = None
             if self.accept_kw("WITH"):
-                if self.accept_kw("COMMENT"):
-                    self.accept_op("=")
-                    comment = self.expect_string()
-            return ast.CreateTenant(name, ine, comment)
+                while True:
+                    if self.accept_kw("COMMENT"):
+                        self.accept_op("=")
+                        comment = self.expect_string()
+                    elif self.accept_kw("DROP_AFTER"):
+                        self.accept_op("=")
+                        drop_after = self.expect_string()
+                    else:
+                        break
+                    self.accept_op(",")
+            return ast.CreateTenant(name, ine, comment, drop_after)
         if k == "USER":
             self.next()
             ine = self._if_not_exists()
-            name = self.expect_ident()
+            name = self._ident_or_string()
             password = ""
             comment = ""
+            granted_admin = False
+            must_change = None
             if self.accept_kw("WITH"):
                 while True:
                     if self.accept_kw("PASSWORD"):
@@ -822,19 +878,34 @@ class Parser:
                     elif self.accept_kw("COMMENT"):
                         self.accept_op("=")
                         comment = self.expect_string()
+                    elif self.accept_kw("GRANTED_ADMIN"):
+                        self.accept_op("=")
+                        granted_admin = \
+                            self.expect_kw("TRUE", "FALSE") == "TRUE"
+                    elif self.accept_kw("MUST_CHANGE_PASSWORD"):
+                        self.accept_op("=")
+                        must_change = \
+                            self.expect_kw("TRUE", "FALSE") == "TRUE"
                     else:
                         break
                     self.accept_op(",")
-            return ast.CreateUser(name, password, ine, comment)
+            return ast.CreateUser(name, password, ine, comment,
+                                  granted_admin, must_change)
         if k == "ROLE":
             self.next()
             ine = self._if_not_exists()
-            name = self.expect_ident()
+            name = self._ident_or_string()
             inherit = "member"
             if self.accept_kw("INHERIT"):
                 inherit = self.expect_ident().lower()
             return ast.CreateRole(name, inherit, ine)
         raise ParserError(f"unsupported CREATE {k}")
+
+    def _ident_or_string(self) -> str:
+        """Role names may be quoted STRINGS ('d d' — dcl_role.slt)."""
+        if self.peek().kind == "string":
+            return self.next().value
+        return self.expect_ident()
 
     def _if_not_exists(self) -> bool:
         if self.kw() == "IF":
@@ -857,7 +928,10 @@ class Parser:
         if k == "DATABASE":
             self.next()
             ie = self._if_exists()
-            return ast.DropDatabase(self.expect_ident(), ie)
+            name = self._ident_or_string()
+            if self.accept_kw("AFTER"):
+                self.expect_string()   # delayed drop window (trash holds)
+            return ast.DropDatabase(name, ie)
         if k == "TABLE":
             self.next()
             ie = self._if_exists()
@@ -870,15 +944,18 @@ class Parser:
         if k == "TENANT":
             self.next()
             ie = self._if_exists()
-            return ast.DropTenant(self.expect_ident(), ie)
+            name = self._ident_or_string()
+            if self.accept_kw("AFTER"):
+                self.expect_string()
+            return ast.DropTenant(name, ie)
         if k == "USER":
             self.next()
             ie = self._if_exists()
-            return ast.DropUser(self.expect_ident(), ie)
+            return ast.DropUser(self._ident_or_string(), ie)
         if k == "ROLE":
             self.next()
             ie = self._if_exists()
-            return ast.DropRole(self.expect_ident(), ie)
+            return ast.DropRole(self._ident_or_string(), ie)
         raise ParserError(f"unsupported DROP {k}")
 
     def parse_alter(self):
@@ -886,29 +963,58 @@ class Parser:
         k = self.kw()
         if k == "DATABASE":
             self.next()
-            name = self.expect_ident()
+            name = self._ident_or_string()
             self.expect_kw("SET")
             opts = {}
             while True:
                 o = self.kw()
                 if o == "TTL":
                     self.next()
+                    self.accept_op("=")
                     opts["ttl"] = self.expect_string()
                 elif o == "SHARD":
                     self.next()
+                    self.accept_op("=")
                     opts["shard_num"] = int(self.expect_number())
                 elif o == "VNODE_DURATION":
                     self.next()
+                    self.accept_op("=")
                     opts["vnode_duration"] = self.expect_string()
                 elif o == "REPLICA":
                     self.next()
+                    self.accept_op("=")
                     opts["replica"] = int(self.expect_number())
+                elif o == "PRECISION":
+                    self.next()
+                    self.accept_op("=")
+                    self.expect_string()
+                    raise ParserError(
+                        "database precision cannot be altered")
+                elif o in ("MAX_MEMCACHE_SIZE", "WAL_MAX_FILE_SIZE",
+                           "MEMCACHE_PARTITIONS", "MAX_CACHE_READERS",
+                           "WAL_SYNC", "STRICT_WRITE"):
+                    raise ParserError(
+                        f"database option {o} cannot be altered")
                 else:
                     break
+                if len(opts) > 1:
+                    # the reference's ALTER DATABASE takes EXACTLY one
+                    # option per statement (alter_database.slt)
+                    raise ParserError(
+                        "ALTER DATABASE takes one option per statement")
             return ast.AlterDatabase(name, opts)
         if k == "TABLE":
             self.next()
-            name = self.expect_ident()
+            tdb, name = self.parse_qualified_ident()
+            if tdb is not None:
+                name = f"{tdb}.{name}"   # executor splits db-qualified
+            if self.accept_kw("RENAME"):
+                self.expect_kw("COLUMN")
+                old = self.expect_ident()
+                self.expect_kw("TO")
+                new = self.expect_ident()
+                return ast.AlterTable(name, "rename", drop_name=old,
+                                      rename_to=new)
             if self.accept_kw("ADD"):
                 if self.accept_kw("TAG"):
                     return ast.AlterTable(name, "add_tag",
@@ -931,9 +1037,33 @@ class Parser:
             self.next()
             name = self.expect_ident()
             self.expect_kw("SET")
-            self.expect_kw("PASSWORD")
-            self.accept_op("=")
-            return ast.AlterUser(name, self.expect_string())
+            changes = {}
+            while True:
+                o = self.kw()
+                if o == "PASSWORD":
+                    self.next()
+                    self.accept_op("=")
+                    changes["password"] = self.expect_string()
+                elif o == "COMMENT":
+                    self.next()
+                    self.accept_op("=")
+                    changes["comment"] = self.expect_string()
+                elif o == "GRANTED_ADMIN":
+                    self.next()
+                    self.accept_op("=")
+                    changes["granted_admin"] = \
+                        self.expect_kw("TRUE", "FALSE") == "TRUE"
+                elif o == "MUST_CHANGE_PASSWORD":
+                    self.next()
+                    self.accept_op("=")
+                    changes["must_change_password"] = \
+                        self.expect_kw("TRUE", "FALSE") == "TRUE"
+                else:
+                    break
+                self.accept_op(",")
+            if not changes:
+                raise ParserError("ALTER USER SET expects an option")
+            return ast.AlterUser(name, changes)
         if k == "TENANT":
             self.next()
             tenant = self.expect_ident()
@@ -948,7 +1078,29 @@ class Parser:
                 self.expect_kw("USER")
                 return ast.AlterTenantMember(tenant, self.expect_ident(),
                                              add=False)
-            raise ParserError("ALTER TENANT expects ADD USER or REMOVE USER")
+            if self.accept_kw("SET"):
+                changes = {}
+                while True:
+                    o = self.kw()
+                    if o == "COMMENT":
+                        self.next()
+                        self.accept_op("=")
+                        changes["comment"] = self.expect_string()
+                    elif o == "DROP_AFTER":
+                        self.next()
+                        self.accept_op("=")
+                        changes["drop_after"] = self.expect_string()
+                    else:
+                        break
+                    self.accept_op(",")
+                if not changes:
+                    raise ParserError("ALTER TENANT SET expects an option")
+                return ast.AlterTenantOpts(tenant, changes)
+            if self.accept_kw("UNSET"):
+                o = self.expect_kw("DROP_AFTER", "COMMENT")
+                return ast.AlterTenantOpts(tenant, {o.lower(): None})
+            raise ParserError(
+                "ALTER TENANT expects ADD/REMOVE USER or SET/UNSET")
         raise ParserError(f"unsupported ALTER {k}")
 
     def _parse_values_rel(self):
@@ -1333,6 +1485,12 @@ class Parser:
         if t.kind == "number":
             self.next()
             return Literal(_num(t.value))
+        if t.kind == "sysvar":
+            self.next()
+            val = _SYSTEM_VARS.get(t.value)
+            if val is None:
+                raise ParserError(f"unknown system variable @@{t.value}")
+            return Literal(val() if callable(val) else val)
         if t.kind == "string":
             self.next()
             return Literal(t.value)

@@ -632,8 +632,9 @@ class _AggCollector:
             # zeroes it (sqlancer: count(1,2,3) == count(*))
             if any(isinstance(a, Literal) and a.value is None
                    for a in args):
-                name, col = "count_null_const", None
-                args = []
+                # count(x, NULL, ...) counts nothing: reduce to the
+                # single-arg count(NULL) shape the dispatch below handles
+                args = [Literal(None)]
             else:
                 cols_only = [a for a in args if isinstance(a, Column)]
                 if not all(isinstance(a, (Column, Literal))

@@ -26,10 +26,22 @@ def system_table(executor, db: str, table: str, session) -> tuple[list[str], lis
             rows = []
             for name in meta.list_databases(session.tenant):
                 o = meta.database(session.tenant, name).options
-                rows.append((session.tenant, name, str(o.ttl), o.shard_num,
-                             str(o.vnode_duration), o.replica, o.precision.name))
+                cfg = o.config
+                rows.append((
+                    session.tenant, name, o.ttl.humantime(), o.shard_num,
+                    o.vnode_duration.humantime(), o.replica,
+                    o.precision.name,
+                    _size_str(cfg.get("max_memcache_size", "128 MiB")),
+                    cfg.get("memcache_partitions", 16),
+                    _size_str(cfg.get("wal_max_file_size", "128 MiB")),
+                    bool(cfg.get("wal_sync", False)),
+                    bool(cfg.get("strict_write", False)),
+                    cfg.get("max_cache_readers", 32)))
             return _cols(["tenant_name", "database_name", "ttl", "shard",
-                          "vnode_duration", "replica", "precision"], rows)
+                          "vnode_duration", "replica", "precision",
+                          "max_memcache_size", "memcache_partitions",
+                          "wal_max_file_size", "wal_sync", "strict_write",
+                          "max_cache_readers"], rows)
         if t == "tables":
             # column set and values follow the reference
             # (information_schema_provider/builder/tables.rs: table_type
@@ -54,28 +66,98 @@ def system_table(executor, db: str, table: str, session) -> tuple[list[str], lis
                           "table_type", "table_engine", "table_options"],
                          rows)
         if t == "columns":
+            # reference column set (information_schema_provider/builder/
+            # columns.rs): ordinal position, nullability, DESCRIBE-style
+            # codec rendering (explicit NULL codec → SQL NULL)
             rows = []
             for dbn in meta.list_databases(session.tenant):
                 for tn in meta.list_tables(session.tenant, dbn):
                     schema = meta.table(session.tenant, dbn, tn)
-                    for c in schema.columns:
+                    for pos, c in enumerate(schema.columns):
                         ct = c.column_type
                         kind = ("TIME" if ct.is_time else
                                 "TAG" if ct.is_tag else "FIELD")
-                        dtype = ("TIMESTAMP" if ct.is_time else "STRING"
-                                 if ct.is_tag else ct.value_type.sql_name())
-                        rows.append((session.tenant, dbn, tn, c.name, kind,
-                                     dtype, c.encoding.name))
+                        dtype = ("TIMESTAMP(NANOSECOND)" if ct.is_time
+                                 else "STRING" if ct.is_tag
+                                 else ct.value_type.sql_name())
+                        codec = (None if c.encoding.name == "NULL"
+                                 else (c.encoding.name
+                                       if c.explicit_codec else "DEFAULT"))
+                        rows.append((session.tenant, dbn, tn, c.name,
+                                     kind, pos, None, not ct.is_time,
+                                     dtype, codec))
             return _cols(["table_tenant", "table_database", "table_name",
-                          "column_name", "column_type", "data_type",
+                          "column_name", "column_type",
+                          "ordinal_position", "column_default",
+                          "is_nullable", "data_type",
                           "compression_codec"], rows)
         if t == "tenants":
             return _tenants_table(meta)
         if t == "users":
             return _users_table(meta)
+        if t == "roles":
+            # reference information_schema ROLES: per-tenant roles incl.
+            # the system roles (role_name, role_type, inherit_role)
+            rows = []
+            for name, spec in sorted(
+                    meta.list_roles(session.tenant).items()):
+                system = name in ("owner", "member")
+                rows.append((name,
+                             "system" if system else "custom",
+                             None if system else spec.get("inherit")))
+            return _cols(["role_name", "role_type", "inherit_role"], rows)
+        if t == "members":
+            rows = [(user, role) for user, role in sorted(
+                meta.members.get(session.tenant, {}).items())]
+            return _cols(["user_name", "role_name"], rows)
         if t == "queries":
-            return _cols(["query_id", "query_text", "user_name", "tenant_name",
-                          "state", "duration"], [])
+            # live registry incl. the asking query itself (reference
+            # QueryTracker view; query_type is 'batch' for SQL)
+            import time as _t
+
+            rows = []
+            for qid, q in executor.tracker.snapshot():
+                txt = q["sql"].strip()
+                if not txt.endswith(";"):
+                    txt += ";"
+                rows.append((str(qid), "batch", txt, q["user"],
+                             q.get("tenant", ""), q.get("db", ""),
+                             "SCHEDULING",
+                             round(_t.time() - q["start"], 6)))
+            return _cols(["query_id", "query_type", "query_text",
+                          "user_name", "tenant_name", "database_name",
+                          "state", "duration"], rows)
+        if t == "enabled_roles":
+            # roles of the CURRENT session user in the current tenant
+            role = meta.members.get(session.tenant,
+                                    {}).get(session.user)
+            rows = [(role,)] if role else []
+            return _cols(["role_name"], rows)
+        if t == "resource_status":
+            # pending/applied resource ops from the recycle bin
+            # (reference ResourceManager persists ops in meta;
+            # resource_status.slt pins DropDatabase entries)
+            rows = []
+            for key in meta.trash.get("db", {}):
+                tenant, dbn = key.split(".", 1)
+                rows.append((0, f"{tenant}-{dbn}", "DropDatabase", 0,
+                             "Successed", ""))
+            for key in meta.trash.get("table", {}):
+                parts = key.split(".", 2)
+                rows.append((0, "-".join(parts), "DropTable", 0,
+                             "Successed", ""))
+            for name in meta.trash.get("tenant", {}):
+                rows.append((0, name, "DropTenant", 0, "Successed", ""))
+            return _cols(["time", "name", "action", "try_count",
+                          "status", "comment"], rows)
+        if t == "database_privileges":
+            rows = []
+            for role, spec in meta.roles.get(session.tenant, {}).items():
+                for dbn, lvl in (spec.get("privileges") or {}).items():
+                    rows.append((session.tenant, dbn,
+                                 lvl.capitalize(), role))
+            return _cols(["tenant_name", "database_name",
+                          "privilege_type", "role_name"], rows)
     if db == "cluster_schema":
         # the reference serves users/tenants from CLUSTER_SCHEMA
         # (metadata/cluster_schema_provider); keep them reachable from the
@@ -112,14 +194,46 @@ def system_table(executor, db: str, table: str, session) -> tuple[list[str], lis
     raise TableNotFound(f"{db}.{table}")
 
 
+def _size_str(v) -> str:
+    from .executor import _size_display
+
+    return _size_display(v)
+
+
 def _users_table(meta):
-    rows = [(name, bool(u.get("admin")), u.get("comment", ""))
+    import json
+
+    def opts_json(u):
+        # reference user_options JSON: keys appear only when SET, in
+        # hash_password → must_change_password → comment order
+        # (dcl/alter_user.slt pins the shapes); the hash never leaks
+        out = {"hash_password": "*****"}
+        if "must_change_password" in u and u["must_change_password"] \
+                is not None:
+            out["must_change_password"] = bool(u["must_change_password"])
+        if u.get("comment"):
+            out["comment"] = u["comment"]
+        return json.dumps(out, separators=(",", ":"),
+                          ensure_ascii=False)
+
+    rows = [(name, bool(u.get("admin")), opts_json(u))
             for name, u in meta.users.items()]
-    return _cols(["user_name", "is_admin", "comment"], rows)
+    return _cols(["user_name", "is_admin", "user_options"], rows)
 
 
 def _tenants_table(meta):
-    rows = [(name, opts.comment) for name, opts in meta.tenants.items()]
+    import json
+
+    def opts_json(o):
+        da = None
+        if o.drop_after is not None:
+            da = {"duration": str(o.drop_after)}
+        return json.dumps(
+            {"comment": o.comment or None, "limiter_config": o.limiter,
+             "drop_after": da, "tenant_is_hidden": False},
+            separators=(",", ":"), ensure_ascii=False)
+
+    rows = [(name, opts_json(opts)) for name, opts in meta.tenants.items()]
     return _cols(["tenant_name", "tenant_options"], rows)
 
 

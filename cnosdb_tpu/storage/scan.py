@@ -86,12 +86,15 @@ def _series_parts(vnode: VnodeStorage, table: str, sid: int,
                 if not sel.any():
                     continue
             fields = {}
+            aliases = _field_aliases(vnode, table, field_names)
             for name in field_names:
-                col = cm.column(name)
-                if col is None:
+                src = next((c for c in aliases.get(name, [name])
+                            if cm.column(c) is not None), None)
+                if src is None:
                     continue
+                col = cm.column(src)
                 vt = ValueType(col.pages[0].value_type)
-                vals, valid = r.read_series_column(table, sid, name)
+                vals, valid = r.read_series_column(table, sid, src)
                 if sel is not None:
                     vals, valid = vals[sel], valid[sel]
                 fields[name] = (vt, vals, valid)
@@ -108,10 +111,13 @@ def _series_parts(vnode: VnodeStorage, table: str, sid: int,
                 continue
             ts = ts[tmask]
         fields = {}
+        aliases = _field_aliases(vnode, table, field_names)
         for name in field_names:
-            if name not in mfields:
+            src = next((c for c in aliases.get(name, [name])
+                        if c in mfields), None)
+            if src is None:
                 continue
-            vt, vals, valid = mfields[name]
+            vt, vals, valid = mfields[src]
             if tmask is not None:
                 vals, valid = vals[tmask], valid[tmask]
             fields[name] = (vt, vals, valid)
@@ -212,6 +218,30 @@ def merge_parts(parts, field_names: list[str]):
             vals_out = DictArray(vals_out, union)
         out[name] = (vt, vals_out, valid_out)
     return uts, out
+
+
+def _field_aliases(vnode: VnodeStorage, table: str,
+                   field_names: list[str]) -> dict:
+    """name → [name, *prior_names] (RENAME COLUMN lineage: old chunks
+    wrote under the previous names)."""
+    schema = vnode.schemas.get(table)
+    out = {}
+    for n in field_names:
+        cands = [n]
+        if schema is not None:
+            c = schema.column(n) if schema.contains_column(n) else None
+            if c is not None and getattr(c, "prior_names", None):
+                cands += list(c.prior_names)
+        out[n] = cands
+    return out
+
+
+def _resolve_chunk_col(cols: dict, cands: list):
+    for c in cands:
+        hit = cols.get(c)
+        if hit is not None:
+            return hit
+    return None
 
 
 def scan_vnode(vnode: VnodeStorage, table: str,
@@ -452,6 +482,7 @@ def _scan_vnode_native(vnode: VnodeStorage, table: str,
                 continue
             files.append((fm, version.reader(fm)))
     mem_sids = _mem_series_ids(vnode, table)
+    aliases = _field_aliases(vnode, table, field_names)
 
     # ---------------------------------------------------------------- plan
     # per series: ("n", sid, [(reader, chunk, cols, [page idx])], n_rows,
@@ -555,7 +586,8 @@ def _scan_vnode_native(vnode: VnodeStorage, table: str,
                 tp = cm.time_pages[i]
                 _add_page(r, tp, None, off, 0)
                 for name in field_names:
-                    col = cols.get(name)
+                    col = _resolve_chunk_col(cols, aliases.get(name,
+                                                               [name]))
                     if col is None:
                         continue   # absent column: stays zero/invalid
                     pm = col.pages[i]

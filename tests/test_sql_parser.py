@@ -125,7 +125,8 @@ def test_tenant_user():
     assert one("CREATE TENANT test").name == "test"
     u = one("CREATE USER u1 WITH PASSWORD = 'secret'")
     assert u.password == "secret"
-    assert one("ALTER USER u1 SET PASSWORD = 'n'").password == "n"
+    assert one("ALTER USER u1 SET PASSWORD = 'n'").changes == {
+        "password": "n"}
     assert one("DROP TENANT IF EXISTS test").if_exists
 
 
