@@ -281,6 +281,32 @@ class TskvTableSchema:
         self.schema_version += 1
         return col
 
+    def rename_column(self, old: str, new: str) -> TableColumn:
+        """RENAME COLUMN: the column keeps its id — TSM chunks resolve
+        fields by id (storage/scan.py), so historic data follows the
+        rename even if `new` is later reused. `old` joins prior_names
+        for the name-keyed surfaces (memcache rows, id-less chunks);
+        reusing a renamed-away name cuts the other column's lineage to
+        it, mirroring add_column."""
+        col = self._by_name.get(old)
+        if col is None:
+            raise ColumnNotFound(f"{self.name}.{old}")
+        if col.column_type.is_time:
+            raise SchemaError("cannot rename the time column")
+        if new in self._by_name:
+            raise SchemaError(f"duplicate column {new!r} in {self.name}")
+        if not _IDENT_RE.match(new):
+            raise SchemaError(f"invalid column name {new!r}")
+        for c in self.columns:
+            if c is not col and new in getattr(c, "prior_names", ()):
+                c.prior_names = [x for x in c.prior_names if x != new]
+        del self._by_name[old]
+        col.prior_names = [old] + [x for x in col.prior_names if x != old]
+        col.name = new
+        self._by_name[new] = col
+        self.schema_version += 1
+        return col
+
     def drop_column(self, name: str) -> TableColumn:
         col = self._by_name.get(name)
         if col is None:

@@ -532,21 +532,15 @@ class QueryExecutor:
             schema.add_column(stmt.column.name, ColumnType.tag())
         elif stmt.action == "rename":
             # RENAME COLUMN old TO new (reference rename_field/tag.slt:
-            # time never renames; target must be free)
-            old = stmt.drop_name
-            if old == "time":
-                raise ExecutionError("cannot rename the time column")
-            if schema.contains_column(stmt.rename_to):
-                raise ExecutionError(
-                    f"column {stmt.rename_to!r} exists")
-            col = schema.column(old)
-            if col is None:
-                raise ExecutionError(f"column {old!r} not found")
-            del schema._by_name[old]
-            col.prior_names = [old] + list(col.prior_names)
-            col.name = stmt.rename_to
-            schema._by_name[stmt.rename_to] = col
-            schema.schema_version += 1
+            # time never renames; target must be free) — invariants live
+            # in TskvTableSchema.rename_column; buffered rows re-key so
+            # they follow the column like id-resolved TSM chunks do
+            col = schema.rename_column(stmt.drop_name, stmt.rename_to)
+            if col.column_type.is_field:
+                owner = f"{session.tenant}.{db}"
+                for v in self.coord.engine.local_vnodes(owner):
+                    v.rename_mem_field(name, stmt.drop_name,
+                                       stmt.rename_to)
         elif stmt.action == "drop":
             tgt = schema.column(stmt.drop_name)
             if tgt is not None and tgt.column_type.is_field:
@@ -562,7 +556,11 @@ class QueryExecutor:
                 # columns (create_table.slt pins DROP column7 on a
                 # two-tag table as an error)
                 raise ExecutionError("cannot drop a tag column")
-            schema.drop_column(stmt.drop_name)
+            dropped = schema.drop_column(stmt.drop_name)
+            if dropped.column_type.is_field:
+                owner = f"{session.tenant}.{db}"
+                for v in self.coord.engine.local_vnodes(owner):
+                    v.drop_mem_field(name, stmt.drop_name)
         self.meta.update_table(schema)
         return ResultSet.message("ok")
 

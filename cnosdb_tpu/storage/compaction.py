@@ -150,8 +150,8 @@ class Picker:
 # merge executor
 # ---------------------------------------------------------------------------
 def run_compaction(version: Version, req: CompactReq, out_file_id: int,
-                   alloc_id=None,
-                   max_out_bytes: int = 0) -> VersionEdit | None:
+                   alloc_id=None, max_out_bytes: int = 0,
+                   schemas: dict | None = None) -> VersionEdit | None:
     """Merge req.files → time-partitioned file(s) at req.target_level;
     returns the edit (caller applies it via Summary). Tombstoned rows are
     dropped for good.
@@ -196,9 +196,10 @@ def run_compaction(version: Version, req: CompactReq, out_file_id: int,
 
     tables: list[str] = sorted({t for _, r, _ in readers for t in r.tables()})
     for table in tables:
+        schema = schemas.get(table) if schemas else None
         sids = sorted({int(s) for _, r, _ in readers for s in r.series_ids(table)})
         for sid in sids:
-            merged = _merge_series(table, sid, readers)
+            merged = _merge_series(table, sid, readers, schema)
             if merged is None:
                 continue
             ts, cols = merged
@@ -232,17 +233,25 @@ def run_compaction(version: Version, req: CompactReq, out_file_id: int,
     return VersionEdit(add_files=add_files, del_files=edit_del)
 
 
-def _merge_series(table: str, sid: int, readers) -> tuple[np.ndarray, dict] | None:
+def _merge_series(table: str, sid: int, readers,
+                  schema=None) -> tuple[np.ndarray, dict] | None:
     """Vectorized k-file merge of one series.
 
     Concatenate rows from all files (priority = position in `readers`,
     ascending file_id), stable-sort by ts, then per field pick the last
     valid value within each timestamp group — identical semantics to
     memcache.materialize.
+
+    Columns unify by COLUMN ID (name only for id-less legacy chunks):
+    after RENAME COLUMN reuses a name, same-named chunk columns from
+    different schema eras are different columns and must not merge.
+    The output column is written under the id's current schema name.
     """
     ts_parts: list[np.ndarray] = []
-    col_parts: dict[str, list[tuple[int, np.ndarray, np.ndarray]]] = {}
-    col_types: dict[str, tuple[ValueType, Encoding, int]] = {}
+    col_parts: dict[object, list[tuple[int, np.ndarray, np.ndarray]]] = {}
+    # key → (vt, enc, cid, latest-seen chunk name); readers are ordered
+    # oldest→newest, so the last write gives the newest on-disk name
+    col_types: dict[object, tuple[ValueType, Encoding, int, str]] = {}
     offsets: list[int] = []
     total = 0
     for fm, r, tb in readers:
@@ -257,9 +266,14 @@ def _merge_series(table: str, sid: int, readers) -> tuple[np.ndarray, dict] | No
             vals, valid = r.read_series_column(table, sid, col.name)
             if keep is not None:
                 vals, valid = vals[keep], valid[keep]
-            col_parts.setdefault(col.name, []).append((total, vals, valid))
-            if col.name not in col_types:
-                col_types[col.name] = (vt, Encoding(pm0.encoding), col.column_id)
+            key = col.column_id if col.column_id else ("name", col.name)
+            col_parts.setdefault(key, []).append((total, vals, valid))
+            if key not in col_types:
+                col_types[key] = (vt, Encoding(pm0.encoding),
+                                  col.column_id, col.name)
+            else:
+                t = col_types[key]
+                col_types[key] = (t[0], t[1], t[2], col.name)
         if keep is not None:
             ts = ts[keep]
         ts_parts.append(ts)
@@ -281,8 +295,12 @@ def _merge_series(table: str, sid: int, readers) -> tuple[np.ndarray, dict] | No
     else:
         uts = ts_all
     out_cols = {}
-    for name, parts in col_parts.items():
-        vt, enc, cid = col_types[name]
+    for key, parts in col_parts.items():
+        vt, enc, cid, name = col_types[key]
+        if cid and schema is not None:
+            sc = schema.column_by_id(cid)
+            if sc is not None:
+                name = sc.name
         np_dtype = vt.numpy_dtype()
         is_str = np_dtype is object
         if is_str:
@@ -310,6 +328,11 @@ def _merge_series(table: str, sid: int, readers) -> tuple[np.ndarray, dict] | No
         if is_str:
             vals_out = DictArray(vals_out, union)
         null_mask = None if valid_out.all() else ~valid_out
+        if name in out_cols:
+            # two ids converged on one name (a dropped column whose last
+            # on-disk name a live column now holds): ids stay the scan
+            # identity, the name only needs chunk-uniqueness
+            name = f"{name}#{cid}"
         out_cols[name] = (cid, vt, enc, vals_out, null_mask)
     return uts, out_cols
 

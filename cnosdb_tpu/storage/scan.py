@@ -61,6 +61,7 @@ def _series_parts(vnode: VnodeStorage, table: str, sid: int,
                   field_names: list[str], trs: TimeRanges):
     """Collect (ts, {field: (vt, vals, valid)}) parts in priority order."""
     parts = []
+    targets = _field_targets(vnode, table, field_names)
     version = vnode.summary.version
     # files: L4..L1 then L0, ascending file_id within level ⇒ ascending priority
     for level in (4, 3, 2, 1, 0):
@@ -86,15 +87,14 @@ def _series_parts(vnode: VnodeStorage, table: str, sid: int,
                 if not sel.any():
                     continue
             fields = {}
-            aliases = _field_aliases(vnode, table, field_names)
+            maps = _chunk_maps(cm)
             for name in field_names:
-                src = next((c for c in aliases.get(name, [name])
-                            if cm.column(c) is not None), None)
-                if src is None:
+                cid, cands = targets[name]
+                col = _resolve_chunk_col(maps, cid, cands)
+                if col is None:
                     continue
-                col = cm.column(src)
                 vt = ValueType(col.pages[0].value_type)
-                vals, valid = r.read_series_column(table, sid, src)
+                vals, valid = r.read_series_column(table, sid, col.name)
                 if sel is not None:
                     vals, valid = vals[sel], valid[sel]
                 fields[name] = (vt, vals, valid)
@@ -111,9 +111,8 @@ def _series_parts(vnode: VnodeStorage, table: str, sid: int,
                 continue
             ts = ts[tmask]
         fields = {}
-        aliases = _field_aliases(vnode, table, field_names)
         for name in field_names:
-            src = next((c for c in aliases.get(name, [name])
+            src = next((c for c in targets[name][1]
                         if c in mfields), None)
             if src is None:
                 continue
@@ -220,27 +219,59 @@ def merge_parts(parts, field_names: list[str]):
     return uts, out
 
 
-def _field_aliases(vnode: VnodeStorage, table: str,
+def _field_targets(vnode: VnodeStorage, table: str,
                    field_names: list[str]) -> dict:
-    """name → [name, *prior_names] (RENAME COLUMN lineage: old chunks
-    wrote under the previous names)."""
+    """name → (column_id | None, [name, *prior_names]).
+
+    TSM chunk columns are resolved by column id when both sides carry
+    one: ids are never reused (models/schema.py), so data written under
+    a renamed-away name can never conflate with a newer column that
+    later took the name. The name-lineage candidates are the fallback
+    for id-less chunks (flushed without a schema) and for name-keyed
+    memcache rows."""
     schema = vnode.schemas.get(table)
     out = {}
     for n in field_names:
         cands = [n]
+        cid = None
         if schema is not None:
             c = schema.column(n) if schema.contains_column(n) else None
-            if c is not None and getattr(c, "prior_names", None):
-                cands += list(c.prior_names)
-        out[n] = cands
+            if c is not None:
+                cid = c.id
+                if getattr(c, "prior_names", None):
+                    cands += list(c.prior_names)
+        out[n] = (cid, cands)
     return out
 
 
-def _resolve_chunk_col(cols: dict, cands: list):
-    for c in cands:
-        hit = cols.get(c)
-        if hit is not None:
-            return hit
+def _chunk_maps(cm) -> tuple[dict, dict]:
+    """Build one (by_id, by_name) lookup per chunk — resolve all query
+    columns against it rather than re-scanning cm.columns per field."""
+    by_id: dict = {}
+    by_name: dict = {}
+    for c in cm.columns:
+        if c.column_id:
+            by_id.setdefault(c.column_id, c)
+        by_name.setdefault(c.name, c)
+    return by_id, by_name
+
+
+def _resolve_chunk_col(maps, cid, cands):
+    """→ ColumnMeta for one query column inside one chunk, id-first.
+
+    Name fallback only considers chunk columns WITHOUT an id when the
+    query column's id is known — a chunk column carrying a different id
+    is provably another (renamed/dropped) column, even if its name
+    matches."""
+    by_id, by_name = maps
+    if cid is not None:
+        c = by_id.get(cid)
+        if c is not None:
+            return c
+    for nm in cands:
+        c = by_name.get(nm)
+        if c is not None and (cid is None or not c.column_id):
+            return c
     return None
 
 
@@ -482,7 +513,7 @@ def _scan_vnode_native(vnode: VnodeStorage, table: str,
                 continue
             files.append((fm, version.reader(fm)))
     mem_sids = _mem_series_ids(vnode, table)
-    aliases = _field_aliases(vnode, table, field_names)
+    targets = _field_targets(vnode, table, field_names)
 
     # ---------------------------------------------------------------- plan
     # per series: ("n", sid, [(reader, chunk, cols, [page idx])], n_rows,
@@ -494,7 +525,7 @@ def _scan_vnode_native(vnode: VnodeStorage, table: str,
     for sid in series_ids:
         sid = int(sid)
         entry = _plan_series(vnode, table, sid, files, mem_sids, trs,
-                             constraints, field_names)
+                             constraints, field_names, targets)
         if entry is None:
             continue
         if entry[0] == "p":   # series pruned away entirely by constraints
@@ -586,8 +617,7 @@ def _scan_vnode_native(vnode: VnodeStorage, table: str,
                 tp = cm.time_pages[i]
                 _add_page(r, tp, None, off, 0)
                 for name in field_names:
-                    col = _resolve_chunk_col(cols, aliases.get(name,
-                                                               [name]))
+                    col = cols.get(name)
                     if col is None:
                         continue   # absent column: stays zero/invalid
                     pm = col.pages[i]
@@ -720,10 +750,13 @@ def _scan_vnode_native(vnode: VnodeStorage, table: str,
 
 
 def _plan_series(vnode, table, sid, files, mem_sids, trs, constraints,
-                 field_names):
+                 field_names, targets):
     """→ ("n", sid, [(reader, chunk, cols, admitted idx)], n_rows, trim,
     pruned) | ("f", sid, ts, fields) | ("p",) (rows existed but every
-    page was constraint-pruned) | None (no rows)."""
+    page was constraint-pruned) | None (no rows). `cols` maps QUERY
+    column names to each chunk's ColumnMeta (id-resolved — see
+    _resolve_chunk_col), so constraint pruning and page decode stay
+    correct across RENAME COLUMN."""
     fallback = sid in mem_sids
     chunks = []
     if not fallback:
@@ -765,7 +798,13 @@ def _plan_series(vnode, table, sid, files, mem_sids, trs, constraints,
     pruned = False
     time_admitted = 0
     for r, cm in chunks:
-        cols = {c.name: c for c in cm.columns}
+        cols = {}
+        maps = _chunk_maps(cm)
+        for qname in field_names:
+            cid, cands = targets[qname]
+            c = _resolve_chunk_col(maps, cid, cands)
+            if c is not None:
+                cols[qname] = c
         idx = []
         for i, tp in enumerate(cm.time_pages):
             if not trs.is_all and not trs.overlaps(

@@ -143,6 +143,28 @@ class VnodeStorage:
             self.wal.sync()
             self.wal.purge_to(self.summary.version.flushed_seq + 1)
 
+    def rename_mem_field(self, table: str, old: str, new: str):
+        """ALTER ... RENAME COLUMN: re-key buffered (unflushed) rows so
+        in-memory data follows the column the same way id-resolved TSM
+        chunks do — without this, renaming a column to a previously-used
+        name would conflate the two columns' unflushed values."""
+        with self.lock:
+            for cache in [self.active, *self.immutables]:
+                for (t, _sid), sd in cache.series.items():
+                    if t == table and old in sd.field_chunks:
+                        sd.field_chunks[new] = sd.field_chunks.pop(old)
+
+    def drop_mem_field(self, table: str, name: str):
+        """ALTER ... DROP COLUMN: purge buffered rows of the dropped
+        field. Leftover name-keyed memcache chunks would otherwise be
+        resurrected by a later RENAME/ADD that reuses the name (flushed
+        chunks are immune: their dropped column id is never requested)."""
+        with self.lock:
+            for cache in [self.active, *self.immutables]:
+                for (t, _sid), sd in cache.series.items():
+                    if t == table:
+                        sd.field_chunks.pop(name, None)
+
     # ------------------------------------------------------------------ compact
     def compact(self, force_level: int | None = None) -> bool:
         """Run at most one compaction round; → True if work was done."""
@@ -156,7 +178,8 @@ class VnodeStorage:
             edit = run_compaction(
                 self.summary.version, req, fid,
                 alloc_id=self.summary.next_file_id,
-                max_out_bytes=self.picker.max_output_file_size)
+                max_out_bytes=self.picker.max_output_file_size,
+                schemas=self.schemas)
             if edit is None:
                 return False
             # bump only when the file set actually changes so no-op rounds
@@ -233,7 +256,8 @@ class VnodeStorage:
             edit = run_compaction(
                 self.summary.version, req, fid,
                 alloc_id=self.summary.next_file_id,
-                max_out_bytes=self.picker.max_output_file_size)
+                max_out_bytes=self.picker.max_output_file_size,
+                schemas=self.schemas)
             if edit is None:
                 return False
             self.data_version += 1

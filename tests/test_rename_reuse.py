@@ -1,0 +1,134 @@
+"""RENAME COLUMN lineage: reusing a renamed-away name must never
+conflate two columns' data (TSM chunks resolve fields by column id —
+storage/scan.py _resolve_chunk_col; buffered memcache rows re-key at
+ALTER time — vnode.rename_mem_field). Reference behavior:
+alter_table.rs rename_column keeps the column id stable."""
+import pytest
+
+from cnosdb_tpu.parallel.coordinator import Coordinator
+from cnosdb_tpu.parallel.meta import MetaStore
+from cnosdb_tpu.sql.executor import QueryExecutor
+from cnosdb_tpu.storage.engine import TsKv
+
+
+@pytest.fixture
+def db(tmp_path):
+    meta = MetaStore(str(tmp_path / "meta.json"))
+    engine = TsKv(str(tmp_path / "data"))
+    coord = Coordinator(meta, engine)
+    ex = QueryExecutor(meta, coord)
+    ex._engine = engine
+    yield ex
+    engine.close()
+
+
+def _setup(db):
+    db.execute_one("CREATE TABLE t (f1 BIGINT, f2 BIGINT, TAGS(tg))")
+    db.execute_one(
+        "INSERT INTO t (time, tg, f1, f2) VALUES "
+        "(1000, 'a', 100, 200), (2000, 'a', 101, 201)")
+
+
+def _rename_chain(db):
+    db.execute_one("ALTER TABLE t RENAME COLUMN f1 TO g")
+    db.execute_one("ALTER TABLE t RENAME COLUMN f2 TO f1")
+
+
+def _check(db):
+    rs = db.execute_one("SELECT time, g, f1 FROM t ORDER BY time")
+    assert rs.columns[1].tolist() == [100, 101]   # g = historic f1
+    assert rs.columns[2].tolist() == [200, 201]   # new f1 = historic f2
+
+
+def test_rename_reuse_memcache(db):
+    """Unflushed rows: the ALTER re-keys live memcache data."""
+    _setup(db)
+    _rename_chain(db)
+    _check(db)
+
+
+def test_rename_reuse_flushed(db):
+    """Flushed chunks: id-based resolution picks the right column."""
+    _setup(db)
+    db._engine.flush_all()
+    _rename_chain(db)
+    _check(db)
+
+
+def test_rename_reuse_flushed_with_filter(db):
+    """Predicate page pruning must key constraints onto the id-resolved
+    chunk column, not the same-named stale one."""
+    _setup(db)
+    db._engine.flush_all()
+    _rename_chain(db)
+    rs = db.execute_one("SELECT time, f1 FROM t WHERE f1 >= 201")
+    assert rs.columns[1].tolist() == [201]
+    rs = db.execute_one("SELECT time, g FROM t WHERE g <= 100")
+    assert rs.columns[1].tolist() == [100]
+
+
+def test_rename_reuse_across_compaction(db):
+    """Compaction merges chunk columns by id and writes them back under
+    the current schema names."""
+    _setup(db)
+    db._engine.flush_all()
+    _rename_chain(db)
+    db.execute_one(
+        "INSERT INTO t (time, tg, g, f1) VALUES (3000, 'a', 102, 202)")
+    db._engine.flush_all()
+    db._engine.compact_all()
+    rs = db.execute_one("SELECT time, g, f1 FROM t ORDER BY time")
+    assert rs.columns[1].tolist() == [100, 101, 102]
+    assert rs.columns[2].tolist() == [200, 201, 202]
+
+
+def test_rename_then_add_fresh_column(db):
+    """ADD COLUMN under a renamed-away name starts empty (lineage cut —
+    models/schema.py add_column)."""
+    _setup(db)
+    db._engine.flush_all()
+    db.execute_one("ALTER TABLE t RENAME COLUMN f1 TO g")
+    db.execute_one("ALTER TABLE t ADD FIELD f1 BIGINT")
+    rs = db.execute_one("SELECT time, g, f1 FROM t ORDER BY time")
+    assert rs.columns[1].tolist() == [100, 101]
+    assert rs.columns[2].tolist() == [None, None]
+
+
+def test_rename_simple_follows_data(db):
+    """Plain rename still reads historic chunks (no reuse involved)."""
+    _setup(db)
+    db._engine.flush_all()
+    db.execute_one("ALTER TABLE t RENAME COLUMN f1 TO vis")
+    rs = db.execute_one("SELECT time, vis FROM t ORDER BY time")
+    assert rs.columns[1].tolist() == [100, 101]
+
+
+def test_drop_then_rename_no_resurrection(db):
+    """DROP COLUMN purges unflushed memcache chunks; renaming another
+    column onto the dropped name must not resurrect the dropped values."""
+    db.execute_one("CREATE TABLE t (a BIGINT, b BIGINT, TAGS(tg))")
+    db.execute_one("INSERT INTO t (time, tg, b) VALUES (1000, 'x', 555)")
+    db.execute_one("ALTER TABLE t DROP COLUMN b")
+    db.execute_one("ALTER TABLE t RENAME COLUMN a TO b")
+    rs = db.execute_one("SELECT time, b FROM t")
+    assert rs.columns[1].tolist() == [None]
+
+
+def test_drop_then_add_no_resurrection(db):
+    """Same leftover-chunk hazard through ADD COLUMN instead of RENAME."""
+    db.execute_one("CREATE TABLE t (a BIGINT, b BIGINT, TAGS(tg))")
+    db.execute_one("INSERT INTO t (time, tg, b) VALUES (1000, 'x', 555)")
+    db.execute_one("ALTER TABLE t DROP COLUMN b")
+    db.execute_one("ALTER TABLE t ADD FIELD b BIGINT")
+    rs = db.execute_one("SELECT time, b FROM t")
+    assert rs.columns[1].tolist() == [None]
+
+
+def test_rename_errors(db):
+    _setup(db)
+    with pytest.raises(Exception):
+        db.execute_one("ALTER TABLE t RENAME COLUMN time TO t2")
+    with pytest.raises(Exception):
+        db.execute_one("ALTER TABLE t RENAME COLUMN f1 TO f2")
+    with pytest.raises(Exception):
+        db.execute_one("ALTER TABLE t RENAME COLUMN nope TO x")
