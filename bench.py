@@ -357,11 +357,18 @@ def _guard_degraded_relay():
     # clearing the var in-process would be too late — the plugin
     # registered at THIS interpreter's start
     print(f"# {verdict}\n# re-exec on CPU jax", file=sys.stderr)
-    env = cleaned_cpu_env({
+    extra = {
         "CNOSDB_BENCH_REEXEC": "1",
         # record WHY this run fell back so the JSON carries the verdict
         "CNOSDB_BENCH_PROBE": verdict,
-    })
+    }
+    # stash the relay address cleaned_cpu_env is about to strip — the
+    # end-of-bench re-probe (_device_metric_subprocess) needs it back to
+    # dial the relay at all
+    pool_ips = os.environ.get("PALLAS_AXON_POOL_IPS")
+    if pool_ips:
+        extra["CNOSDB_BENCH_ORIG_POOL_IPS"] = pool_ips
+    env = cleaned_cpu_env(extra)
     os.execve(sys.executable, [sys.executable] + sys.argv, env)
 
 
@@ -375,7 +382,17 @@ def _device_kernel_metric():
     timeout. → dict of extra JSON fields."""
     probe = os.environ.get("CNOSDB_BENCH_PROBE")
     if probe:
-        return {"device_probe": probe}   # degraded: say why, measure nothing
+        # the START-of-bench probe failed and this process re-exec'd on
+        # CPU jax — but the relay may have recovered since (round-4: the
+        # bench gave up after one probe and four rounds produced zero
+        # device evidence). Re-probe at bench END via a fresh subprocess
+        # carrying the ORIGINAL device env; on success, capture the
+        # microbench there.
+        sub = _device_metric_subprocess()
+        if sub is not None:
+            sub["device_probe_start"] = probe
+            return sub
+        return {"device_probe": probe}   # still degraded: say why
     import threading
 
     result: dict = {}
@@ -386,6 +403,43 @@ def _device_kernel_metric():
     if not result:
         return {"device_probe": "metric timeout (relay degraded mid-run?)"}
     return result
+
+
+def _device_metric_subprocess() -> dict | None:
+    """Run the device kernel microbench in a child process with the
+    original (device) environment. → parsed dict on success, None when
+    the relay is still dead."""
+    import subprocess
+
+    code = (
+        "import json, sys\n"
+        "import bench\n"
+        "r = {}\n"
+        "bench._device_kernel_metric_body(r)\n"
+        "print('\\n__DEVICE__' + json.dumps(r))\n")
+    env = dict(os.environ)
+    env.pop("CNOSDB_BENCH_REEXEC", None)
+    env.pop("CNOSDB_BENCH_PROBE", None)
+    env.pop("JAX_PLATFORMS", None)
+    # restore the relay address the degraded-relay re-exec stripped —
+    # without it the child comes up on CPU jax and the re-probe can
+    # never succeed
+    orig = env.pop("CNOSDB_BENCH_ORIG_POOL_IPS", None)
+    if orig:
+        env["PALLAS_AXON_POOL_IPS"] = orig
+    try:
+        out = subprocess.run(
+            [sys.executable, "-c", code], capture_output=True, text=True,
+            timeout=420, env=env,
+            cwd=os.path.dirname(os.path.abspath(__file__)))
+        for line in out.stdout.splitlines():
+            if line.startswith("__DEVICE__"):
+                rec = json.loads(line[len("__DEVICE__"):])
+                if rec.get("device_probe") == "ok":
+                    return rec
+        return None
+    except Exception:
+        return None
 
 
 def _device_kernel_metric_body(result: dict):
@@ -451,6 +505,25 @@ def _device_kernel_metric_body(result: dict):
         result["device_probe"] = f"metric failed: {e!r:.200}"
 
 
+def _persist_device_evidence(device: dict):
+    """Write DEVICE_r.json next to the repo whenever a device metric was
+    captured (or record the relay's failure verdict with a timestamp) —
+    round-4 verdict item 5: a healthy-relay round must leave durable
+    device-executed evidence; a relay-down round must say so verifiably."""
+    try:
+        import datetime
+
+        rec = dict(device)
+        rec["captured_at"] = datetime.datetime.now(
+            datetime.timezone.utc).isoformat()
+        path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "DEVICE_r.json")
+        with open(path, "w") as f:
+            json.dump(rec, f, indent=1)
+    except Exception:
+        pass   # evidence capture must never sink the bench
+
+
 def main():
     _guard_degraded_relay()
     data_dir = tempfile.mkdtemp(prefix="cnosdb_bench_")
@@ -513,10 +586,16 @@ def main():
                            for k, v in stages.snapshot().items()}
             stages.enable(False)
             np_fn()   # warm
-            t0 = time.perf_counter()
-            for _ in range(iters):
-                np_fn()
-            base_dt = (time.perf_counter() - t0) / iters
+            # MEDIAN-of-3 oracle timing: a single numpy run fluctuates
+            # ±2× (round-4 verdict: the denominator must be stable);
+            # absolute engine ms stays the tracked contract either way
+            samples = []
+            for _ in range(3):
+                t0 = time.perf_counter()
+                for _ in range(iters):
+                    np_fn()
+                samples.append((time.perf_counter() - t0) / iters)
+            base_dt = sorted(samples)[1]
             rate = rows_touched / engine_dt
             vs = (rows_touched / engine_dt) / (rows_touched / base_dt)
             results[name] = {"rows_per_s": round(rate, 1),
@@ -525,6 +604,8 @@ def main():
                              "cold_rows_per_s": round(
                                  rows_touched / cold_dt, 1),
                              "baseline_ms": round(base_dt * 1e3, 1),
+                             "baseline_ms_samples": [
+                                 round(x * 1e3, 1) for x in samples],
                              "vs_baseline": round(vs, 3),
                              "vs_baseline_cold": round(
                                  base_dt / cold_dt, 3),
@@ -542,6 +623,21 @@ def main():
 
         from cnosdb_tpu.ops import pallas_kernels
 
+        # secondary tiers: full TSBS IoT-13 + ClickBench-43 coverage,
+        # each query oracle-checked (round-4 verdict item 9); scaled via
+        # CNOSDB_BENCH_SUITE_ROWS, skippable with CNOSDB_BENCH_SUITES=0
+        suites = {}
+        if os.environ.get("CNOSDB_BENCH_SUITES", "1") != "0":
+            try:
+                import bench_suites
+
+                suites = bench_suites.run_suites(
+                    executor, coord, DEFAULT_TENANT, "public", session)
+            except Exception as e:   # a tier failure must not sink the
+                suites = {"suite_errors": {"tier": repr(e)[:200]}}
+
+        device = _device_kernel_metric()
+        _persist_device_evidence(device)
         print(json.dumps({
             "metric": "tsbs_double_groupby_1h_scan_agg_100m",
             "value": round(headline[0], 1),
@@ -553,7 +649,8 @@ def main():
             "shapes": results,
             "pallas_enabled": pallas_kernels.enabled(),
             "pallas_engagements": pallas_kernels.engagements(),
-            **_device_kernel_metric(),
+            **suites,
+            **device,
         }))
         coord.close()
     finally:

@@ -1,0 +1,606 @@
+"""Secondary benchmark tiers: TSBS IoT-13 and ClickBench-43.
+
+The reference ships harnesses for both (benchmark/tsbs/run_queries.sh:37-50
+with shell_env.sh's 13 IoT query types; benchmark/hits/sql/queries.sql's 43
+ClickBench queries). This module runs every query type against datasets
+built through the normal write path, CHECKS each result against a numpy
+oracle over the same data, and reports warm per-query times. Not the
+headline — bench.py's primary shapes stay the contract — but full
+coverage so regressions in any query family surface in BENCH_r*.json.
+
+Scale via CNOSDB_BENCH_SUITE_ROWS (default 1_000_000 hits rows,
+hits_rows // 4 readings rows).
+"""
+from __future__ import annotations
+
+import os
+import time
+
+import numpy as np
+
+SUITE_ROWS = int(os.environ.get("CNOSDB_BENCH_SUITE_ROWS", 1_000_000))
+DAY_NS = 86_400_000_000_000
+BASE_TS = 1_640_995_200_000_000_000  # 2022-01-01
+
+
+# ---------------------------------------------------------------------------
+# datasets
+# ---------------------------------------------------------------------------
+def build_hits(coord, tenant, db, n_rows):
+    """ClickBench-shaped wide table (the column subset the 43 queries
+    touch), written through the normal ingest path."""
+    from cnosdb_tpu.models.points import SeriesRows, WriteBatch
+    from cnosdb_tpu.models.schema import ValueType
+    from cnosdb_tpu.models.series import SeriesKey
+
+    rng = np.random.default_rng(99)
+    n = n_rows
+    phrases = np.array([""] * 4 + [f"phrase {i}" for i in range(60)],
+                       dtype=object)
+    urls = np.array([f"http://site{i % 7}.test/p/{i}"
+                     for i in range(500)] + [
+                    f"http://google.test/q/{i}" for i in range(20)],
+                    dtype=object)
+    titles = np.array([f"Title {i}" for i in range(200)] + [
+                      f"Google Result {i}" for i in range(8)],
+                      dtype=object)
+    referers = np.array([""] * 3 + [
+        f"https://www.ref{i % 9}.test/path/{i}" for i in range(80)],
+        dtype=object)
+    models = np.array([""] * 5 + [f"model-{i}" for i in range(12)],
+                      dtype=object)
+
+    cols = {
+        "adv_engine_id": rng.integers(0, 5, n) * (rng.random(n) < 0.2),
+        "resolution_width": rng.integers(800, 2600, n),
+        "user_id": rng.integers(0, n // 20 + 2, n),
+        "region_id": rng.integers(0, 40, n),
+        "mobile_phone": rng.integers(0, 6, n),
+        "search_engine_id": rng.integers(0, 4, n),
+        "counter_id": rng.integers(0, 100, n),
+        "client_ip": rng.integers(1 << 20, 1 << 28, n),
+        "watch_id": rng.integers(0, n // 3 + 2, n),
+        "is_refresh": (rng.random(n) < 0.1).astype(np.int64),
+        "trafic_source_id": rng.integers(-1, 8, n),
+        "is_link": (rng.random(n) < 0.3).astype(np.int64),
+        "is_download": (rng.random(n) < 0.05).astype(np.int64),
+        "dont_count_hits": (rng.random(n) < 0.05).astype(np.int64),
+        "url_hash": rng.integers(0, 50, n),
+        "referer_hash": rng.integers(0, 50, n),
+        "window_client_width": rng.integers(300, 2000, n),
+        "window_client_height": rng.integers(300, 1400, n),
+    }
+    sidx = {
+        "search_phrase": rng.integers(0, len(phrases), n),
+        "url": rng.integers(0, len(urls), n),
+        "title": rng.integers(0, len(titles), n),
+        "referer": rng.integers(0, len(referers), n),
+        "mobile_phone_model": rng.integers(0, len(models), n),
+    }
+    sdata = {"search_phrase": phrases, "url": urls, "title": titles,
+             "referer": referers, "mobile_phone_model": models}
+    ts = BASE_TS + rng.integers(0, 30 * DAY_NS // 1000, n).astype(
+        np.int64) * 1000
+    ts.sort()
+    key = SeriesKey("hits", {"site": "s0"})
+    CH = 250_000
+    for off in range(0, n, CH):
+        e = min(off + CH, n)
+        fields = {}
+        for name, arr in cols.items():
+            fields[name] = (int(ValueType.INTEGER),
+                            arr[off:e].astype(np.int64))
+        for name, idx in sidx.items():
+            fields[name] = (int(ValueType.STRING),
+                            list(sdata[name][idx[off:e]]))
+        wb = WriteBatch()
+        wb.add_series("hits", SeriesRows(key, ts[off:e], fields))
+        coord.write_points(tenant, db, wb)
+    coord.engine.flush_all()
+    coord.engine.compact_all()
+    out = {k: v.astype(np.int64) for k, v in cols.items()}
+    out.update({k: sdata[k][v] for k, v in sidx.items()})
+    out["time"] = ts
+    return out
+
+
+def build_readings(coord, tenant, db, n_rows):
+    """TSBS IoT-shaped truck telemetry."""
+    from cnosdb_tpu.models.points import SeriesRows, WriteBatch
+    from cnosdb_tpu.models.schema import ValueType
+    from cnosdb_tpu.models.series import SeriesKey
+
+    rng = np.random.default_rng(17)
+    n_trucks = 50
+    per = max(200, n_rows // n_trucks)
+    data = {"ts": [], "truck": [], "fleet": [], "velocity": [],
+            "fuel_state": [], "current_load": [], "load_capacity": [],
+            "latitude": [], "longitude": [], "status": []}
+    for t in range(n_trucks):
+        fleet = f"fleet_{t % 5}"
+        name = f"truck_{t:03d}"
+        ts = BASE_TS + (np.arange(per, dtype=np.int64) * 10
+                        + rng.integers(0, 3)) * 1_000_000_000
+        vel = np.clip(rng.normal(45, 20, per), 0, 100)
+        vel[rng.random(per) < 0.2] = 0.0          # parked windows
+        fuel = np.clip(1.0 - np.linspace(0, 1.2, per)
+                       + rng.normal(0, .02, per), 0, 1)
+        cap = float(rng.choice([1500.0, 2000.0, 3000.0]))
+        load = np.clip(rng.normal(0.6, 0.3, per), 0, 1) * cap
+        lat = 40 + rng.normal(0, 0.5, per).cumsum() * 1e-3
+        lon = -105 + rng.normal(0, 0.5, per).cumsum() * 1e-3
+        status = (rng.random(per) < 0.05).astype(np.int64)  # 1 = down
+        wb = WriteBatch()
+        wb.add_series("readings", SeriesRows(
+            SeriesKey("readings", {"name": name, "fleet": fleet}), ts,
+            {"velocity": (int(ValueType.FLOAT), vel),
+             "fuel_state": (int(ValueType.FLOAT), fuel),
+             "current_load": (int(ValueType.FLOAT), load),
+             "load_capacity": (int(ValueType.FLOAT),
+                               np.full(per, cap)),
+             "latitude": (int(ValueType.FLOAT), lat),
+             "longitude": (int(ValueType.FLOAT), lon),
+             "status": (int(ValueType.INTEGER), status)}))
+        coord.write_points(tenant, db, wb)
+        data["ts"].append(ts)
+        data["truck"].append(np.full(per, t))
+        data["fleet"].append(np.full(per, t % 5))
+        data["velocity"].append(vel)
+        data["fuel_state"].append(fuel)
+        data["current_load"].append(load)
+        data["load_capacity"].append(np.full(per, cap))
+        data["latitude"].append(lat)
+        data["longitude"].append(lon)
+        data["status"].append(status)
+    coord.engine.flush_all()
+    coord.engine.compact_all()
+    return {k: np.concatenate(v) for k, v in data.items()}
+
+
+# ---------------------------------------------------------------------------
+# runner
+# ---------------------------------------------------------------------------
+def _run(executor, session, name, sql, check, results, errors):
+    try:
+        executor.execute_one(sql, session)      # warm-up
+        t0 = time.perf_counter()
+        rs = executor.execute_one(sql, session)
+        dt = time.perf_counter() - t0
+        if check is not None:
+            check(rs)
+        results[name] = round(dt * 1e3, 2)
+    except Exception as e:
+        errors[name] = f"{type(e).__name__}: {e}"[:160]
+
+
+def _col(rs, name):
+    return rs.columns[rs.names.index(name)]
+
+
+# ---------------------------------------------------------------------------
+# TSBS IoT-13
+# ---------------------------------------------------------------------------
+def run_tsbs(executor, session, a) -> tuple[dict, dict]:
+    """13 IoT query types (benchmark/tsbs/shell_env.sh QUERY_TYPES),
+    each oracle-checked over the ingested arrays."""
+    res: dict = {}
+    err: dict = {}
+    trucks = np.unique(a["truck"])
+
+    def per_truck_last(col):
+        out = {}
+        for t in trucks:
+            m = a["truck"] == t
+            out[int(t)] = col[m][np.argmax(a["ts"][m])]
+        return out
+
+    last_fuel = per_truck_last(a["fuel_state"])
+    low_fuel = {t for t, v in last_fuel.items() if v < 0.1}
+
+    def chk_low_fuel(rs):
+        got = {int(n.split("_")[1]) for n in _col(rs, "name")}
+        assert got == low_fuel, (len(got), len(low_fuel))
+
+    _run(executor, session, "low-fuel",
+         "SELECT name, last(fuel_state) AS f FROM readings GROUP BY name "
+         "HAVING last(fuel_state) < 0.1 ORDER BY name",
+         chk_low_fuel, res, err)
+
+    last_load = per_truck_last(a["current_load"])
+    cap_of = per_truck_last(a["load_capacity"])
+    high = {t for t in last_load
+            if last_load[t] / cap_of[t] > 0.9}
+
+    def chk_high_load(rs):
+        got = {int(n.split("_")[1]) for n in _col(rs, "name")}
+        assert got == high
+
+    _run(executor, session, "high-load",
+         "SELECT name, last(current_load) AS l, last(load_capacity) AS c "
+         "FROM readings GROUP BY name "
+         "HAVING last(current_load) / last(load_capacity) > 0.9 "
+         "ORDER BY name", chk_high_load, res, err)
+
+    lat_last = per_truck_last(a["latitude"])
+
+    def chk_last_loc(rs):
+        names = _col(rs, "name")
+        lats = _col(rs, "lat")
+        for nm, lv in zip(names, lats):
+            t = int(nm.split("_")[1])
+            assert abs(lv - lat_last[t]) < 1e-9
+
+    _run(executor, session, "last-loc",
+         "SELECT name, last(latitude) AS lat, last(longitude) AS lon "
+         "FROM readings GROUP BY name ORDER BY name",
+         chk_last_loc, res, err)
+
+    _run(executor, session, "single-last-loc",
+         "SELECT name, last(latitude) AS lat, last(longitude) AS lon "
+         "FROM readings WHERE name = 'truck_007' GROUP BY name",
+         lambda rs: np.testing.assert_allclose(
+             _col(rs, "lat")[0], lat_last[7]), res, err)
+
+    # stationary-trucks: avg velocity < 1 over a 10-minute window
+    win_lo = int(a["ts"].min())
+    win_hi = win_lo + 600 * 10**9 - 1
+    wm = (a["ts"] >= win_lo) & (a["ts"] <= win_hi)
+    stat = set()
+    for t in trucks:
+        m = wm & (a["truck"] == t)
+        if m.any() and a["velocity"][m].mean() < 1.0:
+            stat.add(int(t))
+    _run(executor, session, "stationary-trucks",
+         f"SELECT name, avg(velocity) AS v FROM readings WHERE time >= "
+         f"{win_lo} AND time <= {win_hi} GROUP BY name "
+         "HAVING avg(velocity) < 1 ORDER BY name",
+         lambda rs: rs.n_rows == len(stat) or (_ for _ in ()).throw(
+             AssertionError((rs.n_rows, len(stat)))), res, err)
+
+    # avg-load: avg load ratio by fleet
+    fleet_ratio = {}
+    for f in range(5):
+        m = a["fleet"] == f
+        fleet_ratio[f] = float(
+            (a["current_load"][m] / a["load_capacity"][m]).mean())
+
+    def chk_avg_load(rs):
+        for fl, v in zip(_col(rs, "fleet"), _col(rs, "r")):
+            np.testing.assert_allclose(
+                v, fleet_ratio[int(fl.split("_")[1])], rtol=1e-9)
+
+    _run(executor, session, "avg-load",
+         "SELECT fleet, avg(current_load / load_capacity) AS r "
+         "FROM readings GROUP BY fleet ORDER BY fleet",
+         chk_avg_load, res, err)
+
+    # daily-activity: readings per day per fleet
+    day = ((a["ts"] - BASE_TS) // DAY_NS).astype(np.int64)
+
+    def chk_daily(rs):
+        want = np.bincount(day)
+        got = dict(zip(_col(rs, "d"), _col(rs, "c")))
+        assert int(got[BASE_TS]) == int(want[0])
+
+    _run(executor, session, "daily-activity",
+         "SELECT date_bin(INTERVAL '24 hours', time) AS d, "
+         "count(velocity) AS c FROM readings GROUP BY d ORDER BY d",
+         chk_daily, res, err)
+
+    # breakdown-frequency: status=1 readings per fleet
+    bf = {f: int(((a["fleet"] == f) & (a["status"] == 1)).sum())
+          for f in range(5)}
+
+    def chk_breakdown(rs):
+        for fl, c in zip(_col(rs, "fleet"), _col(rs, "c")):
+            assert int(c) == bf[int(fl.split("_")[1])]
+
+    _run(executor, session, "breakdown-frequency",
+         "SELECT fleet, count(status) AS c FROM readings "
+         "WHERE status = 1 GROUP BY fleet ORDER BY fleet",
+         chk_breakdown, res, err)
+
+    # driving-session families: 10-minute windows with avg velocity > 5
+    bucket = ((a["ts"] - BASE_TS) // (600 * 10**9)).astype(np.int64)
+    nb = int(bucket.max()) + 1
+    active_windows = 0
+    for t in trucks:
+        m = a["truck"] == t
+        s = np.bincount(bucket[m], weights=a["velocity"][m],
+                        minlength=nb)
+        c = np.bincount(bucket[m], minlength=nb)
+        with np.errstate(invalid="ignore"):
+            active_windows += int(((s / np.maximum(c, 1) > 5)
+                                   & (c > 0)).sum())
+
+    def chk_sessions(rs):
+        assert int(rs.columns[0][0]) == active_windows
+
+    session_sql = (
+        "SELECT count(*) FROM (SELECT name, "
+        "date_bin(INTERVAL '10 minutes', time) AS w, avg(velocity) AS v "
+        "FROM readings GROUP BY name, w) s WHERE v > 5")
+    for qname in ("long-driving-sessions", "long-daily-sessions",
+                  "avg-daily-driving-session",
+                  "avg-daily-driving-duration"):
+        _run(executor, session, qname, session_sql, chk_sessions,
+             res, err)
+
+    # avg-vs-projected-fuel-consumption
+    ratio = float(np.nanmean(a["fuel_state"]))
+    _run(executor, session, "avg-vs-projected-fuel-consumption",
+         "SELECT avg(fuel_state) AS r FROM readings",
+         lambda rs: np.testing.assert_allclose(rs.columns[0][0], ratio,
+                                               rtol=1e-9), res, err)
+    return res, err
+
+
+# ---------------------------------------------------------------------------
+# ClickBench-43
+# ---------------------------------------------------------------------------
+def run_clickbench(executor, session, a) -> tuple[dict, dict]:
+    """The 43 hits queries (benchmark/hits/sql/queries.sql) translated to
+    this engine's dialect over the scaled hits table; each checked
+    against a numpy oracle computed from the ingested arrays."""
+    res: dict = {}
+    err: dict = {}
+    n = len(a["time"])
+
+    def scalar_eq(val):
+        def chk(rs):
+            got = rs.columns[0][0]
+            if isinstance(val, float):
+                np.testing.assert_allclose(float(got), val, rtol=1e-9)
+            else:
+                assert int(got) == int(val), (got, val)
+        return chk
+
+    def topk_col(colname, want_sorted):
+        def chk(rs):
+            got = np.sort(np.asarray(_col(rs, colname), dtype=np.float64))
+            np.testing.assert_allclose(got, np.sort(want_sorted),
+                                       rtol=1e-9)
+        return chk
+
+    def rows_eq(k):
+        return lambda rs: (rs.n_rows == k) or (_ for _ in ()).throw(
+            AssertionError(rs.n_rows))
+
+    adv = a["adv_engine_id"]
+    rw = a["resolution_width"]
+    uid = a["user_id"]
+    sp = a["search_phrase"]
+    url = a["url"]
+
+    def topc(key_arrays, weights=None, k=10, sel=None):
+        """Top-k counts per composite key → sorted count list."""
+        if sel is None:
+            sel = np.ones(n, dtype=bool)
+        keys = list(zip(*[np.asarray(x)[sel] for x in key_arrays]))
+        from collections import Counter
+
+        c = Counter(keys)
+        return np.array(sorted(c.values())[::-1][:k], dtype=np.float64)
+
+    q = []
+    q.append(("q01", "SELECT count(*) FROM hits", scalar_eq(n)))
+    q.append(("q02", "SELECT count(*) FROM hits WHERE adv_engine_id <> 0",
+              scalar_eq(int((adv != 0).sum()))))
+    q.append(("q03", "SELECT sum(adv_engine_id), count(*), "
+              "avg(resolution_width) FROM hits",
+              scalar_eq(int(adv.sum()))))
+    q.append(("q04", "SELECT avg(user_id) FROM hits",
+              lambda rs: np.testing.assert_allclose(
+                  float(rs.columns[0][0]), uid.mean(), rtol=1e-9)))
+    q.append(("q05", "SELECT count(DISTINCT user_id) FROM hits",
+              scalar_eq(len(np.unique(uid)))))
+    q.append(("q06", "SELECT count(DISTINCT search_phrase) FROM hits",
+              scalar_eq(len(np.unique(sp)))))
+    q.append(("q07", "SELECT min(time), max(time) FROM hits",
+              scalar_eq(int(a["time"].min()))))
+    adv_counts = np.bincount(adv[adv != 0])
+    q.append(("q08", "SELECT adv_engine_id, count(*) AS c FROM hits "
+              "WHERE adv_engine_id <> 0 GROUP BY adv_engine_id "
+              "ORDER BY c DESC",
+              topk_col("c", np.sort(adv_counts[adv_counts > 0])[::-1]
+                       .astype(np.float64))))
+
+    def distinct_per_key(keys, vals, k=10):
+        import collections
+
+        s = collections.defaultdict(set)
+        for key, v in zip(keys, vals):
+            s[key].add(v)
+        return np.array(sorted((len(v) for v in s.values()))[::-1][:k],
+                        dtype=np.float64)
+
+    q.append(("q09", "SELECT region_id, count(DISTINCT user_id) AS u "
+              "FROM hits GROUP BY region_id ORDER BY u DESC LIMIT 10",
+              topk_col("u", distinct_per_key(a["region_id"], uid))))
+    q.append(("q10", "SELECT region_id, sum(adv_engine_id), count(*) AS "
+              "c, avg(resolution_width), count(DISTINCT user_id) FROM "
+              "hits GROUP BY region_id ORDER BY c DESC LIMIT 10",
+              topk_col("c", topc([a["region_id"]]))))
+    mm = a["mobile_phone_model"] != ""
+    q.append(("q11", "SELECT mobile_phone_model, count(DISTINCT user_id)"
+              " AS u FROM hits WHERE mobile_phone_model <> '' GROUP BY "
+              "mobile_phone_model ORDER BY u DESC LIMIT 10",
+              topk_col("u", distinct_per_key(
+                  a["mobile_phone_model"][mm], uid[mm]))))
+    q.append(("q12", "SELECT mobile_phone, mobile_phone_model, "
+              "count(DISTINCT user_id) AS u FROM hits WHERE "
+              "mobile_phone_model <> '' GROUP BY mobile_phone, "
+              "mobile_phone_model ORDER BY u DESC LIMIT 10",
+              topk_col("u", distinct_per_key(
+                  list(zip(a["mobile_phone"][mm],
+                           a["mobile_phone_model"][mm])), uid[mm]))))
+    sm = sp != ""
+    q.append(("q13", "SELECT search_phrase, count(*) AS c FROM hits "
+              "WHERE search_phrase <> '' GROUP BY search_phrase "
+              "ORDER BY c DESC LIMIT 10",
+              topk_col("c", topc([sp], sel=sm))))
+    q.append(("q14", "SELECT search_phrase, count(DISTINCT user_id) AS u"
+              " FROM hits WHERE search_phrase <> '' GROUP BY "
+              "search_phrase ORDER BY u DESC LIMIT 10",
+              topk_col("u", distinct_per_key(sp[sm], uid[sm]))))
+    q.append(("q15", "SELECT search_engine_id, search_phrase, count(*) "
+              "AS c FROM hits WHERE search_phrase <> '' GROUP BY "
+              "search_engine_id, search_phrase ORDER BY c DESC LIMIT 10",
+              topk_col("c", topc([a["search_engine_id"], sp], sel=sm))))
+    q.append(("q16", "SELECT user_id, count(*) AS c FROM hits GROUP BY "
+              "user_id ORDER BY c DESC LIMIT 10",
+              topk_col("c", topc([uid]))))
+    q.append(("q17", "SELECT user_id, search_phrase, count(*) AS c FROM "
+              "hits GROUP BY user_id, search_phrase ORDER BY c DESC "
+              "LIMIT 10", topk_col("c", topc([uid, sp]))))
+    q.append(("q18", "SELECT user_id, search_phrase, count(*) AS c FROM "
+              "hits GROUP BY user_id, search_phrase LIMIT 10",
+              rows_eq(10)))
+    q.append(("q19", "SELECT user_id, date_part('minute', time) AS m, "
+              "search_phrase, count(*) AS c FROM hits GROUP BY user_id, "
+              "m, search_phrase ORDER BY c DESC LIMIT 10",
+              topk_col("c", topc(
+                  [uid, (a["time"] // 60_000_000_000) % 60, sp]))))
+    some_uid = int(uid[0])
+    q.append(("q20", f"SELECT user_id FROM hits WHERE user_id = "
+              f"{some_uid}", rows_eq(int((uid == some_uid).sum()))))
+    gm = np.array(["google" in u for u in url])
+    q.append(("q21", "SELECT count(*) FROM hits WHERE url LIKE "
+              "'%google%'", scalar_eq(int(gm.sum()))))
+    q.append(("q22", "SELECT search_phrase, min(url), count(*) AS c "
+              "FROM hits WHERE url LIKE '%google%' AND search_phrase <> "
+              "'' GROUP BY search_phrase ORDER BY c DESC LIMIT 10",
+              topk_col("c", topc([sp], sel=gm & sm))))
+    tmask = np.array(["Google" in t for t in a["title"]]) \
+        & ~np.array([".google." in u for u in url]) & sm
+    q.append(("q23", "SELECT search_phrase, min(url), min(title), "
+              "count(*) AS c, count(DISTINCT user_id) FROM hits WHERE "
+              "title LIKE '%Google%' AND url NOT LIKE '%.google.%' AND "
+              "search_phrase <> '' GROUP BY search_phrase ORDER BY c "
+              "DESC LIMIT 10", topk_col("c", topc([sp], sel=tmask))))
+    q.append(("q24", "SELECT * FROM hits WHERE url LIKE '%google%' "
+              "ORDER BY time LIMIT 10",
+              rows_eq(min(10, int(gm.sum())))))
+    q.append(("q25", "SELECT search_phrase FROM hits WHERE search_phrase"
+              " <> '' ORDER BY time LIMIT 10", rows_eq(10)))
+    q.append(("q26", "SELECT search_phrase FROM hits WHERE search_phrase"
+              " <> '' ORDER BY search_phrase LIMIT 10", rows_eq(10)))
+    q.append(("q27", "SELECT search_phrase FROM hits WHERE search_phrase"
+              " <> '' ORDER BY time, search_phrase LIMIT 10",
+              rows_eq(10)))
+    um = url != ""
+    q.append(("q28", "SELECT counter_id, avg(length(url)) AS l, count(*)"
+              " AS c FROM hits WHERE url <> '' GROUP BY counter_id "
+              "HAVING count(*) > 1000 ORDER BY l DESC LIMIT 25",
+              None))
+    q.append(("q29", "SELECT regexp_replace(referer, "
+              "'^https?://(?:www\\.)?([^/]+)/.*$', '\\1') AS k, "
+              "avg(length(referer)) AS l, count(*) AS c, min(referer) "
+              "FROM hits WHERE referer <> '' GROUP BY k HAVING count(*) "
+              "> 1000 ORDER BY l DESC LIMIT 25", None))
+    q.append(("q30", "SELECT " + ", ".join(
+        f"sum(resolution_width + {i})" for i in range(0, 90, 30))
+        + " FROM hits", scalar_eq(int(rw.sum()))))
+    q.append(("q31", "SELECT search_engine_id, client_ip, count(*) AS c,"
+              " sum(is_refresh), avg(resolution_width) FROM hits WHERE "
+              "search_phrase <> '' GROUP BY search_engine_id, client_ip "
+              "ORDER BY c DESC LIMIT 10",
+              topk_col("c", topc([a["search_engine_id"],
+                                  a["client_ip"]], sel=sm))))
+    q.append(("q32", "SELECT watch_id, client_ip, count(*) AS c, "
+              "sum(is_refresh), avg(resolution_width) FROM hits WHERE "
+              "search_phrase <> '' GROUP BY watch_id, client_ip "
+              "ORDER BY c DESC LIMIT 10",
+              topk_col("c", topc([a["watch_id"], a["client_ip"]],
+                                 sel=sm))))
+    q.append(("q33", "SELECT url, count(*) AS c FROM hits GROUP BY url "
+              "ORDER BY c DESC LIMIT 10", topk_col("c", topc([url]))))
+    q.append(("q34", "SELECT 1 AS one, url, count(*) AS c FROM hits "
+              "GROUP BY one, url ORDER BY c DESC LIMIT 10",
+              topk_col("c", topc([url]))))
+    q.append(("q35", "SELECT client_ip, client_ip - 1, client_ip - 2, "
+              "client_ip - 3, count(*) AS c FROM hits GROUP BY "
+              "client_ip, client_ip - 1, client_ip - 2, client_ip - 3 "
+              "ORDER BY c DESC LIMIT 10",
+              topk_col("c", topc([a["client_ip"]]))))
+    lo = BASE_TS + 5 * DAY_NS
+    hi = BASE_TS + 12 * DAY_NS
+    range_m = ((a["time"] >= lo) & (a["time"] <= hi)
+               & (a["counter_id"] == 62))
+    q36m = range_m & (a["dont_count_hits"] == 0) \
+        & (a["is_refresh"] == 0) & um
+    q.append(("q36", f"SELECT url, count(*) AS pv FROM hits WHERE "
+              f"counter_id = 62 AND time >= {lo} AND time <= {hi} AND "
+              "dont_count_hits = 0 AND is_refresh = 0 AND url <> '' "
+              "GROUP BY url ORDER BY pv DESC LIMIT 10",
+              topk_col("pv", topc([url], sel=q36m))))
+    q37m = range_m & (a["dont_count_hits"] == 0) & (a["is_refresh"] == 0)
+    q.append(("q37", f"SELECT title, count(*) AS pv FROM hits WHERE "
+              f"counter_id = 62 AND time >= {lo} AND time <= {hi} AND "
+              "dont_count_hits = 0 AND is_refresh = 0 AND title <> '' "
+              "GROUP BY title ORDER BY pv DESC LIMIT 10",
+              topk_col("pv", topc([a["title"]], sel=q37m))))
+    q.append(("q38", f"SELECT url, count(*) AS pv FROM hits WHERE "
+              f"counter_id = 62 AND time >= {lo} AND time <= {hi} AND "
+              "is_refresh = 0 AND is_link <> 0 AND is_download = 0 "
+              "GROUP BY url ORDER BY pv DESC LIMIT 10 OFFSET 100",
+              None))
+    q.append(("q39", "SELECT trafic_source_id, search_engine_id, "
+              "adv_engine_id, CASE WHEN (search_engine_id = 0 AND "
+              "adv_engine_id = 0) THEN referer ELSE '' END AS src, url "
+              f"AS dst, count(*) AS pv FROM hits WHERE counter_id = 62 "
+              f"AND time >= {lo} AND time <= {hi} AND is_refresh = 0 "
+              "GROUP BY trafic_source_id, search_engine_id, "
+              "adv_engine_id, src, dst ORDER BY pv DESC LIMIT 10 "
+              "OFFSET 100", None))
+    q.append(("q40", f"SELECT url_hash, date_bin(INTERVAL '24 hours', "
+              f"time) AS d, count(*) AS pv FROM hits WHERE counter_id = "
+              f"62 AND time >= {lo} AND time <= {hi} AND is_refresh = 0 "
+              "AND trafic_source_id IN (-1, 6) AND referer_hash = 33 "
+              "GROUP BY url_hash, d ORDER BY pv DESC LIMIT 10 OFFSET 10",
+              None))
+    q.append(("q41", f"SELECT window_client_width, window_client_height,"
+              f" count(*) AS pv FROM hits WHERE counter_id = 62 AND "
+              f"time >= {lo} AND time <= {hi} AND is_refresh = 0 AND "
+              "dont_count_hits = 0 AND url_hash = 22 GROUP BY "
+              "window_client_width, window_client_height ORDER BY pv "
+              "DESC LIMIT 10 OFFSET 100", None))
+    q42m = ((a["time"] >= BASE_TS + 7 * DAY_NS)
+            & (a["time"] <= BASE_TS + 9 * DAY_NS)
+            & (a["counter_id"] == 62) & (a["is_refresh"] == 0)
+            & (a["dont_count_hits"] == 0))
+    q.append(("q42", "SELECT date_trunc('minute', time) AS m, count(*) "
+              f"AS pv FROM hits WHERE counter_id = 62 AND time >= "
+              f"{BASE_TS + 7 * DAY_NS} AND time <= "
+              f"{BASE_TS + 9 * DAY_NS} AND is_refresh = 0 AND "
+              "dont_count_hits = 0 GROUP BY m ORDER BY m LIMIT 10 "
+              "OFFSET 10", None))
+    q.append(("q43", "SELECT count(*) FROM hits WHERE time >= "
+              f"{BASE_TS + 7 * DAY_NS} AND time <= "
+              f"{BASE_TS + 9 * DAY_NS}",
+              scalar_eq(int(((a["time"] >= BASE_TS + 7 * DAY_NS)
+                             & (a["time"] <= BASE_TS + 9 * DAY_NS))
+                            .sum()))))
+
+    for name, sql, check in q:
+        _run(executor, session, name, sql, check, res, err)
+    return res, err
+
+
+def run_suites(executor, coord, tenant, db, session) -> dict:
+    out: dict = {}
+    t0 = time.perf_counter()
+    hits = build_hits(coord, tenant, db, SUITE_ROWS)
+    readings = build_readings(coord, tenant, db, SUITE_ROWS // 4)
+    out["suite_build_s"] = round(time.perf_counter() - t0, 1)
+    cb, cb_err = run_clickbench(executor, session, hits)
+    ts, ts_err = run_tsbs(executor, session, readings)
+    out["clickbench_ms"] = cb
+    out["tsbs_iot_ms"] = ts
+    errs = {**{f"cb:{k}": v for k, v in cb_err.items()},
+            **{f"tsbs:{k}": v for k, v in ts_err.items()}}
+    if errs:
+        out["suite_errors"] = errs
+    out["clickbench_pass"] = f"{len(cb)}/43"
+    out["tsbs_pass"] = f"{len(ts)}/13"
+    return out

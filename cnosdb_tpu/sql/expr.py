@@ -968,6 +968,47 @@ def _time_window_scalar(t, window, *rest):
     return {"kind": "window", "start": start, "end": start + w}
 
 
+def _regexp_replace(v, pat, rep, flags=""):
+    """DataFusion regexp_replace (Rust regex \\1 backrefs match python
+    re.sub's); 'g' flag = replace all, else first occurrence; i/m/s/x
+    map to the matching regex modes, anything else is an error (never
+    silently dropped)."""
+    import re as _re
+
+    if isinstance(pat, (np.ndarray, DictArray)) \
+            or isinstance(rep, (np.ndarray, DictArray)):
+        raise PlanError("regexp_replace pattern must be a constant")
+    count = 1
+    fl = 0
+    for ch in str(flags):
+        if ch == "g":
+            count = 0
+        elif ch == "i":
+            fl |= _re.IGNORECASE
+        elif ch == "m":
+            fl |= _re.MULTILINE
+        elif ch == "s":
+            fl |= _re.DOTALL
+        elif ch == "x":
+            fl |= _re.VERBOSE
+        else:
+            raise PlanError(
+                f"regexp_replace() does not support the \"{ch}\" flag")
+    rx = _re.compile(str(pat), fl)
+
+    def one(x):
+        return None if x is None else rx.sub(str(rep), str(x),
+                                             count=count)
+
+    if isinstance(v, DictArray):
+        return v.map_values(one)
+    if isinstance(v, np.ndarray):
+        out = np.empty(len(v), dtype=object)
+        out[:] = [one(x) for x in v]
+        return out
+    return one(v)
+
+
 def _fn_nullif(a, b):
     """NULLIF(a, b): NULL where a == b, else a."""
     if isinstance(a, np.ndarray) or isinstance(b, np.ndarray) \
@@ -1680,6 +1721,8 @@ def _register_tsfuncs():
         "upper": _str_func(str.upper),
         "lower": _str_func(str.lower),
         "length": _str_func(len, out=np.int64),
+        "regexp_replace": lambda xp, v, pat, rep, *flags: _regexp_replace(
+            v, pat, rep, flags[0] if flags else ""),
         "char_length": _str_func(len, out=np.int64),
         # TRIM takes exactly one argument (the charset form is btrim /
         # TRIM(BOTH..FROM)); ltrim/rtrim accept an optional charset
