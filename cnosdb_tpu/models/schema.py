@@ -412,7 +412,11 @@ class Duration:
     """A time duration usable as TTL / vnode_duration (reference
     database_schema.rs DatabaseOptions durations, e.g. '1d', '365d', 'inf')."""
 
-    ns: int  # 0 == INF
+    ns: int  # 0 == INF unless zero=True
+    # an EXPLICIT zero duration ('0', '0d') is distinct from INF:
+    # drop_after '0' serializes as {secs:0, is_inf:false}
+    # (dcl_tenant.slt) while TTL 'inf' retains forever
+    zero: bool = False
 
     INF_NS = 0
 
@@ -448,8 +452,9 @@ class Duration:
                 raise SchemaError(f"bad duration {s!r}")
             pos = m.end()
             num, unit = m.group(1), m.group(2)
-            factor = cls._HUMANTIME_NS.get(unit) \
-                or cls._HUMANTIME_NS.get(unit.lower())
+            # humantime is case-sensitive: 'M' is month, 'm' minute, and
+            # '7Y' is invalid (dcl_tenant.slt pins it as an error)
+            factor = cls._HUMANTIME_NS.get(unit)
             if factor is None:
                 raise SchemaError(f"bad duration {s!r}")
             total += int(num) * factor
@@ -460,13 +465,14 @@ class Duration:
             m = re.match(r"^(\d+)$", raw)
             if not m:
                 raise SchemaError(f"bad duration {s!r}")
-            total = int(m.group(1)) * 1_000_000_000   # bare number: secs
-        if total == 0:
-            # ns=0 is the INF sentinel; a literal zero duration would
-            # silently mean "retain forever", so reject it.
-            raise SchemaError(
-                f"zero duration {s!r} is invalid (use 'INF' for unlimited)")
-        return cls(total)
+            # unit-less number = DAYS (reference CnosDuration:
+            # drop_after '7' serializes as 604800 secs)
+            total = int(m.group(1)) * 86_400_000_000_000
+        if total // 1_000_000_000 >= 2 ** 64:
+            # the reference stores u64 SECONDS: u64::MAX days overflows
+            # (dcl_tenant.slt) but '1000000d' TTLs are fine
+            raise SchemaError(f"duration {s!r} overflows")
+        return cls(total, zero=(total == 0))
 
     def humantime(self) -> str:
         """humantime::format_duration text — what the reference's
@@ -493,7 +499,7 @@ class Duration:
 
     @property
     def is_inf(self) -> bool:
-        return self.ns == 0
+        return self.ns == 0 and not self.zero
 
     def __str__(self) -> str:
         if self.is_inf:
@@ -562,14 +568,20 @@ class TenantOptions:
     drop_after: Duration | None = None
 
     def to_dict(self) -> dict:
+        da = None
+        if self.drop_after is not None:
+            da = {"ns": self.drop_after.ns, "zero": self.drop_after.zero}
         return {
             "comment": self.comment,
             "limiter": self.limiter,
-            "drop_after": self.drop_after.ns if self.drop_after else None,
+            "drop_after": da,
         }
 
     @classmethod
     def from_dict(cls, d: dict) -> "TenantOptions":
         da = d.get("drop_after")
-        return cls(d.get("comment", ""), d.get("limiter"),
-                   Duration(da) if da is not None else None)
+        if isinstance(da, dict):
+            da = Duration(da["ns"], zero=bool(da.get("zero")))
+        elif da is not None:   # legacy int form
+            da = Duration(da)
+        return cls(d.get("comment", ""), d.get("limiter"), da)

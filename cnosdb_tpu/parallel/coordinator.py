@@ -96,6 +96,12 @@ class Coordinator:
                 self.engine.set_table_schema(owner, schema)
         elif event == "drop_table":
             self.engine.drop_table(payload["owner"], payload["table"])
+        elif event == "purge_table":
+            # a trashed incarnation was superseded by CREATE of the same
+            # name: hard-delete its rows before the new table goes live
+            self.engine.drop_table(payload["owner"], payload["table"])
+            with self._scan_cache_lock:
+                self._scan_cache.clear()
         elif event == "trash_table":
             # soft delete: schema gone, row data stays until purge
             self.engine.remove_table_schema(payload["owner"],
@@ -538,7 +544,13 @@ class Coordinator:
         # valid for any filtered query (superset + row filter), so probe
         # it as a fallback; and a scan the constraints didn't actually
         # prune is stored under the shared unfiltered key.
+        # schema_version keys DDL: after ALTER (drop/add/rename column) a
+        # cached batch may hold stale columns — especially under
+        # field_names=None (SELECT *), where the requested set is
+        # implicit and identical keys would collide across the ALTER
+        schema = v.schemas.get(table)
         base_key = (split.owner, split.vnode_id, table,
+                    getattr(schema, "schema_version", None),
                     tuple(field_names) if field_names is not None else None,
                     tuple((r.min_ts, r.max_ts) for r in trs.ranges),
                     sids_key)

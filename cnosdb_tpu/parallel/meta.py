@@ -34,6 +34,50 @@ from ..models.schema import (
 )
 
 DEFAULT_TENANT = "cnosdb"
+
+# limiter_config shape (reference limiter/limiter_kind.rs): fixed key
+# order, every request slot present (null when unset)
+_LIMITER_OBJECT_KEYS = ("max_users_number", "max_databases",
+                        "max_shard_number", "max_replicate_number",
+                        "max_retention_time")
+_LIMITER_REQUEST_KEYS = ("coord_data_in", "coord_data_out",
+                         "coord_queries", "coord_writes", "http_data_in",
+                         "http_data_out", "http_queries", "http_writes")
+
+
+def build_limiter_config(groups: dict) -> dict:
+    """{group: {key: int}} from the SQL option list → the reference's
+    limiter_config JSON structure."""
+    obj = None
+    if "object_config" in groups:
+        src = groups["object_config"]
+        obj = {k: src[k] for k in _LIMITER_OBJECT_KEYS if k in src}
+    req = {}
+    for g in _LIMITER_REQUEST_KEYS:
+        src = groups.get(g)
+        if src is None:
+            req[g] = None
+            continue
+        missing = {"remote_max", "remote_initial", "remote_refill",
+                   "remote_interval", "local_max",
+                   "local_initial"} - set(src)
+        if missing:
+            # request buckets are all-or-nothing (dcl_tenant.slt pins a
+            # 2-key coord_data_out as an error)
+            raise MetaError(
+                f"limiter group {g} missing {sorted(missing)}")
+        req[g] = {
+            "remote_bucket": {
+                "max": src.get("remote_max", 0),
+                "initial": src.get("remote_initial", 0),
+                "refill": src.get("remote_refill", 0),
+                "interval": src.get("remote_interval", 0)},
+            "local_bucket": {
+                "max": src.get("local_max", 0),
+                "initial": src.get("local_initial", 0)}}
+    return {"object_config": obj, "request_config": req}
+
+
 DEFAULT_DATABASE = "public"
 USAGE_SCHEMA = "usage_schema"
 
@@ -220,6 +264,8 @@ class MetaStore:
     # ------------------------------------------------------------ tenants/users
     def create_tenant(self, name: str, options: TenantOptions | None = None):
         with self.lock:
+            if not name or not name.strip() or "/" in name:
+                raise MetaError("invalid tenant name")
             if name in self.tenants:
                 raise MetaError(f"tenant {name!r} exists")
             self.tenants[name] = options or TenantOptions()
@@ -227,26 +273,64 @@ class MetaStore:
             self._notify("create_tenant", tenant=name)
 
     def alter_tenant_options(self, name: str, changes: dict):
-        """SET/UNSET comment/drop_after (None value = unset) —
-        reference ALTER TENANT (ast.rs AlterTenantOperation)."""
+        """SET/UNSET comment/drop_after/limiter groups (None value =
+        unset) — reference ALTER TENANT (ast.rs AlterTenantOperation)."""
         from ..models.schema import Duration
 
         with self.lock:
             if name not in self.tenants:
                 raise TenantNotFound(name)
+            if name == DEFAULT_TENANT:
+                # the system tenant's options are immutable
+                # (dcl_tenant.slt / tenants.slt pin SET object_config
+                # on cnosdb as an error)
+                raise MetaError("cannot alter the system tenant")
             opts = self.tenants[name]
-            if "comment" in changes:
-                opts.comment = changes["comment"] or ""
+            # validate EVERYTHING before mutating: a failing option list
+            # must leave the tenant untouched (dcl_tenant.slt: the
+            # comment of an errored SET does not stick)
+            staged = {}
             if "drop_after" in changes:
                 v = changes["drop_after"]
-                opts.drop_after = Duration.parse(v) if v else None
+                staged["drop_after"] = Duration.parse(v) if v else None
+            if "_limiter_groups" in changes:
+                groups = changes["_limiter_groups"]
+                new = build_limiter_config(groups)
+                cur = opts.limiter or {
+                    "object_config": None,
+                    "request_config": {k: None
+                                       for k in _LIMITER_REQUEST_KEYS}}
+                if "object_config" in groups:
+                    # partial object_config MERGES over the existing
+                    # values (dcl_tenant.slt: max_shard_number survives
+                    # an alter that only sets users/databases/retention)
+                    merged = dict(cur.get("object_config") or {})
+                    merged.update(new["object_config"] or {})
+                    cur["object_config"] = {
+                        k: merged[k] for k in _LIMITER_OBJECT_KEYS
+                        if k in merged}
+                for g in groups:
+                    if g != "object_config":
+                        cur["request_config"][g] = new["request_config"][g]
+                staged["limiter"] = cur
+            if "comment" in changes:
+                opts.comment = changes["comment"] or ""
+            if "drop_after" in staged:
+                opts.drop_after = staged["drop_after"]
+            if "limiter" in staged:
+                opts.limiter = staged["limiter"]
+            if "_limiter" in changes:   # UNSET _LIMITER
+                opts.limiter = None
             self._persist()
             self._notify("alter_tenant", tenant=name)
 
     def drop_tenant(self, name: str, at: float | None = None,
-                    if_exists: bool = False):
+                    if_exists: bool = False, after: str | None = None):
         """Soft delete: the tenant and all its databases move to the
-        recycle bin; RECOVER TENANT restores everything."""
+        recycle bin; RECOVER TENANT restores everything. DROP ... AFTER
+        with a deadline SHORTER than the tenant's configured drop_after
+        collapses to an immediate hard delete (dcl_tenant.slt: t5 is
+        unrecoverable, t4 with a longer AFTER recovers)."""
         import time as _time
 
         with self.lock:
@@ -256,6 +340,15 @@ class MetaStore:
                 if if_exists:
                     return
                 raise TenantNotFound(name)
+            hard = False
+            if after is not None:
+                from ..models.schema import Duration
+
+                cfg = self.tenants[name].drop_after
+                after_d = Duration.parse(after)
+                # AFTER 'INF' (or a cfg of INF) never shrinks the window
+                hard = cfg is not None and not after_d.is_inf \
+                    and not cfg.is_inf and after_d.ns < cfg.ns
             dropped = [o for o in self.databases if o.startswith(name + ".")]
             fire = []
             old = self.trash["tenant"].pop(name, None)
@@ -269,11 +362,20 @@ class MetaStore:
                 "dbs": {o: self._db_to_trash(o, at) for o in dropped},
                 "at": _time.time() if at is None else at,
             }
+            if hard:
+                # immediate reclamation: no recycle-bin window
+                p = self.trash["tenant"].pop(name)
+                for owner, dbp in p["dbs"].items():
+                    if owner in self.databases:
+                        fire += self._payload_vnode_events(owner, dbp)
+                    else:
+                        fire.append(("drop_db", {"owner": owner}))
             self._persist()
             for event, kw in fire:
                 self._notify(event, **kw)
-            for owner in dropped:
-                self._notify("trash_db", owner=owner)
+            if not hard:
+                for owner in dropped:
+                    self._notify("trash_db", owner=owner)
             self._notify("drop_tenant", tenant=name)
 
     def recover_tenant(self, name: str):
@@ -341,7 +443,7 @@ class MetaStore:
                     comment: str = "",
                     must_change_password: bool | None = None):
         with self.lock:
-            if not name or not name.strip():
+            if not name or not name.strip() or "/" in name:
                 raise MetaError("invalid user name")
             if name in self.users:
                 raise MetaError(f"user {name!r} exists")
@@ -385,8 +487,11 @@ class MetaStore:
                     hash_password(changes.pop("password"))
                 self._auth_cache.clear()
             if "granted_admin" in changes:
-                self.users[name]["admin"] = bool(
-                    changes.pop("granted_admin"))
+                ga = bool(changes.pop("granted_admin"))
+                self.users[name]["admin"] = ga
+                # surfaced as a SET option in user_options JSON
+                # (dcl/alter_user.slt)
+                self.users[name]["granted_admin"] = ga
             if "comment" in changes:
                 self.users[name]["comment"] = changes.pop("comment")
             if "must_change_password" in changes:
@@ -463,6 +568,8 @@ class MetaStore:
         with self.lock:
             if tenant not in self.tenants:
                 raise TenantNotFound(tenant)
+            if not name or not name.strip() or "/" in name:
+                raise MetaError("invalid role name")
             roles = self.roles.setdefault(tenant, {})
             if name in roles or name in ("owner", "member"):
                 raise MetaError(f"role {name!r} exists in tenant {tenant!r}")
@@ -478,10 +585,13 @@ class MetaStore:
                 # error)
                 raise MetaError(f"cannot drop system role {name!r}")
             self.roles.get(tenant, {}).pop(name, None)
+            # memberships through the dropped role die with it — the
+            # user is OUT of the tenant, not demoted (dcl_role.slt:
+            # SHOW DATABASES errors for them afterwards)
             members = self.members.get(tenant, {})
             for user, role in list(members.items()):
                 if role == name:
-                    members[user] = "member"
+                    del members[user]
             self._persist()
 
     def list_roles(self, tenant: str) -> dict:
@@ -499,15 +609,24 @@ class MetaStore:
             if spec is None:
                 raise MetaError(f"unknown role {role!r} (system roles "
                                 "cannot be granted to)")
+            if f"{tenant}.{db}" not in self.databases:
+                # the grant target must exist (database_privileges.slt)
+                raise DatabaseNotFound(db)
             spec["privileges"][db] = level
             self._persist()
 
     def revoke_db_privilege(self, tenant: str, role: str, db: str):
         with self.lock:
             spec = self.roles.get(tenant, {}).get(role)
-            if spec is not None:
-                spec["privileges"].pop(db, None)
-                self._persist()
+            if spec is None:
+                raise MetaError(f"unknown role {role!r}")
+            if db not in spec["privileges"]:
+                # revoking a grant that was never made is an error
+                # (dcl_role.slt)
+                raise MetaError(
+                    f"role {role!r} holds no privilege on {db!r}")
+            spec["privileges"].pop(db)
+            self._persist()
 
     def check_db_privilege(self, user: str, tenant: str, db: str,
                            need: str) -> bool:
@@ -521,11 +640,10 @@ class MetaStore:
                 return True
             role = self.members.get(tenant, {}).get(user)
             if role is None:
-                # non-members of the default tenant get member rights there
-                if tenant == DEFAULT_TENANT:
-                    role = "member"
-                else:
-                    return False
+                # membership is explicit even in the default tenant — a
+                # user whose only role was dropped is OUT (dcl_role.slt
+                # pins SHOW DATABASES as an error for them)
+                return False
             need_rank = self._PRIV_ORDER[need]
             if role == "owner":
                 return True
@@ -536,7 +654,11 @@ class MetaStore:
                 return False
             if spec.get("inherit") == "owner":
                 return True
-            granted = spec["privileges"].get(db, "read")
+            granted = spec["privileges"].get(db)
+            if granted is None:
+                # a custom member-inherit role holds ONLY its explicit
+                # grants (dcl_role.slt: read on db1 does not open db2)
+                return False
             return need_rank <= self._PRIV_ORDER[granted]
 
     # ------------------------------------------------------------ databases
@@ -552,8 +674,12 @@ class MetaStore:
                 # reference rejects names outside the identifier charset
                 # (create_database.slt: "db/1", '', ' ')
                 raise MetaError(f"invalid database name {schema.name!r}")
-            if schema.name in ("cluster_schema", "information_schema",
-                               "usage_schema"):
+            reserved = ("information_schema", "usage_schema") \
+                if schema.tenant != DEFAULT_TENANT else \
+                ("cluster_schema", "information_schema", "usage_schema")
+            if schema.name in reserved:
+                # cluster_schema is reserved only in the system tenant —
+                # others may own a real db of that name (dcl_tenant.slt)
                 raise MetaError(
                     f"cannot create system schema {schema.name!r}")
             if schema.owner in self.databases:
@@ -679,8 +805,16 @@ class MetaStore:
                 if if_not_exists:
                     return
                 raise TableAlreadyExists(schema.name)
+            # creating over a trashed same-name incarnation ends its
+            # RECOVER window — the old incarnation's rows must never
+            # resurface under the new table (reference: recreate after
+            # DROP reads an empty table, create_table.slt)
+            trashed = self.trash["table"].pop(f"{owner}.{schema.name}",
+                                              None)
             tbls[schema.name] = schema
             self._persist()
+            if trashed is not None:
+                self._notify("purge_table", owner=owner, table=schema.name)
             self._notify("create_table", owner=owner, table=schema.name)
 
     def update_table(self, schema: TskvTableSchema):
@@ -739,7 +873,9 @@ class MetaStore:
         return self.tables.get(f"{tenant}.{db}", {}).get(table)
 
     def list_tables(self, tenant: str, db: str) -> list[str]:
-        return sorted(self.tables.get(f"{tenant}.{db}", {}).keys())
+        owner = f"{tenant}.{db}"
+        return sorted(set(self.tables.get(owner, {}))
+                      | set(self.externals.get(owner, {})))
 
     # ------------------------------------------------------------ nodes
     def register_node(self, node_id: int, grpc_addr: str = "",

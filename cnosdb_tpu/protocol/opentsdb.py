@@ -14,7 +14,11 @@ from ..models.schema import ValueType
 from ..models.series import SeriesKey, Tag
 
 
-def parse_opentsdb(text: str) -> WriteBatch:
+def parse_opentsdb(text: str, precision=None) -> WriteBatch:
+    """`precision` (a models.schema.Precision), when given, fixes the
+    timestamp unit explicitly (the reference's write APIs take a
+    precision parameter); otherwise seconds/milliseconds are
+    auto-detected like the reference telnet service."""
     groups: dict[tuple[str, tuple], dict] = {}
     for lineno, raw in enumerate(text.splitlines(), 1):
         line = raw.strip()
@@ -36,15 +40,58 @@ def parse_opentsdb(text: str) -> WriteBatch:
             ts = int(ts_s)
         except ValueError:
             raise ParserError(f"opentsdb line {lineno}: bad timestamp {ts_s!r}")
-        ts = normalize_ts_ns(ts)
+        ts = _scale_ts(ts, precision)
         try:
             val = float(val_s)
         except ValueError:
             raise ParserError(f"opentsdb line {lineno}: bad value {val_s!r}")
-        key = (metric, tuple(sorted(tags.items())))
-        g = groups.setdefault(key, {"tags": tags, "ts": [], "vals": []})
-        g["ts"].append(ts)
-        g["vals"].append(val)
+        _append(groups, metric, tags, ts, val)
+    return _to_batch(groups)
+
+
+def parse_opentsdb_json(text: str, precision=None) -> WriteBatch:
+    """OpenTSDB JSON put bodies (reference open_tsdb json parser):
+    one datapoint object or an array of them —
+    {"metric": ..., "timestamp": ..., "value": ..., "tags": {...}}."""
+    import json
+
+    try:
+        doc = json.loads(text)
+    except ValueError as e:
+        raise ParserError(f"opentsdb json: {e}")
+    if isinstance(doc, dict):
+        doc = [doc]
+    if not isinstance(doc, list):
+        raise ParserError("opentsdb json: expected object or array")
+    groups: dict[tuple[str, tuple], dict] = {}
+    for i, dp in enumerate(doc):
+        if not isinstance(dp, dict):
+            raise ParserError(f"opentsdb json datapoint {i}: not an object")
+        try:
+            metric = str(dp["metric"])
+            ts = int(dp["timestamp"])
+            val = float(dp["value"])
+        except (KeyError, TypeError, ValueError) as e:
+            raise ParserError(f"opentsdb json datapoint {i}: {e}")
+        tags = {str(k): str(v) for k, v in (dp.get("tags") or {}).items()}
+        _append(groups, metric, tags, _scale_ts(ts, precision), val)
+    return _to_batch(groups)
+
+
+def _scale_ts(ts: int, precision) -> int:
+    if precision is None:
+        return normalize_ts_ns(ts)
+    return ts * precision.to_ns_factor()
+
+
+def _append(groups: dict, metric: str, tags: dict, ts: int, val: float):
+    key = (metric, tuple(sorted(tags.items())))
+    g = groups.setdefault(key, {"tags": tags, "ts": [], "vals": []})
+    g["ts"].append(ts)
+    g["vals"].append(val)
+
+
+def _to_batch(groups: dict) -> WriteBatch:
     wb = WriteBatch()
     for (metric, _), g in groups.items():
         sk = SeriesKey(metric, [Tag(k, v) for k, v in g["tags"].items()])

@@ -257,10 +257,14 @@ class HttpServer:
         session = self._session(request)
         self._authorize_write(session)
         body = await request.text()
-        from ..protocol.opentsdb import parse_opentsdb
+        from ..protocol.opentsdb import parse_opentsdb, parse_opentsdb_json
 
         try:
-            batch = parse_opentsdb(body)
+            # the reference serves telnet put lines AND the OpenTSDB
+            # JSON body shape; sniff the leading character
+            lead = body.lstrip()[:1]
+            batch = (parse_opentsdb_json(body) if lead in ("[", "{")
+                     else parse_opentsdb(body))
             loop = asyncio.get_running_loop()
             await loop.run_in_executor(
                 None, lambda: self.coord.write_points(

@@ -204,12 +204,16 @@ class CreateTenant:
     if_not_exists: bool = False
     comment: str = ""
     drop_after: str | None = None
+    # {group_name: {key: int}} from object_config / coord_* / http_*
+    # option groups (reference limiter_config)
+    limiter_groups: dict | None = None
 
 
 @dataclass
 class DropTenant:
     name: str
     if_exists: bool = False
+    after: str | None = None   # DROP TENANT x AFTER '<duration>'
 
 
 @dataclass

@@ -234,10 +234,13 @@ class QueryExecutor:
             return self._update(stmt, session)
         if isinstance(stmt, ast.CreateTenant):
             from ..models.schema import Duration
+            from ..parallel.meta import build_limiter_config
 
             try:
                 self.meta.create_tenant(stmt.name, TenantOptions(
                     comment=stmt.comment,
+                    limiter=(build_limiter_config(stmt.limiter_groups)
+                             if stmt.limiter_groups else None),
                     drop_after=(Duration.parse(stmt.drop_after)
                                 if stmt.drop_after else None)))
             except Exception:
@@ -245,7 +248,8 @@ class QueryExecutor:
                     raise
             return ResultSet.message("ok")
         if isinstance(stmt, ast.DropTenant):
-            self.meta.drop_tenant(stmt.name, if_exists=stmt.if_exists)
+            self.meta.drop_tenant(stmt.name, if_exists=stmt.if_exists,
+                                  after=stmt.after)
             return ResultSet.message("ok")
         if isinstance(stmt, ast.AlterTenantOpts):
             self.meta.alter_tenant_options(stmt.tenant, stmt.changes)
@@ -264,6 +268,11 @@ class QueryExecutor:
             self.meta.drop_user(stmt.name, if_exists=stmt.if_exists)
             return ResultSet.message("ok")
         if isinstance(stmt, ast.AlterUser):
+            if stmt.name == "root" and session.user != "root":
+                # only the initial admin may alter itself — a GRANTED
+                # admin altering root would be privilege escalation
+                # (dcl_user.slt pins comment/password/granted_admin)
+                raise ExecutionError("only root may alter user root")
             self.meta.alter_user(stmt.name, changes=stmt.changes)
             return ResultSet.message("ok")
         if isinstance(stmt, ast.CreateRole):
@@ -301,8 +310,10 @@ class QueryExecutor:
                 self.meta.remove_member(stmt.tenant, stmt.user)
             return ResultSet.message("ok")
         if isinstance(stmt, ast.CreateExternalTable):
+            xdb, xname = stmt.name.rsplit(".", 1) \
+                if "." in stmt.name else (session.database, stmt.name)
             self.meta.create_external_table(
-                session.tenant, session.database, stmt.name, stmt.path,
+                session.tenant, xdb, xname, stmt.path,
                 stmt.fmt, stmt.header, stmt.if_not_exists, stmt.options,
                 stmt.columns)
             return ResultSet.message("ok")
@@ -365,6 +376,11 @@ class QueryExecutor:
         from ..errors import AuthError
 
         user = session.user
+        tenants = getattr(self.meta, "tenants", None)
+        if tenants is not None and session.tenant not in tenants:
+            # even an admin cannot act inside a tenant that does not
+            # exist (cluster_schema/tenants.slt: select 1 errors)
+            raise AuthError(f"tenant {session.tenant!r} not found")
         u = self.meta.users.get(user)
         if u is None or u.get("admin"):
             return  # unknown → authentication already failed upstream
@@ -384,15 +400,21 @@ class QueryExecutor:
                     f"{stmt.tenant!r}")
             return
         if isinstance(stmt, self._READ_STMTS):
+            if isinstance(stmt, ast.SelectStmt) and stmt.table is None \
+                    and stmt.from_item is None:
+                # constant SELECT (current_user() etc.) touches no
+                # database resource — no privilege needed
+                # (function/session.slt: a grantless member runs it)
+                return
             need = "read"
         elif isinstance(stmt, self._WRITE_STMTS):
             need = "write"
         else:
             need = "all"
         db = getattr(stmt, "database", None) or session.database
-        from .system_tables import is_system_db
+        from .system_tables import is_system_db_for
 
-        if is_system_db(db) and need == "read":
+        if is_system_db_for(db, session) and need == "read":
             return
         if not self.meta.check_db_privilege(user, session.tenant, db, need):
             raise AuthError(
@@ -530,17 +552,58 @@ class QueryExecutor:
                 col.encoding = col.default_encoding()
         elif stmt.action == "add_tag":
             schema.add_column(stmt.column.name, ColumnType.tag())
+        elif stmt.action == "alter_codec":
+            # ALTER <col> SET CODEC: fields only (reference alter_table.slt
+            # pins tag/time as errors); CODEC(DEFAULT) restores the
+            # type-default rendering
+            col = schema.column(stmt.column.name)
+            if not col.column_type.is_field:
+                raise ExecutionError(
+                    "only FIELD columns take a compression codec")
+            if stmt.column.codec == "DEFAULT":
+                col.encoding = col.default_encoding()
+                col.explicit_codec = False
+            else:
+                from ..models.codec import codecs_for
+
+                enc = Encoding.from_str(stmt.column.codec)
+                if enc not in codecs_for(col.column_type.value_type.name):
+                    raise ExecutionError(
+                        f"codec {stmt.column.codec} does not apply to "
+                        f"{col.column_type.value_type.name}")
+                col.encoding = enc
+                col.explicit_codec = True
+            schema.schema_version += 1
         elif stmt.action == "rename":
             # RENAME COLUMN old TO new (reference rename_field/tag.slt:
             # time never renames; target must be free) — invariants live
             # in TskvTableSchema.rename_column; buffered rows re-key so
             # they follow the column like id-resolved TSM chunks do
             col = schema.rename_column(stmt.drop_name, stmt.rename_to)
+            owner = f"{session.tenant}.{db}"
             if col.column_type.is_field:
-                owner = f"{session.tenant}.{db}"
                 for v in self.coord.engine.local_vnodes(owner):
                     v.rename_mem_field(name, stmt.drop_name,
                                        stmt.rename_to)
+            elif col.column_type.is_tag:
+                # tag values live in index series keys, which carry tag
+                # NAMES — rewrite them so historic series follow the
+                # column (same WAL-logged machinery as tag UPDATE)
+                from ..models.series import SeriesKey
+
+                for v in self.coord.engine.local_vnodes(owner):
+                    old_keys, new_keys = [], []
+                    for sid in v.index.table_series_ids(name):
+                        k = v.index.get_series_key(int(sid))
+                        if k is None or k.tag_value(stmt.drop_name) is None:
+                            continue
+                        tags = {(stmt.rename_to if tk == stmt.drop_name
+                                 else tk): tv
+                                for tk, tv in k.tag_dict().items()}
+                        old_keys.append(k)
+                        new_keys.append(SeriesKey(name, tags))
+                    if old_keys:
+                        v.update_tags(name, old_keys, new_keys)
         elif stmt.action == "drop":
             tgt = schema.column(stmt.drop_name)
             if tgt is not None and tgt.column_type.is_field:
@@ -763,6 +826,21 @@ class QueryExecutor:
                  np.array([bool(o.config.get("wal_sync", False))]),
                  np.array([bool(o.config.get("strict_write", False))]),
                  np.array([o.config.get("max_cache_readers", 32)])])
+        ext = self.meta.external_opt(
+            session.tenant, stmt.database or session.database, stmt.name)
+        if ext is not None:
+            # external tables DESCRIBE with arrow type names and no
+            # codec (create_external_table.slt: "Decimal128(10, 6)")
+            names = [c[0] for c in ext.get("columns") or []]
+            types = [_arrow_type_name(c[1])
+                     for c in ext.get("columns") or []]
+            return ResultSet(
+                ["column_name", "data_type", "column_type",
+                 "compression_codec"],
+                [np.array(names, dtype=object),
+                 np.array(types, dtype=object),
+                 np.array(["FIELD"] * len(names), dtype=object),
+                 np.array([None] * len(names), dtype=object)])
         schema = self.meta.table(session.tenant,
                                  stmt.database or session.database, stmt.name)
         names, types, kinds, codecs = [], [], [], []
@@ -1176,9 +1254,9 @@ class QueryExecutor:
 
             table, db = st["table"], st["db"]
             stmt = dataclasses.replace(stmt, table=table, database=db)
-        from .system_tables import is_system_db, system_table
+        from .system_tables import is_system_db_for, system_table
 
-        if is_system_db(db):
+        if is_system_db_for(db, session):
             names, cols = system_table(self, db, table, session)
             has_agg = stmt.group_by or any(
                 rel.collect_aggs(it.expr, AGG_FUNCS)
@@ -3108,11 +3186,24 @@ def _load_external(ext: dict) -> tuple[list[str], list[np.ndarray]]:
     through utils.objstore with the table's stored connection options)."""
     from ..utils import objstore
 
-    src = objstore.open_source(ext["path"], ext.get("options"))
+    path = ext["path"]
+    # relative locations resolve against CNOSDB_EXTERNAL_DATA_ROOT when
+    # absent from the cwd (test corpora reference fixture trees by
+    # repo-relative path)
+    root = os.environ.get("CNOSDB_EXTERNAL_DATA_ROOT")
+    if root and "://" not in path and not os.path.isabs(path) \
+            and not os.path.exists(path) \
+            and os.path.exists(os.path.join(root, path)):
+        path = os.path.join(root, path)
+    src = objstore.open_source(path, ext.get("options"))
     if ext["fmt"] == "parquet":
         import pyarrow.parquet as pq
 
         table = pq.read_table(src)   # accepts files and directories
+    elif ext["fmt"] in ("ndjson", "json"):
+        import pyarrow.json as pj
+
+        table = pj.read_json(src)
     else:
         import pyarrow as pa
         import pyarrow.csv as pc
@@ -3315,15 +3406,45 @@ def _insert_coerce(vt, v, col: str):
     return v
 
 
+def _arrow_type_name(sql_type: str) -> str:
+    """Declared external-column SQL type → the arrow type name the
+    reference's DESCRIBE prints (create_external_table.slt)."""
+    t = sql_type.strip().upper()
+    m = re.match(r"^DECIMAL\((\d+),\s*(\d+)\)$", t)
+    if m:
+        return f"Decimal128({m.group(1)}, {m.group(2)})"
+    return {
+        "BIGINT": "Int64", "BIGINT UNSIGNED": "UInt64",
+        "INT": "Int32", "INTEGER": "Int32", "SMALLINT": "Int16",
+        "TINYINT": "Int8", "DOUBLE": "Float64", "FLOAT": "Float32",
+        "BOOLEAN": "Boolean", "STRING": "Utf8", "VARCHAR": "Utf8",
+        "TEXT": "Utf8", "TIMESTAMP": "Timestamp(Nanosecond, None)",
+        "DATE": "Date32",
+    }.get(t, t.capitalize())
+
+
 def _size_display(v) -> str:
-    """'128MiB'/'300M' → the reference's byte-size rendering
-    ('128 MiB', '300 MiB')."""
+    """'128MiB'/'300M' → the reference's byte-size rendering: parse to
+    bytes (decimal K/M/G vs binary Ki/Mi/Gi suffixes), then humanize in
+    BINARY units with full float precision — describe_database.slt pins
+    wal_max_file_size '300M' as '286.102294921875 MiB'."""
     s = str(v).strip()
-    m = re.match(r"^(\d+(?:\.\d+)?)\s*([KMGT]?)(i?B?)$", s, re.I)
+    m = re.match(r"^(\d+(?:\.\d+)?)\s*([KMGTP]?)(I?B?)$", s, re.I)
     if not m:
         return s
-    num, unit = m.group(1), m.group(2).upper()
-    return f"{num} {unit}iB" if unit else f"{num} B"
+    num = float(m.group(1))
+    unit, tail = m.group(2).upper(), m.group(3).upper()
+    power = " KMGTP".index(unit) if unit else 0
+    base = 1024 if (unit and tail.startswith("I")) else 1000
+    nbytes = num * base ** power
+    for p, uname in ((5, "PiB"), (4, "TiB"), (3, "GiB"), (2, "MiB"),
+                     (1, "KiB")):
+        if nbytes >= 1024 ** p:
+            val = nbytes / 1024 ** p
+            txt = str(int(val)) if val == int(val) else repr(val)
+            return f"{txt} {uname}"
+    txt = str(int(nbytes)) if nbytes == int(nbytes) else repr(nbytes)
+    return f"{txt} B"
 
 
 def _median_value(vals: np.ndarray):

@@ -170,6 +170,15 @@ _SYSTEM_VARS = {
 }
 
 
+# tenant limiter option groups (reference limiter_config: ALTER TENANT
+# SET object_config ... , coord_data_in remote_max = N ...)
+_LIMITER_GROUPS = {
+    "OBJECT_CONFIG", "COORD_DATA_IN", "COORD_DATA_OUT", "COORD_QUERIES",
+    "COORD_WRITES", "HTTP_DATA_IN", "HTTP_DATA_OUT", "HTTP_QUERIES",
+    "HTTP_WRITES",
+}
+
+
 # ---------------------------------------------------------------------------
 # parser
 # ---------------------------------------------------------------------------
@@ -240,6 +249,22 @@ class Parser:
         while self.accept_op(","):
             out.append(self.expect_ident())
         self.expect_op(")")
+        return out
+
+    def _parse_limiter_pairs(self) -> dict:
+        """`key = <int> key = <int> ...` after a limiter group name;
+        stops when the next token is not an `ident =` pair (the next
+        group name or a comma follows)."""
+        out: dict = {}
+        while (self.peek().kind == "ident"
+               and self.i + 1 < len(self.tokens)
+               and self.tokens[self.i + 1].kind == "op"
+               and self.tokens[self.i + 1].value == "="):
+            key = self.next().value.lower()
+            self.next()   # '='
+            out[key] = int(self.expect_number())
+        if not out:
+            raise ParserError("limiter option group expects key = value")
         return out
 
     def _parse_kv_parens(self) -> dict:
@@ -601,10 +626,16 @@ class Parser:
                     self.expect_op(")")
                 return ast.SubqueryRef(sub, alias, col_aliases)
             return ast.SubqueryRef(sub, f"__subquery_{self.i}")
-        name = self.expect_ident()
-        database = None
-        if self.accept_op("."):
-            database, name = name, self.expect_ident()
+        if self.peek().kind == "string":
+            # FROM 'name': DataFusion accepts a single-quoted table
+            # reference (create_external_table.slt SELECT * FROM 'ba sic')
+            name = self.expect_string()
+            database = None
+        else:
+            name = self.expect_ident()
+            database = None
+            if self.accept_op("."):
+                database, name = name, self.expect_ident()
         alias = None
         if self.accept_kw("AS"):
             alias = self.expect_ident()
@@ -653,10 +684,23 @@ class Parser:
             self.next()
             self.expect_kw("TABLE")
             ine = self._if_not_exists()
-            name = self.expect_ident()
+            # quoted, string-literal, and db-qualified names are all
+            # accepted; blank or '/'-bearing names are not
+            # (create_external_table.slt)
+            if self.peek().kind == "string":
+                name = self.expect_string()
+            else:
+                tdb, name = self.parse_qualified_ident()
+                if tdb is not None:
+                    name = f"{tdb}.{name}"
+            leaf = name.rsplit(".", 1)[-1]
+            if not leaf.strip() or "/" in leaf:
+                raise ParserError(f"invalid table name {name!r}")
             columns: list = []
             if self.accept_op("("):
                 while True:
+                    if self.peek().kind == "op" and self.peek().value == ")":
+                        break   # trailing comma before the close paren
                     cname = self.expect_ident()
                     parts = [self.expect_ident()]
                     if self.accept_op("("):   # DECIMAL(10,6) etc.
@@ -850,18 +894,26 @@ class Parser:
             name = self._ident_or_string()
             comment = ""
             drop_after = None
+            limiter: dict | None = None
             if self.accept_kw("WITH"):
                 while True:
-                    if self.accept_kw("COMMENT"):
+                    o = self.kw()
+                    if o == "COMMENT":
+                        self.next()
                         self.accept_op("=")
                         comment = self.expect_string()
-                    elif self.accept_kw("DROP_AFTER"):
+                    elif o == "DROP_AFTER":
+                        self.next()
                         self.accept_op("=")
                         drop_after = self.expect_string()
+                    elif o in _LIMITER_GROUPS:
+                        self.next()
+                        limiter = limiter or {}
+                        limiter[o.lower()] = self._parse_limiter_pairs()
                     else:
                         break
                     self.accept_op(",")
-            return ast.CreateTenant(name, ine, comment, drop_after)
+            return ast.CreateTenant(name, ine, comment, drop_after, limiter)
         if k == "USER":
             self.next()
             ine = self._if_not_exists()
@@ -945,9 +997,10 @@ class Parser:
             self.next()
             ie = self._if_exists()
             name = self._ident_or_string()
+            after = None
             if self.accept_kw("AFTER"):
-                self.expect_string()
-            return ast.DropTenant(name, ie)
+                after = self.expect_string()
+            return ast.DropTenant(name, ie, after)
         if k == "USER":
             self.next()
             ie = self._if_exists()
@@ -1032,6 +1085,17 @@ class Parser:
             if self.accept_kw("DROP"):
                 self.accept_kw("COLUMN")
                 return ast.AlterTable(name, "drop", drop_name=self.expect_ident())
+            if self.accept_kw("ALTER"):
+                # ALTER TABLE t ALTER <col> SET CODEC(<name>)
+                # (reference alter_table.slt)
+                cname = self.expect_ident()
+                self.expect_kw("SET")
+                self.expect_kw("CODEC")
+                self.expect_op("(")
+                codec = self.expect_ident().upper()
+                self.expect_op(")")
+                return ast.AlterTable(name, "alter_codec",
+                                      ast.ColumnDef(cname, "", codec))
             raise ParserError("unsupported ALTER TABLE action")
         if k == "USER":
             self.next()
@@ -1079,6 +1143,15 @@ class Parser:
                 return ast.AlterTenantMember(tenant, self.expect_ident(),
                                              add=False)
             if self.accept_kw("SET"):
+                if self.accept_kw("USER"):
+                    # ALTER TENANT t SET USER u AS role: re-role an
+                    # existing member (dcl_tenant.slt)
+                    user = self.expect_ident()
+                    role = "member"
+                    if self.accept_kw("AS"):
+                        role = self.expect_ident()
+                    return ast.AlterTenantMember(tenant, user, role,
+                                                 add=True)
                 changes = {}
                 while True:
                     o = self.kw()
@@ -1090,6 +1163,10 @@ class Parser:
                         self.next()
                         self.accept_op("=")
                         changes["drop_after"] = self.expect_string()
+                    elif o in _LIMITER_GROUPS:
+                        self.next()
+                        changes.setdefault("_limiter_groups", {})[
+                            o.lower()] = self._parse_limiter_pairs()
                     else:
                         break
                     self.accept_op(",")
@@ -1097,7 +1174,7 @@ class Parser:
                     raise ParserError("ALTER TENANT SET expects an option")
                 return ast.AlterTenantOpts(tenant, changes)
             if self.accept_kw("UNSET"):
-                o = self.expect_kw("DROP_AFTER", "COMMENT")
+                o = self.expect_kw("DROP_AFTER", "COMMENT", "_LIMITER")
                 return ast.AlterTenantOpts(tenant, {o.lower(): None})
             raise ParserError(
                 "ALTER TENANT expects ADD/REMOVE USER or SET/UNSET")

@@ -13,8 +13,24 @@ import numpy as np
 from ..errors import TableNotFound
 
 
-def is_system_db(db: str) -> bool:
-    return db in ("information_schema", "cluster_schema", "usage_schema")
+def is_system_db_for(db: str, session) -> bool:
+    """cluster_schema is only registered under the system default
+    tenant — another tenant may own a REAL database of that name
+    (dcl_tenant.slt: create database cluster_schema under tenant001)."""
+    from ..parallel.meta import DEFAULT_TENANT
+
+    if db == "cluster_schema":
+        return session.tenant == DEFAULT_TENANT
+    return db in ("information_schema", "usage_schema")
+
+
+def _is_owner_view(meta, session) -> bool:
+    """Instance admins and tenant owners see full catalog tables; plain
+    members get filtered views (roles.slt, database_privileges.slt)."""
+    u = meta.users.get(session.user)
+    return (u is None or bool(u.get("admin"))
+            or meta.check_db_privilege(session.user, session.tenant,
+                                       "", "all"))
 
 
 def system_table(executor, db: str, table: str, session) -> tuple[list[str], list]:
@@ -48,10 +64,13 @@ def system_table(executor, db: str, table: str, session) -> tuple[list[str], lis
             # TABLE, engine TSKV/EXTERNAL/STREAM, options 'TODO')
             rows = []
             for dbn in meta.list_databases(session.tenant):
-                for tn in meta.list_tables(session.tenant, dbn):
+                owner = f"{session.tenant}.{dbn}"
+                # tskv tables only — externals are listed below with
+                # their own engine tag (list_tables merges both for
+                # SHOW TABLES, which would double-list here)
+                for tn in sorted(meta.tables.get(owner, {})):
                     rows.append((session.tenant, dbn, tn, "TABLE", "TSKV",
                                  "TODO"))
-                owner = f"{session.tenant}.{dbn}"
                 for tn in sorted(getattr(meta, "externals", {})
                                  .get(owner, {})):
                     rows.append((session.tenant, dbn, tn, "TABLE",
@@ -71,7 +90,8 @@ def system_table(executor, db: str, table: str, session) -> tuple[list[str], lis
             # codec rendering (explicit NULL codec → SQL NULL)
             rows = []
             for dbn in meta.list_databases(session.tenant):
-                for tn in meta.list_tables(session.tenant, dbn):
+                for tn in sorted(meta.tables.get(
+                        f"{session.tenant}.{dbn}", {})):
                     schema = meta.table(session.tenant, dbn, tn)
                     for pos, c in enumerate(schema.columns):
                         ct = c.column_type
@@ -97,14 +117,17 @@ def system_table(executor, db: str, table: str, session) -> tuple[list[str], lis
             return _users_table(meta)
         if t == "roles":
             # reference information_schema ROLES: per-tenant roles incl.
-            # the system roles (role_name, role_type, inherit_role)
+            # the system roles (role_name, role_type, inherit_role) —
+            # visible only to instance admins and tenant OWNERS; other
+            # members read it empty (dcl_role.slt, roles.slt)
             rows = []
-            for name, spec in sorted(
-                    meta.list_roles(session.tenant).items()):
-                system = name in ("owner", "member")
-                rows.append((name,
-                             "system" if system else "custom",
-                             None if system else spec.get("inherit")))
+            if _is_owner_view(meta, session):
+                for name, spec in sorted(
+                        meta.list_roles(session.tenant).items()):
+                    system = name in ("owner", "member")
+                    rows.append((name,
+                                 "system" if system else "custom",
+                                 None if system else spec.get("inherit")))
             return _cols(["role_name", "role_type", "inherit_role"], rows)
         if t == "members":
             rows = [(user, role) for user, role in sorted(
@@ -151,8 +174,16 @@ def system_table(executor, db: str, table: str, session) -> tuple[list[str], lis
             return _cols(["time", "name", "action", "try_count",
                           "status", "comment"], rows)
         if t == "database_privileges":
+            # admins and tenant owners see every grant; a plain member
+            # sees only their OWN role's grants
+            # (database_privileges.slt)
             rows = []
+            owner_view = _is_owner_view(meta, session)
+            own_role = meta.members.get(session.tenant,
+                                        {}).get(session.user)
             for role, spec in meta.roles.get(session.tenant, {}).items():
+                if not owner_view and role != own_role:
+                    continue
                 for dbn, lvl in (spec.get("privileges") or {}).items():
                     rows.append((session.tenant, dbn,
                                  lvl.capitalize(), role))
@@ -161,10 +192,23 @@ def system_table(executor, db: str, table: str, session) -> tuple[list[str], lis
     if db == "cluster_schema":
         # the reference serves users/tenants from CLUSTER_SCHEMA
         # (metadata/cluster_schema_provider); keep them reachable from the
-        # information_schema spelling too
+        # information_schema spelling too. The schema is only registered
+        # under the system default tenant — any other tenant sees
+        # table-not-found, and non-admin sessions read users EMPTY
+        # (sys_table/cluster_schema/users.slt)
+        if session.tenant != "cnosdb":
+            raise TableNotFound(f"{db}.{table}")
         if t == "users":
+            u = meta.users.get(session.user)
+            if u is not None and not u.get("admin"):
+                return _cols(["user_name", "is_admin", "user_options"], [])
             return _users_table(meta)
         if t == "tenants":
+            u = meta.users.get(session.user)
+            if u is not None and not u.get("admin"):
+                # tenant catalog is admin-only; members read it empty
+                # (cluster_schema/tenants.slt)
+                return _cols(["tenant_name", "tenant_options"], [])
             return _tenants_table(meta)
         if t == "nodes":
             rows = [(n.id, n.http_addr, n.grpc_addr, "running")
@@ -213,6 +257,8 @@ def _users_table(meta):
             out["must_change_password"] = bool(u["must_change_password"])
         if u.get("comment"):
             out["comment"] = u["comment"]
+        if "granted_admin" in u and u["granted_admin"] is not None:
+            out["granted_admin"] = bool(u["granted_admin"])
         return json.dumps(out, separators=(",", ":"),
                           ensure_ascii=False)
 
@@ -227,7 +273,11 @@ def _tenants_table(meta):
     def opts_json(o):
         da = None
         if o.drop_after is not None:
-            da = {"duration": str(o.drop_after)}
+            # reference serde of Duration: {"duration":{"secs","nanos"},
+            # "is_inf"} (cluster_schema/tenants.slt)
+            da = {"duration": {"secs": o.drop_after.ns // 10 ** 9,
+                               "nanos": o.drop_after.ns % 10 ** 9},
+                  "is_inf": o.drop_after.is_inf}
         return json.dumps(
             {"comment": o.comment or None, "limiter_config": o.limiter,
              "drop_after": da, "tenant_is_hidden": False},

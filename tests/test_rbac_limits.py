@@ -65,10 +65,19 @@ def test_rbac_owner_and_nonmember(db):
     owner = Session(tenant="acme", user="boss")
     # owners may run DDL in their tenant
     db.execute_one("CREATE DATABASE d WITH SHARD 1", owner)
-    # non-member denied entirely
+    # non-member denied on anything touching the tenant's databases
+    # (constant SELECTs are privilege-free — function/session.slt)
     with pytest.raises(AuthError):
-        db.execute_one("SELECT 1", Session(tenant="acme", database="d",
-                                           user="stranger"))
+        db.execute_one("SHOW TABLES", Session(tenant="acme", database="d",
+                                              user="stranger"))
+    # the constant-SELECT exemption must not extend to aliased tables,
+    # joins, or derived tables (stmt.table is None but from_item is set)
+    db.execute_one("CREATE TABLE d.secret (v BIGINT, TAGS(tg))", owner)
+    for q in ("SELECT * FROM secret s",
+              "SELECT * FROM (SELECT * FROM secret) q"):
+        with pytest.raises(AuthError):
+            db.execute_one(q, Session(tenant="acme", database="d",
+                                      user="stranger"))
 
 
 def test_token_bucket():

@@ -38,12 +38,25 @@ def _parse(path):
         for kind, prefix in (("ok", "statement ok "),
                              ("error", "statement error "),
                              ("lineproto", "lineproto "),
+                             ("opentsdbjson", "opentsdbjson "),
+                             ("opentsdb", "opentsdb "),
+                             ("writeprecision", "writeprecision "),
                              ("cleandir", "cleandir "),
                              ("usetenant", "usetenant "),
                              ("useuser", "useuser "),
                              ("use", "usedb ")):
             if line.startswith(prefix):
-                blocks.append((kind, line[len(prefix):], None, i))
+                body = line[len(prefix):]
+                if body == "<<":
+                    # heredoc: multi-line statement (real newlines are
+                    # significant, e.g. multi-line tenant comments)
+                    part = []
+                    while i < len(lines) and lines[i].rstrip() != ">>":
+                        part.append(lines[i])
+                        i += 1
+                    i += 1   # skip '>>'
+                    body = "\n".join(part)
+                blocks.append((kind, body, None, i))
                 break
         else:
             for kind in ("querysort", "query"):
@@ -82,6 +95,14 @@ def _case_files():
     ]
 
 
+@pytest.fixture(autouse=True)
+def _external_data_root(monkeypatch):
+    """The corpus references fixture files by reference-repo-relative
+    LOCATION; resolve them against the read-only reference checkout."""
+    if os.path.isdir("/root/reference"):
+        monkeypatch.setenv("CNOSDB_EXTERNAL_DATA_ROOT", "/root/reference")
+
+
 @pytest.mark.parametrize("case", _case_files())
 def test_ref_sqllogic(case, tmp_path):
     meta = MetaStore(str(tmp_path / "meta.json"))
@@ -89,6 +110,7 @@ def test_ref_sqllogic(case, tmp_path):
     coord = Coordinator(meta, engine)
     ex = QueryExecutor(meta, coord)
     session = Session()
+    write_precision = "ns"   # set by the `writeprecision` directive
     try:
         for kind, sql, expected, lineno in _parse(
                 os.path.join(CASES_DIR, case)):
@@ -97,11 +119,22 @@ def test_ref_sqllogic(case, tmp_path):
 
                 assert sql.startswith("/tmp/"), sql   # safety rail
                 shutil.rmtree(sql, ignore_errors=True)
+            elif kind == "writeprecision":
+                write_precision = sql.strip()
             elif kind == "lineproto":
                 from cnosdb_tpu.models.schema import Precision
                 from cnosdb_tpu.protocol.line_protocol import parse_lines
 
-                batch = parse_lines(sql, Precision.parse("ns"))
+                batch = parse_lines(sql, Precision.parse(write_precision))
+                coord.write_points(session.tenant, session.database, batch)
+            elif kind in ("opentsdb", "opentsdbjson"):
+                from cnosdb_tpu.models.schema import Precision
+                from cnosdb_tpu.protocol.opentsdb import (
+                    parse_opentsdb, parse_opentsdb_json)
+
+                fn = parse_opentsdb_json if kind == "opentsdbjson" \
+                    else parse_opentsdb
+                batch = fn(sql, Precision.parse(write_precision))
                 coord.write_points(session.tenant, session.database, batch)
             elif kind == "usetenant":
                 session.tenant = sql
