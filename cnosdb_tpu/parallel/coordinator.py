@@ -69,6 +69,17 @@ class Coordinator:
         self._scan_cache_lock = threading.Lock()
         # schema auto-creation callbacks land on meta; keep engine's view hot
         meta.watch(self._on_meta_event)
+        # usage_schema's bootstrap metric tables predate any create_table
+        # event — seed the engine's schema view so flushed chunks carry
+        # column ids
+        for owner, tbls in getattr(meta, "tables", {}).items():
+            if owner.endswith(".usage_schema"):
+                for t in tbls.values():
+                    self.engine.set_table_schema(owner, t)
+        # throttle clock + cumulative counters per usage metric key,
+        # lock-guarded: executor/HTTP threads record concurrently
+        self._usage_last: dict = {}
+        self._usage_lock = threading.Lock()
 
     def _rpc(self, node_id: int, method: str, payload: dict):
         from .net import RpcUnavailable, rpc_call
@@ -132,8 +143,20 @@ class Coordinator:
         # gate large ingests on the memory budget (reference raft/writer.rs
         # :58-84 gates writes on GreedyMemoryPool)
         est = batch.n_rows() * 128
+        record = db != "usage_schema"
+        pre_sizes = None
+        if record:
+            try:
+                pre_sizes = self._vnode_cache_sizes(owner)
+            except Exception:
+                record = False   # metrics must never fail the write
         with self.memory_pool.reservation(est, f"write to {owner}"):
             self._write_points_inner(tenant, db, owner, batch, sync)
+        if record:
+            try:
+                self._record_write_usage(tenant, db, owner, est, pre_sizes)
+            except Exception:
+                pass
 
     def _write_points_inner(self, tenant, db, owner, batch, sync):
         per_rs: dict[int, tuple[object, WriteBatch]] = {}
@@ -157,6 +180,74 @@ class Coordinator:
                     entry[1].add_series(table, sub)
         for rs, sub_batch in per_rs.values():
             self._write_replica_set(owner, rs, sub_batch, sync)
+
+    # ----------------------------------------------------- usage metrics
+    # The reference's metrics reporter (usage_schema.rs) writes REAL rows
+    # into cnosdb.usage_schema: cumulative per-tenant counters
+    # (coord_data_in/out, coord_writes/queries, sql/http_*) and per-vnode
+    # gauges (vnode_cache_size pre+post around each write,
+    # vnode_disk_storage after it). Metric writes never recurse (records
+    # skip when the target db IS usage_schema) and never fail the caller.
+
+    def _vnode_cache_sizes(self, owner: str) -> dict:
+        # only already-open local vnodes — lazily opening every on-disk
+        # vnode would defeat the point of a cheap gauge. Snapshot under
+        # engine.lock: concurrent writes open vnodes mid-iteration.
+        with self.engine.lock:
+            vnodes = list(self.engine.vnodes.items())
+        return {vid: v.active.usage_size
+                for (o, vid), v in vnodes if o == owner}
+
+    def record_usage(self, table: str, tags: dict, value: int,
+                     throttle: bool = False, cumulative: bool = False):
+        """Append one point to usage_schema.<table>. `throttle` caps the
+        series at one sample per second; `cumulative` accumulates the
+        value into a monotone counter first (prometheus-style)."""
+        try:
+            key = (table, tuple(sorted(tags.items())))
+            now = time.time()
+            with self._usage_lock:
+                if cumulative:
+                    cnt = self._usage_last.setdefault(("c", key), [0])
+                    cnt[0] += value
+                    value = cnt[0]
+                if throttle:
+                    last = self._usage_last.get(("t", key))
+                    if last is not None and now - last < 1.0:
+                        return
+                    self._usage_last[("t", key)] = now
+            from ..models.points import SeriesRows, WriteBatch
+            from ..models.schema import ValueType
+            from ..models.series import SeriesKey, Tag
+
+            sk = SeriesKey(table, [Tag(k, str(v)) for k, v in tags.items()])
+            wb = WriteBatch()
+            wb.add_series(table, SeriesRows(
+                sk, [time.time_ns()],
+                {"value": (int(ValueType.UNSIGNED), [int(value)])}))
+            self.write_points("cnosdb", "usage_schema", wb)
+        except Exception:
+            pass   # metrics must never fail or recurse into the caller
+
+    def _record_write_usage(self, tenant, db, owner, est_bytes, pre_sizes):
+        node = str(self.node_id)
+        base = {"tenant": tenant, "database": db, "node_id": node}
+        self.record_usage("coord_data_in", base, est_bytes,
+                          throttle=True, cumulative=True)
+        self.record_usage("coord_writes", base, 1,
+                          throttle=True, cumulative=True)
+        post = self._vnode_cache_sizes(owner)
+        for vid, sz in post.items():
+            pre = (pre_sizes or {}).get(vid, 0)
+            if sz == pre and vid in (pre_sizes or {}):
+                continue   # untouched vnode
+            vt = {"tenant": tenant, "database": db, "node_id": node,
+                  "vnode_id": str(vid)}
+            self.record_usage("vnode_cache_size", vt, pre)
+            self.record_usage("vnode_cache_size", vt, sz)
+            v = self.engine.vnodes.get((owner, vid))
+            if v is not None:
+                self.record_usage("vnode_disk_storage", vt, v.disk_size())
 
     def _split_series_by_bucket(self, tenant: str, db: str, sr: SeriesRows):
         """A series' rows can straddle buckets; split rows by bucket then

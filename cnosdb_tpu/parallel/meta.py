@@ -166,10 +166,52 @@ class MetaStore:
                               "comment": "system admin",
                               "must_change_password": True}
         for db in (DEFAULT_DATABASE, USAGE_SCHEMA):
-            schema = DatabaseSchema(DEFAULT_TENANT, db, DatabaseOptions())
+            opts = DatabaseOptions()
+            if db == USAGE_SCHEMA:
+                # the reference gives usage_schema a tiny memcache
+                # (usage_schema.rs; DESCRIBE DATABASE pins '2 MiB')
+                opts.config = dict(opts.config or {})
+                opts.config["max_memcache_size"] = "2 MiB"
+            schema = DatabaseSchema(DEFAULT_TENANT, db, opts)
             self.databases[schema.owner] = schema
             self.tables.setdefault(schema.owner, {})
             self.buckets.setdefault(schema.owner, [])
+        self._bootstrap_usage_tables()
+
+    def _bootstrap_usage_tables(self):
+        """The reference's metrics reporter registers REAL tskv tables in
+        usage_schema (usage_schema.rs): per-tenant coord/sql/http
+        counters and per-vnode gauges, all `value BIGINT UNSIGNED` with
+        STRING tags. Rows are written by the coordinator/HTTP hooks."""
+        from ..models.schema import ColumnType
+
+        owner = f"{DEFAULT_TENANT}.{USAGE_SCHEMA}"
+        tbls = self.tables.setdefault(owner, {})
+
+        from ..models.schema import TableColumn, ValueType
+
+        def mk(name, tags):
+            if name in tbls:
+                return
+            cols = [("time", ColumnType.time())]
+            cols += [(t, ColumnType.tag()) for t in tags]
+            cols.append(("value", ColumnType.field(ValueType.UNSIGNED)))
+            tbls[name] = TskvTableSchema(
+                DEFAULT_TENANT, USAGE_SCHEMA, name,
+                [TableColumn(i, n, ct) for i, (n, ct) in enumerate(cols)])
+
+        coord_tags = ("database", "node_id", "tenant")
+        for n in ("coord_data_in", "coord_data_out", "coord_queries",
+                  "coord_writes", "sql_data_in"):
+            mk(n, coord_tags)
+        http_tags = ("api", "database", "host", "node_id", "tenant",
+                     "user")
+        for n in ("http_data_in", "http_data_out", "http_queries",
+                  "http_writes"):
+            mk(n, http_tags)
+        vnode_tags = ("database", "node_id", "tenant", "vnode_id")
+        for n in ("vnode_disk_storage", "vnode_cache_size"):
+            mk(n, vnode_tags)
 
     def _to_dict(self) -> dict:
         return {
@@ -227,6 +269,14 @@ class MetaStore:
         self.recent_req_ids = list(d.get("recent_req_ids", []))
         self.trash = d.get("trash", {"tenant": {}, "db": {}, "table": {}})
         self._next_bucket_id, self._next_replica_id, self._next_vnode_id = d["next_ids"]
+        # snapshots written before the usage_schema metric tables existed
+        # must still grow them on load (mk() is idempotent), along with
+        # the 2 MiB memcache config the reference pins
+        us = self.databases.get(f"{DEFAULT_TENANT}.{USAGE_SCHEMA}")
+        if us is not None:
+            us.options.config = dict(us.options.config or {})
+            us.options.config.setdefault("max_memcache_size", "2 MiB")
+            self._bootstrap_usage_tables()
 
     def _notify(self, event: str, **kw):
         with self.lock:

@@ -136,7 +136,24 @@ class HttpServer:
             return _err_response(_status_for(e), e)
         self.metrics.incr("http_writes")
         self.metrics.incr("http_points_written", batch.n_rows())
+        self._record_http_usage(request, session, "http_data_in",
+                                len(body))
+        self._record_http_usage(request, session, "http_writes", 1)
         return web.Response(status=200)
+
+    def _record_http_usage(self, request, session, table: str, value: int):
+        """usage_schema HTTP-plane counters (reference http reporters):
+        cumulative per (tenant, db, api, user), 1s-throttled."""
+        try:
+            self.coord.record_usage(
+                table,
+                {"tenant": session.tenant, "database": session.database,
+                 "node_id": str(self.coord.node_id),
+                 "api": request.path, "host": request.host,
+                 "user": session.user},
+                value, throttle=True, cumulative=True)
+        except Exception:
+            pass
 
     async def handle_sql(self, request):
         session = self._session(request)
@@ -161,6 +178,8 @@ class HttpServer:
             self.metrics.incr("http_sql_errors")
             return _err_response(_status_for(e), e)
         self.metrics.incr("http_queries")
+        self._record_http_usage(request, session, "http_queries", 1)
+        self._record_http_usage(request, session, "http_data_in", len(sql))
         rs = results[-1] if results else ResultSet.empty()
         if "json" in accept:
             resp = web.Response(text=format_json(rs),

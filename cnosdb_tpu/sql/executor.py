@@ -154,10 +154,25 @@ class QueryExecutor:
             for s in parse_sql(sql):
                 self.tracker.check_cancelled(qid)
                 out.append(self.execute_statement(s, session))
+            self._record_query_usage(sql, session)
             return out
         finally:
             self._tls.qid = prev_qid
             self.tracker.finish(qid)
+
+    def _record_query_usage(self, sql: str, session: Session):
+        """usage_schema counters for the SQL plane (reference
+        usage_schema.rs sql_data_in / coord_queries reporters) — 1-second
+        throttled cumulative rows; never fails the query."""
+        try:
+            tags = {"tenant": session.tenant, "database": session.database,
+                    "node_id": str(self.coord.node_id)}
+            self.coord.record_usage("sql_data_in", tags, len(sql),
+                                    throttle=True, cumulative=True)
+            self.coord.record_usage("coord_queries", tags, 1,
+                                    throttle=True, cumulative=True)
+        except Exception:
+            pass
 
     def _poll_cancel(self):
         qid = getattr(getattr(self, "_tls", None), "qid", None)
@@ -1256,7 +1271,26 @@ class QueryExecutor:
             stmt = dataclasses.replace(stmt, table=table, database=db)
         from .system_tables import is_system_db_for, system_table
 
-        if is_system_db_for(db, session):
+        if db == "usage_schema" and table in self.meta.tables.get(
+                "cnosdb.usage_schema", {}):
+            # usage_schema is a REAL database under the system tenant
+            # (metric tables + user tables); other tenants read it as a
+            # view filtered to their own rows
+            # (usage_schema_privilege.slt, coord_metrics.slt)
+            if session.tenant != "cnosdb":
+                import dataclasses
+
+                from .expr import BinOp
+
+                tagf = BinOp("=", Column("tenant"),
+                             Literal(session.tenant))
+                stmt = dataclasses.replace(
+                    stmt, where=(tagf if stmt.where is None
+                                 else BinOp("and", stmt.where, tagf)))
+                session = Session(tenant="cnosdb",
+                                  database=session.database,
+                                  user=session.user)
+        elif is_system_db_for(db, session):
             names, cols = system_table(self, db, table, session)
             has_agg = stmt.group_by or any(
                 rel.collect_aggs(it.expr, AGG_FUNCS)

@@ -106,7 +106,7 @@ def system_table(executor, db: str, table: str, session) -> tuple[list[str], lis
                         rows.append((session.tenant, dbn, tn, c.name,
                                      kind, pos, None, not ct.is_time,
                                      dtype, codec))
-            return _cols(["table_tenant", "table_database", "table_name",
+            return _cols(["tenant_name", "database_name", "table_name",
                           "column_name", "column_type",
                           "ordinal_position", "column_default",
                           "is_nullable", "data_type",

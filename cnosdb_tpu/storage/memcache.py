@@ -168,6 +168,16 @@ class MemCache:
     def should_flush(self) -> bool:
         return self.approx_bytes >= self.max_bytes
 
+    @property
+    def usage_size(self) -> int:
+        """The reference's cache-memory estimate (80 bytes per
+        row-column: a 1-row single-field write reads 160 —
+        vnode_cache_size.slt), decoupled from the flush-threshold
+        accounting so gauge parity can't change flush cadence.
+        approx_bytes is always a multiple of 48, so the rescale is
+        exact."""
+        return self.approx_bytes * 80 // 48
+
     def mark_immutable(self):
         self.immutable = True
 

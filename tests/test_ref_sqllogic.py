@@ -141,12 +141,13 @@ def test_ref_sqllogic(case, tmp_path):
             elif kind == "useuser":
                 session.user = sql
             elif kind == "use":
+                dbname = sql.rstrip(";").strip()
                 try:
-                    ex.execute_one(f"CREATE DATABASE IF NOT EXISTS {sql}",
-                                   session)
+                    ex.execute_one(
+                        f"CREATE DATABASE IF NOT EXISTS {dbname}", session)
                 except Exception:
                     pass
-                session.database = sql
+                session.database = dbname
             elif kind == "ok":
                 try:
                     ex.execute_one(sql, session)
