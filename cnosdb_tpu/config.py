@@ -9,10 +9,51 @@ log/service/cluster.
 from __future__ import annotations
 
 import os
-import tomllib
 from dataclasses import dataclass, field, fields as dc_fields
 
 from .errors import ConfigError
+
+try:
+    import tomllib
+except ImportError:  # Python < 3.11: minimal flat-TOML fallback, enough
+    # for the [section] / key = scalar shape this module itself emits
+    class tomllib:  # type: ignore[no-redef]
+        class TOMLDecodeError(ValueError):
+            pass
+
+        @staticmethod
+        def load(f):
+            data: dict = {}
+            section = None
+            for lineno, raw in enumerate(
+                    f.read().decode("utf-8").splitlines(), 1):
+                line = raw.split("#", 1)[0].strip() \
+                    if not raw.strip().startswith('"') else raw.strip()
+                if not line:
+                    continue
+                if line.startswith("[") and line.endswith("]"):
+                    section = data.setdefault(line[1:-1].strip(), {})
+                    continue
+                if "=" not in line or section is None:
+                    raise tomllib.TOMLDecodeError(
+                        f"line {lineno}: {raw!r}")
+                k, _, v = line.partition("=")
+                v = v.strip()
+                if len(v) >= 2 and v[0] == '"' and v[-1] == '"':
+                    val: object = v[1:-1]
+                elif v in ("true", "false"):
+                    val = v == "true"
+                else:
+                    try:
+                        val = int(v)
+                    except ValueError:
+                        try:
+                            val = float(v)
+                        except ValueError:
+                            raise tomllib.TOMLDecodeError(
+                                f"line {lineno}: bad value {v!r}")
+                section[k.strip()] = val
+            return data
 
 
 @dataclass

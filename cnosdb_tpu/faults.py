@@ -1,0 +1,274 @@
+"""Deterministic fault-injection plane.
+
+The distributed machinery (multi-raft replication, leader-retry writes,
+broken-replica failover, WAL torn-tail recovery) promises invariants that
+only show up under partial failure. This module makes those failures
+*injectable, deterministic and inheritable*: named fault points threaded
+through the RPC plane, the WAL/record-file layer, flush/compaction and the
+meta service fire according to a seeded schedule parsed from the
+``CNOSDB_FAULTS`` environment variable — so the multi-process cluster
+harness (tests/cluster_harness.py) arms every spawned node just by setting
+the env, and the same spec + seed reproduces the same firing sequence.
+
+Zero overhead when disabled: ``CNOSDB_FAULTS`` unset leaves the
+module-level ``ENABLED`` bool False, and every hook site guards with a
+single ``if faults.ENABLED:`` check before calling :func:`fire`.
+
+Schedule grammar (rules separated by ``;``)::
+
+    CNOSDB_FAULTS = "seed=<int>" | <rule> { ";" <rule> }
+    rule          = <point> ":" <action> [ ":" <sched> ]
+    action        = fail | delay(<ms>) | drop | torn[(<bytes>)]
+                  | enospc | io_error | crash
+    sched         = <k>=<v> { "," <k>=<v> }     # all optional, AND-ed
+                      nth=<k>     fire only on the k-th matching hit
+                      after=<k>   fire on every hit after the k-th
+                      times=<k>   fire at most k times
+                      once        fire at most once (= times=1)
+                      prob=<p>    fire with probability p (seeded RNG)
+                      if=<substr> hit counts only when <substr> appears in
+                                  the hook call's context values (method
+                                  name, peer address, path ...)
+
+Example::
+
+    CNOSDB_FAULTS="seed=7;rpc.send:fail:if=127.0.0.1:9402;\
+wal.append:torn(4):nth=11;rpc.reply:drop:nth=1,if=write_replica"
+
+Actions ``fail`` / ``enospc`` / ``io_error`` raise (:class:`FaultInjected`
+is an ``OSError`` so existing network/disk error handling takes the same
+path a real fault would), ``delay`` sleeps, ``crash`` calls ``os._exit``.
+``torn`` and ``drop`` are *site-implemented*: :func:`fire` returns the
+``(action, arg)`` tuple and the hook site performs the partial write /
+reply drop itself.
+
+Fault points currently threaded (see ARCHITECTURE.md "Fault model"):
+  rpc.send rpc.response rpc.server rpc.reply          parallel/net.py
+  record.append record.sync                           storage/record_file.py
+  wal.append wal.sync wal.roll                        storage/wal.py
+  flush.run                                           storage/flush.py
+  compaction.run                                      storage/compaction.py
+  meta.propose meta.apply                             parallel/meta_service.py
+"""
+from __future__ import annotations
+
+import errno as _errno
+import os
+import random
+import threading
+import time
+import zlib
+
+
+class FaultInjected(OSError):
+    """An injected failure. Subclasses OSError so hook sites' existing
+    connection/disk error handling treats it exactly like the real thing."""
+
+
+# Single module-level guard — hook sites check `faults.ENABLED` (one
+# attribute load + bool test) before paying for the fire() call.
+ENABLED = False
+
+# Runtime control surface (`_faults` RPC method) is armed iff CNOSDB_FAULTS
+# is present in the environment — harness-spawned processes inherit it, and
+# production processes (env unset) expose nothing.
+CTL_ARMED = "CNOSDB_FAULTS" in os.environ
+
+_lock = threading.RLock()
+_rules: dict[str, list["_Rule"]] = {}
+_fired: list[tuple[str, str, int]] = []   # (point, action, hit#) sequence
+_seed = 0
+
+_SITE_ACTIONS = frozenset({"torn", "drop"})
+_KNOWN_ACTIONS = _SITE_ACTIONS | {"fail", "delay", "enospc", "io_error",
+                                  "crash"}
+
+
+class _Rule:
+    __slots__ = ("point", "action", "arg", "when", "hits", "fired", "rng")
+
+    def __init__(self, point: str, action: str, arg: str | None,
+                 when: dict, seed: int):
+        self.point = point
+        self.action = action
+        self.arg = arg
+        self.when = when
+        self.hits = 0
+        self.fired = 0
+        # per-rule RNG seeded from the global seed and a *stable* hash of
+        # the rule text (hash() is salted per process; crc32 is not), so
+        # prob schedules replay identically across processes and runs
+        key = zlib.crc32(f"{point}:{action}:{arg}".encode())
+        self.rng = random.Random((seed << 32) ^ key)
+
+    def check(self, ctx: dict) -> bool:
+        """Advance this rule's hit counter for a matching call and decide
+        whether it fires. Caller holds _lock (determinism under threads)."""
+        w = self.when
+        cond = w.get("if")
+        if cond is not None:
+            hay = " ".join(str(v) for v in ctx.values())
+            if cond not in hay:
+                return False
+        self.hits += 1
+        if "nth" in w and self.hits != w["nth"]:
+            return False
+        if "after" in w and self.hits <= w["after"]:
+            return False
+        if "times" in w and self.fired >= w["times"]:
+            return False
+        if "prob" in w and self.rng.random() >= w["prob"]:
+            return False
+        self.fired += 1
+        return True
+
+
+def _parse_rule(text: str, seed: int) -> _Rule:
+    parts = text.split(":", 1)
+    if len(parts) != 2 or not parts[0]:
+        raise ValueError(f"bad fault rule {text!r} (want point:action[:sched])")
+    point = parts[0].strip()
+    rest = parts[1]
+    # action may carry "(arg)"; the schedule follows the NEXT ":" — but an
+    # "if=" value can itself contain ":" (host:port), so split the schedule
+    # off first on the ":" that is outside parentheses
+    depth = 0
+    split_at = -1
+    for i, ch in enumerate(rest):
+        if ch == "(":
+            depth += 1
+        elif ch == ")":
+            depth -= 1
+        elif ch == ":" and depth == 0:
+            split_at = i
+            break
+    act_text = rest if split_at < 0 else rest[:split_at]
+    sched_text = "" if split_at < 0 else rest[split_at + 1:]
+    act_text = act_text.strip()
+    arg = None
+    if "(" in act_text:
+        if not act_text.endswith(")"):
+            raise ValueError(f"bad fault action {act_text!r}")
+        act_text, arg = act_text[:-1].split("(", 1)
+    action = act_text.strip()
+    if action not in _KNOWN_ACTIONS:
+        raise ValueError(f"unknown fault action {action!r} in {text!r}")
+    when: dict = {}
+    if sched_text:
+        for kv in sched_text.split(","):
+            kv = kv.strip()
+            if not kv:
+                continue
+            if kv == "once":
+                when["times"] = 1
+                continue
+            k, _, v = kv.partition("=")
+            k = k.strip()
+            if k == "if":
+                when["if"] = v.strip()
+            elif k == "prob":
+                when["prob"] = float(v)
+            elif k in ("nth", "after", "times"):
+                when[k] = int(v)
+            else:
+                raise ValueError(f"unknown fault schedule key {k!r} in {text!r}")
+    return _Rule(point, action, arg, when, seed)
+
+
+def configure(spec: str | None) -> None:
+    """(Re)install the fault schedule from a spec string ("" disables).
+
+    Raises ValueError on a malformed spec — a chaos run silently running
+    with no faults armed would report false-green invariants."""
+    global ENABLED, _seed
+    rules: dict[str, list[_Rule]] = {}
+    seed = 0
+    texts = []
+    for part in (spec or "").split(";"):
+        part = part.strip()
+        if not part:
+            continue
+        if part.startswith("seed="):
+            seed = int(part[5:])
+        else:
+            texts.append(part)
+    with _lock:
+        _seed = seed
+        for t in texts:
+            r = _parse_rule(t, seed)
+            rules.setdefault(r.point, []).append(r)
+        _rules.clear()
+        _rules.update(rules)
+        _fired.clear()
+        ENABLED = bool(rules)
+
+
+def reset() -> None:
+    """Disable injection and clear rules + the fired log."""
+    configure("")
+
+
+def fire(point: str, **ctx) -> tuple[str, str | None] | None:
+    """Hook entry: evaluate `point`'s rules against this call.
+
+    Raising actions (fail/enospc/io_error) raise FaultInjected/OSError,
+    delay sleeps, crash exits the process. Site-implemented actions
+    (torn/drop) return ``(action, arg)`` for the caller to perform;
+    returns None when nothing fires."""
+    if not ENABLED:
+        return None
+    with _lock:
+        rules = _rules.get(point)
+        if not rules:
+            return None
+        hit = None
+        for r in rules:
+            if r.check(ctx):
+                hit = r
+                _fired.append((point, r.action, r.hits))
+                break
+        if hit is None:
+            return None
+        action, arg = hit.action, hit.arg
+    # execute OUTSIDE the lock: delay must not serialize unrelated points
+    if action == "fail":
+        raise FaultInjected(f"injected fail at {point}")
+    if action == "enospc":
+        raise FaultInjected(_errno.ENOSPC, f"injected ENOSPC at {point}")
+    if action == "io_error":
+        raise FaultInjected(_errno.EIO, f"injected EIO at {point}")
+    if action == "delay":
+        time.sleep(float(arg or 10) / 1e3)
+        return None
+    if action == "crash":
+        os._exit(137)
+    return (action, arg)
+
+
+def fired_log() -> list[tuple[str, str, int]]:
+    """The (point, action, hit#) sequence fired so far — the determinism
+    witness: same spec + same workload ⇒ same log."""
+    with _lock:
+        return list(_fired)
+
+
+def control(payload: dict) -> dict:
+    """Runtime control handler behind the `_faults` RPC method (armed only
+    when CNOSDB_FAULTS is present in the process environment):
+
+      {"spec": "<schedule>"}  reconfigure ("" disables)
+      {"log": true}           return the fired log
+    """
+    out: dict = {"ok": True}
+    if "spec" in payload:
+        configure(payload["spec"] or "")
+        out["enabled"] = ENABLED
+    if payload.get("log"):
+        out["log"] = [list(t) for t in fired_log()]
+    return out
+
+
+# Arm from the environment at import: harness-spawned subprocesses inherit
+# the parent's CNOSDB_FAULTS and come up with the same schedule.
+if CTL_ARMED:
+    configure(os.environ.get("CNOSDB_FAULTS", ""))

@@ -13,6 +13,7 @@ and a scan that fails on the leader's node fails over to follower replicas
 """
 from __future__ import annotations
 
+import os
 import threading
 import time
 from dataclasses import dataclass, field
@@ -20,12 +21,20 @@ from dataclasses import dataclass, field
 import numpy as np
 
 from ..errors import CoordinatorError
+from ..utils.backoff import Backoff
 from ..models.points import SeriesRows, WriteBatch
 from ..models.predicate import ColumnDomains, TimeRanges
 from ..models.schema import TskvTableSchema, ValueType
 from ..storage.engine import TsKv
 from ..storage.scan import ScanBatch, scan_vnode
 from .meta import MetaStore
+
+# Per-node circuit breaker: after CB_THRESHOLD consecutive connection-level
+# failures, calls to that node fast-fail for CB_COOLDOWN seconds instead of
+# each eating a full RPC timeout (a dead peer would otherwise stall every
+# split of every scan). One probe per cooldown window re-tests the node.
+CB_THRESHOLD = int(os.environ.get("CNOSDB_CB_THRESHOLD", "3"))
+CB_COOLDOWN = float(os.environ.get("CNOSDB_CB_COOLDOWN", "2.0"))
 
 
 @dataclass
@@ -80,14 +89,45 @@ class Coordinator:
         # lock-guarded: executor/HTTP threads record concurrently
         self._usage_last: dict = {}
         self._usage_lock = threading.Lock()
+        # circuit breaker: node_id → [consecutive_failures, open_until]
+        self._cb: dict = {}
+        self._cb_lock = threading.Lock()
 
     def _rpc(self, node_id: int, method: str, payload: dict):
-        from .net import RpcUnavailable, rpc_call
+        from .net import RpcError, RpcUnavailable, rpc_call
 
         addr = self.meta.node_addr(node_id)
         if not addr:
             raise RpcUnavailable(f"node {node_id} has no address")
-        return rpc_call(addr, method, payload)
+        now = time.monotonic()
+        with self._cb_lock:
+            st = self._cb.get(node_id)
+            if st is not None and st[0] >= CB_THRESHOLD:
+                if now < st[1]:
+                    raise RpcUnavailable(
+                        f"{method}@node {node_id}: circuit open after "
+                        f"{st[0]} consecutive failures "
+                        f"(probe in {st[1] - now:.1f}s)")
+                # half-open: this call is the single probe; keep the
+                # circuit closed to everyone else until it resolves
+                st[1] = now + CB_COOLDOWN
+        try:
+            reply = rpc_call(addr, method, payload)
+        except RpcUnavailable:
+            with self._cb_lock:
+                st = self._cb.setdefault(node_id, [0, 0.0])
+                st[0] += 1
+                if st[0] >= CB_THRESHOLD:
+                    st[1] = time.monotonic() + CB_COOLDOWN
+            raise
+        except RpcError:
+            # app-level rejection: the node answered, so it is alive
+            with self._cb_lock:
+                self._cb.pop(node_id, None)
+            raise
+        with self._cb_lock:
+            self._cb.pop(node_id, None)
+        return reply
 
     def _on_meta_event(self, event: str, payload: dict):
         if event == "update_vnode":
@@ -316,6 +356,7 @@ class Coordinator:
         from .raft import NotLeader
 
         deadline = time.monotonic() + timeout
+        bo = Backoff(initial=0.05, cap=1.0)
         hint_vnode: int | None = None
         last_err = None
         has_local = any(v.node_id == self.node_id for v in rs.vnodes)
@@ -348,7 +389,8 @@ class Coordinator:
                 if r.get("ok"):
                     return r.get("index")
                 hint_vnode = r.get("hint")
-            time.sleep(0.1)
+            if not bo.sleep(deadline):
+                break
         raise CoordinatorError(
             f"no reachable leader for replica set {rs.id} of {owner}"
         ) from last_err
@@ -363,6 +405,7 @@ class Coordinator:
         from .raft import NotLeader
 
         deadline = time.monotonic() + timeout
+        bo = Backoff(initial=0.05, cap=1.0)
         hint_vnode: int | None = None
         last_err = None
         has_local = not self.distributed or \
@@ -398,7 +441,8 @@ class Coordinator:
                     if r.get("ok"):
                         return r.get("index")
                     hint_vnode = r.get("hint")
-            time.sleep(0.1)
+            if not bo.sleep(deadline):
+                break
         raise CoordinatorError(
             f"membership change failed for replica set {rs.id} of {owner}"
         ) from last_err
@@ -679,7 +723,8 @@ class Coordinator:
         from .net import RpcError, RpcUnavailable
 
         targets = [(split.vnode_id, split.node_id)] + list(split.alternates)
-        last_err = None
+        last_unreach = None
+        last_reject = None
         for vnode_id, node_id in targets:
             if node_id == self.node_id:
                 if self.engine.vnode(split.owner, vnode_id) is None:
@@ -703,11 +748,11 @@ class Coordinator:
             except RpcUnavailable as e:
                 # connection-level failure only: an app-level RpcError
                 # (e.g. a memory-pool rejection) is not a broken replica
-                last_err = e
+                last_unreach = e
                 self._mark_vnode_broken(vnode_id)
                 continue
             except RpcError as e:
-                last_err = e
+                last_reject = e
                 continue
             if vnode_id in split.broken_ids:
                 self._clear_vnode_broken(vnode_id)  # it answered: self-heal
@@ -715,9 +760,18 @@ class Coordinator:
             if raw is None:
                 return None
             return decode_scan_batch(raw)
+        if last_reject is not None:
+            # at least one replica ANSWERED and rejected the scan — an
+            # app-level error, not an availability problem; its message is
+            # the actionable one (e.g. memory-pool rejection)
+            msg = (f"scan of vnode {split.vnode_id} of {split.owner} "
+                   f"rejected: {last_reject}")
+            if last_unreach is not None:
+                msg += f" (other replicas unreachable: {last_unreach})"
+            raise CoordinatorError(msg) from last_reject
         raise CoordinatorError(
             f"all replicas unreachable for vnode {split.vnode_id} "
-            f"of {split.owner}") from last_err
+            f"of {split.owner}") from last_unreach
 
     # ---------------------------------------------------------------- admin
     def drop_table(self, tenant: str, db: str, table: str):
@@ -892,6 +946,7 @@ class Coordinator:
         target = self._write_replicated(owner, rs, WalEntryType.RAFT_BLANK,
                                         b"", sync=False)
         deadline = time.monotonic() + timeout
+        bo = Backoff(initial=0.05, cap=1.0)
         while True:
             pr = self._replica_progress(owner, rs, vnode_id)
             if pr is not None and pr[0] >= target:
@@ -900,7 +955,7 @@ class Coordinator:
                 raise CoordinatorError(
                     f"{what} has not caught up (stays COPYING, unread; "
                     f"retry the admin op to re-check)")
-            time.sleep(0.1)
+            bo.sleep(deadline)
 
     def drop_replica(self, vnode_id: int):
         """REPLICA REMOVE: shrink the raft config via the leader (the

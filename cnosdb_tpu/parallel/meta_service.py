@@ -25,6 +25,7 @@ import threading
 import time
 
 from .. import errors as _errors
+from .. import faults
 from ..errors import CnosError, MetaError
 from ..models.meta_data import BucketInfo
 from ..models.schema import DatabaseSchema, TenantOptions, TskvTableSchema
@@ -119,9 +120,14 @@ class MetaStateMachine:
             with self.store.lock:
                 self._arm(req_id)
             return
+        method, kwargs, req_id = _mp.unpackb(entry.data, raw=False)
+        if faults.ENABLED:
+            # injected environmental failure: must fire BEFORE applied_index
+            # advances, so the raft apply loop's stall-and-retry re-executes
+            # this entry instead of skipping it as already-replayed
+            faults.fire("meta.apply", method=method, index=entry.index)
         with self.store.lock:
             self.store.applied_index = entry.index
-        method, kwargs, req_id = _mp.unpackb(entry.data, raw=False)
         if req_id in self._seen:
             # retried proposal whose first copy DID commit (propose timeout
             # or leadership change): applying twice would double-mutate.
@@ -373,6 +379,8 @@ class MetaService:
                     kwargs["at"] = time.time()
                 if method == "purge_trash" and kwargs.get("now") is None:
                     kwargs["now"] = time.time()
+                if faults.ENABLED:
+                    faults.fire("meta.propose", method=method)
                 try:
                     self.raft.propose(
                         1, _mp.packb([method, kwargs, req_id],

@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import hmac
 import http.client
+import logging
 import os
 import threading
 import time
@@ -26,7 +27,12 @@ from socket import timeout as socket_timeout
 
 import msgpack
 
+from .. import faults
 from ..errors import CnosError
+from ..utils import stages
+from ..utils.backoff import Backoff
+
+log = logging.getLogger("cnosdb.rpc")
 
 # Intra-cluster shared secret (CNOSDB_CLUSTER_SECRET): when set, every RPC
 # must carry it — the plane exposes destructive admin and file-installing
@@ -65,6 +71,10 @@ class RpcServer:
 
     def __init__(self, host: str, port: int, handlers: dict):
         self.handlers = dict(handlers)
+        if faults.CTL_ARMED:
+            # runtime fault control for chaos harnesses — only exposed when
+            # the process was launched with CNOSDB_FAULTS in its environment
+            self.handlers.setdefault("_faults", faults.control)
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -90,11 +100,25 @@ class RpcServer:
                 from ..server.trace import GLOBAL_COLLECTOR
 
                 try:
-                    with GLOBAL_COLLECTOR.from_headers(
-                            self.headers, f"rpc:{method}"):
-                        reply = fn(unpack(body) if body else {})
+                    if faults.ENABLED:
+                        # fail/delay/crash before dispatch (server-side fault)
+                        faults.fire("rpc.server", method=method)
+                    with stages.stage(f"rpc_{method}_ms"):
+                        with GLOBAL_COLLECTOR.from_headers(
+                                self.headers, f"rpc:{method}"):
+                            reply = fn(unpack(body) if body else {})
+                    if faults.ENABLED and faults.fire("rpc.reply",
+                                                      method=method):
+                        # injected lost ack: the handler HAS applied the
+                        # mutation; drop the reply so the client sees a
+                        # response-phase failure (net.py retry policy must
+                        # not re-execute it)
+                        self.close_connection = True
+                        return
                     self._reply(200, pack(reply))
                 except Exception as e:  # propagate to caller, keep serving
+                    stages.count_error(f"rpc.{method}")
+                    log.debug("rpc handler %s failed", method, exc_info=True)
                     self._reply(500, pack({"_err": type(e).__name__,
                                            "_msg": str(e)}))
 
@@ -178,6 +202,13 @@ def rpc_call(addr: str, method: str, payload: dict | None = None,
     tid = current_trace_header()
     if tid:
         hdrs[TRACE_HEADER] = tid
+    if faults.ENABLED:
+        try:
+            # simulated network partition toward (addr, method): checked
+            # once per call, before any bytes move — the peer never sees it
+            faults.fire("rpc.send", addr=addr, method=method)
+        except faults.FaultInjected as e:
+            raise RpcUnavailable(f"{method}@{addr}: {e}") from e
     for attempt in range(_ConnPool.MAX_IDLE_PER_ADDR + 1):
         conn, reused = _pool.get(addr, timeout)
         conn.timeout = timeout
@@ -195,6 +226,11 @@ def rpc_call(addr: str, method: str, payload: dict | None = None,
                 continue
             raise RpcUnavailable(f"{method}@{addr}: {e}") from e
         try:
+            if faults.ENABLED:
+                # reply lost in the network AFTER the server applied the
+                # request — FaultInjected is an OSError, so it takes the
+                # never-retry response-phase path below like a real loss
+                faults.fire("rpc.response", addr=addr, method=method)
             resp = conn.getresponse()
             raw = resp.read()
             reply = unpack(raw) if raw else {}
@@ -217,12 +253,19 @@ def rpc_call(addr: str, method: str, payload: dict | None = None,
 
 
 def wait_rpc_ready(addr: str, method: str = "ping", timeout: float = 10.0):
-    """Poll until a peer answers (process start-up races in harnesses)."""
-    deadline = time.monotonic() + timeout
+    """Poll until a peer answers (process start-up races in harnesses).
+
+    Jittered exponential backoff instead of a fixed 50 ms spin: N nodes
+    waiting on the same meta service otherwise hammer it in lockstep."""
+    start = time.monotonic()
+    deadline = start + timeout
+    bo = Backoff(initial=0.02, cap=0.5)
     while True:
         try:
             return rpc_call(addr, method, {}, timeout=2.0)
-        except RpcError:
-            if time.monotonic() > deadline:
-                raise
-            time.sleep(0.05)
+        except RpcError as e:
+            if time.monotonic() > deadline or not bo.sleep(deadline):
+                elapsed = time.monotonic() - start
+                raise RpcUnavailable(
+                    f"{method}@{addr} not ready after {elapsed:.1f}s "
+                    f"(last error: {e})") from e

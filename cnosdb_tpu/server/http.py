@@ -624,6 +624,15 @@ class HttpServer:
         return web.json_response({"data": data, "total": len(data)})
 
     async def handle_metrics(self, request):
+        from ..utils import stages
+
+        # fold the always-on failure counters (RPC handler errors etc.) in
+        # as gauges at render time — set_gauge is idempotent, so repeated
+        # scrapes see the current cumulative totals
+        for name, n in stages.errors_snapshot().items():
+            area, _, what = name.partition(".")
+            self.metrics.set_gauge("cnosdb_errors_total", n,
+                                   area=area, kind=what or area)
         return web.Response(text=self.metrics.prometheus_text(),
                             content_type="text/plain")
 

@@ -35,8 +35,8 @@ import threading
 import zlib
 
 import numpy as np
-import zstandard
 
+from ..utils.zstd_compat import zstandard
 from ..errors import CodecError
 from ..models.codec import Encoding
 from ..models.schema import ValueType

@@ -15,6 +15,7 @@ from dataclasses import dataclass
 
 import numpy as np
 
+from .. import faults
 from ..models.schema import ValueType
 from ..models.codec import Encoding
 from ..models.strcol import DictArray, as_dict_part as _as_dict_part, \
@@ -163,6 +164,9 @@ def run_compaction(version: Version, req: CompactReq, out_file_id: int,
     files (the reference bounds per-level file size the same way,
     kv_option.rs level_max_file_size; without the bound every L0 round
     rewrites the whole level: O(n²) ingest amplification)."""
+    if faults.ENABLED:
+        faults.fire("compaction.run", out_file_id=out_file_id,
+                    level=req.target_level)
     # priority must match scan._series_parts: higher level = older data =
     # lower priority (L4..L1 then L0), ascending file_id within a level.
     # Readers/tombstones come from the Version caches; Version._apply evicts

@@ -9,6 +9,7 @@ from __future__ import annotations
 
 import os
 
+from .. import faults
 from ..models.schema import TskvTableSchema, ValueType
 from ..models.codec import Encoding
 from .memcache import MemCache
@@ -22,6 +23,8 @@ def flush_memcache(cache: MemCache, file_id: int, path: str,
     the cache was empty)."""
     if cache.is_empty:
         return None
+    if faults.ENABLED:
+        faults.fire("flush.run", path=path, file_id=file_id)
     os.makedirs(os.path.dirname(path), exist_ok=True)
     w = TsmWriter(path)
     n_series = 0

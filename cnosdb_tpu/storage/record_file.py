@@ -13,16 +13,50 @@ import struct
 import zlib
 from typing import Iterator
 
+from .. import faults
 from ..errors import StorageError
 
 FILE_MAGIC = b"CNOSREC1"
 _HDR = struct.Struct("<II")
 
 
+def _valid_prefix_len(path: str) -> int:
+    """Byte length of the longest valid [magic + records] prefix, 0 when
+    the magic itself is unreadable."""
+    with open(path, "rb") as f:
+        buf = f.read()
+    if buf[:len(FILE_MAGIC)] != FILE_MAGIC:
+        return 0
+    off = len(FILE_MAGIC)
+    n = len(buf)
+    while off + _HDR.size <= n:
+        ln, crc = _HDR.unpack_from(buf, off)
+        end = off + _HDR.size + ln
+        if end > n or zlib.crc32(buf[off + _HDR.size:end]) != crc:
+            break
+        off = end
+    return off
+
+
 class RecordWriter:
     def __init__(self, path: str):
         self.path = path
         exists = os.path.exists(path) and os.path.getsize(path) >= len(FILE_MAGIC)
+        if exists:
+            # Crash recovery: a torn tail (partial record from an
+            # interrupted write) must be truncated BEFORE appending —
+            # readers stop at the tear, so anything appended after it
+            # would be durably written yet invisible to replay.
+            valid = _valid_prefix_len(path)
+            if valid and valid < os.path.getsize(path):
+                with open(path, "r+b") as f:
+                    f.truncate(valid)
+        elif os.path.exists(path):
+            # shorter than the magic: a segment creation that died
+            # mid-header — restart it from scratch rather than appending
+            # the magic after garbage
+            with open(path, "r+b") as f:
+                f.truncate(0)
         self._f = open(path, "ab")
         if not exists:
             self._f.write(FILE_MAGIC)
@@ -31,11 +65,24 @@ class RecordWriter:
     def append(self, payload: bytes) -> int:
         """Append one record, return its file offset."""
         off = self._f.tell()
-        self._f.write(_HDR.pack(len(payload), zlib.crc32(payload)))
-        self._f.write(payload)
+        rec = _HDR.pack(len(payload), zlib.crc32(payload)) + payload
+        if faults.ENABLED:
+            hit = faults.fire("record.append", path=self.path)
+            if hit and hit[0] == "torn":
+                # crash mid-write: leave a truncated record on disk and die
+                # the way the kernel would — readers must stop at the tear
+                cut = min(int(hit[1]) if hit[1] else max(1, len(rec) // 2),
+                          len(rec))
+                self._f.write(rec[:len(rec) - cut])
+                self._f.flush()
+                raise faults.FaultInjected(
+                    f"injected torn write ({cut}B short) at {self.path}")
+        self._f.write(rec)
         return off
 
     def sync(self):
+        if faults.ENABLED:
+            faults.fire("record.sync", path=self.path)
         self._f.flush()
         os.fsync(self._f.fileno())
 

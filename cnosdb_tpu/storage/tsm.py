@@ -31,9 +31,9 @@ from dataclasses import dataclass, field
 
 import msgpack
 import numpy as np
-import zstandard
 
 from ..errors import TsmError, ChecksumMismatch
+from ..utils.zstd_compat import zstandard
 from ..models.codec import Encoding
 from ..models.schema import ValueType
 from ..models.strcol import DictArray

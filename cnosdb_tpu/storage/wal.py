@@ -20,6 +20,7 @@ import re
 import struct
 from dataclasses import dataclass
 
+from .. import faults
 from ..errors import WalError
 from .record_file import RecordReader, RecordWriter
 
@@ -121,6 +122,8 @@ class Wal:
         self._writer = RecordWriter(self._seg_path(self._segments[-1]))
 
     def _roll(self):
+        if faults.ENABLED:
+            faults.fire("wal.roll", dir=self.dir)
         self._writer.close()
         self._persist_tail_marker()
         self._segments.append(self._segments[-1] + 1)
@@ -144,6 +147,9 @@ class Wal:
         elif seq < self._next_seq:
             # raft log truncation-on-conflict: drop tail entries >= seq first
             self.truncate_from(seq)
+        if faults.ENABLED:
+            faults.fire("wal.append", dir=self.dir, seq=seq,
+                        entry_type=entry_type)
         e = WalEntry(seq, entry_type, data, term)
         self._writer.append(e.encode())
         if self.sync_on_append:
@@ -154,6 +160,8 @@ class Wal:
         return seq
 
     def sync(self):
+        if faults.ENABLED:
+            faults.fire("wal.sync", dir=self.dir)
         if self._writer:
             self._writer.sync()
 

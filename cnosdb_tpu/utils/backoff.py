@@ -1,0 +1,55 @@
+"""Jittered exponential backoff for retry loops.
+
+Replaces the fixed ``time.sleep(0.05)`` / ``time.sleep(0.1)`` spins in the
+coordinator write/membership retry loops and ``wait_rpc_ready`` — fixed
+delays synchronize retries across callers (thundering herd on a recovering
+leader) and either burn CPU (too short) or stretch failover latency (too
+long). Full jitter per AWS architecture-blog guidance: each delay is drawn
+uniformly from ``[0, min(cap, initial * factor**attempt)]``.
+"""
+from __future__ import annotations
+
+import random
+import time
+
+
+class Backoff:
+    """One retry loop's backoff state.
+
+    >>> bo = Backoff(initial=0.05, cap=2.0)
+    >>> while not done():
+    ...     if not bo.sleep(deadline):
+    ...         raise TimeoutError(...)
+    """
+
+    def __init__(self, initial: float = 0.05, cap: float = 2.0,
+                 factor: float = 2.0, rng: random.Random | None = None):
+        self.initial = initial
+        self.cap = cap
+        self.factor = factor
+        self.attempt = 0
+        self._rng = rng or random
+
+    def reset(self) -> None:
+        """Back to the initial delay (call after a success mid-loop)."""
+        self.attempt = 0
+
+    def next(self) -> float:
+        """The next delay (seconds), advancing the attempt counter."""
+        ceiling = min(self.cap, self.initial * (self.factor ** self.attempt))
+        self.attempt += 1
+        return self._rng.uniform(0.0, ceiling) if ceiling > 0 else 0.0
+
+    def sleep(self, deadline: float | None = None) -> bool:
+        """Sleep the next delay, clamped to ``deadline`` (``time.monotonic``
+        basis). Returns False iff the deadline has already passed — the
+        caller should stop retrying."""
+        d = self.next()
+        if deadline is not None:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                return False
+            d = min(d, remaining)
+        if d > 0:
+            time.sleep(d)
+        return True

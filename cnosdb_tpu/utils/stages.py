@@ -22,6 +22,10 @@ _lock = threading.Lock()
 _enabled = False
 _ms: dict[str, float] = {}
 _counts: dict[str, int] = {}
+# Error counters are ALWAYS on (unlike timing stages): a swallowed RPC
+# handler exception with no counter is invisible in production. Keyed
+# "area.method" (e.g. "rpc.write_replica"); surfaced via /metrics.
+_errors: dict[str, int] = {}
 
 
 def enable(flag: bool = True) -> None:
@@ -33,6 +37,7 @@ def reset() -> None:
     with _lock:
         _ms.clear()
         _counts.clear()
+        _errors.clear()
 
 
 def snapshot() -> dict:
@@ -47,6 +52,17 @@ def count(name: str, n: int = 1) -> None:
         return
     with _lock:
         _counts[name] = _counts.get(name, 0) + n
+
+
+def count_error(name: str, n: int = 1) -> None:
+    """Always-on failure counter (not gated on enable())."""
+    with _lock:
+        _errors[name] = _errors.get(name, 0) + n
+
+
+def errors_snapshot() -> dict[str, int]:
+    with _lock:
+        return dict(sorted(_errors.items()))
 
 
 @contextmanager

@@ -1,0 +1,34 @@
+"""zstandard import gate with a zlib fallback.
+
+The storage layer (tsm.py, codecs.py) wants zstd, but the dependency may
+be absent in slim environments. Rather than failing at import (which
+takes the whole engine — and every test that touches it — down), fall
+back to zlib behind the same two-class API surface the callers use.
+
+The fallback is NOT wire-compatible with real zstd: files written with
+one cannot be read with the other. That is fine for self-contained
+deployments/tests (the only situation where zstandard is missing); the
+chosen codec is a process-lifetime constant, so a single store never
+mixes frames.
+"""
+from __future__ import annotations
+
+try:
+    import zstandard
+except ImportError:  # slim environment: gate, don't crash the engine
+    import zlib as _zlib
+
+    class _Compressor:
+        def __init__(self, level: int = 3):
+            self._level = min(max(int(level), 1), 9)
+
+        def compress(self, data: bytes) -> bytes:
+            return _zlib.compress(data, self._level)
+
+    class _Decompressor:
+        def decompress(self, data: bytes) -> bytes:
+            return _zlib.decompress(data)
+
+    class zstandard:  # type: ignore[no-redef]  # namespace stand-in
+        ZstdCompressor = _Compressor
+        ZstdDecompressor = _Decompressor

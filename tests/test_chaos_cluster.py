@@ -1,0 +1,181 @@
+"""Multi-process chaos soak (slow-marked, excluded from tier-1).
+
+Drives the deterministic fault plane (cnosdb_tpu/faults.py) against a real
+3-node cluster: every data-node subprocess inherits CNOSDB_FAULTS from the
+harness env, which arms the `_faults` runtime-control RPC; the tests then
+install per-node schedules (partitions, crashes) and assert the headline
+invariants:
+
+- no acknowledged write is lost across a leader partition + re-election
+- an injected crash (os._exit inside the RPC server) behaves like a power
+  loss: the cluster keeps serving on the majority and the node catches up
+  after restart
+- scans fail over to replica alternates when the primary's node is
+  unreachable, and self-heal once the partition lifts
+"""
+import os
+import time
+
+import pytest
+
+from cluster_harness import Cluster
+from cnosdb_tpu.parallel.net import RpcError, rpc_call
+
+pytestmark = [pytest.mark.slow, pytest.mark.cluster]
+
+
+@pytest.fixture(scope="module")
+def cluster(tmp_path_factory):
+    # Arm the fault-control plane in every spawned node: CNOSDB_FAULTS in
+    # the inherited env (no rules yet — seed only) sets faults.CTL_ARMED in
+    # each subprocess, exposing the `_faults` RPC. The test process itself
+    # imported cnosdb_tpu.faults long ago with the var unset, so its own
+    # RPC client stays injection-free.
+    os.environ["CNOSDB_FAULTS"] = "seed=1"
+    try:
+        c = Cluster(str(tmp_path_factory.mktemp("chaos")), n_nodes=3).start()
+    finally:
+        del os.environ["CNOSDB_FAULTS"]
+    yield c
+    c.stop()
+
+
+def _set_faults(node, spec: str) -> dict:
+    return rpc_call(f"127.0.0.1:{node.rpc_port}", "_faults",
+                    {"spec": spec}, timeout=5.0)
+
+
+def _csv_rows(out: str) -> list[list[str]]:
+    lines = [l for l in out.strip().splitlines() if l]
+    return [l.split(",") for l in lines[1:]]
+
+
+def _count(node, table, db) -> int:
+    rows = _csv_rows(node.sql(f"SELECT count(*) FROM {table}", db=db))
+    return int(rows[0][0]) if rows else 0
+
+
+def _wait_count(node, table, db, expect, timeout=30.0):
+    deadline = time.monotonic() + timeout
+    got = -1
+    while time.monotonic() < deadline:
+        try:
+            got = _count(node, table, db)
+            if got == expect:
+                return got
+        except Exception:
+            pass
+        time.sleep(0.3)
+    return got
+
+
+def test_fault_control_plane_is_armed(cluster):
+    for n in cluster.nodes:
+        out = _set_faults(n, "")
+        assert out["ok"] and out["enabled"] is False
+
+
+def test_no_acked_write_lost_across_partition_and_reelection(cluster):
+    """Partition each node in turn (so one round provably isolates the
+    raft leader), keep writing acked batches through the healthy majority,
+    then heal — every acknowledged write must be readable everywhere."""
+    n1 = cluster.nodes[0]
+    n1.sql("CREATE DATABASE dpart WITH SHARD 1 REPLICA 3", db="public")
+    base = 1_700_000_000_000_000_000
+    total = 0
+
+    def write_batch(writer, k):
+        nonlocal total
+        lines = "\n".join(
+            f"pw,host=h{i % 4} v={i} {base + (total + i) * 1_000}"
+            for i in range(k))
+        writer.write_lp(lines, db="dpart")  # raising == not acked
+        total += k
+
+    write_batch(n1, 20)
+    assert _wait_count(n1, "pw", "dpart", total) == total
+
+    for victim in cluster.nodes:
+        healthy = [n for n in cluster.nodes if n is not victim]
+        # isolate `victim` at the RPC layer, both directions: it cannot
+        # send to anyone, and the others cannot send to it
+        _set_faults(victim, "rpc.send:fail")
+        for n in healthy:
+            _set_faults(n, f"rpc.send:fail:if=127.0.0.1:{victim.rpc_port}")
+        try:
+            # acked writes through the healthy majority; if the victim was
+            # the leader this forces a re-election first (write_lp blocks
+            # until the write is durably committed or raises)
+            write_batch(healthy[0], 20)
+        finally:
+            for n in cluster.nodes:
+                _set_faults(n, "")
+        assert _wait_count(healthy[1], "pw", "dpart", total,
+                           timeout=60.0) == total
+
+    # after the last heal every node (including every ex-victim) converges
+    for n in cluster.nodes:
+        assert _wait_count(n, "pw", "dpart", total, timeout=90.0) == total
+
+
+def test_injected_crash_and_catchup(cluster):
+    """The crash action is a real os._exit inside the node's RPC server —
+    indistinguishable from power loss. Majority keeps serving; the crashed
+    node restarts, recovers its WAL, and catches up."""
+    n1, n2, n3 = cluster.nodes
+    n1.sql("CREATE DATABASE dcrash WITH SHARD 1 REPLICA 3", db="public")
+    base = 1_700_000_000_000_000_000
+    lines = "\n".join(
+        f"cr,host=h{i % 4} v={i} {base + i * 1_000}" for i in range(30))
+    n1.write_lp(lines, db="dcrash")
+    assert _wait_count(n1, "cr", "dcrash", 30) == 30
+
+    # the arming request installs the rule AFTER its own rpc.server hook
+    # ran, so the NEXT _faults call is the one that dies mid-serve
+    _set_faults(n3, "rpc.server:crash:once,if=_faults")
+    with pytest.raises(Exception):
+        _set_faults(n3, "")
+    deadline = time.monotonic() + 15.0
+    while n3.proc.poll() is None and time.monotonic() < deadline:
+        time.sleep(0.1)
+    assert n3.proc.poll() == 137  # the injected exit code
+    n3.proc = None
+
+    # majority continues to accept acked writes while n3 is down
+    more = "\n".join(
+        f"cr,host=h{i % 4} v={i} {base + (30 + i) * 1_000}"
+        for i in range(30))
+    n1.write_lp(more, db="dcrash")
+    assert _wait_count(n2, "cr", "dcrash", 60) == 60
+
+    n3.start().wait_ready(timeout=90.0)
+    assert _wait_count(n3, "cr", "dcrash", 60, timeout=90.0) == 60
+
+
+def test_scan_failover_to_alternates_and_self_heal(cluster):
+    """With the querying node partitioned from its peers, scans must be
+    served entirely from local replicas (remote primaries fail over down
+    the alternate list); lifting the partition restores remote scanning
+    and self-heals any replicas marked broken along the way."""
+    n1 = cluster.nodes[0]
+    n1.sql("CREATE DATABASE dscan WITH SHARD 1 REPLICA 3", db="public")
+    base = 1_700_000_000_000_000_000
+    lines = "\n".join(
+        f"sc,host=h{i % 4} v={i} {base + i * 1_000}" for i in range(40))
+    n1.write_lp(lines, db="dscan")
+    assert _wait_count(n1, "sc", "dscan", 40) == 40
+
+    others = [n for n in cluster.nodes if n is not n1]
+    spec = ";".join(f"rpc.send:fail:if=127.0.0.1:{n.rpc_port}"
+                    for n in others)
+    _set_faults(n1, spec)
+    try:
+        # every remote target is unreachable from n1: REPLICA 3 guarantees
+        # a local alternate, so the scan must still return everything
+        assert _wait_count(n1, "sc", "dscan", 40, timeout=30.0) == 40
+    finally:
+        _set_faults(n1, "")
+    # healed: scans keep answering (and broken marks self-heal on success)
+    assert _wait_count(n1, "sc", "dscan", 40, timeout=30.0) == 40
+    out = _set_faults(n1, "")
+    assert out["ok"]

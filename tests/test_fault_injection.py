@@ -1,0 +1,369 @@
+"""Fast (single-process) fault-injection suite.
+
+Exercises the deterministic fault plane (cnosdb_tpu/faults.py) and the
+invariants it exists to prove: schedule determinism, at-most-once RPC
+apply under lost replies, WAL torn-tail truncation on recovery, server
+error counters, and the coordinator circuit breaker / backoff hardening.
+The multi-process partition/crash soak lives in test_chaos_cluster.py
+(slow-marked).
+"""
+import threading
+import time
+
+import pytest
+
+from cnosdb_tpu import faults
+from cnosdb_tpu.parallel.net import (RpcError, RpcServer, RpcUnavailable,
+                                     rpc_call, wait_rpc_ready)
+from cnosdb_tpu.storage.record_file import RecordReader, RecordWriter
+from cnosdb_tpu.storage.wal import Wal, WalEntryType
+from cnosdb_tpu.utils import stages
+from cnosdb_tpu.utils.backoff import Backoff
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    yield
+    faults.reset()
+    stages.reset()
+
+
+# ------------------------------------------------------------- schedule plane
+def test_disabled_by_default_zero_overhead():
+    assert faults.ENABLED is False
+    assert faults.fire("rpc.send", addr="x") is None
+
+
+def test_schedule_nth_times_if():
+    faults.configure("wal.append:fail:nth=2")
+    assert faults.fire("wal.append", dir="d") is None
+    with pytest.raises(faults.FaultInjected):
+        faults.fire("wal.append", dir="d")
+    assert faults.fire("wal.append", dir="d") is None
+
+    faults.configure("rpc.reply:drop:times=2,if=write")
+    assert faults.fire("rpc.reply", method="scan_vnode") is None
+    assert faults.fire("rpc.reply", method="write_replica") == ("drop", None)
+    assert faults.fire("rpc.reply", method="write_replica") == ("drop", None)
+    assert faults.fire("rpc.reply", method="write_replica") is None
+
+
+def test_schedule_after_and_args():
+    faults.configure("record.append:torn(4):after=2")
+    assert faults.fire("record.append", path="p") is None
+    assert faults.fire("record.append", path="p") is None
+    assert faults.fire("record.append", path="p") == ("torn", "4")
+    assert faults.fire("record.append", path="p") == ("torn", "4")
+
+
+def test_prob_schedule_is_deterministic():
+    """Same seed + same call sequence → identical firing sequence and
+    fired log, across reconfigurations (stands in for across processes:
+    the RNG is seeded from the spec text via crc32, not hash())."""
+    spec = "seed=42;flush.run:delay(1):prob=0.3;wal.sync:delay(1):prob=0.7"
+
+    def run():
+        faults.configure(spec)
+        for i in range(30):
+            faults.fire("flush.run", path=f"p{i}")
+            faults.fire("wal.sync", dir="d")
+        return faults.fired_log()
+
+    log1, log2 = run(), run()
+    assert log1 == log2
+    assert any(p == "flush.run" for p, _, _ in log1)
+    assert any(p == "wal.sync" for p, _, _ in log1)
+
+
+def test_different_seed_different_schedule():
+    logs = []
+    for seed in (1, 2):
+        faults.configure(f"seed={seed};flush.run:delay(1):prob=0.5")
+        for i in range(40):
+            faults.fire("flush.run", path=f"p{i}")
+        logs.append([h for _, _, h in faults.fired_log()])
+    assert logs[0] != logs[1]
+
+
+def test_malformed_spec_rejected():
+    with pytest.raises(ValueError):
+        faults.configure("wal.append:explode")
+    with pytest.raises(ValueError):
+        faults.configure("justapoint")
+    with pytest.raises(ValueError):
+        faults.configure("wal.append:fail:bogus=1")
+
+
+def test_control_surface():
+    out = faults.control({"spec": "wal.append:fail:once", "log": True})
+    assert out["ok"] and out["enabled"] and out["log"] == []
+    with pytest.raises(faults.FaultInjected):
+        faults.fire("wal.append", dir="d")
+    out = faults.control({"log": True})
+    assert out["log"] == [["wal.append", "fail", 1]]
+    out = faults.control({"spec": ""})
+    assert out["enabled"] is False
+
+
+# ------------------------------------------------------------------ RPC plane
+@pytest.fixture()
+def rpc_server():
+    calls = {"n": 0, "lock": threading.Lock()}
+
+    def apply_(payload):
+        with calls["lock"]:
+            calls["n"] += 1
+        return {"ok": True, "n": calls["n"]}
+
+    def boom(payload):
+        raise ValueError("handler exploded")
+
+    srv = RpcServer("127.0.0.1", 0, {"apply": apply_, "boom": boom,
+                                     "ping": lambda p: {"pong": True}})
+    srv.start()
+    yield srv, calls
+    srv.stop()
+
+
+def test_rpc_send_partition(rpc_server):
+    """rpc.send models a network partition toward (addr, method): the
+    client sees RpcUnavailable and the server never applies anything."""
+    srv, calls = rpc_server
+    faults.configure(f"rpc.send:fail:if={srv.addr}")
+    with pytest.raises(RpcUnavailable):
+        rpc_call(srv.addr, "apply", {})
+    assert calls["n"] == 0
+    # a different peer is unaffected by the if= filter
+    faults.configure("rpc.send:fail:if=9.9.9.9:1")
+    assert rpc_call(srv.addr, "apply", {})["ok"]
+    assert calls["n"] == 1
+
+
+def test_lost_reply_is_at_most_once(rpc_server):
+    """The net.py:204 lost-ack case: the server applies the mutation but
+    the reply is dropped. The client MUST see a response-phase failure and
+    MUST NOT auto-retry — exactly one apply happened."""
+    srv, calls = rpc_server
+    faults.configure("rpc.reply:drop:nth=1,if=apply")
+    with pytest.raises(RpcUnavailable):
+        rpc_call(srv.addr, "apply", {})
+    assert calls["n"] == 1  # applied exactly once despite the lost ack
+    # the plane recovered: a fresh call applies a second time
+    assert rpc_call(srv.addr, "apply", {})["n"] == 2
+
+
+def test_lost_response_client_side_at_most_once(rpc_server):
+    """rpc.response: reply lost in the network after the server processed
+    the request — same at-most-once contract, client-side injection."""
+    srv, calls = rpc_server
+    faults.configure(f"rpc.response:fail:once,if={srv.addr}")
+    with pytest.raises(RpcUnavailable):
+        rpc_call(srv.addr, "apply", {})
+    # the request was on the wire before the injected loss: the server
+    # finishes applying it asynchronously — wait, then assert exactly once
+    deadline = time.monotonic() + 5.0
+    while calls["n"] < 1 and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert calls["n"] == 1
+    assert rpc_call(srv.addr, "apply", {})["n"] == 2
+
+
+def test_server_fault_point_and_error_counter(rpc_server):
+    srv, _ = rpc_server
+    faults.configure("rpc.server:fail:nth=1,if=apply")
+    with pytest.raises(RpcError):
+        rpc_call(srv.addr, "apply", {})
+    # injected server-side failure and real handler errors both count
+    with pytest.raises(RpcError, match="handler exploded"):
+        rpc_call(srv.addr, "boom", {})
+    errs = stages.errors_snapshot()
+    assert errs.get("rpc.apply") == 1
+    assert errs.get("rpc.boom") == 1
+
+
+def test_wait_rpc_ready_reports_elapsed_and_cause():
+    t0 = time.monotonic()
+    with pytest.raises(RpcUnavailable) as ei:
+        wait_rpc_ready("127.0.0.1:1", timeout=0.4)
+    assert time.monotonic() - t0 < 5.0
+    msg = str(ei.value)
+    assert "not ready after" in msg and "last error" in msg
+    assert ei.value.__cause__ is not None
+
+
+# ------------------------------------------------------------------ WAL layer
+def test_wal_torn_final_record_recovery(tmp_path):
+    """Crash mid-append (torn tail): recovery keeps every entry before the
+    tear, truncates the tear, and post-recovery appends are replayable."""
+    d = str(tmp_path / "wal")
+    w = Wal(d)
+    for i in range(10):
+        w.append(WalEntryType.WRITE, f"w{i}".encode())
+    w.sync()
+    faults.configure("record.append:torn:nth=1")
+    with pytest.raises(faults.FaultInjected):
+        w.append(WalEntryType.WRITE, b"torn-victim")
+    faults.reset()
+    # the process "died" here: drop the handle without a clean close
+    w._writer._f.close()
+
+    w2 = Wal(d)
+    entries = list(w2.replay())
+    assert [e.seq for e in entries] == list(range(1, 11))
+    assert [e.data for e in entries] == [f"w{i}".encode() for i in range(10)]
+    assert w2.next_seq == 11
+    # the tear was truncated on reopen, so new appends stay replayable
+    s = w2.append(WalEntryType.WRITE, b"after-recovery")
+    assert s == 11
+    assert list(w2.replay())[-1].data == b"after-recovery"
+    w2.close()
+
+
+def test_wal_truncated_segment_header_recovery(tmp_path):
+    """Crash during segment creation leaves a file shorter than the magic;
+    reopening must restart that segment instead of appending after it."""
+    import os
+
+    d = str(tmp_path / "wal")
+    w = Wal(d, max_segment_size=128)
+    for i in range(10):
+        w.append(WalEntryType.WRITE, b"x" * 24)
+    w.close()
+    segs = sorted(f for f in os.listdir(d) if f.startswith("wal_"))
+    assert len(segs) > 1
+    # simulate the crash: newest segment died mid-header
+    newest = os.path.join(d, segs[-1])
+    with open(newest, "wb") as f:
+        f.write(b"CNO")
+    w2 = Wal(d)
+    before = [e.seq for e in w2.replay()]
+    s = w2.append(WalEntryType.WRITE, b"fresh")
+    assert [e.seq for e in w2.replay()] == before + [s]
+    assert list(w2.replay())[-1].data == b"fresh"
+    w2.close()
+
+
+def test_record_writer_truncates_torn_tail_on_reopen(tmp_path):
+    p = str(tmp_path / "r.log")
+    w = RecordWriter(p)
+    w.append(b"one")
+    w.append(b"two")
+    w.close()
+    import os
+    raw = open(p, "rb").read()
+    open(p, "wb").write(raw[:-2])  # tear the last record
+    w2 = RecordWriter(p)
+    w2.append(b"three")
+    w2.close()
+    assert RecordReader(p).records() == [b"one", b"three"]
+    # file holds no dead bytes: reported size equals the valid prefix
+    from cnosdb_tpu.storage.record_file import _valid_prefix_len
+    assert _valid_prefix_len(p) == os.path.getsize(p)
+
+
+def test_wal_sync_enospc_surfaces(tmp_path):
+    import errno
+
+    w = Wal(str(tmp_path / "wal"))
+    w.append(WalEntryType.WRITE, b"a")
+    faults.configure("wal.sync:enospc:once")
+    with pytest.raises(OSError) as ei:
+        w.sync()
+    assert ei.value.errno == errno.ENOSPC
+    w.sync()  # once: next sync succeeds
+    w.close()
+
+
+# ------------------------------------------------------- hardening primitives
+def test_backoff_grows_and_caps():
+    bo = Backoff(initial=0.1, cap=0.5, factor=2.0)
+    import random
+    bo._rng = random.Random(7)
+    delays = [bo.next() for _ in range(8)]
+    assert all(0.0 <= d <= 0.5 for d in delays)
+    # ceilings: 0.1, 0.2, 0.4, then capped at 0.5
+    assert delays[0] <= 0.1
+    bo.reset()
+    assert bo.attempt == 0
+
+
+def test_backoff_sleep_respects_deadline():
+    bo = Backoff(initial=10.0, cap=10.0)
+    assert bo.sleep(time.monotonic() - 1.0) is False  # already expired
+    t0 = time.monotonic()
+    assert bo.sleep(time.monotonic() + 0.05) is True  # clamped to 50ms
+    assert time.monotonic() - t0 < 1.0
+
+
+def test_circuit_breaker_fast_fails_then_probes(monkeypatch, tmp_path):
+    """After CB_THRESHOLD consecutive connection failures to a node, the
+    coordinator fast-fails without paying the RPC timeout, then re-probes
+    after the cooldown and closes the circuit on success."""
+    from cnosdb_tpu.parallel import coordinator as coord_mod
+    from cnosdb_tpu.parallel.coordinator import Coordinator
+
+    monkeypatch.setattr(coord_mod, "CB_THRESHOLD", 2)
+    monkeypatch.setattr(coord_mod, "CB_COOLDOWN", 0.15)
+
+    co = Coordinator.__new__(Coordinator)  # breaker state only, no engine
+    co._cb = {}
+    co._cb_lock = threading.Lock()
+    co.meta = type("M", (), {"node_addr": staticmethod(
+        lambda nid: "127.0.0.1:9")})()
+
+    calls = {"n": 0}
+    state = {"up": False}
+
+    def fake_rpc_call(addr, method, payload, timeout=10.0):
+        calls["n"] += 1
+        if not state["up"]:
+            raise RpcUnavailable(f"{method}@{addr}: down")
+        return {"ok": True}
+
+    monkeypatch.setattr(coord_mod, "rpc_call", fake_rpc_call,
+                        raising=False)
+    import cnosdb_tpu.parallel.net as net_mod
+    monkeypatch.setattr(net_mod, "rpc_call", fake_rpc_call)
+
+    for _ in range(2):
+        with pytest.raises(RpcUnavailable):
+            co._rpc(1, "ping", {})
+    assert calls["n"] == 2
+    # circuit now open: the wire is NOT touched
+    with pytest.raises(RpcUnavailable, match="circuit open"):
+        co._rpc(1, "ping", {})
+    assert calls["n"] == 2
+    # after the cooldown one probe goes through and closes the circuit
+    state["up"] = True
+    time.sleep(0.2)
+    assert co._rpc(1, "ping", {})["ok"]
+    assert calls["n"] == 3
+    assert co._rpc(1, "ping", {})["ok"]
+
+
+def test_rpc_error_does_not_trip_breaker(monkeypatch):
+    """An app-level rejection proves the peer is alive: it must reset the
+    consecutive-failure count, not add to it."""
+    from cnosdb_tpu.parallel import coordinator as coord_mod
+    from cnosdb_tpu.parallel.coordinator import Coordinator
+
+    monkeypatch.setattr(coord_mod, "CB_THRESHOLD", 2)
+    co = Coordinator.__new__(Coordinator)
+    co._cb = {}
+    co._cb_lock = threading.Lock()
+    co.meta = type("M", (), {"node_addr": staticmethod(
+        lambda nid: "127.0.0.1:9")})()
+
+    seq = [RpcUnavailable("down"), RpcError("rejected"),
+           RpcUnavailable("down"), RpcError("rejected")]
+
+    def fake_rpc_call(addr, method, payload, timeout=10.0):
+        raise seq.pop(0)
+
+    import cnosdb_tpu.parallel.net as net_mod
+    monkeypatch.setattr(net_mod, "rpc_call", fake_rpc_call)
+
+    for exc in (RpcUnavailable, RpcError, RpcUnavailable, RpcError):
+        with pytest.raises(exc):
+            co._rpc(1, "ping", {})
+    assert co._cb == {}  # never accumulated to the threshold

@@ -1,0 +1,42 @@
+"""Lint: no bare ``except:`` in the distributed/storage planes.
+
+A bare except swallows KeyboardInterrupt/SystemExit — in the RPC server
+and raft/WAL recovery paths that turns an operator Ctrl-C or an injected
+crash into a silently-ignored event and can mask real corruption. Use
+``except Exception`` (or narrower) so control-flow exceptions propagate.
+"""
+import ast
+import os
+
+import pytest
+
+import cnosdb_tpu
+
+_PKG_ROOT = os.path.dirname(cnosdb_tpu.__file__)
+_CHECKED_DIRS = ("parallel", "storage")
+
+
+def _py_files():
+    for sub in _CHECKED_DIRS:
+        root = os.path.join(_PKG_ROOT, sub)
+        for dirpath, _, names in os.walk(root):
+            for name in sorted(names):
+                if name.endswith(".py"):
+                    yield os.path.join(dirpath, name)
+
+
+@pytest.mark.parametrize("path", list(_py_files()),
+                         ids=lambda p: os.path.relpath(p, _PKG_ROOT))
+def test_no_bare_except(path):
+    with open(path, "r", encoding="utf-8") as f:
+        tree = ast.parse(f.read(), filename=path)
+    offenders = [node.lineno for node in ast.walk(tree)
+                 if isinstance(node, ast.ExceptHandler) and node.type is None]
+    assert not offenders, (
+        f"bare 'except:' at {os.path.relpath(path, _PKG_ROOT)}:"
+        f"{offenders} — catch 'Exception' (or narrower) instead")
+
+
+def test_checked_dirs_nonempty():
+    files = list(_py_files())
+    assert len(files) > 10, files  # the lint must actually cover the tree
