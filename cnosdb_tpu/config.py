@@ -79,6 +79,9 @@ class QueryConfig:
     auth_enabled: bool = False
     read_timeout_ms: int = 3_000_000
     write_timeout_ms: int = 3_000_000
+    # shared scan/decode pool widths (utils/executor.py); 0 = auto
+    scan_executor_threads: int = 0
+    decode_executor_threads: int = 0
 
 
 @dataclass
@@ -104,6 +107,9 @@ class WalConfig:
 class CacheConfig:
     max_buffer_size: int = 128 * 1024 * 1024
     partition: int = 0
+    # byte cap on the coordinator's scan-snapshot cache (sum of cached
+    # ScanBatch nbytes); entry count is capped separately
+    scan_cache_max_bytes: int = 1024 * 1024 * 1024
 
 
 @dataclass

@@ -11,8 +11,19 @@ only group parameters in and [num_segments] partials out.
 
 Invalidation: ScanBatches are immutable snapshots; the device arrays are
 attached to the batch object itself, and batches are cached per vnode
-data_version upstream (coordinator scan cache), so a write/flush/
-compaction naturally rotates both layers.
+snapshot token upstream (coordinator scan cache), so a write/flush/
+compaction naturally rotates both layers. Two pipeline hooks keep the
+COLD path off the critical PCIe+decode sum:
+
+  * EagerUploader — handed into storage/scan via `upload_hook`; each
+    field column device_puts as soon as its pages finish decoding, so
+    transfer overlaps the decode of the remaining columns. The staged
+    arrays ride along on the batch (`_preuploaded`) and DeviceBatch
+    reuses them instead of re-staging.
+  * merged_device_batch — after a delta rescan merged into a cached
+    batch (coordinator delta path), the merged twin is built by GATHERING
+    the unchanged columns from the cached twin on device; only the delta
+    rows cross the wire.
 """
 from __future__ import annotations
 
@@ -21,6 +32,7 @@ import numpy as np
 import jax
 
 from ..models.schema import ValueType
+from ..utils import stages
 from .kernels import pad_rows
 
 
@@ -40,6 +52,35 @@ class DeviceBatch:
                  "series_params")
 
     def __init__(self, batch):
+        with stages.stage("upload_ms"):
+            self._init_meta(batch)
+            pre = getattr(batch, "_preuploaded", None)
+            pre_cols = pre[1] if pre is not None and pre[0] == self.n_pad \
+                else {}
+            for name, (vt, vals, valid) in batch.fields.items():
+                if vt in (ValueType.STRING, ValueType.GEOMETRY):
+                    continue  # strings aggregate host-side
+                p = pre_cols.get(name)
+                if p is not None and p[0] == vt:
+                    # column staged by the scan's eager-upload pipeline
+                    _vt, dev_vals, dev_valid, all_valid = p
+                    self.field_all_valid[name] = all_valid
+                    self.fields[name] = (vt, dev_vals, dev_valid)
+                    continue
+                dev_vals = vals if vt != ValueType.BOOLEAN \
+                    else vals.astype(np.int64)
+                all_valid = bool(valid.all())
+                self.field_all_valid[name] = all_valid
+                self.fields[name] = (
+                    vt,
+                    _put(_pad_to(dev_vals, self.n_pad, 0)),
+                    None if all_valid
+                    else _put(_pad_to(valid, self.n_pad, False)),
+                )
+
+    def _init_meta(self, batch):
+        """Everything except the field columns: row counts, the i32
+        timestamp pair, series ordinals, lazy rank."""
         n = batch.n_rows
         self.n_rows = n
         self.n_pad = pad_rows(max(n, 1))
@@ -56,7 +97,8 @@ class DeviceBatch:
         # launches under the relay re-stream every passed buffer, so each
         # optional input is skipped (static kernel flag) when derivable:
         self.ns_all_zero = bool((ns == 0).all())   # second-aligned data
-        self.ts_ns = None if self.ns_all_zero else _put(_pad_to(ns, self.n_pad, 0))
+        self.ts_ns = None if self.ns_all_zero \
+            else _put(_pad_to(ns, self.n_pad, 0))
         # Regular-series fast path: when every series is a contiguous run
         # with a constant whole-second stride (the normal telemetry shape),
         # ship ONLY [n_series, 3] params (row_start, sec0, stride_s); the
@@ -92,22 +134,132 @@ class DeviceBatch:
         self.rank = None
         self.fields: dict[str, tuple[ValueType, object, object]] = {}
         self.field_all_valid: dict[str, bool] = {}
-        for name, (vt, vals, valid) in batch.fields.items():
-            if vt in (ValueType.STRING, ValueType.GEOMETRY):
-                continue  # strings aggregate host-side
-            dev_vals = vals if vt != ValueType.BOOLEAN else vals.astype(np.int64)
-            all_valid = bool(valid.all())
-            self.field_all_valid[name] = all_valid
-            self.fields[name] = (
-                vt,
-                _put(_pad_to(dev_vals, self.n_pad, 0)),
-                None if all_valid else _put(_pad_to(valid, self.n_pad, False)),
-            )
 
     def rank_dev(self):
         if self.rank is None:
             self.rank = _put(_pad_to(self._rank_np, self.n_pad, 0))
         return self.rank
+
+
+class EagerUploader:
+    """Receives finished scan columns from storage/scan's decode pipeline
+    and stages them on device immediately (device_put enqueues are async,
+    so the transfer of column N overlaps the decode of column N+1). The
+    staged columns attach to the ScanBatch as `_preuploaded`, which
+    DeviceBatch.__init__ consumes instead of re-staging. Failures are
+    swallowed (counted) — the batch then just uploads lazily as before."""
+
+    def __init__(self, n_rows: int):
+        self.n_pad = pad_rows(max(n_rows, 1))
+        self._cols: dict = {}
+
+    def put(self, name: str, vt: ValueType, vals: np.ndarray,
+            valid: np.ndarray):
+        try:
+            with stages.stage("upload_ms"):
+                dev_vals = vals if vt != ValueType.BOOLEAN \
+                    else vals.astype(np.int64)
+                all_valid = bool(valid.all())
+                self._cols[name] = (
+                    vt,
+                    _put(_pad_to(dev_vals, self.n_pad, 0)),
+                    None if all_valid
+                    else _put(_pad_to(valid, self.n_pad, False)),
+                    all_valid,
+                )
+        except Exception:
+            stages.count_error("scan.eager_upload")
+
+    def attach(self, batch):
+        if self._cols:
+            batch._preuploaded = (self.n_pad, self._cols)
+
+
+def merged_device_batch(merged, cached, delta,
+                        append_gather: np.ndarray) -> "DeviceBatch | None":
+    """Build the device twin of a delta-merged batch by gathering the
+    unchanged rows from the cached twin ON DEVICE — the cached field
+    columns never re-cross the host↔device pipe; only the (small) delta
+    rows upload. Only valid for the pure-append merge shape
+    (`append_gather` from merge_scan_batches): with duplicate (sid, ts)
+    groups, each field picks its winner independently and one shared
+    row-gather would be wrong — callers fall back to a lazy full build.
+
+    The i32 timestamp pair / ordinals / rank rebuild on host (cheap i32
+    work); → the attached DeviceBatch, or None when the cached twin is
+    missing or shaped incompatibly."""
+    old = getattr(cached, "_device_batch", None)
+    if old is None or old.series_params is not None:
+        return None
+    import jax.numpy as jnp
+
+    with stages.stage("upload_ms"):
+        n_c, n_d = cached.n_rows, delta.n_rows
+        db = DeviceBatch.__new__(DeviceBatch)
+        db._init_meta(merged)
+        # gather index into [cached rows | delta rows | zero sentinel];
+        # pad rows hit the sentinel so they read (0, invalid) regardless
+        # of kernel-side pad masking
+        sent = n_c + n_d
+        g = np.full(db.n_pad, sent, dtype=np.int32)
+        g[:merged.n_rows] = append_gather
+        g_dev = _put(g)
+        pre = getattr(delta, "_preuploaded", None)
+        pre_cols = pre[1] if pre is not None else {}
+        for name, (vt, vals, valid) in merged.fields.items():
+            if vt in (ValueType.STRING, ValueType.GEOMETRY):
+                continue
+            of = old.fields.get(name) if name in cached.fields else None
+            if of is None or of[0] != vt or old.n_pad < n_c:
+                # new/retyped column: plain upload of the merged array
+                dev_vals = vals if vt != ValueType.BOOLEAN \
+                    else vals.astype(np.int64)
+                all_valid = bool(valid.all())
+                db.field_all_valid[name] = all_valid
+                db.fields[name] = (
+                    vt, _put(_pad_to(dev_vals, db.n_pad, 0)),
+                    None if all_valid
+                    else _put(_pad_to(valid, db.n_pad, False)))
+                continue
+            _vt, old_vals, old_valid = of
+            df = delta.fields.get(name)
+            p = pre_cols.get(name)
+            if p is not None and p[0] == vt and pre[0] >= n_d:
+                d_vals_dev = p[1][:n_d]
+                d_valid_dev = p[2][:n_d] if p[2] is not None else None
+                d_all_valid = p[3]
+            else:
+                if df is not None:
+                    d_vals = df[1] if vt != ValueType.BOOLEAN \
+                        else df[1].astype(np.int64)
+                    d_valid = df[2]
+                else:   # field absent from the delta: all-invalid zeros
+                    d_vals = np.zeros(
+                        n_d, dtype=np.int64 if vt == ValueType.BOOLEAN
+                        else vt.numpy_dtype())
+                    d_valid = np.zeros(n_d, dtype=bool)
+                d_vals_dev = _put(np.ascontiguousarray(d_vals))
+                d_all_valid = bool(d_valid.all())
+                d_valid_dev = None if d_all_valid \
+                    else _put(np.ascontiguousarray(d_valid))
+            zero = jnp.zeros(1, dtype=old_vals.dtype)
+            cat = jnp.concatenate([old_vals[:n_c], d_vals_dev, zero])
+            vals_dev = cat[g_dev]
+            all_valid = bool(valid.all())
+            db.field_all_valid[name] = all_valid
+            if all_valid:
+                valid_dev = None
+            else:
+                ov = old_valid[:n_c] if old_valid is not None \
+                    else jnp.ones(n_c, dtype=bool)
+                dv = d_valid_dev if d_valid_dev is not None \
+                    else jnp.ones(n_d, dtype=bool)
+                vcat = jnp.concatenate(
+                    [ov, dv, jnp.zeros(1, dtype=bool)])
+                valid_dev = vcat[g_dev]
+            db.fields[name] = (vt, vals_dev, valid_dev)
+        merged._device_batch = db
+        return db
 
 
 def _regular_series_params(sid_ordinal: np.ndarray, sec: np.ndarray,

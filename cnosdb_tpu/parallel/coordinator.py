@@ -17,6 +17,7 @@ import os
 import threading
 import time
 from dataclasses import dataclass, field
+from hashlib import blake2b
 
 import numpy as np
 
@@ -56,6 +57,10 @@ class PlacedSplit:
 
 class Coordinator:
     SCAN_CACHE_SIZE = 32
+    # byte cap across cached ScanBatches (sum of array nbytes): entry
+    # count alone lets a few huge vnodes pin gigabytes of host memory
+    SCAN_CACHE_MAX_BYTES = int(os.environ.get(
+        "CNOSDB_CACHE_SCAN_CACHE_MAX_BYTES", str(1024 * 1024 * 1024)))
 
     def __init__(self, meta, engine: TsKv, node_id: int | None = None,
                  memory_pool=None):
@@ -74,7 +79,9 @@ class Coordinator:
         # reference's TsmReader LRU cache, promoted to whole-scan snapshots
         # because host→device transfer dominates on this hardware);
         # lock-guarded: node-service handler threads scan concurrently
+        # key → (ScanToken, ScanBatch, nbytes); LRU by dict re-insertion
         self._scan_cache: dict = {}
+        self._scan_cache_bytes = 0
         self._scan_cache_lock = threading.Lock()
         # schema auto-creation callbacks land on meta; keep engine's view hot
         meta.watch(self._on_meta_event)
@@ -138,6 +145,7 @@ class Coordinator:
                                              payload["rs_id"])
             with self._scan_cache_lock:
                 self._scan_cache.clear()
+                self._scan_cache_bytes = 0
             return
         if event in ("create_table", "update_table", "recover_table"):
             owner = payload["owner"]
@@ -153,6 +161,7 @@ class Coordinator:
             self.engine.drop_table(payload["owner"], payload["table"])
             with self._scan_cache_lock:
                 self._scan_cache.clear()
+                self._scan_cache_bytes = 0
         elif event == "trash_table":
             # soft delete: schema gone, row data stays until purge
             self.engine.remove_table_schema(payload["owner"],
@@ -167,6 +176,7 @@ class Coordinator:
             self.engine.close_database(payload["owner"])
             with self._scan_cache_lock:
                 self._scan_cache.clear()
+                self._scan_cache_bytes = 0
         elif event == "recover_db":
             owner = payload["owner"]
             tenant, db = owner.split(".", 1)
@@ -610,9 +620,9 @@ class Coordinator:
         doms = tag_domains or ColumnDomains.all()
         splits = self.table_vnodes(tenant, db, table, trs, doms)
 
-        import os
+        from ..utils import executor
 
-        workers = min(8, len(splits))
+        workers = min(executor.pool_size("scan"), len(splits))
         # divide the host's cores across concurrent vnode scans: the
         # native page decoder threads inside each scan multiply with the
         # pool width, and oversubscription thrashes the cold path
@@ -647,11 +657,10 @@ class Coordinator:
             # vnode scans are independent: decode in parallel (the C++
             # codec calls and big numpy ops release the GIL, so the cold
             # TSM→columns path scales with cores — the reference's scan
-            # fans out across DataFusion partitions the same way)
-            from concurrent.futures import ThreadPoolExecutor
-
-            with ThreadPoolExecutor(max_workers=workers) as tp:
-                results = list(tp.map(one, splits))
+            # fans out across DataFusion partitions the same way) on the
+            # long-lived shared pool (utils/executor.py), not a per-call
+            # ThreadPoolExecutor
+            results = executor.run_all("scan", one, splits)
         else:
             results = [one(s) for s in splits]
         return [b for b in results if b is not None and b.n_rows]
@@ -669,10 +678,9 @@ class Coordinator:
             sids = v.index.get_series_ids_by_domains(table, doms)
             if len(sids) == 0:
                 return None
-        import hashlib
-
-        sids_key = (hashlib.md5(np.ascontiguousarray(sids).tobytes())
-                    .hexdigest() if sids is not None else None)
+        sids_key = (blake2b(np.ascontiguousarray(sids).tobytes(),
+                            digest_size=16).hexdigest()
+                    if sids is not None else None)
         # a predicate-pruned batch holds only pages that can satisfy THAT
         # constraint set: it is cached under the constraints' rendering
         # and never serves a different query. The UNFILTERED entry remains
@@ -693,27 +701,133 @@ class Coordinator:
         key0 = base_key + (None,)
         from ..utils import stages
 
+        # token BEFORE probe/decode: a write racing the decode makes the
+        # stored token conservative (its rows re-decode next delta and
+        # dedup away), never stale
+        token = v.scan_token()
+        stale = None
         with self._scan_cache_lock:
             for k in ((key, key0) if filter_key else (key0,)):
                 hit = self._scan_cache.get(k)
-                if hit is not None and hit[0] == v.data_version:
+                if hit is None:
+                    continue
+                if hit[0].data_version == v.data_version:
                     self._scan_cache[k] = self._scan_cache.pop(k)  # LRU
                     stages.count("scan_hit")
                     return hit[1]
+                if stale is None:
+                    stale = (k, hit)
+        if stale is not None:
+            b = self._scan_delta(v, stale, token, table, trs, sids,
+                                 field_names, page_constraints,
+                                 key, key0, n_threads)
+            if b is not None:
+                return b
         stages.count("scan_miss")
         with stages.stage("decode_ms"):
             b = scan_vnode(v, table, series_ids=sids, time_ranges=trs,
                            field_names=field_names,
                            page_constraints=page_constraints,
-                           n_threads=n_threads)
+                           n_threads=n_threads,
+                           upload_hook=self._upload_hook())
         if not getattr(b, "_pages_pruned", False):
             key = key0   # nothing pruned: the batch is the full scan
-        with self._scan_cache_lock:
-            self._scan_cache.pop(key, None)  # supersede stale version
-            while len(self._scan_cache) >= self.SCAN_CACHE_SIZE:
-                self._scan_cache.pop(next(iter(self._scan_cache)))
-            self._scan_cache[key] = (v.data_version, b)
+        self._cache_store(key, token, b)
         return b
+
+    def _scan_delta(self, v, stale, token, table, trs, sids, field_names,
+                    page_constraints, key, key0, n_threads):
+        """Incremental rescan off a stale cache entry: decode only the
+        TSM files / memcache rows the entry's token doesn't cover, merge
+        into the cached batch (and its device twin), re-cache under the
+        advanced token. → the merged batch, or None when only a full
+        rescan is sound (destructive mutation, files compacted away,
+        schema drift between the batches)."""
+        from ..storage.scan import DeltaVnodeView, merge_scan_batches
+        from ..utils import stages
+
+        hit_key, (old, cached, _nb) = stale
+        if old.destructive_version != token.destructive_version:
+            return None   # tombstones / tag re-keys: no delta can express
+        if not (old.file_ids <= token.file_ids):
+            return None   # files compacted away: cached rows may be gone
+        new_fids = token.file_ids - old.file_ids
+        if not new_fids and token.mem_seq <= old.mem_seq:
+            # nothing actually new (e.g. an L0→L1 promotion kept the same
+            # file ids): refresh the token on the cached batch
+            stages.count("delta_hit")
+            self._cache_store(hit_key, token, cached)
+            return cached
+        view = DeltaVnodeView(v, new_fids, old.mem_seq)
+        with stages.stage("decode_ms"):
+            delta = scan_vnode(view, table, series_ids=sids,
+                               time_ranges=trs, field_names=field_names,
+                               page_constraints=page_constraints,
+                               n_threads=n_threads,
+                               upload_hook=self._upload_hook())
+        cached_pruned = getattr(cached, "_pages_pruned", False)
+        pruned = cached_pruned or getattr(delta, "_pages_pruned", False)
+        if delta.n_rows == 0:
+            merged, gather = cached, None
+        else:
+            res = merge_scan_batches(cached, delta)
+            if res is None:
+                return None
+            merged, gather = res
+            merged._pages_pruned = pruned
+            if gather is not None \
+                    and getattr(cached, "_device_batch", None) is not None:
+                try:
+                    from ..ops.device_cache import merged_device_batch
+
+                    with stages.stage("merge_ms"):
+                        merged_device_batch(merged, cached, delta, gather)
+                except Exception:
+                    stages.count_error("scan.device_merge")
+        stages.count("delta_hit")
+        stages.count("delta_rows", delta.n_rows)
+        # a pruned result is only valid for this constraint set: it must
+        # live under the filtered key even when the stale hit was the
+        # unfiltered fallback entry
+        store_key = hit_key if hit_key == key else (key if pruned else key0)
+        self._cache_store(store_key, token, merged)
+        return merged
+
+    def _cache_store(self, key, token, batch):
+        nb = _batch_nbytes(batch)
+        with self._scan_cache_lock:
+            old = self._scan_cache.pop(key, None)
+            if old is not None:
+                self._scan_cache_bytes -= old[2]
+            while self._scan_cache and (
+                    len(self._scan_cache) >= self.SCAN_CACHE_SIZE
+                    or self._scan_cache_bytes + nb
+                    > self.SCAN_CACHE_MAX_BYTES):
+                lru = next(iter(self._scan_cache))
+                self._scan_cache_bytes -= self._scan_cache.pop(lru)[2]
+            self._scan_cache[key] = (token, batch, nb)
+            self._scan_cache_bytes += nb
+
+    def scan_cache_stats(self) -> tuple[int, int]:
+        """→ (entries, bytes) for /metrics."""
+        with self._scan_cache_lock:
+            return len(self._scan_cache), self._scan_cache_bytes
+
+    def _upload_hook(self):
+        """Eager-upload factory for the scan pipeline — only when queries
+        will actually take the device path; on pure-CPU placements the
+        staging copy is wasted work."""
+        try:
+            from ..ops.placement import scan_device
+            from ..ops.tpu_exec import _FORCE_DEVICE
+
+            if scan_device().platform != "cpu" or _FORCE_DEVICE():
+                from ..ops.device_cache import EagerUploader
+
+                return EagerUploader
+        except Exception:
+            pass
+        return None
 
     def _scan_remote(self, split: PlacedSplit, field_names) -> ScanBatch | None:
         """Scan one split on its owning node, failing over to replica
@@ -1232,3 +1346,20 @@ class Coordinator:
                 if k is not None:
                     keys[(k.table, k.tags)] = k
         return [keys[k] for k in sorted(keys)]
+
+
+def _batch_nbytes(b: ScanBatch) -> int:
+    """Host footprint of a cached ScanBatch (cache byte accounting).
+    Dictionary-encoded string columns count codes + a per-unique-value
+    estimate; exactness doesn't matter, monotonicity does."""
+    n = int(b.ts.nbytes) + int(b.sid_ordinal.nbytes) \
+        + int(b.series_ids.nbytes)
+    for _name, (_vt, vals, valid) in b.fields.items():
+        codes = getattr(vals, "codes", None)
+        if codes is not None:   # DictArray
+            n += int(codes.nbytes)
+            n += sum(len(str(x)) + 49 for x in vals.values)
+        else:
+            n += int(vals.nbytes)
+        n += int(valid.nbytes)
+    return n

@@ -624,7 +624,7 @@ class HttpServer:
         return web.json_response({"data": data, "total": len(data)})
 
     async def handle_metrics(self, request):
-        from ..utils import stages
+        from ..utils import executor, stages
 
         # fold the always-on failure counters (RPC handler errors etc.) in
         # as gauges at render time — set_gauge is idempotent, so repeated
@@ -633,6 +633,16 @@ class HttpServer:
             area, _, what = name.partition(".")
             self.metrics.set_gauge("cnosdb_errors_total", n,
                                    area=area, kind=what or area)
+        # shared scan/decode pool health: live task counts + pool widths
+        for name, n in executor.active_counts().items():
+            self.metrics.set_gauge("cnosdb_scan_executor_active", n,
+                                   pool=name)
+        for name, n in executor.pool_sizes().items():
+            self.metrics.set_gauge("cnosdb_scan_executor_threads", n,
+                                   pool=name)
+        entries, nbytes = self.coord.scan_cache_stats()
+        self.metrics.set_gauge("cnosdb_scan_cache_entries", entries)
+        self.metrics.set_gauge("cnosdb_scan_cache_bytes", nbytes)
         return web.Response(text=self.metrics.prometheus_text(),
                             content_type="text/plain")
 
@@ -833,6 +843,8 @@ def run_server(args) -> int:
 
     # Config.load with no path still applies CNOSDB_* env overrides
     cfg = Config.load(getattr(args, "config", None))
+    from ..utils import executor
+    executor.configure(cfg.query)
     mode = getattr(args, "mode", "singleton")
     if mode == "meta":
         return run_meta_server(args)

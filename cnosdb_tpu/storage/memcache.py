@@ -20,7 +20,8 @@ _APPROX_ROW_BYTES = 48
 class SeriesData:
     """Accumulated rows of one series inside a memcache."""
 
-    __slots__ = ("sid", "table", "ts_chunks", "field_chunks", "n_rows")
+    __slots__ = ("sid", "table", "ts_chunks", "field_chunks", "n_rows",
+                 "seq_chunks")
 
     def __init__(self, sid: int, table: str):
         self.sid = sid
@@ -30,13 +31,40 @@ class SeriesData:
         # chunk with its rows in the concatenated timestamp stream
         self.field_chunks: dict[str, list[tuple[int, int, list]]] = {}
         self.n_rows = 0
+        # WAL seq per ts chunk (non-decreasing — appends follow log order);
+        # lets a delta scan take only the chunk suffix newer than a token
+        self.seq_chunks: list[int] = []
 
-    def append(self, sr: SeriesRows):
+    def append(self, sr: SeriesRows, seq: int = 0):
         off = self.n_rows
         self.ts_chunks.append(sr.timestamps)
+        self.seq_chunks.append(seq)
         self.n_rows += len(sr.timestamps)
         for name, (vt, vals) in sr.fields.items():
             self.field_chunks.setdefault(name, []).append((off, vt, vals))
+
+    def suffix(self, after_seq: int) -> "SeriesData | None":
+        """→ a SeriesData holding only the chunks with seq > after_seq
+        (None when there are none). Shares the chunk lists' objects —
+        callers must treat the result as read-only."""
+        import bisect
+
+        i = bisect.bisect_right(self.seq_chunks, after_seq)
+        if i >= len(self.ts_chunks):
+            return None
+        nd = SeriesData(self.sid, self.table)
+        nd.ts_chunks = self.ts_chunks[i:]
+        nd.seq_chunks = self.seq_chunks[i:]
+        nd.n_rows = sum(len(c) for c in nd.ts_chunks)
+        if nd.n_rows == 0:
+            return None
+        base = sum(len(c) for c in self.ts_chunks[:i])
+        for name, chunks in self.field_chunks.items():
+            kept = [(off - base, vt, vals) for (off, vt, vals) in chunks
+                    if off >= base]
+            if kept:
+                nd.field_chunks[name] = kept
+        return nd
 
     def materialize(self) -> tuple[np.ndarray, dict[str, tuple[ValueType, np.ndarray, np.ndarray]], np.ndarray]:
         """→ (sorted unique ts, {field: (vt, values, valid_mask)}, order)
@@ -148,7 +176,7 @@ class MemCache:
         sd = self.series.get(key)
         if sd is None:
             sd = self.series[key] = SeriesData(sid, table)
-        sd.append(sr)
+        sd.append(sr, seq)
         nb = len(sr.timestamps)
         self.approx_bytes += nb * _APPROX_ROW_BYTES * (1 + len(sr.fields))
         if self.min_seq is None:
@@ -215,7 +243,31 @@ class MemCache:
                     v = [vals[i] if valid[i] else None for i in np.nonzero(keep)[0]]
                     nf[name] = (int(vt), v)
                 from ..models.series import SeriesKey
-                nd.append(SeriesRows(SeriesKey(tbl, []), kts, nf))
+                # the rebuilt chunk carries the cache's max seq: it holds
+                # survivors of older writes, so a delta suffix taken at an
+                # older token must include it (the delete itself also bumps
+                # destructive_version, which forces a full rescan anyway)
+                nd.append(SeriesRows(SeriesKey(tbl, []), kts, nf),
+                          self.max_seq)
                 self.series[(tbl, sid)] = nd
             else:
                 del self.series[(tbl, sid)]
+
+    def suffix_view(self, after_seq: int) -> "MemCache | None":
+        """→ a read-only MemCache exposing only rows appended with WAL
+        seq > after_seq, or None when this cache has nothing newer. Used
+        by the delta scan (storage/scan.DeltaVnodeView) so an incremental
+        rescan decodes only post-token memcache chunks."""
+        if self.max_seq <= after_seq:
+            return None
+        out = MemCache(self.vnode_id, self.max_bytes)
+        out.immutable = True
+        out.min_seq = self.min_seq
+        out.max_seq = self.max_seq
+        # list(): scans run without the vnode lock, so a concurrent write
+        # may grow the dict mid-iteration (same discipline as _series_parts)
+        for key, sd in list(self.series.items()):
+            suf = sd.suffix(after_seq)
+            if suf is not None:
+                out.series[key] = suf
+        return out if out.series else None

@@ -23,7 +23,7 @@ from ..models.predicate import TimeRange, TimeRanges
 from ..models.schema import TskvTableSchema, ValueType
 from ..models.strcol import DictArray, as_dict_part as _as_dict_part, \
     unify_dictionaries
-from .memcache import _group_starts
+from .memcache import MemCache, _group_starts
 from .vnode import VnodeStorage
 
 
@@ -219,6 +219,149 @@ def merge_parts(parts, field_names: list[str]):
     return uts, out
 
 
+# ---------------------------------------------------------------------------
+# delta rescan: decode only what a ScanToken doesn't cover
+# ---------------------------------------------------------------------------
+
+
+class _DeltaVersion:
+    """Version facade whose levels hold ONLY `new_fids`; readers,
+    tombstones and paths delegate to the live Version (same caches)."""
+
+    def __init__(self, version, new_fids: frozenset):
+        self._version = version
+        self.levels = [
+            {fid: fm for fid, fm in lvl.items() if fid in new_fids}
+            for lvl in version.levels]
+
+    def reader(self, fm):
+        return self._version.reader(fm)
+
+    def tombstone(self, fm):
+        return self._version.tombstone(fm)
+
+    def file_path(self, fm):
+        return self._version.file_path(fm)
+
+    def all_files(self):
+        out = []
+        for lvl in self.levels:
+            out.extend(lvl.values())
+        return out
+
+
+class _DeltaSummary:
+    def __init__(self, version):
+        self.version = version
+
+
+class DeltaVnodeView:
+    """Vnode facade exposing only data NEWER than a ScanToken: the TSM
+    files in `new_fids` plus memcache rows with WAL seq > `after_seq`.
+    scan_vnode runs against it unchanged — the result is the delta batch
+    that merge_scan_batches folds into the cached snapshot. Index and
+    schemas are the live ones (valid because the coordinator only takes
+    this path when destructive_version matched)."""
+
+    def __init__(self, vnode: VnodeStorage, new_fids: frozenset,
+                 after_seq: int):
+        self.vnode_id = vnode.vnode_id
+        self.summary = _DeltaSummary(
+            _DeltaVersion(vnode.summary.version, new_fids))
+        self.index = vnode.index
+        self.schemas = vnode.schemas
+        act = vnode.active.suffix_view(after_seq)
+        self.active = act if act is not None \
+            else MemCache(vnode.vnode_id)
+        self.immutables = [sv for c in list(vnode.immutables)
+                           if (sv := c.suffix_view(after_seq)) is not None]
+
+
+def merge_scan_batches(cached: ScanBatch, delta: ScanBatch):
+    """Fold a delta decode into a cached snapshot.
+
+    → (merged, append_gather) or None when the batches disagree on a
+    field's type (schema drift the caller resolves with a full rescan).
+    `append_gather` is an int64 row-gather into concat(cached, delta)
+    producing the merged batch, present iff no (series, ts) pair occurs
+    in both inputs — the pure-append case the device twin can replay
+    with one gather per column (ops/device_cache.merged_device_batch).
+
+    Dedup semantics match a full rescan: every delta source (a freshly
+    flushed L0 file, newer memcache chunks) outranks every cached source,
+    and rows the delta re-decodes after a flush carry identical values,
+    so per-field latest-valid-wins over [cached, delta] is exactly the
+    scan's merge rule. Output is canonical: series ids ascending (the
+    index returns sorted sid arrays), ts ascending and unique per series.
+    """
+    n_c, n_d = cached.n_rows, delta.n_rows
+    for name, (vt, _v, _m) in delta.fields.items():
+        cf = cached.fields.get(name)
+        if cf is not None and cf[0] != vt:
+            return None
+    all_sids = np.union1d(cached.series_ids, delta.series_ids)
+    sid_all = np.concatenate([cached.series_ids[cached.sid_ordinal],
+                              delta.series_ids[delta.sid_ordinal]])
+    ts_all = np.concatenate([cached.ts, delta.ts])
+    n = n_c + n_d
+    # stable (ts, sid) lexsort: within a duplicate (sid, ts) group the
+    # cached rows precede the delta rows, so "last valid wins" = delta
+    order = np.lexsort((ts_all, sid_all))
+    sid_s = sid_all[order]
+    ts_s = ts_all[order]
+    newgrp = np.empty(n, dtype=bool)
+    newgrp[0] = True
+    newgrp[1:] = (sid_s[1:] != sid_s[:-1]) | (ts_s[1:] != ts_s[:-1])
+    group_starts = np.nonzero(newgrp)[0]
+    pure_append = len(group_starts) == n
+    uts = ts_s[group_starts]
+    usid = sid_s[group_starts]
+    sid_ordinal = np.searchsorted(all_sids, usid).astype(np.int32)
+    idx = np.arange(n, dtype=np.int64)
+    out_fields: dict = {}
+    names = list(cached.fields)
+    names += [nm for nm in delta.fields if nm not in cached.fields]
+    for name in names:
+        vt = (cached.fields.get(name) or delta.fields[name])[0]
+        np_dtype = vt.numpy_dtype()
+        is_str = np_dtype is object
+        if is_str:
+            das = [_as_dict_part(b.fields[name][1])
+                   if name in b.fields else None
+                   for b in (cached, delta)]
+            union = unify_dictionaries([d for d in das if d is not None])
+            vals_all = np.zeros(n, dtype=np.int32)
+        else:
+            vals_all = np.zeros(n, dtype=np_dtype)
+        valid_all = np.zeros(n, dtype=bool)
+        off = 0
+        for bi, b in enumerate((cached, delta)):
+            m = b.n_rows
+            if name in b.fields:
+                _vt, vals, valid = b.fields[name]
+                vals_all[off:off + m] = (das[bi].remap_to(union)
+                                         if is_str else vals)
+                valid_all[off:off + m] = valid
+            off += m
+        vals_s = vals_all[order]
+        valid_s = valid_all[order]
+        score = np.where(valid_s, idx, -1)
+        last_valid = np.maximum.reduceat(score, group_starts)
+        valid_out = last_valid >= 0
+        vals_out = vals_s[np.clip(last_valid, 0, None)]
+        if is_str:
+            vals_out = DictArray(vals_out, union)
+        out_fields[name] = (vt, vals_out, valid_out)
+    keymap = {int(s): k for s, k in zip(cached.series_ids,
+                                        cached.series_keys)}
+    keymap.update((int(s), k) for s, k in zip(delta.series_ids,
+                                              delta.series_keys))
+    merged = ScanBatch(cached.table, all_sids.astype(np.uint64),
+                       [keymap[int(s)] for s in all_sids],
+                       uts, sid_ordinal, out_fields)
+    return merged, (order[group_starts] if pure_append else None)
+
+
 def _field_targets(vnode: VnodeStorage, table: str,
                    field_names: list[str]) -> dict:
     """name → (column_id | None, [name, *prior_names]).
@@ -280,7 +423,7 @@ def scan_vnode(vnode: VnodeStorage, table: str,
                time_ranges: TimeRanges | None = None,
                field_names: list[str] | None = None,
                page_filter=None, page_constraints: dict | None = None,
-               n_threads: int = 1) -> ScanBatch:
+               n_threads: int = 1, upload_hook=None) -> ScanBatch:
     """Materialize a vnode scan into one ScanBatch.
 
     `page_filter` (an sql.expr tree, optional) enables predicate page
@@ -291,6 +434,11 @@ def scan_vnode(vnode: VnodeStorage, table: str,
     as `page_constraints` so the tree is walked once per query, not per
     vnode. `n_threads` sizes the native decoder's pool (the coordinator
     divides the host's cores across concurrent vnode scans).
+    `upload_hook`, when given, is `hook(total_rows) -> uploader`: as each
+    field column finishes decoding cleanly it is handed to
+    `uploader.put(...)` so device transfer overlaps the decode of the
+    remaining columns (the double-buffer half of the pipeline; storage
+    stays jax-free — the hook comes from ops/device_cache).
     """
     trs = time_ranges if time_ranges is not None else TimeRanges.all()
     if series_ids is None:
@@ -311,7 +459,7 @@ def scan_vnode(vnode: VnodeStorage, table: str,
             page_constraints = _page_constraints(page_filter, field_names)
         batch = _scan_vnode_native(vnode, table, series_ids, trs,
                                    field_names, page_constraints or {},
-                                   n_threads)
+                                   n_threads, upload_hook)
         if batch is not None:
             return batch
 
@@ -498,7 +646,8 @@ def _page_admits(cols: dict, i: int, constraints: dict) -> bool:
 def _scan_vnode_native(vnode: VnodeStorage, table: str,
                        series_ids, trs: TimeRanges,
                        field_names: list[str], constraints: dict,
-                       n_threads: int) -> ScanBatch | None:
+                       n_threads: int,
+                       upload_hook=None) -> ScanBatch | None:
     from . import native
 
     if not native.pagedec_available():
@@ -639,23 +788,76 @@ def _scan_vnode_native(vnode: VnodeStorage, table: str,
                 off += tp.n_rows
 
     # ------------------------------------------------------- native decode
+    # one task per (file, column): pages of one column across files write
+    # DISJOINT row ranges of the same output array, so tasks run
+    # concurrently on the shared decode pool. Eager upload: once every
+    # task of a column has finished cleanly, its final array is handed to
+    # the uploader while the remaining columns still decode (decode N+1
+    # overlaps device_put of N — device_put enqueues are async).
+    tasks = []
+    col_remaining: dict[str, int] = {}
     for g in groups.values():
-        base = g["base"]
         for colname, (desc_list, jobs) in g["cols"].items():
             desc = np.array(desc_list, dtype=np.int64).reshape(-1, 6)
             if colname is None:
                 out_vals, out_valid = ts_all, None
             else:
                 out_vals, out_valid = numeric_cols[colname]
-            status = native.decode_pages(base, desc, out_vals, out_valid,
-                                         n_threads=n_threads)
-            if status is None:
-                return None   # library vanished mid-flight: legacy path
-            bad = np.nonzero(status)[0]
-            for bi in bad:
-                pm, out_off = jobs[bi]
-                py_jobs.append((g["reader"], pm, colname, out_off,
-                                ftypes.get(colname)))
+                col_remaining[colname] = col_remaining.get(colname, 0) + 1
+            tasks.append((g, colname, desc, out_vals, out_valid, jobs))
+
+    uploader = None
+    if upload_hook is not None and not fallback_writes \
+            and not (any_trim and not trs.is_all):
+        # fallback series splice into every column after decode, and a
+        # time trim re-slices the arrays — both would invalidate an
+        # eagerly shipped copy, so only clean scans pipeline uploads
+        uploader = upload_hook(total)
+    dirty_cols = {j[2] for j in py_jobs}
+
+    def _run(task):
+        g, _colname, desc, out_vals, out_valid, _jobs = task
+        return native.decode_pages(g["base"], desc, out_vals, out_valid,
+                                   n_threads=per_task_threads)
+
+    def _finish(task, status) -> bool:
+        """Fold one task's result back in (main thread); False = abort."""
+        g, colname, _desc, _ov, _om, jobs = task
+        if status is None:
+            return False   # library vanished mid-flight: legacy path
+        for bi in np.nonzero(status)[0]:
+            pm, out_off = jobs[bi]
+            py_jobs.append((g["reader"], pm, colname, out_off,
+                            ftypes.get(colname)))
+            dirty_cols.add(colname)
+        if colname is None:
+            return True
+        col_remaining[colname] -= 1
+        if uploader is not None and col_remaining[colname] == 0 \
+                and colname not in dirty_cols:
+            vals, valid = numeric_cols[colname]
+            uploader.put(colname, ftypes[colname], vals, valid)
+        return True
+
+    if len(tasks) > 1:
+        from concurrent.futures import as_completed
+
+        from ..utils.executor import submit as _submit
+
+        per_task_threads = 1 if len(tasks) >= n_threads \
+            else max(1, n_threads // len(tasks))
+        futs = {_submit("decode", _run, t): t for t in tasks}
+        aborted = False
+        for f in as_completed(futs):
+            if not _finish(futs[f], f.result()):
+                aborted = True
+        if aborted:
+            return None
+    else:
+        per_task_threads = n_threads
+        for t in tasks:
+            if not _finish(t, _run(t)):
+                return None
 
     # ------------------------------------------------ python page fallbacks
     for r, pm, colname, out_off, vt in py_jobs:
@@ -746,6 +948,8 @@ def _scan_vnode_native(vnode: VnodeStorage, table: str,
     b = ScanBatch(table, np.array(kept_sids, dtype=np.uint64), keys,
                   ts_all, sid_ordinal, out_fields)
     b._pages_pruned = any_pruned
+    if uploader is not None:
+        uploader.attach(b)
     return b
 
 

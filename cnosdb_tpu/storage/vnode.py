@@ -14,6 +14,7 @@ from __future__ import annotations
 
 import os
 import threading
+from dataclasses import dataclass
 
 import msgpack
 import numpy as np
@@ -29,6 +30,23 @@ from .memcache import MemCache
 from .summary import Summary, VersionEdit
 from .tombstone import TombstoneEntry, TsmTombstone
 from .wal import Wal, WalEntryType
+
+
+@dataclass(frozen=True)
+class ScanToken:
+    """What a cached ScanBatch was decoded from: the TSM file-id set plus
+    the last memcache WAL seq at capture time. A later scan whose current
+    token differs only by ADDED files / HIGHER seq can decode just the
+    delta and merge it into the cached batch; `destructive_version`
+    gates that — deletes/tag-renames mutate existing files (tombstones)
+    or the index in place, which no file/seq diff can express, so any
+    bump forces a full rescan. `data_version` is kept for the exact-match
+    fast path (scan_hit)."""
+
+    data_version: int
+    destructive_version: int
+    file_ids: frozenset
+    mem_seq: int
 
 
 class VnodeStorage:
@@ -52,7 +70,24 @@ class VnodeStorage:
         # monotonically increasing snapshot id: bumps on any mutation so
         # scan caches (host ScanBatch + device twin) invalidate naturally
         self.data_version = 0
+        # bumps only on mutations that CANNOT be expressed as a delta over
+        # the (file set, memcache seq) token: tombstone-writing deletes,
+        # tag re-keys, snapshot installs, in-place memcache field edits
+        self.destructive_version = 0
         self._replay_wal()
+
+    def scan_token(self) -> ScanToken:
+        """Capture the snapshot token for a scan ABOUT to run. Taken under
+        the vnode lock so the file set and seq are mutually consistent; a
+        write racing the subsequent (unlocked) decode only makes the token
+        conservative — its rows re-decode on the next delta and dedup."""
+        with self.lock:
+            return ScanToken(
+                self.data_version,
+                self.destructive_version,
+                frozenset(fm.file_id
+                          for fm in self.summary.version.all_files()),
+                self.wal.next_seq - 1)
 
     # ------------------------------------------------------------------ boot
     def _replay_wal(self):
@@ -149,6 +184,10 @@ class VnodeStorage:
         chunks do — without this, renaming a column to a previously-used
         name would conflate the two columns' unflushed values."""
         with self.lock:
+            # in-place memcache edit: invisible to the (file set, seq)
+            # token, so delta merges must not span it (the schema_version
+            # cache key already isolates it; this is defense in depth)
+            self.destructive_version += 1
             for cache in [self.active, *self.immutables]:
                 for (t, _sid), sd in cache.series.items():
                     if t == table and old in sd.field_chunks:
@@ -160,6 +199,7 @@ class VnodeStorage:
         resurrected by a later RENAME/ADD that reuses the name (flushed
         chunks are immune: their dropped column id is never requested)."""
         with self.lock:
+            self.destructive_version += 1
             for cache in [self.active, *self.immutables]:
                 for (t, _sid), sd in cache.series.items():
                     if t == table:
@@ -363,6 +403,7 @@ class VnodeStorage:
             self.active = MemCache(self.vnode_id, self.memcache_bytes)
             self.immutables = []
             self.data_version += 1
+            self.destructive_version += 1
 
     def checksum(self) -> str:
         """Content checksum of every live row, independent of physical
@@ -438,6 +479,7 @@ class VnodeStorage:
 
     def _apply_drop_table(self, table: str):
         self.data_version += 1
+        self.destructive_version += 1
         self.active.delete_table(table)
         for c in self.immutables:
             c.delete_table(table)
@@ -455,6 +497,7 @@ class VnodeStorage:
 
     def _apply_delete_series(self, table: str, sids):
         self.data_version += 1
+        self.destructive_version += 1
         for c in [self.active, *self.immutables]:
             for sid in sids:
                 c.delete_series(table, int(sid))
@@ -474,6 +517,7 @@ class VnodeStorage:
 
     def _apply_delete_time_range(self, table: str, sids, min_ts: int, max_ts: int):
         self.data_version += 1
+        self.destructive_version += 1
         for c in [self.active, *self.immutables]:
             c.delete_time_range(table, sids, min_ts, max_ts)
         ents = ([TombstoneEntry(table, int(s), min_ts, max_ts) for s in sids]
@@ -485,6 +529,7 @@ class VnodeStorage:
     def _apply_update_tags(self, table: str, old_keys: list[bytes], new_keys: list[bytes]):
         """UPDATE tag values: re-key series (reference update_tags_value)."""
         self.data_version += 1
+        self.destructive_version += 1
         for ob, nb in zip(old_keys, new_keys):
             old_key = SeriesKey.decode(ob)
             sid = self.index.get_series_id(old_key)

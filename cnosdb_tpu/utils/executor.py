@@ -1,0 +1,100 @@
+"""Process-wide scan/decode thread pools.
+
+The coordinator used to build a ThreadPoolExecutor per scan_table call —
+pool construction and teardown on every query, and no global view of how
+many decode threads are live. These two pools are created lazily, once
+per process, and sized from config (`[query] scan_executor_threads` /
+`decode_executor_threads`, env `CNOSDB_QUERY_*`, 0 = auto):
+
+  "scan"   — coordinator vnode fan-out (one task per PlacedSplit)
+  "decode" — per-(file, column) native page-decode tasks inside
+             storage/scan._scan_vnode_native
+
+They are deliberately SEPARATE: decode tasks are submitted from inside
+scan tasks, and a single shared pool would deadlock once every thread is
+a scan waiting on decode futures that can never be scheduled.
+
+Active-task counts are exported to /metrics (cnosdb_scan_executor_active)
+so decode-thread saturation is observable.
+"""
+from __future__ import annotations
+
+import os
+import threading
+from concurrent.futures import ThreadPoolExecutor
+
+_lock = threading.Lock()
+_pools: dict[str, ThreadPoolExecutor] = {}
+_sizes: dict[str, int] = {}
+_active: dict[str, int] = {"scan": 0, "decode": 0}
+# config-provided sizes (set once at server boot); env overrides still win
+_configured: dict[str, int] = {}
+
+_ENV = {"scan": "CNOSDB_QUERY_SCAN_EXECUTOR_THREADS",
+        "decode": "CNOSDB_QUERY_DECODE_EXECUTOR_THREADS"}
+
+
+def configure(query_cfg) -> None:
+    """Adopt pool sizes from a QueryConfig. Only affects pools not yet
+    created (first submission wins — pools are process-lifetime)."""
+    with _lock:
+        _configured["scan"] = int(getattr(
+            query_cfg, "scan_executor_threads", 0) or 0)
+        _configured["decode"] = int(getattr(
+            query_cfg, "decode_executor_threads", 0) or 0)
+
+
+def _auto_size(name: str) -> int:
+    ncpu = os.cpu_count() or 1
+    # scan fan-out keeps the historical cap of 8 concurrent vnode scans;
+    # the decode pool covers the cores so per-column tasks can fill them
+    return min(8, ncpu) if name == "scan" else max(2, ncpu)
+
+
+def _pool(name: str) -> ThreadPoolExecutor:
+    with _lock:
+        ex = _pools.get(name)
+        if ex is None:
+            size = int(os.environ.get(_ENV[name], "0") or 0) \
+                or _configured.get(name, 0) or _auto_size(name)
+            ex = _pools[name] = ThreadPoolExecutor(
+                max_workers=size, thread_name_prefix=f"cnosdb-{name}")
+            _sizes[name] = size
+        return ex
+
+
+def submit(name: str, fn, *args):
+    """Submit to the named shared pool with active-task accounting."""
+    def run():
+        with _lock:
+            _active[name] += 1
+        try:
+            return fn(*args)
+        finally:
+            with _lock:
+                _active[name] -= 1
+    return _pool(name).submit(run)
+
+
+def run_all(name: str, fn, items: list) -> list:
+    """Run fn over items on the named pool, results in item order.
+    Exceptions propagate (matching the executor.map the scan used)."""
+    futures = [submit(name, fn, it) for it in items]
+    return [f.result() for f in futures]
+
+
+def pool_size(name: str) -> int:
+    _pool(name)
+    with _lock:
+        return _sizes[name]
+
+
+def active_counts() -> dict[str, int]:
+    with _lock:
+        return dict(_active)
+
+
+def pool_sizes() -> dict[str, int]:
+    """Sizes of pools that exist (no side effect of creating them)."""
+    with _lock:
+        return dict(_sizes)

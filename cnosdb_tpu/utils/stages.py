@@ -7,9 +7,18 @@ across threads (the scan fans out on a pool).
 
 Stages recorded by the engine:
   scan_hit / scan_miss  — coordinator scan-snapshot cache counters
-  decode_ms             — TSM read+decode (cache-miss scans only)
+  delta_hit             — stale cache entry refreshed by decoding only
+                          the new TSM files / memcache rows since its
+                          snapshot token (no full rescan)
+  delta_rows            — rows decoded by those delta scans (small when
+                          the pipeline is healthy; a full rescan's worth
+                          means tokens are being invalidated)
+  decode_ms             — TSM read+decode (cache-miss and delta scans)
+  upload_ms             — host→device column uploads (eager per-column
+                          uploads overlapped with decode, plus any
+                          residual transfer at DeviceBatch build)
   kernel_ms             — fused segment-aggregate kernels
-  merge_ms              — cross-vnode partial merge
+  merge_ms              — cross-vnode partial merge / device delta-merge
   finalize_ms           — vectorized finalizers + output rendering
 """
 from __future__ import annotations

@@ -1,0 +1,270 @@
+"""Incremental scan snapshots (delta tokens) + scan-cache accounting.
+
+The coordinator's scan cache keys each vnode batch by a ScanToken
+(TSM file-id set + memcache WAL seqno + destructive version). A stale
+hit decodes only what the token doesn't cover and merges it into the
+cached batch — these tests pin the perf counters (`delta_hit` /
+`delta_rows` / `scan_miss`) AND bit-identical equivalence with a full
+rescan across interleaved writes, flushes, compactions, deletes and
+ALTERs.
+"""
+import numpy as np
+import pytest
+
+from cnosdb_tpu.models.points import SeriesRows, WriteBatch
+from cnosdb_tpu.models.schema import ValueType
+from cnosdb_tpu.models.series import SeriesKey
+from cnosdb_tpu.parallel.coordinator import Coordinator
+from cnosdb_tpu.parallel.meta import DEFAULT_TENANT, MetaStore
+from cnosdb_tpu.storage.engine import TsKv
+from cnosdb_tpu.utils import stages
+
+
+@pytest.fixture
+def cluster(tmp_path):
+    meta = MetaStore(str(tmp_path / "meta.json"))
+    engine = TsKv(str(tmp_path / "data"))
+    coord = Coordinator(meta, engine)
+    yield meta, engine, coord
+    engine.close()
+
+
+def _write(coord, host, ts_list, vals, table="cpu", db="public",
+           field="usage"):
+    wb = WriteBatch()
+    wb.add_series(table, SeriesRows(
+        SeriesKey(table, {"host": host}), list(ts_list),
+        {field: (int(ValueType.FLOAT), list(vals))}))
+    coord.write_points(DEFAULT_TENANT, db, wb)
+
+
+def _counters(coord, *scan_args, **scan_kw):
+    """Run one scan with stage counters on → (batches, snapshot)."""
+    stages.reset()
+    stages.enable(True)
+    try:
+        bs = coord.scan_table(*scan_args, **scan_kw)
+        return bs, stages.snapshot()
+    finally:
+        stages.enable(False)
+        stages.reset()
+
+
+def _flat(batches):
+    """Canonical row set: sorted (sid, ts, field, value, valid) tuples —
+    order-independent equality across scans."""
+    out = []
+    for b in batches:
+        sid = b.series_ids[b.sid_ordinal]
+        for name, (_vt, v, valid) in sorted(b.fields.items()):
+            vv = v.decode() if hasattr(v, "decode") else v
+            vals = np.where(valid, vv, 0)
+            out += list(zip(sid.tolist(), b.ts.tolist(),
+                            [name] * len(b.ts),
+                            np.asarray(vals).tolist(), valid.tolist()))
+    return sorted(out)
+
+
+def _fresh_scan(meta, engine, table="cpu", db="public"):
+    """Forced full rescan ground truth: a new Coordinator over the SAME
+    engine has an empty scan cache, so every batch decodes from scratch.
+    (A second TsKv over the live data dir would race the first one's
+    WAL/summary writes — same engine, fresh cache is the honest probe.)"""
+    return _flat(Coordinator(meta, engine).scan_table(
+        DEFAULT_TENANT, db, table))
+
+
+# --------------------------------------------------------------- perf smoke
+
+def test_rescan_after_one_write_is_delta_not_miss(cluster):
+    """Acceptance: after 1 new row on a scanned vnode, the rescan reports
+    delta_hit (not scan_miss) and decodes only the new row."""
+    meta, engine, coord = cluster
+    _write(coord, "a", range(500), [float(i) for i in range(500)])
+    coord.scan_table(DEFAULT_TENANT, "public", "cpu")
+
+    _write(coord, "a", [10_000], [42.0])
+    bs, snap = _counters(coord, DEFAULT_TENANT, "public", "cpu")
+    assert snap.get("delta_hit", 0) >= 1, snap
+    assert snap.get("scan_miss", 0) == 0, snap
+    # only the delta decodes: 1 new row, not the 500 cached ones
+    assert snap.get("delta_rows", 0) <= 2, snap
+    assert sum(b.n_rows for b in bs) == 501
+    row = {(s, t): v for s, t, _f, v, ok in _flat(bs) if ok}
+    assert row[min(row)[0], 10_000] == 42.0
+
+
+def test_second_scan_is_plain_hit(cluster):
+    meta, engine, coord = cluster
+    _write(coord, "a", range(50), [1.0] * 50)
+    coord.scan_table(DEFAULT_TENANT, "public", "cpu")
+    _, snap = _counters(coord, DEFAULT_TENANT, "public", "cpu")
+    assert snap.get("scan_hit", 0) >= 1 and "delta_hit" not in snap, snap
+
+
+def test_memcache_only_delta(cluster):
+    """Delta entirely from memcache rows (no flush): new series too."""
+    meta, engine, coord = cluster
+    _write(coord, "a", range(100), [1.0] * 100)
+    coord.scan_table(DEFAULT_TENANT, "public", "cpu")
+    _write(coord, "b", range(30), [2.0] * 30)   # new series, mem only
+    bs, snap = _counters(coord, DEFAULT_TENANT, "public", "cpu")
+    assert snap.get("delta_hit", 0) >= 1, snap
+    assert sum(b.n_rows for b in bs) == 130
+    assert _flat(bs) == _fresh_scan(meta, engine)
+
+
+def test_overwrite_same_timestamp_delta_wins(cluster):
+    meta, engine, coord = cluster
+    _write(coord, "a", range(20), [1.0] * 20)
+    coord.scan_table(DEFAULT_TENANT, "public", "cpu")
+    _write(coord, "a", [7], [99.0])
+    bs, snap = _counters(coord, DEFAULT_TENANT, "public", "cpu")
+    assert snap.get("delta_hit", 0) >= 1, snap
+    assert sum(b.n_rows for b in bs) == 20      # dedup, no double row
+    rows = {(s, t): v for s, t, _f, v, ok in _flat(bs) if ok}
+    assert list(rows[k] for k in rows if k[1] == 7) == [99.0]
+    assert _flat(bs) == _fresh_scan(meta, engine)
+
+
+def test_flush_then_rescan_stays_delta(cluster):
+    """A flush turns memcache rows into a new L0 file: the rescan decodes
+    that file as the delta and dedups the re-decoded rows."""
+    meta, engine, coord = cluster
+    _write(coord, "a", range(100), [1.0] * 100)
+    coord.scan_table(DEFAULT_TENANT, "public", "cpu")
+    engine.flush_all()
+    bs, snap = _counters(coord, DEFAULT_TENANT, "public", "cpu")
+    assert snap.get("delta_hit", 0) >= 1, snap
+    assert snap.get("scan_miss", 0) == 0, snap
+    assert sum(b.n_rows for b in bs) == 100
+    assert _flat(bs) == _fresh_scan(meta, engine)
+
+
+# ------------------------------------------------------------- invalidation
+
+def test_compaction_invalidates_delta_tokens(cluster):
+    """Regression: compaction rewrites the file set, so cached tokens no
+    longer cover it → full rescan (scan_miss), never a bogus delta."""
+    meta, engine, coord = cluster
+    for i in range(4):
+        _write(coord, "a", range(i * 10, i * 10 + 10), [float(i)] * 10)
+        engine.flush_all()
+    coord.scan_table(DEFAULT_TENANT, "public", "cpu")
+    v = next(iter(engine.vnodes.values()))
+    before = v.scan_token().file_ids
+    engine.compact_all()
+    assert v.scan_token().file_ids != before, "compaction did not rewrite files"
+    bs, snap = _counters(coord, DEFAULT_TENANT, "public", "cpu")
+    assert snap.get("scan_miss", 0) >= 1, snap
+    assert snap.get("delta_hit", 0) == 0, snap
+    assert _flat(bs) == _fresh_scan(meta, engine)
+
+
+def test_delete_forces_full_rescan(cluster):
+    """Tombstone-writing deletes bump destructive_version: a delta can't
+    express removed rows, so the next scan is a full rescan."""
+    meta, engine, coord = cluster
+    _write(coord, "a", range(100), [1.0] * 100)
+    engine.flush_all()
+    coord.scan_table(DEFAULT_TENANT, "public", "cpu")
+    from cnosdb_tpu.models.predicate import ColumnDomains
+    coord.delete_from_table(DEFAULT_TENANT, "public", "cpu",
+                            ColumnDomains.all(), 0, 49)
+    bs, snap = _counters(coord, DEFAULT_TENANT, "public", "cpu")
+    assert snap.get("delta_hit", 0) == 0, snap
+    assert sum(b.n_rows for b in bs) == 50
+    assert _flat(bs) == _fresh_scan(meta, engine)
+
+
+# ------------------------------------------------------------ property test
+
+def test_delta_merge_equals_full_rescan_interleaved(cluster):
+    """Property: after every step of an interleaved write/flush/compact/
+    ALTER schedule, the (possibly delta-merged) cached scan is
+    bit-identical to a forced full rescan of the same storage."""
+    from cnosdb_tpu.sql.executor import QueryExecutor
+
+    meta, engine, coord = cluster
+    ex = QueryExecutor(meta, coord)
+    rng = np.random.default_rng(7)
+
+    _write(coord, "h0", range(10), rng.random(10).tolist())
+    coord.scan_table(DEFAULT_TENANT, "public", "cpu")
+
+    next_ts = 1000
+    for step in range(24):
+        op = step % 6
+        if op in (0, 1, 3):     # writes: old series, new series, overwrite
+            host = f"h{rng.integers(0, 4)}"
+            n = int(rng.integers(1, 8))
+            base = next_ts if op != 3 else int(rng.integers(0, 10))
+            next_ts += n
+            _write(coord, host, range(base, base + n),
+                   rng.random(n).tolist())
+        elif op == 2:
+            engine.flush_all()
+        elif op == 4 and step == 10:
+            ex.execute_one("ALTER TABLE cpu ADD FIELD extra DOUBLE")
+        elif op == 5 and step == 17:
+            engine.flush_all()
+            engine.compact_all()
+        got = _flat(coord.scan_table(DEFAULT_TENANT, "public", "cpu"))
+        want = _fresh_scan(meta, engine)
+        assert got == want, f"divergence after step {step} (op {op})"
+
+    # the schedule must actually have exercised the delta path
+    _write(coord, "h1", [99_999], [5.0])
+    _, snap = _counters(coord, DEFAULT_TENANT, "public", "cpu")
+    assert snap.get("delta_hit", 0) >= 1, snap
+
+
+def test_alter_table_isolates_cache_entries(cluster):
+    """ALTER bumps schema_version which is part of the cache key: post-DDL
+    scans never serve a pre-DDL batch (no delta across the ALTER)."""
+    from cnosdb_tpu.sql.executor import QueryExecutor
+
+    meta, engine, coord = cluster
+    ex = QueryExecutor(meta, coord)
+    _write(coord, "a", range(10), [1.0] * 10)
+    coord.scan_table(DEFAULT_TENANT, "public", "cpu")
+    ex.execute_one("ALTER TABLE cpu ADD FIELD extra DOUBLE")
+    bs, snap = _counters(coord, DEFAULT_TENANT, "public", "cpu")
+    assert snap.get("scan_miss", 0) >= 1, snap
+    assert _flat(bs) == _fresh_scan(meta, engine)
+
+
+# ---------------------------------------------------------- cache accounting
+
+def test_scan_cache_byte_accounting_and_cap(cluster):
+    meta, engine, coord = cluster
+    _write(coord, "a", range(100), [1.0] * 100)
+    coord.scan_table(DEFAULT_TENANT, "public", "cpu")
+    entries, nbytes = coord.scan_cache_stats()
+    assert entries == 1
+    # ts(8) + usage vals(8) per row is the floor; keys/overhead add more
+    assert nbytes >= 100 * 16
+
+    # shrink the byte cap below one entry: storing evicts down to it
+    old = coord.SCAN_CACHE_MAX_BYTES
+    try:
+        coord.SCAN_CACHE_MAX_BYTES = nbytes // 2
+        _write(coord, "b", range(100), [2.0] * 100, table="mem")
+        coord.scan_table(DEFAULT_TENANT, "public", "mem")
+        entries2, nbytes2 = coord.scan_cache_stats()
+        assert entries2 <= 1
+    finally:
+        coord.SCAN_CACHE_MAX_BYTES = old
+
+
+def test_executor_pool_and_metrics_surface():
+    from cnosdb_tpu.utils import executor
+
+    assert executor.pool_size("scan") >= 1
+    assert executor.pool_size("decode") >= 1
+    sizes = executor.pool_sizes()
+    assert sizes.get("scan", 0) >= 1 and sizes.get("decode", 0) >= 1
+    active = executor.active_counts()
+    assert active.get("scan", 0) >= 0
+    # the pool actually runs work, in submission order
+    assert executor.run_all("scan", lambda x: x * 2, [1, 2, 3]) == [2, 4, 6]
