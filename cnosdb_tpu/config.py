@@ -94,6 +94,11 @@ class StorageConfig:
     max_compact_size: int = 2 * 1024 * 1024 * 1024
     strict_write: bool = False
     reserve_space: int = 0
+    # background integrity scrubber (storage/scrub.py): seconds between
+    # sweeps, 0 = off (default — tests/benchmarks must opt in); read-rate
+    # cap so a sweep never starves foreground scans of disk bandwidth
+    scrub_interval: int = 0
+    scrub_mb_per_sec: int = 8
 
 
 @dataclass

@@ -19,7 +19,7 @@ Schedule grammar (rules separated by ``;``)::
     CNOSDB_FAULTS = "seed=<int>" | <rule> { ";" <rule> }
     rule          = <point> ":" <action> [ ":" <sched> ]
     action        = fail | delay(<ms>) | drop | torn[(<bytes>)]
-                  | enospc | io_error | crash
+                  | corrupt[(<nbytes>)] | enospc | io_error | crash
     sched         = <k>=<v> { "," <k>=<v> }     # all optional, AND-ed
                       nth=<k>     fire only on the k-th matching hit
                       after=<k>   fire on every hit after the k-th
@@ -38,9 +38,12 @@ wal.append:torn(4):nth=11;rpc.reply:drop:nth=1,if=write_replica"
 Actions ``fail`` / ``enospc`` / ``io_error`` raise (:class:`FaultInjected`
 is an ``OSError`` so existing network/disk error handling takes the same
 path a real fault would), ``delay`` sleeps, ``crash`` calls ``os._exit``.
-``torn`` and ``drop`` are *site-implemented*: :func:`fire` returns the
-``(action, arg)`` tuple and the hook site performs the partial write /
-reply drop itself.
+``torn``, ``drop`` and ``corrupt`` are *site-implemented*: :func:`fire`
+returns the ``(action, arg)`` tuple and the hook site performs the partial
+write / reply drop / on-disk bit flip itself. ``corrupt(<nbytes>)`` flips
+bytes of an already-durable file (default 1) at a deterministic offset —
+the silent-corruption model the integrity plane (storage/scrub.py) exists
+to catch.
 
 Fault points currently threaded (see ARCHITECTURE.md "Fault model"):
   rpc.send rpc.response rpc.server rpc.reply          parallel/net.py
@@ -49,6 +52,7 @@ Fault points currently threaded (see ARCHITECTURE.md "Fault model"):
   flush.run                                           storage/flush.py
   compaction.run                                      storage/compaction.py
   meta.propose meta.apply                             parallel/meta_service.py
+  tsm.write scrub.read                                storage/tsm.py, scrub.py
 """
 from __future__ import annotations
 
@@ -79,7 +83,7 @@ _rules: dict[str, list["_Rule"]] = {}
 _fired: list[tuple[str, str, int]] = []   # (point, action, hit#) sequence
 _seed = 0
 
-_SITE_ACTIONS = frozenset({"torn", "drop"})
+_SITE_ACTIONS = frozenset({"torn", "drop", "corrupt"})
 _KNOWN_ACTIONS = _SITE_ACTIONS | {"fail", "delay", "enospc", "io_error",
                                   "crash"}
 
@@ -243,6 +247,33 @@ def fire(point: str, **ctx) -> tuple[str, str | None] | None:
     if action == "crash":
         os._exit(137)
     return (action, arg)
+
+
+def corrupt_file(path: str, nbytes: int = 1,
+                 lo: int = 0, hi: int | None = None) -> int:
+    """Site helper for the ``corrupt`` action: XOR-flip `nbytes` bytes of
+    `path` inside the [lo, hi) window at an offset derived from the file
+    name (stable hash, no RNG — replayable). Returns the flip offset.
+
+    The flip targets already-durable bytes, modeling bit rot / a bad
+    sector underneath a sealed file — invisible until a CRC check
+    (scan-time page read or the background scrubber) walks over it."""
+    size = os.path.getsize(path)
+    hi = size if hi is None else min(int(hi), size)
+    lo = max(0, int(lo))
+    nbytes = max(1, int(nbytes))
+    span = hi - lo - nbytes
+    if span <= 0:   # window too small: fall back to anywhere in the file
+        lo, span = 0, max(1, size - nbytes)
+    off = lo + zlib.crc32(os.path.basename(path).encode()) % span
+    with open(path, "r+b") as f:
+        f.seek(off)
+        orig = f.read(nbytes)
+        f.seek(off)
+        f.write(bytes(b ^ 0xFF for b in orig))
+        f.flush()
+        os.fsync(f.fileno())
+    return off
 
 
 def fired_log() -> list[tuple[str, str, int]]:

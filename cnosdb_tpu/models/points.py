@@ -121,11 +121,23 @@ def _dec_col(v):
     return list(v)
 
 
+# Reserved key carrying the schema stamp inside an encoded WriteBatch.
+# "\x00" can never start a table name (idents are [A-Za-z_][A-Za-z0-9_]*),
+# so the stamp cannot collide with user data; decoders that predate it
+# would have treated it as a (never-matching) table entry.
+META_KEY = "\x00meta"
+
+
 @dataclass
 class WriteBatch:
     """table → list[SeriesRows]."""
 
     tables: dict[str, list[SeriesRows]] = field(default_factory=dict)
+    # schema stamp: table → {"sv": schema_version, "cols": {name: col_id}}
+    # written by the vnode write path at WAL-append time; post-crash replay
+    # uses it to re-key field names by column id when the live schema moved
+    # (RENAME/DROP between the write and the crash).
+    meta: dict = field(default_factory=dict)
 
     def add_series(self, table: str, sr: SeriesRows):
         self.tables.setdefault(table, []).append(sr)
@@ -142,18 +154,56 @@ class WriteBatch:
                  {k: [vt, _enc_col(vals)] for k, (vt, vals) in sr.fields.items()}]
                 for sr in srs
             ]
+        if self.meta:
+            obj[META_KEY] = self.meta
         return msgpack.packb(obj, use_bin_type=True)
 
     @classmethod
     def decode(cls, data: bytes) -> "WriteBatch":
         obj = msgpack.unpackb(data, raw=False, strict_map_key=False)
         wb = cls()
+        wb.meta = obj.pop(META_KEY, None) or {}
         for table, srs in obj.items():
             for key_b, ts, fields in srs:
                 wb.add_series(table, SeriesRows(
                     SeriesKey.decode(key_b), _dec_col(ts),
                     {k: (int(v[0]), _dec_col(v[1])) for k, v in fields.items()}))
         return wb
+
+    # -- schema stamp ----------------------------------------------------
+    def stamp_schema(self, schemas: dict) -> None:
+        """Record each written table's schema_version + the column ids of
+        the written field names into `self.meta` (WAL-durable via encode).
+        Post-crash replay compares the stamp against the live schema and
+        re-keys fields by id, so rows written before a RENAME/DROP land
+        under the column they were written to even when the old name was
+        reused. Tables without a known schema stay unstamped (replay then
+        keeps today's name-keyed behavior)."""
+        for table, srs in self.tables.items():
+            schema = schemas.get(table)
+            if schema is None or table in self.meta:
+                continue
+            names = {n for sr in srs for n in sr.fields}
+            cols = {n: schema.column(n).id for n in names
+                    if schema.contains_column(n)}
+            self.meta[table] = {"sv": schema.schema_version, "cols": cols}
+
+    def replay_remap(self, table: str, schema) -> dict | None:
+        """→ {written_name: current_name | None(dropped)} when this batch's
+        stamp disagrees with the live schema; None when no re-keying is
+        needed (no stamp, same version, or schema unknown)."""
+        stamp = self.meta.get(table) if self.meta else None
+        if not stamp or schema is None \
+                or schema.schema_version == stamp.get("sv"):
+            return None
+        remap = {}
+        changed = False
+        for name, cid in (stamp.get("cols") or {}).items():
+            col = schema.column_by_id(cid)
+            remap[name] = None if col is None else col.name
+            if col is None or col.name != name:
+                changed = True
+        return remap if changed else None
 
     # -- convenience builder (tests, SQL INSERT path) --------------------
     @classmethod

@@ -523,16 +523,24 @@ class DatabaseOptions:
     config: dict = dc_field(default_factory=dict)
 
     def to_dict(self) -> dict:
+        # {ns, zero} shape (same as TenantOptions.drop_after): a bare int
+        # loses the zero flag, so TTL '0' would reload as INF
         return {
-            "ttl": self.ttl.ns, "shard_num": self.shard_num,
-            "vnode_duration": self.vnode_duration.ns,
+            "ttl": {"ns": self.ttl.ns, "zero": self.ttl.zero},
+            "shard_num": self.shard_num,
+            "vnode_duration": {"ns": self.vnode_duration.ns,
+                               "zero": self.vnode_duration.zero},
             "replica": self.replica, "precision": int(self.precision),
             "config": self.config,
         }
 
     @classmethod
     def from_dict(cls, d: dict) -> "DatabaseOptions":
-        out = cls(Duration(d["ttl"]), d["shard_num"], Duration(d["vnode_duration"]),
+        def dur(v) -> Duration:
+            if isinstance(v, dict):
+                return Duration(v["ns"], zero=bool(v.get("zero")))
+            return Duration(v)   # legacy bare-int form
+        out = cls(dur(d["ttl"]), d["shard_num"], dur(d["vnode_duration"]),
                   d["replica"], Precision(d["precision"]))
         out.config = dict(d.get("config") or {})
         return out

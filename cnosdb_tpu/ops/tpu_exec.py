@@ -28,6 +28,15 @@ from . import kernels
 
 _DENSE_BUCKET_LIMIT = 1 << 21
 
+# Guards the per-batch derived caches (_seg_cache / _partials) hanging off
+# SHARED scan-cache-resident batches: concurrent queries over one cached
+# snapshot race the get-or-create, the eviction pop and the read-modify-
+# write memo merge. One process-wide lock — the guarded sections are dict
+# bookkeeping only (no kernel work), so contention is negligible.
+import threading as _threading
+
+_BATCH_CACHE_LOCK = _threading.Lock()
+
 
 def _FORCE_DEVICE() -> bool:
     import os
@@ -309,10 +318,11 @@ def launch_scan_aggregate(batch: ScanBatch, query: TpuQuery):
         # re-derivation, not decode, dominates repeat queries)
         seg_key = (tuple(query.group_tags), tuple(query.group_fields),
                    origin, interval, bmin, dense_span)
-        seg_cache = getattr(batch, "_seg_cache", None)
-        if seg_cache is None:
-            seg_cache = batch._seg_cache = {}
-        cached = seg_cache.get(seg_key)
+        with _BATCH_CACHE_LOCK:
+            seg_cache = getattr(batch, "_seg_cache", None)
+            if seg_cache is None:
+                seg_cache = batch._seg_cache = {}
+            cached = seg_cache.get(seg_key)
         if cached is not None:
             seg_ids, bucket_starts, n_buckets = cached[:3]
         else:
@@ -346,19 +356,23 @@ def launch_scan_aggregate(batch: ScanBatch, query: TpuQuery):
             # the bound is deliberately tight: ≤2 shapes ≈ 2×8B/row plus
             # run layout + rank/order ≈ 8B/row — ~24B/row worst case on a
             # scan-cache-resident batch
-            while len(seg_cache) >= 2:
-                seg_cache.pop(next(iter(seg_cache)))
-            # slots: seg_ids, bucket_starts, n_buckets, counts,
-            #        run_starts, run_counts (runs built lazily)
-            seg_cache[seg_key] = [seg_ids, bucket_starts, n_buckets,
-                                  None, None, None]
+            with _BATCH_CACHE_LOCK:
+                while len(seg_cache) >= 2:
+                    seg_cache.pop(next(iter(seg_cache)))
+                # slots: seg_ids, bucket_starts, n_buckets, counts,
+                #        run_starts, run_counts (runs built lazily)
+                seg_cache[seg_key] = [seg_ids, bucket_starts, n_buckets,
+                                      None, None, None]
         num_segments = n_groups * n_buckets
 
         def cached_runs():
             """Run layout of the cached segment ids (storage batches are
             series-contiguous + time-ordered per series, so segments form
             runs; kernels.run_boundaries). → (starts, run_counts)."""
-            entry = seg_cache[seg_key]
+            entry = seg_cache.get(seg_key)
+            if entry is None:
+                # evicted by a concurrent query's insert: recompute locally
+                entry = [seg_ids, bucket_starts, n_buckets, None, None, None]
             if entry[4] is None:
                 entry[4] = kernels.run_boundaries(seg_ids, batch.sid_ordinal)
                 entry[5] = np.diff(np.append(entry[4], n))
@@ -534,9 +548,10 @@ def launch_scan_aggregate(batch: ScanBatch, query: TpuQuery):
         # native fused pass seeds the same cache.
         memo_ok = query.filter is None and sel_idx is None \
             and (row_mask is None or all_rows)
-        partials = getattr(batch, "_partials", None)
-        if partials is None:
-            partials = batch._partials = {}
+        with _BATCH_CACHE_LOCK:
+            partials = getattr(batch, "_partials", None)
+            if partials is None:
+                partials = batch._partials = {}
 
         def memo_get(cname, wants):
             if not memo_ok:
@@ -551,11 +566,12 @@ def launch_scan_aggregate(batch: ScanBatch, query: TpuQuery):
 
         def memo_put(cname, r):
             if memo_ok and isinstance(r, dict):
-                old = partials.get((seg_key, cname))
-                merged = {**old, **r} if old else dict(r)
-                while len(partials) >= 16:
-                    partials.pop(next(iter(partials)))
-                partials[(seg_key, cname)] = merged
+                with _BATCH_CACHE_LOCK:
+                    old = partials.get((seg_key, cname))
+                    merged = {**old, **r} if old else dict(r)
+                    while len(partials) >= 16:
+                        partials.pop(next(iter(partials)))
+                    partials[(seg_key, cname)] = merged
 
         col_results = {}
         for cname, wants in col_wants.items():
@@ -811,14 +827,15 @@ def _try_native_fused(batch, query, col_wants, group_of_series, n_groups,
             # computed these over the full snapshot (same eviction cap
             # as memo_put — unbounded shapes must not pile up on one
             # long-lived cached batch)
-            partials = getattr(batch, "_partials", None)
-            if partials is None:
-                partials = batch._partials = {}
-            old = partials.get((seg_cache_key, cname))
-            while len(partials) >= 16:
-                partials.pop(next(iter(partials)))
-            partials[(seg_cache_key, cname)] = \
-                {**old, **r} if old else dict(r)
+            with _BATCH_CACHE_LOCK:
+                partials = getattr(batch, "_partials", None)
+                if partials is None:
+                    partials = batch._partials = {}
+                old = partials.get((seg_cache_key, cname))
+                while len(partials) >= 16:
+                    partials.pop(next(iter(partials)))
+                partials[(seg_cache_key, cname)] = \
+                    {**old, **r} if old else dict(r)
         col_results[cname] = r
     if presence is None:
         # count(*)-only query: presence pass without a value column
@@ -841,14 +858,15 @@ def _try_native_fused(batch, query, col_wants, group_of_series, n_groups,
         # bucket_starts, n_buckets, counts, run_starts, run_counts) —
         # seg ids are filter-independent; counts only cacheable when no
         # filter shaped this presence
-        seg_cache = getattr(batch, "_seg_cache", None)
-        if seg_cache is None:
-            seg_cache = batch._seg_cache = {}
-        while len(seg_cache) >= 2:
-            seg_cache.pop(next(iter(seg_cache)))
-        seg_cache[seg_cache_key] = [
-            seg_out, bucket_starts, n_buckets,
-            presence if row_mask is None else None, None, None]
+        with _BATCH_CACHE_LOCK:
+            seg_cache = getattr(batch, "_seg_cache", None)
+            if seg_cache is None:
+                seg_cache = batch._seg_cache = {}
+            while len(seg_cache) >= 2:
+                seg_cache.pop(next(iter(seg_cache)))
+            seg_cache[seg_cache_key] = [
+                seg_out, bucket_starts, n_buckets,
+                presence if row_mask is None else None, None, None]
 
     def complete():
         return _assemble(batch, query, presence, present, col_results,

@@ -13,6 +13,7 @@ and a scan that fails on the leader's node fails over to follower replicas
 """
 from __future__ import annotations
 
+import logging
 import os
 import threading
 import time
@@ -21,7 +22,7 @@ from hashlib import blake2b
 
 import numpy as np
 
-from ..errors import CoordinatorError
+from ..errors import ChecksumMismatch, CoordinatorError
 from ..utils.backoff import Backoff
 from ..models.points import SeriesRows, WriteBatch
 from ..models.predicate import ColumnDomains, TimeRanges
@@ -29,6 +30,8 @@ from ..models.schema import TskvTableSchema, ValueType
 from ..storage.engine import TsKv
 from ..storage.scan import ScanBatch, scan_vnode
 from .meta import MetaStore
+
+log = logging.getLogger(__name__)
 
 # Per-node circuit breaker: after CB_THRESHOLD consecutive connection-level
 # failures, calls to that node fast-fail for CB_COOLDOWN seconds instead of
@@ -85,13 +88,16 @@ class Coordinator:
         self._scan_cache_lock = threading.Lock()
         # schema auto-creation callbacks land on meta; keep engine's view hot
         meta.watch(self._on_meta_event)
-        # usage_schema's bootstrap metric tables predate any create_table
-        # event — seed the engine's schema view so flushed chunks carry
-        # column ids
+        # seed the engine's schema view from the catalog for EVERY owner
+        # (not just usage_schema's bootstrap tables): on restart no
+        # create_table events replay, and WAL replay / flush need the
+        # schema to re-key replayed fields by column id and to stamp
+        # flushed chunks. MetaClient delegates `tables` to its cache
+        # replica, so distributed nodes seed the same way (and keep
+        # hydrating via watch events).
         for owner, tbls in getattr(meta, "tables", {}).items():
-            if owner.endswith(".usage_schema"):
-                for t in tbls.values():
-                    self.engine.set_table_schema(owner, t)
+            for t in tbls.values():
+                self.engine.set_table_schema(owner, t)
         # throttle clock + cumulative counters per usage metric key,
         # lock-guarded: executor/HTTP threads record concurrently
         self._usage_last: dict = {}
@@ -342,6 +348,10 @@ class Coordinator:
         leader (reference service.rs write_replica_by_raft)."""
         from ..storage.wal import WalEntryType
 
+        # stamp schema version/column ids before any encode: the WAL-bound
+        # payload then replays correctly across RENAME/DROP on every path
+        # (direct, RPC-forwarded, raft-replicated)
+        batch.stamp_schema(self.engine.schemas.get(owner, {}))
         if len(rs.vnodes) <= 1:
             target = rs.vnodes[0].node_id if rs.vnodes else self.node_id
             if not self.distributed or target == self.node_id:
@@ -650,8 +660,25 @@ class Coordinator:
         def one(split):
             if self.distributed and split.node_id != self.node_id:
                 return self._scan_remote(split, field_names)
-            return self._scan_local(split, field_names, page_constraints,
-                                    filter_key, n_threads)
+            try:
+                return self._scan_local(split, field_names, page_constraints,
+                                        filter_key, n_threads)
+            except ChecksumMismatch as e:
+                # corruption already quarantined + vnode marked BROKEN by
+                # _scan_local; fail the in-flight scan over to a replica
+                # alternate rather than erroring the query. The corrupt
+                # primary is NOT retried locally — post-quarantine it would
+                # answer with silently-missing rows.
+                alts = list(split.alternates)
+                if not alts:
+                    raise
+                fo = PlacedSplit(split.owner, alts[0][0], split.table,
+                                 split.time_ranges, split.tag_domains,
+                                 node_id=alts[0][1], alternates=alts[1:],
+                                 broken_ids=set(split.broken_ids))
+                log.warning("scan failover after corruption on vnode %s: %s",
+                            split.vnode_id, e)
+                return self._scan_remote(fo, field_names)
 
         if len(splits) > 1:
             # vnode scans are independent: decode in parallel (the C++
@@ -717,19 +744,29 @@ class Coordinator:
                     return hit[1]
                 if stale is None:
                     stale = (k, hit)
-        if stale is not None:
-            b = self._scan_delta(v, stale, token, table, trs, sids,
-                                 field_names, page_constraints,
-                                 key, key0, n_threads)
-            if b is not None:
-                return b
-        stages.count("scan_miss")
-        with stages.stage("decode_ms"):
-            b = scan_vnode(v, table, series_ids=sids, time_ranges=trs,
-                           field_names=field_names,
-                           page_constraints=page_constraints,
-                           n_threads=n_threads,
-                           upload_hook=self._upload_hook())
+        try:
+            if stale is not None:
+                b = self._scan_delta(v, stale, token, table, trs, sids,
+                                     field_names, page_constraints,
+                                     key, key0, n_threads)
+                if b is not None:
+                    return b
+            stages.count("scan_miss")
+            with stages.stage("decode_ms"):
+                b = scan_vnode(v, table, series_ids=sids, time_ranges=trs,
+                               field_names=field_names,
+                               page_constraints=page_constraints,
+                               n_threads=n_threads,
+                               upload_hook=self._upload_hook())
+        except ChecksumMismatch as e:
+            # quarantine-on-read: drop the corrupt file from the live
+            # Version (manifest-durable, excluded from every future scan),
+            # invalidate this vnode's cached batches, and mark the vnode
+            # BROKEN so scans route to replica alternates until
+            # anti-entropy repairs it. Runs HERE (not in the dispatcher)
+            # so a remote scan_vnode RPC quarantines on the owning node.
+            self._quarantine_on_read(split.owner, split.vnode_id, e)
+            raise
         if not getattr(b, "_pages_pruned", False):
             key = key0   # nothing pruned: the batch is the full scan
         self._cache_store(key, token, b)
@@ -1182,6 +1219,148 @@ class Coordinator:
                     cs = "<unreachable>"
             out.append((v.id, v.node_id, cs))
         return out
+
+    # ------------------------------------------------------- integrity
+    def _drop_vnode_cache_entries(self, owner: str, vnode_id: int) -> None:
+        """Evict every cached ScanBatch of one vnode (quarantine/repair
+        changed its on-disk truth; the data_version bump would catch a
+        probe, but the entries must not pin memory either)."""
+        with self._scan_cache_lock:
+            for k in [k for k in self._scan_cache
+                      if k[0] == owner and k[1] == vnode_id]:
+                self._scan_cache_bytes -= self._scan_cache.pop(k)[2]
+
+    def _quarantine_on_read(self, owner: str, vnode_id: int, exc) -> None:
+        """A ChecksumMismatch surfaced during a scan: quarantine the
+        offending TSM file and mark the vnode BROKEN. Advisory best-effort
+        — the scan is failing over regardless."""
+        from ..storage import scrub
+
+        scrub.count("corruptions_detected")
+        path = (getattr(exc, "ctx", None) or {}).get("path")
+        try:
+            v = self.engine.vnode(owner, vnode_id)
+            if v is not None and path \
+                    and v.quarantine_file(path=path) is not None:
+                scrub.count("files_quarantined")
+                log.warning("quarantined corrupt file %s on vnode %s",
+                            path, vnode_id)
+        except Exception:
+            log.exception("quarantine of %s failed", path)
+        self._drop_vnode_cache_entries(owner, vnode_id)
+        self._mark_vnode_broken(vnode_id)
+        self._stepdown_quarantined(vnode_id)
+
+    def on_scrub_corruption(self, owner: str, vnode_id: int,
+                            paths: list[str]) -> None:
+        """Scrubber bridge (storage/scrub.py Scrubber on_corruption): the
+        sweep already quarantined the files; finish the read-side story —
+        evict cached batches and route scans away until repair."""
+        self._drop_vnode_cache_entries(owner, vnode_id)
+        self._mark_vnode_broken(vnode_id)
+        self._stepdown_quarantined(vnode_id)
+
+    def _stepdown_quarantined(self, vnode_id: int) -> None:
+        """If the quarantined replica leads its raft group, step it down:
+        file_snapshot() refuses to serve while quarantine evidence exists
+        (a quarantined state machine diverged from its applied log), so a
+        leader that later needed the snapshot fallback could never catch a
+        follower up. A healthy peer should lead until repair. Advisory —
+        the refusal alone already guarantees safety."""
+        if self._replica_mgr is None:
+            return
+        try:
+            hit = self.meta.find_vnode(vnode_id)
+            if hit is not None:
+                owner, _bucket, rs, _v = hit
+                if self._replica_mgr.stepdown_local(owner, rs, vnode_id):
+                    log.warning("stepped down quarantined raft leader "
+                                "vnode %s", vnode_id)
+        except Exception:
+            log.exception("stepdown of quarantined vnode %s failed",
+                          vnode_id)
+
+    def anti_entropy_sweep(self) -> dict:
+        """Cross-replica repair loop: for every multi-replica set, compare
+        content checksums (checksum_group); rebuild each minority-divergent
+        replica (bit rot, quarantined files, missed writes) from a majority
+        peer via the vnode snapshot machinery, re-verify convergence, and
+        clear its BROKEN mark (reference compaction/check.rs checksum admin
+        + raft snapshot install, composed into an anti-entropy pass)."""
+        report = {"checked": 0, "repaired": [], "failed": []}
+        for owner in sorted(getattr(self.meta, "databases", {})):
+            tenant, _, db = owner.partition(".")
+            try:
+                buckets = self.meta.buckets_for(tenant, db)
+            except Exception:
+                continue
+            for bucket in buckets:
+                for rs in bucket.shard_group:
+                    if len(rs.vnodes) < 2:
+                        continue
+                    report["checked"] += 1
+                    try:
+                        self._repair_replica_set(owner, rs, report)
+                    except Exception:
+                        log.exception("anti-entropy on replica set %s "
+                                      "failed", rs.id)
+        return report
+
+    def _replica_checksum(self, owner: str, vnode_id: int, node: int) -> str:
+        if node == self.node_id or not self.distributed:
+            v = self.engine.vnode(owner, vnode_id)
+            return v.checksum() if v is not None else ""
+        try:
+            return self._rpc(node, "vnode_checksum",
+                             {"owner": owner, "vnode_id": vnode_id}) \
+                .get("checksum", "")
+        except Exception:
+            return "<unreachable>"
+
+    def _repair_replica_set(self, owner: str, rs, report: dict) -> None:
+        from collections import Counter
+
+        from ..storage import scrub
+
+        group = self.checksum_group(rs.id)
+        usable = [(vid, nid, cs) for vid, nid, cs in group
+                  if cs and cs != "<unreachable>"]
+        if len(usable) < 2:
+            return
+        majority, votes = Counter(
+            cs for _, _, cs in usable).most_common(1)[0]
+        if votes * 2 <= len(usable):
+            return  # no majority: cannot tell who holds the truth
+        donors = [(vid, nid) for vid, nid, cs in usable if cs == majority]
+        for vid, nid in ((v, n) for v, n, cs in usable if cs != majority):
+            ok = False
+            for d_vid, d_nid in donors:
+                try:
+                    data = self._fetch_vnode_snapshot(owner, d_vid, d_nid)
+                    if data is None:
+                        continue
+                    self._install_vnode_snapshot(owner, vid, nid, data)
+                    # converged = the repaired replica now matches its
+                    # donor's CURRENT checksum (the donor may have taken
+                    # writes since the group was sampled)
+                    cs2 = self._replica_checksum(owner, vid, nid)
+                    ok = bool(cs2) and cs2 != "<unreachable>" \
+                        and cs2 == self._replica_checksum(owner, d_vid, d_nid)
+                except Exception:
+                    log.exception("repair of vnode %s from %s failed",
+                                  vid, d_vid)
+                    ok = False
+                if ok:
+                    break
+            if ok:
+                scrub.count("repairs_ok")
+                self._drop_vnode_cache_entries(owner, vid)
+                self._clear_vnode_broken(vid)
+                report["repaired"].append(vid)
+                log.info("anti-entropy repaired vnode %s of %s", vid, owner)
+            else:
+                scrub.count("repairs_failed")
+                report["failed"].append(vid)
 
     def copy_vnode_to_set(self, rs_id: int, to_node: int) -> int:
         """REPLICA ADD ON <rs> NODE <n>: seed a new replica from the set's

@@ -76,6 +76,13 @@ class LogStore:
         none): outcomes at or below it are unknowable, not superseded."""
         return 0
 
+    def purged_term(self, index: int) -> int | None:
+        """Remembered term of an applied-then-purged entry, None if not
+        recorded. Purge only ever runs below the applied index, and
+        applied ⇒ committed, so a remembered term is as authoritative in
+        an AppendEntries prev-term check as the entry itself."""
+        return None
+
     def last_index(self) -> int:
         raise NotImplementedError
 
@@ -814,7 +821,18 @@ class RaftNode:
             prev_term = self.log.term_at(prev_idx)
             entries = self.log.entries_from(ni)
             if prev_idx > 0 and prev_term == 0 and self.log.entry_at(prev_idx) is None:
-                need_snapshot = True  # log purged below ni
+                # prev purged by WAL GC. Its remembered term substitutes —
+                # but only when everything from ni onward is still servable
+                # (ni itself retained, or nothing to send): a purged ni
+                # means the follower genuinely needs the state, and an
+                # empty-entries append would stall it forever instead.
+                remembered = self.log.purged_term(prev_idx)
+                can_serve = (ni > self.log.last_index()
+                             or self.log.entry_at(ni) is not None)
+                if remembered and can_serve:
+                    prev_term = remembered
+                else:
+                    need_snapshot = True  # log purged below ni
             msg = None if need_snapshot else {
                 "type": "append_entries", "from": self.node_id,
                 "term": self.term, "prev_log_index": prev_idx,
@@ -973,6 +991,13 @@ class RaftNode:
             prev_idx, prev_term = msg["prev_log_index"], msg["prev_log_term"]
             if prev_idx > 0:
                 local_term = self.log.term_at(prev_idx)
+                if local_term == 0 and self.log.entry_at(prev_idx) is None:
+                    # prev was applied here then GC'd: match against its
+                    # remembered term rather than rejecting — a reject
+                    # walks the leader's next_index down into its own
+                    # purged range and forces a full snapshot install for
+                    # state this follower already has
+                    local_term = self.log.purged_term(prev_idx) or 0
                 if local_term != prev_term:
                     conflict = min(prev_idx, self.log.last_index() + 1)
                     return {"term": self.term, "success": False,

@@ -61,7 +61,11 @@ class HttpServer:
             web.get("/debug/traces", self.handle_traces),
             web.get("/debug/backtrace", self.handle_backtrace),
             web.get("/debug/pprof", self.handle_pprof),
+            web.get("/debug/scrub", self.handle_scrub),
         ])
+        # background integrity scrubber (storage/scrub.py), attached by
+        # run_server when cfg.storage.scrub_interval > 0
+        self.scrubber = None
 
     # ------------------------------------------------------------- helpers
     def _auth(self, request) -> tuple[str, str]:
@@ -269,6 +273,33 @@ class HttpServer:
         for key, c in sorted(counts.items(), key=lambda kv: -kv[1])[:80]:
             lines.append(f"{c:6d}  {key}")
         return web.Response(text="\n".join(lines), content_type="text/plain")
+
+    async def handle_scrub(self, request):
+        """Trigger one synchronous integrity sweep over every local vnode
+        (CRC-verify TSM files, index checkpoints, sealed WAL segments;
+        corrupt files are quarantined). `?repair=1` additionally runs the
+        coordinator's anti-entropy pass so minority-divergent replicas are
+        rebuilt from healthy peers before the response returns."""
+        self._require_admin(request)
+        from ..storage import scrub
+
+        repair = request.query.get("repair", "0") not in ("0", "", "false")
+
+        def run():
+            if self.scrubber is not None:
+                res = self.scrubber.sweep_once()
+            else:
+                res = scrub.scrub_engine(
+                    self.coord.engine,
+                    on_corruption=self.coord.on_scrub_corruption)
+            out = {"scrub": res}
+            if repair:
+                out["repair"] = self.coord.anti_entropy_sweep()
+            out["counters"] = scrub.counters_snapshot()
+            return out
+
+        loop = asyncio.get_running_loop()
+        return web.json_response(await loop.run_in_executor(None, run))
 
     async def handle_opentsdb_write(self, request):
         """OpenTSDB telnet-style put lines over HTTP (reference
@@ -643,6 +674,12 @@ class HttpServer:
         entries, nbytes = self.coord.scan_cache_stats()
         self.metrics.set_gauge("cnosdb_scan_cache_entries", entries)
         self.metrics.set_gauge("cnosdb_scan_cache_bytes", nbytes)
+        # integrity plane: scrub progress + corruption/quarantine/repair
+        # totals (storage/scrub.py counters are always on)
+        from ..storage import scrub
+
+        for name, n in scrub.counters_snapshot().items():
+            self.metrics.set_gauge("cnosdb_integrity_total", n, kind=name)
         return web.Response(text=self.metrics.prometheus_text(),
                             content_type="text/plain")
 
@@ -801,8 +838,11 @@ def build_server(data_dir: str, auth_enabled: bool = False,
 
     meta = MetaStore(os.path.join(data_dir, "meta", "meta.json"))
     engine = TsKv(os.path.join(data_dir, "db"), wal_sync=wal_sync)
-    engine.open_existing()
+    # coordinator BEFORE open_existing: its init hydrates the engine's
+    # schema view from the catalog, which WAL replay needs to re-key
+    # replayed fields by column id across a pre-crash RENAME/DROP
     coord = Coordinator(meta, engine)
+    engine.open_existing()
     executor = QueryExecutor(meta, coord)
     executor.restore_streams()  # persisted streams resume at their watermark
     return HttpServer(meta, coord, executor, auth_enabled=auth_enabled)
@@ -824,8 +864,8 @@ def build_cluster_node(data_dir: str, meta_addr: str, node_id: int,
     wait_rpc_ready(meta_addr, timeout=30.0)
     meta = MetaClient(meta_addr, node_id=node_id)
     engine = TsKv(os.path.join(data_dir, "db"), wal_sync=wal_sync)
-    engine.open_existing()
     coord = Coordinator(meta, engine, node_id=node_id)
+    engine.open_existing()
     node_svc = DataNodeService(coord, host=rpc_host, port=rpc_port).start()
     meta.register_node(node_id, grpc_addr=node_svc.addr)
     meta.start_heartbeat()
@@ -859,6 +899,17 @@ def run_server(args) -> int:
                               auth_enabled=cfg.query.auth_enabled,
                               wal_sync=cfg.wal.sync)
     flight_port = cfg.service.flight_rpc_listen_port
+
+    if cfg.storage.scrub_interval > 0:
+        from ..storage.scrub import Scrubber
+
+        server.scrubber = Scrubber(
+            server.coord.engine, cfg.storage.scrub_interval,
+            mb_per_sec=cfg.storage.scrub_mb_per_sec,
+            on_corruption=server.coord.on_scrub_corruption)
+        server.scrubber.start()
+        print(f"integrity scrubber every {cfg.storage.scrub_interval}s "
+              f"at {cfg.storage.scrub_mb_per_sec} MB/s")
 
     if cfg.trace.otlp_endpoint:
         from .trace import GLOBAL_COLLECTOR, OtlpExporter
@@ -929,6 +980,8 @@ def run_server(args) -> int:
     try:
         asyncio.run(main())
     except KeyboardInterrupt:
+        if server.scrubber is not None:
+            server.scrubber.stop()
         server.coord.close()
     return 0
 

@@ -618,6 +618,11 @@ def _page_admits(cols: dict, i: int, constraints: dict) -> bool:
         lo, hi = pm.stat_min, pm.stat_max
         if lo is None or hi is None:
             continue   # no stats (e.g. all-null page): cannot prune
+        if pm.value_type == int(ValueType.FLOAT) \
+                and getattr(pm, "stats_version", 0) < 1:
+            # legacy finite-only float stats: an ±inf row may lie outside
+            # the recorded interval, so pruning on it could drop rows
+            continue
         for op, val in cons:
             if op == ">":
                 ok = hi > val

@@ -1,0 +1,371 @@
+"""Background integrity scrubber: end-to-end CRC verification at rest.
+
+Role-parity with the reference's file-level checksums plus the repair loop
+the paper's integrity plane calls for: silent corruption (bit rot, torn
+sectors, fs bugs) is found *before* a query trips over it, and found
+corruption feeds the same quarantine path the read side uses — the file is
+dropped from the live Version (manifest-durable), renamed aside, and the
+vnode is left for anti-entropy repair to restore from a healthy replica.
+
+What is verified, per vnode:
+  - every live TSM file (delta + tsm levels): footer crc via TsmReader
+    construction, then every page crc via ``_read_page`` over the full
+    chunk tree — the same codepaths a scan exercises, so a clean scrub
+    means clean reads. Known gap: the bloom region carries no crc in the
+    TSM format, so a flipped bloom bit (possible silent false-negative
+    series skip) is invisible to both scrub and reads;
+  - the index checkpoint (``index.ckpt``): magic/version header (the body
+    is msgpack + numpy sections decoded on open; a bad header is the
+    corruption signature of a torn replace);
+  - sealed WAL segments (every ``wal_*.log`` except the active tail):
+    ``record_file._valid_prefix_len`` must cover the whole file.
+
+Actively-appended record files (summary manifest, index binlog, active WAL
+segment) are deliberately NOT scrubbed — a reader racing an in-flight
+append sees a legitimately torn tail, which replay tolerates by design.
+
+Scrubbing is rate-limited by a token bucket (``scrub_mb_per_sec``) so a
+background sweep cannot starve foreground scans of disk bandwidth, and is
+off by default (``scrub_interval = 0``) so tests and benchmarks see no
+background I/O unless they opt in.
+
+Counters (always on, folded into /metrics):
+    scrub_bytes           bytes whose crcs were verified
+    scrub_files           files fully verified
+    corruptions_detected  mismatches found (scrub or read path)
+    files_quarantined     TSM files renamed aside + dropped from Version
+    repairs_ok            anti-entropy snapshot repairs that converged
+    repairs_failed        repair attempts that did not converge
+"""
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import time
+
+from ..errors import ChecksumMismatch, CnosError
+from .. import faults
+from .index import CKPT_NAME, _CKPT_MAGIC
+from .record_file import _valid_prefix_len
+from .tsm import TsmReader
+from .wal import SEGMENT_PATTERN
+
+log = logging.getLogger(__name__)
+
+# ---------------------------------------------------------------------------
+# counters — always on (stages.count_error pattern); cheap enough to never
+# gate, folded into /metrics gauges at render time
+# ---------------------------------------------------------------------------
+_COUNTER_NAMES = ("scrub_bytes", "scrub_files", "corruptions_detected",
+                  "files_quarantined", "repairs_ok", "repairs_failed")
+_counters = {k: 0 for k in _COUNTER_NAMES}
+_counters_lock = threading.Lock()
+
+
+def count(name: str, n: int = 1) -> None:
+    with _counters_lock:
+        _counters[name] = _counters.get(name, 0) + int(n)
+
+
+def counters_snapshot() -> dict[str, int]:
+    with _counters_lock:
+        return dict(_counters)
+
+
+def counters_reset() -> None:
+    """Test helper: zero all counters."""
+    with _counters_lock:
+        for k in list(_counters):
+            _counters[k] = 0
+
+
+# ---------------------------------------------------------------------------
+# rate limiting
+# ---------------------------------------------------------------------------
+class RateLimiter:
+    """Token bucket in bytes/sec; ``take`` blocks until the debt drains.
+
+    Capacity is one second's allowance, so a burst (one big TSM file read
+    at once) borrows at most ~1s ahead and then pays it back — the sweep's
+    long-run rate stays within ~2x of the configured target even though
+    verification reads whole files."""
+
+    def __init__(self, bytes_per_sec: int):
+        self.rate = max(1, int(bytes_per_sec))
+        self._avail = float(self.rate)
+        self._last = time.monotonic()
+        self._lock = threading.Lock()
+
+    def take(self, nbytes: int, stop: threading.Event | None = None) -> None:
+        while True:
+            with self._lock:
+                now = time.monotonic()
+                self._avail = min(float(self.rate),
+                                  self._avail + (now - self._last) * self.rate)
+                self._last = now
+                if self._avail > 0:
+                    self._avail -= nbytes  # may go negative: debt
+                    return
+                wait = max(-self._avail / self.rate, 0.001)
+            if stop is not None and stop.wait(min(wait, 0.25)):
+                return
+            if stop is None:
+                time.sleep(min(wait, 0.25))
+
+
+# ---------------------------------------------------------------------------
+# verification primitives — each returns bytes verified, raises
+# ChecksumMismatch on corruption
+# ---------------------------------------------------------------------------
+def verify_tsm(path: str) -> int:
+    """Footer crc + every page crc of one TSM file.
+
+    Raises ChecksumMismatch for ANY damage — crc mismatch, bad magic, a
+    meta tree that no longer decompresses — because a flip landing in the
+    meta/footer region is the same bit rot as one landing in a page. Only
+    a missing file propagates as OSError (compaction race, not damage)."""
+    size = os.path.getsize(path)
+    try:
+        r = TsmReader(path)
+    except ChecksumMismatch:
+        raise
+    except OSError:
+        raise
+    except Exception as e:
+        raise ChecksumMismatch(f"tsm structure: {e}", path=path)
+    try:
+        for group in r.groups.values():
+            for chunk in group.chunks.values():
+                for pm in chunk.time_pages:
+                    r._read_page(pm)
+                for col in chunk.columns:
+                    for pm in col.pages:
+                        r._read_page(pm)
+    except ChecksumMismatch:
+        raise
+    except Exception as e:
+        raise ChecksumMismatch(f"tsm page walk: {e}", path=path)
+    finally:
+        r.close()
+    return size
+
+
+def verify_record_file(path: str) -> int:
+    """A sealed record file must be valid crc'd records end to end."""
+    size = os.path.getsize(path)
+    ok = _valid_prefix_len(path)
+    if ok < size:
+        raise ChecksumMismatch("record crc", path=path, offset=ok)
+    return size
+
+
+def verify_index_checkpoint(path: str) -> int:
+    """Header magic/version of an index checkpoint (atomic-replace
+    artifact: a bad header means the file itself is damaged)."""
+    import struct
+
+    size = os.path.getsize(path)
+    with open(path, "rb") as f:
+        hdr = f.read(12)
+    if len(hdr) < 12:
+        raise ChecksumMismatch("index ckpt truncated", path=path, offset=0)
+    magic, _version, hlen = struct.unpack("<III", hdr)
+    if magic != _CKPT_MAGIC or 12 + hlen > size:
+        raise ChecksumMismatch("index ckpt header", path=path, offset=0)
+    return size
+
+
+# ---------------------------------------------------------------------------
+# per-vnode sweep
+# ---------------------------------------------------------------------------
+def _corrupt_window(path: str) -> tuple[int, int | None]:
+    """Flip window for the `corrupt` fault action: for TSM files, the
+    crc-covered page region [5, meta_off) — a flip in the (un-crc'd)
+    bloom region would be undetectable by design and make the fault a
+    no-op for tests; other files flip anywhere."""
+    if path.endswith(".tsm"):
+        import struct
+
+        try:
+            size = os.path.getsize(path)
+            with open(path, "rb") as f:
+                f.seek(size - 64)
+                meta_off = struct.unpack("<Q", f.read(8))[0]
+            if 5 < meta_off <= size:
+                return 5, meta_off
+        except (OSError, struct.error):
+            pass
+    return 0, None
+
+
+def _fire_read_fault(path: str) -> None:
+    """`scrub.read` fault point: lets tests flip bytes of exactly the file
+    the scrubber is about to verify (deterministic corruption-at-rest)."""
+    if faults.ENABLED:
+        hit = faults.fire("scrub.read", path=path)
+        if hit and hit[0] == "corrupt":
+            lo, hi = _corrupt_window(path)
+            faults.corrupt_file(path, int(hit[1] or 1), lo=lo, hi=hi)
+
+
+def scrub_vnode(vnode, limiter: RateLimiter | None = None,
+                stop: threading.Event | None = None) -> dict:
+    """Verify one vnode's at-rest artifacts; quarantine corrupt TSM files.
+
+    Returns a summary dict: {"bytes", "files", "corrupt": [paths]}."""
+    out = {"bytes": 0, "files": 0, "corrupt": []}
+
+    def _budget(path: str) -> int:
+        try:
+            size = os.path.getsize(path)
+        except OSError:
+            return -1  # vanished (compaction / quarantine race): skip
+        if limiter is not None:
+            limiter.take(size, stop)
+        return size
+
+    # -- live TSM files (snapshot the list; compaction may mutate) -------
+    with vnode.lock:
+        version = vnode.summary.version
+        tsm_paths = [version.file_path(fm) for fm in version.all_files()]
+    for path in tsm_paths:
+        if stop is not None and stop.is_set():
+            return out
+        if _budget(path) < 0:
+            continue
+        _fire_read_fault(path)
+        try:
+            n = verify_tsm(path)
+        except ChecksumMismatch as e:
+            log.warning("scrub: corruption in %s: %s", path, e)
+            count("corruptions_detected")
+            out["corrupt"].append(path)
+            if vnode.quarantine_file(path=path) is not None:
+                count("files_quarantined")
+            continue
+        except OSError:
+            continue  # racing delete/compaction — not corruption evidence
+        out["bytes"] += n
+        out["files"] += 1
+        count("scrub_bytes", n)
+        count("scrub_files")
+
+    # -- index checkpoint ------------------------------------------------
+    ckpt = os.path.join(vnode.dir, "index", CKPT_NAME)
+    if os.path.exists(ckpt) and not (stop is not None and stop.is_set()):
+        if _budget(ckpt) >= 0:
+            _fire_read_fault(ckpt)
+            try:
+                n = verify_index_checkpoint(ckpt)
+                out["bytes"] += n
+                out["files"] += 1
+                count("scrub_bytes", n)
+                count("scrub_files")
+            except ChecksumMismatch as e:
+                log.warning("scrub: corruption in %s: %s", ckpt, e)
+                count("corruptions_detected")
+                out["corrupt"].append(ckpt)
+            except OSError:
+                pass
+
+    # -- sealed WAL segments (all but the active tail) -------------------
+    wal_dir = os.path.join(vnode.dir, "wal")
+    try:
+        segs = sorted(n for n in os.listdir(wal_dir)
+                      if SEGMENT_PATTERN.match(n))
+    except OSError:
+        segs = []
+    for name in segs[:-1]:
+        if stop is not None and stop.is_set():
+            return out
+        path = os.path.join(wal_dir, name)
+        if _budget(path) < 0:
+            continue
+        _fire_read_fault(path)
+        try:
+            n = verify_record_file(path)
+            out["bytes"] += n
+            out["files"] += 1
+            count("scrub_bytes", n)
+            count("scrub_files")
+        except ChecksumMismatch as e:
+            log.warning("scrub: corruption in %s: %s", path, e)
+            count("corruptions_detected")
+            out["corrupt"].append(path)
+        except OSError:
+            pass
+    return out
+
+
+def scrub_engine(engine, limiter: RateLimiter | None = None,
+                 stop: threading.Event | None = None,
+                 on_corruption=None) -> dict:
+    """One full sweep over every open vnode of a TsKv engine."""
+    total = {"bytes": 0, "files": 0, "corrupt": []}
+    with engine.lock:
+        vnodes = list(engine.vnodes.items())
+    for (owner, vid), vnode in vnodes:
+        if stop is not None and stop.is_set():
+            break
+        try:
+            res = scrub_vnode(vnode, limiter, stop)
+        except CnosError as e:  # vnode closed mid-sweep
+            log.debug("scrub: skipping vnode %s/%s: %s", owner, vid, e)
+            continue
+        total["bytes"] += res["bytes"]
+        total["files"] += res["files"]
+        total["corrupt"].extend(res["corrupt"])
+        if res["corrupt"] and on_corruption is not None:
+            on_corruption(owner, vid, res["corrupt"])
+    return total
+
+
+# ---------------------------------------------------------------------------
+# background worker
+# ---------------------------------------------------------------------------
+class Scrubber:
+    """Daemon thread running ``scrub_engine`` every ``interval`` seconds.
+
+    ``on_corruption(owner, vnode_id, paths)`` (optional) is the bridge to
+    the coordinator: marking the vnode BROKEN so scans fail over, and
+    letting the anti-entropy sweep repair it from a replica."""
+
+    def __init__(self, engine, interval: int, mb_per_sec: int = 8,
+                 on_corruption=None):
+        self.engine = engine
+        self.interval = max(1, int(interval))
+        self.limiter = RateLimiter(max(1, int(mb_per_sec)) * (1 << 20))
+        self.on_corruption = on_corruption
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self.last_sweep: dict | None = None
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._thread = threading.Thread(
+            target=self._run, name="scrubber", daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=5)
+            self._thread = None
+
+    def sweep_once(self) -> dict:
+        """Synchronous full sweep (the /debug/scrub trigger); rate-limited
+        like the background loop."""
+        res = scrub_engine(self.engine, self.limiter, self._stop,
+                           self.on_corruption)
+        self.last_sweep = res
+        return res
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval):
+            try:
+                self.sweep_once()
+            except Exception:  # noqa: BLE001 — the sweep must never die
+                log.exception("scrub sweep failed")

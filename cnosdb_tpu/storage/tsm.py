@@ -32,6 +32,7 @@ from dataclasses import dataclass, field
 import msgpack
 import numpy as np
 
+from .. import faults
 from ..errors import TsmError, ChecksumMismatch
 from ..utils.zstd_compat import zstandard
 from ..models.codec import Encoding
@@ -66,14 +67,22 @@ class PageMeta:
     stat_min: float | int | None = None
     stat_max: float | int | None = None
     stat_sum: float | int | None = None
+    # stats format era. 0 = legacy writers whose float stats excluded ±inf
+    # (a page holding inf rows could carry a finite-only interval); 1 =
+    # ±inf-inclusive stats. Predicate pruning (scan._page_admits) must not
+    # prune float pages below version 1 — their interval may lie.
+    stats_version: int = 0
 
     def to_list(self):
         return [self.offset, self.size, self.n_rows, self.n_values,
                 self.value_type, self.encoding, self.min_ts, self.max_ts,
-                self.stat_min, self.stat_max, self.stat_sum]
+                self.stat_min, self.stat_max, self.stat_sum,
+                self.stats_version]
 
     @classmethod
     def from_list(cls, l):
+        # length-tolerant: files sealed before stats_version existed carry
+        # 11-element page lists and decode with the legacy default of 0
         return cls(*l)
 
 
@@ -223,7 +232,7 @@ class TsmWriter:
             chunk.time_pages.append(PageMeta(
                 off, size, len(seg), len(seg), int(ValueType.INTEGER),
                 int(Encoding.DELTA_TS), int(seg[0]), int(seg[-1]),
-                int(seg[0]), int(seg[-1]), None))
+                int(seg[0]), int(seg[-1]), None, stats_version=1))
 
         # field pages
         for name, (cid, vt, enc, values, null_mask) in columns.items():
@@ -255,7 +264,8 @@ class TsmWriter:
                 nvals = len(dense)
                 cm.pages.append(PageMeta(
                     off, size, e - s, nvals, int(vt), blk[0],
-                    int(seg_ts[0]), int(seg_ts[-1]), smin, smax, ssum))
+                    int(seg_ts[0]), int(seg_ts[-1]), smin, smax, ssum,
+                    stats_version=1))
             chunk.columns.append(cm)
         group.chunks[series_id] = chunk
 
@@ -282,6 +292,14 @@ class TsmWriter:
         self._f.close()
         os.replace(self.path + ".tmp", self.path)
         self._finished = True
+        if faults.ENABLED:
+            # silent-corruption model: flip bytes INSIDE the already-durable
+            # page region (header/meta/footer stay intact, so the file opens
+            # fine and the flip is only caught by a page-crc check)
+            hit = faults.fire("tsm.write", path=self.path)
+            if hit and hit[0] == "corrupt":
+                faults.corrupt_file(self.path, int(hit[1] or 1),
+                                    lo=5, hi=meta_off)
         return TsmFooter(meta_off, len(meta), bloom_off, len(bloom),
                          self._min_ts, self._max_ts, series_count)
 

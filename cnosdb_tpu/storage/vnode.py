@@ -99,6 +99,9 @@ class VnodeStorage:
     def write(self, batch: WriteBatch, sync: bool = False) -> int:
         """Log + apply one write batch; → assigned WAL seq."""
         with self.lock:
+            # stamp schema version + column ids into the WAL payload so a
+            # post-crash replay can re-key fields by id across RENAME/DROP
+            batch.stamp_schema(self.schemas)
             data = batch.encode()
             seq = self.wal.append(WalEntryType.WRITE, data)
             if sync:
@@ -146,8 +149,19 @@ class VnodeStorage:
     def _apply_write(self, batch: WriteBatch, seq: int):
         self.data_version += 1
         for table, series_list in batch.tables.items():
+            # the batch's schema stamp vs the live schema: replayed entries
+            # written before a RENAME/DROP re-key their fields by column id
+            # (live writes stamp and apply under one lock, so remap is None)
+            remap = batch.replay_remap(table, self.schemas.get(table))
             for sr in series_list:
                 sid = self.index.add_series_if_not_exists(sr.key)
+                if remap is not None:
+                    fields = {}
+                    for name, v in sr.fields.items():
+                        tgt = remap.get(name, name)
+                        if tgt is not None:   # None → column dropped
+                            fields[tgt] = v
+                    sr = SeriesRows(sr.key, sr.timestamps, fields)
                 self.active.write_series(table, sid, sr, seq)
         if self.active.should_flush():
             self.flush()
@@ -272,6 +286,48 @@ class VnodeStorage:
                         pass
         return True
 
+    def quarantine_file(self, path: str | None = None,
+                        file_id: int | None = None) -> int | None:
+        """Contain a corrupt TSM file: durably drop it from the manifest
+        (future scans never open it; the cached reader is closed by the
+        VersionEdit apply) and rename it to `<path>.quarantine` — kept on
+        disk as forensic evidence, invisible to the `.tsm`-suffix GC, and
+        wiped by the next snapshot install (repair). Bumps both version
+        counters so every ScanToken / scan-cache entry over this vnode
+        invalidates. → the quarantined file_id, or None when the file is
+        not (or no longer) referenced."""
+        with self.lock:
+            version = self.summary.version
+            target = None
+            for fm in version.all_files():
+                if fm.file_id == file_id or (
+                        path is not None
+                        and os.path.abspath(version.file_path(fm))
+                        == os.path.abspath(path)):
+                    target = fm
+                    break
+            if target is None:
+                return None
+            fpath = version.file_path(target)
+            self.summary.apply(VersionEdit(del_files=[target.file_id]))
+            try:
+                os.replace(fpath, fpath + ".quarantine")
+            except OSError:
+                pass   # already renamed / vanished: the manifest drop holds
+            self.data_version += 1
+            self.destructive_version += 1
+            return target.file_id
+
+    def quarantined_files(self) -> list[str]:
+        """Paths of quarantined (renamed-aside) TSM files still on disk."""
+        out = []
+        for sub in ("delta", "tsm"):
+            d = os.path.join(self.dir, sub)
+            if os.path.isdir(d):
+                out.extend(os.path.join(d, n) for n in sorted(os.listdir(d))
+                           if n.endswith(".quarantine"))
+        return out
+
     def compact_major(self) -> bool:
         """One-shot FULL compaction: merge every file of every level into
         time-partitioned, size-bounded files at one level (reference user
@@ -317,7 +373,19 @@ class VnodeStorage:
         immutable once written, so their bytes are read after release —
         a concurrent compaction that deletes one shows up as a missing
         file and triggers a retry, instead of stalling writes for the
-        whole multi-GB read."""
+        whole multi-GB read.
+
+        A vnode holding quarantined files REFUSES to snapshot: its state
+        machine no longer matches the applied log (the quarantined rows
+        are gone), so serving the snapshot — to a raft follower or a
+        repair fetch — would clone the data loss onto healthy replicas.
+        Repair wipes the quarantine evidence on install, which is what
+        re-enables snapshots afterwards."""
+        if self.quarantined_files():
+            raise StorageError(
+                f"vnode {self.vnode_id} has quarantined files: snapshot "
+                "refused (state diverged from the applied log; this "
+                "replica must be repaired from a healthy peer first)")
         skip_top = {"wal", "hardstate"}
         for _attempt in range(5):
             with self.lock:
@@ -331,6 +399,8 @@ class VnodeStorage:
                     for name in names:
                         if rel_root == "." and name == "hardstate":
                             continue
+                        if name.endswith(".quarantine"):
+                            continue   # forensic evidence, never shipped
                         rel = os.path.normpath(os.path.join(rel_root, name))
                         if name.endswith(".tsm"):
                             big.append(rel)   # immutable: read outside
@@ -354,6 +424,8 @@ class VnodeStorage:
                     continue
                 for name in names:
                     if rel_root == "." and name == "hardstate":
+                        continue
+                    if name.endswith(".quarantine"):
                         continue
                     rel = os.path.normpath(os.path.join(rel_root, name))
                     with open(os.path.join(root, name), "rb") as f:

@@ -188,6 +188,62 @@ def test_wal_log_store_roundtrip(tmp_path):
     wal3.close()
 
 
+def test_purged_log_does_not_force_snapshot_install(tmp_path):
+    """After every member GCs its applied log prefix (what a vnode flush
+    does to the WAL-backed store), heartbeats and new appends must ride
+    the remembered purged terms: falling back to install_snapshot here is
+    both wasteful (full state clone per heartbeat) and dangerous (it is
+    the path that cloned a quarantined leader's stripped state machine
+    onto healthy followers)."""
+
+    class InstallCountingSM(KvSM):
+        def __init__(self):
+            super().__init__()
+            self.installs = 0
+
+        def install_snapshot(self, data, last_index, last_term):
+            self.installs += 1
+            super().install_snapshot(data, last_index, last_term)
+
+    tx = InProcessTransport()
+    nodes, sms, wals = {}, {}, []
+    for i in range(1, 4):
+        wal = Wal(str(tmp_path / f"wal{i}"))
+        store = WalLogStore(wal, str(tmp_path / f"hs{i}"))
+        sm = InstallCountingSM()
+        nodes[i] = RaftNode("g1", i, [1, 2, 3], store, sm, tx,
+                            election_timeout=(0.05, 0.15),
+                            heartbeat_interval=0.02)
+        sms[i] = sm
+        wals.append(wal)
+    try:
+        leader = wait_leader(nodes)
+        for i in range(6):
+            put(leader, f"k{i}", i)
+        deadline = time.monotonic() + 3
+        while time.monotonic() < deadline \
+                and not all(len(sm.data) == 6 for sm in sms.values()):
+            time.sleep(0.02)
+        assert all(len(sm.data) == 6 for sm in sms.values())
+        # GC the applied prefix everywhere (vnode flush → wal purge)
+        for n in nodes.values():
+            n.log.purge_to(n.commit_index + 1)
+            assert n.log.entry_at(1) is None
+        # continued traffic replicates in place — no snapshot installs
+        put(leader, "post", 99)
+        deadline = time.monotonic() + 3
+        while time.monotonic() < deadline \
+                and not all(sm.data.get("post") == 99 for sm in sms.values()):
+            time.sleep(0.02)
+        assert all(sm.data.get("post") == 99 for sm in sms.values())
+        assert all(sm.installs == 0 for sm in sms.values())
+    finally:
+        for n in nodes.values():
+            n.stop()
+        for w in wals:
+            w.close()
+
+
 def test_snapshot_install_for_lagging_follower():
     tx, nodes, sms = make_cluster()
     try:

@@ -132,3 +132,56 @@ def test_rename_errors(db):
         db.execute_one("ALTER TABLE t RENAME COLUMN f1 TO f2")
     with pytest.raises(Exception):
         db.execute_one("ALTER TABLE t RENAME COLUMN nope TO x")
+
+
+# ---------------------------------------------------------------- crash replay
+def _build(tmp_path):
+    meta = MetaStore(str(tmp_path / "meta.json"))
+    engine = TsKv(str(tmp_path / "data"), background_compaction=False)
+    coord = Coordinator(meta, engine)
+    # coordinator BEFORE open_existing (mirrors server/http.py build_*):
+    # its init hydrates the schema view WAL replay re-keys against
+    engine.open_existing()
+    ex = QueryExecutor(meta, coord)
+    ex._engine = engine
+    return ex, engine
+
+
+def test_rename_reuse_crash_replay(tmp_path):
+    """WAL entries written BEFORE a rename chain carry the old field names;
+    post-crash replay must re-key them by column id (the WriteBatch schema
+    stamp), or historic f1 rows would land under the reused name f1."""
+    ex, engine = _build(tmp_path)
+    _setup(ex)            # rows reach memcache + WAL under f1/f2
+    _rename_chain(ex)     # f1 → g, f2 → f1 (live memcache re-keys; WAL keeps
+    #                       the as-written names + schema-version stamp)
+    # crash: WAL durable, process dies WITHOUT close() — close would flush
+    # the memcache and empty the replay window this test exists to cover
+    for v in engine.vnodes.values():
+        v.wal.sync()
+    engine._compactor.shutdown(wait=False)
+
+    ex2, engine2 = _build(tmp_path)
+    _check(ex2)           # g = historic f1 values, f1 = historic f2 values
+    engine2.close()
+
+
+def test_rename_drop_crash_replay_drops_rows(tmp_path):
+    """A column DROPPED between write and crash must not resurrect at
+    replay under a later same-named column (the stamp maps its id to a
+    column the live schema no longer has)."""
+    ex, engine = _build(tmp_path)
+    ex.execute_one("CREATE TABLE t (f1 BIGINT, f2 BIGINT, TAGS(tg))")
+    ex.execute_one(
+        "INSERT INTO t (time, tg, f1, f2) VALUES (1000, 'a', 100, 200)")
+    ex.execute_one("ALTER TABLE t DROP COLUMN f2")
+    ex.execute_one("ALTER TABLE t ADD FIELD f2 BIGINT")
+    for v in engine.vnodes.values():
+        v.wal.sync()
+    engine._compactor.shutdown(wait=False)
+
+    ex2, engine2 = _build(tmp_path)
+    rs = ex2.execute_one("SELECT time, f1, f2 FROM t ORDER BY time")
+    assert rs.columns[1].tolist() == [100]
+    assert rs.columns[2].tolist() == [None]   # dropped data stays dropped
+    engine2.close()

@@ -1,0 +1,252 @@
+"""Integrity plane, fast single-process suite (tentpole of the corruption
+scrubber PR): CRC sweep over at-rest artifacts, quarantine-on-read and
+quarantine-by-scrub, scan-cache invalidation after quarantine, the
+`corrupt` fault-grammar action, and the scrubber's rate limiter. The
+multi-node bit-flip → failover → anti-entropy-repair proof lives in
+test_chaos_cluster.py (slow-marked)."""
+import os
+import time
+
+import numpy as np
+import pytest
+
+from cnosdb_tpu import faults
+from cnosdb_tpu.errors import ChecksumMismatch
+from cnosdb_tpu.models.points import SeriesRows, WriteBatch
+from cnosdb_tpu.models.schema import TskvTableSchema, ValueType
+from cnosdb_tpu.models.series import SeriesKey
+from cnosdb_tpu.storage import scrub
+from cnosdb_tpu.storage.scan import scan_vnode
+from cnosdb_tpu.storage.vnode import VnodeStorage
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    scrub.counters_reset()
+    yield
+    faults.reset()
+    scrub.counters_reset()
+
+
+def _schema():
+    return {"cpu": TskvTableSchema.new_measurement(
+        "t", "db", "cpu", tags=["host"],
+        fields=[("usage", ValueType.FLOAT)])}
+
+
+def _wb(host, ts_list, usage_list):
+    wb = WriteBatch()
+    wb.add_series("cpu", SeriesRows(
+        SeriesKey("cpu", {"host": host}), list(ts_list),
+        {"usage": (int(ValueType.FLOAT), list(usage_list))}))
+    return wb
+
+
+def _tsm_paths(v):
+    version = v.summary.version
+    return [version.file_path(fm) for fm in version.all_files()]
+
+
+# ------------------------------------------------------------- clean sweep
+def test_clean_sweep_verifies_all_artifacts(tmp_engine_dir):
+    v = VnodeStorage(1, tmp_engine_dir, schemas=_schema())
+    v.write(_wb("h1", range(100), np.arange(100) * 0.5))
+    v.flush()
+    res = scrub.scrub_vnode(v)
+    assert res["corrupt"] == []
+    assert res["files"] >= 1
+    assert res["bytes"] >= os.path.getsize(_tsm_paths(v)[0])
+    snap = scrub.counters_snapshot()
+    assert snap["scrub_bytes"] == res["bytes"]
+    assert snap["scrub_files"] == res["files"]
+    assert snap["corruptions_detected"] == 0
+    v.close()
+
+
+def test_verify_tsm_catches_any_flip_region(tmp_engine_dir):
+    """A flip anywhere — page, meta, footer — must read as corruption."""
+    v = VnodeStorage(1, tmp_engine_dir, schemas=_schema())
+    v.write(_wb("h1", range(50), np.arange(50) * 1.0))
+    v.flush()
+    path = _tsm_paths(v)[0]
+    v.close()
+    with open(path, "rb") as f:
+        orig = f.read()
+    size = len(orig)
+    import struct
+
+    meta_off = struct.unpack_from("<Q", orig, size - 64)[0]
+    # one offset per region: a page byte, a meta byte, a footer byte
+    # (the bloom region carries no crc — a known, documented gap)
+    for off in (16, meta_off + 2, size - 8):
+        with open(path, "r+b") as f:
+            f.seek(off)
+            f.write(bytes([orig[off] ^ 0xFF]))
+        with pytest.raises(ChecksumMismatch):
+            scrub.verify_tsm(path)
+        with open(path, "wb") as f:
+            f.write(orig)
+    assert scrub.verify_tsm(path) == size
+
+
+# ------------------------------------------------------------- quarantine
+def test_scrub_quarantines_and_scan_excludes_file(tmp_engine_dir):
+    v = VnodeStorage(1, tmp_engine_dir, schemas=_schema())
+    # file 1 sealed CORRUPT via the tsm.write fault (flips inside the page
+    # region, so magic/meta/footer stay valid — the read-path signature)
+    faults.configure("tsm.write:corrupt(2):nth=1")
+    v.write(_wb("h1", [10, 20, 30], [1.0, 2.0, 3.0]))
+    v.flush()
+    faults.reset()
+    v.write(_wb("h2", [40, 50], [4.0, 5.0]))
+    v.flush()
+    assert len(_tsm_paths(v)) == 2
+
+    with pytest.raises(ChecksumMismatch):
+        scan_vnode(v, "cpu")
+
+    res = scrub.scrub_vnode(v)
+    assert len(res["corrupt"]) == 1
+    snap = scrub.counters_snapshot()
+    assert snap["corruptions_detected"] == 1
+    assert snap["files_quarantined"] == 1
+    # quarantined: dropped from the Version, renamed aside, kept on disk
+    assert len(_tsm_paths(v)) == 1
+    qs = v.quarantined_files()
+    assert len(qs) == 1 and qs[0].endswith(".quarantine")
+    # scans work again and serve exactly the surviving file
+    b = scan_vnode(v, "cpu")
+    np.testing.assert_array_equal(np.sort(b.ts), [40, 50])
+    # GC never deletes the evidence
+    from cnosdb_tpu.storage.summary import delete_unreferenced_files
+
+    delete_unreferenced_files(v.summary.version)
+    assert os.path.exists(qs[0])
+    v.close()
+
+
+def test_quarantined_vnode_refuses_file_snapshot(tmp_engine_dir):
+    """A quarantined state machine diverged from its applied raft log —
+    serving a file snapshot (to a follower or a repair fetch) would clone
+    the data loss onto healthy replicas, so it must refuse. Repair's
+    install wipes the evidence, which is what re-enables snapshots."""
+    from cnosdb_tpu.errors import StorageError
+
+    v = VnodeStorage(1, tmp_engine_dir, schemas=_schema())
+    v.write(_wb("h1", [1, 2, 3], [1.0, 2.0, 3.0]))
+    v.flush()
+    snap = v.file_snapshot()
+    assert snap["files"]
+    assert not any(r.endswith(".quarantine") for r in snap["files"])
+    assert v.quarantine_file(path=_tsm_paths(v)[0]) is not None
+    with pytest.raises(StorageError):
+        v.file_snapshot()
+    # install (repair) clears the evidence and re-enables snapshots
+    v.install_file_snapshot(snap)
+    assert v.quarantined_files() == []
+    snap2 = v.file_snapshot()
+    assert not any(r.endswith(".quarantine") for r in snap2["files"])
+    v.close()
+
+
+def test_quarantine_invalidates_scan_token(tmp_engine_dir):
+    v = VnodeStorage(1, tmp_engine_dir, schemas=_schema())
+    v.write(_wb("h1", range(20), np.arange(20) * 1.0))
+    v.flush()
+    tok = v.scan_token()
+    path = _tsm_paths(v)[0]
+    assert v.quarantine_file(path=path) is not None
+    tok2 = v.scan_token()
+    # both versions bump: exact-match cache entries AND delta rescans off
+    # the stale token are refused
+    assert tok2.data_version != tok.data_version
+    assert tok2.destructive_version != tok.destructive_version
+    v.close()
+
+
+def test_coordinator_scan_cache_invalidated_after_quarantine(tmp_path):
+    from cnosdb_tpu.parallel.coordinator import Coordinator
+    from cnosdb_tpu.parallel.meta import MetaStore
+    from cnosdb_tpu.sql.executor import QueryExecutor
+    from cnosdb_tpu.storage.engine import TsKv
+
+    meta = MetaStore(str(tmp_path / "meta.json"))
+    engine = TsKv(str(tmp_path / "data"), background_compaction=False)
+    coord = Coordinator(meta, engine)
+    ex = QueryExecutor(meta, coord)
+    ex._engine = engine
+
+    ex.execute_one("CREATE TABLE cpu (usage DOUBLE, TAGS(host))")
+    ex.execute_one(
+        "INSERT INTO cpu (time, host, usage) VALUES "
+        + ", ".join(f"({t}, 'h1', {t}.5)" for t in range(1, 31)))
+    engine.flush_all()
+    assert len(list(ex.execute_one("SELECT * FROM cpu").rows())) == 30
+    # cached now; corrupt + scrub-quarantine behind the cache's back
+    owner = "cnosdb.public"
+    (vnode,) = engine.local_vnodes(owner)
+    path = _tsm_paths(vnode)[0]
+    faults.configure("scrub.read:corrupt(2)")
+    res = scrub.scrub_engine(engine,
+                             on_corruption=coord.on_scrub_corruption)
+    faults.reset()
+    assert path in res["corrupt"]
+    # the cache must NOT serve the pre-quarantine snapshot
+    assert list(ex.execute_one("SELECT * FROM cpu").rows()) == []
+    engine.close()
+
+
+# ------------------------------------------------------------- fault grammar
+def test_corrupt_action_parses_and_fires():
+    faults.configure("scrub.read:corrupt(3):nth=2")
+    assert faults.fire("scrub.read", path="p") is None
+    assert faults.fire("scrub.read", path="p") == ("corrupt", "3")
+    assert faults.fire("scrub.read", path="p") is None
+    faults.configure("tsm.write:corrupt")
+    assert faults.fire("tsm.write", path="p") == ("corrupt", None)
+
+
+def test_corrupt_file_is_deterministic(tmp_path):
+    p = str(tmp_path / "blob.bin")
+    payload = bytes(range(256)) * 8
+    with open(p, "wb") as f:
+        f.write(payload)
+    off1 = faults.corrupt_file(p, 2)
+    with open(p, "rb") as f:
+        flipped = f.read()
+    assert flipped != payload
+    assert flipped[off1:off1 + 2] == bytes(
+        b ^ 0xFF for b in payload[off1:off1 + 2])
+    with open(p, "wb") as f:
+        f.write(payload)
+    assert faults.corrupt_file(p, 2) == off1  # same name → same offset
+
+
+def test_sealed_wal_segment_scrub(tmp_path):
+    from cnosdb_tpu.storage.record_file import RecordWriter
+
+    p = str(tmp_path / "wal_0000000001.log")
+    w = RecordWriter(p)
+    for i in range(10):
+        w.append(b"x" * 100 + bytes([i]))
+    w.close()
+    assert scrub.verify_record_file(p) == os.path.getsize(p)
+    faults.corrupt_file(p, 1, lo=16)
+    with pytest.raises(ChecksumMismatch):
+        scrub.verify_record_file(p)
+
+
+# ------------------------------------------------------------- rate limiter
+def test_rate_limiter_holds_long_run_rate():
+    rate = 40 * (1 << 20)
+    lim = scrub.RateLimiter(rate)
+    lim.take(rate)  # drain the one-second burst allowance
+    t0 = time.monotonic()
+    for _ in range(4):
+        lim.take(8 << 20)
+    elapsed = time.monotonic() - t0
+    # post-burst steady state: 32MB at 40MB/s, debt-bucket overshoots by
+    # at most one chunk → expect ~0.6s; the acceptance bound is "within
+    # 2x of scrub_mb_per_sec", i.e. must finish well under 1.6s and must
+    # not run unthrottled either
+    assert 0.4 <= elapsed < 1.6
