@@ -77,8 +77,16 @@ class QueryConfig:
     query_sql_limit: int = 16 * 1024 * 1024
     write_sql_limit: int = 160 * 1024 * 1024
     auth_enabled: bool = False
-    read_timeout_ms: int = 3_000_000
-    write_timeout_ms: int = 3_000_000
+    # default request deadlines (overridable per request via the
+    # X-CnosDB-Deadline-Ms header); the reference shipped 3_000_000 ms
+    # (50 min) which in practice meant "no deadline" — 30 s read / 10 s
+    # write keeps one slow replica from absorbing a node
+    read_timeout_ms: int = 30_000
+    write_timeout_ms: int = 10_000
+    # per-node admission gate (server/admission.py): queries running at
+    # once, and how many may wait in line before the node sheds with 503
+    max_concurrent_queries: int = 64
+    max_queued_queries: int = 128
     # shared scan/decode pool widths (utils/executor.py); 0 = auto
     scan_executor_threads: int = 0
     decode_executor_threads: int = 0

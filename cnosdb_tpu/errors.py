@@ -126,4 +126,34 @@ class AuthError(CnosError):
 
 
 class LimiterError(CnosError):
+    """Per-tenant rate/quota budget exhausted. HTTP 429 + Retry-After:
+    only THIS tenant needs to back off (contrast AdmissionRejected)."""
+
     code = "090001"
+
+    def __init__(self, message: str = "", retry_after: float = 1.0, **ctx):
+        super().__init__(message, **ctx)
+        self.retry_after = retry_after
+
+
+class DeadlineExceeded(CnosError):
+    """Request ran past its deadline budget (header or config timeout).
+
+    Deliberately NOT a QueryError subclass: retry/failover loops that
+    swallow query- or RPC-level errors must not absorb it — once the
+    budget is gone the only correct move is to unwind to the client
+    (HTTP 504)."""
+
+    code = "100001"
+
+
+class AdmissionRejected(CnosError):
+    """Shed by the per-node admission gate (queue full, or queue wait
+    would outlive the request's own deadline). HTTP 503 + Retry-After —
+    distinct from the per-tenant LimiterError 429."""
+
+    code = "100002"
+
+    def __init__(self, message: str = "", retry_after: float = 1.0, **ctx):
+        super().__init__(message, **ctx)
+        self.retry_after = retry_after

@@ -24,6 +24,7 @@ from ..models.schema import ValueType
 from ..models.strcol import DictArray
 from ..storage.scan import ScanBatch
 from ..sql.expr import Expr
+from ..utils import deadline as _deadline
 from . import kernels
 
 _DENSE_BUCKET_LIMIT = 1 << 21
@@ -575,6 +576,10 @@ def launch_scan_aggregate(batch: ScanBatch, query: TpuQuery):
 
         col_results = {}
         for cname, wants in col_wants.items():
+            # deadline checkpoint between partial-agg chunks: each column
+            # is a host-staging + device-dispatch unit, so an expired or
+            # killed request stops before paying for the next column
+            _deadline.check_current()
             cached_r = memo_get(cname, wants)
             if cached_r is not None:
                 col_results[cname] = cached_r

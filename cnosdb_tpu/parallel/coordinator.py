@@ -22,7 +22,8 @@ from hashlib import blake2b
 
 import numpy as np
 
-from ..errors import ChecksumMismatch, CoordinatorError
+from ..errors import ChecksumMismatch, CoordinatorError, DeadlineExceeded
+from ..utils import deadline as deadline_mod
 from ..utils.backoff import Backoff
 from ..models.points import SeriesRows, WriteBatch
 from ..models.predicate import ColumnDomains, TimeRanges
@@ -106,7 +107,8 @@ class Coordinator:
         self._cb: dict = {}
         self._cb_lock = threading.Lock()
 
-    def _rpc(self, node_id: int, method: str, payload: dict):
+    def _rpc(self, node_id: int, method: str, payload: dict,
+             timeout: float = 10.0):
         from .net import RpcError, RpcUnavailable, rpc_call
 
         addr = self.meta.node_addr(node_id)
@@ -124,9 +126,19 @@ class Coordinator:
                 # half-open: this call is the single probe; keep the
                 # circuit closed to everyone else until it resolves
                 st[1] = now + CB_COOLDOWN
+        dl = deadline_mod.current()
+        if dl is not None and dl.qid is not None:
+            # remember every node this request sent work to, so a kill /
+            # expiry / disconnect can fan best-effort cancel_scan out
+            dl.remote_nodes.add(addr)
         try:
-            reply = rpc_call(addr, method, payload)
+            reply = rpc_call(addr, method, payload, timeout=timeout)
         except RpcUnavailable:
+            if dl is not None and dl.dead():
+                # the socket timed out because OUR budget ran dry (or the
+                # query was killed mid-read), not because the peer is
+                # sick: don't poison the breaker or mark replicas broken
+                dl.check()  # raises DeadlineExceeded / cancelled
             with self._cb_lock:
                 st = self._cb.setdefault(node_id, [0, 0.0])
                 st[0] += 1
@@ -371,16 +383,21 @@ class Coordinator:
     def _write_replicated(self, owner: str, rs, entry_type: int, data: bytes,
                           sync: bool, timeout: float = 15.0):
         """Find the raft leader across nodes, retrying on leader change /
-        node loss (reference TskvLeaderExecutor::do_request retry loop)."""
+        node loss (reference TskvLeaderExecutor::do_request retry loop).
+        The caller's request deadline caps the whole retry budget — a
+        short-deadline write fails fast instead of riding the 15 s
+        default."""
         from .net import RpcError, RpcUnavailable
         from .raft import NotLeader
 
+        timeout = deadline_mod.cap_current(timeout)
         deadline = time.monotonic() + timeout
         bo = Backoff(initial=0.05, cap=1.0)
         hint_vnode: int | None = None
         last_err = None
         has_local = any(v.node_id == self.node_id for v in rs.vnodes)
         while time.monotonic() < deadline:
+            deadline_mod.check_current()
             # 1. a local member may be (or become) the leader
             if has_local:
                 try:
@@ -424,6 +441,7 @@ class Coordinator:
         from .net import RpcError, RpcUnavailable
         from .raft import NotLeader
 
+        timeout = deadline_mod.cap_current(timeout)
         deadline = time.monotonic() + timeout
         bo = Backoff(initial=0.05, cap=1.0)
         hint_vnode: int | None = None
@@ -431,6 +449,7 @@ class Coordinator:
         has_local = not self.distributed or \
             any(v.node_id == self.node_id for v in rs.vnodes)
         while time.monotonic() < deadline:
+            deadline_mod.check_current()
             if has_local:
                 try:
                     return self.replica_manager().change_membership_local(
@@ -923,6 +942,28 @@ class Coordinator:
         raise CoordinatorError(
             f"all replicas unreachable for vnode {split.vnode_id} "
             f"of {split.owner}") from last_unreach
+
+    def cancel_remote_scans(self, dl) -> int:
+        """Best-effort cancel fan-out: tell every node this request sent
+        work to (recorded in `dl.remote_nodes` by `_rpc`) to stop scans
+        for its qid. Fired on KILL QUERY, deadline expiry, and HTTP
+        client disconnect. Runs with the deadline scope CLEARED — the
+        whole point is that the request's own budget is already dead.
+        Returns the number of nodes that acknowledged."""
+        from .net import RpcError, rpc_call
+
+        if dl is None or not dl.qid:
+            return 0
+        acked = 0
+        with deadline_mod.scope(None):
+            for addr in list(dl.remote_nodes):
+                try:
+                    rpc_call(addr, "cancel_scan", {"qid": dl.qid},
+                             timeout=1.0)
+                    acked += 1
+                except RpcError:
+                    pass  # best-effort: the node may be gone already
+        return acked
 
     # ---------------------------------------------------------------- admin
     def drop_table(self, tenant: str, db: str, table: str):

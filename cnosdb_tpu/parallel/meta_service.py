@@ -495,7 +495,7 @@ class MetaClient:
 
     # ---------------------------------------------------------------- sync
     def refresh(self):
-        r = rpc_call(self.addr, "meta_read")
+        r = rpc_call(self.addr, "meta_read", timeout=10.0)
         self._apply(r["version"], r["snapshot"], [])
         # the snapshot already reflects every event up to its version; a
         # watch must never replay history from before it (a replayed
@@ -550,7 +550,7 @@ class MetaClient:
     def _forward(self, method: str, **kwargs):
         try:
             r = rpc_call(self.addr, "meta_write",
-                         {"method": method, "kwargs": kwargs})
+                         {"method": method, "kwargs": kwargs}, timeout=10.0)
         except RpcError as e:
             _raise_remote(e)
         self._apply(r["version"], r.get("snapshot"), r["events"])

@@ -28,7 +28,8 @@ from socket import timeout as socket_timeout
 import msgpack
 
 from .. import faults
-from ..errors import CnosError
+from ..errors import CnosError, DeadlineExceeded
+from ..utils import deadline as deadline_mod
 from ..utils import stages
 from ..utils.backoff import Backoff
 
@@ -103,10 +104,47 @@ class RpcServer:
                     if faults.ENABLED:
                         # fail/delay/crash before dispatch (server-side fault)
                         faults.fire("rpc.server", method=method)
+                    payload = unpack(body) if body else {}
+                    # request-lifecycle envelope: the caller's remaining
+                    # deadline (wall-clock epoch ms) and query id ride in
+                    # the payload; install them as this handler thread's
+                    # context so nested work (scans, decode pool, further
+                    # RPC hops) inherits the shrinking budget
+                    dl = None
+                    if isinstance(payload, dict) and (
+                            "_deadline_ms" in payload or "_qid" in payload):
+                        dl = deadline_mod.from_wire(
+                            payload.pop("_deadline_ms", None),
+                            qid=payload.pop("_qid", None))
+                        if dl.expired() or (dl.qid and
+                                            deadline_mod.CANCELS
+                                            .is_cancelled(dl.qid)):
+                            # reject already-dead work on dequeue instead
+                            # of executing it (it sat in a queue/delay
+                            # longer than the caller was willing to wait)
+                            deadline_mod.bump("expired_rejected")
+                            stages.count_error(f"rpc.{method}.expired")
+                            self._reply(500, pack(
+                                {"_err": "DeadlineExceeded",
+                                 "_msg": f"{method}: work expired before "
+                                         f"dispatch"}))
+                            return
                     with stages.stage(f"rpc_{method}_ms"):
                         with GLOBAL_COLLECTOR.from_headers(
                                 self.headers, f"rpc:{method}"):
-                            reply = fn(unpack(body) if body else {})
+                            if dl is not None and dl.qid:
+                                deadline_mod.CANCELS.register(dl.qid, dl)
+                                try:
+                                    with deadline_mod.scope(dl):
+                                        reply = fn(payload)
+                                finally:
+                                    deadline_mod.CANCELS.unregister(
+                                        dl.qid, dl)
+                            elif dl is not None:
+                                with deadline_mod.scope(dl):
+                                    reply = fn(payload)
+                            else:
+                                reply = fn(payload)
                     if faults.ENABLED and faults.fire("rpc.reply",
                                                       method=method):
                         # injected lost ack: the handler HAS applied the
@@ -191,7 +229,25 @@ def rpc_call(addr: str, method: str, payload: dict | None = None,
     retried (the classic stale keep-alive race, where the request cannot
     have been processed). A timeout or a fresh-connection failure is NOT
     retried — the server may have fully applied a non-idempotent mutation
-    whose reply was lost, and re-executing it would double-apply."""
+    whose reply was lost, and re-executing it would double-apply.
+
+    Deadline integration: when the calling thread carries a request
+    deadline (utils/deadline.py), the remaining budget caps the socket
+    timeout for this hop, the payload gains `_deadline_ms`/`_qid` so the
+    peer can reject expired work and register for cancel fan-out, and an
+    already-expired/cancelled context refuses to send at all."""
+    dl = deadline_mod.current()
+    if dl is not None:
+        # raises DeadlineExceeded / cancelled QueryError when no budget
+        # remains — do not open a socket for work that cannot finish
+        timeout = dl.cap(timeout)
+        wire = dl.to_wire_ms()
+        if wire is not None or dl.qid is not None:
+            payload = dict(payload or {})
+            if wire is not None:
+                payload["_deadline_ms"] = wire
+            if dl.qid is not None:
+                payload["_qid"] = dl.qid
     body = pack(payload or {})
     from ..server.trace import TRACE_HEADER, current_trace_header
 
@@ -246,6 +302,10 @@ def rpc_call(addr: str, method: str, payload: dict | None = None,
             # catch RpcError/RpcUnavailable must be able to fail fast
             raise RpcUnauthorized(f"{method}@{addr}: {reply.get('_msg')}")
         if resp.status != 200:
+            if reply.get("_err") == "DeadlineExceeded":
+                # typed: failover loops must unwind, not try the next
+                # replica with a budget that is already gone
+                raise DeadlineExceeded(f"{method}@{addr}: {reply.get('_msg')}")
             raise RpcError(f"{method}@{addr}: "
                            f"{reply.get('_err')}: {reply.get('_msg')}")
         return reply
@@ -256,7 +316,10 @@ def wait_rpc_ready(addr: str, method: str = "ping", timeout: float = 10.0):
     """Poll until a peer answers (process start-up races in harnesses).
 
     Jittered exponential backoff instead of a fixed 50 ms spin: N nodes
-    waiting on the same meta service otherwise hammer it in lockstep."""
+    waiting on the same meta service otherwise hammer it in lockstep.
+    A caller-carried request deadline caps the whole poll budget — a
+    short-deadline request must not wait out the full 10 s default."""
+    timeout = deadline_mod.cap_current(timeout)
     start = time.monotonic()
     deadline = start + timeout
     bo = Backoff(initial=0.02, cap=0.5)

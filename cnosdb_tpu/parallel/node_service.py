@@ -37,6 +37,7 @@ class DataNodeService:
             "write_vnode": self._write_vnode,
             "write_replica": self._write_replica,
             "scan_vnode": self._scan_vnode,
+            "cancel_scan": self._cancel_scan,
             "tag_values": self._tag_values,
             "series_keys": self._series_keys,
             "delete_vnode_range": self._delete_vnode_range,
@@ -102,6 +103,20 @@ class DataNodeService:
         if b is None:
             return {"ipc": None}
         return {"ipc": encode_scan_batch(b)}
+
+    def _cancel_scan(self, p):
+        """Best-effort cancellation fan-in (reference kill_query over the
+        coordinator's admin plane): flip the cancel flag of every handler
+        currently working for this qid (registered by the RPC server on
+        dispatch) and tombstone the qid so queued/delayed work for it is
+        rejected on dequeue instead of executed."""
+        from ..utils import deadline as deadline_mod
+
+        qid = p.get("qid")
+        if not qid:
+            return {"ok": False, "cancelled": 0}
+        n = deadline_mod.CANCELS.cancel(str(qid))
+        return {"ok": True, "cancelled": n}
 
     def _tag_values(self, p):
         return {"values": self.coord.tag_values_local(

@@ -1,0 +1,106 @@
+"""Per-node query admission gate.
+
+The reference bounds concurrent query execution with its dispatcher's
+`query_limit` semaphore (query_server dispatcher/manager.rs) on top of
+the per-tenant request limiters. This is the rebuild's equivalent: a
+bounded running-set plus a bounded FIFO-ish wait queue in front of the
+SQL endpoint.
+
+  * up to `max_concurrent` queries execute at once;
+  * up to `max_queued` more wait, each for at most its own request
+    deadline (a queued request that cannot finish in time is shed NOW,
+    not after burning its whole budget in line);
+  * everything beyond that is shed immediately with AdmissionRejected,
+    which the HTTP layer maps to 503 + Retry-After — deliberately
+    distinct from the per-tenant token-bucket LimiterError (429): 429
+    means "you specifically are over YOUR budget", 503 means "the node
+    is saturated for everyone, back off and retry".
+
+Acquisition happens on the executor worker thread (one thread per
+in-flight HTTP request), so waiting here blocks no event loop. Counters
+and queue-depth/wait gauges feed /metrics via `stats()`.
+"""
+from __future__ import annotations
+
+import threading
+import time
+
+from ..errors import AdmissionRejected
+from ..utils import deadline as deadline_mod
+
+
+class AdmissionGate:
+    def __init__(self, max_concurrent: int = 64, max_queued: int = 128):
+        self.max_concurrent = max(1, int(max_concurrent))
+        self.max_queued = max(0, int(max_queued))
+        self._cond = threading.Condition()
+        self._running = 0
+        self._queued = 0
+        # cumulative counters (cnosdb_requests_*_total)
+        self.admitted_total = 0
+        self.queued_total = 0
+        self.shed_total = 0
+        # wait-time accounting for the queue-wait gauge
+        self._wait_sum_ms = 0.0
+        self._wait_max_ms = 0.0
+
+    def acquire(self, dl: deadline_mod.Deadline | None = None) -> float:
+        """Block until admitted; returns seconds spent queued.
+
+        Raises AdmissionRejected when the queue is full, or when the
+        caller's deadline dies while waiting in line."""
+        with self._cond:
+            if self._running < self.max_concurrent and self._queued == 0:
+                self._running += 1
+                self.admitted_total += 1
+                return 0.0
+            if self._queued >= self.max_queued:
+                self.shed_total += 1
+                raise AdmissionRejected(
+                    f"admission queue full "
+                    f"({self._running} running, {self._queued} queued)",
+                    retry_after=1.0)
+            self._queued += 1
+            self.queued_total += 1
+            start = time.monotonic()
+            try:
+                while True:
+                    if dl is not None and dl.dead():
+                        self.shed_total += 1
+                        raise AdmissionRejected(
+                            "shed while queued: request deadline "
+                            f"{'cancelled' if dl.cancelled else 'expired'} "
+                            f"after {time.monotonic() - start:.2f}s in line",
+                            retry_after=1.0)
+                    if self._running < self.max_concurrent:
+                        self._running += 1
+                        self.admitted_total += 1
+                        waited = time.monotonic() - start
+                        self._wait_sum_ms += waited * 1000.0
+                        self._wait_max_ms = max(self._wait_max_ms,
+                                                waited * 1000.0)
+                        return waited
+                    rem = dl.remaining() if dl is not None else None
+                    self._cond.wait(timeout=min(rem, 0.1)
+                                    if rem is not None else 0.1)
+            finally:
+                self._queued -= 1
+
+    def release(self) -> None:
+        with self._cond:
+            self._running -= 1
+            self._cond.notify()
+
+    def stats(self) -> dict:
+        with self._cond:
+            n_adm = self.admitted_total
+            avg = self._wait_sum_ms / n_adm if n_adm else 0.0
+            return {
+                "running": self._running,
+                "queued": self._queued,
+                "admitted_total": n_adm,
+                "queued_total": self.queued_total,
+                "shed_total": self.shed_total,
+                "queue_wait_ms_avg": avg,
+                "queue_wait_ms_max": self._wait_max_ms,
+            }

@@ -17,24 +17,38 @@ import numpy as np
 from aiohttp import web
 
 from .. import __version__
-from ..errors import CnosError, ParserError, QueryError
+from ..errors import CnosError, DeadlineExceeded, ParserError, QueryError
 from ..models.schema import Precision
 from ..parallel.coordinator import Coordinator
 from ..parallel.meta import MetaStore, DEFAULT_TENANT
 from ..protocol.line_protocol import parse_lines
 from ..sql.executor import QueryExecutor, ResultSet, Session
 from ..storage.engine import TsKv
+from ..utils import deadline as deadline_mod
+from .admission import AdmissionGate
 from .metrics import MetricsRegistry
+
+# per-request deadline override (milliseconds of budget from ingress);
+# absent → the config [query] read_timeout_ms / write_timeout_ms defaults
+DEADLINE_HEADER = "X-CnosDB-Deadline-Ms"
 
 
 class HttpServer:
     def __init__(self, meta: MetaStore, coord: Coordinator,
-                 executor: QueryExecutor, auth_enabled: bool = False):
+                 executor: QueryExecutor, auth_enabled: bool = False,
+                 query_cfg=None):
+        from ..config import QueryConfig
+
         self.meta = meta
         self.coord = coord
         self.executor = executor
         self.auth_enabled = auth_enabled
         self.metrics = MetricsRegistry()
+        qc = query_cfg or QueryConfig()
+        self.read_timeout_ms = int(qc.read_timeout_ms)
+        self.write_timeout_ms = int(qc.write_timeout_ms)
+        self.gate = AdmissionGate(qc.max_concurrent_queries,
+                                  qc.max_queued_queries)
         from ..parallel.limiter import TenantLimiters
 
         self.limiters = TenantLimiters(meta)
@@ -94,6 +108,21 @@ class HttpServer:
         db = request.query.get("db", "public")
         return Session(tenant=tenant, database=db, user=user)
 
+    def _request_deadline(self, request, default_ms: int) -> deadline_mod.Deadline:
+        """Per-request lifecycle context, created once at ingress. The
+        client may shrink (or extend) the config default via the
+        X-CnosDB-Deadline-Ms header; 0 or a negative value means
+        unbounded (kill/disconnect cancellation still applies)."""
+        raw = request.headers.get(DEADLINE_HEADER)
+        ms = default_ms
+        if raw is not None:
+            try:
+                ms = int(float(raw))
+            except ValueError:
+                raise web.HTTPBadRequest(
+                    text=f"bad {DEADLINE_HEADER} header: {raw!r}")
+        return deadline_mod.Deadline(ms / 1000.0 if ms > 0 else None)
+
     def _authorize_read(self, session: Session):
         if not self.auth_enabled:
             return
@@ -128,15 +157,25 @@ class HttpServer:
         except Exception:
             return _err_response(400, ParserError(f"bad precision {precision!r}"))
         body = await request.text()
+        dl = self._request_deadline(request, self.write_timeout_ms)
+
+        def run():
+            with deadline_mod.scope(dl):
+                self.coord.write_points(session.tenant, session.database,
+                                        batch)
+
         try:
             batch = parse_lines(body, prec)
             self.limiters.check_write(session.tenant, batch.n_rows())
             loop = asyncio.get_running_loop()
-            await loop.run_in_executor(
-                None, lambda: self.coord.write_points(
-                    session.tenant, session.database, batch))
+            await loop.run_in_executor(None, run)
+        except asyncio.CancelledError:
+            dl.cancel("client disconnected")
+            raise
         except CnosError as e:
             self.metrics.incr("http_write_errors")
+            if isinstance(e, DeadlineExceeded):
+                self.metrics.incr("cnosdb_requests_deadline_exceeded_total")
             return _err_response(_status_for(e), e)
         self.metrics.incr("http_writes")
         self.metrics.incr("http_points_written", batch.n_rows())
@@ -169,17 +208,43 @@ class HttpServer:
 
         span = GLOBAL_COLLECTOR.from_headers(request.headers, "http:sql")
         span.set_tag("sql", sql[:200]).set_tag("tenant", session.tenant)
+        dl = self._request_deadline(request, self.read_timeout_ms)
 
         def run():
-            with span:
-                return self.executor.execute_sql(sql, session)
+            # on the executor worker thread: one thread per in-flight
+            # request, so blocking in the admission gate is safe
+            with deadline_mod.scope(dl):
+                self.gate.acquire(dl)   # AdmissionRejected → 503
+                try:
+                    with span:
+                        return self.executor.execute_sql(sql, session)
+                except CnosError:
+                    if dl.qid and dl.remote_nodes:
+                        # deadline expiry / kill / disconnect unwound the
+                        # query while remote vnodes may still be working:
+                        # best-effort cancel fan-out frees their workers
+                        try:
+                            self.coord.cancel_remote_scans(dl)
+                        except Exception:
+                            pass
+                    raise
+                finally:
+                    self.gate.release()
 
         try:
             self.limiters.check_query(session.tenant)
             loop = asyncio.get_running_loop()
             results = await loop.run_in_executor(None, run)
+        except asyncio.CancelledError:
+            # aiohttp cancels the handler when the client disconnects;
+            # flip the cancel flag so the (uninterruptible) worker thread
+            # unwinds at its next checkpoint and fans cancels out itself
+            dl.cancel("client disconnected")
+            raise
         except CnosError as e:
             self.metrics.incr("http_sql_errors")
+            if isinstance(e, DeadlineExceeded):
+                self.metrics.incr("cnosdb_requests_deadline_exceeded_total")
             return _err_response(_status_for(e), e)
         self.metrics.incr("http_queries")
         self._record_http_usage(request, session, "http_queries", 1)
@@ -674,6 +739,24 @@ class HttpServer:
         entries, nbytes = self.coord.scan_cache_stats()
         self.metrics.set_gauge("cnosdb_scan_cache_entries", entries)
         self.metrics.set_gauge("cnosdb_scan_cache_bytes", nbytes)
+        # request-lifecycle plane: admission gate counters + queue gauges
+        # (cnosdb_requests_deadline_exceeded_total is a true counter,
+        # incremented where the 504 is returned)
+        g = self.gate.stats()
+        self.metrics.set_gauge("cnosdb_requests_admitted_total",
+                               g["admitted_total"])
+        self.metrics.set_gauge("cnosdb_requests_queued_total",
+                               g["queued_total"])
+        self.metrics.set_gauge("cnosdb_requests_shed_total", g["shed_total"])
+        self.metrics.set_gauge("cnosdb_requests_running", g["running"])
+        self.metrics.set_gauge("cnosdb_requests_queue_depth", g["queued"])
+        self.metrics.set_gauge("cnosdb_requests_queue_wait_ms",
+                               g["queue_wait_ms_avg"], stat="avg")
+        self.metrics.set_gauge("cnosdb_requests_queue_wait_ms",
+                               g["queue_wait_ms_max"], stat="max")
+        # cancellation fan-out + shed-before-decode observability
+        for name, n in deadline_mod.counters_snapshot().items():
+            self.metrics.set_gauge("cnosdb_deadline_total", n, kind=name)
         # integrity plane: scrub progress + corruption/quarantine/repair
         # totals (storage/scrub.py counters are always on)
         from ..storage import scrub
@@ -811,27 +894,36 @@ def format_table(rs: ResultSet) -> str:
 
 def _status_for(e: CnosError) -> int:
     from ..errors import (
-        AuthError, DatabaseNotFound, LimiterError, ParserError, PlanError,
-        TableNotFound,
+        AdmissionRejected, AuthError, DatabaseNotFound, LimiterError,
+        ParserError, PlanError, TableNotFound,
     )
 
     if isinstance(e, AuthError):
         return 403
     if isinstance(e, LimiterError):
-        return 429
+        return 429          # per-tenant budget — THIS tenant backs off
+    if isinstance(e, AdmissionRejected):
+        return 503          # node saturated for everyone — shed load
+    if isinstance(e, DeadlineExceeded):
+        return 504          # request outlived its budget
     if isinstance(e, (ParserError, PlanError, DatabaseNotFound, TableNotFound)):
         return 422
     return 500
 
 
 def _err_response(status: int, e: CnosError):
+    headers = {}
+    if status in (429, 503):
+        # both shed classes are retryable; tell clients when
+        headers["Retry-After"] = str(
+            max(1, int(round(float(getattr(e, "retry_after", 1.0))))))
     return web.json_response(
         {"error_code": getattr(e, "code", "000000"), "error_message": str(e)},
-        status=status)
+        status=status, headers=headers)
 
 
 def build_server(data_dir: str, auth_enabled: bool = False,
-                 wal_sync: bool = False):
+                 wal_sync: bool = False, query_cfg=None):
     """Wire meta + engine + coordinator + executor (reference
     server.rs ServiceBuilder::build_query_storage)."""
     import os
@@ -845,12 +937,14 @@ def build_server(data_dir: str, auth_enabled: bool = False,
     engine.open_existing()
     executor = QueryExecutor(meta, coord)
     executor.restore_streams()  # persisted streams resume at their watermark
-    return HttpServer(meta, coord, executor, auth_enabled=auth_enabled)
+    return HttpServer(meta, coord, executor, auth_enabled=auth_enabled,
+                      query_cfg=query_cfg)
 
 
 def build_cluster_node(data_dir: str, meta_addr: str, node_id: int,
                        rpc_host: str = "127.0.0.1", rpc_port: int = 0,
-                       auth_enabled: bool = False, wal_sync: bool = False):
+                       auth_enabled: bool = False, wal_sync: bool = False,
+                       query_cfg=None):
     """Wire a cluster data/query node: MetaClient cache + node RPC service
     + local engine + distributed coordinator (reference server.rs
     build_query_storage in cluster deployment: AdminMeta::new +
@@ -870,7 +964,8 @@ def build_cluster_node(data_dir: str, meta_addr: str, node_id: int,
     meta.register_node(node_id, grpc_addr=node_svc.addr)
     meta.start_heartbeat()
     executor = QueryExecutor(meta, coord)
-    server = HttpServer(meta, coord, executor, auth_enabled=auth_enabled)
+    server = HttpServer(meta, coord, executor, auth_enabled=auth_enabled,
+                        query_cfg=query_cfg)
     server.node_service = node_svc
     return server
 
@@ -892,12 +987,14 @@ def run_server(args) -> int:
         server = build_cluster_node(
             args.data_dir, args.meta, getattr(args, "node_id", 1) or 1,
             rpc_port=getattr(args, "rpc_port", 0) or 0,
-            auth_enabled=cfg.query.auth_enabled, wal_sync=cfg.wal.sync)
+            auth_enabled=cfg.query.auth_enabled, wal_sync=cfg.wal.sync,
+            query_cfg=cfg.query)
         print(f"node rpc on {server.node_service.addr}")
     else:
         server = build_server(args.data_dir,
                               auth_enabled=cfg.query.auth_enabled,
-                              wal_sync=cfg.wal.sync)
+                              wal_sync=cfg.wal.sync,
+                              query_cfg=cfg.query)
     flight_port = cfg.service.flight_rpc_listen_port
 
     if cfg.storage.scrub_interval > 0:

@@ -91,7 +91,8 @@ class QueryTracker:
         self._next = 1
         self.running: dict[int, dict] = {}
 
-    def register(self, sql: str, session: "Session") -> int:
+    def register(self, sql: str, session: "Session",
+                 ctx=None) -> int:
         import time as _t
 
         with self._lock:
@@ -100,7 +101,13 @@ class QueryTracker:
             self.running[qid] = {"sql": sql, "user": session.user,
                                  "tenant": session.tenant,
                                  "db": session.database,
-                                 "start": _t.time(), "cancelled": False}
+                                 "start": _t.time(), "cancelled": False,
+                                 "ctx": ctx}
+            if ctx is not None:
+                # link the request-lifecycle context (utils/deadline.py)
+                # so KILL QUERY / disconnect can cancel in-flight remote
+                # work, not just the between-statement checks
+                ctx.qid = str(qid)
             return qid
 
     def finish(self, qid: int):
@@ -113,12 +120,23 @@ class QueryTracker:
             if q is None:
                 return False
             q["cancelled"] = True
-            return True
+            ctx = q.get("ctx")
+        if ctx is not None:
+            ctx.cancel("killed")
+        return True
+
+    def ctx_of(self, qid: int):
+        with self._lock:
+            q = self.running.get(qid)
+            return q.get("ctx") if q is not None else None
 
     def check_cancelled(self, qid: int):
         q = self.running.get(qid)
         if q is not None and q["cancelled"]:
             raise QueryError(f"query {qid} cancelled")
+        ctx = q.get("ctx") if q is not None else None
+        if ctx is not None:
+            ctx.check()  # deadline expiry / disconnect-cancel
 
     def snapshot(self) -> list[tuple[int, dict]]:
         with self._lock:
@@ -142,7 +160,13 @@ class QueryExecutor:
     # ------------------------------------------------------------------ api
     def execute_sql(self, sql: str, session: Session | None = None) -> list[ResultSet]:
         session = session or Session()
-        qid = self.tracker.register(sql, session)
+        from ..utils import deadline as _deadline_mod
+
+        # adopt the ambient request context (installed at HTTP ingress);
+        # embedded/direct callers without one keep today's no-deadline
+        # behavior — only the cooperative kill applies
+        ctx = _deadline_mod.current()
+        qid = self.tracker.register(sql, session, ctx=ctx)
         import threading as _th
 
         if not hasattr(self, "_tls"):
@@ -356,7 +380,16 @@ class QueryExecutor:
             self.meta.drop_stream(stmt.name)
             return ResultSet.message("ok")
         if isinstance(stmt, ast.KillQuery):
+            ctx = self.tracker.ctx_of(stmt.query_id)
             ok = self.tracker.kill(stmt.query_id)
+            if ok and ctx is not None:
+                # fan best-effort cancel_scan out to every node still
+                # working for this query, so remote vnode scans stop
+                # DURING the fetch instead of running to completion
+                try:
+                    self.coord.cancel_remote_scans(ctx)
+                except Exception:
+                    pass  # kill remains cooperative-best-effort
             return ResultSet.message("ok" if ok else "no such query")
         if isinstance(stmt, ast.CompactStmt):
             self.coord.engine.compact_all()

@@ -21,6 +21,7 @@ import numpy as np
 
 from ..models.predicate import TimeRange, TimeRanges
 from ..models.schema import TskvTableSchema, ValueType
+from ..utils import deadline as deadline_mod
 from ..models.strcol import DictArray, as_dict_part as _as_dict_part, \
     unify_dictionaries
 from .memcache import MemCache, _group_starts
@@ -470,6 +471,9 @@ def scan_vnode(vnode: VnodeStorage, table: str,
     kept_sids = []
     total = 0
     for ordinal, sid in enumerate(series_ids):
+        # cooperative checkpoint: a killed/expired request stops between
+        # series instead of materializing the rest of the vnode
+        deadline_mod.check_current()
         sid = int(sid)
         parts = _series_parts(vnode, table, sid, field_names, trs)
         ts, fields = merge_parts(parts, field_names)
@@ -866,6 +870,7 @@ def _scan_vnode_native(vnode: VnodeStorage, table: str,
 
     # ------------------------------------------------ python page fallbacks
     for r, pm, colname, out_off, vt in py_jobs:
+        deadline_mod.check_current()
         n = pm.n_rows
         if colname is None:
             ts_all[out_off:out_off + n] = r.read_time_page(pm)

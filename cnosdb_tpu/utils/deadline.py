@@ -1,0 +1,226 @@
+"""Per-request deadline + cancellation context.
+
+The reference threads a tokio `CancellationToken`/timeout pair from the
+HTTP service through the coordinator into tskv scans (query_server's
+QueryTracker + the per-request `Duration` budget in config). This module
+is the rebuild's equivalent for synchronous threads: a `Deadline` object
+created once at ingress (header `X-CnosDB-Deadline-Ms`, else the config
+`[query] read_timeout_ms` / `write_timeout_ms` defaults) and carried
+thread-locally so every layer below — SQL executor, coordinator fan-out,
+RPC hops, shared scan/decode pools, TPU partial-agg loops — can
+
+  * shrink its own blocking budget to the remaining time (`cap()`),
+  * refuse to start work that can no longer finish (`check()`), and
+  * observe a cooperative cancel (KILL QUERY / client disconnect).
+
+Clock discipline: expiry is tracked on the *monotonic* clock locally.
+Crossing a process boundary (RPC payload `_deadline_ms`) uses wall-clock
+epoch ms — same-host clocks in tests/clusters make this safe, and a
+skewed clock only ever makes a remote hop more or less patient, never
+wrong (the client's socket timeout is the hard bound).
+
+`CANCELS` is the node-side registry: RPC handlers running on behalf of a
+query register under its qid, and a best-effort `cancel_scan(qid)` RPC
+flips every registered context's cancel flag so in-flight scan loops
+stop at their next check.
+"""
+from __future__ import annotations
+
+import threading
+import time
+
+from ..errors import DeadlineExceeded, QueryError
+
+_tls = threading.local()
+
+# observability counters folded into /metrics by server/http.handle_metrics
+_ctr_lock = threading.Lock()
+_counters: dict[str, int] = {
+    "cancel_scan_received": 0,   # cancel_scan RPCs handled on this node
+    "tasks_shed": 0,             # pool tasks dropped before running
+    "expired_rejected": 0,       # RPCs rejected already-expired on dequeue
+}
+
+
+def bump(name: str, n: int = 1) -> None:
+    with _ctr_lock:
+        _counters[name] = _counters.get(name, 0) + n
+
+
+def counters_snapshot() -> dict[str, int]:
+    with _ctr_lock:
+        return dict(_counters)
+
+
+class Deadline:
+    """Monotonic deadline + cancel flag for one request.
+
+    `timeout_s=None` means no time bound (cancel-only context). `qid`
+    links the context to the query tracker so KILL QUERY and remote
+    cancel fan-out can find it. `remote_nodes` records every RPC address
+    the coordinator sent scan work to, for best-effort cancel fan-out.
+    """
+
+    __slots__ = ("expires_at", "qid", "cancelled", "cancel_reason",
+                 "remote_nodes")
+
+    def __init__(self, timeout_s: float | None = None, qid: str | None = None):
+        self.expires_at = (time.monotonic() + timeout_s) \
+            if timeout_s is not None else None
+        self.qid = qid
+        self.cancelled = False
+        self.cancel_reason = ""
+        self.remote_nodes: set[str] = set()
+
+    def remaining(self) -> float | None:
+        """Seconds left, None if unbounded. May be <= 0 once expired."""
+        if self.expires_at is None:
+            return None
+        return self.expires_at - time.monotonic()
+
+    def expired(self) -> bool:
+        r = self.remaining()
+        return r is not None and r <= 0
+
+    def cancel(self, reason: str = "cancelled") -> None:
+        self.cancelled = True
+        if not self.cancel_reason:
+            self.cancel_reason = reason
+
+    def dead(self) -> bool:
+        return self.cancelled or self.expired()
+
+    def check(self) -> None:
+        """Raise if this request must stop (cancelled or out of budget)."""
+        if self.cancelled:
+            raise QueryError(f"query {self.qid or '?'} cancelled"
+                             + (f" ({self.cancel_reason})"
+                                if self.cancel_reason not in
+                                ("", "cancelled") else ""))
+        r = self.remaining()
+        if r is not None and r <= 0:
+            raise DeadlineExceeded(
+                f"deadline exceeded ({-r * 1000:.0f} ms past budget)",
+                qid=self.qid)
+
+    def cap(self, timeout: float) -> float:
+        """Shrink a blocking budget to the remaining deadline.
+
+        Raises via check() when nothing remains — callers must not start
+        a blocking operation they cannot finish. Floors at 50 ms so a
+        nearly-expired request still gets a usable socket timeout rather
+        than an instant local EAGAIN-style failure."""
+        r = self.remaining()
+        if r is None:
+            return timeout
+        if r <= 0 or self.cancelled:
+            self.check()
+        return min(timeout, max(r, 0.05))
+
+    # ---- wire form (RPC payload `_deadline_ms`: wall-clock epoch ms) ----
+
+    def to_wire_ms(self) -> int | None:
+        r = self.remaining()
+        if r is None:
+            return None
+        return int((time.time() + max(r, 0.0)) * 1000)
+
+
+def from_wire(deadline_at_ms: int | None, qid: str | None = None) -> Deadline:
+    if deadline_at_ms is None:
+        return Deadline(None, qid=qid)
+    return Deadline(deadline_at_ms / 1000.0 - time.time(), qid=qid)
+
+
+def current() -> Deadline | None:
+    return getattr(_tls, "dl", None)
+
+
+class scope:
+    """Install `dl` as the thread's current deadline; None clears it
+    (used by cancel fan-out, which must run even after expiry)."""
+
+    def __init__(self, dl: Deadline | None):
+        self.dl = dl
+        self.prev: Deadline | None = None
+
+    def __enter__(self):
+        self.prev = getattr(_tls, "dl", None)
+        _tls.dl = self.dl
+        return self.dl
+
+    def __exit__(self, *exc):
+        _tls.dl = self.prev
+        return False
+
+
+def check_current() -> None:
+    """Cheap cooperative checkpoint for inner loops (scan/decode/agg)."""
+    dl = getattr(_tls, "dl", None)
+    if dl is not None:
+        dl.check()
+
+
+def cap_current(timeout: float) -> float:
+    dl = getattr(_tls, "dl", None)
+    if dl is None:
+        return timeout
+    return dl.cap(timeout)
+
+
+class CancelRegistry:
+    """Node-side per-qid cancel flags.
+
+    `register` remembers a Deadline working for qid (RPC handlers do this
+    on dispatch); `cancel(qid)` flips every registered context and leaves
+    a tombstone so work for that qid arriving shortly *after* the cancel
+    (e.g. still sitting in a fault-injected delay) is rejected on
+    dequeue instead of executed."""
+
+    TOMBSTONE_TTL = 60.0
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._working: dict[str, list[Deadline]] = {}
+        self._tombstones: dict[str, float] = {}
+
+    def _prune(self, now: float) -> None:
+        dead = [q for q, t in self._tombstones.items()
+                if now - t > self.TOMBSTONE_TTL]
+        for q in dead:
+            del self._tombstones[q]
+
+    def register(self, qid: str, dl: Deadline) -> None:
+        with self._lock:
+            if qid in self._tombstones:
+                dl.cancel("cancelled before dispatch")
+            self._working.setdefault(qid, []).append(dl)
+
+    def unregister(self, qid: str, dl: Deadline) -> None:
+        with self._lock:
+            lst = self._working.get(qid)
+            if lst is not None:
+                try:
+                    lst.remove(dl)
+                except ValueError:
+                    pass
+                if not lst:
+                    del self._working[qid]
+
+    def is_cancelled(self, qid: str) -> bool:
+        with self._lock:
+            return qid in self._tombstones
+
+    def cancel(self, qid: str) -> int:
+        now = time.monotonic()
+        with self._lock:
+            self._prune(now)
+            self._tombstones[qid] = now
+            victims = list(self._working.get(qid, ()))
+        for dl in victims:
+            dl.cancel("remote cancel")
+        bump("cancel_scan_received")
+        return len(victims)
+
+
+CANCELS = CancelRegistry()

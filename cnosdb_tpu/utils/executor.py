@@ -22,6 +22,9 @@ from __future__ import annotations
 import os
 import threading
 from concurrent.futures import ThreadPoolExecutor
+from concurrent.futures import TimeoutError as _FuturesTimeout
+
+from . import deadline as deadline_mod
 
 _lock = threading.Lock()
 _pools: dict[str, ThreadPoolExecutor] = {}
@@ -64,11 +67,25 @@ def _pool(name: str) -> ThreadPoolExecutor:
 
 
 def submit(name: str, fn, *args):
-    """Submit to the named shared pool with active-task accounting."""
+    """Submit to the named shared pool with active-task accounting.
+
+    The submitting thread's request deadline (if any) is captured here
+    and re-installed in the worker, so deadline/cancel state crosses the
+    pool boundary. Shed-before-run: a task whose request is already dead
+    by the time a worker picks it up raises instead of executing —
+    queued column decodes for an expired scan never start."""
+    dl = deadline_mod.current()
+
     def run():
         with _lock:
             _active[name] += 1
         try:
+            if dl is not None:
+                if dl.dead():
+                    deadline_mod.bump("tasks_shed")
+                    dl.check()
+                with deadline_mod.scope(dl):
+                    return fn(*args)
             return fn(*args)
         finally:
             with _lock:
@@ -78,9 +95,32 @@ def submit(name: str, fn, *args):
 
 def run_all(name: str, fn, items: list) -> list:
     """Run fn over items on the named pool, results in item order.
-    Exceptions propagate (matching the executor.map the scan used)."""
+    Exceptions propagate (matching the executor.map the scan used).
+
+    With a request deadline in scope, the wait polls so a kill/expiry
+    unblocks the caller promptly even while a worker is still stuck in
+    a remote read (the worker itself is bounded by its capped socket
+    timeout and its own shed checks)."""
     futures = [submit(name, fn, it) for it in items]
-    return [f.result() for f in futures]
+    dl = deadline_mod.current()
+    if dl is None:
+        return [f.result() for f in futures]
+    out = []
+    try:
+        for f in futures:
+            while True:
+                try:
+                    out.append(f.result(timeout=0.05))
+                    break
+                except _FuturesTimeout:
+                    dl.check()
+        return out
+    finally:
+        # a raise above abandons the remaining futures; cancel whatever
+        # has not started so shed accounting stays truthful
+        if len(out) != len(futures):
+            for f in futures:
+                f.cancel()
 
 
 def pool_size(name: str) -> int:

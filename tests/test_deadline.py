@@ -1,0 +1,482 @@
+"""Request-lifecycle plane: deadlines, cancellation, admission control.
+
+Fast (no-cluster) coverage for utils/deadline.py, the shared-pool
+deadline propagation in utils/executor.py, the admission gate, the RPC
+deadline envelope in parallel/net.py, and the HTTP status mapping
+(429 limiter vs 503 admission vs 504 deadline, each with Retry-After
+where retryable). Cluster-level acceptance lives in
+test_deadline_cluster.py.
+"""
+import threading
+import time
+
+import pytest
+
+from cnosdb_tpu.config import Config
+from cnosdb_tpu.errors import (
+    AdmissionRejected, DeadlineExceeded, LimiterError, QueryError,
+)
+from cnosdb_tpu.server.admission import AdmissionGate
+from cnosdb_tpu.utils import deadline as deadline_mod
+from cnosdb_tpu.utils import executor as pool_mod
+from cnosdb_tpu.utils.deadline import CANCELS, Deadline
+
+
+# ------------------------------------------------------------ Deadline unit
+def test_deadline_basics():
+    dl = Deadline(10.0, qid="7")
+    assert not dl.expired() and not dl.dead()
+    assert 9.0 < dl.remaining() <= 10.0
+    dl.check()  # healthy: no raise
+
+    dl2 = Deadline(None)
+    assert dl2.remaining() is None and not dl2.expired()
+    dl2.check()
+
+    expired = Deadline(-0.01)
+    assert expired.expired() and expired.dead()
+    with pytest.raises(DeadlineExceeded):
+        expired.check()
+
+
+def test_deadline_cancel_wins_over_time():
+    dl = Deadline(60.0, qid="9")
+    dl.cancel("killed")
+    assert dl.dead() and not dl.expired()
+    with pytest.raises(QueryError, match="cancelled"):
+        dl.check()
+    # first reason sticks
+    dl.cancel("other")
+    assert dl.cancel_reason == "killed"
+
+
+def test_deadline_cap():
+    dl = Deadline(0.5)
+    assert dl.cap(10.0) <= 0.5
+    assert dl.cap(0.1) == pytest.approx(0.1, abs=0.01)
+    # floor: a nearly-dead request still gets a usable socket timeout
+    floor = Deadline(10.0)
+    floor.expires_at = time.monotonic() + 0.01
+    assert floor.cap(10.0) == pytest.approx(0.05, abs=0.02)
+    with pytest.raises(DeadlineExceeded):
+        Deadline(-1.0).cap(10.0)
+    assert Deadline(None).cap(3.0) == 3.0
+
+
+def test_wire_roundtrip():
+    dl = Deadline(5.0, qid="42")
+    wire = dl.to_wire_ms()
+    back = deadline_mod.from_wire(wire, qid="42")
+    assert back.qid == "42"
+    assert abs(back.remaining() - dl.remaining()) < 0.25
+    unbounded = deadline_mod.from_wire(None, qid="x")
+    assert unbounded.remaining() is None
+
+
+def test_scope_install_and_clear():
+    assert deadline_mod.current() is None
+    dl = Deadline(5.0)
+    with deadline_mod.scope(dl):
+        assert deadline_mod.current() is dl
+        with deadline_mod.scope(None):  # cancel fan-out idiom
+            assert deadline_mod.current() is None
+        assert deadline_mod.current() is dl
+    assert deadline_mod.current() is None
+
+
+def test_check_and_cap_current_without_scope():
+    deadline_mod.check_current()          # no scope: no-op
+    assert deadline_mod.cap_current(7.0) == 7.0
+    with deadline_mod.scope(Deadline(0.5)):
+        assert deadline_mod.cap_current(7.0) <= 0.5
+        with pytest.raises(DeadlineExceeded):
+            with deadline_mod.scope(Deadline(-1.0)):
+                deadline_mod.check_current()
+
+
+# ------------------------------------------------- shared pools propagation
+def test_pool_propagates_deadline_scope():
+    dl = Deadline(30.0, qid="p1")
+    with deadline_mod.scope(dl):
+        f = pool_mod.submit("scan", deadline_mod.current)
+    assert f.result(timeout=5) is dl
+    # and the worker restores its own state afterwards
+    f2 = pool_mod.submit("scan", deadline_mod.current)
+    assert f2.result(timeout=5) is None
+
+
+def test_pool_sheds_task_for_dead_request():
+    shed_before = deadline_mod.counters_snapshot()["tasks_shed"]
+    dl = Deadline(30.0)
+    dl.cancel("killed")
+    ran = []
+    with deadline_mod.scope(dl):
+        f = pool_mod.submit("decode", lambda: ran.append(1))
+    with pytest.raises(QueryError, match="cancelled"):
+        f.result(timeout=5)
+    assert not ran  # shed BEFORE running
+    assert deadline_mod.counters_snapshot()["tasks_shed"] == shed_before + 1
+
+
+def test_run_all_unblocks_promptly_on_cancel():
+    dl = Deadline(30.0, qid="p2")
+    release = threading.Event()
+
+    def slow(_):
+        release.wait(10.0)
+        return 1
+
+    def killer():
+        time.sleep(0.2)
+        dl.cancel("killed")
+
+    threading.Thread(target=killer, daemon=True).start()
+    t0 = time.monotonic()
+    with deadline_mod.scope(dl):
+        with pytest.raises(QueryError, match="cancelled"):
+            pool_mod.run_all("scan", slow, [1, 2])
+    elapsed = time.monotonic() - t0
+    release.set()  # free the workers
+    assert elapsed < 2.0, f"run_all held the caller {elapsed:.2f}s past kill"
+
+
+def test_run_all_without_deadline_plain_results():
+    assert pool_mod.run_all("scan", lambda x: x * 2, [1, 2, 3]) == [2, 4, 6]
+
+
+# --------------------------------------------------------- admission gate
+def test_gate_admits_within_capacity():
+    g = AdmissionGate(max_concurrent=2, max_queued=2)
+    assert g.acquire(None) == 0.0
+    assert g.acquire(None) == 0.0
+    s = g.stats()
+    assert s["running"] == 2 and s["admitted_total"] == 2
+    g.release(), g.release()
+    assert g.stats()["running"] == 0
+
+
+def test_gate_sheds_when_queue_full():
+    g = AdmissionGate(max_concurrent=1, max_queued=0)
+    g.acquire(None)
+    with pytest.raises(AdmissionRejected) as ei:
+        g.acquire(None)
+    assert ei.value.retry_after >= 1.0
+    assert g.stats()["shed_total"] == 1
+    g.release()
+
+
+def test_gate_queued_request_shed_on_deadline_expiry():
+    g = AdmissionGate(max_concurrent=1, max_queued=4)
+    g.acquire(None)  # occupy the only slot
+    t0 = time.monotonic()
+    with pytest.raises(AdmissionRejected, match="shed while queued"):
+        g.acquire(Deadline(0.3))
+    elapsed = time.monotonic() - t0
+    assert elapsed < 1.5, "queued waiter should shed at its own deadline"
+    s = g.stats()
+    assert s["shed_total"] == 1 and s["queued"] == 0
+    g.release()
+
+
+def test_gate_queued_request_admitted_after_release():
+    g = AdmissionGate(max_concurrent=1, max_queued=4)
+    g.acquire(None)
+    got = []
+
+    def waiter():
+        got.append(g.acquire(Deadline(10.0)))
+
+    t = threading.Thread(target=waiter, daemon=True)
+    t.start()
+    time.sleep(0.15)
+    assert g.stats()["queued"] == 1
+    g.release()
+    t.join(timeout=5)
+    assert len(got) == 1 and got[0] >= 0.0  # waited, then admitted
+    s = g.stats()
+    assert s["admitted_total"] == 2 and s["queue_wait_ms_max"] > 0.0
+    g.release()
+
+
+# ---------------------------------------------------- config knob satellite
+def test_query_timeout_knobs_default_and_roundtrip(tmp_path):
+    c = Config()
+    assert c.query.read_timeout_ms == 30_000
+    assert c.query.write_timeout_ms == 10_000
+    assert c.query.max_concurrent_queries == 64
+    assert c.query.max_queued_queries == 128
+    text = c.to_toml()
+    for knob in ("read_timeout_ms", "write_timeout_ms",
+                 "max_concurrent_queries", "max_queued_queries"):
+        assert knob in text
+    p = tmp_path / "c.toml"
+    p.write_text("[query]\nread_timeout_ms = 1234\n"
+                 "max_concurrent_queries = 3\n")
+    c2 = Config.load(str(p))
+    assert c2.query.read_timeout_ms == 1234
+    assert c2.query.max_concurrent_queries == 3
+    c3 = Config.load(str(p), env={"CNOSDB_QUERY_WRITE_TIMEOUT_MS": "777"})
+    assert c3.query.write_timeout_ms == 777
+
+
+# ------------------------------------------------------------ RPC envelope
+@pytest.fixture
+def rpc_server():
+    from cnosdb_tpu.parallel.net import RpcServer
+
+    calls = []
+
+    def slow(p):
+        time.sleep(float(p.get("sleep", 1.5)))
+        return {"ok": True}
+
+    def spin(p):
+        # cooperative loop: runs until its installed deadline is cancelled
+        deadline = time.monotonic() + 8.0
+        while time.monotonic() < deadline:
+            deadline_mod.check_current()
+            time.sleep(0.02)
+        return {"ok": True, "timed_out": True}
+
+    def echo(p):
+        calls.append(p)
+        return {"ok": True}
+
+    srv = RpcServer("127.0.0.1", 0, {"slow": slow, "spin": spin,
+                                     "echo": echo}).start()
+    srv.test_calls = calls
+    yield srv
+    srv.stop()
+
+
+def test_rpc_timeout_capped_by_deadline(rpc_server):
+    from cnosdb_tpu.parallel.net import RpcUnavailable, rpc_call
+
+    t0 = time.monotonic()
+    with deadline_mod.scope(Deadline(0.4)):
+        with pytest.raises((RpcUnavailable, DeadlineExceeded)):
+            rpc_call(rpc_server.addr, "slow", {"sleep": 5.0}, timeout=10.0)
+    elapsed = time.monotonic() - t0
+    assert elapsed < 1.5, (
+        f"hop took {elapsed:.2f}s — socket timeout was not capped to the "
+        f"request's remaining budget")
+
+
+def test_rpc_refuses_to_send_when_dead(rpc_server):
+    from cnosdb_tpu.parallel.net import rpc_call
+
+    with deadline_mod.scope(Deadline(-1.0)):
+        with pytest.raises(DeadlineExceeded):
+            rpc_call(rpc_server.addr, "echo", {}, timeout=5.0)
+    assert not rpc_server.test_calls  # never reached the wire
+
+
+def test_rpc_server_rejects_expired_work_on_dequeue(rpc_server):
+    from cnosdb_tpu.parallel.net import rpc_call
+
+    before = deadline_mod.counters_snapshot()["expired_rejected"]
+    past = int((time.time() - 5.0) * 1000)
+    with pytest.raises(DeadlineExceeded, match="expired before dispatch"):
+        rpc_call(rpc_server.addr, "echo",
+                 {"_deadline_ms": past, "_qid": "qx"}, timeout=5.0)
+    assert not rpc_server.test_calls  # handler never dispatched
+    assert deadline_mod.counters_snapshot()["expired_rejected"] == before + 1
+
+
+def test_rpc_deadline_envelope_stripped_before_handler(rpc_server):
+    from cnosdb_tpu.parallel.net import rpc_call
+
+    with deadline_mod.scope(Deadline(5.0, qid="q-env")):
+        rpc_call(rpc_server.addr, "echo", {"a": 1}, timeout=5.0)
+    assert rpc_server.test_calls == [{"a": 1}]  # _deadline_ms/_qid popped
+
+
+def test_cancel_registry_flips_inflight_handler(rpc_server):
+    from cnosdb_tpu.parallel.net import rpc_call
+
+    qid = "q-cancel-1"
+    err, t0 = [], time.monotonic()
+
+    def call():
+        with deadline_mod.scope(Deadline(20.0, qid=qid)):
+            try:
+                rpc_call(rpc_server.addr, "spin", {}, timeout=20.0)
+            except Exception as e:  # noqa: BLE001 - recording for assert
+                err.append(e)
+
+    th = threading.Thread(target=call, daemon=True)
+    th.start()
+    # wait for the handler to register under the qid, then cancel it
+    for _ in range(100):
+        if CANCELS._working.get(qid):
+            break
+        time.sleep(0.02)
+    else:
+        pytest.fail("handler never registered in CANCELS")
+    assert CANCELS.cancel(qid) >= 1
+    th.join(timeout=5)
+    elapsed = time.monotonic() - t0
+    assert err and elapsed < 3.0, "cancel did not end the in-flight handler"
+    # tombstone: later work for the same qid is rejected on dequeue
+    with deadline_mod.scope(Deadline(5.0, qid=qid)):
+        with pytest.raises(DeadlineExceeded):
+            rpc_call(rpc_server.addr, "echo", {}, timeout=5.0)
+
+
+# --------------------------------------------------- HTTP status mapping
+class _Harness:
+    """Real aiohttp server in a thread; urllib client returning headers."""
+
+    def __init__(self, data_dir: str):
+        import asyncio
+        import socket
+
+        from cnosdb_tpu.server.http import build_server
+
+        self.server = build_server(data_dir)
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            self.port = s.getsockname()[1]
+        self._loop = asyncio.new_event_loop()
+        self._started = threading.Event()
+
+        def run():
+            asyncio.set_event_loop(self._loop)
+
+            async def boot():
+                self._runner = await self.server.start("127.0.0.1", self.port)
+                self._started.set()
+
+            self._loop.create_task(boot())
+            self._loop.run_forever()
+
+        self._thread = threading.Thread(target=run, daemon=True)
+        self._thread.start()
+        assert self._started.wait(10)
+
+    def request(self, method, path, data=None, headers=None):
+        """→ (status, body, response-headers dict)."""
+        import urllib.error
+        import urllib.request
+
+        url = f"http://127.0.0.1:{self.port}{path}"
+        req = urllib.request.Request(
+            url, data=data.encode() if data is not None else None,
+            headers=headers or {}, method=method)
+        try:
+            with urllib.request.urlopen(req, timeout=30) as resp:
+                return resp.status, resp.read().decode(), dict(resp.headers)
+        except urllib.error.HTTPError as e:
+            return e.code, e.read().decode(), dict(e.headers)
+
+    def close(self):
+        self._loop.call_soon_threadsafe(self._loop.stop)
+        self._thread.join(timeout=5)
+        self.server.coord.close()
+
+
+@pytest.fixture
+def http(tmp_path):
+    h = _Harness(str(tmp_path / "srv"))
+    yield h
+    h.close()
+
+
+def _seed_rows(h, n=20):
+    lines = "\n".join(
+        f"cpu,host=h{i % 4} usage={i}.5 {1672531200000000000 + i * 10**9}"
+        for i in range(n))
+    status, body, _ = h.request("POST", "/api/v1/write?db=public", lines)
+    assert status == 200, body
+
+
+def test_http_limiter_429_vs_admission_503(http):
+    """Satellite: the two shed classes stay distinct, both retryable."""
+    _seed_rows(http)
+
+    def over_budget(tenant):
+        raise LimiterError("tenant over query budget", retry_after=7.0)
+
+    orig = http.server.limiters.check_query
+    http.server.limiters.check_query = over_budget
+    try:
+        status, body, hdrs = http.request(
+            "POST", "/api/v1/sql?db=public", "SELECT count(*) FROM cpu")
+        assert status == 429, body
+        assert hdrs.get("Retry-After") == "7"
+    finally:
+        http.server.limiters.check_query = orig
+
+    # node saturated: single slot held, zero queue → immediate 503
+    http.server.gate = AdmissionGate(max_concurrent=1, max_queued=0)
+    http.server.gate.acquire(None)
+    try:
+        status, body, hdrs = http.request(
+            "POST", "/api/v1/sql?db=public", "SELECT count(*) FROM cpu")
+        assert status == 503, body
+        assert hdrs.get("Retry-After") == "1"
+    finally:
+        http.server.gate.release()
+    # capacity restored → back to 200
+    status, body, _ = http.request(
+        "POST", "/api/v1/sql?db=public", "SELECT count(*) FROM cpu")
+    assert status == 200, body
+
+
+def test_http_deadline_header_504_and_counter(http):
+    _seed_rows(http)
+    # delay execution past the 1 ms budget so expiry is deterministic even
+    # in a warm process (the real checkpoints then observe a dead deadline)
+    orig_exec = http.server.executor.execute_sql
+
+    def slow_exec(sql, session):
+        time.sleep(0.05)
+        return orig_exec(sql, session)
+
+    http.server.executor.execute_sql = slow_exec
+    try:
+        status, body, _ = http.request(
+            "POST", "/api/v1/sql?db=public",
+            "SELECT count(*) FROM cpu",
+            headers={"X-CnosDB-Deadline-Ms": "1"})
+    finally:
+        http.server.executor.execute_sql = orig_exec
+    assert status == 504, body
+    assert "deadline" in body.lower() or "expired" in body.lower() \
+        or "cancel" in body.lower(), body
+    status, text, _ = http.request("GET", "/metrics")
+    assert status == 200
+    line = next(ln for ln in text.splitlines()
+                if ln.startswith("cnosdb_requests_deadline_exceeded_total"))
+    assert float(line.rsplit(" ", 1)[1]) >= 1
+    # a sane deadline still succeeds
+    status, body, _ = http.request(
+        "POST", "/api/v1/sql?db=public", "SELECT count(*) FROM cpu",
+        headers={"X-CnosDB-Deadline-Ms": "30000"})
+    assert status == 200, body
+
+
+def test_http_bad_deadline_header_400(http):
+    status, body, _ = http.request(
+        "POST", "/api/v1/sql?db=public", "SELECT 1",
+        headers={"X-CnosDB-Deadline-Ms": "soon"})
+    assert status == 400, body
+
+
+def test_http_metrics_exports_request_lifecycle_gauges(http):
+    _seed_rows(http, n=4)
+    status, _, _ = http.request(
+        "POST", "/api/v1/sql?db=public", "SELECT count(*) FROM cpu")
+    assert status == 200
+    status, text, _ = http.request("GET", "/metrics")
+    assert status == 200
+    for metric in ("cnosdb_requests_admitted_total",
+                   "cnosdb_requests_shed_total",
+                   "cnosdb_requests_queue_depth",
+                   "cnosdb_requests_queue_wait_ms",
+                   "cnosdb_deadline_total"):
+        assert metric in text, f"missing {metric} on /metrics"
+    # the sql above went through the gate
+    line = next(ln for ln in text.splitlines()
+                if ln.startswith("cnosdb_requests_admitted_total"))
+    assert float(line.rsplit(" ", 1)[1]) >= 1

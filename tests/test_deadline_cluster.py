@@ -1,0 +1,281 @@
+"""Request-lifecycle plane against a real 2-node cluster.
+
+Fast (tier-1) coverage:
+
+- a remote vnode fetch slower than the request deadline returns 504
+  within ~1.2x the deadline (the capped socket timeout, NOT the 10 s RPC
+  default or the injected 3 s delay), and the deadline-exceeded counter
+  increments;
+- KILL QUERY landing while the coordinator is blocked in a remote scan
+  RPC ends the query promptly AND the remote node receives the
+  best-effort cancel_scan fan-out.
+
+Slow (excluded from tier-1): an overload storm against a tiny admission
+gate yields only 200/429/503, admitted queries return correct results,
+and the node-side pools/gate drain back to zero afterwards.
+
+The injected delay uses the fault plane exactly like test_chaos_cluster:
+CNOSDB_FAULTS in the spawned nodes' env arms the `_faults` control RPC.
+"""
+import base64
+import os
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from cluster_harness import Cluster
+from cnosdb_tpu.parallel.net import rpc_call
+
+pytestmark = [pytest.mark.cluster]
+
+
+@pytest.fixture(scope="module")
+def cluster(tmp_path_factory):
+    os.environ["CNOSDB_FAULTS"] = "seed=1"
+    try:
+        c = Cluster(str(tmp_path_factory.mktemp("ddl")), n_nodes=2).start()
+    finally:
+        del os.environ["CNOSDB_FAULTS"]
+    yield c
+    c.stop()
+
+
+def _set_faults(node, spec: str) -> dict:
+    return rpc_call(f"127.0.0.1:{node.rpc_port}", "_faults",
+                    {"spec": spec}, timeout=5.0)
+
+
+def _req(node, method, path, data=None, headers=None, timeout=30.0):
+    """Like Node.http but returns (status, body) instead of raising, and
+    accepts extra request headers (the deadline header, Accept, ...)."""
+    hdrs = {"Authorization": "Basic " + base64.b64encode(b"root:").decode()}
+    hdrs.update(headers or {})
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{node.http_port}{path}",
+        data=data.encode() if isinstance(data, str) else data,
+        headers=hdrs, method=method)
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status, resp.read().decode()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read().decode()
+
+
+def _metric(node, prefix: str) -> float:
+    """Sum of all /metrics samples whose rendered name starts with prefix
+    (labelled gauges contribute one line per label set)."""
+    status, text = _req(node, "GET", "/metrics")
+    assert status == 200
+    total, found = 0.0, False
+    for ln in text.splitlines():
+        if ln.startswith(prefix):
+            total += float(ln.rsplit(" ", 1)[1])
+            found = True
+    return total if found else 0.0
+
+
+def _csv_rows(out: str) -> list[list[str]]:
+    lines = [l for l in out.strip().splitlines() if l]
+    return [l.split(",") for l in lines[1:]]
+
+
+N_ROWS = 40
+
+
+@pytest.fixture(scope="module")
+def seeded(cluster):
+    """Database with SHARD 2 REPLICA 1 on 2 nodes: the round-robin bucket
+    placement puts one vnode on each node, so any full-table scan issued
+    at node 1 must fetch the other shard from node 2 over scan_vnode."""
+    n1 = cluster.nodes[0]
+    n1.sql("CREATE DATABASE ddl WITH SHARD 2 REPLICA 1", db="public")
+    base = 1_700_000_000_000_000_000
+    lines = "\n".join(
+        f"m,host=h{i % 16} v={i} {base + i * 1_000_000}"
+        for i in range(N_ROWS))
+    n1.write_lp(lines, db="ddl")
+    deadline = time.monotonic() + 30.0
+    while time.monotonic() < deadline:
+        rows = _csv_rows(n1.sql("SELECT count(*) FROM m", db="ddl"))
+        if rows and int(rows[0][0]) == N_ROWS:
+            return "ddl"
+        time.sleep(0.3)
+    pytest.fail("seed rows never became readable")
+
+
+def test_remote_fetch_slower_than_deadline_is_504(cluster, seeded):
+    """Acceptance: injected 3 s delay on the remote scan RPC vs an 800 ms
+    request deadline → deadline-exceeded in ~the deadline (capped socket
+    timeout), nowhere near the delay or the 10 s RPC default."""
+    n1, n2 = cluster.nodes
+    before = _metric(n1, "cnosdb_requests_deadline_exceeded_total")
+    _set_faults(n2, "rpc.server:delay(3000):if=scan_vnode")
+    try:
+        t0 = time.monotonic()
+        status, body = _req(n1, "POST", f"/api/v1/sql?db={seeded}",
+                            "SELECT count(*) FROM m",
+                            headers={"X-CnosDB-Deadline-Ms": "800"})
+        elapsed = time.monotonic() - t0
+    finally:
+        _set_faults(n2, "")
+    assert status == 504, (status, body)
+    # ~1.2x the 800 ms budget plus scheduling slack — and provably not
+    # the 3 s injected delay or the 10 s default socket timeout
+    assert elapsed < 1.6, f"504 took {elapsed:.2f}s; deadline not enforced"
+    after = _metric(n1, "cnosdb_requests_deadline_exceeded_total")
+    assert after >= before + 1
+    # the node still serves normally once the fault is lifted
+    status, body = _req(n1, "POST", f"/api/v1/sql?db={seeded}",
+                        "SELECT count(*) FROM m")
+    assert status == 200 and _csv_rows(body)[0][0] == str(N_ROWS)
+
+
+def test_kill_query_during_remote_fetch(cluster, seeded):
+    """Satellite: KILL QUERY lands while the coordinator is blocked in a
+    remote vnode fetch → the query ends promptly and the remote node
+    observes the cancel_scan fan-out."""
+    n1, n2 = cluster.nodes
+    cancels_before = _metric(
+        n2, 'cnosdb_deadline_total{kind="cancel_scan_received"}')
+    _set_faults(n2, "rpc.server:delay(4000):if=scan_vnode")
+    result = {}
+
+    def go():
+        result["status"], result["body"] = _req(
+            n1, "POST", f"/api/v1/sql?db={seeded}",
+            "SELECT max(v) FROM m")
+        result["done_at"] = time.monotonic()
+
+    th = threading.Thread(target=go, daemon=True)
+    th.start()
+    try:
+        qid = None
+        poll_until = time.monotonic() + 10.0
+        while qid is None and time.monotonic() < poll_until:
+            for row in _csv_rows(n1.sql("SHOW QUERIES")):
+                if "max(v)" in row[1]:
+                    qid = int(row[0])
+                    break
+            else:
+                time.sleep(0.05)
+        assert qid is not None, "victim query never appeared in SHOW QUERIES"
+        t_kill = time.monotonic()
+        n1.sql(f"KILL QUERY {qid}")
+        th.join(timeout=5.0)
+        assert not th.is_alive(), "query did not end after KILL"
+        assert result["done_at"] - t_kill < 2.5, (
+            "KILL took %.2fs to unblock the query (remote delay is 4 s)"
+            % (result["done_at"] - t_kill))
+        assert result["status"] != 200
+        assert "cancel" in result["body"].lower(), result["body"]
+        # the remote node must have received the best-effort cancel RPC
+        # (from the KILL handler and/or the unwinding worker)
+        fanout_until = time.monotonic() + 5.0
+        while time.monotonic() < fanout_until:
+            if _metric(n2, 'cnosdb_deadline_total{kind="cancel_scan_received"}'
+                       ) > cancels_before:
+                break
+            time.sleep(0.1)
+        else:
+            pytest.fail("remote node never observed cancel_scan")
+    finally:
+        _set_faults(n2, "")
+        th.join(timeout=10.0)
+
+
+# --------------------------------------------------------------- overload
+@pytest.fixture(scope="module")
+def storm_cluster(tmp_path_factory):
+    """Own cluster with a deliberately tiny admission gate (2 running +
+    2 queued per node), configured through the documented env overrides."""
+    knobs = {"CNOSDB_FAULTS": "seed=1",
+             "CNOSDB_QUERY_MAX_CONCURRENT_QUERIES": "2",
+             "CNOSDB_QUERY_MAX_QUEUED_QUERIES": "2"}
+    os.environ.update(knobs)
+    try:
+        c = Cluster(str(tmp_path_factory.mktemp("storm")), n_nodes=2).start()
+    finally:
+        for k in knobs:
+            del os.environ[k]
+    yield c
+    c.stop()
+
+
+@pytest.mark.slow
+def test_overload_storm_sheds_cleanly(storm_cluster):
+    """Acceptance (slow): a storm beyond gate capacity yields ONLY
+    success/429/503 — never a hang, a 500, or a wrong answer — and the
+    gate + scan pools drain to zero afterwards, including for a client
+    that disconnects mid-query."""
+    n1, n2 = storm_cluster.nodes
+    n1.sql("CREATE DATABASE dstorm WITH SHARD 2 REPLICA 1", db="public")
+    base = 1_700_000_000_000_000_000
+    n1.write_lp("\n".join(
+        f"s,host=h{i % 16} v={i} {base + i * 1_000_000}" for i in range(32)),
+        db="dstorm")
+    deadline = time.monotonic() + 30.0
+    while time.monotonic() < deadline:
+        rows = _csv_rows(n1.sql("SELECT count(*) FROM s", db="dstorm"))
+        if rows and int(rows[0][0]) == 32:
+            break
+        time.sleep(0.3)
+    else:
+        pytest.fail("seed rows never became readable")
+
+    # make every query slow enough to pile up at the gate: the shard on
+    # node 2 answers its scan RPC only after 600 ms
+    _set_faults(n2, "rpc.server:delay(600):if=scan_vnode")
+    outcomes = []
+    lock = threading.Lock()
+
+    def client():
+        status, body = _req(n1, "POST", "/api/v1/sql?db=dstorm",
+                            "SELECT count(*) FROM s")
+        with lock:
+            outcomes.append((status, body))
+
+    def dropper():
+        # client that walks away mid-query: its worker must be reaped
+        # (disconnect → cancel flag → worker unwinds + fans out cancels)
+        try:
+            _req(n1, "POST", "/api/v1/sql?db=dstorm",
+                 "SELECT count(*) FROM s", timeout=0.2)
+        except Exception:
+            pass
+
+    try:
+        threads = [threading.Thread(target=client, daemon=True)
+                   for _ in range(12)]
+        threads.append(threading.Thread(target=dropper, daemon=True))
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60.0)
+        assert not any(t.is_alive() for t in threads), "storm client hung"
+    finally:
+        _set_faults(n2, "")
+
+    assert len(outcomes) == 12
+    statuses = {s for s, _ in outcomes}
+    assert statuses <= {200, 429, 503}, statuses
+    assert 200 in statuses, "nothing was admitted during the storm"
+    assert 503 in statuses, "nothing was shed — gate limits not applied"
+    for status, body in outcomes:
+        if status == 200:
+            assert _csv_rows(body)[0][0] == "32", body
+    shed = _metric(n1, "cnosdb_requests_shed_total")
+    assert shed >= sum(1 for s, _ in outcomes if s == 503)
+
+    # drain: gate empty, scan/decode pools idle on BOTH nodes
+    drain_until = time.monotonic() + 20.0
+    while time.monotonic() < drain_until:
+        if (_metric(n1, "cnosdb_requests_running") == 0
+                and _metric(n1, "cnosdb_requests_queue_depth") == 0
+                and _metric(n1, "cnosdb_scan_executor_active") == 0
+                and _metric(n2, "cnosdb_scan_executor_active") == 0):
+            return
+        time.sleep(0.25)
+    pytest.fail("gate/pools did not drain to zero after the storm")

@@ -40,3 +40,40 @@ def test_no_bare_except(path):
 def test_checked_dirs_nonempty():
     files = list(_py_files())
     assert len(files) > 10, files  # the lint must actually cover the tree
+
+
+# --------------------------------------------------------------------------
+# Lint: every rpc_call() must pass an explicit timeout. The 10 s default
+# is a trap: a hop that silently inherits it ignores the caller's request
+# deadline, so one slow peer absorbs the node for 10 s per split. Passing
+# `timeout=` forces the author to pick a budget (which net.rpc_call then
+# caps to the calling request's remaining deadline).
+# --------------------------------------------------------------------------
+def _rpc_call_sites(tree):
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fn = node.func
+        name = fn.id if isinstance(fn, ast.Name) else \
+            fn.attr if isinstance(fn, ast.Attribute) else None
+        if name == "rpc_call":
+            yield node
+
+
+@pytest.mark.parametrize("path", list(_py_files()),
+                         ids=lambda p: os.path.relpath(p, _PKG_ROOT))
+def test_rpc_call_has_explicit_timeout(path):
+    if path.endswith(os.path.join("parallel", "net.py")):
+        return  # the definition module (wait_rpc_ready's probe is capped)
+    with open(path, "r", encoding="utf-8") as f:
+        tree = ast.parse(f.read(), filename=path)
+    offenders = [
+        node.lineno for node in _rpc_call_sites(tree)
+        if not any(kw.arg == "timeout" or kw.arg is None  # **kwargs may carry it
+                   for kw in node.keywords)
+        and len(node.args) < 4  # positional timeout is the 4th arg
+    ]
+    assert not offenders, (
+        f"rpc_call without explicit timeout= at "
+        f"{os.path.relpath(path, _PKG_ROOT)}:{offenders} — every hop must "
+        f"pick a budget (the request deadline then caps it)")
