@@ -648,6 +648,7 @@ def main():
             "compact_s": round(compact_s, 1),
             "shapes": results,
             "pallas_enabled": pallas_kernels.enabled(),
+            "pallas_disabled_reason": pallas_kernels.disabled_reason(),
             "pallas_engagements": pallas_kernels.engagements(),
             **suites,
             **device,

@@ -160,17 +160,36 @@ def build_readings(coord, tenant, db, n_rows):
 # ---------------------------------------------------------------------------
 # runner
 # ---------------------------------------------------------------------------
-def _run(executor, session, name, sql, check, results, errors):
+def _run(executor, session, name, sql, check, results, errors,
+         stage_out=None):
+    from cnosdb_tpu.utils import stages as _stages
+
     try:
         executor.execute_one(sql, session)      # warm-up
+        if stage_out is not None:
+            _stages.reset()
+            _stages.enable(True)
         t0 = time.perf_counter()
         rs = executor.execute_one(sql, session)
         dt = time.perf_counter() - t0
+        if stage_out is not None:
+            # aggregation-plane stages per query: group cardinality,
+            # factorize cost, which DISTINCT path engaged
+            snap = _stages.snapshot()
+            _stages.enable(False)
+            keep = {k: v for k, v in snap.items()
+                    if k in ("factorize_ms", "group_count")
+                    or k.startswith("distinct_path")}
+            if keep:
+                stage_out[name] = keep
         if check is not None:
             check(rs)
         results[name] = round(dt * 1e3, 2)
     except Exception as e:
         errors[name] = f"{type(e).__name__}: {e}"[:160]
+    finally:
+        if stage_out is not None:
+            _stages.enable(False)
 
 
 def _col(rs, name):
@@ -338,12 +357,13 @@ def run_tsbs(executor, session, a) -> tuple[dict, dict]:
 # ---------------------------------------------------------------------------
 # ClickBench-43
 # ---------------------------------------------------------------------------
-def run_clickbench(executor, session, a) -> tuple[dict, dict]:
+def run_clickbench(executor, session, a) -> tuple[dict, dict, dict]:
     """The 43 hits queries (benchmark/hits/sql/queries.sql) translated to
     this engine's dialect over the scaled hits table; each checked
     against a numpy oracle computed from the ingested arrays."""
     res: dict = {}
     err: dict = {}
+    stg: dict = {}
     n = len(a["time"])
 
     def scalar_eq(val):
@@ -583,8 +603,8 @@ def run_clickbench(executor, session, a) -> tuple[dict, dict]:
                             .sum()))))
 
     for name, sql, check in q:
-        _run(executor, session, name, sql, check, res, err)
-    return res, err
+        _run(executor, session, name, sql, check, res, err, stage_out=stg)
+    return res, err, stg
 
 
 def run_suites(executor, coord, tenant, db, session) -> dict:
@@ -593,9 +613,10 @@ def run_suites(executor, coord, tenant, db, session) -> dict:
     hits = build_hits(coord, tenant, db, SUITE_ROWS)
     readings = build_readings(coord, tenant, db, SUITE_ROWS // 4)
     out["suite_build_s"] = round(time.perf_counter() - t0, 1)
-    cb, cb_err = run_clickbench(executor, session, hits)
+    cb, cb_err, cb_stg = run_clickbench(executor, session, hits)
     ts, ts_err = run_tsbs(executor, session, readings)
     out["clickbench_ms"] = cb
+    out["clickbench_stages"] = cb_stg
     out["tsbs_iot_ms"] = ts
     errs = {**{f"cb:{k}": v for k, v in cb_err.items()},
             **{f"tsbs:{k}": v for k, v in ts_err.items()}}

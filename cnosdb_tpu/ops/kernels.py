@@ -321,3 +321,61 @@ def _pad(a: np.ndarray, n: int, fill=0):
     out = np.full(n, fill, dtype=a.dtype)
     out[:len(a)] = a
     return out
+
+
+# ---------------------------------------------------------------------------
+# sort-based DISTINCT on device (ops/group_agg.py device path)
+# ---------------------------------------------------------------------------
+@functools.partial(jax.jit, static_argnames=("num_segments",))
+def _segment_distinct(pairs, nv, *, num_segments: int):
+    """count(DISTINCT) from (group·nv + value) pair codes: sort, mark each
+    first occurrence, segment-sum the indicators by group. Padded rows
+    carry pair codes whose group lands >= num_segments, which segment_sum's
+    out-of-range scatter semantics drop."""
+    sp = jnp.sort(pairs)
+    first = jnp.concatenate(
+        [jnp.ones((1,), jnp.bool_), sp[1:] != sp[:-1]])
+    seg = sp // nv
+    return jax.ops.segment_sum(
+        first.astype(jnp.int32), seg, num_segments)
+
+
+_device_sort = jax.jit(jnp.sort)
+
+
+def segment_distinct_count(gid: np.ndarray, vcodes: np.ndarray,
+                           num_segments: int, n_values: int) -> np.ndarray:
+    """Host wrapper for the single-chunk device DISTINCT: pads rows to a
+    size class (sentinel pairs map past num_segments and are dropped),
+    runs the jitted sort+boundary+segment_sum kernel, returns i64 counts."""
+    n = len(gid)
+    if n == 0:
+        return np.zeros(num_segments, dtype=np.int64)
+    nv = np.int64(max(int(n_values), 1))
+    pairs = gid.astype(np.int64) * nv + vcodes.astype(np.int64)
+    np_pad = pad_rows(n)
+    ns_pad = pad_segments(max(num_segments, 1))
+    if np_pad != n:
+        pairs = _pad(pairs, np_pad, fill=np.int64(ns_pad) * nv)
+    out = _segment_distinct(pairs, nv, num_segments=ns_pad)
+    return np.asarray(out)[:num_segments].astype(np.int64)
+
+
+def sorted_pair_codes(gid: np.ndarray, vcodes: np.ndarray,
+                      n_values: int) -> np.ndarray:
+    """One chunk's DISTINCT partial: device-sorted unique (group, value)
+    pair codes. Sentinel-padded rows sort to the tail and are sliced off;
+    the dedup of the sorted run happens host-side so the partial is the
+    plain sorted pair array parallel.distributed_agg.merge_distinct_pairs
+    expects on the wire."""
+    n = len(gid)
+    if n == 0:
+        return np.zeros(0, dtype=np.int64)
+    nv = np.int64(max(int(n_values), 1))
+    pairs = gid.astype(np.int64) * nv + vcodes.astype(np.int64)
+    np_pad = pad_rows(n)
+    if np_pad != n:
+        pairs = _pad(pairs, np_pad, fill=np.iinfo(np.int64).max)
+    sp = np.asarray(_device_sort(pairs))[:n]
+    keep = np.concatenate(([True], sp[1:] != sp[:-1]))
+    return sp[keep]

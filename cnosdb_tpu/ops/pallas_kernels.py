@@ -51,16 +51,30 @@ def enabled() -> bool:
     """Should aggregate_column_host route through this kernel?
     CNOSDB_TPU_PALLAS=1 forces on (interpret-mode on CPU backends), =0
     off; default: only on a real TPU scan device."""
+    return disabled_reason() is None
+
+
+def disabled_reason() -> str | None:
+    """None when the kernel is usable, else WHY it is not — the answer
+    bench.py reports so a "pallas_enabled: false" line is actionable
+    (env override vs broken import vs no TPU in the device probe)."""
     mode = os.environ.get("CNOSDB_TPU_PALLAS", "auto").lower()
     if mode in ("1", "on", "true"):
-        return PALLAS_AVAILABLE
+        return None if PALLAS_AVAILABLE \
+            else "CNOSDB_TPU_PALLAS=1 but jax.experimental.pallas import failed"
     if mode in ("0", "off", "false"):
-        return False
+        return f"disabled by env CNOSDB_TPU_PALLAS={mode}"
     if not PALLAS_AVAILABLE:
-        return False
+        return "jax.experimental.pallas import failed"
     from .placement import scan_device
 
-    return scan_device().platform == "tpu"
+    try:
+        dev = scan_device()
+    except Exception as e:  # no jax devices at all
+        return f"device probe failed: {e!r}"
+    if dev.platform != "tpu":
+        return f"scan device is {dev.platform!r}, not tpu (auto mode)"
+    return None
 
 
 def _extrema(dtype):

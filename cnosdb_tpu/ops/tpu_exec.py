@@ -109,6 +109,213 @@ def finish_scan_aggregate(job) -> AggResult:
     return job()
 
 
+def _tag_group_layout(batch: ScanBatch, group_tags: list[str]):
+    """series → tag-group mapping. → (group_of_series i32 [n_series],
+    group_labels [tag tuples], n_groups)."""
+    if group_tags:
+        label_of_series = []
+        group_map: dict[tuple, int] = {}
+        for key in batch.series_keys:
+            tags = key.tag_dict() if key is not None else {}
+            label = tuple(tags.get(t) for t in group_tags)
+            gid = group_map.setdefault(label, len(group_map))
+            label_of_series.append(gid)
+        group_of_series = np.array(label_of_series, dtype=np.int32)
+        group_labels = [None] * len(group_map)
+        for label, gid in group_map.items():
+            group_labels[gid] = label
+        return group_of_series, group_labels, len(group_map)
+    return np.zeros(batch.n_series, dtype=np.int32), [()], 1
+
+
+def _gf_layout(batch: ScanBatch, group_fields: list[str], n: int):
+    """GROUP BY field axes: per field the dictionary-code axis (+1 slot
+    for the NULL group key). Factorizations are immutable per scan
+    snapshot and cached on the batch (numeric np.unique at 10M rows costs
+    ~100s of ms per query) — the ScanToken-persistent half of the key
+    factorization plane. → (gf_dims, gf_dicts, gf_codes)."""
+    gf_dims: list[int] = []
+    gf_dicts: list[np.ndarray] = []
+    gf_codes: list[np.ndarray] = []
+    gf_cache = getattr(batch, "_gf_cache", None)
+    if gf_cache is None and group_fields:
+        gf_cache = batch._gf_cache = {}
+    for fcol in group_fields:
+        hit = gf_cache.get(fcol)
+        if hit is not None:
+            dim, dic, codes = hit
+            gf_dims.append(dim)
+            gf_dicts.append(dic)
+            gf_codes.append(codes)
+            continue
+        # bound sized to the query: evicting below the current key-set
+        # would thrash every repeat of a multi-field GROUP BY
+        gf_bound = max(2, len(group_fields))
+        f = batch.fields.get(fcol)
+        if f is None:  # column absent in this vnode: every row groups NULL
+            while len(gf_cache) >= gf_bound:
+                gf_cache.pop(next(iter(gf_cache)))
+            gf_cache[fcol] = (1, np.empty(0, dtype=object),
+                              np.zeros(n, dtype=np.int64))
+            gf_dims.append(1)
+            gf_dicts.append(np.empty(0, dtype=object))
+            gf_codes.append(np.zeros(n, dtype=np.int64))
+            continue
+        _vt, vals, valid = f
+        from ..utils import stages as _stages
+
+        with _stages.stage("factorize_ms"):
+            if _vt in (ValueType.STRING, ValueType.GEOMETRY):
+                da = vals if isinstance(vals, DictArray) \
+                    else DictArray.from_objects(vals)
+                u = len(da.values)
+                codes = da.codes.astype(np.int64)
+                dic = da.values
+            else:
+                # numeric group keys factorize per batch (np.unique
+                # collapses NaNs to one group, matching DataFusion)
+                arr = np.asarray(vals)
+                if _vt == ValueType.BOOLEAN:
+                    arr = arr.astype(np.int64)
+                uniq, inv = np.unique(arr, return_inverse=True)
+                u = len(uniq)
+                codes = inv.astype(np.int64)
+                dic = uniq.astype(object)
+                if _vt == ValueType.BOOLEAN:
+                    dic = np.array([bool(x) for x in uniq], dtype=object)
+            if not bool(valid.all()):
+                codes = np.where(valid, codes, u)
+        while len(gf_cache) >= gf_bound:
+            gf_cache.pop(next(iter(gf_cache)))
+        gf_cache[fcol] = (u + 1, dic, codes)
+        gf_dims.append(u + 1)
+        gf_dicts.append(dic)
+        gf_codes.append(codes)
+    return gf_dims, gf_dicts, gf_codes
+
+
+def _bucket_geometry(batch: ScanBatch, time_bucket):
+    """→ (ts_lo, ts_hi, origin, interval, bmin, dense_span); min/max are
+    immutable per scan snapshot and cached (a 100M-row i64 min+max costs
+    ~150ms — pure waste on every repeated query)."""
+    mm = getattr(batch, "_ts_minmax", None)
+    if mm is None:
+        mm = batch._ts_minmax = (int(batch.ts.min()), int(batch.ts.max()))
+    ts_lo, ts_hi = mm
+    if time_bucket is not None:
+        origin, interval = time_bucket
+        bmin = (ts_lo - origin) // interval
+        bmax = (ts_hi - origin) // interval
+        return ts_lo, ts_hi, origin, interval, bmin, int(bmax - bmin + 1)
+    return ts_lo, ts_hi, 0, 0, 0, 1
+
+
+def _seg_layout(batch: ScanBatch, group_tags, group_fields, group_of_series,
+                gf_dims, gf_codes, origin, interval, bmin, dense_span,
+                cpu_mode: bool):
+    """Per-row combined (tag × field × bucket) segment ids, cached on the
+    batch under the same key the kernel path uses — one derivation serves
+    both the segment kernels and the host distinct/collect merges.
+    → (seg_ids, bucket_starts, n_buckets, seg_cache, seg_key)."""
+    n = batch.n_rows
+    seg_key = (tuple(group_tags), tuple(group_fields),
+               origin, interval, bmin, dense_span)
+    with _BATCH_CACHE_LOCK:
+        seg_cache = getattr(batch, "_seg_cache", None)
+        if seg_cache is None:
+            seg_cache = batch._seg_cache = {}
+        cached = seg_cache.get(seg_key)
+    if cached is not None:
+        seg_ids, bucket_starts, n_buckets = cached[:3]
+        return seg_ids, bucket_starts, n_buckets, seg_cache, seg_key
+    group_of_row = group_of_series[batch.sid_ordinal]
+    if gf_dims:
+        group_of_row = group_of_row.astype(np.int64)
+        for dim, codes in zip(gf_dims, gf_codes):
+            group_of_row = group_of_row * dim + codes
+    if interval:
+        b = (batch.ts - origin) // interval
+        if dense_span <= _DENSE_BUCKET_LIMIT:
+            bucket_ids = (b - bmin).astype(np.int32)
+            bucket_starts = origin + (bmin + np.arange(
+                dense_span, dtype=np.int64)) * interval
+            n_buckets = dense_span
+        else:
+            uniq, inv = np.unique(b, return_inverse=True)
+            bucket_ids = inv.astype(np.int32)
+            bucket_starts = origin + uniq * interval
+            n_buckets = len(uniq)
+    else:
+        bucket_ids = np.zeros(n, dtype=np.int32)
+        bucket_starts = None
+        n_buckets = 1
+    # i64 on the numpy path: bincount would otherwise re-cast an
+    # i32 key array to intp on EVERY call (a 40ms copy at 10M rows)
+    seg_dtype = np.int64 if cpu_mode else np.int32
+    seg_ids = (group_of_row.astype(np.int64) * n_buckets
+               + bucket_ids.astype(np.int64)).astype(seg_dtype)
+    # small LRU with eviction. NOTE this derived-cache memory rides
+    # the batch outside the MemoryPool's admission accounting, so
+    # the bound is deliberately tight: ≤2 shapes ≈ 2×8B/row plus
+    # run layout + rank/order ≈ 8B/row — ~24B/row worst case on a
+    # scan-cache-resident batch
+    with _BATCH_CACHE_LOCK:
+        while len(seg_cache) >= 2:
+            seg_cache.pop(next(iter(seg_cache)))
+        # slots: seg_ids, bucket_starts, n_buckets, counts,
+        #        run_starts, run_counts (runs built lazily)
+        seg_cache[seg_key] = [seg_ids, bucket_starts, n_buckets,
+                              None, None, None]
+    return seg_ids, bucket_starts, n_buckets, seg_cache, seg_key
+
+
+@dataclass
+class HostGroupLayout:
+    """Decoded group/segment layout for host-side merges (_merge_distinct
+    in sql/executor.py): per-row combined segment ids plus the tables
+    that decode a segment back to its (tag tuple, field values, bucket
+    start) group key. Built from the same per-batch caches the kernel
+    path populates, so a warm rescan pays nothing."""
+
+    seg_ids: np.ndarray
+    num_segments: int
+    n_buckets: int
+    bucket_starts: np.ndarray | None
+    group_labels: list
+    gf_dims: list
+    gf_dicts: list
+    gf_codes: list
+
+
+def host_group_layout(batch: ScanBatch, group_tags: list[str],
+                      group_fields: list[str],
+                      time_bucket) -> HostGroupLayout | None:
+    """Segment layout for host-side distinct/collect merges, sharing the
+    ScanToken-persistent _gf_cache/_seg_cache with launch_scan_aggregate
+    (identical cache keys — whichever path runs first seeds the other)."""
+    n = batch.n_rows
+    if n == 0:
+        return None
+    group_of_series, group_labels, n_groups = _tag_group_layout(
+        batch, group_tags)
+    gf_dims, gf_dicts, gf_codes = _gf_layout(batch, group_fields, n)
+    for d in gf_dims:
+        n_groups *= d
+    _lo, _hi, origin, interval, bmin, dense_span = _bucket_geometry(
+        batch, time_bucket)
+    from .placement import scan_device
+
+    cpu_mode = scan_device().platform == "cpu" and not _FORCE_DEVICE()
+    seg_ids, bucket_starts, n_buckets, _, _ = _seg_layout(
+        batch, group_tags, group_fields, group_of_series, gf_dims,
+        gf_codes, origin, interval, bmin, dense_span, cpu_mode)
+    return HostGroupLayout(
+        seg_ids=seg_ids, num_segments=n_groups * n_buckets,
+        n_buckets=n_buckets, bucket_starts=bucket_starts,
+        group_labels=group_labels, gf_dims=gf_dims, gf_dicts=gf_dicts,
+        gf_codes=gf_codes)
+
+
 def launch_scan_aggregate(batch: ScanBatch, query: TpuQuery):
     """Start a scan-aggregate; device kernels are dispatched asynchronously
     so a coordinator can launch every vnode's kernel before fetching any
@@ -121,84 +328,13 @@ def launch_scan_aggregate(batch: ScanBatch, query: TpuQuery):
         return AggResult({nm: np.empty(0) for nm in names}, 0)
 
     # ------------------------------------------------ grouping: series → group
-    if query.group_tags:
-        label_of_series = []
-        group_map: dict[tuple, int] = {}
-        for key in batch.series_keys:
-            tags = key.tag_dict() if key is not None else {}
-            label = tuple(tags.get(t) for t in query.group_tags)
-            gid = group_map.setdefault(label, len(group_map))
-            label_of_series.append(gid)
-        group_of_series = np.array(label_of_series, dtype=np.int32)
-        group_labels = [None] * len(group_map)
-        for label, gid in group_map.items():
-            group_labels[gid] = label
-        n_groups = len(group_map)
-    else:
-        group_of_series = np.zeros(batch.n_series, dtype=np.int32)
-        group_labels = [()]
-        n_groups = 1
-    group_of_row = None  # host path computes lazily
+    group_of_series, group_labels, n_groups = _tag_group_layout(
+        batch, query.group_tags)
 
     # ---------------------------------------- string-field group dimensions
     # each GROUP BY field contributes its dictionary-code axis (+1 slot for
     # the NULL group key); combined gid = ((tag_gid·d1 + c1)·d2 + c2)…
-    gf_dims: list[int] = []
-    gf_dicts: list[np.ndarray] = []
-    gf_codes: list[np.ndarray] = []
-    # factorizations are immutable per scan snapshot: cache on the batch
-    # (numeric np.unique at 10M rows costs ~100s of ms per query)
-    gf_cache = getattr(batch, "_gf_cache", None)
-    if gf_cache is None and query.group_fields:
-        gf_cache = batch._gf_cache = {}
-    for fcol in query.group_fields:
-        hit = gf_cache.get(fcol)
-        if hit is not None:
-            dim, dic, codes = hit
-            gf_dims.append(dim)
-            gf_dicts.append(dic)
-            gf_codes.append(codes)
-            continue
-        # bound sized to the query: evicting below the current key-set
-        # would thrash every repeat of a multi-field GROUP BY
-        gf_bound = max(2, len(query.group_fields))
-        f = batch.fields.get(fcol)
-        if f is None:  # column absent in this vnode: every row groups NULL
-            while len(gf_cache) >= gf_bound:
-                gf_cache.pop(next(iter(gf_cache)))
-            gf_cache[fcol] = (1, np.empty(0, dtype=object),
-                              np.zeros(n, dtype=np.int64))
-            gf_dims.append(1)
-            gf_dicts.append(np.empty(0, dtype=object))
-            gf_codes.append(np.zeros(n, dtype=np.int64))
-            continue
-        _vt, vals, valid = f
-        if _vt in (ValueType.STRING, ValueType.GEOMETRY):
-            da = vals if isinstance(vals, DictArray) \
-                else DictArray.from_objects(vals)
-            u = len(da.values)
-            codes = da.codes.astype(np.int64)
-            dic = da.values
-        else:
-            # numeric group keys factorize per batch (np.unique collapses
-            # NaNs to one group, matching DataFusion's grouping)
-            arr = np.asarray(vals)
-            if _vt == ValueType.BOOLEAN:
-                arr = arr.astype(np.int64)
-            uniq, inv = np.unique(arr, return_inverse=True)
-            u = len(uniq)
-            codes = inv.astype(np.int64)
-            dic = uniq.astype(object)
-            if _vt == ValueType.BOOLEAN:
-                dic = np.array([bool(x) for x in uniq], dtype=object)
-        if not bool(valid.all()):
-            codes = np.where(valid, codes, u)
-        while len(gf_cache) >= gf_bound:
-            gf_cache.pop(next(iter(gf_cache)))
-        gf_cache[fcol] = (u + 1, dic, codes)
-        gf_dims.append(u + 1)
-        gf_dicts.append(dic)
-        gf_codes.append(codes)
+    gf_dims, gf_dicts, gf_codes = _gf_layout(batch, query.group_fields, n)
     for d in gf_dims:
         n_groups *= d
 
@@ -215,20 +351,8 @@ def launch_scan_aggregate(batch: ScanBatch, query: TpuQuery):
     needs_rank = any(a.func in ("first", "last") for a in query.aggs)
 
     # ------------------------------------------------ bucket geometry (meta only)
-    # min/max are immutable per scan snapshot: cache them (a 100M-row i64
-    # min+max costs ~150ms — pure waste on every repeated query)
-    mm = getattr(batch, "_ts_minmax", None)
-    if mm is None:
-        mm = batch._ts_minmax = (int(batch.ts.min()), int(batch.ts.max()))
-    ts_lo, ts_hi = mm
-    if query.time_bucket is not None:
-        origin, interval = query.time_bucket
-        bmin = (ts_lo - origin) // interval
-        bmax = (ts_hi - origin) // interval
-        dense_span = int(bmax - bmin + 1)
-    else:
-        origin = interval = bmin = 0
-        dense_span = 1
+    ts_lo, ts_hi, origin, interval, bmin, dense_span = _bucket_geometry(
+        batch, query.time_bucket)
 
     arith = None
     if query.time_bucket is not None:
@@ -317,53 +441,9 @@ def launch_scan_aggregate(batch: ScanBatch, query: TpuQuery):
         # same (group tags, bucket) shape over one scan snapshot — cache it
         # on the batch (same rationale as the reference's TsmReader cache:
         # re-derivation, not decode, dominates repeat queries)
-        seg_key = (tuple(query.group_tags), tuple(query.group_fields),
-                   origin, interval, bmin, dense_span)
-        with _BATCH_CACHE_LOCK:
-            seg_cache = getattr(batch, "_seg_cache", None)
-            if seg_cache is None:
-                seg_cache = batch._seg_cache = {}
-            cached = seg_cache.get(seg_key)
-        if cached is not None:
-            seg_ids, bucket_starts, n_buckets = cached[:3]
-        else:
-            group_of_row = group_of_series[batch.sid_ordinal]
-            if gf_dims:
-                group_of_row = group_of_row.astype(np.int64)
-                for dim, codes in zip(gf_dims, gf_codes):
-                    group_of_row = group_of_row * dim + codes
-            if query.time_bucket is not None:
-                b = (batch.ts - origin) // interval
-                if dense_span <= _DENSE_BUCKET_LIMIT:
-                    bucket_ids = (b - bmin).astype(np.int32)
-                    bucket_starts = origin + (bmin + np.arange(dense_span, dtype=np.int64)) * interval
-                    n_buckets = dense_span
-                else:
-                    uniq, inv = np.unique(b, return_inverse=True)
-                    bucket_ids = inv.astype(np.int32)
-                    bucket_starts = origin + uniq * interval
-                    n_buckets = len(uniq)
-            else:
-                bucket_ids = np.zeros(n, dtype=np.int32)
-                bucket_starts = None
-                n_buckets = 1
-            # i64 on the numpy path: bincount would otherwise re-cast an
-            # i32 key array to intp on EVERY call (a 40ms copy at 10M rows)
-            seg_dtype = np.int64 if cpu_mode else np.int32
-            seg_ids = (group_of_row.astype(np.int64) * n_buckets
-                       + bucket_ids.astype(np.int64)).astype(seg_dtype)
-            # small LRU with eviction. NOTE this derived-cache memory rides
-            # the batch outside the MemoryPool's admission accounting, so
-            # the bound is deliberately tight: ≤2 shapes ≈ 2×8B/row plus
-            # run layout + rank/order ≈ 8B/row — ~24B/row worst case on a
-            # scan-cache-resident batch
-            with _BATCH_CACHE_LOCK:
-                while len(seg_cache) >= 2:
-                    seg_cache.pop(next(iter(seg_cache)))
-                # slots: seg_ids, bucket_starts, n_buckets, counts,
-                #        run_starts, run_counts (runs built lazily)
-                seg_cache[seg_key] = [seg_ids, bucket_starts, n_buckets,
-                                      None, None, None]
+        seg_ids, bucket_starts, n_buckets, seg_cache, seg_key = _seg_layout(
+            batch, query.group_tags, query.group_fields, group_of_series,
+            gf_dims, gf_codes, origin, interval, bmin, dense_span, cpu_mode)
         num_segments = n_groups * n_buckets
 
         def cached_runs():

@@ -60,6 +60,21 @@ def _dist_kernel(values, valid, seg_ids, rank, *, mesh: Mesh,
     return fn(values, valid, seg_ids, rank)
 
 
+def merge_distinct_pairs(chunks: list[np.ndarray], n_values: int,
+                         num_segments: int) -> np.ndarray:
+    """Combine per-chunk/per-shard DISTINCT partials (sorted (group·nv +
+    value) pair-code arrays from ops.kernels.sorted_pair_codes) into
+    per-group distinct counts. The wire format is the plain sorted i64
+    pair array — the same shape single-chip partials use, so multi-chip
+    merging needs no new collective."""
+    if not chunks:
+        return np.zeros(num_segments, dtype=np.int64)
+    pairs = np.unique(np.concatenate(chunks))
+    nv = max(int(n_values), 1)
+    return np.bincount((pairs // nv).astype(np.int64),
+                       minlength=num_segments).astype(np.int64)[:num_segments]
+
+
 def distributed_aggregate_host(values: np.ndarray, valid: np.ndarray,
                                seg_ids: np.ndarray, rank: np.ndarray,
                                num_segments: int, mesh: Mesh,

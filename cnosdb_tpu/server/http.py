@@ -763,6 +763,18 @@ class HttpServer:
 
         for name, n in scrub.counters_snapshot().items():
             self.metrics.set_gauge("cnosdb_integrity_total", n, kind=name)
+        # decode plane: pages that missed the native pagedec fast lane,
+        # by reason — a hot reason here is a concrete decode regression
+        from ..storage import scan as _scan
+
+        for name, n in _scan.decode_fallback_snapshot().items():
+            self.metrics.set_gauge("cnosdb_decode_fallback_total", n,
+                                   reason=name)
+        # aggregation plane: factorize/distinct path totals
+        from ..ops import group_agg as _group_agg
+
+        for name, n in _group_agg.counters_snapshot().items():
+            self.metrics.set_gauge("cnosdb_group_agg_total", n, kind=name)
         return web.Response(text=self.metrics.prometheus_text(),
                             content_type="text/plain")
 

@@ -32,6 +32,7 @@ from ..models.strcol import DictArray, as_object_array
 from ..ops.tpu_exec import AggSpec, TpuQuery, execute_scan_aggregate
 from ..parallel.coordinator import Coordinator
 from ..parallel.meta import MetaStore
+from ..utils import stages
 from . import ast
 from . import expr as expr_mod
 from . import relational as rel
@@ -2903,7 +2904,8 @@ class QueryExecutor:
             acc: dict[tuple, dict] = {}
             for r in results:
                 _merge_partial(acc, r, plan, phys_aggs)
-            if not acc and not plan.group_tags and plan.bucket is None:
+            if not acc and not plan.group_tags \
+                    and not plan.group_fields and plan.bucket is None:
                 acc[()] = {}
             return self._finalize_aggregate(plan, acc, finalize)
         # host-aggregate (distinct/collect) path: launch all kernels
@@ -2916,14 +2918,17 @@ class QueryExecutor:
             _merge_partial(acc, r, plan, phys_aggs)
             for spec in distinct_specs:
                 _merge_distinct(acc, batch, plan, spec)
-        if not acc and not plan.group_tags and plan.bucket is None:
+        if not acc and not plan.group_tags \
+                and not plan.group_fields and plan.bucket is None:
             acc[()] = {}  # SQL: a global aggregate always yields one row
 
         return self._finalize_aggregate(plan, acc, finalize)
 
     def _finalize_single(self, plan: AggregatePlan, r, phys_aggs, finalize):
         n = r.n_rows
-        if n == 0 and not plan.group_tags and plan.bucket is None:
+        stages.count("group_count", n)
+        if n == 0 and not plan.group_tags and not plan.group_fields \
+                and plan.bucket is None:
             # SQL: a global aggregate always yields one row
             return self._finalize_aggregate(plan, {(): {}}, finalize)
         env: dict[str, np.ndarray] = {}
@@ -2960,6 +2965,7 @@ class QueryExecutor:
     def _finalize_aggregate(self, plan: AggregatePlan, acc: dict, finalize):
         keys = list(acc.keys())
         n = len(keys)
+        stages.count("group_count", n)
         env: dict[str, np.ndarray] = {}
         for i, t in enumerate(plan.group_tags + plan.group_fields):
             env[t] = np.array([k[i] for k in keys], dtype=object)
@@ -3963,11 +3969,15 @@ def _merge_partial(acc: dict, result, plan: AggregatePlan,
                     parts[a.alias + "__ts"] = ts
 
 
-def _batch_column(batch, plan, col):
+def _batch_column(batch, plan, col, native: bool = False):
     """(values, valid) for a field / tag / time column of a scan batch,
-    or (None, None) when absent from this vnode."""
+    or (None, None) when absent from this vnode. native=True skips the
+    object-array conversion (the vectorized DISTINCT path factorizes
+    native dtypes — and DictArray codes — directly)."""
     if col in batch.fields:
         vt, vals, valid = batch.fields[col]
+        if native:
+            return vals, valid
         return as_object_array(vals), valid
     if col in plan.schema.tag_names():
         per_series = np.array(
@@ -3981,8 +3991,19 @@ def _batch_column(batch, plan, col):
 
 
 def _merge_distinct(acc: dict, batch, plan: AggregatePlan, spec: AggSpec):
-    """Host-side COUNT(DISTINCT col) + collect partials per group."""
-    vals, valid = _batch_column(batch, plan, spec.column)
+    """Host-side COUNT(DISTINCT col) + collect/count_multi partials per
+    group.
+
+    Vectorized: rows map to combined (tag × field × bucket) segment ids
+    through ops.tpu_exec.host_group_layout — the same per-batch cached
+    factorization the segment kernels use, so warm rescans pay nothing —
+    and every per-group update happens in bulk: count_multi via bincount,
+    collect via one stable argsort + run slicing, DISTINCT via sorted
+    unique (group, value) code pairs (ops.group_agg). Python work is
+    O(occupied groups), not O(rows). The per-row fold survives only as
+    the fallback for unfactorizable payloads."""
+    native = spec.func == "count_distinct"
+    vals, valid = _batch_column(batch, plan, spec.column, native=native)
     if vals is None:
         return
     vals2 = None
@@ -4000,10 +4021,6 @@ def _merge_distinct(acc: dict, batch, plan: AggregatePlan, spec: AggSpec):
     # reuse the group/bucket mapping by building keys per row
     from ..ops.tpu_exec import _filter_env
 
-    tagmaps = []
-    for k in batch.series_keys:
-        tags = k.tag_dict() if k is not None else {}
-        tagmaps.append(tuple(tags.get(t) for t in plan.group_tags))
     mask = np.ones(batch.n_rows, dtype=bool)
     if plan.filter is not None:
         env = _filter_env(batch, needed=plan.filter.columns())
@@ -4015,18 +4032,41 @@ def _merge_distinct(acc: dict, batch, plan: AggregatePlan, spec: AggSpec):
         if mask.shape == ():
             mask = np.full(batch.n_rows, bool(mask))
     mask = mask & valid
+    buckets = None
     if plan.bucket is not None:
         origin, interval = plan.bucket
         buckets = origin + ((batch.ts - origin) // interval) * interval
+    if _merge_distinct_vec(acc, batch, plan, spec, vals, vals2, mask):
+        return
+    # ------------------------------------------- scalar fallback
+    if isinstance(vals, DictArray):
+        vals = as_object_array(vals)
+    tagmaps = []
+    for k in batch.series_keys:
+        tags = k.tag_dict() if k is not None else {}
+        tagmaps.append(tuple(tags.get(t) for t in plan.group_tags))
+    gf_cols = []
+    for fc in plan.group_fields:
+        gv, gok = _batch_column(batch, plan, fc)
+        if gv is None:
+            gv = np.empty(batch.n_rows, dtype=object)
+            gok = np.zeros(batch.n_rows, dtype=bool)
+        gf_cols.append((gv, gok))
+
+    def row_key(i):
+        key = tagmaps[batch.sid_ordinal[i]]
+        for gv, gok in gf_cols:
+            key = key + ((_canon_group_key(gv[i]) if gok[i] else None),)
+        if plan.bucket is not None:
+            key = key + (int(buckets[i]),)
+        return key
+
     collect = spec.func in ("collect", "collect_ts", "collect2")
     idxs = np.nonzero(mask)[0]
     if spec.func == "count_multi":
-        if plan.bucket is not None or plan.group_tags:
+        if plan.bucket is not None or plan.group_tags or plan.group_fields:
             for i in idxs:
-                key = tagmaps[batch.sid_ordinal[i]]
-                if plan.bucket is not None:
-                    key = key + (int(buckets[i]),)
-                parts = acc.setdefault(key, {})
+                parts = acc.setdefault(row_key(i), {})
                 parts[spec.alias] = parts.get(spec.alias, 0) + 1
         else:
             parts = acc.setdefault((), {})
@@ -4036,10 +4076,7 @@ def _merge_distinct(acc: dict, batch, plan: AggregatePlan, spec: AggSpec):
         # group indices first, slice values in bulk per group
         group_rows: dict[tuple, list[int]] = {}
         for i in idxs:
-            key = tagmaps[batch.sid_ordinal[i]]
-            if plan.bucket is not None:
-                key = key + (int(buckets[i]),)
-            group_rows.setdefault(key, []).append(i)
+            group_rows.setdefault(row_key(i), []).append(i)
         arr = np.asarray(vals)
         with_ts = spec.func == "collect_ts"
         arr2 = np.asarray(vals2) if vals2 is not None else None
@@ -4054,17 +4091,130 @@ def _merge_distinct(acc: dict, batch, plan: AggregatePlan, spec: AggSpec):
             parts.setdefault(spec.alias, []).append(chunk)
         return
     for i in idxs:
-        key = tagmaps[batch.sid_ordinal[i]]
-        if plan.bucket is not None:
-            key = key + (int(buckets[i]),)
-        parts = acc.setdefault(key, {})
+        parts = acc.setdefault(row_key(i), {})
         s = parts.setdefault(spec.alias, set())
         s.add(vals[i])
 
 
+def _merge_distinct_vec(acc: dict, batch, plan: AggregatePlan,
+                        spec: AggSpec, vals, vals2,
+                        mask: np.ndarray) -> bool:
+    """Bulk per-group merge of one host aggregate over one batch.
+    Returns False when the payload defeats factorization (caller keeps
+    the scalar fold). Segment layout (and its decode tables) comes from
+    the ScanToken-persistent caches shared with the kernel path."""
+    from ..ops import group_agg as _ga
+    from ..ops.tpu_exec import host_group_layout
+
+    try:
+        layout = host_group_layout(batch, plan.group_tags,
+                                   plan.group_fields, plan.bucket)
+    except Exception:
+        stages.count_error("executor.group_layout")
+        return False
+    if layout is None:
+        return False        # empty batch: scalar path keeps global-key rows
+    idx = np.nonzero(mask)[0]
+    globl = not (plan.bucket is not None or plan.group_tags
+                 or plan.group_fields)
+    if spec.func == "count_multi" and globl:
+        # global count_multi creates its row even when no rows match
+        parts = acc.setdefault((), {})
+        parts[spec.alias] = parts.get(spec.alias, 0) + len(idx)
+        return True
+    # occupied segments only — never allocate num_segments-sized arrays
+    # (tag × bucket cardinality is unbounded on this host path)
+    useg, inv = np.unique(layout.seg_ids[idx].astype(np.int64),
+                          return_inverse=True)
+    inv = inv.astype(np.int64).ravel()
+
+    def seg_keys(segs: np.ndarray) -> list[tuple]:
+        """Decode combined segment ids → group key tuples (tag values,
+        field values, bucket start) — the exact key layout
+        _merge_partial builds from the kernel's label columns."""
+        nb = max(layout.n_buckets, 1)
+        bkt = segs % nb
+        rem = segs // nb
+        peeled = []
+        for dim, dic in zip(reversed(layout.gf_dims),
+                            reversed(layout.gf_dicts)):
+            peeled.append((rem % dim, dic))
+            rem = rem // dim
+        peeled.reverse()
+        keys = []
+        bs = layout.bucket_starts
+        for i in range(len(segs)):
+            key = layout.group_labels[int(rem[i])]
+            for codes_arr, dic in peeled:
+                c = int(codes_arr[i])
+                key = key + ((_canon_group_key(dic[c]) if c < len(dic)
+                              else None),)
+            if plan.bucket is not None:
+                key = key + (int(bs[int(bkt[i])]),)
+            keys.append(key)
+        return keys
+
+    if spec.func == "count_multi":
+        cnt = np.bincount(inv, minlength=len(useg))
+        for key, c in zip(seg_keys(useg), cnt):
+            parts = acc.setdefault(key, {})
+            parts[spec.alias] = parts.get(spec.alias, 0) + int(c)
+        return True
+    if spec.func in ("collect", "collect_ts", "collect2"):
+        order, bounds, run_codes = _ga.grouped_order(inv)
+        arr = np.asarray(vals)
+        arr2 = np.asarray(vals2) if vals2 is not None else None
+        with_ts = spec.func == "collect_ts"
+        keys = seg_keys(useg[run_codes.astype(np.int64)])
+        for k, key in enumerate(keys):
+            rows = idx[order[bounds[k]:bounds[k + 1]]]
+            if spec.func == "collect2":
+                chunk = (arr[rows], arr2[rows])
+            elif with_ts:
+                chunk = (batch.ts[rows], arr[rows])
+            else:
+                chunk = arr[rows]
+            acc.setdefault(key, {}).setdefault(spec.alias, []).append(chunk)
+        return True
+    # ---- count(DISTINCT): sorted unique (group, value) code pairs
+    if isinstance(vals, DictArray):
+        # dictionary codes ARE the factorization (values unique by
+        # construction — the gf group axis makes the same assumption)
+        codes = vals.codes.astype(np.int64)[idx]
+        dic = vals.values
+        nv = len(dic)
+    else:
+        f = _ga.factorize(np.asarray(vals)[idx])
+        if f is None:
+            return False
+        codes, dic, nv = f.codes, f.values, f.n_values
+    pairs = _ga.distinct_pairs(inv, codes, nv)
+    _ga._count("distinct_sort")
+    stages.count("distinct_path.sort")
+    nvm = max(nv, 1)
+    pseg = pairs // nvm
+    pval = pairs % nvm
+    if not len(pairs):
+        return True
+    starts = np.nonzero(np.concatenate(
+        ([True], pseg[1:] != pseg[:-1])))[0]
+    ends = np.append(starts[1:], len(pairs))
+    for k, key in enumerate(seg_keys(useg[pseg[starts]])):
+        s = acc.setdefault(key, {}).setdefault(spec.alias, set())
+        s.update(dic[pval[starts[k]:ends[k]]].tolist())
+    return True
+
+
 def _apply_gapfill(plan: AggregatePlan, rs: ResultSet) -> ResultSet:
     """Expand to a dense (group × bucket) grid; fill per locf/interpolate
-    (reference extension/expr scalar_function gapfill/locf/interpolate)."""
+    (reference extension/expr scalar_function gapfill/locf/interpolate).
+
+    Vectorized over the grid: rows scatter into a (n_groups, n_buckets)
+    matrix in one fancy-indexed assignment, locf is a row-wise
+    maximum.accumulate of last-known indices (object columns included —
+    locf's semantics there are positional, not arithmetic), and
+    interpolate stays np.interp per group. Python work is O(result rows
+    + groups), never O(groups × grid)."""
     origin, interval = plan.bucket
     cols = {n: c for n, c in zip(rs.names, rs.columns)}
     # outputs may alias the bucket ("t") and tags: resolve via plan.output
@@ -4076,7 +4226,7 @@ def _apply_gapfill(plan: AggregatePlan, rs: ResultSet) -> ResultSet:
                 time_name = name
             elif expr.name in plan.group_tags:
                 tag_name_of[expr.name] = name
-    if time_name is None or time_name not in cols:
+    if time_name is None or time_name not in cols or rs.n_rows == 0:
         return rs
     times = cols[time_name].astype(np.int64)
     # grid bounds: the query's time range when bounded, else observed range
@@ -4089,76 +4239,87 @@ def _apply_gapfill(plan: AggregatePlan, rs: ResultSet) -> ResultSet:
         if qhi < 2**62:
             hi = origin + ((qhi - origin) // interval) * interval
     grid = np.arange(lo, hi + 1, interval, dtype=np.int64)
+    G = len(grid)
     gt = [tag_name_of.get(t, t) for t in plan.group_tags if
           tag_name_of.get(t, t) in cols]
     group_keys = list(zip(*[cols[t] for t in gt])) if gt else [()] * rs.n_rows
-    groups: dict[tuple, dict[int, int]] = {}
+    # group ids per row (tag keys are arbitrary objects: dict factorize),
+    # renumbered into the output order (sorted by stringified key)
+    gmap: dict[tuple, int] = {}
+    gids = np.empty(rs.n_rows, dtype=np.int64)
     for i, k in enumerate(group_keys):
-        groups.setdefault(tuple(k), {})[int(times[i])] = i
+        gids[i] = gmap.setdefault(tuple(k), len(gmap))
+    sorted_keys = sorted(gmap, key=lambda k: tuple(str(x) for x in k))
+    rank = np.empty(len(gmap), dtype=np.int64)
+    for pos, key in enumerate(sorted_keys):
+        rank[gmap[key]] = pos
+    ng = len(sorted_keys)
+    bi = (times - lo) // interval
+    ok = (bi >= 0) & (bi < G)
+    # later rows win duplicate (group, bucket) cells — same as the old
+    # dict-of-rows construction
+    flat = rank[gids[ok]] * G + bi[ok]
+
+    def _locf2d(vals: np.ndarray, known: np.ndarray) -> np.ndarray:
+        """Row-wise forward fill: carry the last known column index."""
+        src_col = np.where(known, np.arange(G)[None, :], -1)
+        src_col = np.maximum.accumulate(src_col, axis=1)
+        filled = src_col >= 0
+        rows = np.broadcast_to(np.arange(ng)[:, None], (ng, G))
+        out = vals.copy()
+        out[filled] = vals[rows[filled], src_col[filled]]
+        return out
 
     agg_names = [n for n in rs.names if n not in gt and n != time_name]
-    out: dict[str, list] = {n: [] for n in rs.names}
-    for key in sorted(groups, key=lambda k: tuple(str(x) for x in k)):
-        row_of = groups[key]
-        present_t = np.array(sorted(row_of), dtype=np.int64)
-        for name in agg_names:
-            src = cols[name]
-            if src.dtype == object:
-                # string-valued aggregates: grid holes stay None; only locf
-                # makes sense for them
-                vals = np.full(len(grid), None, dtype=object)
-                for t, i in row_of.items():
-                    gi = (t - lo) // interval
-                    if 0 <= gi < len(grid):
-                        vals[gi] = src[i]
-                if plan.fill_methods.get(name) == "locf":
-                    last = None
-                    for j in range(len(vals)):
-                        if vals[j] is None:
-                            vals[j] = last
-                        else:
-                            last = vals[j]
-                out[name].extend(vals.tolist())
-                continue
-            vals = np.full(len(grid), np.nan)
-            for t, i in row_of.items():
-                gi = (t - lo) // interval
-                if 0 <= gi < len(grid):
-                    v = src[i]
-                    vals[gi] = v if v is not None else np.nan
-            method = plan.fill_methods.get(name)
+    out_cols_by_name: dict[str, np.ndarray] = {}
+    for name in agg_names:
+        src = cols[name]
+        method = plan.fill_methods.get(name)
+        if src.dtype == object:
+            # string-valued aggregates: grid holes stay None; only locf
+            # makes sense for them
+            vals = np.full(ng * G, None, dtype=object)
+            vals[flat] = src[ok]
+            vals = vals.reshape(ng, G)
             if method == "locf":
-                last = np.nan
-                for j in range(len(vals)):
-                    if np.isnan(vals[j]):
-                        vals[j] = last
-                    else:
-                        last = vals[j]
-            elif method == "interpolate":
-                known = ~np.isnan(vals)
-                if known.sum() >= 2:
-                    xs = grid[known].astype(np.float64)
-                    ys = vals[known]
-                    missing = ~known
-                    interp = np.interp(grid[missing].astype(np.float64), xs, ys)
-                    # strict interpolation: no extrapolation beyond endpoints
-                    mlo, mhi = grid[known][0], grid[known][-1]
-                    inside = (grid[missing] >= mlo) & (grid[missing] <= mhi)
-                    fill = np.full(missing.sum(), np.nan)
-                    fill[inside] = interp[inside]
-                    vals[missing] = fill
-            out[name].extend(vals.tolist())
-        for i, t in enumerate(gt):
-            out[t].extend([key[i]] * len(grid))
-        out[time_name].extend(grid.tolist())
+                known = np.frompyfunc(
+                    lambda v: v is not None, 1, 1)(vals).astype(bool)
+                vals = _locf2d(vals, known)
+            out_cols_by_name[name] = vals.ravel()
+            continue
+        vals = np.full(ng * G, np.nan)
+        vals[flat] = src[ok].astype(np.float64)
+        vals = vals.reshape(ng, G)
+        if method == "locf":
+            vals = _locf2d(vals, ~np.isnan(vals))
+        elif method == "interpolate":
+            gridf = grid.astype(np.float64)
+            for r in range(ng):
+                row = vals[r]
+                known = ~np.isnan(row)
+                if known.sum() < 2:
+                    continue
+                missing = ~known
+                interp = np.interp(gridf[missing], gridf[known], row[known])
+                # strict interpolation: no extrapolation beyond endpoints
+                mlo, mhi = grid[known][0], grid[known][-1]
+                inside = (grid[missing] >= mlo) & (grid[missing] <= mhi)
+                fill = np.full(int(missing.sum()), np.nan)
+                fill[inside] = interp[inside]
+                row[missing] = fill
+        out_cols_by_name[name] = vals.ravel()
     new_cols = []
     for n in rs.names:
         if n == time_name:
-            new_cols.append(np.array(out[n], dtype=np.int64))
-        elif n in gt or (n in cols and cols[n].dtype == object):
-            new_cols.append(np.array(out[n], dtype=object))
+            new_cols.append(np.tile(grid, ng))
+        elif n in gt:
+            i = gt.index(n)
+            col = np.empty(ng * G, dtype=object)
+            for pos, key in enumerate(sorted_keys):
+                col[pos * G:(pos + 1) * G] = key[i]
+            new_cols.append(col)
         else:
-            new_cols.append(np.array(out[n]))
+            new_cols.append(out_cols_by_name[n])
     return ResultSet(rs.names, new_cols)
 
 

@@ -1533,6 +1533,94 @@ def _fn_date_trunc(granularity, ns):
     return int(dt2.timestamp()) * _NS
 
 
+_DAY_NS = 86_400 * _NS
+
+
+def _vec_date_part(field, ns):
+    """Array form of _fn_date_part over int64 ns columns: datetime64
+    calendar math instead of per-row datetime.fromtimestamp (the single
+    hottest scalar loop in the relational path — ClickBench q19 spends
+    seconds here). Integer arithmetic throughout, so results are exact
+    where the float-seconds scalar path can round near bucket edges.
+    Returns float64 (the scalar path always returns float) or None for
+    unknown fields (caller's scalar loop raises the canonical error)."""
+    import numpy as _np
+
+    f = str(field).lower()
+    ns = ns.astype(_np.int64, copy=False)
+    if f in ("minute", "minutes"):
+        return ((ns // (60 * _NS)) % 60).astype(_np.float64)
+    if f in ("hour", "hours"):
+        return ((ns // (3600 * _NS)) % 24).astype(_np.float64)
+    if f in ("dow",):
+        # epoch day 0 = Thursday; PostgreSQL dow has Sunday = 0
+        return ((ns // _DAY_NS + 4) % 7).astype(_np.float64)
+    if f in ("second", "seconds"):
+        return (ns % (60 * _NS)) / 1e9
+    if f in ("millisecond", "milliseconds"):
+        return (ns % (60 * _NS)) / 1e6
+    if f in ("microsecond", "microseconds"):
+        return (ns % (60 * _NS)) / 1e3
+    if f in ("nanosecond", "nanoseconds"):
+        return (((ns // _NS) % 60) * _NS + ns % _NS).astype(_np.float64)
+    if f in ("epoch",):
+        return ns / 1e9
+    d = ns.astype("datetime64[ns]")
+    if f in ("year", "years"):
+        return (d.astype("datetime64[Y]").astype(_np.int64)
+                + 1970).astype(_np.float64)
+    mo = d.astype("datetime64[M]").astype(_np.int64)
+    if f in ("month", "months"):
+        return (mo % 12 + 1).astype(_np.float64)
+    if f in ("quarter",):
+        return ((mo % 12) // 3 + 1).astype(_np.float64)
+    days = d.astype("datetime64[D]")
+    if f in ("day", "days"):
+        return ((days - mo.astype("datetime64[M]").astype("datetime64[D]"))
+                .astype(_np.int64) + 1).astype(_np.float64)
+    if f in ("doy",):
+        y0 = d.astype("datetime64[Y]").astype("datetime64[D]")
+        return ((days - y0).astype(_np.int64) + 1).astype(_np.float64)
+    if f in ("week", "weeks"):
+        # ISO week = ordinal of this week's Thursday within ITS year
+        epoch_days = days.astype(_np.int64)
+        th = epoch_days - (epoch_days + 3) % 7 + 3
+        thd = th.astype("datetime64[D]")
+        ty0 = thd.astype("datetime64[Y]").astype("datetime64[D]")
+        return (((thd - ty0).astype(_np.int64)) // 7 + 1).astype(_np.float64)
+    return None
+
+
+def _vec_date_trunc(granularity, ns):
+    """Array form of _fn_date_trunc; int64 output matching the scalar
+    path's all-int listcomp dtype. None for unknown granularities."""
+    import numpy as _np
+
+    g = str(granularity).lower()
+    ns = ns.astype(_np.int64, copy=False)
+    unit = {"hour": 3600 * _NS, "minute": 60 * _NS, "second": _NS,
+            "millisecond": 1_000_000, "microsecond": 1_000}.get(g)
+    if unit is not None:
+        return (ns // unit) * unit
+    if g == "day":
+        return (ns // _DAY_NS) * _DAY_NS
+    if g == "week":
+        days = ns // _DAY_NS
+        return (days - (days + 3) % 7) * _DAY_NS   # back to Monday
+    d = ns.astype("datetime64[ns]")
+    if g == "month":
+        return d.astype("datetime64[M]").astype("datetime64[ns]") \
+            .astype(_np.int64)
+    if g == "quarter":
+        mo = d.astype("datetime64[M]").astype(_np.int64)
+        return ((mo // 3) * 3).astype("datetime64[M]") \
+            .astype("datetime64[ns]").astype(_np.int64)
+    if g == "year":
+        return d.astype("datetime64[Y]").astype("datetime64[ns]") \
+            .astype(_np.int64)
+    return None
+
+
 def _fn_from_unixtime(x):
     if isinstance(x, (float, np.floating)) or isinstance(x, str):
         # reference signature: from_unixtime(Int64) only
@@ -1567,15 +1655,17 @@ def _register_time_scalars():
         .strftime("%Y-%m-%d"),
         "current_time": lambda xp: datetime.now(timezone.utc)
         .strftime("%H:%M:%S.%f"),
-        "date_part": _scalar_first_obj(_fn_date_part),
-        "datepart": _scalar_first_obj(_fn_date_part),
-        "date_trunc": _scalar_first_obj(_fn_date_trunc),
+        "date_part": _scalar_first_obj(_fn_date_part, vec=_vec_date_part),
+        "datepart": _scalar_first_obj(_fn_date_part, vec=_vec_date_part),
+        "date_trunc": _scalar_first_obj(_fn_date_trunc,
+                                        vec=_vec_date_trunc),
         # relational-path DATE_BIN (the single-table path lowers it into
         # the bucket kernel; derived subqueries evaluate it row-wise —
         # tsbench avg_daily_driving_duration buckets inside a CTE)
         "date_bin": lambda xp, iv, ts, *origin: _fn_date_bin(
             iv, ts, origin[0] if origin else 0),
-        "datetrunc": _scalar_first_obj(_fn_date_trunc),
+        "datetrunc": _scalar_first_obj(_fn_date_trunc,
+                                       vec=_vec_date_trunc),
         "from_unixtime": _obj_func(_fn_from_unixtime),
         "to_timestamp": _obj_func(_fn_to_timestamp),
         "to_timestamp_seconds": _obj_func(
@@ -1590,14 +1680,20 @@ def _register_time_scalars():
     })
 
 
-def _scalar_first_obj(fn):
+def _scalar_first_obj(fn, vec=None):
     """Lift fn(scalar_opt, value) where the FIRST argument is a scalar
-    option (field name / granularity) and the second is the column."""
+    option (field name / granularity) and the second is the column.
+    `vec` is an optional whole-array fast path for integer columns (the
+    timestamp case); it returns None to defer to the scalar loop."""
     def run(xp, opt, arr):
         import numpy as _np
 
         opt = opt.item() if hasattr(opt, "item") else opt
         if isinstance(arr, _np.ndarray):
+            if vec is not None and arr.dtype.kind in "iu" and len(arr):
+                out = vec(opt, arr)
+                if out is not None:
+                    return out
             vals = [None if x is None else fn(opt, x) for x in arr]
             if vals and all(isinstance(v, int) for v in vals):
                 return _np.array(vals, dtype=_np.int64)

@@ -877,17 +877,15 @@ def _plan_aggregate(stmt, schema, time_trs, tag_domains, residual):
 
     if (gapfill or fill_methods) and bucket is None:
         raise PlanError("gapfill/locf/interpolate require a time bucket")
-    # aggregates the segment kernels evaluate directly; everything else
-    # (median/stddev/mode/increase/sample/gauge/state/data-quality/
-    # count_distinct) merges host-side KEYED ON TAGS ONLY, so field group
-    # keys must take the relational pipeline with those
-    _KERNEL_AGGS = {"count", "count_star", "sum", "mean", "avg",
-                    "min", "max", "first", "last"}
-    if group_fields and (gapfill or fill_methods
-                         or any(a.func not in _KERNEL_AGGS
-                                for a in coll.aggs)):
+    # Field group keys ride the fused path for every aggregate: kernel
+    # aggregates reduce over the combined (tag × field × bucket) segment
+    # ids directly, and the host-merged rest (count_distinct / collect* /
+    # count_multi) decode the same segment layout in _merge_distinct_vec,
+    # so their keys line up with the kernel partials. Only gapfill/fill
+    # still needs the relational pipeline's dense group grid.
+    if group_fields and (gapfill or fill_methods):
         e = PlanError(
-            "field GROUP BY combines only with kernel aggregates")
+            "field GROUP BY does not combine with gapfill/fill")
         e.fallback_relational = True
         raise e
     return AggregatePlan(

@@ -21,6 +21,8 @@ import numpy as np
 
 from ..errors import PlanError
 from ..models.strcol import DictArray
+from ..ops import group_agg as _ga
+from ..utils import stages
 from .expr import BinOp, Column, Expr, Func, WindowFunc
 
 
@@ -355,20 +357,29 @@ def hash_join(left: Scope, right: Scope, kind: str,
 # host group-by (relational path; the single-table path uses fused kernels)
 # ---------------------------------------------------------------------------
 def group_indices(key_cols: list, n: int):
-    """→ (group id per row [n], representative row per group)."""
+    """→ (group id per row [n], representative row per group).
+
+    Per-axis dense codes (ops.group_agg key factorization) chained into
+    one combined id, then re-densified — the same factorize → combine
+    layout the segment kernels use, timed under the factorize_ms stage."""
     if n == 0:
         return np.zeros(0, dtype=np.int64), np.zeros(0, dtype=np.int64)
     if not key_cols:
+        stages.count("group_count", 1)
         return np.zeros(n, dtype=np.int64), np.zeros(1, dtype=np.int64)
-    ids = None
-    for kc in key_cols:
-        kc = np.asarray(kc)
-        _, inv = np.unique(kc.astype("U") if kc.dtype == object else kc,
-                           return_inverse=True)
-        card = int(inv.max()) + 1
-        ids = inv.astype(np.int64) if ids is None else ids * card + inv
-    _, first_idx, gid = np.unique(ids, return_index=True, return_inverse=True)
-    return gid.astype(np.int64), first_idx.astype(np.int64)
+    with stages.stage("factorize_ms"):
+        parts = []
+        for kc in key_cols:
+            kc = np.asarray(kc)
+            _, inv = np.unique(kc.astype("U") if kc.dtype == object else kc,
+                               return_inverse=True)
+            inv = inv.astype(np.int64).ravel()
+            parts.append((inv, int(inv.max()) + 1))
+        ids, _ = _ga.combine_codes(parts)
+        _, first_idx, gid = np.unique(ids, return_index=True,
+                                      return_inverse=True)
+    stages.count("group_count", len(first_idx))
+    return gid.astype(np.int64).ravel(), first_idx.astype(np.int64)
 
 
 def _col_valid(col) -> np.ndarray:
@@ -408,6 +419,12 @@ def host_aggregate(func: str, col, gid: np.ndarray, n_groups: int,
     g, v = gid[valid], col[valid]
     if func == "count":
         if distinct:
+            fast = _ga.distinct_count(g, v, n_groups)
+            if fast is not None:
+                return fast
+            # unfactorizable payload (mixed-type / NaN objects): the
+            # per-row set fold is the only path with exact Python
+            # equality semantics
             out = np.zeros(n_groups, dtype=np.int64)
             seen: dict[int, set] = {}
             for i in range(len(g)):
@@ -465,26 +482,24 @@ def host_aggregate(func: str, col, gid: np.ndarray, n_groups: int,
             out = s / np.maximum(c, 1)
         return _null_where(out, c == 0)
     if func in ("min", "max"):
-        if col.dtype == object:
-            out = np.full(n_groups, None, dtype=object)
-            for i in range(len(g)):
-                cur = out[g[i]]
-                if cur is None or (func == "min" and v[i] < cur) \
-                        or (func == "max" and v[i] > cur):
-                    out[g[i]] = v[i]
-            return out
-        out = np.full(n_groups, np.nan)
-        filled = np.zeros(n_groups, dtype=bool)
-        red = np.fmin if func == "min" else np.fmax
+        fast = _ga.group_min_max(func, g, v, n_groups)
+        if fast is not None:
+            best, filled = fast
+            if col.dtype == object:
+                return best          # None holes already in place
+            if np.issubdtype(col.dtype, np.integer) and filled.all():
+                return best.astype(col.dtype)
+            if col.dtype == bool and filled.all():
+                return best.astype(bool)
+            return _null_where(best.astype(np.float64), ~filled)
+        # unfactorizable object payload: scalar Python compare fold
+        out = np.full(n_groups, None, dtype=object)
         for i in range(len(g)):
-            gi = g[i]
-            out[gi] = v[i] if not filled[gi] else red(out[gi], v[i])
-            filled[gi] = True
-        if np.issubdtype(col.dtype, np.integer) and filled.all():
-            return out.astype(col.dtype)
-        if col.dtype == bool and filled.all():
-            return out.astype(bool)
-        return _null_where(out, ~filled)
+            cur = out[g[i]]
+            if cur is None or (func == "min" and v[i] < cur) \
+                    or (func == "max" and v[i] > cur):
+                out[g[i]] = v[i]
+        return out
     if func in ("corr", "covar_samp", "covar_pop"):
         if col2 is None:
             raise PlanError(f"{func} takes two columns")

@@ -545,6 +545,29 @@ _NATIVE_NUMERIC = {
 }
 _NATIVE_ENC = {1: {6}, 2: {2, 11}, 3: {10}}   # kind → decodable encodings
 
+# Why pages miss the native pagedec fast lane, by reason — the
+# observability half of the decode plane (surfaced on /metrics as
+# cnosdb_decode_fallback_total{reason=...}). A hot reason is actionable:
+#   string        value type has no native lane (dictionary decode)
+#   value_type    numeric type pagedec doesn't cover
+#   encoding      codec outside the native decoder's set
+#   schema_change page typed differently than the column (cast path)
+#   native_reject native decoder refused the page at runtime
+import threading as _threading
+
+_FALLBACK_LOCK = _threading.Lock()
+_FALLBACK: dict[str, int] = {}
+
+
+def _count_fallback(reason: str, n: int = 1) -> None:
+    with _FALLBACK_LOCK:
+        _FALLBACK[reason] = _FALLBACK.get(reason, 0) + n
+
+
+def decode_fallback_snapshot() -> dict[str, int]:
+    with _FALLBACK_LOCK:
+        return dict(sorted(_FALLBACK.items()))
+
 
 def _mem_series_ids(vnode: VnodeStorage, table: str) -> set:
     """Series ids with unflushed rows for `table` (active + immutables)."""
@@ -781,6 +804,7 @@ def _scan_vnode_native(vnode: VnodeStorage, table: str,
                     pm = col.pages[i]
                     vt = ftypes.get(name)
                     if vt in (ValueType.STRING, ValueType.GEOMETRY):
+                        _count_fallback("string")
                         py_jobs.append((r, pm, name, off, vt))
                         continue
                     kind = _NATIVE_NUMERIC.get(pm.value_type)
@@ -791,6 +815,10 @@ def _scan_vnode_native(vnode: VnodeStorage, table: str,
                         # is typed by ftypes, so a differently-typed page
                         # must go through the casting Python path, never
                         # the width-blind native writer
+                        _count_fallback(
+                            "value_type" if kind is None else
+                            "encoding" if pm.encoding not in _NATIVE_ENC[kind]
+                            else "schema_change")
                         py_jobs.append((r, pm, name, off, vt))
                         continue
                     _add_page(r, pm, name, off, kind)
@@ -836,6 +864,7 @@ def _scan_vnode_native(vnode: VnodeStorage, table: str,
             return False   # library vanished mid-flight: legacy path
         for bi in np.nonzero(status)[0]:
             pm, out_off = jobs[bi]
+            _count_fallback("native_reject")
             py_jobs.append((g["reader"], pm, colname, out_off,
                             ftypes.get(colname)))
             dirty_cols.add(colname)

@@ -20,6 +20,13 @@ Stages recorded by the engine:
   kernel_ms             — fused segment-aggregate kernels
   merge_ms              — cross-vnode partial merge / device delta-merge
   finalize_ms           — vectorized finalizers + output rendering
+  factorize_ms          — group-key factorization (value column →
+                          dense codes + dictionary; ~0 on warm
+                          ScanToken caches)
+  group_count           — output group cardinality per query
+  distinct_path.sort    — count(DISTINCT) via host sorted pair codes
+  distinct_path.device  — … via the jax segment kernels
+  distinct_path.fallback— … via the scalar set fold (unfactorizable)
 """
 from __future__ import annotations
 

@@ -1,0 +1,90 @@
+"""Lint: no per-row Python loops in the vectorized aggregation sections.
+
+The aggregation plane's contract (ops/group_agg.py) is that per-group
+work happens through factorized codes + numpy/segment kernels; a
+``for i in idxs:`` loop over row indices reintroduces the O(rows)
+Python accumulation the plane replaced, and it regresses silently (the
+results stay right, only 10-100× slower at ClickBench cardinalities).
+This lint walks the named vectorized functions and flags any for-loop
+over a row-index iterable. The deliberate scalar fallbacks (mixed-type
+payloads that defeat factorization) stay allowed — but ratcheted, so
+they can't quietly multiply.
+"""
+import ast
+import os
+
+import pytest
+
+import cnosdb_tpu
+
+_PKG_ROOT = os.path.dirname(cnosdb_tpu.__file__)
+
+# function → file: the sections that must stay loop-free over rows
+_VECTORIZED_FUNCS = {
+    "_merge_distinct_vec": os.path.join("sql", "executor.py"),
+    "_apply_gapfill": os.path.join("sql", "executor.py"),
+    "_merge_results_vec": os.path.join("sql", "executor.py"),
+}
+
+# iterable names that mean "one element per data row"
+_ROW_ITER_NAMES = {"idxs", "idx", "rows", "row_idxs"}
+
+
+def _find_func(tree: ast.Module, name: str):
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                and node.name == name:
+            return node
+    return None
+
+
+def _row_loops(fn: ast.AST):
+    """For-loops whose iterable is a row-index array: a bare name from
+    the denylist, or a direct np.nonzero(...) subscript."""
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.For):
+            continue
+        it = node.iter
+        if isinstance(it, ast.Name) and it.id in _ROW_ITER_NAMES:
+            yield node.lineno
+        elif isinstance(it, ast.Subscript) \
+                and isinstance(it.value, ast.Call) \
+                and isinstance(it.value.func, ast.Attribute) \
+                and it.value.func.attr == "nonzero":
+            yield node.lineno
+
+
+def _parse(relpath: str) -> ast.Module:
+    path = os.path.join(_PKG_ROOT, relpath)
+    with open(path, "r", encoding="utf-8") as f:
+        return ast.parse(f.read(), filename=path)
+
+
+@pytest.mark.parametrize("func,relpath", sorted(_VECTORIZED_FUNCS.items()))
+def test_vectorized_agg_sections_have_no_row_loops(func, relpath):
+    tree = _parse(relpath)
+    fn = _find_func(tree, func)
+    assert fn is not None, (
+        f"{func} not found in {relpath} — update _VECTORIZED_FUNCS if it "
+        f"was renamed (the lint must keep covering it)")
+    offenders = list(_row_loops(fn))
+    assert not offenders, (
+        f"per-row loop in vectorized section {relpath}:{func} at lines "
+        f"{offenders} — use factorized codes + bincount/reduceat/"
+        f"grouped_order (ops/group_agg.py) instead")
+
+
+def test_scalar_fallback_row_loops_ratcheted():
+    """_merge_distinct keeps per-row folds ONLY as the fallback for
+    payloads that defeat factorization. Three exist (count_multi,
+    collect grouping, distinct). Adding a fourth means a new code path
+    skipped the vectorized plane — stop and route it through
+    _merge_distinct_vec instead."""
+    tree = _parse(os.path.join("sql", "executor.py"))
+    fn = _find_func(tree, "_merge_distinct")
+    assert fn is not None
+    offenders = list(_row_loops(fn))
+    assert len(offenders) <= 3, (
+        f"scalar row-loop count in _merge_distinct grew to "
+        f"{len(offenders)} (lines {offenders}) — new aggregation work "
+        f"belongs in _merge_distinct_vec")
