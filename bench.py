@@ -638,6 +638,14 @@ def main():
 
         device = _device_kernel_metric()
         _persist_device_evidence(device)
+        # invariant plane: current static-analysis finding counts, so a
+        # bench artifact records the tree's lint debt alongside its perf
+        try:
+            from cnosdb_tpu import analysis as _analysis
+
+            lint_findings = _analysis.finding_counts()
+        except Exception as e:
+            lint_findings = {"error": repr(e)[:200]}
         print(json.dumps({
             "metric": "tsbs_double_groupby_1h_scan_agg_100m",
             "value": round(headline[0], 1),
@@ -650,6 +658,7 @@ def main():
             "pallas_enabled": pallas_kernels.enabled(),
             "pallas_disabled_reason": pallas_kernels.disabled_reason(),
             "pallas_engagements": pallas_kernels.engagements(),
+            "lint_findings": lint_findings,
             **suites,
             **device,
         }))

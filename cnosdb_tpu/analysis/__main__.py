@@ -1,0 +1,67 @@
+"""CLI: ``python -m cnosdb_tpu.analysis [paths…] [--json] [--fix-baseline]``.
+
+Exit status: 0 when the tree is clean (no findings beyond the baseline,
+no stale baseline cells), 1 otherwise. CI runs this as a tier-1 gate
+(tests/test_invariants.py); run it locally before pushing.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from . import BASELINE_PATH, run, write_baseline
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m cnosdb_tpu.analysis",
+        description="single-walk AST lint over the cnosdb_tpu invariants")
+    ap.add_argument("paths", nargs="*",
+                    help="files/dirs to lint (default: the whole package)")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="machine-readable report on stdout")
+    ap.add_argument("--fix-baseline", action="store_true",
+                    help="freeze the current findings as the new baseline "
+                         "(ratchet down after fixing debt, or absorb a "
+                         "new rule's pre-existing findings)")
+    ap.add_argument("--baseline", default=BASELINE_PATH,
+                    help="baseline file (default: the package baseline)")
+    ap.add_argument("--all-rules", action="store_true",
+                    help="ignore per-rule path scoping (fixture testing)")
+    args = ap.parse_args(argv)
+
+    rep = run(args.paths or None, baseline_path=args.baseline,
+              ignore_scope=args.all_rules)
+
+    if args.fix_baseline:
+        if args.paths:
+            print("--fix-baseline requires a whole-tree run (no paths)",
+                  file=sys.stderr)
+            return 2
+        write_baseline(rep.counts, args.baseline)
+        print(f"baseline rewritten: {len(rep.findings)} finding(s) in "
+              f"{len(rep.counts)} (rule, file) cell(s) -> {args.baseline}")
+        return 0
+
+    if args.as_json:
+        print(json.dumps(rep.as_dict(), indent=1))
+        return 0 if rep.ok else 1
+
+    for f in sorted(rep.violations, key=lambda f: (f.path, f.line)):
+        print(f.render())
+    for rule, path, allowed, found in rep.stale:
+        print(f"{path}: [{rule}] baseline stale: {allowed} allowed but "
+              f"only {found} found — lock the fix in with --fix-baseline")
+    n_base = len(rep.findings) - len(rep.violations)
+    if rep.ok:
+        print(f"OK: 0 violations ({n_base} baselined finding(s))")
+    else:
+        print(f"FAIL: {len(rep.violations)} violation(s), "
+              f"{len(rep.stale)} stale baseline cell(s) "
+              f"({n_base} baselined)")
+    return 0 if rep.ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

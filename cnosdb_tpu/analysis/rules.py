@@ -1,0 +1,514 @@
+"""Project-invariant lint rules.
+
+Every rule documents the incident or PR that motivated it (``motivation``)
+— a rule that can't point at a real failure it prevents is noise. To add
+one: subclass :class:`~cnosdb_tpu.analysis.Rule`, set ``name`` (kebab-case;
+it is the suppression token and the baseline key), declare ``node_types``
+for the shared walk and/or override ``begin_module`` for whole-module
+passes, and append it to :func:`all_rules`. Run ``--fix-baseline`` once if
+the tree has pre-existing debt the new rule should ratchet rather than
+block on.
+"""
+from __future__ import annotations
+
+import ast
+import re
+
+from . import Rule
+
+# --------------------------------------------------------------------------
+# helpers
+# --------------------------------------------------------------------------
+
+
+def _call_name(node: ast.Call) -> str | None:
+    fn = node.func
+    if isinstance(fn, ast.Name):
+        return fn.id
+    if isinstance(fn, ast.Attribute):
+        return fn.attr
+    return None
+
+
+def _recv_text(node: ast.Call) -> str:
+    if isinstance(node.func, ast.Attribute):
+        try:
+            return ast.unparse(node.func.value)
+        except Exception:
+            return "?"
+    return ""
+
+
+def _walk_no_nested_funcs(root: ast.AST):
+    """Walk a statement subtree without descending into nested function /
+    lambda bodies (code merely *defined* there doesn't run under the
+    enclosing lock/handler)."""
+    stack = list(ast.iter_child_nodes(root))
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _names_in(node: ast.AST) -> set:
+    return {n.id for n in ast.walk(node) if isinstance(n, ast.Name)}
+
+
+def _is_time_time_call(node: ast.AST) -> bool:
+    return (isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr == "time"
+            and isinstance(node.func.value, ast.Name)
+            and node.func.value.id in ("time", "_time"))
+
+
+# --------------------------------------------------------------------------
+# 1. no-bare-except — migrated from tests/test_no_bare_except.py (PR 1),
+#    widened from parallel/+storage/ to the whole package
+# --------------------------------------------------------------------------
+class NoBareExcept(Rule):
+    name = "no-bare-except"
+    motivation = ("PR 1 chaos suite: a bare except in RPC/recovery paths "
+                  "swallows KeyboardInterrupt/SystemExit, turning operator "
+                  "Ctrl-C and injected crashes into silently-ignored events")
+    node_types = (ast.ExceptHandler,)
+
+    def visit(self, node, ctx):
+        if node.type is None:
+            ctx.report(self, node,
+                       "bare 'except:' — catch Exception (or narrower) so "
+                       "control-flow exceptions propagate")
+
+
+# --------------------------------------------------------------------------
+# 2. rpc-call-timeout — migrated from tests/test_no_bare_except.py (PR 4),
+#    widened to the whole package
+# --------------------------------------------------------------------------
+class RpcCallTimeout(Rule):
+    name = "rpc-call-timeout"
+    motivation = ("PR 4 deadline plane: an rpc_call inheriting the 10 s "
+                  "default ignores the caller's request deadline — one slow "
+                  "peer absorbs the node for 10 s per split")
+    node_types = (ast.Call,)
+
+    def applies_to(self, relpath):
+        # net.py defines rpc_call (wait_rpc_ready's probe is capped there)
+        return relpath != "cnosdb_tpu/parallel/net.py"
+
+    def visit(self, node, ctx):
+        if _call_name(node) != "rpc_call":
+            return
+        has_kw = any(kw.arg == "timeout" or kw.arg is None  # **kwargs
+                     for kw in node.keywords)
+        if not has_kw and len(node.args) < 4:   # positional timeout = 4th
+            ctx.report(self, node,
+                       "rpc_call without explicit timeout= — every hop must "
+                       "pick a budget (the request deadline then caps it)")
+
+
+# --------------------------------------------------------------------------
+# 3/4. row-loop — migrated from tests/test_no_row_loops.py (PR 5)
+# --------------------------------------------------------------------------
+_VECTORIZED_FUNCS = ("_merge_distinct_vec", "_apply_gapfill",
+                     "_merge_results_vec")
+_FALLBACK_FUNC = "_merge_distinct"
+_ROW_ITER_NAMES = {"idxs", "idx", "rows", "row_idxs"}
+
+
+def _row_loops(fn: ast.AST):
+    """For-loops whose iterable is a row-index array: a bare name from the
+    denylist, or a direct np.nonzero(...) subscript."""
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.For):
+            continue
+        it = node.iter
+        if isinstance(it, ast.Name) and it.id in _ROW_ITER_NAMES:
+            yield node.lineno
+        elif isinstance(it, ast.Subscript) \
+                and isinstance(it.value, ast.Call) \
+                and isinstance(it.value.func, ast.Attribute) \
+                and it.value.func.attr == "nonzero":
+            yield node.lineno
+
+
+class _RowLoopBase(Rule):
+    def applies_to(self, relpath):
+        return relpath == "cnosdb_tpu/sql/executor.py"
+
+    def _funcs(self, ctx, names):
+        found = {}
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and node.name in names:
+                found[node.name] = node
+        return found
+
+
+class RowLoop(_RowLoopBase):
+    name = "row-loop"
+    motivation = ("PR 5 aggregation plane: a per-row Python loop in a "
+                  "vectorized section regresses silently — results stay "
+                  "right, only 10-100x slower at ClickBench cardinalities")
+
+    def begin_module(self, ctx):
+        found = self._funcs(ctx, _VECTORIZED_FUNCS)
+        for name in _VECTORIZED_FUNCS:
+            fn = found.get(name)
+            if fn is None:
+                ctx.report(self, 1,
+                           f"vectorized section {name} not found — if it "
+                           f"was renamed, update analysis/rules.py so the "
+                           f"lint keeps covering it")
+                continue
+            for line in _row_loops(fn):
+                ctx.report(self, line,
+                           f"per-row loop in vectorized section {name} — "
+                           f"use factorized codes + bincount/reduceat/"
+                           f"grouped_order (ops/group_agg.py) instead")
+
+
+class RowLoopFallback(_RowLoopBase):
+    name = "row-loop-fallback"
+    motivation = ("PR 5: _merge_distinct keeps per-row folds ONLY for "
+                  "payloads that defeat factorization; the baseline pins "
+                  "the count so new code paths can't quietly join them")
+
+    def begin_module(self, ctx):
+        fn = self._funcs(ctx, (_FALLBACK_FUNC,)).get(_FALLBACK_FUNC)
+        if fn is None:
+            ctx.report(self, 1,
+                       f"{_FALLBACK_FUNC} not found — update "
+                       f"analysis/rules.py if it was renamed")
+            return
+        for line in _row_loops(fn):
+            ctx.report(self, line,
+                       "scalar row-loop fallback in _merge_distinct "
+                       "(baselined; new aggregation work belongs in "
+                       "_merge_distinct_vec)")
+
+
+# --------------------------------------------------------------------------
+# 5. lock-blocking — new: blocking calls written inside `with <lock>:`
+# --------------------------------------------------------------------------
+_LOCKISH = ("lock", "mutex", "cond", "_cv")
+_BLOCKING_NAMES = {"rpc_call", "wait_rpc_ready", "urlopen", "recv",
+                   "recv_into", "sendall", "accept", "getresponse",
+                   "run_all"}
+_SUBPROCESS_NAMES = {"run", "check_call", "check_output", "Popen", "call"}
+
+
+def _lockish_name(expr: ast.AST) -> str | None:
+    if isinstance(expr, ast.Name):
+        n = expr.id
+    elif isinstance(expr, ast.Attribute):
+        n = expr.attr
+    elif isinstance(expr, ast.Call):
+        # with self._registry.lock_for(x): — look at the callee name
+        return _lockish_name(expr.func)
+    else:
+        return None
+    low = n.lower()
+    return n if any(k in low for k in _LOCKISH) else None
+
+
+class LockBlocking(Rule):
+    name = "lock-blocking"
+    motivation = ("PRs 1-4 each found a stall where one slow peer/disk op "
+                  "serialized the node because a mutex was held across it; "
+                  "ROADMAP #1/#2 add more threads and more locks")
+    node_types = (ast.With,)
+
+    def visit(self, node, ctx):
+        locks = [n for n in (_lockish_name(it.context_expr)
+                             for it in node.items) if n]
+        if not locks:
+            return
+        ctx_texts = set()
+        for it in node.items:
+            try:
+                ctx_texts.add(ast.unparse(it.context_expr))
+            except Exception:
+                pass
+        seen_lines = set()
+        for inner in _walk_no_nested_funcs(node):
+            if not isinstance(inner, ast.Call) or inner.lineno in seen_lines:
+                continue
+            what = self._blocking(inner, ctx_texts)
+            if what:
+                seen_lines.add(inner.lineno)
+                ctx.report(self, inner,
+                           f"{what} while holding {'/'.join(locks)} — move "
+                           f"the blocking call outside the lock (snapshot "
+                           f"state, drop the lock, then block)")
+
+    @staticmethod
+    def _blocking(call: ast.Call, ctx_texts: set) -> str | None:
+        name = _call_name(call)
+        recv = _recv_text(call)
+        if name in _BLOCKING_NAMES:
+            return f"{name}()"
+        if name == "sleep" and recv in ("", "time"):
+            return "time.sleep()"
+        if name == "open" and isinstance(call.func, ast.Name):
+            return "file open()"
+        if name == "result" and recv:
+            return "future .result()"
+        if name == "wait" and recv and recv not in ctx_texts:
+            # cv.wait() on the with-target releases the lock; .wait() on
+            # anything else (Event, Thread, process) blocks while holding it
+            return f"{recv}.wait()"
+        if name in _SUBPROCESS_NAMES and recv == "subprocess":
+            return f"subprocess.{name}()"
+        return None
+
+
+# --------------------------------------------------------------------------
+# 6. swallowed-exception — new: `except Exception: pass` in the planes
+#    where silence has already masked corruption
+# --------------------------------------------------------------------------
+class SwallowedException(Rule):
+    name = "swallowed-exception"
+    motivation = ("PR 3 integrity plane: quarantine/repair bugs hid behind "
+                  "silent except-pass until a counter was added; in "
+                  "parallel/+storage/ every swallow needs a log or metric")
+    node_types = (ast.ExceptHandler,)
+
+    def applies_to(self, relpath):
+        return relpath.startswith(("cnosdb_tpu/parallel/",
+                                   "cnosdb_tpu/storage/"))
+
+    def visit(self, node, ctx):
+        if not (isinstance(node.type, ast.Name)
+                and node.type.id == "Exception"):
+            return
+        if len(node.body) == 1 and isinstance(node.body[0], ast.Pass):
+            ctx.report(self, node,
+                       "'except Exception: pass' with no log/metric — count "
+                       "it (utils/stages.count_error) or narrow the except; "
+                       "silent swallows have masked real corruption before")
+
+
+# --------------------------------------------------------------------------
+# 7. jax-purity — new: Python control flow / host syncs on traced values
+# --------------------------------------------------------------------------
+_JAX_PURITY_FILES = ("cnosdb_tpu/ops/kernels.py",
+                     "cnosdb_tpu/ops/group_agg.py",
+                     "cnosdb_tpu/ops/pallas_kernels.py")
+_ARRAY_MODULES = {"jnp", "lax", "pl"}
+
+
+def _contains_jit(expr: ast.AST) -> bool:
+    for n in ast.walk(expr):
+        if isinstance(n, ast.Name) and n.id == "jit":
+            return True
+        if isinstance(n, ast.Attribute) and n.attr == "jit":
+            return True
+    return False
+
+
+def _static_argnames(call: ast.Call) -> set:
+    out: set = set()
+    for kw in call.keywords:
+        if kw.arg != "static_argnames":
+            continue
+        v = kw.value
+        if isinstance(v, ast.Constant) and isinstance(v.value, str):
+            out.add(v.value)
+        elif isinstance(v, (ast.Tuple, ast.List)):
+            for elt in v.elts:
+                if isinstance(elt, ast.Constant) and isinstance(elt.value, str):
+                    out.add(elt.value)
+    return out
+
+
+class JaxPurity(Rule):
+    name = "jax-purity"
+    motivation = ("tracer leaks are the standing failure mode of the "
+                  "device plane (ROADMAP #1/#2): a Python `if` or .item() "
+                  "on a traced value breaks jit tracing or forces a "
+                  "device->host sync in the middle of the kernel")
+
+    def applies_to(self, relpath):
+        return relpath in _JAX_PURITY_FILES
+
+    def begin_module(self, ctx):
+        funcs = {n.name: n for n in ast.walk(ctx.tree)
+                 if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))}
+        traced: dict[str, set] = {}   # fn name → static argnames
+        for name, fn in funcs.items():
+            if name.endswith("_kernel"):
+                traced.setdefault(name, set())
+            for dec in fn.decorator_list:
+                if _contains_jit(dec):
+                    statics = _static_argnames(dec) \
+                        if isinstance(dec, ast.Call) else set()
+                    traced.setdefault(name, set()).update(statics)
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            is_jit = _contains_jit(node.func) or (
+                _call_name(node) == "pallas_call")
+            if not is_jit:
+                continue
+            statics = _static_argnames(node)
+            for n in ast.walk(node):
+                if isinstance(n, ast.Name) and n.id in funcs:
+                    traced.setdefault(n.id, set()).update(statics)
+        for name in traced:
+            self._check_traced(funcs[name], traced[name], ctx)
+        # host syncs are wrong anywhere in these files' device sections:
+        # .item() stalls the pipeline per element
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Attribute) \
+                    and node.func.attr == "item" and not node.args:
+                ctx.report(self, node,
+                           ".item() forces a device->host sync — keep "
+                           "values on device or pull whole arrays once "
+                           "with np.asarray")
+
+    def _check_traced(self, fn, statics: set, ctx):
+        args = fn.args
+        tainted = {a.arg for a in
+                   list(args.posonlyargs) + list(args.args)
+                   if a.arg not in statics and a.arg != "self"}
+        # forward-propagate through assignments from array expressions
+        assigns = sorted((n for n in ast.walk(fn)
+                          if isinstance(n, (ast.Assign, ast.AugAssign,
+                                            ast.AnnAssign))),
+                         key=lambda n: n.lineno)
+        for _ in range(2):   # two passes ≈ fixpoint for real code
+            for a in assigns:
+                value = a.value
+                if value is None:
+                    continue
+                refs = _names_in(value)
+                is_arrayish = bool(refs & tainted) or any(
+                    isinstance(n, ast.Attribute)
+                    and isinstance(n.value, ast.Name)
+                    and n.value.id in _ARRAY_MODULES
+                    for n in ast.walk(value))
+                if not is_arrayish:
+                    continue
+                targets = a.targets if isinstance(a, ast.Assign) \
+                    else [a.target]
+                for t in targets:
+                    tainted |= _names_in(t)
+        for node in ast.walk(fn):
+            if isinstance(node, (ast.If, ast.While, ast.IfExp)) \
+                    and _names_in(node.test) & tainted:
+                ctx.report(self, node,
+                           f"Python branch on traced value "
+                           f"({', '.join(sorted(_names_in(node.test) & tainted))}) "
+                           f"inside jitted {fn.name} — use jnp.where/"
+                           f"lax.cond, or mark the arg static")
+            elif isinstance(node, ast.Call):
+                name = _call_name(node)
+                if name in ("bool", "int", "float") and node.args \
+                        and _names_in(node.args[0]) & tainted:
+                    ctx.report(self, node,
+                               f"{name}() on traced value inside jitted "
+                               f"{fn.name} — concretizes the tracer "
+                               f"(ConcretizationTypeError at best)")
+                elif name in ("asarray", "array") \
+                        and _recv_text(node) == "np" and node.args \
+                        and _names_in(node.args[0]) & tainted:
+                    ctx.report(self, node,
+                               f"np.{name}() on traced value inside jitted "
+                               f"{fn.name} — host materialization under "
+                               f"trace")
+
+
+# --------------------------------------------------------------------------
+# 8. wallclock-duration — new: time.time() arithmetic where monotonic()
+#    is required
+# --------------------------------------------------------------------------
+class WallclockDuration(Rule):
+    name = "wallclock-duration"
+    motivation = ("PR 4: deadline/backoff/breaker intervals measured with "
+                  "time.time() jump under NTP step/slew — a clock step "
+                  "mid-flight fires timeouts early or never")
+
+    def begin_module(self, ctx):
+        # each function is its own scope (the per-scope walks stop at
+        # nested defs, so nothing is visited twice); module level last
+        scopes = [n for n in ast.walk(ctx.tree)
+                  if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+        scopes.append(ctx.tree)
+        for scope in scopes:
+            self._check_scope(scope, ctx)
+
+    def _check_scope(self, scope, ctx):
+        tainted: set = set()
+        for n in _walk_no_nested_funcs(scope):
+            if isinstance(n, ast.Assign) and _is_time_time_call(n.value):
+                # only plain names: `kwargs["at"] = time.time()` stores a
+                # timestamp in a container, it doesn't make the container
+                # a clock reading
+                tainted |= {t.id for t in n.targets
+                            if isinstance(t, ast.Name)}
+        reported: set = set()
+        for n in _walk_no_nested_funcs(scope):
+            if not isinstance(n, (ast.BinOp, ast.Compare)):
+                continue
+            if isinstance(n, ast.BinOp) \
+                    and not isinstance(n.op, (ast.Add, ast.Sub)):
+                continue
+            hit = any(_is_time_time_call(x) for x in ast.walk(n))
+            if not hit and tainted:
+                hit = bool(_names_in(n) & tainted)
+            if hit and n.lineno not in reported:
+                reported.add(n.lineno)
+                ctx.report(self, n,
+                           "duration arithmetic on time.time() — wall "
+                           "clock steps under NTP; use time.monotonic() "
+                           "(wall clock is only for cross-process "
+                           "timestamps, which deserve a disable= + reason)")
+
+
+# --------------------------------------------------------------------------
+# 9. metrics-naming — new: /metrics naming conventions
+# --------------------------------------------------------------------------
+_METRIC_NAME_RE = re.compile(r"^cnosdb_[a-z0-9_]+$")
+_METRIC_METHODS = {"incr", "set_gauge", "observe"}
+
+
+class MetricsNaming(Rule):
+    name = "metrics-naming"
+    motivation = ("dashboards and the bench-trajectory tooling key on "
+                  "cnosdb_* naming; unprefixed or mis-suffixed series "
+                  "silently fall out of every query")
+    node_types = (ast.Call,)
+
+    def visit(self, node, ctx):
+        if not (isinstance(node.func, ast.Attribute)
+                and node.func.attr in _METRIC_METHODS):
+            return
+        if not (node.args and isinstance(node.args[0], ast.Constant)
+                and isinstance(node.args[0].value, str)):
+            return
+        name = node.args[0].value
+        method = node.func.attr
+        if not _METRIC_NAME_RE.match(name):
+            ctx.report(self, node,
+                       f"metric {name!r} must match cnosdb_[a-z0-9_]+ "
+                       f"(prefixed, lowercase snake_case)")
+            return
+        if method == "incr" and not name.endswith("_total"):
+            ctx.report(self, node,
+                       f"counter {name!r} must end in _total "
+                       f"(prometheus counter convention)")
+        elif method == "observe" and not name.endswith(
+                ("_ms", "_seconds", "_bytes")):
+            ctx.report(self, node,
+                       f"histogram {name!r} must end in a unit suffix "
+                       f"(_ms, _seconds, _bytes)")
+
+
+def all_rules() -> list:
+    return [NoBareExcept(), RpcCallTimeout(), RowLoop(), RowLoopFallback(),
+            LockBlocking(), SwallowedException(), JaxPurity(),
+            WallclockDuration(), MetricsNaming()]

@@ -62,6 +62,7 @@ import random
 import threading
 import time
 import zlib
+from .utils import lockwatch
 
 
 class FaultInjected(OSError):
@@ -78,7 +79,7 @@ ENABLED = False
 # production processes (env unset) expose nothing.
 CTL_ARMED = "CNOSDB_FAULTS" in os.environ
 
-_lock = threading.RLock()
+_lock = lockwatch.RLock("faults.registry")
 _rules: dict[str, list["_Rule"]] = {}
 _fired: list[tuple[str, str, int]] = []   # (point, action, hit#) sequence
 _seed = 0

@@ -28,8 +28,9 @@ from dataclasses import dataclass
 import numpy as np
 
 from ..utils import stages
+from ..utils import lockwatch
 
-_LOCK = threading.Lock()
+_LOCK = lockwatch.Lock("group_agg.plan_cache")
 _COUNTERS: dict[str, int] = {}
 
 

@@ -35,8 +35,9 @@ _DENSE_BUCKET_LIMIT = 1 << 21
 # write memo merge. One process-wide lock — the guarded sections are dict
 # bookkeeping only (no kernel work), so contention is negligible.
 import threading as _threading
+from ..utils import lockwatch
 
-_BATCH_CACHE_LOCK = _threading.Lock()
+_BATCH_CACHE_LOCK = lockwatch.Lock("tpu_exec.batch_cache")
 
 
 def _FORCE_DEVICE() -> bool:

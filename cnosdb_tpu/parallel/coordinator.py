@@ -23,6 +23,7 @@ from hashlib import blake2b
 import numpy as np
 
 from ..errors import ChecksumMismatch, CoordinatorError, DeadlineExceeded
+from ..utils import stages
 from ..utils import deadline as deadline_mod
 from ..utils.backoff import Backoff
 from ..models.points import SeriesRows, WriteBatch
@@ -31,6 +32,7 @@ from ..models.schema import TskvTableSchema, ValueType
 from ..storage.engine import TsKv
 from ..storage.scan import ScanBatch, scan_vnode
 from .meta import MetaStore
+from ..utils import lockwatch
 
 log = logging.getLogger(__name__)
 
@@ -86,7 +88,7 @@ class Coordinator:
         # key → (ScanToken, ScanBatch, nbytes); LRU by dict re-insertion
         self._scan_cache: dict = {}
         self._scan_cache_bytes = 0
-        self._scan_cache_lock = threading.Lock()
+        self._scan_cache_lock = lockwatch.Lock("coord.scan_cache")
         # schema auto-creation callbacks land on meta; keep engine's view hot
         meta.watch(self._on_meta_event)
         # seed the engine's schema view from the catalog for EVERY owner
@@ -102,10 +104,10 @@ class Coordinator:
         # throttle clock + cumulative counters per usage metric key,
         # lock-guarded: executor/HTTP threads record concurrently
         self._usage_last: dict = {}
-        self._usage_lock = threading.Lock()
+        self._usage_lock = lockwatch.Lock("coord.usage")
         # circuit breaker: node_id → [consecutive_failures, open_until]
         self._cb: dict = {}
-        self._cb_lock = threading.Lock()
+        self._cb_lock = lockwatch.Lock("coord.circuit_breakers")
 
     def _rpc(self, node_id: int, method: str, payload: dict,
              timeout: float = 10.0):
@@ -224,7 +226,7 @@ class Coordinator:
             try:
                 self._record_write_usage(tenant, db, owner, est, pre_sizes)
             except Exception:
-                pass
+                stages.count_error("swallow.coord.record_write_usage")
 
     def _write_points_inner(self, tenant, db, owner, batch, sync):
         per_rs: dict[int, tuple[object, WriteBatch]] = {}
@@ -273,7 +275,7 @@ class Coordinator:
         value into a monotone counter first (prometheus-style)."""
         try:
             key = (table, tuple(sorted(tags.items())))
-            now = time.time()
+            now = time.monotonic()   # throttle interval, not a timestamp
             with self._usage_lock:
                 if cumulative:
                     cnt = self._usage_last.setdefault(("c", key), [0])
@@ -295,7 +297,7 @@ class Coordinator:
                 {"value": (int(ValueType.UNSIGNED), [int(value)])}))
             self.write_points("cnosdb", "usage_schema", wb)
         except Exception:
-            pass   # metrics must never fail or recurse into the caller
+            stages.count_error("swallow.coord.report_usage")  # metrics must never fail or recurse into the caller
 
     def _record_write_usage(self, tenant, db, owner, est_bytes, pre_sizes):
         node = str(self.node_id)
@@ -500,7 +502,7 @@ class Coordinator:
                           {"owner": owner, "rs": rs.to_dict(),
                            "vnode_id": vnode_id})
         except Exception:
-            pass
+            stages.count_error("swallow.coord.replica_stepdown")
 
     def _replica_progress(self, owner: str, rs,
                           vnode_id: int) -> tuple[int, int] | None:
@@ -881,7 +883,7 @@ class Coordinator:
                 from ..ops.device_cache import EagerUploader
 
                 return EagerUploader
-        except Exception:
+        except Exception:  # lint: disable=swallowed-exception (device probe: no accelerator is the normal case on CPU hosts, not an error)
             pass
         return None
 
@@ -987,7 +989,7 @@ class Coordinator:
                 return
             self.meta.update_vnode(vnode_id, status=int(VnodeStatus.BROKEN))
         except Exception:
-            pass  # advisory only; the scan already failed over
+            stages.count_error("swallow.coord.mark_vnode_broken")  # advisory only; the scan already failed over
 
     def _clear_vnode_broken(self, vnode_id: int):
         from ..models.meta_data import VnodeStatus
@@ -995,7 +997,7 @@ class Coordinator:
         try:
             self.meta.update_vnode(vnode_id, status=int(VnodeStatus.RUNNING))
         except Exception:
-            pass
+            stages.count_error("swallow.coord.clear_vnode_broken")
 
     # ---------------------------------------------------------------- admin
     def move_vnode(self, vnode_id: int, to_node: int):
@@ -1033,7 +1035,7 @@ class Coordinator:
                               {"owner": owner, "vnode_id": vnode_id,
                                "rs_id": rs.id})
                 except Exception:
-                    pass  # source unreachable: placement is authoritative
+                    stages.count_error("swallow.coord.vnode_drop_rpc")  # source unreachable: placement is authoritative
             self.meta.update_vnode(vnode_id, node_id=to_node,
                                    status=int(VnodeStatus.COPYING))
             hit2 = self.meta.find_replica_set(rs.id)
@@ -1053,7 +1055,7 @@ class Coordinator:
                 self._rpc(src_node, "vnode_drop",
                           {"owner": owner, "vnode_id": vnode_id})
         except Exception:
-            pass  # orphaned source data is garbage, not corruption
+            stages.count_error("swallow.coord.vnode_drop_rpc")  # orphaned source data is garbage, not corruption
 
     def copy_vnode(self, vnode_id: int, to_node: int) -> int:
         """COPY VNODE <id> TO NODE <n>: add a replica seeded from a
@@ -1084,7 +1086,7 @@ class Coordinator:
             try:
                 self.meta.remove_replica_vnode(new_id)
             except Exception:
-                pass  # meta unreachable: placeholder stays; retryable
+                stages.count_error("swallow.coord.remove_placeholder")  # meta unreachable: placeholder stays; retryable
             raise
         return new_id
 
@@ -1116,11 +1118,11 @@ class Coordinator:
                     owner, rs_new, sorted(v.id for v in rs.vnodes),
                     timeout=5.0)
             except Exception:
-                pass
+                stages.count_error("swallow.coord.membership_rollback")
             try:
                 self.meta.remove_replica_vnode(new_id)
             except Exception:
-                pass
+                stages.count_error("swallow.coord.remove_placeholder")
             raise
 
     def _wait_member_caught_up(self, owner: str, rs, vnode_id: int,
@@ -1189,7 +1191,7 @@ class Coordinator:
                               {"owner": owner, "rs_id": rs.id,
                                "vnode_id": survivor_to_stop.id})
                 except Exception:
-                    pass  # stale member is inert once placement updated
+                    stages.count_error("swallow.coord.replica_stop_member")  # stale member is inert once placement updated
         if self._replica_mgr is not None:
             self._replica_mgr.stop_member(owner, rs.id, vnode_id)
         if node == self.node_id or not self.distributed:
@@ -1200,7 +1202,7 @@ class Coordinator:
                           {"owner": owner, "vnode_id": vnode_id,
                            "rs_id": rs.id})
             except Exception:
-                pass  # orphaned data is garbage, placement is authoritative
+                stages.count_error("swallow.coord.vnode_drop_rpc")  # orphaned data is garbage, placement is authoritative
 
     def destroy_replica_set(self, rs_id: int):
         """REPLICA DESTORY: tear down a (damaged) replica set wholesale —
@@ -1222,7 +1224,7 @@ class Coordinator:
                               {"owner": owner, "vnode_id": v.id,
                                "rs_id": rs_id})
                 except Exception:
-                    pass  # unreachable node: placement is authoritative
+                    stages.count_error("swallow.coord.vnode_drop_rpc")  # unreachable node: placement is authoritative
 
     def compact_vnode(self, vnode_id: int):
         """COMPACT VNODE on whichever node owns it."""

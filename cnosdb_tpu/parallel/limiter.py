@@ -18,6 +18,7 @@ import threading
 import time
 
 from ..errors import LimiterError
+from ..utils import lockwatch
 
 
 class TokenBucket:
@@ -28,7 +29,7 @@ class TokenBucket:
         self.capacity = float(burst if burst is not None else rate_per_sec)
         self.tokens = self.capacity
         self.t_last = time.monotonic()
-        self.lock = threading.Lock()
+        self.lock = lockwatch.Lock("limiter.bucket")
 
     def try_acquire(self, n: float = 1.0) -> bool:
         with self.lock:
@@ -48,7 +49,7 @@ class TenantLimiters:
     def __init__(self, meta):
         self.meta = meta
         self._buckets: dict[tuple[str, str], TokenBucket] = {}
-        self._lock = threading.Lock()
+        self._lock = lockwatch.Lock("limiter.tenants")
 
     def _bucket(self, tenant: str, kind: str) -> TokenBucket | None:
         opts = self.meta.tenants.get(tenant)

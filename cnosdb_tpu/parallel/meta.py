@@ -24,6 +24,7 @@ import secrets
 import threading
 import time
 
+from ..utils import stages
 from ..errors import (
     DatabaseAlreadyExists, DatabaseNotFound, MetaError, TableAlreadyExists,
     TableNotFound, TenantNotFound,
@@ -32,6 +33,7 @@ from ..models.meta_data import BucketInfo, NodeInfo, ReplicationSet, VnodeInfo
 from ..models.schema import (
     DatabaseOptions, DatabaseSchema, TenantOptions, TskvTableSchema,
 )
+from ..utils import lockwatch
 
 DEFAULT_TENANT = "cnosdb"
 
@@ -111,7 +113,7 @@ class MetaStore:
         data node, so placement must not target its node_id."""
         self.path = path
         self.node_id = node_id
-        self.lock = threading.RLock()
+        self.lock = lockwatch.RLock("meta.store")
         self.tenants: dict[str, TenantOptions] = {}
         self.users: dict[str, dict] = {}
         self.databases: dict[str, DatabaseSchema] = {}          # owner → schema
@@ -289,7 +291,7 @@ class MetaStore:
             try:
                 w(event, kw)
             except Exception:
-                pass
+                stages.count_error("swallow.meta.watcher_cb")
 
     def wait_version(self, after: int, timeout: float = 30.0) -> int:
         """Block until version > after (long-poll /watch); → current version."""
@@ -455,7 +457,7 @@ class MetaStore:
         set."""
         import time as _time
 
-        cutoff = (_time.time() if now is None else now) - older_than_s
+        cutoff = (_time.time() if now is None else now) - older_than_s  # lint: disable=wallclock-duration (proposer pins wall-clock now into the replicated purge command so members agree)
         with self.lock:
             fire = []
 
@@ -959,7 +961,7 @@ class MetaStore:
             out = []
             for n in self.nodes.values():
                 seen = n.attributes.get("last_seen")
-                if seen is None or now - seen <= max_age:
+                if seen is None or now - seen <= max_age:  # lint: disable=wallclock-duration (last_seen rides meta snapshots cross-process; wall clock by design)
                     out.append(n)
             return out
 

@@ -26,11 +26,13 @@ import time
 
 from .. import errors as _errors
 from .. import faults
+from ..utils import stages
 from ..errors import CnosError, MetaError
 from ..models.meta_data import BucketInfo
 from ..models.schema import DatabaseSchema, TenantOptions, TskvTableSchema
 from .meta import MetaStore
 from .net import RpcError, RpcServer, rpc_call
+from ..utils import lockwatch
 
 # mutation → {arg name → rehydrator} applied server-side
 _ARG_HYDRATORS = {
@@ -189,7 +191,7 @@ class MetaStateMachine:
                 if restored:
                     self._seen = dict.fromkeys(self.store.recent_req_ids)
             except Exception:
-                pass
+                stages.count_error("swallow.metasvc.restore")
             if not restored:
                 # disk unreadable too: at least rewind the watermark and
                 # dedup arming so the retry is not mistaken for a dup
@@ -435,7 +437,7 @@ class MetaService:
                     rpc_call(addr, "meta_beat", {**p, "_fwd": True},
                              timeout=5.0)
                 except Exception:
-                    pass  # beat is best-effort
+                    stages.count_error("swallow.metasvc.beat_forward")  # beat is best-effort
         return {"ok": True}
 
     def _watch(self, p):
@@ -483,7 +485,7 @@ class MetaClient:
         self.cache = MetaStore(path=None, node_id=node_id, register_self=False)
         self._watchers: list = []
         self._seen_version = 0
-        self._sync_lock = threading.Lock()
+        self._sync_lock = lockwatch.Lock("metasvc.sync")
         self._stop = threading.Event()
         self.refresh()
         self._watch_thread = None
@@ -519,7 +521,7 @@ class MetaClient:
                 try:
                     w(event, kw)
                 except Exception:
-                    pass
+                    stages.count_error("swallow.metasvc.watcher_cb")
 
     def _watch_loop(self):
         while not self._stop.is_set():
@@ -539,7 +541,7 @@ class MetaClient:
                     rpc_call(self.addr, "meta_beat",
                              {"node_id": self.node_id}, timeout=5.0)
                 except Exception:
-                    pass
+                    stages.count_error("swallow.metasvc.self_beat")
         self._hb_thread = threading.Thread(target=beat, daemon=True)
         self._hb_thread.start()
 

@@ -32,6 +32,7 @@ from ..errors import CnosError, DeadlineExceeded
 from ..utils import deadline as deadline_mod
 from ..utils import stages
 from ..utils.backoff import Backoff
+from ..utils import lockwatch
 
 log = logging.getLogger("cnosdb.rpc")
 
@@ -196,7 +197,7 @@ class _ConnPool:
     MAX_IDLE_PER_ADDR = 8
 
     def __init__(self):
-        self.lock = threading.Lock()
+        self.lock = lockwatch.Lock("net.conn_pool")
         self.idle: dict[str, list[http.client.HTTPConnection]] = {}
 
     def get(self, addr: str, timeout: float):
@@ -236,6 +237,9 @@ def rpc_call(addr: str, method: str, payload: dict | None = None,
     timeout for this hop, the payload gains `_deadline_ms`/`_qid` so the
     peer can reject expired work and register for cancel fan-out, and an
     already-expired/cancelled context refuses to send at all."""
+    # lock-order watchdog: an RPC issued with any mutex held means one
+    # slow peer can stall every thread queued on that mutex
+    lockwatch.note_blocking(f"rpc:{method}")
     dl = deadline_mod.current()
     if dl is not None:
         # raises DeadlineExceeded / cancelled QueryError when no budget

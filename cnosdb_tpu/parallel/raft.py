@@ -29,7 +29,9 @@ from dataclasses import dataclass, field
 
 import msgpack
 
+from ..utils import stages
 from ..errors import ReplicationError
+from ..utils import lockwatch
 
 
 class Role:
@@ -279,7 +281,7 @@ class InProcessTransport(Transport):
     def __init__(self):
         self.nodes: dict[tuple[str, int], "RaftNode"] = {}
         self.partitions: set[frozenset] = set()
-        self.lock = threading.Lock()
+        self.lock = lockwatch.Lock("raft.sim_net")
         self.loss_rate = 0.0
         self.max_delay_s = 0.0
         self.reorder_rate = 0.0
@@ -418,11 +420,11 @@ class RaftNode:
         self.next_index: dict[int, int] = {}
         self.match_index: dict[int, int] = {}
         self.alive = True
-        self.lock = threading.RLock()
+        self.lock = lockwatch.RLock("raft.node")
         # serializes sm.apply vs sm.snapshot so a shipped snapshot is
         # consistent with the applied index it claims (ordering: self.lock
         # may be held when taking _sm_lock, never the reverse)
-        self._sm_lock = threading.Lock()
+        self._sm_lock = lockwatch.Lock("raft.sm")
         self._last_heartbeat = time.monotonic()
         self._election_deadline = self._new_deadline()
         self._stop = threading.Event()
@@ -445,7 +447,7 @@ class RaftNode:
             try:
                 cb(self)
             except Exception:
-                pass
+                stages.count_error("swallow.raft.on_state_cb")
 
     def stop(self):
         self._stop.set()
@@ -501,7 +503,7 @@ class RaftNode:
         # for slow/unreachable peers (same rationale as _broadcast_append)
         votes = [1]
         total = len(self.peers) + 1
-        vote_lock = threading.Lock()
+        vote_lock = lockwatch.Lock("raft.vote")
         settled = threading.Event()
 
         replied = [0]
@@ -573,7 +575,7 @@ class RaftNode:
             self._election_deadline = self._new_deadline()
         votes = [1]
         total = len(self.peers) + 1
-        vote_lock = threading.Lock()
+        vote_lock = lockwatch.Lock("raft.vote")
         settled = threading.Event()
 
         replied = [0]
@@ -809,7 +811,7 @@ class RaftNode:
         try:
             self._send_append(peer)
         except Exception:
-            pass
+            stages.count_error("swallow.raft.send_append")
 
     def _send_append(self, peer: int):
         need_snapshot = False
@@ -1064,7 +1066,7 @@ class MultiRaft:
 
     def __init__(self):
         self.groups: dict[str, RaftNode] = {}
-        self.lock = threading.Lock()
+        self.lock = lockwatch.Lock("raft.multi")
 
     def add(self, node: RaftNode):
         with self.lock:

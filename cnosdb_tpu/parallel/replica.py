@@ -30,6 +30,7 @@ from .raft import (
     HttpTransport, InProcessTransport, LogEntry, MultiRaft, NotLeader,
     RaftNode, StateMachine, WalLogStore,
 )
+from ..utils import lockwatch
 
 
 class VnodeStateMachine(StateMachine):
@@ -73,12 +74,12 @@ class ReplicaGroupManager:
         self.multi = MultiRaft()
         self.election_timeout = election_timeout
         self.heartbeat_interval = heartbeat_interval
-        self.lock = threading.Lock()
+        self.lock = lockwatch.Lock("replica.manager")
         # group_id → ReplicationSet placement (for peer resolution)
         self._placements: dict[str, ReplicationSet] = {}
         # leadership transitions wake blocked writers (event-driven, not
         # sleep-polling: pollers starve under load and hit deadlines)
-        self._state_cv = threading.Condition()
+        self._state_cv = threading.Condition(lockwatch.RLock("replica.state_cv"))
 
     def _on_member_state(self, _node) -> None:
         with self._state_cv:

@@ -27,13 +27,14 @@ import time
 
 from ..errors import AdmissionRejected
 from ..utils import deadline as deadline_mod
+from ..utils import lockwatch
 
 
 class AdmissionGate:
     def __init__(self, max_concurrent: int = 64, max_queued: int = 128):
         self.max_concurrent = max(1, int(max_concurrent))
         self.max_queued = max(0, int(max_queued))
-        self._cond = threading.Condition()
+        self._cond = threading.Condition(lockwatch.RLock("admission.gate"))
         self._running = 0
         self._queued = 0
         # cumulative counters (cnosdb_requests_*_total)

@@ -35,6 +35,7 @@ except Exception:  # pragma: no cover - pyarrow always present in this env
     FLIGHT_AVAILABLE = False
 
 from ..sql.executor import QueryExecutor, ResultSet, Session
+from ..utils import lockwatch
 
 # ---------------------------------------------------------------- protobuf
 _SQL_NS = "type.googleapis.com/arrow.flight.protocol.sql."
@@ -317,7 +318,7 @@ if FLIGHT_AVAILABLE:
             self.location = location
             # statement_handle → executed Table (one do_get consumes it)
             self._results: dict[bytes, "pa.Table"] = {}
-            self._results_lock = threading.Lock()
+            self._results_lock = lockwatch.Lock("flight.results")
             # prepared handle → last bound parameter row (DoPut with a
             # CommandPreparedStatementQuery descriptor binds; the next
             # get_flight_info on that handle consumes the binding)

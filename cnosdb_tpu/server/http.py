@@ -76,6 +76,7 @@ class HttpServer:
             web.get("/debug/backtrace", self.handle_backtrace),
             web.get("/debug/pprof", self.handle_pprof),
             web.get("/debug/scrub", self.handle_scrub),
+            web.get("/debug/lockgraph", self.handle_lockgraph),
         ])
         # background integrity scrubber (storage/scrub.py), attached by
         # run_server when cfg.storage.scrub_interval > 0
@@ -173,12 +174,12 @@ class HttpServer:
             dl.cancel("client disconnected")
             raise
         except CnosError as e:
-            self.metrics.incr("http_write_errors")
+            self.metrics.incr("cnosdb_http_write_errors_total")
             if isinstance(e, DeadlineExceeded):
                 self.metrics.incr("cnosdb_requests_deadline_exceeded_total")
             return _err_response(_status_for(e), e)
-        self.metrics.incr("http_writes")
-        self.metrics.incr("http_points_written", batch.n_rows())
+        self.metrics.incr("cnosdb_http_writes_total")
+        self.metrics.incr("cnosdb_http_points_written_total", batch.n_rows())
         self._record_http_usage(request, session, "http_data_in",
                                 len(body))
         self._record_http_usage(request, session, "http_writes", 1)
@@ -242,11 +243,11 @@ class HttpServer:
             dl.cancel("client disconnected")
             raise
         except CnosError as e:
-            self.metrics.incr("http_sql_errors")
+            self.metrics.incr("cnosdb_http_sql_errors_total")
             if isinstance(e, DeadlineExceeded):
                 self.metrics.incr("cnosdb_requests_deadline_exceeded_total")
             return _err_response(_status_for(e), e)
-        self.metrics.incr("http_queries")
+        self.metrics.incr("cnosdb_http_queries_total")
         self._record_http_usage(request, session, "http_queries", 1)
         self._record_http_usage(request, session, "http_data_in", len(sql))
         rs = results[-1] if results else ResultSet.empty()
@@ -412,7 +413,7 @@ class HttpServer:
                     session.tenant, session.database, batch))
         except CnosError as e:
             return _err_response(_status_for(e), e)
-        self.metrics.incr("prom_write_points", batch.n_rows())
+        self.metrics.incr("cnosdb_prom_write_points_total", batch.n_rows())
         return web.Response(status=204)
 
     async def handle_prom_read(self, request):
@@ -550,10 +551,10 @@ class HttpServer:
                 None, lambda: self.coord.write_points(
                     session.tenant, session.database, batch))
         except CnosError as e:
-            self.metrics.incr("es_bulk_errors")
+            self.metrics.incr("cnosdb_es_bulk_errors_total")
             return _err_response(_status_for(e), e)
-        self.metrics.incr("es_bulk_writes")
-        self.metrics.incr("es_bulk_points_written", batch.n_rows())
+        self.metrics.incr("cnosdb_es_bulk_writes_total")
+        self.metrics.incr("cnosdb_es_bulk_points_written_total", batch.n_rows())
         return web.json_response({"errors": False, "items": batch.n_rows()})
 
     # --------------------------------------------------- traces (OTLP in)
@@ -719,6 +720,17 @@ class HttpServer:
                 status=404)
         return web.json_response({"data": data, "total": len(data)})
 
+    async def handle_lockgraph(self, request):
+        """Runtime lock-order watchdog state (utils/lockwatch.py): the
+        observed (held → acquired) graph, any order cycles (potential
+        deadlocks), longest-held locks, and locks held across an RPC hop.
+        Reports `enabled: false` with empty tables unless the process was
+        started with CNOSDB_LOCKWATCH=1 (chaos/cluster suites do this)."""
+        self._require_admin(request)
+        from ..utils import lockwatch
+
+        return web.json_response(lockwatch.report())
+
     async def handle_metrics(self, request):
         from ..utils import executor, stages
 
@@ -775,6 +787,13 @@ class HttpServer:
 
         for name, n in _group_agg.counters_snapshot().items():
             self.metrics.set_gauge("cnosdb_group_agg_total", n, kind=name)
+        # invariant plane: lock-order watchdog counters (all zero unless
+        # the node runs with CNOSDB_LOCKWATCH=1; order_cycles > 0 means a
+        # potential deadlock was observed — see /debug/lockgraph)
+        from ..utils import lockwatch
+
+        for name, n in lockwatch.counters_snapshot().items():
+            self.metrics.set_gauge("cnosdb_lockwatch_total", n, kind=name)
         return web.Response(text=self.metrics.prometheus_text(),
                             content_type="text/plain")
 
@@ -811,7 +830,7 @@ class HttpServer:
                         await loop.run_in_executor(
                             None, lambda b=batch: self.coord.write_points(
                                 DEFAULT_TENANT, "public", b))
-                        self.metrics.incr("tcp_opentsdb_points",
+                        self.metrics.incr("cnosdb_tcp_opentsdb_points_total",
                                           batch.n_rows())
                     except CnosError as e:
                         writer.write(f"error: {e}\n".encode())

@@ -7,11 +7,12 @@ from __future__ import annotations
 
 import threading
 from collections import defaultdict
+from ..utils import lockwatch
 
 
 class MetricsRegistry:
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = lockwatch.Lock("metrics.registry")
         self._counters: dict[tuple[str, tuple], float] = defaultdict(float)
         self._gauges: dict[tuple[str, tuple], float] = {}
         self._histograms: dict[tuple[str, tuple], list] = defaultdict(list)

@@ -17,6 +17,7 @@ import contextvars
 import secrets
 import threading
 import time
+from ..utils import lockwatch
 
 TRACE_HEADER = "cnos-trace-id"
 
@@ -71,7 +72,7 @@ class TraceCollector:
     def __init__(self, capacity: int = 4096):
         self.capacity = capacity
         self._spans: list[dict] = []
-        self._lock = threading.Lock()
+        self._lock = lockwatch.Lock("trace.collector")
         self.sinks: list = []   # extra consumers (OTLP exporter)
 
     def record(self, span: Span):
@@ -143,7 +144,7 @@ class OtlpExporter:
         self.batch_size = batch_size
         self.flush_interval_s = flush_interval_s
         self._queue: list[dict] = []
-        self._lock = threading.Lock()
+        self._lock = lockwatch.Lock("trace.otlp_queue")
         self._wake = threading.Event()
         self._stop = False
         self.exported = 0

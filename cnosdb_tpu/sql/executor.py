@@ -33,6 +33,7 @@ from ..ops.tpu_exec import AggSpec, TpuQuery, execute_scan_aggregate
 from ..parallel.coordinator import Coordinator
 from ..parallel.meta import MetaStore
 from ..utils import stages
+from ..utils import lockwatch
 from . import ast
 from . import expr as expr_mod
 from . import relational as rel
@@ -88,7 +89,7 @@ class QueryTracker:
     def __init__(self):
         import threading
 
-        self._lock = threading.Lock()
+        self._lock = lockwatch.Lock("executor.query_tracker")
         self._next = 1
         self.running: dict[int, dict] = {}
 

@@ -19,6 +19,7 @@ import numpy as np
 
 from ..errors import QueryError
 from .executor import QueryExecutor, ResultSet, Session
+from ..utils import lockwatch
 
 
 @dataclass
@@ -85,7 +86,7 @@ class OffsetTracker:
     watermark skip past data that is still arriving in order."""
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = lockwatch.Lock("stream.offsets")
         self._processed: dict[str, int] = {}
         self._available: dict[str, int] = {}
 
@@ -124,7 +125,7 @@ class MemoryStateStore:
     removes matching rows from the committed state and returns them."""
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = lockwatch.Lock("stream.state_store")
         self._committed: list[ResultSet] = []
         self._uncommitted: list[ResultSet] = []
         self._version = 0
@@ -173,7 +174,7 @@ class StateStoreFactory:
     (reference MemoryStateStoreFactory)."""
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = lockwatch.Lock("stream.state_factory")
         self._stores: dict[tuple, MemoryStateStore] = {}
 
     def get_or_default(self, query_id: str, partition_id: int = 0,

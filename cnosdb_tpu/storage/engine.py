@@ -14,6 +14,7 @@ from ..models.points import WriteBatch
 from ..models.schema import TskvTableSchema
 from .compaction import Picker
 from .vnode import VnodeStorage
+from ..utils import lockwatch
 
 
 class TsKv:
@@ -28,7 +29,7 @@ class TsKv:
         self.wal_sync = wal_sync
         self.picker = picker
         self.background_compaction = background_compaction
-        self.lock = threading.RLock()
+        self.lock = lockwatch.RLock("engine.registry")
         self.vnodes: dict[tuple[str, int], VnodeStorage] = {}
         self.schemas: dict[str, dict[str, TskvTableSchema]] = {}  # owner → tables
         # background workers drive compactions (reference CompactJob pool,

@@ -14,10 +14,11 @@ import subprocess
 import numpy as np
 
 import threading
+from ..utils import lockwatch
 
 _LIB = None
 _TRIED = False
-_LOAD_LOCK = threading.Lock()
+_LOAD_LOCK = lockwatch.Lock("native.load")
 _tls = threading.local()
 
 

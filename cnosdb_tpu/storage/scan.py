@@ -26,6 +26,7 @@ from ..models.strcol import DictArray, as_dict_part as _as_dict_part, \
     unify_dictionaries
 from .memcache import MemCache, _group_starts
 from .vnode import VnodeStorage
+from ..utils import lockwatch
 
 
 @dataclass
@@ -555,7 +556,7 @@ _NATIVE_ENC = {1: {6}, 2: {2, 11}, 3: {10}}   # kind → decodable encodings
 #   native_reject native decoder refused the page at runtime
 import threading as _threading
 
-_FALLBACK_LOCK = _threading.Lock()
+_FALLBACK_LOCK = lockwatch.Lock("scan.fallback")
 _FALLBACK: dict[str, int] = {}
 
 

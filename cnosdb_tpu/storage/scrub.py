@@ -50,6 +50,7 @@ from .index import CKPT_NAME, _CKPT_MAGIC
 from .record_file import _valid_prefix_len
 from .tsm import TsmReader
 from .wal import SEGMENT_PATTERN
+from ..utils import lockwatch
 
 log = logging.getLogger(__name__)
 
@@ -60,7 +61,7 @@ log = logging.getLogger(__name__)
 _COUNTER_NAMES = ("scrub_bytes", "scrub_files", "corruptions_detected",
                   "files_quarantined", "repairs_ok", "repairs_failed")
 _counters = {k: 0 for k in _COUNTER_NAMES}
-_counters_lock = threading.Lock()
+_counters_lock = lockwatch.Lock("scrub.counters")
 
 
 def count(name: str, n: int = 1) -> None:
@@ -95,7 +96,7 @@ class RateLimiter:
         self.rate = max(1, int(bytes_per_sec))
         self._avail = float(self.rate)
         self._last = time.monotonic()
-        self._lock = threading.Lock()
+        self._lock = lockwatch.Lock("scrub.throttle")
 
     def take(self, nbytes: int, stop: threading.Event | None = None) -> None:
         while True:

@@ -30,6 +30,7 @@ from .memcache import MemCache
 from .summary import Summary, VersionEdit
 from .tombstone import TombstoneEntry, TsmTombstone
 from .wal import Wal, WalEntryType
+from ..utils import lockwatch
 
 
 @dataclass(frozen=True)
@@ -60,7 +61,7 @@ class VnodeStorage:
         os.makedirs(dir_path, exist_ok=True)
         self.schemas = schemas if schemas is not None else {}
         self.memcache_bytes = memcache_bytes
-        self.lock = threading.RLock()
+        self.lock = lockwatch.RLock(f"vnode.{vnode_id}")
         self.summary = Summary(dir_path)
         self.index = TSIndex(os.path.join(dir_path, "index"))
         self.wal = Wal(os.path.join(dir_path, "wal"), sync_on_append=wal_sync)
@@ -405,7 +406,7 @@ class VnodeStorage:
                         if name.endswith(".tsm"):
                             big.append(rel)   # immutable: read outside
                         else:
-                            with open(os.path.join(root, name), "rb") as f:
+                            with open(os.path.join(root, name), "rb") as f:  # lint: disable=lock-blocking (small mutable files read under lock so the snapshot is a consistent cut)
                                 files[rel] = f.read()
             try:
                 for rel in big:
@@ -428,7 +429,7 @@ class VnodeStorage:
                     if name.endswith(".quarantine"):
                         continue
                     rel = os.path.normpath(os.path.join(rel_root, name))
-                    with open(os.path.join(root, name), "rb") as f:
+                    with open(os.path.join(root, name), "rb") as f:  # lint: disable=lock-blocking (final capture attempt deliberately under lock: consistency over latency)
                         files[rel] = f.read()
             return {"files": files, "digests": _digests(files)}
 
@@ -468,7 +469,7 @@ class VnodeStorage:
             for rel, raw in snap["files"].items():
                 path = os.path.join(self.dir, rel)
                 os.makedirs(os.path.dirname(path), exist_ok=True)
-                with open(path, "wb") as f:
+                with open(path, "wb") as f:  # lint: disable=lock-blocking (snapshot install must be atomic vs readers; consistency over latency)
                     f.write(raw)
             self.summary = Summary(self.dir)
             self.index = TSIndex(os.path.join(self.dir, "index"))

@@ -21,6 +21,7 @@ import struct
 from dataclasses import dataclass
 
 from .. import faults
+from ..utils import stages
 from ..errors import WalError
 from .record_file import RecordReader, RecordWriter
 
@@ -221,7 +222,7 @@ class Wal:
             try:
                 cb(seq)
             except Exception:
-                pass
+                stages.count_error("swallow.wal.purge_listener")
 
     def total_size(self) -> int:
         return sum(os.path.getsize(self._seg_path(s)) for s in self._list_segments())

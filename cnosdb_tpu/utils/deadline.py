@@ -30,11 +30,12 @@ import threading
 import time
 
 from ..errors import DeadlineExceeded, QueryError
+from . import lockwatch
 
 _tls = threading.local()
 
 # observability counters folded into /metrics by server/http.handle_metrics
-_ctr_lock = threading.Lock()
+_ctr_lock = lockwatch.Lock("deadline.counters")
 _counters: dict[str, int] = {
     "cancel_scan_received": 0,   # cancel_scan RPCs handled on this node
     "tasks_shed": 0,             # pool tasks dropped before running
@@ -123,13 +124,13 @@ class Deadline:
         r = self.remaining()
         if r is None:
             return None
-        return int((time.time() + max(r, 0.0)) * 1000)
+        return int((time.time() + max(r, 0.0)) * 1000)  # lint: disable=wallclock-duration (wire form IS wall-clock epoch ms — see module docstring on clock discipline)
 
 
 def from_wire(deadline_at_ms: int | None, qid: str | None = None) -> Deadline:
     if deadline_at_ms is None:
         return Deadline(None, qid=qid)
-    return Deadline(deadline_at_ms / 1000.0 - time.time(), qid=qid)
+    return Deadline(deadline_at_ms / 1000.0 - time.time(), qid=qid)  # lint: disable=wallclock-duration (wire form IS wall-clock epoch ms — skew only shifts patience, socket timeout is the hard bound)
 
 
 def current() -> Deadline | None:
@@ -180,7 +181,7 @@ class CancelRegistry:
     TOMBSTONE_TTL = 60.0
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = lockwatch.Lock("deadline.cancels")
         self._working: dict[str, list[Deadline]] = {}
         self._tombstones: dict[str, float] = {}
 

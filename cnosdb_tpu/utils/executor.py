@@ -25,8 +25,9 @@ from concurrent.futures import ThreadPoolExecutor
 from concurrent.futures import TimeoutError as _FuturesTimeout
 
 from . import deadline as deadline_mod
+from . import lockwatch
 
-_lock = threading.Lock()
+_lock = lockwatch.Lock("executor.pools")
 _pools: dict[str, ThreadPoolExecutor] = {}
 _sizes: dict[str, int] = {}
 _active: dict[str, int] = {"scan": 0, "decode": 0}

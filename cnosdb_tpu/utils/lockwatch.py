@@ -1,0 +1,299 @@
+"""Runtime lock-order watchdog (CNOSDB_LOCKWATCH=1).
+
+The AST lint plane (cnosdb_tpu/analysis) catches blocking calls written
+*textually* inside a ``with lock:`` block, but it cannot see dynamic
+composition — coordinator code that takes engine.lock and then calls a
+helper that takes vnode.lock, or an RPC issued three frames below a held
+mutex. This module is the runtime complement: an instrumented Lock/RLock
+wrapper that records, per thread, the order in which locks nest, folds
+every observed (held → acquired) pair into a global lock-order graph, and
+reports
+
+  * cycles in that graph (two threads taking A→B and B→A — a potential
+    deadlock even if the interleaving never fired in this run),
+  * the longest-held locks (ms), and
+  * locks held across an RPC hop (``parallel/net.rpc_call`` notes itself
+    via :func:`note_blocking` — one slow peer then stalls every thread
+    queued on that lock).
+
+Zero-cost when off: the :func:`Lock`/:func:`RLock` factories return plain
+``threading`` primitives unless CNOSDB_LOCKWATCH was set at import (or
+:func:`enable` was called before the lock was created), so production
+paths pay nothing. The chaos and deadline cluster suites switch it on in
+every spawned node, making each soak run double as a race/deadlock
+detector; ``/debug/lockgraph`` serves :func:`report` and /metrics carries
+``cnosdb_lockwatch_*`` counters.
+"""
+from __future__ import annotations
+
+import os
+import threading
+import time
+
+_ENABLED = os.environ.get("CNOSDB_LOCKWATCH", "") not in ("", "0", "false")
+
+# Bookkeeping is guarded by one plain (never watched) leaf mutex: it is
+# only ever taken *after* a watched lock's inner acquire succeeds, and no
+# watched acquire happens under it, so it cannot extend the order graph.
+_state = threading.Lock()
+_tls = threading.local()
+
+_edges: dict[tuple[str, str], int] = {}     # (held, acquired) → count
+_held_max_ms: dict[str, float] = {}          # lock → longest single hold
+_across: dict[tuple[str, str], int] = {}     # (lock, blocking op) → count
+_counters: dict[str, int] = {
+    "watched_locks": 0,      # _Watched instances created
+    "acquires": 0,           # non-reentrant acquisitions recorded
+    "order_edges": 0,        # unique (held → acquired) pairs seen
+    "held_across_blocking": 0,   # note_blocking() hits with locks held
+}
+
+
+def enabled() -> bool:
+    return _ENABLED
+
+
+def enable(flag: bool = True) -> None:
+    """Flip instrumentation for locks created *after* this call (tests).
+    Locks already handed out keep their nature."""
+    global _ENABLED
+    _ENABLED = flag
+
+
+def reset() -> None:
+    with _state:
+        _edges.clear()
+        _held_max_ms.clear()
+        _across.clear()
+        for k in _counters:
+            _counters[k] = 0
+
+
+def Lock(name: str | None = None):
+    """A ``threading.Lock`` — instrumented iff the watchdog is enabled."""
+    if not _ENABLED:
+        return threading.Lock()
+    return _Watched(threading.Lock(), name or _callsite(), reentrant=False)
+
+
+def RLock(name: str | None = None):
+    """A ``threading.RLock`` — instrumented iff the watchdog is enabled."""
+    if not _ENABLED:
+        return threading.RLock()
+    return _Watched(threading.RLock(), name or _callsite(), reentrant=True)
+
+
+def _callsite() -> str:
+    import sys
+
+    f = sys._getframe(2)
+    return f"{os.path.basename(f.f_code.co_filename)}:{f.f_lineno}"
+
+
+def _held_stack() -> list:
+    st = getattr(_tls, "held", None)
+    if st is None:
+        st = _tls.held = []
+    return st
+
+
+class _Watched:
+    """Instrumented lock: context-manager + acquire/release/locked, plus
+    the ``_is_owned``/``_release_save``/``_acquire_restore`` trio so
+    ``threading.Condition(watched_lock)`` keeps working (wait() must run
+    the same bookkeeping as a plain release/acquire pair)."""
+
+    __slots__ = ("_inner", "name", "_reentrant")
+
+    def __init__(self, inner, name: str, reentrant: bool):
+        self._inner = inner
+        self.name = name
+        self._reentrant = reentrant
+        with _state:
+            _counters["watched_locks"] += 1
+
+    # ------------------------------------------------------- bookkeeping
+    def _note_acquire(self) -> None:
+        held = _held_stack()
+        reentrant = any(e[0] is self for e in held)
+        held.append((self, time.monotonic(), reentrant))
+        if reentrant:
+            return   # nesting on ourselves adds no ordering information
+        with _state:
+            _counters["acquires"] += 1
+            seen = set()
+            for other, _t0, _re in held[:-1]:
+                if other is self or other.name in seen:
+                    continue
+                seen.add(other.name)
+                key = (other.name, self.name)
+                if key not in _edges:
+                    _counters["order_edges"] += 1
+                    _edges[key] = 0
+                _edges[key] += 1
+
+    def _note_release(self) -> None:
+        held = getattr(_tls, "held", None)
+        if not held:
+            return
+        for i in range(len(held) - 1, -1, -1):
+            if held[i][0] is self:
+                _me, t0, reentrant = held.pop(i)
+                if not reentrant:
+                    ms = (time.monotonic() - t0) * 1e3
+                    with _state:
+                        if ms > _held_max_ms.get(self.name, 0.0):
+                            _held_max_ms[self.name] = ms
+                return
+
+    # ---------------------------------------------------------- lock API
+    def acquire(self, blocking: bool = True, timeout: float = -1):
+        ok = self._inner.acquire(blocking, timeout)
+        if ok:
+            self._note_acquire()
+        return ok
+
+    def release(self) -> None:
+        self._note_release()
+        self._inner.release()
+
+    def locked(self) -> bool:
+        return self._inner.locked()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+    def __repr__(self):
+        return f"<lockwatch {self.name} {self._inner!r}>"
+
+    # ------------------------------------- threading.Condition protocol
+    def _is_owned(self) -> bool:
+        if hasattr(self._inner, "_is_owned"):
+            return self._inner._is_owned()
+        if self._inner.acquire(False):
+            self._inner.release()
+            return False
+        return True
+
+    def _release_save(self):
+        self._note_release()
+        if hasattr(self._inner, "_release_save"):
+            return self._inner._release_save()
+        self._inner.release()
+        return None
+
+    def _acquire_restore(self, state) -> None:
+        if hasattr(self._inner, "_acquire_restore"):
+            self._inner._acquire_restore(state)
+        else:
+            self._inner.acquire()
+        self._note_acquire()
+
+
+def note_blocking(what: str) -> None:
+    """Called by known-blocking plumbing (the RPC client) so holds that
+    span a network hop show up even though the AST never sees them."""
+    if not _ENABLED:
+        return
+    held = getattr(_tls, "held", None)
+    if not held:
+        return
+    names = {e[0].name for e in held if not e[2]}
+    if not names:
+        return
+    with _state:
+        _counters["held_across_blocking"] += 1
+        for n in names:
+            key = (n, what)
+            _across[key] = _across.get(key, 0) + 1
+
+
+# ------------------------------------------------------------- reporting
+def cycles() -> list[list[str]]:
+    """Strongly-connected components of the order graph with ≥2 locks
+    (or a self-edge): each is a set of locks that some pair of code paths
+    acquires in conflicting order — a potential deadlock."""
+    with _state:
+        adj: dict[str, set] = {}
+        for (a, b) in _edges:
+            adj.setdefault(a, set()).add(b)
+            adj.setdefault(b, set())
+    index: dict[str, int] = {}
+    low: dict[str, int] = {}
+    on_stack: set = set()
+    stack: list[str] = []
+    out: list[list[str]] = []
+    counter = [0]
+
+    def strongconnect(root: str):
+        work = [(root, iter(sorted(adj[root])))]
+        index[root] = low[root] = counter[0]
+        counter[0] += 1
+        stack.append(root)
+        on_stack.add(root)
+        while work:
+            v, it = work[-1]
+            advanced = False
+            for w in it:
+                if w not in index:
+                    index[w] = low[w] = counter[0]
+                    counter[0] += 1
+                    stack.append(w)
+                    on_stack.add(w)
+                    work.append((w, iter(sorted(adj[w]))))
+                    advanced = True
+                    break
+                elif w in on_stack:
+                    low[v] = min(low[v], index[w])
+            if advanced:
+                continue
+            work.pop()
+            if work:
+                pv = work[-1][0]
+                low[pv] = min(low[pv], low[v])
+            if low[v] == index[v]:
+                comp = []
+                while True:
+                    w = stack.pop()
+                    on_stack.discard(w)
+                    comp.append(w)
+                    if w == v:
+                        break
+                if len(comp) > 1 or (v, v) in _edges:
+                    out.append(sorted(comp))
+
+    for node in sorted(adj):
+        if node not in index:
+            strongconnect(node)
+    return sorted(out)
+
+
+def report() -> dict:
+    """The /debug/lockgraph payload."""
+    cyc = cycles()
+    with _state:
+        edges = [{"from": a, "to": b, "count": n}
+                 for (a, b), n in sorted(_edges.items())]
+        longest = [{"lock": k, "max_held_ms": round(v, 3)}
+                   for k, v in sorted(_held_max_ms.items(),
+                                      key=lambda kv: -kv[1])[:20]]
+        across = [{"lock": a, "op": op, "count": n}
+                  for (a, op), n in sorted(_across.items())]
+        ctrs = dict(_counters)
+    ctrs["order_cycles"] = len(cyc)
+    return {"enabled": _ENABLED, "counters": ctrs, "edges": edges,
+            "cycles": cyc, "longest_held": longest,
+            "held_across_blocking": across}
+
+
+def counters_snapshot() -> dict[str, int]:
+    """Flat ints for the /metrics fold (cnosdb_lockwatch_total{kind=…})."""
+    with _state:
+        out = dict(_counters)
+    out["order_cycles"] = len(cycles())
+    return out

@@ -11,6 +11,7 @@ from __future__ import annotations
 import threading
 
 from ..errors import CnosError
+from . import lockwatch
 
 
 class MemoryExhausted(CnosError):
@@ -21,7 +22,7 @@ class MemoryPool:
     def __init__(self, capacity_bytes: int):
         self.capacity = int(capacity_bytes)
         self.used = 0
-        self._lock = threading.Lock()
+        self._lock = lockwatch.Lock("memory_pool")
 
     def acquire(self, n: int, what: str = "buffer"):
         with self._lock:

@@ -244,7 +244,7 @@ class GcsStore:
             raise ObjectStoreError(
                 "gcs needs client_email+private_key (or disable_oauth "
                 "against an emulator)")
-        if self._tok and self._tok[1] > time.time() + 60:
+        if self._tok and self._tok[1] > time.monotonic() + 60:
             return {"Authorization": f"Bearer {self._tok[0]}"}
         token = self._fetch_token()
         return {"Authorization": f"Bearer {token}"}
@@ -281,7 +281,9 @@ class GcsStore:
                     {"Content-Type": "application/x-www-form-urlencoded"},
                     body)
         tok = json.loads(raw)["access_token"]
-        self._tok = (tok, time.time() + 3300)
+        # expiry on the monotonic clock: the token lives `expires_in`
+        # seconds from NOW — an NTP step must not stretch or clip it
+        self._tok = (tok, time.monotonic() + 3300)
         return tok
 
     def get(self, key: str) -> bytes:

@@ -33,8 +33,9 @@ from __future__ import annotations
 import threading
 import time
 from contextlib import contextmanager
+from . import lockwatch
 
-_lock = threading.Lock()
+_lock = lockwatch.Lock("stages.counters")
 _enabled = False
 _ms: dict[str, float] = {}
 _counts: dict[str, int] = {}

@@ -1,0 +1,8 @@
+"""Fixture: one bare except (line 7)."""
+
+
+def f():
+    try:
+        return 1
+    except:
+        return 0

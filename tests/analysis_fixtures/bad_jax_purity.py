@@ -1,0 +1,30 @@
+"""Fixture: tracer leaks in jitted code — Python branch on a traced arg
+(line 9), bool() on a traced value (line 16), .item() host sync
+(line 20). Static args may branch (line 27)."""
+import jax
+
+
+@jax.jit
+def f(x):
+    if x > 0:
+        return x
+    return -x
+
+
+def g_kernel(y):
+    flag = y + 1
+    return bool(flag)
+
+
+def host_pull(arr):
+    return arr.item()
+
+
+from functools import partial                             # noqa: E402
+
+
+@partial(jax.jit, static_argnames=("mode",))
+def h(x, mode):
+    if mode == "fast":
+        return x
+    return x * 2

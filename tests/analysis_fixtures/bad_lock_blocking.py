@@ -1,0 +1,27 @@
+"""Fixture: blocking calls under `with <lock>:` (lines 8, 9, 15);
+cv.wait() on the with-target itself releases the lock and must pass."""
+import subprocess
+
+
+def f(self, rpc_call):
+    with self.lock:
+        rpc_call("127.0.0.1:1", "scan", {}, timeout=1.0)
+        data = open("/tmp/x").read()
+    return data
+
+
+def g(self):
+    with self._write_mutex:
+        subprocess.run(["sync"])
+
+
+def ok_condition_wait(self):
+    with self._cv:
+        self._cv.wait(1.0)
+
+
+def ok_nested_def(self):
+    with self.lock:
+        def later():
+            return open("/tmp/x").read()
+        return later

@@ -1,0 +1,11 @@
+"""Fixture: metric naming violations — unprefixed (line 6), counter
+without _total (line 7), histogram without unit suffix (line 8)."""
+
+
+def f(m):
+    m.incr("http_writes_total")
+    m.incr("cnosdb_http_writes")
+    m.observe("cnosdb_query_latency", 1.0)
+    m.incr("cnosdb_http_writes_total")          # ok
+    m.observe("cnosdb_query_latency_ms", 1.0)   # ok
+    m.set_gauge("cnosdb_queue_depth", 3)        # ok

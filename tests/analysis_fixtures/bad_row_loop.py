@@ -1,0 +1,23 @@
+"""Fixture: per-row Python loops in the vectorized sections (line 7) and
+the pinned fallback (line 21). Mirrors sql/executor.py's function names
+so the row-loop rules find their targets when scope is ignored."""
+
+
+def _merge_distinct_vec(idxs, out):
+    for i in idxs:
+        out.append(i)
+    return out
+
+
+def _apply_gapfill(cols):
+    return cols
+
+
+def _merge_results_vec(parts):
+    return parts
+
+
+def _merge_distinct(rows, acc):
+    for row in rows:
+        acc.add(row)
+    return acc

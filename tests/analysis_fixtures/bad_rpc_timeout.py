@@ -1,0 +1,10 @@
+"""Fixture: rpc_call without a timeout (lines 6, 7); explicit/positional
+timeouts and **kwargs pass-through are fine."""
+
+
+def f(rpc_call, addr, extra):
+    rpc_call(addr, "scan", {})
+    rpc_call(addr, "scan")
+    rpc_call(addr, "scan", {}, timeout=2.0)     # explicit keyword: ok
+    rpc_call(addr, "scan", {}, 2.0)             # positional 4th: ok
+    rpc_call(addr, "scan", {}, **extra)         # **kwargs may carry it: ok

@@ -1,0 +1,12 @@
+"""Fixture: silent except-pass (line 7); a counted swallow passes."""
+
+
+def f(risky, count_error):
+    try:
+        risky()
+    except Exception:
+        pass
+    try:
+        risky()
+    except Exception:
+        count_error("swallow.fixture")

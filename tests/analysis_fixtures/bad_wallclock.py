@@ -1,0 +1,25 @@
+"""Fixture: duration arithmetic on time.time() (lines 8, 14); monotonic
+arithmetic and stored timestamps pass."""
+import time
+
+
+def f():
+    t0 = time.time()
+    return time.time() - t0
+
+
+def g(deadline_s):
+    start = time.time()
+    while True:
+        if start + deadline_s < 5:
+            break
+
+
+def ok_monotonic():
+    t0 = time.monotonic()
+    return time.monotonic() - t0
+
+
+def ok_timestamp_store(kwargs):
+    kwargs["at"] = time.time()
+    return kwargs

@@ -1,0 +1,20 @@
+"""Fixture: every violation here carries a `# lint: disable=` and must
+produce zero findings. The last one uses the `all` token."""
+import time
+
+
+def f(rpc_call, addr):
+    rpc_call(addr, "scan", {})  # lint: disable=rpc-call-timeout (fixture: suppression must silence the rule)
+    t0 = time.time()
+    return time.time() - t0  # lint: disable=wallclock-duration (fixture: cross-process timestamp)
+
+
+def g(risky):
+    try:
+        risky()
+    except Exception:  # lint: disable=swallowed-exception (fixture: reason goes here)
+        pass
+    try:
+        risky()
+    except:  # lint: disable=all (fixture: the all token silences every rule)
+        pass

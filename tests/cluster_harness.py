@@ -55,7 +55,8 @@ class Node:
              "--data-dir", self.data_dir,
              "--http-port", str(self.http_port),
              "--rpc-port", str(self.rpc_port)],
-            env=_env(), stdout=self.cluster.log, stderr=self.cluster.log)
+            env=self.cluster.env, stdout=self.cluster.log,
+            stderr=self.cluster.log)
         return self
 
     def kill(self):
@@ -97,6 +98,11 @@ class Node:
 class Cluster:
     def __init__(self, root: str, n_nodes: int = 3):
         self.root = root
+        # snapshot the spawn env ONCE: fixtures set knobs (CNOSDB_FAULTS,
+        # CNOSDB_LOCKWATCH, ...) around construction and drop them right
+        # after, and a node RESTARTED mid-test (crash injection) must come
+        # back with the same knobs as its first boot
+        self.env = _env()
         self.meta_port = free_port()
         os.makedirs(root, exist_ok=True)
         self.log = open(os.path.join(root, "cluster.log"), "ab")
@@ -109,7 +115,7 @@ class Cluster:
              "--mode", "meta",
              "--data-dir", os.path.join(self.root, "meta"),
              "--meta-port", str(self.meta_port)],
-            env=_env(), stdout=self.log, stderr=self.log)
+            env=self.env, stdout=self.log, stderr=self.log)
         for n in self.nodes:
             n.start()
         for n in self.nodes:
@@ -133,3 +139,24 @@ class Cluster:
             if n.proc is not None:
                 return n
         raise RuntimeError("no node alive")
+
+
+def assert_lock_graph_acyclic(cluster: Cluster) -> int:
+    """Teardown invariant for suites run with CNOSDB_LOCKWATCH=1: pull
+    /debug/lockgraph from every node still alive and fail on any observed
+    lock-order cycle (two code paths nesting the same locks in opposite
+    order — a deadlock waiting for the right interleaving). Returns the
+    number of nodes checked so callers can assert coverage."""
+    import json as _json
+
+    checked = 0
+    for n in cluster.nodes:
+        if n.proc is None or n.proc.poll() is not None:
+            continue
+        rep = _json.loads(n.http("GET", "/debug/lockgraph"))
+        assert rep["enabled"], f"node {n.node_id}: lockwatch not enabled"
+        assert rep["cycles"] == [], (
+            f"node {n.node_id}: lock-order cycles {rep['cycles']} "
+            f"(edges: {rep['edges']})")
+        checked += 1
+    return checked

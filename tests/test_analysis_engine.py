@@ -1,0 +1,145 @@
+"""Self-tests for the invariant lint engine (cnosdb_tpu/analysis).
+
+Each rule is exercised against a known-bad fixture in
+tests/analysis_fixtures/ (linted as data, never imported), then the
+engine mechanics themselves: inline suppressions, the baseline ratchet
+in both directions, and the CLI's exit codes.
+"""
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from cnosdb_tpu import analysis
+from cnosdb_tpu.analysis import rules as rules_mod
+
+FIXTURES = os.path.join(os.path.dirname(__file__), "analysis_fixtures")
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(analysis.__file__)))
+
+
+def _fx(name: str) -> str:
+    return os.path.join(FIXTURES, name)
+
+
+def _lint(filename, rule):
+    return analysis.lint_files([_fx(filename)], rules=[rule],
+                               ignore_scope=True)
+
+
+# ------------------------------------------------------------- per-rule
+# (fixture file, rule, expected finding lines)
+_CASES = [
+    ("bad_bare_except.py", rules_mod.NoBareExcept(), [7]),
+    ("bad_rpc_timeout.py", rules_mod.RpcCallTimeout(), [6, 7]),
+    ("bad_lock_blocking.py", rules_mod.LockBlocking(), [8, 9, 15]),
+    ("bad_swallow.py", rules_mod.SwallowedException(), [7]),
+    ("bad_jax_purity.py", rules_mod.JaxPurity(), [9, 16, 20]),
+    ("bad_wallclock.py", rules_mod.WallclockDuration(), [8, 14]),
+    ("bad_metrics.py", rules_mod.MetricsNaming(), [6, 7, 8]),
+    ("bad_row_loop.py", rules_mod.RowLoop(), [7]),
+    ("bad_row_loop.py", rules_mod.RowLoopFallback(), [21]),
+]
+
+
+@pytest.mark.parametrize(
+    "filename,rule,lines", _CASES,
+    ids=[f"{rule.name}:{filename}" for filename, rule, lines in _CASES])
+def test_rule_catches_fixture(filename, rule, lines):
+    findings = _lint(filename, rule)
+    assert [f.line for f in sorted(findings, key=lambda f: f.line)] == lines, \
+        [f.render() for f in findings]
+    assert all(f.rule == rule.name for f in findings)
+
+
+def test_every_rule_has_a_fixture_and_motivation():
+    covered = {rule.name for _fn, rule, _l in _CASES}
+    for rule in rules_mod.all_rules():
+        assert rule.name in covered, f"rule {rule.name} has no fixture case"
+        assert rule.motivation, f"rule {rule.name} must name its incident"
+
+
+# --------------------------------------------------------- suppressions
+def test_inline_disable_silences_only_that_rule():
+    # the two row-loop rules are structural (they report when their target
+    # functions are absent), so scope-ignoring them over an unrelated
+    # fixture is meaningless — every other rule runs
+    rules = [r for r in rules_mod.all_rules()
+             if not r.name.startswith("row-loop")]
+    findings = analysis.lint_files([_fx("suppressed.py")], rules=rules,
+                                   ignore_scope=True)
+    assert findings == [], [f.render() for f in findings]
+
+
+def test_disable_on_other_line_does_not_leak():
+    # the suppression must sit on the finding's own line
+    findings = _lint("bad_swallow.py", rules_mod.SwallowedException())
+    assert len(findings) == 1
+
+
+# ------------------------------------------------------ baseline ratchet
+def _run_fixture(rule, baseline_path):
+    return analysis.run([_fx("bad_swallow.py")], rules=[rule],
+                        baseline_path=baseline_path, ignore_scope=True)
+
+
+def test_baseline_ratchet_both_directions(tmp_path):
+    rule = rules_mod.SwallowedException()
+    bl = str(tmp_path / "baseline.json")
+    relpath = analysis.norm_relpath(_fx("bad_swallow.py"))
+
+    # no baseline: the finding is a hard violation
+    rep = _run_fixture(rule, bl)
+    assert not rep.ok and len(rep.violations) == 1
+
+    # frozen at the current count: ok, finding rides the baseline
+    analysis.write_baseline(rep.counts, bl)
+    rep = _run_fixture(rule, bl)
+    assert rep.ok and rep.findings and not rep.violations
+
+    # over-generous baseline: stale — the ratchet only turns one way
+    analysis.write_baseline({(rule.name, relpath): 5}, bl)
+    rep = _run_fixture(rule, bl)
+    assert not rep.ok
+    assert rep.stale == [(rule.name, relpath, 5, 1)]
+
+
+def test_baseline_roundtrip_drops_zero_cells(tmp_path):
+    bl = str(tmp_path / "b.json")
+    analysis.write_baseline({("r1", "a.py"): 2, ("r2", "b.py"): 0}, bl)
+    assert analysis.load_baseline(bl) == {("r1", "a.py"): 2}
+
+
+def test_stale_check_ignores_files_outside_the_run(tmp_path):
+    # a subset run must not flag baseline cells for files it never read
+    rule = rules_mod.SwallowedException()
+    bl = str(tmp_path / "baseline.json")
+    analysis.write_baseline({(rule.name, "cnosdb_tpu/other.py"): 3}, bl)
+    rep = _run_fixture(rule, bl)
+    assert rep.stale == []
+
+
+# ----------------------------------------------------------------- CLI
+def _cli(*args):
+    return subprocess.run(
+        [sys.executable, "-m", "cnosdb_tpu.analysis", *args],
+        capture_output=True, text=True, cwd=REPO, timeout=120)
+
+
+def test_cli_nonzero_on_fixtures(tmp_path):
+    empty = str(tmp_path / "empty_baseline.json")
+    p = _cli(FIXTURES, "--all-rules", "--baseline", empty, "--json")
+    assert p.returncode == 1, p.stdout + p.stderr
+    rep = json.loads(p.stdout)
+    assert not rep["ok"] and rep["violations"]
+    rules_hit = {f["rule"] for f in rep["violations"]}
+    assert {"no-bare-except", "swallowed-exception", "lock-blocking",
+            "wallclock-duration", "metrics-naming",
+            "jax-purity"} <= rules_hit
+
+
+def test_cli_fix_baseline_requires_whole_tree(tmp_path):
+    p = _cli(FIXTURES, "--fix-baseline",
+             "--baseline", str(tmp_path / "b.json"))
+    assert p.returncode == 2

@@ -19,7 +19,7 @@ import time
 
 import pytest
 
-from cluster_harness import Cluster
+from cluster_harness import Cluster, assert_lock_graph_acyclic
 from cnosdb_tpu.parallel.net import RpcError, rpc_call
 
 pytestmark = [pytest.mark.slow, pytest.mark.cluster]
@@ -32,12 +32,18 @@ def cluster(tmp_path_factory):
     # each subprocess, exposing the `_faults` RPC. The test process itself
     # imported cnosdb_tpu.faults long ago with the var unset, so its own
     # RPC client stays injection-free.
-    os.environ["CNOSDB_FAULTS"] = "seed=1"
+    # CNOSDB_LOCKWATCH arms the lock-order watchdog in every node, so the
+    # whole soak doubles as a deadlock detector: teardown asserts the
+    # observed lock-order graph stayed acyclic on every surviving node.
+    knobs = {"CNOSDB_FAULTS": "seed=1", "CNOSDB_LOCKWATCH": "1"}
+    os.environ.update(knobs)
     try:
         c = Cluster(str(tmp_path_factory.mktemp("chaos")), n_nodes=3).start()
     finally:
-        del os.environ["CNOSDB_FAULTS"]
+        for k in knobs:
+            del os.environ[k]
     yield c
+    assert assert_lock_graph_acyclic(c) > 0
     c.stop()
 
 

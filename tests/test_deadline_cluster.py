@@ -26,7 +26,7 @@ import urllib.request
 
 import pytest
 
-from cluster_harness import Cluster
+from cluster_harness import Cluster, assert_lock_graph_acyclic
 from cnosdb_tpu.parallel.net import rpc_call
 
 pytestmark = [pytest.mark.cluster]
@@ -34,12 +34,19 @@ pytestmark = [pytest.mark.cluster]
 
 @pytest.fixture(scope="module")
 def cluster(tmp_path_factory):
-    os.environ["CNOSDB_FAULTS"] = "seed=1"
+    # lock-order watchdog on in every node: the deadline/cancel fan-out
+    # paths exercise most cross-lock nesting, so teardown checks the
+    # observed order graph stayed acyclic (and /metrics carries counters)
+    knobs = {"CNOSDB_FAULTS": "seed=1", "CNOSDB_LOCKWATCH": "1"}
+    os.environ.update(knobs)
     try:
         c = Cluster(str(tmp_path_factory.mktemp("ddl")), n_nodes=2).start()
     finally:
-        del os.environ["CNOSDB_FAULTS"]
+        for k in knobs:
+            del os.environ[k]
     yield c
+    assert assert_lock_graph_acyclic(c) > 0
+    assert "cnosdb_lockwatch_total" in c.alive_node().http("GET", "/metrics")
     c.stop()
 
 
@@ -192,6 +199,7 @@ def storm_cluster(tmp_path_factory):
     """Own cluster with a deliberately tiny admission gate (2 running +
     2 queued per node), configured through the documented env overrides."""
     knobs = {"CNOSDB_FAULTS": "seed=1",
+             "CNOSDB_LOCKWATCH": "1",
              "CNOSDB_QUERY_MAX_CONCURRENT_QUERIES": "2",
              "CNOSDB_QUERY_MAX_QUEUED_QUERIES": "2"}
     os.environ.update(knobs)
@@ -201,6 +209,7 @@ def storm_cluster(tmp_path_factory):
         for k in knobs:
             del os.environ[k]
     yield c
+    assert assert_lock_graph_acyclic(c) > 0
     c.stop()
 
 

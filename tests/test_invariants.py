@@ -1,0 +1,76 @@
+"""Tier-1 invariant gate: the unified lint engine over the whole package.
+
+Replaces the old ad-hoc AST tests (test_no_bare_except.py,
+test_no_row_loops.py) with one entry point. The engine runs ONCE at
+collection; each (rule, file) cell that carries findings or baseline
+budget gets its own test id, so a regression reads as e.g.
+
+    test_invariants.py::test_cell[swallowed-exception:cnosdb_tpu/parallel/raft.py]
+
+Fixing baselined debt also fails (stale baseline) until the fix is
+locked in with `python -m cnosdb_tpu.analysis --fix-baseline`.
+"""
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from cnosdb_tpu import analysis
+from cnosdb_tpu.analysis import rules as rules_mod
+
+_REPORT = analysis.run()
+_RULES = sorted(r.name for r in rules_mod.all_rules())
+
+# every (rule, file) cell with current findings or baseline budget gets
+# a stable test id; rules with neither get one "(clean)" id
+_CELLS = sorted(set(_REPORT.counts) | set(_REPORT.baseline))
+_PARAMS = []
+for rule in _RULES:
+    files = [p for (r, p) in _CELLS if r == rule]
+    for p in files or ["(clean)"]:
+        _PARAMS.append((rule, p))
+
+
+@pytest.mark.parametrize("rule,path", _PARAMS,
+                         ids=[f"{r}:{p}" for r, p in _PARAMS])
+def test_cell(rule, path):
+    if path == "(clean)":
+        hits = [f for f in _REPORT.findings if f.rule == rule]
+        assert hits == [], [f.render() for f in hits]
+        return
+    found = _REPORT.counts.get((rule, path), 0)
+    allowed = _REPORT.baseline.get((rule, path), 0)
+    cell = [f.render() for f in _REPORT.findings
+            if f.rule == rule and f.path == path]
+    assert found <= allowed, (
+        f"{found} finding(s), baseline allows {allowed}:\n" + "\n".join(cell))
+    assert found >= allowed, (
+        f"baseline stale: {allowed} allowed but {found} found — lock the "
+        f"fix in with `python -m cnosdb_tpu.analysis --fix-baseline`")
+
+
+def test_whole_tree_ok():
+    assert _REPORT.ok, (
+        [f.render() for f in _REPORT.violations],
+        _REPORT.stale)
+
+
+def test_no_unknown_rules_in_baseline():
+    known = set(_RULES)
+    assert {r for (r, _p) in _REPORT.baseline} <= known
+
+
+def test_cli_json_gate():
+    """The CI entry point: `python -m cnosdb_tpu.analysis --json` must
+    exit 0 on the tree and report machine-readable state."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(
+        analysis.__file__)))
+    p = subprocess.run([sys.executable, "-m", "cnosdb_tpu.analysis",
+                        "--json"],
+                       capture_output=True, text=True, cwd=repo, timeout=300)
+    assert p.returncode == 0, p.stdout + p.stderr
+    rep = json.loads(p.stdout)
+    assert rep["ok"] is True
+    assert rep["violations"] == []

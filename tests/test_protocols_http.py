@@ -191,4 +191,4 @@ def test_http_opentsdb_write(http):
 def test_http_metrics(http):
     http.request("POST", "/api/v1/write?db=public", "m v=1 1")
     status, text = http.request("GET", "/metrics")
-    assert "http_points_written" in text
+    assert "cnosdb_http_points_written_total" in text
