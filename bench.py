@@ -554,6 +554,33 @@ def main():
 
         from cnosdb_tpu.utils import stages
 
+        def profiled(sql, iters=1):
+            """Run `sql` iters times under one scoped QueryProfile →
+            (per-iteration seconds, last ResultSet, per-iteration stage
+            snapshot). Replaces the old process-global enable/reset
+            dance: concurrent queries no longer bleed into each other's
+            stage numbers."""
+            prof = stages.QueryProfile()
+            t0 = time.perf_counter()
+            with stages.profile_scope(prof):
+                for _ in range(iters):
+                    rs = executor.execute_one(sql, session)
+            dt = (time.perf_counter() - t0) / iters
+            snap = {k: (round(v / iters, 2) if k.endswith("_ms") else v)
+                    for k, v in prof.snapshot().items()}
+            reconcile_stages(snap, dt * 1e3, sql)
+            return dt, rs, snap
+
+        def reconcile_stages(snap, wall_ms, what):
+            """Profile sanity: the executor-thread stages are disjoint
+            sections of one query, so their sum can never meaningfully
+            exceed wall clock (pool-side stages like decode_ms
+            legitimately can — width-fold)."""
+            serial = sum(snap.get(k, 0)
+                         for k in ("kernel_ms", "merge_ms", "finalize_ms"))
+            assert serial <= wall_ms * 1.25 + 50, \
+                f"stage sum {serial:.1f}ms > wall {wall_ms:.1f}ms: {what}"
+
         arrays = Arrays(coord, DEFAULT_TENANT, "public")
         results = {}
         headline = None
@@ -563,28 +590,13 @@ def main():
             # lives or dies on)
             with coord._scan_cache_lock:
                 coord._scan_cache.clear()
-            stages.reset()
-            stages.enable(True)
-            t0 = time.perf_counter()
-            rs = executor.execute_one(sql, session)
-            cold_dt = time.perf_counter() - t0
-            cold_stages = stages.snapshot()
-            stages.enable(False)
+            cold_dt, rs, cold_stages = profiled(sql)
             spot_check(name, rs, arrays)
             executor.execute_one(sql, session)   # warm-up: builds the
             # per-snapshot derived caches (run layout etc.) once
             # WARM: scan snapshots hot, stage-instrumented
-            stages.reset()
-            stages.enable(True)
             iters = 2
-            t0 = time.perf_counter()
-            for _ in range(iters):
-                rs = executor.execute_one(sql, session)
-            engine_dt = (time.perf_counter() - t0) / iters
-            warm_stages = {k: (round(v / iters, 2)
-                               if k.endswith("_ms") else v)
-                           for k, v in stages.snapshot().items()}
-            stages.enable(False)
+            engine_dt, rs, warm_stages = profiled(sql, iters=iters)
             np_fn()   # warm
             # MEDIAN-of-3 oracle timing: a single numpy run fluctuates
             # ±2× (round-4 verdict: the denominator must be stable);

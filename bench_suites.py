@@ -166,18 +166,15 @@ def _run(executor, session, name, sql, check, results, errors,
 
     try:
         executor.execute_one(sql, session)      # warm-up
-        if stage_out is not None:
-            _stages.reset()
-            _stages.enable(True)
+        prof = _stages.QueryProfile() if stage_out is not None else None
         t0 = time.perf_counter()
-        rs = executor.execute_one(sql, session)
+        with _stages.profile_scope(prof):
+            rs = executor.execute_one(sql, session)
         dt = time.perf_counter() - t0
-        if stage_out is not None:
+        if prof is not None:
             # aggregation-plane stages per query: group cardinality,
             # factorize cost, which DISTINCT path engaged
-            snap = _stages.snapshot()
-            _stages.enable(False)
-            keep = {k: v for k, v in snap.items()
+            keep = {k: v for k, v in prof.snapshot().items()
                     if k in ("factorize_ms", "group_count")
                     or k.startswith("distinct_path")}
             if keep:
@@ -187,9 +184,6 @@ def _run(executor, session, name, sql, check, results, errors,
         results[name] = round(dt * 1e3, 2)
     except Exception as e:
         errors[name] = f"{type(e).__name__}: {e}"[:160]
-    finally:
-        if stage_out is not None:
-            _stages.enable(False)
 
 
 def _col(rs, name):

@@ -508,7 +508,51 @@ class MetricsNaming(Rule):
                        f"(_ms, _seconds, _bytes)")
 
 
+# --------------------------------------------------------------------------
+# 10. stage-catalog — new: profiling stage names must come from the
+#     documented catalog
+# --------------------------------------------------------------------------
+_STAGE_METHODS = {"stage", "count"}
+_STAGE_RECEIVERS = {"stages", "_stages"}
+
+
+class StageCatalog(Rule):
+    name = "stage-catalog"
+    motivation = ("PR 7 profiling plane: EXPLAIN ANALYZE, the slow-query "
+                  "log and bench trend tooling all key on stage names; a "
+                  "typo'd or undocumented name silently drifts out of "
+                  "every report instead of failing")
+    node_types = (ast.Call,)
+
+    def visit(self, node, ctx):
+        if _call_name(node) not in _STAGE_METHODS \
+                or _recv_text(node) not in _STAGE_RECEIVERS \
+                or not node.args:
+            return
+        from ..utils.stages import DYNAMIC_STAGE_PREFIXES, STAGE_CATALOG
+
+        arg = node.args[0]
+        if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+            name = arg.value
+            if name in STAGE_CATALOG \
+                    or name.startswith(DYNAMIC_STAGE_PREFIXES):
+                return
+            ctx.report(self, node,
+                       f"stage name {name!r} is not in the documented "
+                       f"catalog (utils/stages.STAGE_CATALOG) — add it "
+                       f"there with a description, or fix the typo")
+        elif isinstance(arg, ast.JoinedStr):
+            head = arg.values[0].value \
+                if (arg.values and isinstance(arg.values[0], ast.Constant)
+                    and isinstance(arg.values[0].value, str)) else ""
+            if not head.startswith(DYNAMIC_STAGE_PREFIXES):
+                ctx.report(self, node,
+                           f"dynamic stage name (f-string head {head!r}) "
+                           f"does not start with a registered prefix "
+                           f"(utils/stages.DYNAMIC_STAGE_PREFIXES)")
+
+
 def all_rules() -> list:
     return [NoBareExcept(), RpcCallTimeout(), RowLoop(), RowLoopFallback(),
             LockBlocking(), SwallowedException(), JaxPurity(),
-            WallclockDuration(), MetricsNaming()]
+            WallclockDuration(), MetricsNaming(), StageCatalog()]

@@ -90,6 +90,11 @@ class QueryConfig:
     # shared scan/decode pool widths (utils/executor.py); 0 = auto
     scan_executor_threads: int = 0
     decode_executor_threads: int = 0
+    # slow-query log: queries whose wall time meets/exceeds this
+    # threshold are recorded (trace id + stage profile) into
+    # usage_schema.slow_queries. 0 (the default) disables the log.
+    # Env override: CNOSDB_QUERY_SLOW_QUERY_THRESHOLD_MS.
+    slow_query_threshold_ms: int = 0
 
 
 @dataclass

@@ -301,8 +301,10 @@ def _pad_to(a: np.ndarray, n: int, fill) -> np.ndarray:
 
 
 def _put(a: np.ndarray):
+    from ..utils import stages
     from .placement import scan_device
 
+    stages.count("upload_bytes", int(getattr(a, "nbytes", 0)))
     return jax.device_put(a, scan_device())
 
 

@@ -167,6 +167,9 @@ _engagements = 0
 def note_engaged() -> None:
     global _engagements
     _engagements += 1
+    from ..utils import stages
+
+    stages.count("pallas_engagements")
 
 
 def engagements() -> int:

@@ -69,9 +69,14 @@ def unpack(raw: bytes):
 
 
 class RpcServer:
-    """Serves `handlers[method](payload) -> reply` at POST /rpc/<method>."""
+    """Serves `handlers[method](payload) -> reply` at POST /rpc/<method>.
 
-    def __init__(self, host: str, port: int, handlers: dict):
+    `node_id` (when the owner has one, e.g. DataNodeService) labels the
+    per-request sub-profiles this server returns to profiling callers."""
+
+    def __init__(self, host: str, port: int, handlers: dict,
+                 node_id: int | None = None):
+        self.node_id = node_id
         self.handlers = dict(handlers)
         if faults.CTL_ARMED:
             # runtime fault control for chaos harnesses — only exposed when
@@ -106,6 +111,13 @@ class RpcServer:
                         # fail/delay/crash before dispatch (server-side fault)
                         faults.fire("rpc.server", method=method)
                     payload = unpack(body) if body else {}
+                    # per-query profiling envelope: a profiling caller
+                    # marks the payload; the handler then runs inside a
+                    # node-local QueryProfile whose stage timings ride
+                    # back in the reply for the coordinator to merge
+                    want_profile = bool(
+                        isinstance(payload, dict)
+                        and payload.pop("_profile", False))
                     # request-lifecycle envelope: the caller's remaining
                     # deadline (wall-clock epoch ms) and query id ride in
                     # the payload; install them as this handler thread's
@@ -130,7 +142,10 @@ class RpcServer:
                                  "_msg": f"{method}: work expired before "
                                          f"dispatch"}))
                             return
-                    with stages.stage(f"rpc_{method}_ms"):
+                    prof = stages.QueryProfile(node_id=outer.node_id) \
+                        if want_profile else None
+                    with stages.profile_scope(prof), \
+                            stages.stage(f"rpc_{method}_ms"):
                         with GLOBAL_COLLECTOR.from_headers(
                                 self.headers, f"rpc:{method}"):
                             if dl is not None and dl.qid:
@@ -146,6 +161,11 @@ class RpcServer:
                                     reply = fn(payload)
                             else:
                                 reply = fn(payload)
+                    if prof is not None and isinstance(reply, dict):
+                        # reply envelope: this handler's node-local
+                        # sub-profile rides home for the caller to merge
+                        reply = dict(reply)
+                        reply["_profile"] = prof.to_wire()
                     if faults.ENABLED and faults.fire("rpc.reply",
                                                       method=method):
                         # injected lost ack: the handler HAS applied the
@@ -252,6 +272,12 @@ def rpc_call(addr: str, method: str, payload: dict | None = None,
                 payload["_deadline_ms"] = wire
             if dl.qid is not None:
                 payload["_qid"] = dl.qid
+    prof = stages.current_profile()
+    if prof is not None:
+        # profiling envelope: ask the peer to run this dispatch inside a
+        # node-local profile and return it in the reply
+        payload = dict(payload or {})
+        payload["_profile"] = True
     body = pack(payload or {})
     from ..server.trace import TRACE_HEADER, current_trace_header
 
@@ -301,6 +327,18 @@ def rpc_call(addr: str, method: str, payload: dict | None = None,
             conn.close()
             raise RpcUnavailable(f"{method}@{addr}: {e}") from e
         _pool.put(addr, conn)
+        if prof is not None and isinstance(reply, dict) \
+                and "_profile" in reply:
+            sub = reply.pop("_profile")
+            if isinstance(sub, dict):
+                # key the sub-profile by node/vnode/method so the
+                # coordinator-side merge can attribute per node
+                sub.setdefault("addr", addr)
+                sub["method"] = method
+                if isinstance(payload, dict) \
+                        and payload.get("vnode_id") is not None:
+                    sub["vnode"] = payload["vnode_id"]
+                prof.merge_remote(sub)
         if resp.status == 403:
             # typed: auth misconfiguration is permanent — retry loops that
             # catch RpcError/RpcUnavailable must be able to fail fast

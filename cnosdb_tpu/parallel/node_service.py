@@ -30,7 +30,7 @@ class DataNodeService:
     def __init__(self, coord: Coordinator, host: str = "127.0.0.1",
                  port: int = 0):
         self.coord = coord
-        self.server = RpcServer(host, port, {
+        self.server = RpcServer(host, port, node_id=coord.node_id, handlers={
             "ping": self._ping,
             "status": self._status,
             "raft_msg": self._raft_msg,

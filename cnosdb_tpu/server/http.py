@@ -12,6 +12,7 @@ import asyncio
 import base64
 import json
 import sys
+import time
 
 import numpy as np
 from aiohttp import web
@@ -25,12 +26,18 @@ from ..protocol.line_protocol import parse_lines
 from ..sql.executor import QueryExecutor, ResultSet, Session
 from ..storage.engine import TsKv
 from ..utils import deadline as deadline_mod
+from ..utils import stages
 from .admission import AdmissionGate
 from .metrics import MetricsRegistry
 
 # per-request deadline override (milliseconds of budget from ingress);
 # absent → the config [query] read_timeout_ms / write_timeout_ms defaults
 DEADLINE_HEADER = "X-CnosDB-Deadline-Ms"
+# opt-in per-query profiling: any truthy value on the request installs a
+# QueryProfile at ingress; the response then carries a compact JSON
+# summary header, and the full profile is at /debug/profile?qid=
+PROFILE_HEADER = "X-CnosDB-Profile"
+PROFILE_SUMMARY_HEADER = "X-CnosDB-Profile-Summary"
 
 
 class HttpServer:
@@ -47,6 +54,10 @@ class HttpServer:
         qc = query_cfg or QueryConfig()
         self.read_timeout_ms = int(qc.read_timeout_ms)
         self.write_timeout_ms = int(qc.write_timeout_ms)
+        # slow-query log: [query] slow_query_threshold_ms (0 = off);
+        # enforced in the executor so KILLed/expired queries still log
+        executor.slow_query_threshold_ms = \
+            int(getattr(qc, "slow_query_threshold_ms", 0) or 0)
         self.gate = AdmissionGate(qc.max_concurrent_queries,
                                   qc.max_queued_queries)
         from ..parallel.limiter import TenantLimiters
@@ -73,6 +84,7 @@ class HttpServer:
             web.get("/metrics", self.handle_metrics),
             web.get("/debug/health", self.handle_ping),
             web.get("/debug/traces", self.handle_traces),
+            web.get("/debug/profile", self.handle_profile),
             web.get("/debug/backtrace", self.handle_backtrace),
             web.get("/debug/pprof", self.handle_pprof),
             web.get("/debug/scrub", self.handle_scrub),
@@ -210,11 +222,18 @@ class HttpServer:
         span = GLOBAL_COLLECTOR.from_headers(request.headers, "http:sql")
         span.set_tag("sql", sql[:200]).set_tag("tenant", session.tenant)
         dl = self._request_deadline(request, self.read_timeout_ms)
+        # opt-in per-query profile summary: X-CnosDB-Profile: 1 installs
+        # the profile at ingress so the response can carry its totals
+        # (the full profile stays fetchable at /debug/profile?qid=)
+        want_profile = request.headers.get(PROFILE_HEADER, "") \
+            not in ("", "0", "false")
+        prof = stages.QueryProfile() if want_profile else None
 
         def run():
             # on the executor worker thread: one thread per in-flight
             # request, so blocking in the admission gate is safe
-            with deadline_mod.scope(dl):
+            # profile_scope(None) is a harmless clear, so no conditional
+            with deadline_mod.scope(dl), stages.profile_scope(prof):
                 self.gate.acquire(dl)   # AdmissionRejected → 503
                 try:
                     with span:
@@ -232,6 +251,7 @@ class HttpServer:
                 finally:
                     self.gate.release()
 
+        t0 = time.monotonic()
         try:
             self.limiters.check_query(session.tenant)
             loop = asyncio.get_running_loop()
@@ -248,6 +268,9 @@ class HttpServer:
                 self.metrics.incr("cnosdb_requests_deadline_exceeded_total")
             return _err_response(_status_for(e), e)
         self.metrics.incr("cnosdb_http_queries_total")
+        # reference query_sql_process_ms: end-to-end SQL latency histogram
+        self.metrics.observe("cnosdb_query_sql_process_ms",
+                             (time.monotonic() - t0) * 1e3)
         self._record_http_usage(request, session, "http_queries", 1)
         self._record_http_usage(request, session, "http_data_in", len(sql))
         rs = results[-1] if results else ResultSet.empty()
@@ -259,6 +282,13 @@ class HttpServer:
                                 content_type="text/plain")
         else:
             resp = web.Response(text=format_csv(rs), content_type="text/csv")
+        if prof is not None:
+            import json as _json
+
+            summary = {"qid": prof.qid, "wall_ms": prof.wall_ms,
+                       "stages": prof.stage_totals()}
+            resp.headers[PROFILE_SUMMARY_HEADER] = _json.dumps(
+                summary, separators=(",", ":"))[:4096]
         # gzip negotiation (reference http_service gzip layer)
         if "gzip" in request.headers.get("Accept-Encoding", ""):
             resp.enable_compression()
@@ -291,6 +321,21 @@ class HttpServer:
         tid = request.query.get("trace_id")
         limit = int(self._query_number(request, "limit", 500, 1, 10_000))
         return web.json_response(GLOBAL_COLLECTOR.spans(tid, limit))
+
+    async def handle_profile(self, request):
+        """Recent per-query profiles (bounded ring, like traces):
+        `?qid=<n>` returns one full profile — stage timings, per-node
+        sub-profiles, device telemetry; without qid, summaries of the
+        most recent queries."""
+        self._require_admin(request)
+        qid = request.query.get("qid")
+        if qid:
+            d = stages.PROFILES.get(qid)
+            if d is None:
+                raise web.HTTPNotFound(text=f"no profile for qid {qid!r}")
+            return web.json_response(d)
+        limit = int(self._query_number(request, "limit", 50, 1, 256))
+        return web.json_response(stages.PROFILES.recent(limit))
 
     async def handle_backtrace(self, request):
         """Live thread stacks (reference /debug/backtrace,

@@ -10,13 +10,26 @@ from collections import defaultdict
 from ..utils import lockwatch
 
 
+class _Hist:
+    """Streaming histogram: per-bucket counts + sum + count, O(1) memory
+    per series regardless of observation volume (the previous sample-list
+    representation grew without bound on long-lived servers)."""
+
+    __slots__ = ("buckets", "total", "count")
+
+    def __init__(self, n_bounds: int):
+        self.buckets = [0] * n_bounds   # non-cumulative, per bound
+        self.total = 0.0
+        self.count = 0
+
+
 class MetricsRegistry:
     def __init__(self):
         self._lock = lockwatch.Lock("metrics.registry")
         self._counters: dict[tuple[str, tuple], float] = defaultdict(float)
         self._gauges: dict[tuple[str, tuple], float] = {}
-        self._histograms: dict[tuple[str, tuple], list] = defaultdict(list)
         self._hist_bounds = [0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1, 5, 10, 60]
+        self._histograms: dict[tuple[str, tuple], _Hist] = {}
 
     def incr(self, name: str, value: float = 1, **labels):
         with self._lock:
@@ -28,7 +41,16 @@ class MetricsRegistry:
 
     def observe(self, name: str, value: float, **labels):
         with self._lock:
-            self._histograms[(name, _lk(labels))].append(value)
+            h = self._histograms.get((name, _lk(labels)))
+            if h is None:
+                h = self._histograms[(name, _lk(labels))] = \
+                    _Hist(len(self._hist_bounds))
+            for i, b in enumerate(self._hist_bounds):
+                if value <= b:
+                    h.buckets[i] += 1
+                    break
+            h.total += value
+            h.count += 1
 
     def prometheus_text(self) -> str:
         out = []
@@ -39,15 +61,15 @@ class MetricsRegistry:
             for (name, labels), v in sorted(self._gauges.items()):
                 out.append(f"# TYPE {name} gauge")
                 out.append(f"{name}{_fmt(labels)} {v}")
-            for (name, labels), vals in sorted(self._histograms.items()):
+            for (name, labels), h in sorted(self._histograms.items()):
                 out.append(f"# TYPE {name} histogram")
                 cum = 0
-                for b in self._hist_bounds:
-                    cum = sum(1 for x in vals if x <= b)
+                for i, b in enumerate(self._hist_bounds):
+                    cum += h.buckets[i]
                     out.append(f'{name}_bucket{_fmt(labels, le=b)} {cum}')
-                out.append(f'{name}_bucket{_fmt(labels, le="+Inf")} {len(vals)}')
-                out.append(f"{name}_sum{_fmt(labels)} {sum(vals)}")
-                out.append(f"{name}_count{_fmt(labels)} {len(vals)}")
+                out.append(f'{name}_bucket{_fmt(labels, le="+Inf")} {h.count}')
+                out.append(f"{name}_sum{_fmt(labels)} {h.total}")
+                out.append(f"{name}_count{_fmt(labels)} {h.count}")
         return "\n".join(out) + "\n"
 
 

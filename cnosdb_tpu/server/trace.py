@@ -121,6 +121,12 @@ class TraceCollector:
 GLOBAL_COLLECTOR = TraceCollector()
 
 
+def current_span() -> Span | None:
+    """The context-active span, if any (profile plane attaches per-stage
+    timings to the root span it finds here)."""
+    return _current_span.get()
+
+
 def current_trace_header() -> str | None:
     """Outgoing propagation value for the active span, if any."""
     cur = _current_span.get()

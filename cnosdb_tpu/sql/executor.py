@@ -162,6 +162,9 @@ class QueryExecutor:
     # ------------------------------------------------------------------ api
     def execute_sql(self, sql: str, session: Session | None = None) -> list[ResultSet]:
         session = session or Session()
+        from contextlib import nullcontext
+
+        from ..server import trace as _trace
         from ..utils import deadline as _deadline_mod
 
         # adopt the ambient request context (installed at HTTP ingress);
@@ -170,21 +173,85 @@ class QueryExecutor:
         ctx = _deadline_mod.current()
         qid = self.tracker.register(sql, session, ctx=ctx)
         import threading as _th
+        import time as _t
 
         if not hasattr(self, "_tls"):
             self._tls = _th.local()
         prev_qid = getattr(self._tls, "qid", None)
         self._tls.qid = qid
+        # always-on per-query profile: adopt an ambient one (bench /
+        # EXPLAIN ANALYZE / a caller-installed scope) or own a fresh one
+        prof = stages.current_profile()
+        own_prof = prof is None
+        if own_prof:
+            prof = stages.QueryProfile(
+                node_id=getattr(self.coord, "node_id", None))
+        prof.qid = str(qid)
+        if prof.sql is None:
+            prof.sql = sql[:512]
+        span = _trace.current_span()
+        if span is not None:
+            prof.trace_id = span.trace_id
+        t0 = _t.perf_counter()
+        error: str | None = None
         try:
-            out = []
-            for s in parse_sql(sql):
-                self.tracker.check_cancelled(qid)
-                out.append(self.execute_statement(s, session))
-            self._record_query_usage(sql, session)
-            return out
+            with (stages.profile_scope(prof) if own_prof
+                  else nullcontext()):
+                out = []
+                for s in parse_sql(sql):
+                    self.tracker.check_cancelled(qid)
+                    out.append(self.execute_statement(s, session))
+                self._record_query_usage(sql, session)
+                return out
+        except BaseException as e:
+            error = f"{type(e).__name__}: {e}"[:200]
+            raise
         finally:
+            wall_ms = (_t.perf_counter() - t0) * 1e3
+            try:
+                self._finish_profile(prof, wall_ms, error, span, session)
+            except Exception:
+                stages.count_error("swallow.executor.profile")
             self._tls.qid = prev_qid
             self.tracker.finish(qid)
+
+    def _finish_profile(self, prof, wall_ms: float, error: str | None,
+                        span, session: Session) -> None:
+        """Seal one query's profile: stamp wall time + device telemetry,
+        publish to the bounded PROFILES ring (`GET /debug/profile`),
+        attach stage timings to the root trace span, and feed the
+        slow-query log. Runs in execute_sql's `finally`, so KILLed and
+        deadline-exceeded queries are recorded too."""
+        prof.finish(wall_ms=wall_ms, error=error)
+        stages.PROFILES.record(prof)
+        if span is not None:
+            for k, v in prof.snapshot().items():
+                span.set_tag(f"stage.{k}", v)
+            span.set_tag("profile.qid", prof.qid)
+        threshold = int(getattr(self, "slow_query_threshold_ms", 0) or 0)
+        if threshold > 0 and wall_ms >= threshold:
+            self._slow_query_log(prof, wall_ms, error, session)
+
+    def _slow_query_log(self, prof, wall_ms: float, error: str | None,
+                        session: Session) -> None:
+        """usage_schema.slow_queries: one row per threshold-exceeding
+        query (value = wall ms) tagged with qid/trace id/user and the
+        dominant stage costs, so the log is SQL-queryable next to the
+        rest of the self-telemetry plane. Never fails the query."""
+        try:
+            totals = prof.stage_totals()
+            tags = {"tenant": session.tenant, "database": session.database,
+                    "node_id": str(self.coord.node_id),
+                    "user": session.user, "qid": str(prof.qid),
+                    "trace_id": prof.trace_id or "",
+                    "sql": (prof.sql or "")[:180],
+                    "error": (error or "")[:120],
+                    "decode_ms": str(totals.get("decode_ms", 0)),
+                    "kernel_ms": str(totals.get("kernel_ms", 0)),
+                    "merge_ms": str(totals.get("merge_ms", 0))}
+            self.coord.record_usage("slow_queries", tags, int(wall_ms))
+        except Exception:
+            stages.count_error("swallow.executor.slow_query_log")
 
     def _record_query_usage(self, sql: str, session: Session):
         """usage_schema counters for the SQL plane (reference
@@ -1237,14 +1304,34 @@ class QueryExecutor:
             import time as _t
 
             db = sel.database or session.database
+            # the inner query runs inside its OWN profile so the rendered
+            # breakdown covers exactly this execution; it then folds into
+            # any ambient profile (the enclosing statement's) so the
+            # stages aren't lost to the outer scope
+            prof = stages.QueryProfile(
+                node_id=getattr(self.coord, "node_id", None),
+                sql=sel.to_sql() if hasattr(sel, "to_sql") else None)
             t0 = _t.perf_counter()
             # execute the SAME plan object that gets printed below
-            if isinstance(plan, AggregatePlan):
-                rs = self._exec_aggregate(plan, session.tenant, db)
-            else:
-                rs = self._exec_raw(plan, session.tenant, db)
+            with stages.profile_scope(prof):
+                if isinstance(plan, AggregatePlan):
+                    rs = self._exec_aggregate(plan, session.tenant, db)
+                else:
+                    rs = self._exec_raw(plan, session.tenant, db)
             elapsed = (_t.perf_counter() - t0) * 1e3
+            prof.finish(wall_ms=elapsed)
+            outer = stages.current_profile()
+            if outer is not None:
+                outer.merge_child(prof)
             lines.append(f"Execution: {rs.n_rows} rows in {elapsed:.2f}ms")
+            # per-stage, per-node breakdown (the reference's DataFusion
+            # EXPLAIN ANALYZE metrics rows, merged across the cluster)
+            for node, cell in sorted(prof.node_stages().items()):
+                for name, value in sorted(cell.items()):
+                    lines.append(f"stage node={node} name={name} "
+                                 f"value={value}")
+            for k, v in sorted(prof.device.items()):
+                lines.append(f"device {k}={v}")
         if isinstance(plan, AggregatePlan):
             lines.append("TpuAggregateExec")
             lines.append(f"  table={plan.table}")

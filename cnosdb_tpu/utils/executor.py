@@ -19,6 +19,7 @@ so decode-thread saturation is observable.
 """
 from __future__ import annotations
 
+import contextvars
 import os
 import threading
 from concurrent.futures import ThreadPoolExecutor
@@ -72,10 +73,16 @@ def submit(name: str, fn, *args):
 
     The submitting thread's request deadline (if any) is captured here
     and re-installed in the worker, so deadline/cancel state crosses the
-    pool boundary. Shed-before-run: a task whose request is already dead
-    by the time a worker picks it up raises instead of executing —
-    queued column decodes for an expired scan never start."""
+    pool boundary; its contextvars (active trace span, query profile)
+    are captured as a Context and the task runs inside it, so a child
+    span started in a pool worker keeps the submitting query's trace_id
+    and stage timings land in that query's profile — cross-thread
+    contextvar loss is the classic silent failure here. Shed-before-run:
+    a task whose request is already dead by the time a worker picks it
+    up raises instead of executing — queued column decodes for an
+    expired scan never start."""
     dl = deadline_mod.current()
+    ctx = contextvars.copy_context()
 
     def run():
         with _lock:
@@ -86,8 +93,8 @@ def submit(name: str, fn, *args):
                     deadline_mod.bump("tasks_shed")
                     dl.check()
                 with deadline_mod.scope(dl):
-                    return fn(*args)
-            return fn(*args)
+                    return ctx.run(fn, *args)
+            return ctx.run(fn, *args)
         finally:
             with _lock:
                 _active[name] -= 1

@@ -1,96 +1,317 @@
-"""Per-stage query timing — the bench instrumentation plane.
+"""Per-query stage profiling — the always-on instrumentation plane.
 
-`bench.py` enables this around each measured query to report where the
-time goes (scan cache hit/miss, TSM decode, kernel, merge, finalize);
-disabled it costs one dict lookup per stage() call. Counters accumulate
-across threads (the scan fans out on a pool).
+Every `stage()` / `count()` call lands in the *active query's*
+:class:`QueryProfile` (a contextvar installed at ingress by the SQL
+executor, by `EXPLAIN ANALYZE`, or by bench.py). With no profile in
+scope both are a single contextvar read — cheap enough to leave on in
+production. Profiles propagate:
 
-Stages recorded by the engine:
-  scan_hit / scan_miss  — coordinator scan-snapshot cache counters
-  delta_hit             — stale cache entry refreshed by decoding only
-                          the new TSM files / memcache rows since its
-                          snapshot token (no full rescan)
-  delta_rows            — rows decoded by those delta scans (small when
-                          the pipeline is healthy; a full rescan's worth
-                          means tokens are being invalidated)
-  decode_ms             — TSM read+decode (cache-miss and delta scans)
-  upload_ms             — host→device column uploads (eager per-column
-                          uploads overlapped with decode, plus any
-                          residual transfer at DeviceBatch build)
-  kernel_ms             — fused segment-aggregate kernels
-  merge_ms              — cross-vnode partial merge / device delta-merge
-  finalize_ms           — vectorized finalizers + output rendering
-  factorize_ms          — group-key factorization (value column →
-                          dense codes + dictionary; ~0 on warm
-                          ScanToken caches)
-  group_count           — output group cardinality per query
-  distinct_path.sort    — count(DISTINCT) via host sorted pair codes
-  distinct_path.device  — … via the jax segment kernels
-  distinct_path.fallback— … via the scalar set fold (unfactorizable)
+  * across the shared scan/decode pools (utils/executor.py re-runs each
+    task inside the submitting thread's contextvars.Context), and
+  * across RPC hops (parallel/net.py adds a `_profile` marker to the
+    payload; the remote handler runs inside its own node-local profile
+    and returns it in the reply, where the caller folds it into the
+    active profile's `subprofiles`, keyed by node/vnode/method).
+
+Consumers: `EXPLAIN ANALYZE` renders the merged per-stage/per-node
+breakdown, HTTP exposes an opt-in summary header plus
+`GET /debug/profile?qid=` over the bounded `PROFILES` ring, finished
+profiles attach to their root trace span as tags, and the slow-query
+log writes threshold-exceeding profiles into usage_schema.
+
+Stage catalog — every *literal* name passed to stage()/count() must
+appear in STAGE_CATALOG (enforced by the `stage-catalog` lint rule in
+cnosdb_tpu/analysis); dynamically-built names must use a prefix from
+DYNAMIC_STAGE_PREFIXES. Keys ending in `_ms` are durations, `_bytes`
+byte totals; everything else is a plain count.
 """
 from __future__ import annotations
 
+import contextvars
 import threading
 import time
+from collections import deque
 from contextlib import contextmanager
 from . import lockwatch
 
-_lock = lockwatch.Lock("stages.counters")
-_enabled = False
-_ms: dict[str, float] = {}
-_counts: dict[str, int] = {}
-# Error counters are ALWAYS on (unlike timing stages): a swallowed RPC
-# handler exception with no counter is invisible in production. Keyed
-# "area.method" (e.g. "rpc.write_replica"); surfaced via /metrics.
+# The documented profile schema. A name missing here is invisible to
+# every dashboard/bench consumer, so the lint plane refuses it.
+STAGE_CATALOG: dict[str, str] = {
+    "scan_hit": "coordinator scan-snapshot cache hits",
+    "scan_miss": "coordinator scan-snapshot cache misses (full decode)",
+    "delta_hit": "stale cache entries refreshed by decoding only the "
+                 "new TSM files / memcache rows since their token",
+    "delta_rows": "rows decoded by delta scans (a full rescan's worth "
+                  "means tokens are being invalidated)",
+    "decode_ms": "TSM read+decode (cache-miss and delta scans)",
+    "upload_ms": "host→device column uploads",
+    "upload_bytes": "bytes moved host→device by those uploads",
+    "kernel_ms": "fused segment-aggregate kernels",
+    "merge_ms": "cross-vnode partial merge / device delta-merge",
+    "finalize_ms": "vectorized finalizers + output rendering",
+    "factorize_ms": "group-key factorization (values → dense codes)",
+    "group_count": "output group cardinality per query",
+    "distinct_path.sort": "count(DISTINCT) via host sorted pair codes",
+    "distinct_path.device": "count(DISTINCT) via the jax segment kernels",
+    "distinct_path.fallback": "count(DISTINCT) via the scalar set fold",
+    "pallas_engagements": "aggregations that ran through a Pallas kernel",
+    "kernel_cache.hit": "segment-geometry/program cache hits on the "
+                        "device batch (compile/derive skipped)",
+    "kernel_cache.miss": "segment-geometry/program cache misses "
+                         "(derived data rebuilt, jit may recompile)",
+}
+
+# Prefixes for names composed at runtime (skipped by the literal lint
+# check but still part of the documented schema):
+#   rpc_<method>_ms — server-side wall time of one RPC handler dispatch
+DYNAMIC_STAGE_PREFIXES = ("rpc_",)
+
+_profile: contextvars.ContextVar = contextvars.ContextVar(
+    "cnos_query_profile", default=None)
+
+# Error counters are ALWAYS on and process-global (unlike stages): a
+# swallowed RPC handler exception with no counter is invisible in
+# production. Keyed "area.method" (e.g. "rpc.write_replica"); surfaced
+# via /metrics.
+_err_lock = lockwatch.Lock("stages.errors")
 _errors: dict[str, int] = {}
 
 
-def enable(flag: bool = True) -> None:
-    global _enabled
-    _enabled = flag
+class QueryProfile:
+    """Stage timings/counters + device telemetry for ONE query.
+
+    Thread-safe: scan/decode pool workers and RPC reply threads all
+    accumulate into the submitting query's profile concurrently. The
+    lock is a plain leaf mutex (never held across any other acquire).
+    """
+
+    __slots__ = ("qid", "sql", "trace_id", "node_id", "started_at",
+                 "wall_ms", "error", "ms", "counts", "device",
+                 "subprofiles", "_lock")
+
+    def __init__(self, qid: str | None = None, node_id=None,
+                 sql: str | None = None):
+        self.qid = qid
+        self.sql = sql
+        self.trace_id: str | None = None
+        self.node_id = node_id
+        self.started_at = time.time()
+        self.wall_ms: float | None = None
+        self.error: str | None = None
+        self.ms: dict[str, float] = {}
+        self.counts: dict[str, int] = {}
+        self.device: dict = {}
+        # remote per-node sub-profiles: [{node, addr, method, vnode,
+        # ms, counts}, ...] — appended by net.rpc_call as replies land
+        self.subprofiles: list[dict] = []
+        self._lock = threading.Lock()
+
+    # ------------------------------------------------------- accumulation
+    def add_ms(self, name: str, dt_ms: float) -> None:
+        with self._lock:
+            self.ms[name] = self.ms.get(name, 0.0) + dt_ms
+
+    def add_count(self, name: str, n: int = 1) -> None:
+        with self._lock:
+            self.counts[name] = self.counts.get(name, 0) + n
+
+    def merge_remote(self, entry: dict) -> None:
+        """Fold one remote node's wire sub-profile in (keyed by
+        node/vnode/method — the coordinator-side merge keeps them
+        separate so EXPLAIN ANALYZE can attribute per node)."""
+        with self._lock:
+            self.subprofiles.append(entry)
+
+    def merge_child(self, child: "QueryProfile") -> None:
+        """Fold a nested profile (e.g. EXPLAIN ANALYZE's inner query)
+        into this one so its stages aren't lost to the outer scope."""
+        with child._lock:
+            ms = dict(child.ms)
+            counts = dict(child.counts)
+            subs = list(child.subprofiles)
+        with self._lock:
+            for k, v in ms.items():
+                self.ms[k] = self.ms.get(k, 0.0) + v
+            for k, v in counts.items():
+                self.counts[k] = self.counts.get(k, 0) + v
+            self.subprofiles.extend(subs)
+
+    # ---------------------------------------------------------- rendering
+    def snapshot(self) -> dict:
+        """Local stage map, bench wire shape: rounded `*_ms` floats
+        merged with integer counters, sorted by key (the format BENCH_r*
+        `stages_warm`/`stages_cold` fields have always used)."""
+        with self._lock:
+            out = {k: round(v, 2) for k, v in sorted(self.ms.items())}
+            out.update(sorted(self.counts.items()))
+            return out
+
+    def to_wire(self) -> dict:
+        """Compact reply-envelope form for the RPC plane."""
+        with self._lock:
+            return {"node": self.node_id,
+                    "ms": {k: round(v, 3) for k, v in self.ms.items()},
+                    "counts": dict(self.counts)}
+
+    def node_stages(self) -> dict[str, dict]:
+        """Merged per-node view: node label → {stage: value}. Local
+        stages land under this profile's node id; each remote
+        sub-profile folds into its originating node's cell."""
+        local = str(self.node_id) if self.node_id is not None else "local"
+        with self._lock:
+            out: dict[str, dict] = {local: {}}
+            for k, v in self.ms.items():
+                out[local][k] = round(out[local].get(k, 0.0) + v, 3)
+            for k, v in self.counts.items():
+                out[local][k] = out[local].get(k, 0) + v
+            for sub in self.subprofiles:
+                node = sub.get("node")
+                label = str(node) if node is not None \
+                    else str(sub.get("addr", "remote"))
+                cell = out.setdefault(label, {})
+                for k, v in (sub.get("ms") or {}).items():
+                    cell[k] = round(cell.get(k, 0.0) + v, 3)
+                for k, v in (sub.get("counts") or {}).items():
+                    cell[k] = cell.get(k, 0) + v
+            return out
+
+    def stage_totals(self) -> dict:
+        """Cluster-wide totals: every node's stages summed per name."""
+        totals: dict = {}
+        for cell in self.node_stages().values():
+            for k, v in cell.items():
+                totals[k] = round(totals.get(k, 0) + v, 3)
+        return totals
+
+    def to_dict(self) -> dict:
+        with self._lock:
+            return {"qid": self.qid, "sql": self.sql,
+                    "trace_id": self.trace_id, "node_id": self.node_id,
+                    "started_at": self.started_at, "wall_ms": self.wall_ms,
+                    "error": self.error,
+                    "ms": {k: round(v, 3) for k, v in sorted(self.ms.items())},
+                    "counts": dict(sorted(self.counts.items())),
+                    "device": dict(self.device),
+                    "subprofiles": [dict(s) for s in self.subprofiles]}
+
+    # ---------------------------------------------------------- lifecycle
+    def finish(self, wall_ms: float | None = None,
+               error: str | None = None) -> "QueryProfile":
+        """Stamp wall time + device telemetry. Captures only from
+        modules that are ALREADY imported — finishing a profile must
+        never drag the jax stack in on a cold text-only query."""
+        import sys
+
+        if wall_ms is not None:
+            self.wall_ms = round(wall_ms, 3)
+        if error is not None:
+            self.error = error
+        pk = sys.modules.get("cnosdb_tpu.ops.pallas_kernels")
+        if pk is None and "cnosdb_tpu.ops.kernels" in sys.modules:
+            # the jax kernel stack is already resident (this query ran
+            # aggregates), so the pallas module itself is a cheap import
+            try:
+                from ..ops import pallas_kernels as pk
+            except Exception:  # lint: disable=swallowed-exception (telemetry stamp must never fail the query)
+                pk = None
+        if pk is not None:
+            try:
+                self.device["pallas_enabled"] = pk.enabled()
+                self.device["pallas_disabled_reason"] = pk.disabled_reason()
+            except Exception:  # lint: disable=swallowed-exception (telemetry stamp must never fail the query)
+                pass
+        return self
 
 
-def reset() -> None:
-    with _lock:
-        _ms.clear()
-        _counts.clear()
-        _errors.clear()
+def current_profile() -> QueryProfile | None:
+    return _profile.get()
 
 
-def snapshot() -> dict:
-    with _lock:
-        out = {k: round(v, 2) for k, v in sorted(_ms.items())}
-        out.update(sorted(_counts.items()))
-        return out
+class profile_scope:
+    """Install `profile` as the active query profile for the block
+    (None clears the scope — e.g. background work inside a request
+    that must not bill to it)."""
+
+    __slots__ = ("profile", "_token")
+
+    def __init__(self, profile: QueryProfile | None):
+        self.profile = profile
+        self._token = None
+
+    def __enter__(self):
+        self._token = _profile.set(self.profile)
+        return self.profile
+
+    def __exit__(self, *exc):
+        if self._token is not None:
+            _profile.reset(self._token)
+        return False
 
 
-def count(name: str, n: int = 1) -> None:
-    if not _enabled:
-        return
-    with _lock:
-        _counts[name] = _counts.get(name, 0) + n
+class ProfileRing:
+    """Bounded ring of recently finished profiles (dict snapshots),
+    queryable by qid — the trace collector's shape, applied to
+    profiles so `GET /debug/profile?qid=` works after the fact."""
+
+    def __init__(self, capacity: int = 256):
+        self._ring: deque = deque(maxlen=capacity)
+        self._lock = lockwatch.Lock("stages.profile_ring")
+
+    def record(self, profile: QueryProfile) -> None:
+        with self._lock:
+            self._ring.append(profile.to_dict())
+
+    def get(self, qid: str) -> dict | None:
+        with self._lock:
+            for d in reversed(self._ring):
+                if d.get("qid") == str(qid):
+                    return d
+        return None
+
+    def recent(self, limit: int = 50) -> list[dict]:
+        with self._lock:
+            out = list(self._ring)[-limit:]
+        return [{"qid": d.get("qid"), "sql": d.get("sql"),
+                 "trace_id": d.get("trace_id"), "wall_ms": d.get("wall_ms"),
+                 "started_at": d.get("started_at"), "error": d.get("error")}
+                for d in out]
 
 
-def count_error(name: str, n: int = 1) -> None:
-    """Always-on failure counter (not gated on enable())."""
-    with _lock:
-        _errors[name] = _errors.get(name, 0) + n
+PROFILES = ProfileRing()
 
 
-def errors_snapshot() -> dict[str, int]:
-    with _lock:
-        return dict(sorted(_errors.items()))
-
-
+# --------------------------------------------------------------- recording
 @contextmanager
 def stage(name: str):
-    if not _enabled:
+    prof = _profile.get()
+    if prof is None:
         yield
         return
     t0 = time.perf_counter()
     try:
         yield
     finally:
-        dt = (time.perf_counter() - t0) * 1e3
-        with _lock:
-            _ms[name] = _ms.get(name, 0.0) + dt
+        prof.add_ms(name, (time.perf_counter() - t0) * 1e3)
+
+
+def count(name: str, n: int = 1) -> None:
+    prof = _profile.get()
+    if prof is not None:
+        prof.add_count(name, n)
+
+
+def count_error(name: str, n: int = 1) -> None:
+    """Always-on process-global failure counter (never profile-scoped)."""
+    with _err_lock:
+        _errors[name] = _errors.get(name, 0) + n
+
+
+def errors_snapshot() -> dict[str, int]:
+    with _err_lock:
+        return dict(sorted(_errors.items()))
+
+
+def reset() -> None:
+    """Clear the process-global error counters (test isolation)."""
+    with _err_lock:
+        _errors.clear()

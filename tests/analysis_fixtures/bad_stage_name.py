@@ -1,0 +1,13 @@
+"""Fixture: every stages.stage()/count() name must be in the catalog."""
+
+
+def f(stages, method, n):
+    stages.count("scan_hit")                  # ok: in the catalog
+    stages.count("scan_hits")                 # unknown name (typo)
+    with stages.stage("decode_ms"):           # ok
+        pass
+    with stages.stage("decode_time_ms"):      # unknown name
+        pass
+    stages.count(f"rpc_{method}_ms")          # ok: registered prefix
+    stages.count(f"vnode_{n}_ms")             # unregistered dynamic prefix
+    "abc".count("scan_hits")                  # ok: not the stages module

@@ -40,6 +40,7 @@ _CASES = [
     ("bad_metrics.py", rules_mod.MetricsNaming(), [6, 7, 8]),
     ("bad_row_loop.py", rules_mod.RowLoop(), [7]),
     ("bad_row_loop.py", rules_mod.RowLoopFallback(), [21]),
+    ("bad_stage_name.py", rules_mod.StageCatalog(), [6, 9, 12]),
 ]
 
 

@@ -1,0 +1,513 @@
+"""Per-query profiling plane: scoped QueryProfile, cross-thread/RPC
+propagation, EXPLAIN ANALYZE breakdowns, slow-query log, /debug/profile,
+and the streaming /metrics histograms (reference query_sql_process_ms +
+DataFusion EXPLAIN ANALYZE metrics)."""
+import json
+import re
+import threading
+import time
+
+import pytest
+
+from cnosdb_tpu.errors import DeadlineExceeded, QueryError
+from cnosdb_tpu.parallel.coordinator import Coordinator
+from cnosdb_tpu.parallel.meta import DEFAULT_TENANT, MetaStore
+from cnosdb_tpu.sql.executor import QueryExecutor, Session
+from cnosdb_tpu.storage.engine import TsKv
+from cnosdb_tpu.utils import deadline as deadline_mod
+from cnosdb_tpu.utils import executor as pool_mod
+from cnosdb_tpu.utils import stages
+
+
+# ------------------------------------------------------------------ units
+def test_stage_and_count_land_in_active_profile():
+    prof = stages.QueryProfile(qid="q1")
+    with stages.profile_scope(prof):
+        with stages.stage("decode_ms"):
+            time.sleep(0.002)
+        stages.count("scan_hit")
+        stages.count("upload_bytes", 4096)
+    snap = prof.snapshot()
+    assert snap["decode_ms"] >= 1.0
+    assert snap["scan_hit"] == 1
+    assert snap["upload_bytes"] == 4096
+    # outside any scope both are no-ops, not errors
+    with stages.stage("decode_ms"):
+        pass
+    stages.count("scan_hit")
+    assert prof.snapshot() == snap
+
+
+def test_profile_scope_nesting_and_clear():
+    outer = stages.QueryProfile()
+    with stages.profile_scope(outer):
+        assert stages.current_profile() is outer
+        with stages.profile_scope(None):   # background work: bill nobody
+            assert stages.current_profile() is None
+            stages.count("scan_hit")
+        assert stages.current_profile() is outer
+    assert stages.current_profile() is None
+    assert outer.snapshot() == {}
+
+
+def test_merge_child_and_node_stages():
+    parent = stages.QueryProfile(node_id=1)
+    child = stages.QueryProfile(node_id=1)
+    child.add_ms("kernel_ms", 5.0)
+    child.add_count("group_count", 7)
+    child.merge_remote({"node": 2, "ms": {"rpc_scan_vnode_ms": 3.0},
+                        "counts": {"scan_miss": 1}})
+    parent.merge_child(child)
+    nodes = parent.node_stages()
+    assert nodes["1"]["kernel_ms"] == 5.0
+    assert nodes["1"]["group_count"] == 7
+    assert nodes["2"]["rpc_scan_vnode_ms"] == 3.0
+    totals = parent.stage_totals()
+    assert totals["kernel_ms"] == 5.0 and totals["scan_miss"] == 1
+
+
+def test_profile_ring_is_bounded_and_queryable():
+    ring = stages.ProfileRing(capacity=8)
+    for i in range(20):
+        ring.record(stages.QueryProfile(qid=str(i)).finish(wall_ms=float(i)))
+    assert len(ring.recent(limit=256)) == 8
+    assert ring.get("19")["wall_ms"] == 19.0
+    assert ring.get("0") is None          # evicted
+    assert ring.recent(limit=3)[-1]["qid"] == "19"
+
+
+# ----------------------------------------------- cross-thread propagation
+def test_profile_and_trace_cross_pool_workers():
+    """The classic contextvar loss: work submitted to the shared pools
+    must keep billing the submitting query's profile and trace."""
+    from cnosdb_tpu.server.trace import GLOBAL_COLLECTOR, current_trace_header
+
+    prof = stages.QueryProfile()
+    seen = []
+
+    def task(i):
+        stages.count("scan_hit")
+        with stages.stage("decode_ms"):
+            time.sleep(0.001)
+        seen.append((threading.current_thread().name,
+                     stages.current_profile(), current_trace_header()))
+        return i
+
+    with GLOBAL_COLLECTOR.span("query") as span:
+        with stages.profile_scope(prof):
+            out = pool_mod.run_all("decode", task, list(range(8)))
+    assert out == list(range(8))
+    snap = prof.snapshot()
+    assert snap["scan_hit"] == 8, "counts lost crossing the pool boundary"
+    assert snap["decode_ms"] >= 8 * 1.0
+    workers = {name for name, _p, _t in seen}
+    assert any(n != threading.current_thread().name for n in workers)
+    for _name, p, hdr in seen:
+        assert p is prof, "profile did not cross the pool boundary"
+        assert hdr and hdr.startswith(span.trace_id + ":"), \
+            "trace context did not cross the pool boundary"
+
+
+# ----------------------------------------------------------- RPC envelope
+def test_rpc_subprofile_round_trip():
+    from cnosdb_tpu.parallel.net import RpcServer, rpc_call
+
+    handler_profiled = []
+
+    def handler(p):
+        handler_profiled.append(stages.current_profile() is not None)
+        with stages.stage("decode_ms"):
+            time.sleep(0.002)
+        stages.count("scan_miss")
+        return {"ok": True, "vnode_id": p.get("vnode_id")}
+
+    srv = RpcServer("127.0.0.1", 0, {"scan_vnode": handler},
+                    node_id=7).start()
+    try:
+        # no profile in scope: no marker sent, handler runs unprofiled
+        reply = rpc_call(srv.addr, "scan_vnode", {"vnode_id": 3})
+        assert handler_profiled == [False]
+        assert "_profile" not in reply
+        prof = stages.QueryProfile(node_id=1)
+        with stages.profile_scope(prof):
+            reply = rpc_call(srv.addr, "scan_vnode", {"vnode_id": 3})
+        assert handler_profiled == [False, True]
+        assert "_profile" not in reply, "envelope must be stripped"
+        assert len(prof.subprofiles) == 1
+        sub = prof.subprofiles[0]
+        assert sub["node"] == 7
+        assert sub["method"] == "scan_vnode" and sub["vnode"] == 3
+        assert sub["counts"]["scan_miss"] == 1
+        assert sub["ms"]["decode_ms"] >= 1.0
+        assert sub["ms"]["rpc_scan_vnode_ms"] >= sub["ms"]["decode_ms"]
+        assert prof.node_stages()["7"]["scan_miss"] == 1
+    finally:
+        srv.stop()
+
+
+# --------------------------------------------------------- EXPLAIN ANALYZE
+@pytest.fixture
+def db(tmp_path):
+    meta = MetaStore(str(tmp_path / "meta.json"))
+    engine = TsKv(str(tmp_path / "data"))
+    coord = Coordinator(meta, engine)
+    ex = QueryExecutor(meta, coord)
+    yield ex
+    coord.close()
+
+
+def _seed(db, n=200):
+    db.execute_one("CREATE TABLE m (v DOUBLE, TAGS(h))")
+    rows = ", ".join(f"({i * 10**9}, 'h{i % 4}', {i}.5)" for i in range(n))
+    db.execute_one(f"INSERT INTO m (time, h, v) VALUES {rows}")
+
+
+def _stage_rows(rs):
+    """Parse `stage node=<n> name=<s> value=<v>` result rows →
+    [(node, name, value)]."""
+    out = []
+    for line in rs.columns[0]:
+        m = re.match(r"stage node=(\S+) name=(\S+) value=(\S+)", str(line))
+        if m:
+            out.append((m.group(1), m.group(2), float(m.group(3))))
+    return out
+
+
+def test_explain_analyze_renders_stage_and_device_rows(db):
+    _seed(db)
+    rs = db.execute_one(
+        "EXPLAIN ANALYZE SELECT h, count(*), max(v) FROM m GROUP BY h")
+    text = "\n".join(str(x) for x in rs.columns[0])
+    assert "Execution: 4 rows" in text
+    assert "TpuAggregateExec" in text
+    rows = _stage_rows(rs)
+    names = {n for _node, n, _v in rows}
+    assert "kernel_ms" in names and "group_count" in names
+    for _node, name, value in rows:
+        assert name in stages.STAGE_CATALOG \
+            or name.startswith(stages.DYNAMIC_STAGE_PREFIXES)
+        assert value >= 0
+    assert "device pallas_enabled=" in text
+
+
+def test_explain_analyze_reconciles_with_scoped_profile(db):
+    """The rendered breakdown and the ambient (bench-style) profile must
+    agree: the inner profile folds into the outer, so per-stage sums
+    reconcile within 10%."""
+    _seed(db)
+    db.execute_one("SELECT h, count(*) FROM m GROUP BY h")   # warm caches
+    outer = stages.QueryProfile()
+    with stages.profile_scope(outer):
+        rs = db.execute_one(
+            "EXPLAIN ANALYZE SELECT h, count(*), max(v) FROM m GROUP BY h")
+    rendered: dict[str, float] = {}
+    for _node, name, value in _stage_rows(rs):
+        rendered[name] = rendered.get(name, 0.0) + value
+    totals = outer.stage_totals()
+    assert rendered, "no stage rows rendered"
+    for name, value in rendered.items():
+        got = totals.get(name, 0.0)
+        assert abs(got - value) <= max(0.1 * value, 0.5), \
+            f"{name}: EXPLAIN={value} vs profile={got}"
+
+
+def test_profile_sealed_by_executor_and_ring_recorded(db):
+    _seed(db, n=50)
+    prof = stages.QueryProfile()
+    with stages.profile_scope(prof):
+        db.execute_one("SELECT count(*) FROM m")
+    assert prof.qid is not None
+    assert prof.wall_ms is not None and prof.wall_ms > 0
+    assert prof.sql == "SELECT count(*) FROM m"
+    assert "pallas_enabled" in prof.device
+    d = stages.PROFILES.get(prof.qid)
+    assert d is not None and d["wall_ms"] == prof.wall_ms
+
+
+# ---------------------------------------------------------- slow-query log
+def _slow_rows(db):
+    db.slow_query_threshold_ms = 0
+    rs = db.execute_one(
+        "SELECT error, qid, sql FROM usage_schema.slow_queries")
+    return list(zip(*(list(c) for c in rs.columns))) if rs.n_rows else []
+
+
+def test_slow_query_log_threshold(db):
+    _seed(db, n=50)
+    db.slow_query_threshold_ms = 10_000   # nothing is that slow
+    db.execute_one("SELECT count(*) FROM m")
+    db.slow_query_threshold_ms = 1
+    orig = db.execute_statement
+
+    def slow_stmt(stmt, session):
+        time.sleep(0.01)
+        return orig(stmt, session)
+
+    db.execute_statement = slow_stmt
+    try:
+        db.execute_one("SELECT max(v) FROM m")
+    finally:
+        db.execute_statement = orig
+    rows = _slow_rows(db)
+    assert rows, "threshold-exceeding query did not reach usage_schema"
+    assert any("max(v)" in r[2] for r in rows)
+    assert all("count(*)" not in r[2] for r in rows), \
+        "query under threshold must not be logged"
+
+
+def test_killed_and_deadline_exceeded_queries_still_log(db):
+    """_finish_profile runs in execute_sql's finally: a query unwound by
+    KILL or deadline expiry still lands in the slow-query log, with its
+    error recorded."""
+    _seed(db, n=50)
+    db.slow_query_threshold_ms = 1
+    orig = db.execute_statement
+
+    def killed_stmt(stmt, session):
+        qid = db._tls.qid
+        db.tracker.kill(qid)                 # KILLed mid-flight
+        time.sleep(0.01)
+        db.tracker.check_cancelled(qid)      # raises: query killed
+        return orig(stmt, session)
+
+    db.execute_statement = killed_stmt
+    try:
+        with pytest.raises(QueryError):
+            db.execute_one("SELECT min(v) FROM m")
+    finally:
+        db.execute_statement = orig
+
+    def expired_stmt(stmt, session):
+        time.sleep(0.01)
+        deadline_mod.check_current()         # raises DeadlineExceeded
+        return orig(stmt, session)
+
+    db.slow_query_threshold_ms = 1
+    db.execute_statement = expired_stmt
+    try:
+        with pytest.raises(DeadlineExceeded):
+            with deadline_mod.scope(deadline_mod.Deadline(0.001)):
+                db.execute_one("SELECT sum(v) FROM m")
+    finally:
+        db.execute_statement = orig
+    rows = _slow_rows(db)
+    errors = [r[0] for r in rows]
+    assert any("killed" in e.lower() or "cancel" in e.lower()
+               for e in errors), errors
+    assert any("DeadlineExceeded" in e for e in errors), errors
+
+
+# --------------------------------------------------- HTTP plane + metrics
+@pytest.fixture
+def http(tmp_path):
+    from test_deadline import _Harness
+
+    h = _Harness(str(tmp_path / "srv"))
+    yield h
+    h.close()
+
+
+def _seed_http(h, n=40):
+    lines = "\n".join(
+        f"cpu,host=h{i % 4} usage={i}.5 {1672531200000000000 + i * 10**9}"
+        for i in range(n))
+    status, body, _ = h.request("POST", "/api/v1/write?db=public", lines)
+    assert status == 200, body
+
+
+def test_http_profile_header_and_debug_profile(http):
+    _seed_http(http)
+    # without the header: no summary
+    status, _body, hdrs = http.request(
+        "POST", "/api/v1/sql?db=public", "SELECT count(*) FROM cpu")
+    assert status == 200 and "X-CnosDB-Profile-Summary" not in hdrs
+    # opt-in: compact summary on the response
+    status, _body, hdrs = http.request(
+        "POST", "/api/v1/sql?db=public",
+        "SELECT host, max(usage) FROM cpu GROUP BY host",
+        headers={"X-CnosDB-Profile": "1"})
+    assert status == 200
+    summary = json.loads(hdrs["X-CnosDB-Profile-Summary"])
+    assert summary["wall_ms"] > 0
+    assert summary["stages"].get("group_count") == 4
+    qid = summary["qid"]
+    # full profile from the bounded ring
+    status, body, _ = http.request("GET", f"/debug/profile?qid={qid}")
+    assert status == 200
+    full = json.loads(body)
+    assert full["qid"] == qid and full["counts"]["group_count"] == 4
+    assert "pallas_enabled" in full["device"]
+    status, body, _ = http.request("GET", "/debug/profile")
+    recents = json.loads(body)
+    assert any(d["qid"] == qid for d in recents)
+    status, body, _ = http.request("GET", "/debug/profile?qid=nope")
+    assert status == 404
+
+
+# A strict (small) Prometheus text-format checker: every line must be a
+# comment or `name{labels} value`; histograms must expose cumulative
+# monotone buckets ending in +Inf == _count, plus _sum/_count.
+_SAMPLE_RE = re.compile(
+    r'^([a-zA-Z_:][a-zA-Z0-9_:]*)'
+    r'(\{[a-zA-Z_][a-zA-Z0-9_]*="[^"]*"(,[a-zA-Z_][a-zA-Z0-9_]*="[^"]*")*\})?'
+    r' (-?\d+(\.\d+)?([eE][+-]?\d+)?|[+-]Inf|NaN)$')
+
+
+_LABEL_RE = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="([^"]*)"')
+
+
+def _check_prometheus(text: str):
+    types: dict[str, str] = {}
+    samples: list[tuple[str, str, float]] = []
+    assert text.endswith("\n")
+    for line in text.splitlines():
+        if not line:
+            continue
+        if line.startswith("#"):
+            parts = line.split()
+            assert parts[0] == "#" and parts[1] in ("TYPE", "HELP"), line
+            if parts[1] == "TYPE":
+                assert parts[3] in ("counter", "gauge", "histogram",
+                                    "summary", "untyped"), line
+                types[parts[2]] = parts[3]
+            continue
+        m = _SAMPLE_RE.match(line)
+        assert m, f"malformed sample line: {line!r}"
+        samples.append((m.group(1), m.group(2) or "", float(m.group(4))))
+    # histogram families: cumulative buckets + _sum + _count per series
+    for fam, t in types.items():
+        if t != "histogram":
+            continue
+        by_series: dict[tuple, list] = {}
+        sums, counts = {}, {}
+        for name, labels, v in samples:
+            pairs = dict(_LABEL_RE.findall(labels))
+            le = pairs.pop("le", None)
+            key = tuple(sorted(pairs.items()))
+            if name == f"{fam}_bucket":
+                assert le is not None, f"bucket sample without le: {labels}"
+                by_series.setdefault(key, []).append((le, v))
+            elif name == f"{fam}_sum":
+                sums[key] = v
+            elif name == f"{fam}_count":
+                counts[key] = v
+        assert by_series, f"histogram {fam} has no buckets"
+        for key, buckets in by_series.items():
+            values = [v for _le, v in buckets]
+            assert values == sorted(values), \
+                f"{fam}{key}: buckets not cumulative: {buckets}"
+            assert buckets[-1][0] == "+Inf"
+            assert buckets[-1][1] == counts.get(key), \
+                f"{fam}{key}: +Inf bucket != _count"
+            assert key in sums, f"{fam}{key}: missing _sum"
+    return types, samples
+
+
+def test_metrics_endpoint_full_prometheus_parse(http):
+    _seed_http(http)
+    for _ in range(3):
+        status, _b, _h = http.request(
+            "POST", "/api/v1/sql?db=public", "SELECT count(*) FROM cpu")
+        assert status == 200
+    status, text, _ = http.request("GET", "/metrics")
+    assert status == 200
+    types, samples = _check_prometheus(text)
+    names = {n for n, _l, _v in samples}
+    assert "cnosdb_http_queries_total" in names
+    # the SQL latency histogram engaged and checks out strictly
+    assert types.get("cnosdb_query_sql_process_ms") == "histogram"
+    cnt = [v for n, _l, v in samples
+           if n == "cnosdb_query_sql_process_ms_count"]
+    assert cnt and cnt[0] >= 3
+
+
+def test_histogram_memory_bounded_under_soak():
+    """100k observations must not grow per-sample state (the old
+    implementation appended every value to a list forever)."""
+    from cnosdb_tpu.server.metrics import MetricsRegistry
+
+    reg = MetricsRegistry()
+    n = 100_000
+    for i in range(n):
+        reg.observe("cnosdb_soak_ms", (i % 1000) / 10.0, route="q")
+    hists = list(reg._histograms.values())
+    assert len(hists) == 1
+    h = hists[0]
+    assert not hasattr(h, "append"), "histogram state must not be a list"
+    assert len(h.buckets) == len(reg._hist_bounds)
+    assert h.count == n
+    assert h.total == pytest.approx(sum((i % 1000) / 10.0
+                                        for i in range(1000)) * (n // 1000))
+    text = reg.prometheus_text()
+    _check_prometheus(text)
+    # spot-check one cumulative bucket against the definition
+    m = re.search(r'cnosdb_soak_ms_bucket\{route="q",le="5"\} (\d+)', text)
+    # values are (i % 1000)/10 ∈ [0, 99.9]; ≤5 → i%1000 ∈ [0, 50] → 51/1000
+    assert m and int(m.group(1)) == 51 * (n // 1000)
+
+
+# ------------------------------------------------------- cluster breakdown
+@pytest.mark.cluster
+def test_explain_analyze_cluster_per_node_breakdown(tmp_path):
+    """EXPLAIN ANALYZE on a multi-vnode distributed query: stage rows for
+    every participating node, reconciling with the request's profile
+    totals within 10%."""
+    import base64
+    import urllib.request
+
+    from cluster_harness import Cluster
+
+    c = Cluster(str(tmp_path / "cl"), n_nodes=2).start()
+    try:
+        n1 = c.nodes[0]
+        n1.sql("CREATE DATABASE d1 WITH SHARD 4 REPLICA 1", db="public")
+        lines = "\n".join(
+            f"cpu,host=h{i} usage={i}.5 {1_700_000_000_000_000_000 + i * 10**3}"
+            for i in range(64))
+        n1.write_lp(lines, db="d1")
+
+        def sql_with_profile(q):
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{n1.http_port}/api/v1/sql?db=d1",
+                data=q.encode(), method="POST",
+                headers={"Authorization": "Basic "
+                         + base64.b64encode(b"root:").decode(),
+                         "X-CnosDB-Profile": "1"})
+            with urllib.request.urlopen(req, timeout=30) as resp:
+                return resp.read().decode(), dict(resp.headers)
+
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            try:
+                body, _h = sql_with_profile("SELECT count(*) FROM cpu")
+                if body.strip().splitlines()[-1] == "64":
+                    break
+            except Exception:
+                pass
+            time.sleep(0.3)
+        body, hdrs = sql_with_profile(
+            "EXPLAIN ANALYZE SELECT host, max(usage) FROM cpu GROUP BY host")
+        rows = []
+        for line in body.splitlines():
+            m = re.match(r'"?stage node=(\S+) name=(\S+) value=([\d.]+)"?',
+                         line)
+            if m:
+                rows.append((m.group(1), m.group(2), float(m.group(3))))
+        assert rows, f"no stage rows in:\n{body}"
+        nodes = {node for node, _n, _v in rows}
+        assert len(nodes) >= 2, \
+            f"expected per-node attribution across the cluster, got {nodes}"
+        remote = [n for n, name, _v in rows if name.startswith("rpc_")]
+        assert remote, "remote nodes must report rpc_* handler stages"
+        # reconcile the rendered rows against the request profile summary
+        summary = json.loads(hdrs["X-CnosDB-Profile-Summary"])
+        totals = summary["stages"]
+        rendered: dict[str, float] = {}
+        for _node, name, value in rows:
+            rendered[name] = rendered.get(name, 0.0) + value
+        for name, value in rendered.items():
+            got = totals.get(name, 0.0)
+            assert abs(got - value) <= max(0.1 * value, 0.5), \
+                f"{name}: EXPLAIN={value} vs profile={got} ({totals})"
+    finally:
+        c.stop()

@@ -39,15 +39,11 @@ def _write(coord, host, ts_list, vals, table="cpu", db="public",
 
 
 def _counters(coord, *scan_args, **scan_kw):
-    """Run one scan with stage counters on → (batches, snapshot)."""
-    stages.reset()
-    stages.enable(True)
-    try:
+    """Run one scan under a scoped profile → (batches, snapshot)."""
+    prof = stages.QueryProfile()
+    with stages.profile_scope(prof):
         bs = coord.scan_table(*scan_args, **scan_kw)
-        return bs, stages.snapshot()
-    finally:
-        stages.enable(False)
-        stages.reset()
+    return bs, prof.snapshot()
 
 
 def _flat(batches):
