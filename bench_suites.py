@@ -601,6 +601,115 @@ def run_clickbench(executor, session, a) -> tuple[dict, dict, dict]:
     return res, err, stg
 
 
+# ---------------------------------------------------------------------------
+# dashboard steady-state (materialized rollup plane)
+# ---------------------------------------------------------------------------
+def run_dashboard(executor, coord, tenant, db, session) -> dict:
+    """The workload materialized rollups exist for: a dashboard panel
+    re-issuing the same full-history time-bucketed group-by as history
+    grows 10×. Each step appends a chunk, flushes, advances the view
+    watermark deterministically, then times the panel query with the
+    subsumption rewrite on vs off (both oracle-checked against numpy
+    over the full arrays). With the view, only the unsealed tail is
+    scanned raw, so view_ms should stay flat while noview_ms grows
+    with history; view_growth is last/first view_ms as the headline."""
+    from cnosdb_tpu.models.points import SeriesRows, WriteBatch
+    from cnosdb_tpu.models.schema import ValueType
+    from cnosdb_tpu.models.series import SeriesKey
+    from cnosdb_tpu.sql import matview as _mv
+
+    rng = np.random.default_rng(23)
+    n_hosts = 8
+    steps = 10
+    chunk = max(1000, SUITE_ROWS // 100)      # ×10 over the run
+    delay_ns = 10 * 1_000_000_000
+
+    # the dataset is historical (BASE_TS = 2022): the wall-clock
+    # background maintainer would seal past the data's end and strand
+    # appended rows below the hwm — refresh deterministically instead
+    prev_auto = os.environ.get("CNOSDB_MATVIEW_AUTO")
+    os.environ["CNOSDB_MATVIEW_AUTO"] = "0"
+
+    executor.execute_one(
+        "CREATE TABLE IF NOT EXISTS dash (value DOUBLE, TAGS(host))",
+        session)
+    executor.execute_one(
+        "CREATE MATERIALIZED VIEW bench_dash WATERMARK DELAY '10s' AS "
+        "SELECT date_bin(INTERVAL '1 minute', time) AS t, host, "
+        "sum(value) AS s, count(value) AS c FROM dash GROUP BY t, host",
+        session)
+    me = executor.matview_engine()
+
+    sql = ("SELECT date_bin(INTERVAL '1 minute', time) AS t, host, "
+           "sum(value) AS s, count(value) AS c FROM dash "
+           "GROUP BY t, host ORDER BY t, host")
+    out: dict = {"history_rows": [], "view_ms": [], "noview_ms": []}
+    all_ts: list = []
+    all_host: list = []
+    all_val: list = []
+    written = 0
+    for _step in range(steps):
+        per = chunk // n_hosts
+        for h in range(n_hosts):
+            ts = BASE_TS + (written // n_hosts + np.arange(per,
+                            dtype=np.int64)) * 1_000_000_000
+            val = rng.normal(50, 10, per)
+            wb = WriteBatch()
+            wb.add_series("dash", SeriesRows(
+                SeriesKey("dash", {"host": f"host_{h}"}), ts,
+                {"value": (int(ValueType.FLOAT), val)}))
+            coord.write_points(tenant, db, wb)
+            all_ts.append(ts)
+            all_host.append(np.full(per, h))
+            all_val.append(val)
+        written += per * n_hosts
+        coord.engine.flush_all()
+        me.refresh("bench_dash",
+                   now_ns=int(max(t[-1] for t in all_ts)) + delay_ns + 1)
+
+        ts_a = np.concatenate(all_ts)
+        host_a = np.concatenate(all_host)
+        val_a = np.concatenate(all_val)
+        bucket = ts_a // 60_000_000_000 * 60_000_000_000
+
+        def check(rs, host_a=host_a, val_a=val_a, bucket=bucket):
+            assert rs.n_rows == len(set(zip(bucket.tolist(),
+                                            host_a.tolist()))), \
+                f"group count {rs.n_rows}"
+            assert np.isclose(float(np.sum(_col(rs, "s"))),
+                              float(val_a.sum()), rtol=1e-9), "sum drift"
+            assert int(np.sum(_col(rs, "c"))) == len(val_a), "count drift"
+
+        hits0 = _mv.counters_snapshot().get("rewrite_hit", 0)
+        timings = {}
+        for mode, enabled in (("view_ms", True), ("noview_ms", False)):
+            executor.matview_rewrite_enabled = enabled
+            executor.execute_one(sql, session)            # warm-up
+            t0 = time.perf_counter()
+            rs = executor.execute_one(sql, session)
+            timings[mode] = round((time.perf_counter() - t0) * 1e3, 2)
+            check(rs)
+        executor.matview_rewrite_enabled = True
+        hits = _mv.counters_snapshot().get("rewrite_hit", 0) - hits0
+        out["history_rows"].append(written)
+        out["view_ms"].append(timings["view_ms"])
+        out["noview_ms"].append(timings["noview_ms"])
+        out.setdefault("view_hits", []).append(hits)
+
+    # 2 rewriteable queries per step (warm-up + timed) in view mode
+    out["view_hit_ratio"] = round(sum(out["view_hits"]) / (2 * steps), 3)
+    out["view_growth"] = round(out["view_ms"][-1]
+                               / max(out["view_ms"][0], 1e-6), 2)
+    out["noview_growth"] = round(out["noview_ms"][-1]
+                                 / max(out["noview_ms"][0], 1e-6), 2)
+    executor.execute_one("DROP MATERIALIZED VIEW bench_dash", session)
+    if prev_auto is None:
+        os.environ.pop("CNOSDB_MATVIEW_AUTO", None)
+    else:
+        os.environ["CNOSDB_MATVIEW_AUTO"] = prev_auto
+    return out
+
+
 def run_suites(executor, coord, tenant, db, session) -> dict:
     out: dict = {}
     t0 = time.perf_counter()
@@ -618,4 +727,9 @@ def run_suites(executor, coord, tenant, db, session) -> dict:
         out["suite_errors"] = errs
     out["clickbench_pass"] = f"{len(cb)}/43"
     out["tsbs_pass"] = f"{len(ts)}/13"
+    try:
+        out["dashboard"] = run_dashboard(executor, coord, tenant, db,
+                                         session)
+    except Exception as e:   # rollup-tier failure must not sink the run
+        out["dashboard"] = {"error": repr(e)[:200]}
     return out

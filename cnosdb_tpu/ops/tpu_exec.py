@@ -39,6 +39,44 @@ from ..utils import lockwatch
 
 _BATCH_CACHE_LOCK = lockwatch.Lock("tpu_exec.batch_cache")
 
+# process-wide memo observability (satellite of the materialized-rollup
+# plane: view-vs-memo hit rates must be comparable on /metrics). Counters
+# and the live-batch set share _BATCH_CACHE_LOCK with the memo itself —
+# every touch point already holds or takes that lock once.
+_MEMO_COUNTERS = {"hit": 0, "miss": 0, "evict": 0}
+import weakref as _weakref
+
+# id(batch) → batch, weakly held (ScanBatch is an eq dataclass, so not
+# hashable — keyed by identity; entries vanish with their batch)
+_memo_batches: "_weakref.WeakValueDictionary" = _weakref.WeakValueDictionary()
+
+
+def _memo_count(kind: str, n: int = 1) -> None:
+    with _BATCH_CACHE_LOCK:
+        _MEMO_COUNTERS[kind] = _MEMO_COUNTERS.get(kind, 0) + n
+
+
+def memo_counters_snapshot() -> dict:
+    with _BATCH_CACHE_LOCK:
+        return dict(_MEMO_COUNTERS)
+
+
+def memo_bytes() -> int:
+    """Resident bytes across every live batch's partial-agg memo."""
+    total = 0
+    with _BATCH_CACHE_LOCK:
+        batches = list(_memo_batches.values())
+    for b in batches:
+        partials = getattr(b, "_partials", None)
+        if not partials:
+            continue
+        for part in list(partials.values()):
+            for v in part.values():
+                nb = getattr(v, "nbytes", None)
+                if nb is not None:
+                    total += int(nb)
+    return total
+
 
 def _FORCE_DEVICE() -> bool:
     import os
@@ -643,11 +681,12 @@ def launch_scan_aggregate(batch: ScanBatch, query: TpuQuery):
             if not memo_ok:
                 return None
             hit = partials.get((seg_key, cname))
-            if hit is None:
-                return None
-            for need in _wanted_keys(wants):
-                if need not in hit:
-                    return None
+            if hit is not None:
+                for need in _wanted_keys(wants):
+                    if need not in hit:
+                        hit = None
+                        break
+            _memo_count("hit" if hit is not None else "miss")
             return hit
 
         def memo_put(cname, r):
@@ -657,7 +696,9 @@ def launch_scan_aggregate(batch: ScanBatch, query: TpuQuery):
                     merged = {**old, **r} if old else dict(r)
                     while len(partials) >= 16:
                         partials.pop(next(iter(partials)))
+                        _MEMO_COUNTERS["evict"] += 1
                     partials[(seg_key, cname)] = merged
+                    _memo_batches[id(batch)] = batch
 
         col_results = {}
         for cname, wants in col_wants.items():

@@ -80,6 +80,8 @@ class Coordinator:
         self.distributed = not isinstance(meta, MetaStore)
         self.node_id = node_id if node_id is not None else meta.node_id
         self._replica_mgr = None  # built on first multi-replica write
+        # set by sql/matview.MatviewEngine; serves matview_partials RPCs
+        self.matview_maintainer = None
         # ScanBatch snapshots keyed by vnode data_version: repeated queries
         # reuse both the host batch and its device-resident twin (the
         # reference's TsmReader LRU cache, promoted to whole-scan snapshots

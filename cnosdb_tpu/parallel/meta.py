@@ -123,6 +123,7 @@ class MetaStore:
             {node_id: NodeInfo(node_id)} if register_self else {}
         self.streams: dict[str, dict] = {}  # stream name → definition
         self.stream_tables: dict[str, dict] = {}  # stream table → binding
+        self.matviews: dict[str, dict] = {}  # materialized view → definition
         self.members: dict[str, dict[str, str]] = {}  # tenant → {user → role}
         self.roles: dict[str, dict[str, dict]] = {}   # tenant → {role → spec}
         # external (file-backed) tables: owner → {name → {path, fmt, header}}
@@ -226,6 +227,7 @@ class MetaStore:
             "nodes": {str(k): v.to_dict() for k, v in self.nodes.items()},
             "streams": self.streams,
             "stream_tables": self.stream_tables,
+            "matviews": self.matviews,
             "members": self.members,
             "roles": self.roles,
             "externals": self.externals,
@@ -264,6 +266,7 @@ class MetaStore:
         self.nodes = {int(k): NodeInfo.from_dict(v) for k, v in d["nodes"].items()}
         self.streams = d.get("streams", {})
         self.stream_tables = d.get("stream_tables", {})
+        self.matviews = d.get("matviews", {})
         self.members = d.get("members", {})
         self.roles = d.get("roles", {})
         self.externals = d.get("externals", {})
@@ -1140,6 +1143,19 @@ class MetaStore:
     def drop_stream(self, name: str):
         with self.lock:
             if self.streams.pop(name, None) is not None:
+                self._persist()
+
+    # ------------------------------------------------- materialized views
+    def create_matview(self, name: str, definition: dict):
+        with self.lock:
+            if name in self.matviews:
+                raise MetaError(f"materialized view {name!r} exists")
+            self.matviews[name] = definition
+            self._persist()
+
+    def drop_matview(self, name: str):
+        with self.lock:
+            if self.matviews.pop(name, None) is not None:
                 self._persist()
 
     # ------------------------------------------------- stream tables

@@ -46,7 +46,8 @@ MUTATIONS = frozenset([
     "create_tenant", "drop_tenant", "create_user", "drop_user", "alter_user",
     "add_member", "remove_member", "create_database", "alter_database",
     "drop_database", "create_table", "update_table", "drop_table",
-    "create_stream", "drop_stream", "locate_bucket_for_write",
+    "create_stream", "drop_stream", "create_matview", "drop_matview",
+    "locate_bucket_for_write",
     "expire_buckets", "register_node", "report_heartbeat",
     "create_role", "drop_role", "grant_db_privilege", "revoke_db_privilege",
     "create_external_table", "drop_external_table",
@@ -607,6 +608,13 @@ class MetaClient:
 
     def drop_stream(self, name):
         return self._forward("drop_stream", name=name)
+
+    def create_matview(self, name, definition):
+        return self._forward("create_matview", name=name,
+                             definition=definition)
+
+    def drop_matview(self, name):
+        return self._forward("drop_matview", name=name)
 
     def register_node(self, node_id, grpc_addr="", http_addr=""):
         return self._forward("register_node", node_id=node_id,

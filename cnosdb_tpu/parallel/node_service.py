@@ -46,6 +46,7 @@ class DataNodeService:
             "vnode_drop": self._vnode_drop,
             "vnode_compact": self._vnode_compact,
             "vnode_checksum": self._vnode_checksum,
+            "matview_partials": self._matview_partials,
             "replica_change_membership": self._replica_change_membership,
             "replica_stepdown": self._replica_stepdown,
             "replica_progress": self._replica_progress,
@@ -75,6 +76,14 @@ class DataNodeService:
         reply = self.coord.replica_manager().handle_raft_msg(
             p["group"], p["to"], p["msg"])
         return {"reply": reply}
+
+    def _matview_partials(self, p):
+        """Sealed rollup partials for one LOCAL vnode (coordinator-side
+        subsumption rewrite fan-out)."""
+        me = getattr(self.coord, "matview_maintainer", None)
+        if me is None:
+            return {"hwm": None, "rows": []}
+        return me.partials_for(p["view"], p["owner"], p["vnode_id"])
 
     def _write_vnode(self, p):
         batch = WriteBatch.decode(p["data"])

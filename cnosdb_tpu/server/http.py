@@ -88,6 +88,7 @@ class HttpServer:
             web.get("/debug/backtrace", self.handle_backtrace),
             web.get("/debug/pprof", self.handle_pprof),
             web.get("/debug/scrub", self.handle_scrub),
+            web.get("/debug/matview", self.handle_matview),
             web.get("/debug/lockgraph", self.handle_lockgraph),
         ])
         # background integrity scrubber (storage/scrub.py), attached by
@@ -411,6 +412,39 @@ class HttpServer:
 
         loop = asyncio.get_running_loop()
         return web.json_response(await loop.run_in_executor(None, run))
+
+    async def handle_matview(self, request):
+        """Materialized-rollup admin surface: per-vnode watermarks and
+        group counts for `?name=`, every registered view without it.
+        `?refresh=1` forces a synchronous delta refresh first (with an
+        optional deterministic `?now_ns=`), `?verify=1` compares the
+        incremental state against a from-scratch recompute — the
+        crash/replay chaos oracle."""
+        self._require_admin(request)
+        me = self.executor.matview_engine()
+        name = request.query.get("name")
+        refresh = request.query.get("refresh", "0") not in ("0", "", "false")
+        verify = request.query.get("verify", "0") not in ("0", "", "false")
+        now_ns = request.query.get("now_ns")
+
+        def run():
+            me.sync_from_meta()
+            if name is None:
+                return {"views": sorted(me.views)}
+            out = {"name": name}
+            if refresh:
+                out["refreshed_vnodes"] = me.refresh(
+                    name, now_ns=int(now_ns) if now_ns else None)
+            out["status"] = me.status(name)
+            if verify:
+                out["verify"] = me.verify(name)
+            return out
+
+        loop = asyncio.get_running_loop()
+        try:
+            return web.json_response(await loop.run_in_executor(None, run))
+        except QueryError as e:
+            raise web.HTTPNotFound(text=str(e))
 
     async def handle_opentsdb_write(self, request):
         """OpenTSDB telnet-style put lines over HTTP (reference
@@ -839,6 +873,23 @@ class HttpServer:
 
         for name, n in lockwatch.counters_snapshot().items():
             self.metrics.set_gauge("cnosdb_lockwatch_total", n, kind=name)
+        # warm-agg memo + materialized rollups: only when the jax exec /
+        # matview modules are already resident — a metrics scrape must
+        # never be the thing that drags the kernel stack in
+        import sys as _sys
+
+        _tx = _sys.modules.get("cnosdb_tpu.ops.tpu_exec")
+        if _tx is not None:
+            self.metrics.set_gauge("cnosdb_agg_memo_bytes",
+                                   _tx.memo_bytes())
+            for name, n in _tx.memo_counters_snapshot().items():
+                self.metrics.set_gauge("cnosdb_agg_memo_total", n,
+                                       kind=name)
+        _mv = _sys.modules.get("cnosdb_tpu.sql.matview")
+        if _mv is not None:
+            for name, n in _mv.counters_snapshot().items():
+                self.metrics.set_gauge("cnosdb_matview_total", n,
+                                       kind=name)
         return web.Response(text=self.metrics.prometheus_text(),
                             content_type="text/plain")
 
@@ -1013,6 +1064,7 @@ def build_server(data_dir: str, auth_enabled: bool = False,
     engine.open_existing()
     executor = QueryExecutor(meta, coord)
     executor.restore_streams()  # persisted streams resume at their watermark
+    executor.restore_matviews()  # rollups resume flush-driven maintenance
     return HttpServer(meta, coord, executor, auth_enabled=auth_enabled,
                       query_cfg=query_cfg)
 
@@ -1040,6 +1092,7 @@ def build_cluster_node(data_dir: str, meta_addr: str, node_id: int,
     meta.register_node(node_id, grpc_addr=node_svc.addr)
     meta.start_heartbeat()
     executor = QueryExecutor(meta, coord)
+    executor.restore_matviews()  # rollups resume flush-driven maintenance
     server = HttpServer(meta, coord, executor, auth_enabled=auth_enabled,
                         query_cfg=query_cfg)
     server.node_service = node_svc

@@ -370,6 +370,24 @@ class DropStream:
 
 
 @dataclass
+class CreateMatView:
+    """CREATE MATERIALIZED VIEW name [WATERMARK DELAY '...'] AS SELECT —
+    a durable incremental rollup (sql/matview.py)."""
+
+    name: str
+    select: "SelectStmt"
+    select_sql: str                 # raw text (persisted definition)
+    delay_ns: int = 0
+    if_not_exists: bool = False
+
+
+@dataclass
+class DropMatView:
+    name: str
+    if_exists: bool = False
+
+
+@dataclass
 class CompactStmt:
     database: str | None = None
 

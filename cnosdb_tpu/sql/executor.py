@@ -158,6 +158,13 @@ class QueryExecutor:
         self.memory_pool = memory_pool or DEFAULT_POOL
         self._stream_engine = None
         self._stream_lock = _th.Lock()
+        self._matview_engine = None
+        self._matview_lock = _th.Lock()
+        # planner consults materialized rollups unless disabled (the
+        # rewrite is bit-identical, so this is an escape hatch, not a
+        # correctness knob)
+        self.matview_rewrite_enabled = \
+            os.environ.get("CNOSDB_MATVIEW_REWRITE", "1") != "0"
 
     # ------------------------------------------------------------------ api
     def execute_sql(self, sql: str, session: Session | None = None) -> list[ResultSet]:
@@ -448,6 +455,10 @@ class QueryExecutor:
             se.drop(stmt.name)
             self.meta.drop_stream(stmt.name)
             return ResultSet.message("ok")
+        if isinstance(stmt, ast.CreateMatView):
+            return self._create_matview(stmt, session)
+        if isinstance(stmt, ast.DropMatView):
+            return self._drop_matview(stmt)
         if isinstance(stmt, ast.KillQuery):
             ctx = self.tracker.ctx_of(stmt.query_id)
             ok = self.tracker.kill(stmt.query_id)
@@ -595,6 +606,53 @@ class QueryExecutor:
 
                 logging.getLogger("cnosdb.stream").exception(
                     "failed to restore stream %s", name)
+
+    # ------------------------------------------------------- materialized views
+    def matview_engine(self):
+        if self._matview_engine is None:
+            with self._matview_lock:
+                if self._matview_engine is None:
+                    from .matview import MatviewEngine
+
+                    self._matview_engine = MatviewEngine(
+                        self, os.path.join(self.coord.engine.data_dir,
+                                           "matviews"))
+        return self._matview_engine
+
+    def _create_matview(self, stmt: ast.CreateMatView, session: Session):
+        from .matview import compile_view
+
+        me = self.matview_engine()
+        me.sync_from_meta()
+        if stmt.name in me.views:
+            if stmt.if_not_exists:
+                return ResultSet.message("ok")
+            raise ExecutionError(
+                f"materialized view {stmt.name!r} exists")
+        db = stmt.select.database or session.database
+        # eligibility is validated NOW (aggregate shape, mergeable
+        # partials) — an ineligible view must fail the CREATE
+        vdef = compile_view(stmt.name, stmt.select, stmt.select_sql,
+                            stmt.delay_ns, session.tenant, db, self.meta)
+        vdef.user = session.user
+        self.meta.create_matview(stmt.name, vdef.definition())
+        me.register(vdef)
+        return ResultSet.message("ok")
+
+    def _drop_matview(self, stmt: ast.DropMatView):
+        me = self.matview_engine()
+        me.sync_from_meta()
+        if stmt.name not in me.views and not stmt.if_exists:
+            raise ExecutionError(
+                f"unknown materialized view {stmt.name!r}")
+        self.meta.drop_matview(stmt.name)
+        me.drop(stmt.name)
+        return ResultSet.message("ok")
+
+    def restore_matviews(self):
+        """Instantiate the maintainer on boot so persisted views resume
+        flush-driven maintenance (cheap: no jax imports)."""
+        self.matview_engine().sync_from_meta()
 
     # ------------------------------------------------------------------ DDL
     def _create_database(self, stmt: ast.CreateDatabase, session: Session):
@@ -850,6 +908,18 @@ class QueryExecutor:
                            else "<callback>" for n in names], dtype=object),
                  np.array([se.streams[n].interval_s for n in names]),
                  np.array([se.streams[n].sql[:120] for n in names], dtype=object)])
+        if stmt.kind == "matviews":
+            me = self.matview_engine()
+            me.sync_from_meta()
+            names = sorted(me.views)
+            views = [me.views[n] for n in names]
+            return ResultSet(
+                ["view_name", "table", "delay_ns", "query"],
+                [np.array(names, dtype=object),
+                 np.array([v.table for v in views], dtype=object),
+                 np.array([v.delay_ns for v in views], dtype=np.int64),
+                 np.array([v.select_sql[:120] for v in views],
+                          dtype=object)])
         if stmt.kind == "roles":
             roles = self.meta.list_roles(session.tenant)
             names = sorted(roles)
@@ -2927,6 +2997,20 @@ class QueryExecutor:
                                | (plan.filter.columns()
                                   & set(plan.schema.field_names())
                                   if plan.filter else set()))
+        rw = self._matview_rewrite(plan, phys_aggs, tenant, db)
+        if rw is not None:
+            # sealed buckets come pre-aggregated from the view; only the
+            # unsealed tail / unaligned range edges hit raw storage
+            batches = [] if rw.scan_ranges.is_empty else \
+                self.coord.scan_table(
+                    tenant, db, plan.table, time_ranges=rw.scan_ranges,
+                    tag_domains=plan.tag_domains,
+                    field_names=needed_fields, page_filter=plan.filter)
+            with self.memory_pool.reservation(_batches_bytes(batches),
+                                              f"scan of {plan.table}"):
+                return self._exec_aggregate_seeded(plan, batches,
+                                                   phys_aggs, finalize,
+                                                   rw.acc)
         batches = self.coord.scan_table(
             tenant, db, plan.table, time_ranges=plan.time_ranges,
             tag_domains=plan.tag_domains, field_names=needed_fields,
@@ -2935,6 +3019,55 @@ class QueryExecutor:
                                           f"scan of {plan.table}"):
             return self._exec_aggregate_batches(plan, batches, phys_aggs,
                                                 finalize)
+
+    def _matview_rewrite(self, plan, phys_aggs, tenant: str, db: str):
+        """Try the materialized-rollup subsumption rewrite; None keeps
+        the raw-scan path. Zero-cost while the catalog has no views."""
+        if not self.matview_rewrite_enabled or plan.gapfill:
+            return None
+        try:
+            if not getattr(self.meta, "matviews", None):
+                return None
+        except Exception:
+            return None
+        from .matview import MERGEABLE_FUNCS
+
+        if any(a.func not in MERGEABLE_FUNCS for a in phys_aggs):
+            return None
+        try:
+            return self.matview_engine().rewrite(plan, phys_aggs,
+                                                 tenant, db)
+        except Exception:
+            # the rewrite is an optimization: any failure inside it must
+            # degrade to the (always-correct) raw scan, visibly counted
+            stages.count_error("matview.rewrite")
+            return None
+
+    def _exec_aggregate_seeded(self, plan, batches, phys_aggs, finalize,
+                               acc: dict):
+        """Finish an aggregate whose accumulator was seeded from sealed
+        view buckets: fold the residual raw batches through the same
+        partial-merge path, then finalize normally (bit-identical to a
+        full scan)."""
+        from ..ops.tpu_exec import finish_scan_aggregate, launch_scan_aggregate
+
+        ncpu = os.cpu_count() or 1
+        q = TpuQuery(filter=plan.filter, group_tags=plan.group_tags,
+                     group_fields=plan.group_fields,
+                     time_bucket=plan.bucket,
+                     kernel_threads=max(1, ncpu // max(1, min(8,
+                                                              len(batches) or 1))),
+                     aggs=phys_aggs)
+        jobs = [launch_scan_aggregate(batch, q) for batch in batches]
+        with stages.stage("merge_ms"):
+            for job in jobs:
+                self._poll_cancel()
+                r = finish_scan_aggregate(job)
+                _merge_partial(acc, r, plan, phys_aggs)
+        if not acc and not plan.group_tags \
+                and not plan.group_fields and plan.bucket is None:
+            acc[()] = {}  # SQL: a global aggregate always yields one row
+        return self._finalize_aggregate(plan, acc, finalize)
 
     def _exec_aggregate_batches(self, plan, batches, phys_aggs, finalize):
         host_funcs = ("count_distinct", "collect", "collect_ts",

@@ -867,6 +867,24 @@ class Parser:
                 self.accept_op("=")
                 engine = self.expect_ident().lower()
             return ast.CreateStreamTable(name, columns, opts, engine, ine)
+        if k == "MATERIALIZED":
+            # CREATE MATERIALIZED VIEW [IF NOT EXISTS] name
+            #   [WATERMARK DELAY '<interval>'] AS SELECT ...
+            self.next()
+            self.expect_kw("VIEW")
+            ine = self._if_not_exists()
+            name = self.expect_ident()
+            delay_ns = 0
+            if self.accept_kw("WATERMARK"):
+                self.expect_kw("DELAY")
+                delay_ns = parse_interval_string(self.expect_string())
+            self.expect_kw("AS")
+            start_pos = self.peek().pos
+            select = self.parse_select()
+            end_pos = self.peek().pos
+            return ast.CreateMatView(name, select,
+                                     self.sql[start_pos:end_pos].strip(),
+                                     delay_ns, ine)
         if k == "STREAM":
             self.next()
             ine = self._if_not_exists()
@@ -993,6 +1011,11 @@ class Parser:
             self.next()
             ie = self._if_exists()
             return ast.DropStream(self.expect_ident(), ie)
+        if k == "MATERIALIZED":
+            self.next()
+            self.expect_kw("VIEW")
+            ie = self._if_exists()
+            return ast.DropMatView(self.expect_ident(), ie)
         if k == "TENANT":
             self.next()
             ie = self._if_exists()
@@ -1310,6 +1333,10 @@ class Parser:
         if k == "STREAMS":
             self.next()
             return ast.ShowStmt("streams")
+        if k == "MATERIALIZED":
+            self.next()
+            self.expect_kw("VIEWS")
+            return ast.ShowStmt("matviews")
         if k == "ROLES":
             self.next()
             return ast.ShowStmt("roles")

@@ -62,6 +62,12 @@ class WatermarkTracker:
         os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
         with open(tmp, "w") as f:
             json.dump(self.watermarks, f)
+            # fsync BEFORE the rename: os.replace is only atomic for
+            # data already on disk — a power loss after the rename but
+            # before writeback would otherwise leave an empty/torn file
+            # where a valid watermark used to be
+            f.flush()
+            os.fsync(f.fileno())
         os.replace(tmp, self.path)
 
 

@@ -42,6 +42,9 @@ class TsKv:
         self._compactor = ThreadPoolExecutor(workers,
                                              thread_name_prefix="compact")
         self._compact_pending: set[tuple[str, int]] = set()
+        # (owner, vnode_id) flush notifications — set by the materialized
+        # rollup maintainer; must be cheap and non-blocking
+        self.flush_listener = None
 
     # ---------------------------------------------------------------- vnodes
     def vnode_dir(self, owner: str, vnode_id: int) -> str:
@@ -58,8 +61,15 @@ class TsKv:
                     memcache_bytes=self.memcache_bytes,
                     wal_sync=self.wal_sync,
                     picker=self.picker or Picker())
+                v.on_flush = \
+                    lambda o=owner, vid=vnode_id: self._notify_flush(o, vid)
                 self.vnodes[key] = v
             return v
+
+    def _notify_flush(self, owner: str, vnode_id: int):
+        cb = self.flush_listener
+        if cb is not None:
+            cb(owner, vnode_id)
 
     def vnode(self, owner: str, vnode_id: int) -> VnodeStorage | None:
         v = self.vnodes.get((owner, vnode_id))

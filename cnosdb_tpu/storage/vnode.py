@@ -30,7 +30,7 @@ from .memcache import MemCache
 from .summary import Summary, VersionEdit
 from .tombstone import TombstoneEntry, TsmTombstone
 from .wal import Wal, WalEntryType
-from ..utils import lockwatch
+from ..utils import lockwatch, stages
 
 
 @dataclass(frozen=True)
@@ -75,6 +75,9 @@ class VnodeStorage:
         # the (file set, memcache seq) token: tombstone-writing deletes,
         # tag re-keys, snapshot installs, in-place memcache field edits
         self.destructive_version = 0
+        # post-flush callback set by the storage engine (materialized
+        # rollup maintenance); fired OUTSIDE the vnode lock
+        self.on_flush = None
         self._replay_wal()
 
     def scan_token(self) -> ScanToken:
@@ -178,10 +181,12 @@ class VnodeStorage:
 
     def flush(self, sync: bool = True):
         """Rotate active cache and persist ALL immutables to L0 files."""
+        flushed = False
         with self.lock:
             self.switch_to_immutable()
             if self.immutables:
                 self.data_version += 1
+                flushed = True
             for cache in self.immutables:
                 fid = self.summary.next_file_id()
                 path = os.path.join(self.dir, "delta", f"_{fid:06d}.tsm")
@@ -192,6 +197,13 @@ class VnodeStorage:
             self.index.sync()
             self.wal.sync()
             self.wal.purge_to(self.summary.version.flushed_seq + 1)
+        cb = self.on_flush
+        if flushed and cb is not None:
+            # outside the lock: listeners must never block the write path
+            try:
+                cb()
+            except Exception:
+                stages.count_error("flush.listener")
 
     def rename_mem_field(self, table: str, old: str, new: str):
         """ALTER ... RENAME COLUMN: re-key buffered (unflushed) rows so

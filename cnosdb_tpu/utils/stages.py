@@ -59,6 +59,17 @@ STAGE_CATALOG: dict[str, str] = {
                         "device batch (compile/derive skipped)",
     "kernel_cache.miss": "segment-geometry/program cache misses "
                          "(derived data rebuilt, jit may recompile)",
+    "matview.refresh_ms": "materialized-rollup delta refresh (scan the "
+                          "[hwm, watermark) slice + fold + persist)",
+    "matview.delta_rows": "raw rows folded into rollup partials by delta "
+                          "refreshes (full-history's worth means the "
+                          "watermark is not advancing)",
+    "matview.hit": "aggregate queries rewritten to read sealed buckets "
+                   "from a materialized rollup",
+    "matview.miss": "rewrite-eligible aggregate queries no registered "
+                    "view subsumed (raw scan)",
+    "matview.seed_groups": "accumulator groups seeded from sealed view "
+                           "buckets by rewritten queries",
 }
 
 # Prefixes for names composed at runtime (skipped by the literal lint

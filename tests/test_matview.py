@@ -1,0 +1,386 @@
+"""Materialized rollup plane: CREATE/SHOW/DROP lifecycle, subsumption
+rewrite bit-identity, late-data watermark semantics, durable per-vnode
+state across restart, and the crash/replay chaos oracle (slow-marked).
+
+The fast suite runs everything in-process with CNOSDB_MATVIEW_AUTO=0 and
+explicit ``now_ns`` so watermark advancement is deterministic against the
+~1970 synthetic timestamps; the chaos test spawns a real node process and
+injects a crash at the ``matview.persist`` fault site (power loss between
+writing the tmp state file and the atomic rename).
+"""
+import glob
+import json
+import os
+import time
+import urllib.error
+
+import pytest
+
+from cnosdb_tpu.errors import QueryError
+from cnosdb_tpu.parallel.coordinator import Coordinator
+from cnosdb_tpu.parallel.meta import MetaStore
+from cnosdb_tpu.sql import matview
+from cnosdb_tpu.sql.executor import QueryExecutor
+from cnosdb_tpu.sql.stream import WatermarkTracker
+from cnosdb_tpu.storage.engine import TsKv
+
+
+SEC = 10**9
+MIN = 60 * SEC
+
+
+@pytest.fixture
+def db(tmp_path, monkeypatch):
+    monkeypatch.setenv("CNOSDB_MATVIEW_AUTO", "0")
+    meta = MetaStore(str(tmp_path / "meta.json"))
+    engine = TsKv(str(tmp_path / "data"))
+    coord = Coordinator(meta, engine)
+    ex = QueryExecutor(meta, coord)
+    ex.execute_one("CREATE TABLE m (v DOUBLE, TAGS(h))")
+    yield ex
+    coord.close()
+
+
+def _seed(db, n=200, start=0):
+    # i.5 values: sums of halves stay exact in binary FP, so incremental
+    # vs from-scratch aggregation must be bit-identical, not just close
+    rows = ", ".join(f"({(start + i) * SEC}, 'h{(start + i) % 4}', "
+                     f"{start + i}.5)" for i in range(n))
+    db.execute_one(f"INSERT INTO m (time, h, v) VALUES {rows}")
+
+
+def _mk_view(db, name="mv1", delay="10s"):
+    db.execute_one(
+        f"CREATE MATERIALIZED VIEW {name} WATERMARK DELAY '{delay}' AS "
+        "SELECT date_bin(INTERVAL '1 minute', time) AS t, h, "
+        "sum(v), count(v) FROM m GROUP BY t, h")
+
+
+def _refresh(db, name="mv1", now_ns=None):
+    return db.matview_engine().refresh(name, now_ns=now_ns)
+
+
+def _both(db, q):
+    """(rows with rewrite, rows without) — repr-compared for bit identity."""
+    db.matview_rewrite_enabled = True
+    a = db.execute_one(q).rows()
+    db.matview_rewrite_enabled = False
+    b = db.execute_one(q).rows()
+    db.matview_rewrite_enabled = True
+    return sorted(map(repr, a)), sorted(map(repr, b))
+
+
+# ------------------------------------------------------------- lifecycle
+def test_create_show_drop(db):
+    _mk_view(db)
+    rs = db.execute_one("SHOW MATERIALIZED VIEWS")
+    rows = rs.rows()
+    assert len(rows) == 1
+    row = dict(zip(rs.names, rows[0]))
+    assert row["view_name"] == "mv1" and row["table"] == "m"
+    assert int(row["delay_ns"]) == 10 * SEC
+    assert "sum(v)" in row["query"]
+
+    with pytest.raises(QueryError):
+        _mk_view(db)                     # duplicate
+    db.execute_one(
+        "CREATE MATERIALIZED VIEW IF NOT EXISTS mv1 AS "
+        "SELECT date_bin(INTERVAL '1 minute', time) AS t, sum(v) "
+        "FROM m GROUP BY t")             # no-op, keeps original def
+
+    db.execute_one("DROP MATERIALIZED VIEW mv1")
+    assert db.execute_one("SHOW MATERIALIZED VIEWS").rows() == []
+    with pytest.raises(QueryError):
+        db.execute_one("DROP MATERIALIZED VIEW mv1")
+    db.execute_one("DROP MATERIALIZED VIEW IF EXISTS mv1")   # no-op
+
+
+def test_ineligible_definitions_rejected(db):
+    bad = [
+        # WHERE: deltas would need the predicate re-applied to raw rows
+        "CREATE MATERIALIZED VIEW b AS SELECT date_bin(INTERVAL '1 minute',"
+        " time) AS t, sum(v) FROM m WHERE v > 1 GROUP BY t",
+        # no time bucket: nothing ever seals
+        "CREATE MATERIALIZED VIEW b AS SELECT h, sum(v) FROM m GROUP BY h",
+        # median has no mergeable partial form
+        "CREATE MATERIALIZED VIEW b AS SELECT date_bin(INTERVAL '1 minute',"
+        " time) AS t, median(v) FROM m GROUP BY t",
+        # count(DISTINCT) partials are not mergeable either
+        "CREATE MATERIALIZED VIEW b AS SELECT date_bin(INTERVAL '1 minute',"
+        " time) AS t, count(DISTINCT v) FROM m GROUP BY t",
+        # LIMIT makes the state order-dependent
+        "CREATE MATERIALIZED VIEW b AS SELECT date_bin(INTERVAL '1 minute',"
+        " time) AS t, sum(v) FROM m GROUP BY t LIMIT 3",
+    ]
+    for sql in bad:
+        with pytest.raises(QueryError):
+            db.execute_one(sql)
+    assert db.execute_one("SHOW MATERIALIZED VIEWS").rows() == []
+
+
+# ------------------------------------------------------ subsumption rewrite
+def test_rewrite_bit_identical_across_query_shapes(db):
+    _seed(db)
+    _mk_view(db)
+    db.execute_one(
+        "CREATE MATERIALIZED VIEW mv2 AS "
+        "SELECT date_bin(INTERVAL '1 minute', time) AS t, h, max(v), "
+        "min(v), first(time, v), last(time, v), avg(v) FROM m GROUP BY t, h")
+    db.coord.engine.flush_all()
+    now = 200 * SEC + 10 * SEC + 1
+    _refresh(db, "mv1", now)
+    _refresh(db, "mv2", now)
+    queries = [
+        # same grain, grouped
+        "SELECT date_bin(INTERVAL '1 minute', time) AS t, h, sum(v) AS s, "
+        "count(v) AS c FROM m GROUP BY t, h ORDER BY t, h",
+        # coarser origin-congruent re-bucket
+        "SELECT date_bin(INTERVAL '2 minutes', time) AS t, sum(v) AS s "
+        "FROM m GROUP BY t ORDER BY t",
+        # global (no bucket, no tags)
+        "SELECT sum(v) AS s, count(v) AS c FROM m",
+        # range: sealed span from the view + residual edges from raw
+        f"SELECT h, sum(v) AS s FROM m WHERE time >= {30 * SEC} "
+        f"AND time < {170 * SEC} GROUP BY h ORDER BY h",
+        # residual tag filters, decided per sealed group
+        "SELECT h, sum(v) AS s FROM m WHERE h = 'h1' GROUP BY h",
+        "SELECT h, sum(v) AS s FROM m WHERE h != 'h0' GROUP BY h ORDER BY h",
+        "SELECT sum(v) AS s FROM m WHERE h = 'h1' OR h = 'h2'",
+        # the mv2 agg family
+        "SELECT h, max(v) AS mx, min(v) AS mn FROM m GROUP BY h ORDER BY h",
+        "SELECT h, first(time, v) AS f, last(time, v) AS l FROM m "
+        "GROUP BY h ORDER BY h",
+        "SELECT h, avg(v) AS a FROM m GROUP BY h ORDER BY h",
+    ]
+    for q in queries:
+        before = matview.counters_snapshot().get("rewrite_hit", 0)
+        a, b = _both(db, q)
+        assert a == b, q
+        assert matview.counters_snapshot().get("rewrite_hit", 0) \
+            == before + 1, q
+
+
+def test_rewrite_misses_when_ineligible(db):
+    _seed(db)
+    _mk_view(db)
+    db.coord.engine.flush_all()
+    _refresh(db, now_ns=200 * SEC + 10 * SEC + 1)
+    misses = [
+        # field predicate: must see raw rows
+        "SELECT h, sum(v) AS s FROM m WHERE v > 50 GROUP BY h ORDER BY h",
+        # finer bucket than the view's grain
+        "SELECT date_bin(INTERVAL '30 seconds', time) AS t, sum(v) AS s "
+        "FROM m GROUP BY t ORDER BY t",
+        # agg the view does not carry
+        "SELECT h, max(v) AS mx FROM m GROUP BY h ORDER BY h",
+    ]
+    for q in misses:
+        before = matview.counters_snapshot().get("rewrite_hit", 0)
+        a, b = _both(db, q)
+        assert a == b, q
+        assert matview.counters_snapshot().get("rewrite_hit", 0) \
+            == before, q
+
+
+def test_unsealed_tail_merges_with_sealed_buckets(db):
+    _seed(db, n=120)
+    _mk_view(db)
+    db.coord.engine.flush_all()
+    # seal only the first minute: hwm = align_down(90s - 10s) = 60s
+    _refresh(db, now_ns=90 * SEC)
+    assert db.matview_engine().status("mv1")["vnodes"]
+    q = "SELECT h, sum(v) AS s, count(v) AS c FROM m GROUP BY h ORDER BY h"
+    before = matview.counters_snapshot().get("rewrite_hit", 0)
+    a, b = _both(db, q)
+    assert a == b
+    assert matview.counters_snapshot().get("rewrite_hit", 0) == before + 1
+
+
+def test_late_data_within_watermark_delay(db):
+    _seed(db, n=60)
+    _mk_view(db, delay="30s")
+    db.coord.engine.flush_all()
+    # hwm = align_down(80s - 30s) = 0: nothing sealed yet
+    _refresh(db, now_ns=80 * SEC)
+    # rows 60..89 land "late" but inside the delay window — they are
+    # still above the hwm, so the next refresh folds them exactly once
+    _seed(db, n=30, start=60)
+    db.coord.engine.flush_all()
+    _refresh(db, now_ns=150 * SEC)       # seals [0, 120s)
+    rep = db.matview_engine().verify("mv1")
+    assert rep["equal"], rep
+    a, b = _both(db, "SELECT h, sum(v) AS s, count(v) AS c FROM m "
+                     "GROUP BY h ORDER BY h")
+    assert a == b
+
+
+def test_refresh_is_delta_only_and_idempotent(db):
+    _seed(db, n=60)
+    _mk_view(db)
+    db.coord.engine.flush_all()
+    c0 = matview.counters_snapshot().get("delta_rows", 0)
+    _refresh(db, now_ns=80 * SEC)        # seals [0, 60s): 60 rows
+    c1 = matview.counters_snapshot().get("delta_rows", 0)
+    assert c1 - c0 == 60
+    _refresh(db, now_ns=80 * SEC)        # same watermark: no delta
+    assert matview.counters_snapshot().get("delta_rows", 0) == c1
+    _seed(db, n=60, start=60)
+    db.coord.engine.flush_all()
+    _refresh(db, now_ns=140 * SEC)       # advances to 120s: 60 more rows
+    assert matview.counters_snapshot().get("delta_rows", 0) - c1 == 60
+    assert db.matview_engine().verify("mv1")["equal"]
+
+
+def test_drop_cleans_persisted_state(db, tmp_path):
+    _seed(db, n=60)
+    _mk_view(db)
+    db.coord.engine.flush_all()
+    _refresh(db, now_ns=80 * SEC)
+    pat = str(tmp_path / "data" / "**" / "matview" / "*")
+    assert glob.glob(pat, recursive=True)
+    tracker = db.matview_engine().tracker
+    assert any(k.startswith("mv1@") for k in tracker.watermarks)
+    db.execute_one("DROP MATERIALIZED VIEW mv1")
+    assert glob.glob(pat, recursive=True) == []
+    assert not any(k.startswith("mv1@") for k in tracker.watermarks)
+
+
+def test_torn_state_file_degrades_to_raw_scan(db, tmp_path):
+    _seed(db, n=60)
+    _mk_view(db)
+    db.coord.engine.flush_all()
+    _refresh(db, now_ns=80 * SEC)
+    paths = glob.glob(str(tmp_path / "data" / "**" / "matview" / "*.json"),
+                      recursive=True)
+    assert paths
+    for p in paths:
+        with open(p, "w") as f:
+            f.write('{"hwm": 123, "rows": [[["h0",')   # torn mid-write
+    me = db.matview_engine()
+    with me._lock:
+        me._states.clear()               # force reload from disk
+    q = "SELECT h, sum(v) AS s FROM m GROUP BY h ORDER BY h"
+    before = matview.counters_snapshot().get("rewrite_hit", 0)
+    a, b = _both(db, q)
+    assert a == b                        # correct, just slower
+    assert matview.counters_snapshot().get("rewrite_hit", 0) == before
+
+
+# ----------------------------------------------------- restart durability
+def test_restart_restores_definition_and_state(tmp_path, monkeypatch):
+    monkeypatch.setenv("CNOSDB_MATVIEW_AUTO", "0")
+    meta = MetaStore(str(tmp_path / "meta.json"))
+    engine = TsKv(str(tmp_path / "data"))
+    coord = Coordinator(meta, engine)
+    ex = QueryExecutor(meta, coord)
+    ex.execute_one("CREATE TABLE m (v DOUBLE, TAGS(h))")
+    _seed(ex, n=120)
+    _mk_view(ex)
+    coord.engine.flush_all()
+    _refresh(ex, now_ns=140 * SEC)
+    hwm_before = ex.matview_engine().status("mv1")["vnodes"]
+    assert any(v["hwm"] is not None for v in hwm_before.values())
+    coord.close()
+
+    meta2 = MetaStore(str(tmp_path / "meta.json"))
+    engine2 = TsKv(str(tmp_path / "data"))
+    coord2 = Coordinator(meta2, engine2)
+    ex2 = QueryExecutor(meta2, coord2)
+    try:
+        ex2.restore_matviews()           # what build_server does on boot
+        me = ex2.matview_engine()
+        assert "mv1" in me.views
+        assert me.status("mv1")["vnodes"] == hwm_before
+        assert me.verify("mv1")["equal"]
+        # delta maintenance resumes from the persisted hwm, not zero
+        c0 = matview.counters_snapshot().get("delta_rows", 0)
+        _seed(ex2, n=30, start=120)
+        coord2.engine.flush_all()
+        me.refresh("mv1", now_ns=200 * SEC)
+        assert matview.counters_snapshot().get("delta_rows", 0) - c0 == 30
+        db = ex2
+        a, b = _both(db, "SELECT h, sum(v) AS s FROM m GROUP BY h "
+                         "ORDER BY h")
+        assert a == b
+    finally:
+        coord2.close()
+
+
+# -------------------------------------------------------------- satellites
+def test_watermark_tracker_persist_is_atomic(tmp_path):
+    path = str(tmp_path / "wm.json")
+    t = WatermarkTracker(path)
+    t.set("mv1@t.db:1", 12345)
+    assert not os.path.exists(path + ".tmp")     # fsync'd then renamed
+    assert WatermarkTracker(path).watermarks["mv1@t.db:1"] == 12345
+    with open(path) as f:
+        json.load(f)                             # valid JSON on disk
+
+
+def test_agg_memo_counters_exposed(db):
+    from cnosdb_tpu.ops import tpu_exec
+    snap = tpu_exec.memo_counters_snapshot()
+    assert set(snap) == {"hit", "miss", "evict"}
+    assert all(isinstance(v, int) and v >= 0 for v in snap.values())
+    assert isinstance(tpu_exec.memo_bytes(), int)
+    _seed(db, n=60)
+    db.coord.engine.flush_all()
+    db.execute_one("SELECT h, sum(v) FROM m GROUP BY h")
+    after = tpu_exec.memo_counters_snapshot()
+    assert sum(after.values()) >= sum(snap.values())
+    # monotone: counters never go backwards
+    assert all(after[k] >= snap[k] for k in snap)
+
+
+# ------------------------------------------------------------ chaos (slow)
+@pytest.mark.slow
+@pytest.mark.cluster
+def test_crash_during_persist_then_replay_is_exact(tmp_path):
+    """Power loss between writing the tmp state file and the atomic
+    rename: the tracker never ran ahead of the state, so after restart a
+    refresh replays the delta and the incremental view must equal a
+    from-scratch recompute bit-for-bit."""
+    from cluster_harness import Cluster
+    from cnosdb_tpu.parallel.net import rpc_call
+
+    os.environ["CNOSDB_FAULTS"] = "seed=7"
+    try:
+        cluster = Cluster(str(tmp_path / "c"), n_nodes=1).start()
+    finally:
+        del os.environ["CNOSDB_FAULTS"]
+    try:
+        n = cluster.nodes[0]
+        n.sql("CREATE TABLE c (v DOUBLE, TAGS(h))")
+        lines = "\n".join(f"c,h=h{i % 3} v={i}.5 {i * SEC}"
+                          for i in range(180))
+        n.write_lp(lines)
+        n.sql("CREATE MATERIALIZED VIEW cmv WATERMARK DELAY '10s' AS "
+              "SELECT date_bin(INTERVAL '1 minute', time) AS t, h, "
+              "sum(v), count(v) FROM c GROUP BY t, h")
+        oracle = {f"h{h}": sum(i + 0.5 for i in range(180) if i % 3 == h)
+                  for h in range(3)}
+
+        rpc_call(f"127.0.0.1:{n.rpc_port}", "_faults",
+                 {"spec": "matview.persist:crash:once"}, timeout=5.0)
+        now = 180 * SEC + 10 * SEC + 1
+        with pytest.raises(Exception):   # connection dies with the process
+            n.http("GET", f"/debug/matview?name=cmv&refresh=1&now_ns={now}")
+        deadline = time.monotonic() + 20
+        while n.proc.poll() is None and time.monotonic() < deadline:
+            time.sleep(0.1)
+        assert n.proc.poll() is not None, "crash fault did not fire"
+        n.proc = None
+
+        n.start().wait_ready()
+        out = json.loads(n.http(
+            "GET",
+            f"/debug/matview?name=cmv&refresh=1&verify=1&now_ns={now}"))
+        assert out["verify"]["equal"], out["verify"]
+        assert any(v["hwm"] == 180 * SEC
+                   for v in out["status"]["vnodes"].values())
+        rows = [l.split(",") for l in n.sql(
+            "SELECT h, sum(v) FROM c GROUP BY h ORDER BY h"
+        ).strip().splitlines()[1:]]
+        assert {r[0]: float(r[1]) for r in rows} == oracle
+    finally:
+        cluster.stop()
