@@ -524,6 +524,107 @@ def _persist_device_evidence(device: dict):
         pass   # evidence capture must never sink the bench
 
 
+def decode_bench():
+    """Per-codec cold-decode micro-bench: MB/s of decoded output through
+    each of the three scan lanes — host (pure numpy, native library
+    masked), native (pagedec/codec C++ where built), and device
+    (ops/device_decode batched kernels, interpret on CPU hosts). The
+    same encoded blocks feed every lane, so BENCH_r0x shows lane-relative
+    decode throughput per codec, not workload noise."""
+    from cnosdb_tpu.models.codec import Encoding
+    from cnosdb_tpu.models.schema import ValueType
+    from cnosdb_tpu.ops import device_decode
+    from cnosdb_tpu.storage import codecs, native
+
+    rng = np.random.default_rng(7)
+    n_pages, page_len = 32, 8192
+    cases = {}
+    ints = rng.integers(-1000, 1000,
+                        size=(n_pages, page_len)).cumsum(axis=1)
+    cases["delta_i64"] = (ValueType.INTEGER, [
+        codecs.encode(row, ValueType.INTEGER, Encoding.DELTA)
+        for row in ints])
+    ts = (np.arange(page_len, dtype=np.int64) * 1_000_000)[None, :] \
+        + rng.integers(0, 1 << 40, size=(n_pages, 1))
+    cases["delta_ts_const"] = (ValueType.INTEGER, [
+        codecs.encode_timestamps(row) for row in ts])
+    floats = rng.normal(20.0, 5.0, size=(n_pages, page_len)).round(2)
+    cases["gorilla_f64"] = (ValueType.FLOAT, [
+        codecs.encode(row, ValueType.FLOAT, Encoding.GORILLA)
+        for row in floats])
+    bools = rng.random(size=(n_pages, page_len)) < 0.5
+    cases["bitpack_bool"] = (ValueType.BOOLEAN, [
+        codecs.encode(row, ValueType.BOOLEAN, Encoding.BITPACK)
+        for row in bools])
+    words = np.array(["ok", "warn", "err", "crit"], dtype=object)
+    strs = rng.choice(words, size=(n_pages, page_len))
+    cases["dict_string"] = (ValueType.STRING, [
+        codecs.encode(row, ValueType.STRING) for row in strs])
+
+    def timed(fn, reps=3):
+        fn()   # warm (jit compiles count against no lane)
+        best = None
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            fn()
+            dt = time.perf_counter() - t0
+            best = dt if best is None else min(best, dt)
+        return best
+
+    def host_lane(blocks, vt):
+        for b in blocks:
+            codecs.decode(b, vt)
+
+    def device_lane(blocks, vt):
+        lane = device_decode.DeviceDecodeLane(interpret=True)
+        if vt in (ValueType.STRING, ValueType.GEOMETRY):
+            out_vals = out_valid = None
+        else:
+            out_vals = np.empty(n_pages * page_len, vt.numpy_dtype())
+            out_valid = np.empty(n_pages * page_len, bool)
+        for i, b in enumerate(blocks):
+            plan, reason = codecs.split_for_device(b, vt)
+            assert plan is not None, reason
+            sink = (lambda dense: None) if out_vals is None else None
+            lane.submit(plan, i, "c", vt, i * page_len, page_len, None,
+                        out_vals, out_valid, sink=sink)
+        failed = lane.run()
+        assert not failed, f"{len(failed)} device pages failed"
+
+    out = {"n_pages": n_pages, "page_len": page_len, "codecs": {}}
+    for name, (vt, blocks) in cases.items():
+        itemsize = 8 if vt != ValueType.BOOLEAN else 1
+        if vt == ValueType.STRING:
+            itemsize = 4   # device lane materializes i32 codes
+        out_mb = n_pages * page_len * itemsize / 1e6
+        row = {"out_mb": round(out_mb, 2)}
+        native.available()   # force the load attempt BEFORE masking
+        lib_saved, tried_saved = native._LIB, native._TRIED
+        try:
+            native._LIB = None   # mask the C++ codecs: pure-numpy lane
+            native._TRIED = True
+            row["host_mbps"] = round(
+                out_mb / timed(lambda: host_lane(blocks, vt)), 1)
+        finally:
+            native._LIB, native._TRIED = lib_saved, tried_saved
+        if native.available():
+            row["native_mbps"] = round(
+                out_mb / timed(lambda: host_lane(blocks, vt)), 1)
+        else:
+            row["native_mbps"] = None
+        try:
+            row["device_mbps"] = round(
+                out_mb / timed(lambda: device_lane(blocks, vt)), 1)
+        except Exception as e:
+            row["device_mbps"] = None
+            row["device_error"] = repr(e)[:200]
+        out["codecs"][name] = row
+        print(f"# decode_bench {name}: host {row['host_mbps']}MB/s "
+              f"native {row['native_mbps']}MB/s "
+              f"device {row['device_mbps']}MB/s", file=sys.stderr)
+    return out
+
+
 def main():
     _guard_degraded_relay()
     data_dir = tempfile.mkdtemp(prefix="cnosdb_bench_")
@@ -633,7 +734,13 @@ def main():
             if name == "double_groupby_1":
                 headline = (rate, vs)
 
-        from cnosdb_tpu.ops import pallas_kernels
+        from cnosdb_tpu.ops import device_decode, pallas_kernels
+
+        # decode plane micro-bench: per-codec MB/s through each lane
+        try:
+            decode_results = decode_bench()
+        except Exception as e:   # a micro-bench failure must not sink
+            decode_results = {"error": repr(e)[:200]}
 
         # secondary tiers: full TSBS IoT-13 + ClickBench-43 coverage,
         # each query oracle-checked (round-4 verdict item 9); scaled via
@@ -670,6 +777,11 @@ def main():
             "pallas_enabled": pallas_kernels.enabled(),
             "pallas_disabled_reason": pallas_kernels.disabled_reason(),
             "pallas_engagements": pallas_kernels.engagements(),
+            "device_decode_enabled": device_decode.enabled(),
+            "device_decode_disabled_reason":
+                device_decode.disabled_reason(),
+            "device_decode_engagements": device_decode.engagements(),
+            "decode_bench": decode_results,
             "lint_findings": lint_findings,
             **suites,
             **device,

@@ -296,7 +296,8 @@ class SwallowedException(Rule):
 # --------------------------------------------------------------------------
 _JAX_PURITY_FILES = ("cnosdb_tpu/ops/kernels.py",
                      "cnosdb_tpu/ops/group_agg.py",
-                     "cnosdb_tpu/ops/pallas_kernels.py")
+                     "cnosdb_tpu/ops/pallas_kernels.py",
+                     "cnosdb_tpu/ops/device_decode.py")
 _ARRAY_MODULES = {"jnp", "lax", "pl"}
 
 
@@ -473,7 +474,7 @@ class WallclockDuration(Rule):
 # 9. metrics-naming — new: /metrics naming conventions
 # --------------------------------------------------------------------------
 _METRIC_NAME_RE = re.compile(r"^cnosdb_[a-z0-9_]+$")
-_METRIC_METHODS = {"incr", "set_gauge", "observe"}
+_METRIC_METHODS = {"incr", "set_gauge", "set_counter", "observe"}
 
 
 class MetricsNaming(Rule):
@@ -497,7 +498,8 @@ class MetricsNaming(Rule):
                        f"metric {name!r} must match cnosdb_[a-z0-9_]+ "
                        f"(prefixed, lowercase snake_case)")
             return
-        if method == "incr" and not name.endswith("_total"):
+        if method in ("incr", "set_counter") \
+                and not name.endswith("_total"):
             ctx.report(self, node,
                        f"counter {name!r} must end in _total "
                        f"(prometheus counter convention)")
@@ -552,7 +554,108 @@ class StageCatalog(Rule):
                            f"(utils/stages.DYNAMIC_STAGE_PREFIXES)")
 
 
+# --------------------------------------------------------------------------
+# 11. device-decode-accounting — new (PR 9): no silent host fallbacks
+# --------------------------------------------------------------------------
+_DDA_FUNCS = {
+    "cnosdb_tpu/storage/codecs.py": ("split_for_device",),
+    "cnosdb_tpu/storage/scan.py": ("_submit_device_page",),
+    "cnosdb_tpu/ops/device_decode.py": ("run", "attach_device_columns"),
+}
+_DDA_ACCOUNTING = {"_rejected", "_count_fallback", "count_outcome",
+                   "declined", "submit", "note_engaged", "count_error"}
+
+
+def _dda_has_accounting(node: ast.AST) -> bool:
+    for n in ast.walk(node):
+        if isinstance(n, ast.Call) and _call_name(n) in _DDA_ACCOUNTING:
+            return True
+    return False
+
+
+def _dda_success_return(stmt: ast.AST) -> bool:
+    """``return <plan>, None`` — split_for_device's accepted shape."""
+    return (isinstance(stmt, ast.Return)
+            and isinstance(stmt.value, ast.Tuple)
+            and len(stmt.value.elts) == 2
+            and isinstance(stmt.value.elts[1], ast.Constant)
+            and stmt.value.elts[1].value is None)
+
+
+def _dda_blocks(fn: ast.AST):
+    """Every statement list in fn, nested functions excluded (a sink
+    closure's exits belong to its own call-time contract)."""
+    stack = [fn]
+    while stack:
+        node = stack.pop()
+        for field in ("body", "orelse", "finalbody"):
+            block = getattr(node, field, None)
+            if isinstance(block, list) and block:   # IfExp's are exprs
+                yield block
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.Lambda)):
+                continue
+            stack.append(child)
+
+
+class DeviceDecodeAccounting(Rule):
+    name = "device-decode-accounting"
+    motivation = ("PR 9 device-decode plane: every page the device lane "
+                  "examines but does not decode must book a (lane, "
+                  "reason) outcome — an unaccounted early return/raise "
+                  "reintroduces invisible host fallbacks, the exact "
+                  "regression cnosdb_device_decode_total exists to catch")
+
+    def applies_to(self, relpath):
+        return relpath in _DDA_FUNCS
+
+    def begin_module(self, ctx):
+        want = _DDA_FUNCS.get(ctx.relpath)
+        guarded = want is not None
+        if want is None:
+            # scope-ignored run (fixtures/self-tests): lint any function
+            # bearing a guarded name, but skip the presence check — only
+            # the real lane files owe us all of them
+            want = tuple({n for names in _DDA_FUNCS.values()
+                          for n in names})
+        found = set()
+        for fn in ast.walk(ctx.tree):
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    or fn.name not in want:
+                continue
+            found.add(fn.name)
+            terminal = fn.body[-1]
+            for block in _dda_blocks(fn):
+                for i, stmt in enumerate(block):
+                    if not isinstance(stmt, (ast.Return, ast.Raise)) \
+                            or stmt is terminal:
+                        continue
+                    prev = block[i - 1] if i else None
+                    if _dda_has_accounting(stmt) \
+                            or _dda_success_return(stmt) \
+                            or (prev is not None
+                                and _dda_has_accounting(prev)):
+                        continue
+                    kind = "return" if isinstance(stmt, ast.Return) \
+                        else "raise"
+                    ctx.report(self, stmt,
+                               f"unaccounted early {kind} in {fn.name} — "
+                               f"device-decode lane exits must pass "
+                               f"reason accounting (_rejected/declined/"
+                               f"count_outcome/_count_fallback) so host "
+                               f"fallbacks stay visible on /metrics")
+        for name in want if guarded else ():
+            if name not in found:
+                ctx.report(self, 1,
+                           f"device-decode guarded function {name} not "
+                           f"found — if it was renamed, update "
+                           f"analysis/rules.py so the lint keeps "
+                           f"covering it")
+
+
 def all_rules() -> list:
     return [NoBareExcept(), RpcCallTimeout(), RowLoop(), RowLoopFallback(),
             LockBlocking(), SwallowedException(), JaxPurity(),
-            WallclockDuration(), MetricsNaming(), StageCatalog()]
+            WallclockDuration(), MetricsNaming(), StageCatalog(),
+            DeviceDecodeAccounting()]

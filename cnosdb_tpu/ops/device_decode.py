@@ -1,0 +1,442 @@
+"""Device-side decode plane: the TSM codecs as batched accelerator kernels.
+
+Cold scans were host-bound: every page decoded on the CPU (native or
+numpy) and only the finished arrays crossed the PCIe pipe (BENCH_r05:
+decode_ms 71 s cold vs 0.8 ms warm kernel time). Following "GPU
+Acceleration of SQL Analytics on Compressed Data" (arxiv 2506.10092),
+this module inverts that: host work stops at the byte-container stage
+(zstd et al — storage/codecs.split_for_device), the still-narrow
+post-container payloads ship to the device, and the per-value codec
+transforms run there as batched jitted kernels:
+
+  delta / delta_ts   widen -> unzigzag -> cumsum   (i64, u64 bit-rides)
+  delta const-stride first + stride * iota          (18-byte pages)
+  gorilla f64        byte-plane assembly -> log-step prefix-XOR scan
+                     (native/bytetrans.h as lane-parallel u32 planes;
+                     a Pallas kernel when CNOSDB_TPU_PALLAS allows,
+                     else lax.associative_scan)
+  bitpack bool       bit-expansion from packed u8
+  string dict pages  narrow code widening (codes on device; the Python
+                     dictionary itself stays host-side)
+
+Batching: pages are padded into fixed-shape [B, L] buffers keyed by
+(kind, width, pow2 length bucket) and B is padded to a pow2, so the jit
+cache sees a handful of shapes regardless of page-size jitter. Outputs
+are bit-identical to storage/codecs.decode (verified by the property
+suite in tests/test_device_decode.py) because every transform is
+integer/bitwise: XOR scans, two's-complement cumsum and bitcasts have no
+rounding.
+
+Gating mirrors pallas_kernels: CNOSDB_DEVICE_DECODE=1 forces the lane on
+(interpret/XLA-on-CPU backends included — how tests engage it), =0 off,
+auto enables it only when the scan device is a real TPU. The scan layer
+(storage/scan) receives a DeviceDecodeLane via `decode_hook` so storage
+itself stays jax-free; every page the lane examines but does not decode
+books a (lane, reason) outcome — surfaced as
+cnosdb_device_decode_total{lane,reason} and required by the
+device-decode-accounting lint rule.
+"""
+from __future__ import annotations
+
+import functools
+import os
+import threading
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..models.codec import Encoding
+from ..models.schema import ValueType
+from ..utils import stages
+from . import pallas_kernels
+
+try:  # pallas import is deferred-fail: CPU-only deployments keep working
+    from jax.experimental import pallas as pl
+    PALLAS_AVAILABLE = True
+except Exception:  # pragma: no cover
+    pl = None
+    PALLAS_AVAILABLE = False
+
+# TPU lane width: value buckets are pow2 multiples of this, so the last
+# (vectorized) dimension always tiles cleanly
+_MIN_LANE = 128
+_WIDTH_DTYPE = {1: np.uint8, 2: np.uint16, 4: np.uint32, 8: np.uint64}
+
+
+def enabled() -> bool:
+    """Should scans route decodes through this plane?
+    CNOSDB_DEVICE_DECODE=1 forces on (XLA/interpret on CPU backends —
+    the test/bench mode), =0 off; default: only on a real TPU."""
+    return disabled_reason() is None
+
+
+def disabled_reason() -> str | None:
+    """None when the lane is usable, else WHY not — bench.py reports it
+    next to pallas_disabled_reason so a silent fallback is visible."""
+    mode = os.environ.get("CNOSDB_DEVICE_DECODE", "auto").lower()
+    if mode in ("1", "on", "true"):
+        return None
+    if mode in ("0", "off", "false"):
+        return f"disabled by env CNOSDB_DEVICE_DECODE={mode}"
+    from .placement import scan_device
+
+    try:
+        dev = scan_device()
+    except Exception as e:  # no jax devices at all
+        return f"device probe failed: {e!r}"
+    if dev.platform != "tpu":
+        return f"scan device is {dev.platform!r}, not tpu (auto mode)"
+    return None
+
+
+# ---------------------------------------------------------------------------
+# engagement + outcome accounting
+# ---------------------------------------------------------------------------
+_LOCK = threading.Lock()
+_engagements = 0
+_outcomes: dict[tuple[str, str], int] = {}
+
+
+def note_engaged(n: int = 1) -> None:
+    global _engagements
+    with _LOCK:
+        _engagements += n
+    stages.count("device_decode_engagements", n)
+
+
+def engagements() -> int:
+    """Pages decoded by the device lane this process (bench.py records
+    this next to pallas_engagements so BENCH_r* shows lane adoption)."""
+    with _LOCK:
+        return _engagements
+
+
+def count_outcome(lane: str, reason: str, n: int = 1) -> None:
+    """Book n pages as handled by `lane` ("device" or "host") for
+    `reason` — the raw series behind cnosdb_device_decode_total."""
+    with _LOCK:
+        _outcomes[(lane, reason)] = _outcomes.get((lane, reason), 0) + n
+
+
+def outcomes_snapshot() -> dict[tuple[str, str], int]:
+    with _LOCK:
+        return dict(sorted(_outcomes.items()))
+
+
+def _pow2(n: int, minimum: int) -> int:
+    n = max(int(n), minimum)
+    return 1 << (n - 1).bit_length()
+
+
+# ---------------------------------------------------------------------------
+# kernels (pure XLA; gorilla optionally via Pallas)
+# ---------------------------------------------------------------------------
+@jax.jit
+def _delta_kernel(zz, firsts):
+    """[B, L] narrow zigzag deltas + [B] firsts -> [B, L] i64 values.
+
+    Row b carries n_b-1 deltas zero-padded to L; out[b, i] =
+    first_b + sum(deltas[:i]) so out[b, :n_b] matches the host decode
+    (two's-complement cumsum wraps identically to numpy's)."""
+    u = zz.astype(jnp.uint64)
+    one = jnp.uint64(1)
+    dec = (u >> one) ^ (jnp.uint64(0) - (u & one))   # unzigzag, in u64
+    d = jax.lax.bitcast_convert_type(dec, jnp.int64)
+    csum = jnp.cumsum(d, axis=1)
+    zero = jnp.zeros((d.shape[0], 1), jnp.int64)
+    return firsts[:, None] + jnp.concatenate([zero, csum[:, :-1]], axis=1)
+
+
+@functools.partial(jax.jit, static_argnames=("length",))
+def _delta_const_kernel(firsts, strides, length):
+    """Constant-stride timestamp fast path: first + stride * iota."""
+    idx = jnp.arange(length, dtype=jnp.int64)
+    return firsts[:, None] + strides[:, None] * idx[None, :]
+
+
+def _assemble_planes(planes):
+    """[B, 8, L] u8 byte planes (plane k = byte k of each u64, little
+    endian) -> (lo, hi) u32 halves of the XOR'd u64 stream."""
+    p = planes.astype(jnp.uint32)
+    lo = p[:, 0] | (p[:, 1] << 8) | (p[:, 2] << 16) | (p[:, 3] << 24)
+    hi = p[:, 4] | (p[:, 5] << 8) | (p[:, 6] << 16) | (p[:, 7] << 24)
+    return lo, hi
+
+
+def _combine_f64(lo, hi):
+    u = lo.astype(jnp.uint64) | (hi.astype(jnp.uint64) << jnp.uint64(32))
+    return jax.lax.bitcast_convert_type(u, jnp.float64)
+
+
+@jax.jit
+def _gorilla_xla_kernel(planes):
+    """Gorilla f64: untranspose + prefix-XOR scan, XOR running as two
+    independent u32 planes (XOR is bytewise, so the split is exact)."""
+    lo, hi = _assemble_planes(planes)
+    lo = jax.lax.associative_scan(jnp.bitwise_xor, lo, axis=1)
+    hi = jax.lax.associative_scan(jnp.bitwise_xor, hi, axis=1)
+    return _combine_f64(lo, hi)
+
+
+@jax.jit
+def _gorilla_pre_kernel(planes):
+    return _assemble_planes(planes)
+
+
+@jax.jit
+def _gorilla_post_kernel(lo, hi):
+    return _combine_f64(lo, hi)
+
+
+def _make_xor_scan_body(steps: int):
+    """Pallas kernel body: log-step (Hillis-Steele) inclusive XOR scan
+    over the lane axis — `steps` = log2(bucket length) unrolled at trace
+    time, each row tile VMEM-resident."""
+    def body(x_ref, o_ref):
+        x = x_ref[...]
+        for k in range(steps):
+            s = 1 << k
+            x = x ^ jnp.concatenate(
+                [jnp.zeros_like(x[:, :s]), x[:, :-s]], axis=1)
+        o_ref[...] = x
+    return body
+
+
+def _pallas_xor_scan(x, interpret: bool):
+    b, width = x.shape
+    steps = max(width.bit_length() - 1, 0)   # width is a pow2 bucket
+    return pl.pallas_call(
+        _make_xor_scan_body(steps),
+        grid=(b,),
+        in_specs=[pl.BlockSpec((1, width), lambda i: (i, 0))],
+        out_specs=pl.BlockSpec((1, width), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((b, width), jnp.uint32),
+        interpret=interpret,
+    )(x)
+
+
+@jax.jit
+def _bitpack_kernel(packed):
+    """[B, Lb] packed u8 -> [B, Lb*8] 0/1 u8 (MSB-first, np.packbits)."""
+    shifts = jnp.arange(7, -1, -1, dtype=jnp.uint8)
+    bits = (packed[:, :, None] >> shifts[None, None, :]) & jnp.uint8(1)
+    return bits.reshape(packed.shape[0], -1)
+
+
+@jax.jit
+def _codes_kernel(codes):
+    """Narrow dictionary codes -> i32 (the DictArray code dtype)."""
+    return codes.astype(jnp.int32)
+
+
+# ---------------------------------------------------------------------------
+# the scan-facing lane
+# ---------------------------------------------------------------------------
+class _Job:
+    __slots__ = ("plan", "token", "colname", "vt", "out_off", "n_rows",
+                 "nm", "out_vals", "out_valid", "sink", "dev")
+
+
+class DeviceDecodeLane:
+    """One scan's device-decode batch builder.
+
+    Driven by storage/scan._scan_vnode_native: `submit()` during page
+    planning (plans come from codecs.split_for_device — storage stays
+    jax-free, this object crosses the boundary via `decode_hook`), one
+    `run()` that executes the batched kernels, writes host outputs back
+    (null-mask expansion included) and returns the tokens of pages whose
+    kernel failed (the caller re-routes those through the Python lane),
+    then `attach_device_columns()` hands fully device-decoded, null-free,
+    contiguously-covering columns to the EagerUploader ON DEVICE — the
+    decoded values never re-cross the pipe, and tpu_exec's fused
+    filter->segment-aggregate launch consumes them via the existing
+    `_preuploaded` plumbing.
+    """
+
+    _NUMERIC_ENC = {
+        int(ValueType.FLOAT): {int(Encoding.GORILLA)},
+        int(ValueType.INTEGER): {int(Encoding.DELTA),
+                                 int(Encoding.DELTA_TS)},
+        int(ValueType.UNSIGNED): {int(Encoding.DELTA),
+                                  int(Encoding.DELTA_TS)},
+        int(ValueType.BOOLEAN): {int(Encoding.BITPACK),
+                                 int(Encoding.NULL)},
+    }
+
+    def __init__(self, interpret: bool | None = None):
+        if interpret is None:
+            from .placement import scan_device
+
+            interpret = scan_device().platform != "tpu"
+        self._interpret = bool(interpret)
+        self._use_pallas = PALLAS_AVAILABLE and pallas_kernels.enabled()
+        self._jobs: list[_Job] = []
+
+    def accepts(self, value_type: int, encoding: int) -> bool:
+        """Cheap pre-check: does (value_type, encoding) have a device
+        kernel at all? (String pages always submit — the container
+        codec id is not page-visible without reading the block.)"""
+        ok = self._NUMERIC_ENC.get(int(value_type))
+        return ok is not None and int(encoding) in ok
+
+    def declined(self, reason: str, n: int = 1) -> None:
+        """Book n pages the scan examined but routed to a host lane."""
+        count_outcome("host", reason, n)
+
+    def pending(self) -> int:
+        return len(self._jobs)
+
+    def submit(self, plan: dict, token, colname, vt, out_off: int,
+               n_rows: int, nm, out_vals, out_valid, sink=None) -> None:
+        """Queue one page. Numeric/time pages write into
+        out_vals/out_valid at out_off (nm = null mask, as
+        read_field_page returns); string pages deliver dense i32 codes
+        to `sink` instead."""
+        j = _Job()
+        j.plan, j.token, j.colname, j.vt = plan, token, colname, vt
+        j.out_off, j.n_rows, j.nm = out_off, n_rows, nm
+        j.out_vals, j.out_valid, j.sink = out_vals, out_valid, sink
+        j.dev = None
+        self._jobs.append(j)
+
+    # ------------------------------------------------------------- execute
+    def run(self) -> list:
+        """Execute every submitted page as batched kernels; → failed
+        tokens for the caller's Python lane. Every page leaves here
+        either decoded or reason-booked (device-decode-accounting rule)."""
+        failed: list = []
+        groups: dict = {}
+        for j in self._jobs:
+            groups.setdefault(self._group_key(j), []).append(j)
+        for key, jobs in groups.items():
+            try:
+                dev_rows = self._run_group(key, jobs)
+            except Exception:
+                stages.count_error("device_decode.kernel")
+                for j in jobs:
+                    count_outcome("host", "kernel_error")
+                    failed.append(j.token)
+                continue
+            for j, dev in zip(jobs, dev_rows):
+                j.dev = dev
+                self._writeback(j, np.asarray(dev))
+            count_outcome("device", "ok", len(jobs))
+            note_engaged(len(jobs))
+        return failed
+
+    def _group_key(self, j: _Job):
+        p = j.plan
+        kind = p["kind"]
+        if kind == "bitpack":
+            return (kind, 1, _pow2((p["n"] + 7) // 8, _MIN_LANE // 8))
+        width = p.get("width", 8)
+        return (kind, width, _pow2(p["n"], _MIN_LANE))
+
+    def _run_group(self, key, jobs):
+        """One (kind, width, length-bucket) batch -> per-job device rows
+        (each sliced to its true value count, still on device)."""
+        kind, width, lane_len = key
+        b_pad = _pow2(len(jobs), 1)
+        if kind == "delta_const":
+            firsts = np.zeros(b_pad, np.int64)
+            strides = np.zeros(b_pad, np.int64)
+            for bi, j in enumerate(jobs):
+                firsts[bi] = j.plan["first"]
+                strides[bi] = j.plan["stride"]
+            out = _delta_const_kernel(self._put(firsts),
+                                      self._put(strides), length=lane_len)
+        elif kind == "delta":
+            zz = np.zeros((b_pad, lane_len), dtype=_WIDTH_DTYPE[width])
+            firsts = np.zeros(b_pad, np.int64)
+            for bi, j in enumerate(jobs):
+                raw = np.frombuffer(j.plan["raw"], dtype=zz.dtype)
+                zz[bi, :len(raw)] = raw
+                firsts[bi] = j.plan["first"]
+            out = _delta_kernel(self._put(zz), self._put(firsts))
+        elif kind == "gorilla":
+            planes = np.zeros((b_pad, 8, lane_len), dtype=np.uint8)
+            for bi, j in enumerate(jobs):
+                n = j.plan["n"]
+                planes[bi, :, :n] = np.frombuffer(
+                    j.plan["raw"], dtype=np.uint8).reshape(8, n)
+            pd = self._put(planes)
+            if self._use_pallas:
+                lo, hi = _gorilla_pre_kernel(pd)
+                lo = _pallas_xor_scan(lo, self._interpret)
+                hi = _pallas_xor_scan(hi, self._interpret)
+                out = _gorilla_post_kernel(lo, hi)
+                pallas_kernels.note_engaged()
+            else:
+                out = _gorilla_xla_kernel(pd)
+        elif kind == "bitpack":
+            packed = np.zeros((b_pad, lane_len), dtype=np.uint8)
+            for bi, j in enumerate(jobs):
+                raw = np.frombuffer(j.plan["raw"], dtype=np.uint8)
+                nb = (j.plan["n"] + 7) // 8
+                packed[bi, :nb] = raw[:nb]
+            out = _bitpack_kernel(self._put(packed))
+        else:   # dict codes
+            codes = np.zeros((b_pad, lane_len), dtype=_WIDTH_DTYPE[width])
+            for bi, j in enumerate(jobs):
+                raw = np.frombuffer(j.plan["raw"], dtype=codes.dtype)
+                codes[bi, :len(raw)] = raw
+            out = _codes_kernel(self._put(codes))
+        return [out[bi, :j.plan["n"]] for bi, j in enumerate(jobs)]
+
+    def _put(self, a: np.ndarray):
+        from .device_cache import _put
+
+        return _put(a)
+
+    def _writeback(self, j: _Job, dense: np.ndarray) -> None:
+        """Host-side landing: expand the dense kernel output through the
+        page's null mask into the scan's output arrays (same contract as
+        the Python page lane)."""
+        if j.sink is not None:
+            j.sink(dense)
+            return
+        if j.vt == ValueType.UNSIGNED:
+            dense = dense.view(np.uint64)
+        elif j.vt == ValueType.BOOLEAN:
+            dense = dense.astype(np.bool_)
+        off, n = j.out_off, j.n_rows
+        if j.nm is None:
+            j.out_vals[off:off + n] = dense
+            if j.out_valid is not None:
+                j.out_valid[off:off + n] = True
+        else:
+            j.out_vals[off:off + n][~j.nm] = dense
+            j.out_valid[off:off + n] = ~j.nm
+
+    # ------------------------------------------------------ device columns
+    def attach_device_columns(self, uploader, total: int) -> None:
+        """Hand columns whose EVERY page decoded on-device, null-free and
+        covering [0, total) contiguously, to the EagerUploader as device
+        arrays (no host round-trip). Anything else already landed in the
+        host arrays and uploads lazily/eagerly as before."""
+        bycol: dict[str, list[_Job]] = {}
+        for j in self._jobs:
+            if j.colname is None or j.sink is not None:
+                continue
+            bycol.setdefault(j.colname, []).append(j)
+        for name, jobs in bycol.items():
+            jobs.sort(key=lambda j: j.out_off)
+            if any(j.dev is None or j.nm is not None for j in jobs):
+                count_outcome("device", "column_not_resident")
+                continue
+            off = 0
+            for j in jobs:
+                if j.out_off != off:
+                    off = -1
+                    break
+                off += j.n_rows
+            if off != total:
+                count_outcome("device", "column_not_resident")
+                continue
+            try:
+                uploader.put_device(name, jobs[0].vt,
+                                    [j.dev for j in jobs])
+            except Exception:
+                stages.count_error("device_decode.attach")

@@ -780,7 +780,8 @@ class Coordinator:
                                field_names=field_names,
                                page_constraints=page_constraints,
                                n_threads=n_threads,
-                               upload_hook=self._upload_hook())
+                               upload_hook=self._upload_hook(),
+                               decode_hook=self._decode_hook())
         except ChecksumMismatch as e:
             # quarantine-on-read: drop the corrupt file from the live
             # Version (manifest-durable, excluded from every future scan),
@@ -824,7 +825,8 @@ class Coordinator:
                                time_ranges=trs, field_names=field_names,
                                page_constraints=page_constraints,
                                n_threads=n_threads,
-                               upload_hook=self._upload_hook())
+                               upload_hook=self._upload_hook(),
+                               decode_hook=self._decode_hook())
         cached_pruned = getattr(cached, "_pages_pruned", False)
         pruned = cached_pruned or getattr(delta, "_pages_pruned", False)
         if delta.n_rows == 0:
@@ -885,6 +887,20 @@ class Coordinator:
                 from ..ops.device_cache import EagerUploader
 
                 return EagerUploader
+        except Exception:  # lint: disable=swallowed-exception (device probe: no accelerator is the normal case on CPU hosts, not an error)
+            pass
+        return None
+
+    def _decode_hook(self):
+        """Device-decode lane factory for the scan pipeline: a fresh
+        DeviceDecodeLane per scan when the plane is enabled (real TPU, or
+        forced via CNOSDB_DEVICE_DECODE=1), else None — scans then use
+        the native/Python host lanes exactly as before."""
+        try:
+            from ..ops import device_decode
+
+            if device_decode.enabled():
+                return device_decode.DeviceDecodeLane
         except Exception:  # lint: disable=swallowed-exception (device probe: no accelerator is the normal case on CPU hosts, not an error)
             pass
         return None

@@ -855,12 +855,14 @@ class HttpServer:
         for name, n in scrub.counters_snapshot().items():
             self.metrics.set_gauge("cnosdb_integrity_total", n, kind=name)
         # decode plane: pages that missed the native pagedec fast lane,
-        # by reason — a hot reason here is a concrete decode regression
+        # by reason — a hot reason here is a concrete decode regression.
+        # These are monotonic process totals: set_counter (not set_gauge)
+        # so PromQL rate()/increase() work on them
         from ..storage import scan as _scan
 
         for name, n in _scan.decode_fallback_snapshot().items():
-            self.metrics.set_gauge("cnosdb_decode_fallback_total", n,
-                                   reason=name)
+            self.metrics.set_counter("cnosdb_decode_fallback_total", n,
+                                     reason=name)
         # aggregation plane: factorize/distinct path totals
         from ..ops import group_agg as _group_agg
 
@@ -885,6 +887,13 @@ class HttpServer:
             for name, n in _tx.memo_counters_snapshot().items():
                 self.metrics.set_gauge("cnosdb_agg_memo_total", n,
                                        kind=name)
+        # device-decode plane: per-(lane, reason) page outcomes — only
+        # when the lane module is resident (same no-jax-on-scrape rule)
+        _dd = _sys.modules.get("cnosdb_tpu.ops.device_decode")
+        if _dd is not None:
+            for (lane, reason), n in _dd.outcomes_snapshot().items():
+                self.metrics.set_counter("cnosdb_device_decode_total", n,
+                                         lane=lane, reason=reason)
         _mv = _sys.modules.get("cnosdb_tpu.sql.matview")
         if _mv is not None:
             for name, n in _mv.counters_snapshot().items():

@@ -39,6 +39,15 @@ class MetricsRegistry:
         with self._lock:
             self._gauges[(name, _lk(labels))] = value
 
+    def set_counter(self, name: str, value: float, **labels):
+        """Export an externally-accumulated monotonic total as a counter
+        series. For sources that keep their own running sum (e.g. the
+        scan planes' fallback/outcome tallies): `incr` would re-add the
+        whole total on every scrape, `set_gauge` would mistype it and
+        break rate() — this assigns, and exposition stays `counter`."""
+        with self._lock:
+            self._counters[(name, _lk(labels))] = value
+
     def observe(self, name: str, value: float, **labels):
         with self._lock:
             h = self._histograms.get((name, _lk(labels)))

@@ -20,8 +20,14 @@ bandwidth into arrays ready for PCIe staging.
 - Strings: DICTIONARY pages — sorted unique values + narrow-cast int32
   codes → container codec (zstd/gzip/zlib/bzip; SNAPPY rides zlib-1 — no
   snappy lib in env, id preserved). Decode materializes the dictionary
-  (O(unique) Python) and the codes in one frombuffer; v1 length-prefixed
-  pages remain readable. Code order == string order (models.strcol).
+  with one whole-blob UTF-8 decode + offset slicing (_materialize_dict)
+  and the codes in one frombuffer; v1 length-prefixed pages remain
+  readable. Code order == string order (models.strcol).
+
+`split_for_device` is the host half of the device-decode lane
+(ops/device_decode): it parses a block and runs ONLY the byte-container
+stage, returning a kernel plan for the per-value transforms — the
+device runs widen/unzigzag/cumsum/untranspose/XOR-scan/unpackbits.
 
 Each encoded block: [1B encoding id][payload]; `encode`/`decode` dispatch
 on column value type + id, matching the reference's one-byte code header
@@ -266,6 +272,30 @@ def _pack_strings(values) -> bytes:
             + bytes([width]) + codes_raw)
 
 
+def _materialize_dict(blob: bytes, lens: np.ndarray) -> np.ndarray:
+    """Length-prefixed UTF-8 blob → object array of str, vectorized:
+    ONE whole-blob decode + offset slicing instead of a per-entry
+    bytes.decode() call (the former O(unique) loop dominated string-page
+    cold decodes). Byte offsets equal char offsets only for ASCII, so a
+    multibyte blob maps byte→char offsets via a cumsum over UTF-8
+    start bytes (continuation bytes match 0b10xxxxxx)."""
+    u = len(lens)
+    values = np.empty(u, dtype=object)
+    if u == 0:
+        return values
+    ends = np.cumsum(lens)
+    starts = ends - lens
+    text = blob.decode()
+    if len(text) != len(blob):
+        bs = np.frombuffer(blob, dtype=np.uint8)
+        chars = np.concatenate(
+            ([0], np.cumsum((bs & 0xC0) != 0x80)))   # chars in blob[:i]
+        starts = chars[starts]
+        ends = chars[ends]
+    values[:] = [text[s:e] for s, e in zip(starts.tolist(), ends.tolist())]
+    return values
+
+
 def _unpack_strings(raw: bytes) -> DictArray:
     head = int(np.frombuffer(raw[:4], dtype=np.uint32)[0])
     if head != _DICT_MARKER:  # v1 page
@@ -274,12 +304,9 @@ def _unpack_strings(raw: bytes) -> DictArray:
     u = int(np.frombuffer(raw[8:12], dtype=np.uint32)[0])
     lens = np.frombuffer(raw[12:12 + 4 * u], dtype=np.uint32)
     off = 12 + 4 * u
-    ends = np.cumsum(lens)
-    starts = ends - lens
-    values = np.empty(u, dtype=object)
-    for i in range(u):  # O(unique), not O(rows)
-        values[i] = raw[off + starts[i]: off + ends[i]].decode()
-    off += int(ends[-1]) if u else 0
+    blob_len = int(lens.sum())
+    values = _materialize_dict(raw[off:off + blob_len], lens)
+    off += blob_len
     width = raw[off]
     codes = _widen(width, raw[off + 1:])[:n].astype(np.int32)
     if u == 0:
@@ -290,13 +317,8 @@ def _unpack_strings(raw: bytes) -> DictArray:
 def _unpack_strings_v1(raw: bytes) -> np.ndarray:
     n = int(np.frombuffer(raw[:4], dtype=np.uint32)[0])
     lens = np.frombuffer(raw[4:4 + 4 * n], dtype=np.uint32)
-    out = np.empty(n, dtype=object)
     off = 4 + 4 * n
-    ends = np.cumsum(lens)
-    starts = ends - lens
-    for i in range(n):
-        out[i] = raw[off + starts[i]: off + ends[i]].decode()
-    return out
+    return _materialize_dict(raw[off:off + int(lens.sum())], lens)
 
 
 _STR_CONTAINERS = {
@@ -385,6 +407,91 @@ def decode(data: bytes, vt: ValueType) -> np.ndarray:
     except Exception as e:
         raise CodecError(f"decode failed: {e}", vt=vt.name, encoding=encoding.name)
     raise CodecError("illegal encoding for type", vt=vt.name, encoding=encoding.name)
+
+
+# ---------------------------------------------------------------------------
+# device-decode lane: the host half
+# ---------------------------------------------------------------------------
+def _rejected(reason: str):
+    """No device lane for this block; the CALLER books `reason` (scan's
+    _count_fallback + device_decode.count_outcome — storage stays
+    jax-free, so the counters live across the hook boundary)."""
+    return None, reason
+
+
+def split_for_device(data: bytes, vt: ValueType):
+    """Host half of a device decode → (plan, None) or (None, reason).
+
+    Parses one encoded block ([1B id][payload]) and runs only the byte
+    container (zstd et al). The plan dict describes the remaining
+    per-value work for ops/device_decode's batched kernels:
+      {"kind": "delta", "n", "first", "width", "raw"}    zigzag deltas
+      {"kind": "delta_const", "n", "first", "stride"}    18-byte pages
+      {"kind": "gorilla", "n", "raw"}                    u8 byte planes
+      {"kind": "bitpack", "n", "raw"}                    packed bits
+      {"kind": "dict", "n", "width", "raw", "values"}    narrow codes +
+                                                         host dictionary
+    Rejections are total: every early return passes through _rejected()
+    (enforced by the device-decode-accounting lint rule).
+    """
+    if len(data) == 0:
+        return _rejected("empty")
+    encoding = Encoding(data[0])
+    payload = data[1:]
+    if vt in (ValueType.INTEGER, ValueType.UNSIGNED):
+        if encoding not in (Encoding.DELTA, Encoding.DELTA_TS):
+            return _rejected("encoding")
+        tag = payload[0]
+        if tag == 0:
+            return _rejected("empty")
+        n = int(np.frombuffer(payload[1:5], dtype=np.uint32)[0])
+        first = int(np.frombuffer(payload[5:13], dtype=np.int64)[0])
+        if tag == 1:
+            stride = int(np.frombuffer(payload[13:21], dtype=np.int64)[0])
+            return {"kind": "delta_const", "n": n, "first": first,
+                    "stride": stride}, None
+        width = payload[13]
+        raw = _ZSTD_D.decompress(payload[14:])
+        return {"kind": "delta", "n": n, "first": first, "width": width,
+                "raw": raw}, None
+    if vt == ValueType.FLOAT:
+        if encoding != Encoding.GORILLA:
+            return _rejected("encoding")
+        if payload[0] == 0:
+            return _rejected("empty")
+        n = int(np.frombuffer(payload[1:5], dtype=np.uint32)[0])
+        return {"kind": "gorilla", "n": n,
+                "raw": _ZSTD_D.decompress(payload[5:])}, None
+    if vt == ValueType.BOOLEAN:
+        if encoding not in (Encoding.BITPACK, Encoding.NULL):
+            return _rejected("encoding")
+        n = int(np.frombuffer(payload[:4], dtype=np.uint32)[0])
+        if n == 0:
+            return _rejected("empty")
+        return {"kind": "bitpack", "n": n, "raw": payload[4:]}, None
+    if vt in (ValueType.STRING, ValueType.GEOMETRY):
+        _, decomp = _STR_CONTAINERS.get(encoding,
+                                        _STR_CONTAINERS[Encoding.DEFAULT])
+        raw = decomp(payload)
+        head = int(np.frombuffer(raw[:4], dtype=np.uint32)[0])
+        if head != _DICT_MARKER:
+            return _rejected("string_v1")
+        n = int(np.frombuffer(raw[4:8], dtype=np.uint32)[0])
+        if n == 0:
+            return _rejected("empty")
+        u = int(np.frombuffer(raw[8:12], dtype=np.uint32)[0])
+        lens = np.frombuffer(raw[12:12 + 4 * u], dtype=np.uint32)
+        off = 12 + 4 * u
+        blob_len = int(lens.sum())
+        values = _materialize_dict(raw[off:off + blob_len], lens)
+        if u == 0:
+            values = np.array([""], dtype=object)
+        off += blob_len
+        width = raw[off]
+        return {"kind": "dict", "n": n, "width": width,
+                "raw": raw[off + 1:off + 1 + n * width],
+                "values": values}, None
+    return _rejected("value_type")
 
 
 def encode_timestamps(ts: np.ndarray, encoding: Encoding = Encoding.DEFAULT) -> bytes:

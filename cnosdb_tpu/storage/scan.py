@@ -27,6 +27,7 @@ from ..models.strcol import DictArray, as_dict_part as _as_dict_part, \
 from .memcache import MemCache, _group_starts
 from .vnode import VnodeStorage
 from ..utils import lockwatch
+from ..utils import stages
 
 
 @dataclass
@@ -425,7 +426,8 @@ def scan_vnode(vnode: VnodeStorage, table: str,
                time_ranges: TimeRanges | None = None,
                field_names: list[str] | None = None,
                page_filter=None, page_constraints: dict | None = None,
-               n_threads: int = 1, upload_hook=None) -> ScanBatch:
+               n_threads: int = 1, upload_hook=None,
+               decode_hook=None) -> ScanBatch:
     """Materialize a vnode scan into one ScanBatch.
 
     `page_filter` (an sql.expr tree, optional) enables predicate page
@@ -441,6 +443,11 @@ def scan_vnode(vnode: VnodeStorage, table: str,
     `uploader.put(...)` so device transfer overlaps the decode of the
     remaining columns (the double-buffer half of the pipeline; storage
     stays jax-free — the hook comes from ops/device_cache).
+    `decode_hook`, when given, is `hook() -> DeviceDecodeLane | None`
+    (ops/device_decode): pages whose codec has a device kernel stop host
+    work at the byte container and decode as batched kernels on the
+    accelerator — the third lane beside native pagedec and per-page
+    Python.
     """
     trs = time_ranges if time_ranges is not None else TimeRanges.all()
     if series_ids is None:
@@ -461,7 +468,7 @@ def scan_vnode(vnode: VnodeStorage, table: str,
             page_constraints = _page_constraints(page_filter, field_names)
         batch = _scan_vnode_native(vnode, table, series_ids, trs,
                                    field_names, page_constraints or {},
-                                   n_threads, upload_hook)
+                                   n_threads, upload_hook, decode_hook)
         if batch is not None:
             return batch
 
@@ -554,6 +561,9 @@ _NATIVE_ENC = {1: {6}, 2: {2, 11}, 3: {10}}   # kind → decodable encodings
 #   encoding      codec outside the native decoder's set
 #   schema_change page typed differently than the column (cast path)
 #   native_reject native decoder refused the page at runtime
+#   native_unavailable  no native library and the device lane declined
+#   device_decode.*     device lane examined the page but declined
+#                       (reason suffix from codecs.split_for_device)
 import threading as _threading
 
 _FALLBACK_LOCK = lockwatch.Lock("scan.fallback")
@@ -676,14 +686,69 @@ def _page_admits(cols: dict, i: int, constraints: dict) -> bool:
     return True
 
 
+def _submit_device_page(dev_lane, r, pm, colname, out_off, vt,
+                        numeric_cols, string_parts, string_valid,
+                        ts_all) -> bool:
+    """Try to queue one page on the device-decode lane. True = queued;
+    False = the caller routes the page to a host lane, with the decline
+    reason already booked on both counters (decode_fallback_snapshot's
+    device_decode.* reasons and cnosdb_device_decode_total)."""
+    from . import codecs as _codecs
+
+    try:
+        if colname is None:
+            block, nm = r._read_page(pm), None
+        else:
+            block, nm = r.read_field_page_split(pm)
+        plan, reason = _codecs.split_for_device(
+            block, vt if colname is not None else ValueType.INTEGER)
+    except Exception:
+        plan, reason = None, "read_error"
+    if plan is None:
+        _count_fallback("device_decode." + reason)
+        dev_lane.declined(reason)
+        return False
+    n = pm.n_rows
+    token = (r, pm, colname, out_off, vt)
+    if colname is None:
+        dev_lane.submit(plan, token, None, ValueType.INTEGER, out_off, n,
+                        None, ts_all, None)
+        return True
+    if vt in (ValueType.STRING, ValueType.GEOMETRY):
+        parts, sv = string_parts[colname], string_valid[colname]
+        values = plan["values"]
+
+        def _sink(dense, _off=out_off, _n=n, _nm=nm, _values=values):
+            if _nm is None:
+                codes = dense.astype(np.int32, copy=False)
+                valid_p = np.ones(_n, dtype=bool)
+            else:
+                codes = np.zeros(_n, dtype=np.int32)
+                codes[~_nm] = dense
+                valid_p = ~_nm
+            parts.append((_off, DictArray(codes, _values)))
+            sv[_off:_off + _n] = valid_p
+
+        dev_lane.submit(plan, token, colname, vt, out_off, n, nm,
+                        None, None, sink=_sink)
+        return True
+    out_vals, out_valid = numeric_cols[colname]
+    dev_lane.submit(plan, token, colname, vt, out_off, n, nm,
+                    out_vals, out_valid)
+    return True
+
+
 def _scan_vnode_native(vnode: VnodeStorage, table: str,
                        series_ids, trs: TimeRanges,
                        field_names: list[str], constraints: dict,
                        n_threads: int,
-                       upload_hook=None) -> ScanBatch | None:
+                       upload_hook=None,
+                       decode_hook=None) -> ScanBatch | None:
     from . import native
 
-    if not native.pagedec_available():
+    dev_lane = decode_hook() if decode_hook is not None else None
+    native_ok = native.pagedec_available()
+    if not native_ok and dev_lane is None:
         return None
     version = vnode.summary.version
     files = []
@@ -797,15 +862,41 @@ def _scan_vnode_native(vnode: VnodeStorage, table: str,
         for r, cm, cols, idx in chunks:
             for i in idx:
                 tp = cm.time_pages[i]
-                _add_page(r, tp, None, off, 0)
+                if not (dev_lane is not None
+                        and dev_lane.accepts(int(ValueType.INTEGER),
+                                             tp.encoding)
+                        and _submit_device_page(
+                            dev_lane, r, tp, None, off, ValueType.INTEGER,
+                            numeric_cols, string_parts, string_valid,
+                            ts_all)):
+                    if native_ok:
+                        _add_page(r, tp, None, off, 0)
+                    else:
+                        py_jobs.append((r, tp, None, off, None))
                 for name in field_names:
                     col = cols.get(name)
                     if col is None:
                         continue   # absent column: stays zero/invalid
                     pm = col.pages[i]
                     vt = ftypes.get(name)
+                    if dev_lane is not None and pm.value_type == int(vt) \
+                            and (vt in (ValueType.STRING,
+                                        ValueType.GEOMETRY)
+                                 or dev_lane.accepts(pm.value_type,
+                                                     pm.encoding)) \
+                            and _submit_device_page(
+                                dev_lane, r, pm, name, off, vt,
+                                numeric_cols, string_parts, string_valid,
+                                ts_all):
+                        continue
                     if vt in (ValueType.STRING, ValueType.GEOMETRY):
                         _count_fallback("string")
+                        py_jobs.append((r, pm, name, off, vt))
+                        continue
+                    if not native_ok:
+                        # device lane declined and there is no native
+                        # decoder in this build: per-page Python path
+                        _count_fallback("native_unavailable")
                         py_jobs.append((r, pm, name, off, vt))
                         continue
                     kind = _NATIVE_NUMERIC.get(pm.value_type)
@@ -824,6 +915,15 @@ def _scan_vnode_native(vnode: VnodeStorage, table: str,
                         continue
                     _add_page(r, pm, name, off, kind)
                 off += tp.n_rows
+
+    # ------------------------------------------------------ device decode
+    # the third lane runs BEFORE the native tasks: device writebacks land
+    # in the shared output arrays first, so a column split between lanes
+    # is already complete when _finish's eager upload sees it, and kernel
+    # failures join py_jobs before dirty_cols is computed
+    if dev_lane is not None and dev_lane.pending():
+        with stages.stage("device_decode_ms"):
+            py_jobs.extend(dev_lane.run())
 
     # ------------------------------------------------------- native decode
     # one task per (file, column): pages of one column across files write
@@ -852,6 +952,10 @@ def _scan_vnode_native(vnode: VnodeStorage, table: str,
         # eagerly shipped copy, so only clean scans pipeline uploads
         uploader = upload_hook(total)
     dirty_cols = {j[2] for j in py_jobs}
+    if uploader is not None and dev_lane is not None:
+        # columns whose every page decoded on-device attach as device
+        # arrays — decoded values never re-cross the PCIe pipe
+        dev_lane.attach_device_columns(uploader, total)
 
     def _run(task):
         g, _colname, desc, out_vals, out_valid, _jobs = task

@@ -425,6 +425,21 @@ class TsmReader:
         vals = codecs.decode(payload[off:], ValueType(pm.value_type))
         return vals, nm
 
+    def read_field_page_split(self, pm: PageMeta) -> tuple[bytes, np.ndarray | None]:
+        """→ (encoded_block, null_mask|None) WITHOUT decoding values —
+        the device-decode lane's entry point: the null bitset expands
+        host-side (cheap), the codec block goes to
+        codecs.split_for_device so its value transforms run on device."""
+        payload = self._read_page(pm)
+        has_nulls, blen = struct.unpack_from("<BI", payload, 0)
+        off = 5
+        nm = None
+        if has_nulls:
+            bits = np.frombuffer(payload[off:off + blen], dtype=np.uint8)
+            nm = np.unpackbits(bits, count=pm.n_rows).astype(bool)
+            off += blen
+        return payload[off:], nm
+
     def read_series_timestamps(self, table: str, series_id: int) -> np.ndarray:
         cm = self.chunk(table, series_id)
         if cm is None:

@@ -44,6 +44,10 @@ STAGE_CATALOG: dict[str, str] = {
     "delta_rows": "rows decoded by delta scans (a full rescan's worth "
                   "means tokens are being invalidated)",
     "decode_ms": "TSM read+decode (cache-miss and delta scans)",
+    "device_decode_ms": "batched device codec kernels within a scan "
+                        "(the accelerator half of decode_ms)",
+    "device_decode_engagements": "pages decoded by the device-decode "
+                                 "lane instead of a host lane",
     "upload_ms": "host→device column uploads",
     "upload_bytes": "bytes moved host→device by those uploads",
     "kernel_ms": "fused segment-aggregate kernels",
@@ -229,6 +233,14 @@ class QueryProfile:
             try:
                 self.device["pallas_enabled"] = pk.enabled()
                 self.device["pallas_disabled_reason"] = pk.disabled_reason()
+            except Exception:  # lint: disable=swallowed-exception (telemetry stamp must never fail the query)
+                pass
+        dd = sys.modules.get("cnosdb_tpu.ops.device_decode")
+        if dd is not None:
+            try:
+                self.device["device_decode_enabled"] = dd.enabled()
+                self.device["device_decode_disabled_reason"] = \
+                    dd.disabled_reason()
             except Exception:  # lint: disable=swallowed-exception (telemetry stamp must never fail the query)
                 pass
         return self

@@ -1,0 +1,20 @@
+"""Fixture: device-decode lane exits that skip reason accounting
+(lines 9 and 18). Mirrors the guarded function names so the rule finds
+its targets when scope is ignored; the line-12 reject and both terminal
+returns are legal shapes and must stay silent."""
+
+
+def split_for_device(data, vt, count_outcome):
+    if not data:
+        return None, "empty"
+    if vt == 0:
+        count_outcome("host", "encoding")
+        return None, "encoding"
+    return {"kind": "delta"}, None
+
+
+def run(jobs, count_outcome):
+    if not jobs:
+        return []
+    count_outcome("device", "ok", len(jobs))
+    return jobs

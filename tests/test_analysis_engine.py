@@ -41,6 +41,7 @@ _CASES = [
     ("bad_row_loop.py", rules_mod.RowLoop(), [7]),
     ("bad_row_loop.py", rules_mod.RowLoopFallback(), [21]),
     ("bad_stage_name.py", rules_mod.StageCatalog(), [6, 9, 12]),
+    ("bad_device_decode.py", rules_mod.DeviceDecodeAccounting(), [9, 18]),
 ]
 
 

@@ -1,0 +1,341 @@
+"""Device-decode plane (ops/device_decode + codecs.split_for_device):
+bit-identical parity against the host decoder across every codec, dtype
+and null pattern (interpret mode on CPU), reason accounting for rejected
+pages, and the end-to-end scan lane — engagements > 0 and batch
+equivalence vs the legacy Python scan, plus device-resident column
+attachment through the EagerUploader."""
+import os
+
+import numpy as np
+import pytest
+
+from cnosdb_tpu.models.codec import Encoding
+from cnosdb_tpu.models.points import SeriesRows, WriteBatch
+from cnosdb_tpu.models.schema import TskvTableSchema, ValueType
+from cnosdb_tpu.models.series import SeriesKey
+from cnosdb_tpu.models.strcol import DictArray
+from cnosdb_tpu.ops import device_decode
+from cnosdb_tpu.storage import codecs
+from cnosdb_tpu.storage.scan import scan_vnode
+from cnosdb_tpu.storage.vnode import VnodeStorage
+
+
+# ---------------------------------------------------------------------------
+# kernel parity: device lane output must be BIT-identical to codecs.decode
+# ---------------------------------------------------------------------------
+def _device_decode_block(block: bytes, vt: ValueType) -> np.ndarray:
+    """Round one encoded block through the device lane (interpret=True)
+    and return the decoded values, shaped like codecs.decode's output."""
+    plan, reason = codecs.split_for_device(block, vt)
+    assert plan is not None, f"split rejected: {reason}"
+    n = plan["n"]
+    lane = device_decode.DeviceDecodeLane(interpret=True)
+    if vt in (ValueType.STRING, ValueType.GEOMETRY):
+        got = {}
+
+        def sink(dense, _plan=plan):
+            got["vals"] = np.asarray(_plan["values"])[dense]
+
+        lane.submit(plan, "tok", "c", vt, 0, n, None, None, None,
+                    sink=sink)
+        assert lane.run() == []
+        return got["vals"]
+    out_vals = np.zeros(n, dtype=vt.numpy_dtype())
+    out_valid = np.zeros(n, dtype=bool)
+    lane.submit(plan, "tok", "c", vt, 0, n, None, out_vals, out_valid)
+    assert lane.run() == []
+    assert out_valid.all()
+    return out_vals
+
+
+def _assert_bit_identical(dev: np.ndarray, host: np.ndarray):
+    assert dev.dtype == host.dtype
+    if dev.dtype == np.float64:
+        # NaN payloads included: compare the raw bit patterns
+        np.testing.assert_array_equal(dev.view(np.uint64),
+                                      host.view(np.uint64))
+    else:
+        np.testing.assert_array_equal(dev, host)
+
+
+_LENGTHS = [1, 2, 3, 127, 128, 129, 1000, 4096]
+
+
+@pytest.mark.parametrize("n", _LENGTHS)
+def test_delta_i64_parity(rng, n):
+    vals = rng.integers(-(1 << 40), 1 << 40, n).cumsum()
+    block = codecs.encode(vals, ValueType.INTEGER, Encoding.DELTA)
+    host = codecs.decode(block, ValueType.INTEGER)
+    _assert_bit_identical(_device_decode_block(block, ValueType.INTEGER),
+                          host)
+
+
+def test_delta_i64_extreme_values(rng):
+    vals = np.array([np.iinfo(np.int64).min, -1, 0, 1,
+                     np.iinfo(np.int64).max, 7, -(1 << 62)], np.int64)
+    block = codecs.encode(vals, ValueType.INTEGER, Encoding.DELTA)
+    host = codecs.decode(block, ValueType.INTEGER)
+    _assert_bit_identical(_device_decode_block(block, ValueType.INTEGER),
+                          host)
+
+
+@pytest.mark.parametrize("n", _LENGTHS)
+def test_delta_ts_const_stride_parity(rng, n):
+    ts = int(rng.integers(0, 1 << 50)) \
+        + np.arange(n, dtype=np.int64) * 30_000_000
+    block = codecs.encode_timestamps(ts)
+    host = codecs.decode_timestamps(block)
+    _assert_bit_identical(_device_decode_block(block, ValueType.INTEGER),
+                          host)
+
+
+@pytest.mark.parametrize("n", _LENGTHS)
+def test_unsigned_parity(rng, n):
+    vals = rng.integers(0, np.iinfo(np.uint64).max, n, dtype=np.uint64)
+    block = codecs.encode(vals, ValueType.UNSIGNED, Encoding.DELTA)
+    host = codecs.decode(block, ValueType.UNSIGNED)
+    _assert_bit_identical(_device_decode_block(block, ValueType.UNSIGNED),
+                          host)
+
+
+@pytest.mark.parametrize("n", _LENGTHS)
+def test_gorilla_f64_parity(rng, n):
+    vals = rng.normal(20.0, 5.0, n).round(3)
+    block = codecs.encode(vals, ValueType.FLOAT, Encoding.GORILLA)
+    host = codecs.decode(block, ValueType.FLOAT)
+    _assert_bit_identical(_device_decode_block(block, ValueType.FLOAT),
+                          host)
+
+
+def test_gorilla_f64_special_values(rng):
+    vals = np.array([0.0, -0.0, np.nan, np.inf, -np.inf, 5e-324,
+                     np.finfo(np.float64).max, 1.0, 1.0, 1.0], np.float64)
+    block = codecs.encode(vals, ValueType.FLOAT, Encoding.GORILLA)
+    host = codecs.decode(block, ValueType.FLOAT)
+    _assert_bit_identical(_device_decode_block(block, ValueType.FLOAT),
+                          host)
+
+
+@pytest.mark.parametrize("n", _LENGTHS)
+def test_bitpack_bool_parity(rng, n):
+    vals = rng.random(n) < 0.5
+    block = codecs.encode(vals, ValueType.BOOLEAN, Encoding.BITPACK)
+    host = codecs.decode(block, ValueType.BOOLEAN)
+    _assert_bit_identical(_device_decode_block(block, ValueType.BOOLEAN),
+                          host)
+
+
+@pytest.mark.parametrize("n", [1, 127, 1000])
+def test_dict_string_parity(rng, n):
+    words = np.array(["", "ok", "wärn", "err", "crité"], dtype=object)
+    vals = words[rng.integers(0, len(words), n)]
+    block = codecs.encode(vals, ValueType.STRING)
+    host = codecs.decode(block, ValueType.STRING).materialize()
+    dev = _device_decode_block(block, ValueType.STRING)
+    np.testing.assert_array_equal(dev, np.asarray(host, dtype=object))
+
+
+def test_pallas_gorilla_path_parity(rng, monkeypatch):
+    """CNOSDB_TPU_PALLAS=1 routes the gorilla XOR scan through the
+    Pallas kernel (interpret on CPU) — still bit-identical, and it books
+    a pallas engagement."""
+    from cnosdb_tpu.ops import pallas_kernels
+
+    monkeypatch.setenv("CNOSDB_TPU_PALLAS", "1")
+    if not device_decode.PALLAS_AVAILABLE:
+        pytest.skip("pallas import unavailable")
+    vals = rng.normal(0.0, 100.0, 777)
+    block = codecs.encode(vals, ValueType.FLOAT, Encoding.GORILLA)
+    host = codecs.decode(block, ValueType.FLOAT)
+    before = pallas_kernels.engagements()
+    _assert_bit_identical(_device_decode_block(block, ValueType.FLOAT),
+                          host)
+    assert pallas_kernels.engagements() > before
+
+
+# ---------------------------------------------------------------------------
+# rejection accounting: split_for_device + the lane's outcome counters
+# ---------------------------------------------------------------------------
+def test_split_rejects_with_reasons(rng):
+    ints = rng.integers(0, 100, 50)
+    plan, reason = codecs.split_for_device(
+        codecs.encode(ints, ValueType.INTEGER, Encoding.QUANTILE),
+        ValueType.INTEGER)
+    assert plan is None and reason == "encoding"
+    plan, reason = codecs.split_for_device(
+        codecs.encode(np.empty(0, np.int64), ValueType.INTEGER,
+                      Encoding.DELTA), ValueType.INTEGER)
+    assert plan is None and reason == "empty"
+    plan, reason = codecs.split_for_device(b"", ValueType.INTEGER)
+    assert plan is None and reason == "empty"
+    plan, reason = codecs.split_for_device(
+        codecs.encode(rng.normal(size=10), ValueType.FLOAT,
+                      Encoding.QUANTILE), ValueType.FLOAT)
+    assert plan is None and reason == "encoding"
+
+
+def test_declined_pages_book_host_outcomes():
+    before = device_decode.outcomes_snapshot().get(("host", "encoding"), 0)
+    lane = device_decode.DeviceDecodeLane(interpret=True)
+    assert not lane.accepts(int(ValueType.INTEGER), int(Encoding.QUANTILE))
+    lane.declined("encoding", 3)
+    snap = device_decode.outcomes_snapshot()
+    assert snap[("host", "encoding")] == before + 3
+
+
+def test_decoded_pages_book_device_outcomes(rng):
+    before = device_decode.outcomes_snapshot().get(("device", "ok"), 0)
+    eng_before = device_decode.engagements()
+    block = codecs.encode(rng.integers(0, 9, 64), ValueType.INTEGER,
+                          Encoding.DELTA)
+    _device_decode_block(block, ValueType.INTEGER)
+    assert device_decode.outcomes_snapshot()[("device", "ok")] > before
+    assert device_decode.engagements() > eng_before
+
+
+def test_set_counter_exports_counter_type_without_accumulating():
+    """The /metrics export of externally-accumulated totals: counter
+    TYPE (rate() works), assignment semantics (a re-scrape must not
+    double-count the running sum the way incr would)."""
+    from cnosdb_tpu.server.metrics import MetricsRegistry
+
+    m = MetricsRegistry()
+    m.set_counter("cnosdb_device_decode_total", 5,
+                  lane="host", reason="encoding")
+    m.set_counter("cnosdb_device_decode_total", 7,
+                  lane="host", reason="encoding")
+    text = m.prometheus_text()
+    assert "# TYPE cnosdb_device_decode_total counter" in text
+    assert 'cnosdb_device_decode_total{lane="host",reason="encoding"} 7' \
+        in text
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: the scan's third lane under CNOSDB_DEVICE_DECODE=1
+# ---------------------------------------------------------------------------
+def _schema():
+    return {"m": TskvTableSchema.new_measurement(
+        "t", "db", "m", tags=["host"],
+        fields=[("f", ValueType.FLOAT), ("i", ValueType.INTEGER),
+                ("b", ValueType.BOOLEAN), ("s", ValueType.STRING)])}
+
+
+def _write(v, host, ts, **cols):
+    types = {"f": ValueType.FLOAT, "i": ValueType.INTEGER,
+             "b": ValueType.BOOLEAN, "s": ValueType.STRING,
+             "u": ValueType.UNSIGNED}
+    fields = {name: (int(types[name]),
+                     [None if x is None
+                      else (x.item() if isinstance(x, np.generic) else x)
+                      for x in xs])
+              for name, xs in cols.items() if xs is not None}
+    wb = WriteBatch()
+    wb.add_series("m", SeriesRows(SeriesKey("m", {"host": host}),
+                                  list(ts), fields))
+    v.write(wb)
+
+
+def _assert_batches_equal(a, b):
+    assert a.n_rows == b.n_rows
+    np.testing.assert_array_equal(a.series_ids, b.series_ids)
+    np.testing.assert_array_equal(a.ts, b.ts)
+    np.testing.assert_array_equal(a.sid_ordinal, b.sid_ordinal)
+    assert set(a.fields) == set(b.fields)
+    for name in a.fields:
+        vt_a, vals_a, valid_a = a.fields[name]
+        vt_b, vals_b, valid_b = b.fields[name]
+        assert vt_a == vt_b
+        np.testing.assert_array_equal(valid_a, valid_b)
+        if isinstance(vals_a, DictArray) or isinstance(vals_b, DictArray):
+            obj_a = np.asarray(vals_a.materialize()
+                               if isinstance(vals_a, DictArray) else vals_a)
+            obj_b = np.asarray(vals_b.materialize()
+                               if isinstance(vals_b, DictArray) else vals_b)
+            np.testing.assert_array_equal(obj_a[valid_a], obj_b[valid_b])
+        else:
+            np.testing.assert_array_equal(vals_a[valid_a], vals_b[valid_b])
+
+
+def _device_scan(v, **kw):
+    got = scan_vnode(v, "m",
+                     decode_hook=lambda: device_decode.DeviceDecodeLane(
+                         interpret=True), **kw)
+    os.environ["CNOSDB_NO_NATIVE_SCAN"] = "1"
+    try:
+        want = scan_vnode(v, "m", **kw)
+    finally:
+        del os.environ["CNOSDB_NO_NATIVE_SCAN"]
+    return got, want
+
+
+def test_scan_device_lane_equivalence(tmp_engine_dir, rng):
+    v = VnodeStorage(1, tmp_engine_dir, schemas=_schema())
+    n = 1200
+    _write(v, "h1", range(n), f=rng.normal(size=n),
+           i=rng.integers(-50, 50, n), b=rng.integers(0, 2, n) > 0,
+           s=[f"v{x}" for x in rng.integers(0, 5, n)])
+    _write(v, "h2", range(500, 900), f=rng.normal(size=400))
+    v.flush()
+    before = device_decode.engagements()
+    got, want = _device_scan(v)
+    assert device_decode.engagements() > before, \
+        "scan did not engage the device-decode lane"
+    _assert_batches_equal(got, want)
+    v.close()
+
+
+def test_scan_device_lane_with_nulls(tmp_engine_dir):
+    v = VnodeStorage(1, tmp_engine_dir, schemas=_schema())
+    n = 500
+    _write(v, "h1", range(n),
+           f=[float(x) if x % 2 == 0 else None for x in range(n)],
+           i=[int(x) if x % 3 == 0 else None for x in range(n)],
+           s=[f"s{x}" if x % 5 == 0 else None for x in range(n)])
+    v.flush()
+    got, want = _device_scan(v)
+    _assert_batches_equal(got, want)
+    vt, vals, valid = got.fields["f"]
+    assert valid.sum() == (n + 1) // 2
+    v.close()
+
+
+def test_scan_device_lane_multi_flush_and_trim(tmp_engine_dir, rng):
+    from cnosdb_tpu.models.predicate import TimeRange, TimeRanges
+
+    v = VnodeStorage(1, tmp_engine_dir, schemas=_schema())
+    for base in (0, 1000, 2000):
+        _write(v, "h1", range(base, base + 500),
+               f=np.arange(base, base + 500) * 0.5,
+               i=rng.integers(0, 99, 500))
+        v.flush()
+    got, want = _device_scan(
+        v, time_ranges=TimeRanges([TimeRange(250, 2200)]))
+    _assert_batches_equal(got, want)
+    v.close()
+
+
+def test_scan_device_lane_attaches_device_columns(tmp_engine_dir, rng):
+    """Null-free columns fully decoded on device attach to the batch as
+    `_preuploaded` device arrays through EagerUploader.put_device — and
+    the staged values match the host arrays exactly."""
+    from cnosdb_tpu.ops.device_cache import EagerUploader
+
+    v = VnodeStorage(1, tmp_engine_dir, schemas=_schema())
+    n = 800
+    f = rng.normal(size=n)
+    i = rng.integers(-1000, 1000, n)
+    _write(v, "h1", range(n), f=f, i=i)
+    v.flush()
+    got = scan_vnode(
+        v, "m", upload_hook=EagerUploader,
+        decode_hook=lambda: device_decode.DeviceDecodeLane(interpret=True))
+    pre = getattr(got, "_preuploaded", None)
+    assert pre is not None, "no columns were staged on device"
+    n_pad, cols = pre
+    for name, host_vals in (("f", f), ("i", i)):
+        assert name in cols, f"column {name} not device-resident"
+        vt, dev_vals, dev_valid, all_valid = cols[name]
+        assert all_valid and dev_valid is None
+        np.testing.assert_array_equal(
+            np.asarray(dev_vals)[:n].astype(host_vals.dtype), host_vals)
+    v.close()
