@@ -625,6 +625,62 @@ def decode_bench():
     return out
 
 
+def _string_filter_engagements() -> int:
+    try:
+        from cnosdb_tpu.ops import strkernels
+
+        return strkernels.engagements()
+    except Exception:
+        return 0
+
+
+def string_bench(executor, session):
+    """String-plane micro-bench over hits_str: the same LIKE shapes timed
+    through the dictionary lane (per-unique kernels + code gather) and
+    through the host per-row fallback (CNOSDB_STR_LANE=0). MB/s is string
+    payload scanned per second, so the two lanes are directly comparable
+    per pattern class (contains / prefix / regex-lite)."""
+    shapes = {
+        "contains": "SELECT count(*) FROM hits_str "
+                    "WHERE url LIKE '%ge/00%'",
+        "prefix": "SELECT count(*) FROM hits_str "
+                  "WHERE url LIKE '/page/01%'",
+        "regex_lite": "SELECT count(*) FROM hits_str "
+                      "WHERE url LIKE '/page/_1_0%'",
+    }
+    payload_mb = STR_ROWS * len("/page/0000") / 1e6
+    out = {"rows": STR_ROWS, "payload_mb": round(payload_mb, 2)}
+    prev = os.environ.get("CNOSDB_STR_LANE")
+    try:
+        for name, sql in shapes.items():
+            row = {}
+            counts = {}
+            for lane, env in (("dict_mbps", "1"), ("host_mbps", "0")):
+                os.environ["CNOSDB_STR_LANE"] = env
+                executor.execute_one(sql, session)   # warm
+                best = None
+                for _ in range(3):
+                    t0 = time.perf_counter()
+                    rs = executor.execute_one(sql, session)
+                    dt = time.perf_counter() - t0
+                    best = dt if best is None else min(best, dt)
+                counts[lane] = int(np.asarray(rs.columns[0])[0])
+                row[lane] = round(payload_mb / best, 1)
+            assert counts["dict_mbps"] == counts["host_mbps"], \
+                f"lane divergence on {name}: {counts}"
+            row["matches"] = counts["dict_mbps"]
+            out[name] = row
+            print(f"# string_bench {name}: dict {row['dict_mbps']}MB/s "
+                  f"host {row['host_mbps']}MB/s "
+                  f"({row['matches']} matches)", file=sys.stderr)
+    finally:
+        if prev is None:
+            os.environ.pop("CNOSDB_STR_LANE", None)
+        else:
+            os.environ["CNOSDB_STR_LANE"] = prev
+    return out
+
+
 def main():
     _guard_degraded_relay()
     data_dir = tempfile.mkdtemp(prefix="cnosdb_bench_")
@@ -742,6 +798,13 @@ def main():
         except Exception as e:   # a micro-bench failure must not sink
             decode_results = {"error": repr(e)[:200]}
 
+        # string plane micro-bench: dict lane vs host fallback per LIKE
+        # shape, same data + oracle-checked match counts
+        try:
+            string_results = string_bench(executor, session)
+        except Exception as e:
+            string_results = {"error": repr(e)[:200]}
+
         # secondary tiers: full TSBS IoT-13 + ClickBench-43 coverage,
         # each query oracle-checked (round-4 verdict item 9); scaled via
         # CNOSDB_BENCH_SUITE_ROWS, skippable with CNOSDB_BENCH_SUITES=0
@@ -782,6 +845,8 @@ def main():
                 device_decode.disabled_reason(),
             "device_decode_engagements": device_decode.engagements(),
             "decode_bench": decode_results,
+            "string_bench": string_results,
+            "string_filter_engagements": _string_filter_engagements(),
             "lint_findings": lint_findings,
             **suites,
             **device,

@@ -172,11 +172,14 @@ def _run(executor, session, name, sql, check, results, errors,
             rs = executor.execute_one(sql, session)
         dt = time.perf_counter() - t0
         if prof is not None:
-            # aggregation-plane stages per query: group cardinality,
-            # factorize cost, which DISTINCT path engaged
+            # aggregation/string-plane stages per query: group
+            # cardinality, factorize cost, which DISTINCT path engaged,
+            # string predicate routing + pages skipped, top-k routing
             keep = {k: v for k, v in prof.snapshot().items()
-                    if k in ("factorize_ms", "group_count")
-                    or k.startswith("distinct_path")}
+                    if k in ("factorize_ms", "group_count",
+                             "ngram_pages_skipped")
+                    or k.startswith(("distinct_path", "string_path",
+                                     "topk."))}
             if keep:
                 stage_out[name] = keep
         if check is not None:
@@ -604,6 +607,140 @@ def run_clickbench(executor, session, a) -> tuple[dict, dict, dict]:
 # ---------------------------------------------------------------------------
 # dashboard steady-state (materialized rollup plane)
 # ---------------------------------------------------------------------------
+def build_spans(coord, tenant, db, n_rows):
+    """OTLP-shaped trace/span table: log search is the workload the
+    string plane unlocks. Bodies are templated log lines with rare
+    needles ('timeout', 'deadline exceeded') so n-gram page skipping has
+    something to prune; span/trace ids exercise prefix and exact lanes."""
+    from cnosdb_tpu.models.points import SeriesRows, WriteBatch
+    from cnosdb_tpu.models.schema import ValueType
+    from cnosdb_tpu.models.series import SeriesKey
+
+    rng = np.random.default_rng(7)
+    n = n_rows
+    spans = np.array([f"GET /api/v{i}" for i in range(12)] +
+                     [f"POST /api/v{i}" for i in range(6)] +
+                     ["db.query", "cache.get", "auth.check"], dtype=object)
+    bodies = np.array(
+        [f"INFO request handled path=/p{i} status=200" for i in range(160)]
+        + [f"WARN slow upstream path=/p{i} retry=1" for i in range(24)]
+        + ["ERROR upstream timeout path=/p3 attempt=2",
+           "ERROR deadline exceeded calling billing",
+           "WARN connection reset by peer"], dtype=object)
+    body_w = np.concatenate([np.full(160, 1.0), np.full(24, 0.08),
+                             np.full(3, 0.004)])
+    body_w /= body_w.sum()
+    span_idx = rng.integers(0, len(spans), n)
+    body_idx = rng.choice(len(bodies), n, p=body_w)
+    trace_idx = rng.integers(0, max(n // 8, 2), n)
+    dur = rng.integers(50, 500_000, n).astype(np.int64)
+    status = np.where(rng.random(n) < 0.97, "OK", "ERROR").astype(object)
+    ts = BASE_TS + rng.integers(0, 7 * DAY_NS // 1000, n).astype(
+        np.int64) * 1000
+    ts.sort()
+    CH = 250_000
+    for svc in range(4):
+        sel = np.flatnonzero(span_idx % 4 == svc)
+        key = SeriesKey("trace_spans", {"service": f"svc_{svc}"})
+        for off in range(0, len(sel), CH):
+            ix = sel[off:off + CH]
+            fields = {
+                "trace_id": (int(ValueType.STRING),
+                             [f"tr-{i:08d}" for i in trace_idx[ix]]),
+                "span_name": (int(ValueType.STRING),
+                              list(spans[span_idx[ix]])),
+                "status_code": (int(ValueType.STRING), list(status[ix])),
+                "body": (int(ValueType.STRING), list(bodies[body_idx[ix]])),
+                "duration_us": (int(ValueType.INTEGER), dur[ix]),
+            }
+            wb = WriteBatch()
+            wb.add_series("trace_spans", SeriesRows(key, ts[ix], fields))
+            coord.write_points(tenant, db, wb)
+    coord.engine.flush_all()
+    coord.engine.compact_all()
+    return {
+        "service": np.array([f"svc_{i % 4}" for i in span_idx],
+                            dtype=object),
+        "trace_id": np.array([f"tr-{i:08d}" for i in trace_idx],
+                             dtype=object),
+        "span_name": spans[span_idx],
+        "status_code": status,
+        "body": bodies[body_idx],
+        "duration_us": dur,
+        "time": ts,
+    }
+
+
+def run_logsearch(executor, session, a) -> tuple[dict, dict, dict]:
+    """Log/trace search shapes over the OTLP-style spans table, each
+    oracle-checked against numpy over the ingested arrays (the oracle
+    never goes through the string plane)."""
+    res: dict = {}
+    err: dict = {}
+    stg: dict = {}
+    body = a["body"]
+    span = a["span_name"]
+
+    def contains(hay, needle):
+        return np.char.find(hay.astype(str), needle) >= 0
+
+    n_timeout = int(contains(body, "timeout").sum())
+    n_error = int(np.char.startswith(body.astype(str), "ERROR").sum())
+    err_by_svc = {}
+    em = contains(body, "ERROR")
+    for s in np.unique(a["service"][em]):
+        err_by_svc[s] = int((a["service"][em] == s).sum())
+    n_span = int((span == "db.query").sum())
+    tr_prefix = a["trace_id"][0][:6]
+    n_trace = int(np.char.startswith(a["trace_id"].astype(str),
+                                     tr_prefix).sum())
+
+    def scalar_eq(val):
+        def chk(rs):
+            got = int(np.asarray(rs.columns[0])[0])
+            assert got == val, f"{got} != {val}"
+        return chk
+
+    def chk_topdur(rs):
+        d = a["duration_us"]
+        maxes = {s: float(d[span == s].max()) for s in np.unique(span)}
+        got = list(zip(_col(rs, "span_name"),
+                       (float(v) for v in _col(rs, "d"))))
+        assert len(got) == 5, got
+        assert all(maxes[s] == v for s, v in got), got
+        vals = [v for _s, v in got]
+        floor = sorted(maxes.values(), reverse=True)[4]
+        assert vals == sorted(vals, reverse=True) and vals[-1] >= floor, got
+
+    def chk_errsvc(rs):
+        got = dict(zip(_col(rs, "service"),
+                       (int(v) for v in _col(rs, "c"))))
+        assert got == err_by_svc, f"{got} != {err_by_svc}"
+
+    _run(executor, session, "ls1_needle",
+         "SELECT count(*) FROM trace_spans WHERE body LIKE '%timeout%'",
+         scalar_eq(n_timeout), res, err, stg)
+    _run(executor, session, "ls2_prefix",
+         "SELECT count(*) FROM trace_spans WHERE body LIKE 'ERROR%'",
+         scalar_eq(n_error), res, err, stg)
+    _run(executor, session, "ls3_exact",
+         "SELECT count(*) FROM trace_spans WHERE span_name LIKE 'db.query'",
+         scalar_eq(n_span), res, err, stg)
+    _run(executor, session, "ls4_err_by_service",
+         "SELECT service, count(*) AS c FROM trace_spans "
+         "WHERE body LIKE '%ERROR%' GROUP BY service ORDER BY service",
+         chk_errsvc, res, err, stg)
+    _run(executor, session, "ls5_slow_spans",
+         "SELECT span_name, max(duration_us) AS d FROM trace_spans "
+         "GROUP BY span_name ORDER BY d DESC LIMIT 5",
+         chk_topdur, res, err, stg)
+    _run(executor, session, "ls6_trace_prefix",
+         f"SELECT count(*) FROM trace_spans "
+         f"WHERE trace_id LIKE '{tr_prefix}%'",
+         scalar_eq(n_trace), res, err, stg)
+    return res, err, stg
+
+
 def run_dashboard(executor, coord, tenant, db, session) -> dict:
     """The workload materialized rollups exist for: a dashboard panel
     re-issuing the same full-history time-bucketed group-by as history
@@ -727,6 +864,17 @@ def run_suites(executor, coord, tenant, db, session) -> dict:
         out["suite_errors"] = errs
     out["clickbench_pass"] = f"{len(cb)}/43"
     out["tsbs_pass"] = f"{len(ts)}/13"
+    try:
+        spans = build_spans(coord, tenant, db, SUITE_ROWS // 4)
+        ls, ls_err, ls_stg = run_logsearch(executor, session, spans)
+        out["logsearch_ms"] = ls
+        out["logsearch_stages"] = ls_stg
+        out["logsearch_pass"] = f"{len(ls)}/6"
+        if ls_err:
+            out.setdefault("suite_errors", {}).update(
+                {f"ls:{k}": v for k, v in ls_err.items()})
+    except Exception as e:   # string-plane failure must not sink the run
+        out["logsearch_pass"] = {"error": repr(e)[:200]}
     try:
         out["dashboard"] = run_dashboard(executor, coord, tenant, db,
                                          session)

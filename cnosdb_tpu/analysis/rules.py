@@ -654,8 +654,91 @@ class DeviceDecodeAccounting(Rule):
                            f"covering it")
 
 
+# --------------------------------------------------------------------------
+# 12. string-filter-accounting — new (PR 10): no silent per-row fallbacks
+# --------------------------------------------------------------------------
+_SFA_FUNCS = {
+    "cnosdb_tpu/ops/strkernels.py": ("unique_mask", "like_rows",
+                                     "topk_order_indices"),
+    "cnosdb_tpu/sql/expr.py": ("_per_unique_cmp",),
+}
+_SFA_ACCOUNTING = {"note_path", "count", "note_engaged", "count_outcome"}
+
+
+def _sfa_has_accounting(node: ast.AST) -> bool:
+    for n in ast.walk(node):
+        if isinstance(n, ast.Call) and _call_name(n) in _SFA_ACCOUNTING:
+            return True
+    return False
+
+
+def _sfa_silent_none(stmt: ast.AST) -> bool:
+    """``return None`` / bare ``return`` — a decline the CALLER books (the
+    normal evaluator that then runs is not itself a string predicate, e.g.
+    a numeric cmp falling out of _per_unique_cmp)."""
+    return (isinstance(stmt, ast.Return)
+            and (stmt.value is None
+                 or (isinstance(stmt.value, ast.Constant)
+                     and stmt.value.value is None)))
+
+
+class StringFilterAccounting(Rule):
+    name = "string-filter-accounting"
+    motivation = ("PR 10 string/search plane: every exit out of the "
+                  "per-unique/top-k lanes must book a (path, reason) "
+                  "outcome or a topk.* stage — a silent early return "
+                  "reintroduces invisible per-row host fallbacks, the "
+                  "exact regression cnosdb_string_filter_total exists "
+                  "to catch")
+
+    def applies_to(self, relpath):
+        return relpath in _SFA_FUNCS
+
+    def begin_module(self, ctx):
+        want = _SFA_FUNCS.get(ctx.relpath)
+        guarded = want is not None
+        if want is None:
+            # scope-ignored run (fixtures/self-tests): lint any function
+            # bearing a guarded name, but skip the presence check
+            want = tuple({n for names in _SFA_FUNCS.values()
+                          for n in names})
+        found = set()
+        for fn in ast.walk(ctx.tree):
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    or fn.name not in want:
+                continue
+            found.add(fn.name)
+            terminal = fn.body[-1]
+            for block in _dda_blocks(fn):
+                for i, stmt in enumerate(block):
+                    if not isinstance(stmt, (ast.Return, ast.Raise)) \
+                            or stmt is terminal:
+                        continue
+                    prev = block[i - 1] if i else None
+                    if _sfa_has_accounting(stmt) \
+                            or _sfa_silent_none(stmt) \
+                            or (prev is not None
+                                and _sfa_has_accounting(prev)):
+                        continue
+                    kind = "return" if isinstance(stmt, ast.Return) \
+                        else "raise"
+                    ctx.report(self, stmt,
+                               f"unaccounted early {kind} in {fn.name} — "
+                               f"string-lane exits must book a path/"
+                               f"reason (note_path/stages.count) so "
+                               f"per-row fallbacks stay visible on "
+                               f"/metrics")
+        for name in want if guarded else ():
+            if name not in found:
+                ctx.report(self, 1,
+                           f"string-filter guarded function {name} not "
+                           f"found — if it was renamed, update "
+                           f"analysis/rules.py so the lint keeps "
+                           f"covering it")
+
+
 def all_rules() -> list:
     return [NoBareExcept(), RpcCallTimeout(), RowLoop(), RowLoopFallback(),
             LockBlocking(), SwallowedException(), JaxPurity(),
             WallclockDuration(), MetricsNaming(), StageCatalog(),
-            DeviceDecodeAccounting()]
+            DeviceDecodeAccounting(), StringFilterAccounting()]

@@ -13,6 +13,7 @@ and to jit-able predicate closures (device filtering in ops/filter.py).
 """
 from __future__ import annotations
 
+import re
 from dataclasses import dataclass
 from typing import Iterable
 
@@ -256,6 +257,8 @@ class RangeDomain(Domain):
         if isinstance(other, SetDomain):
             vals = {v for v in other.values if self.contains_value(v)}
             return SetDomain(vals) if vals else NoneDomain()
+        if isinstance(other, LikeDomain):
+            return self   # sound: the LIKE re-runs at execution
         assert isinstance(other, RangeDomain)
         out = []
         for a in self.ranges:
@@ -272,6 +275,8 @@ class RangeDomain(Domain):
             # keep as range union (approximate upward: used for pruning, so
             # over-approximation is safe)
             return RangeDomain(self.ranges + [ValueRange(v, True, v, True) for v in other.values])
+        if isinstance(other, LikeDomain):
+            return other.union(self)
         assert isinstance(other, RangeDomain)
         return RangeDomain(self.ranges + other.ranges)
 
@@ -314,6 +319,58 @@ class SetDomain(Domain):
 
     def __repr__(self):
         return f"Set({sorted(self.values)!r})"
+
+
+class LikeDomain(Domain):
+    """Values matching a LIKE pattern (tag LIKE '%x%' pushed into the
+    series index, evaluated per-unique over the tag dictionary). Algebra
+    is a sound over-approximation: intersect keeps the more selective
+    side exactly, union widens to All — rows admitted here are always
+    re-checked by the full predicate at execution."""
+
+    def __init__(self, pattern: str):
+        self.pattern = pattern
+        self._rx = None
+
+    def _regex(self):
+        # models/ cannot import ops/ (jax); this mirrors the host LIKE
+        # automaton at sql.expr.Like._compile, pinned by a parity test
+        if self._rx is None:
+            out = []
+            for ch in self.pattern:
+                if ch == "%":
+                    out.append(".*")
+                elif ch == "_":
+                    out.append(".")
+                else:
+                    out.append(re.escape(ch))
+            self._rx = re.compile("^" + "".join(out) + "$", re.DOTALL)
+        return self._rx
+
+    def intersect(self, other: Domain) -> Domain:
+        if isinstance(other, AllDomain):
+            return self
+        if isinstance(other, NoneDomain):
+            return other
+        if isinstance(other, SetDomain):
+            vals = {v for v in other.values if self.contains_value(v)}
+            return SetDomain(vals) if vals else NoneDomain()
+        # range ∧ like: keep the range (sound; the LIKE re-runs at exec)
+        return other
+
+    def union(self, other: Domain) -> Domain:
+        if isinstance(other, NoneDomain):
+            return self
+        return AllDomain()
+
+    def contains_value(self, v) -> bool:
+        return isinstance(v, str) and bool(self._regex().match(v))
+
+    def __eq__(self, o):
+        return isinstance(o, LikeDomain) and self.pattern == o.pattern
+
+    def __repr__(self):
+        return f"Like({self.pattern!r})"
 
 
 class ColumnDomains:
@@ -404,6 +461,8 @@ def domain_to_wire(d: Domain) -> list:
                           for r in d.ranges]]
     if isinstance(d, SetDomain):
         return ["set", sorted(d.values)]
+    if isinstance(d, LikeDomain):
+        return ["like", d.pattern]
     raise TypeError(f"unknown domain {type(d).__name__}")
 
 
@@ -416,4 +475,6 @@ def domain_from_wire(w: list) -> Domain:
     if tag == "range":
         return RangeDomain([ValueRange(lo, li, hi, hic)
                             for lo, li, hi, hic in w[1]])
+    if tag == "like":
+        return LikeDomain(w[1])
     return SetDomain(w[1])

@@ -192,13 +192,56 @@ class DictArray:
 def unify_dictionaries(das: list) -> np.ndarray:
     """→ the sorted union dictionary over all parts. Non-mutating (decoded
     DictArrays can be shared through reader caches across concurrent
-    scans); callers re-express codes via `d.remap_to(union)`."""
-    vals = [d.values for d in das if len(d.values)]
+    scans); callers re-express codes via `d.remap_to(union)`.
+
+    One hash-based dedup over Σ|U_i| then one sort of |U_union| — the
+    previous np.unique(concatenate) sorted the full Σ|U_i| with Python
+    compares, which dominated factorize_ms on multi-page assemblies.
+    Parts sharing a dictionary object (scan-cache reuse) dedupe by id
+    first so their uniques hash once."""
+    vals = []
+    seen_ids = set()
+    for d in das:
+        v = d.values
+        if len(v) and id(v) not in seen_ids:
+            seen_ids.add(id(v))
+            vals.append(v)
     if not vals:
         return np.array([""], dtype=object)
-    if len(vals) == 1 or all(v is vals[0] for v in vals[1:]):
+    if len(vals) == 1:
         return vals[0]
-    return np.unique(np.concatenate(vals))
+    cat = np.concatenate(vals)
+    if pa is not None:
+        try:
+            uniq = pa.array(cat, type=pa.large_utf8(),
+                            from_pandas=False).unique().to_pylist()
+            uniq.sort()
+            out = np.empty(len(uniq), dtype=object)
+            out[:] = uniq
+            return out
+        except Exception:
+            pass  # non-str entries → the object-compare path below
+    return np.unique(cat)
+
+
+def dict_encode_strict(arr: np.ndarray) -> "DictArray | None":
+    """Hash-encode an all-string object array through arrow (no null or
+    non-str coercion — None on anything that isn't pure str, so callers
+    keep their exact legacy semantics for mixed columns). Used by
+    relational.group_indices to factorize string keys without the
+    astype("U") copy + O(N log N) Python-compare sort."""
+    if pa is None or not isinstance(arr, np.ndarray) or arr.dtype != object:
+        return None
+    try:
+        pa_arr = pa.array(arr, type=pa.large_utf8(), from_pandas=False)
+    except Exception:
+        return None
+    if pa_arr.null_count:
+        return None
+    enc = pa_arr.dictionary_encode()
+    codes = enc.indices.to_numpy(zero_copy_only=False).astype(np.int64)
+    values = np.array(enc.dictionary.to_pylist(), dtype=object)
+    return DictArray._normalize(codes, values)
 
 
 def as_object_array(vals) -> np.ndarray:

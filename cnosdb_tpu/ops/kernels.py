@@ -379,3 +379,36 @@ def sorted_pair_codes(gid: np.ndarray, vcodes: np.ndarray,
     sp = np.asarray(_device_sort(pairs))[:n]
     keep = np.concatenate(([True], sp[1:] != sp[:-1]))
     return sp[keep]
+
+
+@functools.partial(jax.jit, static_argnames=("k",))
+def _topk_threshold(vals, *, k: int):
+    top, _ = jax.lax.top_k(vals, k)
+    return top[k - 1]
+
+
+def dict_mask_gather(mask: np.ndarray, codes):
+    """Per-unique predicate mask → row mask on device: one integer gather
+    through the dictionary codes (the strkernels broadcast for codes that
+    already live on the accelerator via EagerUploader.put_device)."""
+    return _dict_mask_gather(jnp.asarray(mask), codes)
+
+
+_dict_mask_gather = jax.jit(lambda mask, codes: jnp.take(mask, codes, axis=0,
+                                                         mode="clip"))
+
+
+def topk_threshold(vals: np.ndarray, k: int):
+    """k-th largest value of `vals` (descending top-K threshold) via
+    jax.lax.top_k; only this scalar crosses back to host. Rows are padded
+    to a size class with the dtype minimum so jit caches a handful of
+    programs; caller guarantees 0 < k < len(vals) and no NaNs."""
+    n = len(vals)
+    np_pad = pad_rows(n)
+    if np_pad != n:
+        if vals.dtype.kind == "f":
+            fill = vals.dtype.type(-np.inf)
+        else:
+            fill = np.iinfo(vals.dtype).min
+        vals = _pad(vals, np_pad, fill=fill)
+    return np.asarray(_topk_threshold(vals, k=int(k)))

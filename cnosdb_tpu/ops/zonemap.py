@@ -18,7 +18,9 @@ from __future__ import annotations
 import numpy as np
 
 from ..models.schema import ValueType
-from ..sql.expr import Between, BinOp, Column, InList, Literal
+from ..models.strcol import DictArray
+from ..sql.expr import Between, BinOp, Column, InList, Like, Literal
+from . import strkernels
 
 BLOCK = 8192
 
@@ -138,6 +140,24 @@ def possible_blocks(e, batch) -> np.ndarray | None:
         for v in e.values:
             m |= (bmin <= v) & (bmax >= v)
         return m
+    if isinstance(e, Like) and isinstance(e.pattern, str) \
+            and isinstance(e.expr, Column):
+        f = batch.fields.get(e.expr.name)
+        if f is None:
+            return None
+        vt, vals, _valid = f
+        if vt != ValueType.STRING or not isinstance(vals, DictArray) \
+                or not len(vals):
+            return None
+        # per-unique LIKE mask, broadcast through codes, reduced per
+        # block. Sound under negation too: a valid matching row always
+        # sets its block; invalid rows (code 0) can only ADD blocks.
+        mask, _reason = strkernels.unique_mask(vals.values, e.pattern)
+        if e.negated:
+            mask = ~mask
+        rows = mask[vals.codes]
+        starts = np.arange(0, len(rows), BLOCK)
+        return np.logical_or.reduceat(rows, starts)
     return None
 
 

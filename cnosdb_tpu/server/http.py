@@ -894,6 +894,12 @@ class HttpServer:
             for (lane, reason), n in _dd.outcomes_snapshot().items():
                 self.metrics.set_counter("cnosdb_device_decode_total", n,
                                          lane=lane, reason=reason)
+        # string/search plane: per-(path, reason) predicate outcomes
+        _sk = _sys.modules.get("cnosdb_tpu.ops.strkernels")
+        if _sk is not None:
+            for (path, reason), n in _sk.outcomes_snapshot().items():
+                self.metrics.set_counter("cnosdb_string_filter_total", n,
+                                         path=path, reason=reason)
         _mv = _sys.modules.get("cnosdb_tpu.sql.matview")
         if _mv is not None:
             for name, n in _mv.counters_snapshot().items():

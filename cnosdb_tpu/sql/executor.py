@@ -2814,8 +2814,31 @@ class QueryExecutor:
             n_groups = 1
 
         agg_cache: dict[str, np.ndarray] = {}
+        # Gather per-group representatives only for names the
+        # post-aggregate exprs (keys/items/HAVING/ORDER BY) can reach —
+        # gathering every env column was O(columns × groups) object
+        # traffic on wide scans. Aggregate args read scope.env directly.
+        needed: set[str] = set()
+        for e in key_exprs:
+            needed |= e.columns()
+        for it in stmt.items:
+            if isinstance(it.expr, Expr):
+                needed |= it.expr.columns()
+        if stmt.having is not None:
+            needed |= stmt.having.columns()
+        for oe, _asc in stmt.order_by:
+            if isinstance(oe, Expr):
+                needed |= oe.columns()
+            elif isinstance(oe, str):
+                needed.add(oe)
+        for name in list(needed):
+            if "." in name:   # struct access resolves through the base col
+                needed.add(name.rpartition(".")[0])
         genv = {}
         for k, v in scope.env.items():
+            base = k[10:] if k.startswith("__valid__:") else k
+            if base not in needed:
+                continue
             gv = v[first_idx]
             if n_groups and len(gv) < n_groups:   # synthesized empty group
                 gv = np.full(n_groups, None, dtype=object)
@@ -4577,13 +4600,24 @@ def _order_limit(rs: ResultSet, order_by, limit, offset, env) -> ResultSet:
             keys.append(vals)
             if nulls is not None:
                 keys.append(nulls)  # later key = higher priority in lexsort
-        idx = np.lexsort(keys)
-        # lexsort is ascending on all; apply desc by flipping per-key is
-        # complex — handle single-key desc and uniform direction fast paths
-        if all(not asc for _, asc in order_by):
-            idx = idx[::-1]
-        elif not all(asc for _, asc in order_by):
-            idx = _mixed_order(order_by, env, n)
+        idx = None
+        if limit is not None and len(order_by) == 1 and len(keys) == 1:
+            # ORDER BY key LIMIT k: select-then-gather top-k
+            # (ops/strkernels; device threshold on TPU) instead of a full
+            # sort — bit-identical tie order, or None → full sort below
+            from ..ops import strkernels
+
+            idx = strkernels.topk_order_indices(
+                keys[0], None, order_by[0][1], (offset or 0) + limit)
+        if idx is None:
+            idx = np.lexsort(keys)
+            # lexsort is ascending on all; apply desc by flipping per-key
+            # is complex — handle single-key desc and uniform direction
+            # fast paths
+            if all(not asc for _, asc in order_by):
+                idx = idx[::-1]
+            elif not all(asc for _, asc in order_by):
+                idx = _mixed_order(order_by, env, n)
         rs = ResultSet(rs.names, [c[idx] for c in rs.columns])
     if offset:
         rs = ResultSet(rs.names, [c[offset:] for c in rs.columns])

@@ -17,7 +17,7 @@ import numpy as np
 
 from ..errors import PlanError
 from ..models.predicate import (
-    AllDomain, ColumnDomains, NoneDomain, RangeDomain, SetDomain,
+    AllDomain, ColumnDomains, LikeDomain, NoneDomain, RangeDomain, SetDomain,
 )
 from ..models.strcol import DictArray
 
@@ -357,6 +357,11 @@ class BinOp(Expr):
         f = _BIN_OPS.get(self.op)
         if f is None:
             raise PlanError(f"unknown operator {self.op!r}")
+        if xp is np and self.op in ("=", "!=", "<", "<=", ">", ">=") \
+                and "__unique_eval__" not in env:
+            out = self._per_unique_cmp(env)
+            if out is not None:
+                return out
         a = self.left.eval(env, xp)
         b = self.right.eval(env, xp)
         if xp is np and (_is_obj_arr(a) or _is_obj_arr(b)):
@@ -398,12 +403,80 @@ class BinOp(Expr):
             out = _mask_operand_validity(out, env, self.left, self.right)
         return out
 
+    def _per_unique_cmp(self, env):
+        """substr-equality lane (ops/strkernels): a comparison whose only
+        column is a DictArray reached through pure string funcs evaluates
+        once per UNIQUE — the same tree runs against a one-row-per-unique
+        surrogate env (host semantics by construction, `__unique_eval__`
+        stops recursion) and the bool mask gathers through the codes.
+        Returns None for any shape outside the lane (caller books nothing:
+        the row path itself is not a string-plane fallback for e.g.
+        numeric cmps)."""
+        if not (isinstance(self.left, Func) or isinstance(self.right, Func)):
+            return None   # bare col-vs-literal is already per-unique
+        if not (_unique_safe(self.left) and _unique_safe(self.right)):
+            return None
+        cols = self.columns()
+        if len(cols) != 1:
+            return None
+        (cname,) = cols
+        try:
+            da = env.get(cname)
+        except AttributeError:
+            return None
+        if not isinstance(da, DictArray) or not len(da.values):
+            return None
+        from ..ops import strkernels
+
+        if not strkernels.enabled():
+            strkernels.note_path("host_fallback", "lane_disabled")
+            return None
+        senv = {cname: strkernels.unique_surrogate(da),
+                "__unique_eval__": True}
+        try:
+            um = self.eval(senv, np)
+        except Exception:
+            return None
+        if not (isinstance(um, np.ndarray) and um.dtype == bool
+                and um.shape == (len(da.values),)):
+            return None
+        strkernels.note_path("per_unique", "cmp")
+        out = strkernels.broadcast_codes(um, da.codes)
+        return _mask_operand_validity(out, env, self.left, self.right)
+
     def columns(self):
         return self.left.columns() | self.right.columns()
 
     def to_sql(self):
         op = self.op.upper() if self.op in ("and", "or") else self.op
         return f"({self.left.to_sql()} {op} {self.right.to_sql()})"
+
+
+_UNIQUE_SAFE_FUNCS = frozenset({
+    # pure value→value string scalars: per-unique evaluation is exact
+    "substr", "substring", "lower", "upper", "trim", "ltrim", "rtrim",
+    "btrim", "reverse", "replace", "left", "right", "repeat", "length",
+    "char_length", "character_length", "octet_length", "bit_length",
+    "concat", "translate", "lpad", "rpad", "split_part", "strpos",
+    "position", "starts_with", "ends_with", "initcap", "md5", "ascii",
+    "chr", "to_hex",
+})
+
+
+def _unique_safe(e) -> bool:
+    """True when `e` is a pure scalar tree (columns, literals, whitelisted
+    string funcs) whose value depends only on the row's own value — the
+    admission test for BinOp's per-unique surrogate lane."""
+    if isinstance(e, Column):
+        return True
+    if isinstance(e, Literal):
+        return not isinstance(e.value, Expr)
+    if isinstance(e, Func):
+        return (e.name.lower() in _UNIQUE_SAFE_FUNCS
+                and e.agg_order is None
+                and all(isinstance(a, Expr) and _unique_safe(a)
+                        for a in e.args))
+    return False
 
 
 def _is_interval(v) -> bool:
@@ -767,6 +840,9 @@ class Like(Expr):
     def _eval_dynamic(self, env, xp):
         """Pattern is an EXPRESSION (sqlancer: x LIKE (cast(...)||t0)):
         evaluate both sides row-wise, compile per distinct pattern."""
+        from ..ops import strkernels
+
+        strkernels.note_path("host_fallback", "dynamic_pattern")
         v = self.expr.eval(env, xp)
         p = self.pattern.eval(env, xp)
         n = _env_rows(env)
@@ -793,14 +869,23 @@ class Like(Expr):
     def eval(self, env, xp):
         if isinstance(self.pattern, Expr):
             return self._eval_dynamic(env, xp)
+        from ..ops import strkernels
+
         v = self.expr.eval(env, xp)
         rx = self._regex()
         if isinstance(v, DictArray):
-            # regex once per unique, gather to rows
-            out = v.map_values(
-                lambda x: bool(rx.match(x)) if isinstance(x, str) else False,
-                out_dtype=bool)
-            out = ~out if self.negated else out
+            if strkernels.enabled():
+                # per-unique lane: classified vectorized mask over the
+                # dictionary (or regex-per-unique), gathered through codes
+                out = strkernels.like_rows(v, self.pattern, rx=rx,
+                                           negated=self.negated)
+            else:
+                strkernels.note_path("host_fallback", "lane_disabled")
+                out = v.map_values(
+                    lambda x: bool(rx.match(x))
+                    if isinstance(x, str) else False,
+                    out_dtype=bool)
+                out = ~out if self.negated else out
             if xp is np:
                 out = _mask_operand_validity(out, env, self.expr)
             return out
@@ -808,6 +893,7 @@ class Like(Expr):
         if arr is None:
             m = bool(rx.match(str(v)))
             return (not m) if self.negated else m
+        strkernels.note_path("host_fallback", "unencoded_rows")
         out = np.fromiter(
             (bool(rx.match(x)) if isinstance(x, str) else False for x in arr),
             dtype=bool, count=len(arr))
@@ -2656,6 +2742,15 @@ def _extract(e: Expr, cols: set[str]) -> ColumnDomains:
                 e.expr.name,
                 RangeDomain.of(low=e.low.value, high=e.high.value))
         return ColumnDomains.all()
+    if (isinstance(e, Like) and not e.negated
+            and isinstance(e.expr, Column) and isinstance(e.pattern, str)
+            and e.expr.name in cols):
+        if "%" not in e.pattern and "_" not in e.pattern:
+            # wildcard-free LIKE is equality — plus the $-accepts-a-
+            # trailing-newline quirk of the host automaton
+            return ColumnDomains.of(
+                e.expr.name, SetDomain([e.pattern, e.pattern + "\n"]))
+        return ColumnDomains.of(e.expr.name, LikeDomain(e.pattern))
     return ColumnDomains.all()
 
 

@@ -20,7 +20,7 @@ import copy
 import numpy as np
 
 from ..errors import PlanError
-from ..models.strcol import DictArray
+from ..models.strcol import DictArray, dict_encode_strict
 from ..ops import group_agg as _ga
 from ..utils import stages
 from .expr import BinOp, Column, Expr, Func, WindowFunc
@@ -370,9 +370,22 @@ def group_indices(key_cols: list, n: int):
     with stages.stage("factorize_ms"):
         parts = []
         for kc in key_cols:
+            if isinstance(kc, DictArray):
+                # already factorized: codes are ranks into the sorted
+                # dictionary (re-densified by the final np.unique below)
+                parts.append((kc.codes.astype(np.int64).ravel(),
+                              len(kc.values)))
+                continue
             kc = np.asarray(kc)
-            _, inv = np.unique(kc.astype("U") if kc.dtype == object else kc,
-                               return_inverse=True)
+            if kc.dtype == object:
+                enc = dict_encode_strict(kc)
+                if enc is not None:
+                    parts.append((enc.codes.astype(np.int64).ravel(),
+                                  len(enc.values)))
+                    continue
+                # mixed/null keys keep the legacy stringified sort
+                kc = kc.astype("U")
+            _, inv = np.unique(kc, return_inverse=True)
             inv = inv.astype(np.int64).ravel()
             parts.append((inv, int(inv.max()) + 1))
         ids, _ = _ga.combine_codes(parts)

@@ -33,7 +33,8 @@ import numpy as np
 
 from ..errors import IndexError_
 from ..models.predicate import (
-    AllDomain, ColumnDomains, Domain, NoneDomain, RangeDomain, SetDomain,
+    AllDomain, ColumnDomains, Domain, LikeDomain, NoneDomain, RangeDomain,
+    SetDomain,
 )
 from ..models.series import SeriesKey
 from .record_file import RecordReader, RecordWriter
@@ -610,6 +611,31 @@ class TSIndex:
                 vals.update(self._ckpt.tag_values(table, tag_key))
             parts = [self._value_sids(table, tag_key, v)
                      for v in vals if dom.contains_value(v)]
+            parts = [p for p in parts if len(p)]
+            if not parts:
+                return np.empty(0, dtype=np.uint64)
+            return np.unique(np.concatenate(parts))
+        if isinstance(dom, LikeDomain):
+            # tag LIKE '%x%': the tag value set IS a dictionary — one
+            # vectorized per-unique mask (ops/strkernels), then sid unions
+            # for the matching values only
+            vals = set(self._inverted.get(table, {}).get(tag_key, {}).keys())
+            if self._ckpt is not None:
+                vals.update(self._ckpt.tag_values(table, tag_key))
+            if not vals:
+                return np.empty(0, dtype=np.uint64)
+            varr = np.empty(len(vals), dtype=object)
+            varr[:] = sorted(vals)
+            try:
+                from ..ops import strkernels
+
+                mask, _reason = strkernels.unique_mask(varr, dom.pattern)
+            except ImportError:   # host-only deploy: scalar per-unique
+                mask = np.fromiter(
+                    (dom.contains_value(v) for v in varr),
+                    dtype=bool, count=len(varr))
+            parts = [self._value_sids(table, tag_key, v)
+                     for v in varr[mask]]
             parts = [p for p in parts if len(p)]
             if not parts:
                 return np.empty(0, dtype=np.uint64)

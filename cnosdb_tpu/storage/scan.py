@@ -598,14 +598,23 @@ def _page_constraints(page_filter, field_names) -> dict:
     page stats exclude only NaNs, which satisfy no comparison).
     → {col: [("op", value) | ("between", (lo, hi)) | ("in", values)]}
     """
-    from ..sql.expr import Between, BinOp, Column, InList, Literal
+    import os
+
+    from ..sql.expr import Between, BinOp, Column, InList, Like, Literal
 
     fields = set(field_names)
     out: dict[str, list] = {}
+    ngram_on = os.environ.get("CNOSDB_NGRAM_SKIP", "1").lower() \
+        not in ("0", "off", "false")
 
     def numeric(v):
         return isinstance(v, (int, float, np.integer, np.floating)) \
             and not isinstance(v, bool)
+
+    def add_ngram(col, tris):
+        # a subset of required trigrams only admits MORE pages — sound
+        if ngram_on and tris:
+            out.setdefault(col, []).append(("ngram", tris))
 
     def walk(e):
         if isinstance(e, BinOp):
@@ -623,6 +632,17 @@ def _page_constraints(page_filter, field_names) -> dict:
                     col, lit, op = e.right.name, e.left.value, flip[e.op]
                 if col in fields and numeric(lit):
                     out.setdefault(col, []).append((op, lit))
+                elif col in fields and op == "=" and isinstance(lit, str):
+                    from ..ops import strkernels
+
+                    add_ngram(col, strkernels.value_trigrams(lit))
+            return
+        if isinstance(e, Like) and not e.negated \
+                and isinstance(e.expr, Column) and isinstance(e.pattern, str) \
+                and e.expr.name in fields:
+            from ..ops import strkernels
+
+            add_ngram(e.expr.name, strkernels.required_trigrams(e.pattern))
             return
         if isinstance(e, Between) and not e.negated \
                 and isinstance(e.expr, Column) \
@@ -653,6 +673,20 @@ def _page_admits(cols: dict, i: int, constraints: dict) -> bool:
         if col is None:
             return False
         pm = col.pages[i]
+        ngram_cons = [c for c in cons if c[0] == "ngram"]
+        if ngram_cons:
+            # checked before the stats gate: string pages carry no
+            # min/max (the `continue` below) but do carry signatures
+            sig = getattr(pm, "ngram", None)
+            if sig is not None:
+                from ..ops import strkernels
+
+                for _op, tris in ngram_cons:
+                    if not strkernels.signature_admits(sig, tris):
+                        stages.count("ngram_pages_skipped", 1)
+                        strkernels.note_path("ngram_skip", "page")
+                        return False
+            cons = [c for c in cons if c[0] != "ngram"]
         lo, hi = pm.stat_min, pm.stat_max
         if lo is None or hi is None:
             continue   # no stats (e.g. all-null page): cannot prune

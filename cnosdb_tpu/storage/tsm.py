@@ -51,6 +51,25 @@ _ZC = codecs._TlsZstd(1)
 _ZD = codecs._TlsZstd(None)
 
 
+def _string_signature(dense) -> bytes | None:
+    """Trigram page-skip signature for one string page (flush and
+    compaction both land here via TsmWriter.write_series). Advisory:
+    any failure yields None (page always admits), never a failed seal.
+    Lazy import — strkernels lives in ops/, whose package init pulls jax;
+    host-only storage paths must not pay that unless a string page is
+    actually sealed."""
+    try:
+        from ..ops import strkernels
+
+        if isinstance(dense, DictArray):
+            uniques = dense.values[np.unique(dense.codes)]
+        else:
+            uniques = {v for v in dense if isinstance(v, str)}
+        return strkernels.build_page_signature(uniques)
+    except Exception:
+        return None
+
+
 # ---------------------------------------------------------------------------
 # metadata model
 # ---------------------------------------------------------------------------
@@ -72,12 +91,16 @@ class PageMeta:
     # ±inf-inclusive stats. Predicate pruning (scan._page_admits) must not
     # prune float pages below version 1 — their interval may lie.
     stats_version: int = 0
+    # string pages: trigram bloom signature over the page's distinct
+    # values (ops/strkernels.build_page_signature). None = pre-signature
+    # file (never prunes); b"" = page provably holds no 3-byte substring.
+    ngram: bytes | None = None
 
     def to_list(self):
         return [self.offset, self.size, self.n_rows, self.n_values,
                 self.value_type, self.encoding, self.min_ts, self.max_ts,
                 self.stat_min, self.stat_max, self.stat_sum,
-                self.stats_version]
+                self.stats_version, self.ngram]
 
     @classmethod
     def from_list(cls, l):
@@ -252,8 +275,11 @@ class TsmWriter:
                     dense = vals
                     bitset = b""
                     has_nulls = False
+                ngram = None
                 if vt in (ValueType.STRING, ValueType.GEOMETRY):
                     smin = smax = ssum = None
+                    if vt == ValueType.STRING:
+                        ngram = _string_signature(dense)
                 else:
                     dense = np.ascontiguousarray(dense)
                     smin, smax, ssum = _compute_stats(dense, vt)
@@ -265,7 +291,7 @@ class TsmWriter:
                 cm.pages.append(PageMeta(
                     off, size, e - s, nvals, int(vt), blk[0],
                     int(seg_ts[0]), int(seg_ts[-1]), smin, smax, ssum,
-                    stats_version=1))
+                    stats_version=1, ngram=ngram))
             chunk.columns.append(cm)
         group.chunks[series_id] = chunk
 

@@ -74,12 +74,21 @@ STAGE_CATALOG: dict[str, str] = {
                     "view subsumed (raw scan)",
     "matview.seed_groups": "accumulator groups seeded from sealed view "
                            "buckets by rewritten queries",
+    "ngram_pages_skipped": "string pages pruned before decode by trigram "
+                           "signatures (ops/strkernels)",
+    "topk.host": "ORDER BY+LIMIT answered by np.partition select-then-"
+                 "gather instead of a full sort",
+    "topk.device": "ORDER BY+LIMIT thresholds computed by jax.lax.top_k",
+    "topk.declined": "ORDER BY+LIMIT shapes outside the top-k fast path "
+                     "(nulls/NaN/object keys, k≥n) — full sort",
 }
 
 # Prefixes for names composed at runtime (skipped by the literal lint
 # check but still part of the documented schema):
 #   rpc_<method>_ms — server-side wall time of one RPC handler dispatch
-DYNAMIC_STAGE_PREFIXES = ("rpc_",)
+#   string_path.<path> — string predicates per strkernels lane
+#     (per_unique / ngram_skip / host_fallback)
+DYNAMIC_STAGE_PREFIXES = ("rpc_", "string_path.")
 
 _profile: contextvars.ContextVar = contextvars.ContextVar(
     "cnos_query_profile", default=None)

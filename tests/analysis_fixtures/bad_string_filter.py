@@ -1,0 +1,23 @@
+"""Fixture: string-filter lane exits that skip path/reason booking
+(lines 10 and 21). Mirrors the guarded function names so the rule finds
+its targets when scope is ignored; the booked return, the terminal
+returns, and the caller-booked bare `return None` decline are legal
+shapes and must stay silent."""
+
+
+def unique_mask(values, pattern, note_path):
+    if not len(values):
+        return [], "empty"
+    if pattern is None:
+        note_path("host_fallback", "dynamic_pattern")
+        return [False] * len(values), "dynamic"
+    return [True] * len(values), "contains"
+
+
+def topk_order_indices(vals, nulls, asc, k, count):
+    if k <= 0:
+        return None
+    if nulls is not None:
+        return list(range(k))
+    count("topk.host", 1)
+    return sorted(range(len(vals)), key=vals.__getitem__)[:k]

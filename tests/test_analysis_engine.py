@@ -42,6 +42,7 @@ _CASES = [
     ("bad_row_loop.py", rules_mod.RowLoopFallback(), [21]),
     ("bad_stage_name.py", rules_mod.StageCatalog(), [6, 9, 12]),
     ("bad_device_decode.py", rules_mod.DeviceDecodeAccounting(), [9, 18]),
+    ("bad_string_filter.py", rules_mod.StringFilterAccounting(), [10, 21]),
 ]
 
 
