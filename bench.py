@@ -820,8 +820,9 @@ def main():
 
         device = _device_kernel_metric()
         _persist_device_evidence(device)
-        # invariant plane: current static-analysis finding counts, so a
-        # bench artifact records the tree's lint debt alongside its perf
+        # invariant plane: per-rule finding counts + analyzer wall time,
+        # so a bench artifact records the tree's lint debt AND what the
+        # static plane costs alongside the perf it guards
         try:
             from cnosdb_tpu import analysis as _analysis
 

@@ -13,11 +13,25 @@ the tree with their own conventions and covered only two directories.
 This package replaces them: rules live in :mod:`.rules`, every rule
 names the incident that motivated it, and the whole tree is in scope.
 
+Two rule shapes share the registry:
+
+* per-file rules (:class:`Rule`) see one module at a time from the
+  single shared AST walk;
+* interprocedural rules (:class:`ProjectRule`) run once per lint run
+  over the project call graph + per-function summaries built by
+  :mod:`.interproc` — params/returns tagged host, device, or
+  tainted-by-device, fixed-point over a worklist — so a device array
+  produced two call edges away still counts as device at the sink.
+
 Usage:
 
     python -m cnosdb_tpu.analysis              # lint the package, exit 0/1
     python -m cnosdb_tpu.analysis --json       # machine-readable findings
     python -m cnosdb_tpu.analysis --fix-baseline   # re-freeze current debt
+    python -m cnosdb_tpu.analysis --changed REF    # findings only for files
+                                                   # touched since git REF
+    python -m cnosdb_tpu.analysis --callgraph      # dump the call graph +
+                                                   # summaries and exit
 
 Suppressions: append ``# lint: disable=<rule>[,<rule>…]  (reason)`` to
 the offending line (the line the finding points at — the ``with``/
@@ -33,6 +47,7 @@ from __future__ import annotations
 
 import ast
 import dataclasses
+import io
 import json
 import os
 import tokenize
@@ -78,6 +93,17 @@ class Rule:
         pass
 
 
+class ProjectRule(Rule):
+    """Interprocedural invariant: instead of per-node visits it gets one
+    ``check(project)`` call over the whole-run call graph + summaries
+    (:class:`cnosdb_tpu.analysis.interproc.Project`). ``applies_to``
+    scopes where findings may be *reported*; summaries are always built
+    from every file in the run so taint crosses file boundaries."""
+
+    def check(self, project) -> None:
+        raise NotImplementedError
+
+
 class ModuleContext:
     """Per-file state shared by every rule during the single walk."""
 
@@ -89,10 +115,19 @@ class ModuleContext:
         self.lines = source.splitlines()
         self.tree = tree
         self._sink = sink
+        # --changed mode: muted files contribute call-graph summaries but
+        # produce no findings
+        self.muted = False
+        # lines where an inline disable actually absorbed a finding this
+        # run — the stale-suppression audit flags the rest
+        self.suppressed_lines: set = set()
 
     def report(self, rule: Rule, node, message: str) -> None:
         line = node if isinstance(node, int) else node.lineno
         if self._suppressed(rule.name, line):
+            self.suppressed_lines.add(line)
+            return
+        if self.muted:
             return
         self._sink.append(Finding(rule.name, self.relpath, line, message))
 
@@ -139,6 +174,8 @@ class Report:
     stale: list              # (rule, path, baselined, found) under-budget
     counts: dict             # (rule, path) → found count
     baseline: dict           # (rule, path) → allowed count
+    rule_totals: dict = dataclasses.field(default_factory=dict)
+    wall_ms: float = 0.0     # analyzer wall time for this run
 
     @property
     def ok(self) -> bool:
@@ -152,6 +189,13 @@ class Report:
             "stale": [{"rule": r, "path": p, "baselined": b, "found": n}
                       for (r, p, b, n) in self.stale],
             "counts": {f"{r}:{p}": n for (r, p), n in sorted(self.counts.items())},
+            # CI artifact: one-line-diffable per-rule totals (a gauge per
+            # rule label, zero-filled for every registered rule)
+            "metrics": {
+                "cnosdb_analysis_findings_total":
+                    dict(sorted(self.rule_totals.items())),
+                "cnosdb_analysis_wall_ms": self.wall_ms,
+            },
         }
 
 
@@ -177,29 +221,47 @@ def write_baseline(counts: dict, path: str = BASELINE_PATH) -> dict:
     return out
 
 
-def lint_files(paths=None, rules=None, ignore_scope: bool = False) -> list:
+def lint_files(paths=None, rules=None, ignore_scope: bool = False,
+               report_filter=None) -> list:
     """Run every rule over ``paths`` (default: the whole package) with a
     single AST walk per file; returns raw findings (suppressions already
-    honored, baseline NOT yet applied)."""
+    honored, baseline NOT yet applied).
+
+    ``report_filter``: optional set of relpaths; files outside it are
+    still parsed and indexed (interprocedural summaries need the whole
+    project) but report no findings — this is the --changed mode.
+
+    When run with the full registry (``rules is None``), a trailing
+    stale-suppression audit flags ``# lint: disable=`` comments that
+    absorbed no finding during this run."""
     from . import rules as rules_mod
 
     active = list(rules) if rules is not None else rules_mod.all_rules()
+    per_file = [r for r in active if not isinstance(r, ProjectRule)]
+    project_rules = [r for r in active if isinstance(r, ProjectRule)]
+    audit = rules is None
     findings: list[Finding] = []
+    contexts: list[ModuleContext] = []
     for path in iter_py_files(paths):
         relpath = norm_relpath(path)
-        scoped = [r for r in active
-                  if ignore_scope or r.applies_to(relpath)]
-        if not scoped:
+        muted = report_filter is not None and relpath not in report_filter
+        scoped = [] if muted else [r for r in per_file
+                                   if ignore_scope or r.applies_to(relpath)]
+        if not scoped and not project_rules and not audit:
             continue
         try:
             with tokenize.open(path) as f:   # honors coding cookies
                 source = f.read()
             tree = ast.parse(source, filename=path)
         except (SyntaxError, UnicodeDecodeError) as e:
-            findings.append(Finding("parse-error", relpath,
-                                    getattr(e, "lineno", 1) or 1, repr(e)))
+            if not muted:
+                findings.append(Finding("parse-error", relpath,
+                                        getattr(e, "lineno", 1) or 1,
+                                        repr(e)))
             continue
         ctx = ModuleContext(path, relpath, source, tree, findings)
+        ctx.muted = muted
+        contexts.append(ctx)
         dispatch: dict[type, list] = {}
         for rule in scoped:
             rule.begin_module(ctx)
@@ -209,12 +271,62 @@ def lint_files(paths=None, rules=None, ignore_scope: bool = False) -> list:
             for node in ast.walk(tree):
                 for rule in dispatch.get(type(node), ()):
                     rule.visit(node, ctx)
+    if project_rules and contexts:
+        from . import interproc
+
+        project = interproc.Project(contexts, ignore_scope=ignore_scope)
+        for rule in project_rules:
+            rule.check(project)
+    if audit:
+        _audit_suppressions(contexts, findings)
     return findings
 
 
+def _disable_comments(source: str):
+    """Yield ``(lineno, rule-list)`` for every REAL ``# lint: disable=``
+    comment. Tokenized rather than text-scanned so docstrings/strings
+    that merely *mention* the marker (this module's own docs, fixtures)
+    don't count as suppressions."""
+    reader = io.StringIO(source).readline
+    try:
+        for tok in tokenize.generate_tokens(reader):
+            if tok.type != tokenize.COMMENT:
+                continue
+            at = tok.string.find(_DISABLE_MARK)
+            if at < 0:
+                continue
+            spec = tok.string[at + len(_DISABLE_MARK):]
+            names = spec.split()[0].rstrip("(") if spec.split() else ""
+            yield tok.start[0], names
+    except (tokenize.TokenError, IndentationError):
+        return
+
+
+def _audit_suppressions(contexts, findings) -> None:
+    """Flag ``# lint: disable=`` comments that suppressed nothing in this
+    run — dead weight at best, a typo'd rule name silently disabling
+    nothing at worst. Only meaningful on full-registry runs (a subset run
+    legitimately leaves other rules' suppressions idle)."""
+    for ctx in contexts:
+        if ctx.muted:
+            continue
+        for lineno, names in _disable_comments(ctx.source):
+            if lineno in ctx.suppressed_lines:
+                continue
+            findings.append(Finding(
+                "stale-suppression", ctx.relpath, lineno,
+                f"suppression 'disable={names}' absorbed no finding — "
+                f"the debt it excused is gone (or the rule name is "
+                f"wrong); delete the comment"))
+
+
 def run(paths=None, rules=None, baseline_path: str = BASELINE_PATH,
-        ignore_scope: bool = False) -> Report:
-    findings = lint_files(paths, rules=rules, ignore_scope=ignore_scope)
+        ignore_scope: bool = False, report_filter=None) -> Report:
+    import time as _time
+
+    t0 = _time.perf_counter()
+    findings = lint_files(paths, rules=rules, ignore_scope=ignore_scope,
+                          report_filter=report_filter)
     baseline = load_baseline(baseline_path)
     counts: dict[tuple, int] = {}
     for f in findings:
@@ -225,19 +337,35 @@ def run(paths=None, rules=None, baseline_path: str = BASELINE_PATH,
     # stale cells only matter for files this run actually looked at —
     # a subset run must not flag the rest of the tree's baseline
     seen_paths = {norm_relpath(p) for p in iter_py_files(paths)}
+    if report_filter is not None:
+        seen_paths &= set(report_filter)
     stale = [(rule, relpath, allowed, counts.get((rule, relpath), 0))
              for (rule, relpath), allowed in sorted(baseline.items())
              if relpath in seen_paths
              and counts.get((rule, relpath), 0) < allowed]
+    if rules is None:
+        from . import rules as rules_mod
+
+        rule_totals = {r.name: 0 for r in rules_mod.all_rules()}
+    else:
+        rule_totals = {r.name: 0 for r in rules}
+    for f in findings:
+        rule_totals[f.rule] = rule_totals.get(f.rule, 0) + 1
     return Report(findings=findings, violations=violations, stale=stale,
-                  counts=counts, baseline=baseline)
+                  counts=counts, baseline=baseline,
+                  rule_totals=rule_totals,
+                  wall_ms=round((_time.perf_counter() - t0) * 1000.0, 1))
 
 
 def finding_counts() -> dict:
-    """Cheap whole-tree summary for bench metadata: total findings, how
-    many ride on the baseline, and how many are hard violations."""
+    """Whole-tree summary for bench metadata: totals, per-rule finding
+    counts, and the analyzer's wall time, so the cost of the static
+    plane rides in the perf trajectory next to the numbers it guards."""
     rep = run()
     return {"findings": len(rep.findings),
             "baselined": len(rep.findings) - len(rep.violations),
             "violations": len(rep.violations),
-            "stale_baseline_cells": len(rep.stale)}
+            "stale_baseline_cells": len(rep.stale),
+            "analyzer_wall_ms": rep.wall_ms,
+            "per_rule": {r: n for r, n in sorted(rep.rule_totals.items())
+                         if n}}

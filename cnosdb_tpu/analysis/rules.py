@@ -738,7 +738,10 @@ class StringFilterAccounting(Rule):
 
 
 def all_rules() -> list:
+    from .interproc import project_rules
+
     return [NoBareExcept(), RpcCallTimeout(), RowLoop(), RowLoopFallback(),
             LockBlocking(), SwallowedException(), JaxPurity(),
             WallclockDuration(), MetricsNaming(), StageCatalog(),
-            DeviceDecodeAccounting(), StringFilterAccounting()]
+            DeviceDecodeAccounting(), StringFilterAccounting(),
+            *project_rules()]

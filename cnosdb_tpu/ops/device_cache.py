@@ -187,7 +187,7 @@ class EagerUploader:
                     cat = jax.lax.bitcast_convert_type(cat, jnp.uint64)
                 elif vt == ValueType.BOOLEAN:
                     cat = cat.astype(jnp.int64)
-                n = int(cat.shape[0])
+                n = int(cat.shape[0])  # lint: disable=host-sync (shape metadata only — no device data crosses; see EagerUploader docstring)
                 if n < self.n_pad:
                     cat = jnp.concatenate(
                         [cat, jnp.zeros(self.n_pad - n, dtype=cat.dtype)])

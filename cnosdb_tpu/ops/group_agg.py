@@ -271,8 +271,9 @@ def _device_distinct_count(gid: np.ndarray, vcodes: np.ndarray,
         if n == 0:
             return np.zeros(n_groups, dtype=np.int64)
         if n <= chunk_rows:
-            return np.asarray(kernels.segment_distinct_count(
-                gid, vcodes, n_groups, nv))[:n_groups]
+            # segment_distinct_count already materializes host i64 counts
+            # sliced to n_groups — re-wrapping it was a second copy
+            return kernels.segment_distinct_count(gid, vcodes, n_groups, nv)
         chunks = []
         for off in range(0, n, chunk_rows):
             e = min(off + chunk_rows, n)

@@ -208,7 +208,7 @@ def segment_partials_pallas(values: np.ndarray, valid: np.ndarray,
         jnp.asarray(bases), jnp.asarray(values), jnp.asarray(valid),
         jnp.asarray(seg_ids, dtype=jnp.int32),
         num_segments=num_segments, interpret=interpret)
-    host = {k: np.asarray(v) for k, v in out.items()}
+    host = {k: np.asarray(v) for k, v in out.items()}  # lint: disable=host-sync (audited transfer point: one batched pull per pallas window call)
     if wants is not None:
         host = {k: v for k, v in host.items() if wants.get(_WANT_OF[k])}
     return host

@@ -233,7 +233,11 @@ class MatviewEngine:
         self.views: dict[str, MatViewDef] = {}
         self._states: dict[tuple, dict] = {}   # (name, owner, vid) → state
         self._lock = lockwatch.Lock("matview.state")
-        self._refresh_lock = lockwatch.Lock("matview.refresh")
+        # refresh mutual exclusion is per view and guards only the
+        # in-flight set — scan/aggregate work never runs under it, so a
+        # slow device refresh of one view cannot stall the others
+        self._refresh_cv = threading.Condition()
+        self._refreshing: set[str] = set()
         self._dirty: set[tuple] = set()        # (owner, vnode_id) flushed
         self._wake = threading.Event()
         self._stop = threading.Event()
@@ -360,13 +364,21 @@ class MatviewEngine:
             raise QueryError(f"unknown materialized view {name!r}")
         now = _now_ns() if now_ns is None else int(now_ns)
         done = 0
-        with self._refresh_lock:
+        with self._refresh_cv:
+            while name in self._refreshing:   # two racers would double-
+                self._refresh_cv.wait()       # apply deltas past the hwm
+            self._refreshing.add(name)
+        try:
             for split in self._placed_splits(vdef):
                 if self.coord.distributed \
                         and split.node_id != self.coord.node_id:
                     continue
                 if self._refresh_vnode(vdef, split.vnode_id, now):
                     done += 1
+        finally:
+            with self._refresh_cv:
+                self._refreshing.discard(name)
+                self._refresh_cv.notify_all()
         return done
 
     def _placed_splits(self, vdef: MatViewDef):

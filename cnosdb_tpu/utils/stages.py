@@ -236,13 +236,13 @@ class QueryProfile:
             # aggregates), so the pallas module itself is a cheap import
             try:
                 from ..ops import pallas_kernels as pk
-            except Exception:  # lint: disable=swallowed-exception (telemetry stamp must never fail the query)
+            except Exception:  # telemetry stamp must never fail the query
                 pk = None
         if pk is not None:
             try:
                 self.device["pallas_enabled"] = pk.enabled()
                 self.device["pallas_disabled_reason"] = pk.disabled_reason()
-            except Exception:  # lint: disable=swallowed-exception (telemetry stamp must never fail the query)
+            except Exception:  # telemetry stamp must never fail the query
                 pass
         dd = sys.modules.get("cnosdb_tpu.ops.device_decode")
         if dd is not None:
@@ -250,7 +250,7 @@ class QueryProfile:
                 self.device["device_decode_enabled"] = dd.enabled()
                 self.device["device_decode_disabled_reason"] = \
                     dd.disabled_reason()
-            except Exception:  # lint: disable=swallowed-exception (telemetry stamp must never fail the query)
+            except Exception:  # telemetry stamp must never fail the query
                 pass
         return self
 

@@ -1,0 +1,13 @@
+"""deadline-propagation fixture: the budget stops at the middle hop."""
+
+
+def fetch_remote(addr, payload, deadline=None):
+    return rpc_call(addr, "scan", payload)
+
+
+def run_query(addr, deadline):
+    return fetch_remote(addr, {})
+
+
+def run_query_ok(addr, deadline):
+    return fetch_remote(addr, {}, deadline=deadline)

@@ -1,0 +1,13 @@
+"""host-sync fixture: device values pulled to host mid-pipeline."""
+import jax.numpy as jnp
+import numpy as np
+
+
+def scan_chunk(vals):
+    dev = jnp.cumsum(jnp.asarray(vals))
+    total = float(dev[-1])
+    host = np.asarray(dev)
+    peak = dev.max().item()
+    for v in dev:
+        host = host + v
+    return total, host, peak

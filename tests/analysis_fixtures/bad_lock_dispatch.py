@@ -1,0 +1,17 @@
+"""lock-held-dispatch fixture: device work reached under a mutex."""
+import threading
+
+import jax.numpy as jnp
+
+_LOCK = threading.Lock()
+
+
+def submit_rows(rows):
+    return jnp.asarray(rows).sum()
+
+
+def flush(rows):
+    with _LOCK:
+        out = jnp.cumsum(rows)
+        total = submit_rows(rows)
+    return out, total

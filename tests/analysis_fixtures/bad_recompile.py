@@ -1,0 +1,14 @@
+"""recompile-hazard fixture: dynamic scalars reaching a jit boundary."""
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def pad_kernel(x, n):
+    if x.shape[0] > 4:
+        return jnp.zeros(n)
+    return x
+
+
+def run_batch(batch):
+    return pad_kernel(batch, len(batch))

@@ -1,0 +1,6 @@
+"""Inner layer of the cross-file taint chain (device_chain_outer.py)."""
+import jax.numpy as jnp
+
+
+def make_rows(n):
+    return jnp.arange(n)

@@ -1,0 +1,13 @@
+"""Outer layer: taint crosses two call edges before the host pull."""
+import numpy as np
+
+from device_chain_inner import make_rows
+
+
+def passthrough(n):
+    return make_rows(n) * 2
+
+
+def consume(n):
+    rows = passthrough(n)
+    return np.asarray(rows)

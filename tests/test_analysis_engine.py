@@ -13,6 +13,7 @@ import sys
 import pytest
 
 from cnosdb_tpu import analysis
+from cnosdb_tpu.analysis import interproc
 from cnosdb_tpu.analysis import rules as rules_mod
 
 FIXTURES = os.path.join(os.path.dirname(__file__), "analysis_fixtures")
@@ -43,6 +44,11 @@ _CASES = [
     ("bad_stage_name.py", rules_mod.StageCatalog(), [6, 9, 12]),
     ("bad_device_decode.py", rules_mod.DeviceDecodeAccounting(), [9, 18]),
     ("bad_string_filter.py", rules_mod.StringFilterAccounting(), [10, 21]),
+    # interprocedural rule family (cnosdb_tpu/analysis/interproc.py)
+    ("bad_host_sync.py", interproc.HostSync(), [8, 9, 10, 11]),
+    ("bad_recompile.py", interproc.RecompileHazard(), [8, 14]),
+    ("bad_lock_dispatch.py", interproc.LockHeldDispatch(), [15, 16]),
+    ("bad_deadline_drop.py", interproc.DeadlinePropagation(), [9]),
 ]
 
 
@@ -61,6 +67,56 @@ def test_every_rule_has_a_fixture_and_motivation():
     for rule in rules_mod.all_rules():
         assert rule.name in covered, f"rule {rule.name} has no fixture case"
         assert rule.motivation, f"rule {rule.name} must name its incident"
+
+
+# ----------------------------------------------- interprocedural passes
+def test_cross_file_taint_flows_two_call_edges():
+    # make_rows (device) -> passthrough -> consume: the host pull in
+    # consume is three files of context away from the jnp call that
+    # tainted it, across two resolved call-graph edges
+    findings = analysis.lint_files(
+        [_fx("device_chain_outer.py"), _fx("device_chain_inner.py")],
+        rules=[interproc.HostSync()], ignore_scope=True)
+    outer = analysis.norm_relpath(_fx("device_chain_outer.py"))
+    assert [(f.path, f.line) for f in findings] == [(outer, 13)]
+
+
+def test_cross_file_taint_needs_the_inner_file():
+    # without the producer module the call cannot resolve, the value is
+    # not provably device, and the conservative analyzer stays silent
+    findings = analysis.lint_files([_fx("device_chain_outer.py")],
+                                   rules=[interproc.HostSync()],
+                                   ignore_scope=True)
+    assert findings == []
+
+
+def test_report_filter_mutes_findings_but_keeps_summaries():
+    # the --changed contract: unchanged files are still indexed (the
+    # taint below only exists because the inner file was parsed) but
+    # only files in the filter may report
+    inner, outer = _fx("device_chain_inner.py"), _fx("device_chain_outer.py")
+    keep_outer = {analysis.norm_relpath(outer)}
+    findings = analysis.lint_files([outer, inner],
+                                   rules=[interproc.HostSync()],
+                                   ignore_scope=True,
+                                   report_filter=keep_outer)
+    assert [f.line for f in findings] == [13]
+    keep_inner = {analysis.norm_relpath(inner)}
+    findings = analysis.lint_files([outer, inner],
+                                   rules=[interproc.HostSync()],
+                                   ignore_scope=True,
+                                   report_filter=keep_inner)
+    assert findings == []
+
+
+def test_stale_suppression_audit(tmp_path):
+    # a disable that absorbs nothing is flagged on full-registry runs;
+    # marker text inside a string literal is NOT a suppression
+    f = tmp_path / "dead.py"
+    f.write_text("x = 1  # lint: disable=host-sync (debt long gone)\n"
+                 "DOC = 'mentioning lint: disable=all is fine'\n")
+    findings = analysis.lint_files([str(f)])
+    assert [(x.rule, x.line) for x in findings] == [("stale-suppression", 1)]
 
 
 # --------------------------------------------------------- suppressions
@@ -146,3 +202,31 @@ def test_cli_fix_baseline_requires_whole_tree(tmp_path):
     p = _cli(FIXTURES, "--fix-baseline",
              "--baseline", str(tmp_path / "b.json"))
     assert p.returncode == 2
+
+
+def test_cli_fix_baseline_reports_pruned_cells(tmp_path):
+    bl = str(tmp_path / "b.json")
+    analysis.write_baseline(
+        {("swallowed-exception", "cnosdb_tpu/long_gone.py"): 2}, bl)
+    p = _cli("--fix-baseline", "--baseline", bl)
+    assert p.returncode == 0, p.stdout + p.stderr
+    assert "pruned stale cell swallowed-exception:cnosdb_tpu/long_gone.py" \
+        in p.stdout
+    assert ("swallowed-exception", "cnosdb_tpu/long_gone.py") \
+        not in analysis.load_baseline(bl)
+
+
+def test_cli_changed_rejects_explicit_paths():
+    p = _cli(FIXTURES, "--changed", "HEAD")
+    assert p.returncode == 2
+
+
+def test_cli_callgraph_dumps_summaries():
+    p = _cli(_fx("device_chain_inner.py"), _fx("device_chain_outer.py"),
+             "--callgraph")
+    assert p.returncode == 0, p.stdout + p.stderr
+    lines = {l.split(" ", 1)[0].rsplit(":", 1)[-1]: l
+             for l in p.stdout.splitlines()}
+    assert "returns-device" in lines["make_rows"]
+    assert "returns-device" in lines["passthrough"]
+    assert "device_chain_inner.py:make_rows" in lines["passthrough"]

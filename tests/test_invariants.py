@@ -62,15 +62,27 @@ def test_no_unknown_rules_in_baseline():
     assert {r for (r, _p) in _REPORT.baseline} <= known
 
 
-def test_cli_json_gate():
+def test_cli_json_gate(tmp_path):
     """The CI entry point: `python -m cnosdb_tpu.analysis --json` must
-    exit 0 on the tree and report machine-readable state."""
+    exit 0 on the tree, report machine-readable state, and write the run
+    artifact carrying the cnosdb_analysis_findings_total{rule} gauge."""
     repo = os.path.dirname(os.path.dirname(os.path.abspath(
         analysis.__file__)))
+    artifact = str(tmp_path / "analysis_report.json")
     p = subprocess.run([sys.executable, "-m", "cnosdb_tpu.analysis",
-                        "--json"],
+                        "--json", "--artifact", artifact],
                        capture_output=True, text=True, cwd=repo, timeout=300)
     assert p.returncode == 0, p.stdout + p.stderr
     rep = json.loads(p.stdout)
     assert rep["ok"] is True
     assert rep["violations"] == []
+    with open(artifact, encoding="utf-8") as f:
+        art = json.load(f)
+    totals = art["metrics"]["cnosdb_analysis_findings_total"]
+    # zero-filled per-rule gauge: every registered rule gets a label so
+    # CI diffs are one-line readable even when a rule is clean
+    assert set(_RULES) <= set(totals)
+    for rule in ("host-sync", "recompile-hazard", "lock-held-dispatch",
+                 "deadline-propagation"):
+        assert totals[rule] == 0, (rule, totals)
+    assert art["metrics"]["cnosdb_analysis_wall_ms"] > 0
