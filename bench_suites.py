@@ -847,6 +847,124 @@ def run_dashboard(executor, coord, tenant, db, session) -> dict:
     return out
 
 
+def run_coldscan(executor, coord, tenant, db, session) -> dict:
+    """Mixed hot/cold scan (tiered object-store plane): half the history
+    ages into a LocalStore "bucket", then the same oracle-checked
+    group-by runs all-hot, mixed with a cold block cache, and mixed
+    warm. Headline: cold_over_hot (acceptance: ≤ 3×) plus the near-data
+    pruning counters — pages pruned locally, bytes downloaded vs stored,
+    block-cache hit ratio."""
+    import tempfile
+
+    from cnosdb_tpu.models.points import SeriesRows, WriteBatch
+    from cnosdb_tpu.models.schema import ValueType
+    from cnosdb_tpu.models.series import SeriesKey
+    from cnosdb_tpu.storage import tiering
+
+    rng = np.random.default_rng(31)
+    n_hosts = 4
+    chunk = max(2000, SUITE_ROWS // 50)
+    per = chunk // n_hosts
+    boundary = BASE_TS + 30 * DAY_NS      # old half < boundary < new half
+
+    executor.execute_one(
+        "CREATE TABLE IF NOT EXISTS cold_m (value DOUBLE, TAGS(host))",
+        session)
+    total = {"n": 0, "s": 0.0}
+    # old half: 5 sealed files compacted to L1 (what tiers); new half:
+    # recent deltas left at L0 so compaction can't merge across the
+    # boundary and tiering (level ≥ 1) only ages the old file
+    for compact, t0 in ((True, BASE_TS), (False, boundary + DAY_NS)):
+        for step in range(5):
+            for h in range(n_hosts):
+                ts = t0 + (step * per + np.arange(per, dtype=np.int64)) \
+                    * 1_000_000_000
+                val = rng.normal(50, 10, per)
+                wb = WriteBatch()
+                wb.add_series("cold_m", SeriesRows(
+                    SeriesKey("cold_m", {"host": f"host_{h}"}), ts,
+                    {"value": (int(ValueType.FLOAT), val)}))
+                coord.write_points(tenant, db, wb)
+                total["n"] += per
+                total["s"] += float(val.sum())
+            coord.engine.flush_all()
+        if compact:
+            coord.engine.compact_all()
+
+    sql = ("SELECT host, count(value) AS c, sum(value) AS s FROM cold_m "
+           "GROUP BY host ORDER BY host")
+
+    def timed():
+        with coord._scan_cache_lock:
+            coord._scan_cache.clear()
+        t0 = time.perf_counter()
+        rs = executor.execute_one(sql, session)
+        ms = round((time.perf_counter() - t0) * 1e3, 2)
+        assert int(np.sum(_col(rs, "c"))) == total["n"], "count drift"
+        assert np.isclose(float(np.sum(_col(rs, "s"))), total["s"],
+                          rtol=1e-9), "sum drift"
+        return ms
+
+    out: dict = {"rows": total["n"]}
+    timed()                                   # warm-up, decoders jitted
+    out["hot_ms"] = timed()
+
+    bucket = tempfile.mkdtemp(prefix="cnosdb_cold_bench_")
+    tiering.configure(bucket)
+    tiering.counters_reset()
+    tiering.block_cache_clear()
+    try:
+        vnodes = list(coord.engine.vnodes.values())
+        tiered = sum(tiering.tier_vnode(v, boundary_ns=boundary)
+                     for v in vnodes)
+        out["files_tiered"] = tiered
+        snap = tiering.cold_tier_snapshot()
+        out["bytes_tiered"] = snap.get(("tier", "bytes_uploaded"), 0)
+
+        tiering.counters_reset()
+        out["cold_ms"] = timed()              # cold block cache
+        snap = tiering.cold_tier_snapshot()
+        out["cold_range_gets"] = snap.get(("fetch", "range_gets"), 0)
+        out["cold_pages_fetched"] = snap.get(("fetch", "pages_fetched"), 0)
+        out["cold_bytes_downloaded"] = snap.get(
+            ("fetch", "bytes_downloaded"), 0)
+        out["cold_pages_pruned"] = snap.get(("prune", "pages_pruned"), 0)
+
+        # near-data pruning: a recent-window query must answer without
+        # touching the store — every cold page is excluded locally
+        tiering.counters_reset()
+        with coord._scan_cache_lock:
+            coord._scan_cache.clear()
+        tiering.block_cache_clear()
+        rs = executor.execute_one(
+            f"SELECT count(value) AS c FROM cold_m "
+            f"WHERE time >= {boundary}", session)
+        assert int(np.sum(_col(rs, "c"))) == total["n"] // 2, "window drift"
+        snap = tiering.cold_tier_snapshot()
+        out["window_pages_pruned"] = snap.get(("prune", "pages_pruned"), 0)
+        out["window_bytes_downloaded"] = snap.get(
+            ("fetch", "bytes_downloaded"), 0)
+
+        timed()                               # refill the block cache
+        tiering.counters_reset()
+        out["cold_warm_ms"] = timed()         # served from the block cache
+        snap = tiering.cold_tier_snapshot()
+        hits = snap.get(("cache", "hit"), 0)
+        misses = snap.get(("cache", "miss"), 0)
+        out["block_cache_hit_ratio"] = round(
+            hits / max(hits + misses, 1), 3)
+        out["warm_bytes_downloaded"] = snap.get(
+            ("fetch", "bytes_downloaded"), 0)
+        out["cold_over_hot"] = round(
+            out["cold_ms"] / max(out["hot_ms"], 1e-6), 2)
+    finally:
+        # hand the engine back hot so later phases never need the bucket
+        for v in list(coord.engine.vnodes.values()):
+            tiering.rehydrate_vnode(v)
+        tiering.configure(None)
+    return out
+
+
 def run_suites(executor, coord, tenant, db, session) -> dict:
     out: dict = {}
     t0 = time.perf_counter()
@@ -880,4 +998,9 @@ def run_suites(executor, coord, tenant, db, session) -> dict:
                                          session)
     except Exception as e:   # rollup-tier failure must not sink the run
         out["dashboard"] = {"error": repr(e)[:200]}
+    try:
+        out["coldscan"] = run_coldscan(executor, coord, tenant, db,
+                                       session)
+    except Exception as e:   # cold-tier failure must not sink the run
+        out["coldscan"] = {"error": repr(e)[:200]}
     return out

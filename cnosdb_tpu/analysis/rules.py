@@ -737,6 +737,79 @@ class StringFilterAccounting(Rule):
                            f"covering it")
 
 
+# --------------------------------------------------------------------------
+# 13. cold-tier-accounting — new (PR 12): no silent cold-lane exits
+# --------------------------------------------------------------------------
+_CTA_FUNCS = {
+    "cnosdb_tpu/storage/tiering.py": (
+        "tier_vnode", "_tier_file", "rehydrate_file", "recover_vnode",
+        "fetch_pages", "_page_raw", "_read_page", "buffer_array",
+        "verify_cold_file", "purge_vnode"),
+}
+_CTA_ACCOUNTING = {"_count_cold", "count", "count_error"}
+
+
+def _cta_has_accounting(node: ast.AST) -> bool:
+    for n in ast.walk(node):
+        if isinstance(n, ast.Call) and _call_name(n) in _CTA_ACCOUNTING:
+            return True
+    return False
+
+
+class ColdTierAccounting(Rule):
+    name = "cold-tier-accounting"
+    motivation = ("PR 12 cold-tier plane: every exit out of the tier/"
+                  "fetch/rehydrate lanes must book a (lane, reason) into "
+                  "cnosdb_cold_tier_total — an unaccounted early return/"
+                  "raise hides exactly the events (skipped files, cache "
+                  "overflows, remote divergence) the cold tier's "
+                  "correctness story depends on observing")
+
+    def applies_to(self, relpath):
+        return relpath in _CTA_FUNCS
+
+    def begin_module(self, ctx):
+        want = _CTA_FUNCS.get(ctx.relpath)
+        guarded = want is not None
+        if want is None:
+            # scope-ignored run (fixtures/self-tests): lint any function
+            # bearing a guarded name, but skip the presence check
+            want = tuple({n for names in _CTA_FUNCS.values()
+                          for n in names})
+        found = set()
+        for fn in ast.walk(ctx.tree):
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    or fn.name not in want:
+                continue
+            found.add(fn.name)
+            terminal = fn.body[-1]
+            for block in _dda_blocks(fn):
+                for i, stmt in enumerate(block):
+                    if not isinstance(stmt, (ast.Return, ast.Raise)) \
+                            or stmt is terminal:
+                        continue
+                    prev = block[i - 1] if i else None
+                    if _cta_has_accounting(stmt) \
+                            or (prev is not None
+                                and _cta_has_accounting(prev)):
+                        continue
+                    kind = "return" if isinstance(stmt, ast.Return) \
+                        else "raise"
+                    ctx.report(self, stmt,
+                               f"unaccounted early {kind} in {fn.name} — "
+                               f"cold-tier lane exits must book a (lane, "
+                               f"reason) (_count_cold/stages.count) so "
+                               f"tiering skips and fetch failures stay "
+                               f"visible on /metrics")
+        for name in want if guarded else ():
+            if name not in found:
+                ctx.report(self, 1,
+                           f"cold-tier guarded function {name} not "
+                           f"found — if it was renamed, update "
+                           f"analysis/rules.py so the lint keeps "
+                           f"covering it")
+
+
 def all_rules() -> list:
     from .interproc import project_rules
 
@@ -744,4 +817,4 @@ def all_rules() -> list:
             LockBlocking(), SwallowedException(), JaxPurity(),
             WallclockDuration(), MetricsNaming(), StageCatalog(),
             DeviceDecodeAccounting(), StringFilterAccounting(),
-            *project_rules()]
+            ColdTierAccounting(), *project_rules()]

@@ -112,6 +112,15 @@ class StorageConfig:
     # cap so a sweep never starves foreground scans of disk bandwidth
     scrub_interval: int = 0
     scrub_mb_per_sec: int = 8
+    # cold tiering (storage/tiering.py): object-store URI (s3:// gs://
+    # az:// file://; empty = tiering off), seconds between tiering sweeps
+    # (0 = no background job; tier_vnode can still be driven manually),
+    # and the age past which a sealed file goes cold. The reference's
+    # `[storage] ttl` expires data outright; here TTL becomes
+    # tier-then-expire — see ARCHITECTURE.md "Tiered storage".
+    tiering_uri: str = ""
+    tiering_interval: int = 0
+    tiering_cold_after_s: int = 24 * 3600
 
 
 @dataclass

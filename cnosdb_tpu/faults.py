@@ -53,6 +53,7 @@ Fault points currently threaded (see ARCHITECTURE.md "Fault model"):
   compaction.run                                      storage/compaction.py
   meta.propose meta.apply                             parallel/meta_service.py
   tsm.write scrub.read                                storage/tsm.py, scrub.py
+  objstore.get objstore.put                           utils/objstore.py
 """
 from __future__ import annotations
 

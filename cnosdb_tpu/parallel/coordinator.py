@@ -22,7 +22,8 @@ from hashlib import blake2b
 
 import numpy as np
 
-from ..errors import ChecksumMismatch, CoordinatorError, DeadlineExceeded
+from ..errors import ChecksumMismatch, CoordinatorError, DeadlineExceeded, \
+    TsmError
 from ..utils import stages
 from ..utils import deadline as deadline_mod
 from ..utils.backoff import Backoff
@@ -55,6 +56,12 @@ class PlacedSplit:
     time_ranges: TimeRanges
     tag_domains: ColumnDomains
     node_id: int = 0
+    # "hot" | "cold": cold = the vnode holds object-store-tiered files, so
+    # its scan lane prunes against local sidecars and ranged-GETs only the
+    # surviving pages (storage/tiering.py); informational for planning,
+    # metrics and the cold-recovery retry — the readers themselves are
+    # tier-transparent
+    tier: str = "hot"
     # failover candidates: other replicas as (vnode_id, node_id)
     alternates: list = field(default_factory=list)
     # replicas currently marked BROKEN (self-heal on a successful scan)
@@ -626,11 +633,43 @@ class Coordinator:
                 split = PlacedSplit(owner, vnode_id, table,
                                     time_ranges, tag_domains,
                                     node_id=node_id,
+                                    tier=self._split_tier(owner, vnode_id,
+                                                          node_id),
                                     alternates=running + broken)
                 split.broken_ids = {a.id for a in rs.vnodes
                                     if a.status == VnodeStatus.BROKEN}
                 splits.append(split)
         return splits
+
+    def _split_tier(self, owner: str, vnode_id: int, node_id: int) -> str:
+        """COLD iff the (locally-placed) vnode has object-store-tiered
+        files — a registry peek, no vnode open; remote vnodes report hot
+        (their own node makes the tier call when it scans)."""
+        if node_id != self.node_id and self.distributed:
+            return "hot"
+        from ..storage import tiering
+
+        d = self.engine.vnode_dir(owner, vnode_id)
+        return "cold" if tiering.cold_ids(d) else "hot"
+
+    def _recover_cold(self, owner: str, vnode_id: int) -> int:
+        """Rebuild lost / corrupt cold-tier sidecars of a LOCAL vnode
+        from the object store (ranged tail reads — no full download).
+        → sidecars rebuilt; 0 when the vnode has no cold files or the
+        rebuild failed (callers then fall back to replica repair)."""
+        from ..storage import tiering
+
+        try:
+            v = self.engine.vnode(owner, vnode_id)
+            if v is None or not tiering.cold_ids(v.dir):
+                return 0
+            n = tiering.recover_vnode(v)
+        except Exception:
+            log.exception("cold-tier recovery of vnode %s failed", vnode_id)
+            return 0
+        if n:
+            self._drop_vnode_cache_entries(owner, vnode_id)
+        return n
 
     def scan_table(self, tenant: str, db: str, table: str,
                    time_ranges: TimeRanges | None = None,
@@ -684,6 +723,18 @@ class Coordinator:
             if self.distributed and split.node_id != self.node_id:
                 return self._scan_remote(split, field_names)
             try:
+                return self._scan_local(split, field_names, page_constraints,
+                                        filter_key, n_threads)
+            except TsmError as e:
+                # cold-tier metadata damage (lost / corrupt skip-index
+                # sidecar): repairable in place from the object store —
+                # rebuild the sidecars via ranged tail reads and retry the
+                # scan ONCE. Safe to retry locally: TsmError never
+                # quarantines, so the manifest still names every file.
+                if not self._recover_cold(split.owner, split.vnode_id):
+                    raise
+                log.warning("rebuilt cold sidecars on vnode %s after: %s",
+                            split.vnode_id, e)
                 return self._scan_local(split, field_names, page_constraints,
                                         filter_key, n_threads)
             except ChecksumMismatch as e:
@@ -1413,6 +1464,13 @@ class Coordinator:
                     ok = False
                 if ok:
                     break
+            if not ok and (nid == self.node_id or not self.distributed):
+                # no healthy peer could seed this replica: the cold tier
+                # is the replica of last resort — rebuild sidecars from
+                # the object store and re-vote
+                if self._recover_cold(owner, vid):
+                    cs2 = self._replica_checksum(owner, vid, nid)
+                    ok = bool(cs2) and cs2 == majority
             if ok:
                 scrub.count("repairs_ok")
                 self._drop_vnode_cache_entries(owner, vid)

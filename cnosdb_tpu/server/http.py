@@ -905,6 +905,19 @@ class HttpServer:
             for name, n in _mv.counters_snapshot().items():
                 self.metrics.set_gauge("cnosdb_matview_total", n,
                                        kind=name)
+        # cold-tier plane: per-(lane, reason) tier/fetch/prune/cache
+        # outcomes plus the block cache's live size — only when the
+        # tiering module is resident (nothing cold has happened otherwise)
+        _ct = _sys.modules.get("cnosdb_tpu.storage.tiering")
+        if _ct is not None:
+            for (lane, reason), n in _ct.cold_tier_snapshot().items():
+                self.metrics.set_counter("cnosdb_cold_tier_total", n,
+                                         lane=lane, reason=reason)
+            bc = _ct.block_cache_stats()
+            self.metrics.set_gauge("cnosdb_cold_block_cache_bytes",
+                                   bc["bytes"])
+            self.metrics.set_gauge("cnosdb_cold_block_cache_entries",
+                                   bc["entries"])
         return web.Response(text=self.metrics.prometheus_text(),
                             content_type="text/plain")
 
@@ -1152,6 +1165,22 @@ def run_server(args) -> int:
         print(f"integrity scrubber every {cfg.storage.scrub_interval}s "
               f"at {cfg.storage.scrub_mb_per_sec} MB/s")
 
+    if cfg.storage.tiering_uri:
+        from ..storage import tiering
+
+        tiering.configure(cfg.storage.tiering_uri)
+        if cfg.storage.tiering_interval > 0:
+            server.tiering_job = tiering.TieringJob(
+                server.coord.engine, cfg.storage.tiering_interval,
+                cfg.storage.tiering_cold_after_s)
+            server.tiering_job.start()
+            print(f"cold tiering → {cfg.storage.tiering_uri} every "
+                  f"{cfg.storage.tiering_interval}s "
+                  f"(cold after {cfg.storage.tiering_cold_after_s}s)")
+        else:
+            print(f"cold tier configured → {cfg.storage.tiering_uri} "
+                  f"(no background sweep)")
+
     if cfg.trace.otlp_endpoint:
         from .trace import GLOBAL_COLLECTOR, OtlpExporter
 
@@ -1174,7 +1203,10 @@ def run_server(args) -> int:
                     for bucket in server.meta.expire_buckets(tenant, db, now):
                         for rs in bucket.shard_group:
                             for v in rs.vnodes:
-                                server.coord.engine.drop_vnode(owner, v.id)
+                                # tier-then-expire: expired vnodes also
+                                # release their cold-tier objects
+                                server.coord.engine.drop_vnode(
+                                    owner, v.id, purge_cold=True)
                 except Exception:
                     pass
             try:

@@ -59,24 +59,48 @@ class Picker:
     def level_max_size(self, level: int) -> int:
         return self.level_base_size * (self.level_size_multiplier ** max(0, level - 1))
 
-    def pick(self, version: Version) -> CompactReq | None:
+    def pick(self, version: Version,
+             exclude: frozenset = frozenset()) -> CompactReq | None:
+        """`exclude`: file_ids compaction must not rewrite (cold-tiered
+        files have no local bytes — storage/tiering.py). Exclusion keeps
+        the oldest-first-prefix ordering rule by truncating at the first
+        excluded file rather than skipping over it."""
         # delta compaction first: L0 count trigger
         l0 = sorted(version.levels[0].values(), key=lambda f: f.file_id)
+        l0 = self._prefix_before_excluded(l0, exclude)
         if len(l0) >= self.l0_trigger:
             picked = l0[:self.max_compact_files]
             return CompactReq(
-                picked + self._include_overlap(version, 1, picked), 1)
+                picked + self._include_overlap(version, 1, picked, exclude),
+                1)
         # level compaction: size overflow spills oldest files upward
         for level in range(1, MAX_LEVEL):
             if version.level_size(level) > self.level_max_size(level):
                 files = sorted(version.levels[level].values(), key=lambda f: f.file_id)
-                picked = files[: self.max_compact_files]
+                picked = self._prefix_before_excluded(
+                    files, exclude)[: self.max_compact_files]
+                if not picked:
+                    continue   # level frozen behind cold files
                 return CompactReq(
-                    picked + self._include_overlap(version, level + 1, picked),
+                    picked + self._include_overlap(version, level + 1,
+                                                   picked, exclude),
                     level + 1)
         return None
 
-    def pick_promotions(self, version: Version) \
+    @staticmethod
+    def _prefix_before_excluded(files: list[FileMeta],
+                                exclude: frozenset) -> list[FileMeta]:
+        if not exclude:
+            return files
+        out = []
+        for f in files:
+            if f.file_id in exclude:
+                break
+            out.append(f)
+        return out
+
+    def pick_promotions(self, version: Version,
+                        exclude: frozenset = frozenset()) \
             -> list[tuple[FileMeta, int]]:
         """Files that can move one level up by METADATA ONLY (zero bytes
         re-encoded): flush-sized L0 files, and oldest files of an
@@ -95,6 +119,8 @@ class Picker:
         max1 = max(version.levels[1], default=0)
         out = []
         for f in sorted(version.levels[0].values(), key=lambda x: x.file_id):
+            if f.file_id in exclude:
+                break
             if f.size >= self.promote_file_size and f.file_id > max1:
                 out.append((f, 1))
             else:
@@ -109,7 +135,7 @@ class Picker:
             max_t = max(version.levels[level + 1], default=0)
             for f in sorted(version.levels[level].values(),
                             key=lambda x: x.file_id):
-                if f.file_id <= max_t:
+                if f.file_id <= max_t or f.file_id in exclude:
                     break
                 out.append((f, level + 1))
                 max_t = f.file_id
@@ -121,7 +147,8 @@ class Picker:
         return out
 
     def _include_overlap(self, version: Version, target: int,
-                         picked: list[FileMeta]) -> list[FileMeta]:
+                         picked: list[FileMeta],
+                         exclude: frozenset = frozenset()) -> list[FileMeta]:
         """Target-level files to rewrite alongside `picked` — ALL of the
         overlapping ones, or NONE.
 
@@ -139,6 +166,10 @@ class Picker:
         overlapped = [f for f in version.levels[target].values()
                       if f.overlaps(lo, hi)]
         if not overlapped:
+            return []
+        if exclude and any(f.file_id in exclude for f in overlapped):
+            # a cold file overlaps: rewriting the rest would violate
+            # all-or-none, so choose "none" (time-split output is legal)
             return []
         picked_sz = sum(f.size for f in picked)
         if sum(f.size for f in overlapped) > 2 * max(picked_sz, 1) \

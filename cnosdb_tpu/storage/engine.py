@@ -177,6 +177,10 @@ class TsKv:
             self.schemas.pop(owner, None)
             d = os.path.join(self.data_dir, "data", owner)
             if os.path.isdir(d):
+                from . import tiering
+
+                for name in os.listdir(d):
+                    tiering.purge_vnode(os.path.join(d, name))
                 shutil.rmtree(d, ignore_errors=True)
 
     def close_database(self, owner: str):
@@ -188,7 +192,11 @@ class TsKv:
                 del self.vnodes[key]
             self.schemas.pop(owner, None)
 
-    def drop_vnode(self, owner: str, vnode_id: int):
+    def drop_vnode(self, owner: str, vnode_id: int,
+                   purge_cold: bool = False):
+        """`purge_cold` also deletes the vnode's cold-tier objects
+        (best-effort) — the tier-then-expire path: TTL tiers data first,
+        then the drop reclaims both local disk and the object store."""
         import shutil
 
         with self.lock:
@@ -197,6 +205,10 @@ class TsKv:
             if v:
                 v.close()
             d = self.vnode_dir(owner, vnode_id)
+            if purge_cold and os.path.isdir(d):
+                from . import tiering
+
+                tiering.purge_vnode(d)
             if os.path.isdir(d):
                 shutil.rmtree(d, ignore_errors=True)
 

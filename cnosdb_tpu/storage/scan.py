@@ -562,6 +562,8 @@ _NATIVE_ENC = {1: {6}, 2: {2, 11}, 3: {10}}   # kind → decodable encodings
 #   schema_change page typed differently than the column (cast path)
 #   native_reject native decoder refused the page at runtime
 #   native_unavailable  no native library and the device lane declined
+#   cold_tier     page lives in the object store; the native mmap lane
+#                 cannot touch it (decodes via Python over the block cache)
 #   device_decode.*     device lane examined the page but declined
 #                       (reason suffix from codecs.split_for_device)
 import threading as _threading
@@ -578,6 +580,15 @@ def _count_fallback(reason: str, n: int = 1) -> None:
 def decode_fallback_snapshot() -> dict[str, int]:
     with _FALLBACK_LOCK:
         return dict(sorted(_FALLBACK.items()))
+
+
+def _count_cold_pruned(n: int) -> None:
+    """Pages of a COLD file skipped by local zone-map/constraint pruning:
+    each one is a page whose bytes were never downloaded."""
+    from . import tiering
+
+    stages.count("cold.pages_pruned", n)
+    tiering._count_cold("prune", "pages_pruned", n)
 
 
 def _mem_series_ids(vnode: VnodeStorage, table: str) -> set:
@@ -827,6 +838,27 @@ def _scan_vnode_native(vnode: VnodeStorage, table: str,
         b._pages_pruned = any_pruned
         return b
 
+    # ------------------------------------------------- cold-tier prefetch
+    # every page that survived pruning on a cold reader is fetched up
+    # front in one coalesced ranged-GET pass, so the decode lanes below
+    # hit the block cache instead of issuing a GET per page
+    cold_wants: dict[int, tuple] = {}
+    for entry in plan:
+        if entry[0] != "n":
+            continue
+        for r, cm, cols, idx in entry[2]:
+            if not getattr(r, "is_cold", False):
+                continue
+            lst = cold_wants.setdefault(id(r), (r, []))[1]
+            for i in idx:
+                lst.append(cm.time_pages[i])
+                for name in field_names:
+                    col = cols.get(name)
+                    if col is not None:
+                        lst.append(col.pages[i])
+    for r, pms in cold_wants.values():
+        r.fetch_pages(pms)
+
     # ------------------------------------------------------- column typing
     ftypes: dict[str, ValueType] = {}
     for entry in plan:
@@ -903,7 +935,7 @@ def _scan_vnode_native(vnode: VnodeStorage, table: str,
                             dev_lane, r, tp, None, off, ValueType.INTEGER,
                             numeric_cols, string_parts, string_valid,
                             ts_all)):
-                    if native_ok:
+                    if native_ok and not getattr(r, "is_cold", False):
                         _add_page(r, tp, None, off, 0)
                     else:
                         py_jobs.append((r, tp, None, off, None))
@@ -931,6 +963,14 @@ def _scan_vnode_native(vnode: VnodeStorage, table: str,
                         # device lane declined and there is no native
                         # decoder in this build: per-page Python path
                         _count_fallback("native_unavailable")
+                        py_jobs.append((r, pm, name, off, vt))
+                        continue
+                    if getattr(r, "is_cold", False):
+                        # the native writer reads pages out of a local
+                        # mmap (buffer_array) — cold pages have no local
+                        # bytes, so they decode via the Python lane over
+                        # the block cache
+                        _count_fallback("cold_tier")
                         py_jobs.append((r, pm, name, off, vt))
                         continue
                     kind = _NATIVE_NUMERIC.get(pm.value_type)
@@ -1188,13 +1228,19 @@ def _plan_series(vnode, table, sid, files, mem_sids, trs, constraints,
             if c is not None:
                 cols[qname] = c
         idx = []
+        cold = getattr(r, "is_cold", False)
+        cold_pruned = 0
         for i, tp in enumerate(cm.time_pages):
             if not trs.is_all and not trs.overlaps(
                     TimeRange(tp.min_ts, tp.max_ts)):
+                if cold:
+                    cold_pruned += 1
                 continue
             time_admitted += 1
             if constraints and not _page_admits(cols, i, constraints):
                 pruned = True
+                if cold:
+                    cold_pruned += 1
                 continue
             idx.append(i)
             n_rows += tp.n_rows
@@ -1204,6 +1250,8 @@ def _plan_series(vnode, table, sid, files, mem_sids, trs, constraints,
                     r0.min_ts <= tp.min_ts and tp.max_ts <= r0.max_ts
                     for r0 in trs.ranges):
                 trim = True
+        if cold_pruned:
+            _count_cold_pruned(cold_pruned)
         if idx:
             admitted.append((r, cm, cols, idx))
     if n_rows == 0:

@@ -227,12 +227,37 @@ def scrub_vnode(vnode, limiter: RateLimiter | None = None,
         return size
 
     # -- live TSM files (snapshot the list; compaction may mutate) -------
+    from . import tiering
+    from ..utils import objstore
+
     with vnode.lock:
         version = vnode.summary.version
-        tsm_paths = [version.file_path(fm) for fm in version.all_files()]
-    for path in tsm_paths:
+        cold = tiering.cold_ids(vnode.dir)
+        tsm_files = [(version.file_path(fm), fm.file_id)
+                     for fm in version.all_files()]
+    for path, fid in tsm_files:
         if stop is not None and stop.is_set():
             return out
+        if fid in cold:
+            # cold file: no local bytes. Verify the local sidecar still
+            # parses and the remote object's footer matches it (a cheap
+            # ranged GET); divergence is corruption evidence that feeds
+            # the same anti-entropy repair path, but never quarantine —
+            # the manifest entry is the only pointer to the remote bytes
+            try:
+                n = tiering.verify_cold_file(vnode, fid)
+            except ChecksumMismatch as e:
+                log.warning("scrub: cold-tier corruption in %s: %s", path, e)
+                count("corruptions_detected")
+                out["corrupt"].append(path)
+            except (OSError, objstore.ObjectStoreError):
+                continue  # store unreachable / races: not corruption
+            else:
+                out["bytes"] += n
+                out["files"] += 1
+                count("scrub_bytes", n)
+                count("scrub_files")
+            continue
         if _budget(path) < 0:
             continue
         _fire_read_fault(path)

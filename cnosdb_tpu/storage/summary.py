@@ -107,8 +107,27 @@ class Version:
     def reader(self, fm: FileMeta) -> TsmReader:
         r = self._readers.get(fm.file_id)
         if r is None:
-            r = self._readers[fm.file_id] = TsmReader(self.file_path(fm))
+            # the single reader chokepoint: files recorded in the vnode's
+            # cold registry (storage/tiering.py cold.json) open as cold
+            # readers — sidecar metadata locally, page bytes via ranged
+            # object-store GETs — so every scan/decode lane above stays
+            # tier-transparent
+            from . import tiering
+
+            entry = tiering.cold_entry(self.dir, fm.file_id)
+            if entry is not None:
+                r = tiering.open_cold_reader(self.file_path(fm), entry)
+            else:
+                r = TsmReader(self.file_path(fm))
+            self._readers[fm.file_id] = r
         return r
+
+    def drop_reader(self, fid: int) -> None:
+        """Close and forget one cached reader (tier/rehydrate flips the
+        backing store; the next `reader()` call reopens the right kind)."""
+        r = self._readers.pop(fid, None)
+        if r:
+            r.close()
 
     def tombstone(self, fm: FileMeta):
         """Cached per-file tombstone; all tombstone writes must go through

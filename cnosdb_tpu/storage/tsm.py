@@ -352,11 +352,49 @@ class TsmFooter:
 # ---------------------------------------------------------------------------
 # reader
 # ---------------------------------------------------------------------------
+def parse_tail(tail, path: str, tail_off: int = 0):
+    """Parse a TSM file's trailing metadata section (zstd-msgpack chunk
+    meta + bloom + fixed footer) → (groups, bloom, footer).
+
+    `tail` holds the file bytes from absolute offset `tail_off` to EOF —
+    the whole mmap for the hot reader (tail_off=0), or just the sidecar
+    tail for the cold tier (tail_off = footer.meta_off). Footer offsets
+    are absolute file offsets, rebased here."""
+    if len(tail) < FOOTER_SIZE:
+        raise TsmError("file too small", path=path)
+    footer_raw = tail[-FOOTER_SIZE:]
+    body = footer_raw[:52]
+    crc, fmagic, fver = struct.unpack_from("<IIB", footer_raw, 52)
+    if fmagic != MAGIC:
+        raise TsmError("bad footer magic", path=path)
+    if zlib.crc32(body) != crc:
+        raise ChecksumMismatch("footer crc", path=path)
+    (meta_off, meta_len, bloom_off, bloom_len,
+     min_ts, max_ts, series_count) = struct.unpack("<QQQQqqI", body)
+    footer = TsmFooter(meta_off, meta_len, bloom_off, bloom_len,
+                       min_ts, max_ts, series_count)
+    lo = meta_off - tail_off
+    if lo < 0 or bloom_off - tail_off < 0:
+        raise TsmError("tail section does not cover meta", path=path)
+    meta_raw = _ZD.decompress(tail[lo:lo + meta_len])
+    groups: dict[str, ChunkGroupMeta] = {}
+    for g in msgpack.unpackb(meta_raw, strict_map_key=False):
+        cg = ChunkGroupMeta.from_list(g)
+        groups[cg.table] = cg
+    blo = bloom_off - tail_off
+    bloom = BloomFilter.from_bytes(tail[blo:blo + bloom_len])
+    return groups, bloom, footer
+
+
 class TsmReader:
     """Random-access TSM reader (reference tsm/reader.rs:825).
 
     Loads footer + meta eagerly (small), pages lazily via one mmap'd file.
     """
+
+    # storage/tiering.py's ColdTsmReader overrides this: scan routing uses
+    # it to keep cold pages off the mmap-dependent native batch lane
+    is_cold = False
 
     def __init__(self, path: str):
         self.path = path
@@ -369,23 +407,10 @@ class TsmReader:
         magic, version = struct.unpack_from("<IB", self._buf, 0)
         if magic != MAGIC:
             raise TsmError("bad magic", path=path)
-        footer_raw = self._buf[-FOOTER_SIZE:]
-        body = footer_raw[:52]
-        crc, fmagic, fver = struct.unpack_from("<IIB", footer_raw, 52)
-        if fmagic != MAGIC:
-            raise TsmError("bad footer magic", path=path)
-        if zlib.crc32(body) != crc:
-            raise ChecksumMismatch("footer crc", path=path)
-        (meta_off, meta_len, bloom_off, bloom_len,
-         self.min_ts, self.max_ts, self.series_count) = struct.unpack("<QQQQqqI", body)
-        self.footer = TsmFooter(meta_off, meta_len, bloom_off, bloom_len,
-                                self.min_ts, self.max_ts, self.series_count)
-        meta_raw = _ZD.decompress(self._buf[meta_off:meta_off + meta_len])
-        self.groups: dict[str, ChunkGroupMeta] = {}
-        for g in msgpack.unpackb(meta_raw, strict_map_key=False):
-            cg = ChunkGroupMeta.from_list(g)
-            self.groups[cg.table] = cg
-        self.bloom = BloomFilter.from_bytes(self._buf[bloom_off:bloom_off + bloom_len])
+        self.groups, self.bloom, self.footer = parse_tail(self._buf, path)
+        self.min_ts = self.footer.min_ts
+        self.max_ts = self.footer.max_ts
+        self.series_count = self.footer.series_count
 
     def close(self):
         self._buf_arr = None

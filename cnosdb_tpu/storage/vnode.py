@@ -233,12 +233,38 @@ class VnodeStorage:
                         sd.field_chunks.pop(name, None)
 
     # ------------------------------------------------------------------ compact
+    def _compaction_exclude(self) -> frozenset:
+        """File ids compaction must leave alone: cold-tiered files (their
+        bytes live in the object store — storage/tiering.py) plus any hot
+        file overlapping a cold file's time range. The overlap closure
+        prevents resurrection: a rewrite landing at a level that outranks
+        a cold file carrying a newer row version would flip
+        last-write-wins. Backfill writes into an already-tiered window
+        therefore freeze until the tiering job moves them too (documented
+        limitation)."""
+        from . import tiering
+
+        cold = tiering.cold_ids(self.dir)
+        if not cold:
+            return frozenset()
+        version = self.summary.version
+        all_fms = version.all_files()
+        ranges = [(fm.min_ts, fm.max_ts) for fm in all_fms
+                  if fm.file_id in cold]
+        out = set(cold)
+        for fm in all_fms:
+            if fm.file_id not in out and any(
+                    fm.overlaps(lo, hi) for lo, hi in ranges):
+                out.add(fm.file_id)
+        return frozenset(out)
+
     def compact(self, force_level: int | None = None) -> bool:
         """Run at most one compaction round; → True if work was done."""
         with self.lock:
             if self._promote_l0():
                 return True
-            req = self.picker.pick(self.summary.version)
+            req = self.picker.pick(self.summary.version,
+                                   exclude=self._compaction_exclude())
             if req is None:
                 return False
             fid = self.summary.next_file_id()
@@ -268,7 +294,8 @@ class VnodeStorage:
         from .tombstone import tombstone_path as _tb
 
         version = self.summary.version
-        promos = self.picker.pick_promotions(version)
+        promos = self.picker.pick_promotions(
+            version, exclude=self._compaction_exclude())
         if not promos:
             return False
         adds = []
@@ -351,8 +378,10 @@ class VnodeStorage:
 
         with self.lock:
             version = self.summary.version
+            exclude = self._compaction_exclude()
             files = [f for lvl in range(0, 5)
-                     for f in version.levels[lvl].values()]
+                     for f in version.levels[lvl].values()
+                     if f.file_id not in exclude]
             if len(files) <= 1:
                 return False
             total = sum(f.size for f in files)

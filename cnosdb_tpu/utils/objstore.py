@@ -17,12 +17,17 @@ import datetime
 import hashlib
 import hmac
 import json
+import os
 import time
 import urllib.error
 import urllib.parse
 import urllib.request
+import zlib
 
 from ..errors import CnosError
+from .. import faults
+from . import deadline as _deadline
+from .backoff import Backoff
 
 
 class ObjectStoreError(CnosError):
@@ -117,32 +122,172 @@ def _boolish(v) -> bool:
     return bool(v)
 
 
-def _http(method: str, url: str, headers: dict, body: bytes | None,
-          timeout: float = 30.0) -> bytes:
-    req = urllib.request.Request(url, data=body, method=method,
-                                 headers=headers)
+# HTTP statuses worth retrying: throttles, transient server errors, and
+# request-timeout — anything else (403, 404, 412 …) is a caller bug or a
+# permanent condition where a retry just burns the deadline budget.
+_RETRYABLE_HTTP = frozenset({408, 429, 500, 502, 503, 504})
+
+
+def _retries() -> int:
     try:
-        with urllib.request.urlopen(req, timeout=timeout) as r:
-            return r.read()
-    except urllib.error.HTTPError as e:
-        detail = e.read()[:300]
-        raise ObjectStoreError(
-            f"{method} {url} → HTTP {e.code}: {detail!r}")
-    except urllib.error.URLError as e:
-        raise ObjectStoreError(f"{method} {url} failed: {e.reason}")
+        return max(0, int(os.environ.get("CNOSDB_OBJSTORE_RETRIES", "4")))
+    except ValueError:
+        return 4
+
+
+def _deadline_expiry() -> float | None:
+    dl = _deadline.current()
+    return dl.expires_at if dl is not None else None
+
+
+def _apply_body_fault(hit, body: bytes) -> bytes:
+    """Site implementation for response-body faults on `objstore.get`:
+    ``torn(n)`` keeps only the first n bytes (a connection cut mid-stream
+    that the transport didn't surface), ``corrupt(n)`` XOR-flips n bytes
+    mid-body (bit rot in the object store) — both invisible until a page
+    CRC check walks over them."""
+    if hit is None or not body:
+        return body
+    action, arg = hit
+    if action == "torn":
+        keep = int(arg) if arg else len(body) // 2
+        return body[:max(0, min(len(body), keep))]
+    if action == "corrupt":
+        n = max(1, int(arg or 1))
+        off = zlib.crc32(body[:64]) % max(1, len(body) - n + 1)
+        return (body[:off] + bytes(b ^ 0xFF for b in body[off:off + n])
+                + body[off + n:])
+    return body
+
+
+def _http_status(method: str, url: str, headers: dict, body: bytes | None,
+                 timeout: float = 30.0,
+                 fault_point: str | None = None,
+                 **fault_ctx) -> tuple[int, bytes]:
+    """One store call with jittered-backoff retries and deadline-capped
+    per-attempt timeouts → (status, body). Transient failures (URLError,
+    throttle/5xx statuses, injected faults) retry until the attempt budget
+    or the ambient request deadline runs out; permanent HTTP errors raise
+    immediately."""
+    bo = Backoff(initial=0.05, cap=2.0)
+    attempts = _retries() + 1
+    last: Exception | None = None
+    for attempt in range(attempts):
+        per_try = _deadline.cap_current(timeout)
+        req = urllib.request.Request(url, data=body, method=method,
+                                     headers=headers)
+        try:
+            hit = None
+            if faults.ENABLED and fault_point:
+                hit = faults.fire(fault_point, method=method, url=url,
+                                  **fault_ctx)
+                if hit is not None and hit[0] == "drop":
+                    raise urllib.error.URLError("injected response drop")
+            with urllib.request.urlopen(req, timeout=per_try) as r:
+                return r.status, _apply_body_fault(hit, r.read())
+        except faults.FaultInjected as e:
+            last = e
+        except urllib.error.HTTPError as e:
+            detail = e.read()[:300]
+            last = ObjectStoreError(
+                f"{method} {url} → HTTP {e.code}: {detail!r}")
+            if e.code not in _RETRYABLE_HTTP:
+                raise last
+        except urllib.error.URLError as e:
+            last = ObjectStoreError(f"{method} {url} failed: {e.reason}")
+        except TimeoutError:
+            last = ObjectStoreError(f"{method} {url} timed out after "
+                                    f"{per_try:.1f}s")
+        if attempt + 1 >= attempts or not bo.sleep(_deadline_expiry()):
+            break
+    raise ObjectStoreError(
+        f"{method} {url} failed after {attempts} attempts: {last}")
+
+
+def _http(method: str, url: str, headers: dict, body: bytes | None,
+          timeout: float = 30.0, fault_point: str | None = None,
+          **fault_ctx) -> bytes:
+    return _http_status(method, url, headers, body, timeout,
+                        fault_point=fault_point, **fault_ctx)[1]
+
+
+def _range_header(offset: int, length: int) -> str:
+    return f"bytes={offset}-{offset + length - 1}"
+
+
+def _slice_range(status: int, body: bytes, offset: int, length: int) -> bytes:
+    """Normalize a ranged GET: 206 bodies are the requested window; a
+    server that ignored Range answers 200 with the whole object, which we
+    slice locally so callers always see at most `length` bytes."""
+    if status == 206:
+        return body[:length]
+    return body[offset:offset + length]
 
 
 # ---------------------------------------------------------------------------
 # local
 # ---------------------------------------------------------------------------
 class LocalStore:
+    """Filesystem-backed store. Carries the same fault sites and retry
+    semantics as the HTTP stores so chaos suites and the cold tier behave
+    identically against a local "bucket" (how the tests and benches run
+    without network egress)."""
+
+    def _retrying(self, fn, fault_point: str, key: str):
+        bo = Backoff(initial=0.05, cap=2.0)
+        attempts = _retries() + 1
+        last: Exception | None = None
+        for attempt in range(attempts):
+            _deadline.check_current()
+            try:
+                hit = None
+                if faults.ENABLED:
+                    hit = faults.fire(fault_point, key=key, store="local")
+                return fn(hit)
+            except FileNotFoundError:
+                raise            # permanent: retrying cannot conjure the key
+            except OSError as e:
+                last = e
+            if attempt + 1 >= attempts or not bo.sleep(_deadline_expiry()):
+                break
+        raise ObjectStoreError(
+            f"local {key} failed after {attempts} attempts: {last}")
+
     def get(self, key: str) -> bytes:
-        with open(key, "rb") as f:
-            return f.read()
+        def fn(hit):
+            with open(key, "rb") as f:
+                return _apply_body_fault(hit, f.read())
+        return self._retrying(fn, "objstore.get", key)
+
+    def get_range(self, key: str, offset: int, length: int) -> bytes:
+        def fn(hit):
+            with open(key, "rb") as f:
+                f.seek(offset)
+                return _apply_body_fault(hit, f.read(length))
+        return self._retrying(fn, "objstore.get", key)
 
     def put(self, key: str, data: bytes) -> None:
-        with open(key, "wb") as f:
-            f.write(data)
+        def fn(hit):
+            d = os.path.dirname(key)
+            if d:
+                os.makedirs(d, exist_ok=True)
+            body = data
+            if hit is not None and hit[0] == "torn":
+                keep = int(hit[1]) if hit[1] else len(data) // 2
+                body = data[:keep]
+            with open(key, "wb") as f:
+                f.write(body)
+            if body is not data:
+                raise ObjectStoreError(f"local {key}: torn write injected")
+        return self._retrying(fn, "objstore.put", key)
+
+    def delete(self, key: str) -> None:
+        def fn(hit):
+            try:
+                os.unlink(key)
+            except FileNotFoundError:
+                pass   # idempotent delete, like the HTTP stores' 404
+        return self._retrying(fn, "objstore.put", key)
 
 
 # ---------------------------------------------------------------------------
@@ -214,11 +359,29 @@ class S3Store:
 
     def get(self, key: str) -> bytes:
         url, path = self._url_and_path(key)
-        return _http("GET", url, self._signed_headers("GET", path, b""), None)
+        return _http("GET", url, self._signed_headers("GET", path, b""), None,
+                     fault_point="objstore.get", key=key)
+
+    def get_range(self, key: str, offset: int, length: int) -> bytes:
+        url, path = self._url_and_path(key)
+        headers = self._signed_headers("GET", path, b"")
+        # Range rides unsigned: SigV4 covers only host/x-amz-* here
+        # (SignedHeaders=host;x-amz-content-sha256;x-amz-date), so adding it
+        # after signing is wire-legal
+        headers["Range"] = _range_header(offset, length)
+        status, body = _http_status("GET", url, headers, None,
+                                    fault_point="objstore.get", key=key)
+        return _slice_range(status, body, offset, length)
 
     def put(self, key: str, data: bytes) -> None:
         url, path = self._url_and_path(key)
-        _http("PUT", url, self._signed_headers("PUT", path, data), data)
+        _http("PUT", url, self._signed_headers("PUT", path, data), data,
+              fault_point="objstore.put", key=key)
+
+    def delete(self, key: str) -> None:
+        url, path = self._url_and_path(key)
+        _http("DELETE", url, self._signed_headers("DELETE", path, b""),
+              None, fault_point="objstore.put", key=key)
 
 
 # ---------------------------------------------------------------------------
@@ -286,16 +449,31 @@ class GcsStore:
         self._tok = (tok, time.monotonic() + 3300)
         return tok
 
+    def _media_url(self, key: str) -> str:
+        return (f"{self.base}/storage/v1/b/{self.bucket}/o/"
+                f"{urllib.parse.quote(key, safe='')}?alt=media")
+
     def get(self, key: str) -> bytes:
-        url = (f"{self.base}/storage/v1/b/{self.bucket}/o/"
-               f"{urllib.parse.quote(key, safe='')}?alt=media")
-        return _http("GET", url, self._auth(), None)
+        return _http("GET", self._media_url(key), self._auth(), None,
+                     fault_point="objstore.get", key=key)
+
+    def get_range(self, key: str, offset: int, length: int) -> bytes:
+        headers = {**self._auth(), "Range": _range_header(offset, length)}
+        status, body = _http_status("GET", self._media_url(key), headers,
+                                    None, fault_point="objstore.get", key=key)
+        return _slice_range(status, body, offset, length)
 
     def put(self, key: str, data: bytes) -> None:
         url = (f"{self.base}/upload/storage/v1/b/{self.bucket}/o"
                f"?uploadType=media&name={urllib.parse.quote(key, safe='')}")
         headers = {"Content-Type": "application/octet-stream", **self._auth()}
-        _http("POST", url, headers, data)
+        _http("POST", url, headers, data, fault_point="objstore.put", key=key)
+
+    def delete(self, key: str) -> None:
+        url = (f"{self.base}/storage/v1/b/{self.bucket}/o/"
+               f"{urllib.parse.quote(key, safe='')}")
+        _http("DELETE", url, self._auth(), None,
+              fault_point="objstore.put", key=key)
 
 
 # ---------------------------------------------------------------------------
@@ -321,10 +499,15 @@ class AzblobStore:
         else:
             self.base = f"https://{self.account}.blob.core.windows.net"
 
-    def _headers(self, method: str, key: str, body: bytes | None) -> dict:
+    def _headers(self, method: str, key: str, body: bytes | None,
+                 extra: dict | None = None) -> dict:
         now = datetime.datetime.now(datetime.timezone.utc) \
             .strftime("%a, %d %b %Y %H:%M:%S GMT")
         headers = {"x-ms-date": now, "x-ms-version": "2021-08-06"}
+        if extra:
+            # merged before signing: x-ms-* extras (x-ms-range) land in the
+            # sorted CanonicalizedHeaders block and are covered by the MAC
+            headers.update(extra)
         length = str(len(body)) if body else ""
         content_type = ""
         if body is not None:
@@ -362,7 +545,21 @@ class AzblobStore:
 
     def get(self, key: str) -> bytes:
         return _http("GET", self._url(key), self._headers("GET", key, None),
-                     None)
+                     None, fault_point="objstore.get", key=key)
+
+    def get_range(self, key: str, offset: int, length: int) -> bytes:
+        headers = self._headers(
+            "GET", key, None,
+            extra={"x-ms-range": _range_header(offset, length)})
+        status, body = _http_status("GET", self._url(key), headers, None,
+                                    fault_point="objstore.get", key=key)
+        return _slice_range(status, body, offset, length)
 
     def put(self, key: str, data: bytes) -> None:
-        _http("PUT", self._url(key), self._headers("PUT", key, data), data)
+        _http("PUT", self._url(key), self._headers("PUT", key, data), data,
+              fault_point="objstore.put", key=key)
+
+    def delete(self, key: str) -> None:
+        _http("DELETE", self._url(key),
+              self._headers("DELETE", key, None), None,
+              fault_point="objstore.put", key=key)

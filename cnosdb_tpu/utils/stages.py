@@ -81,6 +81,16 @@ STAGE_CATALOG: dict[str, str] = {
     "topk.device": "ORDER BY+LIMIT thresholds computed by jax.lax.top_k",
     "topk.declined": "ORDER BY+LIMIT shapes outside the top-k fast path "
                      "(nulls/NaN/object keys, k≥n) — full sort",
+    "cold.fetch_ms": "ranged object-store GETs for cold-tier pages "
+                     "(storage/tiering.py fetch_pages)",
+    "cold.range_gets": "coalesced byte-range requests issued to the "
+                       "object store by cold scans",
+    "cold.pages_fetched": "cold pages whose bytes were downloaded "
+                          "(cache misses after pruning)",
+    "cold.bytes_downloaded": "bytes fetched from the object store by "
+                             "cold scans (vs. bytes the pages span)",
+    "cold.pages_pruned": "cold pages eliminated locally by sidecar zone "
+                         "maps/constraints — zero bytes downloaded",
 }
 
 # Prefixes for names composed at runtime (skipped by the literal lint

@@ -35,10 +35,29 @@ class _FakeStore(BaseHTTPRequestHandler):
     def do_GET(self):
         self.server.requests.append(
             ("GET", self.path, {k.lower(): v for k, v in self.headers.items()}))
+        if self.server.fail_statuses:
+            self.send_response(self.server.fail_statuses.pop(0))
+            self.end_headers()
+            return
         blob = self.server.blobs.get(self._key())
         if blob is None:
             self.send_response(404)
             self.end_headers()
+            return
+        # S3/GCS send `Range`, Azure signs `x-ms-range`; both use the same
+        # bytes=a-b grammar. ignore_range models a server that answers 200
+        # with the whole object (clients must slice locally).
+        rng = self.headers.get("Range") or self.headers.get("x-ms-range")
+        if rng and not self.server.ignore_range:
+            a, b = rng.split("=", 1)[1].split("-")
+            chunk = blob[int(a):int(b) + 1]
+            self.send_response(206)
+            self.send_header(
+                "Content-Range", f"bytes {a}-{int(a) + len(chunk) - 1}"
+                                 f"/{len(blob)}")
+            self.send_header("Content-Length", str(len(chunk)))
+            self.end_headers()
+            self.wfile.write(chunk)
             return
         self.send_response(200)
         self.send_header("Content-Length", str(len(blob)))
@@ -75,6 +94,8 @@ def fake(request):
     srv = ThreadingHTTPServer(("127.0.0.1", 0), _FakeStore)
     srv.blobs = {}
     srv.requests = []
+    srv.fail_statuses = []
+    srv.ignore_range = False
     t = threading.Thread(target=srv.serve_forever, daemon=True)
     t.start()
     yield srv
@@ -162,6 +183,143 @@ def test_uri_parsing_errors():
         objstore.parse_uri("ftp://b/k")
     with pytest.raises(objstore.ObjectStoreError):
         objstore.parse_uri("s3:///nobucket")
+
+
+# ---------------------------------------------------------------------------
+# byte-range reads (cold-tier page fetch path) + retry semantics
+# ---------------------------------------------------------------------------
+_BLOB = bytes(range(256)) * 4   # 1 KiB, every offset distinguishable
+
+
+def _range_gets(srv):
+    return [r for r in srv.requests if r[0] == "GET"
+            and ("range" in r[2] or "x-ms-range" in r[2])]
+
+
+def test_s3_get_range_sends_range_header_and_handles_206(fake):
+    st = objstore.S3Store("bkt", endpoint_url=_endpoint(fake),
+                          access_key_id="AK", secret_key="SK")
+    st.put("obj", _BLOB)
+    assert st.get_range("obj", 100, 64) == _BLOB[100:164]
+    (_, _, hdrs), = _range_gets(fake)
+    assert hdrs["range"] == "bytes=100-163"
+    # Range rides outside the SigV4 signature — the signed header set must
+    # not change when it is added (a signer that folded it in would 403
+    # against real S3)
+    assert "SignedHeaders=host;x-amz-content-sha256;x-amz-date" \
+        in hdrs["authorization"]
+
+
+def test_gcs_get_range(fake):
+    st = objstore.GcsStore("bkt", gcs_base_url=_endpoint(fake),
+                           disable_oauth=True)
+    st.put("d/x.bin", _BLOB)
+    assert st.get_range("d/x.bin", 0, 16) == _BLOB[:16]
+    assert st.get_range("d/x.bin", 1000, 64) == _BLOB[1000:1024]  # past EOF
+    (_, _, h1), (_, _, h2) = _range_gets(fake)
+    assert h1["range"] == "bytes=0-15" and h2["range"] == "bytes=1000-1063"
+
+
+def test_azblob_get_range_signs_x_ms_range(fake):
+    key = base64.b64encode(b"storage-account-key").decode()
+    st = objstore.AzblobStore("ctr", account="acct", access_key=key,
+                              endpoint_url=_endpoint(fake))
+    st.put("b.bin", _BLOB)
+    assert st.get_range("b.bin", 7, 9) == _BLOB[7:16]
+    (_, _, hdrs), = _range_gets(fake)
+    # Azure's ranged read uses x-ms-range (covered by the SharedKey MAC),
+    # not the plain Range header
+    assert hdrs["x-ms-range"] == "bytes=7-15"
+    assert "range" not in hdrs
+    assert hdrs["authorization"].startswith("SharedKey acct:")
+
+
+def test_local_get_range(tmp_path):
+    p = str(tmp_path / "obj.bin")
+    st = objstore.LocalStore()
+    st.put(p, _BLOB)
+    assert st.get_range(p, 300, 12) == _BLOB[300:312]
+    assert st.get_range(p, 1020, 100) == _BLOB[1020:]   # clamped at EOF
+
+
+def test_get_range_falls_back_to_200_full_body(fake):
+    # a server that ignores Range answers 200 with the whole object; the
+    # client slices locally so callers still see exactly [offset, offset+n)
+    st = objstore.S3Store("bkt", endpoint_url=_endpoint(fake))
+    st.put("obj", _BLOB)
+    fake.ignore_range = True
+    assert st.get_range("obj", 33, 10) == _BLOB[33:43]
+
+
+def test_http_5xx_retries_until_success(fake, monkeypatch):
+    monkeypatch.setenv("CNOSDB_OBJSTORE_RETRIES", "4")
+    st = objstore.S3Store("bkt", endpoint_url=_endpoint(fake))
+    st.put("k", b"v")
+    fake.fail_statuses = [503, 500]
+    assert st.get("k") == b"v"
+    gets = [r for r in fake.requests if r[0] == "GET"]
+    assert len(gets) == 3                         # 2 failures + 1 success
+
+
+def test_http_retry_budget_exhausts(fake, monkeypatch):
+    monkeypatch.setenv("CNOSDB_OBJSTORE_RETRIES", "1")   # 2 attempts
+    st = objstore.S3Store("bkt", endpoint_url=_endpoint(fake))
+    st.put("k", b"v")
+    fake.fail_statuses = [500, 500, 500]
+    with pytest.raises(objstore.ObjectStoreError, match="after 2 attempts"):
+        st.get("k")
+    assert len([r for r in fake.requests if r[0] == "GET"]) == 2
+
+
+def test_http_404_is_permanent_no_retry(fake, monkeypatch):
+    monkeypatch.setenv("CNOSDB_OBJSTORE_RETRIES", "4")
+    st = objstore.S3Store("bkt", endpoint_url=_endpoint(fake))
+    with pytest.raises(objstore.ObjectStoreError, match="404"):
+        st.get("missing")
+    assert len(fake.requests) == 1                # no second attempt
+
+
+def test_injected_get_fault_retries_then_succeeds(fake, monkeypatch):
+    from cnosdb_tpu import faults
+
+    monkeypatch.setenv("CNOSDB_OBJSTORE_RETRIES", "4")
+    st = objstore.GcsStore("bkt", gcs_base_url=_endpoint(fake),
+                           disable_oauth=True)
+    st.put("x", b"payload")
+    faults.configure("seed=1;objstore.get:fail:times=2")
+    try:
+        assert st.get("x") == b"payload"
+        log = [f for f in faults.fired_log() if f[0] == "objstore.get"]
+        assert len(log) == 2
+    finally:
+        faults.reset()
+
+
+def test_local_store_get_fault_retries(tmp_path, monkeypatch):
+    from cnosdb_tpu import faults
+
+    monkeypatch.setenv("CNOSDB_OBJSTORE_RETRIES", "4")
+    p = str(tmp_path / "f.bin")
+    st = objstore.LocalStore()
+    st.put(p, b"data")
+    faults.configure("seed=1;objstore.get:fail:times=2")
+    try:
+        assert st.get_range(p, 1, 2) == b"at"
+    finally:
+        faults.reset()
+
+
+def test_injected_put_fault_exhausts_budget(tmp_path, monkeypatch):
+    from cnosdb_tpu import faults
+
+    monkeypatch.setenv("CNOSDB_OBJSTORE_RETRIES", "1")
+    st = objstore.LocalStore()
+    faults.configure("seed=1;objstore.put:fail")       # every attempt fails
+    try:
+        with pytest.raises(objstore.ObjectStoreError, match="2 attempts"):
+            st.put(str(tmp_path / "f.bin"), b"data")
+    finally:
+        faults.reset()
 
 
 # ---------------------------------------------------------------------------
