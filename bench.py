@@ -818,6 +818,22 @@ def main():
             except Exception as e:   # a tier failure must not sink the
                 suites = {"suite_errors": {"tier": repr(e)[:200]}}
 
+        # chaos: crash the canonical workload at the fast sweep's fault
+        # sites in subprocesses, restart, and report recovery time plus
+        # the client-history checker verdicts (skippable with
+        # CNOSDB_BENCH_CHAOS=0)
+        chaos_results = {}
+        if os.environ.get("CNOSDB_BENCH_CHAOS", "1") != "0":
+            try:
+                import tempfile
+
+                from cnosdb_tpu.chaos import sweep as chaos_sweep
+
+                with tempfile.TemporaryDirectory() as chaos_dir:
+                    chaos_results = chaos_sweep.bench_block(chaos_dir)
+            except Exception as e:   # a chaos failure must not sink
+                chaos_results = {"error": repr(e)[:200]}
+
         device = _device_kernel_metric()
         _persist_device_evidence(device)
         # invariant plane: per-rule finding counts + analyzer wall time,
@@ -849,6 +865,7 @@ def main():
             "string_bench": string_results,
             "string_filter_engagements": _string_filter_engagements(),
             "lint_findings": lint_findings,
+            "chaos": chaos_results,
             **suites,
             **device,
         }))

@@ -810,6 +810,55 @@ class ColdTierAccounting(Rule):
                            f"covering it")
 
 
+# --------------------------------------------------------------------------
+# 14. fault-site-coverage — new (PR 13): every fire() site must be in the
+#     FAULT_POINTS registry the crash sweep enumerates
+# --------------------------------------------------------------------------
+_FSC_RECEIVERS = {"faults", "_faults"}
+
+
+class FaultSiteCoverage(Rule):
+    name = "fault-site-coverage"
+    motivation = ("PR 13 nemesis plane: the crash-point sweep enumerates "
+                  "faults.FAULT_POINTS — a fire() site that never "
+                  "registered is a fault point the sweep silently skips, "
+                  "so its torn-state bugs go unexplored; every site must "
+                  "register_point() in its module or carry a reasoned "
+                  "disable")
+    node_types = (ast.Call,)
+
+    def begin_module(self, ctx):
+        self._registered = set()
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Call) \
+                    and _call_name(node) == "register_point" \
+                    and _recv_text(node) in _FSC_RECEIVERS \
+                    and node.args \
+                    and isinstance(node.args[0], ast.Constant) \
+                    and isinstance(node.args[0].value, str):
+                self._registered.add(node.args[0].value)
+
+    def visit(self, node, ctx):
+        if _call_name(node) != "fire" \
+                or _recv_text(node) not in _FSC_RECEIVERS \
+                or not node.args:
+            return
+        arg = node.args[0]
+        if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+            if arg.value not in self._registered:
+                ctx.report(self, node,
+                           f"fault point {arg.value!r} fired here but "
+                           f"never registered — add faults.register_point"
+                           f"({arg.value!r}, __name__, ...) in this "
+                           f"module so the crash sweep covers it")
+        else:
+            ctx.report(self, node,
+                       "dynamic fault point name — the sweep registry is "
+                       "static, so fire() must name a literal registered "
+                       "point, or register every candidate point and "
+                       "carry a reasoned lint disable")
+
+
 def all_rules() -> list:
     from .interproc import project_rules
 
@@ -817,4 +866,4 @@ def all_rules() -> list:
             LockBlocking(), SwallowedException(), JaxPurity(),
             WallclockDuration(), MetricsNaming(), StageCatalog(),
             DeviceDecodeAccounting(), StringFilterAccounting(),
-            ColdTierAccounting(), *project_rules()]
+            ColdTierAccounting(), FaultSiteCoverage(), *project_rules()]

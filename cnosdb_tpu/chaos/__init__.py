@@ -1,0 +1,68 @@
+"""Nemesis plane: client-history consistency checking + crash-point sweep.
+
+The fault grammar (cnosdb_tpu/faults.py) *injects* failures; this package
+decides whether the system survived them from the only vantage point that
+matters — what clients were told. Three parts:
+
+  history.py   append-only invoke/ok/fail recorder for client operations
+               (writes, reads, deletes, DDL), session-tagged, ordered by
+               a logical event index — no wall-clock dependence, so
+               verdicts replay identically across machines and runs.
+  checker.py   invariants over a history + the post-recovery observed
+               state: no-lost-acked-write, no-resurrection, per-session
+               monotonic reads / read-your-writes, matview-vs-scan
+               parity, checksum-group convergence.
+  workload.py  the canonical single-node write→flush→compact→tier→matview
+               workload, runnable as a subprocess so an injected ``crash``
+               (os._exit) kills a real process mid-step; verify() reopens
+               the same directories (recovery) and runs the checker.
+  sweep.py     exhaustive crash-point sweep: a ``noop`` probe pass learns
+               how many times each registered FAULT_POINT is crossed, then
+               every (point, nth) pair gets its own fresh run with
+               ``crash`` armed — restart, recover, check.
+  nemesis.py   seeded deterministic fault schedules (partition,
+               crash-restart, delay storm, corrupt) composed over the
+               multi-process cluster harness via the `_faults` RPC.
+
+Every verdict and recovery timing lands here, exported on /metrics as
+``cnosdb_chaos_total{check,verdict}`` and recovery-time gauges.
+"""
+from __future__ import annotations
+
+from ..utils import lockwatch
+
+_lock = lockwatch.Lock("chaos.counters")
+_verdicts: dict[tuple[str, str], int] = {}
+_recovery: dict[str, float] = {}
+
+
+def note_verdict(check: str, ok: bool) -> None:
+    key = (check, "pass" if ok else "fail")
+    with _lock:
+        _verdicts[key] = _verdicts.get(key, 0) + 1
+
+
+def note_recovery(kind: str, seconds: float) -> None:
+    """Latest recovery duration per kind (e.g. crash→first successful
+    full read) — a gauge, not a counter: the current answer to "how long
+    does recovery take", refreshed by every measured recovery."""
+    with _lock:
+        _recovery[kind] = float(seconds)
+
+
+def chaos_snapshot() -> dict[tuple[str, str], int]:
+    """(check, verdict) → count, for /metrics cnosdb_chaos_total."""
+    with _lock:
+        return dict(_verdicts)
+
+
+def recovery_snapshot() -> dict[str, float]:
+    """kind → seconds, for the /metrics recovery gauges."""
+    with _lock:
+        return dict(_recovery)
+
+
+def counters_reset() -> None:
+    with _lock:
+        _verdicts.clear()
+        _recovery.clear()

@@ -1,0 +1,141 @@
+"""Client-op history: an append-only JSONL of invoke/ok/fail events.
+
+The recorder is the only wall-clock-free ground truth a consistency
+checker can trust: each event carries a monotonically increasing logical
+index `e` (file order == happens-before as the client saw it), a session
+id `s`, and for outcome events the index `of` of the invoke they resolve.
+Mutating invokes are fsync'd *before* the operation executes — otherwise
+a crash could apply a write whose invoke record died in the page cache,
+and the checker would misread the surviving row as a resurrection.
+
+The file itself is crash-exposed (that is the point), so the loader
+tolerates a torn tail: a trailing line that does not parse is dropped,
+anything before it must parse. An invoke with no outcome is *ambiguous* —
+the operation may or may not have been applied — and every check treats
+it that way (allowed but never required).
+"""
+from __future__ import annotations
+
+import json
+import os
+from dataclasses import dataclass, field
+
+
+class HistoryRecorder:
+    """Append-only writer. Not thread-safe per instance by design — one
+    recorder per client session thread, or callers serialize; the nemesis
+    driver gives each session its own recorder over the same file via
+    `shared_lock`."""
+
+    def __init__(self, path: str, lock=None):
+        self.path = path
+        self._lock = lock
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        self._f = open(path, "ab")
+        # continue the index after a restart: events already on disk keep
+        # their positions, new ones append after them
+        self._n = sum(1 for _ in History.load(path).events)
+
+    def _emit(self, ev: dict, durable: bool) -> int:
+        if self._lock is not None:
+            with self._lock:
+                return self._emit_locked(ev, durable)
+        return self._emit_locked(ev, durable)
+
+    def _emit_locked(self, ev: dict, durable: bool) -> int:
+        ev["e"] = self._n
+        self._n += 1
+        self._f.write(json.dumps(ev, separators=(",", ":")).encode() + b"\n")
+        self._f.flush()
+        if durable:
+            os.fsync(self._f.fileno())
+        return ev["e"]
+
+    def invoke(self, session: str, op: str, durable: bool = True,
+               **data) -> int:
+        """Record an operation about to start; returns its event index.
+        `durable` must stay True for mutating ops (see module doc)."""
+        return self._emit({"s": session, "t": "invoke", "op": op, **data},
+                          durable)
+
+    def ok(self, session: str, of: int, **data) -> int:
+        return self._emit({"s": session, "t": "ok", "of": of, **data},
+                          durable=False)
+
+    def fail(self, session: str, of: int, err: str = "") -> int:
+        return self._emit({"s": session, "t": "fail", "of": of,
+                           "err": err[:200]}, durable=False)
+
+    def close(self) -> None:
+        self._f.close()
+
+
+@dataclass
+class Op:
+    """One invoke joined to its outcome (if any)."""
+    op: str
+    session: str
+    invoke_e: int
+    data: dict
+    outcome: str | None = None      # "ok" | "fail" | None (ambiguous)
+    outcome_e: int = -1
+    ok_data: dict = field(default_factory=dict)
+
+    @property
+    def acked(self) -> bool:
+        return self.outcome == "ok"
+
+
+class History:
+    """Parsed history: raw `events` plus invoke/outcome-joined `ops`."""
+
+    def __init__(self, events: list[dict]):
+        self.events = events
+        by_e: dict[int, Op] = {}
+        for ev in events:
+            if ev.get("t") == "invoke":
+                data = {k: v for k, v in ev.items()
+                        if k not in ("e", "s", "t", "op")}
+                by_e[ev["e"]] = Op(op=ev.get("op", "?"), session=ev["s"],
+                                   invoke_e=ev["e"], data=data)
+        for ev in events:
+            t = ev.get("t")
+            if t not in ("ok", "fail"):
+                continue
+            inv = by_e.get(ev.get("of", -1))
+            if inv is None or inv.outcome is not None:
+                continue
+            inv.outcome = t
+            inv.outcome_e = ev["e"]
+            if t == "ok":
+                inv.ok_data = {k: v for k, v in ev.items()
+                               if k not in ("e", "s", "t", "of")}
+        self.ops = sorted(by_e.values(), key=lambda o: o.invoke_e)
+
+    @classmethod
+    def load(cls, path: str) -> "History":
+        events: list[dict] = []
+        try:
+            with open(path, "rb") as f:
+                lines = f.read().split(b"\n")
+        except FileNotFoundError:
+            return cls([])
+        for i, line in enumerate(lines):
+            if not line.strip():
+                continue
+            try:
+                events.append(json.loads(line))
+            except ValueError:
+                # only the final line may be torn — a parse failure with
+                # more data after it means the file is corrupt, not torn,
+                # and the checker must not silently drop evidence
+                if any(l.strip() for l in lines[i + 1:]):
+                    raise
+                break
+        return cls(events)
+
+    def by_op(self, *names: str) -> list[Op]:
+        return [o for o in self.ops if o.op in names]
+
+    def sessions(self) -> list[str]:
+        return sorted({o.session for o in self.ops})

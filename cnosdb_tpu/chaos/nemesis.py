@@ -1,0 +1,79 @@
+"""Seeded nemesis schedules: deterministic fault plans for a live cluster.
+
+A plan is a pure function of (seed, n_nodes, steps, kinds) — the same
+seed always yields the same event sequence, so a failure printed with its
+seed is a one-line reproduction. Events are *applied* by the caller (the
+cluster suite in tests/test_chaos_cluster.py) because only it holds the
+harness: partitions and delay storms become CNOSDB_FAULTS specs pushed
+over the `_faults` runtime RPC, crash-restarts use the harness's
+kill/start, disk corruption arms the scrub.read corrupt action. This
+module renders those specs; it never talks to a process itself.
+"""
+from __future__ import annotations
+
+import random
+from dataclasses import dataclass
+
+KINDS = ("partition", "crash_restart", "delay_storm", "corrupt")
+
+
+@dataclass(frozen=True)
+class NemesisEvent:
+    step: int
+    kind: str       # one of KINDS
+    node: int       # victim data-node index
+    param: int      # kind-specific: delay ms / bytes to corrupt
+
+
+def generate_plan(seed: int, n_nodes: int, steps: int = 6,
+                  kinds: tuple[str, ...] = KINDS) -> list[NemesisEvent]:
+    """Deterministic event sequence; `seed` fully determines it."""
+    for k in kinds:
+        if k not in KINDS:
+            raise ValueError(f"unknown nemesis kind {k!r}")
+    rng = random.Random(seed)
+    plan = []
+    for i in range(steps):
+        kind = kinds[rng.randrange(len(kinds))]
+        plan.append(NemesisEvent(step=i, kind=kind,
+                                 node=rng.randrange(n_nodes),
+                                 param=rng.choice((20, 50, 120))))
+    return plan
+
+
+def event_specs(ev: NemesisEvent, victim_addr: str,
+                seed: int) -> tuple[str, str]:
+    """→ (victim node's CNOSDB_FAULTS spec, every other node's spec) for
+    the duration of the event; ("", "") means the harness acts directly
+    (crash_restart = kill + start, no injection needed)."""
+    prefix = f"seed={seed + ev.step};"
+    if ev.kind == "partition":
+        # victim drops all outbound sends; peers drop sends to the victim
+        # — a symmetric partition around one node
+        return (prefix + "rpc.send:fail",
+                prefix + f"rpc.send:fail:if={victim_addr}")
+    if ev.kind == "delay_storm":
+        return (prefix + f"rpc.send:delay({ev.param}):prob=0.5",
+                prefix + f"rpc.send:delay({ev.param}):prob=0.2,"
+                         f"if={victim_addr}")
+    if ev.kind == "corrupt":
+        # flip bytes of the next file the victim's scrubber verifies —
+        # at-rest corruption the integrity plane must catch and repair
+        return (prefix + f"scrub.read:corrupt({max(1, ev.param // 20)})"
+                         f":once", "")
+    if ev.kind == "crash_restart":
+        return ("", "")
+    raise ValueError(f"unknown nemesis kind {ev.kind!r}")
+
+
+def heal_spec(seed: int, ev: NemesisEvent) -> str:
+    """Spec that clears the event's injection but keeps faults armed (the
+    harness keeps CNOSDB_FAULTS in the env, so "" would disarm the
+    control surface on the next restart — send the bare seed instead)."""
+    return f"seed={seed + ev.step}"
+
+
+def describe(plan: list[NemesisEvent], seed: int) -> str:
+    head = f"nemesis seed={seed} ({len(plan)} steps): "
+    return head + ", ".join(
+        f"#{e.step} {e.kind}@n{e.node}(p={e.param})" for e in plan)

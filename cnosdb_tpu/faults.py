@@ -19,7 +19,7 @@ Schedule grammar (rules separated by ``;``)::
     CNOSDB_FAULTS = "seed=<int>" | <rule> { ";" <rule> }
     rule          = <point> ":" <action> [ ":" <sched> ]
     action        = fail | delay(<ms>) | drop | torn[(<bytes>)]
-                  | corrupt[(<nbytes>)] | enospc | io_error | crash
+                  | corrupt[(<nbytes>)] | enospc | io_error | crash | noop
     sched         = <k>=<v> { "," <k>=<v> }     # all optional, AND-ed
                       nth=<k>     fire only on the k-th matching hit
                       after=<k>   fire on every hit after the k-th
@@ -43,17 +43,15 @@ returns the ``(action, arg)`` tuple and the hook site performs the partial
 write / reply drop / on-disk bit flip itself. ``corrupt(<nbytes>)`` flips
 bytes of an already-durable file (default 1) at a deterministic offset —
 the silent-corruption model the integrity plane (storage/scrub.py) exists
-to catch.
+to catch. ``noop`` fires (lands in the fired log, advances hit counters)
+but does nothing — the chaos sweep's probe pass arms it at every point to
+learn how many times each site is crossed by a workload.
 
-Fault points currently threaded (see ARCHITECTURE.md "Fault model"):
-  rpc.send rpc.response rpc.server rpc.reply          parallel/net.py
-  record.append record.sync                           storage/record_file.py
-  wal.append wal.sync wal.roll                        storage/wal.py
-  flush.run                                           storage/flush.py
-  compaction.run                                      storage/compaction.py
-  meta.propose meta.apply                             parallel/meta_service.py
-  tsm.write scrub.read                                storage/tsm.py, scrub.py
-  objstore.get objstore.put                           utils/objstore.py
+Every fire() site self-registers in :data:`FAULT_POINTS` via
+:func:`register_point` at module import — the registry the crash-point
+sweep (cnosdb_tpu/chaos/sweep.py) enumerates and the `fault-site-coverage`
+lint rule enforces. The authoritative point table lives in ARCHITECTURE.md
+"Fault model"; at runtime, ``control({"points": True})`` returns it.
 """
 from __future__ import annotations
 
@@ -87,7 +85,49 @@ _seed = 0
 
 _SITE_ACTIONS = frozenset({"torn", "drop", "corrupt"})
 _KNOWN_ACTIONS = _SITE_ACTIONS | {"fail", "delay", "enospc", "io_error",
-                                  "crash"}
+                                  "crash", "noop"}
+
+
+class FaultPoint:
+    """One registered fire() site — the unit the crash-point sweep
+    enumerates. `scope` is "node" when the point is reachable from the
+    single-process canonical workload (chaos/workload.py) and therefore
+    swept crash-by-crash, or "cluster" when it only fires across
+    processes (RPC plane, meta raft) and is exercised by the nemesis
+    suite in tests/test_chaos_cluster.py instead."""
+
+    __slots__ = ("name", "module", "scope", "desc")
+
+    def __init__(self, name: str, module: str, scope: str, desc: str):
+        self.name = name
+        self.module = module
+        self.scope = scope
+        self.desc = desc
+
+    def as_row(self) -> list[str]:
+        return [self.name, self.module, self.scope, self.desc]
+
+
+# point name -> FaultPoint; populated by register_point() calls that sit
+# next to each fire() site (enforced by the fault-site-coverage lint rule)
+FAULT_POINTS: dict[str, FaultPoint] = {}
+
+
+def register_point(name: str, module: str, scope: str = "node",
+                   desc: str = "") -> None:
+    """Self-registration for a fire() site, called at import of the module
+    that hosts the hook. Idempotent (module reload overwrites)."""
+    if scope not in ("node", "cluster"):
+        raise ValueError(f"fault point {name!r}: scope must be node|cluster")
+    with _lock:
+        FAULT_POINTS[name] = FaultPoint(name, module, scope, desc)
+
+
+def registered_points(scope: str | None = None) -> dict[str, FaultPoint]:
+    """Snapshot of the registry, optionally filtered to one scope."""
+    with _lock:
+        return {n: p for n, p in FAULT_POINTS.items()
+                if scope is None or p.scope == scope}
 
 
 class _Rule:
@@ -237,6 +277,8 @@ def fire(point: str, **ctx) -> tuple[str, str | None] | None:
             return None
         action, arg = hit.action, hit.arg
     # execute OUTSIDE the lock: delay must not serialize unrelated points
+    if action == "noop":
+        return None   # fired log + hit counters advanced; nothing injected
     if action == "fail":
         raise FaultInjected(f"injected fail at {point}")
     if action == "enospc":
@@ -291,6 +333,7 @@ def control(payload: dict) -> dict:
 
       {"spec": "<schedule>"}  reconfigure ("" disables)
       {"log": true}           return the fired log
+      {"points": true}        return the FAULT_POINTS registry rows
     """
     out: dict = {"ok": True}
     if "spec" in payload:
@@ -298,6 +341,9 @@ def control(payload: dict) -> dict:
         out["enabled"] = ENABLED
     if payload.get("log"):
         out["log"] = [list(t) for t in fired_log()]
+    if payload.get("points"):
+        out["points"] = [p.as_row() for _, p in
+                         sorted(registered_points().items())]
     return out
 
 

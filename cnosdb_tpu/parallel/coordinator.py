@@ -650,7 +650,13 @@ class Coordinator:
         from ..storage import tiering
 
         d = self.engine.vnode_dir(owner, vnode_id)
-        return "cold" if tiering.cold_ids(d) else "hot"
+        try:
+            return "cold" if tiering.cold_ids(d) else "hot"
+        except TsmError:
+            # torn cold registry: the tier is only a planning hint, so
+            # answer "cold" and let the scan hit the damage inside the
+            # guarded path, where _recover_cold rebuilds and retries
+            return "cold"
 
     def _recover_cold(self, owner: str, vnode_id: int) -> int:
         """Rebuild lost / corrupt cold-tier sidecars of a LOCAL vnode
@@ -661,8 +667,13 @@ class Coordinator:
 
         try:
             v = self.engine.vnode(owner, vnode_id)
-            if v is None or not tiering.cold_ids(v.dir):
+            if v is None:
                 return 0
+            try:
+                if not tiering.cold_ids(v.dir):
+                    return 0
+            except TsmError:
+                pass    # torn registry: exactly what recover_vnode heals
             n = tiering.recover_vnode(v)
         except Exception:
             log.exception("cold-tier recovery of vnode %s failed", vnode_id)

@@ -32,6 +32,11 @@ from ..models.meta_data import BucketInfo
 from ..models.schema import DatabaseSchema, TenantOptions, TskvTableSchema
 from .meta import MetaStore
 from .net import RpcError, RpcServer, rpc_call
+
+faults.register_point("meta.propose", __name__, scope="cluster",
+                      desc="meta mutation proposed to the replicated log")
+faults.register_point("meta.apply", __name__, scope="cluster",
+                      desc="committed meta entry applied to the store")
 from ..utils import lockwatch
 
 # mutation → {arg name → rehydrator} applied server-side

@@ -36,6 +36,15 @@ from ..utils import lockwatch
 
 log = logging.getLogger("cnosdb.rpc")
 
+faults.register_point("rpc.send", __name__, scope="cluster",
+                      desc="client connect/send to a peer")
+faults.register_point("rpc.response", __name__, scope="cluster",
+                      desc="reply lost in flight after the server applied")
+faults.register_point("rpc.server", __name__, scope="cluster",
+                      desc="server-side dispatch of an inbound method")
+faults.register_point("rpc.reply", __name__, scope="cluster",
+                      desc="server reply serialization/drop")
+
 # Intra-cluster shared secret (CNOSDB_CLUSTER_SECRET): when set, every RPC
 # must carry it — the plane exposes destructive admin and file-installing
 # methods (vnode_install, meta_restore, raft_msg), so any deployment that

@@ -918,6 +918,16 @@ class HttpServer:
                                    bc["bytes"])
             self.metrics.set_gauge("cnosdb_cold_block_cache_entries",
                                    bc["entries"])
+        # nemesis plane: checker verdicts + recovery timings — resident
+        # only when a chaos suite has run in this process
+        _ch = _sys.modules.get("cnosdb_tpu.chaos")
+        if _ch is not None:
+            for (check, verdict), n in _ch.chaos_snapshot().items():
+                self.metrics.set_counter("cnosdb_chaos_total", n,
+                                         check=check, verdict=verdict)
+            for kind, secs in _ch.recovery_snapshot().items():
+                self.metrics.set_gauge("cnosdb_chaos_recovery_seconds",
+                                       secs, kind=kind)
         return web.Response(text=self.metrics.prometheus_text(),
                             content_type="text/plain")
 

@@ -60,6 +60,9 @@ from .stream import WatermarkTracker
 
 log = logging.getLogger("cnosdb.matview")
 
+faults.register_point("matview.persist", __name__,
+                      desc="matview state persist, between fsync and rename")
+
 # partial functions a view can persist and the rewrite can merge — the
 # same set the vectorized cross-vnode merge supports (executor
 # _VEC_MERGE_FUNCS); anything else (collect/distinct payloads) is not a

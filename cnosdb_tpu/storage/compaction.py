@@ -22,6 +22,9 @@ from ..models.strcol import DictArray, as_dict_part as _as_dict_part, \
     unify_dictionaries
 from .memcache import _group_starts, _typed_array
 from .summary import FileMeta, Version, VersionEdit, MAX_LEVEL
+
+faults.register_point("compaction.run", __name__,
+                      desc="merge compaction, before the version edit")
 from .tombstone import tombstone_path
 from .tsm import TsmWriter
 

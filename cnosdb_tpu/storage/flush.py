@@ -16,6 +16,9 @@ from .memcache import MemCache
 from .summary import FileMeta, VersionEdit
 from .tsm import TsmWriter
 
+faults.register_point("flush.run", __name__,
+                      desc="memcache→TSM flush, before the version edit")
+
 
 def flush_memcache(cache: MemCache, file_id: int, path: str,
                    schemas: dict[str, TskvTableSchema] | None = None) -> VersionEdit | None:

@@ -19,6 +19,11 @@ from ..errors import StorageError
 FILE_MAGIC = b"CNOSREC1"
 _HDR = struct.Struct("<II")
 
+faults.register_point("record.append", __name__,
+                      desc="record-file append (torn-write site)")
+faults.register_point("record.sync", __name__,
+                      desc="record-file fsync")
+
 
 def _valid_prefix_len(path: str) -> int:
     """Byte length of the longest valid [magic + records] prefix, 0 when

@@ -54,6 +54,9 @@ from ..utils import lockwatch
 
 log = logging.getLogger(__name__)
 
+faults.register_point("scrub.read", __name__,
+                      desc="scrubber about to verify a file (corrupt-at-rest)")
+
 # ---------------------------------------------------------------------------
 # counters — always on (stages.count_error pattern); cheap enough to never
 # gate, folded into /metrics gauges at render time

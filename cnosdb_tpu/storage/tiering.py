@@ -39,6 +39,7 @@ import threading
 import time
 import zlib
 
+from .. import faults
 from ..errors import ChecksumMismatch, StorageError, TsmError
 from ..utils import lockwatch, stages
 from ..utils import objstore
@@ -48,6 +49,9 @@ from .tsm import FOOTER_SIZE, TsmReader, parse_tail
 SIDECAR_MAGIC = 0x7C05DBC1
 SIDECAR_VERSION = 1
 _SIDECAR_HDR = struct.Struct("<IBQQ")
+
+faults.register_point("tiering.registry", __name__,
+                      desc="cold.json rewrite, between fsync and rename")
 SIDECAR_SUFFIX = ".tsmc"
 REGISTRY_NAME = "cold.json"
 
@@ -204,8 +208,17 @@ def cold_map(dir_path: str) -> dict[int, dict]:
         with open(path, "r", encoding="utf-8") as f:
             raw = json.load(f)
         m = {int(fid): e for fid, e in raw.get("files", {}).items()}
-    except (OSError, ValueError):
-        m = {}
+    except (OSError, ValueError) as e:
+        # a registry that exists but does not parse must be LOUD: treating
+        # it as empty would drop every cold file from scans and let the
+        # next _registry_mutate rewrite cold.json without them — silent
+        # data loss (found by the crash-point sweep's torn-registry arm).
+        # TsmError rides the coordinator's recover-and-retry path, where
+        # recover_vnode() rebuilds the registry from the local sidecars.
+        _count_cold("registry", "unreadable")
+        stages.count_error("tiering.registry")
+        raise TsmError(f"cold registry unreadable (rebuild via "
+                       f"recover_vnode): {path}: {e}") from e
     with _reg_lock:
         _registry[dir_path] = (mtime, m)
     return m
@@ -219,23 +232,46 @@ def cold_ids(dir_path: str) -> frozenset[int]:
     return frozenset(cold_map(dir_path))
 
 
-def _registry_mutate(dir_path: str, file_id: int, entry: dict | None) -> None:
-    """Add (entry != None) or remove one cold record, atomically (tmp +
-    rename + fsync). Callers hold the vnode lock, serializing mutators."""
+def _registry_write(dir_path: str, m: dict[int, dict]) -> None:
+    """Install a full registry image atomically (tmp + fsync + rename).
+    The `tiering.registry` fault point sits between the durable tmp and
+    the rename — `crash` there leaves the OLD registry intact (atomicity
+    witness), `torn(n)` installs a truncated image (bit-rot model that
+    cold_map now refuses loudly instead of reading as empty)."""
     path = _registry_path(dir_path)
-    m = dict(cold_map(dir_path))
-    if entry is None:
-        m.pop(file_id, None)
-    else:
-        m[file_id] = entry
     tmp = path + ".tmp"
     with open(tmp, "w", encoding="utf-8") as f:
         json.dump({"files": {str(fid): e for fid, e in sorted(m.items())}}, f)
         f.flush()
         os.fsync(f.fileno())
+    torn = False
+    if faults.ENABLED:
+        hit = faults.fire("tiering.registry", dir=dir_path, path=path)
+        if hit is not None and hit[0] == "torn":
+            with open(tmp, "r+b") as tf:
+                tf.truncate(int(hit[1] or 8))
+            torn = True
     os.replace(tmp, path)
     with _reg_lock:
-        _registry[dir_path] = (os.stat(path).st_mtime_ns, m)
+        if torn:
+            # the on-disk image is damaged: caching the good in-memory
+            # map would mask the tear from this very process and defer
+            # discovery to the next boot — drop the entry so the next
+            # read hits the disk image and the recover path
+            _registry.pop(dir_path, None)
+        else:
+            _registry[dir_path] = (os.stat(path).st_mtime_ns, m)
+
+
+def _registry_mutate(dir_path: str, file_id: int, entry: dict | None) -> None:
+    """Add (entry != None) or remove one cold record, atomically. Callers
+    hold the vnode lock, serializing mutators."""
+    m = dict(cold_map(dir_path))
+    if entry is None:
+        m.pop(file_id, None)
+    else:
+        m[file_id] = entry
+    _registry_write(dir_path, m)
 
 
 # ---------------------------------------------------------------------------
@@ -527,15 +563,53 @@ def rehydrate_vnode(vnode) -> int:
     return n
 
 
+def _rebuild_registry(vnode) -> int:
+    """Inverse disaster path: cold.json torn/corrupt while the sidecars
+    survived — reconstruct each entry from its sidecar header (size and
+    tail_off live there; the object key is re-derived from vnode/file id)
+    and install a fresh registry atomically. A file with neither a hot
+    copy nor a parseable sidecar cannot be recovered locally and is left
+    out (the scrubber's repair re-vote handles it from a replica).
+    → entries rebuilt."""
+    m: dict[int, dict] = {}
+    with vnode.lock:
+        version = vnode.summary.version
+        for fm in version.all_files():
+            path = version.file_path(fm)
+            if os.path.exists(path):
+                continue               # hot: was never (or no longer) cold
+            try:
+                size, tail_off, _tail = read_sidecar(path)
+            except (TsmError, OSError):
+                _count_cold("registry", "entry_unrecoverable")
+                continue
+            m[fm.file_id] = {"key": _object_key(vnode.vnode_id, fm.file_id),
+                             "size": int(size), "tail_off": int(tail_off)}
+        _registry_write(vnode.dir, m)
+    _count_cold("registry", "entries_rebuilt", len(m))
+    return len(m)
+
+
 def recover_vnode(vnode) -> int:
     """Disaster path: local skip-index sidecars lost or corrupt while
     cold.json survived — re-fetch each tiered file's tail section from
     the object store and rebuild the sidecar. Metadata-only rehydration:
-    page bytes stay cold. → sidecars rebuilt."""
+    page bytes stay cold. The mirror-image failure (cold.json torn,
+    sidecars intact) is healed first via _rebuild_registry. → sidecars
+    rebuilt."""
     if not configured():
         _count_cold("rehydrate", "not_configured")
         return 0
     store, _ = _store_and_prefix()
+    healed = 0
+    try:
+        cold_map(vnode.dir)
+    except TsmError:
+        # counts toward the return value even when the fresh image is
+        # empty: a registry-only heal (sidecars intact) is still a
+        # recovery, and callers retrying a failed scan key off a truthy
+        # result
+        healed = max(1, _rebuild_registry(vnode))
     with vnode.lock:
         version = vnode.summary.version
         work = [(fm, cold_entry(vnode.dir, fm.file_id))
@@ -566,7 +640,7 @@ def recover_vnode(vnode) -> int:
             vnode.summary.version.drop_reader(fm.file_id)
         n += 1
     _count_cold("rehydrate", "sidecars_rebuilt", n)
-    return n
+    return n + healed
 
 
 def verify_cold_file(vnode, file_id: int) -> int:

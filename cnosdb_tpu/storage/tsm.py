@@ -45,6 +45,9 @@ MAGIC = 0x7C05DB01
 VERSION = 1
 FOOTER_SIZE = 64
 
+faults.register_point("tsm.write", __name__,
+                      desc="sealed TSM file finalize (corrupt-at-rest site)")
+
 # thread-local contexts (parallel flush/compaction writers + query-pool
 # readers; zstd contexts are not safe for concurrent use)
 _ZC = codecs._TlsZstd(1)

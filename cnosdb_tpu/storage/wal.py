@@ -28,6 +28,11 @@ from .record_file import RecordReader, RecordWriter
 SEGMENT_PATTERN = re.compile(r"^wal_(\d{10})\.log$")
 _ENTRY_HDR = struct.Struct("<QBQ")
 
+faults.register_point("wal.append", __name__,
+                      desc="WAL entry append (torn-tail site)")
+faults.register_point("wal.sync", __name__, desc="WAL fsync")
+faults.register_point("wal.roll", __name__, desc="WAL segment roll")
+
 
 class WalEntryType:
     WRITE = 1          # point write batch

@@ -34,6 +34,12 @@ class ObjectStoreError(CnosError):
     pass
 
 
+faults.register_point("objstore.get", __name__,
+                      desc="object download / ranged GET")
+faults.register_point("objstore.put", __name__,
+                      desc="object upload")
+
+
 # ---------------------------------------------------------------------------
 # URI handling
 # ---------------------------------------------------------------------------
@@ -179,7 +185,7 @@ def _http_status(method: str, url: str, headers: dict, body: bytes | None,
         try:
             hit = None
             if faults.ENABLED and fault_point:
-                hit = faults.fire(fault_point, method=method, url=url,
+                hit = faults.fire(fault_point, method=method, url=url,  # lint: disable=fault-site-coverage (point is the caller's literal; objstore.get/put registered above)
                                   **fault_ctx)
                 if hit is not None and hit[0] == "drop":
                     raise urllib.error.URLError("injected response drop")
@@ -242,7 +248,7 @@ class LocalStore:
             try:
                 hit = None
                 if faults.ENABLED:
-                    hit = faults.fire(fault_point, key=key, store="local")
+                    hit = faults.fire(fault_point, key=key, store="local")  # lint: disable=fault-site-coverage (point is the caller's literal; objstore.get/put registered above)
                 return fn(hit)
             except FileNotFoundError:
                 raise            # permanent: retrying cannot conjure the key

@@ -91,6 +91,12 @@ STAGE_CATALOG: dict[str, str] = {
                              "cold scans (vs. bytes the pages span)",
     "cold.pages_pruned": "cold pages eliminated locally by sidecar zone "
                          "maps/constraints — zero bytes downloaded",
+    "chaos.checks": "consistency-checker verdicts evaluated by the "
+                    "nemesis plane (chaos/checker.py)",
+    "chaos.crash_sites": "crash-point sweep runs executed — one per "
+                         "(fault point, nth crossing) pair",
+    "chaos.mttr_ms": "crash→first-successful-read recovery time measured "
+                     "by chaos workload verify",
 }
 
 # Prefixes for names composed at runtime (skipped by the literal lint

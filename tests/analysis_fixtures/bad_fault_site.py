@@ -1,0 +1,11 @@
+"""fault-site-coverage fixture: fire() sites outside the sweep registry."""
+from cnosdb_tpu import faults
+
+faults.register_point("demo.registered", __name__, desc="covered point")
+
+
+def crossing(path, point):
+    if faults.ENABLED:
+        faults.fire("demo.registered", path=path)      # registered: fine
+        faults.fire("demo.unregistered", path=path)    # never registered
+        faults.fire(point, path=path)                  # dynamic name

@@ -1,0 +1,245 @@
+"""Nemesis plane fast suite: history recorder semantics (torn tails,
+restart-continued indexes), every consistency check catching a
+deliberately violated synthetic history, seeded nemesis plan determinism,
+the FAULT_POINTS registry contract, and the /metrics export shape.
+
+Everything here is in-process and subprocess-free; the crash-point sweep
+itself lives in test_chaos_sweep.py and the cluster nemesis mixes in
+test_chaos_cluster.py.
+"""
+import json
+
+import pytest
+
+from cnosdb_tpu import chaos, faults
+from cnosdb_tpu.chaos import nemesis
+from cnosdb_tpu.chaos.checker import (
+    book, check_checksum_convergence, check_matview_parity,
+    check_monotonic_reads, check_no_lost_acked_writes,
+    check_no_resurrection, check_read_your_writes, run_client_checks)
+from cnosdb_tpu.chaos.history import History, HistoryRecorder
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    faults.reset()
+    chaos.counters_reset()
+    yield
+    faults.reset()
+    chaos.counters_reset()
+
+
+# ------------------------------------------------------------- recorder
+def test_recorder_roundtrip_and_join(tmp_path):
+    p = str(tmp_path / "h.jsonl")
+    r = HistoryRecorder(p)
+    e0 = r.invoke("s1", "write", keys=["a", "b"])
+    r.ok("s1", e0)
+    e1 = r.invoke("s1", "read", durable=False, mono=True)
+    r.ok("s1", e1, keys=["a", "b"])
+    e2 = r.invoke("s2", "write", keys=["c"])
+    r.fail("s2", e2, "boom")
+    e3 = r.invoke("s2", "write", keys=["d"])   # crash before outcome
+    r.close()
+    h = History.load(p)
+    assert [o.op for o in h.ops] == ["write", "read", "write", "write"]
+    w0, rd, w1, w2 = h.ops
+    assert w0.acked and rd.acked and rd.ok_data["keys"] == ["a", "b"]
+    assert w1.outcome == "fail" and w2.outcome is None
+    assert h.sessions() == ["s1", "s2"]
+    assert e3 > e2 > e1 > e0
+
+
+def test_recorder_continues_index_after_restart(tmp_path):
+    p = str(tmp_path / "h.jsonl")
+    r = HistoryRecorder(p)
+    r.invoke("s1", "write", keys=["a"])
+    r.close()
+    r2 = HistoryRecorder(p)           # a restarted client process
+    e = r2.invoke("s1", "write", keys=["b"])
+    r2.close()
+    assert e == 1
+    assert len(History.load(p).events) == 2
+
+
+def test_history_tolerates_torn_tail_only(tmp_path):
+    p = tmp_path / "h.jsonl"
+    good = json.dumps({"e": 0, "s": "s1", "t": "invoke", "op": "write",
+                       "keys": ["a"]})
+    p.write_bytes((good + "\n").encode() + b'{"e": 1, "s": "s1", "t"')
+    h = History.load(str(p))          # torn final line: dropped
+    assert len(h.events) == 1
+    p.write_bytes(b'{"torn\n' + (good + "\n").encode())
+    with pytest.raises(ValueError):   # garbage MID-file: corrupt, loud
+        History.load(str(p))
+
+
+# -------------------------------------------------------------- checker
+def _mk(events):
+    """Build a History from (session, type, op_or_of, data) tuples."""
+    out = []
+    for i, (s, t, x, data) in enumerate(events):
+        ev = {"e": i, "s": s, "t": t, **data}
+        if t == "invoke":
+            ev["op"] = x
+        else:
+            ev["of"] = x
+        out.append(ev)
+    return History(out)
+
+
+def test_lost_acked_write_detected():
+    h = _mk([("s1", "invoke", "write", {"keys": ["a", "b"]}),
+             ("s1", "ok", 0, {}),
+             ("s1", "invoke", "write", {"keys": ["c"]})])  # ambiguous
+    assert check_no_lost_acked_writes(h, {"a", "b"})
+    assert check_no_lost_acked_writes(h, {"a", "b", "c"})  # c allowed
+    r = check_no_lost_acked_writes(h, {"a"})
+    assert not r.ok and "b" in r.detail
+
+
+def test_lost_write_excused_by_delete():
+    h = _mk([("s1", "invoke", "write", {"keys": ["a"]}),
+             ("s1", "ok", 0, {}),
+             ("s2", "invoke", "delete", {"keys": ["a"]})])  # even unacked
+    assert check_no_lost_acked_writes(h, set())
+
+
+def test_resurrection_detected():
+    h = _mk([("s1", "invoke", "write", {"keys": ["a", "b"]}),
+             ("s1", "ok", 0, {}),
+             ("s1", "invoke", "delete", {"keys": ["a"]}),
+             ("s1", "ok", 2, {})])
+    assert check_no_resurrection(h, {"b"})
+    undead = check_no_resurrection(h, {"a", "b"})
+    assert not undead.ok and "a" in undead.detail
+    nowhere = check_no_resurrection(h, {"b", "ghost"})
+    assert not nowhere.ok and "ghost" in nowhere.detail
+
+
+def test_read_your_writes_detected():
+    h = _mk([("s1", "invoke", "write", {"keys": ["a"]}),
+             ("s1", "ok", 0, {}),
+             ("s1", "invoke", "read", {}),
+             ("s1", "ok", 2, {"keys": []}),          # missed own write
+             ("s2", "invoke", "read", {}),
+             ("s2", "ok", 4, {"keys": []})])         # s2 never wrote: fine
+    r = check_read_your_writes(h)
+    assert not r.ok and "s1" in r.detail
+    h2 = _mk([("s1", "invoke", "write", {"keys": ["a"]}),
+              ("s1", "ok", 0, {}),
+              ("s1", "invoke", "read", {}),
+              ("s1", "ok", 2, {"keys": ["a"]})])
+    assert check_read_your_writes(h2)
+
+
+def test_monotonic_reads_detected():
+    h = _mk([("s1", "invoke", "read", {"mono": True}),
+             ("s1", "ok", 0, {"keys": ["a", "b"]}),
+             ("s1", "invoke", "read", {"mono": True}),
+             ("s1", "ok", 2, {"keys": ["a"]})])      # b vanished
+    r = check_monotonic_reads(h)
+    assert not r.ok and "b" in r.detail
+    # a delete between the reads excuses the shrink
+    h2 = _mk([("s1", "invoke", "read", {"mono": True}),
+              ("s1", "ok", 0, {"keys": ["a", "b"]}),
+              ("s2", "invoke", "delete", {"keys": ["b"]}),
+              ("s2", "ok", 2, {}),
+              ("s1", "invoke", "read", {"mono": True}),
+              ("s1", "ok", 4, {"keys": ["a"]})])
+    assert check_monotonic_reads(h2)
+
+
+def test_matview_parity_and_checksum_convergence():
+    assert check_matview_parity([(1, "a")], [(1, "a")])
+    assert not check_matview_parity([(1, "a")], [(1, "b")]).ok
+    assert check_checksum_convergence(
+        {1: {"g1": "x"}, 2: {"g1": "x"}, 3: {}})
+    r = check_checksum_convergence({1: {"g1": "x"}, 2: {"g1": "y"}})
+    assert not r.ok and "g1" in r.detail
+
+
+def test_book_feeds_metrics_export():
+    from cnosdb_tpu.server.metrics import MetricsRegistry
+
+    h = _mk([("s1", "invoke", "write", {"keys": ["a"]}),
+             ("s1", "ok", 0, {})])
+    book(run_client_checks(h, set()))          # no_lost fails, rest pass
+    chaos.note_recovery("crash_restart", 1.25)
+    snap = chaos.chaos_snapshot()
+    assert snap[("no_lost_acked_writes", "fail")] == 1
+    assert snap[("no_resurrection", "pass")] == 1
+    m = MetricsRegistry()
+    for (check, verdict), n in snap.items():
+        m.set_counter("cnosdb_chaos_total", n, check=check, verdict=verdict)
+    for kind, secs in chaos.recovery_snapshot().items():
+        m.set_gauge("cnosdb_chaos_recovery_seconds", secs, kind=kind)
+    text = m.prometheus_text()
+    assert "# TYPE cnosdb_chaos_total counter" in text
+    assert ('cnosdb_chaos_total{check="no_lost_acked_writes",'
+            'verdict="fail"} 1') in text
+    assert ('cnosdb_chaos_recovery_seconds{kind="crash_restart"} 1.25'
+            in text)
+
+
+# -------------------------------------------------------------- nemesis
+def test_nemesis_plan_is_deterministic():
+    a = nemesis.generate_plan(42, n_nodes=3, steps=8)
+    b = nemesis.generate_plan(42, n_nodes=3, steps=8)
+    assert a == b                      # same seed ⇒ same plan, exactly
+    assert nemesis.generate_plan(43, n_nodes=3, steps=8) != a
+    assert all(0 <= e.node < 3 and e.kind in nemesis.KINDS for e in a)
+    assert "seed=42" in nemesis.describe(a, 42)
+
+
+def test_nemesis_specs_render_and_parse():
+    for ev in nemesis.generate_plan(7, n_nodes=3, steps=12):
+        victim, others = nemesis.event_specs(ev, "127.0.0.1:9402", seed=7)
+        for spec in (victim, others, nemesis.heal_spec(7, ev)):
+            faults.configure(spec)     # must parse under the real grammar
+    faults.reset()
+
+
+def test_fired_sequence_reproduces_for_same_seed_and_spec():
+    spec = "seed=11;rpc.send:noop:prob=0.4;wal.append:noop:nth=3"
+    logs = []
+    for _ in range(2):
+        faults.configure(spec)
+        for i in range(20):
+            faults.fire("rpc.send", addr="127.0.0.1:1", method="m")
+            faults.fire("wal.append", dir="d", seq=i)
+        logs.append(faults.fired_log())
+    assert logs[0] == logs[1] and logs[0]   # same seed+spec ⇒ same firing
+    faults.configure(spec.replace("seed=11", "seed=12"))
+    for i in range(20):
+        faults.fire("rpc.send", addr="127.0.0.1:1", method="m")
+    assert [t for t in faults.fired_log() if t[0] == "rpc.send"] != \
+        [t for t in logs[0] if t[0] == "rpc.send"]
+
+
+# ------------------------------------------------------------- registry
+def test_fault_point_registry_covers_every_site():
+    from cnosdb_tpu.chaos import sweep
+
+    node = set(sweep.node_points())
+    assert node == {"record.append", "record.sync", "wal.append",
+                    "wal.sync", "wal.roll", "flush.run", "compaction.run",
+                    "tsm.write", "scrub.read", "objstore.get",
+                    "objstore.put", "matview.persist", "tiering.registry"}
+    cluster = set(faults.registered_points(scope="cluster"))
+    assert cluster == {"rpc.send", "rpc.response", "rpc.server",
+                       "rpc.reply", "meta.propose", "meta.apply"}
+    for p in faults.registered_points().values():
+        assert p.module and p.desc, f"{p.name} must carry module + desc"
+
+
+def test_faults_control_lists_points():
+    out = faults.control({"points": True})
+    names = [row[0] for row in out["points"]]
+    assert names == sorted(names) and "tiering.registry" in names
+
+
+def test_noop_action_fires_but_injects_nothing(tmp_path):
+    faults.configure("seed=1;wal.append:noop")
+    assert faults.fire("wal.append", dir="d") is None
+    assert faults.fired_log() == [("wal.append", "noop", 1)]

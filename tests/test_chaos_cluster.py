@@ -250,3 +250,194 @@ def test_bitflip_quarantine_failover_and_anti_entropy_repair(cluster):
         assert _wait_count(n, "ig", "dintg", 40, timeout=30.0) == 40
     rep2 = json.loads(n1.http("GET", "/debug/scrub?repair=1"))["repair"]
     assert rep2["failed"] == []
+
+
+# ---------------------------------------------------------------------------
+# nemesis plane (PR 13): history-checked invariants under seeded schedules
+# ---------------------------------------------------------------------------
+NEM_BASE = 1_700_000_000_000_000_000
+
+
+def _keys_on(node, table, db) -> set[str]:
+    rows = _csv_rows(node.sql(f"SELECT DISTINCT k FROM {table}", db=db))
+    return {r[0] for r in rows}
+
+
+def _wait_keys(node, table, db, expect: set[str], timeout=60.0) -> set[str]:
+    deadline = time.monotonic() + timeout
+    got: set[str] = set()
+    while time.monotonic() < deadline:
+        try:
+            got = _keys_on(node, table, db)
+            if got == expect:
+                return got
+        except Exception:
+            pass
+        time.sleep(0.3)
+    return got
+
+
+class _Client:
+    """History-recorded client: every write/read/delete lands in the
+    recorder as invoke → ok/fail, so the checker can audit the run."""
+
+    def __init__(self, rec, table: str, db: str):
+        self.rec, self.table, self.db = rec, table, db
+        self.n = 0
+
+    def write(self, node, session: str, k: int) -> list[str]:
+        keys = [f"k{self.n + i}" for i in range(k)]
+        lines = "\n".join(
+            f"{self.table},k={key} v=1 {NEM_BASE + (self.n + i) * 1_000}"
+            for i, key in enumerate(keys))
+        e = self.rec.invoke(session, "write", keys=keys)
+        try:
+            node.write_lp(lines, db=self.db)    # raising == not acked
+        except Exception as ex:
+            self.rec.fail(session, e, str(ex)[:200])
+            return []
+        self.rec.ok(session, e)
+        self.n += k
+        return keys
+
+    def read(self, node, session: str) -> set[str] | None:
+        e = self.rec.invoke(session, "read", durable=False, mono=True)
+        try:
+            keys = _keys_on(node, self.table, self.db)
+        except Exception as ex:
+            self.rec.fail(session, e, str(ex)[:200])
+            return None
+        self.rec.ok(session, e, keys=sorted(keys))
+        return keys
+
+    def delete_before(self, node, session: str, upto: int) -> list[str]:
+        keys = [f"k{i}" for i in range(min(upto, self.n))]
+        e = self.rec.invoke(session, "delete", keys=keys)
+        try:
+            node.sql(f"DELETE FROM {self.table} WHERE time < "
+                     f"{NEM_BASE + upto * 1_000}", db=self.db)
+        except Exception as ex:
+            self.rec.fail(session, e, str(ex)[:200])
+            return keys     # even an unacked delete may have applied
+        self.rec.ok(session, e)
+        return keys
+
+
+def _assert_checks(history, observed: set[str], context: str):
+    from cnosdb_tpu.chaos.checker import run_client_checks
+
+    results = run_client_checks(history, observed)
+    bad = [r for r in results if not r.ok]
+    assert not bad, context + "\n" + "\n".join(
+        f"{r.name}: {r.detail}" for r in bad)
+
+
+def test_rolling_restart_no_lost_acked_writes(cluster, tmp_path):
+    """Restart every node in turn while a recorded client keeps writing
+    through the survivors: zero acknowledged writes may be lost, and the
+    write path's unavailability window stays bounded (REPLICA 3 keeps a
+    quorum up throughout)."""
+    from cnosdb_tpu.chaos.history import History, HistoryRecorder
+
+    n1 = cluster.nodes[0]
+    n1.sql("CREATE DATABASE droll WITH SHARD 1 REPLICA 3", db="public")
+    rec = HistoryRecorder(str(tmp_path / "roll.jsonl"))
+    cl = _Client(rec, "rr", "droll")
+
+    acked: set[str] = set()
+    acked.update(cl.write(n1, "w", 20))
+    assert _wait_keys(n1, "rr", "droll", acked) == acked
+
+    worst_gap = 0.0
+    for victim in cluster.nodes:
+        victim.kill()
+        survivor = cluster.alive_node()
+        # the write path may blip while leadership moves off the killed
+        # node; time the outage from the first failed ack to the next
+        # successful one
+        gap_start = None
+        deadline = time.monotonic() + 60.0
+        while time.monotonic() < deadline:
+            got = cl.write(survivor, "w", 5)
+            if got:
+                acked.update(got)
+                break
+            gap_start = gap_start or time.monotonic()
+            time.sleep(0.5)
+        if gap_start is not None:
+            worst_gap = max(worst_gap, time.monotonic() - gap_start)
+        got = cl.write(survivor, "w", 5)
+        assert got, "write path did not recover while one node was down"
+        acked.update(got)
+        victim.start().wait_ready(timeout=90.0)
+        assert _wait_keys(victim, "rr", "droll", acked, timeout=90.0) \
+            == acked, f"node {victim.node_id} lost acked writes on restart"
+    rec.close()
+
+    assert worst_gap < 30.0, \
+        f"write unavailability window {worst_gap:.1f}s exceeds bound"
+    h = History.load(str(tmp_path / "roll.jsonl"))
+    for n in cluster.nodes:
+        _assert_checks(h, _wait_keys(n, "rr", "droll", acked),
+                       f"rolling restart, node {n.node_id}")
+
+
+def test_nemesis_mix_preserves_client_invariants(cluster, tmp_path):
+    """A seeded nemesis schedule mixing partitions and crash-restarts over
+    the 3-node cluster, with every client op recorded: afterwards the full
+    history must satisfy no-lost-acked-write, no-resurrection and
+    per-session monotonic reads on every node's final state. The printed
+    seed reproduces the exact schedule."""
+    from cnosdb_tpu.chaos import nemesis
+    from cnosdb_tpu.chaos.history import History, HistoryRecorder
+
+    seed = 5
+    plan = nemesis.generate_plan(seed, n_nodes=3, steps=4,
+                                 kinds=("partition", "crash_restart"))
+    ctx = nemesis.describe(plan, seed)
+    n1 = cluster.nodes[0]
+    n1.sql("CREATE DATABASE dnem WITH SHARD 1 REPLICA 3", db="public")
+    rec = HistoryRecorder(str(tmp_path / "nem.jsonl"))
+    cl = _Client(rec, "nm", "dnem")
+
+    acked: set[str] = set()
+    deleted: set[str] = set()
+    acked.update(cl.write(n1, "w", 20))
+    assert _wait_keys(n1, "nm", "dnem", acked) == acked
+
+    for ev in plan:
+        victim = cluster.nodes[ev.node]
+        healthy = [n for n in cluster.nodes if n is not victim]
+        if ev.kind == "partition":
+            vspec, ospec = nemesis.event_specs(
+                ev, f"127.0.0.1:{victim.rpc_port}", seed)
+            _set_faults(victim, vspec)
+            for n in healthy:
+                _set_faults(n, ospec)
+            try:
+                acked.update(cl.write(healthy[0], "w", 10))
+                cl.read(healthy[1], f"r{healthy[1].node_id}")
+            finally:
+                for n in cluster.nodes:
+                    _set_faults(n, nemesis.heal_spec(seed, ev))
+        else:                              # crash_restart: a power loss
+            victim.kill()
+            survivor = cluster.alive_node()
+            acked.update(cl.write(survivor, "w", 10))
+            cl.read(survivor, f"r{survivor.node_id}")
+            victim.start().wait_ready(timeout=90.0)
+        live = acked - deleted
+        for n in cluster.nodes:
+            assert _wait_keys(n, "nm", "dnem", live, timeout=90.0) == live, \
+                f"{ctx}\nstep #{ev.step} ({ev.kind}@n{ev.node}): " \
+                f"node {n.node_id} diverged"
+            cl.read(n, f"r{n.node_id}")
+        if ev.step == 1:   # mid-schedule delete arms the resurrection check
+            deleted.update(cl.delete_before(cluster.alive_node(), "w", 10))
+    rec.close()
+
+    h = History.load(str(tmp_path / "nem.jsonl"))
+    live = acked - deleted
+    for n in cluster.nodes:
+        final = _wait_keys(n, "nm", "dnem", live, timeout=90.0)
+        _assert_checks(h, final, f"{ctx}\nfinal state on node {n.node_id}")
