@@ -965,6 +965,134 @@ def run_coldscan(executor, coord, tenant, db, session) -> dict:
     return out
 
 
+def run_pointqps(executor, coord, tenant, db, session) -> dict:
+    """High-QPS serving-plane benchmark: a closed loop of threads
+    re-issuing point-query shapes against a hosts×rows table. Warm
+    requests should land in the ScanToken-keyed result cache (target:
+    ≥10k qps, p99 < 20 ms, hit ratio ≥ 0.9); a second phase issues
+    unique-literal variants under forced micro-batching so the fused
+    path and its width histogram get exercised too. Counters are read
+    as deltas — the serving counters are process-global."""
+    import threading as _threading
+
+    from cnosdb_tpu.models.points import SeriesRows, WriteBatch
+    from cnosdb_tpu.models.schema import ValueType
+    from cnosdb_tpu.models.series import SeriesKey
+    from cnosdb_tpu.server import serving as _serving
+
+    sv = getattr(executor, "serving", None)
+    if sv is None:
+        return {"disabled": True}       # CNOSDB_SERVING=0 A/B runs
+    rng = np.random.default_rng(47)
+    n_hosts = 64
+    per = 64
+    executor.execute_one(
+        "CREATE TABLE IF NOT EXISTS pq (value DOUBLE, TAGS(host))",
+        session)
+    for h in range(n_hosts):
+        ts = BASE_TS + np.arange(per, dtype=np.int64) * 1_000_000_000
+        wb = WriteBatch()
+        wb.add_series("pq", SeriesRows(
+            SeriesKey("pq", {"host": f"host_{h}"}), ts,
+            {"value": (int(ValueType.FLOAT), rng.normal(50, 10, per))}))
+        coord.write_points(tenant, db, wb)
+    coord.engine.flush_all()
+
+    qs = [f"SELECT time, value FROM pq WHERE host = 'host_{h}'"
+          for h in range(n_hosts)]
+    for q in qs:                        # warm plan + result caches
+        rs = executor.execute_one(q, session)
+        assert rs.n_rows == per, f"point query returned {rs.n_rows}"
+
+    threads = 4
+    per_thread = 5000
+    orders = [rng.integers(0, n_hosts, per_thread) for _ in range(threads)]
+    lat: list[list[float]] = [[] for _ in range(threads)]
+    gate = _threading.Barrier(threads + 1)
+    c0 = _serving.counters_snapshot()
+
+    def worker(i):
+        mine = lat[i]
+        gate.wait()
+        for j in orders[i]:
+            t0 = time.perf_counter()
+            executor.execute_one(qs[j], session)
+            mine.append(time.perf_counter() - t0)
+
+    ths = [_threading.Thread(target=worker, args=(i,))
+           for i in range(threads)]
+    for t in ths:
+        t.start()
+    gate.wait()
+    t0 = time.perf_counter()
+    for t in ths:
+        t.join()
+    elapsed = time.perf_counter() - t0
+
+    c1 = _serving.counters_snapshot()
+
+    def delta(layer, outcome):
+        return (c1.get((layer, outcome), 0) - c0.get((layer, outcome), 0))
+
+    hits, misses = delta("result_cache", "hit"), delta("result_cache",
+                                                       "miss")
+    all_lat = np.sort(np.concatenate([np.asarray(x) for x in lat]))
+    total = int(len(all_lat))
+    out = {
+        "threads": threads,
+        "requests": total,
+        "point_qps": round(total / max(elapsed, 1e-9), 1),
+        "p50_ms": round(float(np.percentile(all_lat, 50)) * 1e3, 3),
+        "p99_ms": round(float(np.percentile(all_lat, 99)) * 1e3, 3),
+        "hit_ratio": round(hits / max(hits + misses, 1), 4),
+        "plan_rebinds": delta("plan_cache", "hit_rebind"),
+    }
+
+    # ---- fused micro-batching phase: unique literals defeat the result
+    # cache so every request reaches the batch rendezvous
+    w0 = _serving.width_histogram()
+    prev_force, prev_win = sv.batcher.force, sv.batcher.window_s
+    sv.batcher.force = True
+    sv.batcher.window_s = 0.002
+    fthreads, fper = 8, 40
+    fgate = _threading.Barrier(fthreads + 1)
+    ferr: list = []
+
+    def fworker(i):
+        fgate.wait()
+        for k in range(fper):
+            u = i * fper + k
+            try:
+                executor.execute_one(
+                    f"SELECT time, value FROM pq WHERE "
+                    f"host = 'host_{u % n_hosts}' AND value > -{u}.0",
+                    session)
+            except Exception as e:      # surfaced in the report
+                ferr.append(repr(e)[:120])
+                return
+    fths = [_threading.Thread(target=fworker, args=(i,))
+            for i in range(fthreads)]
+    for t in fths:
+        t.start()
+    fgate.wait()
+    ft0 = time.perf_counter()
+    for t in fths:
+        t.join()
+    felapsed = time.perf_counter() - ft0
+    sv.batcher.force, sv.batcher.window_s = prev_force, prev_win
+    w1 = _serving.width_histogram()
+    c2 = _serving.counters_snapshot()
+    out["fused_widths"] = {str(k): w1.get(k, 0) - w0.get(k, 0)
+                           for k in sorted(w1)
+                           if w1.get(k, 0) - w0.get(k, 0)}
+    out["fused_queries"] = (c2.get(("batch", "fused"), 0)
+                            - c1.get(("batch", "fused"), 0))
+    out["fused_qps"] = round(fthreads * fper / max(felapsed, 1e-9), 1)
+    if ferr:
+        out["fused_errors"] = ferr[:5]
+    return out
+
+
 def run_suites(executor, coord, tenant, db, session) -> dict:
     out: dict = {}
     t0 = time.perf_counter()
@@ -1003,4 +1131,9 @@ def run_suites(executor, coord, tenant, db, session) -> dict:
                                        session)
     except Exception as e:   # cold-tier failure must not sink the run
         out["coldscan"] = {"error": repr(e)[:200]}
+    try:
+        out["pointqps"] = run_pointqps(executor, coord, tenant, db,
+                                       session)
+    except Exception as e:   # serving-plane failure must not sink the run
+        out["pointqps"] = {"error": repr(e)[:200]}
     return out

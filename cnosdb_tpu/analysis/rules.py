@@ -811,7 +811,77 @@ class ColdTierAccounting(Rule):
 
 
 # --------------------------------------------------------------------------
-# 14. fault-site-coverage — new (PR 13): every fire() site must be in the
+# 14. serving-accounting — new (PR 15): no silent serving-plane exits
+# --------------------------------------------------------------------------
+_SVA_FUNCS = {
+    "cnosdb_tpu/server/serving.py": ("try_execute", "submit"),
+}
+_SVA_ACCOUNTING = {"_count_serving", "count", "count_error"}
+
+
+def _sva_has_accounting(node: ast.AST) -> bool:
+    for n in ast.walk(node):
+        if isinstance(n, ast.Call) and _call_name(n) in _SVA_ACCOUNTING:
+            return True
+    return False
+
+
+class ServingAccounting(Rule):
+    name = "serving-accounting"
+    motivation = ("PR 15 serving plane: every exit out of the cache/fuse "
+                  "entry points must book a (layer, outcome) into "
+                  "cnosdb_serving_total — an unaccounted early return "
+                  "makes hit-ratio and batching telemetry lie, hiding "
+                  "exactly the regressions (silent bypasses, declined "
+                  "fusions) the serving-plane SLO depends on seeing")
+
+    def applies_to(self, relpath):
+        return relpath in _SVA_FUNCS
+
+    def begin_module(self, ctx):
+        want = _SVA_FUNCS.get(ctx.relpath)
+        guarded = want is not None
+        if want is None:
+            # scope-ignored run (fixtures/self-tests): lint any function
+            # bearing a guarded name, but skip the presence check
+            want = tuple({n for names in _SVA_FUNCS.values()
+                          for n in names})
+        found = set()
+        for fn in ast.walk(ctx.tree):
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    or fn.name not in want:
+                continue
+            found.add(fn.name)
+            terminal = fn.body[-1]
+            for block in _dda_blocks(fn):
+                for i, stmt in enumerate(block):
+                    if not isinstance(stmt, (ast.Return, ast.Raise)) \
+                            or stmt is terminal:
+                        continue
+                    prev = block[i - 1] if i else None
+                    if _sva_has_accounting(stmt) \
+                            or (prev is not None
+                                and _sva_has_accounting(prev)):
+                        continue
+                    kind = "return" if isinstance(stmt, ast.Return) \
+                        else "raise"
+                    ctx.report(self, stmt,
+                               f"unaccounted early {kind} in {fn.name} — "
+                               f"serving-plane exits must book a (layer, "
+                               f"outcome) (_count_serving/stages.count) "
+                               f"so cache bypasses and declined fusions "
+                               f"stay visible on /metrics")
+        for name in want if guarded else ():
+            if name not in found:
+                ctx.report(self, 1,
+                           f"serving guarded function {name} not "
+                           f"found — if it was renamed, update "
+                           f"analysis/rules.py so the lint keeps "
+                           f"covering it")
+
+
+# --------------------------------------------------------------------------
+# 15. fault-site-coverage — new (PR 13): every fire() site must be in the
 #     FAULT_POINTS registry the crash sweep enumerates
 # --------------------------------------------------------------------------
 _FSC_RECEIVERS = {"faults", "_faults"}
@@ -866,4 +936,5 @@ def all_rules() -> list:
             LockBlocking(), SwallowedException(), JaxPurity(),
             WallclockDuration(), MetricsNaming(), StageCatalog(),
             DeviceDecodeAccounting(), StringFilterAccounting(),
-            ColdTierAccounting(), FaultSiteCoverage(), *project_rules()]
+            ColdTierAccounting(), ServingAccounting(), FaultSiteCoverage(),
+            *project_rules()]

@@ -1213,6 +1213,37 @@ def is_null_columns(e) -> set:
     return out
 
 
+def stacked_filter_masks(env: dict, filters: list, n_rows: int,
+                         field_cols: set) -> np.ndarray:
+    """Fused micro-batch filter stage: evaluate M member filters over ONE
+    shared scan environment → an ``(M, n_rows)`` bool stack, one row mask
+    per member. This is the demux half of batching — the scan (decode,
+    upload, device dispatch) was paid once for the whole group; each
+    member's mask applies the SAME 3VL conjunctive validity semantics as
+    the solo path in `QueryExecutor._exec_raw_batches`, so fused results
+    are bit-identical to solo. A ``None`` filter means "all rows"."""
+    masks = np.empty((len(filters), n_rows), dtype=bool)
+    for i, f in enumerate(filters):
+        if f is None:
+            masks[i] = True
+            continue
+        # full copy (np.array, not asarray): the eval result may BE a
+        # shared-env column (filter `bool_field`), and the in-place
+        # validity AND below must never write through to the env that
+        # every other member reads
+        m = np.array(f.eval(env, np), dtype=bool)
+        if m.shape == ():
+            m = np.full(n_rows, bool(m))
+        if is_conjunctive(f):
+            skip = is_null_columns(f)
+            for c in f.columns() - skip:
+                vk = f"__valid__:{c}"
+                if c in field_cols and vk in env:
+                    m &= env[vk]
+        masks[i] = m
+    return masks
+
+
 def _ordered_within_series(batch: ScanBatch) -> bool:
     """True when (a) timestamps are non-decreasing within every series run
     AND (b) each series occupies exactly one contiguous run — the storage

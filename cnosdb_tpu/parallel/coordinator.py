@@ -686,7 +686,8 @@ class Coordinator:
                    time_ranges: TimeRanges | None = None,
                    tag_domains: ColumnDomains | None = None,
                    field_names: list[str] | None = None,
-                   page_filter=None) -> list[ScanBatch]:
+                   page_filter=None,
+                   fingerprint: str | None = None) -> list[ScanBatch]:
         """Fan a scan out over placed vnodes → one ScanBatch per vnode.
 
         `page_filter` (optional sql.expr tree) lets the storage scan prune
@@ -732,7 +733,8 @@ class Coordinator:
 
         def one(split):
             if self.distributed and split.node_id != self.node_id:
-                return self._scan_remote(split, field_names)
+                return self._scan_remote(split, field_names,
+                                         fingerprint=fingerprint)
             try:
                 return self._scan_local(split, field_names, page_constraints,
                                         filter_key, n_threads)
@@ -937,6 +939,58 @@ class Coordinator:
         with self._scan_cache_lock:
             return len(self._scan_cache), self._scan_cache_bytes
 
+    def table_tokens(self, tenant: str, db: str, table: str):
+        """Serving-plane invalidation key: the table's schema version plus
+        one ScanToken tuple per covering vnode, each captured under that
+        vnode's lock. Equality of two captures proves no flush / delete /
+        compaction / tier / DDL event touched the table's DATABASE in
+        between (vnodes are shared per-database, so a write to a sibling
+        table conservatively misses — never serves stale). Walks
+        `meta.buckets_for` directly instead of `table_vnodes` to skip the
+        per-split tier peek — this runs on every result-cache probe.
+
+        → None when the table is dropped, a covering vnode is replicated
+        (the scan may read a replica this capture didn't token), or a
+        remote owner can't answer — callers must bypass caching then."""
+        schema = self.meta.table_opt(tenant, db, table)
+        if schema is None:
+            return None
+        owner = f"{tenant}.{db}"
+        toks: dict = {"schema": getattr(schema, "schema_version", None)}
+        seen = set()
+        for bucket in self.meta.buckets_for(tenant, db, None, None):
+            for rs in bucket.shard_group:
+                if len(rs.vnodes) > 1:
+                    return None
+                vnode_id = rs.leader_vnode_id
+                if vnode_id in seen:
+                    continue
+                seen.add(vnode_id)
+                v = self.engine.vnode(owner, vnode_id)
+                if v is not None:
+                    t = v.scan_token()
+                    toks[vnode_id] = (t.data_version,
+                                      t.destructive_version,
+                                      t.file_ids, t.mem_seq)
+                    continue
+                if not self.distributed:
+                    return None
+                info = rs.vnode(vnode_id)
+                if info is None:
+                    return None
+                try:
+                    r = self._rpc(info.node_id, "vnode_token",
+                                  {"owner": owner, "vnode_id": vnode_id})
+                except Exception:
+                    return None
+                t = r.get("token") if isinstance(r, dict) else None
+                if t is None:
+                    return None
+                toks[vnode_id] = (t["data_version"],
+                                  t["destructive_version"],
+                                  frozenset(t["file_ids"]), t["mem_seq"])
+        return toks
+
     def _upload_hook(self):
         """Eager-upload factory for the scan pipeline — only when queries
         will actually take the device path; on pure-CPU placements the
@@ -967,10 +1021,13 @@ class Coordinator:
             pass
         return None
 
-    def _scan_remote(self, split: PlacedSplit, field_names) -> ScanBatch | None:
+    def _scan_remote(self, split: PlacedSplit, field_names,
+                     fingerprint: str | None = None) -> ScanBatch | None:
         """Scan one split on its owning node, failing over to replica
         alternates (reference opener.rs:84-120 remote open +
-        reader/mod.rs:36 broken-replica failover)."""
+        reader/mod.rs:36 broken-replica failover). `fingerprint` tags the
+        RPC with the serving-plane query identity so the owning node's
+        scan cache + stage counters attribute the work cluster-wide."""
         from .ipc import decode_scan_batch
         from .net import RpcError, RpcUnavailable
 
@@ -996,6 +1053,7 @@ class Coordinator:
                     "trs": split.time_ranges.to_wire(),
                     "doms": split.tag_domains.to_wire(),
                     "field_names": field_names,
+                    "fp": fingerprint,
                 })
             except RpcUnavailable as e:
                 # connection-level failure only: an app-level RpcError
@@ -1319,6 +1377,12 @@ class Coordinator:
         else:
             self._rpc(v.node_id, "vnode_compact",
                       {"owner": owner, "vnode_id": vnode_id})
+        try:
+            from ..server import serving
+
+            serving.invalidate_owner(owner)
+        except Exception:
+            stages.count_error("serving.invalidate")
 
     def checksum_group(self, rs_id: int) -> list[tuple[int, int, str]]:
         """Per-replica content checksums for one replica set (reference

@@ -45,6 +45,7 @@ class DataNodeService:
             "vnode_install": self._vnode_install,
             "vnode_drop": self._vnode_drop,
             "vnode_compact": self._vnode_compact,
+            "vnode_token": self._vnode_token,
             "vnode_checksum": self._vnode_checksum,
             "matview_partials": self._matview_partials,
             "replica_change_membership": self._replica_change_membership,
@@ -104,6 +105,12 @@ class DataNodeService:
         return {"ok": True, "index": idx}
 
     def _scan_vnode(self, p):
+        if p.get("fp"):
+            # serving-plane-tagged scan: lets cluster-wide dashboards
+            # attribute remote work to the originating query family
+            from ..utils import stages
+
+            stages.count("serving.remote_fp")
         split = PlacedSplit(
             p["owner"], p["vnode_id"], p["table"],
             TimeRanges.from_wire(p["trs"]),
@@ -112,6 +119,19 @@ class DataNodeService:
         if b is None:
             return {"ipc": None}
         return {"ipc": encode_scan_batch(b)}
+
+    def _vnode_token(self, p):
+        """Serving-plane result-cache validation: the LOCAL vnode's
+        ScanToken, so a coordinating node can key / revalidate cached
+        results whose data lives here."""
+        v = self.coord.engine.vnode(p["owner"], p["vnode_id"])
+        if v is None:
+            return {"token": None}
+        t = v.scan_token()
+        return {"token": {"data_version": t.data_version,
+                          "destructive_version": t.destructive_version,
+                          "file_ids": sorted(t.file_ids),
+                          "mem_seq": t.mem_seq}}
 
     def _cancel_scan(self, p):
         """Best-effort cancellation fan-in (reference kill_query over the

@@ -92,6 +92,13 @@ class AdmissionGate:
             self._running -= 1
             self._cond.notify()
 
+    def pressure(self) -> tuple[int, int]:
+        """Dirty-read ``(running, queued)`` for the serving-plane micro-
+        batcher's fuse-or-solo decision. Deliberately lock-free: it runs
+        on every admitted point query, and a momentarily torn pair only
+        mis-sizes one batching window — never correctness."""
+        return self._running, self._queued
+
     def stats(self) -> dict:
         with self._cond:
             n_adm = self.admitted_total

@@ -60,6 +60,11 @@ class HttpServer:
             int(getattr(qc, "slow_query_threshold_ms", 0) or 0)
         self.gate = AdmissionGate(qc.max_concurrent_queries,
                                   qc.max_queued_queries)
+        # the serving plane's micro-batcher keys its fuse-or-solo decision
+        # off this gate's pressure (queued > 0 / running at the cap)
+        sv = getattr(executor, "serving", None)
+        if sv is not None:
+            sv.attach_gate(self.gate)
         from ..parallel.limiter import TenantLimiters
 
         self.limiters = TenantLimiters(meta)
@@ -918,6 +923,23 @@ class HttpServer:
                                    bc["bytes"])
             self.metrics.set_gauge("cnosdb_cold_block_cache_entries",
                                    bc["entries"])
+        # serving plane: per-(layer, outcome) cache/batch counters plus
+        # live cache sizes — only when the plane is resident
+        # (CNOSDB_SERVING=0 never imports it)
+        _sv = _sys.modules.get("cnosdb_tpu.server.serving")
+        if _sv is not None:
+            for (layer, outcome), n in _sv.counters_snapshot().items():
+                self.metrics.set_counter("cnosdb_serving_total", n,
+                                         layer=layer, outcome=outcome)
+            for cache, (entries, nbytes) in _sv.cache_stats().items():
+                self.metrics.set_gauge(f"cnosdb_serving_{cache}_entries",
+                                       entries)
+                if cache == "result_cache":
+                    self.metrics.set_gauge(
+                        f"cnosdb_serving_{cache}_bytes", nbytes)
+            for width, n in _sv.width_histogram().items():
+                self.metrics.set_counter("cnosdb_serving_batch_width_total",
+                                         n, width=str(width))
         # nemesis plane: checker verdicts + recovery timings — resident
         # only when a chaos suite has run in this process
         _ch = _sys.modules.get("cnosdb_tpu.chaos")

@@ -165,6 +165,13 @@ class QueryExecutor:
         # correctness knob)
         self.matview_rewrite_enabled = \
             os.environ.get("CNOSDB_MATVIEW_REWRITE", "1") != "0"
+        # serving plane (plan cache / result cache / fused batching);
+        # CNOSDB_SERVING=0 restores byte-identical legacy behavior
+        self.serving = None
+        if os.environ.get("CNOSDB_SERVING", "1") != "0":
+            from ..server.serving import ServingPlane
+
+            self.serving = ServingPlane(self)
 
     # ------------------------------------------------------------------ api
     def execute_sql(self, sql: str, session: Session | None = None) -> list[ResultSet]:
@@ -204,6 +211,11 @@ class QueryExecutor:
         try:
             with (stages.profile_scope(prof) if own_prof
                   else nullcontext()):
+                if self.serving is not None:
+                    out = self.serving.try_execute(sql, session)
+                    if out is not None:
+                        self._record_query_usage(sql, session)
+                        return out
                 out = []
                 for s in parse_sql(sql):
                     self.tracker.check_cancelled(qid)
@@ -279,6 +291,20 @@ class QueryExecutor:
         if qid is not None:
             self.tracker.check_cancelled(qid)
 
+    def _serving_invalidate(self, tenant: str, db: str,
+                            table: str | None = None) -> None:
+        """Push serving-plane eviction after a destructive mutation
+        (DELETE / DROP / ALTER). Hygiene only — result-cache probes
+        revalidate ScanTokens, so losing this push (fault point
+        serving.invalidate, or a crash right here) can never cause a
+        stale read; it just leaves dead entries for LRU to age out."""
+        try:
+            from ..server import serving
+
+            serving.invalidate(tenant, db, table)
+        except Exception:
+            stages.count_error("serving.invalidate")
+
     def execute_one(self, sql: str, session: Session | None = None) -> ResultSet:
         rs = self.execute_sql(sql, session)
         return rs[-1] if rs else ResultSet.empty()
@@ -298,6 +324,7 @@ class QueryExecutor:
         if isinstance(stmt, ast.DropDatabase):
             self.coord.drop_database(session.tenant, stmt.name,
                                      if_exists=stmt.if_exists)
+            self._serving_invalidate(session.tenant, stmt.name)
             return ResultSet.message("ok")
         if isinstance(stmt, ast.CreateTable):
             return self._create_table(stmt, session)
@@ -334,6 +361,7 @@ class QueryExecutor:
                     return ResultSet.message("ok")
             self.meta.drop_table(session.tenant, db, stmt.name,
                                  if_exists=stmt.if_exists)
+            self._serving_invalidate(session.tenant, db, stmt.name)
             return ResultSet.message("ok")
         if isinstance(stmt, ast.AlterTable):
             return self._alter_table(stmt, session)
@@ -800,6 +828,7 @@ class QueryExecutor:
                 for v in self.coord.engine.local_vnodes(owner):
                     v.drop_mem_field(name, stmt.drop_name)
         self.meta.update_table(schema)
+        self._serving_invalidate(session.tenant, db, name)
         return ResultSet.message("ok")
 
     # ------------------------------------------------------------------ SHOW
@@ -1213,6 +1242,9 @@ class QueryExecutor:
         self.coord.delete_from_table(session.tenant,
                                      stmt.database or session.database,
                                      stmt.table, tag_domains, lo, hi)
+        self._serving_invalidate(session.tenant,
+                                 stmt.database or session.database,
+                                 stmt.table)
         return ResultSet.message("ok")
 
     def _update(self, stmt: ast.UpdateStmt, session: Session):
@@ -1424,6 +1456,12 @@ class QueryExecutor:
     def _select(self, stmt: ast.SelectStmt, session: Session):
         from .analyzer import analyze
 
+        # consume-once serving-plane handoff: non-None only for the OUTER
+        # statement of a serving-instrumented request — subquery
+        # resolution re-enters _select and must stay invisible to the
+        # plan/result caches
+        sv_state = self.serving.claim() if self.serving is not None \
+            else None
         stmt = self._fold_session_scalars(stmt, session)
         stmt = analyze(self._resolve_subqueries(stmt, session))
         if stmt.from_item is not None or self._needs_relational(stmt):
@@ -1513,6 +1551,9 @@ class QueryExecutor:
         schema = self.meta.table(session.tenant, db, table)
         try:
             plan = plan_select(stmt, schema)
+            if sv_state is not None:
+                self.serving.observe_plan(sv_state, stmt, plan, session,
+                                          db, table, schema)
             if isinstance(plan, AggregatePlan):
                 return self._exec_aggregate(plan, session.tenant, db)
             return self._exec_raw(plan, session.tenant, db)
@@ -3006,6 +3047,10 @@ class QueryExecutor:
 
     # ---------------------------------------------------------- aggregates
     def _exec_aggregate(self, plan: AggregatePlan, tenant: str, db: str):
+        if self.serving is not None:
+            # aggregates never fuse (segment kernels own their whole
+            # batch); book the decline so batch telemetry stays honest
+            self.serving.batcher.decline("aggregate")
         phys_aggs, finalize = _decompose_aggs(plan.aggs)
         second_cols = set()
         for a in phys_aggs:
@@ -3257,26 +3302,54 @@ class QueryExecutor:
         field_names = sorted(needed & set(plan.schema.field_names()))
         if not field_names:
             field_names = plan.schema.field_names()
+        sv = self.serving
+        if sv is not None:
+            # fused micro-batching rendezvous: compatible concurrent
+            # point queries share one scan; None = run the solo path
+            rs = sv.batcher.submit(self, plan, tenant, db, field_names)
+            if rs is not None:
+                return rs
         batches = self.coord.scan_table(
             tenant, db, plan.table, time_ranges=plan.time_ranges,
-            tag_domains=plan.tag_domains, field_names=field_names)
+            tag_domains=plan.tag_domains, field_names=field_names,
+            fingerprint=sv.current_fp() if sv is not None else None)
         with self.memory_pool.reservation(_batches_bytes(batches),
                                           f"scan of {plan.table}"):
             return self._exec_raw_batches(plan, batches)
 
-    def _exec_raw_batches(self, plan: RawScanPlan, batches):
+    def _raw_batch_env(self, schema, b) -> dict:
+        """Filter/projection eval environment for one ScanBatch: time +
+        field columns with their `__valid__:` masks + per-row tag values
+        gathered through the series ordinals."""
+        env = {"time": b.ts}
+        for fname, (vt, vals, valid) in b.fields.items():
+            env[fname] = vals
+            env[f"__valid__:{fname}"] = valid
+        for t in schema.tag_names():
+            per_series = np.array(
+                [(k.tag_value(t) if k is not None else None)
+                 for k in b.series_keys], dtype=object)
+            env[t] = per_series[b.sid_ordinal] if b.n_series else \
+                np.empty(0, dtype=object)
+        return env
+
+    def _exec_raw_batches(self, plan: RawScanPlan, batches, prepared=None):
+        """`prepared` (serving-plane fused batches) short-circuits the
+        scan→env→mask stage with precomputed ``(env, mask, n_rows)``
+        triples — the member's own filter mask over a SHARED env; the
+        projection half below is identical either way."""
         frames = []
+        if prepared is not None:
+            for env, mask, total in prepared:
+                if not bool(mask.all()):
+                    env = {k: (v[mask]
+                               if isinstance(v, (np.ndarray, DictArray))
+                               and len(v) == total else v)
+                           for k, v in env.items()}
+                frames.append((env, int(mask.sum())))
+            batches = []
         for b in batches:
-            env = {"time": b.ts}
-            for fname, (vt, vals, valid) in b.fields.items():
-                env[fname] = vals
-                env[f"__valid__:{fname}"] = valid
-            for t in plan.schema.tag_names():
-                per_series = np.array(
-                    [(k.tag_value(t) if k is not None else None)
-                     for k in b.series_keys], dtype=object)
-                env[t] = per_series[b.sid_ordinal] if b.n_series else \
-                    np.empty(0, dtype=object)
+            env = self._raw_batch_env(plan.schema, b)
             mask = np.ones(b.n_rows, dtype=bool)
             if plan.filter is not None:
                 missing = [c for c in plan.filter.columns() if c not in env]

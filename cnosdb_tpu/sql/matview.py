@@ -382,6 +382,16 @@ class MatviewEngine:
             with self._refresh_cv:
                 self._refreshing.discard(name)
                 self._refresh_cv.notify_all()
+        if done:
+            # a refreshed rollup changes what matview-rewritten aggregates
+            # read: drop the serving plane's cached results for the base
+            # table (hygiene — probes revalidate tokens regardless)
+            try:
+                from ..server import serving
+
+                serving.invalidate(vdef.tenant, vdef.database, vdef.table)
+            except Exception:
+                stages.count_error("serving.invalidate")
         return done
 
     def _placed_splits(self, vdef: MatViewDef):

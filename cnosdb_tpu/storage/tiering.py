@@ -469,13 +469,38 @@ def tier_vnode(vnode, boundary_ns: int, limit: int | None = None) -> int:
         return 0
     store, _ = _store_and_prefix()
     n = 0
-    for fm in eligible_files(vnode, boundary_ns):
-        if limit is not None and n >= limit:
-            _count_cold("tier", "limit_reached")
-            return n
-        if _tier_file(vnode, store, fm):
-            n += 1
+    try:
+        for fm in eligible_files(vnode, boundary_ns):
+            if limit is not None and n >= limit:
+                _count_cold("tier", "limit_reached")
+                return n
+            if _tier_file(vnode, store, fm):
+                n += 1
+    finally:
+        if n:
+            _serving_invalidate(vnode)
     return n
+
+
+def _serving_invalidate(vnode) -> None:
+    """Tiering moved this vnode's bytes WITHOUT bumping data_version
+    (deliberate: a tiered scan is bit-identical, so coordinator scan
+    caches stay valid) — which means ScanToken revalidation cannot see
+    the move, and this push eviction is the only thing that retires
+    serving-plane entries now backed by cold storage. Losing it is still
+    safe (a hit serves identical bytes), just unhygienic. The owner
+    string is the vnode directory's parent name (engine layout
+    data/<owner>/<id>)."""
+    try:
+        from ..server import serving
+
+        owner = os.path.basename(os.path.dirname(vnode.dir))
+        if "." in owner:
+            serving.invalidate_owner(owner)
+    except Exception:
+        from ..utils import stages
+
+        stages.count_error("serving.invalidate")
 
 
 def _tier_file(vnode, store, fm) -> bool:

@@ -97,6 +97,28 @@ STAGE_CATALOG: dict[str, str] = {
                          "(fault point, nth crossing) pair",
     "chaos.mttr_ms": "crash→first-successful-read recovery time measured "
                      "by chaos workload verify",
+    "serving.plan_hit": "SELECTs answered from a cached analyzed plan "
+                        "(parse+analyze+plan all skipped)",
+    "serving.plan_rebind": "template fingerprint hits re-bound with new "
+                           "literal params (parse+analyze skipped, "
+                           "plan_select re-run)",
+    "serving.plan_miss": "fingerprintable SELECTs that paid a full "
+                         "parse+analyze+plan (then seeded the cache)",
+    "serving.result_hit": "SELECTs answered from the ScanToken-validated "
+                          "result cache (engine untouched)",
+    "serving.result_miss": "result-cache probes whose entry was absent "
+                           "or token-stale",
+    "serving.result_bypass": "executed SELECTs whose result was not "
+                             "cacheable (system/relational path, remote "
+                             "vnodes, oversized result)",
+    "serving.fused": "point queries executed inside a fused micro-batch "
+                     "(shared scan + stacked filter masks)",
+    "serving.solo": "batchable point queries that ran alone (no gate "
+                    "pressure, or the window closed empty)",
+    "serving.fused_scan_ms": "shared scan wall time paid once per fused "
+                             "batch (booked to the leader's profile)",
+    "serving.remote_fp": "scan_vnode RPCs carrying a serving-plane "
+                         "fingerprint (cluster-wide cache attribution)",
 }
 
 # Prefixes for names composed at runtime (skipped by the literal lint

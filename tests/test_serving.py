@@ -1,0 +1,411 @@
+"""Serving plane (plan cache / ScanToken-keyed result cache / fused
+micro-batching): fingerprint normalization, cache hit + template-rebind
+correctness, and the stale-read oracle — every invalidation source
+(DELETE, DDL, matview refresh, tiering, compaction) run with the push
+eviction FAULTED AWAY (``serving.invalidate:fail``), so freshness must
+come entirely from probe-time ScanToken revalidation. Plus fused-vs-solo
+bit-identity (NULL/NaN columns, deadline shedding one member only) and
+the CNOSDB_SERVING=0 byte-identical legacy A/B.
+"""
+import threading
+import time
+
+import pytest
+
+from cnosdb_tpu import faults
+from cnosdb_tpu.errors import (DeadlineExceeded, MetaError, QueryError,
+                               TableNotFound)
+from cnosdb_tpu.parallel.coordinator import Coordinator
+from cnosdb_tpu.parallel.meta import MetaStore
+from cnosdb_tpu.server import serving
+from cnosdb_tpu.sql.executor import QueryExecutor, Session
+from cnosdb_tpu.storage import tiering
+from cnosdb_tpu.storage.engine import TsKv
+from cnosdb_tpu.utils import deadline as deadline_mod
+
+
+@pytest.fixture(autouse=True)
+def _clean(monkeypatch):
+    monkeypatch.delenv("CNOSDB_SERVING", raising=False)
+    monkeypatch.delenv("CNOSDB_SERVING_BATCH_FORCE", raising=False)
+    serving.reset_counters()
+    yield
+    faults.reset()
+    serving.reset_counters()
+
+
+@pytest.fixture
+def db(tmp_path):
+    meta = MetaStore(str(tmp_path / "meta.json"))
+    engine = TsKv(str(tmp_path / "data"))
+    coord = Coordinator(meta, engine)
+    ex = QueryExecutor(meta, coord)
+    ex.execute_one("CREATE DATABASE sdb")
+    s = Session(database="sdb")
+    ex.execute_one("CREATE TABLE t (f1 BIGINT, f2 DOUBLE, TAGS(tag))", s)
+    ex.execute_one(
+        "INSERT INTO t (time, tag, f1, f2) VALUES "
+        "(1,'a',10,1.5),(2,'a',40,2.5),(3,'b',20,3.5),(4,'c',30,4.5)", s)
+    yield ex, s
+    coord.close()
+
+
+def _ctr(layer, outcome):
+    return serving.counters_snapshot().get((layer, outcome), 0)
+
+
+def _rows(ex, s, q):
+    return sorted(map(repr, ex.execute_one(q, s).rows()))
+
+
+# ----------------------------------------------------------- fingerprint
+def test_fingerprint_hoists_literals_case_insensitively():
+    a = serving.fingerprint(
+        "SELECT F1 FROM T WHERE Tag = 'a' AND f2 > 3 LIMIT 10")
+    b = serving.fingerprint(
+        "select f1 from t where tag='b' and f2>7 limit 20")
+    assert a is not None and b is not None
+    assert a[0] == b[0]                      # one family, one fingerprint
+    assert a[1] == ("a", 3, 10) and b[1] == ("b", 7, 20)
+    # int vs float params must not unify (type-tagged keys downstream)
+    c = serving.fingerprint("select f1 from t where f2 > 3.0")
+    assert c is not None and isinstance(c[1][0], float)
+
+
+def test_fingerprint_declines_uncacheable_shapes():
+    assert serving.fingerprint("select now()") is None
+    assert serving.fingerprint("select f1 from t; select f2 from t") is None
+    assert serving.fingerprint("insert into t (time) values (1)") is None
+    # a single trailing ';' is not a multi-statement request
+    assert serving.fingerprint("select 1;") == ("select ?", (1,))
+    # quoted idents keep quotes: "A b" can never collide with a b
+    q = serving.fingerprint('select "A b" from t')
+    assert q is not None and '"A b"' in q[0]
+
+
+# ---------------------------------------------------------- cache layers
+def test_result_cache_hit_and_template_rebind(db):
+    ex, s = db
+    q = "select f1 from t where tag='a'"
+    assert _rows(ex, s, q) == ["(10,)", "(40,)"]      # miss → stored
+    h0 = _ctr("result_cache", "hit")
+    assert _rows(ex, s, q) == ["(10,)", "(40,)"]      # exact hit
+    assert _ctr("result_cache", "hit") == h0 + 1
+    e, b = ex.serving.result_cache.stats()
+    assert e >= 1 and b > 0
+    # same fingerprint, new param: plan-template rebind, correct rows
+    r0 = _ctr("plan_cache", "hit_rebind")
+    assert _rows(ex, s, "select f1 from t where tag='b'") == ["(20,)"]
+    assert _ctr("plan_cache", "hit_rebind") == r0 + 1
+
+
+def test_plain_write_invalidates_via_tokens_plan_survives(db):
+    ex, s = db
+    q = "select f1 from t where tag='c'"
+    assert _rows(ex, s, q) == ["(30,)"]
+    assert _rows(ex, s, q) == ["(30,)"]               # cached
+    ex.execute_one(
+        "INSERT INTO t (time, tag, f1, f2) VALUES (9,'c',70,9.5)", s)
+    # no push hook on INSERT: the probe must catch the token bump alone,
+    # while the analyzed plan stays cached (exact plan hit, fresh scan)
+    p0 = _ctr("plan_cache", "hit")
+    assert _rows(ex, s, q) == ["(30,)", "(70,)"]
+    assert _ctr("plan_cache", "hit") == p0 + 1
+
+
+def test_errors_are_never_cached(db):
+    ex, s = db
+    e0 = ex.serving.result_cache.stats()[0]
+    for _ in range(2):
+        with pytest.raises(QueryError):
+            ex.execute_one("select no_such_col from t", s)
+    assert ex.serving.result_cache.stats()[0] == e0
+
+
+# ------------------------------------------------- stale-read oracle
+# each source of invalidation runs with push eviction faulted away:
+# correctness must come from probe-time ScanToken revalidation alone
+def test_stale_read_oracle_delete(db):
+    ex, s = db
+    q = "select f1 from t where f2 > 0"
+    assert len(_rows(ex, s, q)) == 4
+    assert _ctr("result_cache", "hit") >= 0 and _rows(ex, s, q)  # cached
+    faults.configure("serving.invalidate:fail")
+    ex.execute_one("delete from t where tag = 'a'", s)
+    assert _rows(ex, s, q) == ["(20,)", "(30,)"]      # no stale 'a' rows
+
+
+def test_stale_read_oracle_alter_table(db):
+    ex, s = db
+    q = "select f1 from t where tag='a'"
+    _rows(ex, s, q)
+    _rows(ex, s, q)                                    # cached
+    faults.configure("serving.invalidate:fail")
+    inv0 = _ctr("result_cache", "invalidate")
+    ex.execute_one("ALTER TABLE t ADD FIELD f3 BIGINT", s)
+    # schema version rides the token map: probe evicts, plan re-parses
+    assert _rows(ex, s, q) == ["(10,)", "(40,)"]
+    assert _ctr("result_cache", "invalidate") > inv0
+
+
+def test_stale_read_oracle_drop_table(db):
+    ex, s = db
+    q = "select f1 from t where tag='a'"
+    _rows(ex, s, q)
+    faults.configure("serving.invalidate:fail")
+    ex.execute_one("DROP TABLE t", s)
+    with pytest.raises(TableNotFound):
+        ex.execute_one(q, s)          # cached result must not resurrect t
+
+
+def test_stale_read_oracle_drop_database_is_selective(db):
+    ex, s = db
+    ex.execute_one("CREATE DATABASE other")
+    s2 = Session(database="other")
+    ex.execute_one("CREATE TABLE t (f1 BIGINT, TAGS(tag))", s2)
+    ex.execute_one("INSERT INTO t (time, tag, f1) VALUES (1,'x',5)", s2)
+    q = "select f1 from t where tag='x'"
+    qa = "select f1 from t where tag='a'"
+    assert _rows(ex, s2, q) == ["(5,)"]
+    _rows(ex, s, qa)
+    faults.configure("serving.invalidate:fail")
+    ex.execute_one("DROP DATABASE sdb")
+    with pytest.raises((QueryError, MetaError)):
+        ex.execute_one(qa, s)
+    # the OTHER database's entry survives and still hits
+    h0 = _ctr("result_cache", "hit")
+    assert _rows(ex, s2, q) == ["(5,)"]
+    assert _ctr("result_cache", "hit") == h0 + 1
+
+
+def test_stale_read_oracle_matview_refresh(db, monkeypatch):
+    monkeypatch.setenv("CNOSDB_MATVIEW_AUTO", "0")
+    ex, s = db
+    SEC = 10 ** 9
+    rows = ", ".join(f"({i * SEC}, 'h{i % 2}', {i}, {i}.5)"
+                     for i in range(20))
+    ex.execute_one("CREATE TABLE m (f1 BIGINT, v DOUBLE, TAGS(h))", s)
+    ex.execute_one(f"INSERT INTO m (time, h, f1, v) VALUES {rows}", s)
+    ex.execute_one(
+        "CREATE MATERIALIZED VIEW mv WATERMARK DELAY '1s' AS "
+        "SELECT date_bin(INTERVAL '1 minute', time) AS tb, h, sum(v) "
+        "FROM m GROUP BY tb, h", s)
+    ex.matview_engine().refresh("mv", now_ns=100 * SEC)
+    q = "SELECT h, sum(v) FROM m GROUP BY h"
+    first = _rows(ex, s, q)
+    assert _rows(ex, s, q) == first                    # cached
+    faults.configure("serving.invalidate:fail")
+    rows2 = ", ".join(f"({(20 + i) * SEC}, 'h{i % 2}', {20 + i}, "
+                      f"{20 + i}.5)" for i in range(10))
+    ex.execute_one(f"INSERT INTO m (time, h, f1, v) VALUES {rows2}", s)
+    ex.matview_engine().refresh("mv", now_ns=200 * SEC)
+    faults.reset()
+    ex.matview_rewrite_enabled = False
+    want = _rows(ex, s, "SELECT h, sum(v) FROM m GROUP BY h ")  # no-cache spelling
+    ex.matview_rewrite_enabled = True
+    faults.configure("serving.invalidate:fail")
+    got = _rows(ex, s, q)
+    assert got == want and got != first                # fresh, not stale
+
+
+def test_stale_read_oracle_tiering(db, tmp_path):
+    # tiering is the one source that does NOT flip the ScanToken — on
+    # purpose, a tiered scan is bit-identical and coordinator scan
+    # caches stay valid — so the oracle here is two-sided: with the
+    # push eviction faulted away a cache hit must still be the right
+    # bytes, and without the fault the push must actually evict
+    ex, s = db
+    store = tmp_path / "bucket"
+    store.mkdir()
+    tiering.configure(str(store))
+    try:
+        ex.coord.engine.flush_all()
+        ex.execute_one(
+            "INSERT INTO t (time, tag, f1, f2) VALUES (5,'a',50,5.5)", s)
+        ex.coord.engine.flush_all()
+        for v in list(ex.coord.engine.vnodes.values()):
+            v.compact_major()                # tiering wants sealed L1+
+        q = "select f1 from t where tag='a'"
+        want = _rows(ex, s, q)
+        assert _rows(ex, s, q) == want                 # cached
+        faults.configure("serving.invalidate:fail")
+        h0 = _ctr("result_cache", "hit")
+        moved = sum(tiering.tier_vnode(v, boundary_ns=10 ** 18)
+                    for v in list(ex.coord.engine.vnodes.values()))
+        assert moved >= 1
+        assert _rows(ex, s, q) == want                 # sound hit
+        assert _ctr("result_cache", "hit") == h0 + 1
+        # unfaulted: a fresh tier event's push eviction retires the
+        # entry and the re-read goes through the cold tier, identical
+        faults.reset()
+        ex.execute_one(
+            "INSERT INTO t (time, tag, f1, f2) VALUES (6,'a',60,6.5)", s)
+        ex.coord.engine.flush_all()
+        ex.execute_one(
+            "INSERT INTO t (time, tag, f1, f2) VALUES (7,'b',70,7.5)", s)
+        ex.coord.engine.flush_all()
+        for v in list(ex.coord.engine.vnodes.values()):
+            v.compact_major()
+        want2 = _rows(ex, s, q)
+        assert "(60,)" in want2 and _rows(ex, s, q) == want2   # cached
+        inv0 = _ctr("result_cache", "invalidate")
+        moved = sum(tiering.tier_vnode(v, boundary_ns=10 ** 18)
+                    for v in list(ex.coord.engine.vnodes.values()))
+        assert moved >= 1
+        assert _ctr("result_cache", "invalidate") > inv0
+        assert _rows(ex, s, q) == want2
+    finally:
+        tiering.configure(None)
+        tiering.block_cache_clear()
+        tiering.counters_reset()
+
+
+def test_stale_read_oracle_compaction(db):
+    ex, s = db
+    ex.coord.engine.flush_all()
+    ex.execute_one(
+        "INSERT INTO t (time, tag, f1, f2) VALUES (8,'a',80,8.5)", s)
+    ex.coord.engine.flush_all()                        # 2 L0 files
+    q = "select f1 from t where tag='a'"
+    want = _rows(ex, s, q)
+    assert _rows(ex, s, q) == want                     # cached
+    faults.configure("serving.invalidate:fail")
+    inv0 = _ctr("result_cache", "invalidate")
+    for owner, vid in list(ex.coord.engine.vnodes):
+        if owner == "cnosdb.sdb":
+            ex.coord.compact_vnode(vid)
+    assert _rows(ex, s, q) == want
+    assert _ctr("result_cache", "invalidate") > inv0
+
+
+# -------------------------------------------------------- fused batching
+def _mk_point_table(ex, s):
+    ex.execute_one("CREATE TABLE p (f1 BIGINT, f2 DOUBLE, TAGS(tag))", s)
+    # NULL column: rows for tag 'c' never write f2; NaN rides on 'd'
+    ex.execute_one(
+        "INSERT INTO p (time, tag, f1, f2) VALUES "
+        "(1,'a',1,0.5),(2,'a',2,1.5),(3,'b',3,2.5),(4,'b',4,3.5)", s)
+    ex.execute_one("INSERT INTO p (time, tag, f1) VALUES (5,'c',5),(6,'c',6)", s)
+    try:
+        ex.execute_one(
+            "INSERT INTO p (time, tag, f1, f2) VALUES (7,'d',7,NaN)", s)
+    except Exception:
+        import numpy as np
+
+        from cnosdb_tpu.models.points import SeriesRows, WriteBatch
+        from cnosdb_tpu.models.schema import ValueType
+        from cnosdb_tpu.models.series import SeriesKey
+        wb = WriteBatch()
+        wb.add_series("p", SeriesRows(
+            SeriesKey("p", {"tag": "d"}), np.array([7], dtype=np.int64),
+            {"f1": (int(ValueType.INTEGER), np.array([7])),
+             "f2": (int(ValueType.FLOAT), np.array([float("nan")]))}))
+        ex.coord.write_points("cnosdb", "sdb", wb)
+
+
+def test_fused_point_queries_bit_identical_to_solo(db, monkeypatch):
+    ex, s = db
+    _mk_point_table(ex, s)
+    tags = ["a", "b", "c", "d"]
+    qs = {t: f"select time, f1, f2 from p where tag='{t}'" for t in tags}
+    # solo baseline through a serving-disabled executor on the same data
+    monkeypatch.setenv("CNOSDB_SERVING", "0")
+    solo_ex = QueryExecutor(ex.meta, ex.coord)
+    assert solo_ex.serving is None
+    want = {t: _rows(solo_ex, s, qs[t]) for t in tags}
+    monkeypatch.delenv("CNOSDB_SERVING")
+
+    ex.serving.batcher.force = True
+    ex.serving.batcher.window_s = 0.25
+    got, errors = {}, {}
+
+    def run(tag):
+        try:
+            got[tag] = _rows(ex, s, qs[tag])
+        except Exception as e:          # surfaced via the errors dict
+            errors[tag] = e
+
+    threads = [threading.Thread(target=run, args=(t,)) for t in tags]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join()
+    assert not errors, errors
+    assert got == want
+    widths = serving.width_histogram()
+    assert widths and max(widths) >= 2, widths         # something fused
+    # and a fused answer re-served from cache is still bit-identical
+    assert {t: _rows(ex, s, qs[t]) for t in tags} == want
+
+
+def test_fused_member_deadline_sheds_only_that_member(db):
+    ex, s = db
+    _mk_point_table(ex, s)
+    want_a = _rows(ex, s, "select f1 from p where tag='a' and f2 < 99")
+    serving.reset_counters()
+    ex.serving.result_cache.invalidate("cnosdb", "sdb")  # force re-exec
+    ex.serving.batcher.force = True
+    ex.serving.batcher.window_s = 0.5
+    got, errors = {}, {}
+
+    def leader():
+        try:
+            got["a"] = _rows(ex, s, "select f1 from p where tag='a' and f2 < 99")
+        except Exception as e:
+            errors["a"] = e
+
+    def follower():
+        try:
+            with deadline_mod.scope(deadline_mod.Deadline(0.12)):
+                got["b"] = _rows(ex, s, "select f1 from p where tag='b' and f2 < 99")
+        except Exception as e:
+            errors["b"] = e
+
+    ta = threading.Thread(target=leader)
+    tb = threading.Thread(target=follower)
+    ta.start()
+    time.sleep(0.1)                     # leader's window is open by now
+    tb.start()
+    ta.join()
+    tb.join()
+    assert isinstance(errors.get("b"), DeadlineExceeded), (got, errors)
+    assert "a" not in errors and got["a"] == want_a
+
+
+# --------------------------------------------------------- kill switch
+def test_serving_disabled_is_byte_identical(db, monkeypatch):
+    ex, s = db
+    queries = [
+        "select time, f1, f2 from t where tag='a'",
+        "select f1 from t where f2 > 2.0 limit 2",
+        "select tag, sum(f1) from t group by tag",
+        "select count(f1) from t",
+    ]
+    monkeypatch.setenv("CNOSDB_SERVING", "0")
+    legacy = QueryExecutor(ex.meta, ex.coord)
+    assert legacy.serving is None
+    for q in queries:
+        want = _rows(legacy, s, q)
+        assert _rows(ex, s, q) == want      # miss path
+        assert _rows(ex, s, q) == want      # cached path
+
+
+# -------------------------------------------------------------- caches
+def test_result_cache_byte_cap_and_oversize_reject():
+    rc = serving.ResultCache(max_bytes=1 << 20, max_entries=16)
+    def ent(n):
+        return serving._ResultEntry(None, {}, None, "t", "d", "m", n)
+    assert not rc.store("huge", ent((1 << 20) // 8 + 1))   # > cap/8
+    for i in range(20):
+        assert rc.store(("k", i), ent(100_000))
+    e, b = rc.stats()
+    assert e <= 16 and b <= 1 << 20              # LRU bounded both ways
+    assert rc.get(("k", 0)) is None and rc.get(("k", 19)) is not None
+
+
+def test_plan_cache_lru_bound():
+    pc = serving.PlanCache(max_entries=8)
+    for i in range(20):
+        pe = serving._PlanEntry(None, None, "t", "d", "m", 0, (i,), None)
+        pc.store(("t", "d", "fp", (i,)), pe)
+    assert pc.stats()[0] == 8

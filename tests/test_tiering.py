@@ -428,6 +428,9 @@ def test_query_path_recovers_wiped_sidecars(tmp_path, store_dir):
     with coord._scan_cache_lock:
         coord._scan_cache.clear()
     tiering.block_cache_clear()
+    from cnosdb_tpu.server import serving as serving_mod
+
+    serving_mod.invalidate("cnosdb", "public")   # the wipe bumps no token
     rs = db.execute_one("SELECT count(v) FROM m")
     assert int(rs.columns[0][0]) == 50      # recovered, not lost
     for v in tiered:
