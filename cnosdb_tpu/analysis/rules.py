@@ -881,7 +881,78 @@ class ServingAccounting(Rule):
 
 
 # --------------------------------------------------------------------------
-# 15. fault-site-coverage — new (PR 13): every fire() site must be in the
+# 15. backup-accounting — new (PR 16): no silent DR-plane exits
+# --------------------------------------------------------------------------
+_BKA_FUNCS = {
+    "cnosdb_tpu/storage/backup.py": ("archive_segment", "create_backup",
+                                     "restore_backup", "install_vnode"),
+}
+_BKA_ACCOUNTING = {"_count_backup", "count", "count_error"}
+
+
+def _bka_has_accounting(node: ast.AST) -> bool:
+    for n in ast.walk(node):
+        if isinstance(n, ast.Call) and _call_name(n) in _BKA_ACCOUNTING:
+            return True
+    return False
+
+
+class BackupAccounting(Rule):
+    name = "backup-accounting"
+    motivation = ("PR 16 disaster-recovery plane: every exit out of the "
+                  "archive/backup/restore lanes must book an (op, "
+                  "outcome) into cnosdb_backup_total — an unaccounted "
+                  "early return makes the RPO/backup telemetry lie, and "
+                  "a DR plane that silently skips segments or vnodes is "
+                  "discovered exactly when the backup is needed")
+
+    def applies_to(self, relpath):
+        return relpath in _BKA_FUNCS
+
+    def begin_module(self, ctx):
+        want = _BKA_FUNCS.get(ctx.relpath)
+        guarded = want is not None
+        if want is None:
+            # scope-ignored run (fixtures/self-tests): lint any function
+            # bearing a guarded name, but skip the presence check
+            want = tuple({n for names in _BKA_FUNCS.values()
+                          for n in names})
+        found = set()
+        for fn in ast.walk(ctx.tree):
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    or fn.name not in want:
+                continue
+            found.add(fn.name)
+            terminal = fn.body[-1]
+            for block in _dda_blocks(fn):
+                for i, stmt in enumerate(block):
+                    if not isinstance(stmt, (ast.Return, ast.Raise)) \
+                            or stmt is terminal:
+                        continue
+                    prev = block[i - 1] if i else None
+                    if _bka_has_accounting(stmt) \
+                            or (prev is not None
+                                and _bka_has_accounting(prev)):
+                        continue
+                    kind = "return" if isinstance(stmt, ast.Return) \
+                        else "raise"
+                    ctx.report(self, stmt,
+                               f"unaccounted early {kind} in {fn.name} — "
+                               f"DR-plane exits must book an (op, "
+                               f"outcome) (_count_backup/stages.count) so "
+                               f"skipped segments and failed installs "
+                               f"stay visible on /metrics")
+        for name in want if guarded else ():
+            if name not in found:
+                ctx.report(self, 1,
+                           f"backup guarded function {name} not "
+                           f"found — if it was renamed, update "
+                           f"analysis/rules.py so the lint keeps "
+                           f"covering it")
+
+
+# --------------------------------------------------------------------------
+# 16. fault-site-coverage — new (PR 13): every fire() site must be in the
 #     FAULT_POINTS registry the crash sweep enumerates
 # --------------------------------------------------------------------------
 _FSC_RECEIVERS = {"faults", "_faults"}
@@ -936,5 +1007,5 @@ def all_rules() -> list:
             LockBlocking(), SwallowedException(), JaxPurity(),
             WallclockDuration(), MetricsNaming(), StageCatalog(),
             DeviceDecodeAccounting(), StringFilterAccounting(),
-            ColdTierAccounting(), ServingAccounting(), FaultSiteCoverage(),
-            *project_rules()]
+            ColdTierAccounting(), ServingAccounting(), BackupAccounting(),
+            FaultSiteCoverage(), *project_rules()]

@@ -62,12 +62,23 @@ def _delete_targets(history: History, before_e: int | None = None) -> set:
     return out
 
 
-def check_no_lost_acked_writes(history: History,
-                               observed: set) -> CheckResult:
+def check_no_lost_acked_writes(history: History, observed: set,
+                               before_ts: float | None = None) -> CheckResult:
+    """`before_ts` (wall seconds) restricts the obligation to writes
+    acked at-or-before that instant — the point-in-time-restore form: a
+    restore to T (or to the archived watermark after total node loss)
+    owes only the writes acked by then. The bound is conservative: an
+    ok event's stamp lands *after* the durable append it acknowledges,
+    so ok_ts ≤ watermark implies the write's entries are archived. An
+    ok event with no stamp (older history format) stays required."""
     acked: set = set()
     for op in history.by_op("write"):
-        if op.acked:
-            acked.update(op.data.get("keys", ()))
+        if not op.acked:
+            continue
+        if before_ts is not None and op.outcome_ts is not None \
+                and op.outcome_ts > before_ts:
+            continue
+        acked.update(op.data.get("keys", ()))
     lost = acked - observed - _delete_targets(history)
     return CheckResult(
         "no_lost_acked_writes", not lost,
@@ -169,9 +180,12 @@ def check_checksum_convergence(per_node: dict) -> CheckResult:
                        f"{len(groups)} groups converged")
 
 
-def run_client_checks(history: History, observed: set) -> list[CheckResult]:
-    """The four history-only invariants, in severity order."""
-    return [check_no_lost_acked_writes(history, observed),
+def run_client_checks(history: History, observed: set,
+                      before_ts: float | None = None) -> list[CheckResult]:
+    """The four history-only invariants, in severity order. `before_ts`
+    bounds the no-lost-acked-writes obligation for point-in-time
+    restores (see check_no_lost_acked_writes)."""
+    return [check_no_lost_acked_writes(history, observed, before_ts),
             check_no_resurrection(history, observed),
             check_read_your_writes(history),
             check_monotonic_reads(history)]

@@ -44,6 +44,13 @@ class HistoryRecorder:
 
     def _emit_locked(self, ev: dict, durable: bool) -> int:
         ev["e"] = self._n
+        # wall-clock stamp: checks never ORDER by it (the index `e` is
+        # happens-before), but the DR checker compares ok-event times
+        # against the archived watermark to bound which acked writes a
+        # point-in-time restore must preserve
+        import time
+
+        ev["ts"] = time.time()
         self._n += 1
         self._f.write(json.dumps(ev, separators=(",", ":")).encode() + b"\n")
         self._f.flush()
@@ -79,6 +86,7 @@ class Op:
     data: dict
     outcome: str | None = None      # "ok" | "fail" | None (ambiguous)
     outcome_e: int = -1
+    outcome_ts: float | None = None  # wall time of the outcome event
     ok_data: dict = field(default_factory=dict)
 
     @property
@@ -95,7 +103,7 @@ class History:
         for ev in events:
             if ev.get("t") == "invoke":
                 data = {k: v for k, v in ev.items()
-                        if k not in ("e", "s", "t", "op")}
+                        if k not in ("e", "s", "t", "op", "ts")}
                 by_e[ev["e"]] = Op(op=ev.get("op", "?"), session=ev["s"],
                                    invoke_e=ev["e"], data=data)
         for ev in events:
@@ -107,9 +115,10 @@ class History:
                 continue
             inv.outcome = t
             inv.outcome_e = ev["e"]
+            inv.outcome_ts = ev.get("ts")
             if t == "ok":
                 inv.ok_data = {k: v for k, v in ev.items()
-                               if k not in ("e", "s", "t", "of")}
+                               if k not in ("e", "s", "t", "of", "ts")}
         self.ops = sorted(by_e.values(), key=lambda o: o.invoke_e)
 
     @classmethod

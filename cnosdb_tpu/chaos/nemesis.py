@@ -15,6 +15,13 @@ import random
 from dataclasses import dataclass
 
 KINDS = ("partition", "crash_restart", "delay_storm", "corrupt")
+# disaster-recovery kinds, never mixed into the default rotation: both
+# destroy data on purpose (total_loss wipes a node's data dir,
+# operator_error drops a whole database) and are only survivable when
+# the DR plane (storage/backup.py) is configured — the harness restores
+# from the archive store and the checker judges RPO against the
+# archived watermark
+DR_KINDS = ("total_loss", "operator_error")
 
 
 @dataclass(frozen=True)
@@ -29,7 +36,7 @@ def generate_plan(seed: int, n_nodes: int, steps: int = 6,
                   kinds: tuple[str, ...] = KINDS) -> list[NemesisEvent]:
     """Deterministic event sequence; `seed` fully determines it."""
     for k in kinds:
-        if k not in KINDS:
+        if k not in KINDS and k not in DR_KINDS:
             raise ValueError(f"unknown nemesis kind {k!r}")
     rng = random.Random(seed)
     plan = []
@@ -61,7 +68,10 @@ def event_specs(ev: NemesisEvent, victim_addr: str,
         # at-rest corruption the integrity plane must catch and repair
         return (prefix + f"scrub.read:corrupt({max(1, ev.param // 20)})"
                          f":once", "")
-    if ev.kind == "crash_restart":
+    if ev.kind == "crash_restart" or ev.kind in DR_KINDS:
+        # the harness acts directly: kill+start, rm -rf the victim's
+        # data dir (total_loss), or DROP DATABASE (operator_error) —
+        # followed by RESTORE from the archive store
         return ("", "")
     raise ValueError(f"unknown nemesis kind {ev.kind!r}")
 

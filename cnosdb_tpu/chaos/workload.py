@@ -51,10 +51,14 @@ def _open_db(root: str):
     from ..parallel.meta import MetaStore
     from ..sql.executor import QueryExecutor
     from ..storage.engine import TsKv
-    from ..storage import tiering
+    from ..storage import backup, tiering
 
     os.environ.setdefault("CNOSDB_MATVIEW_AUTO", "0")
     tiering.configure(os.path.join(root, "bucket"))
+    # DR plane shares the root: sealed WAL segments stream to archive/
+    # from the moment each vnode opens, so the run crosses backup.archive
+    # continuously and BACKUP/RESTORE below cross the other two sites
+    backup.configure_archive(os.path.join(root, "archive"))
     meta = MetaStore(os.path.join(root, "meta.json"))
     engine = TsKv(os.path.join(root, "data"))
     coord = Coordinator(meta, engine)
@@ -134,6 +138,7 @@ def run(root: str) -> None:
              "sum(v), count(v) FROM w GROUP BY t, h")
         ex.matview_engine().refresh("mv", now_ns=NOW_NS)
         _scrub(engine, hist)
+        _backup_restore(ex, hist)
         _read(ex, hist, "s1")
         _read(ex, hist, "s2")
     finally:
@@ -153,6 +158,19 @@ def _tier(engine, hist) -> None:
     for v in engine.local_vnodes(OWNER):
         n += tiering.tier_vnode(v, TIER_BOUNDARY)
     hist.ok("s1", inv, files=n)
+
+
+def _backup_restore(ex, hist) -> None:
+    """Cross the DR plane's backup.manifest + restore.install sites: one
+    consistent backup, then a restore into a parallel database. The
+    source database must come through untouched — the post-restore reads
+    and the checker prove it."""
+    inv = hist.invoke("s1", "ddl", name="backup")
+    ex.execute_one("BACKUP DATABASE public")
+    hist.ok("s1", inv)
+    inv = hist.invoke("s1", "ddl", name="restore")
+    ex.execute_one("RESTORE DATABASE public AS public_r")
+    hist.ok("s1", inv)
 
 
 def _scrub(engine, hist) -> None:
@@ -193,8 +211,11 @@ def verify(root: str) -> dict:
         return {"mttr_s": mttr, "observed": len(observed),
                 "results": results}
     finally:
+        from ..storage import backup
+
         coord.close()
         tiering.configure(None)
+        backup.configure_archive(None)
 
 
 def _matview_check(ex, hist):

@@ -121,6 +121,16 @@ class StorageConfig:
     tiering_uri: str = ""
     tiering_interval: int = 0
     tiering_cold_after_s: int = 24 * 3600
+    # disaster-recovery plane (storage/backup.py): object-store URI for
+    # continuous WAL archiving + BACKUP/RESTORE manifests (empty = DR
+    # off). May share a bucket with tiering_uri under a different prefix;
+    # cold objects are referenced by backups, never copied.
+    wal_archive_uri: str = ""
+    # optional store credentials/overrides for wal_archive_uri: a JSON
+    # object of CONNECTION-style keys (endpoint_url, access_key_id, …).
+    # String-typed so the TOML fallback parser and the env override
+    # (CNOSDB_STORAGE_WAL_ARCHIVE_OPTIONS) both carry it unchanged.
+    wal_archive_options: str = ""
 
 
 @dataclass

@@ -1586,6 +1586,58 @@ class Coordinator:
             self._rpc(node, "vnode_install",
                       {"owner": owner, "vnode_id": vnode_id, "data": data})
 
+    # ------------------------------------------------------------ disaster
+    # recovery: BACKUP / RESTORE fan-out (storage/backup.py owns the
+    # archive-store mechanics; the coordinator supplies cluster routing)
+    def backup_database(self, tenant: str, db: str,
+                        incremental: bool = False) -> dict:
+        """BACKUP DATABASE: cut every leader placement (remote ones via
+        the backup_cut RPC) into one consistent, meta-recorded backup."""
+        from ..storage import backup
+
+        owner = f"{tenant}.{db}"
+
+        def fetch_cut(vnode_id: int, node_id: int):
+            if node_id == self.node_id or not self.distributed:
+                return None       # engine.vnode already said "not here"
+            reply = self._rpc(node_id, "backup_cut",
+                              {"owner": owner, "vnode_id": vnode_id},
+                              timeout=60.0)
+            return reply.get("cut")
+
+        return backup.create_backup(self.meta, self.engine, tenant, db,
+                                    incremental=incremental,
+                                    fetch_cut=fetch_cut)
+
+    def restore_database(self, tenant: str, db: str,
+                         backup_id: str | None = None,
+                         to_ts: int | None = None,
+                         new_name: str | None = None) -> dict:
+        """RESTORE DATABASE [TO TIMESTAMP] [AS]: manifest → per-placement
+        install, routed to whichever node owns each target vnode."""
+        from ..storage import backup
+
+        return backup.restore_backup(
+            self.meta, self.engine, tenant, db, backup_id=backup_id,
+            to_ts=to_ts, new_name=new_name,
+            install=self._install_restored_vnode)
+
+    def _install_restored_vnode(self, owner: str, vnode_id: int, vn: dict,
+                                snap: dict, entries: list) -> None:
+        from ..storage import backup
+
+        hit = self.meta.find_vnode(vnode_id)
+        node = hit[3].node_id if hit is not None else self.node_id
+        if node == self.node_id or not self.distributed:
+            backup.install_vnode(self.engine, owner, vnode_id, snap,
+                                 entries)
+        else:
+            self._rpc(node, "restore_vnode",
+                      {"owner": owner, "vnode_id": vnode_id, "snap": snap,
+                       "entries": entries}, timeout=60.0)
+        # the restored vnode's bytes changed under every cached scan
+        self._drop_vnode_cache_entries(owner, vnode_id)
+
     def _peer_nodes(self, tenant: str, db: str) -> list[int]:
         """Other nodes hosting vnodes of this database."""
         if not self.distributed:

@@ -147,6 +147,11 @@ class MetaStore:
         # it, spi ast.rs:65-77). Payloads keep full schema state; data
         # files stay on disk until purge_trash.
         self.trash: dict[str, dict] = {"tenant": {}, "db": {}, "table": {}}
+        # disaster-recovery backup catalog (storage/backup.py): owner →
+        # ordered list of backup entries. Rides the same replicated
+        # snapshot as the rest of the catalog, so RESTORE can find its
+        # manifests after total node loss of any data node.
+        self.backups: dict[str, list[dict]] = {}
         # recently-applied raft request ids, persisted in the SAME atomic
         # meta.json write as the mutations they guard: a restarted member
         # replaying a retried duplicate proposal (or a retry reaching a
@@ -234,6 +239,7 @@ class MetaStore:
             "applied_index": self.applied_index,
             "recent_req_ids": self.recent_req_ids,
             "trash": self.trash,
+            "backups": self.backups,
             "next_ids": [self._next_bucket_id, self._next_replica_id, self._next_vnode_id],
         }
 
@@ -273,6 +279,7 @@ class MetaStore:
         self.applied_index = d.get("applied_index", 0)
         self.recent_req_ids = list(d.get("recent_req_ids", []))
         self.trash = d.get("trash", {"tenant": {}, "db": {}, "table": {}})
+        self.backups = d.get("backups", {})
         self._next_bucket_id, self._next_replica_id, self._next_vnode_id = d["next_ids"]
         # snapshots written before the usage_schema metric tables existed
         # must still grow them on load (mk() is idempotent), along with
@@ -1253,6 +1260,34 @@ class MetaStore:
             self._persist()
             self._notify("create_bucket", owner=owner, bucket_id=bucket.id)
             return bucket
+
+    # ------------------------------------------------------------ backups
+    def record_backup(self, owner: str, entry: dict) -> None:
+        """Append one backup-catalog entry (storage/backup.py manifest
+        pointer). Meta-replicated: the catalog is part of the persisted
+        snapshot, so it survives any data node."""
+        with self.lock:
+            self.backups.setdefault(owner, []).append(dict(entry))
+            self._persist()
+            self._notify("record_backup", owner=owner, backup_id=entry["id"])
+
+    def list_backups(self, owner: str) -> list[dict]:
+        with self.lock:
+            return [dict(e) for e in self.backups.get(owner, [])]
+
+    def prune_backups(self, owner: str, keep: int) -> int:
+        """Drop catalog entries beyond the newest `keep` (manifest GC has
+        already deleted their objects); keep=0 clears the owner's whole
+        catalog. → entries removed."""
+        with self.lock:
+            entries = self.backups.get(owner, [])
+            if keep < 0 or len(entries) <= keep:
+                return 0
+            dropped = len(entries) - keep
+            self.backups[owner] = entries[-keep:] if keep else []
+            self._persist()
+            self._notify("prune_backups", owner=owner)
+            return dropped
 
     def buckets_for(self, tenant: str, db: str,
                     min_ts: int | None = None, max_ts: int | None = None) -> list[BucketInfo]:

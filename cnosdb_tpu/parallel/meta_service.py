@@ -59,6 +59,7 @@ MUTATIONS = frozenset([
     "update_vnode", "add_replica_vnode", "remove_replica_vnode",
     "promote_replica", "remove_replica_set",
     "recover_tenant", "recover_database", "recover_table", "purge_trash",
+    "record_backup", "prune_backups",
 ])
 
 
@@ -681,6 +682,12 @@ class MetaClient:
 
     def purge_trash(self, older_than_s=0.0):
         return self._forward("purge_trash", older_than_s=older_than_s)
+
+    def record_backup(self, owner, entry):
+        return self._forward("record_backup", owner=owner, entry=entry)
+
+    def prune_backups(self, owner, keep):
+        return self._forward("prune_backups", owner=owner, keep=keep)
 
     def expire_buckets(self, tenant, db, now_ns):
         return self._forward("expire_buckets", tenant=tenant, db=db,

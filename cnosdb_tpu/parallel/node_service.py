@@ -42,6 +42,8 @@ class DataNodeService:
             "series_keys": self._series_keys,
             "delete_vnode_range": self._delete_vnode_range,
             "vnode_snapshot": self._vnode_snapshot,
+            "backup_cut": self._backup_cut,
+            "restore_vnode": self._restore_vnode,
             "vnode_install": self._vnode_install,
             "vnode_drop": self._vnode_drop,
             "vnode_compact": self._vnode_compact,
@@ -171,6 +173,26 @@ class DataNodeService:
         if v is None:
             return {"data": None}
         return {"data": VnodeStateMachine(v).snapshot()}
+
+    def _backup_cut(self, p):
+        """BACKUP fan-out: one local vnode's consistency cut (files +
+        digests + flushed_seq + scan token), with the forced WAL seal +
+        archive catch_up baked into _local_cut."""
+        from ..storage import backup
+
+        v = self.coord.engine.vnode(p["owner"], p["vnode_id"])
+        if v is None:
+            return {"cut": None}
+        return {"cut": backup._local_cut(v)}
+
+    def _restore_vnode(self, p):
+        """RESTORE fan-out: wipe + install one local vnode from shipped
+        snapshot bytes, then replay the shipped archived-WAL entries."""
+        from ..storage import backup
+
+        backup.install_vnode(self.coord.engine, p["owner"], p["vnode_id"],
+                             p["snap"], p["entries"])
+        return {"ok": True}
 
     def _vnode_install(self, p):
         from .replica import VnodeStateMachine

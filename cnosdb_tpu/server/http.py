@@ -93,6 +93,7 @@ class HttpServer:
             web.get("/debug/backtrace", self.handle_backtrace),
             web.get("/debug/pprof", self.handle_pprof),
             web.get("/debug/scrub", self.handle_scrub),
+            web.get("/debug/backup", self.handle_backup),
             web.get("/debug/matview", self.handle_matview),
             web.get("/debug/lockgraph", self.handle_lockgraph),
         ])
@@ -413,6 +414,42 @@ class HttpServer:
             if repair:
                 out["repair"] = self.coord.anti_entropy_sweep()
             out["counters"] = scrub.counters_snapshot()
+            return out
+
+        loop = asyncio.get_running_loop()
+        return web.json_response(await loop.run_in_executor(None, run))
+
+    async def handle_backup(self, request):
+        """Disaster-recovery plane status: archive config, per-vnode
+        archiver watermarks + lag, counters, and the meta backup catalog.
+        `?catchup=1` forces a synchronous seal + archive pass (the manual
+        RPO-flush lever; BACKUP DATABASE does this per cut anyway)."""
+        self._require_admin(request)
+        from ..storage import backup
+
+        catchup = request.query.get("catchup", "0") not in \
+            ("0", "", "false")
+
+        def run():
+            out = {"enabled": backup.archive_enabled(),
+                   "archivers": [], "catalog": {}}
+            if not out["enabled"]:
+                return out
+            if catchup:
+                for a in backup.archivers():
+                    a.wal.seal_active()
+                    a.catch_up()
+            for a in backup.archivers():
+                out["archivers"].append(
+                    {"owner": a.owner, "vnode_id": a.vnode_id,
+                     "watermark": a.watermark(),
+                     "lag_seconds": a.lag_seconds()})
+            out["lag_seconds"] = backup.archive_lag_seconds()
+            out["counters"] = {f"{op}.{outcome}": n for (op, outcome), n
+                               in backup.backup_snapshot().items()}
+            for owner, entries in getattr(self.meta, "backups",
+                                          {}).items():
+                out["catalog"][owner] = [e["id"] for e in entries]
             return out
 
         loop = asyncio.get_running_loop()
@@ -940,6 +977,16 @@ class HttpServer:
             for width, n in _sv.width_histogram().items():
                 self.metrics.set_counter("cnosdb_serving_batch_width_total",
                                          n, width=str(width))
+        # disaster-recovery plane: per-(op, outcome) archive/backup/
+        # restore counters plus the RPO gauge (age of the oldest sealed-
+        # but-unarchived WAL segment) — resident only once configured
+        _bk = _sys.modules.get("cnosdb_tpu.storage.backup")
+        if _bk is not None and _bk.archive_enabled():
+            for (op, outcome), n in _bk.backup_snapshot().items():
+                self.metrics.set_counter("cnosdb_backup_total", n,
+                                         op=op, outcome=outcome)
+            self.metrics.set_gauge("cnosdb_backup_archive_lag_seconds",
+                                   _bk.archive_lag_seconds())
         # nemesis plane: checker verdicts + recovery timings — resident
         # only when a chaos suite has run in this process
         _ch = _sys.modules.get("cnosdb_tpu.chaos")
@@ -1212,6 +1259,26 @@ def run_server(args) -> int:
         else:
             print(f"cold tier configured → {cfg.storage.tiering_uri} "
                   f"(no background sweep)")
+
+    if cfg.storage.wal_archive_uri:
+        from ..config import ConfigError
+        from ..storage import backup
+
+        arch_opts = None
+        if cfg.storage.wal_archive_options:
+            try:
+                arch_opts = json.loads(cfg.storage.wal_archive_options)
+            except ValueError as e:
+                raise ConfigError(
+                    f"bad [storage] wal_archive_options JSON: {e}")
+        backup.configure_archive(cfg.storage.wal_archive_uri, arch_opts)
+        # vnodes opened before this point (engine boot replay) missed the
+        # __init__ attach hook: wire them now so fence + catch_up cover
+        # every WAL in the process
+        for v in list(server.coord.engine.vnodes.values()):
+            backup.attach_vnode(v)
+        print(f"WAL archive → {cfg.storage.wal_archive_uri} "
+              f"(continuous archiving + BACKUP/RESTORE enabled)")
 
     if cfg.trace.otlp_endpoint:
         from .trace import GLOBAL_COLLECTOR, OtlpExporter

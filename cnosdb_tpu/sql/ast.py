@@ -329,6 +329,29 @@ class RecoverStmt:
 
 
 @dataclass
+class BackupStmt:
+    """BACKUP DATABASE <db> [INCREMENTAL] — consistent cluster-wide cut
+    into the archive store (storage/backup.py; the reference ships
+    `cnosdb-cli dump` / meta export instead, see PARITY.md)."""
+
+    database: str
+    incremental: bool = False
+
+
+@dataclass
+class RestoreStmt:
+    """RESTORE DATABASE <db> [FROM '<backup_id>'] [TO TIMESTAMP <t>]
+    [AS <new_name>] — point-in-time restore: newest backup at-or-before
+    T plus archived-WAL replay up to T; without TO TIMESTAMP, roll
+    forward to the latest archived write."""
+
+    database: str
+    backup_id: Optional[str] = None
+    to_ts: Optional[int] = None         # ns since epoch
+    new_name: Optional[str] = None
+
+
+@dataclass
 class AlterTenantMember:
     """ALTER TENANT t ADD USER u AS r | REMOVE USER u."""
 

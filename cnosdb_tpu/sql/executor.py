@@ -505,6 +505,24 @@ class QueryExecutor:
         if isinstance(stmt, ast.FlushStmt):
             self.coord.engine.flush_all()
             return ResultSet.message("ok")
+        if isinstance(stmt, ast.BackupStmt):
+            entry = self.coord.backup_database(
+                session.tenant, stmt.database,
+                incremental=stmt.incremental)
+            return ResultSet.message(
+                f"backup {entry['id']}: {entry['vnodes']} vnodes, "
+                f"{entry['objects_uploaded']} objects uploaded, "
+                f"{entry['objects_reused']} reused")
+        if isinstance(stmt, ast.RestoreStmt):
+            out = self.coord.restore_database(
+                session.tenant, stmt.database, backup_id=stmt.backup_id,
+                to_ts=stmt.to_ts, new_name=stmt.new_name)
+            # every cached plan/result over the target db read bytes that
+            # the install just replaced
+            self._serving_invalidate(session.tenant, out["database"])
+            return ResultSet.message(
+                f"restored {out['database']} from {out['backup_id']}: "
+                f"{len(out['vnodes'])} vnodes")
         raise ExecutionError(f"unsupported statement {type(stmt).__name__}")
 
     # privilege needed per statement class
@@ -521,7 +539,10 @@ class QueryExecutor:
                     ast.CopyStmt, ast.CreateExternalTable,
                     # cluster-topology mutation reaches every tenant's
                     # vnodes via the global placement map: instance scope
-                    ast.VnodeAdmin, ast.CompactStmt, ast.FlushStmt)
+                    ast.VnodeAdmin, ast.CompactStmt, ast.FlushStmt,
+                    # BACKUP/RESTORE move whole databases through the
+                    # shared archive store and wipe/install vnode dirs
+                    ast.BackupStmt, ast.RestoreStmt)
 
     def _check_privilege(self, stmt, session: Session):
         """RBAC gate (reference auth/auth_control.rs AccessControlImpl →
@@ -927,6 +948,32 @@ class QueryExecutor:
                  np.array(texts, dtype=object),
                  np.array(users, dtype=object),
                  np.array(durs)])
+        if stmt.kind == "backups":
+            entries = []
+            for db in self.meta.list_databases(session.tenant):
+                entries.extend(
+                    self.meta.list_backups(f"{session.tenant}.{db}"))
+            entries.sort(key=lambda e: e["created_ts"])
+            import datetime as _dt
+
+            created = [_dt.datetime.fromtimestamp(
+                e["created_ts"], _dt.timezone.utc).isoformat()
+                for e in entries]
+            return ResultSet(
+                ["backup_id", "database", "incremental", "created_at",
+                 "vnodes", "objects_uploaded", "objects_reused", "bytes"],
+                [np.array([e["id"] for e in entries], dtype=object),
+                 np.array([e["owner"].split(".", 1)[1] for e in entries],
+                          dtype=object),
+                 np.array([bool(e["incremental"]) for e in entries],
+                          dtype=bool),
+                 np.array(created, dtype=object),
+                 np.array([e["vnodes"] for e in entries], dtype=np.int64),
+                 np.array([e["objects_uploaded"] for e in entries],
+                          dtype=np.int64),
+                 np.array([e["objects_reused"] for e in entries],
+                          dtype=np.int64),
+                 np.array([e["bytes"] for e in entries], dtype=np.int64)])
         if stmt.kind == "streams":
             se = self.stream_engine()
             names = sorted(se.streams)

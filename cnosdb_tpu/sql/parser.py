@@ -352,6 +352,30 @@ class Parser:
             else:
                 database, name = None, self.expect_ident()
             return ast.RecoverStmt(kind.lower(), name, database)
+        if k == "BACKUP":
+            # BACKUP DATABASE <n> [INCREMENTAL]
+            self.next()
+            self.expect_kw("DATABASE")
+            name = self.expect_ident()
+            return ast.BackupStmt(name,
+                                  incremental=self.accept_kw("INCREMENTAL"))
+        if k == "RESTORE":
+            # RESTORE DATABASE <n> [FROM '<backup_id>']
+            #   [TO TIMESTAMP <ns>|'<RFC3339>'] [AS <new_name>]
+            self.next()
+            self.expect_kw("DATABASE")
+            stmt = ast.RestoreStmt(self.expect_ident())
+            if self.accept_kw("FROM"):
+                stmt.backup_id = self.expect_string()
+            if self.accept_kw("TO"):
+                self.expect_kw("TIMESTAMP")
+                if self.peek().kind == "string":
+                    stmt.to_ts = parse_timestamp_string(self.expect_string())
+                else:
+                    stmt.to_ts = int(self.expect_number())
+            if self.accept_kw("AS"):
+                stmt.new_name = self.expect_ident()
+            return stmt
         if k == "COMPACT":
             self.next()
             if self.accept_kw("VNODE"):
@@ -1330,6 +1354,9 @@ class Parser:
         if k == "QUERIES":
             self.next()
             return ast.ShowStmt("queries")
+        if k == "BACKUPS":
+            self.next()
+            return ast.ShowStmt("backups")
         if k == "STREAMS":
             self.next()
             return ast.ShowStmt("streams")

@@ -61,26 +61,42 @@ def system_table(executor, db: str, table: str, session) -> tuple[list[str], lis
         if t == "tables":
             # column set and values follow the reference
             # (information_schema_provider/builder/tables.rs: table_type
-            # TABLE, engine TSKV/EXTERNAL/STREAM, options 'TODO')
+            # TABLE, engine TSKV/EXTERNAL/STREAM) — except table_options,
+            # where the reference emits the literal 'TODO'; here each
+            # engine's stored spec is rendered for real
             rows = []
             for dbn in meta.list_databases(session.tenant):
                 owner = f"{session.tenant}.{dbn}"
+                o = meta.database(session.tenant, dbn).options
+                tskv_opts = _render_options({
+                    "ttl": o.ttl.humantime(), "shard": o.shard_num,
+                    "vnode_duration": o.vnode_duration.humantime(),
+                    "replica": o.replica, "precision": o.precision.name})
                 # tskv tables only — externals are listed below with
                 # their own engine tag (list_tables merges both for
                 # SHOW TABLES, which would double-list here)
                 for tn in sorted(meta.tables.get(owner, {})):
                     rows.append((session.tenant, dbn, tn, "TABLE", "TSKV",
-                                 "TODO"))
-                for tn in sorted(getattr(meta, "externals", {})
-                                 .get(owner, {})):
+                                 tskv_opts))
+                for tn, spec in sorted(getattr(meta, "externals", {})
+                                       .get(owner, {}).items()):
                     rows.append((session.tenant, dbn, tn, "TABLE",
-                                 "EXTERNAL", "TODO"))
+                                 "EXTERNAL", _render_options({
+                                     "path": spec.get("path", ""),
+                                     "format": spec.get("fmt", "csv"),
+                                     "header": spec.get("header", True),
+                                     **spec.get("options", {})})))
             for key, st in sorted(getattr(meta, "stream_tables",
                                           {}).items()):
                 tenant, dbn, name = key.split(".", 2)
                 if tenant != session.tenant:
                     continue
-                rows.append((tenant, dbn, name, "TABLE", "STREAM", "TODO"))
+                rows.append((tenant, dbn, name, "TABLE", "STREAM",
+                             _render_options({
+                                 "db": st.get("db", ""),
+                                 "table": st.get("table", ""),
+                                 "event_time_column":
+                                     st.get("event_time_column", "")})))
             return _cols(["table_tenant", "table_database", "table_name",
                           "table_type", "table_engine", "table_options"],
                          rows)
@@ -236,6 +252,17 @@ def system_table(executor, db: str, table: str, session) -> tuple[list[str], lis
                 rows.append((owner, vid, v.wal.total_size()))
             return _cols(["owner", "vnode_id", "wal_bytes"], rows)
     raise TableNotFound(f"{db}.{table}")
+
+
+def _render_options(opts: dict) -> str:
+    """Deterministic `k=v,...` rendering (sorted keys, SQL-style bools)
+    for the table_options column."""
+    def val(v):
+        if isinstance(v, bool):
+            return "true" if v else "false"
+        return v
+
+    return ",".join(f"{k}={val(v)}" for k, v in sorted(opts.items()))
 
 
 def _size_str(v) -> str:

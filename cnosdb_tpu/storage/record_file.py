@@ -103,6 +103,26 @@ class RecordWriter:
             self._f.close()
 
 
+def iter_records(buf: bytes) -> Iterator[bytes]:
+    """Yield record payloads from an in-memory record-file image with the
+    same stop-at-tear/corruption semantics as RecordReader. The DR plane
+    (storage/backup.py) decodes archived WAL segments straight from
+    object-store bytes through this."""
+    off = len(FILE_MAGIC)
+    n = len(buf)
+    while off + _HDR.size <= n:
+        ln, crc = _HDR.unpack_from(buf, off)
+        start = off + _HDR.size
+        end = start + ln
+        if end > n:
+            break  # torn tail
+        payload = buf[start:end]
+        if zlib.crc32(payload) != crc:
+            break  # corruption: stop replay here
+        yield payload
+        off = end
+
+
 class RecordReader:
     def __init__(self, path: str):
         self.path = path
@@ -112,20 +132,7 @@ class RecordReader:
             raise StorageError("bad record file magic", path=path)
 
     def __iter__(self) -> Iterator[bytes]:
-        off = len(FILE_MAGIC)
-        buf = self._buf
-        n = len(buf)
-        while off + _HDR.size <= n:
-            ln, crc = _HDR.unpack_from(buf, off)
-            start = off + _HDR.size
-            end = start + ln
-            if end > n:
-                break  # torn tail
-            payload = buf[start:end]
-            if zlib.crc32(payload) != crc:
-                break  # corruption: stop replay here
-            yield payload
-            off = end
+        yield from iter_records(self._buf)
 
     def records(self) -> list[bytes]:
         return list(self)

@@ -232,6 +232,16 @@ def cold_ids(dir_path: str) -> frozenset[int]:
     return frozenset(cold_map(dir_path))
 
 
+def cold_objects(dir_path: str) -> list[str]:
+    """The tiering-store object keys a vnode's cold files reference. The
+    DR manifest (storage/backup.py) records these as referenced-not-
+    copied: a restored vnode keeps reading the SAME tiering objects
+    through the cold.json it restored (entries carry full keys, so a
+    restore onto a different vnode id still resolves them), which keeps
+    backups incremental over cold data."""
+    return sorted(e["key"] for e in cold_map(dir_path).values())
+
+
 def _registry_write(dir_path: str, m: dict[int, dict]) -> None:
     """Install a full registry image atomically (tmp + fsync + rename).
     The `tiering.registry` fault point sits between the durable tmp and

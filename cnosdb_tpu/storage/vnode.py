@@ -65,6 +65,11 @@ class VnodeStorage:
         self.summary = Summary(dir_path)
         self.index = TSIndex(os.path.join(dir_path, "index"))
         self.wal = Wal(os.path.join(dir_path, "wal"), sync_on_append=wal_sync)
+        # DR plane: attach the WAL archiver BEFORE replay — replay can
+        # flush, flush purges, and the purge fence must already be up
+        from . import backup as _backup
+        if _backup.archive_enabled():
+            _backup.attach_vnode(self)
         self.active = MemCache(vnode_id, memcache_bytes)
         self.immutables: list[MemCache] = []
         self.picker = picker or Picker()

@@ -8,25 +8,29 @@ seq > flushed watermark. The replication layer stores its raft entries
 through this same API, so there is exactly one durable log per vnode.
 
 Entry record layout (inside a record-file payload):
-    seq u64 | entry_type u8 | term u64 | data...
+    seq u64 | entry_type u8 | term u64 | ts u64 | data...
 
 `term` is 0 for unreplicated vnodes; the raft layer stores its term here so
-one durable log serves both recovery paths.
+one durable log serves both recovery paths. `ts` is the wall-clock append
+time in ns — the disaster-recovery plane (storage/backup.py) replays
+archived entries "up to TIMESTAMP T" by this stamp, so it rides every
+entry rather than living in a side channel.
 """
 from __future__ import annotations
 
 import os
 import re
 import struct
+import time
 from dataclasses import dataclass
 
 from .. import faults
 from ..utils import stages
 from ..errors import WalError
-from .record_file import RecordReader, RecordWriter
+from .record_file import FILE_MAGIC, RecordReader, RecordWriter
 
 SEGMENT_PATTERN = re.compile(r"^wal_(\d{10})\.log$")
-_ENTRY_HDR = struct.Struct("<QBQ")
+_ENTRY_HDR = struct.Struct("<QBQQ")
 
 faults.register_point("wal.append", __name__,
                       desc="WAL entry append (torn-tail site)")
@@ -50,14 +54,16 @@ class WalEntry:
     entry_type: int
     data: bytes
     term: int = 0
+    ts: int = 0          # wall-clock append time, ns (PITR replay bound)
 
     def encode(self) -> bytes:
-        return _ENTRY_HDR.pack(self.seq, self.entry_type, self.term) + self.data
+        return _ENTRY_HDR.pack(self.seq, self.entry_type, self.term,
+                               self.ts) + self.data
 
     @classmethod
     def decode(cls, payload: bytes) -> "WalEntry":
-        seq, et, term = _ENTRY_HDR.unpack_from(payload, 0)
-        return cls(seq, et, payload[_ENTRY_HDR.size:], term)
+        seq, et, term, ts = _ENTRY_HDR.unpack_from(payload, 0)
+        return cls(seq, et, payload[_ENTRY_HDR.size:], term, ts)
 
 
 class Wal:
@@ -74,6 +80,12 @@ class Wal:
         self._min_seq = 1
         self._writer: RecordWriter | None = None
         self.purge_listeners: list = []  # called with (seq) after purge_to
+        # DR hooks (storage/backup.py): seal_listeners fire with the
+        # sealed segment id after every roll (archive trigger);
+        # archive_fence(seg_id)->bool gates purge_to so GC can never
+        # outrun the archived watermark. Both default to seed behavior.
+        self.seal_listeners: list = []
+        self.archive_fence = None
         if self._segments:
             entries = list(self.replay())
             if entries:
@@ -132,8 +144,26 @@ class Wal:
             faults.fire("wal.roll", dir=self.dir)
         self._writer.close()
         self._persist_tail_marker()
-        self._segments.append(self._segments[-1] + 1)
+        sealed = self._segments[-1]
+        self._segments.append(sealed + 1)
         self._writer = RecordWriter(self._seg_path(self._segments[-1]))
+        # archive trigger: a failed upload must never fail the write path
+        # (catch_up() re-archives later); crash-action faults still fire
+        for cb in self.seal_listeners:
+            try:
+                cb(sealed)
+            except Exception:
+                stages.count_error("swallow.wal.seal_listener")
+
+    def seal_active(self) -> int | None:
+        """Force-roll the active segment so its entries become archivable
+        (BACKUP's consistency cut). → sealed segment id, or None when the
+        active segment holds no entries."""
+        if self._writer is None or self._writer.size <= len(FILE_MAGIC):
+            return None
+        sealed = self._segments[-1]
+        self._roll()
+        return sealed
 
     # -- append/replay ---------------------------------------------------
     @property
@@ -156,7 +186,7 @@ class Wal:
         if faults.ENABLED:
             faults.fire("wal.append", dir=self.dir, seq=seq,
                         entry_type=entry_type)
-        e = WalEntry(seq, entry_type, data, term)
+        e = WalEntry(seq, entry_type, data, term, time.time_ns())
         self._writer.append(e.encode())
         if self.sync_on_append:
             self._writer.sync()
@@ -212,8 +242,14 @@ class Wal:
         segs = self._list_segments()
         # Delete only segments provably below the watermark; unreadable
         # segments and everything after them are kept (log order matters),
-        # as is the active segment.
+        # as is the active segment. The archive fence additionally keeps
+        # any segment not yet uploaded — and everything after it, since
+        # deleting later segments around a retained one would tear the
+        # archived log's order.
         for seg in segs[:-1]:
+            if self.archive_fence is not None \
+                    and not self._fence_allows(seg):
+                break
             try:
                 max_seq = 0
                 for payload in RecordReader(self._seg_path(seg)):
@@ -228,6 +264,16 @@ class Wal:
                 cb(seq)
             except Exception:
                 stages.count_error("swallow.wal.purge_listener")
+
+    def _fence_allows(self, seg: int) -> bool:
+        """A fence that errors fails CLOSED (segment kept): dropping WAL
+        bytes on an archiver hiccup is the exact data loss the fence
+        exists to prevent."""
+        try:
+            return bool(self.archive_fence(seg))
+        except Exception:
+            stages.count_error("swallow.wal.archive_fence")
+            return False
 
     def total_size(self) -> int:
         return sum(os.path.getsize(self._seg_path(s)) for s in self._list_segments())

@@ -16,8 +16,10 @@ import base64
 import datetime
 import hashlib
 import hmac
+import html
 import json
 import os
+import re
 import time
 import urllib.error
 import urllib.parse
@@ -221,6 +223,30 @@ def _range_header(offset: int, length: int) -> str:
     return f"bytes={offset}-{offset + length - 1}"
 
 
+def _xml_texts(tag: str, body: bytes) -> list[str]:
+    """Text of every <tag>…</tag> in a listing response. The list XML
+    bodies are flat (no attributes on these elements, text-only
+    content), so a scan beats dragging in a namespace-aware parser."""
+    return [html.unescape(m) for m in
+            re.findall(rf"<{tag}>([^<]*)</{tag}>",
+                       body.decode("utf-8", "replace"))]
+
+
+def _xml_text(tag: str, body: bytes) -> str | None:
+    hits = _xml_texts(tag, body)
+    return hits[0] if hits and hits[0] else None
+
+
+def _delete_listed(store, prefix: str) -> int:
+    """Shared delete_prefix: page through list_prefix, delete each key
+    (every request rides the per-call retry/deadline path). → keys
+    deleted."""
+    keys = store.list_prefix(prefix)
+    for k in keys:
+        store.delete(k)
+    return len(keys)
+
+
 def _slice_range(status: int, body: bytes, offset: int, length: int) -> bytes:
     """Normalize a ranged GET: 206 bodies are the requested window; a
     server that ignored Range answers 200 with the whole object, which we
@@ -295,6 +321,28 @@ class LocalStore:
                 pass   # idempotent delete, like the HTTP stores' 404
         return self._retrying(fn, "objstore.put", key)
 
+    def list_prefix(self, prefix: str) -> list[str]:
+        """Every key (filesystem path) under `prefix`, sorted. Local keys
+        ARE paths, so the walk root is the prefix's directory component
+        and matching is a plain string-prefix test — same contract as the
+        HTTP stores' paginated listings."""
+        def fn(hit):
+            base = prefix if os.path.isdir(prefix) \
+                else os.path.dirname(prefix)
+            if not base or not os.path.isdir(base):
+                return []
+            out = []
+            for root, _dirs, names in os.walk(base):
+                for name in names:
+                    p = os.path.join(root, name)
+                    if p.startswith(prefix):
+                        out.append(p)
+            return sorted(out)
+        return self._retrying(fn, "objstore.get", prefix)
+
+    def delete_prefix(self, prefix: str) -> int:
+        return _delete_listed(self, prefix)
+
 
 # ---------------------------------------------------------------------------
 # AWS S3 — SigV4 request signing (stdlib hmac/sha256)
@@ -326,9 +374,13 @@ class S3Store:
         return self.base + path, path
 
     def _signed_headers(self, method: str, path: str, body: bytes,
-                        now: datetime.datetime | None = None) -> dict:
+                        now: datetime.datetime | None = None,
+                        query: str = "") -> dict:
         """AWS Signature Version 4 (the algorithm object_store's
-        AmazonS3Builder clients implement; anonymous when no key is set)."""
+        AmazonS3Builder clients implement; anonymous when no key is set).
+        `query` is the already-canonical query string (keys sorted,
+        values URI-encoded) for sub-resource requests like ListObjectsV2
+        — it must be byte-identical to what goes on the wire."""
         host = urllib.parse.urlparse(self.base).netloc
         payload_hash = hashlib.sha256(body or b"").hexdigest()
         headers = {"host": host, "x-amz-content-sha256": payload_hash}
@@ -342,7 +394,7 @@ class S3Store:
             headers["x-amz-security-token"] = self.token
         signed = ";".join(sorted(headers))
         canonical = "\n".join([
-            method, path, "",
+            method, path, query,
             *[f"{k}:{headers[k].strip()}" for k in sorted(headers)],
             "", signed, payload_hash])
         scope = f"{datestamp}/{self.region}/s3/aws4_request"
@@ -388,6 +440,32 @@ class S3Store:
         url, path = self._url_and_path(key)
         _http("DELETE", url, self._signed_headers("DELETE", path, b""),
               None, fault_point="objstore.put", key=key)
+
+    def list_prefix(self, prefix: str) -> list[str]:
+        """ListObjectsV2, paginated via continuation-token. The query
+        string is part of the SigV4 canonical request, so it is built
+        once in canonical form and signed byte-identical."""
+        out: list[str] = []
+        token: str | None = None
+        path = f"/{self.bucket}" if self.path_style else "/"
+        while True:
+            params = {"list-type": "2", "prefix": prefix}
+            if token:
+                params["continuation-token"] = token
+            query = "&".join(
+                f"{urllib.parse.quote(k, safe='-_.~')}="
+                f"{urllib.parse.quote(v, safe='-_.~')}"
+                for k, v in sorted(params.items()))
+            headers = self._signed_headers("GET", path, b"", query=query)
+            body = _http("GET", f"{self.base}{path}?{query}", headers,
+                         None, fault_point="objstore.get", key=prefix)
+            out.extend(_xml_texts("Key", body))
+            token = _xml_text("NextContinuationToken", body)
+            if not token:
+                return out
+
+    def delete_prefix(self, prefix: str) -> int:
+        return _delete_listed(self, prefix)
 
 
 # ---------------------------------------------------------------------------
@@ -481,6 +559,27 @@ class GcsStore:
         _http("DELETE", url, self._auth(), None,
               fault_point="objstore.put", key=key)
 
+    def list_prefix(self, prefix: str) -> list[str]:
+        """JSON-API object listing, paginated via nextPageToken."""
+        out: list[str] = []
+        token: str | None = None
+        while True:
+            params = {"prefix": prefix}
+            if token:
+                params["pageToken"] = token
+            url = (f"{self.base}/storage/v1/b/{self.bucket}/o?"
+                   + urllib.parse.urlencode(sorted(params.items())))
+            raw = _http("GET", url, self._auth(), None,
+                        fault_point="objstore.get", key=prefix)
+            d = json.loads(raw)
+            out.extend(item["name"] for item in d.get("items", []))
+            token = d.get("nextPageToken")
+            if not token:
+                return out
+
+    def delete_prefix(self, prefix: str) -> int:
+        return _delete_listed(self, prefix)
+
 
 # ---------------------------------------------------------------------------
 # Azure Blob — SharedKey signing (or bearer token / azurite emulator)
@@ -506,7 +605,9 @@ class AzblobStore:
             self.base = f"https://{self.account}.blob.core.windows.net"
 
     def _headers(self, method: str, key: str, body: bytes | None,
-                 extra: dict | None = None) -> dict:
+                 extra: dict | None = None,
+                 url_path: str | None = None,
+                 params: dict | None = None) -> dict:
         now = datetime.datetime.now(datetime.timezone.utc) \
             .strftime("%a, %d %b %Y %H:%M:%S GMT")
         headers = {"x-ms-date": now, "x-ms-version": "2021-08-06"}
@@ -533,8 +634,15 @@ class AzblobStore:
         canon_headers = "".join(
             f"{k}:{headers[k]}\n" for k in sorted(headers)
             if k.startswith("x-ms-"))
-        url_path = urllib.parse.urlparse(self._url(key)).path
+        if url_path is None:
+            url_path = urllib.parse.urlparse(self._url(key)).path
         canon_resource = f"/{self.account}{url_path}"
+        if params:
+            # query params join CanonicalizedResource as sorted
+            # lowercase "\nkey:value" lines (Storage SharedKey spec) —
+            # container listings are unforgeable only if signed
+            canon_resource += "".join(
+                f"\n{k.lower()}:{params[k]}" for k in sorted(params))
         to_sign = "\n".join([
             method, "", "", length, "", content_type, "", "", "", "", "",
             "",
@@ -569,3 +677,32 @@ class AzblobStore:
         _http("DELETE", self._url(key),
               self._headers("DELETE", key, None), None,
               fault_point="objstore.put", key=key)
+
+    def list_prefix(self, prefix: str) -> list[str]:
+        """Container blob listing (restype=container&comp=list),
+        paginated via NextMarker; the query params ride inside the
+        SharedKey CanonicalizedResource."""
+        out: list[str] = []
+        marker: str | None = None
+        container_path = urllib.parse.urlparse(
+            f"{self.base}/{self.container}").path
+        while True:
+            params = {"restype": "container", "comp": "list",
+                      "prefix": prefix}
+            if marker:
+                params["marker"] = marker
+            query = urllib.parse.urlencode(sorted(params.items()))
+            headers = self._headers("GET", "", None,
+                                    url_path=container_path,
+                                    params=params)
+            body = _http("GET",
+                         f"{self.base}/{self.container}?{query}",
+                         headers, None,
+                         fault_point="objstore.get", key=prefix)
+            out.extend(_xml_texts("Name", body))
+            marker = _xml_text("NextMarker", body)
+            if not marker:
+                return out
+
+    def delete_prefix(self, prefix: str) -> int:
+        return _delete_listed(self, prefix)

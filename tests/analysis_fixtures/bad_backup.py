@@ -1,0 +1,26 @@
+"""Fixture: DR-plane exits that skip (op, outcome) accounting (lines
+10 and 20). Mirrors the guarded function names so the rule finds its
+targets when scope is ignored; the counted return at 12-13, the
+accounting-on-previous-line raise at 23-24, and both terminal returns
+are legal shapes and must stay silent."""
+
+
+def archive_segment(seg_id, archived, _count_backup):
+    if seg_id in archived:
+        return False
+    if seg_id < 0:
+        _count_backup("archive", "bad_segment")
+        return False
+    return True
+
+
+def restore_backup(catalog, backup_id, _count_backup):
+    entry = [e for e in catalog if e["id"] == backup_id]
+    if not entry:
+        raise ValueError("no such backup")
+    for vn in entry[0]["vnodes"]:
+        if vn.get("torn"):
+            _count_backup("restore", "torn_vnode")
+            raise ValueError("torn manifest vnode")
+    _count_backup("restore", "ok")
+    return entry[0]

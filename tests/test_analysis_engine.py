@@ -46,6 +46,7 @@ _CASES = [
     ("bad_string_filter.py", rules_mod.StringFilterAccounting(), [10, 21]),
     ("bad_cold_tier.py", rules_mod.ColdTierAccounting(), [10, 20]),
     ("bad_serving.py", rules_mod.ServingAccounting(), [10, 20]),
+    ("bad_backup.py", rules_mod.BackupAccounting(), [10, 20]),
     ("bad_fault_site.py", rules_mod.FaultSiteCoverage(), [10, 11]),
     # interprocedural rule family (cnosdb_tpu/analysis/interproc.py)
     ("bad_host_sync.py", interproc.HostSync(), [8, 9, 10, 11]),

@@ -226,7 +226,8 @@ def test_fault_point_registry_covers_every_site():
                     "wal.sync", "wal.roll", "flush.run", "compaction.run",
                     "tsm.write", "scrub.read", "objstore.get",
                     "objstore.put", "matview.persist", "tiering.registry",
-                    "serving.invalidate"}
+                    "serving.invalidate", "backup.archive",
+                    "backup.manifest", "restore.install"}
     cluster = set(faults.registered_points(scope="cluster"))
     assert cluster == {"rpc.send", "rpc.response", "rpc.server",
                        "rpc.reply", "meta.propose", "meta.apply"}

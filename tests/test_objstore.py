@@ -6,6 +6,8 @@ import base64
 import datetime
 import hashlib
 import hmac
+import json
+import os
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
@@ -32,12 +34,62 @@ class _FakeStore(BaseHTTPRequestHandler):
 
         return urllib.parse.unquote(self.path.split("?")[0])
 
+    def _query(self):
+        import urllib.parse
+
+        if "?" not in self.path:
+            return {}
+        qs = urllib.parse.parse_qs(self.path.split("?", 1)[1])
+        return {k: v[0] for k, v in qs.items()}
+
+    def _list(self, qs):
+        """List endpoint for all three dialects, paginated at
+        server.page_size keys per response (continuation-token /
+        pageToken / marker are all a plain start index here)."""
+        base = self._key().rstrip("/")
+        names = sorted(k[len(base) + 1:] for k in self.server.blobs
+                       if k.startswith(base + "/"))
+        names = [n for n in names if n.startswith(qs.get("prefix", ""))]
+        start = int(qs.get("continuation-token") or qs.get("pageToken")
+                    or qs.get("marker") or 0)
+        page = names[start:start + self.server.page_size]
+        nxt = str(start + len(page)) \
+            if start + len(page) < len(names) else ""
+        if "list-type" in qs:                     # S3 ListObjectsV2
+            keys = "".join(f"<Contents><Key>{n}</Key></Contents>"
+                           for n in page)
+            if nxt:
+                keys += (f"<NextContinuationToken>{nxt}"
+                         "</NextContinuationToken>")
+            payload = f"<ListBucketResult>{keys}</ListBucketResult>".encode()
+        elif qs.get("comp") == "list":            # Azure container listing
+            keys = "".join(f"<Blob><Name>{n}</Name></Blob>" for n in page)
+            payload = (f"<EnumerationResults><Blobs>{keys}</Blobs>"
+                       f"<NextMarker>{nxt}</NextMarker>"
+                       "</EnumerationResults>").encode()
+        else:                                     # GCS JSON API
+            d = {"items": [{"name": n} for n in page]}
+            if nxt:
+                d["nextPageToken"] = nxt
+            payload = json.dumps(d).encode()
+        self.send_response(200)
+        self.send_header("Content-Length", str(len(payload)))
+        self.end_headers()
+        self.wfile.write(payload)
+
     def do_GET(self):
         self.server.requests.append(
             ("GET", self.path, {k.lower(): v for k, v in self.headers.items()}))
         if self.server.fail_statuses:
             self.send_response(self.server.fail_statuses.pop(0))
             self.end_headers()
+            return
+        qs = self._query()
+        path_noq = self.path.split("?")[0]
+        if ("list-type" in qs or qs.get("comp") == "list"
+                or (path_noq.startswith("/storage/v1/b/")
+                    and path_noq.endswith("/o"))):
+            self._list(qs)
             return
         blob = self.server.blobs.get(self._key())
         if blob is None:
@@ -72,6 +124,16 @@ class _FakeStore(BaseHTTPRequestHandler):
         self.send_response(200)
         self.end_headers()
 
+    def do_DELETE(self):
+        self.server.requests.append(
+            ("DELETE", self.path,
+             {k.lower(): v for k, v in self.headers.items()}))
+        if self.server.blobs.pop(self._key(), None) is None:
+            self.send_response(404)
+        else:
+            self.send_response(204)
+        self.end_headers()
+
     def do_POST(self):  # GCS media upload
         self.server.requests.append(("POST", self.path, dict(self.headers)))
         n = int(self.headers.get("Content-Length", 0))
@@ -96,6 +158,7 @@ def fake(request):
     srv.requests = []
     srv.fail_statuses = []
     srv.ignore_range = False
+    srv.page_size = 1000
     t = threading.Thread(target=srv.serve_forever, daemon=True)
     t.start()
     yield srv
@@ -320,6 +383,111 @@ def test_injected_put_fault_exhausts_budget(tmp_path, monkeypatch):
             st.put(str(tmp_path / "f.bin"), b"data")
     finally:
         faults.reset()
+
+
+# ---------------------------------------------------------------------------
+# prefix listing + bulk delete (WAL-archive / backup-catalog GC path)
+# ---------------------------------------------------------------------------
+def test_local_list_and_delete_prefix(tmp_path):
+    st = objstore.LocalStore()
+    base = str(tmp_path / "arch")
+    for name in ("wal/1/a.log", "wal/1/b.log", "wal/2/c.log", "obj/x"):
+        st.put(os.path.join(base, name), b"d")
+    pfx = os.path.join(base, "wal", "1") + os.sep
+    assert st.list_prefix(pfx) == sorted(
+        os.path.join(base, n) for n in ("wal/1/a.log", "wal/1/b.log"))
+    assert st.list_prefix(os.path.join(base, "nothing") + os.sep) == []
+    assert st.delete_prefix(pfx) == 2
+    assert st.list_prefix(pfx) == []
+    assert st.get(os.path.join(base, "obj/x")) == b"d"   # sibling untouched
+
+
+def test_s3_list_prefix_paginates(fake):
+    st = objstore.S3Store("bkt", endpoint_url=_endpoint(fake),
+                          access_key_id="AK", secret_key="SK")
+    keys = [f"wal/0/seg_{i:03d}.log" for i in range(5)]
+    for k in keys:
+        st.put(k, b"x")
+    st.put("other/zzz", b"x")
+    fake.page_size = 2
+    assert st.list_prefix("wal/0/") == keys
+    # 5 keys at 2/page → 3 signed GETs, continuation-token carried through
+    lists = [r for r in fake.requests
+             if r[0] == "GET" and "list-type=2" in r[1]]
+    assert len(lists) == 3
+    assert "continuation-token" in lists[1][1]
+    assert all(h["authorization"].startswith("AWS4-HMAC-SHA256")
+               for _, _, h in lists)
+
+
+def test_s3_delete_prefix(fake):
+    st = objstore.S3Store("bkt", endpoint_url=_endpoint(fake))
+    for i in range(3):
+        st.put(f"wal/0/{i}.log", b"x")
+    st.put("keep", b"x")
+    assert st.delete_prefix("wal/0/") == 3
+    assert st.list_prefix("wal/0/") == []
+    assert st.get("keep") == b"x"
+
+
+def test_gcs_list_prefix_paginates(fake):
+    st = objstore.GcsStore("bkt", gcs_base_url=_endpoint(fake),
+                           disable_oauth=True)
+    for i in range(4):
+        st.put(f"m/{i}", b"x")
+    st.put("n/0", b"x")
+    fake.page_size = 3
+    assert st.list_prefix("m/") == [f"m/{i}" for i in range(4)]
+    lists = [r for r in fake.requests if r[0] == "GET" and "/o?" in r[1]]
+    assert len(lists) == 2 and "pageToken" in lists[1][1]
+    assert st.delete_prefix("m/") == 4
+    assert st.list_prefix("m/") == []
+
+
+def test_azblob_list_and_delete_prefix(fake):
+    key = base64.b64encode(b"storage-account-key").decode()
+    st = objstore.AzblobStore("ctr", account="acct", access_key=key,
+                              endpoint_url=_endpoint(fake))
+    for i in range(3):
+        st.put(f"wal/{i}.log", b"x")
+    st.put("keep.bin", b"x")
+    fake.page_size = 2
+    assert st.list_prefix("wal/") == [f"wal/{i}.log" for i in range(3)]
+    lists = [r for r in fake.requests
+             if r[0] == "GET" and "comp=list" in r[1]]
+    assert len(lists) == 2 and "marker=" in lists[1][1]
+    # the listing is signed (query params ride CanonicalizedResource)
+    assert all(h["authorization"].startswith("SharedKey acct:")
+               for _, _, h in lists)
+    assert st.delete_prefix("wal/") == 3
+    assert st.get("keep.bin") == b"x"
+    with pytest.raises(objstore.ObjectStoreError, match="404"):
+        st.get("wal/0.log")
+
+
+def test_list_prefix_rides_get_retry_path(fake, monkeypatch):
+    from cnosdb_tpu import faults
+
+    monkeypatch.setenv("CNOSDB_OBJSTORE_RETRIES", "4")
+    st = objstore.S3Store("bkt", endpoint_url=_endpoint(fake))
+    st.put("p/a", b"x")
+    faults.configure("seed=1;objstore.get:fail:times=2")
+    try:
+        assert st.list_prefix("p/") == ["p/a"]
+        log = [f for f in faults.fired_log() if f[0] == "objstore.get"]
+        assert len(log) == 2
+    finally:
+        faults.reset()
+
+
+def test_list_prefix_retries_5xx_mid_pagination(fake, monkeypatch):
+    monkeypatch.setenv("CNOSDB_OBJSTORE_RETRIES", "2")
+    st = objstore.S3Store("bkt", endpoint_url=_endpoint(fake))
+    for i in range(3):
+        st.put(f"p/{i}", b"x")
+    fake.page_size = 2
+    fake.fail_statuses = [503]       # first page throttled once
+    assert st.list_prefix("p/") == ["p/0", "p/1", "p/2"]
 
 
 # ---------------------------------------------------------------------------
