@@ -350,7 +350,11 @@ def _guard_degraded_relay():
         return
     from cnosdb_tpu.utils.relay import cleaned_cpu_env, probe_jax_importable
 
-    verdict = probe_jax_importable()
+    # cap the probe: a dead relay should cost seconds of the bench
+    # budget, not the full 120 s subprocess default (the driver's
+    # whole-bench timeout eats the difference otherwise)
+    cap = float(os.environ.get("CNOSDB_BENCH_PROBE_TIMEOUT", "45"))
+    verdict = probe_jax_importable(timeout=cap)
     if verdict is None:
         return
     # re-exec is safe here (bench.py is a top-level script, argv is real);
@@ -825,8 +829,6 @@ def main():
         chaos_results = {}
         if os.environ.get("CNOSDB_BENCH_CHAOS", "1") != "0":
             try:
-                import tempfile
-
                 from cnosdb_tpu.chaos import sweep as chaos_sweep
 
                 with tempfile.TemporaryDirectory() as chaos_dir:

@@ -165,7 +165,12 @@ def _run(executor, session, name, sql, check, results, errors,
     from cnosdb_tpu.utils import stages as _stages
 
     try:
-        executor.execute_one(sql, session)      # warm-up
+        # profile the warm-up too: it is the COLD pass, the only one the
+        # compressed-domain lane and the decoders actually run in — the
+        # timed pass below is served from the scan/result caches
+        cold_prof = _stages.QueryProfile() if stage_out is not None else None
+        with _stages.profile_scope(cold_prof):
+            executor.execute_one(sql, session)  # warm-up
         prof = _stages.QueryProfile() if stage_out is not None else None
         t0 = time.perf_counter()
         with _stages.profile_scope(prof):
@@ -175,11 +180,26 @@ def _run(executor, session, name, sql, check, results, errors,
             # aggregation/string-plane stages per query: group
             # cardinality, factorize cost, which DISTINCT path engaged,
             # string predicate routing + pages skipped, top-k routing
-            keep = {k: v for k, v in prof.snapshot().items()
+            snap = prof.snapshot()
+            keep = {k: v for k, v in snap.items()
                     if k in ("factorize_ms", "group_count",
                              "ngram_pages_skipped")
                     or k.startswith(("distinct_path", "string_path",
-                                     "topk."))}
+                                     "topk.", "compressed."))}
+            # compressed-domain visibility per query, read from the COLD
+            # pass: how many bytes the decode lanes actually touched, and
+            # whether the lane engaged at all (pages answered/skipped/
+            # masked from encoded form)
+            cold = cold_prof.snapshot()
+            for k, v in cold.items():
+                if k.startswith("compressed."):
+                    keep[k] = v
+            keep["bytes_materialized"] = int(
+                cold.get("compressed.bytes_materialized", 0))
+            keep["compressed_path"] = bool(
+                cold.get("compressed.pages_answered", 0)
+                or cold.get("compressed.pages_skipped", 0)
+                or cold.get("compressed.pages_masked", 0))
             if keep:
                 stage_out[name] = keep
         if check is not None:
@@ -944,6 +964,51 @@ def run_coldscan(executor, coord, tenant, db, session) -> dict:
         out["window_pages_pruned"] = snap.get(("prune", "pages_pruned"), 0)
         out["window_bytes_downloaded"] = snap.get(
             ("fetch", "bytes_downloaded"), 0)
+
+        # compressed-domain A/B on the cold half: a stats-answerable
+        # aggregate must come back bit-identical with the lane on and
+        # off (CNOSDB_COMPRESSED_DOMAIN=0 = the decode-lane oracle), and
+        # the lane run must download a fraction of the oracle's bytes —
+        # answered pages never leave the object store
+        from cnosdb_tpu.storage import compressed_domain as _cd
+
+        def cold_once(alias):
+            # a distinct alias per pass keeps the serving-plane result
+            # cache out of the A/B — same SQL text would be served from
+            # the token-revalidated cache with zero bytes downloaded
+            with coord._scan_cache_lock:
+                coord._scan_cache.clear()
+            tiering.block_cache_clear()
+            tiering.counters_reset()
+            t0 = time.perf_counter()
+            rs = executor.execute_one(
+                f"SELECT count(value) AS {alias} FROM cold_m", session)
+            ms = round((time.perf_counter() - t0) * 1e3, 2)
+            snap2 = tiering.cold_tier_snapshot()
+            return (int(np.sum(_col(rs, alias))), ms,
+                    snap2.get(("fetch", "bytes_downloaded"), 0))
+
+        before_cd = _cd.outcomes_snapshot()
+        lane_c, out["compressed_ms"], lane_dl = cold_once("c_lane")
+        after_cd = _cd.outcomes_snapshot()
+        out["compressed_pages_answered"] = sum(
+            n - before_cd.get(k, 0) for k, n in after_cd.items()
+            if k[0] in ("meta", "closed", "skip"))
+        prev_cd = os.environ.get("CNOSDB_COMPRESSED_DOMAIN")
+        os.environ["CNOSDB_COMPRESSED_DOMAIN"] = "0"
+        try:
+            oracle_c, out["compressed_oracle_ms"], oracle_dl = \
+                cold_once("c_oracle")
+        finally:
+            if prev_cd is None:
+                os.environ.pop("CNOSDB_COMPRESSED_DOMAIN", None)
+            else:
+                os.environ["CNOSDB_COMPRESSED_DOMAIN"] = prev_cd
+        assert lane_c == oracle_c == total["n"], "compressed A/B drift"
+        out["compressed_bytes_downloaded"] = lane_dl
+        out["compressed_oracle_bytes_downloaded"] = oracle_dl
+        out["compressed_bytes_ratio"] = round(
+            oracle_dl / max(lane_dl, 1), 1)
 
         timed()                               # refill the block cache
         tiering.counters_reset()

@@ -1000,6 +1000,87 @@ class FaultSiteCoverage(Rule):
                        "carry a reasoned lint disable")
 
 
+# --------------------------------------------------------------------------
+# 17. compressed-domain-accounting — new (PR 17): no silent lane bails
+# --------------------------------------------------------------------------
+_CDA_FUNCS = {
+    "cnosdb_tpu/storage/compressed_domain.py":
+        ("build_spec", "_classify", "_answer", "_page_row_mask"),
+}
+_CDA_ACCOUNTING = {"count_outcome", "_declined", "_mat"}
+
+
+def _cda_has_accounting(node: ast.AST) -> bool:
+    for n in ast.walk(node):
+        if isinstance(n, ast.Call) and _call_name(n) in _CDA_ACCOUNTING:
+            return True
+    return False
+
+
+def _cda_success_return(stmt: ast.AST) -> bool:
+    """``return <name>`` — handing back a computed result (a survivor
+    mask, a spec) is the accepted shape; bails return None / a literal
+    and must book why."""
+    return isinstance(stmt, ast.Return) and isinstance(stmt.value, ast.Name)
+
+
+class CompressedDomainAccounting(Rule):
+    name = "compressed-domain-accounting"
+    motivation = ("PR 17 compressed-domain lane: every page the lane "
+                  "declines to answer/skip/mask must book a (lane, "
+                  "reason) outcome — an unaccounted early return/raise "
+                  "is a silent fall-through to full decode, the exact "
+                  "regression cnosdb_compressed_domain_total exists to "
+                  "catch")
+
+    def applies_to(self, relpath):
+        return relpath in _CDA_FUNCS
+
+    def begin_module(self, ctx):
+        want = _CDA_FUNCS.get(ctx.relpath)
+        guarded = want is not None
+        if want is None:
+            # scope-ignored run (fixtures/self-tests): lint any function
+            # bearing a guarded name, but skip the presence check
+            want = tuple({n for names in _CDA_FUNCS.values()
+                          for n in names})
+        found = set()
+        for fn in ast.walk(ctx.tree):
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    or fn.name not in want:
+                continue
+            found.add(fn.name)
+            terminal = fn.body[-1]
+            for block in _dda_blocks(fn):
+                for i, stmt in enumerate(block):
+                    if not isinstance(stmt, (ast.Return, ast.Raise)) \
+                            or stmt is terminal:
+                        continue
+                    # accounting may land anywhere earlier in the same
+                    # block (skip exits bump counters between the book
+                    # and the return), or inside the return expression
+                    if _cda_has_accounting(stmt) \
+                            or _cda_success_return(stmt) \
+                            or any(_cda_has_accounting(prev)
+                                   for prev in block[:i]):
+                        continue
+                    kind = "return" if isinstance(stmt, ast.Return) \
+                        else "raise"
+                    ctx.report(self, stmt,
+                               f"unaccounted early {kind} in {fn.name} — "
+                               f"compressed-domain lane exits must book a "
+                               f"reason (count_outcome/_declined/_mat) so "
+                               f"silent full-decode fallbacks stay "
+                               f"visible on /metrics")
+        for name in want if guarded else ():
+            if name not in found:
+                ctx.report(self, 1,
+                           f"compressed-domain guarded function {name} "
+                           f"not found — if it was renamed, update "
+                           f"analysis/rules.py so the lint keeps "
+                           f"covering it")
+
+
 def all_rules() -> list:
     from .interproc import project_rules
 
@@ -1008,4 +1089,5 @@ def all_rules() -> list:
             WallclockDuration(), MetricsNaming(), StageCatalog(),
             DeviceDecodeAccounting(), StringFilterAccounting(),
             ColdTierAccounting(), ServingAccounting(), BackupAccounting(),
-            FaultSiteCoverage(), *project_rules()]
+            FaultSiteCoverage(), CompressedDomainAccounting(),
+            *project_rules()]

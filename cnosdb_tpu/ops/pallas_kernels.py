@@ -64,6 +64,12 @@ def disabled_reason() -> str | None:
             else "CNOSDB_TPU_PALLAS=1 but jax.experimental.pallas import failed"
     if mode in ("0", "off", "false"):
         return f"disabled by env CNOSDB_TPU_PALLAS={mode}"
+    probe = os.environ.get("CNOSDB_BENCH_PROBE")
+    if probe:
+        # bench.py re-exec'd this process on CPU jax after its start-of-
+        # bench relay probe failed; the verdict it stashed is the real
+        # answer ("scan device is cpu" would bury it)
+        return f"device probe failed at bench start: {probe}"
     if not PALLAS_AVAILABLE:
         return "jax.experimental.pallas import failed"
     from .placement import scan_device

@@ -43,6 +43,7 @@ per-row fallbacks are the regression this plane exists to remove.
 """
 from __future__ import annotations
 
+import functools
 import os
 import re
 import threading
@@ -237,13 +238,17 @@ def literal_runs(pattern: str) -> list[str]:
     return runs
 
 
+@functools.lru_cache(maxsize=512)
 def value_trigrams(s: str) -> tuple[bytes, ...]:
     """Required trigrams for string EQUALITY with `s` (no wildcard
-    semantics — a literal '%' in s is just a byte)."""
+    semantics — a literal '%' in s is just a byte). Memoized per
+    literal: the page-admit pass re-renders the same needle for every
+    page of every vnode it probes."""
     tris = _trigrams(s.encode("utf-8", "surrogatepass"))
     return tuple(sorted(tris)[:_MAX_QUERY_TRIGRAMS])
 
 
+@functools.lru_cache(maxsize=512)
 def required_trigrams(pattern: str) -> tuple[bytes, ...] | None:
     """Byte trigrams (over UTF-8) every LIKE match must contain, or None
     when the pattern has no ≥3-byte literal run (unusable for skipping).

@@ -687,13 +687,20 @@ class Coordinator:
                    tag_domains: ColumnDomains | None = None,
                    field_names: list[str] | None = None,
                    page_filter=None,
-                   fingerprint: str | None = None) -> list[ScanBatch]:
+                   fingerprint: str | None = None,
+                   compressed_spec=None) -> list[ScanBatch]:
         """Fan a scan out over placed vnodes → one ScanBatch per vnode.
 
         `page_filter` (optional sql.expr tree) lets the storage scan prune
         pages its statistics prove can't match — the returned batches then
         only cover filter-relevant rows, so callers MUST apply that same
         filter. Cache entries are keyed by the filter's rendering.
+        `compressed_spec` (storage/compressed_domain.CompressedSpec)
+        additionally engages the compressed-domain lane: batches may come
+        back with rows already dropped and `compressed_partials` attached
+        (possibly with ZERO rows and only partials) — valid ONLY for
+        queries with that exact spec, so engaged batches cache under a
+        spec-extended key.
         """
         # a soft-dropped (trashed) table's rows stay on disk for RECOVER
         # but must not be readable until then
@@ -737,7 +744,8 @@ class Coordinator:
                                          fingerprint=fingerprint)
             try:
                 return self._scan_local(split, field_names, page_constraints,
-                                        filter_key, n_threads)
+                                        filter_key, n_threads,
+                                        compressed_spec)
             except TsmError as e:
                 # cold-tier metadata damage (lost / corrupt skip-index
                 # sidecar): repairable in place from the object store —
@@ -749,7 +757,8 @@ class Coordinator:
                 log.warning("rebuilt cold sidecars on vnode %s after: %s",
                             split.vnode_id, e)
                 return self._scan_local(split, field_names, page_constraints,
-                                        filter_key, n_threads)
+                                        filter_key, n_threads,
+                                        compressed_spec)
             except ChecksumMismatch as e:
                 # corruption already quarantined + vnode marked BROKEN by
                 # _scan_local; fail the in-flight scan over to a replica
@@ -777,12 +786,17 @@ class Coordinator:
             results = executor.run_all("scan", one, splits)
         else:
             results = [one(s) for s in splits]
-        return [b for b in results if b is not None and b.n_rows]
+        # a 0-row batch can still carry the whole vnode's answer as
+        # compressed-domain partials — it must reach the executor's merge
+        return [b for b in results if b is not None
+                and (b.n_rows
+                     or getattr(b, "compressed_partials", None))]
 
     def _scan_local(self, split: PlacedSplit, field_names,
                     page_constraints: dict | None = None,
                     filter_key: str | None = None,
-                    n_threads: int = 1) -> ScanBatch | None:
+                    n_threads: int = 1,
+                    compressed_spec=None) -> ScanBatch | None:
         table, trs, doms = split.table, split.time_ranges, split.tag_domains
         v = self.engine.vnode(split.owner, split.vnode_id)
         if v is None:
@@ -813,6 +827,15 @@ class Coordinator:
                     sids_key)
         key = base_key + (filter_key,)
         key0 = base_key + (None,)
+        # a compressed-domain batch may have rows dropped / pre-answered
+        # that only THIS spec's filter+aggregates account for: it caches
+        # under a spec-extended key. The plain/pruned entries stay valid
+        # fallbacks for a spec'd query (superset + executor row filter),
+        # but never the reverse — NOTE filter_key alone is not enough:
+        # specs with different predicates can share a constraint
+        # rendering (e.g. bool conjuncts render no constraints at all).
+        spec_key = (base_key + (filter_key, compressed_spec.key)
+                    if compressed_spec is not None else None)
         from ..utils import stages
 
         # token BEFORE probe/decode: a write racing the decode makes the
@@ -820,8 +843,11 @@ class Coordinator:
         # dedup away), never stale
         token = v.scan_token()
         stale = None
+        probes = (key, key0) if filter_key else (key0,)
+        if spec_key is not None:
+            probes = (spec_key,) + probes
         with self._scan_cache_lock:
-            for k in ((key, key0) if filter_key else (key0,)):
+            for k in probes:
                 hit = self._scan_cache.get(k)
                 if hit is None:
                     continue
@@ -845,7 +871,8 @@ class Coordinator:
                                page_constraints=page_constraints,
                                n_threads=n_threads,
                                upload_hook=self._upload_hook(),
-                               decode_hook=self._decode_hook())
+                               decode_hook=self._decode_hook(),
+                               compressed_spec=compressed_spec)
         except ChecksumMismatch as e:
             # quarantine-on-read: drop the corrupt file from the live
             # Version (manifest-durable, excluded from every future scan),
@@ -855,7 +882,9 @@ class Coordinator:
             # so a remote scan_vnode RPC quarantines on the owning node.
             self._quarantine_on_read(split.owner, split.vnode_id, e)
             raise
-        if not getattr(b, "_pages_pruned", False):
+        if getattr(b, "_compressed_engaged", False):
+            key = spec_key   # lane-shaped batch: valid for this spec only
+        elif not getattr(b, "_pages_pruned", False):
             key = key0   # nothing pruned: the batch is the full scan
         self._cache_store(key, token, b)
         return b
@@ -876,6 +905,10 @@ class Coordinator:
             return None   # tombstones / tag re-keys: no delta can express
         if not (old.file_ids <= token.file_ids):
             return None   # files compacted away: cached rows may be gone
+        if getattr(cached, "_compressed_engaged", False):
+            # compressed-domain batches pre-answer pages as partials that
+            # a merge can't extend — only a full rescan is sound
+            return None
         new_fids = token.file_ids - old.file_ids
         if not new_fids and token.mem_seq <= old.mem_seq:
             # nothing actually new (e.g. an L0→L1 promotion kept the same

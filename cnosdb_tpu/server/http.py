@@ -942,6 +942,13 @@ class HttpServer:
             for (path, reason), n in _sk.outcomes_snapshot().items():
                 self.metrics.set_counter("cnosdb_string_filter_total", n,
                                          path=path, reason=reason)
+        # compressed-domain lane: per-(lane, reason) page outcomes —
+        # answered/skipped/masked/materialized and why
+        _cd = _sys.modules.get("cnosdb_tpu.storage.compressed_domain")
+        if _cd is not None:
+            for (lane, reason), n in _cd.outcomes_snapshot().items():
+                self.metrics.set_counter("cnosdb_compressed_domain_total",
+                                         n, lane=lane, reason=reason)
         _mv = _sys.modules.get("cnosdb_tpu.sql.matview")
         if _mv is not None:
             for name, n in _mv.counters_snapshot().items():

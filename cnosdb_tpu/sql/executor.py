@@ -3126,10 +3126,16 @@ class QueryExecutor:
                 return self._exec_aggregate_seeded(plan, batches,
                                                    phys_aggs, finalize,
                                                    rw.acc)
+        # compressed-domain lane: fully-answerable pages come back as
+        # pre-aggregated partials instead of rows (storage decides
+        # per-page; a None spec books why the query can't engage)
+        from ..storage import compressed_domain
+
+        cspec = compressed_domain.build_spec(plan, phys_aggs)
         batches = self.coord.scan_table(
             tenant, db, plan.table, time_ranges=plan.time_ranges,
             tag_domains=plan.tag_domains, field_names=needed_fields,
-            page_filter=plan.filter)
+            page_filter=plan.filter, compressed_spec=cspec)
         with self.memory_pool.reservation(_batches_bytes(batches),
                                           f"scan of {plan.table}"):
             return self._exec_aggregate_batches(plan, batches, phys_aggs,
@@ -3206,6 +3212,26 @@ class QueryExecutor:
 
         from ..utils import stages
 
+        if any(getattr(b, "compressed_partials", None) for b in batches):
+            # compressed-domain partials join the generic accumulator
+            # path: kernels run only over batches that still have rows,
+            # page partials fold in with _merge_partial-identical
+            # semantics (order-independent, so bit-identical)
+            kernel_batches = [b for b in batches if b.n_rows]
+            with stages.stage("kernel_ms"):
+                self._poll_cancel()
+                results = [finish_scan_aggregate(
+                    launch_scan_aggregate(b, q)) for b in kernel_batches]
+            acc: dict[tuple, dict] = {}
+            with stages.stage("merge_ms"):
+                for r in results:
+                    _merge_partial(acc, r, plan, phys_aggs)
+                for b in batches:
+                    _merge_compressed_partials(acc, b, plan, phys_aggs)
+            if not acc and not plan.group_tags \
+                    and not plan.group_fields and plan.bucket is None:
+                acc[()] = {}  # SQL: a global aggregate always yields one row
+            return self._finalize_aggregate(plan, acc, finalize)
         if len(batches) == 1 and not distinct_specs:
             # single-vnode fast path: finalize vectorized straight from
             # the kernel's arrays, no per-group python merge
@@ -4331,6 +4357,50 @@ def _merge_partial(acc: dict, result, plan: AggregatePlan,
                 if better:
                     parts[a.alias] = v
                     parts[a.alias + "__ts"] = ts
+
+
+def _merge_compressed_partials(acc: dict, batch, plan: AggregatePlan,
+                               phys_aggs: list[AggSpec]):
+    """Fold a batch's compressed-domain page partials into the generic
+    accumulator. Key layout and merge semantics are _merge_partial's
+    exactly — group tags from the partial's series key (same values
+    _tag_group_layout labels carry), bucket time appended — so lane
+    partials and kernel partials interleave bit-identically regardless
+    of which pages the lane answered."""
+    cp = getattr(batch, "compressed_partials", None)
+    if not cp:
+        return
+    skeys = cp["series_keys"]
+    for (sid, bts), parts in cp["rows"].items():
+        sk = skeys.get(sid)
+        tags = sk.tag_dict() if sk is not None else {}
+        key = tuple(_canon_group_key(tags.get(t))
+                    for t in plan.group_tags)
+        if plan.bucket is not None:
+            key = key + (int(bts),)
+        dst = acc.setdefault(key, {})
+        for a in phys_aggs:
+            if a.alias not in parts:
+                continue
+            v = parts[a.alias]
+            cur = dst.get(a.alias)
+            if a.func == "count":
+                dst[a.alias] = (cur or 0) + int(v)
+            elif a.func == "sum":
+                dst[a.alias] = v if cur is None else cur + v
+            elif a.func == "min":
+                dst[a.alias] = v if cur is None else min(cur, v)
+            elif a.func == "max":
+                dst[a.alias] = v if cur is None else max(cur, v)
+            elif a.func in ("first", "last"):
+                ts = int(parts.get(a.alias + "__ts", 0))
+                cur_ts = dst.get(a.alias + "__ts")
+                better = (cur is None or cur_ts is None
+                          or (a.func == "first" and ts < cur_ts)
+                          or (a.func == "last" and ts > cur_ts))
+                if better:
+                    dst[a.alias] = v
+                    dst[a.alias + "__ts"] = ts
 
 
 def _batch_column(batch, plan, col, native: bool = False):

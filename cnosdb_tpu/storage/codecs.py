@@ -333,7 +333,7 @@ _STR_CONTAINERS = {
 
 
 # ---------------------------------------------------------------------------
-# dispatch
+# dispatch — one codec table, keyed (value type, encoding id)
 # ---------------------------------------------------------------------------
 def _resolve_default(vt: ValueType, is_time: bool) -> Encoding:
     if is_time:
@@ -348,34 +348,178 @@ def _resolve_default(vt: ValueType, is_time: bool) -> Encoding:
     }[vt]
 
 
+# device-decode lane: the host half -----------------------------------------
+def _rejected(reason: str):
+    """No device lane for this block; the CALLER books `reason` (scan's
+    _count_fallback + device_decode.count_outcome — storage stays
+    jax-free, so the counters live across the hook boundary)."""
+    return None, reason
+
+
+def _split_delta(payload: bytes):
+    tag = payload[0]
+    if tag == 0:
+        return _rejected("empty")
+    n = int(np.frombuffer(payload[1:5], dtype=np.uint32)[0])
+    first = int(np.frombuffer(payload[5:13], dtype=np.int64)[0])
+    if tag == 1:
+        stride = int(np.frombuffer(payload[13:21], dtype=np.int64)[0])
+        return {"kind": "delta_const", "n": n, "first": first,
+                "stride": stride}, None
+    width = payload[13]
+    raw = _ZSTD_D.decompress(payload[14:])
+    return {"kind": "delta", "n": n, "first": first, "width": width,
+            "raw": raw}, None
+
+
+def _split_gorilla(payload: bytes):
+    if payload[0] == 0:
+        return _rejected("empty")
+    n = int(np.frombuffer(payload[1:5], dtype=np.uint32)[0])
+    return {"kind": "gorilla", "n": n,
+            "raw": _ZSTD_D.decompress(payload[5:])}, None
+
+
+def _split_bitpack(payload: bytes):
+    n = int(np.frombuffer(payload[:4], dtype=np.uint32)[0])
+    if n == 0:
+        return _rejected("empty")
+    return {"kind": "bitpack", "n": n, "raw": payload[4:]}, None
+
+
+def _split_dict(raw: bytes):
+    """Container-stripped string page → dict plan (codes stay narrow,
+    dictionary materialized host-side once per page)."""
+    head = int(np.frombuffer(raw[:4], dtype=np.uint32)[0])
+    if head != _DICT_MARKER:
+        return _rejected("string_v1")
+    n = int(np.frombuffer(raw[4:8], dtype=np.uint32)[0])
+    if n == 0:
+        return _rejected("empty")
+    u = int(np.frombuffer(raw[8:12], dtype=np.uint32)[0])
+    lens = np.frombuffer(raw[12:12 + 4 * u], dtype=np.uint32)
+    off = 12 + 4 * u
+    blob_len = int(lens.sum())
+    values = _materialize_dict(raw[off:off + blob_len], lens)
+    if u == 0:
+        values = np.array([""], dtype=object)
+    off += blob_len
+    width = raw[off]
+    return {"kind": "dict", "n": n, "width": width,
+            "raw": raw[off + 1:off + 1 + n * width],
+            "values": values}, None
+
+
+class _Codec:
+    """One (value type, encoding) dispatch row.
+
+    ``enc(values, is_time) -> payload`` and ``dec(payload) -> array``
+    implement the byte codec; ``split(payload) -> (plan, reason)`` is the
+    host half of the device-decode lane (None ⇒ the device lane rejects
+    with "encoding"). encode/decode/split_for_device all dispatch through
+    this one table, and downstream lanes (device decode, the
+    compressed-domain lane) register per-``kind`` handlers against the
+    split plans instead of growing their own if/elif ladders.
+    """
+    __slots__ = ("enc", "dec", "split")
+
+    def __init__(self, enc, dec, split=None):
+        self.enc = enc
+        self.dec = dec
+        self.split = split
+
+
+def _int_rows(unsigned: bool) -> dict:
+    dtype = np.uint64 if unsigned else np.int64
+
+    def dec_delta(payload):
+        return _decode_delta(payload, unsigned)
+
+    def dec_raw(payload):
+        return _decode_raw_transposed(payload, dtype)
+
+    def enc_raw(values, is_time):
+        return _encode_raw_transposed(np.asarray(values), level3=True)
+
+    raw_codec = _Codec(enc_raw, dec_raw)
+    return {
+        Encoding.DELTA: _Codec(
+            lambda values, is_time: _encode_delta(np.asarray(values), is_ts=is_time),
+            dec_delta, _split_delta),
+        Encoding.DELTA_TS: _Codec(
+            lambda values, is_time: _encode_delta(np.asarray(values), is_ts=True),
+            dec_delta, _split_delta),
+        Encoding.QUANTILE: raw_codec,
+        Encoding.NULL: raw_codec,
+    }
+
+
+def _float_rows() -> dict:
+    def enc_raw(values, is_time):
+        return _encode_raw_transposed(np.asarray(values, dtype=np.float64), level3=True)
+
+    def dec_raw(payload):
+        return _decode_raw_transposed(payload, np.float64)
+
+    raw_codec = _Codec(enc_raw, dec_raw)
+    return {
+        Encoding.GORILLA: _Codec(
+            lambda values, is_time: _encode_gorilla(np.asarray(values)),
+            _decode_gorilla, _split_gorilla),
+        Encoding.QUANTILE: raw_codec,
+        Encoding.NULL: raw_codec,
+    }
+
+
+def _bool_rows() -> dict:
+    codec = _Codec(lambda values, is_time: _encode_bool(np.asarray(values)),
+                   _decode_bool, _split_bitpack)
+    return {Encoding.BITPACK: codec, Encoding.NULL: codec}
+
+
+def _str_row(container: Encoding) -> _Codec:
+    comp, decomp = _STR_CONTAINERS[container]
+    return _Codec(lambda values, is_time: comp(_pack_strings(values)),
+                  lambda payload: _unpack_strings(decomp(payload)),
+                  lambda payload: _split_dict(decomp(payload)))
+
+
+_CODEC_TABLE: dict[tuple[ValueType, Encoding], _Codec] = {}
+for _vt, _rows in ((ValueType.INTEGER, _int_rows(False)),
+                   (ValueType.UNSIGNED, _int_rows(True)),
+                   (ValueType.FLOAT, _float_rows()),
+                   (ValueType.BOOLEAN, _bool_rows())):
+    for _e, _codec in _rows.items():
+        _CODEC_TABLE[(_vt, _e)] = _codec
+for _vt in (ValueType.STRING, ValueType.GEOMETRY):
+    for _e in _STR_CONTAINERS:
+        _CODEC_TABLE[(_vt, _e)] = _str_row(_e)
+_VTS_WITH_ROWS = {vt for vt, _ in _CODEC_TABLE}
+
+
+def _codec_for(vt: ValueType, encoding: Encoding) -> _Codec | None:
+    codec = _CODEC_TABLE.get((vt, encoding))
+    if codec is None and vt in (ValueType.STRING, ValueType.GEOMETRY):
+        # string pages round-trip under any container id: unknown ids ride
+        # the DEFAULT container (historic `_STR_CONTAINERS.get` fallback)
+        codec = _CODEC_TABLE.get((vt, Encoding.DEFAULT))
+    return codec
+
+
 def encode(values: np.ndarray, vt: ValueType, encoding: Encoding = Encoding.DEFAULT,
            is_time: bool = False) -> bytes:
     """Encode a column block → [1B encoding id][payload]."""
     if encoding == Encoding.DEFAULT:
         encoding = _resolve_default(vt, is_time)
-    eid = bytes([int(encoding)])
+    codec = _codec_for(vt, encoding)
+    if codec is None:
+        raise CodecError("illegal encoding for type", vt=vt.name, encoding=encoding.name)
     try:
-        if vt in (ValueType.INTEGER, ValueType.UNSIGNED):
-            if encoding in (Encoding.DELTA, Encoding.DELTA_TS):
-                return eid + _encode_delta(np.asarray(values), is_ts=(encoding == Encoding.DELTA_TS or is_time))
-            if encoding in (Encoding.QUANTILE, Encoding.NULL):
-                return eid + _encode_raw_transposed(np.asarray(values), level3=True)
-        elif vt == ValueType.FLOAT:
-            if encoding == Encoding.GORILLA:
-                return eid + _encode_gorilla(np.asarray(values))
-            if encoding in (Encoding.QUANTILE, Encoding.NULL):
-                return eid + _encode_raw_transposed(np.asarray(values, dtype=np.float64), level3=True)
-        elif vt == ValueType.BOOLEAN:
-            if encoding in (Encoding.BITPACK, Encoding.NULL):
-                return eid + _encode_bool(np.asarray(values))
-        elif vt in (ValueType.STRING, ValueType.GEOMETRY):
-            comp, _ = _STR_CONTAINERS.get(encoding, _STR_CONTAINERS[Encoding.DEFAULT])
-            return eid + comp(_pack_strings(values))
+        return bytes([int(encoding)]) + codec.enc(values, is_time)
     except CodecError:
         raise
     except Exception as e:  # pragma: no cover - defensive
         raise CodecError(f"encode failed: {e}", vt=vt.name, encoding=encoding.name)
-    raise CodecError("illegal encoding for type", vt=vt.name, encoding=encoding.name)
 
 
 def decode(data: bytes, vt: ValueType) -> np.ndarray:
@@ -383,40 +527,15 @@ def decode(data: bytes, vt: ValueType) -> np.ndarray:
     if len(data) == 0:
         raise CodecError("empty block")
     encoding = Encoding(data[0])
-    payload = data[1:]
+    codec = _codec_for(vt, encoding)
+    if codec is None:
+        raise CodecError("illegal encoding for type", vt=vt.name, encoding=encoding.name)
     try:
-        if vt in (ValueType.INTEGER, ValueType.UNSIGNED):
-            unsigned = vt == ValueType.UNSIGNED
-            if encoding in (Encoding.DELTA, Encoding.DELTA_TS):
-                return _decode_delta(payload, unsigned)
-            if encoding in (Encoding.QUANTILE, Encoding.NULL):
-                return _decode_raw_transposed(payload, np.uint64 if unsigned else np.int64)
-        elif vt == ValueType.FLOAT:
-            if encoding == Encoding.GORILLA:
-                return _decode_gorilla(payload)
-            if encoding in (Encoding.QUANTILE, Encoding.NULL):
-                return _decode_raw_transposed(payload, np.float64)
-        elif vt == ValueType.BOOLEAN:
-            if encoding in (Encoding.BITPACK, Encoding.NULL):
-                return _decode_bool(payload)
-        elif vt in (ValueType.STRING, ValueType.GEOMETRY):
-            _, decomp = _STR_CONTAINERS.get(encoding, _STR_CONTAINERS[Encoding.DEFAULT])
-            return _unpack_strings(decomp(payload))
+        return codec.dec(data[1:])
     except CodecError:
         raise
     except Exception as e:
         raise CodecError(f"decode failed: {e}", vt=vt.name, encoding=encoding.name)
-    raise CodecError("illegal encoding for type", vt=vt.name, encoding=encoding.name)
-
-
-# ---------------------------------------------------------------------------
-# device-decode lane: the host half
-# ---------------------------------------------------------------------------
-def _rejected(reason: str):
-    """No device lane for this block; the CALLER books `reason` (scan's
-    _count_fallback + device_decode.count_outcome — storage stays
-    jax-free, so the counters live across the hook boundary)."""
-    return None, reason
 
 
 def split_for_device(data: bytes, vt: ValueType):
@@ -432,66 +551,19 @@ def split_for_device(data: bytes, vt: ValueType):
       {"kind": "dict", "n", "width", "raw", "values"}    narrow codes +
                                                          host dictionary
     Rejections are total: every early return passes through _rejected()
-    (enforced by the device-decode-accounting lint rule).
+    (enforced by the device-decode-accounting lint rule). The same plans
+    feed the compressed-domain lane's closed-form handlers
+    (storage/compressed_domain.py), which key off plan["kind"].
     """
     if len(data) == 0:
         return _rejected("empty")
     encoding = Encoding(data[0])
-    payload = data[1:]
-    if vt in (ValueType.INTEGER, ValueType.UNSIGNED):
-        if encoding not in (Encoding.DELTA, Encoding.DELTA_TS):
-            return _rejected("encoding")
-        tag = payload[0]
-        if tag == 0:
-            return _rejected("empty")
-        n = int(np.frombuffer(payload[1:5], dtype=np.uint32)[0])
-        first = int(np.frombuffer(payload[5:13], dtype=np.int64)[0])
-        if tag == 1:
-            stride = int(np.frombuffer(payload[13:21], dtype=np.int64)[0])
-            return {"kind": "delta_const", "n": n, "first": first,
-                    "stride": stride}, None
-        width = payload[13]
-        raw = _ZSTD_D.decompress(payload[14:])
-        return {"kind": "delta", "n": n, "first": first, "width": width,
-                "raw": raw}, None
-    if vt == ValueType.FLOAT:
-        if encoding != Encoding.GORILLA:
-            return _rejected("encoding")
-        if payload[0] == 0:
-            return _rejected("empty")
-        n = int(np.frombuffer(payload[1:5], dtype=np.uint32)[0])
-        return {"kind": "gorilla", "n": n,
-                "raw": _ZSTD_D.decompress(payload[5:])}, None
-    if vt == ValueType.BOOLEAN:
-        if encoding not in (Encoding.BITPACK, Encoding.NULL):
-            return _rejected("encoding")
-        n = int(np.frombuffer(payload[:4], dtype=np.uint32)[0])
-        if n == 0:
-            return _rejected("empty")
-        return {"kind": "bitpack", "n": n, "raw": payload[4:]}, None
-    if vt in (ValueType.STRING, ValueType.GEOMETRY):
-        _, decomp = _STR_CONTAINERS.get(encoding,
-                                        _STR_CONTAINERS[Encoding.DEFAULT])
-        raw = decomp(payload)
-        head = int(np.frombuffer(raw[:4], dtype=np.uint32)[0])
-        if head != _DICT_MARKER:
-            return _rejected("string_v1")
-        n = int(np.frombuffer(raw[4:8], dtype=np.uint32)[0])
-        if n == 0:
-            return _rejected("empty")
-        u = int(np.frombuffer(raw[8:12], dtype=np.uint32)[0])
-        lens = np.frombuffer(raw[12:12 + 4 * u], dtype=np.uint32)
-        off = 12 + 4 * u
-        blob_len = int(lens.sum())
-        values = _materialize_dict(raw[off:off + blob_len], lens)
-        if u == 0:
-            values = np.array([""], dtype=object)
-        off += blob_len
-        width = raw[off]
-        return {"kind": "dict", "n": n, "width": width,
-                "raw": raw[off + 1:off + 1 + n * width],
-                "values": values}, None
-    return _rejected("value_type")
+    codec = _codec_for(vt, encoding)
+    if codec is None:
+        return _rejected("encoding" if vt in _VTS_WITH_ROWS else "value_type")
+    if codec.split is None:
+        return _rejected("encoding")
+    return codec.split(data[1:])
 
 
 def encode_timestamps(ts: np.ndarray, encoding: Encoding = Encoding.DEFAULT) -> bytes:

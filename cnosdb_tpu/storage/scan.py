@@ -28,6 +28,7 @@ from .memcache import MemCache, _group_starts
 from .vnode import VnodeStorage
 from ..utils import lockwatch
 from ..utils import stages
+from . import compressed_domain
 
 
 @dataclass
@@ -427,7 +428,7 @@ def scan_vnode(vnode: VnodeStorage, table: str,
                field_names: list[str] | None = None,
                page_filter=None, page_constraints: dict | None = None,
                n_threads: int = 1, upload_hook=None,
-               decode_hook=None) -> ScanBatch:
+               decode_hook=None, compressed_spec=None) -> ScanBatch:
     """Materialize a vnode scan into one ScanBatch.
 
     `page_filter` (an sql.expr tree, optional) enables predicate page
@@ -448,6 +449,14 @@ def scan_vnode(vnode: VnodeStorage, table: str,
     work at the byte container and decode as batched kernels on the
     accelerator — the third lane beside native pagedec and per-page
     Python.
+    `compressed_spec` (storage/compressed_domain.CompressedSpec), when
+    given, engages the compressed-domain lane AHEAD of the decode lanes:
+    merge-free pages provably skippable/answerable from their encoded
+    representation leave the plan entirely (contributions ride
+    `batch.compressed_partials`), and mixed string/bool predicate pages
+    decode but gather only surviving rows (late materialization). The
+    batch is only valid for queries with that exact spec — the
+    coordinator keys its cache accordingly.
     """
     trs = time_ranges if time_ranges is not None else TimeRanges.all()
     if series_ids is None:
@@ -468,7 +477,8 @@ def scan_vnode(vnode: VnodeStorage, table: str,
             page_constraints = _page_constraints(page_filter, field_names)
         batch = _scan_vnode_native(vnode, table, series_ids, trs,
                                    field_names, page_constraints or {},
-                                   n_threads, upload_hook, decode_hook)
+                                   n_threads, upload_hook, decode_hook,
+                                   compressed_spec)
         if batch is not None:
             return batch
 
@@ -788,12 +798,18 @@ def _scan_vnode_native(vnode: VnodeStorage, table: str,
                        field_names: list[str], constraints: dict,
                        n_threads: int,
                        upload_hook=None,
-                       decode_hook=None) -> ScanBatch | None:
+                       decode_hook=None,
+                       compressed_spec=None) -> ScanBatch | None:
     from . import native
 
     dev_lane = decode_hook() if decode_hook is not None else None
     native_ok = native.pagedec_available()
-    if not native_ok and dev_lane is None:
+    if not native_ok and dev_lane is None and compressed_spec is None:
+        # no fast decode lane and no compressed-domain work: the simple
+        # per-series fallback below is equivalent and cheaper to plan.
+        # With a spec the page-level plan is still worth building — the
+        # lane skips/answers pages before any decode, and survivors fall
+        # through to the per-page Python jobs
         return None
     version = vnode.summary.version
     files = []
@@ -831,11 +847,33 @@ def _scan_vnode_native(vnode: VnodeStorage, table: str,
         else:
             total += len(entry[2])
 
+    # --------------------------------------------- compressed-domain lane
+    # lane zero: before any bytes move, pages provably skippable or
+    # answerable from their encoded representation leave the plan; their
+    # aggregate contributions ride the batch as pre-aggregated partials
+    lane = None
+    if compressed_spec is not None:
+        lane = compressed_domain.ScanLane(compressed_spec, trs,
+                                          vnode.index)
+        with stages.stage("compressed_ms"):
+            plan = lane.filter_plan(plan)
+        if lane.engaged:
+            any_pruned = True
+            total = sum(e[3] if e[0] == "n" else len(e[2]) for e in plan)
+
     if total == 0:
         b = ScanBatch(table, np.empty(0, dtype=np.uint64), [],
                       np.empty(0, dtype=np.int64),
                       np.empty(0, dtype=np.int32), {})
         b._pages_pruned = any_pruned
+        if lane is not None:
+            lane_wants: dict[int, tuple] = {}
+            lane.extend_cold_wants(lane_wants)
+            for r, pms in lane_wants.values():
+                r.fetch_pages(pms)
+            with stages.stage("compressed_ms"):
+                lane.run_jobs()
+            lane.attach(b)
         return b
 
     # ------------------------------------------------- cold-tier prefetch
@@ -856,8 +894,16 @@ def _scan_vnode_native(vnode: VnodeStorage, table: str,
                     col = cols.get(name)
                     if col is not None:
                         lst.append(col.pages[i])
+    if lane is not None:
+        # closed-form jobs read only the pages they need (often just the
+        # time page) — those ranges join the same coalesced GET pass, so
+        # answered pages' VALUE bytes are never downloaded
+        lane.extend_cold_wants(cold_wants)
     for r, pms in cold_wants.values():
         r.fetch_pages(pms)
+    if lane is not None and lane.jobs:
+        with stages.stage("compressed_ms"):
+            lane.run_jobs()
 
     # ------------------------------------------------------- column typing
     ftypes: dict[str, ValueType] = {}
@@ -910,6 +956,7 @@ def _scan_vnode_native(vnode: VnodeStorage, table: str,
     keys = []
     counts: list[int] = []
     fallback_writes = []   # (entry, base_off)
+    bytes_materialized = 0   # page bytes routed into ANY decode lane
     off = 0
     for entry in plan:
         if entry[0] == "f":
@@ -988,6 +1035,11 @@ def _scan_vnode_native(vnode: VnodeStorage, table: str,
                         py_jobs.append((r, pm, name, off, vt))
                         continue
                     _add_page(r, pm, name, off, kind)
+                bytes_materialized += tp.size + sum(
+                    cols[name].pages[i].size for name in field_names
+                    if name in cols)
+                if lane is not None:
+                    lane.apply_page_masks(cm, i, off, total)
                 off += tp.n_rows
 
     # ------------------------------------------------------ device decode
@@ -1020,10 +1072,12 @@ def _scan_vnode_native(vnode: VnodeStorage, table: str,
 
     uploader = None
     if upload_hook is not None and not fallback_writes \
-            and not (any_trim and not trs.is_all):
-        # fallback series splice into every column after decode, and a
-        # time trim re-slices the arrays — both would invalidate an
-        # eagerly shipped copy, so only clean scans pipeline uploads
+            and not (any_trim and not trs.is_all) \
+            and (lane is None or not lane.has_masks):
+        # fallback series splice into every column after decode, a time
+        # trim re-slices the arrays, and compressed-domain survivor masks
+        # gather a subset — all would invalidate an eagerly shipped copy,
+        # so only clean scans pipeline uploads
         uploader = upload_hook(total)
     dirty_cols = {j[2] for j in py_jobs}
     if uploader is not None and dev_lane is not None:
@@ -1142,8 +1196,14 @@ def _scan_vnode_native(vnode: VnodeStorage, table: str,
         out_fields[name] = (ftypes[name], DictArray(codes_all, union),
                             string_valid[name])
 
-    if any_trim and not trs.is_all:
-        keep = _time_mask(ts_all, trs)
+    row_mask = lane.row_mask if lane is not None else None
+    if (any_trim and not trs.is_all) or row_mask is not None:
+        keep = _time_mask(ts_all, trs) if (any_trim and not trs.is_all) \
+            else None
+        if row_mask is not None:
+            # late materialization: only rows surviving every
+            # compressed-domain predicate mask are gathered
+            keep = row_mask if keep is None else (keep & row_mask)
         if keep is not None and not keep.all():
             ts_all = ts_all[keep]
             sid_ordinal = sid_ordinal[keep]
@@ -1166,6 +1226,11 @@ def _scan_vnode_native(vnode: VnodeStorage, table: str,
     b = ScanBatch(table, np.array(kept_sids, dtype=np.uint64), keys,
                   ts_all, sid_ordinal, out_fields)
     b._pages_pruned = any_pruned
+    if lane is not None:
+        bytes_materialized += lane.bytes_materialized
+        lane.attach(b)
+    if bytes_materialized:
+        stages.count("compressed.bytes_materialized", bytes_materialized)
     if uploader is not None:
         uploader.attach(b)
     return b

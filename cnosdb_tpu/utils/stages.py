@@ -76,6 +76,20 @@ STAGE_CATALOG: dict[str, str] = {
                            "buckets by rewritten queries",
     "ngram_pages_skipped": "string pages pruned before decode by trigram "
                            "signatures (ops/strkernels)",
+    "compressed_ms": "compressed-domain lane: page classification + "
+                     "closed-form jobs (storage/compressed_domain)",
+    "compressed.pages_answered": "pages whose aggregate contribution "
+                                 "came from stats/closed forms — never "
+                                 "decoded into rows",
+    "compressed.pages_skipped": "pages proven predicate-false from "
+                                "encoded form — zero bytes touched",
+    "compressed.pages_masked": "pages filtered in code space (dict/"
+                               "bitpack masks) — only survivors gather",
+    "compressed.bytes_avoided": "page bytes the compressed-domain lane "
+                                "kept out of every decode lane",
+    "compressed.bytes_materialized": "page bytes that DID enter a decode "
+                                     "lane (the ≥5× drop the lane exists "
+                                     "to produce on selective scans)",
     "topk.host": "ORDER BY+LIMIT answered by np.partition select-then-"
                  "gather instead of a full sort",
     "topk.device": "ORDER BY+LIMIT thresholds computed by jax.lax.top_k",

@@ -1,0 +1,24 @@
+"""Fixture: compressed-domain lane exits that skip reason accounting
+(lines 9 and 20). The _declined return, the booked bail, the success
+return of a computed name, and both terminal returns are legal shapes
+and must stay silent."""
+
+
+def build_spec(plan, phys_aggs, _declined):
+    if plan is None:
+        return None
+    if not getattr(plan, "aggs", None):
+        return _declined("agg_func")
+    return object()
+
+
+def _page_row_mask(r, pm, evt, ops, count_outcome):
+    if r is None:
+        count_outcome("mask", "read_error")
+        return None
+    if pm is None:
+        return None
+    dense = [evt in ops]
+    if evt:
+        return dense
+    return None

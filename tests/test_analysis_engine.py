@@ -48,6 +48,8 @@ _CASES = [
     ("bad_serving.py", rules_mod.ServingAccounting(), [10, 20]),
     ("bad_backup.py", rules_mod.BackupAccounting(), [10, 20]),
     ("bad_fault_site.py", rules_mod.FaultSiteCoverage(), [10, 11]),
+    ("bad_compressed_domain.py",
+     rules_mod.CompressedDomainAccounting(), [9, 20]),
     # interprocedural rule family (cnosdb_tpu/analysis/interproc.py)
     ("bad_host_sync.py", interproc.HostSync(), [8, 9, 10, 11]),
     ("bad_recompile.py", interproc.RecompileHazard(), [8, 14]),
