@@ -1158,6 +1158,109 @@ def run_pointqps(executor, coord, tenant, db, session) -> dict:
     return out
 
 
+def run_straggler() -> dict:
+    """Gray-failure tail-latency suite (parallel/health.py plane): a
+    2-replica straggler bed (chaos/straggler.py — real wire, real
+    engine, synthetic placement) scanned in three phases:
+
+      * healthy, hedging on — the tail must NOT pay for the insurance:
+        `healthy_hedges_fired` is expected to be 0 (suppression + the
+        adaptive p95 trigger prove hedging is tail-only);
+      * the PINNED primary browned out by `straggle_delay_ms`, hedging
+        on — a short unmeasured adaptation stage first
+        (`adaptation_hedges` + `adapt_p99_ms`), then the measured
+        window: the primary slot follows the raft leader for
+        read-your-writes and is never re-routed by health, so every
+        scan's first attempt lands on the straggler and the hedge lane
+        must rescue it — `hedged_p99_ms` ≈ hedge trigger + the healthy
+        replica's latency (tens of ms, NOT the brownout delay), with
+        ~one fired/won/cancelled hedge per scan in `hedged`;
+      * same brownout, CNOSDB_HEDGE=0 — the unprotected legacy tail the
+        plane exists to cut (p99 ≈ the injected delay; the headline is
+        `nohedge_over_healthy` vs `straggler_over_healthy`).
+
+    The scorer keeps its warm sketches into the brownout (a real
+    cluster has them when a replica browns out), so the adaptive
+    trigger — max(floor, min(p95, 4×p50)), not the raw config floor —
+    prices the hedges, and won hedges feed the loser's elapsed-so-far
+    back as censored samples that keep the failover/hedge ordering of
+    the ALTERNATES honest."""
+    import tempfile
+
+    from cnosdb_tpu.chaos.straggler import StragglerBed, batch_bytes
+    from cnosdb_tpu.parallel import health
+
+    iters = int(os.environ.get("CNOSDB_BENCH_STRAGGLER_ITERS", "60"))
+    delay_ms = float(os.environ.get("CNOSDB_BENCH_STRAGGLER_DELAY_MS",
+                                    "120"))
+    prev_hedge = os.environ.pop("CNOSDB_HEDGE", None)
+    root = tempfile.mkdtemp(prefix="cnosdb_straggler_")
+    bed = StragglerBed(root, rows=4000)
+    out: dict = {"iters": iters, "straggle_delay_ms": delay_ms}
+
+    def phase(tag, n):
+        lat = []
+        for i in range(n):
+            t0 = time.perf_counter()
+            bed.scan_once(qid=f"{tag}-{i}")
+            lat.append(time.perf_counter() - t0)
+        a = np.sort(np.asarray(lat))
+        return (round(float(np.percentile(a, 50)) * 1e3, 2),
+                round(float(np.percentile(a, 99)) * 1e3, 2))
+
+    def hedge_counts():
+        hedge, _ = health.counters_snapshot()
+        agg: dict = {}
+        for (outcome, _reason), v in hedge.items():
+            agg[outcome] = agg.get(outcome, 0) + v
+        return {k: agg.get(k, 0)
+                for k in ("fired", "won", "lost", "cancelled",
+                          "suppressed")}
+
+    try:
+        ref = batch_bytes(bed.scan_once(qid="warm-ref"))
+        health.SCORER.reset()
+        bed.warm_replicas()               # honest warm samples everywhere
+        phase("warm", 12)                 # real p95s in the sketches
+        health.reset_counters()
+        out["healthy_p50_ms"], out["healthy_p99_ms"] = phase(
+            "healthy", iters)
+        out["healthy_hedges"] = hedge_counts()
+
+        # brown out the PINNED primary (split targets the leader first
+        # — read-your-writes — so health never re-routes the first
+        # attempt): the worst case, every scan must be hedge-rescued
+        victim = bed.replicas[0]
+        victim.delay_s = delay_ms / 1e3
+        health.reset_counters()
+        _, out["adapt_p99_ms"] = phase("adapt", 8)
+        time.sleep(delay_ms / 1e3 + 0.05)   # hedge-loser replies land,
+        out["adaptation_hedges"] = hedge_counts()   # scorer sees them
+        health.reset_counters()
+        out["hedged_p50_ms"], out["hedged_p99_ms"] = phase(
+            "straggle", iters)
+        out["hedged"] = hedge_counts()
+        assert batch_bytes(bed.scan_once(qid="parity")) == ref, \
+            "hedged scan result drifted from the healthy baseline"
+
+        os.environ["CNOSDB_HEDGE"] = "0"
+        health.SCORER.reset()
+        out["nohedge_p50_ms"], out["nohedge_p99_ms"] = phase(
+            "legacy", iters)
+
+        out["straggler_over_healthy"] = round(
+            out["hedged_p99_ms"] / max(out["healthy_p99_ms"], 1e-6), 2)
+        out["nohedge_over_healthy"] = round(
+            out["nohedge_p99_ms"] / max(out["healthy_p99_ms"], 1e-6), 2)
+    finally:
+        if prev_hedge is None:
+            os.environ.pop("CNOSDB_HEDGE", None)
+        else:
+            os.environ["CNOSDB_HEDGE"] = prev_hedge
+        bed.close()
+    return out
+
+
 def run_suites(executor, coord, tenant, db, session) -> dict:
     out: dict = {}
     t0 = time.perf_counter()
@@ -1201,4 +1304,8 @@ def run_suites(executor, coord, tenant, db, session) -> dict:
                                        session)
     except Exception as e:   # serving-plane failure must not sink the run
         out["pointqps"] = {"error": repr(e)[:200]}
+    try:
+        out["straggler"] = run_straggler()   # self-contained bed
+    except Exception as e:   # gray-failure plane must not sink the run
+        out["straggler"] = {"error": repr(e)[:200]}
     return out

@@ -1081,6 +1081,90 @@ class CompressedDomainAccounting(Rule):
                            f"covering it")
 
 
+# --------------------------------------------------------------------------
+# 18. hedge-accounting — new (PR 18): no silent hedge-lane exits
+# --------------------------------------------------------------------------
+_HGA_FUNCS = {
+    "cnosdb_tpu/parallel/coordinator.py": ("_scan_remote_hedged",),
+}
+_HGA_ACCOUNTING = {"count_hedge", "count", "count_error", "count_breaker"}
+
+
+def _hga_has_accounting(node: ast.AST) -> bool:
+    for n in ast.walk(node):
+        if isinstance(n, ast.Call) and _call_name(n) in _HGA_ACCOUNTING:
+            return True
+    return False
+
+
+def _hga_success_return(stmt: ast.AST) -> bool:
+    """``return <name>`` / ``return None`` / bare ``return`` — the
+    winner-settle shapes: won/lost were booked in the enclosing block
+    before the result dispatch, so these carry no reason of their own.
+    Literal returns and raises must book why."""
+    return isinstance(stmt, ast.Return) and (
+        stmt.value is None
+        or isinstance(stmt.value, ast.Name)
+        or (isinstance(stmt.value, ast.Constant)
+            and stmt.value.value is None))
+
+
+class HedgeAccounting(Rule):
+    name = "hedge-accounting"
+    motivation = ("PR 18 gray-failure plane: every exit out of the hedged "
+                  "scan lane must book into cnosdb_hedge_total (fired/won/"
+                  "lost/cancelled/suppressed) or a hedge.* stage — an "
+                  "unaccounted early exit makes the hedge ledger lie, and "
+                  "that ledger is the only proof hedging stays tail-only "
+                  "instead of silently doubling cluster scan load")
+
+    def applies_to(self, relpath):
+        return relpath in _HGA_FUNCS
+
+    def begin_module(self, ctx):
+        want = _HGA_FUNCS.get(ctx.relpath)
+        guarded = want is not None
+        if want is None:
+            # scope-ignored run (fixtures/self-tests): lint any function
+            # bearing a guarded name, but skip the presence check
+            want = tuple({n for names in _HGA_FUNCS.values()
+                          for n in names})
+        found = set()
+        for fn in ast.walk(ctx.tree):
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    or fn.name not in want:
+                continue
+            found.add(fn.name)
+            terminal = fn.body[-1]
+            for block in _dda_blocks(fn):
+                for i, stmt in enumerate(block):
+                    if not isinstance(stmt, (ast.Return, ast.Raise)) \
+                            or stmt is terminal:
+                        continue
+                    # accounting may land anywhere earlier in the same
+                    # block (the settle path books won/lost, then
+                    # dispatches on the result shape)
+                    if _hga_has_accounting(stmt) \
+                            or _hga_success_return(stmt) \
+                            or any(_hga_has_accounting(prev)
+                                   for prev in block[:i]):
+                        continue
+                    kind = "return" if isinstance(stmt, ast.Return) \
+                        else "raise"
+                    ctx.report(self, stmt,
+                               f"unaccounted early {kind} in {fn.name} — "
+                               f"hedge-lane exits must book into "
+                               f"cnosdb_hedge_total (count_hedge) or a "
+                               f"hedge.* stage so the hedge ledger stays "
+                               f"trustworthy on /metrics")
+        for name in want if guarded else ():
+            if name not in found:
+                ctx.report(self, 1,
+                           f"hedge guarded function {name} not found — "
+                           f"if it was renamed, update analysis/rules.py "
+                           f"so the lint keeps covering it")
+
+
 def all_rules() -> list:
     from .interproc import project_rules
 
@@ -1090,4 +1174,4 @@ def all_rules() -> list:
             DeviceDecodeAccounting(), StringFilterAccounting(),
             ColdTierAccounting(), ServingAccounting(), BackupAccounting(),
             FaultSiteCoverage(), CompressedDomainAccounting(),
-            *project_rules()]
+            HedgeAccounting(), *project_rules()]

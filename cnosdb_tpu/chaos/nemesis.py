@@ -14,7 +14,8 @@ from __future__ import annotations
 import random
 from dataclasses import dataclass
 
-KINDS = ("partition", "crash_restart", "delay_storm", "corrupt")
+KINDS = ("partition", "crash_restart", "delay_storm", "corrupt",
+         "slow_replica")
 # disaster-recovery kinds, never mixed into the default rotation: both
 # destroy data on purpose (total_loss wipes a node's data dir,
 # operator_error drops a whole database) and are only survivable when
@@ -63,6 +64,12 @@ def event_specs(ev: NemesisEvent, victim_addr: str,
         return (prefix + f"rpc.send:delay({ev.param}):prob=0.5",
                 prefix + f"rpc.send:delay({ev.param}):prob=0.2,"
                          f"if={victim_addr}")
+    if ev.kind == "slow_replica":
+        # gray failure: the victim keeps answering every RPC, just
+        # slowly — server-side delay before dispatch, deterministic
+        # (prob=1) so tail-latency bounds are measurable. Peers stay
+        # clean; this is the scenario the hedged-scan plane exists for.
+        return (prefix + f"rpc.server:delay({ev.param})", "")
     if ev.kind == "corrupt":
         # flip bytes of the next file the victim's scrubber verifies —
         # at-rest corruption the integrity plane must catch and repair

@@ -95,6 +95,15 @@ class QueryConfig:
     # usage_schema.slow_queries. 0 (the default) disables the log.
     # Env override: CNOSDB_QUERY_SLOW_QUERY_THRESHOLD_MS.
     slow_query_threshold_ms: int = 0
+    # gray-failure tolerance plane (parallel/health.py): floor on the
+    # adaptive per-(node, method-class) p95 hedge trigger — a warm-cache
+    # microsecond p95 must not hedge every scan — and the per-coordinator
+    # cap on concurrently in-flight hedges (hedges add load exactly when
+    # the cluster is slow). CNOSDB_HEDGE=0 disables hedging entirely.
+    # Env overrides: CNOSDB_QUERY_HEDGE_DELAY_MS_FLOOR /
+    # CNOSDB_QUERY_HEDGE_MAX_INFLIGHT.
+    hedge_delay_ms_floor: int = 25
+    hedge_max_inflight: int = 8
 
 
 @dataclass

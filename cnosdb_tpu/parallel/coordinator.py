@@ -13,8 +13,10 @@ and a scan that fails on the leader's node fails over to follower replicas
 """
 from __future__ import annotations
 
+import contextvars
 import logging
 import os
+import queue as queue_mod
 import threading
 import time
 from dataclasses import dataclass, field
@@ -32,6 +34,7 @@ from ..models.predicate import ColumnDomains, TimeRanges
 from ..models.schema import TskvTableSchema, ValueType
 from ..storage.engine import TsKv
 from ..storage.scan import ScanBatch, scan_vnode
+from . import health
 from .meta import MetaStore
 from ..utils import lockwatch
 
@@ -43,6 +46,10 @@ log = logging.getLogger(__name__)
 # split of every scan). One probe per cooldown window re-tests the node.
 CB_THRESHOLD = int(os.environ.get("CNOSDB_CB_THRESHOLD", "3"))
 CB_COOLDOWN = float(os.environ.get("CNOSDB_CB_COOLDOWN", "2.0"))
+# Deadline-burn threshold for breaker resets: only a success faster than
+# this fraction of the hop's timeout absolves accumulated failures — a
+# single crawl-speed success from a browning-out node must not rearm it.
+CB_BURN_FRACTION = float(os.environ.get("CNOSDB_CB_BURN_FRACTION", "0.5"))
 
 
 @dataclass
@@ -117,10 +124,16 @@ class Coordinator:
         # circuit breaker: node_id → [consecutive_failures, open_until]
         self._cb: dict = {}
         self._cb_lock = lockwatch.Lock("coord.circuit_breakers")
+        # hedged-scan plane: per-coordinator in-flight hedge cap (hedges
+        # add load exactly when the cluster is slow — bound them) and a
+        # sequence for derived per-attempt hedge qids
+        self._hedge_limiter = health.HedgeLimiter(health.HEDGE_MAX_INFLIGHT)
+        self._hedge_seq = 0
+        self._hedge_lock = lockwatch.Lock("coord.hedge_seq")
 
     def _rpc(self, node_id: int, method: str, payload: dict,
-             timeout: float = 10.0):
-        from .net import RpcError, RpcUnavailable, rpc_call
+             timeout: float = 10.0, hedge: bool = False):
+        from .net import RpcError, RpcThrottled, RpcUnavailable, rpc_call
 
         addr = self.meta.node_addr(node_id)
         if not addr:
@@ -137,11 +150,25 @@ class Coordinator:
                 # half-open: this call is the single probe; keep the
                 # circuit closed to everyone else until it resolves
                 st[1] = now + CB_COOLDOWN
+                health.count_breaker(node_id, "half_open")
+        if health.enabled() and method in health.HEDGEABLE \
+                and not hedge and not health.SLOW_START.admit(node_id):
+            # freshly-closed breaker still ramping: fast-fail this READ
+            # to an alternate instead of piling full traffic back onto a
+            # barely-recovered node (writes are raft-placed, no
+            # alternate exists, so they always pass). Hedges bypass the
+            # ramp: a hedge is a single limiter-capped rescue probe for
+            # a query whose preferred replica is ALREADY browned out —
+            # the ramping node may be its only fast alternate
+            raise RpcThrottled(
+                f"{method}@node {node_id}: slow-start ramp after breaker "
+                f"close — read routed to an alternate")
         dl = deadline_mod.current()
         if dl is not None and dl.qid is not None:
             # remember every node this request sent work to, so a kill /
             # expiry / disconnect can fan best-effort cancel_scan out
             dl.remote_nodes.add(addr)
+        t0 = time.monotonic()
         try:
             reply = rpc_call(addr, method, payload, timeout=timeout)
         except RpcUnavailable:
@@ -155,15 +182,32 @@ class Coordinator:
                 st[0] += 1
                 if st[0] >= CB_THRESHOLD:
                     st[1] = time.monotonic() + CB_COOLDOWN
+                    if st[0] == CB_THRESHOLD:
+                        health.count_breaker(node_id, "open")
+            # an opened breaker voids any in-progress readmission ramp
+            health.SLOW_START.clear(node_id)
             raise
         except RpcError:
             # app-level rejection: the node answered, so it is alive
-            with self._cb_lock:
-                self._cb.pop(node_id, None)
+            self._cb_reset(node_id)
             raise
-        with self._cb_lock:
-            self._cb.pop(node_id, None)
+        if time.monotonic() - t0 < CB_BURN_FRACTION * timeout:
+            self._cb_reset(node_id)
+        # a slow success deliberately leaves the consecutive-failure
+        # counter standing: the node answered, but at brownout speed —
+        # resetting on it would let a node timing out for everyone else
+        # rearm itself with one crawled reply
         return reply
+
+    def _cb_reset(self, node_id: int) -> None:
+        """Breaker success path: clear accumulated failures; when this
+        closes an OPEN breaker, start the slow-start readmission ramp
+        instead of readmitting full traffic at once."""
+        with self._cb_lock:
+            st = self._cb.pop(node_id, None)
+        if st is not None and st[0] >= CB_THRESHOLD:
+            health.count_breaker(node_id, "closed")
+            health.SLOW_START.begin(node_id)
 
     def _on_meta_event(self, event: str, payload: dict):
         if event == "update_vnode":
@@ -522,9 +566,16 @@ class Coordinator:
             if pr is not None:
                 return pr
         if self.distributed:
-            for v in rs.vnodes:
-                if v.node_id == self.node_id:
-                    continue
+            members = [v for v in rs.vnodes if v.node_id != self.node_id]
+            if health.enabled() and len(members) > 1:
+                # read-only quorum probe: ask the healthiest member
+                # first so one browning-out peer can't put its full RPC
+                # timeout in front of every progress check
+                members = health.SCORER.rank(
+                    members,
+                    lambda v: self.meta.node_addr(v.node_id)
+                    or f"node:{v.node_id}")
+            for v in members:
                 try:
                     r = self._rpc(v.node_id, "replica_progress",
                                   {"owner": owner, "rs": rs.to_dict(),
@@ -1060,11 +1111,52 @@ class Coordinator:
         alternates (reference opener.rs:84-120 remote open +
         reader/mod.rs:36 broken-replica failover). `fingerprint` tags the
         RPC with the serving-plane query identity so the owning node's
-        scan cache + stage counters attribute the work cluster-wide."""
+        scan cache + stage counters attribute the work cluster-wide.
+
+        With the gray-failure plane on (the default), failover
+        candidates are health-ranked instead of fixed-order and the scan
+        is hedged against tail latency; CNOSDB_HEDGE=0 restores the
+        legacy byte-identical routing below."""
+        targets = [(split.vnode_id, split.node_id)] + list(split.alternates)
+        if not health.enabled():
+            return self._scan_remote_solo(split, targets, field_names,
+                                          fingerprint)
+        targets = self._rank_targets(targets, split)
+        return self._scan_remote_hedged(split, targets, field_names,
+                                        fingerprint)
+
+    def _rank_targets(self, targets: list, split: PlacedSplit) -> list:
+        """Health-ranked FAILOVER order for one split's (vnode, node)
+        candidates. The planner's primary choice (the live raft leader,
+        or its healthy stand-in when the leader is meta-BROKEN) stays
+        pinned at the head: leader-follow is what gives scans
+        read-your-writes — a follower that hasn't applied the tail of
+        the log yet answers with silently-missing rows, so health may
+        never promote a replica into the primary slot. Everything
+        after the head is health-ordered: local placements first, then
+        power-of-two-choices among scorer-HEALTHY replicas, DEGRADED
+        next, scorer-BROKEN after — and meta-BROKEN replicas stay
+        pinned at the very tail (meta marks them data-suspect; the
+        scorer only judges responsiveness, never data state). A
+        browned-out leader is therefore rescued by the hedge lane, not
+        by re-routing the primary."""
+        head, rest = targets[:1], targets[1:]
+        live = [t for t in rest if t[0] not in split.broken_ids]
+        tail = [t for t in rest if t[0] in split.broken_ids]
+
+        def addr_of(t):
+            if t[1] == self.node_id:
+                return None
+            return self.meta.node_addr(t[1]) or f"node:{t[1]}"
+
+        return head + health.SCORER.rank(live, addr_of) + tail
+
+    def _scan_remote_solo(self, split: PlacedSplit, targets, field_names,
+                          fingerprint: str | None = None) -> ScanBatch | None:
+        """Legacy fixed-order failover loop (CNOSDB_HEDGE=0 A/B path)."""
         from .ipc import decode_scan_batch
         from .net import RpcError, RpcUnavailable
 
-        targets = [(split.vnode_id, split.node_id)] + list(split.alternates)
         last_unreach = None
         last_reject = None
         for vnode_id, node_id in targets:
@@ -1112,6 +1204,265 @@ class Coordinator:
             if last_unreach is not None:
                 msg += f" (other replicas unreachable: {last_unreach})"
             raise CoordinatorError(msg) from last_reject
+        raise CoordinatorError(
+            f"all replicas unreachable for vnode {split.vnode_id} "
+            f"of {split.owner}") from last_unreach
+
+    def _hedge_delay_s(self, node_id: int) -> float:
+        """Adaptive hedge trigger for an attempt against `node_id`: that
+        node's (addr, scan) p95, floored by [query] hedge_delay_ms_floor
+        so a microsecond warm-cache p95 can't hedge every call."""
+        floor_s = health.HEDGE_DELAY_FLOOR_MS / 1e3
+        if node_id == self.node_id:
+            return floor_s
+        addr = self.meta.node_addr(node_id)
+        if not addr:
+            return floor_s
+        return health.SCORER.hedge_delay(addr, "scan", floor_s=floor_s)
+
+    def _scan_remote_hedged(self, split: PlacedSplit, targets, field_names,
+                            fingerprint: str | None = None):
+        """Hedged scan over health-ranked targets — the tail-latency
+        defense (fires unless CNOSDB_HEDGE=0).
+
+        The best-ranked target is tried exactly as the legacy path
+        would; if it hasn't answered within the adaptive hedge delay
+        (its (addr, scan) p95, floored by config and capped by the
+        remaining Deadline budget), the SAME scan fires at the
+        next-ranked replica under a derived child deadline carrying its
+        OWN hedge qid. The first success wins bit-identically (replicas
+        are raft-converged, and the winner's IPC bytes decode the same
+        whoever served them); every other in-flight attempt is
+        cancelled through the cancel_scan fan-out, which names only the
+        loser's hedge qid so the query's scans of OTHER vnodes are
+        untouched. A failed attempt triggers immediate failover to the
+        next target — failovers are not hedges and skip the limiter.
+        Every exit of this lane books into cnosdb_hedge_total
+        (hedge-accounting lint rule)."""
+        from .ipc import decode_scan_batch
+        from .net import RpcError, RpcThrottled, RpcUnavailable
+
+        parent = deadline_mod.current()
+        base_qid = (parent.qid if parent is not None else None) or "scan"
+        resq: queue_mod.Queue = queue_mod.Queue()
+        inflight: dict[int, dict] = {}       # attempt idx → {dl, ...}
+        hedges_fired = 0
+        next_target = 0
+        armed = True          # one suppression verdict per scan
+        last_unreach = last_reject = None
+        throttled_idxs: list[int] = []   # slow-start-refused targets
+
+        def launch(is_hedge: bool, idx: int | None = None,
+                   bypass_ramp: bool = False) -> None:
+            nonlocal next_target, hedges_fired
+            if idx is None:
+                idx = next_target
+                next_target += 1
+            bypass_ramp = bypass_ramp or is_hedge
+            vnode_id, node_id = targets[idx]
+            with self._hedge_lock:
+                self._hedge_seq += 1
+                seq = self._hedge_seq
+            child = deadline_mod.derived(f"{base_qid}#h{seq}")
+            ctx = contextvars.copy_context()   # profile rides along
+            holds_slot = is_hedge
+
+            def attempt():
+                try:
+                    with deadline_mod.scope(child):
+                        if node_id == self.node_id:
+                            if self.engine.vnode(split.owner,
+                                                 vnode_id) is None:
+                                # placement says local but the data is
+                                # absent (dropped / never installed)
+                                resq.put((idx, "skip", None))
+                                return
+                            alt = PlacedSplit(split.owner, vnode_id,
+                                              split.table,
+                                              split.time_ranges,
+                                              split.tag_domains)
+                            resq.put((idx, "local",
+                                      self._scan_local(alt, field_names)))
+                            return
+                        r = self._rpc(node_id, "scan_vnode", {
+                            "owner": split.owner, "vnode_id": vnode_id,
+                            "table": split.table,
+                            "trs": split.time_ranges.to_wire(),
+                            "doms": split.tag_domains.to_wire(),
+                            "field_names": field_names,
+                            "fp": fingerprint,
+                        }, hedge=bypass_ramp)
+                        resq.put((idx, "remote", r))
+                except RpcThrottled as e:
+                    # slow-start ramp refusal: the peer was never
+                    # contacted — not evidence of a broken replica
+                    resq.put((idx, "unreach", e))
+                except RpcUnavailable as e:
+                    self._mark_vnode_broken(vnode_id)
+                    resq.put((idx, "unreach", e))
+                except RpcError as e:
+                    resq.put((idx, "reject", e))
+                except BaseException as e:
+                    # deadline expiry / cancel / local engine failure —
+                    # the collector decides whether it unwinds the query
+                    resq.put((idx, "error", e))
+                finally:
+                    if holds_slot:
+                        self._hedge_limiter.release()
+
+            inflight[idx] = {"dl": child, "vnode_id": vnode_id,
+                             "node_id": node_id, "hedge": is_hedge,
+                             "t0": time.monotonic()}
+            if is_hedge:
+                hedges_fired += 1
+                health.count_hedge("fired")
+                stages.count("hedge.fired")
+            threading.Thread(target=ctx.run, args=(attempt,), daemon=True,
+                             name=f"hedge-scan-{base_qid}-{seq}").start()
+
+        def abandon(reason: str) -> None:
+            """Cancel every still-in-flight attempt (their own hedge
+            qids only) and book the cancellations. Each loser's
+            elapsed-so-far is fed to the scorer as a censored latency
+            sample — the loser IS at least this slow, and waiting for
+            its reply to land before learning that would keep routing
+            scans at a straggler for a full brownout-latency window."""
+            now = time.monotonic()
+            for o in inflight.values():
+                o["dl"].cancel(reason)
+                # best-effort cancel off the query thread: delivering it
+                # to the loser synchronously would make every rescued
+                # query pay the straggler's latency all over again
+                threading.Thread(
+                    target=self.cancel_remote_scans, args=(o["dl"],),
+                    daemon=True,
+                    name=f"hedge-cancel-{base_qid}").start()
+                if o["node_id"] != self.node_id:
+                    addr = self.meta.node_addr(o["node_id"])
+                    if addr:
+                        health.SCORER.observe_censored(
+                            addr, "scan", now - o["t0"])
+                health.count_hedge("cancelled")
+                stages.count("hedge.cancelled")
+            inflight.clear()
+
+        launch(is_hedge=False)
+        while inflight:
+            wait_s = None
+            if armed and inflight:
+                # the hedge trigger is the cheaper of the NEWEST launched
+                # attempt's scan p95 and the NEXT candidate's: a hedge is
+                # worth firing once the outstanding call is slower than
+                # what the alternate typically delivers (so a scan routed
+                # to a known-slow replica — stale score, exploration — is
+                # rescued at the fast replica's pace, not the slow one's).
+                # Capped by the remaining deadline budget.
+                wait_s = self._hedge_delay_s(targets[next_target - 1][1])
+                if next_target < len(targets):
+                    wait_s = min(wait_s,
+                                 self._hedge_delay_s(targets[next_target][1]))
+                if parent is not None:
+                    rem = parent.remaining()
+                    if rem is not None:
+                        wait_s = min(wait_s, max(rem, 0.0))
+            try:
+                idx, kind, value = resq.get(timeout=wait_s)
+            except queue_mod.Empty:
+                # trigger elapsed, attempt still in flight: hedge — or
+                # book exactly why not (the suppression accounting is
+                # what proves hedging stays tail-only). A target that
+                # was refused by the slow-start ramp stays eligible
+                # HERE: the ramp gates organic reads, while a hedge is
+                # a single limiter-capped rescue probe that bypasses it
+                # — without the retry, a ramping replica plus a browned
+                # primary leaves the query waiting out the full
+                # brownout with no alternate at all.
+                retry_idx = None
+                if next_target >= len(targets):
+                    if not throttled_idxs:
+                        health.count_hedge("suppressed", "no_alternate")
+                        stages.count("hedge.suppressed")
+                        armed = False
+                        continue
+                    retry_idx = throttled_idxs[0]
+                rem = parent.remaining() if parent is not None else None
+                if parent is not None and (parent.dead()
+                                           or (rem is not None
+                                               and rem <= 0.05)):
+                    # no budget left to pay for a second attempt; the
+                    # in-flight socket timeout is capped by the same
+                    # budget and will resolve the scan shortly
+                    health.count_hedge("suppressed", "no_budget")
+                    stages.count("hedge.suppressed")
+                    armed = False
+                    continue
+                if not self._hedge_limiter.try_acquire(
+                        health.HEDGE_MAX_INFLIGHT):
+                    health.count_hedge("suppressed", "limiter")
+                    stages.count("hedge.suppressed")
+                    armed = False
+                    continue
+                if retry_idx is not None:
+                    throttled_idxs.pop(0)
+                launch(is_hedge=True, idx=retry_idx)
+                continue
+            a = inflight.pop(idx, None)
+            if a is None:     # late result of an already-settled attempt
+                continue
+            if kind in ("local", "remote"):
+                won_by_hedge = a["hedge"]
+                abandon("hedge loser")
+                if won_by_hedge:
+                    health.count_hedge("won")
+                    stages.count("hedge.won")
+                lost = hedges_fired - (1 if won_by_hedge else 0)
+                if lost > 0:
+                    health.count_hedge("lost", n=lost)
+                if a["vnode_id"] in split.broken_ids:
+                    self._clear_vnode_broken(a["vnode_id"])  # self-heal
+                if kind == "local":
+                    return value
+                raw = value.get("ipc")
+                if raw is None:
+                    return None
+                return decode_scan_batch(raw)
+            if kind == "error" and not a["hedge"]:
+                # primary-lineage failure of the typed kind the legacy
+                # loop propagates immediately (deadline gone, cancel,
+                # local checksum damage): unwind instead of retrying
+                # replicas with a budget/state that is already dead
+                if hedges_fired:
+                    health.count_hedge("lost", n=hedges_fired)
+                abandon("hedge abort")
+                raise value
+            # failed / skipped attempt: record and fail over
+            if kind == "unreach":
+                last_unreach = value
+                if isinstance(value, RpcThrottled):
+                    throttled_idxs.append(idx)   # hedge may retry it
+            elif kind in ("reject", "error"):
+                last_reject = value
+            if not inflight:
+                if next_target < len(targets):
+                    launch(is_hedge=False)   # failover, not a hedge
+                elif throttled_idxs:
+                    # nothing left but ramp-refused targets: a refusal
+                    # is load-shedding, not unavailability — retry past
+                    # the ramp rather than failing the whole scan
+                    launch(is_hedge=False, idx=throttled_idxs.pop(0),
+                           bypass_ramp=True)
+        if hedges_fired:
+            health.count_hedge("lost", n=hedges_fired)
+        if last_reject is not None:
+            # at least one replica ANSWERED and rejected the scan — an
+            # app-level error, not an availability problem
+            stages.count_error("hedge.exhausted")
+            msg = (f"scan of vnode {split.vnode_id} of {split.owner} "
+                   f"rejected: {last_reject}")
+            if last_unreach is not None:
+                msg += f" (other replicas unreachable: {last_unreach})"
+            raise CoordinatorError(msg) from last_reject
+        stages.count_error("hedge.exhausted")
         raise CoordinatorError(
             f"all replicas unreachable for vnode {split.vnode_id} "
             f"of {split.owner}") from last_unreach

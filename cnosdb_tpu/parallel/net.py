@@ -20,6 +20,7 @@ import hmac
 import http.client
 import logging
 import os
+import socket
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -28,6 +29,7 @@ from socket import timeout as socket_timeout
 import msgpack
 
 from .. import faults
+from . import health
 from ..errors import CnosError, DeadlineExceeded
 from ..utils import deadline as deadline_mod
 from ..utils import stages
@@ -69,6 +71,12 @@ class RpcUnavailable(RpcError):
     """Peer unreachable (connection refused / reset / timeout)."""
 
 
+class RpcThrottled(RpcUnavailable):
+    """Call refused locally by the breaker's slow-start ramp — the peer
+    was never contacted, so this is NOT evidence of a broken replica
+    (failover paths must not mark vnodes broken on it)."""
+
+
 def pack(obj) -> bytes:
     return msgpack.packb(obj, use_bin_type=True)
 
@@ -95,6 +103,10 @@ class RpcServer:
 
         class Handler(BaseHTTPRequestHandler):
             protocol_version = "HTTP/1.1"
+            # small replies otherwise stall ~40ms on Nagle + delayed-ACK
+            # — a latency floor that buries every probe/cancel RPC and
+            # poisons the health scorer's latency baselines
+            disable_nagle_algorithm = True
 
             def log_message(self, *a):  # quiet
                 pass
@@ -224,26 +236,52 @@ class _ConnPool:
     TCP connection for every raft message."""
 
     MAX_IDLE_PER_ADDR = 8
+    # idle keep-alives older than this are closed instead of reused: a
+    # peer restart leaves dead sockets behind, and every one of them
+    # burns a connect-error + retry on its next use; age-evicting keeps
+    # the stale-keep-alive race to the recently-active window
+    MAX_IDLE_AGE_S = float(os.environ.get("CNOSDB_RPC_IDLE_MAX_AGE_S", "30"))
 
     def __init__(self):
         self.lock = lockwatch.Lock("net.conn_pool")
-        self.idle: dict[str, list[http.client.HTTPConnection]] = {}
+        # addr → [(conn, idle_since_monotonic), ...]; LIFO so the
+        # freshest (least likely stale) connection is reused first
+        self.idle: dict[str, list] = {}
 
     def get(self, addr: str, timeout: float):
         """→ (conn, reused) — reused connections may be stale keep-alives."""
+        now = time.monotonic()
+        stale, conn = [], None
         with self.lock:
             conns = self.idle.get(addr)
-            if conns:
-                return conns.pop(), True
+            while conns:
+                c, t = conns.pop()
+                if now - t > self.MAX_IDLE_AGE_S:
+                    stale.append(c)
+                    continue
+                conn = c
+                break
+        for c in stale:   # close outside the pool lock
+            c.close()
+        if conn is not None:
+            return conn, True
         host, _, port = addr.rpartition(":")
-        return http.client.HTTPConnection(host, int(port),
-                                          timeout=timeout), False
+        conn = http.client.HTTPConnection(host, int(port), timeout=timeout)
+        try:
+            # connect eagerly so TCP_NODELAY applies to the FIRST request
+            # too; the ~40ms Nagle/delayed-ACK stall on small payloads
+            # would otherwise dwarf every probe/cancel RPC
+            conn.connect()
+            conn.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        except OSError:
+            pass   # unreachable peers surface on send, same as before
+        return conn, False
 
     def put(self, addr: str, conn):
         with self.lock:
             conns = self.idle.setdefault(addr, [])
             if len(conns) < self.MAX_IDLE_PER_ADDR:
-                conns.append(conn)
+                conns.append((conn, time.monotonic()))
                 return
         conn.close()
 
@@ -297,12 +335,26 @@ def rpc_call(addr: str, method: str, payload: dict | None = None,
     tid = current_trace_header()
     if tid:
         hdrs[TRACE_HEADER] = tid
+
+    # gray-failure signal: EVERY completion of this call (success, typed
+    # rejection, unreachable, deadline) feeds the process-global health
+    # scorer; burn = fraction of the capped budget the hop consumed, only
+    # meaningful when a deadline bounded the hop
+    t0 = time.perf_counter()
+    bounded = dl is not None and dl.remaining() is not None
+
+    def _obs(outcome: str) -> None:
+        elapsed = time.perf_counter() - t0
+        burn = (elapsed / timeout) if bounded and timeout > 0 else None
+        health.SCORER.observe(addr, method, elapsed, outcome, burn=burn)
+
     if faults.ENABLED:
         try:
             # simulated network partition toward (addr, method): checked
             # once per call, before any bytes move — the peer never sees it
             faults.fire("rpc.send", addr=addr, method=method)
         except faults.FaultInjected as e:
+            _obs(health.UNREACHABLE)
             raise RpcUnavailable(f"{method}@{addr}: {e}") from e
     for attempt in range(_ConnPool.MAX_IDLE_PER_ADDR + 1):
         conn, reused = _pool.get(addr, timeout)
@@ -319,6 +371,7 @@ def rpc_call(addr: str, method: str, payload: dict | None = None,
             conn.close()
             if reused and not isinstance(e, (TimeoutError, socket_timeout)):
                 continue
+            _obs(health.UNREACHABLE)
             raise RpcUnavailable(f"{method}@{addr}: {e}") from e
         try:
             if faults.ENABLED:
@@ -334,8 +387,15 @@ def rpc_call(addr: str, method: str, payload: dict | None = None,
             # response-phase failure: the server may have fully processed a
             # non-idempotent mutation whose reply was lost — NEVER retry
             conn.close()
+            _obs(health.UNREACHABLE)
             raise RpcUnavailable(f"{method}@{addr}: {e}") from e
-        _pool.put(addr, conn)
+        if resp.status == 200:
+            _pool.put(addr, conn)
+        else:
+            # an errored exchange may leave the stream mid-frame (chunked
+            # error bodies, aborted handlers): never pool it — the reuse
+            # would surface as an unrelated stale-keep-alive failure later
+            conn.close()
         if prof is not None and isinstance(reply, dict) \
                 and "_profile" in reply:
             sub = reply.pop("_profile")
@@ -351,15 +411,20 @@ def rpc_call(addr: str, method: str, payload: dict | None = None,
         if resp.status == 403:
             # typed: auth misconfiguration is permanent — retry loops that
             # catch RpcError/RpcUnavailable must be able to fail fast
+            _obs(health.REJECTED)
             raise RpcUnauthorized(f"{method}@{addr}: {reply.get('_msg')}")
         if resp.status != 200:
             if reply.get("_err") == "DeadlineExceeded":
                 # typed: failover loops must unwind, not try the next
                 # replica with a budget that is already gone
+                _obs(health.DEADLINE)
                 raise DeadlineExceeded(f"{method}@{addr}: {reply.get('_msg')}")
+            _obs(health.REJECTED)
             raise RpcError(f"{method}@{addr}: "
                            f"{reply.get('_err')}: {reply.get('_msg')}")
+        _obs(health.OK)
         return reply
+    _obs(health.UNREACHABLE)
     raise RpcUnavailable(f"{method}@{addr}: pooled connections exhausted")
 
 

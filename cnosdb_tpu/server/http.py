@@ -58,6 +58,12 @@ class HttpServer:
         # enforced in the executor so KILLed/expired queries still log
         executor.slow_query_threshold_ms = \
             int(getattr(qc, "slow_query_threshold_ms", 0) or 0)
+        # gray-failure plane: push [query] hedge knobs into the
+        # process-global health scorer (the coordinator reads the module
+        # globals at hedge time, so late configure() is fine)
+        from ..parallel import health as _health
+
+        _health.configure(qc)
         self.gate = AdmissionGate(qc.max_concurrent_queries,
                                   qc.max_queued_queries)
         # the serving plane's micro-batcher keys its fuse-or-solo decision
@@ -87,7 +93,7 @@ class HttpServer:
             web.get("/api/traces", self.handle_jaeger_traces),
             web.get("/api/traces/{trace_id}", self.handle_jaeger_trace),
             web.get("/metrics", self.handle_metrics),
-            web.get("/debug/health", self.handle_ping),
+            web.get("/debug/health", self.handle_health),
             web.get("/debug/traces", self.handle_traces),
             web.get("/debug/profile", self.handle_profile),
             web.get("/debug/backtrace", self.handle_backtrace),
@@ -852,6 +858,56 @@ class HttpServer:
 
         return web.json_response(lockwatch.report())
 
+    async def handle_health(self, request):
+        """Gray-failure tolerance plane (parallel/health.py): per-node
+        health scores (state, err/burn EWMAs, per-method-class latency
+        quantiles), the coordinator's circuit-breaker table, slow-start
+        ramps in progress, and the hedge/breaker transition counters.
+        All zeros/empty until this node has coordinated remote work."""
+        self._require_admin(request)
+        from ..parallel import health
+
+        hedge, breaker = health.counters_snapshot()
+        now = time.monotonic()
+        cb = {}
+        for node_id, st in list(self.coord._cb.items()):
+            open_for = st[1] - now
+            cb[str(node_id)] = {
+                "consecutive_failures": st[0],
+                "state": "open" if open_for > 0 else "closed",
+                "open_remaining_s": round(max(0.0, open_for), 3),
+            }
+        # raft-member introspection: a gray failure often looks like "the
+        # follower silently stopped applying" — surface every local
+        # member's role/term/log/commit/applied so that is one curl away
+        raft = {}
+        mgr = self.coord._replica_mgr
+        if mgr is not None:
+            for (gid, vid), node in list(mgr.transport.nodes.items()):
+                raft[f"{gid}#{vid}"] = {
+                    "role": node.role, "term": node.term,
+                    "leader_id": node.leader_id, "alive": node.alive,
+                    "last_index": node.log.last_index(),
+                    "commit": node.commit_index,
+                    "applied": node.last_applied,
+                }
+        return web.json_response({
+            "hedging_enabled": health.enabled(),
+            "hedge_delay_ms_floor": health.HEDGE_DELAY_FLOOR_MS,
+            "hedge_max_inflight": health.HEDGE_MAX_INFLIGHT,
+            "hedge_inflight": self.coord._hedge_limiter.inflight(),
+            "raft_members": raft,
+            "nodes": health.SCORER.snapshot(),
+            "breakers": cb,
+            "slow_start": health.SLOW_START.ramping(),
+            "counters": {
+                "hedge": {f"{o}:{r}" if r else o: n
+                          for (o, r), n in sorted(hedge.items())},
+                "breaker": {f"{node}:{state}": n
+                            for (node, state), n in sorted(breaker.items())},
+            },
+        })
+
     async def handle_metrics(self, request):
         from ..utils import executor, stages
 
@@ -994,6 +1050,19 @@ class HttpServer:
                                          op=op, outcome=outcome)
             self.metrics.set_gauge("cnosdb_backup_archive_lag_seconds",
                                    _bk.archive_lag_seconds())
+        # gray-failure plane: hedge outcomes (fired/won/lost/cancelled/
+        # suppressed, with suppression reason) and breaker state
+        # transitions per node. True counters so rate() catches a node
+        # flapping open/closed or a hedge storm.
+        from ..parallel import health as _health
+
+        _hedge, _breaker = _health.counters_snapshot()
+        for (outcome, reason), n in _hedge.items():
+            self.metrics.set_counter("cnosdb_hedge_total", n,
+                                     outcome=outcome, reason=reason or "-")
+        for (node, state), n in _breaker.items():
+            self.metrics.set_counter("cnosdb_breaker_total", n,
+                                     node=node, state=state)
         # nemesis plane: checker verdicts + recovery timings — resident
         # only when a chaos suite has run in this process
         _ch = _sys.modules.get("cnosdb_tpu.chaos")

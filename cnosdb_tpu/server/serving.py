@@ -627,10 +627,23 @@ def _fused_exec(executor, members: list[_Member], tenant: str, db: str):
                 and repr(m.plan.tag_domains) != repr(doms):
             doms = ColumnDomains.all()
             break
+    # hedged members under fused batches: the shared scan runs ONCE under
+    # the leader's deadline, so any hedges its remote splits fire serve
+    # every member of the group — book the delta to the leader's profile
+    # (process-wide counters, so concurrent queries' hedges can bleed in;
+    # the count is attribution telemetry, not an exact invariant)
+    from ..parallel import health as health_mod
+
+    h0 = sum(v for (o, _r), v in health_mod.counters_snapshot()[0].items()
+             if o == "fired")
     with stages.stage("serving.fused_scan_ms"):
         batches = executor.coord.scan_table(
             tenant, db, plan0.table, time_ranges=plan0.time_ranges,
             tag_domains=doms, field_names=members[0].field_names)
+    h1 = sum(v for (o, _r), v in health_mod.counters_snapshot()[0].items()
+             if o == "fired")
+    if h1 > h0:
+        stages.count("serving.fused_hedges", h1 - h0)
     filters = [m.plan.filter for m in members]
     filter_cols = set()
     for f in filters:

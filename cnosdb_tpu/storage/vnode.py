@@ -83,6 +83,12 @@ class VnodeStorage:
         # post-flush callback set by the storage engine (materialized
         # rollup maintenance); fired OUTSIDE the vnode lock
         self.on_flush = None
+        # highest WAL seq whose mutation is REFLECTED in files+memcache.
+        # Distinct from wal.next_seq-1: under replication the WAL doubles
+        # as the raft log, so entries are durable at replication time but
+        # only visible at apply time — a scan token must describe what a
+        # scan can see, not what the log stores (see scan_token()).
+        self.applied_seq = self.summary.version.flushed_seq
         self._replay_wal()
 
     def scan_token(self) -> ScanToken:
@@ -96,7 +102,12 @@ class VnodeStorage:
                 self.destructive_version,
                 frozenset(fm.file_id
                           for fm in self.summary.version.all_files()),
-                self.wal.next_seq - 1)
+                # applied_seq, NOT wal.next_seq-1: a raft-replicated entry
+                # sits in the WAL before it commits/applies. A token taken
+                # in that window must not claim the entry's seq — the
+                # delta path (DeltaVnodeView, seq > token.mem_seq) would
+                # then skip its rows forever once they apply.
+                self.applied_seq)
 
     # ------------------------------------------------------------------ boot
     def _replay_wal(self):
@@ -116,6 +127,8 @@ class VnodeStorage:
             if sync:
                 self.wal.sync()
             self._apply_write(batch, seq)
+            if seq > self.applied_seq:
+                self.applied_seq = seq
             return seq
 
     def apply_entry(self, entry_type: int, data: bytes, seq: int):
@@ -125,6 +138,10 @@ class VnodeStorage:
             self._apply_entry(entry_type, data, seq, logged=True)
 
     def _apply_entry(self, entry_type: int, data: bytes, seq: int, logged: bool):
+        # advance even for no-op entries (blank/membership, empty deletes):
+        # the entry's full effect is reflected once this call returns
+        if seq > self.applied_seq:
+            self.applied_seq = seq
         if entry_type == WalEntryType.WRITE:
             self._apply_write(WriteBatch.decode(data), seq)
         elif entry_type == WalEntryType.DELETE_TABLE:
@@ -593,8 +610,9 @@ class VnodeStorage:
     def drop_table(self, table: str):
         with self.lock:
             data = msgpack.packb({"table": table})
-            self.wal.append(WalEntryType.DELETE_TABLE, data)
+            seq = self.wal.append(WalEntryType.DELETE_TABLE, data)
             self._apply_drop_table(table)
+            self.applied_seq = max(self.applied_seq, seq)
 
     def _apply_drop_table(self, table: str):
         self.data_version += 1
@@ -611,8 +629,9 @@ class VnodeStorage:
     def delete_series(self, table: str, sids: list[int]):
         with self.lock:
             data = msgpack.packb({"table": table, "sids": [int(s) for s in sids]})
-            self.wal.append(WalEntryType.DELETE_SERIES, data)
+            seq = self.wal.append(WalEntryType.DELETE_SERIES, data)
             self._apply_delete_series(table, sids)
+            self.applied_seq = max(self.applied_seq, seq)
 
     def _apply_delete_series(self, table: str, sids):
         self.data_version += 1
@@ -631,8 +650,9 @@ class VnodeStorage:
                 "table": table,
                 "sids": [int(s) for s in sids] if sids is not None else None,
                 "min_ts": int(min_ts), "max_ts": int(max_ts)})
-            self.wal.append(WalEntryType.DELETE_TIME_RANGE, data)
+            seq = self.wal.append(WalEntryType.DELETE_TIME_RANGE, data)
             self._apply_delete_time_range(table, sids, min_ts, max_ts)
+            self.applied_seq = max(self.applied_seq, seq)
 
     def _apply_delete_time_range(self, table: str, sids, min_ts: int, max_ts: int):
         self.data_version += 1
@@ -662,9 +682,10 @@ class VnodeStorage:
                 "table": table,
                 "old_keys": [k.encode() for k in old_keys],
                 "new_keys": [k.encode() for k in new_keys]})
-            self.wal.append(WalEntryType.UPDATE_TAGS, data)
+            seq = self.wal.append(WalEntryType.UPDATE_TAGS, data)
             self._apply_update_tags(table, [k.encode() for k in old_keys],
                                     [k.encode() for k in new_keys])
+            self.applied_seq = max(self.applied_seq, seq)
 
     # ------------------------------------------------------------------ stats
     def series_count(self) -> int:

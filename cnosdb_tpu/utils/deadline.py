@@ -133,6 +133,21 @@ def from_wire(deadline_at_ms: int | None, qid: str | None = None) -> Deadline:
     return Deadline(deadline_at_ms / 1000.0 - time.time(), qid=qid)  # lint: disable=wallclock-duration (wire form IS wall-clock epoch ms — skew only shifts patience, socket timeout is the hard bound)
 
 
+def derived(qid: str | None) -> Deadline:
+    """Per-attempt child context for hedged fan-out: shares the calling
+    thread's remaining budget (same monotonic expiry — a hedge must
+    never outlive the query) but carries its OWN qid, so cancelling a
+    losing hedge attempt through CANCELS / cancel_scan never touches
+    the query's other work registered under the parent qid. The child
+    also keeps its own `remote_nodes` set: loser cancel fan-out targets
+    exactly the nodes that attempt reached."""
+    parent = current()
+    d = Deadline(None, qid=qid)
+    if parent is not None:
+        d.expires_at = parent.expires_at
+    return d
+
+
 def current() -> Deadline | None:
     return getattr(_tls, "dl", None)
 

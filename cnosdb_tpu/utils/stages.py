@@ -133,6 +133,19 @@ STAGE_CATALOG: dict[str, str] = {
                              "batch (booked to the leader's profile)",
     "serving.remote_fp": "scan_vnode RPCs carrying a serving-plane "
                          "fingerprint (cluster-wide cache attribution)",
+    "serving.fused_hedges": "hedged scan attempts fired during a fused "
+                            "micro-batch's shared scan (booked to the "
+                            "leader; process-wide delta, so concurrent "
+                            "queries' hedges can bleed in)",
+    "hedge.fired": "hedged scan attempts launched at a next-ranked "
+                   "replica after the adaptive p95 trigger elapsed",
+    "hedge.won": "scans answered by a hedge attempt instead of the "
+                 "primary (the tail the plane exists to cut)",
+    "hedge.cancelled": "losing hedge/primary attempts cancelled through "
+                       "the cancel_scan(qid) fan-out after a winner",
+    "hedge.suppressed": "hedge triggers that elapsed without firing "
+                        "(limiter / no budget / no alternate — proves "
+                        "hedging stays tail-only)",
 }
 
 # Prefixes for names composed at runtime (skipped by the literal lint

@@ -1,0 +1,26 @@
+"""Fixture: hedge-lane exits that skip cnosdb_hedge_total accounting
+(lines 12 and 15). Mirrors the guarded function name so the rule finds
+its target when scope is ignored; the booked return at 18-19, the Name
+return at 21-22, the None return at 24, the booked terminal raise at
+25-26 are legal shapes and must stay silent."""
+
+
+def _scan_remote_hedged(split, targets, count_hedge, count_error):
+    inflight = {}
+    for idx, (vnode_id, node_id) in enumerate(targets):
+        if node_id is None:
+            raise RuntimeError("unplaced replica")
+        inflight[idx] = vnode_id
+    if not targets:
+        return []
+    result = inflight.get(0)
+    if split is None:
+        count_hedge("suppressed", "no_alternate")
+        return []
+    if result is not None:
+        count_hedge("won")
+        return result
+    if not inflight:
+        return None
+    count_error("hedge.exhausted")
+    raise RuntimeError("all replicas unreachable")

@@ -35,3 +35,16 @@ def tmp_engine_dir(tmp_path):
     d = tmp_path / "engine"
     d.mkdir()
     return str(d)
+
+
+@pytest.fixture(autouse=True)
+def _isolate_health_state():
+    """The gray-failure plane keeps process-global node state (latency
+    scorer, slow-start ramps, hedge/breaker counters). Left standing, a
+    breaker tripped in one test throttles RPCs in the next."""
+    from cnosdb_tpu.parallel import health
+
+    health.SCORER.reset()
+    health.SLOW_START.reset()
+    health.reset_counters()
+    yield

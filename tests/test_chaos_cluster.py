@@ -441,3 +441,99 @@ def test_nemesis_mix_preserves_client_invariants(cluster, tmp_path):
     for n in cluster.nodes:
         final = _wait_keys(n, "nm", "dnem", live, timeout=90.0)
         _assert_checks(h, final, f"{ctx}\nfinal state on node {n.node_id}")
+
+
+def _hedges_fired(node) -> int:
+    total = 0
+    for line in node.http("GET", "/metrics").splitlines():
+        if line.startswith("cnosdb_hedge_total") \
+                and 'outcome="fired"' in line:
+            total += int(float(line.rsplit(" ", 1)[1]))
+    return total
+
+
+def test_slow_replica_brownout_tail_bounded(cluster):
+    """Gray failure (slow_replica nemesis): one replica holder keeps
+    answering every RPC, just 120ms late. The hedged-scan plane on the
+    querying coordinator must (a) fire zero hedges while the cluster is
+    healthy, (b) engage during the brownout, and (c) hold the query p99
+    within 3x the healthy p99 — while every answer stays correct before,
+    during, and after (checker green)."""
+    from cnosdb_tpu.chaos import nemesis
+
+    n1 = cluster.nodes[0]
+    n1.sql("CREATE DATABASE dgray WITH SHARD 1 REPLICA 2", db="public")
+    base = 1_800_000_000_000_000_000
+    rows = 400
+    lines = "\n".join(
+        f"gray,host=h{i % 7} v={float(i)} {base + i * 1_000_000}"
+        for i in range(rows))
+    n1.write_lp(lines, db="dgray")
+    assert _wait_count(n1, "gray", "dgray", rows) == rows
+
+    # REPLICA 2 on 3 nodes: exactly one node holds nothing locally — the
+    # one whose scans go dark when all its outbound sends are dropped.
+    # Query from THAT node, so every scan crosses the wire with two
+    # replica candidates (local replicas always outrank remote ones).
+    qnode = None
+    for n in cluster.nodes:
+        others = [o for o in cluster.nodes if o is not n]
+        _set_faults(n, ";".join(f"rpc.send:fail:if=127.0.0.1:{o.rpc_port}"
+                                for o in others))
+        try:
+            ok = _wait_count(n, "gray", "dgray", rows, timeout=5.0) == rows
+        finally:
+            _set_faults(n, "")
+        if not ok:
+            qnode = n
+            break
+    assert qnode is not None, "some node should hold no local replica"
+    holders = [n for n in cluster.nodes if n is not qnode]
+
+    q = "SELECT count(*), sum(v) FROM gray"
+    baseline = _csv_rows(qnode.sql(q, db="dgray"))[0]
+    assert int(baseline[0]) == rows
+
+    def phase(n):
+        lat = []
+        for _ in range(n):
+            t0 = time.monotonic()
+            got = _csv_rows(qnode.sql(q, db="dgray"))[0]
+            lat.append(time.monotonic() - t0)
+            assert got == baseline     # correct under all conditions
+        lat.sort()
+        return lat[min(len(lat) - 1, int(0.99 * len(lat)))]
+
+    phase(5)                           # warm caches + latency sketches
+    fired0 = _hedges_fired(qnode)
+    healthy_p99 = phase(30)
+    assert _hedges_fired(qnode) == fired0, \
+        "hedges fired on a healthy cluster — hedging must be tail-only"
+
+    # brown out the holder the coordinator currently PREFERS (the one
+    # whose scan lane carries the most samples): worst case, primary
+    # traffic lands on the straggler until the plane reacts
+    snap = json.loads(qnode.http("GET", "/debug/health"))["nodes"]
+    def scan_samples(node):
+        cell = snap.get(f"127.0.0.1:{node.rpc_port}", {})
+        return cell.get("classes", {}).get("scan", {}).get("samples", 0)
+    victim = max(holders, key=scan_samples)
+    ev = nemesis.NemesisEvent(step=0, kind="slow_replica",
+                              node=victim.node_id, param=120)
+    vspec, peers = nemesis.event_specs(
+        ev, f"127.0.0.1:{victim.rpc_port}", seed=11)
+    assert peers == ""                 # gray failure: only the victim
+    _set_faults(victim, vspec)
+    try:
+        phase(5)                       # adaptation: rescues + re-ranking
+        browned_p99 = phase(30)
+    finally:
+        _set_faults(victim, nemesis.heal_spec(11, ev))
+    assert _hedges_fired(qnode) > fired0, \
+        "brownout never engaged the hedge lane"
+    bound = max(3 * healthy_p99, 0.1)  # abs floor rides out CI jitter
+    assert browned_p99 <= bound, \
+        f"brownout p99 {browned_p99:.3f}s exceeds {bound:.3f}s " \
+        f"(healthy p99 {healthy_p99:.3f}s)"
+    # healed: same bytes, breaker-free path
+    assert _csv_rows(qnode.sql(q, db="dgray"))[0] == baseline

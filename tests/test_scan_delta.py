@@ -264,3 +264,32 @@ def test_executor_pool_and_metrics_surface():
     assert active.get("scan", 0) >= 0
     # the pool actually runs work, in submission order
     assert executor.run_all("scan", lambda x: x * 2, [1, 2, 3]) == [2, 4, 6]
+
+
+def test_scan_token_excludes_stored_but_unapplied_entries(tmp_path):
+    """Under replication the vnode WAL doubles as the raft log: an entry
+    is stored at replication time but only visible at apply time. A token
+    captured in that window must NOT cover the entry's seq — a cached
+    0-row result would otherwise revalidate as "delta empty" forever once
+    the rows apply (seq > mem_seq filters them out)."""
+    from cnosdb_tpu.storage.vnode import VnodeStorage
+    from cnosdb_tpu.storage.wal import WalEntryType
+
+    v = VnodeStorage(1, str(tmp_path / "v1"))
+    wb = WriteBatch()
+    wb.add_series("cpu", SeriesRows(
+        SeriesKey("cpu", {"host": "a"}), [10, 20],
+        {"usage": (int(ValueType.FLOAT), [1.0, 2.0])}))
+    data = wb.encode()
+    # replication layer stores the entry (append-time durability)...
+    seq = v.wal.append(WalEntryType.WRITE, data)
+    t0 = v.scan_token()
+    assert t0.mem_seq < seq
+    # ...and applies it once the quorum commits
+    v.apply_entry(WalEntryType.WRITE, data, seq)
+    t1 = v.scan_token()
+    assert t1.mem_seq == seq
+    assert t1.data_version > t0.data_version
+    # the delta over the old token now surfaces the applied rows
+    sv = v.active.suffix_view(t0.mem_seq)
+    assert sv is not None and not sv.is_empty
