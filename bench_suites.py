@@ -1261,6 +1261,190 @@ def run_straggler() -> dict:
     return out
 
 
+def run_overload(executor, coord, tenant, db, session) -> dict:
+    """Memory-governance overload suite (server/memory.py plane): a
+    closed-loop mix of ingest writers and wide count(DISTINCT) group-by
+    storms, run three times with the broker budget set so the same
+    workload sits at 0.5×, 1× and 2× of its measured footprint. Per
+    phase it reports the degradation ladder's actions straight from the
+    broker counters — pool reclaims, delayed / backpressure-shed /
+    fail-closed writes, queued-query sheds, group-state spills — plus
+    client-observed p99s and reject counts.
+
+    The correctness headline is `bit_identical`: EVERY storm result in
+    every phase (including the 2× phase, where the accumulator spills
+    to disk) must equal the legacy `CNOSDB_MEMORY=0` oracle row-for-row
+    — memory pressure may slow or shed work, never change an answer.
+    The storm queries carry a unique no-op tag predicate so the serving
+    result cache cannot answer them; each one reaches the accumulator
+    (and its spiller) for real."""
+    import threading as _threading
+
+    from cnosdb_tpu.errors import CnosError
+    from cnosdb_tpu.models.points import SeriesRows, WriteBatch
+    from cnosdb_tpu.models.schema import ValueType
+    from cnosdb_tpu.models.series import SeriesKey
+    from cnosdb_tpu.server import memory as memgov
+
+    if not memgov.enabled():
+        return {"disabled": True}       # CNOSDB_MEMORY=0 A/B runs
+    rng = np.random.default_rng(53)
+    n_hosts, per = 256, 200
+    executor.execute_one(
+        "CREATE TABLE IF NOT EXISTS ov (value DOUBLE, TAGS(host))",
+        session)
+    for h in range(n_hosts):
+        ts = BASE_TS + np.arange(per, dtype=np.int64) * 1_000_000_000
+        wb = WriteBatch()
+        wb.add_series("ov", SeriesRows(
+            SeriesKey("ov", {"host": f"host_{h:03d}"}), ts,
+            {"value": (int(ValueType.FLOAT), rng.normal(50, 10, per))}))
+        coord.write_points(tenant, db, wb)
+    coord.engine.flush_all()
+
+    def storm_sql(u: int) -> str:
+        # the u-varying predicate matches every row (no host is 'zzN'):
+        # same answer, but a fresh ScanToken defeats the result cache
+        return (f"SELECT host, count(DISTINCT value), sum(value), "
+                f"min(value), max(value) FROM ov WHERE host <> 'zz{u}' "
+                f"GROUP BY host")
+
+    # oracle: the governance-off legacy path, once, on the static table
+    prev_env = os.environ.get("CNOSDB_MEMORY")
+    os.environ["CNOSDB_MEMORY"] = "0"
+    try:
+        baseline = executor.execute_one(storm_sql(0), session).rows()
+    finally:
+        if prev_env is None:
+            os.environ.pop("CNOSDB_MEMORY", None)
+        else:
+            os.environ["CNOSDB_MEMORY"] = prev_env
+    assert len(baseline) == n_hosts
+
+    def ingest_batch(tag: int) -> WriteBatch:
+        ts = (BASE_TS + np.arange(64, dtype=np.int64) * 1_000_000
+              + tag * 100_000_000_000)
+        wb = WriteBatch()
+        for s in range(4):
+            wb.add_series("ov_ing", SeriesRows(
+                SeriesKey("ov_ing", {"host": f"ing_{(tag + s) % 32}"}), ts,
+                {"value": (int(ValueType.FLOAT),
+                           rng.normal(0, 1, ts.size))}))
+        return wb
+
+    # footprint reference: one dry mixed round at the resting budget
+    coord.write_points(tenant, db, ingest_batch(0))
+    executor.execute_one(storm_sql(1), session)
+    ref_used = max(memgov.BROKER.used(), 1 << 20)
+    # group-state estimate mirrors sql/executor._acc_group_bytes — the
+    # count(DISTINCT) sets dominate: 64 + 64*len per group
+    est_state = n_hosts * (64 + 16 + 64 + 64 * per + 3 * 24)
+
+    prev_group = memgov.GROUP_BYTES
+    prev_delay = memgov.WRITE_DELAY_MS
+    memgov.WRITE_DELAY_MS = 100     # keep the shed path fast, not 2s
+    q_threads, q_iters = 2, 5
+    w_threads, w_iters = 2, 10
+    out: dict = {"table_rows": n_hosts * per, "ref_used_bytes": ref_used,
+                 "group_state_est_bytes": est_state, "phases": {}}
+    all_identical = True
+    try:
+        for factor in (0.5, 1.0, 2.0):
+            budget = max(int(ref_used / factor), 1 << 16)
+            gbudget = int(est_state / factor)
+            memgov.BROKER.resize(budget)
+            memgov.GROUP_BYTES = gbudget
+            coord.engine.flush_all()    # comparable resting state
+            c0 = memgov.counters_snapshot()
+            qlat: list[list[float]] = [[] for _ in range(q_threads)]
+            wlat: list[list[float]] = [[] for _ in range(w_threads)]
+            rejects = [0] * w_threads
+            errs: list[str] = []
+            bad = [0]
+            gate = _threading.Barrier(q_threads + w_threads)
+
+            def qworker(i, tag=int(factor * 10)):
+                gate.wait()
+                for k in range(q_iters):
+                    u = tag * 1000 + i * q_iters + k
+                    t0 = time.perf_counter()
+                    try:
+                        rows = executor.execute_one(
+                            storm_sql(u), session).rows()
+                    except CnosError as e:
+                        errs.append(repr(e)[:120])
+                        continue
+                    qlat[i].append(time.perf_counter() - t0)
+                    if rows != baseline:
+                        bad[0] += 1
+
+            def wworker(i, tag=int(factor * 10)):
+                gate.wait()
+                for k in range(w_iters):
+                    t0 = time.perf_counter()
+                    try:
+                        coord.write_points(
+                            tenant, db,
+                            ingest_batch(tag * 1000 + i * w_iters + k))
+                    except CnosError:   # typed shed — the ladder working
+                        rejects[i] += 1
+                        time.sleep(0.05)
+                        continue
+                    wlat[i].append(time.perf_counter() - t0)
+
+            ths = [_threading.Thread(target=qworker, args=(i,))
+                   for i in range(q_threads)]
+            ths += [_threading.Thread(target=wworker, args=(i,))
+                    for i in range(w_threads)]
+            for t in ths:
+                t.start()
+            for t in ths:
+                t.join()
+
+            c1 = memgov.counters_snapshot()
+
+            def delta(pool, action):
+                return c1.get((pool, action), 0) - c0.get((pool, action), 0)
+
+            qs = np.sort(np.concatenate(
+                [np.asarray(x) for x in qlat] or [np.zeros(0)]))
+            ws = np.sort(np.concatenate(
+                [np.asarray(x) for x in wlat] or [np.zeros(0)]))
+            identical = bad[0] == 0 and not errs
+            all_identical = all_identical and identical
+            out["phases"][f"{factor:g}x"] = {
+                "budget_bytes": budget,
+                "group_budget_bytes": gbudget,
+                "query_ok": int(qs.size),
+                "query_p99_ms": round(
+                    float(np.percentile(qs, 99)) * 1e3, 2) if qs.size
+                else None,
+                "write_ok": int(ws.size),
+                "write_p99_ms": round(
+                    float(np.percentile(ws, 99)) * 1e3, 2) if ws.size
+                else None,
+                "write_rejects": sum(rejects),
+                "spills": delta("query_groups", "spill"),
+                "unspills": delta("query_groups", "unspill"),
+                "write_delayed": delta("write", "delayed"),
+                "write_backpressure_shed": delta("write",
+                                                 "backpressure_shed"),
+                "write_fail_hard": delta("write", "fail_hard"),
+                "queued_shed": delta("admission", "shed_queued"),
+                "reclaims": sum(
+                    v - c0.get(k, 0) for k, v in c1.items()
+                    if k[1] == "reclaim"),
+                "bit_identical": identical,
+                **({"query_errors": errs[:3]} if errs else {}),
+            }
+    finally:
+        memgov.BROKER.resize(0)         # back to config/auto
+        memgov.GROUP_BYTES = prev_group
+        memgov.WRITE_DELAY_MS = prev_delay
+    out["bit_identical"] = all_identical
+    return out
+
+
 def run_suites(executor, coord, tenant, db, session) -> dict:
     out: dict = {}
     t0 = time.perf_counter()
@@ -1308,4 +1492,9 @@ def run_suites(executor, coord, tenant, db, session) -> dict:
         out["straggler"] = run_straggler()   # self-contained bed
     except Exception as e:   # gray-failure plane must not sink the run
         out["straggler"] = {"error": repr(e)[:200]}
+    try:
+        out["overload"] = run_overload(executor, coord, tenant, db,
+                                       session)
+    except Exception as e:   # memory-governance plane must not sink it
+        out["overload"] = {"error": repr(e)[:200]}
     return out

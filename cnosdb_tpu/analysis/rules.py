@@ -1165,6 +1165,90 @@ class HedgeAccounting(Rule):
                            f"so the lint keeps covering it")
 
 
+# --------------------------------------------------------------------------
+# 19. memory-accounting — new (PR 19): no silent ladder exits
+# --------------------------------------------------------------------------
+_MEM_FUNCS = {
+    "cnosdb_tpu/server/memory.py": ("write_admit", "rebalance"),
+}
+_MEM_ACCOUNTING = {"count", "_event"}
+
+
+def _mem_has_accounting(node: ast.AST) -> bool:
+    for n in ast.walk(node):
+        if isinstance(n, ast.Call) and _call_name(n) in _MEM_ACCOUNTING:
+            return True
+    return False
+
+
+def _mem_success_return(stmt: ast.AST) -> bool:
+    """``return <name>`` / ``return None`` / bare ``return`` — the
+    under-watermark fast paths: nothing was degraded, so there is
+    nothing to book. Literal returns and raises must book why."""
+    return isinstance(stmt, ast.Return) and (
+        stmt.value is None
+        or isinstance(stmt.value, ast.Name)
+        or (isinstance(stmt.value, ast.Constant)
+            and stmt.value.value is None))
+
+
+class MemoryAccounting(Rule):
+    name = "memory-accounting"
+    motivation = ("PR 19 memory-governance plane: every degradation the "
+                  "ladder takes (reclaim, shed, backpressure delay, "
+                  "fail-closed) must book into cnosdb_memory_total "
+                  "{pool,action} — an unaccounted exit means the node "
+                  "degraded service with no trace, and those counters "
+                  "are the only proof the broker (not an OOM kill) "
+                  "handled the pressure")
+
+    def applies_to(self, relpath):
+        return relpath in _MEM_FUNCS
+
+    def begin_module(self, ctx):
+        want = _MEM_FUNCS.get(ctx.relpath)
+        guarded = want is not None
+        if want is None:
+            # scope-ignored run (fixtures/self-tests): lint any function
+            # bearing a guarded name, but skip the presence check
+            want = tuple({n for names in _MEM_FUNCS.values()
+                          for n in names})
+        found = set()
+        for fn in ast.walk(ctx.tree):
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    or fn.name not in want:
+                continue
+            found.add(fn.name)
+            terminal = fn.body[-1]
+            for block in _dda_blocks(fn):
+                for i, stmt in enumerate(block):
+                    if not isinstance(stmt, (ast.Return, ast.Raise)) \
+                            or stmt is terminal:
+                        continue
+                    # booking may land anywhere earlier in the same
+                    # block (the ladder counts, logs the event ring,
+                    # then raises)
+                    if _mem_has_accounting(stmt) \
+                            or _mem_success_return(stmt) \
+                            or any(_mem_has_accounting(prev)
+                                   for prev in block[:i]):
+                        continue
+                    kind = "return" if isinstance(stmt, ast.Return) \
+                        else "raise"
+                    ctx.report(self, stmt,
+                               f"unaccounted early {kind} in {fn.name} — "
+                               f"memory-ladder exits must book into "
+                               f"cnosdb_memory_total (count/_event) so "
+                               f"every degradation stays visible on "
+                               f"/metrics and /debug/memory")
+        for name in want if guarded else ():
+            if name not in found:
+                ctx.report(self, 1,
+                           f"memory guarded function {name} not found — "
+                           f"if it was renamed, update analysis/rules.py "
+                           f"so the lint keeps covering it")
+
+
 def all_rules() -> list:
     from .interproc import project_rules
 
@@ -1174,4 +1258,4 @@ def all_rules() -> list:
             DeviceDecodeAccounting(), StringFilterAccounting(),
             ColdTierAccounting(), ServingAccounting(), BackupAccounting(),
             FaultSiteCoverage(), CompressedDomainAccounting(),
-            HedgeAccounting(), *project_rules()]
+            HedgeAccounting(), MemoryAccounting(), *project_rules()]

@@ -15,7 +15,7 @@ import random
 from dataclasses import dataclass
 
 KINDS = ("partition", "crash_restart", "delay_storm", "corrupt",
-         "slow_replica")
+         "slow_replica", "memory_pressure")
 # disaster-recovery kinds, never mixed into the default rotation: both
 # destroy data on purpose (total_loss wipes a node's data dir,
 # operator_error drops a whole database) and are only survivable when
@@ -75,10 +75,13 @@ def event_specs(ev: NemesisEvent, victim_addr: str,
         # at-rest corruption the integrity plane must catch and repair
         return (prefix + f"scrub.read:corrupt({max(1, ev.param // 20)})"
                          f":once", "")
-    if ev.kind == "crash_restart" or ev.kind in DR_KINDS:
+    if ev.kind == "crash_restart" or ev.kind == "memory_pressure" \
+            or ev.kind in DR_KINDS:
         # the harness acts directly: kill+start, rm -rf the victim's
-        # data dir (total_loss), or DROP DATABASE (operator_error) —
-        # followed by RESTORE from the archive store
+        # data dir (total_loss), DROP DATABASE (operator_error) with
+        # RESTORE from the archive store, or squeeze/restore the
+        # victim's memory-broker budget over the `_memory` runtime RPC
+        # (memory_pressure) — no fault-spec injection needed
         return ("", "")
     raise ValueError(f"unknown nemesis kind {ev.kind!r}")
 

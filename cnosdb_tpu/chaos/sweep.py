@@ -41,7 +41,7 @@ _REPO = os.path.dirname(os.path.dirname(os.path.dirname(
 # named torn-state candidates (tiering registry, matview persist) ride in
 # the full sweep
 FAST_POINTS = ("wal.append", "flush.run", "tiering.registry",
-               "backup.archive")
+               "backup.archive", "memory.spill")
 
 
 def node_points() -> list[str]:
@@ -49,6 +49,8 @@ def node_points() -> list[str]:
     so their register_point calls have run."""
     import cnosdb_tpu.parallel.net                 # noqa: F401
     import cnosdb_tpu.parallel.meta_service        # noqa: F401
+    import cnosdb_tpu.server.serving               # noqa: F401
+    import cnosdb_tpu.sql.executor                 # noqa: F401
     import cnosdb_tpu.sql.matview                  # noqa: F401
     import cnosdb_tpu.storage.backup               # noqa: F401
     import cnosdb_tpu.storage.compaction           # noqa: F401

@@ -119,6 +119,7 @@ def run(root: str) -> None:
         _write(ex, engine, hist, "s1", _batch(60, 60))
         _write(ex, engine, hist, "s1", _batch(120, 60))
         _read(ex, hist, "s1")
+        _spill_groupby(ex, hist)
         _ddl(ex, hist, "s1", "flush", "FLUSH")
         _write(ex, engine, hist, "s2", _batch(180, 60))
         _write(ex, engine, hist, "s2", _batch(240, 60))
@@ -148,6 +149,25 @@ def run(root: str) -> None:
     with open(os.path.join(root, TRACE), "w", encoding="utf-8") as f:
         json.dump({"fired": [list(t) for t in faults.fired_log()]}, f)
     coord.close()
+
+
+def _spill_groupby(ex, hist) -> None:
+    """Cross the memory.spill site: squeeze the group budget so a wide
+    group-by's accumulator spills (spill-vs-in-memory bit-identity is
+    proven by tests/test_memory.py; here the point just needs a real
+    crossing for the crash sweep). count(DISTINCT) forces the host
+    accumulator path where the spiller lives."""
+    from ..server import memory as memgov
+
+    inv = hist.invoke("s1", "ddl", name="spill_groupby")
+    saved = memgov.GROUP_BYTES
+    memgov.GROUP_BYTES = 1
+    try:
+        ex.execute_one("SELECT h, count(DISTINCT v), sum(v) FROM w "
+                       "GROUP BY h")
+    finally:
+        memgov.GROUP_BYTES = saved
+    hist.ok("s1", inv)
 
 
 def _tier(engine, hist) -> None:

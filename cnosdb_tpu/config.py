@@ -104,6 +104,23 @@ class QueryConfig:
     # CNOSDB_QUERY_HEDGE_MAX_INFLIGHT.
     hedge_delay_ms_floor: int = 25
     hedge_max_inflight: int = 8
+    # memory-governance plane (server/memory.py): total process budget
+    # arbitrated across the registered pools (0 = auto: a quarter of
+    # physical RAM, floored at 1 GiB), soft/hard watermarks as percent
+    # of that budget (soft starts cache reclaim + queued-query shedding,
+    # hard fails writes closed), the per-query accounting budget (0 =
+    # unlimited; an over-budget query dies with MemoryExceeded / HTTP
+    # 413), the group-state budget above which an aggregate spills its
+    # accumulator to disk, and the bounded write-path delay spent
+    # waiting for flush progress before shedding with 503.
+    # CNOSDB_MEMORY=0 disables the whole plane (byte-identical legacy
+    # path); env overrides: CNOSDB_QUERY_MEMORY_TOTAL_BYTES etc.
+    memory_total_bytes: int = 0
+    memory_soft_pct: int = 70
+    memory_hard_pct: int = 90
+    memory_per_query_bytes: int = 0
+    memory_group_bytes: int = 64 * 1024 * 1024
+    memory_write_delay_ms: int = 2000
 
 
 @dataclass

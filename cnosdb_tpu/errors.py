@@ -157,3 +157,28 @@ class AdmissionRejected(CnosError):
     def __init__(self, message: str = "", retry_after: float = 1.0, **ctx):
         super().__init__(message, **ctx)
         self.retry_after = retry_after
+
+
+class MemoryExceeded(CnosError):
+    """A single request outgrew its memory budget (per-query kill), or
+    the node is above its hard memory watermark and must fail closed.
+
+    Deliberately NOT a QueryError subclass, for the same reason as
+    DeadlineExceeded: retry/failover loops must not absorb it — the
+    request itself is the problem and retrying it elsewhere just moves
+    the OOM. HTTP 413 (payload too large — the request, not the node,
+    is oversized), so clients can tell it apart from the node-saturated
+    503."""
+
+    code = "100003"
+
+
+class WriteBackpressure(AdmissionRejected):
+    """Write shed by memory backpressure: the broker delayed the write
+    waiting for flush progress, the delay budget ran out, and the node
+    is still above its soft watermark. HTTP 503 + Retry-After (derived
+    from flush progress) like its parent, but counted separately
+    (cnosdb_requests_backpressured_total) so dashboards can tell a
+    memory squeeze from an admission-queue overflow."""
+
+    code = "100004"

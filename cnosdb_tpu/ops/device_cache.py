@@ -35,6 +35,27 @@ from ..models.schema import ValueType
 from ..utils import stages
 from .kernels import pad_rows
 
+# live device uploads, weakly held — the broker's device_uploads pool
+# reads estimated resident bytes from here; no reclaim callback (device
+# buffers die with their scan batch, evicting mid-query would corrupt
+# the kernels referencing them)
+import weakref as _weakref
+
+_LIVE_BATCHES: "_weakref.WeakSet" = _weakref.WeakSet()
+
+
+def device_bytes_used() -> int:
+    return sum(getattr(b, "est_bytes", 0) for b in list(_LIVE_BATCHES))
+
+
+def _register_device_pool() -> None:
+    from ..server import memory as _memory
+
+    _memory.register_pool("device_uploads", usage_fn=device_bytes_used)
+
+
+_register_device_pool()
+
 
 class DeviceBatch:
     """Padded, device-resident columns of one ScanBatch.
@@ -49,7 +70,7 @@ class DeviceBatch:
     __slots__ = ("n_rows", "n_pad", "n_series", "epoch_ns", "ts_sec", "ts_ns",
                  "sid_ordinal", "rank", "in_rows", "fields", "ts_min", "ts_max",
                  "i32_ok", "ns_all_zero", "field_all_valid", "_rank_np",
-                 "series_params")
+                 "series_params", "est_bytes", "__weakref__")
 
     def __init__(self, batch):
         with stages.stage("upload_ms"):
@@ -77,6 +98,8 @@ class DeviceBatch:
                     None if all_valid
                     else _put(_pad_to(valid, self.n_pad, False)),
                 )
+            self.est_bytes = self._estimate_bytes()
+            _LIVE_BATCHES.add(self)
 
     def _init_meta(self, batch):
         """Everything except the field columns: row counts, the i32
@@ -135,9 +158,23 @@ class DeviceBatch:
         self.fields: dict[str, tuple[ValueType, object, object]] = {}
         self.field_all_valid: dict[str, bool] = {}
 
+    def _estimate_bytes(self) -> int:
+        """Resident device-buffer bytes (feeds the broker's
+        device_uploads pool; estimate only — the broker never reclaims
+        uploads, they die with their scan batch)."""
+        total = 0
+        for a in (self.ts_sec, self.ts_ns, self.sid_ordinal, self.rank,
+                  self.series_params):
+            total += int(getattr(a, "nbytes", 0) or 0)
+        for _vt, dev_vals, dev_valid in self.fields.values():
+            total += int(getattr(dev_vals, "nbytes", 0) or 0)
+            total += int(getattr(dev_valid, "nbytes", 0) or 0)
+        return total
+
     def rank_dev(self):
         if self.rank is None:
             self.rank = _put(_pad_to(self._rank_np, self.n_pad, 0))
+            self.est_bytes += int(getattr(self.rank, "nbytes", 0) or 0)
         return self.rank
 
 

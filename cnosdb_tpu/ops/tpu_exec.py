@@ -78,6 +78,44 @@ def memo_bytes() -> int:
     return total
 
 
+def memo_clear(target_bytes: int = 0) -> int:
+    """Broker reclaim: drop partial-agg memos (pure caches — a cleared
+    memo recomputes on next touch). target_bytes=0 clears everything."""
+    freed = 0
+    with _BATCH_CACHE_LOCK:
+        batches = list(_memo_batches.values())
+    for b in batches:
+        if target_bytes and freed >= target_bytes:
+            break
+        partials = getattr(b, "_partials", None)
+        if not partials:
+            continue
+        with _BATCH_CACHE_LOCK:
+            n = 0
+            for part in list(partials.values()):
+                for v in part.values():
+                    nb = getattr(v, "nbytes", None)
+                    if nb is not None:
+                        n += int(nb)
+            evicted = len(partials)
+            partials.clear()
+            _MEMO_COUNTERS["evict"] = \
+                _MEMO_COUNTERS.get("evict", 0) + evicted
+        freed += n
+    return freed
+
+
+def _register_memo_pool() -> None:
+    from ..server import memory as _memory
+
+    _memory.register_pool("agg_memo",
+                          usage_fn=memo_bytes,
+                          reclaim=memo_clear)
+
+
+_register_memo_pool()
+
+
 def _FORCE_DEVICE() -> bool:
     import os
 

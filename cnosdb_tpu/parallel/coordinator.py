@@ -32,6 +32,7 @@ from ..utils.backoff import Backoff
 from ..models.points import SeriesRows, WriteBatch
 from ..models.predicate import ColumnDomains, TimeRanges
 from ..models.schema import TskvTableSchema, ValueType
+from ..server import memory
 from ..storage.engine import TsKv
 from ..storage.scan import ScanBatch, scan_vnode
 from . import health
@@ -105,6 +106,12 @@ class Coordinator:
         self._scan_cache: dict = {}
         self._scan_cache_bytes = 0
         self._scan_cache_lock = lockwatch.Lock("coord.scan_cache")
+        # memory-governance plane: the scan cache is an evictable pool —
+        # the broker shrinks it LRU-first when the node crosses its soft
+        # watermark (latest coordinator instance wins, like the engine)
+        memory.register_pool("scan_cache",
+                             usage_fn=lambda: self.scan_cache_stats()[1],
+                             reclaim=self._reclaim_scan_cache)
         # schema auto-creation callbacks land on meta; keep engine's view hot
         meta.watch(self._on_meta_event)
         # seed the engine's schema view from the catalog for EVERY owner
@@ -267,6 +274,11 @@ class Coordinator:
         # :58-84 gates writes on GreedyMemoryPool)
         est = batch.n_rows() * 128
         record = db != "usage_schema"
+        if record:
+            # memory-governance ladder at USER ingress only: internal
+            # usage_schema rows and the raft apply/heartbeat plane are
+            # never backpressured (they are how the node drains)
+            memory.write_admit(est)
         pre_sizes = None
         if record:
             try:
@@ -1023,6 +1035,20 @@ class Coordinator:
         with self._scan_cache_lock:
             return len(self._scan_cache), self._scan_cache_bytes
 
+    def _reclaim_scan_cache(self, target_bytes: int) -> int:
+        """Broker reclaim callback: evict LRU entries until
+        `target_bytes` are freed (or the cache is empty). Safe to lose
+        any entry — snapshots revalidate by ScanToken on the next
+        scan."""
+        freed = 0
+        with self._scan_cache_lock:
+            while self._scan_cache and freed < target_bytes:
+                lru = next(iter(self._scan_cache))
+                freed += self._scan_cache.pop(lru)[2]
+            self._scan_cache_bytes = max(0,
+                                         self._scan_cache_bytes - freed)
+        return freed
+
     def table_tokens(self, tenant: str, db: str, table: str):
         """Serving-plane invalidation key: the table's schema version plus
         one ScanToken tuple per covering vnode, each captured under that
@@ -1194,6 +1220,9 @@ class Coordinator:
             raw = r.get("ipc")
             if raw is None:
                 return None
+            # per-query accounting: the reply buffer + its decoded twin
+            # are this request's to pay for (MemoryExceeded kills only it)
+            memory.charge_query(len(raw), "rpc_result")
             return decode_scan_batch(raw)
         if last_reject is not None:
             # at least one replica ANSWERED and rejected the scan — an
@@ -1425,6 +1454,7 @@ class Coordinator:
                 raw = value.get("ipc")
                 if raw is None:
                     return None
+                memory.charge_query(len(raw), "rpc_result")
                 return decode_scan_batch(raw)
             if kind == "error" and not a["hedge"]:
                 # primary-lineage failure of the typed kind the legacy

@@ -99,6 +99,11 @@ class RpcServer:
             # runtime fault control for chaos harnesses — only exposed when
             # the process was launched with CNOSDB_FAULTS in its environment
             self.handlers.setdefault("_faults", faults.control)
+            # memory-broker control (memory_pressure nemesis squeezes /
+            # restores the budget at runtime) rides the same arming knob
+            from ..server import memory as _memory
+
+            self.handlers.setdefault("_memory", _memory.control)
         outer = self
 
         class Handler(BaseHTTPRequestHandler):

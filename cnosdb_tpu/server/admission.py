@@ -16,6 +16,32 @@ SQL endpoint.
     means "you specifically are over YOUR budget", 503 means "the node
     is saturated for everyone, back off and retry".
 
+Rejection taxonomy (each failure names its actor and its remedy):
+
+  ====  ==========================  =================================
+  code  error (counter)             meaning / client remedy
+  ====  ==========================  =================================
+  429   LimiterError                this tenant exceeded ITS bucket
+        (rate_limited)              — slow down, others unaffected
+  503   AdmissionRejected           node saturated for everyone —
+        (shed)                      back off per Retry-After
+  503   WriteBackpressure           memory broker shedding WRITES
+        (backpressured)             while flushes drain; Retry-After
+                                    derives from observed flush
+                                    progress (server/memory.py)
+  504   DeadlineExceeded            the request ran out of ITS OWN
+        (deadline)                  time budget mid-flight
+  413   MemoryExceeded              this query/write is too big for
+        (memory)                    its byte budget — shrink it;
+                                    retrying unchanged cannot help
+  ====  ==========================  =================================
+
+The memory broker's degradation ladder (server/memory.py) also sheds
+QUEUED — never running — queries via `shed_queued()` when reclaiming
+caches alone cannot get back under the soft watermark: a queued query
+holds no partial state yet, so shedding it frees future memory at zero
+wasted work.
+
 Acquisition happens on the executor worker thread (one thread per
 in-flight HTTP request), so waiting here blocks no event loop. Counters
 and queue-depth/wait gauges feed /metrics via `stats()`.
@@ -37,6 +63,10 @@ class AdmissionGate:
         self._cond = threading.Condition(lockwatch.RLock("admission.gate"))
         self._running = 0
         self._queued = 0
+        # memory-pressure shed generation: shed_queued() bumps the epoch
+        # and every waiter queued BEFORE the bump sheds itself
+        self._shed_epoch = 0
+        self._shed_retry_after = 1.0
         # cumulative counters (cnosdb_requests_*_total)
         self.admitted_total = 0
         self.queued_total = 0
@@ -64,8 +94,16 @@ class AdmissionGate:
             self._queued += 1
             self.queued_total += 1
             start = time.monotonic()
+            epoch = self._shed_epoch
             try:
                 while True:
+                    if self._shed_epoch > epoch:
+                        self.shed_total += 1
+                        raise AdmissionRejected(
+                            "shed while queued: node over memory "
+                            "watermark (queued queries shed first, "
+                            "running queries finish)",
+                            retry_after=self._shed_retry_after)
                     if dl is not None and dl.dead():
                         self.shed_total += 1
                         raise AdmissionRejected(
@@ -91,6 +129,18 @@ class AdmissionGate:
         with self._cond:
             self._running -= 1
             self._cond.notify()
+
+    def shed_queued(self, retry_after: float = 1.0) -> int:
+        """Memory-broker ladder step 2: shed every currently QUEUED
+        query with 503 + `retry_after` (the waiters raise on wakeup).
+        Running queries are untouched. Returns how many were shed."""
+        with self._cond:
+            n = self._queued
+            if n:
+                self._shed_epoch += 1
+                self._shed_retry_after = float(retry_after)
+                self._cond.notify_all()
+            return n
 
     def pressure(self) -> tuple[int, int]:
         """Dirty-read ``(running, queued)`` for the serving-plane micro-

@@ -66,6 +66,13 @@ class HttpServer:
         _health.configure(qc)
         self.gate = AdmissionGate(qc.max_concurrent_queries,
                                   qc.max_queued_queries)
+        # memory-governance plane: push [query] memory_* knobs into the
+        # broker and hand it the gate so ladder step 2 can shed QUEUED
+        # queries (server/memory.py)
+        from . import memory as _memory
+
+        _memory.configure(qc)
+        _memory.set_admission_gate(self.gate)
         # the serving plane's micro-batcher keys its fuse-or-solo decision
         # off this gate's pressure (queued > 0 / running at the cap)
         sv = getattr(executor, "serving", None)
@@ -102,6 +109,7 @@ class HttpServer:
             web.get("/debug/backup", self.handle_backup),
             web.get("/debug/matview", self.handle_matview),
             web.get("/debug/lockgraph", self.handle_lockgraph),
+            web.get("/debug/memory", self.handle_memory),
         ])
         # background integrity scrubber (storage/scrub.py), attached by
         # run_server when cfg.storage.scrub_interval > 0
@@ -202,6 +210,15 @@ class HttpServer:
             self.metrics.incr("cnosdb_http_write_errors_total")
             if isinstance(e, DeadlineExceeded):
                 self.metrics.incr("cnosdb_requests_deadline_exceeded_total")
+            from ..errors import MemoryExceeded, WriteBackpressure
+
+            # memory-ladder outcomes get their own counters: 503-with-
+            # Retry-After (flushes draining, retry helps) vs 413 (the
+            # write itself is too big / node fail-closed over hard)
+            if isinstance(e, WriteBackpressure):
+                self.metrics.incr("cnosdb_requests_backpressured_total")
+            elif isinstance(e, MemoryExceeded):
+                self.metrics.incr("cnosdb_requests_memory_exceeded_total")
             return _err_response(_status_for(e), e)
         self.metrics.incr("cnosdb_http_writes_total")
         self.metrics.incr("cnosdb_http_points_written_total", batch.n_rows())
@@ -279,6 +296,10 @@ class HttpServer:
             self.metrics.incr("cnosdb_http_sql_errors_total")
             if isinstance(e, DeadlineExceeded):
                 self.metrics.incr("cnosdb_requests_deadline_exceeded_total")
+            from ..errors import MemoryExceeded
+
+            if isinstance(e, MemoryExceeded):
+                self.metrics.incr("cnosdb_requests_memory_exceeded_total")
             return _err_response(_status_for(e), e)
         self.metrics.incr("cnosdb_http_queries_total")
         # reference query_sql_process_ms: end-to-end SQL latency histogram
@@ -858,6 +879,16 @@ class HttpServer:
 
         return web.json_response(lockwatch.report())
 
+    async def handle_memory(self, request):
+        """Memory-governance plane (server/memory.py): broker budget +
+        watermarks, live per-pool bytes, per-(pool, action) ladder
+        counters and the recent reclaim/shed/spill event ring. Reports
+        `enabled: false` when the node runs with CNOSDB_MEMORY=0."""
+        self._require_admin(request)
+        from . import memory as _memory
+
+        return web.json_response(_memory.debug_snapshot())
+
     async def handle_health(self, request):
         """Gray-failure tolerance plane (parallel/health.py): per-node
         health scores (state, err/burn EWMAs, per-method-class latency
@@ -966,6 +997,19 @@ class HttpServer:
 
         for name, n in _group_agg.counters_snapshot().items():
             self.metrics.set_gauge("cnosdb_group_agg_total", n, kind=name)
+        # memory-governance plane: per-(pool, action) ladder totals +
+        # live pool bytes (see /debug/memory for the full snapshot)
+        from . import memory as _memory
+
+        if _memory.enabled():
+            for (pool, action), n in _memory.counters_snapshot().items():
+                self.metrics.set_counter("cnosdb_memory_total", n,
+                                         pool=pool, action=action)
+            for pool, b in _memory.BROKER.usage().items():
+                self.metrics.set_gauge("cnosdb_memory_pool_bytes", b,
+                                       pool=pool)
+            self.metrics.set_gauge("cnosdb_memory_budget_bytes",
+                                   _memory.BROKER.total())
         # invariant plane: lock-order watchdog counters (all zero unless
         # the node runs with CNOSDB_LOCKWATCH=1; order_cycles > 0 means a
         # potential deadlock was observed — see /debug/lockgraph)
@@ -1205,7 +1249,7 @@ def format_table(rs: ResultSet) -> str:
 def _status_for(e: CnosError) -> int:
     from ..errors import (
         AdmissionRejected, AuthError, DatabaseNotFound, LimiterError,
-        ParserError, PlanError, TableNotFound,
+        MemoryExceeded, ParserError, PlanError, TableNotFound,
     )
 
     if isinstance(e, AuthError):
@@ -1214,6 +1258,8 @@ def _status_for(e: CnosError) -> int:
         return 429          # per-tenant budget — THIS tenant backs off
     if isinstance(e, AdmissionRejected):
         return 503          # node saturated for everyone — shed load
+    if isinstance(e, MemoryExceeded):
+        return 413          # request over its byte budget — not retryable
     if isinstance(e, DeadlineExceeded):
         return 504          # request outlived its budget
     if isinstance(e, (ParserError, PlanError, DatabaseNotFound, TableNotFound)):

@@ -1,0 +1,483 @@
+"""Memory-governance plane: one broker arbitrating every byte pool.
+
+The reference bounds memory with a single GreedyMemoryPool
+(common/memory_pool) gating writes and DataFusion queries; everything
+else — caches, memcaches, group state — trusts its own independent cap
+and the node OOMs when the caps add up past physical RAM under a burst
+of wide group-bys plus an ingest spike. This module is the rebuild's
+arbitration layer (the Taurus shared-node argument, PAPERS.md
+2506.20010): a process-global :class:`MemoryBroker` with named,
+accounted pools registered by each subsystem —
+
+  ==============  =======================================  ==========
+  pool            feeder (usage_fn / book)                 reclaim
+  ==============  =======================================  ==========
+  memcache        engine vnodes: active+immutable caches   flush
+                  (dtype-aware bytes, unflushed WAL rows)
+  scan_cache      coordinator ScanToken-keyed snapshots    LRU evict
+  block_cache     cold-tier decoded block cache            clear
+  serving         plan cache + result cache                evict
+  agg_memo        per-batch partial-agg memos (tpu_exec)   clear
+  query_groups    live aggregation accumulators (booked    (spills
+                  by executor group spillers)               itself)
+  device_uploads  live DeviceBatch uploads (die with       —
+                  their scan batch)
+  ==============  =======================================  ==========
+
+and a deterministic degradation ladder over a soft/hard watermark pair:
+
+  1. above **soft** — reclaim evictable pools via their callbacks,
+     largest usage first, until back under soft;
+  2. still above soft — shed *queued* (never running) queries through
+     the admission gate with 503 + Retry-After;
+  3. write path — bounded delay below the hard watermark (waiting for
+     flush progress; sheds WriteBackpressure/503 when the delay budget
+     runs out), fail-closed MemoryExceeded above it. The raft /
+     heartbeat plane is NEVER touched: backpressure applies at
+     `Coordinator.write_points` (user ingress) only, so replication and
+     elections keep making progress while clients back off.
+
+Per-query accounting rides the existing Deadline plumbing (PR 4): a
+:class:`QueryMemory` hangs off the ambient deadline, every large
+materialization site (scan assembly, RPC result buffers, group state)
+charges it, and crossing the per-query budget raises a typed
+MemoryExceeded (HTTP 413) that kills only the oversized query.
+
+Master gate: CNOSDB_MEMORY=0 disables the whole plane — no pool reads,
+no ladder, byte-identical legacy behavior. Below the soft watermark the
+plane only *observes* (usage_fn reads), so untriggered behavior is
+bit-identical by construction.
+
+Observability: cnosdb_memory_total{pool,action} counters + a bounded
+ring of recent reclaim/shed events, folded into /metrics and served by
+GET /debug/memory.
+"""
+from __future__ import annotations
+
+import os
+import time
+from collections import deque
+
+from ..errors import MemoryExceeded, WriteBackpressure
+from ..utils import lockwatch
+from ..utils import deadline as deadline_mod
+
+# ---------------------------------------------------------------- knobs
+# ([query] memory_* config; configure() applies a loaded QueryConfig.
+# Env overrides CNOSDB_QUERY_MEMORY_* ride the config loader; the
+# bare ones below let harness subprocesses inherit without a file.)
+TOTAL_BYTES = int(os.environ.get("CNOSDB_QUERY_MEMORY_TOTAL_BYTES", "0"))
+SOFT_PCT = int(os.environ.get("CNOSDB_QUERY_MEMORY_SOFT_PCT", "70"))
+HARD_PCT = int(os.environ.get("CNOSDB_QUERY_MEMORY_HARD_PCT", "90"))
+PER_QUERY_BYTES = int(os.environ.get(
+    "CNOSDB_QUERY_MEMORY_PER_QUERY_BYTES", "0"))
+GROUP_BYTES = int(os.environ.get(
+    "CNOSDB_QUERY_MEMORY_GROUP_BYTES", str(64 * 1024 * 1024)))
+WRITE_DELAY_MS = int(os.environ.get(
+    "CNOSDB_QUERY_MEMORY_WRITE_DELAY_MS", "2000"))
+
+_REBALANCE_INTERVAL_S = 0.05   # ladder re-evaluation throttle
+_EVENT_RING = 64
+
+
+def enabled() -> bool:
+    """Master gate: CNOSDB_MEMORY=0 restores byte-identical legacy
+    behavior (no pools read, no ladder, no per-query accounting).
+    Read per call — harness processes flip it via env."""
+    return os.environ.get("CNOSDB_MEMORY", "1") != "0"
+
+
+def configure(query_cfg) -> None:
+    """Apply [query] memory_* knobs (called from server wiring)."""
+    global TOTAL_BYTES, SOFT_PCT, HARD_PCT, PER_QUERY_BYTES
+    global GROUP_BYTES, WRITE_DELAY_MS
+    for attr, glob in (("memory_total_bytes", "TOTAL_BYTES"),
+                       ("memory_soft_pct", "SOFT_PCT"),
+                       ("memory_hard_pct", "HARD_PCT"),
+                       ("memory_per_query_bytes", "PER_QUERY_BYTES"),
+                       ("memory_group_bytes", "GROUP_BYTES"),
+                       ("memory_write_delay_ms", "WRITE_DELAY_MS")):
+        v = getattr(query_cfg, attr, None)
+        if v is not None:
+            globals()[glob] = int(v)
+    BROKER.resize(TOTAL_BYTES)
+
+
+def _auto_total() -> int:
+    """0 = auto: a quarter of physical RAM, floored at 1 GiB."""
+    try:
+        phys = os.sysconf("SC_PAGE_SIZE") * os.sysconf("SC_PHYS_PAGES")
+    except (ValueError, OSError, AttributeError):
+        phys = 8 << 30
+    return max(1 << 30, phys // 4)
+
+
+# ------------------------------------------------------------- counters
+_ctr_lock = lockwatch.Lock("memory.counters")
+_counters: dict[tuple[str, str], int] = {}
+
+
+def count(pool: str, action: str, n: int = 1) -> None:
+    with _ctr_lock:
+        _counters[(pool, action)] = _counters.get((pool, action), 0) + n
+
+
+def counters_snapshot() -> dict[tuple[str, str], int]:
+    with _ctr_lock:
+        return dict(_counters)
+
+
+def reset_counters() -> None:
+    with _ctr_lock:
+        _counters.clear()
+
+
+class _Pool:
+    """One accounted pool: pull-style (usage_fn) or push-style (booked
+    via book/unbook). `reclaim` takes a byte target and returns bytes
+    freed (best effort)."""
+
+    __slots__ = ("name", "usage_fn", "reclaim", "booked")
+
+    def __init__(self, name, usage_fn=None, reclaim=None):
+        self.name = name
+        self.usage_fn = usage_fn
+        self.reclaim = reclaim
+        self.booked = 0
+
+    def usage(self) -> int:
+        if self.usage_fn is not None:
+            try:
+                return int(self.usage_fn())
+            except Exception:
+                # a dying subsystem (closed engine, torn-down cache)
+                # must not take the broker with it
+                count(self.name, "usage_error")
+                return 0
+        return self.booked
+
+
+class MemoryBroker:
+    """Process-global arbiter. Registration is idempotent (latest
+    instance of a subsystem wins — tests open engines repeatedly in one
+    process). Reclaim callbacks run OUTSIDE the broker lock: they take
+    their own subsystem locks and must never need ours."""
+
+    def __init__(self):
+        self._lock = lockwatch.Lock("memory.broker")
+        self._pools: dict[str, _Pool] = {}
+        self._events: deque = deque(maxlen=_EVENT_RING)
+        self._last_rebalance = 0.0
+        self._total_override = 0
+
+    # ------------------------------------------------------ registration
+    def register_pool(self, name: str, usage_fn=None, reclaim=None) -> None:
+        with self._lock:
+            prev = self._pools.get(name)
+            p = _Pool(name, usage_fn, reclaim)
+            if prev is not None:
+                p.booked = prev.booked
+            self._pools[name] = p
+
+    def book(self, name: str, n: int, action: str = "book") -> None:
+        with self._lock:
+            p = self._pools.get(name)
+            if p is None:
+                p = self._pools[name] = _Pool(name)
+            p.booked += int(n)
+        count(name, action)
+
+    def unbook(self, name: str, n: int) -> None:
+        with self._lock:
+            p = self._pools.get(name)
+            if p is not None:
+                p.booked = max(0, p.booked - int(n))
+
+    # ------------------------------------------------------------ budget
+    def resize(self, total_bytes: int) -> None:
+        """Runtime budget change (config apply / memory_pressure
+        nemesis). 0 = back to auto."""
+        with self._lock:
+            self._total_override = int(total_bytes)
+            self._last_rebalance = 0.0   # force the next ladder pass
+
+    def total(self) -> int:
+        with self._lock:
+            override = self._total_override
+        return override or TOTAL_BYTES or _auto_total()
+
+    def watermarks(self) -> tuple[int, int]:
+        t = self.total()
+        return t * SOFT_PCT // 100, t * HARD_PCT // 100
+
+    # ------------------------------------------------------------- state
+    def usage(self) -> dict[str, int]:
+        with self._lock:
+            pools = list(self._pools.values())
+        return {p.name: p.usage() for p in pools}
+
+    def used(self) -> int:
+        return sum(self.usage().values())
+
+    def _event(self, pool: str, action: str, nbytes: int) -> None:
+        with self._lock:
+            self._events.append({"pool": pool, "action": action,
+                                 "bytes": int(nbytes),
+                                 "t_mono": time.monotonic()})
+
+    def events_snapshot(self) -> list[dict]:
+        now = time.monotonic()
+        with self._lock:
+            evs = list(self._events)
+        return [{"pool": e["pool"], "action": e["action"],
+                 "bytes": e["bytes"],
+                 "age_s": round(now - e["t_mono"], 2)} for e in evs]
+
+    # ------------------------------------------------------------ ladder
+    def rebalance(self, force: bool = False) -> int:
+        """Run the degradation ladder if due; → current used bytes.
+
+        Step 1: reclaim evictable pools (largest usage first) down to
+        the soft watermark. Step 2: still over soft — shed QUEUED
+        queries through the admission gate (running queries and the
+        raft plane are never touched)."""
+        now = time.monotonic()
+        with self._lock:
+            if not force and now - self._last_rebalance \
+                    < _REBALANCE_INTERVAL_S:
+                due = False
+            else:
+                self._last_rebalance = now
+                due = True
+        usage = self.usage()
+        used = sum(usage.values())
+        if not due:
+            return used
+        soft, _hard = self.watermarks()
+        if used <= soft:
+            return used
+        # step 1: evictable pools, largest first
+        with self._lock:
+            pools = dict(self._pools)
+        for name in sorted(usage, key=lambda n: usage[n], reverse=True):
+            p = pools.get(name)
+            if p is None or p.reclaim is None:
+                continue
+            need = used - soft
+            if need <= 0:
+                break
+            try:
+                freed = int(p.reclaim(need) or 0)
+            except Exception:
+                count(name, "reclaim_error")
+                freed = 0
+            if freed > 0:
+                count(name, "reclaim")
+                self._event(name, "reclaim", freed)
+                used = self.used()
+        if used <= soft:
+            return used
+        # step 2: shed queued queries (admission gate hook, wired by
+        # the http server; embedded processes simply have no queue)
+        gate = _GATE.get("gate")
+        if gate is not None:
+            shed = gate.shed_queued(retry_after=_retry_after(used, soft))
+            if shed:
+                count("admission", "shed_queued", shed)
+                self._event("admission", "shed_queued", 0)
+        return used
+
+    # -------------------------------------------------------- write path
+    def write_admit(self, est_bytes: int = 0) -> None:
+        """Gate one user-ingress write (Coordinator.write_points).
+
+        Below soft: free. Above hard: fail closed (MemoryExceeded —
+        accepting the write would grow the memcache pool the node
+        already cannot flush fast enough). Between: bounded delay
+        polling for flush progress; sheds WriteBackpressure with a
+        Retry-After derived from the observed drain rate when the
+        delay budget runs out."""
+        used = self.rebalance()
+        soft, hard = self.watermarks()
+        if used + est_bytes <= soft:
+            return
+        if used >= hard:
+            count("write", "fail_hard")
+            self._event("write", "fail_hard", est_bytes)
+            raise MemoryExceeded(
+                f"node above hard memory watermark "
+                f"({used}/{hard} bytes) — write failed closed",
+                used=used, hard=hard)
+        # bounded delay: wait for the flush/reclaim machinery to drain
+        # the pools below soft, never past the request's own deadline
+        budget = deadline_mod.cap_current(max(WRITE_DELAY_MS, 0) / 1e3)
+        t0 = time.monotonic()
+        used0 = used
+        while time.monotonic() - t0 < budget:
+            time.sleep(min(0.02, budget))
+            used = self.rebalance(force=True)
+            if used + est_bytes <= soft:
+                count("write", "delayed")
+                return
+            if used >= hard:
+                count("write", "fail_hard")
+                self._event("write", "fail_hard", est_bytes)
+                raise MemoryExceeded(
+                    f"node crossed hard memory watermark during "
+                    f"write delay ({used}/{hard} bytes)",
+                    used=used, hard=hard)
+        # delay budget exhausted: derive Retry-After from the drain
+        # rate actually observed while we waited (flush progress)
+        elapsed = max(time.monotonic() - t0, 1e-3)
+        rate = (used0 - used) / elapsed          # bytes/s, may be <= 0
+        over = used + est_bytes - soft
+        eta = over / rate if rate > 0 else _retry_after(used, soft)
+        count("write", "backpressure_shed")
+        self._event("write", "backpressure_shed", est_bytes)
+        raise WriteBackpressure(
+            f"write shed by memory backpressure ({used} bytes in use, "
+            f"soft watermark {soft})",
+            retry_after=round(min(max(eta, 0.5), 10.0), 2))
+
+
+def _retry_after(used: int, soft: int) -> float:
+    """Fallback Retry-After when no drain rate is observable: scale
+    with the overage fraction, clamped to [0.5, 5] seconds."""
+    over = max(used - soft, 0) / max(soft, 1)
+    return round(min(0.5 + 4.5 * min(over, 1.0), 5.0), 2)
+
+
+BROKER = MemoryBroker()
+
+# admission-gate hook (server/http.py wires the process gate in; a dict
+# so embedded tests can install/remove a fake without import dances)
+_GATE: dict = {}
+
+
+def set_admission_gate(gate) -> None:
+    _GATE["gate"] = gate
+
+
+# ---------------------------------------------------- per-query accounts
+class QueryMemory:
+    """Byte account for ONE request, hung off its Deadline. Charges are
+    cumulative-live (charge/release); crossing the budget kills the
+    query with a typed MemoryExceeded — concurrent in-budget queries
+    are untouched. No lock: a query's charges happen on its own worker
+    threads with the deadline already safely published, and a lost
+    race on `used` skews one estimate, never corrupts a result."""
+
+    __slots__ = ("budget", "used", "peak")
+
+    def __init__(self, budget: int):
+        self.budget = int(budget)
+        self.used = 0
+        self.peak = 0
+
+    def charge(self, n: int, site: str, qid=None) -> None:
+        self.used += int(n)
+        if self.used > self.peak:
+            self.peak = self.used
+        if self.budget and self.used > self.budget:
+            count("query", "killed")
+            BROKER._event("query", "killed", self.used)
+            raise MemoryExceeded(
+                f"query memory budget exceeded at {site} "
+                f"({self.used} > {self.budget} bytes)",
+                qid=qid, site=site)
+
+    def release(self, n: int) -> None:
+        self.used = max(0, self.used - int(n))
+
+
+def query_mem() -> QueryMemory | None:
+    """The ambient request's memory account (created on first use), or
+    None when the plane is off / no deadline context is installed."""
+    if not enabled():
+        return None
+    dl = deadline_mod.current()
+    if dl is None:
+        return None
+    qm = dl.mem
+    if qm is None:
+        qm = dl.mem = QueryMemory(PER_QUERY_BYTES)
+    return qm
+
+
+def charge_query(n: int, site: str) -> None:
+    """Charge `n` bytes to the ambient query (no-op when the plane is
+    off or the caller has no request context)."""
+    if n <= 0:
+        return
+    qm = query_mem()
+    if qm is None:
+        return
+    dl = deadline_mod.current()
+    count("query", "charge")
+    qm.charge(n, site, qid=dl.qid if dl is not None else None)
+
+
+def release_query(n: int) -> None:
+    if n <= 0:
+        return
+    qm = query_mem()
+    if qm is not None:
+        qm.release(n)
+
+
+# ------------------------------------------------------- module facades
+def register_pool(name: str, usage_fn=None, reclaim=None) -> None:
+    BROKER.register_pool(name, usage_fn, reclaim)
+
+
+def book(name: str, n: int, action: str = "book") -> None:
+    if enabled():
+        BROKER.book(name, n, action)
+
+
+def unbook(name: str, n: int) -> None:
+    if enabled():
+        BROKER.unbook(name, n)
+
+
+def write_admit(est_bytes: int = 0) -> None:
+    if enabled():
+        BROKER.write_admit(est_bytes)
+
+
+def maybe_rebalance() -> None:
+    """Cheap ladder checkpoint for non-write entry points (query
+    ingress): throttled internally, reads only counters when idle."""
+    if enabled():
+        BROKER.rebalance()
+
+
+def debug_snapshot() -> dict:
+    """GET /debug/memory payload."""
+    soft, hard = BROKER.watermarks()
+    usage = BROKER.usage()
+    return {
+        "enabled": enabled(),
+        "total_bytes": BROKER.total(),
+        "soft_bytes": soft,
+        "hard_bytes": hard,
+        "used_bytes": sum(usage.values()),
+        "pools": usage,
+        "per_query_budget_bytes": PER_QUERY_BYTES,
+        "group_budget_bytes": GROUP_BYTES,
+        "recent_events": BROKER.events_snapshot(),
+        "counters": {f"{p}/{a}": v
+                     for (p, a), v in sorted(counters_snapshot().items())},
+    }
+
+
+def control(payload: dict) -> dict:
+    """Runtime control behind the `_memory` RPC (chaos memory_pressure
+    nemesis): {"total_bytes": N} squeezes/restores the broker budget
+    (0 = back to config/auto); {} just reads the snapshot back."""
+    out: dict = {"ok": True}
+    if "total_bytes" in payload:
+        BROKER.resize(int(payload["total_bytes"]))
+        count("broker", "resize")
+    out["snapshot"] = debug_snapshot()
+    return out

@@ -135,6 +135,34 @@ def invalidate_owner(owner: str, table: str | None = None) -> int:
     return invalidate(tenant, db, table)
 
 
+def _serving_bytes_used() -> int:
+    s = cache_stats()
+    return s["plan_cache"][1] + s["result_cache"][1]
+
+
+def _serving_reclaim(target_bytes: int) -> int:
+    """Broker reclaim: shrink result caches LRU-first across every
+    registered plane — plan caches are entry-capped and tiny, results
+    hold the bytes."""
+    freed = 0
+    for p in list(_PLANES):
+        if freed >= target_bytes:
+            break
+        freed += p.result_cache.reclaim(target_bytes - freed)
+    return freed
+
+
+def _register_serving_pool() -> None:
+    from . import memory as _memory
+
+    _memory.register_pool("serving",
+                          usage_fn=_serving_bytes_used,
+                          reclaim=_serving_reclaim)
+
+
+_register_serving_pool()
+
+
 # ------------------------------------------------------------ fingerprint
 # scalars whose value depends on call time / session — a cached plan or
 # result would freeze them (the executor folds the current_* family at
@@ -460,6 +488,18 @@ class ResultCache:
             e = self._entries.pop(key, None)
             if e is not None:
                 self._bytes -= e.nbytes
+
+    def reclaim(self, target_bytes: int) -> int:
+        """Memory-broker shrink: pop LRU entries until `target_bytes`
+        are freed — a lost entry is just a cache miss."""
+        freed = 0
+        with self._lock:
+            while self._entries and freed < target_bytes:
+                _k, ev = self._entries.popitem(last=False)
+                freed += ev.nbytes
+                _count_serving("result_cache", "evict")
+            self._bytes = max(0, self._bytes - freed)
+        return freed
 
     def invalidate(self, tenant, db, table=None) -> int:
         with self._lock:

@@ -32,8 +32,14 @@ from ..models.strcol import DictArray, as_object_array
 from ..ops.tpu_exec import AggSpec, TpuQuery, execute_scan_aggregate
 from ..parallel.coordinator import Coordinator
 from ..parallel.meta import MetaStore
+from ..server import memory as memgov
 from ..utils import stages
 from ..utils import lockwatch
+from .. import faults
+
+faults.register_point("memory.spill", __name__,
+                      desc="group-state spill file publish "
+                           "(tmp+fsync+rename)")
 from . import ast
 from . import expr as expr_mod
 from . import relational as rel
@@ -3121,7 +3127,9 @@ class QueryExecutor:
                     tenant, db, plan.table, time_ranges=rw.scan_ranges,
                     tag_domains=plan.tag_domains,
                     field_names=needed_fields, page_filter=plan.filter)
-            with self.memory_pool.reservation(_batches_bytes(batches),
+            nbytes = _batches_bytes(batches)
+            memgov.charge_query(nbytes, "scan")
+            with self.memory_pool.reservation(nbytes,
                                               f"scan of {plan.table}"):
                 return self._exec_aggregate_seeded(plan, batches,
                                                    phys_aggs, finalize,
@@ -3136,10 +3144,20 @@ class QueryExecutor:
             tenant, db, plan.table, time_ranges=plan.time_ranges,
             tag_domains=plan.tag_domains, field_names=needed_fields,
             page_filter=plan.filter, compressed_spec=cspec)
-        with self.memory_pool.reservation(_batches_bytes(batches),
+        nbytes = _batches_bytes(batches)
+        memgov.charge_query(nbytes, "scan")
+        with self.memory_pool.reservation(nbytes,
                                           f"scan of {plan.table}"):
             return self._exec_aggregate_batches(plan, batches, phys_aggs,
                                                 finalize)
+
+    def _group_spiller(self, plan, phys_aggs):
+        """Per-aggregate group-state guard: a GroupSpiller when the
+        memory plane is on, else the branch-free no-op (legacy path is
+        byte-identical — the hooks do nothing)."""
+        if not memgov.enabled() or memgov.GROUP_BYTES <= 0:
+            return _NoSpill()
+        return GroupSpiller(plan, phys_aggs, memgov.GROUP_BYTES)
 
     def _matview_rewrite(self, plan, phys_aggs, tenant: str, db: str):
         """Try the materialized-rollup subsumption rewrite; None keeps
@@ -3180,11 +3198,17 @@ class QueryExecutor:
                                                               len(batches) or 1))),
                      aggs=phys_aggs)
         jobs = [launch_scan_aggregate(batch, q) for batch in batches]
-        with stages.stage("merge_ms"):
-            for job in jobs:
-                self._poll_cancel()
-                r = finish_scan_aggregate(job)
-                _merge_partial(acc, r, plan, phys_aggs)
+        spiller = self._group_spiller(plan, phys_aggs)
+        try:
+            with stages.stage("merge_ms"):
+                for job in jobs:
+                    self._poll_cancel()
+                    r = finish_scan_aggregate(job)
+                    _merge_partial(acc, r, plan, phys_aggs)
+                    spiller.observe(acc)
+            acc = spiller.finish(acc)
+        finally:
+            spiller.close()
         if not acc and not plan.group_tags \
                 and not plan.group_fields and plan.bucket is None:
             acc[()] = {}  # SQL: a global aggregate always yields one row
@@ -3223,11 +3247,18 @@ class QueryExecutor:
                 results = [finish_scan_aggregate(
                     launch_scan_aggregate(b, q)) for b in kernel_batches]
             acc: dict[tuple, dict] = {}
-            with stages.stage("merge_ms"):
-                for r in results:
-                    _merge_partial(acc, r, plan, phys_aggs)
-                for b in batches:
-                    _merge_compressed_partials(acc, b, plan, phys_aggs)
+            spiller = self._group_spiller(plan, phys_aggs)
+            try:
+                with stages.stage("merge_ms"):
+                    for r in results:
+                        _merge_partial(acc, r, plan, phys_aggs)
+                        spiller.observe(acc)
+                    for b in batches:
+                        _merge_compressed_partials(acc, b, plan, phys_aggs)
+                        spiller.observe(acc)
+                acc = spiller.finish(acc)
+            finally:
+                spiller.close()
             if not acc and not plan.group_tags \
                     and not plan.group_fields and plan.bucket is None:
                 acc[()] = {}  # SQL: a global aggregate always yields one row
@@ -3264,8 +3295,14 @@ class QueryExecutor:
                     return self._finalize_single(plan, merged, phys_aggs,
                                                  finalize)
             acc: dict[tuple, dict] = {}
-            for r in results:
-                _merge_partial(acc, r, plan, phys_aggs)
+            spiller = self._group_spiller(plan, phys_aggs)
+            try:
+                for r in results:
+                    _merge_partial(acc, r, plan, phys_aggs)
+                    spiller.observe(acc)
+                acc = spiller.finish(acc)
+            finally:
+                spiller.close()
             if not acc and not plan.group_tags \
                     and not plan.group_fields and plan.bucket is None:
                 acc[()] = {}
@@ -3274,12 +3311,18 @@ class QueryExecutor:
         # first, then merge per batch
         jobs = [launch_scan_aggregate(batch, q) for batch in batches]
         acc: dict[tuple, dict] = {}
-        for batch, job in zip(batches, jobs):
-            self._poll_cancel()  # KILL QUERY lands between vnode fetches
-            r = finish_scan_aggregate(job)
-            _merge_partial(acc, r, plan, phys_aggs)
-            for spec in distinct_specs:
-                _merge_distinct(acc, batch, plan, spec)
+        spiller = self._group_spiller(plan, phys_aggs)
+        try:
+            for batch, job in zip(batches, jobs):
+                self._poll_cancel()  # KILL QUERY lands between vnode fetches
+                r = finish_scan_aggregate(job)
+                _merge_partial(acc, r, plan, phys_aggs)
+                for spec in distinct_specs:
+                    _merge_distinct(acc, batch, plan, spec)
+                spiller.observe(acc)
+            acc = spiller.finish(acc)
+        finally:
+            spiller.close()
         if not acc and not plan.group_tags \
                 and not plan.group_fields and plan.bucket is None:
             acc[()] = {}  # SQL: a global aggregate always yields one row
@@ -3386,7 +3429,9 @@ class QueryExecutor:
             tenant, db, plan.table, time_ranges=plan.time_ranges,
             tag_domains=plan.tag_domains, field_names=field_names,
             fingerprint=sv.current_fp() if sv is not None else None)
-        with self.memory_pool.reservation(_batches_bytes(batches),
+        nbytes = _batches_bytes(batches)
+        memgov.charge_query(nbytes, "scan")
+        with self.memory_pool.reservation(nbytes,
                                           f"scan of {plan.table}"):
             return self._exec_raw_batches(plan, batches)
 
@@ -3772,6 +3817,225 @@ def _batches_bytes(batches) -> int:
         for _vt, vals, valid in b.fields.values():
             total += getattr(vals, "nbytes", 0) + getattr(valid, "nbytes", 0)
     return total
+
+
+# ------------------------------------------------- group-state spilling
+def _acc_group_bytes(acc: dict) -> int:
+    """Rough live bytes of a group accumulator (keys + partial values;
+    sets/collect chunks dominate wide states)."""
+    total = 0
+    for key, parts in acc.items():
+        total += 64 + 16 * len(key)
+        for v in parts.values():
+            if isinstance(v, set):
+                total += 64 + 64 * len(v)
+            elif isinstance(v, list):
+                total += 64
+                for ch in v:
+                    if isinstance(ch, tuple):
+                        total += sum(int(getattr(c, "nbytes", 16) or 16)
+                                     for c in ch)
+                    else:
+                        total += int(getattr(ch, "nbytes", 16) or 16)
+            else:
+                total += 16 + int(getattr(v, "nbytes", 0) or 0)
+    return total
+
+
+def _merge_spill_entry(dst: dict, src: dict, phys_aggs):
+    """Fold a LATER spill fragment's parts into an EARLIER one for the
+    same group key. Semantics mirror _merge_partial per func exactly
+    (count add, sum left-fold, min/max combine, first/last by strict
+    timestamp so the earlier epoch wins ties, distinct-set union,
+    collect-chunk extend in arrival order) — spilled and in-memory
+    execution finalize bit-identically."""
+    for a in phys_aggs:
+        al = a.alias
+        if a.func in ("first", "last"):
+            if al not in src:
+                continue
+            v = src[al]
+            ts = src.get(al + "__ts")
+            cur = dst.get(al)
+            cur_ts = dst.get(al + "__ts")
+            better = (cur is None or cur_ts is None
+                      or (a.func == "first" and ts < cur_ts)
+                      or (a.func == "last" and ts > cur_ts))
+            if better:
+                dst[al] = v
+                dst[al + "__ts"] = ts
+            continue
+        if al not in src:
+            continue
+        v = src[al]
+        cur = dst.get(al)
+        if a.func in ("count", "count_multi"):
+            dst[al] = (cur or 0) + int(v)
+        elif a.func == "sum":
+            dst[al] = v if cur is None else cur + v
+        elif a.func == "min":
+            dst[al] = v if cur is None else min(cur, v)
+        elif a.func == "max":
+            dst[al] = v if cur is None else max(cur, v)
+        elif a.func == "count_distinct":
+            if cur is None:
+                dst[al] = v
+            else:
+                cur.update(v)
+        elif a.func in ("collect", "collect_ts", "collect2"):
+            if cur is None:
+                dst[al] = v
+            else:
+                cur.extend(v)
+
+
+class _NoSpill:
+    """Disabled-plane spiller: the aggregate paths call the same three
+    hooks unconditionally, so the legacy path stays branch-free."""
+
+    spill_count = 0
+    spilled_bytes = 0
+
+    def observe(self, acc) -> None:
+        pass
+
+    def finish(self, acc) -> dict:
+        return acc
+
+    def close(self) -> None:
+        pass
+
+
+class GroupSpiller:
+    """Bounds group-by accumulator memory by spilling partial state to
+    disk, bit-identically to the in-memory fold.
+
+    Epoch discipline: the first time the live accumulator crosses the
+    budget, its whole contents spill as epoch 0 and EVERY subsequent
+    observe() spills unconditionally — each later epoch therefore holds
+    at most one batch's contribution per key, so replaying epochs in
+    order reproduces the exact left-fold association the in-memory path
+    would have used (float sums stay bit-identical, first/last ties
+    resolve to the same arrival). Entries carry their (epoch, position)
+    of first appearance; the finished accumulator is rebuilt in global
+    (epoch, pos) order, which is first-appearance insertion order —
+    _finalize_aggregate's row order is unchanged.
+
+    Files publish atomically (tmp + fsync + rename) behind the
+    ``memory.spill`` fault point; key space is partitioned by stable
+    hash so finish() holds one partition in memory at a time."""
+
+    PARTITIONS = 8
+
+    def __init__(self, plan, phys_aggs, budget_bytes: int):
+        self.plan = plan
+        self.phys_aggs = phys_aggs
+        self.budget = int(budget_bytes)
+        self._dir: str | None = None
+        self._epoch = 0
+        self._engaged = False
+        self._booked = 0
+        self._closed = False
+        self.spill_count = 0
+        self.spilled_bytes = 0
+
+    # ------------------------------------------------------------ hooks
+    def observe(self, acc: dict) -> None:
+        est = _acc_group_bytes(acc)
+        if self._engaged or (self.budget and est > self.budget):
+            self._spill(acc, est)
+            return
+        delta = est - self._booked
+        if delta > 0:
+            memgov.book("query_groups", delta, action="grow")
+            self._booked = est
+            # charge BEFORE growing further: an over-budget query dies
+            # here with MemoryExceeded while in-budget neighbors run on
+            memgov.charge_query(delta, "group_state")
+
+    def finish(self, acc: dict) -> dict:
+        if not self._engaged:
+            return acc
+        self._spill(acc, _acc_group_bytes(acc))   # live tail → last epoch
+        merged: list[tuple[int, int, tuple, dict]] = []
+        for p in range(self.PARTITIONS):
+            merged.extend(self._merge_partition(p))
+        merged.sort(key=lambda e: (e[0], e[1]))
+        out = {key: parts for _e, _pos, key, parts in merged}
+        memgov.count("query_groups", "unspill")
+        return out
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        if self._booked:
+            memgov.unbook("query_groups", self._booked)
+            memgov.release_query(self._booked)
+            self._booked = 0
+        if self._dir is not None:
+            import shutil
+
+            shutil.rmtree(self._dir, ignore_errors=True)
+            self._dir = None
+
+    # --------------------------------------------------------- internals
+    def _spill(self, acc: dict, est: int) -> None:
+        if not acc:
+            return
+        self._engaged = True
+        if self._dir is None:
+            import tempfile
+
+            self._dir = tempfile.mkdtemp(prefix="cnosdb-spill-")
+        by_part: dict[int, list] = {}
+        for pos, (key, parts) in enumerate(acc.items()):
+            by_part.setdefault(hash(key) % self.PARTITIONS, []) \
+                .append((pos, key, parts))
+        import pickle
+
+        for p, entries in by_part.items():
+            path = os.path.join(self._dir,
+                                f"p{p:02d}_e{self._epoch:06d}.spill")
+            tmp = path + ".tmp"
+            with open(tmp, "wb") as f:
+                pickle.dump(entries, f, protocol=pickle.HIGHEST_PROTOCOL)
+                f.flush()
+                os.fsync(f.fileno())
+            if faults.ENABLED:
+                faults.fire("memory.spill", path=path, epoch=self._epoch)
+            os.rename(tmp, path)
+            self.spilled_bytes += os.path.getsize(path)
+        self._epoch += 1
+        self.spill_count += 1
+        memgov.count("query_groups", "spill")
+        stages.count("group_spill", 1)
+        acc.clear()
+        if self._booked:
+            memgov.unbook("query_groups", self._booked)
+            memgov.release_query(self._booked)
+            self._booked = 0
+
+    def _merge_partition(self, p: int) -> list[tuple[int, int, tuple, dict]]:
+        import pickle
+
+        assert self._dir is not None
+        names = sorted(n for n in os.listdir(self._dir)
+                       if n.startswith(f"p{p:02d}_e")
+                       and n.endswith(".spill"))
+        part: dict[tuple, list] = {}   # key → [epoch, pos, parts]
+        for name in names:
+            epoch = int(name[len(f"p{p:02d}_e"):-len(".spill")])
+            with open(os.path.join(self._dir, name), "rb") as f:
+                entries = pickle.load(f)
+            for pos, key, parts in entries:
+                cur = part.get(key)
+                if cur is None:
+                    part[key] = [epoch, pos, parts]
+                else:
+                    _merge_spill_entry(cur[2], parts, self.phys_aggs)
+        return [(e, pos, key, parts)
+                for key, (e, pos, parts) in part.items()]
 
 
 def _out_name(it: ast.SelectItem) -> str:

@@ -45,6 +45,14 @@ class TsKv:
         # (owner, vnode_id) flush notifications — set by the materialized
         # rollup maintainer; must be cheap and non-blocking
         self.flush_listener = None
+        # memory-governance plane: the memcache pool (active + immutable
+        # caches = the unflushed-WAL rows) is reclaimed by flushing.
+        # Registration is latest-wins, matching engine lifetime in-process.
+        from ..server import memory as _memory
+
+        _memory.register_pool("memcache",
+                              usage_fn=self.memcache_bytes_used,
+                              reclaim=self._reclaim_memcache)
 
     # ---------------------------------------------------------------- vnodes
     def vnode_dir(self, owner: str, vnode_id: int) -> str:
@@ -150,6 +158,37 @@ class TsKv:
                     self._compact_pending.discard(key)
 
         self._compactor.submit(run)
+
+    def memcache_bytes_used(self) -> int:
+        """Unflushed bytes across every open vnode (active + immutable
+        caches) — the memcache pool's usage feed. Dirty read by design:
+        a write racing this sum skews one broker sample, never a
+        result."""
+        total = 0
+        for v in list(self.vnodes.values()):
+            caches = [v.active, *v.immutables]
+            total += sum(c.approx_bytes for c in caches)
+        return total
+
+    def _reclaim_memcache(self, target_bytes: int) -> int:
+        """Broker reclaim callback: flush the fattest vnodes until
+        `target_bytes` have been persisted (or nothing is left). Runs on
+        whichever thread crossed the watermark — flushing inline IS the
+        backpressure."""
+        before = self.memcache_bytes_used()
+        with self.lock:
+            victims = sorted(
+                self.vnodes.values(),
+                key=lambda v: sum(c.approx_bytes
+                                  for c in [v.active, *v.immutables]),
+                reverse=True)
+        freed = 0
+        for v in victims:
+            if freed >= target_bytes:
+                break
+            v.flush(sync=False)
+            freed = before - self.memcache_bytes_used()
+        return max(0, freed)
 
     def flush_all(self, sync: bool = True):
         with self.lock:

@@ -14,7 +14,35 @@ import numpy as np
 from ..models.points import SeriesRows
 from ..models.schema import ValueType
 
-_APPROX_ROW_BYTES = 48
+# Per-row bookkeeping overhead charged on top of the payload bytes:
+# timestamp (8) + WAL seq share + python list/chunk slots. The old flat
+# _APPROX_ROW_BYTES = 48 heuristic ignored dtypes entirely, so a
+# string-heavy workload blew far past the configured cap before
+# should_flush() noticed while a sparse float workload flushed early;
+# sizing is now dtype-aware (see _series_rows_bytes).
+_ROW_OVERHEAD_BYTES = 16
+
+
+def _series_rows_bytes(sr: SeriesRows) -> int:
+    """Dtype-aware payload estimate for one appended chunk: actual
+    ndarray nbytes where the chunk is typed, element sizes otherwise
+    (strings cost their encoded length + an object-header share), plus
+    8 bytes per row of timestamps and the per-row overhead."""
+    n = len(sr.timestamps)
+    total = n * (8 + _ROW_OVERHEAD_BYTES)
+    for _name, (vt, vals) in sr.fields.items():
+        nb = getattr(vals, "nbytes", None)
+        if nb is not None:                      # typed ndarray chunk
+            total += int(nb)
+            continue
+        if vt == int(ValueType.STRING):
+            for v in vals:
+                total += (len(v) if isinstance(v, (str, bytes)) else 0) + 49
+        elif vt == int(ValueType.BOOLEAN):
+            total += len(vals)
+        else:                                   # numeric python lists
+            total += 8 * len(vals)
+    return total
 
 
 class SeriesData:
@@ -164,6 +192,10 @@ class MemCache:
         self.max_bytes = max_bytes
         self.series: dict[tuple[str, int], SeriesData] = {}
         self.approx_bytes = 0
+        # row-column count (rows × (1 + fields)) kept separately so the
+        # reference's usage gauge stays exact while approx_bytes carries
+        # the real dtype-aware payload size
+        self.rowcols = 0
         self.min_seq: int | None = None
         self.max_seq: int = 0
         self.min_ts = 2**63 - 1
@@ -178,7 +210,8 @@ class MemCache:
             sd = self.series[key] = SeriesData(sid, table)
         sd.append(sr, seq)
         nb = len(sr.timestamps)
-        self.approx_bytes += nb * _APPROX_ROW_BYTES * (1 + len(sr.fields))
+        self.approx_bytes += _series_rows_bytes(sr)
+        self.rowcols += nb * (1 + len(sr.fields))
         if self.min_seq is None:
             self.min_seq = seq
         self.max_seq = max(self.max_seq, seq)
@@ -201,10 +234,8 @@ class MemCache:
         """The reference's cache-memory estimate (80 bytes per
         row-column: a 1-row single-field write reads 160 —
         vnode_cache_size.slt), decoupled from the flush-threshold
-        accounting so gauge parity can't change flush cadence.
-        approx_bytes is always a multiple of 48, so the rescale is
-        exact."""
-        return self.approx_bytes * 80 // 48
+        accounting so dtype-aware sizing can't change gauge parity."""
+        return self.rowcols * 80
 
     def mark_immutable(self):
         self.immutable = True

@@ -26,9 +26,22 @@ from ..models.strcol import DictArray, as_dict_part as _as_dict_part, \
     unify_dictionaries
 from .memcache import MemCache, _group_starts
 from .vnode import VnodeStorage
+from ..server import memory as memgov
 from ..utils import lockwatch
 from ..utils import stages
 from . import compressed_domain
+
+
+def _charge_decoded(batch):
+    """Per-query accounting for one assembled vnode batch (decode-pool
+    bytes): an oversized query dies here with MemoryExceeded before the
+    next vnode materializes."""
+    nb = batch.ts.nbytes + batch.sid_ordinal.nbytes
+    for _vt, vals, valid in batch.fields.values():
+        nb += int(getattr(vals, "nbytes", 0) or 0)
+        nb += int(getattr(valid, "nbytes", 0) or 0)
+    memgov.charge_query(nb, "decode")
+    return batch
 
 
 @dataclass
@@ -480,7 +493,7 @@ def scan_vnode(vnode: VnodeStorage, table: str,
                                    n_threads, upload_hook, decode_hook,
                                    compressed_spec)
         if batch is not None:
-            return batch
+            return _charge_decoded(batch)
 
     ts_parts, ord_parts = [], []
     fparts: dict[str, list[tuple[int, np.ndarray, np.ndarray]]] = {n: [] for n in field_names}
@@ -535,8 +548,9 @@ def scan_vnode(vnode: VnodeStorage, table: str,
             vals_all[off:off + len(vals)] = vals
             valid_all[off:off + len(valid)] = valid
         out_fields[name] = (vt, vals_all, valid_all)
-    return ScanBatch(table, np.array(kept_sids, dtype=np.uint64), keys,
-                     ts_all, ord_all, out_fields)
+    return _charge_decoded(
+        ScanBatch(table, np.array(kept_sids, dtype=np.uint64), keys,
+                  ts_all, ord_all, out_fields))
 
 
 # ---------------------------------------------------------------------------

@@ -180,6 +180,34 @@ def block_cache_clear() -> None:
         _cache_bytes = 0
 
 
+def _block_cache_reclaim(target_bytes: int) -> int:
+    """Broker reclaim callback: shed LRU block-cache entries until
+    `target_bytes` are freed — a lost block is just a re-fetch."""
+    global _cache_bytes
+    freed = 0
+    with _cache_lock:
+        while _cache and freed < target_bytes:
+            oldest = next(iter(_cache))
+            freed += len(_cache.pop(oldest))
+        _cache_bytes = max(0, _cache_bytes - freed)
+    return freed
+
+
+def _register_block_cache_pool() -> None:
+    # module-level cache, module-level (import-time) registration: the
+    # memory-governance broker can shrink the cold block cache when the
+    # node crosses its soft watermark
+    from ..server import memory as _memory
+
+    _memory.register_pool(
+        "block_cache",
+        usage_fn=lambda: block_cache_stats()["bytes"],
+        reclaim=_block_cache_reclaim)
+
+
+_register_block_cache_pool()
+
+
 # ---------------------------------------------------------------------------
 # per-vnode cold registry (cold.json)
 # ---------------------------------------------------------------------------

@@ -63,7 +63,7 @@ class Deadline:
     """
 
     __slots__ = ("expires_at", "qid", "cancelled", "cancel_reason",
-                 "remote_nodes")
+                 "remote_nodes", "mem")
 
     def __init__(self, timeout_s: float | None = None, qid: str | None = None):
         self.expires_at = (time.monotonic() + timeout_s) \
@@ -72,6 +72,11 @@ class Deadline:
         self.cancelled = False
         self.cancel_reason = ""
         self.remote_nodes: set[str] = set()
+        # per-query memory account (server/memory.QueryMemory), created
+        # lazily on first charge; rides the deadline so every layer the
+        # deadline already reaches (scan assembly, decode pools, RPC
+        # hops) can charge the same request without new plumbing
+        self.mem = None
 
     def remaining(self) -> float | None:
         """Seconds left, None if unbounded. May be <= 0 once expired."""
@@ -145,6 +150,7 @@ def derived(qid: str | None) -> Deadline:
     d = Deadline(None, qid=qid)
     if parent is not None:
         d.expires_at = parent.expires_at
+        d.mem = parent.mem   # one query, one memory account
     return d
 
 

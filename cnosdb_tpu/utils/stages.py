@@ -55,6 +55,8 @@ STAGE_CATALOG: dict[str, str] = {
     "finalize_ms": "vectorized finalizers + output rendering",
     "factorize_ms": "group-key factorization (values → dense codes)",
     "group_count": "output group cardinality per query",
+    "group_spill": "group-by accumulator epochs spilled to disk by the "
+                   "memory broker's GroupSpiller (sql/executor.py)",
     "distinct_path.sort": "count(DISTINCT) via host sorted pair codes",
     "distinct_path.device": "count(DISTINCT) via the jax segment kernels",
     "distinct_path.fallback": "count(DISTINCT) via the scalar set fold",

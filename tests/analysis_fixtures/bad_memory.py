@@ -1,0 +1,28 @@
+"""Fixture: memory-ladder exits that skip cnosdb_memory_total
+accounting (lines 13 and 15). Mirrors the guarded function names so the
+rule finds its targets when scope is ignored; the bare return at 11,
+the booked backpressure raise at 17-18, the Name return at 19-20, the
+Name return at 25-26 and the booked terminal raise at 27-28 are legal
+shapes and must stay silent."""
+
+
+def write_admit(used, soft, hard, count, est_bytes=0):
+    if used + est_bytes <= soft:
+        return
+    if used >= hard:
+        raise MemoryError("failed closed over hard watermark")
+    if est_bytes < 0:
+        return []
+    if used > soft:
+        count("write", "backpressure_shed")
+        raise MemoryError("write shed by backpressure")
+    headroom = hard - used
+    return headroom
+
+
+def rebalance(usage, soft, count):
+    used = sum(usage.values())
+    if used <= soft:
+        return used
+    count("admission", "shed_queued")
+    raise MemoryError("still over soft after reclaim")

@@ -227,7 +227,7 @@ def test_fault_point_registry_covers_every_site():
                     "tsm.write", "scrub.read", "objstore.get",
                     "objstore.put", "matview.persist", "tiering.registry",
                     "serving.invalidate", "backup.archive",
-                    "backup.manifest", "restore.install"}
+                    "backup.manifest", "restore.install", "memory.spill"}
     cluster = set(faults.registered_points(scope="cluster"))
     assert cluster == {"rpc.send", "rpc.response", "rpc.server",
                        "rpc.reply", "meta.propose", "meta.apply"}

@@ -537,3 +537,86 @@ def test_slow_replica_brownout_tail_bounded(cluster):
         f"(healthy p99 {healthy_p99:.3f}s)"
     # healed: same bytes, breaker-free path
     assert _csv_rows(qnode.sql(q, db="dgray"))[0] == baseline
+
+
+def _memory_rpc(node, payload: dict) -> dict:
+    return rpc_call(f"127.0.0.1:{node.rpc_port}", "_memory",
+                    payload, timeout=5.0)
+
+
+def test_memory_pressure_fails_writes_closed_then_heals(cluster, tmp_path):
+    """memory_pressure nemesis: squeeze one node's memory broker to a
+    1-byte budget over the `_memory` runtime RPC (the harness-direct
+    action nemesis.event_specs prescribes for this kind). The squeezed
+    node must degrade exactly as the ladder says — user-ingress writes
+    fail CLOSED with a typed 413 (never hang, never ack-then-lose),
+    while reads keep answering and raft replication from the healthy
+    nodes continues ungated — and restoring the budget heals it: writes
+    through the ex-victim succeed again and the recorded history passes
+    the checker on every node's final state."""
+    import urllib.error
+
+    from cnosdb_tpu.chaos import nemesis
+    from cnosdb_tpu.chaos.history import History, HistoryRecorder
+
+    n1 = cluster.nodes[0]
+    n1.sql("CREATE DATABASE dmemp WITH SHARD 1 REPLICA 3", db="public")
+    rec = HistoryRecorder(str(tmp_path / "memp.jsonl"))
+    cl = _Client(rec, "mp", "dmemp")
+
+    acked: set[str] = set()
+    acked.update(cl.write(n1, "w", 20))
+    assert acked, "healthy-cluster write must ack"
+    assert _wait_keys(n1, "mp", "dmemp", acked) == acked
+
+    ev = nemesis.NemesisEvent(step=0, kind="memory_pressure", node=2,
+                              param=1)
+    assert nemesis.event_specs(ev, "unused", seed=13) == ("", ""), \
+        "memory_pressure is harness-direct: no fault-spec injection"
+    victim = cluster.nodes[ev.node]
+    healthy = [n for n in cluster.nodes if n is not victim]
+
+    # squeeze: total=1 byte → soft=hard=0, so after the ladder reclaims
+    # everything it can, any write with a nonzero estimate lands on the
+    # fail-closed branch — deterministic, no timing window
+    out = _memory_rpc(victim, {"total_bytes": ev.param})
+    assert out["ok"] and out["snapshot"]["total_bytes"] == ev.param
+    try:
+        # recorded writes through the victim bounce (fail == not acked)
+        for _ in range(3):
+            assert cl.write(victim, "w", 5) == [], \
+                "write acked through a node above its hard watermark"
+        # the rejection is typed at the HTTP edge: 413 MemoryExceeded
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            victim.write_lp(f"mp,k=kx v=1 {NEM_BASE}", db="dmemp")
+        assert ei.value.code == 413, \
+            f"expected 413 fail-closed, got {ei.value.code}"
+        # the healthy majority keeps acking; replication to the victim
+        # rides the raft plane, which the broker never touches — the
+        # victim still converges and still answers reads
+        got = cl.write(healthy[0], "w", 10)
+        assert got, "healthy node refused writes during peer's squeeze"
+        acked.update(got)
+        assert _wait_keys(victim, "mp", "dmemp", acked, timeout=60.0) \
+            == acked, "squeezed node stopped applying replicated writes"
+        assert cl.read(victim, "rv") == acked
+        # the broker booked the degradation: fail-closed writes counted
+        snap = _memory_rpc(victim, {})["snapshot"]
+        assert snap["counters"].get("write/fail_hard", 0) >= 4
+    finally:
+        # heal: 0 = back to config/auto budget
+        out = _memory_rpc(victim, {"total_bytes": 0})
+    assert out["ok"] and out["snapshot"]["total_bytes"] > (1 << 20)
+
+    # healed: the ex-victim acks user writes again, promptly
+    t0 = time.monotonic()
+    got = cl.write(victim, "w", 5)
+    assert got, "ex-victim still refusing writes after heal"
+    assert time.monotonic() - t0 < 30.0, "post-heal write did not recover"
+    acked.update(got)
+    rec.close()
+
+    h = History.load(str(tmp_path / "memp.jsonl"))
+    for n in cluster.nodes:
+        final = _wait_keys(n, "mp", "dmemp", acked, timeout=90.0)
+        _assert_checks(h, final, f"memory_pressure, node {n.node_id}")

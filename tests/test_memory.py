@@ -1,0 +1,392 @@
+"""Memory-governance plane tests (cnosdb_tpu/server/memory.py).
+
+Covers the broker's degradation ladder (pool reclaim largest-first →
+queued-query shed → write backpressure → fail-closed), the dtype-aware
+memcache sizing that replaced the flat 48-byte row heuristic, per-query
+accounting kills, spill-to-disk group-by state (bit-identical to the
+in-memory path AND to the CNOSDB_MEMORY=0 legacy path), and the HTTP
+status mapping for the new typed errors. Global knobs the tests touch
+(GROUP_BYTES, PER_QUERY_BYTES, WRITE_DELAY_MS, the broker override and
+the admission-gate hook) are always saved and restored.
+"""
+import os
+import threading
+
+import numpy as np
+import pytest
+
+from cnosdb_tpu.errors import (AdmissionRejected, MemoryExceeded,
+                               WriteBackpressure)
+from cnosdb_tpu.parallel.coordinator import Coordinator
+from cnosdb_tpu.parallel.meta import MetaStore
+from cnosdb_tpu.server import memory as memgov
+from cnosdb_tpu.server.admission import AdmissionGate
+from cnosdb_tpu.sql.executor import QueryExecutor, Session
+from cnosdb_tpu.storage.engine import TsKv
+from cnosdb_tpu.utils import deadline as dmod
+
+
+@pytest.fixture
+def db(tmp_path):
+    meta = MetaStore(str(tmp_path / "meta.json"))
+    engine = TsKv(str(tmp_path / "data"))
+    coord = Coordinator(meta, engine)
+    ex = QueryExecutor(meta, coord)
+    yield ex
+    engine.close()
+
+
+@pytest.fixture
+def gate_hook():
+    """Snapshot/restore the broker's admission-gate hook."""
+    prev = memgov._GATE.get("gate")
+    yield
+    memgov.set_admission_gate(prev)
+
+
+def _delta(c0, c1, pool, action):
+    return c1.get((pool, action), 0) - c0.get((pool, action), 0)
+
+
+# ------------------------------------------------------------- the ladder
+def test_rebalance_reclaims_largest_pool_first(gate_hook):
+    b = memgov.MemoryBroker()
+    usage = {"a": 600, "b": 300}
+    calls = []
+
+    def reclaim(name):
+        def run(need):
+            calls.append((name, need))
+            freed, usage[name] = usage[name], 0
+            return freed
+        return run
+
+    b.register_pool("a", usage_fn=lambda: usage["a"], reclaim=reclaim("a"))
+    b.register_pool("b", usage_fn=lambda: usage["b"], reclaim=reclaim("b"))
+    memgov.set_admission_gate(None)
+    b.resize(1000)                       # soft 700, hard 900; used 900
+    used = b.rebalance(force=True)
+    # largest pool reclaimed first, and ONLY it — freeing 600 puts the
+    # node back under soft, so 'b' must survive untouched
+    assert calls == [("a", 200)]
+    assert used == 300 and usage["b"] == 300
+
+
+def test_rebalance_sheds_queued_queries_when_reclaim_insufficient(gate_hook):
+    b = memgov.MemoryBroker()
+    b.register_pool("pinned", usage_fn=lambda: 900)   # nothing evictable
+
+    class FakeGate:
+        def __init__(self):
+            self.retry_afters = []
+
+        def shed_queued(self, retry_after=1.0):
+            self.retry_afters.append(retry_after)
+            return 3
+
+    g = FakeGate()
+    memgov.set_admission_gate(g)
+    c0 = memgov.counters_snapshot()
+    b.resize(1000)
+    b.rebalance(force=True)
+    c1 = memgov.counters_snapshot()
+    assert len(g.retry_afters) == 1
+    assert 0.5 <= g.retry_afters[0] <= 5.0
+    assert _delta(c0, c1, "admission", "shed_queued") == 3
+
+
+def test_write_admit_free_below_soft(gate_hook):
+    b = memgov.MemoryBroker()
+    memgov.set_admission_gate(None)
+    b.resize(1000)
+    b.write_admit(10)                    # no pools, used 0: must not block
+
+
+def test_write_admit_fails_closed_above_hard(gate_hook):
+    b = memgov.MemoryBroker()
+    b.register_pool("pinned", usage_fn=lambda: 950)
+    memgov.set_admission_gate(None)
+    b.resize(1000)                       # hard 900
+    c0 = memgov.counters_snapshot()
+    with pytest.raises(MemoryExceeded):
+        b.write_admit(10)
+    assert _delta(c0, memgov.counters_snapshot(), "write", "fail_hard") == 1
+
+
+def test_write_admit_bounded_delay_admits_on_drain(gate_hook):
+    """Between soft and hard the write waits for flush progress: the
+    first reclaim attempt fails, the in-loop forced rebalance drains
+    the pool, and the write goes through counted as 'delayed'."""
+    b = memgov.MemoryBroker()
+    state = {"usage": 800, "attempts": 0}
+
+    def reclaim(_need):
+        state["attempts"] += 1
+        if state["attempts"] < 2:
+            return 0                     # flush not done yet
+        freed, state["usage"] = state["usage"], 0
+        return freed
+
+    b.register_pool("mc", usage_fn=lambda: state["usage"], reclaim=reclaim)
+    memgov.set_admission_gate(None)
+    prev_delay = memgov.WRITE_DELAY_MS
+    memgov.WRITE_DELAY_MS = 1000
+    c0 = memgov.counters_snapshot()
+    try:
+        b.resize(1000)                   # soft 700 < used 800 < hard 900
+        b.write_admit(10)                # must return, not raise
+    finally:
+        memgov.WRITE_DELAY_MS = prev_delay
+    assert state["attempts"] >= 2
+    assert _delta(c0, memgov.counters_snapshot(), "write", "delayed") == 1
+
+
+def test_write_admit_sheds_backpressure_when_drain_stalls(gate_hook):
+    b = memgov.MemoryBroker()
+    b.register_pool("stuck", usage_fn=lambda: 800)    # never drains
+    memgov.set_admission_gate(None)
+    prev_delay = memgov.WRITE_DELAY_MS
+    memgov.WRITE_DELAY_MS = 60           # keep the test fast
+    c0 = memgov.counters_snapshot()
+    try:
+        b.resize(1000)
+        with pytest.raises(WriteBackpressure) as ei:
+            b.write_admit(10)
+    finally:
+        memgov.WRITE_DELAY_MS = prev_delay
+    assert 0.5 <= ei.value.retry_after <= 10.0
+    assert _delta(c0, memgov.counters_snapshot(),
+                  "write", "backpressure_shed") == 1
+
+
+def test_admission_gate_sheds_queued_waiter_with_retry_after():
+    gate = AdmissionGate(max_concurrent=1, max_queued=4)
+    gate.acquire()                       # occupy the only slot
+    queued = threading.Event()
+    err: list = []
+
+    def waiter():
+        queued.set()
+        try:
+            gate.acquire()
+            gate.release()
+        except AdmissionRejected as e:
+            err.append(e)
+
+    t = threading.Thread(target=waiter)
+    t.start()
+    queued.wait(5)
+    # let the waiter actually enter the queue before shedding it
+    deadline = dmod.Deadline(timeout_s=5)
+    while gate.stats()["queued"] == 0 and not deadline.dead():
+        pass
+    shed = gate.shed_queued(retry_after=2.5)
+    t.join(5)
+    gate.release()
+    assert shed == 1
+    assert len(err) == 1 and err[0].retry_after == 2.5
+    assert "memory" in str(err[0])
+
+
+# ------------------------------------------------ dtype-aware memcache
+def test_memcache_sizing_is_dtype_aware():
+    """Regression for the flat _APPROX_ROW_BYTES=48 heuristic: 100 rows
+    of 1 KiB strings are ~105 KiB of real payload, which the old sizing
+    booked as 100×2×48 ≈ 9.4 KiB — never flushing a 64 KiB cache. The
+    same row count of floats stays far under the cap."""
+    from cnosdb_tpu.models.points import SeriesRows
+    from cnosdb_tpu.models.schema import ValueType
+    from cnosdb_tpu.models.series import SeriesKey
+    from cnosdb_tpu.storage.memcache import MemCache, _series_rows_bytes
+
+    ts = list(range(100))
+    heavy = SeriesRows(SeriesKey("t", {}), ts,
+                       {"s": (int(ValueType.STRING), ["x" * 1024] * 100)})
+    assert _series_rows_bytes(heavy) >= 100 * 1024
+
+    mc = MemCache(1, max_bytes=64 * 1024)
+    mc.write_series("t", 1, heavy, seq=1)
+    assert mc.should_flush(), \
+        "string-heavy cache crossed its byte cap without noticing"
+
+    light = SeriesRows(SeriesKey("t", {}), ts,
+                       {"v": (int(ValueType.FLOAT),
+                              np.zeros(100, dtype=np.float64))})
+    mc2 = MemCache(1, max_bytes=64 * 1024)
+    mc2.write_series("t", 1, light, seq=1)
+    assert not mc2.should_flush(), \
+        "float cache flushed at ~3 KiB of real payload"
+
+    # gauge parity: the reference's 80-bytes-per-row-column usage gauge
+    # (vnode_cache_size.slt) is decoupled from flush sizing — identical
+    # shapes read identical regardless of dtype
+    assert mc.usage_size == mc2.usage_size == 100 * 2 * 80
+
+
+# -------------------------------------------------- spill-to-disk groups
+def _spill_bed(db):
+    db.execute_one("CREATE DATABASE sp WITH SHARD 4")
+    s = Session(database="sp")
+    db.execute_one("CREATE TABLE w (v DOUBLE, TAGS(h))", s)
+    rng = np.random.default_rng(7)
+    rows = []
+    for i in range(400):
+        # mixed magnitudes make float-sum association observable: any
+        # reordering of the fold shows up in the low-order bits
+        v = 1e15 if i % 17 == 0 else float(rng.normal(0.1, 0.05))
+        rows.append(f"({1_700_000_000_000_000_000 + i * 1_000_000}, "
+                    f"'h{i % 7}', {v!r})")
+    db.execute_one("INSERT INTO w (time, h, v) VALUES " + ", ".join(rows),
+                   s)
+
+    def q(u: int) -> str:
+        # the u-varying predicate matches every row (no h is ever 'zzN'):
+        # identical answer, but a fresh query text defeats the serving
+        # result cache so each run truly reaches the accumulator
+        return (f"SELECT h, count(DISTINCT v), sum(v), min(v), max(v) "
+                f"FROM w WHERE h <> 'zz{u}' GROUP BY h")
+
+    return s, q
+
+
+def test_group_spill_is_bit_identical(db):
+    """The acceptance oracle: a 4-shard count(DISTINCT) group-by with
+    the group budget squeezed to 1 byte spills every epoch to disk and
+    must reproduce the in-memory answer EXACTLY — same float bits, same
+    row order — and both must match the CNOSDB_MEMORY=0 legacy path."""
+    s, q = _spill_bed(db)
+    base = db.execute_one(q(0), s).rows()
+    assert len(base) == 7
+
+    prev = memgov.GROUP_BYTES
+    memgov.GROUP_BYTES = 1
+    c0 = memgov.counters_snapshot()
+    try:
+        spilled = db.execute_one(q(1), s).rows()
+    finally:
+        memgov.GROUP_BYTES = prev
+    c1 = memgov.counters_snapshot()
+    assert _delta(c0, c1, "query_groups", "spill") >= 1, \
+        "1-byte group budget never engaged the spiller"
+    assert _delta(c0, c1, "query_groups", "unspill") >= 1
+    assert spilled == base
+
+    # legacy path: plane off ignores the squeezed budget entirely
+    prev_env = os.environ.get("CNOSDB_MEMORY")
+    os.environ["CNOSDB_MEMORY"] = "0"
+    memgov.GROUP_BYTES = 1
+    c2 = memgov.counters_snapshot()
+    try:
+        legacy = db.execute_one(q(2), s).rows()
+    finally:
+        memgov.GROUP_BYTES = prev
+        if prev_env is None:
+            os.environ.pop("CNOSDB_MEMORY", None)
+        else:
+            os.environ["CNOSDB_MEMORY"] = prev_env
+    assert _delta(c2, memgov.counters_snapshot(),
+                  "query_groups", "spill") == 0
+    assert legacy == base
+
+
+def test_group_spill_crash_point_is_registered():
+    from cnosdb_tpu import faults
+    import cnosdb_tpu.sql.executor  # noqa: F401  (registers the point)
+
+    assert "memory.spill" in faults.registered_points(scope="node")
+
+
+# ---------------------------------------------------- per-query accounts
+def test_query_memory_charge_release_peak():
+    qm = memgov.QueryMemory(100)
+    qm.charge(60, "scan")
+    qm.release(30)
+    qm.charge(50, "scan")
+    assert (qm.used, qm.peak) == (80, 80)
+    with pytest.raises(MemoryExceeded) as ei:
+        qm.charge(30, "group_state", qid="q9")
+    assert "group_state" in str(ei.value)
+
+
+def test_per_query_budget_kills_only_the_oversized_query(db):
+    db.execute_one("CREATE TABLE big (v DOUBLE, TAGS(h))")
+    rows = ", ".join(
+        f"({1_700_000_000_000_000_000 + i * 1_000_000}, 'h{i % 4}', {i}.5)"
+        for i in range(5000))
+    db.execute_one("INSERT INTO big (time, h, v) VALUES " + rows)
+
+    # the filtered count scans 1250 rows (~30 KB live); the full SELECT
+    # materializes all 5000 (~120 KB): a 64 KiB budget cleaves them
+    prev = memgov.PER_QUERY_BYTES
+    memgov.PER_QUERY_BYTES = 64 * 1024
+    try:
+        results: dict = {}
+
+        def small(i):
+            with dmod.scope(dmod.Deadline(timeout_s=30, qid=f"s{i}")):
+                rs = db.execute_one(
+                    "SELECT count(*) FROM big WHERE h = 'h0'")
+                results[i] = int(rs.columns[0][0])
+
+        ths = [threading.Thread(target=small, args=(i,)) for i in range(3)]
+        for t in ths:
+            t.start()
+        c0 = memgov.counters_snapshot()
+        with dmod.scope(dmod.Deadline(timeout_s=30, qid="big")):
+            with pytest.raises(MemoryExceeded):
+                db.execute_one("SELECT time, h, v FROM big")
+        for t in ths:
+            t.join()
+        assert _delta(c0, memgov.counters_snapshot(),
+                      "query", "killed") >= 1
+        # the oversized query died alone: its in-budget neighbors
+        # finished with correct answers
+        assert results == {0: 1250, 1: 1250, 2: 1250}
+    finally:
+        memgov.PER_QUERY_BYTES = prev
+
+
+def test_plane_off_disables_accounting_and_admission(gate_hook):
+    prev_env = os.environ.get("CNOSDB_MEMORY")
+    os.environ["CNOSDB_MEMORY"] = "0"
+    try:
+        assert memgov.query_mem() is None
+        with dmod.scope(dmod.Deadline(timeout_s=5)):
+            memgov.charge_query(1 << 40, "scan")     # no-op, no kill
+        memgov.write_admit(1 << 40)                  # facade gates on env
+    finally:
+        if prev_env is None:
+            os.environ.pop("CNOSDB_MEMORY", None)
+        else:
+            os.environ["CNOSDB_MEMORY"] = prev_env
+
+
+# ------------------------------------------------------- observability
+def test_debug_snapshot_and_runtime_control():
+    out = memgov.control({"total_bytes": 12345})
+    try:
+        assert out["ok"]
+        assert out["snapshot"]["total_bytes"] == 12345
+    finally:
+        out = memgov.control({"total_bytes": 0})     # back to auto
+    snap = out["snapshot"]
+    assert snap["total_bytes"] >= (1 << 30)          # auto floor
+    assert {"enabled", "total_bytes", "soft_bytes", "hard_bytes",
+            "used_bytes", "pools", "per_query_budget_bytes",
+            "group_budget_bytes", "recent_events",
+            "counters"} <= set(snap)
+    assert snap["soft_bytes"] < snap["hard_bytes"] < snap["total_bytes"]
+    # the counters fold as cnosdb_memory_total{pool,action} cells
+    assert all("/" in k for k in snap["counters"])
+
+
+def test_http_status_mapping_for_memory_errors():
+    from cnosdb_tpu.server import http as http_mod
+
+    assert http_mod._status_for(MemoryExceeded("too big")) == 413
+    assert http_mod._status_for(
+        WriteBackpressure("shed", retry_after=2.2)) == 503
+    resp = http_mod._err_response(
+        503, WriteBackpressure("shed", retry_after=2.2))
+    assert resp.headers["Retry-After"] == "2"
+    assert http_mod._status_for(AdmissionRejected("queue full")) == 503
