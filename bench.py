@@ -34,6 +34,15 @@ import time
 
 import numpy as np
 
+# the mesh scaling suite (bench_suites.run_mesh) sweeps 1→2→4→8 mesh
+# devices; widen the host platform's virtual device pool up front — XLA
+# reads the flag once at backend init, long before the suite runs.
+# Harmless on accelerator runs: only the cpu device pool widens.
+_xla_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _xla_flags:
+    os.environ["XLA_FLAGS"] = (
+        _xla_flags + " --xla_force_host_platform_device_count=8").strip()
+
 TARGET_ROWS = int(os.environ.get("CNOSDB_BENCH_ROWS", 100_000_000))
 STR_ROWS = max(10_000, TARGET_ROWS // 10)   # hits-style string table
 N_URLS = 1000
@@ -852,6 +861,13 @@ def main():
             "value": round(headline[0], 1),
             "unit": "rows/s",
             "vs_baseline": round(headline[1], 3),
+            # structured relay verdict: null on a healthy device run,
+            # else the probe's reason this bench fell back to CPU jax
+            # (e.g. "TPU relay unresponsive (probe timeout)" after the
+            # CNOSDB_BENCH_PROBE_TIMEOUT cap) — machine-readable, not
+            # just the re-exec's stderr tail
+            "fallback_reason": os.environ.get("CNOSDB_BENCH_PROBE")
+            or None,
             "n_rows": n_rows,
             "ingest_rows_per_s": round(n_rows / ingest_s, 1),
             "compact_s": round(compact_s, 1),

@@ -1445,6 +1445,162 @@ def run_overload(executor, coord, tenant, db, session) -> dict:
     return out
 
 
+def run_mesh(executor, coord, tenant, db, session) -> dict:
+    """Mesh execution plane scaling suite (ops/mesh_exec.py +
+    parallel/distributed_agg.py): the TSBS `double_groupby` shape
+    (host × 1h-bucket, count/sum/min/max) over an 8-shard table, swept
+    across 1 → 2 → 4 → 8 mesh devices via CNOSDB_MESH_DEVICES (get_mesh
+    re-reads it per query, so the sweep runs in-process against the same
+    scan snapshot), plus the CNOSDB_MESH=0 legacy per-batch kernel
+    fan-out + host `_merge_results_vec` as the host-merge baseline.
+
+    Timings are warm steady state: the scan cache and the lane's prep
+    cache are hot, so every mesh iteration measures collective + assemble
+    and every legacy iteration measures kernel fan-out + host merge —
+    the per-stage breakdown (`mesh.collective_ms` vs `kernel_ms` +
+    `merge_ms`) is the collective-vs-host-merge comparison the sweep
+    exists for.
+
+    Correctness headlines: `bit_identical` (every mesh config's answer
+    repr-equals the legacy oracle, so NaN/-0.0/dtype drift would fail)
+    and `zero_host_merges` (every engaged query booked
+    `cnosdb_mesh_total{merge,collective}` and no host-merge hop).
+    `speedup_8x` is p50(1 device) / p50(8 devices); on hosts with fewer
+    physical cores than mesh devices the virtual devices timeshare and
+    the sweep cannot scale — `host_cores` + `speedup_note` record that
+    instead of pretending."""
+    from cnosdb_tpu.models.points import SeriesRows, WriteBatch
+    from cnosdb_tpu.models.schema import ValueType
+    from cnosdb_tpu.models.series import SeriesKey
+    from cnosdb_tpu.ops.placement import mesh_devices
+    from cnosdb_tpu.parallel import mesh
+    from cnosdb_tpu.sql.executor import Session
+    from cnosdb_tpu.utils import stages as _stages
+
+    rows = int(os.environ.get("CNOSDB_BENCH_MESH_ROWS", "1000000"))
+    iters = int(os.environ.get("CNOSDB_BENCH_MESH_ITERS", "5"))
+    n_hosts = 32
+    executor.execute_one(
+        "CREATE DATABASE IF NOT EXISTS meshbench WITH SHARD 8 REPLICA 1",
+        session)
+    ms = Session(database="meshbench")
+    per = max(64, rows // n_hosts)
+    span_ns = 48 * 3_600_000_000_000            # ~48 one-hour buckets
+    step = max(span_ns // per, 1)
+    rng = np.random.default_rng(41)
+    for h in range(n_hosts):
+        ts = BASE_TS + np.arange(per, dtype=np.int64) * step + h
+        wb = WriteBatch()
+        wb.add_series("dg", SeriesRows(
+            SeriesKey("dg", {"host": f"host_{h:02d}"}), ts,
+            {"v": (int(ValueType.FLOAT), rng.normal(50, 10, per))}))
+        coord.write_points(tenant, "meshbench", wb)
+    coord.engine.flush_all()
+    coord.engine.compact_all()
+
+    q = ("SELECT host, date_bin(INTERVAL '1 hour', time) AS t, "
+         "count(*) AS c, sum(v) AS sv, min(v) AS mn, max(v) AS mx "
+         "FROM dg GROUP BY host, t")
+
+    def norm(rs):
+        return (rs.names, [repr(c.tolist()) for c in rs.columns],
+                [str(c.dtype) for c in rs.columns])
+
+    keep_stages = ("kernel_ms", "merge_ms", "finalize_ms",
+                   "mesh.plan_ms", "mesh.upload_ms", "mesh.collective_ms",
+                   "mesh.assemble_ms", "mesh.plan_cache_hit",
+                   "mesh.plan_cache_miss")
+
+    def timed_pass():
+        """→ (p50_ms, p99_ms, mean per-stage ms, outcome deltas, norm)."""
+        executor.execute_one(q, ms)     # scan + prep caches, jit warm
+        executor.execute_one(q, ms)     # settled steady state
+        c0 = mesh.outcomes_snapshot()
+        lat, snaps, rs = [], [], None
+        for _ in range(iters):
+            prof = _stages.QueryProfile()
+            t0 = time.perf_counter()
+            with _stages.profile_scope(prof):
+                rs = executor.execute_one(q, ms)
+            lat.append(time.perf_counter() - t0)
+            snaps.append(prof.snapshot())
+        c1 = mesh.outcomes_snapshot()
+        a = np.sort(np.asarray(lat))
+        stg = {}
+        for k in keep_stages:
+            tot = sum(s.get(k, 0) for s in snaps)
+            if tot:
+                stg[k] = round(tot / iters, 3)
+        outcomes = {f"{lane}:{reason}": v - c0.get((lane, reason), 0)
+                    for (lane, reason), v in c1.items()
+                    if v - c0.get((lane, reason), 0)}
+        return (round(float(np.percentile(a, 50)) * 1e3, 2),
+                round(float(np.percentile(a, 99)) * 1e3, 2),
+                stg, outcomes, norm(rs))
+
+    knobs = ("CNOSDB_MESH", "CNOSDB_MESH_DEVICES",
+             "CNOSDB_MESH_MIN_DEVICES", "CNOSDB_MESH_MIN_ROWS")
+    prev_env = {k: os.environ.get(k) for k in knobs}
+    prev_serving = executor.serving
+    # repeats must reach the aggregate path, not the serving result cache
+    executor.serving = None
+    avail = len(mesh_devices())
+    out: dict = {"rows": n_hosts * per, "hosts": n_hosts, "iters": iters,
+                 "host_cores": len(os.sched_getaffinity(0)),
+                 "devices_available": avail, "devices": {}}
+    identical = True
+    zero_host = True
+    try:
+        os.environ["CNOSDB_MESH_MIN_ROWS"] = "0"
+        os.environ["CNOSDB_MESH_MIN_DEVICES"] = "1"
+
+        # legacy host-merge baseline: per-batch kernels + vec merge
+        os.environ["CNOSDB_MESH"] = "0"
+        p50, p99, stg, outc, oracle = timed_pass()
+        assert outc.get("exec:engaged", 0) == 0, outc
+        out["legacy"] = {"p50_ms": p50, "p99_ms": p99, "stages": stg}
+
+        os.environ["CNOSDB_MESH"] = "1"
+        for d in (1, 2, 4, 8):
+            if d > avail:
+                out["devices"][str(d)] = {
+                    "skipped": f"only {avail} devices in the pool"}
+                continue
+            os.environ["CNOSDB_MESH_DEVICES"] = str(d)
+            p50, p99, stg, outc, got = timed_pass()
+            engaged = outc.get("exec:engaged", 0)
+            ok = engaged == iters \
+                and outc.get("merge:collective", 0) == engaged \
+                and not outc.get("merge:host", 0)
+            zero_host = zero_host and ok
+            identical = identical and got == oracle
+            out["devices"][str(d)] = {
+                "p50_ms": p50, "p99_ms": p99, "stages": stg,
+                "outcomes": outc, "bit_identical": got == oracle}
+        d1 = out["devices"].get("1", {}).get("p50_ms")
+        d8 = out["devices"].get("8", {}).get("p50_ms")
+        if d1 and d8:
+            out["speedup_8x"] = round(d1 / d8, 2)
+            out["speedup_vs_host_merge"] = round(
+                out["legacy"]["p50_ms"] / d8, 2)
+            if out["speedup_8x"] < 3.0 and out["host_cores"] < 8:
+                out["speedup_note"] = (
+                    f"{out['host_cores']} physical core(s) timeshare all "
+                    f"8 virtual devices — the collective runs its shard "
+                    f"programs serially here; scaling needs >= one core "
+                    f"per mesh device")
+    finally:
+        executor.serving = prev_serving
+        for k, v in prev_env.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    out["bit_identical"] = identical
+    out["zero_host_merges"] = zero_host
+    return out
+
+
 def run_suites(executor, coord, tenant, db, session) -> dict:
     out: dict = {}
     t0 = time.perf_counter()
@@ -1497,4 +1653,8 @@ def run_suites(executor, coord, tenant, db, session) -> dict:
                                        session)
     except Exception as e:   # memory-governance plane must not sink it
         out["overload"] = {"error": repr(e)[:200]}
+    try:
+        out["mesh"] = run_mesh(executor, coord, tenant, db, session)
+    except Exception as e:   # mesh execution plane must not sink the run
+        out["mesh"] = {"error": repr(e)[:200]}
     return out

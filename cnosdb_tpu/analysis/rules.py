@@ -1249,6 +1249,86 @@ class MemoryAccounting(Rule):
                            f"so the lint keeps covering it")
 
 
+# --------------------------------------------------------------------------
+# 20. mesh-accounting — new (PR 20): no silent mesh-lane exits
+# --------------------------------------------------------------------------
+_MA_FUNCS = {
+    "cnosdb_tpu/ops/mesh_exec.py": ("try_mesh_aggregate",),
+}
+_MA_ACCOUNTING = {"count_outcome", "_declined", "count_error"}
+
+
+def _ma_has_accounting(node: ast.AST) -> bool:
+    for n in ast.walk(node):
+        if isinstance(n, ast.Call) and _call_name(n) in _MA_ACCOUNTING:
+            return True
+    return False
+
+
+def _ma_success_return(stmt: ast.AST) -> bool:
+    """``return <name>`` — handing back a merged AggResult is the
+    engaged shape (booked just above the return); bails return None /
+    a literal and must book why."""
+    return isinstance(stmt, ast.Return) and isinstance(stmt.value, ast.Name)
+
+
+class MeshAccounting(Rule):
+    name = "mesh-accounting"
+    motivation = ("PR 20 mesh execution plane: every query the mesh lane "
+                  "declines must book a (lane, reason) outcome into "
+                  "cnosdb_mesh_total — an unaccounted early return/raise "
+                  "is a silent fall-through to the host msgpack merge, "
+                  "and those counters are the only proof on-mesh merges "
+                  "actually stay collective instead of quietly regressing "
+                  "to per-batch host hops")
+
+    def applies_to(self, relpath):
+        return relpath in _MA_FUNCS
+
+    def begin_module(self, ctx):
+        want = _MA_FUNCS.get(ctx.relpath)
+        guarded = want is not None
+        if want is None:
+            # scope-ignored run (fixtures/self-tests): lint any function
+            # bearing a guarded name, but skip the presence check
+            want = tuple({n for names in _MA_FUNCS.values()
+                          for n in names})
+        found = set()
+        for fn in ast.walk(ctx.tree):
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    or fn.name not in want:
+                continue
+            found.add(fn.name)
+            terminal = fn.body[-1]
+            for block in _dda_blocks(fn):
+                for i, stmt in enumerate(block):
+                    if not isinstance(stmt, (ast.Return, ast.Raise)) \
+                            or stmt is terminal:
+                        continue
+                    # accounting may land anywhere earlier in the same
+                    # block (engaged exits book both lane counters, then
+                    # return the merged result)
+                    if _ma_has_accounting(stmt) \
+                            or _ma_success_return(stmt) \
+                            or any(_ma_has_accounting(prev)
+                                   for prev in block[:i]):
+                        continue
+                    kind = "return" if isinstance(stmt, ast.Return) \
+                        else "raise"
+                    ctx.report(self, stmt,
+                               f"unaccounted early {kind} in {fn.name} — "
+                               f"mesh-lane exits must book a reason "
+                               f"(count_outcome/_declined) so silent "
+                               f"host-merge fallbacks stay visible on "
+                               f"/metrics")
+        for name in want if guarded else ():
+            if name not in found:
+                ctx.report(self, 1,
+                           f"mesh guarded function {name} not found — "
+                           f"if it was renamed, update analysis/rules.py "
+                           f"so the lint keeps covering it")
+
+
 def all_rules() -> list:
     from .interproc import project_rules
 
@@ -1258,4 +1338,5 @@ def all_rules() -> list:
             DeviceDecodeAccounting(), StringFilterAccounting(),
             ColdTierAccounting(), ServingAccounting(), BackupAccounting(),
             FaultSiteCoverage(), CompressedDomainAccounting(),
-            HedgeAccounting(), MemoryAccounting(), *project_rules()]
+            HedgeAccounting(), MemoryAccounting(), MeshAccounting(),
+            *project_rules()]

@@ -15,7 +15,7 @@ import random
 from dataclasses import dataclass
 
 KINDS = ("partition", "crash_restart", "delay_storm", "corrupt",
-         "slow_replica", "memory_pressure")
+         "slow_replica", "memory_pressure", "device_loss")
 # disaster-recovery kinds, never mixed into the default rotation: both
 # destroy data on purpose (total_loss wipes a node's data dir,
 # operator_error drops a whole database) and are only survivable when
@@ -70,6 +70,12 @@ def event_specs(ev: NemesisEvent, victim_addr: str,
         # (prob=1) so tail-latency bounds are measurable. Peers stay
         # clean; this is the scenario the hedged-scan plane exists for.
         return (prefix + f"rpc.server:delay({ev.param})", "")
+    if ev.kind == "device_loss":
+        # kill a mesh participant mid-collective: the mesh exec lane's
+        # merge kernel dies on the victim, which must book device_loss
+        # and answer through the legacy host/RPC merge — clients see the
+        # same answers throughout (the checker holds them to it)
+        return (prefix + "mesh.collective:fail", "")
     if ev.kind == "corrupt":
         # flip bytes of the next file the victim's scrubber verifies —
         # at-rest corruption the integrity plane must catch and repair

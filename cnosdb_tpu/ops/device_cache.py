@@ -370,6 +370,17 @@ def _put(a: np.ndarray):
     return jax.device_put(a, scan_device())
 
 
+def put_sharded(a: np.ndarray, mesh, spec):
+    """Upload one host array laid out for the execution mesh: rows split
+    over the named shard axis per `spec` (a PartitionSpec). The mesh exec
+    lane (ops/mesh_exec.py) stages every operand through here so sharded
+    uploads book the same `upload_bytes` the single-device path does."""
+    from jax.sharding import NamedSharding
+
+    stages.count("upload_bytes", int(getattr(a, "nbytes", 0)))
+    return jax.device_put(a, NamedSharding(mesh, spec))
+
+
 def device_batch(batch) -> DeviceBatch:
     """Get-or-build the device twin of a ScanBatch (attached to it)."""
     db = getattr(batch, "_device_batch", None)

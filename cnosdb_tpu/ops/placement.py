@@ -42,6 +42,20 @@ def _probe_pipe_mbs(dev) -> float:
     return worst
 
 
+def mesh_devices() -> list:
+    """Device pool for the execution mesh (parallel/mesh.get_mesh): every
+    device on the platform `scan_device()` resolved to. The same pipe
+    probe that demotes single-device kernels to host numpy also governs
+    the mesh — a degraded relay means the scan device is CPU, and the
+    mesh then spans the (virtual) host devices instead of streaming every
+    shard through the thin transport."""
+    dev = scan_device()
+    try:
+        return list(jax.devices(dev.platform))
+    except Exception:
+        return [dev]
+
+
 def scan_device():
     """The device the fused scan kernels (and DeviceBatches) live on."""
     global _placement_device

@@ -397,6 +397,45 @@ def host_group_layout(batch: ScanBatch, group_tags: list[str],
         gf_codes=gf_codes)
 
 
+def host_row_mask(batch: ScanBatch, flt) -> np.ndarray | None:
+    """Filter-passing row mask with the exact semantics of the host scan
+    path below (three-valued logic, missing-column handling, conjunctive
+    per-column NULL masking) — shared with the mesh exec lane
+    (ops/mesh_exec.py) so sharded and single-device answers agree on the
+    same row set. None means no filter (every row participates)."""
+    if flt is None:
+        return None
+    n = batch.n_rows
+    env = _filter_env(batch, needed=flt.columns())
+    has_is_null = _contains_is_null(flt)
+    missing = [c for c in flt.columns() if c not in env]
+    if missing and not has_is_null:
+        # a schema column with no data in this vnode is all-NULL here:
+        # any comparison on it matches nothing
+        return np.zeros(n, dtype=bool)
+    for c in missing:  # IS NULL paths need the env entries
+        env[c] = np.zeros(n)
+        env[f"__valid__:{c}"] = np.zeros(n, dtype=bool)
+    row_mask = np.asarray(flt.eval(env, np), dtype=bool)
+    if row_mask.shape == ():  # constant predicate
+        row_mask = np.full(n, bool(row_mask))
+    if is_conjunctive(flt):
+        skip = is_null_columns(flt) if has_is_null else set()
+        av_cache = getattr(batch, "_allvalid_cache", None)
+        if av_cache is None:
+            av_cache = batch._allvalid_cache = {}
+        for cname in flt.columns() - skip:
+            f = batch.fields.get(cname)
+            if f is None:
+                continue
+            hit = av_cache.get(cname)
+            if hit is None:
+                hit = av_cache[cname] = bool(f[2].all())
+            if not hit:
+                row_mask &= f[2]
+    return row_mask
+
+
 def launch_scan_aggregate(batch: ScanBatch, query: TpuQuery):
     """Start a scan-aggregate; device kernels are dispatched asynchronously
     so a coordinator can launch every vnode's kernel before fetching any

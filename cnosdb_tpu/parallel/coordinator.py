@@ -1016,6 +1016,13 @@ class Coordinator:
         return merged
 
     def _cache_store(self, key, token, batch):
+        # every batch cached here was decoded by THIS node's scan path:
+        # its rows can upload straight onto the execution mesh, so the
+        # shard-aware planner (ops/mesh_exec) may claim it. Remote
+        # batches (msgpack replies in _scan_remote*) never pass through
+        # and stay off-mesh — the executor merges those over the legacy
+        # RPC path.
+        batch._mesh_local = True
         nb = _batch_nbytes(batch)
         with self._scan_cache_lock:
             old = self._scan_cache.pop(key, None)

@@ -19,7 +19,14 @@ from .. import ops as _ops  # noqa: F401 - x64 config side effect
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-from jax import shard_map
+try:              # jax >= 0.6 exports shard_map at top level (check_vma)
+    from jax import shard_map
+except ImportError:   # jax 0.4.x: experimental module, check_rep kwarg
+    from jax.experimental.shard_map import shard_map as _shard_map_exp
+
+    def shard_map(f, *, mesh, in_specs, out_specs, check_vma=False):
+        return _shard_map_exp(f, mesh=mesh, in_specs=in_specs,
+                              out_specs=out_specs, check_rep=check_vma)
 
 from ..ops.kernels import local_segment_partials, pad_rows, pad_segments, _pad
 from .mesh import SHARD_AXIS, mesh_size
@@ -58,6 +65,99 @@ def _dist_kernel(values, valid, seg_ids, rank, *, mesh: Mesh,
         in_specs=(P(SHARD_AXIS), P(SHARD_AXIS), P(SHARD_AXIS), P(SHARD_AXIS)),
         out_specs=P(), check_vma=False)
     return fn(values, valid, seg_ids, rank)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("mesh", "slots", "num_segments", "wants",
+                              "run_pad"))
+def mesh_merge_kernel(values, valid, seg_ids, rank, run_sums, run_segs, *,
+                      mesh: Mesh, slots: int, num_segments: int,
+                      wants: tuple[str, ...], run_pad: int = 0):
+    """Deterministic-order collective merge for the mesh exec lane
+    (ops/mesh_exec.py): each shard holds up to `slots` whole scan
+    batches, rows carry slot-local segment ids (slot · num_segments +
+    seg), and per-(slot, segment) partials fold in GLOBAL BATCH ORDER —
+    shard-major, slot-minor — after an `all_gather` over the shard axis.
+
+    That fold order is the whole point: `sql/executor._merge_results_vec`
+    adds per-batch partials in batch order with np.add.at, so a psum
+    (whose reduction order XLA owns) could drift f64 sums by an ulp. The
+    unrolled fold reproduces the legacy addition order bit-for-bit;
+    min/max/first/last are order-insensitive and ride the same gather.
+    Output is replicated (P()) — one host fetch serves the coordinator.
+
+    Float sums carry one more ordering constraint: the legacy CPU host
+    kernels are run-aware (ufunc.reduceat per contiguous equal-segment
+    run, then run partials folded per segment in run order —
+    ops.kernels.run_segment_partials), and reduceat's within-run f64
+    association is numpy's PAIRWISE reduce — unreproducible by any
+    row-order device scatter. So when `run_pad` > 0 the host has staged
+    the per-run reduceat partials themselves (`run_sums`, computed with
+    the same numpy call the legacy kernel makes) and `run_segs` maps
+    runs to slot-local segments (unused run slots → the dead segment
+    slots·num_segments, sliced off). The device then folds run partials
+    per segment in run order — bincount-over-runs association,
+    bit-for-bit — and the cross-shard merge below stays collective.
+    run_pad == 0 keeps the flat row-order sum (the legacy flat-scatter
+    branches and integer columns).
+    """
+    want_first = "first" in wants
+    want_last = "last" in wants
+    two_level = run_pad > 0 and "sum" in wants
+
+    def body(v, m, s, r, rsum, rseg):
+        local = local_segment_partials(
+            v, m, s, r, num_segments=slots * num_segments,
+            want_count=True, want_sum="sum" in wants and not two_level,
+            want_min="min" in wants, want_max="max" in wants,
+            want_first=want_first, want_last=want_last)
+        if two_level:
+            # run partials → per-(slot, segment) sums in run order (the
+            # bincount-over-runs association); the dead segment absorbs
+            # unused run slots and is sliced off
+            local["sum"] = jax.ops.segment_sum(
+                rsum, rseg,
+                num_segments=slots * num_segments + 1)[:-1]
+        d = mesh_size(mesh)
+
+        def folded(name, op, cast=None):
+            a = jax.lax.all_gather(local[name], SHARD_AXIS)   # [D, slots·S]
+            a = a.reshape(d * slots, num_segments)            # batch order
+            if cast is not None:
+                a = a.astype(cast)
+            acc = a[0]
+            for k in range(1, d * slots):
+                acc = op(acc, a[k])
+            return acc
+
+        out = {"count": folded("count", jnp.add, cast=jnp.int64)}
+        if "sum" in wants:
+            out["sum"] = folded("sum", jnp.add)
+        if "min" in wants:
+            out["min"] = folded("min", jnp.minimum)
+        if "max" in wants:
+            out["max"] = folded("max", jnp.maximum)
+        for nm, pick in (("first", jnp.argmin), ("last", jnp.argmax)):
+            if nm not in wants:
+                continue
+            ranks = jax.lax.all_gather(local[f"{nm}_rank"], SHARD_AXIS) \
+                .reshape(d * slots, num_segments)
+            vals = jax.lax.all_gather(local[nm], SHARD_AXIS) \
+                .reshape(d * slots, num_segments)
+            # ranks are globally unique per valid row (stable argsort of
+            # the concatenated timestamps), so the arg pick is exact —
+            # ties exist only between empty slots' fill keys
+            win = pick(ranks, axis=0)
+            out[nm] = jnp.take_along_axis(vals, win[None, :], axis=0)[0]
+            out[f"{nm}_rank"] = jnp.take_along_axis(
+                ranks, win[None, :], axis=0)[0]
+        return out
+
+    fn = shard_map(
+        body, mesh=mesh,
+        in_specs=(P(SHARD_AXIS),) * 6,
+        out_specs=P(), check_vma=False)
+    return fn(values, valid, seg_ids, rank, run_sums, run_segs)
 
 
 def merge_distinct_pairs(chunks: list[np.ndarray], n_values: int,

@@ -1049,6 +1049,14 @@ class HttpServer:
             for (lane, reason), n in _cd.outcomes_snapshot().items():
                 self.metrics.set_counter("cnosdb_compressed_domain_total",
                                          n, lane=lane, reason=reason)
+        # mesh exec lane: per-(lane, reason) engage/decline outcomes —
+        # ("merge", "collective") counting is the zero-host-msgpack-hop
+        # witness for on-mesh partial merges
+        _mx = _sys.modules.get("cnosdb_tpu.parallel.mesh")
+        if _mx is not None:
+            for (lane, reason), n in _mx.outcomes_snapshot().items():
+                self.metrics.set_counter("cnosdb_mesh_total", n,
+                                         lane=lane, reason=reason)
         _mv = _sys.modules.get("cnosdb_tpu.sql.matview")
         if _mv is not None:
             for name, n in _mv.counters_snapshot().items():

@@ -3272,6 +3272,22 @@ class QueryExecutor:
             with stages.stage("finalize_ms"):
                 return self._finalize_single(plan, r, phys_aggs, finalize)
         if not distinct_specs:
+            if len(batches) > 1:
+                # mesh-native lane: all batches upload sharded over the
+                # execution mesh and partials merge through XLA
+                # collectives in ONE program — no per-batch host partial,
+                # no host merge. Bit-identical to the fan-out + vec merge
+                # below; any decline (off-mesh replica, unsupported
+                # shape, device loss mid-collective) books its reason in
+                # cnosdb_mesh_total and falls through unchanged.
+                from ..ops import mesh_exec
+
+                self._poll_cancel()
+                mres = mesh_exec.try_mesh_aggregate(batches, q)
+                if mres is not None:
+                    with stages.stage("finalize_ms"):
+                        return self._finalize_single(plan, mres, phys_aggs,
+                                                     finalize)
             with stages.stage("kernel_ms"):
                 self._poll_cancel()
                 if len(batches) > 1:

@@ -139,6 +139,23 @@ STAGE_CATALOG: dict[str, str] = {
                             "micro-batch's shared scan (booked to the "
                             "leader; process-wide delta, so concurrent "
                             "queries' hedges can bleed in)",
+    "mesh.plan_ms": "mesh exec lane: global segment/label layout + "
+                    "shard-major staging (ops/mesh_exec._build_prep)",
+    "mesh.upload_ms": "mesh exec lane: sharded host→device uploads "
+                      "(NamedSharding over the shard axis)",
+    "mesh.collective_ms": "mesh exec lane: collective merge programs — "
+                          "per-shard partials folded over the mesh in "
+                          "batch order (distributed_agg.mesh_merge_"
+                          "kernel) + the replicated-result fetch",
+    "mesh.assemble_ms": "mesh exec lane: merged partials → the legacy "
+                        "vec-merge AggResult shape",
+    "mesh.plan_cache_hit": "mesh prep cache hits — sharded operands "
+                           "reused from the lead batch (warm repeats "
+                           "skip layout + upload)",
+    "mesh.plan_cache_miss": "mesh prep cache misses (layout + sharded "
+                            "upload rebuilt)",
+    "mesh.rows": "rows aggregated through the mesh lane per query",
+    "mesh.shards": "mesh devices participating in the collective merge",
     "hedge.fired": "hedged scan attempts launched at a next-ranked "
                    "replica after the adaptive p95 trigger elapsed",
     "hedge.won": "scans answered by a hedge attempt instead of the "

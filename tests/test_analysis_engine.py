@@ -52,6 +52,7 @@ _CASES = [
      rules_mod.CompressedDomainAccounting(), [9, 20]),
     ("bad_hedge.py", rules_mod.HedgeAccounting(), [12, 15]),
     ("bad_memory.py", rules_mod.MemoryAccounting(), [13, 15]),
+    ("bad_mesh.py", rules_mod.MeshAccounting(), [12, 15]),
     # interprocedural rule family (cnosdb_tpu/analysis/interproc.py)
     ("bad_host_sync.py", interproc.HostSync(), [8, 9, 10, 11]),
     ("bad_recompile.py", interproc.RecompileHazard(), [8, 14]),
